@@ -1,0 +1,60 @@
+"""skypilot-tpu: a TPU-native AI workload orchestrator.
+
+A brand-new framework with the capability set of SkyPilot (reference:
+``sky/__init__.py``): task YAML / SDK front end, cost+availability optimizer
+over a hardware catalog, failover provisioning of multi-host TPU pod slices,
+an on-node runtime daemon with a cluster-local job queue, an async
+client->API-server architecture, managed jobs with preemption recovery, and
+replica-autoscaled serving -- built TPU-first:
+
+* TPU topology (generation / chips / hosts / ICI topology) is a first-class
+  type in ``Resources`` (the reference special-cases TPU names in
+  ``sky/resources.py:990-1014``; here it is ``spec.TpuTopology``).
+* Multi-host gang launch wires ``jax.distributed`` coordinator +
+  ``TPU_WORKER_ID`` env vars across pod hosts (the reference injects
+  NCCL/torchrun-shaped env vars, ``sky/backends/task_codegen.py:626-666``).
+* No Ray: TPU pod slices are created atomically, so gang semantics come from
+  the provisioner + per-host runtime daemon (``runtime/``).
+* The payload story is in-tree and JAX-native: ``models/`` (Llama family,
+  MoE), ``ops/`` (Pallas kernels), ``parallel/`` (mesh + shardings, ring
+  attention), ``train/`` (pretraining loop) -- replacing the reference's
+  GPU-only ``llm/`` recipe dirs.
+"""
+
+__version__ = '0.1.0'
+
+# Lazy re-exports: keep `import skypilot_tpu` fast (the reference keeps
+# `import sky` fast via adaptors, sky/adaptors/common.py:10).
+_LAZY_ATTRS = {
+    'Task': ('skypilot_tpu.spec.task', 'Task'),
+    'Resources': ('skypilot_tpu.spec.resources', 'Resources'),
+    'Dag': ('skypilot_tpu.spec.dag', 'Dag'),
+    'TpuTopology': ('skypilot_tpu.spec.topology', 'TpuTopology'),
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec_'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'ClusterStatus': ('skypilot_tpu.state', 'ClusterStatus'),
+    'JobStatus': ('skypilot_tpu.runtime.job_lib', 'JobStatus'),
+}
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_ATTRS[name]
+    except KeyError:
+        raise AttributeError(
+            f'module {__name__!r} has no attribute {name!r}') from None
+    import importlib
+    module = importlib.import_module(module_name)
+    return getattr(module, attr)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_ATTRS))

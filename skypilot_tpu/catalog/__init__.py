@@ -1,0 +1,25 @@
+"""Hardware catalog: instance/accelerator offerings + pricing.
+
+Parity: ``sky/catalog`` (``common.py:193 read_catalog`` fetches hosted CSVs;
+``gcp_catalog.py`` covers TPUs). This rebuild bakes the catalog into the
+package (zero-egress, versioned with the code) and makes TPU offerings the
+primary citizens: every entry knows its ``TpuTopology`` so the optimizer can
+reason about chips/hosts/ICI rather than opaque accelerator strings.
+"""
+from skypilot_tpu.catalog.common import (
+    AcceleratorOffering,
+    get_hourly_cost,
+    get_regions_for_accelerator,
+    get_zones_for_region,
+    list_accelerators,
+    validate_region_zone,
+)
+
+__all__ = [
+    'AcceleratorOffering',
+    'get_hourly_cost',
+    'get_regions_for_accelerator',
+    'get_zones_for_region',
+    'list_accelerators',
+    'validate_region_zone',
+]

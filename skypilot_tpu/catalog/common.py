@@ -1,0 +1,203 @@
+"""Catalog query API (parity: ``sky/catalog/common.py`` + ``gcp_catalog.py``).
+
+An *offering* is an accelerator available in a (cloud, region, zone) at a
+price. TPU offerings carry their parsed ``TpuTopology``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import gcp_data
+from skypilot_tpu.spec.topology import GENERATIONS, TpuTopology
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorOffering:
+    cloud: str
+    accelerator: str            # canonical name ('tpu-v5p-64', 'A100')
+    count: int                  # devices per node (1 for TPU slices)
+    region: str
+    zone: str
+    price_hr: float             # on-demand $/hr for the whole node request
+    spot_price_hr: float
+    tpu: Optional[TpuTopology] = None
+    vram_gb: Optional[float] = None
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.tpu is not None
+
+    def cost(self, use_spot: bool) -> float:
+        return self.spot_price_hr if use_spot else self.price_hr
+
+
+def _tpu_offerings(topology: TpuTopology,
+                   region_filter: Optional[str] = None,
+                   zone_filter: Optional[str] = None
+                   ) -> List[AcceleratorOffering]:
+    gen = topology.generation
+    price_chip, spot_chip = gcp_data.TPU_CHIP_HOUR_PRICES[gen]
+    chips = topology.total_chips
+    out = []
+    for region, zones in gcp_data.TPU_REGIONS.get(gen, {}).items():
+        if region_filter is not None and region != region_filter:
+            continue
+        for zone in zones:
+            if zone_filter is not None and zone != zone_filter:
+                continue
+            out.append(
+                AcceleratorOffering(
+                    cloud='gcp',
+                    accelerator=topology.accelerator_name,
+                    count=1,
+                    region=region,
+                    zone=zone,
+                    price_hr=price_chip * chips,
+                    spot_price_hr=spot_chip * chips,
+                    tpu=topology,
+                    vram_gb=topology.gen.hbm_gb_per_chip * chips,
+                ))
+    return out
+
+
+def _gpu_offerings(name: str,
+                   count: int,
+                   region_filter: Optional[str] = None,
+                   zone_filter: Optional[str] = None
+                   ) -> List[AcceleratorOffering]:
+    if name not in gcp_data.GPU_OFFERINGS:
+        return []
+    price, spot, vram, _family = gcp_data.GPU_OFFERINGS[name]
+    out = []
+    for region, zones in gcp_data.GPU_REGIONS.get(name, {}).items():
+        if region_filter is not None and region != region_filter:
+            continue
+        for zone in zones:
+            if zone_filter is not None and zone != zone_filter:
+                continue
+            out.append(
+                AcceleratorOffering(
+                    cloud='gcp',
+                    accelerator=name,
+                    count=count,
+                    region=region,
+                    zone=zone,
+                    price_hr=price * count,
+                    spot_price_hr=spot * count,
+                    vram_gb=float(vram * count),
+                ))
+    return out
+
+
+def get_offerings(accelerator: str,
+                  count: int = 1,
+                  *,
+                  num_slices: int = 1,
+                  topology: Optional[str] = None,
+                  region: Optional[str] = None,
+                  zone: Optional[str] = None) -> List[AcceleratorOffering]:
+    """All (region, zone, price) offerings for an accelerator request."""
+    tpu = TpuTopology.maybe_from_accelerator(accelerator,
+                                             topology=topology,
+                                             num_slices=num_slices)
+    if tpu is not None:
+        return _tpu_offerings(tpu, region, zone)
+    return _gpu_offerings(accelerator, count, region, zone)
+
+
+def list_accelerators(name_filter: Optional[str] = None,
+                      tpus_only: bool = False) -> Dict[str, List[str]]:
+    """name -> sorted regions; for `skyt show-tpus` (ref CLI `show-gpus`,
+    sky/client/cli/command.py:4075)."""
+    out: Dict[str, List[str]] = {}
+    for gen_name, gen in GENERATIONS.items():
+        chips = 1
+        while chips <= gen.max_chips:
+            count = chips * (gen.cores_per_chip
+                             if gen.count_unit == 'cores' else 1)
+            name = f'tpu-{gen_name}-{count}'
+            if name_filter is None or name_filter.lower() in name.lower():
+                regions = sorted(gcp_data.TPU_REGIONS.get(gen_name, {}))
+                if regions:
+                    out[name] = regions
+            chips *= 2
+    if not tpus_only:
+        for name in gcp_data.GPU_OFFERINGS:
+            if name_filter is None or name_filter.lower() in name.lower():
+                out[name] = sorted(gcp_data.GPU_REGIONS.get(name, {}))
+    return out
+
+
+def get_regions_for_accelerator(accelerator: str) -> List[str]:
+    tpu = TpuTopology.maybe_from_accelerator(accelerator)
+    if tpu is not None:
+        return sorted(gcp_data.TPU_REGIONS.get(tpu.generation, {}))
+    return sorted(gcp_data.GPU_REGIONS.get(accelerator, {}))
+
+
+def get_zones_for_region(accelerator: str, region: str) -> List[str]:
+    tpu = TpuTopology.maybe_from_accelerator(accelerator)
+    if tpu is not None:
+        return list(gcp_data.TPU_REGIONS.get(tpu.generation, {}).get(region, []))
+    return list(gcp_data.GPU_REGIONS.get(accelerator, {}).get(region, []))
+
+
+def validate_region_zone(cloud: str, region: Optional[str],
+                         zone: Optional[str]) -> None:
+    if cloud not in ('gcp', 'fake', 'local'):
+        raise exceptions.InvalidSpecError(f'Unknown cloud {cloud!r}')
+    if cloud != 'gcp' or region is None:
+        return
+    if region not in gcp_data.ALL_GCP_REGIONS:
+        raise exceptions.InvalidSpecError(
+            f'Unknown GCP region {region!r}. Known: '
+            f'{gcp_data.ALL_GCP_REGIONS}')
+    if zone is not None and not zone.startswith(region):
+        raise exceptions.InvalidSpecError(
+            f'Zone {zone!r} is not in region {region!r}')
+
+
+def get_hourly_cost(accelerator: Optional[str],
+                    count: int = 1,
+                    *,
+                    num_slices: int = 1,
+                    use_spot: bool = False,
+                    region: Optional[str] = None,
+                    cpus: Optional[float] = None,
+                    memory: Optional[float] = None) -> float:
+    """Estimated $/hr for a node request (0.0 if unknown)."""
+    if accelerator is None:
+        # Cheapest CPU instance satisfying cpus/memory.
+        best = None
+        for _name, (vcpu, mem, price) in gcp_data.CPU_INSTANCE_TYPES.items():
+            if cpus is not None and vcpu < cpus:
+                continue
+            if memory is not None and mem < memory:
+                continue
+            if best is None or price < best:
+                best = price
+        return best if best is not None else 0.097
+    offerings = get_offerings(accelerator, count, num_slices=num_slices,
+                              region=region)
+    if not offerings:
+        return 0.0
+    return min(o.cost(use_spot) for o in offerings)
+
+
+def pick_cpu_instance_type(cpus: Optional[float],
+                           memory: Optional[float]) -> str:
+    """Cheapest CPU instance type satisfying the request."""
+    best_name, best_price = None, None
+    for name, (vcpu, mem, price) in gcp_data.CPU_INSTANCE_TYPES.items():
+        if cpus is not None and vcpu < cpus:
+            continue
+        if memory is not None and mem < memory:
+            continue
+        if best_price is None or price < best_price:
+            best_name, best_price = name, price
+    if best_name is None:
+        raise exceptions.ResourcesUnavailableError(
+            f'No CPU instance type with cpus>={cpus}, memory>={memory}')
+    return best_name

@@ -1,0 +1,111 @@
+"""Baked-in GCP TPU/GPU offering data.
+
+Prices are representative on-demand USD per chip-hour (TPU, host VM
+included -- TPU-VM pricing bundles the host) or per GPU-hour, from public
+GCP pricing pages; spot is the typical preemptible discount. The reference
+fetches equivalent data as hosted CSVs (sky/catalog/common.py:193,245).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+# generation -> (price per chip-hr, spot price per chip-hr)
+TPU_CHIP_HOUR_PRICES: Dict[str, Tuple[float, float]] = {
+    'v2': (1.35 / 4, 0.60 / 4),
+    'v3': (2.00 / 4, 0.88 / 4),
+    'v4': (3.22, 1.45),
+    'v5e': (1.20, 0.54),
+    'v5p': (4.20, 1.89),
+    'v6e': (2.70, 1.22),
+}
+
+# generation -> {region: [zones with TPU capacity]}
+TPU_REGIONS: Dict[str, Dict[str, List[str]]] = {
+    'v2': {
+        'us-central1': ['us-central1-b', 'us-central1-c', 'us-central1-f'],
+        'europe-west4': ['europe-west4-a'],
+        'asia-east1': ['asia-east1-c'],
+    },
+    'v3': {
+        'us-central1': ['us-central1-a', 'us-central1-b'],
+        'europe-west4': ['europe-west4-a'],
+    },
+    'v4': {
+        'us-central2': ['us-central2-b'],
+    },
+    'v5e': {
+        'us-central1': ['us-central1-a', 'us-central1-b'],
+        'us-west4': ['us-west4-a', 'us-west4-b'],
+        'us-east1': ['us-east1-c'],
+        'us-east5': ['us-east5-b'],
+        'europe-west4': ['europe-west4-b'],
+        'asia-southeast1': ['asia-southeast1-b'],
+    },
+    'v5p': {
+        'us-east5': ['us-east5-a'],
+        'us-central1': ['us-central1-a'],
+        'europe-west4': ['europe-west4-b'],
+    },
+    'v6e': {
+        'us-east1': ['us-east1-d'],
+        'us-east5': ['us-east5-b'],
+        'us-central2': ['us-central2-b'],
+        'europe-west4': ['europe-west4-a'],
+        'asia-northeast1': ['asia-northeast1-b'],
+    },
+}
+
+# GPU offerings kept minimal so the optimizer can rank TPU against GPU
+# (north star: TPUs rank alongside GPUs on cost/availability).
+# name -> (price/hr per device, spot price/hr, vram GB, instance family)
+GPU_OFFERINGS: Dict[str, Tuple[float, float, int, str]] = {
+    'A100': (3.67, 1.10, 40, 'a2-highgpu'),
+    'A100-80GB': (5.12, 1.57, 80, 'a2-ultragpu'),
+    'H100': (11.06, 3.93, 80, 'a3-highgpu'),
+    'L4': (0.70, 0.28, 24, 'g2-standard'),
+    'V100': (2.48, 0.74, 16, 'n1-standard'),
+    'T4': (0.35, 0.11, 16, 'n1-standard'),
+}
+
+GPU_REGIONS: Dict[str, Dict[str, List[str]]] = {
+    'A100': {
+        'us-central1': ['us-central1-a', 'us-central1-b'],
+        'europe-west4': ['europe-west4-a'],
+    },
+    'A100-80GB': {
+        'us-central1': ['us-central1-a'],
+        'us-east4': ['us-east4-c'],
+    },
+    'H100': {
+        'us-central1': ['us-central1-a'],
+        'us-east4': ['us-east4-a'],
+        'europe-west4': ['europe-west4-b'],
+    },
+    'L4': {
+        'us-central1': ['us-central1-a', 'us-central1-b'],
+        'us-east1': ['us-east1-b'],
+        'europe-west4': ['europe-west4-a'],
+    },
+    'V100': {
+        'us-central1': ['us-central1-a'],
+    },
+    'T4': {
+        'us-central1': ['us-central1-a', 'us-central1-b'],
+        'us-east1': ['us-east1-c'],
+    },
+}
+
+# CPU-only fallback instance types: name -> (vcpus, memory GB, price/hr).
+CPU_INSTANCE_TYPES: Dict[str, Tuple[int, float, float]] = {
+    'n2-standard-2': (2, 8, 0.097),
+    'n2-standard-4': (4, 16, 0.194),
+    'n2-standard-8': (8, 32, 0.389),
+    'n2-standard-16': (16, 64, 0.777),
+    'n2-standard-32': (32, 128, 1.554),
+    'n2-highmem-8': (8, 64, 0.524),
+}
+
+ALL_GCP_REGIONS: List[str] = sorted(
+    {r for gen in TPU_REGIONS.values() for r in gen} |
+    {r for acc in GPU_REGIONS.values() for r in acc} |
+    {'us-central1', 'us-east1', 'us-west1', 'europe-west4'})

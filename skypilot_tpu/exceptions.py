@@ -1,0 +1,113 @@
+"""Typed error taxonomy (parity: ``sky/exceptions.py``).
+
+The provisioner's failover loop keys off these types: a
+``ResourcesUnavailableError`` carrying a failover history drives
+zone->region->cloud retry exactly as the reference's
+``RetryingVmProvisioner`` does (sky/backends/cloud_vm_ray_backend.py:789).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SkytError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidSpecError(SkytError):
+    """Task/Resources/YAML validation failure."""
+
+
+class NoCloudAccessError(SkytError):
+    """No cloud is enabled / credentials missing."""
+
+
+class ResourcesUnavailableError(SkytError):
+    """All candidate locations failed (stockout/quota/capacity).
+
+    Carries the per-location failure history so callers (managed jobs
+    recovery, CLI) can display and act on it.
+    """
+
+    def __init__(self,
+                 message: str,
+                 failover_history: Optional[List[Exception]] = None,
+                 no_failover: bool = False) -> None:
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+        self.no_failover = no_failover
+
+
+class ResourcesMismatchError(SkytError):
+    """Requested resources do not match the existing cluster's."""
+
+
+class ProvisionError(SkytError):
+    """A single provisioning attempt failed (classified by the handler)."""
+
+    def __init__(self, message: str, retryable_in_zone: bool = False) -> None:
+        super().__init__(message)
+        self.retryable_in_zone = retryable_in_zone
+
+
+class QuotaExceededError(ProvisionError):
+    """Per-region quota exhausted -> blocklist the region."""
+
+
+class CapacityError(ProvisionError):
+    """Stockout in a zone -> blocklist the zone, try the next."""
+
+
+class ClusterNotUpError(SkytError):
+    """Operation requires an UP cluster."""
+
+
+class ClusterDoesNotExist(SkytError):
+    """Named cluster not found in state."""
+
+
+class ClusterOwnerIdentityMismatchError(SkytError):
+    """Cluster belongs to a different user identity."""
+
+
+class CommandError(SkytError):
+    """A remote/local command returned non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: str = '') -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        cmd = command if len(command) < 100 else command[:100] + '...'
+        super().__init__(
+            f'Command {cmd!r} failed with return code {returncode}.'
+            f' {error_msg}')
+
+
+class JobNotFoundError(SkytError):
+    """Job id not present in the cluster job table."""
+
+
+class ManagedJobReachedMaxRetriesError(SkytError):
+    """Managed job exhausted max_restarts_on_errors."""
+
+
+class RequestNotFoundError(SkytError):
+    """API-server request id unknown."""
+
+
+class RequestCancelledError(SkytError):
+    """API-server request was cancelled by the user."""
+
+
+class ServeUserTerminatedError(SkytError):
+    """Service was torn down while an operation was in flight."""
+
+
+class StorageError(SkytError):
+    """Bucket/storage operation failure."""
+
+
+class NotSupportedError(SkytError):
+    """Feature not supported by the selected cloud/backend."""

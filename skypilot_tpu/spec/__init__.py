@@ -1,0 +1,10 @@
+"""Spec layer: Task / Dag / Resources / TpuTopology (the reference's
+
+``sky/task.py``, ``sky/dag.py``, ``sky/resources.py`` -- with TPU topology
+promoted to a first-class type instead of string special-cases)."""
+from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.spec.topology import TpuTopology
+
+__all__ = ['Dag', 'Resources', 'Task', 'TpuTopology']

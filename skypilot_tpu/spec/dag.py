@@ -1,0 +1,83 @@
+"""Chain DAG of tasks (parity: ``sky/dag.py:26``)."""
+from __future__ import annotations
+
+import enum
+import threading
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.task import Task
+
+
+class DagExecution(enum.Enum):
+    """How downstream tasks launch relative to upstream (ref sky/dag.py:12)."""
+    WAIT_SUCCESS = 'wait_success'   # default: run after parent succeeds
+    PARALLEL = 'parallel'           # launch all at once
+
+
+class Dag:
+    """An ordered chain of tasks.
+
+    Usable as a context manager so `Task()` construction sites can
+    auto-register (parity with `sky.Dag` usage in the reference).
+    """
+
+    _thread_local = threading.local()
+
+    def __init__(self, name: Optional[str] = None,
+                 execution: DagExecution = DagExecution.WAIT_SUCCESS) -> None:
+        self.name = name
+        self.execution = execution
+        self.tasks: List[Task] = []
+
+    # ---------- construction ----------
+
+    def add(self, task: Task) -> 'Dag':
+        self.tasks.append(task)
+        return self
+
+    @classmethod
+    def from_task(cls, task: Task) -> 'Dag':
+        dag = cls(name=task.name)
+        dag.add(task)
+        return dag
+
+    # ---------- context manager ----------
+
+    def __enter__(self) -> 'Dag':
+        stack = getattr(Dag._thread_local, 'stack', None)
+        if stack is None:
+            stack = Dag._thread_local.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        Dag._thread_local.stack.pop()
+
+    @classmethod
+    def get_current(cls) -> Optional['Dag']:
+        stack = getattr(cls._thread_local, 'stack', None)
+        return stack[-1] if stack else None
+
+    # ---------- queries ----------
+
+    def is_chain(self) -> bool:
+        return True  # only chain DAGs supported (like the reference today)
+
+    def validate(self) -> None:
+        if not self.tasks:
+            raise exceptions.InvalidSpecError('Empty DAG')
+        names = [t.name for t in self.tasks if t.name]
+        if len(names) != len(set(names)):
+            raise exceptions.InvalidSpecError(
+                f'Duplicate task names in DAG: {names}')
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __iter__(self):
+        return iter(self.tasks)
+
+    def __repr__(self) -> str:
+        return (f'Dag({self.name or "<unnamed>"}: '
+                f'{" -> ".join(t.name or "?" for t in self.tasks)})')

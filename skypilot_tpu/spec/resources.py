@@ -1,0 +1,390 @@
+"""Resources: a hardware request, TPU-topology-aware.
+
+Parity: ``sky/resources.py:161`` (Resources.__init__), but the TPU
+special-cases (runtime-version inference at :990-1005, accelerator_args) are
+replaced by a structured ``TpuTopology`` member, and ``num_slices`` makes
+multi-slice (DCN) a first-class request.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.topology import TpuTopology
+
+_DEFAULT_DISK_SIZE_GB = 100
+
+
+@dataclasses.dataclass
+class AutostopConfig:
+    """`autostop: {idle_minutes: 10, down: false}` (ref sky/resources.py
+    autostop + sky/skylet/autostop_lib.py:137)."""
+    enabled: bool = False
+    idle_minutes: int = 5
+    down: bool = False
+
+    @classmethod
+    def from_yaml_config(cls, config: Union[None, bool, int, dict]
+                         ) -> 'AutostopConfig':
+        if config is None or config is False:
+            return cls(enabled=False)
+        if config is True:
+            return cls(enabled=True)
+        if isinstance(config, int):
+            return cls(enabled=True, idle_minutes=config)
+        if isinstance(config, dict):
+            return cls(enabled=True,
+                       idle_minutes=int(config.get('idle_minutes', 5)),
+                       down=bool(config.get('down', False)))
+        raise exceptions.InvalidSpecError(f'Invalid autostop: {config!r}')
+
+    def to_yaml_config(self) -> Union[bool, dict]:
+        if not self.enabled:
+            return False
+        return {'idle_minutes': self.idle_minutes, 'down': self.down}
+
+
+def parse_infra(infra: Optional[str]
+                ) -> Tuple[Optional[str], Optional[str], Optional[str]]:
+    """'gcp/us-central2/us-central2-b' -> (cloud, region, zone).
+
+    Parity with the reference's `infra:` string (sky/resources.py infra
+    parsing). '*' wildcards are treated as None.
+    """
+    if not infra:
+        return None, None, None
+    parts = [p if p not in ('*', '') else None for p in infra.split('/')]
+    if len(parts) > 3:
+        raise exceptions.InvalidSpecError(
+            f'Invalid infra string {infra!r}: expected cloud[/region[/zone]]')
+    parts += [None] * (3 - len(parts))
+    return parts[0], parts[1], parts[2]
+
+
+class Resources:
+    """A (possibly partial) hardware request attached to a Task."""
+
+    def __init__(
+        self,
+        *,
+        cloud: Optional[str] = None,
+        infra: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        accelerators: Union[None, str, Dict[str, int]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        num_slices: int = 1,
+        cpus: Union[None, int, str] = None,
+        memory: Union[None, int, str] = None,
+        instance_type: Optional[str] = None,
+        use_spot: bool = False,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
+        disk_size: int = _DEFAULT_DISK_SIZE_GB,
+        image_id: Optional[str] = None,
+        ports: Optional[List[Union[int, str]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        autostop: Union[None, bool, int, dict] = None,
+        network_tier: Optional[str] = None,
+    ) -> None:
+        if infra is not None and (cloud or region or zone):
+            raise exceptions.InvalidSpecError(
+                'Specify either `infra` or cloud/region/zone, not both.')
+        if infra is not None:
+            cloud, region, zone = parse_infra(infra)
+        self._cloud = cloud.lower() if cloud else None
+        self._region = region
+        self._zone = zone
+        self._instance_type = instance_type
+        self._use_spot = bool(use_spot)
+        self._job_recovery = job_recovery
+        self._disk_size = int(disk_size)
+        self._image_id = image_id
+        self._ports = [str(p) for p in ports] if ports else []
+        self._labels = dict(labels) if labels else {}
+        self._autostop = AutostopConfig.from_yaml_config(autostop)
+        self._network_tier = network_tier
+        self._accelerator_args = dict(accelerator_args or {})
+        self._num_slices = int(
+            self._accelerator_args.get('num_slices', num_slices))
+
+        self._cpus = self._parse_quantity(cpus, 'cpus')
+        self._memory = self._parse_quantity(memory, 'memory')
+
+        self._accelerator_name: Optional[str] = None
+        self._accelerator_count: int = 1
+        self._tpu: Optional[TpuTopology] = None
+        self._set_accelerators(accelerators)
+        self._validate()
+
+    # ---------- parsing ----------
+
+    @staticmethod
+    def _parse_quantity(value: Union[None, int, float, str],
+                        what: str) -> Optional[Tuple[float, str]]:
+        """'8' -> (8, '=='); '8+' -> (8, '>='); None -> None."""
+        if value is None:
+            return None
+        op = '=='
+        if isinstance(value, str):
+            value = value.strip()
+            if value.endswith('+'):
+                op = '>='
+                value = value[:-1]
+        try:
+            num = float(value)
+        except (TypeError, ValueError):
+            raise exceptions.InvalidSpecError(
+                f'Invalid {what}: {value!r}') from None
+        return (num, op)
+
+    def _set_accelerators(
+            self, accelerators: Union[None, str, Dict[str, int]]) -> None:
+        if accelerators is None:
+            return
+        if isinstance(accelerators, str):
+            if ':' in accelerators:
+                name, _, count = accelerators.partition(':')
+                accelerators = {name.strip(): int(count)}
+            else:
+                accelerators = {accelerators.strip(): 1}
+        if len(accelerators) != 1:
+            raise exceptions.InvalidSpecError(
+                f'Exactly one accelerator type per resource; got '
+                f'{accelerators!r}')
+        (name, count), = accelerators.items()
+        self._accelerator_name = name
+        self._accelerator_count = int(count)
+        tpu = TpuTopology.maybe_from_accelerator(
+            name,
+            topology=self._accelerator_args.get('topology'),
+            num_slices=self._num_slices)
+        if tpu is not None:
+            if self._accelerator_count != 1:
+                raise exceptions.InvalidSpecError(
+                    f'TPU accelerators take count 1 (the slice size is in '
+                    f'the name); got {name}:{self._accelerator_count}. Use '
+                    f'num_slices for multi-slice.')
+            self._accelerator_name = tpu.accelerator_name
+        self._tpu = tpu
+
+    def _validate(self) -> None:
+        if self._zone is not None and self._region is None:
+            raise exceptions.InvalidSpecError(
+                f'zone {self._zone!r} requires region to be set.')
+        if self._disk_size < 10:
+            raise exceptions.InvalidSpecError('disk_size must be >= 10 GB')
+        if self._network_tier not in (None, 'standard', 'best'):
+            raise exceptions.InvalidSpecError(
+                f'network_tier must be standard|best, got '
+                f'{self._network_tier!r}')
+        if self._num_slices > 1 and self._tpu is None:
+            raise exceptions.InvalidSpecError(
+                'num_slices > 1 requires a TPU accelerator.')
+        rt = self._accelerator_args.get('runtime_version')
+        if rt is not None and self._tpu is None:
+            raise exceptions.InvalidSpecError(
+                'accelerator_args.runtime_version requires a TPU accelerator.')
+
+    # ---------- accessors ----------
+
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        if self._accelerator_name is None:
+            return None
+        return {self._accelerator_name: self._accelerator_count}
+
+    @property
+    def accelerator_args(self) -> Dict[str, Any]:
+        return dict(self._accelerator_args)
+
+    @property
+    def tpu(self) -> Optional[TpuTopology]:
+        return self._tpu
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._tpu is not None
+
+    @property
+    def tpu_runtime_version(self) -> Optional[str]:
+        if self._tpu is None:
+            return None
+        return self._accelerator_args.get('runtime_version',
+                                          self._tpu.runtime_version)
+
+    @property
+    def num_slices(self) -> int:
+        return self._num_slices
+
+    @property
+    def cpus(self) -> Optional[Tuple[float, str]]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[Tuple[float, str]]:
+        return self._memory
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def job_recovery(self) -> Optional[Union[str, Dict[str, Any]]]:
+        return self._job_recovery
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def ports(self) -> List[str]:
+        return list(self._ports)
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return dict(self._labels)
+
+    @property
+    def autostop(self) -> AutostopConfig:
+        return self._autostop
+
+    @property
+    def network_tier(self) -> Optional[str]:
+        return self._network_tier
+
+    # ---------- operations ----------
+
+    def copy(self, **override) -> 'Resources':
+        """A copy with fields overridden (parity: Resources.copy)."""
+        config = self.to_yaml_config()
+        # autostop round-trips via yaml config
+        config.update(override)
+        return Resources.from_yaml_config(config)
+
+    def assert_launchable(self) -> None:
+        if self._cloud is None or self._region is None:
+            raise exceptions.InvalidSpecError(
+                f'Resources not launchable (cloud/region unresolved): {self}')
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if `other` (an existing cluster) can run this request.
+
+        Used by `exec` to reuse clusters (parity:
+        Resources.less_demanding_than).
+        """
+        if self._cloud is not None and self._cloud != other.cloud:
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self.accelerators is not None:
+            if other.accelerators is None:
+                return False
+            (name, count), = self.accelerators.items()
+            if other.accelerators.get(name, 0) < count:
+                return False
+        if self._use_spot and not other.use_spot:
+            return False
+        return True
+
+    # ---------- serialization ----------
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            return cls()
+        config = copy.deepcopy(config)
+        known = {
+            'cloud', 'infra', 'region', 'zone', 'accelerators',
+            'accelerator_args', 'num_slices', 'cpus', 'memory',
+            'instance_type', 'use_spot', 'job_recovery', 'disk_size',
+            'image_id', 'ports', 'labels', 'autostop', 'network_tier',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidSpecError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        if 'disk_size' in config and config['disk_size'] is None:
+            config.pop('disk_size')
+        return cls(**config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value, default=None):
+            if value is not None and value != default:
+                config[key] = value
+
+        add('cloud', self._cloud)
+        add('region', self._region)
+        add('zone', self._zone)
+        if self._accelerator_name is not None:
+            config['accelerators'] = {
+                self._accelerator_name: self._accelerator_count
+            }
+        add('accelerator_args', self._accelerator_args or None)
+        add('num_slices', self._num_slices, default=1)
+        if self._cpus is not None:
+            num, op = self._cpus
+            config['cpus'] = f'{num:g}+' if op == '>=' else f'{num:g}'
+        if self._memory is not None:
+            num, op = self._memory
+            config['memory'] = f'{num:g}+' if op == '>=' else f'{num:g}'
+        add('instance_type', self._instance_type)
+        add('use_spot', self._use_spot, default=False)
+        add('job_recovery', self._job_recovery)
+        add('disk_size', self._disk_size, default=_DEFAULT_DISK_SIZE_GB)
+        add('image_id', self._image_id)
+        add('ports', self._ports or None)
+        add('labels', self._labels or None)
+        if self._autostop.enabled:
+            config['autostop'] = self._autostop.to_yaml_config()
+        add('network_tier', self._network_tier)
+        return config
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud:
+            loc = self._cloud
+            if self._region:
+                loc += f'/{self._region}'
+            if self._zone:
+                loc += f'/{self._zone}'
+            parts.append(loc)
+        if self._tpu is not None:
+            parts.append(str(self._tpu))
+        elif self._accelerator_name:
+            parts.append(f'{self._accelerator_name}:{self._accelerator_count}')
+        if self._instance_type:
+            parts.append(self._instance_type)
+        if self._cpus:
+            parts.append(f'cpus={self._cpus[0]:g}{"+" if self._cpus[1] == ">=" else ""}')
+        if self._use_spot:
+            parts.append('[spot]')
+        return f'Resources({", ".join(parts) or "default"})'
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()

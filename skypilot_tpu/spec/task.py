@@ -1,0 +1,212 @@
+"""Task: the unit of work (parity: ``sky/task.py:314``).
+
+A task = optional setup script + run script + file/storage mounts + env vars
+(+ secrets) + a set of candidate Resources, executed on `num_nodes` nodes.
+For TPU, one "node" is one pod **slice** (all hosts of the slice run the
+task with rank envs); `num_nodes > 1` with a TPU resource therefore means
+multi-slice over DCN -- cleaner than the reference's one-node-many-IPs model
+(``num_ips_per_node``, cloud_vm_ray_backend.py:2613).
+"""
+from __future__ import annotations
+
+import copy
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.resources import Resources
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([a-zA-Z0-9._-]*[a-zA-Z0-9])?$')
+
+CommandOrGen = Union[None, str, Callable[[int, List[str]], Optional[str]]]
+
+
+class Task:
+    """A unit of work."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGen = None,
+        workdir: Optional[str] = None,
+        num_nodes: int = 1,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Dict[str, Any]]] = None,
+        resources: Union[None, Resources, List[Resources]] = None,
+        service: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if name is not None and not _VALID_NAME_RE.fullmatch(name):
+            raise exceptions.InvalidSpecError(f'Invalid task name {name!r}')
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.num_nodes = int(num_nodes)
+        if self.num_nodes < 1:
+            raise exceptions.InvalidSpecError('num_nodes must be >= 1')
+        self.envs: Dict[str, str] = {
+            str(k): str(v) for k, v in (envs or {}).items()
+        }
+        self.secrets: Dict[str, str] = {
+            str(k): str(v) for k, v in (secrets or {}).items()
+        }
+        self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        self.storage_mounts: Dict[str, Dict[str, Any]] = dict(storage_mounts
+                                                              or {})
+        if resources is None:
+            self.resources: List[Resources] = [Resources()]
+        elif isinstance(resources, Resources):
+            self.resources = [resources]
+        else:
+            self.resources = list(resources)
+        self.service = service
+        # Filled by the optimizer (parity: task.best_resources,
+        # sky/optimizer.py:109 assigns per task).
+        self.best_resources: Optional[Resources] = None
+        self._validate()
+
+    def _validate(self) -> None:
+        if isinstance(self.run, str) and not self.run.strip():
+            raise exceptions.InvalidSpecError('run script is empty')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidSpecError(
+                    f'workdir {self.workdir!r} is not a directory')
+        for dst, src in self.file_mounts.items():
+            if not dst.startswith(('/', '~')):
+                raise exceptions.InvalidSpecError(
+                    f'file_mounts destination must be absolute or ~-based: '
+                    f'{dst!r}')
+            del src  # sources may be local paths or bucket URIs
+        if any(r.is_tpu for r in self.resources):
+            for res in self.resources:
+                if res.is_tpu and res.num_slices > 1 and self.num_nodes > 1:
+                    raise exceptions.InvalidSpecError(
+                        'Use either num_nodes>1 (one slice per node) or '
+                        'resources.num_slices>1, not both.')
+
+    # ---------- YAML ----------
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Task':
+        config = copy.deepcopy(config)
+        known = {
+            'name', 'setup', 'run', 'workdir', 'num_nodes', 'envs',
+            'secrets', 'file_mounts', 'storage_mounts', 'resources',
+            'service', 'config',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidSpecError(
+                f'Unknown task fields: {sorted(unknown)}')
+        resources_config = config.get('resources')
+        if isinstance(resources_config, list):
+            resources: Union[Resources, List[Resources]] = [
+                Resources.from_yaml_config(r) for r in resources_config
+            ]
+        elif isinstance(resources_config, dict) and 'any_of' in resources_config:
+            resources = [
+                Resources.from_yaml_config(r)
+                for r in resources_config['any_of']
+            ]
+        else:
+            resources = Resources.from_yaml_config(resources_config)
+        return cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes') or 1,
+            envs=config.get('envs'),
+            secrets=config.get('secrets'),
+            file_mounts=config.get('file_mounts'),
+            storage_mounts=config.get('storage_mounts'),
+            resources=resources,
+            service=config.get('service'),
+        )
+
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Task':
+        with open(os.path.expanduser(path), encoding='utf-8') as f:
+            config = yaml.safe_load(f)
+        if not isinstance(config, dict):
+            raise exceptions.InvalidSpecError(
+                f'YAML file {path} does not contain a task mapping.')
+        return cls.from_yaml_config(config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+        if self.name:
+            config['name'] = self.name
+        if self.workdir:
+            config['workdir'] = self.workdir
+        if self.num_nodes != 1:
+            config['num_nodes'] = self.num_nodes
+        if len(self.resources) == 1:
+            rc = self.resources[0].to_yaml_config()
+            if rc:
+                config['resources'] = rc
+        else:
+            config['resources'] = {
+                'any_of': [r.to_yaml_config() for r in self.resources]
+            }
+        if self.envs:
+            config['envs'] = dict(self.envs)
+        if self.secrets:
+            config['secrets'] = dict(self.secrets)
+        if self.file_mounts:
+            config['file_mounts'] = dict(self.file_mounts)
+        if self.storage_mounts:
+            config['storage_mounts'] = dict(self.storage_mounts)
+        if self.setup:
+            config['setup'] = self.setup
+        if isinstance(self.run, str):
+            config['run'] = self.run
+        if self.service:
+            config['service'] = self.service
+        return config
+
+    def to_yaml(self, path: str) -> None:
+        with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+            yaml.safe_dump(self.to_yaml_config(), f, sort_keys=False)
+
+    # ---------- helpers ----------
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self.envs.update({str(k): str(v) for k, v in envs.items()})
+        return self
+
+    def set_resources(
+            self, resources: Union[Resources, List[Resources]]) -> 'Task':
+        if isinstance(resources, Resources):
+            resources = [resources]
+        self.resources = list(resources)
+        self.best_resources = None
+        return self
+
+    @property
+    def uses_tpu(self) -> bool:
+        return any(r.is_tpu for r in self.resources)
+
+    def get_run_command(self, node_rank: int,
+                        node_ips: List[str]) -> Optional[str]:
+        """Resolve `run` for a node (callable run commands get rank/IPs,
+        parity: sky/task.py CommandGen)."""
+        if callable(self.run):
+            return self.run(node_rank, node_ips)
+        return self.run
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        res = self.best_resources or (
+            self.resources[0] if len(self.resources) == 1 else
+            f'{len(self.resources)} candidates')
+        return f'Task({name}, num_nodes={self.num_nodes}, {res})'

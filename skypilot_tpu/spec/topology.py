@@ -1,0 +1,274 @@
+"""TPU topology as a first-class type.
+
+The reference infers TPU runtime versions from accelerator-name string
+prefixes (``sky/resources.py:990-1014``) and hides multi-host pod structure
+behind ``num_ips_per_node`` (``sky/backends/cloud_vm_ray_backend.py:2613``).
+Here the accelerator string parses into a structured ``TpuTopology`` --
+generation, chip count, ICI topology, hosts -- which the catalog, optimizer,
+provisioner and the parallel/ mesh builder all consume.
+
+Naming convention (GCP): for v2/v3/v4/v5p the trailing number counts
+**TensorCores** (``v5p-64`` = 64 cores = 32 chips); for v5e/v6e it counts
+**chips** (``v5e-16`` = 16 chips). Multi-host slices are created atomically
+(queued resources), which is what makes gang scheduling native on TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Static per-generation hardware facts (public GCP specs)."""
+    name: str                  # 'v5p'
+    count_unit: str            # 'cores' | 'chips' (what the name suffix counts)
+    cores_per_chip: int
+    chips_per_host: int
+    topology_ndim: int         # 2 (v2/v3/v5e/v6e) or 3 (v4/v5p)
+    max_chips: int
+    hbm_gb_per_chip: float
+    bf16_tflops_per_chip: float
+    ici_gbps_per_link: float   # one-direction per-link bandwidth
+    default_runtime_version: str
+
+
+# Public hardware facts; runtime versions follow GCP's tpu-ubuntu2204/ tpu-vm
+# naming (the reference hardcodes the same mapping, sky/resources.py:990-1005).
+GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', 'cores', 2, 4, 2, 512, 16, 45, 62.5,
+                        'tpu-vm-base'),
+    'v3': TpuGeneration('v3', 'cores', 2, 4, 2, 2048, 32, 123, 81.25,
+                        'tpu-vm-base'),
+    'v4': TpuGeneration('v4', 'cores', 2, 4, 3, 4096, 32, 275, 50,
+                        'tpu-ubuntu2204-base'),
+    'v5e': TpuGeneration('v5e', 'chips', 1, 8, 2, 256, 16, 197, 50,
+                         'v2-alpha-tpuv5-lite'),
+    'v5p': TpuGeneration('v5p', 'cores', 2, 4, 3, 8960, 95, 459, 100,
+                         'v2-alpha-tpuv5'),
+    'v6e': TpuGeneration('v6e', 'chips', 1, 8, 2, 256, 32, 918, 100,
+                         'v2-alpha-tpuv6e'),
+}
+
+_ALIASES = {
+    'v5litepod': 'v5e',
+    'v5lite': 'v5e',
+    'trillium': 'v6e',
+}
+
+_NAME_RE = re.compile(
+    r'^(?:tpu-)?(?P<gen>v[0-9]+[a-z]*|v5litepod|v5lite|trillium)-(?P<count>\d+)$',
+    re.IGNORECASE)
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _default_topology(gen: TpuGeneration, chips: int) -> Tuple[int, ...]:
+    """Compute the default ICI topology for a chip count.
+
+    2D generations (v5e/v6e): near-square x*y with power-of-two sides
+    (matches GCP's published v5e topologies: 2x2, 2x4, 4x4, 4x8, 8x8, 8x16,
+    16x16). 3D generations (v4/v5p): x*y*z with each side a multiple of 4
+    for multi-host cubes (4x4x4 and up); small slices use 2x2xZ.
+    """
+    if gen.topology_ndim == 2:
+        if chips == 1:
+            return (1, 1)
+        x = 2 ** (int(math.log2(chips)) // 2)
+        y = chips // x
+        return (min(x, y), max(x, y))
+    # 3D: factor into three near-equal power-of-two-ish sides.
+    if chips <= 4:
+        return (2, 2, 1)
+    # Find factorization x<=y<=z, each >=2, product == chips, sides as equal
+    # as possible; prefer multiples of 4 above 4 chips per side.
+    best: Optional[Tuple[int, int, int]] = None
+    best_score = None
+    for x in range(2, int(round(chips ** (1 / 3))) + 3):
+        if chips % x:
+            continue
+        rest = chips // x
+        for y in range(x, int(math.isqrt(rest)) + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            if z < y:
+                continue
+            score = (z - x, z + y + x)
+            if best_score is None or score < best_score:
+                best_score = score
+                best = (x, y, z)
+    if best is None:
+        return (1, 1, chips)
+    return best
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuTopology:
+    """A TPU slice request: generation + chips + ICI topology (+ slices).
+
+    ``num_slices > 1`` models multi-slice training: N identical pod slices
+    connected over DCN (absent from the reference -- SURVEY.md section 2.10
+    lists multi-slice as a gap to close).
+    """
+    generation: str
+    chips: int                         # chips per slice
+    topology: Tuple[int, ...]          # ICI topology of one slice
+    num_slices: int = 1
+
+    # ---------- constructors ----------
+
+    @classmethod
+    def from_accelerator(cls,
+                         name: str,
+                         topology: Optional[str] = None,
+                         num_slices: int = 1) -> 'TpuTopology':
+        """Parse 'tpu-v5p-64' / 'v5e-16' / 'tpu-v5litepod-8' (+ optional
+        explicit topology like '4x4x4')."""
+        m = _NAME_RE.match(name.strip())
+        if m is None:
+            raise exceptions.InvalidSpecError(
+                f'Invalid TPU accelerator name {name!r}; expected e.g. '
+                "'tpu-v5e-8', 'tpu-v5p-64', 'v6e-16'.")
+        gen_name = _ALIASES.get(m.group('gen').lower(), m.group('gen').lower())
+        if gen_name not in GENERATIONS:
+            raise exceptions.InvalidSpecError(
+                f'Unknown TPU generation {gen_name!r} in {name!r}. '
+                f'Known: {sorted(GENERATIONS)}')
+        gen = GENERATIONS[gen_name]
+        count = int(m.group('count'))
+        if gen.count_unit == 'cores':
+            if count % gen.cores_per_chip:
+                raise exceptions.InvalidSpecError(
+                    f'{name!r}: core count {count} not divisible by '
+                    f'{gen.cores_per_chip} cores/chip.')
+            chips = count // gen.cores_per_chip
+        else:
+            chips = count
+        if chips > gen.max_chips:
+            raise exceptions.InvalidSpecError(
+                f'{name!r}: {chips} chips exceeds the {gen.name} slice '
+                f'maximum of {gen.max_chips}.')
+        if topology is not None:
+            topo = tuple(int(t) for t in topology.lower().split('x'))
+            if math.prod(topo) != chips:
+                raise exceptions.InvalidSpecError(
+                    f'Topology {topology!r} has {math.prod(topo)} chips but '
+                    f'{name!r} requests {chips}.')
+        else:
+            topo = _default_topology(gen, chips)
+        if num_slices < 1:
+            raise exceptions.InvalidSpecError(
+                f'num_slices must be >= 1, got {num_slices}')
+        if not _is_pow2(chips) and chips % gen.chips_per_host:
+            raise exceptions.InvalidSpecError(
+                f'{name!r}: unsupported chip count {chips}.')
+        return cls(generation=gen_name, chips=chips, topology=topo,
+                   num_slices=num_slices)
+
+    @classmethod
+    def maybe_from_accelerator(cls, name: str,
+                               **kwargs) -> Optional['TpuTopology']:
+        """None if `name` is not a TPU accelerator string (e.g. 'A100')."""
+        if _NAME_RE.match(name.strip()) is None:
+            return None
+        return cls.from_accelerator(name, **kwargs)
+
+    # ---------- derived properties ----------
+
+    @property
+    def gen(self) -> TpuGeneration:
+        return GENERATIONS[self.generation]
+
+    @property
+    def cores(self) -> int:
+        return self.chips * self.gen.cores_per_chip
+
+    @property
+    def hosts_per_slice(self) -> int:
+        """Worker VMs per slice: chips/(chips per host), min 1.
+
+        Sub-host slices (v5e-1, v5e-4) fit on one host. This replaces the
+        reference's `num_ips_per_node` (cloud_vm_ray_backend.py:2613).
+        """
+        return max(1, self.chips // self.gen.chips_per_host)
+
+    @property
+    def total_hosts(self) -> int:
+        return self.hosts_per_slice * self.num_slices
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips * self.num_slices
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.chips, self.gen.chips_per_host)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.total_hosts > 1
+
+    @property
+    def accelerator_name(self) -> str:
+        count = (self.cores
+                 if self.gen.count_unit == 'cores' else self.chips)
+        return f'tpu-{self.generation}-{count}'
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(t) for t in self.topology)
+
+    @property
+    def accelerator_type(self) -> str:
+        """GCP TPU API `acceleratorType` (e.g. 'v5p-64', 'v5litepod-16')."""
+        gen_api = {'v5e': 'v5litepod'}.get(self.generation, self.generation)
+        count = (self.cores
+                 if self.gen.count_unit == 'cores' else self.chips)
+        return f'{gen_api}-{count}'
+
+    @property
+    def runtime_version(self) -> str:
+        return self.gen.default_runtime_version
+
+    @property
+    def bf16_tflops_per_slice(self) -> float:
+        return self.chips * self.gen.bf16_tflops_per_chip
+
+    @property
+    def hbm_gb_total(self) -> float:
+        return self.total_chips * self.gen.hbm_gb_per_chip
+
+    def mesh_hint(self) -> Dict[str, int]:
+        """Suggested (ici, dcn) mesh sizing for `parallel.mesh`.
+
+        ICI parallelism within a slice, data parallelism over DCN across
+        slices -- the standard multi-slice recipe (scaling-book).
+        """
+        return {'ici': self.chips, 'dcn': self.num_slices}
+
+    def __str__(self) -> str:
+        s = f'{self.accelerator_name}({self.topology_str})'
+        if self.num_slices > 1:
+            s += f' x{self.num_slices} slices'
+        return s
+
+
+def list_supported_accelerators() -> List[str]:
+    """All canonical accelerator names the catalog should carry."""
+    names = []
+    for gen in GENERATIONS.values():
+        chips = 1
+        while chips <= gen.max_chips:
+            if chips >= gen.chips_per_host or chips in (1, 4) or gen.topology_ndim == 2:
+                count = chips * (gen.cores_per_chip
+                                 if gen.count_unit == 'cores' else 1)
+                names.append(f'tpu-{gen.name}-{count}')
+            chips *= 2
+    return names

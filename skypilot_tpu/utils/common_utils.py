@@ -1,0 +1,138 @@
+"""Small shared helpers: ids, user, retry/backoff, dict utils.
+
+Parity targets: ``sky/utils/common_utils.py`` (cluster name/user helpers) and
+the backoff helpers used by the provisioner retry loops.
+"""
+from __future__ import annotations
+
+import getpass
+import hashlib
+import os
+import random
+import re
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional, TypeVar
+
+T = TypeVar('T')
+
+_USER_HASH_FILE = os.path.expanduser('~/.skyt/user_hash')
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([a-zA-Z0-9_-]*[a-zA-Z0-9])?$')
+
+
+def get_user() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:  # pylint: disable=broad-except
+        return 'unknown'
+
+
+def get_user_hash() -> str:
+    """Stable 8-hex id for this user/machine, cached on disk."""
+    env = os.environ.get('SKYT_USER_HASH')
+    if env:
+        return env
+    try:
+        if os.path.exists(_USER_HASH_FILE):
+            with open(_USER_HASH_FILE, encoding='utf-8') as f:
+                cached = f.read().strip()
+            if re.fullmatch(r'[0-9a-f]{8}', cached):
+                return cached
+    except OSError:
+        pass
+    user_hash = hashlib.md5(
+        (get_user() + str(uuid.getnode())).encode()).hexdigest()[:8]
+    try:
+        os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+        with open(_USER_HASH_FILE, 'w', encoding='utf-8') as f:
+            f.write(user_hash)
+    except OSError:
+        pass
+    return user_hash
+
+
+def generate_cluster_name(prefix: str = 'skyt') -> str:
+    return f'{prefix}-{uuid.uuid4().hex[:4]}-{get_user()[:8]}'
+
+
+def validate_cluster_name(name: str) -> None:
+    if not CLUSTER_NAME_VALID_REGEX.fullmatch(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must start with a letter, '
+            'contain only [a-zA-Z0-9_-], and not end with - or _.')
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex
+
+
+class Backoff:
+    """Decorrelated-jitter exponential backoff (provisioner retry loops;
+
+    the reference uses a similar helper for `_retry_zones`,
+    sky/backends/cloud_vm_ray_backend.py:1003)."""
+
+    def __init__(self,
+                 initial: float = 1.0,
+                 max_backoff: float = 30.0,
+                 multiplier: float = 1.6) -> None:
+        self._initial = initial
+        self._max = max_backoff
+        self._mult = multiplier
+        self._current = initial
+
+    def current_backoff(self) -> float:
+        delay = min(self._current * random.uniform(0.8, 1.2), self._max)
+        self._current = min(self._current * self._mult, self._max)
+        return delay
+
+    def reset(self) -> None:
+        self._current = self._initial
+
+
+def retry(fn: Callable[[], T],
+          *,
+          max_attempts: int = 3,
+          retryable: Callable[[Exception], bool] = lambda e: True,
+          initial_backoff: float = 1.0) -> T:
+    backoff = Backoff(initial=initial_backoff)
+    last_exc: Optional[Exception] = None
+    for attempt in range(max_attempts):
+        try:
+            return fn()
+        except Exception as e:  # pylint: disable=broad-except
+            if not retryable(e):
+                raise
+            last_exc = e
+            if attempt < max_attempts - 1:
+                time.sleep(backoff.current_backoff())
+    assert last_exc is not None
+    raise last_exc
+
+
+def deep_update(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursively merge `override` into `base` (returns a new dict)."""
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_update(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def format_float(x: Optional[float], precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if x >= 100 or x == int(x):
+        return str(int(round(x)))
+    return f'{x:.{precision}f}'
+
+
+def readable_duration(seconds: float) -> str:
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    if seconds < 3600:
+        return f'{seconds // 60}m {seconds % 60}s'
+    return f'{seconds // 3600}h {(seconds % 3600) // 60}m'

@@ -1,0 +1,49 @@
+"""Distributed locks guarding cluster state transitions.
+
+Parity: ``sky/utils/locks.py:51`` (DistributedLock with FileLock/PostgresLock
+backends). We ship the filelock backend; the interface leaves room for a DB
+advisory-lock backend when the API server runs against Postgres.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import filelock
+
+LOCK_DIR = os.path.expanduser('~/.skyt/locks')
+
+
+class DistributedLock:
+    """A named inter-process lock (per-cluster, per-job-controller...)."""
+
+    def __init__(self, name: str, timeout: Optional[float] = None) -> None:
+        os.makedirs(LOCK_DIR, exist_ok=True)
+        safe = name.replace('/', '_')
+        self._path = os.path.join(LOCK_DIR, f'{safe}.lock')
+        self._timeout = -1 if timeout is None else timeout
+        self._lock = filelock.FileLock(self._path, timeout=self._timeout)
+
+    def acquire(self) -> None:
+        self._lock.acquire()
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.is_locked
+
+    def __enter__(self) -> 'DistributedLock':
+        self.acquire()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.release()
+
+
+def cluster_lock(cluster_name: str,
+                 timeout: Optional[float] = None) -> DistributedLock:
+    """The per-cluster provision/teardown lock (parity:
+
+    `_locked_provision`, sky/backends/cloud_vm_ray_backend.py:3342)."""
+    return DistributedLock(f'cluster.{cluster_name}', timeout=timeout)

@@ -1,0 +1,128 @@
+"""Subprocess helpers: run with streaming/capture, parallel map, kill trees.
+
+Parity: ``sky/utils/subprocess_utils.py`` + the log-streaming bits of
+``sky/skylet/log_lib.py``.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from typing import Callable, IO, Iterable, List, Optional, Tuple, TypeVar
+
+import psutil
+
+T = TypeVar('T')
+R = TypeVar('R')
+
+
+def run_command(cmd,
+                *,
+                shell: bool = False,
+                cwd: Optional[str] = None,
+                env: Optional[dict] = None,
+                stream_to: Optional[IO[str]] = None,
+                log_path: Optional[str] = None,
+                timeout: Optional[float] = None) -> Tuple[int, str, str]:
+    """Run a command; capture stdout/stderr; optionally tee stdout+stderr.
+
+    Returns (returncode, stdout, stderr). When `stream_to`/`log_path` is
+    given, stdout and stderr are merged and teed line-by-line.
+    """
+    if isinstance(cmd, str) and not shell:
+        cmd = shlex.split(cmd)
+    full_env = None
+    if env is not None:
+        full_env = {**os.environ, **env}
+    if stream_to is None and log_path is None:
+        proc = subprocess.run(cmd,
+                              shell=shell,
+                              cwd=cwd,
+                              env=full_env,
+                              capture_output=True,
+                              text=True,
+                              timeout=timeout,
+                              check=False)
+        return proc.returncode, proc.stdout, proc.stderr
+    # Tee mode: merge stderr into stdout for ordered logs.
+    log_file = open(log_path, 'a', encoding='utf-8') if log_path else None
+    lines: List[str] = []
+    try:
+        proc = subprocess.Popen(cmd,
+                                shell=shell,
+                                cwd=cwd,
+                                env=full_env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                text=True,
+                                start_new_session=True)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            if stream_to is not None:
+                stream_to.write(line)
+                stream_to.flush()
+            if log_file is not None:
+                log_file.write(line)
+                log_file.flush()
+        returncode = proc.wait(timeout=timeout)
+    finally:
+        if log_file is not None:
+            log_file.close()
+    return returncode, ''.join(lines), ''
+
+
+def run_in_parallel(fn: Callable[[T], R],
+                    args: Iterable[T],
+                    max_workers: Optional[int] = None) -> List[R]:
+    """Ordered parallel map over a thread pool (SSH fan-out to pod hosts)."""
+    args = list(args)
+    if not args:
+        return []
+    if len(args) == 1:
+        return [fn(args[0])]
+    max_workers = max_workers or min(32, len(args))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=max_workers) as ex:
+        return list(ex.map(fn, args))
+
+
+def kill_process_tree(pid: int, sig: int = signal.SIGTERM) -> None:
+    """Signal a process and all of its descendants (gang teardown: a TPU
+
+    program hangs rather than crashes on lost peers, so the whole rank tree
+    must be killed -- see SURVEY.md section 7 'hard parts')."""
+    try:
+        root = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = [root]
+    try:
+        procs.extend(root.children(recursive=True))
+    except psutil.NoSuchProcess:
+        pass
+    for proc in procs:
+        try:
+            proc.send_signal(sig)
+        except (psutil.NoSuchProcess, ProcessLookupError):
+            pass
+
+
+def daemonize_and_run(cmd: List[str],
+                      log_path: str,
+                      env: Optional[dict] = None,
+                      cwd: Optional[str] = None) -> int:
+    """Start a fully detached background process; returns its pid."""
+    full_env = {**os.environ, **(env or {})}
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(cmd,
+                                stdout=log_file,
+                                stderr=subprocess.STDOUT,
+                                stdin=subprocess.DEVNULL,
+                                env=full_env,
+                                cwd=cwd,
+                                start_new_session=True)
+    return proc.pid

@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip sharding logic is tested on a virtual CPU mesh (no multi-chip TPU
+hardware in CI) -- the strategy SURVEY.md section 4 prescribes for the
+rebuild. Real-TPU benchmarking happens in bench.py, not here.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_home(tmp_path, monkeypatch):
+    """Isolate ~/.skyt state per test (the reference resets its sqlite DB per
+    test via reset_global_state, tests/common_test_fixtures.py)."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    monkeypatch.setenv('SKYT_STATE_DIR', str(home / '.skyt'))
+    return home

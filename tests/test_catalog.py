@@ -1,0 +1,61 @@
+"""Catalog lookup tests (ref: sky/catalog tests)."""
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu.catalog.common import get_offerings, pick_cpu_instance_type
+
+
+def test_tpu_offerings():
+    offerings = get_offerings('tpu-v5p-64')
+    assert offerings
+    for o in offerings:
+        assert o.cloud == 'gcp'
+        assert o.tpu is not None and o.tpu.chips == 32
+        assert o.price_hr == pytest.approx(32 * 4.20)
+        assert o.spot_price_hr < o.price_hr
+        assert o.zone.startswith(o.region)
+
+
+def test_region_filter():
+    offerings = get_offerings('tpu-v5e-8', region='us-west4')
+    assert offerings and all(o.region == 'us-west4' for o in offerings)
+    assert get_offerings('tpu-v5e-8', region='mars-central1') == []
+
+
+def test_gpu_offerings():
+    offerings = get_offerings('A100', 8)
+    assert offerings
+    assert offerings[0].price_hr == pytest.approx(8 * 3.67)
+
+
+def test_multi_slice_pricing():
+    single = get_offerings('tpu-v5e-16')[0]
+    multi = get_offerings('tpu-v5e-16', num_slices=4)[0]
+    assert multi.price_hr == pytest.approx(4 * single.price_hr)
+
+
+def test_list_accelerators():
+    accs = catalog.list_accelerators(name_filter='v6e')
+    assert 'tpu-v6e-8' in accs
+    assert all('v6e' in name for name in accs)
+    all_accs = catalog.list_accelerators()
+    assert 'A100' in all_accs and 'tpu-v5p-8' in all_accs
+
+
+def test_hourly_cost():
+    cost = catalog.get_hourly_cost('tpu-v5e-8')
+    assert cost == pytest.approx(8 * 1.20)
+    spot = catalog.get_hourly_cost('tpu-v5e-8', use_spot=True)
+    assert spot < cost
+    assert catalog.get_hourly_cost(None, cpus=4) > 0
+
+
+def test_pick_cpu_instance():
+    assert pick_cpu_instance_type(8, None) == 'n2-standard-8'
+    assert pick_cpu_instance_type(None, None) == 'n2-standard-2'
+
+
+def test_validate_region_zone():
+    catalog.validate_region_zone('gcp', 'us-central1', 'us-central1-a')
+    with pytest.raises(Exception):
+        catalog.validate_region_zone('gcp', 'us-central1', 'europe-west4-a')

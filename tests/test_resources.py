@@ -1,0 +1,79 @@
+"""Resources parsing/validation tests (ref: tests of sky/resources.py)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.resources import Resources, parse_infra
+
+
+def test_tpu_accelerator_string():
+    r = Resources(accelerators='tpu-v5p-64')
+    assert r.is_tpu
+    assert r.tpu.chips == 32
+    assert r.accelerators == {'tpu-v5p-64': 1}
+    assert r.tpu_runtime_version == 'v2-alpha-tpuv5'
+
+
+def test_tpu_runtime_version_override():
+    r = Resources(accelerators='tpu-v5e-8',
+                  accelerator_args={'runtime_version': 'v2-alpha-custom'})
+    assert r.tpu_runtime_version == 'v2-alpha-custom'
+
+
+def test_gpu_accelerator_with_count():
+    r = Resources(accelerators='A100:8')
+    assert not r.is_tpu
+    assert r.accelerators == {'A100': 8}
+
+
+def test_infra_string():
+    assert parse_infra('gcp/us-central2/us-central2-b') == (
+        'gcp', 'us-central2', 'us-central2-b')
+    assert parse_infra('gcp') == ('gcp', None, None)
+    assert parse_infra('gcp/*/us-central1-a') == ('gcp', None, 'us-central1-a')
+    r = Resources(infra='gcp/us-central1', accelerators='tpu-v5e-8')
+    assert r.cloud == 'gcp' and r.region == 'us-central1'
+    with pytest.raises(exceptions.InvalidSpecError):
+        Resources(infra='gcp/us-central1', cloud='gcp')
+
+
+def test_num_slices_requires_tpu():
+    with pytest.raises(exceptions.InvalidSpecError):
+        Resources(accelerators='A100:8', num_slices=2)
+    r = Resources(accelerators='tpu-v5e-16', num_slices=2)
+    assert r.tpu.total_hosts == 4
+
+
+def test_tpu_count_must_be_one():
+    with pytest.raises(exceptions.InvalidSpecError):
+        Resources(accelerators={'tpu-v5e-8': 2})
+
+
+def test_cpus_plus_syntax():
+    r = Resources(cpus='8+', memory='32')
+    assert r.cpus == (8.0, '>=')
+    assert r.memory == (32.0, '==')
+
+
+def test_yaml_roundtrip():
+    r = Resources(cloud='gcp', region='us-east5', accelerators='tpu-v5p-128',
+                  use_spot=True, disk_size=200,
+                  autostop={'idle_minutes': 10, 'down': True},
+                  labels={'team': 'research'})
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r == r2
+    assert r2.autostop.enabled and r2.autostop.down
+    assert r2.autostop.idle_minutes == 10
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(exceptions.InvalidSpecError):
+        Resources.from_yaml_config({'acelerators': 'tpu-v5e-8'})
+
+
+def test_less_demanding_than():
+    small = Resources(accelerators='tpu-v5e-8')
+    big = Resources(cloud='gcp', region='us-west4',
+                    accelerators='tpu-v5e-8')
+    assert small.less_demanding_than(big)
+    other = Resources(accelerators='tpu-v5e-16')
+    assert not other.less_demanding_than(big)

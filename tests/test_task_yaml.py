@@ -1,0 +1,88 @@
+"""Task/Dag YAML parsing tests (ref: tests/test_yaml_parser.py)."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.task import Task
+
+TPU_TASK_YAML = textwrap.dedent("""\
+    name: maxtext-llama3-8b
+    resources:
+      accelerators: tpu-v5p-64
+      use_spot: true
+    num_nodes: 1
+    envs:
+      MODEL: llama3-8b
+    setup: |
+      pip list
+    run: |
+      python -m skypilot_tpu.train --model $MODEL
+    """)
+
+
+def test_from_yaml(tmp_path):
+    path = tmp_path / 'task.yaml'
+    path.write_text(TPU_TASK_YAML)
+    task = Task.from_yaml(str(path))
+    assert task.name == 'maxtext-llama3-8b'
+    assert task.uses_tpu
+    assert task.resources[0].tpu.chips == 32
+    assert task.resources[0].use_spot
+    assert task.envs['MODEL'] == 'llama3-8b'
+    assert 'pip list' in task.setup
+
+
+def test_yaml_roundtrip(tmp_path):
+    path = tmp_path / 'task.yaml'
+    path.write_text(TPU_TASK_YAML)
+    task = Task.from_yaml(str(path))
+    out = tmp_path / 'out.yaml'
+    task.to_yaml(str(out))
+    task2 = Task.from_yaml(str(out))
+    assert task2.to_yaml_config() == task.to_yaml_config()
+
+
+def test_any_of_resources():
+    task = Task.from_yaml_config({
+        'run': 'echo hi',
+        'resources': {
+            'any_of': [
+                {'accelerators': 'tpu-v5e-8'},
+                {'accelerators': 'A100:8'},
+            ]
+        },
+    })
+    assert len(task.resources) == 2
+
+
+def test_unknown_field():
+    with pytest.raises(exceptions.InvalidSpecError):
+        Task.from_yaml_config({'run': 'x', 'nodes': 2})
+
+
+def test_callable_run():
+    task = Task(run=lambda rank, ips: f'echo rank {rank} of {len(ips)}')
+    assert task.get_run_command(1, ['a', 'b']) == 'echo rank 1 of 2'
+
+
+def test_num_slices_vs_num_nodes_conflict():
+    with pytest.raises(exceptions.InvalidSpecError):
+        Task.from_yaml_config({
+            'run': 'x',
+            'num_nodes': 2,
+            'resources': {'accelerators': 'tpu-v5e-16', 'num_slices': 2},
+        })
+
+
+def test_dag_context_manager():
+    with Dag(name='pipeline') as dag:
+        t1 = Task(name='train', run='echo train')
+        t2 = Task(name='eval', run='echo eval')
+        dag.add(t1)
+        dag.add(t2)
+        assert Dag.get_current() is dag
+    assert Dag.get_current() is None
+    dag.validate()
+    assert len(dag) == 2

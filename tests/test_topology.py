@@ -1,0 +1,83 @@
+"""TpuTopology parsing/derivation tests."""
+import math
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.topology import TpuTopology
+
+
+def test_v5p_cores_naming():
+    t = TpuTopology.from_accelerator('tpu-v5p-64')
+    assert t.generation == 'v5p'
+    assert t.chips == 32
+    assert t.cores == 64
+    assert t.hosts_per_slice == 8       # 4 chips/host
+    assert t.is_multi_host
+    assert t.accelerator_name == 'tpu-v5p-64'
+    assert t.accelerator_type == 'v5p-64'
+
+
+def test_v5e_chips_naming():
+    t = TpuTopology.from_accelerator('tpu-v5e-8')
+    assert t.chips == 8
+    assert t.hosts_per_slice == 1
+    assert not t.is_multi_host
+    assert t.accelerator_type == 'v5litepod-8'
+
+
+def test_v6e_multi_host():
+    t = TpuTopology.from_accelerator('tpu-v6e-32')
+    assert t.chips == 32
+    assert t.hosts_per_slice == 4
+    assert math.prod(t.topology) == 32
+    assert len(t.topology) == 2
+
+
+def test_aliases_and_prefix_optional():
+    assert TpuTopology.from_accelerator('v6e-16').generation == 'v6e'
+    assert TpuTopology.from_accelerator(
+        'tpu-v5litepod-8').generation == 'v5e'
+    assert TpuTopology.from_accelerator('trillium-8').generation == 'v6e'
+
+
+def test_explicit_topology():
+    t = TpuTopology.from_accelerator('tpu-v4-32', topology='2x2x4')
+    assert t.topology == (2, 2, 4)
+    with pytest.raises(exceptions.InvalidSpecError):
+        TpuTopology.from_accelerator('tpu-v4-32', topology='4x4x4')
+
+
+def test_default_topology_product_matches_chips():
+    for name in ['tpu-v5e-16', 'tpu-v5e-256', 'tpu-v5p-128', 'tpu-v4-512',
+                 'tpu-v6e-64', 'tpu-v2-32']:
+        t = TpuTopology.from_accelerator(name)
+        assert math.prod(t.topology) == t.chips, name
+
+
+def test_multi_slice():
+    t = TpuTopology.from_accelerator('tpu-v5p-64', num_slices=4)
+    assert t.total_chips == 128
+    assert t.total_hosts == 32
+    assert t.mesh_hint() == {'ici': 32, 'dcn': 4}
+    assert 'x4 slices' in str(t)
+
+
+def test_not_a_tpu():
+    assert TpuTopology.maybe_from_accelerator('A100') is None
+    assert TpuTopology.maybe_from_accelerator('H100:8') is None
+
+
+def test_invalid_names():
+    with pytest.raises(exceptions.InvalidSpecError):
+        TpuTopology.from_accelerator('tpu-v9z-8')
+    with pytest.raises(exceptions.InvalidSpecError):
+        TpuTopology.from_accelerator('tpu-v5p-7')  # not divisible by cores
+    with pytest.raises(exceptions.InvalidSpecError):
+        TpuTopology.from_accelerator('tpu-v5e-100000')  # too big
+
+
+def test_flops_and_hbm():
+    t = TpuTopology.from_accelerator('tpu-v5e-8')
+    assert t.bf16_tflops_per_slice == pytest.approx(8 * 197)
+    assert t.hbm_gb_total == pytest.approx(8 * 16)
