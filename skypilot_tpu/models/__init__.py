@@ -1,0 +1,12 @@
+"""In-tree JAX model families -- the TPU-native payload story.
+
+The reference ships GPU recipes as YAML dirs (``llm/llama-2 .. llama-4,
+mixtral, deepseek-r1 ...``) that shell out to torch frameworks. Here the
+flagship payloads are in-tree JAX: a Llama-family dense decoder and a
+Mixtral-style MoE, written functionally (params = pytrees, pure apply fns)
+with logical-axis shardings so the same code runs 1-chip to multi-slice.
+"""
+from skypilot_tpu.models.config import ModelConfig, get_model_config
+from skypilot_tpu.models import llama
+
+__all__ = ['ModelConfig', 'get_model_config', 'llama']

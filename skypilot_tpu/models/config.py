@@ -1,0 +1,134 @@
+"""Model configurations (Llama-3 family + MoE + tiny test sizes).
+
+Sizes follow the public Llama-3/Mixtral architecture papers; the reference
+orchestrates these same model families as GPU recipes (``llm/llama-3``,
+``llm/mixtral``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from skypilot_tpu.utils.registry import MODEL_REGISTRY
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    head_dim: Optional[int] = None      # default d_model // n_heads
+    rope_theta: float = 500_000.0
+    max_seq_len: int = 8192
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    experts_per_token: int = 2
+    # numerics
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    # remat: 'none' | 'full' | 'dots' (checkpoint matmul outputs only)
+    remat_policy: str = 'full'
+    # attention impl: 'auto' (pallas on TPU, xla elsewhere) | 'xla' | 'pallas'
+    attention_impl: str = 'auto'
+    # Embedding lookup as one-hot matmul: rides the MXU and partitions
+    # cleanly when the table is vocab/embed-sharded (a gather forces XLA
+    # into involuntary full rematerialization of the table).
+    use_iota_embed: bool = True
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def params_count(self) -> int:
+        """Exact dense-param count (used for MFU accounting)."""
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd = self.resolved_head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd \
+            + self.n_heads * hd * d
+        if self.is_moe:
+            mlp = 3 * d * f * self.num_experts + d * self.num_experts
+        else:
+            mlp = 3 * d * f
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        embed = v * d
+        head = 0 if self.tie_embeddings else d * v
+        return self.n_layers * per_layer + embed + head + d
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approx training FLOPs/token: 6*N_active + attention term.
+
+        6*N for fwd+bwd matmuls; attention adds 12*L*hd*H*seq (qk+av,
+        fwd+bwd, causal halves it) -- the standard PaLM-style accounting.
+        """
+        n_active = self.params_count()
+        if self.is_moe:
+            d, f = self.d_model, self.d_ff
+            dense_mlp_all = 3 * d * f * self.num_experts * self.n_layers
+            dense_mlp_active = 3 * d * f * self.experts_per_token * self.n_layers
+            n_active = n_active - dense_mlp_all + dense_mlp_active
+        attn_flops = (12 * self.n_layers * self.n_heads *
+                      self.resolved_head_dim * seq_len) / 2
+        return 6 * n_active + attn_flops
+
+
+def _register(cfg: ModelConfig) -> ModelConfig:
+    MODEL_REGISTRY.register(cfg.name)(cfg)
+    return cfg
+
+
+LLAMA3_8B = _register(ModelConfig(
+    name='llama3-8b', vocab_size=128_256, d_model=4096, n_layers=32,
+    n_heads=32, n_kv_heads=8, d_ff=14336, rope_theta=500_000.0))
+
+LLAMA3_70B = _register(ModelConfig(
+    name='llama3-70b', vocab_size=128_256, d_model=8192, n_layers=80,
+    n_heads=64, n_kv_heads=8, d_ff=28672))
+
+LLAMA2_7B = _register(ModelConfig(
+    name='llama2-7b', vocab_size=32_000, d_model=4096, n_layers=32,
+    n_heads=32, n_kv_heads=32, d_ff=11008, rope_theta=10_000.0,
+    max_seq_len=4096))
+
+MIXTRAL_8X7B = _register(ModelConfig(
+    name='mixtral-8x7b', vocab_size=32_000, d_model=4096, n_layers=32,
+    n_heads=32, n_kv_heads=8, d_ff=14336, rope_theta=1_000_000.0,
+    num_experts=8, experts_per_token=2))
+
+# Small configs for tests / CPU-mesh dryruns / single-chip benches.
+TINY = _register(ModelConfig(
+    name='tiny', vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=2, d_ff=128, max_seq_len=128, remat_policy='none'))
+
+TINY_MOE = _register(ModelConfig(
+    name='tiny-moe', vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=2, d_ff=128, max_seq_len=128, num_experts=4,
+    experts_per_token=2, remat_policy='none'))
+
+# ~125M: fits a single v5e chip comfortably for bench.py.
+SMALL_1B = _register(ModelConfig(
+    name='small-1b', vocab_size=32_000, d_model=2048, n_layers=16,
+    n_heads=16, n_kv_heads=8, d_ff=5504, max_seq_len=2048))
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    cfg: ModelConfig = MODEL_REGISTRY.get(name)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def list_models() -> Tuple[str, ...]:
+    return tuple(MODEL_REGISTRY.keys())

@@ -1,0 +1,10 @@
+"""TPU ops: Pallas kernels with XLA fallbacks.
+
+Dispatch policy: 'auto' picks the Pallas kernel on TPU backends and the
+pure-XLA reference implementation elsewhere (CPU test meshes), so the same
+model code runs everywhere. Kernels follow /opt/skills/guides/pallas_guide.md.
+"""
+from skypilot_tpu.ops.attention import multi_head_attention
+from skypilot_tpu.ops.norms import rms_norm
+
+__all__ = ['multi_head_attention', 'rms_norm']

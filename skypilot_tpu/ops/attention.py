@@ -1,0 +1,90 @@
+"""Attention ops: XLA reference implementation + dispatch to Pallas.
+
+The XLA path is the numerics reference (softmax in fp32) and the CPU-mesh
+test path; `impl='pallas'`/'auto' routes to the flash-attention kernel in
+``ops/pallas/flash_attention.py`` on TPU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # mask value well below bf16 range after fp32 softmax
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() in ('tpu', 'axon')
+
+
+def _pallas_available() -> bool:
+    import importlib.util
+    return importlib.util.find_spec('skypilot_tpu.ops.pallas') is not None
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA broadcast)."""
+    if n_rep == 1:
+        return x
+    b, s, kv, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :],
+                            (b, s, kv, n_rep, d)).reshape(b, s, kv * n_rep, d)
+
+
+def xla_attention(q: jax.Array,
+                  k: jax.Array,
+                  v: jax.Array,
+                  *,
+                  causal: bool = True,
+                  segment_ids: Optional[jax.Array] = None,
+                  scale: Optional[float] = None) -> jax.Array:
+    """Reference attention. q: [B,S,H,D]; k,v: [B,S,KV,D]; returns [B,S,H,D].
+
+    Softmax statistics in fp32 regardless of input dtype (bf16-safe).
+    """
+    b, s_q, n_heads, head_dim = q.shape
+    n_kv = k.shape[2]
+    assert n_heads % n_kv == 0, (n_heads, n_kv)
+    k = repeat_kv(k, n_heads // n_kv)
+    v = repeat_kv(v, n_heads // n_kv)
+    if scale is None:
+        scale = head_dim ** -0.5
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    s_k = k.shape[1]
+    mask = None
+    if causal:
+        q_pos = jnp.arange(s_q)[:, None]
+        k_pos = jnp.arange(s_k)[None, :]
+        mask = q_pos >= k_pos  # [S_q, S_k]
+        mask = mask[None, None, :, :]
+    if segment_ids is not None:
+        seg_mask = (segment_ids[:, :, None] == segment_ids[:, None, :])
+        seg_mask = seg_mask[:, None, :, :]
+        mask = seg_mask if mask is None else jnp.logical_and(mask, seg_mask)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhqk,bkhd->bqhd', weights.astype(v.dtype), v)
+    del b
+    return out
+
+
+def multi_head_attention(q: jax.Array,
+                         k: jax.Array,
+                         v: jax.Array,
+                         *,
+                         causal: bool = True,
+                         segment_ids: Optional[jax.Array] = None,
+                         impl: str = 'auto') -> jax.Array:
+    """Dispatching attention entry point used by models/."""
+    if impl == 'auto':
+        impl = 'pallas' if (_on_tpu() and _pallas_available()) else 'xla'
+    if impl == 'pallas':
+        from skypilot_tpu.ops.pallas import flash_attention  # lazy
+        return flash_attention.flash_attention(q, k, v, causal=causal,
+                                               segment_ids=segment_ids)
+    if impl == 'xla':
+        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    raise ValueError(f'Unknown attention impl {impl!r}')

@@ -1,0 +1,16 @@
+"""Parallelism layer: device meshes, logical-axis sharding rules, and
+
+distributed runtime init. This is where the rebuild departs hardest from the
+reference: SkyPilot's data plane is 'NCCL configured by env injection'
+(SURVEY.md section 2.9); ours is XLA collectives over ICI/DCN driven by
+``jax.sharding`` + ``pjit`` over a ``Mesh``."""
+from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+from skypilot_tpu.parallel.sharding import (LogicalAxisRules,
+                                            logical_sharding,
+                                            shard_params_pytree,
+                                            with_logical_constraint)
+
+__all__ = [
+    'MeshConfig', 'build_mesh', 'LogicalAxisRules', 'logical_sharding',
+    'shard_params_pytree', 'with_logical_constraint',
+]

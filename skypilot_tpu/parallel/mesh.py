@@ -1,0 +1,198 @@
+"""Device mesh construction, ICI/DCN-aware.
+
+Axis convention (outermost -> innermost):
+
+    ('data', 'stage', 'fsdp', 'seq', 'expert', 'tensor')
+
+* ``data``   -- pure data parallelism. Across slices this rides DCN, so it
+  is the outermost axis (gradients all-reduce once per step; lowest
+  bandwidth need -- the scaling-book multi-slice recipe).
+* ``stage``  -- pipeline stages (inter-slice or intra-slice).
+* ``fsdp``   -- fully-sharded data parallel (ZeRO-3-style weight sharding).
+* ``seq``    -- sequence/context parallelism (ring attention).
+* ``expert`` -- MoE expert parallelism.
+* ``tensor`` -- Megatron-style tensor parallelism; innermost so its heavy
+  all-reduces map onto nearest-neighbor ICI links.
+
+The reference has no equivalent (its payloads bring their own meshes); this
+module is what turns a ``TpuTopology`` from the orchestrator into the mesh
+the in-tree payloads (models/, train/) run on.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+MESH_AXIS_NAMES: Tuple[str, ...] = ('data', 'stage', 'fsdp', 'seq', 'expert',
+                                    'tensor')
+
+# Axes whose collectives may cross slice boundaries (ride DCN).
+DCN_AXIS_NAMES: Tuple[str, ...] = ('data', 'stage')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Parallelism degrees. -1 on `fsdp` means 'all remaining devices'."""
+    data: int = 1
+    stage: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    expert: int = 1
+    tensor: int = 1
+    # Degrees that cross slice boundaries (multi-slice over DCN). data_dcn
+    # splits the `data` axis into a DCN-level product; 1 = single slice.
+    num_slices: int = 1
+
+    def resolve(self, num_devices: int) -> 'MeshConfig':
+        """Fill in -1 axes so the product equals num_devices."""
+        sizes = {
+            name: getattr(self, name) for name in MESH_AXIS_NAMES
+        }
+        unknown = [k for k, v in sizes.items() if v == -1]
+        known_product = math.prod(v for v in sizes.values() if v != -1)
+        if not unknown:
+            if known_product != num_devices:
+                raise ValueError(
+                    f'Mesh axes {sizes} multiply to {known_product}, but '
+                    f'{num_devices} devices are present.')
+            return self
+        if len(unknown) > 1:
+            raise ValueError(f'At most one -1 axis allowed, got {unknown}')
+        if num_devices % known_product:
+            raise ValueError(
+                f'{num_devices} devices not divisible by fixed axes product '
+                f'{known_product} ({sizes})')
+        sizes[unknown[0]] = num_devices // known_product
+        return dataclasses.replace(self, **sizes)
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, name) for name in MESH_AXIS_NAMES)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axis_sizes())
+
+
+def build_mesh(config: MeshConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` honoring ICI vs DCN axis placement.
+
+    Single-slice: ``mesh_utils.create_device_mesh`` lays devices out so
+    innermost axes get nearest-neighbor ICI links. Multi-slice:
+    ``create_hybrid_device_mesh`` keeps DCN axes (data/stage) across slice
+    boundaries and ICI axes within a slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    config = config.resolve(len(devices))
+    shape = config.axis_sizes()
+    if config.num_slices > 1:
+        per_slice = len(devices) // config.num_slices
+        dcn_shape = []
+        ici_shape = []
+        remaining_dcn = config.num_slices
+        for name, size in zip(MESH_AXIS_NAMES, shape):
+            if name in DCN_AXIS_NAMES and remaining_dcn > 1:
+                take = math.gcd(size, remaining_dcn)
+                dcn_shape.append(take)
+                ici_shape.append(size // take)
+                remaining_dcn //= take
+            else:
+                dcn_shape.append(1)
+                ici_shape.append(size)
+        if remaining_dcn != 1:
+            raise ValueError(
+                f'num_slices={config.num_slices} does not divide into DCN '
+                f'axes {DCN_AXIS_NAMES} of mesh {dict(zip(MESH_AXIS_NAMES, shape))}')
+        if hasattr(devices[0], 'slice_index'):
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                process_is_granule=False)
+        else:
+            # Virtual CPU mesh (tests/dryrun): devices carry no slice_index.
+            # Emulate the hybrid layout -- consecutive device blocks act as
+            # slices, blocked into the full mesh along the DCN axes.
+            device_array = _block_hybrid_mesh(devices, ici_shape, dcn_shape,
+                                              per_slice)
+    else:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(device_array, MESH_AXIS_NAMES)
+
+
+def _block_hybrid_mesh(devices: Sequence[jax.Device],
+                       ici_shape: Sequence[int],
+                       dcn_shape: Sequence[int],
+                       per_slice: int) -> np.ndarray:
+    """Blocked (slice-major) device ndarray: axis i has size dcn*ici."""
+    full_shape = tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+    out = np.empty(full_shape, dtype=object)
+    num_slices = math.prod(dcn_shape)
+    for slice_idx, dcn_index in enumerate(np.ndindex(*dcn_shape)):
+        group = devices[slice_idx * per_slice:(slice_idx + 1) * per_slice]
+        sub = mesh_utils.create_device_mesh(ici_shape, devices=group,
+                                            allow_split_physical_axes=True)
+        region = tuple(
+            slice(dcn_index[d] * ici_shape[d],
+                  (dcn_index[d] + 1) * ici_shape[d])
+            for d in range(len(full_shape)))
+        out[region] = sub
+    assert slice_idx == num_slices - 1
+    return out
+
+
+def use_mesh(mesh: Mesh):
+    """Ambient-mesh context manager, across jax renames (use_mesh/set_mesh)."""
+    if hasattr(jax.sharding, 'use_mesh'):
+        return jax.sharding.use_mesh(mesh)
+    return jax.sharding.set_mesh(mesh)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """A trivial 1-device mesh (all axes size 1) for single-chip runs."""
+    if device is None:
+        device = jax.devices()[0]
+    arr = np.array([device]).reshape((1,) * len(MESH_AXIS_NAMES))
+    return Mesh(arr, MESH_AXIS_NAMES)
+
+
+def auto_mesh_config(num_devices: int,
+                     *,
+                     num_slices: int = 1,
+                     tensor: int = 1,
+                     seq: int = 1,
+                     expert: int = 1,
+                     stage: int = 1) -> MeshConfig:
+    """Default strategy: explicit TP/SP/EP/PP degrees, DP across slices,
+
+    FSDP over everything left -- the standard large-LM recipe (FSDP within a
+    slice rides ICI; data across slices rides DCN)."""
+    data = num_slices if num_slices > 1 else 1
+    fixed = data * stage * seq * expert * tensor
+    if num_devices % fixed:
+        raise ValueError(
+            f'{num_devices} devices not divisible by requested degrees '
+            f'(data={data}, stage={stage}, seq={seq}, expert={expert}, '
+            f'tensor={tensor})')
+    return MeshConfig(data=data, stage=stage, fsdp=num_devices // fixed,
+                      seq=seq, expert=expert, tensor=tensor,
+                      num_slices=num_slices)
+
+
+def mesh_axis_size(mesh: Mesh, names: Sequence[str]) -> int:
+    return math.prod(mesh.shape[n] for n in names if n in mesh.shape)
+
+
+def describe_mesh(mesh: Mesh) -> str:
+    sizes = {k: v for k, v in mesh.shape.items() if v > 1}
+    return f'Mesh({sizes or "1 device"})'
+
+
+def list_local_devices_message() -> List[str]:
+    return [f'{d.platform}:{d.id}' for d in jax.devices()]

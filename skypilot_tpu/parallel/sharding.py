@@ -1,0 +1,144 @@
+"""Logical-axis sharding rules (MaxText-style, reimplemented).
+
+Model code names array dimensions with *logical* axes ('batch', 'embed',
+'mlp', ...). A rule table maps logical axes to mesh axes; changing the
+parallelism strategy is a rule-table edit, not a model edit. XLA inserts the
+collectives implied by the shardings (scaling-book recipe: pick a mesh,
+annotate, let the compiler do the rest).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+LogicalAxes = Tuple[Optional[str], ...]
+
+
+class LogicalAxisRules:
+    """Ordered logical-axis -> mesh-axes mapping."""
+
+    def __init__(self, rules: Dict[str, MeshAxes]) -> None:
+        self._rules = dict(rules)
+
+    def mesh_axes(self, logical: Optional[str]) -> MeshAxes:
+        if logical is None:
+            return None
+        if logical not in self._rules:
+            raise KeyError(f'No sharding rule for logical axis {logical!r}. '
+                           f'Known: {sorted(self._rules)}')
+        return self._rules[logical]
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
+        """('batch', None, 'embed') -> PartitionSpec(('data','fsdp'), None, 'tensor')"""
+        out = []
+        used = set()
+        for ax in logical_axes:
+            mesh_ax = self.mesh_axes(ax)
+            # A mesh axis may appear at most once in a PartitionSpec; later
+            # occurrences replicate (matches flax.linen logical partitioning
+            # semantics).
+            if mesh_ax is None:
+                out.append(None)
+                continue
+            axes = (mesh_ax,) if isinstance(mesh_ax, str) else tuple(mesh_ax)
+            axes = tuple(a for a in axes if a not in used)
+            used.update(axes)
+            if not axes:
+                out.append(None)
+            elif len(axes) == 1:
+                out.append(axes[0])
+            else:
+                out.append(axes)
+        return P(*out)
+
+    def replace(self, **updates: MeshAxes) -> 'LogicalAxisRules':
+        new = dict(self._rules)
+        new.update(updates)
+        return LogicalAxisRules(new)
+
+
+# Default rules for the decoder LMs in models/ (mirrors the standard
+# MaxText/fsdp recipe):
+#   params:     embed->fsdp, mlp/heads/vocab->tensor, layers->stage (PP)
+#   activations: batch->(data,fsdp), seq->seq (context parallel),
+#                heads->tensor, experts->expert
+DEFAULT_RULES = LogicalAxisRules({
+    # activation axes
+    'batch': ('data', 'fsdp'),
+    'act_seq': 'seq',
+    'act_embed': None,
+    'act_heads': 'tensor',
+    'act_kv_heads': 'tensor',
+    # parameter axes
+    'embed': 'fsdp',
+    'mlp': 'tensor',
+    'heads': 'tensor',
+    'kv_heads': 'tensor',
+    'head_dim': None,
+    'vocab': 'tensor',
+    'layers': 'stage',
+    'expert': 'expert',
+    'norm': None,
+})
+
+
+def logical_sharding(mesh: Mesh,
+                     logical_axes: Sequence[Optional[str]],
+                     rules: LogicalAxisRules = DEFAULT_RULES
+                     ) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def shard_params_pytree(mesh: Mesh,
+                        logical_axes_tree,
+                        rules: LogicalAxisRules = DEFAULT_RULES):
+    """Map a pytree of logical-axes tuples to a pytree of NamedShardings.
+
+    `logical_axes_tree` mirrors the params pytree, with each leaf a tuple of
+    logical axis names (or None entries). Leaves are tuples, so we treat
+    tuples as leaves explicitly.
+    """
+
+    def is_leaf(x):
+        return isinstance(x, tuple)
+
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        logical_axes_tree,
+        is_leaf=is_leaf,
+    )
+
+
+def with_logical_constraint(x: jax.Array,
+                            logical_axes: Sequence[Optional[str]],
+                            mesh: Optional[Mesh] = None,
+                            rules: LogicalAxisRules = DEFAULT_RULES
+                            ) -> jax.Array:
+    """`lax.with_sharding_constraint` by logical axis names.
+
+    Inside jit, the mesh comes from the ambient mesh context
+    (`jax.sharding.use_mesh`) when `mesh` is None; with an explicit mesh we
+    build the NamedSharding directly.
+    """
+    if mesh is None:
+        mesh = _abstract_or_ambient_mesh()
+    if mesh is None:
+        return x  # no mesh context: no-op (single-device path)
+    spec = rules.spec(logical_axes)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _abstract_or_ambient_mesh() -> Optional[Mesh]:
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and mesh.shape:
+            return mesh
+    except Exception:  # pylint: disable=broad-except
+        pass
+    env_mesh = jax._src.mesh.thread_resources.env.physical_mesh  # pylint: disable=protected-access
+    if env_mesh.empty:
+        return None
+    return env_mesh

@@ -1,0 +1,14 @@
+"""Pretraining loop: loss, optimizer, sharded train step, checkpointing.
+
+The in-tree MaxText-equivalent: BASELINE.md's north star workload
+(Llama-3-8B pretraining on a v5p-64 slice) runs this module via a launched
+task (`recipes/`).
+"""
+from skypilot_tpu.train.step import (TrainState, create_train_state,
+                                     make_train_step, train_step_fn)
+from skypilot_tpu.train.loss import cross_entropy_loss
+
+__all__ = [
+    'TrainState', 'create_train_state', 'make_train_step', 'train_step_fn',
+    'cross_entropy_loss',
+]

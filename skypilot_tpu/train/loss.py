@@ -1,0 +1,32 @@
+"""Loss functions (fp32 softmax, optional z-loss, padding-aware)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array,
+                       targets: jax.Array,
+                       weights: Optional[jax.Array] = None,
+                       z_loss_coeff: float = 0.0
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Token-weighted mean cross entropy.
+
+    logits [B,S,V] fp32, targets [B,S] int32, weights [B,S] (1 = real token,
+    0 = pad). Returns (mean_loss, total_weight). z-loss (PaLM) regularizes
+    the log-partition toward 0 for bf16 stability.
+    """
+    logits = logits.astype(jnp.float32)
+    log_z = jax.nn.logsumexp(logits, axis=-1)                      # [B,S]
+    target_logits = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1).squeeze(-1)           # [B,S]
+    nll = log_z - target_logits
+    if z_loss_coeff:
+        nll = nll + z_loss_coeff * jnp.square(log_z)
+    if weights is None:
+        weights = jnp.ones_like(nll)
+    weights = weights.astype(jnp.float32)
+    total_weight = jnp.maximum(weights.sum(), 1.0)
+    return (nll * weights).sum() / total_weight, total_weight

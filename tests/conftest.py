@@ -6,11 +6,18 @@ rebuild. Real-TPU benchmarking happens in bench.py, not here.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
         xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+# The image's sitecustomize force-registers the TPU ('axon') backend,
+# overriding JAX_PLATFORMS; the config update below wins over it. Must run
+# before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,55 @@
+"""Mesh construction tests (8-device virtual CPU mesh, see conftest)."""
+import jax
+import pytest
+
+from skypilot_tpu.parallel.mesh import (MeshConfig, auto_mesh_config,
+                                        build_mesh, describe_mesh,
+                                        single_device_mesh)
+
+
+def test_resolve_fills_fsdp():
+    cfg = MeshConfig(data=2, tensor=2).resolve(8)
+    assert cfg.fsdp == 2
+    assert cfg.num_devices == 8
+
+
+def test_resolve_mismatch_raises():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, fsdp=1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=2, fsdp=2, tensor=4).resolve(8)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert mesh.shape['data'] == 2
+    assert mesh.shape['tensor'] == 2
+    assert mesh.shape['stage'] == 1
+    assert 'data' in describe_mesh(mesh)
+
+
+def test_build_mesh_hybrid_multislice():
+    # 2 virtual slices of 4 devices: data axis rides DCN.
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4, num_slices=2))
+    assert mesh.shape['data'] == 2
+    assert mesh.shape['fsdp'] == 4
+
+
+def test_multislice_requires_dcn_axis():
+    with pytest.raises(ValueError):
+        # no data/stage axis to place 2 slices on
+        build_mesh(MeshConfig(data=1, fsdp=8, num_slices=2))
+
+
+def test_auto_mesh_config():
+    cfg = auto_mesh_config(8, tensor=2)
+    assert cfg.fsdp == 4 and cfg.tensor == 2
+    cfg = auto_mesh_config(8, num_slices=2)
+    assert cfg.data == 2 and cfg.fsdp == 4
+    with pytest.raises(ValueError):
+        auto_mesh_config(8, tensor=3)
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh(jax.devices()[0])
+    assert all(v == 1 for v in mesh.shape.values())

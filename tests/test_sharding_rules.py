@@ -1,0 +1,52 @@
+"""Logical-axis sharding rule tests."""
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.config import get_model_config
+from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+from skypilot_tpu.parallel.sharding import (DEFAULT_RULES,
+                                            shard_params_pytree)
+
+
+def test_spec_mapping():
+    assert DEFAULT_RULES.spec(('batch', 'act_seq', 'act_embed')) == P(
+        ('data', 'fsdp'), 'seq', None)
+    assert DEFAULT_RULES.spec(('embed', 'mlp')) == P('fsdp', 'tensor')
+
+
+def test_duplicate_mesh_axis_dropped():
+    # 'embed'->fsdp appears once; a second fsdp-mapped axis replicates.
+    spec = DEFAULT_RULES.spec(('embed', 'embed'))
+    assert spec == P('fsdp', None)
+
+
+def test_rules_replace():
+    rules = DEFAULT_RULES.replace(embed=None)
+    assert rules.spec(('embed', 'mlp')) == P(None, 'tensor')
+    # original untouched
+    assert DEFAULT_RULES.spec(('embed',)) == P('fsdp')
+
+
+def test_param_shardings_cover_tree():
+    cfg = get_model_config('tiny')
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    axes = llama.param_logical_axes(cfg)
+    shardings = shard_params_pytree(mesh, axes)
+    # embedding: vocab->tensor, embed->fsdp
+    assert shardings['embed']['embedding'].spec == P('tensor', 'fsdp')
+    # attn wq: layers->stage(=1), embed->fsdp, heads->tensor
+    assert shardings['layers']['attn']['wq'].spec == P(
+        'stage', 'fsdp', 'tensor', None)
+
+
+def test_moe_param_axes_match_shapes():
+    import jax
+    cfg = get_model_config('tiny-moe')
+    params = jax.eval_shape(
+        lambda k: llama.init_params(k, cfg), jax.random.key(0))
+    axes = llama.param_logical_axes(cfg)
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_a)
+    for p, a in zip(flat_p, flat_a):
+        assert len(p.shape) == len(a), (p.shape, a)
