@@ -1,0 +1,109 @@
+"""Benchmark: flagship-model training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: model FLOPs utilization (MFU) of the sharded train step on the
+available chip(s). The north-star target from BASELINE.md is >=40% MFU
+(Llama-3-8B on v5p-64); `vs_baseline` is measured MFU / 0.40, so 1.0 means
+the target utilization is met on this hardware.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Peak bf16 TFLOP/s per chip by device kind (public specs).
+_PEAK_TFLOPS = {
+    'v2': 45, 'v3': 123, 'v4': 275, 'v5e': 197, 'v5 lite': 197,
+    'v5p': 459, 'v5': 459, 'v6e': 918, 'v6 lite': 918,
+}
+
+
+def _chip_peak_tflops() -> float:
+    dev = jax.devices()[0]
+    kind = getattr(dev, 'device_kind', '').lower()
+    for key, tflops in sorted(_PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return float(tflops)
+    if dev.platform == 'cpu':
+        return 0.1  # nominal; CPU runs are smoke only
+    return 197.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default=None)
+    parser.add_argument('--batch', type=int, default=None)
+    parser.add_argument('--seq', type=int, default=None)
+    parser.add_argument('--steps', type=int, default=10)
+    parser.add_argument('--warmup', type=int, default=3)
+    args = parser.parse_args()
+
+    from skypilot_tpu.models.config import get_model_config
+    from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+    from skypilot_tpu.train.step import (TrainHParams, create_train_state,
+                                         make_train_step)
+
+    on_accel = jax.default_backend() not in ('cpu',)
+    n_dev = len(jax.devices())
+    model = args.model or ('bench-700m' if on_accel else 'tiny')
+    cfg = get_model_config(model)
+    batch = args.batch or (4 if on_accel else 4)
+    seq = args.seq or (2048 if on_accel else 64)
+    seq = min(seq, cfg.max_seq_len)
+
+    mesh = build_mesh(MeshConfig(fsdp=n_dev))
+    hp = TrainHParams(warmup_steps=10, total_steps=1000)
+    state = create_train_state(jax.random.key(0), cfg, hp, mesh)
+    step = make_train_step(cfg, hp, mesh)
+
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    train_batch = {
+        'tokens': tokens,
+        'targets': jnp.roll(tokens, -1, axis=1),
+        'weights': jnp.ones((batch, seq), jnp.float32),
+    }
+
+    for _ in range(args.warmup):
+        state, metrics = step(state, train_batch)
+    float(metrics['loss'])  # host-level sync (block_until_ready is not a
+    # reliable barrier on the remote-TPU platform; a scalar fetch is)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, train_batch)
+        float(metrics['loss'])
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * args.steps / elapsed
+    flops_per_token = cfg.flops_per_token(seq)
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    peak_tflops = _chip_peak_tflops() * n_dev
+    mfu = achieved_tflops / peak_tflops
+
+    result = {
+        'metric': f'train_mfu_{model}_{jax.default_backend()}{n_dev}',
+        'value': round(mfu * 100, 2),
+        'unit': '% MFU',
+        'vs_baseline': round(mfu / 0.40, 3),
+        'detail': {
+            'tokens_per_sec_per_chip': round(tokens_per_sec / n_dev, 1),
+            'achieved_tflops_per_chip': round(achieved_tflops / n_dev, 2),
+            'peak_tflops_per_chip': peak_tflops / n_dev,
+            'batch': batch, 'seq': seq, 'steps': args.steps,
+            'loss': round(float(metrics['loss']), 4),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

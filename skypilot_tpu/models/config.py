@@ -117,9 +117,14 @@ TINY_MOE = _register(ModelConfig(
     n_kv_heads=2, d_ff=128, max_seq_len=128, num_experts=4,
     experts_per_token=2, remat_policy='none'))
 
-# ~125M: fits a single v5e chip comfortably for bench.py.
 SMALL_1B = _register(ModelConfig(
     name='small-1b', vocab_size=32_000, d_model=2048, n_layers=16,
+    n_heads=16, n_kv_heads=8, d_ff=5504, max_seq_len=2048))
+
+# ~690M: sized so params + fp32 Adam state + activations fit a single
+# 16GB v5e chip -- the single-chip bench.py workload.
+BENCH_700M = _register(ModelConfig(
+    name='bench-700m', vocab_size=32_000, d_model=2048, n_layers=12,
     n_heads=16, n_kv_heads=8, d_ff=5504, max_seq_len=2048))
 
 

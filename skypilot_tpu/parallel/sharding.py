@@ -138,7 +138,10 @@ def _abstract_or_ambient_mesh() -> Optional[Mesh]:
             return mesh
     except Exception:  # pylint: disable=broad-except
         pass
-    env_mesh = jax._src.mesh.thread_resources.env.physical_mesh  # pylint: disable=protected-access
-    if env_mesh.empty:
-        return None
-    return env_mesh
+    try:
+        env_mesh = jax._src.mesh.thread_resources.env.physical_mesh  # pylint: disable=protected-access
+        if not env_mesh.empty:
+            return env_mesh
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return None

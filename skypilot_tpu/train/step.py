@@ -71,19 +71,33 @@ def state_shardings(mesh: Mesh,
         functools.partial(llama.init_params, cfg=cfg), jax.random.key(0))
     opt_shape = jax.eval_shape(optimizer.init, param_shapes)
 
-    # Map each opt-state leaf to the sharding of the param it mirrors (by
-    # shape match against the param tree), scalars replicated.
-    flat_params, _ = jax.tree.flatten(param_shapes)
-    flat_shard, _ = jax.tree.flatten(param_sh)
-    shape_to_sharding = {}
-    for p, s in zip(flat_params, flat_shard):
-        shape_to_sharding.setdefault((p.shape, p.dtype), s)
+    # Optax state embeds params-shaped subtrees (adam mu/nu). Map each opt
+    # leaf to the sharding of the param whose tree path is a suffix of the
+    # opt leaf's path -- exact regardless of shape collisions (two params
+    # with equal shapes but different shardings, e.g. square MLPs).
+    param_paths = {
+        tuple(path): sh
+        for path, sh in jax.tree_util.tree_flatten_with_path(param_sh)[0]
+    }
     replicated = NamedSharding(mesh, P())
 
-    def map_leaf(leaf):
-        return shape_to_sharding.get((leaf.shape, leaf.dtype), replicated)
+    def map_opt_leaf(path, leaf):
+        path = tuple(path)
+        for plen in range(len(path), 0, -1):
+            suffix = path[-plen:]
+            if suffix in param_paths:
+                sh = param_paths[suffix]
+                if sh.shard_shape(leaf.shape):  # rank check via shard_shape
+                    return sh
+        return replicated
 
-    opt_sh = jax.tree.map(map_leaf, opt_shape)
+    def safe_map_opt_leaf(path, leaf):
+        try:
+            return map_opt_leaf(path, leaf)
+        except ValueError:
+            return replicated
+
+    opt_sh = jax.tree_util.tree_map_with_path(safe_map_opt_leaf, opt_shape)
     return TrainState(step=replicated, params=param_sh, opt_state=opt_sh)
 
 
@@ -91,10 +105,12 @@ def create_train_state(rng: jax.Array,
                        cfg: ModelConfig,
                        hp: TrainHParams,
                        mesh: Mesh,
-                       rules: LogicalAxisRules = DEFAULT_RULES) -> TrainState:
+                       rules: LogicalAxisRules = DEFAULT_RULES,
+                       shardings: Optional[TrainState] = None) -> TrainState:
     """Initialize params+opt state directly sharded across the mesh."""
     optimizer = make_optimizer(hp)
-    shardings = state_shardings(mesh, cfg, hp, rules)
+    if shardings is None:
+        shardings = state_shardings(mesh, cfg, hp, rules)
 
     def init_fn(rng):
         params = llama.init_params(rng, cfg)
@@ -141,13 +157,15 @@ def train_step_fn(state: TrainState,
 def make_train_step(cfg: ModelConfig,
                     hp: TrainHParams,
                     mesh: Mesh,
-                    rules: LogicalAxisRules = DEFAULT_RULES
+                    rules: LogicalAxisRules = DEFAULT_RULES,
+                    shardings: Optional[TrainState] = None
                     ) -> Callable[[TrainState, Dict[str, jax.Array]],
                                   Tuple[TrainState, Dict[str, jax.Array]]]:
     """The jitted, donated, mesh-contextualized train step."""
     optimizer = make_optimizer(hp)
     batch_sharding = NamedSharding(mesh, rules.spec(('batch', 'act_seq')))
-    shardings = state_shardings(mesh, cfg, hp, rules)
+    if shardings is None:
+        shardings = state_shardings(mesh, cfg, hp, rules)
 
     step = functools.partial(train_step_fn, cfg=cfg, optimizer=optimizer,
                              hp=hp, rules=rules)

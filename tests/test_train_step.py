@@ -74,6 +74,26 @@ def test_cross_entropy_weights():
     assert float(w) == 2.0
 
 
+def test_opt_state_sharding_exact_under_shape_collision(mesh):
+    """d_ff == d_model: wi_gate and wo have identical shapes but transposed
+    shardings; opt-state moments must mirror their own param, not the first
+    shape match."""
+    from skypilot_tpu.train.step import state_shardings
+    cfg = get_model_config('tiny', d_ff=64)  # d_model == d_ff
+    sh = state_shardings(mesh, cfg, TrainHParams())
+    wo_spec = sh.params['layers']['mlp']['wo'].spec
+    gate_spec = sh.params['layers']['mlp']['wi_gate'].spec
+    assert wo_spec != gate_spec
+    flat = jax.tree_util.tree_flatten_with_path(sh.opt_state)[0]
+    mirrors = 0
+    for path, s in flat:
+        keys = [getattr(k, 'key', getattr(k, 'name', None)) for k in path]
+        if keys[-2:] == ['mlp', 'wo']:
+            assert s.spec == wo_spec, (keys, s.spec)
+            mirrors += 1
+    assert mirrors >= 2  # adam mu and nu at least
+
+
 def test_expert_parallel_mesh():
     """MoE with a real expert axis on the mesh."""
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, expert=2))
