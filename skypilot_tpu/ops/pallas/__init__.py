@@ -1,0 +1,6 @@
+"""Pallas TPU kernels (flash attention, fused norms).
+
+Written against the playbook in /opt/skills/guides/pallas_guide.md. Every
+kernel has an XLA reference implementation in ops/ used for numerics tests
+on CPU meshes; dispatch happens in ops/attention.py.
+"""

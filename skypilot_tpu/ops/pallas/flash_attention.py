@@ -1,0 +1,370 @@
+"""Flash attention (causal, GQA) as Pallas TPU kernels, with custom VJP.
+
+Memory-bound attention is the main obstacle between the XLA baseline and
+the MFU target: the naive path materializes [B,H,S,S] score matrices in
+HBM. This kernel keeps scores in VMEM, streaming K/V blocks against each Q
+block with the usual running-max/sum-exp recurrence (flash attention), and
+recomputes probabilities in the backward from the saved logsumexp.
+
+Layout: kernels run in [B, H, S, D]; the public wrapper takes model layout
+[B, S, H, D]. GQA is handled by indexing the KV head as h // group in the
+BlockSpec index maps (no materialized repeat of K/V in HBM).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+def _interpret() -> bool:
+    # CPU (tests): run kernels in the Pallas interpreter.
+    return jax.default_backend() == 'cpu'
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _block_sizes(s: int) -> Tuple[int, int]:
+    bq = min(DEFAULT_BLOCK_Q, s)
+    bk = min(DEFAULT_BLOCK_K, s)
+    while s % bq:
+        bq //= 2
+    while s % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+def _supported(q: jax.Array, k: jax.Array, s_q: int, s_k: int) -> bool:
+    bq, bk = _block_sizes(s_q)
+    if s_q != s_k:
+        return False
+    if bq < 128 or bk < 128:
+        return False
+    if q.shape[-1] % 128:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                scale: float, causal: bool):
+    """Grid: (B, H, num_q_blocks). K/V refs hold the full [S, D] slice."""
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    head_dim = q_ref.shape[1]
+    s_k = k_ref.shape[0]
+    num_k_blocks = pl.cdiv(s_k, block_k)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    def body(kj, carry):
+        m_prev, l_prev, acc = carry
+        k_start = pl.multiple_of(kj * block_k, block_k)
+        k_blk = k_ref[pl.ds(k_start, block_k), :]
+        v_blk = v_ref[pl.ds(k_start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        if causal:
+            q_pos = (qi * block_q +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 0))
+            k_pos = (k_start +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)         # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                    # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    if causal:
+        # only blocks intersecting the lower triangle
+        upper = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_k_blocks)
+    else:
+        upper = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l_safe)                      # [bq, 1]
+
+
+def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+         scale: float) -> Tuple[jax.Array, jax.Array]:
+    """q: [B,H,S,D]; k,v: [B,KV,S,D] -> (o [B,H,S,D], lse [B,H,S])."""
+    b, h, s, d = q.shape
+    kv = k.shape[1]
+    group = h // kv
+    block_q, block_k = _block_sizes(s)
+    grid = (b, h, s // block_q)
+
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
+                               causal=causal)
+    out_shape = [
+        jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k: int, scale: float, causal: bool):
+    """Grid: (B, H, num_q_blocks); accumulates dq for one q block."""
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    s_k = k_ref.shape[0]
+    num_k_blocks = pl.cdiv(s_k, block_k)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]                                       # [bq, 1]
+    delta = delta_ref[:]                                   # [bq, 1]
+
+    def body(kj, dq_acc):
+        k_start = pl.multiple_of(kj * block_k, block_k)
+        k_blk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = (qi * block_q +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 0))
+            k_pos = (k_start +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                              # [bq, bk]
+        dq_acc = dq_acc + jax.lax.dot_general(
+            ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dq_acc
+
+    if causal:
+        upper = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_k_blocks)
+    else:
+        upper = num_k_blocks
+    dq0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, upper, body, dq0)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, scale: float,
+                    causal: bool):
+    """Grid: (B, H, num_k_blocks); per-q-head dk/dv for one k block.
+
+    Group reduction over q heads happens in the wrapper.
+    """
+    ki = pl.program_id(2)
+    block_k = k_ref.shape[0]
+    s_q = q_ref.shape[0]
+    num_q_blocks = pl.cdiv(s_q, block_q)
+
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+
+    def body(qj, carry):
+        dk_acc, dv_acc = carry
+        q_start = pl.multiple_of(qj * block_q, block_q)
+        q_blk = q_ref[pl.ds(q_start, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(q_start, block_q), :]          # [bq, 1]
+        delta = delta_ref[pl.ds(q_start, block_q), :]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if causal:
+            q_pos = (q_start +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 0))
+            k_pos = (ki * block_k +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        return dk_acc, dv_acc
+
+    if causal:
+        # skip q blocks entirely above the diagonal: q >= ki*block_k
+        lower = jax.lax.div(ki * block_k, block_q)
+    else:
+        lower = 0
+    zeros = jnp.zeros((block_k, k_ref.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (zeros, zeros))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal: bool, scale: float, res, do):
+    q, k, v, o, lse = res
+    b, h, s, d = q.shape
+    kv = k.shape[1]
+    group = h // kv
+    block_q, block_k = _block_sizes(s)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [B, H, S, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal),
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk_per_head, dv_per_head = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale,
+                          causal=causal),
+        grid=(b, h, s // block_k),
+        in_specs=[
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki_, _g=group: (bi, hi // _g, ki_, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki_, _g=group: (bi, hi // _g, ki_, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, 1),
+                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, 1),
+                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki_: (bi, hi, ki_, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki_: (bi, hi, ki_, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # GQA: reduce per-q-head dk/dv over the group.
+    dk = dk_per_head.reshape(b, kv, group, s, d).sum(axis=2).astype(k.dtype)
+    dv = dv_per_head.reshape(b, kv, group, s, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal: bool, scale: float):
+    o, _ = _fwd(q, k, v, causal=causal, scale=scale)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    *,
+                    causal: bool = True,
+                    segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Public entry. q: [B,S,H,D]; k,v: [B,S,KV,D]; returns [B,S,H,D].
+
+    Falls back to the XLA reference for shapes/features the kernel does not
+    cover (segment masks, non-multiple-of-128 blocks, cross-attention).
+    """
+    from skypilot_tpu.ops import attention as xla_attn
+    s_q, s_k = q.shape[1], k.shape[1]
+    if segment_ids is not None or not _supported(q, k, s_q, s_k):
+        return xla_attn.xla_attention(q, k, v, causal=causal,
+                                      segment_ids=segment_ids)
+    scale = q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)                           # [B,H,S,D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _flash(qt, kt, vt, causal, scale)
+    return o.transpose(0, 2, 1, 3)

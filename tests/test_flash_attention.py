@@ -1,0 +1,79 @@
+"""Flash-attention kernel numerics vs the XLA reference.
+
+Runs the Pallas kernels in interpreter mode on CPU (conftest forces the cpu
+backend); the same code paths run compiled on TPU (bench.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops.attention import xla_attention
+from skypilot_tpu.ops.pallas.flash_attention import (_block_sizes,
+                                                     flash_attention)
+
+# Interpreter mode is slow: keep shapes minimal but >= one 128-block.
+B, S, H, KV, D = 1, 256, 2, 1, 128
+
+
+def _qkv(key=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(key), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, S, KV, D), dtype)
+    v = jax.random.normal(ks[2], (B, S, KV, D), dtype)
+    return q, k, v
+
+
+def test_block_sizes():
+    assert _block_sizes(2048) == (512, 512)
+    assert _block_sizes(256) == (256, 256)
+    assert _block_sizes(384) == (384, 384)  # 8-divisible single block
+    assert _block_sizes(768) == (256, 256)
+
+
+def test_forward_matches_reference_causal():
+    q, k, v = _qkv()
+    ref = xla_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_matches_reference_non_causal():
+    q, k, v = _qkv(1)
+    ref = xla_attention(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_gradients_match_reference():
+    q, k, v = _qkv(2)
+
+    def loss(fn, q, k, v):
+        return (fn(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(lambda q, k, v: loss(flash_attention, q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: loss(xla_attention, q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+
+def test_fallback_on_unsupported_shapes():
+    # seq 100: no 128-divisible block -> must fall back to XLA, not crash.
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (1, 100, 2, 64))
+    k = jax.random.normal(ks[1], (1, 100, 1, 64))
+    v = jax.random.normal(ks[2], (1, 100, 1, 64))
+    out = flash_attention(q, k, v, causal=True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_fallback_with_segment_ids():
+    q, k, v = _qkv(3)
+    seg = jnp.concatenate([jnp.zeros((B, S // 2), jnp.int32),
+                           jnp.ones((B, S // 2), jnp.int32)], axis=1)
+    out = flash_attention(q, k, v, causal=True, segment_ids=seg)
+    ref = xla_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
