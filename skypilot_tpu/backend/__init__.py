@@ -1,0 +1,5 @@
+"""Backend layer: cluster lifecycle engine (parity: ``sky/backends/``)."""
+from skypilot_tpu.backend.backend import Backend
+from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+
+__all__ = ['Backend', 'TpuPodBackend']

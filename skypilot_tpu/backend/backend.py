@@ -1,0 +1,43 @@
+"""Backend ABC (parity: ``sky/backends/backend.py:30``)."""
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.optimizer import Candidate
+from skypilot_tpu.provision.api import ClusterInfo
+from skypilot_tpu.spec.task import Task
+
+
+class Backend(abc.ABC):
+    """provision / sync / setup / execute / teardown contract."""
+
+    @abc.abstractmethod
+    def provision(self, task: Task, cluster_name: str, *,
+                  retry_until_up: bool = False,
+                  dryrun: bool = False) -> Optional[ClusterInfo]:
+        ...
+
+    @abc.abstractmethod
+    def sync_workdir(self, info: ClusterInfo, task: Task) -> None:
+        ...
+
+    @abc.abstractmethod
+    def sync_file_mounts(self, info: ClusterInfo, task: Task) -> None:
+        ...
+
+    @abc.abstractmethod
+    def setup(self, info: ClusterInfo, task: Task) -> None:
+        ...
+
+    @abc.abstractmethod
+    def execute(self, info: ClusterInfo, task: Task, *,
+                detach: bool = True) -> int:
+        """Run the task; returns the job id."""
+
+    @abc.abstractmethod
+    def teardown(self, cluster_name: str, *, terminate: bool = True) -> None:
+        ...
+
+    def register_info(self, **kwargs: Dict[str, Any]) -> None:
+        del kwargs

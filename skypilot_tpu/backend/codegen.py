@@ -1,0 +1,118 @@
+"""Task -> per-host launch script compilation.
+
+Parity: ``sky/backends/task_codegen.py`` -- but where RayCodeGen emits a Ray
+driver with placement groups and GPU-shaped env vars
+(``SKYPILOT_NUM_GPUS_PER_NODE``, :626-666), this emits a plain bash script
+per host with the **TPU-native distributed contract**:
+
+* ``SKYT_NODE_RANK`` / ``SKYT_NODE_IPS`` / ``SKYT_NUM_NODES`` -- node-level
+  (slice-level) topology, the analog of the reference's
+  ``SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES`` (skylet/constants.py:521-526).
+* ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` -- worker identity within a
+  slice (what libtpu expects on multi-host slices).
+* ``SKYT_COORDINATOR_ADDRESS`` + ``JAX_COORDINATOR_ADDRESS`` /
+  ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` -- jax.distributed wiring
+  across all hosts of all slices/nodes (replaces NCCL/torchrun env blocks;
+  see SURVEY.md section 2.9 'distributed communication backend').
+* ``MEGASCALE_*`` -- multi-slice (DCN) coordination hints when
+  ``num_slices > 1``.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Dict, List, Optional
+
+from skypilot_tpu.provision.api import ClusterInfo, HostInfo
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+JAX_COORDINATOR_PORT = 8476
+RUNTIME_DIR = '~/.skyt_runtime'
+
+
+def distributed_env(info: ClusterInfo,
+                    host: HostInfo,
+                    resources: Optional[Resources],
+                    num_nodes: int) -> Dict[str, str]:
+    """The full rank-env contract for one host."""
+    node_hosts = info.hosts_of_node(host.node_index)
+    all_hosts = info.hosts
+    node_ips = node_ip_list(info)
+    coordinator_ip = info.head_host.internal_ip
+    process_id = all_hosts.index(host)
+    env = {
+        'SKYT_NODE_RANK': str(host.node_index),
+        'SKYT_NODE_IPS': '\n'.join(node_ips),
+        'SKYT_NUM_NODES': str(num_nodes),
+        'SKYT_CLUSTER_NAME': info.cluster_name,
+        'SKYT_COORDINATOR_ADDRESS':
+            f'{coordinator_ip}:{JAX_COORDINATOR_PORT}',
+        'JAX_COORDINATOR_ADDRESS':
+            f'{coordinator_ip}:{JAX_COORDINATOR_PORT}',
+        'JAX_NUM_PROCESSES': str(len(all_hosts)),
+        'JAX_PROCESS_ID': str(process_id),
+    }
+    tpu = resources.tpu if resources is not None and resources.is_tpu else None
+    if tpu is not None:
+        workers_in_slice = [h for h in node_hosts
+                            if _slice_of(h, tpu) == _slice_of(host, tpu)]
+        env.update({
+            'TPU_WORKER_ID': str(host.worker_index % tpu.hosts_per_slice),
+            'TPU_WORKER_HOSTNAMES': ','.join(
+                h.internal_ip for h in workers_in_slice),
+            'SKYT_TPU_ACCELERATOR': tpu.accelerator_name,
+            'SKYT_TPU_TOPOLOGY': tpu.topology_str,
+        })
+        if tpu.num_slices > 1:
+            slice_id = host.worker_index // tpu.hosts_per_slice
+            env.update({
+                'MEGASCALE_COORDINATOR_ADDRESS': coordinator_ip,
+                'MEGASCALE_NUM_SLICES': str(tpu.num_slices),
+                'MEGASCALE_SLICE_ID': str(slice_id),
+            })
+    return env
+
+
+def _slice_of(host: HostInfo, tpu) -> int:
+    return host.worker_index // tpu.hosts_per_slice
+
+
+def make_job_script(command: str,
+                    env: Dict[str, str],
+                    *,
+                    workdir: Optional[str] = None,
+                    secrets: Optional[Dict[str, str]] = None) -> str:
+    """A self-contained bash script: env exports + cd + user command."""
+    lines = ['#!/usr/bin/env bash', 'set -uo pipefail', '']
+    for key, value in env.items():
+        lines.append(f'export {key}={shlex.quote(str(value))}')
+    for key, value in (secrets or {}).items():
+        lines.append(f'export {key}={shlex.quote(str(value))}')
+    if workdir:
+        if workdir == '~':
+            lines.append('cd "$HOME"')
+        elif workdir.startswith('~/'):
+            lines.append(f'cd "$HOME/{workdir[2:]}"')  # quoted ~ won't expand
+        else:
+            lines.append(f'cd {shlex.quote(workdir)}')
+    lines += ['', command, '']
+    return '\n'.join(lines)
+
+
+def task_env_for_host(task: Task,
+                      info: ClusterInfo,
+                      host: HostInfo,
+                      resources: Optional[Resources]) -> Dict[str, str]:
+    env = dict(task.envs)
+    env.update(distributed_env(info, host, resources, task.num_nodes))
+    return env
+
+
+def node_ip_list(info: ClusterInfo) -> List[str]:
+    """Head IP of each node, rank-ordered (for CommandGen run functions)."""
+    out = []
+    for node in range(info.num_nodes):
+        hosts = info.hosts_of_node(node)
+        if hosts:
+            out.append(hosts[0].internal_ip)
+    return out

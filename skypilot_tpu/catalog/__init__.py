@@ -9,6 +9,7 @@ reason about chips/hosts/ICI rather than opaque accelerator strings.
 from skypilot_tpu.catalog.common import (
     AcceleratorOffering,
     get_hourly_cost,
+    get_offerings,
     get_regions_for_accelerator,
     get_zones_for_region,
     list_accelerators,
@@ -18,6 +19,7 @@ from skypilot_tpu.catalog.common import (
 __all__ = [
     'AcceleratorOffering',
     'get_hourly_cost',
+    'get_offerings',
     'get_regions_for_accelerator',
     'get_zones_for_region',
     'list_accelerators',

@@ -1,0 +1,53 @@
+"""Credential/capability probing (parity: ``sky/check.py:476``)."""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List, Tuple
+
+_cache: Dict[str, Tuple[bool, str]] = {}
+
+
+def _check_gcp() -> Tuple[bool, str]:
+    if os.environ.get('GOOGLE_APPLICATION_CREDENTIALS'):
+        return True, 'service account credentials'
+    try:
+        out = subprocess.run(
+            ['gcloud', 'auth', 'list',
+             '--filter=status:ACTIVE', '--format=value(account)'],
+            capture_output=True, text=True, timeout=10, check=False)
+        if out.returncode == 0 and out.stdout.strip():
+            return True, f'gcloud account {out.stdout.strip().splitlines()[0]}'
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        pass
+    return False, 'no gcloud credentials found'
+
+
+_CHECKS = {
+    'local': lambda: (True, 'always available'),
+    'fake': lambda: (True, 'always available (simulated cloud)'),
+    'gcp': _check_gcp,
+}
+
+
+def check(clouds: List[str] = None, quiet: bool = True) -> Dict[str, Tuple[bool, str]]:
+    """Probe each cloud; returns cloud -> (enabled, reason)."""
+    results = {}
+    for cloud in (clouds or sorted(_CHECKS)):
+        if cloud not in _cache:
+            _cache[cloud] = _CHECKS[cloud]()
+        results[cloud] = _cache[cloud]
+        if not quiet:
+            ok, reason = results[cloud]
+            print(f'  {cloud}: {"enabled" if ok else "disabled"} ({reason})')
+    return results
+
+
+def get_enabled_clouds(refresh: bool = False) -> List[str]:
+    if refresh:
+        _cache.clear()
+    return [c for c, (ok, _) in check().items() if ok]
+
+
+def clear_cache() -> None:
+    _cache.clear()

@@ -1,0 +1,140 @@
+"""Core ops: status/stop/start/down/queue/cancel/logs/autostop.
+
+Parity: ``sky/core.py`` (1945 LoC of impls behind the SDK/CLI).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+from skypilot_tpu.provision.api import ClusterInfo, get_provider
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+def _refresh_cluster_status(record: state.ClusterRecord) -> state.ClusterRecord:
+    """Reconcile DB status with the cloud (parity:
+    backend_utils._update_cluster_status :2528)."""
+    if record.cloud is None:
+        return record
+    provider = get_provider(record.cloud)
+    states = provider.query_instances(record.name)
+    if not states:
+        if record.status != state.ClusterStatus.INIT:
+            state.remove_cluster(record.name)
+            record.status = state.ClusterStatus.INIT
+        return record
+    values = set(states.values())
+    if values == {'running'}:
+        new = state.ClusterStatus.UP
+    elif values <= {'stopped'}:
+        new = state.ClusterStatus.STOPPED
+    else:
+        # partial / preempted / terminating
+        new = state.ClusterStatus.INIT
+    if new != record.status:
+        state.set_cluster_status(record.name, new)
+        state.add_cluster_event(record.name, 'STATUS_REFRESH',
+                                f'{record.status.value} -> {new.value}')
+        record.status = new
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    records = state.get_clusters()
+    if cluster_names:
+        wanted = set(cluster_names)
+        records = [r for r in records if r.name in wanted]
+    if refresh:
+        records = [_refresh_cluster_status(r) for r in records]
+    return [r.to_dict() for r in records]
+
+
+def _get_record(cluster_name: str) -> state.ClusterRecord:
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found.')
+    return record
+
+
+def stop(cluster_name: str) -> None:
+    _get_record(cluster_name)
+    TpuPodBackend().teardown(cluster_name, terminate=False)
+
+
+def down(cluster_name: str) -> None:
+    _get_record(cluster_name)
+    TpuPodBackend().teardown(cluster_name, terminate=True)
+
+
+def start(cluster_name: str) -> None:
+    """Restart a STOPPED cluster (parity: sky/core.py start)."""
+    record = _get_record(cluster_name)
+    if record.status == state.ClusterStatus.UP:
+        return
+    from skypilot_tpu.optimizer import Candidate
+    from skypilot_tpu.provision.provisioner import provision_with_failover
+    from skypilot_tpu.spec.resources import Resources
+    res = Resources.from_yaml_config(record.resources)
+    candidates = [Candidate(resources=res,
+                            hourly_cost=record.hourly_cost)]
+    info, _ = provision_with_failover(cluster_name, candidates,
+                                      record.num_nodes, resume=True)
+    state.add_or_update_cluster(cluster_name,
+                                status=state.ClusterStatus.UP,
+                                handle=info.to_dict())
+
+
+def _cluster_info(cluster_name: str) -> ClusterInfo:
+    record = _get_record(cluster_name)
+    if record.status != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record.status.value}.')
+    return ClusterInfo.from_dict(record.handle)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return TpuPodBackend().queue(_cluster_info(cluster_name))
+
+
+def cancel(cluster_name: str, job_id: int) -> bool:
+    return TpuPodBackend().cancel(_cluster_info(cluster_name), job_id)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = False) -> str:
+    return TpuPodBackend().tail_logs(_cluster_info(cluster_name), job_id,
+                                     follow=follow)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> None:
+    """Set/refresh the autostop policy (enforced by the runtime daemon)."""
+    _get_record(cluster_name)
+    config = ({'idle_minutes': idle_minutes, 'down': down_on_idle}
+              if idle_minutes >= 0 else {})
+    state.add_or_update_cluster(cluster_name,
+                                status=_get_record(cluster_name).status,
+                                autostop=config, touch=False)
+    state.add_cluster_event(cluster_name, 'AUTOSTOP_SET', str(config))
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Rough accumulated cost per live cluster."""
+    import time
+    out = []
+    for record in state.get_clusters():
+        hours = 0.0
+        if record.launched_at and record.status == state.ClusterStatus.UP:
+            hours = (time.time() - record.launched_at) / 3600
+        out.append({
+            'name': record.name,
+            'status': record.status.value,
+            'hourly_cost': record.hourly_cost,
+            'accumulated_cost': round(record.hourly_cost * hours, 2),
+        })
+    return out

@@ -1,0 +1,136 @@
+"""Execution stage machine (parity: ``sky/execution.py``: Stage :48,
+`_execute` :201, `launch` :683, `exec` :918).
+
+OPTIMIZE -> PROVISION -> SYNC_WORKDIR -> SYNC_FILE_MOUNTS -> SETUP -> EXEC
+(-> DOWN on autodown). Library-level entry points; the API server (server/)
+wraps these for the async client path.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+from skypilot_tpu.optimizer import Optimizer
+from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import common_utils, log
+
+logger = log.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'OPTIMIZE'
+    PROVISION = 'PROVISION'
+    SYNC_WORKDIR = 'SYNC_WORKDIR'
+    SYNC_FILE_MOUNTS = 'SYNC_FILE_MOUNTS'
+    SETUP = 'SETUP'
+    EXEC = 'EXEC'
+    DOWN = 'DOWN'
+
+
+ALL_STAGES = list(Stage)
+
+
+def _as_dag(task_or_dag: Union[Task, Dag]) -> Dag:
+    if isinstance(task_or_dag, Dag):
+        return task_or_dag
+    return Dag.from_task(task_or_dag)
+
+
+def launch(task_or_dag: Union[Task, Dag],
+           cluster_name: Optional[str] = None,
+           *,
+           dryrun: bool = False,
+           stream_logs: bool = True,
+           stages: Optional[List[Stage]] = None,
+           down: bool = False,
+           detach_run: bool = False,
+           backend: Optional[TpuPodBackend] = None
+           ) -> List[Tuple[str, Optional[int]]]:
+    """Provision (if needed) + run every task of the DAG.
+
+    Returns [(cluster_name, job_id)] per task. Chain DAG tasks run
+    sequentially, each on its own cluster (parity: _execute_dag,
+    execution.py:340).
+    """
+    dag = _as_dag(task_or_dag)
+    dag.validate()
+    backend = backend or TpuPodBackend()
+    stages = stages or ALL_STAGES
+    results: List[Tuple[str, Optional[int]]] = []
+    for i, task in enumerate(dag.tasks):
+        name = cluster_name if len(dag.tasks) == 1 else (
+            f'{cluster_name}-{i}' if cluster_name else None)
+        if name is None:
+            name = common_utils.generate_cluster_name(
+                task.name or 'skyt')
+        common_utils.validate_cluster_name(name)
+        results.append(
+            _execute_task(task, name, backend, stages,
+                          dryrun=dryrun, stream_logs=stream_logs,
+                          down=down, detach_run=detach_run))
+    return results
+
+
+def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
+                  stages: List[Stage], *, dryrun: bool, stream_logs: bool,
+                  down: bool, detach_run: bool
+                  ) -> Tuple[str, Optional[int]]:
+    if Stage.OPTIMIZE in stages and task.best_resources is None:
+        Optimizer.optimize(Dag.from_task(task))
+    info = None
+    if Stage.PROVISION in stages:
+        info = backend.provision(task, cluster_name, dryrun=dryrun)
+        if dryrun:
+            return cluster_name, None
+    if info is None:
+        record = state.get_cluster(cluster_name)
+        if record is None or record.status != state.ClusterStatus.UP:
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {cluster_name!r} is not UP.')
+        from skypilot_tpu.provision.api import ClusterInfo
+        info = ClusterInfo.from_dict(record.handle)
+    if Stage.SYNC_WORKDIR in stages:
+        backend.sync_workdir(info, task)
+    if Stage.SYNC_FILE_MOUNTS in stages:
+        backend.sync_file_mounts(info, task)
+    if Stage.SETUP in stages:
+        backend.setup(info, task)
+    job_id = None
+    if Stage.EXEC in stages and task.run is not None:
+        state.add_cluster_event(cluster_name, 'JOB_SUBMIT',
+                                task.name or '')
+        job_id = backend.execute(info, task,
+                                 detach=detach_run or not stream_logs)
+    if down and Stage.DOWN in stages:
+        backend.teardown(cluster_name, terminate=True)
+    return cluster_name, job_id
+
+
+def exec_(task_or_dag: Union[Task, Dag],
+          cluster_name: str,
+          *,
+          stream_logs: bool = True,
+          detach_run: bool = False) -> List[Tuple[str, Optional[int]]]:
+    """Run on an existing UP cluster: skip provision/setup (parity:
+    sky/execution.py:918 exec)."""
+    dag = _as_dag(task_or_dag)
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found.')
+    if record.status != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record.status.value}; '
+            'start it first.')
+    backend = TpuPodBackend()
+    results = []
+    for task in dag.tasks:
+        results.append(
+            _execute_task(task, cluster_name, backend,
+                          [Stage.SYNC_WORKDIR, Stage.EXEC],
+                          dryrun=False, stream_logs=stream_logs,
+                          down=False, detach_run=detach_run))
+    return results

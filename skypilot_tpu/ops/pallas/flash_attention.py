@@ -44,10 +44,14 @@ def _supported(q: jax.Array, k: jax.Array, s_q: int, s_k: int) -> bool:
     bq, bk = _block_sizes(s_q)
     if s_q != s_k:
         return False
-    if bq < 128 or bk < 128:
+    # Blocks must be TPU-tileable: 128-multiples cover every dtype's
+    # sublane requirement (8/16/32) and keep the MXU fed.
+    if bq % 128 or bk % 128:
         return False
     if q.shape[-1] % 128:
         return False
+    if q.shape[2] % k.shape[2]:
+        return False  # invalid GQA config; XLA path raises clearly
     return True
 
 
@@ -203,11 +207,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, scale: float,
                     causal: bool):
-    """Grid: (B, H, num_k_blocks); per-q-head dk/dv for one k block.
-
-    Group reduction over q heads happens in the wrapper.
+    """Grid: (B, KV, num_k_blocks, group) -- group (q heads sharing this KV
+    head) is the fastest dimension, so the same dk/dv output block is
+    revisited consecutively and accumulated in place (no [B,H,S,D]
+    intermediates in HBM).
     """
     ki = pl.program_id(2)
+    g = pl.program_id(3)
     block_k = k_ref.shape[0]
     s_q = q_ref.shape[0]
     num_q_blocks = pl.cdiv(s_q, block_q)
@@ -253,8 +259,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lower = 0
     zeros = jnp.zeros((block_k, k_ref.shape[1]), jnp.float32)
     dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (zeros, zeros))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[:] = dk.astype(dk_ref.dtype)
+        dv_ref[:] = dv.astype(dv_ref.dtype)
+
+    @pl.when(g != 0)
+    def _accumulate():
+        dk_ref[:] += dk.astype(dk_ref.dtype)
+        dv_ref[:] += dv.astype(dv_ref.dtype)
 
 
 def _bwd(causal: bool, scale: float, res, do):
@@ -291,41 +305,44 @@ def _bwd(causal: bool, scale: float, res, do):
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
-    dk_per_head, dv_per_head = pl.pallas_call(
+    # Grid: (B, KV, k-blocks, group) -- group fastest so each (b, kv, ki)
+    # output block is revisited consecutively and accumulated in the kernel.
+    dk32, dv32 = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale,
                           causal=causal),
-        grid=(b, h, s // block_k),
+        grid=(b, kv, s // block_k, group),
         in_specs=[
             pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+                         lambda bi, kvh, ki_, g, _g=group:
+                         (bi, kvh * _g + g, 0, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki_, _g=group: (bi, hi // _g, ki_, 0)),
+                         lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki_, _g=group: (bi, hi // _g, ki_, 0)),
+                         lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
             pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+                         lambda bi, kvh, ki_, g, _g=group:
+                         (bi, kvh * _g + g, 0, 0)),
             pl.BlockSpec((None, None, s, 1),
-                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+                         lambda bi, kvh, ki_, g, _g=group:
+                         (bi, kvh * _g + g, 0, 0)),
             pl.BlockSpec((None, None, s, 1),
-                         lambda bi, hi, ki_: (bi, hi, 0, 0)),
+                         lambda bi, kvh, ki_, g, _g=group:
+                         (bi, kvh * _g + g, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki_: (bi, hi, ki_, 0)),
+                         lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki_: (bi, hi, ki_, 0)),
+                         lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kv, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kv, s, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
-    # GQA: reduce per-q-head dk/dv over the group.
-    dk = dk_per_head.reshape(b, kv, group, s, d).sum(axis=2).astype(k.dtype)
-    dv = dv_per_head.reshape(b, kv, group, s, d).sum(axis=2).astype(v.dtype)
-    return dq, dk, dv
+    return dq, dk32.astype(k.dtype), dv32.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,119 @@
+"""Optimizer: choose the cheapest feasible (cloud, region, zone) per task.
+
+Parity: ``sky/optimizer.py:71`` (optimize :109, DP over chain DAGs :429,
+cost estimation :239). The rebuild's DAGs are chains and every candidate is
+a concrete catalog offering, so the DP degenerates to per-task ordered
+candidate lists -- but unlike the reference, TPU offerings carry topology,
+so ranking can include hardware-aware terms (chips, ICI generation) beyond
+price alone.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from skypilot_tpu import catalog, check, exceptions
+from skypilot_tpu.catalog.common import pick_cpu_instance_type
+from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class Candidate:
+    """A launchable, priced resource assignment."""
+    resources: Resources          # cloud/region/zone/instance decided
+    hourly_cost: float
+
+    def __repr__(self) -> str:
+        return f'Candidate({self.resources}, ${self.hourly_cost:.2f}/hr)'
+
+
+def candidates_for(resources: Resources,
+                   enabled_clouds: Optional[Sequence[str]] = None
+                   ) -> List[Candidate]:
+    """All feasible candidates for a resource request, cheapest first."""
+    if enabled_clouds is None:
+        enabled_clouds = check.get_enabled_clouds()
+    clouds = ([resources.cloud] if resources.cloud is not None
+              else list(enabled_clouds))
+    out: List[Candidate] = []
+    for cloud in clouds:
+        if cloud not in enabled_clouds:
+            continue
+        if cloud == 'local':
+            if resources.is_tpu:
+                continue  # no TPU hardware assumption on localhost
+            out.append(Candidate(
+                resources=resources.copy(cloud='local', region='local'),
+                hourly_cost=0.0))
+            continue
+        accels = resources.accelerators
+        if accels is None:
+            # CPU-only: any region works; pick a default region per cloud.
+            cpus = resources.cpus[0] if resources.cpus else None
+            mem = resources.memory[0] if resources.memory else None
+            instance = pick_cpu_instance_type(cpus, mem)
+            cost = catalog.get_hourly_cost(None, cpus=cpus, memory=mem)
+            region = resources.region or 'us-central1'
+            out.append(Candidate(
+                resources=resources.copy(cloud=cloud, region=region,
+                                         instance_type=instance),
+                hourly_cost=cost))
+            continue
+        (name, count), = accels.items()
+        offerings = catalog.get_offerings(
+            name, count,
+            num_slices=resources.num_slices,
+            topology=resources.accelerator_args.get('topology'),
+            region=resources.region,
+            zone=resources.zone)
+        # The catalog is GCP-shaped; 'fake' mirrors it (enable_all_clouds-
+        # style offline testing, ref tests/common_test_fixtures.py:195).
+        for offering in offerings:
+            cost = offering.cost(resources.use_spot)
+            out.append(Candidate(
+                resources=resources.copy(cloud=cloud,
+                                         region=offering.region,
+                                         zone=offering.zone),
+                hourly_cost=cost))
+    out.sort(key=lambda c: (c.hourly_cost, c.resources.region or ''))
+    return out
+
+
+class Optimizer:
+    """Assigns `task.best_resources` for every task in a chain DAG."""
+
+    @staticmethod
+    def optimize(dag: Dag,
+                 enabled_clouds: Optional[Sequence[str]] = None,
+                 quiet: bool = True) -> Dag:
+        dag.validate()
+        for task in dag.tasks:
+            plan = Optimizer.plan_task(task, enabled_clouds)
+            task.best_resources = plan[0].resources
+            if not quiet:
+                logger.info('Task %s: chose %s', task.name or '<unnamed>',
+                            plan[0])
+        return dag
+
+    @staticmethod
+    def plan_task(task: Task,
+                  enabled_clouds: Optional[Sequence[str]] = None
+                  ) -> List[Candidate]:
+        """Ordered candidate list across the task's any_of resources."""
+        all_candidates: List[Candidate] = []
+        for resources in task.resources:
+            all_candidates.extend(candidates_for(resources, enabled_clouds))
+        if not all_candidates:
+            requested = ', '.join(str(r) for r in task.resources)
+            raise exceptions.ResourcesUnavailableError(
+                f'No feasible resources for task '
+                f'{task.name or "<unnamed>"}: requested [{requested}]. '
+                f'Check accelerator name/region against '
+                f'`skyt show-tpus` and enabled clouds.')
+        all_candidates.sort(key=lambda c: c.hourly_cost)
+        return all_candidates

@@ -1,0 +1,19 @@
+"""Provision layer: uniform per-cloud driver interface.
+
+Parity: ``sky/provision/__init__.py:147`` (name-routed dispatch; ops at
+:193-457). Providers register in CLOUD_REGISTRY; the failover provisioner
+(`provisioner.py`) sits above and implements zone->region retry with error
+classification, the TPU flavor of ``RetryingVmProvisioner``
+(cloud_vm_ray_backend.py:789).
+"""
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider,
+                                        get_provider)
+
+# Import for registry side effects.
+from skypilot_tpu.provision import fake as _fake  # noqa: F401
+from skypilot_tpu.provision import local as _local  # noqa: F401
+from skypilot_tpu.provision import gcp as _gcp  # noqa: F401
+
+__all__ = ['ClusterInfo', 'HostInfo', 'ProvisionRequest', 'Provider',
+           'get_provider']

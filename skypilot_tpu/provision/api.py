@@ -1,0 +1,143 @@
+"""Provider interface + data model.
+
+The reference models a TPU pod as ONE node with many IPs
+(``num_ips_per_node``, cloud_vm_ray_backend.py:2613) -- SURVEY.md calls this
+an impedance mismatch to avoid. Here hosts are explicit: a cluster is
+``num_nodes`` *nodes* (for TPU, one node = one pod slice), each node has a
+list of ``HostInfo`` (slice workers). Rank math lives in one place
+(`all_hosts` ordering).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One reachable VM (a TPU slice worker or a plain instance)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str] = None
+    ssh_port: int = 22
+    node_index: int = 0        # which cluster node (slice) this host belongs to
+    worker_index: int = 0      # worker id within the node (TPU_WORKER_ID)
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'HostInfo':
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything the backend needs to reach and drive a cluster."""
+    cluster_name: str
+    provider: str                       # cloud name
+    region: str
+    zone: Optional[str]
+    hosts: List[HostInfo]               # ordered by (node_index, worker_index)
+    ssh_user: str = 'skyt'
+    ssh_key_path: Optional[str] = None
+    custom: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head_host(self) -> HostInfo:
+        return self.hosts[0]
+
+    def hosts_of_node(self, node_index: int) -> List[HostInfo]:
+        return [h for h in self.hosts if h.node_index == node_index]
+
+    @property
+    def num_nodes(self) -> int:
+        return max(h.node_index for h in self.hosts) + 1 if self.hosts else 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'cluster_name': self.cluster_name,
+            'provider': self.provider,
+            'region': self.region,
+            'zone': self.zone,
+            'hosts': [h.to_dict() for h in self.hosts],
+            'ssh_user': self.ssh_user,
+            'ssh_key_path': self.ssh_key_path,
+            'custom': self.custom,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterInfo':
+        d = dict(d)
+        d['hosts'] = [HostInfo.from_dict(h) for h in d['hosts']]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ProvisionRequest:
+    """One provisioning attempt at a concrete (cloud, region, zone)."""
+    cluster_name: str
+    resources: Resources                # launchable: cloud/region decided
+    num_nodes: int
+    region: str
+    zone: Optional[str]
+    # resume: restart existing stopped instances instead of creating
+    resume: bool = False
+    ports: List[str] = dataclasses.field(default_factory=list)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class Provider(abc.ABC):
+    """Per-cloud driver (parity: sky/provision per-cloud modules)."""
+
+    name: str = 'abstract'
+
+    @abc.abstractmethod
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        """Create (or restart) all hosts; atomic per TPU slice.
+
+        Raises CapacityError / QuotaExceededError / ProvisionError.
+        """
+
+    @abc.abstractmethod
+    def stop_instances(self, cluster_name: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def terminate_instances(self, cluster_name: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        """instance_id -> state ('running'|'stopped'|'terminated'|...)."""
+
+    @abc.abstractmethod
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        ...
+
+    def wait_instances(self, cluster_name: str, state: str = 'running',
+                       timeout: float = 600) -> None:
+        """Default: poll query_instances until all hosts reach `state`."""
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            states = self.query_instances(cluster_name)
+            if states and all(s == state for s in states.values()):
+                return
+            time.sleep(min(2, max(0.01, deadline - time.time())))
+        raise TimeoutError(
+            f'{cluster_name}: instances did not reach {state!r} in '
+            f'{timeout}s: {self.query_instances(cluster_name)}')
+
+    def open_ports(self, cluster_name: str, ports: List[str]) -> None:
+        del cluster_name, ports  # default: no-op
+
+
+def get_provider(cloud: str) -> Provider:
+    provider_cls = CLOUD_REGISTRY.get(cloud)
+    return provider_cls()

@@ -1,0 +1,221 @@
+"""Fake GCP-TPU provider: an in-process cloud for tests and dev.
+
+Plays the role of the reference's moto-backed ``mock_aws_backend`` +
+``enable_all_clouds`` fixtures (tests/common_test_fixtures.py:195,494): the
+full provision/failover/recovery machinery runs against it with zero
+credentials. State is a JSON file under the state dir so separate CLI
+processes share the same fake cloud. Fault injection (stockouts, quota,
+preemption, slow creation) is configured through the same file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider)
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+def _store_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'fake_cloud.json')
+
+
+class _Store:
+    """File-backed dict with an exclusive lock."""
+
+    def __init__(self) -> None:
+        self._path = _store_path()
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._lock = filelock.FileLock(self._path + '.lock')
+
+    def __enter__(self) -> Dict[str, Any]:
+        self._lock.acquire()
+        if os.path.exists(self._path):
+            with open(self._path, encoding='utf-8') as f:
+                self._data = json.load(f)
+        else:
+            self._data = {'clusters': {}, 'faults': {}}
+        return self._data
+
+    def __exit__(self, exc_type, *args) -> None:
+        if exc_type is None:
+            tmp = self._path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump(self._data, f)
+            os.replace(tmp, self._path)
+        self._lock.release()
+
+
+# ---------------------------------------------------------------------------
+# Fault injection API (used by tests and the chaos harness)
+# ---------------------------------------------------------------------------
+
+def inject_stockout(zone: str, count: int = -1) -> None:
+    """Next `count` creations in `zone` fail with CapacityError (-1=always)."""
+    with _Store() as data:
+        data['faults'].setdefault('stockout', {})[zone] = count
+
+
+def inject_quota_exceeded(region: str, count: int = -1) -> None:
+    with _Store() as data:
+        data['faults'].setdefault('quota', {})[region] = count
+
+
+def clear_faults() -> None:
+    with _Store() as data:
+        data['faults'] = {}
+
+
+def preempt_cluster(cluster_name: str) -> None:
+    """Simulate spot preemption: all hosts -> terminated."""
+    with _Store() as data:
+        cluster = data['clusters'].get(cluster_name)
+        if cluster:
+            for host in cluster['hosts']:
+                host['state'] = 'preempted'
+            cluster['state'] = 'preempted'
+
+
+def reset() -> None:
+    path = _store_path()
+    if os.path.exists(path):
+        os.remove(path)
+
+
+def _consume_fault(data: Dict[str, Any], kind: str, key: str) -> bool:
+    faults = data.get('faults', {}).get(kind, {})
+    if key not in faults:
+        return False
+    remaining = faults[key]
+    if remaining == 0:
+        return False
+    if remaining > 0:
+        faults[key] = remaining - 1
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Provider
+# ---------------------------------------------------------------------------
+
+@CLOUD_REGISTRY.register('fake')
+class FakeProvider(Provider):
+    """Simulates the GCP TPU queued-resource API (instance_utils.py:1258)."""
+
+    name = 'fake'
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        res = request.resources
+        zone = request.zone or f'{request.region}-a'
+        # Consume faults in their own transaction: raising inside the store
+        # context would roll back the decrement of one-shot faults.
+        with _Store() as data:
+            quota_hit = _consume_fault(data, 'quota', request.region)
+            stockout_hit = (not quota_hit and
+                            _consume_fault(data, 'stockout', zone))
+        if quota_hit:
+            raise exceptions.QuotaExceededError(
+                f'Quota exceeded for {res.accelerators} in region '
+                f'{request.region} (fake)')
+        if stockout_hit:
+            raise exceptions.CapacityError(
+                f'The zone {zone} does not have enough resources '
+                f'available to fulfill the request (fake stockout)')
+        with _Store() as data:
+            existing = data['clusters'].get(request.cluster_name)
+            if existing and existing['state'] == 'stopped' and request.resume:
+                for host in existing['hosts']:
+                    host['state'] = 'running'
+                existing['state'] = 'running'
+                return self._to_cluster_info(request.cluster_name, existing)
+
+            if res.is_tpu:
+                hosts_per_node = res.tpu.hosts_per_slice * res.tpu.num_slices
+            else:
+                hosts_per_node = 1
+            hosts = []
+            for node in range(request.num_nodes):
+                for worker in range(hosts_per_node):
+                    idx = node * hosts_per_node + worker
+                    hosts.append({
+                        'instance_id': f'fake-{uuid.uuid4().hex[:8]}',
+                        'internal_ip': f'10.0.{node}.{worker + 2}',
+                        'external_ip': f'34.0.{node}.{worker + 2}',
+                        'node_index': node,
+                        'worker_index': worker,
+                        'state': 'running',
+                        'index': idx,
+                    })
+            data['clusters'][request.cluster_name] = {
+                'state': 'running',
+                'region': request.region,
+                'zone': zone,
+                'resources': res.to_yaml_config(),
+                'hosts': hosts,
+                'created_at': time.time(),
+                'spot': res.use_spot,
+            }
+            return self._to_cluster_info(request.cluster_name,
+                                         data['clusters'][request.cluster_name])
+
+    def _to_cluster_info(self, name: str,
+                         record: Dict[str, Any]) -> ClusterInfo:
+        hosts = [
+            HostInfo(
+                instance_id=h['instance_id'],
+                internal_ip=h['internal_ip'],
+                external_ip=h.get('external_ip'),
+                node_index=h['node_index'],
+                worker_index=h['worker_index'],
+            ) for h in record['hosts'] if h['state'] == 'running'
+        ]
+        return ClusterInfo(cluster_name=name, provider='fake',
+                           region=record['region'], zone=record['zone'],
+                           hosts=hosts, ssh_user='skyt',
+                           custom={'fake': True})
+
+    def stop_instances(self, cluster_name: str) -> None:
+        with _Store() as data:
+            cluster = data['clusters'].get(cluster_name)
+            if cluster is None:
+                return
+            for host in cluster['hosts']:
+                if host['state'] == 'running':
+                    host['state'] = 'stopped'
+            cluster['state'] = 'stopped'
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        with _Store() as data:
+            data['clusters'].pop(cluster_name, None)
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        with _Store() as data:
+            cluster = data['clusters'].get(cluster_name)
+            if cluster is None:
+                return {}
+            return {h['instance_id']: h['state'] for h in cluster['hosts']}
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        with _Store() as data:
+            cluster = data['clusters'].get(cluster_name)
+            if cluster is None or cluster['state'] != 'running':
+                return None
+            return self._to_cluster_info(cluster_name, cluster)
+
+    # Fake clusters execute commands locally (no SSH); the command runner
+    # checks this flag.
+    run_commands_locally = True
+
+
+def list_fake_clusters() -> List[str]:
+    with _Store() as data:
+        return sorted(data['clusters'])

@@ -1,0 +1,263 @@
+"""GCP TPU-VM provider: queued-resource gang provisioning over the TPU REST
+API.
+
+Parity targets: ``sky/provision/gcp/instance_utils.py:1258 GCPTPUVMInstance``
+(TPU-VM create/stop/terminate), :1491 (queued-resource create+wait),
+``sky/clouds/gcp.py:600`` (queued resources opt-in -- here they are the
+*default* multi-host path, closing the SURVEY.md section 2.10 gap).
+
+Network calls go through `_request` so tests can stub the transport; the
+image is zero-egress, so live use requires a GCP environment (credentials
+via metadata server or GOOGLE_APPLICATION_CREDENTIALS).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = log.init_logger(__name__)
+
+TPU_API = 'https://tpu.googleapis.com/v2'
+
+# Error substrings -> typed exceptions (parity: FailoverCloudErrorHandlerV2
+# _gcp_handler, cloud_vm_ray_backend.py:554).
+_CAPACITY_MARKERS = (
+    'does not have enough resources available',
+    'no more capacity in the zone',
+    'resource_exhausted',
+    'stockout',
+)
+_QUOTA_MARKERS = (
+    'quota exceeded',
+    'quota limit',
+    'exceeds quota',
+)
+
+
+def classify_gcp_error(message: str) -> exceptions.ProvisionError:
+    low = message.lower()
+    if any(m in low for m in _QUOTA_MARKERS):
+        return exceptions.QuotaExceededError(message)
+    if any(m in low for m in _CAPACITY_MARKERS):
+        return exceptions.CapacityError(message)
+    return exceptions.ProvisionError(message)
+
+
+def _default_project() -> Optional[str]:
+    proj = os.environ.get('GOOGLE_CLOUD_PROJECT')
+    if proj:
+        return proj
+    try:
+        out = subprocess.run(
+            ['gcloud', 'config', 'get-value', 'project'],
+            capture_output=True, text=True, timeout=10, check=False)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        pass
+    return None
+
+
+def _access_token() -> str:
+    out = subprocess.run(
+        ['gcloud', 'auth', 'print-access-token'],
+        capture_output=True, text=True, timeout=30, check=False)
+    if out.returncode != 0:
+        raise exceptions.NoCloudAccessError(
+            f'gcloud auth failed: {out.stderr.strip()[:200]}')
+    return out.stdout.strip()
+
+
+@CLOUD_REGISTRY.register('gcp')
+class GcpTpuProvider(Provider):
+    """TPU-VM slices via queued resources; one node == one slice."""
+
+    name = 'gcp'
+
+    def __init__(self, project: Optional[str] = None) -> None:
+        self._project = project or _default_project()
+
+    # -- transport (stubbed in tests) ------------------------------------
+
+    def _request(self, method: str, url: str,
+                 body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header('Authorization', f'Bearer {_access_token()}')
+        req.add_header('Content-Type', 'application/json')
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read().decode() or '{}')
+        except Exception as e:  # noqa: BLE001 -- classified below
+            raise classify_gcp_error(str(e)) from e
+
+    def _parent(self, zone: str) -> str:
+        return f'projects/{self._project}/locations/{zone}'
+
+    # -- provider interface ----------------------------------------------
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        if self._project is None:
+            raise exceptions.NoCloudAccessError(
+                'No GCP project configured (GOOGLE_CLOUD_PROJECT or '
+                'gcloud config).')
+        res = request.resources
+        if not res.is_tpu:
+            raise exceptions.NotSupportedError(
+                'The GCP provider currently targets TPU-VM slices; use '
+                'accelerators: tpu-... (GPU/CPU instances: future work).')
+        zone = request.zone or f'{request.region}-a'
+        tpu = res.tpu
+        for node in range(request.num_nodes):
+            for slice_idx in range(tpu.num_slices):
+                self._create_queued_resource(request, zone, node, slice_idx)
+        self._wait_queued_resources(request, zone, timeout=1800)
+        info = self.get_cluster_info(request.cluster_name)
+        if info is None:
+            raise exceptions.ProvisionError(
+                f'{request.cluster_name}: queued resources active but no '
+                'nodes found')
+        return info
+
+    def _qr_name(self, cluster_name: str, node: int, slice_idx: int) -> str:
+        return f'{cluster_name}-n{node}-s{slice_idx}'
+
+    def _create_queued_resource(self, request: ProvisionRequest, zone: str,
+                                node: int, slice_idx: int) -> None:
+        res = request.resources
+        tpu = res.tpu
+        qr_id = self._qr_name(request.cluster_name, node, slice_idx)
+        node_spec = {
+            'acceleratorType': tpu.accelerator_type,
+            'runtimeVersion': res.tpu_runtime_version,
+            'networkConfig': {'enableExternalIps': True},
+            'metadata': {
+                'skyt-cluster': request.cluster_name,
+                'skyt-node': str(node),
+                'skyt-slice': str(slice_idx),
+            },
+            'labels': {**request.labels, 'skyt-cluster': request.cluster_name},
+        }
+        body: Dict[str, Any] = {
+            'tpu': {'nodeSpec': [{
+                'parent': self._parent(zone),
+                'nodeId': qr_id,
+                'node': node_spec,
+            }]},
+        }
+        if res.use_spot:
+            body['spot'] = {}
+        self._request(
+            'POST',
+            f'{TPU_API}/{self._parent(zone)}/queuedResources'
+            f'?queuedResourceId={qr_id}', body)
+        logger.info('Queued resource %s requested in %s', qr_id, zone)
+
+    def _wait_queued_resources(self, request: ProvisionRequest, zone: str,
+                               timeout: float) -> None:
+        """Poll until every slice is ACTIVE (parity: queued-resource wait,
+        instance_utils.py:1491)."""
+        deadline = time.time() + timeout
+        tpu = request.resources.tpu
+        names = [
+            self._qr_name(request.cluster_name, n, s)
+            for n in range(request.num_nodes)
+            for s in range(tpu.num_slices)
+        ]
+        while time.time() < deadline:
+            states = {}
+            for name in names:
+                resp = self._request(
+                    'GET',
+                    f'{TPU_API}/{self._parent(zone)}/queuedResources/{name}')
+                states[name] = resp.get('state', {}).get('state', 'UNKNOWN')
+            if all(s == 'ACTIVE' for s in states.values()):
+                return
+            failed = {n: s for n, s in states.items()
+                      if s in ('FAILED', 'SUSPENDED')}
+            if failed:
+                raise classify_gcp_error(
+                    f'Queued resources failed: {failed}')
+            time.sleep(10)
+        raise exceptions.CapacityError(
+            f'{request.cluster_name}: queued resources not ACTIVE within '
+            f'{timeout}s (treating as capacity shortage for failover)')
+
+    def _list_cluster_nodes(self, cluster_name: str,
+                            zone: str) -> List[Dict[str, Any]]:
+        resp = self._request('GET', f'{TPU_API}/{self._parent(zone)}/nodes')
+        nodes = resp.get('nodes', [])
+        return [n for n in nodes
+                if n.get('labels', {}).get('skyt-cluster') == cluster_name]
+
+    def _zone_of(self, cluster_name: str) -> Optional[str]:
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster(cluster_name)
+        return record.zone if record else None
+
+    def stop_instances(self, cluster_name: str) -> None:
+        zone = self._zone_of(cluster_name)
+        for node in self._list_cluster_nodes(cluster_name, zone):
+            self._request('POST', f'{TPU_API}/{node["name"]}:stop', {})
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        zone = self._zone_of(cluster_name)
+        if zone is None:
+            return
+        resp = self._request(
+            'GET', f'{TPU_API}/{self._parent(zone)}/queuedResources')
+        for qr in resp.get('queuedResources', []):
+            if qr['name'].split('/')[-1].startswith(cluster_name + '-n'):
+                self._request('DELETE', f'{TPU_API}/{qr["name"]}?force=true')
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        zone = self._zone_of(cluster_name)
+        if zone is None:
+            return {}
+        out = {}
+        state_map = {'READY': 'running', 'STOPPED': 'stopped',
+                     'PREEMPTED': 'preempted', 'TERMINATED': 'terminated'}
+        for node in self._list_cluster_nodes(cluster_name, zone):
+            out[node['name'].split('/')[-1]] = state_map.get(
+                node.get('state', ''), node.get('state', 'unknown').lower())
+        return out
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        zone = self._zone_of(cluster_name)
+        if zone is None:
+            return None
+        nodes = self._list_cluster_nodes(cluster_name, zone)
+        if not nodes:
+            return None
+        hosts: List[HostInfo] = []
+        for tpu_node in nodes:
+            meta = tpu_node.get('metadata', {})
+            node_index = int(meta.get('skyt-node', 0))
+            endpoints = tpu_node.get('networkEndpoints', [])
+            for worker_index, ep in enumerate(endpoints):
+                hosts.append(
+                    HostInfo(
+                        instance_id=(f'{tpu_node["name"].split("/")[-1]}'
+                                     f'-w{worker_index}'),
+                        internal_ip=ep.get('ipAddress', ''),
+                        external_ip=ep.get('accessConfig', {}).get(
+                            'externalIp'),
+                        node_index=node_index,
+                        worker_index=worker_index,
+                    ))
+        hosts.sort(key=lambda h: (h.node_index, h.worker_index))
+        region = zone.rsplit('-', 1)[0]
+        return ClusterInfo(
+            cluster_name=cluster_name, provider='gcp', region=region,
+            zone=zone, hosts=hosts, ssh_user='skyt',
+            ssh_key_path=os.path.expanduser('~/.ssh/skyt-key'))

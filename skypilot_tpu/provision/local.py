@@ -1,0 +1,107 @@
+"""Local provider: 'provisions' this machine.
+
+The end-to-end execution path (sync -> setup -> rank launch -> logs ->
+queue) runs for real against localhost processes -- no cloud, no SSH. This
+is the rebuild's always-available provider for dev and integration tests
+(the reference gets the same effect from kind/existing clusters).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider)
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+def _store_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'local_clusters.json')
+
+
+def _load() -> Dict:
+    path = _store_path()
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            return json.load(f)
+    return {}
+
+
+def _save(data: Dict) -> None:
+    path = _store_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+
+
+@CLOUD_REGISTRY.register('local')
+class LocalProvider(Provider):
+    """One 'host' per node, all localhost; commands run as subprocesses."""
+
+    name = 'local'
+    run_commands_locally = True
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        data = _load()
+        hosts = []
+        for node in range(request.num_nodes):
+            hosts.append({
+                'instance_id': f'local-{request.cluster_name}-{node}',
+                'internal_ip': '127.0.0.1',
+                'external_ip': '127.0.0.1',
+                'node_index': node,
+                'worker_index': 0,
+                'state': 'running',
+            })
+        data[request.cluster_name] = {
+            'state': 'running',
+            'hosts': hosts,
+            'created_at': time.time(),
+            'resources': request.resources.to_yaml_config(),
+        }
+        _save(data)
+        return self.get_cluster_info(request.cluster_name)
+
+    def stop_instances(self, cluster_name: str) -> None:
+        data = _load()
+        if cluster_name in data:
+            data[cluster_name]['state'] = 'stopped'
+            for h in data[cluster_name]['hosts']:
+                h['state'] = 'stopped'
+            _save(data)
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        data = _load()
+        data.pop(cluster_name, None)
+        _save(data)
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        data = _load()
+        if cluster_name not in data:
+            return {}
+        return {h['instance_id']: h['state']
+                for h in data[cluster_name]['hosts']}
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        data = _load()
+        record = data.get(cluster_name)
+        if record is None or record['state'] != 'running':
+            return None
+        hosts = [
+            HostInfo(instance_id=h['instance_id'],
+                     internal_ip=h['internal_ip'],
+                     external_ip=h['external_ip'],
+                     node_index=h['node_index'],
+                     worker_index=h['worker_index'])
+            for h in record['hosts']
+        ]
+        return ClusterInfo(cluster_name=cluster_name, provider='local',
+                           region='local', zone=None, hosts=hosts,
+                           ssh_user=os.environ.get('USER', 'root'),
+                           custom={'local': True})

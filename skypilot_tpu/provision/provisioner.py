@@ -1,0 +1,122 @@
+"""Failover provisioner: ordered candidates -> zone/region retry loop.
+
+Parity: ``RetryingVmProvisioner`` (cloud_vm_ray_backend.py:789;
+`_yield_zones` :842, `_retry_zones` :1003 -- the HOT RETRY LOOP in
+SURVEY.md section 3.1) + the error classify-and-blocklist handlers
+(:395/:522). TPU flavor: the unit of atomicity is a whole pod slice, and
+queued-resource timeouts count as capacity errors.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Set, Tuple
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.optimizer import Candidate
+from skypilot_tpu.provision.api import (ClusterInfo, ProvisionRequest,
+                                        get_provider)
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class Blocklist:
+    """Locations proven infeasible during this provisioning round."""
+    zones: Set[Tuple[str, str]] = dataclasses.field(default_factory=set)
+    regions: Set[Tuple[str, str]] = dataclasses.field(default_factory=set)
+    clouds: Set[str] = dataclasses.field(default_factory=set)
+
+    def blocks(self, candidate: Candidate) -> bool:
+        res = candidate.resources
+        if res.cloud in self.clouds:
+            return True
+        if (res.cloud, res.region) in self.regions:
+            return True
+        if res.zone is not None and (res.cloud, res.zone) in self.zones:
+            return True
+        return False
+
+    def add_for(self, candidate: Candidate,
+                error: exceptions.ProvisionError) -> None:
+        res = candidate.resources
+        if isinstance(error, exceptions.QuotaExceededError):
+            self.regions.add((res.cloud, res.region))
+        elif isinstance(error, exceptions.CapacityError):
+            if res.zone is not None:
+                self.zones.add((res.cloud, res.zone))
+            else:
+                self.regions.add((res.cloud, res.region))
+        elif isinstance(error, exceptions.NoCloudAccessError):
+            self.clouds.add(res.cloud)
+        else:
+            # Unclassified: be conservative, skip the zone only.
+            if res.zone is not None:
+                self.zones.add((res.cloud, res.zone))
+            else:
+                self.regions.add((res.cloud, res.region))
+
+
+def provision_with_failover(
+        cluster_name: str,
+        candidates: List[Candidate],
+        num_nodes: int,
+        *,
+        resume: bool = False,
+        blocklist: Optional[Blocklist] = None,
+) -> Tuple[ClusterInfo, Candidate]:
+    """Try candidates in (cost) order until one provisions.
+
+    Returns (cluster info, the candidate that succeeded). Raises
+    ResourcesUnavailableError with per-location history when all fail.
+    """
+    blocklist = blocklist or Blocklist()
+    history: List[Exception] = []
+    attempted = 0
+    for candidate in candidates:
+        if blocklist.blocks(candidate):
+            continue
+        res = candidate.resources
+        res.assert_launchable()
+        provider = get_provider(res.cloud)
+        request = ProvisionRequest(
+            cluster_name=cluster_name,
+            resources=res,
+            num_nodes=num_nodes,
+            region=res.region,
+            zone=res.zone,
+            resume=resume,
+            ports=res.ports,
+            labels=res.labels,
+        )
+        attempted += 1
+        where = f'{res.cloud}/{res.region}' + (f'/{res.zone}' if res.zone
+                                               else '')
+        logger.info('Provisioning %s on %s (%s)...', cluster_name, where,
+                    res)
+        state.add_cluster_event(cluster_name, 'PROVISION_ATTEMPT', where)
+        try:
+            info = provider.run_instances(request)
+            provider.wait_instances(cluster_name, 'running')
+            state.add_cluster_event(cluster_name, 'PROVISION_OK', where)
+            return info, candidate
+        except exceptions.ProvisionError as e:
+            logger.warning('Provision failed on %s: %s', where, e)
+            state.add_cluster_event(cluster_name, 'PROVISION_FAIL',
+                                    f'{where}: {e}')
+            history.append(e)
+            blocklist.add_for(candidate, e)
+            # Best-effort cleanup of partial creations.
+            try:
+                provider.terminate_instances(cluster_name)
+            except Exception:  # pylint: disable=broad-except
+                pass
+        except exceptions.NoCloudAccessError as e:
+            history.append(e)
+            blocklist.clouds.add(res.cloud)
+    tried = f'{attempted} locations tried' if attempted else (
+        'all candidate locations blocklisted')
+    raise exceptions.ResourcesUnavailableError(
+        f'Failed to provision {cluster_name!r}: {tried}. '
+        f'History: {[str(e) for e in history]}',
+        failover_history=history)

@@ -1,0 +1,6 @@
+"""On-cluster runtime (the skylet equivalent -- parity: ``sky/skylet/``).
+
+Lives on the head node of every cluster: cluster-local job queue
+(`job_lib`), the runtime daemon with scheduling/autostop events
+(`daemon`), and log capture/tailing (`log_lib`).
+"""

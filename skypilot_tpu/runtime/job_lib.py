@@ -1,0 +1,160 @@
+"""Cluster-local job table (parity: ``sky/skylet/job_lib.py``:
+JobStatus :156, JobScheduler :278 -- sqlite-backed).
+
+All functions take the runtime dir explicitly so the same code runs (a) in
+the backend process for local-style clusters, (b) under the on-node daemon,
+and (c) via the `job_cli` shim over SSH.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_RUNTIME_DIR = '~/.skyt_runtime'
+
+
+class JobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.CANCELLED)
+
+
+TERMINAL_STATUSES = [s for s in JobStatus if s.is_terminal()]
+
+
+def _db(runtime_dir: str) -> sqlite3.Connection:
+    runtime_dir = os.path.expanduser(runtime_dir)
+    os.makedirs(runtime_dir, exist_ok=True)
+    conn = sqlite3.connect(os.path.join(runtime_dir, 'jobs.db'), timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            status TEXT NOT NULL,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            num_hosts INTEGER DEFAULT 1,
+            exit_code INTEGER,
+            metadata TEXT,
+            pids TEXT
+        )""")
+    conn.commit()
+    return conn
+
+
+def add_job(runtime_dir: str, name: Optional[str],
+            num_hosts: int = 1,
+            metadata: Optional[Dict[str, Any]] = None) -> int:
+    conn = _db(runtime_dir)
+    cur = conn.execute(
+        'INSERT INTO jobs (name, status, submitted_at, num_hosts, metadata) '
+        'VALUES (?,?,?,?,?)',
+        (name, JobStatus.PENDING.value, time.time(), num_hosts,
+         json.dumps(metadata or {})))
+    conn.commit()
+    job_id = cur.lastrowid
+    conn.close()
+    return job_id
+
+
+def set_status(runtime_dir: str, job_id: int, status: JobStatus,
+               exit_code: Optional[int] = None) -> None:
+    conn = _db(runtime_dir)
+    updates = {'status': status.value}
+    if status == JobStatus.RUNNING:
+        updates['started_at'] = time.time()
+    if status.is_terminal():
+        updates['ended_at'] = time.time()
+    if exit_code is not None:
+        updates['exit_code'] = exit_code
+    sets = ', '.join(f'{k}=?' for k in updates)
+    conn.execute(f'UPDATE jobs SET {sets} WHERE job_id=?',
+                 (*updates.values(), job_id))
+    conn.commit()
+    conn.close()
+
+
+def set_pids(runtime_dir: str, job_id: int, pids: List[int]) -> None:
+    conn = _db(runtime_dir)
+    conn.execute('UPDATE jobs SET pids=? WHERE job_id=?',
+                 (json.dumps(pids), job_id))
+    conn.commit()
+    conn.close()
+
+
+def get_job(runtime_dir: str, job_id: int) -> Optional[Dict[str, Any]]:
+    conn = _db(runtime_dir)
+    row = conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                       (job_id,)).fetchone()
+    conn.close()
+    return _row_to_dict(row) if row else None
+
+
+def list_jobs(runtime_dir: str,
+              statuses: Optional[List[JobStatus]] = None
+              ) -> List[Dict[str, Any]]:
+    conn = _db(runtime_dir)
+    rows = conn.execute(
+        'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+    conn.close()
+    jobs = [_row_to_dict(r) for r in rows]
+    if statuses is not None:
+        wanted = {s.value for s in statuses}
+        jobs = [j for j in jobs if j['status'] in wanted]
+    return jobs
+
+
+def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['metadata'] = json.loads(d.get('metadata') or '{}')
+    d['pids'] = json.loads(d['pids']) if d.get('pids') else []
+    return d
+
+
+def last_activity_time(runtime_dir: str) -> float:
+    """Latest job submit/end time -- the autostop idleness clock
+    (parity: autostop_lib idleness tracking)."""
+    conn = _db(runtime_dir)
+    row = conn.execute(
+        'SELECT MAX(COALESCE(ended_at, submitted_at, 0)) AS t, '
+        'SUM(CASE WHEN status IN (?,?,?) THEN 1 ELSE 0 END) AS active '
+        'FROM jobs',
+        (JobStatus.PENDING.value, JobStatus.SETTING_UP.value,
+         JobStatus.RUNNING.value)).fetchone()
+    conn.close()
+    if row is None or row['t'] is None:
+        return 0.0
+    if row['active']:
+        return time.time()  # active job: never idle
+    return float(row['t'])
+
+
+def job_log_dir(runtime_dir: str, job_id: int) -> str:
+    return os.path.join(os.path.expanduser(runtime_dir), 'jobs',
+                        str(job_id))
+
+
+def cancel_job(runtime_dir: str, job_id: int) -> bool:
+    """Mark cancelled + SIGTERM recorded pids (gang kill: a TPU program
+    hangs rather than crashes on lost peers)."""
+    from skypilot_tpu.utils.subprocess_utils import kill_process_tree
+    job = get_job(runtime_dir, job_id)
+    if job is None or JobStatus(job['status']).is_terminal():
+        return False
+    for pid in job['pids']:
+        kill_process_tree(pid)
+    set_status(runtime_dir, job_id, JobStatus.CANCELLED)
+    return True

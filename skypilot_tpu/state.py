@@ -1,0 +1,213 @@
+"""Global user state: cluster records + events in sqlite.
+
+Parity: ``sky/global_user_state.py`` (SQLAlchemy over sqlite/postgres,
+tables at :68-103). Plain sqlite3 here -- no ORM dependency in the image --
+with JSON columns for structured fields.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+
+
+class ClusterStatus(enum.Enum):
+    """Cluster lifecycle (design parity: sky/design_docs/cluster_status.md)."""
+    INIT = 'INIT'          # provisioning or unhealthy
+    UP = 'UP'              # all hosts running, runtime healthy
+    STOPPED = 'STOPPED'    # instances stopped, disks kept
+
+
+def _state_dir() -> str:
+    return os.environ.get('SKYT_STATE_DIR',
+                          os.path.expanduser('~/.skyt'))
+
+
+_local = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    """Per-thread connection; schema created on first use."""
+    path = os.path.join(_state_dir(), 'state.db')
+    conn = getattr(_local, 'conn', None)
+    if conn is not None and getattr(_local, 'path', None) == path:
+        return conn
+    os.makedirs(_state_dir(), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            status TEXT NOT NULL,
+            cloud TEXT,
+            region TEXT,
+            zone TEXT,
+            resources TEXT,            -- Resources.to_yaml_config() JSON
+            handle TEXT,               -- serialized ClusterInfo JSON
+            num_nodes INTEGER DEFAULT 1,
+            autostop TEXT,
+            launched_at REAL,
+            last_use REAL,
+            owner TEXT,
+            hourly_cost REAL DEFAULT 0
+        );
+        CREATE TABLE IF NOT EXISTS cluster_events (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            cluster_name TEXT NOT NULL,
+            ts REAL NOT NULL,
+            event TEXT NOT NULL,
+            detail TEXT
+        );
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            store_type TEXT,
+            source TEXT,
+            status TEXT,
+            created_at REAL
+        );
+    """)
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    return conn
+
+
+class ClusterRecord:
+    """A row of the clusters table, attribute-accessible."""
+
+    def __init__(self, row: sqlite3.Row) -> None:
+        self.name: str = row['name']
+        self.status = ClusterStatus(row['status'])
+        self.cloud: Optional[str] = row['cloud']
+        self.region: Optional[str] = row['region']
+        self.zone: Optional[str] = row['zone']
+        self.resources: Dict[str, Any] = json.loads(row['resources'] or '{}')
+        self.handle: Dict[str, Any] = json.loads(row['handle'] or '{}')
+        self.num_nodes: int = row['num_nodes']
+        self.autostop: Dict[str, Any] = json.loads(row['autostop'] or '{}')
+        self.launched_at: Optional[float] = row['launched_at']
+        self.last_use: Optional[float] = row['last_use']
+        self.owner: Optional[str] = row['owner']
+        self.hourly_cost: float = row['hourly_cost'] or 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'name': self.name,
+            'status': self.status.value,
+            'cloud': self.cloud,
+            'region': self.region,
+            'zone': self.zone,
+            'resources': self.resources,
+            'num_nodes': self.num_nodes,
+            'autostop': self.autostop,
+            'launched_at': self.launched_at,
+            'last_use': self.last_use,
+            'owner': self.owner,
+            'hourly_cost': self.hourly_cost,
+        }
+
+
+def add_or_update_cluster(name: str,
+                          *,
+                          status: ClusterStatus,
+                          cloud: Optional[str] = None,
+                          region: Optional[str] = None,
+                          zone: Optional[str] = None,
+                          resources: Optional[Dict[str, Any]] = None,
+                          handle: Optional[Dict[str, Any]] = None,
+                          num_nodes: Optional[int] = None,
+                          autostop: Optional[Dict[str, Any]] = None,
+                          hourly_cost: Optional[float] = None,
+                          touch: bool = True) -> None:
+    db = _db()
+    existing = db.execute('SELECT * FROM clusters WHERE name=?',
+                          (name,)).fetchone()
+    now = time.time()
+    if existing is None:
+        db.execute(
+            'INSERT INTO clusters (name, status, cloud, region, zone, '
+            'resources, handle, num_nodes, autostop, launched_at, last_use, '
+            'owner, hourly_cost) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)',
+            (name, status.value, cloud, region, zone,
+             json.dumps(resources or {}), json.dumps(handle or {}),
+             num_nodes or 1, json.dumps(autostop or {}), now, now,
+             common_utils.get_user(), hourly_cost or 0.0))
+    else:
+        updates: Dict[str, Any] = {'status': status.value}
+        if cloud is not None:
+            updates['cloud'] = cloud
+        if region is not None:
+            updates['region'] = region
+        if zone is not None:
+            updates['zone'] = zone
+        if resources is not None:
+            updates['resources'] = json.dumps(resources)
+        if handle is not None:
+            updates['handle'] = json.dumps(handle)
+        if num_nodes is not None:
+            updates['num_nodes'] = num_nodes
+        if autostop is not None:
+            updates['autostop'] = json.dumps(autostop)
+        if hourly_cost is not None:
+            updates['hourly_cost'] = hourly_cost
+        if touch:
+            updates['last_use'] = now
+        sets = ', '.join(f'{k}=?' for k in updates)
+        db.execute(f'UPDATE clusters SET {sets} WHERE name=?',
+                   (*updates.values(), name))
+    db.commit()
+
+
+def get_cluster(name: str) -> Optional[ClusterRecord]:
+    row = _db().execute('SELECT * FROM clusters WHERE name=?',
+                        (name,)).fetchone()
+    return ClusterRecord(row) if row else None
+
+
+def get_clusters() -> List[ClusterRecord]:
+    rows = _db().execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [ClusterRecord(r) for r in rows]
+
+
+def remove_cluster(name: str) -> None:
+    db = _db()
+    db.execute('DELETE FROM clusters WHERE name=?', (name,))
+    db.commit()
+
+
+def set_cluster_status(name: str, status: ClusterStatus) -> None:
+    db = _db()
+    db.execute('UPDATE clusters SET status=? WHERE name=?',
+               (status.value, name))
+    db.commit()
+
+
+def touch_cluster(name: str) -> None:
+    db = _db()
+    db.execute('UPDATE clusters SET last_use=? WHERE name=?',
+               (time.time(), name))
+    db.commit()
+
+
+def add_cluster_event(name: str, event: str, detail: str = '') -> None:
+    """Parity: global_user_state.add_cluster_event (execution.py:582)."""
+    db = _db()
+    db.execute(
+        'INSERT INTO cluster_events (cluster_name, ts, event, detail) '
+        'VALUES (?,?,?,?)', (name, time.time(), event, detail))
+    db.commit()
+
+
+def get_cluster_events(name: str) -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT ts, event, detail FROM cluster_events WHERE cluster_name=? '
+        'ORDER BY ts', (name,)).fetchall()
+    return [dict(r) for r in rows]

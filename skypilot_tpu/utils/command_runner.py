@@ -1,0 +1,221 @@
+"""Command runners: uniform exec/rsync over SSH or local subprocess.
+
+Parity: ``sky/utils/command_runner.py`` (SSHCommandRunner :875,
+LocalProcessCommandRunner :1834). The local runner gives every fake/local
+"host" its own root directory, so multi-host TPU semantics (per-worker
+workdirs, rank envs, gang start) are exercised for real on one machine.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from typing import Dict, IO, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import ClusterInfo, HostInfo
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+def _pycopy(src: str, dst: str, excludes=None) -> None:
+    """Mirror src into dst without the rsync binary (dev images lack it)."""
+    import shutil
+    if not os.path.exists(src):
+        raise exceptions.CommandError(1, f'copy {src}',
+                                      error_msg=f'{src} does not exist')
+    os.makedirs(os.path.dirname(dst.rstrip('/')) or '/', exist_ok=True)
+    if os.path.isdir(src):
+        ignore = (shutil.ignore_patterns(*excludes) if excludes else None)
+        shutil.copytree(src, dst, dirs_exist_ok=True, ignore=ignore)
+    else:
+        shutil.copy2(src, dst)
+
+
+_SSH_OPTIONS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'LogLevel=ERROR',
+]
+
+
+class CommandRunner:
+    """Base: run a shell command on a host and rsync files to it."""
+
+    def __init__(self, host: HostInfo) -> None:
+        self.host = host
+
+    def run(self,
+            cmd: str,
+            *,
+            env: Optional[Dict[str, str]] = None,
+            cwd: Optional[str] = None,
+            stream_to: Optional[IO[str]] = None,
+            log_path: Optional[str] = None,
+            timeout: Optional[float] = None,
+            check: bool = False) -> Tuple[int, str]:
+        raise NotImplementedError
+
+    def rsync(self, src: str, dst: str, *, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
+        raise NotImplementedError
+
+    def _check(self, returncode: int, cmd: str, output: str,
+               check: bool) -> None:
+        if check and returncode != 0:
+            raise exceptions.CommandError(returncode, cmd,
+                                          error_msg=output[-2000:])
+
+
+class LocalCommandRunner(CommandRunner):
+    """Runs on this machine inside the host's private root directory."""
+
+    def __init__(self, host: HostInfo, host_root: str) -> None:
+        super().__init__(host)
+        self.host_root = os.path.expanduser(host_root)
+        os.makedirs(self.host_root, exist_ok=True)
+
+    def _resolve(self, path: str) -> str:
+        """Map a remote-style path (~/...) into the host root."""
+        if path.startswith('~/'):
+            return os.path.join(self.host_root, path[2:])
+        if path == '~':
+            return self.host_root
+        return path
+
+    def run(self, cmd, *, env=None, cwd=None, stream_to=None, log_path=None,
+            timeout=None, check=False):
+        full_env = {**os.environ, **(env or {})}
+        full_env['HOME'] = self.host_root
+        cwd = self._resolve(cwd) if cwd else self.host_root
+        log_file = None
+        if log_path:
+            log_path = self._resolve(log_path)
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            log_file = open(log_path, 'a', encoding='utf-8')
+        lines: List[str] = []
+        try:
+            proc = subprocess.Popen(['bash', '-c', cmd],
+                                    cwd=cwd,
+                                    env=full_env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT,
+                                    text=True,
+                                    start_new_session=True)
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                lines.append(line)
+                if stream_to is not None:
+                    stream_to.write(line)
+                    stream_to.flush()
+                if log_file is not None:
+                    log_file.write(line)
+                    log_file.flush()
+            returncode = proc.wait(timeout=timeout)
+        finally:
+            if log_file is not None:
+                log_file.close()
+        output = ''.join(lines)
+        self._check(returncode, cmd, output, check)
+        return returncode, output
+
+    def rsync(self, src: str, dst: str, *, up: bool = True, excludes=None):
+        src, dst = os.path.expanduser(src), self._resolve(dst)
+        if not up:
+            src, dst = dst, os.path.expanduser(src)
+        _pycopy(src, dst, excludes)
+
+
+class SSHCommandRunner(CommandRunner):
+    """Runs over the `ssh` binary; files move with rsync-over-ssh."""
+
+    def __init__(self, host: HostInfo, ssh_user: str,
+                 ssh_key_path: Optional[str]) -> None:
+        super().__init__(host)
+        self.ssh_user = ssh_user
+        self.ssh_key_path = ssh_key_path
+        self.address = host.external_ip or host.internal_ip
+
+    def _ssh_base(self) -> List[str]:
+        cmd = ['ssh'] + _SSH_OPTIONS + ['-p', str(self.host.ssh_port)]
+        if self.ssh_key_path:
+            cmd += ['-i', os.path.expanduser(self.ssh_key_path)]
+        cmd.append(f'{self.ssh_user}@{self.address}')
+        return cmd
+
+    def run(self, cmd, *, env=None, cwd=None, stream_to=None, log_path=None,
+            timeout=None, check=False):
+        remote = ''
+        for key, value in (env or {}).items():
+            remote += f'export {key}={shlex.quote(str(value))}; '
+        if cwd:
+            remote += f'cd {shlex.quote(cwd)}; '
+        remote += cmd
+        full = self._ssh_base() + [remote]
+        log_file = None
+        if log_path:
+            os.makedirs(os.path.dirname(os.path.expanduser(log_path)),
+                        exist_ok=True)
+            log_file = open(os.path.expanduser(log_path), 'a',
+                            encoding='utf-8')
+        lines: List[str] = []
+        try:
+            proc = subprocess.Popen(full, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                lines.append(line)
+                if stream_to is not None:
+                    stream_to.write(line)
+                    stream_to.flush()
+                if log_file is not None:
+                    log_file.write(line)
+                    log_file.flush()
+            returncode = proc.wait(timeout=timeout)
+        finally:
+            if log_file is not None:
+                log_file.close()
+        output = ''.join(lines)
+        self._check(returncode, cmd, output, check)
+        return returncode, output
+
+    def rsync(self, src: str, dst: str, *, up: bool = True, excludes=None):
+        ssh_cmd = ' '.join(['ssh'] + _SSH_OPTIONS +
+                           ['-p', str(self.host.ssh_port)] +
+                           (['-i', self.ssh_key_path] if self.ssh_key_path
+                            else []))
+        cmd = ['rsync', '-a', '--delete', '-e', ssh_cmd]
+        for pattern in excludes or []:
+            cmd += ['--exclude', pattern]
+        remote = f'{self.ssh_user}@{self.address}:{dst}'
+        src_arg = os.path.expanduser(src)
+        if up:
+            if os.path.isdir(src_arg):
+                src_arg = src_arg.rstrip('/') + '/'
+            cmd += [src_arg, remote]
+        else:
+            cmd += [remote, src_arg]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(proc.returncode, ' '.join(cmd),
+                                          error_msg=proc.stderr[-500:])
+
+
+def runners_for_cluster(info: ClusterInfo) -> List[CommandRunner]:
+    """One runner per host, ordered by (node_index, worker_index)."""
+    local_style = info.custom.get('fake') or info.custom.get('local')
+    runners: List[CommandRunner] = []
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    for host in info.hosts:
+        if local_style:
+            root = os.path.join(state_dir, 'hosts', info.cluster_name,
+                                f'{host.node_index}-{host.worker_index}')
+            runners.append(LocalCommandRunner(host, root))
+        else:
+            runners.append(SSHCommandRunner(host, info.ssh_user,
+                                            info.ssh_key_path))
+    return runners

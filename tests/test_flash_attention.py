@@ -59,6 +59,48 @@ def test_gradients_match_reference():
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
 
 
+def test_bf16_forward_and_grads():
+    """The production dtype path: bf16 inputs, fp32 softmax/accum."""
+    q, k, v = _qkv(5, jnp.bfloat16)
+    ref = xla_attention(q, k, v, causal=True).astype(jnp.float32)
+    out = flash_attention(q, k, v, causal=True).astype(jnp.float32)
+    np.testing.assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
+
+    def loss(fn, q, k, v):
+        return (fn(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(lambda q, k, v: loss(flash_attention, q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: loss(xla_attention, q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-9
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                    b.astype(jnp.float32)))) / scale
+        assert err < 0.05, err
+
+
+def test_unsupported_non_tileable_seq_falls_back():
+    # s=132: block 132 is not a 128-multiple -> XLA fallback, not a
+    # Mosaic compile error.
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (1, 132, 2, 128))
+    k = jax.random.normal(ks[1], (1, 132, 1, 128))
+    v = jax.random.normal(ks[2], (1, 132, 1, 128))
+    out = flash_attention(q, k, v, causal=True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_invalid_gqa_ratio_raises():
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (1, 256, 6, 128))
+    k = jax.random.normal(ks[1], (1, 256, 4, 128))
+    v = jax.random.normal(ks[2], (1, 256, 4, 128))
+    with pytest.raises(AssertionError):
+        flash_attention(q, k, v, causal=True)
+
+
 def test_fallback_on_unsupported_shapes():
     # seq 100: no 128-divisible block -> must fall back to XLA, not crash.
     ks = jax.random.split(jax.random.key(0), 3)

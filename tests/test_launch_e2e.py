@@ -1,0 +1,149 @@
+"""End-to-end launch tests: the full stage machine against fake/local
+providers (the reference covers this with real-cloud smoke tests; here the
+fake cloud runs commands as local processes, so the whole path -- optimize,
+provision, sync, setup, rank env injection, gang exec, logs, queue, down --
+executes for real)."""
+import os
+
+import pytest
+
+import skypilot_tpu
+from skypilot_tpu import core, exceptions, execution, state
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_home):
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def _tpu_task(run, accel='tpu-v5e-16', **kw):
+    return Task(name='t', run=run,
+                resources=Resources(cloud='fake', accelerators=accel), **kw)
+
+
+def test_launch_end_to_end_multihost_rank_envs(capsys):
+    """v5e-16 -> 2 hosts; every host runs with its TPU_WORKER_ID and
+    jax.distributed coordinator env."""
+    task = _tpu_task(
+        'echo "worker=$TPU_WORKER_ID of $JAX_NUM_PROCESSES '
+        'coord=$JAX_COORDINATOR_ADDRESS rank=$SKYT_NODE_RANK"')
+    results = execution.launch(task, cluster_name='e2e')
+    assert results == [('e2e', 1)]
+    record = state.get_cluster('e2e')
+    assert record.status == state.ClusterStatus.UP
+    assert record.hourly_cost > 0
+
+    jobs = core.queue('e2e')
+    assert len(jobs) == 1
+    assert jobs[0]['status'] == 'SUCCEEDED'
+
+    # rank 0 log captured and tail-able
+    log0 = core.tail_logs('e2e', 1)
+    assert 'worker=0' in log0
+    assert 'coord=10.0.0.2:8476' in log0
+
+    # worker 1 got its own TPU_WORKER_ID
+    runtime_root = os.path.join(os.environ['SKYT_STATE_DIR'], 'hosts',
+                                'e2e', '0-1', '.skyt_runtime')
+    with open(os.path.join(runtime_root, 'jobs', '1', 'rank_1.log'),
+              encoding='utf-8') as f:
+        assert 'worker=1 of 2' in f.read()
+
+
+def test_setup_and_workdir_sync(tmp_path):
+    workdir = tmp_path / 'proj'
+    workdir.mkdir()
+    (workdir / 'data.txt').write_text('hello-from-workdir')
+    task = Task(
+        name='wd',
+        workdir=str(workdir),
+        setup='echo setup-ran > ~/setup_marker',
+        run='cat data.txt && cat ~/setup_marker',
+        resources=Resources(cloud='fake', accelerators='tpu-v5e-8'))
+    execution.launch(task, cluster_name='wd')
+    log0 = core.tail_logs('wd', 1)
+    assert 'hello-from-workdir' in log0
+    assert 'setup-ran' in log0
+
+
+def test_failed_run_marks_job_failed():
+    task = _tpu_task('echo about-to-fail; exit 3', accel='tpu-v5e-8')
+    execution.launch(task, cluster_name='fail')
+    jobs = core.queue('fail')
+    assert jobs[0]['status'] == 'FAILED'
+    assert jobs[0]['exit_code'] == 3
+
+
+def test_exec_reuses_cluster():
+    task = _tpu_task('echo first', accel='tpu-v5e-8')
+    execution.launch(task, cluster_name='reuse')
+    task2 = _tpu_task('echo second', accel='tpu-v5e-8')
+    results = execution.exec_(task2, 'reuse')
+    assert results[0][1] == 2  # second job id
+    assert len(core.queue('reuse')) == 2
+
+
+def test_stop_start_down_cycle():
+    task = _tpu_task('echo hi', accel='tpu-v5e-8')
+    execution.launch(task, cluster_name='cycle')
+    core.stop('cycle')
+    assert state.get_cluster('cycle').status == state.ClusterStatus.STOPPED
+    with pytest.raises(exceptions.ClusterNotUpError):
+        core.queue('cycle')
+    core.start('cycle')
+    assert state.get_cluster('cycle').status == state.ClusterStatus.UP
+    core.down('cycle')
+    assert state.get_cluster('cycle') is None
+
+
+def test_status_refresh_detects_preemption():
+    task = _tpu_task('echo hi', accel='tpu-v5e-8',
+                     )
+    task.resources[0] = Resources(cloud='fake', accelerators='tpu-v5e-8',
+                                  use_spot=True)
+    execution.launch(task, cluster_name='spot1')
+    fake.preempt_cluster('spot1')
+    records = core.status(['spot1'], refresh=True)
+    assert records[0]['status'] == 'INIT'
+
+
+def test_autodown():
+    task = _tpu_task('echo bye', accel='tpu-v5e-8')
+    execution.launch(task, cluster_name='autodown', down=True)
+    assert state.get_cluster('autodown') is None
+
+
+def test_dryrun_provisions_nothing():
+    task = _tpu_task('echo hi')
+    execution.launch(task, cluster_name='dry', dryrun=True)
+    assert state.get_cluster('dry') is None
+    assert fake.list_fake_clusters() == []
+
+
+def test_mismatched_resources_rejected():
+    execution.launch(_tpu_task('echo hi', accel='tpu-v5e-8'),
+                     cluster_name='small')
+    big = _tpu_task('echo hi', accel='tpu-v5e-32')
+    with pytest.raises(exceptions.ResourcesMismatchError):
+        execution.launch(big, cluster_name='small')
+
+
+def test_callable_run_gets_rank_and_ips():
+    task = Task(
+        name='gen', num_nodes=2,
+        run=lambda rank, ips: f'echo rank{rank} sees {len(ips)} nodes',
+        resources=Resources(cloud='fake', cpus='2'))
+    execution.launch(task, cluster_name='multi')
+    log0 = core.tail_logs('multi', 1)
+    assert 'rank0 sees 2 nodes' in log0
+
+
+def test_sdk_lazy_exports():
+    assert skypilot_tpu.Task is Task
+    assert callable(skypilot_tpu.launch)
+    assert skypilot_tpu.ClusterStatus is state.ClusterStatus

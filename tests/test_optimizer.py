@@ -1,0 +1,76 @@
+"""Optimizer dryrun tests (ref: tests/test_optimizer_dryruns.py)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.optimizer import Optimizer, candidates_for
+from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+CLOUDS = ['fake', 'local']
+
+
+def test_cheapest_first():
+    cands = candidates_for(Resources(cloud='fake',
+                                     accelerators='tpu-v5e-8'), CLOUDS)
+    assert cands
+    costs = [c.hourly_cost for c in cands]
+    assert costs == sorted(costs)
+    assert all(c.resources.zone is not None for c in cands)
+
+
+def test_spot_cheaper():
+    on_demand = candidates_for(Resources(cloud='fake',
+                                         accelerators='tpu-v5e-8'), CLOUDS)
+    spot = candidates_for(Resources(cloud='fake', accelerators='tpu-v5e-8',
+                                    use_spot=True), CLOUDS)
+    assert spot[0].hourly_cost < on_demand[0].hourly_cost
+
+
+def test_region_filter_respected():
+    cands = candidates_for(
+        Resources(cloud='fake', region='us-west4',
+                  accelerators='tpu-v5e-8'), CLOUDS)
+    assert cands and all(c.resources.region == 'us-west4' for c in cands)
+
+
+def test_cpu_only_task():
+    cands = candidates_for(Resources(cloud='fake', cpus='8+'), CLOUDS)
+    assert cands[0].resources.instance_type == 'n2-standard-8'
+
+
+def test_local_cloud_zero_cost():
+    cands = candidates_for(Resources(cloud='local'), CLOUDS)
+    assert cands[0].hourly_cost == 0.0
+    # local cannot serve TPUs
+    assert candidates_for(Resources(cloud='local',
+                                    accelerators='tpu-v5e-8'), CLOUDS) == []
+
+
+def test_optimize_dag_assigns_best():
+    with Dag('d') as dag:
+        dag.add(Task(name='t1', run='echo hi',
+                     resources=Resources(cloud='fake',
+                                         accelerators='tpu-v5p-32')))
+    Optimizer.optimize(dag, enabled_clouds=CLOUDS)
+    best = dag.tasks[0].best_resources
+    assert best.cloud == 'fake' and best.region and best.zone
+
+
+def test_any_of_picks_cheapest_across():
+    task = Task(run='x', resources=[
+        Resources(cloud='fake', accelerators='tpu-v5p-8'),
+        Resources(cloud='fake', accelerators='tpu-v5e-8'),
+    ])
+    plan = Optimizer.plan_task(task, CLOUDS)
+    # v5e-8 ($9.6/hr) cheaper than v5p-8 (4 chips * 4.2 = $16.8/hr)
+    assert plan[0].resources.tpu.generation == 'v5e'
+
+
+def test_infeasible_raises():
+    task = Task(run='x', resources=Resources(cloud='fake',
+                                             region='us-central2',
+                                             accelerators='tpu-v5e-8'))
+    # v5e not offered in us-central2
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Optimizer.plan_task(task, CLOUDS)

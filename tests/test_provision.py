@@ -1,0 +1,121 @@
+"""Provision + failover tests against the fake cloud (ref: moto-backed
+mock_aws_backend, tests/common_test_fixtures.py:494)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.optimizer import candidates_for
+from skypilot_tpu.provision import fake, get_provider
+from skypilot_tpu.provision.provisioner import (Blocklist,
+                                                provision_with_failover)
+from skypilot_tpu.spec.resources import Resources
+
+CLOUDS = ['fake']
+
+
+@pytest.fixture(autouse=True)
+def fresh_fake_cloud(tmp_home):
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def _candidates(accel='tpu-v5e-16', **kw):
+    return candidates_for(Resources(cloud='fake', accelerators=accel, **kw),
+                          CLOUDS)
+
+
+def test_provision_multi_host_slice():
+    info, chosen = provision_with_failover('c1', _candidates('tpu-v5e-16'),
+                                           num_nodes=1)
+    assert len(info.hosts) == 2          # v5e-16 = 2 hosts
+    assert info.hosts[0].worker_index == 0
+    assert info.hosts[1].worker_index == 1
+    assert chosen.resources.zone is not None
+    provider = get_provider('fake')
+    states = provider.query_instances('c1')
+    assert len(states) == 2 and all(s == 'running' for s in states.values())
+
+
+def test_multi_slice_hosts():
+    cands = candidates_for(
+        Resources(cloud='fake', accelerators='tpu-v5e-16', num_slices=2),
+        CLOUDS)
+    info, _ = provision_with_failover('c2', cands, num_nodes=1)
+    assert len(info.hosts) == 4          # 2 slices x 2 hosts
+
+
+def test_stockout_fails_over_to_next_zone():
+    cands = _candidates('tpu-v5e-8')
+    first_zone = cands[0].resources.zone
+    fake.inject_stockout(first_zone)
+    info, chosen = provision_with_failover('c3', cands, num_nodes=1)
+    assert chosen.resources.zone != first_zone
+    assert info.hosts
+
+
+def test_quota_error_blocklists_region():
+    cands = _candidates('tpu-v5e-8')
+    first_region = cands[0].resources.region
+    fake.inject_quota_exceeded(first_region)
+    blocklist = Blocklist()
+    _, chosen = provision_with_failover('c4', cands, num_nodes=1,
+                                        blocklist=blocklist)
+    assert chosen.resources.region != first_region
+    assert ('fake', first_region) in blocklist.regions
+
+
+def test_exhaustion_raises_with_history():
+    cands = _candidates('tpu-v5e-8')
+    for c in cands:
+        fake.inject_stockout(c.resources.zone)
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc:
+        provision_with_failover('c5', cands, num_nodes=1)
+    assert exc.value.failover_history
+    assert any('stockout' in str(e) for e in exc.value.failover_history)
+
+
+def test_transient_stockout_retry_succeeds_later():
+    cands = _candidates('tpu-v5e-8')
+    # one-shot stockout in the first zone: first try fails over, but a
+    # *fresh* provisioning round (new blocklist) succeeds there again
+    fake.inject_stockout(cands[0].resources.zone, count=1)
+    _, chosen1 = provision_with_failover('c6', cands, num_nodes=1)
+    assert chosen1.resources.zone != cands[0].resources.zone
+    _, chosen2 = provision_with_failover('c7', cands, num_nodes=1)
+    assert chosen2.resources.zone == cands[0].resources.zone
+
+
+def test_stop_resume_cycle():
+    cands = _candidates('tpu-v5e-8')
+    provision_with_failover('c8', cands, num_nodes=1)
+    provider = get_provider('fake')
+    provider.stop_instances('c8')
+    assert all(s == 'stopped'
+               for s in provider.query_instances('c8').values())
+    assert provider.get_cluster_info('c8') is None
+    info, _ = provision_with_failover('c8', cands, num_nodes=1, resume=True)
+    assert all(s == 'running'
+               for s in provider.query_instances('c8').values())
+    assert info.hosts
+
+
+def test_preemption_visible_in_query():
+    provision_with_failover('c9', _candidates('tpu-v5e-8', use_spot=True),
+                            num_nodes=1)
+    fake.preempt_cluster('c9')
+    provider = get_provider('fake')
+    assert all(s == 'preempted'
+               for s in provider.query_instances('c9').values())
+
+
+def test_gcp_error_classification():
+    from skypilot_tpu.provision.gcp import classify_gcp_error
+    err = classify_gcp_error(
+        'The zone does not have enough resources available')
+    assert isinstance(err, exceptions.CapacityError)
+    err = classify_gcp_error('Quota exceeded for TPUS_PER_PROJECT')
+    assert isinstance(err, exceptions.QuotaExceededError)
+    err = classify_gcp_error('internal server error')
+    assert isinstance(err, exceptions.ProvisionError)
+    assert not isinstance(err, (exceptions.CapacityError,
+                                exceptions.QuotaExceededError))
