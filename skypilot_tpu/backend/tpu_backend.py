@@ -88,7 +88,18 @@ class TpuPodBackend(Backend):
             autostop=(autostop.to_yaml_config()
                       if autostop.enabled else {}),
             hourly_cost=chosen.hourly_cost)
+        self._start_runtime_daemon(info)
         return info
+
+    def _start_runtime_daemon(self, info: ClusterInfo) -> None:
+        """Start the skylet-equivalent for this cluster (parity:
+        start_skylet_on_head_node, instance_setup.py:598)."""
+        if info.custom.get('fake') or info.custom.get('local'):
+            from skypilot_tpu.runtime import daemon
+            daemon.start_daemon(info.cluster_name)
+        # SSH clusters: daemon start is part of remote runtime setup
+        # (wheel shipping + `python -m skypilot_tpu.runtime.daemon` over
+        # SSH) -- wired with the real GCP path.
 
     # ------------------------------------------------------------------
     # Sync
@@ -150,20 +161,55 @@ class TpuPodBackend(Backend):
 
     def execute(self, info: ClusterInfo, task: Task, *,
                 detach: bool = True) -> int:
-        """Gang-run the task on every host; returns the job id.
+        """Run the task on every host; returns the job id.
 
-        Rank processes start concurrently on all hosts (threads); rank 0
-        output streams to stdout unless detach. Job state is recorded in
-        the head host's runtime dir.
+        detach=True: write rank scripts + a PENDING job record; the
+        cluster's runtime daemon gang-starts and supervises it (queue
+        semantics -- jobs run one at a time per cluster).
+        detach=False: gang-run in the foreground, streaming rank 0.
         """
         runners = runners_for_cluster(info)
         head_runtime = self._head_runtime_dir(info)
-        job_id = job_lib.add_job(head_runtime, task.name,
-                                 num_hosts=len(info.hosts))
-        job_log = job_lib.job_log_dir(head_runtime, job_id)
+        local_style = bool(info.custom.get('fake') or
+                           info.custom.get('local'))
+        if detach and not local_style:
+            # No runtime daemon wired for this cluster type yet (SSH
+            # daemon start lands with the real GCP path): a PENDING job
+            # would sit forever. Run in the foreground instead.
+            logger.warning('Detached execution requires the cluster '
+                           'runtime daemon; running in the foreground.')
+            detach = False
         resources = _task_resources(task)
         node_ips = codegen.node_ip_list(info)
 
+        if detach:
+            # Write all rank scripts BEFORE the job becomes PENDING: the
+            # daemon polls every second and must never observe a partial
+            # script set (it would gang-start a partial pod).
+            job_id = job_lib.add_job(head_runtime, task.name,
+                                     num_hosts=len(info.hosts),
+                                     status=job_lib.JobStatus.SETTING_UP)
+            log_dir = job_lib.job_log_dir(head_runtime, job_id)
+            os.makedirs(log_dir, exist_ok=True)
+            for idx, host in enumerate(info.hosts):
+                command = task.get_run_command(host.node_index, node_ips)
+                if command is None:
+                    continue
+                env = codegen.task_env_for_host(task, info, host, resources)
+                script = codegen.make_job_script(
+                    command, env,
+                    workdir=_WORKDIR_REMOTE if task.workdir else None,
+                    secrets=task.secrets)
+                with open(os.path.join(log_dir, f'rank_{idx}.sh'), 'w',
+                          encoding='utf-8') as f:
+                    f.write(script)
+            job_lib.set_status(head_runtime, job_id,
+                               job_lib.JobStatus.PENDING)
+            state.touch_cluster(info.cluster_name)
+            return job_id
+
+        job_id = job_lib.add_job(head_runtime, task.name,
+                                 num_hosts=len(info.hosts))
         job_lib.set_status(head_runtime, job_id, job_lib.JobStatus.RUNNING)
         exit_codes: Dict[int, int] = {}
         lock = threading.Lock()
@@ -199,7 +245,6 @@ class TpuPodBackend(Backend):
                  else job_lib.JobStatus.FAILED)
         job_lib.set_status(head_runtime, job_id, final, exit_code=worst)
         state.touch_cluster(info.cluster_name)
-        del job_log
         return job_id
 
     # ------------------------------------------------------------------
@@ -222,7 +267,8 @@ class TpuPodBackend(Backend):
 
     def tail_logs(self, info: ClusterInfo, job_id: Optional[int] = None,
                   stream=None, follow: bool = False) -> str:
-        """Return (and optionally stream) the rank-0 log of a job."""
+        """Return (and optionally follow) the rank-0 log of a job."""
+        from skypilot_tpu.runtime import log_lib
         stream = stream or sys.stdout
         runtime = self._head_runtime_dir(info)
         if job_id is None:
@@ -230,17 +276,26 @@ class TpuPodBackend(Backend):
             if not jobs:
                 raise exceptions.JobNotFoundError('No jobs on cluster')
             job_id = jobs[0]['job_id']
-        log_path = os.path.join(os.path.expanduser(runtime), 'jobs',
-                                str(job_id), 'rank_0.log')
-        if not os.path.exists(log_path):
+        if job_lib.get_job(runtime, job_id) is None:
+            raise exceptions.JobNotFoundError(f'No job {job_id} on cluster')
+        log_path = os.path.join(job_lib.job_log_dir(runtime, job_id),
+                                'rank_0.log')
+
+        def job_done() -> bool:
+            job = job_lib.get_job(runtime, job_id)
+            return job is None or job_lib.JobStatus(
+                job['status']).is_terminal()
+
+        if not follow and not os.path.exists(log_path):
             raise exceptions.JobNotFoundError(
                 f'No logs for job {job_id} at {log_path}')
-        with open(log_path, encoding='utf-8') as f:
-            content = f.read()
-        stream.write(content)
-        return content
+        lines = log_lib.tail_file(log_path, follow=follow,
+                                  stop_when=job_done)
+        return log_lib.stream_to(lines, stream)
 
     def teardown(self, cluster_name: str, *, terminate: bool = True) -> None:
+        from skypilot_tpu.runtime import daemon
+        daemon.stop_daemon(cluster_name)
         with locks.cluster_lock(cluster_name):
             record = state.get_cluster(cluster_name)
             if record is None:

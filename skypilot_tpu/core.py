@@ -87,6 +87,7 @@ def start(cluster_name: str) -> None:
     state.add_or_update_cluster(cluster_name,
                                 status=state.ClusterStatus.UP,
                                 handle=info.to_dict())
+    TpuPodBackend()._start_runtime_daemon(info)  # pylint: disable=protected-access
 
 
 def _cluster_info(cluster_name: str) -> ClusterInfo:
@@ -111,7 +112,7 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
                                      follow=follow)
 
 
-def autostop(cluster_name: str, idle_minutes: int,
+def autostop(cluster_name: str, idle_minutes: float,
              down_on_idle: bool = False) -> None:
     """Set/refresh the autostop policy (enforced by the runtime daemon)."""
     _get_record(cluster_name)

@@ -99,13 +99,23 @@ def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
     if Stage.SETUP in stages:
         backend.setup(info, task)
     job_id = None
+    detach = detach_run or not stream_logs
     if Stage.EXEC in stages and task.run is not None:
         state.add_cluster_event(cluster_name, 'JOB_SUBMIT',
                                 task.name or '')
-        job_id = backend.execute(info, task,
-                                 detach=detach_run or not stream_logs)
+        job_id = backend.execute(info, task, detach=detach)
     if down and Stage.DOWN in stages:
-        backend.teardown(cluster_name, terminate=True)
+        if detach and job_id is not None:
+            # The job is queued, not finished: autodown via the runtime
+            # daemon once the queue drains (immediate teardown would drop
+            # the job). Active jobs keep the cluster non-idle.
+            state.add_or_update_cluster(
+                cluster_name, status=state.ClusterStatus.UP,
+                autostop={'idle_minutes': 0, 'down': True}, touch=False)
+            state.add_cluster_event(cluster_name, 'AUTODOWN_ARMED',
+                                    'down after queued jobs finish')
+        else:
+            backend.teardown(cluster_name, terminate=True)
     return cluster_name, job_id
 
 

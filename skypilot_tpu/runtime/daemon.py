@@ -1,0 +1,285 @@
+"""Runtime daemon: the skylet equivalent, one per cluster head.
+
+Parity: ``sky/skylet/skylet.py`` (EVENTS :31, main :126) +
+``events.py:36-193``:
+
+* **JobSchedulerEvent** -- starts PENDING jobs (gang-spawns one rank
+  process per host with the submitted script), supervises RUNNING jobs
+  (a TPU program *hangs* on lost peers, so any rank failure kills the
+  whole gang), finalizes status with the worst exit code.
+* **AutostopEvent** -- tracks idleness from the job table + cluster
+  last_use; stops or downs the cluster via its provider.
+* **Heartbeat** -- liveness timestamp for status reconciliation.
+
+For local-style clusters (fake/local providers) every "host" is a private
+root directory on this machine, so the daemon gang-starts ranks directly;
+on real SSH clusters the daemon runs on the head node and reaches workers
+over SSH (wired with host keys at provision time).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+import psutil
+
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.subprocess_utils import kill_process_tree
+
+logger = log.init_logger(__name__)
+
+EVENT_PERIOD_SECONDS = 1.0
+
+
+class JobSupervisor:
+    """Gang lifecycle of one running job."""
+
+    def __init__(self, job_id: int, procs: List[subprocess.Popen]) -> None:
+        self.job_id = job_id
+        self.procs = procs
+
+    def poll(self) -> Optional[int]:
+        """None while running; else worst exit code (gang-kill on first
+        failure)."""
+        codes = [p.poll() for p in self.procs]
+        failed = [c for c in codes if c is not None and c != 0]
+        if failed:
+            # kill remaining ranks: TPU programs hang on lost peers
+            for proc in self.procs:
+                if proc.poll() is None:
+                    kill_process_tree(proc.pid, signal.SIGTERM)
+            for proc in self.procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    kill_process_tree(proc.pid, signal.SIGKILL)
+            return max(failed)
+        if all(c is not None for c in codes):
+            return 0
+        return None
+
+
+class Daemon:
+    def __init__(self, cluster_name: str) -> None:
+        self.cluster_name = cluster_name
+        self.supervisor: Optional[JobSupervisor] = None
+        self._host_roots = self._resolve_host_roots()
+        self.head_runtime = os.path.join(self._host_roots[0],
+                                         '.skyt_runtime')
+        os.makedirs(self.head_runtime, exist_ok=True)
+
+    # ------------------------------------------------------------------
+
+    def _resolve_host_roots(self) -> List[str]:
+        """Host root dirs ordered by (node, worker), from cluster state."""
+        from skypilot_tpu import state
+        from skypilot_tpu.provision.api import ClusterInfo
+        from skypilot_tpu.utils.command_runner import runners_for_cluster
+        record = state.get_cluster(self.cluster_name)
+        if record is None or not record.handle:
+            raise RuntimeError(f'No cluster record for {self.cluster_name}')
+        info = ClusterInfo.from_dict(record.handle)
+        runners = runners_for_cluster(info)
+        roots = []
+        for runner in runners:
+            if hasattr(runner, 'host_root'):
+                roots.append(runner.host_root)
+            else:
+                roots.append(os.path.expanduser('~'))
+        return roots
+
+    # ------------------------------------------------------------------
+    # Job scheduling (parity: JobSchedulerEvent -> job_lib.JobScheduler)
+    # ------------------------------------------------------------------
+
+    def _schedule_jobs(self) -> None:
+        if self.supervisor is not None:
+            self._poll_running()
+            return
+        pending = job_lib.list_jobs(self.head_runtime,
+                                    [job_lib.JobStatus.PENDING])
+        if not pending:
+            return
+        job = pending[-1]  # oldest first (list is DESC)
+        self._start_job(job['job_id'])
+
+    def _start_job(self, job_id: int) -> None:
+        log_dir = job_lib.job_log_dir(self.head_runtime, job_id)
+        if not any(
+                os.path.exists(os.path.join(log_dir, f'rank_{r}.sh'))
+                for r in range(len(self._host_roots))):
+            logger.warning('Job %d has no rank scripts; failing', job_id)
+            job_lib.set_status(self.head_runtime, job_id,
+                               job_lib.JobStatus.FAILED, exit_code=1)
+            return
+        procs: List[subprocess.Popen] = []
+        for rank, root in enumerate(self._host_roots):
+            script = os.path.join(log_dir, f'rank_{rank}.sh')
+            if not os.path.exists(script):
+                # a callable run may legitimately skip ranks (None command)
+                continue
+            rank_log = open(os.path.join(log_dir, f'rank_{rank}.log'), 'a',
+                            encoding='utf-8')
+            env = {**os.environ, 'HOME': root}
+            procs.append(subprocess.Popen(
+                ['bash', script], env=env, cwd=root,
+                stdout=rank_log, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL, start_new_session=True))
+            rank_log.close()
+        job_lib.set_status(self.head_runtime, job_id,
+                           job_lib.JobStatus.RUNNING)
+        job_lib.set_pids(self.head_runtime, job_id,
+                         [p.pid for p in procs])
+        self.supervisor = JobSupervisor(job_id, procs)
+        logger.info('Job %d started (%d ranks)', job_id, len(procs))
+
+    def _poll_running(self) -> None:
+        assert self.supervisor is not None
+        job = job_lib.get_job(self.head_runtime, self.supervisor.job_id)
+        if job is None or job['status'] == 'CANCELLED':
+            for proc in self.supervisor.procs:
+                kill_process_tree(proc.pid)
+            self.supervisor = None
+            return
+        code = self.supervisor.poll()
+        if code is None:
+            return
+        final = (job_lib.JobStatus.SUCCEEDED if code == 0
+                 else job_lib.JobStatus.FAILED)
+        job_lib.set_status(self.head_runtime, self.supervisor.job_id, final,
+                           exit_code=code)
+        logger.info('Job %d finished: %s (%d)', self.supervisor.job_id,
+                    final.value, code)
+        self.supervisor = None
+
+    # ------------------------------------------------------------------
+    # Autostop (parity: StopEvent -> autostop_lib, skylet/events.py)
+    # ------------------------------------------------------------------
+
+    def _check_autostop(self) -> bool:
+        """Returns True if the cluster was stopped/downed (daemon exits)."""
+        from skypilot_tpu import state
+        record = state.get_cluster(self.cluster_name)
+        if record is None:
+            return True  # cluster gone
+        config = record.autostop or {}
+        if not config:
+            return False
+        idle_minutes = config.get('idle_minutes', 5)
+        last_job = job_lib.last_activity_time(self.head_runtime)
+        last = max(last_job, record.last_use or 0, record.launched_at or 0)
+        if time.time() - last < idle_minutes * 60:
+            return False
+        logger.info('Cluster %s idle for > %d min: %s', self.cluster_name,
+                    idle_minutes, 'down' if config.get('down') else 'stop')
+        from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+        try:
+            TpuPodBackend().teardown(self.cluster_name,
+                                     terminate=bool(config.get('down')))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error('Autostop failed: %s', e)
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+
+    def _heartbeat(self) -> None:
+        path = os.path.join(self.head_runtime, 'daemon_heartbeat')
+        with open(path, 'w', encoding='utf-8') as f:
+            json.dump({'ts': time.time(), 'pid': os.getpid()}, f)
+
+    def run_forever(self) -> None:
+        logger.info('Daemon for %s up (roots: %d hosts)', self.cluster_name,
+                    len(self._host_roots))
+        while True:
+            try:
+                self._schedule_jobs()
+                self._heartbeat()
+                if self._check_autostop():
+                    logger.info('Cluster gone/stopped; daemon exiting')
+                    return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error('Daemon event error: %s', e, exc_info=True)
+            time.sleep(EVENT_PERIOD_SECONDS)
+
+
+# ---------------------------------------------------------------------------
+# Daemon process management (backend-side helpers)
+# ---------------------------------------------------------------------------
+
+def _pid_file(cluster_name: str) -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'daemons', f'{cluster_name}.pid')
+
+
+def daemon_alive(cluster_name: str) -> bool:
+    path = _pid_file(cluster_name)
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+        proc = psutil.Process(pid)
+        return 'skypilot_tpu.runtime.daemon' in ' '.join(proc.cmdline())
+    except (ValueError, psutil.NoSuchProcess, psutil.AccessDenied):
+        return False
+
+
+def start_daemon(cluster_name: str) -> int:
+    """Spawn the daemon detached (parity: start_skylet_on_head_node,
+    provision/instance_setup.py:598)."""
+    if daemon_alive(cluster_name):
+        with open(_pid_file(cluster_name), encoding='utf-8') as f:
+            return int(f.read().strip())
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    log_path = os.path.join(state_dir, 'daemons', f'{cluster_name}.log')
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        import sys
+        proc = subprocess.Popen(
+            [sys.executable, '-u', '-m', 'skypilot_tpu.runtime.daemon',
+             '--cluster', cluster_name],
+            stdout=log_file, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+    with open(_pid_file(cluster_name), 'w', encoding='utf-8') as f:
+        f.write(str(proc.pid))
+    return proc.pid
+
+
+def stop_daemon(cluster_name: str) -> None:
+    path = _pid_file(cluster_name)
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+        # Autostop runs teardown *from inside the daemon*: killing the
+        # recorded pid would SIGTERM ourselves mid-teardown. The daemon
+        # exits on its own after _check_autostop returns True.
+        if pid != os.getpid():
+            kill_process_tree(pid)
+    except (ValueError, OSError):
+        pass
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster', required=True)
+    args = parser.parse_args()
+    Daemon(args.cluster).run_forever()
+
+
+if __name__ == '__main__':
+    main()

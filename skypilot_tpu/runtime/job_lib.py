@@ -57,12 +57,13 @@ def _db(runtime_dir: str) -> sqlite3.Connection:
 
 def add_job(runtime_dir: str, name: Optional[str],
             num_hosts: int = 1,
-            metadata: Optional[Dict[str, Any]] = None) -> int:
+            metadata: Optional[Dict[str, Any]] = None,
+            status: JobStatus = JobStatus.PENDING) -> int:
     conn = _db(runtime_dir)
     cur = conn.execute(
         'INSERT INTO jobs (name, status, submitted_at, num_hosts, metadata) '
         'VALUES (?,?,?,?,?)',
-        (name, JobStatus.PENDING.value, time.time(), num_hosts,
+        (name, status.value, time.time(), num_hosts,
          json.dumps(metadata or {})))
     conn.commit()
     job_id = cur.lastrowid

@@ -1,0 +1,57 @@
+"""Log capture/tailing (parity: ``sky/skylet/log_lib.py``)."""
+from __future__ import annotations
+
+import os
+import time
+from typing import IO, Iterator, Optional
+
+
+def tail_file(path: str,
+              *,
+              follow: bool = False,
+              from_start: bool = True,
+              poll_interval: float = 0.2,
+              stop_when: Optional[callable] = None) -> Iterator[str]:
+    """Yield lines from a (possibly still-growing) log file.
+
+    `stop_when()` is polled when no new data is available; return True to
+    end following (e.g. when the job reached a terminal status).
+    """
+    path = os.path.expanduser(path)
+    # Wait for the file to appear (a queued job may sit behind another
+    # job for arbitrarily long): governed by stop_when, not a fixed
+    # deadline. Without follow, don't wait at all.
+    while not os.path.exists(path):
+        if not follow:
+            return
+        if stop_when is not None and stop_when():
+            if not os.path.exists(path):
+                return
+            break
+        time.sleep(poll_interval)
+    with open(path, encoding='utf-8', errors='replace') as f:
+        if not from_start:
+            f.seek(0, os.SEEK_END)
+        while True:
+            line = f.readline()
+            if line:
+                yield line
+                continue
+            if not follow:
+                return
+            if stop_when is not None and stop_when():
+                # drain anything written between the check and now
+                rest = f.read()
+                if rest:
+                    yield rest
+                return
+            time.sleep(poll_interval)
+
+
+def stream_to(lines: Iterator[str], out: IO[str]) -> str:
+    buf = []
+    for line in lines:
+        out.write(line)
+        out.flush()
+        buf.append(line)
+    return ''.join(buf)

@@ -22,7 +22,7 @@ class AutostopConfig:
     """`autostop: {idle_minutes: 10, down: false}` (ref sky/resources.py
     autostop + sky/skylet/autostop_lib.py:137)."""
     enabled: bool = False
-    idle_minutes: int = 5
+    idle_minutes: float = 5
     down: bool = False
 
     @classmethod
@@ -32,11 +32,11 @@ class AutostopConfig:
             return cls(enabled=False)
         if config is True:
             return cls(enabled=True)
-        if isinstance(config, int):
+        if isinstance(config, (int, float)):
             return cls(enabled=True, idle_minutes=config)
         if isinstance(config, dict):
             return cls(enabled=True,
-                       idle_minutes=int(config.get('idle_minutes', 5)),
+                       idle_minutes=float(config.get('idle_minutes', 5)),
                        down=bool(config.get('down', False)))
         raise exceptions.InvalidSpecError(f'Invalid autostop: {config!r}')
 

@@ -1,0 +1,119 @@
+"""Runtime daemon tests: detached queue, gang kill, autostop, log follow.
+
+These spawn the real daemon process (parity: skylet lifecycle,
+SURVEY.md section 3.4).
+"""
+import io
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import core, execution, state
+from skypilot_tpu.provision import fake
+from skypilot_tpu.runtime import daemon, job_lib
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_home):
+    fake.reset()
+    yield
+    # kill any daemons started during the test
+    for name in ('d1', 'd2', 'd3', 'd4'):
+        daemon.stop_daemon(name)
+    fake.reset()
+
+
+def _wait_job(cluster, job_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        jobs = {j['job_id']: j for j in core.queue(cluster)}
+        job = jobs.get(job_id)
+        if job and job_lib.JobStatus(job['status']).is_terminal():
+            return job
+        time.sleep(0.3)
+    raise TimeoutError(f'job {job_id} not terminal: {core.queue(cluster)}')
+
+
+def _task(run, accel='tpu-v5e-16', name='t'):
+    return Task(name=name, run=run,
+                resources=Resources(cloud='fake', accelerators=accel))
+
+
+def test_detached_job_runs_via_daemon():
+    task = _task('echo detached-worker-$TPU_WORKER_ID; exit 0')
+    results = execution.launch(task, cluster_name='d1', detach_run=True)
+    job_id = results[0][1]
+    assert daemon.daemon_alive('d1')
+    job = _wait_job('d1', job_id)
+    assert job['status'] == 'SUCCEEDED'
+    log0 = core.tail_logs('d1', job_id)
+    assert 'detached-worker-0' in log0
+
+
+def test_queue_runs_jobs_in_order():
+    execution.launch(_task('sleep 0.5; echo one', accel='tpu-v5e-8'),
+                     cluster_name='d2', detach_run=True)
+    t2 = _task('echo two', accel='tpu-v5e-8')
+    job2 = execution.exec_(t2, 'd2', detach_run=True)[0][1]
+    job = _wait_job('d2', job2)
+    assert job['status'] == 'SUCCEEDED'
+    jobs = core.queue('d2')
+    assert [j['status'] for j in jobs] == ['SUCCEEDED', 'SUCCEEDED']
+
+
+def test_gang_kill_on_rank_failure():
+    """rank 1 fails fast; the daemon must kill rank 0 (which would other-
+    wise 'hang' like a TPU program with a lost peer) and fail the job."""
+    def run(rank_ignored, ips):
+        del rank_ignored, ips
+        return ('if [ "$TPU_WORKER_ID" = "1" ]; then exit 7; '
+                'else sleep 120; fi')
+
+    task = Task(name='gang', run=run,
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-16'))
+    job_id = execution.launch(task, cluster_name='d3',
+                              detach_run=True)[0][1]
+    t0 = time.time()
+    job = _wait_job('d3', job_id, timeout=60)
+    assert job['status'] == 'FAILED'
+    assert job['exit_code'] == 7
+    assert time.time() - t0 < 60  # did not wait for the 120s sleep
+
+
+def test_autostop_stops_idle_cluster():
+    task = _task('echo quick', accel='tpu-v5e-8')
+    task.resources[0] = Resources(cloud='fake', accelerators='tpu-v5e-8',
+                                  autostop={'idle_minutes': 0.05})
+    job_id = execution.launch(task, cluster_name='d4',
+                              detach_run=True)[0][1]
+    _wait_job('d4', job_id)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        record = state.get_cluster('d4')
+        if record and record.status == state.ClusterStatus.STOPPED:
+            break
+        time.sleep(0.5)
+    record = state.get_cluster('d4')
+    assert record.status == state.ClusterStatus.STOPPED
+    events = [e['event'] for e in state.get_cluster_events('d4')]
+    assert 'STOPPED' in events
+
+
+def test_follow_logs_stream_until_terminal():
+    task = _task('for i in 1 2 3; do echo line-$i; sleep 0.2; done',
+                 accel='tpu-v5e-8')
+    job_id = execution.launch(task, cluster_name='d1',
+                              detach_run=True)[0][1]
+    buf = io.StringIO()
+    from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+    from skypilot_tpu.provision.api import ClusterInfo
+    record = state.get_cluster('d1')
+    info = ClusterInfo.from_dict(record.handle)
+    content = TpuPodBackend().tail_logs(info, job_id, stream=buf,
+                                        follow=True)
+    assert 'line-1' in content and 'line-3' in content
+    job = _wait_job('d1', job_id)
+    assert job['status'] == 'SUCCEEDED'
