@@ -1,0 +1,4 @@
+"""Client layer: Python SDK + CLI over the REST API server.
+
+Parity: ``sky/client/`` (sdk.py, cli/command.py).
+"""

@@ -1,0 +1,285 @@
+"""The ``skyt`` CLI (parity: ``sky/client/cli/command.py`` — launch :1317,
+exec :1541, status :2068, queue :2612, logs :2728, cancel :2929, stop
+:3056, autostop :3137, start :3270, down :3480, check :3997, show-gpus
+:4075 → here `show-tpus`, api group :7717).
+
+Every verb goes through the SDK: submit → request_id → stream/get.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+import click
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.client import sdk
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import common_utils
+
+
+def _echo_table(rows: List[dict], columns: List[str]) -> None:
+    if not rows:
+        click.echo('(none)')
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ''))) for r in rows))
+              for c in columns}
+    click.echo('  '.join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        click.echo('  '.join(str(r.get(c, '')).ljust(widths[c])
+                             for c in columns))
+
+
+def _run(request_id: str, async_: bool, stream: bool = True):
+    if async_:
+        click.echo(f'request: {request_id}')
+        return None
+    try:
+        if stream:
+            return sdk.stream_and_get(request_id)
+        return sdk.get(request_id)
+    except exceptions.SkytError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@click.group()
+def cli() -> None:
+    """skypilot-tpu: launch and manage TPU workloads on the cloud."""
+
+
+# -- cluster lifecycle -------------------------------------------------
+
+
+@cli.command()
+@click.argument('entrypoint', required=True)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--down', is_flag=True, default=False,
+              help='Tear down after the job finishes.')
+@click.option('--async', 'async_', is_flag=True, default=False,
+              help='Submit and return the request id immediately.')
+@click.option('--env', multiple=True, help='KEY=VALUE env overrides.')
+def launch(entrypoint: str, cluster: Optional[str], dryrun: bool,
+           down: bool, async_: bool, env) -> None:
+    """Launch a task YAML (provision + sync + setup + run)."""
+    task = Task.from_yaml(entrypoint)
+    if env:
+        task.update_envs(dict(e.split('=', 1) for e in env))
+    request_id = sdk.launch(task, cluster, dryrun=dryrun, down=down)
+    result = _run(request_id, async_)
+    if result:
+        for name, job_id in result:
+            click.echo(f'cluster: {name}  job: {job_id}')
+
+
+@cli.command('exec')
+@click.argument('entrypoint', required=True)
+@click.option('--cluster', '-c', required=True)
+@click.option('--async', 'async_', is_flag=True, default=False)
+def exec_cmd(entrypoint: str, cluster: str, async_: bool) -> None:
+    """Run a task on an existing cluster (skips provision/setup)."""
+    task = Task.from_yaml(entrypoint)
+    result = _run(sdk.exec(task, cluster), async_)
+    if result:
+        for name, job_id in result:
+            click.echo(f'cluster: {name}  job: {job_id}')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(clusters, refresh: bool) -> None:
+    """Show clusters."""
+    records = _run(sdk.status(list(clusters) or None, refresh=refresh),
+                   False, stream=False)
+    for r in records or []:
+        res = r.get('resources') or {}
+        r['resources'] = (res.get('accelerators') or
+                          res.get('instance_type') or 'cpu')
+        if r.get('launched_at'):
+            import time
+            r['age'] = common_utils.readable_duration(
+                time.time() - r['launched_at'])
+    _echo_table(records or [],
+                ['name', 'status', 'resources', 'region', 'age'])
+
+
+@cli.command()
+@click.argument('cluster')
+def stop(cluster: str) -> None:
+    """Stop a cluster (keeps its disk; restart with `skyt start`)."""
+    _run(sdk.stop(cluster), False, stream=False)
+    click.echo(f'Cluster {cluster} stopped.')
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster: str) -> None:
+    """Restart a stopped cluster."""
+    _run(sdk.start(cluster), False)
+    click.echo(f'Cluster {cluster} started.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def down(cluster: str, yes: bool) -> None:
+    """Terminate a cluster."""
+    if not yes:
+        click.confirm(f'Tear down cluster {cluster!r}?', abort=True)
+    _run(sdk.down(cluster), False, stream=False)
+    click.echo(f'Cluster {cluster} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=float, required=True,
+              help='Idle minutes before stopping; -1 disables.')
+@click.option('--down', 'down_on_idle', is_flag=True, default=False,
+              help='Tear down instead of stop.')
+def autostop(cluster: str, idle_minutes: float, down_on_idle: bool) -> None:
+    """Schedule stop/teardown after idleness (runtime-daemon enforced)."""
+    _run(sdk.autostop(cluster, idle_minutes, down_on_idle), False,
+         stream=False)
+    click.echo(f'Autostop set on {cluster}: {idle_minutes} min '
+               f'({"down" if down_on_idle else "stop"}).')
+
+
+# -- jobs on a cluster -------------------------------------------------
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster: str) -> None:
+    """Show a cluster's job queue."""
+    jobs = _run(sdk.queue(cluster), False, stream=False)
+    _echo_table(jobs or [],
+                ['job_id', 'name', 'status', 'submitted_at'])
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--job-id', '-j', type=int, default=None)
+@click.option('--follow/--no-follow', default=True)
+def logs(cluster: str, job_id: Optional[int], follow: bool) -> None:
+    """Tail a job's logs."""
+    _run(sdk.tail_logs(cluster, job_id, follow=follow), False)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+def cancel(cluster: str, job_id: int) -> None:
+    """Cancel a job."""
+    ok = _run(sdk.cancel(cluster, job_id), False, stream=False)
+    click.echo('Cancelled.' if ok else 'Job already finished.')
+
+
+# -- info --------------------------------------------------------------
+
+
+@cli.command()
+def check() -> None:
+    """Probe cloud credentials and show enabled clouds."""
+    result = _run(sdk.check(), False, stream=False) or {}
+    for cloud, (ok, reason) in result.items():
+        mark = 'enabled' if ok else f'disabled ({reason})'
+        click.echo(f'  {cloud}: {mark}')
+
+
+@cli.command('show-tpus')
+@click.option('--name-filter', '-n', default=None)
+@click.option('--tpus-only', is_flag=True, default=False)
+def show_tpus(name_filter: Optional[str], tpus_only: bool) -> None:
+    """List TPU/accelerator offerings and pricing from the catalog."""
+    from skypilot_tpu.catalog import common as catalog
+    rows = []
+    for name, regions in catalog.list_accelerators(name_filter,
+                                                   tpus_only=tpus_only
+                                                   ).items():
+        rows.append({
+            'accelerator': name,
+            'regions': ','.join(regions[:4]) + (
+                f' (+{len(regions)-4})' if len(regions) > 4 else ''),
+            'price_hr': f'${catalog.get_hourly_cost(name):.2f}',
+            'spot_hr':
+                f'${catalog.get_hourly_cost(name, use_spot=True):.2f}',
+        })
+    _echo_table(rows, ['accelerator', 'regions', 'price_hr', 'spot_hr'])
+
+
+@cli.command('cost-report')
+def cost_report() -> None:
+    """Accumulated cost per cluster."""
+    rows = _run(sdk.cost_report(), False, stream=False)
+    _echo_table(rows or [],
+                ['name', 'status', 'hourly_cost', 'accumulated_cost'])
+
+
+# -- api server control ------------------------------------------------
+
+
+@cli.group()
+def api() -> None:
+    """Manage the API server and async requests."""
+
+
+@api.command('start')
+def api_start() -> None:
+    url = sdk.ensure_api_server()
+    click.echo(f'API server healthy at {url}')
+
+
+@api.command('stop')
+def api_stop() -> None:
+    stopped = sdk.api_stop()
+    click.echo('API server stopped.' if stopped else 'No server running.')
+
+
+@api.command('status')
+@click.option('--all', 'show_all', is_flag=True, default=False)
+def api_status(show_all: bool) -> None:
+    reqs = sdk.api_status()
+    if not show_all:
+        reqs = [r for r in reqs
+                if r['status'] in ('PENDING', 'RUNNING')] or reqs[:10]
+    rows = [{
+        'request': r['request_id'][:8],
+        'name': r['name'],
+        'status': r['status'],
+        'user': r['user'],
+    } for r in reqs]
+    _echo_table(rows, ['request', 'name', 'status', 'user'])
+
+
+@api.command('get')
+@click.argument('request_id')
+def api_get(request_id: str) -> None:
+    result = sdk.get(request_id)
+    click.echo(json.dumps(result, indent=2, default=str))
+
+
+@api.command('logs')
+@click.argument('request_id')
+def api_logs(request_id: str) -> None:
+    sdk.stream_and_get(request_id)
+
+
+@api.command('cancel')
+@click.argument('request_id')
+def api_cancel(request_id: str) -> None:
+    ok = sdk.api_cancel(request_id)
+    click.echo('Cancelled.' if ok else 'Not cancellable.')
+
+
+def main() -> None:
+    try:
+        cli()
+    except KeyboardInterrupt:
+        sys.exit(130)
+
+
+if __name__ == '__main__':
+    main()

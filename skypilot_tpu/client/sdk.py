@@ -1,0 +1,287 @@
+"""Python SDK: every call POSTs to the API server and returns a
+``request_id``; ``get()`` blocks on it, ``stream_and_get()`` also tails
+logs (parity: ``sky/client/sdk.py`` launch :668, get :2313,
+stream_and_get :2368 — all-async contract per sky/__init__.py:104-131).
+
+If no server is running, one is auto-started locally (the reference does
+the same for the local API server case).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tarfile
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import DEFAULT_PORT
+from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import log, subprocess_utils
+
+logger = log.init_logger(__name__)
+
+
+class RequestId(str):
+    """A server-side request handle (prefix-resolvable, like git SHAs)."""
+
+
+def api_server_url() -> str:
+    env = os.environ.get('SKYT_API_SERVER_URL')
+    if env:
+        return env.rstrip('/')
+    info_path = os.path.join(requests_db.server_dir(), 'server.json')
+    if os.path.exists(info_path):
+        with open(info_path, encoding='utf-8') as f:
+            info = json.load(f)
+        return f'http://{info["host"]}:{info["port"]}'
+    return f'http://127.0.0.1:{DEFAULT_PORT}'
+
+
+def api_is_healthy(url: Optional[str] = None) -> bool:
+    try:
+        resp = requests_lib.get(f'{url or api_server_url()}/api/health',
+                                timeout=2)
+        return resp.status_code == 200
+    except requests_lib.exceptions.RequestException:
+        return False
+
+
+def ensure_api_server() -> str:
+    """Return a healthy server URL, auto-starting a local one if needed."""
+    url = api_server_url()
+    if api_is_healthy(url):
+        return url
+    if os.environ.get('SKYT_API_SERVER_URL'):
+        raise exceptions.ApiServerError(
+            f'API server at {url} is unreachable.')
+    logger.info('Starting local API server at %s', url)
+    port = int(url.rsplit(':', 1)[1])
+    subprocess_utils.daemonize_and_run(
+        [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
+         str(port)],
+        log_path=os.path.join(requests_db.server_dir(), 'server.log'))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if api_is_healthy(url):
+            return url
+        time.sleep(0.2)
+    raise exceptions.ApiServerError(
+        f'Local API server failed to start at {url}; see '
+        f'{os.path.join(requests_db.server_dir(), "server.log")}')
+
+
+def api_stop() -> bool:
+    """Stop the local API server (parity: `sky api stop`)."""
+    info_path = os.path.join(requests_db.server_dir(), 'server.json')
+    if not os.path.exists(info_path):
+        return False
+    with open(info_path, encoding='utf-8') as f:
+        pid = json.load(f).get('pid')
+    os.remove(info_path)
+    if pid:
+        import signal
+        subprocess_utils.kill_process_tree(pid, signal.SIGTERM)
+        return True
+    return False
+
+
+def _post(route: str, body: Dict[str, Any]) -> RequestId:
+    url = ensure_api_server()
+    resp = requests_lib.post(f'{url}/{route}', json=body, timeout=30)
+    payload = resp.json()
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return RequestId(payload['request_id'])
+
+
+# -- async request lifecycle ------------------------------------------
+
+
+def get(request_id: str, timeout: Optional[float] = None) -> Any:
+    """Block until the request finishes; return its value or raise.
+
+    Parity: sdk.get :2313."""
+    url = ensure_api_server()
+    deadline = None if timeout is None else time.time() + timeout
+    while True:
+        resp = requests_lib.get(
+            f'{url}/api/get',
+            params={'request_id': request_id, 'timeout': 15},
+            timeout=60)
+        if resp.status_code == 404:
+            raise exceptions.RequestDoesNotExist(
+                f'No request {request_id!r}.')
+        payload = resp.json()
+        if resp.status_code != 200:
+            raise exceptions.ApiServerError(
+                payload.get('error', f'HTTP {resp.status_code}'))
+        status = requests_db.RequestStatus(payload['status'])
+        if status == requests_db.RequestStatus.SUCCEEDED:
+            return payload['return_value']
+        if status == requests_db.RequestStatus.FAILED:
+            raise exceptions.RequestFailedError(
+                payload.get('error') or 'request failed',
+                request_id=request_id)
+        if status == requests_db.RequestStatus.CANCELLED:
+            raise exceptions.RequestCancelledError(
+                f'Request {request_id} was cancelled.')
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f'Request {request_id} still {status.value} after '
+                f'{timeout}s.')
+
+
+def stream_and_get(request_id: str,
+                   output: Any = None) -> Any:
+    """Tail the request's log to ``output`` (default stdout), then get().
+
+    Parity: sdk.stream_and_get :2368."""
+    url = ensure_api_server()
+    output = output or sys.stdout
+    with requests_lib.get(f'{url}/api/stream',
+                          params={'request_id': request_id},
+                          stream=True, timeout=None) as resp:
+        for chunk in resp.iter_content(chunk_size=None):
+            output.write(chunk.decode('utf-8', errors='replace'))
+            if hasattr(output, 'flush'):
+                output.flush()
+    return get(request_id)
+
+
+def api_cancel(request_id: str) -> bool:
+    url = ensure_api_server()
+    resp = requests_lib.post(f'{url}/api/cancel',
+                             json={'request_id': request_id}, timeout=30)
+    return bool(resp.json().get('cancelled'))
+
+
+def api_status(status: Optional[str] = None) -> List[Dict[str, Any]]:
+    url = ensure_api_server()
+    params = {'status': status} if status else {}
+    resp = requests_lib.get(f'{url}/api/requests', params=params,
+                            timeout=30)
+    payload = resp.json()
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return payload
+
+
+# -- workdir upload ----------------------------------------------------
+
+
+def _upload_workdir(task_config: Dict[str, Any]) -> Dict[str, Any]:
+    """Tar the local workdir and upload it; rewrite the task's workdir to
+    the server-side extracted path (parity: POST /upload, chunked
+    server.py:1564)."""
+    workdir = task_config.get('workdir')
+    if not workdir or not os.path.isdir(os.path.expanduser(workdir)):
+        return task_config
+    buf = io.BytesIO()
+    src = os.path.expanduser(workdir)
+    def _exclude_git_dir(ti: tarfile.TarInfo) -> Optional[tarfile.TarInfo]:
+        # Exact '.git' path components only: .gitignore/.github must ship.
+        parts = ti.name.split('/')
+        return None if '.git' in parts else ti
+
+    with tarfile.open(fileobj=buf, mode='w:gz') as tar:
+        tar.add(src, arcname='.', filter=_exclude_git_dir)
+    url = ensure_api_server()
+    resp = requests_lib.post(f'{url}/upload', data=buf.getvalue(),
+                             timeout=600)
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            f'workdir upload failed: {resp.text}')
+    task_config = dict(task_config)
+    task_config['workdir'] = resp.json()['path']
+    return task_config
+
+
+# -- public verbs ------------------------------------------------------
+
+
+def _task_configs(task_or_dag: Union[Task, Dag]) -> List[Dict[str, Any]]:
+    tasks = task_or_dag.tasks if isinstance(task_or_dag, Dag) else [
+        task_or_dag]
+    return [_upload_workdir(t.to_yaml_config()) for t in tasks]
+
+
+def launch(task: Union[Task, Dag],
+           cluster_name: Optional[str] = None,
+           *,
+           dryrun: bool = False,
+           down: bool = False) -> RequestId:
+    configs = _task_configs(task)
+    assert len(configs) == 1, 'chain DAGs: launch tasks individually'
+    return _post('launch', {
+        'task_config': configs[0],
+        'cluster_name': cluster_name,
+        'dryrun': dryrun,
+        'down': down,
+    })
+
+
+def exec(task: Union[Task, Dag],  # pylint: disable=redefined-builtin
+         cluster_name: str) -> RequestId:
+    configs = _task_configs(task)
+    return _post('exec', {
+        'task_config': configs[0],
+        'cluster_name': cluster_name,
+    })
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> RequestId:
+    return _post('status', {'cluster_names': cluster_names,
+                            'refresh': refresh})
+
+
+def stop(cluster_name: str) -> RequestId:
+    return _post('stop', {'cluster_name': cluster_name})
+
+
+def start(cluster_name: str) -> RequestId:
+    return _post('start', {'cluster_name': cluster_name})
+
+
+def down(cluster_name: str) -> RequestId:
+    return _post('down', {'cluster_name': cluster_name})
+
+
+def queue(cluster_name: str) -> RequestId:
+    return _post('queue', {'cluster_name': cluster_name})
+
+
+def cancel(cluster_name: str, job_id: int) -> RequestId:
+    return _post('cancel', {'cluster_name': cluster_name,
+                            'job_id': job_id})
+
+
+def tail_logs(cluster_name: str,
+              job_id: Optional[int] = None,
+              follow: bool = False) -> RequestId:
+    return _post('logs', {'cluster_name': cluster_name, 'job_id': job_id,
+                          'follow': follow})
+
+
+def autostop(cluster_name: str, idle_minutes: float,
+             down_on_idle: bool = False) -> RequestId:
+    return _post('autostop', {'cluster_name': cluster_name,
+                              'idle_minutes': idle_minutes,
+                              'down_on_idle': down_on_idle})
+
+
+def cost_report() -> RequestId:
+    return _post('cost_report', {})
+
+
+def check() -> RequestId:
+    return _post('check', {})

@@ -97,6 +97,23 @@ class RequestNotFoundError(SkytError):
     """API-server request id unknown."""
 
 
+# Alias used by the client SDK (parity: sky request lookup errors).
+RequestDoesNotExist = RequestNotFoundError
+
+
+class ApiServerError(SkytError):
+    """API server unreachable or returned an HTTP error."""
+
+
+class RequestFailedError(SkytError):
+    """A server-side request finished with FAILED status."""
+
+    def __init__(self, message: str,
+                 request_id: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.request_id = request_id
+
+
 class RequestCancelledError(SkytError):
     """API-server request was cancelled by the user."""
 

@@ -70,6 +70,13 @@ def inject_quota_exceeded(region: str, count: int = -1) -> None:
         data['faults'].setdefault('quota', {})[region] = count
 
 
+def inject_slow_create(seconds: float) -> None:
+    """Every creation sleeps `seconds` (queued-resource provisioning is
+    slow in reality; lets tests exercise pending/cancel paths)."""
+    with _Store() as data:
+        data['faults']['slow_create_seconds'] = seconds
+
+
 def clear_faults() -> None:
     with _Store() as data:
         data['faults'] = {}
@@ -122,6 +129,9 @@ class FakeProvider(Provider):
             quota_hit = _consume_fault(data, 'quota', request.region)
             stockout_hit = (not quota_hit and
                             _consume_fault(data, 'stockout', zone))
+            slow = data.get('faults', {}).get('slow_create_seconds', 0)
+        if slow:
+            time.sleep(slow)
         if quota_hit:
             raise exceptions.QuotaExceededError(
                 f'Quota exceeded for {res.accelerators} in region '

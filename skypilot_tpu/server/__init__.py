@@ -1,0 +1,7 @@
+"""Client-server layer: REST API server + async request executor.
+
+Parity: ``sky/server/`` — FastAPI app (server.py), LONG/SHORT process-pool
+request executor (requests/executor.py), request DB (requests/requests.py).
+Built on the stdlib HTTP stack (the image has no FastAPI); the wire protocol
+is plain JSON-over-HTTP with chunked log streaming.
+"""

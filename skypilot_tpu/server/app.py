@@ -1,0 +1,262 @@
+"""The API server: JSON-over-HTTP REST app on the stdlib HTTP stack.
+
+Parity: ``sky/server/server.py`` — REST endpoints wrapping core ops (launch
+:1772 schedules execution.launch on the LONG queue), chunked workdir upload
+(:1564), request polling/streaming (stream_utils). FastAPI isn't in the
+image, so routing is a small method+path table over ThreadingHTTPServer;
+the client protocol is identical in spirit: every mutating call returns a
+``request_id`` immediately, results are fetched via ``/api/get`` and logs
+via chunked ``/api/stream``.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import json
+import os
+import shutil
+import tarfile
+import tempfile
+import time
+import urllib.parse
+from http import HTTPStatus
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import skypilot_tpu
+from skypilot_tpu.server import executor as executor_lib
+from skypilot_tpu.server import payloads, requests_db
+from skypilot_tpu.server.requests_db import RequestStatus
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+DEFAULT_PORT = 46590
+
+
+def _uploads_dir() -> str:
+    return os.path.join(requests_db.server_dir(), 'uploads')
+
+
+class ApiHandler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+    server_version = 'skypilot-tpu-api'
+
+    # Quiet the default per-request stderr lines.
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug('%s - %s', self.address_string(), fmt % args)
+
+    # -- helpers -------------------------------------------------------
+
+    def _json_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get('Content-Length', 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _reply(self, payload: Any, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._reply({'error': message}, code)
+
+    @property
+    def _query(self) -> Dict[str, str]:
+        parsed = urllib.parse.urlparse(self.path)
+        return {k: v[0] for k, v in
+                urllib.parse.parse_qs(parsed.query).items()}
+
+    @property
+    def _route(self) -> str:
+        return urllib.parse.urlparse(self.path).path.rstrip('/')
+
+    # -- POST: payload submission + control ----------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        route = self._route
+        try:
+            if route == '/api/cancel':
+                body = self._json_body()
+                ok = executor_lib.cancel_request(body['request_id'])
+                self._reply({'cancelled': ok})
+            elif route == '/upload':
+                self._handle_upload()
+            elif route.lstrip('/') in payloads.PAYLOADS:
+                name = route.lstrip('/')
+                body = self._json_body()
+                _, schedule_type = payloads.PAYLOADS[name]
+                request_id = requests_db.create(
+                    name, body, schedule_type,
+                    user=self.headers.get('X-Skyt-User'))
+                self._reply({'request_id': request_id})
+            else:
+                self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('POST %s failed', route)
+            self._error(HTTPStatus.INTERNAL_SERVER_ERROR,
+                        f'{type(e).__name__}: {e}')
+
+    def _handle_upload(self) -> None:
+        """Chunked workdir upload: gzipped tar body, content-addressed
+        extraction (parity: server.py:1564 + blob storage)."""
+        length = int(self.headers.get('Content-Length', 0))
+        raw = self.rfile.read(length)
+        digest = hashlib.sha256(raw).hexdigest()[:16]
+        os.makedirs(_uploads_dir(), exist_ok=True)
+        dest = os.path.join(_uploads_dir(), digest)
+        if not os.path.exists(dest):
+            tmp = tempfile.mkdtemp(prefix=f'.{digest}-', dir=_uploads_dir())
+            with tarfile.open(fileobj=io.BytesIO(raw), mode='r:gz') as tar:
+                tar.extractall(tmp, filter='data')
+            try:
+                os.rename(tmp, dest)
+            except OSError:
+                # Lost the race to a concurrent identical upload — content
+                # is identical (content-addressed), so theirs is fine.
+                shutil.rmtree(tmp, ignore_errors=True)
+        self._reply({'workdir_token': digest, 'path': dest})
+
+    # -- GET: polling / streaming --------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        route = self._route
+        try:
+            if route == '/api/health':
+                self._reply({
+                    'status': 'healthy',
+                    'version': skypilot_tpu.__version__,
+                })
+            elif route == '/api/get':
+                self._handle_get()
+            elif route == '/api/stream':
+                self._handle_stream()
+            elif route == '/api/requests':
+                status = self._query.get('status')
+                reqs = requests_db.list_requests(
+                    RequestStatus(status) if status else None)
+                self._reply([r.to_dict() for r in reqs])
+            else:
+                self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('GET %s failed', route)
+            try:
+                self._error(HTTPStatus.INTERNAL_SERVER_ERROR,
+                            f'{type(e).__name__}: {e}')
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    def _handle_get(self) -> None:
+        """Block (bounded) until the request is terminal; client re-polls."""
+        query = self._query
+        request_id = query.get('request_id', '')
+        timeout = min(float(query.get('timeout', 15)), 30.0)
+        deadline = time.time() + timeout
+        while True:
+            request = requests_db.get(request_id)
+            if request is None:
+                self._error(HTTPStatus.NOT_FOUND,
+                            f'no request {request_id}')
+                return
+            if request.status.is_terminal() or time.time() > deadline:
+                self._reply(request.to_dict())
+                return
+            time.sleep(0.05)
+
+    def _handle_stream(self) -> None:
+        """Chunked tail of a request's log until it finishes."""
+        query = self._query
+        request_id = query.get('request_id', '')
+        follow = query.get('follow', 'true') != 'false'
+        request = requests_db.get(request_id)
+        if request is None:
+            self._error(HTTPStatus.NOT_FOUND, f'no request {request_id}')
+            return
+        log_path = requests_db.request_log_path(request.request_id)
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/plain; charset=utf-8')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+
+        def send_chunk(data: bytes) -> None:
+            self.wfile.write(f'{len(data):x}\r\n'.encode())
+            self.wfile.write(data + b'\r\n')
+
+        pos = 0
+        while True:
+            # Status first, read second: bytes written between the read and
+            # a later terminal-status check would otherwise never be sent.
+            request = requests_db.get(request_id)
+            done = request is None or request.status.is_terminal()
+            if os.path.exists(log_path):
+                with open(log_path, 'rb') as f:
+                    f.seek(pos)
+                    data = f.read()
+                if data:
+                    send_chunk(data)
+                    pos += len(data)
+            if done or not follow:
+                break
+            time.sleep(0.1)
+        send_chunk(b'')  # terminating chunk
+        self.wfile.write(b'')
+
+
+class ApiServer:
+    """Executor + HTTP server pair; in-process (tests) or main() (prod)."""
+
+    def __init__(self, host: str = '127.0.0.1',
+                 port: int = DEFAULT_PORT) -> None:
+        self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
+        self.httpd.daemon_threads = True
+        self.executor = executor_lib.Executor()
+        self.port = self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.httpd.server_address[0]
+        return f'http://{host}:{self.port}'
+
+    def start_background(self) -> None:
+        import threading
+        self.executor.start()
+        thread = threading.Thread(target=self.httpd.serve_forever,
+                                  name='api-server', daemon=True)
+        thread.start()
+
+    def serve_forever(self) -> None:
+        self.executor.start()
+        logger.info('API server listening on %s', self.url)
+        try:
+            self.httpd.serve_forever()
+        finally:
+            self.executor.shutdown()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.executor.shutdown()
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser('skypilot-tpu api server')
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args(argv)
+    os.makedirs(requests_db.server_dir(), exist_ok=True)
+    with open(os.path.join(requests_db.server_dir(), 'server.json'),
+              'w', encoding='utf-8') as f:
+        json.dump({'host': args.host, 'port': args.port,
+                   'pid': os.getpid()}, f)
+    ApiServer(args.host, args.port).serve_forever()
+
+
+if __name__ == '__main__':
+    main()

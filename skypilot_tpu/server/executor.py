@@ -1,0 +1,176 @@
+"""Async request executor: LONG/SHORT queues, one process per request.
+
+Parity: ``sky/server/requests/executor.py`` (:1-19 queue design,
+RequestWorker :175, `_get_queue` :351, `start` :1063). LONG requests
+(launch/start — hold provisioning locks for minutes) get a small dedicated
+pool so they cannot starve SHORT requests (status/logs).
+
+Each claimed request runs in a forked process with stdout/stderr redirected
+to the per-request log file; the result/error is written back to the request
+DB, so clients can disconnect and re-attach.
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+from skypilot_tpu.server import payloads, requests_db
+from skypilot_tpu.server.requests_db import (Request, RequestStatus,
+                                             ScheduleType)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.subprocess_utils import kill_process_tree
+
+logger = log.init_logger(__name__)
+
+_mp = multiprocessing.get_context('fork')
+
+DEFAULT_WORKERS = {
+    ScheduleType.LONG: int(os.environ.get('SKYT_LONG_WORKERS', '4')),
+    ScheduleType.SHORT: int(os.environ.get('SKYT_SHORT_WORKERS', '16')),
+}
+
+
+def _run_request_in_child(request_id: str) -> None:
+    """Child-process body: redirect output, run the payload, finalize."""
+    request = requests_db.get(request_id)
+    assert request is not None, request_id
+    log_path = requests_db.request_log_path(request_id)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    log_file = open(log_path, 'a', buffering=1, encoding='utf-8')
+    os.dup2(log_file.fileno(), sys.stdout.fileno())
+    os.dup2(log_file.fileno(), sys.stderr.fileno())
+    # Re-point python logging at the new fds.
+    import logging
+    for handler in logging.getLogger().handlers:
+        if isinstance(handler, logging.StreamHandler):
+            handler.stream = sys.stderr
+    requests_db.set_pid(request_id, os.getpid())
+    fn, _ = payloads.PAYLOADS[request.name]
+    try:
+        result = fn(**request.body)
+        try:
+            json.dumps(result)
+        except TypeError:
+            result = repr(result)
+        requests_db.finalize(request_id, RequestStatus.SUCCEEDED, result)
+    except BaseException as e:  # pylint: disable=broad-except
+        traceback.print_exc()
+        requests_db.finalize(request_id, RequestStatus.FAILED,
+                             error=f'{type(e).__name__}: {e}')
+    finally:
+        log_file.flush()
+
+
+class Executor:
+    """Claims PENDING requests and runs each in its own forked process."""
+
+    def __init__(self,
+                 workers: Optional[Dict[ScheduleType, int]] = None) -> None:
+        self._caps = dict(DEFAULT_WORKERS)
+        if workers:
+            self._caps.update(workers)
+        self._running: Dict[str, multiprocessing.process.BaseProcess] = {}
+        self._running_type: Dict[str, ScheduleType] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name='executor',
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._lock:
+            procs = list(self._running.values())
+        for proc in procs:
+            if proc.is_alive() and proc.pid:
+                kill_process_tree(proc.pid, signal.SIGTERM)
+
+    # ------------------------------------------------------------------
+
+    def _reap(self) -> None:
+        with self._lock:
+            done = [(rid, p) for rid, p in self._running.items()
+                    if not p.is_alive()]
+            for rid, proc in done:
+                proc.join()
+                del self._running[rid]
+                del self._running_type[rid]
+                request = requests_db.get(rid)
+                if request and not request.status.is_terminal():
+                    # Child died without finalizing (OOM/kill -9).
+                    requests_db.finalize(
+                        rid, RequestStatus.FAILED,
+                        error=f'worker exited with code {proc.exitcode}')
+
+    def _count(self, schedule_type: ScheduleType) -> int:
+        with self._lock:
+            return sum(1 for t in self._running_type.values()
+                       if t == schedule_type)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._reap()
+            claimed = False
+            for schedule_type, cap in self._caps.items():
+                while self._count(schedule_type) < cap:
+                    request = requests_db.claim_next(schedule_type)
+                    if request is None:
+                        break
+                    self._spawn(request)
+                    claimed = True
+            if not claimed:
+                self._stop.wait(0.05)
+
+    def _spawn(self, request: Request) -> None:
+        proc = _mp.Process(target=_run_request_in_child,
+                           args=(request.request_id,),
+                           name=f'req-{request.request_id[:8]}')
+        proc.start()
+        with self._lock:
+            self._running[request.request_id] = proc
+            self._running_type[request.request_id] = request.schedule_type
+        logger.debug('Request %s (%s) -> pid %s', request.request_id[:8],
+                     request.name, proc.pid)
+
+
+def cancel_request(request_id: str) -> bool:
+    """Cancel a pending or running request (parity: /api/cancel)."""
+    request = requests_db.get(request_id)
+    if request is None or request.status.is_terminal():
+        return False
+    if request.status == RequestStatus.RUNNING and not request.pid:
+        # Claimed but the forked child hasn't recorded its pid yet; wait
+        # briefly so we kill the work instead of just flipping the status.
+        deadline = time.time() + 2
+        while time.time() < deadline and not request.pid:
+            time.sleep(0.05)
+            request = requests_db.get(request_id)
+            if request is None or request.status.is_terminal():
+                return False
+    if request.status == RequestStatus.RUNNING and request.pid:
+        kill_process_tree(request.pid, signal.SIGTERM)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                os.kill(request.pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            kill_process_tree(request.pid, signal.SIGKILL)
+    requests_db.finalize(request.request_id, RequestStatus.CANCELLED,
+                         error='cancelled by user')
+    return True

@@ -1,0 +1,64 @@
+"""Request entrypoints the executor can run, by name.
+
+Each payload runs inside a dedicated worker process with stdout/stderr
+redirected to the request's log file (streamed to clients via
+``/api/stream``). Parity: the core functions `sky/server/server.py`
+endpoints wrap (launch :1772, etc.).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import core, execution
+from skypilot_tpu.server.requests_db import ScheduleType
+from skypilot_tpu.spec.task import Task
+
+
+def _launch(task_config: Dict[str, Any],
+            cluster_name: Optional[str] = None,
+            dryrun: bool = False,
+            down: bool = False,
+            detach_run: bool = False) -> List[Tuple[str, Optional[int]]]:
+    task = Task.from_yaml_config(task_config)
+    return execution.launch(task,
+                            cluster_name,
+                            dryrun=dryrun,
+                            down=down,
+                            detach_run=detach_run)
+
+
+def _exec(task_config: Dict[str, Any],
+          cluster_name: str,
+          detach_run: bool = False) -> List[Tuple[str, Optional[int]]]:
+    task = Task.from_yaml_config(task_config)
+    return execution.exec_(task, cluster_name, detach_run=detach_run)
+
+
+def _logs(cluster_name: str,
+          job_id: Optional[int] = None,
+          follow: bool = False) -> None:
+    # Streamed: print to the request log, which /api/stream tails.
+    print(core.tail_logs(cluster_name, job_id, follow=follow), end='')
+
+
+def _check() -> Dict[str, Any]:
+    from skypilot_tpu import check
+    return check.check()
+
+
+# name -> (callable, schedule type). LONG = holds cloud resources/locks for
+# minutes (parity: executor.py queue split).
+PAYLOADS: Dict[str, Tuple[Callable[..., Any], ScheduleType]] = {
+    'launch': (_launch, ScheduleType.LONG),
+    'exec': (_exec, ScheduleType.LONG),
+    'start': (core.start, ScheduleType.LONG),
+    'stop': (core.stop, ScheduleType.SHORT),
+    'down': (core.down, ScheduleType.SHORT),
+    'status': (core.status, ScheduleType.SHORT),
+    'queue': (core.queue, ScheduleType.SHORT),
+    'cancel': (core.cancel, ScheduleType.SHORT),
+    'logs': (_logs, ScheduleType.SHORT),
+    'autostop': (core.autostop, ScheduleType.SHORT),
+    'cost_report': (core.cost_report, ScheduleType.SHORT),
+    'check': (_check, ScheduleType.SHORT),
+}

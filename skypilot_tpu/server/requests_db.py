@@ -1,0 +1,214 @@
+"""Persistent request table for the API server.
+
+Parity: ``sky/server/requests/requests.py`` — every SDK call becomes a row
+here; clients poll ``/api/get`` or stream logs later, surviving client and
+server restarts.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+class ScheduleType(enum.Enum):
+    """LONG requests (launch/start) get few dedicated workers; SHORT
+    requests (status/logs) get many (parity: executor.py:1-19)."""
+    LONG = 'LONG'
+    SHORT = 'SHORT'
+
+
+def server_dir() -> str:
+    d = os.environ.get(
+        'SKYT_SERVER_DIR',
+        os.path.join(
+            os.environ.get('SKYT_STATE_DIR',
+                           os.path.expanduser('~/.skyt')), 'server'))
+    return d
+
+
+def request_log_path(request_id: str) -> str:
+    return os.path.join(server_dir(), 'logs', f'{request_id}.log')
+
+
+_local = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(server_dir(), 'requests.db')
+    conn = getattr(_local, 'conn', None)
+    # Re-open after fork: reusing a parent's sqlite connection across
+    # processes corrupts the DB (executor workers are forked mid-claim).
+    if (conn is not None and getattr(_local, 'path', None) == path and
+            getattr(_local, 'pid', None) == os.getpid()):
+        return conn
+    os.makedirs(server_dir(), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS requests (
+            request_id TEXT PRIMARY KEY,
+            name TEXT NOT NULL,            -- entrypoint name, e.g. 'launch'
+            body TEXT NOT NULL,            -- JSON kwargs
+            status TEXT NOT NULL,
+            schedule_type TEXT NOT NULL,
+            return_value TEXT,             -- JSON
+            error TEXT,
+            pid INTEGER,
+            user TEXT,
+            created_at REAL,
+            finished_at REAL
+        );
+        CREATE INDEX IF NOT EXISTS idx_requests_status
+            ON requests (status, schedule_type);
+    """)
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    _local.pid = os.getpid()
+    return conn
+
+
+class Request:
+    def __init__(self, row: sqlite3.Row) -> None:
+        self.request_id: str = row['request_id']
+        self.name: str = row['name']
+        self.body: Dict[str, Any] = json.loads(row['body'])
+        self.status = RequestStatus(row['status'])
+        self.schedule_type = ScheduleType(row['schedule_type'])
+        self.return_value = (json.loads(row['return_value'])
+                             if row['return_value'] else None)
+        self.error: Optional[str] = row['error']
+        self.pid: Optional[int] = row['pid']
+        self.user: Optional[str] = row['user']
+        self.created_at: Optional[float] = row['created_at']
+        self.finished_at: Optional[float] = row['finished_at']
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'request_id': self.request_id,
+            'name': self.name,
+            'body': self.body,
+            'status': self.status.value,
+            'return_value': self.return_value,
+            'error': self.error,
+            'pid': self.pid,
+            'user': self.user,
+            'created_at': self.created_at,
+            'finished_at': self.finished_at,
+        }
+
+
+def create(name: str,
+           body: Dict[str, Any],
+           schedule_type: ScheduleType,
+           user: Optional[str] = None) -> str:
+    request_id = common_utils.new_request_id()
+    conn = _db()
+    conn.execute(
+        'INSERT INTO requests (request_id, name, body, status, '
+        'schedule_type, user, created_at) VALUES (?, ?, ?, ?, ?, ?, ?)',
+        (request_id, name, json.dumps(body), RequestStatus.PENDING.value,
+         schedule_type.value, user or common_utils.get_user(), time.time()))
+    conn.commit()
+    return request_id
+
+
+def get(request_id: str) -> Optional[Request]:
+    # Support unambiguous request-id prefixes, like git SHAs / sky requests.
+    rows = _db().execute(
+        'SELECT * FROM requests WHERE request_id LIKE ? '
+        'ORDER BY created_at DESC LIMIT 2',
+        (request_id + '%',)).fetchall()
+    if len(rows) == 1 or (rows and rows[0]['request_id'] == request_id):
+        return Request(rows[0])
+    return None
+
+
+def list_requests(status: Optional[RequestStatus] = None,
+                  limit: int = 100) -> List[Request]:
+    if status is None:
+        rows = _db().execute(
+            'SELECT * FROM requests ORDER BY created_at DESC LIMIT ?',
+            (limit,)).fetchall()
+    else:
+        rows = _db().execute(
+            'SELECT * FROM requests WHERE status = ? '
+            'ORDER BY created_at DESC LIMIT ?',
+            (status.value, limit)).fetchall()
+    return [Request(r) for r in rows]
+
+
+def claim_next(schedule_type: ScheduleType) -> Optional[Request]:
+    """Atomically pop the oldest PENDING request of this type."""
+    conn = _db()
+    with _claim_lock:
+        row = conn.execute(
+            'SELECT * FROM requests WHERE status = ? AND schedule_type = ? '
+            'ORDER BY created_at LIMIT 1',
+            (RequestStatus.PENDING.value, schedule_type.value)).fetchone()
+        if row is None:
+            return None
+        cur = conn.execute(
+            'UPDATE requests SET status = ? WHERE request_id = ? '
+            'AND status = ?',
+            (RequestStatus.RUNNING.value, row['request_id'],
+             RequestStatus.PENDING.value))
+        conn.commit()
+        if cur.rowcount != 1:
+            return None
+    return get(row['request_id'])
+
+
+_claim_lock = threading.Lock()
+
+
+def set_pid(request_id: str, pid: int) -> None:
+    conn = _db()
+    conn.execute('UPDATE requests SET pid = ? WHERE request_id = ?',
+                 (pid, request_id))
+    conn.commit()
+
+
+def finalize(request_id: str,
+             status: RequestStatus,
+             return_value: Any = None,
+             error: Optional[str] = None) -> bool:
+    """First terminal writer wins: a worker finishing after /api/cancel
+    must not overwrite CANCELLED (and vice versa)."""
+    conn = _db()
+    cur = conn.execute(
+        'UPDATE requests SET status = ?, return_value = ?, error = ?, '
+        'finished_at = ? WHERE request_id = ? AND status IN (?, ?)',
+        (status.value, json.dumps(return_value), error, time.time(),
+         request_id, RequestStatus.PENDING.value,
+         RequestStatus.RUNNING.value))
+    conn.commit()
+    return cur.rowcount == 1
+
+
+def reset_db_for_tests() -> None:
+    conn = getattr(_local, 'conn', None)
+    if conn is not None:
+        conn.close()
+        _local.conn = None
+        _local.path = None

@@ -1,0 +1,114 @@
+"""Client-server tests: SDK -> HTTP -> executor -> core ops, in-process
+server (the reference tests its API server with FastAPI's testclient via
+``mock_client_requests``, tests/common_test_fixtures.py:58; here the real
+HTTP server runs on a loopback port with the real process-pool executor)."""
+import io
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.client import sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture()
+def server(tmp_home, monkeypatch):
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)  # ephemeral port
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+
+
+def _tpu_task(run='echo hi', accel='tpu-v5e-8', **kw):
+    return Task(name='t', run=run,
+                resources=Resources(cloud='fake', accelerators=accel), **kw)
+
+
+def test_health_and_autostart_detection(server):
+    assert sdk.api_is_healthy()
+    assert sdk.ensure_api_server() == server.url
+
+
+def test_launch_via_sdk_async_contract(server):
+    request_id = sdk.launch(_tpu_task(
+        'echo "rank=$TPU_WORKER_ID"'), 'api-e2e')
+    # Submission returns immediately with an id; get() blocks to the result.
+    assert isinstance(request_id, str) and len(request_id) == 32
+    result = sdk.get(request_id, timeout=120)
+    assert result == [['api-e2e', 1]]
+
+    # Cluster is UP server-side; status round-trips through the SHORT queue.
+    records = sdk.get(sdk.status(), timeout=60)
+    assert [r['name'] for r in records] == ['api-e2e']
+    assert records[0]['status'] == 'UP'
+
+    # Job queue + logs through the server.
+    jobs = sdk.get(sdk.queue('api-e2e'), timeout=60)
+    assert jobs[0]['status'] == 'SUCCEEDED'
+    buf = io.StringIO()
+    sdk.stream_and_get(sdk.tail_logs('api-e2e', 1), output=buf)
+    assert 'rank=0' in buf.getvalue()
+
+    sdk.get(sdk.down('api-e2e'), timeout=60)
+    assert sdk.get(sdk.status(), timeout=60) == []
+
+
+def test_request_failure_propagates(server):
+    request_id = sdk.queue('no-such-cluster')
+    with pytest.raises(exceptions.RequestFailedError) as err:
+        sdk.get(request_id, timeout=60)
+    assert 'no-such-cluster' in str(err.value)
+
+
+def test_provision_logs_streamed(server):
+    request_id = sdk.launch(_tpu_task(), 'stream-e2e')
+    buf = io.StringIO()
+    result = sdk.stream_and_get(request_id, output=buf)
+    assert result == [['stream-e2e', 1]]
+    # Provisioning progress from the worker process reached the client.
+    assert 'stream-e2e' in buf.getvalue()
+
+
+def test_cancel_pending_request(server, monkeypatch):
+    # Block the LONG queue with a slow fault so the next request stays
+    # PENDING long enough to cancel.
+    fake.inject_slow_create(3)
+    first = sdk.launch(_tpu_task(), 'slow-1')
+    time.sleep(0.3)
+    second = sdk.launch(_tpu_task(), 'slow-2')
+    # Cancel the second while queued or early-running.
+    assert sdk.api_cancel(second)
+    with pytest.raises(exceptions.RequestCancelledError):
+        sdk.get(second, timeout=30)
+    fake.clear_faults()
+    sdk.get(first, timeout=120)
+
+
+def test_request_id_prefix_lookup(server):
+    request_id = sdk.status()
+    sdk.get(request_id, timeout=60)
+    short = request_id[:12]
+    assert sdk.get(short, timeout=60) is not None
+
+
+def test_workdir_upload_content_addressed(server, tmp_path):
+    workdir = tmp_path / 'proj'
+    workdir.mkdir()
+    (workdir / 'data.txt').write_text('uploaded-data')
+    task = _tpu_task('cat data.txt', workdir=str(workdir))
+    result = sdk.stream_and_get(sdk.launch(task, 'up-e2e'),
+                                output=io.StringIO())
+    assert result == [['up-e2e', 1]]
+    buf = io.StringIO()
+    sdk.stream_and_get(sdk.tail_logs('up-e2e', 1), output=buf)
+    assert 'uploaded-data' in buf.getvalue()
