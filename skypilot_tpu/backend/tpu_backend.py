@@ -41,17 +41,19 @@ class TpuPodBackend(Backend):
 
     def provision(self, task: Task, cluster_name: str, *,
                   retry_until_up: bool = False,
-                  dryrun: bool = False) -> Optional[ClusterInfo]:
+                  dryrun: bool = False,
+                  blocklist=None) -> Optional[ClusterInfo]:
         candidates = Optimizer.plan_task(task)
         if dryrun:
             logger.info('Dryrun: would provision %s', candidates[0])
             return None
         with locks.cluster_lock(cluster_name):
-            return self._provision_locked(task, cluster_name, candidates)
+            return self._provision_locked(task, cluster_name, candidates,
+                                          blocklist=blocklist)
 
     def _provision_locked(self, task: Task, cluster_name: str,
-                          candidates: List[Candidate]
-                          ) -> ClusterInfo:
+                          candidates: List[Candidate],
+                          blocklist=None) -> ClusterInfo:
         record = state.get_cluster(cluster_name)
         if record is not None and record.status == state.ClusterStatus.UP:
             info = ClusterInfo.from_dict(record.handle)
@@ -74,7 +76,8 @@ class TpuPodBackend(Backend):
             cluster_name, status=state.ClusterStatus.INIT,
             num_nodes=task.num_nodes)
         info, chosen = provision_with_failover(
-            cluster_name, candidates, task.num_nodes, resume=resume)
+            cluster_name, candidates, task.num_nodes, resume=resume,
+            blocklist=blocklist)
         autostop = chosen.resources.autostop
         state.add_or_update_cluster(
             cluster_name,

@@ -218,6 +218,52 @@ def cost_report() -> None:
                 ['name', 'status', 'hourly_cost', 'accumulated_cost'])
 
 
+# -- managed jobs ------------------------------------------------------
+
+
+@cli.group()
+def jobs() -> None:
+    """Managed jobs with automatic preemption recovery."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint', required=True)
+@click.option('--name', '-n', default=None)
+def jobs_launch(entrypoint: str, name: Optional[str]) -> None:
+    """Submit a managed job (launch-and-forget with recovery)."""
+    task = Task.from_yaml(entrypoint)
+    job_id = _run(sdk.jobs_launch(task, name), False, stream=False)
+    click.echo(f'Managed job {job_id} submitted. '
+               f'`skyt jobs logs {job_id}` to tail.')
+
+
+@jobs.command('queue')
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def jobs_queue(skip_finished: bool) -> None:
+    """List managed jobs."""
+    rows = _run(sdk.jobs_queue(skip_finished), False, stream=False)
+    _echo_table(rows or [],
+                ['job_id', 'name', 'status', 'cluster_name',
+                 'recovery_count', 'strategy'])
+
+
+@jobs.command('cancel')
+@click.argument('job_id', type=int)
+def jobs_cancel(job_id: int) -> None:
+    """Cancel a managed job (tears its cluster down)."""
+    ok = _run(sdk.jobs_cancel(job_id), False, stream=False)
+    click.echo('Cancellation requested.' if ok else 'Already finished.')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int)
+@click.option('--controller', is_flag=True, default=False,
+              help="Show the controller's log instead of the job's.")
+def jobs_logs(job_id: int, controller: bool) -> None:
+    """Show a managed job's logs."""
+    _run(sdk.jobs_logs(job_id, controller=controller), False)
+
+
 # -- api server control ------------------------------------------------
 
 

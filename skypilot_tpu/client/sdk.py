@@ -285,3 +285,25 @@ def cost_report() -> RequestId:
 
 def check() -> RequestId:
     return _post('check', {})
+
+
+# -- managed jobs ------------------------------------------------------
+
+
+def jobs_launch(task: Union[Task, Dag],
+                name: Optional[str] = None) -> RequestId:
+    configs = _task_configs(task)
+    assert len(configs) == 1, 'chain DAGs: launch tasks individually'
+    return _post('jobs/launch', {'task_config': configs[0], 'name': name})
+
+
+def jobs_queue(skip_finished: bool = False) -> RequestId:
+    return _post('jobs/queue', {'skip_finished': skip_finished})
+
+
+def jobs_cancel(job_id: int) -> RequestId:
+    return _post('jobs/cancel', {'job_id': job_id})
+
+
+def jobs_logs(job_id: int, controller: bool = False) -> RequestId:
+    return _post('jobs/logs', {'job_id': job_id, 'controller': controller})

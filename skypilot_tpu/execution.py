@@ -47,7 +47,8 @@ def launch(task_or_dag: Union[Task, Dag],
            stages: Optional[List[Stage]] = None,
            down: bool = False,
            detach_run: bool = False,
-           backend: Optional[TpuPodBackend] = None
+           backend: Optional[TpuPodBackend] = None,
+           provision_blocklist=None,
            ) -> List[Tuple[str, Optional[int]]]:
     """Provision (if needed) + run every task of the DAG.
 
@@ -70,19 +71,22 @@ def launch(task_or_dag: Union[Task, Dag],
         results.append(
             _execute_task(task, name, backend, stages,
                           dryrun=dryrun, stream_logs=stream_logs,
-                          down=down, detach_run=detach_run))
+                          down=down, detach_run=detach_run,
+                          provision_blocklist=provision_blocklist))
     return results
 
 
 def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
                   stages: List[Stage], *, dryrun: bool, stream_logs: bool,
-                  down: bool, detach_run: bool
+                  down: bool, detach_run: bool,
+                  provision_blocklist=None,
                   ) -> Tuple[str, Optional[int]]:
     if Stage.OPTIMIZE in stages and task.best_resources is None:
         Optimizer.optimize(Dag.from_task(task))
     info = None
     if Stage.PROVISION in stages:
-        info = backend.provision(task, cluster_name, dryrun=dryrun)
+        info = backend.provision(task, cluster_name, dryrun=dryrun,
+                                 blocklist=provision_blocklist)
         if dryrun:
             return cluster_name, None
     if info is None:

@@ -1,0 +1,9 @@
+"""Managed jobs: launch-and-forget jobs with preemption recovery.
+
+Parity: ``sky/jobs/`` (16k LoC) — a controller per job monitors the
+worker cluster, detects preemption/failure, and relaunches via a recovery
+strategy (FAILOVER / EAGER_NEXT_REGION); a scheduler bounds controller
+concurrency (jobs/scheduler.py:1-43). The TPU flavor: spot pod slices are
+preempted as a unit, so recovery is always whole-slice relaunch +
+checkpoint-resume from GCS.
+"""

@@ -1,0 +1,215 @@
+"""Per-job controller: launch → monitor → recover → cleanup.
+
+Parity: ``sky/jobs/controller.py`` (JobController :152). Runs as a
+detached process (`python -m skypilot_tpu.jobs.controller --job-id N`)
+spawned by the scheduler. The monitor loop watches two signals:
+
+* the cluster job's status in the on-cluster job table (user-code
+  success/failure), and
+* cluster health from the provider (spot preemption: a TPU slice
+  vanishes as a unit).
+
+On preemption it enters RECOVERING and delegates to the job's recovery
+strategy; on user-code failure it restarts in place up to
+``max_restarts_on_errors`` times (ref recovery_strategy.py:92).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.provision.api import ClusterInfo, get_provider
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+POLL_SECONDS = float(os.environ.get('SKYT_JOBS_CONTROLLER_POLL', '10'))
+
+
+class JobController:
+    def __init__(self, job_id: int) -> None:
+        record = jobs_state.get(job_id)
+        assert record is not None, f'managed job {job_id} not in DB'
+        self.job_id = job_id
+        self.record = record
+        self.task = Task.from_yaml_config(record.task_config)
+        self.cluster_name = (record.cluster_name or
+                             f'{record.name or "job"}-{job_id}')
+        jobs_state.set_cluster_name(job_id, self.cluster_name)
+        self.strategy = StrategyExecutor.make(record.strategy, job_id,
+                                              self.task, self.cluster_name)
+        self.backend = TpuPodBackend()
+        self.restarts_left = record.max_restarts_on_errors
+
+    # -- cluster probes ------------------------------------------------
+
+    def _cluster_info(self) -> Optional[ClusterInfo]:
+        record = state.get_cluster(self.cluster_name)
+        if record is None or record.status != state.ClusterStatus.UP:
+            return None
+        return ClusterInfo.from_dict(record.handle)
+
+    def _cluster_healthy(self) -> bool:
+        record = state.get_cluster(self.cluster_name)
+        if record is None or record.cloud is None:
+            return False
+        try:
+            states = get_provider(record.cloud).query_instances(
+                self.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return False
+        return bool(states) and set(states.values()) == {'running'}
+
+    def _job_status(self, cluster_job_id: int) -> Optional[str]:
+        """Status string from the on-cluster job table, None if
+        unreachable."""
+        info = self._cluster_info()
+        if info is None:
+            return None
+        try:
+            for job in self.backend.queue(info):
+                if job['job_id'] == cluster_job_id:
+                    return job['status']
+        except Exception:  # pylint: disable=broad-except
+            return None
+        return None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _finalize(self, status: ManagedJobStatus,
+                  reason: Optional[str] = None,
+                  teardown: bool = True) -> None:
+        if teardown:
+            try:
+                self.backend.teardown(self.cluster_name, terminate=True)
+            except exceptions.ClusterDoesNotExist:
+                pass
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('Cleanup teardown failed: %s', e)
+        jobs_state.set_status(self.job_id, status, failure_reason=reason)
+        logger.info('Managed job %s: %s', self.job_id, status.value)
+
+    def _recover(self) -> Optional[int]:
+        if jobs_state.cancel_requested(self.job_id):
+            self._finalize(ManagedJobStatus.CANCELLED)
+            return None
+        jobs_state.set_status(self.job_id, ManagedJobStatus.RECOVERING)
+        jobs_state.bump_recovery(self.job_id)
+        try:
+            cluster_job_id = self.strategy.recover()
+        except exceptions.ResourcesUnavailableError as e:
+            self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            return None
+        jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
+        return cluster_job_id
+
+    def run(self) -> None:
+        jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
+        try:
+            cluster_job_id = self.strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            scheduler.launch_done(self.job_id)
+            self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            return
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('Managed job %s: launch failed', self.job_id)
+            scheduler.launch_done(self.job_id)
+            self._finalize(ManagedJobStatus.FAILED_SETUP,
+                           f'{type(e).__name__}: {e}')
+            return
+        scheduler.launch_done(self.job_id)
+        jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
+
+        while True:
+            time.sleep(POLL_SECONDS)
+            if jobs_state.cancel_requested(self.job_id):
+                info = self._cluster_info()
+                if info is not None and cluster_job_id is not None:
+                    try:
+                        self.backend.cancel(info, cluster_job_id)
+                    except Exception:  # pylint: disable=broad-except
+                        pass
+                self._finalize(ManagedJobStatus.CANCELLED)
+                return
+
+            job_status = self._job_status(cluster_job_id)
+            if job_status == 'SUCCEEDED':
+                self._finalize(ManagedJobStatus.SUCCEEDED)
+                return
+            if job_status == 'FAILED':
+                # User code failed on a healthy cluster: restart in place
+                # if budget remains (ref max_restarts_on_errors).
+                if self.restarts_left > 0:
+                    info = self._cluster_info()
+                    if info is None or not self._cluster_healthy():
+                        # Cluster died between the failure and the restart:
+                        # this is a preemption, not a user-code retry.
+                        cluster_job_id = self._recover()
+                        if cluster_job_id is None:
+                            return
+                        continue
+                    self.restarts_left -= 1
+                    logger.info(
+                        'Managed job %s: task failed; restarting in place '
+                        '(%d restarts left).', self.job_id,
+                        self.restarts_left)
+                    jobs_state.set_status(self.job_id,
+                                          ManagedJobStatus.RECOVERING)
+                    jobs_state.bump_recovery(self.job_id)
+                    cluster_job_id = self.backend.execute(info, self.task,
+                                                          detach=True)
+                    jobs_state.set_status(self.job_id,
+                                          ManagedJobStatus.RUNNING)
+                    continue
+                self._finalize(ManagedJobStatus.FAILED,
+                               'task exited non-zero')
+                return
+            if job_status == 'CANCELLED':
+                self._finalize(ManagedJobStatus.CANCELLED)
+                return
+            if job_status in ('PENDING', 'SETTING_UP', 'RUNNING'):
+                if not self._cluster_healthy():
+                    # Preempted mid-run (TPU slices vanish as a unit).
+                    logger.warning(
+                        'Managed job %s: cluster %s unhealthy; '
+                        'recovering.', self.job_id, self.cluster_name)
+                    cluster_job_id = self._recover()
+                    if cluster_job_id is None:
+                        return
+                continue
+            # Job table unreachable: the cluster is gone.
+            logger.warning('Managed job %s: lost cluster %s; recovering.',
+                           self.job_id, self.cluster_name)
+            cluster_job_id = self._recover()
+            if cluster_job_id is None:
+                return
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser('managed-job controller')
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args(argv)
+    controller = JobController(args.job_id)
+    try:
+        controller.run()
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('Controller for job %s crashed', args.job_id)
+        jobs_state.set_status(args.job_id,
+                              ManagedJobStatus.FAILED_CONTROLLER,
+                              failure_reason='controller crashed')
+        raise
+    finally:
+        scheduler.job_done(args.job_id)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,84 @@
+"""Managed-jobs API: launch/queue/cancel/logs.
+
+Parity: ``sky/jobs/server/core.py`` (launch :657, queue, cancel,
+tail_logs). Submission writes the job row and kicks the scheduler; all
+heavy lifting happens in the detached controller process.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+def launch(task: Task, name: Optional[str] = None) -> int:
+    """Submit a managed job; returns its job id immediately."""
+    resources = task.resources[0] if task.resources else None
+    strategy = 'FAILOVER'
+    max_restarts = 0
+    if resources is not None and resources.job_recovery:
+        recovery = resources.job_recovery
+        if isinstance(recovery, str):
+            strategy = recovery
+        else:
+            strategy = recovery.get('strategy') or 'FAILOVER'
+            max_restarts = int(recovery.get('max_restarts_on_errors', 0))
+    job_id = jobs_state.submit(task.to_yaml_config(),
+                               name or task.name,
+                               strategy=strategy,
+                               max_restarts_on_errors=max_restarts)
+    logger.info('Managed job %s submitted (strategy=%s).', job_id,
+                strategy)
+    scheduler.maybe_schedule_next_jobs()
+    return job_id
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    scheduler.reap_dead_controllers()
+    return [r.to_dict() for r in jobs_state.list_jobs(skip_finished)]
+
+
+def cancel(job_id: int) -> bool:
+    """Request cancellation; the controller tears the cluster down."""
+    record = jobs_state.get(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'No managed job {job_id}.')
+    if record.schedule_state == jobs_state.ScheduleState.WAITING:
+        # No controller yet: cancel directly.
+        if jobs_state.request_cancel(job_id):
+            jobs_state.set_status(job_id,
+                                  jobs_state.ManagedJobStatus.CANCELLED)
+            jobs_state.set_schedule_state(job_id,
+                                          jobs_state.ScheduleState.DONE)
+            return True
+        return False
+    return jobs_state.request_cancel(job_id)
+
+
+def tail_logs(job_id: int, controller: bool = False) -> str:
+    """The job's run logs (or its controller's log with
+    ``controller=True``)."""
+    record = jobs_state.get(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'No managed job {job_id}.')
+    if controller:
+        path = jobs_state.controller_log_path(job_id)
+        if not os.path.exists(path):
+            return ''
+        with open(path, encoding='utf-8') as f:
+            return f.read()
+    if record.cluster_name is None:
+        return ''
+    from skypilot_tpu import core as sky_core
+    try:
+        return sky_core.tail_logs(record.cluster_name)
+    except exceptions.SkytError:
+        return (f'(cluster {record.cluster_name} is gone; '
+                f'job status: {record.status.value})\n')

@@ -1,0 +1,173 @@
+"""Recovery strategies: how a managed job relaunches after preemption.
+
+Parity: ``sky/jobs/recovery_strategy.py`` (StrategyExecutor :75,
+FailoverStrategyExecutor :842, EagerFailoverStrategyExecutor :963),
+registered in JOBS_RECOVERY_STRATEGY_REGISTRY (sky/__init__.py:146).
+
+TPU semantics: a preempted spot pod slice disappears as a unit, so
+"recover" is always teardown + full relaunch; the job then resumes from
+its GCS checkpoint (the checkpoint-resume pattern, SURVEY.md §5). The
+two strategies differ only in *where* they retry first:
+
+* FAILOVER — retry the same region first (capacity often returns within
+  minutes), then widen with the preempted zone blocklisted.
+* EAGER_NEXT_REGION — blocklist the whole region immediately (cross-region
+  stockouts are correlated for TPU pods; eagerly pay the egress).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+from skypilot_tpu import exceptions, execution, state
+from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+from skypilot_tpu.provision.provisioner import Blocklist
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
+
+logger = log.init_logger(__name__)
+
+# Initial-launch retry cadence on full stockout (env-tunable for tests;
+# the reference backs off up to RETRY_INIT_GAP_SECONDS=60).
+LAUNCH_RETRY_GAP_SECONDS = float(
+    os.environ.get('SKYT_JOBS_LAUNCH_RETRY_GAP', '20'))
+MAX_LAUNCH_RETRIES = int(os.environ.get('SKYT_JOBS_MAX_LAUNCH_RETRIES',
+                                        '30'))
+
+
+class StrategyExecutor:
+    """Drives launch/recover for one managed job (ref :75)."""
+
+    def __init__(self, job_id: int, task: Task, cluster_name: str) -> None:
+        self.job_id = job_id
+        self.task = task
+        self.cluster_name = cluster_name
+        self.backend = TpuPodBackend()
+        self.blocklist = Blocklist()
+
+    @classmethod
+    def make(cls, strategy: Optional[str], job_id: int, task: Task,
+             cluster_name: str) -> 'StrategyExecutor':
+        name = (strategy or 'FAILOVER').upper()
+        strategy_cls = JOBS_RECOVERY_STRATEGY_REGISTRY.get(name)
+        return strategy_cls(job_id, task, cluster_name)
+
+    # ------------------------------------------------------------------
+
+    def launch(self) -> int:
+        """Initial launch: retry on stockout with a gap until resources
+        appear (parity: StrategyExecutor._launch retry loop)."""
+        return self._launch_with_retries(self.blocklist)
+
+    def recover(self) -> int:
+        """Relaunch after preemption/failure. Returns the new cluster job
+        id. Subclasses choose the blocklist seeding."""
+        raise NotImplementedError
+
+    def _relaunch_once(self, blocklist: Blocklist) -> int:
+        """One launch attempt with the given blocklist (no retry loop)."""
+        results = execution.launch(self.task,
+                                   self.cluster_name,
+                                   detach_run=True,
+                                   backend=self.backend,
+                                   provision_blocklist=blocklist)
+        job_id = results[0][1]
+        assert job_id is not None
+        return job_id
+
+    # ------------------------------------------------------------------
+
+    def _current_location(self) -> Optional[Tuple[str, str, Optional[str]]]:
+        record = state.get_cluster(self.cluster_name)
+        if record is None or record.cloud is None:
+            return None
+        return (record.cloud, record.region, record.zone)
+
+    def _teardown(self) -> None:
+        try:
+            self.backend.teardown(self.cluster_name, terminate=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Teardown of %s failed: %s', self.cluster_name,
+                           e)
+            # The cloud may have already reclaimed it (preemption).
+            state.remove_cluster(self.cluster_name)
+
+    def _launch_with_retries(self, blocklist: Blocklist) -> int:
+        backoff = common_utils.Backoff(LAUNCH_RETRY_GAP_SECONDS,
+                                       LAUNCH_RETRY_GAP_SECONDS * 10)
+        for attempt in range(MAX_LAUNCH_RETRIES):
+            try:
+                return self._relaunch_once(blocklist)
+            except exceptions.ResourcesUnavailableError as e:
+                logger.info(
+                    'Job %s: no resources anywhere (attempt %d/%d): %s',
+                    self.job_id, attempt + 1, MAX_LAUNCH_RETRIES, e)
+                # Full stockout: clear location blocklists (stockouts are
+                # transient) and wait for capacity.
+                blocklist.zones.clear()
+                blocklist.regions.clear()
+                time.sleep(backoff.current_backoff())
+        raise exceptions.ResourcesUnavailableError(
+            f'Managed job {self.job_id}: exhausted {MAX_LAUNCH_RETRIES} '
+            'launch attempts across all locations.')
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register('FAILOVER')
+class FailoverStrategy(StrategyExecutor):
+    """Retry the same region first, then fail over (ref :842)."""
+
+    def recover(self) -> int:
+        location = self._current_location()
+        self._teardown()
+        widened = Blocklist()
+        if location is not None:
+            cloud, region, zone = location
+            # First pass: pin to the previous region (cheap, data local).
+            pinned = Blocklist()
+            pinned.regions.update(
+                {(cloud, r)
+                 for r in _other_regions(self.task, cloud, region)})
+            try:
+                return self._relaunch_once(pinned)
+            except exceptions.ResourcesUnavailableError:
+                logger.info('Job %s: previous region %s has no capacity; '
+                            'widening failover.', self.job_id, region)
+            # Widened pass: everywhere except the just-preempted zone
+            # (its capacity was literally just reclaimed).
+            if zone is not None:
+                widened.zones.add((cloud, zone))
+        return self._launch_with_retries(widened)
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register('EAGER_NEXT_REGION')
+class EagerNextRegionStrategy(StrategyExecutor):
+    """Blocklist the preempted region immediately (ref :963)."""
+
+    def recover(self) -> int:
+        location = self._current_location()
+        self._teardown()
+        blocklist = Blocklist()
+        if location is not None:
+            cloud, region, _zone = location
+            blocklist.regions.add((cloud, region))
+        try:
+            return self._relaunch_once(blocklist)
+        except exceptions.ResourcesUnavailableError:
+            # Every other region is out too; allow the original again.
+            return self._launch_with_retries(Blocklist())
+
+
+def _other_regions(task: Task, cloud: str, keep_region: str) -> list:
+    """All candidate regions except `keep_region` (to pin a relaunch)."""
+    from skypilot_tpu.optimizer import Optimizer
+    regions = set()
+    for candidate in Optimizer.plan_task(task):
+        if candidate.resources.cloud == cloud:
+            regions.add(candidate.resources.region)
+    regions.discard(keep_region)
+    return sorted(regions)

@@ -1,0 +1,81 @@
+"""Managed-job scheduler: bounds concurrent controllers.
+
+Parity: ``sky/jobs/scheduler.py`` (:1-43 docstring — launching is limited
+because provisioning holds locks and cloud quota; alive is limited by
+controller memory). Controllers here are detached local processes (one per
+job); the controller-as-a-dedicated-cluster deployment mode layers on top
+the same way the reference's jobs controller runs on a SkyPilot cluster.
+
+Anyone may call ``maybe_schedule_next_jobs()`` — on submit, on controller
+state transitions, and on queue inspection — it is an idempotent
+claim-and-spawn loop over the WAITING jobs.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import psutil
+
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import log, subprocess_utils
+
+logger = log.init_logger(__name__)
+
+
+def _max_launching() -> int:
+    return int(os.environ.get('SKYT_JOBS_MAX_LAUNCHING', '8'))
+
+
+def _max_alive() -> int:
+    return int(os.environ.get('SKYT_JOBS_MAX_ALIVE', '64'))
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Claim WAITING jobs into LAUNCHING slots and spawn controllers."""
+    while True:
+        job_id = jobs_state.claim_waiting_job(_max_launching(),
+                                              _max_alive())
+        if job_id is None:
+            return
+        log_path = jobs_state.controller_log_path(job_id)
+        pid = subprocess_utils.daemonize_and_run(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            log_path=log_path)
+        jobs_state.set_controller_pid(job_id, pid)
+        logger.info('Managed job %s: controller pid %s', job_id, pid)
+
+
+def launch_done(job_id: int) -> None:
+    """LAUNCHING -> ALIVE: frees a launching slot (called by the
+    controller once provisioning finished or conclusively failed)."""
+    jobs_state.set_schedule_state(job_id, jobs_state.ScheduleState.ALIVE)
+    maybe_schedule_next_jobs()
+
+
+def job_done(job_id: int) -> None:
+    """-> DONE: frees all slots for this job."""
+    jobs_state.set_schedule_state(job_id, jobs_state.ScheduleState.DONE)
+    maybe_schedule_next_jobs()
+
+
+def reap_dead_controllers() -> None:
+    """Mark jobs whose controller process died as FAILED_CONTROLLER
+    (parity: controller HA watchdog; run on queue inspection)."""
+    for record in jobs_state.list_jobs(skip_finished=True):
+        if record.schedule_state in (jobs_state.ScheduleState.WAITING,
+                                     jobs_state.ScheduleState.DONE):
+            continue
+        pid = record.controller_pid
+        if pid is None:
+            continue
+        if not psutil.pid_exists(pid):
+            logger.warning('Managed job %s: controller %s died.',
+                           record.job_id, pid)
+            jobs_state.set_status(
+                record.job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                failure_reason='controller process died')
+            jobs_state.set_schedule_state(record.job_id,
+                                          jobs_state.ScheduleState.DONE)
+    maybe_schedule_next_jobs()

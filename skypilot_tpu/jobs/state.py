@@ -1,0 +1,292 @@
+"""Managed-job DB: job records + the schedule state machine.
+
+Parity: ``sky/jobs/state.py`` (ManagedJobStatus, ManagedJobScheduleState
+:688). Two state axes per job:
+
+* **status** — user-visible lifecycle
+  (PENDING → STARTING → RUNNING → {RECOVERING ↔ RUNNING} → terminal).
+* **schedule_state** — the scheduler's controller-slot accounting
+  (WAITING → LAUNCHING → ALIVE → DONE); LAUNCHING slots are scarce
+  (provisioning is heavy), ALIVE slots are cheap (monitor loops).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'                    # user code failed
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER,
+                        ManagedJobStatus.CANCELLED)
+
+
+class ScheduleState(enum.Enum):
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
+
+def jobs_dir() -> str:
+    return os.path.join(
+        os.environ.get('SKYT_STATE_DIR', os.path.expanduser('~/.skyt')),
+        'managed_jobs')
+
+
+def controller_log_path(job_id: int) -> str:
+    return os.path.join(jobs_dir(), 'logs', f'controller-{job_id}.log')
+
+
+_local = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(jobs_dir(), 'jobs.db')
+    conn = getattr(_local, 'conn', None)
+    if (conn is not None and getattr(_local, 'path', None) == path and
+            getattr(_local, 'pid', None) == os.getpid()):
+        return conn
+    os.makedirs(jobs_dir(), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            task_config TEXT NOT NULL,   -- Task.to_yaml_config() JSON
+            cluster_name TEXT,
+            status TEXT NOT NULL,
+            schedule_state TEXT NOT NULL,
+            strategy TEXT,
+            max_restarts_on_errors INTEGER DEFAULT 0,
+            recovery_count INTEGER DEFAULT 0,
+            failure_reason TEXT,
+            controller_pid INTEGER,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            last_recovered_at REAL
+        );
+    """)
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    _local.pid = os.getpid()
+    return conn
+
+
+class JobRecord:
+    def __init__(self, row: sqlite3.Row) -> None:
+        self.job_id: int = row['job_id']
+        self.name: Optional[str] = row['name']
+        self.task_config: Dict[str, Any] = json.loads(row['task_config'])
+        self.cluster_name: Optional[str] = row['cluster_name']
+        self.status = ManagedJobStatus(row['status'])
+        self.schedule_state = ScheduleState(row['schedule_state'])
+        self.strategy: str = row['strategy'] or 'FAILOVER'
+        self.max_restarts_on_errors: int = row['max_restarts_on_errors']
+        self.recovery_count: int = row['recovery_count']
+        self.failure_reason: Optional[str] = row['failure_reason']
+        self.controller_pid: Optional[int] = row['controller_pid']
+        self.submitted_at: Optional[float] = row['submitted_at']
+        self.started_at: Optional[float] = row['started_at']
+        self.ended_at: Optional[float] = row['ended_at']
+        self.last_recovered_at: Optional[float] = row['last_recovered_at']
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'job_id': self.job_id,
+            'name': self.name,
+            'cluster_name': self.cluster_name,
+            'status': self.status.value,
+            'schedule_state': self.schedule_state.value,
+            'strategy': self.strategy,
+            'recovery_count': self.recovery_count,
+            'failure_reason': self.failure_reason,
+            'submitted_at': self.submitted_at,
+            'started_at': self.started_at,
+            'ended_at': self.ended_at,
+        }
+
+
+def submit(task_config: Dict[str, Any],
+           name: Optional[str],
+           strategy: str,
+           max_restarts_on_errors: int) -> int:
+    conn = _db()
+    cur = conn.execute(
+        'INSERT INTO jobs (name, task_config, status, schedule_state, '
+        'strategy, max_restarts_on_errors, submitted_at) '
+        'VALUES (?, ?, ?, ?, ?, ?, ?)',
+        (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
+         ScheduleState.WAITING.value, strategy, max_restarts_on_errors,
+         time.time()))
+    conn.commit()
+    return cur.lastrowid
+
+
+def get(job_id: int) -> Optional[JobRecord]:
+    row = _db().execute('SELECT * FROM jobs WHERE job_id = ?',
+                        (job_id,)).fetchone()
+    return JobRecord(row) if row else None
+
+
+def list_jobs(skip_finished: bool = False) -> List[JobRecord]:
+    rows = _db().execute(
+        'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+    records = [JobRecord(r) for r in rows]
+    if skip_finished:
+        records = [r for r in records if not r.status.is_terminal()]
+    return records
+
+
+def set_status(job_id: int,
+               status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> bool:
+    """Guarded status write: terminal states are never overwritten, and a
+    pending CANCELLING is only ever resolved to a terminal state — a
+    controller transitioning to RUNNING must not swallow a concurrent
+    cancel (first-writer-wins, same discipline as requests_db.finalize)."""
+    conn = _db()
+    sets = ['status = ?']
+    args: List[Any] = [status.value]
+    if failure_reason is not None:
+        sets.append('failure_reason = ?')
+        args.append(failure_reason)
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at = COALESCE(started_at, ?)')
+        args.append(time.time())
+    if status.is_terminal():
+        sets.append('ended_at = ?')
+        args.append(time.time())
+    args.append(job_id)
+    blocked = [s.value for s in ManagedJobStatus if s.is_terminal()]
+    if not status.is_terminal():
+        blocked.append(ManagedJobStatus.CANCELLING.value)
+    placeholders = ','.join('?' * len(blocked))
+    cur = conn.execute(
+        f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ? '
+        f'AND status NOT IN ({placeholders})', args + blocked)
+    conn.commit()
+    return cur.rowcount == 1
+
+
+def request_cancel(job_id: int) -> bool:
+    """CANCELLING if non-terminal; returns False if already terminal.
+
+    The guarded UPDATE makes cancel-vs-finish a first-writer-wins race,
+    same discipline as requests_db.finalize."""
+    conn = _db()
+    terminal = [s.value for s in ManagedJobStatus if s.is_terminal()]
+    placeholders = ','.join('?' * len(terminal))
+    cur = conn.execute(
+        f'UPDATE jobs SET status = ? WHERE job_id = ? '
+        f'AND status NOT IN ({placeholders}) AND status != ?',
+        [ManagedJobStatus.CANCELLING.value, job_id] + terminal +
+        [ManagedJobStatus.CANCELLING.value])
+    conn.commit()
+    return cur.rowcount == 1
+
+
+def cancel_requested(job_id: int) -> bool:
+    record = get(job_id)
+    return record is not None and record.status in (
+        ManagedJobStatus.CANCELLING, ManagedJobStatus.CANCELLED)
+
+
+def set_schedule_state(job_id: int, schedule_state: ScheduleState) -> None:
+    conn = _db()
+    conn.execute('UPDATE jobs SET schedule_state = ? WHERE job_id = ?',
+                 (schedule_state.value, job_id))
+    conn.commit()
+
+
+def claim_waiting_job(max_launching: int, max_alive: int) -> Optional[int]:
+    """Atomically move the oldest WAITING job to LAUNCHING if slots allow
+    (parity: the jobs scheduler's single-transaction claim,
+    jobs/scheduler.py:29-33)."""
+    conn = _db()
+    with _claim_lock:
+        # Schedulers run in many processes (API-server workers and every
+        # controller); BEGIN IMMEDIATE takes the write lock up front so
+        # count-then-claim is atomic across processes, not just threads.
+        conn.commit()
+        conn.execute('BEGIN IMMEDIATE')
+        try:
+            launching = conn.execute(
+                'SELECT COUNT(*) FROM jobs WHERE schedule_state = ?',
+                (ScheduleState.LAUNCHING.value,)).fetchone()[0]
+            alive = conn.execute(
+                'SELECT COUNT(*) FROM jobs WHERE schedule_state IN (?, ?)',
+                (ScheduleState.LAUNCHING.value,
+                 ScheduleState.ALIVE.value)).fetchone()[0]
+            if launching >= max_launching or alive >= max_alive:
+                conn.rollback()
+                return None
+            row = conn.execute(
+                'SELECT job_id FROM jobs WHERE schedule_state = ? '
+                'ORDER BY job_id LIMIT 1',
+                (ScheduleState.WAITING.value,)).fetchone()
+            if row is None:
+                conn.rollback()
+                return None
+            cur = conn.execute(
+                'UPDATE jobs SET schedule_state = ? WHERE job_id = ? '
+                'AND schedule_state = ?',
+                (ScheduleState.LAUNCHING.value, row['job_id'],
+                 ScheduleState.WAITING.value))
+            if cur.rowcount != 1:
+                conn.rollback()
+                return None
+            conn.commit()
+            return row['job_id']
+        except sqlite3.Error:
+            conn.rollback()
+            raise
+
+
+_claim_lock = threading.Lock()
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    conn = _db()
+    conn.execute('UPDATE jobs SET controller_pid = ? WHERE job_id = ?',
+                 (pid, job_id))
+    conn.commit()
+
+
+def set_cluster_name(job_id: int, cluster_name: str) -> None:
+    conn = _db()
+    conn.execute('UPDATE jobs SET cluster_name = ? WHERE job_id = ?',
+                 (cluster_name, job_id))
+    conn.commit()
+
+
+def bump_recovery(job_id: int) -> None:
+    conn = _db()
+    conn.execute(
+        'UPDATE jobs SET recovery_count = recovery_count + 1, '
+        'last_recovered_at = ? WHERE job_id = ?', (time.time(), job_id))
+    conn.commit()

@@ -46,6 +46,27 @@ def _check() -> Dict[str, Any]:
     return check.check()
 
 
+def _jobs_launch(task_config: Dict[str, Any],
+                 name: Optional[str] = None) -> int:
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.launch(Task.from_yaml_config(task_config), name)
+
+
+def _jobs_queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.queue(skip_finished)
+
+
+def _jobs_cancel(job_id: int) -> bool:
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.cancel(job_id)
+
+
+def _jobs_logs(job_id: int, controller: bool = False) -> None:
+    from skypilot_tpu.jobs import core as jobs_core
+    print(jobs_core.tail_logs(job_id, controller=controller), end='')
+
+
 # name -> (callable, schedule type). LONG = holds cloud resources/locks for
 # minutes (parity: executor.py queue split).
 PAYLOADS: Dict[str, Tuple[Callable[..., Any], ScheduleType]] = {
@@ -61,4 +82,9 @@ PAYLOADS: Dict[str, Tuple[Callable[..., Any], ScheduleType]] = {
     'autostop': (core.autostop, ScheduleType.SHORT),
     'cost_report': (core.cost_report, ScheduleType.SHORT),
     'check': (_check, ScheduleType.SHORT),
+    # Managed jobs: submission is quick (the controller does the work).
+    'jobs/launch': (_jobs_launch, ScheduleType.SHORT),
+    'jobs/queue': (_jobs_queue, ScheduleType.SHORT),
+    'jobs/cancel': (_jobs_cancel, ScheduleType.SHORT),
+    'jobs/logs': (_jobs_logs, ScheduleType.SHORT),
 }

@@ -1,0 +1,122 @@
+"""Managed-jobs tests: controller lifecycle, preemption recovery,
+restart-on-error, scheduler slots — against the fake cloud (the reference
+covers this with tests/test_jobs_and_serve.py + real-cloud smoke tests;
+here preemption is injected into the fake provider and real detached
+controller processes run the recovery)."""
+import time
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fast_controller(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_JOBS_LAUNCH_RETRY_GAP', '0.2')
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def _task(run, recovery=None, **kw):
+    return Task(name='mj', run=run,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8',
+                                    use_spot=True,
+                                    job_recovery=recovery), **kw)
+
+
+def _wait_status(job_id, statuses, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get(job_id)
+        if record and record.status.value in statuses:
+            return record
+        time.sleep(0.2)
+    record = jobs_state.get(job_id)
+    raise AssertionError(
+        f'job {job_id} stuck in {record.status.value if record else None}; '
+        f'wanted {statuses}. Controller log:\n'
+        + jobs_core.tail_logs(job_id, controller=True)[-3000:])
+
+
+def test_managed_job_succeeds_and_cleans_up():
+    job_id = jobs_core.launch(_task('echo managed-ok'))
+    record = _wait_status(job_id, {'SUCCEEDED'})
+    assert record.recovery_count == 0
+    assert record.schedule_state == jobs_state.ScheduleState.DONE
+    # Worker cluster torn down after success.
+    deadline = time.time() + 10
+    while state.get_cluster(record.cluster_name) and time.time() < deadline:
+        time.sleep(0.2)
+    assert state.get_cluster(record.cluster_name) is None
+
+
+def test_preemption_recovery_eager_next_region():
+    job_id = jobs_core.launch(
+        _task('sleep 20 && echo done',
+              recovery={'strategy': 'EAGER_NEXT_REGION'}))
+    record = _wait_status(job_id, {'RUNNING'})
+    original = state.get_cluster(record.cluster_name)
+    assert original is not None
+    original_region = original.region
+
+    fake.preempt_cluster(record.cluster_name)
+    record = _wait_status(job_id, {'RECOVERING', 'RUNNING'}, timeout=30)
+    # Wait until the relaunch lands.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        record = jobs_state.get(job_id)
+        cluster = state.get_cluster(record.cluster_name)
+        if (record.status == jobs_state.ManagedJobStatus.RUNNING and
+                cluster is not None and
+                cluster.status == state.ClusterStatus.UP and
+                cluster.region != original_region):
+            break
+        time.sleep(0.2)
+    assert record.recovery_count >= 1
+    cluster = state.get_cluster(record.cluster_name)
+    # EAGER_NEXT_REGION: the preempted region is blocklisted on relaunch.
+    assert cluster.region != original_region
+    jobs_core.cancel(job_id)
+    _wait_status(job_id, {'CANCELLED'}, timeout=30)
+
+
+def test_restart_on_user_error(tmp_path):
+    marker = tmp_path / 'attempted'
+    job_id = jobs_core.launch(
+        _task(f'if [ -f {marker} ]; then echo second-try-ok; '
+              f'else touch {marker}; exit 1; fi',
+              recovery={'strategy': 'FAILOVER',
+                        'max_restarts_on_errors': 1}))
+    record = _wait_status(job_id, {'SUCCEEDED'})
+    assert record.recovery_count == 1
+
+
+def test_user_error_without_restart_budget_fails():
+    job_id = jobs_core.launch(_task('exit 7'))
+    record = _wait_status(job_id, {'FAILED'})
+    assert record.recovery_count == 0
+
+
+def test_cancel_waiting_job(monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_MAX_LAUNCHING', '0')
+    job_id = jobs_core.launch(_task('echo never-runs'))
+    record = jobs_state.get(job_id)
+    assert record.schedule_state == jobs_state.ScheduleState.WAITING
+    assert jobs_core.cancel(job_id)
+    record = jobs_state.get(job_id)
+    assert record.status == jobs_state.ManagedJobStatus.CANCELLED
+
+
+def test_scheduler_serializes_launches(monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_MAX_LAUNCHING', '1')
+    ids = [jobs_core.launch(_task(f'echo job-{i}')) for i in range(3)]
+    for job_id in ids:
+        _wait_status(job_id, {'SUCCEEDED'}, timeout=90)
