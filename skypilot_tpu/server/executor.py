@@ -52,6 +52,11 @@ def _run_request_in_child(request_id: str) -> None:
         if isinstance(handler, logging.StreamHandler):
             handler.stream = sys.stderr
     requests_db.set_pid(request_id, os.getpid())
+    # A cancel that raced the claim may have already finalized CANCELLED
+    # without seeing a pid to kill; honor it instead of running the payload.
+    request = requests_db.get(request_id)
+    if request is None or request.status.is_terminal():
+        return
     fn, _ = payloads.PAYLOADS[request.name]
     try:
         result = fn(**request.body)
@@ -160,17 +165,29 @@ def cancel_request(request_id: str) -> bool:
             request = requests_db.get(request_id)
             if request is None or request.status.is_terminal():
                 return False
-    if request.status == RequestStatus.RUNNING and request.pid:
-        kill_process_tree(request.pid, signal.SIGTERM)
+    # Mark CANCELLED before killing: the reaper finalizes any dead worker
+    # whose request is still non-terminal as FAILED, and first terminal
+    # writer wins — so the status must land before the SIGTERM does.
+    cancelled = requests_db.finalize(request.request_id,
+                                     RequestStatus.CANCELLED,
+                                     error='cancelled by user')
+    if not cancelled:
+        return False
+    # Re-fetch: the executor may have claimed + spawned between our first
+    # read and the finalize, so the pre-finalize snapshot's pid is stale.
+    # (The child also re-checks terminal status after set_pid, covering the
+    # window where the pid has not landed yet.)
+    request = requests_db.get(request_id)
+    pid = request.pid if request is not None else None
+    if pid:
+        kill_process_tree(pid, signal.SIGTERM)
         deadline = time.time() + 5
         while time.time() < deadline:
             try:
-                os.kill(request.pid, 0)
+                os.kill(pid, 0)
             except ProcessLookupError:
                 break
             time.sleep(0.1)
         else:
-            kill_process_tree(request.pid, signal.SIGKILL)
-    requests_db.finalize(request.request_id, RequestStatus.CANCELLED,
-                         error='cancelled by user')
+            kill_process_tree(pid, signal.SIGKILL)
     return True
