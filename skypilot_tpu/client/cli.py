@@ -264,6 +264,59 @@ def jobs_logs(job_id: int, controller: bool) -> None:
     _run(sdk.jobs_logs(job_id, controller=controller), False)
 
 
+# -- serving -----------------------------------------------------------
+
+
+@cli.group()
+def serve() -> None:
+    """Serve behind a load balancer with autoscaling."""
+
+
+@serve.command('up')
+@click.argument('entrypoint', required=True)
+@click.option('--service-name', '-n', default=None)
+def serve_up(entrypoint: str, service_name: Optional[str]) -> None:
+    """Bring up a service from a task YAML with a `service:` section."""
+    task = Task.from_yaml(entrypoint)
+    result = _run(sdk.serve_up(task, service_name), False, stream=False)
+    click.echo(f"Service {result['name']} starting; endpoint "
+               f"{result['endpoint']}. `skyt serve status` to watch.")
+
+
+@serve.command('down')
+@click.argument('service_name')
+@click.option('--purge', '-p', is_flag=True, default=False,
+              help='Clean up even if the controller is unreachable.')
+def serve_down(service_name: str, purge: bool) -> None:
+    """Tear down a service and all its replicas."""
+    _run(sdk.serve_down(service_name, purge=purge), False, stream=False)
+    click.echo(f'Service {service_name} shutdown requested.')
+
+
+@serve.command('status')
+@click.argument('service_name', required=False, default=None)
+def serve_status(service_name: Optional[str]) -> None:
+    """Show services and their replica fleets."""
+    rows = _run(sdk.serve_status(service_name), False, stream=False)
+    _echo_table(rows or [], ['name', 'status', 'lb_port',
+                             'failure_reason'])
+    for row in rows or []:
+        for replica in row.get('replicas', []):
+            click.echo(
+                f"  replica {replica['replica_id']:>3} "
+                f"{replica['status']:<22} {replica['endpoint'] or '-':<28}"
+                f"{'spot' if replica['is_spot'] else 'on-demand'}")
+
+
+@serve.command('logs')
+@click.argument('service_name')
+@click.option('--replica-id', '-r', type=int, default=None,
+              help="A replica's logs instead of the controller's.")
+def serve_logs(service_name: str, replica_id: Optional[int]) -> None:
+    """Show a service's controller (or replica) logs."""
+    _run(sdk.serve_logs(service_name, replica_id), False)
+
+
 # -- api server control ------------------------------------------------
 
 

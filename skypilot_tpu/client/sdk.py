@@ -307,3 +307,29 @@ def jobs_cancel(job_id: int) -> RequestId:
 
 def jobs_logs(job_id: int, controller: bool = False) -> RequestId:
     return _post('jobs/logs', {'job_id': job_id, 'controller': controller})
+
+
+# -- serving -----------------------------------------------------------
+
+
+def serve_up(task: Union[Task, Dag],
+             service_name: Optional[str] = None) -> RequestId:
+    configs = _task_configs(task)
+    assert len(configs) == 1, 'a service is a single task'
+    return _post('serve/up', {'task_config': configs[0],
+                              'service_name': service_name})
+
+
+def serve_down(service_name: str, purge: bool = False) -> RequestId:
+    return _post('serve/down', {'service_name': service_name,
+                                'purge': purge})
+
+
+def serve_status(service_name: Optional[str] = None) -> RequestId:
+    return _post('serve/status', {'service_name': service_name})
+
+
+def serve_logs(service_name: str,
+               replica_id: Optional[int] = None) -> RequestId:
+    return _post('serve/logs', {'service_name': service_name,
+                                'replica_id': replica_id})

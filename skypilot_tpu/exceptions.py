@@ -122,6 +122,18 @@ class ServeUserTerminatedError(SkytError):
     """Service was torn down while an operation was in flight."""
 
 
+class ServeError(SkytError):
+    """Generic serving failure (controller crash, never-ready)."""
+
+
+class ServiceNotFoundError(SkytError):
+    """Named service is not in the serve DB."""
+
+
+class ServiceAlreadyExistsError(SkytError):
+    """`serve up` with a name that is already taken."""
+
+
 class StorageError(SkytError):
     """Bucket/storage operation failure."""
 

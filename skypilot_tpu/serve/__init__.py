@@ -1,0 +1,6 @@
+"""SkyServe-equivalent: serve models behind a load balancer with
+autoscaling, each replica a cluster (parity: ``sky/serve/``)."""
+from skypilot_tpu.serve.core import down, status, tail_logs, up
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+__all__ = ['ServiceSpec', 'up', 'down', 'status', 'tail_logs']

@@ -1,0 +1,239 @@
+"""Autoscalers: decide the replica fleet size (and its spot/on-demand
+mix) from load statistics.
+
+Parity: ``sky/serve/autoscalers.py`` — hysteresis base :393,
+RequestRateAutoscaler :479, QueueLengthAutoscaler :1094,
+FallbackAutoscaler :933 (spot + on-demand mix). Decisions are data, not
+actions: the controller applies them through the ReplicaManager, which
+keeps the autoscalers pure and unit-testable without clusters.
+
+Hysteresis: a raw target must hold for ``upscale_delay_seconds``
+(resp. ``downscale_delay_seconds``) of consecutive evaluations before
+the fleet moves — scaling a TPU replica means provisioning a slice, so
+flapping is far more expensive than lag.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import AUTOSCALER_REGISTRY
+
+logger = log.init_logger(__name__)
+
+
+class DecisionOp(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+
+
+@dataclasses.dataclass
+class Decision:
+    op: DecisionOp
+    # SCALE_UP: how many + the spot/zone request for each.
+    count: int = 1
+    use_spot: Optional[bool] = None
+    is_fallback: bool = False
+    # SCALE_DOWN: which replica.
+    replica_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class LoadStats:
+    """A window of load-balancer statistics."""
+    qps: float = 0.0
+    queue_length: float = 0.0      # total in-flight across replicas
+    window_seconds: float = 60.0
+
+
+def _alive(replicas: List[serve_state.ReplicaRecord]
+           ) -> List[serve_state.ReplicaRecord]:
+    return [r for r in replicas if not r.status.is_terminal() and
+            r.status != ReplicaStatus.SHUTTING_DOWN]
+
+
+class Autoscaler:
+    """Fixed-size fleet (no load target): keep min_replicas alive,
+    replacing failures/preemptions."""
+
+    def __init__(self, spec: ServiceSpec) -> None:
+        self.spec = spec
+        self._target = spec.min_replicas
+        self._pending_target: Optional[int] = None
+        self._pending_since: float = 0.0
+
+    @classmethod
+    def from_spec(cls, spec: ServiceSpec) -> 'Autoscaler':
+        if spec.base_ondemand_fallback_replicas or \
+                spec.dynamic_ondemand_fallback:
+            return FallbackAutoscaler(spec)
+        if spec.target_qps_per_replica is not None:
+            return RequestRateAutoscaler(spec)
+        if spec.target_queue_length is not None:
+            return QueueLengthAutoscaler(spec)
+        return AUTOSCALER_REGISTRY.get('fixed')(spec)
+
+    # -- target computation with hysteresis ----------------------------
+
+    def _raw_target(self, stats: LoadStats, num_alive: int) -> int:
+        return self.spec.min_replicas
+
+    def _bounded(self, target: int) -> int:
+        lo = self.spec.min_replicas
+        hi = (self.spec.max_replicas
+              if self.spec.max_replicas is not None else max(lo, target))
+        return max(lo, min(hi, target))
+
+    def target_replicas(self, stats: LoadStats, num_alive: int) -> int:
+        """Hysteresis-filtered target (ref hysteresis base :393)."""
+        raw = self._bounded(self._raw_target(stats, num_alive))
+        if raw == self._target:
+            self._pending_target = None
+            return self._target
+        now = time.time()
+        if raw != self._pending_target:
+            self._pending_target = raw
+            self._pending_since = now
+        delay = (self.spec.upscale_delay_seconds if raw > self._target
+                 else self.spec.downscale_delay_seconds)
+        if now - self._pending_since >= delay:
+            logger.info('Autoscaler: target %d -> %d', self._target, raw)
+            self._target = raw
+            self._pending_target = None
+        return self._target
+
+    # -- evaluation ----------------------------------------------------
+
+    def evaluate(self, stats: LoadStats,
+                 replicas: List[serve_state.ReplicaRecord]
+                 ) -> List[Decision]:
+        alive = _alive(replicas)
+        target = self.target_replicas(stats, len(alive))
+        decisions: List[Decision] = []
+        if len(alive) < target:
+            decisions.append(
+                Decision(DecisionOp.SCALE_UP, count=target - len(alive)))
+        elif len(alive) > target:
+            # Down the newest non-ready first, then newest ready
+            # (oldest replicas have the warmest caches).
+            excess = len(alive) - target
+            victims = sorted(
+                alive,
+                key=lambda r: (r.status == ReplicaStatus.READY,
+                               -r.replica_id))
+            for record in victims[:excess]:
+                decisions.append(Decision(DecisionOp.SCALE_DOWN,
+                                          replica_id=record.replica_id))
+        return decisions
+
+
+@AUTOSCALER_REGISTRY.register('fixed', default=True)
+class FixedAutoscaler(Autoscaler):
+    pass
+
+
+@AUTOSCALER_REGISTRY.register('request_rate')
+class RequestRateAutoscaler(Autoscaler):
+    """target = ceil(qps / target_qps_per_replica) (ref :479)."""
+
+    def _raw_target(self, stats: LoadStats, num_alive: int) -> int:
+        assert self.spec.target_qps_per_replica is not None
+        if stats.qps <= 0:
+            return self.spec.min_replicas
+        return math.ceil(stats.qps / self.spec.target_qps_per_replica)
+
+
+@AUTOSCALER_REGISTRY.register('queue_length')
+class QueueLengthAutoscaler(Autoscaler):
+    """target = ceil(total in-flight / target_queue_length) (ref :1094)."""
+
+    def _raw_target(self, stats: LoadStats, num_alive: int) -> int:
+        assert self.spec.target_queue_length is not None
+        if stats.queue_length <= 0:
+            return self.spec.min_replicas
+        return math.ceil(stats.queue_length / self.spec.target_queue_length)
+
+
+@AUTOSCALER_REGISTRY.register('fallback')
+class FallbackAutoscaler(Autoscaler):
+    """Spot fleet with an on-demand floor and optional dynamic on-demand
+    backfill while spot recovers (ref FallbackAutoscaler :933).
+
+    Invariants per evaluation:
+    * ``base_ondemand_fallback_replicas`` permanent on-demand replicas;
+    * remaining target filled with spot;
+    * if ``dynamic_ondemand_fallback`` and alive spot < spot target,
+      temporary on-demand replicas (``is_fallback``) cover the gap and
+      are the first scaled down once spot is READY again.
+    """
+
+    def __init__(self, spec: ServiceSpec) -> None:
+        super().__init__(spec)
+        if spec.target_qps_per_replica is not None:
+            self._inner: Autoscaler = RequestRateAutoscaler(spec)
+        elif spec.target_queue_length is not None:
+            self._inner = QueueLengthAutoscaler(spec)
+        else:
+            self._inner = FixedAutoscaler(spec)
+
+    def evaluate(self, stats: LoadStats,
+                 replicas: List[serve_state.ReplicaRecord]
+                 ) -> List[Decision]:
+        alive = _alive(replicas)
+        target = self._inner.target_replicas(stats, len(alive))
+        base_od = min(self.spec.base_ondemand_fallback_replicas, target)
+        spot_target = target - base_od
+
+        alive_od = [r for r in alive if not r.is_spot and not r.is_fallback]
+        alive_spot = [r for r in alive if r.is_spot]
+        fallback_od = [r for r in alive if not r.is_spot and r.is_fallback]
+        decisions: List[Decision] = []
+
+        if len(alive_od) < base_od:
+            decisions.append(Decision(DecisionOp.SCALE_UP,
+                                      count=base_od - len(alive_od),
+                                      use_spot=False))
+        elif len(alive_od) > base_od:
+            for record in sorted(alive_od,
+                                 key=lambda r: -r.replica_id)[:len(alive_od)
+                                                              - base_od]:
+                decisions.append(Decision(DecisionOp.SCALE_DOWN,
+                                          replica_id=record.replica_id))
+
+        if len(alive_spot) < spot_target:
+            decisions.append(Decision(DecisionOp.SCALE_UP,
+                                      count=spot_target - len(alive_spot),
+                                      use_spot=True))
+        elif len(alive_spot) > spot_target:
+            for record in sorted(
+                    alive_spot,
+                    key=lambda r: (r.status == ReplicaStatus.READY,
+                                   -r.replica_id))[:len(alive_spot)
+                                                   - spot_target]:
+                decisions.append(Decision(DecisionOp.SCALE_DOWN,
+                                          replica_id=record.replica_id))
+
+        if self.spec.dynamic_ondemand_fallback:
+            ready_spot = [r for r in alive_spot
+                          if r.status == ReplicaStatus.READY]
+            gap = spot_target - len(ready_spot)
+            if gap > len(fallback_od):
+                decisions.append(Decision(DecisionOp.SCALE_UP,
+                                          count=gap - len(fallback_od),
+                                          use_spot=False,
+                                          is_fallback=True))
+            elif gap < len(fallback_od):
+                for record in sorted(
+                        fallback_od,
+                        key=lambda r: -r.replica_id)[:len(fallback_od)
+                                                     - max(gap, 0)]:
+                    decisions.append(Decision(DecisionOp.SCALE_DOWN,
+                                              replica_id=record.replica_id))
+        return decisions

@@ -1,0 +1,193 @@
+"""Per-service controller loop: probes replicas, runs the autoscaler,
+applies decisions, feeds the load balancer.
+
+Parity: ``sky/serve/controller.py`` (SkyServeController :40). The
+reference runs controller and load balancer as two processes wired over
+HTTP; here both live in one detached service process (the LB in a
+thread) — same isolation boundary (one process per service), none of
+the localhost RPC.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from skypilot_tpu import state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import (Autoscaler, Decision,
+                                            DecisionOp)
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.load_balancing_policies import ReplicaEntry
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+POLL_SECONDS = float(os.environ.get('SKYT_SERVE_CONTROLLER_POLL', '10'))
+
+
+def _replica_weight(record: serve_state.ReplicaRecord) -> float:
+    """Relative capacity for instance-aware balancing: TPU chip count of
+    the replica's cluster, 1.0 when unknown."""
+    cluster = state.get_cluster(record.cluster_name)
+    if cluster is None or not cluster.resources:
+        return 1.0
+    try:
+        from skypilot_tpu.spec.resources import Resources
+        res = Resources.from_yaml_config(cluster.resources)
+        if res.is_tpu:
+            return float(res.tpu.total_chips)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return 1.0
+
+
+class ServeController:
+    def __init__(self, service_name: str, spec: ServiceSpec, task: Task,
+                 lb: LoadBalancer) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.lb = lb
+        self.manager = ReplicaManager(service_name, spec, task)
+        self.autoscaler = Autoscaler.from_spec(spec)
+        self.spot_placer: Optional[DynamicFallbackSpotPlacer] = None
+        if any(r.use_spot for r in task.resources):
+            self.spot_placer = DynamicFallbackSpotPlacer(
+                self._candidate_zones(task))
+        self._handled_preemptions: set = set()
+
+    @staticmethod
+    def _candidate_zones(task: Task) -> List[str]:
+        from skypilot_tpu.optimizer import Optimizer
+        zones = []
+        try:
+            for candidate in Optimizer.plan_task(task):
+                zone = candidate.resources.zone
+                if zone and zone not in zones:
+                    zones.append(zone)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return zones
+
+    # ------------------------------------------------------------------
+
+    def _apply(self, decisions: List[Decision]) -> None:
+        for decision in decisions:
+            if decision.op == DecisionOp.SCALE_UP:
+                for _ in range(decision.count):
+                    zone = None
+                    use_spot = decision.use_spot
+                    if use_spot is None:
+                        use_spot = any(
+                            r.use_spot
+                            for r in self.manager.task.resources)
+                    if use_spot and self.spot_placer is not None:
+                        zone = self.spot_placer.select()
+                    self.manager.scale_up(use_spot=decision.use_spot,
+                                          zone=zone,
+                                          is_fallback=decision.is_fallback)
+            else:
+                assert decision.replica_id is not None
+                self.manager.scale_down(decision.replica_id)
+
+    def _sync_lb(self,
+                 replicas: List[serve_state.ReplicaRecord]) -> None:
+        entries: List[ReplicaEntry] = []
+        for record in replicas:
+            if record.status == ReplicaStatus.READY and record.endpoint:
+                entries.append((record.replica_id, record.endpoint,
+                                _replica_weight(record)))
+        self.lb.sync_replicas(entries)
+
+    def _update_service_status(
+            self, replicas: List[serve_state.ReplicaRecord]) -> None:
+        service = serve_state.get_service(self.service_name)
+        if service is None or service.status in (
+                ServiceStatus.SHUTTING_DOWN,):
+            return
+        num_ready = sum(1 for r in replicas
+                        if r.status == ReplicaStatus.READY)
+        alive = [r for r in replicas if not r.status.is_terminal()]
+        if num_ready > 0:
+            status = ServiceStatus.READY
+        elif alive:
+            status = ServiceStatus.REPLICA_INIT
+        else:
+            failures = [r for r in replicas if r.status.is_failure()]
+            # Every replica failed and the autoscaler has nothing alive:
+            # fixed-size services with all-failed fleets are FAILED.
+            if (failures and len(failures) == len(replicas) and
+                    not self.spec.autoscaling):
+                status = ServiceStatus.FAILED
+            else:
+                status = ServiceStatus.NO_REPLICA
+        if service.status != status:
+            serve_state.set_service_status(self.service_name, status)
+
+    def _note_preemptions(
+            self, replicas: List[serve_state.ReplicaRecord]) -> None:
+        if self.spot_placer is None:
+            return
+        for record in replicas:
+            if (record.status == ReplicaStatus.PREEMPTED and
+                    record.replica_id not in self._handled_preemptions):
+                self._handled_preemptions.add(record.replica_id)
+                self.spot_placer.handle_preemption(record.zone)
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Tear down every replica, then remove the service record."""
+        logger.info('Service %s: shutting down.', self.service_name)
+        self.manager.join(timeout=60)
+        for record in serve_state.list_replicas(self.service_name,
+                                                include_terminal=False):
+            self.manager.scale_down(record.replica_id)
+        deadline = time.time() + 300
+        remaining = serve_state.list_replicas(self.service_name,
+                                              include_terminal=False)
+        while remaining and time.time() < deadline:
+            time.sleep(min(POLL_SECONDS, 1.0))
+            remaining = serve_state.list_replicas(self.service_name,
+                                                  include_terminal=False)
+        if remaining:
+            # Do NOT delete the rows of still-live clusters: surface the
+            # leak so `serve down --purge` / the operator can finish it.
+            names = [r.cluster_name for r in remaining]
+            logger.error('Service %s: teardown timed out; clusters still '
+                         'live: %s', self.service_name, names)
+            serve_state.set_service_status(
+                self.service_name, ServiceStatus.FAILED,
+                failure_reason=f'teardown timed out; live: {names}')
+            return
+        serve_state.remove_service(self.service_name)
+        logger.info('Service %s: shut down complete.', self.service_name)
+
+    def run_once(self) -> None:
+        replicas = self.manager.probe_all()
+        self._note_preemptions(replicas)
+        stats = self.lb.load_stats()
+        decisions = self.autoscaler.evaluate(stats, replicas)
+        self._apply(decisions)
+        replicas = serve_state.list_replicas(self.service_name)
+        self._sync_lb(replicas)
+        self._update_service_status(replicas)
+
+    def run(self) -> None:
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.REPLICA_INIT)
+        while True:
+            if serve_state.shutdown_requested(self.service_name):
+                self.shutdown()
+                return
+            try:
+                self.run_once()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('Service %s: controller tick failed',
+                                 self.service_name)
+            time.sleep(POLL_SECONDS)

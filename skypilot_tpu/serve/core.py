@@ -1,0 +1,161 @@
+"""Serve API: up/down/status/tail_logs.
+
+Parity: ``sky/serve/server/core.py``. ``up`` validates the task's
+``service:`` section, registers the service, and spawns the detached
+service process (controller + load balancer); ``down`` requests
+shutdown through the DB and the controller tears everything down.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import psutil
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import common_utils, log, subprocess_utils
+
+logger = log.init_logger(__name__)
+
+
+def up(task: Task, service_name: Optional[str] = None) -> Dict[str, Any]:
+    """Bring up a service; returns {name, endpoint} immediately (replicas
+    come up asynchronously)."""
+    if task.service is None:
+        raise exceptions.InvalidSpecError(
+            'Task has no service section; add `service:` to the YAML.')
+    spec = ServiceSpec.from_yaml_config(task.service)
+    name = service_name or task.name or common_utils.generate_cluster_name(
+        'service')
+    common_utils.validate_cluster_name(name)
+    lb_port = common_utils.find_free_port()
+    if not serve_state.add_service(name, spec.to_yaml_config(),
+                                   task.to_yaml_config(), lb_port):
+        raise exceptions.ServiceAlreadyExistsError(
+            f'Service {name!r} already exists.')
+    log_path = serve_state.controller_log_path(name)
+    pid = subprocess_utils.daemonize_and_run(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service-name', name],
+        log_path=log_path)
+    serve_state.set_controller_pid(name, pid)
+    endpoint = f'http://127.0.0.1:{lb_port}'
+    logger.info('Service %s: controller pid %s, endpoint %s', name, pid,
+                endpoint)
+    return {'name': name, 'endpoint': endpoint}
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    """Request shutdown; with purge (or a dead controller), clean up
+    directly from this process."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServiceNotFoundError(
+            f'No service {service_name!r}.')
+    controller_alive = (record.controller_pid is not None and
+                        psutil.pid_exists(record.controller_pid))
+    serve_state.request_shutdown(service_name)
+    if controller_alive and not purge:
+        return
+    # Controller gone (or purge requested): tear down synchronously.
+    # Kill the controller FIRST — a mid-tick autoscaler could otherwise
+    # launch replacement replicas after we list, leaking clusters whose
+    # rows we are about to delete.
+    if record.controller_pid is not None and controller_alive:
+        subprocess_utils.kill_process_tree(record.controller_pid)
+    from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+    backend = TpuPodBackend()
+    for replica in serve_state.list_replicas(service_name,
+                                             include_terminal=False):
+        try:
+            backend.teardown(replica.cluster_name, terminate=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Purge teardown of %s failed: %s',
+                           replica.cluster_name, e)
+            state.remove_cluster(replica.cluster_name)
+    serve_state.remove_service(service_name)
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All services (or one), each with its replica table."""
+    _reap_dead_controllers()
+    if service_name is not None:
+        record = serve_state.get_service(service_name)
+        if record is None:
+            raise exceptions.ServiceNotFoundError(
+                f'No service {service_name!r}.')
+        return [record.to_dict()]
+    return [r.to_dict() for r in serve_state.list_services()]
+
+
+def wait_ready(service_name: str, timeout: float = 300.0) -> Dict[str, Any]:
+    """Block until the service is READY (helper for tests/CLI --wait)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record is None:
+            raise exceptions.ServiceNotFoundError(
+                f'Service {service_name!r} disappeared while waiting.')
+        if record.status == ServiceStatus.READY:
+            return record.to_dict()
+        if record.status.is_terminal():
+            raise exceptions.ServeError(
+                f'Service {service_name} failed: {record.status.value} '
+                f'({record.failure_reason})')
+        time.sleep(0.5)
+    raise exceptions.ServeError(
+        f'Service {service_name} not ready after {timeout:.0f}s.')
+
+
+def tail_logs(service_name: str,
+              replica_id: Optional[int] = None) -> str:
+    """Controller log, or one replica's cluster log."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServiceNotFoundError(
+            f'No service {service_name!r}.')
+    if replica_id is None:
+        path = serve_state.controller_log_path(service_name)
+        if not os.path.exists(path):
+            return ''
+        with open(path, encoding='utf-8') as f:
+            return f.read()
+    replica = serve_state.get_replica(service_name, replica_id)
+    if replica is None:
+        raise exceptions.ServiceNotFoundError(
+            f'Service {service_name} has no replica {replica_id}.')
+    from skypilot_tpu import core as sky_core
+    try:
+        return sky_core.tail_logs(replica.cluster_name)
+    except exceptions.SkytError:
+        return (f'(replica cluster {replica.cluster_name} is gone; '
+                f'status: {replica.status.value})\n')
+
+
+def _reap_dead_controllers() -> None:
+    """Mark services whose controller died as CONTROLLER_FAILED (parity:
+    the reference's controller liveness refresh in the status path)."""
+    for record in serve_state.list_services():
+        if record.status in (ServiceStatus.CONTROLLER_FAILED,):
+            continue
+        if (record.controller_pid is not None and
+                not psutil.pid_exists(record.controller_pid)):
+            if record.status == ServiceStatus.SHUTTING_DOWN:
+                # Controller exiting after shutdown is the happy path;
+                # its last act removes the row. A leftover row means it
+                # died mid-shutdown.
+                serve_state.set_service_status(
+                    record.name, ServiceStatus.CONTROLLER_FAILED,
+                    failure_reason='controller died during shutdown')
+            else:
+                serve_state.set_service_status(
+                    record.name, ServiceStatus.CONTROLLER_FAILED,
+                    failure_reason='controller process died')

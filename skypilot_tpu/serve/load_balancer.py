@@ -1,0 +1,158 @@
+"""Client-facing load balancer: an HTTP proxy over ready replicas.
+
+Parity: ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer :24). Runs
+inside the service process (thread), forwarding every request to a
+replica chosen by the policy, retrying the next replica on connection
+errors. It is also the service's load sensor: a timestamp ring for QPS
+and per-replica in-flight counters feed the autoscaler.
+"""
+from __future__ import annotations
+
+import collections
+import http.client
+import http.server
+import socket
+import socketserver
+import threading
+import time
+import urllib.parse
+from typing import Dict, List, Optional
+
+from skypilot_tpu.serve.autoscalers import LoadStats
+from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
+                                                        ReplicaEntry)
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+MAX_ATTEMPTS = 3
+_HOP_HEADERS = {
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
+    'upgrade', 'host',
+}
+
+
+class LoadBalancer:
+    """Policy + stats shared between the proxy handler and controller."""
+
+    def __init__(self, policy: LoadBalancingPolicy,
+                 qps_window_seconds: float = 60.0) -> None:
+        self.policy = policy
+        self._window = qps_window_seconds
+        self._lock = threading.Lock()
+        self._request_times: collections.deque = collections.deque()
+        self._in_flight: Dict[int, int] = collections.defaultdict(int)
+
+    # -- stats ---------------------------------------------------------
+
+    def record_request(self) -> None:
+        now = time.time()
+        with self._lock:
+            self._request_times.append(now)
+            while (self._request_times and
+                   self._request_times[0] < now - self._window):
+                self._request_times.popleft()
+
+    def begin(self, replica_id: int) -> None:
+        with self._lock:
+            self._in_flight[replica_id] += 1
+
+    def end(self, replica_id: int) -> None:
+        with self._lock:
+            self._in_flight[replica_id] = max(
+                0, self._in_flight[replica_id] - 1)
+
+    def in_flight_snapshot(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._in_flight)
+
+    def load_stats(self) -> LoadStats:
+        now = time.time()
+        with self._lock:
+            while (self._request_times and
+                   self._request_times[0] < now - self._window):
+                self._request_times.popleft()
+            qps = len(self._request_times) / self._window
+            queue = sum(self._in_flight.values())
+        return LoadStats(qps=qps, queue_length=queue,
+                         window_seconds=self._window)
+
+    def sync_replicas(self, replicas: List[ReplicaEntry]) -> None:
+        self.policy.set_replicas(replicas)
+
+    def select(self, exclude=None) -> Optional[ReplicaEntry]:
+        return self.policy.select(self.in_flight_snapshot(), exclude)
+
+
+class _ProxyHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+    lb: LoadBalancer = None  # type: ignore[assignment]
+
+    def log_message(self, fmt: str, *args) -> None:  # silence stderr
+        pass
+
+    def _proxy(self) -> None:
+        lb = self.lb
+        lb.record_request()
+        length = int(self.headers.get('Content-Length') or 0)
+        body = self.rfile.read(length) if length else None
+        tried = set()
+        for _ in range(MAX_ATTEMPTS):
+            entry = lb.select(exclude=tried)
+            if entry is None:
+                break
+            replica_id, url, _weight = entry
+            tried.add(replica_id)
+            parsed = urllib.parse.urlsplit(url)
+            lb.begin(replica_id)
+            try:
+                conn = http.client.HTTPConnection(parsed.hostname,
+                                                  parsed.port, timeout=300)
+                headers = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+                conn.request(self.command, self.path, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                self.send_response(resp.status)
+                for key, value in resp.getheaders():
+                    if key.lower() not in _HOP_HEADERS | {'content-length'}:
+                        self.send_header(key, value)
+                self.send_header('Content-Length', str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                conn.close()
+                return
+            except (ConnectionError, socket.timeout, OSError,
+                    http.client.HTTPException) as e:
+                logger.warning('LB: replica %d unreachable (%s); retrying.',
+                               replica_id, e)
+                continue
+            finally:
+                lb.end(replica_id)
+        self.send_response(503)
+        message = b'No ready replicas\n'
+        self.send_header('Content-Length', str(len(message)))
+        self.end_headers()
+        self.wfile.write(message)
+
+    do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = do_HEAD = _proxy
+
+
+class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
+                           http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def start_load_balancer(lb: LoadBalancer, host: str,
+                        port: int) -> _ThreadingHTTPServer:
+    """Bind and serve in a daemon thread; returns the server."""
+    handler = type('BoundProxyHandler', (_ProxyHandler,), {'lb': lb})
+    server = _ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name=f'lb-{port}', daemon=True)
+    thread.start()
+    logger.info('Load balancer listening on %s:%d', host, port)
+    return server

@@ -1,0 +1,103 @@
+"""Load-balancing policies (parity: ``sky/serve/load_balancing_policies.py``
+RoundRobin :85, LeastLoad :111 — the default — and
+InstanceAwareLeastLoad :151).
+
+A policy sees the ready-replica set as ``(replica_id, url, weight)``
+tuples, where weight is the replica's relative capacity (TPU chip count
+for heterogeneous services), and the per-replica in-flight request count
+maintained by the load balancer.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
+
+ReplicaEntry = Tuple[int, str, float]  # (replica_id, url, weight)
+
+
+class LoadBalancingPolicy:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._replicas: List[ReplicaEntry] = []
+
+    def set_replicas(self, replicas: List[ReplicaEntry]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+
+    @property
+    def replicas(self) -> List[ReplicaEntry]:
+        with self._lock:
+            return list(self._replicas)
+
+    def _candidates(
+            self,
+            exclude: Optional[Set[int]] = None) -> List[ReplicaEntry]:
+        replicas = self.replicas
+        if exclude:
+            replicas = [e for e in replicas if e[0] not in exclude]
+        return replicas
+
+    def select(self, in_flight: Dict[int, int],
+               exclude: Optional[Set[int]] = None
+               ) -> Optional[ReplicaEntry]:
+        """Pick a replica for the next request; None if none ready.
+        ``exclude`` holds replicas that already failed this request (the
+        proxy's failover must not re-pick a dead replica)."""
+        raise NotImplementedError
+
+    @classmethod
+    def make(cls, name: str) -> 'LoadBalancingPolicy':
+        return LB_POLICY_REGISTRY.get(name.lower())()
+
+
+@LB_POLICY_REGISTRY.register('round_robin')
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """Cycle through ready replicas (ref :85)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def select(self, in_flight: Dict[int, int],
+               exclude: Optional[Set[int]] = None
+               ) -> Optional[ReplicaEntry]:
+        with self._lock:
+            replicas = self._replicas
+            if exclude:
+                replicas = [e for e in replicas if e[0] not in exclude]
+            if not replicas:
+                return None
+            entry = replicas[self._index % len(replicas)]
+            self._index += 1
+            return entry
+
+
+@LB_POLICY_REGISTRY.register('least_load')
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Fewest in-flight requests wins (ref :111, the default)."""
+
+    def select(self, in_flight: Dict[int, int],
+               exclude: Optional[Set[int]] = None
+               ) -> Optional[ReplicaEntry]:
+        replicas = self._candidates(exclude)
+        if not replicas:
+            return None
+        return min(replicas, key=lambda e: (in_flight.get(e[0], 0), e[0]))
+
+
+@LB_POLICY_REGISTRY.register('instance_aware_least_load')
+class InstanceAwareLeastLoadPolicy(LoadBalancingPolicy):
+    """Least in-flight *per unit of capacity*: a v5e-8 replica takes 2x
+    the traffic of a v5e-4 one (ref :151 weights by instance type)."""
+
+    def select(self, in_flight: Dict[int, int],
+               exclude: Optional[Set[int]] = None
+               ) -> Optional[ReplicaEntry]:
+        replicas = self._candidates(exclude)
+        if not replicas:
+            return None
+        return min(replicas,
+                   key=lambda e: (in_flight.get(e[0], 0) / max(e[2], 1e-9),
+                                  e[0]))

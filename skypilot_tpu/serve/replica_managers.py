@@ -1,0 +1,277 @@
+"""Replica manager: launch/terminate/probe replicas, each a cluster.
+
+Parity: ``sky/serve/replica_managers.py`` (SkyPilotReplicaManager :764,
+ReplicaInfo :447, probe loop :717). Launch and teardown run in worker
+threads so the controller loop never blocks on provisioning; readiness
+comes from HTTP probes against the replica endpoint, and preemption is
+distinguished from app failure by asking the provider whether the
+cluster's hosts still exist (a spot TPU slice vanishes as a unit).
+"""
+from __future__ import annotations
+
+import http.client
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions, execution, state
+from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import common_utils, log
+
+logger = log.init_logger(__name__)
+
+NOT_READY_THRESHOLD = int(os.environ.get('SKYT_SERVE_NOT_READY_THRESHOLD',
+                                         '3'))
+
+REPLICA_PORT_ENV = 'SKYT_SERVE_REPLICA_PORT'
+REPLICA_ID_ENV = 'SKYT_SERVE_REPLICA_ID'
+
+
+class ReplicaManager:
+    """Drives the replica fleet of one service."""
+
+    def __init__(self, service_name: str, spec: ServiceSpec,
+                 task: Task) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self.backend = TpuPodBackend()
+        self._threads: Dict[int, threading.Thread] = {}
+        self._lock = threading.Lock()
+
+    # -- scale up/down -------------------------------------------------
+
+    def scale_up(self, *, use_spot: Optional[bool] = None,
+                 zone: Optional[str] = None,
+                 is_fallback: bool = False) -> int:
+        """Start one replica; returns its replica id immediately (launch
+        continues in a worker thread)."""
+        replica_id = serve_state.next_replica_id(self.service_name)
+        cluster_name = f'{self.service_name}-replica-{replica_id}'
+        task = self._replica_task(replica_id, use_spot=use_spot, zone=zone)
+        resources = task.resources[0]
+        serve_state.add_replica(self.service_name, replica_id, cluster_name,
+                                is_spot=bool(resources.use_spot),
+                                is_fallback=is_fallback)
+        thread = threading.Thread(
+            target=self._launch_replica,
+            args=(replica_id, cluster_name, task),
+            name=f'launch-{cluster_name}', daemon=True)
+        with self._lock:
+            self._threads[replica_id] = thread
+        thread.start()
+        logger.info('Service %s: launching replica %d (%s, spot=%s).',
+                    self.service_name, replica_id, cluster_name,
+                    resources.use_spot)
+        return replica_id
+
+    def scale_down(self, replica_id: int,
+                   status: ReplicaStatus = ReplicaStatus.TERMINATED) -> None:
+        """Terminate one replica asynchronously; its row stays with the
+        given terminal status (history, like the reference keeps
+        ReplicaInfo for failed replicas)."""
+        record = serve_state.get_replica(self.service_name, replica_id)
+        if record is None or record.status in (ReplicaStatus.SHUTTING_DOWN,
+                                               ReplicaStatus.TERMINATED):
+            return
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.SHUTTING_DOWN)
+        thread = threading.Thread(
+            target=self._teardown_replica,
+            args=(replica_id, record.cluster_name, status),
+            name=f'down-{record.cluster_name}', daemon=True)
+        thread.start()
+        logger.info('Service %s: scaling down replica %d (-> %s).',
+                    self.service_name, replica_id, status.value)
+
+    def join(self, timeout: float = 120.0) -> None:
+        """Wait for in-flight launch threads (used on shutdown)."""
+        deadline = time.time() + timeout
+        with self._lock:
+            threads = list(self._threads.values())
+        for thread in threads:
+            thread.join(max(0.0, deadline - time.time()))
+
+    # -- internals -----------------------------------------------------
+
+    def _replica_task(self, replica_id: int, *,
+                      use_spot: Optional[bool],
+                      zone: Optional[str]) -> Task:
+        """Per-replica task: inject the replica's identity/port envs and
+        any spot/zone overrides from the autoscaler/spot-placer."""
+        config = self.task.to_yaml_config()
+        task = Task.from_yaml_config(config)
+        port = (self.spec.port if self.spec.port is not None else
+                common_utils.find_free_port())
+        task.update_envs({
+            REPLICA_ID_ENV: str(replica_id),
+            REPLICA_PORT_ENV: str(port),
+        })
+        new_resources = []
+        for res in task.resources:
+            overrides = {}
+            if use_spot is not None:
+                overrides['use_spot'] = use_spot
+            if zone is not None:
+                overrides['zone'] = zone
+            new_resources.append(res.copy(**overrides) if overrides else res)
+        task.resources = new_resources
+        # Remember the port for endpoint construction after provisioning.
+        task._replica_port = port  # type: ignore[attr-defined]
+        return task
+
+    def _launch_replica(self, replica_id: int, cluster_name: str,
+                        task: Task) -> None:
+        try:
+            execution.launch(task, cluster_name, detach_run=True,
+                             backend=self.backend, stream_logs=False)
+        except exceptions.ResourcesUnavailableError as e:
+            logger.warning('Service %s: replica %d provision failed: %s',
+                           self.service_name, replica_id, e)
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED_PROVISION)
+            return
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('Service %s: replica %d launch crashed',
+                             self.service_name, replica_id)
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED_PROVISION)
+            return
+        record = state.get_cluster(cluster_name)
+        if record is None or not record.handle:
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED_PROVISION)
+            return
+        handle = record.handle
+        hosts = handle.get('hosts') or []
+        host = hosts[0] if hosts else {}
+        ip = host.get('external_ip') or host.get('internal_ip')
+        # The fake cloud executes replica commands locally, so its
+        # endpoints live on loopback.
+        if (handle.get('custom') or {}).get('fake'):
+            ip = '127.0.0.1'
+        if ip is None:
+            logger.warning('Service %s: replica %d has no reachable IP.',
+                           self.service_name, replica_id)
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED_PROVISION)
+            return
+        port = getattr(task, '_replica_port')
+        serve_state.set_replica_endpoint(self.service_name, replica_id,
+                                         f'http://{ip}:{port}',
+                                         record.zone)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.STARTING)
+
+    def _teardown_replica(self, replica_id: int, cluster_name: str,
+                          final_status: ReplicaStatus) -> None:
+        try:
+            self.backend.teardown(cluster_name, terminate=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Service %s: teardown of %s failed: %s',
+                           self.service_name, cluster_name, e)
+            state.remove_cluster(cluster_name)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       final_status)
+
+    # -- probing -------------------------------------------------------
+
+    def _probe_once(self, endpoint: str) -> bool:
+        url = urllib.parse.urljoin(endpoint, self.spec.readiness_path)
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=self.spec.probe_timeout_seconds) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, http.client.HTTPException,
+                socket.timeout, ConnectionError, OSError):
+            return False
+
+    def _cluster_preempted(self, cluster_name: str) -> bool:
+        record = state.get_cluster(cluster_name)
+        if record is None or record.cloud is None:
+            return True
+        from skypilot_tpu.provision.api import get_provider
+        try:
+            states = get_provider(record.cloud).query_instances(cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return False  # transient API error: not evidence of preemption
+        return not states or set(states.values()) != {'running'}
+
+    def probe_all(self) -> List[serve_state.ReplicaRecord]:
+        """Probe STARTING/READY/NOT_READY replicas; apply transitions.
+        Returns the refreshed replica list.
+
+        Preemption is detected from the provider, not the probe: a
+        replica can answer its readiness probe while its spot slice is
+        already marked for reclaim (and, conversely, an app can be dead
+        on a healthy cluster). The reference makes the same distinction
+        in its process-pool refresh (replica_managers.py:717).
+        """
+        now = time.time()
+        for record in serve_state.list_replicas(self.service_name,
+                                                include_terminal=False):
+            if record.status in (ReplicaStatus.READY,
+                                 ReplicaStatus.NOT_READY,
+                                 ReplicaStatus.STARTING):
+                if (record.endpoint is not None and
+                        self._cluster_preempted(record.cluster_name)):
+                    logger.warning('Service %s: replica %d preempted.',
+                                   self.service_name, record.replica_id)
+                    self.scale_down(record.replica_id,
+                                    ReplicaStatus.PREEMPTED)
+                    continue
+            if record.status == ReplicaStatus.STARTING:
+                if record.endpoint and self._probe_once(record.endpoint):
+                    logger.info('Service %s: replica %d is READY.',
+                                self.service_name, record.replica_id)
+                    serve_state.set_replica_status(self.service_name,
+                                                   record.replica_id,
+                                                   ReplicaStatus.READY)
+                elif (record.launched_at is not None and
+                      now - record.launched_at >
+                      self.spec.initial_delay_seconds):
+                    logger.warning(
+                        'Service %s: replica %d failed initial delay '
+                        '(%.0fs).', self.service_name, record.replica_id,
+                        self.spec.initial_delay_seconds)
+                    self.scale_down(record.replica_id,
+                                    ReplicaStatus.FAILED_INITIAL_DELAY)
+            elif record.status in (ReplicaStatus.READY,
+                                   ReplicaStatus.NOT_READY):
+                if record.endpoint and self._probe_once(record.endpoint):
+                    serve_state.set_replica_status(self.service_name,
+                                                   record.replica_id,
+                                                   ReplicaStatus.READY)
+                    continue
+                failures = serve_state.bump_replica_failures(
+                    self.service_name, record.replica_id)
+                if failures < NOT_READY_THRESHOLD:
+                    serve_state.set_replica_status(self.service_name,
+                                                   record.replica_id,
+                                                   ReplicaStatus.NOT_READY)
+                    continue
+                # Persistently unreachable: preempted or app-dead.
+                if self._cluster_preempted(record.cluster_name):
+                    logger.warning('Service %s: replica %d preempted.',
+                                   self.service_name, record.replica_id)
+                    self.scale_down(record.replica_id,
+                                    ReplicaStatus.PREEMPTED)
+                else:
+                    logger.warning(
+                        'Service %s: replica %d failed probing on a '
+                        'healthy cluster.', self.service_name,
+                        record.replica_id)
+                    self.scale_down(record.replica_id,
+                                    ReplicaStatus.FAILED_PROBING)
+        return serve_state.list_replicas(self.service_name)

@@ -1,0 +1,326 @@
+"""Serve DB: services + replicas (parity: ``sky/serve/serve_state.py``).
+
+One sqlite DB shared by the API server, the per-service controller
+process, and the CLI. Status enums mirror the reference's
+``ServiceStatus`` / ``ReplicaStatus``.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'     # controller up, no replica ready yet
+    READY = 'READY'                   # >=1 replica ready
+    NO_REPLICA = 'NO_REPLICA'         # was ready; all replicas gone
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+    FAILED = 'FAILED'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.CONTROLLER_FAILED,
+                        ServiceStatus.FAILED)
+
+
+class ReplicaStatus(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'             # cluster up, waiting on readiness
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'           # probe failures; may recover
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    TERMINATED = 'TERMINATED'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.PREEMPTED,
+                        ReplicaStatus.FAILED_PROVISION,
+                        ReplicaStatus.FAILED_INITIAL_DELAY,
+                        ReplicaStatus.FAILED_PROBING,
+                        ReplicaStatus.TERMINATED)
+
+    def is_failure(self) -> bool:
+        return self in (ReplicaStatus.FAILED_PROVISION,
+                        ReplicaStatus.FAILED_INITIAL_DELAY,
+                        ReplicaStatus.FAILED_PROBING)
+
+
+def serve_dir() -> str:
+    return os.path.join(
+        os.environ.get('SKYT_STATE_DIR', os.path.expanduser('~/.skyt')),
+        'serve')
+
+
+def controller_log_path(service_name: str) -> str:
+    return os.path.join(serve_dir(), 'logs', f'{service_name}.log')
+
+
+_local = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(serve_dir(), 'serve.db')
+    conn = getattr(_local, 'conn', None)
+    if (conn is not None and getattr(_local, 'path', None) == path and
+            getattr(_local, 'pid', None) == os.getpid()):
+        return conn
+    os.makedirs(serve_dir(), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            spec TEXT NOT NULL,           -- ServiceSpec.to_yaml_config()
+            task_config TEXT NOT NULL,    -- Task.to_yaml_config()
+            status TEXT NOT NULL,
+            shutdown_requested INTEGER DEFAULT 0,
+            controller_pid INTEGER,
+            lb_port INTEGER,
+            requested_at REAL,
+            failure_reason TEXT
+        );
+        CREATE TABLE IF NOT EXISTS replicas (
+            service_name TEXT NOT NULL,
+            replica_id INTEGER NOT NULL,
+            cluster_name TEXT NOT NULL,
+            status TEXT NOT NULL,
+            endpoint TEXT,
+            is_spot INTEGER DEFAULT 0,
+            is_fallback INTEGER DEFAULT 0,  -- dynamic on-demand backfill
+            zone TEXT,
+            launched_at REAL,
+            ready_at REAL,
+            consecutive_failures INTEGER DEFAULT 0,
+            PRIMARY KEY (service_name, replica_id)
+        );
+    """)
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    _local.pid = os.getpid()
+    return conn
+
+
+# -- services ---------------------------------------------------------------
+
+
+class ServiceRecord:
+    def __init__(self, row: sqlite3.Row) -> None:
+        self.name: str = row['name']
+        self.spec: Dict[str, Any] = json.loads(row['spec'])
+        self.task_config: Dict[str, Any] = json.loads(row['task_config'])
+        self.status = ServiceStatus(row['status'])
+        self.shutdown_requested = bool(row['shutdown_requested'])
+        self.controller_pid: Optional[int] = row['controller_pid']
+        self.lb_port: Optional[int] = row['lb_port']
+        self.requested_at: Optional[float] = row['requested_at']
+        self.failure_reason: Optional[str] = row['failure_reason']
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'name': self.name,
+            'status': self.status.value,
+            'lb_port': self.lb_port,
+            'requested_at': self.requested_at,
+            'failure_reason': self.failure_reason,
+            'replicas': [r.to_dict() for r in list_replicas(self.name)],
+        }
+
+
+def add_service(name: str, spec: Dict[str, Any],
+                task_config: Dict[str, Any], lb_port: int) -> bool:
+    conn = _db()
+    try:
+        conn.execute(
+            'INSERT INTO services (name, spec, task_config, status, '
+            'lb_port, requested_at) VALUES (?, ?, ?, ?, ?, ?)',
+            (name, json.dumps(spec), json.dumps(task_config),
+             ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
+        conn.commit()
+        return True
+    except sqlite3.IntegrityError:
+        return False
+
+
+def get_service(name: str) -> Optional[ServiceRecord]:
+    row = _db().execute('SELECT * FROM services WHERE name = ?',
+                        (name,)).fetchone()
+    return ServiceRecord(row) if row else None
+
+
+def list_services() -> List[ServiceRecord]:
+    rows = _db().execute('SELECT * FROM services ORDER BY name').fetchall()
+    return [ServiceRecord(r) for r in rows]
+
+
+def set_service_status(name: str, status: ServiceStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    conn = _db()
+    if failure_reason is not None:
+        conn.execute(
+            'UPDATE services SET status = ?, failure_reason = ? '
+            'WHERE name = ?', (status.value, failure_reason, name))
+    else:
+        conn.execute('UPDATE services SET status = ? WHERE name = ?',
+                     (status.value, name))
+    conn.commit()
+
+
+def set_controller_pid(name: str, pid: int) -> None:
+    conn = _db()
+    conn.execute('UPDATE services SET controller_pid = ? WHERE name = ?',
+                 (pid, name))
+    conn.commit()
+
+
+def request_shutdown(name: str) -> None:
+    conn = _db()
+    conn.execute(
+        'UPDATE services SET shutdown_requested = 1, status = ? '
+        'WHERE name = ?', (ServiceStatus.SHUTTING_DOWN.value, name))
+    conn.commit()
+
+
+def shutdown_requested(name: str) -> bool:
+    row = _db().execute(
+        'SELECT shutdown_requested FROM services WHERE name = ?',
+        (name,)).fetchone()
+    return bool(row and row['shutdown_requested'])
+
+
+def remove_service(name: str) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM replicas WHERE service_name = ?', (name,))
+    conn.execute('DELETE FROM services WHERE name = ?', (name,))
+    conn.commit()
+
+
+# -- replicas ---------------------------------------------------------------
+
+
+class ReplicaRecord:
+    def __init__(self, row: sqlite3.Row) -> None:
+        self.service_name: str = row['service_name']
+        self.replica_id: int = row['replica_id']
+        self.cluster_name: str = row['cluster_name']
+        self.status = ReplicaStatus(row['status'])
+        self.endpoint: Optional[str] = row['endpoint']
+        self.is_spot = bool(row['is_spot'])
+        self.is_fallback = bool(row['is_fallback'])
+        self.zone: Optional[str] = row['zone']
+        self.launched_at: Optional[float] = row['launched_at']
+        self.ready_at: Optional[float] = row['ready_at']
+        self.consecutive_failures: int = row['consecutive_failures']
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'replica_id': self.replica_id,
+            'cluster_name': self.cluster_name,
+            'status': self.status.value,
+            'endpoint': self.endpoint,
+            'is_spot': self.is_spot,
+            'is_fallback': self.is_fallback,
+            'zone': self.zone,
+            'launched_at': self.launched_at,
+            'ready_at': self.ready_at,
+        }
+
+
+def next_replica_id(service_name: str) -> int:
+    row = _db().execute(
+        'SELECT MAX(replica_id) AS m FROM replicas WHERE service_name = ?',
+        (service_name,)).fetchone()
+    return (row['m'] or 0) + 1
+
+
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                *, is_spot: bool, is_fallback: bool = False) -> None:
+    conn = _db()
+    conn.execute(
+        'INSERT INTO replicas (service_name, replica_id, cluster_name, '
+        'status, is_spot, is_fallback, launched_at) '
+        'VALUES (?, ?, ?, ?, ?, ?, ?)',
+        (service_name, replica_id, cluster_name,
+         ReplicaStatus.PROVISIONING.value, int(is_spot), int(is_fallback),
+         time.time()))
+    conn.commit()
+
+
+def get_replica(service_name: str,
+                replica_id: int) -> Optional[ReplicaRecord]:
+    row = _db().execute(
+        'SELECT * FROM replicas WHERE service_name = ? AND replica_id = ?',
+        (service_name, replica_id)).fetchone()
+    return ReplicaRecord(row) if row else None
+
+
+def list_replicas(service_name: str,
+                  include_terminal: bool = True) -> List[ReplicaRecord]:
+    rows = _db().execute(
+        'SELECT * FROM replicas WHERE service_name = ? ORDER BY replica_id',
+        (service_name,)).fetchall()
+    records = [ReplicaRecord(r) for r in rows]
+    if not include_terminal:
+        records = [r for r in records if not r.status.is_terminal()]
+    return records
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus) -> None:
+    conn = _db()
+    if status == ReplicaStatus.READY:
+        conn.execute(
+            'UPDATE replicas SET status = ?, consecutive_failures = 0, '
+            'ready_at = COALESCE(ready_at, ?) '
+            'WHERE service_name = ? AND replica_id = ?',
+            (status.value, time.time(), service_name, replica_id))
+    else:
+        conn.execute(
+            'UPDATE replicas SET status = ? '
+            'WHERE service_name = ? AND replica_id = ?',
+            (status.value, service_name, replica_id))
+    conn.commit()
+
+
+def set_replica_endpoint(service_name: str, replica_id: int, endpoint: str,
+                         zone: Optional[str]) -> None:
+    conn = _db()
+    conn.execute(
+        'UPDATE replicas SET endpoint = ?, zone = ? '
+        'WHERE service_name = ? AND replica_id = ?',
+        (endpoint, zone, service_name, replica_id))
+    conn.commit()
+
+
+def bump_replica_failures(service_name: str, replica_id: int) -> int:
+    conn = _db()
+    conn.execute(
+        'UPDATE replicas SET consecutive_failures = '
+        'consecutive_failures + 1 '
+        'WHERE service_name = ? AND replica_id = ?',
+        (service_name, replica_id))
+    conn.commit()
+    row = conn.execute(
+        'SELECT consecutive_failures FROM replicas '
+        'WHERE service_name = ? AND replica_id = ?',
+        (service_name, replica_id)).fetchone()
+    return row['consecutive_failures'] if row else 0
+
+
+def reset_replica_failures(service_name: str, replica_id: int) -> None:
+    conn = _db()
+    conn.execute(
+        'UPDATE replicas SET consecutive_failures = 0 '
+        'WHERE service_name = ? AND replica_id = ?',
+        (service_name, replica_id))
+    conn.commit()

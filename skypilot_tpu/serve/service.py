@@ -1,0 +1,61 @@
+"""Detached per-service process: load balancer thread + controller loop.
+
+Parity: ``sky/serve/service.py`` (which spawns controller + LB as two
+processes on the serve controller cluster). Spawned by ``serve.core.up``
+via ``daemonize_and_run``; exits when a shutdown request lands in the
+serve DB (``serve down``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.controller import ServeController
+from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                              start_load_balancer)
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+def run_service(service_name: str) -> None:
+    record = serve_state.get_service(service_name)
+    assert record is not None, f'service {service_name} not in DB'
+    spec = ServiceSpec.from_yaml_config(record.spec)
+    task = Task.from_yaml_config(record.task_config)
+    serve_state.set_controller_pid(service_name, os.getpid())
+
+    policy = LoadBalancingPolicy.make(spec.load_balancing_policy)
+    lb = LoadBalancer(policy, qps_window_seconds=spec.qps_window_seconds)
+    host = os.environ.get('SKYT_SERVE_LB_HOST', '127.0.0.1')
+    assert record.lb_port is not None
+    server = start_load_balancer(lb, host, record.lb_port)
+
+    controller = ServeController(service_name, spec, task, lb)
+    try:
+        controller.run()
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('Service %s: controller crashed', service_name)
+        serve_state.set_service_status(service_name,
+                                       ServiceStatus.CONTROLLER_FAILED,
+                                       failure_reason='controller crashed')
+        raise
+    finally:
+        server.shutdown()
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser('serve service process')
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args(argv)
+    run_service(args.service_name)
+
+
+if __name__ == '__main__':
+    main()

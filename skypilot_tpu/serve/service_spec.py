@@ -1,0 +1,193 @@
+"""The ``service:`` section of a task YAML.
+
+Parity: ``sky/serve/service_spec.py`` (SkyServiceSpec). Two policy
+shapes:
+
+* fixed — ``replicas: N``;
+* autoscaled — ``replica_policy:`` with min/max replicas and a load
+  target (``target_qps_per_replica`` or ``target_queue_length``).
+
+Spot-with-fallback knobs (``base_ondemand_fallback_replicas``,
+``dynamic_ondemand_fallback``) mirror the reference's FallbackAutoscaler
+(sky/serve/autoscalers.py:933): TPU spot slices are cheap but vanish as
+a unit, so a service can keep a floor of on-demand replicas and/or
+temporarily backfill with on-demand while spot recovers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_PROBE_TIMEOUT_SECONDS = 15
+DEFAULT_UPSCALE_DELAY_SECONDS = 300
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+DEFAULT_QPS_WINDOW_SECONDS = 60
+
+
+class ServiceSpec:
+    """Validated service section (ref service_spec.py SkyServiceSpec)."""
+
+    def __init__(
+        self,
+        *,
+        port: Optional[int] = None,
+        readiness_path: str = '/',
+        initial_delay_seconds: float = DEFAULT_INITIAL_DELAY_SECONDS,
+        probe_timeout_seconds: float = DEFAULT_PROBE_TIMEOUT_SECONDS,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        target_qps_per_replica: Optional[float] = None,
+        target_queue_length: Optional[float] = None,
+        upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS,
+        downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS,
+        qps_window_seconds: float = DEFAULT_QPS_WINDOW_SECONDS,
+        base_ondemand_fallback_replicas: int = 0,
+        dynamic_ondemand_fallback: bool = False,
+        load_balancing_policy: str = 'least_load',
+    ) -> None:
+        if not readiness_path.startswith('/'):
+            raise exceptions.InvalidSpecError(
+                f'readiness path must start with "/": {readiness_path!r}')
+        if min_replicas < 0:
+            raise exceptions.InvalidSpecError('min_replicas must be >= 0')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise exceptions.InvalidSpecError(
+                f'max_replicas {max_replicas} < min_replicas {min_replicas}')
+        if (target_qps_per_replica is not None and
+                target_queue_length is not None):
+            raise exceptions.InvalidSpecError(
+                'Set only one of target_qps_per_replica / '
+                'target_queue_length.')
+        autoscaling = (target_qps_per_replica is not None or
+                       target_queue_length is not None)
+        if autoscaling and max_replicas is None:
+            raise exceptions.InvalidSpecError(
+                'Autoscaling (a load target) requires max_replicas.')
+        self.port = port
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = float(initial_delay_seconds)
+        self.probe_timeout_seconds = float(probe_timeout_seconds)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = (int(max_replicas)
+                             if max_replicas is not None else None)
+        self.target_qps_per_replica = target_qps_per_replica
+        self.target_queue_length = target_queue_length
+        self.upscale_delay_seconds = float(upscale_delay_seconds)
+        self.downscale_delay_seconds = float(downscale_delay_seconds)
+        self.qps_window_seconds = float(qps_window_seconds)
+        self.base_ondemand_fallback_replicas = int(
+            base_ondemand_fallback_replicas)
+        self.dynamic_ondemand_fallback = bool(dynamic_ondemand_fallback)
+        self.load_balancing_policy = load_balancing_policy
+
+    @property
+    def autoscaling(self) -> bool:
+        return (self.target_qps_per_replica is not None or
+                self.target_queue_length is not None)
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        """Parse the ``service:`` dict (ref from_yaml_config).
+
+        Accepted shapes::
+
+            service:
+              port: 8080
+              readiness_probe: /health          # or a dict with path,
+              replicas: 2                       #   initial_delay_seconds
+            ---
+            service:
+              port: 8080
+              readiness_probe: {path: /health, initial_delay_seconds: 60}
+              replica_policy:
+                min_replicas: 1
+                max_replicas: 4
+                target_qps_per_replica: 10
+        """
+        config = dict(config or {})
+        kwargs: Dict[str, Any] = {}
+        if 'port' in config and config['port'] is not None:
+            kwargs['port'] = int(config['port'])
+        probe = config.get('readiness_probe', '/')
+        if isinstance(probe, str):
+            kwargs['readiness_path'] = probe
+        elif isinstance(probe, dict):
+            kwargs['readiness_path'] = probe.get('path', '/')
+            if 'initial_delay_seconds' in probe:
+                kwargs['initial_delay_seconds'] = probe[
+                    'initial_delay_seconds']
+            if 'timeout_seconds' in probe:
+                kwargs['probe_timeout_seconds'] = probe['timeout_seconds']
+        else:
+            raise exceptions.InvalidSpecError(
+                f'readiness_probe must be a path or dict: {probe!r}')
+        if 'replicas' in config and 'replica_policy' in config:
+            raise exceptions.InvalidSpecError(
+                'Set only one of replicas / replica_policy.')
+        if 'replicas' in config:
+            n = int(config['replicas'])
+            kwargs['min_replicas'] = n
+            kwargs['max_replicas'] = n
+        policy = config.get('replica_policy')
+        if policy is not None:
+            for key in ('min_replicas', 'max_replicas',
+                        'target_qps_per_replica', 'target_queue_length',
+                        'upscale_delay_seconds', 'downscale_delay_seconds',
+                        'qps_window_seconds',
+                        'base_ondemand_fallback_replicas',
+                        'dynamic_ondemand_fallback'):
+                if key in policy:
+                    kwargs[key] = policy[key]
+        if 'load_balancing_policy' in config:
+            kwargs['load_balancing_policy'] = config[
+                'load_balancing_policy']
+        unknown = set(config) - {
+            'port', 'readiness_probe', 'replicas', 'replica_policy',
+            'load_balancing_policy'
+        }
+        if unknown:
+            raise exceptions.InvalidSpecError(
+                f'Unknown service fields: {sorted(unknown)}')
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.probe_timeout_seconds,
+            },
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+        if self.port is not None:
+            config['port'] = self.port
+        policy: Dict[str, Any] = {
+            'min_replicas': self.min_replicas,
+            'upscale_delay_seconds': self.upscale_delay_seconds,
+            'downscale_delay_seconds': self.downscale_delay_seconds,
+            'qps_window_seconds': self.qps_window_seconds,
+        }
+        if self.max_replicas is not None:
+            policy['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            policy['target_qps_per_replica'] = self.target_qps_per_replica
+        if self.target_queue_length is not None:
+            policy['target_queue_length'] = self.target_queue_length
+        if self.base_ondemand_fallback_replicas:
+            policy['base_ondemand_fallback_replicas'] = (
+                self.base_ondemand_fallback_replicas)
+        if self.dynamic_ondemand_fallback:
+            policy['dynamic_ondemand_fallback'] = True
+        config['replica_policy'] = policy
+        return config
+
+    def __repr__(self) -> str:
+        if self.autoscaling:
+            scale = (f'{self.min_replicas}..{self.max_replicas} '
+                     f'(qps/replica={self.target_qps_per_replica}, '
+                     f'queue={self.target_queue_length})')
+        else:
+            scale = str(self.min_replicas)
+        return f'ServiceSpec(port={self.port}, replicas={scale})'

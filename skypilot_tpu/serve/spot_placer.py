@@ -1,0 +1,52 @@
+"""Spot placement across zones (parity: ``sky/serve/spot_placer.py``
+SpotPlacer :170 / DynamicFallbackSpotPlacer :254).
+
+Zones are classified ACTIVE (no recent preemption) or PREEMPTIVE
+(preempted recently). New spot replicas go to ACTIVE zones round-robin;
+a preemption demotes its zone for a cooldown, after which it is retried
+— TPU spot capacity is strongly zone-correlated, so spreading replicas
+over zones is the main availability lever.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+PREEMPTION_COOLDOWN_SECONDS = 1800.0
+
+
+class DynamicFallbackSpotPlacer:
+    def __init__(self, zones: List[str],
+                 cooldown: float = PREEMPTION_COOLDOWN_SECONDS) -> None:
+        self._zones = list(zones)
+        self._cooldown = cooldown
+        self._preempted_at: Dict[str, float] = {}
+        self._next = 0
+
+    def active_zones(self) -> List[str]:
+        now = time.time()
+        active = [
+            z for z in self._zones
+            if now - self._preempted_at.get(z, 0) > self._cooldown
+        ]
+        # All zones preemptive: fall back to the least-recently-preempted
+        # rather than refusing to place (ref :254 Dynamic*Fallback*).
+        if not active and self._zones:
+            active = sorted(self._zones,
+                            key=lambda z: self._preempted_at.get(z, 0))[:1]
+        return active
+
+    def select(self) -> Optional[str]:
+        """Zone for the next spot replica (round-robin over active)."""
+        active = self.active_zones()
+        if not active:
+            return None
+        zone = active[self._next % len(active)]
+        self._next += 1
+        return zone
+
+    def handle_preemption(self, zone: Optional[str]) -> None:
+        if zone is not None:
+            self._preempted_at[zone] = time.time()
+            if zone not in self._zones:
+                self._zones.append(zone)

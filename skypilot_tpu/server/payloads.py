@@ -67,6 +67,29 @@ def _jobs_logs(job_id: int, controller: bool = False) -> None:
     print(jobs_core.tail_logs(job_id, controller=controller), end='')
 
 
+def _serve_up(task_config: Dict[str, Any],
+              service_name: Optional[str] = None) -> Dict[str, Any]:
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.up(Task.from_yaml_config(task_config), service_name)
+
+
+def _serve_down(service_name: str, purge: bool = False) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(service_name, purge=purge)
+
+
+def _serve_status(
+        service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.status(service_name)
+
+
+def _serve_logs(service_name: str,
+                replica_id: Optional[int] = None) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    print(serve_core.tail_logs(service_name, replica_id), end='')
+
+
 # name -> (callable, schedule type). LONG = holds cloud resources/locks for
 # minutes (parity: executor.py queue split).
 PAYLOADS: Dict[str, Tuple[Callable[..., Any], ScheduleType]] = {
@@ -87,4 +110,9 @@ PAYLOADS: Dict[str, Tuple[Callable[..., Any], ScheduleType]] = {
     'jobs/queue': (_jobs_queue, ScheduleType.SHORT),
     'jobs/cancel': (_jobs_cancel, ScheduleType.SHORT),
     'jobs/logs': (_jobs_logs, ScheduleType.SHORT),
+    # Serving: submission is quick (the service process does the work).
+    'serve/up': (_serve_up, ScheduleType.SHORT),
+    'serve/down': (_serve_down, ScheduleType.SHORT),
+    'serve/status': (_serve_status, ScheduleType.SHORT),
+    'serve/logs': (_serve_logs, ScheduleType.SHORT),
 }

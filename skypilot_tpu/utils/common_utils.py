@@ -136,3 +136,12 @@ def readable_duration(seconds: float) -> str:
     if seconds < 3600:
         return f'{seconds // 60}m {seconds % 60}s'
     return f'{seconds // 3600}h {(seconds % 3600) // 60}m'
+
+
+def find_free_port(host: str = '127.0.0.1') -> int:
+    """An OS-assigned free TCP port (racy by nature; callers bind soon
+    after)."""
+    import socket
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -112,3 +112,11 @@ def test_workdir_upload_content_addressed(server, tmp_path):
     buf = io.StringIO()
     sdk.stream_and_get(sdk.tail_logs('up-e2e', 1), output=buf)
     assert 'uploaded-data' in buf.getvalue()
+
+
+def test_serve_endpoints_roundtrip(server):
+    # No services yet.
+    assert sdk.get(sdk.serve_status()) == []
+    # Unknown service errors propagate through the executor.
+    with pytest.raises(exceptions.RequestFailedError):
+        sdk.get(sdk.serve_down('nope'))

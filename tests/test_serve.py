@@ -1,0 +1,295 @@
+"""Serving tests: spec parsing, LB policies, autoscaler decisions (pure),
+and end-to-end service lifecycle against the fake cloud (the reference
+covers serving with tests/test_jobs_and_serve.py + real-cloud smoke
+tests; here replicas are real local HTTP servers)."""
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import fake
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import (Autoscaler, DecisionOp,
+                                            FallbackAutoscaler, LoadStats,
+                                            RequestRateAutoscaler)
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+# A replica payload: stdlib HTTP server on the port the replica manager
+# assigns, responding 200 on every path (incl. /health).
+ECHO_SERVER = ('python3 -m http.server "$SKYT_SERVE_REPLICA_PORT" '
+               '--bind 127.0.0.1')
+
+
+@pytest.fixture(autouse=True)
+def fast_serve(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_SERVE_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_SERVE_NOT_READY_THRESHOLD', '2')
+    fake.reset()
+    yield
+    for record in serve_state.list_services():
+        try:
+            serve_core.down(record.name, purge=True)
+        except exceptions.SkytError:
+            pass
+    fake.reset()
+
+
+def _service_task(replicas=1, **service_extra):
+    service = {
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+        **service_extra,
+    }
+    if 'replica_policy' not in service_extra:
+        service['replicas'] = replicas
+    return Task(name='svc', run=ECHO_SERVER,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8'),
+                service=service)
+
+
+def _wait_service(name, statuses, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_state.get_service(name)
+        if record and record.status.value in statuses:
+            return record
+        time.sleep(0.2)
+    record = serve_state.get_service(name)
+    raise AssertionError(
+        f'service {name} stuck in '
+        f'{record.status.value if record else None}; wanted {statuses}. '
+        f'Controller log:\n{serve_core.tail_logs(name)[-4000:]}')
+
+
+# -- spec -------------------------------------------------------------------
+
+
+def test_service_spec_fixed_replicas():
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replicas': 3,
+    })
+    assert spec.min_replicas == spec.max_replicas == 3
+    assert not spec.autoscaling
+    assert spec.readiness_path == '/health'
+
+
+def test_service_spec_autoscaling_roundtrip():
+    spec = ServiceSpec.from_yaml_config({
+        'port': 9000,
+        'readiness_probe': {'path': '/h', 'initial_delay_seconds': 10},
+        'replica_policy': {
+            'min_replicas': 1,
+            'max_replicas': 5,
+            'target_qps_per_replica': 2.5,
+            'base_ondemand_fallback_replicas': 1,
+            'dynamic_ondemand_fallback': True,
+        },
+    })
+    spec2 = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert spec2.port == 9000
+    assert spec2.max_replicas == 5
+    assert spec2.target_qps_per_replica == 2.5
+    assert spec2.dynamic_ondemand_fallback
+
+
+def test_service_spec_rejects_bad_configs():
+    with pytest.raises(exceptions.InvalidSpecError):
+        ServiceSpec.from_yaml_config({'replicas': 2,
+                                      'replica_policy': {'min_replicas': 1}})
+    with pytest.raises(exceptions.InvalidSpecError):
+        ServiceSpec.from_yaml_config(
+            {'replica_policy': {'min_replicas': 1,
+                                'target_qps_per_replica': 1}})
+    with pytest.raises(exceptions.InvalidSpecError):
+        ServiceSpec.from_yaml_config({'unknown_field': 1})
+
+
+# -- LB policies ------------------------------------------------------------
+
+
+def test_round_robin_policy():
+    policy = LoadBalancingPolicy.make('round_robin')
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0)])
+    picks = [policy.select({})[0] for _ in range(4)]
+    assert picks == [1, 2, 1, 2]
+
+
+def test_least_load_policy():
+    policy = LoadBalancingPolicy.make('least_load')
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0)])
+    assert policy.select({1: 5, 2: 1})[0] == 2
+    assert policy.select({1: 0, 2: 1})[0] == 1
+
+
+def test_instance_aware_policy_weights_by_capacity():
+    policy = LoadBalancingPolicy.make('instance_aware_least_load')
+    # Replica 2 has 4x capacity: 4 in-flight there ~ 1 in-flight on r1.
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 4.0)])
+    assert policy.select({1: 2, 2: 4})[0] == 2
+
+
+# -- autoscalers (pure) -----------------------------------------------------
+
+
+def _spec(**kw):
+    defaults = dict(min_replicas=1, max_replicas=4,
+                    target_qps_per_replica=10,
+                    upscale_delay_seconds=0, downscale_delay_seconds=0)
+    defaults.update(kw)
+    return ServiceSpec(**defaults)
+
+
+class _FakeReplica:
+    def __init__(self, replica_id, status=serve_state.ReplicaStatus.READY,
+                 is_spot=False, is_fallback=False):
+        self.replica_id = replica_id
+        self.status = status
+        self.is_spot = is_spot
+        self.is_fallback = is_fallback
+        self.zone = None
+
+
+def test_request_rate_autoscaler_scales_up_and_down():
+    scaler = RequestRateAutoscaler(_spec())
+    replicas = [_FakeReplica(1)]
+    ups = scaler.evaluate(LoadStats(qps=35), replicas)
+    assert ups[0].op == DecisionOp.SCALE_UP and ups[0].count == 3
+    downs = scaler.evaluate(LoadStats(qps=0), replicas + [
+        _FakeReplica(2), _FakeReplica(3), _FakeReplica(4)])
+    assert sum(1 for d in downs
+               if d.op == DecisionOp.SCALE_DOWN) == 3
+    # Newest replicas are the victims.
+    assert {d.replica_id for d in downs} == {2, 3, 4}
+
+
+def test_autoscaler_hysteresis_delays_upscale():
+    scaler = RequestRateAutoscaler(_spec(upscale_delay_seconds=3600))
+    replicas = [_FakeReplica(1)]
+    assert scaler.evaluate(LoadStats(qps=35), replicas) == []
+    assert scaler.evaluate(LoadStats(qps=35), replicas) == []
+
+
+def test_autoscaler_respects_max_replicas():
+    scaler = RequestRateAutoscaler(_spec())
+    ups = scaler.evaluate(LoadStats(qps=1000), [_FakeReplica(1)])
+    assert ups[0].count == 3  # capped at max_replicas=4
+
+
+def test_fallback_autoscaler_keeps_ondemand_base():
+    scaler = FallbackAutoscaler(
+        _spec(min_replicas=3, max_replicas=3,
+              target_qps_per_replica=None,
+              base_ondemand_fallback_replicas=1))
+    decisions = scaler.evaluate(LoadStats(), [])
+    spot_ups = [d for d in decisions
+                if d.op == DecisionOp.SCALE_UP and d.use_spot]
+    od_ups = [d for d in decisions
+              if d.op == DecisionOp.SCALE_UP and d.use_spot is False]
+    assert sum(d.count for d in od_ups) == 1
+    assert sum(d.count for d in spot_ups) == 2
+
+
+def test_fallback_autoscaler_dynamic_backfill():
+    scaler = FallbackAutoscaler(
+        _spec(min_replicas=2, max_replicas=2,
+              target_qps_per_replica=None,
+              dynamic_ondemand_fallback=True))
+    # Both spot replicas exist but neither is READY yet -> backfill 2 OD.
+    replicas = [
+        _FakeReplica(1, serve_state.ReplicaStatus.PROVISIONING,
+                     is_spot=True),
+        _FakeReplica(2, serve_state.ReplicaStatus.PROVISIONING,
+                     is_spot=True),
+    ]
+    decisions = scaler.evaluate(LoadStats(), replicas)
+    backfills = [d for d in decisions
+                 if d.op == DecisionOp.SCALE_UP and d.is_fallback]
+    assert sum(d.count for d in backfills) == 2
+    # Spot became READY -> the fallback replicas are scaled down.
+    replicas = [
+        _FakeReplica(1, serve_state.ReplicaStatus.READY, is_spot=True),
+        _FakeReplica(2, serve_state.ReplicaStatus.READY, is_spot=True),
+        _FakeReplica(3, is_fallback=True),
+        _FakeReplica(4, is_fallback=True),
+    ]
+    decisions = scaler.evaluate(LoadStats(), replicas)
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    assert {d.replica_id for d in downs} == {3, 4}
+
+
+# -- end to end -------------------------------------------------------------
+
+
+def test_serve_up_ready_and_proxies_requests():
+    result = serve_core.up(_service_task(replicas=2), 'echo')
+    record = _wait_service('echo', {'READY'})
+    replicas = serve_state.list_replicas('echo')
+    ready = [r for r in replicas
+             if r.status == serve_state.ReplicaStatus.READY]
+    assert len(ready) >= 1
+    # Wait for both replicas so the LB has a fleet.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        ready = [r for r in serve_state.list_replicas('echo')
+                 if r.status == serve_state.ReplicaStatus.READY]
+        if len(ready) == 2:
+            break
+        time.sleep(0.2)
+    assert len(ready) == 2
+    # The LB proxies to a replica (http.server returns a directory
+    # listing with 200).
+    time.sleep(1.0)  # let the controller sync the fleet to the LB
+    with urllib.request.urlopen(result['endpoint'], timeout=10) as resp:
+        assert resp.status == 200
+    status = serve_core.status('echo')[0]
+    assert status['status'] == 'READY'
+    assert len(status['replicas']) == 2
+
+
+def test_serve_replica_recovers_from_preemption():
+    serve_core.up(_service_task(replicas=1), 'recov')
+    _wait_service('recov', {'READY'})
+    replica = serve_state.list_replicas('recov')[0]
+    fake.preempt_cluster(replica.cluster_name)
+    # Probe failures accumulate -> PREEMPTED -> autoscaler replaces it.
+    deadline = time.time() + 90
+    replaced = None
+    while time.time() < deadline:
+        replicas = serve_state.list_replicas('recov')
+        ready = [r for r in replicas
+                 if r.replica_id != replica.replica_id and
+                 r.status == serve_state.ReplicaStatus.READY]
+        if ready:
+            replaced = ready[0]
+            break
+        time.sleep(0.3)
+    assert replaced is not None, (
+        f'no replacement replica; log:\n'
+        f'{serve_core.tail_logs("recov")[-4000:]}')
+    old = serve_state.get_replica('recov', replica.replica_id)
+    assert old.status == serve_state.ReplicaStatus.PREEMPTED
+
+
+def test_serve_down_tears_down_replicas():
+    serve_core.up(_service_task(replicas=1), 'teard')
+    _wait_service('teard', {'READY'})
+    replica = serve_state.list_replicas('teard')[0]
+    serve_core.down('teard')
+    deadline = time.time() + 60
+    while serve_state.get_service('teard') and time.time() < deadline:
+        time.sleep(0.2)
+    assert serve_state.get_service('teard') is None
+    assert replica.cluster_name not in fake.list_fake_clusters()
+
+
+def test_serve_duplicate_name_rejected():
+    serve_core.up(_service_task(replicas=1), 'dup')
+    with pytest.raises(exceptions.ServiceAlreadyExistsError):
+        serve_core.up(_service_task(replicas=1), 'dup')
