@@ -166,7 +166,8 @@ def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
                                 rules=rules)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
-    out = multi_head_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    out = multi_head_attention(q, k, v, causal=True,
+                               impl=cfg.attention_impl)
     out = jnp.einsum('bshk,hkd->bsd', out, lp['wo'].astype(dt))
     return out
 

@@ -78,7 +78,13 @@ def multi_head_attention(q: jax.Array,
                          causal: bool = True,
                          segment_ids: Optional[jax.Array] = None,
                          impl: str = 'auto') -> jax.Array:
-    """Dispatching attention entry point used by models/."""
+    """Dispatching attention entry point used by models/.
+
+    impl: 'auto' | 'xla' | 'pallas' | 'ring' | 'ulysses'. The last two
+    are the sequence-parallel paths (ops/ring_attention.py, manual only
+    over the ``seq`` mesh axis — the ambient mesh supplies it); they do
+    not support packed-sequence `segment_ids` yet.
+    """
     if impl == 'auto':
         impl = 'pallas' if (_on_tpu() and _pallas_available()) else 'xla'
     if impl == 'pallas':
@@ -87,4 +93,11 @@ def multi_head_attention(q: jax.Array,
                                                segment_ids=segment_ids)
     if impl == 'xla':
         return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if impl in ('ring', 'ulysses'):
+        if segment_ids is not None:
+            raise NotImplementedError(
+                f'{impl} attention does not support segment_ids yet')
+        from skypilot_tpu.ops import ring_attention as ra  # lazy
+        fn = ra.ring_attention if impl == 'ring' else ra.ulysses_attention
+        return fn(q, k, v, causal=causal)
     raise ValueError(f'Unknown attention impl {impl!r}')

@@ -1,0 +1,283 @@
+"""Sequence/context-parallel attention over the ``seq`` mesh axis.
+
+Long-context deliverable (SURVEY.md §5): the reference framework has no
+sequence parallelism of its own (it only launches payloads that do);
+here it is a first-class op. Two flavors, both expressed with
+``shard_map`` so the collectives ride the ICI mesh axis explicitly:
+
+* **Ring attention** (`ring_attention`): K/V blocks rotate around the
+  ``seq`` axis with `lax.ppermute` while each device keeps its local Q
+  block, accumulating flash-style (m, l, acc) running-softmax stats in
+  fp32. Memory per device is O(S/n) for K/V — no all-gather of the
+  full sequence — so context length scales linearly with the ring
+  size. Compute-skip for fully-masked causal blocks is not attempted
+  (uniform per-step shapes keep XLA's schedule static); masked blocks
+  contribute nothing numerically because the running max washes their
+  unit-weight placeholders out (finite NEG_INF trick).
+
+* **Ulysses / all-to-all attention** (`ulysses_attention`):
+  `lax.all_to_all` re-shards activations seq→heads, runs dense local
+  attention on the full sequence for a head subset, and re-shards
+  back. Cheaper collectives for moderate S (two all-to-alls vs n-1
+  ppermute hops) but per-device memory is O(S); requires
+  heads % ring_size == 0.
+
+Both match `xla_attention` numerics (fp32 softmax) and differentiate
+through the collectives. The ring path carries a flash-style custom
+VJP: the forward pass saves only (q, k, v, out, lse) local blocks —
+O(S/n) residuals — and the backward pass makes a second ring rotation,
+recomputing block probabilities from lse while the per-block dK/dV
+accumulators ride along with their blocks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from skypilot_tpu.ops.attention import NEG_INF, repeat_kv, xla_attention
+from skypilot_tpu.parallel.sharding import _abstract_or_ambient_mesh
+
+
+def _seq_axis_size(mesh: Mesh, seq_axis: str) -> int:
+    return dict(mesh.shape).get(seq_axis, 1)
+
+
+def _rotate(xs, seq_axis: str, n: int):
+    """One ring hop: device i -> i+1, for a pytree of arrays."""
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, seq_axis, perm), xs)
+
+
+def _block_logits(q, k_rep, *, scale, causal, q_pos, k_pos):
+    """fp32 logits of the local Q block against one K block, with the
+    causal mask on *global* positions applied via the finite NEG_INF."""
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k_rep,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]        # [Sq, Sk]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    return logits
+
+
+def _vary(x, seq_axis: str):
+    """Mark an accumulator device-varying on the ring axis (scan carries
+    that depend on axis_index must start out varying). No-op when the
+    value is already varying (e.g. zeros_like of a varying input)."""
+    if seq_axis in getattr(jax.typeof(x), 'vma', ()):
+        return x
+    return lax.pcast(x, (seq_axis,), to='varying')
+
+
+def _ring_fwd_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
+    """Forward ring pass on local blocks: q [B,S/n,H,D], k/v
+    [B,S/n,KV,D] (rotated UNexpanded — GQA repeat happens per step, so
+    ICI traffic and carry memory stay at the KV-head size).
+
+    Device i keeps Q block i; at ring step t it holds K/V block
+    (i - t) mod n. Softmax statistics accumulate in fp32 with the
+    running max initialized to the finite NEG_INF: a fully-masked
+    block contributes unit-weight placeholders that the first real
+    block's correction factor exp(NEG_INF - m_real) = 0 washes out
+    exactly. Returns (out, lse) with lse = m + log(l) saved for the
+    backward pass.
+    """
+    n = lax.axis_size(seq_axis)
+    idx = lax.axis_index(seq_axis)
+    n_rep = q.shape[2] // k.shape[2]
+    b, s_loc, h, d = q.shape
+    q_pos = idx * s_loc + jnp.arange(s_loc)            # global Q positions
+
+    m0 = _vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32), seq_axis)
+    l0 = _vary(jnp.zeros((b, h, s_loc), jnp.float32), seq_axis)
+    acc0 = _vary(jnp.zeros((b, s_loc, h, d), jnp.float32), seq_axis)
+
+    def step(carry, t):
+        k_t, v_t, m, l, acc = carry
+        j = (idx - t) % n
+        k_pos = j * s_loc + jnp.arange(s_loc)
+        logits = _block_logits(q, repeat_kv(k_t, n_rep), scale=scale,
+                               causal=causal, q_pos=q_pos, k_pos=k_pos)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])         # [b,h,q,k]
+        corr = jnp.exp(m - m_new)                      # [b,h,q]
+        l = l * corr + p.sum(axis=-1)
+        v_rep = repeat_kv(v_t, n_rep)
+        pv = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v_rep.dtype),
+                        v_rep).astype(jnp.float32)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        k_next, v_next = _rotate((k_t, v_t), seq_axis, n)
+        return (k_next, v_next, m_new, l, acc), None
+
+    (_, _, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0),
+                                    jnp.arange(n))
+    # Causal attention always includes the diagonal, so l > 0.
+    out = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)                               # [b,h,sq] fp32
+    return out, lse
+
+
+def _ring_bwd_local(q, k, v, out, lse, dout, *, causal: bool, scale: float,
+                    seq_axis: str):
+    """Backward ring pass (the standard ring-attention recipe): K/V
+    blocks make a second full rotation while the per-block dK/dV
+    accumulators ride along WITH their blocks — after n hops each
+    accumulator is back home holding every device's contribution. Only
+    O(S/n) residuals (q, k, v, out, lse) are stored by the forward
+    pass; logits/probabilities are recomputed per step from lse.
+    """
+    n = lax.axis_size(seq_axis)
+    idx = lax.axis_index(seq_axis)
+    n_rep = q.shape[2] // k.shape[2]
+    b, s_loc, h, d = q.shape
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+    # D_i = rowsum(dO * O): the softmax-jacobian diagonal term.
+    delta = jnp.einsum('bqhd,bqhd->bhq', dout.astype(jnp.float32),
+                       out.astype(jnp.float32))        # [b,h,q]
+
+    dq0 = _vary(jnp.zeros((b, s_loc, h, d), jnp.float32), seq_axis)
+    dk0 = _vary(jnp.zeros_like(k, jnp.float32), seq_axis)
+    dv0 = _vary(jnp.zeros_like(v, jnp.float32), seq_axis)
+
+    def step(carry, t):
+        k_t, v_t, dk_t, dv_t, dq = carry
+        j = (idx - t) % n
+        k_pos = j * s_loc + jnp.arange(s_loc)
+        k_rep = repeat_kv(k_t, n_rep)
+        v_rep = repeat_kv(v_t, n_rep)
+        logits = _block_logits(q, k_rep, scale=scale, causal=causal,
+                               q_pos=q_pos, k_pos=k_pos)
+        p = jnp.exp(logits - lse[..., None])           # normalized probs
+        dp = jnp.einsum('bqhd,bkhd->bhqk', dout.astype(jnp.float32),
+                        v_rep.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale       # [b,h,q,k]
+        dq = dq + jnp.einsum('bhqk,bkhd->bqhd', ds,
+                             k_rep.astype(jnp.float32))
+        dk_rep = jnp.einsum('bhqk,bqhd->bkhd', ds,
+                            q.astype(jnp.float32))     # [b,k,h,d]
+        dv_rep = jnp.einsum('bhqk,bqhd->bkhd', p,
+                            dout.astype(jnp.float32))
+        # Sum expanded-head gradients back to the KV heads.
+        kv = k.shape[2]
+        dk_t = dk_t + dk_rep.reshape(b, s_loc, kv, n_rep, d).sum(axis=3)
+        dv_t = dv_t + dv_rep.reshape(b, s_loc, kv, n_rep, d).sum(axis=3)
+        k_next, v_next, dk_next, dv_next = _rotate(
+            (k_t, v_t, dk_t, dv_t), seq_axis, n)
+        return (k_next, v_next, dk_next, dv_next, dq), None
+
+    (_, _, dk, dv, dq), _ = lax.scan(step, (k, v, dk0, dv0, dq0),
+                                     jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _make_ring_core(causal: bool, scale: float, seq_axis: str):
+    """custom_vjp ring attention on local blocks: O(S/n) residuals."""
+
+    @jax.custom_vjp
+    def core(q, k, v):
+        out, _ = _ring_fwd_local(q, k, v, causal=causal, scale=scale,
+                                 seq_axis=seq_axis)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_fwd_local(q, k, v, causal=causal, scale=scale,
+                                   seq_axis=seq_axis)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, dout):
+        q, k, v, out, lse = res
+        return _ring_bwd_local(q, k, v, out, lse, dout, causal=causal,
+                               scale=scale, seq_axis=seq_axis)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   *,
+                   causal: bool = True,
+                   scale: Optional[float] = None,
+                   mesh: Optional[Mesh] = None,
+                   seq_axis: str = 'seq') -> jax.Array:
+    """Ring attention: q [B,S,H,D], k/v [B,S,KV,D] logically sharded on
+    the ``seq`` mesh axis; returns [B,S,H,D] with the same sharding.
+
+    Falls back to `xla_attention` when there is no mesh or the seq axis
+    is trivial (size 1), so models can set ``attention_impl='ring'``
+    unconditionally.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mesh is None:
+        mesh = _abstract_or_ambient_mesh()
+    if mesh is None or _seq_axis_size(mesh, seq_axis) == 1:
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+    s = q.shape[1]
+    n = _seq_axis_size(mesh, seq_axis)
+    if s % n != 0:
+        raise ValueError(
+            f'ring_attention: seq length {s} not divisible by seq mesh '
+            f'axis size {n}')
+    spec = P(None, seq_axis, None, None)
+    body = _make_ring_core(causal, scale, seq_axis)
+    return jax.shard_map(body, mesh=mesh, axis_names={seq_axis},
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
+    """shard_map body: all-to-all seq->heads, dense local attention over
+    the full sequence, all-to-all back."""
+    n = lax.axis_size(seq_axis)
+    n_rep = q.shape[2] // k.shape[2]
+    if k.shape[2] % n != 0:
+        # Not enough KV heads to split: broadcast them to full heads
+        # first (costs the GQA saving on the wire, keeps semantics).
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+    # [B, S/n, H, D] -> [B, S, H/n, D]
+    q = lax.all_to_all(q, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+    out = xla_attention(q, k, v, causal=causal, scale=scale)
+    # [B, S, H/n, D] -> [B, S/n, H, D]
+    return lax.all_to_all(out, seq_axis, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q: jax.Array,
+                      k: jax.Array,
+                      v: jax.Array,
+                      *,
+                      causal: bool = True,
+                      scale: Optional[float] = None,
+                      mesh: Optional[Mesh] = None,
+                      seq_axis: str = 'seq') -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mesh is None:
+        mesh = _abstract_or_ambient_mesh()
+    n = 1 if mesh is None else _seq_axis_size(mesh, seq_axis)
+    if mesh is None or n == 1:
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f'ulysses_attention: {q.shape[2]} heads not divisible by seq '
+            f'mesh axis size {n}')
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f'ulysses_attention: seq length {q.shape[1]} not divisible '
+            f'by seq mesh axis size {n}')
+    spec = P(None, seq_axis, None, None)
+    body = functools.partial(_ulysses_local, causal=causal, scale=scale,
+                             seq_axis=seq_axis)
+    return jax.shard_map(body, mesh=mesh, axis_names={seq_axis},
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
