@@ -123,20 +123,41 @@ class TpuPodBackend(Backend):
         run_in_parallel(sync, runners)
 
     def sync_file_mounts(self, info: ClusterInfo, task: Task) -> None:
-        if not task.file_mounts:
-            return
+        """file_mounts (rsync or bucket COPY) + storage_mounts (bucket
+        MOUNT/COPY/MOUNT_CACHED) onto every host (parity:
+        cloud_vm_ray_backend.py:3876 _execute_file_mounts +
+        _execute_storage_mounts)."""
+        from skypilot_tpu.data.storage import Storage
         runners = runners_for_cluster(info)
-        for dst, src in task.file_mounts.items():
-            if src.startswith(('gs://', 's3://')):
-                # bucket mounts handled by data layer (M-storage)
-                logger.warning('Skipping bucket mount %s (storage layer '
-                               'pending)', src)
+        for dst, src in (task.file_mounts or {}).items():
+            if '://' in src:
+                # Bucket-sourced file mount == COPY-mode storage mount
+                # (ref storage.py:781 docstring contract).
+                storage = Storage(source=src, mode='COPY')
+                self._run_mount_command(runners, dst,
+                                        storage.cluster_command(dst))
                 continue
 
             def sync(runner: CommandRunner, _src=src, _dst=dst) -> None:
                 runner.rsync(_src, _dst, up=True)
 
             run_in_parallel(sync, runners)
+        for dst, config in (task.storage_mounts or {}).items():
+            storage = Storage.from_yaml_config(config)
+            storage.ensure_bucket()
+            self._run_mount_command(runners, dst,
+                                    storage.cluster_command(dst))
+
+    @staticmethod
+    def _run_mount_command(runners, dst: str, cmd: str) -> None:
+        def mount(runner: CommandRunner) -> None:
+            code, output = runner.run(cmd)
+            if code != 0:
+                raise exceptions.StorageError(
+                    f'Mount of {dst} failed (exit {code}): '
+                    f'{output[-800:]}')
+
+        run_in_parallel(mount, runners)
 
     # ------------------------------------------------------------------
     # Setup / execute

@@ -1,0 +1,6 @@
+"""Data & storage layer: bucket abstraction, mounting, transfer
+(parity: ``sky/data/``)."""
+from skypilot_tpu.data.storage import (AbstractStore, Storage, StorageMode,
+                                       StoreType)
+
+__all__ = ['AbstractStore', 'Storage', 'StorageMode', 'StoreType']

@@ -1,0 +1,30 @@
+"""Bucket-to-bucket transfer (parity: ``sky/data/data_transfer.py``)."""
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data.storage import AbstractStore, GcsStore, LocalStore
+
+
+def transfer(src: AbstractStore, dst: AbstractStore) -> None:
+    """Copy all objects of src into dst (cloud-side when possible)."""
+    if isinstance(src, GcsStore) and isinstance(dst, GcsStore):
+        proc = subprocess.run(
+            ['gsutil', '-m', 'rsync', '-r', src.url, dst.url],
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Transfer {src.url} -> {dst.url} failed: '
+                f'{proc.stderr[-500:]}')
+        return
+    if isinstance(src, LocalStore) and isinstance(dst, LocalStore):
+        shutil.copytree(src.bucket_dir, dst.bucket_dir, dirs_exist_ok=True)
+        return
+    if isinstance(src, LocalStore):
+        dst.upload(src.bucket_dir)
+        return
+    raise exceptions.StorageError(
+        f'Unsupported transfer {type(src).__name__} -> '
+        f'{type(dst).__name__}')

@@ -1,0 +1,115 @@
+"""Mount-command generation for bucket stores.
+
+Parity: ``sky/data/mounting_utils.py:23-65`` (gcsfuse/blobfuse2/s3fs/
+rclone command gen). GCS is the TPU-adjacent store, so gcsfuse is the
+primary tool (the reference invokes the same binary); MOUNT_CACHED uses
+rclone's VFS cache like the reference's mount-cached path. All
+functions return *shell command strings* executed on cluster hosts by
+the backend — generation is pure and unit-testable offline.
+"""
+from __future__ import annotations
+
+import shlex
+
+GCSFUSE_VERSION = '2.4.0'
+RCLONE_VERSION = '1.68.1'
+
+
+def quote_path(path: str) -> str:
+    """shlex.quote that keeps leading ``~`` expandable: mounts are
+    host-side paths, and the local (fake-cluster) runner maps ``~`` to
+    the host's private root via $HOME."""
+    if path == '~':
+        return '"$HOME"'
+    if path.startswith('~/'):
+        return f'"$HOME/{_dq(path[2:])}"'
+    return shlex.quote(path)
+
+
+def _dq(s: str) -> str:
+    """Escape for inside double quotes."""
+    return s.replace('\\', '\\\\').replace('"', '\\"').replace(
+        '$', '\\$').replace('`', '\\`')
+
+# Reference installs tooling on first mount (mounting_utils installs
+# gcsfuse per distro); one idempotent snippet, amd64/arm64 aware.
+GCSFUSE_INSTALL = (
+    'command -v gcsfuse >/dev/null 2>&1 || {{ '
+    'ARCH=$(uname -m | sed "s/x86_64/amd64/;s/aarch64/arm64/"); '
+    'curl -fsSL -o /tmp/gcsfuse.deb '
+    'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
+    'v{v}/gcsfuse_{v}_${{ARCH}}.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb; }}').format(v=GCSFUSE_VERSION)
+
+RCLONE_INSTALL = (
+    'command -v rclone >/dev/null 2>&1 || '
+    'curl -fsSL https://rclone.org/install.sh | sudo bash')
+
+
+def gcs_mount_command(bucket: str, mount_path: str,
+                      readonly: bool = False) -> str:
+    """gcsfuse mount (MOUNT mode): direct bucket FS, writes go through."""
+    flags = '--implicit-dirs'
+    if readonly:
+        flags += ' -o ro'
+    path = quote_path(mount_path)
+    return (f'{GCSFUSE_INSTALL} && mkdir -p {path} && '
+            f'{{ mountpoint -q {path} || '
+            f'gcsfuse {flags} {shlex.quote(bucket)} {path}; }}')
+
+
+def gcs_mount_cached_command(bucket: str, mount_path: str) -> str:
+    """rclone VFS-cached mount (MOUNT_CACHED): local write-back cache,
+    async upload — the checkpoint-bucket pattern (SURVEY.md §5
+    checkpoint/resume) without blocking the training loop on GCS."""
+    path = quote_path(mount_path)
+    remote = f'skyt-gcs:{bucket}'
+    return (
+        f'{RCLONE_INSTALL} && mkdir -p {path} ~/.config/rclone && '
+        '{ grep -q "^\\[skyt-gcs\\]" ~/.config/rclone/rclone.conf '
+        '2>/dev/null || printf "[skyt-gcs]\\ntype = gcs\\n" '
+        '>> ~/.config/rclone/rclone.conf; } && '
+        f'{{ mountpoint -q {path} || '
+        f'rclone mount {shlex.quote(remote)} {path} --daemon '
+        '--vfs-cache-mode writes --vfs-cache-max-size 10G '
+        '--dir-cache-time 30s; }}')
+
+
+def gcs_download_command(bucket: str, prefix: str, dest: str) -> str:
+    """COPY mode: one-shot bucket -> local sync on the host.
+
+    The source may name a single object (``gs://b/w.txt`` — then
+    ``dest`` is the destination *file* path) or a prefix/directory
+    (rsync'd into ``dest``); ``gsutil stat`` succeeds only for objects,
+    which disambiguates at run time.
+    """
+    src = shlex.quote(f'gs://{bucket}/{prefix}'.rstrip('/'))
+    dst = quote_path(dest)
+    return (f'if gsutil -q stat {src} 2>/dev/null; then '
+            f'mkdir -p "$(dirname {dst})" && gsutil cp {src} {dst}; '
+            f'else mkdir -p {dst} && '
+            f'gsutil -m rsync -r {src} {dst}; fi')
+
+
+def local_mount_command(bucket_dir: str, mount_path: str) -> str:
+    """LOCAL (test/dev) store 'mount': a symlink into the bucket dir."""
+    path = quote_path(mount_path)
+    return (f'mkdir -p "$(dirname {path})" && '
+            f'ln -sfn {shlex.quote(bucket_dir)} {path}')
+
+
+def local_download_command(bucket_dir: str, prefix: str, dest: str) -> str:
+    """Single file or directory, mirroring gcs_download_command."""
+    src = shlex.quote(bucket_dir if not prefix
+                      else f'{bucket_dir}/{prefix}')
+    dst = quote_path(dest)
+    return (f'if [ -f {src} ]; then '
+            f'mkdir -p "$(dirname {dst})" && cp -a {src} {dst}; '
+            f'else mkdir -p {dst} && cp -a {src}/. {dst}/; fi')
+
+
+def unmount_command(mount_path: str) -> str:
+    path = quote_path(mount_path)
+    return (f'if [ -L {path} ]; then rm -f {path}; '
+            f'elif mountpoint -q {path}; then '
+            f'fusermount -u {path} || sudo umount {path}; fi')

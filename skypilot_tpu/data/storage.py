@@ -1,0 +1,312 @@
+"""Bucket storage abstraction.
+
+Parity: ``sky/data/storage.py`` (StoreType :144, StorageMode :336,
+AbstractStore :538, Storage :781). GCS is the primary store (the
+TPU-adjacent object store); a LOCAL store — a directory under the state
+dir posing as a bucket — serves tests and the fake cloud the same way
+the fake provider serves provisioning (no credentials, full machinery).
+
+A ``Storage`` object is one entry of a task's ``storage_mounts``::
+
+    storage_mounts:
+      /checkpoints:
+        name: my-ckpt-bucket       # bucket name (created if missing)
+        store: gcs                 # gcs | local (default: gcs)
+        mode: MOUNT_CACHED         # MOUNT | COPY | MOUNT_CACHED
+      /data:
+        source: gs://public-ds     # existing bucket -> name from URI
+        mode: COPY
+
+Client-side responsibilities (this module): create/validate the bucket,
+upload a local ``source`` if given. Cluster-side responsibilities
+(command strings consumed by the backend): mount or download onto every
+host.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import Registry
+
+logger = log.init_logger(__name__)
+
+STORE_REGISTRY: Registry = Registry('store')
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+class StoreType(enum.Enum):
+    GCS = 'gcs'
+    LOCAL = 'local'
+
+    @classmethod
+    def from_uri(cls, uri: str) -> 'StoreType':
+        if uri.startswith('gs://'):
+            return cls.GCS
+        if uri.startswith('file://') or uri.startswith('local://'):
+            return cls.LOCAL
+        raise exceptions.StorageError(f'Unsupported storage URI {uri!r} '
+                                      '(expected gs:// or file://)')
+
+
+def _strip_scheme(uri: str) -> str:
+    for scheme in ('gs://', 'file://', 'local://'):
+        if uri.startswith(scheme):
+            return uri[len(scheme):]
+    return uri
+
+
+class AbstractStore:
+    """One bucket in one store backend (ref AbstractStore :538)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    # client side ------------------------------------------------------
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def create(self) -> None:
+        raise NotImplementedError
+
+    def upload(self, local_source: str, prefix: str = '') -> None:
+        """Sync a local file/dir into the bucket under `prefix`."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    # cluster side (command generation) --------------------------------
+    def mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def mount_cached_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def download_command(self, dest: str, prefix: str = '') -> str:
+        raise NotImplementedError
+
+    @property
+    def url(self) -> str:
+        raise NotImplementedError
+
+
+@STORE_REGISTRY.register('gcs', default=True)
+class GcsStore(AbstractStore):
+    """GCS via the gsutil/gcloud CLI (the reference shells out to the
+    same tools for transfers; the cloud SDK python client is avoided so
+    `import skypilot_tpu` stays dependency-light, same reasoning as the
+    reference's lazy adaptors)."""
+
+    def _gsutil(self, *args: str) -> subprocess.CompletedProcess:
+        if shutil.which('gsutil') is None:
+            raise exceptions.StorageError(
+                'gsutil not found; install the Google Cloud SDK or use '
+                "store: local for offline development.")
+        return subprocess.run(['gsutil', *args], capture_output=True,
+                              text=True, check=False)
+
+    def exists(self) -> bool:
+        return self._gsutil('ls', '-b', self.url).returncode == 0
+
+    def create(self) -> None:
+        proc = self._gsutil('mb', self.url)
+        if proc.returncode != 0 and 'already exists' not in proc.stderr:
+            raise exceptions.StorageError(
+                f'Failed to create bucket {self.url}: {proc.stderr[-500:]}')
+
+    def upload(self, local_source: str, prefix: str = '') -> None:
+        dest = self.url + (f'/{prefix}' if prefix else '')
+        src = os.path.expanduser(local_source)
+        if os.path.isdir(src):
+            proc = self._gsutil('-m', 'rsync', '-r', src, dest)
+        else:
+            proc = self._gsutil('cp', src, dest + '/')
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Upload {src} -> {dest} failed: {proc.stderr[-500:]}')
+
+    def delete(self) -> None:
+        self._gsutil('-m', 'rm', '-r', self.url)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.gcs_mount_command(self.name, mount_path)
+
+    def mount_cached_command(self, mount_path: str) -> str:
+        return mounting_utils.gcs_mount_cached_command(self.name,
+                                                       mount_path)
+
+    def download_command(self, dest: str, prefix: str = '') -> str:
+        return mounting_utils.gcs_download_command(self.name, prefix, dest)
+
+    @property
+    def url(self) -> str:
+        return f'gs://{self.name}'
+
+
+@STORE_REGISTRY.register('local')
+class LocalStore(AbstractStore):
+    """A directory posing as a bucket (tests/dev; pairs with the fake
+    cloud whose 'hosts' run on this machine)."""
+
+    @staticmethod
+    def _root() -> str:
+        return os.path.join(
+            os.environ.get('SKYT_STATE_DIR', os.path.expanduser('~/.skyt')),
+            'buckets')
+
+    @property
+    def bucket_dir(self) -> str:
+        # file:///abs/dir sources address a directory outside the
+        # bucket root; plain names live under it.
+        if os.path.isabs(self.name):
+            return self.name
+        return os.path.join(self._root(), self.name)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.bucket_dir)
+
+    def create(self) -> None:
+        os.makedirs(self.bucket_dir, exist_ok=True)
+
+    def upload(self, local_source: str, prefix: str = '') -> None:
+        src = os.path.expanduser(local_source)
+        dest = (os.path.join(self.bucket_dir, prefix) if prefix
+                else self.bucket_dir)
+        os.makedirs(dest, exist_ok=True)
+        if os.path.isdir(src):
+            shutil.copytree(src, dest, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, dest)
+
+    def delete(self) -> None:
+        shutil.rmtree(self.bucket_dir, ignore_errors=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.local_mount_command(self.bucket_dir,
+                                                  mount_path)
+
+    # A local dir needs no cache layer; cached == plain mount.
+    mount_cached_command = mount_command
+
+    def download_command(self, dest: str, prefix: str = '') -> str:
+        return mounting_utils.local_download_command(self.bucket_dir,
+                                                     prefix, dest)
+
+    @property
+    def url(self) -> str:
+        return f'file://{self.bucket_dir}'
+
+
+class Storage:
+    """One storage_mounts entry: a bucket + mode + optional local
+    source (ref Storage :781)."""
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 *,
+                 source: Optional[str] = None,
+                 store: Optional[str] = None,
+                 mode: str = 'MOUNT',
+                 persistent: bool = True) -> None:
+        if name is None and source is None:
+            raise exceptions.StorageError(
+                'storage mount needs a name or a source')
+        if source is not None and '://' in source:
+            inferred = StoreType.from_uri(source).value
+            if store is not None and store != inferred:
+                raise exceptions.StorageError(
+                    f'source {source!r} implies store {inferred!r}, got '
+                    f'{store!r}')
+            store = inferred
+            stripped = _strip_scheme(source)
+            # A local "bucket" URI is a directory path (absolute);
+            # cloud URIs lead with the bucket name.
+            inferred_name = (stripped
+                             if inferred == StoreType.LOCAL.value
+                             else stripped.split('/')[0])
+            if name is not None and name != inferred_name:
+                raise exceptions.StorageError(
+                    f'name {name!r} conflicts with bucket {inferred_name!r}'
+                    f' from source {source!r}; drop the name.')
+            name = inferred_name
+            self.bucket_source = source
+            self.local_source = None
+        else:
+            self.bucket_source = None
+            self.local_source = source
+        assert name is not None
+        self.name = name
+        try:
+            self.mode = StorageMode(mode.upper())
+        except ValueError as e:
+            raise exceptions.StorageError(
+                f'Invalid storage mode {mode!r}; expected one of '
+                f'{[m.value for m in StorageMode]}') from e
+        self.persistent = persistent
+        try:
+            store_cls = STORE_REGISTRY.get(store)
+        except KeyError as e:
+            raise exceptions.StorageError(str(e)) from e
+        self.store: AbstractStore = store_cls(name)
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        known = {'name', 'source', 'store', 'mode', 'persistent'}
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.StorageError(
+                f'Unknown storage fields: {sorted(unknown)}')
+        return cls(config.get('name'),
+                   source=config.get('source'),
+                   store=config.get('store'),
+                   mode=config.get('mode', 'MOUNT'),
+                   persistent=config.get('persistent', True))
+
+    def ensure_bucket(self) -> None:
+        """Create the bucket if needed; upload the local source."""
+        if self.bucket_source is not None:
+            if not self.store.exists():
+                raise exceptions.StorageError(
+                    f'Source bucket {self.bucket_source} does not exist.')
+        elif not self.store.exists():
+            self.store.create()
+        if self.local_source is not None:
+            src = os.path.expanduser(self.local_source)
+            if not os.path.exists(src):
+                raise exceptions.StorageError(
+                    f'storage source {self.local_source!r} not found')
+            self.store.upload(self.local_source)
+
+    def cluster_command(self, mount_path: str) -> str:
+        """The shell command every host runs to realize this mount."""
+        # A bucket_source URI may carry a sub-prefix (gs://b/sub/dir);
+        # the name covers the whole path for local dir "buckets".
+        prefix = ''
+        if self.bucket_source is not None:
+            stripped = _strip_scheme(self.bucket_source)
+            prefix = stripped[len(self.name):].lstrip('/')
+        if self.mode == StorageMode.COPY:
+            return self.store.download_command(mount_path, prefix)
+        if prefix:
+            raise exceptions.StorageError(
+                f'MOUNT of a bucket sub-path ({self.bucket_source}) is '
+                'not supported; mount the bucket root or use COPY.')
+        if self.mode == StorageMode.MOUNT:
+            return self.store.mount_command(mount_path)
+        return self.store.mount_cached_command(mount_path)
+
+    def delete(self) -> None:
+        if not self.persistent:
+            self.store.delete()

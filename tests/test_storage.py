@@ -1,0 +1,215 @@
+"""Storage layer tests: spec parsing, the LOCAL store, mount-command
+generation, and end-to-end storage/file mounts on the fake cloud (the
+reference covers storage with tests/smoke_tests/test_mount_and_storage.py
+against real buckets; the LOCAL store plays the bucket here)."""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions, execution, state
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data.storage import (LocalStore, Storage, StorageMode,
+                                       StoreType)
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_home):
+    fake.reset()
+    yield
+    fake.reset()
+
+
+# -- spec / store unit tests ------------------------------------------------
+
+
+def test_store_type_from_uri():
+    assert StoreType.from_uri('gs://b/x') == StoreType.GCS
+    assert StoreType.from_uri('file:///tmp/x') == StoreType.LOCAL
+    with pytest.raises(exceptions.StorageError):
+        StoreType.from_uri('s3q://nope')
+
+
+def test_storage_spec_parsing():
+    s = Storage.from_yaml_config({'name': 'ckpt', 'store': 'local',
+                                  'mode': 'MOUNT_CACHED'})
+    assert s.mode == StorageMode.MOUNT_CACHED
+    assert isinstance(s.store, LocalStore)
+    with pytest.raises(exceptions.StorageError):
+        Storage.from_yaml_config({'name': 'x', 'bogus': 1})
+    with pytest.raises(exceptions.StorageError):
+        Storage.from_yaml_config({})          # neither name nor source
+    with pytest.raises(exceptions.StorageError):
+        Storage(source='gs://b', store='local')  # scheme/store mismatch
+    with pytest.raises(exceptions.StorageError, match='conflicts'):
+        Storage('other-name', source='gs://b/sub')
+    with pytest.raises(exceptions.StorageError, match='Invalid storage'):
+        Storage('x', mode='MONT')
+    with pytest.raises(exceptions.StorageError):
+        Storage('x', store='s3')              # unknown store backend
+
+
+def test_storage_source_uri_infers_name_and_prefix():
+    s = Storage(source='gs://mybucket/sub/dir', mode='COPY')
+    assert s.name == 'mybucket'
+    cmd = s.cluster_command('/data')
+    assert 'gs://mybucket/sub/dir' in cmd
+    # MOUNT of a sub-path is rejected.
+    s2 = Storage(source='gs://mybucket/sub', mode='MOUNT')
+    with pytest.raises(exceptions.StorageError, match='sub-path'):
+        s2.cluster_command('/data')
+
+
+def test_local_store_lifecycle(tmp_path):
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'a.txt').write_text('hello')
+    store = LocalStore('unit-bucket')
+    assert not store.exists()
+    store.create()
+    store.upload(str(src))
+    assert (LocalStore('unit-bucket').exists())
+    assert os.path.exists(os.path.join(store.bucket_dir, 'a.txt'))
+    store.delete()
+    assert not store.exists()
+
+
+def test_quote_path_preserves_home_expansion():
+    assert mounting_utils.quote_path('~/mnt/x') == '"$HOME/mnt/x"'
+    assert mounting_utils.quote_path('/abs path') == "'/abs path'"
+    assert '"$HOME"' == mounting_utils.quote_path('~')
+
+
+def test_gcs_command_generation():
+    from skypilot_tpu.data.storage import GcsStore
+    store = GcsStore('bkt')
+    mount = store.mount_command('~/mnt')
+    assert 'gcsfuse' in mount and 'bkt' in mount and '$HOME/mnt' in mount
+    cached = store.mount_cached_command('/ckpt')
+    assert 'rclone mount' in cached and 'vfs-cache-mode writes' in cached
+    download = store.download_command('/data', 'pre/fix')
+    # Object sources go through `gsutil cp`, prefixes through rsync.
+    assert 'gsutil -q stat gs://bkt/pre/fix' in download
+    assert 'gsutil -m rsync -r gs://bkt/pre/fix' in download
+    unmount = mounting_utils.unmount_command('~/mnt')
+    assert 'fusermount -u' in unmount and '$HOME/mnt' in unmount
+
+
+def test_local_single_file_download(tmp_path):
+    import subprocess
+    store = LocalStore('onefile')
+    store.create()
+    with open(os.path.join(store.bucket_dir, 'w.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('x1')
+    # File source: dest is the destination file path.
+    cmd = store.download_command(str(tmp_path / 'out' / 'w.txt'), 'w.txt')
+    subprocess.run(['bash', '-c', cmd], check=True)
+    with open(tmp_path / 'out' / 'w.txt', encoding='utf-8') as f:
+        assert f.read() == 'x1'
+
+
+def test_transfer_local_to_local():
+    from skypilot_tpu.data import data_transfer
+    src = LocalStore('xfer-src')
+    src.create()
+    with open(os.path.join(src.bucket_dir, 'a.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('payload')
+    dst = LocalStore('xfer-dst')
+    dst.create()
+    data_transfer.transfer(src, dst)
+    with open(os.path.join(dst.bucket_dir, 'a.txt'),
+              encoding='utf-8') as f:
+        assert f.read() == 'payload'
+
+
+# -- end to end on the fake cloud ------------------------------------------
+
+
+def _task(run, **kw):
+    return Task(name='st', run=run,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8'), **kw)
+
+
+def test_storage_mount_end_to_end(tmp_path):
+    # Seed a "bucket" from a local source dir, MOUNT it, read through it.
+    src = tmp_path / 'dataset'
+    src.mkdir()
+    (src / 'data.txt').write_text('the-data')
+    task = _task(
+        'cat ~/mnt/ds/data.txt > ~/out.txt',
+        storage_mounts={
+            '~/mnt/ds': {'name': 'ds-bucket', 'store': 'local',
+                         'source': str(src)},
+        })
+    execution.launch(task, cluster_name='stm')
+    host_root = os.path.join(os.environ['SKYT_STATE_DIR'], 'hosts', 'stm',
+                             '0-0')
+    with open(os.path.join(host_root, 'out.txt'), encoding='utf-8') as f:
+        assert f.read() == 'the-data'
+
+
+def test_storage_mount_writes_reach_bucket():
+    """The checkpoint pattern: task writes into the mount; the bucket
+    sees it (MOUNT mode writes through)."""
+    task = _task(
+        'echo ckpt-1 > ~/ckpt/model.txt',
+        storage_mounts={
+            '~/ckpt': {'name': 'ckpt-bucket', 'store': 'local'},
+        })
+    execution.launch(task, cluster_name='stw')
+    store = LocalStore('ckpt-bucket')
+    with open(os.path.join(store.bucket_dir, 'model.txt'),
+              encoding='utf-8') as f:
+        assert f.read().strip() == 'ckpt-1'
+
+
+def test_copy_mode_detaches_from_bucket(tmp_path):
+    src = tmp_path / 'seed'
+    src.mkdir()
+    (src / 'f.txt').write_text('v1')
+    task = _task(
+        'cat ~/data/f.txt > ~/copy_out.txt && echo scratch > ~/data/new.txt',
+        storage_mounts={
+            '~/data': {'name': 'copy-bucket', 'store': 'local',
+                       'source': str(src), 'mode': 'COPY'},
+        })
+    execution.launch(task, cluster_name='stc')
+    host_root = os.path.join(os.environ['SKYT_STATE_DIR'], 'hosts', 'stc',
+                             '0-0')
+    with open(os.path.join(host_root, 'copy_out.txt'),
+              encoding='utf-8') as f:
+        assert f.read() == 'v1'
+    # COPY is a snapshot: writes stay on the host, not in the bucket.
+    store = LocalStore('copy-bucket')
+    assert not os.path.exists(os.path.join(store.bucket_dir, 'new.txt'))
+
+
+def test_file_mount_from_bucket_uri(tmp_path):
+    store = LocalStore('fm-bucket')
+    store.create()
+    with open(os.path.join(store.bucket_dir, 'w.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('from-bucket')
+    task = _task(
+        'cat ~/in/w.txt > ~/fm_out.txt',
+        file_mounts={'~/in': f'file://{store.bucket_dir}'})
+    execution.launch(task, cluster_name='stf')
+    host_root = os.path.join(os.environ['SKYT_STATE_DIR'], 'hosts', 'stf',
+                             '0-0')
+    with open(os.path.join(host_root, 'fm_out.txt'),
+              encoding='utf-8') as f:
+        assert f.read() == 'from-bucket'
+
+
+def test_missing_source_fails_before_provision(tmp_path):
+    task = _task('true', storage_mounts={
+        '~/x': {'name': 'nope', 'store': 'local',
+                'source': str(tmp_path / 'does-not-exist')},
+    })
+    with pytest.raises(exceptions.StorageError, match='not found'):
+        execution.launch(task, cluster_name='stx')
