@@ -1,0 +1,92 @@
+"""Admin policy: a user-pluggable request mutation/validation hook.
+
+Parity: ``sky/admin_policy.py`` (AdminPolicy :188, UserRequest :64).
+Deployments point the config key ``admin_policy`` at a
+``module.path.ClassName``; every launch-shaped request is passed through
+``validate_and_mutate`` before execution, letting an operator enforce
+labels, forbid clouds, cap resources, or rewrite tasks centrally.
+
+Example::
+
+    # ~/.skyt/config.yaml
+    admin_policy: mycompany.policies.EnforceSpotPolicy
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.spec.task import Task
+
+
+@dataclasses.dataclass
+class UserRequest:
+    """What the policy sees: the task plus request metadata."""
+    task: Task
+    operation: str                      # 'launch' | 'jobs.launch' | ...
+    request_options: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Task
+
+
+class AdminPolicy:
+    """Subclass and override; raise RejectedByPolicy to deny."""
+
+    def validate_and_mutate(self,
+                            user_request: UserRequest
+                            ) -> MutatedUserRequest:
+        return MutatedUserRequest(task=user_request.task)
+
+
+class RejectedByPolicy(exceptions.SkytError):
+    """The admin policy rejected the request."""
+
+
+def _load_policy() -> Optional[AdminPolicy]:
+    path = config_lib.get_nested(('admin_policy',))
+    if not path:
+        return None
+    module_name, _, class_name = str(path).rpartition('.')
+    if not module_name:
+        raise exceptions.InvalidSpecError(
+            f'admin_policy must be module.path.ClassName, got {path!r}')
+    try:
+        cls = getattr(importlib.import_module(module_name), class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidSpecError(
+            f'Cannot load admin policy {path!r}: {e}') from e
+    policy = cls()
+    if not isinstance(policy, AdminPolicy):
+        raise exceptions.InvalidSpecError(
+            f'{path!r} is not an AdminPolicy subclass')
+    return policy
+
+
+def apply(task: Task, operation: str,
+          request_options: Optional[Dict[str, Any]] = None) -> Task:
+    """Run the configured policy over the task (no-op when unset).
+
+    Applied exactly once per user request: controller-side relaunches
+    (managed-job recovery, serve replicas) carry tasks already stamped
+    ``policy_applied`` and pass through unchanged.
+    """
+    if task.policy_applied:
+        return task
+    policy = _load_policy()
+    if policy is None:
+        return task
+    request = UserRequest(task=task, operation=operation,
+                          request_options=dict(request_options or {}))
+    mutated = policy.validate_and_mutate(request)
+    if not isinstance(mutated, MutatedUserRequest):
+        raise exceptions.InvalidSpecError(
+            'admin policy must return a MutatedUserRequest')
+    mutated.task.policy_applied = True
+    return mutated.task

@@ -134,6 +134,7 @@ class TpuPodBackend(Backend):
                 # Bucket-sourced file mount == COPY-mode storage mount
                 # (ref storage.py:781 docstring contract).
                 storage = Storage(source=src, mode='COPY')
+                storage.ensure_bucket()  # fail client-side on a typo'd bucket
                 self._run_mount_command(runners, dst,
                                         storage.cluster_command(dst))
                 continue

@@ -1,0 +1,137 @@
+"""Layered YAML configuration.
+
+Parity: ``sky/skypilot_config.py`` (get_nested with override_configs,
+docstring :1-50; env entry points :111-117). Four layers, later wins:
+
+1. **server**  — ``$SKYT_STATE_DIR/server/config.yaml`` (deployment-wide
+   defaults an operator sets on the API server host);
+2. **user**    — ``~/.skyt/config.yaml`` or ``$SKYT_CONFIG``;
+3. **project** — ``./.skyt.yaml`` of the current working directory;
+4. **task**    — the ``config:`` section of a task YAML, threaded
+   through as ``override_configs``.
+
+Values are addressed by key path::
+
+    config.get_nested(('jobs', 'max_launching'), default=8)
+
+The merged dict is cached per (paths, mtimes); tests and the API server
+call :func:`reload` after writing config files.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils.common_utils import deep_update
+
+ENV_CONFIG_PATH = 'SKYT_CONFIG'
+PROJECT_CONFIG_NAME = '.skyt.yaml'
+
+_lock = threading.Lock()
+_cache: Optional[Tuple[Tuple, Dict[str, Any]]] = None
+
+
+def _state_dir() -> str:
+    return os.environ.get('SKYT_STATE_DIR', os.path.expanduser('~/.skyt'))
+
+
+def user_config_path() -> str:
+    return os.environ.get(ENV_CONFIG_PATH,
+                          os.path.join(_state_dir(), 'config.yaml'))
+
+
+def server_config_path() -> str:
+    return os.path.join(_state_dir(), 'server', 'config.yaml')
+
+
+def project_config_path() -> str:
+    return os.path.join(os.getcwd(), PROJECT_CONFIG_NAME)
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        try:
+            data = yaml.safe_load(f) or {}
+        except yaml.YAMLError as e:
+            raise exceptions.InvalidSpecError(
+                f'Invalid YAML in config {path}: {e}') from e
+    if not isinstance(data, dict):
+        raise exceptions.InvalidSpecError(
+            f'Config {path} must be a mapping, got {type(data).__name__}')
+    return data
+
+
+def _layer_paths() -> Tuple[str, ...]:
+    return (server_config_path(), user_config_path(),
+            project_config_path())
+
+
+def _fingerprint() -> Tuple:
+    fp = []
+    for path in _layer_paths():
+        try:
+            fp.append((path, os.stat(path).st_mtime_ns))
+        except OSError:
+            fp.append((path, None))
+    return tuple(fp)
+
+
+def loaded() -> Dict[str, Any]:
+    """The merged config (server < user < project)."""
+    global _cache
+    fp = _fingerprint()
+    with _lock:
+        if _cache is not None and _cache[0] == fp:
+            return _cache[1]
+        merged: Dict[str, Any] = {}
+        for path in _layer_paths():
+            merged = deep_update(merged, _load_file(path))
+        _cache = (fp, merged)
+        return merged
+
+
+def reload() -> None:
+    global _cache
+    with _lock:
+        _cache = None
+
+
+def get_nested(key_path: Iterable[str],
+               default: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    """Look up a key path; ``override_configs`` is the task layer."""
+    config = loaded()
+    if override_configs:
+        config = deep_update(dict(config), override_configs)
+    node: Any = config
+    for key in key_path:
+        if not isinstance(node, dict) or key not in node:
+            return default
+        node = node[key]
+    return node
+
+
+def set_nested(key_path: Iterable[str], value: Any,
+               scope: str = 'user') -> None:
+    """Persist a value into the user (or server) config file."""
+    path = {'user': user_config_path(),
+            'server': server_config_path()}[scope]
+    data = _load_file(path)
+    node = data
+    keys = list(key_path)
+    for key in keys[:-1]:
+        node = node.setdefault(key, {})
+        if not isinstance(node, dict):
+            raise exceptions.InvalidSpecError(
+                f'Config path {keys} collides with a scalar at {key!r}')
+    node[keys[-1]] = value
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(data, f)
+    reload()

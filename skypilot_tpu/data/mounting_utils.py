@@ -72,7 +72,7 @@ def gcs_mount_cached_command(bucket: str, mount_path: str) -> str:
         f'{{ mountpoint -q {path} || '
         f'rclone mount {shlex.quote(remote)} {path} --daemon '
         '--vfs-cache-mode writes --vfs-cache-max-size 10G '
-        '--dir-cache-time 30s; }}')
+        '--dir-cache-time 30s; }')
 
 
 def gcs_download_command(bucket: str, prefix: str, dest: str) -> str:

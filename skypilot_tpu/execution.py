@@ -58,6 +58,10 @@ def launch(task_or_dag: Union[Task, Dag],
     """
     dag = _as_dag(task_or_dag)
     dag.validate()
+    # Admin policy hook (parity: admin_policy_utils.apply in
+    # _execute_dag, execution.py:340).
+    from skypilot_tpu import admin_policy
+    dag.tasks = [admin_policy.apply(t, 'launch') for t in dag.tasks]
     backend = backend or TpuPodBackend()
     stages = stages or ALL_STAGES
     results: List[Tuple[str, Optional[int]]] = []

@@ -20,6 +20,8 @@ logger = log.init_logger(__name__)
 
 def launch(task: Task, name: Optional[str] = None) -> int:
     """Submit a managed job; returns its job id immediately."""
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'jobs.launch')
     resources = task.resources[0] if task.resources else None
     strategy = 'FAILOVER'
     max_restarts = 0

@@ -30,12 +30,27 @@ from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
 
 logger = log.init_logger(__name__)
 
-# Initial-launch retry cadence on full stockout (env-tunable for tests;
-# the reference backs off up to RETRY_INIT_GAP_SECONDS=60).
-LAUNCH_RETRY_GAP_SECONDS = float(
-    os.environ.get('SKYT_JOBS_LAUNCH_RETRY_GAP', '20'))
-MAX_LAUNCH_RETRIES = int(os.environ.get('SKYT_JOBS_MAX_LAUNCH_RETRIES',
-                                        '30'))
+# Initial-launch retry cadence on full stockout. Env > per-task config
+# (`config: {jobs: {launch_retry_gap: N}}`) > global config > default
+# (the reference backs off up to RETRY_INIT_GAP_SECONDS=60).
+
+
+def _retry_gap(task: Task) -> float:
+    if 'SKYT_JOBS_LAUNCH_RETRY_GAP' in os.environ:
+        return float(os.environ['SKYT_JOBS_LAUNCH_RETRY_GAP'])
+    from skypilot_tpu import config
+    return float(config.get_nested(
+        ('jobs', 'launch_retry_gap'), 20,
+        override_configs=task.config_overrides))
+
+
+def _max_retries(task: Task) -> int:
+    if 'SKYT_JOBS_MAX_LAUNCH_RETRIES' in os.environ:
+        return int(os.environ['SKYT_JOBS_MAX_LAUNCH_RETRIES'])
+    from skypilot_tpu import config
+    return int(config.get_nested(
+        ('jobs', 'max_launch_retries'), 30,
+        override_configs=task.config_overrides))
 
 
 class StrategyExecutor:
@@ -98,22 +113,23 @@ class StrategyExecutor:
             state.remove_cluster(self.cluster_name)
 
     def _launch_with_retries(self, blocklist: Blocklist) -> int:
-        backoff = common_utils.Backoff(LAUNCH_RETRY_GAP_SECONDS,
-                                       LAUNCH_RETRY_GAP_SECONDS * 10)
-        for attempt in range(MAX_LAUNCH_RETRIES):
+        gap = _retry_gap(self.task)
+        max_retries = _max_retries(self.task)
+        backoff = common_utils.Backoff(gap, gap * 10)
+        for attempt in range(max_retries):
             try:
                 return self._relaunch_once(blocklist)
             except exceptions.ResourcesUnavailableError as e:
                 logger.info(
                     'Job %s: no resources anywhere (attempt %d/%d): %s',
-                    self.job_id, attempt + 1, MAX_LAUNCH_RETRIES, e)
+                    self.job_id, attempt + 1, max_retries, e)
                 # Full stockout: clear location blocklists (stockouts are
                 # transient) and wait for capacity.
                 blocklist.zones.clear()
                 blocklist.regions.clear()
                 time.sleep(backoff.current_backoff())
         raise exceptions.ResourcesUnavailableError(
-            f'Managed job {self.job_id}: exhausted {MAX_LAUNCH_RETRIES} '
+            f'Managed job {self.job_id}: exhausted {max_retries} '
             'launch attempts across all locations.')
 
 

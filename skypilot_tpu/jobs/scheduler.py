@@ -24,11 +24,18 @@ logger = log.init_logger(__name__)
 
 
 def _max_launching() -> int:
-    return int(os.environ.get('SKYT_JOBS_MAX_LAUNCHING', '8'))
+    """Env > config > default (ref: controller CPU-bounded limits)."""
+    from skypilot_tpu import config
+    if 'SKYT_JOBS_MAX_LAUNCHING' in os.environ:
+        return int(os.environ['SKYT_JOBS_MAX_LAUNCHING'])
+    return int(config.get_nested(('jobs', 'max_launching'), 8))
 
 
 def _max_alive() -> int:
-    return int(os.environ.get('SKYT_JOBS_MAX_ALIVE', '64'))
+    from skypilot_tpu import config
+    if 'SKYT_JOBS_MAX_ALIVE' in os.environ:
+        return int(os.environ['SKYT_JOBS_MAX_ALIVE'])
+    return int(config.get_nested(('jobs', 'max_alive'), 64))
 
 
 def maybe_schedule_next_jobs() -> None:

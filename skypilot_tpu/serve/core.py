@@ -30,6 +30,8 @@ def up(task: Task, service_name: Optional[str] = None) -> Dict[str, Any]:
     if task.service is None:
         raise exceptions.InvalidSpecError(
             'Task has no service section; add `service:` to the YAML.')
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'serve.up')
     spec = ServiceSpec.from_yaml_config(task.service)
     name = service_name or task.name or common_utils.generate_cluster_name(
         'service')

@@ -1,0 +1,192 @@
+"""JSON-schema validation of task YAML.
+
+Parity: ``sky/utils/schemas.py`` (2733 LoC of draft-07 schemas — the
+canonical YAML spec). The schema here covers the task surface this
+framework implements; ``Task.from_yaml`` validates before construction
+so users get a pointed "where and what" error instead of a mid-launch
+stack trace.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+
+_ENV_DICT = {
+    'type': 'object',
+    'additionalProperties': {'type': ['string', 'number', 'boolean']},
+}
+
+_AUTOSTOP = {
+    'anyOf': [
+        {'type': ['integer', 'number']},            # idle minutes
+        {'type': 'boolean'},
+        {'type': 'string'},                         # '30m', '1h'
+        {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'idle_minutes': {'type': ['integer', 'number']},
+                'down': {'type': 'boolean'},
+            },
+        },
+    ],
+}
+
+_JOB_RECOVERY = {
+    'anyOf': [
+        {'type': 'string'},                         # strategy name
+        {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'strategy': {'type': ['string', 'null']},
+                'max_restarts_on_errors': {'type': 'integer',
+                                           'minimum': 0},
+            },
+        },
+    ],
+}
+
+_RESOURCES = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'cloud': {'type': ['string', 'null']},
+        'infra': {'type': 'string'},
+        'region': {'type': ['string', 'null']},
+        'zone': {'type': ['string', 'null']},
+        'accelerators': {'type': ['string', 'object', 'null']},
+        'accelerator_args': {'type': 'object'},
+        'num_slices': {'type': 'integer', 'minimum': 1},
+        'cpus': {'type': ['string', 'integer', 'number', 'null']},
+        'memory': {'type': ['string', 'integer', 'number', 'null']},
+        'instance_type': {'type': ['string', 'null']},
+        'use_spot': {'type': 'boolean'},
+        'job_recovery': _JOB_RECOVERY,
+        'disk_size': {'type': ['integer', 'string', 'null']},
+        'image_id': {'type': ['string', 'null']},
+        'ports': {
+            'anyOf': [
+                {'type': ['string', 'integer']},
+                {'type': 'array', 'items': {'type': ['string', 'integer']}},
+            ],
+        },
+        'labels': {'type': 'object',
+                   'additionalProperties': {'type': 'string'}},
+        'autostop': _AUTOSTOP,
+        'network_tier': {'type': 'string',
+                         'enum': ['standard', 'best']},
+    },
+}
+
+_STORAGE_MOUNT = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'source': {'type': 'string'},
+        'store': {'type': 'string', 'enum': ['gcs', 'local']},
+        'mode': {'type': 'string',
+                 'enum': ['MOUNT', 'COPY', 'MOUNT_CACHED',
+                          'mount', 'copy', 'mount_cached']},
+        'persistent': {'type': 'boolean'},
+    },
+    'anyOf': [{'required': ['name']}, {'required': ['source']}],
+}
+
+_SERVICE = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'port': {'type': ['integer', 'null']},
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'path': {'type': 'string'},
+                        'initial_delay_seconds': {
+                            'type': ['integer', 'number']},
+                        'timeout_seconds': {'type': ['integer', 'number']},
+                    },
+                },
+            ],
+        },
+        'replicas': {'type': 'integer', 'minimum': 0},
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': ['integer', 'number']},
+                'target_queue_length': {'type': ['integer', 'number']},
+                'upscale_delay_seconds': {'type': ['integer', 'number']},
+                'downscale_delay_seconds': {'type': ['integer', 'number']},
+                'qps_window_seconds': {'type': ['integer', 'number']},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+        },
+        'load_balancing_policy': {
+            'type': 'string',
+            'enum': ['round_robin', 'least_load',
+                     'instance_aware_least_load'],
+        },
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    '$schema': 'http://json-schema.org/draft-07/schema#',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'workdir': {'type': ['string', 'null']},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'setup': {'type': ['string', 'null']},
+        'run': {'type': ['string', 'null']},
+        'envs': _ENV_DICT,
+        'secrets': _ENV_DICT,
+        'file_mounts': {
+            'type': 'object',
+            'additionalProperties': {'type': 'string'},
+        },
+        'storage_mounts': {
+            'type': 'object',
+            'additionalProperties': _STORAGE_MOUNT,
+        },
+        'resources': {
+            'anyOf': [
+                _RESOURCES,
+                {'type': 'array', 'items': _RESOURCES},
+                {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'any_of': {'type': 'array', 'items': _RESOURCES},
+                    },
+                    'required': ['any_of'],
+                },
+                {'type': 'null'},
+            ],
+        },
+        'service': _SERVICE,
+        'config': {'type': 'object'},
+    },
+}
+
+
+def validate_task_config(config: Dict[str, Any],
+                         source: str = 'task') -> None:
+    """Raise InvalidSpecError with a path-pointed message on violation."""
+    import jsonschema
+    try:
+        jsonschema.validate(config, TASK_SCHEMA)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<top level>'
+        raise exceptions.InvalidSpecError(
+            f'Invalid {source} YAML at {path}: {e.message}') from None

@@ -67,6 +67,13 @@ class Task:
         else:
             self.resources = list(resources)
         self.service = service
+        # Per-task config layer (the `config:` YAML section), threaded
+        # into config.get_nested(... override_configs=...) by consumers.
+        self.config_overrides: Dict[str, Any] = {}
+        # Set once the admin policy has mutated this task; survives the
+        # serialize->controller->relaunch round trip so recovery/replica
+        # launches don't re-apply a non-idempotent policy.
+        self.policy_applied: bool = False
         # Filled by the optimizer (parity: task.best_resources,
         # sky/optimizer.py:109 assigns per task).
         self.best_resources: Optional[Resources] = None
@@ -101,7 +108,7 @@ class Task:
         known = {
             'name', 'setup', 'run', 'workdir', 'num_nodes', 'envs',
             'secrets', 'file_mounts', 'storage_mounts', 'resources',
-            'service', 'config',
+            'service', 'config', '_policy_applied',
         }
         unknown = set(config) - known
         if unknown:
@@ -119,7 +126,7 @@ class Task:
             ]
         else:
             resources = Resources.from_yaml_config(resources_config)
-        return cls(
+        task = cls(
             name=config.get('name'),
             setup=config.get('setup'),
             run=config.get('run'),
@@ -132,6 +139,9 @@ class Task:
             resources=resources,
             service=config.get('service'),
         )
+        task.config_overrides = dict(config.get('config') or {})
+        task.policy_applied = bool(config.get('_policy_applied', False))
+        return task
 
     @classmethod
     def from_yaml(cls, path: str) -> 'Task':
@@ -140,6 +150,10 @@ class Task:
         if not isinstance(config, dict):
             raise exceptions.InvalidSpecError(
                 f'YAML file {path} does not contain a task mapping.')
+        # User-authored YAML gets schema validation for pointed errors
+        # (parity: sky/utils/schemas.py); internal round-trips skip it.
+        from skypilot_tpu.spec import schemas
+        schemas.validate_task_config(config, source=path)
         return cls.from_yaml_config(config)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -172,6 +186,10 @@ class Task:
             config['run'] = self.run
         if self.service:
             config['service'] = self.service
+        if self.config_overrides:
+            config['config'] = dict(self.config_overrides)
+        if self.policy_applied:
+            config['_policy_applied'] = True
         return config
 
     def to_yaml(self, path: str) -> None:

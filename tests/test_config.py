@@ -1,0 +1,187 @@
+"""Layered config, task-YAML schema validation, and the admin-policy
+hook (parity: the reference's skypilot_config/schemas/admin_policy unit
+tests)."""
+import os
+import textwrap
+
+import pytest
+
+from skypilot_tpu import admin_policy, config, exceptions
+from skypilot_tpu.spec import schemas
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fresh_config(tmp_home):
+    config.reload()
+    yield
+    config.reload()
+
+
+def _write(path, text):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(textwrap.dedent(text))
+    config.reload()
+
+
+# -- layered config ---------------------------------------------------------
+
+
+def test_layers_merge_in_order(tmp_path, monkeypatch):
+    _write(config.server_config_path(), """
+        jobs: {max_launching: 2, max_alive: 10}
+        region: server-region
+    """)
+    _write(config.user_config_path(), """
+        jobs: {max_launching: 4}
+    """)
+    monkeypatch.chdir(tmp_path)
+    _write(config.project_config_path(), """
+        region: project-region
+    """)
+    # user overrides server on the shared key; deep merge keeps siblings.
+    assert config.get_nested(('jobs', 'max_launching')) == 4
+    assert config.get_nested(('jobs', 'max_alive')) == 10
+    assert config.get_nested(('region',)) == 'project-region'
+
+
+def test_override_configs_is_last_layer():
+    _write(config.user_config_path(), 'x: {y: 1}\n')
+    assert config.get_nested(('x', 'y'), override_configs={'x': {'y': 9}}) == 9
+    assert config.get_nested(('x', 'y')) == 1
+
+
+def test_missing_key_returns_default():
+    assert config.get_nested(('no', 'such', 'key'), default=42) == 42
+
+
+def test_set_nested_roundtrip():
+    config.set_nested(('serve', 'controller', 'poll'), 7)
+    assert config.get_nested(('serve', 'controller', 'poll')) == 7
+
+
+def test_invalid_config_file_raises():
+    _write(config.user_config_path(), '- not\n- a\n- mapping\n')
+    with pytest.raises(exceptions.InvalidSpecError, match='mapping'):
+        config.loaded()
+
+
+def test_task_yaml_config_section_threads_through(tmp_path):
+    yaml_path = tmp_path / 't.yaml'
+    yaml_path.write_text('run: echo hi\nconfig: {jobs: {max_launching: 3}}\n')
+    task = Task.from_yaml(str(yaml_path))
+    assert task.config_overrides == {'jobs': {'max_launching': 3}}
+    assert config.get_nested(('jobs', 'max_launching'), 8,
+                             override_configs=task.config_overrides) == 3
+    # And it round-trips through serialization (controller processes).
+    again = Task.from_yaml_config(task.to_yaml_config())
+    assert again.config_overrides == task.config_overrides
+
+
+# -- schema validation ------------------------------------------------------
+
+
+def test_schema_accepts_full_task():
+    schemas.validate_task_config({
+        'name': 't',
+        'num_nodes': 2,
+        'resources': {'cloud': 'gcp', 'accelerators': 'tpu-v5e-8',
+                      'use_spot': True,
+                      'job_recovery': {'max_restarts_on_errors': 3}},
+        'storage_mounts': {'/ckpt': {'name': 'b', 'mode': 'MOUNT'}},
+        'service': {'readiness_probe': '/health', 'replicas': 2},
+        'run': 'echo hi',
+    })
+
+
+def test_schema_rejects_with_pointed_path(tmp_path):
+    with pytest.raises(exceptions.InvalidSpecError,
+                       match='resources.num_slices'):
+        schemas.validate_task_config({
+            'run': 'x',
+            'resources': {'num_slices': 0},
+        })
+    with pytest.raises(exceptions.InvalidSpecError, match='bogus'):
+        schemas.validate_task_config({'bogus': 1})
+    yaml_path = tmp_path / 'bad.yaml'
+    yaml_path.write_text('run: echo hi\nresources: {cloud: 5}\n')
+    with pytest.raises(exceptions.InvalidSpecError, match='cloud'):
+        Task.from_yaml(str(yaml_path))
+
+
+# -- admin policy -----------------------------------------------------------
+
+
+class _ForceSpotPolicy(admin_policy.AdminPolicy):
+    def validate_and_mutate(self, user_request):
+        task = user_request.task
+        task.resources = [r.copy(use_spot=True) for r in task.resources]
+        return admin_policy.MutatedUserRequest(task=task)
+
+
+class _DenyAllPolicy(admin_policy.AdminPolicy):
+    def validate_and_mutate(self, user_request):
+        raise admin_policy.RejectedByPolicy(
+            f'{user_request.operation} denied')
+
+
+def test_admin_policy_mutates_task():
+    _write(config.user_config_path(),
+           'admin_policy: tests.test_config._ForceSpotPolicy\n')
+    task = Task(run='x', resources=Resources(cloud='fake',
+                                             accelerators='tpu-v5e-8'))
+    mutated = admin_policy.apply(task, 'launch')
+    assert all(r.use_spot for r in mutated.resources)
+
+
+def test_admin_policy_rejects():
+    _write(config.user_config_path(),
+           'admin_policy: tests.test_config._DenyAllPolicy\n')
+    task = Task(run='x')
+    with pytest.raises(admin_policy.RejectedByPolicy, match='launch denied'):
+        admin_policy.apply(task, 'launch')
+
+
+def test_admin_policy_bad_path_errors():
+    _write(config.user_config_path(), 'admin_policy: not.a.RealPolicy\n')
+    with pytest.raises(exceptions.InvalidSpecError, match='Cannot load'):
+        admin_policy.apply(Task(run='x'), 'launch')
+
+
+def test_no_policy_is_noop():
+    task = Task(run='x')
+    assert admin_policy.apply(task, 'launch') is task
+
+
+class _AppendSetupPolicy(admin_policy.AdminPolicy):
+    """Deliberately non-idempotent: appends a line per application."""
+
+    def validate_and_mutate(self, user_request):
+        task = user_request.task
+        task.setup = (task.setup or '') + 'echo policy\n'
+        return admin_policy.MutatedUserRequest(task=task)
+
+
+def test_admin_policy_applied_once_across_serialization():
+    """Controller relaunches (recovery/replicas) must not re-apply a
+    non-idempotent policy: the applied stamp survives the round trip."""
+    _write(config.user_config_path(),
+           'admin_policy: tests.test_config._AppendSetupPolicy\n')
+    task = admin_policy.apply(Task(run='x'), 'jobs.launch')
+    assert task.setup.count('echo policy') == 1
+    # Round trip through the managed-job DB / serve DB representation.
+    roundtripped = Task.from_yaml_config(task.to_yaml_config())
+    again = admin_policy.apply(roundtripped, 'launch')
+    assert again.setup.count('echo policy') == 1
+
+
+def test_per_task_retry_config_reaches_recovery(monkeypatch):
+    from skypilot_tpu.jobs import recovery_strategy
+    monkeypatch.delenv('SKYT_JOBS_MAX_LAUNCH_RETRIES', raising=False)
+    task = Task.from_yaml_config({
+        'run': 'x', 'config': {'jobs': {'max_launch_retries': 2,
+                                        'launch_retry_gap': 0.5}}})
+    assert recovery_strategy._max_retries(task) == 2
+    assert recovery_strategy._retry_gap(task) == 0.5

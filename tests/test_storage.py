@@ -82,6 +82,21 @@ def test_quote_path_preserves_home_expansion():
     assert '"$HOME"' == mounting_utils.quote_path('~')
 
 
+def test_generated_commands_are_valid_bash():
+    import subprocess
+    from skypilot_tpu.data.storage import GcsStore
+    store = GcsStore('bkt')
+    for cmd in (store.mount_command('~/mnt'),
+                store.mount_cached_command('/ckpt'),
+                store.download_command('/data', 'p'),
+                mounting_utils.unmount_command('~/mnt'),
+                mounting_utils.local_mount_command('/b', '~/m'),
+                mounting_utils.local_download_command('/b', '', '/d')):
+        proc = subprocess.run(['bash', '-n', '-c', cmd],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, f'bad shell: {proc.stderr}\n{cmd}'
+
+
 def test_gcs_command_generation():
     from skypilot_tpu.data.storage import GcsStore
     store = GcsStore('bkt')
