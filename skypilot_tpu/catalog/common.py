@@ -146,7 +146,7 @@ def get_zones_for_region(accelerator: str, region: str) -> List[str]:
 
 def validate_region_zone(cloud: str, region: Optional[str],
                          zone: Optional[str]) -> None:
-    if cloud not in ('gcp', 'fake', 'local'):
+    if cloud not in ('gcp', 'fake', 'local', 'kubernetes'):
         raise exceptions.InvalidSpecError(f'Unknown cloud {cloud!r}')
     if cloud != 'gcp' or region is None:
         return

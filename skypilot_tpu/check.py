@@ -23,10 +23,21 @@ def _check_gcp() -> Tuple[bool, str]:
     return False, 'no gcloud credentials found'
 
 
+def _check_kubernetes() -> Tuple[bool, str]:
+    if os.environ.get('SKYT_K8S_FAKE'):
+        return True, 'fake apiserver (SKYT_K8S_FAKE)'
+    from skypilot_tpu.provision.kubernetes import find_kubeconfig
+    path = find_kubeconfig()
+    if path is not None:
+        return True, f'kubeconfig at {path}'
+    return False, 'no kubeconfig found'
+
+
 _CHECKS = {
     'local': lambda: (True, 'always available'),
     'fake': lambda: (True, 'always available (simulated cloud)'),
     'gcp': _check_gcp,
+    'kubernetes': _check_kubernetes,
 }
 
 

@@ -14,6 +14,7 @@ from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
 from skypilot_tpu.provision import fake as _fake  # noqa: F401
 from skypilot_tpu.provision import local as _local  # noqa: F401
 from skypilot_tpu.provision import gcp as _gcp  # noqa: F401
+from skypilot_tpu.provision import kubernetes as _kubernetes  # noqa: F401
 
 __all__ = ['ClusterInfo', 'HostInfo', 'ProvisionRequest', 'Provider',
            'get_provider']

@@ -221,9 +221,6 @@ class FakeProvider(Provider):
                 return None
             return self._to_cluster_info(cluster_name, cluster)
 
-    # Fake clusters execute commands locally (no SSH); the command runner
-    # checks this flag.
-    run_commands_locally = True
 
 
 def list_fake_clusters() -> List[str]:

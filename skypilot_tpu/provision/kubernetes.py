@@ -1,0 +1,564 @@
+"""Kubernetes (GKE TPU) provider: pods as hosts, TPU slices first-class.
+
+Parity targets: ``sky/provision/kubernetes/`` (11k LoC) — GKE TPU name
+normalization (`utils.py:310` tpu-v6e-8 -> tpu-v6e-slice), generation
+map :243, topology map :632, `is_tpu_on_gke` :4705 — with the big
+difference that **multi-host TPU slices are supported** (the reference
+rejects them, `utils.py:1299-1301`; closing that gap is a SURVEY.md
+§2.10 deliverable). One pod per TPU host; the pods of a slice share a
+`job-name`-style label and a headless Service for stable DNS, and GKE's
+TPU webhook injects `TPU_WORKER_ID`/`TPU_WORKER_HOSTNAMES` for pods
+with the right selectors — our backend additionally injects its own
+rank envs at exec time, so both the webhook and non-GKE clusters work.
+
+The API transport is pluggable: `RestKubernetesApi` talks to a real
+apiserver with kubeconfig auth (bearer token or client certs — the k8s
+Python SDK is intentionally not a dependency, matching the reference's
+lazy-adaptor stance); `FakeKubernetesApi` is a file-backed in-process
+cluster for tests (the moto-style fixture of SURVEY.md §4), with fault
+injection for unschedulable pods.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from typing import Any, Dict, List, Optional
+
+import filelock
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = log.init_logger(__name__)
+
+# GKE accelerator label values per TPU generation (ref kubernetes/
+# utils.py:243 GKE_TPU_ACCELERATOR_TO_GENERATION inverted).
+GKE_TPU_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+LABEL_CLUSTER = 'skyt/cluster'
+LABEL_NODE = 'skyt/node-index'
+LABEL_WORKER = 'skyt/worker-index'
+
+DEFAULT_IMAGE = os.environ.get(
+    'SKYT_K8S_IMAGE', 'python:3.11-slim')
+
+
+def _provision_timeout() -> float:
+    return float(os.environ.get('SKYT_K8S_PROVISION_TIMEOUT', '600'))
+
+
+def gke_tpu_selectors(resources) -> Dict[str, str]:
+    """nodeSelector labels for a TPU slice request (ref utils.py:310/632:
+    name normalization + topology map, derived here from TpuTopology
+    instead of lookup tables)."""
+    tpu = resources.tpu
+    accel = GKE_TPU_ACCELERATOR.get(tpu.generation)
+    if accel is None:
+        raise exceptions.NotSupportedError(
+            f'TPU generation {tpu.generation} has no GKE node pools '
+            f'(available: {sorted(GKE_TPU_ACCELERATOR)})')
+    return {
+        'cloud.google.com/gke-tpu-accelerator': accel,
+        'cloud.google.com/gke-tpu-topology': tpu.topology_str,
+    }
+
+
+def build_pod_manifest(request: ProvisionRequest, node: int, worker: int,
+                       namespace: str) -> Dict[str, Any]:
+    """One pod = one TPU host of one slice (pure; unit-testable)."""
+    res = request.resources
+    name = f'{request.cluster_name}-{node}-{worker}'
+    labels = {
+        LABEL_CLUSTER: request.cluster_name,
+        LABEL_NODE: str(node),
+        LABEL_WORKER: str(worker),
+        **request.labels,
+    }
+    spec: Dict[str, Any] = {
+        'restartPolicy': 'Never',
+        'containers': [{
+            'name': 'skyt',
+            'image': DEFAULT_IMAGE,
+            'command': ['/bin/sh', '-c', 'sleep infinity'],
+            'resources': {},
+        }],
+        'hostname': name,
+        'subdomain': request.cluster_name,   # headless-service DNS
+    }
+    if res.is_tpu:
+        tpu = res.tpu
+        spec['nodeSelector'] = gke_tpu_selectors(res)
+        chips = tpu.chips_per_host
+        spec['containers'][0]['resources'] = {
+            'requests': {'google.com/tpu': str(chips)},
+            'limits': {'google.com/tpu': str(chips)},
+        }
+    if res.use_spot:
+        spec.setdefault('nodeSelector', {})[
+            'cloud.google.com/gke-spot'] = 'true'
+        spec['tolerations'] = [{
+            'key': 'cloud.google.com/gke-spot',
+            'operator': 'Equal',
+            'value': 'true',
+            'effect': 'NoSchedule',
+        }]
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {'name': name, 'namespace': namespace,
+                     'labels': labels},
+        'spec': spec,
+    }
+
+
+def build_headless_service(cluster_name: str,
+                           namespace: str) -> Dict[str, Any]:
+    """Stable per-pod DNS (<hostname>.<cluster>.<ns>.svc) for the gang
+    — what TPU_WORKER_HOSTNAMES points at on GKE."""
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': cluster_name, 'namespace': namespace,
+                     'labels': {LABEL_CLUSTER: cluster_name}},
+        'spec': {
+            'clusterIP': 'None',
+            'selector': {LABEL_CLUSTER: cluster_name},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# API transports
+# ---------------------------------------------------------------------------
+
+
+class KubernetesApi:
+    """The handful of apiserver operations the provider needs."""
+
+    def create_pod(self, namespace: str, manifest: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def create_service(self, namespace: str,
+                       manifest: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def list_pods(self, namespace: str,
+                  label_selector: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+
+def find_kubeconfig() -> Optional[str]:
+    """First existing file of $KUBECONFIG (colon-separated list, per the
+    k8s convention) or ~/.kube/config."""
+    env = os.environ.get('KUBECONFIG')
+    candidates = (env.split(os.pathsep) if env
+                  else [os.path.expanduser('~/.kube/config')])
+    for path in candidates:
+        if path and os.path.exists(path):
+            return path
+    return None
+
+
+class RestKubernetesApi(KubernetesApi):
+    """Thin kubeconfig-authenticated REST client (no k8s SDK dep).
+
+    Auth: static bearer token, embedded client certs, or an ``exec:``
+    credential plugin (the GKE default — gke-gcloud-auth-plugin emits an
+    ExecCredential JSON whose token we use)."""
+
+    def __init__(self, kubeconfig: Optional[str] = None,
+                 context: Optional[str] = None) -> None:
+        path = kubeconfig or find_kubeconfig()
+        if path is None or not os.path.exists(path):
+            raise exceptions.NoCloudAccessError(
+                f'No kubeconfig found (KUBECONFIG='
+                f'{os.environ.get("KUBECONFIG")!r}).')
+        with open(path, encoding='utf-8') as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get('current-context')
+        ctx = next(c['context'] for c in cfg['contexts']
+                   if c['name'] == ctx_name)
+        cluster = next(c['cluster'] for c in cfg['clusters']
+                       if c['name'] == ctx['cluster'])
+        user = next(u['user'] for u in cfg['users']
+                    if u['name'] == ctx['user'])
+        self.server = cluster['server']
+        self._ssl = self._ssl_context(cluster, user)
+        self._token = user.get('token') or self._exec_plugin_token(user)
+
+    @staticmethod
+    def _exec_plugin_token(user: Dict[str, Any]) -> Optional[str]:
+        """Run the kubeconfig `exec:` credential plugin (client.authn
+        ExecCredential protocol — how GKE kubeconfigs authenticate)."""
+        exec_cfg = user.get('exec')
+        if not exec_cfg:
+            return None
+        import subprocess
+        cmd = [exec_cfg['command']] + list(exec_cfg.get('args') or [])
+        env = dict(os.environ)
+        for item in exec_cfg.get('env') or []:
+            env[item['name']] = item['value']
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 env=env, timeout=60, check=False)
+        except FileNotFoundError as e:
+            raise exceptions.NoCloudAccessError(
+                f'kubeconfig exec plugin {cmd[0]!r} not installed: {e}'
+            ) from e
+        if out.returncode != 0:
+            raise exceptions.NoCloudAccessError(
+                f'kubeconfig exec plugin failed: {out.stderr[-500:]}')
+        try:
+            cred = json.loads(out.stdout)
+            return cred['status']['token']
+        except (json.JSONDecodeError, KeyError) as e:
+            raise exceptions.NoCloudAccessError(
+                f'Malformed ExecCredential from {cmd[0]!r}: {e}') from e
+
+    @staticmethod
+    def _ssl_context(cluster: Dict[str, Any],
+                     user: Dict[str, Any]) -> ssl.SSLContext:
+        ctx = ssl.create_default_context()
+        ca = cluster.get('certificate-authority-data')
+        if ca:
+            ctx.load_verify_locations(
+                cadata=base64.b64decode(ca).decode())
+        elif cluster.get('certificate-authority'):
+            ctx.load_verify_locations(cluster['certificate-authority'])
+        cert = user.get('client-certificate-data')
+        key = user.get('client-key-data')
+        if cert and key:
+            # load_cert_chain needs files; write the decoded pair to a
+            # private tmp file and unlink as soon as it is loaded (key
+            # material must not persist in /tmp).
+            cert_file = tempfile.NamedTemporaryFile(delete=False,
+                                                    suffix='.pem')
+            try:
+                os.chmod(cert_file.name, 0o600)
+                cert_file.write(base64.b64decode(cert))
+                cert_file.write(b'\n')
+                cert_file.write(base64.b64decode(key))
+                cert_file.close()
+                ctx.load_cert_chain(cert_file.name)
+            finally:
+                os.unlink(cert_file.name)
+        return ctx
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{self.server}{path}'
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header('Content-Type', 'application/json')
+        req.add_header('Accept', 'application/json')
+        if self._token:
+            req.add_header('Authorization', f'Bearer {self._token}')
+        try:
+            with urllib.request.urlopen(req, context=self._ssl,
+                                        timeout=30) as resp:
+                return json.loads(resp.read() or b'{}')
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors='replace')[:800]
+            raise exceptions.ProvisionError(
+                f'k8s API {method} {path}: HTTP {e.code}: {detail}') from e
+        except (urllib.error.URLError, OSError) as e:
+            # Connection refused / DNS / TLS / timeout: wrap so the
+            # failover provisioner classifies it, not a raw traceback.
+            raise exceptions.ProvisionError(
+                f'k8s API {method} {self.server}{path}: {e}') from e
+
+    def create_pod(self, namespace, manifest):
+        self._request('POST', f'/api/v1/namespaces/{namespace}/pods',
+                      manifest)
+
+    def create_service(self, namespace, manifest):
+        self._request('POST', f'/api/v1/namespaces/{namespace}/services',
+                      manifest)
+
+    def list_pods(self, namespace, label_selector):
+        out = self._request(
+            'GET', f'/api/v1/namespaces/{namespace}/pods'
+            f'?labelSelector={urllib.parse.quote(label_selector)}')
+        return out.get('items', [])
+
+    def delete_pod(self, namespace, name):
+        try:
+            self._request('DELETE',
+                          f'/api/v1/namespaces/{namespace}/pods/{name}')
+        except exceptions.ProvisionError as e:
+            if 'HTTP 404' not in str(e):
+                raise
+
+    def delete_service(self, namespace, name):
+        try:
+            self._request(
+                'DELETE', f'/api/v1/namespaces/{namespace}/services/{name}')
+        except exceptions.ProvisionError as e:
+            if 'HTTP 404' not in str(e):
+                raise
+
+
+def _fake_store_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'fake_k8s.json')
+
+
+class _FakeStore:
+    def __init__(self) -> None:
+        self._path = _fake_store_path()
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._lock = filelock.FileLock(self._path + '.lock')
+
+    def __enter__(self) -> Dict[str, Any]:
+        self._lock.acquire()
+        if os.path.exists(self._path):
+            with open(self._path, encoding='utf-8') as f:
+                self._data = json.load(f)
+        else:
+            self._data = {'pods': {}, 'services': {}, 'faults': {}}
+        return self._data
+
+    def __exit__(self, exc_type, *args) -> None:
+        if exc_type is None:
+            tmp = self._path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump(self._data, f)
+            os.replace(tmp, self._path)
+        self._lock.release()
+
+
+def fake_inject_unschedulable(selector_value: str, count: int = -1) -> None:
+    """Pods whose gke-tpu-accelerator selector equals `selector_value`
+    stay Pending/Unschedulable (capacity fault; -1 = always)."""
+    with _FakeStore() as data:
+        data['faults'].setdefault('unschedulable', {})[selector_value] = count
+
+
+def fake_reset() -> None:
+    path = _fake_store_path()
+    if os.path.exists(path):
+        os.remove(path)
+
+
+class FakeKubernetesApi(KubernetesApi):
+    """In-process apiserver: pods schedule instantly (or fault)."""
+
+    def create_pod(self, namespace, manifest):
+        with _FakeStore() as data:
+            name = manifest['metadata']['name']
+            key = f'{namespace}/{name}'
+            if key in data['pods']:
+                raise exceptions.ProvisionError(
+                    f'k8s API POST pods: HTTP 409: pod {name} exists')
+            accel = manifest['spec'].get('nodeSelector', {}).get(
+                'cloud.google.com/gke-tpu-accelerator', '')
+            faults = data['faults'].get('unschedulable', {})
+            unschedulable = False
+            if accel in faults and faults[accel] != 0:
+                if faults[accel] > 0:
+                    faults[accel] -= 1
+                unschedulable = True
+            pod = dict(manifest)
+            pod['status'] = (
+                {'phase': 'Pending',
+                 'conditions': [{'type': 'PodScheduled',
+                                 'status': 'False',
+                                 'reason': 'Unschedulable'}]}
+                if unschedulable else
+                {'phase': 'Running',
+                 'podIP': f'10.42.{len(data["pods"]) % 250}.'
+                          f'{uuid.uuid4().int % 250 + 2}'})
+            data['pods'][key] = pod
+
+    def create_service(self, namespace, manifest):
+        with _FakeStore() as data:
+            key = f'{namespace}/{manifest["metadata"]["name"]}'
+            data['services'][key] = manifest
+
+    def list_pods(self, namespace, label_selector):
+        want = dict(part.split('=', 1)
+                    for part in label_selector.split(',') if part)
+        with _FakeStore() as data:
+            out = []
+            for key, pod in data['pods'].items():
+                if not key.startswith(f'{namespace}/'):
+                    continue
+                labels = pod['metadata'].get('labels', {})
+                if all(labels.get(k) == v for k, v in want.items()):
+                    out.append(pod)
+            return out
+
+    def delete_pod(self, namespace, name):
+        with _FakeStore() as data:
+            data['pods'].pop(f'{namespace}/{name}', None)
+
+    def delete_service(self, namespace, name):
+        with _FakeStore() as data:
+            data['services'].pop(f'{namespace}/{name}', None)
+
+
+def fake_preempt_pod(namespace: str, name: str) -> None:
+    """Spot reclaim: the pod vanishes (GKE deletes preempted pods)."""
+    with _FakeStore() as data:
+        data['pods'].pop(f'{namespace}/{name}', None)
+
+
+# ---------------------------------------------------------------------------
+# Provider
+# ---------------------------------------------------------------------------
+
+
+@CLOUD_REGISTRY.register('kubernetes', aliases=['k8s'])
+class KubernetesProvider(Provider):
+    """Pods-as-hosts provider over a pluggable apiserver transport."""
+
+    name = 'kubernetes'
+
+    def __init__(self, api: Optional[KubernetesApi] = None,
+                 namespace: Optional[str] = None) -> None:
+        if api is not None:
+            self.api: KubernetesApi = api
+        elif os.environ.get('SKYT_K8S_FAKE'):
+            self.api = FakeKubernetesApi()
+        else:
+            self.api = RestKubernetesApi()
+        from skypilot_tpu import config
+        self.namespace = (namespace or
+                          config.get_nested(('kubernetes', 'namespace'),
+                                            'default'))
+
+    def _selector(self, cluster_name: str) -> str:
+        return f'{LABEL_CLUSTER}={cluster_name}'
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        res = request.resources
+        if res.is_tpu:
+            hosts_per_node = res.tpu.hosts_per_slice * res.tpu.num_slices
+        else:
+            hosts_per_node = 1
+        self.api.create_service(
+            self.namespace,
+            build_headless_service(request.cluster_name, self.namespace))
+        created = []
+        try:
+            for node in range(request.num_nodes):
+                for worker in range(hosts_per_node):
+                    manifest = build_pod_manifest(request, node, worker,
+                                                  self.namespace)
+                    self.api.create_pod(self.namespace, manifest)
+                    created.append(manifest['metadata']['name'])
+            return self._wait_pods_running(request)
+        except exceptions.ProvisionError:
+            # All-or-nothing gang semantics: roll back partial pods so
+            # failover retries cleanly elsewhere.
+            self._cleanup(request.cluster_name)
+            raise
+
+    def _wait_pods_running(self,
+                           request: ProvisionRequest) -> ClusterInfo:
+        timeout = _provision_timeout()
+        deadline = time.time() + timeout
+        selector = self._selector(request.cluster_name)
+        while True:
+            pods = self.api.list_pods(self.namespace, selector)
+            phases = [p.get('status', {}).get('phase') for p in pods]
+            if pods and all(ph == 'Running' for ph in phases):
+                return self._to_cluster_info(request.cluster_name, pods)
+            for pod in pods:
+                for cond in pod.get('status', {}).get('conditions', []):
+                    if cond.get('reason') == 'Unschedulable':
+                        if time.time() > deadline:
+                            self._cleanup(request.cluster_name)
+                            raise exceptions.CapacityError(
+                                f'{request.cluster_name}: TPU pods '
+                                'unschedulable (no node pool capacity '
+                                f'for {pod["spec"].get("nodeSelector")})')
+            if time.time() > deadline:
+                self._cleanup(request.cluster_name)
+                raise exceptions.ProvisionError(
+                    f'{request.cluster_name}: pods not Running after '
+                    f'{timeout:.0f}s (phases: {phases})')
+            time.sleep(min(2.0, timeout / 10))
+
+    def _to_cluster_info(self, cluster_name: str,
+                         pods: List[Dict[str, Any]]) -> ClusterInfo:
+        hosts = []
+        for pod in pods:
+            labels = pod['metadata']['labels']
+            hosts.append(HostInfo(
+                instance_id=pod['metadata']['name'],
+                internal_ip=pod.get('status', {}).get('podIP', ''),
+                external_ip=None,
+                node_index=int(labels.get(LABEL_NODE, 0)),
+                worker_index=int(labels.get(LABEL_WORKER, 0)),
+            ))
+        hosts.sort(key=lambda h: (h.node_index, h.worker_index))
+        return ClusterInfo(
+            cluster_name=cluster_name, provider='kubernetes',
+            region=self.namespace, zone=None, hosts=hosts,
+            ssh_user='root',
+            custom={'kubernetes': True, 'namespace': self.namespace,
+                    'fake': isinstance(self.api, FakeKubernetesApi)})
+
+    def _cleanup(self, cluster_name: str) -> None:
+        for pod in self.api.list_pods(self.namespace,
+                                      self._selector(cluster_name)):
+            self.api.delete_pod(self.namespace, pod['metadata']['name'])
+        self.api.delete_service(self.namespace, cluster_name)
+
+    def stop_instances(self, cluster_name: str) -> None:
+        raise exceptions.NotSupportedError(
+            'Kubernetes pods cannot be stopped; use down (terminate). '
+            '(Same stance as the reference: no k8s stop support.)')
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        self._cleanup(cluster_name)
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        phase_map = {'Running': 'running', 'Pending': 'pending',
+                     'Succeeded': 'terminated', 'Failed': 'terminated',
+                     'Unknown': 'unknown'}
+        out = {}
+        for pod in self.api.list_pods(self.namespace,
+                                      self._selector(cluster_name)):
+            phase = pod.get('status', {}).get('phase', 'Unknown')
+            out[pod['metadata']['name']] = phase_map.get(phase, 'unknown')
+        return out
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        pods = self.api.list_pods(self.namespace,
+                                  self._selector(cluster_name))
+        running = [p for p in pods
+                   if p.get('status', {}).get('phase') == 'Running']
+        if not running:
+            return None
+        return self._to_cluster_info(cluster_name, running)
+
+    def open_ports(self, cluster_name: str, ports: List[str]) -> None:
+        # Pod-network reachability is cluster-internal; LoadBalancer/
+        # Ingress wiring is the serve layer's concern.
+        pass

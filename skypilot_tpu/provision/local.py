@@ -45,7 +45,6 @@ class LocalProvider(Provider):
     """One 'host' per node, all localhost; commands run as subprocesses."""
 
     name = 'local'
-    run_commands_locally = True
 
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
         data = _load()

@@ -176,6 +176,9 @@ TASK_SCHEMA: Dict[str, Any] = {
         },
         'service': _SERVICE,
         'config': {'type': 'object'},
+        # Internal round-trip marker (admin policy already applied);
+        # present when a task exported by to_yaml is re-imported.
+        '_policy_applied': {'type': 'boolean'},
     },
 }
 

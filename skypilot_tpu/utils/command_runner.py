@@ -204,6 +204,100 @@ class SSHCommandRunner(CommandRunner):
                                           error_msg=proc.stderr[-500:])
 
 
+class KubectlCommandRunner(CommandRunner):
+    """Runs inside a pod via `kubectl exec`; files move with `kubectl cp`
+    (parity: the reference's KubernetesCommandRunner,
+    utils/command_runner.py:1410)."""
+
+    def __init__(self, host: HostInfo, namespace: str) -> None:
+        super().__init__(host)
+        self.namespace = namespace
+        self.pod = host.instance_id
+
+    def _kubectl(self) -> List[str]:
+        return ['kubectl', '-n', self.namespace]
+
+    def run(self, cmd, *, env=None, cwd=None, stream_to=None, log_path=None,
+            timeout=None, check=False):
+        remote = ''
+        for key, value in (env or {}).items():
+            remote += f'export {key}={shlex.quote(str(value))}; '
+        if cwd:
+            remote += f'cd {shlex.quote(cwd)}; '
+        remote += cmd
+        full = self._kubectl() + ['exec', self.pod, '--', '/bin/sh', '-c',
+                                  remote]
+        log_file = None
+        if log_path:
+            os.makedirs(os.path.dirname(os.path.expanduser(log_path)),
+                        exist_ok=True)
+            log_file = open(os.path.expanduser(log_path), 'a',
+                            encoding='utf-8')
+        lines: List[str] = []
+        try:
+            proc = subprocess.Popen(full, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                lines.append(line)
+                if stream_to is not None:
+                    stream_to.write(line)
+                    stream_to.flush()
+                if log_file is not None:
+                    log_file.write(line)
+                    log_file.flush()
+            returncode = proc.wait(timeout=timeout)
+        finally:
+            if log_file is not None:
+                log_file.close()
+        output = ''.join(lines)
+        self._check(returncode, cmd, output, check)
+        return returncode, output
+
+    def rsync(self, src: str, dst: str, *, up: bool = True, excludes=None):
+        # tar over `kubectl exec` rather than `kubectl cp`: honors
+        # excludes, and `~` in dst expands inside the pod's shell
+        # (kubectl cp would create a literal './~' directory).
+        src_arg = os.path.expanduser(src)
+        if up:
+            tar_cmd = ['tar', '-C',
+                       src_arg if os.path.isdir(src_arg)
+                       else os.path.dirname(src_arg) or '.', '-czf', '-']
+            for pattern in excludes or []:
+                tar_cmd.append(f'--exclude={pattern}')
+            tar_cmd.append('.' if os.path.isdir(src_arg)
+                           else os.path.basename(src_arg))
+            remote = (f'mkdir -p {dst} && tar -xzf - -C {dst}')
+            kubectl = self._kubectl() + ['exec', '-i', self.pod, '--',
+                                         '/bin/sh', '-c', remote]
+            tar = subprocess.Popen(tar_cmd, stdout=subprocess.PIPE)
+            proc = subprocess.run(kubectl, stdin=tar.stdout,
+                                  capture_output=True, text=True,
+                                  check=False)
+            tar.wait()
+            code = proc.returncode or tar.returncode
+            if code != 0:
+                raise exceptions.CommandError(
+                    code, ' '.join(kubectl),
+                    error_msg=(proc.stderr or '')[-500:])
+        else:
+            remote = f'tar -czf - -C {dst} .'
+            kubectl = self._kubectl() + ['exec', self.pod, '--',
+                                         '/bin/sh', '-c', remote]
+            os.makedirs(src_arg, exist_ok=True)
+            kproc = subprocess.Popen(kubectl, stdout=subprocess.PIPE)
+            untar = subprocess.run(['tar', '-xzf', '-', '-C', src_arg],
+                                   stdin=kproc.stdout,
+                                   capture_output=True, text=True,
+                                   check=False)
+            kproc.wait()
+            code = kproc.returncode or untar.returncode
+            if code != 0:
+                raise exceptions.CommandError(
+                    code, ' '.join(kubectl),
+                    error_msg=(untar.stderr or '')[-500:])
+
+
 def runners_for_cluster(info: ClusterInfo) -> List[CommandRunner]:
     """One runner per host, ordered by (node_index, worker_index)."""
     local_style = info.custom.get('fake') or info.custom.get('local')
@@ -215,6 +309,9 @@ def runners_for_cluster(info: ClusterInfo) -> List[CommandRunner]:
             root = os.path.join(state_dir, 'hosts', info.cluster_name,
                                 f'{host.node_index}-{host.worker_index}')
             runners.append(LocalCommandRunner(host, root))
+        elif info.custom.get('kubernetes'):
+            runners.append(KubectlCommandRunner(
+                host, info.custom.get('namespace', 'default')))
         else:
             runners.append(SSHCommandRunner(host, info.ssh_user,
                                             info.ssh_key_path))
