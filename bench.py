@@ -40,8 +40,12 @@ def main() -> int:
     parser.add_argument('--model', default=None)
     parser.add_argument('--batch', type=int, default=None)
     parser.add_argument('--seq', type=int, default=None)
-    parser.add_argument('--steps', type=int, default=10)
-    parser.add_argument('--warmup', type=int, default=3)
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--warmup', type=int, default=5)
+    parser.add_argument('--optimizer', default=None,
+                        choices=[None, 'adamw', 'adafactor'])
+    parser.add_argument('--param-dtype', default=None,
+                        choices=[None, 'float32', 'bfloat16'])
     args = parser.parse_args()
 
     from skypilot_tpu.models.config import get_model_config
@@ -51,14 +55,25 @@ def main() -> int:
 
     on_accel = jax.default_backend() not in ('cpu',)
     n_dev = len(jax.devices())
-    model = args.model or ('bench-700m' if on_accel else 'tiny')
-    cfg = get_model_config(model)
+    # Flagship-class single-chip default: ~1.7B llama-style with
+    # Adafactor + bf16 params + full remat (the largest class that fits
+    # one 16GB v5e chip; the 8B flagship is the multi-chip config).
+    model = args.model or ('bench-1b7' if on_accel else 'tiny')
+    overrides = {}
+    param_dtype = args.param_dtype or (
+        'bfloat16' if model == 'bench-1b7' else None)
+    if param_dtype:
+        overrides['param_dtype'] = jnp.dtype(param_dtype)
+    cfg = get_model_config(model, **overrides)
+    optimizer = args.optimizer or (
+        'adafactor' if model == 'bench-1b7' else 'adamw')
     batch = args.batch or (8 if on_accel else 4)
     seq = args.seq or (2048 if on_accel else 64)
     seq = min(seq, cfg.max_seq_len)
 
     mesh = build_mesh(MeshConfig(fsdp=n_dev))
-    hp = TrainHParams(warmup_steps=10, total_steps=1000)
+    hp = TrainHParams(warmup_steps=10, total_steps=1000,
+                      optimizer=optimizer)
     shardings = state_shardings(mesh, cfg, hp)
     state = create_train_state(jax.random.key(0), cfg, hp, mesh,
                                shardings=shardings)

@@ -1,0 +1,333 @@
+"""Runtime self-distribution + on-cluster daemon bring-up.
+
+Parity with three reference pieces:
+
+* ``sky/backends/wheel_utils.py:1-40`` -- build the framework package
+  locally (content-hashed tarball, cached) so remote runtime == local
+  version;
+* ``sky/provision/instance_setup.py:301 setup_runtime_on_cluster`` --
+  parallel per-host ship + install;
+* ``sky/provision/instance_setup.py:598 start_skylet_on_head_node`` --
+  start the runtime daemon on the head.
+
+Local-style clusters (fake/local providers) skip shipping -- every "host"
+is a directory on this machine and the daemon runs backend-side -- but go
+through the SAME cluster.json spec, so one daemon implementation serves
+both paths (runtime/daemon.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+import tarfile
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import ClusterInfo
+from skypilot_tpu.runtime import cluster_spec as spec_lib
+from skypilot_tpu.runtime import daemon as daemon_lib
+from skypilot_tpu.runtime.job_client import (REMOTE_PKG_DIR,
+                                             REMOTE_RUNTIME_DIR)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.command_runner import (CommandRunner,
+                                               runners_for_cluster)
+from skypilot_tpu.utils.subprocess_utils import run_in_parallel
+
+logger = log.init_logger(__name__)
+
+
+def is_local_style(info: ClusterInfo) -> bool:
+    """True when the cluster's "hosts" are directories on this machine."""
+    return bool(info.custom.get('fake') or info.custom.get('local'))
+
+
+def head_runtime_dir(info: ClusterInfo) -> str:
+    """The head host's runtime dir, resolved for local-style clusters."""
+    if is_local_style(info):
+        head = runners_for_cluster(info)[0]
+        return head._resolve(REMOTE_RUNTIME_DIR)  # pylint: disable=protected-access
+    return REMOTE_RUNTIME_DIR
+
+
+# ---------------------------------------------------------------------------
+# Packaging (parity: wheel_utils.build_sky_wheel)
+# ---------------------------------------------------------------------------
+
+def _package_root() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+
+def _iter_package_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != '__pycache__']
+        for name in sorted(filenames):
+            if name.endswith(('.pyc', '.pyo')):
+                continue
+            out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def package_runtime() -> tuple:
+    """Build (or reuse) the content-addressed runtime tarball.
+
+    Returns (tarball_path, content_hash). Extracting the tarball yields
+    ``skypilot_tpu/...`` so PYTHONPATH=<extract dir> makes it importable.
+    """
+    root = _package_root()
+    files = _iter_package_files(root)
+    hasher = hashlib.sha256()
+    for path in files:
+        hasher.update(os.path.relpath(path, root).encode('utf-8'))
+        with open(path, 'rb') as f:
+            hasher.update(f.read())
+    content_hash = hasher.hexdigest()[:16]
+
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    cache_dir = os.path.join(state_dir, 'runtime_pkg')
+    os.makedirs(cache_dir, exist_ok=True)
+    tarball = os.path.join(cache_dir, f'skypilot_tpu-{content_hash}.tar.gz')
+    if not os.path.exists(tarball):
+        tmp = tarball + '.tmp'
+        with tarfile.open(tmp, 'w:gz') as tar:
+            for path in files:
+                arcname = os.path.join('skypilot_tpu',
+                                       os.path.relpath(path, root))
+                tar.add(path, arcname=arcname)
+        os.replace(tmp, tarball)
+        logger.info('Packaged runtime %s (%d files)', content_hash,
+                    len(files))
+    return tarball, content_hash
+
+
+# ---------------------------------------------------------------------------
+# Cluster-internal SSH key (head -> worker fan-out)
+# ---------------------------------------------------------------------------
+
+REMOTE_CLUSTER_KEY = f'{REMOTE_RUNTIME_DIR}/cluster_key'
+
+
+def _ensure_cluster_key(cluster_name: str,
+                        fallback_key: Optional[str]
+                        ) -> Tuple[Optional[str], Optional[str]]:
+    """A dedicated keypair for intra-cluster SSH (head daemon -> ranks).
+
+    Returns (private_key_path, public_key_text) on the CLIENT. Generated
+    once per cluster with ssh-keygen; when ssh-keygen is unavailable the
+    provisioning key is reused (parity: the reference generates a cluster
+    key in backend_utils and distributes it via cloud metadata /
+    authorized_keys).
+    """
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    key_dir = os.path.join(state_dir, 'keys', cluster_name)
+    key_path = os.path.join(key_dir, 'cluster_key')
+    pub_path = key_path + '.pub'
+    if not os.path.exists(key_path):
+        os.makedirs(key_dir, exist_ok=True)
+        if shutil.which('ssh-keygen'):
+            subprocess.run(
+                ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+                 '-C', f'skyt-{cluster_name}', '-f', key_path],
+                check=True)
+        elif fallback_key and os.path.exists(
+                os.path.expanduser(fallback_key)):
+            shutil.copy2(os.path.expanduser(fallback_key), key_path)
+            os.chmod(key_path, 0o600)
+            fallback_pub = os.path.expanduser(fallback_key) + '.pub'
+            if os.path.exists(fallback_pub):
+                shutil.copy2(fallback_pub, pub_path)
+        else:
+            return None, None
+    pub_text = None
+    if os.path.exists(pub_path):
+        with open(pub_path, encoding='utf-8') as f:
+            pub_text = f.read().strip()
+    return key_path, pub_text
+
+
+def _install_cluster_key(runners: List[CommandRunner], key_path: str,
+                         pub_text: Optional[str]) -> None:
+    """Private key to the head; pubkey into every host's authorized_keys."""
+    head = runners[0]
+    head.run(f'mkdir -p {REMOTE_RUNTIME_DIR}', check=True)
+    head.rsync(key_path, f'{REMOTE_RUNTIME_DIR}/', up=True)
+    head.run(f'chmod 600 {REMOTE_CLUSTER_KEY}', check=True)
+    if not pub_text:
+        return
+
+    def authorize(runner: CommandRunner) -> None:
+        quoted = pub_text.replace("'", "'\\''")
+        runner.run(
+            f'mkdir -p ~/.ssh && chmod 700 ~/.ssh && '
+            f"grep -qF '{quoted}' ~/.ssh/authorized_keys 2>/dev/null || "
+            f"echo '{quoted}' >> ~/.ssh/authorized_keys && "
+            f'chmod 600 ~/.ssh/authorized_keys', check=True)
+
+    run_in_parallel(authorize, runners)
+
+
+# ---------------------------------------------------------------------------
+# Cluster spec construction
+# ---------------------------------------------------------------------------
+
+def build_cluster_spec(info: ClusterInfo,
+                       autostop: Optional[dict] = None,
+                       ssh_key: Optional[str] = None
+                       ) -> spec_lib.ClusterSpec:
+    hosts: List[spec_lib.HostSpec] = []
+    if is_local_style(info):
+        runners = runners_for_cluster(info)
+        for rank, (runner, host) in enumerate(zip(runners, info.hosts)):
+            hosts.append(spec_lib.HostSpec(
+                rank=rank, kind='local',
+                root=getattr(runner, 'host_root', '~'),
+                node_index=host.node_index,
+                worker_index=host.worker_index))
+    else:
+        for rank, host in enumerate(info.hosts):
+            if rank == 0:
+                # The daemon runs ON the head node itself.
+                hosts.append(spec_lib.HostSpec(
+                    rank=0, kind='local', root='~',
+                    node_index=host.node_index,
+                    worker_index=host.worker_index))
+            else:
+                hosts.append(spec_lib.HostSpec(
+                    rank=rank, kind='ssh',
+                    address=host.internal_ip,
+                    ssh_port=host.ssh_port,
+                    node_index=host.node_index,
+                    worker_index=host.worker_index))
+    return spec_lib.ClusterSpec(
+        cluster_name=info.cluster_name,
+        cloud=info.provider,
+        hosts=hosts,
+        ssh_user=info.ssh_user,
+        ssh_key=ssh_key,
+        autostop=autostop or {})
+
+
+# ---------------------------------------------------------------------------
+# Bring-up
+# ---------------------------------------------------------------------------
+
+def _ship_runtime_to_host(runner: CommandRunner, tarball: str,
+                          content_hash: str) -> None:
+    code, out = runner.run(
+        f'cat {REMOTE_RUNTIME_DIR}/runtime_hash 2>/dev/null || true')
+    if code == 0 and out.strip() == content_hash:
+        return  # up to date
+    # Ship into a DIRECTORY, not a file path: rsync-over-ssh and the
+    # kubectl tar-pipe transport both place the file inside a target dir
+    # under its basename, so this is the one dst shape that behaves the
+    # same on every runner.
+    pkg_dir = f'{REMOTE_RUNTIME_DIR}/pkg'
+    remote_tar = f'{pkg_dir}/{os.path.basename(tarball)}'
+    runner.run(f'mkdir -p {pkg_dir}', check=True)
+    runner.rsync(tarball, pkg_dir + '/', up=True)
+    code, out = runner.run(
+        f'mkdir -p {REMOTE_PKG_DIR} && '
+        f'tar -xzf {remote_tar} -C {REMOTE_PKG_DIR} && '
+        f'rm -rf {pkg_dir} && '
+        f'echo {content_hash} > {REMOTE_RUNTIME_DIR}/runtime_hash && '
+        f'PYTHONPATH={REMOTE_PKG_DIR} python3 -c "import skypilot_tpu" && '
+        f'echo SKYT_RUNTIME_OK')
+    if code != 0 or 'SKYT_RUNTIME_OK' not in out:
+        raise exceptions.CommandError(
+            code or 1, 'runtime install', error_msg=out[-2000:])
+
+
+def _start_remote_daemon(head_runner: CommandRunner) -> None:
+    probe = (f'PYTHONPATH={REMOTE_PKG_DIR}:$PYTHONPATH python3 -m '
+             f'skypilot_tpu.runtime.job_cli --runtime-dir '
+             f'{REMOTE_RUNTIME_DIR} daemon-status')
+    code, out = head_runner.run(probe)
+    if code == 0 and '"alive": true' in out:
+        return
+    # NOTE: assignment-prefix (not `env VAR=~/..`) so the shell
+    # tilde-expands REMOTE_PKG_DIR; nohup inherits the environment.
+    start = (f'PYTHONPATH={REMOTE_PKG_DIR}:$PYTHONPATH '
+             f'nohup python3 -um skypilot_tpu.runtime.daemon '
+             f'--runtime-dir {REMOTE_RUNTIME_DIR} '
+             f'>> {REMOTE_RUNTIME_DIR}/daemon.log 2>&1 < /dev/null & '
+             f'echo SKYT_DAEMON_STARTED $!')
+    code, out = head_runner.run(start)
+    if code != 0 or 'SKYT_DAEMON_STARTED' not in out:
+        raise exceptions.CommandError(code or 1, 'daemon start',
+                                      error_msg=out[-2000:])
+
+
+def stop_remote_daemon(head_runner: CommandRunner) -> None:
+    """Best-effort daemon kill on the head node (teardown path)."""
+    cmd = (f'pid=$(cat {REMOTE_RUNTIME_DIR}/daemon.pid 2>/dev/null); '
+           f'if [ -n "$pid" ]; then kill $pid 2>/dev/null; fi; true')
+    try:
+        head_runner.run(cmd, timeout=60)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('Remote daemon stop failed: %s', e)
+
+
+def ensure_runtime(info: ClusterInfo,
+                   autostop: Optional[dict] = None) -> None:
+    """Ship the runtime, write the cluster spec, start the daemon.
+
+    Idempotent: re-running on an up cluster re-ships only when the
+    package content changed and never double-starts the daemon.
+    """
+    if is_local_style(info):
+        spec = build_cluster_spec(info, autostop=autostop)
+        runtime_dir = head_runtime_dir(info)
+        os.makedirs(runtime_dir, exist_ok=True)
+        spec_lib.write_spec(runtime_dir, spec)
+        daemon_lib.start_daemon(info.cluster_name, runtime_dir)
+        return
+
+    runners = runners_for_cluster(info)
+    tarball, content_hash = package_runtime()
+
+    def setup_host(runner: CommandRunner) -> None:
+        _ship_runtime_to_host(runner, tarball, content_hash)
+
+    # Parallel ship to every host (parity: instance_setup.py:301).
+    run_in_parallel(setup_host, runners)
+
+    head = runners[0]
+    # Multi-host: the head daemon fans ranks out over SSH, so it needs a
+    # key that works cluster-internally -- generate + install one.
+    remote_key: Optional[str] = None
+    if len(info.hosts) > 1:
+        key_path, pub_text = _ensure_cluster_key(info.cluster_name,
+                                                 info.ssh_key_path)
+        if key_path:
+            _install_cluster_key(runners, key_path, pub_text)
+            remote_key = REMOTE_CLUSTER_KEY
+        else:
+            logger.warning(
+                'No cluster-internal SSH key available (ssh-keygen '
+                'missing and no provisioning key); multi-host gang '
+                'start from the head daemon may fail auth.')
+    spec = build_cluster_spec(info, autostop=autostop, ssh_key=remote_key)
+    spec_json = spec.to_json()
+    import base64
+    b64 = base64.b64encode(spec_json.encode('utf-8')).decode('ascii')
+    head.run(
+        f'mkdir -p {REMOTE_RUNTIME_DIR} && echo {b64} | base64 -d > '
+        f'{REMOTE_RUNTIME_DIR}/{spec_lib.CLUSTER_SPEC_FILENAME}',
+        check=True)
+    _start_remote_daemon(head)
+
+
+def local_daemon_teardown(info: ClusterInfo) -> None:
+    """Stop whichever daemon flavor this cluster has."""
+    if is_local_style(info):
+        daemon_lib.stop_daemon(info.cluster_name)
+        return
+    try:
+        stop_remote_daemon(runners_for_cluster(info)[0])
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('Daemon teardown failed: %s', e)

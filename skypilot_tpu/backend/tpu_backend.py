@@ -7,18 +7,18 @@ groups, no vendored Ray patches (SURVEY.md section 7 design stance).
 """
 from __future__ import annotations
 
-import os
 import sys
 import threading
 from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions, state
-from skypilot_tpu.backend import codegen
+from skypilot_tpu.backend import codegen, runtime_setup
 from skypilot_tpu.backend.backend import Backend
 from skypilot_tpu.optimizer import Candidate, Optimizer
 from skypilot_tpu.provision.api import ClusterInfo, get_provider
 from skypilot_tpu.provision.provisioner import provision_with_failover
 from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.runtime.job_client import job_table_for
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import locks, log
 from skypilot_tpu.utils.command_runner import (CommandRunner,
@@ -91,18 +91,19 @@ class TpuPodBackend(Backend):
             autostop=(autostop.to_yaml_config()
                       if autostop.enabled else {}),
             hourly_cost=chosen.hourly_cost)
-        self._start_runtime_daemon(info)
+        self._start_runtime_daemon(
+            info, autostop=(autostop.to_yaml_config()
+                            if autostop.enabled else {}))
         return info
 
-    def _start_runtime_daemon(self, info: ClusterInfo) -> None:
-        """Start the skylet-equivalent for this cluster (parity:
-        start_skylet_on_head_node, instance_setup.py:598)."""
-        if info.custom.get('fake') or info.custom.get('local'):
-            from skypilot_tpu.runtime import daemon
-            daemon.start_daemon(info.cluster_name)
-        # SSH clusters: daemon start is part of remote runtime setup
-        # (wheel shipping + `python -m skypilot_tpu.runtime.daemon` over
-        # SSH) -- wired with the real GCP path.
+    def _start_runtime_daemon(self, info: ClusterInfo,
+                              autostop=None) -> None:
+        """Ship the runtime + start the skylet-equivalent daemon (parity:
+        wheel_utils + instance_setup.setup_runtime_on_cluster :301 +
+        start_skylet_on_head_node :598). One path for every cluster
+        flavor -- local-style daemons run backend-side, SSH clusters get
+        the package shipped and the daemon started on the head node."""
+        runtime_setup.ensure_runtime(info, autostop=autostop)
 
     # ------------------------------------------------------------------
     # Sync
@@ -194,48 +195,37 @@ class TpuPodBackend(Backend):
         detach=False: gang-run in the foreground, streaming rank 0.
         """
         runners = runners_for_cluster(info)
-        head_runtime = self._head_runtime_dir(info)
-        local_style = bool(info.custom.get('fake') or
-                           info.custom.get('local'))
-        if detach and not local_style:
-            # No runtime daemon wired for this cluster type yet (SSH
-            # daemon start lands with the real GCP path): a PENDING job
-            # would sit forever. Run in the foreground instead.
-            logger.warning('Detached execution requires the cluster '
-                           'runtime daemon; running in the foreground.')
-            detach = False
         resources = _task_resources(task)
         node_ips = codegen.node_ip_list(info)
+        job_table = job_table_for(info)
 
         if detach:
-            # Write all rank scripts BEFORE the job becomes PENDING: the
-            # daemon polls every second and must never observe a partial
-            # script set (it would gang-start a partial pod).
-            job_id = job_lib.add_job(head_runtime, task.name,
-                                     num_hosts=len(info.hosts),
-                                     status=job_lib.JobStatus.SETTING_UP)
-            log_dir = job_lib.job_log_dir(head_runtime, job_id)
-            os.makedirs(log_dir, exist_ok=True)
+            # The submission protocol writes all rank scripts BEFORE the
+            # job becomes PENDING: the daemon polls every second and must
+            # never observe a partial script set (it would gang-start a
+            # partial pod). DirectJobTable does this in-process;
+            # RemoteJobTable does it atomically on-head via the job_cli
+            # shim (one SSH round trip).
+            scripts: Dict[int, str] = {}
             for idx, host in enumerate(info.hosts):
                 command = task.get_run_command(host.node_index, node_ips)
                 if command is None:
                     continue
                 env = codegen.task_env_for_host(task, info, host, resources)
-                script = codegen.make_job_script(
+                scripts[idx] = codegen.make_job_script(
                     command, env,
                     workdir=_WORKDIR_REMOTE if task.workdir else None,
                     secrets=task.secrets)
-                with open(os.path.join(log_dir, f'rank_{idx}.sh'), 'w',
-                          encoding='utf-8') as f:
-                    f.write(script)
-            job_lib.set_status(head_runtime, job_id,
-                               job_lib.JobStatus.PENDING)
+            job_id = job_table.submit(task.name, len(info.hosts), scripts)
             state.touch_cluster(info.cluster_name)
             return job_id
 
-        job_id = job_lib.add_job(head_runtime, task.name,
-                                 num_hosts=len(info.hosts))
-        job_lib.set_status(head_runtime, job_id, job_lib.JobStatus.RUNNING)
+        # Foreground gang-run: ranks are driven from this process through
+        # the runners; the job row is still recorded in the CLUSTER's job
+        # table (RUNNING from the start, so the daemon never gang-starts
+        # it a second time).
+        job_id = job_table.add_job(task.name, len(info.hosts),
+                                   job_lib.JobStatus.RUNNING)
         exit_codes: Dict[int, int] = {}
         lock = threading.Lock()
 
@@ -250,11 +240,21 @@ class TpuPodBackend(Backend):
                 command, env,
                 workdir=_WORKDIR_REMOTE if task.workdir else None,
                 secrets=task.secrets)
-            stream = sys.stdout if (idx == 0 and not detach) else None
-            code, _ = runner.run(
-                script,
-                stream_to=stream,
-                log_path=f'~/.skyt_runtime/jobs/{job_id}/rank_{idx}.log')
+            # Logs are recorded on the HOST side (tee), so `tail_logs`
+            # reads the same path whether a job ran foreground or via the
+            # daemon -- on SSH clusters the client-side log file of the
+            # old scheme was unreachable from `skyt logs`. POSIX-only
+            # constructs: kubectl runners execute via /bin/sh, where
+            # bash's PIPESTATUS does not exist.
+            job_dir = f'~/.skyt_runtime/jobs/{job_id}'
+            rank_log = f'{job_dir}/rank_{idx}.log'
+            rc_file = f'{job_dir}/rank_{idx}.rc'
+            wrapped = (f'mkdir -p {job_dir}\n'
+                       f'{{\n(\n{script}\n)\necho $? > {rc_file}\n}} 2>&1 '
+                       f'| tee -a {rank_log}\n'
+                       f'exit $(cat {rc_file})')
+            stream = sys.stdout if idx == 0 else None
+            code, _ = runner.run(wrapped, stream_to=stream)
             with lock:
                 exit_codes[idx] = code
 
@@ -268,7 +268,7 @@ class TpuPodBackend(Backend):
         worst = max(exit_codes.values()) if exit_codes else 1
         final = (job_lib.JobStatus.SUCCEEDED if worst == 0
                  else job_lib.JobStatus.FAILED)
-        job_lib.set_status(head_runtime, job_id, final, exit_code=worst)
+        job_table.set_status(job_id, final, exit_code=worst)
         state.touch_cluster(info.cluster_name)
         return job_id
 
@@ -278,54 +278,35 @@ class TpuPodBackend(Backend):
 
     def _head_runtime_dir(self, info: ClusterInfo) -> str:
         """Runtime dir of the head host, resolved for local-style clusters."""
-        runners = runners_for_cluster(info)
-        head = runners[0]
-        if hasattr(head, '_resolve'):
-            return head._resolve('~/.skyt_runtime')  # pylint: disable=protected-access
-        return job_lib.DEFAULT_RUNTIME_DIR
+        return runtime_setup.head_runtime_dir(info)
 
     def queue(self, info: ClusterInfo) -> List[Dict]:
-        return job_lib.list_jobs(self._head_runtime_dir(info))
+        return job_table_for(info).list_jobs()
 
     def cancel(self, info: ClusterInfo, job_id: int) -> bool:
-        return job_lib.cancel_job(self._head_runtime_dir(info), job_id)
+        return job_table_for(info).cancel(job_id)
 
     def tail_logs(self, info: ClusterInfo, job_id: Optional[int] = None,
                   stream=None, follow: bool = False) -> str:
         """Return (and optionally follow) the rank-0 log of a job."""
-        from skypilot_tpu.runtime import log_lib
         stream = stream or sys.stdout
-        runtime = self._head_runtime_dir(info)
+        job_table = job_table_for(info)
         if job_id is None:
-            jobs = job_lib.list_jobs(runtime)
+            jobs = job_table.list_jobs()
             if not jobs:
                 raise exceptions.JobNotFoundError('No jobs on cluster')
             job_id = jobs[0]['job_id']
-        if job_lib.get_job(runtime, job_id) is None:
-            raise exceptions.JobNotFoundError(f'No job {job_id} on cluster')
-        log_path = os.path.join(job_lib.job_log_dir(runtime, job_id),
-                                'rank_0.log')
-
-        def job_done() -> bool:
-            job = job_lib.get_job(runtime, job_id)
-            return job is None or job_lib.JobStatus(
-                job['status']).is_terminal()
-
-        if not follow and not os.path.exists(log_path):
-            raise exceptions.JobNotFoundError(
-                f'No logs for job {job_id} at {log_path}')
-        lines = log_lib.tail_file(log_path, follow=follow,
-                                  stop_when=job_done)
-        return log_lib.stream_to(lines, stream)
+        return job_table.tail(job_id, follow=follow, stream=stream)
 
     def teardown(self, cluster_name: str, *, terminate: bool = True) -> None:
-        from skypilot_tpu.runtime import daemon
-        daemon.stop_daemon(cluster_name)
         with locks.cluster_lock(cluster_name):
             record = state.get_cluster(cluster_name)
             if record is None:
                 raise exceptions.ClusterDoesNotExist(
                     f'Cluster {cluster_name!r} not found.')
+            if record.handle:
+                runtime_setup.local_daemon_teardown(
+                    ClusterInfo.from_dict(record.handle))
             provider = get_provider(record.cloud or 'fake')
             if terminate:
                 provider.terminate_instances(cluster_name)

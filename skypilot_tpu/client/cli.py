@@ -373,6 +373,25 @@ def api_cancel(request_id: str) -> None:
     click.echo('Cancelled.' if ok else 'Not cancellable.')
 
 
+@cli.group()
+def recipes() -> None:
+    """Curated launchable recipes (`skyt launch recipe://NAME`)."""
+
+
+@recipes.command('list')
+def recipes_list() -> None:
+    from skypilot_tpu import recipes as recipes_lib
+    _echo_table(recipes_lib.list_recipes(), ['name', 'description'])
+
+
+@recipes.command('show')
+@click.argument('name')
+def recipes_show(name: str) -> None:
+    from skypilot_tpu import recipes as recipes_lib
+    with open(recipes_lib.resolve(name), encoding='utf-8') as f:
+        click.echo(f.read())
+
+
 def main() -> None:
     try:
         cli()

@@ -87,7 +87,8 @@ def start(cluster_name: str) -> None:
     state.add_or_update_cluster(cluster_name,
                                 status=state.ClusterStatus.UP,
                                 handle=info.to_dict())
-    TpuPodBackend()._start_runtime_daemon(info)  # pylint: disable=protected-access
+    TpuPodBackend()._start_runtime_daemon(  # pylint: disable=protected-access
+        info, autostop=record.autostop)
 
 
 def _cluster_info(cluster_name: str) -> ClusterInfo:
@@ -114,14 +115,32 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
 
 def autostop(cluster_name: str, idle_minutes: float,
              down_on_idle: bool = False) -> None:
-    """Set/refresh the autostop policy (enforced by the runtime daemon)."""
-    _get_record(cluster_name)
+    """Set/refresh the autostop policy (enforced by the runtime daemon).
+
+    Written both to the client state DB (status display) and through to
+    the cluster's runtime spec, which is what the head-node daemon
+    actually enforces (parity: skylet autostop_lib.set_autostop :181 --
+    the reference also pushes the policy to the cluster)."""
+    record = _get_record(cluster_name)
     config = ({'idle_minutes': idle_minutes, 'down': down_on_idle}
               if idle_minutes >= 0 else {})
-    state.add_or_update_cluster(cluster_name,
-                                status=_get_record(cluster_name).status,
+    state.add_or_update_cluster(cluster_name, status=record.status,
                                 autostop=config, touch=False)
     state.add_cluster_event(cluster_name, 'AUTOSTOP_SET', str(config))
+    if record.status == state.ClusterStatus.UP and record.handle:
+        from skypilot_tpu.runtime.job_client import job_table_for
+        try:
+            job_table_for(
+                ClusterInfo.from_dict(record.handle)).set_autostop(config)
+        except (FileNotFoundError, exceptions.CommandError) as e:
+            # Policy is recorded client-side; the daemon spec will pick
+            # it up on the next cluster (re)start, but tell the user the
+            # live cluster is not enforcing it yet.
+            raise exceptions.CommandError(
+                1, 'autostop push',
+                error_msg=f'Could not push the autostop policy to the '
+                          f'cluster runtime ({e}); it will apply after '
+                          f'the cluster restarts.') from e
 
 
 def cost_report() -> List[Dict[str, Any]]:

@@ -1,0 +1,8 @@
+"""In-tree TPU serving payload: tokenizer, batched KV-cache engine, HTTP
+server (the reference serves LLMs through external engines -- vLLM /
+JetStream YAMLs under ``llm/`` and ``examples/tpu/v6e``; SURVEY.md §7
+makes the TPU-native equivalent an in-tree deliverable)."""
+from skypilot_tpu.inference.engine import InferenceEngine
+from skypilot_tpu.inference.tokenizer import ByteTokenizer
+
+__all__ = ['InferenceEngine', 'ByteTokenizer']

@@ -1,0 +1,108 @@
+"""Inference HTTP server: the in-tree serving payload.
+
+Runs behind the serve stack (``serve/``): the replica manager launches
+this per replica, the readiness probe hits /health, the load balancer
+proxies /generate. stdlib HTTP (threaded) -- the data plane is the TPU
+decode scan, not the web layer.
+
+    python -m skypilot_tpu.inference.server --model tiny --port 8080
+
+Endpoints:
+    GET  /health            -> 200 {"status": "ok", "model": ...}
+    GET  /stats             -> decode throughput counters
+    POST /generate          -> {"prompts": [...], "max_new_tokens": N,
+                                "temperature": t} -> {"outputs": [...]}
+
+Parity: the JetStream/vLLM serving payloads of the reference
+(``examples/tpu/v6e/benchmark-llama2-7b.yaml``, ``llm/vllm``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from skypilot_tpu.inference.engine import InferenceEngine
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+def make_handler(engine: InferenceEngine):
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, fmt, *args):  # quiet
+            logger.debug(fmt, *args)
+
+        def _json(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode('utf-8')
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == '/health':
+                self._json(200, {'status': 'ok',
+                                 'model': engine.cfg.name})
+            elif self.path == '/stats':
+                self._json(200, engine.stats)
+            else:
+                self._json(404, {'error': 'not found'})
+
+        def do_POST(self):
+            if self.path != '/generate':
+                self._json(404, {'error': 'not found'})
+                return
+            try:
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length) or b'{}')
+                prompts = req.get('prompts') or [req.get('prompt', '')]
+                outputs = engine.generate_text(
+                    prompts,
+                    max_new_tokens=int(req.get('max_new_tokens', 32)),
+                    temperature=float(req.get('temperature', 0.0)),
+                    seed=int(req.get('seed', 0)))
+                self._json(200, {'outputs': outputs})
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error('generate failed: %s', e, exc_info=True)
+                self._json(500, {'error': str(e)})
+
+    return Handler
+
+
+def serve(engine: InferenceEngine, host: str, port: int):
+    server = ThreadingHTTPServer((host, port), make_handler(engine))
+    logger.info('Inference server for %s on %s:%d', engine.cfg.name, host,
+                port)
+    return server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--port', type=int, default=8080)
+    parser.add_argument('--max-batch', type=int, default=8)
+    args = parser.parse_args(argv)
+    engine = InferenceEngine(args.model,
+                             checkpoint_dir=args.checkpoint_dir,
+                             max_batch=args.max_batch)
+    # Warm the compile cache so the first real request (and the serve
+    # stack's readiness window) isn't paying XLA compile time.
+    engine.generate_text(['warmup'], max_new_tokens=8)
+    server = serve(engine, args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Byte-level tokenizer: ids 0..2 reserved (pad/bos/eos), byte b -> b+3.
+
+Self-contained (no external vocab files), reversible for any UTF-8 text,
+and small enough that the tiny test models (vocab 512) cover the full id
+range. Real deployments can swap in a sentencepiece/HF tokenizer behind
+the same encode/decode interface; the engine only needs ids.
+"""
+from __future__ import annotations
+
+from typing import List
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+_OFFSET = 3
+
+
+class ByteTokenizer:
+    vocab_size = 256 + _OFFSET
+    pad_id = PAD_ID
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+
+    def encode(self, text: str, *, add_bos: bool = True) -> List[int]:
+        ids = [b + _OFFSET for b in text.encode('utf-8')]
+        return [BOS_ID] + ids if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i - _OFFSET for i in ids
+                     if i >= _OFFSET and i - _OFFSET < 256)
+        return data.decode('utf-8', errors='replace')

@@ -80,7 +80,10 @@ def tail_logs(job_id: int, controller: bool = False) -> str:
         return ''
     from skypilot_tpu import core as sky_core
     try:
-        return sky_core.tail_logs(record.cluster_name)
+        # Streams to stdout itself; return '' so callers that print the
+        # return value don't emit every line twice.
+        sky_core.tail_logs(record.cluster_name)
+        return ''
     except exceptions.SkytError:
         return (f'(cluster {record.cluster_name} is gone; '
                 f'job status: {record.status.value})\n')

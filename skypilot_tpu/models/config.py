@@ -127,6 +127,13 @@ BENCH_700M = _register(ModelConfig(
     name='bench-700m', vocab_size=32_000, d_model=2048, n_layers=12,
     n_heads=16, n_kv_heads=8, d_ff=5504, max_seq_len=2048))
 
+# ~1.7B Llama-style: the largest class that trains on one 16GB v5e chip
+# (fp32 params + Adafactor factored state + full remat). The single-chip
+# flagship bench workload; llama3-8b is the multi-chip flagship.
+BENCH_1B7 = _register(ModelConfig(
+    name='bench-1b7', vocab_size=32_000, d_model=2560, n_layers=22,
+    n_heads=20, n_kv_heads=4, d_ff=6912, max_seq_len=2048))
+
 
 def get_model_config(name: str, **overrides) -> ModelConfig:
     cfg: ModelConfig = MODEL_REGISTRY.get(name)

@@ -1,0 +1,249 @@
+"""KV-cache autoregressive decoding for the Llama family.
+
+The serving-side counterpart of ``models/llama.forward`` (the reference
+serves its models through external engines -- vLLM/JetStream YAMLs, e.g.
+``examples/tpu/v6e/benchmark-llama2-7b.yaml``; here decode is in-tree and
+TPU-first):
+
+* the KV cache is a pair of stacked-layer arrays
+  ``[L, B, max_len, kv_heads, head_dim]`` scanned with the same one
+  compiled layer body as training (no per-layer Python loop);
+* prefill processes the whole (right-padded) prompt batch in one causal
+  pass and writes the cache; decode steps are single-token updates with
+  per-sequence length masking, so shapes stay static under jit;
+* cache insertion is a one-hot scatter over positions (no
+  data-dependent dynamic slices -> XLA keeps everything fused);
+* sampling: greedy or temperature, jit-compatible.
+
+Right-padding is safe under causal masking: real tokens never attend to
+later pads, and decode masks cache positions >= the sequence's length.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.config import ModelConfig
+from skypilot_tpu.models.llama import apply_rope, rope_table
+from skypilot_tpu.ops import rms_norm
+
+Params = Dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Stacked-layer KV cache + per-sequence lengths."""
+    k: jax.Array        # [L, B, max_len, kv_heads, head_dim]
+    v: jax.Array        # [L, B, max_len, kv_heads, head_dim]
+    lengths: jax.Array  # [B] int32: number of valid positions per sequence
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads,
+             cfg.resolved_head_dim)
+    dt = cfg.compute_dtype
+    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                   lengths=jnp.zeros((batch,), jnp.int32))
+
+
+def _embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    dt = cfg.compute_dtype
+    table = params['embed']['embedding'].astype(dt)
+    if cfg.use_iota_embed:
+        one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=dt)
+        return jnp.einsum('bsv,vd->bsd', one_hot, table)
+    return table[tokens]
+
+
+def _lm_head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    x = rms_norm(x, params['final_norm']['scale'], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        head = params['embed']['embedding'].astype(cfg.compute_dtype).T
+    else:
+        head = params['lm_head']['w'].astype(cfg.compute_dtype)
+    return jnp.einsum('bsd,dv->bsv', x, head,
+                      preferred_element_type=jnp.float32)
+
+
+def _mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
+    dt = cfg.compute_dtype
+    if cfg.is_moe:
+        # Decode reuses the dense-dispatch MoE from training.
+        from skypilot_tpu.models.llama import _moe_block
+        from skypilot_tpu.parallel.sharding import DEFAULT_RULES
+        return _moe_block(x, lp['moe'], cfg, DEFAULT_RULES)
+    mlp = lp['mlp']
+    gate = jnp.einsum('bsd,df->bsf', x, mlp['wi_gate'].astype(dt))
+    up = jnp.einsum('bsd,df->bsf', x, mlp['wi_up'].astype(dt))
+    return jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+                      mlp['wo'].astype(dt))
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
+            cfg: ModelConfig, max_len: int
+            ) -> Tuple[jax.Array, KVCache]:
+    """Process right-padded prompts; returns (last-token logits, cache).
+
+    tokens: [B, S] int32 (S <= max_len), lengths: [B] valid counts.
+    """
+    b, s = tokens.shape
+    dt = cfg.compute_dtype
+    positions = jnp.arange(s)
+    sin, cos = rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta)
+    x = _embed(params, tokens, cfg)
+
+    def layer(carry, lp):
+        x = carry
+        h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
+        q = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wq'].astype(dt))
+        k = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wk'].astype(dt))
+        v = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wv'].astype(dt))
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        from skypilot_tpu.ops import multi_head_attention
+        attn = multi_head_attention(q, k, v, causal=True,
+                                    impl=cfg.attention_impl)
+        x = x + jnp.einsum('bshk,hkd->bsd', attn,
+                           lp['attn']['wo'].astype(dt))
+        h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
+        x = x + _mlp(h, lp, cfg)
+        # cache entries for this layer, padded to max_len
+        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, params['layers'])
+    logits = _lm_head(params, x, cfg)               # [B, S, V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [B, V]
+    return last, KVCache(k=k_cache, v=v_cache, lengths=lengths)
+
+
+# ---------------------------------------------------------------------------
+# Decode step
+# ---------------------------------------------------------------------------
+
+def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
+                cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
+    """One autoregressive step. tokens: [B] int32 (the just-sampled token).
+
+    Returns (logits [B, V], updated cache with lengths+1).
+    """
+    b = tokens.shape[0]
+    dt = cfg.compute_dtype
+    positions = cache.lengths[:, None]                       # [B, 1]
+    sin, cos = rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta)
+    x = _embed(params, tokens[:, None], cfg)                 # [B, 1, D]
+
+    max_len = cache.max_len
+    # one-hot over cache positions for scatter + mask for attention
+    pos_iota = jnp.arange(max_len)                           # [T]
+    insert = (pos_iota[None, :] == cache.lengths[:, None])   # [B, T]
+    valid = (pos_iota[None, :] <= cache.lengths[:, None])    # [B, T]
+
+    def layer(carry, scanned):
+        x = carry
+        lp, k_cache, v_cache = scanned
+        h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
+        q = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wq'].astype(dt))
+        k = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wk'].astype(dt))
+        v = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wv'].astype(dt))
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # scatter the new K/V row into the cache at position `length`
+        ins = insert[:, :, None, None].astype(dt)            # [B,T,1,1]
+        k_cache = k_cache * (1 - ins) + k * ins
+        v_cache = v_cache * (1 - ins) + v * ins
+        # grouped-query attention over the cache (fp32 softmax stats)
+        groups = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(b, 1, cfg.n_kv_heads, groups,
+                       cfg.resolved_head_dim)
+        scores = jnp.einsum('bqhgk,bthk->bhgqt', qg.astype(jnp.float32),
+                            k_cache.astype(jnp.float32))
+        scores = scores * (cfg.resolved_head_dim ** -0.5)
+        scores = jnp.where(valid[:, None, None, None, :], scores,
+                           -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum('bhgqt,bthk->bqhgk', probs, v_cache)
+        attn = attn.reshape(b, 1, cfg.n_heads, cfg.resolved_head_dim)
+        x = x + jnp.einsum('bshk,hkd->bsd', attn,
+                           lp['attn']['wo'].astype(dt))
+        h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
+        x = x + _mlp(h, lp, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params['layers'], cache.k, cache.v))
+    logits = _lm_head(params, x, cfg)[:, 0]                  # [B, V]
+    new_cache = KVCache(k=k_new, v=v_new, lengths=cache.lengths + 1)
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Sampling + generate loop
+# ---------------------------------------------------------------------------
+
+def sample(logits: jax.Array, rng: jax.Array, temperature: float) -> jax.Array:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits / temperature,
+                                  axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=('cfg', 'max_new_tokens',
+                                             'temperature', 'eos_id'))
+def generate(params: Params,
+             tokens: jax.Array,
+             lengths: jax.Array,
+             cfg: ModelConfig,
+             *,
+             max_new_tokens: int,
+             temperature: float = 0.0,
+             eos_id: Optional[int] = None,
+             rng: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Batched generation: prompts [B, S] + lengths [B] ->
+    (generated [B, max_new_tokens], gen_lengths [B]).
+
+    The decode loop is a lax.scan of the jitted single-token step --
+    static shapes throughout, one compiled program per (B, S, N) triple.
+    """
+    b, s = tokens.shape
+    max_len = s + max_new_tokens
+    rng = rng if rng is not None else jax.random.key(0)
+    eos = -1 if eos_id is None else eos_id
+
+    last_logits, cache = prefill(params, tokens, lengths, cfg, max_len)
+
+    def step(carry, step_rng):
+        logits, cache, done = carry
+        tok = sample(logits, step_rng, temperature)
+        tok = jnp.where(done, eos if eos >= 0 else 0, tok)
+        done = done | (tok == eos)
+        logits, cache = decode_step(params, tok, cache, cfg)
+        return (logits, cache, done), tok
+
+    done0 = jnp.zeros((b,), bool)
+    rngs = jax.random.split(rng, max_new_tokens)
+    (_, _, done), toks = jax.lax.scan((step), (last_logits, cache, done0),
+                                      rngs)
+    generated = toks.T                                       # [B, N]
+    if eos >= 0:
+        gen_lengths = jnp.argmax(generated == eos, axis=1)
+        gen_lengths = jnp.where(jnp.any(generated == eos, axis=1),
+                                gen_lengths, max_new_tokens)
+    else:
+        gen_lengths = jnp.full((b,), max_new_tokens, jnp.int32)
+    return generated, gen_lengths.astype(jnp.int32)

@@ -1,0 +1,114 @@
+"""ICI/DCN collective microbenchmark (the nccl-test equivalent).
+
+    python -m skypilot_tpu.ops.collectives_bench --op all_reduce \
+        --size-mb 64
+
+Parity: ``examples/nccl_test.yaml:12-14`` measures NCCL all-reduce
+algbw/busbw across GPU nodes; here the collectives are XLA's, over the
+device mesh (ICI within a slice, DCN across slices when launched
+multi-host by the backend's jax.distributed wiring). Reports one JSON
+line per op with algbw (payload/time) and busbw (algbw scaled by the
+ring-traffic factor 2(n-1)/n for all-reduce; (n-1)/n for
+all-gather/reduce-scatter), matching nccl-tests conventions.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _bus_factor(op: str, n: int) -> float:
+    if n <= 1:
+        return 1.0
+    if op == 'all_reduce':
+        return 2 * (n - 1) / n
+    if op in ('all_gather', 'reduce_scatter'):
+        return (n - 1) / n
+    return 1.0  # ppermute: point-to-point
+
+
+def build_op(op: str, mesh: Mesh):
+    n = mesh.size
+
+    if op == 'all_reduce':
+        def fn(x):
+            return jax.lax.psum(x, 'x')
+    elif op == 'all_gather':
+        def fn(x):
+            return jax.lax.all_gather(x, 'x')
+    elif op == 'reduce_scatter':
+        def fn(x):
+            return jax.lax.psum_scatter(x, 'x', tiled=True)
+    elif op == 'ppermute':
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def fn(x):
+            return jax.lax.ppermute(x, 'x', perm)
+    else:
+        raise ValueError(f'unknown op {op!r}')
+
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=P('x'),
+                     out_specs=P('x') if op != 'all_gather' else P())
+
+
+def bench_op(op: str, size_mb: float, iters: int, warmup: int) -> dict:
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ('x',))
+    elems = int(size_mb * 1e6 / 4)
+    elems -= elems % max(n, 1)
+    x = jnp.arange(elems, dtype=jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P('x')))
+    fn = jax.jit(build_op(op, mesh))
+    for _ in range(max(warmup, 1)):
+        out = fn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    payload_bytes = elems * 4
+    algbw = payload_bytes * iters / elapsed / 1e9
+    busbw = algbw * _bus_factor(op, n)
+    return {
+        'metric': f'collective_{op}_{n}dev',
+        'value': round(busbw, 3),
+        'unit': 'GB/s busbw',
+        'detail': {
+            'algbw_gbps': round(algbw, 3),
+            'payload_mb': round(payload_bytes / 1e6, 1),
+            'iters': iters,
+            'devices': n,
+            'device_kind': getattr(devices[0], 'device_kind', 'unknown'),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--op', default='all_reduce',
+                        choices=['all_reduce', 'all_gather',
+                                 'reduce_scatter', 'ppermute', 'all'])
+    parser.add_argument('--size-mb', type=float, default=64)
+    parser.add_argument('--iters', type=int, default=20)
+    parser.add_argument('--warmup', type=int, default=3)
+    args = parser.parse_args(argv)
+    ops = (['all_reduce', 'all_gather', 'reduce_scatter', 'ppermute']
+           if args.op == 'all' else [args.op])
+    for op in ops:
+        print(json.dumps(bench_op(op, args.size_mb, args.iters,
+                                  args.warmup)), flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

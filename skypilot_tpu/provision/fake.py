@@ -188,10 +188,47 @@ class FakeProvider(Provider):
                 worker_index=h['worker_index'],
             ) for h in record['hosts'] if h['state'] == 'running'
         ]
+        if os.environ.get('SKYT_FAKE_SSH_MODE'):
+            # SSH mode: the backend sees a *real* (non-local-style)
+            # cluster and goes down the SSHCommandRunner + runtime-ship +
+            # remote-daemon path; the `ssh`/`rsync` binaries are the
+            # tests/fake_bin shims, which map each fake IP to a private
+            # host root via the map file written here.
+            self._write_ssh_map(name, hosts)
+            return ClusterInfo(cluster_name=name, provider='fake',
+                               region=record['region'], zone=record['zone'],
+                               hosts=hosts, ssh_user='skyt',
+                               custom={'fake_ssh': True})
         return ClusterInfo(cluster_name=name, provider='fake',
                            region=record['region'], zone=record['zone'],
                            hosts=hosts, ssh_user='skyt',
                            custom={'fake': True})
+
+    @staticmethod
+    def _write_ssh_map(cluster_name: str, hosts: List[HostInfo]) -> None:
+        state_dir = os.environ.get('SKYT_STATE_DIR',
+                                   os.path.expanduser('~/.skyt'))
+        map_path = os.environ.get(
+            'SKYT_FAKE_SSH_MAP', os.path.join(state_dir,
+                                              'fake_ssh_map.json'))
+        existing: Dict[str, str] = {}
+        if os.path.exists(map_path):
+            try:
+                with open(map_path, encoding='utf-8') as f:
+                    existing = json.load(f)
+            except (OSError, ValueError):
+                existing = {}
+        for h in hosts:
+            root = os.path.join(state_dir, 'hosts', cluster_name,
+                                f'{h.node_index}-{h.worker_index}')
+            existing[h.internal_ip] = root
+            if h.external_ip:
+                existing[h.external_ip] = root
+        os.makedirs(os.path.dirname(map_path), exist_ok=True)
+        tmp = map_path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(existing, f)
+        os.replace(tmp, map_path)
 
     def stop_instances(self, cluster_name: str) -> None:
         with _Store() as data:

@@ -1,0 +1,53 @@
+"""Curated launchable recipes: `skyt launch recipe://<name>`.
+
+Parity: the reference's recipes registry (``sky/recipes/{core,db}.py``,
+``sky launch recipe://...``) + its ``llm/`` payload directory (48 GPU
+recipe dirs). Here the payloads are the in-tree TPU-native drivers
+(train/pretrain, train/grpo, inference/server, ops/collectives_bench),
+so a recipe is one YAML, not a directory of launcher scripts.
+
+API: ``resolve('recipe://name' | 'name') -> path``, ``list_recipes()``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+PREFIX = 'recipe://'
+_RECIPE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def is_recipe_ref(entrypoint: str) -> bool:
+    return entrypoint.startswith(PREFIX)
+
+
+def list_recipes() -> List[Dict[str, str]]:
+    out = []
+    for name in sorted(os.listdir(_RECIPE_DIR)):
+        if not name.endswith(('.yaml', '.yml')):
+            continue
+        path = os.path.join(_RECIPE_DIR, name)
+        description = ''
+        with open(path, encoding='utf-8') as f:
+            first = f.readline().strip()
+        if first.startswith('#'):
+            description = first.lstrip('# ')
+        out.append({
+            'name': name.rsplit('.', 1)[0],
+            'path': path,
+            'description': description,
+        })
+    return out
+
+
+def resolve(entrypoint: str) -> str:
+    """'recipe://pretrain-1b7' (or bare name) -> absolute YAML path."""
+    name = entrypoint[len(PREFIX):] if is_recipe_ref(entrypoint) \
+        else entrypoint
+    for ext in ('.yaml', '.yml'):
+        path = os.path.join(_RECIPE_DIR, name + ext)
+        if os.path.exists(path):
+            return path
+    available = ', '.join(r['name'] for r in list_recipes())
+    raise FileNotFoundError(
+        f'Unknown recipe {name!r}. Available: {available}')

@@ -1,0 +1,105 @@
+"""On-cluster runtime spec: everything the head-node daemon needs.
+
+The reference's skylet reads cluster facts from Ray + a provision record
+baked into the cluster YAML; here the backend writes ONE json file,
+``<runtime_dir>/cluster.json``, at runtime-setup time and the daemon is
+driven solely by it -- no access to the client's state DB required, so the
+same daemon code runs backend-side (local-style clusters) and on a real
+SSH-reachable head node (parity: ``sky/skylet/skylet.py`` +
+``sky/provision/instance_setup.py:598`` start_skylet_on_head_node).
+
+Hosts are rank-ordered. ``kind``:
+* ``local``  -- the rank runs on the daemon's machine with HOME=``root``
+  (fake/local providers: one private root dir per simulated host; the real
+  head node itself: root='~').
+* ``ssh``    -- the rank runs on another host of the cluster, reached from
+  the head over SSH (``address``/``ssh_port``/spec.ssh_user/spec.ssh_key).
+
+The autostop policy lives here too (updated in place by `skyt autostop`
+through the job_cli shim) so idleness enforcement is cluster-local, like
+the reference's autostop_lib (skylet/autostop_lib.py:137).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+CLUSTER_SPEC_FILENAME = 'cluster.json'
+
+
+@dataclasses.dataclass
+class HostSpec:
+    rank: int
+    kind: str                      # 'local' | 'ssh'
+    root: Optional[str] = None     # local: host root dir ('~' = real home)
+    address: Optional[str] = None  # ssh: address reachable from the head
+    ssh_port: int = 22
+    node_index: int = 0
+    worker_index: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'HostSpec':
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    cluster_name: str
+    cloud: Optional[str]
+    hosts: List[HostSpec]
+    ssh_user: str = 'skyt'
+    ssh_key: Optional[str] = None      # path on the head node
+    autostop: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            'cluster_name': self.cluster_name,
+            'cloud': self.cloud,
+            'hosts': [h.to_dict() for h in self.hosts],
+            'ssh_user': self.ssh_user,
+            'ssh_key': self.ssh_key,
+            'autostop': self.autostop,
+        }, indent=1)
+
+    @classmethod
+    def from_json(cls, text: str) -> 'ClusterSpec':
+        d = json.loads(text)
+        d['hosts'] = [HostSpec.from_dict(h) for h in d['hosts']]
+        return cls(**d)
+
+
+def spec_path(runtime_dir: str) -> str:
+    return os.path.join(os.path.expanduser(runtime_dir),
+                        CLUSTER_SPEC_FILENAME)
+
+
+def write_spec(runtime_dir: str, spec: ClusterSpec) -> None:
+    path = spec_path(runtime_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        f.write(spec.to_json())
+    os.replace(tmp, path)
+
+
+def read_spec(runtime_dir: str) -> Optional[ClusterSpec]:
+    path = spec_path(runtime_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return ClusterSpec.from_json(f.read())
+
+
+def set_autostop(runtime_dir: str, config: Dict[str, Any]) -> None:
+    """Update the autostop policy in place (daemon re-reads every loop)."""
+    spec = read_spec(runtime_dir)
+    if spec is None:
+        raise FileNotFoundError(
+            f'No cluster spec at {spec_path(runtime_dir)}')
+    spec.autostop = config
+    write_spec(runtime_dir, spec)

@@ -7,14 +7,18 @@ Parity: ``sky/skylet/skylet.py`` (EVENTS :31, main :126) +
   process per host with the submitted script), supervises RUNNING jobs
   (a TPU program *hangs* on lost peers, so any rank failure kills the
   whole gang), finalizes status with the worst exit code.
-* **AutostopEvent** -- tracks idleness from the job table + cluster
-  last_use; stops or downs the cluster via its provider.
+* **AutostopEvent** -- tracks idleness from the job table; stops or downs
+  the cluster via its provider.
 * **Heartbeat** -- liveness timestamp for status reconciliation.
 
-For local-style clusters (fake/local providers) every "host" is a private
-root directory on this machine, so the daemon gang-starts ranks directly;
-on real SSH clusters the daemon runs on the head node and reaches workers
-over SSH (wired with host keys at provision time).
+The daemon is driven ONLY by ``<runtime_dir>/cluster.json``
+(runtime/cluster_spec.py), so the same code runs (a) backend-side for
+local-style clusters, where every "host" is a private root directory on
+this machine, and (b) ON the head node of a real SSH cluster, where rank 0
+runs locally and ranks 1+ are reached over SSH using the cluster-internal
+key shipped at runtime-setup time (replacing the reference's Ray worker
+agents; gang start/kill parity: RayCodeGen placement groups,
+task_codegen.py:301).
 """
 from __future__ import annotations
 
@@ -24,10 +28,11 @@ import os
 import signal
 import subprocess
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import psutil
 
+from skypilot_tpu.runtime import cluster_spec as spec_lib
 from skypilot_tpu.runtime import job_lib
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils.subprocess_utils import kill_process_tree
@@ -37,10 +42,58 @@ logger = log.init_logger(__name__)
 EVENT_PERIOD_SECONDS = 1.0
 
 
+class RankProc:
+    """One rank of a running gang."""
+
+    def __init__(self, rank: int, proc: subprocess.Popen) -> None:
+        self.rank = rank
+        self.proc = proc
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def kill(self, sig: int = signal.SIGTERM) -> None:
+        if self.proc.poll() is None:
+            kill_process_tree(self.proc.pid, sig)
+
+    def wait(self, timeout: float) -> None:
+        self.proc.wait(timeout=timeout)
+
+
+class SshRankProc(RankProc):
+    """A rank running on another host, driven over an SSH connection.
+
+    The remote command records its own pid before exec'ing the script so a
+    gang kill reaches the remote process tree even though killing the
+    local ssh client alone would only drop the connection.
+    """
+
+    def __init__(self, rank: int, proc: subprocess.Popen,
+                 ssh_base: List[str], pid_file: str) -> None:
+        super().__init__(rank, proc)
+        self._ssh_base = ssh_base
+        self._pid_file = pid_file
+
+    def kill(self, sig: int = signal.SIGTERM) -> None:
+        sig_name = 'KILL' if sig == signal.SIGKILL else 'TERM'
+        remote = (f'pid=$(cat {self._pid_file} 2>/dev/null); '
+                  f'if [ -n "$pid" ]; then '
+                  f'kill -{sig_name} -- -$pid 2>/dev/null || '
+                  f'kill -{sig_name} $pid 2>/dev/null; fi; true')
+        try:
+            subprocess.run(self._ssh_base + [remote], timeout=60,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL, check=False)
+        except subprocess.TimeoutExpired:
+            logger.warning('Remote kill timed out for rank %d', self.rank)
+        if self.proc.poll() is None:
+            kill_process_tree(self.proc.pid, sig)
+
+
 class JobSupervisor:
     """Gang lifecycle of one running job."""
 
-    def __init__(self, job_id: int, procs: List[subprocess.Popen]) -> None:
+    def __init__(self, job_id: int, procs: List[RankProc]) -> None:
         self.job_id = job_id
         self.procs = procs
 
@@ -50,49 +103,81 @@ class JobSupervisor:
         codes = [p.poll() for p in self.procs]
         failed = [c for c in codes if c is not None and c != 0]
         if failed:
-            # kill remaining ranks: TPU programs hang on lost peers
-            for proc in self.procs:
-                if proc.poll() is None:
-                    kill_process_tree(proc.pid, signal.SIGTERM)
-            for proc in self.procs:
-                try:
-                    proc.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    kill_process_tree(proc.pid, signal.SIGKILL)
+            self.kill_all()
             return max(failed)
         if all(c is not None for c in codes):
             return 0
         return None
 
+    def kill_all(self) -> None:
+        # kill remaining ranks: TPU programs hang on lost peers
+        for proc in self.procs:
+            if proc.poll() is None:
+                proc.kill(signal.SIGTERM)
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill(signal.SIGKILL)
+
 
 class Daemon:
-    def __init__(self, cluster_name: str) -> None:
-        self.cluster_name = cluster_name
+    def __init__(self, runtime_dir: str) -> None:
+        self.runtime_dir = os.path.expanduser(runtime_dir)
+        os.makedirs(self.runtime_dir, exist_ok=True)
+        self.spec = spec_lib.read_spec(self.runtime_dir)
+        if self.spec is None:
+            raise RuntimeError(f'No cluster spec in {self.runtime_dir}')
+        self.cluster_name = self.spec.cluster_name
         self.supervisor: Optional[JobSupervisor] = None
-        self._host_roots = self._resolve_host_roots()
-        self.head_runtime = os.path.join(self._host_roots[0],
-                                         '.skyt_runtime')
-        os.makedirs(self.head_runtime, exist_ok=True)
+        self.started_at = time.time()
 
     # ------------------------------------------------------------------
+    # Rank launch
+    # ------------------------------------------------------------------
 
-    def _resolve_host_roots(self) -> List[str]:
-        """Host root dirs ordered by (node, worker), from cluster state."""
-        from skypilot_tpu import state
-        from skypilot_tpu.provision.api import ClusterInfo
-        from skypilot_tpu.utils.command_runner import runners_for_cluster
-        record = state.get_cluster(self.cluster_name)
-        if record is None or not record.handle:
-            raise RuntimeError(f'No cluster record for {self.cluster_name}')
-        info = ClusterInfo.from_dict(record.handle)
-        runners = runners_for_cluster(info)
-        roots = []
-        for runner in runners:
-            if hasattr(runner, 'host_root'):
-                roots.append(runner.host_root)
-            else:
-                roots.append(os.path.expanduser('~'))
-        return roots
+    def _ssh_base(self, host: spec_lib.HostSpec) -> List[str]:
+        from skypilot_tpu.utils.command_runner import SSH_OPTIONS
+        cmd = ['ssh'] + SSH_OPTIONS + ['-p', str(host.ssh_port)]
+        if self.spec.ssh_key:
+            cmd += ['-i', os.path.expanduser(self.spec.ssh_key)]
+        cmd.append(f'{self.spec.ssh_user}@{host.address}')
+        return cmd
+
+    def _start_rank(self, host: spec_lib.HostSpec, job_id: int,
+                    script: str, log_dir: str) -> RankProc:
+        rank = host.rank
+        rank_log = open(os.path.join(log_dir, f'rank_{rank}.log'), 'a',
+                        encoding='utf-8')
+        try:
+            if host.kind == 'local':
+                root = os.path.expanduser(host.root or '~')
+                env = {**os.environ, 'HOME': root}
+                proc = subprocess.Popen(
+                    ['bash', script], env=env, cwd=root,
+                    stdout=rank_log, stderr=subprocess.STDOUT,
+                    stdin=subprocess.DEVNULL, start_new_session=True)
+                return RankProc(rank, proc)
+            # SSH rank: stream the script over stdin (`bash -s`); the
+            # remote shell records its pid first so gang-kill can reach
+            # the remote process group.
+            remote_job_dir = f'~/.skyt_runtime/jobs/{job_id}'
+            pid_file = f'{remote_job_dir}/rank_{rank}.pid'
+            remote = (f'mkdir -p {remote_job_dir} && '
+                      f'echo $$ > {pid_file} && exec bash -s')
+            ssh_base = self._ssh_base(host)
+            script_file = open(script, encoding='utf-8')
+            try:
+                proc = subprocess.Popen(
+                    ssh_base + [remote],
+                    stdin=script_file,
+                    stdout=rank_log, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+            finally:
+                script_file.close()
+            return SshRankProc(rank, proc, ssh_base, pid_file)
+        finally:
+            rank_log.close()
 
     # ------------------------------------------------------------------
     # Job scheduling (parity: JobSchedulerEvent -> job_lib.JobScheduler)
@@ -102,7 +187,7 @@ class Daemon:
         if self.supervisor is not None:
             self._poll_running()
             return
-        pending = job_lib.list_jobs(self.head_runtime,
+        pending = job_lib.list_jobs(self.runtime_dir,
                                     [job_lib.JobStatus.PENDING])
         if not pending:
             return
@@ -110,41 +195,37 @@ class Daemon:
         self._start_job(job['job_id'])
 
     def _start_job(self, job_id: int) -> None:
-        log_dir = job_lib.job_log_dir(self.head_runtime, job_id)
-        if not any(
-                os.path.exists(os.path.join(log_dir, f'rank_{r}.sh'))
-                for r in range(len(self._host_roots))):
+        log_dir = job_lib.job_log_dir(self.runtime_dir, job_id)
+        hosts = self.spec.hosts
+        scripts = {
+            h.rank: os.path.join(log_dir, f'rank_{h.rank}.sh')
+            for h in hosts
+            if os.path.exists(os.path.join(log_dir, f'rank_{h.rank}.sh'))
+        }
+        if not scripts:
             logger.warning('Job %d has no rank scripts; failing', job_id)
-            job_lib.set_status(self.head_runtime, job_id,
+            job_lib.set_status(self.runtime_dir, job_id,
                                job_lib.JobStatus.FAILED, exit_code=1)
             return
-        procs: List[subprocess.Popen] = []
-        for rank, root in enumerate(self._host_roots):
-            script = os.path.join(log_dir, f'rank_{rank}.sh')
-            if not os.path.exists(script):
-                # a callable run may legitimately skip ranks (None command)
+        procs: List[RankProc] = []
+        for host in hosts:
+            # a callable run may legitimately skip ranks (None command)
+            if host.rank not in scripts:
                 continue
-            rank_log = open(os.path.join(log_dir, f'rank_{rank}.log'), 'a',
-                            encoding='utf-8')
-            env = {**os.environ, 'HOME': root}
-            procs.append(subprocess.Popen(
-                ['bash', script], env=env, cwd=root,
-                stdout=rank_log, stderr=subprocess.STDOUT,
-                stdin=subprocess.DEVNULL, start_new_session=True))
-            rank_log.close()
-        job_lib.set_status(self.head_runtime, job_id,
+            procs.append(self._start_rank(host, job_id, scripts[host.rank],
+                                          log_dir))
+        job_lib.set_status(self.runtime_dir, job_id,
                            job_lib.JobStatus.RUNNING)
-        job_lib.set_pids(self.head_runtime, job_id,
-                         [p.pid for p in procs])
+        job_lib.set_pids(self.runtime_dir, job_id,
+                         [p.proc.pid for p in procs])
         self.supervisor = JobSupervisor(job_id, procs)
         logger.info('Job %d started (%d ranks)', job_id, len(procs))
 
     def _poll_running(self) -> None:
         assert self.supervisor is not None
-        job = job_lib.get_job(self.head_runtime, self.supervisor.job_id)
+        job = job_lib.get_job(self.runtime_dir, self.supervisor.job_id)
         if job is None or job['status'] == 'CANCELLED':
-            for proc in self.supervisor.procs:
-                kill_process_tree(proc.pid)
+            self.supervisor.kill_all()
             self.supervisor = None
             return
         code = self.supervisor.poll()
@@ -152,7 +233,7 @@ class Daemon:
             return
         final = (job_lib.JobStatus.SUCCEEDED if code == 0
                  else job_lib.JobStatus.FAILED)
-        job_lib.set_status(self.head_runtime, self.supervisor.job_id, final,
+        job_lib.set_status(self.runtime_dir, self.supervisor.job_id, final,
                            exit_code=code)
         logger.info('Job %d finished: %s (%d)', self.supervisor.job_id,
                     final.value, code)
@@ -164,39 +245,80 @@ class Daemon:
 
     def _check_autostop(self) -> bool:
         """Returns True if the cluster was stopped/downed (daemon exits)."""
-        from skypilot_tpu import state
-        record = state.get_cluster(self.cluster_name)
-        if record is None:
-            return True  # cluster gone
-        config = record.autostop or {}
+        spec = spec_lib.read_spec(self.runtime_dir)
+        if spec is None:
+            return True  # spec gone: cluster being torn down
+        self.spec = spec  # autostop config / host set may have changed
+        config = spec.autostop or {}
         if not config:
             return False
+        if self.supervisor is not None:
+            return False  # active job: never idle
         idle_minutes = config.get('idle_minutes', 5)
-        last_job = job_lib.last_activity_time(self.head_runtime)
-        last = max(last_job, record.last_use or 0, record.launched_at or 0)
+        last_job = job_lib.last_activity_time(self.runtime_dir)
+        last = max(last_job, self.started_at, self._last_use_time())
         if time.time() - last < idle_minutes * 60:
             return False
-        logger.info('Cluster %s idle for > %d min: %s', self.cluster_name,
-                    idle_minutes, 'down' if config.get('down') else 'stop')
-        from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+        down = bool(config.get('down'))
+        logger.info('Cluster %s idle for > %s min: %s', self.cluster_name,
+                    idle_minutes, 'down' if down else 'stop')
+        return self._teardown_cluster(down)
+
+    def _last_use_time(self) -> float:
+        """mtime of the `last_use` touch file (bumped by job_cli ops)."""
+        path = os.path.join(self.runtime_dir, 'last_use')
         try:
-            TpuPodBackend().teardown(self.cluster_name,
-                                     terminate=bool(config.get('down')))
+            return os.path.getmtime(path)
+        except OSError:
+            return 0.0
+
+    def _teardown_cluster(self, down: bool) -> bool:
+        """Stop/terminate via the provider; sync the client state DB when
+        it is reachable (backend-side daemons). On a real head node the
+        state DB is absent -- the server's background reconciler flips the
+        record on the next refresh (parity: skylet autostop calls the
+        cloud API with the instance's own credentials)."""
+        from skypilot_tpu.provision.api import get_provider
+        try:
+            provider = get_provider(self.spec.cloud or 'fake')
+            if down:
+                provider.terminate_instances(self.cluster_name)
+            else:
+                provider.stop_instances(self.cluster_name)
         except Exception as e:  # pylint: disable=broad-except
-            logger.error('Autostop failed: %s', e)
+            logger.error('Autostop provider call failed: %s', e)
             return False
+        try:
+            from skypilot_tpu import state
+            record = state.get_cluster(self.cluster_name)
+            if record is not None:
+                if down:
+                    state.remove_cluster(self.cluster_name)
+                    state.add_cluster_event(self.cluster_name,
+                                            'TERMINATED', 'autostop: idle')
+                else:
+                    state.set_cluster_status(self.cluster_name,
+                                             state.ClusterStatus.STOPPED)
+                    state.add_cluster_event(self.cluster_name, 'STOPPED',
+                                            'autostop: idle')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('State DB sync after autostop failed: %s', e)
         return True
 
     # ------------------------------------------------------------------
 
     def _heartbeat(self) -> None:
-        path = os.path.join(self.head_runtime, 'daemon_heartbeat')
+        path = os.path.join(self.runtime_dir, 'daemon_heartbeat')
         with open(path, 'w', encoding='utf-8') as f:
             json.dump({'ts': time.time(), 'pid': os.getpid()}, f)
 
     def run_forever(self) -> None:
-        logger.info('Daemon for %s up (roots: %d hosts)', self.cluster_name,
-                    len(self._host_roots))
+        logger.info('Daemon for %s up (%d hosts, runtime %s)',
+                    self.cluster_name, len(self.spec.hosts),
+                    self.runtime_dir)
+        with open(os.path.join(self.runtime_dir, 'daemon.pid'), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(os.getpid()))
         while True:
             try:
                 self._schedule_jobs()
@@ -210,7 +332,7 @@ class Daemon:
 
 
 # ---------------------------------------------------------------------------
-# Daemon process management (backend-side helpers)
+# Daemon process management (backend-side helpers, local-style clusters)
 # ---------------------------------------------------------------------------
 
 def _pid_file(cluster_name: str) -> str:
@@ -232,9 +354,10 @@ def daemon_alive(cluster_name: str) -> bool:
         return False
 
 
-def start_daemon(cluster_name: str) -> int:
-    """Spawn the daemon detached (parity: start_skylet_on_head_node,
-    provision/instance_setup.py:598)."""
+def start_daemon(cluster_name: str, runtime_dir: str) -> int:
+    """Spawn the daemon detached on THIS machine (local-style clusters;
+    parity: start_skylet_on_head_node, provision/instance_setup.py:598.
+    SSH clusters start theirs over SSH in runtime_setup)."""
     if daemon_alive(cluster_name):
         with open(_pid_file(cluster_name), encoding='utf-8') as f:
             return int(f.read().strip())
@@ -246,7 +369,7 @@ def start_daemon(cluster_name: str) -> int:
         import sys
         proc = subprocess.Popen(
             [sys.executable, '-u', '-m', 'skypilot_tpu.runtime.daemon',
-             '--cluster', cluster_name],
+             '--runtime-dir', runtime_dir],
             stdout=log_file, stderr=subprocess.STDOUT,
             stdin=subprocess.DEVNULL, start_new_session=True)
     with open(_pid_file(cluster_name), 'w', encoding='utf-8') as f:
@@ -276,9 +399,9 @@ def stop_daemon(cluster_name: str) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--cluster', required=True)
+    parser.add_argument('--runtime-dir', required=True)
     args = parser.parse_args()
-    Daemon(args.cluster).run_forever()
+    Daemon(args.runtime_dir).run_forever()
 
 
 if __name__ == '__main__':

@@ -1,0 +1,184 @@
+"""Remote job-table shim: the backend drives a cluster's job queue by
+running this module ON the head node over SSH.
+
+Parity: ``sky/skylet/job_lib.py:1161 JobLibCodeGen`` -- the reference
+generates Python snippets executed over SSH (its newer path is skylet
+gRPC, ``cloud_vm_ray_backend.py:2884``); here the shim is a real CLI
+shipped with the runtime (backend/runtime_setup.py), invoked as::
+
+    PYTHONPATH=~/.skyt_runtime/runtime python3 -m \\
+        skypilot_tpu.runtime.job_cli --runtime-dir ~/.skyt_runtime <cmd>
+
+Every command prints ONE JSON document on stdout (except ``tail``, which
+streams raw log lines), so the backend-side client
+(runtime/job_client.py RemoteJobTable) parses the last line.
+
+``submit`` reads a base64'd JSON payload argument containing all rank
+scripts and performs the full submission protocol atomically on-head:
+job row at SETTING_UP -> write every rank script -> flip to PENDING (the
+daemon polls every second and must never observe a partial script set).
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import sys
+import time
+
+# stdlib-only imports at module level: this runs on cluster hosts where
+# only the shipped runtime package is guaranteed importable.
+from skypilot_tpu.runtime import job_lib
+
+
+def _touch_last_use(runtime_dir: str) -> None:
+    path = os.path.join(os.path.expanduser(runtime_dir), 'last_use')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(str(time.time()))
+
+
+def cmd_submit(runtime_dir: str, payload_b64: str) -> dict:
+    payload = json.loads(base64.b64decode(payload_b64).decode('utf-8'))
+    job_id = job_lib.add_job(runtime_dir, payload.get('name'),
+                             num_hosts=int(payload.get('num_hosts', 1)),
+                             metadata=payload.get('metadata'),
+                             status=job_lib.JobStatus.SETTING_UP)
+    log_dir = job_lib.job_log_dir(runtime_dir, job_id)
+    os.makedirs(log_dir, exist_ok=True)
+    for rank, script in payload['scripts'].items():
+        path = os.path.join(log_dir, f'rank_{int(rank)}.sh')
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(script)
+    job_lib.set_status(runtime_dir, job_id, job_lib.JobStatus.PENDING)
+    _touch_last_use(runtime_dir)
+    return {'job_id': job_id}
+
+
+def cmd_add(runtime_dir: str, name: str, num_hosts: int,
+            status: str) -> dict:
+    job_id = job_lib.add_job(runtime_dir, name or None,
+                             num_hosts=num_hosts,
+                             status=job_lib.JobStatus(status))
+    _touch_last_use(runtime_dir)
+    return {'job_id': job_id}
+
+
+def cmd_set_status(runtime_dir: str, job_id: int, status: str,
+                   exit_code) -> dict:
+    job_lib.set_status(runtime_dir, job_id, job_lib.JobStatus(status),
+                       exit_code=exit_code)
+    return {'ok': True}
+
+
+def cmd_list(runtime_dir: str) -> list:
+    return job_lib.list_jobs(runtime_dir)
+
+
+def cmd_get(runtime_dir: str, job_id: int) -> dict:
+    job = job_lib.get_job(runtime_dir, job_id)
+    return job if job is not None else {'error': 'not_found'}
+
+
+def cmd_cancel(runtime_dir: str, job_id: int) -> dict:
+    return {'cancelled': job_lib.cancel_job(runtime_dir, job_id)}
+
+
+def cmd_set_autostop(runtime_dir: str, config_b64: str) -> dict:
+    from skypilot_tpu.runtime import cluster_spec
+    config = json.loads(base64.b64decode(config_b64).decode('utf-8'))
+    cluster_spec.set_autostop(runtime_dir, config)
+    _touch_last_use(runtime_dir)
+    return {'ok': True}
+
+
+def cmd_daemon_status(runtime_dir: str) -> dict:
+    path = os.path.join(os.path.expanduser(runtime_dir),
+                        'daemon_heartbeat')
+    if not os.path.exists(path):
+        return {'alive': False}
+    with open(path, encoding='utf-8') as f:
+        hb = json.load(f)
+    return {'alive': time.time() - hb.get('ts', 0) < 30, **hb}
+
+
+def cmd_tail(runtime_dir: str, job_id: int, follow: bool) -> int:
+    """Stream the rank-0 log to stdout; exits when the job is terminal."""
+    from skypilot_tpu.runtime import log_lib
+    job = job_lib.get_job(runtime_dir, job_id)
+    if job is None:
+        print(f'No job {job_id} on cluster', file=sys.stderr)
+        return 3
+    log_path = os.path.join(job_lib.job_log_dir(runtime_dir, job_id),
+                            'rank_0.log')
+
+    def job_done() -> bool:
+        j = job_lib.get_job(runtime_dir, job_id)
+        return j is None or job_lib.JobStatus(j['status']).is_terminal()
+
+    if not follow and not os.path.exists(log_path):
+        print(f'No logs for job {job_id}', file=sys.stderr)
+        return 3
+    for line in log_lib.tail_file(log_path, follow=follow,
+                                  stop_when=job_done):
+        sys.stdout.write(line)
+        sys.stdout.flush()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog='job_cli')
+    parser.add_argument('--runtime-dir',
+                        default=job_lib.DEFAULT_RUNTIME_DIR)
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    p = sub.add_parser('submit')
+    p.add_argument('payload_b64')
+    p = sub.add_parser('add')
+    p.add_argument('--name', default='')
+    p.add_argument('--num-hosts', type=int, default=1)
+    p.add_argument('--status', default='PENDING')
+    p = sub.add_parser('set-status')
+    p.add_argument('job_id', type=int)
+    p.add_argument('status')
+    p.add_argument('--exit-code', type=int, default=None)
+    sub.add_parser('list')
+    p = sub.add_parser('get')
+    p.add_argument('job_id', type=int)
+    p = sub.add_parser('cancel')
+    p.add_argument('job_id', type=int)
+    p = sub.add_parser('set-autostop')
+    p.add_argument('config_b64')
+    sub.add_parser('daemon-status')
+    p = sub.add_parser('tail')
+    p.add_argument('job_id', type=int)
+    p.add_argument('--follow', action='store_true')
+    args = parser.parse_args(argv)
+
+    rt = args.runtime_dir
+    if args.cmd == 'submit':
+        out = cmd_submit(rt, args.payload_b64)
+    elif args.cmd == 'add':
+        out = cmd_add(rt, args.name, args.num_hosts, args.status)
+    elif args.cmd == 'set-status':
+        out = cmd_set_status(rt, args.job_id, args.status, args.exit_code)
+    elif args.cmd == 'list':
+        out = cmd_list(rt)
+    elif args.cmd == 'get':
+        out = cmd_get(rt, args.job_id)
+    elif args.cmd == 'cancel':
+        out = cmd_cancel(rt, args.job_id)
+    elif args.cmd == 'set-autostop':
+        out = cmd_set_autostop(rt, args.config_b64)
+    elif args.cmd == 'daemon-status':
+        out = cmd_daemon_status(rt)
+    elif args.cmd == 'tail':
+        return cmd_tail(rt, args.job_id, args.follow)
+    else:  # pragma: no cover - argparse enforces choices
+        return 2
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""Backend-side access to a cluster's job table.
+
+Two transports behind one interface (parity: the reference reaches the
+cluster job queue via skylet gRPC, ``cloud_vm_ray_backend.py:2884``, with
+an SSH-codegen fallback, ``job_lib.py:1161``):
+
+* ``DirectJobTable`` -- the head "host" is a directory on this machine
+  (fake/local providers): plain function calls into runtime/job_lib.
+* ``RemoteJobTable`` -- a real cluster: run the job_cli shim on the head
+  node through the cluster's CommandRunner (SSH/kubectl).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from typing import Any, Dict, IO, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.runtime import cluster_spec, job_lib, log_lib
+
+REMOTE_RUNTIME_DIR = '~/.skyt_runtime'
+# Where runtime_setup extracts the shipped package on each host.
+REMOTE_PKG_DIR = '~/.skyt_runtime/runtime'
+
+
+class JobTable:
+    """Submit/inspect/cancel jobs + runtime-daemon state on one cluster."""
+
+    def submit(self, name: Optional[str], num_hosts: int,
+               scripts: Dict[int, str],
+               metadata: Optional[Dict[str, Any]] = None) -> int:
+        raise NotImplementedError
+
+    def add_job(self, name: Optional[str], num_hosts: int,
+                status: job_lib.JobStatus) -> int:
+        """Record a job row without scripts (foreground execution)."""
+        raise NotImplementedError
+
+    def set_status(self, job_id: int, status: job_lib.JobStatus,
+                   exit_code: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def get(self, job_id: int) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def cancel(self, job_id: int) -> bool:
+        raise NotImplementedError
+
+    def set_autostop(self, config: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def tail(self, job_id: int, *, follow: bool = False,
+             stream: Optional[IO[str]] = None) -> str:
+        raise NotImplementedError
+
+    def daemon_alive(self) -> bool:
+        raise NotImplementedError
+
+
+class DirectJobTable(JobTable):
+    def __init__(self, runtime_dir: str) -> None:
+        self.runtime_dir = runtime_dir
+
+    def submit(self, name, num_hosts, scripts, metadata=None) -> int:
+        job_id = job_lib.add_job(self.runtime_dir, name,
+                                 num_hosts=num_hosts, metadata=metadata,
+                                 status=job_lib.JobStatus.SETTING_UP)
+        log_dir = job_lib.job_log_dir(self.runtime_dir, job_id)
+        os.makedirs(log_dir, exist_ok=True)
+        for rank, script in scripts.items():
+            with open(os.path.join(log_dir, f'rank_{rank}.sh'), 'w',
+                      encoding='utf-8') as f:
+                f.write(script)
+        job_lib.set_status(self.runtime_dir, job_id,
+                           job_lib.JobStatus.PENDING)
+        return job_id
+
+    def add_job(self, name, num_hosts, status):
+        return job_lib.add_job(self.runtime_dir, name,
+                               num_hosts=num_hosts, status=status)
+
+    def set_status(self, job_id, status, exit_code=None):
+        job_lib.set_status(self.runtime_dir, job_id, status,
+                           exit_code=exit_code)
+
+    def list_jobs(self):
+        return job_lib.list_jobs(self.runtime_dir)
+
+    def get(self, job_id):
+        return job_lib.get_job(self.runtime_dir, job_id)
+
+    def cancel(self, job_id):
+        return job_lib.cancel_job(self.runtime_dir, job_id)
+
+    def set_autostop(self, config):
+        cluster_spec.set_autostop(self.runtime_dir, config)
+
+    def tail(self, job_id, *, follow=False, stream=None):
+        if self.get(job_id) is None:
+            raise exceptions.JobNotFoundError(
+                f'No job {job_id} on cluster')
+        log_path = os.path.join(
+            job_lib.job_log_dir(self.runtime_dir, job_id), 'rank_0.log')
+
+        def job_done() -> bool:
+            job = self.get(job_id)
+            return job is None or job_lib.JobStatus(
+                job['status']).is_terminal()
+
+        if not follow and not os.path.exists(log_path):
+            raise exceptions.JobNotFoundError(
+                f'No logs for job {job_id} at {log_path}')
+        lines = log_lib.tail_file(log_path, follow=follow,
+                                  stop_when=job_done)
+        import sys
+        return log_lib.stream_to(lines, stream or sys.stdout)
+
+    def daemon_alive(self) -> bool:
+        path = os.path.join(os.path.expanduser(self.runtime_dir),
+                            'daemon_heartbeat')
+        try:
+            with open(path, encoding='utf-8') as f:
+                hb = json.load(f)
+            return time.time() - hb.get('ts', 0) < 30
+        except (OSError, ValueError):
+            return False
+
+
+class RemoteJobTable(JobTable):
+    """Drives the job_cli shim on the head node via a CommandRunner."""
+
+    def __init__(self, head_runner,
+                 runtime_dir: str = REMOTE_RUNTIME_DIR) -> None:
+        self.runner = head_runner
+        self.runtime_dir = runtime_dir
+
+    def _invoke(self, args: str, *, stream: Optional[IO[str]] = None,
+                check_rc: bool = True) -> Any:
+        cmd = (f'PYTHONPATH={REMOTE_PKG_DIR}:$PYTHONPATH '
+               f'python3 -m skypilot_tpu.runtime.job_cli '
+               f'--runtime-dir {self.runtime_dir} {args}')
+        code, output = self.runner.run(cmd, stream_to=stream)
+        if code != 0 and check_rc:
+            raise exceptions.CommandError(
+                code, f'job_cli {args.split()[0]}',
+                error_msg=output[-2000:])
+        return code, output
+
+    @staticmethod
+    def _parse(output: str) -> Any:
+        for line in reversed(output.strip().splitlines()):
+            line = line.strip()
+            if line.startswith(('{', '[')):
+                return json.loads(line)
+        raise exceptions.CommandError(
+            1, 'job_cli', error_msg=f'No JSON in output: {output[-500:]}')
+
+    def submit(self, name, num_hosts, scripts, metadata=None) -> int:
+        payload = {
+            'name': name,
+            'num_hosts': num_hosts,
+            'scripts': {str(r): s for r, s in scripts.items()},
+            'metadata': metadata or {},
+        }
+        b64 = base64.b64encode(
+            json.dumps(payload).encode('utf-8')).decode('ascii')
+        _, output = self._invoke(f'submit {b64}')
+        return int(self._parse(output)['job_id'])
+
+    def add_job(self, name, num_hosts, status):
+        import shlex
+        name_arg = f'--name {shlex.quote(name)} ' if name else ''
+        _, output = self._invoke(
+            f'add {name_arg}--num-hosts {num_hosts} '
+            f'--status {status.value}')
+        return int(self._parse(output)['job_id'])
+
+    def set_status(self, job_id, status, exit_code=None):
+        exit_arg = (f' --exit-code {exit_code}'
+                    if exit_code is not None else '')
+        self._invoke(f'set-status {job_id} {status.value}{exit_arg}')
+
+    def list_jobs(self):
+        _, output = self._invoke('list')
+        return self._parse(output)
+
+    def get(self, job_id):
+        _, output = self._invoke(f'get {job_id}')
+        job = self._parse(output)
+        return None if job.get('error') == 'not_found' else job
+
+    def cancel(self, job_id):
+        _, output = self._invoke(f'cancel {job_id}')
+        return bool(self._parse(output)['cancelled'])
+
+    def set_autostop(self, config):
+        b64 = base64.b64encode(
+            json.dumps(config).encode('utf-8')).decode('ascii')
+        self._invoke(f'set-autostop {b64}')
+
+    def tail(self, job_id, *, follow=False, stream=None):
+        import sys
+        stream = stream or sys.stdout
+        flag = ' --follow' if follow else ''
+        code, output = self._invoke(f'tail {job_id}{flag}', stream=stream,
+                                    check_rc=False)
+        if code == 3:
+            raise exceptions.JobNotFoundError(
+                f'No job/logs for {job_id}: {output[-300:]}')
+        if code != 0:
+            raise exceptions.CommandError(code, 'job_cli tail',
+                                          error_msg=output[-2000:])
+        return output
+
+    def daemon_alive(self) -> bool:
+        try:
+            _, output = self._invoke('daemon-status')
+            return bool(self._parse(output).get('alive'))
+        except exceptions.CommandError:
+            return False
+
+
+def job_table_for(info) -> JobTable:
+    """The right transport for this cluster's job table."""
+    from skypilot_tpu.backend import runtime_setup
+    from skypilot_tpu.utils.command_runner import runners_for_cluster
+    if runtime_setup.is_local_style(info):
+        return DirectJobTable(runtime_setup.head_runtime_dir(info))
+    return RemoteJobTable(runners_for_cluster(info)[0])

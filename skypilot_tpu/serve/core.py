@@ -136,7 +136,10 @@ def tail_logs(service_name: str,
             f'Service {service_name} has no replica {replica_id}.')
     from skypilot_tpu import core as sky_core
     try:
-        return sky_core.tail_logs(replica.cluster_name)
+        # Streams to stdout itself; return '' so callers that print the
+        # return value don't emit every line twice.
+        sky_core.tail_logs(replica.cluster_name)
+        return ''
     except exceptions.SkytError:
         return (f'(replica cluster {replica.cluster_name} is gone; '
                 f'status: {replica.status.value})\n')

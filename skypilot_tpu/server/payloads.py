@@ -37,8 +37,10 @@ def _exec(task_config: Dict[str, Any],
 def _logs(cluster_name: str,
           job_id: Optional[int] = None,
           follow: bool = False) -> None:
-    # Streamed: print to the request log, which /api/stream tails.
-    print(core.tail_logs(cluster_name, job_id, follow=follow), end='')
+    # tail_logs STREAMS to stdout (the request log, which /api/stream
+    # tails live) and also returns the text -- printing the return too
+    # would double every line.
+    core.tail_logs(cluster_name, job_id, follow=follow)
 
 
 def _check() -> Dict[str, Any]:

@@ -145,6 +145,11 @@ class Task:
 
     @classmethod
     def from_yaml(cls, path: str) -> 'Task':
+        if path.startswith('recipe://'):
+            # Curated launchable recipes shipped with the framework
+            # (parity: `sky launch recipe://...`, sky/recipes/core.py).
+            from skypilot_tpu import recipes
+            path = recipes.resolve(path)
         with open(os.path.expanduser(path), encoding='utf-8') as f:
             config = yaml.safe_load(f)
         if not isinstance(config, dict):

@@ -1,0 +1,71 @@
+"""Checkpoint save/restore (orbax-backed) + the resume pattern.
+
+The reference does not checkpoint model state itself -- its *pattern* is
+jobs writing checkpoints to a MOUNT_CACHED bucket and resuming after
+recovery (SURVEY.md §5, docs/source/examples/checkpointing.rst). Here the
+in-tree trainer implements that pattern natively: save to a local dir
+(which a storage mount maps to a bucket), restore-latest on startup.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+def _manager(directory: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+    directory = os.path.abspath(os.path.expanduser(directory))
+    os.makedirs(directory, exist_ok=True)
+    options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                           create=True)
+    return ocp.CheckpointManager(directory, options=options)
+
+
+def save(directory: str, step: int, tree: Any,
+         max_to_keep: int = 3) -> None:
+    import orbax.checkpoint as ocp
+    mgr = _manager(directory, max_to_keep)
+    mgr.save(step, args=ocp.args.StandardSave(tree))
+    mgr.wait_until_finished()
+    mgr.close()
+    logger.info('Saved checkpoint step %d to %s', step, directory)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    directory = os.path.abspath(os.path.expanduser(directory))
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(directory: str, step: int, target: Any) -> Any:
+    """Restore `step` into the structure/shardings of `target`."""
+    import orbax.checkpoint as ocp
+    mgr = _manager(directory)
+    restored = mgr.restore(
+        step, args=ocp.args.StandardRestore(target))
+    mgr.close()
+    logger.info('Restored checkpoint step %d from %s', step, directory)
+    return restored
+
+
+def restore_latest(directory: str,
+                   init_fn: Callable[[], Any]) -> Any:
+    """Restore the newest checkpoint, or build fresh state via init_fn.
+
+    The managed-job recovery contract: a relaunched task calls this and
+    transparently resumes (tests force preemption and assert the step
+    counter survives).
+    """
+    step = latest_step(directory)
+    target = init_fn()
+    if step is None:
+        return target
+    return restore(directory, step, target)

@@ -1,0 +1,190 @@
+"""GRPO post-training driver: RL with group-relative advantages.
+
+    python -m skypilot_tpu.train.grpo --model tiny --steps 30 \
+        --checkpoint-dir ~/ckpts
+
+The TPU-native equivalent of the reference's ``llm/verl`` GRPO recipes
+(BASELINE.json config #5: GRPO on preemptible TPUs with managed-job
+recovery). The algorithm (DeepSeekMath-style GRPO):
+
+  1. sample G rollouts per prompt from the current policy (KV-cache
+     decode path, temperature > 0);
+  2. score each rollout with a verifiable reward;
+  3. advantage = (reward - group mean) / group std  -- no value network;
+  4. policy-gradient step on sum(logprob * advantage) over generated
+     tokens.
+
+The built-in verifiable task: each prompt ends with a "target" token and
+the reward is the fraction of generated tokens equal to it -- a policy
+that learns to repeat the cue earns reward 1.0, so learning is observable
+in a few dozen steps even on the tiny test model (the same contract as a
+real RLVR task, minus the external grader).
+
+Checkpoint/resume follows the managed-jobs recovery pattern: state is
+saved to --checkpoint-dir every --checkpoint-every steps and restored on
+restart, so a preempted spot job continues where it left off.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GrpoState:
+    step: jax.Array
+    params: Dict
+    opt_state: object
+
+
+def make_prompts(rng: jax.Array, n: int, prompt_len: int,
+                 vocab_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Prompts whose last token is the repeat-me cue."""
+    body = jax.random.randint(rng, (n, prompt_len), 3, vocab_size)
+    targets = body[:, -1]
+    return body, targets
+
+
+def reward_fn(generated: jax.Array, targets: jax.Array) -> jax.Array:
+    """Fraction of generated tokens equal to the cue token. [P*G] -> r."""
+    return jnp.mean(
+        (generated == targets[:, None]).astype(jnp.float32), axis=1)
+
+
+def grpo_advantages(rewards: jax.Array, group_size: int) -> jax.Array:
+    """[P*G] rewards -> group-normalized advantages (GRPO core)."""
+    grouped = rewards.reshape(-1, group_size)
+    mean = grouped.mean(axis=1, keepdims=True)
+    std = grouped.std(axis=1, keepdims=True)
+    return ((grouped - mean) / (std + 1e-6)).reshape(-1)
+
+
+def make_grpo_step(cfg, optimizer):
+    from skypilot_tpu.models import llama
+
+    def loss_fn(params, tokens, gen_mask, advantages):
+        """tokens [B, T]: prompt+generated; gen_mask marks generated
+        positions; maximize sum(adv * logprob(token))."""
+        logits = llama.forward(params, tokens[:, :-1], cfg)
+        logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        chosen = jnp.take_along_axis(
+            logprobs, tokens[:, 1:, None], axis=-1)[..., 0]   # [B, T-1]
+        mask = gen_mask[:, 1:].astype(jnp.float32)
+        seq_logprob = (chosen * mask).sum(axis=1)
+        loss = -(advantages * seq_logprob).mean()
+        return loss, (seq_logprob.mean(),)
+
+    @jax.jit
+    def step(state: GrpoState, tokens, gen_mask, advantages):
+        (loss, (mean_lp,)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, tokens, gen_mask,
+                                   advantages)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return GrpoState(step=state.step + 1, params=params,
+                         opt_state=opt_state), {
+                             'loss': loss, 'mean_logprob': mean_lp}
+
+    return step
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--steps', type=int, default=30)
+    parser.add_argument('--prompts-per-step', type=int, default=4)
+    parser.add_argument('--group-size', type=int, default=4)
+    parser.add_argument('--prompt-len', type=int, default=8)
+    parser.add_argument('--max-new-tokens', type=int, default=8)
+    parser.add_argument('--temperature', type=float, default=1.0)
+    parser.add_argument('--learning-rate', type=float, default=1e-4)
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--checkpoint-every', type=int, default=10)
+    parser.add_argument('--log-every', type=int, default=5)
+    parser.add_argument('--vocab-size', type=int, default=None,
+                        help='Override model vocab (smoke-scale runs: a '
+                             'small vocab makes the repeat-reward dense '
+                             'enough to learn in a few steps).')
+    parser.add_argument('--num-prompts', type=int, default=256,
+                        help='Size of the (synthetic) prompt dataset; '
+                             'steps cycle through it.')
+    args = parser.parse_args(argv)
+
+    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models.config import get_model_config
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+
+    overrides = {'attention_impl': 'xla'}
+    if args.vocab_size:
+        overrides['vocab_size'] = args.vocab_size
+    cfg = get_model_config(args.model, **overrides)
+    optimizer = optax.adamw(args.learning_rate)
+
+    def init_state() -> GrpoState:
+        params = llama.init_params(jax.random.key(0), cfg)
+        return GrpoState(step=jnp.zeros((), jnp.int32), params=params,
+                         opt_state=optimizer.init(params))
+
+    state = init_state()
+    start_step = 0
+    if args.checkpoint_dir:
+        latest = ckpt_lib.latest_step(args.checkpoint_dir)
+        if latest is not None:
+            state = ckpt_lib.restore(args.checkpoint_dir, latest, state)
+            start_step = int(state.step)
+            print(json.dumps({'resumed_from_step': start_step}),
+                  flush=True)
+    grpo_step = make_grpo_step(cfg, optimizer)
+    p, g = args.prompts_per_step, args.group_size
+    # The prompt "dataset": a fixed pool, cycled per step (a real RLVR
+    # recipe would load prompts from a file/bucket here).
+    pool, pool_targets = make_prompts(jax.random.key(42),
+                                      args.num_prompts, args.prompt_len,
+                                      cfg.vocab_size)
+
+    for step in range(start_step, args.steps):
+        sample_rng = jax.random.key(1000 + step)
+        idx = (step * p + jnp.arange(p)) % args.num_prompts
+        prompts, targets = pool[idx], pool_targets[idx]
+        # G rollouts per prompt: tile the batch, one sampled seed per step
+        tiled = jnp.repeat(prompts, g, axis=0)              # [P*G, L]
+        tiled_targets = jnp.repeat(targets, g)
+        lengths = jnp.full((p * g,), args.prompt_len, jnp.int32)
+        generated, _ = decode.generate(
+            state.params, tiled, lengths, cfg,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature, rng=sample_rng)
+        rewards = reward_fn(generated, tiled_targets)
+        advantages = grpo_advantages(rewards, g)
+        tokens = jnp.concatenate([tiled, generated], axis=1)
+        gen_mask = jnp.concatenate(
+            [jnp.zeros_like(tiled), jnp.ones_like(generated)], axis=1)
+        state, metrics = grpo_step(state, tokens, gen_mask, advantages)
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            print(json.dumps({
+                'step': step + 1,
+                'mean_reward': round(float(rewards.mean()), 4),
+                'loss': round(float(metrics['loss']), 4),
+            }), flush=True)
+        if (args.checkpoint_dir and
+                ((step + 1) % args.checkpoint_every == 0 or
+                 step + 1 == args.steps)):
+            ckpt_lib.save(args.checkpoint_dir, step + 1, state)
+    print(json.dumps({'done': True, 'final_step': args.steps}), flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

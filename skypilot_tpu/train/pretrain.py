@@ -1,0 +1,194 @@
+"""Pretraining driver: the in-tree trainer recipes launch.
+
+    python -m skypilot_tpu.train.pretrain --model bench-1b7 --steps 100 \
+        --checkpoint-dir ~/ckpts --mesh fsdp=-1
+
+TPU-native equivalents of the reference's GPU payload drivers
+(``examples/tpu/v6e/train-llama3-8b.yaml`` runs PyTorch/XLA FSDP via HF
+trainer): multi-host wiring comes from the backend's env contract
+(JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID,
+backend/codegen.py) -> ``jax.distributed.initialize``; sharding is a
+``--mesh`` string over the named axes (data/stage/fsdp/seq/expert/
+tensor); checkpoints go to --checkpoint-dir (a storage mount in the
+recipe YAML) and training transparently resumes from the latest one --
+the managed-jobs recovery contract.
+
+Emits one JSON line per --log-every steps:
+    {"step": N, "loss": x, "tokens_per_sec": y, "mfu_pct": z}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def maybe_init_distributed() -> None:
+    """Join the jax.distributed gang when launched multi-host by the
+    backend (env contract from backend/codegen.py; replaces the
+    reference's torchrun/NCCL env block, SURVEY.md §2.9)."""
+    num_processes = int(os.environ.get('JAX_NUM_PROCESSES', '1'))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=os.environ['JAX_COORDINATOR_ADDRESS'],
+        num_processes=num_processes,
+        process_id=int(os.environ['JAX_PROCESS_ID']))
+
+
+def parse_mesh(spec: Optional[str]) -> Dict[str, int]:
+    """'fsdp=-1,tensor=2' -> {'fsdp': -1, 'tensor': 2}."""
+    if not spec:
+        return {'fsdp': -1}
+    out: Dict[str, int] = {}
+    for part in spec.split(','):
+        key, _, value = part.partition('=')
+        out[key.strip()] = int(value)
+    return out
+
+
+def synthetic_batch(step: int, batch: int, seq: int,
+                    vocab_size: int) -> Dict[str, jax.Array]:
+    """Deterministic synthetic LM data (zipf-ish marginals so loss moves)."""
+    rng = jax.random.key(step)
+    r1, r2 = jax.random.split(rng)
+    base = jax.random.randint(r1, (batch, seq), 0, vocab_size)
+    # inject learnable structure: every other token repeats its left
+    # neighbor, so a real model drives loss well below uniform entropy
+    repeat = jnp.roll(base, 1, axis=1)
+    mask = (jnp.arange(seq) % 2).astype(bool)
+    tokens = jnp.where(mask[None, :], repeat, base)
+    del r2
+    return {
+        'tokens': tokens,
+        'targets': jnp.roll(tokens, -1, axis=1),
+        'weights': jnp.ones((batch, seq), jnp.float32),
+    }
+
+
+def file_batch_iterator(path: str, batch: int, seq: int):
+    """Stream batches from a flat .npy/int32 token file (memmapped)."""
+    import numpy as np
+    data = np.load(os.path.expanduser(path), mmap_mode='r')
+    tokens_per_batch = batch * (seq + 1)
+    offset = 0
+    while True:
+        if offset + tokens_per_batch > data.shape[0]:
+            offset = 0
+        chunk = np.asarray(
+            data[offset:offset + tokens_per_batch]).reshape(
+                batch, seq + 1)
+        offset += tokens_per_batch
+        yield {
+            'tokens': jnp.asarray(chunk[:, :-1]),
+            'targets': jnp.asarray(chunk[:, 1:]),
+            'weights': jnp.ones((batch, seq), jnp.float32),
+        }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--batch', type=int, default=4)
+    parser.add_argument('--seq', type=int, default=None)
+    parser.add_argument('--learning-rate', type=float, default=3e-4)
+    parser.add_argument('--warmup-steps', type=int, default=10)
+    parser.add_argument('--optimizer', default='adamw',
+                        choices=['adamw', 'adafactor'])
+    parser.add_argument('--mesh', default=None,
+                        help="e.g. 'data=2,fsdp=-1,tensor=2'")
+    parser.add_argument('--data', default='synthetic',
+                        help="'synthetic' or a flat token .npy file")
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--checkpoint-every', type=int, default=50)
+    parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--param-dtype', default=None,
+                        choices=[None, 'float32', 'bfloat16'])
+    args = parser.parse_args(argv)
+
+    maybe_init_distributed()
+
+    from skypilot_tpu.models.config import get_model_config
+    from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    from skypilot_tpu.train.step import (TrainHParams, create_train_state,
+                                         make_train_step, state_shardings)
+
+    overrides = {}
+    if args.param_dtype:
+        overrides['param_dtype'] = jnp.dtype(args.param_dtype)
+    cfg = get_model_config(args.model, **overrides)
+    seq = min(args.seq or 1024, cfg.max_seq_len)
+    hp = TrainHParams(learning_rate=args.learning_rate,
+                      warmup_steps=args.warmup_steps,
+                      total_steps=max(args.steps, args.warmup_steps + 1),
+                      optimizer=args.optimizer)
+    mesh = build_mesh(MeshConfig(**parse_mesh(args.mesh)))
+    # The global batch shards over (data, fsdp) and seq over (seq): round
+    # up so every shard is non-empty regardless of device count.
+    batch_div = mesh.shape['data'] * mesh.shape['fsdp']
+    batch = -(-args.batch // batch_div) * batch_div
+    seq_div = mesh.shape['seq']
+    seq = -(-seq // seq_div) * seq_div
+    if batch != args.batch:
+        print(json.dumps({'batch_rounded_to': batch}), flush=True)
+    args.batch = batch
+    shardings = state_shardings(mesh, cfg, hp)
+    state = create_train_state(jax.random.key(0), cfg, hp, mesh,
+                               shardings=shardings)
+    start_step = 0
+    if args.checkpoint_dir:
+        latest = ckpt_lib.latest_step(args.checkpoint_dir)
+        if latest is not None:
+            state = ckpt_lib.restore(args.checkpoint_dir, latest, state)
+            start_step = int(state.step)
+            print(json.dumps({'resumed_from_step': start_step}), flush=True)
+    step_fn = make_train_step(cfg, hp, mesh, shardings=shardings)
+
+    data_iter = (file_batch_iterator(args.data, args.batch, seq)
+                 if args.data != 'synthetic' else None)
+    flops_per_token = cfg.flops_per_token(seq)
+    window_t0 = time.perf_counter()
+    window_tokens = 0
+    is_main = jax.process_index() == 0
+    for step in range(start_step, args.steps):
+        if data_iter is not None:
+            batch = next(data_iter)
+        else:
+            batch = synthetic_batch(step, args.batch, seq, cfg.vocab_size)
+        state, metrics = step_fn(state, batch)
+        window_tokens += args.batch * seq * jax.process_count()
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            loss = float(metrics['loss'])  # sync point
+            elapsed = time.perf_counter() - window_t0
+            tps = window_tokens / max(elapsed, 1e-9)
+            if is_main:
+                print(json.dumps({
+                    'step': step + 1,
+                    'loss': round(loss, 4),
+                    'tokens_per_sec': round(tps, 1),
+                    'achieved_tflops': round(
+                        tps * flops_per_token / 1e12, 2),
+                }), flush=True)
+            window_t0 = time.perf_counter()
+            window_tokens = 0
+        if (args.checkpoint_dir and
+                ((step + 1) % args.checkpoint_every == 0 or
+                 step + 1 == args.steps)):
+            if is_main:
+                ckpt_lib.save(args.checkpoint_dir, step + 1, state)
+    if is_main:
+        print(json.dumps({'done': True, 'final_step': args.steps}),
+              flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -36,6 +36,10 @@ class TrainHParams:
     b2: float = 0.95
     grad_clip_norm: float = 1.0
     z_loss_coeff: float = 1e-4
+    # 'adamw' (2 fp32 moments/param) or 'adafactor' (factored second
+    # moment, ~O(rows+cols) state -- the HBM-frugal choice that lets a
+    # ~1.7B model train on one 16GB v5e chip; standard TPU practice).
+    optimizer: str = 'adamw'
 
 
 @jax.tree_util.register_dataclass
@@ -53,6 +57,15 @@ def make_optimizer(hp: TrainHParams) -> optax.GradientTransformation:
         warmup_steps=hp.warmup_steps,
         decay_steps=max(hp.total_steps, hp.warmup_steps + 1),
         end_value=hp.learning_rate * 0.1)
+    if hp.optimizer == 'adafactor':
+        return optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip_norm),
+            optax.adafactor(schedule, weight_decay_rate=hp.weight_decay,
+                            decay_rate=hp.b2),
+        )
+    if hp.optimizer != 'adamw':
+        raise ValueError(f'Unknown optimizer {hp.optimizer!r} '
+                         f"(expected 'adamw' or 'adafactor')")
     return optax.chain(
         optax.clip_by_global_norm(hp.grad_clip_norm),
         optax.adamw(schedule, b1=hp.b1, b2=hp.b2,

@@ -32,13 +32,17 @@ def _pycopy(src: str, dst: str, excludes=None) -> None:
         shutil.copy2(src, dst)
 
 
-_SSH_OPTIONS = [
+# Shared by SSHCommandRunner and the head daemon's rank fan-out
+# (runtime/daemon.py) -- one place to tune SSH behavior for every
+# framework-issued connection.
+SSH_OPTIONS = [
     '-o', 'StrictHostKeyChecking=no',
     '-o', 'UserKnownHostsFile=/dev/null',
     '-o', 'IdentitiesOnly=yes',
     '-o', 'ConnectTimeout=30',
     '-o', 'LogLevel=ERROR',
 ]
+_SSH_OPTIONS = SSH_OPTIONS  # backward-compat alias
 
 
 class CommandRunner:

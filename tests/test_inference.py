@@ -1,0 +1,72 @@
+"""Inference engine + HTTP server (the in-tree serving payload)."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.inference.engine import InferenceEngine
+from skypilot_tpu.inference.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope='module')
+def engine():
+    return InferenceEngine('tiny', max_batch=4)
+
+
+def test_tokenizer_round_trip():
+    tok = ByteTokenizer()
+    ids = tok.encode('hello wörld')
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == 'hello wörld'
+
+
+def test_generate_text_batch(engine):
+    outs = engine.generate_text(['abc', 'a much longer prompt here'],
+                                max_new_tokens=8)
+    assert len(outs) == 2
+    assert all(isinstance(o, str) for o in outs)
+    assert engine.stats['requests'] == 2
+    assert engine.stats['tokens_generated'] > 0
+
+
+def test_generate_deterministic_greedy(engine):
+    a = engine.generate_text(['same prompt'], max_new_tokens=8)
+    b = engine.generate_text(['same prompt'], max_new_tokens=8)
+    assert a == b
+
+
+def test_batch_larger_than_max_batch_chunks(engine):
+    outs = engine.generate_text([f'p{i}' for i in range(7)],
+                                max_new_tokens=4)
+    assert len(outs) == 7
+
+
+def test_http_server_generate_and_health(engine):
+    from skypilot_tpu.inference.server import serve
+    server = serve(engine, '127.0.0.1', 0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/health', timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health == {'status': 'ok', 'model': 'tiny'}
+
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/generate',
+            data=json.dumps({'prompts': ['hi'],
+                             'max_new_tokens': 4}).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert len(out['outputs']) == 1
+
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/stats', timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats['requests'] >= 1
+    finally:
+        server.shutdown()
+        server.server_close()
