@@ -84,6 +84,11 @@ def make_job_script(command: str,
                     secrets: Optional[Dict[str, str]] = None) -> str:
     """A self-contained bash script: env exports + cd + user command."""
     lines = ['#!/usr/bin/env bash', 'set -uo pipefail', '']
+    # The shipped runtime (runtime_setup.py REMOTE_PKG_DIR; local-style
+    # hosts get a symlink) -- makes `python3 -m skypilot_tpu.*` payloads
+    # (the in-tree recipes) importable on every cluster host.
+    lines.append('export PYTHONPATH="$HOME/.skyt_runtime/runtime'
+                 '${PYTHONPATH:+:$PYTHONPATH}"')
     for key, value in env.items():
         lines.append(f'export {key}={shlex.quote(str(value))}')
     for key, value in (secrets or {}).items():

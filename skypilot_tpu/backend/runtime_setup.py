@@ -284,6 +284,19 @@ def ensure_runtime(info: ClusterInfo,
         runtime_dir = head_runtime_dir(info)
         os.makedirs(runtime_dir, exist_ok=True)
         spec_lib.write_spec(runtime_dir, spec)
+        # "Ship" the runtime to each local host root as a symlink so job
+        # scripts find it at the uniform $HOME/.skyt_runtime/runtime
+        # location (same contract as _ship_runtime_to_host over SSH).
+        pkg_root = _package_root()
+        for host in spec.hosts:
+            root = os.path.expanduser(host.root or '~')
+            link_dir = os.path.join(root, '.skyt_runtime', 'runtime')
+            os.makedirs(link_dir, exist_ok=True)
+            link = os.path.join(link_dir, 'skypilot_tpu')
+            if os.path.lexists(link) and not os.path.exists(link):
+                os.remove(link)  # dangling symlink from a moved install
+            if not os.path.lexists(link):
+                os.symlink(pkg_root, link)
         daemon_lib.start_daemon(info.cluster_name, runtime_dir)
         return
 
