@@ -246,8 +246,16 @@ def forward(params: Params,
             cfg: ModelConfig,
             *,
             positions: Optional[jax.Array] = None,
-            rules: LogicalAxisRules = DEFAULT_RULES) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] fp32."""
+            rules: LogicalAxisRules = DEFAULT_RULES,
+            pipeline_stages: int = 1,
+            pipeline_microbatches: Optional[int] = None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] fp32.
+
+    ``pipeline_stages > 1`` runs the decoder stack as a microbatched
+    GPipe pipeline over the ``stage`` mesh axis (parallel/pipeline.py);
+    embedding and the LM head stay outside the pipelined region
+    (replicated work along ``stage``, sharded as usual on other axes).
+    """
     _, s = tokens.shape
     dt = cfg.compute_dtype
     if positions is None:
@@ -273,7 +281,31 @@ def forward(params: Params,
     def scan_body(carry, lp):
         return layer_fn(carry, lp), None
 
-    x, _ = jax.lax.scan(scan_body, x, params['layers'])
+    if pipeline_stages > 1:
+        from skypilot_tpu.parallel import pipeline
+        if positions is not None and positions.ndim > 1:
+            raise ValueError(
+                'per-example positions are not supported with '
+                'pipeline_stages > 1 (sin/cos are closed over at full '
+                'batch size but stages see microbatches); decode paths '
+                'with KV caches run unpipelined')
+
+        def stage_fn(stage_lp, xi):
+            out, _ = jax.lax.scan(scan_body, xi, stage_lp)
+            return out
+
+        stage_params = pipeline.stage_stack(
+            params['layers'], param_logical_axes(cfg)['layers'],
+            pipeline_stages, rules)
+        num_micro = (pipeline_microbatches or
+                     pipeline.default_num_microbatches(
+                         tokens.shape[0], pipeline_stages))
+        x = pipeline.pipeline_apply(stage_params, x, stage_fn,
+                                    n_stages=pipeline_stages,
+                                    num_microbatches=num_micro,
+                                    rules=rules)
+    else:
+        x, _ = jax.lax.scan(scan_body, x, params['layers'])
     x = rms_norm(x, params['final_norm']['scale'], cfg.norm_eps)
     if cfg.tie_embeddings:
         head = params['embed']['embedding'].astype(dt).T

@@ -1,0 +1,146 @@
+"""GPipe-style pipeline parallelism over the ``stage`` mesh axis.
+
+TPU-first design: instead of a hand-scheduled per-stage program (the
+reference ships PP only inside GPU payloads -- DeepSpeed configs in
+``examples/deepspeed-multinode/sky.yaml``; SURVEY §2.9 makes a native
+pipelined train step a rebuild deliverable), the pipeline is expressed as
+ordinary sharded array ops and GSPMD partitions it:
+
+* layer params reshape to ``[n_stages, layers_per_stage, ...]`` with the
+  leading dim sharded over ``stage`` -- a free, local reshape because the
+  ``layers -> stage`` rule already shards the stacked-layer dim;
+* each schedule tick applies every stage's layers at once as a ``vmap``
+  over that leading dim -- XLA partitions the vmapped computation across
+  the stage devices with zero communication;
+* the stage->stage activation handoff is a ``jnp.roll`` on a
+  stage-sharded buffer -- XLA lowers it to a CollectivePermute riding
+  ICI (or DCN when the stage axis spans slices, the standard
+  pipeline-across-slices deployment);
+* reverse-mode autodiff through the schedule yields the backward
+  pipeline automatically (the transpose of a roll is the opposite roll).
+
+The schedule is plain GPipe: ``num_microbatches + n_stages - 1`` ticks,
+bubble fraction ``(S-1)/(M+S-1)``. Combined with ``jax.checkpoint`` on
+the layer body (remat), the peak-memory profile matches the standard
+microbatched pipeline.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.parallel.sharding import (DEFAULT_RULES, LogicalAxisRules,
+                                            with_spec_constraint)
+
+Params = Dict[str, Any]
+
+
+def stage_stack(layers_params: Params, layer_axes: Params, n_stages: int,
+                rules: LogicalAxisRules = DEFAULT_RULES) -> Params:
+    """[L, ...] stacked-layer leaves -> [n_stages, L/n_stages, ...].
+
+    The first logical axis of every layer leaf is ``layers`` (sharded over
+    ``stage``); after the reshape the constraint pins the new leading dim
+    to ``stage`` and replicates the per-stage layer dim, so the reshape is
+    a local view change on every device -- no data movement.
+    """
+
+    def is_leaf(x):
+        return isinstance(x, tuple)
+
+    def reshape(p, axes):
+        n_layers = p.shape[0]
+        if n_layers % n_stages:
+            raise ValueError(
+                f'n_layers={n_layers} not divisible by pipeline '
+                f'stages={n_stages}')
+        stacked = p.reshape(n_stages, n_layers // n_stages, *p.shape[1:])
+        full = rules.spec(axes)
+        spec = P(full[0], None, *list(full)[1:])
+        return with_spec_constraint(stacked, spec)
+
+    return _tree_map_with_axes(reshape, layers_params, layer_axes, is_leaf)
+
+
+def _tree_map_with_axes(fn, params, axes_tree, axes_is_leaf):
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_a = jax.tree_util.tree_flatten(axes_tree, is_leaf=axes_is_leaf)[0]
+    assert len(flat_p) == len(flat_a), (len(flat_p), len(flat_a))
+    return jax.tree_util.tree_unflatten(
+        treedef, [fn(p, a) for p, a in zip(flat_p, flat_a)])
+
+
+def pipeline_apply(stage_params: Params,
+                   x: jax.Array,
+                   stage_fn: Callable[[Params, jax.Array], jax.Array],
+                   *,
+                   n_stages: int,
+                   num_microbatches: int,
+                   act_logical_axes: Sequence = ('batch', 'act_seq',
+                                                 'act_embed'),
+                   rules: LogicalAxisRules = DEFAULT_RULES) -> jax.Array:
+    """Run ``stage_fn`` over all stages as a microbatched pipeline.
+
+    ``stage_params``: pytree with leading dims [n_stages, ...] (from
+    ``stage_stack``). ``x``: [B, ...] activations entering stage 0.
+    ``stage_fn(params_for_one_stage, microbatch)`` applies one stage's
+    layers. Returns the full-batch activations after the last stage.
+    """
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f'batch={batch} not divisible by '
+                         f'num_microbatches={num_microbatches}')
+    mb = batch // num_microbatches
+
+    act_spec = rules.spec(act_logical_axes)
+    micro_spec = P(None, *act_spec)               # [M, mb, ...]
+    state_spec = P('stage', *list(act_spec))      # [n_stages, mb, ...]
+
+    x_micro = x.reshape(num_microbatches, mb, *x.shape[1:])
+    x_micro = with_spec_constraint(x_micro, micro_spec)
+
+    state = jnp.zeros((n_stages, mb) + x.shape[1:], x.dtype)
+    state = with_spec_constraint(state, state_spec)
+    outputs = jnp.zeros_like(x_micro)
+
+    vmapped = jax.vmap(stage_fn)
+    total_ticks = num_microbatches + n_stages - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage s receives stage s-1's previous output; stage 0 receives
+        # the next microbatch (clamped index: past the last microbatch the
+        # fed value is junk that never reaches a collected output).
+        inp = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.minimum(t, num_microbatches - 1), 0,
+            keepdims=False)
+        shifted = jnp.roll(state, 1, axis=0)      # CollectivePermute
+        state_in = shifted.at[0].set(inp)
+        state_in = with_spec_constraint(state_in, state_spec)
+        out = vmapped(stage_params, state_in)
+        out = with_spec_constraint(out, state_spec)
+        # Collect the last stage's emission. Before the pipeline fills
+        # (t < n_stages-1) the clamped write lands in row 0, which is
+        # overwritten with the real microbatch-0 output at t=n_stages-1.
+        write_idx = jnp.maximum(t - (n_stages - 1), 0)
+        outputs2 = jax.lax.dynamic_update_index_in_dim(
+            outputs, out[-1], write_idx, 0)
+        return (out, outputs2), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                   jnp.arange(total_ticks))
+    outputs = with_spec_constraint(outputs, micro_spec)
+    return outputs.reshape(batch, *x.shape[1:])
+
+
+def default_num_microbatches(batch: int, n_stages: int) -> int:
+    """Largest M <= 2*n_stages dividing batch (2x stages keeps the GPipe
+    bubble <= 1/3; more microbatches shrink it further but also shrink
+    per-tick matmuls below MXU-efficient sizes)."""
+    for m in range(min(2 * n_stages, batch), 0, -1):
+        if batch % m == 0:
+            return m
+    return 1

@@ -131,6 +131,16 @@ def with_logical_constraint(x: jax.Array,
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def with_spec_constraint(x: jax.Array, spec: P) -> jax.Array:
+    """`with_sharding_constraint` with an explicit PartitionSpec against the
+    ambient mesh (used where the spec is built structurally rather than from
+    logical axis names, e.g. the pipeline stage buffers)."""
+    mesh = _abstract_or_ambient_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def _abstract_or_ambient_mesh() -> Optional[Mesh]:
     try:
         mesh = jax.sharding.get_abstract_mesh()

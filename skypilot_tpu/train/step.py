@@ -36,6 +36,9 @@ class TrainHParams:
     b2: float = 0.95
     grad_clip_norm: float = 1.0
     z_loss_coeff: float = 1e-4
+    # Pipeline microbatch count when the mesh has a stage axis > 1; None =
+    # largest divisor of batch <= 2*stages (parallel/pipeline.py).
+    pipeline_microbatches: Optional[int] = None
     # 'adamw' (2 fp32 moments/param) or 'adafactor' (factored second
     # moment, ~O(rows+cols) state -- the HBM-frugal choice that lets a
     # ~1.7B model train on one 16GB v5e chip; standard TPU practice).
@@ -141,12 +144,16 @@ def train_step_fn(state: TrainState,
                   cfg: ModelConfig,
                   optimizer: optax.GradientTransformation,
                   hp: TrainHParams,
-                  rules: LogicalAxisRules = DEFAULT_RULES
+                  rules: LogicalAxisRules = DEFAULT_RULES,
+                  pipeline_stages: int = 1
                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     """One SGD step. batch: tokens [B,S], targets [B,S], weights [B,S]."""
 
     def loss_fn(params):
-        logits = llama.forward(params, batch['tokens'], cfg, rules=rules)
+        logits = llama.forward(
+            params, batch['tokens'], cfg, rules=rules,
+            pipeline_stages=pipeline_stages,
+            pipeline_microbatches=hp.pipeline_microbatches)
         loss, _ = cross_entropy_loss(logits, batch['targets'],
                                      batch.get('weights'),
                                      z_loss_coeff=hp.z_loss_coeff)
@@ -181,7 +188,8 @@ def make_train_step(cfg: ModelConfig,
         shardings = state_shardings(mesh, cfg, hp, rules)
 
     step = functools.partial(train_step_fn, cfg=cfg, optimizer=optimizer,
-                             hp=hp, rules=rules)
+                             hp=hp, rules=rules,
+                             pipeline_stages=mesh.shape.get('stage', 1))
     jitted = jax.jit(
         step,
         in_shardings=(shardings, batch_sharding),

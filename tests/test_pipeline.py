@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe schedule over the `stage` mesh axis.
+
+Parity bar: SURVEY §2.9 PP row -- the reference ships PP only inside GPU
+payloads (examples/deepspeed-multinode/sky.yaml); here it is a native
+train-step capability, validated on the virtual 8-device CPU mesh
+(conftest forces --xla_force_host_platform_device_count=8).
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.models.config import get_model_config
+from skypilot_tpu.parallel import pipeline
+from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+from skypilot_tpu.train.step import (TrainHParams, create_train_state,
+                                     make_train_step, state_shardings)
+
+
+def _train_losses(stage: int, n_steps: int = 3, tensor: int = 1,
+                  microbatches=None, model: str = 'tiny', **cfg_kwargs):
+    cfg = get_model_config(model, attention_impl='xla', **cfg_kwargs)
+    hp = TrainHParams(warmup_steps=1, total_steps=4,
+                      pipeline_microbatches=microbatches)
+    mesh = build_mesh(MeshConfig(data=1, stage=stage, fsdp=-1,
+                                 tensor=tensor))
+    sh = state_shardings(mesh, cfg, hp)
+    state = create_train_state(jax.random.key(0), cfg, hp, mesh,
+                               shardings=sh)
+    step = make_train_step(cfg, hp, mesh, shardings=sh)
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                cfg.vocab_size)
+    batch = {'tokens': tokens, 'targets': jnp.roll(tokens, -1, axis=1),
+             'weights': jnp.ones((8, 32), jnp.float32)}
+    losses = []
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics['loss']))
+    return losses
+
+
+def test_stage2_loss_parity_with_stage1():
+    """The one VERDICT acceptance: stage>=2 matches stage=1 numerics."""
+    base = _train_losses(stage=1)
+    piped = _train_losses(stage=2)
+    assert all(jnp.isfinite(jnp.asarray(piped)))
+    assert abs(base[-1] - piped[-1]) < 2e-3, (base, piped)
+
+
+def test_stage4_with_tensor_parallel():
+    losses = _train_losses(stage=4, tensor=2, n_layers=4)
+    assert losses[-1] < losses[0]  # actually learning, not just running
+
+
+def test_explicit_microbatch_count():
+    base = _train_losses(stage=1)
+    piped = _train_losses(stage=2, microbatches=8)  # mb=1 each
+    assert abs(base[-1] - piped[-1]) < 2e-3
+
+
+def test_stage_stack_rejects_indivisible_layers():
+    cfg = get_model_config('tiny')  # tiny has a small even layer count
+    from skypilot_tpu.models import llama
+    params = llama.init_params(jax.random.key(0), cfg)
+    axes = llama.param_logical_axes(cfg)
+    with pytest.raises(ValueError, match='not divisible'):
+        pipeline.stage_stack(params['layers'], axes['layers'],
+                             cfg.n_layers + 1)
+
+
+def test_pipeline_apply_rejects_indivisible_batch():
+    with pytest.raises(ValueError, match='not divisible'):
+        pipeline.pipeline_apply(
+            {}, jnp.zeros((5, 4)), lambda p, x: x,
+            n_stages=2, num_microbatches=2)
+
+
+def test_default_num_microbatches():
+    assert pipeline.default_num_microbatches(8, 2) == 4
+    assert pipeline.default_num_microbatches(8, 4) == 8
+    assert pipeline.default_num_microbatches(6, 2) == 3
+    assert pipeline.default_num_microbatches(7, 4) == 7
+    assert pipeline.default_num_microbatches(1, 4) == 1
