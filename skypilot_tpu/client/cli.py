@@ -392,6 +392,70 @@ def recipes_show(name: str) -> None:
         click.echo(f.read())
 
 
+@cli.group()
+def users() -> None:
+    """User + token administration (parity: the reference's users/RBAC
+    surface, sky/users/). Goes through the API server so auth/RBAC
+    apply; bootstrap the first admin with the operator's static
+    SKYT_API_SERVER_TOKEN, or --local on the server host itself."""
+
+
+_LOCAL_HELP = 'Operate on the local users DB directly (server-host bootstrap).'
+
+
+@users.command('list')
+def users_list() -> None:
+    from skypilot_tpu.client import sdk
+    _echo_table(sdk.users_list(), ['name', 'role', 'created_at'])
+
+
+@users.command('create')
+@click.argument('name')
+@click.option('--role', default='user', type=click.Choice(['admin', 'user']))
+@click.option('--local', is_flag=True, default=False, help=_LOCAL_HELP)
+def users_create(name: str, role: str, local: bool) -> None:
+    if local:
+        from skypilot_tpu.users import users_db
+        record = users_db.create_user(name, role).to_dict()
+    else:
+        from skypilot_tpu.client import sdk
+        record = sdk.users_create(name, role)
+    click.echo(f"created user {record['name']} (role {record['role']})")
+
+
+@users.command('delete')
+@click.argument('name')
+def users_delete(name: str) -> None:
+    from skypilot_tpu.client import sdk
+    sdk.users_delete(name)
+    click.echo(f'deleted user {name}')
+
+
+@users.command('set-role')
+@click.argument('name')
+@click.argument('role', type=click.Choice(['admin', 'user']))
+def users_set_role(name: str, role: str) -> None:
+    from skypilot_tpu.client import sdk
+    sdk.users_set_role(name, role)
+    click.echo(f'user {name} role -> {role}')
+
+
+@users.command('token')
+@click.argument('name', required=False, default=None)
+@click.option('--label', default='')
+@click.option('--local', is_flag=True, default=False, help=_LOCAL_HELP)
+def users_token(name: Optional[str], label: str, local: bool) -> None:
+    """Mint a bearer token (printed once; store it securely)."""
+    if local:
+        from skypilot_tpu.users import users_db
+        if name is None:
+            raise click.UsageError('NAME is required with --local')
+        click.echo(users_db.create_token(name, label))
+    else:
+        from skypilot_tpu.client import sdk
+        click.echo(sdk.users_token(name, label))
+
+
 def main() -> None:
     try:
         cli()

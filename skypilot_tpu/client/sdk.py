@@ -44,6 +44,16 @@ def api_server_url() -> str:
     return f'http://127.0.0.1:{DEFAULT_PORT}'
 
 
+def _auth_headers() -> Dict[str, str]:
+    """Bearer token from env/config (parity: the reference reads service
+    account tokens from SKYPILOT_SERVICE_ACCOUNT_TOKEN / ~/.sky config)."""
+    token = os.environ.get('SKYT_API_TOKEN')
+    if not token:
+        from skypilot_tpu import config
+        token = config.get_nested(('api_server', 'token'), None)
+    return {'Authorization': f'Bearer {token}'} if token else {}
+
+
 def api_is_healthy(url: Optional[str] = None) -> bool:
     try:
         resp = requests_lib.get(f'{url or api_server_url()}/api/health',
@@ -94,7 +104,8 @@ def api_stop() -> bool:
 
 def _post(route: str, body: Dict[str, Any]) -> RequestId:
     url = ensure_api_server()
-    resp = requests_lib.post(f'{url}/{route}', json=body, timeout=30)
+    resp = requests_lib.post(f'{url}/{route}', json=body, timeout=30,
+                             headers=_auth_headers())
     payload = resp.json()
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
@@ -115,7 +126,7 @@ def get(request_id: str, timeout: Optional[float] = None) -> Any:
         resp = requests_lib.get(
             f'{url}/api/get',
             params={'request_id': request_id, 'timeout': 15},
-            timeout=60)
+            timeout=60, headers=_auth_headers())
         if resp.status_code == 404:
             raise exceptions.RequestDoesNotExist(
                 f'No request {request_id!r}.')
@@ -148,7 +159,12 @@ def stream_and_get(request_id: str,
     output = output or sys.stdout
     with requests_lib.get(f'{url}/api/stream',
                           params={'request_id': request_id},
-                          stream=True, timeout=None) as resp:
+                          stream=True, timeout=None,
+                          headers=_auth_headers()) as resp:
+        if resp.status_code != 200:
+            raise exceptions.ApiServerError(
+                f'stream failed: HTTP {resp.status_code}: '
+                f'{resp.text[:500]}')
         for chunk in resp.iter_content(chunk_size=None):
             output.write(chunk.decode('utf-8', errors='replace'))
             if hasattr(output, 'flush'):
@@ -159,20 +175,72 @@ def stream_and_get(request_id: str,
 def api_cancel(request_id: str) -> bool:
     url = ensure_api_server()
     resp = requests_lib.post(f'{url}/api/cancel',
-                             json={'request_id': request_id}, timeout=30)
-    return bool(resp.json().get('cancelled'))
+                             json={'request_id': request_id}, timeout=30,
+                             headers=_auth_headers())
+    payload = resp.json()
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return bool(payload.get('cancelled'))
 
 
 def api_status(status: Optional[str] = None) -> List[Dict[str, Any]]:
     url = ensure_api_server()
     params = {'status': status} if status else {}
     resp = requests_lib.get(f'{url}/api/requests', params=params,
-                            timeout=30)
+                            timeout=30, headers=_auth_headers())
     payload = resp.json()
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
             payload.get('error', f'HTTP {resp.status_code}'))
     return payload
+
+
+# -- user administration (server-side, auth/RBAC enforced) -------------
+
+
+def _users_request(method: str, route: str,
+                   body: Optional[Dict[str, Any]] = None) -> Any:
+    """Users routes go through the SERVER so rbac gates apply (a local
+    sqlite write would bypass auth and target the wrong DB on remote
+    deployments)."""
+    url = ensure_api_server()
+    if method == 'GET':
+        resp = requests_lib.get(f'{url}{route}', timeout=30,
+                                headers=_auth_headers())
+    else:
+        resp = requests_lib.post(f'{url}{route}', json=body or {},
+                                 timeout=30, headers=_auth_headers())
+    payload = resp.json()
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return payload
+
+
+def users_list() -> List[Dict[str, Any]]:
+    return _users_request('GET', '/api/users')
+
+
+def users_create(name: str, role: str = 'user') -> Dict[str, Any]:
+    return _users_request('POST', '/api/users/create',
+                          {'name': name, 'role': role})
+
+
+def users_delete(name: str) -> Dict[str, Any]:
+    return _users_request('POST', '/api/users/delete', {'name': name})
+
+
+def users_set_role(name: str, role: str) -> Dict[str, Any]:
+    return _users_request('POST', '/api/users/set-role',
+                          {'name': name, 'role': role})
+
+
+def users_token(name: Optional[str] = None, label: str = '') -> str:
+    body: Dict[str, Any] = {'label': label}
+    if name:
+        body['name'] = name
+    return _users_request('POST', '/api/users/token', body)['token']
 
 
 # -- workdir upload ----------------------------------------------------
@@ -196,7 +264,7 @@ def _upload_workdir(task_config: Dict[str, Any]) -> Dict[str, Any]:
         tar.add(src, arcname='.', filter=_exclude_git_dir)
     url = ensure_api_server()
     resp = requests_lib.post(f'{url}/upload', data=buf.getvalue(),
-                             timeout=600)
+                             timeout=600, headers=_auth_headers())
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
             f'workdir upload failed: {resp.text}')

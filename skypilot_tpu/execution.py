@@ -85,12 +85,15 @@ def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
                   down: bool, detach_run: bool,
                   provision_blocklist=None,
                   ) -> Tuple[str, Optional[int]]:
+    from skypilot_tpu.utils import timeline
     if Stage.OPTIMIZE in stages and task.best_resources is None:
-        Optimizer.optimize(Dag.from_task(task))
+        with timeline.Event('optimize', cluster=cluster_name):
+            Optimizer.optimize(Dag.from_task(task))
     info = None
     if Stage.PROVISION in stages:
-        info = backend.provision(task, cluster_name, dryrun=dryrun,
-                                 blocklist=provision_blocklist)
+        with timeline.Event('provision', cluster=cluster_name):
+            info = backend.provision(task, cluster_name, dryrun=dryrun,
+                                     blocklist=provision_blocklist)
         if dryrun:
             return cluster_name, None
     if info is None:
@@ -101,11 +104,14 @@ def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
         from skypilot_tpu.provision.api import ClusterInfo
         info = ClusterInfo.from_dict(record.handle)
     if Stage.SYNC_WORKDIR in stages:
-        backend.sync_workdir(info, task)
+        with timeline.Event('sync_workdir', cluster=cluster_name):
+            backend.sync_workdir(info, task)
     if Stage.SYNC_FILE_MOUNTS in stages:
-        backend.sync_file_mounts(info, task)
+        with timeline.Event('sync_file_mounts', cluster=cluster_name):
+            backend.sync_file_mounts(info, task)
     if Stage.SETUP in stages:
-        backend.setup(info, task)
+        with timeline.Event('setup', cluster=cluster_name):
+            backend.setup(info, task)
     job_id = None
     detach = detach_run or not stream_logs
     if Stage.EXEC in stages and task.run is not None:

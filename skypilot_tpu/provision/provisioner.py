@@ -9,6 +9,7 @@ queued-resource timeouts count as capacity errors.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Set, Tuple
 
 from skypilot_tpu import exceptions, state
@@ -95,10 +96,16 @@ def provision_with_failover(
         logger.info('Provisioning %s on %s (%s)...', cluster_name, where,
                     res)
         state.add_cluster_event(cluster_name, 'PROVISION_ATTEMPT', where)
+        attempt_start = time.time()
         try:
             info = provider.run_instances(request)
             provider.wait_instances(cluster_name, 'running')
             state.add_cluster_event(cluster_name, 'PROVISION_OK', where)
+            # Durable latency sample: /api/metrics builds the
+            # skyt_provision_seconds histogram (the BASELINE p50
+            # orchestration metric) from these events.
+            state.add_cluster_event(cluster_name, 'PROVISION_DONE',
+                                    f'{time.time() - attempt_start:.3f}')
             return info, candidate
         except exceptions.ProvisionError as e:
             logger.warning('Provision failed on %s: %s', where, e)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import hmac
 import io
 import json
 import os
@@ -28,11 +29,26 @@ import skypilot_tpu
 from skypilot_tpu.server import executor as executor_lib
 from skypilot_tpu.server import payloads, requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
+from skypilot_tpu.users import rbac, users_db
 from skypilot_tpu.utils import log
 
 logger = log.init_logger(__name__)
 
 DEFAULT_PORT = 46590
+
+# Routes reachable without a bearer token even when auth is on (parity:
+# sky/server/server.py exempts /api/health from the auth middlewares;
+# /api/metrics is scraped by Prometheus which typically has no user token,
+# matching the reference's separate unauthenticated metrics port).
+_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics'})
+
+
+def _auth_enabled() -> bool:
+    """Token auth is on when configured OR a static env token is set."""
+    if os.environ.get('SKYT_API_SERVER_TOKEN'):
+        return True
+    from skypilot_tpu import config
+    return bool(config.get_nested(('api_server', 'auth'), False))
 
 
 def _uploads_dir() -> str:
@@ -76,31 +92,104 @@ class ApiHandler(BaseHTTPRequestHandler):
     def _route(self) -> str:
         return urllib.parse.urlparse(self.path).path.rstrip('/')
 
+    # -- auth (parity: server.py:391 bearer-token middleware) ----------
+
+    def _authenticate(self) -> Tuple[bool, Optional[users_db.UserRecord]]:
+        """(authorized, user). user=None means auth is off (single-user
+        deployment -- everything allowed, like the reference with no auth
+        middleware installed)."""
+        if self._route in _AUTH_EXEMPT or not _auth_enabled():
+            return True, None
+        header = self.headers.get('Authorization', '')
+        if not header.startswith('Bearer '):
+            return False, None
+        token = header[len('Bearer '):].strip()
+        static = os.environ.get('SKYT_API_SERVER_TOKEN')
+        if static and hmac.compare_digest(token, static):
+            # The operator's deployment token acts as a built-in admin.
+            return True, users_db.UserRecord(name='operator', role='admin',
+                                             created_at=0.0)
+        user = users_db.authenticate(token)
+        if user is None:
+            return False, None
+        return True, user
+
+    def _deny(self) -> None:
+        self.send_response(HTTPStatus.UNAUTHORIZED)
+        body = json.dumps({'error': 'authentication required'}).encode()
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('WWW-Authenticate', 'Bearer')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     # -- POST: payload submission + control ----------------------------
 
     def do_POST(self) -> None:  # noqa: N802
         route = self._route
         try:
+            authorized, user = self._authenticate()
+            if not authorized:
+                self._deny()
+                return
             if route == '/api/cancel':
                 body = self._json_body()
                 ok = executor_lib.cancel_request(body['request_id'])
                 self._reply({'cancelled': ok})
             elif route == '/upload':
                 self._handle_upload()
+            elif route.startswith('/api/users'):
+                self._handle_users_post(route, user)
             elif route.lstrip('/') in payloads.PAYLOADS:
                 name = route.lstrip('/')
                 body = self._json_body()
                 _, schedule_type = payloads.PAYLOADS[name]
                 request_id = requests_db.create(
                     name, body, schedule_type,
-                    user=self.headers.get('X-Skyt-User'))
+                    user=(user.name if user else
+                          self.headers.get('X-Skyt-User')))
                 self._reply({'request_id': request_id})
             else:
                 self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
+        except PermissionError as e:
+            self._error(HTTPStatus.FORBIDDEN, str(e))
+        except (ValueError, KeyError) as e:
+            # User errors (duplicate user, unknown role, missing field)
+            # are the client's fault, not a server fault.
+            self._error(HTTPStatus.BAD_REQUEST, f'{type(e).__name__}: {e}')
         except Exception as e:  # pylint: disable=broad-except
             logger.exception('POST %s failed', route)
             self._error(HTTPStatus.INTERNAL_SERVER_ERROR,
                         f'{type(e).__name__}: {e}')
+
+    def _handle_users_post(self, route: str,
+                           user: Optional[users_db.UserRecord]) -> None:
+        """User administration (parity: sky/users/server.py routes)."""
+        body = self._json_body()
+        if route == '/api/users/create':
+            rbac.require_permission(user, 'users.create')
+            record = users_db.create_user(body['name'],
+                                          body.get('role', 'user'))
+            self._reply(record.to_dict())
+        elif route == '/api/users/delete':
+            rbac.require_permission(user, 'users.delete')
+            users_db.delete_user(body['name'])
+            self._reply({'deleted': body['name']})
+        elif route == '/api/users/set-role':
+            rbac.require_permission(user, 'users.set_role')
+            users_db.set_role(body['name'], body['role'])
+            self._reply({'name': body['name'], 'role': body['role']})
+        elif route == '/api/users/token':
+            # A user may mint tokens for themself; admins for anyone.
+            target = body.get('name') or (user.name if user else None)
+            if target is None:
+                raise ValueError('name required when auth is disabled')
+            if user is not None and target != user.name:
+                rbac.require_permission(user, 'users.token.other')
+            token = users_db.create_token(target, body.get('label', ''))
+            self._reply({'token': token, 'name': target})
+        else:
+            self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
 
     def _handle_upload(self) -> None:
         """Chunked workdir upload: gzipped tar body, content-addressed
@@ -127,11 +216,26 @@ class ApiHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802
         route = self._route
         try:
+            authorized, _user = self._authenticate()
+            if not authorized:
+                self._deny()
+                return
             if route == '/api/health':
                 self._reply({
                     'status': 'healthy',
                     'version': skypilot_tpu.__version__,
                 })
+            elif route == '/api/users':
+                self._reply([u.to_dict() for u in users_db.list_users()])
+            elif route == '/api/metrics':
+                from skypilot_tpu.server import metrics
+                body = metrics.render_text().encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'text/plain; version=0.0.4')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif route == '/api/get':
                 self._handle_get()
             elif route == '/api/stream':
@@ -218,6 +322,17 @@ class ApiServer:
         self.httpd.daemon_threads = True
         self.executor = executor_lib.Executor()
         self.port = self.httpd.server_address[1]
+        self.daemons: list = []
+
+    def _start_daemons(self) -> None:
+        """Background reconcile loops (parity: server/daemons.py:84).
+        Config `api_server.daemons_enabled: false` disables them (used by
+        tests that need deterministic provider interactions)."""
+        from skypilot_tpu import config
+        from skypilot_tpu.server import daemons as daemons_lib
+        if not config.get_nested(('api_server', 'daemons_enabled'), True):
+            return
+        self.daemons = daemons_lib.start_all()
 
     @property
     def url(self) -> str:
@@ -227,12 +342,14 @@ class ApiServer:
     def start_background(self) -> None:
         import threading
         self.executor.start()
+        self._start_daemons()
         thread = threading.Thread(target=self.httpd.serve_forever,
                                   name='api-server', daemon=True)
         thread.start()
 
     def serve_forever(self) -> None:
         self.executor.start()
+        self._start_daemons()
         logger.info('API server listening on %s', self.url)
         try:
             self.httpd.serve_forever()
@@ -242,6 +359,8 @@ class ApiServer:
     def shutdown(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        for d in self.daemons:
+            d.stop()
         self.executor.shutdown()
 
 

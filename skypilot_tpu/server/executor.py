@@ -70,6 +70,10 @@ def _run_request_in_child(request_id: str) -> None:
         requests_db.finalize(request_id, RequestStatus.FAILED,
                              error=f'{type(e).__name__}: {e}')
     finally:
+        # multiprocessing children exit via os._exit (no atexit): flush
+        # any buffered timeline spans explicitly or they are lost.
+        from skypilot_tpu.utils import timeline
+        timeline.save()
         log_file.flush()
 
 

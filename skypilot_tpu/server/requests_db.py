@@ -12,7 +12,7 @@ import os
 import sqlite3
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu.utils import common_utils
 
@@ -204,6 +204,25 @@ def finalize(request_id: str,
          RequestStatus.RUNNING.value))
     conn.commit()
     return cur.rowcount == 1
+
+
+def count_by_name_status() -> List[Tuple[str, str, int]]:
+    """(payload name, status, count) aggregates for /api/metrics."""
+    rows = _db().execute(
+        'SELECT name, status, COUNT(*) AS n FROM requests '
+        'GROUP BY name, status').fetchall()
+    return [(r['name'], r['status'], r['n']) for r in rows]
+
+
+def pending_depth_by_queue() -> Dict[str, int]:
+    """PENDING backlog per schedule queue for /api/metrics."""
+    rows = _db().execute(
+        'SELECT schedule_type, COUNT(*) AS n FROM requests '
+        'WHERE status = ? GROUP BY schedule_type',
+        (RequestStatus.PENDING.value,)).fetchall()
+    out = {t.value: 0 for t in ScheduleType}
+    out.update({r['schedule_type']: r['n'] for r in rows})
+    return out
 
 
 def reset_db_for_tests() -> None:

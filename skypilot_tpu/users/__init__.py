@@ -1,0 +1,19 @@
+"""Users, service-account tokens, and role-based access control.
+
+Parity: ``sky/users/`` (permission.py:44 PermissionService casbin
+enforcer, rbac.py roles, token_service.py). Rebuilt small: a sqlite users
+table with salted-hash bearer tokens and a two-role model
+(admin/user) enforced in the API server -- no casbin, the policy matrix
+is a dict.
+"""
+from skypilot_tpu.users.users_db import (ROLE_ADMIN, ROLE_USER, UserRecord,
+                                         authenticate, create_token,
+                                         create_user, delete_user, get_user,
+                                         list_users, set_role)
+from skypilot_tpu.users.rbac import check_permission
+
+__all__ = [
+    'ROLE_ADMIN', 'ROLE_USER', 'UserRecord', 'authenticate', 'check_permission',
+    'create_token', 'create_user', 'delete_user', 'get_user', 'list_users',
+    'set_role',
+]

@@ -1,0 +1,40 @@
+"""Role-based access control: a static permission matrix.
+
+Parity: ``sky/users/permission.py:44`` (casbin enforcer over role->route
+policies) and ``sky/users/rbac.py`` (role definitions). The rebuild keeps
+the same two built-in roles and encodes the policy as data. Scope today:
+user administration is admin-gated; payload routes (launch/serve/...) and
+reads are open to ANY authenticated user (same default as the reference's
+rbac.get_default_user_blocklist -- only user/workspace admin is blocked).
+Workspace actions are listed here and enforced by the workspaces module.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu.users.users_db import ROLE_ADMIN, ROLE_USER, UserRecord
+
+# Actions a plain (non-admin) user may NOT perform.
+_ADMIN_ONLY = frozenset({
+    'users.create', 'users.delete', 'users.set_role', 'users.token.other',
+    'workspaces.create', 'workspaces.delete', 'workspaces.update',
+})
+
+
+def check_permission(user: Optional[UserRecord], action: str) -> bool:
+    """True when `user` may perform `action`.
+
+    ``None`` user means auth is disabled (single-user deployment): allow
+    everything, same as the reference with no auth middlewares installed.
+    """
+    if user is None:
+        return True
+    if user.role == ROLE_ADMIN:
+        return True
+    return action not in _ADMIN_ONLY
+
+
+def require_permission(user: Optional[UserRecord], action: str) -> None:
+    if not check_permission(user, action):
+        raise PermissionError(
+            f'user {user.name!r} (role {user.role}) may not {action}')

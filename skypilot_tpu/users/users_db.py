@@ -1,0 +1,180 @@
+"""User + service-account-token store (sqlite).
+
+Parity: ``sky/users/token_service.py`` (token mint/verify) and the users
+table of ``sky/global_user_state.py``. Tokens are ``skyt_<id>_<secret>``;
+only a salted SHA-256 of the secret is stored, verification is
+constant-time. A token authenticates as its owning user; roles gate
+mutating routes (rbac.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import os
+import secrets
+import sqlite3
+import threading
+import time
+from typing import List, Optional
+
+ROLE_ADMIN = 'admin'
+ROLE_USER = 'user'
+_ROLES = (ROLE_ADMIN, ROLE_USER)
+
+TOKEN_PREFIX = 'skyt'
+
+
+def _state_dir() -> str:
+    return os.environ.get('SKYT_STATE_DIR',
+                          os.path.expanduser('~/.skyt'))
+
+
+_local = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.join(_state_dir(), 'users.db')
+    conn = getattr(_local, 'conn', None)
+    if conn is not None and getattr(_local, 'path', None) == path:
+        return conn
+    os.makedirs(_state_dir(), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS users (
+            name TEXT PRIMARY KEY,
+            role TEXT NOT NULL,
+            created_at REAL NOT NULL
+        );
+        CREATE TABLE IF NOT EXISTS tokens (
+            token_id TEXT PRIMARY KEY,
+            user_name TEXT NOT NULL,
+            salt TEXT NOT NULL,
+            secret_hash TEXT NOT NULL,
+            label TEXT,
+            created_at REAL NOT NULL,
+            last_used_at REAL
+        );
+    """)
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    return conn
+
+
+@dataclasses.dataclass
+class UserRecord:
+    name: str
+    role: str
+    created_at: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def create_user(name: str, role: str = ROLE_USER) -> UserRecord:
+    if role not in _ROLES:
+        raise ValueError(f'unknown role {role!r} (expected one of {_ROLES})')
+    if not name or '/' in name:
+        raise ValueError(f'invalid user name {name!r}')
+    conn = _db()
+    now = time.time()
+    try:
+        conn.execute(
+            'INSERT INTO users (name, role, created_at) VALUES (?, ?, ?)',
+            (name, role, now))
+    except sqlite3.IntegrityError as e:
+        raise ValueError(f'user {name!r} already exists') from e
+    conn.commit()
+    return UserRecord(name=name, role=role, created_at=now)
+
+
+def get_user(name: str) -> Optional[UserRecord]:
+    row = _db().execute('SELECT * FROM users WHERE name = ?',
+                        (name,)).fetchone()
+    if row is None:
+        return None
+    return UserRecord(name=row['name'], role=row['role'],
+                      created_at=row['created_at'])
+
+
+def list_users() -> List[UserRecord]:
+    rows = _db().execute('SELECT * FROM users ORDER BY name').fetchall()
+    return [UserRecord(name=r['name'], role=r['role'],
+                       created_at=r['created_at']) for r in rows]
+
+
+def set_role(name: str, role: str) -> None:
+    if role not in _ROLES:
+        raise ValueError(f'unknown role {role!r}')
+    conn = _db()
+    cur = conn.execute('UPDATE users SET role = ? WHERE name = ?',
+                       (role, name))
+    if cur.rowcount == 0:
+        raise ValueError(f'no user {name!r}')
+    conn.commit()
+
+
+def delete_user(name: str) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM users WHERE name = ?', (name,))
+    conn.execute('DELETE FROM tokens WHERE user_name = ?', (name,))
+    conn.commit()
+
+
+def _hash(secret: str, salt: str) -> str:
+    return hashlib.sha256(f'{salt}:{secret}'.encode()).hexdigest()
+
+
+def create_token(user_name: str, label: str = '') -> str:
+    """Mint a bearer token for a user; the cleartext is returned ONCE."""
+    if get_user(user_name) is None:
+        raise ValueError(f'no user {user_name!r}')
+    token_id = secrets.token_hex(4)
+    secret = secrets.token_urlsafe(24)
+    salt = secrets.token_hex(8)
+    conn = _db()
+    conn.execute(
+        'INSERT INTO tokens (token_id, user_name, salt, secret_hash, label, '
+        'created_at) VALUES (?, ?, ?, ?, ?, ?)',
+        (token_id, user_name, salt, _hash(secret, salt), label, time.time()))
+    conn.commit()
+    return f'{TOKEN_PREFIX}_{token_id}_{secret}'
+
+
+def authenticate(token: str) -> Optional[UserRecord]:
+    """Token -> user, or None. Constant-time secret comparison."""
+    parts = token.split('_', 2)
+    if len(parts) != 3 or parts[0] != TOKEN_PREFIX:
+        return None
+    _, token_id, secret = parts
+    conn = _db()
+    row = conn.execute('SELECT * FROM tokens WHERE token_id = ?',
+                       (token_id,)).fetchone()
+    if row is None:
+        return None
+    if not hmac.compare_digest(_hash(secret, row['salt']),
+                               row['secret_hash']):
+        return None
+    conn.execute('UPDATE tokens SET last_used_at = ? WHERE token_id = ?',
+                 (time.time(), token_id))
+    conn.commit()
+    return get_user(row['user_name'])
+
+
+def list_tokens(user_name: Optional[str] = None) -> List[dict]:
+    q = 'SELECT token_id, user_name, label, created_at, last_used_at FROM tokens'
+    args: tuple = ()
+    if user_name:
+        q += ' WHERE user_name = ?'
+        args = (user_name,)
+    return [dict(r) for r in _db().execute(q, args).fetchall()]
+
+
+def revoke_token(token_id: str) -> bool:
+    conn = _db()
+    cur = conn.execute('DELETE FROM tokens WHERE token_id = ?', (token_id,))
+    conn.commit()
+    return cur.rowcount > 0

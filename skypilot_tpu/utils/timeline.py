@@ -1,0 +1,124 @@
+"""Chrome trace-event timeline for orchestration hot paths.
+
+Parity: ``sky/utils/timeline.py:23`` -- opt-in tracing written as Chrome
+``chrome://tracing`` / Perfetto JSON when ``SKYT_TIMELINE_FILE`` is set.
+``@timeline.event('name')`` decorates hot functions (launch / provision /
+sync / setup stages); ``with timeline.Event('name'):`` wraps ad-hoc
+spans. Events are buffered in-process and flushed on exit (and on every
+``save()``), one complete-event (ph='X') per span.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+_registered_atexit = False
+
+ENV_VAR = 'SKYT_TIMELINE_FILE'
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(ENV_VAR))
+
+
+class Event:
+    """Context manager recording one complete trace event."""
+
+    def __init__(self, name: str, **args: Any) -> None:
+        self._name = name
+        self._args = args
+        self._begin: Optional[float] = None
+
+    def __enter__(self) -> 'Event':
+        self._begin = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not enabled() or self._begin is None:
+            return
+        end = time.time()
+        record = {
+            'name': self._name,
+            'ph': 'X',                          # complete event
+            'ts': self._begin * 1e6,            # microseconds
+            'dur': (end - self._begin) * 1e6,
+            'pid': os.getpid(),
+            'tid': threading.get_ident() % 1_000_000,
+        }
+        if self._args:
+            record['args'] = {k: str(v) for k, v in self._args.items()}
+        global _registered_atexit
+        with _lock:
+            _events.append(record)
+            if not _registered_atexit:
+                atexit.register(save)
+                _registered_atexit = True
+
+
+def event(name_or_fn=None, **event_args):
+    """Decorator form: ``@timeline.event`` or ``@timeline.event('name')``."""
+
+    def wrap(fn: Callable, name: str):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if not enabled():
+                return fn(*args, **kwargs)
+            with Event(name, **event_args):
+                return fn(*args, **kwargs)
+        return inner
+
+    if callable(name_or_fn):
+        return wrap(name_or_fn, name_or_fn.__qualname__)
+
+    def deco(fn: Callable):
+        return wrap(fn, name_or_fn or fn.__qualname__)
+    return deco
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Flush buffered events as a Chrome trace JSON; returns the path."""
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    with _lock:
+        events = list(_events)
+    if not events:
+        return None
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    # Merge with an existing file so multi-process runs (executor forks)
+    # accumulate into one trace; the read-merge-replace is serialized
+    # with flock or two children flushing together would drop spans.
+    import fcntl
+    lock_path = path + '.lock'
+    with open(lock_path, 'w', encoding='utf-8') as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        existing: List[Dict[str, Any]] = []
+        if os.path.exists(path):
+            try:
+                with open(path, encoding='utf-8') as f:
+                    existing = json.load(f).get('traceEvents', [])
+            except (json.JSONDecodeError, OSError):
+                existing = []
+        seen = {(e['pid'], e['tid'], e['ts'], e['name'])
+                for e in existing}
+        merged = existing + [
+            e for e in events
+            if (e['pid'], e['tid'], e['ts'], e['name']) not in seen]
+        tmp = f'{path}.{os.getpid()}.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump({'traceEvents': merged, 'displayTimeUnit': 'ms'}, f)
+        os.replace(tmp, path)
+    return path
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

@@ -1,0 +1,276 @@
+"""Auth (bearer tokens, users, RBAC) + server background daemons.
+
+Parity bars: ``sky/server/server.py:195-591`` (auth middlewares),
+``sky/users/permission.py`` (RBAC), ``sky/server/daemons.py:84-240``
+(periodic cluster-status / managed-job reconciliation). VERDICT r1 #6
+acceptance: unauthenticated requests 401 when auth is on; a preempted
+fake cluster flips to INIT in state without anyone calling status.
+"""
+import os
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu import config, state
+from skypilot_tpu.client import sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.users import users_db
+
+
+def _write_user_config(text):
+    path = config.user_config_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(text)
+    config.reload()
+
+
+@pytest.fixture()
+def server(tmp_home, monkeypatch):
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+
+
+@pytest.fixture()
+def auth_server(tmp_home, monkeypatch):
+    """Server with bearer-token auth enabled via config."""
+    _write_user_config('api_server:\n  auth: true\n  daemons_enabled: false\n')
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+    config.reload()
+
+
+# -- users/tokens store ------------------------------------------------
+
+
+def test_user_and_token_lifecycle(tmp_home):
+    users_db.create_user('ada', role='admin')
+    users_db.create_user('bob')
+    assert [u.name for u in users_db.list_users()] == ['ada', 'bob']
+    token = users_db.create_token('bob', label='laptop')
+    assert token.startswith('skyt_')
+    user = users_db.authenticate(token)
+    assert user is not None and user.name == 'bob' and user.role == 'user'
+    # tampered token fails
+    assert users_db.authenticate(token[:-2] + 'xx') is None
+    assert users_db.authenticate('garbage') is None
+    # revoke kills it
+    token_id = token.split('_')[1]
+    assert users_db.revoke_token(token_id)
+    assert users_db.authenticate(token) is None
+
+
+def test_duplicate_user_rejected(tmp_home):
+    users_db.create_user('ada')
+    with pytest.raises(ValueError, match='already exists'):
+        users_db.create_user('ada')
+
+
+# -- server auth -------------------------------------------------------
+
+
+def test_unauthenticated_request_401(auth_server):
+    resp = requests_lib.get(f'{auth_server.url}/api/requests', timeout=10)
+    assert resp.status_code == 401
+    resp = requests_lib.post(f'{auth_server.url}/status', json={},
+                             timeout=10)
+    assert resp.status_code == 401
+
+
+def test_health_stays_open_with_auth(auth_server):
+    resp = requests_lib.get(f'{auth_server.url}/api/health', timeout=10)
+    assert resp.status_code == 200
+
+
+def test_valid_token_authenticates_and_attributes(auth_server, monkeypatch):
+    users_db.create_user('ada', role='admin')
+    token = users_db.create_token('ada')
+    headers = {'Authorization': f'Bearer {token}'}
+    resp = requests_lib.get(f'{auth_server.url}/api/requests',
+                            headers=headers, timeout=10)
+    assert resp.status_code == 200
+    # SDK path: env token; request is attributed to the token's user.
+    monkeypatch.setenv('SKYT_API_TOKEN', token)
+    request_id = sdk.status()
+    record = sdk.get(request_id)
+    reqs = sdk.api_status()
+    assert any(r['user'] == 'ada' for r in reqs)
+    assert record == []
+
+
+def test_bad_token_401(auth_server):
+    headers = {'Authorization': 'Bearer skyt_dead_beef'}
+    resp = requests_lib.get(f'{auth_server.url}/api/requests',
+                            headers=headers, timeout=10)
+    assert resp.status_code == 401
+
+
+def test_static_operator_token(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_API_SERVER_TOKEN', 'op-secret')
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    try:
+        r = requests_lib.get(f'{srv.url}/api/requests', timeout=10)
+        assert r.status_code == 401
+        r = requests_lib.get(
+            f'{srv.url}/api/requests',
+            headers={'Authorization': 'Bearer op-secret'}, timeout=10)
+        assert r.status_code == 200
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+
+
+# -- RBAC over user-admin routes ---------------------------------------
+
+
+def test_rbac_user_cannot_admin(auth_server):
+    users_db.create_user('ada', role='admin')
+    users_db.create_user('bob')
+    admin_tok = users_db.create_token('ada')
+    user_tok = users_db.create_token('bob')
+
+    def post(route, body, tok):
+        return requests_lib.post(
+            f'{auth_server.url}{route}', json=body,
+            headers={'Authorization': f'Bearer {tok}'}, timeout=10)
+
+    # plain user: cannot create users or mint tokens for others
+    assert post('/api/users/create', {'name': 'eve'},
+                user_tok).status_code == 403
+    assert post('/api/users/token', {'name': 'ada'},
+                user_tok).status_code == 403
+    # but can mint a token for themself
+    resp = post('/api/users/token', {}, user_tok)
+    assert resp.status_code == 200
+    assert users_db.authenticate(resp.json()['token']).name == 'bob'
+    # admin: can create users and change roles
+    assert post('/api/users/create', {'name': 'eve'},
+                admin_tok).status_code == 200
+    assert post('/api/users/set-role', {'name': 'eve', 'role': 'admin'},
+                admin_tok).status_code == 200
+    assert users_db.get_user('eve').role == 'admin'
+
+
+def test_duplicate_user_is_400_not_500(auth_server):
+    users_db.create_user('ada', role='admin')
+    tok = users_db.create_token('ada')
+    headers = {'Authorization': f'Bearer {tok}'}
+    r1 = requests_lib.post(f'{auth_server.url}/api/users/create',
+                           json={'name': 'eve'}, headers=headers, timeout=10)
+    assert r1.status_code == 200
+    r2 = requests_lib.post(f'{auth_server.url}/api/users/create',
+                           json={'name': 'eve'}, headers=headers, timeout=10)
+    assert r2.status_code == 400
+    assert 'already exists' in r2.json()['error']
+
+
+def test_sdk_users_roundtrip_with_operator_token(tmp_home, monkeypatch):
+    """CLI/SDK user admin goes through the server (RBAC applies), using
+    the static operator token to bootstrap."""
+    monkeypatch.setenv('SKYT_API_SERVER_TOKEN', 'op-secret')
+    monkeypatch.setenv('SKYT_API_TOKEN', 'op-secret')
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        sdk.users_create('ada', 'admin')
+        token = sdk.users_token('ada')
+        assert users_db.authenticate(token).name == 'ada'
+        names = [u['name'] for u in sdk.users_list()]
+        assert names == ['ada']
+        sdk.users_set_role('ada', 'user')
+        assert users_db.get_user('ada').role == 'user'
+        sdk.users_delete('ada')
+        assert sdk.users_list() == []
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+        fake.reset()
+
+
+# -- background daemons ------------------------------------------------
+
+
+def test_preempted_cluster_flips_to_init_without_status_call(
+        tmp_home, monkeypatch):
+    """The VERDICT acceptance: the cluster-status daemon notices
+    preemption on its own (parity: daemons.py:166)."""
+    _write_user_config('api_server:\n  cluster_refresh_interval: 0.2\n'
+                       '  jobs_refresh_interval: 60\n')
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        task = Task(name='t', run='echo hi',
+                    resources=Resources(cloud='fake',
+                                        accelerators='tpu-v5e-8'))
+        request_id = sdk.launch(task, cluster_name='dmn')
+        sdk.get(request_id)
+        assert state.get_cluster('dmn').status == state.ClusterStatus.UP
+        fake.preempt_cluster('dmn')
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            record = state.get_cluster('dmn')
+            if record.status == state.ClusterStatus.INIT:
+                break
+            time.sleep(0.1)
+        assert state.get_cluster('dmn').status == state.ClusterStatus.INIT
+        assert any(d.ticks > 0 for d in srv.daemons)
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+        fake.reset()
+        config.reload()
+
+
+def test_daemons_disabled_by_config(tmp_home):
+    _write_user_config('api_server:\n  daemons_enabled: false\n')
+    srv = ApiServer(port=0)
+    srv.start_background()
+    try:
+        assert srv.daemons == []
+    finally:
+        srv.shutdown()
+        config.reload()
+
+
+def test_daemon_survives_tick_errors(tmp_home):
+    from skypilot_tpu.server import daemons as daemons_lib
+    calls = []
+
+    def bad_tick():
+        calls.append(1)
+        raise RuntimeError('boom')
+
+    d = daemons_lib.Daemon('t', lambda: 0.05, bad_tick)
+    d.start()
+    time.sleep(0.4)
+    d.stop()
+    assert len(calls) >= 2
+    assert 'boom' in d.last_error
