@@ -1,10 +1,14 @@
-"""GCP TPU-VM provider: queued-resource gang provisioning over the TPU REST
-API.
+"""GCP provider: TPU-VM slices (queued resources) + GCE CPU instances.
 
 Parity targets: ``sky/provision/gcp/instance_utils.py:1258 GCPTPUVMInstance``
 (TPU-VM create/stop/terminate), :1491 (queued-resource create+wait),
 ``sky/clouds/gcp.py:600`` (queued resources opt-in -- here they are the
-*default* multi-host path, closing the SURVEY.md section 2.10 gap).
+*default* multi-host path, closing the SURVEY.md section 2.10 gap),
+``sky/provision/gcp/config.py`` (network/firewall/key bootstrap, compacted:
+default-VPC probe + skyt-managed firewall rules + generated SSH keypair
+injected via instance metadata instead of the reference's 1178-LoC
+IAM/VPC state machine), GCE CPU instances for cheap controller VMs
+(``instance_utils.py GCPComputeInstance``).
 
 Network calls go through `_request` so tests can stub the transport; the
 image is zero-egress, so live use requires a GCP environment (credentials
@@ -14,6 +18,8 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import shutil
 import subprocess
 import time
 from typing import Any, Dict, List, Optional
@@ -27,6 +33,10 @@ from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 logger = log.init_logger(__name__)
 
 TPU_API = 'https://tpu.googleapis.com/v2'
+COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+
+SSH_USER = 'skyt'
+_NOT_FOUND_MARKERS = ('404', 'not found', 'notfound')
 
 # Error substrings -> typed exceptions (parity: FailoverCloudErrorHandlerV2
 # _gcp_handler, cloud_vm_ray_backend.py:554).
@@ -77,6 +87,35 @@ def _access_token() -> str:
     return out.stdout.strip()
 
 
+# ---------------------------------------------------------------------------
+# SSH keypair management (parity: the reference wires OS Login / metadata
+# keys through gcp-ray.yml.j2; here a skyt-managed keypair is generated
+# once and its public half is injected into node metadata at create time)
+# ---------------------------------------------------------------------------
+
+def ssh_key_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'keys', 'gcp', 'skyt-gcp-key')
+
+
+def ensure_ssh_keypair() -> tuple:
+    """(private_key_path, public_key_text); generated once per install."""
+    key_path = ssh_key_path()
+    pub_path = key_path + '.pub'
+    if not os.path.exists(key_path):
+        os.makedirs(os.path.dirname(key_path), exist_ok=True)
+        if not shutil.which('ssh-keygen'):
+            raise exceptions.ProvisionError(
+                'ssh-keygen not available; cannot generate the GCP '
+                'cluster SSH keypair')
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+             '-C', 'skyt-gcp', '-f', key_path], check=True)
+    with open(pub_path, encoding='utf-8') as f:
+        return key_path, f.read().strip()
+
+
 @CLOUD_REGISTRY.register('gcp')
 class GcpTpuProvider(Provider):
     """TPU-VM slices via queued resources; one node == one slice."""
@@ -104,6 +143,75 @@ class GcpTpuProvider(Provider):
     def _parent(self, zone: str) -> str:
         return f'projects/{self._project}/locations/{zone}'
 
+    def _get_optional(self, url: str) -> Optional[Dict[str, Any]]:
+        """GET that returns None on 404 (probe-style calls)."""
+        try:
+            return self._request('GET', url)
+        except exceptions.ProvisionError as e:
+            low = str(e).lower()
+            if any(m in low for m in _NOT_FOUND_MARKERS):
+                return None
+            raise
+
+    # -- network/firewall bootstrap (parity: provision/gcp/config.py,
+    #    compacted) ------------------------------------------------------
+
+    # project -> chosen network; CLASS-level so the result (including
+    # which network to use) survives across provider instances -- every
+    # provision goes through a fresh get_provider() object.
+    _bootstrapped_projects: dict = {}
+
+    def bootstrap(self) -> str:
+        """Ensure a usable VPC + SSH ingress; returns the network name.
+
+        The default VPC is used when present (the common case); otherwise
+        a ``skyt-net`` auto-subnet VPC is created. A ``skyt-allow-ssh``
+        firewall rule opens tcp:22 to the managed instances.
+        """
+        key = self._project
+        if key in self._bootstrapped_projects:
+            self._network = self._bootstrapped_projects[key]
+            return self._network
+        base = f'{COMPUTE_API}/projects/{self._project}/global'
+        network = 'default'
+        if self._get_optional(f'{base}/networks/default') is None:
+            if self._get_optional(f'{base}/networks/skyt-net') is None:
+                self._request('POST', f'{base}/networks', {
+                    'name': 'skyt-net',
+                    'autoCreateSubnetworks': True,
+                })
+            network = 'skyt-net'
+        if self._get_optional(f'{base}/firewalls/skyt-allow-ssh') is None:
+            self._request('POST', f'{base}/firewalls', {
+                'name': 'skyt-allow-ssh',
+                'network': f'global/networks/{network}',
+                'direction': 'INGRESS',
+                'allowed': [{'IPProtocol': 'tcp', 'ports': ['22']}],
+                'sourceRanges': ['0.0.0.0/0'],
+                'targetTags': ['skyt'],
+            })
+        self._network = network
+        self._bootstrapped_projects[key] = network
+        return network
+
+    def open_ports(self, cluster_name: str, ports: List[str]) -> None:
+        """Per-cluster ingress rule (parity: provision API open_ports)."""
+        if not ports:
+            return
+        base = f'{COMPUTE_API}/projects/{self._project}/global'
+        rule = f'skyt-{cluster_name}-ports'
+        if self._get_optional(f'{base}/firewalls/{rule}') is not None:
+            return
+        network = getattr(self, '_network', 'default')
+        self._request('POST', f'{base}/firewalls', {
+            'name': rule,
+            'network': f'global/networks/{network}',
+            'direction': 'INGRESS',
+            'allowed': [{'IPProtocol': 'tcp', 'ports': list(ports)}],
+            'sourceRanges': ['0.0.0.0/0'],
+            'targetTags': ['skyt'],
+        })
+
     # -- provider interface ----------------------------------------------
 
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
@@ -112,22 +220,53 @@ class GcpTpuProvider(Provider):
                 'No GCP project configured (GOOGLE_CLOUD_PROJECT or '
                 'gcloud config).')
         res = request.resources
-        if not res.is_tpu:
-            raise exceptions.NotSupportedError(
-                'The GCP provider currently targets TPU-VM slices; use '
-                'accelerators: tpu-... (GPU/CPU instances: future work).')
         zone = request.zone or f'{request.region}-a'
-        tpu = res.tpu
-        for node in range(request.num_nodes):
-            for slice_idx in range(tpu.num_slices):
-                self._create_queued_resource(request, zone, node, slice_idx)
-        self._wait_queued_resources(request, zone, timeout=1800)
+        self.bootstrap()
+        if request.ports:
+            self.open_ports(request.cluster_name, request.ports)
+        if request.resume and self.query_instances(request.cluster_name):
+            # Only wait when instances actually exist: a fully-reclaimed
+            # cluster (spot DELETE) would otherwise hang wait_instances
+            # for the whole timeout instead of creating fresh.
+            self._start_stopped(request.cluster_name, zone)
+            self.wait_instances(request.cluster_name, 'running',
+                                timeout=600)
+            info = self.get_cluster_info(request.cluster_name)
+            if info is not None:
+                return info
+            # fall through: nothing to resume, create fresh
+        if res.is_tpu:
+            tpu = res.tpu
+            for node in range(request.num_nodes):
+                for slice_idx in range(tpu.num_slices):
+                    self._create_queued_resource(request, zone, node,
+                                                 slice_idx)
+            self._wait_queued_resources(request, zone, timeout=1800)
+        else:
+            # GCE CPU instances: the controller-VM path (parity:
+            # instance_utils.py GCPComputeInstance) -- jobs/serve
+            # controllers live on cheap VMs, not TPU hosts.
+            for node in range(request.num_nodes):
+                self._create_compute_instance(request, zone, node)
+            self.wait_instances(request.cluster_name, 'running',
+                                timeout=600)
         info = self.get_cluster_info(request.cluster_name)
         if info is None:
             raise exceptions.ProvisionError(
-                f'{request.cluster_name}: queued resources active but no '
-                'nodes found')
+                f'{request.cluster_name}: instances created but none '
+                'found on list')
         return info
+
+    def _start_stopped(self, cluster_name: str, zone: str) -> None:
+        for node in self._list_cluster_nodes(cluster_name, zone):
+            if node.get('state') == 'STOPPED':
+                self._request('POST', f'{TPU_API}/{node["name"]}:start', {})
+        for inst in self._list_compute_instances(cluster_name, zone):
+            if inst.get('status') == 'TERMINATED':  # GCE 'stopped' status
+                self._request(
+                    'POST',
+                    f'{self._zone_base(zone)}/instances/{inst["name"]}'
+                    f'/start', {})
 
     def _qr_name(self, cluster_name: str, node: int, slice_idx: int) -> str:
         return f'{cluster_name}-n{node}-s{slice_idx}'
@@ -137,14 +276,22 @@ class GcpTpuProvider(Provider):
         res = request.resources
         tpu = res.tpu
         qr_id = self._qr_name(request.cluster_name, node, slice_idx)
+        _, pub_key = ensure_ssh_keypair()
+        network = getattr(self, '_network', 'default')
         node_spec = {
             'acceleratorType': tpu.accelerator_type,
             'runtimeVersion': res.tpu_runtime_version,
-            'networkConfig': {'enableExternalIps': True},
+            'networkConfig': {'enableExternalIps': True,
+                              'network': f'global/networks/{network}'},
+            'tags': ['skyt'],
             'metadata': {
                 'skyt-cluster': request.cluster_name,
                 'skyt-node': str(node),
                 'skyt-slice': str(slice_idx),
+                # The key that makes wait_for_ssh/runtime-ship possible:
+                # same metadata contract as GCE (guest agent installs it
+                # into ~skyt/.ssh/authorized_keys on every worker).
+                'ssh-keys': f'{SSH_USER}:{pub_key}',
             },
             'labels': {**request.labels, 'skyt-cluster': request.cluster_name},
         }
@@ -174,6 +321,7 @@ class GcpTpuProvider(Provider):
             for n in range(request.num_nodes)
             for s in range(tpu.num_slices)
         ]
+        interval = 5.0
         while time.time() < deadline:
             states = {}
             for name in names:
@@ -188,10 +336,75 @@ class GcpTpuProvider(Provider):
             if failed:
                 raise classify_gcp_error(
                     f'Queued resources failed: {failed}')
-            time.sleep(10)
+            # Exponential backoff to 30s with +/-25% jitter: queued
+            # resources take minutes-to-hours and synchronized polls from
+            # many provisioners hammer the regional endpoint.
+            time.sleep(interval * random.uniform(0.75, 1.25))
+            interval = min(interval * 1.5, 30.0)
         raise exceptions.CapacityError(
             f'{request.cluster_name}: queued resources not ACTIVE within '
             f'{timeout}s (treating as capacity shortage for failover)')
+
+    # -- GCE CPU instances (controller VMs) ------------------------------
+
+    def _zone_base(self, zone: str) -> str:
+        return f'{COMPUTE_API}/projects/{self._project}/zones/{zone}'
+
+    def _machine_type(self, res) -> str:
+        if res.instance_type:
+            return res.instance_type
+        cpus = int(res.cpus[0]) if res.cpus else 4
+        # e2-standard-N (N a power of two >= 2): the cheap controller-VM
+        # family; round the request up to the next available size.
+        n = max(2, 1 << (max(1, cpus) - 1).bit_length())
+        return f'e2-standard-{min(n, 32)}'
+
+    def _create_compute_instance(self, request: ProvisionRequest, zone: str,
+                                 node: int) -> None:
+        res = request.resources
+        _, pub_key = ensure_ssh_keypair()
+        network = getattr(self, '_network', 'default')
+        name = f'{request.cluster_name}-n{node}'
+        body = {
+            'name': name,
+            'machineType': (f'zones/{zone}/machineTypes/'
+                            f'{self._machine_type(res)}'),
+            'tags': {'items': ['skyt']},
+            'disks': [{
+                'boot': True,
+                'autoDelete': True,
+                'initializeParams': {
+                    'sourceImage': ('projects/debian-cloud/global/images/'
+                                    'family/debian-12'),
+                    'diskSizeGb': str(res.disk_size),
+                },
+            }],
+            'networkInterfaces': [{
+                'network': f'global/networks/{network}',
+                'accessConfigs': [{'type': 'ONE_TO_ONE_NAT',
+                                   'name': 'External NAT'}],
+            }],
+            'metadata': {'items': [
+                {'key': 'ssh-keys', 'value': f'{SSH_USER}:{pub_key}'},
+                {'key': 'skyt-cluster', 'value': request.cluster_name},
+                {'key': 'skyt-node', 'value': str(node)},
+            ]},
+            'labels': {**request.labels,
+                       'skyt-cluster': request.cluster_name},
+        }
+        if res.use_spot:
+            body['scheduling'] = {'provisioningModel': 'SPOT',
+                                  'instanceTerminationAction': 'DELETE'}
+        self._request('POST', f'{self._zone_base(zone)}/instances', body)
+        logger.info('GCE instance %s requested in %s', name, zone)
+
+    def _list_compute_instances(self, cluster_name: str,
+                                zone: str) -> List[Dict[str, Any]]:
+        import urllib.parse
+        flt = urllib.parse.quote(f'labels.skyt-cluster={cluster_name}')
+        resp = self._request(
+            'GET', f'{self._zone_base(zone)}/instances?filter={flt}')
+        return resp.get('items', [])
 
     def _list_cluster_nodes(self, cluster_name: str,
                             zone: str) -> List[Dict[str, Any]]:
@@ -207,8 +420,18 @@ class GcpTpuProvider(Provider):
 
     def stop_instances(self, cluster_name: str) -> None:
         zone = self._zone_of(cluster_name)
+        if zone is None:
+            # No cluster record -> nothing addressable to stop (VERDICT
+            # weak #4: previously built a locations/None URL).
+            logger.warning('stop_instances(%s): no zone on record, '
+                           'skipping', cluster_name)
+            return
         for node in self._list_cluster_nodes(cluster_name, zone):
             self._request('POST', f'{TPU_API}/{node["name"]}:stop', {})
+        for inst in self._list_compute_instances(cluster_name, zone):
+            self._request(
+                'POST',
+                f'{self._zone_base(zone)}/instances/{inst["name"]}/stop', {})
 
     def terminate_instances(self, cluster_name: str) -> None:
         zone = self._zone_of(cluster_name)
@@ -219,28 +442,42 @@ class GcpTpuProvider(Provider):
         for qr in resp.get('queuedResources', []):
             if qr['name'].split('/')[-1].startswith(cluster_name + '-n'):
                 self._request('DELETE', f'{TPU_API}/{qr["name"]}?force=true')
+        for inst in self._list_compute_instances(cluster_name, zone):
+            self._request(
+                'DELETE',
+                f'{self._zone_base(zone)}/instances/{inst["name"]}')
+        # Per-cluster firewall rule cleanup (created by open_ports).
+        base = f'{COMPUTE_API}/projects/{self._project}/global'
+        rule = f'skyt-{cluster_name}-ports'
+        if self._get_optional(f'{base}/firewalls/{rule}') is not None:
+            self._request('DELETE', f'{base}/firewalls/{rule}')
+
+    _TPU_STATE_MAP = {'READY': 'running', 'STOPPED': 'stopped',
+                      'PREEMPTED': 'preempted', 'TERMINATED': 'terminated'}
+    _GCE_STATE_MAP = {'RUNNING': 'running', 'TERMINATED': 'stopped',
+                      'STOPPING': 'stopping', 'PROVISIONING': 'starting',
+                      'STAGING': 'starting'}
 
     def query_instances(self, cluster_name: str) -> Dict[str, str]:
         zone = self._zone_of(cluster_name)
         if zone is None:
             return {}
         out = {}
-        state_map = {'READY': 'running', 'STOPPED': 'stopped',
-                     'PREEMPTED': 'preempted', 'TERMINATED': 'terminated'}
         for node in self._list_cluster_nodes(cluster_name, zone):
-            out[node['name'].split('/')[-1]] = state_map.get(
+            out[node['name'].split('/')[-1]] = self._TPU_STATE_MAP.get(
                 node.get('state', ''), node.get('state', 'unknown').lower())
+        for inst in self._list_compute_instances(cluster_name, zone):
+            out[inst['name']] = self._GCE_STATE_MAP.get(
+                inst.get('status', ''),
+                inst.get('status', 'unknown').lower())
         return out
 
     def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
         zone = self._zone_of(cluster_name)
         if zone is None:
             return None
-        nodes = self._list_cluster_nodes(cluster_name, zone)
-        if not nodes:
-            return None
         hosts: List[HostInfo] = []
-        for tpu_node in nodes:
+        for tpu_node in self._list_cluster_nodes(cluster_name, zone):
             meta = tpu_node.get('metadata', {})
             node_index = int(meta.get('skyt-node', 0))
             endpoints = tpu_node.get('networkEndpoints', [])
@@ -255,9 +492,24 @@ class GcpTpuProvider(Provider):
                         node_index=node_index,
                         worker_index=worker_index,
                     ))
+        for inst in self._list_compute_instances(cluster_name, zone):
+            meta_items = {i['key']: i['value']
+                          for i in inst.get('metadata', {}).get('items', [])}
+            nic = (inst.get('networkInterfaces') or [{}])[0]
+            access = (nic.get('accessConfigs') or [{}])[0]
+            hosts.append(
+                HostInfo(
+                    instance_id=inst['name'],
+                    internal_ip=nic.get('networkIP', ''),
+                    external_ip=access.get('natIP'),
+                    node_index=int(meta_items.get('skyt-node', 0)),
+                    worker_index=0,
+                ))
+        if not hosts:
+            return None
         hosts.sort(key=lambda h: (h.node_index, h.worker_index))
         region = zone.rsplit('-', 1)[0]
         return ClusterInfo(
             cluster_name=cluster_name, provider='gcp', region=region,
-            zone=zone, hosts=hosts, ssh_user='skyt',
-            ssh_key_path=os.path.expanduser('~/.ssh/skyt-key'))
+            zone=zone, hosts=hosts, ssh_user=SSH_USER,
+            ssh_key_path=ssh_key_path())
