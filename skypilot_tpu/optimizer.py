@@ -22,14 +22,62 @@ from skypilot_tpu.utils import log
 logger = log.init_logger(__name__)
 
 
+# Planning-time utilization assumption for runtime estimation: the
+# BASELINE.md target MFU. Real jobs vary; this only needs to be CONSISTENT
+# across candidates so the ranking (perf-per-dollar) is right.
+PLANNING_MFU = 0.40
+# $/GB egress between regions (public GCP inter-region ballpark; parity:
+# sky/optimizer.py:75 + cloud egress tables).
+EGRESS_PRICE_PER_GB = 0.08
+
+
 @dataclasses.dataclass
 class Candidate:
     """A launchable, priced resource assignment."""
     resources: Resources          # cloud/region/zone/instance decided
     hourly_cost: float
+    peak_tflops: Optional[float] = None   # bf16 aggregate, for time est.
+    estimated_hours: Optional[float] = None
+    egress_cost: float = 0.0
+
+    @property
+    def total_cost(self) -> Optional[float]:
+        """End-to-end $ when the runtime is estimable (else None)."""
+        if self.estimated_hours is None:
+            return None
+        return self.hourly_cost * self.estimated_hours + self.egress_cost
 
     def __repr__(self) -> str:
-        return f'Candidate({self.resources}, ${self.hourly_cost:.2f}/hr)'
+        extra = ''
+        if self.estimated_hours is not None:
+            extra = (f', ~{self.estimated_hours:.1f}h'
+                     f' -> ${self.total_cost:.2f} total')
+        return f'Candidate({self.resources}, ${self.hourly_cost:.2f}/hr{extra})'
+
+
+def _annotate_estimates(candidate: Candidate, task) -> Candidate:
+    """Fill runtime/egress estimates from task hints (parity:
+    sky/optimizer.py:239 cost/time estimation, :75 egress).
+
+    Runtime = FLOPs / (aggregate peak * PLANNING_MFU): a compute-bound
+    model, which is exactly the case where price-only ranking picks wrong
+    (a cheap small slice over a faster better-$/FLOP one).
+    """
+    res = candidate.resources
+    if res.is_tpu and res.tpu is not None:
+        candidate.peak_tflops = (res.tpu.total_chips *
+                                 res.tpu.gen.bf16_tflops_per_chip)
+    if task is not None:
+        flops = getattr(task, 'estimated_flops', None)
+        if flops and candidate.peak_tflops:
+            eff = candidate.peak_tflops * 1e12 * PLANNING_MFU
+            candidate.estimated_hours = flops / eff / 3600.0
+        inputs_gb = getattr(task, 'estimated_inputs_gb', None)
+        src_region = getattr(task, 'inputs_region', None)
+        if inputs_gb and src_region and res.region and \
+                src_region != res.region:
+            candidate.egress_cost = inputs_gb * EGRESS_PRICE_PER_GB
+    return candidate
 
 
 def candidates_for(resources: Resources,
@@ -90,10 +138,12 @@ class Optimizer:
     @staticmethod
     def optimize(dag: Dag,
                  enabled_clouds: Optional[Sequence[str]] = None,
-                 quiet: bool = True) -> Dag:
+                 quiet: bool = True,
+                 minimize: str = 'cost') -> Dag:
         dag.validate()
         for task in dag.tasks:
-            plan = Optimizer.plan_task(task, enabled_clouds)
+            plan = Optimizer.plan_task(task, enabled_clouds,
+                                       minimize=minimize)
             task.best_resources = plan[0].resources
             if not quiet:
                 logger.info('Task %s: chose %s', task.name or '<unnamed>',
@@ -102,9 +152,20 @@ class Optimizer:
 
     @staticmethod
     def plan_task(task: Task,
-                  enabled_clouds: Optional[Sequence[str]] = None
-                  ) -> List[Candidate]:
-        """Ordered candidate list across the task's any_of resources."""
+                  enabled_clouds: Optional[Sequence[str]] = None,
+                  minimize: str = 'cost') -> List[Candidate]:
+        """Ordered candidate list across the task's any_of resources.
+
+        Ranking (parity: sky/optimizer.py OptimizeTarget COST/TIME):
+        * `cost`: total end-to-end $ when the task carries an
+          `estimated_flops` hint (runtime x hourly + egress); hourly $
+          otherwise -- with peak TFLOPs/$ as the tie-break so equal-price
+          offerings prefer the faster hardware.
+        * `time`: estimated runtime first (needs the hint), cost second.
+        """
+        if minimize not in ('cost', 'time'):
+            raise ValueError(f"minimize must be 'cost' or 'time', "
+                             f'got {minimize!r}')
         all_candidates: List[Candidate] = []
         for resources in task.resources:
             all_candidates.extend(candidates_for(resources, enabled_clouds))
@@ -115,5 +176,25 @@ class Optimizer:
                 f'{task.name or "<unnamed>"}: requested [{requested}]. '
                 f'Check accelerator name/region against '
                 f'`skyt show-tpus` and enabled clouds.')
-        all_candidates.sort(key=lambda c: c.hourly_cost)
+        all_candidates = [_annotate_estimates(c, task)
+                          for c in all_candidates]
+
+        if minimize == 'time':
+            def key(c: Candidate):
+                return (c.estimated_hours if c.estimated_hours is not None
+                        else float('inf'), c.total_cost or c.hourly_cost)
+        else:
+            def key(c: Candidate):
+                total = c.total_cost
+                if total is not None:
+                    # Estimable candidates rank first, by end-to-end $ --
+                    # hourly $ and total $ are different units, so the
+                    # leading tier flag keeps them out of one comparison.
+                    return (0, total, c.hourly_cost)
+                # No runtime estimate: hourly $ with perf-per-dollar
+                # tie-break (more TFLOPs per $ first).
+                perf_per_dollar = ((c.peak_tflops or 0.0) /
+                                   max(c.hourly_cost, 1e-9))
+                return (1, c.hourly_cost + c.egress_cost, -perf_per_dollar)
+        all_candidates.sort(key=key)
         return all_candidates

@@ -176,6 +176,12 @@ TASK_SCHEMA: Dict[str, Any] = {
         },
         'service': _SERVICE,
         'config': {'type': 'object'},
+        # Optimizer hints (parity: sky/optimizer.py:239 time estimation +
+        # :75 egress cost; the reference estimates via
+        # task.set_time_estimator, here declaratively in YAML).
+        'estimated_flops': {'type': ['number', 'null'], 'minimum': 0},
+        'estimated_inputs_gb': {'type': ['number', 'null'], 'minimum': 0},
+        'inputs_region': {'type': ['string', 'null']},
         # Internal round-trip marker (admin policy already applied);
         # present when a task exported by to_yaml is re-imported.
         '_policy_applied': {'type': 'boolean'},

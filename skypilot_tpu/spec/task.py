@@ -41,6 +41,9 @@ class Task:
         storage_mounts: Optional[Dict[str, Dict[str, Any]]] = None,
         resources: Union[None, Resources, List[Resources]] = None,
         service: Optional[Dict[str, Any]] = None,
+        estimated_flops: Optional[float] = None,
+        estimated_inputs_gb: Optional[float] = None,
+        inputs_region: Optional[str] = None,
     ) -> None:
         if name is not None and not _VALID_NAME_RE.fullmatch(name):
             raise exceptions.InvalidSpecError(f'Invalid task name {name!r}')
@@ -67,6 +70,11 @@ class Task:
         else:
             self.resources = list(resources)
         self.service = service
+        # Optimizer hints: total compute (FLOPs) for runtime estimation
+        # and input size/region for egress cost (optimizer.py).
+        self.estimated_flops = estimated_flops
+        self.estimated_inputs_gb = estimated_inputs_gb
+        self.inputs_region = inputs_region
         # Per-task config layer (the `config:` YAML section), threaded
         # into config.get_nested(... override_configs=...) by consumers.
         self.config_overrides: Dict[str, Any] = {}
@@ -109,6 +117,7 @@ class Task:
             'name', 'setup', 'run', 'workdir', 'num_nodes', 'envs',
             'secrets', 'file_mounts', 'storage_mounts', 'resources',
             'service', 'config', '_policy_applied',
+            'estimated_flops', 'estimated_inputs_gb', 'inputs_region',
         }
         unknown = set(config) - known
         if unknown:
@@ -138,6 +147,9 @@ class Task:
             storage_mounts=config.get('storage_mounts'),
             resources=resources,
             service=config.get('service'),
+            estimated_flops=config.get('estimated_flops'),
+            estimated_inputs_gb=config.get('estimated_inputs_gb'),
+            inputs_region=config.get('inputs_region'),
         )
         task.config_overrides = dict(config.get('config') or {})
         task.policy_applied = bool(config.get('_policy_applied', False))
@@ -193,6 +205,12 @@ class Task:
             config['service'] = self.service
         if self.config_overrides:
             config['config'] = dict(self.config_overrides)
+        if self.estimated_flops is not None:
+            config['estimated_flops'] = self.estimated_flops
+        if self.estimated_inputs_gb is not None:
+            config['estimated_inputs_gb'] = self.estimated_inputs_gb
+        if self.inputs_region is not None:
+            config['inputs_region'] = self.inputs_region
         if self.policy_applied:
             config['_policy_applied'] = True
         return config
