@@ -1,0 +1,248 @@
+// fuse-proxy server: privileged helper that runs fusermount on behalf of
+// unprivileged pods.
+//
+// Rebuild of the reference's Go addon (addons/fuse-proxy, 726 LoC: a
+// fusermount shim + privileged DaemonSet server) in C++ per the
+// TPU-native framework's native-runtime stance (SURVEY.md §2.11).
+//
+// Architecture (same as the reference):
+//   * this server runs privileged (DaemonSet) with /dev/fuse and
+//     CAP_SYS_ADMIN, listening on a unix socket shared with pods via a
+//     hostPath volume;
+//   * unprivileged pods ship a `fusermount` shim (fusermount_shim.cc) on
+//     PATH; FUSE clients (gcsfuse/rclone/goofys) exec it expecting the
+//     fusermount protocol: perform the mount and pass the opened
+//     /dev/fuse fd back over the unix socket named by _FUSE_COMMFD;
+//   * the shim forwards argv + cwd here; this server execs the REAL
+//     fusermount in that cwd (mount namespace note: the DaemonSet shares
+//     the pod mount ns via hostPID/nsenter in deployment), captures the
+//     fd fusermount hands back over its own _FUSE_COMMFD channel, and
+//     relays (exit code, fd) to the shim over SCM_RIGHTS.
+//
+// Wire format shim -> server (one request per connection):
+//   u32 argc | argc x (u32 len, bytes) | u32 cwd_len, bytes
+// Server -> shim:
+//   u32 exit_code, then (iff a mount fd exists) one byte 'F' with an
+//   SCM_RIGHTS fd attached; else one byte 'N'.
+//
+// Env knobs: FUSE_PROXY_SOCKET (default /run/skyt-fuse-proxy.sock),
+// FUSE_PROXY_FUSERMOUNT (real fusermount binary; default
+// /usr/bin/fusermount3 then /usr/bin/fusermount; tests point it at a
+// mock).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr const char* kDefaultSocket = "/run/skyt-fuse-proxy.sock";
+
+bool ReadFull(int fd, void* buf, size_t len) {
+  auto* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = read(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t len) {
+  const auto* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = write(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool ReadU32(int fd, uint32_t* out) { return ReadFull(fd, out, 4); }
+
+bool ReadString(int fd, std::string* out) {
+  uint32_t len;
+  if (!ReadU32(fd, &len) || len > (1u << 20)) return false;
+  out->resize(len);
+  return len == 0 || ReadFull(fd, out->data(), len);
+}
+
+// Send one byte with an optional fd attached via SCM_RIGHTS.
+bool SendByteWithFd(int sock, char tag, int fd) {
+  struct msghdr msg = {};
+  struct iovec iov = {&tag, 1};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char control[CMSG_SPACE(sizeof(int))] = {};
+  if (fd >= 0) {
+    msg.msg_control = control;
+    msg.msg_controllen = sizeof(control);
+    struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+    cmsg->cmsg_level = SOL_SOCKET;
+    cmsg->cmsg_type = SCM_RIGHTS;
+    cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(cmsg), &fd, sizeof(int));
+  }
+  return sendmsg(sock, &msg, 0) == 1;
+}
+
+// Receive one fd sent by fusermount over the _FUSE_COMMFD socket.
+int RecvFdFromFusermount(int sock) {
+  char buf[1];
+  struct msghdr msg = {};
+  struct iovec iov = {buf, sizeof(buf)};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char control[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = control;
+  msg.msg_controllen = sizeof(control);
+  if (recvmsg(sock, &msg, 0) < 0) return -1;
+  for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      int fd;
+      memcpy(&fd, CMSG_DATA(cmsg), sizeof(int));
+      return fd;
+    }
+  }
+  return -1;
+}
+
+std::string RealFusermount() {
+  const char* env = getenv("FUSE_PROXY_FUSERMOUNT");
+  if (env != nullptr && env[0] != '\0') return env;
+  if (access("/usr/bin/fusermount3", X_OK) == 0)
+    return "/usr/bin/fusermount3";
+  return "/usr/bin/fusermount";
+}
+
+// Handle one shim connection: run fusermount, relay (rc, fd).
+void HandleClient(int client) {
+  uint32_t argc;
+  if (!ReadU32(client, &argc) || argc == 0 || argc > 64) {
+    close(client);
+    return;
+  }
+  std::vector<std::string> args(argc);
+  for (auto& a : args) {
+    if (!ReadString(client, &a)) {
+      close(client);
+      return;
+    }
+  }
+  std::string cwd;
+  if (!ReadString(client, &cwd)) {
+    close(client);
+    return;
+  }
+
+  // _FUSE_COMMFD channel for the real fusermount to pass the mount fd.
+  int comm[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, comm) != 0) {
+    close(client);
+    return;
+  }
+
+  pid_t pid = fork();
+  if (pid == 0) {
+    close(comm[0]);
+    if (!cwd.empty() && chdir(cwd.c_str()) != 0) _exit(127);
+    char commfd[16];
+    snprintf(commfd, sizeof(commfd), "%d", comm[1]);
+    setenv("_FUSE_COMMFD", commfd, 1);
+    // Keep comm[1] open across exec.
+    int flags = fcntl(comm[1], F_GETFD);
+    fcntl(comm[1], F_SETFD, flags & ~FD_CLOEXEC);
+    std::vector<char*> argv;
+    std::string real = RealFusermount();
+    argv.push_back(const_cast<char*>(real.c_str()));
+    for (size_t i = 1; i < args.size(); ++i)
+      argv.push_back(const_cast<char*>(args[i].c_str()));
+    argv.push_back(nullptr);
+    execv(real.c_str(), argv.data());
+    _exit(127);
+  }
+  close(comm[1]);
+
+  int mount_fd = -1;
+  // fusermount only passes an fd for mount operations; poll with a
+  // short wait so unmounts don't block on a never-sent fd.
+  struct timeval tv = {5, 0};
+  setsockopt(comm[0], SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  mount_fd = RecvFdFromFusermount(comm[0]);
+
+  int status = 0;
+  waitpid(pid, &status, 0);
+  uint32_t rc = WIFEXITED(status) ? WEXITSTATUS(status) : 1;
+
+  WriteFull(client, &rc, 4);
+  if (mount_fd >= 0) {
+    SendByteWithFd(client, 'F', mount_fd);
+    close(mount_fd);
+  } else {
+    SendByteWithFd(client, 'N', -1);
+  }
+  close(comm[0]);
+  close(client);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* sock_path = getenv("FUSE_PROXY_SOCKET");
+  if (sock_path == nullptr || sock_path[0] == '\0')
+    sock_path = kDefaultSocket;
+  if (argc > 1) sock_path = argv[1];
+
+  signal(SIGPIPE, SIG_IGN);
+  unlink(sock_path);
+  int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (srv < 0) {
+    perror("socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", sock_path);
+  if (bind(srv, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  chmod(sock_path, 0666);  // pods run as arbitrary uids
+  if (listen(srv, 16) != 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stderr, "fuse-proxy-server listening on %s (fusermount: %s)\n",
+          sock_path, RealFusermount().c_str());
+  for (;;) {
+    int client = accept(srv, nullptr, nullptr);
+    if (client < 0) {
+      if (errno == EINTR) continue;
+      perror("accept");
+      return 1;
+    }
+    HandleClient(client);  // serial: mounts are rare + fast
+  }
+}

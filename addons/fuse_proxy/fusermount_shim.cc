@@ -1,0 +1,152 @@
+// fusermount shim: drop-in `fusermount`/`fusermount3` for unprivileged
+// pods; forwards the real work to the privileged fuse-proxy server.
+//
+// C++ rebuild of the reference's Go shim (addons/fuse-proxy/cmd/shim;
+// see fuse_proxy_server.cc for the architecture + wire format). FUSE
+// clients exec this exactly like fusermount: when mounting they set
+// _FUSE_COMMFD to a unix-socket fd and expect the opened /dev/fuse fd
+// back over it; this shim relays argv+cwd to the server, receives
+// (exit code, fd) over SCM_RIGHTS, and forwards the fd to its caller
+// over _FUSE_COMMFD -- transparent to gcsfuse/rclone/goofys.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr const char* kDefaultSocket = "/run/skyt-fuse-proxy.sock";
+
+bool WriteFull(int fd, const void* buf, size_t len) {
+  const auto* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = write(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool ReadFull(int fd, void* buf, size_t len) {
+  auto* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = read(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool WriteString(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  return WriteFull(fd, &len, 4) && (len == 0 || WriteFull(fd, s.data(), len));
+}
+
+// Receive the tag byte (+ optional SCM_RIGHTS fd) from the server.
+int RecvTagFd(int sock, char* tag) {
+  struct msghdr msg = {};
+  struct iovec iov = {tag, 1};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char control[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = control;
+  msg.msg_controllen = sizeof(control);
+  if (recvmsg(sock, &msg, 0) != 1) return -1;
+  for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      int fd;
+      memcpy(&fd, CMSG_DATA(cmsg), sizeof(int));
+      return fd;
+    }
+  }
+  return -1;
+}
+
+// Forward the mount fd to our caller (the FUSE client library) over the
+// unix socket it named in _FUSE_COMMFD -- the fusermount protocol.
+bool SendFdToCaller(int commfd, int fd) {
+  char tag = 'F';
+  struct msghdr msg = {};
+  struct iovec iov = {&tag, 1};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char control[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = control;
+  msg.msg_controllen = sizeof(control);
+  struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+  cmsg->cmsg_level = SOL_SOCKET;
+  cmsg->cmsg_type = SCM_RIGHTS;
+  cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+  memcpy(CMSG_DATA(cmsg), &fd, sizeof(int));
+  return sendmsg(commfd, &msg, 0) == 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* sock_path = getenv("FUSE_PROXY_SOCKET");
+  if (sock_path == nullptr || sock_path[0] == '\0')
+    sock_path = kDefaultSocket;
+
+  int sock = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) {
+    perror("fusermount-shim: socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", sock_path);
+  if (connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    fprintf(stderr, "fusermount-shim: cannot reach fuse-proxy at %s: %s\n",
+            sock_path, strerror(errno));
+    return 1;
+  }
+
+  uint32_t argc_u = static_cast<uint32_t>(argc);
+  if (!WriteFull(sock, &argc_u, 4)) return 1;
+  for (int i = 0; i < argc; ++i) {
+    if (!WriteString(sock, argv[i])) return 1;
+  }
+  char cwd[4096];
+  if (getcwd(cwd, sizeof(cwd)) == nullptr) cwd[0] = '\0';
+  if (!WriteString(sock, cwd)) return 1;
+
+  uint32_t rc = 1;
+  if (!ReadFull(sock, &rc, 4)) {
+    fprintf(stderr, "fusermount-shim: server hung up\n");
+    return 1;
+  }
+  char tag = 'N';
+  int mount_fd = RecvTagFd(sock, &tag);
+  if (tag == 'F' && mount_fd >= 0) {
+    const char* commfd_env = getenv("_FUSE_COMMFD");
+    if (commfd_env != nullptr) {
+      int commfd = atoi(commfd_env);
+      if (!SendFdToCaller(commfd, mount_fd)) {
+        fprintf(stderr, "fusermount-shim: fd relay to caller failed\n");
+        return 1;
+      }
+    }
+    close(mount_fd);
+  }
+  close(sock);
+  return static_cast<int>(rc);
+}

@@ -44,6 +44,18 @@ class TpuPodBackend(Backend):
                   dryrun: bool = False,
                   blocklist=None) -> Optional[ClusterInfo]:
         candidates = Optimizer.plan_task(task)
+        # FUSE-mount storage on k8s needs the fuse-proxy shim wired into
+        # the pod manifest (provision/kubernetes.py _needs_fuse); flag it
+        # via a label so the request carries the hint to any provider.
+        from skypilot_tpu.data.storage import StorageMode
+        needs_fuse = any(
+            (mount.get('mode') or 'MOUNT').upper() in
+            (StorageMode.MOUNT.value, StorageMode.MOUNT_CACHED.value)
+            for mount in task.storage_mounts.values())
+        if needs_fuse:
+            for cand in candidates:
+                cand.resources = cand.resources.copy(
+                    labels={**cand.resources.labels, 'skyt-fuse': 'true'})
         if dryrun:
             logger.info('Dryrun: would provision %s', candidates[0])
             return None

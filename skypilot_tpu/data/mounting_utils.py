@@ -46,6 +46,14 @@ RCLONE_INSTALL = (
     'curl -fsSL https://rclone.org/install.sh | sudo bash')
 
 
+# Unprivileged k8s pods reach fusermount through the fuse-proxy shim
+# (provision/kubernetes.py wires FUSE_PROXY_SOCKET + the shared bin dir;
+# addons/fuse_proxy). Prepending in-shell preserves the image's PATH.
+FUSE_PROXY_PATH_PREFIX = (
+    'if [ -n "${FUSE_PROXY_SOCKET:-}" ]; then '
+    'export PATH="$(dirname "$FUSE_PROXY_SOCKET")/bin:$PATH"; fi')
+
+
 def gcs_mount_command(bucket: str, mount_path: str,
                       readonly: bool = False) -> str:
     """gcsfuse mount (MOUNT mode): direct bucket FS, writes go through."""
@@ -53,7 +61,8 @@ def gcs_mount_command(bucket: str, mount_path: str,
     if readonly:
         flags += ' -o ro'
     path = quote_path(mount_path)
-    return (f'{GCSFUSE_INSTALL} && mkdir -p {path} && '
+    return (f'{FUSE_PROXY_PATH_PREFIX} && '
+            f'{GCSFUSE_INSTALL} && mkdir -p {path} && '
             f'{{ mountpoint -q {path} || '
             f'gcsfuse {flags} {shlex.quote(bucket)} {path}; }}')
 
@@ -65,6 +74,7 @@ def gcs_mount_cached_command(bucket: str, mount_path: str) -> str:
     path = quote_path(mount_path)
     remote = f'skyt-gcs:{bucket}'
     return (
+        f'{FUSE_PROXY_PATH_PREFIX} && '
         f'{RCLONE_INSTALL} && mkdir -p {path} ~/.config/rclone && '
         '{ grep -q "^\\[skyt-gcs\\]" ~/.config/rclone/rclone.conf '
         '2>/dev/null || printf "[skyt-gcs]\\ntype = gcs\\n" '
@@ -89,6 +99,58 @@ def gcs_download_command(bucket: str, prefix: str, dest: str) -> str:
             f'mkdir -p "$(dirname {dst})" && gsutil cp {src} {dst}; '
             f'else mkdir -p {dst} && '
             f'gsutil -m rsync -r {src} {dst}; fi')
+
+
+def _rclone_s3_remote_config() -> str:
+    """Idempotent rclone remote backed by the configured S3 endpoint.
+
+    Credentials/endpoint come from AWS_* / SKYT_S3_ENDPOINT_URL env vars
+    via rclone's env_auth; S3CompatibleStore._env_prefix embeds them in
+    the generated command (the client resolves config at gen time --
+    hosts have no client config)."""
+    return (
+        'mkdir -p ~/.config/rclone && '
+        '{ grep -q "^\\[skyt-s3\\]" ~/.config/rclone/rclone.conf '
+        '2>/dev/null || printf "[skyt-s3]\\ntype = s3\\n'
+        'provider = Other\\nenv_auth = true\\n'
+        'endpoint = ${SKYT_S3_ENDPOINT_URL:-https://s3.amazonaws.com}\\n" '
+        '>> ~/.config/rclone/rclone.conf; }')
+
+
+def s3_mount_command(bucket: str, mount_path: str) -> str:
+    """rclone mount of an S3-compatible bucket (MOUNT mode; parity:
+    s3fs/goofys command gen in the reference -- rclone is the one tool
+    that covers every S3-compatible provider)."""
+    path = quote_path(mount_path)
+    remote = f'skyt-s3:{bucket}'
+    return (f'{FUSE_PROXY_PATH_PREFIX} && '
+            f'{RCLONE_INSTALL} && {_rclone_s3_remote_config()} && '
+            f'mkdir -p {path} && '
+            f'{{ mountpoint -q {path} || '
+            f'rclone mount {shlex.quote(remote)} {path} --daemon '
+            '--vfs-cache-mode off --dir-cache-time 30s; }')
+
+
+def s3_mount_cached_command(bucket: str, mount_path: str) -> str:
+    """rclone VFS write-back cache (MOUNT_CACHED; checkpoint pattern)."""
+    path = quote_path(mount_path)
+    remote = f'skyt-s3:{bucket}'
+    return (f'{FUSE_PROXY_PATH_PREFIX} && '
+            f'{RCLONE_INSTALL} && {_rclone_s3_remote_config()} && '
+            f'mkdir -p {path} && '
+            f'{{ mountpoint -q {path} || '
+            f'rclone mount {shlex.quote(remote)} {path} --daemon '
+            '--vfs-cache-mode writes --vfs-cache-max-size 10G '
+            '--dir-cache-time 30s; }')
+
+
+def s3_download_command(bucket: str, prefix: str, dest: str) -> str:
+    """COPY mode via the shipped runtime's stdlib S3 client -- no
+    aws-cli/rclone needed for one-shot downloads."""
+    dst = quote_path(dest)
+    return (f'mkdir -p {dst} && '
+            f'python3 -m skypilot_tpu.data.s3 sync-down '
+            f'{shlex.quote(bucket)} {shlex.quote(prefix)} {dst}')
 
 
 def local_mount_command(bucket_dir: str, mount_path: str) -> str:

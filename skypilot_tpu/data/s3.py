@@ -1,0 +1,289 @@
+"""Minimal S3 client: SigV4 over stdlib HTTP, endpoint-configurable.
+
+Parity: ``sky/data/storage.py:1855 S3CompatibleStore`` -- one store class
+serving every S3-compatible endpoint (AWS, Cloudflare R2, MinIO, Ceph...)
+selected by config. The reference shells out to aws-cli/boto3; neither is
+in this image, so the wire protocol is implemented directly: SigV4
+signing is ~40 lines of hmac/sha256 and removes the dependency entirely
+(same reasoning as the reference's lazy adaptors -- `import skypilot_tpu`
+must not drag cloud SDKs).
+
+Credentials/endpoint resolution order:
+1. explicit ``S3Config`` arguments;
+2. env: ``SKYT_S3_ENDPOINT_URL`` / ``AWS_ACCESS_KEY_ID`` /
+   ``AWS_SECRET_ACCESS_KEY`` / ``AWS_DEFAULT_REGION``;
+3. layered config: ``storage.s3.{endpoint_url,access_key_id,...}``.
+
+Also a tiny CLI (``python3 -m skypilot_tpu.data.s3``) used by the
+cluster-side download commands -- every host has the shipped runtime on
+PYTHONPATH, so no extra tooling is needed on nodes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+import hmac
+import os
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Tuple
+from xml.etree import ElementTree
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class S3Config:
+    endpoint_url: str
+    access_key_id: str
+    secret_access_key: str
+    region: str = 'us-east-1'
+
+    @classmethod
+    def load(cls,
+             endpoint_url: Optional[str] = None,
+             access_key_id: Optional[str] = None,
+             secret_access_key: Optional[str] = None,
+             region: Optional[str] = None,
+             require_credentials: bool = True) -> 'S3Config':
+        from skypilot_tpu import config as config_lib
+
+        def pick(explicit, env_key, cfg_key, default=None):
+            if explicit:
+                return explicit
+            if os.environ.get(env_key):
+                return os.environ[env_key]
+            return config_lib.get_nested(('storage', 's3', cfg_key),
+                                         default)
+
+        endpoint = pick(endpoint_url, 'SKYT_S3_ENDPOINT_URL',
+                        'endpoint_url', 'https://s3.amazonaws.com')
+        key = pick(access_key_id, 'AWS_ACCESS_KEY_ID', 'access_key_id')
+        secret = pick(secret_access_key, 'AWS_SECRET_ACCESS_KEY',
+                      'secret_access_key')
+        reg = pick(region, 'AWS_DEFAULT_REGION', 'region', 'us-east-1')
+        if (not key or not secret) and require_credentials:
+            raise exceptions.StorageError(
+                'S3-compatible store needs credentials: set '
+                'AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY or '
+                'storage.s3.access_key_id/secret_access_key in config.')
+        return cls(endpoint_url=endpoint.rstrip('/'),
+                   access_key_id=key or '',
+                   secret_access_key=secret or '', region=reg)
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class S3Client:
+    """Path-style S3 REST client with SigV4 request signing."""
+
+    def __init__(self, cfg: S3Config) -> None:
+        self.cfg = cfg
+
+    # -- SigV4 ---------------------------------------------------------
+
+    def _signed_request(self, method: str, bucket: str, key: str = '',
+                        query: Optional[Dict[str, str]] = None,
+                        body: bytes = b'') -> urllib.request.Request:
+        cfg = self.cfg
+        parsed = urllib.parse.urlparse(cfg.endpoint_url)
+        host = parsed.netloc
+        path = f'/{bucket}' + (f'/{urllib.parse.quote(key)}' if key else '')
+        if parsed.path and parsed.path != '/':
+            path = parsed.path.rstrip('/') + path
+        query = dict(sorted((query or {}).items()))
+        # SigV4 canonicalizes with %20 (quote), never '+' (quote_plus) --
+        # a space in a prefix would otherwise SignatureDoesNotMatch.
+        canonical_query = urllib.parse.urlencode(
+            query, quote_via=urllib.parse.quote)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+        datestamp = now.strftime('%Y%m%d')
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = {
+            'host': host,
+            'x-amz-content-sha256': payload_hash,
+            'x-amz-date': amz_date,
+        }
+        signed_headers = ';'.join(sorted(headers))
+        canonical_headers = ''.join(
+            f'{k}:{headers[k]}\n' for k in sorted(headers))
+        canonical_request = '\n'.join([
+            method, path, canonical_query, canonical_headers,
+            signed_headers, payload_hash,
+        ])
+        scope = f'{datestamp}/{cfg.region}/s3/aws4_request'
+        string_to_sign = '\n'.join([
+            'AWS4-HMAC-SHA256', amz_date, scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ])
+        k_date = _sign(f'AWS4{cfg.secret_access_key}'.encode(), datestamp)
+        k_region = _sign(k_date, cfg.region)
+        k_service = _sign(k_region, 's3')
+        k_signing = _sign(k_service, 'aws4_request')
+        signature = hmac.new(k_signing, string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        auth = (f'AWS4-HMAC-SHA256 '
+                f'Credential={cfg.access_key_id}/{scope}, '
+                f'SignedHeaders={signed_headers}, Signature={signature}')
+        url = f'{parsed.scheme}://{host}{path}'
+        if canonical_query:
+            url += f'?{canonical_query}'
+        req = urllib.request.Request(url, data=body or None, method=method)
+        req.add_header('Authorization', auth)
+        for k, v in headers.items():
+            if k != 'host':
+                req.add_header(k, v)
+        return req
+
+    def _call(self, method: str, bucket: str, key: str = '',
+              query: Optional[Dict[str, str]] = None,
+              body: bytes = b'') -> Tuple[int, bytes]:
+        """Returns (status, body); HTTP errors are returned, not raised
+        (callers decide which codes are acceptable per operation)."""
+        req = self._signed_request(method, bucket, key, query, body)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except urllib.error.URLError as e:
+            raise exceptions.StorageError(
+                f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
+                f'{e.reason}') from e
+
+    # -- operations ----------------------------------------------------
+
+    def bucket_exists(self, bucket: str) -> bool:
+        code, _ = self._call('HEAD', bucket)
+        return code == 200
+
+    def create_bucket(self, bucket: str) -> None:
+        code, body = self._call('PUT', bucket)
+        if code not in (200, 204) and b'BucketAlreadyOwnedByYou' not in body:
+            raise exceptions.StorageError(
+                f'create bucket {bucket}: HTTP {code} {body[:300]!r}')
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        code, body = self._call('PUT', bucket, key, body=data)
+        if code not in (200, 204):
+            raise exceptions.StorageError(
+                f'put {bucket}/{key}: HTTP {code} {body[:300]!r}')
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        code, body = self._call('GET', bucket, key)
+        if code != 200:
+            raise exceptions.StorageError(
+                f'get {bucket}/{key}: HTTP {code} {body[:300]!r}')
+        return body
+
+    def list_objects(self, bucket: str,
+                     prefix: str = '') -> Iterator[str]:
+        """Yield keys under prefix (ListObjectsV2, paginated)."""
+        token: Optional[str] = None
+        while True:
+            query = {'list-type': '2'}
+            if prefix:
+                query['prefix'] = prefix
+            if token:
+                query['continuation-token'] = token
+            code, body = self._call('GET', bucket, query=query)
+            if code != 200:
+                raise exceptions.StorageError(
+                    f'list {bucket}/{prefix}: HTTP {code} {body[:300]!r}')
+            root = ElementTree.fromstring(body)
+            ns = ''
+            if root.tag.startswith('{'):
+                ns = root.tag.split('}')[0] + '}'
+            for el in root.findall(f'{ns}Contents'):
+                key_el = el.find(f'{ns}Key')
+                if key_el is not None and key_el.text:
+                    yield key_el.text
+            truncated = root.find(f'{ns}IsTruncated')
+            if truncated is None or truncated.text != 'true':
+                return
+            token_el = root.find(f'{ns}NextContinuationToken')
+            token = token_el.text if token_el is not None else None
+            if not token:
+                return
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._call('DELETE', bucket, key)
+
+    def delete_prefix(self, bucket: str, prefix: str = '') -> None:
+        for key in list(self.list_objects(bucket, prefix)):
+            self.delete_object(bucket, key)
+
+    def delete_bucket(self, bucket: str) -> None:
+        self.delete_prefix(bucket)
+        self._call('DELETE', bucket)
+
+    # -- directory sync ------------------------------------------------
+
+    def sync_up(self, local_dir: str, bucket: str, prefix: str = '') -> int:
+        """Upload a file or directory tree; returns object count."""
+        local_dir = os.path.expanduser(local_dir)
+        count = 0
+        if os.path.isfile(local_dir):
+            with open(local_dir, 'rb') as f:
+                key = os.path.join(prefix, os.path.basename(local_dir)) \
+                    if prefix else os.path.basename(local_dir)
+                self.put_object(bucket, key, f.read())
+            return 1
+        for dirpath, _, filenames in os.walk(local_dir):
+            for filename in filenames:
+                path = os.path.join(dirpath, filename)
+                rel = os.path.relpath(path, local_dir)
+                key = os.path.join(prefix, rel) if prefix else rel
+                with open(path, 'rb') as f:
+                    self.put_object(bucket, key.replace(os.sep, '/'),
+                                    f.read())
+                count += 1
+        return count
+
+    def sync_down(self, bucket: str, prefix: str, dest: str) -> int:
+        """Download all objects under prefix into dest; returns count."""
+        dest = os.path.expanduser(dest)
+        count = 0
+        for key in self.list_objects(bucket, prefix):
+            rel = key[len(prefix):].lstrip('/') if prefix else key
+            target = os.path.join(dest, rel)
+            os.makedirs(os.path.dirname(target) or dest, exist_ok=True)
+            with open(target, 'wb') as f:
+                f.write(self.get_object(bucket, key))
+            count += 1
+        return count
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI used by cluster-side COPY commands (runtime is shipped, so
+    every host can run `python3 -m skypilot_tpu.data.s3 ...`)."""
+    import argparse
+    parser = argparse.ArgumentParser('skyt-s3')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    down = sub.add_parser('sync-down')
+    down.add_argument('bucket')
+    down.add_argument('prefix')
+    down.add_argument('dest')
+    up = sub.add_parser('sync-up')
+    up.add_argument('source')
+    up.add_argument('bucket')
+    up.add_argument('--prefix', default='')
+    args = parser.parse_args(argv)
+    client = S3Client(S3Config.load())
+    if args.cmd == 'sync-down':
+        n = client.sync_down(args.bucket, args.prefix, args.dest)
+        print(f'downloaded {n} objects')
+    else:
+        n = client.sync_up(args.source, args.bucket, args.prefix)
+        print(f'uploaded {n} objects')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -48,20 +48,24 @@ class StorageMode(enum.Enum):
 
 class StoreType(enum.Enum):
     GCS = 'gcs'
+    S3 = 's3'
     LOCAL = 'local'
 
     @classmethod
     def from_uri(cls, uri: str) -> 'StoreType':
         if uri.startswith('gs://'):
             return cls.GCS
+        if uri.startswith(('s3://', 'r2://')):
+            return cls.S3
         if uri.startswith('file://') or uri.startswith('local://'):
             return cls.LOCAL
         raise exceptions.StorageError(f'Unsupported storage URI {uri!r} '
-                                      '(expected gs:// or file://)')
+                                      '(expected gs://, s3://, r2:// or '
+                                      'file://)')
 
 
 def _strip_scheme(uri: str) -> str:
-    for scheme in ('gs://', 'file://', 'local://'):
+    for scheme in ('gs://', 's3://', 'r2://', 'file://', 'local://'):
         if uri.startswith(scheme):
             return uri[len(scheme):]
     return uri
@@ -153,6 +157,75 @@ class GcsStore(AbstractStore):
     @property
     def url(self) -> str:
         return f'gs://{self.name}'
+
+
+@STORE_REGISTRY.register('s3')
+class S3CompatibleStore(AbstractStore):
+    """Any S3-compatible endpoint -- AWS, Cloudflare R2, MinIO, Ceph --
+    selected by ``storage.s3.endpoint_url`` config / env (parity:
+    sky/data/storage.py:1855 S3CompatibleStore; one class, many
+    providers). Wire protocol implemented in data/s3.py (stdlib SigV4),
+    so no aws-cli/boto3 is needed client- OR cluster-side."""
+
+    def _client(self):
+        from skypilot_tpu.data import s3 as s3_lib
+        return s3_lib.S3Client(s3_lib.S3Config.load())
+
+    def _env_prefix(self) -> str:
+        """Credential/endpoint exports prepended to cluster-side commands.
+
+        Hosts have no client config, so the client resolves the S3
+        endpoint + credentials at command-GENERATION time and embeds
+        them (parity: the reference rsyncs ~/.aws credentials files to
+        clusters -- same trust model, command-scoped instead of a file).
+        Also exports the shipped-runtime PYTHONPATH: COPY commands run
+        `python3 -m skypilot_tpu.data.s3` outside a job script.
+        """
+        import shlex
+        from skypilot_tpu.data import s3 as s3_lib
+        # Best-effort: commands still generate without client creds
+        # (hosts may authenticate via instance roles / their own env).
+        cfg = s3_lib.S3Config.load(require_credentials=False)
+        exports = [
+            'PYTHONPATH="$HOME/.skyt_runtime/runtime'
+            '${PYTHONPATH:+:$PYTHONPATH}"',
+            f'SKYT_S3_ENDPOINT_URL={shlex.quote(cfg.endpoint_url)}',
+            f'AWS_DEFAULT_REGION={shlex.quote(cfg.region)}',
+        ]
+        if cfg.access_key_id and cfg.secret_access_key:
+            exports.append(
+                f'AWS_ACCESS_KEY_ID={shlex.quote(cfg.access_key_id)}')
+            exports.append('AWS_SECRET_ACCESS_KEY='
+                           f'{shlex.quote(cfg.secret_access_key)}')
+        return 'export ' + ' '.join(exports) + ' && '
+
+    def exists(self) -> bool:
+        return self._client().bucket_exists(self.name)
+
+    def create(self) -> None:
+        self._client().create_bucket(self.name)
+
+    def upload(self, local_source: str, prefix: str = '') -> None:
+        self._client().sync_up(local_source, self.name, prefix)
+
+    def delete(self) -> None:
+        self._client().delete_bucket(self.name)
+
+    def mount_command(self, mount_path: str) -> str:
+        return self._env_prefix() + mounting_utils.s3_mount_command(
+            self.name, mount_path)
+
+    def mount_cached_command(self, mount_path: str) -> str:
+        return self._env_prefix() + mounting_utils.s3_mount_cached_command(
+            self.name, mount_path)
+
+    def download_command(self, dest: str, prefix: str = '') -> str:
+        return self._env_prefix() + mounting_utils.s3_download_command(
+            self.name, prefix, dest)
+
+    @property
+    def url(self) -> str:
+        return f's3://{self.name}'
 
 
 @STORE_REGISTRY.register('local')

@@ -119,12 +119,94 @@ def build_pod_manifest(request: ProvisionRequest, node: int, worker: int,
             'value': 'true',
             'effect': 'NoSchedule',
         }]
+    if _needs_fuse(request):
+        _add_fuse_proxy_mount(spec)
     return {
         'apiVersion': 'v1',
         'kind': 'Pod',
         'metadata': {'name': name, 'namespace': namespace,
                      'labels': labels},
         'spec': spec,
+    }
+
+
+def _needs_fuse(request: ProvisionRequest) -> bool:
+    """MOUNT/MOUNT_CACHED storage on an unprivileged pod needs the
+    fuse-proxy shim (labels carry the hint from the backend)."""
+    return request.labels.get('skyt-fuse') == 'true'
+
+
+FUSE_PROXY_SOCKET_DIR = '/run/skyt-fuse-proxy'
+
+
+def _add_fuse_proxy_mount(spec: Dict[str, Any]) -> None:
+    """Wire the pod to the node's fuse-proxy DaemonSet (addons/fuse_proxy
+    C++ rebuild of the reference's Go addons/fuse-proxy): the shim binary
+    + server socket arrive via hostPath, the shim is prepended to PATH so
+    gcsfuse/rclone transparently exec it instead of real fusermount --
+    NO privileged: true on the workload pod."""
+    spec.setdefault('volumes', []).append({
+        'name': 'skyt-fuse-proxy',
+        'hostPath': {'path': FUSE_PROXY_SOCKET_DIR,
+                     'type': 'DirectoryOrCreate'},
+    })
+    container = spec['containers'][0]
+    container.setdefault('volumeMounts', []).append({
+        'name': 'skyt-fuse-proxy',
+        'mountPath': FUSE_PROXY_SOCKET_DIR,
+    })
+    container.setdefault('env', []).append(
+        {'name': 'FUSE_PROXY_SOCKET',
+         'value': f'{FUSE_PROXY_SOCKET_DIR}/fuse-proxy.sock'})
+    # NOTE: the shim dir is prepended to PATH at mount-command run time
+    # (mounting_utils.fuse_proxy_path_prefix), in-shell -- setting a
+    # PATH env here would clobber whatever PATH the image bakes in.
+
+
+def build_fuse_proxy_daemonset(namespace: str) -> Dict[str, Any]:
+    """The privileged per-node fuse-proxy server (parity: the reference's
+    fuse-proxy DaemonSet manifest, addons/fuse-proxy README)."""
+    return {
+        'apiVersion': 'apps/v1',
+        'kind': 'DaemonSet',
+        'metadata': {'name': 'skyt-fuse-proxy', 'namespace': namespace},
+        'spec': {
+            'selector': {'matchLabels': {'app': 'skyt-fuse-proxy'}},
+            'template': {
+                'metadata': {'labels': {'app': 'skyt-fuse-proxy'}},
+                'spec': {
+                    'hostPID': True,
+                    'containers': [{
+                        'name': 'server',
+                        'image': DEFAULT_IMAGE,
+                        'command': [
+                            '/bin/sh', '-c',
+                            # Install shim for pods, then serve.
+                            f'mkdir -p {FUSE_PROXY_SOCKET_DIR}/bin && '
+                            f'cp /opt/skyt/fusermount-shim '
+                            f'{FUSE_PROXY_SOCKET_DIR}/bin/fusermount && '
+                            f'cp /opt/skyt/fusermount-shim '
+                            f'{FUSE_PROXY_SOCKET_DIR}/bin/fusermount3 && '
+                            f'exec /opt/skyt/fuse-proxy-server '
+                            f'{FUSE_PROXY_SOCKET_DIR}/fuse-proxy.sock',
+                        ],
+                        'securityContext': {'privileged': True},
+                        'volumeMounts': [
+                            {'name': 'proxy-dir',
+                             'mountPath': FUSE_PROXY_SOCKET_DIR},
+                            {'name': 'dev-fuse', 'mountPath': '/dev/fuse'},
+                        ],
+                    }],
+                    'volumes': [
+                        {'name': 'proxy-dir',
+                         'hostPath': {'path': FUSE_PROXY_SOCKET_DIR,
+                                      'type': 'DirectoryOrCreate'}},
+                        {'name': 'dev-fuse',
+                         'hostPath': {'path': '/dev/fuse'}},
+                    ],
+                },
+            },
+        },
     }
 
 

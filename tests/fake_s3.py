@@ -1,0 +1,154 @@
+"""In-process S3-compatible endpoint for store tests (moto-style, per
+SURVEY §4: 'a moto-equivalent fake' for offline provider testing).
+
+Implements the path-style subset the client uses: bucket HEAD/PUT/DELETE,
+object PUT/GET/DELETE, ListObjectsV2 with prefix + pagination. Requires a
+SigV4 Authorization header on every request (verifying the client signs)
+but does not validate the signature."""
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+from xml.sax.saxutils import escape
+
+
+class _State:
+    def __init__(self) -> None:
+        self.buckets: Dict[str, Dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+
+
+def _handler_for(state: _State):
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _split(self):
+            parsed = urllib.parse.urlparse(self.path)
+            parts = parsed.path.lstrip('/').split('/', 1)
+            bucket = parts[0]
+            key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ''
+            query = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(parsed.query).items()}
+            return bucket, key, query
+
+        def _reply(self, code: int, body: bytes = b'',
+                   ctype: str = 'application/xml'):
+            self.send_response(code)
+            self.send_header('Content-Type', ctype)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _check_auth(self) -> bool:
+            auth = self.headers.get('Authorization', '')
+            if not auth.startswith('AWS4-HMAC-SHA256'):
+                self._reply(403, b'<Error><Code>AccessDenied</Code></Error>')
+                return False
+            return True
+
+        def do_HEAD(self):  # noqa: N802
+            if not self._check_auth():
+                return
+            bucket, key, _ = self._split()
+            with state.lock:
+                if bucket not in state.buckets:
+                    self._reply(404)
+                elif key and key not in state.buckets[bucket]:
+                    self._reply(404)
+                else:
+                    self._reply(200)
+
+        def do_PUT(self):  # noqa: N802
+            if not self._check_auth():
+                return
+            bucket, key, _ = self._split()
+            length = int(self.headers.get('Content-Length', 0))
+            data = self.rfile.read(length) if length else b''
+            with state.lock:
+                if not key:
+                    state.buckets.setdefault(bucket, {})
+                    self._reply(200)
+                    return
+                if bucket not in state.buckets:
+                    self._reply(404, b'<Error><Code>NoSuchBucket</Code>'
+                                     b'</Error>')
+                    return
+                state.buckets[bucket][key] = data
+            self._reply(200)
+
+        def do_GET(self):  # noqa: N802
+            if not self._check_auth():
+                return
+            bucket, key, query = self._split()
+            with state.lock:
+                if bucket not in state.buckets:
+                    self._reply(404, b'<Error><Code>NoSuchBucket</Code>'
+                                     b'</Error>')
+                    return
+                objs = state.buckets[bucket]
+                if key:
+                    if key not in objs:
+                        self._reply(404, b'<Error><Code>NoSuchKey</Code>'
+                                         b'</Error>')
+                        return
+                    self._reply(200, objs[key],
+                                ctype='application/octet-stream')
+                    return
+                # ListObjectsV2 with small pages to exercise pagination
+                prefix = query.get('prefix', '')
+                token = query.get('continuation-token', '')
+                keys = sorted(k for k in objs if k.startswith(prefix))
+                if token:
+                    keys = [k for k in keys if k > token]
+                page, rest = keys[:2], keys[2:]
+                contents = ''.join(
+                    f'<Contents><Key>{escape(k)}</Key></Contents>'
+                    for k in page)
+                truncated = 'true' if rest else 'false'
+                next_token = (f'<NextContinuationToken>{escape(page[-1])}'
+                              f'</NextContinuationToken>'
+                              if rest else '')
+                xml = (f'<?xml version="1.0"?><ListBucketResult>'
+                       f'<IsTruncated>{truncated}</IsTruncated>'
+                       f'{contents}{next_token}</ListBucketResult>')
+                self._reply(200, xml.encode())
+
+        def do_DELETE(self):  # noqa: N802
+            if not self._check_auth():
+                return
+            bucket, key, _ = self._split()
+            with state.lock:
+                if key:
+                    state.buckets.get(bucket, {}).pop(key, None)
+                else:
+                    state.buckets.pop(bucket, None)
+            self._reply(204)
+
+    return Handler
+
+
+class FakeS3Server:
+    """`with FakeS3Server() as url:` -- a live endpoint on 127.0.0.1."""
+
+    def __init__(self) -> None:
+        self.state = _State()
+        self.httpd = ThreadingHTTPServer(('127.0.0.1', 0),
+                                         _handler_for(self.state))
+        self.httpd.daemon_threads = True
+        self.url = f'http://127.0.0.1:{self.httpd.server_address[1]}'
+
+    def __enter__(self) -> 'FakeS3Server':
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

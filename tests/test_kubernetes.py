@@ -15,11 +15,16 @@ from skypilot_tpu.spec.task import Task
 
 @pytest.fixture(autouse=True)
 def fake_k8s(tmp_home, monkeypatch):
+    from skypilot_tpu import check
     monkeypatch.setenv('SKYT_K8S_FAKE', '1')
     monkeypatch.setenv('SKYT_K8S_PROVISION_TIMEOUT', '2')
+    # The credential-probe cache is process-global; this fixture changes
+    # the env the kubernetes probe reads, so stale entries must go.
+    check.clear_cache()
     k8s.fake_reset()
     yield
     k8s.fake_reset()
+    check.clear_cache()
 
 
 def _request(accel='tpu-v5e-8', cluster='kc', num_nodes=1, **res_kw):

@@ -1,0 +1,248 @@
+"""S3-compatible store + fuse-proxy addon.
+
+Parity bars: ``sky/data/storage.py:1855 S3CompatibleStore`` (one store
+class, endpoint-selected provider) and ``addons/fuse-proxy`` (Go 726 LoC
+-> C++ rebuild; VERDICT r1 #8). The S3 tests run against the in-process
+fake endpoint (tests/fake_s3.py); the fuse tests compile the C++ with g++
+and exercise the full shim->server->fusermount fd-relay protocol with a
+mock fusermount.
+"""
+import os
+import shutil
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import s3 as s3_lib
+from skypilot_tpu.data.storage import Storage, StoreType
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fake_s3 import FakeS3Server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def s3_env(tmp_home, monkeypatch):
+    with FakeS3Server() as srv:
+        monkeypatch.setenv('SKYT_S3_ENDPOINT_URL', srv.url)
+        monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'test-key')
+        monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'test-secret')
+        yield srv
+
+
+# -- S3 client ---------------------------------------------------------
+
+
+def test_bucket_and_object_lifecycle(s3_env):
+    client = s3_lib.S3Client(s3_lib.S3Config.load())
+    assert not client.bucket_exists('b1')
+    client.create_bucket('b1')
+    assert client.bucket_exists('b1')
+    client.put_object('b1', 'dir/a.txt', b'hello')
+    assert client.get_object('b1', 'dir/a.txt') == b'hello'
+    client.delete_bucket('b1')
+    assert not client.bucket_exists('b1')
+
+
+def test_list_pagination_and_prefix(s3_env):
+    client = s3_lib.S3Client(s3_lib.S3Config.load())
+    client.create_bucket('b2')
+    for i in range(5):
+        client.put_object('b2', f'p/{i}.bin', b'x')
+    client.put_object('b2', 'other.bin', b'y')
+    keys = sorted(client.list_objects('b2', 'p/'))
+    assert keys == [f'p/{i}.bin' for i in range(5)]  # paginated (page=2)
+    assert len(list(client.list_objects('b2'))) == 6
+
+
+def test_sync_up_down_roundtrip(s3_env, tmp_path):
+    src = tmp_path / 'src'
+    (src / 'sub').mkdir(parents=True)
+    (src / 'a.txt').write_text('A')
+    (src / 'sub' / 'b.txt').write_text('B')
+    client = s3_lib.S3Client(s3_lib.S3Config.load())
+    client.create_bucket('b3')
+    assert client.sync_up(str(src), 'b3', 'ckpt') == 2
+    dest = tmp_path / 'dest'
+    assert client.sync_down('b3', 'ckpt', str(dest)) == 2
+    assert (dest / 'a.txt').read_text() == 'A'
+    assert (dest / 'sub' / 'b.txt').read_text() == 'B'
+
+
+def test_s3_cli_module(s3_env, tmp_path):
+    src = tmp_path / 'up'
+    src.mkdir()
+    (src / 'f.txt').write_text('via-cli')
+    client = s3_lib.S3Client(s3_lib.S3Config.load())
+    client.create_bucket('b4')
+    assert s3_lib.main(['sync-up', str(src), 'b4', '--prefix', 'p']) == 0
+    dest = tmp_path / 'down'
+    assert s3_lib.main(['sync-down', 'b4', 'p', str(dest)]) == 0
+    assert (dest / 'f.txt').read_text() == 'via-cli'
+
+
+def test_missing_credentials_raise(tmp_home, monkeypatch):
+    for var in ('AWS_ACCESS_KEY_ID', 'AWS_SECRET_ACCESS_KEY'):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(exceptions.StorageError, match='credentials'):
+        s3_lib.S3Config.load()
+
+
+# -- Storage integration ----------------------------------------------
+
+
+def test_storage_with_s3_store(s3_env, tmp_path):
+    src = tmp_path / 'data'
+    src.mkdir()
+    (src / 'x.txt').write_text('X')
+    storage = Storage('skyt-test-bucket', source=str(src), store='s3',
+                      mode='COPY')
+    storage.ensure_bucket()
+    client = s3_lib.S3Client(s3_lib.S3Config.load())
+    assert client.get_object('skyt-test-bucket', 'x.txt') == b'X'
+    cmd = storage.cluster_command('/data')
+    assert 'skypilot_tpu.data.s3 sync-down' in cmd
+    storage.persistent = False
+    storage.delete()
+    assert not client.bucket_exists('skyt-test-bucket')
+
+
+def test_s3_uri_inference():
+    assert StoreType.from_uri('s3://bkt/path') == StoreType.S3
+    assert StoreType.from_uri('r2://bkt') == StoreType.S3
+    storage = Storage(source='s3://some-bucket/sub')
+    assert storage.name == 'some-bucket'
+    # MOUNT of a sub-path is rejected; root mount works
+    with pytest.raises(exceptions.StorageError, match='sub-path'):
+        storage.cluster_command('/m')
+    root = Storage(source='s3://some-bucket')
+    assert 'rclone mount' in root.cluster_command('/m')
+
+
+def test_s3_mount_commands_shapes():
+    m = mounting_utils.s3_mount_command('bkt', '/m')
+    assert 'rclone mount' in m and 'skyt-s3:bkt' in m
+    mc = mounting_utils.s3_mount_cached_command('bkt', '/m')
+    assert 'vfs-cache-mode writes' in mc
+
+
+def test_s3_uri_inference_subpath_copy_prefix():
+    storage = Storage(source='s3://some-bucket/sub/dir', mode='COPY')
+    cmd = storage.cluster_command('/data')
+    assert "'sub/dir'" in cmd or 'sub/dir' in cmd
+
+
+# -- fuse-proxy (C++) --------------------------------------------------
+
+
+@pytest.fixture(scope='module')
+def fuse_binaries(tmp_path_factory):
+    if shutil.which('g++') is None and shutil.which('make') is None:
+        pytest.skip('no C++ toolchain')
+    build = tmp_path_factory.mktemp('fuse_build')
+    src_dir = os.path.join(REPO, 'addons', 'fuse_proxy')
+    proc = subprocess.run(
+        ['make', '-C', src_dir, f'BINDIR={build}'],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return {
+        'server': os.path.join(build, 'fuse-proxy-server'),
+        'shim': os.path.join(build, 'fusermount-shim'),
+    }
+
+
+def test_fuse_proxy_relays_exit_code_and_args(fuse_binaries, tmp_path):
+    """Full protocol: shim -> server -> (mock) fusermount, args + cwd +
+    rc relayed; the mock passes an fd back and the shim forwards it over
+    _FUSE_COMMFD like real fusermount."""
+    sock = str(tmp_path / 'p.sock')
+    marker = tmp_path / 'marker'
+    # Mock fusermount: records argv+cwd, sends one end of a pipe back
+    # over _FUSE_COMMFD (what real fusermount does with /dev/fuse).
+    mock = tmp_path / 'mock_fusermount.py'
+    mock.write_text(f'''#!{sys.executable}
+import array, os, socket, sys
+with open({str(marker)!r}, 'w') as f:
+    f.write(' '.join(sys.argv[1:]) + '\\n' + os.getcwd())
+commfd = int(os.environ['_FUSE_COMMFD'])
+r, w = os.pipe()
+os.write(w, b'fd-payload')
+sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM, fileno=commfd)
+sock.sendmsg([b'F'], [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                       array.array('i', [r]))])
+sys.exit(7)
+''')
+    mock.chmod(0o755)
+    env = {**os.environ, 'FUSE_PROXY_SOCKET': sock,
+           'FUSE_PROXY_FUSERMOUNT': str(mock)}
+    server = subprocess.Popen([fuse_binaries['server']], env=env,
+                              stderr=subprocess.PIPE)
+    try:
+        # wait for the socket
+        for _ in range(100):
+            if os.path.exists(sock):
+                break
+            import time
+            time.sleep(0.05)
+        # act as the FUSE client library: make the _FUSE_COMMFD pair
+        left, right = socket.socketpair(socket.AF_UNIX,
+                                        socket.SOCK_STREAM)
+        workdir = tmp_path / 'wd'
+        workdir.mkdir()
+        shim_env = {**env, '_FUSE_COMMFD': str(right.fileno())}
+        proc = subprocess.run(
+            [fuse_binaries['shim'], '-o', 'rw', '/mnt/test'],
+            env=shim_env, cwd=str(workdir),
+            pass_fds=(right.fileno(),),
+            capture_output=True, text=True, timeout=30)
+        # rc relayed from the mock fusermount
+        assert proc.returncode == 7, proc.stderr
+        # args + cwd relayed to the (mock) fusermount
+        recorded = marker.read_text().splitlines()
+        assert recorded[0] == '-o rw /mnt/test'
+        assert recorded[1] == str(workdir)
+        # the mount fd came back through _FUSE_COMMFD
+        import array
+        msg, ancdata, _, _ = left.recvmsg(1, socket.CMSG_SPACE(4))
+        assert msg == b'F'
+        fds = array.array('i')
+        fds.frombytes(ancdata[0][2])
+        payload = os.read(fds[0], 16)
+        assert payload == b'fd-payload'
+    finally:
+        server.kill()
+
+
+def test_fuse_proxy_pod_wiring(tmp_home):
+    from skypilot_tpu.provision import kubernetes as k8s
+    from skypilot_tpu.provision.api import ProvisionRequest
+    from skypilot_tpu.spec.resources import Resources
+    req = ProvisionRequest(
+        cluster_name='c', num_nodes=1, region='r', zone=None,
+        resources=Resources(cloud='kubernetes',
+                            accelerators='tpu-v5e-8'),
+        labels={'skyt-fuse': 'true'})
+    manifest = k8s.build_pod_manifest(req, 0, 0, 'default')
+    spec = manifest['spec']
+    assert any(v['name'] == 'skyt-fuse-proxy'
+               for v in spec.get('volumes', []))
+    env = {e['name']: e['value']
+           for e in spec['containers'][0].get('env', [])}
+    assert env['FUSE_PROXY_SOCKET'].endswith('fuse-proxy.sock')
+    # PATH is NOT set in the manifest (would clobber the image's PATH);
+    # mount commands prepend the shim dir in-shell instead.
+    assert 'PATH' not in env
+    from skypilot_tpu.data import mounting_utils
+    assert mounting_utils.FUSE_PROXY_PATH_PREFIX in \
+        mounting_utils.gcs_mount_command('b', '/m')
+    # the workload pod itself is NOT privileged
+    assert 'privileged' not in str(spec['containers'][0].get(
+        'securityContext', {}))
+    ds = k8s.build_fuse_proxy_daemonset('default')
+    tpl = ds['spec']['template']['spec']
+    assert tpl['containers'][0]['securityContext']['privileged'] is True

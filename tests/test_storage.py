@@ -48,7 +48,7 @@ def test_storage_spec_parsing():
     with pytest.raises(exceptions.StorageError, match='Invalid storage'):
         Storage('x', mode='MONT')
     with pytest.raises(exceptions.StorageError):
-        Storage('x', store='s3')              # unknown store backend
+        Storage('x', store='oci')             # unknown store backend
 
 
 def test_storage_source_uri_infers_name_and_prefix():
