@@ -1,0 +1,3 @@
+{{- define "skypilot-tpu.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 53 | trimSuffix "-" -}}
+{{- end -}}

@@ -69,6 +69,16 @@ def _load_policy() -> Optional[AdminPolicy]:
     return policy
 
 
+# Plugin-registered policies, chained after the configured one
+# (plugins.PluginContext.register_admin_policy).
+_plugin_policies: list = []
+
+
+def register_policy(fn) -> None:
+    """fn(UserRequest) -> MutatedUserRequest, chained per request."""
+    _plugin_policies.append(fn)
+
+
 def apply(task: Task, operation: str,
           request_options: Optional[Dict[str, Any]] = None) -> Task:
     """Run the configured policy over the task (no-op when unset).
@@ -80,13 +90,18 @@ def apply(task: Task, operation: str,
     if task.policy_applied:
         return task
     policy = _load_policy()
-    if policy is None:
+    if policy is None and not _plugin_policies:
         return task
     request = UserRequest(task=task, operation=operation,
                           request_options=dict(request_options or {}))
-    mutated = policy.validate_and_mutate(request)
-    if not isinstance(mutated, MutatedUserRequest):
-        raise exceptions.InvalidSpecError(
-            'admin policy must return a MutatedUserRequest')
-    mutated.task.policy_applied = True
-    return mutated.task
+    chain = (([policy.validate_and_mutate] if policy else []) +
+             list(_plugin_policies))
+    for step in chain:
+        mutated = step(request)
+        if not isinstance(mutated, MutatedUserRequest):
+            raise exceptions.InvalidSpecError(
+                'admin policy must return a MutatedUserRequest')
+        request = UserRequest(task=mutated.task, operation=operation,
+                              request_options=request.request_options)
+    request.task.policy_applied = True
+    return request.task

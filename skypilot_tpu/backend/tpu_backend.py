@@ -67,6 +67,11 @@ class TpuPodBackend(Backend):
                           candidates: List[Candidate],
                           blocklist=None) -> ClusterInfo:
         record = state.get_cluster(cluster_name)
+        if record is not None:
+            # Reusing (or resuming) an existing cluster crosses into its
+            # workspace; same guard as core ops (_get_record).
+            from skypilot_tpu import workspaces
+            workspaces.check_cluster_access(record, op='launch on')
         if record is not None and record.status == state.ClusterStatus.UP:
             info = ClusterInfo.from_dict(record.handle)
             # Reuse only if the existing cluster satisfies the request
@@ -81,15 +86,24 @@ class TpuPodBackend(Backend):
                     f'which does not satisfy the requested resources. '
                     f'Use a new cluster name or `skyt down {cluster_name}`.')
             state.touch_cluster(cluster_name)
+            # The reuse path still mounts task volumes (sync stage), so
+            # they must be recorded as attached — otherwise `volumes
+            # delete` would pass the in-use check and pull the backing
+            # storage out from under the running job.
+            if task.volumes:
+                from skypilot_tpu import volumes as volumes_lib
+                for mount in self._resolve_volumes(task):
+                    volumes_lib.note_attached(mount['name'], cluster_name)
             return info
         resume = record is not None and (
             record.status == state.ClusterStatus.STOPPED)
         state.add_or_update_cluster(
             cluster_name, status=state.ClusterStatus.INIT,
             num_nodes=task.num_nodes)
+        volume_mounts = self._resolve_volumes(task)
         info, chosen = provision_with_failover(
             cluster_name, candidates, task.num_nodes, resume=resume,
-            blocklist=blocklist)
+            blocklist=blocklist, volumes=volume_mounts)
         autostop = chosen.resources.autostop
         state.add_or_update_cluster(
             cluster_name,
@@ -106,7 +120,29 @@ class TpuPodBackend(Backend):
         self._start_runtime_daemon(
             info, autostop=(autostop.to_yaml_config()
                             if autostop.enabled else {}))
+        if volume_mounts:
+            from skypilot_tpu import volumes as volumes_lib
+            for mount in volume_mounts:
+                volumes_lib.note_attached(mount['name'], cluster_name)
         return info
+
+    @staticmethod
+    def _resolve_volumes(task: Task) -> List[Dict]:
+        """task.volumes (mount_path -> name) resolved against the volume
+        table; every named volume must exist (`skyt volumes apply`)."""
+        if not task.volumes:
+            return []
+        from skypilot_tpu import volumes as volumes_lib
+        resolved = []
+        for mount_path, volume_name in sorted(task.volumes.items()):
+            record = volumes_lib.get(volume_name)  # raises if missing
+            resolved.append({
+                'name': volume_name,
+                'mount_path': mount_path,
+                'type': record['type'],
+                'config': record['config'],
+            })
+        return resolved
 
     def _start_runtime_daemon(self, info: ClusterInfo,
                               autostop=None) -> None:
@@ -161,6 +197,18 @@ class TpuPodBackend(Backend):
             storage.ensure_bucket()
             self._run_mount_command(runners, dst,
                                     storage.cluster_command(dst))
+        # Named volumes. k8s PVCs are already in the pod manifest
+        # (provision-time); command-mounted providers (fake/local hostpath,
+        # GCE PD) get their mount commands run on every host here.
+        if task.volumes:
+            from skypilot_tpu import volumes as volumes_lib
+            for mount_path, volume_name in sorted(task.volumes.items()):
+                record = volumes_lib.get(volume_name)
+                if record['type'] == 'k8s-pvc':
+                    continue
+                for cmd in volumes_lib.mount_commands(volume_name,
+                                                      mount_path):
+                    self._run_mount_command(runners, mount_path, cmd)
 
     @staticmethod
     def _run_mount_command(runners, dst: str, cmd: str) -> None:

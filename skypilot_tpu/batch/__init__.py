@@ -1,0 +1,11 @@
+"""Sky Batch equivalent: map a dataset over a worker pool.
+
+Parity: ``sky/batch/`` (coordinator.py:1-21 lifecycle, worker.py:1-13,
+dataset.py, io_formats.py). See dataset.Dataset for the user entrypoint.
+"""
+from skypilot_tpu.batch.dataset import Dataset
+from skypilot_tpu.batch.io_formats import (JsonlReader, JsonReader,
+                                           read_records, write_records)
+
+__all__ = ['Dataset', 'JsonlReader', 'JsonReader', 'read_records',
+           'write_records']

@@ -1,0 +1,179 @@
+"""Batch coordinator: split → dispatch to pool workers → merge.
+
+Parity: ``sky/batch/coordinator.py`` (:1-21 lifecycle — count & split the
+dataset, discover pool workers, dispatch batches, track progress, merge).
+Differences from the reference, deliberately: the mapper is a SHELL
+COMMAND contract instead of a cloudpickled Python function — the worker
+runs ``run_command`` with ``$BATCH_INPUT``/``$BATCH_OUTPUT`` pointing at
+JSONL files. That keeps workers language-agnostic (a JAX tokenizer, a
+C++ binary, a python script) and removes the pickle-version coupling the
+reference carries between client and worker.
+
+Fault model: a batch whose job fails (or whose worker disappears —
+preemption) is requeued onto another worker, up to ``max_retries`` times;
+the pool's serve controller independently replaces the lost worker.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.batch import io_formats
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+_REMOTE_DIR = '~/.skyt_batch'
+
+
+class BatchJob:
+    def __init__(self, index: int, records: List[Dict[str, Any]]) -> None:
+        self.index = index
+        self.records = records
+        self.attempts = 0
+        self.results: Optional[List[Dict[str, Any]]] = None
+        self.error: Optional[str] = None
+
+
+class BatchCoordinator:
+    """Runs inline in the caller (the reference runs inline on the jobs
+    controller, coordinator.py:1-21 — same stance: no extra cluster)."""
+
+    def __init__(self,
+                 pool_name: str,
+                 run_command: str,
+                 *,
+                 max_retries: int = 2,
+                 poll_seconds: float = 0.5) -> None:
+        self.pool_name = pool_name
+        self.run_command = run_command
+        self.max_retries = max_retries
+        self.poll_seconds = poll_seconds
+
+    # ------------------------------------------------------------------
+
+    def run(self, batches: List[List[Dict[str, Any]]]
+            ) -> List[Dict[str, Any]]:
+        from skypilot_tpu.jobs import pools
+        jobs = [BatchJob(i, records) for i, records in enumerate(batches)]
+        pending: List[BatchJob] = list(jobs)
+        failed: List[BatchJob] = []
+        done = threading.Event()
+        lock = threading.Lock()
+        busy_workers: Dict[str, BatchJob] = {}
+
+        def next_job() -> Optional[BatchJob]:
+            with lock:
+                return pending.pop(0) if pending else None
+
+        dispatch_error: List[BaseException] = []
+
+        def dispatch_loop() -> None:
+            try:
+                while not done.is_set():
+                    workers = [
+                        w for w in pools.ready_workers(self.pool_name)
+                        if w not in busy_workers]
+                    job = None
+                    for worker in workers:
+                        job = next_job()
+                        if job is None:
+                            break
+                        with lock:
+                            busy_workers[worker] = job
+                        threading.Thread(target=run_one,
+                                         args=(worker, job),
+                                         daemon=True).start()
+                    with lock:
+                        all_done = (not pending and not busy_workers)
+                    if all_done:
+                        done.set()
+                        return
+                    time.sleep(self.poll_seconds)
+            except BaseException as e:  # pylint: disable=broad-except
+                # Pool vanished / serve state error: surface it — a dead
+                # dispatcher must never read as a successful (partial)
+                # map.
+                dispatch_error.append(e)
+                done.set()
+
+        def run_one(worker: str, job: BatchJob) -> None:
+            job.attempts += 1
+            try:
+                job.results = self._run_batch_on_worker(worker, job)
+                logger.info('Batch %d done on %s (%d records)', job.index,
+                            worker, len(job.results))
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('Batch %d failed on %s (attempt %d): %s',
+                               job.index, worker, job.attempts, e)
+                with lock:
+                    if job.attempts <= self.max_retries:
+                        pending.append(job)
+                    else:
+                        job.error = str(e)
+                        failed.append(job)
+            finally:
+                with lock:
+                    busy_workers.pop(worker, None)
+
+        dispatcher = threading.Thread(target=dispatch_loop, daemon=True)
+        dispatcher.start()
+        dispatcher.join()
+        if dispatch_error:
+            raise exceptions.SkytError(
+                f'batch dispatch aborted: {dispatch_error[0]}'
+            ) from dispatch_error[0]
+        if failed:
+            raise exceptions.SkytError(
+                f'{len(failed)}/{len(jobs)} batches failed after '
+                f'{self.max_retries + 1} attempts; first error: '
+                f'{failed[0].error}')
+        merged: List[Dict[str, Any]] = []
+        for job in jobs:
+            merged.extend(job.results or [])
+        return merged
+
+    # ------------------------------------------------------------------
+
+    def _run_batch_on_worker(self, worker: str,
+                             job: BatchJob) -> List[Dict[str, Any]]:
+        """Ship input JSONL → run the mapper command → fetch output."""
+        from skypilot_tpu import state
+        from skypilot_tpu.provision.api import ClusterInfo
+        from skypilot_tpu.utils.command_runner import runners_for_cluster
+        record = state.get_cluster(worker)
+        if record is None or record.status != state.ClusterStatus.UP:
+            raise exceptions.ClusterNotUpError(f'worker {worker} is gone')
+        info = ClusterInfo.from_dict(record.handle)
+        runner = runners_for_cluster(info)[0]  # mapper runs on the head
+
+        # Directory-granular transfer: every runner flavor (rsync-over-
+        # ssh, kubectl tar pipes, local copy) moves DIRECTORIES reliably;
+        # single-file semantics differ between them.
+        remote_dir = f'{_REMOTE_DIR}/batch_{job.index}'
+        remote_in = f'{remote_dir}/in.jsonl'
+        remote_out = f'{remote_dir}/out.jsonl'
+        with tempfile.TemporaryDirectory() as tmp:
+            in_dir = os.path.join(tmp, 'in')
+            io_formats.write_records(os.path.join(in_dir, 'in.jsonl'),
+                                     job.records)
+            runner.rsync(in_dir, remote_dir, up=True)
+            script = (f'export BATCH_INPUT={remote_in} '
+                      f'BATCH_OUTPUT={remote_out} '
+                      f'BATCH_INDEX={job.index}\n'
+                      f'rm -f {remote_out}\n'
+                      f'{self.run_command}')
+            code, output = runner.run(script)
+            if code != 0:
+                raise exceptions.CommandError(
+                    code, f'batch {job.index} mapper',
+                    error_msg=output[-1000:])
+            out_dir = os.path.join(tmp, 'out')
+            runner.rsync(out_dir, remote_dir, up=False)
+            return io_formats.read_records(
+                os.path.join(out_dir, 'out.jsonl'))

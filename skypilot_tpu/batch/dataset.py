@@ -1,0 +1,81 @@
+"""Dataset: the Sky Batch user entrypoint (parity: sky/batch/dataset.py).
+
+    from skypilot_tpu import batch
+    ds = batch.Dataset.from_jsonl('prompts.jsonl')
+    results = ds.map(
+        run='python tokenize.py',      # reads $BATCH_INPUT, writes $BATCH_OUTPUT
+        pool='tok-pool',               # `skyt jobs pool apply` beforehand
+        batch_size=64,
+    )
+    results.to_jsonl('tokens.jsonl')
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.batch import io_formats
+from skypilot_tpu.batch.coordinator import BatchCoordinator
+
+
+class BatchResult:
+    def __init__(self, records: List[Dict[str, Any]]) -> None:
+        self.records = records
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __iter__(self):
+        return iter(self.records)
+
+    def to_jsonl(self, path: str) -> None:
+        io_formats.write_records(path, self.records)
+
+
+class Dataset:
+    def __init__(self, records: List[Dict[str, Any]]) -> None:
+        self.records = records
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> 'Dataset':
+        return cls(io_formats.JsonlReader(path).read())
+
+    @classmethod
+    def from_json(cls, path: str) -> 'Dataset':
+        return cls(io_formats.JsonReader(path).read())
+
+    @classmethod
+    def from_list(cls, records: List[Dict[str, Any]]) -> 'Dataset':
+        return cls(list(records))
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def split(self, batch_size: int) -> List[List[Dict[str, Any]]]:
+        if batch_size < 1:
+            raise exceptions.InvalidSpecError('batch_size must be >= 1')
+        return [self.records[i:i + batch_size]
+                for i in range(0, len(self.records), batch_size)]
+
+    def map(self,
+            *,
+            run: str,
+            pool: str,
+            batch_size: int = 32,
+            max_retries: int = 2,
+            min_workers: int = 1,
+            wait_timeout: float = 300.0) -> BatchResult:
+        """Map ``run`` over the dataset on ``pool``'s workers.
+
+        ``run`` is a shell command executed per batch on a worker with
+        ``$BATCH_INPUT`` (JSONL of the batch's records) and
+        ``$BATCH_OUTPUT`` (where it must write result JSONL) set.
+        """
+        if not self.records:
+            return BatchResult([])
+        from skypilot_tpu.jobs import pools
+        pools.wait_ready(pool, min_workers=min_workers,
+                         timeout=wait_timeout)
+        coordinator = BatchCoordinator(pool, run, max_retries=max_retries)
+        merged = coordinator.run(self.split(batch_size))
+        return BatchResult(merged)

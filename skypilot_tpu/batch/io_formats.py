@@ -1,0 +1,68 @@
+"""Batch input/output formats (parity: sky/batch/io_formats.py).
+
+Records are JSON-serializable dicts. Readers load a dataset file into a
+record list; ``write_records`` persists results. The on-wire batch format
+between coordinator and workers is always JSONL (one record per line) —
+simple to stream, append, and resume.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List
+
+Record = Dict[str, Any]
+
+
+class JsonlReader:
+    """One JSON object per line."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def read(self) -> List[Record]:
+        records = []
+        with open(self.path, encoding='utf-8') as f:
+            for line_no, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f'{self.path}:{line_no}: bad JSONL: {e}') from e
+        return records
+
+
+class JsonReader:
+    """A single JSON array of objects."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def read(self) -> List[Record]:
+        with open(self.path, encoding='utf-8') as f:
+            data = json.load(f)
+        if not isinstance(data, list):
+            raise ValueError(f'{self.path}: expected a JSON array')
+        return data
+
+
+def reader_for(path: str):
+    if path.endswith('.jsonl') or path.endswith('.ndjson'):
+        return JsonlReader(path)
+    if path.endswith('.json'):
+        return JsonReader(path)
+    raise ValueError(f'No reader for {path!r} (use .jsonl or .json)')
+
+
+def read_records(path: str) -> List[Record]:
+    return reader_for(path).read()
+
+
+def write_records(path: str, records: Iterable[Record]) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        for record in records:
+            f.write(json.dumps(record) + '\n')

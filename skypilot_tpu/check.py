@@ -44,23 +44,55 @@ def _check_kubernetes() -> Tuple[bool, str]:
     return False, 'no kubeconfig found'
 
 
+def _check_ssh() -> Tuple[bool, str]:
+    from skypilot_tpu.provision.ssh_pool import (inventory_path,
+                                                 load_inventory)
+    pools = load_inventory()
+    if pools:
+        hosts = sum(len(p['hosts']) for p in pools.values())
+        return True, f'{len(pools)} pool(s), {hosts} host(s)'
+    return False, f'no SSH node pools at {inventory_path()}'
+
+
+def _check_slurm() -> Tuple[bool, str]:
+    from skypilot_tpu.provision.slurm import slurm_available
+    if slurm_available():
+        return True, 'sinfo reachable'
+    return False, 'no slurm binaries (set slurm.command_prefix for a ' \
+                  'remote login node)'
+
+
 _CHECKS = {
     'local': lambda: (True, 'always available'),
     'fake': lambda: (True, 'always available (simulated cloud)'),
     'gcp': _check_gcp,
     'kubernetes': _check_kubernetes,
+    'ssh': _check_ssh,
+    'slurm': _check_slurm,
 }
+
+
+def _cache_scope() -> str:
+    """Probe results depend on the active environment (state dir / HOME
+    hold inventories and credentials); keying the cache on it keeps a
+    process that switches environments — the test suite, an executor
+    child with a per-request HOME — from reading another scope's stale
+    verdicts."""
+    return (os.environ.get('SKYT_STATE_DIR', '') + ':' +
+            os.path.expanduser('~'))
 
 
 def check(clouds: List[str] = None, quiet: bool = True) -> Dict[str, Tuple[bool, str]]:
     """Probe each cloud; returns cloud -> (enabled, reason)."""
     results = {}
     now = time.time()
+    scope = _cache_scope()
     for cloud in (clouds or sorted(_CHECKS)):
-        cached = _cache.get(cloud)
+        key = f'{scope}|{cloud}'
+        cached = _cache.get(key)
         if cached is None or now - cached[0] > _ttl():
-            _cache[cloud] = (now, _CHECKS[cloud]())
-        results[cloud] = _cache[cloud][1]
+            _cache[key] = (now, _CHECKS[cloud]())
+        results[cloud] = _cache[key][1]
         if not quiet:
             ok, reason = results[cloud]
             print(f'  {cloud}: {"enabled" if ok else "disabled"} ({reason})')

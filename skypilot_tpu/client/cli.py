@@ -90,9 +90,12 @@ def exec_cmd(entrypoint: str, cluster: str, async_: bool) -> None:
 @cli.command()
 @click.argument('clusters', nargs=-1)
 @click.option('--refresh', '-r', is_flag=True, default=False)
-def status(clusters, refresh: bool) -> None:
-    """Show clusters."""
-    records = _run(sdk.status(list(clusters) or None, refresh=refresh),
+@click.option('--all-workspaces', '-u', is_flag=True, default=False,
+              help='Show clusters from every workspace.')
+def status(clusters, refresh: bool, all_workspaces: bool) -> None:
+    """Show clusters (scoped to the active workspace)."""
+    records = _run(sdk.status(list(clusters) or None, refresh=refresh,
+                              all_workspaces=all_workspaces),
                    False, stream=False)
     for r in records or []:
         res = r.get('resources') or {}
@@ -264,6 +267,57 @@ def jobs_logs(job_id: int, controller: bool) -> None:
     _run(sdk.jobs_logs(job_id, controller=controller), False)
 
 
+@jobs.command('launch-group')
+@click.argument('entrypoints', nargs=-1, required=True)
+@click.option('--name', '-n', 'group_name', required=True)
+def jobs_launch_group(entrypoints, group_name: str) -> None:
+    """Gang-schedule several task YAMLs as one group (all provision
+    before any runs; one failure cancels the rest)."""
+    tasks = [Task.from_yaml(e) for e in entrypoints]
+    job_ids = _run(sdk.jobs_launch_group(tasks, group_name), False,
+                   stream=False)
+    click.echo(f'group {group_name}: jobs {job_ids}')
+
+
+@jobs.group('pool')
+def jobs_pool() -> None:
+    """Pre-provisioned worker pools for jobs/batch."""
+
+
+@jobs_pool.command('apply')
+@click.argument('entrypoint')
+@click.option('--pool', '-p', 'pool_name', required=True)
+@click.option('--workers', '-n', type=int, default=None)
+def jobs_pool_apply(entrypoint: str, pool_name: str,
+                    workers: Optional[int]) -> None:
+    """Create or resize a worker pool from a task YAML."""
+    task = Task.from_yaml(entrypoint)
+    result = _run(sdk.pool_apply(task, pool_name, workers), False,
+                  stream=False)
+    click.echo(f"pool {result['name']} applying")
+
+
+@jobs_pool.command('status')
+@click.argument('pool_name', required=False, default=None)
+def jobs_pool_status(pool_name: Optional[str]) -> None:
+    rows = _run(sdk.pool_status(pool_name), False, stream=False)
+    flat = []
+    for r in rows or []:
+        ready = sum(1 for rep in r.get('replicas', [])
+                    if rep.get('status') == 'READY')
+        flat.append({'name': r['name'], 'status': r['status'],
+                     'workers': f"{ready}/{len(r.get('replicas', []))}"})
+    _echo_table(flat, ['name', 'status', 'workers'])
+
+
+@jobs_pool.command('down')
+@click.argument('pool_name')
+@click.option('--purge', is_flag=True, default=False)
+def jobs_pool_down(pool_name: str, purge: bool) -> None:
+    _run(sdk.pool_down(pool_name, purge=purge), False, stream=False)
+    click.echo(f'pool {pool_name} shutting down')
+
+
 # -- serving -----------------------------------------------------------
 
 
@@ -335,6 +389,38 @@ def api_start() -> None:
 def api_stop() -> None:
     stopped = sdk.api_stop()
     click.echo('API server stopped.' if stopped else 'No server running.')
+
+
+@api.command('login')
+@click.option('--endpoint', '-e', required=True,
+              help='Remote API server URL, e.g. http://skyt.corp:46590')
+@click.option('--token', '-t', default=None,
+              help='Bearer token (prompted for if omitted and required).')
+def api_login(endpoint: str, token: Optional[str]) -> None:
+    """Point this client at a (remote) API server and store credentials
+    (parity: `sky api login`; the token replaces the browser OAuth flow
+    — mint one with `skyt users token`)."""
+    endpoint = endpoint.rstrip('/')
+    if not sdk.api_is_healthy(endpoint):
+        raise click.ClickException(f'No healthy API server at {endpoint}')
+    from skypilot_tpu import config
+    import requests as requests_lib
+    headers = {'Authorization': f'Bearer {token}'} if token else {}
+    resp = requests_lib.get(f'{endpoint}/api/requests', headers=headers,
+                            timeout=10)
+    if resp.status_code == 401:
+        if token is None:
+            token = click.prompt('Bearer token', hide_input=True)
+            headers = {'Authorization': f'Bearer {token}'}
+            resp = requests_lib.get(f'{endpoint}/api/requests',
+                                    headers=headers, timeout=10)
+        if resp.status_code == 401:
+            raise click.ClickException('Token rejected (401).')
+    config.set_nested(('api_server', 'endpoint'), endpoint)
+    if token:
+        config.set_nested(('api_server', 'token'), token)
+    click.echo(f'Logged in to {endpoint}'
+               f'{" (token stored)" if token else ""}.')
 
 
 @api.command('status')
@@ -456,7 +542,139 @@ def users_token(name: Optional[str], label: str, local: bool) -> None:
         click.echo(sdk.users_token(name, label))
 
 
+# -- ssh (parity: command.py ssh :8212 + websocket proxy) --------------
+
+
+@cli.command('ssh')
+@click.argument('cluster')
+@click.argument('command', nargs=-1)
+def ssh_cmd(cluster: str, command) -> None:
+    """Open an SSH session to a cluster's head host (tunneled through
+    the API server, so it works without a direct route to cluster IPs)."""
+    info = _run(sdk.ssh_info(cluster), False, stream=False)
+    proxy = (f'{sys.executable} -m skypilot_tpu.client.cli api '
+             f'tunnel-stdio {cluster}')
+    args = ['ssh',
+            '-o', f'ProxyCommand={proxy}',
+            '-o', 'StrictHostKeyChecking=no',
+            '-o', 'UserKnownHostsFile=/dev/null',
+            '-o', 'LogLevel=ERROR']
+    key = info.get('key_path')
+    if key and os.path.exists(os.path.expanduser(key)):
+        args += ['-i', os.path.expanduser(key)]
+    args += [f'{info["user"]}@skyt.{cluster}'] + list(command)
+    os.execvp('ssh', args)
+
+
+@api.command('tunnel-stdio', hidden=True)
+@click.argument('cluster')
+@click.option('--port', type=int, default=None)
+def api_tunnel_stdio(cluster: str, port: Optional[int]) -> None:
+    """ProxyCommand mode: pump stdin/stdout through /api/tunnel."""
+    sys.exit(sdk.tunnel_stdio(cluster, port))
+
+
+# -- volumes (parity: command.py volumes group :5435) ------------------
+
+
+@cli.group()
+def volumes() -> None:
+    """Manage persistent volumes."""
+
+
+@volumes.command('apply')
+@click.argument('name')
+@click.option('--type', 'type_', required=True,
+              type=click.Choice(['k8s-pvc', 'hostpath', 'gce-pd']))
+@click.option('--size', default='10', help='Size in GiB.')
+@click.option('--zone', default=None)
+@click.option('--use-existing', is_flag=True, default=False)
+def volumes_apply(name: str, type_: str, size: str, zone: Optional[str],
+                  use_existing: bool) -> None:
+    """Create (or adopt) a volume."""
+    record = _run(sdk.volumes_apply({
+        'name': name, 'type': type_, 'size': size, 'zone': zone,
+        'use_existing': use_existing}), False, stream=False)
+    click.echo(f"volume {record['name']} ({record['type']}, "
+               f"{record['size_gb']}GiB): {record['status']}")
+
+
+@volumes.command('ls')
+def volumes_ls() -> None:
+    rows = _run(sdk.volumes_ls(), False, stream=False)
+    for r in rows or []:
+        r['attached_to'] = ','.join(r.get('attached_to') or []) or '-'
+    _echo_table(rows or [],
+                ['name', 'type', 'size_gb', 'status', 'attached_to'])
+
+
+@volumes.command('delete')
+@click.argument('name')
+def volumes_delete(name: str) -> None:
+    _run(sdk.volumes_delete(name), False, stream=False)
+    click.echo(f'volume {name} deleted')
+
+
+# -- workspaces (parity: command.py workspace group :8110) -------------
+
+
+@cli.group()
+def workspace() -> None:
+    """Manage workspaces (multi-tenant resource isolation)."""
+
+
+@workspace.command('list')
+def workspace_list() -> None:
+    from skypilot_tpu import workspaces
+    active = workspaces.active_workspace()
+    rows = []
+    for name, spec in sorted(workspaces.list_workspaces().items()):
+        rows.append({
+            'name': ('* ' if name == active else '  ') + name,
+            'allowed_clouds': ','.join(spec.get('allowed_clouds') or [])
+                              or '(any)',
+            'description': spec.get('description', ''),
+        })
+    _echo_table(rows, ['name', 'allowed_clouds', 'description'])
+
+
+@workspace.command('create')
+@click.argument('name')
+@click.option('--allowed-cloud', 'allowed', multiple=True,
+              help='Restrict the workspace to these clouds (repeatable).')
+@click.option('--description', default='')
+def workspace_create(name: str, allowed, description: str) -> None:
+    from skypilot_tpu import workspaces
+    workspaces.create_workspace(name, list(allowed) or None, description)
+    click.echo(f'workspace {name} created')
+
+
+@workspace.command('delete')
+@click.argument('name')
+def workspace_delete(name: str) -> None:
+    from skypilot_tpu import workspaces
+    try:
+        workspaces.delete_workspace(name)
+    except exceptions.SkytError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'workspace {name} deleted')
+
+
+@workspace.command('switch')
+@click.argument('name')
+def workspace_switch(name: str) -> None:
+    """Make NAME the active workspace for subsequent commands."""
+    from skypilot_tpu import workspaces
+    try:
+        workspaces.set_active(name)
+    except exceptions.SkytError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'active workspace: {name}')
+
+
 def main() -> None:
+    from skypilot_tpu import plugins
+    plugins.load_plugins()
     try:
         cli()
     except KeyboardInterrupt:

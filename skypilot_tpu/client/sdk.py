@@ -36,6 +36,10 @@ def api_server_url() -> str:
     env = os.environ.get('SKYT_API_SERVER_URL')
     if env:
         return env.rstrip('/')
+    from skypilot_tpu import config
+    configured = config.get_nested(('api_server', 'endpoint'), None)
+    if configured:  # `skyt api login` wrote it
+        return str(configured).rstrip('/')
     info_path = os.path.join(requests_db.server_dir(), 'server.json')
     if os.path.exists(info_path):
         with open(info_path, encoding='utf-8') as f:
@@ -63,12 +67,28 @@ def api_is_healthy(url: Optional[str] = None) -> bool:
         return False
 
 
+def _endpoint_is_configured() -> bool:
+    """True when the endpoint came from env or `skyt api login` config —
+    i.e. the user points at a specific (usually remote) server and we
+    must never auto-start a local one in its place."""
+    if os.environ.get('SKYT_API_SERVER_URL'):
+        return True
+    from skypilot_tpu import config
+    return bool(config.get_nested(('api_server', 'endpoint'), None))
+
+
 def ensure_api_server() -> str:
     """Return a healthy server URL, auto-starting a local one if needed."""
     url = api_server_url()
     if api_is_healthy(url):
         return url
-    if os.environ.get('SKYT_API_SERVER_URL'):
+    if _endpoint_is_configured():
+        # Configured (remote) server: transient unreachability (restart,
+        # flaky network) is retried before giving up.
+        for _ in range(max(0, _retries() - 1)):
+            time.sleep(0.2)
+            if api_is_healthy(url):
+                return url
         raise exceptions.ApiServerError(
             f'API server at {url} is unreachable.')
     logger.info('Starting local API server at %s', url)
@@ -102,10 +122,57 @@ def api_stop() -> bool:
     return False
 
 
+# Transient transport failures worth retrying: connection refused/reset,
+# and a response cut mid-body. Server-side HTTP errors are NOT retried.
+_RETRYABLE = (requests_lib.exceptions.ConnectionError,
+              requests_lib.exceptions.ChunkedEncodingError,
+              requests_lib.exceptions.Timeout)
+
+
+def _retries() -> int:
+    return int(os.environ.get('SKYT_CLIENT_RETRIES', '4'))
+
+
+def _request_with_retries(method: str, url: str, **kwargs: Any):
+    """requests.request with backoff on transient transport errors.
+
+    Safe for POSTs because every submission carries an idempotency key the
+    server dedupes on (parity target: the reference's chaos-proxy suite,
+    tests/chaos/chaos_proxy.py, exercises exactly this client behavior).
+    A 200 whose body fails to parse as JSON is also transient: a response
+    truncated mid-headers can surface as a 'successful' garbage response
+    rather than a transport error.
+    """
+    attempts = _retries()
+    delay = 0.2
+    for attempt in range(attempts):
+        try:
+            resp = requests_lib.request(method, url, **kwargs)
+            if not kwargs.get('stream'):
+                try:
+                    resp.json()
+                except ValueError as e:
+                    raise requests_lib.exceptions.ChunkedEncodingError(
+                        f'malformed response body: {e}')
+            return resp
+        except _RETRYABLE:
+            if attempt == attempts - 1:
+                raise
+            logger.debug('Transient %s %s failure; retry %d/%d', method,
+                         url, attempt + 1, attempts - 1)
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+    raise AssertionError('unreachable')
+
+
 def _post(route: str, body: Dict[str, Any]) -> RequestId:
     url = ensure_api_server()
-    resp = requests_lib.post(f'{url}/{route}', json=body, timeout=30,
-                             headers=_auth_headers())
+    headers = _auth_headers()
+    headers['X-Skyt-Idempotency-Key'] = os.urandom(16).hex()
+    from skypilot_tpu import workspaces
+    headers['X-Skyt-Workspace'] = workspaces.active_workspace()
+    resp = _request_with_retries('POST', f'{url}/{route}', json=body,
+                                 timeout=30, headers=headers)
     payload = resp.json()
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
@@ -123,8 +190,8 @@ def get(request_id: str, timeout: Optional[float] = None) -> Any:
     url = ensure_api_server()
     deadline = None if timeout is None else time.time() + timeout
     while True:
-        resp = requests_lib.get(
-            f'{url}/api/get',
+        resp = _request_with_retries(
+            'GET', f'{url}/api/get',
             params={'request_id': request_id, 'timeout': 15},
             timeout=60, headers=_auth_headers())
         if resp.status_code == 404:
@@ -154,22 +221,129 @@ def stream_and_get(request_id: str,
                    output: Any = None) -> Any:
     """Tail the request's log to ``output`` (default stdout), then get().
 
-    Parity: sdk.stream_and_get :2368."""
+    A stream cut mid-flight resumes from the byte offset already received
+    (``tail_from``) — no replayed or lost log lines across connection
+    drops. Parity: sdk.stream_and_get :2368."""
     url = ensure_api_server()
     output = output or sys.stdout
-    with requests_lib.get(f'{url}/api/stream',
-                          params={'request_id': request_id},
-                          stream=True, timeout=None,
-                          headers=_auth_headers()) as resp:
-        if resp.status_code != 200:
-            raise exceptions.ApiServerError(
-                f'stream failed: HTTP {resp.status_code}: '
-                f'{resp.text[:500]}')
-        for chunk in resp.iter_content(chunk_size=None):
-            output.write(chunk.decode('utf-8', errors='replace'))
-            if hasattr(output, 'flush'):
-                output.flush()
+    received = 0
+    attempts_left = _retries()
+    while True:
+        try:
+            with requests_lib.get(f'{url}/api/stream',
+                                  params={'request_id': request_id,
+                                          'tail_from': received},
+                                  stream=True, timeout=None,
+                                  headers=_auth_headers()) as resp:
+                if resp.status_code != 200:
+                    raise exceptions.ApiServerError(
+                        f'stream failed: HTTP {resp.status_code}: '
+                        f'{resp.text[:500]}')
+                for chunk in resp.iter_content(chunk_size=None):
+                    output.write(chunk.decode('utf-8', errors='replace'))
+                    received += len(chunk)
+                    if hasattr(output, 'flush'):
+                        output.flush()
+            break
+        except _RETRYABLE:
+            attempts_left -= 1
+            if attempts_left <= 0:
+                raise
+            time.sleep(0.2)
     return get(request_id)
+
+
+def ssh_info(cluster_name: str) -> RequestId:
+    return _post('ssh_info', {'cluster_name': cluster_name})
+
+
+def open_tunnel(cluster_name: str, port: Optional[int] = None):
+    """Raw duplex socket to the cluster head's SSH port, THROUGH the API
+    server (parity: sky/templates/websocket_proxy.py). Returns a
+    connected socket plus any bytes the server already sent past the
+    HTTP headers."""
+    import socket as socket_lib
+    import urllib.parse
+    url = ensure_api_server()
+    parsed = urllib.parse.urlparse(url)
+    sock = socket_lib.create_connection(
+        (parsed.hostname, parsed.port or 80), timeout=30)
+    from skypilot_tpu import workspaces
+    lines = [f'POST /api/tunnel HTTP/1.1',
+             f'Host: {parsed.netloc}',
+             f'X-Skyt-Cluster: {cluster_name}',
+             f'X-Skyt-Workspace: {workspaces.active_workspace()}',
+             'Content-Length: 0']
+    if port is not None:
+        lines.append(f'X-Skyt-Port: {port}')
+    for key, value in _auth_headers().items():
+        lines.append(f'{key}: {value}')
+    sock.sendall(('\r\n'.join(lines) + '\r\n\r\n').encode())
+    buf = b''
+    while b'\r\n\r\n' not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise exceptions.ApiServerError(
+                'tunnel: server closed during handshake')
+        buf += chunk
+    headers, leftover = buf.split(b'\r\n\r\n', 1)
+    status_line = headers.split(b'\r\n', 1)[0].decode()
+    if ' 200 ' not in status_line + ' ':
+        sock.close()
+        raise exceptions.ApiServerError(
+            f'tunnel: {status_line} {leftover[:300]!r}')
+    return sock, leftover
+
+
+def tunnel_stdio(cluster_name: str, port: Optional[int] = None) -> int:
+    """Pump stdin/stdout through the tunnel (ssh ProxyCommand mode)."""
+    import threading
+    sock, leftover = open_tunnel(cluster_name, port)
+    stdout = os.fdopen(1, 'wb', buffering=0)
+    stdin = os.fdopen(0, 'rb', buffering=0)
+    if leftover:
+        stdout.write(leftover)
+
+    def downstream() -> None:
+        try:
+            while True:
+                data = sock.recv(65536)
+                if not data:
+                    break
+                stdout.write(data)
+        except OSError:
+            pass
+        finally:
+            os._exit(0)  # ssh closed on us; end the proxy process
+
+    thread = threading.Thread(target=downstream, daemon=True)
+    thread.start()
+    try:
+        while True:
+            data = stdin.read(65536)
+            if not data:
+                break
+            sock.sendall(data)
+    except OSError:
+        pass
+    try:
+        sock.shutdown(1)  # SHUT_WR: stdin closed, drain the rest
+    except OSError:
+        pass
+    thread.join(timeout=30)
+    return 0
+
+
+def volumes_apply(volume_config: Dict[str, Any]) -> RequestId:
+    return _post('volumes/apply', {'volume_config': volume_config})
+
+
+def volumes_ls() -> RequestId:
+    return _post('volumes/ls', {})
+
+
+def volumes_delete(name: str) -> RequestId:
+    return _post('volumes/delete', {'name': name})
 
 
 def api_cancel(request_id: str) -> bool:
@@ -187,8 +361,9 @@ def api_cancel(request_id: str) -> bool:
 def api_status(status: Optional[str] = None) -> List[Dict[str, Any]]:
     url = ensure_api_server()
     params = {'status': status} if status else {}
-    resp = requests_lib.get(f'{url}/api/requests', params=params,
-                            timeout=30, headers=_auth_headers())
+    resp = _request_with_retries('GET', f'{url}/api/requests',
+                                 params=params,
+                                 timeout=30, headers=_auth_headers())
     payload = resp.json()
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
@@ -307,9 +482,11 @@ def exec(task: Union[Task, Dag],  # pylint: disable=redefined-builtin
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> RequestId:
+           refresh: bool = False,
+           all_workspaces: bool = False) -> RequestId:
     return _post('status', {'cluster_names': cluster_names,
-                            'refresh': refresh})
+                            'refresh': refresh,
+                            'all_workspaces': all_workspaces})
 
 
 def stop(cluster_name: str) -> RequestId:
@@ -365,6 +542,13 @@ def jobs_launch(task: Union[Task, Dag],
     return _post('jobs/launch', {'task_config': configs[0], 'name': name})
 
 
+def jobs_launch_group(tasks: List[Task], group_name: str) -> RequestId:
+    return _post('jobs/launch-group', {
+        'task_configs': [t.to_yaml_config() for t in tasks],
+        'group_name': group_name,
+    })
+
+
 def jobs_queue(skip_finished: bool = False) -> RequestId:
     return _post('jobs/queue', {'skip_finished': skip_finished})
 
@@ -375,6 +559,23 @@ def jobs_cancel(job_id: int) -> RequestId:
 
 def jobs_logs(job_id: int, controller: bool = False) -> RequestId:
     return _post('jobs/logs', {'job_id': job_id, 'controller': controller})
+
+
+def pool_apply(task: Union[Task, Dag], pool_name: str,
+               workers: Optional[int] = None) -> RequestId:
+    configs = _task_configs(task)
+    return _post('jobs/pool/apply', {'task_config': configs[0],
+                                     'pool_name': pool_name,
+                                     'workers': workers})
+
+
+def pool_status(pool_name: Optional[str] = None) -> RequestId:
+    return _post('jobs/pool/status', {'pool_name': pool_name})
+
+
+def pool_down(pool_name: str, purge: bool = False) -> RequestId:
+    return _post('jobs/pool/down', {'pool_name': pool_name,
+                                    'purge': purge})
 
 
 # -- serving -----------------------------------------------------------

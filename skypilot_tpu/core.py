@@ -43,8 +43,13 @@ def _refresh_cluster_status(record: state.ClusterRecord) -> state.ClusterRecord:
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
-    records = state.get_clusters()
+           refresh: bool = False,
+           all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records, scoped to the active workspace by default
+    (parity: sky/workspaces/ visibility scoping)."""
+    from skypilot_tpu import workspaces
+    scope = None if all_workspaces else workspaces.active_workspace()
+    records = state.get_clusters(workspace=scope)
     if cluster_names:
         wanted = set(cluster_names)
         records = [r for r in records if r.name in wanted]
@@ -58,6 +63,8 @@ def _get_record(cluster_name: str) -> state.ClusterRecord:
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} not found.')
+    from skypilot_tpu import workspaces
+    workspaces.check_cluster_access(record)
     return record
 
 
@@ -111,6 +118,20 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
               follow: bool = False) -> str:
     return TpuPodBackend().tail_logs(_cluster_info(cluster_name), job_id,
                                      follow=follow)
+
+
+def ssh_info(cluster_name: str) -> Dict[str, Any]:
+    """Connection details for `skyt ssh` (head host; parity: the
+    reference's `sky ssh` config resolution through the server)."""
+    record = _get_record(cluster_name)
+    info = ClusterInfo.from_dict(record.handle)
+    head = info.head_host
+    return {
+        'address': head.external_ip or head.internal_ip,
+        'port': head.ssh_port,
+        'user': info.ssh_user,
+        'key_path': info.ssh_key_path,
+    }
 
 
 def autostop(cluster_name: str, idle_minutes: float,

@@ -62,6 +62,13 @@ def launch(task_or_dag: Union[Task, Dag],
     # _execute_dag, execution.py:340).
     from skypilot_tpu import admin_policy
     dag.tasks = [admin_policy.apply(t, 'launch') for t in dag.tasks]
+    # Workspace policy: explicit cloud choices must be allowed by the
+    # active workspace (parity: sky/workspaces/ per-workspace cloud
+    # allowlists; optimizer-chosen clouds are filtered in _execute_task).
+    from skypilot_tpu import workspaces
+    for task in dag.tasks:
+        for res in task.resources:
+            workspaces.validate_cloud(res.cloud)
     backend = backend or TpuPodBackend()
     stages = stages or ALL_STAGES
     results: List[Tuple[str, Optional[int]]] = []
@@ -88,7 +95,12 @@ def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
     from skypilot_tpu.utils import timeline
     if Stage.OPTIMIZE in stages and task.best_resources is None:
         with timeline.Event('optimize', cluster=cluster_name):
-            Optimizer.optimize(Dag.from_task(task))
+            from skypilot_tpu import check, workspaces
+            allowed = workspaces.allowed_clouds()
+            if allowed is not None:
+                allowed = [c for c in check.get_enabled_clouds()
+                           if c in allowed]
+            Optimizer.optimize(Dag.from_task(task), enabled_clouds=allowed)
     info = None
     if Stage.PROVISION in stages:
         with timeline.Event('provision', cluster=cluster_name):
@@ -145,6 +157,8 @@ def exec_(task_or_dag: Union[Task, Dag],
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} not found.')
+    from skypilot_tpu import workspaces
+    workspaces.check_cluster_access(record, op='exec on')
     if record.status != state.ClusterStatus.UP:
         raise exceptions.ClusterNotUpError(
             f'Cluster {cluster_name!r} is {record.status.value}; '

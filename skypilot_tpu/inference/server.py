@@ -83,6 +83,8 @@ def serve(engine: InferenceEngine, host: str, port: int):
 
 
 def main(argv=None) -> int:
+    from skypilot_tpu.utils.jax_env import honor_jax_platforms
+    honor_jax_platforms()
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
     parser.add_argument('--checkpoint-dir', default=None)

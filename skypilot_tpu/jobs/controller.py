@@ -109,13 +109,52 @@ class JobController:
         except exceptions.ResourcesUnavailableError as e:
             self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
             return None
+        if self.record.group_name:
+            # Recovered on (possibly) new hosts: refresh the rendezvous
+            # map for siblings that re-resolve it.
+            from skypilot_tpu.jobs import job_groups
+            job_groups.publish_hosts(self.job_id, self.cluster_name)
         jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
         return cluster_job_id
 
+    def _gang_launch(self) -> int:
+        """Group member: provision+setup, publish hosts, barrier, exec
+        with the rendezvous env (jobs/job_groups.py)."""
+        from skypilot_tpu.execution import Stage
+        from skypilot_tpu.jobs import job_groups
+        self.strategy.launch_stages = [
+            Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+            Stage.SYNC_FILE_MOUNTS, Stage.SETUP]
+        try:
+            self.strategy.launch()
+        finally:
+            self.strategy.launch_stages = None  # recoveries relaunch fully
+        job_groups.publish_hosts(self.job_id, self.cluster_name)
+        env = job_groups.barrier_and_env(
+            self.record,
+            timeout=float(os.environ.get('SKYT_JOBGROUP_BARRIER_TIMEOUT',
+                                         '1800')))
+        # The env lands on the task itself so recoveries (full
+        # relaunches) keep the rendezvous map.
+        self.task.update_envs(env)
+        info = self._cluster_info()
+        if info is None:
+            raise exceptions.ClusterNotUpError(
+                f'{self.cluster_name} vanished between barrier and exec')
+        return self.backend.execute(info, self.task, detach=True)
+
     def run(self) -> None:
+        from skypilot_tpu.jobs import job_groups
         jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
         try:
-            cluster_job_id = self.strategy.launch()
+            if self.record.group_name:
+                cluster_job_id = self._gang_launch()
+            else:
+                cluster_job_id = self.strategy.launch()
+        except job_groups.GangAborted as e:
+            scheduler.launch_done(self.job_id)
+            self._finalize(ManagedJobStatus.CANCELLED, str(e))
+            return
         except exceptions.ResourcesUnavailableError as e:
             scheduler.launch_done(self.job_id)
             self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
@@ -145,6 +184,21 @@ class JobController:
             if job_status == 'SUCCEEDED':
                 self._finalize(ManagedJobStatus.SUCCEEDED)
                 return
+            if self.record.group_name:
+                failed_sibling = job_groups.sibling_failed(self.record)
+                if failed_sibling is not None:
+                    # Gang semantics: a partial group never keeps
+                    # burning TPU-hours.
+                    info = self._cluster_info()
+                    if info is not None and cluster_job_id is not None:
+                        try:
+                            self.backend.cancel(info, cluster_job_id)
+                        except Exception:  # pylint: disable=broad-except
+                            pass
+                    self._finalize(
+                        ManagedJobStatus.CANCELLED,
+                        f'gang: sibling {failed_sibling} failed')
+                    return
             if job_status == 'FAILED':
                 # User code failed on a healthy cluster: restart in place
                 # if budget remains (ref max_restarts_on_errors).

@@ -42,6 +42,34 @@ def launch(task: Task, name: Optional[str] = None) -> int:
     return job_id
 
 
+def launch_group(tasks: List[Task],
+                 group_name: str) -> List[int]:
+    """Submit a gang-scheduled job group (parity:
+    jobs/job_group_networking.py): every member provisions, the group
+    barriers, then all tasks start with each other's host IPs in env;
+    one member failing cancels the rest. Returns the job ids."""
+    if len(tasks) < 2:
+        raise exceptions.InvalidSpecError(
+            'a job group needs at least 2 tasks')
+    names = [t.name for t in tasks]
+    if len(set(names)) != len(names) or None in names:
+        raise exceptions.InvalidSpecError(
+            'every task in a job group needs a unique name '
+            f'(got {names})')
+    from skypilot_tpu import admin_policy
+    job_ids = []
+    for task in tasks:
+        task = admin_policy.apply(task, 'jobs.launch')
+        job_ids.append(
+            jobs_state.submit(task.to_yaml_config(), task.name,
+                              strategy='FAILOVER',
+                              max_restarts_on_errors=0,
+                              group_name=group_name))
+    logger.info('Job group %s submitted: jobs %s.', group_name, job_ids)
+    scheduler.maybe_schedule_next_jobs()
+    return job_ids
+
+
 def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
     scheduler.reap_dead_controllers()
     return [r.to_dict() for r in jobs_state.list_jobs(skip_finished)]

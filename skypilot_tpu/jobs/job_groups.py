@@ -1,0 +1,96 @@
+"""Gang-scheduled managed-job groups with cross-task host discovery.
+
+Parity: ``sky/jobs/job_group_networking.py:118-217`` — the reference
+gang-schedules multi-task groups and wires cross-task DNS. The
+TPU-native shape here:
+
+1. Every member's controller provisions + sets up its cluster but does
+   NOT start the task.
+2. It publishes its cluster's host IPs to the managed-jobs DB and waits
+   at a barrier for all siblings to do the same ("all slices up before
+   any rank runs" — the same all-or-nothing discipline a TPU pod slice
+   gives within one cluster, lifted to groups of clusters).
+3. Once the group is fully provisioned, each member starts its task
+   with ``SKYT_JOBGROUP`` and per-sibling
+   ``SKYT_JOBGROUP_HOSTS_<TASKNAME>`` env vars (comma-separated IPs) —
+   a rendezvous map instead of the reference's DNS names.
+4. If any member fails (provisioning, user code, cancel), every other
+   member is gang-cancelled: a partial group never burns TPU-hours.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+_FAILED_STATUSES = (ManagedJobStatus.FAILED,
+                    ManagedJobStatus.FAILED_SETUP,
+                    ManagedJobStatus.FAILED_NO_RESOURCE,
+                    ManagedJobStatus.FAILED_CONTROLLER,
+                    ManagedJobStatus.CANCELLED,
+                    ManagedJobStatus.CANCELLING)
+
+
+class GangAborted(exceptions.SkytError):
+    """A sibling failed; this member must stand down."""
+
+
+def _env_key(task_name: Optional[str], job_id: int) -> str:
+    name = task_name or f'job{job_id}'
+    return 'SKYT_JOBGROUP_HOSTS_' + re.sub(r'[^A-Za-z0-9]', '_',
+                                           name).upper()
+
+
+def publish_hosts(job_id: int, cluster_name: str) -> None:
+    record = state.get_cluster(cluster_name)
+    hosts: List[str] = []
+    if record is not None:
+        for host in record.handle.get('hosts', []):
+            hosts.append(host.get('external_ip') or
+                         host.get('internal_ip'))
+    jobs_state.set_group_hosts(job_id, [h for h in hosts if h])
+
+
+def sibling_failed(record: jobs_state.JobRecord) -> Optional[str]:
+    """Name of a failed sibling, or None while the gang is healthy."""
+    assert record.group_name is not None
+    for sibling in jobs_state.list_group(record.group_name):
+        if sibling.job_id == record.job_id:
+            continue
+        if sibling.status in _FAILED_STATUSES:
+            return (f'{sibling.name or sibling.job_id} '
+                    f'({sibling.status.value})')
+    return None
+
+
+def barrier_and_env(record: jobs_state.JobRecord,
+                    timeout: float = 1800.0,
+                    poll: float = 1.0) -> Dict[str, str]:
+    """Wait for every group member to publish hosts; return the
+    rendezvous env map. Raises GangAborted if a sibling fails first."""
+    assert record.group_name is not None
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        failed = sibling_failed(record)
+        if failed is not None:
+            raise GangAborted(
+                f'group {record.group_name}: member {failed} failed '
+                f'before the gang barrier')
+        members = jobs_state.list_group(record.group_name)
+        if members and all(m.group_hosts for m in members):
+            env = {'SKYT_JOBGROUP': record.group_name}
+            for member in members:
+                env[_env_key(member.name, member.job_id)] = ','.join(
+                    member.group_hosts)
+            return env
+        time.sleep(poll)
+    raise GangAborted(
+        f'group {record.group_name}: barrier timed out after '
+        f'{timeout:.0f}s (members still provisioning)')

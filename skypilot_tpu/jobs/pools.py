@@ -1,0 +1,95 @@
+"""Worker pools: pre-provisioned clusters for jobs/batch work.
+
+Parity: ``sky jobs pool`` (SURVEY §2.8 — the reference builds pools on
+the serve machinery; so do we). A pool is a service in pool mode: the
+serve controller keeps N identical worker clusters alive (recovering
+preempted/failed ones via the same replica manager + autoscalers), but
+there is no load balancer and no HTTP readiness probe — a worker is
+ready once it is provisioned and its setup ran.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.spec.task import Task
+
+
+def _is_pool(record_dict: Dict[str, Any]) -> bool:
+    return bool((record_dict.get('spec') or {}).get('pool'))
+
+
+def apply(task: Task, pool_name: str,
+          workers: Optional[int] = None) -> Dict[str, Any]:
+    """Create (or resize) a pool of identical workers from a task.
+
+    The task's ``run`` section is ignored for pool workers (they idle
+    until batch/jobs dispatch work onto them); ``setup`` is where the
+    expensive environment preparation goes.
+    """
+    service = dict(task.service or {})
+    service['pool'] = True
+    if workers is not None:
+        service['workers'] = int(workers)
+    service.setdefault('workers', service.pop('replicas', 1))
+    task.service = service
+    task.run = None  # workers idle; work arrives via exec
+    existing = serve_state.get_service(pool_name)
+    if existing is not None:
+        if not _is_pool(existing.to_dict()):
+            raise exceptions.ServiceAlreadyExistsError(
+                f'{pool_name!r} exists and is a service, not a pool.')
+        # Resize IN PLACE: push the new spec; the pool's controller
+        # hot-reloads it and scales up/down without touching the warm
+        # workers that already exist.
+        from skypilot_tpu.serve.service_spec import ServiceSpec
+        spec = ServiceSpec.from_yaml_config(service)
+        serve_state.set_service_spec(pool_name, spec.to_yaml_config())
+        return {'name': pool_name, 'resized': True}
+    return serve_core.up(task, pool_name)
+
+
+def status(pool_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    records = [r for r in serve_core.status(None) if _is_pool(r)]
+    if pool_name is not None:
+        records = [r for r in records if r['name'] == pool_name]
+        if not records:
+            raise exceptions.ServiceNotFoundError(
+                f'No pool {pool_name!r}.')
+    return records
+
+
+def down(pool_name: str, purge: bool = False) -> None:
+    record = serve_state.get_service(pool_name)
+    if record is None or not _is_pool(record.to_dict()):
+        raise exceptions.ServiceNotFoundError(f'No pool {pool_name!r}.')
+    serve_core.down(pool_name, purge=purge)
+
+
+def ready_workers(pool_name: str) -> List[str]:
+    """Cluster names of READY workers (batch dispatch targets)."""
+    record = serve_state.get_service(pool_name)
+    if record is None:
+        raise exceptions.ServiceNotFoundError(f'No pool {pool_name!r}.')
+    return [r.cluster_name
+            for r in serve_state.list_replicas(pool_name,
+                                               include_terminal=False)
+            if r.status == ReplicaStatus.READY]
+
+
+def wait_ready(pool_name: str, min_workers: int = 1,
+               timeout: float = 300.0) -> List[str]:
+    """Block until >= min_workers are READY; returns their clusters."""
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        workers = ready_workers(pool_name)
+        if len(workers) >= min_workers:
+            return workers
+        time.sleep(1)
+    raise TimeoutError(
+        f'Pool {pool_name!r}: {len(ready_workers(pool_name))}/'
+        f'{min_workers} workers ready after {timeout}s.')

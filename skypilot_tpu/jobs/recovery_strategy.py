@@ -62,6 +62,11 @@ class StrategyExecutor:
         self.cluster_name = cluster_name
         self.backend = TpuPodBackend()
         self.blocklist = Blocklist()
+        # Gang groups provision first and exec after the group barrier
+        # (jobs/job_groups.py): the controller narrows the stages for
+        # the initial launch, then resets to None (full launch) for
+        # recoveries.
+        self.launch_stages = None
 
     @classmethod
     def make(cls, strategy: Optional[str], job_id: int, task: Task,
@@ -82,15 +87,18 @@ class StrategyExecutor:
         id. Subclasses choose the blocklist seeding."""
         raise NotImplementedError
 
-    def _relaunch_once(self, blocklist: Blocklist) -> int:
+    def _relaunch_once(self, blocklist: Blocklist) -> Optional[int]:
         """One launch attempt with the given blocklist (no retry loop)."""
         results = execution.launch(self.task,
                                    self.cluster_name,
                                    detach_run=True,
                                    backend=self.backend,
-                                   provision_blocklist=blocklist)
+                                   provision_blocklist=blocklist,
+                                   stages=self.launch_stages)
         job_id = results[0][1]
-        assert job_id is not None
+        from skypilot_tpu.execution import Stage
+        if self.launch_stages is None or Stage.EXEC in self.launch_stages:
+            assert job_id is not None
         return job_id
 
     # ------------------------------------------------------------------

@@ -87,9 +87,16 @@ def _db() -> sqlite3.Connection:
             submitted_at REAL,
             started_at REAL,
             ended_at REAL,
-            last_recovered_at REAL
+            last_recovered_at REAL,
+            group_name TEXT,             -- gang-scheduled job group
+            group_hosts TEXT             -- JSON host IPs, published at
+                                         -- provision for sibling discovery
         );
     """)
+    cols = {r['name'] for r in conn.execute('PRAGMA table_info(jobs)')}
+    if 'group_name' not in cols:  # pre-existing DB from an older version
+        conn.execute('ALTER TABLE jobs ADD COLUMN group_name TEXT')
+        conn.execute('ALTER TABLE jobs ADD COLUMN group_hosts TEXT')
     conn.commit()
     _local.conn = conn
     _local.path = path
@@ -114,6 +121,9 @@ class JobRecord:
         self.started_at: Optional[float] = row['started_at']
         self.ended_at: Optional[float] = row['ended_at']
         self.last_recovered_at: Optional[float] = row['last_recovered_at']
+        self.group_name: Optional[str] = row['group_name']
+        self.group_hosts: List[str] = json.loads(row['group_hosts'] or
+                                                 '[]')
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -128,23 +138,39 @@ class JobRecord:
             'submitted_at': self.submitted_at,
             'started_at': self.started_at,
             'ended_at': self.ended_at,
+            'group_name': self.group_name,
         }
 
 
 def submit(task_config: Dict[str, Any],
            name: Optional[str],
            strategy: str,
-           max_restarts_on_errors: int) -> int:
+           max_restarts_on_errors: int,
+           group_name: Optional[str] = None) -> int:
     conn = _db()
     cur = conn.execute(
         'INSERT INTO jobs (name, task_config, status, schedule_state, '
-        'strategy, max_restarts_on_errors, submitted_at) '
-        'VALUES (?, ?, ?, ?, ?, ?, ?)',
+        'strategy, max_restarts_on_errors, submitted_at, group_name) '
+        'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
         (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
          ScheduleState.WAITING.value, strategy, max_restarts_on_errors,
-         time.time()))
+         time.time(), group_name))
     conn.commit()
     return cur.lastrowid
+
+
+def list_group(group_name: str) -> List['JobRecord']:
+    rows = _db().execute(
+        'SELECT * FROM jobs WHERE group_name = ? ORDER BY job_id',
+        (group_name,)).fetchall()
+    return [JobRecord(r) for r in rows]
+
+
+def set_group_hosts(job_id: int, hosts: List[str]) -> None:
+    conn = _db()
+    conn.execute('UPDATE jobs SET group_hosts = ? WHERE job_id = ?',
+                 (json.dumps(hosts), job_id))
+    conn.commit()
 
 
 def get(job_id: int) -> Optional[JobRecord]:

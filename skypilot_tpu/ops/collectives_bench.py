@@ -94,6 +94,8 @@ def bench_op(op: str, size_mb: float, iters: int, warmup: int) -> dict:
 
 
 def main(argv=None) -> int:
+    from skypilot_tpu.utils.jax_env import honor_jax_platforms
+    honor_jax_platforms()
     parser = argparse.ArgumentParser()
     parser.add_argument('--op', default='all_reduce',
                         choices=['all_reduce', 'all_gather',

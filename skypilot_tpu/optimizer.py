@@ -99,6 +99,28 @@ def candidates_for(resources: Resources,
                 resources=resources.copy(cloud='local', region='local'),
                 hourly_cost=0.0))
             continue
+        if cloud == 'slurm':
+            # On-prem scheduler: $0/hr, partition rides the region field.
+            region = resources.region or 'slurm'
+            out.append(Candidate(
+                resources=resources.copy(cloud='slurm', region=region),
+                hourly_cost=0.0))
+            continue
+        if cloud == 'ssh':
+            # BYO machines (SSH node pools): region names the pool; the
+            # inventory declares what hardware the hosts carry, so any
+            # accelerator request is taken at the user's word. $0/hr.
+            from skypilot_tpu.provision.ssh_pool import load_inventory
+            pools = load_inventory()
+            wanted = ([resources.region] if resources.region
+                      else sorted(pools))
+            for pool_name in wanted:
+                if pool_name in pools:
+                    out.append(Candidate(
+                        resources=resources.copy(cloud='ssh',
+                                                 region=pool_name),
+                        hourly_cost=0.0))
+            continue
         accels = resources.accelerators
         if accels is None:
             # CPU-only: any region works; pick a default region per cloud.

@@ -1,0 +1,99 @@
+"""Plugin system: user modules extend the framework at load time.
+
+Parity: ``sky/server/plugins.py:39 PluginContext`` + plugin_hooks — the
+reference loads plugins from ``~/.sky/plugins.yaml`` per process context
+and lets them register queue/blob/log backends, routes, RBAC rules, and
+jobs runners. Here plugins are python modules named in config::
+
+    plugins:
+      - mycompany.skyt_plugin          # must expose register(ctx)
+
+Each module's ``register(ctx)`` gets a PluginContext exposing the
+framework's extension points: the cloud/backend/recovery/autoscaler
+registries, the API server payload table, and admin-policy chaining.
+Plugins load once per process, before the first use of any registry
+consumer (server start, CLI dispatch, executor runner start).
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Callable, Dict, List
+
+from skypilot_tpu import config
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+class PluginContext:
+    """What a plugin may extend (parity: PluginContext :39)."""
+
+    def __init__(self) -> None:
+        from skypilot_tpu.utils import registry
+        self.cloud_registry = registry.CLOUD_REGISTRY
+        self.backend_registry = registry.BACKEND_REGISTRY
+        self.recovery_registry = registry.JOBS_RECOVERY_STRATEGY_REGISTRY
+        self.autoscaler_registry = registry.AUTOSCALER_REGISTRY
+        self.lb_policy_registry = registry.LB_POLICY_REGISTRY
+        self.model_registry = registry.MODEL_REGISTRY
+
+    def register_payload(self, name: str, fn: Callable[..., Any],
+                         long_running: bool = False) -> None:
+        """Add an API-server entrypoint (appears as POST /<name>)."""
+        from skypilot_tpu.server import payloads
+        from skypilot_tpu.server.requests_db import ScheduleType
+        if name in payloads.PAYLOADS:
+            raise ValueError(f'payload {name!r} already registered')
+        payloads.PAYLOADS[name] = (
+            fn, ScheduleType.LONG if long_running else ScheduleType.SHORT)
+
+    def register_admin_policy(self, fn: Callable[..., Any]) -> None:
+        """Chain a validate-and-mutate hook onto task submission."""
+        from skypilot_tpu import admin_policy
+        admin_policy.register_policy(fn)
+
+
+_loaded = False
+_lock = threading.Lock()
+_load_errors: Dict[str, str] = {}
+
+
+def load_plugins(force: bool = False) -> List[str]:
+    """Import + register every configured plugin; idempotent."""
+    global _loaded
+    with _lock:
+        if _loaded and not force:
+            return []
+        _loaded = True
+        names = config.get_nested(('plugins',), []) or []
+        context = PluginContext()
+        loaded = []
+        for name in names:
+            try:
+                module = importlib.import_module(name)
+                register = getattr(module, 'register', None)
+                if register is None:
+                    raise AttributeError(
+                        f'plugin {name} has no register(ctx)')
+                register(context)
+                loaded.append(name)
+                logger.info('Loaded plugin %s', name)
+            except Exception as e:  # pylint: disable=broad-except
+                # A broken plugin must not take the server down; record
+                # and continue (the reference isolates plugin failures
+                # the same way).
+                _load_errors[name] = f'{type(e).__name__}: {e}'
+                logger.exception('Plugin %s failed to load', name)
+        return loaded
+
+
+def load_errors() -> Dict[str, str]:
+    return dict(_load_errors)
+
+
+def reset_for_tests() -> None:
+    global _loaded
+    with _lock:
+        _loaded = False
+        _load_errors.clear()

@@ -90,6 +90,9 @@ class ProvisionRequest:
     resume: bool = False
     ports: List[str] = dataclasses.field(default_factory=list)
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Volumes to attach at create time (k8s PVCs ride the pod manifest);
+    # each: {'name', 'mount_path', 'type', 'config'} from volumes.get().
+    volumes: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
 
 class Provider(abc.ABC):

@@ -120,6 +120,29 @@ class FakeProvider(Provider):
 
     name = 'fake'
 
+    # -- volumes (hostpath: a shared dir stands in for a network disk) --
+
+    @staticmethod
+    def _volumes_root() -> str:
+        return os.path.join(os.path.dirname(_store_path()), 'fake_volumes')
+
+    def create_volume(self, volume) -> Dict[str, Any]:
+        backing = os.path.join(self._volumes_root(), volume.name)
+        os.makedirs(backing, exist_ok=True)
+        return {'backing_path': backing}
+
+    def delete_volume(self, record: Dict[str, Any]) -> None:
+        import shutil
+        backing = record['config'].get('backing_path')
+        if backing:
+            shutil.rmtree(backing, ignore_errors=True)
+
+    def volume_mount_commands(self, record: Dict[str, Any],
+                              mount_path: str) -> List[str]:
+        backing = record['config']['backing_path']
+        return [f'mkdir -p "$(dirname {mount_path})" && '
+                f'ln -sfn {backing} {mount_path}']
+
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
         res = request.resources
         zone = request.zone or f'{request.region}-a'

@@ -212,6 +212,44 @@ class GcpTpuProvider(Provider):
             'targetTags': ['skyt'],
         })
 
+    # -- volumes (GCE persistent disks for controller VMs; parity:
+    #    sky/provision/gcp/volume_utils.py) -------------------------------
+
+    def create_volume(self, volume) -> Dict[str, Any]:
+        zone = volume.zone or volume.config.get('zone')
+        if not zone:
+            raise exceptions.InvalidSpecError(
+                'gce-pd volumes need an explicit zone')
+        base = f'{COMPUTE_API}/projects/{self._project}/zones/{zone}'
+        if not volume.use_existing:
+            self._request('POST', f'{base}/disks', {
+                'name': volume.name,
+                'sizeGb': str(volume.size_gb),
+                'type': f'zones/{zone}/diskTypes/'
+                        f'{volume.config.get("disk_type", "pd-balanced")}',
+                'labels': volume.labels,
+            })
+        return {'disk': volume.name, 'zone': zone}
+
+    def delete_volume(self, record: Dict[str, Any]) -> None:
+        zone = record['config']['zone']
+        base = f'{COMPUTE_API}/projects/{self._project}/zones/{zone}'
+        self._request('DELETE',
+                      f'{base}/disks/{record["config"]["disk"]}')
+
+    def volume_mount_commands(self, record: Dict[str, Any],
+                              mount_path: str) -> List[str]:
+        """Attached PDs surface as /dev/disk/by-id/google-<name>; format
+        on first use, then mount (the standard GCE recipe)."""
+        dev = f'/dev/disk/by-id/google-{record["config"]["disk"]}'
+        return [
+            f'sudo blkid {dev} >/dev/null 2>&1 || '
+            f'sudo mkfs.ext4 -q {dev}',
+            f'sudo mkdir -p {mount_path} && '
+            f'sudo mount -o discard,defaults {dev} {mount_path} && '
+            f'sudo chmod a+w {mount_path}',
+        ]
+
     # -- provider interface ----------------------------------------------
 
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
@@ -395,6 +433,19 @@ class GcpTpuProvider(Provider):
         if res.use_spot:
             body['scheduling'] = {'provisioningModel': 'SPOT',
                                   'instanceTerminationAction': 'DELETE'}
+        for vol in request.volumes:
+            # Named gce-pd volumes attach at create; they surface as
+            # /dev/disk/by-id/google-<name> (volume_mount_commands).
+            if vol.get('type') != 'gce-pd':
+                continue
+            body['disks'].append({
+                'boot': False,
+                'autoDelete': False,
+                'deviceName': vol['config']['disk'],
+                'source': (f'projects/{self._project}/zones/'
+                           f'{vol["config"]["zone"]}/disks/'
+                           f'{vol["config"]["disk"]}'),
+            })
         self._request('POST', f'{self._zone_base(zone)}/instances', body)
         logger.info('GCE instance %s requested in %s', name, zone)
 

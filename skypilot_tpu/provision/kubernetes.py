@@ -121,6 +121,21 @@ def build_pod_manifest(request: ProvisionRequest, node: int, worker: int,
         }]
     if _needs_fuse(request):
         _add_fuse_proxy_mount(spec)
+    for i, vol in enumerate(request.volumes):
+        # PVC volumes ride the pod manifest (parity: the reference mounts
+        # k8s volumes via pod spec, sky/provision/kubernetes/volume.py).
+        if vol.get('type') != 'k8s-pvc':
+            continue
+        vol_name = f'skyt-vol-{i}'
+        spec.setdefault('volumes', []).append({
+            'name': vol_name,
+            'persistentVolumeClaim': {
+                'claimName': vol['config'].get('pvc', vol['name'])},
+        })
+        spec['containers'][0].setdefault('volumeMounts', []).append({
+            'name': vol_name,
+            'mountPath': vol['mount_path'],
+        })
     return {
         'apiVersion': 'v1',
         'kind': 'Pod',
@@ -249,6 +264,12 @@ class KubernetesApi:
         raise NotImplementedError
 
     def delete_service(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    def create_pvc(self, namespace: str, manifest: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def delete_pvc(self, namespace: str, name: str) -> None:
         raise NotImplementedError
 
 
@@ -402,6 +423,21 @@ class RestKubernetesApi(KubernetesApi):
             if 'HTTP 404' not in str(e):
                 raise
 
+    def create_pvc(self, namespace, manifest):
+        self._request(
+            'POST',
+            f'/api/v1/namespaces/{namespace}/persistentvolumeclaims',
+            manifest)
+
+    def delete_pvc(self, namespace, name):
+        try:
+            self._request(
+                'DELETE', f'/api/v1/namespaces/{namespace}/'
+                f'persistentvolumeclaims/{name}')
+        except exceptions.ProvisionError as e:
+            if 'HTTP 404' not in str(e):
+                raise
+
 
 def _fake_store_path() -> str:
     state_dir = os.environ.get('SKYT_STATE_DIR',
@@ -502,6 +538,17 @@ class FakeKubernetesApi(KubernetesApi):
         with _FakeStore() as data:
             data['services'].pop(f'{namespace}/{name}', None)
 
+    def create_pvc(self, namespace, manifest):
+        with _FakeStore() as data:
+            key = f'{namespace}/{manifest["metadata"]["name"]}'
+            pvc = dict(manifest)
+            pvc['status'] = {'phase': 'Bound'}
+            data.setdefault('pvcs', {})[key] = pvc
+
+    def delete_pvc(self, namespace, name):
+        with _FakeStore() as data:
+            data.setdefault('pvcs', {}).pop(f'{namespace}/{name}', None)
+
 
 def fake_preempt_pod(namespace: str, name: str) -> None:
     """Spot reclaim: the pod vanishes (GKE deletes preempted pods)."""
@@ -535,6 +582,33 @@ class KubernetesProvider(Provider):
 
     def _selector(self, cluster_name: str) -> str:
         return f'{LABEL_CLUSTER}={cluster_name}'
+
+    # -- volumes (PVCs; parity: sky/provision/kubernetes/volume.py) ----
+
+    def create_volume(self, volume) -> Dict[str, Any]:
+        manifest = {
+            'apiVersion': 'v1',
+            'kind': 'PersistentVolumeClaim',
+            'metadata': {'name': volume.name, 'namespace': self.namespace,
+                         'labels': {'skyt-volume': volume.name,
+                                    **volume.labels}},
+            'spec': {
+                'accessModes': [volume.config.get('access_mode',
+                                                  'ReadWriteOnce')],
+                'resources': {
+                    'requests': {'storage': f'{volume.size_gb}Gi'}},
+                **({'storageClassName': volume.config['storage_class']}
+                   if volume.config.get('storage_class') else {}),
+            },
+        }
+        if not volume.use_existing:
+            self.api.create_pvc(self.namespace, manifest)
+        return {'pvc': volume.name, 'namespace': self.namespace}
+
+    def delete_volume(self, record: Dict[str, Any]) -> None:
+        self.api.delete_pvc(record['config'].get('namespace',
+                                                 self.namespace),
+                            record['config'].get('pvc', record['name']))
 
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
         res = request.resources

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from skypilot_tpu import exceptions, state
 from skypilot_tpu.optimizer import Candidate
@@ -65,6 +65,7 @@ def provision_with_failover(
         *,
         resume: bool = False,
         blocklist: Optional[Blocklist] = None,
+        volumes: Optional[List[Dict]] = None,
 ) -> Tuple[ClusterInfo, Candidate]:
     """Try candidates in (cost) order until one provisions.
 
@@ -89,6 +90,7 @@ def provision_with_failover(
             resume=resume,
             ports=res.ports,
             labels=res.labels,
+            volumes=list(volumes or []),
         )
         attempted += 1
         where = f'{res.cloud}/{res.region}' + (f'/{res.zone}' if res.zone

@@ -1,0 +1,214 @@
+"""Slurm as a provision target: a cluster is a long-lived allocation.
+
+Parity: ``sky/clouds/slurm.py`` + ``sky/provision/slurm/`` +
+``sky/skylet/executor/slurm.py``. The model mirrors the reference's:
+"provisioning" submits a placeholder batch job that holds N nodes
+(``sleep infinity``), the allocated nodes become the cluster's hosts,
+and the normal SSH runtime path (runtime shipping, head daemon,
+detached job queue) runs on them — Slurm hands out nodes; skyt runs the
+workload. Terminate = ``scancel``.
+
+Slurm access is via the local binaries (login node) or a configurable
+SSH prefix (``slurm.command_prefix`` config, e.g. ``ssh login01``).
+Partitions map to the ``region`` field.
+"""
+from __future__ import annotations
+
+import shlex
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config, exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = log.init_logger(__name__)
+
+_JOB_PREFIX = 'skyt-'
+
+
+def _run_slurm(args: List[str], timeout: float = 30) -> str:
+    prefix = config.get_nested(('slurm', 'command_prefix'), None)
+    cmd = (shlex.split(prefix) if prefix else []) + args
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f'slurm: {" ".join(args)} failed (rc={proc.returncode}): '
+            f'{(proc.stderr or proc.stdout)[-500:]}')
+    return proc.stdout
+
+
+def slurm_available() -> bool:
+    try:
+        _run_slurm(['sinfo', '--version'], timeout=10)
+        return True
+    except (exceptions.ProvisionError, FileNotFoundError, OSError,
+            subprocess.TimeoutExpired):
+        return False
+
+
+@CLOUD_REGISTRY.register('slurm')
+class SlurmProvider(Provider):
+    """Hold nodes with a placeholder allocation; run via SSH on them."""
+
+    name = 'slurm'
+
+    # -- helpers -------------------------------------------------------
+
+    @staticmethod
+    def _job_name(cluster_name: str) -> str:
+        return f'{_JOB_PREFIX}{cluster_name}'
+
+    _ACTIVE_STATES = ('RUNNING', 'PENDING', 'CONFIGURING', 'COMPLETING',
+                      'SUSPENDED')
+
+    def _squeue(self, cluster_name: str) -> Optional[Dict[str, str]]:
+        """{job_id, state, nodelist} of the live placeholder job, or
+        None. squeue can briefly list just-cancelled jobs; those stale
+        terminal lines must not shadow a fresh submission, so only
+        ACTIVE states count (newest job wins on ties)."""
+        out = _run_slurm([
+            'squeue', '--noheader', '-o', '%i|%T|%N',
+            '--name', self._job_name(cluster_name)])
+        newest = None
+        for line in out.strip().splitlines():
+            job_id, job_state, nodelist = line.split('|', 2)
+            if job_state not in self._ACTIVE_STATES:
+                continue
+            if newest is None or int(job_id) > int(newest['job_id']):
+                newest = {'job_id': job_id, 'state': job_state,
+                          'nodelist': nodelist}
+        return newest
+
+    @staticmethod
+    def _expand_nodelist(nodelist: str) -> List[str]:
+        """Expand Slurm's compressed hostlist form, including multiple
+        groups: 'cpu[01-02],gpu[03,05],login1' -> [cpu01, cpu02, gpu03,
+        gpu05, login1]. (scontrol does this on a real cluster, but the
+        grammar is small enough to not shell out for.)"""
+        nodes: List[str] = []
+        i = 0
+        n = len(nodelist)
+        while i < n:
+            # One group: <base>[<ranges>] or a bare name, ','-separated
+            # at bracket depth 0.
+            j = i
+            depth = 0
+            while j < n and (nodelist[j] != ',' or depth > 0):
+                if nodelist[j] == '[':
+                    depth += 1
+                elif nodelist[j] == ']':
+                    depth -= 1
+                j += 1
+            group = nodelist[i:j]
+            i = j + 1
+            if not group:
+                continue
+            if '[' not in group:
+                nodes.append(group)
+                continue
+            base, rest = group.split('[', 1)
+            for part in rest.rstrip(']').split(','):
+                if '-' in part:
+                    lo, hi = part.split('-')
+                    width = len(lo)
+                    for k in range(int(lo), int(hi) + 1):
+                        nodes.append(f'{base}{k:0{width}d}')
+                else:
+                    nodes.append(f'{base}{part}')
+        return nodes
+
+    # -- provider interface --------------------------------------------
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        existing = self._squeue(request.cluster_name)
+        if existing is None:
+            partition = request.region
+            args = ['sbatch', '--parsable',
+                    '--job-name', self._job_name(request.cluster_name),
+                    '-N', str(request.num_nodes)]
+            if partition and partition != 'slurm':
+                args += ['-p', partition]
+            cpus = request.resources.cpus
+            if cpus:
+                args += ['--cpus-per-task', str(int(float(cpus[0])))]
+            args += ['--wrap', 'sleep infinity']
+            out = _run_slurm(args).strip()
+            logger.info('Slurm: submitted placeholder job %s for %s',
+                        out, request.cluster_name)
+        info = self._wait_allocation(request)
+        return info
+
+    def _wait_allocation(self, request: ProvisionRequest,
+                         timeout: float = 600) -> ClusterInfo:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self._squeue(request.cluster_name)
+            if job is None:
+                # _squeue only reports ACTIVE jobs: gone means rejected,
+                # cancelled, or failed at allocation.
+                raise exceptions.CapacityError(
+                    f'slurm: placeholder job for {request.cluster_name} '
+                    f'left the queue (rejected/cancelled/failed)')
+            if job['state'] == 'RUNNING' and job['nodelist']:
+                nodes = self._expand_nodelist(job['nodelist'])
+                if len(nodes) < request.num_nodes:
+                    raise exceptions.ProvisionError(
+                        f'slurm: got {len(nodes)} nodes, wanted '
+                        f'{request.num_nodes}')
+                return self._info(request.cluster_name,
+                                  request.region or 'slurm', nodes,
+                                  job['job_id'])
+            time.sleep(2)
+        raise exceptions.CapacityError(
+            f'slurm: allocation for {request.cluster_name} still pending '
+            f'after {timeout}s (queue full?)')
+
+    @staticmethod
+    def _info(cluster_name: str, partition: str, nodes: List[str],
+              job_id: str) -> ClusterInfo:
+        user = config.get_nested(('slurm', 'ssh_user'), None)
+        key = config.get_nested(('slurm', 'ssh_key'), None)
+        import getpass
+        hosts = [HostInfo(instance_id=f'slurm/{job_id}/{n}',
+                          internal_ip=n, node_index=i, worker_index=0)
+                 for i, n in enumerate(nodes)]
+        return ClusterInfo(
+            cluster_name=cluster_name, provider='slurm',
+            region=partition, zone=None, hosts=hosts,
+            ssh_user=user or getpass.getuser(),
+            ssh_key_path=key,
+            custom={'slurm_job_id': job_id})
+
+    def stop_instances(self, cluster_name: str) -> None:
+        # A held allocation burns queue time; stop releases it (restart
+        # re-queues — same semantics as spot-style reclaim).
+        self.terminate_instances(cluster_name)
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        try:
+            _run_slurm(['scancel', '--name',
+                        self._job_name(cluster_name)])
+        except exceptions.ProvisionError as e:
+            logger.warning('scancel %s: %s', cluster_name, e)
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        job = self._squeue(cluster_name)
+        if job is None or job['state'] not in ('RUNNING',):
+            return {}
+        return {n: 'running'
+                for n in self._expand_nodelist(job['nodelist'])}
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        job = self._squeue(cluster_name)
+        if job is None or job['state'] != 'RUNNING':
+            return None
+        return self._info(cluster_name,
+                          config.get_nested(('slurm', 'partition'),
+                                            'slurm'),
+                          self._expand_nodelist(job['nodelist']),
+                          job['job_id'])

@@ -1,0 +1,208 @@
+"""SSH node pools: bring-your-own machines as a provision target.
+
+Parity: ``sky/ssh_node_pools/`` + ``sky/provision/ssh/`` — an inventory
+of SSH-reachable hosts (lab boxes, on-prem TPU VMs, reserved capacity)
+declared in ``~/.skyt/ssh_node_pools.yaml``::
+
+    my-lab:
+      user: ubuntu
+      identity_file: ~/.ssh/lab_key
+      hosts:
+        - 10.0.0.11
+        - 10.0.0.12
+    tpu-reserved:
+      user: tpuadmin
+      hosts:
+        - ip: 10.1.0.5
+        - ip: 10.1.0.6
+
+Each pool is addressable as ``cloud: ssh`` with ``region: <pool name>``
+(or any pool when no region is pinned). "Provisioning" allocates free
+hosts from the pool (persisted, so concurrent clusters never share a
+host); terminate releases them. stop/restart are no-ops — BYO machines
+stay up. The backend then treats the cluster exactly like any SSH
+cluster: runtime tarball shipped, daemon started on the head host,
+detached jobs/queue/logs via the remote job table.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, Provider)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = log.init_logger(__name__)
+
+
+def inventory_path() -> str:
+    return os.environ.get(
+        'SKYT_SSH_NODE_POOLS',
+        os.path.join(os.environ.get('SKYT_STATE_DIR',
+                                    os.path.expanduser('~/.skyt')),
+                     'ssh_node_pools.yaml'))
+
+
+def _allocations_path() -> str:
+    return inventory_path() + '.alloc.json'
+
+
+def load_inventory() -> Dict[str, Dict[str, Any]]:
+    path = inventory_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        data = yaml.safe_load(f) or {}
+    pools: Dict[str, Dict[str, Any]] = {}
+    for pool_name, spec in data.items():
+        hosts = []
+        for h in spec.get('hosts', []):
+            hosts.append({'ip': h} if isinstance(h, str) else dict(h))
+        pools[pool_name] = {
+            'user': spec.get('user', 'root'),
+            'identity_file': spec.get('identity_file'),
+            'hosts': hosts,
+        }
+    return pools
+
+
+class _Allocations:
+    """host ip -> cluster name, persisted with an exclusive lock."""
+
+    def __init__(self) -> None:
+        self._path = _allocations_path()
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._lock = filelock.FileLock(self._path + '.lock')
+
+    def __enter__(self) -> Dict[str, str]:
+        self._lock.acquire()
+        if os.path.exists(self._path):
+            with open(self._path, encoding='utf-8') as f:
+                self._data = json.load(f)
+        else:
+            self._data = {}
+        return self._data
+
+    def __exit__(self, exc_type, *args) -> None:
+        if exc_type is None:
+            tmp = self._path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump(self._data, f)
+            os.replace(tmp, self._path)
+        self._lock.release()
+
+
+@CLOUD_REGISTRY.register('ssh')
+class SshNodePoolProvider(Provider):
+    """Allocate cluster hosts from the static SSH inventory."""
+
+    name = 'ssh'
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        pools = load_inventory()
+        if not pools:
+            raise exceptions.ProvisionError(
+                f'No SSH node pools defined ({inventory_path()}).')
+        pool_name = request.region
+        if pool_name in (None, 'ssh', 'default'):
+            pool_name = next(iter(pools))
+        if pool_name not in pools:
+            raise exceptions.ProvisionError(
+                f'No SSH node pool {pool_name!r}; defined: '
+                f'{sorted(pools)}')
+        pool = pools[pool_name]
+        want = request.num_nodes
+        with _Allocations() as alloc:
+            mine = [h for h in pool['hosts']
+                    if alloc.get(h['ip']) == request.cluster_name]
+            if len(mine) >= want:
+                chosen = mine[:want]  # resume / idempotent re-provision
+            else:
+                free = [h for h in pool['hosts']
+                        if h['ip'] not in alloc]
+                if len(mine) + len(free) < want:
+                    raise exceptions.CapacityError(
+                        f'SSH pool {pool_name!r}: need {want} hosts, '
+                        f'{len(free)} free of {len(pool["hosts"])}.')
+                chosen = mine + free[:want - len(mine)]
+                for h in chosen:
+                    alloc[h['ip']] = request.cluster_name
+        hosts = [
+            HostInfo(instance_id=f'{pool_name}/{h["ip"]}',
+                     internal_ip=h['ip'],
+                     external_ip=h.get('external_ip'),
+                     ssh_port=int(h.get('port', 22)),
+                     node_index=i, worker_index=0)
+            for i, h in enumerate(chosen)
+        ]
+        logger.info('SSH pool %s: allocated %s to %s', pool_name,
+                    [h.internal_ip for h in hosts], request.cluster_name)
+        return self._info(request.cluster_name, pool_name, pool, hosts)
+
+    @staticmethod
+    def _info(cluster_name: str, pool_name: str, pool: Dict[str, Any],
+              hosts: List[HostInfo]) -> ClusterInfo:
+        identity = pool.get('identity_file')
+        return ClusterInfo(
+            cluster_name=cluster_name,
+            provider='ssh',
+            region=pool_name,
+            zone=None,
+            hosts=hosts,
+            ssh_user=pool.get('user', 'root'),
+            ssh_key_path=(os.path.expanduser(identity) if identity
+                          else None),
+            custom={'ssh_pool': pool_name},
+        )
+
+    def stop_instances(self, cluster_name: str) -> None:
+        # BYO machines are never powered off by us; stopping a cluster
+        # just keeps the allocation (restart is instant).
+        logger.info('SSH pool: stop is a no-op for %s (BYO hosts)',
+                    cluster_name)
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        with _Allocations() as alloc:
+            for ip in [ip for ip, c in alloc.items()
+                       if c == cluster_name]:
+                del alloc[ip]
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        with _Allocations() as alloc:
+            ips = [ip for ip, c in alloc.items() if c == cluster_name]
+        return {ip: 'running' for ip in ips}
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        pools = load_inventory()
+        with _Allocations() as alloc:
+            ips = {ip for ip, c in alloc.items() if c == cluster_name}
+        if not ips:
+            return None
+        for pool_name, pool in pools.items():
+            chosen = [h for h in pool['hosts'] if h['ip'] in ips]
+            if chosen:
+                hosts = [
+                    HostInfo(instance_id=f'{pool_name}/{h["ip"]}',
+                             internal_ip=h['ip'],
+                             external_ip=h.get('external_ip'),
+                             ssh_port=int(h.get('port', 22)),
+                             node_index=i, worker_index=0)
+                    for i, h in enumerate(chosen)
+                ]
+                return self._info(cluster_name, pool_name, pool, hosts)
+        return None
+
+    def wait_instances(self, cluster_name: str, state: str = 'running',
+                       timeout: float = 600) -> None:
+        del timeout
+        if state == 'running' and not self.query_instances(cluster_name):
+            raise exceptions.ProvisionError(
+                f'{cluster_name}: no allocated SSH hosts')

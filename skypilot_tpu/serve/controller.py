@@ -49,7 +49,7 @@ def _replica_weight(record: serve_state.ReplicaRecord) -> float:
 
 class ServeController:
     def __init__(self, service_name: str, spec: ServiceSpec, task: Task,
-                 lb: LoadBalancer) -> None:
+                 lb: Optional[LoadBalancer] = None) -> None:
         self.service_name = service_name
         self.spec = spec
         self.lb = lb
@@ -168,14 +168,35 @@ class ServeController:
         serve_state.remove_service(self.service_name)
         logger.info('Service %s: shut down complete.', self.service_name)
 
+    def _reload_spec_if_changed(self) -> None:
+        """Hot-reload the service spec from the DB (pool resize path:
+        serve_state.set_service_spec)."""
+        record = serve_state.get_service(self.service_name)
+        if record is None:
+            return
+        current = self.spec.to_yaml_config()
+        if record.spec == current:
+            return
+        logger.info('Service %s: spec changed, reloading.',
+                    self.service_name)
+        self.spec = ServiceSpec.from_yaml_config(record.spec)
+        self.autoscaler = Autoscaler.from_spec(self.spec)
+        self.manager.spec = self.spec
+
     def run_once(self) -> None:
+        self._reload_spec_if_changed()
         replicas = self.manager.probe_all()
         self._note_preemptions(replicas)
-        stats = self.lb.load_stats()
+        # Pool mode has no load balancer: autoscaling input is replica
+        # state only (fixed-size / spot-fallback autoscalers).
+        from skypilot_tpu.serve.load_balancer import LoadStats
+        stats = (self.lb.load_stats() if self.lb is not None else
+                 LoadStats(qps=0.0, queue_length=0, window_seconds=1.0))
         decisions = self.autoscaler.evaluate(stats, replicas)
         self._apply(decisions)
         replicas = serve_state.list_replicas(self.service_name)
-        self._sync_lb(replicas)
+        if self.lb is not None:
+            self._sync_lb(replicas)
         self._update_service_status(replicas)
 
     def run(self) -> None:

@@ -117,6 +117,17 @@ def wait_ready(service_name: str, timeout: float = 300.0) -> Dict[str, Any]:
         f'Service {service_name} not ready after {timeout:.0f}s.')
 
 
+def wait_gone(service_name: str, timeout: float = 120.0) -> None:
+    """Block until the service record is removed (post-`down` helper)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if serve_state.get_service(service_name) is None:
+            return
+        time.sleep(0.5)
+    raise exceptions.ServeError(
+        f'Service {service_name} still present after {timeout:.0f}s.')
+
+
 def tail_logs(service_name: str,
               replica_id: Optional[int] = None) -> str:
     """Controller log, or one replica's cluster log."""

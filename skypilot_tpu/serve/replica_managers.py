@@ -188,6 +188,10 @@ class ReplicaManager:
     # -- probing -------------------------------------------------------
 
     def _probe_once(self, endpoint: str) -> bool:
+        if self.spec.pool:
+            # Pool workers serve no HTTP endpoint; provisioned + setup
+            # done (which _launch_replica guarantees) == ready.
+            return True
         url = urllib.parse.urljoin(endpoint, self.spec.readiness_path)
         try:
             with urllib.request.urlopen(

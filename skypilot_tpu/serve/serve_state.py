@@ -130,6 +130,7 @@ class ServiceRecord:
         return {
             'name': self.name,
             'status': self.status.value,
+            'spec': self.spec,
             'lb_port': self.lb_port,
             'requested_at': self.requested_at,
             'failure_reason': self.failure_reason,
@@ -173,6 +174,15 @@ def set_service_status(name: str, status: ServiceStatus,
     else:
         conn.execute('UPDATE services SET status = ? WHERE name = ?',
                      (status.value, name))
+    conn.commit()
+
+
+def set_service_spec(name: str, spec: Dict[str, Any]) -> None:
+    """Update a live service's spec (the controller hot-reloads it each
+    tick — pool resizes ride this instead of a down/up cycle)."""
+    conn = _db()
+    conn.execute('UPDATE services SET spec = ? WHERE name = ?',
+                 (json.dumps(spec), name))
     conn.commit()
 
 

@@ -31,11 +31,14 @@ def run_service(service_name: str) -> None:
     task = Task.from_yaml_config(record.task_config)
     serve_state.set_controller_pid(service_name, os.getpid())
 
-    policy = LoadBalancingPolicy.make(spec.load_balancing_policy)
-    lb = LoadBalancer(policy, qps_window_seconds=spec.qps_window_seconds)
-    host = os.environ.get('SKYT_SERVE_LB_HOST', '127.0.0.1')
-    assert record.lb_port is not None
-    server = start_load_balancer(lb, host, record.lb_port)
+    server = None
+    lb = None
+    if not spec.pool:
+        policy = LoadBalancingPolicy.make(spec.load_balancing_policy)
+        lb = LoadBalancer(policy, qps_window_seconds=spec.qps_window_seconds)
+        host = os.environ.get('SKYT_SERVE_LB_HOST', '127.0.0.1')
+        assert record.lb_port is not None
+        server = start_load_balancer(lb, host, record.lb_port)
 
     controller = ServeController(service_name, spec, task, lb)
     try:
@@ -47,7 +50,8 @@ def run_service(service_name: str) -> None:
                                        failure_reason='controller crashed')
         raise
     finally:
-        server.shutdown()
+        if server is not None:
+            server.shutdown()
 
 
 def main(argv: Optional[list] = None) -> None:

@@ -46,6 +46,7 @@ class ServiceSpec:
         base_ondemand_fallback_replicas: int = 0,
         dynamic_ondemand_fallback: bool = False,
         load_balancing_policy: str = 'least_load',
+        pool: bool = False,
     ) -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidSpecError(
@@ -81,6 +82,10 @@ class ServiceSpec:
             base_ondemand_fallback_replicas)
         self.dynamic_ondemand_fallback = bool(dynamic_ondemand_fallback)
         self.load_balancing_policy = load_balancing_policy
+        # Pool mode (parity: `sky jobs pool`, built on the serve stack):
+        # workers are plain clusters — no load balancer, no HTTP probe;
+        # ready = provisioned + setup done.
+        self.pool = bool(pool)
 
     @property
     def autoscaling(self) -> bool:
@@ -123,6 +128,11 @@ class ServiceSpec:
         else:
             raise exceptions.InvalidSpecError(
                 f'readiness_probe must be a path or dict: {probe!r}')
+        if 'pool' in config:
+            kwargs['pool'] = bool(config['pool'])
+        if 'workers' in config:  # pool-mode alias for replicas
+            config = dict(config)
+            config['replicas'] = config.pop('workers')
         if 'replicas' in config and 'replica_policy' in config:
             raise exceptions.InvalidSpecError(
                 'Set only one of replicas / replica_policy.')
@@ -145,7 +155,7 @@ class ServiceSpec:
                 'load_balancing_policy']
         unknown = set(config) - {
             'port', 'readiness_probe', 'replicas', 'replica_policy',
-            'load_balancing_policy'
+            'load_balancing_policy', 'pool', 'workers'
         }
         if unknown:
             raise exceptions.InvalidSpecError(
@@ -163,6 +173,8 @@ class ServiceSpec:
         }
         if self.port is not None:
             config['port'] = self.port
+        if self.pool:
+            config['pool'] = True
         policy: Dict[str, Any] = {
             'min_replicas': self.min_replicas,
             'upscale_delay_seconds': self.upscale_delay_seconds,

@@ -40,7 +40,7 @@ DEFAULT_PORT = 46590
 # sky/server/server.py exempts /api/health from the auth middlewares;
 # /api/metrics is scraped by Prometheus which typically has no user token,
 # matching the reference's separate unauthenticated metrics port).
-_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics'})
+_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics', '/dashboard'})
 
 
 def _auth_enabled() -> bool:
@@ -132,7 +132,9 @@ class ApiHandler(BaseHTTPRequestHandler):
             if not authorized:
                 self._deny()
                 return
-            if route == '/api/cancel':
+            if route == '/api/tunnel':
+                self._handle_tunnel()
+            elif route == '/api/cancel':
                 body = self._json_body()
                 ok = executor_lib.cancel_request(body['request_id'])
                 self._reply({'cancelled': ok})
@@ -147,7 +149,9 @@ class ApiHandler(BaseHTTPRequestHandler):
                 request_id = requests_db.create(
                     name, body, schedule_type,
                     user=(user.name if user else
-                          self.headers.get('X-Skyt-User')))
+                          self.headers.get('X-Skyt-User')),
+                    idem_key=self.headers.get('X-Skyt-Idempotency-Key'),
+                    workspace=self.headers.get('X-Skyt-Workspace'))
                 self._reply({'request_id': request_id})
             else:
                 self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
@@ -191,6 +195,74 @@ class ApiHandler(BaseHTTPRequestHandler):
         else:
             self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
 
+    def _handle_tunnel(self) -> None:
+        """Duplex byte tunnel to a cluster head host's SSH port.
+
+        Parity: ``sky/templates/websocket_proxy.py`` + server websocket
+        routes — `skyt ssh` reaches clusters THROUGH the API server (the
+        client may have no direct route to cluster IPs). Protocol: POST
+        with X-Skyt-Cluster; on 200 the HTTP framing ends and the
+        connection becomes a raw byte pipe to <head>:<ssh_port> (the
+        same connection-hijack trick websockets use).
+        """
+        import socket as socket_lib
+        import threading
+        from skypilot_tpu import state
+        cluster_name = self.headers.get('X-Skyt-Cluster', '')
+        record = state.get_cluster(cluster_name)
+        if record is None or not record.handle.get('hosts'):
+            self._error(HTTPStatus.NOT_FOUND,
+                        f'no cluster {cluster_name!r}')
+            return
+        # Same workspace isolation as every other cluster op: SSH into a
+        # cluster is the most direct cross-tenant access there is.
+        caller_workspace = self.headers.get('X-Skyt-Workspace', 'default')
+        if record.workspace != caller_workspace:
+            self._error(HTTPStatus.FORBIDDEN,
+                        f'cluster {cluster_name!r} belongs to workspace '
+                        f'{record.workspace!r} (yours: '
+                        f'{caller_workspace!r})')
+            return
+        head = record.handle['hosts'][0]
+        addr = head.get('external_ip') or head.get('internal_ip')
+        port = int(self.headers.get('X-Skyt-Port',
+                                    head.get('ssh_port', 22)))
+        try:
+            upstream = socket_lib.create_connection((addr, port),
+                                                    timeout=15)
+        except OSError as e:
+            self._error(HTTPStatus.BAD_GATEWAY,
+                        f'cannot reach {addr}:{port}: {e}')
+            return
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/octet-stream')
+        self.end_headers()
+        self.close_connection = True
+        client = self.connection
+
+        def pump(src, dst) -> None:
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for sock in (src, dst):
+                    try:
+                        sock.shutdown(socket_lib.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        down = threading.Thread(target=pump, args=(upstream, client),
+                                daemon=True)
+        down.start()
+        pump(client, upstream)
+        down.join(timeout=5)
+        upstream.close()
+
     def _handle_upload(self) -> None:
         """Chunked workdir upload: gzipped tar body, content-addressed
         extraction (parity: server.py:1564 + blob storage)."""
@@ -227,6 +299,21 @@ class ApiHandler(BaseHTTPRequestHandler):
                 })
             elif route == '/api/users':
                 self._reply([u.to_dict() for u in users_db.list_users()])
+            elif route == '/api/workspaces':
+                from skypilot_tpu import workspaces
+                self._reply(workspaces.list_workspaces())
+            elif route == '/dashboard':
+                from skypilot_tpu.server import dashboard
+                body = dashboard.DASHBOARD_HTML.encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'text/html; charset=utf-8')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif route == '/api/dashboard/data':
+                from skypilot_tpu.server import dashboard
+                self._reply(dashboard.collect_data())
             elif route == '/api/metrics':
                 from skypilot_tpu.server import metrics
                 body = metrics.render_text().encode()
@@ -275,7 +362,10 @@ class ApiHandler(BaseHTTPRequestHandler):
             time.sleep(0.05)
 
     def _handle_stream(self) -> None:
-        """Chunked tail of a request's log until it finishes."""
+        """Chunked tail of a request's log until it finishes.
+
+        ``tail_from=<byte offset>`` resumes a cut stream without replaying
+        bytes the client already has (chaos: tests/chaos_proxy.py)."""
         query = self._query
         request_id = query.get('request_id', '')
         follow = query.get('follow', 'true') != 'false'
@@ -293,7 +383,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             self.wfile.write(f'{len(data):x}\r\n'.encode())
             self.wfile.write(data + b'\r\n')
 
-        pos = 0
+        pos = int(query.get('tail_from', 0))
         while True:
             # Status first, read second: bytes written between the read and
             # a later terminal-status check would otherwise never be sent.
@@ -318,6 +408,8 @@ class ApiServer:
 
     def __init__(self, host: str = '127.0.0.1',
                  port: int = DEFAULT_PORT) -> None:
+        from skypilot_tpu import plugins
+        plugins.load_plugins()
         self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
         self.httpd.daemon_threads = True
         self.executor = executor_lib.Executor()

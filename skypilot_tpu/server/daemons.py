@@ -85,6 +85,73 @@ def _jobs_refresh_tick() -> None:
     scheduler.maybe_schedule_next_jobs()
 
 
+def _log_ship_tick() -> None:
+    """Ship finished jobs' logs to the configured external store
+    (parity: sky/logs/__init__.py:12 get_logging_agent → GCP Cloud
+    Logging / CloudWatch agents; here the sink is any storage backend —
+    ``logs.store: gs://bucket`` / ``s3://…`` / ``file:///dir``)."""
+    import io
+    import json
+    import os
+    import tempfile
+    from skypilot_tpu import config, state
+    dest = config.get_nested(('logs', 'store'), None)
+    if not dest:
+        return
+    from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+    from skypilot_tpu.data.storage import Storage
+    from skypilot_tpu.provision.api import ClusterInfo
+    from skypilot_tpu.server import requests_db
+    manifest_path = os.path.join(requests_db.server_dir(),
+                                 'shipped_logs.json')
+    manifest = {}
+    if os.path.exists(manifest_path):
+        try:
+            with open(manifest_path, encoding='utf-8') as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            manifest = {}
+    storage = Storage(source=dest, mode='COPY')
+    if not storage.store.exists():
+        storage.store.create()  # the sink is ours to create
+    backend = TpuPodBackend()
+    shipped_any = False
+    for record in state.get_clusters():
+        if record.status != state.ClusterStatus.UP:
+            continue
+        info = ClusterInfo.from_dict(record.handle)
+        try:
+            jobs = backend.queue(info)
+        except Exception:  # pylint: disable=broad-except
+            continue
+        for job in jobs:
+            if job['status'] not in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+                continue
+            key = f'{record.name}/{job["job_id"]}'
+            if key in manifest:
+                continue
+            try:
+                text = backend.tail_logs(info, job['job_id'],
+                                         stream=io.StringIO())
+            except Exception:  # pylint: disable=broad-except
+                continue
+            with tempfile.TemporaryDirectory() as tmp:
+                path = os.path.join(tmp, f'job-{job["job_id"]}.log')
+                with open(path, 'w', encoding='utf-8') as f:
+                    f.write(text)
+                storage.store.upload(
+                    path, prefix=f'skyt-logs/{record.name}')
+            manifest[key] = True
+            shipped_any = True
+            logger.info('Shipped logs for %s to %s', key, dest)
+    if shipped_any:
+        os.makedirs(requests_db.server_dir(), exist_ok=True)
+        tmp_path = manifest_path + '.tmp'
+        with open(tmp_path, 'w', encoding='utf-8') as f:
+            json.dump(manifest, f)
+        os.replace(tmp_path, manifest_path)
+
+
 def _interval(key: str, default: float) -> Callable[[], float]:
     def get() -> float:
         from skypilot_tpu import config
@@ -100,6 +167,9 @@ def build_daemons() -> List[Daemon]:
         Daemon('managed-jobs-refresh',
                _interval('jobs_refresh_interval', 30.0),
                _jobs_refresh_tick),
+        Daemon('log-shipper',
+               _interval('log_ship_interval', 60.0),
+               _log_ship_tick),
     ]
 
 

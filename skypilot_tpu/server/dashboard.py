@@ -1,0 +1,185 @@
+"""Dashboard: a dependency-free web UI served by the API server.
+
+Parity target: ``sky/dashboard`` (a 42k-LoC Next.js app). Rebuilt as a
+single self-contained page — the API server renders ``/dashboard`` (one
+HTML document, no build step, no npm) which polls
+``/api/dashboard/data`` (this module's collector reading the state DBs
+in-process) and renders clusters, managed jobs, services, pools,
+volumes, workspaces and recent requests. Deliberately server-local:
+every byte comes from the same process that owns the DBs, so the
+dashboard works on an air-gapped TPU pod head node.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+
+def collect_data() -> Dict[str, Any]:
+    """Everything the dashboard shows, in one JSON document."""
+    from skypilot_tpu import state, volumes, workspaces
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.server import requests_db
+
+    clusters = []
+    for record in state.get_clusters():
+        resources = record.resources or {}
+        clusters.append({
+            'name': record.name,
+            'status': record.status.value,
+            'cloud': record.cloud,
+            'region': record.region,
+            'resources': (resources.get('accelerators') or
+                          resources.get('instance_type') or 'cpu'),
+            'nodes': record.num_nodes,
+            'workspace': record.workspace,
+            'hourly_cost': round(record.hourly_cost, 3),
+            'age_s': (time.time() - record.launched_at
+                      if record.launched_at else None),
+        })
+
+    jobs = []
+    for job in jobs_state.list_jobs():
+        jobs.append({
+            'job_id': job.job_id,
+            'name': job.name,
+            'status': job.status.value,
+            'cluster_name': job.cluster_name,
+            'recoveries': job.recovery_count,
+        })
+
+    services, pools = [], []
+    for service in serve_state.list_services():
+        d = service.to_dict()
+        ready = sum(1 for r in d['replicas'] if r['status'] == 'READY')
+        row = {'name': d['name'], 'status': d['status'],
+               'replicas': f"{ready}/{len(d['replicas'])}"}
+        (pools if (d.get('spec') or {}).get('pool') else services).append(
+            row)
+
+    recent_requests = [{
+        'request_id': r.request_id[:8],
+        'name': r.name,
+        'status': r.status.value,
+        'user': r.user,
+        'created_at': r.created_at,
+    } for r in requests_db.list_requests(limit=25)]
+
+    return {
+        'generated_at': time.time(),
+        'clusters': clusters,
+        'jobs': jobs,
+        'services': services,
+        'pools': pools,
+        'volumes': volumes.ls(),
+        'workspaces': [
+            {'name': name,
+             'allowed_clouds': ','.join(spec.get('allowed_clouds') or [])
+                               or '(any)'}
+            for name, spec in sorted(workspaces.list_workspaces().items())
+        ],
+        'requests': recent_requests,
+    }
+
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>skypilot-tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 14px/1.45 system-ui, sans-serif; margin: 2rem auto;
+         max-width: 1100px; padding: 0 1rem; }
+  h1 { font-size: 1.3rem; }
+  h2 { font-size: 1.05rem; margin: 1.6rem 0 .4rem; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: .3rem .6rem;
+           border-bottom: 1px solid color-mix(in srgb, currentColor 18%, transparent); }
+  th { font-weight: 600; opacity: .7; text-transform: uppercase;
+       font-size: .72rem; letter-spacing: .04em; }
+  .pill { padding: .05rem .5rem; border-radius: 99px; font-size: .8rem;
+          border: 1px solid currentColor; }
+  .UP, .READY, .SUCCEEDED, .RUNNING { color: #2e7d32; }
+  .INIT, .PENDING, .STARTING, .RECOVERING, .REPLICA_INIT { color: #b26a00; }
+  .STOPPED { color: #666; }
+  .FAILED, .FAILED_PROVISION, .CANCELLED, .CONTROLLER_FAILED { color: #c62828; }
+  .muted { opacity: .6; }
+  #updated { font-size: .8rem; opacity: .6; }
+</style>
+</head>
+<body>
+<h1>skypilot-tpu <span class="muted">dashboard</span></h1>
+<div id="updated">loading…</div>
+<div id="content"></div>
+<script>
+const SECTIONS = [
+  ['Clusters', 'clusters', ['name','status','cloud','region','resources','nodes','workspace','hourly_cost','age']],
+  ['Managed jobs', 'jobs', ['job_id','name','status','cluster_name','recoveries']],
+  ['Services', 'services', ['name','status','replicas']],
+  ['Pools', 'pools', ['name','status','replicas']],
+  ['Volumes', 'volumes', ['name','type','size_gb','status','attached']],
+  ['Workspaces', 'workspaces', ['name','allowed_clouds']],
+  ['Recent requests', 'requests', ['request_id','name','status','user']],
+];
+function fmtAge(s) {
+  if (s == null) return '';
+  if (s < 90) return Math.round(s) + 's';
+  if (s < 5400) return Math.round(s/60) + 'm';
+  return (s/3600).toFixed(1) + 'h';
+}
+function esc(v) {
+  // Names/users are free-form user input; escape EVERYTHING rendered
+  // into innerHTML (stored-XSS guard).
+  return String(v).replace(/[&<>"']/g, c => ({
+    '&':'&amp;', '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
+}
+const STATUS_CLASSES = new Set(['UP','READY','SUCCEEDED','RUNNING','INIT',
+  'PENDING','STARTING','RECOVERING','REPLICA_INIT','STOPPED','FAILED',
+  'FAILED_PROVISION','CANCELLED','CONTROLLER_FAILED']);
+function cell(row, col) {
+  if (col === 'age') return fmtAge(row.age_s);
+  if (col === 'attached') return esc((row.attached_to||[]).join(', '));
+  if (col === 'status') {
+    const v = String(row.status || '');
+    const cls = STATUS_CLASSES.has(v) ? v : '';
+    return `<span class="pill ${cls}">${esc(v)}</span>`;
+  }
+  const v = row[col];
+  return v === null || v === undefined ? '' : esc(v);
+}
+function render(data) {
+  let html = '';
+  for (const [title, key, cols] of SECTIONS) {
+    const rows = data[key] || [];
+    html += `<h2>${title} <span class="muted">(${rows.length})</span></h2>`;
+    if (!rows.length) { html += '<div class="muted">none</div>'; continue; }
+    html += '<table><tr>' + cols.map(c => `<th>${c}</th>`).join('') + '</tr>';
+    for (const row of rows) {
+      html += '<tr>' + cols.map(c => `<td>${cell(row, c)}</td>`).join('') + '</tr>';
+    }
+    html += '</table>';
+  }
+  document.getElementById('content').innerHTML = html;
+  document.getElementById('updated').textContent =
+    'updated ' + new Date(data.generated_at * 1000).toLocaleTimeString();
+}
+async function tick() {
+  try {
+    const resp = await fetch('/api/dashboard/data', {
+      headers: window.SKYT_TOKEN ? {Authorization: 'Bearer ' + window.SKYT_TOKEN} : {},
+    });
+    if (resp.ok) render(await resp.json());
+    else document.getElementById('updated').textContent =
+      'error: HTTP ' + resp.status;
+  } catch (e) {
+    document.getElementById('updated').textContent = 'error: ' + e;
+  }
+}
+tick();
+setInterval(tick, 3000);
+</script>
+</body>
+</html>
+"""

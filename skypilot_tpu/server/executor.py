@@ -1,40 +1,53 @@
-"""Async request executor: LONG/SHORT queues, one process per request.
+"""Async request executor: LONG/SHORT queues served by a worker pool.
 
 Parity: ``sky/server/requests/executor.py`` (:1-19 queue design,
 RequestWorker :175, `_get_queue` :351, `start` :1063). LONG requests
 (launch/start — hold provisioning locks for minutes) get a small dedicated
 pool so they cannot starve SHORT requests (status/logs).
 
-Each claimed request runs in a forked process with stdout/stderr redirected
-to the per-request log file; the result/error is written back to the request
-DB, so clients can disconnect and re-attach.
+Architecture: the server process never forks (it is multi-threaded — HTTP
+threads + monitor — and forking a threaded process risks deadlocks in the
+child). Instead it spawns single-threaded RUNNER processes on demand, up
+to the per-queue cap. Each runner loops: claim a request from the DB
+(atomic cross-process pop, requests_db.claim_next), fork a child for it
+(safe: the runner has one thread), wait, finalize if the child died
+without writing a result. The fork gives each request env/config isolation
+and a private log file, like the reference's one-process-per-request
+execution. Runners are spawned with ``python -S`` so the image's
+sitecustomize (which force-imports jax) is skipped — a runner starts in
+~0.3s and never touches an accelerator.
 """
 from __future__ import annotations
 
 import json
-import multiprocessing
 import os
 import signal
+import subprocess
 import sys
 import threading
 import time
 import traceback
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
-from skypilot_tpu.server import payloads, requests_db
-from skypilot_tpu.server.requests_db import (Request, RequestStatus,
-                                             ScheduleType)
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.requests_db import RequestStatus, ScheduleType
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils.subprocess_utils import kill_process_tree
 
 logger = log.init_logger(__name__)
 
-_mp = multiprocessing.get_context('fork')
-
 DEFAULT_WORKERS = {
     ScheduleType.LONG: int(os.environ.get('SKYT_LONG_WORKERS', '4')),
     ScheduleType.SHORT: int(os.environ.get('SKYT_SHORT_WORKERS', '16')),
 }
+
+# How long a RUNNING request may have a dead pid before the monitor
+# declares the worker lost and finalizes it FAILED.
+_ORPHAN_GRACE_S = 2.0
+# How long a RUNNING request may go without any recorded pid (the fork
+# happens right after the claim; a longer gap means the runner died in
+# between).
+_PIDLESS_GRACE_S = 10.0
 
 
 def _run_request_in_child(request_id: str) -> None:
@@ -52,11 +65,17 @@ def _run_request_in_child(request_id: str) -> None:
         if isinstance(handler, logging.StreamHandler):
             handler.stream = sys.stderr
     requests_db.set_pid(request_id, os.getpid())
+    # The caller's workspace scopes everything this request does (state
+    # stamping, status filtering, launch placement) via the env the core
+    # ops read (workspaces.active_workspace).
+    if request.workspace:
+        os.environ['SKYT_WORKSPACE'] = request.workspace
     # A cancel that raced the claim may have already finalized CANCELLED
     # without seeing a pid to kill; honor it instead of running the payload.
     request = requests_db.get(request_id)
     if request is None or request.status.is_terminal():
         return
+    from skypilot_tpu.server import payloads
     fn, _ = payloads.PAYLOADS[request.name]
     try:
         result = fn(**request.body)
@@ -70,24 +89,87 @@ def _run_request_in_child(request_id: str) -> None:
         requests_db.finalize(request_id, RequestStatus.FAILED,
                              error=f'{type(e).__name__}: {e}')
     finally:
-        # multiprocessing children exit via os._exit (no atexit): flush
-        # any buffered timeline spans explicitly or they are lost.
+        # The child exits via os._exit (no atexit): flush any buffered
+        # timeline spans explicitly or they are lost.
         from skypilot_tpu.utils import timeline
         timeline.save()
         log_file.flush()
 
 
+def runner_main(schedule_type_value: str) -> None:
+    """Body of one pool runner process (single-threaded; safe to fork)."""
+    schedule_type = ScheduleType(schedule_type_value)
+    # Import the payload entrypoints (core/execution — the heavy modules)
+    # once in the runner, so every forked request child inherits them warm
+    # and starts executing immediately. Plugins load here too: their
+    # payloads/strategies must exist in the process that dispatches them.
+    from skypilot_tpu.server import payloads  # noqa: F401
+    from skypilot_tpu import plugins
+    plugins.load_plugins()
+    current_child = {'pid': None}
+
+    def _terminate(signum, frame):  # noqa: ARG001
+        del signum, frame
+        if current_child['pid']:
+            kill_process_tree(current_child['pid'], signal.SIGTERM)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    idle_sleep = 0.05
+    while True:
+        if os.getppid() == 1:  # server died; orphaned runner exits
+            return
+        request = requests_db.claim_next(schedule_type)
+        if request is None:
+            # Back off while the queue is dry (an idle pool must not
+            # hammer the DB's write lock); snap back on the next claim.
+            time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 1.5, 0.5)
+            continue
+        idle_sleep = 0.05
+        pid = os.fork()
+        if pid == 0:
+            try:
+                _run_request_in_child(request.request_id)
+            finally:
+                os._exit(0)
+        current_child['pid'] = pid
+        # A hard-killed runner (kill -9/OOM) cannot clean up its child;
+        # the detached reaper kills the request's tree when we vanish.
+        from skypilot_tpu.utils.subprocess_utils import spawn_orphan_reaper
+        spawn_orphan_reaper(os.getpid(), pid)
+        _, raw_status = os.waitpid(pid, 0)
+        current_child['pid'] = None
+        refreshed = requests_db.get(request.request_id)
+        if refreshed and not refreshed.status.is_terminal():
+            # Child died without finalizing (OOM/kill -9).
+            code = (os.waitstatus_to_exitcode(raw_status)
+                    if hasattr(os, 'waitstatus_to_exitcode') else raw_status)
+            requests_db.finalize(request.request_id, RequestStatus.FAILED,
+                                 error=f'worker exited with code {code}')
+
+
+def _runner_cmd(schedule_type: ScheduleType) -> List[str]:
+    from skypilot_tpu.utils.subprocess_utils import python_s_bootstrap
+    return python_s_bootstrap(
+        'from skypilot_tpu.server.executor import runner_main; '
+        'runner_main(sys.argv[1])') + [schedule_type.value]
+
+
 class Executor:
-    """Claims PENDING requests and runs each in its own forked process."""
+    """Scales runner processes up to per-queue caps; reaps orphans."""
 
     def __init__(self,
                  workers: Optional[Dict[ScheduleType, int]] = None) -> None:
         self._caps = dict(DEFAULT_WORKERS)
         if workers:
             self._caps.update(workers)
-        self._running: Dict[str, multiprocessing.process.BaseProcess] = {}
-        self._running_type: Dict[str, ScheduleType] = {}
-        self._lock = threading.Lock()
+        self._runners: Dict[ScheduleType, List[subprocess.Popen]] = {
+            t: [] for t in ScheduleType}
+        self._dead_pids: Dict[int, float] = {}  # request pid -> first-seen
+        self._pidless: Dict[str, float] = {}    # RUNNING w/o pid -> seen
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -101,58 +183,87 @@ class Executor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        with self._lock:
-            procs = list(self._running.values())
-        for proc in procs:
-            if proc.is_alive() and proc.pid:
-                kill_process_tree(proc.pid, signal.SIGTERM)
+        for pool in self._runners.values():
+            for proc in pool:
+                if proc.poll() is None:
+                    kill_process_tree(proc.pid, signal.SIGTERM)
+        deadline = time.time() + 5
+        for pool in self._runners.values():
+            for proc in pool:
+                try:
+                    proc.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    kill_process_tree(proc.pid, signal.SIGKILL)
 
     # ------------------------------------------------------------------
 
-    def _reap(self) -> None:
-        with self._lock:
-            done = [(rid, p) for rid, p in self._running.items()
-                    if not p.is_alive()]
-            for rid, proc in done:
-                proc.join()
-                del self._running[rid]
-                del self._running_type[rid]
-                request = requests_db.get(rid)
-                if request and not request.status.is_terminal():
-                    # Child died without finalizing (OOM/kill -9).
-                    requests_db.finalize(
-                        rid, RequestStatus.FAILED,
-                        error=f'worker exited with code {proc.exitcode}')
-
-    def _count(self, schedule_type: ScheduleType) -> int:
-        with self._lock:
-            return sum(1 for t in self._running_type.values()
-                       if t == schedule_type)
-
     def _loop(self) -> None:
+        log_path = os.path.join(requests_db.server_dir(), 'runners.log')
+        os.makedirs(requests_db.server_dir(), exist_ok=True)
+        runner_log = open(log_path, 'ab', buffering=0)
+        last_orphan_scan = 0.0
+        idle_wait = 0.05
         while not self._stop.is_set():
-            self._reap()
-            claimed = False
+            depths = requests_db.pending_depth_by_queue()
+            saw_backlog = False
             for schedule_type, cap in self._caps.items():
-                while self._count(schedule_type) < cap:
-                    request = requests_db.claim_next(schedule_type)
-                    if request is None:
-                        break
-                    self._spawn(request)
-                    claimed = True
-            if not claimed:
-                self._stop.wait(0.05)
+                pool = self._runners[schedule_type]
+                pool[:] = [p for p in pool if p.poll() is None]
+                backlog = depths.get(schedule_type.value, 0)
+                if not backlog:
+                    continue
+                saw_backlog = True
+                running = sum(
+                    1 for r in requests_db.list_requests(
+                        RequestStatus.RUNNING)
+                    if r.schedule_type == schedule_type)
+                idle = max(0, len(pool) - running)
+                want = min(cap - len(pool), backlog - idle)
+                for _ in range(max(0, want)):
+                    pool.append(
+                        subprocess.Popen(_runner_cmd(schedule_type),
+                                         stdout=runner_log,
+                                         stderr=runner_log,
+                                         start_new_session=True))
+                    logger.debug('Spawned %s runner (pool=%d)',
+                                 schedule_type.value, len(pool))
+            now = time.time()
+            if now - last_orphan_scan > 1.0:
+                self._reap_orphans(now)
+                last_orphan_scan = now
+            # Idle backoff: one cheap COUNT query per tick when quiet.
+            idle_wait = 0.05 if saw_backlog else min(idle_wait * 1.5, 0.5)
+            self._stop.wait(idle_wait)
+        runner_log.close()
 
-    def _spawn(self, request: Request) -> None:
-        proc = _mp.Process(target=_run_request_in_child,
-                           args=(request.request_id,),
-                           name=f'req-{request.request_id[:8]}')
-        proc.start()
-        with self._lock:
-            self._running[request.request_id] = proc
-            self._running_type[request.request_id] = request.schedule_type
-        logger.debug('Request %s (%s) -> pid %s', request.request_id[:8],
-                     request.name, proc.pid)
+    def _reap_orphans(self, now: float) -> None:
+        """Finalize RUNNING requests whose worker is gone: pid dead
+        (runner + child killed, e.g. OOM/kill -9), or pid never recorded
+        (runner died between claim and fork — without this, the request
+        stays RUNNING forever and clients long-poll indefinitely)."""
+        for request in requests_db.list_requests(RequestStatus.RUNNING):
+            if not request.pid:
+                first_seen = self._pidless.setdefault(request.request_id,
+                                                     now)
+                if now - first_seen > _PIDLESS_GRACE_S:
+                    self._pidless.pop(request.request_id, None)
+                    requests_db.finalize(
+                        request.request_id, RequestStatus.FAILED,
+                        error='worker died before starting')
+                continue
+            self._pidless.pop(request.request_id, None)
+            try:
+                os.kill(request.pid, 0)
+                self._dead_pids.pop(request.pid, None)
+            except ProcessLookupError:
+                first_seen = self._dead_pids.setdefault(request.pid, now)
+                if now - first_seen > _ORPHAN_GRACE_S:
+                    self._dead_pids.pop(request.pid, None)
+                    requests_db.finalize(
+                        request.request_id, RequestStatus.FAILED,
+                        error='worker process died')
+            except PermissionError:
+                self._dead_pids.pop(request.pid, None)
 
 
 def cancel_request(request_id: str) -> bool:
@@ -169,7 +280,7 @@ def cancel_request(request_id: str) -> bool:
             request = requests_db.get(request_id)
             if request is None or request.status.is_terminal():
                 return False
-    # Mark CANCELLED before killing: the reaper finalizes any dead worker
+    # Mark CANCELLED before killing: the runner finalizes any dead worker
     # whose request is still non-terminal as FAILED, and first terminal
     # writer wins — so the status must land before the SIGTERM does.
     cancelled = requests_db.finalize(request.request_id,
@@ -177,7 +288,7 @@ def cancel_request(request_id: str) -> bool:
                                      error='cancelled by user')
     if not cancelled:
         return False
-    # Re-fetch: the executor may have claimed + spawned between our first
+    # Re-fetch: a runner may have claimed + forked between our first
     # read and the finalize, so the pre-finalize snapshot's pid is stale.
     # (The child also re-checks terminal status after set_pid, covering the
     # window where the pid has not landed yet.)

@@ -48,10 +48,32 @@ def _check() -> Dict[str, Any]:
     return check.check()
 
 
+def _volumes_apply(volume_config: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import volumes
+    return volumes.apply(volumes.Volume.from_yaml_config(volume_config))
+
+
+def _volumes_ls() -> List[Dict[str, Any]]:
+    from skypilot_tpu import volumes
+    return volumes.refresh()
+
+
+def _volumes_delete(name: str) -> None:
+    from skypilot_tpu import volumes
+    volumes.delete(name)
+
+
 def _jobs_launch(task_config: Dict[str, Any],
                  name: Optional[str] = None) -> int:
     from skypilot_tpu.jobs import core as jobs_core
     return jobs_core.launch(Task.from_yaml_config(task_config), name)
+
+
+def _jobs_launch_group(task_configs: List[Dict[str, Any]],
+                       group_name: str) -> List[int]:
+    from skypilot_tpu.jobs import core as jobs_core
+    tasks = [Task.from_yaml_config(c) for c in task_configs]
+    return jobs_core.launch_group(tasks, group_name)
 
 
 def _jobs_queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
@@ -67,6 +89,24 @@ def _jobs_cancel(job_id: int) -> bool:
 def _jobs_logs(job_id: int, controller: bool = False) -> None:
     from skypilot_tpu.jobs import core as jobs_core
     print(jobs_core.tail_logs(job_id, controller=controller), end='')
+
+
+def _pool_apply(task_config: Dict[str, Any], pool_name: str,
+                workers: Optional[int] = None) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import pools
+    return pools.apply(Task.from_yaml_config(task_config), pool_name,
+                       workers=workers)
+
+
+def _pool_status(
+        pool_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import pools
+    return pools.status(pool_name)
+
+
+def _pool_down(pool_name: str, purge: bool = False) -> None:
+    from skypilot_tpu.jobs import pools
+    pools.down(pool_name, purge=purge)
 
 
 def _serve_up(task_config: Dict[str, Any],
@@ -107,11 +147,21 @@ PAYLOADS: Dict[str, Tuple[Callable[..., Any], ScheduleType]] = {
     'autostop': (core.autostop, ScheduleType.SHORT),
     'cost_report': (core.cost_report, ScheduleType.SHORT),
     'check': (_check, ScheduleType.SHORT),
+    'ssh_info': (core.ssh_info, ScheduleType.SHORT),
+    # Volumes (parity: sky/volumes/server/server.py routes).
+    'volumes/apply': (_volumes_apply, ScheduleType.SHORT),
+    'volumes/ls': (_volumes_ls, ScheduleType.SHORT),
+    'volumes/delete': (_volumes_delete, ScheduleType.SHORT),
     # Managed jobs: submission is quick (the controller does the work).
     'jobs/launch': (_jobs_launch, ScheduleType.SHORT),
+    'jobs/launch-group': (_jobs_launch_group, ScheduleType.SHORT),
     'jobs/queue': (_jobs_queue, ScheduleType.SHORT),
     'jobs/cancel': (_jobs_cancel, ScheduleType.SHORT),
     'jobs/logs': (_jobs_logs, ScheduleType.SHORT),
+    # Worker pools (parity: `sky jobs pool`, on the serve machinery).
+    'jobs/pool/apply': (_pool_apply, ScheduleType.SHORT),
+    'jobs/pool/status': (_pool_status, ScheduleType.SHORT),
+    'jobs/pool/down': (_pool_down, ScheduleType.SHORT),
     # Serving: submission is quick (the service process does the work).
     'serve/up': (_serve_up, ScheduleType.SHORT),
     'serve/down': (_serve_down, ScheduleType.SHORT),

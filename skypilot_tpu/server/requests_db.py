@@ -75,12 +75,23 @@ def _db() -> sqlite3.Connection:
             error TEXT,
             pid INTEGER,
             user TEXT,
+            idem_key TEXT,                 -- client idempotency key
+            workspace TEXT,                -- caller's active workspace
             created_at REAL,
             finished_at REAL
         );
         CREATE INDEX IF NOT EXISTS idx_requests_status
             ON requests (status, schedule_type);
+        CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem
+            ON requests (idem_key) WHERE idem_key IS NOT NULL;
     """)
+    cols = {r['name'] for r in conn.execute('PRAGMA table_info(requests)')}
+    if 'idem_key' not in cols:  # pre-existing DB from an older version
+        conn.execute('ALTER TABLE requests ADD COLUMN idem_key TEXT')
+        conn.execute('CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem '
+                     'ON requests (idem_key) WHERE idem_key IS NOT NULL')
+    if 'workspace' not in cols:
+        conn.execute('ALTER TABLE requests ADD COLUMN workspace TEXT')
     conn.commit()
     _local.conn = conn
     _local.path = path
@@ -100,6 +111,7 @@ class Request:
         self.error: Optional[str] = row['error']
         self.pid: Optional[int] = row['pid']
         self.user: Optional[str] = row['user']
+        self.workspace: Optional[str] = row['workspace']
         self.created_at: Optional[float] = row['created_at']
         self.finished_at: Optional[float] = row['finished_at']
 
@@ -113,6 +125,7 @@ class Request:
             'error': self.error,
             'pid': self.pid,
             'user': self.user,
+            'workspace': self.workspace,
             'created_at': self.created_at,
             'finished_at': self.finished_at,
         }
@@ -121,15 +134,37 @@ class Request:
 def create(name: str,
            body: Dict[str, Any],
            schedule_type: ScheduleType,
-           user: Optional[str] = None) -> str:
+           user: Optional[str] = None,
+           idem_key: Optional[str] = None,
+           workspace: Optional[str] = None) -> str:
+    """Insert a PENDING request; return its id.
+
+    ``idem_key`` makes submission retry-safe: a client resubmitting after a
+    dropped connection (chaos: tests/chaos_proxy.py) gets the original
+    request_id back instead of double-scheduling the work.
+    """
     request_id = common_utils.new_request_id()
     conn = _db()
-    conn.execute(
-        'INSERT INTO requests (request_id, name, body, status, '
-        'schedule_type, user, created_at) VALUES (?, ?, ?, ?, ?, ?, ?)',
-        (request_id, name, json.dumps(body), RequestStatus.PENDING.value,
-         schedule_type.value, user or common_utils.get_user(), time.time()))
-    conn.commit()
+    try:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, body, status, '
+            'schedule_type, user, idem_key, workspace, created_at) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
+            (request_id, name, json.dumps(body), RequestStatus.PENDING.value,
+             schedule_type.value, user or common_utils.get_user(), idem_key,
+             workspace, time.time()))
+        conn.commit()
+    except sqlite3.IntegrityError:
+        # idem_key collision: the earlier attempt reached us. Roll back
+        # first — the failed INSERT opened a write transaction that would
+        # otherwise hold the DB write lock for this thread's lifetime,
+        # starving every runner's claim.
+        conn.rollback()
+        row = conn.execute(
+            'SELECT request_id FROM requests WHERE idem_key = ?',
+            (idem_key,)).fetchone()
+        assert row is not None, idem_key
+        return row['request_id']
     return request_id
 
 
@@ -159,22 +194,36 @@ def list_requests(status: Optional[RequestStatus] = None,
 
 
 def claim_next(schedule_type: ScheduleType) -> Optional[Request]:
-    """Atomically pop the oldest PENDING request of this type."""
+    """Atomically pop the oldest PENDING request of this type.
+
+    Claimants are separate runner PROCESSES (executor worker pool), so the
+    pop must be atomic at the DB level: a single UPDATE..RETURNING on the
+    selected row, serialized by sqlite's write lock.
+    """
     conn = _db()
     with _claim_lock:
-        row = conn.execute(
-            'SELECT * FROM requests WHERE status = ? AND schedule_type = ? '
-            'ORDER BY created_at LIMIT 1',
-            (RequestStatus.PENDING.value, schedule_type.value)).fetchone()
+        try:
+            row = conn.execute(
+                'UPDATE requests SET status = ? WHERE request_id = ('
+                '  SELECT request_id FROM requests'
+                '  WHERE status = ? AND schedule_type = ?'
+                '  ORDER BY created_at LIMIT 1'
+                ') AND status = ? RETURNING request_id',
+                (RequestStatus.RUNNING.value, RequestStatus.PENDING.value,
+                 schedule_type.value,
+                 RequestStatus.PENDING.value)).fetchone()
+            conn.commit()
+        except sqlite3.OperationalError as e:
+            conn.rollback()
+            # Lock contention (another claimant won) is the expected
+            # transient; anything else — e.g. RETURNING unsupported on
+            # sqlite < 3.35 — must surface, not degrade into a silently
+            # frozen queue.
+            message = str(e).lower()
+            if 'locked' in message or 'busy' in message:
+                return None
+            raise
         if row is None:
-            return None
-        cur = conn.execute(
-            'UPDATE requests SET status = ? WHERE request_id = ? '
-            'AND status = ?',
-            (RequestStatus.RUNNING.value, row['request_id'],
-             RequestStatus.PENDING.value))
-        conn.commit()
-        if cur.rowcount != 1:
             return None
     return get(row['request_id'])
 

@@ -159,6 +159,11 @@ TASK_SCHEMA: Dict[str, Any] = {
             'type': 'object',
             'additionalProperties': _STORAGE_MOUNT,
         },
+        # mount_path -> volume name (`skyt volumes apply` objects).
+        'volumes': {
+            'type': 'object',
+            'additionalProperties': {'type': 'string'},
+        },
         'resources': {
             'anyOf': [
                 _RESOURCES,

@@ -39,6 +39,7 @@ class Task:
         secrets: Optional[Dict[str, str]] = None,
         file_mounts: Optional[Dict[str, str]] = None,
         storage_mounts: Optional[Dict[str, Dict[str, Any]]] = None,
+        volumes: Optional[Dict[str, str]] = None,
         resources: Union[None, Resources, List[Resources]] = None,
         service: Optional[Dict[str, Any]] = None,
         estimated_flops: Optional[float] = None,
@@ -63,6 +64,9 @@ class Task:
         self.file_mounts: Dict[str, str] = dict(file_mounts or {})
         self.storage_mounts: Dict[str, Dict[str, Any]] = dict(storage_mounts
                                                               or {})
+        # volumes: mount_path -> volume name (`skyt volumes apply` objects;
+        # parity: sky/utils/volume.py:55 VolumeMount).
+        self.volumes: Dict[str, str] = dict(volumes or {})
         if resources is None:
             self.resources: List[Resources] = [Resources()]
         elif isinstance(resources, Resources):
@@ -115,8 +119,8 @@ class Task:
         config = copy.deepcopy(config)
         known = {
             'name', 'setup', 'run', 'workdir', 'num_nodes', 'envs',
-            'secrets', 'file_mounts', 'storage_mounts', 'resources',
-            'service', 'config', '_policy_applied',
+            'secrets', 'file_mounts', 'storage_mounts', 'volumes',
+            'resources', 'service', 'config', '_policy_applied',
             'estimated_flops', 'estimated_inputs_gb', 'inputs_region',
         }
         unknown = set(config) - known
@@ -145,6 +149,7 @@ class Task:
             secrets=config.get('secrets'),
             file_mounts=config.get('file_mounts'),
             storage_mounts=config.get('storage_mounts'),
+            volumes=config.get('volumes'),
             resources=resources,
             service=config.get('service'),
             estimated_flops=config.get('estimated_flops'),
@@ -197,6 +202,8 @@ class Task:
             config['file_mounts'] = dict(self.file_mounts)
         if self.storage_mounts:
             config['storage_mounts'] = dict(self.storage_mounts)
+        if self.volumes:
+            config['volumes'] = dict(self.volumes)
         if self.setup:
             config['setup'] = self.setup
         if isinstance(self.run, str):

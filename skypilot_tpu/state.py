@@ -33,10 +33,13 @@ _local = threading.local()
 
 
 def _db() -> sqlite3.Connection:
-    """Per-thread connection; schema created on first use."""
+    """Per-thread connection; schema created on first use. Re-opened
+    after fork: sharing a parent's sqlite connection across processes
+    corrupts the DB (the executor forks a child per request)."""
     path = os.path.join(_state_dir(), 'state.db')
     conn = getattr(_local, 'conn', None)
-    if conn is not None and getattr(_local, 'path', None) == path:
+    if (conn is not None and getattr(_local, 'path', None) == path and
+            getattr(_local, 'pid', None) == os.getpid()):
         return conn
     os.makedirs(_state_dir(), exist_ok=True)
     conn = sqlite3.connect(path, timeout=10)
@@ -56,7 +59,8 @@ def _db() -> sqlite3.Connection:
             launched_at REAL,
             last_use REAL,
             owner TEXT,
-            hourly_cost REAL DEFAULT 0
+            hourly_cost REAL DEFAULT 0,
+            workspace TEXT DEFAULT 'default'
         );
         CREATE TABLE IF NOT EXISTS cluster_events (
             id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -72,10 +76,28 @@ def _db() -> sqlite3.Connection:
             status TEXT,
             created_at REAL
         );
+        CREATE TABLE IF NOT EXISTS volumes (
+            name TEXT PRIMARY KEY,
+            type TEXT NOT NULL,
+            cloud TEXT,
+            region TEXT,
+            zone TEXT,
+            size_gb INTEGER,
+            status TEXT,
+            config TEXT,               -- provider-specific JSON
+            attached_to TEXT,          -- JSON list of cluster names
+            created_at REAL,
+            last_attached REAL
+        );
     """)
+    cols = {r['name'] for r in conn.execute('PRAGMA table_info(clusters)')}
+    if 'workspace' not in cols:  # pre-existing DB from an older version
+        conn.execute("ALTER TABLE clusters ADD COLUMN workspace TEXT "
+                     "DEFAULT 'default'")
     conn.commit()
     _local.conn = conn
     _local.path = path
+    _local.pid = os.getpid()
     return conn
 
 
@@ -96,6 +118,7 @@ class ClusterRecord:
         self.last_use: Optional[float] = row['last_use']
         self.owner: Optional[str] = row['owner']
         self.hourly_cost: float = row['hourly_cost'] or 0.0
+        self.workspace: str = row['workspace'] or 'default'
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -111,7 +134,13 @@ class ClusterRecord:
             'last_use': self.last_use,
             'owner': self.owner,
             'hourly_cost': self.hourly_cost,
+            'workspace': self.workspace,
         }
+
+
+def volumes_db() -> sqlite3.Connection:
+    """The shared state DB, exposed for the volumes table (volumes.py)."""
+    return _db()
 
 
 def add_or_update_cluster(name: str,
@@ -131,14 +160,17 @@ def add_or_update_cluster(name: str,
                           (name,)).fetchone()
     now = time.time()
     if existing is None:
+        from skypilot_tpu import workspaces
         db.execute(
             'INSERT INTO clusters (name, status, cloud, region, zone, '
             'resources, handle, num_nodes, autostop, launched_at, last_use, '
-            'owner, hourly_cost) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)',
+            'owner, hourly_cost, workspace) '
+            'VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)',
             (name, status.value, cloud, region, zone,
              json.dumps(resources or {}), json.dumps(handle or {}),
              num_nodes or 1, json.dumps(autostop or {}), now, now,
-             common_utils.get_user(), hourly_cost or 0.0))
+             common_utils.get_user(), hourly_cost or 0.0,
+             workspaces.active_workspace()))
     else:
         updates: Dict[str, Any] = {'status': status.value}
         if cloud is not None:
@@ -171,9 +203,15 @@ def get_cluster(name: str) -> Optional[ClusterRecord]:
     return ClusterRecord(row) if row else None
 
 
-def get_clusters() -> List[ClusterRecord]:
-    rows = _db().execute(
-        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+def get_clusters(workspace: Optional[str] = None) -> List[ClusterRecord]:
+    """All clusters, optionally scoped to one workspace."""
+    if workspace is None:
+        rows = _db().execute(
+            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    else:
+        rows = _db().execute(
+            'SELECT * FROM clusters WHERE workspace=? '
+            'ORDER BY launched_at DESC', (workspace,)).fetchall()
     return [ClusterRecord(r) for r in rows]
 
 

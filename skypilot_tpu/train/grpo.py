@@ -101,6 +101,8 @@ def make_grpo_step(cfg, optimizer):
 
 
 def main(argv=None) -> int:
+    from skypilot_tpu.utils.jax_env import honor_jax_platforms
+    honor_jax_platforms()
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
     parser.add_argument('--steps', type=int, default=30)

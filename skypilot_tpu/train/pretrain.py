@@ -93,6 +93,8 @@ def file_batch_iterator(path: str, batch: int, seq: int):
 
 
 def main(argv=None) -> int:
+    from skypilot_tpu.utils.jax_env import honor_jax_platforms
+    honor_jax_platforms()
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
     parser.add_argument('--steps', type=int, default=20)

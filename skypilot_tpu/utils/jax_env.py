@@ -1,0 +1,20 @@
+"""JAX environment shims for payload entrypoints.
+
+The image's sitecustomize force-registers the remote-TPU backend and
+IGNORES ``JAX_PLATFORMS`` — so a CPU-forced run (tests, the virtual
+multi-chip dryrun, fake-cloud jobs) would still try to reach the
+accelerator, hanging when the TPU tunnel is unreachable. Every
+``python -m skypilot_tpu...`` payload entrypoint calls
+``honor_jax_platforms()`` first thing in ``main`` to re-assert the
+caller's platform choice before the backend initializes.
+"""
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    platforms = os.environ.get('JAX_PLATFORMS')
+    if platforms:
+        import jax
+        jax.config.update('jax_platforms', platforms)

@@ -1,0 +1,91 @@
+"""Orphan reaper: kill a process tree once its supervisor dies.
+
+Parity: ``sky/skylet/subprocess_daemon.py:1-5`` — a tiny detached
+process that waits for a parent pid to exit and then SIGTERM/SIGKILLs a
+target process tree. Used by the request executor: every forked request
+child gets a reaper watching its runner, so a hard-killed runner
+(kill -9, OOM) cannot leak a half-finished launch running forever.
+
+Run as: python -S -m-less bootstrap (see spawn_orphan_reaper) or
+``python -m skypilot_tpu.utils.subprocess_daemon --parent-pid P
+--proc-pid C``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--parent-pid', type=int, required=True)
+    parser.add_argument('--proc-pid', type=int, required=True)
+    parser.add_argument('--poll-seconds', type=float, default=1.0)
+    args = parser.parse_args(argv)
+
+    while _alive(args.parent_pid):
+        if not _alive(args.proc_pid):
+            return 0  # target finished normally; nothing to reap
+        time.sleep(args.poll_seconds)
+
+    if not _alive(args.proc_pid):
+        return 0
+    # Parent died with the target still running: orphan. Kill the tree.
+    # psutil may not be importable under -S bootstraps; walk /proc.
+    victims = _descendants(args.proc_pid) + [args.proc_pid]
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        for pid in victims:
+            try:
+                os.kill(pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+        deadline = time.time() + 5
+        while time.time() < deadline and any(_alive(p) for p in victims):
+            time.sleep(0.1)
+        if not any(_alive(p) for p in victims):
+            break
+    return 0
+
+
+def _descendants(root: int) -> list:
+    """All transitive children of root, leaves first (via /proc)."""
+    children: dict = {}
+    try:
+        for entry in os.listdir('/proc'):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f'/proc/{entry}/stat', encoding='utf-8',
+                          errors='replace') as f:
+                    fields = f.read().rsplit(')', 1)[-1].split()
+                ppid = int(fields[1])
+            except (OSError, IndexError, ValueError):
+                continue
+            children.setdefault(ppid, []).append(int(entry))
+    except OSError:
+        return []
+    out = []
+    stack = [root]
+    while stack:
+        pid = stack.pop()
+        for child in children.get(pid, []):
+            out.append(child)
+            stack.append(child)
+    return list(reversed(out))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

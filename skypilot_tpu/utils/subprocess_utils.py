@@ -75,6 +75,41 @@ def run_command(cmd,
     return returncode, ''.join(lines), ''
 
 
+def python_s_bootstrap(entry: str) -> List[str]:
+    """argv prefix for a `python -S` child that can import skypilot_tpu.
+
+    -S skips site startup — and with it the image's sitecustomize that
+    force-imports jax (~4s + an accelerator handle no control-plane
+    process wants) — so the child re-adds site-packages and the repo
+    root itself, then runs ``entry`` (a python statement; argv is
+    available as sys.argv[1:]).
+    """
+    import sysconfig
+    site_dir = sysconfig.get_paths()['purelib']
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    bootstrap = (
+        'import site, sys; '
+        f'site.addsitedir({site_dir!r}); '
+        f'sys.path.insert(0, {repo_root!r}); '
+        f'{entry}')
+    return [sys.executable, '-S', '-c', bootstrap]
+
+
+def spawn_orphan_reaper(parent_pid: int, proc_pid: int) -> None:
+    """Detached watchdog: when parent_pid dies, kill proc_pid's tree
+    (parity: sky/skylet/subprocess_daemon.py). Fire-and-forget; the
+    reaper exits on its own when the target finishes first."""
+    cmd = python_s_bootstrap(
+        'from skypilot_tpu.utils.subprocess_daemon import main; '
+        'sys.exit(main(sys.argv[1:]))')
+    subprocess.Popen(
+        cmd + ['--parent-pid', str(parent_pid),
+               '--proc-pid', str(proc_pid)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        stdin=subprocess.DEVNULL, start_new_session=True)
+
+
 def run_in_parallel(fn: Callable[[T], R],
                     args: Iterable[T],
                     max_workers: Optional[int] = None) -> List[R]:

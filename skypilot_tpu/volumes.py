@@ -1,0 +1,236 @@
+"""Volumes: named persistent storage objects, attachable to clusters.
+
+Parity: ``sky/volumes/`` (Volume model volume.py:25, server ops
+server/core.py: volume_apply :305 / volume_list :170 / volume_delete :248
+/ volume_refresh :29) and the ``sky volumes`` CLI group (command.py:5435).
+
+TPU-native stance: the volume types that matter on our two providers are
+Kubernetes PVCs (GKE TPU pods) and host-path-backed volumes on the
+fake/local providers (tests + dev); GCE persistent disks are modeled for
+the GCP provider's CPU controller VMs. A volume is created once, recorded
+in the state DB, mounted into any number of clusters via the task's
+``volumes:`` section, and deleted only when no UP cluster uses it.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+
+class VolumeType(enum.Enum):
+    PVC = 'k8s-pvc'
+    HOSTPATH = 'hostpath'
+    GCE_PD = 'gce-pd'
+
+
+class VolumeStatus(enum.Enum):
+    READY = 'READY'
+    IN_USE = 'IN_USE'
+
+
+_TYPE_TO_CLOUD = {
+    VolumeType.PVC: 'kubernetes',
+    VolumeType.HOSTPATH: 'fake',
+    VolumeType.GCE_PD: 'gcp',
+}
+
+
+class Volume:
+    """A volume spec (parity: volumes/volume.py:25 Volume)."""
+
+    def __init__(self,
+                 name: str,
+                 type: str,  # pylint: disable=redefined-builtin
+                 size_gb: int = 10,
+                 cloud: Optional[str] = None,
+                 region: Optional[str] = None,
+                 zone: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 use_existing: bool = False,
+                 config: Optional[Dict[str, Any]] = None) -> None:
+        if not name:
+            raise exceptions.InvalidSpecError('volume needs a name')
+        self.name = name
+        try:
+            self.type = VolumeType(type)
+        except ValueError:
+            raise exceptions.InvalidSpecError(
+                f'Unknown volume type {type!r}; one of '
+                f'{[t.value for t in VolumeType]}') from None
+        self.size_gb = int(size_gb)
+        self.cloud = cloud or _TYPE_TO_CLOUD[self.type]
+        self.region = region
+        self.zone = zone
+        self.labels = dict(labels or {})
+        self.use_existing = use_existing
+        self.config = dict(config or {})
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Volume':
+        config = dict(config)
+        size = config.pop('size', None)
+        if size is not None:
+            config['size_gb'] = int(str(size).rstrip('GgiB '))
+        return cls(**config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        return {
+            'name': self.name,
+            'type': self.type.value,
+            'size_gb': self.size_gb,
+            'cloud': self.cloud,
+            'region': self.region,
+            'zone': self.zone,
+            'labels': self.labels,
+            'use_existing': self.use_existing,
+            'config': self.config,
+        }
+
+
+# -- state (volumes table lives next to clusters/storage) --------------
+
+
+def _db():
+    return state.volumes_db()
+
+
+def _record_to_dict(row) -> Dict[str, Any]:
+    return {
+        'name': row['name'],
+        'type': row['type'],
+        'cloud': row['cloud'],
+        'region': row['region'],
+        'zone': row['zone'],
+        'size_gb': row['size_gb'],
+        'status': row['status'],
+        'config': json.loads(row['config'] or '{}'),
+        'created_at': row['created_at'],
+        'last_attached': row['last_attached'],
+        'attached_to': json.loads(row['attached_to'] or '[]'),
+    }
+
+
+# -- ops ---------------------------------------------------------------
+
+
+def apply(volume: Volume) -> Dict[str, Any]:
+    """Create (or adopt, when use_existing) a volume; idempotent.
+
+    Parity: volumes/server/core.py:305 volume_apply.
+    """
+    db = _db()
+    row = db.execute('SELECT * FROM volumes WHERE name=?',
+                     (volume.name,)).fetchone()
+    if row is not None:
+        return _record_to_dict(row)
+    from skypilot_tpu.provision.api import get_provider
+    provider = get_provider(volume.cloud)
+    if not hasattr(provider, 'create_volume'):
+        raise exceptions.NotSupportedError(
+            f'Provider {volume.cloud!r} does not support volumes.')
+    provider_config = provider.create_volume(volume)
+    merged = {**volume.config, **provider_config}
+    db.execute(
+        'INSERT INTO volumes (name, type, cloud, region, zone, size_gb, '
+        'status, config, created_at) VALUES (?,?,?,?,?,?,?,?,?)',
+        (volume.name, volume.type.value, volume.cloud, volume.region,
+         volume.zone, volume.size_gb, VolumeStatus.READY.value,
+         json.dumps(merged), time.time()))
+    db.commit()
+    logger.info('Volume %s (%s, %dGiB) ready', volume.name,
+                volume.type.value, volume.size_gb)
+    return get(volume.name)
+
+
+def get(name: str) -> Dict[str, Any]:
+    row = _db().execute('SELECT * FROM volumes WHERE name=?',
+                        (name,)).fetchone()
+    if row is None:
+        raise exceptions.StorageError(f'Volume {name!r} does not exist.')
+    return _record_to_dict(row)
+
+
+def ls() -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT * FROM volumes ORDER BY created_at').fetchall()
+    return [_record_to_dict(r) for r in rows]
+
+
+def delete(name: str) -> None:
+    """Delete a volume; refused while any live cluster has it attached.
+
+    Parity: volumes/server/core.py:248 volume_delete.
+    """
+    record = get(name)
+    attached = _live_attachments(record)
+    if attached:
+        raise exceptions.StorageError(
+            f'Volume {name!r} is attached to cluster(s) {attached}; '
+            f'tear them down first.')
+    from skypilot_tpu.provision.api import get_provider
+    provider = get_provider(record['cloud'])
+    if hasattr(provider, 'delete_volume'):
+        provider.delete_volume(record)
+    db = _db()
+    db.execute('DELETE FROM volumes WHERE name=?', (name,))
+    db.commit()
+
+
+def refresh() -> List[Dict[str, Any]]:
+    """Reconcile IN_USE/READY with actual cluster liveness (parity:
+    volumes/server/core.py:29 volume_refresh, run by the server daemon)."""
+    out = []
+    db = _db()
+    for record in ls():
+        attached = _live_attachments(record)
+        status = (VolumeStatus.IN_USE if attached else
+                  VolumeStatus.READY).value
+        if status != record['status'] or attached != record['attached_to']:
+            db.execute(
+                'UPDATE volumes SET status=?, attached_to=? WHERE name=?',
+                (status, json.dumps(attached), record['name']))
+            db.commit()
+            record = get(record['name'])
+        out.append(record)
+    return out
+
+
+def _live_attachments(record: Dict[str, Any]) -> List[str]:
+    live = []
+    for cluster_name in record['attached_to']:
+        cluster = state.get_cluster(cluster_name)
+        if cluster is not None and cluster.status != state.ClusterStatus.INIT:
+            live.append(cluster_name)
+    return live
+
+
+def note_attached(name: str, cluster_name: str) -> None:
+    record = get(name)
+    attached = set(record['attached_to'])
+    attached.add(cluster_name)
+    db = _db()
+    db.execute(
+        'UPDATE volumes SET status=?, attached_to=?, last_attached=? '
+        'WHERE name=?',
+        (VolumeStatus.IN_USE.value, json.dumps(sorted(attached)),
+         time.time(), name))
+    db.commit()
+
+
+def mount_commands(name: str, mount_path: str) -> List[str]:
+    """Shell commands that make the volume visible at mount_path on a
+    host (run on every host during setup)."""
+    record = get(name)
+    from skypilot_tpu.provision.api import get_provider
+    provider = get_provider(record['cloud'])
+    if hasattr(provider, 'volume_mount_commands'):
+        return provider.volume_mount_commands(record, mount_path)
+    raise exceptions.NotSupportedError(
+        f'Provider {record["cloud"]!r} cannot mount volumes via commands.')

@@ -1,0 +1,129 @@
+"""Workspaces: multi-tenant resource isolation.
+
+Parity: ``sky/workspaces/`` — named workspaces with per-workspace cloud
+allowlists; every cluster/job belongs to the workspace that was active at
+launch, `status` is scoped to the active workspace, and launches into a
+workspace may only use its allowed clouds.
+
+Workspaces are defined in the layered config (``workspaces:`` section,
+server < user < project precedence like everything else in config.py):
+
+    workspaces:
+      dev: {}                      # no restrictions
+      prod:
+        allowed_clouds: [gcp]
+        description: production TPU capacity
+
+The ACTIVE workspace is resolved from ``$SKYT_WORKSPACE`` (how the API
+server's per-request worker inherits the caller's workspace) falling back
+to the ``active_workspace:`` config key, then ``default``. The ``default``
+workspace always exists and cannot be deleted.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config, exceptions
+
+DEFAULT_WORKSPACE = 'default'
+
+
+class WorkspaceError(exceptions.SkytError):
+    pass
+
+
+def active_workspace() -> str:
+    env = os.environ.get('SKYT_WORKSPACE')
+    if env:
+        return env
+    return config.get_nested(('active_workspace',), DEFAULT_WORKSPACE)
+
+
+def list_workspaces() -> Dict[str, Dict[str, Any]]:
+    """name -> spec; the default workspace is always present."""
+    defined = dict(config.get_nested(('workspaces',), {}) or {})
+    defined.setdefault(DEFAULT_WORKSPACE, {})
+    return defined
+
+
+def get_workspace(name: str) -> Dict[str, Any]:
+    workspaces = list_workspaces()
+    if name not in workspaces:
+        raise WorkspaceError(
+            f'Workspace {name!r} is not defined. Known: '
+            f'{sorted(workspaces)}')
+    return workspaces[name] or {}
+
+
+def create_workspace(name: str,
+                     allowed_clouds: Optional[List[str]] = None,
+                     description: str = '') -> Dict[str, Any]:
+    if not name or '/' in name or name != name.strip():
+        raise WorkspaceError(f'Invalid workspace name {name!r}')
+    workspaces = dict(config.get_nested(('workspaces',), {}) or {})
+    if name in workspaces or name == DEFAULT_WORKSPACE:
+        raise WorkspaceError(f'Workspace {name!r} already exists.')
+    spec: Dict[str, Any] = {}
+    if allowed_clouds:
+        spec['allowed_clouds'] = list(allowed_clouds)
+    if description:
+        spec['description'] = description
+    workspaces[name] = spec
+    config.set_nested(('workspaces',), workspaces)
+    return spec
+
+
+def delete_workspace(name: str) -> None:
+    if name == DEFAULT_WORKSPACE:
+        raise WorkspaceError('The default workspace cannot be deleted.')
+    from skypilot_tpu import state
+    in_use = state.get_clusters(workspace=name)
+    if in_use:
+        raise WorkspaceError(
+            f'Workspace {name!r} still has {len(in_use)} cluster(s): '
+            f'{[c.name for c in in_use]}. Tear them down first.')
+    workspaces = dict(config.get_nested(('workspaces',), {}) or {})
+    if name not in workspaces:
+        raise WorkspaceError(f'Workspace {name!r} is not defined.')
+    del workspaces[name]
+    config.set_nested(('workspaces',), workspaces)
+    if config.get_nested(('active_workspace',), None) == name:
+        config.set_nested(('active_workspace',), DEFAULT_WORKSPACE)
+
+
+def set_active(name: str) -> None:
+    get_workspace(name)  # validates existence
+    config.set_nested(('active_workspace',), name)
+
+
+# -- enforcement -------------------------------------------------------
+
+
+def allowed_clouds(workspace: Optional[str] = None) -> Optional[List[str]]:
+    """The workspace's cloud allowlist, or None = unrestricted."""
+    spec = get_workspace(workspace or active_workspace())
+    clouds = spec.get('allowed_clouds')
+    return list(clouds) if clouds else None
+
+
+def validate_cloud(cloud: Optional[str],
+                   workspace: Optional[str] = None) -> None:
+    """Reject an explicit cloud choice the workspace does not allow."""
+    workspace = workspace or active_workspace()
+    allowed = allowed_clouds(workspace)
+    if cloud is not None and allowed is not None and cloud not in allowed:
+        raise WorkspaceError(
+            f'Workspace {workspace!r} only allows clouds {allowed}; '
+            f'requested {cloud!r}.')
+
+
+def check_cluster_access(record: Any, op: str = 'access') -> None:
+    """Guard cross-workspace operations on a cluster record."""
+    cluster_workspace = getattr(record, 'workspace', DEFAULT_WORKSPACE)
+    if cluster_workspace != active_workspace():
+        raise WorkspaceError(
+            f'Cannot {op} cluster {record.name!r}: it belongs to '
+            f'workspace {cluster_workspace!r} (active: '
+            f'{active_workspace()!r}). Switch with '
+            f'`skyt workspace switch {cluster_workspace}`.')

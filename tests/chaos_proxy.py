@@ -1,0 +1,173 @@
+"""A fault-injecting TCP proxy between the SDK and the API server.
+
+Parity: the reference's ``tests/chaos/chaos_proxy.py`` — a proxy inserted
+between client and server that drops/delays connections to prove the
+client's retry logic. Faults here are DETERMINISTIC (per-connection-index
+plans) so tests do not flake:
+
+- ``refuse``: accept then immediately close (client sees a reset before
+  any response).
+- ``cut_after(n)``: forward, then hard-close after relaying n bytes of
+  the server's response (client sees a response cut mid-body).
+- ``delay(s)``: sleep before relaying the first byte.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Dict, Optional
+
+
+class Fault:
+    def __init__(self, kind: str, arg: float = 0) -> None:
+        self.kind = kind
+        self.arg = arg
+
+
+def refuse() -> Fault:
+    return Fault('refuse')
+
+
+def cut_after(n_bytes: int) -> Fault:
+    return Fault('cut', n_bytes)
+
+
+def delay(seconds: float) -> Fault:
+    return Fault('delay', seconds)
+
+
+class ChaosProxy:
+    """Forwards 127.0.0.1:<port> -> target, injecting planned faults.
+
+    ``plan`` maps connection index (0-based, in accept order) to a Fault;
+    unplanned connections pass through untouched.
+    """
+
+    def __init__(self, target_host: str, target_port: int,
+                 plan: Optional[Dict[int, Fault]] = None,
+                 default: Optional[Callable[[int], Optional[Fault]]] = None
+                 ) -> None:
+        self.target = (target_host, target_port)
+        self.plan = dict(plan or {})
+        self.default = default
+        self.connections = 0
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(('127.0.0.1', 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._stopping = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name='chaos-proxy', daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f'http://127.0.0.1:{self.port}'
+
+    def start(self) -> 'ChaosProxy':
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+
+    def _fault_for(self, index: int) -> Optional[Fault]:
+        if index in self.plan:
+            return self.plan[index]
+        if self.default is not None:
+            return self.default(index)
+        return None
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                index = self.connections
+                self.connections += 1
+            threading.Thread(target=self._handle,
+                             args=(client, self._fault_for(index)),
+                             daemon=True).start()
+
+    def _handle(self, client: socket.socket, fault: Optional[Fault]) -> None:
+        import time as time_lib
+        try:
+            if fault is not None and fault.kind == 'refuse':
+                # RST instead of FIN so the client reliably sees an error.
+                client.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                  b'\x01\x00\x00\x00\x00\x00\x00\x00')
+                client.close()
+                return
+            if fault is not None and fault.kind == 'delay':
+                time_lib.sleep(fault.arg)
+            upstream = socket.create_connection(self.target, timeout=10)
+            # The connect timeout must not linger as a read timeout: the
+            # server legitimately holds long-polls (/api/get) silent for
+            # 15s+, and a timed-out pump would kill them.
+            upstream.settimeout(None)
+        except OSError:
+            client.close()
+            return
+
+        cut_budget = [fault.arg] if (fault is not None and
+                                     fault.kind == 'cut') else [None]
+
+        def hard_close() -> None:
+            # shutdown() (not just close()): the peer must see the cut
+            # immediately, and the sibling pump thread blocked in recv()
+            # on the same fd must wake — close() alone does neither while
+            # a syscall still holds the fd.
+            for sock in (client, upstream):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        def pump(src: socket.socket, dst: socket.socket,
+                 meter: bool) -> None:
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    if meter and cut_budget[0] is not None:
+                        if len(data) >= cut_budget[0]:
+                            dst.sendall(data[:int(cut_budget[0])])
+                            hard_close()
+                            return
+                        cut_budget[0] -= len(data)
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        up = threading.Thread(target=pump, args=(client, upstream, False),
+                              daemon=True)
+        down = threading.Thread(target=pump, args=(upstream, client, True),
+                                daemon=True)
+        up.start()
+        down.start()
+        up.join()
+        down.join()
+        for sock in (client, upstream):
+            try:
+                sock.close()
+            except OSError:
+                pass

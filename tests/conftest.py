@@ -21,6 +21,11 @@ jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 
+# Small executor runner pools: enough for the concurrency tests, cheap
+# enough to respawn per test (each API-server test gets a fresh pool).
+os.environ.setdefault('SKYT_LONG_WORKERS', '2')
+os.environ.setdefault('SKYT_SHORT_WORKERS', '4')
+
 
 @pytest.fixture()
 def tmp_home(tmp_path, monkeypatch):

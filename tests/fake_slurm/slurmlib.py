@@ -1,0 +1,60 @@
+"""Shared state for the fake Slurm binaries (sbatch/squeue/scancel).
+
+A JSON file at $SKYT_SLURM_FAKE_STATE holds the job table:
+  {jobs: {job_id: {name, nodes, state, nodelist}}, next_id, total_nodes}
+Jobs become RUNNING immediately when nodes are free, PENDING otherwise
+(set total_nodes small to test queueing). Node names map to fake-ssh
+roots via $SKYT_FAKE_SSH_MAP just like every other SSH-cluster test.
+"""
+import json
+import os
+
+
+def state_path():
+    return os.environ['SKYT_SLURM_FAKE_STATE']
+
+
+def load():
+    if os.path.exists(state_path()):
+        with open(state_path(), encoding='utf-8') as f:
+            return json.load(f)
+    return {'jobs': {}, 'next_id': 1,
+            'total_nodes': int(os.environ.get('SKYT_SLURM_FAKE_NODES',
+                                              '4'))}
+
+
+def save(data):
+    tmp = state_path() + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(data, f)
+    os.replace(tmp, state_path())
+
+
+def nodes_in_use(data):
+    return sum(j['nodes'] for j in data['jobs'].values()
+               if j['state'] == 'RUNNING')
+
+
+def schedule(data):
+    """Promote PENDING jobs (FIFO) while nodes are free."""
+    free = data['total_nodes'] - nodes_in_use(data)
+    used_names = set()
+    for j in data['jobs'].values():
+        if j['state'] == 'RUNNING':
+            used_names.update(j['nodelist'].split(','))
+    for job_id in sorted(data['jobs'], key=int):
+        j = data['jobs'][job_id]
+        if j['state'] != 'PENDING':
+            continue
+        if j['nodes'] <= free:
+            names = []
+            i = 0
+            while len(names) < j['nodes']:
+                cand = f'fnode{i:02d}'
+                if cand not in used_names:
+                    names.append(cand)
+                    used_names.add(cand)
+                i += 1
+            j['state'] = 'RUNNING'
+            j['nodelist'] = ','.join(names)
+            free -= j['nodes']

@@ -1,0 +1,159 @@
+"""Chaos + load tests for the client-server layer.
+
+Parity: ``tests/chaos/chaos_proxy.py`` (fault-injecting proxy between SDK
+and server proves client retry/idempotency) and
+``tests/load_tests/test_load_on_server.py`` (concurrent request storm).
+"""
+import concurrent.futures
+import io
+import time
+
+import pytest
+
+from chaos_proxy import ChaosProxy, cut_after, refuse
+from skypilot_tpu import exceptions
+from skypilot_tpu.client import sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture()
+def server(tmp_home, monkeypatch):
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+
+
+def _tpu_task(run='echo hi'):
+    return Task(name='t', run=run,
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-8'))
+
+
+def _point_sdk_at(monkeypatch, url):
+    monkeypatch.setenv('SKYT_API_SERVER_URL', url)
+
+
+# -- chaos: connection faults between SDK and server -------------------
+
+
+def test_launch_survives_refused_connections(server, monkeypatch):
+    """Every other connection is refused; launch+get still succeed and the
+    work is scheduled exactly once (idempotency key dedupe)."""
+    host, port = server.httpd.server_address
+    proxy = ChaosProxy(host, port,
+                       default=lambda i: refuse() if i % 2 == 0 else None)
+    proxy.start()
+    _point_sdk_at(monkeypatch, proxy.url)
+    try:
+        request_id = sdk.launch(_tpu_task(), 'chaos-launch')
+        result = sdk.get(request_id, timeout=120)
+        assert result == [['chaos-launch', 1]]
+    finally:
+        proxy.stop()
+    # The refused first attempt must not have double-submitted.
+    launches = [r for r in requests_db.list_requests()
+                if r.name == 'launch']
+    assert len(launches) == 1
+    assert proxy.connections >= 2  # the fault actually fired
+
+
+def test_poll_survives_midstream_cut(server, monkeypatch):
+    """The /api/get response is cut mid-body; the client retries the poll
+    and still resolves the request."""
+    host, port = server.httpd.server_address
+    # Connection 0 = POST /launch passes; cut the next response early.
+    proxy = ChaosProxy(host, port, plan={1: cut_after(20)})
+    proxy.start()
+    _point_sdk_at(monkeypatch, proxy.url)
+    try:
+        request_id = sdk.launch(_tpu_task(), 'chaos-poll')
+        assert sdk.get(request_id, timeout=120) == [['chaos-poll', 1]]
+    finally:
+        proxy.stop()
+
+
+def test_stream_resumes_without_replay_or_loss(server, monkeypatch):
+    """A log stream cut mid-flight resumes from the received offset: the
+    final transcript has every line exactly once."""
+    host, port = server.httpd.server_address
+    run = ' && '.join(f'echo marker-{i:03d}' for i in range(40))
+    _point_sdk_at(monkeypatch, server.url)
+    request_id = sdk.launch(_tpu_task(run), 'chaos-stream')
+    assert sdk.get(request_id, timeout=120) == [['chaos-stream', 1]]
+
+    tail_id = sdk.tail_logs('chaos-stream', 1)
+    # Through the proxy: conn 0 is the health probe, conn 1 the stream —
+    # cut the stream a few hundred bytes in; the retry passes clean.
+    proxy = ChaosProxy(host, port, plan={1: cut_after(300)})
+    proxy.start()
+    _point_sdk_at(monkeypatch, proxy.url)
+    buf = io.StringIO()
+    try:
+        sdk.stream_and_get(tail_id, output=buf)
+    finally:
+        proxy.stop()
+    text = buf.getvalue()
+    for i in range(40):
+        assert text.count(f'marker-{i:03d}') == 1, (i, text[:2000])
+
+
+def test_unreachable_server_raises_cleanly(tmp_home, monkeypatch):
+    """With the server gone entirely, retries exhaust into a typed error
+    (not a hang), and quickly."""
+    monkeypatch.setenv('SKYT_API_SERVER_URL', 'http://127.0.0.1:1')
+    monkeypatch.setenv('SKYT_CLIENT_RETRIES', '2')
+    start = time.time()
+    with pytest.raises(exceptions.ApiServerError):
+        sdk.status()
+    assert time.time() - start < 10
+
+
+# -- load: concurrent request storm ------------------------------------
+
+
+def test_concurrent_request_storm(server, monkeypatch):
+    """50 concurrent SDK calls (mixed short/long) all complete; the server
+    stays healthy (parity: tests/load_tests/test_load_on_server.py's
+    50-concurrent-requests scenario)."""
+    _point_sdk_at(monkeypatch, server.url)
+    launch_id = sdk.launch(_tpu_task(), 'storm')
+    assert sdk.get(launch_id, timeout=120) == [['storm', 1]]
+
+    def one_status(_):
+        return sdk.get(sdk.status(), timeout=60)
+
+    def one_queue(_):
+        return sdk.get(sdk.queue('storm'), timeout=60)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=50) as pool:
+        futures = [pool.submit(one_status, i) for i in range(25)]
+        futures += [pool.submit(one_queue, i) for i in range(25)]
+        results = [f.result(timeout=180) for f in futures]
+    assert len(results) == 50
+    for record in results[:25]:
+        assert record[0]['name'] == 'storm'
+    assert sdk.api_is_healthy()
+    # Every request resolved terminally; none stuck RUNNING/PENDING.
+    stuck = [r for r in requests_db.list_requests(limit=200)
+             if not r.status.is_terminal()]
+    assert not stuck
+
+
+def test_executor_pool_respects_caps(server, monkeypatch):
+    """Backlogged SHORT requests never spawn more runners than the cap."""
+    _point_sdk_at(monkeypatch, server.url)
+    ids = [sdk.status() for _ in range(30)]
+    for request_id in ids:
+        sdk.get(request_id, timeout=120)
+    pool = server.executor._runners  # noqa: SLF001
+    for schedule_type, runners in pool.items():
+        assert len(runners) <= server.executor._caps[schedule_type]  # noqa: SLF001

@@ -1,0 +1,67 @@
+"""Dashboard tests: the API server's built-in web UI.
+
+Parity target: ``sky/dashboard`` (Next.js) — rebuilt as a self-contained
+page + JSON collector (server/dashboard.py).
+"""
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.client import sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture()
+def server(tmp_home, monkeypatch):
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+
+
+def test_dashboard_page_serves(server):
+    resp = requests_lib.get(f'{server.url}/dashboard', timeout=10)
+    assert resp.status_code == 200
+    assert 'text/html' in resp.headers['Content-Type']
+    assert 'skypilot-tpu' in resp.text
+    assert '/api/dashboard/data' in resp.text
+
+
+def test_dashboard_data_reflects_state(server):
+    task = Task(name='t', run='echo hi',
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-8'))
+    sdk.get(sdk.launch(task, 'dash-c'), timeout=120)
+    data = requests_lib.get(f'{server.url}/api/dashboard/data',
+                            timeout=10).json()
+    for key in ('clusters', 'jobs', 'services', 'pools', 'volumes',
+                'workspaces', 'requests'):
+        assert key in data
+    names = [c['name'] for c in data['clusters']]
+    assert 'dash-c' in names
+    cluster = data['clusters'][names.index('dash-c')]
+    assert cluster['status'] == 'UP'
+    assert cluster['workspace'] == 'default'
+    assert any(r['name'] == 'launch' for r in data['requests'])
+    sdk.get(sdk.down('dash-c'), timeout=60)
+
+
+def test_dashboard_data_respects_auth(server, monkeypatch):
+    monkeypatch.setenv('SKYT_API_SERVER_TOKEN', 'secret-token')
+    # The page itself is public (it carries no data)...
+    assert requests_lib.get(f'{server.url}/dashboard',
+                            timeout=10).status_code == 200
+    # ...the data endpoint is not.
+    resp = requests_lib.get(f'{server.url}/api/dashboard/data', timeout=10)
+    assert resp.status_code == 401
+    resp = requests_lib.get(
+        f'{server.url}/api/dashboard/data', timeout=10,
+        headers={'Authorization': 'Bearer secret-token'})
+    assert resp.status_code == 200

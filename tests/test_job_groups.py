@@ -1,0 +1,109 @@
+"""Job-group tests: gang provisioning barrier, cross-task host env,
+gang cancellation.
+
+Parity: ``sky/jobs/job_group_networking.py:118-217`` (gang-scheduled
+multi-task groups + cross-task networking).
+"""
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import job_groups
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fast_controller(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_JOBS_LAUNCH_RETRY_GAP', '0.2')
+    monkeypatch.setenv('SKYT_JOBGROUP_BARRIER_TIMEOUT', '90')
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def _member(name, run):
+    return Task(name=name, run=run,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8'))
+
+
+def _wait(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get(job_id)
+        if record and record.status.value in statuses:
+            return record
+        time.sleep(0.2)
+    record = jobs_state.get(job_id)
+    raise AssertionError(
+        f'job {job_id} stuck in '
+        f'{record.status.value if record else None}; wanted {statuses}. '
+        f'Controller log:\n'
+        + jobs_core.tail_logs(job_id, controller=True)[-3000:])
+
+
+def test_group_members_see_each_other():
+    """Both members run with SKYT_JOBGROUP + sibling host env vars."""
+    tasks = [
+        _member('alpha', 'echo "alpha sees beta at '
+                         '$SKYT_JOBGROUP_HOSTS_BETA in $SKYT_JOBGROUP"'),
+        _member('beta', 'echo "beta sees alpha at '
+                        '$SKYT_JOBGROUP_HOSTS_ALPHA"'),
+    ]
+    job_ids = jobs_core.launch_group(tasks, 'duo')
+    assert len(job_ids) == 2
+    for job_id in job_ids:
+        record = _wait(job_id, {'SUCCEEDED'})
+        assert record.group_name == 'duo'
+        assert record.group_hosts  # published at the barrier
+    alpha_log = jobs_core.tail_logs(job_ids[0], controller=True)
+    assert 'gang' not in (jobs_state.get(job_ids[0]).failure_reason or '')
+    del alpha_log
+
+
+def test_group_validation():
+    with pytest.raises(exceptions.InvalidSpecError):
+        jobs_core.launch_group([_member('solo', 'true')], 'g')
+    with pytest.raises(exceptions.InvalidSpecError):
+        jobs_core.launch_group(
+            [_member('dup', 'true'), _member('dup', 'true')], 'g')
+
+
+def test_sibling_failure_gang_cancels():
+    """One member fails -> the long-running sibling is cancelled."""
+    tasks = [
+        _member('worker', 'sleep 120'),
+        _member('crasher', 'sleep 1 && exit 7'),
+    ]
+    job_ids = jobs_core.launch_group(tasks, 'doomed')
+    crasher = _wait(job_ids[1], {'FAILED'})
+    assert crasher.status == jobs_state.ManagedJobStatus.FAILED
+    worker = _wait(job_ids[0], {'CANCELLED'}, timeout=120)
+    assert 'gang' in (worker.failure_reason or '')
+
+
+def test_barrier_aborts_when_member_cannot_provision(monkeypatch):
+    """Member B's provisioning fails outright -> member A is released
+    from the barrier with a gang abort, not a hang."""
+    monkeypatch.setenv('SKYT_JOBS_MAX_LAUNCH_RETRIES', '1')
+    bad = Task(name='bad', run='true',
+               resources=Resources(cloud='fake',
+                                   accelerators='tpu-v5e-8',
+                                   region='nonexistent-region'))
+    good = _member('good', 'sleep 60')
+    job_ids = jobs_core.launch_group([good, bad], 'halfbaked')
+    _wait(job_ids[1], {'FAILED_NO_RESOURCE', 'FAILED_SETUP'})
+    released = _wait(job_ids[0], {'CANCELLED'}, timeout=120)
+    assert released.status == jobs_state.ManagedJobStatus.CANCELLED
+
+
+def test_env_key_sanitization():
+    assert job_groups._env_key('my-task.v2', 1) == (  # noqa: SLF001
+        'SKYT_JOBGROUP_HOSTS_MY_TASK_V2')
+    assert job_groups._env_key(None, 7) == 'SKYT_JOBGROUP_HOSTS_JOB7'

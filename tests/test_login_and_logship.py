@@ -1,0 +1,83 @@
+"""`skyt api login` + log-shipping daemon tests.
+
+Parity: ``sky api login`` (client/oauth.py token flow) and
+``sky/logs/__init__.py:12`` get_logging_agent (external log stores).
+"""
+import os
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import config, execution, state
+from skypilot_tpu.client import cli, sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import daemons, requests_db
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture()
+def server(tmp_home, monkeypatch):
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+
+
+def test_api_login_stores_endpoint_and_token(server, monkeypatch):
+    monkeypatch.setenv('SKYT_API_SERVER_TOKEN', 'corp-token')
+    result = CliRunner().invoke(
+        cli.cli, ['api', 'login', '-e', server.url, '-t', 'corp-token'])
+    assert result.exit_code == 0, result.output
+    assert 'Logged in' in result.output
+    assert config.get_nested(('api_server', 'endpoint')) == server.url
+    assert config.get_nested(('api_server', 'token')) == 'corp-token'
+    # With the env var gone, the SDK resolves the configured endpoint.
+    monkeypatch.delenv('SKYT_API_SERVER_URL')
+    assert sdk.api_server_url() == server.url
+
+
+def test_api_login_rejects_bad_token(server, monkeypatch):
+    monkeypatch.setenv('SKYT_API_SERVER_TOKEN', 'corp-token')
+    result = CliRunner().invoke(
+        cli.cli, ['api', 'login', '-e', server.url, '-t', 'wrong'])
+    assert result.exit_code != 0
+    assert 'rejected' in result.output
+
+
+def test_log_shipper_ships_terminal_job_logs_once(tmp_home):
+    fake.reset()
+    sink = os.path.join(str(tmp_home), 'log-sink')
+    config.set_nested(('logs',), {'store': f'file://{sink}'})
+    task = Task(name='t', run='echo ship-me-please',
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-8'))
+    execution.launch(task, 'ship-c')
+
+    daemons._log_ship_tick()  # noqa: SLF001
+    shipped = os.path.join(sink, 'skyt-logs', 'ship-c', 'job-1.log')
+    assert os.path.exists(shipped), os.listdir(sink)
+    with open(shipped, encoding='utf-8') as f:
+        assert 'ship-me-please' in f.read()
+
+    # Second tick is a no-op (manifest de-dupe): truncate the shipped
+    # file and confirm it is not re-uploaded.
+    with open(shipped, 'w', encoding='utf-8') as f:
+        f.write('tombstone')
+    daemons._log_ship_tick()  # noqa: SLF001
+    with open(shipped, encoding='utf-8') as f:
+        assert f.read() == 'tombstone'
+
+    from skypilot_tpu import core
+    core.down('ship-c')
+    fake.reset()
+
+
+def test_log_shipper_noop_without_config(tmp_home):
+    daemons._log_ship_tick()  # noqa: SLF001  (must not raise)

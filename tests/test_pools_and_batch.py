@@ -1,0 +1,145 @@
+"""Worker pools + Sky Batch tests.
+
+Parity: pools = `sky jobs pool` on the serve machinery (SURVEY §2.8);
+batch = sky/batch/ (dataset split → dispatch to pool workers → merge,
+coordinator.py:1-21) with worker-failure retry.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import batch, exceptions
+from skypilot_tpu.batch.coordinator import BatchCoordinator
+from skypilot_tpu.jobs import pools
+from skypilot_tpu.provision import fake
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fast_serve(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_SERVE_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_SERVE_NOT_READY_THRESHOLD', '2')
+    fake.reset()
+    yield
+    for record in serve_state.list_services():
+        try:
+            serve_core.down(record.name, purge=True)
+        except exceptions.SkytError:
+            pass
+    fake.reset()
+
+
+def _pool_task(workers=2):
+    return Task(name='workers',
+                setup='echo worker ready',
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-8'),
+                service={'pool': True, 'workers': workers})
+
+
+# A mapper that doubles the "x" field of every record.
+DOUBLER = ('python3 -c "'
+           'import json,os\n'
+           'recs=[json.loads(l) for l in open(os.environ[\'BATCH_INPUT\'])]\n'
+           'out=open(os.environ[\'BATCH_OUTPUT\'],\'w\')\n'
+           'for r in recs: out.write(json.dumps({\'x\': r[\'x\']*2})+chr(10))\n'
+           '"')
+
+
+def test_pool_spec_parsing():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({'pool': True, 'workers': 3})
+    assert spec.pool and spec.min_replicas == 3 and spec.max_replicas == 3
+    assert spec.port is None
+    round_tripped = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert round_tripped.pool
+
+
+def test_pool_apply_ready_and_down():
+    pools.apply(_pool_task(workers=2), 'tok-pool')
+    workers = pools.wait_ready('tok-pool', min_workers=2, timeout=120)
+    assert len(workers) == 2
+    records = pools.status('tok-pool')
+    assert records[0]['name'] == 'tok-pool'
+    assert records[0]['status'] == 'READY'
+    # Pools are not visible as plain services in the pool listing of a
+    # non-pool service, and vice versa.
+    with pytest.raises(exceptions.ServiceNotFoundError):
+        pools.status('nope')
+    pools.down('tok-pool')
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if serve_state.get_service('tok-pool') is None:
+            break
+        time.sleep(0.5)
+    assert serve_state.get_service('tok-pool') is None
+
+
+def test_pool_resize_in_place_keeps_warm_workers():
+    """Re-apply with more workers scales up WITHOUT tearing down the
+    existing (warm) workers."""
+    pools.apply(_pool_task(workers=1), 'grow-pool')
+    first = set(pools.wait_ready('grow-pool', min_workers=1, timeout=120))
+    result = pools.apply(_pool_task(workers=2), 'grow-pool')
+    assert result.get('resized')
+    grown = set(pools.wait_ready('grow-pool', min_workers=2, timeout=120))
+    assert first <= grown  # the original worker survived the resize
+    pools.down('grow-pool')
+
+
+def test_batch_map_end_to_end(tmp_path):
+    src = tmp_path / 'in.jsonl'
+    src.write_text('\n'.join(json.dumps({'x': i}) for i in range(10)))
+    pools.apply(_pool_task(workers=2), 'map-pool')
+    ds = batch.Dataset.from_jsonl(str(src))
+    assert len(ds) == 10
+    result = ds.map(run=DOUBLER, pool='map-pool', batch_size=3,
+                    wait_timeout=120)
+    assert sorted(r['x'] for r in result) == [i * 2 for i in range(10)]
+    out = tmp_path / 'out.jsonl'
+    result.to_jsonl(str(out))
+    assert len(batch.read_records(str(out))) == 10
+
+
+def test_batch_retries_failed_batches():
+    """A mapper that fails on its first attempt per batch succeeds on
+    retry (marker files make failures deterministic)."""
+    pools.apply(_pool_task(workers=1), 'retry-pool')
+    pools.wait_ready('retry-pool', min_workers=1, timeout=120)
+    flaky = ('python3 -c "'
+             'import json,os,sys\n'
+             'marker=os.path.expanduser(\'~/flaky_\'+os.environ[\'BATCH_INDEX\'])\n'
+             'if not os.path.exists(marker):\n'
+             '    open(marker,\'w\').close(); sys.exit(1)\n'
+             'recs=[json.loads(l) for l in open(os.environ[\'BATCH_INPUT\'])]\n'
+             'out=open(os.environ[\'BATCH_OUTPUT\'],\'w\')\n'
+             'for r in recs: out.write(json.dumps(r)+chr(10))\n'
+             '"')
+    ds = batch.Dataset.from_list([{'x': i} for i in range(4)])
+    result = ds.map(run=flaky, pool='retry-pool', batch_size=2,
+                    max_retries=2, wait_timeout=120)
+    assert len(result) == 4
+
+
+def test_batch_exhausted_retries_raise():
+    pools.apply(_pool_task(workers=1), 'fail-pool')
+    pools.wait_ready('fail-pool', min_workers=1, timeout=120)
+    ds = batch.Dataset.from_list([{'x': 1}])
+    with pytest.raises(exceptions.SkytError):
+        ds.map(run='exit 3', pool='fail-pool', batch_size=1,
+               max_retries=1, wait_timeout=120)
+
+
+def test_io_formats(tmp_path):
+    path = tmp_path / 'r.jsonl'
+    batch.write_records(str(path), [{'a': 1}, {'a': 2}])
+    assert batch.read_records(str(path)) == [{'a': 1}, {'a': 2}]
+    json_path = tmp_path / 'r.json'
+    json_path.write_text(json.dumps([{'b': 1}]))
+    assert batch.read_records(str(json_path)) == [{'b': 1}]
+    with pytest.raises(ValueError):
+        batch.read_records(str(tmp_path / 'r.csv'))

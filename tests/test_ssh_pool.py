@@ -1,0 +1,122 @@
+"""SSH node-pool provider tests: BYO machines as a provision target.
+
+Parity: ``sky/ssh_node_pools/`` + ``sky/provision/ssh/``. The "remote"
+hosts are the tests/fake_bin ssh/rsync shims (as in test_ssh_runtime) so
+the full SSH cluster path — runtime shipping, remote daemon, detached
+queue — runs against inventory-declared hosts.
+"""
+import json
+import os
+import time
+
+import pytest
+import yaml
+
+from skypilot_tpu import check, core, exceptions, execution, state
+from skypilot_tpu.provision import ssh_pool
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+_FAKE_BIN = os.path.join(os.path.dirname(__file__), 'fake_bin')
+
+_POOL_IPS = ['10.9.0.1', '10.9.0.2', '10.9.0.3']
+
+
+@pytest.fixture(autouse=True)
+def ssh_pool_env(tmp_home, monkeypatch):
+    state_dir = os.environ['SKYT_STATE_DIR']
+    os.makedirs(state_dir, exist_ok=True)
+    inventory = os.path.join(state_dir, 'ssh_node_pools.yaml')
+    with open(inventory, 'w', encoding='utf-8') as f:
+        yaml.safe_dump({
+            'lab': {'user': 'skyt', 'hosts': _POOL_IPS},
+        }, f)
+    # Map the inventory IPs onto private host roots for the ssh shim.
+    map_path = os.path.join(state_dir, 'fake_ssh_map.json')
+    roots = {}
+    for i, ip in enumerate(_POOL_IPS):
+        root = os.path.join(state_dir, 'ssh_hosts', f'host{i}')
+        os.makedirs(root, exist_ok=True)
+        roots[ip] = root
+    with open(map_path, 'w', encoding='utf-8') as f:
+        json.dump(roots, f)
+    monkeypatch.setenv('SKYT_FAKE_SSH_MAP', map_path)
+    monkeypatch.setenv('PATH', _FAKE_BIN + os.pathsep + os.environ['PATH'])
+    yield
+
+
+def _task(run='echo hi', num_nodes=1):
+    return Task(name='byo', run=run, num_nodes=num_nodes,
+                resources=Resources(cloud='ssh'))
+
+
+def test_check_reports_pool():
+    enabled, reason = check.check(['ssh'])['ssh']
+    assert enabled and 'lab' not in reason  # counts, not names
+    assert '1 pool(s), 3 host(s)' in reason
+
+
+def test_inventory_parsing_shapes(tmp_home):
+    path = ssh_pool.inventory_path()
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump({
+            'mixed': {'user': 'u', 'identity_file': '~/.ssh/k',
+                      'hosts': ['1.1.1.1',
+                                {'ip': '2.2.2.2', 'port': 2222}]},
+        }, f)
+    pools = ssh_pool.load_inventory()
+    assert pools['mixed']['hosts'][0] == {'ip': '1.1.1.1'}
+    assert pools['mixed']['hosts'][1]['port'] == 2222
+
+
+def test_launch_on_byo_hosts_end_to_end():
+    """Full SSH-cluster path against inventory hosts: rank env, queue,
+    logs, teardown releases the allocation."""
+    results = execution.launch(
+        _task('echo "rank=$SKYT_NODE_RANK of $SKYT_NUM_NODES"',
+              num_nodes=2), 'byo-e2e')
+    assert results == [('byo-e2e', 1)]
+    record = state.get_cluster('byo-e2e')
+    assert record.cloud == 'ssh' and record.region == 'lab'
+    assert record.hourly_cost == 0
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        jobs = core.queue('byo-e2e')
+        if jobs and jobs[0]['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+        time.sleep(0.5)
+    assert jobs[0]['status'] == 'SUCCEEDED'
+    log_text = core.tail_logs('byo-e2e', 1)
+    assert 'rank=0 of 2' in log_text
+
+    provider = ssh_pool.SshNodePoolProvider()
+    assert len(provider.query_instances('byo-e2e')) == 2
+    core.down('byo-e2e')
+    assert provider.query_instances('byo-e2e') == {}
+
+
+def test_allocation_exclusivity_and_capacity():
+    execution.launch(_task(num_nodes=2), 'byo-a')
+    # Only 1 of 3 hosts left; a 2-node cluster must NOT steal allocated
+    # hosts.
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        execution.launch(_task(num_nodes=2), 'byo-b')
+    execution.launch(_task(num_nodes=1), 'byo-c')
+    a_hosts = {h['internal_ip'] for h in
+               state.get_cluster('byo-a').handle['hosts']}
+    c_hosts = {h['internal_ip'] for h in
+               state.get_cluster('byo-c').handle['hosts']}
+    assert not a_hosts & c_hosts
+    core.down('byo-a')
+    core.down('byo-c')
+
+
+def test_stop_is_noop_terminate_frees():
+    execution.launch(_task(num_nodes=1), 'byo-stop')
+    provider = ssh_pool.SshNodePoolProvider()
+    provider.stop_instances('byo-stop')
+    assert provider.query_instances('byo-stop')  # still allocated
+    provider.terminate_instances('byo-stop')
+    assert provider.query_instances('byo-stop') == {}
+    state.remove_cluster('byo-stop')

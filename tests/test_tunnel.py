@@ -1,0 +1,126 @@
+"""SSH-tunnel tests: duplex byte pipe through the API server.
+
+Parity: ``sky/templates/websocket_proxy.py`` (333 LoC) + the server's
+websocket routes — `skyt ssh` reaches cluster head hosts through the
+API server. The "sshd" here is a local echo server; the tunnel carries
+arbitrary bytes both ways.
+"""
+import socket
+import threading
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.client import sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import ApiServer
+
+
+@pytest.fixture()
+def server(tmp_home, monkeypatch):
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    # The hand-registered cluster below is unknown to the fake provider;
+    # the status-refresh daemon would reap it as externally-terminated.
+    from skypilot_tpu import config
+    config.set_nested(('api_server', 'daemons_enabled'), False)
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    yield srv
+    srv.shutdown()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+
+
+@pytest.fixture()
+def echo_head(tmp_home):
+    """A TCP echo server standing in for a cluster head's sshd, plus a
+    cluster record pointing at it."""
+    listener = socket.socket()
+    listener.bind(('127.0.0.1', 0))
+    listener.listen(4)
+    port = listener.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            def echo(c):
+                try:
+                    while True:
+                        data = c.recv(65536)
+                        if not data:
+                            break
+                        c.sendall(b'echo:' + data)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+            threading.Thread(target=echo, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    state.add_or_update_cluster(
+        'tun-c', status=state.ClusterStatus.UP, cloud='fake',
+        handle={'cluster_name': 'tun-c', 'provider': 'fake',
+                'region': 'r', 'zone': None,
+                'hosts': [{'instance_id': 'i', 'internal_ip': '127.0.0.1',
+                           'external_ip': None, 'ssh_port': port,
+                           'node_index': 0, 'worker_index': 0,
+                           'tags': {}}],
+                'ssh_user': 'skyt', 'ssh_key_path': None, 'custom': {}})
+    yield port
+    listener.close()
+
+
+def test_tunnel_roundtrip(server, echo_head):
+    sock, leftover = sdk.open_tunnel('tun-c')
+    assert leftover == b''
+    sock.sendall(b'hello tunnel')
+    data = b''
+    while b'hello tunnel' not in data:
+        chunk = sock.recv(4096)
+        assert chunk, f'tunnel closed early: {data!r}'
+        data += chunk
+    assert data.startswith(b'echo:')
+    sock.close()
+
+
+def test_tunnel_unknown_cluster_404(server):
+    with pytest.raises(Exception) as err:
+        sdk.open_tunnel('nope')
+    assert '404' in str(err.value)
+
+
+def test_tunnel_respects_auth(server, echo_head, monkeypatch):
+    monkeypatch.setenv('SKYT_API_SERVER_TOKEN', 'tunnel-secret')
+    with pytest.raises(Exception) as err:
+        sdk.open_tunnel('tun-c')
+    assert '401' in str(err.value)
+    monkeypatch.setenv('SKYT_API_TOKEN', 'tunnel-secret')
+    sock, _ = sdk.open_tunnel('tun-c')
+    sock.sendall(b'hi')
+    assert sock.recv(4096).startswith(b'echo:')
+    sock.close()
+
+
+def test_tunnel_respects_workspaces(server, echo_head, monkeypatch):
+    """Cross-workspace SSH is denied (the cluster belongs to 'default')."""
+    monkeypatch.setenv('SKYT_WORKSPACE', 'team-a')
+    with pytest.raises(Exception) as err:
+        sdk.open_tunnel('tun-c')
+    assert '403' in str(err.value)
+    monkeypatch.delenv('SKYT_WORKSPACE')
+    sock, _ = sdk.open_tunnel('tun-c')
+    sock.close()
+
+
+def test_ssh_info_payload(server, echo_head):
+    info = sdk.get(sdk.ssh_info('tun-c'), timeout=60)
+    assert info['address'] == '127.0.0.1'
+    assert info['port'] == echo_head
+    assert info['user'] == 'skyt'

@@ -1,0 +1,111 @@
+"""Workspace tests: CRUD, cloud allowlists, cluster scoping.
+
+Parity: ``sky/workspaces/`` (multi-tenant isolation + per-workspace cloud
+allowlists).
+"""
+import pytest
+
+from skypilot_tpu import execution, state, workspaces
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def _reset(tmp_home):
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def _tpu_task():
+    return Task(name='t', run='echo hi',
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-8'))
+
+
+def test_default_workspace_always_exists():
+    assert workspaces.active_workspace() == 'default'
+    assert 'default' in workspaces.list_workspaces()
+
+
+def test_crud_roundtrip():
+    workspaces.create_workspace('prod', allowed_clouds=['gcp'],
+                                description='prod capacity')
+    assert workspaces.list_workspaces()['prod'] == {
+        'allowed_clouds': ['gcp'], 'description': 'prod capacity'}
+    with pytest.raises(workspaces.WorkspaceError):
+        workspaces.create_workspace('prod')
+    workspaces.set_active('prod')
+    assert workspaces.active_workspace() == 'prod'
+    # Deleting the active workspace resets active to default.
+    workspaces.delete_workspace('prod')
+    assert workspaces.active_workspace() == 'default'
+    with pytest.raises(workspaces.WorkspaceError):
+        workspaces.delete_workspace('default')
+    with pytest.raises(workspaces.WorkspaceError):
+        workspaces.set_active('never-created')
+
+
+def test_env_overrides_active_workspace(monkeypatch):
+    workspaces.create_workspace('team-a')
+    monkeypatch.setenv('SKYT_WORKSPACE', 'team-a')
+    assert workspaces.active_workspace() == 'team-a'
+
+
+def test_cluster_stamped_and_status_scoped(monkeypatch):
+    workspaces.create_workspace('team-a')
+    execution.launch(_tpu_task(), 'ws-default')
+    monkeypatch.setenv('SKYT_WORKSPACE', 'team-a')
+    execution.launch(_tpu_task(), 'ws-team-a')
+
+    from skypilot_tpu import core
+    names = [r['name'] for r in core.status()]
+    assert names == ['ws-team-a']
+    monkeypatch.delenv('SKYT_WORKSPACE')
+    names = [r['name'] for r in core.status()]
+    assert names == ['ws-default']
+    all_names = {r['name'] for r in core.status(all_workspaces=True)}
+    assert all_names == {'ws-default', 'ws-team-a'}
+    assert state.get_cluster('ws-team-a').workspace == 'team-a'
+
+
+def test_cross_workspace_ops_denied(monkeypatch):
+    workspaces.create_workspace('team-a')
+    execution.launch(_tpu_task(), 'ws-guarded')
+    monkeypatch.setenv('SKYT_WORKSPACE', 'team-a')
+    from skypilot_tpu import core
+    with pytest.raises(workspaces.WorkspaceError):
+        core.down('ws-guarded')
+    with pytest.raises(workspaces.WorkspaceError):
+        core.queue('ws-guarded')
+    monkeypatch.delenv('SKYT_WORKSPACE')
+    core.down('ws-guarded')  # owner workspace may tear down
+
+
+def test_allowlist_blocks_explicit_cloud(monkeypatch):
+    workspaces.create_workspace('gcp-only', allowed_clouds=['gcp'])
+    monkeypatch.setenv('SKYT_WORKSPACE', 'gcp-only')
+    with pytest.raises(workspaces.WorkspaceError):
+        execution.launch(_tpu_task(), 'ws-blocked')
+    assert state.get_cluster('ws-blocked') is None
+
+
+def test_allowlist_filters_optimizer_choice(monkeypatch):
+    """With no explicit cloud, the optimizer only considers allowed
+    clouds — here none feasible, so launch fails with no-resources."""
+    from skypilot_tpu import exceptions
+    workspaces.create_workspace('gcp-only', allowed_clouds=['gcp'])
+    monkeypatch.setenv('SKYT_WORKSPACE', 'gcp-only')
+    task = Task(name='t', run='echo hi',
+                resources=Resources(accelerators='tpu-v5e-8'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        execution.launch(task, 'ws-nofeasible')
+
+
+def test_delete_blocked_while_clusters_exist(monkeypatch):
+    workspaces.create_workspace('busy')
+    monkeypatch.setenv('SKYT_WORKSPACE', 'busy')
+    execution.launch(_tpu_task(), 'ws-busy')
+    monkeypatch.delenv('SKYT_WORKSPACE')
+    with pytest.raises(workspaces.WorkspaceError):
+        workspaces.delete_workspace('busy')
