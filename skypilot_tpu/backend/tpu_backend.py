@@ -44,6 +44,32 @@ class TpuPodBackend(Backend):
                   dryrun: bool = False,
                   blocklist=None) -> Optional[ClusterInfo]:
         candidates = Optimizer.plan_task(task)
+        if task.volumes:
+            # Volume gate: a named volume lives on ONE cloud (a PVC is
+            # meaningless on GCE and vice versa), so candidates must be
+            # pinned to the volumes' cloud — otherwise a cheaper cloud
+            # could win the ranking and the mounts would silently become
+            # plain local directories.
+            from skypilot_tpu import volumes as volumes_lib
+            volume_clouds = {
+                volumes_lib.get(name)['cloud']
+                for name in task.volumes.values()}
+            if len(volume_clouds) > 1:
+                raise exceptions.NotSupportedError(
+                    f'Task mounts volumes from multiple clouds '
+                    f'{sorted(volume_clouds)}; volumes of one task must '
+                    f'share a cloud.')
+            volume_cloud = volume_clouds.pop()
+            supported = [c for c in candidates
+                         if c.resources.cloud == volume_cloud]
+            if not supported:
+                raise exceptions.NotSupportedError(
+                    f'Task mounts volumes on {volume_cloud!r} but that '
+                    f'cloud is not among the feasible candidates '
+                    f'({sorted({c.resources.cloud for c in candidates})}'
+                    f'); pin `cloud: {volume_cloud}` or drop the '
+                    f'volumes.')
+            candidates = supported
         # FUSE-mount storage on k8s needs the fuse-proxy shim wired into
         # the pod manifest (provision/kubernetes.py _needs_fuse); flag it
         # via a label so the request carries the hint to any provider.

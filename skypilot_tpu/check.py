@@ -99,6 +99,24 @@ def check(clouds: List[str] = None, quiet: bool = True) -> Dict[str, Tuple[bool,
     return results
 
 
+def capabilities() -> Dict[str, Dict[str, str]]:
+    """Per-cloud unsupported-feature map (parity: clouds/cloud.py:714
+    feature-flag surface), for `skyt check -v` and the planner."""
+    import skypilot_tpu.provision  # noqa: F401  (registry side effects)
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    out: Dict[str, Dict[str, str]] = {}
+    for cloud in sorted(_CHECKS):
+        try:
+            provider_cls = CLOUD_REGISTRY.get(cloud)
+        except KeyError:
+            continue
+        out[cloud] = {
+            cap.value: reason
+            for cap, reason in provider_cls.unsupported_features().items()
+        }
+    return out
+
+
 def get_enabled_clouds(refresh: bool = False) -> List[str]:
     if refresh:
         _cache.clear()

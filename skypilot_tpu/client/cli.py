@@ -184,12 +184,20 @@ def cancel(cluster: str, job_id: int) -> None:
 
 
 @cli.command()
-def check() -> None:
+@click.option('--verbose', '-v', is_flag=True, default=False,
+              help='Also show per-cloud capability limits.')
+def check(verbose: bool) -> None:
     """Probe cloud credentials and show enabled clouds."""
     result = _run(sdk.check(), False, stream=False) or {}
+    caps = {}
+    if verbose:
+        from skypilot_tpu import check as check_lib
+        caps = check_lib.capabilities()
     for cloud, (ok, reason) in result.items():
         mark = 'enabled' if ok else f'disabled ({reason})'
         click.echo(f'  {cloud}: {mark}')
+        for cap, why in sorted(caps.get(cloud, {}).items()):
+            click.echo(f'      no {cap}: {why}')
 
 
 @cli.command('show-tpus')

@@ -69,7 +69,15 @@ def _get_record(cluster_name: str) -> state.ClusterRecord:
 
 
 def stop(cluster_name: str) -> None:
-    _get_record(cluster_name)
+    record = _get_record(cluster_name)
+    if record.cloud is not None:
+        from skypilot_tpu.provision.api import CloudCapability
+        from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+        reason = CLOUD_REGISTRY.get(record.cloud).unsupported_features(
+        ).get(CloudCapability.STOP)
+        if reason is not None:
+            raise exceptions.NotSupportedError(
+                f'`skyt stop` on {record.cloud}: {reason}')
     TpuPodBackend().teardown(cluster_name, terminate=False)
 
 

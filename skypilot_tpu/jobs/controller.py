@@ -41,6 +41,10 @@ class JobController:
         assert record is not None, f'managed job {job_id} not in DB'
         self.job_id = job_id
         self.record = record
+        # Run in the JOB's workspace regardless of which process spawned
+        # this controller (scheduler in a request child, the server's
+        # jobs-refresh daemon, an HA replacement).
+        os.environ['SKYT_WORKSPACE'] = record.workspace
         self.task = Task.from_yaml_config(record.task_config)
         self.cluster_name = (record.cluster_name or
                              f'{record.name or "job"}-{job_id}')
@@ -104,6 +108,12 @@ class JobController:
             return None
         jobs_state.set_status(self.job_id, ManagedJobStatus.RECOVERING)
         jobs_state.bump_recovery(self.job_id)
+        if self.record.group_name:
+            # Recovery relaunches run self.task; rebuild the rendezvous
+            # env from the DB (an HA replacement never saw the original
+            # barrier's in-memory env).
+            from skypilot_tpu.jobs import job_groups
+            self.task.update_envs(job_groups.rebuild_env(self.record))
         try:
             cluster_job_id = self.strategy.recover()
         except exceptions.ResourcesUnavailableError as e:
@@ -143,30 +153,81 @@ class JobController:
                 f'{self.cluster_name} vanished between barrier and exec')
         return self.backend.execute(info, self.task, detach=True)
 
-    def run(self) -> None:
+    def _reattach(self) -> Optional[int]:
+        """Replacement-controller path (HA recovery): adopt the live
+        cluster job if there is one; finalize directly if it already
+        finished; otherwise fall back to a normal recovery. Returns the
+        cluster job id to monitor, or None when the job is finalized."""
+        # A transient queue-read failure must NOT look like an empty
+        # queue: falling into recovery while the original cluster job
+        # still runs would execute the workload twice. Keep probing as
+        # long as the cluster itself stays healthy.
+        while True:
+            info = self._cluster_info()
+            if info is None or not self._cluster_healthy():
+                break
+            try:
+                cluster_jobs = self.backend.queue(info)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning(
+                    'Managed job %s: cluster %s healthy but job table '
+                    'unreachable; retrying.', self.job_id,
+                    self.cluster_name)
+                time.sleep(POLL_SECONDS)
+                continue
+            active = [j for j in cluster_jobs
+                      if j['status'] in ('PENDING', 'SETTING_UP',
+                                         'RUNNING')]
+            if active:
+                logger.info(
+                    'Managed job %s: replacement controller adopted '
+                    'cluster job %s.', self.job_id,
+                    active[-1]['job_id'])
+                jobs_state.set_status(self.job_id,
+                                      ManagedJobStatus.RUNNING)
+                return active[-1]['job_id']
+            if any(j['status'] == 'SUCCEEDED' for j in cluster_jobs):
+                # Finished while no controller was watching.
+                self._finalize(ManagedJobStatus.SUCCEEDED)
+                return None
+            break  # queue readable: no live/succeeded job -> recover
+        # Cluster gone or job died with it: normal recovery machinery.
+        return self._recover()
+
+    def run(self, resume: bool = False) -> None:
         from skypilot_tpu.jobs import job_groups
-        jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
-        try:
-            if self.record.group_name:
-                cluster_job_id = self._gang_launch()
-            else:
-                cluster_job_id = self.strategy.launch()
-        except job_groups.GangAborted as e:
+        if resume:
+            # The first controller may have died mid-LAUNCHING; the
+            # replacement must not pin that launching slot forever.
             scheduler.launch_done(self.job_id)
-            self._finalize(ManagedJobStatus.CANCELLED, str(e))
-            return
-        except exceptions.ResourcesUnavailableError as e:
+            cluster_job_id = self._reattach()
+            if cluster_job_id is None:
+                return
+        else:
+            jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
+            try:
+                if self.record.group_name:
+                    cluster_job_id = self._gang_launch()
+                else:
+                    cluster_job_id = self.strategy.launch()
+            except job_groups.GangAborted as e:
+                scheduler.launch_done(self.job_id)
+                self._finalize(ManagedJobStatus.CANCELLED, str(e))
+                return
+            except exceptions.ResourcesUnavailableError as e:
+                scheduler.launch_done(self.job_id)
+                self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE,
+                               str(e))
+                return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception('Managed job %s: launch failed',
+                                 self.job_id)
+                scheduler.launch_done(self.job_id)
+                self._finalize(ManagedJobStatus.FAILED_SETUP,
+                               f'{type(e).__name__}: {e}')
+                return
             scheduler.launch_done(self.job_id)
-            self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
-            return
-        except Exception as e:  # pylint: disable=broad-except
-            logger.exception('Managed job %s: launch failed', self.job_id)
-            scheduler.launch_done(self.job_id)
-            self._finalize(ManagedJobStatus.FAILED_SETUP,
-                           f'{type(e).__name__}: {e}')
-            return
-        scheduler.launch_done(self.job_id)
-        jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
+            jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
 
         while True:
             time.sleep(POLL_SECONDS)
@@ -251,10 +312,13 @@ class JobController:
 def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser('managed-job controller')
     parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--resume', action='store_true', default=False,
+                        help='Replacement controller: re-attach to the '
+                             'live cluster instead of launching.')
     args = parser.parse_args(argv)
     controller = JobController(args.job_id)
     try:
-        controller.run()
+        controller.run(resume=args.resume)
     except Exception:  # pylint: disable=broad-except
         logger.exception('Controller for job %s crashed', args.job_id)
         jobs_state.set_status(args.job_id,

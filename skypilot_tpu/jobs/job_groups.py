@@ -70,6 +70,18 @@ def sibling_failed(record: jobs_state.JobRecord) -> Optional[str]:
     return None
 
 
+def rebuild_env(record: jobs_state.JobRecord) -> Dict[str, str]:
+    """Rendezvous env from the persisted group state — used by recovery
+    and HA-replacement controllers, whose in-memory env from the
+    original barrier is gone."""
+    assert record.group_name is not None
+    env = {'SKYT_JOBGROUP': record.group_name}
+    for member in jobs_state.list_group(record.group_name):
+        env[_env_key(member.name, member.job_id)] = ','.join(
+            member.group_hosts)
+    return env
+
+
 def barrier_and_env(record: jobs_state.JobRecord,
                     timeout: float = 1800.0,
                     poll: float = 1.0) -> Dict[str, str]:
@@ -85,11 +97,7 @@ def barrier_and_env(record: jobs_state.JobRecord,
                 f'before the gang barrier')
         members = jobs_state.list_group(record.group_name)
         if members and all(m.group_hosts for m in members):
-            env = {'SKYT_JOBGROUP': record.group_name}
-            for member in members:
-                env[_env_key(member.name, member.job_id)] = ','.join(
-                    member.group_hosts)
-            return env
+            return rebuild_env(record)
         time.sleep(poll)
     raise GangAborted(
         f'group {record.group_name}: barrier timed out after '

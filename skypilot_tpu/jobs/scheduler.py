@@ -67,9 +67,39 @@ def job_done(job_id: int) -> None:
     maybe_schedule_next_jobs()
 
 
+def _controller_max_restarts() -> int:
+    from skypilot_tpu import config
+    if 'SKYT_JOBS_CONTROLLER_MAX_RESTARTS' in os.environ:
+        return int(os.environ['SKYT_JOBS_CONTROLLER_MAX_RESTARTS'])
+    return int(config.get_nested(('jobs', 'controller_max_restarts'), 3))
+
+
+def _controller_alive(pid: int) -> bool:
+    """pid_exists that treats zombies as dead (and reaps them when they
+    are our children — controllers spawned from a long-lived server
+    process are not reparented to init)."""
+    try:
+        proc = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return False
+    if proc.status() == psutil.STATUS_ZOMBIE:
+        try:
+            os.waitpid(pid, os.WNOHANG)
+        except (ChildProcessError, OSError):
+            pass
+        return False
+    return True
+
+
 def reap_dead_controllers() -> None:
-    """Mark jobs whose controller process died as FAILED_CONTROLLER
-    (parity: controller HA watchdog; run on queue inspection)."""
+    """HA controller recovery (parity: the reference's HA controllers —
+    autostop_lib.high_availability_specified, k8s-redeployed controllers
+    that re-attach after a crash): a job whose controller process died
+    gets a REPLACEMENT controller that re-attaches to the live cluster
+    (or recovers it), up to ``jobs.controller_max_restarts`` times; only
+    past that budget is the job failed as FAILED_CONTROLLER. Run on
+    queue inspection + by the server's jobs-refresh daemon, so jobs
+    survive an API-server restart too."""
     for record in jobs_state.list_jobs(skip_finished=True):
         if record.schedule_state in (jobs_state.ScheduleState.WAITING,
                                      jobs_state.ScheduleState.DONE):
@@ -77,12 +107,34 @@ def reap_dead_controllers() -> None:
         pid = record.controller_pid
         if pid is None:
             continue
-        if not psutil.pid_exists(pid):
-            logger.warning('Managed job %s: controller %s died.',
-                           record.job_id, pid)
-            jobs_state.set_status(
-                record.job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
-                failure_reason='controller process died')
-            jobs_state.set_schedule_state(record.job_id,
-                                          jobs_state.ScheduleState.DONE)
+        if _controller_alive(pid):
+            continue
+        if jobs_state.claim_controller_restart(
+                record.job_id, pid, _controller_max_restarts()):
+            log_path = jobs_state.controller_log_path(record.job_id)
+            new_pid = subprocess_utils.daemonize_and_run(
+                [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+                 '--job-id', str(record.job_id), '--resume'],
+                log_path=log_path)
+            jobs_state.set_controller_pid(record.job_id, new_pid)
+            logger.warning(
+                'Managed job %s: controller %s died; resumed with '
+                'replacement pid %s (restart %d/%d).', record.job_id,
+                pid, new_pid, record.controller_restarts + 1,
+                _controller_max_restarts())
+            continue
+        # Claim lost: either another process is spawning the replacement
+        # right now, or the restart budget is spent. Only the latter
+        # fails the job (re-read to tell them apart).
+        refreshed = jobs_state.get(record.job_id)
+        if (refreshed is None or refreshed.controller_pid != pid or
+                refreshed.controller_restarts < _controller_max_restarts()):
+            continue
+        logger.warning('Managed job %s: controller %s died; restart '
+                       'budget exhausted.', record.job_id, pid)
+        jobs_state.set_status(
+            record.job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+            failure_reason='controller process died repeatedly')
+        jobs_state.set_schedule_state(record.job_id,
+                                      jobs_state.ScheduleState.DONE)
     maybe_schedule_next_jobs()

@@ -97,6 +97,12 @@ def _db() -> sqlite3.Connection:
     if 'group_name' not in cols:  # pre-existing DB from an older version
         conn.execute('ALTER TABLE jobs ADD COLUMN group_name TEXT')
         conn.execute('ALTER TABLE jobs ADD COLUMN group_hosts TEXT')
+    if 'controller_restarts' not in cols:
+        conn.execute('ALTER TABLE jobs ADD COLUMN controller_restarts '
+                     'INTEGER DEFAULT 0')
+    if 'workspace' not in cols:
+        conn.execute("ALTER TABLE jobs ADD COLUMN workspace TEXT "
+                     "DEFAULT 'default'")
     conn.commit()
     _local.conn = conn
     _local.path = path
@@ -124,6 +130,8 @@ class JobRecord:
         self.group_name: Optional[str] = row['group_name']
         self.group_hosts: List[str] = json.loads(row['group_hosts'] or
                                                  '[]')
+        self.controller_restarts: int = row['controller_restarts'] or 0
+        self.workspace: str = row['workspace'] or 'default'
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -147,14 +155,18 @@ def submit(task_config: Dict[str, Any],
            strategy: str,
            max_restarts_on_errors: int,
            group_name: Optional[str] = None) -> int:
+    # The submitter's workspace is PERSISTED: controllers (and their HA
+    # replacements, spawned later by arbitrary processes) must run in
+    # the job's workspace, not the spawner's.
+    from skypilot_tpu import workspaces
     conn = _db()
     cur = conn.execute(
         'INSERT INTO jobs (name, task_config, status, schedule_state, '
-        'strategy, max_restarts_on_errors, submitted_at, group_name) '
-        'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+        'strategy, max_restarts_on_errors, submitted_at, group_name, '
+        'workspace) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
         (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
          ScheduleState.WAITING.value, strategy, max_restarts_on_errors,
-         time.time(), group_name))
+         time.time(), group_name, workspaces.active_workspace()))
     conn.commit()
     return cur.lastrowid
 
@@ -308,6 +320,25 @@ def set_cluster_name(job_id: int, cluster_name: str) -> None:
     conn.execute('UPDATE jobs SET cluster_name = ? WHERE job_id = ?',
                  (cluster_name, job_id))
     conn.commit()
+
+
+def claim_controller_restart(job_id: int, dead_pid: int,
+                             max_restarts: int) -> bool:
+    """Atomically claim the right to spawn a replacement controller.
+
+    Multiple processes observe dead controllers concurrently (every
+    queue inspection + the server daemon); the conditional UPDATE on the
+    observed pid makes exactly one of them the spawner.
+    """
+    conn = _db()
+    cur = conn.execute(
+        'UPDATE jobs SET controller_restarts = controller_restarts + 1, '
+        'controller_pid = NULL '
+        'WHERE job_id = ? AND controller_pid = ? '
+        'AND controller_restarts < ?',
+        (job_id, dead_pid, max_restarts))
+    conn.commit()
+    return cur.rowcount == 1
 
 
 def bump_recovery(job_id: int) -> None:

@@ -31,6 +31,9 @@ class ModelConfig:
     # MoE (0 experts = dense)
     num_experts: int = 0
     experts_per_token: int = 2
+    # gated-MLP activation: 'silu' (llama/mixtral/qwen) or 'gelu_tanh'
+    # (gemma-family GeGLU)
+    activation: str = 'silu'
     # numerics
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.bfloat16
@@ -106,6 +109,28 @@ MIXTRAL_8X7B = _register(ModelConfig(
     name='mixtral-8x7b', vocab_size=32_000, d_model=4096, n_layers=32,
     n_heads=32, n_kv_heads=8, d_ff=14336, rope_theta=1_000_000.0,
     num_experts=8, experts_per_token=2))
+
+# Gemma family: GeGLU MLP, tied embeddings, wide head_dim (public
+# gemma-7b architecture constants).
+GEMMA_7B = _register(ModelConfig(
+    name='gemma-7b', vocab_size=256_128, d_model=3072, n_layers=28,
+    n_heads=16, n_kv_heads=16, head_dim=256, d_ff=24576,
+    rope_theta=10_000.0, activation='gelu_tanh', tie_embeddings=True))
+
+# Qwen2 family: GQA, large vocab, 1M rope theta (public qwen2-7b
+# architecture constants).
+QWEN2_7B = _register(ModelConfig(
+    name='qwen2-7b', vocab_size=152_064, d_model=3584, n_layers=28,
+    n_heads=28, n_kv_heads=4, d_ff=18944, rope_theta=1_000_000.0,
+    max_seq_len=32768))
+
+# DeepSeek-MoE style: many small experts, higher top-k (fine-grained
+# expert parallelism; exercises large `expert` mesh degrees).
+DEEPSEEK_MOE_16B = _register(ModelConfig(
+    name='deepseek-moe-16b', vocab_size=102_400, d_model=2048,
+    n_layers=28, n_heads=16, n_kv_heads=16, d_ff=1408,
+    rope_theta=10_000.0, num_experts=64, experts_per_token=6,
+    max_seq_len=4096))
 
 # Small configs for tests / CPU-mesh dryruns / single-chip benches.
 TINY = _register(ModelConfig(

@@ -82,9 +82,10 @@ def _mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
         from skypilot_tpu.parallel.sharding import DEFAULT_RULES
         return _moe_block(x, lp['moe'], cfg, DEFAULT_RULES)
     mlp = lp['mlp']
+    from skypilot_tpu.models.llama import _activate
     gate = jnp.einsum('bsd,df->bsf', x, mlp['wi_gate'].astype(dt))
     up = jnp.einsum('bsd,df->bsf', x, mlp['wi_up'].astype(dt))
-    return jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+    return jnp.einsum('bsf,fd->bsd', _activate(gate, cfg) * up,
                       mlp['wo'].astype(dt))
 
 

@@ -172,12 +172,19 @@ def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
     return out
 
 
+def _activate(gate: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Gated-MLP nonlinearity: SwiGLU (llama) or GeGLU (gemma)."""
+    if cfg.activation == 'gelu_tanh':
+        return jax.nn.gelu(gate, approximate=True)
+    return jax.nn.silu(gate)
+
+
 def _mlp_block(x: jax.Array, lp: Params, cfg: ModelConfig,
                rules: LogicalAxisRules) -> jax.Array:
     dt = cfg.compute_dtype
     gate = jnp.einsum('bsd,df->bsf', x, lp['wi_gate'].astype(dt))
     up = jnp.einsum('bsd,df->bsf', x, lp['wi_up'].astype(dt))
-    hidden = jax.nn.silu(gate) * up
+    hidden = _activate(gate, cfg) * up
     hidden = with_logical_constraint(hidden, ('batch', 'act_seq', 'mlp'),
                                      rules=rules)
     return jnp.einsum('bsf,fd->bsd', hidden, lp['wo'].astype(dt))
@@ -204,7 +211,7 @@ def _moe_block(x: jax.Array, lp: Params, cfg: ModelConfig,
     # vs dropped dispatch; replaced by a capacity-based dispatch for large E.
     gate = jnp.einsum('bsd,edf->ebsf', x, lp['wi_gate'].astype(dt))
     up = jnp.einsum('bsd,edf->ebsf', x, lp['wi_up'].astype(dt))
-    hidden = jax.nn.silu(gate) * up
+    hidden = _activate(gate, cfg) * up
     hidden = with_logical_constraint(hidden,
                                      ('expert', 'batch', 'act_seq', 'mlp'),
                                      rules=rules)

@@ -92,6 +92,15 @@ def candidates_for(resources: Resources,
     for cloud in clouds:
         if cloud not in enabled_clouds:
             continue
+        # Capability gate (parity: clouds/cloud.py:714 feature flags):
+        # a spot request never even becomes a candidate on a cloud with
+        # no preemptible tier.
+        if resources.use_spot:
+            from skypilot_tpu.provision.api import CloudCapability
+            from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+            if not CLOUD_REGISTRY.get(cloud).supports(
+                    CloudCapability.SPOT):
+                continue
         if cloud == 'local':
             if resources.is_tpu:
                 continue  # no TPU hardware assumption on localhost

@@ -11,7 +11,22 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import enum
 from typing import Any, Dict, List, Optional
+
+
+class CloudCapability(enum.Enum):
+    """Feature flags a provider may declare unsupported (parity:
+    sky/clouds/cloud.py:714 CloudImplementationFeatures — the per-cloud
+    capability surface the planner consults BEFORE provisioning, so a
+    spot request never reaches a cloud with no spot tier and `skyt
+    stop` fails at submit time on clouds that cannot stop)."""
+    STOP = 'stop'
+    SPOT = 'spot'
+    AUTOSTOP = 'autostop'
+    OPEN_PORTS = 'open_ports'
+    VOLUMES = 'volumes'
+    MULTI_NODE = 'multi_node'
 
 from skypilot_tpu.spec.resources import Resources
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
@@ -99,6 +114,15 @@ class Provider(abc.ABC):
     """Per-cloud driver (parity: sky/provision per-cloud modules)."""
 
     name: str = 'abstract'
+
+    @classmethod
+    def unsupported_features(cls) -> Dict[CloudCapability, str]:
+        """capability -> human reason; absent = supported."""
+        return {}
+
+    @classmethod
+    def supports(cls, capability: CloudCapability) -> bool:
+        return capability not in cls.unsupported_features()
 
     @abc.abstractmethod
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:

@@ -567,6 +567,15 @@ class KubernetesProvider(Provider):
 
     name = 'kubernetes'
 
+    @classmethod
+    def unsupported_features(cls):
+        from skypilot_tpu.provision.api import CloudCapability
+        return {
+            CloudCapability.STOP:
+                'Kubernetes pods cannot be stopped; use down (terminate). '
+                '(Same stance as the reference: no k8s stop support.)',
+        }
+
     def __init__(self, api: Optional[KubernetesApi] = None,
                  namespace: Optional[str] = None) -> None:
         if api is not None:

@@ -46,6 +46,15 @@ class LocalProvider(Provider):
 
     name = 'local'
 
+    @classmethod
+    def unsupported_features(cls):
+        from skypilot_tpu.provision.api import CloudCapability
+        return {
+            CloudCapability.SPOT: 'localhost is never preempted',
+            CloudCapability.VOLUMES: 'no disk API on localhost; use '
+                                     'plain paths',
+        }
+
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
         data = _load()
         hosts = []

@@ -57,6 +57,20 @@ class SlurmProvider(Provider):
 
     name = 'slurm'
 
+    @classmethod
+    def unsupported_features(cls):
+        from skypilot_tpu.provision.api import CloudCapability
+        return {
+            CloudCapability.SPOT:
+                'slurm allocations have no preemptible tier (use '
+                'preemptible partitions via region instead)',
+            CloudCapability.VOLUMES:
+                'no network-disk API under slurm; use the shared '
+                'filesystem',
+            CloudCapability.OPEN_PORTS:
+                'cluster firewalls are admin-managed',
+        }
+
     # -- helpers -------------------------------------------------------
 
     @staticmethod

@@ -106,6 +106,18 @@ class SshNodePoolProvider(Provider):
 
     name = 'ssh'
 
+    @classmethod
+    def unsupported_features(cls):
+        from skypilot_tpu.provision.api import CloudCapability
+        return {
+            CloudCapability.SPOT:
+                'BYO machines have no preemptible pricing tier',
+            CloudCapability.VOLUMES:
+                'no network-disk API on inventory hosts',
+            CloudCapability.OPEN_PORTS:
+                'inventory host firewalls are admin-managed',
+        }
+
     def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
         pools = load_inventory()
         if not pools:

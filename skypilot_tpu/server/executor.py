@@ -50,6 +50,19 @@ _ORPHAN_GRACE_S = 2.0
 _PIDLESS_GRACE_S = 10.0
 
 
+def _set_pdeathsig() -> None:
+    """Ask the kernel to SIGKILL this process when its parent (the
+    runner) dies — kernel-delivered, so it covers kill -9/OOM of the
+    runner. Linux-only, matching the rest of the runtime."""
+    try:
+        import ctypes
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL, 0, 0, 0)
+    except Exception:  # pylint: disable=broad-except
+        pass  # best-effort; the orphan scanner still finalizes the row
+
+
 def _run_request_in_child(request_id: str) -> None:
     """Child-process body: redirect output, run the payload, finalize."""
     request = requests_db.get(request_id)
@@ -132,14 +145,21 @@ def runner_main(schedule_type_value: str) -> None:
         pid = os.fork()
         if pid == 0:
             try:
+                _set_pdeathsig()
                 _run_request_in_child(request.request_id)
             finally:
                 os._exit(0)
         current_child['pid'] = pid
-        # A hard-killed runner (kill -9/OOM) cannot clean up its child;
-        # the detached reaper kills the request's tree when we vanish.
-        from skypilot_tpu.utils.subprocess_utils import spawn_orphan_reaper
-        spawn_orphan_reaper(os.getpid(), pid)
+        # A hard-killed runner (kill -9/OOM) cannot clean up its child:
+        # PDEATHSIG (set in the child) covers the child itself for free;
+        # LONG requests additionally get a detached reaper because their
+        # payloads spawn process TREES (provisioning subprocesses) that
+        # PDEATHSIG does not reach. SHORT requests (status/logs, the
+        # high-rate path) skip the extra interpreter spawn.
+        if schedule_type == ScheduleType.LONG:
+            from skypilot_tpu.utils.subprocess_utils import (
+                spawn_orphan_reaper)
+            spawn_orphan_reaper(os.getpid(), pid)
         _, raw_status = os.waitpid(pid, 0)
         current_child['pid'] = None
         refreshed = requests_db.get(request.request_id)

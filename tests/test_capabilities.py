@@ -1,0 +1,75 @@
+"""Per-cloud capability-flag tests (parity: clouds/cloud.py:714
+CloudImplementationFeatures — declared limits consulted BEFORE work
+starts, not discovered as late provider errors)."""
+import os
+
+import pytest
+import yaml
+
+from skypilot_tpu import check, core, exceptions, execution, state
+from skypilot_tpu.optimizer import candidates_for
+from skypilot_tpu.provision import fake
+from skypilot_tpu.provision.api import CloudCapability
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def _reset(tmp_home):
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def test_capability_surface_shape():
+    caps = check.capabilities()
+    assert caps['kubernetes'].keys() == {'stop'}
+    assert 'spot' in caps['ssh']
+    assert 'spot' in caps['slurm']
+    assert caps['gcp'] == {}   # full-featured
+    assert caps['fake'] == {}
+
+
+def test_spot_request_skips_incapable_clouds(tmp_home):
+    """An explicit spot request on a no-spot cloud yields no candidates
+    (planner gate, not a late provider error)."""
+    inventory = os.path.join(os.environ['SKYT_STATE_DIR'],
+                             'ssh_node_pools.yaml')
+    os.makedirs(os.path.dirname(inventory), exist_ok=True)
+    with open(inventory, 'w', encoding='utf-8') as f:
+        yaml.safe_dump({'lab': {'user': 'u', 'hosts': ['10.0.0.1']}}, f)
+    spot = Resources(cloud='ssh', use_spot=True)
+    assert candidates_for(spot, ['ssh']) == []
+    on_demand = Resources(cloud='ssh')
+    assert len(candidates_for(on_demand, ['ssh'])) == 1
+
+
+def test_stop_rejected_early_on_incapable_cloud(monkeypatch):
+    """`skyt stop` on a k8s cluster fails at submit time with the
+    declared reason, without touching the apiserver."""
+    monkeypatch.setenv('SKYT_K8S_FAKE', '1')
+    state.add_or_update_cluster(
+        'k8s-c', status=state.ClusterStatus.UP, cloud='kubernetes',
+        handle={'cluster_name': 'k8s-c', 'provider': 'kubernetes',
+                'region': 'gke', 'zone': None, 'hosts': [],
+                'ssh_user': 'skyt', 'ssh_key_path': None, 'custom': {}})
+    with pytest.raises(exceptions.NotSupportedError) as err:
+        core.stop('k8s-c')
+    assert 'cannot be stopped' in str(err.value)
+    state.remove_cluster('k8s-c')
+
+
+def test_volume_task_rejected_on_incapable_cloud(tmp_home):
+    from skypilot_tpu import volumes
+    volumes.apply(volumes.Volume(name='v', type='hostpath', size_gb=1))
+    task = Task(name='t', run='true', volumes={'/mnt/v': 'v'},
+                resources=Resources(cloud='local'))
+    with pytest.raises(exceptions.NotSupportedError) as err:
+        execution.launch(task, 'cap-vol')
+    assert 'volumes' in str(err.value)
+
+
+def test_provider_supports_helper():
+    assert CLOUD_REGISTRY.get('fake').supports(CloudCapability.SPOT)
+    assert not CLOUD_REGISTRY.get('ssh').supports(CloudCapability.SPOT)

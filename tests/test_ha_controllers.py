@@ -1,0 +1,103 @@
+"""HA controller tests: a dead managed-job controller is replaced and
+re-attaches; the job is failed only after the restart budget.
+
+Parity: the reference's HA controllers (autostop_lib.py:262
+high_availability_specified — k8s-redeployed controllers re-run after a
+pod crash). Here replacement controllers adopt the live cluster job.
+"""
+import os
+import signal
+import time
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+
+@pytest.fixture(autouse=True)
+def fast_controller(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_JOBS_LAUNCH_RETRY_GAP', '0.2')
+    fake.reset()
+    yield
+    fake.reset()
+
+
+def _task(run):
+    return Task(name='ha', run=run,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8'))
+
+
+def _wait(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = jobs_state.get(job_id)
+        if record and record.status.value in statuses:
+            return record
+        time.sleep(0.2)
+    record = jobs_state.get(job_id)
+    raise AssertionError(
+        f'job {job_id} stuck in '
+        f'{record.status.value if record else None}; wanted {statuses}. '
+        f'Controller log:\n'
+        + jobs_core.tail_logs(job_id, controller=True)[-3000:])
+
+
+def _kill_controller(job_id):
+    record = jobs_state.get(job_id)
+    assert record.controller_pid is not None
+    os.kill(record.controller_pid, signal.SIGKILL)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not scheduler._controller_alive(record.controller_pid):  # noqa: SLF001
+            return record.controller_pid
+        time.sleep(0.1)
+    raise AssertionError('controller refused to die')
+
+
+def test_dead_controller_replaced_and_job_succeeds():
+    job_id = jobs_core.launch(_task('sleep 6 && echo ha-done'))
+    _wait(job_id, {'RUNNING'})
+    old_pid = _kill_controller(job_id)
+    scheduler.reap_dead_controllers()  # the jobs-refresh daemon's tick
+    record = jobs_state.get(job_id)
+    assert record.controller_pid != old_pid
+    assert record.controller_restarts == 1
+    # The replacement adopts the still-running cluster job; the job
+    # finishes SUCCEEDED, not FAILED_CONTROLLER.
+    record = _wait(job_id, {'SUCCEEDED'})
+    assert record.status == jobs_state.ManagedJobStatus.SUCCEEDED
+
+
+def test_restart_budget_exhaustion(monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_CONTROLLER_MAX_RESTARTS', '0')
+    job_id = jobs_core.launch(_task('sleep 60'))
+    _wait(job_id, {'RUNNING'})
+    _kill_controller(job_id)
+    scheduler.reap_dead_controllers()
+    record = _wait(job_id, {'FAILED_CONTROLLER'}, timeout=30)
+    assert 'repeatedly' in record.failure_reason
+    # Best-effort cleanup of the leaked cluster.
+    from skypilot_tpu import core
+    if state.get_cluster(record.cluster_name):
+        core.down(record.cluster_name)
+
+
+def test_replacement_finalizes_job_that_finished_unwatched():
+    job_id = jobs_core.launch(_task('echo quick'))
+    record = _wait(job_id, {'RUNNING', 'SUCCEEDED'})
+    if record.status.value != 'SUCCEEDED':
+        # Kill the controller while (or right after) the task runs;
+        # cluster job finishes unwatched.
+        _kill_controller(job_id)
+        time.sleep(2)
+        scheduler.reap_dead_controllers()
+        record = _wait(job_id, {'SUCCEEDED'})
+    assert record.status == jobs_state.ManagedJobStatus.SUCCEEDED

@@ -88,7 +88,25 @@ def test_params_count_llama3_8b():
     assert 7.9e9 < count < 8.1e9, count
 
 
-@pytest.mark.parametrize('name', ['llama3-8b', 'llama3-70b', 'mixtral-8x7b'])
+def test_gemma_style_geglu_and_tied_embeddings():
+    """gemma family: GeGLU activation + tied embeddings run end to end
+    and genuinely differ from the silu variant."""
+    _, logits_gelu = _fwd('tiny', activation='gelu_tanh',
+                          tie_embeddings=True)
+    _, logits_silu = _fwd('tiny')
+    assert logits_gelu.shape == logits_silu.shape
+    assert not jnp.allclose(logits_gelu, logits_silu)
+
+
+def test_finegrained_moe_config():
+    """deepseek-moe style: many small experts, higher top-k routing."""
+    _, logits = _fwd('tiny-moe', num_experts=8, experts_per_token=3)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+@pytest.mark.parametrize('name', ['llama3-8b', 'llama3-70b',
+                                  'mixtral-8x7b', 'gemma-7b', 'qwen2-7b',
+                                  'deepseek-moe-16b'])
 def test_big_configs_shape_only(name):
     """eval_shape the big configs: no memory, catches shape bugs."""
     cfg = get_model_config(name)
