@@ -35,7 +35,40 @@ def _chip_peak_tflops() -> float:
     return -1.0  # unknown accelerator: caller marks the result estimated
 
 
+def _probe_accelerator(tries: int = 3, probe_timeout: float = 150.0) -> bool:
+    """Check the accelerator answers before committing this process to a
+    jax init that can HANG when the remote-TPU tunnel is down. The probe
+    runs in a killable subprocess; a few retries ride out tunnel blips."""
+    import subprocess
+    for attempt in range(tries):
+        try:
+            proc = subprocess.run(
+                [sys.executable, '-c',
+                 'import jax; print(len(jax.devices()))'],
+                capture_output=True, text=True, timeout=probe_timeout)
+            if proc.returncode == 0 and proc.stdout.strip().isdigit():
+                return True
+            detail = (proc.stderr or proc.stdout).strip()[-300:]
+        except subprocess.TimeoutExpired:
+            detail = f'probe hung >{probe_timeout:.0f}s (tunnel down?)'
+        print(f'accelerator probe {attempt + 1}/{tries} failed: {detail}',
+              file=sys.stderr)
+        if attempt < tries - 1:
+            time.sleep(20)
+    return False
+
+
 def main() -> int:
+    if not _probe_accelerator():
+        print(json.dumps({
+            'metric': 'train_mfu_unavailable',
+            'value': 0,
+            'unit': '% MFU',
+            'vs_baseline': 0,
+            'detail': {'error': 'accelerator backend unreachable after '
+                                'retries (remote-TPU tunnel down)'},
+        }))
+        return 1
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default=None)
     parser.add_argument('--batch', type=int, default=None)

@@ -50,7 +50,8 @@ def make_handler(engine: InferenceEngine):
                 self._json(200, {'status': 'ok',
                                  'model': engine.cfg.name})
             elif self.path == '/stats':
-                self._json(200, engine.stats)
+                stats = engine.stats
+                self._json(200, stats() if callable(stats) else stats)
             else:
                 self._json(404, {'error': 'not found'})
 
@@ -62,11 +63,14 @@ def make_handler(engine: InferenceEngine):
                 length = int(self.headers.get('Content-Length', 0))
                 req = json.loads(self.rfile.read(length) or b'{}')
                 prompts = req.get('prompts') or [req.get('prompt', '')]
-                outputs = engine.generate_text(
-                    prompts,
+                kwargs = dict(
                     max_new_tokens=int(req.get('max_new_tokens', 32)),
                     temperature=float(req.get('temperature', 0.0)),
                     seed=int(req.get('seed', 0)))
+                if hasattr(engine, 'generate_texts'):
+                    outputs = engine.generate_texts(prompts, **kwargs)
+                else:
+                    outputs = engine.generate_text(prompts, **kwargs)
                 self._json(200, {'outputs': outputs})
             except Exception as e:  # pylint: disable=broad-except
                 logger.error('generate failed: %s', e, exc_info=True)
@@ -91,13 +95,30 @@ def main(argv=None) -> int:
     parser.add_argument('--host', default='0.0.0.0')
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--max-batch', type=int, default=8)
+    parser.add_argument('--engine', default='batch',
+                        choices=['batch', 'continuous'],
+                        help='continuous = slot-based continuous '
+                             'batching (JetStream-style serving core).')
+    parser.add_argument('--max-len', type=int, default=None,
+                        help='KV-cache length per slot (continuous '
+                             'engine; default: the model context).')
     args = parser.parse_args(argv)
-    engine = InferenceEngine(args.model,
-                             checkpoint_dir=args.checkpoint_dir,
-                             max_batch=args.max_batch)
-    # Warm the compile cache so the first real request (and the serve
-    # stack's readiness window) isn't paying XLA compile time.
-    engine.generate_text(['warmup'], max_new_tokens=8)
+    if args.engine == 'continuous':
+        from skypilot_tpu.inference.continuous import (
+            ContinuousBatchingEngine)
+        engine = ContinuousBatchingEngine(
+            args.model,
+            checkpoint_dir=args.checkpoint_dir,
+            max_slots=args.max_batch,
+            max_len=args.max_len)
+        engine.generate_text('warmup', max_new_tokens=8)
+    else:
+        engine = InferenceEngine(args.model,
+                                 checkpoint_dir=args.checkpoint_dir,
+                                 max_batch=args.max_batch)
+        # Warm the compile cache so the first real request (and the
+        # serve stack's readiness window) isn't paying XLA compile time.
+        engine.generate_text(['warmup'], max_new_tokens=8)
     server = serve(engine, args.host, args.port)
     try:
         server.serve_forever()

@@ -190,7 +190,28 @@ class JobController:
                 # Finished while no controller was watching.
                 self._finalize(ManagedJobStatus.SUCCEEDED)
                 return None
-            break  # queue readable: no live/succeeded job -> recover
+            if any(j['status'] == 'FAILED' for j in cluster_jobs):
+                # User code failed unwatched: same budget discipline as
+                # the monitor loop — restart in place if allowed, never
+                # silently re-run side-effectful work via recovery.
+                if self.restarts_left > 0:
+                    self.restarts_left -= 1
+                    jobs_state.set_status(self.job_id,
+                                          ManagedJobStatus.RECOVERING)
+                    jobs_state.bump_recovery(self.job_id)
+                    cluster_job_id = self.backend.execute(
+                        info, self.task, detach=True)
+                    jobs_state.set_status(self.job_id,
+                                          ManagedJobStatus.RUNNING)
+                    return cluster_job_id
+                self._finalize(ManagedJobStatus.FAILED,
+                               'task exited non-zero (finished while '
+                               'no controller was watching)')
+                return None
+            if any(j['status'] == 'CANCELLED' for j in cluster_jobs):
+                self._finalize(ManagedJobStatus.CANCELLED)
+                return None
+            break  # queue readable but empty -> recover
         # Cluster gone or job died with it: normal recovery machinery.
         return self._recover()
 

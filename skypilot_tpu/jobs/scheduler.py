@@ -91,6 +91,19 @@ def _controller_alive(pid: int) -> bool:
     return True
 
 
+def _spawn_replacement(record, old_pid) -> None:
+    log_path = jobs_state.controller_log_path(record.job_id)
+    new_pid = subprocess_utils.daemonize_and_run(
+        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+         '--job-id', str(record.job_id), '--resume'],
+        log_path=log_path)
+    jobs_state.set_controller_pid(record.job_id, new_pid)
+    logger.warning(
+        'Managed job %s: controller %s died; resumed with replacement '
+        'pid %s (restart %d/%d).', record.job_id, old_pid, new_pid,
+        record.controller_restarts + 1, _controller_max_restarts())
+
+
 def reap_dead_controllers() -> None:
     """HA controller recovery (parity: the reference's HA controllers —
     autostop_lib.high_availability_specified, k8s-redeployed controllers
@@ -106,22 +119,20 @@ def reap_dead_controllers() -> None:
             continue
         pid = record.controller_pid
         if pid is None:
+            # Claim-window orphan: a previous reaper NULLed the pid but
+            # died before spawning the replacement. After a grace period
+            # the stale claim is re-claimable (atomic; normal in-flight
+            # spawns are younger than the grace and skipped).
+            if (record.controller_claimed_at is not None and
+                    jobs_state.reclaim_stale_controller_claim(
+                        record.job_id)):
+                _spawn_replacement(record, old_pid=None)
             continue
         if _controller_alive(pid):
             continue
         if jobs_state.claim_controller_restart(
                 record.job_id, pid, _controller_max_restarts()):
-            log_path = jobs_state.controller_log_path(record.job_id)
-            new_pid = subprocess_utils.daemonize_and_run(
-                [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-                 '--job-id', str(record.job_id), '--resume'],
-                log_path=log_path)
-            jobs_state.set_controller_pid(record.job_id, new_pid)
-            logger.warning(
-                'Managed job %s: controller %s died; resumed with '
-                'replacement pid %s (restart %d/%d).', record.job_id,
-                pid, new_pid, record.controller_restarts + 1,
-                _controller_max_restarts())
+            _spawn_replacement(record, old_pid=pid)
             continue
         # Claim lost: either another process is spawning the replacement
         # right now, or the restart budget is spent. Only the latter

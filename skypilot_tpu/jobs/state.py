@@ -103,6 +103,9 @@ def _db() -> sqlite3.Connection:
     if 'workspace' not in cols:
         conn.execute("ALTER TABLE jobs ADD COLUMN workspace TEXT "
                      "DEFAULT 'default'")
+    if 'controller_claimed_at' not in cols:
+        conn.execute('ALTER TABLE jobs ADD COLUMN controller_claimed_at '
+                     'REAL')
     conn.commit()
     _local.conn = conn
     _local.path = path
@@ -132,6 +135,8 @@ class JobRecord:
                                                  '[]')
         self.controller_restarts: int = row['controller_restarts'] or 0
         self.workspace: str = row['workspace'] or 'default'
+        self.controller_claimed_at: Optional[float] = (
+            row['controller_claimed_at'])
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -333,10 +338,27 @@ def claim_controller_restart(job_id: int, dead_pid: int,
     conn = _db()
     cur = conn.execute(
         'UPDATE jobs SET controller_restarts = controller_restarts + 1, '
-        'controller_pid = NULL '
+        'controller_pid = NULL, controller_claimed_at = ? '
         'WHERE job_id = ? AND controller_pid = ? '
         'AND controller_restarts < ?',
-        (job_id, dead_pid, max_restarts))
+        (time.time(), job_id, dead_pid, max_restarts))
+    conn.commit()
+    return cur.rowcount == 1
+
+
+def reclaim_stale_controller_claim(job_id: int,
+                                   stale_after: float = 30.0) -> bool:
+    """Claim a job whose previous claimant died between NULLing the pid
+    and spawning the replacement (the claim-window orphan). Atomic: the
+    conditional UPDATE on (pid IS NULL, old claim time) lets exactly one
+    caller through."""
+    conn = _db()
+    cur = conn.execute(
+        'UPDATE jobs SET controller_claimed_at = ? '
+        'WHERE job_id = ? AND controller_pid IS NULL '
+        'AND controller_claimed_at IS NOT NULL '
+        'AND controller_claimed_at < ?',
+        (time.time(), job_id, time.time() - stale_after))
     conn.commit()
     return cur.rowcount == 1
 

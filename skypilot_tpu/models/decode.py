@@ -137,12 +137,21 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
 # ---------------------------------------------------------------------------
 
 def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
-                cfg: ModelConfig) -> Tuple[jax.Array, KVCache]:
+                cfg: ModelConfig,
+                active: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, KVCache]:
     """One autoregressive step. tokens: [B] int32 (the just-sampled token).
 
-    Returns (logits [B, V], updated cache with lengths+1).
+    ``active`` ([B] bool, default all-on) supports continuous batching:
+    inactive slots neither write the cache nor advance their length, so
+    a finished request's slot stays inert until a new prompt prefills
+    over it — the whole batch still runs as ONE static-shape program.
+
+    Returns (logits [B, V], updated cache with lengths+active).
     """
     b = tokens.shape[0]
+    if active is None:
+        active = jnp.ones((b,), bool)
     dt = cfg.compute_dtype
     positions = cache.lengths[:, None]                       # [B, 1]
     sin, cos = rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta)
@@ -151,7 +160,8 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
     max_len = cache.max_len
     # one-hot over cache positions for scatter + mask for attention
     pos_iota = jnp.arange(max_len)                           # [T]
-    insert = (pos_iota[None, :] == cache.lengths[:, None])   # [B, T]
+    insert = ((pos_iota[None, :] == cache.lengths[:, None]) &
+              active[:, None])                               # [B, T]
     valid = (pos_iota[None, :] <= cache.lengths[:, None])    # [B, T]
 
     def layer(carry, scanned):
@@ -188,7 +198,8 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
     x, (k_new, v_new) = jax.lax.scan(
         layer, x, (params['layers'], cache.k, cache.v))
     logits = _lm_head(params, x, cfg)[:, 0]                  # [B, V]
-    new_cache = KVCache(k=k_new, v=v_new, lengths=cache.lengths + 1)
+    new_cache = KVCache(k=k_new, v=v_new,
+                        lengths=cache.lengths + active.astype(jnp.int32))
     return logits, new_cache
 
 

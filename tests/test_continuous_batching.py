@@ -1,0 +1,103 @@
+"""Continuous-batching engine tests.
+
+Parity target: the serving core of JetStream/vLLM-style engines — one
+static decode program over fixed slots, requests admitted/retired
+mid-stream. Correctness bar: continuous-batched greedy output is
+IDENTICAL to the standalone batch generate for every prompt, no matter
+how requests interleave.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+from skypilot_tpu.models import decode as decode_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.config import get_model_config
+
+
+@pytest.fixture(scope='module')
+def engine():
+    eng = ContinuousBatchingEngine('tiny', max_slots=2, max_len=96)
+    yield eng
+    eng.shutdown()
+
+
+def _reference_greedy(engine, ids, max_new_tokens):
+    tokens = jnp.asarray([ids], jnp.int32)
+    lengths = jnp.asarray([len(ids)], jnp.int32)
+    generated, gen_len = decode_lib.generate(
+        engine.params, tokens, lengths, engine.cfg,
+        max_new_tokens=max_new_tokens, temperature=0.0)
+    return list(np.asarray(generated)[0][:int(gen_len[0])])
+
+
+def test_single_request_matches_batch_generate(engine):
+    ids = [5, 9, 42, 7]
+    out = engine.generate_ids(ids, max_new_tokens=8)
+    assert out == _reference_greedy(engine, ids, 8)
+
+
+def test_interleaved_requests_match_isolated_outputs(engine):
+    """3 staggered requests on 2 slots: every output equals the
+    request's isolated greedy decode (batch composition is invisible)."""
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 18], [31, 41, 59, 26, 5, 3]]
+    outs = [None] * len(prompts)
+
+    def run(i):
+        time.sleep(0.05 * i)  # staggered arrivals
+        outs[i] = engine.generate_ids(prompts[i], max_new_tokens=10)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, prompt in enumerate(prompts):
+        assert outs[i] == _reference_greedy(engine, prompt, 10), i
+
+
+def test_slot_reuse_more_requests_than_slots(engine):
+    """8 requests through 2 slots — the loop retires and refills."""
+    results = [engine.generate_ids([i + 1, i + 2], max_new_tokens=4)
+               for i in range(8)]
+    for i, out in enumerate(results):
+        assert out == _reference_greedy(engine, [i + 1, i + 2], 4), i
+    stats = engine.stats()
+    assert stats['active'] == 0 and stats['pending'] == 0
+
+
+def test_text_roundtrip(engine):
+    text = engine.generate_text('hi', max_new_tokens=6)
+    assert isinstance(text, str)
+
+
+def test_http_payload_on_continuous_engine(engine):
+    """The serving payload's /generate handles concurrent prompts on
+    the continuous engine (the `--engine continuous` server path)."""
+    import json
+    import urllib.request
+    from skypilot_tpu.inference.server import serve
+    server = serve(engine, '127.0.0.1', 0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        body = json.dumps({'prompts': ['a', 'bb', 'ccc'],
+                           'max_new_tokens': 4}).encode()
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/generate', data=body,
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            payload = json.loads(resp.read())
+        assert len(payload['outputs']) == 3
+        stats = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/stats', timeout=10).read())
+        assert stats['slots'] == engine.max_slots
+    finally:
+        server.shutdown()
