@@ -155,7 +155,8 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
 
 def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
                      sin: jax.Array, cos: jax.Array,
-                     rules: LogicalAxisRules) -> jax.Array:
+                     rules: LogicalAxisRules,
+                     segments: Optional[jax.Array] = None) -> jax.Array:
     dt = cfg.compute_dtype
     q = jnp.einsum('bsd,dhk->bshk', x, lp['wq'].astype(dt))
     k = jnp.einsum('bsd,dhk->bshk', x, lp['wk'].astype(dt))
@@ -167,6 +168,7 @@ def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
     out = multi_head_attention(q, k, v, causal=True,
+                               segment_ids=segments,
                                impl=cfg.attention_impl)
     out = jnp.einsum('bshk,hkd->bsd', out, lp['wo'].astype(dt))
     return out
@@ -222,9 +224,11 @@ def _moe_block(x: jax.Array, lp: Params, cfg: ModelConfig,
 
 def _decoder_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
                    sin: jax.Array, cos: jax.Array,
-                   rules: LogicalAxisRules) -> jax.Array:
+                   rules: LogicalAxisRules,
+                   segments: Optional[jax.Array] = None) -> jax.Array:
     h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
-    x = x + _attention_block(h, lp['attn'], cfg, sin, cos, rules)
+    x = x + _attention_block(h, lp['attn'], cfg, sin, cos, rules,
+                             segments=segments)
     h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
     if cfg.is_moe:
         x = x + _moe_block(h, lp['moe'], cfg, rules)
@@ -253,6 +257,7 @@ def forward(params: Params,
             cfg: ModelConfig,
             *,
             positions: Optional[jax.Array] = None,
+            segments: Optional[jax.Array] = None,
             rules: LogicalAxisRules = DEFAULT_RULES,
             pipeline_stages: int = 1,
             pipeline_microbatches: Optional[int] = None) -> jax.Array:
@@ -278,8 +283,13 @@ def forward(params: Params,
     x = with_logical_constraint(x, ('batch', 'act_seq', 'act_embed'),
                                 rules=rules)
 
+    if segments is not None and pipeline_stages > 1:
+        raise ValueError(
+            'packed-sequence segments are not supported with '
+            'pipeline_stages > 1 (segments are closed over at full '
+            'batch size but stages see microbatches)')
     layer_fn = functools.partial(_decoder_layer, cfg=cfg, sin=sin, cos=cos,
-                                 rules=rules)
+                                 rules=rules, segments=segments)
     policy = _remat_policy(cfg)
     if cfg.remat_policy != 'none':
         layer_fn = jax.checkpoint(layer_fn, policy=policy,

@@ -108,6 +108,12 @@ def main(argv=None) -> int:
                         help="e.g. 'data=2,fsdp=-1,tensor=2'")
     parser.add_argument('--data', default='synthetic',
                         help="'synthetic' or a flat token .npy file")
+    parser.add_argument('--packed', action='store_true', default=False,
+                        help='Pack EOS-delimited documents from --data '
+                             'into padding-free batches (native C++ '
+                             'packer; segment-masked attention).')
+    parser.add_argument('--eos-id', type=int, default=1,
+                        help='Document delimiter token for --packed.')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=50)
     parser.add_argument('--log-every', type=int, default=10)
@@ -154,8 +160,14 @@ def main(argv=None) -> int:
             print(json.dumps({'resumed_from_step': start_step}), flush=True)
     step_fn = make_train_step(cfg, hp, mesh, shardings=shardings)
 
-    data_iter = (file_batch_iterator(args.data, args.batch, seq)
-                 if args.data != 'synthetic' else None)
+    if args.data == 'synthetic':
+        data_iter = None
+    elif args.packed:
+        from skypilot_tpu.data.packer import packed_batch_iterator
+        data_iter = packed_batch_iterator(args.data, batch=args.batch,
+                                          seq=seq, eos_id=args.eos_id)
+    else:
+        data_iter = file_batch_iterator(args.data, args.batch, seq)
     flops_per_token = cfg.flops_per_token(seq)
     window_t0 = time.perf_counter()
     window_tokens = 0

@@ -152,6 +152,8 @@ def train_step_fn(state: TrainState,
     def loss_fn(params):
         logits = llama.forward(
             params, batch['tokens'], cfg, rules=rules,
+            positions=batch.get('positions'),
+            segments=batch.get('segments'),
             pipeline_stages=pipeline_stages,
             pipeline_microbatches=hp.pipeline_microbatches)
         loss, _ = cross_entropy_loss(logits, batch['targets'],
