@@ -53,10 +53,15 @@ def load_native() -> Optional[ctypes.CDLL]:
         if not os.path.exists(so_path) or stale:
             if not have_src:
                 raise OSError(f'no cached packer and no source at {_SRC}')
+            # Compile to a private temp and rename into place: concurrent
+            # processes (multi-worker launches, pytest-xdist) must never
+            # dlopen a half-written library or rewrite a mapped one.
+            tmp_path = f'{so_path}.{os.getpid()}.tmp'
             subprocess.run(
                 ['g++', '-O3', '-fPIC', '-shared', '-std=c++17',
-                 '-o', so_path, _SRC],
+                 '-o', tmp_path, _SRC],
                 check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, so_path)
         lib = ctypes.CDLL(so_path)
         lib.skyt_pack_batch.restype = ctypes.c_long
         lib.skyt_pack_batch.argtypes = [

@@ -20,6 +20,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_warned_fallbacks: set = set()
+
+
+def _warn_fallback_once(reason: str) -> None:
+    """The silent-fallback trap: dropping off the flash kernel onto the
+    O(S^2) XLA reference is a real MFU/HBM cliff at long seq — say so,
+    once per distinct reason."""
+    if reason in _warned_fallbacks:
+        return
+    _warned_fallbacks.add(reason)
+    from skypilot_tpu.utils import log
+    log.init_logger(__name__).warning(
+        'flash attention: falling back to the XLA reference for %s '
+        '(O(S^2) memory; expect lower MFU at long sequence lengths)',
+        reason)
+
 NEG_INF = -1e30
 
 def _interpret() -> bool:
@@ -377,6 +393,9 @@ def flash_attention(q: jax.Array,
     from skypilot_tpu.ops import attention as xla_attn
     s_q, s_k = q.shape[1], k.shape[1]
     if segment_ids is not None or not _supported(q, k, s_q, s_k):
+        _warn_fallback_once(
+            'segment-masked attention' if segment_ids is not None else
+            f'shape (q={q.shape}, k={k.shape})')
         return xla_attn.xla_attention(q, k, v, causal=causal,
                                       segment_ids=segment_ids)
     scale = q.shape[-1] ** -0.5

@@ -27,6 +27,7 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def maybe_init_distributed() -> None:
@@ -178,7 +179,11 @@ def main(argv=None) -> int:
         else:
             batch = synthetic_batch(step, args.batch, seq, cfg.vocab_size)
         state, metrics = step_fn(state, batch)
-        window_tokens += args.batch * seq * jax.process_count()
+        # REAL tokens, not grid cells: packed batches carry padding with
+        # weight 0 and must not inflate throughput (for the dense paths
+        # the weights are all ones, so this is the same number).
+        real_tokens = float(np.asarray(batch['weights']).sum())
+        window_tokens += real_tokens * jax.process_count()
         if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
             loss = float(metrics['loss'])  # sync point
             elapsed = time.perf_counter() - window_t0

@@ -72,11 +72,14 @@ def test_iterator_weights_respect_boundaries():
     batch = next(it)
     weights, segments = batch['weights'][0], batch['segments'][0]
     targets, toks = batch['targets'][0], batch['tokens'][0]
+    full_segments = np.asarray(
+        packer.pack_batch(tokens, 0, 1, batch=1, seq=9)[0]['segments'])[0]
     for i in range(8):
         if weights[i]:
-            assert segments[i] == batch['segments'][0][i]
-            # weighted target is the next token of the SAME document
-            assert targets[i] == toks[i + 1] if i + 1 < 8 else True
+            # A weighted position's NEXT token is in the same document.
+            assert full_segments[i + 1] == full_segments[i] > 0
+            if i + 1 < 8:
+                assert targets[i] == toks[i + 1]
     # The last token of each segment has weight 0 (next token is another
     # doc or padding).
     for segment in np.unique(segments[segments > 0]):
