@@ -680,13 +680,45 @@ def workspace_switch(name: str) -> None:
     click.echo(f'active workspace: {name}')
 
 
+# Command groups whose SECOND argv token is a subcommand name (safe to
+# record); for plain commands argv[2] is user content (cluster names,
+# YAML paths) and must never reach telemetry.
+_TELEMETRY_GROUPS = frozenset({
+    'jobs', 'serve', 'api', 'volumes', 'workspace', 'users', 'recipes'})
+
+
+def _telemetry_verb(argv: List[str]) -> str:
+    if len(argv) < 1 or argv[0].startswith('-'):
+        return 'help'
+    verb = argv[0]
+    if (verb in _TELEMETRY_GROUPS and len(argv) > 1 and
+            not argv[1].startswith('-')):
+        verb += '.' + argv[1]
+    return verb[:48]
+
+
 def main() -> None:
+    import time
     from skypilot_tpu import plugins
+    from skypilot_tpu.utils import usage
     plugins.load_plugins()
+    verb = _telemetry_verb(sys.argv[1:])
+    start = time.time()
     try:
         cli()
+        # Unreachable in practice: click's standalone mode exits via
+        # SystemExit even on success (handled below).
     except KeyboardInterrupt:
+        usage.record(f'cli.{verb}', outcome='interrupted',
+                     duration_s=time.time() - start)
         sys.exit(130)
+    except SystemExit as e:
+        code = e.code if isinstance(e.code, int) else (0 if e.code is None
+                                                       else 1)
+        usage.record(f'cli.{verb}',
+                     outcome='ok' if code == 0 else f'exit_{code}',
+                     duration_s=time.time() - start)
+        raise
 
 
 if __name__ == '__main__':

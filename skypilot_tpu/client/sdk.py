@@ -58,13 +58,42 @@ def _auth_headers() -> Dict[str, str]:
     return {'Authorization': f'Bearer {token}'} if token else {}
 
 
+_version_checked: set = set()
+
+
 def api_is_healthy(url: Optional[str] = None) -> bool:
+    url = url or api_server_url()
     try:
-        resp = requests_lib.get(f'{url or api_server_url()}/api/health',
-                                timeout=2)
-        return resp.status_code == 200
+        resp = requests_lib.get(f'{url}/api/health', timeout=2)
+        if resp.status_code != 200:
+            return False
+        _check_server_version(url, resp)
+        return True
     except requests_lib.exceptions.RequestException:
         return False
+
+
+def _check_server_version(url: str, resp) -> None:
+    """Client/server version negotiation (parity: sky/server/versions.py
+    — the reference refuses mismatched majors; we warn loudly once per
+    server: mismatched wheels are the classic source of protocol bugs)."""
+    if url in _version_checked:
+        return
+    _version_checked.add(url)
+    try:
+        server_version = resp.json().get('version')
+        if server_version and server_version != _client_version():
+            logger.warning(
+                'API server at %s runs skypilot-tpu %s but this client '
+                'is %s — upgrade the older side if requests misbehave.',
+                url, server_version, _client_version())
+    except ValueError:
+        pass
+
+
+def _client_version() -> str:
+    import skypilot_tpu
+    return skypilot_tpu.__version__
 
 
 def _endpoint_is_configured() -> bool:

@@ -89,7 +89,9 @@ def _run_request_in_child(request_id: str) -> None:
     if request is None or request.status.is_terminal():
         return
     from skypilot_tpu.server import payloads
+    from skypilot_tpu.utils import usage
     fn, _ = payloads.PAYLOADS[request.name]
+    started = time.time()
     try:
         result = fn(**request.body)
         try:
@@ -97,10 +99,14 @@ def _run_request_in_child(request_id: str) -> None:
         except TypeError:
             result = repr(result)
         requests_db.finalize(request_id, RequestStatus.SUCCEEDED, result)
+        usage.record(f'request.{request.name}',
+                     duration_s=time.time() - started)
     except BaseException as e:  # pylint: disable=broad-except
         traceback.print_exc()
         requests_db.finalize(request_id, RequestStatus.FAILED,
                              error=f'{type(e).__name__}: {e}')
+        usage.record(f'request.{request.name}', outcome='failed',
+                     duration_s=time.time() - started)
     finally:
         # The child exits via os._exit (no atexit): flush any buffered
         # timeline spans explicitly or they are lost.

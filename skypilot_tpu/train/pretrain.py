@@ -180,9 +180,15 @@ def main(argv=None) -> int:
             batch = synthetic_batch(step, args.batch, seq, cfg.vocab_size)
         state, metrics = step_fn(state, batch)
         # REAL tokens, not grid cells: packed batches carry padding with
-        # weight 0 and must not inflate throughput (for the dense paths
-        # the weights are all ones, so this is the same number).
-        real_tokens = float(np.asarray(batch['weights']).sum())
+        # weight 0 and must not inflate throughput. Only the packed path
+        # needs the sum (its weights are already host numpy); dense
+        # paths have statically-known counts — summing a device array
+        # every step would force a host transfer in the hot loop.
+        weights = batch.get('weights')
+        if isinstance(weights, np.ndarray):
+            real_tokens = float(weights.sum())
+        else:
+            real_tokens = args.batch * seq
         window_tokens += real_tokens * jax.process_count()
         if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
             loss = float(metrics['loss'])  # sync point

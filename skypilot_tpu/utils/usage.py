@@ -1,0 +1,129 @@
+"""Usage telemetry: opt-in, local-first event log.
+
+Parity: ``sky/usage/usage_lib.py`` + the heartbeat event (the reference
+ships usage messages to a hosted Loki; design doc
+sky/design_docs/usage_collection.md). Stance here: privacy-first —
+events are ALWAYS recorded locally (a JSONL ring under the state dir,
+useful for `skyt` debugging and the dashboard), and shipped to an HTTP
+collector ONLY when the operator configures one::
+
+    usage:
+      endpoint: https://collector.corp/skyt   # POST, JSON body
+      enabled: true
+
+Payloads carry no cluster names, commands, or YAML contents — just the
+verb, outcome, duration, and coarse environment facts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import platform
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+import skypilot_tpu
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+_MAX_LOCAL_BYTES = 5 * 1024 * 1024
+
+
+def _usage_dir() -> str:
+    return os.path.join(
+        os.environ.get('SKYT_STATE_DIR', os.path.expanduser('~/.skyt')),
+        'usage')
+
+
+def _run_id() -> str:
+    """Stable anonymous installation id (random uuid, created once)."""
+    path = os.path.join(_usage_dir(), 'installation_id')
+    try:
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                return f.read().strip()
+        os.makedirs(_usage_dir(), exist_ok=True)
+        value = uuid.uuid4().hex
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(value)
+        return value
+    except OSError:
+        return 'unknown'
+
+
+def record(event: str, *, outcome: str = 'ok',
+           duration_s: Optional[float] = None,
+           detail: Optional[Dict[str, Any]] = None) -> None:
+    """Append one event locally; ship it if a collector is configured.
+
+    Never raises: telemetry must not break the actual work.
+    """
+    payload = {
+        'ts': time.time(),
+        'event': event,
+        'outcome': outcome,
+        'duration_s': (round(duration_s, 3)
+                       if duration_s is not None else None),
+        'version': skypilot_tpu.__version__,
+        'python': platform.python_version(),
+        'platform': platform.system().lower(),
+        'installation': _run_id(),
+        **(detail or {}),
+    }
+    try:
+        os.makedirs(_usage_dir(), exist_ok=True)
+        path = os.path.join(_usage_dir(), 'events.jsonl')
+        # Bounded: rotate once instead of growing forever.
+        if (os.path.exists(path) and
+                os.path.getsize(path) > _MAX_LOCAL_BYTES):
+            os.replace(path, path + '.1')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(payload) + '\n')
+    except OSError:
+        pass
+    _maybe_ship(payload)
+
+
+def _maybe_ship(payload: Dict[str, Any]) -> None:
+    """Fire-and-forget: a slow/blackholed collector must never stall
+    the CLI exit path or an executor worker."""
+    try:
+        from skypilot_tpu import config
+        if not config.get_nested(('usage', 'enabled'), False):
+            return
+        endpoint = config.get_nested(('usage', 'endpoint'), None)
+        if not endpoint:
+            return
+    except Exception:  # pylint: disable=broad-except
+        return
+
+    def ship() -> None:
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                endpoint, data=json.dumps(payload).encode(),
+                headers={'Content-Type': 'application/json'})
+            urllib.request.urlopen(req, timeout=3).read()
+        except Exception:  # pylint: disable=broad-except
+            logger.debug('usage ship failed', exc_info=True)
+
+    import threading
+    threading.Thread(target=ship, name='usage-ship',
+                     daemon=True).start()
+
+
+def recent(limit: int = 100) -> list:
+    path = os.path.join(_usage_dir(), 'events.jsonl')
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding='utf-8') as f:
+        lines = f.readlines()[-limit:]
+    out = []
+    for line in lines:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out

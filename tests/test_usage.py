@@ -1,0 +1,106 @@
+"""Usage telemetry + version negotiation tests.
+
+Parity: sky/usage/usage_lib.py (local-first, opt-in shipping) and
+sky/server/versions.py (client/server version check).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from skypilot_tpu import config
+from skypilot_tpu.utils import usage
+
+
+@pytest.fixture(autouse=True)
+def _home(tmp_home):
+    yield
+
+
+def test_events_recorded_locally():
+    usage.record('cli.launch', duration_s=1.234)
+    usage.record('cli.status', outcome='exit_1')
+    events = usage.recent()
+    assert [e['event'] for e in events] == ['cli.launch', 'cli.status']
+    assert events[0]['duration_s'] == 1.234
+    assert events[1]['outcome'] == 'exit_1'
+    assert events[0]['installation'] == events[1]['installation']
+    # No payload fields that could carry user content.
+    assert not any(k in events[0] for k in ('command', 'yaml', 'name'))
+
+
+def test_shipping_only_when_opted_in():
+    received = []
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers['Content-Length'])
+            received.append(json.loads(self.rfile.read(length)))
+            self.send_response(200)
+            self.send_header('Content-Length', '0')
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = HTTPServer(('127.0.0.1', 0), Collector)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    endpoint = f'http://127.0.0.1:{server.server_address[1]}/collect'
+    try:
+        import time
+        config.set_nested(('usage',), {'endpoint': endpoint})
+        usage.record('cli.down')          # enabled not set -> local only
+        time.sleep(0.5)
+        assert received == []
+        config.set_nested(('usage',), {'endpoint': endpoint,
+                                       'enabled': True})
+        usage.record('cli.down')          # shipping is fire-and-forget
+        deadline = time.time() + 10
+        while time.time() < deadline and not received:
+            time.sleep(0.05)
+        assert len(received) == 1 and received[0]['event'] == 'cli.down'
+    finally:
+        server.shutdown()
+
+
+def test_collector_failure_never_raises():
+    config.set_nested(('usage',), {'endpoint': 'http://127.0.0.1:1/x',
+                                   'enabled': True})
+    usage.record('cli.launch')  # dead collector: still no exception
+    assert usage.recent()[-1]['event'] == 'cli.launch'
+
+
+def test_version_mismatch_warns_once(tmp_home):
+    import logging
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.server.app import ApiServer
+    import skypilot_tpu
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    capture = Capture()
+    logging.getLogger('skypilot_tpu').addHandler(capture)
+    try:
+        sdk._version_checked.clear()  # noqa: SLF001
+        real_fn = sdk._client_version  # noqa: SLF001
+        sdk._client_version = lambda: '0.0.1'
+        try:
+            assert sdk.api_is_healthy(srv.url)
+            assert sdk.api_is_healthy(srv.url)  # second: no new warning
+        finally:
+            sdk._client_version = real_fn
+        warnings = [m for m in records if 'upgrade the older side' in m]
+        assert len(warnings) == 1
+        del skypilot_tpu
+    finally:
+        logging.getLogger('skypilot_tpu').removeHandler(capture)
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
