@@ -691,9 +691,16 @@ def _telemetry_verb(argv: List[str]) -> str:
     if len(argv) < 1 or argv[0].startswith('-'):
         return 'help'
     verb = argv[0]
+    if verb not in cli.commands:
+        # A typo'd/omitted command puts USER CONTENT at argv[0] (e.g.
+        # `skyt my-cluster status`); never record it.
+        return 'unknown'
     if (verb in _TELEMETRY_GROUPS and len(argv) > 1 and
             not argv[1].startswith('-')):
-        verb += '.' + argv[1]
+        group = cli.commands[verb]
+        sub = argv[1]
+        if hasattr(group, 'commands') and sub in group.commands:
+            verb += '.' + sub
     return verb[:48]
 
 

@@ -81,14 +81,16 @@ def _check_server_version(url: str, resp) -> None:
         return
     _version_checked.add(url)
     try:
-        server_version = resp.json().get('version')
+        payload = resp.json()
+        server_version = (payload.get('version')
+                          if isinstance(payload, dict) else None)
         if server_version and server_version != _client_version():
             logger.warning(
                 'API server at %s runs skypilot-tpu %s but this client '
                 'is %s — upgrade the older side if requests misbehave.',
                 url, server_version, _client_version())
     except ValueError:
-        pass
+        pass  # a proxy answering 200 with junk is still "healthy"
 
 
 def _client_version() -> str:

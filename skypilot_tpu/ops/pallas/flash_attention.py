@@ -76,8 +76,15 @@ def _supported(q: jax.Array, k: jax.Array, s_q: int, s_k: int) -> bool:
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                scale: float, causal: bool):
-    """Grid: (B, H, num_q_blocks). K/V refs hold the full [S, D] slice."""
+                scale: float, causal: bool, seg_q_ref=None,
+                seg_k_ref=None):
+    """Grid: (B, H, num_q_blocks). K/V refs hold the full [S, D] slice.
+
+    With ``seg_q_ref``/``seg_k_ref`` ([block_q]/[S] int32 slices of the
+    same [B, S] segment-id array), scores cross segment boundaries are
+    masked — packed-sequence training stays on the kernel instead of
+    falling back to the O(S^2) XLA reference.
+    """
     qi = pl.program_id(2)
     block_q = q_ref.shape[0]
     head_dim = q_ref.shape[1]
@@ -85,6 +92,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     num_k_blocks = pl.cdiv(s_k, block_k)
 
     q = q_ref[:].astype(jnp.float32) * scale
+    seg_q = seg_q_ref[:] if seg_q_ref is not None else None
 
     def body(kj, carry):
         m_prev, l_prev, acc = carry
@@ -103,6 +111,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                      jax.lax.broadcasted_iota(jnp.int32,
                                               (block_q, block_k), 1))
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if seg_q is not None:
+            seg_k = seg_k_ref[pl.ds(k_start, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)         # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                            # [bq, bk]
@@ -130,17 +141,47 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[:] = m + jnp.log(l_safe)                      # [bq, 1]
 
 
+def _fwd_kernel_seg(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref,
+                    lse_ref, *, block_k: int, scale: float, causal: bool):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, block_k=block_k,
+                scale=scale, causal=causal, seg_q_ref=seg_q_ref,
+                seg_k_ref=seg_k_ref)
+
+
 def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
-         scale: float) -> Tuple[jax.Array, jax.Array]:
-    """q: [B,H,S,D]; k,v: [B,KV,S,D] -> (o [B,H,S,D], lse [B,H,S])."""
+         scale: float,
+         segments: Optional[jax.Array] = None
+         ) -> Tuple[jax.Array, jax.Array]:
+    """q: [B,H,S,D]; k,v: [B,KV,S,D]; segments [B,S] int32 or None ->
+    (o [B,H,S,D], lse [B,H,S])."""
     b, h, s, d = q.shape
     kv = k.shape[1]
     group = h // kv
     block_q, block_k = _block_sizes(s)
     grid = (b, h, s // block_q)
 
-    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
-                               causal=causal)
+    in_specs = [
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, s, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        pl.BlockSpec((None, None, s, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+    ]
+    operands = [q, k, v]
+    if segments is None:
+        kernel = functools.partial(_fwd_kernel, block_k=block_k,
+                                   scale=scale, causal=causal)
+    else:
+        kernel = functools.partial(_fwd_kernel_seg, block_k=block_k,
+                                   scale=scale, causal=causal)
+        in_specs += [
+            pl.BlockSpec((None, block_q),
+                         lambda bi, hi, qi: (bi, qi)),     # q-side slice
+            pl.BlockSpec((None, s),
+                         lambda bi, hi, qi: (bi, 0)),      # full k side
+        ]
+        operands += [segments, segments]
     out_shape = [
         jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
@@ -148,14 +189,7 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_q, d),
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -164,7 +198,7 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
         ],
         out_shape=out_shape,
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -173,7 +207,8 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k: int, scale: float, causal: bool):
+                   *, block_k: int, scale: float, causal: bool,
+                   seg_q_ref=None, seg_k_ref=None):
     """Grid: (B, H, num_q_blocks); accumulates dq for one q block."""
     qi = pl.program_id(2)
     block_q = q_ref.shape[0]
@@ -184,6 +219,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     do = do_ref[:].astype(jnp.float32)
     lse = lse_ref[:]                                       # [bq, 1]
     delta = delta_ref[:]                                   # [bq, 1]
+    seg_q = seg_q_ref[:] if seg_q_ref is not None else None
 
     def body(kj, dq_acc):
         k_start = pl.multiple_of(kj * block_k, block_k)
@@ -200,6 +236,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                      jax.lax.broadcasted_iota(jnp.int32,
                                               (block_q, block_k), 1))
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if seg_q is not None:
+            seg_k = seg_k_ref[pl.ds(k_start, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         p = jnp.exp(s - lse)                               # [bq, bk]
         dp = jax.lax.dot_general(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -220,9 +259,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
+def _bwd_dq_kernel_seg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       seg_q_ref, seg_k_ref, dq_ref, *, block_k: int,
+                       scale: float, causal: bool):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, block_k=block_k, scale=scale, causal=causal,
+                   seg_q_ref=seg_q_ref, seg_k_ref=seg_k_ref)
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, scale: float,
-                    causal: bool):
+                    causal: bool, seg_q_ref=None, seg_k_ref=None):
     """Grid: (B, KV, num_k_blocks, group) -- group (q heads sharing this KV
     head) is the fastest dimension, so the same dk/dv output block is
     revisited consecutively and accumulated in place (no [B,H,S,D]
@@ -236,6 +283,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     k_blk = k_ref[:].astype(jnp.float32)
     v_blk = v_ref[:].astype(jnp.float32)
+    seg_k = seg_k_ref[:] if seg_k_ref is not None else None
 
     def body(qj, carry):
         dk_acc, dv_acc = carry
@@ -255,6 +303,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      jax.lax.broadcasted_iota(jnp.int32,
                                               (block_q, block_k), 1))
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if seg_k is not None:
+            seg_q = seg_q_ref[pl.ds(q_start, block_q)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -287,7 +338,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] += dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal: bool, scale: float, res, do):
+def _bwd_dkv_kernel_seg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        seg_q_ref, seg_k_ref, dk_ref, dv_ref, *,
+                        block_q: int, scale: float, causal: bool):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, block_q=block_q, scale=scale,
+                    causal=causal, seg_q_ref=seg_q_ref,
+                    seg_k_ref=seg_k_ref)
+
+
+def _bwd_impl(causal, scale, res, do, segments=None):
     q, k, v, o, lse = res
     b, h, s, d = q.shape
     kv = k.shape[1]
@@ -297,54 +357,83 @@ def _bwd(causal: bool, scale: float, res, do):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)                # [B, H, S, 1]
 
+    dq_in_specs = [
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, s, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        pl.BlockSpec((None, None, s, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+    ]
+    dq_operands = [q, k, v, do, lse, delta]
+    if segments is None:
+        dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k,
+                                      scale=scale, causal=causal)
+    else:
+        dq_kernel = functools.partial(_bwd_dq_kernel_seg, block_k=block_k,
+                                      scale=scale, causal=causal)
+        dq_in_specs += [
+            pl.BlockSpec((None, block_q),
+                         lambda bi, hi, qi: (bi, qi)),
+            pl.BlockSpec((None, s),
+                         lambda bi, hi, qi: (bi, 0)),
+        ]
+        dq_operands += [segments, segments]
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
-                          causal=causal),
+        dq_kernel,
         grid=(b, h, s // block_q),
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q, 1),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q, 1),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((None, None, block_q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands)
 
     # Grid: (B, KV, k-blocks, group) -- group fastest so each (b, kv, ki)
     # output block is revisited consecutively and accumulated in the kernel.
+    dkv_in_specs = [
+        pl.BlockSpec((None, None, s, d),
+                     lambda bi, kvh, ki_, g, _g=group:
+                     (bi, kvh * _g + g, 0, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
+        pl.BlockSpec((None, None, s, d),
+                     lambda bi, kvh, ki_, g, _g=group:
+                     (bi, kvh * _g + g, 0, 0)),
+        pl.BlockSpec((None, None, s, 1),
+                     lambda bi, kvh, ki_, g, _g=group:
+                     (bi, kvh * _g + g, 0, 0)),
+        pl.BlockSpec((None, None, s, 1),
+                     lambda bi, kvh, ki_, g, _g=group:
+                     (bi, kvh * _g + g, 0, 0)),
+    ]
+    dkv_operands = [q, k, v, do, lse, delta]
+    if segments is None:
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                                       scale=scale, causal=causal)
+    else:
+        dkv_kernel = functools.partial(_bwd_dkv_kernel_seg,
+                                       block_q=block_q, scale=scale,
+                                       causal=causal)
+        dkv_in_specs += [
+            pl.BlockSpec((None, s),
+                         lambda bi, kvh, ki_, g: (bi, 0)),   # full q side
+            pl.BlockSpec((None, block_k),
+                         lambda bi, kvh, ki_, g: (bi, ki_)),  # k slice
+        ]
+        dkv_operands += [segments, segments]
     dk32, dv32 = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale,
-                          causal=causal),
+        dkv_kernel,
         grid=(b, kv, s // block_k, group),
-        in_specs=[
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, kvh, ki_, g, _g=group:
-                         (bi, kvh * _g + g, 0, 0)),
-            pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
-            pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
-            pl.BlockSpec((None, None, s, d),
-                         lambda bi, kvh, ki_, g, _g=group:
-                         (bi, kvh * _g + g, 0, 0)),
-            pl.BlockSpec((None, None, s, 1),
-                         lambda bi, kvh, ki_, g, _g=group:
-                         (bi, kvh * _g + g, 0, 0)),
-            pl.BlockSpec((None, None, s, 1),
-                         lambda bi, kvh, ki_, g, _g=group:
-                         (bi, kvh * _g + g, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_k, d),
                          lambda bi, kvh, ki_, g: (bi, kvh, ki_, 0)),
@@ -356,9 +445,20 @@ def _bwd(causal: bool, scale: float, res, do):
             jax.ShapeDtypeStruct((b, kv, s, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands)
 
     return dq, dk32.astype(k.dtype), dv32.astype(v.dtype)
+
+
+def _bwd(causal: bool, scale: float, res, do):
+    return _bwd_impl(causal, scale, res, do, segments=None)
+
+
+def _bwd_seg(causal: bool, scale: float, res, do):
+    *core, segments = res
+    dq, dk, dv = _bwd_impl(causal, scale, tuple(core), do,
+                           segments=segments)
+    return dq, dk, dv, None  # segment ids carry no gradient
 
 
 # ---------------------------------------------------------------------------
@@ -379,6 +479,20 @@ def _flash_fwd_rule(q, k, v, causal, scale):
 _flash.defvjp(_flash_fwd_rule, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_seg(q, k, v, segments, causal: bool, scale: float):
+    o, _ = _fwd(q, k, v, causal=causal, scale=scale, segments=segments)
+    return o
+
+
+def _flash_seg_fwd_rule(q, k, v, segments, causal, scale):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale, segments=segments)
+    return o, (q, k, v, o, lse, segments)
+
+
+_flash_seg.defvjp(_flash_seg_fwd_rule, _bwd_seg)
+
+
 def flash_attention(q: jax.Array,
                     k: jax.Array,
                     v: jax.Array,
@@ -387,20 +501,24 @@ def flash_attention(q: jax.Array,
                     segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Public entry. q: [B,S,H,D]; k,v: [B,S,KV,D]; returns [B,S,H,D].
 
-    Falls back to the XLA reference for shapes/features the kernel does not
-    cover (segment masks, non-multiple-of-128 blocks, cross-attention).
+    ``segment_ids`` ([B, S] int32; packed sequences) runs ON the kernel —
+    cross-segment scores are masked in every block. Falls back to the XLA
+    reference only for shapes the kernel does not cover (non-multiple-of-
+    128 blocks, cross-attention).
     """
     from skypilot_tpu.ops import attention as xla_attn
     s_q, s_k = q.shape[1], k.shape[1]
-    if segment_ids is not None or not _supported(q, k, s_q, s_k):
-        _warn_fallback_once(
-            'segment-masked attention' if segment_ids is not None else
-            f'shape (q={q.shape}, k={k.shape})')
+    if not _supported(q, k, s_q, s_k):
+        _warn_fallback_once(f'shape (q={q.shape}, k={k.shape})')
         return xla_attn.xla_attention(q, k, v, causal=causal,
                                       segment_ids=segment_ids)
     scale = q.shape[-1] ** -0.5
     qt = q.transpose(0, 2, 1, 3)                           # [B,H,S,D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    o = _flash(qt, kt, vt, causal, scale)
+    if segment_ids is None:
+        o = _flash(qt, kt, vt, causal, scale)
+    else:
+        o = _flash_seg(qt, kt, vt,
+                       segment_ids.astype(jnp.int32), causal, scale)
     return o.transpose(0, 2, 1, 3)

@@ -110,8 +110,14 @@ def _maybe_ship(payload: Dict[str, Any]) -> None:
             logger.debug('usage ship failed', exc_info=True)
 
     import threading
-    threading.Thread(target=ship, name='usage-ship',
-                     daemon=True).start()
+    thread = threading.Thread(target=ship, name='usage-ship',
+                              daemon=True)
+    thread.start()
+    # Short bounded join: both production call sites (CLI exit path,
+    # executor child about to os._exit) terminate right after record(),
+    # which would kill an unjoined daemon thread before it ever
+    # connects. 0.75s caps the stall a dead collector can add.
+    thread.join(timeout=0.75)
 
 
 def recent(limit: int = 100) -> list:
