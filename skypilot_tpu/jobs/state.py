@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import common_utils
+
 
 class ManagedJobStatus(enum.Enum):
     PENDING = 'PENDING'
@@ -94,18 +96,25 @@ def _db() -> sqlite3.Connection:
         );
     """)
     cols = {r['name'] for r in conn.execute('PRAGMA table_info(jobs)')}
+
+    def _add_column(ddl: str) -> None:
+        common_utils.add_column_if_missing(conn, ddl)
+
+    # Each column gated independently: DDL autocommits per statement, so a
+    # process killed mid-migration can leave any prefix of these applied.
     if 'group_name' not in cols:  # pre-existing DB from an older version
-        conn.execute('ALTER TABLE jobs ADD COLUMN group_name TEXT')
-        conn.execute('ALTER TABLE jobs ADD COLUMN group_hosts TEXT')
+        _add_column('ALTER TABLE jobs ADD COLUMN group_name TEXT')
+    if 'group_hosts' not in cols:
+        _add_column('ALTER TABLE jobs ADD COLUMN group_hosts TEXT')
     if 'controller_restarts' not in cols:
-        conn.execute('ALTER TABLE jobs ADD COLUMN controller_restarts '
-                     'INTEGER DEFAULT 0')
+        _add_column('ALTER TABLE jobs ADD COLUMN controller_restarts '
+                    'INTEGER DEFAULT 0')
     if 'workspace' not in cols:
-        conn.execute("ALTER TABLE jobs ADD COLUMN workspace TEXT "
-                     "DEFAULT 'default'")
+        _add_column("ALTER TABLE jobs ADD COLUMN workspace TEXT "
+                    "DEFAULT 'default'")
     if 'controller_claimed_at' not in cols:
-        conn.execute('ALTER TABLE jobs ADD COLUMN controller_claimed_at '
-                     'REAL')
+        _add_column('ALTER TABLE jobs ADD COLUMN controller_claimed_at '
+                    'REAL')
     conn.commit()
     _local.conn = conn
     _local.path = path

@@ -87,11 +87,13 @@ def _db() -> sqlite3.Connection:
     """)
     cols = {r['name'] for r in conn.execute('PRAGMA table_info(requests)')}
     if 'idem_key' not in cols:  # pre-existing DB from an older version
-        conn.execute('ALTER TABLE requests ADD COLUMN idem_key TEXT')
+        common_utils.add_column_if_missing(
+            conn, 'ALTER TABLE requests ADD COLUMN idem_key TEXT')
         conn.execute('CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem '
                      'ON requests (idem_key) WHERE idem_key IS NOT NULL')
     if 'workspace' not in cols:
-        conn.execute('ALTER TABLE requests ADD COLUMN workspace TEXT')
+        common_utils.add_column_if_missing(
+            conn, 'ALTER TABLE requests ADD COLUMN workspace TEXT')
     conn.commit()
     _local.conn = conn
     _local.path = path

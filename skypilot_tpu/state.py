@@ -92,8 +92,9 @@ def _db() -> sqlite3.Connection:
     """)
     cols = {r['name'] for r in conn.execute('PRAGMA table_info(clusters)')}
     if 'workspace' not in cols:  # pre-existing DB from an older version
-        conn.execute("ALTER TABLE clusters ADD COLUMN workspace TEXT "
-                     "DEFAULT 'default'")
+        common_utils.add_column_if_missing(
+            conn, "ALTER TABLE clusters ADD COLUMN workspace TEXT "
+            "DEFAULT 'default'")
     conn.commit()
     _local.conn = conn
     _local.path = path

@@ -10,11 +10,26 @@ import hashlib
 import os
 import random
 import re
+import sqlite3
 import time
 import uuid
 from typing import Any, Callable, Dict, Optional, TypeVar
 
 T = TypeVar('T')
+
+
+def add_column_if_missing(conn: sqlite3.Connection, ddl: str) -> None:
+    """Run an ``ALTER TABLE ... ADD COLUMN`` tolerating a concurrent winner.
+
+    Schema migrations run lazily from every process that opens the DB;
+    two processes can both observe the column missing before either
+    commits, and sqlite raises ``duplicate column name`` for the loser.
+    """
+    try:
+        conn.execute(ddl)
+    except sqlite3.OperationalError as e:
+        if 'duplicate column' not in str(e):
+            raise
 
 _USER_HASH_FILE = os.path.expanduser('~/.skyt/user_hash')
 CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([a-zA-Z0-9_-]*[a-zA-Z0-9])?$')
