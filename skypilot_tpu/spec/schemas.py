@@ -86,7 +86,7 @@ _STORAGE_MOUNT = {
     'properties': {
         'name': {'type': 'string'},
         'source': {'type': 'string'},
-        'store': {'type': 'string', 'enum': ['gcs', 'local']},
+        'store': {'type': 'string', 'enum': ['gcs', 's3', 'local']},
         'mode': {'type': 'string',
                  'enum': ['MOUNT', 'COPY', 'MOUNT_CACHED',
                           'mount', 'copy', 'mount_cached']},
