@@ -35,10 +35,13 @@ def _chip_peak_tflops() -> float:
     return -1.0  # unknown accelerator: caller marks the result estimated
 
 
-def _probe_accelerator(tries: int = 3, probe_timeout: float = 150.0) -> bool:
-    """Check the accelerator answers before committing this process to a
-    jax init that can HANG when the remote-TPU tunnel is down. The probe
-    runs in a killable subprocess; a few retries ride out tunnel blips."""
+def _probe_accelerator(tries: int = 6, probe_timeout: float = 150.0) -> int:
+    """Device count the accelerator backend answers with, 0 if unreachable.
+
+    Probes before committing this process to a jax init that can HANG when
+    the remote-TPU tunnel is down. The probe runs in a killable
+    subprocess; a few retries ride out tunnel blips. Init chatter can
+    precede the count on stdout, so only the last line is parsed."""
     import subprocess
     for attempt in range(tries):
         try:
@@ -46,16 +49,17 @@ def _probe_accelerator(tries: int = 3, probe_timeout: float = 150.0) -> bool:
                 [sys.executable, '-c',
                  'import jax; print(len(jax.devices()))'],
                 capture_output=True, text=True, timeout=probe_timeout)
-            if proc.returncode == 0 and proc.stdout.strip().isdigit():
-                return True
+            lines = proc.stdout.strip().splitlines()
+            if proc.returncode == 0 and lines and lines[-1].isdigit():
+                return int(lines[-1])
             detail = (proc.stderr or proc.stdout).strip()[-300:]
         except subprocess.TimeoutExpired:
             detail = f'probe hung >{probe_timeout:.0f}s (tunnel down?)'
         print(f'accelerator probe {attempt + 1}/{tries} failed: {detail}',
               file=sys.stderr)
         if attempt < tries - 1:
-            time.sleep(20)
-    return False
+            time.sleep(min(30 * (attempt + 1), 120))
+    return 0
 
 
 def main() -> int:
@@ -79,6 +83,9 @@ def main() -> int:
                         choices=[None, 'adamw', 'adafactor'])
     parser.add_argument('--param-dtype', default=None,
                         choices=[None, 'float32', 'bfloat16'])
+    parser.add_argument('--remat-policy', default=None,
+                        choices=[None, 'none', 'dots', 'save_attn',
+                                 'save_dots', 'full'])
     args = parser.parse_args()
 
     from skypilot_tpu.models.config import get_model_config
@@ -97,6 +104,8 @@ def main() -> int:
         'bfloat16' if model == 'bench-1b7' else None)
     if param_dtype:
         overrides['param_dtype'] = jnp.dtype(param_dtype)
+    if args.remat_policy:
+        overrides['remat_policy'] = args.remat_policy
     cfg = get_model_config(model, **overrides)
     optimizer = args.optimizer or (
         'adafactor' if model == 'bench-1b7' else 'adamw')

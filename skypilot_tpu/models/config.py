@@ -37,7 +37,10 @@ class ModelConfig:
     # numerics
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.bfloat16
-    # remat: 'none' | 'full' | 'dots' (checkpoint matmul outputs only)
+    # remat: 'none' | 'full' (save nothing) | 'save_attn' (save qkv +
+    # attention out: backward skips the O(S^2) attention recompute) |
+    # 'save_dots' (+ mlp hidden/out: only elementwise recomputed) |
+    # 'dots' (XLA policy: every non-batched matmul output saved)
     remat_policy: str = 'full'
     # attention impl: 'auto' (pallas on TPU, xla elsewhere) | 'xla' | 'pallas'
     attention_impl: str = 'auto'

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from skypilot_tpu.models.config import ModelConfig
 from skypilot_tpu.ops import multi_head_attention, rms_norm
@@ -158,9 +159,14 @@ def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
                      rules: LogicalAxisRules,
                      segments: Optional[jax.Array] = None) -> jax.Array:
     dt = cfg.compute_dtype
-    q = jnp.einsum('bsd,dhk->bshk', x, lp['wq'].astype(dt))
-    k = jnp.einsum('bsd,dhk->bshk', x, lp['wk'].astype(dt))
-    v = jnp.einsum('bsd,dhk->bshk', x, lp['wv'].astype(dt))
+    # checkpoint_name tags make these saveable under the selective remat
+    # policies (save_attn/save_dots) without saving everything else.
+    q = checkpoint_name(
+        jnp.einsum('bsd,dhk->bshk', x, lp['wq'].astype(dt)), 'query_proj')
+    k = checkpoint_name(
+        jnp.einsum('bsd,dhk->bshk', x, lp['wk'].astype(dt)), 'key_proj')
+    v = checkpoint_name(
+        jnp.einsum('bsd,dhk->bshk', x, lp['wv'].astype(dt)), 'value_proj')
     q = with_logical_constraint(q, ('batch', 'act_seq', 'act_heads', None),
                                 rules=rules)
     k = with_logical_constraint(k, ('batch', 'act_seq', 'act_kv_heads', None),
@@ -171,7 +177,7 @@ def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
                                segment_ids=segments,
                                impl=cfg.attention_impl)
     out = jnp.einsum('bshk,hkd->bsd', out, lp['wo'].astype(dt))
-    return out
+    return checkpoint_name(out, 'attn_out')
 
 
 def _activate(gate: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -189,7 +195,9 @@ def _mlp_block(x: jax.Array, lp: Params, cfg: ModelConfig,
     hidden = _activate(gate, cfg) * up
     hidden = with_logical_constraint(hidden, ('batch', 'act_seq', 'mlp'),
                                      rules=rules)
-    return jnp.einsum('bsf,fd->bsd', hidden, lp['wo'].astype(dt))
+    hidden = checkpoint_name(hidden, 'mlp_hidden')
+    return checkpoint_name(
+        jnp.einsum('bsf,fd->bsd', hidden, lp['wo'].astype(dt)), 'mlp_out')
 
 
 def _moe_block(x: jax.Array, lp: Params, cfg: ModelConfig,
@@ -239,10 +247,28 @@ def _decoder_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
 
 
 def _remat_policy(cfg: ModelConfig):
+    """Remat spectrum, cheapest memory -> cheapest recompute.
+
+    * ``full`` — save nothing; backward re-runs the whole layer
+      (~4/3x model FLOPs => ~75% MFU ceiling).
+    * ``save_attn`` — save q/k/v projections + attention output, so the
+      backward never re-runs the (O(S^2)) attention kernel or the qkv/out
+      matmuls; the MLP is still recomputed. ~4 activations/layer saved.
+    * ``save_dots`` — additionally save the MLP hidden + output (MaxText's
+      'minimal': only elementwise ops recomputed). Most memory.
+    * ``dots`` — XLA-level policy: every non-batched dot output saved.
+    """
     if cfg.remat_policy == 'none':
         return None
     if cfg.remat_policy == 'dots':
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if cfg.remat_policy == 'save_attn':
+        return jax.checkpoint_policies.save_only_these_names(
+            'query_proj', 'key_proj', 'value_proj', 'attn_out')
+    if cfg.remat_policy == 'save_dots':
+        return jax.checkpoint_policies.save_only_these_names(
+            'query_proj', 'key_proj', 'value_proj', 'attn_out',
+            'mlp_hidden', 'mlp_out')
     if cfg.remat_policy == 'full':
         return jax.checkpoint_policies.nothing_saveable
     raise ValueError(f'Unknown remat policy {cfg.remat_policy!r}')

@@ -116,3 +116,34 @@ def test_big_configs_shape_only(name):
     out = jax.eval_shape(
         lambda p, t: llama.forward(p, t, cfg), params, tokens)
     assert out.shape == (1, 128, cfg.vocab_size)
+
+
+@pytest.mark.parametrize('policy', ['full', 'dots', 'save_attn',
+                                    'save_dots'])
+def test_remat_policies_match_loss_and_grads(policy):
+    """Every remat policy computes identical loss and gradients — remat
+    trades recompute for memory, never numerics (checkpoint_name tags in
+    the layer body feed save_only_these_names)."""
+
+    def loss_fn(params, cfg, tokens):
+        logits = llama.forward(params, tokens, cfg)
+        targets = jnp.roll(tokens, -1, axis=1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(
+            jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+    ref_cfg = get_model_config('tiny', attention_impl='xla',
+                               remat_policy='none')
+    params = llama.init_params(jax.random.key(0), ref_cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                ref_cfg.vocab_size)
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params, ref_cfg,
+                                                      tokens)
+
+    cfg = get_model_config('tiny', attention_impl='xla',
+                           remat_policy=policy)
+    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        grads, ref_grads)
