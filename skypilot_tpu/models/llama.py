@@ -225,9 +225,11 @@ def _moe_block(x: jax.Array, lp: Params, cfg: ModelConfig,
     hidden = with_logical_constraint(hidden,
                                      ('expert', 'batch', 'act_seq', 'mlp'),
                                      rules=rules)
+    # Same tag names as the dense MLP so save_dots covers MoE too.
+    hidden = checkpoint_name(hidden, 'mlp_hidden')
     expert_out = jnp.einsum('ebsf,efd->ebsd', hidden, lp['wo'].astype(dt))
     out = jnp.einsum('ebsd,bse->bsd', expert_out, combine.astype(dt))
-    return out
+    return checkpoint_name(out, 'mlp_out')
 
 
 def _decoder_layer(x: jax.Array, lp: Params, cfg: ModelConfig,

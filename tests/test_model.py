@@ -118,9 +118,10 @@ def test_big_configs_shape_only(name):
     assert out.shape == (1, 128, cfg.vocab_size)
 
 
+@pytest.mark.parametrize('model', ['tiny', 'tiny-moe'])
 @pytest.mark.parametrize('policy', ['full', 'dots', 'save_attn',
                                     'save_dots'])
-def test_remat_policies_match_loss_and_grads(policy):
+def test_remat_policies_match_loss_and_grads(policy, model):
     """Every remat policy computes identical loss and gradients — remat
     trades recompute for memory, never numerics (checkpoint_name tags in
     the layer body feed save_only_these_names)."""
@@ -132,7 +133,7 @@ def test_remat_policies_match_loss_and_grads(policy):
         return -jnp.mean(
             jnp.take_along_axis(logp, targets[..., None], axis=-1))
 
-    ref_cfg = get_model_config('tiny', attention_impl='xla',
+    ref_cfg = get_model_config(model, attention_impl='xla',
                                remat_policy='none')
     params = llama.init_params(jax.random.key(0), ref_cfg)
     tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
@@ -140,7 +141,7 @@ def test_remat_policies_match_loss_and_grads(policy):
     ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params, ref_cfg,
                                                       tokens)
 
-    cfg = get_model_config('tiny', attention_impl='xla',
+    cfg = get_model_config(model, attention_impl='xla',
                            remat_policy=policy)
     loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
