@@ -64,7 +64,8 @@ class ContinuousBatchingEngine:
                  checkpoint_dir: Optional[str] = None,
                  max_slots: int = 4,
                  max_len: Optional[int] = None,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 quantize: bool = False) -> None:
         self.cfg = cfg or get_model_config(model)
         self.tokenizer = ByteTokenizer()
         self.max_slots = max_slots
@@ -85,6 +86,8 @@ class ContinuousBatchingEngine:
         else:
             self.params = llama.init_params(jax.random.key(seed),
                                             self.cfg)
+        from skypilot_tpu.models.quant import maybe_quantize
+        self.params = maybe_quantize(self.params, quantize)
         self.cache = decode_lib.init_cache(self.cfg, max_slots,
                                            self.max_len)
         self._slots: List[Optional[_Request]] = [None] * max_slots

@@ -43,7 +43,8 @@ class InferenceEngine:
                  params: Optional[Any] = None,
                  checkpoint_dir: Optional[str] = None,
                  seed: int = 0,
-                 max_batch: int = 8) -> None:
+                 max_batch: int = 8,
+                 quantize: bool = False) -> None:
         self.cfg = cfg or get_model_config(model)
         self.tokenizer = ByteTokenizer()
         if self.tokenizer.vocab_size > self.cfg.vocab_size:
@@ -64,6 +65,10 @@ class InferenceEngine:
                            'params' in restored else restored)
         else:
             self.params = llama.init_params(jax.random.key(seed), self.cfg)
+        # W8A8 int8: halves weight HBM traffic on the decode path and
+        # rides the MXU's 2x int8 throughput (models/quant.py).
+        from skypilot_tpu.models.quant import maybe_quantize
+        self.params = maybe_quantize(self.params, quantize)
         self.stats: Dict[str, float] = {
             'requests': 0, 'tokens_generated': 0, 'decode_seconds': 0.0}
 

@@ -102,6 +102,9 @@ def main(argv=None) -> int:
     parser.add_argument('--max-len', type=int, default=None,
                         help='KV-cache length per slot (continuous '
                              'engine; default: the model context).')
+    parser.add_argument('--quantize', action='store_true',
+                        help='int8 W8A8 weights (half the decode HBM '
+                             'traffic, 2x MXU int8 rate).')
     args = parser.parse_args(argv)
     if args.engine == 'continuous':
         from skypilot_tpu.inference.continuous import (
@@ -110,12 +113,14 @@ def main(argv=None) -> int:
             args.model,
             checkpoint_dir=args.checkpoint_dir,
             max_slots=args.max_batch,
-            max_len=args.max_len)
+            max_len=args.max_len,
+            quantize=args.quantize)
         engine.generate_text('warmup', max_new_tokens=8)
     else:
         engine = InferenceEngine(args.model,
                                  checkpoint_dir=args.checkpoint_dir,
-                                 max_batch=args.max_batch)
+                                 max_batch=args.max_batch,
+                                 quantize=args.quantize)
         # Warm the compile cache so the first real request (and the
         # serve stack's readiness window) isn't paying XLA compile time.
         engine.generate_text(['warmup'], max_new_tokens=8)

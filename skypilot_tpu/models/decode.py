@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models.config import ModelConfig
 from skypilot_tpu.models.llama import apply_rope, rope_table
+from skypilot_tpu.models.quant import QTensor, weight_einsum
 from skypilot_tpu.ops import rms_norm
 
 Params = Dict[str, Any]
@@ -68,7 +69,11 @@ def _lm_head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     x = rms_norm(x, params['final_norm']['scale'], cfg.norm_eps)
     if cfg.tie_embeddings:
         head = params['embed']['embedding'].astype(cfg.compute_dtype).T
+    elif isinstance(params['lm_head']['w'], QTensor):
+        return weight_einsum('bsd,dv->bsv', x, params['lm_head']['w'],
+                             jnp.float32)
     else:
+        # fp path: bf16 operands, f32 accumulate (MXU-rate matmul).
         head = params['lm_head']['w'].astype(cfg.compute_dtype)
     return jnp.einsum('bsd,dv->bsv', x, head,
                       preferred_element_type=jnp.float32)
@@ -83,10 +88,10 @@ def _mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
         return _moe_block(x, lp['moe'], cfg, DEFAULT_RULES)
     mlp = lp['mlp']
     from skypilot_tpu.models.llama import _activate
-    gate = jnp.einsum('bsd,df->bsf', x, mlp['wi_gate'].astype(dt))
-    up = jnp.einsum('bsd,df->bsf', x, mlp['wi_up'].astype(dt))
-    return jnp.einsum('bsf,fd->bsd', _activate(gate, cfg) * up,
-                      mlp['wo'].astype(dt))
+    gate = weight_einsum('bsd,df->bsf', x, mlp['wi_gate'], dt)
+    up = weight_einsum('bsd,df->bsf', x, mlp['wi_up'], dt)
+    return weight_einsum('bsf,fd->bsd', _activate(gate, cfg) * up,
+                         mlp['wo'], dt)
 
 
 # ---------------------------------------------------------------------------
@@ -109,16 +114,15 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
     def layer(carry, lp):
         x = carry
         h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
-        q = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wq'].astype(dt))
-        k = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wk'].astype(dt))
-        v = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wv'].astype(dt))
+        q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
+        k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
+        v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         from skypilot_tpu.ops import multi_head_attention
         attn = multi_head_attention(q, k, v, causal=True,
                                     impl=cfg.attention_impl)
-        x = x + jnp.einsum('bshk,hkd->bsd', attn,
-                           lp['attn']['wo'].astype(dt))
+        x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)
         # cache entries for this layer, padded to max_len
@@ -168,9 +172,9 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
         x = carry
         lp, k_cache, v_cache = scanned
         h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
-        q = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wq'].astype(dt))
-        k = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wk'].astype(dt))
-        v = jnp.einsum('bsd,dhk->bshk', h, lp['attn']['wv'].astype(dt))
+        q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
+        k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
+        v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         # scatter the new K/V row into the cache at position `length`
@@ -189,8 +193,7 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
         probs = jax.nn.softmax(scores, axis=-1).astype(dt)
         attn = jnp.einsum('bhgqt,bthk->bqhgk', probs, v_cache)
         attn = attn.reshape(b, 1, cfg.n_heads, cfg.resolved_head_dim)
-        x = x + jnp.einsum('bshk,hkd->bsd', attn,
-                           lp['attn']['wo'].astype(dt))
+        x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)
         return x, (k_cache, v_cache)

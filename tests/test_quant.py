@@ -1,0 +1,129 @@
+"""Int8 W8A8 quantization for serving (models/quant.py).
+
+Parity frame: the reference serves through external int8-capable
+engines (vLLM/JetStream); here quantization is in-tree and must (a) be
+numerically sound, (b) halve weight bytes, (c) drop into both decode
+engines unchanged."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode as decode_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.config import get_model_config
+from skypilot_tpu.models.quant import (QTensor, param_bytes,
+                                       quantize_params, quantize_tensor,
+                                       weight_einsum)
+
+
+def test_quantize_tensor_roundtrip_error():
+    w = jax.random.normal(jax.random.key(0), (64, 32))
+    qt = quantize_tensor(w, (0,))
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 32)
+    deq = qt.astype(jnp.float32)
+    # per-channel absmax symmetric: worst-case error is scale/2
+    err = jnp.abs(deq - w)
+    assert float(err.max()) <= float(qt.scale.max()) / 2 + 1e-6
+
+
+def test_weight_einsum_matches_fp_einsum():
+    x = jax.random.normal(jax.random.key(1), (2, 4, 64))
+    w = jax.random.normal(jax.random.key(2), (64, 8, 16))
+    qt = quantize_tensor(w, (0,))
+    ref = jnp.einsum('bsd,dhk->bshk', x, w)
+    out = weight_einsum('bsd,dhk->bshk', x, qt, jnp.float32)
+    # int8 x int8 with per-token + per-channel scales: ~1% relative
+    rel = jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref)
+    assert float(rel) < 0.02, float(rel)
+    # fp arrays pass straight through
+    np.testing.assert_allclose(
+        np.asarray(weight_einsum('bsd,dhk->bshk', x, w, jnp.float32)),
+        np.asarray(ref), rtol=1e-5)
+
+
+def test_weight_einsum_rejects_unscalable_spec():
+    w = jax.random.normal(jax.random.key(3), (4, 64, 8))
+    qt = quantize_tensor(w, (1,))
+    x = jax.random.normal(jax.random.key(4), (2, 4, 64))
+    with pytest.raises(AssertionError):
+        weight_einsum('bsd,edf->ebsf', x, qt, jnp.float32)
+
+
+def test_quantize_params_halves_bytes_and_keeps_structure():
+    cfg = get_model_config('tiny')
+    params = llama.init_params(jax.random.key(0), cfg)
+    qparams = quantize_params(params)
+    # Embeddings/norms stay fp; layer projections shrink ~4x (f32->int8),
+    # so totals drop well below the fp32 baseline.
+    assert param_bytes(qparams) < 0.55 * param_bytes(params)
+    attn = qparams['layers']['attn']
+    assert isinstance(attn['wq'], QTensor)
+    # stacked per-layer scales: leading dim == n_layers (lax.scan slices)
+    assert attn['wq'].scale.shape[0] == cfg.n_layers
+    assert isinstance(qparams['embed']['embedding'], jax.Array)
+
+
+def test_moe_experts_stay_fp_by_default():
+    """The MoE dispatch can't ride the int8 kernel (suffix rule), so
+    experts quantize only on explicit opt-in."""
+    cfg = get_model_config('tiny-moe')
+    params = llama.init_params(jax.random.key(0), cfg)
+    default = quantize_params(params)
+    assert isinstance(default['layers']['moe']['wi_gate'], jax.Array)
+    assert isinstance(default['layers']['attn']['wq'], QTensor)
+    opted = quantize_params(params, quantize_moe=True)
+    assert isinstance(opted['layers']['moe']['wi_gate'], QTensor)
+    assert isinstance(opted['layers']['moe']['router'], jax.Array)
+
+
+@pytest.mark.parametrize('model', ['tiny', 'tiny-moe'])
+def test_quantized_generate_close_to_fp(model):
+    cfg = get_model_config(model, attention_impl='xla')
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jnp.array([[5, 6, 7, 8, 9, 10, 11, 12]], jnp.int32)
+    lengths = jnp.array([8], jnp.int32)
+    fp_out, fp_len = decode_lib.generate(params, tokens, lengths, cfg,
+                                         max_new_tokens=8)
+    q_out, q_len = decode_lib.generate(quantize_params(params), tokens,
+                                       lengths, cfg, max_new_tokens=8)
+    # Greedy decode from the same random init: quantization noise may
+    # eventually diverge a path, but the first tokens must agree.
+    assert np.asarray(fp_out)[0, 0] == np.asarray(q_out)[0, 0]
+    assert fp_out.shape == q_out.shape
+
+
+def test_quantized_prefill_logits_close():
+    cfg = get_model_config('tiny', attention_impl='xla')
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                cfg.vocab_size)
+    lengths = jnp.array([16, 16], jnp.int32)
+    fp_logits, _ = decode_lib.prefill(params, tokens, lengths, cfg, 24)
+    q_logits, _ = decode_lib.prefill(quantize_params(params), tokens,
+                                     lengths, cfg, 24)
+    fp = np.asarray(fp_logits, np.float32)
+    q = np.asarray(q_logits, np.float32)
+    cos = (fp * q).sum() / (np.linalg.norm(fp) * np.linalg.norm(q))
+    assert cos > 0.99, cos
+    # top-1 agreement on the last-token logits
+    assert (fp.argmax(-1) == q.argmax(-1)).mean() >= 0.5
+
+
+def test_engine_quantize_flag():
+    from skypilot_tpu.inference.engine import InferenceEngine
+    eng = InferenceEngine('tiny', quantize=True)
+    out = eng.generate_text(['hello'], max_new_tokens=4)
+    assert len(out) == 1 and isinstance(out[0], str)
+
+
+def test_continuous_engine_quantize_flag():
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    eng = ContinuousBatchingEngine('tiny', max_slots=2, max_len=64,
+                                   quantize=True)
+    try:
+        out = eng.generate_ids([5, 6, 7], max_new_tokens=4)
+        assert len(out) <= 4
+    finally:
+        eng.shutdown()
