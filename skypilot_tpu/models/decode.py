@@ -162,11 +162,12 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
     x = _embed(params, tokens[:, None], cfg)                 # [B, 1, D]
 
     max_len = cache.max_len
-    # one-hot over cache positions for scatter + mask for attention
+    # one-hot over cache positions for the scatter; valid rows for
+    # attention = positions 0..length inclusive (the just-written row).
     pos_iota = jnp.arange(max_len)                           # [T]
     insert = ((pos_iota[None, :] == cache.lengths[:, None]) &
               active[:, None])                               # [B, T]
-    valid = (pos_iota[None, :] <= cache.lengths[:, None])    # [B, T]
+    n_valid = cache.lengths + 1                              # [B]
 
     def layer(carry, scanned):
         x = carry
@@ -181,18 +182,14 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
         ins = insert[:, :, None, None].astype(dt)            # [B,T,1,1]
         k_cache = k_cache * (1 - ins) + k * ins
         v_cache = v_cache * (1 - ins) + v * ins
-        # grouped-query attention over the cache (fp32 softmax stats)
-        groups = cfg.n_heads // cfg.n_kv_heads
-        qg = q.reshape(b, 1, cfg.n_kv_heads, groups,
-                       cfg.resolved_head_dim)
-        scores = jnp.einsum('bqhgk,bthk->bhgqt', qg.astype(jnp.float32),
-                            k_cache.astype(jnp.float32))
-        scores = scores * (cfg.resolved_head_dim ** -0.5)
-        scores = jnp.where(valid[:, None, None, None, :], scores,
-                           -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum('bhgqt,bthk->bqhgk', probs, v_cache)
-        attn = attn.reshape(b, 1, cfg.n_heads, cfg.resolved_head_dim)
+        # Grouped-query attention over the cache: the length-aware
+        # Pallas kernel reads only ceil(len/block) cache blocks per
+        # sequence (ops/pallas/decode_attention.py); the XLA fallback
+        # masks the full cache.
+        from skypilot_tpu.ops.pallas.decode_attention import (
+            decode_attention)
+        attn = decode_attention(q, k_cache, v_cache, n_valid,
+                                impl=cfg.attention_impl)
         x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)

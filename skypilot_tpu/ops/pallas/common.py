@@ -1,0 +1,34 @@
+"""Shared helpers for the Pallas TPU kernels (flash + decode attention)."""
+from __future__ import annotations
+
+import jax
+
+NEG_INF = -1e30
+
+_warned_fallbacks: set = set()
+
+
+def interpret_mode() -> bool:
+    """CPU (tests): run kernels in the Pallas interpreter."""
+    return jax.default_backend() == 'cpu'
+
+
+def warn_fallback_once(kernel: str, reason: str) -> None:
+    """The silent-fallback trap: dropping off a kernel onto the XLA
+    reference is a real MFU/HBM cliff — say so, once per reason."""
+    key = (kernel, reason)
+    if key in _warned_fallbacks:
+        return
+    _warned_fallbacks.add(key)
+    from skypilot_tpu.utils import log
+    log.init_logger(__name__).warning(
+        '%s: falling back to the XLA reference for %s '
+        '(expect higher HBM traffic / lower throughput)', kernel, reason)
+
+
+def fit_block(total: int, preferred: int) -> int:
+    """Largest power-of-two-reduced block <= preferred that divides total."""
+    b = min(preferred, total)
+    while total % b:
+        b //= 2
+    return max(b, 1)

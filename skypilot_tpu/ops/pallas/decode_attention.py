@@ -1,0 +1,193 @@
+"""Length-aware single-token decode attention as a Pallas TPU kernel.
+
+Decode attention is pure HBM bandwidth: one query row per sequence
+attends over a [T, D] KV cache whose tail is mostly empty (T = max_len,
+valid rows = the sequence's current length). The XLA reference reads the
+WHOLE cache every generated token; this kernel makes the KV-block grid
+index a function of the scalar-prefetched lengths, clamping out-of-range
+blocks to the last valid one — consecutive grid steps that map to the
+same block elide the DMA, so HBM traffic scales with ceil(len/block)
+instead of T. At low cache fill (early decode, long max_new_tokens)
+that is a multi-x bandwidth saving per token.
+
+GQA runs natively: the grid is (batch, kv_head, kv_block) and the query
+block holds that kv head's whole group of query heads, so K/V are never
+repeated in HBM (same trick as flash_attention.py).
+
+Parity frame: the reference serves through engines whose decode kernels
+do exactly this (vLLM paged attention, JetStream); here it is in-tree,
+behind the same ``attention_impl`` switch as training flash attention.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from skypilot_tpu.ops.pallas.common import (NEG_INF, fit_block,
+                                            interpret_mode,
+                                            warn_fallback_once)
+
+DEFAULT_BLOCK_K = 512
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
+                   num_blocks: int):
+    """Grid (B, KVH, NT). q_ref [G, D]; k/v_ref [block_k, D]; o_ref [G, D].
+
+    Flash-style running max/sum across the (sequential, innermost) kv
+    block axis; scratch persists between grid steps. Blocks at or past
+    the sequence's length are skipped (their index map aliased them to
+    an already-resident block, so they also cost no DMA).
+    """
+    bi = pl.program_id(0)
+    ti = pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n_valid = n_valid_ref[bi]
+
+    @pl.when(ti * block_k < n_valid)
+    def _block():
+        q = q_ref[:].astype(jnp.float32) * scale            # [G, D]
+        k = k_ref[:].astype(jnp.float32)                    # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [G, bk]
+        pos = (ti * block_k +
+               jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(pos < n_valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ti == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[:] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                   n_valid: jax.Array, scale: float,
+                   block_k: int) -> jax.Array:
+    """q [B, KVH, G, D]; caches [B, T, KVH, D]; n_valid [B] -> [B, KVH, G, D]."""
+    b, kvh, g, d = q.shape
+    t = k_cache.shape[1]
+    nt = t // block_k
+    grid = (b, kvh, nt)
+
+    def kv_index(bi, hi, ti, n_valid):
+        # Clamp to the last block that holds valid rows: skipped steps
+        # re-map to an already-fetched block => the DMA is elided.
+        last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
+        return (bi, jnp.minimum(ti, last), hi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, g, d),
+                         lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, block_k, None, d), kv_index),
+            pl.BlockSpec((None, block_k, None, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((None, None, g, d),
+                               lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),    # running max
+            pltpu.VMEM((g, 1), jnp.float32),    # running sum
+            pltpu.VMEM((g, d), jnp.float32),    # output accumulator
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               scale=scale, num_blocks=nt)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        interpret=interpret_mode(),
+    )(n_valid, q, k_cache, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference + public wrapper
+# ---------------------------------------------------------------------------
+
+def xla_decode_attention(q: jax.Array, k_cache: jax.Array,
+                         v_cache: jax.Array,
+                         n_valid: jax.Array) -> jax.Array:
+    """Reference path: full-cache masked attention (reads all T rows).
+
+    q [B, 1, H, D]; caches [B, T, KVH, D]; n_valid [B] -> [B, 1, H, D].
+    """
+    b, _, h, d = q.shape
+    kvh = k_cache.shape[2]
+    g = h // kvh
+    qg = q.reshape(b, 1, kvh, g, d)
+    scores = jnp.einsum('bqhgk,bthk->bhgqt', qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * (d ** -0.5)
+    t = k_cache.shape[1]
+    valid = jnp.arange(t)[None, :] < n_valid[:, None]        # [B, T]
+    scores = jnp.where(valid[:, None, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    attn = jnp.einsum('bhgqt,bthk->bqhgk', probs, v_cache)
+    return attn.reshape(b, 1, h, d)
+
+
+def _supported(d: int, t: int, block_k: int) -> bool:
+    if t % block_k:
+        return False           # a partial tail block would go unattended
+    if interpret_mode():
+        return True            # interpreter has no tiling constraints
+    return d % 128 == 0 and block_k % 128 == 0
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     n_valid: jax.Array, *,
+                     impl: str = 'auto',
+                     block_k: Optional[int] = None) -> jax.Array:
+    """Single-token attention over a KV cache with per-sequence lengths.
+
+    q: [B, 1, H, D] (the new token's queries); k_cache/v_cache:
+    [B, T, KVH, D]; n_valid: [B] int32 count of valid cache rows.
+    Returns [B, 1, H, D]. ``impl``: 'auto' (kernel when tileable) |
+    'pallas' (kernel, XLA fallback WITH a warning when untileable) |
+    'xla'.
+    """
+    b, one, h, d = q.shape
+    assert one == 1, 'decode_attention takes a single query position'
+    t = k_cache.shape[1]
+    kvh = k_cache.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    bk = fit_block(t, block_k or DEFAULT_BLOCK_K)
+    supported = _supported(d, t, bk)
+    if impl == 'xla' or not supported:
+        if impl == 'pallas' and not supported:
+            warn_fallback_once(
+                'decode attention',
+                f'shape (T={t}, D={d}, block_k={bk})')
+        return xla_decode_attention(q, k_cache, v_cache, n_valid)
+    qg = q.reshape(b, 1, kvh, h // kvh, d)[:, 0]             # [B,KVH,G,D]
+    out = _pallas_decode(qg, k_cache, v_cache,
+                         n_valid.astype(jnp.int32), d ** -0.5, bk)
+    return out.reshape(b, 1, h, d)

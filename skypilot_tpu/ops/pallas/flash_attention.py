@@ -20,40 +20,24 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_warned_fallbacks: set = set()
+from skypilot_tpu.ops.pallas.common import (NEG_INF, fit_block,
+                                            interpret_mode,
+                                            warn_fallback_once)
 
 
 def _warn_fallback_once(reason: str) -> None:
-    """The silent-fallback trap: dropping off the flash kernel onto the
-    O(S^2) XLA reference is a real MFU/HBM cliff at long seq — say so,
-    once per distinct reason."""
-    if reason in _warned_fallbacks:
-        return
-    _warned_fallbacks.add(reason)
-    from skypilot_tpu.utils import log
-    log.init_logger(__name__).warning(
-        'flash attention: falling back to the XLA reference for %s '
-        '(O(S^2) memory; expect lower MFU at long sequence lengths)',
-        reason)
+    warn_fallback_once('flash attention', reason)
 
-NEG_INF = -1e30
 
 def _interpret() -> bool:
-    # CPU (tests): run kernels in the Pallas interpreter.
-    return jax.default_backend() == 'cpu'
+    return interpret_mode()
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
 def _block_sizes(s: int) -> Tuple[int, int]:
-    bq = min(DEFAULT_BLOCK_Q, s)
-    bk = min(DEFAULT_BLOCK_K, s)
-    while s % bq:
-        bq //= 2
-    while s % bk:
-        bk //= 2
-    return max(bq, 1), max(bk, 1)
+    return fit_block(s, DEFAULT_BLOCK_Q), fit_block(s, DEFAULT_BLOCK_K)
 
 
 def _supported(q: jax.Array, k: jax.Array, s_q: int, s_k: int) -> bool:

@@ -120,6 +120,12 @@ def main(argv=None) -> int:
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--param-dtype', default=None,
                         choices=[None, 'float32', 'bfloat16'])
+    parser.add_argument('--remat-policy', default=None,
+                        choices=[None, 'none', 'dots', 'save_attn',
+                                 'save_dots', 'full'],
+                        help='activation remat: full = least memory; '
+                             'save_attn/save_dots trade memory for '
+                             'less recompute (models/config.py).')
     args = parser.parse_args(argv)
 
     maybe_init_distributed()
@@ -133,6 +139,8 @@ def main(argv=None) -> int:
     overrides = {}
     if args.param_dtype:
         overrides['param_dtype'] = jnp.dtype(args.param_dtype)
+    if args.remat_policy:
+        overrides['remat_policy'] = args.remat_policy
     cfg = get_model_config(args.model, **overrides)
     seq = min(args.seq or 1024, cfg.max_seq_len)
     hp = TrainHParams(learning_rate=args.learning_rate,
