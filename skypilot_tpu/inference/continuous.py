@@ -65,7 +65,8 @@ class ContinuousBatchingEngine:
                  max_slots: int = 4,
                  max_len: Optional[int] = None,
                  seed: int = 0,
-                 quantize: bool = False) -> None:
+                 quantize: bool = False,
+                 mesh: Optional[Any] = None) -> None:
         self.cfg = cfg or get_model_config(model)
         self.tokenizer = ByteTokenizer()
         self.max_slots = max_slots
@@ -86,6 +87,9 @@ class ContinuousBatchingEngine:
         else:
             self.params = llama.init_params(jax.random.key(seed),
                                             self.cfg)
+        # Mesh placement first, then quantization (see engine.py note).
+        from skypilot_tpu.inference.sharding import prepare_engine
+        self.params, self.cfg = prepare_engine(self.params, self.cfg, mesh)
         from skypilot_tpu.models.quant import maybe_quantize
         self.params = maybe_quantize(self.params, quantize)
         self.cache = decode_lib.init_cache(self.cfg, max_slots,

@@ -44,7 +44,8 @@ class InferenceEngine:
                  checkpoint_dir: Optional[str] = None,
                  seed: int = 0,
                  max_batch: int = 8,
-                 quantize: bool = False) -> None:
+                 quantize: bool = False,
+                 mesh: Optional[Any] = None) -> None:
         self.cfg = cfg or get_model_config(model)
         self.tokenizer = ByteTokenizer()
         if self.tokenizer.vocab_size > self.cfg.vocab_size:
@@ -65,6 +66,13 @@ class InferenceEngine:
                            'params' in restored else restored)
         else:
             self.params = llama.init_params(jax.random.key(seed), self.cfg)
+        # Tensor-parallel serving: 'tensor=N' shards params over the
+        # mesh (inference/sharding.py) — how flagship models span a slice.
+        # Mesh placement FIRST: quantizing sharded params propagates the
+        # shardings onto the int8/scale leaves, while device_put on an
+        # already-quantized tree would choke on the squeezed scale axes.
+        from skypilot_tpu.inference.sharding import prepare_engine
+        self.params, self.cfg = prepare_engine(self.params, self.cfg, mesh)
         # W8A8 int8: halves weight HBM traffic on the decode path and
         # rides the MXU's 2x int8 throughput (models/quant.py).
         from skypilot_tpu.models.quant import maybe_quantize

@@ -105,6 +105,10 @@ def main(argv=None) -> int:
     parser.add_argument('--quantize', action='store_true',
                         help='int8 W8A8 weights (half the decode HBM '
                              'traffic, 2x MXU int8 rate).')
+    parser.add_argument('--mesh', default=None,
+                        help="tensor-parallel serving, e.g. 'tensor=8' "
+                             '(shards params over the local chips; how '
+                             'flagship models span a slice).')
     args = parser.parse_args(argv)
     if args.engine == 'continuous':
         from skypilot_tpu.inference.continuous import (
@@ -114,13 +118,15 @@ def main(argv=None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             max_slots=args.max_batch,
             max_len=args.max_len,
-            quantize=args.quantize)
+            quantize=args.quantize,
+            mesh=args.mesh)
         engine.generate_text('warmup', max_new_tokens=8)
     else:
         engine = InferenceEngine(args.model,
                                  checkpoint_dir=args.checkpoint_dir,
                                  max_batch=args.max_batch,
-                                 quantize=args.quantize)
+                                 quantize=args.quantize,
+                                 mesh=args.mesh)
         # Warm the compile cache so the first real request (and the
         # serve stack's readiness window) isn't paying XLA compile time.
         engine.generate_text(['warmup'], max_new_tokens=8)

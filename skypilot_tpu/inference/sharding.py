@@ -1,0 +1,86 @@
+"""Multi-chip (tensor-parallel) serving: shard engine params over a mesh.
+
+An 8B model in bf16 (~16 GB) does not fit one v5e chip — serving it
+needs the slice, the way the reference's engines do tensor parallelism
+(vLLM/sglang ``tensor_parallel_size``, SURVEY §2.9 TP row). Here the
+engines reuse the training stack's logical-axis rules: heads/ffn/expert
+dims shard over the ``tensor`` axis, everything else replicates, and
+GSPMD propagates those shardings through the prefill/decode programs
+(per-head attention partitions cleanly; activations stay sharded on the
+head axis between the qkv and output projections).
+
+Note: under a multi-device mesh the decode path uses the XLA attention
+reference — the Pallas decode kernel is an opaque primitive to the
+GSPMD partitioner and would force cache all-gathers until it is wrapped
+in shard_map (future work; the kernel stays the single-chip fast path).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import jax
+from jax.sharding import Mesh
+
+from skypilot_tpu.models.config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+def build_inference_mesh(spec: Union[str, Mesh]) -> Mesh:
+    """'tensor=4' / 'tensor=4,data=2'-style spec (or a ready Mesh).
+
+    Unspecified axes stay at 1 and the mesh takes exactly the devices
+    the spec multiplies out to — unlike training, leftover chips must
+    NOT be absorbed into fsdp (weight-gathering per matmul is the wrong
+    default for a latency-bound decode loop)."""
+    if isinstance(spec, Mesh):
+        return spec
+    if not spec or not spec.strip():
+        raise ValueError(
+            "empty mesh spec: pass e.g. 'tensor=4' (or omit the mesh "
+            'argument for single-device serving)')
+    import math
+    from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+    from skypilot_tpu.train.pretrain import parse_mesh
+    axes = {'data': 1, 'stage': 1, 'fsdp': 1, 'seq': 1, 'expert': 1,
+            'tensor': 1}
+    parsed = parse_mesh(spec)
+    unknown = set(parsed) - set(axes)
+    if unknown:
+        raise ValueError(
+            f'unknown mesh axis {sorted(unknown)} in {spec!r}; valid '
+            f'axes: {sorted(axes)}')
+    axes.update(parsed)
+    wildcards = [a for a, v in axes.items() if v == -1]
+    if wildcards:  # 'tensor=-1': absorb every local chip
+        if len(wildcards) > 1:
+            raise ValueError(f'mesh {spec!r}: only one axis may be -1')
+        fixed = math.prod(v for v in axes.values() if v != -1)
+        axes[wildcards[0]] = max(len(jax.devices()) // fixed, 1)
+    n = math.prod(axes.values())
+    if n < 1 or n > len(jax.devices()):
+        raise ValueError(
+            f'mesh {spec!r} needs {n} devices, have {len(jax.devices())}')
+    return build_mesh(MeshConfig(**axes), devices=jax.devices()[:n])
+
+
+def shard_inference_params(params: Params, mesh: Mesh,
+                           cfg: ModelConfig) -> Params:
+    """Place params on the mesh under the model's logical-axes rules."""
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel.sharding import shard_params_pytree
+    shardings = shard_params_pytree(mesh, llama.param_logical_axes(cfg))
+    return jax.device_put(params, shardings)
+
+
+def prepare_engine(params: Params, cfg: ModelConfig,
+                   mesh: Optional[Union[str, Mesh]]):
+    """(params, cfg) ready for the engine: sharded + XLA attention under
+    a multi-device mesh, unchanged otherwise."""
+    if mesh is None:
+        return params, cfg
+    mesh = build_inference_mesh(mesh)
+    if mesh.size > 1:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, attention_impl='xla')
+    return shard_inference_params(params, mesh, cfg), cfg
