@@ -62,6 +62,81 @@ def _probe_accelerator(tries: int = 6, probe_timeout: float = 150.0) -> int:
     return 0
 
 
+def _decode_bench(args, model: str, on_accel: bool) -> int:
+    """Serving throughput: steady-state decode tokens/sec (single device).
+
+    `generate` runs prefill + decode in one program, so timing one call
+    would fold the prompt pass into the 'decode' number. Instead two
+    generate lengths (N and 2N) are timed and DIFFERENCED — the prefill
+    cost cancels exactly and the rate is the pure autoregressive loop
+    (KV-cache attention + weight reads). `--quantize` and
+    `--attention-impl` expose the int8 / Pallas-kernel A/B axes.
+    """
+    import numpy as np
+
+    from skypilot_tpu.models import decode as decode_lib
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models.config import get_model_config
+    from skypilot_tpu.models.quant import maybe_quantize
+
+    overrides = {}
+    param_dtype = args.param_dtype or ('bfloat16' if on_accel else None)
+    if param_dtype:
+        overrides['param_dtype'] = jnp.dtype(param_dtype)
+    if args.attention_impl:
+        overrides['attention_impl'] = args.attention_impl
+    cfg = get_model_config(model, **overrides)
+    batch = args.batch or (8 if on_accel else 2)
+    new_tokens = args.steps or (256 if on_accel else 16)
+    prompt_len = args.seq or (128 if on_accel else 16)
+    # prompt + the longer (2N) run must stay inside the model context.
+    prompt_len = min(prompt_len, max(cfg.max_seq_len - 2 * new_tokens, 8))
+    new_tokens = min(new_tokens, max((cfg.max_seq_len - prompt_len) // 2, 1))
+
+    params = maybe_quantize(
+        llama.init_params(jax.random.key(0), cfg), args.quantize)
+    tokens = jax.random.randint(jax.random.key(1), (batch, prompt_len),
+                                0, cfg.vocab_size)
+    lengths = jnp.full((batch,), prompt_len, jnp.int32)
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        out, _ = decode_lib.generate(params, tokens, lengths, cfg,
+                                     max_new_tokens=n, temperature=0.0)
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    warmups = args.warmup or 1
+    for _ in range(warmups):                 # compile both programs
+        run(new_tokens)
+        run(2 * new_tokens)
+    t_n = run(new_tokens)
+    t_2n = run(2 * new_tokens)
+    decode_elapsed = max(t_2n - t_n, 1e-9)   # prefill cancels
+    toks_per_sec = batch * new_tokens / decode_elapsed
+    result = {
+        # Runs on ONE device (no mesh): labeled as such regardless of
+        # how many chips the host exposes.
+        'metric': f'decode_toks_per_sec_{model}'
+                  f'{"_int8" if args.quantize else ""}'
+                  f'_{jax.default_backend()}1',
+        'value': round(toks_per_sec, 1),
+        'unit': 'tokens/sec',
+        'vs_baseline': 0,     # no reference decode number to compare
+        'detail': {
+            'batch': batch, 'prompt_len': prompt_len,
+            'new_tokens': new_tokens, 'quantized': args.quantize,
+            'attention_impl': cfg.attention_impl,
+            'param_dtype': str(jnp.dtype(param_dtype or jnp.float32)),
+            'devices_used': 1,
+            'per_seq_toks_per_sec': round(toks_per_sec / batch, 1),
+            'prefill_plus_n_seconds': round(t_n, 4),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def main() -> int:
     if not _probe_accelerator():
         print(json.dumps({
@@ -77,8 +152,11 @@ def main() -> int:
     parser.add_argument('--model', default=None)
     parser.add_argument('--batch', type=int, default=None)
     parser.add_argument('--seq', type=int, default=None)
-    parser.add_argument('--steps', type=int, default=20)
-    parser.add_argument('--warmup', type=int, default=5)
+    parser.add_argument('--steps', type=int, default=None,
+                        help='train: timed steps (default 20); decode: '
+                             'generated tokens (default 256 on accel).')
+    parser.add_argument('--warmup', type=int, default=None,
+                        help='warmup runs (default: train 5, decode 1).')
     parser.add_argument('--optimizer', default=None,
                         choices=[None, 'adamw', 'adafactor'])
     parser.add_argument('--param-dtype', default=None,
@@ -86,19 +164,35 @@ def main() -> int:
     parser.add_argument('--remat-policy', default=None,
                         choices=[None, 'none', 'dots', 'save_attn',
                                  'save_dots', 'full'])
+    parser.add_argument('--mode', default='train',
+                        choices=['train', 'decode'],
+                        help='train = MFU of the sharded train step '
+                             '(the driver metric); decode = serving '
+                             'tokens/sec of the KV-cache decode loop.')
+    parser.add_argument('--quantize', action='store_true',
+                        help='decode mode: int8 W8A8 weights.')
+    parser.add_argument('--attention-impl', default=None,
+                        choices=[None, 'auto', 'xla', 'pallas'],
+                        help='decode mode: attention implementation.')
     args = parser.parse_args()
+
+    on_accel = jax.default_backend() not in ('cpu',)
+    # Flagship-class single-chip default: ~1.7B llama-style with
+    # Adafactor + bf16 params + full remat (the largest class that fits
+    # one 16GB v5e chip; the 8B flagship is the multi-chip config).
+    model = args.model or ('bench-1b7' if on_accel else 'tiny')
+
+    if args.mode == 'decode':
+        return _decode_bench(args, model, on_accel)
+    args.steps = args.steps or 20
+    args.warmup = args.warmup or 5
 
     from skypilot_tpu.models.config import get_model_config
     from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
     from skypilot_tpu.train.step import (TrainHParams, create_train_state,
                                          make_train_step, state_shardings)
 
-    on_accel = jax.default_backend() not in ('cpu',)
     n_dev = len(jax.devices())
-    # Flagship-class single-chip default: ~1.7B llama-style with
-    # Adafactor + bf16 params + full remat (the largest class that fits
-    # one 16GB v5e chip; the 8B flagship is the multi-chip config).
-    model = args.model or ('bench-1b7' if on_accel else 'tiny')
     overrides = {}
     param_dtype = args.param_dtype or (
         'bfloat16' if model == 'bench-1b7' else None)
