@@ -89,7 +89,8 @@ class ContinuousBatchingEngine:
                                             self.cfg)
         # Mesh placement first, then quantization (see engine.py note).
         from skypilot_tpu.inference.sharding import prepare_engine
-        self.params, self.cfg = prepare_engine(self.params, self.cfg, mesh)
+        self.params, self.cfg, self._mesh = prepare_engine(
+            self.params, self.cfg, mesh)
         from skypilot_tpu.models.quant import maybe_quantize
         self.params = maybe_quantize(self.params, quantize)
         self.cache = decode_lib.init_cache(self.cfg, max_slots,
@@ -148,6 +149,11 @@ class ContinuousBatchingEngine:
     # -- serving loop ---------------------------------------------------
 
     def _loop(self) -> None:
+        from skypilot_tpu.inference.sharding import mesh_context
+        with mesh_context(self._mesh):
+            self._loop_body()
+
+    def _loop_body(self) -> None:
         while not self._stop.is_set():
             self._admit()
             active_mask = np.array([r is not None for r in self._slots])

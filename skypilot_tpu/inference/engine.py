@@ -72,7 +72,8 @@ class InferenceEngine:
         # shardings onto the int8/scale leaves, while device_put on an
         # already-quantized tree would choke on the squeezed scale axes.
         from skypilot_tpu.inference.sharding import prepare_engine
-        self.params, self.cfg = prepare_engine(self.params, self.cfg, mesh)
+        self.params, self.cfg, self._mesh = prepare_engine(
+            self.params, self.cfg, mesh)
         # W8A8 int8: halves weight HBM traffic on the decode path and
         # rides the MXU's 2x int8 throughput (models/quant.py).
         from skypilot_tpu.models.quant import maybe_quantize
@@ -105,7 +106,8 @@ class InferenceEngine:
             tokens[i, :len(p)] = p
         pad_lengths = np.concatenate(
             [lengths, np.ones(batch_b - b, np.int32)])
-        with self._lock:
+        from skypilot_tpu.inference.sharding import mesh_context
+        with self._lock, mesh_context(self._mesh):
             t0 = time.perf_counter()
             generated, gen_lengths = decode_lib.generate(
                 self.params, jnp.asarray(tokens),

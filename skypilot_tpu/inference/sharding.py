@@ -9,10 +9,12 @@ GSPMD propagates those shardings through the prefill/decode programs
 (per-head attention partitions cleanly; activations stay sharded on the
 head axis between the qkv and output projections).
 
-Note: under a multi-device mesh the decode path uses the XLA attention
-reference — the Pallas decode kernel is an opaque primitive to the
-GSPMD partitioner and would force cache all-gathers until it is wrapped
-in shard_map (future work; the kernel stays the single-chip fast path).
+Attention under a multi-device mesh: PREFILL pins to the XLA reference
+(its einsums partition cleanly; a bare pallas_call is opaque to the
+GSPMD partitioner), while DECODE keeps the length-aware Pallas kernel —
+it runs per-kv-head-shard via shard_map over the tensor axis, which the
+engines enable by wrapping their compute calls in
+``jax.sharding.set_mesh`` (see ``mesh_context``).
 """
 from __future__ import annotations
 
@@ -75,12 +77,34 @@ def shard_inference_params(params: Params, mesh: Mesh,
 
 def prepare_engine(params: Params, cfg: ModelConfig,
                    mesh: Optional[Union[str, Mesh]]):
-    """(params, cfg) ready for the engine: sharded + XLA attention under
-    a multi-device mesh, unchanged otherwise."""
+    """(params, cfg, mesh) ready for the engine.
+
+    Under a multi-device mesh: params shard; PREFILL attention pins to
+    the XLA path (GSPMD partitions its einsums; the flash kernel is an
+    opaque primitive there); DECODE attention defaults to 'auto' — the
+    decode kernel runs per-kv-head-shard via shard_map when the engine
+    wraps its calls in ``jax.sharding.set_mesh(mesh)``."""
     if mesh is None:
-        return params, cfg
+        return params, cfg, None
     mesh = build_inference_mesh(mesh)
     if mesh.size > 1:
         import dataclasses
-        cfg = dataclasses.replace(cfg, attention_impl='xla')
-    return shard_inference_params(params, mesh, cfg), cfg
+        # Prefill must take the GSPMD-partitionable XLA path; decode
+        # defaults to the shard_map kernel but an explicit user setting
+        # (e.g. 'xla' to rule the kernel out while debugging) wins.
+        cfg = dataclasses.replace(
+            cfg, attention_impl='xla',
+            decode_attention_impl=cfg.decode_attention_impl or 'auto')
+    return shard_inference_params(params, mesh, cfg), cfg, mesh
+
+
+def mesh_context(mesh: Optional[Mesh]):
+    """``set_mesh(mesh)`` (or a no-op) for wrapping engine compute calls.
+
+    Puts the mesh in thread-local context so the decode path can see it
+    (``get_abstract_mesh`` inside jit) and route the attention kernel
+    through shard_map."""
+    import contextlib
+    if mesh is None:
+        return contextlib.nullcontext()
+    return jax.sharding.set_mesh(mesh)

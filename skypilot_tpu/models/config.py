@@ -44,6 +44,10 @@ class ModelConfig:
     remat_policy: str = 'full'
     # attention impl: 'auto' (pallas on TPU, xla elsewhere) | 'xla' | 'pallas'
     attention_impl: str = 'auto'
+    # decode-side override (None = follow attention_impl). Lets TP serving
+    # keep prefill on the (GSPMD-partitionable) XLA path while the decode
+    # kernel runs per-shard under shard_map (inference/sharding.py).
+    decode_attention_impl: Optional[str] = None
     # Embedding lookup as one-hot matmul: rides the MXU and partitions
     # cleanly when the table is vocab/embed-sharded (a gather forces XLA
     # into involuntary full rematerialization of the table).

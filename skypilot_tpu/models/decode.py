@@ -188,8 +188,9 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
         # masks the full cache.
         from skypilot_tpu.ops.pallas.decode_attention import (
             decode_attention)
-        attn = decode_attention(q, k_cache, v_cache, n_valid,
-                                impl=cfg.attention_impl)
+        attn = decode_attention(
+            q, k_cache, v_cache, n_valid,
+            impl=cfg.decode_attention_impl or cfg.attention_impl)
         x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)

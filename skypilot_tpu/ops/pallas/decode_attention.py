@@ -181,6 +181,24 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     assert h % kvh == 0, (h, kvh)
     bk = fit_block(t, block_k or DEFAULT_BLOCK_K)
     supported = _supported(d, t, bk)
+
+    # Under an ambient mesh with a tensor axis (TP serving), the kernel
+    # runs per-shard via shard_map: the grid is already per-kv-head, so
+    # splitting kv heads over 'tensor' needs no collectives. Otherwise a
+    # multi-device mesh falls back to the (GSPMD-partitionable) XLA path
+    # — a bare pallas_call is opaque to the partitioner.
+    from skypilot_tpu.parallel.sharding import _abstract_or_ambient_mesh
+    mesh = _abstract_or_ambient_mesh()
+    tp = int(mesh.shape.get('tensor', 1)) if mesh is not None else 1
+    multi_device = mesh is not None and mesh.size > 1
+    if multi_device and (tp <= 1 or kvh % tp or not supported):
+        if impl == 'pallas':
+            warn_fallback_once(
+                'decode attention',
+                f'mesh {dict(mesh.shape)} (kv_heads={kvh} not divisible '
+                f'by tensor={tp}, or untileable shape)')
+        return xla_decode_attention(q, k_cache, v_cache, n_valid)
+
     if impl == 'xla' or not supported:
         if impl == 'pallas' and not supported:
             warn_fallback_once(
@@ -188,6 +206,26 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                 f'shape (T={t}, D={d}, block_k={bk})')
         return xla_decode_attention(q, k_cache, v_cache, n_valid)
     qg = q.reshape(b, 1, kvh, h // kvh, d)[:, 0]             # [B,KVH,G,D]
-    out = _pallas_decode(qg, k_cache, v_cache,
-                         n_valid.astype(jnp.int32), d ** -0.5, bk)
+    n_valid = n_valid.astype(jnp.int32)
+    if multi_device:
+        from jax.sharding import PartitionSpec as P
+        fn = functools.partial(_pallas_decode, scale=d ** -0.5,
+                               block_k=bk)
+        out = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(None, 'tensor', None, None),   # q: kv-head shard
+                      P(None, None, 'tensor', None),   # k cache
+                      P(None, None, 'tensor', None),   # v cache
+                      P()),                            # lengths replicate
+            out_specs=P(None, 'tensor', None, None),
+            # Manualize ONLY the tensor axis: other mesh axes (e.g. a
+            # data axis sharding the request batch) stay in auto mode
+            # instead of being force-replicated inside the manual region.
+            axis_names={'tensor'},
+            # pallas_call's out_shape carries no varying-mesh-axes info;
+            # skip the vma check (the kernel is per-shard pure).
+            check_vma=False,
+        )(qg, k_cache, v_cache, n_valid)
+    else:
+        out = _pallas_decode(qg, k_cache, v_cache, n_valid, d ** -0.5, bk)
     return out.reshape(b, 1, h, d)

@@ -45,6 +45,31 @@ def test_mesh_plus_quantize_compose():
     assert len(wq.q.sharding.device_set) == 2
 
 
+def test_tp_decode_uses_pallas_kernel_via_shard_map(monkeypatch):
+    """Under use_mesh + TP, the decode kernel runs per-kv-head-shard via
+    shard_map (not the XLA fallback)."""
+    from skypilot_tpu.ops.pallas import decode_attention as da
+    calls = {'n': 0}
+    real = da._pallas_decode
+
+    def counting(*a, **k):
+        calls['n'] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(da, '_pallas_decode', counting)
+    cfg = get_model_config('tiny', n_heads=4, n_kv_heads=2,
+                           compute_dtype=jnp.float32)
+    base = InferenceEngine(cfg=cfg, seed=0)
+    out_base = base.generate_ids([[5, 6, 7, 8]], max_new_tokens=4)
+    tp = InferenceEngine(cfg=cfg, seed=0, mesh='tensor=2')
+    assert tp.cfg.attention_impl == 'xla'          # prefill: GSPMD path
+    assert tp.cfg.decode_attention_impl == 'auto'  # decode: kernel
+    calls['n'] = 0
+    out_tp = tp.generate_ids([[5, 6, 7, 8]], max_new_tokens=4)
+    assert out_base == out_tp
+    assert calls['n'] > 0, 'decode kernel never ran under the TP mesh'
+
+
 def test_bad_mesh_specs_rejected():
     import pytest
     with pytest.raises(ValueError, match='empty mesh spec'):
@@ -58,5 +83,5 @@ def test_bad_mesh_specs_rejected():
 def test_prepare_engine_none_is_identity():
     cfg = get_model_config('tiny')
     params = llama.init_params(jax.random.key(0), cfg)
-    p2, c2 = prepare_engine(params, cfg, None)
-    assert p2 is params and c2 is cfg
+    p2, c2, m2 = prepare_engine(params, cfg, None)
+    assert p2 is params and c2 is cfg and m2 is None
