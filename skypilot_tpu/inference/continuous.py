@@ -66,8 +66,12 @@ class ContinuousBatchingEngine:
                  max_len: Optional[int] = None,
                  seed: int = 0,
                  quantize: bool = False,
+                 quantize_kv: bool = False,
                  mesh: Optional[Any] = None) -> None:
         self.cfg = cfg or get_model_config(model)
+        if quantize_kv:
+            from skypilot_tpu.models.config import with_int8_kv_cache
+            self.cfg = with_int8_kv_cache(self.cfg)
         self.tokenizer = ByteTokenizer()
         self.max_slots = max_slots
         # Cache length defaults to the model's full context (the cache
@@ -135,12 +139,17 @@ class ContinuousBatchingEngine:
                                            jnp.asarray(tokens), lengths,
                                            self.cfg, self.max_len)
         # Splice the single-sequence cache into the shared one at `slot`.
+        def splice(big, one):
+            return jax.lax.dynamic_update_slice_in_dim(big, one, slot,
+                                                       axis=1)
         self.cache = decode_lib.KVCache(
-            k=jax.lax.dynamic_update_slice_in_dim(self.cache.k, small.k,
-                                                  slot, axis=1),
-            v=jax.lax.dynamic_update_slice_in_dim(self.cache.v, small.v,
-                                                  slot, axis=1),
-            lengths=self.cache.lengths.at[slot].set(lengths[0]))
+            k=splice(self.cache.k, small.k),
+            v=splice(self.cache.v, small.v),
+            lengths=self.cache.lengths.at[slot].set(lengths[0]),
+            k_scale=(splice(self.cache.k_scale, small.k_scale)
+                     if self.cache.quantized else None),
+            v_scale=(splice(self.cache.v_scale, small.v_scale)
+                     if self.cache.quantized else None))
         self._last_logits = self._last_logits.at[slot].set(
             logits[0].astype(jnp.float32))
         self._rngs[slot] = jax.random.key(request.seed)

@@ -45,8 +45,14 @@ class InferenceEngine:
                  seed: int = 0,
                  max_batch: int = 8,
                  quantize: bool = False,
+                 quantize_kv: bool = False,
                  mesh: Optional[Any] = None) -> None:
         self.cfg = cfg or get_model_config(model)
+        if quantize_kv:
+            # int8 KV cache: half the cache memory (2x context/slots per
+            # chip); the decode kernel dequantizes in-VMEM.
+            from skypilot_tpu.models.config import with_int8_kv_cache
+            self.cfg = with_int8_kv_cache(self.cfg)
         self.tokenizer = ByteTokenizer()
         if self.tokenizer.vocab_size > self.cfg.vocab_size:
             raise ValueError(

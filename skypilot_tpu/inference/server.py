@@ -105,6 +105,9 @@ def main(argv=None) -> int:
     parser.add_argument('--quantize', action='store_true',
                         help='int8 W8A8 weights (half the decode HBM '
                              'traffic, 2x MXU int8 rate).')
+    parser.add_argument('--quantize-kv', action='store_true',
+                        help='int8 KV cache (half the cache memory -> '
+                             '2x context/slots; in-kernel dequant).')
     parser.add_argument('--mesh', default=None,
                         help="tensor-parallel serving, e.g. 'tensor=8' "
                              '(shards params over the local chips; how '
@@ -119,6 +122,7 @@ def main(argv=None) -> int:
             max_slots=args.max_batch,
             max_len=args.max_len,
             quantize=args.quantize,
+            quantize_kv=args.quantize_kv,
             mesh=args.mesh)
         engine.generate_text('warmup', max_new_tokens=8)
     else:
@@ -126,6 +130,7 @@ def main(argv=None) -> int:
                                  checkpoint_dir=args.checkpoint_dir,
                                  max_batch=args.max_batch,
                                  quantize=args.quantize,
+                                 quantize_kv=args.quantize_kv,
                                  mesh=args.mesh)
         # Warm the compile cache so the first real request (and the
         # serve stack's readiness window) isn't paying XLA compile time.

@@ -48,6 +48,10 @@ class ModelConfig:
     # keep prefill on the (GSPMD-partitionable) XLA path while the decode
     # kernel runs per-shard under shard_map (inference/sharding.py).
     decode_attention_impl: Optional[str] = None
+    # KV cache storage: 'compute' (= compute_dtype) | 'int8' (per-row
+    # scales: half the cache memory -> 2x context/slots per chip, and the
+    # decode kernel dequantizes in-VMEM so the cache read stream halves).
+    kv_cache_dtype: str = 'compute'
     # Embedding lookup as one-hot matmul: rides the MXU and partitions
     # cleanly when the table is vocab/embed-sharded (a gather forces XLA
     # into involuntary full rematerialization of the table).
@@ -165,6 +169,11 @@ BENCH_700M = _register(ModelConfig(
 BENCH_1B7 = _register(ModelConfig(
     name='bench-1b7', vocab_size=32_000, d_model=2560, n_layers=22,
     n_heads=20, n_kv_heads=4, d_ff=6912, max_seq_len=2048))
+
+
+def with_int8_kv_cache(cfg: ModelConfig) -> ModelConfig:
+    """Engine helper: the int8-KV-cache variant of a config."""
+    return dataclasses.replace(cfg, kv_cache_dtype='int8')
 
 
 def get_model_config(name: str, **overrides) -> ModelConfig:

@@ -38,22 +38,53 @@ Params = Dict[str, Any]
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    """Stacked-layer KV cache + per-sequence lengths."""
+    """Stacked-layer KV cache + per-sequence lengths.
+
+    With ``cfg.kv_cache_dtype == 'int8'`` the k/v arrays store int8 and
+    ``k_scale``/``v_scale`` hold per-row (position x kv-head) fp32
+    scales — half the cache memory, dequantized in-kernel on read.
+    """
     k: jax.Array        # [L, B, max_len, kv_heads, head_dim]
     v: jax.Array        # [L, B, max_len, kv_heads, head_dim]
     lengths: jax.Array  # [B] int32: number of valid positions per sequence
+    k_scale: Optional[jax.Array] = None   # [L, B, max_len, kv_heads] f32
+    v_scale: Optional[jax.Array] = None
 
     @property
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 over the trailing head_dim axis."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
+    if cfg.kv_cache_dtype not in ('compute', 'int8'):
+        raise ValueError(
+            f"kv_cache_dtype must be 'compute' or 'int8', got "
+            f'{cfg.kv_cache_dtype!r}')
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads,
              cfg.resolved_head_dim)
+    lengths = jnp.zeros((batch,), jnp.int32)
+    if cfg.kv_cache_dtype == 'int8':
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       lengths=lengths,
+                       k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                       v_scale=jnp.zeros(shape[:-1], jnp.float32))
     dt = cfg.compute_dtype
     return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
-                   lengths=jnp.zeros((batch,), jnp.int32))
+                   lengths=lengths)
 
 
 def _embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -127,13 +158,25 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
         x = x + _mlp(h, lp, cfg)
         # cache entries for this layer, padded to max_len
         pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        if cfg.kv_cache_dtype == 'int8':
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            return x, (jnp.pad(k_q, pad), jnp.pad(v_q, pad),
+                       jnp.pad(k_s, pad[:-1]), jnp.pad(v_s, pad[:-1]))
         return x, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-    x, (k_cache, v_cache) = jax.lax.scan(layer, x, params['layers'])
+    if cfg.kv_cache_dtype == 'int8':
+        x, (k_cache, v_cache, k_scale, v_scale) = jax.lax.scan(
+            layer, x, params['layers'])
+        cache = KVCache(k=k_cache, v=v_cache, lengths=lengths,
+                        k_scale=k_scale, v_scale=v_scale)
+    else:
+        x, (k_cache, v_cache) = jax.lax.scan(layer, x, params['layers'])
+        cache = KVCache(k=k_cache, v=v_cache, lengths=lengths)
     logits = _lm_head(params, x, cfg)               # [B, S, V]
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [B, V]
-    return last, KVCache(k=k_cache, v=v_cache, lengths=lengths)
+    return last, cache
 
 
 # ---------------------------------------------------------------------------
@@ -169,9 +212,15 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
               active[:, None])                               # [B, T]
     n_valid = cache.lengths + 1                              # [B]
 
+    quantized = cache.quantized
+
     def layer(carry, scanned):
         x = carry
-        lp, k_cache, v_cache = scanned
+        if quantized:
+            lp, k_cache, v_cache, k_scale, v_scale = scanned
+        else:
+            lp, k_cache, v_cache = scanned
+            k_scale = v_scale = None
         h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
         q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
         k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
@@ -179,9 +228,17 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         # scatter the new K/V row into the cache at position `length`
-        ins = insert[:, :, None, None].astype(dt)            # [B,T,1,1]
-        k_cache = k_cache * (1 - ins) + k * ins
-        v_cache = v_cache * (1 - ins) + v * ins
+        ins4 = insert[:, :, None, None]                      # [B,T,1,1]
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            k_cache = jnp.where(ins4, k_q, k_cache)
+            v_cache = jnp.where(ins4, v_q, v_cache)
+            k_scale = jnp.where(insert[:, :, None], k_s, k_scale)
+            v_scale = jnp.where(insert[:, :, None], v_s, v_scale)
+        else:
+            k_cache = jnp.where(ins4, k.astype(k_cache.dtype), k_cache)
+            v_cache = jnp.where(ins4, v.astype(v_cache.dtype), v_cache)
         # Grouped-query attention over the cache: the length-aware
         # Pallas kernel reads only ceil(len/block) cache blocks per
         # sequence (ops/pallas/decode_attention.py); the XLA fallback
@@ -190,17 +247,27 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
             decode_attention)
         attn = decode_attention(
             q, k_cache, v_cache, n_valid,
+            k_scale=k_scale, v_scale=v_scale,
             impl=cfg.decode_attention_impl or cfg.attention_impl)
         x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)
+        if quantized:
+            return x, (k_cache, v_cache, k_scale, v_scale)
         return x, (k_cache, v_cache)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params['layers'], cache.k, cache.v))
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params['layers'], cache.k, cache.v,
+                       cache.k_scale, cache.v_scale))
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params['layers'], cache.k, cache.v))
+        ks_new = vs_new = None
     logits = _lm_head(params, x, cfg)[:, 0]                  # [B, V]
     new_cache = KVCache(k=k_new, v=v_new,
-                        lengths=cache.lengths + active.astype(jnp.int32))
+                        lengths=cache.lengths + active.astype(jnp.int32),
+                        k_scale=ks_new, v_scale=vs_new)
     return logits, new_cache
 
 

@@ -41,13 +41,15 @@ DEFAULT_BLOCK_K = 512
 
 def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
-                   num_blocks: int):
+                   num_blocks: int, ks_ref=None, vs_ref=None):
     """Grid (B, KVH, NT). q_ref [G, D]; k/v_ref [block_k, D]; o_ref [G, D].
 
     Flash-style running max/sum across the (sequential, innermost) kv
     block axis; scratch persists between grid steps. Blocks at or past
     the sequence's length are skipped (their index map aliased them to
-    an already-resident block, so they also cost no DMA).
+    an already-resident block, so they also cost no DMA). With
+    ``ks_ref``/``vs_ref`` ([block_k] per-row scales) the cache is int8
+    and dequantizes here in VMEM — the HBM stream stays int8.
     """
     bi = pl.program_id(0)
     ti = pl.program_id(2)
@@ -64,6 +66,8 @@ def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
     def _block():
         q = q_ref[:].astype(jnp.float32) * scale            # [G, D]
         k = k_ref[:].astype(jnp.float32)                    # [bk, D]
+        if ks_ref is not None:
+            k = k * ks_ref[:][:, None]
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [G, bk]
@@ -76,8 +80,12 @@ def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
         corr = jnp.exp(m_prev - m_new)
         m_ref[...] = m_new
         l_ref[...] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        if vs_ref is not None:
+            v = v_ref[:].astype(jnp.float32) * vs_ref[:][:, None]
+        else:
+            v = v_ref[:]
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[:],
+            p.astype(v.dtype), v,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -87,10 +95,21 @@ def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[:] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(n_valid_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, block_k: int,
+                         scale: float, num_blocks: int):
+    _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, block_k=block_k, scale=scale,
+                   num_blocks=num_blocks, ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                   n_valid: jax.Array, scale: float,
-                   block_k: int) -> jax.Array:
-    """q [B, KVH, G, D]; caches [B, T, KVH, D]; n_valid [B] -> [B, KVH, G, D]."""
+                   n_valid: jax.Array, scale: float, block_k: int,
+                   k_scale: Optional[jax.Array] = None,
+                   v_scale: Optional[jax.Array] = None) -> jax.Array:
+    """q [B, KVH, G, D]; caches [B, T, KVH, D] (+ optional [B, KVH, T]
+    int8 row scales, T minor for lane tiling); n_valid [B] ->
+    [B, KVH, G, D]."""
     b, kvh, g, d = q.shape
     t = k_cache.shape[1]
     nt = t // block_k
@@ -102,15 +121,34 @@ def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
         return (bi, jnp.minimum(ti, last), hi, 0)
 
+    def scale_index(bi, hi, ti, n_valid):
+        last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
+        return (bi, hi, jnp.minimum(ti, last))
+
+    in_specs = [
+        pl.BlockSpec((None, None, g, d),
+                     lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, block_k, None, d), kv_index),
+        pl.BlockSpec((None, block_k, None, d), kv_index),
+    ]
+    operands = [q, k_cache, v_cache]
+    if k_scale is not None:
+        # Scales arrive [B, KVH, T]: T minor-most so the lane dim is
+        # tiled in block_k multiples (Mosaic rejects a squeezed minor
+        # dim; same convention as flash_attention's segment refs).
+        in_specs += [pl.BlockSpec((None, None, block_k), scale_index),
+                     pl.BlockSpec((None, None, block_k), scale_index)]
+        operands += [k_scale, v_scale]
+        kernel = functools.partial(_decode_kernel_quant, block_k=block_k,
+                                   scale=scale, num_blocks=nt)
+    else:
+        kernel = functools.partial(_decode_kernel, block_k=block_k,
+                                   scale=scale, num_blocks=nt)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, g, d),
-                         lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, block_k, None, d), kv_index),
-            pl.BlockSpec((None, block_k, None, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, g, d),
                                lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
         scratch_shapes=[
@@ -119,14 +157,13 @@ def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pltpu.VMEM((g, d), jnp.float32),    # output accumulator
         ],
     )
-    kernel = functools.partial(_decode_kernel, block_k=block_k,
-                               scale=scale, num_blocks=nt)
+    out_dtype = q.dtype
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), out_dtype),
         interpret=interpret_mode(),
-    )(n_valid, q, k_cache, v_cache)
+    )(n_valid, *operands)
 
 
 # ---------------------------------------------------------------------------
@@ -135,14 +172,21 @@ def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def xla_decode_attention(q: jax.Array, k_cache: jax.Array,
                          v_cache: jax.Array,
-                         n_valid: jax.Array) -> jax.Array:
+                         n_valid: jax.Array,
+                         k_scale: Optional[jax.Array] = None,
+                         v_scale: Optional[jax.Array] = None) -> jax.Array:
     """Reference path: full-cache masked attention (reads all T rows).
 
     q [B, 1, H, D]; caches [B, T, KVH, D]; n_valid [B] -> [B, 1, H, D].
+    ``k_scale``/``v_scale`` ([B, T, KVH]) dequantize an int8 cache.
     """
     b, _, h, d = q.shape
     kvh = k_cache.shape[2]
     g = h // kvh
+    if k_scale is not None:
+        k_cache = k_cache.astype(jnp.float32) * k_scale[..., None]
+        v_cache = (v_cache.astype(jnp.float32) *
+                   v_scale[..., None]).astype(q.dtype)
     qg = q.reshape(b, 1, kvh, g, d)
     scores = jnp.einsum('bqhgk,bthk->bhgqt', qg.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * (d ** -0.5)
@@ -164,12 +208,16 @@ def _supported(d: int, t: int, block_k: int) -> bool:
 
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      n_valid: jax.Array, *,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
                      impl: str = 'auto',
                      block_k: Optional[int] = None) -> jax.Array:
     """Single-token attention over a KV cache with per-sequence lengths.
 
     q: [B, 1, H, D] (the new token's queries); k_cache/v_cache:
-    [B, T, KVH, D]; n_valid: [B] int32 count of valid cache rows.
+    [B, T, KVH, D]; n_valid: [B] int32 count of valid cache rows;
+    ``k_scale``/``v_scale``: [B, T, KVH] per-row scales of an int8
+    cache (dequantized in-kernel, so the HBM stream stays int8).
     Returns [B, 1, H, D]. ``impl``: 'auto' (kernel when tileable) |
     'pallas' (kernel, XLA fallback WITH a warning when untileable) |
     'xla'.
@@ -197,26 +245,40 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                 'decode attention',
                 f'mesh {dict(mesh.shape)} (kv_heads={kvh} not divisible '
                 f'by tensor={tp}, or untileable shape)')
-        return xla_decode_attention(q, k_cache, v_cache, n_valid)
+        return xla_decode_attention(q, k_cache, v_cache, n_valid,
+                                    k_scale, v_scale)
 
     if impl == 'xla' or not supported:
         if impl == 'pallas' and not supported:
             warn_fallback_once(
                 'decode attention',
                 f'shape (T={t}, D={d}, block_k={bk})')
-        return xla_decode_attention(q, k_cache, v_cache, n_valid)
+        return xla_decode_attention(q, k_cache, v_cache, n_valid,
+                                    k_scale, v_scale)
     qg = q.reshape(b, 1, kvh, h // kvh, d)[:, 0]             # [B,KVH,G,D]
     n_valid = n_valid.astype(jnp.int32)
+    if k_scale is not None:
+        # Kernel layout: [B, KVH, T] (T minor-most for lane tiling).
+        k_scale = k_scale.transpose(0, 2, 1)
+        v_scale = v_scale.transpose(0, 2, 1)
     if multi_device:
         from jax.sharding import PartitionSpec as P
-        fn = functools.partial(_pallas_decode, scale=d ** -0.5,
-                               block_k=bk)
+
+        def fn(qg_, k_, v_, nv_, ks_=None, vs_=None):
+            return _pallas_decode(qg_, k_, v_, nv_, d ** -0.5, bk,
+                                  ks_, vs_)
+
+        in_specs = [P(None, 'tensor', None, None),   # q: kv-head shard
+                    P(None, None, 'tensor', None),   # k cache
+                    P(None, None, 'tensor', None),   # v cache
+                    P()]                             # lengths replicate
+        operands = [qg, k_cache, v_cache, n_valid]
+        if k_scale is not None:
+            in_specs += [P(None, 'tensor', None), P(None, 'tensor', None)]
+            operands += [k_scale, v_scale]
         out = jax.shard_map(
             fn, mesh=mesh,
-            in_specs=(P(None, 'tensor', None, None),   # q: kv-head shard
-                      P(None, None, 'tensor', None),   # k cache
-                      P(None, None, 'tensor', None),   # v cache
-                      P()),                            # lengths replicate
+            in_specs=tuple(in_specs),
             out_specs=P(None, 'tensor', None, None),
             # Manualize ONLY the tensor axis: other mesh axes (e.g. a
             # data axis sharding the request batch) stay in auto mode
@@ -225,7 +287,8 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             # pallas_call's out_shape carries no varying-mesh-axes info;
             # skip the vma check (the kernel is per-shard pure).
             check_vma=False,
-        )(qg, k_cache, v_cache, n_valid)
+        )(*operands)
     else:
-        out = _pallas_decode(qg, k_cache, v_cache, n_valid, d ** -0.5, bk)
+        out = _pallas_decode(qg, k_cache, v_cache, n_valid, d ** -0.5, bk,
+                             k_scale, v_scale)
     return out.reshape(b, 1, h, d)
