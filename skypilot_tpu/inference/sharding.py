@@ -9,12 +9,14 @@ GSPMD propagates those shardings through the prefill/decode programs
 (per-head attention partitions cleanly; activations stay sharded on the
 head axis between the qkv and output projections).
 
-Attention under a multi-device mesh: PREFILL pins to the XLA reference
-(its einsums partition cleanly; a bare pallas_call is opaque to the
-GSPMD partitioner), while DECODE keeps the length-aware Pallas kernel —
-it runs per-kv-head-shard via shard_map over the tensor axis, which the
-engines enable by wrapping their compute calls in
-``jax.sharding.set_mesh`` (see ``mesh_context``).
+Attention under a multi-device mesh: both phases keep their kernels by
+shard_mapping over the tensor axis (heads are embarrassingly parallel)
+— prefill splits the flash kernel per head shard
+(``models/decode._prefill_attention``), decode splits the length-aware
+cache kernel per kv-head shard. The engines enable this by wrapping
+their compute calls in ``jax.sharding.set_mesh`` (see
+``mesh_context``); non-dividing head counts fall back to the
+GSPMD-partitionable XLA reference.
 """
 from __future__ import annotations
 
@@ -79,21 +81,22 @@ def prepare_engine(params: Params, cfg: ModelConfig,
                    mesh: Optional[Union[str, Mesh]]):
     """(params, cfg, mesh) ready for the engine.
 
-    Under a multi-device mesh: params shard; PREFILL attention pins to
-    the XLA path (GSPMD partitions its einsums; the flash kernel is an
-    opaque primitive there); DECODE attention defaults to 'auto' — the
-    decode kernel runs per-kv-head-shard via shard_map when the engine
-    wraps its calls in ``jax.sharding.set_mesh(mesh)``."""
+    Under a multi-device mesh: params shard, and decode attention
+    defaults to 'auto' — both the prefill flash kernel and the decode
+    cache kernel run per-head-shard via shard_map when the engine wraps
+    its calls in ``jax.sharding.set_mesh(mesh)``."""
     if mesh is None:
         return params, cfg, None
     mesh = build_inference_mesh(mesh)
     if mesh.size > 1:
         import dataclasses
-        # Prefill must take the GSPMD-partitionable XLA path; decode
-        # defaults to the shard_map kernel but an explicit user setting
-        # (e.g. 'xla' to rule the kernel out while debugging) wins.
+        # Both phases keep their kernels under TP: prefill shard_maps
+        # flash over the head axis (models/decode.py
+        # _prefill_attention), decode shard_maps the length-aware
+        # kernel. An explicit user decode setting (e.g. 'xla' to rule
+        # the kernel out while debugging) wins over the TP default.
         cfg = dataclasses.replace(
-            cfg, attention_impl='xla',
+            cfg,
             decode_attention_impl=cfg.decode_attention_impl or 'auto')
     return shard_inference_params(params, mesh, cfg), cfg, mesh
 

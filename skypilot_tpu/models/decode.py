@@ -110,6 +110,47 @@ def _lm_head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
                       preferred_element_type=jnp.float32)
 
 
+def _prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                       cfg: ModelConfig) -> jax.Array:
+    """Prefill attention, TP-aware.
+
+    Under an ambient mesh with a tensor axis (the serving engines enter
+    ``set_mesh``), heads are embarrassingly parallel: shard_map splits
+    q/k/v on the head axis and each shard runs the normal attention
+    (flash kernel on TPU) locally — no collectives, and the kernel
+    stays usable where a bare pallas_call would be opaque to GSPMD.
+    Falls back to the partitionable XLA reference when head counts
+    don't divide the tensor degree.
+    """
+    from skypilot_tpu.ops import multi_head_attention
+    from skypilot_tpu.parallel.sharding import (ambient_tensor_parallelism,
+                                                tensor_shard_map)
+    mesh, tp = ambient_tensor_parallelism()
+    h, kvh = q.shape[2], k.shape[2]
+    impl = cfg.attention_impl
+    if mesh is None or mesh.size == 1:
+        return multi_head_attention(q, k, v, causal=True, impl=impl)
+    if tp <= 1 or h % tp or kvh % tp:
+        if impl == 'pallas':
+            from skypilot_tpu.ops.pallas.common import warn_fallback_once
+            warn_fallback_once(
+                'prefill attention',
+                f'mesh {dict(mesh.shape)} (heads {h}/{kvh} not divisible '
+                f'by tensor={tp})')
+        from skypilot_tpu.ops.attention import xla_attention
+        return xla_attention(q, k, v, causal=True)
+    from jax.sharding import PartitionSpec as P
+
+    def shard_fn(q_, k_, v_):
+        return multi_head_attention(q_, k_, v_, causal=True, impl=impl)
+
+    return tensor_shard_map(
+        shard_fn, mesh,
+        in_specs=(P(None, None, 'tensor', None),) * 3,
+        out_specs=P(None, None, 'tensor', None),
+    )(q, k, v)
+
+
 def _mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
     dt = cfg.compute_dtype
     if cfg.is_moe:
@@ -150,9 +191,7 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
         v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        from skypilot_tpu.ops import multi_head_attention
-        attn = multi_head_attention(q, k, v, causal=True,
-                                    impl=cfg.attention_impl)
+        attn = _prefill_attention(q, k, v, cfg)
         x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)

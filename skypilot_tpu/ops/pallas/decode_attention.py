@@ -235,9 +235,9 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # splitting kv heads over 'tensor' needs no collectives. Otherwise a
     # multi-device mesh falls back to the (GSPMD-partitionable) XLA path
     # — a bare pallas_call is opaque to the partitioner.
-    from skypilot_tpu.parallel.sharding import _abstract_or_ambient_mesh
-    mesh = _abstract_or_ambient_mesh()
-    tp = int(mesh.shape.get('tensor', 1)) if mesh is not None else 1
+    from skypilot_tpu.parallel.sharding import (ambient_tensor_parallelism,
+                                                tensor_shard_map)
+    mesh, tp = ambient_tensor_parallelism()
     multi_device = mesh is not None and mesh.size > 1
     if multi_device and (tp <= 1 or kvh % tp or not supported):
         if impl == 'pallas':
@@ -276,17 +276,10 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         if k_scale is not None:
             in_specs += [P(None, 'tensor', None), P(None, 'tensor', None)]
             operands += [k_scale, v_scale]
-        out = jax.shard_map(
-            fn, mesh=mesh,
+        out = tensor_shard_map(
+            fn, mesh,
             in_specs=tuple(in_specs),
             out_specs=P(None, 'tensor', None, None),
-            # Manualize ONLY the tensor axis: other mesh axes (e.g. a
-            # data axis sharding the request batch) stay in auto mode
-            # instead of being force-replicated inside the manual region.
-            axis_names={'tensor'},
-            # pallas_call's out_shape carries no varying-mesh-axes info;
-            # skip the vma check (the kernel is per-shard pure).
-            check_vma=False,
         )(*operands)
     else:
         out = _pallas_decode(qg, k_cache, v_cache, n_valid, d ** -0.5, bk,

@@ -141,6 +141,27 @@ def with_spec_constraint(x: jax.Array, spec: P) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def ambient_tensor_parallelism():
+    """(ambient mesh or None, tensor-axis degree) for TP dispatch."""
+    mesh = _abstract_or_ambient_mesh()
+    tp = int(mesh.shape.get('tensor', 1)) if mesh is not None else 1
+    return mesh, tp
+
+
+def tensor_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map manualizing ONLY the tensor axis.
+
+    Other mesh axes (e.g. a data axis sharding a request batch) stay in
+    auto mode instead of being force-replicated inside the manual
+    region; check_vma is off because the wrapped fns bottom out in
+    pallas_call, whose out_shape carries no varying-mesh-axes info.
+    """
+    import jax as _jax
+    return _jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, axis_names={'tensor'},
+                          check_vma=False)
+
+
 def _abstract_or_ambient_mesh() -> Optional[Mesh]:
     try:
         mesh = jax.sharding.get_abstract_mesh()
