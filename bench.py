@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -138,7 +139,11 @@ def _decode_bench(args, model: str, on_accel: bool) -> int:
 
 
 def main() -> int:
-    if not _probe_accelerator():
+    try:
+        tries = max(int(os.environ.get('SKYT_BENCH_PROBE_TRIES', '6')), 1)
+    except ValueError:
+        tries = 6
+    if not _probe_accelerator(tries=tries):
         print(json.dumps({
             'metric': 'train_mfu_unavailable',
             'value': 0,
