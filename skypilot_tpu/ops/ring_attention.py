@@ -53,14 +53,26 @@ def _rotate(xs, seq_axis: str, n: int):
     return jax.tree.map(lambda x: lax.ppermute(x, seq_axis, perm), xs)
 
 
-def _block_logits(q, k_rep, *, scale, causal, q_pos, k_pos):
+def _block_logits(q, k_rep, *, scale, causal, q_pos, k_pos,
+                  q_seg=None, k_seg=None):
     """fp32 logits of the local Q block against one K block, with the
-    causal mask on *global* positions applied via the finite NEG_INF."""
+    causal mask on *global* positions applied via the finite NEG_INF.
+
+    ``q_seg``/``k_seg`` ([B, Sq]/[B, Sk]) additionally mask cross-segment
+    scores for packed sequences; a fully-masked block contributes only
+    unit-weight placeholders that the running max washes out, and every
+    token's diagonal entry (own segment, causal-allowed) keeps l > 0.
+    """
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k_rep,
                         preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]        # [Sq, Sk]
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        mask = (q_pos[:, None] >= k_pos[None, :])[None]    # [1, Sq, Sk]
+    if q_seg is not None:
+        seg = q_seg[:, :, None] == k_seg[:, None, :]       # [B, Sq, Sk]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
     return logits
 
 
@@ -73,7 +85,8 @@ def _vary(x, seq_axis: str):
     return lax.pcast(x, (seq_axis,), to='varying')
 
 
-def _ring_fwd_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
+def _ring_fwd_local(q, k, v, seg, *, causal: bool, scale: float,
+                    seq_axis: str):
     """Forward ring pass on local blocks: q [B,S/n,H,D], k/v
     [B,S/n,KV,D] (rotated UNexpanded — GQA repeat happens per step, so
     ICI traffic and carry memory stay at the KV-head size).
@@ -83,7 +96,9 @@ def _ring_fwd_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
     running max initialized to the finite NEG_INF: a fully-masked
     block contributes unit-weight placeholders that the first real
     block's correction factor exp(NEG_INF - m_real) = 0 washes out
-    exactly. Returns (out, lse) with lse = m + log(l) saved for the
+    exactly. ``seg`` ([B, S/n] local segment ids, or None) rides the
+    ring with its K/V block so packed sequences mask cross-segment
+    scores. Returns (out, lse) with lse = m + log(l) saved for the
     backward pass.
     """
     n = lax.axis_size(seq_axis)
@@ -91,17 +106,24 @@ def _ring_fwd_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
     n_rep = q.shape[2] // k.shape[2]
     b, s_loc, h, d = q.shape
     q_pos = idx * s_loc + jnp.arange(s_loc)            # global Q positions
+    # The segment block rides the ring ONLY when packing is in use — the
+    # unpacked path must not pay a dead int32 ppermute per hop.
+    ring0 = ((k, v) if seg is None
+             else (k, v, _vary(seg, seq_axis)))
 
     m0 = _vary(jnp.full((b, h, s_loc), NEG_INF, jnp.float32), seq_axis)
     l0 = _vary(jnp.zeros((b, h, s_loc), jnp.float32), seq_axis)
     acc0 = _vary(jnp.zeros((b, s_loc, h, d), jnp.float32), seq_axis)
 
     def step(carry, t):
-        k_t, v_t, m, l, acc = carry
+        ring, m, l, acc = carry
+        k_t, v_t = ring[0], ring[1]
+        kseg_t = ring[2] if seg is not None else None
         j = (idx - t) % n
         k_pos = j * s_loc + jnp.arange(s_loc)
         logits = _block_logits(q, repeat_kv(k_t, n_rep), scale=scale,
-                               causal=causal, q_pos=q_pos, k_pos=k_pos)
+                               causal=causal, q_pos=q_pos, k_pos=k_pos,
+                               q_seg=seg, k_seg=kseg_t)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         p = jnp.exp(logits - m_new[..., None])         # [b,h,q,k]
         corr = jnp.exp(m - m_new)                      # [b,h,q]
@@ -110,24 +132,23 @@ def _ring_fwd_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
         pv = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v_rep.dtype),
                         v_rep).astype(jnp.float32)
         acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
-        k_next, v_next = _rotate((k_t, v_t), seq_axis, n)
-        return (k_next, v_next, m_new, l, acc), None
+        return (_rotate(ring, seq_axis, n), m_new, l, acc), None
 
-    (_, _, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0),
-                                    jnp.arange(n))
+    (_, m, l, acc), _ = lax.scan(step, (ring0, m0, l0, acc0),
+                                 jnp.arange(n))
     # Causal attention always includes the diagonal, so l > 0.
     out = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
     lse = m + jnp.log(l)                               # [b,h,sq] fp32
     return out, lse
 
 
-def _ring_bwd_local(q, k, v, out, lse, dout, *, causal: bool, scale: float,
-                    seq_axis: str):
+def _ring_bwd_local(q, k, v, seg, out, lse, dout, *, causal: bool,
+                    scale: float, seq_axis: str):
     """Backward ring pass (the standard ring-attention recipe): K/V
     blocks make a second full rotation while the per-block dK/dV
     accumulators ride along WITH their blocks — after n hops each
     accumulator is back home holding every device's contribution. Only
-    O(S/n) residuals (q, k, v, out, lse) are stored by the forward
+    O(S/n) residuals (q, k, v, seg, out, lse) are stored by the forward
     pass; logits/probabilities are recomputed per step from lse.
     """
     n = lax.axis_size(seq_axis)
@@ -142,15 +163,23 @@ def _ring_bwd_local(q, k, v, out, lse, dout, *, causal: bool, scale: float,
     dq0 = _vary(jnp.zeros((b, s_loc, h, d), jnp.float32), seq_axis)
     dk0 = _vary(jnp.zeros_like(k, jnp.float32), seq_axis)
     dv0 = _vary(jnp.zeros_like(v, jnp.float32), seq_axis)
+    # dK/dV accumulators ride with their K/V block; the segment block
+    # rides too, but only on the packed path (no dead ppermute).
+    ring0 = ((k, v, dk0, dv0) if seg is None
+             else (k, v, _vary(seg, seq_axis), dk0, dv0))
 
     def step(carry, t):
-        k_t, v_t, dk_t, dv_t, dq = carry
+        ring, dq = carry
+        k_t, v_t = ring[0], ring[1]
+        kseg_t = ring[2] if seg is not None else None
+        dk_t, dv_t = ring[-2], ring[-1]
         j = (idx - t) % n
         k_pos = j * s_loc + jnp.arange(s_loc)
         k_rep = repeat_kv(k_t, n_rep)
         v_rep = repeat_kv(v_t, n_rep)
         logits = _block_logits(q, k_rep, scale=scale, causal=causal,
-                               q_pos=q_pos, k_pos=k_pos)
+                               q_pos=q_pos, k_pos=k_pos,
+                               q_seg=seg, k_seg=kseg_t)
         p = jnp.exp(logits - lse[..., None])           # normalized probs
         dp = jnp.einsum('bqhd,bkhd->bhqk', dout.astype(jnp.float32),
                         v_rep.astype(jnp.float32))
@@ -165,36 +194,66 @@ def _ring_bwd_local(q, k, v, out, lse, dout, *, causal: bool, scale: float,
         kv = k.shape[2]
         dk_t = dk_t + dk_rep.reshape(b, s_loc, kv, n_rep, d).sum(axis=3)
         dv_t = dv_t + dv_rep.reshape(b, s_loc, kv, n_rep, d).sum(axis=3)
-        k_next, v_next, dk_next, dv_next = _rotate(
-            (k_t, v_t, dk_t, dv_t), seq_axis, n)
-        return (k_next, v_next, dk_next, dv_next, dq), None
+        ring = ring[:-2] + (dk_t, dv_t)
+        return (_rotate(ring, seq_axis, n), dq), None
 
-    (_, _, dk, dv, dq), _ = lax.scan(step, (k, v, dk0, dv0, dq0),
-                                     jnp.arange(n))
+    (ring, dq), _ = lax.scan(step, (ring0, dq0), jnp.arange(n))
+    dk, dv = ring[-2], ring[-1]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _make_ring_core(causal: bool, scale: float, seq_axis: str):
-    """custom_vjp ring attention on local blocks: O(S/n) residuals."""
+def _make_ring_core(causal: bool, scale: float, seq_axis: str,
+                    with_seg: bool):
+    """custom_vjp ring attention on local blocks: O(S/n) residuals.
+
+    With ``with_seg`` the core takes (q, k, v, seg); seg is an integer
+    input, so its cotangent is the symbolic-zero ``float0``.
+    """
+
+    def bwd_common(res, dout):
+        q, k, v, seg, out, lse = res
+        return _ring_bwd_local(q, k, v, seg, out, lse, dout,
+                               causal=causal, scale=scale,
+                               seq_axis=seq_axis)
+
+    if with_seg:
+        import numpy as np
+
+        @jax.custom_vjp
+        def core(q, k, v, seg):
+            out, _ = _ring_fwd_local(q, k, v, seg, causal=causal,
+                                     scale=scale, seq_axis=seq_axis)
+            return out
+
+        def fwd(q, k, v, seg):
+            out, lse = _ring_fwd_local(q, k, v, seg, causal=causal,
+                                       scale=scale, seq_axis=seq_axis)
+            return out, (q, k, v, seg, out, lse)
+
+        def bwd(res, dout):
+            dq, dk, dv = bwd_common(res, dout)
+            dseg = np.zeros(res[3].shape, dtype=jax.dtypes.float0)
+            return dq, dk, dv, dseg
+
+        core.defvjp(fwd, bwd)
+        return core
 
     @jax.custom_vjp
-    def core(q, k, v):
-        out, _ = _ring_fwd_local(q, k, v, causal=causal, scale=scale,
-                                 seq_axis=seq_axis)
+    def core3(q, k, v):
+        out, _ = _ring_fwd_local(q, k, v, None, causal=causal,
+                                 scale=scale, seq_axis=seq_axis)
         return out
 
-    def fwd(q, k, v):
-        out, lse = _ring_fwd_local(q, k, v, causal=causal, scale=scale,
-                                   seq_axis=seq_axis)
-        return out, (q, k, v, out, lse)
+    def fwd3(q, k, v):
+        out, lse = _ring_fwd_local(q, k, v, None, causal=causal,
+                                   scale=scale, seq_axis=seq_axis)
+        return out, (q, k, v, None, out, lse)
 
-    def bwd(res, dout):
-        q, k, v, out, lse = res
-        return _ring_bwd_local(q, k, v, out, lse, dout, causal=causal,
-                               scale=scale, seq_axis=seq_axis)
+    def bwd3(res, dout):
+        return bwd_common(res, dout)
 
-    core.defvjp(fwd, bwd)
-    return core
+    core3.defvjp(fwd3, bwd3)
+    return core3
 
 
 def ring_attention(q: jax.Array,
@@ -202,11 +261,16 @@ def ring_attention(q: jax.Array,
                    v: jax.Array,
                    *,
                    causal: bool = True,
+                   segment_ids: Optional[jax.Array] = None,
                    scale: Optional[float] = None,
                    mesh: Optional[Mesh] = None,
                    seq_axis: str = 'seq') -> jax.Array:
     """Ring attention: q [B,S,H,D], k/v [B,S,KV,D] logically sharded on
     the ``seq`` mesh axis; returns [B,S,H,D] with the same sharding.
+
+    ``segment_ids`` ([B, S], packed sequences) is supported: the local
+    segment-id block rides the ring with its K/V block, so packed
+    long-context training composes with sequence parallelism.
 
     Falls back to `xla_attention` when there is no mesh or the seq axis
     is trivial (size 1), so models can set ``attention_impl='ring'``
@@ -217,7 +281,8 @@ def ring_attention(q: jax.Array,
     if mesh is None:
         mesh = _abstract_or_ambient_mesh()
     if mesh is None or _seq_axis_size(mesh, seq_axis) == 1:
-        return xla_attention(q, k, v, causal=causal, scale=scale)
+        return xla_attention(q, k, v, causal=causal, scale=scale,
+                             segment_ids=segment_ids)
     s = q.shape[1]
     n = _seq_axis_size(mesh, seq_axis)
     if s % n != 0:
@@ -225,15 +290,25 @@ def ring_attention(q: jax.Array,
             f'ring_attention: seq length {s} not divisible by seq mesh '
             f'axis size {n}')
     spec = P(None, seq_axis, None, None)
-    body = _make_ring_core(causal, scale, seq_axis)
+    body = _make_ring_core(causal, scale, seq_axis,
+                           with_seg=segment_ids is not None)
+    if segment_ids is None:
+        return jax.shard_map(body, mesh=mesh, axis_names={seq_axis},
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+    seg_spec = P(None, seq_axis)
     return jax.shard_map(body, mesh=mesh, axis_names={seq_axis},
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         in_specs=(spec, spec, spec, seg_spec),
+                         out_specs=spec)(
+                             q, k, v, segment_ids.astype(jnp.int32))
 
 
-def _ulysses_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
+def _ulysses_local(q, k, v, seg=None, *, causal: bool, scale: float,
+                   seq_axis: str):
     """shard_map body: all-to-all seq->heads, dense local attention over
-    the full sequence, all-to-all back."""
+    the full sequence, all-to-all back. Packed-sequence segment ids
+    (``seg``, [B, S/n] local) are all-gathered to the full sequence —
+    cheap int32 traffic next to the q/k/v all-to-alls."""
     n = lax.axis_size(seq_axis)
     n_rep = q.shape[2] // k.shape[2]
     if k.shape[2] % n != 0:
@@ -245,7 +320,10 @@ def _ulysses_local(q, k, v, *, causal: bool, scale: float, seq_axis: str):
     q = lax.all_to_all(q, seq_axis, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, seq_axis, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, seq_axis, split_axis=2, concat_axis=1, tiled=True)
-    out = xla_attention(q, k, v, causal=causal, scale=scale)
+    if seg is not None:
+        seg = lax.all_gather(seg, seq_axis, axis=1, tiled=True)  # [B, S]
+    out = xla_attention(q, k, v, causal=causal, scale=scale,
+                        segment_ids=seg)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(out, seq_axis, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -256,6 +334,7 @@ def ulysses_attention(q: jax.Array,
                       v: jax.Array,
                       *,
                       causal: bool = True,
+                      segment_ids: Optional[jax.Array] = None,
                       scale: Optional[float] = None,
                       mesh: Optional[Mesh] = None,
                       seq_axis: str = 'seq') -> jax.Array:
@@ -266,7 +345,8 @@ def ulysses_attention(q: jax.Array,
         mesh = _abstract_or_ambient_mesh()
     n = 1 if mesh is None else _seq_axis_size(mesh, seq_axis)
     if mesh is None or n == 1:
-        return xla_attention(q, k, v, causal=causal, scale=scale)
+        return xla_attention(q, k, v, causal=causal, scale=scale,
+                             segment_ids=segment_ids)
     if q.shape[2] % n != 0:
         raise ValueError(
             f'ulysses_attention: {q.shape[2]} heads not divisible by seq '
@@ -278,6 +358,12 @@ def ulysses_attention(q: jax.Array,
     spec = P(None, seq_axis, None, None)
     body = functools.partial(_ulysses_local, causal=causal, scale=scale,
                              seq_axis=seq_axis)
+    if segment_ids is None:
+        return jax.shard_map(body, mesh=mesh, axis_names={seq_axis},
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+    seg_spec = P(None, seq_axis)
     return jax.shard_map(body, mesh=mesh, axis_names={seq_axis},
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         in_specs=(spec, spec, spec, seg_spec),
+                         out_specs=spec)(
+                             q, k, v, segment_ids.astype(jnp.int32))
