@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from skypilot_tpu.inference.tokenizer import ByteTokenizer
+from skypilot_tpu.inference.tokenizer import get_tokenizer
 from skypilot_tpu.models import decode as decode_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.config import ModelConfig, get_model_config
@@ -62,17 +62,28 @@ class ContinuousBatchingEngine:
                  cfg: Optional[ModelConfig] = None,
                  params: Optional[Any] = None,
                  checkpoint_dir: Optional[str] = None,
+                 hf_checkpoint: Optional[str] = None,
                  max_slots: int = 4,
                  max_len: Optional[int] = None,
                  seed: int = 0,
                  quantize: bool = False,
                  quantize_kv: bool = False,
                  mesh: Optional[Any] = None) -> None:
+        # Real-weights path: see engine.py (models/hf_interop.py).
+        if hf_checkpoint:
+            from skypilot_tpu.models import hf_interop
+            params, cfg = hf_interop.resolve_engine_inputs(
+                hf_checkpoint, params, cfg)
         self.cfg = cfg or get_model_config(model)
         if quantize_kv:
             from skypilot_tpu.models.config import with_int8_kv_cache
             self.cfg = with_int8_kv_cache(self.cfg)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = get_tokenizer(hf_checkpoint,
+                                       require=bool(hf_checkpoint))
+        if self.tokenizer.vocab_size > self.cfg.vocab_size:
+            raise ValueError(
+                f'Model vocab {self.cfg.vocab_size} < tokenizer '
+                f'vocab {self.tokenizer.vocab_size}')
         self.max_slots = max_slots
         # Cache length defaults to the model's full context (the cache
         # is allocated once: max_slots * max_len rows).

@@ -23,7 +23,7 @@ import numpy as np
 from skypilot_tpu.models import decode as decode_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.models.config import ModelConfig, get_model_config
-from skypilot_tpu.inference.tokenizer import ByteTokenizer
+from skypilot_tpu.inference.tokenizer import get_tokenizer
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -42,21 +42,30 @@ class InferenceEngine:
                  cfg: Optional[ModelConfig] = None,
                  params: Optional[Any] = None,
                  checkpoint_dir: Optional[str] = None,
+                 hf_checkpoint: Optional[str] = None,
                  seed: int = 0,
                  max_batch: int = 8,
                  quantize: bool = False,
                  quantize_kv: bool = False,
                  mesh: Optional[Any] = None) -> None:
+        # hf_checkpoint: an HF-layout dir (config.json + safetensors +
+        # tokenizer.json) — real published weights + real BPE tokenizer
+        # (models/hf_interop.py). The cfg/params args then come from it.
+        if hf_checkpoint:
+            from skypilot_tpu.models import hf_interop
+            params, cfg = hf_interop.resolve_engine_inputs(
+                hf_checkpoint, params, cfg)
         self.cfg = cfg or get_model_config(model)
         if quantize_kv:
             # int8 KV cache: half the cache memory (2x context/slots per
             # chip); the decode kernel dequantizes in-VMEM.
             from skypilot_tpu.models.config import with_int8_kv_cache
             self.cfg = with_int8_kv_cache(self.cfg)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = get_tokenizer(hf_checkpoint,
+                                       require=bool(hf_checkpoint))
         if self.tokenizer.vocab_size > self.cfg.vocab_size:
             raise ValueError(
-                f'Model vocab {self.cfg.vocab_size} < byte-tokenizer '
+                f'Model vocab {self.cfg.vocab_size} < tokenizer '
                 f'vocab {self.tokenizer.vocab_size}')
         self.max_batch = max_batch
         self._lock = threading.Lock()

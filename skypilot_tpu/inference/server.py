@@ -92,6 +92,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
     parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--hf-checkpoint', default=None,
+                        help='HF-layout checkpoint dir (config.json + '
+                             'safetensors + tokenizer.json): serve real '
+                             'published weights with the real BPE '
+                             'tokenizer (models/hf_interop.py).')
     parser.add_argument('--host', default='0.0.0.0')
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--max-batch', type=int, default=8)
@@ -119,6 +124,7 @@ def main(argv=None) -> int:
         engine = ContinuousBatchingEngine(
             args.model,
             checkpoint_dir=args.checkpoint_dir,
+            hf_checkpoint=args.hf_checkpoint,
             max_slots=args.max_batch,
             max_len=args.max_len,
             quantize=args.quantize,
@@ -128,6 +134,7 @@ def main(argv=None) -> int:
     else:
         engine = InferenceEngine(args.model,
                                  checkpoint_dir=args.checkpoint_dir,
+                                 hf_checkpoint=args.hf_checkpoint,
                                  max_batch=args.max_batch,
                                  quantize=args.quantize,
                                  quantize_kv=args.quantize_kv,

@@ -1,13 +1,19 @@
-"""Byte-level tokenizer: ids 0..2 reserved (pad/bos/eos), byte b -> b+3.
+"""Tokenizers behind one encode/decode interface.
 
-Self-contained (no external vocab files), reversible for any UTF-8 text,
-and small enough that the tiny test models (vocab 512) cover the full id
-range. Real deployments can swap in a sentencepiece/HF tokenizer behind
-the same encode/decode interface; the engine only needs ids.
+* ``ByteTokenizer`` — self-contained byte-level fallback (no vocab
+  files), reversible for any UTF-8 text; the tiny test models
+  (vocab 512) cover its full id range.
+* ``HFTokenizer`` — a real BPE tokenizer loaded from an HF checkpoint
+  dir's ``tokenizer.json`` (Llama-3 ships its 128k-token BPE this way:
+  ref ``llm/llama-3_1``). Backed by the ``tokenizers`` library.
+* ``get_tokenizer(dir)`` — factory: HF when a tokenizer.json is
+  present, byte-level otherwise. Engines only ever see ids.
 """
 from __future__ import annotations
 
-from typing import List
+import json
+import os
+from typing import List, Optional
 
 PAD_ID = 0
 BOS_ID = 1
@@ -29,3 +35,85 @@ class ByteTokenizer:
         data = bytes(i - _OFFSET for i in ids
                      if i >= _OFFSET and i - _OFFSET < 256)
         return data.decode('utf-8', errors='replace')
+
+
+class HFTokenizer:
+    """BPE tokenizer from an HF checkpoint dir (tokenizer.json).
+
+    Special-token ids come from tokenizer_config.json (bos/eos token
+    strings -> ids); pad defaults to eos the way HF generation does
+    when no pad token is defined.
+    """
+
+    def __init__(self, path: str) -> None:
+        from tokenizers import Tokenizer  # rust-backed, baked in
+        tok_file = (path if path.endswith('.json')
+                    else os.path.join(path, 'tokenizer.json'))
+        self._tok = Tokenizer.from_file(tok_file)
+        self.vocab_size = self._tok.get_vocab_size()
+        base = os.path.dirname(tok_file)
+        self.bos_id, self.eos_id = self._special_ids(base)
+        self.pad_id = self.eos_id
+
+    def _special_ids(self, base: str):
+        def token_str(v):
+            return v['content'] if isinstance(v, dict) else v
+
+        bos = eos = None
+        cfg_file = os.path.join(base, 'tokenizer_config.json')
+        if os.path.exists(cfg_file):
+            with open(cfg_file) as f:
+                tc = json.load(f)
+            if tc.get('bos_token'):
+                bos = self._tok.token_to_id(token_str(tc['bos_token']))
+            if tc.get('eos_token'):
+                eos = self._tok.token_to_id(token_str(tc['eos_token']))
+        if bos is None:
+            for cand in ('<|begin_of_text|>', '<s>', '<bos>'):
+                bos = self._tok.token_to_id(cand)
+                if bos is not None:
+                    break
+        if eos is None:
+            for cand in ('<|end_of_text|>', '</s>', '<eos>',
+                         '<|eot_id|>'):
+                eos = self._tok.token_to_id(cand)
+                if eos is not None:
+                    break
+        if eos is None:
+            raise ValueError(
+                f'no eos token found for tokenizer under {base}: add a '
+                'tokenizer_config.json with "eos_token" (an arbitrary '
+                'vocab id must not silently become a stop token)')
+        if bos is None:
+            bos = eos
+        return bos, eos
+
+    def encode(self, text: str, *, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        specials = {self.bos_id, self.eos_id, self.pad_id}
+        return self._tok.decode([i for i in ids if i not in specials],
+                                skip_special_tokens=True)
+
+
+def get_tokenizer(checkpoint_dir: Optional[str] = None, *,
+                  require: bool = False):
+    """HFTokenizer when the dir ships a tokenizer.json, else bytes.
+
+    ``require=True`` (the engines' explicit ``hf_checkpoint`` path):
+    a missing tokenizer.json raises instead of silently serving real
+    weights through the byte fallback's nonsense vocabulary.
+    """
+    if checkpoint_dir and os.path.exists(
+            os.path.join(checkpoint_dir, 'tokenizer.json')):
+        return HFTokenizer(checkpoint_dir)
+    if checkpoint_dir and require:
+        raise ValueError(
+            f'no tokenizer.json under {checkpoint_dir}: an HF '
+            'checkpoint must ship its tokenizer (sentencepiece-only '
+            'exports: convert with transformers '
+            "`AutoTokenizer...save_pretrained`), or the byte fallback "
+            'would silently mis-encode every prompt')
+    return ByteTokenizer()

@@ -25,6 +25,13 @@ class ModelConfig:
     d_ff: int
     head_dim: Optional[int] = None      # default d_model // n_heads
     rope_theta: float = 500_000.0
+    # Llama-3.1-style NTK rope scaling (HF config.json `rope_scaling`
+    # with rope_type='llama3'). factor == 0 disables. Kept as scalars so
+    # the frozen config stays hashable.
+    rope_scaling_factor: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     max_seq_len: int = 8192
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
@@ -60,6 +67,15 @@ class ModelConfig:
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def rope_scaling(self) -> Optional[Tuple[float, float, float, int]]:
+        """(factor, low_freq, high_freq, original_max_pos) or None."""
+        if not self.rope_scaling_factor:
+            return None
+        return (self.rope_scaling_factor, self.rope_low_freq_factor,
+                self.rope_high_freq_factor,
+                self.rope_original_max_position)
 
     @property
     def is_moe(self) -> bool:

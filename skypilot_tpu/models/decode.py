@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models.config import ModelConfig
-from skypilot_tpu.models.llama import apply_rope, rope_table
+from skypilot_tpu.models.llama import apply_rope, rope_table_for
 from skypilot_tpu.models.quant import QTensor, weight_einsum
 from skypilot_tpu.ops import rms_norm
 
@@ -180,7 +180,7 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
     b, s = tokens.shape
     dt = cfg.compute_dtype
     positions = jnp.arange(s)
-    sin, cos = rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta)
+    sin, cos = rope_table_for(cfg, positions)
     x = _embed(params, tokens, cfg)
 
     def layer(carry, lp):
@@ -240,7 +240,7 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
         active = jnp.ones((b,), bool)
     dt = cfg.compute_dtype
     positions = cache.lengths[:, None]                       # [B, 1]
-    sin, cos = rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta)
+    sin, cos = rope_table_for(cfg, positions)
     x = _embed(params, tokens[:, None], cfg)                 # [B, 1, D]
 
     max_len = cache.max_len

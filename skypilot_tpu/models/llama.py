@@ -33,13 +33,38 @@ Params = Dict[str, Any]
 # Rotary position embeddings
 # ---------------------------------------------------------------------------
 
-def rope_table(positions: jax.Array, head_dim: int,
-               theta: float) -> Tuple[jax.Array, jax.Array]:
-    """sin/cos tables [*, S, head_dim/2] (fp32)."""
+def rope_table(positions: jax.Array, head_dim: int, theta: float,
+               scaling: Optional[Tuple[float, float, float, int]] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """sin/cos tables [*, S, head_dim/2] (fp32).
+
+    ``scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_position): the Llama-3.1 NTK frequency rescale (HF
+    ``rope_scaling`` with rope_type='llama3') — long-wavelength
+    frequencies are divided by ``factor``, short ones kept, with a
+    smooth ramp between the two wavelength cutoffs.
+    """
     half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        factor, low_f, high_f, orig_max = scaling
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wavelen = orig_max / low_f
+        high_wavelen = orig_max / high_f
+        smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = jnp.where(wavelen > low_wavelen, freqs / factor,
+                          jnp.where(wavelen < high_wavelen, freqs, scaled))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, half]
     return jnp.sin(angles), jnp.cos(angles)
+
+
+def rope_table_for(cfg: ModelConfig,
+                   positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """rope_table with the config's theta + optional llama3 scaling."""
+    return rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta,
+                      scaling=cfg.rope_scaling)
 
 
 def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
@@ -300,7 +325,7 @@ def forward(params: Params,
     dt = cfg.compute_dtype
     if positions is None:
         positions = jnp.arange(s)
-    sin, cos = rope_table(positions, cfg.resolved_head_dim, cfg.rope_theta)
+    sin, cos = rope_table_for(cfg, positions)
 
     table = params['embed']['embedding'].astype(dt)
     if cfg.use_iota_embed:

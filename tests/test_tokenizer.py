@@ -1,0 +1,102 @@
+"""Tokenizer stack: byte-level fallback + real BPE from tokenizer.json
+(VERDICT r2 missing #1: 'no real tokenizer').
+
+The BPE fixture is trained in-test with the `tokenizers` library — the
+same artifact an HF checkpoint dir ships (tokenizer.json +
+tokenizer_config.json), minus the download.
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.inference.tokenizer import (ByteTokenizer, HFTokenizer,
+                                              get_tokenizer)
+
+tokenizers = pytest.importorskip('tokenizers')
+
+CORPUS = [
+    'the quick brown fox jumps over the lazy dog',
+    'pack my box with five dozen liquor jugs',
+    'sphinx of black quartz judge my vow',
+    'how vexingly quick daft zebras jump',
+] * 8
+
+
+@pytest.fixture()
+def bpe_dir(tmp_path):
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from tokenizers.trainers import BpeTrainer
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = BpeTrainer(
+        vocab_size=300,
+        special_tokens=['<|begin_of_text|>', '<|end_of_text|>'])
+    tok.train_from_iterator(CORPUS, trainer)
+    d = tmp_path / 'ckpt'
+    d.mkdir()
+    tok.save(str(d / 'tokenizer.json'))
+    with open(d / 'tokenizer_config.json', 'w') as f:
+        json.dump({'bos_token': '<|begin_of_text|>',
+                   'eos_token': '<|end_of_text|>'}, f)
+    return str(d)
+
+
+def test_hf_tokenizer_roundtrip(bpe_dir):
+    tok = HFTokenizer(bpe_dir)
+    text = 'the quick brown fox'
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_id
+    assert all(0 <= i < tok.vocab_size for i in ids)
+    assert tok.decode(ids) == text
+
+
+def test_hf_tokenizer_compresses_vs_bytes(bpe_dir):
+    """A trained BPE must beat byte-level on in-domain text — the whole
+    point of shipping a real tokenizer."""
+    tok = HFTokenizer(bpe_dir)
+    text = 'the quick brown fox jumps over the lazy dog'
+    assert len(tok.encode(text, add_bos=False)) < len(text)
+
+
+def test_special_ids_from_config(bpe_dir):
+    tok = HFTokenizer(bpe_dir)
+    assert tok.bos_id == tok._tok.token_to_id('<|begin_of_text|>')
+    assert tok.eos_id == tok._tok.token_to_id('<|end_of_text|>')
+    assert tok.pad_id == tok.eos_id
+
+
+def test_decode_strips_specials(bpe_dir):
+    tok = HFTokenizer(bpe_dir)
+    ids = tok.encode('judge my vow')
+    padded = ids + [tok.eos_id, tok.pad_id, tok.pad_id]
+    assert tok.decode(padded) == 'judge my vow'
+
+
+def test_get_tokenizer_factory(bpe_dir, tmp_path):
+    assert isinstance(get_tokenizer(bpe_dir), HFTokenizer)
+    assert isinstance(get_tokenizer(None), ByteTokenizer)
+    empty = tmp_path / 'empty'
+    empty.mkdir()
+    assert isinstance(get_tokenizer(str(empty)), ByteTokenizer)
+
+
+def test_engine_serves_real_checkpoint(bpe_dir, tmp_path):
+    """End-to-end: an HF-layout dir (config.json + safetensors +
+    tokenizer.json) drives the serving engine — encode with the real
+    BPE, decode through the model, detokenize."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.inference.engine import InferenceEngine
+    from skypilot_tpu.models import hf_interop, llama
+    from skypilot_tpu.models.config import get_model_config
+
+    cfg = get_model_config('tiny', vocab_size=512)
+    params = llama.init_params(jax.random.key(0), cfg)
+    hf_interop.save_checkpoint(params, cfg, bpe_dir)
+    engine = InferenceEngine(hf_checkpoint=bpe_dir)
+    assert isinstance(engine.tokenizer, HFTokenizer)
+    assert engine.cfg.vocab_size == 512
+    out = engine.generate_text(['the quick'], max_new_tokens=4)
+    assert len(out) == 1 and isinstance(out[0], str)
