@@ -326,9 +326,27 @@ class Daemon:
                 if self._check_autostop():
                     logger.info('Cluster gone/stopped; daemon exiting')
                     return
+                if self._superseded():
+                    logger.info('Runtime dir gone or daemon superseded; '
+                                'exiting')
+                    return
             except Exception as e:  # pylint: disable=broad-except
                 logger.error('Daemon event error: %s', e, exc_info=True)
             time.sleep(EVENT_PERIOD_SECONDS)
+
+    def _superseded(self) -> bool:
+        """Self-reap: the runtime dir vanished (torn-down cluster, wiped
+        test tmpdir) or another daemon re-claimed it (daemon.pid no
+        longer ours). Without this, orphaned daemons spin at 1 Hz
+        forever (r2-verdict weakness #8)."""
+        pid_path = os.path.join(self.runtime_dir, 'daemon.pid')
+        if not os.path.isdir(self.runtime_dir):
+            return True
+        try:
+            with open(pid_path, encoding='utf-8') as f:
+                return int(f.read().strip()) != os.getpid()
+        except (OSError, ValueError):
+            return True  # pid file gone/corrupt: dir being torn down
 
 
 # ---------------------------------------------------------------------------
