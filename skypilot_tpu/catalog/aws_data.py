@@ -1,0 +1,77 @@
+"""Baked-in AWS offerings (parity: ``sky/catalog/aws_catalog.py`` over
+hosted CSVs from ``sky/catalog/data_fetchers/fetch_aws.py``).
+
+Same stance as ``gcp_data``: a versioned in-package table (zero-egress
+operation) that the TTL-refresh layer (``catalog/refresh.py``) can
+overlay with newer hosted data when a feed is configured. Prices are
+representative us-east-1 on-demand/spot rates; the optimizer only needs
+relative ordering to rank candidates.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+# accelerator -> {accel_count: (instance_type, price_hr, spot_price_hr,
+#                               vram_gb_per_accel)}
+# The instance is the smallest type carrying exactly `count` of the
+# accelerator (AWS sells GPUs only via fixed instance shapes).
+GPU_INSTANCE_TYPES: Dict[str, Dict[int, Tuple[str, float, float, int]]] = {
+    'H100': {8: ('p5.48xlarge', 98.32, 39.33, 80)},
+    'A100': {8: ('p4d.24xlarge', 32.77, 9.83, 40)},
+    'A100-80GB': {8: ('p4de.24xlarge', 40.97, 12.29, 80)},
+    'V100': {1: ('p3.2xlarge', 3.06, 0.92, 16),
+             4: ('p3.8xlarge', 12.24, 3.67, 16),
+             8: ('p3.16xlarge', 24.48, 7.34, 16)},
+    'A10G': {1: ('g5.xlarge', 1.006, 0.45, 24),
+             4: ('g5.12xlarge', 5.672, 2.55, 24),
+             8: ('g5.48xlarge', 16.288, 7.33, 24)},
+    'T4': {1: ('g4dn.xlarge', 0.526, 0.24, 16),
+           4: ('g4dn.12xlarge', 3.912, 1.76, 16),
+           8: ('g4dn.metal', 7.824, 3.52, 16)},
+    'L4': {1: ('g6.xlarge', 0.805, 0.36, 24),
+           4: ('g6.12xlarge', 4.602, 2.07, 24),
+           8: ('g6.48xlarge', 13.350, 6.01, 24)},
+}
+
+# GPU availability by region (zone suffixes appended per region).
+GPU_REGIONS: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    name: {
+        'us-east-1': ('us-east-1a', 'us-east-1b', 'us-east-1c'),
+        'us-west-2': ('us-west-2a', 'us-west-2b', 'us-west-2c'),
+        'eu-west-1': ('eu-west-1a', 'eu-west-1b'),
+    }
+    for name in GPU_INSTANCE_TYPES
+}
+# H100 capacity pools are narrower.
+GPU_REGIONS['H100'] = {
+    'us-east-1': ('us-east-1a', 'us-east-1b'),
+    'us-west-2': ('us-west-2a',),
+}
+
+# name -> (vcpus, memory_gb, price_hr)
+CPU_INSTANCE_TYPES: Dict[str, Tuple[int, float, float]] = {
+    'm6i.large': (2, 8.0, 0.096),
+    'm6i.xlarge': (4, 16.0, 0.192),
+    'm6i.2xlarge': (8, 32.0, 0.384),
+    'm6i.4xlarge': (16, 64.0, 0.768),
+    'c6i.xlarge': (4, 8.0, 0.170),
+    'c6i.4xlarge': (16, 32.0, 0.680),
+    'r6i.xlarge': (4, 32.0, 0.252),
+    'r6i.4xlarge': (16, 128.0, 1.008),
+}
+
+ALL_AWS_REGIONS = ('us-east-1', 'us-east-2', 'us-west-1', 'us-west-2',
+                   'eu-west-1', 'eu-central-1', 'ap-northeast-1',
+                   'ap-southeast-1')
+
+DEFAULT_REGION = 'us-east-1'
+
+# Resolved server-side by EC2 at RunInstances time — always the current
+# canonical Ubuntu 22.04 AMI for the target region, no baked-in ids.
+DEFAULT_AMI_SSM = ('resolve:ssm:/aws/service/canonical/ubuntu/server/'
+                   '22.04/stable/current/amd64/hvm/ebs-gp2/ami-id')
+
+
+def instance_type_for(accelerator: str, count: int):
+    """(instance_type, price, spot_price, vram_per_gpu) or None."""
+    return GPU_INSTANCE_TYPES.get(accelerator, {}).get(count)

@@ -38,7 +38,9 @@ def _tpu_offerings(topology: TpuTopology,
                    zone_filter: Optional[str] = None
                    ) -> List[AcceleratorOffering]:
     gen = topology.generation
-    price_chip, spot_chip = gcp_data.TPU_CHIP_HOUR_PRICES[gen]
+    from skypilot_tpu.catalog import refresh
+    price_chip, spot_chip = refresh.tpu_chip_prices(
+        gen, gcp_data.TPU_CHIP_HOUR_PRICES[gen])
     chips = topology.total_chips
     out = []
     for region, zones in gcp_data.TPU_REGIONS.get(gen, {}).items():
@@ -69,7 +71,9 @@ def _gpu_offerings(name: str,
                    ) -> List[AcceleratorOffering]:
     if name not in gcp_data.GPU_OFFERINGS:
         return []
-    price, spot, vram, _family = gcp_data.GPU_OFFERINGS[name]
+    from skypilot_tpu.catalog import refresh
+    price, spot, vram, _family = refresh.gcp_gpu_offering(
+        name, gcp_data.GPU_OFFERINGS[name])
     out = []
     for region, zones in gcp_data.GPU_REGIONS.get(name, {}).items():
         if region_filter is not None and region != region_filter:
@@ -91,20 +95,62 @@ def _gpu_offerings(name: str,
     return out
 
 
+def _aws_gpu_offerings(name: str,
+                       count: int,
+                       region_filter: Optional[str] = None,
+                       zone_filter: Optional[str] = None
+                       ) -> List[AcceleratorOffering]:
+    from skypilot_tpu.catalog import aws_data, refresh
+    picked = aws_data.instance_type_for(name, count)
+    if picked is None:
+        return []
+    picked = refresh.aws_gpu_instance(name, count, picked)
+    _instance, price, spot, vram = picked
+    out = []
+    for region, zones in aws_data.GPU_REGIONS.get(name, {}).items():
+        if region_filter is not None and region != region_filter:
+            continue
+        for zone in zones:
+            if zone_filter is not None and zone != zone_filter:
+                continue
+            out.append(
+                AcceleratorOffering(
+                    cloud='aws', accelerator=name, count=count,
+                    region=region, zone=zone,
+                    # AWS GPU prices are whole-instance (fixed shapes).
+                    price_hr=price, spot_price_hr=spot,
+                    vram_gb=float(vram * count)))
+    return out
+
+
 def get_offerings(accelerator: str,
                   count: int = 1,
                   *,
+                  cloud: Optional[str] = None,
                   num_slices: int = 1,
                   topology: Optional[str] = None,
                   region: Optional[str] = None,
                   zone: Optional[str] = None) -> List[AcceleratorOffering]:
-    """All (region, zone, price) offerings for an accelerator request."""
+    """All (region, zone, price) offerings for an accelerator request.
+
+    ``cloud=None`` returns offerings across every cataloged cloud;
+    'fake' and 'kubernetes' mirror the GCP table ('fake' is
+    enable_all_clouds-style offline testing, ref
+    tests/common_test_fixtures.py:195; k8s node hardware is priced by
+    its GCP lookalike).
+    """
     tpu = TpuTopology.maybe_from_accelerator(accelerator,
                                              topology=topology,
                                              num_slices=num_slices)
-    if tpu is not None:
-        return _tpu_offerings(tpu, region, zone)
-    return _gpu_offerings(accelerator, count, region, zone)
+    out: List[AcceleratorOffering] = []
+    if cloud in (None, 'gcp', 'fake', 'kubernetes'):
+        if tpu is not None:
+            out.extend(_tpu_offerings(tpu, region, zone))
+        else:
+            out.extend(_gpu_offerings(accelerator, count, region, zone))
+    if tpu is None and cloud in (None, 'aws'):
+        out.extend(_aws_gpu_offerings(accelerator, count, region, zone))
+    return out
 
 
 def list_accelerators(name_filter: Optional[str] = None,
@@ -146,22 +192,39 @@ def get_zones_for_region(accelerator: str, region: str) -> List[str]:
 
 def validate_region_zone(cloud: str, region: Optional[str],
                          zone: Optional[str]) -> None:
-    if cloud not in ('gcp', 'fake', 'local', 'kubernetes'):
+    if cloud not in ('gcp', 'aws', 'fake', 'local', 'kubernetes'):
         raise exceptions.InvalidSpecError(f'Unknown cloud {cloud!r}')
-    if cloud != 'gcp' or region is None:
+    if region is None:
         return
-    if region not in gcp_data.ALL_GCP_REGIONS:
-        raise exceptions.InvalidSpecError(
-            f'Unknown GCP region {region!r}. Known: '
-            f'{gcp_data.ALL_GCP_REGIONS}')
+    if cloud == 'gcp':
+        if region not in gcp_data.ALL_GCP_REGIONS:
+            raise exceptions.InvalidSpecError(
+                f'Unknown GCP region {region!r}. Known: '
+                f'{gcp_data.ALL_GCP_REGIONS}')
+    elif cloud == 'aws':
+        from skypilot_tpu.catalog import aws_data
+        if region not in aws_data.ALL_AWS_REGIONS:
+            raise exceptions.InvalidSpecError(
+                f'Unknown AWS region {region!r}. Known: '
+                f'{aws_data.ALL_AWS_REGIONS}')
+    else:
+        return
     if zone is not None and not zone.startswith(region):
         raise exceptions.InvalidSpecError(
             f'Zone {zone!r} is not in region {region!r}')
 
 
+def _cpu_tables(cloud: Optional[str]) -> Dict[str, tuple]:
+    if cloud == 'aws':
+        from skypilot_tpu.catalog import aws_data
+        return aws_data.CPU_INSTANCE_TYPES
+    return gcp_data.CPU_INSTANCE_TYPES
+
+
 def get_hourly_cost(accelerator: Optional[str],
                     count: int = 1,
                     *,
+                    cloud: Optional[str] = None,
                     num_slices: int = 1,
                     use_spot: bool = False,
                     region: Optional[str] = None,
@@ -171,7 +234,7 @@ def get_hourly_cost(accelerator: Optional[str],
     if accelerator is None:
         # Cheapest CPU instance satisfying cpus/memory.
         best = None
-        for _name, (vcpu, mem, price) in gcp_data.CPU_INSTANCE_TYPES.items():
+        for _name, (vcpu, mem, price) in _cpu_tables(cloud).items():
             if cpus is not None and vcpu < cpus:
                 continue
             if memory is not None and mem < memory:
@@ -179,18 +242,19 @@ def get_hourly_cost(accelerator: Optional[str],
             if best is None or price < best:
                 best = price
         return best if best is not None else 0.097
-    offerings = get_offerings(accelerator, count, num_slices=num_slices,
-                              region=region)
+    offerings = get_offerings(accelerator, count, cloud=cloud,
+                              num_slices=num_slices, region=region)
     if not offerings:
         return 0.0
     return min(o.cost(use_spot) for o in offerings)
 
 
 def pick_cpu_instance_type(cpus: Optional[float],
-                           memory: Optional[float]) -> str:
+                           memory: Optional[float],
+                           cloud: Optional[str] = None) -> str:
     """Cheapest CPU instance type satisfying the request."""
     best_name, best_price = None, None
-    for name, (vcpu, mem, price) in gcp_data.CPU_INSTANCE_TYPES.items():
+    for name, (vcpu, mem, price) in _cpu_tables(cloud).items():
         if cpus is not None and vcpu < cpus:
             continue
         if memory is not None and mem < memory:
@@ -201,3 +265,10 @@ def pick_cpu_instance_type(cpus: Optional[float],
         raise exceptions.ResourcesUnavailableError(
             f'No CPU instance type with cpus>={cpus}, memory>={memory}')
     return best_name
+
+
+def default_region(cloud: str) -> str:
+    if cloud == 'aws':
+        from skypilot_tpu.catalog import aws_data
+        return aws_data.DEFAULT_REGION
+    return 'us-central1'

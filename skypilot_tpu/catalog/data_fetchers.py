@@ -1,0 +1,64 @@
+"""Catalog feed regenerator (parity: ``sky/catalog/data_fetchers/``).
+
+The reference ships per-cloud fetcher scripts that regenerate its hosted
+CSVs from cloud pricing APIs. Here the feed is one JSON document
+(schema: ``catalog/refresh.py``); this tool emits it from the baked-in
+tables so a maintainer can edit prices (or wire a pricing-API scraper
+in) and host the result at ``catalog.feed_url``:
+
+    python -m skypilot_tpu.catalog.data_fetchers --out feed.json
+    # edit feed.json / post-process, then host it; clusters pick it up
+    # within catalog.refresh_ttl_hours.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+from skypilot_tpu.catalog import aws_data, gcp_data
+
+
+def build_feed() -> dict:
+    return {
+        'version': 1,
+        'generated_at': time.time(),
+        'gcp': {
+            'tpu_chip_hour_prices': {
+                gen: list(prices)
+                for gen, prices in gcp_data.TPU_CHIP_HOUR_PRICES.items()
+            },
+            'gpu_offerings': {
+                name: list(entry)
+                for name, entry in gcp_data.GPU_OFFERINGS.items()
+            },
+        },
+        'aws': {
+            'gpu_instance_types': {
+                name: {str(count): list(entry)
+                       for count, entry in shapes.items()}
+                for name, shapes in aws_data.GPU_INSTANCE_TYPES.items()
+            },
+        },
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--out', default='-',
+                        help='output path (default: stdout)')
+    args = parser.parse_args(argv)
+    feed = build_feed()
+    text = json.dumps(feed, indent=2, sort_keys=True)
+    if args.out == '-':
+        print(text)
+    else:
+        with open(args.out, 'w', encoding='utf-8') as f:
+            f.write(text + '\n')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Hosted catalog feed: TTL refresh over the baked-in tables.
+
+Parity: ``sky/catalog/common.py:193-245`` (``read_catalog`` pulls
+versioned hosted CSVs with TTL re-fetch and falls back to the cached
+copy). Stale price data silently corrupts the optimizer's ranking —
+which is the product — so the baked-in tables (``gcp_data``/
+``aws_data``, versioned with the code) act as the always-available
+floor and a configured feed overlays fresher numbers:
+
+* ``catalog.feed_url`` in layered config (or ``SKYT_CATALOG_FEED``) —
+  an ``https://``/``file://``/plain-path JSON document produced by
+  ``python -m skypilot_tpu.catalog.data_fetchers``.
+* Fetched at most once per TTL (``catalog.refresh_ttl_hours``, default
+  24; env ``SKYT_CATALOG_TTL_HOURS``); the last good copy is cached at
+  ``~/.skyt/catalog/feed.json`` and used when the feed is unreachable,
+  so fully offline operation is preserved.
+* ``skyt check`` surfaces staleness (``staleness_warning``).
+
+Feed schema (all sections optional — absent keys keep baked values):
+
+    {"version": 1, "generated_at": 1700000000.0,
+     "gcp": {"tpu_chip_hour_prices": {"v5e": [1.2, 0.54]},
+             "gpu_offerings": {"A100": [2.9, 1.1, 40, "a2"]}},
+     "aws": {"gpu_instance_types": {"A10G": {"1": ["g5.xlarge",
+                                                    1.0, 0.45, 24]}}}}
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+_mem_cache: Dict[str, Tuple[float, Dict[str, Any]]] = {}
+
+
+def _feed_url() -> Optional[str]:
+    url = os.environ.get('SKYT_CATALOG_FEED')
+    if url:
+        return url
+    from skypilot_tpu import config as config_lib
+    return config_lib.get_nested(('catalog', 'feed_url'), None)
+
+
+def _ttl_seconds() -> float:
+    hours = os.environ.get('SKYT_CATALOG_TTL_HOURS')
+    if hours is None:
+        from skypilot_tpu import config as config_lib
+        hours = config_lib.get_nested(('catalog', 'refresh_ttl_hours'), 24)
+    return float(hours) * 3600
+
+
+def cache_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'catalog', 'feed.json')
+
+
+def _fetch(url: str) -> Dict[str, Any]:
+    if url.startswith('file://'):
+        url_path = url[len('file://'):]
+        with open(url_path, encoding='utf-8') as f:
+            return json.load(f)
+    if '://' not in url:
+        with open(url, encoding='utf-8') as f:
+            return json.load(f)
+    with urllib.request.urlopen(url, timeout=20) as resp:
+        return json.loads(resp.read().decode('utf-8'))
+
+
+def get_overlay(refresh: bool = False) -> Dict[str, Any]:
+    """The current catalog overlay ({} when no feed is configured).
+
+    Never raises: fetch failures fall back to the on-disk copy, then to
+    the empty overlay (baked tables only).
+    """
+    url = _feed_url()
+    if not url:
+        return {}
+    now = time.time()
+    cached = _mem_cache.get(url)
+    if not refresh and cached and now - cached[0] < _ttl_seconds():
+        return cached[1]
+    path = cache_path()
+    disk_age = None
+    if os.path.exists(path):
+        disk_age = now - os.path.getmtime(path)
+    if not refresh and disk_age is not None and disk_age < _ttl_seconds():
+        try:
+            with open(path, encoding='utf-8') as f:
+                overlay = json.load(f)
+            _mem_cache[url] = (now, overlay)
+            return overlay
+        except (OSError, json.JSONDecodeError):
+            pass
+    try:
+        overlay = _fetch(url)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(overlay, f)
+        os.replace(tmp, path)
+        _mem_cache[url] = (now, overlay)
+        return overlay
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('catalog feed %s unreachable (%s); using %s', url,
+                       e, 'cached copy' if disk_age is not None
+                       else 'baked-in tables')
+        if os.path.exists(path):
+            try:
+                with open(path, encoding='utf-8') as f:
+                    overlay = json.load(f)
+                _mem_cache[url] = (now, overlay)
+                return overlay
+            except (OSError, json.JSONDecodeError):
+                pass
+        _mem_cache[url] = (now, {})
+        return {}
+
+
+def clear_cache() -> None:
+    _mem_cache.clear()
+
+
+def staleness_warning() -> Optional[str]:
+    """Human warning for `skyt check` when the feed looks stale."""
+    url = _feed_url()
+    if not url:
+        return None
+    overlay = get_overlay()
+    if not overlay:
+        return (f'catalog feed {url} unreachable and no cached copy: '
+                'prices come from the baked-in tables (may be stale)')
+    generated = overlay.get('generated_at')
+    if generated is not None:
+        age_days = (time.time() - float(generated)) / 86400
+        if age_days > 30:
+            return (f'catalog feed is {age_days:.0f} days old; '
+                    'regenerate with skypilot_tpu.catalog.data_fetchers')
+    path = cache_path()
+    if os.path.exists(path):
+        age = time.time() - os.path.getmtime(path)
+        if age > 2 * _ttl_seconds():
+            return (f'catalog cache is {age / 3600:.0f}h old '
+                    '(feed unreachable?); prices may be stale')
+    return None
+
+
+# -- overlay lookups used by catalog/common.py ------------------------------
+
+def tpu_chip_prices(gen: str, baked: Tuple[float, float]
+                    ) -> Tuple[float, float]:
+    entry = get_overlay().get('gcp', {}).get('tpu_chip_hour_prices',
+                                             {}).get(gen)
+    return tuple(entry) if entry else baked
+
+
+def gcp_gpu_offering(name: str, baked):
+    entry = get_overlay().get('gcp', {}).get('gpu_offerings',
+                                             {}).get(name)
+    return tuple(entry) if entry else baked
+
+
+def aws_gpu_instance(name: str, count: int, baked):
+    entry = get_overlay().get('aws', {}).get('gpu_instance_types',
+                                             {}).get(name, {}).get(
+                                                 str(count))
+    return tuple(entry) if entry else baked

@@ -62,10 +62,24 @@ def _check_slurm() -> Tuple[bool, str]:
                   'remote login node)'
 
 
+def _check_aws() -> Tuple[bool, str]:
+    if (os.environ.get('AWS_ACCESS_KEY_ID')
+            and os.environ.get('AWS_SECRET_ACCESS_KEY')):
+        return True, 'static credentials (env)'
+    from skypilot_tpu import config as config_lib
+    if (config_lib.get_nested(('aws', 'access_key_id'), None)
+            and config_lib.get_nested(('aws', 'secret_access_key'),
+                                      None)):
+        return True, 'static credentials (config)'
+    return False, ('no AWS credentials: set AWS_ACCESS_KEY_ID/'
+                   'AWS_SECRET_ACCESS_KEY or aws.* in config')
+
+
 _CHECKS = {
     'local': lambda: (True, 'always available'),
     'fake': lambda: (True, 'always available (simulated cloud)'),
     'gcp': _check_gcp,
+    'aws': _check_aws,
     'kubernetes': _check_kubernetes,
     'ssh': _check_ssh,
     'slurm': _check_slurm,

@@ -198,6 +198,10 @@ def check(verbose: bool) -> None:
         click.echo(f'  {cloud}: {mark}')
         for cap, why in sorted(caps.get(cloud, {}).items()):
             click.echo(f'      no {cap}: {why}')
+    from skypilot_tpu.catalog import refresh as catalog_refresh
+    warning = catalog_refresh.staleness_warning()
+    if warning:
+        click.echo(f'  WARNING: {warning}')
 
 
 @cli.command('show-tpus')

@@ -135,9 +135,11 @@ def candidates_for(resources: Resources,
             # CPU-only: any region works; pick a default region per cloud.
             cpus = resources.cpus[0] if resources.cpus else None
             mem = resources.memory[0] if resources.memory else None
-            instance = pick_cpu_instance_type(cpus, mem)
-            cost = catalog.get_hourly_cost(None, cpus=cpus, memory=mem)
-            region = resources.region or 'us-central1'
+            instance = pick_cpu_instance_type(cpus, mem, cloud=cloud)
+            cost = catalog.get_hourly_cost(None, cloud=cloud, cpus=cpus,
+                                           memory=mem)
+            from skypilot_tpu.catalog.common import default_region
+            region = resources.region or default_region(cloud)
             out.append(Candidate(
                 resources=resources.copy(cloud=cloud, region=region,
                                          instance_type=instance),
@@ -146,12 +148,11 @@ def candidates_for(resources: Resources,
         (name, count), = accels.items()
         offerings = catalog.get_offerings(
             name, count,
+            cloud=cloud,
             num_slices=resources.num_slices,
             topology=resources.accelerator_args.get('topology'),
             region=resources.region,
             zone=resources.zone)
-        # The catalog is GCP-shaped; 'fake' mirrors it (enable_all_clouds-
-        # style offline testing, ref tests/common_test_fixtures.py:195).
         for offering in offerings:
             cost = offering.cost(resources.use_spot)
             out.append(Candidate(

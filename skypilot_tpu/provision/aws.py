@@ -1,0 +1,506 @@
+"""AWS EC2 provider: SigV4-signed Query API over stdlib HTTP.
+
+Parity: ``sky/provision/aws/instance.py`` + ``sky/clouds/aws.py`` — the
+reference's second-biggest driver, built there on boto3. Neither boto3
+nor aws-cli is in this image, so the wire protocol is implemented
+directly (same stance as the GCP driver's urllib REST and the S3
+client's SigV4): the EC2 Query API is form-encoded POST + XML, and
+SigV4 is the same ~40 lines of hmac the S3 client uses.
+
+Cluster identity rides tags (``skyt-cluster``), instances are plain EC2
+VMs (GPU shapes from ``catalog/aws_data.py``), the SSH keypair is
+imported once per account, and a ``skyt-<cluster>`` security group
+opens 22 (+ task ports via ``open_ports``). Network calls go through
+``_request`` so tests stub the transport (tests/test_aws_provider.py,
+mirroring the GCP fake).
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import shutil
+import subprocess
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+from xml.etree import ElementTree
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, CloudCapability,
+                                        HostInfo, Provider,
+                                        ProvisionRequest)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = log.init_logger(__name__)
+
+EC2_API_VERSION = '2016-11-15'
+
+# Error codes -> typed exceptions (parity: FailoverCloudErrorHandlerV2
+# _aws_handler, cloud_vm_ray_backend.py).
+_CAPACITY_CODES = ('InsufficientInstanceCapacity', 'InsufficientCapacity',
+                   'SpotMaxPriceTooLow', 'InsufficientHostCapacity')
+_QUOTA_CODES = ('InstanceLimitExceeded', 'VcpuLimitExceeded',
+                'MaxSpotInstanceCountExceeded', 'RequestLimitExceeded')
+_AUTH_CODES = ('AuthFailure', 'UnauthorizedOperation',
+               'InvalidClientTokenId', 'SignatureDoesNotMatch')
+
+
+def classify_aws_error(code: str, message: str) -> exceptions.ProvisionError:
+    if code in _QUOTA_CODES:
+        return exceptions.QuotaExceededError(f'{code}: {message}')
+    if code in _CAPACITY_CODES:
+        return exceptions.CapacityError(f'{code}: {message}')
+    if code in _AUTH_CODES:
+        return exceptions.NoCloudAccessError(f'{code}: {message}')
+    return exceptions.ProvisionError(f'{code}: {message}')
+
+
+def _credentials() -> tuple:
+    key = os.environ.get('AWS_ACCESS_KEY_ID')
+    secret = os.environ.get('AWS_SECRET_ACCESS_KEY')
+    if not key or not secret:
+        from skypilot_tpu import config as config_lib
+        key = key or config_lib.get_nested(('aws', 'access_key_id'), None)
+        secret = secret or config_lib.get_nested(
+            ('aws', 'secret_access_key'), None)
+    if not key or not secret:
+        raise exceptions.NoCloudAccessError(
+            'AWS credentials not found: set AWS_ACCESS_KEY_ID/'
+            'AWS_SECRET_ACCESS_KEY or aws.access_key_id/'
+            'secret_access_key in config')
+    return key, secret
+
+
+def ssh_key_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'keys', 'aws', 'skyt-aws-key')
+
+
+def ensure_ssh_keypair() -> tuple:
+    """(private_key_path, public_key_text); generated once per install."""
+    key_path = ssh_key_path()
+    pub_path = key_path + '.pub'
+    if not os.path.exists(key_path):
+        os.makedirs(os.path.dirname(key_path), exist_ok=True)
+        if not shutil.which('ssh-keygen'):
+            raise exceptions.ProvisionError(
+                'ssh-keygen not available; cannot generate the AWS '
+                'cluster SSH keypair')
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+             '-C', 'skyt-aws', '-f', key_path], check=True)
+    with open(pub_path, encoding='utf-8') as f:
+        return key_path, f.read().strip()
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _flatten_params(params: Dict[str, Any]) -> Dict[str, str]:
+    """Nested dicts/lists -> the Query API's dotted/indexed flat keys."""
+    flat: Dict[str, str] = {}
+
+    def put(prefix: str, value: Any) -> None:
+        if isinstance(value, dict):
+            for k, v in value.items():
+                put(f'{prefix}.{k}' if prefix else k, v)
+        elif isinstance(value, (list, tuple)):
+            for i, v in enumerate(value, start=1):
+                put(f'{prefix}.{i}', v)
+        elif isinstance(value, bool):
+            flat[prefix] = 'true' if value else 'false'
+        else:
+            flat[prefix] = str(value)
+
+    put('', params)
+    return flat
+
+
+class _Xml:
+    """Namespace-insensitive helpers over the EC2 response XML."""
+
+    @staticmethod
+    def strip(tag: str) -> str:
+        return tag.split('}', 1)[1] if '}' in tag else tag
+
+    @classmethod
+    def find_all(cls, node, name: str) -> List[Any]:
+        return [c for c in node.iter() if cls.strip(c.tag) == name]
+
+    @classmethod
+    def child_text(cls, node, name: str) -> Optional[str]:
+        for child in node:
+            if cls.strip(child.tag) == name:
+                return child.text
+        return None
+
+
+@CLOUD_REGISTRY.register('aws')
+class AwsProvider(Provider):
+    """Plain-EC2 clusters; every host is one instance."""
+
+    name = 'aws'
+
+    @classmethod
+    def unsupported_features(cls) -> Dict[CloudCapability, str]:
+        return {
+            CloudCapability.VOLUMES:
+                'EBS volume provisioning is not wired up yet',
+        }
+
+    # -- transport (stubbed in tests) ----------------------------------
+
+    def _request(self, action: str, params: Dict[str, Any],
+                 region: str) -> ElementTree.Element:
+        """One signed EC2 Query API call; returns the parsed XML root."""
+        key_id, secret = _credentials()
+        host = f'ec2.{region}.amazonaws.com'
+        flat = dict(_flatten_params(params))
+        flat['Action'] = action
+        flat['Version'] = EC2_API_VERSION
+        body = urllib.parse.urlencode(sorted(flat.items())).encode()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+        datestamp = now.strftime('%Y%m%d')
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = {
+            'content-type': 'application/x-www-form-urlencoded',
+            'host': host,
+            'x-amz-date': amz_date,
+        }
+        signed_headers = ';'.join(sorted(headers))
+        canonical_headers = ''.join(
+            f'{k}:{headers[k]}\n' for k in sorted(headers))
+        canonical_request = '\n'.join(
+            ['POST', '/', '', canonical_headers, signed_headers,
+             payload_hash])
+        scope = f'{datestamp}/{region}/ec2/aws4_request'
+        string_to_sign = '\n'.join([
+            'AWS4-HMAC-SHA256', amz_date, scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest()])
+        k_date = _sign(f'AWS4{secret}'.encode(), datestamp)
+        k_region = _sign(k_date, region)
+        k_service = _sign(k_region, 'ec2')
+        k_signing = _sign(k_service, 'aws4_request')
+        signature = hmac.new(k_signing, string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        headers['Authorization'] = (
+            f'AWS4-HMAC-SHA256 Credential={key_id}/{scope}, '
+            f'SignedHeaders={signed_headers}, Signature={signature}')
+        req = urllib.request.Request(f'https://{host}/', data=body,
+                                     headers=headers, method='POST')
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return ElementTree.fromstring(resp.read())
+        except urllib.error.HTTPError as e:
+            text = e.read().decode('utf-8', errors='replace')
+            try:
+                root = ElementTree.fromstring(text)
+                err = _Xml_first_error(root)
+            except ElementTree.ParseError:
+                err = (str(e.code), text[:300])
+            raise classify_aws_error(*err) from None
+        except exceptions.ProvisionError:
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            # URLError / socket timeouts / parse failures: typed, so
+            # failover and cleanup paths keyed on ProvisionError work.
+            raise exceptions.ProvisionError(
+                f'EC2 {action} in {region} failed: {e}') from e
+
+    # -- identity tags -------------------------------------------------
+
+    @staticmethod
+    def _cluster_filter(cluster_name: str) -> Dict[str, Any]:
+        return {'Filter': [
+            {'Name': 'tag:skyt-cluster', 'Value': [cluster_name]},
+            {'Name': 'instance-state-name',
+             'Value': ['pending', 'running', 'stopping', 'stopped']},
+        ]}
+
+    def _describe(self, cluster_name: str, region: str,
+                  include_terminated: bool = False) -> List[Dict[str, Any]]:
+        params = self._cluster_filter(cluster_name)
+        if include_terminated:
+            params['Filter'][1]['Value'].extend(
+                ['shutting-down', 'terminated'])
+        root = self._request('DescribeInstances', params, region)
+        out = []
+        for inst in _Xml.find_all(root, 'instancesSet'):
+            for item in inst:
+                if _Xml.strip(item.tag) != 'item':
+                    continue
+                tags = {}
+                for tag_item in _Xml.find_all(item, 'tagSet'):
+                    for t in tag_item:
+                        k = _Xml.child_text(t, 'key')
+                        v = _Xml.child_text(t, 'value')
+                        if k:
+                            tags[k] = v or ''
+                state_el = next(iter(_Xml.find_all(item, 'instanceState')),
+                                None)
+                out.append({
+                    'instance_id': _Xml.child_text(item, 'instanceId'),
+                    'state': (_Xml.child_text(state_el, 'name')
+                              if state_el is not None else 'unknown'),
+                    'private_ip': _Xml.child_text(item,
+                                                  'privateIpAddress'),
+                    'public_ip': _Xml.child_text(item, 'ipAddress'),
+                    'zone': next(
+                        (_Xml.child_text(p, 'availabilityZone')
+                         for p in _Xml.find_all(item, 'placement')), None),
+                    'tags': tags,
+                })
+        out.sort(key=lambda i: int(i['tags'].get('skyt-node', 0)))
+        return out
+
+    def _region_of(self, cluster_name: str) -> Optional[str]:
+        from skypilot_tpu import state
+        record = state.get_cluster(cluster_name)
+        if record and record.handle.get('provider') == 'aws':
+            return record.handle.get('region')
+        return None
+
+    # -- security group / keypair --------------------------------------
+
+    def _ensure_keypair(self, region: str) -> str:
+        _, pub = ensure_ssh_keypair()
+        name = 'skyt-aws-key'
+        root = self._request('DescribeKeyPairs', {}, region)
+        existing = {_Xml.child_text(i, 'keyName')
+                    for i in _Xml.find_all(root, 'item')}
+        if name not in existing:
+            import base64
+            self._request('ImportKeyPair', {
+                'KeyName': name,
+                'PublicKeyMaterial':
+                    base64.b64encode(pub.encode()).decode(),
+            }, region)
+        return name
+
+    def _ensure_security_group(self, cluster_name: str,
+                               region: str) -> str:
+        name = f'skyt-{cluster_name}'
+        root = self._request('DescribeSecurityGroups', {'Filter': [
+            {'Name': 'group-name', 'Value': [name]}]}, region)
+        for item in _Xml.find_all(root, 'item'):
+            gid = _Xml.child_text(item, 'groupId')
+            if gid and _Xml.child_text(item, 'groupName') == name:
+                return gid
+        created = self._request('CreateSecurityGroup', {
+            'GroupName': name,
+            'GroupDescription': f'skyt cluster {cluster_name}',
+        }, region)
+        gid = next((e.text for e in created.iter()
+                    if _Xml.strip(e.tag) == 'groupId'), name)
+        self._authorize_ingress(gid, ['22'], region)
+        return gid
+
+    def _authorize_ingress(self, group_id: str, ports: List[str],
+                           region: str) -> None:
+        perms = []
+        for port in ports:
+            lo, _, hi = str(port).partition('-')
+            perms.append({
+                'IpProtocol': 'tcp',
+                'FromPort': int(lo),
+                'ToPort': int(hi or lo),
+                'IpRanges': [{'CidrIp': '0.0.0.0/0'}],
+            })
+        try:
+            self._request('AuthorizeSecurityGroupIngress', {
+                'GroupId': group_id, 'IpPermissions': perms}, region)
+        except exceptions.ProvisionError as e:
+            if 'InvalidPermission.Duplicate' not in str(e):
+                raise
+
+    # -- instance selection --------------------------------------------
+
+    @staticmethod
+    def _instance_type(resources) -> str:
+        from skypilot_tpu.catalog import aws_data
+        if resources.instance_type:
+            return resources.instance_type
+        accels = resources.accelerators
+        if accels:
+            (name, count), = accels.items()
+            picked = aws_data.instance_type_for(name, count)
+            if picked is None:
+                raise exceptions.ProvisionError(
+                    f'no AWS instance shape for {count}x {name}; known: '
+                    f'{sorted(aws_data.GPU_INSTANCE_TYPES)}')
+            return picked[0]
+        from skypilot_tpu.catalog.common import pick_cpu_instance_type
+        cpus = resources.cpus[0] if resources.cpus else None
+        mem = resources.memory[0] if resources.memory else None
+        # Raises ResourcesUnavailableError when nothing satisfies the
+        # request — never silently under-provisions.
+        return pick_cpu_instance_type(cpus, mem, cloud='aws')
+
+    @staticmethod
+    def _image_id(resources) -> str:
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.catalog import aws_data
+        return (resources.image_id or
+                config_lib.get_nested(('aws', 'ami_id'), None) or
+                aws_data.DEFAULT_AMI_SSM)
+
+    # -- Provider API --------------------------------------------------
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        region = request.region
+        existing = self._describe(request.cluster_name, region)
+        if request.resume and existing:
+            stopped = [i['instance_id'] for i in existing
+                       if i['state'] == 'stopped']
+            if stopped:
+                self._request('StartInstances',
+                              {'InstanceId': stopped}, region)
+            return self._cluster_info(request.cluster_name, region)
+        if existing:
+            raise exceptions.ProvisionError(
+                f'cluster {request.cluster_name} already has instances '
+                f'in {region}; use resume or terminate first')
+        key_name = self._ensure_keypair(region)
+        group_id = self._ensure_security_group(request.cluster_name,
+                                               region)
+        if request.ports:
+            self._authorize_ingress(group_id, request.ports, region)
+        params: Dict[str, Any] = {
+            'ImageId': self._image_id(request.resources),
+            'InstanceType': self._instance_type(request.resources),
+            'MinCount': request.num_nodes,
+            'MaxCount': request.num_nodes,
+            'KeyName': key_name,
+            'SecurityGroupId': [group_id],
+            'TagSpecification': [{
+                'ResourceType': 'instance',
+                'Tag': [{'Key': 'skyt-cluster',
+                         'Value': request.cluster_name},
+                        {'Key': 'Name',
+                         'Value': request.cluster_name}] +
+                       [{'Key': k, 'Value': v}
+                        for k, v in request.labels.items()],
+            }],
+        }
+        if request.zone:
+            params['Placement'] = {'AvailabilityZone': request.zone}
+        if request.resources.use_spot:
+            params['InstanceMarketOptions'] = {'MarketType': 'spot'}
+        root = self._request('RunInstances', params, region)
+        ids = [_Xml.child_text(i, 'instanceId')
+               for i in _Xml.find_all(root, 'item')
+               if _Xml.child_text(i, 'instanceId')]
+        # Per-node rank tags (instance order within the reservation is
+        # the node order).
+        for idx, iid in enumerate(ids):
+            self._request('CreateTags', {
+                'ResourceId': [iid],
+                'Tag': [{'Key': 'skyt-node', 'Value': str(idx)}],
+            }, region)
+        logger.info('AWS: launched %d x %s in %s for %s', len(ids),
+                    params['InstanceType'], region, request.cluster_name)
+        return self._cluster_info(request.cluster_name, region)
+
+    def _cluster_info(self, cluster_name: str, region: str) -> ClusterInfo:
+        instances = self._describe(cluster_name, region)
+        hosts = [
+            HostInfo(
+                instance_id=i['instance_id'],
+                internal_ip=i['private_ip'] or '',
+                external_ip=i['public_ip'],
+                node_index=int(i['tags'].get('skyt-node', idx)),
+                worker_index=0,
+                tags=i['tags'],
+            ) for idx, i in enumerate(instances)
+        ]
+        from skypilot_tpu import config as config_lib
+        return ClusterInfo(
+            cluster_name=cluster_name,
+            provider='aws',
+            region=region,
+            zone=instances[0]['zone'] if instances else None,
+            hosts=hosts,
+            ssh_user=config_lib.get_nested(('aws', 'ssh_user'), 'ubuntu'),
+            ssh_key_path=ssh_key_path(),
+        )
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return None
+        info = self._cluster_info(cluster_name, region)
+        return info if info.hosts else None
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return {}
+        state_map = {
+            'pending': 'starting', 'running': 'running',
+            'stopping': 'stopping', 'stopped': 'stopped',
+            'shutting-down': 'terminating', 'terminated': 'terminated',
+        }
+        return {
+            i['instance_id']: state_map.get(i['state'], i['state'])
+            for i in self._describe(cluster_name, region,
+                                    include_terminated=True)
+        }
+
+    def _instance_ids(self, cluster_name: str, region: str) -> List[str]:
+        return [i['instance_id']
+                for i in self._describe(cluster_name, region)]
+
+    def stop_instances(self, cluster_name: str) -> None:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return
+        ids = self._instance_ids(cluster_name, region)
+        if ids:
+            self._request('StopInstances', {'InstanceId': ids}, region)
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return
+        ids = self._instance_ids(cluster_name, region)
+        if ids:
+            self._request('TerminateInstances', {'InstanceId': ids},
+                          region)
+        try:
+            root = self._request('DescribeSecurityGroups', {'Filter': [
+                {'Name': 'group-name',
+                 'Value': [f'skyt-{cluster_name}']}]}, region)
+            for item in _Xml.find_all(root, 'item'):
+                gid = _Xml.child_text(item, 'groupId')
+                if gid:
+                    self._request('DeleteSecurityGroup',
+                                  {'GroupId': gid}, region)
+        except exceptions.ProvisionError as e:
+            # Group deletion races instance shutdown; leave it for the
+            # next terminate (parity: the reference retries SG cleanup).
+            logger.debug('SG cleanup deferred: %s', e)
+
+    def open_ports(self, cluster_name: str, ports: List[str]) -> None:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return
+        gid = self._ensure_security_group(cluster_name, region)
+        self._authorize_ingress(gid, ports, region)
+
+
+def _Xml_first_error(root) -> tuple:
+    code = msg = None
+    for el in root.iter():
+        tag = _Xml.strip(el.tag)
+        if tag == 'Code' and code is None:
+            code = el.text
+        elif tag == 'Message' and msg is None:
+            msg = el.text
+    return code or 'Unknown', msg or 'unknown AWS error'

@@ -320,15 +320,18 @@ class Daemon:
                   encoding='utf-8') as f:
             f.write(str(os.getpid()))
         while True:
+            # Self-reap check FIRST: if the runtime dir is gone,
+            # _schedule_jobs/_heartbeat raise and would skip a check
+            # placed after them in the try block — spinning forever.
+            if self._superseded():
+                logger.info('Runtime dir gone or daemon superseded; '
+                            'exiting')
+                return
             try:
                 self._schedule_jobs()
                 self._heartbeat()
                 if self._check_autostop():
                     logger.info('Cluster gone/stopped; daemon exiting')
-                    return
-                if self._superseded():
-                    logger.info('Runtime dir gone or daemon superseded; '
-                                'exiting')
                     return
             except Exception as e:  # pylint: disable=broad-except
                 logger.error('Daemon event error: %s', e, exc_info=True)
