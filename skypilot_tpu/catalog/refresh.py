@@ -87,23 +87,33 @@ def get_overlay(refresh: bool = False) -> Dict[str, Any]:
     if not refresh and cached and now - cached[0] < _ttl_seconds():
         return cached[1]
     path = cache_path()
+
+    def read_disk():
+        """Cached overlay, ONLY if it came from this url (a changed
+        feed_url must not serve the old feed's prices)."""
+        try:
+            with open(path, encoding='utf-8') as f:
+                doc = json.load(f)
+            if doc.get('_source_url') == url:
+                return doc['overlay']
+        except (OSError, json.JSONDecodeError, KeyError):
+            pass
+        return None
+
     disk_age = None
     if os.path.exists(path):
         disk_age = now - os.path.getmtime(path)
     if not refresh and disk_age is not None and disk_age < _ttl_seconds():
-        try:
-            with open(path, encoding='utf-8') as f:
-                overlay = json.load(f)
+        overlay = read_disk()
+        if overlay is not None:
             _mem_cache[url] = (now, overlay)
             return overlay
-        except (OSError, json.JSONDecodeError):
-            pass
     try:
         overlay = _fetch(url)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + '.tmp'
         with open(tmp, 'w', encoding='utf-8') as f:
-            json.dump(overlay, f)
+            json.dump({'_source_url': url, 'overlay': overlay}, f)
         os.replace(tmp, path)
         _mem_cache[url] = (now, overlay)
         return overlay
@@ -111,16 +121,11 @@ def get_overlay(refresh: bool = False) -> Dict[str, Any]:
         logger.warning('catalog feed %s unreachable (%s); using %s', url,
                        e, 'cached copy' if disk_age is not None
                        else 'baked-in tables')
-        if os.path.exists(path):
-            try:
-                with open(path, encoding='utf-8') as f:
-                    overlay = json.load(f)
-                _mem_cache[url] = (now, overlay)
-                return overlay
-            except (OSError, json.JSONDecodeError):
-                pass
-        _mem_cache[url] = (now, {})
-        return {}
+        overlay = read_disk()
+        if overlay is None:
+            overlay = {}
+        _mem_cache[url] = (now, overlay)
+        return overlay
 
 
 def clear_cache() -> None:

@@ -408,13 +408,25 @@ def api_stop() -> None:
               help='Remote API server URL, e.g. http://skyt.corp:46590')
 @click.option('--token', '-t', default=None,
               help='Bearer token (prompted for if omitted and required).')
-def api_login(endpoint: str, token: Optional[str]) -> None:
+@click.option('--sso', is_flag=True, default=False,
+              help='Browser flow: sign in on the server login page; '
+                   'the minted token lands here via a localhost '
+                   'callback (parity: sky/client/oauth.py).')
+def api_login(endpoint: str, token: Optional[str], sso: bool) -> None:
     """Point this client at a (remote) API server and store credentials
-    (parity: `sky api login`; the token replaces the browser OAuth flow
-    — mint one with `skyt users token`)."""
+    (parity: `sky api login`; --sso is the browser flow, or mint a
+    token with `skyt users token`)."""
     endpoint = endpoint.rstrip('/')
     if not sdk.api_is_healthy(endpoint):
         raise click.ClickException(f'No healthy API server at {endpoint}')
+    if sso:
+        from skypilot_tpu.client.oauth import browser_login
+        token, user = browser_login(endpoint)
+        from skypilot_tpu import config
+        config.set_nested(('api_server', 'endpoint'), endpoint)
+        config.set_nested(('api_server', 'token'), token)
+        click.echo(f'Logged in to {endpoint} as {user} (token stored).')
+        return
     from skypilot_tpu import config
     import requests as requests_lib
     headers = {'Authorization': f'Bearer {token}'} if token else {}

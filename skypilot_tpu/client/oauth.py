@@ -1,0 +1,89 @@
+"""Browser-flow login: localhost callback server + server login page.
+
+Parity: ``sky/client/oauth.py`` — the CLI starts a loopback HTTP
+listener, opens the server's ``/auth/login`` page with
+``redirect_uri=http://127.0.0.1:<port>/callback``, and the server
+redirects the browser back with a freshly-minted token; the CLI
+captures it without the user pasting anything. No IdP dependency: the
+server's login page authenticates whatever credential the deployment
+uses (static operator token or a user token), which is what the
+reference's OAuth2-proxy indirection ultimately does too.
+"""
+from __future__ import annotations
+
+import threading
+import urllib.parse
+import webbrowser
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Optional, Tuple
+
+DEFAULT_TIMEOUT_SECONDS = 300.0
+
+
+class _Callback(BaseHTTPRequestHandler):
+    token: Optional[str] = None
+    user: Optional[str] = None
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def do_GET(self):  # noqa: N802
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(self.path).query)
+        type(self).token = (query.get('token') or [None])[0]
+        type(self).user = (query.get('user') or [None])[0]
+        ok = type(self).token is not None
+        body = (b'<html><body><h3>Login complete - return to your '
+                b'terminal.</h3></body></html>' if ok else
+                b'<html><body><h3>Login failed: no token in '
+                b'callback.</h3></body></html>')
+        self.send_response(200 if ok else 400)
+        self.send_header('Content-Type', 'text/html')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def login_url(endpoint: str, callback_port: int) -> str:
+    redirect = f'http://127.0.0.1:{callback_port}/callback'
+    return (f'{endpoint}/auth/login?' +
+            urllib.parse.urlencode({'redirect_uri': redirect}))
+
+
+def browser_login(endpoint: str,
+                  timeout: float = DEFAULT_TIMEOUT_SECONDS,
+                  open_browser: bool = True) -> Tuple[str, str]:
+    """(token, user_name) once the browser round-trip completes."""
+
+    class Handler(_Callback):
+        token = None
+        user = None
+
+    server = HTTPServer(('127.0.0.1', 0), Handler)
+    port = server.server_address[1]
+    done = threading.Event()
+
+    def serve_one():
+        server.handle_request()  # exactly one callback hit
+        done.set()
+
+    thread = threading.Thread(target=serve_one, daemon=True)
+    thread.start()
+    url = login_url(endpoint, port)
+    print(f'Opening {url}\n(continue in the browser; waiting for the '
+          'callback...)')
+    if open_browser:
+        try:
+            webbrowser.open(url)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    try:
+        if not done.wait(timeout):
+            raise TimeoutError(
+                f'no login callback within {timeout:.0f}s; open {url} '
+                'manually or use --token')
+        if Handler.token is None:
+            raise RuntimeError('login callback carried no token')
+        return Handler.token, Handler.user or 'unknown'
+    finally:
+        server.server_close()

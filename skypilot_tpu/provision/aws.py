@@ -271,7 +271,11 @@ class AwsProvider(Provider):
 
     def _ensure_keypair(self, region: str) -> str:
         _, pub = ensure_ssh_keypair()
-        name = 'skyt-aws-key'
+        # Key NAME embeds the pubkey digest: a regenerated local key
+        # gets a fresh EC2 keypair instead of silently diverging from
+        # an old upload with the same name (unreachable instances).
+        digest = hashlib.sha256(pub.encode()).hexdigest()[:12]
+        name = f'skyt-aws-key-{digest}'
         root = self._request('DescribeKeyPairs', {}, region)
         existing = {_Xml.child_text(i, 'keyName')
                     for i in _Xml.find_all(root, 'item')}

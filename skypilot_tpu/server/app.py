@@ -21,6 +21,7 @@ import tarfile
 import tempfile
 import time
 import urllib.parse
+from html import escape as html_escape
 from http import HTTPStatus
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -40,7 +41,9 @@ DEFAULT_PORT = 46590
 # sky/server/server.py exempts /api/health from the auth middlewares;
 # /api/metrics is scraped by Prometheus which typically has no user token,
 # matching the reference's separate unauthenticated metrics port).
-_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics', '/dashboard'})
+# /auth/login is the browser entry point — it must render unauthenticated
+# and then SET the session (the dashboard itself requires it).
+_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics', '/auth/login'})
 
 
 def _auth_enabled() -> bool:
@@ -53,6 +56,26 @@ def _auth_enabled() -> bool:
 
 def _uploads_dir() -> str:
     return os.path.join(requests_db.server_dir(), 'uploads')
+
+
+def _expiry(body: Dict[str, Any]) -> Optional[float]:
+    """Validated optional expires_seconds (user error -> 400, not 500)."""
+    value = body.get('expires_seconds')
+    if value is None:
+        return None
+    if not isinstance(value, (int, float)) or isinstance(value, bool) \
+            or value <= 0:
+        raise ValueError(
+            f'expires_seconds must be a positive number, got {value!r}')
+    return float(value)
+
+
+def _can_view(user, request) -> bool:
+    """Per-workspace 'view' grant for a request record (bindings close
+    a workspace's requests/logs, not just its submissions)."""
+    from skypilot_tpu.users import rbac as rbac_lib
+    workspace = getattr(request, 'workspace', None) or 'default'
+    return rbac_lib.check_workspace_access(user, workspace, 'view')
 
 
 class ApiHandler(BaseHTTPRequestHandler):
@@ -101,18 +124,35 @@ class ApiHandler(BaseHTTPRequestHandler):
         if self._route in _AUTH_EXEMPT or not _auth_enabled():
             return True, None
         header = self.headers.get('Authorization', '')
-        if not header.startswith('Bearer '):
+        if header.startswith('Bearer '):
+            token = header[len('Bearer '):].strip()
+            user = self._user_for_token(token)
+            if user is not None:
+                return True, user
             return False, None
-        token = header[len('Bearer '):].strip()
+        # Session cookie (browser/dashboard requests carry no bearer).
+        from skypilot_tpu.server import sessions
+        cookie = sessions.read_cookie(self.headers.get('Cookie'))
+        if cookie:
+            name = sessions.verify(cookie)
+            if name == 'operator':
+                return True, users_db.UserRecord(
+                    name='operator', role='admin', created_at=0.0)
+            if name is not None:
+                user = users_db.get_user(name)
+                if user is not None:
+                    return True, user
+        return False, None
+
+    @staticmethod
+    def _user_for_token(token: str
+                        ) -> Optional[users_db.UserRecord]:
         static = os.environ.get('SKYT_API_SERVER_TOKEN')
         if static and hmac.compare_digest(token, static):
             # The operator's deployment token acts as a built-in admin.
-            return True, users_db.UserRecord(name='operator', role='admin',
-                                             created_at=0.0)
-        user = users_db.authenticate(token)
-        if user is None:
-            return False, None
-        return True, user
+            return users_db.UserRecord(name='operator', role='admin',
+                                       created_at=0.0)
+        return users_db.authenticate(token)
 
     def _deny(self) -> None:
         self.send_response(HTTPStatus.UNAUTHORIZED)
@@ -134,6 +174,8 @@ class ApiHandler(BaseHTTPRequestHandler):
                 return
             if route == '/api/tunnel':
                 self._handle_tunnel()
+            elif route == '/auth/login':
+                self._handle_login()
             elif route == '/api/cancel':
                 body = self._json_body()
                 ok = executor_lib.cancel_request(body['request_id'])
@@ -142,16 +184,23 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._handle_upload()
             elif route.startswith('/api/users'):
                 self._handle_users_post(route, user)
+            elif route == '/api/workspaces/set-role':
+                self._handle_workspace_role(user)
             elif route.lstrip('/') in payloads.PAYLOADS:
                 name = route.lstrip('/')
                 body = self._json_body()
+                workspace = self.headers.get('X-Skyt-Workspace')
+                # Per-workspace bindings: a bound workspace admits only
+                # its members (rbac.check_workspace_access).
+                rbac.require_workspace_access(user, workspace or 'default',
+                                              'use')
                 _, schedule_type = payloads.PAYLOADS[name]
                 request_id = requests_db.create(
                     name, body, schedule_type,
                     user=(user.name if user else
                           self.headers.get('X-Skyt-User')),
                     idem_key=self.headers.get('X-Skyt-Idempotency-Key'),
-                    workspace=self.headers.get('X-Skyt-Workspace'))
+                    workspace=workspace)
                 self._reply({'request_id': request_id})
             else:
                 self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
@@ -190,10 +239,120 @@ class ApiHandler(BaseHTTPRequestHandler):
                 raise ValueError('name required when auth is disabled')
             if user is not None and target != user.name:
                 rbac.require_permission(user, 'users.token.other')
-            token = users_db.create_token(target, body.get('label', ''))
+            token = users_db.create_token(
+                target, body.get('label', ''),
+                expires_seconds=_expiry(body))
             self._reply({'token': token, 'name': target})
+        elif route == '/api/users/service-account':
+            # Machine principals with optionally-expiring tokens
+            # (parity: sky/users/token_service.py SA tokens).
+            rbac.require_permission(user, 'users.create')
+            record, token = users_db.create_service_account(
+                body['name'], body.get('label', ''),
+                expires_seconds=_expiry(body))
+            self._reply({'name': record.name, 'role': record.role,
+                         'token': token})
         else:
             self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
+
+    def _handle_workspace_role(self, user) -> None:
+        """Set/remove a per-workspace role binding. Global admins or the
+        workspace's own admins may manage bindings."""
+        body = self._json_body()
+        workspace = body['workspace']
+        is_ws_admin = (user is not None and
+                       rbac.workspace_role(user, workspace) == 'admin')
+        if not is_ws_admin:
+            rbac.require_permission(user, 'workspaces.update')
+        role = body.get('role')
+        if role:
+            users_db.set_workspace_role(workspace, body['name'], role)
+        else:
+            users_db.remove_workspace_role(workspace, body['name'])
+        self._reply({'workspace': workspace, 'name': body['name'],
+                     'role': role})
+
+    # -- browser login (parity: sky/client/oauth.py callback flow +
+    # server.py session handling) --------------------------------------
+
+    _LOGIN_HTML = """<!doctype html><html><head><title>skyt login</title>
+<style>body{{font-family:system-ui;margin:4em auto;max-width:24em}}
+input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
+<h2>skypilot-tpu login</h2>
+<form method="post" action="/auth/login">
+<input type="hidden" name="redirect_uri" value="{redirect}"/>
+<input type="password" name="token" placeholder="API token" autofocus/>
+<input type="submit" value="Sign in"/>
+</form>{error}</body></html>"""
+
+    def _render_login_form(self, error: str = '') -> None:
+        redirect = self._query.get('redirect_uri', '/dashboard')
+        body = self._LOGIN_HTML.format(
+            redirect=html_escape(redirect, quote=True),
+            error=f'<p style="color:#b00">{html_escape(error)}</p>'
+                  if error else '').encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/html; charset=utf-8')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _handle_login(self) -> None:
+        """POST /auth/login: token -> session cookie (+ redirect).
+
+        Browser flow: the form posts here, the session cookie admits
+        the dashboard. CLI flow (`skyt api login --sso`): redirect_uri
+        is a loopback callback; a FRESH token is minted and appended to
+        it so the browser hands credentials to the CLI without the user
+        pasting anything.
+        """
+        from skypilot_tpu.server import sessions
+        length = int(self.headers.get('Content-Length', 0))
+        raw = self.rfile.read(length).decode('utf-8', 'replace')
+        ctype = self.headers.get('Content-Type', '')
+        if 'json' in ctype:
+            form = json.loads(raw or '{}')
+        else:
+            form = {k: v[0] for k, v in
+                    urllib.parse.parse_qs(raw).items()}
+        token = (form.get('token') or '').strip()
+        redirect = form.get('redirect_uri') or '/dashboard'
+        user = self._user_for_token(token) if token else None
+        if user is None:
+            self._render_login_form(error='invalid token')
+            return
+        # Redirect targets are a token-exfiltration surface: ONLY exact
+        # loopback hosts (the CLI callback) or same-origin paths are
+        # honored — a prefix match would let localhost.evil.com receive
+        # a minted token.
+        parsed = urllib.parse.urlparse(redirect)
+        is_loopback = (parsed.scheme == 'http' and
+                       parsed.hostname in ('127.0.0.1', 'localhost',
+                                           '::1'))
+        if not is_loopback:
+            if parsed.scheme or parsed.netloc or not \
+                    redirect.startswith('/') or redirect.startswith('//'):
+                self._render_login_form(
+                    error='redirect_uri must be a loopback URL or a '
+                          'same-origin path')
+                return
+        cookie = sessions.mint(user.name)
+        if is_loopback:
+            # CLI callback: mint a fresh stored token (the static
+            # operator token is passed through as-is — it has no user
+            # row to mint against).
+            if user.name == 'operator':
+                fresh = token
+            else:
+                fresh = users_db.create_token(user.name, 'browser-login')
+            sep = '&' if '?' in redirect else '?'
+            redirect = f'{redirect}{sep}' + urllib.parse.urlencode(
+                {'token': fresh, 'user': user.name})
+        self.send_response(HTTPStatus.SEE_OTHER)
+        self.send_header('Location', redirect)
+        self.send_header('Set-Cookie', sessions.set_cookie_header(cookie))
+        self.send_header('Content-Length', '0')
+        self.end_headers()
 
     def _handle_tunnel(self) -> None:
         """Duplex byte tunnel to a cluster head host's SSH port.
@@ -288,11 +447,24 @@ class ApiHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802
         route = self._route
         try:
-            authorized, _user = self._authenticate()
+            authorized, user = self._authenticate()
             if not authorized:
+                if route == '/dashboard':
+                    # Browsers get the login form, not a JSON 401.
+                    self.send_response(HTTPStatus.FOUND)
+                    self.send_header('Location',
+                                     '/auth/login?redirect_uri=/dashboard')
+                    self.send_header('Content-Length', '0')
+                    self.end_headers()
+                    return
                 self._deny()
                 return
-            if route == '/api/health':
+            if route == '/auth/login':
+                self._render_login_form()
+            elif route == '/api/workspaces/roles':
+                self._reply(users_db.list_workspace_roles(
+                    self._query.get('workspace')))
+            elif route == '/api/health':
                 self._reply({
                     'status': 'healthy',
                     'version': skypilot_tpu.__version__,
@@ -324,14 +496,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
             elif route == '/api/get':
-                self._handle_get()
+                self._handle_get(user)
             elif route == '/api/stream':
-                self._handle_stream()
+                self._handle_stream(user)
             elif route == '/api/requests':
                 status = self._query.get('status')
                 reqs = requests_db.list_requests(
                     RequestStatus(status) if status else None)
-                self._reply([r.to_dict() for r in reqs])
+                # Bound workspaces hide their requests from non-members
+                # (the 'view' grant — bodies carry task defs/env vars).
+                self._reply([r.to_dict() for r in reqs
+                             if _can_view(user, r)])
             else:
                 self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
         except (BrokenPipeError, ConnectionResetError):
@@ -344,7 +519,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionResetError):
                 pass
 
-    def _handle_get(self) -> None:
+    def _handle_get(self, user=None) -> None:
         """Block (bounded) until the request is terminal; client re-polls."""
         query = self._query
         request_id = query.get('request_id', '')
@@ -356,12 +531,17 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._error(HTTPStatus.NOT_FOUND,
                             f'no request {request_id}')
                 return
+            if not _can_view(user, request):
+                self._error(HTTPStatus.FORBIDDEN,
+                            f'no view access to workspace '
+                            f'{request.workspace!r}')
+                return
             if request.status.is_terminal() or time.time() > deadline:
                 self._reply(request.to_dict())
                 return
             time.sleep(0.05)
 
-    def _handle_stream(self) -> None:
+    def _handle_stream(self, user=None) -> None:
         """Chunked tail of a request's log until it finishes.
 
         ``tail_from=<byte offset>`` resumes a cut stream without replaying
@@ -372,6 +552,11 @@ class ApiHandler(BaseHTTPRequestHandler):
         request = requests_db.get(request_id)
         if request is None:
             self._error(HTTPStatus.NOT_FOUND, f'no request {request_id}')
+            return
+        if not _can_view(user, request):
+            self._error(HTTPStatus.FORBIDDEN,
+                        f'no view access to workspace '
+                        f'{request.workspace!r}')
             return
         log_path = requests_db.request_log_path(request.request_id)
         self.send_response(200)

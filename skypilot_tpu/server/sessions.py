@@ -1,0 +1,100 @@
+"""Signed session cookies for the dashboard (parity: the session layer
+of ``sky/server/server.py:337-591`` basic-auth + cookie handling).
+
+Stateless, HMAC-signed values — no session table: the cookie carries
+``user|expiry|hmac(secret, user|expiry)`` with the per-install secret
+kept under the server state dir. Browser logins (``/auth/login``) set
+it; dashboard routes accept it interchangeably with a bearer token.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import secrets
+import time
+from typing import Optional
+
+COOKIE_NAME = 'skyt_session'
+DEFAULT_TTL_SECONDS = 12 * 3600
+
+
+def _secret_path() -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'server', 'session_secret')
+
+
+def _secret() -> bytes:
+    path = _secret_path()
+    for _ in range(2):
+        try:
+            with open(path, 'rb') as f:
+                value = f.read()
+            if value:  # complete write (atomic rename below)
+                return value
+        except OSError:
+            pass
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        value = secrets.token_bytes(32)
+        # Fully write a private temp, then link it into place: link(2)
+        # is atomic and fails if the name exists, so a reader can never
+        # observe a partial secret and concurrent creators converge on
+        # one winner.
+        tmp = f'{path}.{os.getpid()}.tmp'
+        with open(tmp, 'wb') as f:
+            f.write(value)
+        os.chmod(tmp, 0o600)
+        try:
+            os.link(tmp, path)
+            return value
+        except FileExistsError:
+            pass  # lost the race: loop re-reads the winner's secret
+        finally:
+            os.unlink(tmp)
+    raise RuntimeError(f'could not create or read {path}')
+
+
+def _sign(payload: str) -> str:
+    return hmac.new(_secret(), payload.encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def mint(user_name: str, ttl_seconds: float = DEFAULT_TTL_SECONDS) -> str:
+    expiry = int(time.time() + ttl_seconds)
+    payload = f'{user_name}|{expiry}'
+    return f'{payload}|{_sign(payload)}'
+
+
+def verify(cookie_value: str) -> Optional[str]:
+    """Cookie value -> user name, or None (bad signature / expired)."""
+    parts = cookie_value.rsplit('|', 1)
+    if len(parts) != 2:
+        return None
+    payload, signature = parts
+    if not hmac.compare_digest(_sign(payload), signature):
+        return None
+    try:
+        user_name, expiry = payload.rsplit('|', 1)
+        if time.time() > int(expiry):
+            return None
+    except ValueError:
+        return None
+    return user_name
+
+
+def set_cookie_header(value: str,
+                      ttl_seconds: float = DEFAULT_TTL_SECONDS) -> str:
+    return (f'{COOKIE_NAME}={value}; Path=/; Max-Age={int(ttl_seconds)}; '
+            'HttpOnly; SameSite=Lax')
+
+
+def read_cookie(cookie_header: Optional[str]) -> Optional[str]:
+    """Extract the session cookie value from a Cookie header."""
+    if not cookie_header:
+        return None
+    for part in cookie_header.split(';'):
+        name, _, value = part.strip().partition('=')
+        if name == COOKIE_NAME and value:
+            return value
+    return None

@@ -38,3 +38,54 @@ def require_permission(user: Optional[UserRecord], action: str) -> None:
     if not check_permission(user, action):
         raise PermissionError(
             f'user {user.name!r} (role {user.role}) may not {action}')
+
+
+# -- per-workspace bindings (parity: sky/users/permission.py's
+# workspace-scoped casbin policies) -----------------------------------------
+
+# binding role -> workspace actions it grants
+_WS_GRANTS = {
+    'viewer': frozenset({'view'}),
+    'editor': frozenset({'view', 'use'}),
+    'admin': frozenset({'view', 'use', 'admin'}),
+}
+
+
+def workspace_role(user: Optional[UserRecord],
+                   workspace: str) -> Optional[str]:
+    if user is None:
+        return None
+    from skypilot_tpu.users import users_db
+    return users_db.get_workspace_role(workspace, user.name)
+
+
+def check_workspace_access(user: Optional[UserRecord], workspace: str,
+                           action: str = 'use') -> bool:
+    """True when `user` may perform `action` ('view'|'use'|'admin') in
+    `workspace`.
+
+    A workspace with NO bindings is open to every authenticated user
+    (the pre-bindings behavior — bindings are opt-in per workspace); the
+    moment any binding exists, membership is required. Global admins
+    always pass; ``None`` user = auth disabled = allow.
+    """
+    if user is None:
+        return True
+    if user.role == ROLE_ADMIN:
+        return True
+    from skypilot_tpu.users import users_db
+    bindings = users_db.list_workspace_roles(workspace)
+    if not bindings:
+        return True
+    role = users_db.get_workspace_role(workspace, user.name)
+    if role is None:
+        return False
+    return action in _WS_GRANTS.get(role, frozenset())
+
+
+def require_workspace_access(user: Optional[UserRecord], workspace: str,
+                             action: str = 'use') -> None:
+    if not check_workspace_access(user, workspace, action):
+        raise PermissionError(
+            f'user {user.name!r} has no {action!r} access to workspace '
+            f'{workspace!r} (ask a workspace admin for a role binding)')

@@ -20,7 +20,18 @@ from typing import List, Optional
 
 ROLE_ADMIN = 'admin'
 ROLE_USER = 'user'
-_ROLES = (ROLE_ADMIN, ROLE_USER)
+# Service accounts (parity: sky/users/token_service.py SA tokens):
+# machine principals — tokens may carry an expiry, and they never hold
+# admin rights regardless of bindings.
+ROLE_SERVICE = 'service'
+_ROLES = (ROLE_ADMIN, ROLE_USER, ROLE_SERVICE)
+
+# Per-workspace binding roles (parity: sky/users/permission.py's
+# casbin policies keyed on workspace).
+WS_ROLE_ADMIN = 'admin'
+WS_ROLE_EDITOR = 'editor'
+WS_ROLE_VIEWER = 'viewer'
+_WS_ROLES = (WS_ROLE_ADMIN, WS_ROLE_EDITOR, WS_ROLE_VIEWER)
 
 TOKEN_PREFIX = 'skyt'
 
@@ -57,7 +68,17 @@ def _db() -> sqlite3.Connection:
             created_at REAL NOT NULL,
             last_used_at REAL
         );
+        CREATE TABLE IF NOT EXISTS workspace_roles (
+            workspace TEXT NOT NULL,
+            user_name TEXT NOT NULL,
+            role TEXT NOT NULL,
+            PRIMARY KEY (workspace, user_name)
+        );
     """)
+    try:  # migration: token expiry (column added after first release)
+        conn.execute('ALTER TABLE tokens ADD COLUMN expires_at REAL')
+    except sqlite3.OperationalError:
+        pass
     conn.commit()
     _local.conn = conn
     _local.path = path
@@ -128,24 +149,48 @@ def _hash(secret: str, salt: str) -> str:
     return hashlib.sha256(f'{salt}:{secret}'.encode()).hexdigest()
 
 
-def create_token(user_name: str, label: str = '') -> str:
-    """Mint a bearer token for a user; the cleartext is returned ONCE."""
+def create_token(user_name: str, label: str = '',
+                 expires_seconds: Optional[float] = None) -> str:
+    """Mint a bearer token for a user; the cleartext is returned ONCE.
+
+    ``expires_seconds`` bounds the token's life (service-account
+    hygiene); None = no expiry (human tokens, revocable by id).
+    """
     if get_user(user_name) is None:
         raise ValueError(f'no user {user_name!r}')
     token_id = secrets.token_hex(4)
     secret = secrets.token_urlsafe(24)
     salt = secrets.token_hex(8)
+    expires_at = (time.time() + expires_seconds
+                  if expires_seconds else None)
     conn = _db()
     conn.execute(
         'INSERT INTO tokens (token_id, user_name, salt, secret_hash, label, '
-        'created_at) VALUES (?, ?, ?, ?, ?, ?)',
-        (token_id, user_name, salt, _hash(secret, salt), label, time.time()))
+        'created_at, expires_at) VALUES (?, ?, ?, ?, ?, ?, ?)',
+        (token_id, user_name, salt, _hash(secret, salt), label,
+         time.time(), expires_at))
     conn.commit()
     return f'{TOKEN_PREFIX}_{token_id}_{secret}'
 
 
+def create_service_account(name: str, label: str = '',
+                           expires_seconds: Optional[float] = None
+                           ) -> tuple:
+    """(UserRecord, token): a machine principal + its bearer token in
+    one step (parity: sky/users/token_service.py service accounts)."""
+    user = get_user(name)
+    if user is None:
+        user = create_user(name, ROLE_SERVICE)
+    elif user.role != ROLE_SERVICE:
+        raise ValueError(f'{name!r} exists and is not a service account')
+    token = create_token(name, label or 'service-account',
+                         expires_seconds)
+    return user, token
+
+
 def authenticate(token: str) -> Optional[UserRecord]:
-    """Token -> user, or None. Constant-time secret comparison."""
+    """Token -> user, or None. Constant-time secret comparison;
+    expired tokens never authenticate."""
     parts = token.split('_', 2)
     if len(parts) != 3 or parts[0] != TOKEN_PREFIX:
         return None
@@ -157,6 +202,9 @@ def authenticate(token: str) -> Optional[UserRecord]:
         return None
     if not hmac.compare_digest(_hash(secret, row['salt']),
                                row['secret_hash']):
+        return None
+    expires_at = row['expires_at'] if 'expires_at' in row.keys() else None
+    if expires_at is not None and time.time() > expires_at:
         return None
     conn.execute('UPDATE tokens SET last_used_at = ? WHERE token_id = ?',
                  (time.time(), token_id))
@@ -178,3 +226,53 @@ def revoke_token(token_id: str) -> bool:
     cur = conn.execute('DELETE FROM tokens WHERE token_id = ?', (token_id,))
     conn.commit()
     return cur.rowcount > 0
+
+
+# -- per-workspace role bindings -------------------------------------------
+
+def set_workspace_role(workspace: str, user_name: str, role: str) -> None:
+    if role not in _WS_ROLES:
+        raise ValueError(
+            f'unknown workspace role {role!r} (expected {_WS_ROLES})')
+    user = get_user(user_name)
+    if user is None:
+        raise ValueError(f'no user {user_name!r}')
+    if user.role == ROLE_SERVICE and role == WS_ROLE_ADMIN:
+        # Machine principals never administer workspaces (they could
+        # then grant/revoke human bindings).
+        raise ValueError(
+            f'service account {user_name!r} cannot be a workspace '
+            "admin (use 'editor' or 'viewer')")
+    conn = _db()
+    conn.execute(
+        'INSERT INTO workspace_roles (workspace, user_name, role) '
+        'VALUES (?, ?, ?) ON CONFLICT (workspace, user_name) '
+        'DO UPDATE SET role = excluded.role',
+        (workspace, user_name, role))
+    conn.commit()
+
+
+def remove_workspace_role(workspace: str, user_name: str) -> bool:
+    conn = _db()
+    cur = conn.execute(
+        'DELETE FROM workspace_roles WHERE workspace = ? AND '
+        'user_name = ?', (workspace, user_name))
+    conn.commit()
+    return cur.rowcount > 0
+
+
+def get_workspace_role(workspace: str, user_name: str) -> Optional[str]:
+    row = _db().execute(
+        'SELECT role FROM workspace_roles WHERE workspace = ? AND '
+        'user_name = ?', (workspace, user_name)).fetchone()
+    return row['role'] if row else None
+
+
+def list_workspace_roles(workspace: Optional[str] = None) -> List[dict]:
+    q = 'SELECT workspace, user_name, role FROM workspace_roles'
+    args: tuple = ()
+    if workspace:
+        q += ' WHERE workspace = ?'
+        args = (workspace,)
+    q += ' ORDER BY workspace, user_name'
+    return [dict(r) for r in _db().execute(q, args).fetchall()]

@@ -194,7 +194,7 @@ def test_run_instances_full_lifecycle(fake):
     assert info.hosts[0].external_ip.startswith('54.0.0.')
     assert info.ssh_user == 'ubuntu'
     # keypair imported once; SG created with port 22 open
-    assert 'skyt-aws-key' in fake.key_pairs
+    assert any(k.startswith('skyt-aws-key-') for k in fake.key_pairs)
     assert (22, 22) in fake.groups['skyt-aws-c1']['ports']
     # GPU shape resolution: 1x A10G -> g5.xlarge
     run_call = next(p for a, p, _ in fake.calls if a == 'RunInstances')

@@ -106,8 +106,8 @@ def test_unreachable_feed_falls_back_to_cache_then_baked(
     overlay = refresh.get_overlay()
     assert overlay['gcp']['tpu_chip_hour_prices']['v5e'] == [9.99, 4.44]
     assert _os.path.exists(refresh.cache_path())
-    # Point at a dead URL: the cached copy serves.
-    monkeypatch.setenv('SKYT_CATALOG_FEED', str(feed) + '.missing')
+    # The feed becomes unreachable (same URL): the cached copy serves.
+    _os.rename(str(feed), str(feed) + '.hidden')
     refresh.clear_cache()
     overlay2 = refresh.get_overlay(refresh=True)
     assert overlay2.get('gcp', {}).get('tpu_chip_hour_prices',
