@@ -60,11 +60,16 @@ def browser_login(endpoint: str,
         user = None
 
     server = HTTPServer(('127.0.0.1', 0), Handler)
+    server.timeout = 1.0
     port = server.server_address[1]
     done = threading.Event()
 
     def serve_one():
-        server.handle_request()  # exactly one callback hit
+        # Keep serving until the TOKEN callback lands: browsers open
+        # speculative/preconnect requests (favicon, prefetch) that must
+        # not consume the listener.
+        while not done.is_set() and Handler.token is None:
+            server.handle_request()
         done.set()
 
     thread = threading.Thread(target=serve_one, daemon=True)
@@ -86,4 +91,5 @@ def browser_login(endpoint: str,
             raise RuntimeError('login callback carried no token')
         return Handler.token, Handler.user or 'unknown'
     finally:
+        done.set()  # stop the serve loop before closing the socket
         server.server_close()

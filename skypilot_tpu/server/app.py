@@ -78,6 +78,24 @@ def _can_view(user, request) -> bool:
     return rbac_lib.check_workspace_access(user, workspace, 'view')
 
 
+def _view_filter(user):
+    """Request-visibility predicate with ONE bindings query (listings
+    check N rows; per-row check_workspace_access would be ~2N queries
+    on the serving thread)."""
+    if user is None or user.role == 'admin':
+        return lambda request: True
+    from skypilot_tpu.users import users_db as users_db_lib
+    bound: Dict[str, set] = {}
+    for b in users_db_lib.list_workspace_roles():
+        bound.setdefault(b['workspace'], set()).add(b['user_name'])
+    def ok(request) -> bool:
+        workspace = getattr(request, 'workspace', None) or 'default'
+        members = bound.get(workspace)
+        # Unbound workspace: open. Bound: any binding grants 'view'.
+        return members is None or user.name in members
+    return ok
+
+
 class ApiHandler(BaseHTTPRequestHandler):
     protocol_version = 'HTTP/1.1'
     server_version = 'skypilot-tpu-api'
@@ -178,6 +196,12 @@ class ApiHandler(BaseHTTPRequestHandler):
                 self._handle_login()
             elif route == '/api/cancel':
                 body = self._json_body()
+                request = requests_db.get(body['request_id'])
+                if request is not None:
+                    # Same gate as submission: cancelling a bound
+                    # workspace's work needs the 'use' grant.
+                    rbac.require_workspace_access(
+                        user, request.workspace or 'default', 'use')
                 ok = executor_lib.cancel_request(body['request_id'])
                 self._reply({'cancelled': ok})
             elif route == '/upload':
@@ -285,8 +309,13 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
 <input type="submit" value="Sign in"/>
 </form>{error}</body></html>"""
 
-    def _render_login_form(self, error: str = '') -> None:
-        redirect = self._query.get('redirect_uri', '/dashboard')
+    def _render_login_form(self, error: str = '',
+                           redirect: Optional[str] = None) -> None:
+        # On a failed POST the redirect_uri came from the FORM, not the
+        # URL query — preserve it or an --sso retry lands on /dashboard
+        # and the CLI callback starves.
+        if redirect is None:
+            redirect = self._query.get('redirect_uri', '/dashboard')
         body = self._LOGIN_HTML.format(
             redirect=html_escape(redirect, quote=True),
             error=f'<p style="color:#b00">{html_escape(error)}</p>'
@@ -319,7 +348,8 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
         redirect = form.get('redirect_uri') or '/dashboard'
         user = self._user_for_token(token) if token else None
         if user is None:
-            self._render_login_form(error='invalid token')
+            self._render_login_form(error='invalid token',
+                                    redirect=redirect)
             return
         # Redirect targets are a token-exfiltration surface: ONLY exact
         # loopback hosts (the CLI callback) or same-origin paths are
@@ -485,7 +515,25 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 self.wfile.write(body)
             elif route == '/api/dashboard/data':
                 from skypilot_tpu.server import dashboard
-                self._reply(dashboard.collect_data())
+                self._reply(dashboard.collect_data(
+                    request_filter=_view_filter(user)))
+            elif route == '/api/dashboard/job-log':
+                from skypilot_tpu.server import dashboard
+                raw_id = self._query.get('job_id', '0')
+                try:
+                    job_id = int(raw_id)
+                except ValueError:
+                    self._error(HTTPStatus.BAD_REQUEST,
+                                f'job_id must be an integer, got '
+                                f'{raw_id!r}')
+                    return
+                body = dashboard.job_log_tail(job_id).encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'text/plain; charset=utf-8')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif route == '/api/metrics':
                 from skypilot_tpu.server import metrics
                 body = metrics.render_text().encode()
@@ -505,8 +553,8 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                     RequestStatus(status) if status else None)
                 # Bound workspaces hide their requests from non-members
                 # (the 'view' grant — bodies carry task defs/env vars).
-                self._reply([r.to_dict() for r in reqs
-                             if _can_view(user, r)])
+                viewer = _view_filter(user)
+                self._reply([r.to_dict() for r in reqs if viewer(r)])
             else:
                 self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
         except (BrokenPipeError, ConnectionResetError):

@@ -8,6 +8,14 @@ in-process) and renders clusters, managed jobs, services, pools,
 volumes, workspaces and recent requests. Deliberately server-local:
 every byte comes from the same process that owns the DBs, so the
 dashboard works on an air-gapped TPU pod head node.
+
+v2 (r2 verdict #8 — parity of *information* with the Next.js app's
+pages, not of framework): an infra section (per-cloud credential
+status + capability limits, ``sky/dashboard/src/pages/infra``), users
++ workspace role bindings admin data
+(``src/pages/users``/``workspaces``), per-request drill-down (full
+request record + its log tail via ``/api/stream``) and per-managed-job
+controller log view (``/api/dashboard/job-log``).
 """
 from __future__ import annotations
 
@@ -15,8 +23,47 @@ import time
 from typing import Any, Dict
 
 
-def collect_data() -> Dict[str, Any]:
-    """Everything the dashboard shows, in one JSON document."""
+def collect_infra() -> 'list[Dict[str, Any]]':
+    """Per-cloud credential/capability rows (ref dashboard infra page).
+
+    Uses the TTL-cached probe results — rendering the dashboard must
+    not hammer cloud auth endpoints on every poll.
+    """
+    from skypilot_tpu import check as check_lib
+    caps = check_lib.capabilities()
+    rows = []
+    for cloud, (ok, reason) in sorted(check_lib.check().items()):
+        limits = '; '.join(f'no {cap}' for cap in sorted(
+            caps.get(cloud, {}))) or ''
+        rows.append({'cloud': cloud,
+                     'status': 'ENABLED' if ok else 'DISABLED',
+                     'detail': reason, 'limits': limits})
+    return rows
+
+
+def job_log_tail(job_id: int, max_bytes: int = 64 * 1024) -> str:
+    """Tail of a managed job's controller log (drill-down view)."""
+    import os
+    from skypilot_tpu.jobs import state as jobs_state
+    path = jobs_state.controller_log_path(int(job_id))
+    try:
+        size = os.path.getsize(path)
+        with open(path, 'rb') as f:
+            if size > max_bytes:
+                f.seek(size - max_bytes)
+            return f.read().decode('utf-8', errors='replace')
+    except OSError:
+        return f'(no controller log at {path})'
+
+
+def collect_data(request_filter=None) -> Dict[str, Any]:
+    """Everything the dashboard shows, in one JSON document.
+
+    ``request_filter`` (a predicate over request records — the server
+    passes its per-user workspace-view filter) keeps bound workspaces'
+    request metadata out of non-members' dashboards, matching the
+    /api/requests enforcement.
+    """
     from skypilot_tpu import state, volumes, workspaces
     from skypilot_tpu.jobs import state as jobs_state
     from skypilot_tpu.serve import serve_state
@@ -59,15 +106,24 @@ def collect_data() -> Dict[str, Any]:
             row)
 
     recent_requests = [{
-        'request_id': r.request_id[:8],
+        'request_id': r.request_id,
+        'short_id': r.request_id[:8],
         'name': r.name,
         'status': r.status.value,
         'user': r.user,
+        'workspace': r.workspace,
         'created_at': r.created_at,
-    } for r in requests_db.list_requests(limit=25)]
+    } for r in requests_db.list_requests(limit=25)
+      if request_filter is None or request_filter(r)]
+
+    from skypilot_tpu.users import users_db
+    users = [{'name': u.name, 'role': u.role} for u in
+             users_db.list_users()]
+    bindings = users_db.list_workspace_roles()
 
     return {
         'generated_at': time.time(),
+        'infra': collect_infra(),
         'clusters': clusters,
         'jobs': jobs,
         'services': services,
@@ -79,6 +135,8 @@ def collect_data() -> Dict[str, Any]:
                                or '(any)'}
             for name, spec in sorted(workspaces.list_workspaces().items())
         ],
+        'users': users,
+        'bindings': bindings,
         'requests': recent_requests,
     }
 
@@ -112,16 +170,26 @@ DASHBOARD_HTML = """<!doctype html>
 <body>
 <h1>skypilot-tpu <span class="muted">dashboard</span></h1>
 <div id="updated">loading…</div>
+<div id="panel" style="display:none; position:fixed; inset:8% 10%;
+     overflow:auto; border:1px solid currentColor; border-radius:8px;
+     background:Canvas; padding:1rem; z-index:10;">
+  <a href="#" onclick="return hidePanel()" style="float:right">close</a>
+  <h2 id="panel-title"></h2>
+  <pre id="panel-body" style="white-space:pre-wrap; font-size:.8rem;"></pre>
+</div>
 <div id="content"></div>
 <script>
 const SECTIONS = [
+  ['Infra', 'infra', ['cloud','status','detail','limits']],
   ['Clusters', 'clusters', ['name','status','cloud','region','resources','nodes','workspace','hourly_cost','age']],
-  ['Managed jobs', 'jobs', ['job_id','name','status','cluster_name','recoveries']],
+  ['Managed jobs', 'jobs', ['job_id','name','status','cluster_name','recoveries','logs']],
   ['Services', 'services', ['name','status','replicas']],
   ['Pools', 'pools', ['name','status','replicas']],
   ['Volumes', 'volumes', ['name','type','size_gb','status','attached']],
   ['Workspaces', 'workspaces', ['name','allowed_clouds']],
-  ['Recent requests', 'requests', ['request_id','name','status','user']],
+  ['Users', 'users', ['name','role']],
+  ['Workspace role bindings', 'bindings', ['workspace','user_name','role']],
+  ['Recent requests', 'requests', ['short_id','name','status','user','workspace','detail']],
 ];
 function fmtAge(s) {
   if (s == null) return '';
@@ -137,10 +205,14 @@ function esc(v) {
 }
 const STATUS_CLASSES = new Set(['UP','READY','SUCCEEDED','RUNNING','INIT',
   'PENDING','STARTING','RECOVERING','REPLICA_INIT','STOPPED','FAILED',
-  'FAILED_PROVISION','CANCELLED','CONTROLLER_FAILED']);
+  'FAILED_PROVISION','CANCELLED','CONTROLLER_FAILED','ENABLED','DISABLED']);
 function cell(row, col) {
   if (col === 'age') return fmtAge(row.age_s);
   if (col === 'attached') return esc((row.attached_to||[]).join(', '));
+  if (col === 'logs')  // managed-job controller log drill-down
+    return `<a href="#" onclick="return showJobLog(${Number(row.job_id)||0})">view</a>`;
+  if (col === 'detail' && row.request_id)  // request drill-down
+    return `<a href="#" onclick="return showRequest('${esc(row.request_id)}')">open</a>`;
   if (col === 'status') {
     const v = String(row.status || '');
     const cls = STATUS_CLASSES.has(v) ? v : '';
@@ -148,6 +220,38 @@ function cell(row, col) {
   }
   const v = row[col];
   return v === null || v === undefined ? '' : esc(v);
+}
+async function showPanel(title, loader) {
+  const panel = document.getElementById('panel');
+  const body = document.getElementById('panel-body');
+  document.getElementById('panel-title').textContent = title;
+  body.textContent = 'loading…';
+  panel.style.display = 'block';
+  try { body.textContent = await loader(); }
+  catch (e) { body.textContent = 'error: ' + e; }
+  return false;
+}
+function hidePanel() {
+  document.getElementById('panel').style.display = 'none';
+  return false;
+}
+function showJobLog(jobId) {
+  return showPanel('controller log — job ' + jobId, async () => {
+    const r = await fetch('/api/dashboard/job-log?job_id=' + jobId);
+    return await r.text();
+  });
+}
+function showRequest(requestId) {
+  return showPanel('request ' + requestId.slice(0, 8), async () => {
+    const rec = await (await fetch(
+      '/api/get?request_id=' + requestId + '&timeout=0')).json();
+    let log = '';
+    try {
+      log = await (await fetch('/api/stream?request_id=' + requestId +
+                               '&follow=false')).text();
+    } catch (e) { log = '(no log: ' + e + ')'; }
+    return JSON.stringify(rec, null, 2) + '\\n\\n--- log ---\\n' + log;
+  });
 }
 function render(data) {
   let html = '';

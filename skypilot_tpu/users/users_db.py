@@ -100,6 +100,12 @@ def create_user(name: str, role: str = ROLE_USER) -> UserRecord:
         raise ValueError(f'unknown role {role!r} (expected one of {_ROLES})')
     if not name or '/' in name:
         raise ValueError(f'invalid user name {name!r}')
+    if name == 'operator':
+        # Reserved: the static deployment token's synthetic admin
+        # identity — a DB row with this name would let its session
+        # cookie escalate to admin.
+        raise ValueError("'operator' is a reserved name (the static "
+                         'deployment token identity)')
     conn = _db()
     now = time.time()
     try:

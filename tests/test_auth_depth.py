@@ -311,3 +311,50 @@ def test_expires_seconds_validation(auth_server):
                                     'expires_seconds': bad},
                               headers=_hdr(admin_token), timeout=10)
         assert r.status_code == 400, (bad, r.text)
+
+
+def test_operator_name_reserved(tmp_home):
+    with pytest.raises(ValueError, match='reserved'):
+        users_db.create_user('operator')
+
+
+def test_bound_workspace_blocks_cancel(auth_server):
+    srv, admin_token = auth_server
+    users_db.create_user('member2')
+    users_db.create_user('outsider2')
+    users_db.set_workspace_role('sec2', 'member2', 'editor')
+    member = users_db.create_token('member2')
+    outsider = users_db.create_token('outsider2')
+    rid = requests_lib.post(
+        f'{srv.url}/launch',
+        json={'cluster_name': 'c', 'task': {'run': 'true'}},
+        headers={**_hdr(member), 'X-Skyt-Workspace': 'sec2'},
+        timeout=10).json()['request_id']
+    blocked = requests_lib.post(f'{srv.url}/api/cancel',
+                                json={'request_id': rid},
+                                headers=_hdr(outsider), timeout=10)
+    assert blocked.status_code == 403
+    allowed = requests_lib.post(f'{srv.url}/api/cancel',
+                                json={'request_id': rid},
+                                headers=_hdr(member), timeout=10)
+    assert allowed.status_code == 200
+
+
+def test_dashboard_data_hides_bound_workspace_requests(auth_server):
+    srv, admin_token = auth_server
+    users_db.create_user('m3')
+    users_db.create_user('o3')
+    users_db.set_workspace_role('sec3', 'm3', 'editor')
+    member = users_db.create_token('m3')
+    outsider = users_db.create_token('o3')
+    rid = requests_lib.post(
+        f'{srv.url}/launch',
+        json={'cluster_name': 'c', 'task': {'run': 'true'}},
+        headers={**_hdr(member), 'X-Skyt-Workspace': 'sec3'},
+        timeout=10).json()['request_id']
+    data = requests_lib.get(f'{srv.url}/api/dashboard/data',
+                            headers=_hdr(outsider), timeout=10).json()
+    assert rid not in {r['request_id'] for r in data['requests']}
+    data_m = requests_lib.get(f'{srv.url}/api/dashboard/data',
+                              headers=_hdr(member), timeout=10).json()
+    assert rid in {r['request_id'] for r in data_m['requests']}

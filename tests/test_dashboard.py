@@ -65,3 +65,56 @@ def test_dashboard_data_respects_auth(server, monkeypatch):
         f'{server.url}/api/dashboard/data', timeout=10,
         headers={'Authorization': 'Bearer secret-token'})
     assert resp.status_code == 200
+
+
+def test_dashboard_v2_sections(tmp_home):
+    """Infra / users / bindings data + request drill-down fields
+    (VERDICT r2 next #8: parity of information with the ref app)."""
+    from skypilot_tpu.server import dashboard
+    from skypilot_tpu.users import users_db
+    users_db.create_user('ada', role='admin')
+    users_db.create_user('bob')
+    users_db.set_workspace_role('research', 'bob', 'viewer')
+    data = dashboard.collect_data()
+    infra = {row['cloud']: row for row in data['infra']}
+    assert infra['fake']['status'] == 'ENABLED'
+    assert infra['local']['status'] == 'ENABLED'
+    assert 'gcp' in infra
+    assert {u['name'] for u in data['users']} == {'ada', 'bob'}
+    assert data['bindings'] == [
+        {'workspace': 'research', 'user_name': 'bob', 'role': 'viewer'}]
+    # Requests carry the full id for drill-down plus the short label.
+    from skypilot_tpu.server import requests_db
+    requests_db.reset_db_for_tests()
+    rid = requests_db.create('launch', {},
+                             requests_db.ScheduleType.SHORT)
+    data = dashboard.collect_data()
+    row = next(r for r in data['requests'] if r['request_id'] == rid)
+    assert row['short_id'] == rid[:8]
+    requests_db.reset_db_for_tests()
+
+
+def test_job_log_route(tmp_home):
+    import os
+    import requests as requests_lib
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.server.app import ApiServer
+    path = jobs_state.controller_log_path(7)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write('recovery attempt 1\nrunning\n')
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    try:
+        r = requests_lib.get(f'{srv.url}/api/dashboard/job-log?job_id=7',
+                             timeout=10)
+        assert r.status_code == 200
+        assert 'recovery attempt 1' in r.text
+        missing = requests_lib.get(
+            f'{srv.url}/api/dashboard/job-log?job_id=999', timeout=10)
+        assert 'no controller log' in missing.text
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
