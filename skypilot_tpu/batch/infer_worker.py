@@ -1,0 +1,62 @@
+"""Batch-inference mapper: the worker side of recipe://batch-inference.
+
+Contract (batch/coordinator.py): read the JSONL slice at $BATCH_INPUT
+({"prompt": ...} per record), write completions to $BATCH_OUTPUT. The
+engine loads once per worker process and serves every slice the
+coordinator routes here (parity: the reference's llm/batch_inference
+workers run vLLM over their shard).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from skypilot_tpu.utils.jax_env import honor_jax_platforms
+    honor_jax_platforms()
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny',
+                        help='registered model name OR an HF checkpoint '
+                             'dir (config.json + safetensors + '
+                             'tokenizer.json)')
+    parser.add_argument('--max-new-tokens', type=int, default=128)
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--max-batch', type=int, default=8)
+    parser.add_argument('--input', default=None,
+                        help='override $BATCH_INPUT (local testing)')
+    parser.add_argument('--output', default=None,
+                        help='override $BATCH_OUTPUT (local testing)')
+    args = parser.parse_args(argv)
+
+    in_path = args.input or os.environ['BATCH_INPUT']
+    out_path = args.output or os.environ['BATCH_OUTPUT']
+    records = []
+    with open(in_path, encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+
+    from skypilot_tpu.inference.engine import InferenceEngine
+    if os.path.isdir(args.model):
+        engine = InferenceEngine(hf_checkpoint=args.model,
+                                 max_batch=args.max_batch)
+    else:
+        engine = InferenceEngine(args.model, max_batch=args.max_batch)
+    prompts = [r.get('prompt', '') for r in records]
+    completions = engine.generate_text(
+        prompts, max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature)
+    with open(out_path, 'w', encoding='utf-8') as f:
+        for record, completion in zip(records, completions):
+            f.write(json.dumps({**record, 'completion': completion})
+                    + '\n')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

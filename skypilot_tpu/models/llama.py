@@ -182,16 +182,24 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
 def _attention_block(x: jax.Array, lp: Params, cfg: ModelConfig,
                      sin: jax.Array, cos: jax.Array,
                      rules: LogicalAxisRules,
-                     segments: Optional[jax.Array] = None) -> jax.Array:
+                     segments: Optional[jax.Array] = None,
+                     lora_params: Optional[Params] = None) -> jax.Array:
     dt = cfg.compute_dtype
     # checkpoint_name tags make these saveable under the selective remat
     # policies (save_attn/save_dots) without saving everything else.
-    q = checkpoint_name(
-        jnp.einsum('bsd,dhk->bshk', x, lp['wq'].astype(dt)), 'query_proj')
+    q = jnp.einsum('bsd,dhk->bshk', x, lp['wq'].astype(dt))
     k = checkpoint_name(
         jnp.einsum('bsd,dhk->bshk', x, lp['wk'].astype(dt)), 'key_proj')
-    v = checkpoint_name(
-        jnp.einsum('bsd,dhk->bshk', x, lp['wv'].astype(dt)), 'value_proj')
+    v = jnp.einsum('bsd,dhk->bshk', x, lp['wv'].astype(dt))
+    if lora_params is not None:
+        # LoRA deltas on q/v (models/lora.py) — base weights stay
+        # frozen; adapters ride the layer scan stacked like the bases.
+        from skypilot_tpu.models.lora import apply_lora_qv
+        dq, dv = apply_lora_qv(x, lora_params)
+        q = q + dq
+        v = v + dv
+    q = checkpoint_name(q, 'query_proj')
+    v = checkpoint_name(v, 'value_proj')
     q = with_logical_constraint(q, ('batch', 'act_seq', 'act_heads', None),
                                 rules=rules)
     k = with_logical_constraint(k, ('batch', 'act_seq', 'act_kv_heads', None),
@@ -263,7 +271,8 @@ def _decoder_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
                    segments: Optional[jax.Array] = None) -> jax.Array:
     h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
     x = x + _attention_block(h, lp['attn'], cfg, sin, cos, rules,
-                             segments=segments)
+                             segments=segments,
+                             lora_params=lp.get('lora'))
     h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
     if cfg.is_moe:
         x = x + _moe_block(h, lp['moe'], cfg, rules)
@@ -364,9 +373,13 @@ def forward(params: Params,
             out, _ = jax.lax.scan(scan_body, xi, stage_lp)
             return out
 
+        layer_axes = param_logical_axes(cfg)['layers']
+        if 'lora' in params['layers']:
+            from skypilot_tpu.models.lora import lora_logical_axes
+            layer_axes = dict(layer_axes)
+            layer_axes['lora'] = lora_logical_axes()
         stage_params = pipeline.stage_stack(
-            params['layers'], param_logical_axes(cfg)['layers'],
-            pipeline_stages, rules)
+            params['layers'], layer_axes, pipeline_stages, rules)
         num_micro = (pipeline_microbatches or
                      pipeline.default_num_microbatches(
                          tokens.shape[0], pipeline_stages))

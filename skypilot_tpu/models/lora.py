@@ -1,0 +1,115 @@
+"""LoRA adapters for the stacked-layer Llama pytree.
+
+Parity: the reference's finetuning recipes
+(``/root/reference/llm/llama-3_1-finetuning/`` runs torchtune
+lora_finetune_distributed). TPU-first shape: adapters live INSIDE the
+layer pytree (``params['layers']['lora']``) stacked on the leading
+layer axis, so the decoder's single ``lax.scan`` body picks them up
+with no model-code changes beyond the attention block — one compiled
+layer regardless of depth, and the adapter matmuls fuse into the
+surrounding einsums.
+
+Standard recipe: adapters on the attention q/v projections
+(``W_eff = W + (alpha/r) * A @ B``), A ~ N(0, 1/r), B = 0 — the model
+starts exactly at the base checkpoint. ``merge`` folds adapters into
+dense weights for export (an HF checkpoint servable anywhere, no
+adapter runtime needed).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.config import ModelConfig
+
+Params = Dict[str, Any]
+
+DEFAULT_ALPHA = 16.0
+
+
+def init_lora_params(rng: jax.Array, cfg: ModelConfig, rank: int,
+                     dtype=jnp.float32) -> Params:
+    """Stacked adapter pytree for q/v projections ([L, ...] leading)."""
+    d, hd = cfg.d_model, cfg.resolved_head_dim
+    h, kv, n = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    ks = jax.random.split(rng, 2)
+    std = rank ** -0.5
+
+    def a_init(key):
+        return std * jax.random.normal(key, (n, d, rank), jnp.float32
+                                       ).astype(dtype)
+
+    return {
+        'wq_a': a_init(ks[0]),
+        'wq_b': jnp.zeros((n, rank, h, hd), dtype),
+        'wv_a': a_init(ks[1]),
+        'wv_b': jnp.zeros((n, rank, kv, hd), dtype),
+    }
+
+
+def lora_logical_axes() -> Params:
+    """Adapter ranks replicate; the head axes shard like their bases."""
+    return {
+        'wq_a': ('layers', 'embed', None),
+        'wq_b': ('layers', None, 'heads', 'head_dim'),
+        'wv_a': ('layers', 'embed', None),
+        'wv_b': ('layers', None, 'kv_heads', 'head_dim'),
+    }
+
+
+def lora_scale(rank: int, alpha: float = DEFAULT_ALPHA) -> float:
+    return alpha / rank
+
+
+def apply_lora_qv(x: jax.Array, lora: Params):
+    """(delta_q, delta_v) for the attention block: [B,S,H,hd] deltas."""
+    dt = x.dtype
+    rank = lora['wq_a'].shape[-1]
+    scale = lora_scale(rank)
+    dq = jnp.einsum('bsr,rhk->bshk',
+                    jnp.einsum('bsd,dr->bsr', x, lora['wq_a'].astype(dt)),
+                    lora['wq_b'].astype(dt)) * scale
+    dv = jnp.einsum('bsr,rhk->bshk',
+                    jnp.einsum('bsd,dr->bsr', x, lora['wv_a'].astype(dt)),
+                    lora['wv_b'].astype(dt)) * scale
+    return dq, dv
+
+
+def attach(params: Params, lora: Params) -> Params:
+    """Return params with the adapter subtree mounted for the scan."""
+    out = dict(params)
+    out['layers'] = dict(params['layers'])
+    out['layers']['lora'] = lora
+    return out
+
+
+def detach(params: Params) -> Params:
+    out = dict(params)
+    out['layers'] = {k: v for k, v in params['layers'].items()
+                     if k != 'lora'}
+    return out
+
+
+def merge(params: Params, alpha: float = DEFAULT_ALPHA) -> Params:
+    """Fold adapters into the dense weights (export path):
+    wq += scale * A_q @ B_q, wv += scale * A_v @ B_v. The rank comes
+    from the adapter shapes — a caller-supplied rank could silently
+    mis-scale the export relative to the served adapter model."""
+    lora = params['layers'].get('lora')
+    if lora is None:
+        return params
+    scale = lora_scale(lora['wq_a'].shape[-1], alpha)
+    merged = detach(params)
+    attn = dict(merged['layers']['attn'])
+    f32 = jnp.float32
+    attn['wq'] = (attn['wq'].astype(f32) + scale * jnp.einsum(
+        'ldr,lrhk->ldhk', lora['wq_a'].astype(f32),
+        lora['wq_b'].astype(f32))).astype(attn['wq'].dtype)
+    attn['wv'] = (attn['wv'].astype(f32) + scale * jnp.einsum(
+        'ldr,lrhk->ldhk', lora['wv_a'].astype(f32),
+        lora['wv_b'].astype(f32))).astype(attn['wv'].dtype)
+    merged['layers'] = dict(merged['layers'])
+    merged['layers']['attn'] = attn
+    return merged

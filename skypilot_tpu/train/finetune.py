@@ -1,0 +1,256 @@
+"""Finetuning driver: real checkpoint in, real checkpoint out.
+
+    python -m skypilot_tpu.train.finetune \
+        --hf-checkpoint /ckpts/Meta-Llama-3.1-8B --data corpus.txt \
+        --lora-rank 16 --steps 200 --export-dir /ckpts/my-ft \
+        --mesh fsdp=-1
+
+TPU-native equivalent of the reference's finetuning recipes
+(``/root/reference/llm/llama-3_1-finetuning/`` = torchtune
+full/LoRA finetuning launched as a GPU payload). The checkpoint loads
+through ``models/hf_interop.py`` (safetensors), text tokenizes with the
+checkpoint's own BPE (``tokenizer.json``), and the result exports back
+to HF layout (LoRA adapters merged into dense weights) — servable by
+the in-tree engines or anything else that reads Llama safetensors.
+
+Two modes:
+* **full** (``--lora-rank 0``): every parameter trains; the standard
+  sharded train step (fsdp/tensor mesh axes apply).
+* **LoRA** (``--lora-rank R``): base weights FROZEN (bf16, no
+  optimizer state — the memory point of LoRA), adapters train in fp32.
+
+Checkpoint/resume follows the managed-jobs recovery contract
+(--checkpoint-dir; restored on restart).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def text_batch_iterator(path: str, tokenizer, batch: int,
+                        seq: int) -> Iterator[dict]:
+    """Tokenize a text file (one document per line) into a contiguous
+    stream and cut [batch, seq] LM batches, cycling at EOF."""
+    ids = []
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                ids.extend(tokenizer.encode(line, add_bos=True))
+                ids.append(tokenizer.eos_id)
+    if not ids:
+        raise ValueError(f'corpus {path} is empty (no non-blank lines)')
+    if len(ids) < batch * (seq + 1):
+        # Small corpora: tile so a batch always fills.
+        reps = -(-batch * (seq + 1) // len(ids))
+        ids = ids * reps
+    data = np.asarray(ids, np.int32)
+    per_batch = batch * (seq + 1)
+    offset = 0
+    while True:
+        if offset + per_batch > data.shape[0]:
+            offset = 0
+        chunk = data[offset:offset + per_batch].reshape(batch, seq + 1)
+        offset += per_batch
+        yield {
+            'tokens': jnp.asarray(chunk[:, :-1]),
+            'targets': jnp.asarray(chunk[:, 1:]),
+            'weights': jnp.ones((batch, seq), jnp.float32),
+        }
+
+
+def make_lora_step(base_params, cfg, optimizer):
+    """Jitted LoRA step: grads ONLY through the adapter pytree; the
+    frozen base is closed over (donated nothing, no optimizer state)."""
+    from skypilot_tpu.models import llama, lora as lora_lib
+    from skypilot_tpu.train.loss import cross_entropy_loss
+
+    def loss_fn(lora_params, batch):
+        params = lora_lib.attach(base_params, lora_params)
+        logits = llama.forward(params, batch['tokens'], cfg)
+        loss, _ = cross_entropy_loss(logits, batch['targets'],
+                                     batch.get('weights'))
+        return loss
+
+    @jax.jit
+    def step(lora_params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(lora_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state,
+                                              lora_params)
+        lora_params = optax.apply_updates(lora_params, updates)
+        return lora_params, opt_state, loss
+
+    return step
+
+
+def main(argv=None) -> int:
+    from skypilot_tpu.utils.jax_env import honor_jax_platforms
+    honor_jax_platforms()
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--hf-checkpoint', required=True,
+                        help='HF-layout dir (config.json + safetensors '
+                             '+ tokenizer.json)')
+    parser.add_argument('--data', required=True,
+                        help='text file (one document per line) or flat '
+                             'int32 token .npy')
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--batch', type=int, default=4)
+    parser.add_argument('--seq', type=int, default=512)
+    parser.add_argument('--learning-rate', type=float, default=1e-5)
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='0 = full finetune; >0 = LoRA rank '
+                             '(frozen bf16 base, fp32 adapters)')
+    parser.add_argument('--mesh', default=None,
+                        help="full-FT sharding, e.g. 'fsdp=-1'")
+    parser.add_argument('--export-dir', default=None,
+                        help='write the finetuned model back as an '
+                             'HF-layout checkpoint (LoRA merged)')
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--checkpoint-every', type=int, default=50)
+    parser.add_argument('--log-every', type=int, default=10)
+    args = parser.parse_args(argv)
+
+    from skypilot_tpu.inference.tokenizer import get_tokenizer
+    from skypilot_tpu.models import hf_interop, lora as lora_lib
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    from skypilot_tpu.train.pretrain import (file_batch_iterator,
+                                             maybe_init_distributed,
+                                             parse_mesh)
+
+    maybe_init_distributed()
+    use_lora = args.lora_rank > 0
+    if use_lora and args.mesh:
+        # Adapter training runs the frozen base on the default device
+        # placement; mesh sharding applies to full FT only.
+        print(json.dumps({'warning': '--mesh is ignored with '
+                          '--lora-rank > 0 (LoRA runs unsharded)'}),
+              flush=True)
+    # LoRA: frozen base in bf16 halves resident memory and no base
+    # optimizer state exists. Full FT: fp32 master weights.
+    params, cfg = hf_interop.load_checkpoint(
+        args.hf_checkpoint,
+        dtype=jnp.bfloat16 if use_lora else jnp.float32)
+    seq = min(args.seq, cfg.max_seq_len)
+    mesh = None
+    if not use_lora:
+        from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+        mesh = build_mesh(MeshConfig(**parse_mesh(args.mesh)))
+        # Round the batch up to the mesh's (data, fsdp) divisor the way
+        # the pretrain driver does — every shard must be non-empty.
+        batch_div = mesh.shape['data'] * mesh.shape['fsdp']
+        rounded = -(-args.batch // batch_div) * batch_div
+        if rounded != args.batch:
+            print(json.dumps({'batch_rounded_to': rounded}), flush=True)
+        args.batch = rounded
+    if args.data.endswith('.npy'):
+        data_iter = file_batch_iterator(args.data, args.batch, seq)
+    else:
+        tokenizer = get_tokenizer(args.hf_checkpoint, require=True)
+        data_iter = text_batch_iterator(args.data, tokenizer,
+                                        args.batch, seq)
+
+    is_main = jax.process_index() == 0
+    t0 = time.perf_counter()
+
+    if use_lora:
+        lora_params = lora_lib.init_lora_params(
+            jax.random.key(0), cfg, args.lora_rank)
+        optimizer = optax.adamw(args.learning_rate)
+        opt_state = optimizer.init(lora_params)
+        start_step = 0
+        if args.checkpoint_dir:
+            latest = ckpt_lib.latest_step(args.checkpoint_dir)
+            if latest is not None:
+                restored = ckpt_lib.restore(
+                    args.checkpoint_dir, latest,
+                    {'lora': lora_params, 'opt': opt_state,
+                     'step': 0})
+                lora_params = restored['lora']
+                opt_state = restored['opt']
+                start_step = int(restored['step'])
+                print(json.dumps({'resumed_from_step': start_step}),
+                      flush=True)
+        step_fn = make_lora_step(params, cfg, optimizer)
+        for step in range(start_step, args.steps):
+            batch = next(data_iter)
+            lora_params, opt_state, loss = step_fn(lora_params,
+                                                   opt_state, batch)
+            if is_main and ((step + 1) % args.log_every == 0 or
+                            step + 1 == args.steps):
+                print(json.dumps({'step': step + 1,
+                                  'loss': round(float(loss), 4),
+                                  'mode': f'lora-r{args.lora_rank}'}),
+                      flush=True)
+            if (args.checkpoint_dir and is_main and
+                    ((step + 1) % args.checkpoint_every == 0 or
+                     step + 1 == args.steps)):
+                ckpt_lib.save(args.checkpoint_dir, step + 1,
+                              {'lora': lora_params, 'opt': opt_state,
+                               'step': step + 1})
+        final_params = lora_lib.merge(
+            lora_lib.attach(params, lora_params))
+    else:
+        from skypilot_tpu.train.step import (
+            TrainHParams, create_train_state_from_params,
+            make_train_step, state_shardings)
+        hp = TrainHParams(learning_rate=args.learning_rate,
+                          warmup_steps=min(10, args.steps),
+                          total_steps=max(args.steps, 11))
+        shardings = state_shardings(mesh, cfg, hp)
+        state = create_train_state_from_params(params, cfg, hp, mesh,
+                                               shardings=shardings)
+        start_step = 0
+        if args.checkpoint_dir:
+            latest = ckpt_lib.latest_step(args.checkpoint_dir)
+            if latest is not None:
+                state = ckpt_lib.restore(args.checkpoint_dir, latest,
+                                         state)
+                start_step = int(state.step)
+                print(json.dumps({'resumed_from_step': start_step}),
+                      flush=True)
+        step_fn = make_train_step(cfg, hp, mesh, shardings=shardings)
+        for step in range(start_step, args.steps):
+            batch = next(data_iter)
+            state, metrics = step_fn(state, batch)
+            if is_main and ((step + 1) % args.log_every == 0 or
+                            step + 1 == args.steps):
+                print(json.dumps({
+                    'step': step + 1,
+                    'loss': round(float(metrics['loss']), 4),
+                    'mode': 'full'}), flush=True)
+            if (args.checkpoint_dir and is_main and
+                    ((step + 1) % args.checkpoint_every == 0 or
+                     step + 1 == args.steps)):
+                ckpt_lib.save(args.checkpoint_dir, step + 1, state)
+        final_params = state.params
+
+    if args.export_dir and is_main:
+        hf_interop.save_checkpoint(
+            jax.device_get(final_params), cfg, args.export_dir,
+            dtype=np.float32)
+        # Ship the tokenizer along so the export serves end-to-end.
+        for fn in ('tokenizer.json', 'tokenizer_config.json'):
+            src = os.path.join(args.hf_checkpoint, fn)
+            if os.path.exists(src):
+                import shutil
+                shutil.copy(src, os.path.join(args.export_dir, fn))
+        print(json.dumps({'exported': args.export_dir}), flush=True)
+    if is_main:
+        print(json.dumps({'done': True,
+                          'seconds': round(time.perf_counter() - t0, 1)}),
+              flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -139,6 +139,32 @@ def create_train_state(rng: jax.Array,
         return init_jit(rng)
 
 
+def create_train_state_from_params(params: Params,
+                                   cfg: ModelConfig,
+                                   hp: TrainHParams,
+                                   mesh: Mesh,
+                                   rules: LogicalAxisRules = DEFAULT_RULES,
+                                   shardings: Optional[TrainState] = None
+                                   ) -> TrainState:
+    """TrainState around EXISTING params (finetuning a loaded
+    checkpoint): params are placed on the mesh and the optimizer state
+    initializes sharded on-device, mirroring create_train_state."""
+    del cfg  # layout comes from the params themselves
+    optimizer = make_optimizer(hp)
+    if shardings is None:
+        raise ValueError('shardings required (state_shardings(...))')
+    params = jax.device_put(params, shardings.params)
+
+    def init_fn(p):
+        return TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                          opt_state=optimizer.init(p))
+
+    with use_mesh(mesh):
+        init_jit = jax.jit(init_fn, out_shardings=shardings,
+                           in_shardings=(shardings.params,))
+        return init_jit(params)
+
+
 def train_step_fn(state: TrainState,
                   batch: Dict[str, jax.Array],
                   cfg: ModelConfig,
