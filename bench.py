@@ -138,6 +138,115 @@ def _decode_bench(args, model: str, on_accel: bool) -> int:
     return 0
 
 
+def _kernels_smoke(on_accel: bool) -> int:
+    """Mosaic-lowering smoke: every Pallas kernel (flash, segmented
+    flash incl. backward, length-aware decode, int8-KV decode) compiles
+    with interpret=False and matches the XLA reference ON THE REAL
+    CHIP. The r2 verdict's gap: these only ever ran in interpret mode
+    on CPU; this mode runs whenever a TPU is present (CPU runs exercise
+    the same paths through the interpreter and say so).
+    """
+    import numpy as np
+
+    from skypilot_tpu.ops.attention import xla_attention
+    from skypilot_tpu.ops.pallas import decode_attention as da
+    from skypilot_tpu.ops.pallas import flash_attention as fa
+
+    checks = {}
+
+    def record(name, make_got, ref, tol):
+        # Every check runs under its own guard: a Mosaic lowering
+        # failure — the exact condition this smoke hunts — must land in
+        # the JSON line, not kill the process before it prints.
+        try:
+            got = make_got()
+            err = float(np.max(np.abs(np.asarray(got, np.float32) -
+                                      np.asarray(ref, np.float32))))
+            checks[name] = {'max_abs_err': round(err, 6),
+                            'ok': err < tol}
+        except Exception as e:  # pylint: disable=broad-except
+            checks[name] = {'ok': False,
+                            'error': f'{type(e).__name__}: {e}'[:300]}
+
+    # Interpret mode on CPU is ~1000x slower: shrink to the smallest
+    # kernel-supported shapes (seq/d multiples of 128) off-chip.
+    if on_accel:
+        b, s, h, kv, d, t = 2, 512, 8, 4, 128, 256
+    else:
+        b, s, h, kv, d, t = 1, 256, 2, 1, 128, 128
+    fwd_tol = 2e-2 if on_accel else 2e-4
+    grad_tol = 2e-1 if on_accel else 2e-3
+    dt = jnp.bfloat16 if on_accel else jnp.float32
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dt)
+    k = jax.random.normal(ks[1], (b, s, kv, d), dt)
+    v = jax.random.normal(ks[2], (b, s, kv, d), dt)
+    seg = jnp.concatenate([jnp.zeros((b, s // 2), jnp.int32),
+                           jnp.ones((b, s - s // 2), jnp.int32)], axis=1)
+    ref = xla_attention(q, k, v, causal=True)
+    ref_seg = xla_attention(q, k, v, causal=True, segment_ids=seg)
+    record('flash_fwd',
+           lambda: fa.flash_attention(q, k, v, causal=True), ref,
+           fwd_tol)
+    record('flash_seg_fwd',
+           lambda: fa.flash_attention(q, k, v, causal=True,
+                                      segment_ids=seg),
+           ref_seg, fwd_tol)
+
+    def loss(fn):
+        return lambda q_, k_, v_: (
+            fn(q_, k_, v_).astype(jnp.float32) ** 2).sum()
+
+    grad3 = lambda fn: jax.grad(loss(fn), argnums=(0, 1, 2))  # noqa: E731
+    g_ref = grad3(lambda *a: xla_attention(*a, causal=True))(q, k, v)
+    g_ref_seg = grad3(lambda *a: xla_attention(
+        *a, causal=True, segment_ids=seg))(q, k, v)
+    for tag, flash_fn, refs in (
+            ('flash', lambda *a: fa.flash_attention(*a, causal=True),
+             g_ref),
+            ('flash_seg', lambda *a: fa.flash_attention(
+                *a, causal=True, segment_ids=seg), g_ref_seg)):
+        try:
+            grads = grad3(flash_fn)(q, k, v)
+        except Exception as e:  # pylint: disable=broad-except
+            checks[f'{tag}_grads'] = {
+                'ok': False, 'error': f'{type(e).__name__}: {e}'[:300]}
+            continue
+        for name, a, r in zip((f'{tag}_dq', f'{tag}_dk', f'{tag}_dv'),
+                              grads, refs):
+            record(name, lambda a=a: a, r, grad_tol)
+
+    # Decode kernel: [B,1,H,D] query over a length-masked cache.
+    kc = jax.random.normal(ks[1], (b, t, kv, d), dt)
+    vc = jax.random.normal(ks[2], (b, t, kv, d), dt)
+    q1 = jax.random.normal(ks[0], (b, 1, h, d), dt)
+    n_valid = jnp.asarray(([t, t // 3] * b)[:b], jnp.int32)
+    ref_dec = da.xla_decode_attention(q1, kc, vc, n_valid)
+    record('decode_kernel',
+           lambda: da.decode_attention(q1, kc, vc, n_valid,
+                                       impl='pallas'),
+           ref_dec, fwd_tol)
+
+    from skypilot_tpu.models.decode import quantize_kv
+    kq, kscale = quantize_kv(kc)
+    vq, vscale = quantize_kv(vc)
+    record('decode_kernel_int8kv',
+           lambda: da.decode_attention(q1, kq, vq, n_valid,
+                                       k_scale=kscale, v_scale=vscale,
+                                       impl='pallas'),
+           ref_dec, 0.12)  # int8 cache quantization error floor
+
+    all_ok = all(c['ok'] for c in checks.values())
+    print(json.dumps({
+        'metric': f'pallas_kernels_lowering_{jax.default_backend()}',
+        'value': 1 if all_ok else 0,
+        'unit': 'all kernels lower + match',
+        'vs_baseline': 1 if all_ok else 0,
+        'detail': {'interpret_mode': not on_accel, **checks},
+    }))
+    return 0 if all_ok else 1
+
+
 def main() -> int:
     try:
         tries = max(int(os.environ.get('SKYT_BENCH_PROBE_TRIES', '6')), 1)
@@ -170,10 +279,12 @@ def main() -> int:
                         choices=[None, 'none', 'dots', 'save_attn',
                                  'save_dots', 'full'])
     parser.add_argument('--mode', default='train',
-                        choices=['train', 'decode'],
+                        choices=['train', 'decode', 'kernels'],
                         help='train = MFU of the sharded train step '
                              '(the driver metric); decode = serving '
-                             'tokens/sec of the KV-cache decode loop.')
+                             'tokens/sec of the KV-cache decode loop; '
+                             'kernels = Mosaic-lowering smoke for every '
+                             'Pallas kernel vs the XLA reference.')
     parser.add_argument('--quantize', action='store_true',
                         help='decode mode: int8 W8A8 weights.')
     parser.add_argument('--attention-impl', default=None,
@@ -189,6 +300,8 @@ def main() -> int:
 
     if args.mode == 'decode':
         return _decode_bench(args, model, on_accel)
+    if args.mode == 'kernels':
+        return _kernels_smoke(on_accel)
     args.steps = args.steps or 20
     args.warmup = args.warmup or 5
 

@@ -22,10 +22,21 @@ from skypilot_tpu.utils import log
 logger = log.init_logger(__name__)
 
 
-# Planning-time utilization assumption for runtime estimation: the
-# BASELINE.md target MFU. Real jobs vary; this only needs to be CONSISTENT
-# across candidates so the ranking (perf-per-dollar) is right.
-PLANNING_MFU = 0.40
+# Planning-time utilization assumption for runtime estimation. Real
+# jobs vary; the table only needs the RELATIVE ordering right across
+# generations so perf-per-dollar ranks v5e/v5p/v6e fairly: newer
+# generations have higher peak ratios than typically-achieved fractions
+# (public MaxText/MLPerf runs land lower on v6e than v5p relative to
+# peak — bigger MXUs are harder to keep fed at the same batch).
+PLANNING_MFU = 0.40          # default / unknown hardware
+PLANNING_MFU_BY_GENERATION = {
+    'v2': 0.30, 'v3': 0.35, 'v4': 0.45, 'v5e': 0.45, 'v5p': 0.50,
+    'v6e': 0.40,
+}
+
+
+def planning_mfu(generation: Optional[str]) -> float:
+    return PLANNING_MFU_BY_GENERATION.get(generation or '', PLANNING_MFU)
 # $/GB egress between regions (public GCP inter-region ballpark; parity:
 # sky/optimizer.py:75 + cloud egress tables).
 EGRESS_PRICE_PER_GB = 0.08
@@ -70,7 +81,8 @@ def _annotate_estimates(candidate: Candidate, task) -> Candidate:
     if task is not None:
         flops = getattr(task, 'estimated_flops', None)
         if flops and candidate.peak_tflops:
-            eff = candidate.peak_tflops * 1e12 * PLANNING_MFU
+            gen = res.tpu.generation if (res.is_tpu and res.tpu) else None
+            eff = candidate.peak_tflops * 1e12 * planning_mfu(gen)
             candidate.estimated_hours = flops / eff / 3600.0
         inputs_gb = getattr(task, 'estimated_inputs_gb', None)
         src_region = getattr(task, 'inputs_region', None)

@@ -167,3 +167,16 @@ def test_check_cache_ttl_expires(monkeypatch):
     check_lib.check(['fake'])
     assert len(calls) == 2          # TTL elapsed -> re-probed
     check_lib.clear_cache()
+
+
+def test_planning_mfu_per_generation():
+    """Runtime estimation uses per-generation achievable MFU (r2 weak
+    #7: a constant across v5e/v5p/v6e misranks cross-generation)."""
+    from skypilot_tpu.optimizer import (PLANNING_MFU,
+                                        PLANNING_MFU_BY_GENERATION,
+                                        planning_mfu)
+    assert planning_mfu('v5p') > planning_mfu('v6e')
+    assert planning_mfu(None) == PLANNING_MFU
+    assert planning_mfu('unknown-gen') == PLANNING_MFU
+    assert set(PLANNING_MFU_BY_GENERATION) >= {'v4', 'v5e', 'v5p',
+                                               'v6e'}
