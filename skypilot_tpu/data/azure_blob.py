@@ -1,0 +1,329 @@
+"""Minimal Azure Blob client: SharedKey over stdlib HTTP.
+
+Parity: ``sky/data/storage.py:144 AzureBlobStore`` — the reference
+shells out to az-cli/azure SDKs; neither is in this image, so the wire
+protocol is implemented directly, the same stance as ``data/s3.py``
+(SigV4) and the GCP driver (urllib REST): SharedKey signing is ~40
+lines of hmac and removes the dependency entirely.
+
+Credentials/endpoint resolution:
+1. explicit ``AzureBlobConfig`` arguments;
+2. env: ``AZURE_STORAGE_ACCOUNT`` / ``AZURE_STORAGE_KEY`` /
+   ``SKYT_AZURE_BLOB_ENDPOINT`` (testing: point at a fake server);
+3. layered config: ``storage.azure.{account,key,endpoint_url}``.
+
+Also a tiny CLI (``python3 -m skypilot_tpu.data.azure_blob``) for the
+cluster-side download commands (hosts carry the shipped runtime).
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import datetime
+import hashlib
+import hmac
+import os
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Iterator, List, Optional
+from xml.etree import ElementTree
+
+from skypilot_tpu import exceptions
+
+API_VERSION = '2021-08-06'
+# Files above this stream as Put Block / Put Block List instead of one
+# Put Blob (single-put has a service limit and would buffer the whole
+# file in memory).
+SINGLE_PUT_LIMIT = 64 * 1024 * 1024
+BLOCK_SIZE = 32 * 1024 * 1024
+
+
+class AzureHttpError(exceptions.StorageError):
+    """Storage error carrying the HTTP status (never classify by
+    substring — a container named 'x-404' must not read as missing)."""
+
+    def __init__(self, message: str, code: int) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+@dataclasses.dataclass
+class AzureBlobConfig:
+    account: str
+    key: str
+    endpoint_url: str  # e.g. https://{account}.blob.core.windows.net
+
+    @classmethod
+    def load(cls,
+             account: Optional[str] = None,
+             key: Optional[str] = None,
+             endpoint_url: Optional[str] = None,
+             require_credentials: bool = True) -> 'AzureBlobConfig':
+        from skypilot_tpu import config as config_lib
+
+        def pick(explicit, env_key, cfg_key):
+            if explicit:
+                return explicit
+            if os.environ.get(env_key):
+                return os.environ[env_key]
+            return config_lib.get_nested(('storage', 'azure', cfg_key),
+                                         None)
+
+        account = pick(account, 'AZURE_STORAGE_ACCOUNT', 'account')
+        key = pick(key, 'AZURE_STORAGE_KEY', 'key')
+        endpoint = pick(endpoint_url, 'SKYT_AZURE_BLOB_ENDPOINT',
+                        'endpoint_url')
+        if (not account or not key) and require_credentials:
+            raise exceptions.StorageError(
+                'Azure Blob needs credentials: set '
+                'AZURE_STORAGE_ACCOUNT/AZURE_STORAGE_KEY or '
+                'storage.azure.account/key in config.')
+        if not endpoint:
+            endpoint = f'https://{account}.blob.core.windows.net'
+        return cls(account=account or '', key=key or '',
+                   endpoint_url=endpoint.rstrip('/'))
+
+
+class AzureBlobClient:
+    """Container/blob operations with SharedKey request signing."""
+
+    def __init__(self, cfg: AzureBlobConfig) -> None:
+        self.cfg = cfg
+
+    # -- SharedKey -----------------------------------------------------
+
+    def _signed_request(self, method: str, container: str, blob: str = '',
+                        query: Optional[Dict[str, str]] = None,
+                        body: bytes = b'',
+                        extra_headers: Optional[Dict[str, str]] = None
+                        ) -> urllib.request.Request:
+        cfg = self.cfg
+        query = dict(sorted((query or {}).items()))
+        path = f'/{container}'
+        if blob:
+            path += f'/{urllib.parse.quote(blob)}'
+        url = cfg.endpoint_url + path
+        if query:
+            url += '?' + urllib.parse.urlencode(query)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        headers = {
+            'x-ms-date': now.strftime('%a, %d %b %Y %H:%M:%S GMT'),
+            'x-ms-version': API_VERSION,
+        }
+        headers.update(extra_headers or {})
+        canonical_headers = ''.join(
+            f'{k.lower()}:{v}\n'
+            for k, v in sorted(headers.items())
+            if k.lower().startswith('x-ms-'))
+        # Canonicalized resource: /account/path plus each query param
+        # lowercase-sorted on its own line.
+        canonical_resource = f'/{cfg.account}{path}'
+        for k, v in query.items():
+            canonical_resource += f'\n{k.lower()}:{v}'
+        content_length = str(len(body)) if body else ''
+        string_to_sign = '\n'.join([
+            method,
+            '',                       # Content-Encoding
+            '',                       # Content-Language
+            content_length,           # Content-Length ('' when 0)
+            '',                       # Content-MD5
+            headers.get('Content-Type', ''),
+            '',                       # Date (x-ms-date is used)
+            '', '', '', '', '',       # If-* / Range
+        ]) + '\n' + canonical_headers + canonical_resource
+        signature = base64.b64encode(
+            hmac.new(base64.b64decode(cfg.key),
+                     string_to_sign.encode('utf-8'),
+                     hashlib.sha256).digest()).decode()
+        headers['Authorization'] = (
+            f'SharedKey {cfg.account}:{signature}')
+        return urllib.request.Request(url, data=body,
+                                      headers=headers, method=method)
+
+    def _call(self, method: str, container: str, blob: str = '',
+              query: Optional[Dict[str, str]] = None,
+              body: bytes = b'',
+              extra_headers: Optional[Dict[str, str]] = None) -> bytes:
+        req = self._signed_request(method, container, blob, query, body,
+                                   extra_headers)
+        try:
+            # data always set (b'' included) so urllib emits
+            # Content-Length: 0 — Azure 411s length-less PUTs.
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode('utf-8', errors='replace')[:300]
+            raise AzureHttpError(
+                f'Azure Blob {method} {container}/{blob}: HTTP '
+                f'{e.code} {detail}', code=e.code) from None
+        except urllib.error.URLError as e:
+            raise exceptions.StorageError(
+                f'Azure Blob endpoint unreachable: {e}') from None
+
+    # -- operations ----------------------------------------------------
+
+    def container_exists(self, container: str) -> bool:
+        try:
+            self._call('GET', container,
+                       query={'restype': 'container'})
+            return True
+        except AzureHttpError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def create_container(self, container: str) -> None:
+        try:
+            self._call('PUT', container, query={'restype': 'container'})
+        except AzureHttpError as e:
+            if e.code != 409:  # 409 = already exists
+                raise
+
+    def put_blob(self, container: str, blob: str, data: bytes) -> None:
+        self._call('PUT', container, blob, body=data,
+                   extra_headers={'x-ms-blob-type': 'BlockBlob',
+                                  'Content-Type':
+                                      'application/octet-stream'})
+
+    def put_blob_from_file(self, container: str, blob: str,
+                           path: str,
+                           block_size: int = BLOCK_SIZE) -> None:
+        """Upload a file; large files stream as Put Block + Put Block
+        List (bounded memory, no single-put size limit)."""
+        size = os.path.getsize(path)
+        if size <= SINGLE_PUT_LIMIT and size <= block_size * 2:
+            with open(path, 'rb') as f:
+                self.put_blob(container, blob, f.read())
+            return
+        block_ids: List[str] = []
+        with open(path, 'rb') as f:
+            index = 0
+            while True:
+                chunk = f.read(block_size)
+                if not chunk:
+                    break
+                block_id = base64.b64encode(
+                    f'{index:08d}'.encode()).decode()
+                self._call('PUT', container, blob, body=chunk,
+                           query={'comp': 'block',
+                                  'blockid': block_id})
+                block_ids.append(block_id)
+                index += 1
+        manifest = ('<?xml version="1.0" encoding="utf-8"?><BlockList>'
+                    + ''.join(f'<Latest>{bid}</Latest>'
+                              for bid in block_ids)
+                    + '</BlockList>').encode()
+        self._call('PUT', container, blob, body=manifest,
+                   query={'comp': 'blocklist'},
+                   extra_headers={'Content-Type': 'application/xml'})
+
+    def get_blob(self, container: str, blob: str) -> bytes:
+        return self._call('GET', container, blob)
+
+    def get_blob_to_file(self, container: str, blob: str,
+                         path: str) -> None:
+        """Stream a blob to disk (no full-blob buffer)."""
+        import shutil
+        req = self._signed_request('GET', container, blob)
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp, \
+                    open(path, 'wb') as f:
+                shutil.copyfileobj(resp, f, length=1024 * 1024)
+        except urllib.error.HTTPError as e:
+            raise AzureHttpError(
+                f'Azure Blob GET {container}/{blob}: HTTP {e.code}',
+                code=e.code) from None
+
+    def list_blobs(self, container: str,
+                   prefix: str = '') -> Iterator[str]:
+        marker = ''
+        while True:
+            query = {'restype': 'container', 'comp': 'list'}
+            if prefix:
+                query['prefix'] = prefix
+            if marker:
+                query['marker'] = marker
+            root = ElementTree.fromstring(
+                self._call('GET', container, query=query))
+            for el in root.iter('Name'):
+                yield el.text or ''
+            marker_el = root.find('NextMarker')
+            marker = (marker_el.text or '') if marker_el is not None \
+                else ''
+            if not marker:
+                return
+
+    def delete_blob(self, container: str, blob: str) -> None:
+        self._call('DELETE', container, blob)
+
+    def delete_container(self, container: str) -> None:
+        self._call('DELETE', container, query={'restype': 'container'})
+
+    # -- sync helpers (store + CLI surface) ----------------------------
+
+    def sync_up(self, local_dir: str, container: str,
+                prefix: str = '') -> int:
+        local_dir = os.path.expanduser(local_dir)
+        count = 0
+        if os.path.isfile(local_dir):
+            name = (f'{prefix.rstrip("/")}/' if prefix else '') + \
+                os.path.basename(local_dir)
+            self.put_blob_from_file(container, name, local_dir)
+            return 1
+        for root, _dirs, files in os.walk(local_dir):
+            for fn in files:
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, local_dir)
+                name = (f'{prefix.rstrip("/")}/' if prefix else '') + rel
+                self.put_blob_from_file(container,
+                                        name.replace(os.sep, '/'), full)
+                count += 1
+        return count
+
+    def sync_down(self, container: str, prefix: str, dest: str) -> int:
+        dest = os.path.abspath(os.path.expanduser(dest))
+        count = 0
+        for name in self.list_blobs(container, prefix):
+            rel = name[len(prefix):].lstrip('/') if prefix else name
+            target = os.path.join(dest, rel) if rel else os.path.join(
+                dest, os.path.basename(name))
+            # Server-supplied names must not escape dest ('..'
+            # segments would let a shared bucket overwrite arbitrary
+            # host files).
+            target = os.path.normpath(target)
+            if os.path.commonpath([dest, target]) != dest:
+                raise exceptions.StorageError(
+                    f'refusing blob name escaping the destination: '
+                    f'{name!r}')
+            os.makedirs(os.path.dirname(target) or '.', exist_ok=True)
+            self.get_blob_to_file(container, name, target)
+            count += 1
+        return count
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest='op', required=True)
+    down = sub.add_parser('download')
+    down.add_argument('container')
+    down.add_argument('prefix')
+    down.add_argument('dest')
+    up = sub.add_parser('upload')
+    up.add_argument('source')
+    up.add_argument('container')
+    up.add_argument('--prefix', default='')
+    args = parser.parse_args(argv)
+    client = AzureBlobClient(AzureBlobConfig.load())
+    if args.op == 'download':
+        n = client.sync_down(args.container, args.prefix, args.dest)
+    else:
+        n = client.sync_up(args.source, args.container, args.prefix)
+    print(f'{n} objects')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

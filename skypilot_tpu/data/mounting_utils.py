@@ -175,3 +175,61 @@ def unmount_command(mount_path: str) -> str:
     return (f'if [ -L {path} ]; then rm -f {path}; '
             f'elif mountpoint -q {path}; then '
             f'fusermount -u {path} || sudo umount {path}; fi')
+
+
+AZURE_RCLONE_CONF = '~/.config/rclone/skyt-az.conf'
+
+
+def _rclone_azure_remote_config() -> str:
+    """Dedicated rclone conf for Azure Blob, REGENERATED on every mount
+    (grep-once idempotency would freeze the first run's account/key —
+    rotated storage keys must take effect on the next mount; env_auth
+    does not cover azureblob storage-key auth, so the values bake into
+    the file from the gen-time exports). Endpoint rides along so
+    Azurite/sovereign clouds mount what COPY downloads from. Parity:
+    blobfuse2 command gen in the reference; rclone covers the same."""
+    return (
+        'mkdir -p ~/.config/rclone && '
+        'printf "[skyt-az]\\ntype = azureblob\\n'
+        'account = ${AZURE_STORAGE_ACCOUNT}\\n'
+        'key = ${AZURE_STORAGE_KEY}\\n'
+        'endpoint = ${SKYT_AZURE_BLOB_ENDPOINT}\\n" '
+        f'> {AZURE_RCLONE_CONF}')
+
+
+def azure_mount_command(container: str, mount_path: str) -> str:
+    """rclone mount of an Azure Blob container (MOUNT mode)."""
+    path = quote_path(mount_path)
+    remote = f'skyt-az:{container}'
+    return (f'{FUSE_PROXY_PATH_PREFIX} && '
+            f'{RCLONE_INSTALL} && {_rclone_azure_remote_config()} && '
+            f'mkdir -p {path} && '
+            f'{{ mountpoint -q {path} || '
+            f'rclone mount --config {AZURE_RCLONE_CONF} '
+            f'{shlex.quote(remote)} {path} --daemon '
+            '--vfs-cache-mode off --dir-cache-time 30s; }')
+
+
+def azure_mount_cached_command(container: str, mount_path: str) -> str:
+    """rclone VFS write-back cache (MOUNT_CACHED; checkpoint pattern)."""
+    path = quote_path(mount_path)
+    remote = f'skyt-az:{container}'
+    return (f'{FUSE_PROXY_PATH_PREFIX} && '
+            f'{RCLONE_INSTALL} && {_rclone_azure_remote_config()} && '
+            f'mkdir -p {path} && '
+            f'{{ mountpoint -q {path} || '
+            f'rclone mount --config {AZURE_RCLONE_CONF} '
+            f'{shlex.quote(remote)} {path} --daemon '
+            '--vfs-cache-mode writes --vfs-cache-max-size 10G '
+            '--dir-cache-time 30s; }')
+
+
+def azure_download_command(container: str, prefix: str,
+                           dest: str) -> str:
+    """COPY mode via the shipped runtime's stdlib Azure Blob client."""
+    dst = quote_path(dest)
+    return (f'mkdir -p {dst} && '
+            'PYTHONPATH="$HOME/.skyt_runtime/runtime'
+            '${PYTHONPATH:+:$PYTHONPATH}" '
+            f'python3 -m skypilot_tpu.data.azure_blob download '
+            f'{shlex.quote(container)} {shlex.quote(prefix)} {dst}')

@@ -49,23 +49,30 @@ class StorageMode(enum.Enum):
 class StoreType(enum.Enum):
     GCS = 'gcs'
     S3 = 's3'
+    AZURE = 'azure'
     LOCAL = 'local'
 
     @classmethod
     def from_uri(cls, uri: str) -> 'StoreType':
         if uri.startswith('gs://'):
             return cls.GCS
-        if uri.startswith(('s3://', 'r2://')):
+        # oci:// rides the S3-compatible path: OCI Object Storage
+        # exposes an S3 compat endpoint (storage.s3.endpoint_url =
+        # https://{ns}.compat.objectstorage.{region}.oraclecloud.com).
+        if uri.startswith(('s3://', 'r2://', 'oci://')):
             return cls.S3
+        if uri.startswith(('az://', 'azblob://')):
+            return cls.AZURE
         if uri.startswith('file://') or uri.startswith('local://'):
             return cls.LOCAL
         raise exceptions.StorageError(f'Unsupported storage URI {uri!r} '
-                                      '(expected gs://, s3://, r2:// or '
-                                      'file://)')
+                                      '(expected gs://, s3://, r2://, '
+                                      'oci://, az:// or file://)')
 
 
 def _strip_scheme(uri: str) -> str:
-    for scheme in ('gs://', 's3://', 'r2://', 'file://', 'local://'):
+    for scheme in ('gs://', 's3://', 'r2://', 'oci://', 'az://',
+                   'azblob://', 'file://', 'local://'):
         if uri.startswith(scheme):
             return uri[len(scheme):]
     return uri
@@ -226,6 +233,71 @@ class S3CompatibleStore(AbstractStore):
     @property
     def url(self) -> str:
         return f's3://{self.name}'
+
+
+@STORE_REGISTRY.register('azure')
+class AzureBlobStore(AbstractStore):
+    """Azure Blob containers via the stdlib SharedKey client
+    (data/azure_blob.py). Parity: sky/data/storage.py:144
+    AzureBlobStore (az-cli/SDK there; direct wire protocol here, the
+    same stance as the S3 store). Mounts ride rclone's azureblob
+    backend — the one FUSE tool covering gcs/s3/azure alike."""
+
+    def _client(self):
+        from skypilot_tpu.data import azure_blob
+        return azure_blob.AzureBlobClient(
+            azure_blob.AzureBlobConfig.load())
+
+    def _env_prefix(self) -> str:
+        """Gen-time credential embedding (same trust model as the S3
+        store: command-scoped, no credential files rsynced)."""
+        import shlex
+        from skypilot_tpu.data import azure_blob
+        cfg = azure_blob.AzureBlobConfig.load(require_credentials=False)
+        exports = [
+            'PYTHONPATH="$HOME/.skyt_runtime/runtime'
+            '${PYTHONPATH:+:$PYTHONPATH}"',
+        ]
+        if cfg.account:
+            exports.append(
+                f'AZURE_STORAGE_ACCOUNT={shlex.quote(cfg.account)}')
+        if cfg.key:
+            exports.append(f'AZURE_STORAGE_KEY={shlex.quote(cfg.key)}')
+        if cfg.endpoint_url and cfg.account and \
+                not cfg.endpoint_url.endswith('blob.core.windows.net'):
+            exports.append('SKYT_AZURE_BLOB_ENDPOINT='
+                           f'{shlex.quote(cfg.endpoint_url)}')
+        return 'export ' + ' '.join(exports) + ' && '
+
+    def exists(self) -> bool:
+        return self._client().container_exists(self.name)
+
+    def create(self) -> None:
+        self._client().create_container(self.name)
+
+    def upload(self, local_source: str, prefix: str = '') -> None:
+        self._client().sync_up(local_source, self.name, prefix)
+
+    def delete(self) -> None:
+        self._client().delete_container(self.name)
+
+    def mount_command(self, mount_path: str) -> str:
+        return self._env_prefix() + mounting_utils.azure_mount_command(
+            self.name, mount_path)
+
+    def mount_cached_command(self, mount_path: str) -> str:
+        return (self._env_prefix() +
+                mounting_utils.azure_mount_cached_command(
+                    self.name, mount_path))
+
+    def download_command(self, dest: str, prefix: str = '') -> str:
+        return (self._env_prefix() +
+                mounting_utils.azure_download_command(
+                    self.name, prefix, dest))
+
+    @property
+    def url(self) -> str:
+        return f'az://{self.name}'
 
 
 @STORE_REGISTRY.register('local')

@@ -1,0 +1,242 @@
+"""Azure Blob store (VERDICT r2 missing #7): stdlib SharedKey client
+against an in-process fake Blob endpoint, store wiring, and mount
+command generation.
+
+Parity bar: ``sky/data/storage.py:144 AzureBlobStore`` +
+``sky/data/mounting_utils.py`` blobfuse2 command gen (rclone azureblob
+here).
+"""
+import base64
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import azure_blob, storage
+
+
+class _State:
+    def __init__(self):
+        self.containers = {}
+        self.blocks = {}          # (container, blob) -> {id: bytes}
+        self.lock = threading.Lock()
+
+
+def _handler_for(state):
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, *a):
+            pass
+
+        def _split(self):
+            parsed = urllib.parse.urlparse(self.path)
+            parts = parsed.path.lstrip('/').split('/', 1)
+            container = parts[0]
+            blob = urllib.parse.unquote(parts[1]) if len(parts) > 1 \
+                else ''
+            query = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(parsed.query).items()}
+            return container, blob, query
+
+        def _reply(self, code, body=b''):
+            self.send_response(code)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _authed(self):
+            auth = self.headers.get('Authorization', '')
+            if not auth.startswith('SharedKey '):
+                self._reply(403)
+                return False
+            return True
+
+        def do_PUT(self):  # noqa: N802
+            if not self._authed():
+                return
+            container, blob, query = self._split()
+            length = int(self.headers.get('Content-Length', 0))
+            data = self.rfile.read(length) if length else b''
+            with state.lock:
+                if query.get('restype') == 'container':
+                    if container in state.containers:
+                        self._reply(409)
+                        return
+                    state.containers[container] = {}
+                    self._reply(201)
+                    return
+                if container not in state.containers:
+                    self._reply(404)
+                    return
+                if query.get('comp') == 'block':
+                    state.blocks.setdefault((container, blob), {})[
+                        query['blockid']] = data
+                elif query.get('comp') == 'blocklist':
+                    import re
+                    ids = re.findall(r'<Latest>([^<]+)</Latest>',
+                                     data.decode())
+                    staged = state.blocks.pop((container, blob), {})
+                    state.containers[container][blob] = b''.join(
+                        staged[i] for i in ids)
+                else:
+                    state.containers[container][blob] = data
+            self._reply(201)
+
+        def do_GET(self):  # noqa: N802
+            if not self._authed():
+                return
+            container, blob, query = self._split()
+            with state.lock:
+                if container not in state.containers:
+                    self._reply(404)
+                    return
+                blobs = state.containers[container]
+                if query.get('comp') == 'list':
+                    prefix = query.get('prefix', '')
+                    names = ''.join(
+                        f'<Blob><Name>{escape(n)}</Name></Blob>'
+                        for n in sorted(blobs) if n.startswith(prefix))
+                    body = (f'<?xml version="1.0"?><EnumerationResults>'
+                            f'<Blobs>{names}</Blobs>'
+                            f'<NextMarker/></EnumerationResults>'
+                            ).encode()
+                    self._reply(200, body)
+                    return
+                if query.get('restype') == 'container':
+                    self._reply(200)
+                    return
+                if blob not in blobs:
+                    self._reply(404)
+                    return
+                self._reply(200, blobs[blob])
+
+        def do_DELETE(self):  # noqa: N802
+            if not self._authed():
+                return
+            container, blob, query = self._split()
+            with state.lock:
+                if query.get('restype') == 'container':
+                    state.containers.pop(container, None)
+                    self._reply(202)
+                    return
+                state.containers.get(container, {}).pop(blob, None)
+            self._reply(202)
+
+    return Handler
+
+
+@pytest.fixture()
+def fake_azure(tmp_home, monkeypatch):
+    state = _State()
+    server = ThreadingHTTPServer(('127.0.0.1', 0), _handler_for(state))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    port = server.server_address[1]
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'testacct')
+    monkeypatch.setenv('AZURE_STORAGE_KEY',
+                       base64.b64encode(b'secret-key').decode())
+    monkeypatch.setenv('SKYT_AZURE_BLOB_ENDPOINT',
+                       f'http://127.0.0.1:{port}')
+    yield state
+    server.shutdown()
+
+
+def _client():
+    return azure_blob.AzureBlobClient(azure_blob.AzureBlobConfig.load())
+
+
+def test_container_and_blob_roundtrip(fake_azure):
+    client = _client()
+    assert not client.container_exists('ckpts')
+    client.create_container('ckpts')
+    assert client.container_exists('ckpts')
+    client.create_container('ckpts')  # idempotent (409 swallowed)
+    client.put_blob('ckpts', 'a/b.txt', b'hello azure')
+    assert client.get_blob('ckpts', 'a/b.txt') == b'hello azure'
+    client.put_blob('ckpts', 'a/c.txt', b'x')
+    client.put_blob('ckpts', 'other.txt', b'y')
+    assert list(client.list_blobs('ckpts', prefix='a/')) == [
+        'a/b.txt', 'a/c.txt']
+    client.delete_blob('ckpts', 'a/b.txt')
+    assert list(client.list_blobs('ckpts', prefix='a/')) == ['a/c.txt']
+    client.delete_container('ckpts')
+    assert not client.container_exists('ckpts')
+
+
+def test_sync_up_down(fake_azure, tmp_path):
+    client = _client()
+    client.create_container('data')
+    src = tmp_path / 'src'
+    (src / 'sub').mkdir(parents=True)
+    (src / 'one.txt').write_text('1')
+    (src / 'sub' / 'two.txt').write_text('2')
+    assert client.sync_up(str(src), 'data', prefix='in') == 2
+    dest = tmp_path / 'dest'
+    assert client.sync_down('data', 'in', str(dest)) == 2
+    assert (dest / 'one.txt').read_text() == '1'
+    assert (dest / 'sub' / 'two.txt').read_text() == '2'
+
+
+def test_store_wiring_and_uris(fake_azure):
+    assert storage.StoreType.from_uri('az://bucket') == \
+        storage.StoreType.AZURE
+    assert storage.StoreType.from_uri('oci://b') == storage.StoreType.S3
+    store = storage.AzureBlobStore('cont')
+    store.create()
+    assert store.exists()
+    assert store.url == 'az://cont'
+    mount = store.mount_command('/mnt/az')
+    assert 'rclone mount' in mount and 'skyt-az:cont' in mount
+    assert 'AZURE_STORAGE_ACCOUNT=testacct' in mount
+    cached = store.mount_cached_command('/mnt/az')
+    assert '--vfs-cache-mode writes' in cached
+    down = store.download_command('/tmp/dl', prefix='p')
+    assert 'azure_blob download' in down
+
+
+def test_missing_credentials_raise(tmp_home, monkeypatch):
+    monkeypatch.delenv('AZURE_STORAGE_ACCOUNT', raising=False)
+    monkeypatch.delenv('AZURE_STORAGE_KEY', raising=False)
+    with pytest.raises(exceptions.StorageError, match='credentials'):
+        azure_blob.AzureBlobConfig.load()
+
+
+def test_block_streaming_upload_and_download(fake_azure, tmp_path,
+                                             monkeypatch):
+    """Large files go through Put Block / Put Block List with bounded
+    memory, and downloads stream to disk."""
+    client = _client()
+    client.create_container('big')
+    src = tmp_path / 'big.bin'
+    payload = bytes(range(256)) * 1024          # 256 KiB
+    src.write_bytes(payload)
+    monkeypatch.setattr(azure_blob, 'SINGLE_PUT_LIMIT', 1024)
+    client.put_blob_from_file('big', 'ckpt.bin', str(src),
+                              block_size=64 * 1024)
+    assert client.get_blob('big', 'ckpt.bin') == payload
+    dest = tmp_path / 'down.bin'
+    client.get_blob_to_file('big', 'ckpt.bin', str(dest))
+    assert dest.read_bytes() == payload
+
+
+def test_sync_down_rejects_escaping_blob_names(fake_azure, tmp_path):
+    client = _client()
+    client.create_container('evil')
+    client.put_blob('evil', '../outside.txt', b'pwn')
+    with pytest.raises(exceptions.StorageError, match='escaping'):
+        client.sync_down('evil', '', str(tmp_path / 'dl'))
+
+
+def test_mount_conf_regenerated_with_endpoint(fake_azure):
+    from skypilot_tpu.data import mounting_utils
+    cmd = mounting_utils.azure_mount_command('c', '/mnt/c')
+    assert 'skyt-az.conf' in cmd
+    assert 'endpoint = ${SKYT_AZURE_BLOB_ENDPOINT}' in cmd
+    assert '--config' in cmd
+    assert 'grep -q' not in cmd  # regenerated, never grep-frozen
