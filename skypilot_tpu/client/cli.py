@@ -550,6 +550,53 @@ def users_set_role(name: str, role: str) -> None:
     click.echo(f'user {name} role -> {role}')
 
 
+@users.command('service-account')
+@click.argument('name')
+@click.option('--label', default='')
+@click.option('--expires-hours', type=float, default=None,
+              help='Token lifetime; omitted = no expiry.')
+def users_service_account(name: str, label: str,
+                          expires_hours: Optional[float]) -> None:
+    """Create a machine principal + its bearer token (printed once).
+
+    Service accounts never hold admin or workspace-admin rights; their
+    tokens can expire (parity: sky/users/token_service.py SA tokens).
+    """
+    from skypilot_tpu.client import sdk
+    result = sdk.users_service_account(
+        name, label,
+        expires_seconds=(expires_hours * 3600
+                         if expires_hours is not None else None))
+    click.echo(f"service account {result['name']}: {result['token']}")
+
+
+@users.command('set-workspace-role')
+@click.argument('workspace')
+@click.argument('name')
+@click.argument('role', type=click.Choice(['admin', 'editor', 'viewer',
+                                           'none']))
+def users_set_workspace_role(workspace: str, name: str,
+                             role: str) -> None:
+    """Bind (or with 'none', unbind) a user's role in a workspace.
+
+    The first binding CLOSES the workspace to non-members: submission
+    needs 'use' (editor+), request/log visibility needs 'view'.
+    """
+    from skypilot_tpu.client import sdk
+    sdk.workspace_set_role(workspace, name,
+                           None if role == 'none' else role)
+    click.echo(f'{workspace}: {name} -> {role}')
+
+
+@users.command('workspace-roles')
+@click.option('--workspace', '-w', default=None)
+def users_workspace_roles(workspace: Optional[str]) -> None:
+    """List per-workspace role bindings."""
+    from skypilot_tpu.client import sdk
+    _echo_table(sdk.workspace_roles(workspace),
+                ['workspace', 'user_name', 'role'])
+
+
 @users.command('token')
 @click.argument('name', required=False, default=None)
 @click.option('--label', default='')

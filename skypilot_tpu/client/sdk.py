@@ -14,6 +14,7 @@ import os
 import sys
 import tarfile
 import time
+import urllib.parse
 from typing import Any, Dict, List, Optional, Union
 
 import requests as requests_lib
@@ -447,6 +448,31 @@ def users_token(name: Optional[str] = None, label: str = '') -> str:
     if name:
         body['name'] = name
     return _users_request('POST', '/api/users/token', body)['token']
+
+
+def users_service_account(name: str, label: str = '',
+                          expires_seconds: Optional[float] = None
+                          ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {'name': name, 'label': label}
+    if expires_seconds is not None:
+        body['expires_seconds'] = expires_seconds
+    return _users_request('POST', '/api/users/service-account', body)
+
+
+def workspace_set_role(workspace: str, name: str,
+                       role: Optional[str]) -> Dict[str, Any]:
+    """Bind (role) or unbind (role=None) a user in a workspace."""
+    return _users_request('POST', '/api/workspaces/set-role',
+                          {'workspace': workspace, 'name': name,
+                           'role': role})
+
+
+def workspace_roles(workspace: Optional[str] = None
+                    ) -> List[Dict[str, Any]]:
+    route = '/api/workspaces/roles'
+    if workspace:
+        route += '?' + urllib.parse.urlencode({'workspace': workspace})
+    return _users_request('GET', route)
 
 
 # -- workdir upload ----------------------------------------------------

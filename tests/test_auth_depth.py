@@ -18,6 +18,7 @@ import requests as requests_lib
 from skypilot_tpu import config
 from skypilot_tpu.server import requests_db, sessions
 from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.client import cli as cli_mod
 from skypilot_tpu.users import rbac, users_db
 
 
@@ -358,3 +359,27 @@ def test_dashboard_data_hides_bound_workspace_requests(auth_server):
     data_m = requests_lib.get(f'{srv.url}/api/dashboard/data',
                               headers=_hdr(member), timeout=10).json()
     assert rid in {r['request_id'] for r in data_m['requests']}
+
+
+def test_cli_workspace_role_and_service_account_verbs(auth_server):
+    """The skyt verbs for the r3 admin surfaces (SDK -> server)."""
+    from click.testing import CliRunner
+    srv, admin_token = auth_server
+    config.set_nested(('api_server', 'token'), admin_token)
+    runner = CliRunner()
+    users_db.create_user('wanda')
+    r = runner.invoke(cli_mod.cli, ['users', 'set-workspace-role',
+                                    'lab', 'wanda', 'editor'])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli_mod.cli, ['users', 'workspace-roles',
+                                    '-w', 'lab'])
+    assert 'wanda' in r.output and 'editor' in r.output
+    r = runner.invoke(cli_mod.cli, ['users', 'set-workspace-role',
+                                    'lab', 'wanda', 'none'])
+    assert r.exit_code == 0
+    assert users_db.get_workspace_role('lab', 'wanda') is None
+    r = runner.invoke(cli_mod.cli, ['users', 'service-account', 'robot',
+                                    '--expires-hours', '1'])
+    assert r.exit_code == 0, r.output
+    token = r.output.split(':', 1)[1].strip()
+    assert users_db.authenticate(token).name == 'robot'
