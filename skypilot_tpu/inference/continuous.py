@@ -231,12 +231,11 @@ class ContinuousBatchingEngine:
 
     # -- public API -----------------------------------------------------
 
-    def generate_ids(self, token_ids: List[int], *,
-                     max_new_tokens: int = 32,
-                     temperature: float = 0.0,
-                     eos_id: Optional[int] = None,
-                     seed: int = 0,
-                     timeout: float = 300.0) -> List[int]:
+    def _submit(self, token_ids: List[int], max_new_tokens: int,
+                temperature: float, eos_id: Optional[int],
+                seed: int) -> _Request:
+        """Shared admission path: validate + enqueue (both the blocking
+        and streaming entries; the policy must not drift between them)."""
         if len(token_ids) >= self.max_len:
             # Reject loudly: silently truncating a prompt answers a
             # question the caller never asked.
@@ -247,6 +246,16 @@ class ContinuousBatchingEngine:
                            eos_id, seed)
         self._pending.put(request)
         self._wake.set()
+        return request
+
+    def generate_ids(self, token_ids: List[int], *,
+                     max_new_tokens: int = 32,
+                     temperature: float = 0.0,
+                     eos_id: Optional[int] = None,
+                     seed: int = 0,
+                     timeout: float = 300.0) -> List[int]:
+        request = self._submit(token_ids, max_new_tokens, temperature,
+                               eos_id, seed)
         if not request.done.wait(timeout):
             raise TimeoutError('generation timed out')
         if request.error is not None:
@@ -261,6 +270,59 @@ class ContinuousBatchingEngine:
         out = self.generate_ids(ids, eos_id=self.tokenizer.eos_id,
                                 **kwargs)
         return self.tokenizer.decode(out)
+
+    def stream_ids(self, token_ids: List[int], *,
+                   max_new_tokens: int = 32,
+                   temperature: float = 0.0,
+                   eos_id: Optional[int] = None,
+                   seed: int = 0,
+                   timeout: float = 300.0):
+        """Yield generated token ids AS THEY LAND in the slot loop
+        (the decode thread appends to request.generated; this iterator
+        tails it) — the vLLM/JetStream streaming serving shape.
+
+        Validation/admission happens EAGERLY (same as generate_ids: an
+        over-long prompt raises here, not at first iteration)."""
+        import time as time_lib
+        request = self._submit(token_ids, max_new_tokens, temperature,
+                               eos_id, seed)
+
+        def tail():
+            emitted = 0
+            deadline = time_lib.time() + timeout
+            while True:
+                finished = request.done.is_set()
+                generated = request.generated
+                while emitted < len(generated):
+                    token = generated[emitted]
+                    emitted += 1
+                    if eos_id is not None and token == eos_id:
+                        return
+                    yield token
+                if finished:
+                    if request.error is not None:
+                        raise request.error
+                    return
+                if time_lib.time() > deadline:
+                    raise TimeoutError('generation timed out')
+                time_lib.sleep(0.005)
+
+        return tail()
+
+    def stream_text(self, prompt: str, **kwargs: Any):
+        """Yield text DELTAS: ids decode cumulatively (single BPE
+        tokens may be partial UTF-8; the running decode keeps deltas
+        well-formed)."""
+        ids = self.tokenizer.encode(prompt)
+        out_ids: List[int] = []
+        text_so_far = ''
+        for token in self.stream_ids(ids, eos_id=self.tokenizer.eos_id,
+                                     **kwargs):
+            out_ids.append(token)
+            text = self.tokenizer.decode(out_ids)
+            delta, text_so_far = text[len(text_so_far):], text
+            if delta:
+                yield delta
 
     def generate_texts(self, prompts: List[str],
                        **kwargs: Any) -> List[str]:

@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from skypilot_tpu.inference.engine import InferenceEngine
@@ -56,25 +58,160 @@ def make_handler(engine: InferenceEngine):
                 self._json(404, {'error': 'not found'})
 
         def do_POST(self):
-            if self.path != '/generate':
-                self._json(404, {'error': 'not found'})
-                return
             try:
                 length = int(self.headers.get('Content-Length', 0))
                 req = json.loads(self.rfile.read(length) or b'{}')
-                prompts = req.get('prompts') or [req.get('prompt', '')]
-                kwargs = dict(
-                    max_new_tokens=int(req.get('max_new_tokens', 32)),
-                    temperature=float(req.get('temperature', 0.0)),
-                    seed=int(req.get('seed', 0)))
-                if hasattr(engine, 'generate_texts'):
-                    outputs = engine.generate_texts(prompts, **kwargs)
+                if self.path == '/generate':
+                    self._generate(req)
+                elif self.path == '/v1/completions':
+                    self._openai(req, chat=False)
+                elif self.path == '/v1/chat/completions':
+                    self._openai(req, chat=True)
                 else:
-                    outputs = engine.generate_text(prompts, **kwargs)
-                self._json(200, {'outputs': outputs})
+                    self._json(404, {'error': 'not found'})
             except Exception as e:  # pylint: disable=broad-except
                 logger.error('generate failed: %s', e, exc_info=True)
-                self._json(500, {'error': str(e)})
+                try:
+                    self._json(500, {'error': str(e)})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        def _generate(self, req):
+            prompts = req.get('prompts') or [req.get('prompt', '')]
+            kwargs = dict(
+                max_new_tokens=int(req.get('max_new_tokens', 32)),
+                temperature=float(req.get('temperature', 0.0)),
+                seed=int(req.get('seed', 0)))
+            if hasattr(engine, 'generate_texts'):
+                outputs = engine.generate_texts(prompts, **kwargs)
+            else:
+                outputs = engine.generate_text(prompts, **kwargs)
+            self._json(200, {'outputs': outputs})
+
+        # -- OpenAI-compatible surface (parity: the reference serves
+        # vLLM, whose clients speak this API; point an OpenAI client's
+        # base_url here and it works, streaming included) -------------
+
+        def _openai(self, req, chat: bool):
+            if chat:
+                messages = req.get('messages') or []
+                prompt = ''.join(
+                    f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+                    for m in messages) + 'assistant:'
+            else:
+                prompt = req.get('prompt', '')
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ''
+            # `null` is how OpenAI clients spell "default" — never
+            # float(None)-crash on it.
+            max_tokens = int(req.get('max_tokens') or 64)
+            kwargs = dict(
+                max_new_tokens=max_tokens,
+                temperature=float(req.get('temperature') or 0.0))
+            rid = f'cmpl-{os.urandom(8).hex()}'
+            model = engine.cfg.name
+            if req.get('stream'):
+                if not hasattr(engine, 'stream_ids'):
+                    # A silent JSON body would break SSE-expecting
+                    # clients: refuse clearly instead.
+                    self._json(400, {
+                        'error': 'stream=true requires the continuous '
+                                 'engine (--engine continuous)'})
+                    return
+                self._openai_stream(rid, model, prompt, chat, kwargs)
+                return
+            tok = engine.tokenizer
+            ids = tok.encode(prompt)
+            if hasattr(engine, 'generate_texts'):
+                # continuous engine: single-request ids API
+                out_ids = engine.generate_ids(
+                    ids, eos_id=tok.eos_id, **kwargs)
+            else:
+                # batch engine: list-in, list-out
+                out_ids = engine.generate_ids([ids], **kwargs)[0]
+                if tok.eos_id in out_ids:
+                    out_ids = out_ids[:out_ids.index(tok.eos_id)]
+            text = tok.decode(out_ids)
+            finish = ('length' if len(out_ids) >= max_tokens
+                      else 'stop')
+            if chat:
+                choice = {'index': 0, 'finish_reason': finish,
+                          'message': {'role': 'assistant',
+                                      'content': text}}
+                obj = 'chat.completion'
+            else:
+                choice = {'index': 0, 'finish_reason': finish,
+                          'text': text}
+                obj = 'text_completion'
+            self._json(200, {'id': rid, 'object': obj, 'model': model,
+                             'created': int(time.time()),
+                             'choices': [choice]})
+
+        def _openai_stream(self, rid, model, prompt, chat, kwargs):
+            # Everything that can fail with a clean 500 must happen
+            # BEFORE the 200 + chunked headers go out (after that, a
+            # second status line would corrupt the stream).
+            tok = engine.tokenizer
+            ids = tok.encode(prompt)
+            token_iter = engine.stream_ids(ids, eos_id=tok.eos_id,
+                                           **kwargs)
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Cache-Control', 'no-cache')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+
+            def send(obj_bytes: bytes) -> None:
+                frame = b'data: ' + obj_bytes + b'\n\n'
+                self.wfile.write(f'{len(frame):x}\r\n'.encode() +
+                                 frame + b'\r\n')
+                self.wfile.flush()
+
+            created = int(time.time())
+            obj = 'chat.completion.chunk' if chat else 'text_completion'
+
+            def chunk(choice) -> bytes:
+                return json.dumps({'id': rid, 'object': obj,
+                                   'model': model, 'created': created,
+                                   'choices': [choice]}).encode()
+
+            try:
+                out_ids, text_so_far = [], ''
+                try:
+                    for token in token_iter:
+                        out_ids.append(token)
+                        text = tok.decode(out_ids)
+                        delta = text[len(text_so_far):]
+                        text_so_far = text
+                        if not delta:
+                            continue
+                        if chat:
+                            choice = {'index': 0, 'finish_reason': None,
+                                      'delta': {'content': delta}}
+                        else:
+                            choice = {'index': 0, 'finish_reason': None,
+                                      'text': delta}
+                        send(chunk(choice))
+                    finish = ('length'
+                              if len(out_ids) >=
+                              kwargs['max_new_tokens'] else 'stop')
+                except Exception as e:  # pylint: disable=broad-except
+                    # Mid-stream failure: the status line is gone; the
+                    # honest move is an error frame + clean termination.
+                    logger.error('stream failed: %s', e, exc_info=True)
+                    send(json.dumps({'error': str(e)}).encode())
+                    finish = None
+                if finish is not None:
+                    final = ({'index': 0, 'finish_reason': finish,
+                              'delta': {}} if chat else
+                             {'index': 0, 'finish_reason': finish,
+                              'text': ''})
+                    send(chunk(final))
+                send(b'[DONE]')
+                self.wfile.write(b'0\r\n\r\n')
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-stream
 
     return Handler
 

@@ -101,3 +101,71 @@ def test_http_payload_on_continuous_engine(engine):
         assert stats['slots'] == engine.max_slots
     finally:
         server.shutdown()
+
+
+def test_stream_ids_yields_incrementally(tmp_home):
+    """Tokens surface while the slot loop is still decoding — the
+    streaming serving shape (vLLM/JetStream parity)."""
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    engine = ContinuousBatchingEngine('tiny', max_slots=2, max_len=64)
+    try:
+        ids = engine.tokenizer.encode('stream me')
+        seen = list(engine.stream_ids(ids, max_new_tokens=6,
+                                      eos_id=None))
+        assert len(seen) == 6
+        # Deterministic greedy: matches the non-streaming result.
+        full = engine.generate_ids(ids, max_new_tokens=6)
+        assert seen == full
+        # Text deltas reassemble into the full decode.
+        deltas = list(engine.stream_text('stream me', max_new_tokens=6))
+        assert ''.join(deltas) == engine.generate_text(
+            'stream me', max_new_tokens=6)
+    finally:
+        engine.shutdown()
+
+
+def test_openai_compatible_routes(tmp_home):
+    """OpenAI-surface parity: completions + chat + SSE streaming."""
+    import json as json_lib
+    import threading
+    import requests as requests_lib
+    from skypilot_tpu.inference import server as srv_mod
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    engine = ContinuousBatchingEngine('tiny', max_slots=2, max_len=64)
+    server = srv_mod.serve(engine, '127.0.0.1', 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        base = f'http://127.0.0.1:{port}'
+        r = requests_lib.post(f'{base}/v1/completions',
+                              json={'prompt': 'hello', 'max_tokens': 4},
+                              timeout=60)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body['object'] == 'text_completion'
+        # 4 tokens generated without an EOS = truncated by max_tokens.
+        assert body['choices'][0]['finish_reason'] == 'length'
+        c = requests_lib.post(
+            f'{base}/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'hi'}],
+                  'max_tokens': 4}, timeout=60)
+        msg = c.json()['choices'][0]['message']
+        assert msg['role'] == 'assistant'
+        # SSE streaming: data: frames ending with [DONE].
+        s = requests_lib.post(
+            f'{base}/v1/completions',
+            json={'prompt': 'hello', 'max_tokens': 4, 'stream': True},
+            timeout=60, stream=True)
+        frames = [ln for ln in s.iter_lines() if ln]
+        assert frames[-1] == b'data: [DONE]'
+        payloads = [json_lib.loads(f[len(b'data: '):])
+                    for f in frames[:-1]]
+        assert payloads[-1]['choices'][0]['finish_reason'] in (
+            'stop', 'length')
+        assert all(p['object'] == 'text_completion' for p in payloads)
+        # Random tiny weights may emit only special tokens (empty
+        # deltas) — frame STRUCTURE is the contract under test; delta
+        # content equivalence is covered by test_stream_ids.
+    finally:
+        server.shutdown()
+        engine.shutdown()

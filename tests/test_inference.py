@@ -70,3 +70,24 @@ def test_http_server_generate_and_health(engine):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_openai_route_on_batch_engine(tmp_home):
+    """/v1/completions works on the batch-synchronous engine too (its
+    generate_text is list-in/list-out)."""
+    import threading
+    import requests as requests_lib
+    from skypilot_tpu.inference import server as srv_mod
+    from skypilot_tpu.inference.engine import InferenceEngine
+    engine = InferenceEngine('tiny')
+    server = srv_mod.serve(engine, '127.0.0.1', 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        r = requests_lib.post(
+            f'http://127.0.0.1:{port}/v1/completions',
+            json={'prompt': 'hello', 'max_tokens': 4}, timeout=120)
+        assert r.status_code == 200, r.text
+        assert isinstance(r.json()['choices'][0]['text'], str)
+    finally:
+        server.shutdown()
