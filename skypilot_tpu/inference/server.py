@@ -8,13 +8,20 @@ decode scan, not the web layer.
     python -m skypilot_tpu.inference.server --model tiny --port 8080
 
 Endpoints:
-    GET  /health            -> 200 {"status": "ok", "model": ...}
-    GET  /stats             -> decode throughput counters
-    POST /generate          -> {"prompts": [...], "max_new_tokens": N,
-                                "temperature": t} -> {"outputs": [...]}
+    GET  /health               -> 200 {"status": "ok", "model": ...}
+    GET  /stats                -> decode throughput counters
+    POST /generate             -> {"prompts": [...], "max_new_tokens":
+                                   N, "temperature": t}
+                                  -> {"outputs": [...]}
+    POST /v1/completions       -> OpenAI-compatible (incl. SSE
+    POST /v1/chat/completions     streaming with the continuous
+                                  engine) — point an OpenAI client's
+                                  base_url here; the serve stack's
+                                  load balancer forwards these too.
 
 Parity: the JetStream/vLLM serving payloads of the reference
-(``examples/tpu/v6e/benchmark-llama2-7b.yaml``, ``llm/vllm``).
+(``examples/tpu/v6e/benchmark-llama2-7b.yaml``, ``llm/vllm`` — whose
+clients speak exactly this OpenAI surface).
 """
 from __future__ import annotations
 
