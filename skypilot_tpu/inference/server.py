@@ -9,7 +9,8 @@ decode scan, not the web layer.
 
 Endpoints:
     GET  /health               -> 200 {"status": "ok", "model": ...}
-    GET  /stats                -> decode throughput counters
+    GET  /stats                -> decode throughput counters (JSON)
+    GET  /metrics              -> the same counters, Prometheus text
     POST /generate             -> {"prompts": [...], "max_new_tokens":
                                    N, "temperature": t}
                                   -> {"outputs": [...]}
@@ -54,13 +55,45 @@ def make_handler(engine: InferenceEngine):
             self.end_headers()
             self.wfile.write(body)
 
+        def _body(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header('Content-Type', ctype)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _stats(self):
+            stats = engine.stats
+            return stats() if callable(stats) else stats
+
+        # Monotonic counters vs point-in-time gauges (Prometheus type
+        # correctness: rate() over a gauge breaks scrapers/linters).
+        _COUNTERS = frozenset({'requests', 'tokens_generated',
+                               'decode_seconds'})
+
         def do_GET(self):
             if self.path == '/health':
                 self._json(200, {'status': 'ok',
                                  'model': engine.cfg.name})
             elif self.path == '/stats':
-                stats = engine.stats
-                self._json(200, stats() if callable(stats) else stats)
+                self._json(200, self._stats())
+            elif self.path == '/metrics':
+                # Prometheus text format for external scrapers
+                # (parity: vLLM's /metrics; the serve stack's
+                # autoscalers use the load balancer's LoadStats, not
+                # this endpoint).
+                lines = []
+                for key, value in sorted(self._stats().items()):
+                    if isinstance(value, (int, float)):
+                        kind = ('counter' if key in self._COUNTERS
+                                else 'gauge')
+                        name = f'skyt_inference_{key}'
+                        if kind == 'counter':
+                            name += '_total'
+                        lines.append(f'# TYPE {name} {kind}')
+                        lines.append(f'{name} {value}')
+                self._body(200, ('\n'.join(lines) + '\n').encode(),
+                           'text/plain; version=0.0.4')
             else:
                 self._json(404, {'error': 'not found'})
 

@@ -91,3 +91,25 @@ def test_openai_route_on_batch_engine(tmp_home):
         assert isinstance(r.json()['choices'][0]['text'], str)
     finally:
         server.shutdown()
+
+
+def test_inference_server_metrics_endpoint(engine, tmp_home):
+    import threading
+    import requests as requests_lib
+    from skypilot_tpu.inference import server as srv_mod
+    server = srv_mod.serve(engine, '127.0.0.1', 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        requests_lib.post(f'http://127.0.0.1:{port}/generate',
+                          json={'prompts': ['x'], 'max_new_tokens': 2},
+                          timeout=120)
+        m = requests_lib.get(f'http://127.0.0.1:{port}/metrics',
+                             timeout=10)
+        assert m.status_code == 200
+        # Monotonic stats are counters with _total; Prometheus-typed.
+        assert '# TYPE skyt_inference_requests_total counter' in m.text
+        assert 'skyt_inference_tokens_generated_total' in m.text
+    finally:
+        server.shutdown()
+        server.server_close()
