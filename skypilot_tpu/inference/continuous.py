@@ -116,6 +116,9 @@ class ContinuousBatchingEngine:
         self._last_logits = jnp.zeros((max_slots, self.cfg.vocab_size),
                                       jnp.float32)
         self._pending: 'queue.Queue[_Request]' = queue.Queue()
+        self._requests_total = 0
+        self._tokens_total = 0
+        self._decode_seconds_total = 0.0
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop,
@@ -183,6 +186,8 @@ class ContinuousBatchingEngine:
                 continue
             temps = np.array([r.temperature if r else 0.0
                               for r in self._slots], np.float32)
+            import time as time_lib
+            step_t0 = time_lib.perf_counter()
             try:
                 tokens, logits, cache = self._decode_fn(
                     self.params, self._last_logits, self.cache,
@@ -200,10 +205,13 @@ class ContinuousBatchingEngine:
             self._last_logits = logits
             host_tokens = np.asarray(tokens)
             lengths = np.asarray(cache.lengths)
+            self._decode_seconds_total += (time_lib.perf_counter() -
+                                           step_t0)
             for slot, request in enumerate(self._slots):
                 if request is None:
                     continue
                 token = int(host_tokens[slot])
+                self._tokens_total += 1
                 request.generated.append(token)
                 finished = (
                     (request.eos_id is not None and
@@ -244,6 +252,7 @@ class ContinuousBatchingEngine:
                 f'{self.max_len} (prompt + generation must fit)')
         request = _Request(token_ids, max_new_tokens, temperature,
                            eos_id, seed)
+        self._requests_total += 1
         self._pending.put(request)
         self._wake.set()
         return request
@@ -339,11 +348,15 @@ class ContinuousBatchingEngine:
                        for p in prompts]
             return [f.result() for f in futures]
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, float]:
         return {
             'slots': self.max_slots,
             'active': sum(r is not None for r in self._slots),
             'pending': self._pending.qsize(),
+            # Monotonic counters (Prometheus counter type on /metrics).
+            'requests': self._requests_total,
+            'tokens_generated': self._tokens_total,
+            'decode_seconds': round(self._decode_seconds_total, 4),
         }
 
     def shutdown(self) -> None:

@@ -48,12 +48,8 @@ def make_handler(engine: InferenceEngine):
             logger.debug(fmt, *args)
 
         def _json(self, code: int, payload) -> None:
-            body = json.dumps(payload).encode('utf-8')
-            self.send_response(code)
-            self.send_header('Content-Type', 'application/json')
-            self.send_header('Content-Length', str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._body(code, json.dumps(payload).encode('utf-8'),
+                       'application/json')
 
         def _body(self, code: int, body: bytes, ctype: str) -> None:
             self.send_response(code)

@@ -38,6 +38,28 @@ class ModelConfig:
     # MoE (0 experts = dense)
     num_experts: int = 0
     experts_per_token: int = 2
+    # MoE dispatch: 'dense' (every expert runs every token — exact,
+    # O(E/k)x MLP FLOPs overhead; fine for tiny E) or 'capacity'
+    # (fixed per-expert capacity C = factor*G*k/E per token group,
+    # sort-free cumsum routing, tokens over capacity drop that expert —
+    # the standard TPU MoE shape: static shapes, expert-sharded
+    # einsums).
+    moe_dispatch: str = 'dense'
+    capacity_factor: float = 1.25
+    # Routing-tensor bound: tokens route in groups of at most this many
+    # (GShard-style group axis) so the [*, G*k, E, C] dispatch tensors
+    # stay O(G^2) instead of O(S^2) at long sequence lengths.
+    moe_group_size: int = 4096
+    # Switch/GShard router load-balancing auxiliary loss coefficient
+    # (0 disables). Without it, capacity dispatch lets the router
+    # collapse onto a few experts and silently drop most tokens.
+    router_aux_loss_coeff: float = 0.01
+
+    def __post_init__(self) -> None:
+        if self.moe_dispatch not in ('dense', 'capacity'):
+            raise ValueError(
+                f'unknown moe_dispatch {self.moe_dispatch!r} '
+                "(expected 'dense' or 'capacity')")
     # gated-MLP activation: 'silu' (llama/mixtral/qwen) or 'gelu_tanh'
     # (gemma-family GeGLU)
     activation: str = 'silu'

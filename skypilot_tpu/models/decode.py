@@ -154,10 +154,13 @@ def _prefill_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
     dt = cfg.compute_dtype
     if cfg.is_moe:
-        # Decode reuses the dense-dispatch MoE from training.
+        # Decode reuses the training MoE block (dense or capacity per
+        # cfg.moe_dispatch — capacity drops over-capacity tokens during
+        # prefill too); the router aux loss is a training-only term.
         from skypilot_tpu.models.llama import _moe_block
         from skypilot_tpu.parallel.sharding import DEFAULT_RULES
-        return _moe_block(x, lp['moe'], cfg, DEFAULT_RULES)
+        out, _aux = _moe_block(x, lp['moe'], cfg, DEFAULT_RULES)
+        return out
     mlp = lp['mlp']
     from skypilot_tpu.models.llama import _activate
     gate = weight_einsum('bsd,df->bsf', x, mlp['wi_gate'], dt)

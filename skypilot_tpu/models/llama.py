@@ -233,18 +233,100 @@ def _mlp_block(x: jax.Array, lp: Params, cfg: ModelConfig,
         jnp.einsum('bsf,fd->bsd', hidden, lp['wo'].astype(dt)), 'mlp_out')
 
 
+def _router_aux_loss(router_logits: jax.Array,
+                     selected: jax.Array, e: int) -> jax.Array:
+    """Switch/GShard load-balancing loss: E * Σ_e f_e · P_e, where f_e
+    is the fraction of tokens whose TOP-1 expert is e and P_e the mean
+    router probability of e. Minimized (=1) at uniform balance — the
+    gradient pressure that keeps capacity dispatch from collapsing onto
+    a few experts and silently dropping most tokens."""
+    probs = jax.nn.softmax(router_logits, axis=-1)            # [B,S,E]
+    top1 = jax.nn.one_hot(selected[..., 0], e, dtype=jnp.float32)
+    f = top1.reshape(-1, e).mean(axis=0)
+    p = probs.reshape(-1, e).mean(axis=0)
+    return e * jnp.sum(f * p)
+
+
+def _moe_block_capacity(x: jax.Array, lp: Params, cfg: ModelConfig,
+                        rules: LogicalAxisRules):
+    """Capacity-based top-k MoE dispatch (the standard TPU shape).
+
+    Tokens route in GROUPS of at most ``moe_group_size`` (GShard group
+    axis): per group each expert processes at most
+    C = ceil(capacity_factor * G * k / E) tokens, so the routing
+    tensors are O(G·E·C) ≈ O(G²) per group instead of O(S²) at long
+    sequence lengths. Routing is a cumsum position-in-expert (no sort,
+    no data-dependent gather — XLA keeps everything tiled), the expert
+    FFN runs on [E, B', C, d] sharded over the 'expert' mesh axis, and
+    tokens over capacity lose that expert's contribution. Versus the
+    dense dispatch this cuts MLP FLOPs from E/k-fold to
+    ~capacity_factor-fold of the active compute.
+
+    Returns (out, aux_loss).
+    """
+    dt = cfg.compute_dtype
+    b, s, d = x.shape
+    e, k_top = cfg.num_experts, cfg.experts_per_token
+    group = min(s, cfg.moe_group_size)
+    if s % group:
+        group = s  # indivisible: one group (small/odd seq lengths)
+    n_groups = s // group
+    xg = x.reshape(b * n_groups, group, d)
+    bg = b * n_groups
+    capacity = max(1, -(-int(cfg.capacity_factor * group * k_top) // e))
+    router_logits = jnp.einsum('bsd,de->bse', xg.astype(jnp.float32),
+                               lp['router'].astype(jnp.float32))
+    weights, selected = jax.lax.top_k(router_logits, k_top)   # [B',G,k]
+    weights = jax.nn.softmax(weights, axis=-1)
+    aux = _router_aux_loss(router_logits, selected, e)
+    mask = jax.nn.one_hot(selected, e, dtype=jnp.float32)     # [B',G,k,E]
+    # Position-in-expert: k-major priority (every token's 1st choice
+    # claims capacity before any 2nd choice), tokens in sequence order.
+    mask_km = mask.transpose(0, 2, 1, 3).reshape(bg, k_top * group, e)
+    pos = jnp.cumsum(mask_km, axis=1) - 1.0                   # [B',kG,E]
+    keep = mask_km * (pos < capacity)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32) * \
+        keep[..., None]                                       # [B',kG,E,C]
+    slot = slot.reshape(bg, k_top, group, e, capacity).transpose(
+        0, 2, 1, 3, 4)                                        # [B',G,k,E,C]
+    combine = jnp.einsum('bsk,bskec->bsec', weights, slot)    # [B',G,E,C]
+    dispatch = (combine > 0.0).astype(dt)
+    xe = jnp.einsum('bsec,bsd->ebcd', dispatch, xg)           # [E,B',C,d]
+    xe = with_logical_constraint(xe, ('expert', 'batch', None,
+                                      'act_embed'), rules=rules)
+    gate = jnp.einsum('ebcd,edf->ebcf', xe, lp['wi_gate'].astype(dt))
+    up = jnp.einsum('ebcd,edf->ebcf', xe, lp['wi_up'].astype(dt))
+    hidden = _activate(gate, cfg) * up
+    hidden = with_logical_constraint(hidden, ('expert', 'batch', None,
+                                              'mlp'), rules=rules)
+    hidden = checkpoint_name(hidden, 'mlp_hidden')
+    out_e = jnp.einsum('ebcf,efd->ebcd', hidden, lp['wo'].astype(dt))
+    y = jnp.einsum('bsec,ebcd->bsd', combine.astype(dt), out_e)
+    y = y.reshape(b, s, d)
+    return checkpoint_name(y, 'mlp_out'), aux
+
+
 def _moe_block(x: jax.Array, lp: Params, cfg: ModelConfig,
-               rules: LogicalAxisRules) -> jax.Array:
+               rules: LogicalAxisRules):
     """Mixtral-style top-k MoE, einsum-dispatched (dense one-hot combine).
 
     Dense dispatch keeps shapes static for XLA (no gather/scatter with
     data-dependent sizes); expert matmuls shard over the 'expert' mesh axis.
+    ``cfg.moe_dispatch='capacity'`` routes to the fixed-capacity
+    implementation instead (_moe_block_capacity).
+
+    Returns (out, aux_loss) — the router load-balancing term the train
+    loss adds with ``router_aux_loss_coeff``.
     """
+    if cfg.moe_dispatch == 'capacity':
+        return _moe_block_capacity(x, lp, cfg, rules)
     dt = cfg.compute_dtype
     e, k_top = cfg.num_experts, cfg.experts_per_token
     router_logits = jnp.einsum('bsd,de->bse', x.astype(jnp.float32),
                                lp['router'].astype(jnp.float32))
     weights, selected = jax.lax.top_k(router_logits, k_top)     # [B,S,k]
+    aux = _router_aux_loss(router_logits, selected, e)
     weights = jax.nn.softmax(weights, axis=-1)                  # renormalize
     # combine[b,s,e] = sum_k weight_k * onehot(selected_k == e)
     combine = jnp.sum(
@@ -262,24 +344,27 @@ def _moe_block(x: jax.Array, lp: Params, cfg: ModelConfig,
     hidden = checkpoint_name(hidden, 'mlp_hidden')
     expert_out = jnp.einsum('ebsf,efd->ebsd', hidden, lp['wo'].astype(dt))
     out = jnp.einsum('ebsd,bse->bsd', expert_out, combine.astype(dt))
-    return checkpoint_name(out, 'mlp_out')
+    return checkpoint_name(out, 'mlp_out'), aux
 
 
 def _decoder_layer(x: jax.Array, lp: Params, cfg: ModelConfig,
                    sin: jax.Array, cos: jax.Array,
                    rules: LogicalAxisRules,
-                   segments: Optional[jax.Array] = None) -> jax.Array:
+                   segments: Optional[jax.Array] = None):
+    """Returns (x, aux_loss) — aux is 0 for dense-MLP layers."""
     h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
     x = x + _attention_block(h, lp['attn'], cfg, sin, cos, rules,
                              segments=segments,
                              lora_params=lp.get('lora'))
     h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
     if cfg.is_moe:
-        x = x + _moe_block(h, lp['moe'], cfg, rules)
+        moe_out, aux = _moe_block(h, lp['moe'], cfg, rules)
+        x = x + moe_out
     else:
         x = x + _mlp_block(h, lp['mlp'], cfg, rules)
+        aux = jnp.zeros((), jnp.float32)
     return with_logical_constraint(x, ('batch', 'act_seq', 'act_embed'),
-                                   rules=rules)
+                                   rules=rules), aux
 
 
 def _remat_policy(cfg: ModelConfig):
@@ -322,13 +407,19 @@ def forward(params: Params,
             segments: Optional[jax.Array] = None,
             rules: LogicalAxisRules = DEFAULT_RULES,
             pipeline_stages: int = 1,
-            pipeline_microbatches: Optional[int] = None) -> jax.Array:
+            pipeline_microbatches: Optional[int] = None,
+            return_aux: bool = False):
     """tokens [B, S] int32 -> logits [B, S, vocab] fp32.
 
     ``pipeline_stages > 1`` runs the decoder stack as a microbatched
     GPipe pipeline over the ``stage`` mesh axis (parallel/pipeline.py);
     embedding and the LM head stay outside the pipelined region
     (replicated work along ``stage``, sharded as usual on other axes).
+
+    ``return_aux``: also return the layer-mean router load-balancing
+    loss (MoE; see _router_aux_loss) as (logits, aux). Not available
+    under pipeline parallelism (the stage body only carries
+    activations) — raise rather than silently return 0.
     """
     _, s = tokens.shape
     dt = cfg.compute_dtype
@@ -358,10 +449,17 @@ def forward(params: Params,
                                   prevent_cse=False)
 
     def scan_body(carry, lp):
-        return layer_fn(carry, lp), None
+        new_x, aux = layer_fn(carry, lp)
+        return new_x, aux
 
+    aux_loss = jnp.zeros((), jnp.float32)
     if pipeline_stages > 1:
         from skypilot_tpu.parallel import pipeline
+        if return_aux:
+            raise ValueError(
+                'return_aux is not supported with pipeline_stages > 1 '
+                '(the stage body carries activations only); set '
+                'router_aux_loss_coeff=0 for pipelined MoE training')
         if positions is not None and positions.ndim > 1:
             raise ValueError(
                 'per-example positions are not supported with '
@@ -388,7 +486,8 @@ def forward(params: Params,
                                     num_microbatches=num_micro,
                                     rules=rules)
     else:
-        x, _ = jax.lax.scan(scan_body, x, params['layers'])
+        x, per_layer_aux = jax.lax.scan(scan_body, x, params['layers'])
+        aux_loss = per_layer_aux.mean()
     x = rms_norm(x, params['final_norm']['scale'], cfg.norm_eps)
     if cfg.tie_embeddings:
         head = params['embed']['embedding'].astype(dt).T
@@ -396,5 +495,9 @@ def forward(params: Params,
         head = params['lm_head']['w'].astype(dt)
     logits = jnp.einsum('bsd,dv->bsv', x, head,
                         preferred_element_type=jnp.float32)
-    return with_logical_constraint(logits, ('batch', 'act_seq', 'vocab'),
-                                   rules=rules)
+    logits = with_logical_constraint(logits,
+                                     ('batch', 'act_seq', 'vocab'),
+                                     rules=rules)
+    if return_aux:
+        return logits, aux_loss
+    return logits

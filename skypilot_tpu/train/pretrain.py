@@ -126,6 +126,13 @@ def main(argv=None) -> int:
                         help='activation remat: full = least memory; '
                              'save_attn/save_dots trade memory for '
                              'less recompute (models/config.py).')
+    parser.add_argument('--moe-dispatch', default=None,
+                        choices=[None, 'dense', 'capacity'],
+                        help='MoE routing: dense = exact, O(E/k)x MLP '
+                             'FLOPs; capacity = fixed per-expert '
+                             'capacity, ~capacity_factor x active '
+                             'FLOPs (drops over-capacity tokens).')
+    parser.add_argument('--capacity-factor', type=float, default=None)
     args = parser.parse_args(argv)
 
     maybe_init_distributed()
@@ -141,6 +148,10 @@ def main(argv=None) -> int:
         overrides['param_dtype'] = jnp.dtype(args.param_dtype)
     if args.remat_policy:
         overrides['remat_policy'] = args.remat_policy
+    if args.moe_dispatch:
+        overrides['moe_dispatch'] = args.moe_dispatch
+    if args.capacity_factor is not None:
+        overrides['capacity_factor'] = args.capacity_factor
     cfg = get_model_config(args.model, **overrides)
     seq = min(args.seq or 1024, cfg.max_seq_len)
     hp = TrainHParams(learning_rate=args.learning_rate,

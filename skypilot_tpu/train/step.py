@@ -175,19 +175,27 @@ def train_step_fn(state: TrainState,
                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     """One SGD step. batch: tokens [B,S], targets [B,S], weights [B,S]."""
 
+    # Router load-balancing aux loss: MoE only, and not under PP (the
+    # stage body carries activations only — forward would raise).
+    use_aux = (cfg.is_moe and cfg.router_aux_loss_coeff > 0
+               and pipeline_stages == 1)
+
     def loss_fn(params):
-        logits = llama.forward(
+        out = llama.forward(
             params, batch['tokens'], cfg, rules=rules,
             positions=batch.get('positions'),
             segments=batch.get('segments'),
             pipeline_stages=pipeline_stages,
-            pipeline_microbatches=hp.pipeline_microbatches)
+            pipeline_microbatches=hp.pipeline_microbatches,
+            return_aux=use_aux)
+        logits, aux = out if use_aux else (out, 0.0)
         loss, _ = cross_entropy_loss(logits, batch['targets'],
                                      batch.get('weights'),
                                      z_loss_coeff=hp.z_loss_coeff)
-        return loss
+        return loss + cfg.router_aux_loss_coeff * aux, aux
 
-    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    (loss, aux), grads = jax.value_and_grad(loss_fn,
+                                            has_aux=True)(state.params)
     updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
     new_params = optax.apply_updates(state.params, updates)
@@ -196,6 +204,8 @@ def train_step_fn(state: TrainState,
         'loss': loss,
         'grad_norm': grad_norm,
         'step': state.step,
+        # 1.0 = perfectly balanced router (dense/non-MoE report 0).
+        'router_aux': jnp.asarray(aux, jnp.float32),
     }
     new_state = TrainState(step=state.step + 1, params=new_params,
                            opt_state=new_opt_state)

@@ -169,3 +169,18 @@ def test_openai_compatible_routes(tmp_home):
     finally:
         server.shutdown()
         engine.shutdown()
+
+
+def test_continuous_engine_throughput_counters(tmp_home):
+    """The continuous engine exposes the monotonic counters /metrics
+    types as counters (requests/tokens_generated/decode_seconds)."""
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    engine = ContinuousBatchingEngine('tiny', max_slots=2, max_len=64)
+    try:
+        engine.generate_text('count me', max_new_tokens=4)
+        stats = engine.stats()
+        assert stats['requests'] >= 1
+        assert stats['tokens_generated'] >= 4
+        assert stats['decode_seconds'] > 0
+    finally:
+        engine.shutdown()
