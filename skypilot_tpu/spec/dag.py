@@ -42,6 +42,22 @@ class Dag:
         dag.add(task)
         return dag
 
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Dag':
+        """Load a (possibly multi-document) task YAML as a chain DAG.
+
+        Single-document files become a one-task DAG, so callers can
+        accept either shape from one entry point (parity:
+        `sky.Dag` loading of '---'-separated pipeline YAMLs).
+        """
+        title, docs = Task._load_yaml_docs(path)
+        dag = cls(name=title or (docs[0].get('name')
+                                 if len(docs) == 1 else None))
+        for doc in docs:
+            dag.add(Task.from_yaml_config(doc))
+        dag.validate()
+        return dag
+
     # ---------- context manager ----------
 
     def __enter__(self) -> 'Dag':

@@ -12,7 +12,7 @@ from __future__ import annotations
 import copy
 import os
 import re
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import yaml
 
@@ -161,22 +161,54 @@ class Task:
         return task
 
     @classmethod
-    def from_yaml(cls, path: str) -> 'Task':
+    def _load_yaml_docs(cls, path: str
+                        ) -> 'Tuple[Optional[str], List[Dict[str, Any]]]':
+        """(pipeline title, validated task-config documents) from a
+        (possibly multi-doc, '---'-separated) YAML file. Parity: the
+        reference's pipeline YAMLs (`sky jobs launch dag.yaml`) use the
+        same framing; a leading name-only document titles the DAG."""
         if path.startswith('recipe://'):
             # Curated launchable recipes shipped with the framework
             # (parity: `sky launch recipe://...`, sky/recipes/core.py).
             from skypilot_tpu import recipes
             path = recipes.resolve(path)
         with open(os.path.expanduser(path), encoding='utf-8') as f:
-            config = yaml.safe_load(f)
-        if not isinstance(config, dict):
+            docs = [d for d in yaml.safe_load_all(f) if d is not None]
+        if not docs or not all(isinstance(d, dict) for d in docs):
             raise exceptions.InvalidSpecError(
-                f'YAML file {path} does not contain a task mapping.')
+                f'YAML file {path} does not contain task mappings.')
+        # A first document carrying ONLY a name titles the pipeline.
+        title = None
+        if len(docs) > 1 and set(docs[0]) <= {'name'}:
+            title = docs[0].get('name')
+            docs = docs[1:]
         # User-authored YAML gets schema validation for pointed errors
         # (parity: sky/utils/schemas.py); internal round-trips skip it.
         from skypilot_tpu.spec import schemas
-        schemas.validate_task_config(config, source=path)
-        return cls.from_yaml_config(config)
+        for doc in docs:
+            schemas.validate_task_config(doc, source=path)
+        return title, docs
+
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Task':
+        if path.startswith('recipe://'):
+            from skypilot_tpu import recipes
+            resolved = recipes.resolve(path)
+        else:
+            resolved = path
+        # Pipeline detection BEFORE per-stage validation: a multi-doc
+        # file should get the 'use the DAG path' message, not a stage-2
+        # schema error.
+        with open(os.path.expanduser(resolved), encoding='utf-8') as f:
+            n_docs = sum(1 for d in yaml.safe_load_all(f)
+                         if d is not None)
+        if n_docs > 1:
+            raise exceptions.InvalidSpecError(
+                f'{path} is a multi-task pipeline ({n_docs} documents); '
+                'load it with Dag.from_yaml / launch each stage via '
+                'the DAG path.')
+        _, docs = cls._load_yaml_docs(resolved)
+        return cls.from_yaml_config(docs[0])
 
     def to_yaml_config(self) -> Dict[str, Any]:
         config: Dict[str, Any] = {}

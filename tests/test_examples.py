@@ -9,6 +9,7 @@ import pytest
 
 from skypilot_tpu import optimizer
 from skypilot_tpu.spec import schemas
+from skypilot_tpu.spec.dag import Dag
 from skypilot_tpu.spec.task import Task
 
 EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), '..', 'examples')
@@ -22,8 +23,9 @@ def test_examples_exist():
 @pytest.mark.parametrize('path', EXAMPLE_PATHS,
                          ids=[os.path.basename(p) for p in EXAMPLE_PATHS])
 def test_example_parses_and_validates(path):
-    task = Task.from_yaml(path)
-    assert task.run, f'{path}: no run section'
+    dag = Dag.from_yaml(path)
+    for task in dag.tasks:
+        assert task.run, f'{path}: no run section'
     # First comment line is the doc line (recipes registry convention).
     with open(path, encoding='utf-8') as f:
         assert f.readline().startswith('# '), f'{path}: missing doc comment'

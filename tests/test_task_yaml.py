@@ -86,3 +86,27 @@ def test_dag_context_manager():
     assert Dag.get_current() is None
     dag.validate()
     assert len(dag) == 2
+
+
+def test_multi_document_pipeline_yaml(tmp_path):
+    """'---'-separated pipeline YAMLs load as a chain DAG; Task.from_yaml
+    points multi-doc users at the DAG path instead of mis-parsing."""
+    path = tmp_path / 'pipe.yaml'
+    path.write_text(
+        'name: pipeline\n'
+        '---\n'
+        'name: prep\nresources:\n  cpus: 4+\nrun: echo prep\n'
+        '---\n'
+        'name: train\nresources:\n  accelerators: tpu-v5e-8\n'
+        'run: echo train\n')
+    dag = Dag.from_yaml(str(path))
+    assert dag.name == 'pipeline'
+    assert [t.name for t in dag.tasks] == ['prep', 'train']
+    with pytest.raises(exceptions.InvalidSpecError,
+                       match='multi-task'):
+        Task.from_yaml(str(path))
+    # Single-doc files still load through both entry points.
+    single = tmp_path / 'one.yaml'
+    single.write_text('name: solo\nrun: echo hi\n')
+    assert Task.from_yaml(str(single)).name == 'solo'
+    assert Dag.from_yaml(str(single)).tasks[0].name == 'solo'
