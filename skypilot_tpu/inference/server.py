@@ -129,11 +129,17 @@ def make_handler(engine: InferenceEngine):
         # base_url here and it works, streaming included) -------------
 
         def _openai(self, req, chat: bool):
+            tok = engine.tokenizer
+            # Templated chat prompts render their own BOS — encoding
+            # must not prepend a second one.
+            add_bos = True
             if chat:
                 messages = req.get('messages') or []
-                prompt = ''.join(
-                    f"{m.get('role', 'user')}: {m.get('content', '')}\n"
-                    for m in messages) + 'assistant:'
+                # The checkpoint's own chat template (jinja in
+                # tokenizer_config.json) — what the model was actually
+                # tuned on; plain transcript otherwise.
+                prompt = tok.apply_chat_template(messages)
+                add_bos = not getattr(tok, 'chat_template', None)
             else:
                 prompt = req.get('prompt', '')
                 if isinstance(prompt, list):
@@ -154,10 +160,10 @@ def make_handler(engine: InferenceEngine):
                         'error': 'stream=true requires the continuous '
                                  'engine (--engine continuous)'})
                     return
-                self._openai_stream(rid, model, prompt, chat, kwargs)
+                self._openai_stream(rid, model, prompt, chat, kwargs,
+                                    add_bos=add_bos)
                 return
-            tok = engine.tokenizer
-            ids = tok.encode(prompt)
+            ids = tok.encode(prompt, add_bos=add_bos)
             if hasattr(engine, 'generate_texts'):
                 # continuous engine: single-request ids API
                 out_ids = engine.generate_ids(
@@ -183,12 +189,13 @@ def make_handler(engine: InferenceEngine):
                              'created': int(time.time()),
                              'choices': [choice]})
 
-        def _openai_stream(self, rid, model, prompt, chat, kwargs):
+        def _openai_stream(self, rid, model, prompt, chat, kwargs,
+                           add_bos: bool = True):
             # Everything that can fail with a clean 500 must happen
             # BEFORE the 200 + chunked headers go out (after that, a
             # second status line would corrupt the stream).
             tok = engine.tokenizer
-            ids = tok.encode(prompt)
+            ids = tok.encode(prompt, add_bos=add_bos)
             token_iter = engine.stream_ids(ids, eos_id=tok.eos_id,
                                            **kwargs)
             self.send_response(200)

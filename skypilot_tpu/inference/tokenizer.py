@@ -21,11 +21,21 @@ EOS_ID = 2
 _OFFSET = 3
 
 
+def render_transcript(messages,
+                      add_generation_prompt: bool = True) -> str:
+    """Plain role-prefixed chat transcript (the no-template fallback)."""
+    text = ''.join(
+        f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+        for m in messages)
+    return text + ('assistant:' if add_generation_prompt else '')
+
+
 class ByteTokenizer:
     vocab_size = 256 + _OFFSET
     pad_id = PAD_ID
     bos_id = BOS_ID
     eos_id = EOS_ID
+    chat_template = None
 
     def encode(self, text: str, *, add_bos: bool = True) -> List[int]:
         ids = [b + _OFFSET for b in text.encode('utf-8')]
@@ -35,6 +45,10 @@ class ByteTokenizer:
         data = bytes(i - _OFFSET for i in ids
                      if i >= _OFFSET and i - _OFFSET < 256)
         return data.decode('utf-8', errors='replace')
+
+    def apply_chat_template(self, messages,
+                            add_generation_prompt: bool = True) -> str:
+        return render_transcript(messages, add_generation_prompt)
 
 
 class HFTokenizer:
@@ -54,6 +68,55 @@ class HFTokenizer:
         base = os.path.dirname(tok_file)
         self.bos_id, self.eos_id = self._special_ids(base)
         self.pad_id = self.eos_id
+        self.chat_template = self._load_chat_template(base)
+        self._compiled_template = None
+        if self.chat_template:
+            # Compile ONCE (the serving hot path must not re-parse a
+            # multi-KB template per request), and in a SANDBOX: the
+            # template ships with a third-party checkpoint — plain
+            # jinja would let it reach __globals__/os (transformers
+            # uses ImmutableSandboxedEnvironment for the same reason).
+            import jinja2
+            from jinja2.sandbox import ImmutableSandboxedEnvironment
+            env = ImmutableSandboxedEnvironment(
+                trim_blocks=True, lstrip_blocks=True,
+                undefined=jinja2.ChainableUndefined)
+            env.globals['raise_exception'] = _template_raise
+            self._compiled_template = env.from_string(
+                self.chat_template)
+
+    @staticmethod
+    def _load_chat_template(base: str):
+        cfg_file = os.path.join(base, 'tokenizer_config.json')
+        if not os.path.exists(cfg_file):
+            return None
+        with open(cfg_file) as f:
+            template = json.load(f).get('chat_template')
+        if isinstance(template, list):
+            # HF also allows [{name, template}, ...]; 'default' wins.
+            by_name = {t.get('name'): t.get('template')
+                       for t in template if isinstance(t, dict)}
+            return by_name.get('default') or next(
+                iter(by_name.values()), None)
+        return template
+
+    def apply_chat_template(self, messages,
+                            add_generation_prompt: bool = True) -> str:
+        """Render messages with the checkpoint's own chat template
+        (tokenizer_config.json, jinja — the same artifact transformers
+        renders), falling back to a plain role-prefixed transcript.
+
+        Templated prompts carry their own BOS — encode them with
+        ``add_bos=False`` (server: the template controls specials).
+        """
+        if self._compiled_template is not None:
+            bos = self._tok.id_to_token(self.bos_id) or ''
+            eos = self._tok.id_to_token(self.eos_id) or ''
+            return self._compiled_template.render(
+                messages=messages,
+                add_generation_prompt=add_generation_prompt,
+                bos_token=bos, eos_token=eos)
+        return render_transcript(messages, add_generation_prompt)
 
     def _special_ids(self, base: str):
         def token_str(v):
@@ -117,3 +180,7 @@ def get_tokenizer(checkpoint_dir: Optional[str] = None, *,
             "`AutoTokenizer...save_pretrained`), or the byte fallback "
             'would silently mis-encode every prompt')
     return ByteTokenizer()
+
+
+def _template_raise(message):  # chat templates call raise_exception()
+    raise ValueError(f'chat template error: {message}')

@@ -100,3 +100,34 @@ def test_engine_serves_real_checkpoint(bpe_dir, tmp_path):
     assert engine.cfg.vocab_size == 512
     out = engine.generate_text(['the quick'], max_new_tokens=4)
     assert len(out) == 1 and isinstance(out[0], str)
+
+
+def test_chat_template_rendering(bpe_dir):
+    """The checkpoint's jinja chat template renders messages the way
+    transformers would; absent a template, a plain transcript."""
+    import json as json_lib
+    tok = HFTokenizer(bpe_dir)
+    messages = [{'role': 'user', 'content': 'hello'},
+                {'role': 'assistant', 'content': 'hi'},
+                {'role': 'user', 'content': 'bye'}]
+    # No template: role-prefixed transcript + generation prompt.
+    plain = tok.apply_chat_template(messages)
+    assert plain.endswith('assistant:')
+    assert 'user: hello' in plain
+    # Llama-3-style template from tokenizer_config.json.
+    cfg_path = f'{bpe_dir}/tokenizer_config.json'
+    with open(cfg_path) as f:
+        cfg = json_lib.load(f)
+    cfg['chat_template'] = (
+        "{{ bos_token }}{% for m in messages %}"
+        "<|{{ m['role'] }}|>{{ m['content'] }}<|end|>{% endfor %}"
+        "{% if add_generation_prompt %}<|assistant|>{% endif %}")
+    with open(cfg_path, 'w') as f:
+        json_lib.dump(cfg, f)
+    tok2 = HFTokenizer(bpe_dir)
+    out = tok2.apply_chat_template(messages)
+    assert out.startswith('<|begin_of_text|>')
+    assert '<|user|>hello<|end|>' in out
+    assert out.endswith('<|assistant|>')
+    assert tok2.apply_chat_template(
+        messages, add_generation_prompt=False).endswith('<|end|>')
