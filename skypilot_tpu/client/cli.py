@@ -63,11 +63,25 @@ def cli() -> None:
 @click.option('--env', multiple=True, help='KEY=VALUE env overrides.')
 def launch(entrypoint: str, cluster: Optional[str], dryrun: bool,
            down: bool, async_: bool, env) -> None:
-    """Launch a task YAML (provision + sync + setup + run)."""
-    task = Task.from_yaml(entrypoint)
-    if env:
-        task.update_envs(dict(e.split('=', 1) for e in env))
-    request_id = sdk.launch(task, cluster, dryrun=dryrun, down=down)
+    """Launch a task YAML (provision + sync + setup + run).
+
+    Multi-document ('---'-separated) pipeline YAMLs launch stage by
+    stage in order, each stage on its own cluster sized by its own
+    resources (parity: the reference's pipeline handling).
+    """
+    from skypilot_tpu.spec.dag import Dag
+    dag = Dag.from_yaml(entrypoint)
+    env_overrides = dict(e.split('=', 1) for e in env) if env else {}
+    if env_overrides:
+        for task in dag.tasks:
+            task.update_envs(env_overrides)
+    if len(dag.tasks) > 1:
+        cluster = cluster or dag.name or 'pipeline'
+        click.echo(f'pipeline {cluster}: {len(dag.tasks)} stages '
+                   '(server runs them in order; a failed stage aborts '
+                   'the rest)')
+    request_id = sdk.launch(dag if len(dag.tasks) > 1 else dag.tasks[0],
+                            cluster, dryrun=dryrun, down=down)
     result = _run(request_id, async_)
     if result:
         for name, job_id in result:

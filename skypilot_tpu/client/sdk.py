@@ -520,9 +520,17 @@ def launch(task: Union[Task, Dag],
            dryrun: bool = False,
            down: bool = False) -> RequestId:
     configs = _task_configs(task)
-    assert len(configs) == 1, 'chain DAGs: launch tasks individually'
+    if len(configs) == 1:
+        return _post('launch', {
+            'task_config': configs[0],
+            'cluster_name': cluster_name,
+            'dryrun': dryrun,
+            'down': down,
+        })
+    # Chain DAG: the SERVER runs the stages in order with WAIT_SUCCESS
+    # gating (one request, one log stream — server/payloads._launch).
     return _post('launch', {
-        'task_config': configs[0],
+        'task_configs': configs,
         'cluster_name': cluster_name,
         'dryrun': dryrun,
         'down': down,

@@ -74,7 +74,7 @@ def launch(task_or_dag: Union[Task, Dag],
     results: List[Tuple[str, Optional[int]]] = []
     for i, task in enumerate(dag.tasks):
         name = cluster_name if len(dag.tasks) == 1 else (
-            f'{cluster_name}-{i}' if cluster_name else None)
+            f'{cluster_name}-{task.name or i}' if cluster_name else None)
         if name is None:
             name = common_utils.generate_cluster_name(
                 task.name or 'skyt')
@@ -84,7 +84,35 @@ def launch(task_or_dag: Union[Task, Dag],
                           dryrun=dryrun, stream_logs=stream_logs,
                           down=down, detach_run=detach_run,
                           provision_blocklist=provision_blocklist))
+        # Chain semantics (DagExecution.WAIT_SUCCESS, the default): a
+        # failed stage must ABORT the pipeline — running stage N+1 on
+        # output stage N never produced burns accelerator-hours.
+        from skypilot_tpu.spec.dag import DagExecution
+        job_id = results[-1][1]
+        if (len(dag.tasks) > 1 and i + 1 < len(dag.tasks)
+                and dag.execution == DagExecution.WAIT_SUCCESS
+                and job_id is not None and not dryrun and not detach_run):
+            record = next(
+                (j for j in backend.queue(
+                    _cluster_info_for(results[-1][0]))
+                 if j.get('job_id') == job_id), None)
+            status = (record or {}).get('status')
+            if status != 'SUCCEEDED':
+                raise exceptions.SkytError(
+                    f'pipeline stage {i + 1}/{len(dag.tasks)} '
+                    f'({task.name or name}) finished '
+                    f'{status or "UNKNOWN"}; aborting the remaining '
+                    f'{len(dag.tasks) - i - 1} stage(s) '
+                    '(WAIT_SUCCESS chain)')
     return results
+
+
+def _cluster_info_for(cluster_name: str):
+    from skypilot_tpu import state
+    from skypilot_tpu.provision.api import ClusterInfo
+    record = state.get_cluster(cluster_name)
+    assert record is not None, cluster_name
+    return ClusterInfo.from_dict(record.handle)
 
 
 def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,

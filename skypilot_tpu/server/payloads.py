@@ -14,13 +14,25 @@ from skypilot_tpu.server.requests_db import ScheduleType
 from skypilot_tpu.spec.task import Task
 
 
-def _launch(task_config: Dict[str, Any],
+def _launch(task_config: Optional[Dict[str, Any]] = None,
             cluster_name: Optional[str] = None,
             dryrun: bool = False,
             down: bool = False,
-            detach_run: bool = False) -> List[Tuple[str, Optional[int]]]:
-    task = Task.from_yaml_config(task_config)
-    return execution.launch(task,
+            detach_run: bool = False,
+            task_configs: Optional[List[Dict[str, Any]]] = None
+            ) -> List[Tuple[str, Optional[int]]]:
+    # task_configs: a multi-stage pipeline (chain DAG) — stages run in
+    # order server-side with WAIT_SUCCESS gating (execution.launch).
+    # task_config stays the single-task wire shape older clients send.
+    if task_configs:
+        from skypilot_tpu.spec.dag import Dag
+        dag = Dag()
+        for config in task_configs:
+            dag.add(Task.from_yaml_config(config))
+        target = dag
+    else:
+        target = Task.from_yaml_config(task_config)
+    return execution.launch(target,
                             cluster_name,
                             dryrun=dryrun,
                             down=down,

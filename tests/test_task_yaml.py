@@ -110,3 +110,76 @@ def test_multi_document_pipeline_yaml(tmp_path):
     single.write_text('name: solo\nrun: echo hi\n')
     assert Task.from_yaml(str(single)).name == 'solo'
     assert Dag.from_yaml(str(single)).tasks[0].name == 'solo'
+
+
+def test_cli_launch_runs_pipeline_stages(tmp_home, tmp_path, monkeypatch):
+    """`skyt launch pipeline.yaml` launches '---' stages in order on
+    per-stage clusters (fake cloud end-to-end)."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu.client.cli import cli
+    from skypilot_tpu.provision import fake
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.server.app import ApiServer
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        path = tmp_path / 'pipe.yaml'
+        path.write_text(
+            'name: pl\n'
+            '---\n'
+            'name: stage1\nresources:\n  cloud: fake\n'
+            '  accelerators: tpu-v5e-8\nrun: echo one\n'
+            '---\n'
+            'name: stage2\nresources:\n  cloud: fake\n'
+            '  accelerators: tpu-v5e-8\nrun: echo two\n')
+        result = CliRunner().invoke(cli, ['launch', str(path), '-c',
+                                          'pl'])
+        assert result.exit_code == 0, result.output
+        assert 'pipeline pl: 2 stages' in result.output
+        assert 'cluster: pl-stage1' in result.output
+        assert 'cluster: pl-stage2' in result.output
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+        fake.reset()
+
+
+def test_pipeline_failed_stage_aborts_chain(tmp_home, tmp_path,
+                                            monkeypatch):
+    """WAIT_SUCCESS: a failed stage stops the pipeline — stage 2
+    never provisions."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu import state
+    from skypilot_tpu.client.cli import cli
+    from skypilot_tpu.provision import fake
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.server.app import ApiServer
+    fake.reset()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        path = tmp_path / 'pipe.yaml'
+        path.write_text(
+            'name: doomed\n'
+            '---\n'
+            'name: bad\nresources:\n  cloud: fake\n'
+            '  accelerators: tpu-v5e-8\nrun: exit 3\n'
+            '---\n'
+            'name: never\nresources:\n  cloud: fake\n'
+            '  accelerators: tpu-v5e-8\nrun: echo unreachable\n')
+        result = CliRunner().invoke(cli, ['launch', str(path), '-c',
+                                          'dm'])
+        assert result.exit_code != 0
+        assert 'aborting' in result.output
+        assert state.get_cluster('dm-never') is None  # never provisioned
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+        fake.reset()
