@@ -113,6 +113,12 @@ class AzureBlobClient:
             'x-ms-version': API_VERSION,
         }
         headers.update(extra_headers or {})
+        # Always pin Content-Type: urllib injects
+        # 'application/x-www-form-urlencoded' whenever data is not None
+        # (always here) — an unsigned header the real service includes
+        # in ITS string-to-sign, so leaving it implicit 403s every
+        # request on real Azure.
+        headers.setdefault('Content-Type', 'application/octet-stream')
         canonical_headers = ''.join(
             f'{k.lower()}:{v}\n'
             for k, v in sorted(headers.items())

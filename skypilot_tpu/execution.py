@@ -54,7 +54,11 @@ def launch(task_or_dag: Union[Task, Dag],
 
     Returns [(cluster_name, job_id)] per task. Chain DAG tasks run
     sequentially, each on its own cluster (parity: _execute_dag,
-    execution.py:340).
+    execution.py:340). A multi-stage WAIT_SUCCESS chain BLOCKS between
+    stages (every detach mode) until the prior stage is terminal —
+    callers that must not block for the pipeline's duration should run
+    it as a managed job group (jobs/job_groups.py), the same altitude
+    the reference runs pipelines at (its jobs controller).
     """
     dag = _as_dag(task_or_dag)
     dag.validate()
@@ -71,6 +75,9 @@ def launch(task_or_dag: Union[Task, Dag],
             workspaces.validate_cloud(res.cloud)
     backend = backend or TpuPodBackend()
     stages = stages or ALL_STAGES
+    from skypilot_tpu.spec.dag import DagExecution
+    chain_gated = (len(dag.tasks) > 1 and not dryrun
+                   and dag.execution == DagExecution.WAIT_SUCCESS)
     results: List[Tuple[str, Optional[int]]] = []
     for i, task in enumerate(dag.tasks):
         name = cluster_name if len(dag.tasks) == 1 else (
@@ -79,24 +86,48 @@ def launch(task_or_dag: Union[Task, Dag],
             name = common_utils.generate_cluster_name(
                 task.name or 'skyt')
         common_utils.validate_cluster_name(name)
+        # Chain semantics (DagExecution.WAIT_SUCCESS, the default): a
+        # failed stage must ABORT the pipeline — running stage N+1 on
+        # output stage N never produced burns accelerator-hours. Every
+        # non-final stage is polled to a TERMINAL status before the
+        # next launches, in EVERY detach mode: _execute_task detaches
+        # whenever detach_run OR stream_logs is False, and a detached
+        # job is still PENDING/RUNNING right after submit — gating on
+        # the instantaneous status (or skipping the gate when
+        # detached) would abort or mis-order a healthy pipeline.
+        stage_gated = chain_gated and i + 1 < len(dag.tasks)
+        # Gated stages defer `down` to AFTER the gate: arming autodown
+        # at submit would race _wait_terminal's polling (the daemon
+        # can tear the cluster down between the job finishing and the
+        # next poll).
         results.append(
             _execute_task(task, name, backend, stages,
                           dryrun=dryrun, stream_logs=stream_logs,
-                          down=down, detach_run=detach_run,
+                          down=down and not stage_gated,
+                          detach_run=detach_run,
                           provision_blocklist=provision_blocklist))
-        # Chain semantics (DagExecution.WAIT_SUCCESS, the default): a
-        # failed stage must ABORT the pipeline — running stage N+1 on
-        # output stage N never produced burns accelerator-hours.
-        from skypilot_tpu.spec.dag import DagExecution
         job_id = results[-1][1]
-        if (len(dag.tasks) > 1 and i + 1 < len(dag.tasks)
-                and dag.execution == DagExecution.WAIT_SUCCESS
-                and job_id is not None and not dryrun and not detach_run):
-            record = next(
-                (j for j in backend.queue(
-                    _cluster_info_for(results[-1][0]))
-                 if j.get('job_id') == job_id), None)
-            status = (record or {}).get('status')
+        if stage_gated:
+            # job_id None = nothing ran (run=None / EXEC not staged):
+            # trivially successful, but `down` must still be honored.
+            try:
+                status = ('SUCCEEDED' if job_id is None else
+                          _wait_terminal(backend, results[-1][0], job_id))
+            except Exception:
+                # Persistent poll failure: the job may STILL be running
+                # on the cluster, so tearing it down here could kill a
+                # healthy multi-day job. Leave it up, loudly.
+                logger.error(
+                    f'pipeline: lost contact with {results[-1][0]} '
+                    f'while waiting on job {job_id}; the cluster is '
+                    f'left UP (job may be running) — check `skyt queue '
+                    f'{results[-1][0]}` and `skyt down` it manually')
+                raise
+            if down and Stage.DOWN in stages:
+                try:
+                    backend.teardown(results[-1][0], terminate=True)
+                except exceptions.ClusterDoesNotExist:
+                    pass  # torn down externally mid-wait
             if status != 'SUCCEEDED':
                 raise exceptions.SkytError(
                     f'pipeline stage {i + 1}/{len(dag.tasks)} '
@@ -107,12 +138,87 @@ def launch(task_or_dag: Union[Task, Dag],
     return results
 
 
-def _cluster_info_for(cluster_name: str):
-    from skypilot_tpu import state
+def _wait_terminal(backend: TpuPodBackend, cluster_name: str,
+                   job_id: int) -> Optional[str]:
+    """Poll the cluster job queue until ``job_id`` reaches a terminal
+    status; returns it. Attached runs are already terminal on the first
+    poll; detached runs genuinely wait (a pipeline stage may run for
+    days — no deadline, but progress is logged). Exits without a
+    terminal status when the cluster record vanishes (external
+    teardown) or the remote runtime daemon stops heartbeating (the job
+    can never finish): returns the last status seen, which the caller
+    treats as failure. Transient queue/SSH errors are retried; only
+    ``SKYT_PIPELINE_POLL_RETRIES`` consecutive failures raise."""
+    import os
+    import time
+    interval = float(os.environ.get('SKYT_PIPELINE_POLL_SECONDS', '5'))
+    max_errors = int(os.environ.get('SKYT_PIPELINE_POLL_RETRIES', '10'))
+    # Declare the remote daemon dead only after this much wall-clock
+    # (it heartbeats on its own cadence; checking too early races
+    # daemon startup on a freshly provisioned cluster).
+    daemon_grace = float(
+        os.environ.get('SKYT_PIPELINE_DAEMON_GRACE_SECONDS', '60'))
     from skypilot_tpu.provision.api import ClusterInfo
-    record = state.get_cluster(cluster_name)
-    assert record is not None, cluster_name
-    return ClusterInfo.from_dict(record.handle)
+    from skypilot_tpu.runtime.job_client import job_table_for
+    from skypilot_tpu.runtime.job_lib import TERMINAL_STATUSES
+    terminal = {s.value for s in TERMINAL_STATUSES}
+    last_status = None
+    polls = 0
+    consecutive_errors = 0
+    start = time.monotonic()
+    next_daemon_check = start + daemon_grace
+
+    def _gone() -> Optional[str]:
+        logger.warning(
+            f'cluster {cluster_name!r} disappeared while waiting on '
+            f'job {job_id} (last status: {last_status})')
+        return last_status
+
+    while True:
+        cluster = state.get_cluster(cluster_name)
+        if cluster is None:
+            return _gone()
+        info = ClusterInfo.from_dict(cluster.handle)
+        try:
+            jobs = backend.queue(info)
+        except Exception as e:
+            # Cluster torn down between the record read and the queue
+            # query (stale handle): same graceful exit as record-gone.
+            if state.get_cluster(cluster_name) is None:
+                return _gone()
+            consecutive_errors += 1
+            if consecutive_errors >= max_errors:
+                raise
+            logger.warning(
+                f'pipeline: poll {cluster_name} job {job_id} failed '
+                f'({consecutive_errors}/{max_errors}): {e}; retrying')
+            time.sleep(min(interval * consecutive_errors, 60))
+            continue
+        consecutive_errors = 0
+        record = next(
+            (j for j in jobs if j.get('job_id') == job_id), None)
+        status = (record or {}).get('status')
+        if status is None or status in terminal:
+            return status
+        last_status = status
+        polls += 1
+        if time.monotonic() >= next_daemon_check:
+            next_daemon_check = time.monotonic() + daemon_grace
+            # A non-terminal job on a dead daemon never finishes —
+            # bail instead of waiting forever.
+            try:
+                alive = job_table_for(info).daemon_alive()
+            except Exception:
+                alive = True  # transient; the error path above handles
+            if not alive:
+                logger.warning(
+                    f'runtime daemon on {cluster_name!r} is dead; job '
+                    f'{job_id} ({status}) can never finish — giving up')
+                return last_status
+        if polls % 60 == 0:
+            logger.info(f'pipeline: waiting on {cluster_name} job '
+                        f'{job_id} ({status}, {polls} polls)')
+        time.sleep(interval)
 
 
 def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,

@@ -374,7 +374,18 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
             if user.name == 'operator':
                 fresh = token
             else:
-                fresh = users_db.create_token(user.name, 'browser-login')
+                # One live browser-login credential per user: bound
+                # life, and prior ones revoked AFTER the new mint
+                # succeeds (create-then-revoke — a failed mint must not
+                # strand the user with zero working CLI tokens).
+                prior = [t['token_id']
+                         for t in users_db.list_tokens(user.name)
+                         if t['label'] == 'browser-login']
+                fresh = users_db.create_token(
+                    user.name, 'browser-login',
+                    expires_seconds=30 * 24 * 3600)
+                for token_id in prior:
+                    users_db.revoke_token(token_id)
             sep = '&' if '?' in redirect else '?'
             redirect = f'{redirect}{sep}' + urllib.parse.urlencode(
                 {'token': fresh, 'user': user.name})

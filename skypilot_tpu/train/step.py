@@ -192,16 +192,20 @@ def train_step_fn(state: TrainState,
         loss, _ = cross_entropy_loss(logits, batch['targets'],
                                      batch.get('weights'),
                                      z_loss_coeff=hp.z_loss_coeff)
-        return loss + cfg.router_aux_loss_coeff * aux, aux
+        return loss + cfg.router_aux_loss_coeff * aux, (loss, aux)
 
-    (loss, aux), grads = jax.value_and_grad(loss_fn,
-                                            has_aux=True)(state.params)
+    (total_loss, (ce_loss, aux)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params)
     updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
     new_params = optax.apply_updates(state.params, updates)
     grad_norm = optax.global_norm(grads)
     metrics = {
-        'loss': loss,
+        # 'loss' stays plain cross-entropy for cross-run comparability
+        # (dense vs MoE, pre/post aux-loss runs); the optimized
+        # objective is 'total_loss'.
+        'loss': ce_loss,
+        'total_loss': total_loss,
         'grad_norm': grad_norm,
         'step': state.step,
         # 1.0 = perfectly balanced router (dense/non-MoE report 0).

@@ -98,7 +98,9 @@ class UserRecord:
 def create_user(name: str, role: str = ROLE_USER) -> UserRecord:
     if role not in _ROLES:
         raise ValueError(f'unknown role {role!r} (expected one of {_ROLES})')
-    if not name or '/' in name:
+    # '|' is the session-cookie payload delimiter (sessions.py) — an
+    # ambiguous encoding must never be signed.
+    if not name or '/' in name or '|' in name:
         raise ValueError(f'invalid user name {name!r}')
     if name == 'operator':
         # Reserved: the static deployment token's synthetic admin

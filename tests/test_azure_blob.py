@@ -25,6 +25,43 @@ class _State:
         self.lock = threading.Lock()
 
 
+_ACCOUNT = 'testacct'
+_KEY = base64.b64encode(b'secret-key').decode()
+
+
+def _server_side_signature(handler):
+    """Recompute the SharedKey signature the way real Azure does: from
+    the headers actually received on the wire (notably Content-Type —
+    urllib injects one when none is set, which is exactly the bug a
+    prefix-only check cannot catch)."""
+    import hashlib
+    import hmac
+    parsed = urllib.parse.urlparse(handler.path)
+    content_length = handler.headers.get('Content-Length', '')
+    if content_length == '0':  # API >= 2015-02-21: empty when zero
+        content_length = ''
+    xms = sorted((k.lower(), v) for k, v in handler.headers.items()
+                 if k.lower().startswith('x-ms-'))
+    canonical_headers = ''.join(f'{k}:{v}\n' for k, v in xms)
+    canonical_resource = f'/{_ACCOUNT}{parsed.path}'
+    query = {k: v[0] for k, v in
+             urllib.parse.parse_qs(parsed.query).items()}
+    for k in sorted(query):
+        canonical_resource += f'\n{k.lower()}:{query[k]}'
+    string_to_sign = '\n'.join([
+        handler.command,
+        '', '',
+        content_length,
+        '',
+        handler.headers.get('Content-Type', ''),
+        '', '', '', '', '', '',
+    ]) + '\n' + canonical_headers + canonical_resource
+    return base64.b64encode(
+        hmac.new(base64.b64decode(_KEY),
+                 string_to_sign.encode('utf-8'),
+                 hashlib.sha256).digest()).decode()
+
+
 def _handler_for(state):
 
     class Handler(BaseHTTPRequestHandler):
@@ -52,7 +89,10 @@ def _handler_for(state):
 
         def _authed(self):
             auth = self.headers.get('Authorization', '')
-            if not auth.startswith('SharedKey '):
+            if not auth.startswith(f'SharedKey {_ACCOUNT}:'):
+                self._reply(403)
+                return False
+            if auth.split(':', 1)[1] != _server_side_signature(self):
                 self._reply(403)
                 return False
             return True
@@ -138,9 +178,8 @@ def fake_azure(tmp_home, monkeypatch):
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     port = server.server_address[1]
-    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'testacct')
-    monkeypatch.setenv('AZURE_STORAGE_KEY',
-                       base64.b64encode(b'secret-key').decode())
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', _ACCOUNT)
+    monkeypatch.setenv('AZURE_STORAGE_KEY', _KEY)
     monkeypatch.setenv('SKYT_AZURE_BLOB_ENDPOINT',
                        f'http://127.0.0.1:{port}')
     yield state

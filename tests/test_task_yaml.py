@@ -148,6 +148,47 @@ def test_cli_launch_runs_pipeline_stages(tmp_home, tmp_path, monkeypatch):
         fake.reset()
 
 
+def test_detached_pipeline_waits_instead_of_aborting(tmp_home,
+                                                     monkeypatch):
+    """launch(dag, stream_logs=False) detaches each stage; the
+    WAIT_SUCCESS gate must poll the job to a terminal status, not
+    abort a healthy pipeline on an instantaneous PENDING/RUNNING."""
+    from skypilot_tpu import execution, state
+    from skypilot_tpu.provision import fake
+    from skypilot_tpu.spec.dag import Dag
+    from skypilot_tpu.spec.resources import Resources
+    from skypilot_tpu.spec.task import Task
+    fake.reset()
+    monkeypatch.setenv('SKYT_PIPELINE_POLL_SECONDS', '0.1')
+    try:
+        with Dag(name='dp') as dag:
+            for name in ('s1', 's2'):
+                dag.add(Task(name=name, run='sleep 0.3 && echo ok',
+                             resources=Resources(
+                                 cloud='fake',
+                                 accelerators='tpu-v5e-8')))
+        results = execution.launch(dag, cluster_name='dp',
+                                   stream_logs=False)
+        assert [r[0] for r in results] == ['dp-s1', 'dp-s2']
+        assert state.get_cluster('dp-s2') is not None
+        # detach_run=True detaches the same way — the gate must still
+        # apply (stage 2 only after stage 1 SUCCEEDED), and down=True
+        # tears gated stages down deterministically after the gate,
+        # not via racy autodown.
+        with Dag(name='dr') as dag2:
+            for name in ('s1', 's2'):
+                dag2.add(Task(name=name, run='sleep 0.3 && echo ok',
+                              resources=Resources(
+                                  cloud='fake',
+                                  accelerators='tpu-v5e-8')))
+        results = execution.launch(dag2, cluster_name='dr',
+                                   detach_run=True, down=True)
+        assert [r[0] for r in results] == ['dr-s1', 'dr-s2']
+        assert state.get_cluster('dr-s1') is None  # gated stage downed
+    finally:
+        fake.reset()
+
+
 def test_pipeline_failed_stage_aborts_chain(tmp_home, tmp_path,
                                             monkeypatch):
     """WAIT_SUCCESS: a failed stage stops the pipeline — stage 2
