@@ -13,7 +13,7 @@ from typing import List, Optional, Tuple, Union
 from skypilot_tpu import exceptions, state
 from skypilot_tpu.backend.tpu_backend import TpuPodBackend
 from skypilot_tpu.optimizer import Optimizer
-from skypilot_tpu.spec.dag import Dag
+from skypilot_tpu.spec.dag import Dag, DagExecution
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import common_utils, log
 
@@ -75,7 +75,6 @@ def launch(task_or_dag: Union[Task, Dag],
             workspaces.validate_cloud(res.cloud)
     backend = backend or TpuPodBackend()
     stages = stages or ALL_STAGES
-    from skypilot_tpu.spec.dag import DagExecution
     chain_gated = (len(dag.tasks) > 1 and not dryrun
                    and dag.execution == DagExecution.WAIT_SUCCESS)
     results: List[Tuple[str, Optional[int]]] = []

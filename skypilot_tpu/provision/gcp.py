@@ -491,7 +491,13 @@ class GcpTpuProvider(Provider):
         resp = self._request(
             'GET', f'{TPU_API}/{self._parent(zone)}/queuedResources')
         for qr in resp.get('queuedResources', []):
-            if qr['name'].split('/')[-1].startswith(cluster_name + '-n'):
+            # Match by the skyt-cluster label on the QR's node spec, like
+            # every other listing path. A name-prefix match is ambiguous:
+            # cluster 'a' would capture 'a-n1''s QR 'a-n1-n0-s0'.
+            specs = qr.get('tpu', {}).get('nodeSpec', [])
+            owner = {ns.get('node', {}).get('labels', {})
+                     .get('skyt-cluster') for ns in specs}
+            if cluster_name in owner:
                 self._request('DELETE', f'{TPU_API}/{qr["name"]}?force=true')
         for inst in self._list_compute_instances(cluster_name, zone):
             self._request(

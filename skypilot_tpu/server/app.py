@@ -19,6 +19,7 @@ import os
 import shutil
 import tarfile
 import tempfile
+import threading
 import time
 import urllib.parse
 from html import escape as html_escape
@@ -44,6 +45,11 @@ DEFAULT_PORT = 46590
 # /auth/login is the browser entry point — it must render unauthenticated
 # and then SET the session (the dashboard itself requires it).
 _AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics', '/auth/login'})
+
+# Serializes browser-login mint+revoke per process: two concurrent logins
+# for the same user must not revoke each other's freshly minted token
+# (request B's 'prior' list would otherwise include A's new token).
+_BROWSER_TOKEN_LOCK = threading.Lock()
 
 
 def _auth_enabled() -> bool:
@@ -377,15 +383,18 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 # One live browser-login credential per user: bound
                 # life, and prior ones revoked AFTER the new mint
                 # succeeds (create-then-revoke — a failed mint must not
-                # strand the user with zero working CLI tokens).
-                prior = [t['token_id']
-                         for t in users_db.list_tokens(user.name)
-                         if t['label'] == 'browser-login']
-                fresh = users_db.create_token(
-                    user.name, 'browser-login',
-                    expires_seconds=30 * 24 * 3600)
-                for token_id in prior:
-                    users_db.revoke_token(token_id)
+                # strand the user with zero working CLI tokens). The
+                # lock keeps a concurrent login's fresh token out of
+                # this request's 'prior' list.
+                with _BROWSER_TOKEN_LOCK:
+                    prior = [t['token_id']
+                             for t in users_db.list_tokens(user.name)
+                             if t['label'] == 'browser-login']
+                    fresh = users_db.create_token(
+                        user.name, 'browser-login',
+                        expires_seconds=30 * 24 * 3600)
+                    for token_id in prior:
+                        users_db.revoke_token(token_id)
             sep = '&' if '?' in redirect else '?'
             redirect = f'{redirect}{sep}' + urllib.parse.urlencode(
                 {'token': fresh, 'user': user.name})
@@ -406,7 +415,6 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
         same connection-hijack trick websockets use).
         """
         import socket as socket_lib
-        import threading
         from skypilot_tpu import state
         cluster_name = self.headers.get('X-Skyt-Cluster', '')
         record = state.get_cluster(cluster_name)
@@ -676,7 +684,6 @@ class ApiServer:
         return f'http://{host}:{self.port}'
 
     def start_background(self) -> None:
-        import threading
         self.executor.start()
         self._start_daemons()
         thread = threading.Thread(target=self.httpd.serve_forever,

@@ -91,6 +91,11 @@ def state_shardings(mesh: Mesh,
     # leaf to the sharding of the param whose tree path is a suffix of the
     # opt leaf's path -- exact regardless of shape collisions (two params
     # with equal shapes but different shardings, e.g. square MLPs).
+    param_shape_leaves = {
+        tuple(path): leaf.shape
+        for path, leaf
+        in jax.tree_util.tree_flatten_with_path(param_shapes)[0]
+    }
     param_paths = {
         tuple(path): sh
         for path, sh in jax.tree_util.tree_flatten_with_path(param_sh)[0]
@@ -98,22 +103,20 @@ def state_shardings(mesh: Mesh,
     replicated = NamedSharding(mesh, P())
 
     def map_opt_leaf(path, leaf):
+        # Only leaves with the param's EXACT shape inherit its sharding
+        # (adam mu/nu). Rank-reduced stats (adafactor v_row/v_col drop a
+        # dim) stay replicated -- a shard_shape probe can't catch them on
+        # meshes whose axes are all size 1, where any spec "fits".
         path = tuple(path)
         for plen in range(len(path), 0, -1):
             suffix = path[-plen:]
             if suffix in param_paths:
-                sh = param_paths[suffix]
-                if sh.shard_shape(leaf.shape):  # rank check via shard_shape
-                    return sh
+                if param_shape_leaves[suffix] == tuple(leaf.shape):
+                    return param_paths[suffix]
+                break
         return replicated
 
-    def safe_map_opt_leaf(path, leaf):
-        try:
-            return map_opt_leaf(path, leaf)
-        except ValueError:
-            return replicated
-
-    opt_sh = jax.tree_util.tree_map_with_path(safe_map_opt_leaf, opt_shape)
+    opt_sh = jax.tree_util.tree_map_with_path(map_opt_leaf, opt_shape)
     return TrainState(step=replicated, params=param_sh, opt_state=opt_sh)
 
 

@@ -25,6 +25,7 @@ class FakeGcp(gcp.GcpTpuProvider):
         super().__init__(project='proj')
         self.calls = []
         self.qrs = {}         # qr_id -> state
+        self.qr_specs = {}    # qr_id -> creation body (tpu.nodeSpec)
         self.nodes = {}       # node_id -> node dict
         self.instances = {}   # name -> instance dict
         self.firewalls = {}
@@ -59,6 +60,7 @@ class FakeGcp(gcp.GcpTpuProvider):
         if m and method == 'POST':
             qr_id = m.group(1)
             self.qrs[qr_id] = 'ACTIVE'
+            self.qr_specs[qr_id] = body
             spec = body['tpu']['nodeSpec'][0]
             self.nodes[qr_id] = {
                 'name': f'projects/proj/locations/z/nodes/{qr_id}',
@@ -77,12 +79,16 @@ class FakeGcp(gcp.GcpTpuProvider):
         if m and method == 'GET':
             return {'state': {'state': self.qrs[m.group(1)]}}
         if url.endswith('/queuedResources') and method == 'GET':
+            # Real list responses carry the full QueuedResource object
+            # including tpu.nodeSpec (and its labels), not just the name.
             return {'queuedResources': [
-                {'name': f'projects/proj/locations/z/queuedResources/{q}'}
+                {'name': f'projects/proj/locations/z/queuedResources/{q}',
+                 **self.qr_specs[q]}
                 for q in self.qrs]}
         if 'queuedResources/' in url and method == 'DELETE':
             qr_id = url.split('queuedResources/')[1].split('?')[0]
             self.qrs.pop(qr_id, None)
+            self.qr_specs.pop(qr_id, None)
             self.nodes.pop(qr_id, None)
             return {}
         # --- tpu: nodes ---
@@ -189,6 +195,20 @@ def test_cpu_instance_create_for_controller_vm(provider, tmp_home):
     assert info.hosts[0].external_ip == '34.9.9.9'
     provider.terminate_instances('ctrl')
     assert provider.instances == {}
+
+
+def test_terminate_spares_prefix_sibling_cluster(provider, tmp_home):
+    # VERDICT r3 weak #5: teardown matched QRs by name prefix, so
+    # terminating cluster 'a' deleted cluster 'a-n1''s QR 'a-n1-n0-s0'
+    # ('a-n1-n0-s0'.startswith('a-n')). The label filter must not.
+    _record('a')
+    _record('a-n1')
+    provider.run_instances(_tpu_request('a'))
+    provider.run_instances(_tpu_request('a-n1'))
+    assert set(provider.qrs) == {'a-n0-s0', 'a-n1-n0-s0'}
+    provider.terminate_instances('a')
+    assert set(provider.qrs) == {'a-n1-n0-s0'}
+    assert provider.query_instances('a-n1') == {'a-n1-n0-s0': 'running'}
 
 
 def test_terminate_cleans_up_port_firewall(provider, tmp_home):
