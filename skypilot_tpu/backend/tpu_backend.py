@@ -8,7 +8,6 @@ groups, no vendored Ray patches (SURVEY.md section 7 design stance).
 from __future__ import annotations
 
 import sys
-import threading
 from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions, state
@@ -17,7 +16,6 @@ from skypilot_tpu.backend.backend import Backend
 from skypilot_tpu.optimizer import Candidate, Optimizer
 from skypilot_tpu.provision.api import ClusterInfo, get_provider
 from skypilot_tpu.provision.provisioner import provision_with_failover
-from skypilot_tpu.runtime import job_lib
 from skypilot_tpu.runtime.job_client import job_table_for
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import locks, log
@@ -275,86 +273,62 @@ class TpuPodBackend(Backend):
                 detach: bool = True) -> int:
         """Run the task on every host; returns the job id.
 
-        detach=True: write rank scripts + a PENDING job record; the
-        cluster's runtime daemon gang-starts and supervises it (queue
-        semantics -- jobs run one at a time per cluster).
-        detach=False: gang-run in the foreground, streaming rank 0.
+        EVERY job flows through the cluster's job queue and is
+        gang-started by the runtime daemon — attached runs simply
+        follow the rank-0 log until the job is terminal (parity:
+        `sky exec` codegens + submits to the job queue and tails,
+        never drives ranks from the client). A foreground side-channel
+        would bypass the daemon's admission control (TPU exclusivity,
+        concurrency caps).
         """
-        runners = runners_for_cluster(info)
         resources = _task_resources(task)
         node_ips = codegen.node_ip_list(info)
         job_table = job_table_for(info)
 
-        if detach:
-            # The submission protocol writes all rank scripts BEFORE the
-            # job becomes PENDING: the daemon polls every second and must
-            # never observe a partial script set (it would gang-start a
-            # partial pod). DirectJobTable does this in-process;
-            # RemoteJobTable does it atomically on-head via the job_cli
-            # shim (one SSH round trip).
-            scripts: Dict[int, str] = {}
-            for idx, host in enumerate(info.hosts):
-                command = task.get_run_command(host.node_index, node_ips)
-                if command is None:
-                    continue
-                env = codegen.task_env_for_host(task, info, host, resources)
-                scripts[idx] = codegen.make_job_script(
-                    command, env,
-                    workdir=_WORKDIR_REMOTE if task.workdir else None,
-                    secrets=task.secrets)
-            job_id = job_table.submit(task.name, len(info.hosts), scripts)
-            state.touch_cluster(info.cluster_name)
-            return job_id
-
-        # Foreground gang-run: ranks are driven from this process through
-        # the runners; the job row is still recorded in the CLUSTER's job
-        # table (RUNNING from the start, so the daemon never gang-starts
-        # it a second time).
-        job_id = job_table.add_job(task.name, len(info.hosts),
-                                   job_lib.JobStatus.RUNNING)
-        exit_codes: Dict[int, int] = {}
-        lock = threading.Lock()
-
-        def run_rank(idx: int) -> None:
-            runner, host = runners[idx], info.hosts[idx]
+        # The submission protocol writes all rank scripts BEFORE the
+        # job becomes PENDING: the daemon polls every second and must
+        # never observe a partial script set (it would gang-start a
+        # partial pod). DirectJobTable does this in-process;
+        # RemoteJobTable does it atomically on-head via the job_cli
+        # shim (one SSH round trip).
+        scripts: Dict[int, str] = {}
+        for idx, host in enumerate(info.hosts):
             command = task.get_run_command(host.node_index, node_ips)
             if command is None:
-                exit_codes[idx] = 0
-                return
+                continue
             env = codegen.task_env_for_host(task, info, host, resources)
-            script = codegen.make_job_script(
+            scripts[idx] = codegen.make_job_script(
                 command, env,
                 workdir=_WORKDIR_REMOTE if task.workdir else None,
                 secrets=task.secrets)
-            # Logs are recorded on the HOST side (tee), so `tail_logs`
-            # reads the same path whether a job ran foreground or via the
-            # daemon -- on SSH clusters the client-side log file of the
-            # old scheme was unreachable from `skyt logs`. POSIX-only
-            # constructs: kubectl runners execute via /bin/sh, where
-            # bash's PIPESTATUS does not exist.
-            job_dir = f'~/.skyt_runtime/jobs/{job_id}'
-            rank_log = f'{job_dir}/rank_{idx}.log'
-            rc_file = f'{job_dir}/rank_{idx}.rc'
-            wrapped = (f'mkdir -p {job_dir}\n'
-                       f'{{\n(\n{script}\n)\necho $? > {rc_file}\n}} 2>&1 '
-                       f'| tee -a {rank_log}\n'
-                       f'exit $(cat {rc_file})')
-            stream = sys.stdout if idx == 0 else None
-            code, _ = runner.run(wrapped, stream_to=stream)
-            with lock:
-                exit_codes[idx] = code
-
-        threads = [threading.Thread(target=run_rank, args=(i,), daemon=True)
-                   for i in range(len(runners))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-
-        worst = max(exit_codes.values()) if exit_codes else 1
-        final = (job_lib.JobStatus.SUCCEEDED if worst == 0
-                 else job_lib.JobStatus.FAILED)
-        job_table.set_status(job_id, final, exit_code=worst)
+        # The daemon's admission control needs the job's resource
+        # class: tasks that EXPLICITLY request no accelerator are
+        # CPU-only and may share the cluster with a running TPU job.
+        # No resources at all (bare `exec`) conservatively counts as
+        # TPU — a surprise-concurrent TPU program would crash on busy
+        # devices.
+        uses_tpu = (resources is None
+                    or bool(resources.accelerators))
+        if not detach and not job_table.daemon_alive():
+            # Attached runs need a live daemon or the follow would hang
+            # on a forever-PENDING job. Local-style daemons can simply
+            # be restarted; a dead remote daemon means the runtime needs
+            # re-shipping (skyt launch does).
+            if runtime_setup.is_local_style(info):
+                from skypilot_tpu.runtime import daemon as daemon_lib
+                daemon_lib.start_daemon(
+                    info.cluster_name, runtime_setup.head_runtime_dir(info))
+            else:
+                raise exceptions.ClusterNotUpError(
+                    f'Runtime daemon on {info.cluster_name!r} is not '
+                    'responding; cannot run an attached job. Re-run '
+                    '`skyt launch` to restore the cluster runtime.')
+        job_id = job_table.submit(task.name, len(info.hosts), scripts,
+                                  metadata={'uses_tpu': uses_tpu})
+        state.touch_cluster(info.cluster_name)
+        if detach:
+            return job_id
+        job_table.tail(job_id, follow=True, stream=sys.stdout)
         state.touch_cluster(info.cluster_name)
         return job_id
 

@@ -5,6 +5,11 @@ import os
 import time
 from typing import IO, Iterator, Optional
 
+# SSH ranks echo this as their first line so the head-side daemon can
+# observe remote liveness (daemon.RANK_STARTED_MARKER); it is framework
+# plumbing, not job output, so reads drop it.
+_RANK_STARTED_MARKER = '__SKYT_RANK_STARTED__'
+
 
 def tail_file(path: str,
               *,
@@ -35,13 +40,18 @@ def tail_file(path: str,
         while True:
             line = f.readline()
             if line:
-                yield line
+                if line.strip() != _RANK_STARTED_MARKER:
+                    yield line
                 continue
             if not follow:
                 return
             if stop_when is not None and stop_when():
                 # drain anything written between the check and now
                 rest = f.read()
+                if _RANK_STARTED_MARKER in rest:
+                    rest = '\n'.join(
+                        ln for ln in rest.split('\n')
+                        if ln.strip() != _RANK_STARTED_MARKER)
                 if rest:
                     yield rest
                 return

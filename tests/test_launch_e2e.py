@@ -47,10 +47,12 @@ def test_launch_end_to_end_multihost_rank_envs(capsys):
     assert 'worker=0' in log0
     assert 'coord=10.0.0.2:8476' in log0
 
-    # worker 1 got its own TPU_WORKER_ID
-    runtime_root = os.path.join(os.environ['SKYT_STATE_DIR'], 'hosts',
-                                'e2e', '0-1', '.skyt_runtime')
-    with open(os.path.join(runtime_root, 'jobs', '1', 'rank_1.log'),
+    # worker 1 got its own TPU_WORKER_ID (all rank logs live in the
+    # HEAD's runtime dir: the daemon gang-starts every job — attached
+    # runs included — and collects rank stdout there)
+    head_runtime = os.path.join(os.environ['SKYT_STATE_DIR'], 'hosts',
+                                'e2e', '0-0', '.skyt_runtime')
+    with open(os.path.join(head_runtime, 'jobs', '1', 'rank_1.log'),
               encoding='utf-8') as f:
         assert 'worker=1 of 2' in f.read()
 

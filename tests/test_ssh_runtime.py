@@ -133,6 +133,35 @@ def test_cancel_detached_job_gang_kills_remote_ranks():
     assert not alive, f'rank procs survived cancel: {alive}'
 
 
+def test_gang_start_straggler_fails_within_deadline(monkeypatch):
+    """SURVEY §7 hard-parts bullet 3 (VERDICT r3 weak #6): a rank whose
+    SSH spawn hangs never reaches 'started'; the daemon must fail the
+    job within the gang-start deadline instead of leaving it RUNNING
+    forever, and say which rank straggled."""
+    monkeypatch.setenv('SKYT_GANG_START_DEADLINE', '4')
+    # worker 0-1 (rank 1): its rank-spawn SSH hangs before the remote
+    # shell starts; every other SSH op to it works normally.
+    monkeypatch.setenv('SKYT_FAKE_SSH_HANG_ROOT', os.path.join('0-1'))
+    task = _tpu_task('sleep 120; echo never')
+    job_id = execution.launch(task, cluster_name='sshhang',
+                              detach_run=True)[0][1]
+    # Clock from submission (launch already includes provisioning +
+    # runtime shipping): deadline 4s + daemon/kill/poll overheads must
+    # stay far below the 120s the job would run if never reaped.
+    t0 = time.time()
+    job = _wait_status('sshhang', job_id, {'FAILED'}, timeout=40)
+    assert job['status'] == 'FAILED'
+    assert time.time() - t0 < 40
+    # per-rank diagnosis recorded in the straggler's log on the head
+    head_runtime = os.path.join(_host_root('sshhang', 0, 0),
+                                '.skyt_runtime')
+    rank1_log = os.path.join(head_runtime, 'jobs', str(job_id),
+                             'rank_1.log')
+    with open(rank1_log, encoding='utf-8') as f:
+        content = f.read()
+    assert 'never started' in content
+
+
 def test_workdir_and_setup_over_ssh(tmp_path):
     workdir = tmp_path / 'proj'
     workdir.mkdir()
