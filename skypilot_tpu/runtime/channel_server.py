@@ -1,0 +1,212 @@
+"""Persistent runtime channel: head-side server process.
+
+Parity: the reference skylet serves four gRPC services over ONE
+SSH-tunneled channel per cluster (``sky/schemas/proto/jobsv1.proto`` et
+al., channel setup ``cloud_vm_ray_backend.py:2395``) so clients don't pay
+an SSH exec per job-table op and the server can receive pushes. This is
+the same architecture without gRPC (not in the image, and a 60-line
+framed protocol carries the identical schema): the backend holds one
+``python -m skypilot_tpu.runtime.channel_server`` process per cluster —
+spawned through the cluster's transport (local / ssh / kubectl exec) —
+and multiplexes requests over its stdin/stdout.
+
+Wire format: 4-byte big-endian length + UTF-8 JSON, both directions.
+
+* request:  ``{"id": N, "op": "...", ...params}``
+* response: ``{"id": N, "ok": true, "result": ...}`` or
+  ``{"id": N, "ok": false, "error": "..."}``
+* stream:   ``{"id": N, "stream": "data", "text": "..."}`` repeated,
+  then ``{"id": N, "stream": "end"}`` (used by ``tail``)
+* push:     ``{"event": "job", "job_id": J, "status": "...", "ts": T}``
+  — unsolicited job-state transitions from the table watcher, the bit
+  the one-shot job_cli shim fundamentally cannot do.
+
+Ops are the job_cli command set (the handlers are literally shared); the
+server exits when stdin closes, so a dropped transport can never leak a
+process.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import threading
+import time
+from typing import Any, Dict
+
+from skypilot_tpu.runtime import job_cli, job_lib, log_lib
+
+_LEN = struct.Struct('>I')
+MAX_FRAME = 64 << 20
+
+# How often the watcher diffs the job table for push events. Head-local
+# sqlite reads are ~free; sub-second cadence meets the "<2 s without a
+# poll tick (server-side)" bar with margin.
+WATCH_PERIOD = float(os.environ.get('SKYT_CHANNEL_WATCH_PERIOD', '0.3'))
+
+
+def read_frame(stream) -> Dict[str, Any]:
+    header = stream.read(_LEN.size)
+    if len(header) < _LEN.size:
+        raise EOFError
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f'frame of {length} bytes exceeds {MAX_FRAME}')
+    body = b''
+    while len(body) < length:
+        chunk = stream.read(length - len(body))
+        if not chunk:
+            raise EOFError
+        body += chunk
+    return json.loads(body.decode('utf-8'))
+
+
+def write_frame(stream, obj: Dict[str, Any], lock=None) -> None:
+    body = json.dumps(obj).encode('utf-8')
+    data = _LEN.pack(len(body)) + body
+    if lock is not None:
+        with lock:
+            stream.write(data)
+            stream.flush()
+    else:
+        stream.write(data)
+        stream.flush()
+
+
+class ChannelServer:
+    def __init__(self, runtime_dir: str) -> None:
+        self.runtime_dir = runtime_dir
+        self._out = sys.stdout.buffer
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+
+    # -- outbound ------------------------------------------------------
+
+    def _send(self, obj: Dict[str, Any]) -> None:
+        try:
+            write_frame(self._out, obj, self._lock)
+        except (BrokenPipeError, OSError):
+            self._stopping.set()
+
+    # -- op handlers ---------------------------------------------------
+
+    def _handle(self, req: Dict[str, Any]) -> None:
+        rid = req.get('id')
+        op = req.get('op')
+        rt = self.runtime_dir
+        try:
+            if op == 'ping':
+                result = {'pong': True, 'ts': time.time()}
+            elif op == 'submit':
+                result = job_cli.cmd_submit(rt, req['payload_b64'])
+            elif op == 'add':
+                result = job_cli.cmd_add(rt, req.get('name', ''),
+                                         int(req.get('num_hosts', 1)),
+                                         req.get('status', 'PENDING'))
+            elif op == 'set_status':
+                result = job_cli.cmd_set_status(rt, int(req['job_id']),
+                                                req['status'],
+                                                req.get('exit_code'))
+            elif op == 'list':
+                result = job_cli.cmd_list(rt)
+            elif op == 'get':
+                result = job_cli.cmd_get(rt, int(req['job_id']))
+            elif op == 'cancel':
+                result = job_cli.cmd_cancel(rt, int(req['job_id']))
+            elif op == 'set_autostop':
+                result = job_cli.cmd_set_autostop(rt, req['config_b64'])
+            elif op == 'daemon_status':
+                result = job_cli.cmd_daemon_status(rt)
+            elif op == 'tail':
+                self._stream_tail(rid, int(req['job_id']),
+                                  bool(req.get('follow')))
+                return
+            else:
+                self._send({'id': rid, 'ok': False,
+                            'error': f'unknown op {op!r}'})
+                return
+        except Exception as e:  # pylint: disable=broad-except
+            self._send({'id': rid, 'ok': False,
+                        'error': f'{type(e).__name__}: {e}'})
+            return
+        self._send({'id': rid, 'ok': True, 'result': result})
+
+    def _stream_tail(self, rid, job_id: int, follow: bool) -> None:
+        job = job_lib.get_job(self.runtime_dir, job_id)
+        if job is None:
+            self._send({'id': rid, 'ok': False, 'kind': 'not_found',
+                        'error': f'No job {job_id} on cluster'})
+            return
+        log_path = os.path.join(
+            job_lib.job_log_dir(self.runtime_dir, job_id), 'rank_0.log')
+
+        def job_done() -> bool:
+            if self._stopping.is_set():
+                return True
+            j = job_lib.get_job(self.runtime_dir, job_id)
+            return j is None or job_lib.JobStatus(
+                j['status']).is_terminal()
+
+        if not follow and not os.path.exists(log_path):
+            self._send({'id': rid, 'ok': False, 'kind': 'not_found',
+                        'error': f'No logs for job {job_id}'})
+            return
+        for line in log_lib.tail_file(log_path, follow=follow,
+                                      stop_when=job_done):
+            self._send({'id': rid, 'stream': 'data', 'text': line})
+            if self._stopping.is_set():
+                return
+        self._send({'id': rid, 'stream': 'end'})
+
+    # -- job-table watcher (the push half) -----------------------------
+
+    def _watch(self) -> None:
+        seen: Dict[int, str] = {}
+        first = True
+        while not self._stopping.is_set():
+            try:
+                jobs = job_lib.list_jobs(self.runtime_dir)
+            except Exception:  # pylint: disable=broad-except
+                jobs = []
+            for job in jobs:
+                job_id, status = job['job_id'], job['status']
+                if seen.get(job_id) != status:
+                    seen[job_id] = status
+                    if not first:  # don't replay history on connect
+                        self._send({'event': 'job', 'job_id': job_id,
+                                    'status': status,
+                                    'name': job.get('name'),
+                                    'exit_code': job.get('exit_code'),
+                                    'ts': time.time()})
+            first = False
+            self._stopping.wait(WATCH_PERIOD)
+
+    def serve(self) -> None:
+        watcher = threading.Thread(target=self._watch, daemon=True)
+        watcher.start()
+        stdin = sys.stdin.buffer
+        while not self._stopping.is_set():
+            try:
+                req = read_frame(stdin)
+            except EOFError:
+                break
+            except ValueError:
+                break
+            threading.Thread(target=self._handle, args=(req,),
+                             daemon=True).start()
+        self._stopping.set()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--runtime-dir',
+                        default=job_lib.DEFAULT_RUNTIME_DIR)
+    args = parser.parse_args()
+    ChannelServer(args.runtime_dir).serve()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

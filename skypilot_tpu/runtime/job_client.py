@@ -25,6 +25,24 @@ REMOTE_RUNTIME_DIR = '~/.skyt_runtime'
 REMOTE_PKG_DIR = '~/.skyt_runtime/runtime'
 
 
+def encode_b64_json(obj: Any) -> str:
+    """Wire encoding shared by the job_cli shim and the channel
+    transport — both feed job_cli's cmd_* handlers on the head."""
+    return base64.b64encode(
+        json.dumps(obj).encode('utf-8')).decode('ascii')
+
+
+def encode_submit_payload(name: Optional[str], num_hosts: int,
+                          scripts: Dict[int, str],
+                          metadata: Optional[Dict[str, Any]]) -> str:
+    return encode_b64_json({
+        'name': name,
+        'num_hosts': num_hosts,
+        'scripts': {str(r): s for r, s in scripts.items()},
+        'metadata': metadata or {},
+    })
+
+
 class JobTable:
     """Submit/inspect/cancel jobs + runtime-daemon state on one cluster."""
 
@@ -161,14 +179,7 @@ class RemoteJobTable(JobTable):
             1, 'job_cli', error_msg=f'No JSON in output: {output[-500:]}')
 
     def submit(self, name, num_hosts, scripts, metadata=None) -> int:
-        payload = {
-            'name': name,
-            'num_hosts': num_hosts,
-            'scripts': {str(r): s for r, s in scripts.items()},
-            'metadata': metadata or {},
-        }
-        b64 = base64.b64encode(
-            json.dumps(payload).encode('utf-8')).decode('ascii')
+        b64 = encode_submit_payload(name, num_hosts, scripts, metadata)
         _, output = self._invoke(f'submit {b64}')
         return int(self._parse(output)['job_id'])
 
@@ -199,9 +210,7 @@ class RemoteJobTable(JobTable):
         return bool(self._parse(output)['cancelled'])
 
     def set_autostop(self, config):
-        b64 = base64.b64encode(
-            json.dumps(config).encode('utf-8')).decode('ascii')
-        self._invoke(f'set-autostop {b64}')
+        self._invoke(f'set-autostop {encode_b64_json(config)}')
 
     def tail(self, job_id, *, follow=False, stream=None):
         import sys
@@ -226,9 +235,20 @@ class RemoteJobTable(JobTable):
 
 
 def job_table_for(info) -> JobTable:
-    """The right transport for this cluster's job table."""
+    """The right transport for this cluster's job table.
+
+    Non-local clusters prefer the persistent channel (one live
+    connection per cluster, framed ops, no per-op SSH exec —
+    runtime/channel.py); the job_cli shim remains the fallback when a
+    channel can't be established (runtime not shipped yet, transport
+    down, or ``SKYT_RUNTIME_CHANNEL=0``).
+    """
     from skypilot_tpu.backend import runtime_setup
     from skypilot_tpu.utils.command_runner import runners_for_cluster
     if runtime_setup.is_local_style(info):
         return DirectJobTable(runtime_setup.head_runtime_dir(info))
+    from skypilot_tpu.runtime import channel as channel_lib
+    client = channel_lib.get_channel(info)
+    if client is not None:
+        return channel_lib.ChannelJobTable(client)
     return RemoteJobTable(runners_for_cluster(info)[0])

@@ -137,8 +137,12 @@ PROVISION_SECONDS = Histogram(
     'skyt_provision_seconds', 'Cluster provision latency (seconds)')
 DAEMON_TICKS = Counter(
     'skyt_daemon_ticks_total', 'Background daemon loop iterations')
+RUNTIME_EVENTS = Counter(
+    'skyt_runtime_events_total',
+    'Job-state transitions pushed over cluster runtime channels')
 
-_ALL = [REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS]
+_ALL = [REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
+        RUNTIME_EVENTS]
 
 
 def collect_from_db() -> None:

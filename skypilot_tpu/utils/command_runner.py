@@ -66,6 +66,12 @@ class CommandRunner:
               excludes: Optional[List[str]] = None) -> None:
         raise NotImplementedError
 
+    def popen(self, cmd: str) -> subprocess.Popen:
+        """Start `cmd` on the host with binary stdin/stdout pipes —
+        the transport for long-lived framed-protocol connections
+        (runtime/channel.py), which `run`'s one-shot exec can't carry."""
+        raise NotImplementedError
+
     def _check(self, returncode: int, cmd: str, output: str,
                check: bool) -> None:
         if check and returncode != 0:
@@ -131,6 +137,14 @@ class LocalCommandRunner(CommandRunner):
             src, dst = dst, os.path.expanduser(src)
         _pycopy(src, dst, excludes)
 
+    def popen(self, cmd: str) -> subprocess.Popen:
+        full_env = {**os.environ, 'HOME': self.host_root}
+        return subprocess.Popen(['bash', '-c', cmd], cwd=self.host_root,
+                                env=full_env,
+                                stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                start_new_session=True)
+
 
 class SSHCommandRunner(CommandRunner):
     """Runs over the `ssh` binary; files move with rsync-over-ssh."""
@@ -184,6 +198,12 @@ class SSHCommandRunner(CommandRunner):
         output = ''.join(lines)
         self._check(returncode, cmd, output, check)
         return returncode, output
+
+    def popen(self, cmd: str) -> subprocess.Popen:
+        return subprocess.Popen(self._ssh_base() + [cmd],
+                                stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                start_new_session=True)
 
     def rsync(self, src: str, dst: str, *, up: bool = True, excludes=None):
         ssh_cmd = ' '.join(['ssh'] + _SSH_OPTIONS +
@@ -257,6 +277,13 @@ class KubectlCommandRunner(CommandRunner):
         output = ''.join(lines)
         self._check(returncode, cmd, output, check)
         return returncode, output
+
+    def popen(self, cmd: str) -> subprocess.Popen:
+        full = self._kubectl() + ['exec', '-i', self.pod, '--',
+                                  '/bin/sh', '-c', cmd]
+        return subprocess.Popen(full, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                start_new_session=True)
 
     def rsync(self, src: str, dst: str, *, up: bool = True, excludes=None):
         # tar over `kubectl exec` rather than `kubectl cp`: honors
