@@ -481,22 +481,54 @@ def workspace_roles(workspace: Optional[str] = None
 def _upload_workdir(task_config: Dict[str, Any]) -> Dict[str, Any]:
     """Tar the local workdir and upload it; rewrite the task's workdir to
     the server-side extracted path (parity: POST /upload, chunked
-    server.py:1564)."""
+    server.py:1564).
+
+    The tarball is spooled to disk (never held in RAM) and hashed; a
+    GET /upload/<digest> probe skips the transfer entirely when the
+    server already holds this content (resume-by-digest), else the POST
+    streams the file so a multi-GB workdir costs O(chunk) memory on
+    both ends.
+    """
+    import hashlib
+    import tempfile
     workdir = task_config.get('workdir')
     if not workdir or not os.path.isdir(os.path.expanduser(workdir)):
         return task_config
-    buf = io.BytesIO()
     src = os.path.expanduser(workdir)
+
     def _exclude_git_dir(ti: tarfile.TarInfo) -> Optional[tarfile.TarInfo]:
         # Exact '.git' path components only: .gitignore/.github must ship.
         parts = ti.name.split('/')
         return None if '.git' in parts else ti
 
-    with tarfile.open(fileobj=buf, mode='w:gz') as tar:
-        tar.add(src, arcname='.', filter=_exclude_git_dir)
     url = ensure_api_server()
-    resp = requests_lib.post(f'{url}/upload', data=buf.getvalue(),
-                             timeout=600, headers=_auth_headers())
+    import gzip
+    with tempfile.NamedTemporaryFile(prefix='skyt-workdir-',
+                                     suffix='.tgz') as spool:
+        # gzip mtime pinned to 0 and FNAME to '': `w:gz` stamps the
+        # compression time AND the spool's random temp filename into
+        # the header, which would give identical content a different
+        # digest on every call and defeat resume-by-digest.
+        with gzip.GzipFile(filename='', fileobj=spool, mode='wb',
+                           mtime=0) as gz:
+            with tarfile.open(fileobj=gz, mode='w') as tar:
+                tar.add(src, arcname='.', filter=_exclude_git_dir)
+        spool.flush()
+        hasher = hashlib.sha256()
+        spool.seek(0)
+        for chunk in iter(lambda: spool.read(1 << 20), b''):
+            hasher.update(chunk)
+        digest = hasher.hexdigest()[:16]
+        probe = requests_lib.get(f'{url}/upload/{digest}', timeout=10,
+                                 headers=_auth_headers())
+        if probe.status_code == 200 and probe.json().get('exists'):
+            task_config = dict(task_config)
+            task_config['workdir'] = probe.json()['path']
+            return task_config
+        spool.seek(0)
+        resp = requests_lib.post(
+            f'{url}/upload', data=spool, timeout=600,
+            headers={**_auth_headers(), 'X-Skyt-Digest': digest})
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
             f'workdir upload failed: {resp.text}')

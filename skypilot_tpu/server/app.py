@@ -472,24 +472,66 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
         upstream.close()
 
     def _handle_upload(self) -> None:
-        """Chunked workdir upload: gzipped tar body, content-addressed
-        extraction (parity: server.py:1564 + blob storage)."""
+        """Streamed workdir upload: the gzipped tar body is spooled to
+        disk in 64 KiB chunks with sha256 computed on the fly, so server
+        memory stays O(chunk) however large the workdir (parity:
+        server.py:1564 chunked upload + blob storage). Content-addressed
+        extraction dedups identical uploads; clients that know their
+        digest probe GET /upload/<digest> first and skip the body
+        entirely (resume-by-digest)."""
         length = int(self.headers.get('Content-Length', 0))
-        raw = self.rfile.read(length)
-        digest = hashlib.sha256(raw).hexdigest()[:16]
         os.makedirs(_uploads_dir(), exist_ok=True)
-        dest = os.path.join(_uploads_dir(), digest)
-        if not os.path.exists(dest):
-            tmp = tempfile.mkdtemp(prefix=f'.{digest}-', dir=_uploads_dir())
-            with tarfile.open(fileobj=io.BytesIO(raw), mode='r:gz') as tar:
-                tar.extractall(tmp, filter='data')
+        hasher = hashlib.sha256()
+        fd, spool = tempfile.mkstemp(prefix='.spool-', dir=_uploads_dir())
+        try:
+            with os.fdopen(fd, 'wb') as out:
+                remaining = length
+                while remaining > 0:
+                    chunk = self.rfile.read(min(65536, remaining))
+                    if not chunk:
+                        raise OSError('client disconnected mid-upload')
+                    hasher.update(chunk)
+                    out.write(chunk)
+                    remaining -= len(chunk)
+            digest = hasher.hexdigest()[:16]
+            claimed = self.headers.get('X-Skyt-Digest')
+            if claimed and claimed != digest:
+                self._error(HTTPStatus.BAD_REQUEST,
+                            f'digest mismatch: body hashed to {digest}, '
+                            f'header claimed {claimed} (corrupt upload?)')
+                return
+            dest = os.path.join(_uploads_dir(), digest)
+            if not os.path.exists(dest):
+                tmp = tempfile.mkdtemp(prefix=f'.{digest}-',
+                                       dir=_uploads_dir())
+                with tarfile.open(spool, mode='r:gz') as tar:
+                    tar.extractall(tmp, filter='data')
+                try:
+                    os.rename(tmp, dest)
+                except OSError:
+                    # Lost the race to a concurrent identical upload —
+                    # content is identical (content-addressed), so
+                    # theirs is fine.
+                    shutil.rmtree(tmp, ignore_errors=True)
+        finally:
             try:
-                os.rename(tmp, dest)
+                os.remove(spool)
             except OSError:
-                # Lost the race to a concurrent identical upload — content
-                # is identical (content-addressed), so theirs is fine.
-                shutil.rmtree(tmp, ignore_errors=True)
+                pass
         self._reply({'workdir_token': digest, 'path': dest})
+
+    def _handle_upload_probe(self, digest: str) -> None:
+        """GET /upload/<digest>: lets a client skip re-sending a workdir
+        the server already holds (resume-by-digest). The digest must be
+        exactly the 16-hex-char form _handle_upload mints — anything
+        else ('..', separators) would escape the uploads dir."""
+        import re
+        if not re.fullmatch(r'[0-9a-f]{16}', digest):
+            self._reply({'exists': False, 'path': None})
+            return
+        dest = os.path.join(_uploads_dir(), digest)
+        exists = os.path.isdir(dest)
+        self._reply({'exists': exists, 'path': dest if exists else None})
 
     # -- GET: polling / streaming --------------------------------------
 
@@ -513,6 +555,8 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
             elif route == '/api/workspaces/roles':
                 self._reply(users_db.list_workspace_roles(
                     self._query.get('workspace')))
+            elif route.startswith('/upload/'):
+                self._handle_upload_probe(route[len('/upload/'):])
             elif route == '/api/health':
                 self._reply({
                     'status': 'healthy',
