@@ -1,0 +1,78 @@
+"""Baked-in Azure offerings (parity: ``sky/catalog/azure_catalog.py``
+over hosted CSVs from ``sky/catalog/data_fetchers/fetch_azure.py``).
+
+Same stance as ``aws_data``/``gcp_data``: a versioned in-package table
+(zero-egress operation) the TTL-refresh layer can overlay. Prices are
+representative eastus pay-as-you-go/spot rates; the optimizer only needs
+relative ordering.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+# accelerator -> {count: (vm_size, price_hr, spot_price_hr,
+#                         vram_gb_per_accel)}
+# Azure sells GPUs via fixed N-series VM sizes, like AWS's P/G shapes.
+GPU_INSTANCE_TYPES: Dict[str, Dict[int, Tuple[str, float, float, int]]] = {
+    'H100': {8: ('Standard_ND96isr_H100_v5', 98.32, 39.33, 80)},
+    'A100-80GB': {
+        1: ('Standard_NC24ads_A100_v4', 3.673, 1.469, 80),
+        2: ('Standard_NC48ads_A100_v4', 7.346, 2.938, 80),
+        4: ('Standard_NC96ads_A100_v4', 14.692, 5.877, 80),
+        8: ('Standard_ND96amsr_A100_v4', 32.77, 13.11, 80),
+    },
+    'A100': {8: ('Standard_ND96asr_v4', 27.20, 10.88, 40)},
+    'V100': {1: ('Standard_NC6s_v3', 3.06, 0.92, 16),
+             2: ('Standard_NC12s_v3', 6.12, 1.84, 16),
+             4: ('Standard_NC24s_v3', 12.24, 3.67, 16)},
+    'T4': {1: ('Standard_NC4as_T4_v3', 0.526, 0.21, 16),
+           4: ('Standard_NC64as_T4_v3', 4.352, 1.74, 16)},
+    'A10': {1: ('Standard_NV36ads_A10_v5', 3.20, 1.28, 24)},
+}
+
+# GPU availability by region. Azure zones are region-scoped ordinals
+# ('1'/'2'/'3'), not region-prefixed names.
+GPU_REGIONS: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    name: {
+        'eastus': ('1', '2', '3'),
+        'westus3': ('1', '2'),
+        'westeurope': ('1', '2', '3'),
+        'southcentralus': ('1', '2'),
+    }
+    for name in GPU_INSTANCE_TYPES
+}
+GPU_REGIONS['H100'] = {
+    'eastus': ('1', '2'),
+    'southcentralus': ('1',),
+}
+
+# name -> (vcpus, memory_gb, price_hr)
+CPU_INSTANCE_TYPES: Dict[str, Tuple[int, float, float]] = {
+    'Standard_D2s_v5': (2, 8.0, 0.096),
+    'Standard_D4s_v5': (4, 16.0, 0.192),
+    'Standard_D8s_v5': (8, 32.0, 0.384),
+    'Standard_D16s_v5': (16, 64.0, 0.768),
+    'Standard_F4s_v2': (4, 8.0, 0.169),
+    'Standard_F16s_v2': (16, 32.0, 0.677),
+    'Standard_E4s_v5': (4, 32.0, 0.252),
+    'Standard_E16s_v5': (16, 128.0, 1.008),
+}
+
+ALL_AZURE_REGIONS = ('eastus', 'eastus2', 'westus2', 'westus3',
+                     'westeurope', 'northeurope', 'southcentralus',
+                     'japaneast', 'southeastasia')
+
+DEFAULT_REGION = 'eastus'
+
+# Canonical Ubuntu 22.04 Gen2 marketplace image (latest at deploy time).
+DEFAULT_IMAGE = {
+    'publisher': 'Canonical',
+    'offer': '0001-com-ubuntu-server-jammy',
+    'sku': '22_04-lts-gen2',
+    'version': 'latest',
+}
+
+
+def instance_type_for(accelerator: str, count: int):
+    """(vm_size, price, spot_price, vram_per_gpu) or None."""
+    return GPU_INSTANCE_TYPES.get(accelerator, {}).get(count)

@@ -95,6 +95,33 @@ def _gpu_offerings(name: str,
     return out
 
 
+def _fixed_shape_gpu_offerings(cloud: str,
+                               name: str,
+                               count: int,
+                               picked: tuple,
+                               regions: Dict[str, tuple],
+                               region_filter: Optional[str],
+                               zone_filter: Optional[str]
+                               ) -> List[AcceleratorOffering]:
+    """Offerings for clouds that sell GPUs via fixed instance shapes
+    (AWS, Azure): whole-instance prices, one entry per (region, zone)."""
+    _instance, price, spot, vram = picked
+    out = []
+    for region, zones in regions.items():
+        if region_filter is not None and region != region_filter:
+            continue
+        for zone in zones:
+            if zone_filter is not None and zone != zone_filter:
+                continue
+            out.append(
+                AcceleratorOffering(
+                    cloud=cloud, accelerator=name, count=count,
+                    region=region, zone=zone,
+                    price_hr=price, spot_price_hr=spot,
+                    vram_gb=float(vram * count)))
+    return out
+
+
 def _aws_gpu_offerings(name: str,
                        count: int,
                        region_filter: Optional[str] = None,
@@ -105,22 +132,23 @@ def _aws_gpu_offerings(name: str,
     if picked is None:
         return []
     picked = refresh.aws_gpu_instance(name, count, picked)
-    _instance, price, spot, vram = picked
-    out = []
-    for region, zones in aws_data.GPU_REGIONS.get(name, {}).items():
-        if region_filter is not None and region != region_filter:
-            continue
-        for zone in zones:
-            if zone_filter is not None and zone != zone_filter:
-                continue
-            out.append(
-                AcceleratorOffering(
-                    cloud='aws', accelerator=name, count=count,
-                    region=region, zone=zone,
-                    # AWS GPU prices are whole-instance (fixed shapes).
-                    price_hr=price, spot_price_hr=spot,
-                    vram_gb=float(vram * count)))
-    return out
+    return _fixed_shape_gpu_offerings(
+        'aws', name, count, picked, aws_data.GPU_REGIONS.get(name, {}),
+        region_filter, zone_filter)
+
+
+def _azure_gpu_offerings(name: str,
+                         count: int,
+                         region_filter: Optional[str] = None,
+                         zone_filter: Optional[str] = None
+                         ) -> List[AcceleratorOffering]:
+    from skypilot_tpu.catalog import azure_data
+    picked = azure_data.instance_type_for(name, count)
+    if picked is None:
+        return []
+    return _fixed_shape_gpu_offerings(
+        'azure', name, count, picked,
+        azure_data.GPU_REGIONS.get(name, {}), region_filter, zone_filter)
 
 
 def get_offerings(accelerator: str,
@@ -150,6 +178,8 @@ def get_offerings(accelerator: str,
             out.extend(_gpu_offerings(accelerator, count, region, zone))
     if tpu is None and cloud in (None, 'aws'):
         out.extend(_aws_gpu_offerings(accelerator, count, region, zone))
+    if tpu is None and cloud in (None, 'azure'):
+        out.extend(_azure_gpu_offerings(accelerator, count, region, zone))
     return out
 
 
@@ -192,7 +222,8 @@ def get_zones_for_region(accelerator: str, region: str) -> List[str]:
 
 def validate_region_zone(cloud: str, region: Optional[str],
                          zone: Optional[str]) -> None:
-    if cloud not in ('gcp', 'aws', 'fake', 'local', 'kubernetes'):
+    if cloud not in ('gcp', 'aws', 'azure', 'fake', 'local',
+                     'kubernetes'):
         raise exceptions.InvalidSpecError(f'Unknown cloud {cloud!r}')
     if region is None:
         return
@@ -207,6 +238,13 @@ def validate_region_zone(cloud: str, region: Optional[str],
             raise exceptions.InvalidSpecError(
                 f'Unknown AWS region {region!r}. Known: '
                 f'{aws_data.ALL_AWS_REGIONS}')
+    elif cloud == 'azure':
+        from skypilot_tpu.catalog import azure_data
+        if region not in azure_data.ALL_AZURE_REGIONS:
+            raise exceptions.InvalidSpecError(
+                f'Unknown Azure region {region!r}. Known: '
+                f'{azure_data.ALL_AZURE_REGIONS}')
+        return  # Azure zones are ordinals ('1'), not region-prefixed
     else:
         return
     if zone is not None and not zone.startswith(region):
@@ -218,6 +256,9 @@ def _cpu_tables(cloud: Optional[str]) -> Dict[str, tuple]:
     if cloud == 'aws':
         from skypilot_tpu.catalog import aws_data
         return aws_data.CPU_INSTANCE_TYPES
+    if cloud == 'azure':
+        from skypilot_tpu.catalog import azure_data
+        return azure_data.CPU_INSTANCE_TYPES
     return gcp_data.CPU_INSTANCE_TYPES
 
 
@@ -271,4 +312,7 @@ def default_region(cloud: str) -> str:
     if cloud == 'aws':
         from skypilot_tpu.catalog import aws_data
         return aws_data.DEFAULT_REGION
+    if cloud == 'azure':
+        from skypilot_tpu.catalog import azure_data
+        return azure_data.DEFAULT_REGION
     return 'us-central1'

@@ -75,11 +75,21 @@ def _check_aws() -> Tuple[bool, str]:
                    'AWS_SECRET_ACCESS_KEY or aws.* in config')
 
 
+def _check_azure() -> Tuple[bool, str]:
+    try:
+        from skypilot_tpu.provision.azure import credentials
+        credentials()
+        return True, 'service-principal credentials'
+    except Exception as e:  # pylint: disable=broad-except
+        return False, str(e)[:200]
+
+
 _CHECKS = {
     'local': lambda: (True, 'always available'),
     'fake': lambda: (True, 'always available (simulated cloud)'),
     'gcp': _check_gcp,
     'aws': _check_aws,
+    'azure': _check_azure,
     'kubernetes': _check_kubernetes,
     'ssh': _check_ssh,
     'slurm': _check_slurm,

@@ -1,0 +1,533 @@
+"""Azure compute provider: ARM REST (JSON) over stdlib HTTP.
+
+Parity: ``sky/provision/azure/instance.py`` + ``sky/clouds/azure.py`` —
+the reference's third compute cloud, built there on the azure-sdk
+adaptors. The SDK isn't in this image, so the ARM wire protocol is
+implemented directly (same stance as the GCP urllib REST and AWS Query
+API drivers): OAuth2 client-credentials tokens against
+login.microsoftonline.com, then JSON PUT/GET/POST/DELETE against
+``management.azure.com``.
+
+Deployment model (deliberately simpler than the reference's per-cluster
+ARM template): ONE resource group per cluster (``skyt-<cluster>``)
+holding the vnet/NSG/NICs/public-IPs/VMs — terminate is a single RG
+delete, the idiomatic-Azure equivalent of label-filtered teardown.
+Cluster identity additionally rides ``skyt-cluster``/``skyt-node`` tags
+on each VM. Network calls go through ``_request`` so tests stub the
+transport (tests/test_azure_provider.py, mirroring the GCP/AWS fakes).
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.api import (ClusterInfo, CloudCapability,
+                                        HostInfo, Provider,
+                                        ProvisionRequest)
+from skypilot_tpu.utils import log
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = log.init_logger(__name__)
+
+ARM = 'https://management.azure.com'
+COMPUTE_API = '2024-07-01'
+NETWORK_API = '2024-05-01'
+RESOURCE_API = '2022-09-01'
+
+SSH_USER = 'skyt'
+
+# ARM error codes -> typed exceptions (parity:
+# FailoverCloudErrorHandlerV2._azure_handler).
+_CAPACITY_CODES = ('SkuNotAvailable', 'AllocationFailed',
+                   'ZonalAllocationFailed', 'OverconstrainedAllocationRequest',
+                   'SpotAllocationFailed')
+_QUOTA_CODES = ('QuotaExceeded', 'OperationNotAllowed')
+_AUTH_CODES = ('AuthorizationFailed', 'InvalidAuthenticationToken',
+               'AuthenticationFailed', 'InvalidClientSecret')
+
+
+def classify_azure_error(code: str, message: str) -> exceptions.ProvisionError:
+    if code in _QUOTA_CODES:
+        return exceptions.QuotaExceededError(f'{code}: {message}')
+    if code in _CAPACITY_CODES:
+        return exceptions.CapacityError(f'{code}: {message}')
+    if code in _AUTH_CODES:
+        return exceptions.NoCloudAccessError(f'{code}: {message}')
+    return exceptions.ProvisionError(f'{code}: {message}')
+
+
+def _setting(env: str, config_key: str) -> Optional[str]:
+    import os
+    value = os.environ.get(env)
+    if value:
+        return value
+    from skypilot_tpu import config as config_lib
+    return config_lib.get_nested(('azure', config_key), None)
+
+
+def credentials() -> Dict[str, str]:
+    creds = {
+        'subscription': _setting('AZURE_SUBSCRIPTION_ID',
+                                 'subscription_id'),
+        'tenant': _setting('AZURE_TENANT_ID', 'tenant_id'),
+        'client': _setting('AZURE_CLIENT_ID', 'client_id'),
+        'secret': _setting('AZURE_CLIENT_SECRET', 'client_secret'),
+    }
+    missing = [k for k, v in creds.items() if not v]
+    if missing:
+        raise exceptions.NoCloudAccessError(
+            f'Azure credentials incomplete (missing {missing}): set '
+            'AZURE_SUBSCRIPTION_ID/AZURE_TENANT_ID/AZURE_CLIENT_ID/'
+            'AZURE_CLIENT_SECRET or azure.* in config')
+    return creds
+
+
+def ssh_key_path() -> str:
+    import os
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'keys', 'azure', 'skyt-azure-key')
+
+
+def ensure_ssh_keypair() -> tuple:
+    import os
+    import shutil
+    import subprocess
+    key_path = ssh_key_path()
+    pub_path = key_path + '.pub'
+    if not os.path.exists(key_path):
+        os.makedirs(os.path.dirname(key_path), exist_ok=True)
+        if not shutil.which('ssh-keygen'):
+            raise exceptions.ProvisionError(
+                'ssh-keygen not available; cannot generate the Azure '
+                'cluster SSH keypair')
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+             '-C', 'skyt-azure', '-f', key_path], check=True)
+    with open(pub_path, encoding='utf-8') as f:
+        return key_path, f.read().strip()
+
+
+@CLOUD_REGISTRY.register('azure')
+class AzureProvider(Provider):
+    """One resource group per cluster; every host is one VM."""
+
+    name = 'azure'
+    _token_cache: Dict[str, tuple] = {}
+
+    @classmethod
+    def unsupported_features(cls) -> Dict[CloudCapability, str]:
+        return {
+            CloudCapability.VOLUMES:
+                'managed-disk volume provisioning is not wired up yet',
+        }
+
+    # -- transport (stubbed in tests) ----------------------------------
+
+    def _token(self) -> str:
+        creds = credentials()
+        cache_key = f'{creds["tenant"]}/{creds["client"]}'
+        cached = self._token_cache.get(cache_key)
+        if cached and cached[1] - 60 > time.time():
+            return cached[0]
+        body = urllib.parse.urlencode({
+            'grant_type': 'client_credentials',
+            'client_id': creds['client'],
+            'client_secret': creds['secret'],
+            'scope': f'{ARM}/.default',
+        }).encode()
+        url = (f'https://login.microsoftonline.com/{creds["tenant"]}'
+               f'/oauth2/v2.0/token')
+        req = urllib.request.Request(url, data=body, method='POST')
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise exceptions.NoCloudAccessError(
+                f'Azure token request failed: '
+                f'{e.read().decode(errors="replace")[:300]}') from None
+        except urllib.error.URLError as e:
+            # Typed so provision_with_failover moves to the next cloud
+            # instead of crashing on a raw socket error.
+            raise exceptions.ProvisionError(
+                f'Azure token endpoint unreachable: {e}') from None
+        token = payload['access_token']
+        self._token_cache[cache_key] = (
+            token, time.time() + float(payload.get('expires_in', 3600)))
+        return token
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 api_version: str = COMPUTE_API) -> Dict[str, Any]:
+        """One ARM call; path is subscription-relative or absolute."""
+        creds = credentials()
+        if not path.startswith('/subscriptions'):
+            path = f'/subscriptions/{creds["subscription"]}{path}'
+        sep = '&' if '?' in path else '?'
+        url = f'{ARM}{path}{sep}api-version={api_version}'
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={'Authorization': f'Bearer {self._token()}',
+                     'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as e:
+            text = e.read().decode('utf-8', errors='replace')
+            try:
+                err = json.loads(text).get('error', {})
+                code, msg = err.get('code', str(e.code)), err.get(
+                    'message', text[:300])
+            except (ValueError, AttributeError):
+                code, msg = str(e.code), text[:300]
+            if e.code == 404 and method == 'GET':
+                raise exceptions.ProvisionError(
+                    f'NotFound: {msg}') from None
+            raise classify_azure_error(code, msg) from None
+        except exceptions.ProvisionError:
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            raise exceptions.ProvisionError(
+                f'ARM {method} {path} failed: {e}') from e
+
+    def _get_optional(self, path: str,
+                      api_version: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self._request('GET', path, api_version=api_version)
+        except exceptions.ProvisionError as e:
+            if 'NotFound' in str(e) or 'ResourceGroupNotFound' in str(e):
+                return None
+            raise
+
+    # -- naming --------------------------------------------------------
+
+    @staticmethod
+    def _rg(cluster_name: str) -> str:
+        return f'skyt-{cluster_name}'
+
+    def _rg_path(self, cluster_name: str) -> str:
+        return f'/resourceGroups/{self._rg(cluster_name)}'
+
+    def _net_path(self, cluster_name: str, kind: str, name: str) -> str:
+        return (f'{self._rg_path(cluster_name)}/providers/'
+                f'Microsoft.Network/{kind}/{name}')
+
+    def _vm_path(self, cluster_name: str, vm: str) -> str:
+        return (f'{self._rg_path(cluster_name)}/providers/'
+                f'Microsoft.Compute/virtualMachines/{vm}')
+
+    # -- network scaffolding -------------------------------------------
+
+    def _ensure_network(self, request: ProvisionRequest,
+                        region: str) -> str:
+        """RG + vnet + NSG; returns the subnet resource id."""
+        cluster = request.cluster_name
+        self._request('PUT', self._rg_path(cluster),
+                      {'location': region,
+                       'tags': {'skyt-cluster': cluster}},
+                      api_version=RESOURCE_API)
+        nsg_rules = [{
+            'name': 'skyt-allow-ssh',
+            'properties': {
+                'priority': 1000, 'direction': 'Inbound',
+                'access': 'Allow', 'protocol': 'Tcp',
+                'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                'destinationAddressPrefix': '*',
+                'destinationPortRange': '22',
+            },
+        }]
+        for i, port in enumerate(request.ports or []):
+            nsg_rules.append({
+                'name': f'skyt-port-{port}',
+                'properties': {
+                    'priority': 1100 + i, 'direction': 'Inbound',
+                    'access': 'Allow', 'protocol': 'Tcp',
+                    'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                    'destinationAddressPrefix': '*',
+                    'destinationPortRange': str(port),
+                },
+            })
+        nsg = self._request(
+            'PUT', self._net_path(cluster, 'networkSecurityGroups',
+                                  'skyt-nsg'),
+            {'location': region,
+             'properties': {'securityRules': nsg_rules}},
+            api_version=NETWORK_API)
+        vnet = self._request(
+            'PUT', self._net_path(cluster, 'virtualNetworks', 'skyt-vnet'),
+            {'location': region,
+             'properties': {
+                 'addressSpace': {'addressPrefixes': ['10.20.0.0/16']},
+                 'subnets': [{
+                     'name': 'default',
+                     'properties': {
+                         'addressPrefix': '10.20.0.0/24',
+                         'networkSecurityGroup': {'id': nsg['id']},
+                     },
+                 }],
+             }},
+            api_version=NETWORK_API)
+        return vnet['properties']['subnets'][0]['id']
+
+    def _create_nic(self, cluster: str, region: str, node: int,
+                    subnet_id: str) -> str:
+        ip = self._request(
+            'PUT', self._net_path(cluster, 'publicIPAddresses',
+                                  f'{cluster}-n{node}-ip'),
+            {'location': region,
+             'sku': {'name': 'Standard'},
+             'properties': {'publicIPAllocationMethod': 'Static'}},
+            api_version=NETWORK_API)
+        nic = self._request(
+            'PUT', self._net_path(cluster, 'networkInterfaces',
+                                  f'{cluster}-n{node}-nic'),
+            {'location': region,
+             'properties': {'ipConfigurations': [{
+                 'name': 'primary',
+                 'properties': {
+                     'subnet': {'id': subnet_id},
+                     'publicIPAddress': {'id': ip['id']},
+                 },
+             }]}},
+            api_version=NETWORK_API)
+        return nic['id']
+
+    # -- instance selection --------------------------------------------
+
+    @staticmethod
+    def _vm_size(resources) -> str:
+        from skypilot_tpu.catalog import azure_data
+        if resources.instance_type:
+            return resources.instance_type
+        accels = resources.accelerators
+        if accels:
+            (name, count), = accels.items()
+            picked = azure_data.instance_type_for(name, count)
+            if picked is None:
+                raise exceptions.ProvisionError(
+                    f'no Azure VM size for {count}x {name}; known: '
+                    f'{sorted(azure_data.GPU_INSTANCE_TYPES)}')
+            return picked[0]
+        from skypilot_tpu.catalog.common import pick_cpu_instance_type
+        cpus = resources.cpus[0] if resources.cpus else None
+        mem = resources.memory[0] if resources.memory else None
+        return pick_cpu_instance_type(cpus, mem, cloud='azure')
+
+    # -- Provider API --------------------------------------------------
+
+    def run_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        from skypilot_tpu.catalog import azure_data
+        cluster, region = request.cluster_name, request.region
+        existing = self._list_vms(cluster)
+        if request.resume and existing:
+            for vm in existing:
+                if self._power_state(cluster, vm['name']) == 'deallocated':
+                    self._request(
+                        'POST', self._vm_path(cluster, vm['name']) +
+                        '/start', {})
+            return self._cluster_info(cluster, region)
+        if existing:
+            raise exceptions.ProvisionError(
+                f'cluster {cluster} already has VMs; use resume or '
+                'terminate first')
+        _, pub_key = ensure_ssh_keypair()
+        subnet_id = self._ensure_network(request, region)
+        size = self._vm_size(request.resources)
+        for node in range(request.num_nodes):
+            nic_id = self._create_nic(cluster, region, node, subnet_id)
+            body: Dict[str, Any] = {
+                'location': region,
+                'tags': {'skyt-cluster': cluster, 'skyt-node': str(node),
+                         **request.labels},
+                'properties': {
+                    'hardwareProfile': {'vmSize': size},
+                    'storageProfile': {
+                        'imageReference': dict(azure_data.DEFAULT_IMAGE),
+                        'osDisk': {
+                            'createOption': 'FromImage',
+                            'deleteOption': 'Delete',
+                            'diskSizeGB': request.resources.disk_size,
+                        },
+                    },
+                    'osProfile': {
+                        'computerName': f'{cluster}-n{node}',
+                        'adminUsername': SSH_USER,
+                        'linuxConfiguration': {
+                            'disablePasswordAuthentication': True,
+                            'ssh': {'publicKeys': [{
+                                'path': (f'/home/{SSH_USER}/.ssh/'
+                                         'authorized_keys'),
+                                'keyData': pub_key,
+                            }]},
+                        },
+                    },
+                    'networkProfile': {'networkInterfaces': [{
+                        'id': nic_id,
+                        'properties': {'deleteOption': 'Delete'},
+                    }]},
+                },
+            }
+            if request.zone:
+                body['zones'] = [str(request.zone)]
+            if request.resources.use_spot:
+                body['properties']['priority'] = 'Spot'
+                body['properties']['evictionPolicy'] = 'Deallocate'
+                body['properties']['billingProfile'] = {'maxPrice': -1}
+            self._request('PUT', self._vm_path(cluster,
+                                               f'{cluster}-n{node}'),
+                          body)
+        self._wait_provisioned(cluster, request.num_nodes)
+        logger.info('Azure: launched %d x %s in %s for %s',
+                    request.num_nodes, size, region, cluster)
+        return self._cluster_info(cluster, region)
+
+    def _wait_provisioned(self, cluster: str, num_nodes: int,
+                          timeout: float = 900.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            vms = self._list_vms(cluster)
+            states = [vm.get('properties', {}).get('provisioningState')
+                      for vm in vms]
+            if len(vms) >= num_nodes and all(
+                    s == 'Succeeded' for s in states):
+                return
+            failed = [vm['name'] for vm, s in zip(vms, states)
+                      if s == 'Failed']
+            if failed:
+                raise exceptions.CapacityError(
+                    f'Azure VM provisioning failed for {failed} '
+                    '(treating as capacity for failover)')
+            time.sleep(5.0)
+        raise exceptions.CapacityError(
+            f'{cluster}: VMs not provisioned within {timeout}s')
+
+    # -- inventory -----------------------------------------------------
+
+    def _list_vms(self, cluster: str) -> List[Dict[str, Any]]:
+        resp = self._get_optional(
+            f'{self._rg_path(cluster)}/providers/Microsoft.Compute'
+            '/virtualMachines', COMPUTE_API)
+        if resp is None:
+            return []
+        vms = [vm for vm in resp.get('value', [])
+               if vm.get('tags', {}).get('skyt-cluster') == cluster]
+        vms.sort(key=lambda vm: int(vm.get('tags', {}).get('skyt-node',
+                                                           0)))
+        return vms
+
+    def _power_state(self, cluster: str, vm_name: str) -> str:
+        view = self._get_optional(
+            self._vm_path(cluster, vm_name) + '/instanceView',
+            COMPUTE_API) or {}
+        for status in view.get('statuses', []):
+            code = status.get('code', '')
+            if code.startswith('PowerState/'):
+                return code.split('/', 1)[1]
+        return 'unknown'
+
+    def _ip_of(self, cluster: str, node: int) -> tuple:
+        nic = self._get_optional(
+            self._net_path(cluster, 'networkInterfaces',
+                           f'{cluster}-n{node}-nic'), NETWORK_API) or {}
+        configs = nic.get('properties', {}).get('ipConfigurations', [])
+        private = public = None
+        for cfg in configs:
+            props = cfg.get('properties', {})
+            private = private or props.get('privateIPAddress')
+            ip_ref = props.get('publicIPAddress')
+            if ip_ref:
+                ip = self._get_optional(
+                    self._net_path(cluster, 'publicIPAddresses',
+                                   f'{cluster}-n{node}-ip'),
+                    NETWORK_API) or {}
+                public = ip.get('properties', {}).get('ipAddress')
+        return private, public
+
+    def _cluster_info(self, cluster: str, region: str) -> ClusterInfo:
+        hosts = []
+        for vm in self._list_vms(cluster):
+            node = int(vm.get('tags', {}).get('skyt-node', 0))
+            private, public = self._ip_of(cluster, node)
+            hosts.append(HostInfo(
+                instance_id=vm['name'],
+                internal_ip=private or '',
+                external_ip=public,
+                node_index=node,
+                worker_index=0,
+                tags=vm.get('tags', {}),
+            ))
+        return ClusterInfo(
+            cluster_name=cluster, provider='azure', region=region,
+            zone=None, hosts=hosts, ssh_user=SSH_USER,
+            ssh_key_path=ssh_key_path())
+
+    def _region_of(self, cluster: str) -> Optional[str]:
+        from skypilot_tpu import state
+        record = state.get_cluster(cluster)
+        if record and record.handle.get('provider') == 'azure':
+            return record.handle.get('region')
+        return None
+
+    def get_cluster_info(self, cluster_name: str) -> Optional[ClusterInfo]:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return None
+        info = self._cluster_info(cluster_name, region)
+        return info if info.hosts else None
+
+    def query_instances(self, cluster_name: str) -> Dict[str, str]:
+        state_map = {
+            'running': 'running', 'starting': 'starting',
+            'deallocated': 'stopped', 'deallocating': 'stopping',
+            'stopped': 'stopped', 'stopping': 'stopping',
+        }
+        out = {}
+        for vm in self._list_vms(cluster_name):
+            power = self._power_state(cluster_name, vm['name'])
+            out[vm['name']] = state_map.get(power, power)
+        return out
+
+    def stop_instances(self, cluster_name: str) -> None:
+        for vm in self._list_vms(cluster_name):
+            # Deallocate (not powerOff): releases compute billing, the
+            # semantic `skyt stop` promises.
+            self._request(
+                'POST',
+                self._vm_path(cluster_name, vm['name']) + '/deallocate',
+                {})
+
+    def terminate_instances(self, cluster_name: str) -> None:
+        # The RG owns every cluster resource: one delete, no orphan
+        # NIC/IP/disk sweep (deleteOption=Delete covers the VM-attached
+        # ones; the RG covers the rest).
+        if self._get_optional(self._rg_path(cluster_name),
+                              RESOURCE_API) is None:
+            return
+        self._request('DELETE', self._rg_path(cluster_name),
+                      api_version=RESOURCE_API)
+
+    def open_ports(self, cluster_name: str, ports: List[str]) -> None:
+        region = self._region_of(cluster_name)
+        if region is None:
+            return
+        for i, port in enumerate(ports):
+            self._request(
+                'PUT',
+                self._net_path(cluster_name, 'networkSecurityGroups',
+                               'skyt-nsg') +
+                f'/securityRules/skyt-open-{port}',
+                {'properties': {
+                    'priority': 1200 + i, 'direction': 'Inbound',
+                    'access': 'Allow', 'protocol': 'Tcp',
+                    'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                    'destinationAddressPrefix': '*',
+                    'destinationPortRange': str(port),
+                }},
+                api_version=NETWORK_API)
