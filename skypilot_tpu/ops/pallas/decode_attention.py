@@ -119,26 +119,33 @@ def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         # Clamp to the last block that holds valid rows: skipped steps
         # re-map to an already-fetched block => the DMA is elided.
         last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
-        return (bi, jnp.minimum(ti, last), hi, 0)
+        return (bi, jnp.minimum(ti, last), hi)
 
     def scale_index(bi, hi, ti, n_valid):
         last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
-        return (bi, hi, jnp.minimum(ti, last))
+        return (bi, hi, jnp.minimum(ti, last), 0)
 
+    # Mosaic validates the LAST TWO dims of every block against the
+    # (8, 128) tile — a squeezed kv-head dim there is rejected. The
+    # caches view as [B, T, KVH*D] (contiguous minor dims, no copy) so
+    # the trailing block dims are (block_k, d) and the head is selected
+    # by the Blocked index hi (offset hi*d), identical DMA pattern.
+    kv_view = (b, t, kvh * d)
     in_specs = [
         pl.BlockSpec((None, None, g, d),
                      lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
-        pl.BlockSpec((None, block_k, None, d), kv_index),
-        pl.BlockSpec((None, block_k, None, d), kv_index),
+        pl.BlockSpec((None, block_k, d), kv_index),
+        pl.BlockSpec((None, block_k, d), kv_index),
     ]
-    operands = [q, k_cache, v_cache]
+    operands = [q, k_cache.reshape(kv_view), v_cache.reshape(kv_view)]
     if k_scale is not None:
-        # Scales arrive [B, KVH, T]: T minor-most so the lane dim is
-        # tiled in block_k multiples (Mosaic rejects a squeezed minor
-        # dim; same convention as flash_attention's segment refs).
-        in_specs += [pl.BlockSpec((None, None, block_k), scale_index),
-                     pl.BlockSpec((None, None, block_k), scale_index)]
-        operands += [k_scale, v_scale]
+        # Scales arrive [B, KVH, T]; a trailing singleton makes the
+        # checked trailing dims (block_k, 1) — block_k is a lane-tile
+        # multiple and 1 equals its array dim.
+        in_specs += [
+            pl.BlockSpec((None, None, block_k, None), scale_index),
+            pl.BlockSpec((None, None, block_k, None), scale_index)]
+        operands += [k_scale[..., None], v_scale[..., None]]
         kernel = functools.partial(_decode_kernel_quant, block_k=block_k,
                                    scale=scale, num_blocks=nt)
     else:
