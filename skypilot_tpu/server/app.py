@@ -129,6 +129,14 @@ class ApiHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str) -> None:
         self._reply({'error': message}, code)
 
+    def _reply_text(self, text: str, code: int = 200) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'text/plain; charset=utf-8')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     @property
     def _query(self) -> Dict[str, str]:
         parsed = urllib.parse.urlparse(self.path)
@@ -590,13 +598,40 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                                 f'job_id must be an integer, got '
                                 f'{raw_id!r}')
                     return
-                body = dashboard.job_log_tail(job_id).encode()
-                self.send_response(200)
-                self.send_header('Content-Type',
-                                 'text/plain; charset=utf-8')
-                self.send_header('Content-Length', str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reply_text(dashboard.job_log_tail(job_id))
+            elif route == '/api/dashboard/cluster':
+                from skypilot_tpu.server import dashboard
+                self._reply(dashboard.cluster_detail(
+                    self._query.get('name', '')))
+            elif route == '/api/dashboard/cluster-job-log':
+                from skypilot_tpu.server import dashboard
+                raw_id = self._query.get('job_id', '0')
+                try:
+                    job_id = int(raw_id)
+                except ValueError:
+                    self._error(HTTPStatus.BAD_REQUEST,
+                                f'job_id must be an integer, got '
+                                f'{raw_id!r}')
+                    return
+                self._reply_text(dashboard.cluster_job_log(
+                    self._query.get('name', ''), job_id))
+            elif route == '/api/dashboard/service':
+                from skypilot_tpu.server import dashboard
+                self._reply(dashboard.service_detail(
+                    self._query.get('name', '')))
+            elif route == '/api/dashboard/catalog':
+                from skypilot_tpu.server import dashboard
+                self._reply(dashboard.catalog_data())
+            elif route == '/api/dashboard/cost':
+                from skypilot_tpu.server import dashboard
+                self._reply(dashboard.cost_data())
+            elif route == '/api/dashboard/recipes':
+                from skypilot_tpu.server import dashboard
+                self._reply(dashboard.recipes_data())
+            elif route == '/api/dashboard/recipe':
+                from skypilot_tpu.server import dashboard
+                self._reply_text(dashboard.recipe_yaml(
+                    self._query.get('name', '')))
             elif route == '/api/metrics':
                 from skypilot_tpu.server import metrics
                 body = metrics.render_text().encode()

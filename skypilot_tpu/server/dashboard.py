@@ -56,6 +56,105 @@ def job_log_tail(job_id: int, max_bytes: int = 64 * 1024) -> str:
         return f'(no controller log at {path})'
 
 
+def cluster_detail(name: str) -> Dict[str, Any]:
+    """Everything `skyt status`/`queue`/`ssh-info` shows for one
+    cluster: record, hosts, event history, and the cluster job queue
+    (drill-down page; ref dashboard src/pages/clusters/[cluster])."""
+    from skypilot_tpu import core, state
+    record = state.get_cluster(name)
+    if record is None:
+        return {'error': f'no cluster {name!r}'}
+    hosts = [{
+        'instance_id': h.get('instance_id'),
+        'internal_ip': h.get('internal_ip'),
+        'external_ip': h.get('external_ip'),
+        'node': h.get('node_index'),
+        'worker': h.get('worker_index'),
+    } for h in record.handle.get('hosts', [])]
+    try:
+        queue = core.queue(name)
+    except Exception as e:  # pylint: disable=broad-except
+        queue = []
+        queue_error = str(e)
+    else:
+        queue_error = None
+    return {
+        'name': record.name,
+        'status': record.status.value,
+        'cloud': record.cloud,
+        'region': record.region,
+        'zone': record.zone,
+        'workspace': record.workspace,
+        'resources': record.resources,
+        'autostop': record.autostop,
+        'hourly_cost': record.hourly_cost,
+        'launched_at': record.launched_at,
+        'hosts': hosts,
+        'events': state.get_cluster_events(name),
+        'queue': queue,
+        'queue_error': queue_error,
+    }
+
+
+def cluster_job_log(name: str, job_id: int,
+                    max_bytes: int = 64 * 1024) -> str:
+    """Rank-0 log of a cluster job (`skyt logs` equivalent); the SPA
+    polls this for its live-tail panel."""
+    import io
+    from skypilot_tpu import state
+    from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+    from skypilot_tpu.provision.api import ClusterInfo
+    record = state.get_cluster(name)
+    if record is None:
+        return f'(no cluster {name!r})'
+    buf = io.StringIO()
+    try:
+        TpuPodBackend().tail_logs(ClusterInfo.from_dict(record.handle),
+                                  int(job_id), stream=buf, follow=False)
+    except Exception as e:  # pylint: disable=broad-except
+        return f'(no log: {e})'
+    text = buf.getvalue()
+    return text[-max_bytes:]
+
+
+def service_detail(name: str) -> Dict[str, Any]:
+    """Per-replica rows for one service/pool (`skyt serve status`)."""
+    from skypilot_tpu.serve import serve_state
+    record = serve_state.get_service(name)
+    if record is None:
+        return {'error': f'no service {name!r}'}
+    return record.to_dict()
+
+
+def catalog_data() -> 'list[Dict[str, Any]]':
+    """Accelerator -> regions (`skyt show-tpus`)."""
+    from skypilot_tpu import catalog
+    return [{'accelerator': accel, 'regions': ', '.join(regions)}
+            for accel, regions in
+            sorted(catalog.list_accelerators().items())]
+
+
+def cost_data() -> 'list[Dict[str, Any]]':
+    from skypilot_tpu import core
+    return core.cost_report()
+
+
+def recipes_data() -> 'list[Dict[str, Any]]':
+    from skypilot_tpu import recipes
+    return [{'name': r['name'], 'description': r['description']}
+            for r in recipes.list_recipes()]
+
+
+def recipe_yaml(name: str) -> str:
+    from skypilot_tpu import recipes
+    try:
+        path = recipes.resolve(name)
+    except Exception as e:  # pylint: disable=broad-except
+        return f'(unknown recipe {name!r}: {e})'
+    with open(path, encoding='utf-8') as f:
+        return f.read()
+
+
 def collect_data(request_filter=None) -> Dict[str, Any]:
     """Everything the dashboard shows, in one JSON document.
 
@@ -148,139 +247,315 @@ DASHBOARD_HTML = """<!doctype html>
 <title>skypilot-tpu dashboard</title>
 <style>
   :root { color-scheme: light dark; }
-  body { font: 14px/1.45 system-ui, sans-serif; margin: 2rem auto;
-         max-width: 1100px; padding: 0 1rem; }
-  h1 { font-size: 1.3rem; }
-  h2 { font-size: 1.05rem; margin: 1.6rem 0 .4rem; }
+  body { font: 14px/1.45 system-ui, sans-serif; margin: 0; display: flex;
+         min-height: 100vh; }
+  nav { width: 170px; flex: none; padding: 1rem .6rem; border-right:
+        1px solid color-mix(in srgb, currentColor 15%, transparent); }
+  nav .brand { font-weight: 700; margin: 0 .4rem .8rem; }
+  nav a { display: block; padding: .3rem .6rem; border-radius: 6px;
+          color: inherit; text-decoration: none; }
+  nav a.active { background: color-mix(in srgb, currentColor 12%, transparent);
+                 font-weight: 600; }
+  nav .count { float: right; opacity: .55; font-size: .78rem; }
+  main { flex: 1; padding: 1.2rem 1.6rem; max-width: 1100px; min-width: 0; }
+  h1 { font-size: 1.15rem; margin: 0 0 .2rem; }
+  h2 { font-size: 1rem; margin: 1.4rem 0 .4rem; }
   table { border-collapse: collapse; width: 100%; }
   th, td { text-align: left; padding: .3rem .6rem;
            border-bottom: 1px solid color-mix(in srgb, currentColor 18%, transparent); }
   th { font-weight: 600; opacity: .7; text-transform: uppercase;
        font-size: .72rem; letter-spacing: .04em; }
+  tr.click { cursor: pointer; }
+  tr.click:hover { background: color-mix(in srgb, currentColor 7%, transparent); }
   .pill { padding: .05rem .5rem; border-radius: 99px; font-size: .8rem;
-          border: 1px solid currentColor; }
-  .UP, .READY, .SUCCEEDED, .RUNNING { color: #2e7d32; }
-  .INIT, .PENDING, .STARTING, .RECOVERING, .REPLICA_INIT { color: #b26a00; }
-  .STOPPED { color: #666; }
-  .FAILED, .FAILED_PROVISION, .CANCELLED, .CONTROLLER_FAILED { color: #c62828; }
+          border: 1px solid currentColor; white-space: nowrap; }
+  .UP, .READY, .SUCCEEDED, .RUNNING, .ENABLED, .ALIVE { color: #2e7d32; }
+  .INIT, .PENDING, .STARTING, .RECOVERING, .REPLICA_INIT, .SETTING_UP,
+  .LAUNCHING, .WAITING, .CANCELLING, .PROVISIONING { color: #b26a00; }
+  .STOPPED, .DISABLED { color: #777; }
+  .FAILED, .FAILED_PROVISION, .FAILED_SETUP, .FAILED_NO_RESOURCE,
+  .FAILED_CONTROLLER, .CANCELLED, .CONTROLLER_FAILED, .NOT_READY,
+  .SHUTTING_DOWN { color: #c62828; }
   .muted { opacity: .6; }
-  #updated { font-size: .8rem; opacity: .6; }
+  #updated { font-size: .8rem; opacity: .6; margin-bottom: .6rem; }
+  #panel { display: none; position: fixed; inset: 6% 8%; overflow: auto;
+           border: 1px solid currentColor; border-radius: 8px;
+           background: Canvas; padding: 1rem 1.2rem; z-index: 10; }
+  #panel pre { white-space: pre-wrap; font-size: .8rem; }
+  #logbox { white-space: pre-wrap; font-size: .8rem; max-height: 55vh;
+            overflow: auto; border: 1px solid
+            color-mix(in srgb, currentColor 25%, transparent);
+            border-radius: 6px; padding: .6rem; }
 </style>
 </head>
 <body>
-<h1>skypilot-tpu <span class="muted">dashboard</span></h1>
-<div id="updated">loading…</div>
-<div id="panel" style="display:none; position:fixed; inset:8% 10%;
-     overflow:auto; border:1px solid currentColor; border-radius:8px;
-     background:Canvas; padding:1rem; z-index:10;">
+<nav>
+  <div class="brand">skypilot-tpu</div>
+  <div id="nav"></div>
+</nav>
+<main>
+  <h1 id="page-title"></h1>
+  <div id="updated">loading…</div>
+  <div id="content"></div>
+</main>
+<div id="panel">
   <a href="#" onclick="return hidePanel()" style="float:right">close</a>
   <h2 id="panel-title"></h2>
-  <pre id="panel-body" style="white-space:pre-wrap; font-size:.8rem;"></pre>
+  <div id="panel-body"></div>
 </div>
-<div id="content"></div>
 <script>
-const SECTIONS = [
-  ['Infra', 'infra', ['cloud','status','detail','limits']],
-  ['Clusters', 'clusters', ['name','status','cloud','region','resources','nodes','workspace','hourly_cost','age']],
-  ['Managed jobs', 'jobs', ['job_id','name','status','cluster_name','recoveries','logs']],
-  ['Services', 'services', ['name','status','replicas']],
-  ['Pools', 'pools', ['name','status','replicas']],
-  ['Volumes', 'volumes', ['name','type','size_gb','status','attached']],
-  ['Workspaces', 'workspaces', ['name','allowed_clouds']],
-  ['Users', 'users', ['name','role']],
-  ['Workspace role bindings', 'bindings', ['workspace','user_name','role']],
-  ['Recent requests', 'requests', ['short_id','name','status','user','workspace','detail']],
+// Hash-routed no-build SPA over the /api/dashboard/* JSON API. Every
+// CLI read verb has a page or drill-down here: status/queue/logs ->
+// Clusters (+detail), jobs queue/logs -> Jobs, serve status/logs ->
+// Serve, check -> Infra, show-tpus -> Catalog, cost-report -> Cost,
+// recipes list/show -> Recipes, api status/get/logs -> Requests,
+// users/workspaces/volumes -> their pages.
+const PAGES = [
+  ['clusters',   'Clusters'],
+  ['jobs',       'Managed jobs'],
+  ['serve',      'Serve'],
+  ['infra',      'Infra'],
+  ['volumes',    'Volumes'],
+  ['workspaces', 'Workspaces'],
+  ['requests',   'Requests'],
+  ['catalog',    'Catalog'],
+  ['cost',       'Cost'],
+  ['recipes',    'Recipes'],
 ];
+let DATA = null;          // /api/dashboard/data snapshot (for counts)
+let logTimer = null;      // live-tail poller for the open log panel
+
+function esc(v) {
+  return String(v).replace(/[&<>"']/g, c => ({
+    '&':'&amp;', '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
+}
 function fmtAge(s) {
   if (s == null) return '';
   if (s < 90) return Math.round(s) + 's';
   if (s < 5400) return Math.round(s/60) + 'm';
   return (s/3600).toFixed(1) + 'h';
 }
-function esc(v) {
-  // Names/users are free-form user input; escape EVERYTHING rendered
-  // into innerHTML (stored-XSS guard).
-  return String(v).replace(/[&<>"']/g, c => ({
-    '&':'&amp;', '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
+function pill(v) {
+  return `<span class="pill ${/^[A-Z_]+$/.test(v||'') ? esc(v) : ''}">` +
+         esc(v == null ? '' : v) + '</span>';
 }
-const STATUS_CLASSES = new Set(['UP','READY','SUCCEEDED','RUNNING','INIT',
-  'PENDING','STARTING','RECOVERING','REPLICA_INIT','STOPPED','FAILED',
-  'FAILED_PROVISION','CANCELLED','CONTROLLER_FAILED','ENABLED','DISABLED']);
-function cell(row, col) {
-  if (col === 'age') return fmtAge(row.age_s);
-  if (col === 'attached') return esc((row.attached_to||[]).join(', '));
-  if (col === 'logs')  // managed-job controller log drill-down
-    return `<a href="#" onclick="return showJobLog(${Number(row.job_id)||0})">view</a>`;
-  if (col === 'detail' && row.request_id)  // request drill-down
-    return `<a href="#" onclick="return showRequest('${esc(row.request_id)}')">open</a>`;
-  if (col === 'status') {
-    const v = String(row.status || '');
-    const cls = STATUS_CLASSES.has(v) ? v : '';
-    return `<span class="pill ${cls}">${esc(v)}</span>`;
+function table(rows, cols, rowAttr) {
+  if (!rows || !rows.length) return '<div class="muted">none</div>';
+  let html = '<table><tr>' +
+    cols.map(c => `<th>${esc(c.label || c.key)}</th>`).join('') + '</tr>';
+  for (const row of rows) {
+    const attr = rowAttr ? rowAttr(row) : '';
+    html += `<tr ${attr}>` + cols.map(c => {
+      const v = c.fmt ? c.fmt(row) : row[c.key];
+      if (c.key === 'status' && !c.fmt) return `<td>${pill(v)}</td>`;
+      return `<td>${v == null ? '' : (c.raw ? v : esc(v))}</td>`;
+    }).join('') + '</tr>';
   }
-  const v = row[col];
-  return v === null || v === undefined ? '' : esc(v);
+  return html + '</table>';
 }
-async function showPanel(title, loader) {
-  const panel = document.getElementById('panel');
-  const body = document.getElementById('panel-body');
+async function getJSON(url) {
+  const r = await fetch(url, {headers: window.SKYT_TOKEN ?
+    {Authorization: 'Bearer ' + window.SKYT_TOKEN} : {}});
+  if (!r.ok) throw new Error('HTTP ' + r.status);
+  return await r.json();
+}
+async function getText(url) {
+  const r = await fetch(url, {headers: window.SKYT_TOKEN ?
+    {Authorization: 'Bearer ' + window.SKYT_TOKEN} : {}});
+  return await r.text();
+}
+
+// -- panels ------------------------------------------------------------
+function showPanel(title, html) {
   document.getElementById('panel-title').textContent = title;
-  body.textContent = 'loading…';
-  panel.style.display = 'block';
-  try { body.textContent = await loader(); }
-  catch (e) { body.textContent = 'error: ' + e; }
+  document.getElementById('panel-body').innerHTML = html;
+  document.getElementById('panel').style.display = 'block';
   return false;
 }
 function hidePanel() {
   document.getElementById('panel').style.display = 'none';
+  if (logTimer) { clearInterval(logTimer); logTimer = null; }
   return false;
 }
-function showJobLog(jobId) {
-  return showPanel('controller log — job ' + jobId, async () => {
-    const r = await fetch('/api/dashboard/job-log?job_id=' + jobId);
-    return await r.text();
-  });
-}
-function showRequest(requestId) {
-  return showPanel('request ' + requestId.slice(0, 8), async () => {
-    const rec = await (await fetch(
-      '/api/get?request_id=' + requestId + '&timeout=0')).json();
-    let log = '';
-    try {
-      log = await (await fetch('/api/stream?request_id=' + requestId +
-                               '&follow=false')).text();
-    } catch (e) { log = '(no log: ' + e + ')'; }
-    return JSON.stringify(rec, null, 2) + '\\n\\n--- log ---\\n' + log;
-  });
-}
-function render(data) {
-  let html = '';
-  for (const [title, key, cols] of SECTIONS) {
-    const rows = data[key] || [];
-    html += `<h2>${title} <span class="muted">(${rows.length})</span></h2>`;
-    if (!rows.length) { html += '<div class="muted">none</div>'; continue; }
-    html += '<table><tr>' + cols.map(c => `<th>${c}</th>`).join('') + '</tr>';
-    for (const row of rows) {
-      html += '<tr>' + cols.map(c => `<td>${cell(row, c)}</td>`).join('') + '</tr>';
+function showLog(title, url) {
+  showPanel(title,
+    '<label><input type="checkbox" id="follow" checked> follow</label>' +
+    '<div id="logbox" class="muted">loading…</div>');
+  const poll = async () => {
+    const box = document.getElementById('logbox');
+    if (!box) return;
+    const text = await getText(url);
+    const stick = box.scrollTop + box.clientHeight >= box.scrollHeight - 8;
+    box.textContent = text || '(empty)';
+    box.classList.remove('muted');
+    if (stick) box.scrollTop = box.scrollHeight;
+    const follow = document.getElementById('follow');
+    if (logTimer && (!follow || !follow.checked)) {
+      clearInterval(logTimer); logTimer = null;
     }
-    html += '</table>';
-  }
-  document.getElementById('content').innerHTML = html;
-  document.getElementById('updated').textContent =
-    'updated ' + new Date(data.generated_at * 1000).toLocaleTimeString();
+  };
+  poll();
+  logTimer = setInterval(poll, 2000);   // live tail: re-poll while open
+  return false;
 }
-async function tick() {
+async function showCluster(name) {
+  const d = await getJSON('/api/dashboard/cluster?name=' +
+                          encodeURIComponent(name));
+  if (d.error) return showPanel(name, `<div>${esc(d.error)}</div>`);
+  let html = `<div>${pill(d.status)} ${esc(d.cloud||'')} ` +
+    `${esc(d.region||'')} · workspace ${esc(d.workspace)} · ` +
+    `$${(d.hourly_cost||0).toFixed(2)}/h</div>`;
+  html += '<h2>Job queue</h2>' + table(d.queue, [
+    {key:'job_id', label:'id'}, {key:'name'}, {key:'status'},
+    {key:'log', label:'log', raw:true, fmt: r =>
+      `<a href="#" onclick="return showLog('job ${Number(r.job_id)||0} log',` +
+      `'/api/dashboard/cluster-job-log?name=${encodeURIComponent(name)}` +
+      `&job_id=${Number(r.job_id)||0}')">view</a>`},
+  ]);
+  if (d.queue_error) html += `<div class="muted">${esc(d.queue_error)}</div>`;
+  html += '<h2>Hosts</h2>' + table(d.hosts, [
+    {key:'node'}, {key:'worker'}, {key:'instance_id'},
+    {key:'internal_ip'}, {key:'external_ip'}]);
+  html += '<h2>Events</h2>' + table((d.events||[]).slice(-30).reverse(), [
+    {key:'event'}, {key:'detail'},
+    {key:'ts', label:'when', fmt: r =>
+      r.ts ? new Date(r.ts * 1000).toLocaleString() : ''}]);
+  html += '<h2>Resources</h2><pre>' +
+    esc(JSON.stringify(d.resources, null, 2)) + '</pre>';
+  return showPanel(name, html);
+}
+async function showService(name) {
+  const d = await getJSON('/api/dashboard/service?name=' +
+                          encodeURIComponent(name));
+  if (d.error) return showPanel(name, `<div>${esc(d.error)}</div>`);
+  let html = `<div>${pill(d.status)}</div><h2>Replicas</h2>` +
+    table(d.replicas || [], [
+      {key:'replica_id', label:'id'}, {key:'status'},
+      {key:'cluster_name', label:'cluster'},
+      {key:'url', fmt: r => r.url || ''},
+    ]);
+  html += '<h2>Spec</h2><pre>' +
+    esc(JSON.stringify(d.spec, null, 2)) + '</pre>';
+  return showPanel(name, html);
+}
+async function showRequest(requestId) {
+  const rec = await getJSON('/api/get?request_id=' + requestId +
+                            '&timeout=0');
+  let log = '';
   try {
-    const resp = await fetch('/api/dashboard/data', {
-      headers: window.SKYT_TOKEN ? {Authorization: 'Bearer ' + window.SKYT_TOKEN} : {},
-    });
-    if (resp.ok) render(await resp.json());
-    else document.getElementById('updated').textContent =
-      'error: HTTP ' + resp.status;
+    log = await getText('/api/stream?request_id=' + requestId +
+                        '&follow=false');
+  } catch (e) { log = '(no log: ' + e + ')'; }
+  return showPanel('request ' + requestId.slice(0, 8),
+    '<pre>' + esc(JSON.stringify(rec, null, 2)) +
+    '\n\n--- log ---\n' + esc(log) + '</pre>');
+}
+async function showRecipe(name) {
+  const text = await getText('/api/dashboard/recipe?name=' +
+                             encodeURIComponent(name));
+  return showPanel('recipe://' + name, '<pre>' + esc(text) + '</pre>');
+}
+function showJobLog(jobId) {
+  return showLog('controller log — job ' + jobId,
+                 '/api/dashboard/job-log?job_id=' + jobId);
+}
+
+// -- pages -------------------------------------------------------------
+const RENDERERS = {
+  clusters: d => table(d.clusters, [
+    {key:'name'}, {key:'status'}, {key:'cloud'}, {key:'region'},
+    {key:'resources'}, {key:'nodes'}, {key:'workspace'},
+    {key:'hourly_cost', label:'$/h'},
+    {key:'age', fmt: r => fmtAge(r.age_s)},
+  ], r => `class="click" onclick="showCluster('${esc(r.name)}')"`),
+  jobs: d => table(d.jobs, [
+    {key:'job_id', label:'id'}, {key:'name'}, {key:'status'},
+    {key:'cluster_name', label:'cluster'},
+    {key:'recoveries'},
+    {key:'logs', raw:true, fmt: r =>
+      `<a href="#" onclick="return showJobLog(${Number(r.job_id)||0})">view</a>`},
+  ]),
+  serve: d =>
+    '<h2>Services</h2>' + table(d.services, [
+      {key:'name'}, {key:'status'}, {key:'replicas'},
+    ], r => `class="click" onclick="showService('${esc(r.name)}')"`) +
+    '<h2>Pools</h2>' + table(d.pools, [
+      {key:'name'}, {key:'status'}, {key:'replicas'},
+    ], r => `class="click" onclick="showService('${esc(r.name)}')"`),
+  infra: d => table(d.infra, [
+    {key:'cloud'}, {key:'status'}, {key:'detail'}, {key:'limits'}]),
+  volumes: d => table(d.volumes, [
+    {key:'name'}, {key:'type'}, {key:'size_gb'}, {key:'status'},
+    {key:'attached', fmt: r => (r.attached_to||[]).join(', ')}]),
+  workspaces: d =>
+    '<h2>Workspaces</h2>' + table(d.workspaces, [
+      {key:'name'}, {key:'allowed_clouds'}]) +
+    '<h2>Users</h2>' + table(d.users, [
+      {key:'name'}, {key:'role'}]) +
+    '<h2>Workspace role bindings</h2>' + table(d.bindings, [
+      {key:'workspace'}, {key:'user_name'}, {key:'role'}]),
+  requests: d => table(d.requests, [
+    {key:'short_id', label:'id'}, {key:'name'}, {key:'status'},
+    {key:'user'}, {key:'workspace'},
+    {key:'detail', raw:true, fmt: r =>
+      `<a href="#" onclick="return showRequest('${esc(r.request_id)}')">open</a>`},
+  ]),
+};
+const PAGE_FETCHERS = {   // pages with their own endpoint
+  catalog: async () => table(await getJSON('/api/dashboard/catalog'), [
+    {key:'accelerator'}, {key:'regions'}]),
+  cost: async () => table(await getJSON('/api/dashboard/cost'), [
+    {key:'name'}, {key:'status'}, {key:'hourly_cost', label:'$/h'},
+    {key:'accumulated_cost', label:'accumulated $'}]),
+  recipes: async () => table(await getJSON('/api/dashboard/recipes'), [
+    {key:'name'}, {key:'description'},
+  ], r => `class="click" onclick="showRecipe('${esc(r.name)}')"`),
+};
+
+function currentPage() {
+  const h = (location.hash || '#/clusters').replace(/^#[\\/]/, '');
+  return PAGES.some(([k]) => k === h) ? h : 'clusters';
+}
+function renderNav() {
+  const page = currentPage();
+  const counts = DATA ? {
+    clusters: DATA.clusters.length, jobs: DATA.jobs.length,
+    serve: DATA.services.length + DATA.pools.length,
+    volumes: DATA.volumes.length, requests: DATA.requests.length,
+  } : {};
+  document.getElementById('nav').innerHTML = PAGES.map(([k, label]) =>
+    `<a href="#/${k}" class="${k === page ? 'active' : ''}">${label}` +
+    (counts[k] != null ? `<span class="count">${counts[k]}</span>` : '') +
+    '</a>').join('');
+}
+async function render() {
+  const page = currentPage();
+  document.getElementById('page-title').textContent =
+    PAGES.find(([k]) => k === page)[1];
+  renderNav();
+  const content = document.getElementById('content');
+  try {
+    if (PAGE_FETCHERS[page]) {
+      content.innerHTML = await PAGE_FETCHERS[page]();
+    } else if (DATA) {
+      content.innerHTML = RENDERERS[page](DATA);
+    }
+    if (DATA)
+      document.getElementById('updated').textContent = 'updated ' +
+        new Date(DATA.generated_at * 1000).toLocaleTimeString();
   } catch (e) {
     document.getElementById('updated').textContent = 'error: ' + e;
   }
 }
+async function tick() {
+  try {
+    DATA = await getJSON('/api/dashboard/data');
+    await render();
+  } catch (e) {
+    document.getElementById('updated').textContent = 'error: ' + e;
+  }
+}
+window.addEventListener('hashchange', render);
 tick();
 setInterval(tick, 3000);
 </script>
