@@ -146,7 +146,13 @@ def recipes_data() -> 'list[Dict[str, Any]]':
 
 
 def recipe_yaml(name: str) -> str:
+    import re
     from skypilot_tpu import recipes
+    # Registry names only — '..'/'/' would os.path.join out of the
+    # recipe dir and read arbitrary *.yaml on the server.
+    if not re.fullmatch(r'[A-Za-z0-9][A-Za-z0-9._-]*', name) \
+            or '..' in name:
+        return f'(unknown recipe {name!r})'
     try:
         path = recipes.resolve(name)
     except Exception as e:  # pylint: disable=broad-except
@@ -362,6 +368,8 @@ async function getJSON(url) {
 async function getText(url) {
   const r = await fetch(url, {headers: window.SKYT_TOKEN ?
     {Authorization: 'Bearer ' + window.SKYT_TOKEN} : {}});
+  if (!r.ok) throw new Error('HTTP ' + r.status + ': ' +
+                             (await r.text()).slice(0, 200));
   return await r.text();
 }
 
@@ -378,6 +386,7 @@ function hidePanel() {
   return false;
 }
 function showLog(title, url) {
+  if (logTimer) { clearInterval(logTimer); logTimer = null; }
   showPanel(title,
     '<label><input type="checkbox" id="follow" checked> follow</label>' +
     '<div id="logbox" class="muted">loading…</div>');
@@ -399,6 +408,7 @@ function showLog(title, url) {
   return false;
 }
 async function showCluster(name) {
+  try {
   const d = await getJSON('/api/dashboard/cluster?name=' +
                           encodeURIComponent(name));
   if (d.error) return showPanel(name, `<div>${esc(d.error)}</div>`);
@@ -423,8 +433,10 @@ async function showCluster(name) {
   html += '<h2>Resources</h2><pre>' +
     esc(JSON.stringify(d.resources, null, 2)) + '</pre>';
   return showPanel(name, html);
+  } catch (e) { return showPanel(name, '<pre>error: ' + esc(e) + '</pre>'); }
 }
 async function showService(name) {
+  try {
   const d = await getJSON('/api/dashboard/service?name=' +
                           encodeURIComponent(name));
   if (d.error) return showPanel(name, `<div>${esc(d.error)}</div>`);
@@ -437,10 +449,15 @@ async function showService(name) {
   html += '<h2>Spec</h2><pre>' +
     esc(JSON.stringify(d.spec, null, 2)) + '</pre>';
   return showPanel(name, html);
+  } catch (e) { return showPanel(name, '<pre>error: ' + esc(e) + '</pre>'); }
 }
 async function showRequest(requestId) {
-  const rec = await getJSON('/api/get?request_id=' + requestId +
-                            '&timeout=0');
+  let rec;
+  try {
+    rec = await getJSON('/api/get?request_id=' + requestId + '&timeout=0');
+  } catch (e) {
+    return showPanel('request', '<pre>error: ' + esc(e) + '</pre>');
+  }
   let log = '';
   try {
     log = await getText('/api/stream?request_id=' + requestId +
@@ -448,11 +465,14 @@ async function showRequest(requestId) {
   } catch (e) { log = '(no log: ' + e + ')'; }
   return showPanel('request ' + requestId.slice(0, 8),
     '<pre>' + esc(JSON.stringify(rec, null, 2)) +
-    '\n\n--- log ---\n' + esc(log) + '</pre>');
+    '\\n\\n--- log ---\\n' + esc(log) + '</pre>');
 }
 async function showRecipe(name) {
-  const text = await getText('/api/dashboard/recipe?name=' +
-                             encodeURIComponent(name));
+  let text;
+  try {
+    text = await getText('/api/dashboard/recipe?name=' +
+                         encodeURIComponent(name));
+  } catch (e) { text = 'error: ' + e; }
   return showPanel('recipe://' + name, '<pre>' + esc(text) + '</pre>');
 }
 function showJobLog(jobId) {

@@ -190,3 +190,17 @@ def test_dashboard_spa_routes_every_read_verb(server):
                      'showRecipe',           # skyt recipes show
                      'job-log'):             # skyt jobs logs --controller
         assert fragment in html, f'dashboard SPA missing {fragment}'
+
+
+def test_dashboard_served_bytes_have_no_raw_newline_in_js_strings():
+    """Regression: a missed double-escape put REAL newlines inside a
+    single-quoted JS string, a SyntaxError that killed the whole SPA
+    (browsers only; grep-based tests passed). Check the served bytes:
+    every single-quoted string on each script line must be closed on
+    that same line."""
+    from skypilot_tpu.server import dashboard
+    html = dashboard.DASHBOARD_HTML
+    # The escaped form must reach the browser as backslash-n, not as a
+    # real newline inside the quoted string.
+    assert '\\n\\n--- log ---\\n' in html
+    assert "'\n" not in html.split('showRequest')[1].split('}')[0]
