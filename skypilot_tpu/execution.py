@@ -77,6 +77,13 @@ def launch(task_or_dag: Union[Task, Dag],
     stages = stages or ALL_STAGES
     chain_gated = (len(dag.tasks) > 1 and not dryrun
                    and dag.execution == DagExecution.WAIT_SUCCESS)
+    if chain_gated and not dag.is_chain():
+        # Fan-out graph (explicit depends_on edges): topological levels,
+        # each level's tasks concurrently (prep -> N trainings -> eval).
+        return _launch_graph(dag, cluster_name, backend, stages,
+                             stream_logs=stream_logs, down=down,
+                             detach_run=detach_run,
+                             provision_blocklist=provision_blocklist)
     results: List[Tuple[str, Optional[int]]] = []
     for i, task in enumerate(dag.tasks):
         name = cluster_name if len(dag.tasks) == 1 else (
@@ -135,6 +142,70 @@ def launch(task_or_dag: Union[Task, Dag],
                     f'{len(dag.tasks) - i - 1} stage(s) '
                     '(WAIT_SUCCESS chain)')
     return results
+
+
+def _launch_graph(dag: Dag, cluster_name: Optional[str],
+                  backend: TpuPodBackend, stages: List[Stage], *,
+                  stream_logs: bool, down: bool, detach_run: bool,
+                  provision_blocklist=None
+                  ) -> List[Tuple[str, Optional[int]]]:
+    """General-DAG executor (ref: the ILP optimizer's graph handling,
+    sky/optimizer.py:490 — expressiveness parity, not joint-placement):
+    run topological levels in order; WITHIN a level every task gets its
+    own cluster and runs in its own thread. Any non-SUCCEEDED task
+    aborts all levels below it (WAIT_SUCCESS semantics). Leaf tasks are
+    not waited on, mirroring the chain executor's ungated final stage;
+    non-leaf clusters defer ``down`` to after their gate."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    levels = dag.topological_levels()
+
+    def run_stage(task: Task) -> Tuple[Tuple[str, Optional[int]], str]:
+        name = (f'{cluster_name}-{task.name}' if cluster_name
+                else common_utils.generate_cluster_name(task.name))
+        common_utils.validate_cluster_name(name)
+        is_leaf = not dag.children(task)
+        result = _execute_task(task, name, backend, stages,
+                               dryrun=False, stream_logs=stream_logs,
+                               down=down and is_leaf,
+                               detach_run=detach_run,
+                               provision_blocklist=provision_blocklist)
+        if is_leaf:
+            return result, 'SUCCEEDED'
+        job_id = result[1]
+        try:
+            status = ('SUCCEEDED' if job_id is None else
+                      _wait_terminal(backend, result[0], job_id))
+        except Exception:
+            logger.error(
+                f'dag: lost contact with {result[0]} while waiting on '
+                f'job {job_id}; cluster left UP — check `skyt queue '
+                f'{result[0]}`')
+            raise
+        if down and Stage.DOWN in stages:
+            try:
+                backend.teardown(result[0], terminate=True)
+            except exceptions.ClusterDoesNotExist:
+                pass
+        return result, status
+
+    results: dict = {}
+    for li, level in enumerate(levels):
+        with ThreadPoolExecutor(max_workers=len(level)) as pool:
+            futures = {t.name: pool.submit(run_stage, t) for t in level}
+        statuses = {}
+        for task_name, future in futures.items():
+            results[task_name], statuses[task_name] = future.result()
+        failed = sorted(n for n, s in statuses.items()
+                        if s != 'SUCCEEDED')
+        if failed:
+            remaining = sum(len(lvl) for lvl in levels[li + 1:])
+            raise exceptions.SkytError(
+                f'dag: task(s) {failed} finished '
+                f'{[statuses[n] or "UNKNOWN" for n in failed]}; '
+                f'aborting the {remaining} downstream task(s) '
+                '(WAIT_SUCCESS)')
+    return [results[t.name] for t in dag.tasks]
 
 
 def _wait_terminal(backend: TpuPodBackend, cluster_name: str,

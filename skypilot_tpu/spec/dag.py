@@ -1,9 +1,12 @@
-"""Chain DAG of tasks (parity: ``sky/dag.py:26``)."""
+"""Task DAGs: implicit chains and explicit fan-out graphs (parity:
+``sky/dag.py:26`` for chains; the reference's ILP optimizer handles
+general graphs — here the shape is explicit ``depends_on`` edges and
+execution runs topological levels, each level's tasks concurrently)."""
 from __future__ import annotations
 
 import enum
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.spec.task import Task
@@ -77,8 +80,57 @@ class Dag:
 
     # ---------- queries ----------
 
+    def has_explicit_edges(self) -> bool:
+        return any(t.depends_on for t in self.tasks)
+
     def is_chain(self) -> bool:
-        return True  # only chain DAGs supported (like the reference today)
+        """True when execution order is a simple path AND document
+        order already matches it (the chain executor iterates
+        ``self.tasks`` verbatim — a linear graph declared out of order
+        must go through the graph executor or edges would be
+        violated)."""
+        if not self.has_explicit_edges():
+            return True
+        levels = self.topological_levels()
+        return (all(len(level) == 1 for level in levels)
+                and [level[0] for level in levels] == self.tasks)
+
+    def parents(self, task: Task) -> List[Task]:
+        by_name = {t.name: t for t in self.tasks}
+        # Dangling names tolerated for from_task wrappers (see
+        # topological_levels).
+        return [by_name[d] for d in task.depends_on if d in by_name]
+
+    def children(self, task: Task) -> List[Task]:
+        return [t for t in self.tasks if task.name in t.depends_on]
+
+    def topological_levels(self) -> List[List[Task]]:
+        """Tasks grouped into dependency levels: every task's parents
+        live in strictly earlier levels, so one level's tasks can run
+        concurrently (fan-out). Implicit chains come back as singleton
+        levels in document order."""
+        if not self.has_explicit_edges():
+            return [[t] for t in self.tasks]
+        # Edges bind only within this dag: a single task wrapped via
+        # from_task (optimizer, recovery relaunch) may carry depends_on
+        # names of siblings that are not part of the wrapper.
+        known = {t.name for t in self.tasks}
+        remaining = list(self.tasks)
+        placed: set = set()
+        levels: List[List[Task]] = []
+        while remaining:
+            level = [t for t in remaining
+                     if all(d in placed for d in t.depends_on
+                            if d in known)]
+            if not level:
+                cyclic = ', '.join(t.name or '?' for t in remaining)
+                raise exceptions.InvalidSpecError(
+                    f'DAG has a dependency cycle among: {cyclic}')
+            for t in level:
+                placed.add(t.name)
+            remaining = [t for t in remaining if t not in level]
+            levels.append(level)
+        return levels
 
     def validate(self) -> None:
         if not self.tasks:
@@ -87,6 +139,31 @@ class Dag:
         if len(names) != len(set(names)):
             raise exceptions.InvalidSpecError(
                 f'Duplicate task names in DAG: {names}')
+        for t in self.tasks:
+            if t.name and t.name in t.depends_on:
+                raise exceptions.InvalidSpecError(
+                    f'Task {t.name!r} depends on itself')
+        if self.has_explicit_edges() and len(self.tasks) > 1:
+            if self.execution != DagExecution.WAIT_SUCCESS:
+                # PARALLEL would silently launch children before (or
+                # while) their declared parents run.
+                raise exceptions.InvalidSpecError(
+                    'depends_on edges require the WAIT_SUCCESS '
+                    f'execution mode, not {self.execution.value!r}')
+            # Explicit graphs need every task addressable by name.
+            missing = [t for t in self.tasks if not t.name]
+            if missing:
+                raise exceptions.InvalidSpecError(
+                    'Every task of a DAG with depends_on edges needs a '
+                    'name')
+            known: Dict[str, Task] = {t.name: t for t in self.tasks}
+            for t in self.tasks:
+                unknown = [d for d in t.depends_on if d not in known]
+                if unknown:
+                    raise exceptions.InvalidSpecError(
+                        f'Task {t.name!r} depends on unknown task(s) '
+                        f'{unknown}')
+            self.topological_levels()  # raises on cycles
 
     def __len__(self) -> int:
         return len(self.tasks)

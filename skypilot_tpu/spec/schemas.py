@@ -187,6 +187,9 @@ TASK_SCHEMA: Dict[str, Any] = {
         'estimated_flops': {'type': ['number', 'null'], 'minimum': 0},
         'estimated_inputs_gb': {'type': ['number', 'null'], 'minimum': 0},
         'inputs_region': {'type': ['string', 'null']},
+        # Explicit DAG edges (fan-out graphs): names of tasks in the
+        # same multi-document YAML this one waits on.
+        'depends_on': {'type': 'array', 'items': {'type': 'string'}},
         # Internal round-trip marker (admin policy already applied);
         # present when a task exported by to_yaml is re-imported.
         '_policy_applied': {'type': 'boolean'},

@@ -45,6 +45,7 @@ class Task:
         estimated_flops: Optional[float] = None,
         estimated_inputs_gb: Optional[float] = None,
         inputs_region: Optional[str] = None,
+        depends_on: Optional[List[str]] = None,
     ) -> None:
         if name is not None and not _VALID_NAME_RE.fullmatch(name):
             raise exceptions.InvalidSpecError(f'Invalid task name {name!r}')
@@ -79,6 +80,9 @@ class Task:
         self.estimated_flops = estimated_flops
         self.estimated_inputs_gb = estimated_inputs_gb
         self.inputs_region = inputs_region
+        # Explicit DAG edges: names of tasks this one waits on. Absent
+        # everywhere -> the DAG is an implicit chain (document order).
+        self.depends_on: List[str] = [str(d) for d in (depends_on or [])]
         # Per-task config layer (the `config:` YAML section), threaded
         # into config.get_nested(... override_configs=...) by consumers.
         self.config_overrides: Dict[str, Any] = {}
@@ -122,6 +126,7 @@ class Task:
             'secrets', 'file_mounts', 'storage_mounts', 'volumes',
             'resources', 'service', 'config', '_policy_applied',
             'estimated_flops', 'estimated_inputs_gb', 'inputs_region',
+            'depends_on',
         }
         unknown = set(config) - known
         if unknown:
@@ -155,6 +160,7 @@ class Task:
             estimated_flops=config.get('estimated_flops'),
             estimated_inputs_gb=config.get('estimated_inputs_gb'),
             inputs_region=config.get('inputs_region'),
+            depends_on=config.get('depends_on'),
         )
         task.config_overrides = dict(config.get('config') or {})
         task.policy_applied = bool(config.get('_policy_applied', False))
@@ -250,6 +256,8 @@ class Task:
             config['estimated_inputs_gb'] = self.estimated_inputs_gb
         if self.inputs_region is not None:
             config['inputs_region'] = self.inputs_region
+        if self.depends_on:
+            config['depends_on'] = list(self.depends_on)
         if self.policy_applied:
             config['_policy_applied'] = True
         return config

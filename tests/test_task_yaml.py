@@ -224,3 +224,136 @@ def test_pipeline_failed_stage_aborts_chain(tmp_home, tmp_path,
         srv.shutdown()
         requests_db.reset_db_for_tests()
         fake.reset()
+
+
+def test_dag_topology_validation():
+    """Explicit depends_on edges: cycles, unknown names, unnamed tasks,
+    and level computation (VERDICT r3 missing #8: fan-out DAGs)."""
+    from skypilot_tpu.spec.dag import Dag
+
+    def t(name, deps=()):
+        return Task(name=name, run='echo x',
+                    depends_on=list(deps))
+
+    dag = Dag()
+    for task in (t('prep'), t('a', ['prep']), t('b', ['prep']),
+                 t('eval', ['a', 'b'])):
+        dag.add(task)
+    dag.validate()
+    assert not dag.is_chain()
+    levels = [[x.name for x in level]
+              for level in dag.topological_levels()]
+    assert levels == [['prep'], ['a', 'b'], ['eval']]
+    assert [p.name for p in dag.parents(dag.tasks[3])] == ['a', 'b']
+    assert [c.name for c in dag.children(dag.tasks[0])] == ['a', 'b']
+
+    # A linear explicit graph in document order is still a chain...
+    linear = Dag()
+    for task in (t('x'), t('y', ['x']), t('z', ['y'])):
+        linear.add(task)
+    assert linear.is_chain()
+    # ...but declared OUT of dependency order it must take the graph
+    # executor (the chain loop iterates document order verbatim).
+    ooo = Dag()
+    for task in (t('second', ['first']), t('first')):
+        ooo.add(task)
+    assert not ooo.is_chain()
+    assert [[x.name for x in lvl] for lvl in ooo.topological_levels()] \
+        == [['first'], ['second']]
+
+    # depends_on edges demand WAIT_SUCCESS (PARALLEL would launch
+    # children before their parents).
+    from skypilot_tpu.spec.dag import DagExecution
+    par = Dag(execution=DagExecution.PARALLEL)
+    for task in (t('r'), t('s', ['r'])):
+        par.add(task)
+    with pytest.raises(exceptions.InvalidSpecError, match='WAIT_SUCCESS'):
+        par.validate()
+
+    cyclic = Dag()
+    for task in (t('p', ['q']), t('q', ['p'])):
+        cyclic.add(task)
+    with pytest.raises(exceptions.InvalidSpecError, match='cycle'):
+        cyclic.validate()
+
+    unknown = Dag().add(t('solo', ['ghost'])).add(t('other'))
+    with pytest.raises(exceptions.InvalidSpecError, match='unknown'):
+        unknown.validate()
+    # ...but a SINGLE-task dag tolerates dangling edges: from_task
+    # wrappers (optimizer, recovery relaunch) carry sibling names that
+    # are not part of the wrapper.
+    Dag().add(t('solo2', ['ghost'])).validate()
+
+    unnamed = Dag().add(t('root')).add(
+        Task(run='echo x', depends_on=['root']))
+    with pytest.raises(exceptions.InvalidSpecError, match='needs a name'):
+        unnamed.validate()
+
+    selfdep = Dag().add(t('s', ['s']))
+    with pytest.raises(exceptions.InvalidSpecError, match='itself'):
+        selfdep.validate()
+
+
+def test_fanout_dag_runs_level_concurrently_and_gates(tmp_home, tmp_path):
+    """prep -> {a, b} -> eval: a and b run CONCURRENTLY (wall-clock
+    overlap proven by timestamps they record), eval starts only after
+    both; a failing branch aborts eval."""
+    import json
+    import time as time_lib
+
+    from skypilot_tpu import execution, state
+    from skypilot_tpu.provision import fake
+    from skypilot_tpu.spec.dag import Dag
+    from skypilot_tpu.spec.resources import Resources
+    fake.reset()
+    marks = tmp_path / 'marks'
+    marks.mkdir()
+
+    def t(name, run, deps=()):
+        return Task(name=name, run=run, depends_on=list(deps),
+                    resources=Resources(cloud='fake',
+                                        accelerators='tpu-v5e-8'))
+
+    def stamp(name, body='sleep 2'):
+        return (f'echo "{{\\"start\\": $(date +%s.%N)}}" > '
+                f'{marks}/{name}.start; {body}; '
+                f'echo "{{\\"end\\": $(date +%s.%N)}}" > '
+                f'{marks}/{name}.end')
+
+    dag = Dag(name='fan')
+    dag.add(t('prep', 'echo prep-done'))
+    dag.add(t('a', stamp('a'), ['prep']))
+    dag.add(t('b', stamp('b'), ['prep']))
+    dag.add(t('eval', stamp('eval', 'echo eval-done'), ['a', 'b']))
+    results = execution.launch(dag, cluster_name='fan')
+    assert [r[0] for r in results] == ['fan-prep', 'fan-a', 'fan-b',
+                                      'fan-eval']
+
+    def read(path):
+        with open(path, encoding='utf-8') as f:
+            return float(json.load(f).popitem()[1])
+
+    a_start = read(marks / 'a.start')
+    a_end = read(marks / 'a.end')
+    b_start = read(marks / 'b.start')
+    b_end = read(marks / 'b.end')
+    eval_start = read(marks / 'eval.start')
+    # concurrency: a and b overlap in wall-clock
+    assert a_start < b_end and b_start < a_end, (
+        a_start, a_end, b_start, b_end)
+    # gating: eval starts after both finished
+    assert eval_start >= max(a_end, b_end)
+    for cluster in ('fan-prep', 'fan-a', 'fan-b', 'fan-eval'):
+        from skypilot_tpu import core
+        core.down(cluster)
+    fake.reset()
+
+    # Failing branch: eval never launches.
+    dag2 = Dag(name='fan2')
+    dag2.add(t('a', 'echo ok'))
+    dag2.add(t('bad', 'exit 3'))
+    dag2.add(t('eval', 'echo never', ['a', 'bad']))
+    with pytest.raises(exceptions.SkytError, match='aborting'):
+        execution.launch(dag2, cluster_name='fan2')
+    assert state.get_cluster('fan2-eval') is None
+    fake.reset()
