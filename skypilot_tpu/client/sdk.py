@@ -51,12 +51,18 @@ def api_server_url() -> str:
 
 def _auth_headers() -> Dict[str, str]:
     """Bearer token from env/config (parity: the reference reads service
-    account tokens from SKYPILOT_SERVICE_ACCOUNT_TOKEN / ~/.sky config)."""
+    account tokens from SKYPILOT_SERVICE_ACCOUNT_TOKEN / ~/.sky config).
+    Every request also declares the client's API protocol version so
+    the server can refuse below-floor clients."""
+    from skypilot_tpu.server import versions
+    headers = {versions.API_VERSION_HEADER: str(versions.API_VERSION)}
     token = os.environ.get('SKYT_API_TOKEN')
     if not token:
         from skypilot_tpu import config
         token = config.get_nested(('api_server', 'token'), None)
-    return {'Authorization': f'Bearer {token}'} if token else {}
+    if token:
+        headers['Authorization'] = f'Bearer {token}'
+    return headers
 
 
 _version_checked: set = set()
@@ -83,15 +89,26 @@ def _check_server_version(url: str, resp) -> None:
     _version_checked.add(url)
     try:
         payload = resp.json()
-        server_version = (payload.get('version')
-                          if isinstance(payload, dict) else None)
-        if server_version and server_version != _client_version():
-            logger.warning(
-                'API server at %s runs skypilot-tpu %s but this client '
-                'is %s — upgrade the older side if requests misbehave.',
-                url, server_version, _client_version())
     except ValueError:
-        pass  # a proxy answering 200 with junk is still "healthy"
+        return  # a proxy answering 200 with junk is still "healthy"
+    if not isinstance(payload, dict):
+        return
+    # HARD floor on the protocol version (ref: sky/server/versions.py
+    # refuses incompatible versions; unparsable values count as 0 and
+    # are refused too — versions.check_compatibility never raises) ...
+    from skypilot_tpu.server import versions
+    message = versions.check_compatibility(
+        payload.get('api_version'), peer='server')
+    if message is not None:
+        _version_checked.discard(url)  # re-check after an upgrade
+        raise exceptions.ApiServerError(message)
+    # ... and a WARNING on mixed package versions (usually harmless).
+    server_version = payload.get('version')
+    if server_version and server_version != _client_version():
+        logger.warning(
+            'API server at %s runs skypilot-tpu %s but this client '
+            'is %s — upgrade the older side if requests misbehave.',
+            url, server_version, _client_version())
 
 
 def _client_version() -> str:

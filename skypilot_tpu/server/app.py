@@ -186,6 +186,22 @@ class ApiHandler(BaseHTTPRequestHandler):
                                        created_at=0.0)
         return users_db.authenticate(token)
 
+    def _check_client_version(self) -> bool:
+        """Protocol floor on mutating requests (ref: sky/server/versions
+        refuses incompatible clients). Header absent = pre-versioning
+        client (version 1). Returns False after replying 426."""
+        from skypilot_tpu.server import versions
+        raw = self.headers.get(versions.API_VERSION_HEADER)
+        try:
+            peer = int(raw) if raw is not None else None
+        except ValueError:
+            peer = 0
+        message = versions.check_compatibility(peer, peer='client')
+        if message is None:
+            return True
+        self._error(HTTPStatus.UPGRADE_REQUIRED, message)
+        return False
+
     def _deny(self) -> None:
         self.send_response(HTTPStatus.UNAUTHORIZED)
         body = json.dumps({'error': 'authentication required'}).encode()
@@ -203,6 +219,8 @@ class ApiHandler(BaseHTTPRequestHandler):
             authorized, user = self._authenticate()
             if not authorized:
                 self._deny()
+                return
+            if not self._check_client_version():
                 return
             if route == '/api/tunnel':
                 self._handle_tunnel()
@@ -566,9 +584,11 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
             elif route.startswith('/upload/'):
                 self._handle_upload_probe(route[len('/upload/'):])
             elif route == '/api/health':
+                from skypilot_tpu.server import versions
                 self._reply({
                     'status': 'healthy',
                     'version': skypilot_tpu.__version__,
+                    'api_version': versions.API_VERSION,
                 })
             elif route == '/api/users':
                 self._reply([u.to_dict() for u in users_db.list_users()])

@@ -133,3 +133,39 @@ def test_version_mismatch_warns_not_refuses(server, old_sdk,
     # And the connection still serves requests after the warning.
     rid = old_sdk._post('status', {'refresh': False})
     assert old_sdk.get(rid, timeout=60) is not None or True
+
+
+def test_api_version_floor_refuses_old_client(server, monkeypatch):
+    """r3 verdict weak #8: the protocol floor HARD-refuses a client
+    below MIN_COMPATIBLE_API_VERSION with an upgrade message (426),
+    instead of mis-parsing its requests."""
+    import requests as requests_lib
+    from skypilot_tpu.server import versions
+    # Today's floor accepts version-1 (pre-versioning) clients...
+    no_header = requests_lib.post(f'{server.url}/status',
+                                  json={'refresh': False}, timeout=10)
+    assert no_header.status_code == 200
+    # ...until the floor advances: then a below-floor client is refused.
+    monkeypatch.setattr(versions, 'MIN_COMPATIBLE_API_VERSION', 2)
+    refused = requests_lib.post(f'{server.url}/status',
+                                json={'refresh': False}, timeout=10)
+    assert refused.status_code == 426
+    assert 'upgrade the client' in refused.json()['error']
+    # A current client (header = API_VERSION) still passes the new floor.
+    ok = requests_lib.post(
+        f'{server.url}/status', json={'refresh': False}, timeout=10,
+        headers={versions.API_VERSION_HEADER: str(versions.API_VERSION)})
+    assert ok.status_code == 200
+
+
+def test_api_version_floor_refuses_old_server(server, monkeypatch):
+    """The client side of the floor: a server reporting a below-floor
+    api_version raises instead of silently warning."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.server import versions
+    sdk._version_checked.clear()
+    monkeypatch.setattr(versions, 'MIN_COMPATIBLE_API_VERSION', 99)
+    with pytest.raises(exceptions.ApiServerError, match='upgrade the API'):
+        sdk.api_is_healthy(server.url)
+    sdk._version_checked.clear()
