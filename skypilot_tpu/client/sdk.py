@@ -129,8 +129,19 @@ def _endpoint_is_configured() -> bool:
 def ensure_api_server() -> str:
     """Return a healthy server URL, auto-starting a local one if needed."""
     url = api_server_url()
-    if api_is_healthy(url):
-        return url
+    try:
+        if api_is_healthy(url):
+            return url
+    except exceptions.ApiServerError:
+        # Below the protocol floor. A remote server isn't ours to fix;
+        # a LOCAL daemon left over from an older wheel is — replace it
+        # (otherwise every command fails until a manual `skyt api stop`).
+        if _endpoint_is_configured():
+            raise
+        logger.warning('Local API server at %s speaks an incompatible '
+                       'protocol (older wheel?); restarting it.', url)
+        api_stop()
+        _version_checked.discard(url)
     if _endpoint_is_configured():
         # Configured (remote) server: transient unreachability (restart,
         # flaky network) is retried before giving up.

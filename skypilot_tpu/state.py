@@ -1,8 +1,13 @@
-"""Global user state: cluster records + events in sqlite.
+"""Global user state: cluster records + events in sqlite OR Postgres.
 
 Parity: ``sky/global_user_state.py`` (SQLAlchemy over sqlite/postgres,
-tables at :68-103). Plain sqlite3 here -- no ORM dependency in the image --
-with JSON columns for structured fields.
+tables at :68-103). No ORM dependency in the image: the default backend
+is plain sqlite3; setting ``SKYT_DB_URL=postgres://user:pw@host/db``
+switches to a shared Postgres (utils/pg.py stdlib wire client) so
+multiple API-server replicas can serve one deployment (the HA story the
+helm chart's single-PVC mode can't give). The ``?``-placeholder SQL
+here is written in the common dialect; ``_PgAdapter`` translates the
+few sqlite-isms (AUTOINCREMENT, PRAGMA) on the way out.
 """
 from __future__ import annotations
 
@@ -30,21 +35,84 @@ def _state_dir() -> str:
 
 
 _local = threading.local()
+# (url, pid) pairs whose shared-DB schema this process already ensured.
+_pg_schema_ready: set = set()
 
 
-def _db() -> sqlite3.Connection:
+def db_url() -> Optional[str]:
+    """Postgres DSN when the deployment uses a shared DB, else None."""
+    return os.environ.get('SKYT_DB_URL') or None
+
+
+class _PgAdapter:
+    """sqlite3-connection-shaped facade over utils/pg.PgConnection,
+    translating the schema's sqlite-isms to Postgres."""
+
+    def __init__(self, conn) -> None:
+        self._conn = conn
+
+    @staticmethod
+    def _translate(sql: str) -> Optional[str]:
+        stripped = sql.strip()
+        if stripped.startswith('PRAGMA journal_mode'):
+            return None                      # sqlite-only tuning
+        if stripped.startswith('PRAGMA table_info'):
+            table = stripped.split('(', 1)[1].rstrip(') ')
+            return ("SELECT column_name AS name FROM "
+                    "information_schema.columns WHERE table_name="
+                    f"'{table}'")
+        sql = sql.replace('INTEGER PRIMARY KEY AUTOINCREMENT',
+                          'BIGSERIAL PRIMARY KEY')
+        # sqlite REAL is 8-byte; Postgres REAL is float4, which rounds
+        # epoch timestamps to ~2-minute granularity. DDL only (the word
+        # appears nowhere else in this module's SQL).
+        return sql.replace(' REAL', ' DOUBLE PRECISION')
+
+    def execute(self, sql: str, params=()):
+        translated = self._translate(sql)
+        if translated is None:
+            from skypilot_tpu.utils.pg import _Result
+            return _Result([], [], [])
+        return self._conn.execute(translated, params)
+
+    def executescript(self, script: str) -> None:
+        for statement in script.split(';'):
+            if statement.strip():
+                self.execute(statement)
+
+    def commit(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def _db():
     """Per-thread connection; schema created on first use. Re-opened
     after fork: sharing a parent's sqlite connection across processes
     corrupts the DB (the executor forks a child per request)."""
-    path = os.path.join(_state_dir(), 'state.db')
+    url = db_url()
+    path = url or os.path.join(_state_dir(), 'state.db')
     conn = getattr(_local, 'conn', None)
     if (conn is not None and getattr(_local, 'path', None) == path and
             getattr(_local, 'pid', None) == os.getpid()):
         return conn
-    os.makedirs(_state_dir(), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
+    if url is not None:
+        from skypilot_tpu.utils import pg
+        conn = _PgAdapter(pg.PgConnection.from_url(url))
+        # The shared DB's schema is ensured ONCE per process, not per
+        # request thread — replaying 4 CREATE TABLEs + the migration
+        # probe on every HTTP request thread is pure round-trip waste.
+        if (url, os.getpid()) in _pg_schema_ready:
+            _local.conn = conn
+            _local.path = path
+            _local.pid = os.getpid()
+            return conn
+    else:
+        os.makedirs(_state_dir(), exist_ok=True)
+        conn = sqlite3.connect(path, timeout=10)
+        conn.row_factory = sqlite3.Row
+        conn.execute('PRAGMA journal_mode=WAL')
     conn.executescript("""
         CREATE TABLE IF NOT EXISTS clusters (
             name TEXT PRIMARY KEY,
@@ -96,6 +164,8 @@ def _db() -> sqlite3.Connection:
             conn, "ALTER TABLE clusters ADD COLUMN workspace TEXT "
             "DEFAULT 'default'")
     conn.commit()
+    if url is not None:
+        _pg_schema_ready.add((url, os.getpid()))
     _local.conn = conn
     _local.path = path
     _local.pid = os.getpid()

@@ -30,6 +30,11 @@ def add_column_if_missing(conn: sqlite3.Connection, ddl: str) -> None:
     except sqlite3.OperationalError as e:
         if 'duplicate column' not in str(e):
             raise
+    except Exception as e:  # Postgres backend: same race, 42701
+        from skypilot_tpu.utils.pg import PgError
+        if not (isinstance(e, PgError)
+                and (e.code == '42701' or 'already exists' in str(e))):
+            raise
 
 _USER_HASH_FILE = os.path.expanduser('~/.skyt/user_hash')
 CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([a-zA-Z0-9_-]*[a-zA-Z0-9])?$')

@@ -1,37 +1,131 @@
 """Distributed locks guarding cluster state transitions.
 
-Parity: ``sky/utils/locks.py:51`` (DistributedLock with FileLock/PostgresLock
-backends). We ship the filelock backend; the interface leaves room for a DB
-advisory-lock backend when the API server runs against Postgres.
+Parity: ``sky/utils/locks.py:51`` (DistributedLock with FileLock /
+PostgresLock backends). Default backend is filelock (one machine); when
+the deployment runs against a shared Postgres (``SKYT_DB_URL``), the
+backend switches to session advisory locks (``pg_advisory_lock`` —
+exactly the reference's PostgresLock, :164) so API-server REPLICAS on
+different machines serialize the same cluster's transitions.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import time
 from typing import Optional
 
 import filelock
 
+from skypilot_tpu import exceptions
+
 LOCK_DIR = os.path.expanduser('~/.skyt/locks')
 
 
-class DistributedLock:
-    """A named inter-process lock (per-cluster, per-job-controller...)."""
+class LockTimeout(exceptions.SkytError):
+    pass
 
-    def __init__(self, name: str, timeout: Optional[float] = None) -> None:
+
+class _FileLockBackend:
+    def __init__(self, name: str, timeout: Optional[float]) -> None:
         os.makedirs(LOCK_DIR, exist_ok=True)
         safe = name.replace('/', '_')
         self._path = os.path.join(LOCK_DIR, f'{safe}.lock')
-        self._timeout = -1 if timeout is None else timeout
-        self._lock = filelock.FileLock(self._path, timeout=self._timeout)
+        self._lock = filelock.FileLock(
+            self._path, timeout=-1 if timeout is None else timeout)
 
     def acquire(self) -> None:
-        self._lock.acquire()
+        try:
+            self._lock.acquire()
+        except filelock.Timeout as e:
+            raise LockTimeout(str(e)) from None
 
     def release(self) -> None:
         self._lock.release()
 
     def locked(self) -> bool:
         return self._lock.is_locked
+
+
+class _PostgresLockBackend:
+    """Session advisory lock on the shared DB (ref PostgresLock,
+    sky/utils/locks.py:164): the lock key is a stable 64-bit hash of
+    the name; held by THIS connection until released/closed, so a
+    crashed holder's lock dies with its connection."""
+
+    def __init__(self, name: str, url: str,
+                 timeout: Optional[float]) -> None:
+        self._name = name
+        self._url = url
+        self._timeout = timeout
+        self._conn = None
+        self._key = int.from_bytes(
+            hashlib.sha256(name.encode()).digest()[:8], 'big',
+            signed=True)
+        self._held = False
+
+    def acquire(self) -> None:
+        from skypilot_tpu.utils import pg
+        if self._conn is None:
+            self._conn = pg.PgConnection.from_url(self._url)
+        # ALWAYS poll with try-lock, even untimed: a blocking
+        # pg_advisory_lock() can out-wait the client's socket timeout,
+        # and the abandoned session would later be GRANTED the lock
+        # server-side with nobody using it — a cross-replica deadlock.
+        deadline = (None if self._timeout is None
+                    else time.time() + self._timeout)
+        while True:
+            row = self._conn.execute(
+                f'SELECT pg_try_advisory_lock({self._key}) AS ok'
+            ).fetchone()
+            value = row['ok']
+            if value is True or value == 't':
+                self._held = True
+                return
+            if deadline is not None and time.time() >= deadline:
+                raise LockTimeout(
+                    f'advisory lock {self._name!r} not acquired within '
+                    f'{self._timeout}s')
+            time.sleep(0.2 if self._timeout is None
+                       else min(0.2, max(self._timeout / 20, 0.01)))
+
+    def release(self) -> None:
+        # Unlock AND drop the session: each lock object owns a dedicated
+        # connection, and leaving it open until garbage collection
+        # accumulates idle sessions against max_connections.
+        if self._conn is not None:
+            if self._held:
+                try:
+                    self._conn.execute(
+                        f'SELECT pg_advisory_unlock({self._key})')
+                except Exception:  # pylint: disable=broad-except
+                    pass  # closing the session releases it anyway
+                self._held = False
+            self._conn.close()
+            self._conn = None
+
+    def locked(self) -> bool:
+        return self._held
+
+
+class DistributedLock:
+    """A named inter-process lock (per-cluster, per-job-controller...)."""
+
+    def __init__(self, name: str, timeout: Optional[float] = None) -> None:
+        from skypilot_tpu import state
+        url = state.db_url()
+        if url is not None:
+            self._backend = _PostgresLockBackend(name, url, timeout)
+        else:
+            self._backend = _FileLockBackend(name, timeout)
+
+    def acquire(self) -> None:
+        self._backend.acquire()
+
+    def release(self) -> None:
+        self._backend.release()
+
+    def locked(self) -> bool:
+        return self._backend.locked()
 
     def __enter__(self) -> 'DistributedLock':
         self.acquire()

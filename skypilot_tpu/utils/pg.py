@@ -1,0 +1,321 @@
+"""Minimal PostgreSQL wire-protocol client on the stdlib socket.
+
+Parity frame: the reference's Postgres support rides SQLAlchemy +
+psycopg2 (``sky/global_user_state.py``, ``sky/utils/locks.py:164``);
+neither is in this image, so — same stance as the GCP REST, S3 SigV4
+and Azure SharedKey clients — the wire protocol (v3) is implemented
+directly: startup, cleartext/md5/SCRAM-SHA-256 auth, and the simple
+query flow (Q → RowDescription/DataRow/CommandComplete).
+
+Deliberately small surface, shaped like sqlite3 so state.py can treat
+either backend uniformly:
+
+    conn = PgConnection.from_url('postgres://user:pw@host:5432/db')
+    rows = conn.execute('SELECT * FROM t WHERE name=?', ('x',)).fetchall()
+
+The simple protocol carries no bind parameters, so ``?`` placeholders
+are substituted client-side with fully quoted literals (``_quote``).
+Results come back as dicts keyed by column name; scalar values are
+text (ints/floats coerced on read by callers' json/float use — the
+state layer stores JSON strings and numbers only).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import urllib.parse
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+class PgError(Exception):
+    """Server-reported error (message field M of ErrorResponse)."""
+
+    def __init__(self, fields: Dict[str, str]) -> None:
+        self.fields = fields
+        self.code = fields.get('C', '')
+        super().__init__(fields.get('M', 'postgres error'))
+
+
+def _quote(value: Any) -> str:
+    """A Python value as a safe SQL literal (simple-protocol client-side
+    parameter substitution)."""
+    if value is None:
+        return 'NULL'
+    if isinstance(value, bool):
+        return 'TRUE' if value else 'FALSE'
+    if isinstance(value, (int, float)):
+        return repr(value)
+    text = str(value).replace("'", "''")
+    if '\\' in text:
+        # Standard-conforming strings treat backslash literally, but be
+        # explicit so the literal survives either server setting.
+        text = text.replace('\\', '\\\\')
+        return f" E'{text}'"
+    return f"'{text}'"
+
+
+def substitute(sql: str, params: Sequence[Any]) -> str:
+    """Replace ``?`` placeholders outside string literals."""
+    if not params:
+        return sql
+    out: List[str] = []
+    it = iter(params)
+    in_string = False
+    for ch in sql:
+        if ch == "'":
+            in_string = not in_string
+            out.append(ch)
+        elif ch == '?' and not in_string:
+            out.append(_quote(next(it)))
+        else:
+            out.append(ch)
+    return ''.join(out)
+
+
+# Common type OIDs -> Python coercion (simple protocol is text-only).
+_OID_CAST = {
+    16: lambda v: v == 't',                      # bool
+    20: int, 21: int, 23: int, 26: int,          # int8/2/4, oid
+    700: float, 701: float, 1700: float,         # float4/8, numeric
+}
+
+
+class _Result:
+    """sqlite3-cursor-shaped result set (dict rows, typed values)."""
+
+    def __init__(self, columns: List[str], oids: List[int],
+                 rows: List[List[Optional[str]]]) -> None:
+        casts = [_OID_CAST.get(oid) for oid in oids]
+        self._rows = [
+            {name: (value if value is None or cast is None
+                    else cast(value))
+             for name, cast, value in zip(columns, casts, row)}
+            for row in rows
+        ]
+
+    def fetchone(self) -> Optional[Dict[str, Any]]:
+        return self._rows[0] if self._rows else None
+
+    def fetchall(self) -> List[Dict[str, Any]]:
+        return list(self._rows)
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        return iter(self._rows)
+
+
+class PgConnection:
+    def __init__(self, host: str, port: int, user: str,
+                 password: str, database: str,
+                 connect_timeout: float = 10.0) -> None:
+        self.user = user
+        self.password = password
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(30.0)
+        self._buf = b''
+        self._startup(database)
+
+    @classmethod
+    def from_url(cls, url: str) -> 'PgConnection':
+        parsed = urllib.parse.urlparse(url)
+        if parsed.scheme not in ('postgres', 'postgresql'):
+            raise ValueError(f'not a postgres url: {url!r}')
+        return cls(host=parsed.hostname or 'localhost',
+                   port=parsed.port or 5432,
+                   user=urllib.parse.unquote(parsed.username or 'postgres'),
+                   password=urllib.parse.unquote(parsed.password or ''),
+                   database=(parsed.path or '/postgres').lstrip('/')
+                   or 'postgres')
+
+    # -- framing -------------------------------------------------------
+
+    def _send(self, type_byte: bytes, payload: bytes) -> None:
+        self._sock.sendall(type_byte + struct.pack('>I', len(payload) + 4)
+                           + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise PgError({'M': 'server closed the connection'})
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _recv_message(self) -> Tuple[bytes, bytes]:
+        header = self._recv_exact(5)
+        (length,) = struct.unpack('>I', header[1:])
+        return header[:1], self._recv_exact(length - 4)
+
+    # -- startup / auth ------------------------------------------------
+
+    def _startup(self, database: str) -> None:
+        params = (f'user\0{self.user}\0database\0{database}\0'
+                  'application_name\0skypilot-tpu\0\0').encode()
+        payload = struct.pack('>I', 196608) + params  # protocol 3.0
+        self._sock.sendall(struct.pack('>I', len(payload) + 4) + payload)
+        while True:
+            mtype, body = self._recv_message()
+            if mtype == b'R':
+                self._handle_auth(body)
+            elif mtype == b'Z':      # ReadyForQuery
+                return
+            elif mtype == b'E':
+                raise PgError(_parse_error(body))
+            # S (ParameterStatus) / K (BackendKeyData): ignored
+
+    def _handle_auth(self, body: bytes) -> None:
+        (code,) = struct.unpack('>I', body[:4])
+        if code == 0:                # AuthenticationOk
+            return
+        if code == 3:                # cleartext
+            self._send(b'p', self.password.encode() + b'\0')
+            return
+        if code == 5:                # md5
+            salt = body[4:8]
+            inner = hashlib.md5(
+                self.password.encode() + self.user.encode()).hexdigest()
+            digest = hashlib.md5(inner.encode() + salt).hexdigest()
+            self._send(b'p', b'md5' + digest.encode() + b'\0')
+            return
+        if code == 10:               # SASL: mechanisms list
+            mechanisms = body[4:].split(b'\0')
+            if b'SCRAM-SHA-256' not in mechanisms:
+                raise PgError({'M': f'unsupported SASL {mechanisms}'})
+            self._scram()
+            return
+        raise PgError({'M': f'unsupported auth method {code}'})
+
+    def _scram(self) -> None:
+        """SCRAM-SHA-256 (RFC 5802/7677) over the SASL messages."""
+        nonce = base64.b64encode(os.urandom(18)).decode()
+        first_bare = f'n={self.user},r={nonce}'
+        client_first = 'n,,' + first_bare
+        payload = (b'SCRAM-SHA-256\0' +
+                   struct.pack('>I', len(client_first)) +
+                   client_first.encode())
+        self._send(b'p', payload)
+        mtype, body = self._recv_message()
+        if mtype == b'E':
+            raise PgError(_parse_error(body))
+        (code,) = struct.unpack('>I', body[:4])
+        assert code == 11, f'expected SASLContinue, got {code}'
+        server_first = body[4:].decode()
+        attrs = dict(p.split('=', 1) for p in server_first.split(','))
+        server_nonce, salt_b64, iterations = (attrs['r'], attrs['s'],
+                                              int(attrs['i']))
+        if not server_nonce.startswith(nonce):
+            raise PgError({'M': 'SCRAM server nonce mismatch'})
+        salted = hashlib.pbkdf2_hmac('sha256', self.password.encode(),
+                                     base64.b64decode(salt_b64),
+                                     iterations)
+        client_key = hmac.new(salted, b'Client Key',
+                              hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        without_proof = f'c=biws,r={server_nonce}'
+        auth_message = (f'{first_bare},{server_first},'
+                        f'{without_proof}').encode()
+        signature = hmac.new(stored_key, auth_message,
+                             hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        final = (f'{without_proof},p='
+                 f'{base64.b64encode(proof).decode()}')
+        self._send(b'p', final.encode())
+        mtype, body = self._recv_message()
+        if mtype == b'E':
+            raise PgError(_parse_error(body))
+        (code,) = struct.unpack('>I', body[:4])
+        assert code == 12, f'expected SASLFinal, got {code}'
+        server_key = hmac.new(salted, b'Server Key',
+                              hashlib.sha256).digest()
+        expected = hmac.new(server_key, auth_message,
+                            hashlib.sha256).digest()
+        got = dict(p.split('=', 1)
+                   for p in body[4:].decode().split(','))
+        if base64.b64decode(got.get('v', '')) != expected:
+            raise PgError({'M': 'SCRAM server signature mismatch '
+                                '(not the server we authenticated?)'})
+
+    # -- queries -------------------------------------------------------
+
+    def execute(self, sql: str,
+                params: Sequence[Any] = ()) -> _Result:
+        self._send(b'Q', substitute(sql, params).encode() + b'\0')
+        columns: List[str] = []
+        oids: List[int] = []
+        rows: List[List[Optional[str]]] = []
+        error: Optional[PgError] = None
+        while True:
+            mtype, body = self._recv_message()
+            if mtype == b'T':        # RowDescription
+                columns, oids = _parse_row_description(body)
+            elif mtype == b'D':      # DataRow
+                rows.append(_parse_data_row(body))
+            elif mtype == b'E':
+                error = PgError(_parse_error(body))
+            elif mtype == b'Z':      # ReadyForQuery: statement done
+                if error is not None:
+                    raise error
+                return _Result(columns, oids, rows)
+            # C (CommandComplete) / N (Notice) / I (EmptyQuery): skip
+
+    def executescript(self, script: str) -> None:
+        for statement in script.split(';'):
+            if statement.strip():
+                self.execute(statement)
+
+    def commit(self) -> None:
+        """Simple-protocol statements autocommit; kept for sqlite-shaped
+        call sites."""
+
+    def close(self) -> None:
+        try:
+            self._send(b'X', b'')
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _parse_error(body: bytes) -> Dict[str, str]:
+    fields: Dict[str, str] = {}
+    for part in body.split(b'\0'):
+        if part:
+            fields[chr(part[0])] = part[1:].decode('utf-8', 'replace')
+    return fields
+
+
+def _parse_row_description(body: bytes
+                           ) -> Tuple[List[str], List[int]]:
+    (count,) = struct.unpack('>H', body[:2])
+    names: List[str] = []
+    oids: List[int] = []
+    offset = 2
+    for _ in range(count):
+        end = body.index(b'\0', offset)
+        names.append(body[offset:end].decode())
+        # fixed part: table oid(4) attnum(2) TYPE OID(4) len(2) mod(4)
+        # fmt(2) = 18 bytes
+        (oid,) = struct.unpack('>I', body[end + 7:end + 11])
+        oids.append(oid)
+        offset = end + 1 + 18
+    return names, oids
+
+
+def _parse_data_row(body: bytes) -> List[Optional[str]]:
+    (count,) = struct.unpack('>H', body[:2])
+    values: List[Optional[str]] = []
+    offset = 2
+    for _ in range(count):
+        (length,) = struct.unpack('>i', body[offset:offset + 4])
+        offset += 4
+        if length < 0:
+            values.append(None)
+        else:
+            values.append(body[offset:offset + length].decode('utf-8',
+                                                              'replace'))
+            offset += length
+    return values
