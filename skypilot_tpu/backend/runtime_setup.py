@@ -86,13 +86,21 @@ def package_runtime() -> tuple:
             hasher.update(f.read())
     content_hash = hasher.hexdigest()[:16]
 
+    # Cache location overridable so short-lived state dirs (tests, CI
+    # sandboxes) can share one tarball across environments.
     state_dir = os.environ.get('SKYT_STATE_DIR',
                                os.path.expanduser('~/.skyt'))
-    cache_dir = os.path.join(state_dir, 'runtime_pkg')
+    cache_dir = os.environ.get(
+        'SKYT_RUNTIME_PKG_CACHE', os.path.join(state_dir, 'runtime_pkg'))
     os.makedirs(cache_dir, exist_ok=True)
     tarball = os.path.join(cache_dir, f'skypilot_tpu-{content_hash}.tar.gz')
     if not os.path.exists(tarball):
-        tmp = tarball + '.tmp'
+        # Unique temp name: concurrent builders (two test sessions, two
+        # executor children) must not interleave writes into one '.tmp'
+        # and os.replace a corrupt archive.
+        import tempfile
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix='.tmp')
+        os.close(fd)
         with tarfile.open(tmp, 'w:gz') as tar:
             for path in files:
                 arcname = os.path.join('skypilot_tpu',
@@ -230,12 +238,20 @@ def _ship_runtime_to_host(runner: CommandRunner, tarball: str,
     remote_tar = f'{pkg_dir}/{os.path.basename(tarball)}'
     runner.run(f'mkdir -p {pkg_dir}', check=True)
     runner.rsync(tarball, pkg_dir + '/', up=True)
+    # The import probe catches broken installs on real clusters but
+    # costs a ~2s python start per host; test harnesses (which install
+    # the very package they run from) may skip it.
+    skip_verify = os.environ.get('SKYT_RUNTIME_SKIP_IMPORT_CHECK',
+                                 '0') not in ('', '0')
+    verify = ('true' if skip_verify
+              else f'PYTHONPATH={REMOTE_PKG_DIR} python3 -c '
+                   f'"import skypilot_tpu"')
     code, out = runner.run(
         f'mkdir -p {REMOTE_PKG_DIR} && '
         f'tar -xzf {remote_tar} -C {REMOTE_PKG_DIR} && '
         f'rm -rf {pkg_dir} && '
         f'echo {content_hash} > {REMOTE_RUNTIME_DIR}/runtime_hash && '
-        f'PYTHONPATH={REMOTE_PKG_DIR} python3 -c "import skypilot_tpu" && '
+        f'{verify} && '
         f'echo SKYT_RUNTIME_OK')
     if code != 0 or 'SKYT_RUNTIME_OK' not in out:
         raise exceptions.CommandError(
@@ -264,8 +280,13 @@ def _start_remote_daemon(head_runner: CommandRunner) -> None:
 
 def stop_remote_daemon(head_runner: CommandRunner) -> None:
     """Best-effort daemon kill on the head node (teardown path)."""
+    # Heartbeat/pid files are scrubbed with the kill: a re-provision of
+    # the same host minutes later must not read the dead daemon's fresh
+    # heartbeat as "alive" and skip starting its own daemon.
     cmd = (f'pid=$(cat {REMOTE_RUNTIME_DIR}/daemon.pid 2>/dev/null); '
-           f'if [ -n "$pid" ]; then kill $pid 2>/dev/null; fi; true')
+           f'if [ -n "$pid" ]; then kill $pid 2>/dev/null; fi; '
+           f'rm -f {REMOTE_RUNTIME_DIR}/daemon.pid '
+           f'{REMOTE_RUNTIME_DIR}/daemon_heartbeat; true')
     try:
         head_runner.run(cmd, timeout=60)
     except Exception as e:  # pylint: disable=broad-except

@@ -269,6 +269,32 @@ class TpuPodBackend(Backend):
 
         run_in_parallel(run_setup, list(zip(runners, info.hosts)))
 
+    @staticmethod
+    def _daemon_ready(info: ClusterInfo, job_table,
+                      grace: Optional[float] = None) -> bool:
+        """daemon_alive with a startup grace: a just-provisioned
+        cluster's daemon is nohup'd and needs a beat to write its first
+        heartbeat — checking the instant after launch would misread a
+        healthy cluster as dead. Local-style clusters skip the grace
+        (the in-process pid-verified check is authoritative, and the
+        restart path behind this check is a cheap idempotent respawn);
+        remote polls pay an SSH exec each, so they poll slowly."""
+        import os as os_lib
+        import time as time_lib
+        if job_table.daemon_alive():
+            return True
+        if runtime_setup.is_local_style(info):
+            return False
+        if grace is None:
+            grace = float(os_lib.environ.get('SKYT_DAEMON_START_GRACE',
+                                             '20'))
+        deadline = time_lib.time() + grace
+        while time_lib.time() < deadline:
+            time_lib.sleep(2.0)
+            if job_table.daemon_alive():
+                return True
+        return False
+
     def execute(self, info: ClusterInfo, task: Task, *,
                 detach: bool = True) -> int:
         """Run the task on every host; returns the job id.
@@ -309,7 +335,7 @@ class TpuPodBackend(Backend):
         # devices.
         uses_tpu = (resources is None
                     or bool(resources.accelerators))
-        if not detach and not job_table.daemon_alive():
+        if not detach and not self._daemon_ready(info, job_table):
             # Attached runs need a live daemon or the follow would hang
             # on a forever-PENDING job. Local-style daemons can simply
             # be restarted; a dead remote daemon means the runtime needs

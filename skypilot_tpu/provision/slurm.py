@@ -14,6 +14,7 @@ Partitions map to the ``region`` field.
 """
 from __future__ import annotations
 
+import os
 import shlex
 import subprocess
 import time
@@ -177,7 +178,8 @@ class SlurmProvider(Provider):
                 return self._info(request.cluster_name,
                                   request.region or 'slurm', nodes,
                                   job['job_id'])
-            time.sleep(2)
+            time.sleep(float(os.environ.get('SKYT_SLURM_POLL_SECONDS',
+                                            '2')))
         raise exceptions.CapacityError(
             f'slurm: allocation for {request.cluster_name} still pending '
             f'after {timeout}s (queue full?)')

@@ -142,12 +142,11 @@ class ChannelServer:
         log_path = os.path.join(
             job_lib.job_log_dir(self.runtime_dir, job_id), 'rank_0.log')
 
+        stop_condition = job_cli.follow_stop_condition(self.runtime_dir,
+                                                       job_id)
+
         def job_done() -> bool:
-            if self._stopping.is_set():
-                return True
-            j = job_lib.get_job(self.runtime_dir, job_id)
-            return j is None or job_lib.JobStatus(
-                j['status']).is_terminal()
+            return self._stopping.is_set() or stop_condition()
 
         if not follow and not os.path.exists(log_path):
             self._send({'id': rid, 'ok': False, 'kind': 'not_found',

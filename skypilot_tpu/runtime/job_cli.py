@@ -98,9 +98,48 @@ def cmd_daemon_status(runtime_dir: str) -> dict:
                         'daemon_heartbeat')
     if not os.path.exists(path):
         return {'alive': False}
-    with open(path, encoding='utf-8') as f:
-        hb = json.load(f)
-    return {'alive': time.time() - hb.get('ts', 0) < 30, **hb}
+    try:
+        with open(path, encoding='utf-8') as f:
+            hb = json.load(f)
+    except (OSError, ValueError):
+        return {'alive': False}
+    alive = time.time() - hb.get('ts', 0) < 30
+    pid = hb.get('pid')
+    if alive and pid is not None:
+        # A heartbeat outlives its writer: a daemon killed seconds ago
+        # (teardown + immediate re-provision of the same host) reads as
+        # alive for up to 30s — long enough to skip the new daemon's
+        # start and strand every submitted job in PENDING. Only ESRCH
+        # means dead: EPERM (daemon under another uid) is proof of life.
+        try:
+            os.kill(int(pid), 0)
+        except ProcessLookupError:
+            alive = False
+        except PermissionError:
+            pass
+        except (OSError, ValueError):
+            pass  # inconclusive probe: trust the fresh heartbeat
+    return {'alive': alive, **hb}
+
+
+def follow_stop_condition(runtime_dir: str, job_id: int):
+    """``stop_when`` for follow-tails, shared by every transport
+    (job_cli tail, DirectJobTable, channel_server): stop on a terminal
+    job, and stop on a DEAD daemon — a non-terminal job nobody
+    supervises never finishes, so following it hangs the client
+    forever. The grace covers a daemon still starting up."""
+    grace = float(os.environ.get('SKYT_TAIL_DAEMON_GRACE', '45'))
+    stream_started = time.time()
+
+    def job_done() -> bool:
+        job = job_lib.get_job(runtime_dir, job_id)
+        if job is None or job_lib.JobStatus(job['status']).is_terminal():
+            return True
+        if time.time() - stream_started < grace:
+            return False
+        return not cmd_daemon_status(runtime_dir).get('alive', False)
+
+    return job_done
 
 
 def cmd_tail(runtime_dir: str, job_id: int, follow: bool) -> int:
@@ -112,16 +151,12 @@ def cmd_tail(runtime_dir: str, job_id: int, follow: bool) -> int:
         return 3
     log_path = os.path.join(job_lib.job_log_dir(runtime_dir, job_id),
                             'rank_0.log')
-
-    def job_done() -> bool:
-        j = job_lib.get_job(runtime_dir, job_id)
-        return j is None or job_lib.JobStatus(j['status']).is_terminal()
-
     if not follow and not os.path.exists(log_path):
         print(f'No logs for job {job_id}', file=sys.stderr)
         return 3
-    for line in log_lib.tail_file(log_path, follow=follow,
-                                  stop_when=job_done):
+    for line in log_lib.tail_file(
+            log_path, follow=follow,
+            stop_when=follow_stop_condition(runtime_dir, job_id)):
         sys.stdout.write(line)
         sys.stdout.flush()
     return 0

@@ -124,29 +124,24 @@ class DirectJobTable(JobTable):
                 f'No job {job_id} on cluster')
         log_path = os.path.join(
             job_lib.job_log_dir(self.runtime_dir, job_id), 'rank_0.log')
-
-        def job_done() -> bool:
-            job = self.get(job_id)
-            return job is None or job_lib.JobStatus(
-                job['status']).is_terminal()
-
         if not follow and not os.path.exists(log_path):
             raise exceptions.JobNotFoundError(
                 f'No logs for job {job_id} at {log_path}')
-        lines = log_lib.tail_file(log_path, follow=follow,
-                                  stop_when=job_done)
+        from skypilot_tpu.runtime import job_cli
+        lines = log_lib.tail_file(
+            log_path, follow=follow,
+            stop_when=job_cli.follow_stop_condition(self.runtime_dir,
+                                                    job_id))
         import sys
         return log_lib.stream_to(lines, stream or sys.stdout)
 
     def daemon_alive(self) -> bool:
-        path = os.path.join(os.path.expanduser(self.runtime_dir),
-                            'daemon_heartbeat')
-        try:
-            with open(path, encoding='utf-8') as f:
-                hb = json.load(f)
-            return time.time() - hb.get('ts', 0) < 30
-        except (OSError, ValueError):
-            return False
+        # cmd_daemon_status verifies the heartbeat's PID is actually
+        # alive — a daemon killed seconds ago leaves a fresh heartbeat
+        # that would otherwise read as healthy for up to 30s.
+        from skypilot_tpu.runtime import job_cli
+        return bool(job_cli.cmd_daemon_status(
+            self.runtime_dir).get('alive'))
 
 
 class RemoteJobTable(JobTable):

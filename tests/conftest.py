@@ -7,6 +7,12 @@ rebuild. Real-TPU benchmarking happens in bench.py, not here.
 import os
 
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# The image's sitecustomize imports jax (+ the axon TPU plugin) into
+# EVERY python process when PALLAS_AXON_POOL_IPS is set — a ~2s tax on
+# each spawned daemon / job_cli / channel / executor python. Tests run
+# CPU-only and never touch the TPU tunnel, so drop the trigger for this
+# process AND everything it spawns.
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
@@ -19,9 +25,31 @@ import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
 
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
 import uuid  # noqa: E402
 
 import pytest  # noqa: E402
+
+# Modules that `import jax` get the `compute` marker: their wall-clock is
+# XLA compilation, not framework logic, so CI can run the orchestrator
+# suite (-m 'not compute', minutes) separately from the compute suite.
+_COMPUTE_CACHE = {}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        path = str(item.fspath)
+        if path not in _COMPUTE_CACHE:
+            try:
+                with open(path, encoding='utf-8') as f:
+                    source = f.read()
+            except OSError:
+                source = ''
+            _COMPUTE_CACHE[path] = ('import jax' in source)
+        if _COMPUTE_CACHE[path]:
+            item.add_marker(pytest.mark.compute)
 
 # Small executor runner pools: enough for the concurrency tests, cheap
 # enough to respawn per test (each API-server test gets a fresh pool).
@@ -31,8 +59,21 @@ os.environ.setdefault('SKYT_SHORT_WORKERS', '4')
 # Runtime daemons spawned by tests tick fast: attached runs submit to the
 # cluster job queue and wait for the daemon to gang-start them, so the
 # production 1 Hz cadence adds ~1-2s to EVERY attached launch (r3 verdict
-# weak #7: a slow suite stops getting run).
+# weak #7: a slow suite stops getting run). Same story for the slurm
+# allocation poll and serve/jobs controller loops.
 os.environ.setdefault('SKYT_DAEMON_PERIOD', '0.05')
+os.environ.setdefault('SKYT_SLURM_POLL_SECONDS', '0.1')
+os.environ.setdefault('SKYT_CHANNEL_WATCH_PERIOD', '0.05')
+# One runtime tarball for the whole session (per-test state dirs would
+# re-hash + re-tar it on every ssh-mode launch) in a PRIVATE fresh dir
+# (a predictable /tmp name could be pre-planted by another local user),
+# and skip the remote `import skypilot_tpu` probe (~2s/host) — the
+# shipped package IS the package the tests run from.
+if 'SKYT_RUNTIME_PKG_CACHE' not in os.environ:
+    _pkg_cache = tempfile.mkdtemp(prefix='skyt-pkg-')
+    os.environ['SKYT_RUNTIME_PKG_CACHE'] = _pkg_cache
+    atexit.register(shutil.rmtree, _pkg_cache, True)
+os.environ.setdefault('SKYT_RUNTIME_SKIP_IMPORT_CHECK', '1')
 
 # Every process spawned anywhere under this test session (daemons,
 # API servers, executor runners, serve controllers — all detached via
