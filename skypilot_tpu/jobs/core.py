@@ -99,6 +99,24 @@ def tail_logs(job_id: int, controller: bool = False) -> str:
     if record is None:
         raise exceptions.JobNotFoundError(f'No managed job {job_id}.')
     if controller:
+        if record.controller_cluster and record.controller_pid:
+            # Offloaded controller: its log is a cluster job log. A
+            # NULL pid (claim window mid-respawn) has no log to read.
+            import io
+            from skypilot_tpu import state as state_lib
+            from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+            from skypilot_tpu.provision.api import ClusterInfo
+            cluster = state_lib.get_cluster(record.controller_cluster)
+            if cluster is None:
+                return ''
+            buf = io.StringIO()
+            try:
+                TpuPodBackend().tail_logs(
+                    ClusterInfo.from_dict(cluster.handle),
+                    record.controller_pid, stream=buf)
+            except exceptions.SkytError:
+                pass
+            return buf.getvalue()
         path = jobs_state.controller_log_path(job_id)
         if not os.path.exists(path):
             return ''

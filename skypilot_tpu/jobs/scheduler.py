@@ -9,6 +9,16 @@ the same way the reference's jobs controller runs on a SkyPilot cluster.
 Anyone may call ``maybe_schedule_next_jobs()`` — on submit, on controller
 state transitions, and on queue inspection — it is an idempotent
 claim-and-spawn loop over the WAITING jobs.
+
+**Controller offload** (parity: the reference's jobs controller runs on
+a provisioned SkyPilot cluster, sky/jobs/server/core.py:521): set
+``jobs.controller_cluster: <name>`` (or SKYT_JOBS_CONTROLLER_CLUSTER)
+to a pre-launched CPU cluster and controllers run there as detached
+cluster jobs instead of local processes — the API-server host stops
+being the ceiling on concurrent jobs. Controllers reach the shared
+state through SKYT_DB_URL (forwarded automatically), so this composes
+with the Postgres HA mode. Liveness = the controller job's status on
+that cluster; replacements respawn there under the same restart budget.
 """
 from __future__ import annotations
 
@@ -38,6 +48,74 @@ def _max_alive() -> int:
     return int(config.get_nested(('jobs', 'max_alive'), 64))
 
 
+def controller_cluster() -> 'str | None':
+    """Offload target, when configured (env > config > None=local)."""
+    from skypilot_tpu import config
+    return (os.environ.get('SKYT_JOBS_CONTROLLER_CLUSTER')
+            or config.get_nested(('jobs', 'controller_cluster'), None))
+
+
+def _spawn_local(job_id: int, resume: bool) -> None:
+    log_path = jobs_state.controller_log_path(job_id)
+    args = [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+            '--job-id', str(job_id)]
+    if resume:
+        args.append('--resume')
+    pid = subprocess_utils.daemonize_and_run(args, log_path=log_path)
+    jobs_state.set_controller_pid(job_id, pid)
+    logger.info('Managed job %s: controller pid %s%s', job_id, pid,
+                ' (resume)' if resume else '')
+
+
+def _spawn_controller(job_id: int, resume: bool = False) -> None:
+    """Start the controller process — locally, or as a detached CPU job
+    on the configured controller cluster — and record its identity."""
+    cluster = controller_cluster()
+    if cluster is None:
+        _spawn_local(job_id, resume)
+        return
+    from skypilot_tpu import execution
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.spec.resources import Resources
+    from skypilot_tpu.spec.task import Task
+    # The offloaded controller must see the SAME jobs/cluster state as
+    # the server: via the shared Postgres (SKYT_DB_URL — the HA story)
+    # or a shared-filesystem state dir. With neither, a remote
+    # controller would find an empty DB and burn the restart budget —
+    # run locally instead, loudly.
+    envs = {}
+    if state_lib.db_url():
+        envs['SKYT_DB_URL'] = state_lib.db_url()
+    if os.environ.get('SKYT_STATE_DIR'):
+        envs['SKYT_STATE_DIR'] = os.environ['SKYT_STATE_DIR']
+    if not envs:
+        logger.error(
+            'jobs.controller_cluster=%r is set but neither SKYT_DB_URL '
+            'nor a shared SKYT_STATE_DIR is configured — an offloaded '
+            'controller could not see the jobs DB. Running the '
+            'controller locally instead; configure a shared Postgres '
+            '(SKYT_DB_URL) to actually offload.', cluster)
+        _spawn_local(job_id, resume)
+        return
+    resume_flag = ' --resume' if resume else ''
+    task = Task(
+        name=f'skyt-controller-{job_id}',
+        run=('PYTHONPATH=~/.skyt_runtime/runtime:$PYTHONPATH '
+             f'python3 -um skypilot_tpu.jobs.controller '
+             f'--job-id {job_id}{resume_flag}'),
+        envs=envs,
+        # CPU-only: controller jobs SHARE the controller cluster (the
+        # daemon admits them concurrently; TPU exclusivity untouched).
+        resources=Resources())
+    results = execution.exec_(task, cluster, detach_run=True)
+    cluster_job_id = results[0][1]
+    jobs_state.set_controller_pid(job_id, cluster_job_id,
+                                  controller_cluster=cluster)
+    logger.info('Managed job %s: controller is job %s on cluster %s%s',
+                job_id, cluster_job_id, cluster,
+                ' (resume)' if resume else '')
+
+
 def maybe_schedule_next_jobs() -> None:
     """Claim WAITING jobs into LAUNCHING slots and spawn controllers."""
     while True:
@@ -45,13 +123,19 @@ def maybe_schedule_next_jobs() -> None:
                                               _max_alive())
         if job_id is None:
             return
-        log_path = jobs_state.controller_log_path(job_id)
-        pid = subprocess_utils.daemonize_and_run(
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id)],
-            log_path=log_path)
-        jobs_state.set_controller_pid(job_id, pid)
-        logger.info('Managed job %s: controller pid %s', job_id, pid)
+        try:
+            _spawn_controller(job_id)
+        except Exception as e:  # pylint: disable=broad-except
+            # A failed spawn (offload cluster missing/restarting) must
+            # RELEASE the claimed slot or the job is stuck LAUNCHING
+            # with no controller forever; the next scheduler tick
+            # retries from WAITING.
+            logger.error(
+                'Managed job %s: controller spawn failed (%s); '
+                'returning the job to WAITING for retry', job_id, e)
+            jobs_state.set_schedule_state(
+                job_id, jobs_state.ScheduleState.WAITING)
+            return
 
 
 def launch_done(job_id: int) -> None:
@@ -91,17 +175,50 @@ def _controller_alive(pid: int) -> bool:
     return True
 
 
+def _try_spawn_replacement(record, old_pid) -> None:
+    """Replacement spawn that never propagates: the reaper runs inline
+    from `skyt jobs queue` and must keep reaping the other jobs. A
+    failed spawn (offload cluster briefly down) leaves the claim
+    timestamp in place, so the stale-claim path retries after its
+    grace."""
+    try:
+        _spawn_replacement(record, old_pid)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.error(
+            'Managed job %s: replacement controller spawn failed (%s); '
+            'will retry after the claim grace period.',
+            record.job_id, e)
+
+
 def _spawn_replacement(record, old_pid) -> None:
-    log_path = jobs_state.controller_log_path(record.job_id)
-    new_pid = subprocess_utils.daemonize_and_run(
-        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-         '--job-id', str(record.job_id), '--resume'],
-        log_path=log_path)
-    jobs_state.set_controller_pid(record.job_id, new_pid)
     logger.warning(
-        'Managed job %s: controller %s died; resumed with replacement '
-        'pid %s (restart %d/%d).', record.job_id, old_pid, new_pid,
+        'Managed job %s: controller %s died; spawning replacement '
+        '(restart %d/%d).', record.job_id, old_pid,
         record.controller_restarts + 1, _controller_max_restarts())
+    _spawn_controller(record.job_id, resume=True)
+
+
+def _controller_alive_for(record) -> bool:
+    """Liveness for either controller placement: a local pid, or a
+    controller job on the offload cluster."""
+    if record.controller_cluster:
+        from skypilot_tpu import core, exceptions
+        from skypilot_tpu.runtime import job_lib
+        try:
+            jobs = core.queue(record.controller_cluster)
+        except (exceptions.ClusterDoesNotExist,
+                exceptions.ClusterNotUpError):
+            return False   # controller cluster conclusively gone
+        except Exception:  # pylint: disable=broad-except
+            # Transient (SSH blip, channel reconnect): INCONCLUSIVE must
+            # read as alive — declaring a healthy controller dead would
+            # spawn a duplicate and burn the restart budget.
+            return True
+        row = next((j for j in jobs
+                    if j.get('job_id') == record.controller_pid), None)
+        return (row is not None and
+                not job_lib.JobStatus(row['status']).is_terminal())
+    return _controller_alive(record.controller_pid)
 
 
 def reap_dead_controllers() -> None:
@@ -118,7 +235,7 @@ def reap_dead_controllers() -> None:
                                      jobs_state.ScheduleState.DONE):
             continue
         pid = record.controller_pid
-        if pid is None:
+        if pid is None:  # pylint: disable=duplicate-code
             # Claim-window orphan: a previous reaper NULLed the pid but
             # died before spawning the replacement. After a grace period
             # the stale claim is re-claimable (atomic; normal in-flight
@@ -126,13 +243,13 @@ def reap_dead_controllers() -> None:
             if (record.controller_claimed_at is not None and
                     jobs_state.reclaim_stale_controller_claim(
                         record.job_id)):
-                _spawn_replacement(record, old_pid=None)
+                _try_spawn_replacement(record, old_pid=None)
             continue
-        if _controller_alive(pid):
+        if _controller_alive_for(record):
             continue
         if jobs_state.claim_controller_restart(
                 record.job_id, pid, _controller_max_restarts()):
-            _spawn_replacement(record, old_pid=pid)
+            _try_spawn_replacement(record, old_pid=pid)
             continue
         # Claim lost: either another process is spawning the replacement
         # right now, or the restart budget is spent. Only the latter

@@ -63,16 +63,36 @@ def controller_log_path(job_id: int) -> str:
 _local = threading.local()
 
 
-def _db() -> sqlite3.Connection:
-    path = os.path.join(jobs_dir(), 'jobs.db')
+# (url, pid) pairs whose shared-DB schema this process already ensured.
+_pg_schema_ready: set = set()
+
+
+def _db():
+    """sqlite (default) or the shared Postgres when SKYT_DB_URL is set —
+    the same dual backend as the cluster state DB (state._db): managed
+    jobs must be visible to every API-server replica AND to controllers
+    running off the server host (controller-offload mode)."""
+    from skypilot_tpu import state as state_lib
+    url = state_lib.db_url()
+    path = (f'{url}#jobs' if url
+            else os.path.join(jobs_dir(), 'jobs.db'))
     conn = getattr(_local, 'conn', None)
     if (conn is not None and getattr(_local, 'path', None) == path and
             getattr(_local, 'pid', None) == os.getpid()):
         return conn
-    os.makedirs(jobs_dir(), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
+    if url is not None:
+        from skypilot_tpu.utils import pg
+        conn = pg.PgSqliteAdapter(pg.PgConnection.from_url(url))
+        if (url, os.getpid()) in _pg_schema_ready:
+            _local.conn = conn
+            _local.path = path
+            _local.pid = os.getpid()
+            return conn
+    else:
+        os.makedirs(jobs_dir(), exist_ok=True)
+        conn = sqlite3.connect(path, timeout=10)
+        conn.row_factory = sqlite3.Row
+        conn.execute('PRAGMA journal_mode=WAL')
     conn.executescript("""
         CREATE TABLE IF NOT EXISTS jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -115,7 +135,13 @@ def _db() -> sqlite3.Connection:
     if 'controller_claimed_at' not in cols:
         _add_column('ALTER TABLE jobs ADD COLUMN controller_claimed_at '
                     'REAL')
+    if 'controller_cluster' not in cols:
+        # Controller-offload mode: which cluster hosts this job's
+        # controller process (NULL = a local process on the server).
+        _add_column('ALTER TABLE jobs ADD COLUMN controller_cluster TEXT')
     conn.commit()
+    if url is not None:
+        _pg_schema_ready.add((url, os.getpid()))
     _local.conn = conn
     _local.path = path
     _local.pid = os.getpid()
@@ -146,6 +172,7 @@ class JobRecord:
         self.workspace: str = row['workspace'] or 'default'
         self.controller_claimed_at: Optional[float] = (
             row['controller_claimed_at'])
+        self.controller_cluster: Optional[str] = row['controller_cluster']
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -174,13 +201,16 @@ def submit(task_config: Dict[str, Any],
     # the job's workspace, not the spawner's.
     from skypilot_tpu import workspaces
     conn = _db()
-    cur = conn.execute(
-        'INSERT INTO jobs (name, task_config, status, schedule_state, '
-        'strategy, max_restarts_on_errors, submitted_at, group_name, '
-        'workspace) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
-        (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
-         ScheduleState.WAITING.value, strategy, max_restarts_on_errors,
-         time.time(), group_name, workspaces.active_workspace()))
+    sql = ('INSERT INTO jobs (name, task_config, status, schedule_state, '
+           'strategy, max_restarts_on_errors, submitted_at, group_name, '
+           'workspace) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)')
+    params = (name, json.dumps(task_config),
+              ManagedJobStatus.PENDING.value, ScheduleState.WAITING.value,
+              strategy, max_restarts_on_errors, time.time(), group_name,
+              workspaces.active_workspace())
+    if getattr(conn, 'is_postgres', False):
+        return conn.insert_returning(sql, params, 'job_id')
+    cur = conn.execute(sql, params)
     conn.commit()
     return cur.lastrowid
 
@@ -280,52 +310,87 @@ def claim_waiting_job(max_launching: int, max_alive: int) -> Optional[int]:
     (parity: the jobs scheduler's single-transaction claim,
     jobs/scheduler.py:29-33)."""
     conn = _db()
+    is_pg = getattr(conn, 'is_postgres', False)
     with _claim_lock:
         # Schedulers run in many processes (API-server workers and every
         # controller); BEGIN IMMEDIATE takes the write lock up front so
         # count-then-claim is atomic across processes, not just threads.
-        conn.commit()
-        conn.execute('BEGIN IMMEDIATE')
+        # On the shared-Postgres backend the atomicity comes from an
+        # advisory lock on THIS connection instead (replicas on other
+        # machines also claim; session locks are transaction-independent
+        # and cost no extra connection handshake).
+        locked = False
         try:
-            launching = conn.execute(
-                'SELECT COUNT(*) FROM jobs WHERE schedule_state = ?',
-                (ScheduleState.LAUNCHING.value,)).fetchone()[0]
-            alive = conn.execute(
-                'SELECT COUNT(*) FROM jobs WHERE schedule_state IN (?, ?)',
-                (ScheduleState.LAUNCHING.value,
-                 ScheduleState.ALIVE.value)).fetchone()[0]
-            if launching >= max_launching or alive >= max_alive:
-                conn.rollback()
-                return None
-            row = conn.execute(
-                'SELECT job_id FROM jobs WHERE schedule_state = ? '
-                'ORDER BY job_id LIMIT 1',
-                (ScheduleState.WAITING.value,)).fetchone()
-            if row is None:
-                conn.rollback()
-                return None
-            cur = conn.execute(
-                'UPDATE jobs SET schedule_state = ? WHERE job_id = ? '
-                'AND schedule_state = ?',
-                (ScheduleState.LAUNCHING.value, row['job_id'],
-                 ScheduleState.WAITING.value))
-            if cur.rowcount != 1:
-                conn.rollback()
-                return None
+            if is_pg:
+                while True:
+                    got = conn.execute(
+                        f'SELECT pg_try_advisory_lock({_CLAIM_LOCK_KEY})'
+                        ' AS ok').fetchone()['ok']
+                    if got is True or got == 't':
+                        locked = True
+                        break
+                    time.sleep(0.05)
             conn.commit()
-            return row['job_id']
-        except sqlite3.Error:
-            conn.rollback()
-            raise
+            conn.execute('BEGIN IMMEDIATE')
+            try:
+                launching = conn.execute(
+                    'SELECT COUNT(*) FROM jobs WHERE schedule_state = ?',
+                    (ScheduleState.LAUNCHING.value,)).fetchone()[0]
+                alive = conn.execute(
+                    'SELECT COUNT(*) FROM jobs WHERE schedule_state '
+                    'IN (?, ?)',
+                    (ScheduleState.LAUNCHING.value,
+                     ScheduleState.ALIVE.value)).fetchone()[0]
+                if launching >= max_launching or alive >= max_alive:
+                    conn.rollback()
+                    return None
+                row = conn.execute(
+                    'SELECT job_id FROM jobs WHERE schedule_state = ? '
+                    'ORDER BY job_id LIMIT 1',
+                    (ScheduleState.WAITING.value,)).fetchone()
+                if row is None:
+                    conn.rollback()
+                    return None
+                cur = conn.execute(
+                    'UPDATE jobs SET schedule_state = ? WHERE job_id = ? '
+                    'AND schedule_state = ?',
+                    (ScheduleState.LAUNCHING.value, row['job_id'],
+                     ScheduleState.WAITING.value))
+                if cur.rowcount != 1:
+                    conn.rollback()
+                    return None
+                conn.commit()
+                return row['job_id']
+            except Exception:
+                # Roll back on ANY failure — a PG error would otherwise
+                # leave this thread's cached connection wedged in an
+                # aborted transaction (every later call fails).
+                conn.rollback()
+                raise
+        finally:
+            if locked:
+                try:
+                    conn.execute('SELECT pg_advisory_unlock'
+                                 f'({_CLAIM_LOCK_KEY})')
+                except Exception:  # pylint: disable=broad-except
+                    pass  # session death releases it server-side
 
 
 _claim_lock = threading.Lock()
+# Stable 64-bit advisory-lock key for the cross-replica claim section
+# (= int.from_bytes(sha256(b'jobs-scheduler-claim')[:8], signed)).
+_CLAIM_LOCK_KEY = 2766150969836407153
 
 
-def set_controller_pid(job_id: int, pid: int) -> None:
+def set_controller_pid(job_id: int, pid: int,
+                       controller_cluster: Optional[str] = None) -> None:
+    """Record where this job's controller runs: a local pid
+    (controller_cluster None) or a job id ON the named controller
+    cluster (offload mode)."""
     conn = _db()
-    conn.execute('UPDATE jobs SET controller_pid = ? WHERE job_id = ?',
-                 (pid, job_id))
+    conn.execute(
+        'UPDATE jobs SET controller_pid = ?, controller_cluster = ? '
+        'WHERE job_id = ?', (pid, controller_cluster, job_id))
     conn.commit()
 
 

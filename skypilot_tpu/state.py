@@ -44,49 +44,6 @@ def db_url() -> Optional[str]:
     return os.environ.get('SKYT_DB_URL') or None
 
 
-class _PgAdapter:
-    """sqlite3-connection-shaped facade over utils/pg.PgConnection,
-    translating the schema's sqlite-isms to Postgres."""
-
-    def __init__(self, conn) -> None:
-        self._conn = conn
-
-    @staticmethod
-    def _translate(sql: str) -> Optional[str]:
-        stripped = sql.strip()
-        if stripped.startswith('PRAGMA journal_mode'):
-            return None                      # sqlite-only tuning
-        if stripped.startswith('PRAGMA table_info'):
-            table = stripped.split('(', 1)[1].rstrip(') ')
-            return ("SELECT column_name AS name FROM "
-                    "information_schema.columns WHERE table_name="
-                    f"'{table}'")
-        sql = sql.replace('INTEGER PRIMARY KEY AUTOINCREMENT',
-                          'BIGSERIAL PRIMARY KEY')
-        # sqlite REAL is 8-byte; Postgres REAL is float4, which rounds
-        # epoch timestamps to ~2-minute granularity. DDL only (the word
-        # appears nowhere else in this module's SQL).
-        return sql.replace(' REAL', ' DOUBLE PRECISION')
-
-    def execute(self, sql: str, params=()):
-        translated = self._translate(sql)
-        if translated is None:
-            from skypilot_tpu.utils.pg import _Result
-            return _Result([], [], [])
-        return self._conn.execute(translated, params)
-
-    def executescript(self, script: str) -> None:
-        for statement in script.split(';'):
-            if statement.strip():
-                self.execute(statement)
-
-    def commit(self) -> None:
-        pass
-
-    def close(self) -> None:
-        self._conn.close()
-
-
 def _db():
     """Per-thread connection; schema created on first use. Re-opened
     after fork: sharing a parent's sqlite connection across processes
@@ -99,7 +56,7 @@ def _db():
         return conn
     if url is not None:
         from skypilot_tpu.utils import pg
-        conn = _PgAdapter(pg.PgConnection.from_url(url))
+        conn = pg.PgSqliteAdapter(pg.PgConnection.from_url(url))
         # The shared DB's schema is ensured ONCE per process, not per
         # request thread — replaying 4 CREATE TABLEs + the migration
         # probe on every HTTP request thread is pure round-trip waste.

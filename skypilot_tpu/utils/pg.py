@@ -84,26 +84,45 @@ _OID_CAST = {
 }
 
 
+class Row(dict):
+    """A result row addressable by column name OR position (the two
+    access styles sqlite3.Row callers use)."""
+
+    def __init__(self, columns: List[str], values: List[Any]) -> None:
+        super().__init__(zip(columns, values))
+        self._values = values
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._values[key]
+        return super().__getitem__(key)
+
+
 class _Result:
-    """sqlite3-cursor-shaped result set (dict rows, typed values)."""
+    """sqlite3-cursor-shaped result set (typed rows + rowcount)."""
 
     def __init__(self, columns: List[str], oids: List[int],
-                 rows: List[List[Optional[str]]]) -> None:
+                 rows: List[List[Optional[str]]],
+                 rowcount: int = -1) -> None:
         casts = [_OID_CAST.get(oid) for oid in oids]
         self._rows = [
-            {name: (value if value is None or cast is None
-                    else cast(value))
-             for name, cast, value in zip(columns, casts, row)}
+            Row(columns,
+                [value if value is None or cast is None else cast(value)
+                 for cast, value in zip(casts, row)])
             for row in rows
         ]
+        # DML statements report affected rows via the CommandComplete
+        # tag; SELECTs report the row count (matching sqlite cursors
+        # closely enough for the `rowcount == 1` guard idiom).
+        self.rowcount = rowcount
 
-    def fetchone(self) -> Optional[Dict[str, Any]]:
+    def fetchone(self) -> Optional[Row]:
         return self._rows[0] if self._rows else None
 
-    def fetchall(self) -> List[Dict[str, Any]]:
+    def fetchall(self) -> List[Row]:
         return list(self._rows)
 
-    def __iter__(self) -> Iterator[Dict[str, Any]]:
+    def __iter__(self) -> Iterator[Row]:
         return iter(self._rows)
 
 
@@ -248,6 +267,7 @@ class PgConnection:
         columns: List[str] = []
         oids: List[int] = []
         rows: List[List[Optional[str]]] = []
+        rowcount = -1
         error: Optional[PgError] = None
         while True:
             mtype, body = self._recv_message()
@@ -255,13 +275,18 @@ class PgConnection:
                 columns, oids = _parse_row_description(body)
             elif mtype == b'D':      # DataRow
                 rows.append(_parse_data_row(body))
+            elif mtype == b'C':      # CommandComplete: "UPDATE 3" etc.
+                tag = body.rstrip(b'\0').decode('ascii', 'replace')
+                parts = tag.split()
+                if parts and parts[-1].isdigit():
+                    rowcount = int(parts[-1])
             elif mtype == b'E':
                 error = PgError(_parse_error(body))
             elif mtype == b'Z':      # ReadyForQuery: statement done
                 if error is not None:
                     raise error
-                return _Result(columns, oids, rows)
-            # C (CommandComplete) / N (Notice) / I (EmptyQuery): skip
+                return _Result(columns, oids, rows, rowcount)
+            # N (Notice) / I (EmptyQuery): skip
 
     def executescript(self, script: str) -> None:
         for statement in script.split(';'):
@@ -319,3 +344,68 @@ def _parse_data_row(body: bytes) -> List[Optional[str]]:
                                                               'replace'))
             offset += length
     return values
+
+
+class PgSqliteAdapter:
+    """sqlite3-connection-shaped facade over PgConnection, translating
+    the state layers' sqlite-isms so one SQL body serves both backends
+    (state.py, jobs/state.py)."""
+
+    is_postgres = True
+
+    def __init__(self, conn: 'PgConnection') -> None:
+        self._conn = conn
+
+    @staticmethod
+    def _translate(sql: str) -> Optional[str]:
+        stripped = sql.strip()
+        if stripped.startswith('PRAGMA journal_mode'):
+            return None                      # sqlite-only tuning
+        if stripped.startswith('PRAGMA table_info'):
+            table = stripped.split('(', 1)[1].rstrip(') ')
+            return ("SELECT column_name AS name FROM "
+                    "information_schema.columns WHERE table_name="
+                    f"'{table}'")
+        if stripped == 'BEGIN IMMEDIATE':
+            return 'BEGIN'
+        sql = sql.replace('INTEGER PRIMARY KEY AUTOINCREMENT',
+                          'BIGSERIAL PRIMARY KEY')
+        # sqlite REAL is 8-byte; Postgres REAL is float4, which rounds
+        # epoch timestamps to ~2-minute granularity (DDL-only token).
+        return sql.replace(' REAL', ' DOUBLE PRECISION')
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> _Result:
+        translated = self._translate(sql)
+        if translated is None:
+            return _Result([], [], [])
+        return self._conn.execute(translated, params)
+
+    def executescript(self, script: str) -> None:
+        for statement in script.split(';'):
+            if statement.strip():
+                self.execute(statement)
+
+    def insert_returning(self, sql: str, params: Sequence[Any],
+                         id_column: str) -> int:
+        """INSERT returning the new row id (sqlite callers use
+        cursor.lastrowid, which the wire protocol has no analog for)."""
+        row = self.execute(f'{sql} RETURNING {id_column}',
+                           params).fetchone()
+        return int(row[id_column])
+
+    def commit(self) -> None:
+        # Outside an explicit BEGIN, simple-protocol statements
+        # autocommit; inside one, COMMIT ends it.
+        try:
+            self.execute('COMMIT')
+        except PgError:
+            pass  # no transaction in progress
+
+    def rollback(self) -> None:
+        try:
+            self.execute('ROLLBACK')
+        except PgError:
+            pass
+
+    def close(self) -> None:
+        self._conn.close()

@@ -214,6 +214,12 @@ class FakePgServer:
                                 [['t']])
 
     def _query(self, sock, conn_id, sql: str) -> None:
+        # Transaction statements are no-ops here: the fake serializes
+        # every query under one lock, and its per-statement sqlite
+        # commit would fight real BEGIN/COMMIT bookkeeping.
+        if sql.strip().upper() in ('BEGIN', 'COMMIT', 'ROLLBACK'):
+            self._send(sock, b'C', sql.strip().upper().encode() + b'\0')
+            return
         m = _ADVISORY_RE.match(sql.strip())
         if m:
             self._advisory_op(sock, conn_id, m.group(1).lower(),
@@ -229,13 +235,18 @@ class FakePgServer:
                 cursor = self._sqlite.execute(sql)
                 rows = cursor.fetchall()
                 description = cursor.description
+                rowcount = cursor.rowcount
                 self._sqlite.commit()
         except sqlite3.Error as e:
             code = ('42701' if 'duplicate column' in str(e) else 'XX000')
             self._send_error(sock, str(e), code=code)
             return
         if description is None:
-            self._send(sock, b'C', b'OK\0')
+            # Real CommandComplete tags carry the affected-row count
+            # ('UPDATE 3'), which clients' rowcount guards rely on.
+            verb = (sql.split() or ['OK'])[0].upper()
+            self._send(sock, b'C',
+                       f'{verb} {max(rowcount, 0)}\0'.encode())
             return
         columns = [d[0] for d in description]
         oids = []
@@ -271,3 +282,13 @@ class FakePgServer:
                     body += struct.pack('>i', len(encoded)) + encoded
             self._send(sock, b'D', body)
         self._send(sock, b'C', f'SELECT {len(rows)}\0'.encode())
+
+
+if __name__ == '__main__':
+    # Standalone mode for CLI-level drives: print the DSN, serve until
+    # killed.
+    import time as _time
+    _server = FakePgServer()
+    print(_server.url, flush=True)
+    while True:
+        _time.sleep(60)

@@ -159,3 +159,48 @@ def test_log_gc_prunes_aged_controller_logs(tmp_home, monkeypatch):
     # Non-positive retention disables collection entirely.
     monkeypatch.setenv('SKYT_JOBS_LOG_RETENTION_HOURS', '0')
     assert log_gc.collect(now=time.time() + 9e9) == 0
+
+
+def test_controller_offload_runs_on_cluster(monkeypatch):
+    """r3 missing #4 (parity: sky/jobs/server/core.py:521 — controllers
+    run on a provisioned cluster, not the API-server host): with
+    jobs.controller_cluster configured, the controller is a detached
+    CPU job on that cluster; the managed job completes, liveness and
+    controller logs route through the cluster."""
+    from skypilot_tpu import core as sky_core
+    from skypilot_tpu import execution
+    from skypilot_tpu.jobs import scheduler
+
+    # A pre-launched CPU-style controller cluster on the fake provider.
+    execution.launch(
+        Task(name='ctl',
+             resources=Resources(cloud='fake', accelerators='tpu-v5e-8')),
+        cluster_name='ctl-cluster')
+    monkeypatch.setenv('SKYT_JOBS_CONTROLLER_CLUSTER', 'ctl-cluster')
+
+    job_id = jobs_core.launch(_task('echo offloaded-ok'))
+    record = _wait_status(job_id, {'SUCCEEDED'})
+
+    # The controller ran ON the cluster, identified by a cluster job id.
+    assert record.controller_cluster == 'ctl-cluster'
+    # The controller job may still be tearing the worker cluster down
+    # for a beat after the managed job turns SUCCEEDED.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        ctl_jobs = {j['job_id']: j
+                    for j in sky_core.queue('ctl-cluster')}
+        ctl_job = ctl_jobs[record.controller_pid]
+        if ctl_job['status'] == 'SUCCEEDED':
+            break
+        time.sleep(0.5)
+    assert ctl_job['name'] == f'skyt-controller-{job_id}'
+    assert ctl_job['status'] == 'SUCCEEDED'
+    assert ctl_job['metadata'].get('uses_tpu') is False  # shares freely
+
+    # Controller logs route through the cluster job log.
+    log = jobs_core.tail_logs(job_id, controller=True)
+    assert 'launch' in log.lower() or log  # controller produced output
+
+    # Liveness: a finished controller job reads as dead (so the reaper
+    # would act on a non-terminal managed job), a running one as alive.
+    assert not scheduler._controller_alive_for(record)

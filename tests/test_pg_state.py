@@ -105,3 +105,33 @@ def test_advisory_lock_released_when_holder_connection_dies(pg_server):
     assert acquired.wait(timeout=5), (
         'advisory lock not released on holder disconnect')
     waiter.release()
+
+
+def test_managed_jobs_state_on_postgres(pg_server):
+    from skypilot_tpu.jobs import state as jobs_state
+    jobs_state._local.__dict__.clear()
+    job_a = jobs_state.submit({'run': 'echo a'}, 'job-a', 'FAILOVER', 1)
+    job_b = jobs_state.submit({'run': 'echo b'}, 'job-b', 'FAILOVER', 0)
+    assert (job_a, job_b) == (1, 2)
+
+    # Claim honors FIFO and the launching cap.
+    assert jobs_state.claim_waiting_job(1, 10) == job_a
+    assert jobs_state.claim_waiting_job(1, 10) is None  # cap hit
+    jobs_state.set_schedule_state(job_a, jobs_state.ScheduleState.ALIVE)
+    assert jobs_state.claim_waiting_job(1, 10) == job_b
+
+    assert jobs_state.set_status(
+        job_a, jobs_state.ManagedJobStatus.RUNNING)
+    assert jobs_state.set_status(
+        job_a, jobs_state.ManagedJobStatus.SUCCEEDED)
+    # Terminal status never overwritten (the rowcount-guard idiom).
+    assert not jobs_state.set_status(
+        job_a, jobs_state.ManagedJobStatus.RUNNING)
+
+    record = jobs_state.get(job_a)
+    assert record.status == jobs_state.ManagedJobStatus.SUCCEEDED
+    assert record.max_restarts_on_errors == 1
+    assert isinstance(record.submitted_at, float)
+    names = [r.name for r in jobs_state.list_jobs()]
+    assert names == ['job-b', 'job-a']
+    jobs_state._local.__dict__.clear()
