@@ -107,8 +107,8 @@ def tail_logs(job_id: int, controller: bool = False) -> str:
             from skypilot_tpu.backend.tpu_backend import TpuPodBackend
             from skypilot_tpu.provision.api import ClusterInfo
             cluster = state_lib.get_cluster(record.controller_cluster)
-            if cluster is None:
-                return ''
+            if cluster is None or not cluster.handle.get('hosts'):
+                return ''  # stopped/mid-relaunch: no hosts to read from
             buf = io.StringIO()
             try:
                 TpuPodBackend().tail_logs(

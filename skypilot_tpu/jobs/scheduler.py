@@ -55,13 +55,22 @@ def controller_cluster() -> 'str | None':
             or config.get_nested(('jobs', 'controller_cluster'), None))
 
 
+class ControllerSpawnError(Exception):
+    """The controller process/job could NOT be started (the claimed
+    slot is safe to release). Post-spawn bookkeeping failures are NOT
+    this — there the controller is already running."""
+
+
 def _spawn_local(job_id: int, resume: bool) -> None:
     log_path = jobs_state.controller_log_path(job_id)
     args = [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
             '--job-id', str(job_id)]
     if resume:
         args.append('--resume')
-    pid = subprocess_utils.daemonize_and_run(args, log_path=log_path)
+    try:
+        pid = subprocess_utils.daemonize_and_run(args, log_path=log_path)
+    except Exception as e:
+        raise ControllerSpawnError(str(e)) from e
     jobs_state.set_controller_pid(job_id, pid)
     logger.info('Managed job %s: controller pid %s%s', job_id, pid,
                 ' (resume)' if resume else '')
@@ -83,7 +92,10 @@ def _spawn_controller(job_id: int, resume: bool = False) -> None:
     # or a shared-filesystem state dir. With neither, a remote
     # controller would find an empty DB and burn the restart budget —
     # run locally instead, loudly.
-    envs = {}
+    # The controller's own scheduler ticks (launch_done/job_done) spawn
+    # SIBLING controllers — they must land on this same cluster, not as
+    # local processes on the controller-cluster node.
+    envs = {'SKYT_JOBS_CONTROLLER_CLUSTER': cluster}
     if state_lib.db_url():
         envs['SKYT_DB_URL'] = state_lib.db_url()
     if os.environ.get('SKYT_STATE_DIR'):
@@ -107,7 +119,10 @@ def _spawn_controller(job_id: int, resume: bool = False) -> None:
         # CPU-only: controller jobs SHARE the controller cluster (the
         # daemon admits them concurrently; TPU exclusivity untouched).
         resources=Resources())
-    results = execution.exec_(task, cluster, detach_run=True)
+    try:
+        results = execution.exec_(task, cluster, detach_run=True)
+    except Exception as e:
+        raise ControllerSpawnError(str(e)) from e
     cluster_job_id = results[0][1]
     jobs_state.set_controller_pid(job_id, cluster_job_id,
                                   controller_cluster=cluster)
@@ -125,16 +140,26 @@ def maybe_schedule_next_jobs() -> None:
             return
         try:
             _spawn_controller(job_id)
-        except Exception as e:  # pylint: disable=broad-except
-            # A failed spawn (offload cluster missing/restarting) must
-            # RELEASE the claimed slot or the job is stuck LAUNCHING
-            # with no controller forever; the next scheduler tick
-            # retries from WAITING.
+        except ControllerSpawnError as e:
+            # Nothing started: RELEASE the claimed slot or the job is
+            # stuck LAUNCHING with no controller forever; the next
+            # scheduler tick retries from WAITING.
             logger.error(
                 'Managed job %s: controller spawn failed (%s); '
                 'returning the job to WAITING for retry', job_id, e)
             jobs_state.set_schedule_state(
                 job_id, jobs_state.ScheduleState.WAITING)
+            return
+        except Exception as e:  # pylint: disable=broad-except
+            # The controller IS running but its identity wasn't
+            # recorded (transient DB blip). Re-WAITING would spawn a
+            # DUPLICATE controller — leave the job; the controller
+            # itself advances the schedule state, only crash-restart
+            # coverage is degraded for this job.
+            logger.error(
+                'Managed job %s: controller started but bookkeeping '
+                'failed (%s); crash-restart coverage degraded for this '
+                'job.', job_id, e)
             return
 
 
@@ -198,24 +223,43 @@ def _spawn_replacement(record, old_pid) -> None:
     _spawn_controller(record.job_id, resume=True)
 
 
-def _controller_alive_for(record) -> bool:
+_CLUSTER_GONE = object()
+_CLUSTER_UNREACHABLE = object()
+
+
+def _fetch_controller_queue(cluster: str, cache: dict):
+    """One job-table fetch per controller cluster per reap pass (N
+    offloaded jobs share a cluster; N identical SSH fetches scale queue
+    inspection linearly for nothing)."""
+    if cluster not in cache:
+        from skypilot_tpu import core, exceptions
+        try:
+            cache[cluster] = {j.get('job_id'): j
+                              for j in core.queue(cluster)}
+        except (exceptions.ClusterDoesNotExist,
+                exceptions.ClusterNotUpError):
+            cache[cluster] = _CLUSTER_GONE
+        except Exception:  # pylint: disable=broad-except
+            cache[cluster] = _CLUSTER_UNREACHABLE
+    return cache[cluster]
+
+
+def _controller_alive_for(record, queue_cache=None) -> bool:
     """Liveness for either controller placement: a local pid, or a
     controller job on the offload cluster."""
     if record.controller_cluster:
-        from skypilot_tpu import core, exceptions
         from skypilot_tpu.runtime import job_lib
-        try:
-            jobs = core.queue(record.controller_cluster)
-        except (exceptions.ClusterDoesNotExist,
-                exceptions.ClusterNotUpError):
+        jobs = _fetch_controller_queue(record.controller_cluster,
+                                       queue_cache if queue_cache
+                                       is not None else {})
+        if jobs is _CLUSTER_GONE:
             return False   # controller cluster conclusively gone
-        except Exception:  # pylint: disable=broad-except
+        if jobs is _CLUSTER_UNREACHABLE:
             # Transient (SSH blip, channel reconnect): INCONCLUSIVE must
             # read as alive — declaring a healthy controller dead would
             # spawn a duplicate and burn the restart budget.
             return True
-        row = next((j for j in jobs
-                    if j.get('job_id') == record.controller_pid), None)
+        row = jobs.get(record.controller_pid)
         return (row is not None and
                 not job_lib.JobStatus(row['status']).is_terminal())
     return _controller_alive(record.controller_pid)
@@ -230,6 +274,7 @@ def reap_dead_controllers() -> None:
     past that budget is the job failed as FAILED_CONTROLLER. Run on
     queue inspection + by the server's jobs-refresh daemon, so jobs
     survive an API-server restart too."""
+    queue_cache: dict = {}
     for record in jobs_state.list_jobs(skip_finished=True):
         if record.schedule_state in (jobs_state.ScheduleState.WAITING,
                                      jobs_state.ScheduleState.DONE):
@@ -245,7 +290,7 @@ def reap_dead_controllers() -> None:
                         record.job_id)):
                 _try_spawn_replacement(record, old_pid=None)
             continue
-        if _controller_alive_for(record):
+        if _controller_alive_for(record, queue_cache):
             continue
         if jobs_state.claim_controller_restart(
                 record.job_id, pid, _controller_max_restarts()):

@@ -395,17 +395,14 @@ class PgSqliteAdapter:
 
     def commit(self) -> None:
         # Outside an explicit BEGIN, simple-protocol statements
-        # autocommit; inside one, COMMIT ends it.
-        try:
-            self.execute('COMMIT')
-        except PgError:
-            pass  # no transaction in progress
+        # autocommit and COMMIT is a harmless WARNING (not an error) —
+        # so a raised PgError here is a REAL failed commit and must
+        # propagate: swallowing it would let a claim 'succeed' that the
+        # server rolled back.
+        self.execute('COMMIT')
 
     def rollback(self) -> None:
-        try:
-            self.execute('ROLLBACK')
-        except PgError:
-            pass
+        self.execute('ROLLBACK')
 
     def close(self) -> None:
         self._conn.close()

@@ -28,10 +28,17 @@ def test_registry_lists_all_recipes():
 
 
 def test_every_recipe_parses_as_task():
+    from skypilot_tpu.spec.dag import Dag
     for r in recipes.list_recipes():
-        task = Task.from_yaml(f"recipe://{r['name']}")
-        assert task.run, f"recipe {r['name']} has no run command"
-        assert task.resources[0].accelerators is not None
+        # Recipes may be single tasks or multi-document pipelines
+        # (chains / fan-out graphs); both load through Dag.from_yaml.
+        dag = Dag.from_yaml(recipes.resolve(f"recipe://{r['name']}"))
+        for task in dag.tasks:
+            assert task.run, (f"recipe {r['name']} task "
+                              f"{task.name!r} has no run command")
+        assert any(t.resources[0].accelerators is not None
+                   for t in dag.tasks), (
+            f"recipe {r['name']} requests no accelerators anywhere")
 
 
 def test_resolve_unknown_recipe():
