@@ -204,3 +204,32 @@ def test_controller_offload_runs_on_cluster(monkeypatch):
     # Liveness: a finished controller job reads as dead (so the reaper
     # would act on a non-terminal managed job), a running one as alive.
     assert not scheduler._controller_alive_for(record)
+
+
+def test_offloaded_sibling_controllers_land_on_cluster(monkeypatch):
+    """The sibling-spawn path: with max_launching=1, job 2's controller
+    is spawned by job 1's controller's own scheduler tick (launch_done)
+    running ON the controller cluster — it must land on that same
+    cluster (env forwarded), not as a stray local process with a
+    misread pid."""
+    from skypilot_tpu import core as sky_core
+    from skypilot_tpu import execution
+
+    execution.launch(
+        Task(name='ctl',
+             resources=Resources(cloud='fake', accelerators='tpu-v5e-8')),
+        cluster_name='ctl2-cluster')
+    monkeypatch.setenv('SKYT_JOBS_CONTROLLER_CLUSTER', 'ctl2-cluster')
+    monkeypatch.setenv('SKYT_JOBS_MAX_LAUNCHING', '1')
+
+    ids = [jobs_core.launch(_task(f'echo sib-{i}')) for i in range(2)]
+    for job_id in ids:
+        _wait_status(job_id, {'SUCCEEDED'}, timeout=120)
+
+    records = {job_id: jobs_state.get(job_id) for job_id in ids}
+    for job_id, record in records.items():
+        assert record.controller_cluster == 'ctl2-cluster', (
+            f'managed job {job_id} controller ran off-cluster: '
+            f'{record.controller_cluster!r}')
+    ctl_names = {j['name'] for j in sky_core.queue('ctl2-cluster')}
+    assert {f'skyt-controller-{job_id}' for job_id in ids} <= ctl_names
