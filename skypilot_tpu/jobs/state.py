@@ -68,84 +68,72 @@ _pg_schema_ready: set = set()
 
 
 def _db():
-    """sqlite (default) or the shared Postgres when SKYT_DB_URL is set —
-    the same dual backend as the cluster state DB (state._db): managed
-    jobs must be visible to every API-server replica AND to controllers
-    running off the server host (controller-offload mode)."""
+    """Per-thread dual-backend connection — same factory as the cluster
+    state DB (utils/pg.connect_dual_backend): managed jobs must be
+    visible to every API-server replica AND to controllers running off
+    the server host (controller-offload mode)."""
     from skypilot_tpu import state as state_lib
-    url = state_lib.db_url()
-    path = (f'{url}#jobs' if url
-            else os.path.join(jobs_dir(), 'jobs.db'))
-    conn = getattr(_local, 'conn', None)
-    if (conn is not None and getattr(_local, 'path', None) == path and
-            getattr(_local, 'pid', None) == os.getpid()):
-        return conn
-    if url is not None:
-        from skypilot_tpu.utils import pg
-        conn = pg.PgSqliteAdapter(pg.PgConnection.from_url(url))
-        if (url, os.getpid()) in _pg_schema_ready:
-            _local.conn = conn
-            _local.path = path
-            _local.pid = os.getpid()
-            return conn
-    else:
-        os.makedirs(jobs_dir(), exist_ok=True)
-        conn = sqlite3.connect(path, timeout=10)
-        conn.row_factory = sqlite3.Row
+    from skypilot_tpu.utils import pg
+
+    def init_schema(conn) -> None:
         conn.execute('PRAGMA journal_mode=WAL')
-    conn.executescript("""
-        CREATE TABLE IF NOT EXISTS jobs (
-            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
-            name TEXT,
-            task_config TEXT NOT NULL,   -- Task.to_yaml_config() JSON
-            cluster_name TEXT,
-            status TEXT NOT NULL,
-            schedule_state TEXT NOT NULL,
-            strategy TEXT,
-            max_restarts_on_errors INTEGER DEFAULT 0,
-            recovery_count INTEGER DEFAULT 0,
-            failure_reason TEXT,
-            controller_pid INTEGER,
-            submitted_at REAL,
-            started_at REAL,
-            ended_at REAL,
-            last_recovered_at REAL,
-            group_name TEXT,             -- gang-scheduled job group
-            group_hosts TEXT             -- JSON host IPs, published at
-                                         -- provision for sibling discovery
-        );
-    """)
-    cols = {r['name'] for r in conn.execute('PRAGMA table_info(jobs)')}
+        conn.executescript("""
+            CREATE TABLE IF NOT EXISTS jobs (
+                job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT,
+                task_config TEXT NOT NULL,  -- Task yaml-config JSON
+                cluster_name TEXT,
+                status TEXT NOT NULL,
+                schedule_state TEXT NOT NULL,
+                strategy TEXT,
+                max_restarts_on_errors INTEGER DEFAULT 0,
+                recovery_count INTEGER DEFAULT 0,
+                failure_reason TEXT,
+                controller_pid INTEGER,
+                submitted_at REAL,
+                started_at REAL,
+                ended_at REAL,
+                last_recovered_at REAL,
+                group_name TEXT,            -- gang-scheduled job group
+                group_hosts TEXT            -- JSON host IPs, published
+                                            -- at provision for sibling
+                                            -- discovery
+            );
+        """)
+        cols = {r['name'] for r in
+                conn.execute('PRAGMA table_info(jobs)')}
 
-    def _add_column(ddl: str) -> None:
-        common_utils.add_column_if_missing(conn, ddl)
+        def _add_column(ddl: str) -> None:
+            common_utils.add_column_if_missing(conn, ddl)
 
-    # Each column gated independently: DDL autocommits per statement, so a
-    # process killed mid-migration can leave any prefix of these applied.
-    if 'group_name' not in cols:  # pre-existing DB from an older version
-        _add_column('ALTER TABLE jobs ADD COLUMN group_name TEXT')
-    if 'group_hosts' not in cols:
-        _add_column('ALTER TABLE jobs ADD COLUMN group_hosts TEXT')
-    if 'controller_restarts' not in cols:
-        _add_column('ALTER TABLE jobs ADD COLUMN controller_restarts '
-                    'INTEGER DEFAULT 0')
-    if 'workspace' not in cols:
-        _add_column("ALTER TABLE jobs ADD COLUMN workspace TEXT "
-                    "DEFAULT 'default'")
-    if 'controller_claimed_at' not in cols:
-        _add_column('ALTER TABLE jobs ADD COLUMN controller_claimed_at '
-                    'REAL')
-    if 'controller_cluster' not in cols:
-        # Controller-offload mode: which cluster hosts this job's
-        # controller process (NULL = a local process on the server).
-        _add_column('ALTER TABLE jobs ADD COLUMN controller_cluster TEXT')
-    conn.commit()
-    if url is not None:
-        _pg_schema_ready.add((url, os.getpid()))
-    _local.conn = conn
-    _local.path = path
-    _local.pid = os.getpid()
-    return conn
+        # Each column gated independently: DDL autocommits per
+        # statement, so a process killed mid-migration can leave any
+        # prefix of these applied.
+        if 'group_name' not in cols:  # pre-existing older DB
+            _add_column('ALTER TABLE jobs ADD COLUMN group_name TEXT')
+        if 'group_hosts' not in cols:
+            _add_column('ALTER TABLE jobs ADD COLUMN group_hosts TEXT')
+        if 'controller_restarts' not in cols:
+            _add_column('ALTER TABLE jobs ADD COLUMN '
+                        'controller_restarts INTEGER DEFAULT 0')
+        if 'workspace' not in cols:
+            _add_column("ALTER TABLE jobs ADD COLUMN workspace TEXT "
+                        "DEFAULT 'default'")
+        if 'controller_claimed_at' not in cols:
+            _add_column('ALTER TABLE jobs ADD COLUMN '
+                        'controller_claimed_at REAL')
+        if 'controller_cluster' not in cols:
+            # Controller-offload mode: which cluster hosts this job's
+            # controller (NULL = a local process on the server).
+            _add_column('ALTER TABLE jobs ADD COLUMN '
+                        'controller_cluster TEXT')
+        conn.commit()
+
+    os.makedirs(jobs_dir(), exist_ok=True)
+    return pg.connect_dual_backend(
+        _local, _pg_schema_ready, url=state_lib.db_url(),
+        sqlite_path=os.path.join(jobs_dir(), 'jobs.db'),
+        init_schema=init_schema)
 
 
 class JobRecord:

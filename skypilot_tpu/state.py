@@ -6,8 +6,8 @@ is plain sqlite3; setting ``SKYT_DB_URL=postgres://user:pw@host/db``
 switches to a shared Postgres (utils/pg.py stdlib wire client) so
 multiple API-server replicas can serve one deployment (the HA story the
 helm chart's single-PVC mode can't give). The ``?``-placeholder SQL
-here is written in the common dialect; ``_PgAdapter`` translates the
-few sqlite-isms (AUTOINCREMENT, PRAGMA) on the way out.
+here is written in the common dialect; ``utils/pg.PgSqliteAdapter``
+translates the few sqlite-isms (AUTOINCREMENT, PRAGMA) on the way out.
 """
 from __future__ import annotations
 
@@ -45,88 +45,70 @@ def db_url() -> Optional[str]:
 
 
 def _db():
-    """Per-thread connection; schema created on first use. Re-opened
-    after fork: sharing a parent's sqlite connection across processes
-    corrupts the DB (the executor forks a child per request)."""
-    url = db_url()
-    path = url or os.path.join(_state_dir(), 'state.db')
-    conn = getattr(_local, 'conn', None)
-    if (conn is not None and getattr(_local, 'path', None) == path and
-            getattr(_local, 'pid', None) == os.getpid()):
-        return conn
-    if url is not None:
-        from skypilot_tpu.utils import pg
-        conn = pg.PgSqliteAdapter(pg.PgConnection.from_url(url))
-        # The shared DB's schema is ensured ONCE per process, not per
-        # request thread — replaying 4 CREATE TABLEs + the migration
-        # probe on every HTTP request thread is pure round-trip waste.
-        if (url, os.getpid()) in _pg_schema_ready:
-            _local.conn = conn
-            _local.path = path
-            _local.pid = os.getpid()
-            return conn
-    else:
-        os.makedirs(_state_dir(), exist_ok=True)
-        conn = sqlite3.connect(path, timeout=10)
-        conn.row_factory = sqlite3.Row
+    """Per-thread dual-backend connection (sqlite default, shared
+    Postgres via SKYT_DB_URL) — utils/pg.connect_dual_backend holds the
+    caching/fork/schema-gate logic shared with jobs/state."""
+    from skypilot_tpu.utils import pg
+
+    def init_schema(conn) -> None:
         conn.execute('PRAGMA journal_mode=WAL')
-    conn.executescript("""
-        CREATE TABLE IF NOT EXISTS clusters (
-            name TEXT PRIMARY KEY,
-            status TEXT NOT NULL,
-            cloud TEXT,
-            region TEXT,
-            zone TEXT,
-            resources TEXT,            -- Resources.to_yaml_config() JSON
-            handle TEXT,               -- serialized ClusterInfo JSON
-            num_nodes INTEGER DEFAULT 1,
-            autostop TEXT,
-            launched_at REAL,
-            last_use REAL,
-            owner TEXT,
-            hourly_cost REAL DEFAULT 0,
-            workspace TEXT DEFAULT 'default'
-        );
-        CREATE TABLE IF NOT EXISTS cluster_events (
-            id INTEGER PRIMARY KEY AUTOINCREMENT,
-            cluster_name TEXT NOT NULL,
-            ts REAL NOT NULL,
-            event TEXT NOT NULL,
-            detail TEXT
-        );
-        CREATE TABLE IF NOT EXISTS storage (
-            name TEXT PRIMARY KEY,
-            store_type TEXT,
-            source TEXT,
-            status TEXT,
-            created_at REAL
-        );
-        CREATE TABLE IF NOT EXISTS volumes (
-            name TEXT PRIMARY KEY,
-            type TEXT NOT NULL,
-            cloud TEXT,
-            region TEXT,
-            zone TEXT,
-            size_gb INTEGER,
-            status TEXT,
-            config TEXT,               -- provider-specific JSON
-            attached_to TEXT,          -- JSON list of cluster names
-            created_at REAL,
-            last_attached REAL
-        );
-    """)
-    cols = {r['name'] for r in conn.execute('PRAGMA table_info(clusters)')}
-    if 'workspace' not in cols:  # pre-existing DB from an older version
-        common_utils.add_column_if_missing(
-            conn, "ALTER TABLE clusters ADD COLUMN workspace TEXT "
-            "DEFAULT 'default'")
-    conn.commit()
-    if url is not None:
-        _pg_schema_ready.add((url, os.getpid()))
-    _local.conn = conn
-    _local.path = path
-    _local.pid = os.getpid()
-    return conn
+        conn.executescript("""
+            CREATE TABLE IF NOT EXISTS clusters (
+                name TEXT PRIMARY KEY,
+                status TEXT NOT NULL,
+                cloud TEXT,
+                region TEXT,
+                zone TEXT,
+                resources TEXT,            -- Resources yaml-config JSON
+                handle TEXT,               -- serialized ClusterInfo JSON
+                num_nodes INTEGER DEFAULT 1,
+                autostop TEXT,
+                launched_at REAL,
+                last_use REAL,
+                owner TEXT,
+                hourly_cost REAL DEFAULT 0,
+                workspace TEXT DEFAULT 'default'
+            );
+            CREATE TABLE IF NOT EXISTS cluster_events (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                cluster_name TEXT NOT NULL,
+                ts REAL NOT NULL,
+                event TEXT NOT NULL,
+                detail TEXT
+            );
+            CREATE TABLE IF NOT EXISTS storage (
+                name TEXT PRIMARY KEY,
+                store_type TEXT,
+                source TEXT,
+                status TEXT,
+                created_at REAL
+            );
+            CREATE TABLE IF NOT EXISTS volumes (
+                name TEXT PRIMARY KEY,
+                type TEXT NOT NULL,
+                cloud TEXT,
+                region TEXT,
+                zone TEXT,
+                size_gb INTEGER,
+                status TEXT,
+                config TEXT,               -- provider-specific JSON
+                attached_to TEXT,          -- JSON list of cluster names
+                created_at REAL,
+                last_attached REAL
+            );
+        """)
+        cols = {r['name'] for r in
+                conn.execute('PRAGMA table_info(clusters)')}
+        if 'workspace' not in cols:  # pre-existing older DB
+            common_utils.add_column_if_missing(
+                conn, "ALTER TABLE clusters ADD COLUMN workspace TEXT "
+                "DEFAULT 'default'")
+        conn.commit()
+
+    return pg.connect_dual_backend(
+        _local, _pg_schema_ready, url=db_url(),
+        sqlite_path=os.path.join(_state_dir(), 'state.db'),
+        init_schema=init_schema)
 
 
 class ClusterRecord:

@@ -406,3 +406,41 @@ class PgSqliteAdapter:
 
     def close(self) -> None:
         self._conn.close()
+
+
+def connect_dual_backend(local, ready_set, *, url, sqlite_path,
+                         init_schema):
+    """Thread-cached connection for the dual-backend state DBs
+    (state.py, jobs/state.py — one copy of the subtle logic):
+
+    * per-thread, re-opened after fork (a parent's sqlite handle shared
+      across processes corrupts the DB; the executor forks per request);
+    * sqlite (default) or ``PgSqliteAdapter`` over the shared server
+      when ``url`` is set;
+    * ``init_schema(conn)`` (DDL + migrations, idempotent) runs on
+      every sqlite connect (local file, ~free) but once per process for
+      Postgres (``ready_set`` gates it — replaying DDL per HTTP request
+      thread is round-trip waste against a remote DB).
+    """
+    import sqlite3
+    cache_path = f'{url}#{sqlite_path}' if url else sqlite_path
+    conn = getattr(local, 'conn', None)
+    if (conn is not None and getattr(local, 'path', None) == cache_path
+            and getattr(local, 'pid', None) == os.getpid()):
+        return conn
+    if url:
+        conn = PgSqliteAdapter(PgConnection.from_url(url))
+        if (url, os.getpid()) not in ready_set:
+            init_schema(conn)
+            ready_set.add((url, os.getpid()))
+    else:
+        os.makedirs(os.path.dirname(sqlite_path), exist_ok=True)
+        conn = sqlite3.connect(sqlite_path, timeout=10)
+        conn.row_factory = sqlite3.Row
+        conn.execute('PRAGMA journal_mode=WAL')
+        init_schema(conn)
+        conn.commit()
+    local.conn = conn
+    local.path = cache_path
+    local.pid = os.getpid()
+    return conn
