@@ -223,45 +223,15 @@ def _spawn_replacement(record, old_pid) -> None:
     _spawn_controller(record.job_id, resume=True)
 
 
-_CLUSTER_GONE = object()
-_CLUSTER_UNREACHABLE = object()
-
-
-def _fetch_controller_queue(cluster: str, cache: dict):
-    """One job-table fetch per controller cluster per reap pass (N
-    offloaded jobs share a cluster; N identical SSH fetches scale queue
-    inspection linearly for nothing)."""
-    if cluster not in cache:
-        from skypilot_tpu import core, exceptions
-        try:
-            cache[cluster] = {j.get('job_id'): j
-                              for j in core.queue(cluster)}
-        except (exceptions.ClusterDoesNotExist,
-                exceptions.ClusterNotUpError):
-            cache[cluster] = _CLUSTER_GONE
-        except Exception:  # pylint: disable=broad-except
-            cache[cluster] = _CLUSTER_UNREACHABLE
-    return cache[cluster]
-
-
 def _controller_alive_for(record, queue_cache=None) -> bool:
     """Liveness for either controller placement: a local pid, or a
-    controller job on the offload cluster."""
+    controller job on the offload cluster (shared GONE-vs-UNREACHABLE
+    logic: utils/controller_liveness.py)."""
     if record.controller_cluster:
-        from skypilot_tpu.runtime import job_lib
-        jobs = _fetch_controller_queue(record.controller_cluster,
-                                       queue_cache if queue_cache
-                                       is not None else {})
-        if jobs is _CLUSTER_GONE:
-            return False   # controller cluster conclusively gone
-        if jobs is _CLUSTER_UNREACHABLE:
-            # Transient (SSH blip, channel reconnect): INCONCLUSIVE must
-            # read as alive — declaring a healthy controller dead would
-            # spawn a duplicate and burn the restart budget.
-            return True
-        row = jobs.get(record.controller_pid)
-        return (row is not None and
-                not job_lib.JobStatus(row['status']).is_terminal())
+        from skypilot_tpu.utils import controller_liveness
+        return controller_liveness.cluster_job_alive(
+            record.controller_cluster, record.controller_pid,
+            queue_cache)
     return _controller_alive(record.controller_pid)
 
 

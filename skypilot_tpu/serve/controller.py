@@ -200,8 +200,15 @@ class ServeController:
         self._update_service_status(replicas)
 
     def run(self) -> None:
-        serve_state.set_service_status(self.service_name,
-                                       ServiceStatus.REPLICA_INIT)
+        record = serve_state.get_service(self.service_name)
+        if record is not None and record.status == (
+                ServiceStatus.CONTROLLER_INIT):
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.REPLICA_INIT)
+        # Replacement-controller attach: adopt the fleet a previous
+        # controller left behind (no-op on a fresh start; a READY
+        # service must not flap through REPLICA_INIT).
+        self.manager.recover_inflight()
         while True:
             if serve_state.shutdown_requested(self.service_name):
                 self.shutdown()

@@ -4,6 +4,20 @@ Parity: ``sky/serve/server/core.py``. ``up`` validates the task's
 ``service:`` section, registers the service, and spawns the detached
 service process (controller + load balancer); ``down`` requests
 shutdown through the DB and the controller tears everything down.
+
+**Controller offload** (parity: the reference's serve controller is a
+provisioned cluster, sky/utils/controller_utils.py:124 +
+sky/serve/service.py:1): set ``serve.controller_cluster: <name>`` (or
+SKYT_SERVE_CONTROLLER_CLUSTER) to a pre-launched CPU cluster and the
+service process — controller loop + load balancer — runs there as a
+detached cluster job instead of a local process. The API server host
+stops being a single point of failure for serving: it can die and
+restart while the LB keeps proxying and the controller keeps
+autoscaling. Requires shared state (SKYT_DB_URL or a shared
+SKYT_STATE_DIR), same contract as jobs controller offload
+(jobs/scheduler.py). Liveness = the controller job's status on that
+cluster; dead controllers get replacements under
+``serve.controller_max_restarts``.
 """
 from __future__ import annotations
 
@@ -24,6 +38,115 @@ from skypilot_tpu.utils import common_utils, log, subprocess_utils
 logger = log.init_logger(__name__)
 
 
+def controller_cluster() -> 'Optional[str]':
+    """Offload target, when configured (env > config > None=local)."""
+    from skypilot_tpu import config
+    return (os.environ.get('SKYT_SERVE_CONTROLLER_CLUSTER')
+            or config.get_nested(('serve', 'controller_cluster'), None))
+
+
+def _controller_max_restarts() -> int:
+    from skypilot_tpu import config
+    if 'SKYT_SERVE_CONTROLLER_MAX_RESTARTS' in os.environ:
+        return int(os.environ['SKYT_SERVE_CONTROLLER_MAX_RESTARTS'])
+    return int(config.get_nested(('serve', 'controller_max_restarts'), 3))
+
+
+def _endpoint_host(cluster: str) -> str:
+    """Where clients reach the offloaded LB: the controller cluster's
+    head address (env override for NAT'd / test deployments)."""
+    override = os.environ.get('SKYT_SERVE_ENDPOINT_HOST')
+    if override:
+        return override
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(cluster)
+    if record is not None and record.handle.get('hosts'):
+        head = record.handle['hosts'][0]
+        return head.get('external_ip') or head['internal_ip']
+    return '127.0.0.1'
+
+
+def _spawn_local(name: str) -> None:
+    log_path = serve_state.controller_log_path(name)
+    pid = subprocess_utils.daemonize_and_run(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service-name', name],
+        log_path=log_path)
+    serve_state.set_controller_pid(name, pid)
+    # A local replacement for a previously-offloaded controller must
+    # stop advertising the old cluster head as its endpoint.
+    serve_state.set_lb_host(name, None)
+    logger.info('Service %s: controller pid %s', name, pid)
+
+
+def _spawn_controller(name: str) -> None:
+    """Start the service process — locally, or as a detached CPU job on
+    the configured serve controller cluster — and record its identity.
+    Raises on spawn failure (nothing started)."""
+    cluster = controller_cluster()
+    if cluster is None:
+        _spawn_local(name)
+        return
+    from skypilot_tpu import execution
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.spec.resources import Resources
+    # Same shared-state contract as the jobs controller offload
+    # (jobs/scheduler.py:_spawn_controller): without a shared DB or
+    # state dir a remote controller sees an empty serve DB — run
+    # locally instead, loudly.
+    envs = {'SKYT_SERVE_ON_CLUSTER': '1'}
+    if state_lib.db_url():
+        envs['SKYT_DB_URL'] = state_lib.db_url()
+    if os.environ.get('SKYT_STATE_DIR'):
+        envs['SKYT_STATE_DIR'] = os.environ['SKYT_STATE_DIR']
+    if len(envs) == 1:
+        logger.error(
+            'serve.controller_cluster=%r is set but neither SKYT_DB_URL '
+            'nor a shared SKYT_STATE_DIR is configured — an offloaded '
+            'serve controller could not see the serve DB. Running the '
+            'controller locally instead; configure a shared Postgres '
+            '(SKYT_DB_URL) to actually offload.', cluster)
+        _spawn_local(name)
+        return
+    # The LB must listen on a reachable interface of the controller
+    # cluster head, not loopback.
+    envs['SKYT_SERVE_LB_HOST'] = os.environ.get('SKYT_SERVE_LB_HOST',
+                                                '0.0.0.0')
+    for knob in ('SKYT_SERVE_CONTROLLER_POLL',
+                 'SKYT_SERVE_NOT_READY_THRESHOLD'):
+        if knob in os.environ:
+            envs[knob] = os.environ[knob]
+    task = Task(
+        name=f'skyt-serve-{name}',
+        run=('PYTHONPATH=~/.skyt_runtime/runtime:$PYTHONPATH '
+             f'python3 -um skypilot_tpu.serve.service '
+             f'--service-name {name}'),
+        envs=envs,
+        # CPU-only: serve controllers SHARE the controller cluster.
+        resources=Resources())
+    results = execution.exec_(task, cluster, detach_run=True)
+    cluster_job_id = results[0][1]
+    try:
+        serve_state.set_controller_pid(name, cluster_job_id,
+                                       controller_cluster=cluster)
+        serve_state.set_lb_host(name, _endpoint_host(cluster))
+    except Exception:
+        # The controller job IS running but its identity couldn't be
+        # recorded (DB blip). Callers treat a raise as "nothing
+        # started" — make that true again, or the job leaks.
+        from skypilot_tpu import core as sky_core
+        try:
+            sky_core.cancel(cluster, cluster_job_id)
+        except Exception as cancel_err:  # pylint: disable=broad-except
+            logger.error(
+                'Service %s: controller job %s on %s is orphaned '
+                '(bookkeeping AND cancel failed: %s) — cancel it '
+                'manually.', name, cluster_job_id, cluster, cancel_err)
+        raise
+    logger.info('Service %s: controller is job %s on cluster %s', name,
+                cluster_job_id, cluster)
+
+
 def up(task: Task, service_name: Optional[str] = None) -> Dict[str, Any]:
     """Bring up a service; returns {name, endpoint} immediately (replicas
     come up asynchronously)."""
@@ -41,16 +164,44 @@ def up(task: Task, service_name: Optional[str] = None) -> Dict[str, Any]:
                                    task.to_yaml_config(), lb_port):
         raise exceptions.ServiceAlreadyExistsError(
             f'Service {name!r} already exists.')
-    log_path = serve_state.controller_log_path(name)
-    pid = subprocess_utils.daemonize_and_run(
-        [sys.executable, '-m', 'skypilot_tpu.serve.service',
-         '--service-name', name],
-        log_path=log_path)
-    serve_state.set_controller_pid(name, pid)
-    endpoint = f'http://127.0.0.1:{lb_port}'
-    logger.info('Service %s: controller pid %s, endpoint %s', name, pid,
-                endpoint)
+    try:
+        _spawn_controller(name)
+    except Exception:
+        # Nothing started: don't leave a zombie row claiming the name.
+        serve_state.remove_service(name)
+        raise
+    record = serve_state.get_service(name)
+    endpoint = record.endpoint if record else None
+    logger.info('Service %s: endpoint %s', name, endpoint)
     return {'name': name, 'endpoint': endpoint}
+
+
+def _controller_alive_for(record, queue_cache=None) -> bool:
+    """Liveness for either controller placement: a local pid, or a
+    controller job on the offload cluster."""
+    if record.controller_pid is None:
+        return False
+    if record.controller_cluster:
+        from skypilot_tpu.utils import controller_liveness
+        return controller_liveness.cluster_job_alive(
+            record.controller_cluster, record.controller_pid,
+            queue_cache)
+    return psutil.pid_exists(record.controller_pid)
+
+
+def _kill_controller(record) -> None:
+    """Stop the controller wherever it runs (purge path)."""
+    if record.controller_pid is None:
+        return
+    if record.controller_cluster:
+        from skypilot_tpu import core as sky_core
+        try:
+            sky_core.cancel(record.controller_cluster,
+                            record.controller_pid)
+        except exceptions.SkytError:
+            pass
+    else:
+        subprocess_utils.kill_process_tree(record.controller_pid)
 
 
 def down(service_name: str, purge: bool = False) -> None:
@@ -60,8 +211,7 @@ def down(service_name: str, purge: bool = False) -> None:
     if record is None:
         raise exceptions.ServiceNotFoundError(
             f'No service {service_name!r}.')
-    controller_alive = (record.controller_pid is not None and
-                        psutil.pid_exists(record.controller_pid))
+    controller_alive = _controller_alive_for(record)
     serve_state.request_shutdown(service_name)
     if controller_alive and not purge:
         return
@@ -69,8 +219,8 @@ def down(service_name: str, purge: bool = False) -> None:
     # Kill the controller FIRST — a mid-tick autoscaler could otherwise
     # launch replacement replicas after we list, leaking clusters whose
     # rows we are about to delete.
-    if record.controller_pid is not None and controller_alive:
-        subprocess_utils.kill_process_tree(record.controller_pid)
+    if controller_alive:
+        _kill_controller(record)
     from skypilot_tpu.backend.tpu_backend import TpuPodBackend
     backend = TpuPodBackend()
     for replica in serve_state.list_replicas(service_name,
@@ -137,10 +287,18 @@ def tail_logs(service_name: str,
             f'No service {service_name!r}.')
     if replica_id is None:
         path = serve_state.controller_log_path(service_name)
-        if not os.path.exists(path):
-            return ''
-        with open(path, encoding='utf-8') as f:
-            return f.read()
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                return f.read()
+        if record.controller_cluster and record.controller_pid:
+            # Offloaded controller: its log is the cluster job's log.
+            from skypilot_tpu import core as sky_core
+            try:
+                return sky_core.tail_logs(record.controller_cluster,
+                                          record.controller_pid)
+            except exceptions.SkytError as e:
+                return f'(controller log unavailable: {e})\n'
+        return ''
     replica = serve_state.get_replica(service_name, replica_id)
     if replica is None:
         raise exceptions.ServiceNotFoundError(
@@ -157,21 +315,71 @@ def tail_logs(service_name: str,
 
 
 def _reap_dead_controllers() -> None:
-    """Mark services whose controller died as CONTROLLER_FAILED (parity:
-    the reference's controller liveness refresh in the status path)."""
+    """HA serve controllers (parity: the reference's HA controller
+    recovery): a service whose controller died gets a REPLACEMENT
+    controller — re-attached to the live replica fleet through the
+    shared DB — up to ``serve.controller_max_restarts`` times; only
+    past that budget is it CONTROLLER_FAILED. Run on status inspection
+    and by the server daemons."""
+    queue_cache: dict = {}
     for record in serve_state.list_services():
         if record.status in (ServiceStatus.CONTROLLER_FAILED,):
             continue
-        if (record.controller_pid is not None and
-                not psutil.pid_exists(record.controller_pid)):
+        if record.controller_pid is None:
+            # Two orphan shapes, both claimed atomically: `up` died
+            # before ever spawning a controller (no claim timestamp),
+            # or a previous reaper NULLed the pid but died / failed
+            # before the replacement started (stale claim timestamp).
             if record.status == ServiceStatus.SHUTTING_DOWN:
-                # Controller exiting after shutdown is the happy path;
-                # its last act removes the row. A leftover row means it
-                # died mid-shutdown.
-                serve_state.set_service_status(
-                    record.name, ServiceStatus.CONTROLLER_FAILED,
-                    failure_reason='controller died during shutdown')
+                continue
+            if record.controller_claimed_at is None:
+                claimed = serve_state.claim_never_spawned_service(
+                    record.name)
             else:
-                serve_state.set_service_status(
-                    record.name, ServiceStatus.CONTROLLER_FAILED,
-                    failure_reason='controller process died')
+                claimed = serve_state.reclaim_stale_controller_claim(
+                    record.name)
+            if claimed:
+                try:
+                    _spawn_controller(record.name)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.error(
+                        'Service %s: controller spawn failed (%s); '
+                        'will retry after the claim grace period.',
+                        record.name, e)
+            continue
+        if _controller_alive_for(record, queue_cache):
+            continue
+        if record.status == ServiceStatus.SHUTTING_DOWN:
+            # Controller exiting after shutdown is the happy path; its
+            # last act removes the row. A leftover row means it died
+            # mid-shutdown — don't restart into a torn-down fleet.
+            serve_state.set_service_status(
+                record.name, ServiceStatus.CONTROLLER_FAILED,
+                failure_reason='controller died during shutdown')
+            continue
+        if serve_state.claim_controller_restart(
+                record.name, record.controller_pid,
+                _controller_max_restarts()):
+            logger.warning(
+                'Service %s: controller %s died; spawning replacement '
+                '(restart %d/%d).', record.name, record.controller_pid,
+                record.controller_restarts + 1, _controller_max_restarts())
+            try:
+                _spawn_controller(record.name)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error(
+                    'Service %s: replacement controller spawn failed '
+                    '(%s); next status inspection retries.',
+                    record.name, e)
+                # Leave pid NULL: the claim below won't match again, but
+                # a NULL pid with non-terminal status is retried here.
+            continue
+        # Claim lost: another process is spawning, or budget spent.
+        refreshed = serve_state.get_service(record.name)
+        if (refreshed is None or
+                refreshed.controller_pid != record.controller_pid or
+                refreshed.controller_restarts < _controller_max_restarts()):
+            continue
+        serve_state.set_service_status(
+            record.name, ServiceStatus.CONTROLLER_FAILED,
+            failure_reason='controller died repeatedly')

@@ -93,6 +93,32 @@ class ReplicaManager:
         logger.info('Service %s: scaling down replica %d (-> %s).',
                     self.service_name, replica_id, status.value)
 
+    def recover_inflight(self) -> None:
+        """Re-drive replica rows whose worker threads died with a
+        previous controller (replacement-controller attach, parity: the
+        reference's HA controller re-sync): an orphaned PROVISIONING row
+        is torn down (the autoscaler replaces it); an orphaned
+        SHUTTING_DOWN teardown is re-issued."""
+        for record in serve_state.list_replicas(self.service_name,
+                                                include_terminal=False):
+            if record.status == ReplicaStatus.PROVISIONING:
+                logger.warning(
+                    'Service %s: replica %d was mid-provision when the '
+                    'previous controller died; tearing it down.',
+                    self.service_name, record.replica_id)
+                self.scale_down(record.replica_id,
+                                ReplicaStatus.FAILED_PROVISION)
+            elif record.status == ReplicaStatus.SHUTTING_DOWN:
+                logger.warning(
+                    'Service %s: re-issuing orphaned teardown of '
+                    'replica %d.', self.service_name, record.replica_id)
+                threading.Thread(
+                    target=self._teardown_replica,
+                    args=(record.replica_id, record.cluster_name,
+                          ReplicaStatus.TERMINATED),
+                    name=f'down-{record.cluster_name}',
+                    daemon=True).start()
+
     def join(self, timeout: float = 120.0) -> None:
         """Wait for in-flight launch threads (used on shutdown)."""
         deadline = time.time() + timeout

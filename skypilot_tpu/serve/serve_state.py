@@ -1,8 +1,10 @@
 """Serve DB: services + replicas (parity: ``sky/serve/serve_state.py``).
 
-One sqlite DB shared by the API server, the per-service controller
-process, and the CLI. Status enums mirror the reference's
-``ServiceStatus`` / ``ReplicaStatus``.
+One DB shared by the API server, the per-service controller process,
+and the CLI — sqlite by default, or the shared Postgres when
+``SKYT_DB_URL`` is set (controller-offload mode needs the controller,
+running on a different machine, to see the same rows). Status enums
+mirror the reference's ``ServiceStatus`` / ``ReplicaStatus``.
 """
 from __future__ import annotations
 
@@ -66,49 +68,79 @@ def controller_log_path(service_name: str) -> str:
 
 _local = threading.local()
 
+# (url, pid) pairs whose shared-DB schema this process already ensured.
+_pg_schema_ready: set = set()
 
-def _db() -> sqlite3.Connection:
-    path = os.path.join(serve_dir(), 'serve.db')
-    conn = getattr(_local, 'conn', None)
-    if (conn is not None and getattr(_local, 'path', None) == path and
-            getattr(_local, 'pid', None) == os.getpid()):
-        return conn
+
+def _db():
+    """Per-thread dual-backend connection — same factory as the cluster
+    and managed-jobs DBs (utils/pg.connect_dual_backend): an offloaded
+    serve controller must see the same services/replicas rows as every
+    API-server replica."""
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.utils import pg
+    from skypilot_tpu.utils import common_utils
+
+    def init_schema(conn) -> None:
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.executescript("""
+            CREATE TABLE IF NOT EXISTS services (
+                name TEXT PRIMARY KEY,
+                spec TEXT NOT NULL,        -- ServiceSpec.to_yaml_config()
+                task_config TEXT NOT NULL, -- Task.to_yaml_config()
+                status TEXT NOT NULL,
+                shutdown_requested INTEGER DEFAULT 0,
+                controller_pid INTEGER,
+                lb_port INTEGER,
+                requested_at REAL,
+                failure_reason TEXT
+            );
+            CREATE TABLE IF NOT EXISTS replicas (
+                service_name TEXT NOT NULL,
+                replica_id INTEGER NOT NULL,
+                cluster_name TEXT NOT NULL,
+                status TEXT NOT NULL,
+                endpoint TEXT,
+                is_spot INTEGER DEFAULT 0,
+                is_fallback INTEGER DEFAULT 0,  -- on-demand backfill
+                zone TEXT,
+                launched_at REAL,
+                ready_at REAL,
+                consecutive_failures INTEGER DEFAULT 0,
+                PRIMARY KEY (service_name, replica_id)
+            );
+        """)
+        cols = {r['name'] for r in
+                conn.execute('PRAGMA table_info(services)')}
+        # Each column gated independently: DDL autocommits per
+        # statement, so a process killed mid-migration can leave any
+        # prefix of these applied.
+        if 'controller_cluster' not in cols:
+            # Controller-offload mode: which cluster hosts this
+            # service's controller (NULL = a local process).
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN '
+                'controller_cluster TEXT')
+        if 'controller_restarts' not in cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN '
+                'controller_restarts INTEGER DEFAULT 0')
+        if 'lb_host' not in cols:
+            # Where the LB actually listens (offload: the controller
+            # cluster's head, not the API server).
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN lb_host TEXT')
+        if 'controller_claimed_at' not in cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN '
+                'controller_claimed_at REAL')
+        conn.commit()
+
     os.makedirs(serve_dir(), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
-    conn.executescript("""
-        CREATE TABLE IF NOT EXISTS services (
-            name TEXT PRIMARY KEY,
-            spec TEXT NOT NULL,           -- ServiceSpec.to_yaml_config()
-            task_config TEXT NOT NULL,    -- Task.to_yaml_config()
-            status TEXT NOT NULL,
-            shutdown_requested INTEGER DEFAULT 0,
-            controller_pid INTEGER,
-            lb_port INTEGER,
-            requested_at REAL,
-            failure_reason TEXT
-        );
-        CREATE TABLE IF NOT EXISTS replicas (
-            service_name TEXT NOT NULL,
-            replica_id INTEGER NOT NULL,
-            cluster_name TEXT NOT NULL,
-            status TEXT NOT NULL,
-            endpoint TEXT,
-            is_spot INTEGER DEFAULT 0,
-            is_fallback INTEGER DEFAULT 0,  -- dynamic on-demand backfill
-            zone TEXT,
-            launched_at REAL,
-            ready_at REAL,
-            consecutive_failures INTEGER DEFAULT 0,
-            PRIMARY KEY (service_name, replica_id)
-        );
-    """)
-    conn.commit()
-    _local.conn = conn
-    _local.path = path
-    _local.pid = os.getpid()
-    return conn
+    return pg.connect_dual_backend(
+        _local, _pg_schema_ready, url=state_lib.db_url(),
+        sqlite_path=os.path.join(serve_dir(), 'serve.db'),
+        init_schema=init_schema)
 
 
 # -- services ---------------------------------------------------------------
@@ -125,6 +157,17 @@ class ServiceRecord:
         self.lb_port: Optional[int] = row['lb_port']
         self.requested_at: Optional[float] = row['requested_at']
         self.failure_reason: Optional[str] = row['failure_reason']
+        self.controller_cluster: Optional[str] = row['controller_cluster']
+        self.controller_restarts: int = row['controller_restarts'] or 0
+        self.lb_host: Optional[str] = row['lb_host']
+        self.controller_claimed_at: Optional[float] = (
+            row['controller_claimed_at'])
+
+    @property
+    def endpoint(self) -> Optional[str]:
+        if self.lb_port is None:
+            return None
+        return f'http://{self.lb_host or "127.0.0.1"}:{self.lb_port}'
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -132,6 +175,8 @@ class ServiceRecord:
             'status': self.status.value,
             'spec': self.spec,
             'lb_port': self.lb_port,
+            'endpoint': self.endpoint,
+            'controller_cluster': self.controller_cluster,
             'requested_at': self.requested_at,
             'failure_reason': self.failure_reason,
             'replicas': [r.to_dict() for r in list_replicas(self.name)],
@@ -140,6 +185,7 @@ class ServiceRecord:
 
 def add_service(name: str, spec: Dict[str, Any],
                 task_config: Dict[str, Any], lb_port: int) -> bool:
+    from skypilot_tpu.utils import pg
     conn = _db()
     try:
         conn.execute(
@@ -151,6 +197,11 @@ def add_service(name: str, spec: Dict[str, Any],
         return True
     except sqlite3.IntegrityError:
         return False
+    except pg.PgError as e:
+        # 23505 = unique_violation; fake_pg surfaces sqlite's message.
+        if e.code == '23505' or 'UNIQUE constraint' in str(e):
+            return False
+        raise
 
 
 def get_service(name: str) -> Optional[ServiceRecord]:
@@ -186,11 +237,88 @@ def set_service_spec(name: str, spec: Dict[str, Any]) -> None:
     conn.commit()
 
 
-def set_controller_pid(name: str, pid: int) -> None:
+def set_controller_pid(name: str, pid: int,
+                       controller_cluster: Optional[str] = None) -> None:
+    """Record where this service's controller runs: a local pid
+    (controller_cluster None) or a job id ON the named controller
+    cluster (offload mode)."""
     conn = _db()
-    conn.execute('UPDATE services SET controller_pid = ? WHERE name = ?',
-                 (pid, name))
+    conn.execute(
+        'UPDATE services SET controller_pid = ?, '
+        'controller_cluster = ? WHERE name = ?',
+        (pid, controller_cluster, name))
     conn.commit()
+
+
+def set_lb_host(name: str, host: Optional[str]) -> None:
+    conn = _db()
+    conn.execute('UPDATE services SET lb_host = ? WHERE name = ?',
+                 (host, name))
+    conn.commit()
+
+
+def set_lb_port(name: str, port: int) -> None:
+    """The service process re-publishes the port it actually bound
+    (the port `up` picked was only checked for freeness on the
+    API-server host, not the controller cluster head)."""
+    conn = _db()
+    conn.execute('UPDATE services SET lb_port = ? WHERE name = ?',
+                 (port, name))
+    conn.commit()
+
+
+def claim_controller_restart(name: str, dead_pid: int,
+                             max_restarts: int) -> bool:
+    """Atomically claim the right to spawn a replacement controller
+    (same discipline as jobs/state.claim_controller_restart: the
+    conditional UPDATE on the observed pid makes exactly one of the
+    concurrent observers the spawner)."""
+    conn = _db()
+    cur = conn.execute(
+        'UPDATE services SET controller_restarts = '
+        'controller_restarts + 1, controller_pid = NULL, '
+        'controller_claimed_at = ? '
+        'WHERE name = ? AND controller_pid = ? '
+        'AND controller_restarts < ?',
+        (time.time(), name, dead_pid, max_restarts))
+    conn.commit()
+    return cur.rowcount == 1
+
+
+def claim_never_spawned_service(name: str,
+                                grace: float = 30.0) -> bool:
+    """Claim a service whose `up` died between add_service and the
+    controller spawn (pid NULL, no claim timestamp, still
+    CONTROLLER_INIT past the grace period). Atomic: the conditional
+    UPDATE lets exactly one reaper through; setting
+    controller_claimed_at moves it onto the normal stale-claim retry
+    path if this spawn fails too."""
+    conn = _db()
+    cur = conn.execute(
+        'UPDATE services SET controller_claimed_at = ? '
+        'WHERE name = ? AND controller_pid IS NULL '
+        'AND controller_claimed_at IS NULL AND status = ? '
+        'AND requested_at < ?',
+        (time.time(), name, ServiceStatus.CONTROLLER_INIT.value,
+         time.time() - grace))
+    conn.commit()
+    return cur.rowcount == 1
+
+
+def reclaim_stale_controller_claim(name: str,
+                                   stale_after: float = 30.0) -> bool:
+    """Claim a service whose previous claimant died between NULLing the
+    pid and spawning the replacement (same orphan window as
+    jobs/state.reclaim_stale_controller_claim)."""
+    conn = _db()
+    cur = conn.execute(
+        'UPDATE services SET controller_claimed_at = ? '
+        'WHERE name = ? AND controller_pid IS NULL '
+        'AND controller_claimed_at IS NOT NULL '
+        'AND controller_claimed_at < ?',
+        (time.time(), name, time.time() - stale_after))
+    conn.commit()
+    return cur.rowcount == 1
 
 
 def request_shutdown(name: str) -> None:

@@ -29,7 +29,10 @@ def run_service(service_name: str) -> None:
     assert record is not None, f'service {service_name} not in DB'
     spec = ServiceSpec.from_yaml_config(record.spec)
     task = Task.from_yaml_config(record.task_config)
-    serve_state.set_controller_pid(service_name, os.getpid())
+    if not os.environ.get('SKYT_SERVE_ON_CLUSTER'):
+        # Offloaded controllers are identified by their cluster job id,
+        # recorded by the spawner — the remote pid must not clobber it.
+        serve_state.set_controller_pid(service_name, os.getpid())
 
     server = None
     lb = None
@@ -38,7 +41,20 @@ def run_service(service_name: str) -> None:
         lb = LoadBalancer(policy, qps_window_seconds=spec.qps_window_seconds)
         host = os.environ.get('SKYT_SERVE_LB_HOST', '127.0.0.1')
         assert record.lb_port is not None
-        server = start_load_balancer(lb, host, record.lb_port)
+        try:
+            server = start_load_balancer(lb, host, record.lb_port)
+        except OSError:
+            # `up` validated the port on the API-server host; HERE (an
+            # offloaded controller-cluster head, or a restart racing a
+            # lingering socket) it can be taken. Bind a free one and
+            # re-publish it so `status` endpoints stay correct.
+            from skypilot_tpu.utils import common_utils
+            port = common_utils.find_free_port()
+            logger.warning(
+                'Service %s: LB port %s is taken; rebinding on %s.',
+                service_name, record.lb_port, port)
+            server = start_load_balancer(lb, host, port)
+            serve_state.set_lb_port(service_name, port)
 
     controller = ServeController(service_name, spec, task, lb)
     try:

@@ -87,6 +87,13 @@ def _jobs_refresh_tick() -> None:
     log_gc.collect()
 
 
+def _serve_refresh_tick() -> None:
+    """Reap dead serve controllers (HA replacement spawn) without
+    waiting for a client to ask for `serve status`."""
+    from skypilot_tpu.serve import core as serve_core
+    serve_core._reap_dead_controllers()  # pylint: disable=protected-access
+
+
 def _log_ship_tick() -> None:
     """Ship finished jobs' logs to the configured external store
     (parity: sky/logs/__init__.py:12 get_logging_agent → GCP Cloud
@@ -212,6 +219,9 @@ def build_daemons() -> List[Daemon]:
         Daemon('managed-jobs-refresh',
                _interval('jobs_refresh_interval', 30.0),
                _jobs_refresh_tick),
+        Daemon('serve-refresh',
+               _interval('serve_refresh_interval', 30.0),
+               _serve_refresh_tick),
         Daemon('log-shipper',
                _interval('log_ship_interval', 60.0),
                _log_ship_tick),

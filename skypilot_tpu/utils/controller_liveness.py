@@ -1,0 +1,49 @@
+"""Liveness of offloaded controllers (shared by jobs and serve).
+
+A controller that runs as a detached job on a controller cluster is
+alive iff its job row on that cluster is non-terminal. Two subtleties
+both consumers must share (a fix to one must not miss the other):
+
+* one job-table fetch per cluster per reap pass (N offloaded
+  controllers share a cluster; N identical SSH fetches scale queue
+  inspection linearly for nothing);
+* conclusively-gone clusters read as dead, but *unreachable* clusters
+  (SSH blip, channel reconnect) read as ALIVE — declaring a healthy
+  controller dead would spawn a duplicate and burn the restart budget.
+"""
+from __future__ import annotations
+
+CLUSTER_GONE = object()
+CLUSTER_UNREACHABLE = object()
+
+
+def fetch_controller_queue(cluster: str, cache: dict):
+    """The cluster's job table keyed by job_id, memoized in ``cache``;
+    CLUSTER_GONE / CLUSTER_UNREACHABLE sentinels on failure."""
+    if cluster not in cache:
+        from skypilot_tpu import core, exceptions
+        try:
+            cache[cluster] = {j.get('job_id'): j
+                              for j in core.queue(cluster)}
+        except (exceptions.ClusterDoesNotExist,
+                exceptions.ClusterNotUpError):
+            cache[cluster] = CLUSTER_GONE
+        except Exception:  # pylint: disable=broad-except
+            cache[cluster] = CLUSTER_UNREACHABLE
+    return cache[cluster]
+
+
+def cluster_job_alive(cluster: str, job_id: int,
+                      queue_cache: dict = None) -> bool:
+    """Is the controller job non-terminal on its cluster? Inconclusive
+    reads as alive (see module docstring)."""
+    from skypilot_tpu.runtime import job_lib
+    jobs = fetch_controller_queue(
+        cluster, queue_cache if queue_cache is not None else {})
+    if jobs is CLUSTER_GONE:
+        return False
+    if jobs is CLUSTER_UNREACHABLE:
+        return True
+    row = jobs.get(job_id)
+    return (row is not None and
+            not job_lib.JobStatus(row['status']).is_terminal())

@@ -135,3 +135,49 @@ def test_managed_jobs_state_on_postgres(pg_server):
     names = [r.name for r in jobs_state.list_jobs()]
     assert names == ['job-b', 'job-a']
     jobs_state._local.__dict__.clear()
+
+
+def test_serve_state_on_postgres(pg_server):
+    """Serve offload rides the shared DB: services + replicas written
+    through one API-server/controller must be visible to any other
+    process pointed at the same SKYT_DB_URL."""
+    from skypilot_tpu.serve import serve_state
+    serve_state._local.__dict__.clear()
+    assert serve_state.add_service('svc', {'replicas': 1},
+                                   {'run': 'srv'}, 8001)
+    assert not serve_state.add_service('svc', {}, {}, 8002)  # duplicate
+    serve_state.set_controller_pid('svc', 42,
+                                   controller_cluster='ctl-cluster')
+    serve_state.set_lb_host('svc', '10.0.0.9')
+    serve_state.add_replica('svc', 1, 'svc-replica-1', is_spot=False)
+    serve_state.set_replica_endpoint('svc', 1, 'http://10.0.0.7:9000',
+                                     'us-central2-b')
+    serve_state.set_replica_status('svc', 1,
+                                   serve_state.ReplicaStatus.READY)
+
+    record = serve_state.get_service('svc')
+    assert record.controller_cluster == 'ctl-cluster'
+    assert record.controller_pid == 42
+    assert record.endpoint == 'http://10.0.0.9:8001'
+    replicas = serve_state.list_replicas('svc')
+    assert len(replicas) == 1
+    assert replicas[0].status == serve_state.ReplicaStatus.READY
+    assert replicas[0].endpoint == 'http://10.0.0.7:9000'
+
+    # Restart claim: exactly one concurrent observer wins; budget caps.
+    assert serve_state.claim_controller_restart('svc', 42, 3)
+    assert not serve_state.claim_controller_restart('svc', 42, 3)
+    record = serve_state.get_service('svc')
+    assert record.controller_pid is None
+    assert record.controller_restarts == 1
+    assert isinstance(record.controller_claimed_at, float)
+    # Stale-claim reclamation only past the grace period.
+    assert not serve_state.reclaim_stale_controller_claim(
+        'svc', stale_after=30.0)
+    assert serve_state.reclaim_stale_controller_claim(
+        'svc', stale_after=0.0)
+
+    serve_state.remove_service('svc')
+    assert serve_state.get_service('svc') is None
+    assert serve_state.list_replicas('svc') == []
+    serve_state._local.__dict__.clear()

@@ -1,0 +1,198 @@
+"""Serve controller offload: the service process (controller + LB) runs
+as a detached job on a provisioned cluster, not on the API-server host
+(parity: sky/utils/controller_utils.py:124 + sky/serve/service.py:1 —
+the reference's serve controller IS a cluster). The API server can die
+and restart while the LB keeps proxying and the controller keeps
+autoscaling; dead controllers get replacement jobs under the restart
+budget, re-attaching to the live fleet through the shared DB."""
+import time
+import urllib.request
+
+import psutil
+import pytest
+
+from skypilot_tpu import core as sky_core
+from skypilot_tpu import execution
+from skypilot_tpu.provision import fake
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+ECHO_SERVER = ('python3 -m http.server "$SKYT_SERVE_REPLICA_PORT" '
+               '--bind 127.0.0.1')
+
+CTL_CLUSTER = 'serve-ctl'
+
+
+@pytest.fixture(autouse=True)
+def offload_env(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_SERVE_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_SERVE_NOT_READY_THRESHOLD', '2')
+    # The fake cloud executes "cluster" commands locally, so both the LB
+    # bind and the advertised endpoint live on loopback.
+    monkeypatch.setenv('SKYT_SERVE_LB_HOST', '127.0.0.1')
+    monkeypatch.setenv('SKYT_SERVE_ENDPOINT_HOST', '127.0.0.1')
+    fake.reset()
+    execution.launch(
+        Task(name='ctl',
+             resources=Resources(cloud='fake', accelerators='tpu-v5e-8')),
+        cluster_name=CTL_CLUSTER)
+    monkeypatch.setenv('SKYT_SERVE_CONTROLLER_CLUSTER', CTL_CLUSTER)
+    yield
+    for record in serve_state.list_services():
+        try:
+            serve_core.down(record.name, purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    fake.reset()
+
+
+def _service_task():
+    return Task(name='svc', run=ECHO_SERVER,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8'),
+                service={
+                    'readiness_probe': {'path': '/',
+                                        'initial_delay_seconds': 30,
+                                        'timeout_seconds': 2},
+                    'replicas': 1,
+                })
+
+
+def _wait_service(name, statuses, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_state.get_service(name)
+        if record and record.status.value in statuses:
+            return record
+        time.sleep(0.2)
+    record = serve_state.get_service(name)
+    raise AssertionError(
+        f'service {name} stuck in '
+        f'{record.status.value if record else None}; wanted {statuses}. '
+        f'Controller log:\n{serve_core.tail_logs(name)[-4000:]}')
+
+
+def _wait_endpoint(endpoint, timeout=60):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(endpoint, timeout=5) as resp:
+                return resp.status
+        except OSError as e:
+            last = e
+            time.sleep(0.3)
+    raise AssertionError(f'endpoint {endpoint} never answered: {last}')
+
+
+def _controller_job_row(record):
+    jobs = {j.get('job_id'): j for j in sky_core.queue(CTL_CLUSTER)}
+    return jobs.get(record.controller_pid)
+
+
+def test_offloaded_service_serves_and_survives_server_death():
+    """The whole serving stack runs on the controller cluster: the
+    service becomes READY, proxies requests, and recovers a preempted
+    replica with NO live process belonging to the `up` caller (the
+    'API server' here) — its death is irrelevant by construction."""
+    result = serve_core.up(_service_task(), 'off')
+    record = _wait_service('off', {'READY'})
+
+    # Placement: the controller is a job on the cluster, not a local pid.
+    assert record.controller_cluster == CTL_CLUSTER
+    row = _controller_job_row(record)
+    assert row is not None, 'controller job not in cluster queue'
+    assert row['name'] == 'skyt-serve-off'
+
+    # The offloaded LB proxies to the replica.
+    assert result['endpoint'].startswith('http://127.0.0.1:')
+    with urllib.request.urlopen(result['endpoint'], timeout=10) as resp:
+        assert resp.status == 200
+
+    # Autoscaling continues without the API server: preempt the replica
+    # and the ON-CLUSTER controller replaces it.
+    replica = serve_state.list_replicas('off')[0]
+    fake.preempt_cluster(replica.cluster_name)
+    deadline = time.time() + 120
+    replaced = None
+    while time.time() < deadline:
+        ready = [r for r in serve_state.list_replicas('off')
+                 if r.replica_id != replica.replica_id and
+                 r.status == serve_state.ReplicaStatus.READY]
+        if ready:
+            replaced = ready[0]
+            break
+        time.sleep(0.3)
+    assert replaced is not None, (
+        f'no replacement replica; controller log:\n'
+        f'{serve_core.tail_logs("off")[-4000:]}')
+
+    # Down flows through the DB to the on-cluster controller.
+    serve_core.down('off')
+    deadline = time.time() + 90
+    while serve_state.get_service('off') and time.time() < deadline:
+        time.sleep(0.2)
+    assert serve_state.get_service('off') is None
+
+
+def test_offloaded_controller_replaced_within_budget():
+    """A dead controller job gets a replacement job on the cluster that
+    re-attaches to the live replica fleet (restart budget, parity: the
+    reference's HA controller recovery)."""
+    serve_core.up(_service_task(), 'ha')
+    record = _wait_service('ha', {'READY'})
+    old_job = record.controller_pid
+    replicas_before = {r.replica_id
+                       for r in serve_state.list_replicas('ha')}
+
+    # Kill ONLY the controller process (a real controller-host death
+    # leaves the replica machines running; the fake cloud's replica
+    # daemons are process-tree descendants, so a tree kill would take
+    # the fleet down with it and mask the adoption path).
+    killed = None
+    for proc in psutil.process_iter(['cmdline']):
+        try:
+            cmd = ' '.join(proc.info['cmdline'] or [])
+        except psutil.Error:
+            continue
+        if ('skypilot_tpu.serve.service' in cmd and
+                '--service-name ha' in cmd):
+            proc.kill()
+            killed = proc.pid
+            break
+    assert killed is not None, 'controller process not found'
+    # Wait until the cluster job table reports it dead.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        row = _controller_job_row(record)
+        if row is None or row['status'] not in ('RUNNING', 'PENDING',
+                                                'SETTING_UP'):
+            break
+        time.sleep(0.3)
+
+    # The status path runs the reaper (as the server daemons do).
+    deadline = time.time() + 60
+    refreshed = None
+    while time.time() < deadline:
+        serve_core.status('ha')
+        refreshed = serve_state.get_service('ha')
+        if (refreshed.controller_pid is not None and
+                refreshed.controller_pid != old_job):
+            break
+        time.sleep(0.3)
+    assert refreshed.controller_pid != old_job, 'no replacement spawned'
+    assert refreshed.controller_cluster == CTL_CLUSTER
+    assert refreshed.controller_restarts == 1
+
+    # The replacement re-attaches to the SAME fleet (no relaunch) and
+    # the service keeps serving.
+    record = _wait_service('ha', {'READY'})
+    assert _wait_endpoint(record.endpoint) == 200
+    replicas_after = {r.replica_id
+                      for r in serve_state.list_replicas('ha')
+                      if r.status == serve_state.ReplicaStatus.READY}
+    assert replicas_before & replicas_after, (
+        'replacement controller relaunched the fleet instead of '
+        'adopting it')
