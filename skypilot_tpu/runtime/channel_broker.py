@@ -1,0 +1,272 @@
+"""Channel broker: one resident owner for the per-cluster runtime
+channels, shared by every short-lived process.
+
+The executor forks a process per request (server/executor.py), so the
+per-PROCESS channel cache in runtime/channel.py never pays off on the
+request path: each forked child that touches a cluster spawns a fresh
+``channel_server`` over SSH, uses it once, and exits — exactly the
+per-op transport cost the channel exists to kill. The reference keeps
+ONE cached skylet channel per cluster inside its long-lived server
+process (``cloud_vm_ray_backend.py:2395``); this broker restores that
+shape for the fork-per-request architecture:
+
+* The API server runs a :class:`ChannelBroker` thread that listens on a
+  unix socket and OWNS the channel cache (``channel.get_channel`` in
+  the server process — the same cache the server daemons use, so event
+  pushes keep landing in one place).
+* Runner processes (and the request children they fork) inherit
+  ``SKYT_CHANNEL_BROKER_SOCK`` and proxy job-table ops through the
+  socket — zero SSH spawns on the request path.
+* Anything without the env (CLI local mode, tests, the server process
+  itself) keeps the direct per-process channel path.
+
+Wire format: the channel's own framed JSON (4-byte length prefix,
+``channel_server.read_frame``/``write_frame``). One request frame per
+connection-op: ``{"op": .., "info": <ClusterInfo dict>, "params": ..}``;
+responses mirror the channel protocol, including ``stream`` frames for
+``tail``.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import threading
+import uuid
+from typing import Any, Dict, IO, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.runtime.channel import ChannelError
+from skypilot_tpu.runtime.channel_server import read_frame, write_frame
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+BROKER_SOCK_ENV = 'SKYT_CHANNEL_BROKER_SOCK'
+DEFAULT_TIMEOUT = float(os.environ.get('SKYT_CHANNEL_TIMEOUT', '120'))
+
+
+def _sock_path() -> str:
+    # /tmp, not the state dir: AF_UNIX paths cap at ~107 bytes and test
+    # tmpdirs routinely blow past that.
+    return f'/tmp/skyt-broker-{uuid.uuid4().hex[:12]}.sock'
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    """One connection = one op (connects are ~free on a unix socket and
+    a connection-per-op keeps the broker stateless per client)."""
+
+    def handle(self) -> None:  # noqa: D102
+        rfile = self.request.makefile('rb')
+        wfile = self.request.makefile('wb')
+        try:
+            frame = read_frame(rfile)
+            self._dispatch(frame, wfile)
+        except (EOFError, ValueError, OSError, BrokenPipeError):
+            pass
+        finally:
+            for f in (rfile, wfile):
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+    def _dispatch(self, frame: Dict[str, Any], wfile) -> None:
+        from skypilot_tpu.provision.api import ClusterInfo
+        from skypilot_tpu.runtime import channel as channel_lib
+        op = frame.get('op')
+        if op == 'broker_ping':
+            write_frame(wfile, {'ok': True, 'result': 'pong'})
+            wfile.flush()
+            return
+        info = ClusterInfo.from_dict(frame['info'])
+        client = channel_lib.get_channel(info)
+        if op == 'ensure_channel':
+            write_frame(wfile, {'ok': True,
+                                'result': client is not None})
+            wfile.flush()
+            return
+        if client is None:
+            write_frame(wfile, {'ok': False, 'no_channel': True,
+                                'error': f'no channel to '
+                                         f'{info.cluster_name}'})
+            wfile.flush()
+            return
+        params = frame.get('params') or {}
+        timeout = float(frame.get('timeout') or DEFAULT_TIMEOUT)
+        try:
+            if op == 'tail':
+                self._tail(client, params, wfile, timeout)
+            else:
+                result = client.request(op, timeout=timeout, **params)
+                write_frame(wfile, {'ok': True, 'result': result})
+        except exceptions.JobNotFoundError as e:
+            write_frame(wfile, {'ok': False, 'kind': 'not_found',
+                                'error': str(e)})
+        except (exceptions.CommandError, OSError) as e:
+            write_frame(wfile, {'ok': False, 'error': str(e)})
+        wfile.flush()
+
+    @staticmethod
+    def _tail(client, params: Dict[str, Any], wfile,
+              timeout: float) -> None:
+        class _FrameStream:
+            """Relay tail chunks as stream frames as they arrive."""
+
+            @staticmethod
+            def write(text: str) -> None:
+                write_frame(wfile, {'stream': 'data', 'text': text})
+
+            @staticmethod
+            def flush() -> None:
+                wfile.flush()
+
+        client.tail(int(params['job_id']),
+                    follow=bool(params.get('follow')),
+                    stream=_FrameStream(), timeout=timeout)
+        write_frame(wfile, {'stream': 'end'})
+
+
+class _ThreadingUnixServer(socketserver.ThreadingMixIn,
+                           socketserver.UnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ChannelBroker:
+    """The in-server broker endpoint (started by ApiServer)."""
+
+    def __init__(self, sock_path: Optional[str] = None) -> None:
+        self.sock_path = sock_path or _sock_path()
+        self._server = _ThreadingUnixServer(self.sock_path, _Handler)
+        os.chmod(self.sock_path, 0o600)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name='channel-broker',
+                                        daemon=True)
+        self._thread.start()
+        logger.debug('Channel broker listening on %s', self.sock_path)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Client side (runs in runner/request-child processes)
+# ---------------------------------------------------------------------------
+
+
+class BrokerUnavailable(ChannelError):
+    """Broker socket gone/unresponsive mid-use. Subclasses ChannelError
+    (itself a CommandError) so every existing channel-failure handler —
+    daemon_alive's (ChannelError, CommandError) catch, status refresh —
+    degrades the same way a dead direct channel does."""
+
+
+class BrokerChannelProxy:
+    """Quacks like ChannelClient (``request``/``tail``) but executes
+    each op through the broker's cached channel."""
+
+    def __init__(self, sock_path: str, info) -> None:
+        self.sock_path = sock_path
+        self.info_dict = info.to_dict()
+        self.name = info.cluster_name
+
+    def _dial(self):
+        try:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(DEFAULT_TIMEOUT + 30)
+            sock.connect(self.sock_path)
+            return sock
+        except OSError as e:
+            raise BrokerUnavailable(str(e)) from e
+
+    def _roundtrip(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        sock = self._dial()
+        try:
+            wfile = sock.makefile('wb')
+            rfile = sock.makefile('rb')
+            write_frame(wfile, frame)
+            wfile.flush()
+            return read_frame(rfile)
+        except (EOFError, ValueError, OSError) as e:
+            raise BrokerUnavailable(str(e)) from e
+        finally:
+            sock.close()
+
+    def request(self, op: str, timeout: float = DEFAULT_TIMEOUT,
+                **params) -> Any:
+        reply = self._roundtrip({'op': op, 'info': self.info_dict,
+                                 'params': params, 'timeout': timeout})
+        if not reply.get('ok'):
+            if reply.get('kind') == 'not_found':
+                raise exceptions.JobNotFoundError(
+                    reply.get('error', 'not found'))
+            raise ChannelError(reply.get('error', 'broker op failed'))
+        return reply.get('result')
+
+    def ensure_channel(self) -> bool:
+        reply = self._roundtrip({'op': 'ensure_channel',
+                                 'info': self.info_dict})
+        return bool(reply.get('ok') and reply.get('result'))
+
+    def tail(self, job_id: int, *, follow: bool = False,
+             stream: Optional[IO[str]] = None,
+             timeout: float = DEFAULT_TIMEOUT) -> str:
+        sock = self._dial()
+        buf = []
+        try:
+            wfile = sock.makefile('wb')
+            rfile = sock.makefile('rb')
+            if follow:
+                sock.settimeout(None)  # silent jobs may log nothing
+            write_frame(wfile, {'op': 'tail', 'info': self.info_dict,
+                                'params': {'job_id': job_id,
+                                           'follow': follow},
+                                'timeout': timeout})
+            wfile.flush()
+            while True:
+                frame = read_frame(rfile)
+                if frame.get('stream') == 'data':
+                    text = frame.get('text', '')
+                    buf.append(text)
+                    if stream is not None:
+                        stream.write(text)
+                        stream.flush()
+                    continue
+                if frame.get('stream') == 'end':
+                    return ''.join(buf)
+                if frame.get('kind') == 'not_found':
+                    raise exceptions.JobNotFoundError(
+                        frame.get('error', f'no job {job_id}'))
+                raise ChannelError(
+                    frame.get('error', 'broker closed mid-tail'))
+        except (EOFError, ValueError, OSError) as e:
+            raise BrokerUnavailable(str(e)) from e
+        finally:
+            sock.close()
+
+
+def broker_job_table(info):
+    """A JobTable proxied through the broker, or None (no broker env,
+    broker dead, or no channel to this cluster — caller falls back)."""
+    sock_path = os.environ.get(BROKER_SOCK_ENV)
+    if not sock_path:
+        return None
+    from skypilot_tpu.runtime.channel import ChannelJobTable
+    proxy = BrokerChannelProxy(sock_path, info)
+    try:
+        if not proxy.ensure_channel():
+            return None
+    except BrokerUnavailable as e:
+        logger.debug('channel broker unavailable (%s); using direct '
+                     'transport', e)
+        return None
+    return ChannelJobTable(proxy)

@@ -232,17 +232,24 @@ class RemoteJobTable(JobTable):
 def job_table_for(info) -> JobTable:
     """The right transport for this cluster's job table.
 
-    Non-local clusters prefer the persistent channel (one live
-    connection per cluster, framed ops, no per-op SSH exec —
-    runtime/channel.py); the job_cli shim remains the fallback when a
-    channel can't be established (runtime not shipped yet, transport
-    down, or ``SKYT_RUNTIME_CHANNEL=0``).
+    Non-local clusters prefer, in order: the channel BROKER (a resident
+    process — the API server — owns one live channel per cluster and
+    short-lived forked request children proxy through its unix socket,
+    runtime/channel_broker.py); a direct persistent channel owned by
+    THIS process (one live connection per cluster, framed ops, no
+    per-op SSH exec — runtime/channel.py); the job_cli shim as the last
+    fallback (runtime not shipped yet, transport down, or
+    ``SKYT_RUNTIME_CHANNEL=0``).
     """
     from skypilot_tpu.backend import runtime_setup
     from skypilot_tpu.utils.command_runner import runners_for_cluster
     if runtime_setup.is_local_style(info):
         return DirectJobTable(runtime_setup.head_runtime_dir(info))
     from skypilot_tpu.runtime import channel as channel_lib
+    from skypilot_tpu.runtime import channel_broker
+    table = channel_broker.broker_job_table(info)
+    if table is not None:
+        return table
     client = channel_lib.get_channel(info)
     if client is not None:
         return channel_lib.ChannelJobTable(client)

@@ -255,7 +255,10 @@ class ApiHandler(BaseHTTPRequestHandler):
                     # workspace's work needs the 'use' grant.
                     rbac.require_workspace_access(
                         user, request.workspace or 'default', 'use')
-                ok = executor_lib.cancel_request(body['request_id'])
+                ok = executor_lib.cancel_request(
+                    body['request_id'],
+                    server_id=getattr(self.server, 'skyt_server_id',
+                                      None))
                 self._reply({'cancelled': ok})
             elif route == '/upload':
                 self._handle_upload()
@@ -804,13 +807,38 @@ class ApiServer:
     """Executor + HTTP server pair; in-process (tests) or main() (prod)."""
 
     def __init__(self, host: str = '127.0.0.1',
-                 port: int = DEFAULT_PORT) -> None:
+                 port: int = DEFAULT_PORT,
+                 server_id: Optional[str] = None) -> None:
         from skypilot_tpu import plugins
         plugins.load_plugins()
         self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
         self.httpd.daemon_threads = True
-        self.executor = executor_lib.Executor()
         self.port = self.httpd.server_address[1]
+        # Replica identity for the shared requests DB (HA). When the
+        # identity survives a restart (bare-metal host:port, k8s
+        # container restart) the rebooted server adopts its own
+        # orphaned rows; an identity that does NOT survive (replaced
+        # k8s pod) is recovered by peers via the heartbeat-requeue
+        # path instead.
+        import socket as socket_lib
+        self.server_id = (server_id or os.environ.get('SKYT_SERVER_ID')
+                          or f'{socket_lib.gethostname()}:{self.port}')
+        # Channel broker: this process owns one live runtime channel
+        # per cluster; runner/request processes proxy through the
+        # socket instead of spawning per-request SSH channels.
+        self.broker = None
+        if os.environ.get('SKYT_CHANNEL_BROKER', '1') != '0':
+            from skypilot_tpu.runtime.channel_broker import ChannelBroker
+            try:
+                self.broker = ChannelBroker()
+                self.broker.start()
+            except OSError as e:
+                logger.warning('channel broker disabled: %s', e)
+                self.broker = None
+        self.httpd.skyt_server_id = self.server_id
+        self.executor = executor_lib.Executor(
+            server_id=self.server_id,
+            broker_sock=self.broker.sock_path if self.broker else None)
         self.daemons: list = []
 
     def _start_daemons(self) -> None:
@@ -821,7 +849,7 @@ class ApiServer:
         from skypilot_tpu.server import daemons as daemons_lib
         if not config.get_nested(('api_server', 'daemons_enabled'), True):
             return
-        self.daemons = daemons_lib.start_all()
+        self.daemons = daemons_lib.start_all(server_id=self.server_id)
 
     @property
     def url(self) -> str:
@@ -850,6 +878,8 @@ class ApiServer:
         for d in self.daemons:
             d.stop()
         self.executor.shutdown()
+        if self.broker is not None:
+            self.broker.stop()
 
 
 def main(argv: Optional[list] = None) -> None:

@@ -12,9 +12,11 @@ process; intervals come from the layered config so tests can shrink them::
 """
 from __future__ import annotations
 
+import functools
+import os
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu.utils import log
 
@@ -92,6 +94,48 @@ def _serve_refresh_tick() -> None:
     waiting for a client to ask for `serve status`."""
     from skypilot_tpu.serve import core as serve_core
     serve_core._reap_dead_controllers()  # pylint: disable=protected-access
+
+
+# When this replica's LAST beat write failed, it must not judge peers:
+# a shared-DB outage makes every beat stale at once, and replicas that
+# requeue on recovery would double-execute each other's live work. The
+# tick only reaps after its own view of the DB has been continuously
+# healthy for a full stale window (any live peer beats within it).
+_ha_healthy_since: Dict[str, float] = {}
+
+
+def _requests_ha_tick(server_id: str) -> None:
+    """Heartbeat this replica + requeue RUNNING requests owned by
+    replicas whose heartbeat went stale (HA: any replica finishes any
+    poll; see requests_db module docstring). Stale threshold must
+    comfortably exceed the tick interval so a busy-but-alive replica is
+    never declared dead."""
+    from skypilot_tpu import config
+    from skypilot_tpu.server import requests_db
+    try:
+        requests_db.beat(server_id)
+    except Exception:
+        _ha_healthy_since.pop(server_id, None)
+        raise
+    now = time.time()
+    healthy_since = _ha_healthy_since.setdefault(server_id, now)
+    stale_after = float(
+        os.environ.get('SKYT_SERVER_STALE_S')
+        or config.get_nested(('api_server', 'server_stale_seconds'), 15.0))
+    if now - healthy_since < stale_after:
+        # Not yet one full stale window of continuous DB health from
+        # our side — a live peer may simply not have gotten its beat
+        # through yet (shared-DB outage, or we just booted mid-blip).
+        return
+    requeued, failed = requests_db.requeue_dead_server_requests(
+        server_id, stale_after)
+    if requeued:
+        logger.warning('Requeued %d request(s) from dead replicas.',
+                       requeued)
+    if failed:
+        logger.warning(
+            'Failed %d request(s) whose replicas died repeatedly '
+            '(requeue budget spent).', failed)
 
 
 def _log_ship_tick() -> None:
@@ -211,8 +255,14 @@ def _interval(key: str, default: float) -> Callable[[], float]:
     return get
 
 
-def build_daemons() -> List[Daemon]:
-    return [
+def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
+    daemons = []
+    if server_id is not None:
+        daemons.append(
+            Daemon('requests-ha',
+                   _interval('requests_ha_interval', 5.0),
+                   functools.partial(_requests_ha_tick, server_id)))
+    return daemons + [
         Daemon('cluster-status-refresh',
                _interval('cluster_refresh_interval', 60.0),
                _cluster_refresh_tick),
@@ -231,8 +281,8 @@ def build_daemons() -> List[Daemon]:
     ]
 
 
-def start_all() -> List[Daemon]:
-    daemons = build_daemons()
+def start_all(server_id: Optional[str] = None) -> List[Daemon]:
+    daemons = build_daemons(server_id)
     for d in daemons:
         d.start()
     logger.info('Started %d background daemons: %s', len(daemons),

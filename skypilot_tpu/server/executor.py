@@ -50,6 +50,20 @@ _ORPHAN_GRACE_S = 2.0
 _PIDLESS_GRACE_S = 10.0
 
 
+def _same_process(pid: int, recorded_created: Optional[float]) -> bool:
+    """Does the live process at ``pid`` have the start time we recorded
+    for the worker? Rows without a recorded time (legacy) are trusted
+    on existence alone."""
+    if recorded_created is None:
+        return True
+    try:
+        import psutil
+        return abs(psutil.Process(pid).create_time() -
+                   recorded_created) < 2.0
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
 def _set_pdeathsig() -> None:
     """Ask the kernel to SIGKILL this process when its parent (the
     runner) dies — kernel-delivered, so it covers kill -9/OOM of the
@@ -63,8 +77,12 @@ def _set_pdeathsig() -> None:
         pass  # best-effort; the orphan scanner still finalizes the row
 
 
-def _run_request_in_child(request_id: str) -> None:
-    """Child-process body: redirect output, run the payload, finalize."""
+def _run_request_in_child(request_id: str,
+                          server_id: Optional[str] = None) -> None:
+    """Child-process body: redirect output, run the payload, finalize.
+
+    ``server_id`` fences every DB write: if this replica was declared
+    dead and the request reclaimed by a peer, our writes must no-op."""
     request = requests_db.get(request_id)
     assert request is not None, request_id
     log_path = requests_db.request_log_path(request_id)
@@ -77,7 +95,13 @@ def _run_request_in_child(request_id: str) -> None:
     for handler in logging.getLogger().handlers:
         if isinstance(handler, logging.StreamHandler):
             handler.stream = sys.stderr
-    requests_db.set_pid(request_id, os.getpid())
+    try:
+        import psutil
+        pid_created = psutil.Process(os.getpid()).create_time()
+    except Exception:  # pylint: disable=broad-except
+        pid_created = None
+    requests_db.set_pid(request_id, os.getpid(), owner=server_id,
+                        pid_created=pid_created)
     # The caller's workspace scopes everything this request does (state
     # stamping, status filtering, launch placement) via the env the core
     # ops read (workspaces.active_workspace).
@@ -98,13 +122,15 @@ def _run_request_in_child(request_id: str) -> None:
             json.dumps(result)
         except TypeError:
             result = repr(result)
-        requests_db.finalize(request_id, RequestStatus.SUCCEEDED, result)
+        requests_db.finalize(request_id, RequestStatus.SUCCEEDED, result,
+                             owner=server_id)
         usage.record(f'request.{request.name}',
                      duration_s=time.time() - started)
     except BaseException as e:  # pylint: disable=broad-except
         traceback.print_exc()
         requests_db.finalize(request_id, RequestStatus.FAILED,
-                             error=f'{type(e).__name__}: {e}')
+                             error=f'{type(e).__name__}: {e}',
+                             owner=server_id)
         usage.record(f'request.{request.name}', outcome='failed',
                      duration_s=time.time() - started)
     finally:
@@ -115,7 +141,8 @@ def _run_request_in_child(request_id: str) -> None:
         log_file.flush()
 
 
-def runner_main(schedule_type_value: str) -> None:
+def runner_main(schedule_type_value: str,
+                server_id: Optional[str] = None) -> None:
     """Body of one pool runner process (single-threaded; safe to fork)."""
     schedule_type = ScheduleType(schedule_type_value)
     # Import the payload entrypoints (core/execution — the heavy modules)
@@ -140,7 +167,7 @@ def runner_main(schedule_type_value: str) -> None:
     while True:
         if os.getppid() == 1:  # server died; orphaned runner exits
             return
-        request = requests_db.claim_next(schedule_type)
+        request = requests_db.claim_next(schedule_type, server_id)
         if request is None:
             # Back off while the queue is dry (an idle pool must not
             # hammer the DB's write lock); snap back on the next claim.
@@ -152,7 +179,7 @@ def runner_main(schedule_type_value: str) -> None:
         if pid == 0:
             try:
                 _set_pdeathsig()
-                _run_request_in_child(request.request_id)
+                _run_request_in_child(request.request_id, server_id)
             finally:
                 os._exit(0)
         current_child['pid'] = pid
@@ -174,22 +201,29 @@ def runner_main(schedule_type_value: str) -> None:
             code = (os.waitstatus_to_exitcode(raw_status)
                     if hasattr(os, 'waitstatus_to_exitcode') else raw_status)
             requests_db.finalize(request.request_id, RequestStatus.FAILED,
-                                 error=f'worker exited with code {code}')
+                                 error=f'worker exited with code {code}',
+                                 owner=server_id)
 
 
-def _runner_cmd(schedule_type: ScheduleType) -> List[str]:
+def _runner_cmd(schedule_type: ScheduleType,
+                server_id: Optional[str]) -> List[str]:
     from skypilot_tpu.utils.subprocess_utils import python_s_bootstrap
     return python_s_bootstrap(
         'from skypilot_tpu.server.executor import runner_main; '
-        'runner_main(sys.argv[1])') + [schedule_type.value]
+        'runner_main(sys.argv[1], sys.argv[2] or None)'
+    ) + [schedule_type.value, server_id or '']
 
 
 class Executor:
     """Scales runner processes up to per-queue caps; reaps orphans."""
 
     def __init__(self,
-                 workers: Optional[Dict[ScheduleType, int]] = None) -> None:
+                 workers: Optional[Dict[ScheduleType, int]] = None,
+                 server_id: Optional[str] = None,
+                 broker_sock: Optional[str] = None) -> None:
         self._caps = dict(DEFAULT_WORKERS)
+        self._server_id = server_id
+        self._broker_sock = broker_sock
         if workers:
             self._caps.update(workers)
         self._runners: Dict[ScheduleType, List[subprocess.Popen]] = {
@@ -239,23 +273,39 @@ class Executor:
                 if not backlog:
                     continue
                 saw_backlog = True
+                # Scoped to OWN rows: in HA mode the shared DB holds
+                # other replicas' RUNNING requests too, and counting
+                # them would spawn runners for busy-ness that isn't
+                # ours.
                 running = sum(
                     1 for r in requests_db.list_requests(
-                        RequestStatus.RUNNING)
-                    if r.schedule_type == schedule_type)
+                        RequestStatus.RUNNING, limit=None)
+                    if r.schedule_type == schedule_type and
+                    r.server_id in (None, self._server_id))
                 idle = max(0, len(pool) - running)
                 want = min(cap - len(pool), backlog - idle)
+                runner_env = None
+                if self._broker_sock:
+                    # Runners (and the request children they fork)
+                    # proxy channel ops through the server's broker.
+                    from skypilot_tpu.runtime.channel_broker import (
+                        BROKER_SOCK_ENV)
+                    runner_env = {**os.environ,
+                                  BROKER_SOCK_ENV: self._broker_sock}
                 for _ in range(max(0, want)):
                     pool.append(
-                        subprocess.Popen(_runner_cmd(schedule_type),
+                        subprocess.Popen(_runner_cmd(schedule_type,
+                                                     self._server_id),
                                          stdout=runner_log,
                                          stderr=runner_log,
+                                         env=runner_env,
                                          start_new_session=True))
                     logger.debug('Spawned %s runner (pool=%d)',
                                  schedule_type.value, len(pool))
             now = time.time()
             if now - last_orphan_scan > 1.0:
                 self._reap_orphans(now)
+                self._kill_cancelled_own(now)
                 last_orphan_scan = now
             # Idle backoff: one cheap COUNT query per tick when quiet.
             idle_wait = 0.05 if saw_backlog else min(idle_wait * 1.5, 0.5)
@@ -266,8 +316,16 @@ class Executor:
         """Finalize RUNNING requests whose worker is gone: pid dead
         (runner + child killed, e.g. OOM/kill -9), or pid never recorded
         (runner died between claim and fork — without this, the request
-        stays RUNNING forever and clients long-poll indefinitely)."""
-        for request in requests_db.list_requests(RequestStatus.RUNNING):
+        stays RUNNING forever and clients long-poll indefinitely).
+
+        HA scoping: pids are host-local, so this scan only judges
+        requests THIS replica claimed (rows with no server_id predate
+        the column and belong to the single-server mode). Other
+        replicas' orphans are requeued by the heartbeat daemon."""
+        for request in requests_db.list_requests(RequestStatus.RUNNING,
+                                                 limit=None):
+            if request.server_id not in (None, self._server_id):
+                continue
             if not request.pid:
                 first_seen = self._pidless.setdefault(request.request_id,
                                                      now)
@@ -275,11 +333,18 @@ class Executor:
                     self._pidless.pop(request.request_id, None)
                     requests_db.finalize(
                         request.request_id, RequestStatus.FAILED,
-                        error='worker died before starting')
+                        error='worker died before starting',
+                        owner=request.server_id)
                 continue
             self._pidless.pop(request.request_id, None)
             try:
                 os.kill(request.pid, 0)
+                if not _same_process(request.pid, request.pid_created):
+                    # The pid exists but is NOT our worker: the pid was
+                    # reused (container restart resets the PID
+                    # namespace; long-lived hosts recycle pids). The
+                    # worker is gone.
+                    raise ProcessLookupError
                 self._dead_pids.pop(request.pid, None)
             except ProcessLookupError:
                 first_seen = self._dead_pids.setdefault(request.pid, now)
@@ -287,16 +352,50 @@ class Executor:
                     self._dead_pids.pop(request.pid, None)
                     requests_db.finalize(
                         request.request_id, RequestStatus.FAILED,
-                        error='worker process died')
+                        error='worker process died',
+                        owner=request.server_id)
             except PermissionError:
                 self._dead_pids.pop(request.pid, None)
 
+    def _kill_cancelled_own(self, now: float) -> None:
+        """Kill OUR workers whose request was CANCELLED through another
+        replica (that replica only flips the status — the pid is local
+        to us). Selected by cancellation time, so a long-running
+        request cancelled late is still seen."""
+        for request in requests_db.cancelled_since(now - 300):
+            if (request.server_id != self._server_id or
+                    not request.pid):
+                continue
+            try:
+                os.kill(request.pid, 0)
+            except (ProcessLookupError, PermissionError):
+                continue
+            if not _same_process(request.pid, request.pid_created):
+                continue
+            logger.info('Killing worker %s of remotely-cancelled '
+                        'request %s', request.pid, request.request_id)
+            kill_process_tree(request.pid, signal.SIGTERM)
 
-def cancel_request(request_id: str) -> bool:
-    """Cancel a pending or running request (parity: /api/cancel)."""
+
+def cancel_request(request_id: str,
+                   server_id: Optional[str] = None) -> bool:
+    """Cancel a pending or running request (parity: /api/cancel).
+
+    The recorded pid is HOST-LOCAL: if another replica owns the request
+    (HA mode), this replica only flips the status — killing `pid` here
+    would hit an unrelated local process. The owning replica's executor
+    loop notices the CANCELLED row and kills its own worker
+    (Executor._kill_cancelled_own)."""
     request = requests_db.get(request_id)
     if request is None or request.status.is_terminal():
         return False
+    remote_owner = (request.server_id is not None and
+                    server_id is not None and
+                    request.server_id != server_id)
+    if remote_owner:
+        return requests_db.finalize(request.request_id,
+                                    RequestStatus.CANCELLED,
+                                    error='cancelled by user')
     if request.status == RequestStatus.RUNNING and not request.pid:
         # Claimed but the forked child hasn't recorded its pid yet; wait
         # briefly so we kill the work instead of just flipping the status.

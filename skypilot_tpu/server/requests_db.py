@@ -3,6 +3,17 @@
 Parity: ``sky/server/requests/requests.py`` — every SDK call becomes a row
 here; clients poll ``/api/get`` or stream logs later, surviving client and
 server restarts.
+
+**HA mode**: with ``SKYT_DB_URL`` set the table lives in the shared
+Postgres, so ANY replica answers any poll and every replica's runner
+pool claims from one queue. Each RUNNING request is stamped with the
+claiming replica's ``server_id``; replicas heartbeat in
+``server_heartbeats`` and requeue (once) the RUNNING requests of a
+replica whose heartbeat went stale — a client polling request X through
+replica B completes even if replica A died mid-execution. Request log
+FILES stay on the executing replica's disk; deployments that want
+cross-replica log streaming mount a shared volume for the server dir
+(the helm chart's log PVC).
 """
 from __future__ import annotations
 
@@ -51,54 +62,76 @@ def request_log_path(request_id: str) -> str:
 
 _local = threading.local()
 
+# (url, pid) pairs whose shared-DB schema this process already ensured.
+_pg_schema_ready: set = set()
 
-def _db() -> sqlite3.Connection:
-    path = os.path.join(server_dir(), 'requests.db')
-    conn = getattr(_local, 'conn', None)
-    # Re-open after fork: reusing a parent's sqlite connection across
-    # processes corrupts the DB (executor workers are forked mid-claim).
-    if (conn is not None and getattr(_local, 'path', None) == path and
-            getattr(_local, 'pid', None) == os.getpid()):
-        return conn
+
+def _db():
+    """Per-thread dual-backend connection (same factory as state.py /
+    jobs — sqlite locally, the shared Postgres under SKYT_DB_URL so
+    every API-server replica serves one request queue)."""
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.utils import pg
+
+    def init_schema(conn) -> None:
+        conn.execute('PRAGMA journal_mode=WAL')
+        # "user" is quoted: reserved word in Postgres.
+        conn.executescript("""
+            CREATE TABLE IF NOT EXISTS requests (
+                request_id TEXT PRIMARY KEY,
+                name TEXT NOT NULL,        -- entrypoint name, e.g. 'launch'
+                body TEXT NOT NULL,        -- JSON kwargs
+                status TEXT NOT NULL,
+                schedule_type TEXT NOT NULL,
+                return_value TEXT,         -- JSON
+                error TEXT,
+                pid INTEGER,
+                "user" TEXT,
+                idem_key TEXT,             -- client idempotency key
+                workspace TEXT,            -- caller's active workspace
+                server_id TEXT,            -- claiming replica (HA)
+                requeues INTEGER DEFAULT 0,
+                pid_created REAL,          -- worker process start time
+                created_at REAL,
+                finished_at REAL
+            );
+            CREATE INDEX IF NOT EXISTS idx_requests_status
+                ON requests (status, schedule_type);
+            CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem
+                ON requests (idem_key) WHERE idem_key IS NOT NULL;
+            CREATE TABLE IF NOT EXISTS server_heartbeats (
+                server_id TEXT PRIMARY KEY,
+                last_beat REAL NOT NULL
+            );
+        """)
+        cols = {r['name'] for r in
+                conn.execute('PRAGMA table_info(requests)')}
+        if 'idem_key' not in cols:  # pre-existing DB, older version
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE requests ADD COLUMN idem_key TEXT')
+            conn.execute(
+                'CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem '
+                'ON requests (idem_key) WHERE idem_key IS NOT NULL')
+        if 'workspace' not in cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE requests ADD COLUMN workspace TEXT')
+        if 'server_id' not in cols:  # legacy DBs only (in CREATE now)
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE requests ADD COLUMN server_id TEXT')
+        if 'requeues' not in cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE requests ADD COLUMN '
+                'requeues INTEGER DEFAULT 0')
+        if 'pid_created' not in cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE requests ADD COLUMN pid_created REAL')
+        conn.commit()
+
     os.makedirs(server_dir(), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
-    conn.executescript("""
-        CREATE TABLE IF NOT EXISTS requests (
-            request_id TEXT PRIMARY KEY,
-            name TEXT NOT NULL,            -- entrypoint name, e.g. 'launch'
-            body TEXT NOT NULL,            -- JSON kwargs
-            status TEXT NOT NULL,
-            schedule_type TEXT NOT NULL,
-            return_value TEXT,             -- JSON
-            error TEXT,
-            pid INTEGER,
-            user TEXT,
-            idem_key TEXT,                 -- client idempotency key
-            workspace TEXT,                -- caller's active workspace
-            created_at REAL,
-            finished_at REAL
-        );
-        CREATE INDEX IF NOT EXISTS idx_requests_status
-            ON requests (status, schedule_type);
-        CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem
-            ON requests (idem_key) WHERE idem_key IS NOT NULL;
-    """)
-    cols = {r['name'] for r in conn.execute('PRAGMA table_info(requests)')}
-    if 'idem_key' not in cols:  # pre-existing DB from an older version
-        common_utils.add_column_if_missing(
-            conn, 'ALTER TABLE requests ADD COLUMN idem_key TEXT')
-        conn.execute('CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem '
-                     'ON requests (idem_key) WHERE idem_key IS NOT NULL')
-    if 'workspace' not in cols:
-        common_utils.add_column_if_missing(
-            conn, 'ALTER TABLE requests ADD COLUMN workspace TEXT')
-    conn.commit()
-    _local.conn = conn
-    _local.path = path
-    _local.pid = os.getpid()
-    return conn
+    return pg.connect_dual_backend(
+        _local, _pg_schema_ready, url=state_lib.db_url(),
+        sqlite_path=os.path.join(server_dir(), 'requests.db'),
+        init_schema=init_schema)
 
 
 class Request:
@@ -116,6 +149,9 @@ class Request:
         self.workspace: Optional[str] = row['workspace']
         self.created_at: Optional[float] = row['created_at']
         self.finished_at: Optional[float] = row['finished_at']
+        self.server_id: Optional[str] = row['server_id']
+        self.requeues: int = row['requeues'] or 0
+        self.pid_created: Optional[float] = row['pid_created']
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -145,22 +181,28 @@ def create(name: str,
     dropped connection (chaos: tests/chaos_proxy.py) gets the original
     request_id back instead of double-scheduling the work.
     """
+    from skypilot_tpu.utils import pg
     request_id = common_utils.new_request_id()
     conn = _db()
     try:
         conn.execute(
             'INSERT INTO requests (request_id, name, body, status, '
-            'schedule_type, user, idem_key, workspace, created_at) '
+            'schedule_type, "user", idem_key, workspace, created_at) '
             'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
             (request_id, name, json.dumps(body), RequestStatus.PENDING.value,
              schedule_type.value, user or common_utils.get_user(), idem_key,
              workspace, time.time()))
         conn.commit()
-    except sqlite3.IntegrityError:
-        # idem_key collision: the earlier attempt reached us. Roll back
-        # first — the failed INSERT opened a write transaction that would
-        # otherwise hold the DB write lock for this thread's lifetime,
-        # starving every runner's claim.
+    except (sqlite3.IntegrityError, pg.PgError) as e:
+        if isinstance(e, pg.PgError) and not (
+                e.code == '23505' or 'UNIQUE constraint' in str(e)):
+            raise
+        # idem_key collision: the earlier attempt reached us (possibly
+        # through ANOTHER replica — the shared DB makes client retries
+        # converge on one request). Roll back first — the failed INSERT
+        # opened a write transaction that would otherwise hold the DB
+        # write lock for this thread's lifetime, starving every
+        # runner's claim.
         conn.rollback()
         row = conn.execute(
             'SELECT request_id FROM requests WHERE idem_key = ?',
@@ -182,37 +224,46 @@ def get(request_id: str) -> Optional[Request]:
 
 
 def list_requests(status: Optional[RequestStatus] = None,
-                  limit: int = 100) -> List[Request]:
+                  limit: Optional[int] = 100) -> List[Request]:
+    """``limit=None`` returns every match — reconciliation scans
+    (orphan reap, dead-replica requeue) MUST see all RUNNING rows; a
+    windowed read hides exactly the oldest orphans it exists to find."""
+    tail = '' if limit is None else f' LIMIT {int(limit)}'
     if status is None:
         rows = _db().execute(
-            'SELECT * FROM requests ORDER BY created_at DESC LIMIT ?',
-            (limit,)).fetchall()
+            f'SELECT * FROM requests ORDER BY created_at DESC{tail}'
+        ).fetchall()
     else:
         rows = _db().execute(
             'SELECT * FROM requests WHERE status = ? '
-            'ORDER BY created_at DESC LIMIT ?',
-            (status.value, limit)).fetchall()
+            f'ORDER BY created_at DESC{tail}',
+            (status.value,)).fetchall()
     return [Request(r) for r in rows]
 
 
-def claim_next(schedule_type: ScheduleType) -> Optional[Request]:
-    """Atomically pop the oldest PENDING request of this type.
+def claim_next(schedule_type: ScheduleType,
+               server_id: Optional[str] = None) -> Optional[Request]:
+    """Atomically pop the oldest PENDING request of this type, stamping
+    the claiming replica's identity.
 
-    Claimants are separate runner PROCESSES (executor worker pool), so the
-    pop must be atomic at the DB level: a single UPDATE..RETURNING on the
-    selected row, serialized by sqlite's write lock.
+    Claimants are separate runner PROCESSES (executor worker pool) and,
+    in HA mode, processes on OTHER replicas — the pop must be atomic at
+    the DB level: a single UPDATE..RETURNING on the selected row,
+    serialized by sqlite's write lock / Postgres row locking (a loser
+    re-evaluates the WHERE on the updated row and matches nothing).
     """
     conn = _db()
     with _claim_lock:
         try:
             row = conn.execute(
-                'UPDATE requests SET status = ? WHERE request_id = ('
+                'UPDATE requests SET status = ?, server_id = ? '
+                'WHERE request_id = ('
                 '  SELECT request_id FROM requests'
                 '  WHERE status = ? AND schedule_type = ?'
                 '  ORDER BY created_at LIMIT 1'
                 ') AND status = ? RETURNING request_id',
-                (RequestStatus.RUNNING.value, RequestStatus.PENDING.value,
-                 schedule_type.value,
+                (RequestStatus.RUNNING.value, server_id,
+                 RequestStatus.PENDING.value, schedule_type.value,
                  RequestStatus.PENDING.value)).fetchone()
             conn.commit()
         except sqlite3.OperationalError as e:
@@ -233,26 +284,52 @@ def claim_next(schedule_type: ScheduleType) -> Optional[Request]:
 _claim_lock = threading.Lock()
 
 
-def set_pid(request_id: str, pid: int) -> None:
+def set_pid(request_id: str, pid: int,
+            owner: Optional[str] = None,
+            pid_created: Optional[float] = None) -> None:
+    """``owner`` fences the write to rows this replica still holds (a
+    requeued-and-reclaimed request must not get a stale pid).
+    ``pid_created`` (the worker's process start time) disambiguates
+    pid REUSE: after a container restart the PID namespace starts
+    over, so a recorded pid can name a live-but-unrelated process —
+    the liveness scan compares start times, not just existence."""
     conn = _db()
-    conn.execute('UPDATE requests SET pid = ? WHERE request_id = ?',
-                 (pid, request_id))
+    if owner is not None:
+        conn.execute(
+            'UPDATE requests SET pid = ?, pid_created = ? '
+            'WHERE request_id = ? AND server_id = ?',
+            (pid, pid_created, request_id, owner))
+    else:
+        conn.execute(
+            'UPDATE requests SET pid = ?, pid_created = ? '
+            'WHERE request_id = ?', (pid, pid_created, request_id))
     conn.commit()
 
 
 def finalize(request_id: str,
              status: RequestStatus,
              return_value: Any = None,
-             error: Optional[str] = None) -> bool:
+             error: Optional[str] = None,
+             owner: Optional[str] = None) -> bool:
     """First terminal writer wins: a worker finishing after /api/cancel
-    must not overwrite CANCELLED (and vice versa)."""
+    must not overwrite CANCELLED (and vice versa).
+
+    ``owner`` is the ownership fence for HA: a replica that was
+    partitioned past the stale threshold may still have a live runner
+    for a request that was requeued and RECLAIMED by a peer — its late
+    finalize must no-op, not clobber the new owner's execution. Pass
+    the executing replica's server_id from every worker-path call;
+    user-initiated cancels stay unfenced."""
     conn = _db()
-    cur = conn.execute(
-        'UPDATE requests SET status = ?, return_value = ?, error = ?, '
-        'finished_at = ? WHERE request_id = ? AND status IN (?, ?)',
-        (status.value, json.dumps(return_value), error, time.time(),
-         request_id, RequestStatus.PENDING.value,
-         RequestStatus.RUNNING.value))
+    sql = ('UPDATE requests SET status = ?, return_value = ?, error = ?, '
+           'finished_at = ? WHERE request_id = ? AND status IN (?, ?)')
+    args = [status.value, json.dumps(return_value), error, time.time(),
+            request_id, RequestStatus.PENDING.value,
+            RequestStatus.RUNNING.value]
+    if owner is not None:
+        sql += ' AND server_id = ?'
+        args.append(owner)
+    cur = conn.execute(sql, args)
     conn.commit()
     return cur.rowcount == 1
 
@@ -276,9 +353,102 @@ def pending_depth_by_queue() -> Dict[str, int]:
     return out
 
 
+def cancelled_since(ts: float) -> List[Request]:
+    """CANCELLED requests finalized at/after ``ts`` — selected by
+    FINISH time, not creation time: the executor's remote-cancel kill
+    scan must see a just-cancelled row no matter how old the request
+    itself is."""
+    rows = _db().execute(
+        'SELECT * FROM requests WHERE status = ? AND finished_at >= ?',
+        (RequestStatus.CANCELLED.value, ts)).fetchall()
+    return [Request(r) for r in rows]
+
+
+# -- HA: replica heartbeats + orphan requeue --------------------------------
+
+
+def beat(server_id: str) -> None:
+    """Refresh this replica's liveness timestamp (portable upsert: an
+    UPDATE-then-INSERT keeps one SQL body for both backends)."""
+    from skypilot_tpu.utils import pg
+    conn = _db()
+    now = time.time()
+    cur = conn.execute(
+        'UPDATE server_heartbeats SET last_beat = ? WHERE server_id = ?',
+        (now, server_id))
+    if cur.rowcount == 0:
+        try:
+            conn.execute(
+                'INSERT INTO server_heartbeats (server_id, last_beat) '
+                'VALUES (?, ?)', (server_id, now))
+        except (sqlite3.IntegrityError, pg.PgError):
+            # Another thread of this replica inserted first; their beat
+            # is as fresh as ours.
+            conn.rollback()
+    conn.commit()
+
+
+def live_server_ids(stale_after: float) -> set:
+    rows = _db().execute(
+        'SELECT server_id FROM server_heartbeats WHERE last_beat >= ?',
+        (time.time() - stale_after,)).fetchall()
+    return {r['server_id'] for r in rows}
+
+
+def requeue_dead_server_requests(own_server_id: str,
+                                 stale_after: float,
+                                 max_requeues: int = 1
+                                 ) -> Tuple[int, int]:
+    """Requeue RUNNING requests owned by replicas whose heartbeat went
+    stale, so another replica's runner pool re-executes them (the
+    client's poll on the same request_id then completes through any
+    replica). Each request is requeued at most ``max_requeues`` times —
+    a request that kills its executor would otherwise ping-pong between
+    replicas forever; past the budget it is FAILED with the death
+    attributed. Atomic per row (conditional UPDATE on the observed
+    status+owner), so concurrent reapers on several replicas never
+    double-requeue. Returns ``(requeued, failed)``.
+
+    Callers must only invoke this after their OWN view of the DB has
+    been continuously healthy for a full stale window (see
+    daemons._requests_ha_tick) — otherwise a shared-DB outage makes
+    every live replica look stale to every other and they requeue each
+    other's in-flight work on recovery."""
+    conn = _db()
+    live = live_server_ids(stale_after)
+    live.add(own_server_id)
+    requeued = failed = 0
+    for request in list_requests(RequestStatus.RUNNING, limit=None):
+        if request.server_id is None or request.server_id in live:
+            continue
+        if request.requeues >= max_requeues:
+            if finalize(request.request_id, RequestStatus.FAILED,
+                        error=(f'API server replica {request.server_id} '
+                               'died mid-request; requeue budget spent'),
+                        owner=request.server_id):
+                failed += 1
+            continue
+        cur = conn.execute(
+            'UPDATE requests SET status = ?, server_id = NULL, '
+            'pid = NULL, requeues = requeues + 1 '
+            'WHERE request_id = ? AND status = ? AND server_id = ?',
+            (RequestStatus.PENDING.value, request.request_id,
+             RequestStatus.RUNNING.value, request.server_id))
+        conn.commit()
+        if cur.rowcount == 1:
+            requeued += 1
+    # Heartbeat rows of long-departed replicas (replaced k8s pods get
+    # NEW names) are dead weight once their requests are drained.
+    conn.execute(
+        'DELETE FROM server_heartbeats WHERE last_beat < ?',
+        (time.time() - max(600.0, 10 * stale_after),))
+    conn.commit()
+    return requeued, failed
+
+
 def reset_db_for_tests() -> None:
     conn = getattr(_local, 'conn', None)
     if conn is not None:
         conn.close()
-        _local.conn = None
-        _local.path = None
+    _local.__dict__.clear()
+    _pg_schema_ready.clear()

@@ -430,9 +430,14 @@ def connect_dual_backend(local, ready_set, *, url, sqlite_path,
         return conn
     if url:
         conn = PgSqliteAdapter(PgConnection.from_url(url))
-        if (url, os.getpid()) not in ready_set:
+        if url not in ready_set:
+            # Keyed by url alone: a forked request child INHERITS the
+            # parent's ready set (the schema it ensured is just as
+            # ensured), and replaying ~6 DDL round trips against the
+            # remote DB on every forked request is pure hot-path waste.
+            # Fresh processes start with an empty set and re-ensure.
             init_schema(conn)
-            ready_set.add((url, os.getpid()))
+            ready_set.add(url)
     else:
         os.makedirs(os.path.dirname(sqlite_path), exist_ok=True)
         conn = sqlite3.connect(sqlite_path, timeout=10)

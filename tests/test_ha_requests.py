@@ -1,0 +1,186 @@
+"""Multi-replica API-server HA over the shared requests DB (parity:
+``sky/server/requests/requests.py`` persists requests server-side so any
+server process answers any poll; the reference's helm HA mode).
+
+Two ApiServer instances share one (fake) Postgres: a request submitted
+through replica A is visible/pollable through replica B; when A dies
+mid-request, B's heartbeat daemon requeues A's RUNNING rows and B's
+runner pool re-executes them, so the client's poll on the SAME
+request_id completes through B."""
+import os
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from skypilot_tpu import state
+from skypilot_tpu.client import sdk
+from skypilot_tpu.provision import fake
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+
+from tests.fake_pg import FakePgServer
+
+
+@pytest.fixture()
+def ha_env(tmp_home, monkeypatch):
+    server = FakePgServer()
+    monkeypatch.setenv('SKYT_DB_URL', server.url)
+    # Fast HA cadence: heartbeat every 0.3s, declare dead after 1.5s.
+    monkeypatch.setenv('SKYT_SERVER_STALE_S', '1.5')
+    cfg_path = os.path.join(os.environ['SKYT_STATE_DIR'], 'server',
+                            'config.yaml')
+    os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump({'api_server': {'requests_ha_interval': 0.3}}, f)
+    state._local.__dict__.clear()
+    requests_db.reset_db_for_tests()
+    fake.reset()
+    yield server
+    requests_db.reset_db_for_tests()
+    state._local.__dict__.clear()
+    fake.reset()
+    server.close()
+
+
+def _tpu_task(run='echo hi'):
+    return Task(name='t', run=run,
+                resources=Resources(cloud='fake', accelerators='tpu-v5e-8'))
+
+
+def _wait(predicate, timeout=30, msg='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.1)
+    raise AssertionError(f'timed out waiting for {msg}')
+
+
+def test_submit_via_a_poll_via_b(ha_env, monkeypatch):
+    """Any replica answers any poll: the request row lives in the
+    shared DB, not in the receiving server's memory or local disk."""
+    srv_a = ApiServer(port=0, server_id='replica-a')
+    srv_a.start_background()
+    srv_b = ApiServer(port=0, server_id='replica-b')
+    srv_b.start_background()
+    try:
+        monkeypatch.setenv('SKYT_API_SERVER_URL', srv_a.url)
+        request_id = sdk.status()
+        # Poll through B — and through B's HTTP surface, not the DB.
+        monkeypatch.setenv('SKYT_API_SERVER_URL', srv_b.url)
+        result = sdk.get(request_id, timeout=60)
+        assert isinstance(result, list)
+        # /api/status listing also sees it from B.
+        with urllib.request.urlopen(
+                f'{srv_b.url}/api/get?request_id={request_id}',
+                timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+def test_replica_death_mid_request_recovers_via_b(ha_env, monkeypatch):
+    """Kill A while it executes a LONG request; the client's poll on the
+    same request_id completes via B (heartbeat-stale requeue)."""
+    srv_a = ApiServer(port=0, server_id='replica-a')
+    srv_a.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv_a.url)
+
+    # A cluster for the long request to exec on (launched through A).
+    launch_id = sdk.launch(_tpu_task(), cluster_name='ha-c')
+    sdk.get(launch_id, timeout=120)
+
+    # The long request: exec blocks until the job's sleep finishes.
+    exec_id = sdk.exec(_tpu_task(run='sleep 8'), cluster_name='ha-c')
+    record = _wait(
+        lambda: (lambda r: r if r and r.status.value == 'RUNNING' and
+                 r.server_id else None)(requests_db.get(exec_id)),
+        msg='exec request RUNNING on A')
+    assert record.server_id == 'replica-a'
+
+    # Replica A dies mid-request (runners killed, heartbeat stops; the
+    # row stays RUNNING with a dead owner).
+    srv_a.shutdown()
+
+    srv_b = ApiServer(port=0, server_id='replica-b')
+    srv_b.start_background()
+    try:
+        monkeypatch.setenv('SKYT_API_SERVER_URL', srv_b.url)
+        result = sdk.get(exec_id, timeout=120)
+        assert result is not None
+        final = requests_db.get(exec_id)
+        assert final.status == requests_db.RequestStatus.SUCCEEDED
+        assert final.server_id == 'replica-b'
+        assert final.requeues == 1
+    finally:
+        srv_b.shutdown()
+
+
+def test_requeue_budget_exhaustion_fails_request(ha_env):
+    """A request whose owner dies repeatedly is FAILED, not ping-ponged
+    forever: the requeue budget is 1."""
+    request_id = requests_db.create('status', {},
+                                    requests_db.ScheduleType.SHORT)
+    claimed = requests_db.claim_next(requests_db.ScheduleType.SHORT,
+                                     'replica-a')
+    assert claimed.request_id == request_id
+    requests_db.beat('replica-b')
+    # First death: requeued.
+    assert requests_db.requeue_dead_server_requests(
+        'replica-b', stale_after=0.0) == (1, 0)
+    assert requests_db.get(request_id).status.value == 'PENDING'
+    assert requests_db.get(request_id).requeues == 1
+    # Second claim + second death: budget spent, FAILED.
+    requests_db.claim_next(requests_db.ScheduleType.SHORT, 'replica-c')
+    assert requests_db.requeue_dead_server_requests(
+        'replica-b', stale_after=0.0) == (0, 1)
+    final = requests_db.get(request_id)
+    assert final.status == requests_db.RequestStatus.FAILED
+    assert 'died mid-request' in final.error
+
+
+def test_idempotent_resubmit_converges_across_replicas(ha_env):
+    """A client retry that lands on a different replica gets the
+    original request id back (shared idem_key index)."""
+    first = requests_db.create('status', {},
+                               requests_db.ScheduleType.SHORT,
+                               idem_key='retry-1')
+    second = requests_db.create('status', {},
+                                requests_db.ScheduleType.SHORT,
+                                idem_key='retry-1')
+    assert first == second
+
+
+def test_stale_owner_finalize_is_fenced(ha_env):
+    """A replica partitioned past the stale window may still have a live
+    runner; once a peer requeues + reclaims the request, the stale
+    owner's late finalize/set_pid must no-op (ownership fence)."""
+    request_id = requests_db.create('status', {},
+                                    requests_db.ScheduleType.SHORT)
+    requests_db.claim_next(requests_db.ScheduleType.SHORT, 'replica-a')
+    requests_db.beat('replica-b')
+    assert requests_db.requeue_dead_server_requests(
+        'replica-b', stale_after=0.0) == (1, 0)
+    # Peer reclaims.
+    reclaimed = requests_db.claim_next(requests_db.ScheduleType.SHORT,
+                                       'replica-b')
+    assert reclaimed.request_id == request_id
+    # The stale owner's runner wakes up and reports a result: fenced.
+    assert not requests_db.finalize(
+        request_id, requests_db.RequestStatus.FAILED,
+        error='late loser write', owner='replica-a')
+    requests_db.set_pid(request_id, 424242, owner='replica-a')
+    record = requests_db.get(request_id)
+    assert record.status == requests_db.RequestStatus.RUNNING
+    assert record.server_id == 'replica-b'
+    assert record.pid != 424242
+    # The new owner's writes land.
+    assert requests_db.finalize(
+        request_id, requests_db.RequestStatus.SUCCEEDED, {'ok': True},
+        owner='replica-b')
