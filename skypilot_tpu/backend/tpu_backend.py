@@ -42,6 +42,20 @@ class TpuPodBackend(Backend):
                   dryrun: bool = False,
                   blocklist=None) -> Optional[ClusterInfo]:
         candidates = Optimizer.plan_task(task)
+        if task.best_resources is not None:
+            # An upstream optimize pass (joint DAG placement, or a
+            # caller that pinned the choice) already decided: its pick
+            # leads, the rest of the ranking stays as failover tail.
+            best = task.best_resources
+
+            def _is_best(c) -> bool:
+                return (c.resources.cloud == best.cloud and
+                        c.resources.region == best.region and
+                        (best.zone is None or
+                         c.resources.zone == best.zone))
+
+            candidates = ([c for c in candidates if _is_best(c)] +
+                          [c for c in candidates if not _is_best(c)])
         if task.volumes:
             # Volume gate: a named volume lives on ONE cloud (a PVC is
             # meaningless on GCE and vice versa), so candidates must be

@@ -75,6 +75,17 @@ def launch(task_or_dag: Union[Task, Dag],
             workspaces.validate_cloud(res.cloud)
     backend = backend or TpuPodBackend()
     stages = stages or ALL_STAGES
+    # Joint DAG placement (parity: sky/optimizer.py:429 DP): tasks with
+    # estimated_outputs_gb hints are placed together so inter-task
+    # egress is traded against rent; _execute_task skips its per-task
+    # optimize when best_resources is already assigned.
+    if (len(dag.tasks) > 1 and (stages is ALL_STAGES or
+                                Stage.OPTIMIZE in stages) and
+            any(t.estimated_outputs_gb for t in dag.tasks) and
+            all(t.best_resources is None for t in dag.tasks)):
+        Optimizer.optimize(dag,
+                           enabled_clouds=workspaces.enabled_allowed_clouds(),
+                           quiet=False)
     chain_gated = (len(dag.tasks) > 1 and not dryrun
                    and dag.execution == DagExecution.WAIT_SUCCESS)
     if chain_gated and not dag.is_chain():
@@ -299,12 +310,10 @@ def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
     from skypilot_tpu.utils import timeline
     if Stage.OPTIMIZE in stages and task.best_resources is None:
         with timeline.Event('optimize', cluster=cluster_name):
-            from skypilot_tpu import check, workspaces
-            allowed = workspaces.allowed_clouds()
-            if allowed is not None:
-                allowed = [c for c in check.get_enabled_clouds()
-                           if c in allowed]
-            Optimizer.optimize(Dag.from_task(task), enabled_clouds=allowed)
+            from skypilot_tpu import workspaces
+            Optimizer.optimize(
+                Dag.from_task(task),
+                enabled_clouds=workspaces.enabled_allowed_clouds())
     info = None
     if Stage.PROVISION in stages:
         with timeline.Event('provision', cluster=cluster_name):

@@ -176,8 +176,100 @@ def candidates_for(resources: Resources,
     return out
 
 
+# Candidate-list cap per task in joint planning (the edge minimization
+# is O(C^2) per edge; 16 covers every region x spot tier that matters).
+MAX_JOINT_CANDIDATES = 16
+# Default runtime assumption when a task carries no FLOPs hint: rank by
+# one hour of rent (parity: the reference's default instance-time
+# assumption in cost estimation, sky/optimizer.py:239).
+DEFAULT_RUNTIME_HOURS = 1.0
+
+
+def _node_cost(candidate: Candidate) -> float:
+    """One comparable $ figure per candidate: end-to-end $ when the
+    runtime is estimable, else one default-runtime hour of rent plus
+    the input-egress charge."""
+    total = candidate.total_cost
+    if total is not None:
+        return total
+    return (candidate.hourly_cost * DEFAULT_RUNTIME_HOURS +
+            candidate.egress_cost)
+
+
+def _edge_cost(parent: Task, parent_cand: Candidate,
+               child_cand: Candidate) -> float:
+    """$ to move the parent's outputs to the child's placement."""
+    gb = parent.estimated_outputs_gb
+    if not gb:
+        return 0.0
+    src = (parent_cand.resources.cloud, parent_cand.resources.region)
+    dst = (child_cand.resources.cloud, child_cand.resources.region)
+    if src == dst:
+        return 0.0
+    return gb * EGRESS_PRICE_PER_GB
+
+
+def _dag_edges(dag: Dag):
+    """(parents_of, children_of) maps by task name. Explicit
+    ``depends_on`` edges when present; otherwise document order IS the
+    chain (the chain executor runs tasks sequentially and data flows
+    forward), which is exactly the reference DP's input shape."""
+    if dag.has_explicit_edges():
+        parents_of = {t.name: dag.parents(t) for t in dag.tasks}
+        children_of = {t.name: dag.children(t) for t in dag.tasks}
+        return parents_of, children_of
+    parents_of = {}
+    children_of = {}
+    for i, task in enumerate(dag.tasks):
+        parents_of[task.name] = [dag.tasks[i - 1]] if i > 0 else []
+        children_of[task.name] = ([dag.tasks[i + 1]]
+                                  if i + 1 < len(dag.tasks) else [])
+    return parents_of, children_of
+
+
+def _levels(dag: Dag) -> 'List[List[Task]]':
+    if dag.has_explicit_edges():
+        return dag.topological_levels()
+    return [[t] for t in dag.tasks]
+
+
+@dataclasses.dataclass
+class DagPlan:
+    """A joint placement for a DAG: per-task choices + the $ ledger."""
+    choices: 'dict[str, Candidate]'
+    edge_costs: 'dict[tuple, float]'     # (parent, child) -> $
+    total_cost: float
+    greedy_cost: float                   # what per-task greedy would pay
+    method: str                          # 'tree-dp' | 'local-search'
+
+    def table(self) -> str:
+        """Human-readable plan table (parity: the reference's optimizer
+        table, sky/optimizer.py _print_candidates)."""
+        lines = [f'{"TASK":<18}{"CLOUD":<8}{"REGION":<18}'
+                 f'{"$/HR":>8}{"NODE $":>10}{"EGRESS IN $":>12}']
+        for name, cand in self.choices.items():
+            egress_in = sum(cost for (_, child), cost in
+                            self.edge_costs.items() if child == name)
+            res = cand.resources
+            lines.append(
+                f'{name:<18}{res.cloud or "?":<8}{res.region or "?":<18}'
+                f'{cand.hourly_cost:>8.2f}{_node_cost(cand):>10.2f}'
+                f'{egress_in:>12.2f}')
+        lines.append(f'Joint plan total: ${self.total_cost:.2f} '
+                     f'(per-task greedy: ${self.greedy_cost:.2f}, '
+                     f'method: {self.method})')
+        return '\n'.join(lines)
+
+
 class Optimizer:
-    """Assigns `task.best_resources` for every task in a chain DAG."""
+    """Assigns `task.best_resources` for every task in a DAG.
+
+    Chain/fan-out DAGs whose tasks carry ``estimated_outputs_gb``
+    hints are planned JOINTLY: placements are chosen to minimize
+    node $ + inter-task egress $ over the whole graph (parity: the
+    reference's DP over chain DAGs, sky/optimizer.py:429, and its ILP
+    for graphs, :490). Everything else keeps per-task greedy.
+    """
 
     @staticmethod
     def optimize(dag: Dag,
@@ -185,6 +277,15 @@ class Optimizer:
                  quiet: bool = True,
                  minimize: str = 'cost') -> Dag:
         dag.validate()
+        if (minimize == 'cost' and len(dag.tasks) > 1 and
+                any(t.estimated_outputs_gb for t in dag.tasks) and
+                all(t.name for t in dag.tasks)):
+            plan = Optimizer.plan_dag(dag, enabled_clouds)
+            for task in dag.tasks:
+                task.best_resources = plan.choices[task.name].resources
+            if not quiet:
+                logger.info('Joint DAG plan:\n%s', plan.table())
+            return dag
         for task in dag.tasks:
             plan = Optimizer.plan_task(task, enabled_clouds,
                                        minimize=minimize)
@@ -193,6 +294,131 @@ class Optimizer:
                 logger.info('Task %s: chose %s', task.name or '<unnamed>',
                             plan[0])
         return dag
+
+    @staticmethod
+    def plan_dag(dag: Dag,
+                 enabled_clouds: Optional[Sequence[str]] = None
+                 ) -> DagPlan:
+        """Jointly place a DAG with inter-task egress.
+
+        Exact dynamic programming when every task has at most one
+        parent (chains and fan-out trees — the reference's DP case);
+        greedy-seeded coordinate descent for fan-in graphs (the
+        reference reaches for an ILP there; local search converges to
+        the same co-location structure without a solver dependency and
+        is never worse than greedy, which it starts from).
+        """
+        parents_of, children_of = _dag_edges(dag)
+        candidates = {}
+        for task in dag.tasks:
+            plan = Optimizer.plan_task(task, enabled_clouds)
+            if len(plan) > MAX_JOINT_CANDIDATES:
+                logger.debug(
+                    'Task %s: %d candidates capped to %d for joint '
+                    'planning.', task.name, len(plan),
+                    MAX_JOINT_CANDIDATES)
+            candidates[task.name] = plan[:MAX_JOINT_CANDIDATES]
+        greedy_choice = {name: plan[0]
+                         for name, plan in candidates.items()}
+        multi_parent = any(len(parents_of[t.name]) > 1
+                           for t in dag.tasks)
+        if multi_parent:
+            choices, method = Optimizer._plan_local_search(
+                dag, candidates, parents_of, children_of)
+        else:
+            choices, method = Optimizer._plan_tree_dp(
+                dag, candidates, parents_of, children_of)
+        edge_costs = {}
+        for task in dag.tasks:
+            for child in children_of[task.name]:
+                edge_costs[(task.name, child.name)] = _edge_cost(
+                    task, choices[task.name], choices[child.name])
+        total = (sum(_node_cost(c) for c in choices.values()) +
+                 sum(edge_costs.values()))
+        greedy_total = sum(_node_cost(c) for c in greedy_choice.values())
+        for task in dag.tasks:
+            for child in children_of[task.name]:
+                greedy_total += _edge_cost(task,
+                                           greedy_choice[task.name],
+                                           greedy_choice[child.name])
+        return DagPlan(choices=choices, edge_costs=edge_costs,
+                       total_cost=total, greedy_cost=greedy_total,
+                       method=method)
+
+    @staticmethod
+    def _plan_tree_dp(dag: Dag, candidates, parents_of, children_of):
+        """Leaves-up DP, exact for forests (every task <=1 parent):
+        best_down[t][i] = node $ of candidate i plus, for each child,
+        the cheapest (edge $ + child subtree $)."""
+        order = [t for level in _levels(dag) for t in level]
+        best_down = {}            # name -> [subtree $ per candidate]
+        pick_down = {}            # (name, i) -> {child: j}
+        for task in reversed(order):
+            cands = candidates[task.name]
+            totals = []
+            for i, cand in enumerate(cands):
+                total = _node_cost(cand)
+                picks = {}
+                for child in children_of[task.name]:
+                    child_cands = candidates[child.name]
+                    best_j, best_cost = 0, float('inf')
+                    for j in range(len(child_cands)):
+                        cost = (_edge_cost(task, cand, child_cands[j]) +
+                                best_down[child.name][j])
+                        if cost < best_cost:
+                            best_j, best_cost = j, cost
+                    total += best_cost
+                    picks[child.name] = best_j
+                totals.append(total)
+                pick_down[(task.name, i)] = picks
+            best_down[task.name] = totals
+        choices = {}
+
+        def _descend(task: Task, i: int) -> None:
+            choices[task.name] = candidates[task.name][i]
+            for child in children_of[task.name]:
+                _descend(child, pick_down[(task.name, i)][child.name])
+
+        for task in order:
+            if not parents_of[task.name]:  # forest roots
+                root_costs = best_down[task.name]
+                _descend(task, root_costs.index(min(root_costs)))
+        return choices, 'tree-dp'
+
+    @staticmethod
+    def _plan_local_search(dag: Dag, candidates, parents_of,
+                           children_of, max_sweeps: int = 8):
+        """Fan-in graphs: start from per-task greedy, then sweep tasks
+        in topological order re-choosing each placement against its
+        fixed neighbors until no sweep improves. Monotone, so never
+        worse than greedy."""
+        order = [t for level in _levels(dag) for t in level]
+        assign = {t.name: 0 for t in order}
+        for _ in range(max_sweeps):
+            changed = False
+            for task in order:
+                cands = candidates[task.name]
+                best_i, best_cost = assign[task.name], float('inf')
+                for i, cand in enumerate(cands):
+                    cost = _node_cost(cand)
+                    for parent in parents_of[task.name]:
+                        cost += _edge_cost(
+                            parent,
+                            candidates[parent.name][assign[parent.name]],
+                            cand)
+                    for child in children_of[task.name]:
+                        cost += _edge_cost(
+                            task, cand,
+                            candidates[child.name][assign[child.name]])
+                    if cost < best_cost:
+                        best_i, best_cost = i, cost
+                if best_i != assign[task.name]:
+                    assign[task.name] = best_i
+                    changed = True
+            if not changed:
+                break
+        return ({t.name: candidates[t.name][assign[t.name]]
+                 for t in order}, 'local-search')
 
     @staticmethod
     def plan_task(task: Task,

@@ -186,6 +186,7 @@ TASK_SCHEMA: Dict[str, Any] = {
         # task.set_time_estimator, here declaratively in YAML).
         'estimated_flops': {'type': ['number', 'null'], 'minimum': 0},
         'estimated_inputs_gb': {'type': ['number', 'null'], 'minimum': 0},
+        'estimated_outputs_gb': {'type': ['number', 'null'], 'minimum': 0},
         'inputs_region': {'type': ['string', 'null']},
         # Explicit DAG edges (fan-out graphs): names of tasks in the
         # same multi-document YAML this one waits on.

@@ -45,6 +45,7 @@ class Task:
         estimated_flops: Optional[float] = None,
         estimated_inputs_gb: Optional[float] = None,
         inputs_region: Optional[str] = None,
+        estimated_outputs_gb: Optional[float] = None,
         depends_on: Optional[List[str]] = None,
     ) -> None:
         if name is not None and not _VALID_NAME_RE.fullmatch(name):
@@ -80,6 +81,9 @@ class Task:
         self.estimated_flops = estimated_flops
         self.estimated_inputs_gb = estimated_inputs_gb
         self.inputs_region = inputs_region
+        # Bytes this task hands to each dependent (DAG edge weight for
+        # the joint optimizer's inter-task egress term).
+        self.estimated_outputs_gb = estimated_outputs_gb
         # Explicit DAG edges: names of tasks this one waits on. Absent
         # everywhere -> the DAG is an implicit chain (document order).
         self.depends_on: List[str] = [str(d) for d in (depends_on or [])]
@@ -126,7 +130,7 @@ class Task:
             'secrets', 'file_mounts', 'storage_mounts', 'volumes',
             'resources', 'service', 'config', '_policy_applied',
             'estimated_flops', 'estimated_inputs_gb', 'inputs_region',
-            'depends_on',
+            'estimated_outputs_gb', 'depends_on',
         }
         unknown = set(config) - known
         if unknown:
@@ -160,6 +164,7 @@ class Task:
             estimated_flops=config.get('estimated_flops'),
             estimated_inputs_gb=config.get('estimated_inputs_gb'),
             inputs_region=config.get('inputs_region'),
+            estimated_outputs_gb=config.get('estimated_outputs_gb'),
             depends_on=config.get('depends_on'),
         )
         task.config_overrides = dict(config.get('config') or {})
@@ -254,6 +259,8 @@ class Task:
             config['estimated_flops'] = self.estimated_flops
         if self.estimated_inputs_gb is not None:
             config['estimated_inputs_gb'] = self.estimated_inputs_gb
+        if self.estimated_outputs_gb is not None:
+            config['estimated_outputs_gb'] = self.estimated_outputs_gb
         if self.inputs_region is not None:
             config['inputs_region'] = self.inputs_region
         if self.depends_on:

@@ -107,6 +107,17 @@ def allowed_clouds(workspace: Optional[str] = None) -> Optional[List[str]]:
     return list(clouds) if clouds else None
 
 
+def enabled_allowed_clouds(workspace: Optional[str] = None
+                           ) -> Optional[List[str]]:
+    """Enabled clouds filtered by the workspace allowlist, or None =
+    every enabled cloud (the optimizer's enabled_clouds contract)."""
+    allowed = allowed_clouds(workspace)
+    if allowed is None:
+        return None
+    from skypilot_tpu import check
+    return [c for c in check.get_enabled_clouds() if c in allowed]
+
+
 def validate_cloud(cloud: Optional[str],
                    workspace: Optional[str] = None) -> None:
     """Reject an explicit cloud choice the workspace does not allow."""

@@ -180,3 +180,121 @@ def test_planning_mfu_per_generation():
     assert planning_mfu('unknown-gen') == PLANNING_MFU
     assert set(PLANNING_MFU_BY_GENERATION) >= {'v4', 'v5e', 'v5p',
                                                'v6e'}
+
+
+# -- joint DAG planning (parity: sky/optimizer.py:429 DP / :490 ILP) -------
+
+
+def _chain_dag(outputs_gb=100.0):
+    """task a pinned to us-west4; b unpinned. Per-task greedy breaks the
+    all-regions-same-price tie by region NAME (asia-southeast1), paying
+    cross-region egress on the a->b edge; joint planning co-locates."""
+    with Dag('jd') as dag:
+        dag.add(Task(name='a', run='produce',
+                     estimated_outputs_gb=outputs_gb,
+                     resources=Resources(cloud='fake', region='us-west4',
+                                         accelerators='tpu-v5e-8')))
+        dag.add(Task(name='b', run='consume', depends_on=['a'],
+                     resources=Resources(cloud='fake',
+                                         accelerators='tpu-v5e-8')))
+    return dag
+
+
+def test_joint_dag_beats_greedy_on_egress():
+    dag = _chain_dag(outputs_gb=100.0)
+    plan = Optimizer.plan_dag(dag, enabled_clouds=CLOUDS)
+    # Greedy would put b in asia-southeast1 (tie-break) and pay
+    # 100 GB x $0.08 = $8 egress; joint co-locates b with a.
+    assert plan.choices['b'].resources.region == 'us-west4'
+    assert plan.total_cost < plan.greedy_cost
+    assert plan.greedy_cost - plan.total_cost == pytest.approx(8.0)
+    assert plan.method == 'tree-dp'
+    table = plan.table()
+    assert 'us-west4' in table and 'greedy' in table
+
+
+def test_joint_optimize_sets_best_resources():
+    dag = _chain_dag()
+    Optimizer.optimize(dag, enabled_clouds=CLOUDS, quiet=False)
+    regions = {t.name: t.best_resources.region for t in dag.tasks}
+    assert regions == {'a': 'us-west4', 'b': 'us-west4'}
+
+
+def test_joint_no_hints_keeps_greedy():
+    """Without outputs hints the per-task greedy path is untouched."""
+    with Dag('ng') as dag:
+        dag.add(Task(name='a', run='x',
+                     resources=Resources(cloud='fake', region='us-west4',
+                                         accelerators='tpu-v5e-8')))
+        dag.add(Task(name='b', run='y', depends_on=['a'],
+                     resources=Resources(cloud='fake',
+                                         accelerators='tpu-v5e-8')))
+    Optimizer.optimize(dag, enabled_clouds=CLOUDS)
+    assert dag.tasks[1].best_resources.region == 'asia-southeast1'
+
+
+def test_joint_implicit_chain_uses_document_order():
+    """Implicit chains (no depends_on) are planned jointly too — the
+    chain executor runs them sequentially, so data flows forward."""
+    with Dag('ic') as dag:
+        dag.add(Task(name='a', run='produce', estimated_outputs_gb=50.0,
+                     resources=Resources(cloud='fake', region='us-east5',
+                                         accelerators='tpu-v5e-8')))
+        dag.add(Task(name='b', run='consume',
+                     resources=Resources(cloud='fake',
+                                         accelerators='tpu-v5e-8')))
+    Optimizer.optimize(dag, enabled_clouds=CLOUDS)
+    assert dag.tasks[1].best_resources.region == 'us-east5'
+
+
+def test_joint_fanout_colocates_children():
+    """Fan-out tree (exact DP): both children follow the parent."""
+    with Dag('fo') as dag:
+        dag.add(Task(name='root', run='produce',
+                     estimated_outputs_gb=200.0,
+                     resources=Resources(cloud='fake', region='us-east1',
+                                         accelerators='tpu-v5e-8')))
+        for child in ('c1', 'c2'):
+            dag.add(Task(name=child, run='consume',
+                         depends_on=['root'],
+                         resources=Resources(cloud='fake',
+                                             accelerators='tpu-v5e-8')))
+    plan = Optimizer.plan_dag(dag, enabled_clouds=CLOUDS)
+    assert plan.method == 'tree-dp'
+    assert plan.choices['c1'].resources.region == 'us-east1'
+    assert plan.choices['c2'].resources.region == 'us-east1'
+
+
+def test_joint_fanin_local_search_colocates():
+    """Fan-in (diamond): multiple parents force the local-search path;
+    it must still co-locate the join with its heavy parents."""
+    with Dag('fi') as dag:
+        dag.add(Task(name='p1', run='x', estimated_outputs_gb=100.0,
+                     resources=Resources(cloud='fake', region='us-west4',
+                                         accelerators='tpu-v5e-8')))
+        dag.add(Task(name='p2', run='y', estimated_outputs_gb=100.0,
+                     resources=Resources(cloud='fake', region='us-west4',
+                                         accelerators='tpu-v5e-8')))
+        dag.add(Task(name='join', run='z', depends_on=['p1', 'p2'],
+                     resources=Resources(cloud='fake',
+                                         accelerators='tpu-v5e-8')))
+    plan = Optimizer.plan_dag(dag, enabled_clouds=CLOUDS)
+    assert plan.method == 'local-search'
+    assert plan.choices['join'].resources.region == 'us-west4'
+    assert plan.total_cost <= plan.greedy_cost
+
+
+def test_joint_respects_runtime_estimates():
+    """A task with a FLOPs hint contributes its end-to-end $ (runtime x
+    rent) to the joint plan, not the 1-hour default."""
+    dag = _chain_dag(outputs_gb=100.0)
+    dag.tasks[1].estimated_flops = 1e18
+    plan = Optimizer.plan_dag(dag, enabled_clouds=CLOUDS)
+    b = plan.choices['b']
+    assert b.estimated_hours is not None
+    # total = a's 1h rent + b's estimated runtime $ + zero egress
+    # (co-located).
+    expected = (plan.choices['a'].hourly_cost * 1.0 +
+                b.hourly_cost * b.estimated_hours)
+    assert plan.total_cost == pytest.approx(expected, rel=1e-6)
+    assert plan.choices['b'].resources.region == 'us-west4'
