@@ -546,7 +546,7 @@ def _upload_workdir(task_config: Dict[str, Any]) -> Dict[str, Any]:
         spool.seek(0)
         for chunk in iter(lambda: spool.read(1 << 20), b''):
             hasher.update(chunk)
-        digest = hasher.hexdigest()[:16]
+        digest = hasher.hexdigest()
         probe = requests_lib.get(f'{url}/upload/{digest}', timeout=10,
                                  headers=_auth_headers())
         if probe.status_code == 200 and probe.json().get('exists'):
@@ -557,6 +557,17 @@ def _upload_workdir(task_config: Dict[str, Any]) -> Dict[str, Any]:
         resp = requests_lib.post(
             f'{url}/upload', data=spool, timeout=600,
             headers={**_auth_headers(), 'X-Skyt-Digest': digest})
+        if (resp.status_code == 400 and
+                'digest mismatch' in resp.text):
+            # Pre-full-sha256 server: it hashes to the legacy 16-char
+            # truncation and rejects our full-length claim. Retry once
+            # with the short form it expects (forward compat for the
+            # client-upgrades-first skew).
+            spool.seek(0)
+            resp = requests_lib.post(
+                f'{url}/upload', data=spool, timeout=600,
+                headers={**_auth_headers(),
+                         'X-Skyt-Digest': digest[:16]})
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
             f'workdir upload failed: {resp.text}')

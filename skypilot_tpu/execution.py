@@ -82,7 +82,9 @@ def launch(task_or_dag: Union[Task, Dag],
     if (len(dag.tasks) > 1 and (stages is ALL_STAGES or
                                 Stage.OPTIMIZE in stages) and
             any(t.estimated_outputs_gb for t in dag.tasks) and
-            all(t.best_resources is None for t in dag.tasks)):
+            all(t.best_resources is None for t in dag.tasks) and
+            (dag.has_explicit_edges() or
+             dag.execution == DagExecution.WAIT_SUCCESS)):
         Optimizer.optimize(dag,
                            enabled_clouds=workspaces.enabled_allowed_clouds(),
                            quiet=False)

@@ -277,9 +277,15 @@ class Optimizer:
                  quiet: bool = True,
                  minimize: str = 'cost') -> Dag:
         dag.validate()
+        from skypilot_tpu.spec.dag import DagExecution
         if (minimize == 'cost' and len(dag.tasks) > 1 and
                 any(t.estimated_outputs_gb for t in dag.tasks) and
-                all(t.name for t in dag.tasks)):
+                all(t.name for t in dag.tasks) and
+                # PARALLEL tasks are independent — document order is
+                # NOT a data-flow chain; charging phantom egress there
+                # would co-locate for no reason.
+                (dag.has_explicit_edges() or
+                 dag.execution == DagExecution.WAIT_SUCCESS)):
             plan = Optimizer.plan_dag(dag, enabled_clouds)
             for task in dag.tasks:
                 task.best_resources = plan.choices[task.name].resources

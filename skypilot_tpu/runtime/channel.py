@@ -239,10 +239,16 @@ def _spawn(info) -> Optional[ChannelClient]:
     from skypilot_tpu.utils.command_runner import runners_for_cluster
     head = runners_for_cluster(info)[0]
     if runtime_setup.is_local_style(info):
-        runtime_dir = runtime_setup.head_runtime_dir(info)
+        import shlex
         import sys
-        cmd = (f'{sys.executable} -m skypilot_tpu.runtime.channel_server '
-               f'--runtime-dir {runtime_dir}')
+        # Quoted: a state dir with spaces/metacharacters would
+        # otherwise start the server against the wrong path, and the
+        # failure is silent (job_table_for just falls back to the
+        # shim, losing the push path).
+        runtime_dir = runtime_setup.head_runtime_dir(info)
+        cmd = (f'{shlex.quote(sys.executable)} '
+               f'-m skypilot_tpu.runtime.channel_server '
+               f'--runtime-dir {shlex.quote(runtime_dir)}')
     else:
         cmd = (f'PYTHONPATH={REMOTE_PKG_DIR}:$PYTHONPATH '
                f'python3 -m skypilot_tpu.runtime.channel_server '

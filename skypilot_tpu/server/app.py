@@ -555,7 +555,10 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                     hasher.update(chunk)
                     out.write(chunk)
                     remaining -= len(chunk)
-            digest = hasher.hexdigest()[:16]
+            # Full-length digest (ADVICE r4: a 64-bit truncation makes
+            # birthday collisions plausible at scale and lets tenants
+            # probe for each other's content existence).
+            digest = hasher.hexdigest()
             claimed = self.headers.get('X-Skyt-Digest')
             if claimed and claimed != digest:
                 self._error(HTTPStatus.BAD_REQUEST,
@@ -585,10 +588,11 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
     def _handle_upload_probe(self, digest: str) -> None:
         """GET /upload/<digest>: lets a client skip re-sending a workdir
         the server already holds (resume-by-digest). The digest must be
-        exactly the 16-hex-char form _handle_upload mints — anything
+        exactly the full-sha256 hex form _handle_upload mints (legacy
+        16-char dirs from older servers still probe true) — anything
         else ('..', separators) would escape the uploads dir."""
         import re
-        if not re.fullmatch(r'[0-9a-f]{16}', digest):
+        if not re.fullmatch(r'[0-9a-f]{16}([0-9a-f]{48})?', digest):
             self._reply({'exists': False, 'path': None})
             return
         dest = os.path.join(_uploads_dir(), digest)
@@ -671,6 +675,14 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                     return
                 self._reply_text(dashboard.cluster_job_log(
                     self._query.get('name', ''), job_id))
+            elif route == '/api/dashboard/tail':
+                with _StreamSlot() as got:
+                    if not got:
+                        self._error(HTTPStatus.SERVICE_UNAVAILABLE,
+                                    f'stream limit ({MAX_STREAMS}) '
+                                    'reached; retry shortly')
+                        return
+                    self._handle_sse_tail()
             elif route == '/api/dashboard/service':
                 from skypilot_tpu.server import dashboard
                 self._reply(dashboard.service_detail(
@@ -755,6 +767,66 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 self._reply(request.to_dict())
                 return
             time.sleep(0.05)
+
+    def _handle_sse_tail(self) -> None:
+        """Server-Sent-Events live tail of a cluster job's rank-0 log
+        (the dashboard's in-page follow — EventSource, not snapshot
+        polling). Chunks arrive as they are written on the cluster,
+        relayed over the runtime channel's follow-tail; a `done` event
+        tells the client to close (EventSource auto-reconnects
+        otherwise)."""
+        query = self._query
+        name = query.get('name', '')
+        try:
+            job_id = int(query.get('job_id', '0'))
+        except ValueError:
+            self._error(HTTPStatus.BAD_REQUEST, 'job_id must be int')
+            return
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/event-stream')
+        self.send_header('Cache-Control', 'no-cache')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+
+        def send_chunk(data: bytes) -> None:
+            self.wfile.write(f'{len(data):x}\r\n'.encode())
+            self.wfile.write(data + b'\r\n')
+            self.wfile.flush()
+
+        def event(text: str, kind: str = 'message') -> None:
+            prefix = b'' if kind == 'message' else \
+                f'event: {kind}\n'.encode()
+            send_chunk(prefix + b'data: ' +
+                       json.dumps(text).encode() + b'\n\n')
+
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster(name)
+        if record is None:
+            event(f'(no cluster {name!r})')
+        else:
+            from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+            from skypilot_tpu.provision.api import ClusterInfo
+
+            class _SseWriter:
+                @staticmethod
+                def write(text: str) -> int:
+                    event(text)
+                    return len(text)
+
+                @staticmethod
+                def flush() -> None:
+                    pass
+
+            try:
+                TpuPodBackend().tail_logs(
+                    ClusterInfo.from_dict(record.handle), job_id,
+                    stream=_SseWriter(), follow=True)
+            except (BrokenPipeError, ConnectionResetError):
+                return      # viewer closed the panel
+            except Exception as e:  # pylint: disable=broad-except
+                event(f'(tail error: {e})')
+        event('', kind='done')
+        send_chunk(b'')
 
     def _handle_stream(self, user=None) -> None:
         """Chunked tail of a request's log until it finishes.

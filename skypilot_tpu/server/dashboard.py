@@ -305,7 +305,7 @@ DASHBOARD_HTML = """<!doctype html>
   <div id="content"></div>
 </main>
 <div id="panel">
-  <a href="#" onclick="return hidePanel()" style="float:right">close</a>
+  <a href="#" data-act="hide" style="float:right">close</a>
   <h2 id="panel-title"></h2>
   <div id="panel-body"></div>
 </div>
@@ -315,7 +315,11 @@ DASHBOARD_HTML = """<!doctype html>
 // Clusters (+detail), jobs queue/logs -> Jobs, serve status/logs ->
 // Serve, check -> Infra, show-tpus -> Catalog, cost-report -> Cost,
 // recipes list/show -> Recipes, api status/get/logs -> Requests,
-// users/workspaces/volumes -> their pages.
+// users/workspaces/volumes -> their pages. Write verbs (stop/down/
+// cancel/serve down) POST to the same payload routes the CLI uses —
+// RBAC is enforced server-side per workspace. All interactivity rides
+// data-* attributes + ONE delegated listener: nothing user-named is
+// ever interpolated into a JS-string context (XSS surface).
 const PAGES = [
   ['clusters',   'Clusters'],
   ['jobs',       'Managed jobs'],
@@ -330,10 +334,30 @@ const PAGES = [
 ];
 let DATA = null;          // /api/dashboard/data snapshot (for counts)
 let logTimer = null;      // live-tail poller for the open log panel
+let logSource = null;     // EventSource of the open SSE tail panel
+// Client-side history for the serve sparklines: service -> ready-count
+// samples (one per data tick).
+const SPARK = {};
 
 function esc(v) {
   return String(v).replace(/[&<>"']/g, c => ({
     '&':'&amp;', '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
+}
+function sparkline(values, width=90, height=18) {
+  if (!values || values.length < 2) return '';
+  const max = Math.max(...values, 1);
+  const pts = values.map((v, i) =>
+    `${(i / (values.length - 1) * width).toFixed(1)},` +
+    `${(height - 2 - v / max * (height - 4)).toFixed(1)}`).join(' ');
+  return `<svg width="${width}" height="${height}"><polyline ` +
+    `points="${pts}" fill="none" stroke="currentColor" ` +
+    `stroke-width="1.5"/></svg>`;
+}
+function actBtn(label, verb, body) {
+  // Safe contexts only: the JSON body lands in an HTML attribute
+  // (esc), never in JS source.
+  return `<button data-act="action" data-verb="${esc(verb)}" ` +
+         `data-body="${esc(JSON.stringify(body))}">${esc(label)}</button>`;
 }
 function fmtAge(s) {
   if (s == null) return '';
@@ -373,6 +397,27 @@ async function getText(url) {
   return await r.text();
 }
 
+// -- write actions -----------------------------------------------------
+async function dashAction(verb, body, el) {
+  if (!confirm(verb + ' ' + Object.values(body).join(' ') + '?'))
+    return;
+  if (el) el.disabled = true;
+  try {
+    const r = await fetch('/' + verb, {method: 'POST',
+      headers: {...(window.SKYT_TOKEN ?
+        {Authorization: 'Bearer ' + window.SKYT_TOKEN} : {}),
+        'Content-Type': 'application/json'},
+      body: JSON.stringify(body)});
+    const j = await r.json();
+    if (!r.ok) { alert('refused: ' + (j.error || r.status)); return; }
+    if (j.request_id)   // wait briefly so the refresh shows the result
+      await getJSON('/api/get?request_id=' + j.request_id +
+                    '&timeout=20').catch(() => {});
+  } catch (e) { alert('action failed: ' + e); }
+  finally { if (el) el.disabled = false; }
+  tick();
+}
+
 // -- panels ------------------------------------------------------------
 function showPanel(title, html) {
   document.getElementById('panel-title').textContent = title;
@@ -383,6 +428,33 @@ function showPanel(title, html) {
 function hidePanel() {
   document.getElementById('panel').style.display = 'none';
   if (logTimer) { clearInterval(logTimer); logTimer = null; }
+  if (logSource) { logSource.close(); logSource = null; }
+  return false;
+}
+function showStream(title, sseUrl, fallbackUrl) {
+  // SSE live tail (EventSource carries the session cookie). Token-auth
+  // clients can't set headers on EventSource -> poll fallback.
+  if (window.SKYT_TOKEN) return showLog(title, fallbackUrl);
+  if (logSource) { logSource.close(); logSource = null; }
+  showPanel(title, '<div id="logbox" class="muted">streaming…</div>');
+  const box = () => document.getElementById('logbox');
+  logSource = new EventSource(sseUrl);
+  logSource.onmessage = ev => {
+    const b = box();
+    if (!b) return;
+    const stick = b.scrollTop + b.clientHeight >= b.scrollHeight - 8;
+    b.classList.remove('muted');
+    b.textContent += JSON.parse(ev.data);
+    if (stick) b.scrollTop = b.scrollHeight;
+  };
+  logSource.addEventListener('done', () => {
+    if (logSource) { logSource.close(); logSource = null; }
+    const b = box();
+    if (b) b.textContent += '\\n(stream ended)';
+  });
+  logSource.onerror = () => {
+    if (logSource) { logSource.close(); logSource = null; }
+  };
   return false;
 }
 function showLog(title, url) {
@@ -418,9 +490,8 @@ async function showCluster(name) {
   html += '<h2>Job queue</h2>' + table(d.queue, [
     {key:'job_id', label:'id'}, {key:'name'}, {key:'status'},
     {key:'log', label:'log', raw:true, fmt: r =>
-      `<a href="#" onclick="return showLog('job ${Number(r.job_id)||0} log',` +
-      `'/api/dashboard/cluster-job-log?name=${encodeURIComponent(name)}` +
-      `&job_id=${Number(r.job_id)||0}')">view</a>`},
+      `<a href="#" data-act="clusterjoblog" data-name="${esc(name)}" ` +
+      `data-job="${Number(r.job_id)||0}">view</a>`},
   ]);
   if (d.queue_error) html += `<div class="muted">${esc(d.queue_error)}</div>`;
   html += '<h2>Hosts</h2>' + table(d.hosts, [
@@ -487,21 +558,33 @@ const RENDERERS = {
     {key:'resources'}, {key:'nodes'}, {key:'workspace'},
     {key:'hourly_cost', label:'$/h'},
     {key:'age', fmt: r => fmtAge(r.age_s)},
-  ], r => `class="click" onclick="showCluster('${esc(r.name)}')"`),
+    {key:'actions', raw:true, fmt: r =>
+      actBtn('stop', 'stop', {cluster_name: r.name}) + ' ' +
+      actBtn('down', 'down', {cluster_name: r.name})},
+  ], r => `class="click" data-act="cluster" data-name="${esc(r.name)}"`),
   jobs: d => table(d.jobs, [
     {key:'job_id', label:'id'}, {key:'name'}, {key:'status'},
     {key:'cluster_name', label:'cluster'},
     {key:'recoveries'},
     {key:'logs', raw:true, fmt: r =>
-      `<a href="#" onclick="return showJobLog(${Number(r.job_id)||0})">view</a>`},
+      `<a href="#" data-act="joblog" data-job="${Number(r.job_id)||0}">view</a>`},
+    {key:'actions', raw:true, fmt: r =>
+      ['SUCCEEDED','FAILED','FAILED_SETUP','FAILED_NO_RESOURCE',
+       'FAILED_CONTROLLER','CANCELLED'].includes(r.status) ? '' :
+      actBtn('cancel', 'jobs/cancel', {job_id: r.job_id})},
   ]),
   serve: d =>
     '<h2>Services</h2>' + table(d.services, [
       {key:'name'}, {key:'status'}, {key:'replicas'},
-    ], r => `class="click" onclick="showService('${esc(r.name)}')"`) +
+      {key:'trend', raw:true, fmt: r => sparkline(SPARK[r.name])},
+      {key:'actions', raw:true, fmt: r =>
+        actBtn('down', 'serve/down', {service_name: r.name})},
+    ], r => `class="click" data-act="service" data-name="${esc(r.name)}"`) +
     '<h2>Pools</h2>' + table(d.pools, [
       {key:'name'}, {key:'status'}, {key:'replicas'},
-    ], r => `class="click" onclick="showService('${esc(r.name)}')"`),
+      {key:'actions', raw:true, fmt: r =>
+        actBtn('down', 'jobs/pool/down', {pool_name: r.name})},
+    ], r => `class="click" data-act="service" data-name="${esc(r.name)}"`),
   infra: d => table(d.infra, [
     {key:'cloud'}, {key:'status'}, {key:'detail'}, {key:'limits'}]),
   volumes: d => table(d.volumes, [
@@ -518,7 +601,10 @@ const RENDERERS = {
     {key:'short_id', label:'id'}, {key:'name'}, {key:'status'},
     {key:'user'}, {key:'workspace'},
     {key:'detail', raw:true, fmt: r =>
-      `<a href="#" onclick="return showRequest('${esc(r.request_id)}')">open</a>`},
+      `<a href="#" data-act="request" data-name="${esc(r.request_id)}">open</a>`},
+    {key:'actions', raw:true, fmt: r =>
+      ['PENDING','RUNNING'].includes(r.status) ?
+      actBtn('cancel', 'api/cancel', {request_id: r.request_id}) : ''},
   ]),
 };
 const PAGE_FETCHERS = {   // pages with their own endpoint
@@ -529,7 +615,7 @@ const PAGE_FETCHERS = {   // pages with their own endpoint
     {key:'accumulated_cost', label:'accumulated $'}]),
   recipes: async () => table(await getJSON('/api/dashboard/recipes'), [
     {key:'name'}, {key:'description'},
-  ], r => `class="click" onclick="showRecipe('${esc(r.name)}')"`),
+  ], r => `class="click" data-act="recipe" data-name="${esc(r.name)}"`),
 };
 
 function currentPage() {
@@ -575,9 +661,42 @@ async function tick() {
     document.getElementById('updated').textContent = 'error: ' + e;
   }
 }
+// ONE delegated listener for every interactive element (no inline JS).
+document.addEventListener('click', ev => {
+  const el = ev.target.closest('[data-act]');
+  if (!el) return;
+  // Buttons inside clickable rows must not also open the row panel.
+  ev.preventDefault();
+  ev.stopPropagation();
+  const d = el.dataset;
+  const acts = {
+    hide: () => hidePanel(),
+    cluster: () => showCluster(d.name),
+    service: () => showService(d.name),
+    recipe: () => showRecipe(d.name),
+    request: () => showRequest(d.name),
+    joblog: () => showJobLog(Number(d.job) || 0),
+    clusterjoblog: () => showStream(
+      'job ' + (Number(d.job) || 0) + ' log (live)',
+      '/api/dashboard/tail?name=' + encodeURIComponent(d.name) +
+        '&job_id=' + (Number(d.job) || 0),
+      '/api/dashboard/cluster-job-log?name=' +
+        encodeURIComponent(d.name) + '&job_id=' + (Number(d.job) || 0)),
+    action: () => dashAction(d.verb, JSON.parse(d.body), el),
+  };
+  (acts[d.act] || (() => {}))();
+}, true);
+function sampleSpark() {
+  if (!DATA) return;
+  for (const s of DATA.services) {
+    const ready = Number((s.replicas || '0/').split('/')[0]) || 0;
+    (SPARK[s.name] = SPARK[s.name] || []).push(ready);
+    if (SPARK[s.name].length > 40) SPARK[s.name].shift();
+  }
+}
 window.addEventListener('hashchange', render);
-tick();
-setInterval(tick, 3000);
+tick().then(sampleSpark);
+setInterval(() => tick().then(sampleSpark), 3000);
 </script>
 </body>
 </html>

@@ -230,6 +230,7 @@ class Executor:
             t: [] for t in ScheduleType}
         self._dead_pids: Dict[int, float] = {}  # request pid -> first-seen
         self._pidless: Dict[str, float] = {}    # RUNNING w/o pid -> seen
+        self._term_sent: Dict[str, float] = {}  # cancelled req -> TERM ts
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -361,7 +362,10 @@ class Executor:
         """Kill OUR workers whose request was CANCELLED through another
         replica (that replica only flips the status — the pid is local
         to us). Selected by cancellation time, so a long-running
-        request cancelled late is still seen."""
+        request cancelled late is still seen. SIGTERM first; a worker
+        still alive 10s after the first signal gets SIGKILL — without
+        the escalation, a TERM-masking worker outlives the scan window
+        and runs to completion despite the cancel."""
         for request in requests_db.cancelled_since(now - 300):
             if (request.server_id != self._server_id or
                     not request.pid):
@@ -369,8 +373,16 @@ class Executor:
             try:
                 os.kill(request.pid, 0)
             except (ProcessLookupError, PermissionError):
+                self._term_sent.pop(request.request_id, None)
                 continue
             if not _same_process(request.pid, request.pid_created):
+                continue
+            first = self._term_sent.setdefault(request.request_id, now)
+            if now - first > 10.0:
+                logger.warning('Worker %s of cancelled request %s '
+                               'ignored SIGTERM; escalating to KILL.',
+                               request.pid, request.request_id)
+                kill_process_tree(request.pid, signal.SIGKILL)
                 continue
             logger.info('Killing worker %s of remotely-cancelled '
                         'request %s', request.pid, request.request_id)

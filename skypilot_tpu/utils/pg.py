@@ -4,28 +4,36 @@ Parity frame: the reference's Postgres support rides SQLAlchemy +
 psycopg2 (``sky/global_user_state.py``, ``sky/utils/locks.py:164``);
 neither is in this image, so — same stance as the GCP REST, S3 SigV4
 and Azure SharedKey clients — the wire protocol (v3) is implemented
-directly: startup, cleartext/md5/SCRAM-SHA-256 auth, and the simple
-query flow (Q → RowDescription/DataRow/CommandComplete).
+directly: SSLRequest TLS upgrade (``sslmode`` from the URL, like
+libpq), startup, cleartext/md5/SCRAM-SHA-256 auth, the simple query
+flow (Q → RowDescription/DataRow/CommandComplete) for parameterless
+statements, and the EXTENDED protocol (Parse/Bind/Execute/Sync) for
+everything with parameters — real server-side bind values, no
+client-side literal substitution.
 
 Deliberately small surface, shaped like sqlite3 so state.py can treat
 either backend uniformly:
 
-    conn = PgConnection.from_url('postgres://user:pw@host:5432/db')
+    conn = PgConnection.from_url(
+        'postgres://user:pw@host:5432/db?sslmode=verify-full'
+        '&sslrootcert=/etc/ssl/corp-ca.pem')
     rows = conn.execute('SELECT * FROM t WHERE name=?', ('x',)).fetchall()
 
-The simple protocol carries no bind parameters, so ``?`` placeholders
-are substituted client-side with fully quoted literals (``_quote``).
-Results come back as dicts keyed by column name; scalar values are
-text (ints/floats coerced on read by callers' json/float use — the
-state layer stores JSON strings and numbers only).
+``sslmode``: ``disable`` (default — matches the plaintext-only history
+of this client), ``require`` (TLS, no cert validation — libpq's
+require), ``verify-ca`` (validate chain), ``verify-full`` (chain +
+hostname). Cloud-managed Postgres (the realistic HA deployment)
+should use ``verify-full`` with the provider CA in ``sslrootcert``.
 """
 from __future__ import annotations
 
 import base64
 import hashlib
 import hmac
+import math
 import os
 import socket
+import ssl
 import struct
 import urllib.parse
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -40,40 +48,59 @@ class PgError(Exception):
         super().__init__(fields.get('M', 'postgres error'))
 
 
-def _quote(value: Any) -> str:
-    """A Python value as a safe SQL literal (simple-protocol client-side
-    parameter substitution)."""
-    if value is None:
-        return 'NULL'
-    if isinstance(value, bool):
-        return 'TRUE' if value else 'FALSE'
-    if isinstance(value, (int, float)):
-        return repr(value)
-    text = str(value).replace("'", "''")
-    if '\\' in text:
-        # Standard-conforming strings treat backslash literally, but be
-        # explicit so the literal survives either server setting.
-        text = text.replace('\\', '\\\\')
-        return f" E'{text}'"
-    return f"'{text}'"
-
-
-def substitute(sql: str, params: Sequence[Any]) -> str:
-    """Replace ``?`` placeholders outside string literals."""
-    if not params:
-        return sql
+def to_dollar_params(sql: str) -> str:
+    """``?`` placeholders → ``$1..$n`` (extended-protocol numbering),
+    skipping string literals and ``--`` line comments."""
     out: List[str] = []
-    it = iter(params)
+    n = 0
+    i = 0
     in_string = False
-    for ch in sql:
-        if ch == "'":
-            in_string = not in_string
+    while i < len(sql):
+        ch = sql[i]
+        if in_string:
             out.append(ch)
-        elif ch == '?' and not in_string:
-            out.append(_quote(next(it)))
+            if ch == "'":
+                in_string = False
+            i += 1
+            continue
+        if ch == "'":
+            in_string = True
+            out.append(ch)
+        elif ch == '-' and sql[i:i + 2] == '--':
+            end = sql.find('\n', i)
+            end = len(sql) if end < 0 else end
+            out.append(sql[i:end])
+            i = end
+            continue
+        elif ch == '?':
+            n += 1
+            out.append(f'${n}')
         else:
             out.append(ch)
+        i += 1
     return ''.join(out)
+
+
+# Parameter type OIDs declared at Parse time (explicit types keep the
+# server from mis-inferring and give the fake server coercion info).
+_PARAM_OID = {bool: 16, int: 20, float: 701, str: 25}
+
+
+def _encode_param(value: Any) -> Tuple[int, Optional[bytes]]:
+    """(type oid, text-format bytes or None for NULL)."""
+    if value is None:
+        return 0, None
+    if isinstance(value, bool):
+        return 16, b't' if value else b'f'
+    if isinstance(value, float):
+        if not math.isfinite(value):
+            raise ValueError(
+                f'non-finite float {value!r} has no SQL literal; '
+                'store NULL explicitly instead')
+        return 701, repr(value).encode()
+    if isinstance(value, int):
+        return 20, str(value).encode()
+    return 25, str(value).encode('utf-8')
 
 
 # Common type OIDs -> Python coercion (simple protocol is text-only).
@@ -126,16 +153,26 @@ class _Result:
         return iter(self._rows)
 
 
+_SSL_REQUEST_CODE = 80877103
+
+
 class PgConnection:
     def __init__(self, host: str, port: int, user: str,
                  password: str, database: str,
-                 connect_timeout: float = 10.0) -> None:
+                 connect_timeout: float = 10.0,
+                 sslmode: str = 'disable',
+                 sslrootcert: Optional[str] = None) -> None:
+        if sslmode not in ('disable', 'require', 'verify-ca',
+                           'verify-full'):
+            raise ValueError(f'unsupported sslmode {sslmode!r}')
         self.user = user
         self.password = password
         self._sock = socket.create_connection((host, port),
                                               timeout=connect_timeout)
         self._sock.settimeout(30.0)
         self._buf = b''
+        if sslmode != 'disable':
+            self._tls_upgrade(host, sslmode, sslrootcert)
         self._startup(database)
 
     @classmethod
@@ -143,12 +180,39 @@ class PgConnection:
         parsed = urllib.parse.urlparse(url)
         if parsed.scheme not in ('postgres', 'postgresql'):
             raise ValueError(f'not a postgres url: {url!r}')
+        query = urllib.parse.parse_qs(parsed.query)
         return cls(host=parsed.hostname or 'localhost',
                    port=parsed.port or 5432,
                    user=urllib.parse.unquote(parsed.username or 'postgres'),
                    password=urllib.parse.unquote(parsed.password or ''),
                    database=(parsed.path or '/postgres').lstrip('/')
-                   or 'postgres')
+                   or 'postgres',
+                   sslmode=query.get('sslmode', ['disable'])[0],
+                   sslrootcert=query.get('sslrootcert', [None])[0])
+
+    # -- TLS -----------------------------------------------------------
+
+    def _tls_upgrade(self, host: str, sslmode: str,
+                     sslrootcert: Optional[str]) -> None:
+        """SSLRequest then wrap (the protocol's STARTTLS: the 8-byte
+        request goes out in clear, the server answers one byte)."""
+        self._sock.sendall(struct.pack('>II', 8, _SSL_REQUEST_CODE))
+        answer = self._sock.recv(1)
+        if answer != b'S':
+            raise PgError({'M': f'server refused TLS (sslmode={sslmode}'
+                                f', got {answer!r})'})
+        if sslmode == 'require':
+            context = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            context.check_hostname = False
+            context.verify_mode = ssl.CERT_NONE
+        else:
+            context = ssl.create_default_context(cafile=sslrootcert)
+            context.check_hostname = (sslmode == 'verify-full')
+        try:
+            self._sock = context.wrap_socket(self._sock,
+                                             server_hostname=host)
+        except ssl.SSLError as e:
+            raise PgError({'M': f'TLS handshake failed: {e}'}) from e
 
     # -- framing -------------------------------------------------------
 
@@ -263,7 +327,38 @@ class PgConnection:
 
     def execute(self, sql: str,
                 params: Sequence[Any] = ()) -> _Result:
-        self._send(b'Q', substitute(sql, params).encode() + b'\0')
+        """Parameterless statements ride the simple protocol (BEGIN,
+        DDL, advisory locks); anything with parameters rides the
+        extended protocol — values travel as bind parameters, never as
+        spliced literals."""
+        if params:
+            self._send_extended(sql, params)
+        else:
+            self._send(b'Q', sql.encode() + b'\0')
+        return self._collect()
+
+    def _send_extended(self, sql: str, params: Sequence[Any]) -> None:
+        encoded = [_encode_param(v) for v in params]
+        query = to_dollar_params(sql).encode()
+        parse = (b'\0' + query + b'\0' +
+                 struct.pack('>H', len(encoded)) +
+                 b''.join(struct.pack('>I', oid) for oid, _ in encoded))
+        bind = bytearray(b'\0\0')             # unnamed portal + stmt
+        bind += struct.pack('>H', 0)          # all params text format
+        bind += struct.pack('>H', len(encoded))
+        for _, value in encoded:
+            if value is None:
+                bind += struct.pack('>i', -1)
+            else:
+                bind += struct.pack('>i', len(value)) + value
+        bind += struct.pack('>H', 0)          # result columns: text
+        self._send(b'P', parse)
+        self._send(b'B', bytes(bind))
+        self._send(b'D', b'P\0')              # Describe the portal
+        self._send(b'E', b'\0' + struct.pack('>I', 0))
+        self._send(b'S', b'')
+
+    def _collect(self) -> _Result:
         columns: List[str] = []
         oids: List[int] = []
         rows: List[List[Optional[str]]] = []
@@ -286,7 +381,8 @@ class PgConnection:
                 if error is not None:
                     raise error
                 return _Result(columns, oids, rows, rowcount)
-            # N (Notice) / I (EmptyQuery): skip
+            # 1 (ParseComplete) / 2 (BindComplete) / n (NoData) /
+            # s (PortalSuspended) / N (Notice) / I (EmptyQuery): skip
 
     def executescript(self, script: str) -> None:
         for statement in script.split(';'):
@@ -355,6 +451,13 @@ class PgSqliteAdapter:
 
     def __init__(self, conn: 'PgConnection') -> None:
         self._conn = conn
+        # Set when the underlying socket is conclusively gone (server
+        # restart, idle-timeout drop): connect_dual_backend then evicts
+        # this cached connection so the NEXT call reconnects — without
+        # it, one transient DB blip wedges the thread until process
+        # restart. SQL errors do NOT mark death (the connection
+        # resyncs at ReadyForQuery).
+        self.dead = False
 
     @staticmethod
     def _translate(sql: str) -> Optional[str]:
@@ -368,17 +471,29 @@ class PgSqliteAdapter:
                     f"'{table}'")
         if stripped == 'BEGIN IMMEDIATE':
             return 'BEGIN'
-        sql = sql.replace('INTEGER PRIMARY KEY AUTOINCREMENT',
-                          'BIGSERIAL PRIMARY KEY')
-        # sqlite REAL is 8-byte; Postgres REAL is float4, which rounds
-        # epoch timestamps to ~2-minute granularity (DDL-only token).
-        return sql.replace(' REAL', ' DOUBLE PRECISION')
+        head = stripped[:6].upper()
+        if head in ('CREATE', 'ALTER '):
+            sql = sql.replace('INTEGER PRIMARY KEY AUTOINCREMENT',
+                              'BIGSERIAL PRIMARY KEY')
+            # sqlite REAL is 8-byte; Postgres REAL is float4, which
+            # rounds epoch timestamps to ~2-minute granularity. DDL
+            # statements only — a ' REAL' inside DML data must survive.
+            sql = sql.replace(' REAL', ' DOUBLE PRECISION')
+        return sql
 
     def execute(self, sql: str, params: Sequence[Any] = ()) -> _Result:
         translated = self._translate(sql)
         if translated is None:
             return _Result([], [], [])
-        return self._conn.execute(translated, params)
+        try:
+            return self._conn.execute(translated, params)
+        except (ConnectionError, OSError) as e:
+            self.dead = True
+            raise PgError({'M': f'connection lost: {e}'}) from e
+        except PgError as e:
+            if 'closed the connection' in str(e):
+                self.dead = True
+            raise
 
     def executescript(self, script: str) -> None:
         for statement in script.split(';'):
@@ -426,7 +541,8 @@ def connect_dual_backend(local, ready_set, *, url, sqlite_path,
     cache_path = f'{url}#{sqlite_path}' if url else sqlite_path
     conn = getattr(local, 'conn', None)
     if (conn is not None and getattr(local, 'path', None) == cache_path
-            and getattr(local, 'pid', None) == os.getpid()):
+            and getattr(local, 'pid', None) == os.getpid()
+            and not getattr(conn, 'dead', False)):
         return conn
     if url:
         conn = PgSqliteAdapter(PgConnection.from_url(url))

@@ -1,6 +1,8 @@
 """Fake PostgreSQL server for tests: speaks wire protocol v3 with real
-SCRAM-SHA-256 auth and executes received SQL against an in-memory
-sqlite DB (moto-style, like the fake GCP/S3/Azure transports).
+SCRAM-SHA-256 auth, an optional TLS listener (SSLRequest upgrade, like
+real Postgres), the simple AND extended (Parse/Bind/Execute) query
+protocols, and executes received SQL against an in-memory sqlite DB
+(moto-style, like the fake GCP/S3/Azure transports).
 
 The dialect gap is bridged in reverse of state._PgAdapter: BIGSERIAL →
 AUTOINCREMENT, information_schema.columns → PRAGMA table_info, and the
@@ -18,6 +20,7 @@ import re
 import socket
 import socketserver
 import sqlite3
+import ssl
 import struct
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -25,6 +28,13 @@ from typing import Dict, List, Optional, Tuple
 USER = 'skyt'
 PASSWORD = 'secret'
 _ITERATIONS = 4096
+_SSL_REQUEST_CODE = 80877103
+
+CERT_DIR = os.path.join(os.path.dirname(__file__), 'certs')
+SERVER_CERT = os.path.join(CERT_DIR, 'server.pem')
+SERVER_KEY = os.path.join(CERT_DIR, 'server.key')
+CA_CERT = os.path.join(CERT_DIR, 'ca.pem')
+WRONG_CA_CERT = os.path.join(CERT_DIR, 'wrong_ca.pem')
 
 _INFO_SCHEMA_RE = re.compile(
     r"SELECT column_name AS name FROM information_schema\.columns "
@@ -35,11 +45,17 @@ _ADVISORY_RE = re.compile(
 
 
 class FakePgServer:
-    def __init__(self) -> None:
+    def __init__(self, tls: bool = False, port: int = 0) -> None:
+        self._tls_context: Optional[ssl.SSLContext] = None
+        if tls:
+            self._tls_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._tls_context.load_cert_chain(SERVER_CERT, SERVER_KEY)
         self._sqlite = sqlite3.connect(':memory:',
                                        check_same_thread=False)
         self._sqlite.row_factory = sqlite3.Row
         self._sql_lock = threading.Lock()
+        self._clients: set = set()
+        self._clients_lock = threading.Lock()
         self._advisory: Dict[int, object] = {}   # key -> holder conn
         self._advisory_lock = threading.Condition()
         outer = self
@@ -52,7 +68,7 @@ class FakePgServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server(('127.0.0.1', 0), Handler)
+        self._server = Server(('127.0.0.1', port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
@@ -65,6 +81,20 @@ class FakePgServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Sever live client connections too — a real server restart
+        # drops them, and the reconnect tests rely on that.
+        with self._clients_lock:
+            clients = list(self._clients)
+            self._clients.clear()
+        for sock in clients:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # -- framing -------------------------------------------------------
 
@@ -102,33 +132,114 @@ class FakePgServer:
 
     def _serve(self, sock: socket.socket) -> None:
         conn_id = object()
+        with self._clients_lock:
+            self._clients.add(sock)
         try:
-            # startup message (untyped)
+            # First untyped message: SSLRequest or startup.
             (length,) = struct.unpack('>I', self._read_exact(sock, 4))
-            self._read_exact(sock, length - 4)  # params ignored
+            body = self._read_exact(sock, length - 4)
+            if (length == 8 and
+                    struct.unpack('>I', body)[0] == _SSL_REQUEST_CODE):
+                if self._tls_context is None:
+                    sock.sendall(b'N')   # no TLS configured
+                else:
+                    sock.sendall(b'S')
+                    sock = self._tls_context.wrap_socket(
+                        sock, server_side=True)
+                # The real startup follows (over TLS if upgraded).
+                (length,) = struct.unpack('>I',
+                                          self._read_exact(sock, 4))
+                self._read_exact(sock, length - 4)
             if not self._authenticate(sock):
                 return
             self._send(sock, b'R', struct.pack('>I', 0))  # Ok
             self._ready(sock)
+            # Extended-protocol state for the unnamed statement.
+            ext: Dict[str, object] = {}
             while True:
                 mtype, body = self._read_message(sock)
                 if mtype == b'X':
                     return
-                if mtype != b'Q':
+                if mtype == b'Q':
+                    self._query(sock, conn_id,
+                                body.rstrip(b'\0').decode())
+                    self._ready(sock)
+                elif mtype == b'P':
+                    self._parse(sock, body, ext)
+                elif mtype == b'B':
+                    self._bind(sock, body, ext)
+                elif mtype == b'D':
+                    pass                 # description sent at Execute
+                elif mtype == b'E':
+                    self._exec_portal(sock, conn_id, ext)
+                elif mtype == b'S':
+                    self._ready(sock)
+                else:
                     self._send_error(sock, f'unsupported {mtype!r}')
                     self._ready(sock)
-                    continue
-                self._query(sock, conn_id,
-                            body.rstrip(b'\0').decode())
-                self._ready(sock)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, ssl.SSLError):
             pass
         finally:
             self._release_all(conn_id)
+            with self._clients_lock:
+                self._clients.discard(sock)
             try:
                 sock.close()
             except OSError:
                 pass
+
+    # -- extended protocol --------------------------------------------
+
+    def _parse(self, sock, body: bytes, ext: Dict[str, object]) -> None:
+        """Parse: name\\0 query\\0 nparams + oids. Stores the query with
+        $n placeholders mapped back to sqlite ?s."""
+        name_end = body.index(b'\0')
+        query_end = body.index(b'\0', name_end + 1)
+        query = body[name_end + 1:query_end].decode()
+        (nparams,) = struct.unpack('>H',
+                                   body[query_end + 1:query_end + 3])
+        oids = [struct.unpack('>I', body[query_end + 3 + i * 4:
+                                         query_end + 7 + i * 4])[0]
+                for i in range(nparams)]
+        ext['sql'] = re.sub(r'\$\d+', '?', query)
+        ext['oids'] = oids
+        self._send(sock, b'1', b'')      # ParseComplete
+
+    def _bind(self, sock, body: bytes, ext: Dict[str, object]) -> None:
+        """Bind: portal\\0 stmt\\0 fmts + text params; coerced by the
+        OIDs declared at Parse."""
+        offset = body.index(b'\0') + 1
+        offset = body.index(b'\0', offset) + 1
+        (nfmt,) = struct.unpack('>H', body[offset:offset + 2])
+        offset += 2 + nfmt * 2
+        (nparams,) = struct.unpack('>H', body[offset:offset + 2])
+        offset += 2
+        values: List[object] = []
+        oids = list(ext.get('oids') or [])
+        for i in range(nparams):
+            (plen,) = struct.unpack('>i', body[offset:offset + 4])
+            offset += 4
+            if plen < 0:
+                values.append(None)
+                continue
+            text = body[offset:offset + plen].decode('utf-8')
+            offset += plen
+            oid = oids[i] if i < len(oids) else 0
+            if oid in (20, 21, 23):
+                values.append(int(text))
+            elif oid in (700, 701, 1700):
+                values.append(float(text))
+            elif oid == 16:
+                values.append(1 if text == 't' else 0)
+            else:
+                values.append(text)
+        ext['params'] = values
+        self._send(sock, b'2', b'')      # BindComplete
+
+    def _exec_portal(self, sock, conn_id, ext: Dict[str, object]) -> None:
+        sql = str(ext.get('sql') or '')
+        params = list(ext.get('params') or [])
+        self._query(sock, conn_id, sql, params)
 
     def _authenticate(self, sock) -> bool:
         """Server half of SCRAM-SHA-256 — the client's real code path."""
@@ -213,7 +324,8 @@ class FakePgServer:
                 self._send_rows(sock, ['pg_advisory_unlock'], [16],
                                 [['t']])
 
-    def _query(self, sock, conn_id, sql: str) -> None:
+    def _query(self, sock, conn_id, sql: str,
+               params: Optional[List[object]] = None) -> None:
         # Transaction statements are no-ops here: the fake serializes
         # every query under one lock, and its per-statement sqlite
         # commit would fight real BEGIN/COMMIT bookkeeping.
@@ -232,7 +344,7 @@ class FakePgServer:
                           'INTEGER PRIMARY KEY AUTOINCREMENT')
         try:
             with self._sql_lock:
-                cursor = self._sqlite.execute(sql)
+                cursor = self._sqlite.execute(sql, params or [])
                 rows = cursor.fetchall()
                 description = cursor.description
                 rowcount = cursor.rowcount

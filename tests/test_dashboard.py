@@ -204,3 +204,91 @@ def test_dashboard_served_bytes_have_no_raw_newline_in_js_strings():
     # real newline inside the quoted string.
     assert '\\n\\n--- log ---\\n' in html
     assert "'\n" not in html.split('showRequest')[1].split('}')[0]
+
+
+def test_dashboard_has_no_inline_js_event_handlers():
+    """ADVICE r4 medium: names must never land in a JS-string context.
+    All interactivity rides data-* attributes + one delegated listener;
+    inline on* handlers are banned outright."""
+    import re
+    from skypilot_tpu.server import dashboard
+    # HTML-attribute form specifically (JS `x.onerror = fn` property
+    # assignments inside the script are fine).
+    assert not re.search(r'on(click|load|error|mouseover)\s*="',
+                         dashboard.DASHBOARD_HTML)
+    assert 'data-act=' in dashboard.DASHBOARD_HTML
+    assert 'addEventListener' in dashboard.DASHBOARD_HTML
+
+
+def test_dashboard_write_actions_rbac(tmp_home, monkeypatch):
+    """VERDICT r4 #7: write actions POST to the existing verbs with
+    RBAC enforced server-side — a workspace viewer is refused, an
+    editor succeeds."""
+    from skypilot_tpu import config
+    from skypilot_tpu.users import users_db
+    cfg = tmp_home / '.skyt' / 'config.yaml'
+    cfg.parent.mkdir(parents=True, exist_ok=True)
+    cfg.write_text('api_server:\n  auth: true\n'
+                   '  daemons_enabled: false\n')
+    config.reload()
+    requests_db.reset_db_for_tests()
+    srv = ApiServer(port=0)
+    srv.start_background()
+    try:
+        users_db.create_user('viewy')
+        users_db.create_user('edity')
+        users_db.set_workspace_role('default', 'viewy', 'viewer')
+        users_db.set_workspace_role('default', 'edity', 'editor')
+        viewer = users_db.create_token('viewy')
+        editor = users_db.create_token('edity')
+        body = {'cluster_name': 'nope'}
+        refused = requests_lib.post(
+            f'{srv.url}/stop', json=body, timeout=10,
+            headers={'Authorization': f'Bearer {viewer}'})
+        assert refused.status_code == 403
+        assert 'use' in refused.json()['error']
+        allowed = requests_lib.post(
+            f'{srv.url}/stop', json=body, timeout=10,
+            headers={'Authorization': f'Bearer {editor}'})
+        assert allowed.status_code == 200
+        assert allowed.json()['request_id']
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+        config.reload()
+
+
+def test_dashboard_sse_live_tail(server):
+    """The in-page live tail is a real SSE stream (EventSource frames:
+    `data:` chunks then a `done` event), not a snapshot fetch."""
+    task = Task(name='sse', run='echo sse-marker-xyz',
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8'))
+    sdk.get(sdk.launch(task, 'sse-c'), timeout=120)
+    resp = requests_lib.get(
+        f'{server.url}/api/dashboard/tail?name=sse-c&job_id=1',
+        stream=True, timeout=60)
+    assert resp.status_code == 200
+    assert resp.headers['Content-Type'].startswith('text/event-stream')
+    body = ''
+    for chunk in resp.iter_content(chunk_size=None, decode_unicode=True):
+        body += chunk
+        if 'event: done' in body:
+            break
+    assert 'data:' in body
+    assert 'sse-marker-xyz' in body
+    sdk.get(sdk.down('sse-c'), timeout=60)
+
+
+def test_dashboard_action_verbs_are_real_routes():
+    """Every data-verb a dashboard button posts must be an actual
+    payload route (or the /api/cancel control route) — a typo'd verb
+    404s and silently kills the button."""
+    import re
+    from skypilot_tpu.server import dashboard, payloads
+    verbs = set(re.findall(r"actBtn\('[^']+', '([^']+)'",
+                           dashboard.DASHBOARD_HTML))
+    assert verbs, 'no action buttons found'
+    for verb in verbs:
+        assert verb == 'api/cancel' or verb in payloads.PAYLOADS, (
+            f'dashboard button posts to unknown route {verb!r}')

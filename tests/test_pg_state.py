@@ -181,3 +181,128 @@ def test_serve_state_on_postgres(pg_server):
     assert serve_state.get_service('svc') is None
     assert serve_state.list_replicas('svc') == []
     serve_state._local.__dict__.clear()
+
+
+# -- TLS + extended-protocol bind params (VERDICT r4 next-round #6) ---------
+
+
+def test_tls_require_roundtrip(tmp_home, monkeypatch):
+    from tests import fake_pg as fake_pg_mod
+    server = FakePgServer(tls=True)
+    try:
+        url = server.url + '?sslmode=require'
+        conn = pg.PgConnection.from_url(url)
+        conn.execute('CREATE TABLE tt (a TEXT, b INTEGER)')
+        conn.execute('INSERT INTO tt VALUES (?, ?)', ('x', 3))
+        assert conn.execute('SELECT b FROM tt WHERE a = ?',
+                            ('x',)).fetchone() == {'b': 3}
+        conn.close()
+    finally:
+        server.close()
+
+
+def test_tls_verify_full_accepts_right_ca_rejects_wrong(tmp_home):
+    from tests import fake_pg as fake_pg_mod
+    server = FakePgServer(tls=True)
+    try:
+        good = (server.url + '?sslmode=verify-full'
+                f'&sslrootcert={fake_pg_mod.CA_CERT}')
+        conn = pg.PgConnection.from_url(good)
+        assert conn.execute('SELECT 1 AS one').fetchone() == {'one': 1}
+        conn.close()
+        bad = (server.url + '?sslmode=verify-full'
+               f'&sslrootcert={fake_pg_mod.WRONG_CA_CERT}')
+        with pytest.raises(pg.PgError, match='TLS handshake failed'):
+            pg.PgConnection.from_url(bad)
+    finally:
+        server.close()
+
+
+def test_tls_required_but_server_plaintext(tmp_home):
+    server = FakePgServer(tls=False)
+    try:
+        with pytest.raises(pg.PgError, match='refused TLS'):
+            pg.PgConnection.from_url(server.url + '?sslmode=require')
+    finally:
+        server.close()
+
+
+def test_state_works_over_tls(tmp_home, monkeypatch):
+    """The whole dual-backend state layer over a verify-full TLS URL —
+    the realistic cloud-managed-Postgres HA deployment."""
+    from tests import fake_pg as fake_pg_mod
+    server = FakePgServer(tls=True)
+    monkeypatch.setenv(
+        'SKYT_DB_URL',
+        server.url + '?sslmode=verify-full'
+        f'&sslrootcert={fake_pg_mod.CA_CERT}')
+    state._local.__dict__.clear()
+    try:
+        state.add_or_update_cluster('tlsc',
+                                    status=state.ClusterStatus.UP,
+                                    cloud='gcp', region='us-central2')
+        record = state.get_cluster('tlsc')
+        assert record.status == state.ClusterStatus.UP
+        state.remove_cluster('tlsc')
+    finally:
+        state._local.__dict__.clear()
+        server.close()
+
+
+def test_bind_params_resist_injection_and_weird_values(pg_server):
+    """Values travel as extended-protocol bind params, never spliced
+    into SQL: injection-shaped strings are stored verbatim."""
+    conn = pg.PgConnection.from_url(pg_server.url)
+    conn.execute('CREATE TABLE inj (v TEXT)')
+    hostile = "'; DROP TABLE inj; --"
+    conn.execute('INSERT INTO inj VALUES (?)', (hostile,))
+    assert conn.execute('SELECT v FROM inj').fetchone() == {'v': hostile}
+    # Comment scanner: a ? inside a line comment is NOT a placeholder.
+    row = conn.execute('SELECT v FROM inj -- what? really?\n'
+                       'WHERE v = ?', (hostile,)).fetchone()
+    assert row == {'v': hostile}
+    # Non-finite floats are rejected loudly instead of emitting
+    # invalid SQL.
+    with pytest.raises(ValueError, match='non-finite'):
+        conn.execute('INSERT INTO inj VALUES (?)', (float('inf'),))
+    conn.close()
+
+
+def test_dollar_param_translation():
+    assert pg.to_dollar_params('a = ? AND b = ?') == 'a = $1 AND b = $2'
+    assert pg.to_dollar_params("v = '?' AND w = ?") == "v = '?' AND w = $1"
+    assert (pg.to_dollar_params('x = ? -- not this ?\nAND y = ?') ==
+            'x = $1 -- not this ?\nAND y = $2')
+
+
+def test_reconnect_after_db_restart(tmp_home, monkeypatch):
+    """ADVICE r4 medium: a cached per-thread connection must be evicted
+    after the server drops it — a transient Postgres restart must not
+    wedge the thread until process restart."""
+    server = FakePgServer()
+    port = server.port
+    monkeypatch.setenv('SKYT_DB_URL', server.url)
+    state._local.__dict__.clear()
+    try:
+        state.add_or_update_cluster('rc', status=state.ClusterStatus.UP,
+                                    cloud='gcp', region='us-central2')
+        assert state.get_cluster('rc') is not None
+        # The DB restarts (connection drops; data is gone — fake_pg is
+        # in-memory, which is fine: we only care about reconnection).
+        server.close()
+        with pytest.raises(pg.PgError):
+            state.get_cluster('rc')
+        server = FakePgServer(port=port)
+        # The fake's in-memory DB lost the schema with the restart (a
+        # real Postgres keeps it on disk); re-arm schema init so the
+        # reconnect path is what's under test, not DDL durability.
+        state._pg_schema_ready.clear()
+        # Same thread, next call: reconnects instead of failing forever.
+        assert state.get_cluster('rc') is None  # fresh empty DB
+        state.add_or_update_cluster('rc2',
+                                    status=state.ClusterStatus.INIT,
+                                    cloud='gcp', region='us-central2')
+        assert state.get_cluster('rc2') is not None
+    finally:
+        state._local.__dict__.clear()
+        server.close()
