@@ -24,6 +24,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              'dir (config.json + safetensors + '
                              'tokenizer.json)')
     parser.add_argument('--max-new-tokens', type=int, default=128)
+    parser.add_argument('--embeddings', action='store_true',
+                        help='emit L2-normalized text embeddings '
+                             '(engine.embed_text) instead of '
+                             'completions')
     parser.add_argument('--temperature', type=float, default=0.0)
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--input', default=None,
@@ -48,6 +52,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         engine = InferenceEngine(args.model, max_batch=args.max_batch)
     prompts = [r.get('prompt', '') for r in records]
+    if args.embeddings:
+        vectors = engine.embed_text(prompts)
+        with open(out_path, 'w', encoding='utf-8') as f:
+            for record, vec in zip(records, vectors):
+                f.write(json.dumps(
+                    {**record,
+                     'embedding': [round(float(v), 6) for v in vec]})
+                    + '\n')
+        return 0
     completions = engine.generate_text(
         prompts, max_new_tokens=args.max_new_tokens,
         temperature=args.temperature)

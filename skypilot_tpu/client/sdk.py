@@ -549,6 +549,12 @@ def _upload_workdir(task_config: Dict[str, Any]) -> Dict[str, Any]:
         digest = hasher.hexdigest()
         probe = requests_lib.get(f'{url}/upload/{digest}', timeout=10,
                                  headers=_auth_headers())
+        if not (probe.status_code == 200 and probe.json().get('exists')):
+            # Pre-full-sha256 server: it stored (and will re-mint) the
+            # legacy 16-char address — probe that too before paying a
+            # full re-upload of content it already holds.
+            probe = requests_lib.get(f'{url}/upload/{digest[:16]}',
+                                     timeout=10, headers=_auth_headers())
         if probe.status_code == 200 and probe.json().get('exists'):
             task_config = dict(task_config)
             task_config['workdir'] = probe.json()['path']

@@ -12,6 +12,7 @@ orchestration side (replicas/autoscaler/LB) lives in ``serve/``.
 """
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -31,6 +32,22 @@ def _bucket(n: int, minimum: int = 16) -> int:
     while b < n:
         b *= 2
     return b
+
+
+@functools.partial(jax.jit, static_argnames=('cfg',))
+def _embed_pooled(params, tokens, lengths, cfg):
+    """Masked mean-pool of final hidden states, L2-normalized."""
+    hidden = llama.forward(params, tokens, cfg, return_hidden=True)
+    s = tokens.shape[1]
+    mask = (jnp.arange(s)[None, :] < lengths[:, None]).astype(
+        hidden.dtype)
+    summed = jnp.einsum('bsd,bs->bd', hidden, mask,
+                        preferred_element_type=jnp.float32)
+    pooled = summed / jnp.maximum(
+        lengths[:, None].astype(jnp.float32), 1.0)
+    # fp32 normalization: bf16 rsqrt drifts ~2e-3 off unit norm.
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-6)
 
 
 class InferenceEngine:
@@ -148,3 +165,36 @@ class InferenceEngine:
         ids = [self.tokenizer.encode(p) for p in prompts]
         outs = self.generate_ids(ids, max_new_tokens, temperature, seed)
         return [self.tokenizer.decode(o) for o in outs]
+
+    # -- text embeddings (ref: llm/ embeddings + batch-inference
+    # variants) ---------------------------------------------------------
+
+    def embed_text(self, texts: List[str]) -> np.ndarray:
+        """[len(texts), d_model] L2-normalized embeddings: final-layer
+        hidden states (llama.forward(return_hidden=True) — the LM head
+        matmul is skipped entirely), masked mean-pooled over the real
+        tokens of each right-padded prompt. Shape-bucketed like
+        generate, so each (batch, seq) bucket compiles once."""
+        if not texts:
+            return np.zeros((0, self.cfg.d_model), np.float32)
+        if len(texts) > self.max_batch:
+            parts = [self.embed_text(texts[i:i + self.max_batch])
+                     for i in range(0, len(texts), self.max_batch)]
+            return np.concatenate(parts, axis=0)
+        ids = [self.tokenizer.encode(t)[:self.cfg.max_seq_len]
+               for t in texts]
+        b = len(ids)
+        lengths = np.array([max(len(p), 1) for p in ids], np.int32)
+        s = _bucket(int(lengths.max()))
+        batch_b = _bucket(b, minimum=1)
+        tokens = np.full((batch_b, s), self.tokenizer.pad_id, np.int32)
+        for i, p in enumerate(ids):
+            tokens[i, :len(p)] = p
+        pad_lengths = np.concatenate(
+            [lengths, np.ones(batch_b - b, np.int32)])
+        from skypilot_tpu.inference.sharding import mesh_context
+        with self._lock, mesh_context(self._mesh):
+            pooled = _embed_pooled(self.params, jnp.asarray(tokens),
+                                   jnp.asarray(pad_lengths), self.cfg)
+            self.stats['requests'] += b
+        return np.asarray(pooled)[:b]

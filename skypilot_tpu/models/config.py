@@ -181,6 +181,18 @@ DEEPSEEK_MOE_16B = _register(ModelConfig(
     rope_theta=10_000.0, num_experts=64, experts_per_token=6,
     max_seq_len=4096))
 
+# GPT-OSS-20B-class open-weights MoE (public architecture constants:
+# 24 layers, d_model 2880, 32 experts top-4, 64 heads / 8 KV heads of
+# dim 64, o200k vocab). The alternating sliding-window attention of
+# the published model is not modeled — layers here are all
+# full-causal, which is the conservative (strictly more expressive)
+# approximation for serving parity.
+GPT_OSS_20B = _register(ModelConfig(
+    name='gpt-oss-20b', vocab_size=201_088, d_model=2880,
+    n_layers=24, n_heads=64, n_kv_heads=8, head_dim=64, d_ff=2880,
+    rope_theta=150_000.0, num_experts=32, experts_per_token=4,
+    max_seq_len=131_072))
+
 # Small configs for tests / CPU-mesh dryruns / single-chip benches.
 TINY = _register(ModelConfig(
     name='tiny', vocab_size=512, d_model=64, n_layers=2, n_heads=4,

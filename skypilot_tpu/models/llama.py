@@ -408,8 +408,11 @@ def forward(params: Params,
             rules: LogicalAxisRules = DEFAULT_RULES,
             pipeline_stages: int = 1,
             pipeline_microbatches: Optional[int] = None,
-            return_aux: bool = False):
-    """tokens [B, S] int32 -> logits [B, S, vocab] fp32.
+            return_aux: bool = False,
+            return_hidden: bool = False):
+    """tokens [B, S] int32 -> logits [B, S, vocab] fp32 (or, with
+    ``return_hidden``, the final normed hidden states [B, S, d_model] —
+    the text-embeddings path).
 
     ``pipeline_stages > 1`` runs the decoder stack as a microbatched
     GPipe pipeline over the ``stage`` mesh axis (parallel/pipeline.py);
@@ -489,6 +492,11 @@ def forward(params: Params,
         x, per_layer_aux = jax.lax.scan(scan_body, x, params['layers'])
         aux_loss = per_layer_aux.mean()
     x = rms_norm(x, params['final_norm']['scale'], cfg.norm_eps)
+    if return_hidden:
+        # Embeddings path: the final normed hidden states, skipping the
+        # LM-head matmul entirely (it's the largest single matmul and
+        # pure waste when the caller pools representations).
+        return x
     if cfg.tie_embeddings:
         head = params['embed']['embedding'].astype(dt).T
     else:

@@ -144,8 +144,15 @@ class FakePgServer:
                     sock.sendall(b'N')   # no TLS configured
                 else:
                     sock.sendall(b'S')
+                    raw = sock
                     sock = self._tls_context.wrap_socket(
                         sock, server_side=True)
+                    # wrap_socket detached the raw socket: close() must
+                    # sever the WRAPPED one or TLS clients never see
+                    # the restart.
+                    with self._clients_lock:
+                        self._clients.discard(raw)
+                        self._clients.add(sock)
                 # The real startup follows (over TLS if upgraded).
                 (length,) = struct.unpack('>I',
                                           self._read_exact(sock, 4))

@@ -113,3 +113,21 @@ def test_inference_server_metrics_endpoint(engine, tmp_home):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_embed_text_pooling_and_shapes():
+    """Text embeddings (engine.embed_text): L2-normalized [N, d_model]
+    vectors from masked mean-pooled final hidden states; identical
+    texts embed identically, different lengths batch together."""
+    import numpy as np
+    from skypilot_tpu.inference.engine import InferenceEngine
+    engine = InferenceEngine('tiny', max_batch=4)
+    texts = ['hello world', 'a much longer sentence about tpus',
+             'hello world']
+    vecs = engine.embed_text(texts)
+    assert vecs.shape == (3, engine.cfg.d_model)
+    norms = np.linalg.norm(vecs, axis=-1)
+    assert np.allclose(norms, 1.0, atol=1e-3)
+    assert np.allclose(vecs[0], vecs[2], atol=1e-5)   # deterministic
+    assert not np.allclose(vecs[0], vecs[1], atol=1e-2)
+    assert engine.embed_text([]).shape == (0, engine.cfg.d_model)
