@@ -151,6 +151,20 @@ def _azure_gpu_offerings(name: str,
         azure_data.GPU_REGIONS.get(name, {}), region_filter, zone_filter)
 
 
+def _oci_gpu_offerings(name: str,
+                       count: int,
+                       region_filter: Optional[str] = None,
+                       zone_filter: Optional[str] = None
+                       ) -> List[AcceleratorOffering]:
+    from skypilot_tpu.catalog import oci_data
+    picked = oci_data.instance_type_for(name, count)
+    if picked is None:
+        return []
+    return _fixed_shape_gpu_offerings(
+        'oci', name, count, picked, oci_data.GPU_REGIONS.get(name, {}),
+        region_filter, zone_filter)
+
+
 def get_offerings(accelerator: str,
                   count: int = 1,
                   *,
@@ -180,6 +194,8 @@ def get_offerings(accelerator: str,
         out.extend(_aws_gpu_offerings(accelerator, count, region, zone))
     if tpu is None and cloud in (None, 'azure'):
         out.extend(_azure_gpu_offerings(accelerator, count, region, zone))
+    if tpu is None and cloud in (None, 'oci'):
+        out.extend(_oci_gpu_offerings(accelerator, count, region, zone))
     return out
 
 
@@ -222,7 +238,7 @@ def get_zones_for_region(accelerator: str, region: str) -> List[str]:
 
 def validate_region_zone(cloud: str, region: Optional[str],
                          zone: Optional[str]) -> None:
-    if cloud not in ('gcp', 'aws', 'azure', 'fake', 'local',
+    if cloud not in ('gcp', 'aws', 'azure', 'oci', 'fake', 'local',
                      'kubernetes'):
         raise exceptions.InvalidSpecError(f'Unknown cloud {cloud!r}')
     if region is None:
@@ -245,6 +261,14 @@ def validate_region_zone(cloud: str, region: Optional[str],
                 f'Unknown Azure region {region!r}. Known: '
                 f'{azure_data.ALL_AZURE_REGIONS}')
         return  # Azure zones are ordinals ('1'), not region-prefixed
+    elif cloud == 'oci':
+        from skypilot_tpu.catalog import oci_data
+        if region not in oci_data.REGIONS:
+            raise exceptions.InvalidSpecError(
+                f'Unknown OCI region {region!r}. Known: '
+                f'{oci_data.REGIONS}')
+        # OCI availability domains are region-prefixed
+        # ('us-ashburn-1-AD-1'); fall through to the prefix check.
     else:
         return
     if zone is not None and not zone.startswith(region):
@@ -259,6 +283,9 @@ def _cpu_tables(cloud: Optional[str]) -> Dict[str, tuple]:
     if cloud == 'azure':
         from skypilot_tpu.catalog import azure_data
         return azure_data.CPU_INSTANCE_TYPES
+    if cloud == 'oci':
+        from skypilot_tpu.catalog import oci_data
+        return oci_data.CPU_INSTANCE_TYPES
     return gcp_data.CPU_INSTANCE_TYPES
 
 
@@ -315,4 +342,7 @@ def default_region(cloud: str) -> str:
     if cloud == 'azure':
         from skypilot_tpu.catalog import azure_data
         return azure_data.DEFAULT_REGION
+    if cloud == 'oci':
+        from skypilot_tpu.catalog import oci_data
+        return oci_data.DEFAULT_REGION
     return 'us-central1'

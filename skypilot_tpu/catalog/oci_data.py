@@ -1,0 +1,58 @@
+"""OCI catalog data: CPU/GPU shapes + regions (public list prices,
+ballpark — parity: the reference's OCI catalog CSVs,
+``sky/catalog/data_fetchers/fetch_oci.py``).
+
+OCI's native model is FLEX shapes (pay per OCPU+GB); the catalog keeps
+a few fixed presets so the optimizer can rank concrete offerings like
+it does for every other cloud. 1 OCPU = 2 vCPUs on E-series.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+DEFAULT_REGION = 'us-ashburn-1'
+
+REGIONS: List[str] = [
+    'us-ashburn-1', 'us-phoenix-1', 'eu-frankfurt-1', 'uk-london-1',
+    'ap-tokyo-1',
+]
+
+# name -> (vcpus, memory_gb, $/hr): E5.Flex presets at public
+# per-OCPU/per-GB list price (0.03/OCPU + 0.002/GB ballpark).
+CPU_INSTANCE_TYPES: Dict[str, Tuple[int, float, float]] = {
+    'VM.Standard.E5.Flex-2-16': (2, 16.0, 0.062),
+    'VM.Standard.E5.Flex-4-32': (4, 32.0, 0.124),
+    'VM.Standard.E5.Flex-8-64': (8, 64.0, 0.248),
+    'VM.Standard.E5.Flex-16-128': (16, 128.0, 0.496),
+    'VM.Standard.E5.Flex-32-256': (32, 256.0, 0.992),
+}
+
+# accelerator -> count -> (shape, $/hr on-demand, $/hr spot, vram/GPU).
+# OCI calls spot 'preemptible capacity' (50% of on-demand list).
+GPU_INSTANCE_TYPES: Dict[str, Dict[int, Tuple[str, float, float, int]]] = {
+    'A10': {
+        1: ('VM.GPU.A10.1', 2.0, 1.0, 24),
+        2: ('VM.GPU.A10.2', 4.0, 2.0, 24),
+    },
+    'A100-80GB': {
+        8: ('BM.GPU.A100-v2.8', 32.0, 16.0, 80),
+    },
+    'H100': {
+        8: ('BM.GPU.H100.8', 80.0, 40.0, 80),
+    },
+}
+
+GPU_REGIONS: Dict[str, Dict[str, List[str]]] = {
+    'A10': {r: [f'{r}-AD-1'] for r in REGIONS},
+    'A100-80GB': {r: [f'{r}-AD-1'] for r in
+                  ('us-ashburn-1', 'us-phoenix-1', 'eu-frankfurt-1')},
+    'H100': {r: [f'{r}-AD-1'] for r in ('us-ashburn-1',)},
+}
+
+
+def instance_type_for(accelerator: str, count: int):
+    """(shape, on_demand $/hr, spot $/hr) or None."""
+    table = GPU_INSTANCE_TYPES.get(accelerator)
+    if not table:
+        return None
+    return table.get(count)

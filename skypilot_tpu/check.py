@@ -84,12 +84,24 @@ def _check_azure() -> Tuple[bool, str]:
         return False, str(e)[:200]
 
 
+def _check_oci() -> Tuple[bool, str]:
+    try:
+        from skypilot_tpu.provision.oci import credentials
+        creds = credentials()
+        if not os.path.exists(creds['key_file']):
+            return False, f'OCI key file missing: {creds["key_file"]}'
+        return True, 'API-key credentials'
+    except Exception as e:  # pylint: disable=broad-except
+        return False, str(e)[:200]
+
+
 _CHECKS = {
     'local': lambda: (True, 'always available'),
     'fake': lambda: (True, 'always available (simulated cloud)'),
     'gcp': _check_gcp,
     'aws': _check_aws,
     'azure': _check_azure,
+    'oci': _check_oci,
     'kubernetes': _check_kubernetes,
     'ssh': _check_ssh,
     'slurm': _check_slurm,

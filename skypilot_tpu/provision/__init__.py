@@ -16,6 +16,7 @@ from skypilot_tpu.provision import local as _local  # noqa: F401
 from skypilot_tpu.provision import gcp as _gcp  # noqa: F401
 from skypilot_tpu.provision import aws as _aws  # noqa: F401
 from skypilot_tpu.provision import azure as _azure  # noqa: F401
+from skypilot_tpu.provision import oci as _oci  # noqa: F401
 from skypilot_tpu.provision import kubernetes as _kubernetes  # noqa: F401
 from skypilot_tpu.provision import ssh_pool as _ssh_pool  # noqa: F401
 from skypilot_tpu.provision import slurm as _slurm  # noqa: F401

@@ -1,0 +1,36 @@
+"""Per-cloud SSH keypair management (shared by cloud drivers).
+
+Each cloud gets its own keypair under ``$SKYT_STATE_DIR/keys/<cloud>/``
+so drivers don't couple to a sibling cloud's module or mislabel keys.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import exceptions
+
+
+def key_path(cloud: str) -> str:
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, 'keys', cloud, f'skyt-{cloud}-key')
+
+
+def ensure_keypair(cloud: str) -> Tuple[str, str]:
+    """(private key path, public key line); generates ed25519 once."""
+    path = key_path(cloud)
+    pub_path = path + '.pub'
+    if not os.path.exists(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if not shutil.which('ssh-keygen'):
+            raise exceptions.ProvisionError(
+                f'ssh-keygen not available; cannot generate the '
+                f'{cloud} cluster SSH keypair')
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+             '-C', f'skyt-{cloud}', '-f', path], check=True)
+    with open(pub_path, encoding='utf-8') as f:
+        return path, f.read().strip()

@@ -1,0 +1,261 @@
+"""OCI provider against a stubbed Core-API transport (VERDICT r4 next
+#10: the fourth real compute cloud on the proven Provider interface).
+
+Parity bars: ``sky/provision/oci/instance.py`` lifecycle +
+``sky/clouds/oci.py`` catalog surface. The fake transport answers Core
+Services REST calls from in-memory dicts so launch / stop / start /
+terminate round-trips, tag-scoped listing, spot (preemptible), flex
+shapes, and error classification are unit-testable offline. The
+HTTP-Signature signer is verified against a real generated RSA key."""
+import base64
+import hashlib
+
+import pytest
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.catalog import common as catalog_common
+from skypilot_tpu.provision import oci
+from skypilot_tpu.provision.api import ProvisionRequest
+from skypilot_tpu.spec.resources import Resources
+
+
+class FakeOci(oci.OciProvider):
+    """In-memory Core API: answers the REST calls the provider makes."""
+
+    def __init__(self):
+        self.instances = {}     # id -> record
+        self.calls = []
+        self.fail_launch_with = None
+        self._seq = 0
+
+    def _request(self, method, region, path, body=None, params=None):
+        self.calls.append((method, path, params))
+        params = params or {}
+        if path == '/instances/' and method == 'POST':
+            if self.fail_launch_with:
+                raise oci.classify_oci_error(self.fail_launch_with,
+                                             'simulated')
+            self._seq += 1
+            iid = f'ocid1.instance.oc1..{self._seq:04d}'
+            record = {'id': iid, 'lifecycleState': 'RUNNING',
+                      'availabilityDomain': body['availabilityDomain'],
+                      'displayName': body['displayName'],
+                      'shape': body['shape'],
+                      'shapeConfig': body.get('shapeConfig'),
+                      'preemptible': 'preemptibleInstanceConfig' in body,
+                      'metadata': body['metadata'],
+                      'freeformTags': body['freeformTags']}
+            self.instances[iid] = record
+            return record
+        if path == '/instances/' and method == 'GET':
+            return {'items': list(self.instances.values())}
+        if path.startswith('/instances/') and method == 'POST':
+            iid = path.split('/')[2]
+            action = params.get('action')
+            if action in ('STOP', 'SOFTSTOP'):
+                self.instances[iid]['lifecycleState'] = 'STOPPED'
+            elif action == 'START':
+                self.instances[iid]['lifecycleState'] = 'RUNNING'
+            return {}
+        if path.startswith('/instances/') and method == 'DELETE':
+            iid = path.split('/')[2]
+            if iid in self.instances:
+                self.instances[iid]['lifecycleState'] = 'TERMINATED'
+            return {}
+        if path == '/vnicAttachments/' and method == 'GET':
+            iid = params['instanceId']
+            n = int(iid[-4:])
+            return {'items': [{'vnicId': f'vnic-{n}',
+                               'lifecycleState': 'ATTACHED'}]}
+        if path.startswith('/vnics/') and method == 'GET':
+            n = int(path.rsplit('-', 1)[1])
+            return {'privateIp': f'10.30.0.{n}',
+                    'publicIp': f'129.1.0.{n}'}
+        raise AssertionError(f'unstubbed OCI call: {method} {path}')
+
+
+def _request_for(cluster, accel='A100-80GB', count=8, num_nodes=2,
+                 zone=None, use_spot=False):
+    res = Resources(cloud='oci', region='us-ashburn-1', zone=zone,
+                    accelerators={accel: count}, use_spot=use_spot)
+    return ProvisionRequest(cluster_name=cluster, resources=res,
+                            num_nodes=num_nodes, region='us-ashburn-1',
+                            zone=zone)
+
+
+@pytest.fixture()
+def fake(tmp_home, monkeypatch, tmp_path):
+    key = tmp_path / 'oci_api_key.pem'
+    key.write_text('unused-by-fake')
+    for var, value in (('OCI_TENANCY_OCID', 'ocid1.tenancy.oc1..t'),
+                       ('OCI_USER_OCID', 'ocid1.user.oc1..u'),
+                       ('OCI_FINGERPRINT', 'aa:bb'),
+                       ('OCI_KEY_FILE', str(key)),
+                       ('OCI_COMPARTMENT_OCID', 'ocid1.compartment..c'),
+                       ('OCI_SUBNET_OCID', 'ocid1.subnet..s'),
+                       ('OCI_IMAGE_OCID', 'ocid1.image..i')):
+        monkeypatch.setenv(var, value)
+    from skypilot_tpu.provision import ssh_keys
+    monkeypatch.setattr(
+        ssh_keys, 'ensure_keypair',
+        lambda cloud: ('/tmp/fake-key', 'ssh-ed25519 AAAA skyt'))
+    provider = FakeOci()
+
+    def record(cluster, region='us-ashburn-1'):
+        state.add_or_update_cluster(
+            cluster, region=region,
+            handle={'provider': 'oci', 'region': region,
+                    'cluster_name': cluster, 'zone': None, 'hosts': [],
+                    'ssh_user': 'skyt', 'ssh_key_path': None,
+                    'custom': {}},
+            status=state.ClusterStatus.UP)
+
+    provider.record = record
+    return provider
+
+
+def test_launch_lifecycle_and_tags(fake):
+    info = fake.run_instances(_request_for('oc1'))
+    assert info.provider == 'oci' and len(info.hosts) == 2
+    assert [h.node_index for h in info.hosts] == [0, 1]
+    assert info.hosts[0].internal_ip.startswith('10.30.0.')
+    assert info.hosts[0].external_ip.startswith('129.1.0.')
+    record = next(iter(fake.instances.values()))
+    assert record['shape'] == 'BM.GPU.A100-v2.8'
+    assert record['freeformTags']['skyt-cluster'] == 'oc1'
+    assert record['metadata']['ssh_authorized_keys'].startswith('skyt:')
+    fake.record('oc1')
+    assert set(fake.query_instances('oc1').values()) == {'running'}
+
+
+def test_stop_resume_terminate_roundtrip(fake):
+    fake.run_instances(_request_for('oc2', num_nodes=1))
+    fake.record('oc2')
+    fake.stop_instances('oc2')
+    assert set(fake.query_instances('oc2').values()) == {'stopped'}
+    req = _request_for('oc2', num_nodes=1)
+    req.resume = True
+    info = fake.run_instances(req)
+    assert len(info.hosts) == 1
+    assert set(fake.query_instances('oc2').values()) == {'running'}
+    fake.terminate_instances('oc2')
+    assert fake.get_cluster_info('oc2') is None
+    fake.terminate_instances('oc2')   # idempotent
+
+
+def test_spot_flex_shapes_and_zone(fake):
+    req = _request_for('oc3', num_nodes=1, use_spot=True,
+                       zone='us-ashburn-1-AD-2')
+    fake.run_instances(req)
+    record = next(iter(fake.instances.values()))
+    assert record['preemptible'] is True
+    assert record['availabilityDomain'] == 'us-ashburn-1-AD-2'
+    # CPU request resolves to a flex shape with an explicit shapeConfig.
+    fake2 = FakeOci()
+    res = Resources(cloud='oci', region='us-ashburn-1', cpus='8+')
+    fake2.run_instances(ProvisionRequest(
+        cluster_name='oc-cpu', resources=res, num_nodes=1,
+        region='us-ashburn-1', zone=None))
+    cpu = next(iter(fake2.instances.values()))
+    assert cpu['shape'] == 'VM.Standard.E5.Flex'
+    assert cpu['shapeConfig'] == {'ocpus': 4.0, 'memoryInGBs': 64.0}
+
+
+def test_error_classification(fake):
+    fake.fail_launch_with = 'OutOfHostCapacity'
+    with pytest.raises(exceptions.CapacityError):
+        fake.run_instances(_request_for('oc4'))
+    fake.fail_launch_with = 'LimitExceeded'
+    with pytest.raises(exceptions.QuotaExceededError):
+        fake.run_instances(_request_for('oc5'))
+    fake.fail_launch_with = 'NotAuthenticated'
+    with pytest.raises(exceptions.NoCloudAccessError):
+        fake.run_instances(_request_for('oc6'))
+
+
+def test_http_signature_verifies_against_public_key():
+    """The draft-cavage signer produces a signature the PUBLIC half of
+    the key verifies over the exact signing string OCI reconstructs."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    body = b'{"displayName": "x"}'
+    url = ('https://iaas.us-ashburn-1.oraclecloud.com/20160918'
+           '/instances/?compartmentId=ocid1.c')
+    headers = oci.signed_headers(
+        'POST', url, body, key_id='t/u/fp', private_key_pem=pem,
+        date='Thu, 31 Jul 2026 00:00:00 GMT')
+    auth = headers['authorization']
+    assert 'keyId="t/u/fp"' in auth
+    assert 'algorithm="rsa-sha256"' in auth
+    assert ('headers="(request-target) date host x-content-sha256 '
+            'content-type content-length"') in auth
+    sha = base64.b64encode(hashlib.sha256(body).digest()).decode()
+    assert headers['x-content-sha256'] == sha
+    signing_string = '\n'.join([
+        '(request-target): post /20160918/instances/'
+        '?compartmentId=ocid1.c',
+        'date: Thu, 31 Jul 2026 00:00:00 GMT',
+        'host: iaas.us-ashburn-1.oraclecloud.com',
+        f'x-content-sha256: {sha}',
+        'content-type: application/json',
+        f'content-length: {len(body)}',
+    ])
+    signature = base64.b64decode(
+        auth.split('signature="')[1].rstrip('"'))
+    key.public_key().verify(signature, signing_string.encode(),
+                            padding.PKCS1v15(), hashes.SHA256())
+
+
+def test_catalog_offerings_and_failover_lands_on_oci(fake, monkeypatch):
+    offers = catalog_common.get_offerings('A100-80GB', 8, cloud='oci')
+    assert offers and all(o.cloud == 'oci' for o in offers)
+    assert min(o.cost(True) for o in offers) < min(
+        o.cost(False) for o in offers)
+
+    from skypilot_tpu.optimizer import candidates_for
+    from skypilot_tpu.provision import provisioner as provisioner_lib
+
+    class Exhausted:
+        def __init__(self, cloud):
+            self.cloud = cloud
+
+        def run_instances(self, request):
+            raise exceptions.CapacityError(f'{self.cloud}: stockout')
+
+        def terminate_instances(self, cluster_name):
+            pass
+
+    monkeypatch.setattr(
+        provisioner_lib, 'get_provider',
+        lambda cloud: fake if cloud == 'oci' else Exhausted(cloud))
+    res = Resources(accelerators={'A100-80GB': 8})
+    cands = candidates_for(res, enabled_clouds=['gcp', 'azure', 'oci'])
+    assert {c.resources.cloud for c in cands} >= {'azure', 'oci'}
+    info, chosen = provisioner_lib.provision_with_failover(
+        'any4', cands, num_nodes=1)
+    assert chosen.resources.cloud == 'oci'
+    assert info.provider == 'oci'
+
+
+def test_oci_enabled_by_api_key(tmp_home, tmp_path, monkeypatch):
+    from skypilot_tpu import check
+    for var in ('OCI_TENANCY_OCID', 'OCI_USER_OCID', 'OCI_FINGERPRINT',
+                'OCI_KEY_FILE'):
+        monkeypatch.delenv(var, raising=False)
+    check.clear_cache()
+    ok, _ = check.check(['oci'])['oci']
+    assert not ok
+    key = tmp_path / 'k.pem'
+    key.write_text('x')
+    monkeypatch.setenv('OCI_TENANCY_OCID', 't')
+    monkeypatch.setenv('OCI_USER_OCID', 'u')
+    monkeypatch.setenv('OCI_FINGERPRINT', 'fp')
+    monkeypatch.setenv('OCI_KEY_FILE', str(key))
+    check.clear_cache()
+    ok, reason = check.check(['oci'])['oci']
+    assert ok and 'credentials' in reason
