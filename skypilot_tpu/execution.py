@@ -252,7 +252,7 @@ def _launch_graph(dag: Dag, cluster_name: Optional[str],
         raise exceptions.SkytError(
             f'dag: task(s) {failed} finished '
             f'{[statuses[n] or "UNKNOWN" for n in failed]}; '
-            f'aborted {len(skipped)} downstream/unstarted task(s) '
+            f'aborting {len(skipped)} downstream/unstarted task(s) '
             f'{skipped} (WAIT_SUCCESS)')
     return [results[t.name] for t in dag.tasks]
 
