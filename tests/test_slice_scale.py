@@ -94,8 +94,9 @@ def test_slice_width_gang_cancel_reaps_all_ranks():
     job_id = execution.launch(task, cluster_name='slice32c',
                               detach_run=True)[0][1]
     _wait_status('slice32c', job_id, {'RUNNING'})
-    # Let the fan-out actually spawn the ranks.
-    deadline = time.time() + 60
+    # Let the fan-out actually spawn the ranks. Generous: 32 SSH-shim
+    # spawns on a 1-core CI box under full-suite load take a while.
+    deadline = time.time() + 240
     while time.time() < deadline:
         count = sum(1 for p in psutil.process_iter(['cmdline'])
                     if 'sleep 600' in ' '.join(p.info['cmdline'] or []))
@@ -106,7 +107,7 @@ def test_slice_width_gang_cancel_reaps_all_ranks():
 
     assert core.cancel('slice32c', job_id)
     _wait_status('slice32c', job_id, {'CANCELLED'})
-    deadline = time.time() + 45
+    deadline = time.time() + 120
     while time.time() < deadline:
         alive = [p.pid for p in psutil.process_iter(['cmdline'])
                  if 'sleep 600' in ' '.join(p.info['cmdline'] or [])]
