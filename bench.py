@@ -299,7 +299,24 @@ def main() -> int:
     model = args.model or ('bench-1b7' if on_accel else 'tiny')
 
     if args.mode == 'decode':
-        return _decode_bench(args, model, on_accel)
+        try:
+            return _decode_bench(args, model, on_accel)
+        except Exception as e:  # pylint: disable=broad-except
+            # A lowering/runtime failure must still land in a parseable
+            # JSON line — tunnel-up windows are short and a traceback
+            # with no artifact wastes one.
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                'metric': f'decode_toks_per_sec_{model}_failed',
+                'value': 0,
+                'unit': 'tokens/sec',
+                'vs_baseline': 0,
+                'detail': {'error': f'{type(e).__name__}: {e}'[:500],
+                           'quantized': args.quantize,
+                           'attention_impl': args.attention_impl},
+            }))
+            return 1
     if args.mode == 'kernels':
         return _kernels_smoke(on_accel)
     args.steps = args.steps or 20

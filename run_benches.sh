@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# One-shot TPU bench capture for a short tunnel-up window: runs all
-# three bench modes back-to-back with minimal probing and snapshots
-# every artifact. Run the MOMENT `python -c "import jax;
+# One-shot TPU bench capture for a short tunnel-up window: FIVE
+# invocations back-to-back (train; decode xla/pallas/pallas+int8;
+# kernels), each capped at 1800 s, with minimal probing and a snapshot
+# artifact per run. Run the MOMENT `python -c "import jax;
 # print(jax.devices())"` answers with a TPU (see PERF.md tunnel log).
 #
 #   ./run_benches.sh [suffix]     # artifacts: BENCH_<mode>_<suffix>.json
@@ -10,18 +11,17 @@ cd "$(dirname "$0")"
 suffix="${1:-r05_measured}"
 export SKYT_BENCH_PROBE_TRIES="${SKYT_BENCH_PROBE_TRIES:-1}"
 
-run_mode() {
-  local mode="$1" out="$2"
-  echo "=== bench --mode $mode ($(date -u +%H:%M:%SZ)) ===" >&2
-  if [ "$mode" = train ]; then
-    timeout 1800 python bench.py | tee "$out"
-  else
-    timeout 1800 python bench.py --mode "$mode" | tee "$out"
-  fi
+run() {
+  local out="$1"; shift
+  echo "=== bench $* ($(date -u +%H:%M:%SZ)) ===" >&2
+  timeout 1800 python bench.py "$@" | tee "$out"
   echo "rc=$? -> $out" >&2
 }
 
-run_mode train   "BENCH_train_${suffix}.json"
-run_mode decode  "BENCH_decode_${suffix}.json"
-run_mode kernels "BENCH_kernels_${suffix}.json"
-echo "All three modes attempted; update PERF.md tables and commit" >&2
+run "BENCH_train_${suffix}.json"
+# The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
+run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla
+run "BENCH_decode_pallas_${suffix}.json" --mode decode --attention-impl pallas
+run "BENCH_decode_int8_${suffix}.json"   --mode decode --attention-impl pallas --quantize
+run "BENCH_kernels_${suffix}.json"       --mode kernels
+echo "All modes attempted; update PERF.md tables and commit" >&2
