@@ -258,9 +258,14 @@ def _interval(key: str, default: float) -> Callable[[], float]:
 def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
     daemons = []
     if server_id is not None:
+        def _ha_interval() -> float:
+            env = os.environ.get('SKYT_REQUESTS_HA_INTERVAL')
+            if env:           # helm: ha.requestsTickSeconds
+                return float(env)
+            return _interval('requests_ha_interval', 5.0)()
+
         daemons.append(
-            Daemon('requests-ha',
-                   _interval('requests_ha_interval', 5.0),
+            Daemon('requests-ha', _ha_interval,
                    functools.partial(_requests_ha_tick, server_id)))
     return daemons + [
         Daemon('cluster-status-refresh',
