@@ -378,8 +378,8 @@ def serve_down(service_name: str, purge: bool) -> None:
 def serve_status(service_name: Optional[str]) -> None:
     """Show services and their replica fleets."""
     rows = _run(sdk.serve_status(service_name), False, stream=False)
-    _echo_table(rows or [], ['name', 'status', 'lb_port',
-                             'failure_reason'])
+    _echo_table(rows or [], ['name', 'status', 'endpoint',
+                             'controller_cluster', 'failure_reason'])
     for row in rows or []:
         for replica in row.get('replicas', []):
             click.echo(
