@@ -76,7 +76,8 @@ def _db():
     from skypilot_tpu.utils import pg
 
     def init_schema(conn) -> None:
-        conn.execute('PRAGMA journal_mode=WAL')
+        from skypilot_tpu.utils import pg as _pg_lib
+        _pg_lib.enable_wal(conn)
         conn.executescript("""
             CREATE TABLE IF NOT EXISTS jobs (
                 job_id INTEGER PRIMARY KEY AUTOINCREMENT,

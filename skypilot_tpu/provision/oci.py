@@ -197,7 +197,18 @@ class OciProvider(Provider):
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
                 raw = resp.read()
-                return json.loads(raw) if raw else {}
+                parsed = json.loads(raw) if raw else {}
+                # OCI list pagination rides a response HEADER; fold it
+                # into the payload so list callers can follow it
+                # without reaching into the transport.
+                next_page = resp.headers.get('opc-next-page')
+                if next_page:
+                    if isinstance(parsed, list):
+                        parsed = {'items': parsed,
+                                  'opc-next-page': next_page}
+                    elif isinstance(parsed, dict):
+                        parsed.setdefault('opc-next-page', next_page)
+                return parsed
         except urllib.error.HTTPError as e:
             text = e.read().decode('utf-8', errors='replace')
             try:
@@ -255,11 +266,27 @@ class OciProvider(Provider):
 
     def _list_instances(self, cluster: str,
                         region: str) -> List[Dict[str, Any]]:
-        """Non-terminated instances carrying this cluster's tag."""
-        out = self._request(
-            'GET', region, '/instances/',
-            params={'compartmentId': placement()['compartment']})
-        rows = out if isinstance(out, list) else out.get('items', [])
+        """Non-terminated instances carrying this cluster's tag.
+
+        Follows ``opc-next-page`` pagination (ADVICE r5 low): in a
+        large compartment a single page can hide this cluster's
+        instances from stop/terminate, silently leaking them."""
+        rows: List[Dict[str, Any]] = []
+        params = {'compartmentId': placement()['compartment']}
+        for _ in range(100):  # bounded: 100 pages ≈ 100k instances
+            out = self._request('GET', region, '/instances/',
+                                params=dict(params))
+            page = out if isinstance(out, list) else out.get('items', [])
+            rows.extend(page)
+            token = (out.get('opc-next-page')
+                     if isinstance(out, dict) else None)
+            if not token:
+                break
+            params['page'] = token
+        else:
+            logger.warning(
+                'OCI instance listing for %s did not drain in 100 '
+                'pages; lifecycle ops may miss instances.', cluster)
         return [r for r in rows
                 if (r.get('freeformTags') or {}).get('skyt-cluster')
                 == cluster and r.get('lifecycleState') not in
@@ -333,7 +360,8 @@ class OciProvider(Provider):
                                          'preserveBootVolume': False}}
             self._request('POST', region, '/instances/', body)
         self.wait_instances(cluster, 'running',
-                            region_hint=region)
+                            region_hint=region,
+                            expected=request.num_nodes)
         return self._cluster_info_from(
             cluster, region, self._list_instances(cluster, region))
 
@@ -381,22 +409,32 @@ class OciProvider(Provider):
 
     def wait_instances(self, cluster_name: str, state: str = 'running',
                        timeout: float = 600,
-                       region_hint: Optional[str] = None) -> None:
+                       region_hint: Optional[str] = None,
+                       expected: Optional[int] = None) -> None:
+        """``expected`` guards against list eventual-consistency and a
+        partially-failed multi-node launch loop (ADVICE r5 low): the
+        wait only succeeds once at least that many instances are
+        visible AND in the target state — never on a subset."""
         import time
         deadline = time.time() + timeout
         region = region_hint or self._region_of(cluster_name)
+        states: Dict[str, str] = {}
         while time.time() < deadline:
             states = {
                 inst['id']: self._STATE_MAP.get(
                     inst['lifecycleState'],
                     inst['lifecycleState'].lower())
                 for inst in self._list_instances(cluster_name, region)}
-            if states and all(s == state for s in states.values()):
+            if (states and
+                    (expected is None or len(states) >= expected) and
+                    all(s == state for s in states.values())):
                 return
             time.sleep(min(2, max(0.01, deadline - time.time())))
         raise TimeoutError(
             f'{cluster_name}: OCI instances did not reach {state!r} '
-            f'in {timeout}s')
+            f'in {timeout}s'
+            + (f' (saw {len(states)}/{expected} instances)'
+               if expected is not None else ''))
 
     def _cluster_info_from(self, cluster: str, region: str,
                            instances: List[Dict[str, Any]]

@@ -29,10 +29,11 @@ responses mirror the channel protocol, including ``stream`` frames for
 from __future__ import annotations
 
 import os
+import shutil
 import socket
 import socketserver
+import tempfile
 import threading
-import uuid
 from typing import Any, Dict, IO, Optional
 
 from skypilot_tpu import exceptions
@@ -46,10 +47,13 @@ BROKER_SOCK_ENV = 'SKYT_CHANNEL_BROKER_SOCK'
 DEFAULT_TIMEOUT = float(os.environ.get('SKYT_CHANNEL_TIMEOUT', '120'))
 
 
-def _sock_path() -> str:
-    # /tmp, not the state dir: AF_UNIX paths cap at ~107 bytes and test
-    # tmpdirs routinely blow past that.
-    return f'/tmp/skyt-broker-{uuid.uuid4().hex[:12]}.sock'
+def _sock_dir() -> str:
+    # A fresh mkdtemp (0700) under /tmp — not the state dir: AF_UNIX
+    # paths cap at ~107 bytes and test tmpdirs routinely blow past
+    # that. The private parent directory closes the ADVICE r5 window
+    # where the socket itself was world-connectable between bind and
+    # chmod: no other local user can traverse to it at any point.
+    return tempfile.mkdtemp(prefix='skyt-brk-', dir='/tmp')
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -137,8 +141,18 @@ class ChannelBroker:
     """The in-server broker endpoint (started by ApiServer)."""
 
     def __init__(self, sock_path: Optional[str] = None) -> None:
-        self.sock_path = sock_path or _sock_path()
-        self._server = _ThreadingUnixServer(self.sock_path, _Handler)
+        self._own_dir: Optional[str] = None
+        if sock_path is None:
+            self._own_dir = _sock_dir()
+            sock_path = os.path.join(self._own_dir, 'broker.sock')
+        self.sock_path = sock_path
+        # Umask-guard the bind for caller-supplied paths too: the
+        # socket is born 0600 instead of racing a post-bind chmod.
+        old_umask = os.umask(0o177)
+        try:
+            self._server = _ThreadingUnixServer(self.sock_path, _Handler)
+        finally:
+            os.umask(old_umask)
         os.chmod(self.sock_path, 0o600)
         self._thread: Optional[threading.Thread] = None
 
@@ -156,6 +170,8 @@ class ChannelBroker:
             os.unlink(self.sock_path)
         except OSError:
             pass
+        if self._own_dir is not None:
+            shutil.rmtree(self._own_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------

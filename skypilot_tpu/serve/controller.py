@@ -209,13 +209,59 @@ class ServeController:
         # controller left behind (no-op on a fresh start; a READY
         # service must not flap through REPLICA_INIT).
         self.manager.recover_inflight()
+        from skypilot_tpu.utils import resilience
+        error_delays = None
         while True:
-            if serve_state.shutdown_requested(self.service_name):
-                self.shutdown()
-                return
             try:
+                # The shutdown check shares the guard: a transient
+                # serve-DB error here used to escape the loop and kill
+                # the controller outright (service.py then marks
+                # CONTROLLER_FAILED for what was a one-tick blip).
+                record = serve_state.get_service(self.service_name)
+                if record is None or record.shutdown_requested:
+                    # A MISSING row is also the exit signal: `down
+                    # --purge` through a non-owning replica can't kill
+                    # this (host-local) pid and deletes the row instead.
+                    self.shutdown()
+                    return
+                if self._superseded(record):
+                    # A peer's reaper declared us dead (our replica's
+                    # heartbeat lapsed — e.g. the server process died
+                    # while we, a detached process, survived) and
+                    # spawned a replacement. Exactly one controller may
+                    # autoscale this fleet: stand down WITHOUT teardown
+                    # — the replacement owns the replicas now.
+                    logger.warning(
+                        'Service %s: superseded by a replacement '
+                        'controller (row pid %s != our pid %s); '
+                        'standing down.', self.service_name,
+                        record.controller_pid, os.getpid())
+                    return
                 self.run_once()
-            except Exception:  # pylint: disable=broad-except
+            except Exception as e:  # pylint: disable=broad-except
                 logger.exception('Service %s: controller tick failed',
                                  self.service_name)
+                if isinstance(e, resilience.transient_db_errors()):
+                    # Bounded extra (jittered) backoff on DB faults:
+                    # don't hammer a locked/flapping store at the poll
+                    # cadence.
+                    if error_delays is None:
+                        error_delays = resilience.backoff_delays(
+                            base=0.5, cap=30.0)
+                    time.sleep(next(error_delays))
+            else:
+                error_delays = None
             time.sleep(POLL_SECONDS)
+
+    @staticmethod
+    def _superseded(record) -> bool:
+        """Has a replacement controller (or a restart claim) taken this
+        service over from this process? Offloaded controllers are
+        identified by cluster job id, not pid — no self-fence there."""
+        if os.environ.get('SKYT_SERVE_ON_CLUSTER'):
+            return False
+        if record.controller_pid is not None:
+            return record.controller_pid != os.getpid()
+        # pid NULL with a claim timestamp = a reaper claimed the
+        # restart and is about to spawn the replacement.
+        return record.controller_claimed_at is not None

@@ -45,6 +45,34 @@ def controller_cluster() -> 'Optional[str]':
             or config.get_nested(('serve', 'controller_cluster'), None))
 
 
+def _my_server_id() -> Optional[str]:
+    """This process's API-server replica identity, when it has one.
+    Request children and spawned controllers inherit it via
+    SKYT_SERVER_ID (set by executor._run_request_in_child /
+    _spawn_local); server daemon threads pass it explicitly instead —
+    two in-process replicas (tests) share one environ."""
+    return os.environ.get('SKYT_SERVER_ID') or None
+
+
+def _pid_create_time(pid: int) -> Optional[float]:
+    try:
+        return psutil.Process(pid).create_time()
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def _same_local_process(pid: int,
+                        recorded_created: Optional[float]) -> bool:
+    """Is the live process at ``pid`` the controller we recorded?
+    Mirrors executor._same_process: rows without a recorded start time
+    (legacy) are trusted on existence alone; a recycled pid (container
+    restart resets the namespace) reads as NOT ours."""
+    if recorded_created is None:
+        return True
+    created = _pid_create_time(pid)
+    return created is not None and abs(created - recorded_created) < 2.0
+
+
 def _controller_max_restarts() -> int:
     from skypilot_tpu import config
     if 'SKYT_SERVE_CONTROLLER_MAX_RESTARTS' in os.environ:
@@ -66,26 +94,35 @@ def _endpoint_host(cluster: str) -> str:
     return '127.0.0.1'
 
 
-def _spawn_local(name: str) -> None:
+def _spawn_local(name: str, server_id: Optional[str] = None) -> None:
+    server_id = server_id or _my_server_id()
     log_path = serve_state.controller_log_path(name)
+    env = {'SKYT_SERVER_ID': server_id} if server_id else None
     pid = subprocess_utils.daemonize_and_run(
         [sys.executable, '-m', 'skypilot_tpu.serve.service',
          '--service-name', name],
-        log_path=log_path)
-    serve_state.set_controller_pid(name, pid)
+        log_path=log_path, env=env)
+    # Owner fencing (ADVICE r5 high): the spawning replica's identity +
+    # the pid's create time make this row pid-judgeable ONLY by us —
+    # a peer replica seeing a host-local pid as dead (or a recycled pid
+    # as alive) must go through the heartbeat-stale path instead.
+    serve_state.set_controller_pid(name, pid, server_id=server_id,
+                                   pid_created=_pid_create_time(pid))
     # A local replacement for a previously-offloaded controller must
     # stop advertising the old cluster head as its endpoint.
     serve_state.set_lb_host(name, None)
-    logger.info('Service %s: controller pid %s', name, pid)
+    logger.info('Service %s: controller pid %s (owner %s)', name, pid,
+                server_id or 'local')
 
 
-def _spawn_controller(name: str) -> None:
+def _spawn_controller(name: str,
+                      server_id: Optional[str] = None) -> None:
     """Start the service process — locally, or as a detached CPU job on
     the configured serve controller cluster — and record its identity.
     Raises on spawn failure (nothing started)."""
     cluster = controller_cluster()
     if cluster is None:
-        _spawn_local(name)
+        _spawn_local(name, server_id)
         return
     from skypilot_tpu import execution
     from skypilot_tpu import state as state_lib
@@ -106,7 +143,7 @@ def _spawn_controller(name: str) -> None:
             'serve controller could not see the serve DB. Running the '
             'controller locally instead; configure a shared Postgres '
             '(SKYT_DB_URL) to actually offload.', cluster)
-        _spawn_local(name)
+        _spawn_local(name, server_id)
         return
     # The LB must listen on a reachable interface of the controller
     # cluster head, not loopback.
@@ -176,9 +213,57 @@ def up(task: Task, service_name: Optional[str] = None) -> Dict[str, Any]:
     return {'name': name, 'endpoint': endpoint}
 
 
-def _controller_alive_for(record, queue_cache=None) -> bool:
+def _owner_is_live(owner: str,
+                   owner_cache: Optional[dict] = None) -> bool:
+    """Heartbeat-based liveness of the replica that spawned a local
+    controller — the ONLY death signal a peer may act on (its pid is
+    meaningless off-host). Shares the requests-DB heartbeat table,
+    stale window, AND self-DB-health gate with request requeue fencing
+    (requests_db.note_db_health): a fresh process, or one just past a
+    DB outage, must observe a full stale window of healthy reads
+    before it may declare any peer dead — otherwise the first reader
+    after a shared-DB blip would take over every live peer's
+    controllers. A replica that never heartbeated is treated as LIVE:
+    staleness proves nothing about it (and the heartbeat purge keeps
+    rows of still-referenced owners, so 'absent' really means
+    never-beat).
+
+    ``owner_cache`` memoizes the two heartbeat-table scans for one reap
+    pass (same role as the reaper's queue_cache) — N peer-owned
+    services cost one pair of scans, not N."""
+    from skypilot_tpu.server import requests_db
+    try:
+        stale_after = requests_db.default_stale_seconds()
+        if owner_cache is not None and 'sets' in owner_cache:
+            live, known = owner_cache['sets']
+        else:
+            live = requests_db.live_server_ids(stale_after)
+            known = requests_db.known_server_ids()
+            if owner_cache is not None:
+                owner_cache['sets'] = (live, known)
+    except Exception as e:  # pylint: disable=broad-except
+        # Our own view of the heartbeat table is broken — exactly when
+        # every peer would look stale at once. Fail toward "alive".
+        logger.debug('owner liveness check for %s failed: %s', owner, e)
+        requests_db.note_db_health('serve-owner-scan', False)
+        return True
+    requests_db.note_db_health('serve-owner-scan', True)
+    if not requests_db.db_healthy_window_elapsed('serve-owner-scan',
+                                                 stale_after):
+        return True
+    return owner in live or owner not in known
+
+
+def _controller_alive_for(record, queue_cache=None,
+                          server_id: Optional[str] = None,
+                          owner_cache: Optional[dict] = None) -> bool:
     """Liveness for either controller placement: a local pid, or a
-    controller job on the offload cluster."""
+    controller job on the offload cluster.
+
+    Local pids are HOST-LOCAL: a row stamped by a PEER replica is never
+    pid-judged here — only the owner's heartbeat going stale (shared
+    requests-DB heartbeats) lets us call it dead. Our own rows get the
+    full pid + create-time check (pid reuse fencing)."""
     if record.controller_pid is None:
         return False
     if record.controller_cluster:
@@ -186,10 +271,17 @@ def _controller_alive_for(record, queue_cache=None) -> bool:
         return controller_liveness.cluster_job_alive(
             record.controller_cluster, record.controller_pid,
             queue_cache)
-    return psutil.pid_exists(record.controller_pid)
+    owner = record.controller_server_id
+    me = server_id or _my_server_id()
+    if owner is not None and owner != me:
+        return _owner_is_live(owner, owner_cache)
+    if not psutil.pid_exists(record.controller_pid):
+        return False
+    return _same_local_process(record.controller_pid,
+                               record.controller_pid_created)
 
 
-def _kill_controller(record) -> None:
+def _kill_controller(record, server_id: Optional[str] = None) -> None:
     """Stop the controller wherever it runs (purge path)."""
     if record.controller_pid is None:
         return
@@ -201,6 +293,18 @@ def _kill_controller(record) -> None:
         except exceptions.SkytError:
             pass
     else:
+        owner = record.controller_server_id
+        me = server_id or _my_server_id()
+        if owner is not None and owner != me:
+            # The pid belongs to ANOTHER replica's host — killing it
+            # here would hit an unrelated local process. The
+            # shutdown_requested flag (already set by down()) makes the
+            # real controller exit on its next tick.
+            logger.info(
+                'Service %s: controller pid %s is owned by replica %s; '
+                'leaving shutdown to its own poll loop.',
+                record.name, record.controller_pid, owner)
+            return
         subprocess_utils.kill_process_tree(record.controller_pid)
 
 
@@ -220,7 +324,31 @@ def down(service_name: str, purge: bool = False) -> None:
     # launch replacement replicas after we list, leaking clusters whose
     # rows we are about to delete.
     if controller_alive:
-        _kill_controller(record)
+        owner = record.controller_server_id
+        me = _my_server_id()
+        if (record.controller_cluster is None and owner is not None
+                and owner != me):
+            # A peer replica's host-local pid: we can't kill it, but
+            # the live controller sees the shutdown flag within one
+            # poll interval and tears down its own fleet (its last act
+            # removes the row). Purging underneath it instead would
+            # race its autoscaler — a mid-tick replica launch would
+            # outlive our row DELETE as a leaked cluster. Wait bounded;
+            # if the row persists the controller is gone/stuck and we
+            # take over the teardown.
+            poll = float(os.environ.get('SKYT_SERVE_CONTROLLER_POLL',
+                                        '10'))
+            deadline = time.time() + 2 * poll + 5
+            while time.time() < deadline:
+                if serve_state.get_service(service_name) is None:
+                    return
+                time.sleep(min(max(poll / 4, 0.1), 1.0))
+            logger.warning(
+                'Service %s: peer-owned controller (replica %s) did '
+                'not finish graceful shutdown in time; purging '
+                'directly.', service_name, owner)
+        else:
+            _kill_controller(record)
     from skypilot_tpu.backend.tpu_backend import TpuPodBackend
     backend = TpuPodBackend()
     for replica in serve_state.list_replicas(service_name,
@@ -314,14 +442,22 @@ def tail_logs(service_name: str,
                 f'status: {replica.status.value})\n')
 
 
-def _reap_dead_controllers() -> None:
+def _reap_dead_controllers(server_id: Optional[str] = None) -> None:
     """HA serve controllers (parity: the reference's HA controller
     recovery): a service whose controller died gets a REPLACEMENT
     controller — re-attached to the live replica fleet through the
     shared DB — up to ``serve.controller_max_restarts`` times; only
     past that budget is it CONTROLLER_FAILED. Run on status inspection
-    and by the server daemons."""
+    and by the server daemons.
+
+    Owner fencing (ADVICE r5 high): liveness of a LOCAL controller
+    spawned by a peer replica is judged by that replica's heartbeat,
+    never by its (host-local) pid — so a live controller is never
+    duplicated, and a heartbeat-stale one is taken over by exactly one
+    peer (claim_controller_restart's conditional UPDATE)."""
+    server_id = server_id or _my_server_id()
     queue_cache: dict = {}
+    owner_cache: dict = {}
     for record in serve_state.list_services():
         if record.status in (ServiceStatus.CONTROLLER_FAILED,):
             continue
@@ -340,14 +476,15 @@ def _reap_dead_controllers() -> None:
                     record.name)
             if claimed:
                 try:
-                    _spawn_controller(record.name)
+                    _spawn_controller(record.name, server_id)
                 except Exception as e:  # pylint: disable=broad-except
                     logger.error(
                         'Service %s: controller spawn failed (%s); '
                         'will retry after the claim grace period.',
                         record.name, e)
             continue
-        if _controller_alive_for(record, queue_cache):
+        if _controller_alive_for(record, queue_cache, server_id,
+                                 owner_cache):
             continue
         if record.status == ServiceStatus.SHUTTING_DOWN:
             # Controller exiting after shutdown is the happy path; its
@@ -365,7 +502,7 @@ def _reap_dead_controllers() -> None:
                 '(restart %d/%d).', record.name, record.controller_pid,
                 record.controller_restarts + 1, _controller_max_restarts())
             try:
-                _spawn_controller(record.name)
+                _spawn_controller(record.name, server_id)
             except Exception as e:  # pylint: disable=broad-except
                 logger.error(
                     'Service %s: replacement controller spawn failed '

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import fault_injection
+
 
 class ServiceStatus(enum.Enum):
     CONTROLLER_INIT = 'CONTROLLER_INIT'
@@ -82,7 +84,8 @@ def _db():
     from skypilot_tpu.utils import common_utils
 
     def init_schema(conn) -> None:
-        conn.execute('PRAGMA journal_mode=WAL')
+        from skypilot_tpu.utils import pg as _pg_lib
+        _pg_lib.enable_wal(conn)
         conn.executescript("""
             CREATE TABLE IF NOT EXISTS services (
                 name TEXT PRIMARY KEY,
@@ -134,6 +137,21 @@ def _db():
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE services ADD COLUMN '
                 'controller_claimed_at REAL')
+        if 'controller_server_id' not in cols:
+            # Owner fencing for HA replicas (ADVICE r5 high): pids are
+            # host-local, so only the replica that spawned a LOCAL
+            # controller may judge its pid; peers take over solely via
+            # the owner's heartbeat going stale (serve/core.py).
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN '
+                'controller_server_id TEXT')
+        if 'controller_pid_created' not in cols:
+            # Process start time disambiguates pid reuse (container
+            # restarts reset the pid namespace) — same fence as
+            # requests.pid_created.
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN '
+                'controller_pid_created REAL')
         conn.commit()
 
     os.makedirs(serve_dir(), exist_ok=True)
@@ -162,6 +180,10 @@ class ServiceRecord:
         self.lb_host: Optional[str] = row['lb_host']
         self.controller_claimed_at: Optional[float] = (
             row['controller_claimed_at'])
+        self.controller_server_id: Optional[str] = (
+            row['controller_server_id'])
+        self.controller_pid_created: Optional[float] = (
+            row['controller_pid_created'])
 
     @property
     def endpoint(self) -> Optional[str]:
@@ -211,6 +233,8 @@ def get_service(name: str) -> Optional[ServiceRecord]:
 
 
 def list_services() -> List[ServiceRecord]:
+    # Chaos hook: the serve-refresh daemon's first read each tick.
+    fault_injection.inject('serve_state.list_services')
     rows = _db().execute('SELECT * FROM services ORDER BY name').fetchall()
     return [ServiceRecord(r) for r in rows]
 
@@ -238,15 +262,22 @@ def set_service_spec(name: str, spec: Dict[str, Any]) -> None:
 
 
 def set_controller_pid(name: str, pid: int,
-                       controller_cluster: Optional[str] = None) -> None:
+                       controller_cluster: Optional[str] = None,
+                       server_id: Optional[str] = None,
+                       pid_created: Optional[float] = None) -> None:
     """Record where this service's controller runs: a local pid
     (controller_cluster None) or a job id ON the named controller
-    cluster (offload mode)."""
+    cluster (offload mode). For local controllers, ``server_id`` stamps
+    the spawning replica and ``pid_created`` the process start time —
+    the fences that keep a PEER replica from pid-judging (host-local!)
+    or a recycled pid from reading as alive."""
+    fault_injection.inject('serve_state.set_controller_pid')
     conn = _db()
     conn.execute(
         'UPDATE services SET controller_pid = ?, '
-        'controller_cluster = ? WHERE name = ?',
-        (pid, controller_cluster, name))
+        'controller_cluster = ?, controller_server_id = ?, '
+        'controller_pid_created = ? WHERE name = ?',
+        (pid, controller_cluster, server_id, pid_created, name))
     conn.commit()
 
 
@@ -277,6 +308,7 @@ def claim_controller_restart(name: str, dead_pid: int,
     cur = conn.execute(
         'UPDATE services SET controller_restarts = '
         'controller_restarts + 1, controller_pid = NULL, '
+        'controller_server_id = NULL, controller_pid_created = NULL, '
         'controller_claimed_at = ? '
         'WHERE name = ? AND controller_pid = ? '
         'AND controller_restarts < ?',
@@ -330,10 +362,15 @@ def request_shutdown(name: str) -> None:
 
 
 def shutdown_requested(name: str) -> bool:
+    """A MISSING row also reads as shutdown: `down --purge` through a
+    replica that doesn't own the controller can't kill the (host-local)
+    pid and deletes the service row instead — the controller must treat
+    the disappearance as its exit signal or it outlives its service
+    and keeps autoscaling replica clusters for a deleted row."""
     row = _db().execute(
         'SELECT shutdown_requested FROM services WHERE name = ?',
         (name,)).fetchone()
-    return bool(row and row['shutdown_requested'])
+    return row is None or bool(row['shutdown_requested'])
 
 
 def remove_service(name: str) -> None:

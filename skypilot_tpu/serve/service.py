@@ -32,7 +32,15 @@ def run_service(service_name: str) -> None:
     if not os.environ.get('SKYT_SERVE_ON_CLUSTER'):
         # Offloaded controllers are identified by their cluster job id,
         # recorded by the spawner — the remote pid must not clobber it.
-        serve_state.set_controller_pid(service_name, os.getpid())
+        # Re-stamp the owner fence too (SKYT_SERVER_ID is inherited
+        # from the spawning replica): this write must not erase the
+        # server_id/create-time that keep peer replicas from
+        # pid-judging this host-local pid.
+        from skypilot_tpu.serve import core as serve_core
+        serve_state.set_controller_pid(
+            service_name, os.getpid(),
+            server_id=os.environ.get('SKYT_SERVER_ID') or None,
+            pid_created=serve_core._pid_create_time(os.getpid()))  # pylint: disable=protected-access
 
     server = None
     lb = None

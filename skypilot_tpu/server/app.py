@@ -560,12 +560,21 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
             # probe for each other's content existence).
             digest = hasher.hexdigest()
             claimed = self.headers.get('X-Skyt-Digest')
+            legacy_alias = None
             if (claimed and len(claimed) == 16 and
                     digest.startswith(claimed)):
-                # Pre-upgrade client claiming the legacy truncated
-                # form of the same content: store under the short
-                # address it will probe next time.
-                digest = claimed
+                # Pre-upgrade client claiming the legacy truncated form
+                # of the same content. Store under the FULL digest (no
+                # new objects accumulate in the 64-bit address space —
+                # ADVICE r5 low) with a short-form alias so the
+                # client's next probe on its truncated digest still
+                # hits.
+                logger.warning(
+                    'Deprecated 16-char X-Skyt-Digest %s accepted '
+                    '(client %s); upgrade the client — truncated '
+                    'digests will be rejected in a future release.',
+                    claimed, self.client_address[0])
+                legacy_alias = claimed
                 claimed = None
             if claimed and claimed != digest:
                 self._error(HTTPStatus.BAD_REQUEST,
@@ -585,6 +594,16 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                     # content is identical (content-addressed), so
                     # theirs is fine.
                     shutil.rmtree(tmp, ignore_errors=True)
+            if legacy_alias is not None:
+                # Relative symlink: the probe path (os.path.isdir
+                # follows links) and any payload resolving the short
+                # token both land on the full-digest object.
+                alias_path = os.path.join(_uploads_dir(), legacy_alias)
+                if not os.path.lexists(alias_path):
+                    try:
+                        os.symlink(digest, alias_path)
+                    except OSError:
+                        pass  # concurrent identical upload linked first
         finally:
             try:
                 os.remove(spool)
@@ -632,11 +651,25 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 self._handle_upload_probe(route[len('/upload/'):])
             elif route == '/api/health':
                 from skypilot_tpu.server import versions
-                self._reply({
+                body = {
                     'status': 'healthy',
                     'version': skypilot_tpu.__version__,
                     'api_version': versions.API_VERSION,
-                })
+                }
+                # Control-plane supervision surface: a replica whose
+                # spawner loop is dead/crash-looping accepts requests
+                # it will never execute — operators (and the chaos
+                # tests) see restart counts + last errors here.
+                app = getattr(self.server, 'skyt_app', None)
+                if app is not None:
+                    executor_health = app.executor.health()
+                    body['server_id'] = app.server_id
+                    body['executor'] = executor_health
+                    body['daemons'] = [d.health() for d in app.daemons]
+                    if not executor_health['alive'] or any(
+                            not d['alive'] for d in body['daemons']):
+                        body['status'] = 'degraded'
+                self._reply(body)
             elif route == '/api/users':
                 self._reply([u.to_dict() for u in users_db.list_users()])
             elif route == '/api/workspaces':
@@ -915,6 +948,7 @@ class ApiServer:
                 logger.warning('channel broker disabled: %s', e)
                 self.broker = None
         self.httpd.skyt_server_id = self.server_id
+        self.httpd.skyt_app = self
         self.executor = executor_lib.Executor(
             server_id=self.server_id,
             broker_sock=self.broker.sock_path if self.broker else None)

@@ -16,15 +16,21 @@ import functools
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import log, resilience
 
 logger = log.init_logger(__name__)
 
 
 class Daemon:
-    """One periodic reconciliation loop (daemon thread)."""
+    """One periodic reconciliation loop (supervised daemon thread).
+
+    Two defense layers (utils/resilience.py): the tick body is guarded
+    in-loop, and the loop itself runs under a SupervisedThread so an
+    exception escaping anywhere else (interval lookup, metrics) restarts
+    the loop with backoff instead of silently disabling reconciliation
+    until the server restarts. ``health()`` feeds /api/health."""
 
     def __init__(self, name: str, interval_fn: Callable[[], float],
                  tick: Callable[[], None]) -> None:
@@ -32,23 +38,38 @@ class Daemon:
         self._interval_fn = interval_fn
         self._tick = tick
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._supervisor: Optional[resilience.SupervisedThread] = None
         self.ticks = 0            # observable for tests/metrics
         self.last_error: Optional[str] = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run,
-                                        name=f'daemon-{self.name}',
-                                        daemon=True)
-        self._thread.start()
+        self._supervisor = resilience.supervised_thread(
+            self._run, name=f'daemon-{self.name}',
+            restart_backoff=(0.2, 30.0), stop_event=self._stop)
+        self._supervisor.start()
+
+    @property
+    def restarts(self) -> int:
+        return self._supervisor.restarts if self._supervisor else 0
+
+    def health(self) -> dict:
+        supervisor = self._supervisor
+        return {
+            'name': self.name,
+            'alive': bool(supervisor and supervisor.is_alive()),
+            'ticks': self.ticks,
+            'restarts': supervisor.restarts if supervisor else 0,
+            'last_error': self.last_error or (
+                supervisor.last_error if supervisor else None),
+        }
 
     def stop(self, join_timeout: float = 5.0) -> None:
         """Signal the loop and wait for an in-flight tick to finish --
         callers (test teardown) reset DBs right after shutdown and a
         mid-flight tick would race them."""
         self._stop.set()
-        if self._thread is not None and self._thread.is_alive():
-            self._thread.join(timeout=join_timeout)
+        if self._supervisor is not None:
+            self._supervisor.stop(join_timeout=join_timeout)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -65,7 +86,14 @@ class Daemon:
             self.ticks += 1
             from skypilot_tpu.server import metrics
             metrics.DAEMON_TICKS.inc(daemon=self.name)
-            self._stop.wait(self._interval_fn())
+            try:
+                interval = float(self._interval_fn())
+            except Exception as e:  # pylint: disable=broad-except
+                # A config-read blip must not kill the cadence source.
+                logger.warning('daemon %s interval lookup failed: %s',
+                               self.name, e)
+                interval = 5.0
+            self._stop.wait(interval)
 
 
 def _cluster_refresh_tick() -> None:
@@ -89,19 +117,14 @@ def _jobs_refresh_tick() -> None:
     log_gc.collect()
 
 
-def _serve_refresh_tick() -> None:
+def _serve_refresh_tick(server_id: Optional[str] = None) -> None:
     """Reap dead serve controllers (HA replacement spawn) without
-    waiting for a client to ask for `serve status`."""
+    waiting for a client to ask for `serve status`. The replica
+    identity scopes pid-liveness judgments to rows this replica
+    spawned (serve/core.py owner fencing)."""
     from skypilot_tpu.serve import core as serve_core
-    serve_core._reap_dead_controllers()  # pylint: disable=protected-access
-
-
-# When this replica's LAST beat write failed, it must not judge peers:
-# a shared-DB outage makes every beat stale at once, and replicas that
-# requeue on recovery would double-execute each other's live work. The
-# tick only reaps after its own view of the DB has been continuously
-# healthy for a full stale window (any live peer beats within it).
-_ha_healthy_since: Dict[str, float] = {}
+    serve_core._reap_dead_controllers(  # pylint: disable=protected-access
+        server_id=server_id)
 
 
 def _requests_ha_tick(server_id: str) -> None:
@@ -109,20 +132,23 @@ def _requests_ha_tick(server_id: str) -> None:
     replicas whose heartbeat went stale (HA: any replica finishes any
     poll; see requests_db module docstring). Stale threshold must
     comfortably exceed the tick interval so a busy-but-alive replica is
-    never declared dead."""
-    from skypilot_tpu import config
+    never declared dead.
+
+    Requeue is gated on the shared self-DB-health window
+    (requests_db.note_db_health): when this replica's LAST beat write
+    failed, it must not judge peers — a shared-DB outage makes every
+    beat stale at once, and replicas that requeue on recovery would
+    double-execute each other's live work."""
     from skypilot_tpu.server import requests_db
+    health_key = f'ha:{server_id}'
     try:
         requests_db.beat(server_id)
     except Exception:
-        _ha_healthy_since.pop(server_id, None)
+        requests_db.note_db_health(health_key, False)
         raise
-    now = time.time()
-    healthy_since = _ha_healthy_since.setdefault(server_id, now)
-    stale_after = float(
-        os.environ.get('SKYT_SERVER_STALE_S')
-        or config.get_nested(('api_server', 'server_stale_seconds'), 15.0))
-    if now - healthy_since < stale_after:
+    requests_db.note_db_health(health_key, True)
+    stale_after = requests_db.default_stale_seconds()
+    if not requests_db.db_healthy_window_elapsed(health_key, stale_after):
         # Not yet one full stale window of continuous DB health from
         # our side — a live peer may simply not have gotten its beat
         # through yet (shared-DB outage, or we just booted mid-blip).
@@ -276,7 +302,7 @@ def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
                _jobs_refresh_tick),
         Daemon('serve-refresh',
                _interval('serve_refresh_interval', 30.0),
-               _serve_refresh_tick),
+               functools.partial(_serve_refresh_tick, server_id)),
         Daemon('log-shipper',
                _interval('log_ship_interval', 60.0),
                _log_ship_tick),

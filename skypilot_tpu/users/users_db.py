@@ -52,7 +52,8 @@ def _db() -> sqlite3.Connection:
     os.makedirs(_state_dir(), exist_ok=True)
     conn = sqlite3.connect(path, timeout=10)
     conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
+    from skypilot_tpu.utils import pg as _pg_lib
+    _pg_lib.enable_wal(conn)
     conn.executescript("""
         CREATE TABLE IF NOT EXISTS users (
             name TEXT PRIMARY KEY,

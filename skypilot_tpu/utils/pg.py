@@ -504,9 +504,24 @@ class PgSqliteAdapter:
                          id_column: str) -> int:
         """INSERT returning the new row id (sqlite callers use
         cursor.lastrowid, which the wire protocol has no analog for)."""
-        row = self.execute(f'{sql} RETURNING {id_column}',
-                           params).fetchone()
-        return int(row[id_column])
+        try:
+            row = self.execute(f'{sql} RETURNING {id_column}',
+                               params).fetchone()
+            return int(row[id_column])
+        except PgError as e:
+            if 'returning' not in str(e).lower():
+                raise
+            # The server under the wire protocol can't parse RETURNING
+            # — an sqlite(<3.35)-backed Postgres stand-in (tests/
+            # fake_pg.py). The syntax error aborted the whole INSERT,
+            # so re-running it plainly is safe, and the stand-in
+            # serializes every statement on ONE sqlite connection, so
+            # last_insert_rowid() is its insert id. Real Postgres
+            # parses RETURNING and never reaches this path.
+            self.execute(sql, params)
+            row = self.execute(
+                'SELECT last_insert_rowid() AS rid').fetchone()
+            return int(row['rid'])
 
     def commit(self) -> None:
         # Outside an explicit BEGIN, simple-protocol statements
@@ -521,6 +536,19 @@ class PgSqliteAdapter:
 
     def close(self) -> None:
         self._conn.close()
+
+
+def enable_wal(conn) -> None:
+    """Best-effort ``PRAGMA journal_mode=WAL`` for init_schema bodies.
+    No-op through the PG adapter (the PRAGMA is translated away);
+    on sqlite a concurrent writer makes the mode switch raise
+    'database is locked' WITHOUT honoring the busy timeout — and WAL
+    is persistent per-file, so a failed re-apply is harmless."""
+    import sqlite3
+    try:
+        conn.execute('PRAGMA journal_mode=WAL')
+    except sqlite3.OperationalError:
+        pass
 
 
 def connect_dual_backend(local, ready_set, *, url, sqlite_path,
@@ -558,7 +586,16 @@ def connect_dual_backend(local, ready_set, *, url, sqlite_path,
         os.makedirs(os.path.dirname(sqlite_path), exist_ok=True)
         conn = sqlite3.connect(sqlite_path, timeout=10)
         conn.row_factory = sqlite3.Row
-        conn.execute('PRAGMA journal_mode=WAL')
+        try:
+            conn.execute('PRAGMA journal_mode=WAL')
+        except sqlite3.OperationalError:
+            # Switching journal modes needs a quiescent DB and does NOT
+            # honor the busy timeout — a concurrent writer (another
+            # thread's executor/daemon tick) makes this raise 'database
+            # is locked' spuriously. WAL is persistent per-file: the
+            # connection that created the file already set it, so a
+            # failed re-apply is harmless.
+            pass
         init_schema(conn)
         conn.commit()
     local.conn = conn

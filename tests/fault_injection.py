@@ -1,0 +1,49 @@
+"""Test helper for the SKYT_FAULT_SPEC deterministic fault layer.
+
+Not a test module — imported by the chaos suites (like chaos_proxy.py).
+Wraps env setup + state reset so a test reads::
+
+    with inject_faults('requests_db.claim:OperationalError:p=0.5:seed=7'):
+        ...exercise the control plane...
+
+The spec travels through the environment, so every process the control
+plane spawns under the ``with`` (executor runners, request children,
+serve controllers) injects the same faults deterministically.
+"""
+import contextlib
+import os
+
+from skypilot_tpu.utils import fault_injection
+
+
+def clause(site: str, exc: str = 'OperationalError', *, p: float = 1.0,
+           seed: int = 0, times=None) -> str:
+    """Compose one well-formed spec clause (validated at parse time)."""
+    spec = f'{site}:{exc}'
+    if p != 1.0:
+        spec += f':p={p}'
+    if seed:
+        spec += f':seed={seed}'
+    if times is not None:
+        spec += f':times={times}'
+    return spec
+
+
+@contextlib.contextmanager
+def inject_faults(*clauses: str):
+    """Activate a fault spec for the duration of the block, resetting
+    RNG/budget state on entry and exit so specs never bleed between
+    tests. Clauses are joined with commas (one spec)."""
+    spec = ','.join(clauses)
+    fault_injection.parse_spec(spec)  # fail fast on typos
+    previous = os.environ.get(fault_injection.SPEC_ENV)
+    os.environ[fault_injection.SPEC_ENV] = spec
+    fault_injection.reset()
+    try:
+        yield
+    finally:
+        if previous is None:
+            os.environ.pop(fault_injection.SPEC_ENV, None)
+        else:
+            os.environ[fault_injection.SPEC_ENV] = previous
+        fault_injection.reset()

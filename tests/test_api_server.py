@@ -148,3 +148,40 @@ def test_serve_endpoints_roundtrip(server):
     # Unknown service errors propagate through the executor.
     with pytest.raises(exceptions.RequestFailedError):
         sdk.get(sdk.serve_down('nope'))
+
+
+def test_legacy_truncated_digest_upload_aliased(server, tmp_path):
+    """Upload back-compat (ADVICE r5 low): a pre-upgrade client
+    claiming the 16-char X-Skyt-Digest gets its content stored under
+    the FULL digest (no new objects accumulate in the legacy 64-bit
+    address space) with a short-form alias, so its next probe on the
+    truncated digest still hits."""
+    import hashlib
+    import json
+    import tarfile
+    import urllib.request
+    workdir = tmp_path / 'legacy'
+    workdir.mkdir()
+    (workdir / 'f.txt').write_text('legacy-content')
+    tar_path = tmp_path / 'w.tar.gz'
+    with tarfile.open(tar_path, 'w:gz') as tar:
+        tar.add(workdir, arcname='.')
+    body = tar_path.read_bytes()
+    digest = hashlib.sha256(body).hexdigest()
+    req = urllib.request.Request(
+        f'{server.url}/upload', data=body, method='POST',
+        headers={'X-Skyt-Digest': digest[:16]})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        reply = json.loads(resp.read())
+    assert reply['workdir_token'] == digest
+    assert reply['path'].endswith(digest)
+    assert os.path.isdir(reply['path'])
+    # The legacy short probe resolves through the alias...
+    with urllib.request.urlopen(
+            f'{server.url}/upload/{digest[:16]}', timeout=10) as resp:
+        probe = json.loads(resp.read())
+    assert probe['exists']
+    # ...as does the full-digest probe.
+    with urllib.request.urlopen(
+            f'{server.url}/upload/{digest}', timeout=10) as resp:
+        assert json.loads(resp.read())['exists']

@@ -1,0 +1,372 @@
+"""Control-plane chaos tests (deterministic fault injection).
+
+The ISSUE-2 acceptance scenarios: the executor spawner survives
+injected sqlite locks and a killed thread; runners absorb mid-claim DB
+faults; a peer replica's serve reaper never duplicates a LIVE
+controller and takes over a heartbeat-stale one exactly once; the HA
+requeue never steals work from a replica that never heartbeated.
+
+Faults ride SKYT_FAULT_SPEC (utils/fault_injection.py) through the
+environment into every spawned process; specs are seeded so every run
+takes the same fault sequence. All tests are fast (<10s) and run in the
+tier-1 `-m 'not slow'` selection.
+"""
+import time
+
+import pytest
+
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.server import daemons as daemons_lib
+from skypilot_tpu.server import executor as executor_lib
+from skypilot_tpu.server import requests_db
+
+from fault_injection import clause, inject_faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture()
+def clean_db(tmp_home):
+    requests_db.reset_db_for_tests()
+    yield
+    requests_db.reset_db_for_tests()
+
+
+def _drain(request_ids, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        records = [requests_db.get(r) for r in request_ids]
+        if all(r and r.status.is_terminal() for r in records):
+            return records
+        time.sleep(0.1)
+    raise AssertionError(
+        'requests did not drain: '
+        + str([(r.request_id, r.status.value)
+               for r in (requests_db.get(i) for i in request_ids) if r]))
+
+
+# -- executor: DB faults mid-claim -------------------------------------
+
+
+def test_runners_survive_db_faults_mid_claim(clean_db):
+    """Half of all claim attempts (seeded) raise OperationalError in the
+    runner processes; the bounded in-runner retry keeps the pool alive
+    and every request still completes."""
+    request_ids = [
+        requests_db.create('status', {}, requests_db.ScheduleType.SHORT)
+        for _ in range(4)]
+    executor = executor_lib.Executor(server_id='chaos-a')
+    with inject_faults(
+            clause('requests_db.claim', p=0.5, seed=7, times=20)):
+        executor.start()
+        try:
+            records = _drain(request_ids)
+            assert all(
+                r.status == requests_db.RequestStatus.SUCCEEDED
+                for r in records)
+        finally:
+            executor.shutdown()
+
+
+def test_killed_spawner_thread_is_resurrected(clean_db):
+    """Kill the spawner loop outright (an exception outside the guarded
+    tick body): the SupervisedThread restarts it and scheduling
+    resumes — the r5 failure mode can no longer be permanent."""
+    executor = executor_lib.Executor(server_id='chaos-b')
+    real_wait = executor._stop.wait  # noqa: SLF001
+    state = {'killed': False}
+
+    def dying_wait(timeout=None):
+        if not state['killed']:
+            state['killed'] = True
+            raise RuntimeError('spawner thread killed by test')
+        return real_wait(timeout)
+
+    executor._stop.wait = dying_wait  # noqa: SLF001
+    executor.start()
+    try:
+        request_id = requests_db.create('status', {},
+                                        requests_db.ScheduleType.SHORT)
+        records = _drain([request_id])
+        assert records[0].status == requests_db.RequestStatus.SUCCEEDED
+        health = executor.health()
+        assert health['alive']
+        assert health['restarts'] >= 1, (
+            'the loop was never killed — vacuous test')
+    finally:
+        executor._stop.wait = real_wait  # noqa: SLF001
+        executor.shutdown()
+
+
+# -- HA requeue fencing ------------------------------------------------
+
+
+def test_requeue_skips_owner_that_never_heartbeated(clean_db):
+    """Heartbeat staleness proves nothing about a replica that never
+    beat (daemons disabled / first instants of life): its RUNNING rows
+    must not be stolen (ADVICE r5 medium)."""
+    request_id = requests_db.create('status', {},
+                                    requests_db.ScheduleType.SHORT)
+    claimed = requests_db.claim_next(requests_db.ScheduleType.SHORT,
+                                     'ghost-replica')
+    assert claimed.request_id == request_id
+    requests_db.beat('replica-b')
+    assert requests_db.requeue_dead_server_requests(
+        'replica-b', stale_after=0.0) == (0, 0)
+    record = requests_db.get(request_id)
+    assert record.status == requests_db.RequestStatus.RUNNING
+    assert record.server_id == 'ghost-replica'
+    # Once the owner HAS beaten and then gone stale, requeue proceeds.
+    requests_db.beat('ghost-replica')
+    time.sleep(0.05)
+    assert requests_db.requeue_dead_server_requests(
+        'replica-b', stale_after=0.01) == (1, 0)
+    assert requests_db.get(request_id).status == (
+        requests_db.RequestStatus.PENDING)
+
+
+def test_partitioned_replica_beat_failures_dont_kill_ha_daemon(clean_db):
+    """Partition this replica from the heartbeat table (every beat
+    raises for a while): the requests-ha daemon keeps running, surfaces
+    the error, and resumes beating once the partition heals."""
+    import functools
+    daemon = daemons_lib.Daemon(
+        'requests-ha', lambda: 0.05,
+        functools.partial(
+            daemons_lib._requests_ha_tick, 'replica-p'))  # noqa: SLF001
+    with inject_faults(clause('requests_db.beat', times=3)):
+        daemon.start()
+        try:
+            deadline = time.time() + 10
+            saw_error = False
+            while time.time() < deadline:
+                if daemon.last_error:
+                    saw_error = True
+                if (saw_error and
+                        'replica-p' in requests_db.live_server_ids(60)):
+                    break
+                time.sleep(0.05)
+            assert saw_error, 'beat fault never surfaced on the daemon'
+            assert 'replica-p' in requests_db.live_server_ids(60), (
+                'beats never resumed after the partition healed')
+            health = daemon.health()
+            assert health['alive'] and health['ticks'] >= 3
+        finally:
+            daemon.stop()
+
+
+# -- serve controller owner fencing ------------------------------------
+
+
+def _add_service(name, pid, owner, pid_created=1000.0):
+    assert serve_state.add_service(name, {}, {}, lb_port=18080)
+    serve_state.set_controller_pid(name, pid, server_id=owner,
+                                   pid_created=pid_created)
+
+
+def test_peer_reaper_never_duplicates_live_controller(
+        clean_db, monkeypatch):
+    """A controller row stamped by replica-a whose pid does not exist on
+    OUR host: with a fresh heartbeat from replica-a the peer reaper
+    must treat it as alive (pids are host-local) — no duplicate spawn,
+    ever."""
+    monkeypatch.setenv('SKYT_SERVER_STALE_S', '30')
+    _add_service('svc-live', pid=999999, owner='replica-a')
+    requests_db.beat('replica-a')
+    spawns = []
+    monkeypatch.setattr(
+        serve_core, '_spawn_controller',
+        lambda name, server_id=None: spawns.append((name, server_id)))
+    for _ in range(3):
+        serve_core._reap_dead_controllers(  # noqa: SLF001
+            server_id='replica-b')
+    assert spawns == []
+    record = serve_state.get_service('svc-live')
+    assert record.controller_pid == 999999
+    assert record.controller_restarts == 0
+
+
+def test_never_heartbeated_owner_is_not_pid_judged(
+        clean_db, monkeypatch):
+    """An owner that never heartbeated is treated as live — same
+    conservative stance as the requests requeue."""
+    monkeypatch.setenv('SKYT_SERVER_STALE_S', '0.01')
+    _add_service('svc-ghost', pid=999999, owner='ghost-replica')
+    spawns = []
+    monkeypatch.setattr(
+        serve_core, '_spawn_controller',
+        lambda name, server_id=None: spawns.append((name, server_id)))
+    serve_core._reap_dead_controllers(server_id='replica-b')  # noqa: SLF001
+    assert spawns == []
+
+
+def test_stale_owner_taken_over_exactly_once(clean_db, monkeypatch):
+    """Once replica-a's heartbeat goes stale, concurrent peer reapers
+    (replica-b, replica-c) race claim_controller_restart — exactly one
+    wins and spawns the replacement."""
+    monkeypatch.setenv('SKYT_SERVER_STALE_S', '0.2')
+    _add_service('svc-stale', pid=999999, owner='replica-a')
+    requests_db.beat('replica-a')
+    spawns = []
+    monkeypatch.setattr(
+        serve_core, '_spawn_controller',
+        lambda name, server_id=None: spawns.append((name, server_id)))
+    # Prime the reaper's self-DB-health window (a fresh process must
+    # observe a full stale window of healthy heartbeat reads before it
+    # may judge peers): this reap sees replica-a live and spawns
+    # nothing.
+    serve_core._reap_dead_controllers(server_id='replica-b')  # noqa: SLF001
+    assert spawns == []
+    time.sleep(0.3)  # a goes stale
+    serve_core._reap_dead_controllers(server_id='replica-b')  # noqa: SLF001
+    serve_core._reap_dead_controllers(server_id='replica-c')  # noqa: SLF001
+    assert len(spawns) == 1, f'takeover not exactly-once: {spawns}'
+    assert spawns[0][0] == 'svc-stale'
+    record = serve_state.get_service('svc-stale')
+    assert record.controller_restarts == 1
+    assert record.controller_pid is None  # claimed; spawn was stubbed
+
+
+def test_own_row_with_recycled_pid_is_replaced(clean_db, monkeypatch):
+    """Our own controller row whose pid now names a DIFFERENT process
+    (create-time mismatch = pid reuse after container restart) is dead
+    — replaced despite the pid 'existing'."""
+    import os
+    monkeypatch.setenv('SKYT_SERVER_ID', 'replica-b')
+    # Our own live pid, but a create time from another era.
+    _add_service('svc-reuse', pid=os.getpid(), owner='replica-b',
+                 pid_created=123.0)
+    spawns = []
+    monkeypatch.setattr(
+        serve_core, '_spawn_controller',
+        lambda name, server_id=None: spawns.append((name, server_id)))
+    serve_core._reap_dead_controllers(server_id='replica-b')  # noqa: SLF001
+    assert spawns == [('svc-reuse', 'replica-b')]
+
+
+def test_own_live_controller_not_reaped(clean_db, monkeypatch):
+    """Sanity: our own row with OUR live pid and matching create time is
+    alive — no spawn."""
+    import os
+    import psutil
+    created = psutil.Process(os.getpid()).create_time()
+    _add_service('svc-mine', pid=os.getpid(), owner='replica-b',
+                 pid_created=created)
+    spawns = []
+    monkeypatch.setattr(
+        serve_core, '_spawn_controller',
+        lambda name, server_id=None: spawns.append((name, server_id)))
+    serve_core._reap_dead_controllers(server_id='replica-b')  # noqa: SLF001
+    assert spawns == []
+
+
+def test_serve_refresh_survives_injected_db_faults(clean_db):
+    """The serve-refresh daemon's tick hits an injected serve-DB fault:
+    the loop records it and keeps ticking."""
+    import functools
+    daemon = daemons_lib.Daemon(
+        'serve-refresh', lambda: 0.05,
+        functools.partial(
+            daemons_lib._serve_refresh_tick, 'replica-b'))  # noqa: SLF001
+    with inject_faults(clause('serve_state.list_services', times=2)):
+        daemon.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and daemon.ticks < 5:
+                time.sleep(0.05)
+            assert daemon.ticks >= 5
+            assert daemon.health()['alive']
+        finally:
+            daemon.stop()
+
+
+# -- health surface ----------------------------------------------------
+
+
+def test_api_health_exposes_supervision_state(clean_db, monkeypatch):
+    """/api/health carries per-loop supervision state: executor
+    alive/restarts and each daemon's ticks/restarts/last_error."""
+    import json
+    import urllib.request
+    from skypilot_tpu.server.app import ApiServer
+    from skypilot_tpu.provision import fake
+    fake.reset()
+    server = ApiServer(port=0, server_id='health-replica')
+    server.start_background()
+    try:
+        with urllib.request.urlopen(f'{server.url}/api/health',
+                                    timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body['server_id'] == 'health-replica'
+        assert body['executor']['alive'] is True
+        assert body['executor']['restarts'] == 0
+        names = {d['name'] for d in body['daemons']}
+        assert 'requests-ha' in names
+        assert all('restarts' in d and 'last_error' in d
+                   for d in body['daemons'])
+        assert body['status'] == 'healthy'
+    finally:
+        server.shutdown()
+        fake.reset()
+
+
+def test_deleted_service_row_reads_as_shutdown(clean_db):
+    """`down --purge` through a non-owning replica cannot kill the
+    (host-local) controller pid and deletes the service row instead —
+    the controller's shutdown poll must treat the missing row as its
+    exit signal, or it outlives the service and keeps autoscaling
+    clusters for a deleted row."""
+    assert serve_state.add_service('svc-purged', {}, {}, lb_port=18081)
+    assert not serve_state.shutdown_requested('svc-purged')
+    serve_state.remove_service('svc-purged')
+    assert serve_state.shutdown_requested('svc-purged')
+
+
+def test_superseded_controller_detection(clean_db, monkeypatch):
+    """A detached controller that outlives its replica's server process
+    must stand down once a replacement takes the row over (self-fence:
+    exactly one controller autoscales a fleet)."""
+    import os
+    from skypilot_tpu.serve.controller import ServeController
+    monkeypatch.delenv('SKYT_SERVE_ON_CLUSTER', raising=False)
+
+    class Row:
+        def __init__(self, pid, claimed_at=None):
+            self.controller_pid = pid
+            self.controller_claimed_at = claimed_at
+
+    # Replacement spawned -> row names a different pid: superseded.
+    assert ServeController._superseded(Row(os.getpid() + 1))  # noqa: SLF001
+    # Restart claimed but replacement not yet spawned: superseded.
+    assert ServeController._superseded(Row(None, claimed_at=123.0))  # noqa: SLF001
+    # Our own row (fresh start): not superseded.
+    assert not ServeController._superseded(Row(os.getpid()))  # noqa: SLF001
+    assert not ServeController._superseded(Row(None))  # noqa: SLF001
+    # Offloaded controllers are identified by cluster job id, not pid.
+    monkeypatch.setenv('SKYT_SERVE_ON_CLUSTER', '1')
+    assert not ServeController._superseded(Row(os.getpid() + 1))  # noqa: SLF001
+
+
+def test_heartbeat_purge_keeps_referenced_owners(clean_db):
+    """The heartbeat-row purge must keep rows still referenced by a
+    serve controller (or RUNNING request): both fencing paths read
+    absence-from-the-table as 'never heartbeated => treat as live', so
+    purging a referenced row would permanently invert a dead replica
+    into an unreapable live one."""
+    conn = requests_db._db()  # noqa: SLF001
+    old = time.time() - 700  # past the max(600, 10*stale) cutoff
+    for server_id in ('dead-ref', 'dead-unref'):
+        conn.execute(
+            'INSERT INTO server_heartbeats (server_id, last_beat) '
+            'VALUES (?, ?)', (server_id, old))
+    conn.commit()
+    # dead-ref is still named by a serve controller row.
+    assert serve_state.add_service('svc-ref', {}, {}, lb_port=18090)
+    serve_state.set_controller_pid('svc-ref', 4242,
+                                   server_id='dead-ref', pid_created=1.0)
+    requests_db.beat('me')
+    requests_db.requeue_dead_server_requests('me', stale_after=15.0)
+    known = requests_db.known_server_ids()
+    assert 'dead-ref' in known, 'referenced heartbeat row was purged'
+    assert 'dead-unref' not in known, 'unreferenced stale row kept'

@@ -130,16 +130,23 @@ def test_requeue_budget_exhaustion_fails_request(ha_env):
     claimed = requests_db.claim_next(requests_db.ScheduleType.SHORT,
                                      'replica-a')
     assert claimed.request_id == request_id
+    # Owners must have heartbeaten at least once for staleness to mean
+    # death (never-beat rows are skipped — see
+    # test_chaos_control_plane).
+    requests_db.beat('replica-a')
     requests_db.beat('replica-b')
+    time.sleep(0.05)
     # First death: requeued.
     assert requests_db.requeue_dead_server_requests(
-        'replica-b', stale_after=0.0) == (1, 0)
+        'replica-b', stale_after=0.01) == (1, 0)
     assert requests_db.get(request_id).status.value == 'PENDING'
     assert requests_db.get(request_id).requeues == 1
     # Second claim + second death: budget spent, FAILED.
     requests_db.claim_next(requests_db.ScheduleType.SHORT, 'replica-c')
+    requests_db.beat('replica-c')
+    time.sleep(0.05)
     assert requests_db.requeue_dead_server_requests(
-        'replica-b', stale_after=0.0) == (0, 1)
+        'replica-b', stale_after=0.01) == (0, 1)
     final = requests_db.get(request_id)
     assert final.status == requests_db.RequestStatus.FAILED
     assert 'died mid-request' in final.error
@@ -164,9 +171,11 @@ def test_stale_owner_finalize_is_fenced(ha_env):
     request_id = requests_db.create('status', {},
                                     requests_db.ScheduleType.SHORT)
     requests_db.claim_next(requests_db.ScheduleType.SHORT, 'replica-a')
+    requests_db.beat('replica-a')
     requests_db.beat('replica-b')
+    time.sleep(0.05)
     assert requests_db.requeue_dead_server_requests(
-        'replica-b', stale_after=0.0) == (1, 0)
+        'replica-b', stale_after=0.01) == (1, 0)
     # Peer reclaims.
     reclaimed = requests_db.claim_next(requests_db.ScheduleType.SHORT,
                                        'replica-b')

@@ -259,3 +259,44 @@ def test_oci_enabled_by_api_key(tmp_home, tmp_path, monkeypatch):
     check.clear_cache()
     ok, reason = check.check(['oci'])['oci']
     assert ok and 'credentials' in reason
+
+
+def test_list_instances_follows_pagination(fake):
+    """_list_instances must drain opc-next-page (ADVICE r5 low): a
+    large compartment splits listings across pages and a single-page
+    read would hide instances from stop/terminate."""
+    fake.run_instances(_request_for('oc7', num_nodes=3))
+    all_rows = list(fake.instances.values())
+    pages = {None: {'items': all_rows[:1], 'opc-next-page': 'p2'},
+             'p2': {'items': all_rows[1:2], 'opc-next-page': 'p3'},
+             'p3': {'items': all_rows[2:]}}
+    real_request = fake._request
+
+    def paged_request(method, region, path, body=None, params=None):
+        if path == '/instances/' and method == 'GET':
+            return pages[(params or {}).get('page')]
+        return real_request(method, region, path, body=body,
+                            params=params)
+
+    fake._request = paged_request
+    listed = fake._list_instances('oc7', 'us-ashburn-1')
+    assert len(listed) == 3
+    fake._request = real_request
+
+
+def test_wait_instances_requires_expected_count(fake):
+    """wait_instances with expected= must NOT succeed on a subset of
+    the requested nodes (partial POST loop / eventually-consistent
+    list)."""
+    fake.run_instances(_request_for('oc8', num_nodes=2))
+    # Hide one instance from listings: only 1 of 2 visible.
+    hidden_id, hidden = next(iter(fake.instances.items()))
+    del fake.instances[hidden_id]
+    with pytest.raises(TimeoutError) as err:
+        fake.wait_instances('oc8', 'running', timeout=0.3,
+                            region_hint='us-ashburn-1', expected=2)
+    assert '1/2' in str(err.value)
+    # Restored, the same wait succeeds.
+    fake.instances[hidden_id] = hidden
+    fake.wait_instances('oc8', 'running', timeout=5,
+                        region_hint='us-ashburn-1', expected=2)
