@@ -1,0 +1,205 @@
+"""Control-plane latency bench: poll-driven vs event-driven wakeups.
+
+Quantifies the notification bus (utils/events.py) against the
+poll-loop control plane it replaced, with the same loop shape the real
+executor uses (claim → run → finalize against server/requests_db):
+
+* ``submit→claimed`` / ``submit→running`` p50/p99 latency over N
+  requests — the poll path's floor is the poll interval; the event
+  path wakes on the create() notification.
+* idle load — heavy DB queries per second (claim attempts scanning the
+  requests table) while the queue is dry, plus the event path's cheap
+  ``PRAGMA data_version`` checks, reported separately so the trade is
+  visible, not hidden.
+
+Modes:
+
+* ``poll``        — SKYT_EVENTS_DISABLED=1; the legacy idle backoff
+                    (0.05 s → ×1.5 → 0.5 s cap) between claim attempts.
+* ``event``       — in-process bus + data_version signal, the executor
+                    spawner's configuration (submitter in-process).
+* ``event-xproc`` — cross-process simulation: the claimer is barred
+                    from the in-process bus and wakes ONLY via the
+                    sqlite data_version transport, the pool-runner /
+                    multi-replica configuration.
+
+CPU-only, no cloud or TPU access; one JSON document on stdout (wired
+into run_benches.sh → ``BENCH_control_plane_<suffix>.json``; measured
+numbers land in PERF.md and docs/control_plane_perf.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[idx]
+
+
+def _fresh_state(tag: str) -> None:
+    """Point every DB at a fresh temp dir and drop cached connections."""
+    root = tempfile.mkdtemp(prefix=f'skyt-bench-{tag}-')
+    os.environ['SKYT_STATE_DIR'] = root
+    os.environ['SKYT_SERVER_DIR'] = os.path.join(root, 'server')
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.utils import events
+    requests_db.reset_db_for_tests()
+    events.reset_for_tests()
+
+
+def run_mode(mode: str, submits: int, spacing: float, idle_seconds: float,
+             poll_cap: float) -> dict:
+    assert mode in ('poll', 'event', 'event-xproc'), mode
+    if mode == 'poll':
+        os.environ['SKYT_EVENTS_DISABLED'] = '1'
+    else:
+        os.environ.pop('SKYT_EVENTS_DISABLED', None)
+    _fresh_state(mode)
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.server.requests_db import RequestStatus, ScheduleType
+    from skypilot_tpu.utils import events
+
+    created = {}              # request_id -> create ts (monotonic)
+    claimed = {}              # request_id -> claim ts
+    running = {}              # request_id -> pid-recorded ts
+    counters = {'claims': 0}  # heavy queries (requests-table scans)
+    stop = threading.Event()
+    done = threading.Event()
+
+    # 'event-xproc' waits on a topic nothing in this process publishes,
+    # so only the data_version transport can wake it — the pool-runner
+    # situation. Seed the DB file so the signal has something to watch.
+    topic = events.REQUESTS if mode != 'event-xproc' else 'bench-xproc'
+    requests_db.pending_depth_by_queue()
+    signal = None
+    if mode != 'poll':
+        signal = requests_db.change_signal()
+
+    # The event path's fallback may relax (it is a degraded-mode bound,
+    # not the latency floor) — same 4x ratio as executor._idle_wait_cap.
+    idle_cap = poll_cap if mode == 'poll' else poll_cap * 4
+
+    def claimer() -> None:
+        idle_sleep = 0.05
+        cursor = events.cursor(topic)
+        while not stop.is_set():
+            counters['claims'] += 1
+            request = requests_db.claim_next(ScheduleType.SHORT)
+            if request is None:
+                if mode == 'poll':
+                    time.sleep(idle_sleep)
+                else:
+                    cursor, _ = events.wait_for(topic, cursor, idle_sleep,
+                                                external=signal,
+                                                stop_event=stop)
+                idle_sleep = min(idle_sleep * 1.5, idle_cap)
+                continue
+            idle_sleep = 0.05
+            now = time.monotonic()
+            claimed[request.request_id] = now
+            # Worker start: the pid write that flips the row to a
+            # runnable worker (the fork itself is out of scope — it
+            # costs the same on both paths).
+            requests_db.set_pid(request.request_id, os.getpid())
+            running[request.request_id] = time.monotonic()
+            requests_db.finalize(request.request_id,
+                                 RequestStatus.SUCCEEDED, {})
+            if len(claimed) >= submits:
+                done.set()
+
+    thread = threading.Thread(target=claimer, daemon=True)
+    thread.start()
+    for i in range(submits):
+        rid = requests_db.create(f'bench-{mode}', {'i': i},
+                                 ScheduleType.SHORT)
+        created[rid] = time.monotonic()
+        time.sleep(spacing)
+    done.wait(timeout=submits * (spacing + poll_cap) + 30)
+
+    # Idle window: queue dry, count heavy queries.
+    idle_start_claims = counters['claims']
+    wakeups_before = dict(events.wakeup_counts())
+    time.sleep(idle_seconds)
+    idle_claims = counters['claims'] - idle_start_claims
+    stop.set()
+    thread.join(timeout=5)
+
+    latency_claimed = [claimed[r] - created[r] for r in created
+                      if r in claimed]
+    latency_running = [running[r] - created[r] for r in created
+                      if r in running]
+    wakeups = {}
+    for (topic_name, source), count in events.wakeup_counts().items():
+        before = wakeups_before.get((topic_name, source), 0)
+        key = f'{topic_name}/{source}'
+        wakeups[key] = wakeups.get(key, 0) + (count - before)
+    return {
+        'mode': mode,
+        'requests': len(latency_claimed),
+        'submit_to_claimed_p50_ms': round(
+            1000 * _percentile(latency_claimed, 0.50), 2),
+        'submit_to_claimed_p99_ms': round(
+            1000 * _percentile(latency_claimed, 0.99), 2),
+        'submit_to_running_p50_ms': round(
+            1000 * _percentile(latency_running, 0.50), 2),
+        'submit_to_running_p99_ms': round(
+            1000 * _percentile(latency_running, 0.99), 2),
+        'idle_heavy_queries_per_sec': round(idle_claims / idle_seconds, 2),
+        'idle_wakeups_during_window': wakeups,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='control-plane poll-vs-event latency bench')
+    parser.add_argument('--submits', type=int, default=25)
+    parser.add_argument('--spacing', type=float, default=1.6,
+                        help='seconds between submissions — long enough '
+                             'for the idle backoff to reach its cap, so '
+                             'the poll mode is measured at its '
+                             'steady-state floor, not mid-backoff')
+    parser.add_argument('--idle-seconds', type=float, default=5.0)
+    parser.add_argument('--poll-cap', type=float, default=0.5,
+                        help='legacy idle-backoff cap (the poll floor)')
+    parser.add_argument('--modes', default='poll,event,event-xproc')
+    args = parser.parse_args(argv)
+    previous_disabled = os.environ.get('SKYT_EVENTS_DISABLED')
+    results = {'bench': 'control_plane', 'ts': time.time(),
+               'poll_cap_s': args.poll_cap, 'modes': {}}
+    try:
+        for mode in args.modes.split(','):
+            mode = mode.strip()
+            if not mode:
+                continue
+            print(f'... running mode {mode}', file=sys.stderr)
+            results['modes'][mode] = run_mode(
+                mode, args.submits, args.spacing, args.idle_seconds,
+                args.poll_cap)
+    finally:
+        if previous_disabled is None:
+            os.environ.pop('SKYT_EVENTS_DISABLED', None)
+        else:
+            os.environ['SKYT_EVENTS_DISABLED'] = previous_disabled
+    poll = results['modes'].get('poll')
+    event = results['modes'].get('event')
+    if poll and event and event['submit_to_claimed_p50_ms']:
+        results['event_speedup_p50'] = round(
+            poll['submit_to_claimed_p50_ms'] /
+            max(event['submit_to_claimed_p50_ms'], 0.01), 1)
+    json.dump(results, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

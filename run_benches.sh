@@ -18,6 +18,14 @@ run() {
   echo "rc=$? -> $out" >&2
 }
 
+# Control-plane latency bench first: CPU-only (no TPU/tunnel needed),
+# poll-vs-event submit->claimed/running p50/p99 + idle DB query rate
+# (docs/control_plane_perf.md; numbers land in PERF.md).
+echo "=== bench control-plane ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_control_plane.py \
+  | tee "BENCH_control_plane_${suffix}.json"
+echo "rc=$? -> BENCH_control_plane_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

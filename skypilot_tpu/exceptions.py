@@ -130,6 +130,12 @@ class ServiceNotFoundError(SkytError):
     """Named service is not in the serve DB."""
 
 
+class ServeEndpointUnknownError(ServeError):
+    """The controller cluster's head address can't be determined, so no
+    client-reachable endpoint can be advertised (a silent 127.0.0.1
+    fallback would publish an endpoint that routes nowhere)."""
+
+
 class ServiceAlreadyExistsError(SkytError):
     """`serve up` with a name that is already taken."""
 

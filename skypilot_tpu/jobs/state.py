@@ -20,6 +20,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import events
 
 
 class ManagedJobStatus(enum.Enum):
@@ -58,6 +59,16 @@ def jobs_dir() -> str:
 
 def controller_log_path(job_id: int) -> str:
     return os.path.join(jobs_dir(), 'logs', f'controller-{job_id}.log')
+
+
+def change_signal() -> 'events.ExternalSignal | None':
+    """Cross-process change signal for the managed-jobs table (the
+    server's jobs-refresh daemon wakes on submits/transitions made by
+    request children and controllers)."""
+    from skypilot_tpu import state as state_lib
+    return events.external_signal(
+        state_lib.db_url(), os.path.join(jobs_dir(), 'jobs.db'),
+        events.MANAGED_JOBS)
 
 
 _local = threading.local()
@@ -198,10 +209,16 @@ def submit(task_config: Dict[str, Any],
               strategy, max_restarts_on_errors, time.time(), group_name,
               workspaces.active_workspace())
     if getattr(conn, 'is_postgres', False):
-        return conn.insert_returning(sql, params, 'job_id')
-    cur = conn.execute(sql, params)
-    conn.commit()
-    return cur.lastrowid
+        job_id = conn.insert_returning(sql, params, 'job_id')
+    else:
+        cur = conn.execute(sql, params)
+        conn.commit()
+        job_id = cur.lastrowid
+    # Wake the server's managed-jobs daemon (another process): the
+    # WAITING job is claimed within milliseconds instead of the
+    # jobs_refresh_interval.
+    events.publish(events.MANAGED_JOBS, conn=conn)
+    return job_id
 
 
 def list_group(group_name: str) -> List['JobRecord']:
@@ -261,6 +278,8 @@ def set_status(job_id: int,
         f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ? '
         f'AND status NOT IN ({placeholders})', args + blocked)
     conn.commit()
+    if cur.rowcount == 1:
+        events.publish(events.MANAGED_JOBS, conn=conn)
     return cur.rowcount == 1
 
 
@@ -278,6 +297,9 @@ def request_cancel(job_id: int) -> bool:
         [ManagedJobStatus.CANCELLING.value, job_id] + terminal +
         [ManagedJobStatus.CANCELLING.value])
     conn.commit()
+    if cur.rowcount == 1:
+        # The controller's cancel check must see this promptly.
+        events.publish(events.MANAGED_JOBS, conn=conn)
     return cur.rowcount == 1
 
 
@@ -292,6 +314,7 @@ def set_schedule_state(job_id: int, schedule_state: ScheduleState) -> None:
     conn.execute('UPDATE jobs SET schedule_state = ? WHERE job_id = ?',
                  (schedule_state.value, job_id))
     conn.commit()
+    events.publish(events.MANAGED_JOBS, conn=conn)
 
 
 def claim_waiting_job(max_launching: int, max_alive: int) -> Optional[int]:

@@ -13,6 +13,7 @@ import dataclasses
 from typing import List, Optional, Sequence
 
 from skypilot_tpu import catalog, check, exceptions
+from skypilot_tpu.catalog import egress as egress_lib
 from skypilot_tpu.catalog.common import pick_cpu_instance_type
 from skypilot_tpu.spec.dag import Dag
 from skypilot_tpu.spec.resources import Resources
@@ -37,9 +38,14 @@ PLANNING_MFU_BY_GENERATION = {
 
 def planning_mfu(generation: Optional[str]) -> float:
     return PLANNING_MFU_BY_GENERATION.get(generation or '', PLANNING_MFU)
-# $/GB egress between regions (public GCP inter-region ballpark; parity:
-# sky/optimizer.py:75 + cloud egress tables).
-EGRESS_PRICE_PER_GB = 0.08
+
+
+# Legacy flat rate, kept as the unknown-pair fallback. Real edges are
+# priced per (source cloud, destination cloud) by catalog/egress.py —
+# cross-cloud edges ride the source's internet-egress tier, which is
+# NOT the intra-cloud inter-region rate (parity: sky/optimizer.py:75 +
+# cloud egress tables).
+EGRESS_PRICE_PER_GB = egress_lib.DEFAULT_EGRESS_PER_GB
 
 
 @dataclasses.dataclass
@@ -88,7 +94,12 @@ def _annotate_estimates(candidate: Candidate, task) -> Candidate:
         src_region = getattr(task, 'inputs_region', None)
         if inputs_gb and src_region and res.region and \
                 src_region != res.region:
-            candidate.egress_cost = inputs_gb * EGRESS_PRICE_PER_GB
+            # Inputs priced per cloud pair: an optional `inputs_cloud`
+            # hint names where the data lives; without it the inputs
+            # are assumed in-cloud (inter-region rate).
+            src_cloud = getattr(task, 'inputs_cloud', None) or res.cloud
+            candidate.egress_cost = inputs_gb * \
+                egress_lib.egress_price_per_gb(src_cloud, res.cloud)
     return candidate
 
 
@@ -206,7 +217,7 @@ def _edge_cost(parent: Task, parent_cand: Candidate,
     dst = (child_cand.resources.cloud, child_cand.resources.region)
     if src == dst:
         return 0.0
-    return gb * EGRESS_PRICE_PER_GB
+    return gb * egress_lib.egress_price_per_gb(src[0], dst[0])
 
 
 def _dag_edges(dag: Dag):

@@ -14,6 +14,8 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import events
+
 DEFAULT_RUNTIME_DIR = '~/.skyt_runtime'
 
 
@@ -68,6 +70,10 @@ def add_job(runtime_dir: str, name: Optional[str],
     conn.commit()
     job_id = cur.lastrowid
     conn.close()
+    # Wakes the channel server's table watcher (same process for
+    # channel-submitted jobs; the on-node daemon's writes reach it via
+    # the jobs.db data_version signal).
+    events.publish(events.RUNTIME_JOBS)
     return job_id
 
 
@@ -86,6 +92,7 @@ def set_status(runtime_dir: str, job_id: int, status: JobStatus,
                  (*updates.values(), job_id))
     conn.commit()
     conn.close()
+    events.publish(events.RUNTIME_JOBS)
 
 
 def set_pids(runtime_dir: str, job_id: int, pids: List[int]) -> None:

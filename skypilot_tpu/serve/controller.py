@@ -24,7 +24,7 @@ from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import events, log
 
 logger = log.init_logger(__name__)
 
@@ -211,7 +211,27 @@ class ServeController:
         self.manager.recover_inflight()
         from skypilot_tpu.utils import resilience
         error_delays = None
+        # Event-driven control writes: `down` / spec updates / purge
+        # deletes land in the serve DB from OTHER processes (API-server
+        # request children); the serve-topic signal wakes this loop in
+        # milliseconds to run the cheap control checks below. The full
+        # probe/autoscale pass (run_once) keeps its POLL_SECONDS
+        # cadence — probing replicas faster than the poll interval
+        # gains nothing and every run_once write would otherwise
+        # re-wake us into a hot loop.
+        signal = None
+        if events.enabled():
+            try:
+                signal = serve_state.change_signal()
+            except Exception:  # pylint: disable=broad-except
+                signal = None
+        cursor = events.cursor(events.SERVE)
+        next_probe = time.monotonic()  # first pass runs immediately
         while True:
+            # Snapshot BEFORE the control reads: a `down`/spec write
+            # landing mid-pass fires the wait instead of being adopted
+            # as the baseline.
+            ext_base = events.external_cursor(events.SERVE, signal)
             try:
                 # The shutdown check shares the guard: a transient
                 # serve-DB error here used to escape the loop and kill
@@ -237,10 +257,16 @@ class ServeController:
                         'standing down.', self.service_name,
                         record.controller_pid, os.getpid())
                     return
-                self.run_once()
+                if time.monotonic() >= next_probe:
+                    self.run_once()
+                    next_probe = time.monotonic() + POLL_SECONDS
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('Service %s: controller tick failed',
                                  self.service_name)
+                # A failed pass must not retry hot: push the next
+                # attempt a full poll interval out (matching the old
+                # sleep-per-iteration behavior).
+                next_probe = time.monotonic() + POLL_SECONDS
                 if isinstance(e, resilience.transient_db_errors()):
                     # Bounded extra (jittered) backoff on DB faults:
                     # don't hammer a locked/flapping store at the poll
@@ -251,7 +277,14 @@ class ServeController:
                     time.sleep(next(error_delays))
             else:
                 error_delays = None
-            time.sleep(POLL_SECONDS)
+            # Sleep until the next probe is due OR a serve-DB write
+            # wakes us early (shutdown/spec-change reaction in ms, with
+            # the probe cadence as the supervised fallback bound).
+            wait = max(0.05, next_probe - time.monotonic())
+            cursor, _ = events.wait_for(events.SERVE, cursor,
+                                        min(wait, POLL_SECONDS),
+                                        external=signal,
+                                        external_base=ext_base)
 
     @staticmethod
     def _superseded(record) -> bool:

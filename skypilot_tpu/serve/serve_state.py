@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import events
 from skypilot_tpu.utils import fault_injection
 
 
@@ -161,6 +162,16 @@ def _db():
         init_schema=init_schema)
 
 
+def change_signal() -> 'events.ExternalSignal | None':
+    """Cross-process change signal for the serve DB: the controller
+    process reacts to `down`/spec updates written by API-server request
+    children in milliseconds instead of a full poll interval."""
+    from skypilot_tpu import state as state_lib
+    return events.external_signal(
+        state_lib.db_url(), os.path.join(serve_dir(), 'serve.db'),
+        events.SERVE)
+
+
 # -- services ---------------------------------------------------------------
 
 
@@ -216,6 +227,7 @@ def add_service(name: str, spec: Dict[str, Any],
             (name, json.dumps(spec), json.dumps(task_config),
              ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
         conn.commit()
+        events.publish(events.SERVE, conn=conn)
         return True
     except sqlite3.IntegrityError:
         return False
@@ -250,6 +262,7 @@ def set_service_status(name: str, status: ServiceStatus,
         conn.execute('UPDATE services SET status = ? WHERE name = ?',
                      (status.value, name))
     conn.commit()
+    events.publish(events.SERVE, conn=conn)
 
 
 def set_service_spec(name: str, spec: Dict[str, Any]) -> None:
@@ -259,6 +272,9 @@ def set_service_spec(name: str, spec: Dict[str, Any]) -> None:
     conn.execute('UPDATE services SET spec = ? WHERE name = ?',
                  (json.dumps(spec), name))
     conn.commit()
+    # The controller hot-reloads the spec on this wakeup (pool resizes
+    # apply in milliseconds, not at the next poll tick).
+    events.publish(events.SERVE, conn=conn)
 
 
 def set_controller_pid(name: str, pid: int,
@@ -359,6 +375,8 @@ def request_shutdown(name: str) -> None:
         'UPDATE services SET shutdown_requested = 1, status = ? '
         'WHERE name = ?', (ServiceStatus.SHUTTING_DOWN.value, name))
     conn.commit()
+    # `serve down` starts tearing down NOW, not at the next poll tick.
+    events.publish(events.SERVE, conn=conn)
 
 
 def shutdown_requested(name: str) -> bool:
@@ -378,6 +396,8 @@ def remove_service(name: str) -> None:
     conn.execute('DELETE FROM replicas WHERE service_name = ?', (name,))
     conn.execute('DELETE FROM services WHERE name = ?', (name,))
     conn.commit()
+    # A deleted row is the purge-path exit signal for the controller.
+    events.publish(events.SERVE, conn=conn)
 
 
 # -- replicas ---------------------------------------------------------------

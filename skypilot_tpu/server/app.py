@@ -32,7 +32,7 @@ from skypilot_tpu.server import executor as executor_lib
 from skypilot_tpu.server import payloads, requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.users import rbac, users_db
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import events, log
 
 logger = log.init_logger(__name__)
 
@@ -71,6 +71,38 @@ class _StreamSlot:
     def __exit__(self, *args):
         if self.ok:
             _STREAM_SLOTS.release()
+
+
+# One shared requests-table change signal serves every /api/get
+# long-poll thread (a per-request signal would open one sqlite
+# connection per poller). Keyed by backend so tests that repoint
+# SKYT_SERVER_DIR / SKYT_DB_URL between ApiServer instances don't watch
+# a stale file. A FAILED build (DB briefly unreachable at first use) is
+# retried after a TTL rather than cached as None forever — otherwise
+# one boot-time blip pins every long-poll on the degraded path for the
+# process lifetime.
+_requests_signals: Dict[str, Tuple[Optional[events.ExternalSignal],
+                                   float]] = {}
+_requests_signals_lock = threading.Lock()
+_SIGNAL_RETRY_S = 30.0
+
+
+def _requests_signal() -> Optional[events.ExternalSignal]:
+    from skypilot_tpu import state as state_lib
+    key = f'{state_lib.db_url() or ""}#{requests_db.server_dir()}'
+    with _requests_signals_lock:
+        cached = _requests_signals.get(key)
+        if cached is not None:
+            signal, built_at = cached
+            if signal is not None or \
+                    time.time() - built_at < _SIGNAL_RETRY_S:
+                return signal
+        try:
+            signal = requests_db.change_signal()
+        except Exception:  # pylint: disable=broad-except
+            signal = None
+        _requests_signals[key] = (signal, time.time())
+        return signal
 
 
 def _auth_enabled() -> bool:
@@ -787,12 +819,24 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 pass
 
     def _handle_get(self, user=None) -> None:
-        """Block (bounded) until the request is terminal; client re-polls."""
+        """Block (bounded) until the request is terminal; client re-polls.
+
+        Event-driven: finalize() publishes on the requests topic
+        (in-process for cancels, data_version/NOTIFY for the forked
+        request children and peer replicas), so the reply goes out
+        milliseconds after the result lands instead of re-SELECTing the
+        row every 50 ms for the whole long-poll window. The bounded
+        re-check below (0.5 s) is the degraded-mode fallback."""
         query = self._query
         request_id = query.get('request_id', '')
         timeout = min(float(query.get('timeout', 15)), 30.0)
         deadline = time.time() + timeout
+        signal = _requests_signal()
+        cursor = events.cursor(events.REQUESTS)
         while True:
+            # Snapshot BEFORE the row read: a finalize landing between
+            # this read and the wait below fires the wait immediately.
+            ext_base = events.external_cursor(events.REQUESTS, signal)
             request = requests_db.get(request_id)
             if request is None:
                 self._error(HTTPStatus.NOT_FOUND,
@@ -803,10 +847,20 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                             f'no view access to workspace '
                             f'{request.workspace!r}')
                 return
-            if request.status.is_terminal() or time.time() > deadline:
+            remaining = deadline - time.time()
+            if request.status.is_terminal() or remaining <= 0:
                 self._reply(request.to_dict())
                 return
-            time.sleep(0.05)
+            # Relax the re-SELECT only when a wake source actually
+            # covers the writer (finalize happens in a forked child, so
+            # the external signal is the only reliable channel here);
+            # without one, keep the legacy 50ms poll.
+            recheck = 0.5 if (events.enabled() and
+                              signal is not None) else 0.05
+            cursor, _ = events.wait_for(events.REQUESTS, cursor,
+                                        min(recheck, remaining),
+                                        external=signal,
+                                        external_base=ext_base)
 
     def _handle_sse_tail(self) -> None:
         """Server-Sent-Events live tail of a cluster job's rank-0 log

@@ -18,7 +18,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-from skypilot_tpu.utils import log, resilience
+from skypilot_tpu.utils import events, log, resilience
 
 logger = log.init_logger(__name__)
 
@@ -30,13 +30,29 @@ class Daemon:
     in-loop, and the loop itself runs under a SupervisedThread so an
     exception escaping anywhere else (interval lookup, metrics) restarts
     the loop with backoff instead of silently disabling reconciliation
-    until the server restarts. ``health()`` feeds /api/health."""
+    until the server restarts. ``health()`` feeds /api/health.
+
+    ``topic``/``signal_factory`` (optional) make the daemon
+    event-driven: a publish on the topic (or a change on the
+    cross-process signal) cuts the interval sleep short, so e.g. a
+    managed-job submit is scheduled in milliseconds instead of waiting
+    out ``jobs_refresh_interval``. The configured interval remains the
+    supervised fallback cadence, and ``min_gap`` floors back-to-back
+    ticks so a write burst can't hot-spin the reconciler."""
 
     def __init__(self, name: str, interval_fn: Callable[[], float],
-                 tick: Callable[[], None]) -> None:
+                 tick: Callable[[], None],
+                 topic: Optional[str] = None,
+                 signal_factory: Optional[Callable] = None,
+                 min_gap: float = 0.25) -> None:
         self.name = name
         self._interval_fn = interval_fn
         self._tick = tick
+        self._topic = topic
+        self._signal_factory = signal_factory
+        self._signal: Optional[events.ExternalSignal] = None
+        self._signal_retry_at = 0.0   # next build attempt (monotonic)
+        self._min_gap = min_gap
         self._stop = threading.Event()
         self._supervisor: Optional[resilience.SupervisedThread] = None
         self.ticks = 0            # observable for tests/metrics
@@ -71,8 +87,50 @@ class Daemon:
         if self._supervisor is not None:
             self._supervisor.stop(join_timeout=join_timeout)
 
+    def _ensure_signal(self) -> None:
+        """Build the external signal lazily (the watched DB/file may
+        not exist until first use) and RE-try after a TTL — a transient
+        DB blip at boot must not pin the daemon on interval polling for
+        the process lifetime. Factory errors only degrade to interval
+        polling."""
+        if (self._signal is None and self._signal_factory is not None
+                and time.monotonic() >= self._signal_retry_at):
+            self._signal_retry_at = time.monotonic() + 30.0
+            try:
+                self._signal = self._signal_factory()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug('daemon %s change signal unavailable: '
+                             '%s', self.name, e)
+
+    def _wait(self, interval: float, cursor: int,
+              ext_base: object) -> int:
+        """Sleep out the interval, or less if the daemon's topic fires.
+        Returns the updated topic cursor."""
+        if self._topic is None:
+            self._stop.wait(interval)
+            return cursor
+        cursor, source = events.wait_for(self._topic, cursor, interval,
+                                         external=self._signal,
+                                         stop_event=self._stop,
+                                         external_base=ext_base)
+        if source in ('event', 'external') and self._min_gap > 0:
+            # Coalesce bursts: one reconcile pass covers every write
+            # that lands within the gap.
+            self._stop.wait(self._min_gap)
+        return cursor
+
     def _run(self) -> None:
+        cursor = (events.cursor(self._topic)
+                  if self._topic is not None else 0)
         while not self._stop.is_set():
+            ext_base = None
+            if self._topic is not None:
+                self._ensure_signal()
+                # Snapshot BEFORE the tick: a cross-process write
+                # landing mid-tick fires the next wait instead of
+                # being adopted as the baseline.
+                ext_base = events.external_cursor(self._topic,
+                                                  self._signal)
             try:
                 self._tick()
                 self.last_error = None
@@ -93,7 +151,7 @@ class Daemon:
                 logger.warning('daemon %s interval lookup failed: %s',
                                self.name, e)
                 interval = 5.0
-            self._stop.wait(interval)
+            cursor = self._wait(interval, cursor, ext_base)
 
 
 def _cluster_refresh_tick() -> None:
@@ -293,16 +351,26 @@ def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
         daemons.append(
             Daemon('requests-ha', _ha_interval,
                    functools.partial(_requests_ha_tick, server_id)))
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
     return daemons + [
         Daemon('cluster-status-refresh',
                _interval('cluster_refresh_interval', 60.0),
                _cluster_refresh_tick),
+        # Event-driven reconcilers: a managed-job submit / serve-state
+        # write (usually from a forked request child) wakes the daemon
+        # through the notification bus instead of waiting out the
+        # refresh interval; the interval stays as the poll fallback.
         Daemon('managed-jobs-refresh',
                _interval('jobs_refresh_interval', 30.0),
-               _jobs_refresh_tick),
+               _jobs_refresh_tick,
+               topic=events.MANAGED_JOBS,
+               signal_factory=jobs_state.change_signal),
         Daemon('serve-refresh',
                _interval('serve_refresh_interval', 30.0),
-               functools.partial(_serve_refresh_tick, server_id)),
+               functools.partial(_serve_refresh_tick, server_id),
+               topic=events.SERVE,
+               signal_factory=serve_state.change_signal),
         Daemon('log-shipper',
                _interval('log_ship_interval', 60.0),
                _log_ship_tick),

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional
 
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus, ScheduleType
-from skypilot_tpu.utils import log, resilience
+from skypilot_tpu.utils import events, log, resilience
 from skypilot_tpu.utils.subprocess_utils import kill_process_tree
 
 logger = log.init_logger(__name__)
@@ -48,6 +48,24 @@ _ORPHAN_GRACE_S = 2.0
 # happens right after the claim; a longer gap means the runner died in
 # between).
 _PIDLESS_GRACE_S = 10.0
+
+
+def _idle_wait_cap(has_wake_source: bool = True) -> float:
+    """Idle poll cap for the spawner/runner loops. Event-driven wakeups
+    (utils/events) make the poll a degraded-mode fallback, so idle
+    loops may relax to a slacker cadence without adding latency — a
+    submit wakes them in milliseconds either way. When the loop has NO
+    working wake source (eventing disabled, or a runner whose external
+    signal failed to build — it has no in-process publishers either),
+    the legacy 0.5 s cap stays the latency floor."""
+    env = os.environ.get('SKYT_EXECUTOR_IDLE_FALLBACK')
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            logger.warning('ignoring malformed '
+                           'SKYT_EXECUTOR_IDLE_FALLBACK=%r', env)
+    return 2.0 if (events.enabled() and has_wake_source) else 0.5
 
 
 def _same_process(pid: int, recorded_created: Optional[float]) -> bool:
@@ -171,9 +189,31 @@ def runner_main(schedule_type_value: str,
 
     idle_sleep = 0.05
     fault_delays = None
+    # Cross-process wakeup on request-table writes (this process has no
+    # in-process publishers): LISTEN/NOTIFY or requests.db data_version.
+    # None (creation failed / eventing disabled) degrades to the pure
+    # idle-backoff poll below.
+    try:
+        claim_signal = requests_db.change_signal()
+    except Exception:  # pylint: disable=broad-except
+        claim_signal = None
+    signal_retry_at = time.monotonic() + 30.0
+    claim_cursor = events.cursor(events.REQUESTS)
     while True:
         if os.getppid() == 1:  # server died; orphaned runner exits
             return
+        if (claim_signal is None and events.enabled() and
+                time.monotonic() >= signal_retry_at):
+            # Bounded rebuild after a boot-time blip — without it this
+            # process polls degraded for its whole life.
+            signal_retry_at = time.monotonic() + 30.0
+            try:
+                claim_signal = requests_db.change_signal()
+            except Exception:  # pylint: disable=broad-except
+                claim_signal = None
+        # Snapshot before the claim read (see Executor._loop).
+        claim_base = events.external_cursor(events.REQUESTS,
+                                            claim_signal)
         try:
             request = requests_db.claim_next(schedule_type, server_id)
         except resilience.transient_db_errors() as e:
@@ -192,10 +232,16 @@ def runner_main(schedule_type_value: str,
             continue
         fault_delays = None
         if request is None:
-            # Back off while the queue is dry (an idle pool must not
-            # hammer the DB's write lock); snap back on the next claim.
-            time.sleep(idle_sleep)
-            idle_sleep = min(idle_sleep * 1.5, 0.5)
+            # Queue dry: sleep until a request-table notification (ms
+            # wakeup) or the idle-backoff fallback elapses — an idle
+            # pool no longer hammers the DB's write lock at a fixed
+            # cadence, and a lost notification costs at most the
+            # fallback interval, not a hang.
+            claim_cursor, _ = events.wait_for(
+                events.REQUESTS, claim_cursor, idle_sleep,
+                external=claim_signal, external_base=claim_base)
+            idle_sleep = min(idle_sleep * 1.5,
+                             _idle_wait_cap(claim_signal is not None))
             continue
         idle_sleep = 0.05
         pid = os.fork()
@@ -319,8 +365,34 @@ class Executor:
         last_orphan_scan = 0.0
         idle_wait = 0.05
         error_delays = None
+        # Event-driven wakeup: request inserts happen on this process's
+        # HTTP threads (requests_db.create publishes in-process), so a
+        # submit wakes the spawner in microseconds; cross-replica
+        # writes arrive via LISTEN/NOTIFY. The idle backoff below
+        # becomes the supervised degraded-mode fallback.
+        try:
+            wake_signal = requests_db.change_signal()
+        except Exception:  # pylint: disable=broad-except
+            wake_signal = None
+        signal_retry_at = time.monotonic() + 30.0
+        wake_cursor = events.cursor(events.REQUESTS)
         try:
             while not self._stop.is_set():
+                if (wake_signal is None and events.enabled() and
+                        time.monotonic() >= signal_retry_at):
+                    # A boot-time DB blip must not pin this loop on
+                    # degraded polling forever (same 30s retry as
+                    # app._requests_signal / Daemon._wait).
+                    signal_retry_at = time.monotonic() + 30.0
+                    try:
+                        wake_signal = requests_db.change_signal()
+                    except Exception:  # pylint: disable=broad-except
+                        wake_signal = None
+                # Snapshot BEFORE the tick reads the table: a write
+                # landing mid-tick then fires the wait instead of
+                # being adopted as the baseline.
+                wake_base = events.external_cursor(events.REQUESTS,
+                                                   wake_signal)
                 try:
                     saw_backlog = self._tick(runner_log)
                     now = time.time()
@@ -347,10 +419,16 @@ class Executor:
                 error_delays = None
                 self.last_error = None
                 # Idle backoff: one cheap COUNT query per tick when
-                # quiet.
+                # quiet — and an event wakeup cuts the wait short the
+                # moment a request lands.
                 idle_wait = (0.05 if saw_backlog
-                             else min(idle_wait * 1.5, 0.5))
-                self._stop.wait(idle_wait)
+                             else min(idle_wait * 1.5,
+                                      _idle_wait_cap(
+                                          wake_signal is not None)))
+                wake_cursor, _ = events.wait_for(
+                    events.REQUESTS, wake_cursor, idle_wait,
+                    external=wake_signal, stop_event=self._stop,
+                    external_base=wake_base)
         finally:
             runner_log.close()
 

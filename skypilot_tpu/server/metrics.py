@@ -140,9 +140,18 @@ DAEMON_TICKS = Counter(
 RUNTIME_EVENTS = Counter(
     'skyt_runtime_events_total',
     'Job-state transitions pushed over cluster runtime channels')
+EVENT_WAKEUPS = Counter(
+    'skyt_event_wakeups_total',
+    'Control-plane loop wakeups by notification-bus topic and source '
+    '(event=in-process notify, external=LISTEN/NOTIFY or data_version, '
+    'catchup=lost notify found at fallback, fallback=degraded poll)')
+NOTIFICATIONS = Counter(
+    'skyt_notifications_total',
+    'Notification-bus publishes by topic and outcome '
+    '(delivered vs suppressed)')
 
 _ALL = [REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
-        RUNTIME_EVENTS]
+        RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS]
 
 
 def collect_from_db() -> None:
@@ -155,12 +164,25 @@ def collect_from_db() -> None:
     """
     from skypilot_tpu import state
     from skypilot_tpu.server import requests_db
+    from skypilot_tpu.utils import events
     with _lock:
         REQUESTS_TOTAL._values.clear()
         PROVISION_SECONDS._counts.clear()
         PROVISION_SECONDS._sums.clear()
         PROVISION_SECONDS._totals.clear()
         PROVISION_SECONDS._samples.clear()
+        EVENT_WAKEUPS._values.clear()
+        NOTIFICATIONS._values.clear()
+    # Notification-bus health (this process's loops: executor spawner,
+    # /api/get long-polls, daemons): delivered-vs-fallback ratios show
+    # whether eventing is working or the control plane is living on the
+    # degraded poll path.
+    for (topic, source), count in events.wakeup_counts().items():
+        EVENT_WAKEUPS.inc(count, topic=topic, source=source)
+    for topic, count in events.publish_counts().items():
+        NOTIFICATIONS.inc(count, topic=topic, outcome='delivered')
+    for topic, count in events.suppressed_counts().items():
+        NOTIFICATIONS.inc(count, topic=topic, outcome='suppressed')
     for name, status, count in requests_db.count_by_name_status():
         REQUESTS_TOTAL.inc(count, name=name, status=status)
     for queue, depth in requests_db.pending_depth_by_queue().items():

@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import events
 from skypilot_tpu.utils import fault_injection
 
 
@@ -136,6 +137,17 @@ def _db():
         init_schema=init_schema)
 
 
+def change_signal() -> 'events.ExternalSignal | None':
+    """Cross-process change signal for the requests table: LISTEN on
+    the shared Postgres (HA), else a data_version watch on the local
+    sqlite file. Consumers: the executor spawner, pool runners, and the
+    /api/get long-poll."""
+    from skypilot_tpu import state as state_lib
+    return events.external_signal(
+        state_lib.db_url(),
+        os.path.join(server_dir(), 'requests.db'), events.REQUESTS)
+
+
 class Request:
     def __init__(self, row: sqlite3.Row) -> None:
         self.request_id: str = row['request_id']
@@ -211,6 +223,10 @@ def create(name: str,
             (idem_key,)).fetchone()
         assert row is not None, idem_key
         return row['request_id']
+    # Wake claimants (executor spawner + pool runners) the moment the
+    # PENDING row is committed — submit→claimed no longer waits out a
+    # poll tick.
+    events.publish(events.REQUESTS, conn=conn)
     return request_id
 
 
@@ -409,6 +425,11 @@ def finalize(request_id: str,
         args.append(owner)
     cur = conn.execute(sql, args)
     conn.commit()
+    if cur.rowcount == 1:
+        # Wakes /api/get long-pollers (the client's wait ends the
+        # instant the result lands) and, for CANCELLED, the owning
+        # replica's executor kill scan.
+        events.publish(events.REQUESTS, conn=conn)
     return cur.rowcount == 1
 
 
@@ -581,6 +602,9 @@ def requeue_dead_server_requests(own_server_id: str,
         conn.commit()
         if cur.rowcount == 1:
             requeued += 1
+    if requeued:
+        # Re-PENDING rows need claimants awake on every replica.
+        events.publish(events.REQUESTS, conn=conn)
     _purge_unreferenced_heartbeats(conn, stale_after)
     return requeued, failed
 

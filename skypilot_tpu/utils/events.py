@@ -1,0 +1,430 @@
+"""Control-plane notification bus: event-driven wakeups over poll loops.
+
+Every resident control-plane loop used to sleep a fixed cadence between
+DB scans (executor spawner tick, pool-runner claim loop, ``/api/get``
+long-poll, channel-server job-table watcher, serve controller, server
+daemons) — so submit→running latency bottomed out at the poll interval
+and an idle control plane burned DB round-trips doing nothing. This
+module replaces the sleeps with a two-layer wakeup:
+
+1. **In-process bus** — topic-keyed condition variables with a
+   monotonic sequence cursor. Writers :func:`publish` after commit;
+   same-process waiters in :func:`wait_for` wake within microseconds.
+   The cursor makes delivery race-free: a publish landing between a
+   reader's snapshot and its wait is seen as ``seq > cursor`` and
+   returns immediately (no lost-wakeup window).
+
+2. **Cross-process / cross-replica signal** — an
+   :class:`ExternalSignal` the waiter checks on a short slice while it
+   sleeps:
+
+   * Postgres ``LISTEN/NOTIFY`` (:class:`PgNotifyListener`) when
+     ``SKYT_DB_URL`` is set — writers ride a ``NOTIFY`` on their
+     existing connection, listeners drain async NotificationResponse
+     frames (utils/pg.py);
+   * ``PRAGMA data_version`` (:class:`SqliteDataVersion`) for the
+     local-sqlite backends — a single-page read that changes whenever
+     ANOTHER connection commits to the file, i.e. a change *signal*,
+     not a table scan.
+
+The old poll cadence is kept as a **supervised fallback**: ``wait_for``
+never blocks past ``fallback_interval``, so a lost/suppressed
+notification degrades to (relaxed) polling instead of a hang. Sources
+are counted per topic (``wakeup_counts``) so ``/api/metrics`` shows
+notifications delivered vs fallback-poll wakeups.
+
+Determinism / chaos: :func:`publish` runs under the
+``SKYT_FAULT_SPEC`` site ``events.publish.<topic>`` (drop the notify,
+keep the write) and external checks under ``events.external.<topic>``
+— tests/test_events.py proves every converted loop still progresses
+with both layers suppressed.
+
+Env knobs::
+
+    SKYT_EVENTS_DISABLED=1   # legacy behavior: wait_for = plain sleep
+    SKYT_EVENTS_SLICE=0.02   # external-signal check cadence (seconds)
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.utils import fault_injection, log
+
+logger = log.init_logger(__name__)
+
+# Topic names double as cross-process channel names (see pg_channel).
+REQUESTS = 'requests'          # API request table (server/requests_db)
+MANAGED_JOBS = 'managed-jobs'  # managed-jobs table (jobs/state)
+SERVE = 'serve'                # serve services/replicas (serve/serve_state)
+RUNTIME_JOBS = 'runtime-jobs'  # cluster-local job table (runtime/job_lib)
+
+DISABLE_ENV = 'SKYT_EVENTS_DISABLED'
+SLICE_ENV = 'SKYT_EVENTS_SLICE'
+
+# Wake sources (the label set of skyt_event_wakeups_total):
+#   event    - in-process publish, delivered via the condition variable
+#              (or found already-advanced when the wait began)
+#   external - cross-process transport (LISTEN/NOTIFY or data_version)
+#   catchup  - a timeout re-check found the cursor advanced (the notify
+#              was lost/suppressed; the write was NOT lost)
+#   fallback - fallback timeout, nothing changed (the degraded poll)
+#   stop     - stop_event was set
+SOURCES = ('event', 'external', 'catchup', 'fallback', 'stop')
+
+
+def enabled() -> bool:
+    return os.environ.get(DISABLE_ENV, '') not in ('1', 'true', 'yes')
+
+
+def _slice_interval() -> float:
+    try:
+        return max(0.005, float(os.environ.get(SLICE_ENV, '0.02')))
+    except ValueError:
+        return 0.02
+
+
+def pg_channel(topic: str) -> str:
+    """NOTIFY/LISTEN channel for a topic ('-' is not identifier-safe)."""
+    return 'skyt_evt_' + topic.replace('-', '_')
+
+
+class _Topic:
+    __slots__ = ('cond', 'seq')
+
+    def __init__(self) -> None:
+        self.cond = threading.Condition()
+        self.seq = 0
+
+
+_topics: Dict[str, _Topic] = {}
+_topics_lock = threading.Lock()
+
+# Process-local counters for /api/metrics (same in-memory stance as
+# server/metrics.py — forked children's counts live in THEIR process).
+_wakeups: Dict[Tuple[str, str], int] = {}
+_published: Dict[str, int] = {}
+_suppressed: Dict[str, int] = {}
+_counts_lock = threading.Lock()
+
+
+def _topic(name: str) -> _Topic:
+    topic = _topics.get(name)
+    if topic is None:
+        with _topics_lock:
+            topic = _topics.setdefault(name, _Topic())
+    return topic
+
+
+def cursor(name: str) -> int:
+    """Current sequence for ``name`` — snapshot BEFORE reading the state
+    you wait on, so a write landing in between reads as ``seq > cursor``
+    and the next :func:`wait_for` returns immediately."""
+    return _topic(name).seq
+
+
+def _count_wakeup(name: str, source: str) -> None:
+    with _counts_lock:
+        key = (name, source)
+        _wakeups[key] = _wakeups.get(key, 0) + 1
+
+
+def publish(name: str, conn=None) -> int:
+    """Signal a committed change on topic ``name``; returns the new
+    sequence. Call AFTER the commit — waiters re-read the store on
+    wake, so publishing an uncommitted write would hand them a stale
+    snapshot and the fallback poll would be the only thing saving them.
+
+    ``conn`` (optional) is the writer's DB connection: when it is a
+    Postgres adapter (``SKYT_DB_URL`` deployments), a ``NOTIFY`` rides
+    it so every OTHER replica's listeners wake too. Local sqlite needs
+    no publisher-side action — the commit itself bumps the file's
+    ``data_version``, which :class:`SqliteDataVersion` watches.
+
+    Never raises: a failed/suppressed notify only degrades latency to
+    the fallback poll (counted in ``suppressed``); the sequence still
+    advances so late waiters catch up on their next wait.
+    """
+    topic = _topic(name)
+    suppressed = False
+    try:
+        fault_injection.inject(f'events.publish.{name}')
+    except Exception:  # pylint: disable=broad-except
+        suppressed = True
+    with topic.cond:
+        topic.seq += 1
+        seq = topic.seq
+        if not suppressed:
+            topic.cond.notify_all()
+    with _counts_lock:
+        bucket = _suppressed if suppressed else _published
+        bucket[name] = bucket.get(name, 0) + 1
+    if not suppressed and conn is not None and getattr(
+            conn, 'is_postgres', False):
+        try:
+            conn.execute('NOTIFY ' + pg_channel(name))
+        except Exception as e:  # pylint: disable=broad-except
+            # Best-effort: an sqlite-backed PG stand-in (tests/fake_pg)
+            # can't parse NOTIFY, and a flapping server may reject it —
+            # peers then wake on their fallback poll instead.
+            logger.debug('NOTIFY %s failed: %s', pg_channel(name), e)
+    return seq
+
+
+_UNSET = object()
+
+
+def external_cursor(name: str, external: 'Optional[ExternalSignal]'
+                    ) -> Optional[object]:
+    """Snapshot the external transport's version BEFORE reading the
+    state you wait on — symmetric with :func:`cursor`. Pass the result
+    to :func:`wait_for` as ``external_base`` so a cross-process write
+    landing DURING your read fires the next wait instead of being
+    silently adopted as the baseline. ``None`` (transport unreadable)
+    is a valid snapshot: the unreadable→readable transition fires."""
+    return _external_version(name, external)
+
+
+def wait_for(name: str,
+             last_cursor: int,
+             fallback_interval: float,
+             external: 'Optional[ExternalSignal]' = None,
+             stop_event: Optional[threading.Event] = None,
+             external_base: object = _UNSET
+             ) -> Tuple[int, str]:
+    """Block until topic ``name`` advances past ``last_cursor``, the
+    ``external`` transport signals a change, ``stop_event`` is set, or
+    ``fallback_interval`` seconds pass — whichever first.
+
+    Returns ``(new_cursor, source)`` with ``source`` in
+    :data:`SOURCES`. The caller re-reads its store on ANY source — the
+    bus carries "something changed", never payloads, so a spurious wake
+    costs one read and a missed one costs at most the fallback
+    interval. With ``SKYT_EVENTS_DISABLED=1`` this degenerates to the
+    legacy bounded sleep (one ``stop_event.wait``), byte-for-byte the
+    old loop behavior.
+    """
+    fallback_interval = max(0.0, fallback_interval)
+    topic = _topic(name)
+    if not enabled():
+        if stop_event is not None:
+            stop_event.wait(fallback_interval)
+        else:
+            time.sleep(fallback_interval)
+        seq = topic.seq
+        _count_wakeup(name, 'fallback')
+        return seq, 'fallback'
+    deadline = time.monotonic() + fallback_interval
+    ext_base = (external_base if external_base is not _UNSET
+                else _external_version(name, external))
+    # Slice the sleep when anything must be checked out-of-band: the
+    # external transport (no fd to select on for sqlite) or stop_event
+    # (a Condition cannot be woken by an Event). Pure in-process waits
+    # sleep the full interval in one cond.wait — zero idle cost.
+    slice_needed = external is not None or stop_event is not None
+    slice_interval = _slice_interval() if external is not None else 0.2
+    while True:
+        if stop_event is not None and stop_event.is_set():
+            _count_wakeup(name, 'stop')
+            return topic.seq, 'stop'
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            seq = topic.seq
+            source = 'catchup' if seq > last_cursor else 'fallback'
+            _count_wakeup(name, source)
+            return seq, source
+        wait_time = min(slice_interval, remaining) if slice_needed \
+            else remaining
+        with topic.cond:
+            if topic.seq > last_cursor:
+                _count_wakeup(name, 'event')
+                return topic.seq, 'event'
+            notified = topic.cond.wait(wait_time)
+            if topic.seq > last_cursor:
+                # 'catchup' = the advance was FOUND on a timeout
+                # re-check, not delivered by a notify — that's how a
+                # suppressed/lost notification shows up in metrics
+                # while the loop still progresses.
+                source = 'event' if notified else 'catchup'
+                _count_wakeup(name, source)
+                return topic.seq, source
+        if external is not None:
+            version = _external_version(name, external)
+            if version is not None and version != ext_base:
+                # Fires on the unreadable->readable transition too
+                # (ext_base None): for SqliteDataVersion that
+                # transition often IS the first write — the write
+                # creates the DB file — and a spurious wake on
+                # transport recovery costs one re-read, while a
+                # swallowed first event costs a full poll interval.
+                _count_wakeup(name, 'external')
+                return topic.seq, 'external'
+
+
+def _external_version(name: str, external) -> Optional[object]:
+    """Never raises: a broken transport reads as 'no signal' and the
+    fallback poll carries the loop (chaos site events.external.<topic>)."""
+    if external is None:
+        return None
+    try:
+        fault_injection.inject(f'events.external.{name}')
+        return external.version()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug('external signal for %s unreadable: %s', name, e)
+        return None
+
+
+# -- cross-process transports -------------------------------------------
+
+
+class ExternalSignal:
+    """A cheap cross-process change signal: ``version()`` returns an
+    opaque value that differs after the watched store changed. May
+    raise; :func:`wait_for` treats errors as 'no signal'."""
+
+    def version(self) -> object:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class SqliteDataVersion(ExternalSignal):
+    """``PRAGMA data_version`` watcher on one sqlite file.
+
+    The pragma changes whenever a DIFFERENT connection commits to the
+    file — one page read, no table scan, no locks taken. The value is
+    only meaningful within one connection's lifetime, so reconnects
+    bump a generation counter to keep versions comparable. Thread-safe
+    (one shared signal serves every HTTP long-poll thread)."""
+
+    def __init__(self, path: str) -> None:
+        self._path = os.path.expanduser(path)
+        self._conn = None
+        self._generation = 0
+        self._lock = threading.Lock()
+
+    def version(self) -> object:
+        import sqlite3
+        with self._lock:
+            if self._conn is None:
+                if not os.path.exists(self._path):
+                    # Not created yet (first write makes it): no signal
+                    # rather than creating an empty DB as a side effect.
+                    raise FileNotFoundError(self._path)
+                self._generation += 1
+                self._conn = sqlite3.connect(self._path, timeout=1,
+                                             check_same_thread=False)
+            try:
+                row = self._conn.execute('PRAGMA data_version').fetchone()
+            except sqlite3.Error:
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
+                raise
+            return (self._generation, row[0])
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
+
+
+class PgNotifyListener(ExternalSignal):
+    """``LISTEN``-ing Postgres connection; ``version()`` drains pending
+    NotificationResponse frames non-blockingly and returns a count that
+    grows with each delivery. Thread-safe; a dead connection is
+    re-established lazily (a failed reconnect reads as 'no signal' and
+    the fallback poll covers the gap)."""
+
+    def __init__(self, url: str, channel: str) -> None:
+        self._url = url
+        self._channel = channel
+        self._conn = None
+        self._count = 0
+        self._generation = 0
+        self._lock = threading.Lock()
+        self._connect_locked()
+
+    def _connect_locked(self) -> None:
+        from skypilot_tpu.utils import pg
+        self._generation += 1
+        self._conn = pg.PgConnection.from_url(self._url)
+        self._conn.execute('LISTEN ' + self._channel)
+
+    def version(self) -> object:
+        with self._lock:
+            if self._conn is None:
+                self._connect_locked()
+            try:
+                self._count += self._conn.drain_notifications()
+            except Exception:
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
+                raise
+            return (self._generation, self._count)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
+
+
+def external_signal(url: Optional[str], sqlite_path: str,
+                    topic: str) -> Optional[ExternalSignal]:
+    """Build the right transport for a dual-backend store: LISTEN on
+    the shared Postgres when ``url`` is set (replica-wide wakeups),
+    else a data_version watch on the local sqlite file. ``None`` when
+    eventing is disabled or the transport can't be established (the
+    caller's fallback poll then carries the loop alone)."""
+    if not enabled():
+        return None
+    if url:
+        try:
+            return PgNotifyListener(url, pg_channel(topic))
+        except Exception as e:  # pylint: disable=broad-except
+            # e.g. an sqlite-backed PG stand-in that can't parse LISTEN
+            # (tests/fake_pg), or the DB being briefly unreachable.
+            logger.debug('LISTEN %s unavailable (%s); poll fallback only',
+                         pg_channel(topic), e)
+            return None
+    return SqliteDataVersion(sqlite_path)
+
+
+# -- metrics surface ----------------------------------------------------
+
+
+def wakeup_counts() -> Dict[Tuple[str, str], int]:
+    """(topic, source) -> wakeups, for skyt_event_wakeups_total."""
+    with _counts_lock:
+        return dict(_wakeups)
+
+
+def publish_counts() -> Dict[str, int]:
+    with _counts_lock:
+        return dict(_published)
+
+
+def suppressed_counts() -> Dict[str, int]:
+    with _counts_lock:
+        return dict(_suppressed)
+
+
+def reset_for_tests() -> None:
+    with _counts_lock:
+        _wakeups.clear()
+        _published.clear()
+        _suppressed.clear()
+    with _topics_lock:
+        _topics.clear()

@@ -171,6 +171,10 @@ class PgConnection:
                                               timeout=connect_timeout)
         self._sock.settimeout(30.0)
         self._buf = b''
+        # Async NotificationResponse frames ('A') collected from the
+        # wire — LISTEN/NOTIFY support for the control-plane event bus
+        # (utils/events.PgNotifyListener). (channel, payload) tuples.
+        self.notifications: List[Tuple[str, str]] = []
         if sslmode != 'disable':
             self._tls_upgrade(host, sslmode, sslrootcert)
         self._startup(database)
@@ -377,6 +381,8 @@ class PgConnection:
                     rowcount = int(parts[-1])
             elif mtype == b'E':
                 error = PgError(_parse_error(body))
+            elif mtype == b'A':      # NotificationResponse (async)
+                self.notifications.append(_parse_notification(body))
             elif mtype == b'Z':      # ReadyForQuery: statement done
                 if error is not None:
                     raise error
@@ -389,6 +395,59 @@ class PgConnection:
             if statement.strip():
                 self.execute(statement)
 
+    def drain_notifications(self) -> int:
+        """Consume every async NotificationResponse currently pending
+        (already-buffered frames plus whatever the socket holds) WITHOUT
+        blocking; returns how many arrived. For dedicated LISTEN
+        connections — on a connection with a query mid-flight the
+        framing would interleave.
+
+        Two-phase so a PARTIAL frame can never block: first pull all
+        readable bytes into the buffer (select-gated recv, plus
+        ``pending()`` for TLS sockets whose decrypted bytes don't show
+        on the raw fd), then parse only frames the buffer holds in
+        full — a split frame waits for the next drain instead of
+        stalling this one on the 30s socket timeout."""
+        import select
+        count = len(self.notifications)
+        self.notifications.clear()
+        pending = getattr(self._sock, 'pending', None)
+        # Short recv timeout: on TLS, select() reports the raw fd
+        # readable as soon as the FIRST bytes of a record land, but a
+        # blocking SSLSocket.recv waits for the complete record — cap
+        # that wait so a split record can't stall every waiter behind
+        # the listener lock for the 30s socket timeout.
+        previous_timeout = self._sock.gettimeout()
+        self._sock.settimeout(0.1)
+        try:
+            while True:
+                if not (pending is not None and pending()):
+                    readable, _, _ = select.select([self._sock], [], [],
+                                                   0)
+                    if not readable:
+                        break
+                try:
+                    chunk = self._sock.recv(65536)
+                except (socket.timeout, ssl.SSLWantReadError):
+                    break        # partial TLS record: next drain's work
+                if not chunk:
+                    raise PgError({'M': 'server closed the connection'})
+                self._buf += chunk
+        finally:
+            self._sock.settimeout(previous_timeout)
+        while len(self._buf) >= 5:
+            (length,) = struct.unpack('>I', self._buf[1:5])
+            if len(self._buf) < 1 + length:
+                break            # incomplete frame: next drain's work
+            mtype, body = self._recv_message()
+            if mtype == b'A':
+                count += 1
+            elif mtype == b'E':
+                raise PgError(_parse_error(body))
+            # S (ParameterStatus) / N (Notice) / Z: skip — idle-time
+            # chatter on a LISTEN-only connection.
+        return count
+
     def commit(self) -> None:
         """Simple-protocol statements autocommit; kept for sqlite-shaped
         call sites."""
@@ -399,6 +458,15 @@ class PgConnection:
             self._sock.close()
         except OSError:
             pass
+
+
+def _parse_notification(body: bytes) -> Tuple[str, str]:
+    """NotificationResponse: int32 sender pid, cstr channel, cstr
+    payload."""
+    end = body.index(b'\0', 4)
+    channel = body[4:end].decode('utf-8', 'replace')
+    payload = body[end + 1:].split(b'\0')[0].decode('utf-8', 'replace')
+    return channel, payload
 
 
 def _parse_error(body: bytes) -> Dict[str, str]:

@@ -71,19 +71,22 @@ def test_runners_survive_db_faults_mid_claim(clean_db):
 
 def test_killed_spawner_thread_is_resurrected(clean_db):
     """Kill the spawner loop outright (an exception outside the guarded
-    tick body): the SupervisedThread restarts it and scheduling
-    resumes — the r5 failure mode can no longer be permanent."""
+    tick body — here the event-bus wait the loop parks in): the
+    SupervisedThread restarts it and scheduling resumes — the r5
+    failure mode can no longer be permanent."""
+    from skypilot_tpu.utils import events as events_lib
     executor = executor_lib.Executor(server_id='chaos-b')
-    real_wait = executor._stop.wait  # noqa: SLF001
+    real_wait_for = events_lib.wait_for
     state = {'killed': False}
 
-    def dying_wait(timeout=None):
-        if not state['killed']:
+    def dying_wait_for(*args, **kwargs):
+        if not state['killed'] and kwargs.get('stop_event') is \
+                executor._stop:  # noqa: SLF001 — only OUR loop dies
             state['killed'] = True
             raise RuntimeError('spawner thread killed by test')
-        return real_wait(timeout)
+        return real_wait_for(*args, **kwargs)
 
-    executor._stop.wait = dying_wait  # noqa: SLF001
+    events_lib.wait_for = dying_wait_for
     executor.start()
     try:
         request_id = requests_db.create('status', {},
@@ -95,7 +98,7 @@ def test_killed_spawner_thread_is_resurrected(clean_db):
         assert health['restarts'] >= 1, (
             'the loop was never killed — vacuous test')
     finally:
-        executor._stop.wait = real_wait  # noqa: SLF001
+        events_lib.wait_for = real_wait_for
         executor.shutdown()
 
 

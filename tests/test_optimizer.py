@@ -298,3 +298,87 @@ def test_joint_respects_runtime_estimates():
                 b.hourly_cost * b.estimated_hours)
     assert plan.total_cost == pytest.approx(expected, rel=1e-6)
     assert plan.choices['b'].resources.region == 'us-west4'
+
+
+# -- per-cloud-pair egress pricing (VERDICT r5 weak #6) -----------------
+
+
+def test_egress_table_cloud_pairs():
+    from skypilot_tpu.catalog import egress
+    # Intra-cloud inter-region < source cloud's internet egress.
+    assert egress.egress_price_per_gb('aws', 'aws') < \
+        egress.egress_price_per_gb('aws', 'gcp')
+    assert egress.egress_price_per_gb('gcp', 'gcp') < \
+        egress.egress_price_per_gb('gcp', 'aws')
+    # Egress is billed by the SENDING cloud: aws->gcp != gcp->aws.
+    assert egress.egress_price_per_gb('aws', 'gcp') != \
+        egress.egress_price_per_gb('gcp', 'aws')
+    # On-prem/BYO SOURCES send free; a metered cloud sending TOWARD a
+    # user-owned network still pays its internet-egress tier.
+    for free in ('local', 'slurm', 'ssh'):
+        assert egress.egress_price_per_gb(free, 'gcp') == 0.0
+        assert egress.egress_price_per_gb('gcp', free) == \
+            egress.egress_price_per_gb('gcp', 'aws')
+    # Unknown pairs fall back to the legacy flat rate.
+    assert egress.egress_price_per_gb(None, 'gcp') == \
+        egress.DEFAULT_EGRESS_PER_GB
+    assert egress.egress_price_per_gb('fake', 'fake') == \
+        egress.DEFAULT_EGRESS_PER_GB
+
+
+def test_joint_plan_picks_cheaper_cloud_pair(monkeypatch):
+    """Two plans differing ONLY in the egress edge: the child has
+    equal-price candidates on gcp and aws; with the parent pinned to
+    aws, aws->aws (inter-region $0.02/GB) must beat aws->gcp (internet
+    egress $0.09/GB) — the flat-rate model saw both edges as identical
+    and kept greedy's tie-break."""
+    from skypilot_tpu import optimizer as opt
+
+    def fake_plan_task(task, enabled_clouds=None, minimize='cost'):
+        del enabled_clouds, minimize
+        if task.name == 'a':
+            return [opt.Candidate(
+                resources=Resources(cloud='aws', region='us-east-1'),
+                hourly_cost=10.0)]
+        return [  # greedy order puts the WRONG (cross-cloud) pair first
+            opt.Candidate(
+                resources=Resources(cloud='gcp', region='us-central1'),
+                hourly_cost=10.0),
+            opt.Candidate(
+                resources=Resources(cloud='aws', region='us-west-2'),
+                hourly_cost=10.0),
+        ]
+
+    monkeypatch.setattr(opt.Optimizer, 'plan_task',
+                        staticmethod(fake_plan_task))
+    with Dag('pair') as dag:
+        dag.add(Task(name='a', run='produce', estimated_outputs_gb=100.0,
+                     resources=Resources(cloud='aws', region='us-east-1')))
+        dag.add(Task(name='b', run='consume', depends_on=['a'],
+                     resources=Resources()))
+    plan = opt.Optimizer.plan_dag(dag)
+    assert plan.choices['b'].resources.cloud == 'aws'
+    assert plan.edge_costs[('a', 'b')] == pytest.approx(100.0 * 0.02)
+    # Greedy (gcp child) would have paid the internet-egress edge.
+    assert plan.greedy_cost - plan.total_cost == \
+        pytest.approx(100.0 * (0.09 - 0.02))
+
+
+def test_inputs_egress_uses_cloud_hint():
+    """`inputs_cloud` prices the input pull per cloud pair (cross-cloud
+    inputs ride the source's internet tier)."""
+    from skypilot_tpu import optimizer as opt
+    task = Task(name='t', run='x', resources=Resources())
+    task.estimated_inputs_gb = 10.0
+    task.inputs_region = 'us-east-1'
+    task.inputs_cloud = 'aws'
+    cand = opt.Candidate(
+        resources=Resources(cloud='gcp', region='us-central1'),
+        hourly_cost=1.0)
+    opt._annotate_estimates(cand, task)
+    assert cand.egress_cost == pytest.approx(10.0 * 0.09)  # aws internet
+    same_cloud = opt.Candidate(
+        resources=Resources(cloud='aws', region='us-west-2'),
+        hourly_cost=1.0)
+    opt._annotate_estimates(same_cloud, task)
+    assert same_cloud.egress_cost == pytest.approx(10.0 * 0.02)
