@@ -1,0 +1,335 @@
+"""Serve data-plane bench: the asyncio streaming LB vs the old
+thread-per-request buffering proxy it replaced.
+
+Three questions, answered against in-process stub replicas (CPU-only,
+no cloud/TPU — wired into run_benches.sh like bench_control_plane.py):
+
+* **Proxy overhead** — request p50/p99 through the LB minus direct-to-
+  replica, at concurrency 1/16/64, with keep-alive pooling on vs off
+  (``SKYT_LB_POOL_SIZE=0`` forces a TCP dial per upstream request —
+  what the old proxy always did).
+* **Streamed TTFT** — a replica that emits N spaced chunks (the SSE
+  token-stream shape of ``inference/server.py``): time-to-first-chunk
+  through the async LB (≈ the replica's first-chunk time) vs through a
+  buffering proxy (≈ total completion time — the old
+  ``resp.read()``-then-forward behavior, reimplemented here verbatim
+  as the baseline since the old code path was replaced, not kept).
+* **Throughput** — requests/s sustained at each concurrency.
+
+One JSON document on stdout; measured numbers land in
+``BENCH_serve_lb_<suffix>.json``, PERF.md, and
+``docs/serve_data_plane.md``.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[idx]
+
+
+# -- stub replicas ----------------------------------------------------------
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    """Fast small-JSON replica: the proxy-overhead workload."""
+    protocol_version = 'HTTP/1.1'
+    _BODY = json.dumps({'outputs': ['ok'] * 8}).encode()
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(self._BODY)))
+        self.end_headers()
+        self.wfile.write(self._BODY)
+
+    do_POST = do_GET
+
+
+def _make_stream_handler(chunks: int, spacing: float):
+    class _StreamHandler(BaseHTTPRequestHandler):
+        """SSE-shaped replica: N spaced chunks, chunked encoding."""
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+            for i in range(chunks):
+                frame = f'data: token{i}\n\n'.encode()
+                self.wfile.write(f'{len(frame):x}\r\n'.encode() + frame +
+                                 b'\r\n')
+                self.wfile.flush()
+                if i < chunks - 1:
+                    time.sleep(spacing)
+            self.wfile.write(b'0\r\n\r\n')
+            self.wfile.flush()
+
+    return _StreamHandler
+
+
+def _start_replica(handler):
+    server = ThreadingHTTPServer(('127.0.0.1', 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+# -- the old proxy, preserved as the baseline -------------------------------
+
+
+class _BufferingProxyHandler(BaseHTTPRequestHandler):
+    """The replaced serve proxy, byte-for-byte in behavior: a fresh
+    HTTPConnection per request and ``resp.read()`` buffering the whole
+    response before the first byte goes to the client."""
+    protocol_version = 'HTTP/1.1'
+    target = None  # (host, port), bound per instance below
+
+    def log_message(self, *args):
+        pass
+
+    def _proxy(self):
+        length = int(self.headers.get('Content-Length') or 0)
+        body = self.rfile.read(length) if length else None
+        host, port = self.target
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        conn.request(self.command, self.path, body=body,
+                     headers={'Accept': '*/*'})
+        resp = conn.getresponse()
+        payload = resp.read()          # <-- the buffering
+        self.send_response(resp.status)
+        for key, value in resp.getheaders():
+            if key.lower() not in ('transfer-encoding', 'content-length',
+                                   'connection'):
+                self.send_header(key, value)
+        self.send_header('Content-Length', str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        conn.close()
+
+    do_GET = do_POST = _proxy
+
+
+def _start_buffering_proxy(target_host, target_port):
+    handler = type('BoundBuffering', (_BufferingProxyHandler,),
+                   {'target': (target_host, target_port)})
+    server = ThreadingHTTPServer(('127.0.0.1', 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+# -- load generator ---------------------------------------------------------
+
+
+def _run_load(host, port, concurrency, total_requests):
+    """Closed-loop client threads, one keep-alive connection each
+    (clients reuse connections in both modes — the knob under test is
+    the LB->replica side). Returns latencies + wall time."""
+    per_worker = max(1, total_requests // concurrency)
+    latencies = []
+    lock = threading.Lock()
+    errors = [0]
+
+    def worker():
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        mine = []
+        for _ in range(per_worker):
+            start = time.monotonic()
+            try:
+                conn.request('GET', '/bench')
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    errors[0] += 1
+                    continue
+            except (OSError, http.client.HTTPException):
+                with lock:
+                    errors[0] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                continue
+            mine.append(time.monotonic() - start)
+        conn.close()
+        with lock:
+            latencies.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    wall_start = time.monotonic()
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    wall = time.monotonic() - wall_start
+    return latencies, wall, errors[0]
+
+
+def _measure_ttft(host, port, path='/stream', tries=5):
+    """Raw-socket streamed read: (ttft, total) medians over `tries`."""
+    ttfts, totals = [], []
+    for _ in range(tries):
+        sock = socket.create_connection((host, port), timeout=30)
+        sock.sendall(f'GET {path} HTTP/1.1\r\nHost: bench\r\n'
+                     'Connection: close\r\n\r\n'.encode())
+        sock.settimeout(30)
+        start = time.monotonic()
+        first_body = None
+        buf = b''
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+            buf += data
+            if first_body is None and b'token0' in buf:
+                first_body = time.monotonic() - start
+        totals.append(time.monotonic() - start)
+        ttfts.append(first_body if first_body is not None else totals[-1])
+        sock.close()
+    return _percentile(ttfts, 0.5), _percentile(totals, 0.5)
+
+
+# -- scenarios --------------------------------------------------------------
+
+
+def _stats(latencies, wall, errors):
+    return {
+        'requests': len(latencies),
+        'errors': errors,
+        'p50_ms': round(1000 * _percentile(latencies, 0.50), 3),
+        'p99_ms': round(1000 * _percentile(latencies, 0.99), 3),
+        'throughput_rps': round(len(latencies) / wall, 1),
+    }
+
+
+def bench_overhead(requests_per_level, levels):
+    from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                                  start_load_balancer)
+    from skypilot_tpu.serve.load_balancing_policies import (
+        LoadBalancingPolicy)
+
+    replica = _start_replica(_EchoHandler)
+    rhost, rport = replica.server_address[:2]
+    results = {}
+    try:
+        for concurrency in levels:
+            level = {}
+            total = requests_per_level * max(1, concurrency // 4)
+            # direct: the floor the proxy adds overhead on top of.
+            level['direct'] = _stats(
+                *_run_load(rhost, rport, concurrency, total))
+            # async LB, keep-alive pools on (the shipped configuration).
+            os.environ.pop('SKYT_LB_POOL_SIZE', None)
+            lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+            lb.sync_replicas([(1, f'http://{rhost}:{rport}', 1.0)])
+            server = start_load_balancer(lb, '127.0.0.1', 0)
+            level['lb_pooled'] = _stats(
+                *_run_load('127.0.0.1', server.port, concurrency, total))
+            server.shutdown()
+            # async LB, pooling off: a TCP dial per upstream request
+            # (what the old proxy always paid).
+            os.environ['SKYT_LB_POOL_SIZE'] = '0'
+            lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+            lb.sync_replicas([(1, f'http://{rhost}:{rport}', 1.0)])
+            server = start_load_balancer(lb, '127.0.0.1', 0)
+            level['lb_per_request_conns'] = _stats(
+                *_run_load('127.0.0.1', server.port, concurrency, total))
+            server.shutdown()
+            os.environ.pop('SKYT_LB_POOL_SIZE', None)
+            # the old buffering thread-proxy, for the full picture.
+            old = _start_buffering_proxy(rhost, rport)
+            level['old_buffering_proxy'] = _stats(
+                *_run_load('127.0.0.1', old.server_address[1],
+                           concurrency, total))
+            old.shutdown()
+            for mode in ('lb_pooled', 'lb_per_request_conns',
+                         'old_buffering_proxy'):
+                level[f'{mode}_overhead_p50_ms'] = round(
+                    level[mode]['p50_ms'] - level['direct']['p50_ms'], 3)
+            results[f'concurrency_{concurrency}'] = level
+    finally:
+        replica.shutdown()
+    return results
+
+
+def bench_streaming(chunks, spacing):
+    from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                                  start_load_balancer)
+    from skypilot_tpu.serve.load_balancing_policies import (
+        LoadBalancingPolicy)
+
+    replica = _start_replica(_make_stream_handler(chunks, spacing))
+    rhost, rport = replica.server_address[:2]
+    result = {'chunks': chunks, 'chunk_spacing_ms': spacing * 1000}
+    try:
+        ttft, total = _measure_ttft(rhost, rport)
+        result['direct'] = {'ttft_ms': round(ttft * 1000, 1),
+                            'total_ms': round(total * 1000, 1)}
+        lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+        lb.sync_replicas([(1, f'http://{rhost}:{rport}', 1.0)])
+        server = start_load_balancer(lb, '127.0.0.1', 0)
+        ttft, total = _measure_ttft('127.0.0.1', server.port)
+        result['async_lb'] = {'ttft_ms': round(ttft * 1000, 1),
+                              'total_ms': round(total * 1000, 1)}
+        server.shutdown()
+        old = _start_buffering_proxy(rhost, rport)
+        ttft, total = _measure_ttft('127.0.0.1', old.server_address[1])
+        result['old_buffering_proxy'] = {
+            'ttft_ms': round(ttft * 1000, 1),
+            'total_ms': round(total * 1000, 1)}
+        old.shutdown()
+        result['ttft_speedup_vs_buffering'] = round(
+            result['old_buffering_proxy']['ttft_ms'] /
+            max(result['async_lb']['ttft_ms'], 0.1), 1)
+    finally:
+        replica.shutdown()
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='serve LB streaming/pooling bench')
+    parser.add_argument('--requests', type=int, default=400,
+                        help='base requests per concurrency level '
+                             '(scaled up with concurrency)')
+    parser.add_argument('--levels', default='1,16,64')
+    parser.add_argument('--stream-chunks', type=int, default=5)
+    parser.add_argument('--stream-spacing', type=float, default=0.2,
+                        help='seconds between streamed chunks — total '
+                             'stream time is (chunks-1)*spacing, the '
+                             'window a buffering proxy sits on the '
+                             'whole response')
+    args = parser.parse_args(argv)
+    levels = [int(x) for x in args.levels.split(',') if x.strip()]
+    results = {
+        'bench': 'serve_lb',
+        'ts': time.time(),
+        'overhead': bench_overhead(args.requests, levels),
+        'streaming': bench_streaming(args.stream_chunks,
+                                     args.stream_spacing),
+    }
+    json.dump(results, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -26,6 +26,14 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_control_plane.py \
   | tee "BENCH_control_plane_${suffix}.json"
 echo "rc=$? -> BENCH_control_plane_${suffix}.json" >&2
 
+# Serve data-plane bench: also CPU-only — async streaming LB vs the old
+# buffering thread-proxy (TTFT passthrough + keep-alive pooling at
+# concurrency 1/16/64; docs/serve_data_plane.md, numbers in PERF.md).
+echo "=== bench serve-lb ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_serve_lb.py \
+  | tee "BENCH_serve_lb_${suffix}.json"
+echo "rc=$? -> BENCH_serve_lb_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

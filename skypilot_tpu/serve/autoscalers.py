@@ -18,7 +18,7 @@ import dataclasses
 import enum
 import math
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -51,6 +51,11 @@ class LoadStats:
     qps: float = 0.0
     queue_length: float = 0.0      # total in-flight across replicas
     window_seconds: float = 60.0
+    # Per-replica EWMA time-to-first-byte (ms) measured by the async
+    # proxy — latency-aware autoscalers (and the status surface) see
+    # which replicas are slow, not just how many requests are in flight.
+    replica_latency_ms: Dict[int, float] = dataclasses.field(
+        default_factory=dict)
 
 
 def _alive(replicas: List[serve_state.ReplicaRecord]

@@ -103,6 +103,15 @@ class ServeController:
                 entries.append((record.replica_id, record.endpoint,
                                 _replica_weight(record)))
         self.lb.sync_replicas(entries)
+        # Publish the data plane's per-replica health (EWMA TTFB +
+        # circuit-breaker state) to the serve DB: `status` runs in
+        # other processes and can't read the LB's memory.
+        try:
+            serve_state.set_replica_lb_state(self.service_name,
+                                             self.lb.lb_state())
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('Service %s: lb-state publish failed',
+                             self.service_name)
 
     def _update_service_status(
             self, replicas: List[serve_state.ReplicaRecord]) -> None:

@@ -1,26 +1,59 @@
-"""Client-facing load balancer: an HTTP proxy over ready replicas.
+"""Client-facing load balancer: an asyncio streaming HTTP proxy over
+ready replicas.
 
-Parity: ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer :24). Runs
-inside the service process (thread), forwarding every request to a
-replica chosen by the policy, retrying the next replica on connection
-errors. It is also the service's load sensor: a timestamp ring for QPS
-and per-replica in-flight counters feed the autoscaler.
+Parity: ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer :24, which
+runs FastAPI/uvicorn + httpx streaming). Here it is one event loop in
+the existing service-process thread, built on raw asyncio streams:
+
+* **Keep-alive pools** — per-replica bounded pools of HTTP/1.1
+  connections with idle reaping; a reused connection skips the TCP
+  handshake on the request hot path (``skyt_lb_pool_reuse_total``).
+* **Streaming passthrough** — response bytes (Content-Length, chunked,
+  or close-delimited) are forwarded to the client as they arrive, so
+  SSE token streams from ``inference/server.py`` keep their
+  time-to-first-token through the proxy instead of being buffered into
+  wait-for-the-whole-completion.
+* **Bounded in-flight** — past ``SKYT_LB_MAX_INFLIGHT`` concurrent
+  proxied requests the LB fast-fails 503 + ``Retry-After`` instead of
+  queueing without bound.
+* **Retry safety** — failover replays a request only when zero request
+  bytes were sent to the failed replica (connect-stage failure), or the
+  method is idempotent (GET/HEAD/OPTIONS). A non-idempotent request
+  that died after any part of it was sent gets an honest 502, never a
+  silent duplicate.
+* **Passive outlier ejection** — consecutive-failure circuit breaker
+  per replica with a timed re-probe (half-open) so a flapping replica
+  stops eating failover attempts but is re-admitted once it recovers.
+
+It is also the service's load sensor: a monotonic timestamp ring for
+QPS, per-replica in-flight counters, and a per-replica EWMA of
+time-to-first-byte feed the autoscaler via ``LoadStats`` and the p2c
+policy via ``select(latencies=...)``.
+
+Knobs (read at construction):
+  SKYT_LB_POOL_SIZE          max idle conns kept per replica (8; 0
+                             disables reuse — every request dials)
+  SKYT_LB_POOL_IDLE_SECONDS  idle conn lifetime before reaping (30)
+  SKYT_LB_MAX_INFLIGHT       fast-fail 503 bound (256)
+  SKYT_LB_EJECT_THRESHOLD    consecutive failures before ejection (3)
+  SKYT_LB_EJECT_SECONDS      ejection duration before re-probe (10)
+  SKYT_LB_EWMA_ALPHA         latency EWMA smoothing factor (0.3)
+  SKYT_LB_UPSTREAM_TIMEOUT   per-read upstream timeout seconds (300)
 """
 from __future__ import annotations
 
+import asyncio
 import collections
-import http.client
-import http.server
+import os
 import socket
-import socketserver
 import threading
 import time
-import urllib.parse
-from typing import Dict, List, Optional
+from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 
 from skypilot_tpu.serve.autoscalers import LoadStats
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         ReplicaEntry)
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
 
 logger = log.init_logger(__name__)
@@ -29,25 +62,54 @@ MAX_ATTEMPTS = 3
 _HOP_HEADERS = {
     'connection', 'keep-alive', 'proxy-authenticate',
     'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
-    'upgrade', 'host',
+    'upgrade', 'host', 'expect',
 }
+# Methods safe to replay after request bytes reached a replica (RFC 9110
+# §9.2.2); everything else replays only when zero body bytes were sent.
+_IDEMPOTENT_METHODS = {'GET', 'HEAD', 'OPTIONS'}
+_MAX_HEAD_BYTES = 65536
+# The LB's own observability surface; leading "/-/" keeps it out of any
+# sane application's path space (documented in docs/serve_data_plane.md).
+LB_METRICS_PATH = '/-/lb/metrics'
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 class LoadBalancer:
-    """Policy + stats shared between the proxy handler and controller."""
+    """Policy + stats + replica health shared between the async proxy,
+    the controller loop, and the autoscaler."""
 
     def __init__(self, policy: LoadBalancingPolicy,
-                 qps_window_seconds: float = 60.0) -> None:
+                 qps_window_seconds: float = 60.0,
+                 retry_after_seconds: Optional[float] = None) -> None:
         self.policy = policy
         self._window = qps_window_seconds
+        # What a 503 tells clients to wait: the controller probe
+        # interval is how long until a down fleet can next change.
+        self.retry_after_seconds = max(1, int(retry_after_seconds or 10))
         self._lock = threading.Lock()
         self._request_times: collections.deque = collections.deque()
         self._in_flight: Dict[int, int] = collections.defaultdict(int)
+        # -- replica health (EWMA latency + circuit breaker) ----------
+        self._ewma_alpha = _env_float('SKYT_LB_EWMA_ALPHA', 0.3)
+        self._eject_threshold = int(
+            _env_float('SKYT_LB_EJECT_THRESHOLD', 3))
+        self._eject_seconds = _env_float('SKYT_LB_EJECT_SECONDS', 10.0)
+        self._ewma: Dict[int, float] = {}            # seconds (TTFB)
+        self._failures: Dict[int, int] = {}          # consecutive
+        self._ejected_until: Dict[int, float] = {}   # monotonic deadline
 
     # -- stats ---------------------------------------------------------
 
     def record_request(self) -> None:
-        now = time.time()
+        # Monotonic: a wall-clock step (NTP slew, manual reset) must not
+        # corrupt the QPS window the autoscaler scales on.
+        now = time.monotonic()
         with self._lock:
             self._request_times.append(now)
             while (self._request_times and
@@ -68,91 +130,754 @@ class LoadBalancer:
             return dict(self._in_flight)
 
     def load_stats(self) -> LoadStats:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             while (self._request_times and
                    self._request_times[0] < now - self._window):
                 self._request_times.popleft()
             qps = len(self._request_times) / self._window
             queue = sum(self._in_flight.values())
+            latency_ms = {rid: ewma * 1000.0
+                          for rid, ewma in self._ewma.items()}
         return LoadStats(qps=qps, queue_length=queue,
-                         window_seconds=self._window)
+                         window_seconds=self._window,
+                         replica_latency_ms=latency_ms)
+
+    # -- replica health ------------------------------------------------
+
+    def observe_latency(self, replica_id: int, seconds: float) -> None:
+        """A successful response head arrived: update the EWMA and close
+        any open circuit (success clears the breaker)."""
+        with self._lock:
+            previous = self._ewma.get(replica_id)
+            if previous is None:
+                self._ewma[replica_id] = seconds
+            else:
+                alpha = self._ewma_alpha
+                self._ewma[replica_id] = (alpha * seconds +
+                                          (1 - alpha) * previous)
+            self._failures.pop(replica_id, None)
+            if self._ejected_until.pop(replica_id, None) is not None:
+                logger.info('LB: replica %d recovered; ejection cleared.',
+                            replica_id)
+
+    def record_failure(self, replica_id: int) -> None:
+        with self._lock:
+            count = self._failures.get(replica_id, 0) + 1
+            self._failures[replica_id] = count
+            if count >= self._eject_threshold:
+                newly = replica_id not in self._ejected_until or \
+                    self._ejected_until[replica_id] <= time.monotonic()
+                self._ejected_until[replica_id] = (
+                    time.monotonic() + self._eject_seconds)
+                if newly:
+                    logger.warning(
+                        'LB: ejecting replica %d for %.1fs after %d '
+                        'consecutive failures.', replica_id,
+                        self._eject_seconds, count)
+
+    def ewma_snapshot(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._ewma)
+
+    def ejected_snapshot(self) -> Dict[int, float]:
+        """Replicas currently ejected -> seconds until re-probe."""
+        now = time.monotonic()
+        with self._lock:
+            return {rid: until - now
+                    for rid, until in self._ejected_until.items()
+                    if until > now}
+
+    def lb_state(self) -> Dict[int, Dict[str, float]]:
+        """Per-replica health for the service status surface (persisted
+        by the controller each tick — status() runs in other
+        processes)."""
+        entries = self.policy.replicas
+        now = time.monotonic()
+        state: Dict[int, Dict[str, float]] = {}
+        with self._lock:
+            for replica_id, _url, _weight in entries:
+                until = self._ejected_until.get(replica_id, 0.0)
+                ejected_for = max(0.0, until - now)
+                state[replica_id] = {
+                    'ewma_ms': self._ewma.get(replica_id, 0.0) * 1000.0,
+                    'ejected': 1.0 if ejected_for > 0 else 0.0,
+                    'ejected_for': ejected_for,
+                    'consecutive_failures': float(
+                        self._failures.get(replica_id, 0)),
+                }
+        return state
+
+    # -- fleet ---------------------------------------------------------
 
     def sync_replicas(self, replicas: List[ReplicaEntry]) -> None:
         self.policy.set_replicas(replicas)
+        live = {entry[0] for entry in replicas}
+        with self._lock:
+            for table in (self._ewma, self._failures, self._ejected_until):
+                for rid in [r for r in table if r not in live]:
+                    del table[rid]
 
-    def select(self, exclude=None) -> Optional[ReplicaEntry]:
-        return self.policy.select(self.in_flight_snapshot(), exclude)
+    def select(self, exclude: Optional[Set[int]] = None
+               ) -> Optional[ReplicaEntry]:
+        now = time.monotonic()
+        with self._lock:
+            ejected = {rid for rid, until in self._ejected_until.items()
+                       if until > now}
+        latencies = self.ewma_snapshot()
+        in_flight = self.in_flight_snapshot()
+        merged = set(exclude or ()) | ejected
+        entry = self.policy.select(in_flight, merged, latencies=latencies)
+        if entry is None and ejected:
+            # Every healthy candidate is gone: trying an ejected replica
+            # beats a guaranteed 503 (and doubles as its re-probe).
+            entry = self.policy.select(in_flight, set(exclude or ()),
+                                       latencies=latencies)
+        return entry
 
 
-class _ProxyHandler(http.server.BaseHTTPRequestHandler):
-    protocol_version = 'HTTP/1.1'
-    lb: LoadBalancer = None  # type: ignore[assignment]
+# ---------------------------------------------------------------------------
+# The asyncio data plane.
+# ---------------------------------------------------------------------------
 
-    def log_message(self, fmt: str, *args) -> None:  # silence stderr
-        pass
 
-    def _proxy(self) -> None:
+class _UpstreamPool:
+    """Bounded keep-alive connections to one replica endpoint. Loop-only
+    (no locking): acquire/release/reap all run on the proxy's event
+    loop."""
+
+    def __init__(self, host: str, port: int, max_idle: int,
+                 idle_seconds: float) -> None:
+        self.host = host
+        self.port = port
+        self.max_idle = max_idle
+        self.idle_seconds = idle_seconds
+        # LIFO: the most recently used connection is warmest and least
+        # likely to hit the server's keep-alive timeout.
+        self._idle: List[Tuple[asyncio.StreamReader,
+                               asyncio.StreamWriter, float]] = []
+
+    async def acquire(self) -> Tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter, bool]:
+        """Returns (reader, writer, reused)."""
+        now = time.monotonic()
+        while self._idle:
+            reader, writer, last_used = self._idle.pop()
+            if (writer.is_closing() or reader.at_eof() or
+                    now - last_used > self.idle_seconds):
+                writer.close()
+                continue
+            return reader, writer, True
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), timeout=10)
+        return reader, writer, False
+
+    def release(self, reader: asyncio.StreamReader,
+                writer: asyncio.StreamWriter) -> None:
+        if (self.max_idle > 0 and len(self._idle) < self.max_idle and
+                not writer.is_closing() and not reader.at_eof()):
+            self._idle.append((reader, writer, time.monotonic()))
+        else:
+            writer.close()
+
+    def reap(self) -> None:
+        now = time.monotonic()
+        keep = []
+        for conn in self._idle:
+            if now - conn[2] > self.idle_seconds or conn[1].is_closing():
+                conn[1].close()
+            else:
+                keep.append(conn)
+        self._idle = keep
+
+    def close_all(self) -> None:
+        for _reader, writer, _last in self._idle:
+            writer.close()
+        self._idle.clear()
+
+
+class _Request:
+    """One parsed client request (body fully buffered — request bodies
+    are prompts/configs; it is the *response* that streams)."""
+
+    def __init__(self, method: str, target: str, version: str,
+                 headers: List[Tuple[str, str]], body: bytes) -> None:
+        self.method = method
+        self.target = target
+        self.version = version
+        self.headers = headers
+        self.body = body
+
+    def header(self, name: str) -> Optional[str]:
+        name = name.lower()
+        for key, value in self.headers:
+            if key.lower() == name:
+                return value
+        return None
+
+    @property
+    def keep_alive(self) -> bool:
+        connection = (self.header('connection') or '').lower()
+        if self.version == 'HTTP/1.0':
+            return 'keep-alive' in connection
+        return 'close' not in connection
+
+
+class _UpstreamState:
+    """Mutable per-attempt bookkeeping the retry classifier reads."""
+
+    def __init__(self) -> None:
+        self.request_sent = False      # any request byte written upstream
+        self.responded = False         # any response byte sent to client
+
+
+async def _read_head(reader: asyncio.StreamReader) -> bytes:
+    head = await reader.readuntil(b'\r\n\r\n')
+    if len(head) > _MAX_HEAD_BYTES:
+        raise ValueError('header block too large')
+    return head
+
+
+def _parse_headers(block: bytes) -> List[Tuple[str, str]]:
+    headers: List[Tuple[str, str]] = []
+    for line in block.split(b'\r\n'):
+        if not line:
+            continue
+        if line[:1] in (b' ', b'\t') and headers:  # obs-fold
+            key, value = headers[-1]
+            headers[-1] = (key, value + ' ' + line.strip().decode('latin-1'))
+            continue
+        name, _, value = line.partition(b':')
+        headers.append((name.strip().decode('latin-1'),
+                        value.strip().decode('latin-1')))
+    return headers
+
+
+async def _read_body(reader: asyncio.StreamReader,
+                     headers: List[Tuple[str, str]]) -> bytes:
+    mapping = {k.lower(): v for k, v in headers}
+    encoding = mapping.get('transfer-encoding', '').lower()
+    if 'chunked' in encoding:
+        chunks = []
+        while True:
+            size_line = await reader.readuntil(b'\r\n')
+            size = int(size_line.split(b';')[0], 16)
+            if size == 0:
+                while await reader.readuntil(b'\r\n') != b'\r\n':
+                    pass
+                break
+            data = await reader.readexactly(size + 2)
+            chunks.append(data[:-2])
+        return b''.join(chunks)
+    length = int(mapping.get('content-length') or 0)
+    if length:
+        return await reader.readexactly(length)
+    return b''
+
+
+class _AsyncProxy:
+    """The event-loop half: accepts client connections, proxies each
+    request over pooled upstream connections, streams responses."""
+
+    def __init__(self, lb: LoadBalancer) -> None:
+        self.lb = lb
+        self.pool_size = int(_env_float('SKYT_LB_POOL_SIZE', 8))
+        self.pool_idle_seconds = _env_float('SKYT_LB_POOL_IDLE_SECONDS',
+                                            30.0)
+        self.max_inflight = int(_env_float('SKYT_LB_MAX_INFLIGHT', 256))
+        self.upstream_timeout = _env_float('SKYT_LB_UPSTREAM_TIMEOUT',
+                                           300.0)
+        self._pools: Dict[Tuple[str, int], _UpstreamPool] = {}
+        self._inflight = 0
+        self.server: Optional[asyncio.base_events.Server] = None
+
+    # -- helpers -------------------------------------------------------
+
+    def _pool_for(self, url: str) -> _UpstreamPool:
+        import urllib.parse
+        parsed = urllib.parse.urlsplit(url)
+        key = (parsed.hostname or '127.0.0.1', parsed.port or 80)
+        pool = self._pools.get(key)
+        if pool is None:
+            pool = _UpstreamPool(key[0], key[1], self.pool_size,
+                                 self.pool_idle_seconds)
+            self._pools[key] = pool
+        return pool
+
+    async def reap_loop(self) -> None:
+        import urllib.parse
+        interval = max(1.0, self.pool_idle_seconds / 2)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                # Drop pools for endpoints that left the fleet (an
+                # autoscaled service churns through replica endpoints;
+                # append-only pools would grow without bound).
+                live = set()
+                for _rid, url, _w in self.lb.policy.replicas:
+                    parsed = urllib.parse.urlsplit(url)
+                    live.add((parsed.hostname or '127.0.0.1',
+                              parsed.port or 80))
+                for key in [k for k in self._pools if k not in live]:
+                    self._pools.pop(key).close_all()
+                for pool in self._pools.values():
+                    pool.reap()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('LB: pool reap tick failed')
+
+    def close_pools(self) -> None:
+        for pool in self._pools.values():
+            pool.close_all()
+
+    @staticmethod
+    def _metrics():
+        from skypilot_tpu.server import metrics
+        return metrics
+
+    async def _respond_simple(self, writer: asyncio.StreamWriter,
+                              status: int, reason: str, body: bytes,
+                              extra_headers: Tuple[Tuple[str, str], ...] = (),
+                              content_type: str = 'text/plain; '
+                                                  'charset=utf-8') -> None:
+        lines = [f'HTTP/1.1 {status} {reason}'.encode(),
+                 f'Content-Type: {content_type}'.encode(),
+                 b'Content-Length: ' + str(len(body)).encode()]
+        for key, value in extra_headers:
+            lines.append(f'{key}: {value}'.encode())
+        writer.write(b'\r\n'.join(lines) + b'\r\n\r\n' + body)
+        await writer.drain()
+
+    # -- client connection loop ----------------------------------------
+
+    async def handle_client(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    head = await _read_head(reader)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        asyncio.LimitOverrunError, ValueError):
+                    return
+                try:
+                    request = self._parse_request(head)
+                    expect = (request.header('expect') or '').lower()
+                    if '100-continue' in expect:
+                        # The old BaseHTTPRequestHandler proxy answered
+                        # this automatically; clients like curl stall
+                        # waiting for it before sending the body.
+                        writer.write(b'HTTP/1.1 100 Continue\r\n\r\n')
+                        await writer.drain()
+                    request.body = await _read_body(reader, request.headers)
+                except (ValueError, asyncio.IncompleteReadError,
+                        ConnectionError):
+                    await self._respond_simple(writer, 400, 'Bad Request',
+                                               b'malformed request\n')
+                    return
+                if request.target == LB_METRICS_PATH:
+                    payload = self._metrics().render_lb_text().encode()
+                    await self._respond_simple(
+                        writer, 200, 'OK', payload,
+                        content_type='text/plain; version=0.0.4')
+                    if not request.keep_alive:
+                        return
+                    continue
+                client_usable = await self._proxy_one(request, writer)
+                if not client_usable or not request.keep_alive:
+                    return
+        except (ConnectionError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+    def _parse_request(self, head: bytes) -> _Request:
+        request_line, _, header_block = head.partition(b'\r\n')
+        parts = request_line.decode('latin-1').split()
+        if len(parts) != 3:
+            raise ValueError(f'bad request line: {request_line!r}')
+        method, target, version = parts
+        return _Request(method.upper(), target, version,
+                        _parse_headers(header_block), b'')
+
+    # -- the proxy core ------------------------------------------------
+
+    async def _proxy_one(self, request: _Request,
+                         client: asyncio.StreamWriter) -> bool:
+        """Proxy one request; returns whether the client connection is
+        still usable for the next request."""
+        metrics = self._metrics()
         lb = self.lb
         lb.record_request()
-        length = int(self.headers.get('Content-Length') or 0)
-        body = self.rfile.read(length) if length else None
-        tried = set()
-        for _ in range(MAX_ATTEMPTS):
-            entry = lb.select(exclude=tried)
-            if entry is None:
-                break
-            replica_id, url, _weight = entry
-            tried.add(replica_id)
-            parsed = urllib.parse.urlsplit(url)
-            lb.begin(replica_id)
-            try:
-                conn = http.client.HTTPConnection(parsed.hostname,
-                                                  parsed.port, timeout=300)
-                headers = {k: v for k, v in self.headers.items()
-                           if k.lower() not in _HOP_HEADERS}
-                conn.request(self.command, self.path, body=body,
-                             headers=headers)
-                resp = conn.getresponse()
-                payload = resp.read()
-                self.send_response(resp.status)
-                for key, value in resp.getheaders():
-                    if key.lower() not in _HOP_HEADERS | {'content-length'}:
-                        self.send_header(key, value)
-                self.send_header('Content-Length', str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-                conn.close()
-                return
-            except (ConnectionError, socket.timeout, OSError,
-                    http.client.HTTPException) as e:
-                logger.warning('LB: replica %d unreachable (%s); retrying.',
-                               replica_id, e)
+        if self._inflight >= self.max_inflight:
+            metrics.LB_REQUESTS.inc(outcome='saturated')
+            await self._respond_simple(
+                client, 503, 'Service Unavailable',
+                b'Load balancer saturated\n',
+                (('Retry-After', '1'),))
+            return True
+        self._inflight += 1
+        start = time.monotonic()
+        tried: Set[int] = set()
+        try:
+            for _ in range(MAX_ATTEMPTS):
+                entry = lb.select(exclude=tried)
+                if entry is None:
+                    break
+                replica_id, url, _weight = entry
+                tried.add(replica_id)
+                pool = self._pool_for(url)
+                state = _UpstreamState()
+                lb.begin(replica_id)
+                try:
+                    usable = await self._attempt(request, client, pool,
+                                                 replica_id, state, start)
+                    metrics.LB_REQUESTS.inc(outcome='ok')
+                    return usable
+                except _ClientGone:
+                    # The *client* went away mid-stream: not a replica
+                    # failure, nothing to retry.
+                    metrics.LB_REQUESTS.inc(outcome='client_abort')
+                    return False
+                except (ConnectionError, OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError, ValueError) as e:
+                    lb.record_failure(replica_id)
+                    logger.warning('LB: replica %d failed (%s: %s).',
+                                   replica_id, type(e).__name__, e)
+                    if state.responded:
+                        # Part of the response already reached the
+                        # client — the only honest move is to cut the
+                        # connection so the client sees the truncation.
+                        metrics.LB_REQUESTS.inc(outcome='aborted')
+                        return False
+                    if (state.request_sent and
+                            request.method not in _IDEMPOTENT_METHODS):
+                        # The replica may have acted on the request
+                        # (even a body-less POST mutates once its head
+                        # is delivered): replaying could duplicate a
+                        # non-idempotent effect.
+                        metrics.LB_REQUESTS.inc(outcome='no_retry')
+                        await self._respond_simple(
+                            client, 502, 'Bad Gateway',
+                            b'Replica failed after request was sent; '
+                            b'not retried (non-idempotent)\n')
+                        return True
+                    continue
+                finally:
+                    lb.end(replica_id)
+            retry_after = str(lb.retry_after_seconds)
+            if not tried:
+                metrics.LB_REQUESTS.inc(outcome='no_replica')
+                await self._respond_simple(
+                    client, 503, 'Service Unavailable',
+                    b'No ready replicas\n',
+                    (('Retry-After', retry_after),))
+            else:
+                metrics.LB_REQUESTS.inc(outcome='upstream_error')
+                await self._respond_simple(
+                    client, 502, 'Bad Gateway',
+                    b'All attempted replicas failed\n',
+                    (('Retry-After', retry_after),))
+            return True
+        finally:
+            self._inflight -= 1
+
+    async def _attempt(self, request: _Request,
+                       client: asyncio.StreamWriter, pool: _UpstreamPool,
+                       replica_id: int, state: _UpstreamState,
+                       start: float) -> bool:
+        """One upstream attempt: send, stream response back. Raises the
+        caller-classified exceptions on upstream failure; raises
+        _ClientGone when the client write side fails."""
+        fault_injection.inject('load_balancer.forward')
+        metrics = self._metrics()
+        attempt_start = time.monotonic()
+        reader, writer, reused = await pool.acquire()
+        if reused:
+            metrics.LB_POOL_REUSE.inc()
+        release = False
+        try:
+            self._write_request(writer, request, pool, state)
+            await writer.drain()
+            allow_chunked = request.version != 'HTTP/1.0'
+            while True:
+                head = await asyncio.wait_for(
+                    _read_head(reader), timeout=self.upstream_timeout)
+                (status, reason, resp_headers, body_iter,
+                 upstream_reusable) = self._parse_response(
+                     reader, head, request.method, allow_chunked)
+                # Interim 1xx responses are not the final answer: read
+                # on (we never forward Expect upstream, so none are
+                # owed to the client).
+                if not 100 <= status < 200:
+                    break
+            now = time.monotonic()
+            # The histogram is the client's view (request arrival ->
+            # response head); the EWMA is the replica's: a failed
+            # earlier attempt's latency must not be billed to the
+            # replica that actually answered.
+            metrics.LB_TTFB.observe(now - start)
+            self.lb.observe_latency(replica_id, now - attempt_start)
+            client_keep = await self._stream_response(
+                client, status, reason, resp_headers, body_iter,
+                upstream_reusable, state)
+            release = upstream_reusable
+            return client_keep
+        finally:
+            if release:
+                pool.release(reader, writer)
+            else:
+                writer.close()
+
+    def _write_request(self, writer: asyncio.StreamWriter,
+                       request: _Request, pool: _UpstreamPool,
+                       state: _UpstreamState) -> None:
+        lines = [f'{request.method} {request.target} HTTP/1.1'.encode(),
+                 f'Host: {pool.host}:{pool.port}'.encode()]
+        for key, value in request.headers:
+            low = key.lower()
+            if low in _HOP_HEADERS or low == 'content-length':
                 continue
-            finally:
-                lb.end(replica_id)
-        self.send_response(503)
-        message = b'No ready replicas\n'
-        self.send_header('Content-Length', str(len(message)))
-        self.end_headers()
-        self.wfile.write(message)
+            lines.append(f'{key}: {value}'.encode())
+        lines.append(
+            b'Content-Length: ' + str(len(request.body)).encode())
+        lines.append(b'Connection: keep-alive')
+        # From here on the replica may have observed (and acted on) the
+        # request — even a body-less POST mutates once its head lands —
+        # so failover must not replay non-idempotent methods.
+        state.request_sent = True
+        writer.write(b'\r\n'.join(lines) + b'\r\n\r\n')
+        if request.body:
+            writer.write(request.body)
 
-    do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = do_HEAD = _proxy
+    def _parse_response(self, reader: asyncio.StreamReader, head: bytes,
+                        method: str, allow_chunked: bool = True):
+        status_line, _, header_block = head.partition(b'\r\n')
+        parts = status_line.decode('latin-1').split(None, 2)
+        if len(parts) < 2 or not parts[0].startswith('HTTP/'):
+            raise ValueError(f'bad status line: {status_line!r}')
+        version = parts[0]
+        status = int(parts[1])
+        reason = parts[2] if len(parts) > 2 else ''
+        headers = _parse_headers(header_block)
+        mapping = {k.lower(): v for k, v in headers}
+        connection = mapping.get('connection', '').lower()
+        reusable = ('close' not in connection and
+                    (version == 'HTTP/1.1' or 'keep-alive' in connection))
+        no_body = (method == 'HEAD' or status in (204, 304) or
+                   100 <= status < 200)
+        if no_body:
+            return status, reason, headers, self._empty_body(), reusable
+        encoding = mapping.get('transfer-encoding', '').lower()
+        if 'chunked' in encoding:
+            if allow_chunked:
+                return (status, reason, headers,
+                        self._chunked_body(reader), reusable)
+            # HTTP/1.0 client can't parse chunked framing: de-chunk and
+            # deliver close-delimited (drop the TE header so the
+            # streamer picks the Connection: close path). The chunk
+            # parse still finds the terminator, so the upstream
+            # connection stays reusable.
+            headers = [(k, v) for k, v in headers
+                       if k.lower() != 'transfer-encoding']
+            return (status, reason, headers,
+                    self._chunked_body(reader, framed=False), reusable)
+        if 'content-length' in mapping:
+            length = int(mapping['content-length'])
+            return (status, reason, headers,
+                    self._sized_body(reader, length), reusable)
+        # Close-delimited (HTTP/1.0 style): stream to EOF; the upstream
+        # connection is spent and the client needs Connection: close.
+        return status, reason, headers, self._eof_body(reader), False
+
+    @staticmethod
+    async def _empty_body() -> AsyncIterator[bytes]:
+        return
+        yield b''  # pragma: no cover — makes this an async generator
+
+    async def _sized_body(self, reader: asyncio.StreamReader,
+                          length: int) -> AsyncIterator[bytes]:
+        remaining = length
+        while remaining > 0:
+            chunk = await asyncio.wait_for(
+                reader.read(min(remaining, 65536)),
+                timeout=self.upstream_timeout)
+            if not chunk:
+                raise asyncio.IncompleteReadError(b'', remaining)
+            remaining -= len(chunk)
+            yield chunk
+
+    async def _chunked_body(self, reader: asyncio.StreamReader,
+                            framed: bool = True) -> AsyncIterator[bytes]:
+        """Forward the chunked framing verbatim (``framed``, the normal
+        HTTP/1.1 case: the client receives Transfer-Encoding: chunked),
+        parsing just enough to find the terminator so the upstream
+        connection stays reusable; or de-chunked payload bytes
+        (``framed=False``, for HTTP/1.0 clients). Each chunk is yielded
+        as it arrives — this is the SSE/TTFT hot path."""
+        while True:
+            size_line = await asyncio.wait_for(
+                reader.readuntil(b'\r\n'), timeout=self.upstream_timeout)
+            size = int(size_line.split(b';')[0], 16)
+            if size == 0:
+                trailer = size_line
+                while True:
+                    line = await asyncio.wait_for(
+                        reader.readuntil(b'\r\n'),
+                        timeout=self.upstream_timeout)
+                    trailer += line
+                    if line == b'\r\n':
+                        if framed:
+                            yield trailer
+                        return
+            data = await asyncio.wait_for(
+                reader.readexactly(size + 2),
+                timeout=self.upstream_timeout)
+            yield (size_line + data) if framed else data[:-2]
+
+    async def _eof_body(self, reader: asyncio.StreamReader
+                        ) -> AsyncIterator[bytes]:
+        while True:
+            chunk = await asyncio.wait_for(reader.read(65536),
+                                           timeout=self.upstream_timeout)
+            if not chunk:
+                return
+            yield chunk
+
+    async def _stream_response(self, client: asyncio.StreamWriter,
+                               status: int, reason: str,
+                               headers: List[Tuple[str, str]],
+                               body_iter: AsyncIterator[bytes],
+                               upstream_reusable: bool,
+                               state: _UpstreamState) -> bool:
+        """Forward head + body to the client as bytes arrive. Returns
+        whether the client connection can serve another request."""
+        mapping = {k.lower(): v for k, v in headers}
+        chunked = 'chunked' in mapping.get('transfer-encoding', '').lower()
+        framed = chunked or 'content-length' in mapping
+        lines = [f'HTTP/1.1 {status} {reason}'.rstrip().encode()]
+        for key, value in headers:
+            low = key.lower()
+            if low in _HOP_HEADERS and not (low == 'transfer-encoding'
+                                            and chunked):
+                continue
+            lines.append(f'{key}: {value}'.encode())
+        # Close-delimited upstream body: only a close can mark the end
+        # for the client, too.
+        client_keep = framed
+        lines.append(b'Connection: keep-alive' if client_keep
+                     else b'Connection: close')
+        head = b'\r\n'.join(lines) + b'\r\n\r\n'
+        try:
+            client.write(head)
+            await client.drain()
+        except (ConnectionError, BrokenPipeError, OSError) as e:
+            raise _ClientGone() from e
+        state.responded = True
+        while True:
+            try:
+                chunk = await body_iter.__anext__()
+            except StopAsyncIteration:
+                break
+            try:
+                # write + drain per chunk: the whole point is that an
+                # SSE token frame reaches the client the moment the
+                # replica emits it, not when the response completes.
+                client.write(chunk)
+                await client.drain()
+            except (ConnectionError, BrokenPipeError, OSError) as e:
+                raise _ClientGone() from e
+        return client_keep
 
 
-class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
-                           http.server.HTTPServer):
-    daemon_threads = True
-    allow_reuse_address = True
+class _ClientGone(Exception):
+    """The downstream client hung up; distinct from replica failure."""
+
+
+# ---------------------------------------------------------------------------
+# Thread plumbing: same surface service.py has always used.
+# ---------------------------------------------------------------------------
+
+
+class LoadBalancerServer:
+    """Handle returned by start_load_balancer: the event loop runs in a
+    daemon thread; shutdown() is callable from any thread (idempotent,
+    matching the old ThreadingHTTPServer surface)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop,
+                 thread: threading.Thread, proxy: _AsyncProxy,
+                 port: int) -> None:
+        self._loop = loop
+        self._thread = thread
+        self._proxy = proxy
+        self.port = port
+        self._shutdown = False
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+
+        def _stop() -> None:
+            if self._proxy.server is not None:
+                self._proxy.server.close()
+            self._proxy.close_pools()
+            self._loop.stop()
+
+        try:
+            self._loop.call_soon_threadsafe(_stop)
+        except RuntimeError:
+            return  # loop already gone
+        self._thread.join(timeout=5)
 
 
 def start_load_balancer(lb: LoadBalancer, host: str,
-                        port: int) -> _ThreadingHTTPServer:
-    """Bind and serve in a daemon thread; returns the server."""
-    handler = type('BoundProxyHandler', (_ProxyHandler,), {'lb': lb})
-    server = _ThreadingHTTPServer((host, port), handler)
-    thread = threading.Thread(target=server.serve_forever,
-                              name=f'lb-{port}', daemon=True)
+                        port: int) -> LoadBalancerServer:
+    """Bind (raising OSError here, in the caller, on a taken port — the
+    service process rebinds on a free one) and serve on an event loop in
+    a daemon thread."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        sock.bind((host, port))
+    except OSError:
+        sock.close()
+        raise
+    sock.listen(128)
+    sock.setblocking(False)
+    bound_port = sock.getsockname()[1]
+
+    loop = asyncio.new_event_loop()
+    proxy = _AsyncProxy(lb)
+    started = threading.Event()
+
+    def run() -> None:
+        asyncio.set_event_loop(loop)
+        proxy.server = loop.run_until_complete(
+            asyncio.start_server(proxy.handle_client, sock=sock))
+        reaper = loop.create_task(proxy.reap_loop())
+        started.set()
+        try:
+            loop.run_forever()
+        finally:
+            reaper.cancel()
+            proxy.close_pools()
+            pending = asyncio.all_tasks(loop)
+            for task in pending:
+                task.cancel()
+            try:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+                loop.run_until_complete(loop.shutdown_asyncgens())
+            except Exception:  # pylint: disable=broad-except
+                pass
+            loop.close()
+
+    thread = threading.Thread(target=run, name=f'lb-{bound_port}',
+                              daemon=True)
     thread.start()
-    logger.info('Load balancer listening on %s:%d', host, port)
-    return server
+    started.wait(timeout=10)
+    logger.info('Load balancer listening on %s:%d', host, bound_port)
+    return LoadBalancerServer(loop, thread, proxy, bound_port)

@@ -1,14 +1,19 @@
 """Load-balancing policies (parity: ``sky/serve/load_balancing_policies.py``
 RoundRobin :85, LeastLoad :111 — the default — and
-InstanceAwareLeastLoad :151).
+InstanceAwareLeastLoad :151; ``p2c_ewma`` goes beyond the reference with
+power-of-two-choices over latency feedback, the tail-tolerant dispatch
+of "The Tail at Scale").
 
 A policy sees the ready-replica set as ``(replica_id, url, weight)``
 tuples, where weight is the replica's relative capacity (TPU chip count
-for heterogeneous services), and the per-replica in-flight request count
-maintained by the load balancer.
+for heterogeneous services), the per-replica in-flight request count
+maintained by the load balancer, and (optionally) the per-replica EWMA
+of time-to-first-byte in seconds (``latencies``) the async proxy
+measures on every response.
 """
 from __future__ import annotations
 
+import random
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -40,11 +45,14 @@ class LoadBalancingPolicy:
         return replicas
 
     def select(self, in_flight: Dict[int, int],
-               exclude: Optional[Set[int]] = None
+               exclude: Optional[Set[int]] = None,
+               latencies: Optional[Dict[int, float]] = None
                ) -> Optional[ReplicaEntry]:
         """Pick a replica for the next request; None if none ready.
-        ``exclude`` holds replicas that already failed this request (the
-        proxy's failover must not re-pick a dead replica)."""
+        ``exclude`` holds replicas that already failed this request or
+        are circuit-breaker-ejected (the proxy's failover must not
+        re-pick a dead replica); ``latencies`` is the per-replica EWMA
+        TTFB in seconds (policies that don't use it ignore it)."""
         raise NotImplementedError
 
     @classmethod
@@ -61,7 +69,8 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         self._index = 0
 
     def select(self, in_flight: Dict[int, int],
-               exclude: Optional[Set[int]] = None
+               exclude: Optional[Set[int]] = None,
+               latencies: Optional[Dict[int, float]] = None
                ) -> Optional[ReplicaEntry]:
         with self._lock:
             replicas = self._replicas
@@ -79,7 +88,8 @@ class LeastLoadPolicy(LoadBalancingPolicy):
     """Fewest in-flight requests wins (ref :111, the default)."""
 
     def select(self, in_flight: Dict[int, int],
-               exclude: Optional[Set[int]] = None
+               exclude: Optional[Set[int]] = None,
+               latencies: Optional[Dict[int, float]] = None
                ) -> Optional[ReplicaEntry]:
         replicas = self._candidates(exclude)
         if not replicas:
@@ -93,7 +103,8 @@ class InstanceAwareLeastLoadPolicy(LoadBalancingPolicy):
     the traffic of a v5e-4 one (ref :151 weights by instance type)."""
 
     def select(self, in_flight: Dict[int, int],
-               exclude: Optional[Set[int]] = None
+               exclude: Optional[Set[int]] = None,
+               latencies: Optional[Dict[int, float]] = None
                ) -> Optional[ReplicaEntry]:
         replicas = self._candidates(exclude)
         if not replicas:
@@ -101,3 +112,47 @@ class InstanceAwareLeastLoadPolicy(LoadBalancingPolicy):
         return min(replicas,
                    key=lambda e: (in_flight.get(e[0], 0) / max(e[2], 1e-9),
                                   e[0]))
+
+
+@LB_POLICY_REGISTRY.register('p2c_ewma')
+class P2cEwmaPolicy(LoadBalancingPolicy):
+    """Power-of-two-choices over an EWMA latency estimate ("The Tail at
+    Scale"): sample two replicas uniformly, send to the one with the
+    lower expected cost ``(in_flight + 1) * ewma_ttfb / weight`` —
+    capacity-weighted like instance_aware_least_load, so a v5e-8
+    replica absorbs 2x the traffic of an equally-fast v5e-4 one.
+
+    p2c keeps the O(1) pick and, unlike full-scan least-latency,
+    avoids the thundering-herd on whichever replica last looked
+    fastest. A replica with no latency sample yet costs as if it were
+    fast — new replicas get probed instead of starved."""
+
+    # Cost floor for never-measured replicas: attractively fast, so the
+    # first request lands and produces a real sample.
+    _COLD_LATENCY = 1e-3
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._rng = random.Random()
+
+    def _cost(self, entry: ReplicaEntry, in_flight: Dict[int, int],
+              latencies: Dict[int, float]) -> float:
+        replica_id, _url, weight = entry
+        latency = max(latencies.get(replica_id, 0.0), self._COLD_LATENCY)
+        return ((in_flight.get(replica_id, 0) + 1) * latency /
+                max(weight, 1e-9))
+
+    def select(self, in_flight: Dict[int, int],
+               exclude: Optional[Set[int]] = None,
+               latencies: Optional[Dict[int, float]] = None
+               ) -> Optional[ReplicaEntry]:
+        replicas = self._candidates(exclude)
+        if not replicas:
+            return None
+        latencies = latencies or {}
+        if len(replicas) <= 2:
+            pair = replicas
+        else:
+            pair = self._rng.sample(replicas, 2)
+        return min(pair, key=lambda e: (self._cost(e, in_flight,
+                                                   latencies), e[0]))

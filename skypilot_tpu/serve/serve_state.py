@@ -153,6 +153,22 @@ def _db():
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE services ADD COLUMN '
                 'controller_pid_created REAL')
+        replica_cols = {r['name'] for r in
+                        conn.execute('PRAGMA table_info(replicas)')}
+        if 'lb_ewma_ms' not in replica_cols:
+            # Data-plane health persisted by the controller each tick:
+            # `status` runs in other processes and can't read the LB's
+            # in-memory EWMA/breaker state directly.
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN lb_ewma_ms REAL')
+        if 'lb_ejected' not in replica_cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN '
+                'lb_ejected INTEGER DEFAULT 0')
+        if 'lb_ejected_until' not in replica_cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN '
+                'lb_ejected_until REAL')
         conn.commit()
 
     os.makedirs(serve_dir(), exist_ok=True)
@@ -416,6 +432,14 @@ class ReplicaRecord:
         self.launched_at: Optional[float] = row['launched_at']
         self.ready_at: Optional[float] = row['ready_at']
         self.consecutive_failures: int = row['consecutive_failures']
+        keys = row.keys()
+        self.lb_ewma_ms: Optional[float] = (
+            row['lb_ewma_ms'] if 'lb_ewma_ms' in keys else None)
+        self.lb_ejected: bool = bool(
+            row['lb_ejected'] if 'lb_ejected' in keys else 0)
+        self.lb_ejected_until: Optional[float] = (
+            row['lb_ejected_until'] if 'lb_ejected_until' in keys
+            else None)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -428,6 +452,11 @@ class ReplicaRecord:
             'zone': self.zone,
             'launched_at': self.launched_at,
             'ready_at': self.ready_at,
+            # Data-plane health (per-replica EWMA TTFB + breaker state
+            # from the LB, persisted each controller tick).
+            'lb_ewma_ms': self.lb_ewma_ms,
+            'lb_ejected': self.lb_ejected,
+            'lb_ejected_until': self.lb_ejected_until,
         }
 
 
@@ -494,6 +523,27 @@ def set_replica_endpoint(service_name: str, replica_id: int, endpoint: str,
         'UPDATE replicas SET endpoint = ?, zone = ? '
         'WHERE service_name = ? AND replica_id = ?',
         (endpoint, zone, service_name, replica_id))
+    conn.commit()
+
+
+def set_replica_lb_state(service_name: str,
+                         states: Dict[int, Dict[str, float]]) -> None:
+    """Persist the LB's per-replica health (ewma_ms / ejected /
+    ejected_for seconds) so `status` in other processes can show it.
+    Monotonic ejection deadlines are converted to wall-clock here."""
+    if not states:
+        return
+    conn = _db()
+    now = time.time()
+    for replica_id, state in states.items():
+        ejected = bool(state.get('ejected'))
+        until = (now + state.get('ejected_for', 0.0)) if ejected else None
+        conn.execute(
+            'UPDATE replicas SET lb_ewma_ms = ?, lb_ejected = ?, '
+            'lb_ejected_until = ? '
+            'WHERE service_name = ? AND replica_id = ?',
+            (state.get('ewma_ms'), int(ejected), until,
+             service_name, replica_id))
     conn.commit()
 
 

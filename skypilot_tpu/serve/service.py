@@ -45,8 +45,12 @@ def run_service(service_name: str) -> None:
     server = None
     lb = None
     if not spec.pool:
+        from skypilot_tpu.serve.controller import POLL_SECONDS
         policy = LoadBalancingPolicy.make(spec.load_balancing_policy)
-        lb = LoadBalancer(policy, qps_window_seconds=spec.qps_window_seconds)
+        # Retry-After on 503s = the probe interval: how long until the
+        # controller can next change a down fleet.
+        lb = LoadBalancer(policy, qps_window_seconds=spec.qps_window_seconds,
+                          retry_after_seconds=POLL_SECONDS)
         host = os.environ.get('SKYT_SERVE_LB_HOST', '127.0.0.1')
         assert record.lb_port is not None
         try:

@@ -12,6 +12,12 @@ Spot-with-fallback knobs (``base_ondemand_fallback_replicas``,
 (sky/serve/autoscalers.py:933): TPU spot slices are cheap but vanish as
 a unit, so a service can keep a floor of on-demand replicas and/or
 temporarily backfill with on-demand while spot recovers.
+
+``load_balancing_policy`` selects how the data plane picks a replica:
+``least_load`` (default), ``round_robin``, ``instance_aware_least_load``
+(in-flight per unit of TPU capacity), or ``p2c_ewma``
+(power-of-two-choices over EWMA time-to-first-byte, capacity-weighted;
+see docs/serve_data_plane.md).
 """
 from __future__ import annotations
 

@@ -150,8 +150,31 @@ NOTIFICATIONS = Counter(
     'Notification-bus publishes by topic and outcome '
     '(delivered vs suppressed)')
 
+# -- serve data plane (incremented by the async LB inside each service
+# process; scraped from the LB's own /-/lb/metrics path, since the LB
+# does not share a process with the API server) ------------------------
+
+_TTFB_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1, 2.5, 5, 10, 30, float('inf'))
+
+LB_REQUESTS = Counter(
+    'skyt_lb_requests_total',
+    'Serve LB proxied requests by outcome (ok, no_replica, saturated, '
+    'upstream_error, no_retry, aborted, client_abort)')
+LB_TTFB = Histogram(
+    'skyt_lb_ttfb_seconds',
+    'Serve LB time from request arrival to upstream response head '
+    '(the streamed-TTFT floor through the proxy)',
+    buckets=_TTFB_BUCKETS)
+LB_POOL_REUSE = Counter(
+    'skyt_lb_pool_reuse_total',
+    'Serve LB upstream requests served over a reused keep-alive '
+    'connection (vs a fresh TCP dial)')
+
+_LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE]
+
 _ALL = [REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
-        RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS]
+        RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS] + _LB_METRICS
 
 
 def collect_from_db() -> None:
@@ -202,6 +225,16 @@ def render_text() -> str:
     collect_from_db()
     lines: List[str] = []
     for metric in _ALL:
+        lines.extend(metric.render())
+    return '\n'.join(lines) + '\n'
+
+
+def render_lb_text() -> str:
+    """The serve LB's own scrape surface (``GET /-/lb/metrics`` on the
+    LB port): just the data-plane metrics, no DB collection — this runs
+    inside the service process's event loop."""
+    lines: List[str] = []
+    for metric in _LB_METRICS:
         lines.extend(metric.render())
     return '\n'.join(lines) + '\n'
 

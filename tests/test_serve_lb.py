@@ -1,0 +1,639 @@
+"""Serve data-plane tests: the asyncio streaming load balancer
+(serve/load_balancer.py) against in-process stub replicas — no clusters,
+no controller; just LoadBalancer + start_load_balancer, the exact
+surface the service process uses.
+
+Covers the PR-4 data-plane semantics: SSE/chunked passthrough (TTFT
+through the LB is bounded by the replica's first chunk, not total
+completion), keep-alive pool reuse, retry safety (non-idempotent
+requests are never replayed after body bytes reached a replica),
+no-replica 503 + Retry-After, saturation fast-fail, the p2c_ewma
+policy, and circuit-breaker ejection + timed re-probe (chaos, via
+SKYT_FAULT_SPEC).
+"""
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                              start_load_balancer)
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.server import metrics
+from tests.fault_injection import inject_faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_for_tests()
+    yield
+    metrics.reset_for_tests()
+
+
+# -- stub replicas ----------------------------------------------------------
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):
+        pass
+
+    def _respond(self):
+        length = int(self.headers.get('Content-Length') or 0)
+        data = self.rfile.read(length) if length else b''
+        body = json.dumps({'path': self.path, 'method': self.command,
+                           'body': data.decode('utf-8', 'replace'),
+                           'port': self.server.server_address[1]}).encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = do_PUT = do_DELETE = _respond
+
+
+class _CountingEcho(_EchoHandler):
+    """Echo that counts distinct upstream TCP connections."""
+
+    def setup(self):
+        self.server.connection_count += 1  # type: ignore[attr-defined]
+        super().setup()
+
+
+def _make_sse_handler(chunks, spacing, emit_times):
+    class _SSEHandler(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+            for i in range(chunks):
+                frame = f'data: chunk{i}\n\n'.encode()
+                self.wfile.write(f'{len(frame):x}\r\n'.encode() + frame +
+                                 b'\r\n')
+                self.wfile.flush()
+                emit_times.append(time.monotonic())
+                if i < chunks - 1:
+                    time.sleep(spacing)
+            self.wfile.write(b'0\r\n\r\n')
+            self.wfile.flush()
+
+        do_POST = do_GET
+
+    return _SSEHandler
+
+
+def _start_replica(handler_cls, counting=False):
+    server = ThreadingHTTPServer(('127.0.0.1', 0), handler_cls)
+    if counting:
+        server.connection_count = 0
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def _start_dying_replica(seen_requests):
+    """Accepts, reads the full request head+body, then closes without
+    responding — the 'replica died after reading the request' failover
+    case."""
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(('127.0.0.1', 0))
+    listener.listen(8)
+
+    def run():
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(5)
+                data = b''
+                while b'\r\n\r\n' not in data:
+                    got = conn.recv(4096)
+                    if not got:
+                        break
+                    data += got
+                head, _, rest = data.partition(b'\r\n\r\n')
+                length = 0
+                for line in head.split(b'\r\n'):
+                    if line.lower().startswith(b'content-length:'):
+                        length = int(line.split(b':')[1])
+                while len(rest) < length:
+                    got = conn.recv(4096)
+                    if not got:
+                        break
+                    rest += got
+                seen_requests.append(head.split(b' ', 1)[0].decode())
+            finally:
+                conn.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return listener
+
+
+def _lb_for(*urls, policy='round_robin', **lb_kwargs):
+    lb = LoadBalancer(LoadBalancingPolicy.make(policy), **lb_kwargs)
+    lb.sync_replicas([(i + 1, url, 1.0) for i, url in enumerate(urls)])
+    server = start_load_balancer(lb, '127.0.0.1', 0)
+    return lb, server
+
+
+def _url(server) -> str:
+    return f'http://127.0.0.1:{server.server_address[1]}'
+
+
+def _outcome_count(outcome: str) -> float:
+    return metrics.LB_REQUESTS._values.get((('outcome', outcome),), 0.0)
+
+
+def _wait_outcome(outcome: str, count: float, timeout: float = 2.0) -> float:
+    """The 'ok' outcome is incremented on the loop thread after the last
+    body byte is streamed — poll briefly instead of racing it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = _outcome_count(outcome)
+        if value >= count:
+            return value
+        time.sleep(0.01)
+    return _outcome_count(outcome)
+
+
+# -- proxy basics -----------------------------------------------------------
+
+
+def test_proxy_get_and_post_roundtrip():
+    replica = _start_replica(_EchoHandler)
+    lb, server = _lb_for(_url(replica))
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/hello', timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())['path'] == '/hello'
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{server.port}/gen',
+            data=b'{"prompt": "hi"}', method='POST')
+        with urllib.request.urlopen(req, timeout=10) as r:
+            payload = json.loads(r.read())
+            assert payload['method'] == 'POST'
+            assert payload['body'] == '{"prompt": "hi"}'
+        assert _wait_outcome('ok', 2) == 2
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+def test_keep_alive_pool_reuses_upstream_connections():
+    replica = _start_replica(_CountingEcho, counting=True)
+    lb, server = _lb_for(_url(replica))
+    try:
+        for i in range(5):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{server.port}/r{i}',
+                    timeout=10) as r:
+                assert r.status == 200
+        # 5 sequential requests ride one upstream keep-alive connection.
+        assert replica.connection_count == 1
+        assert metrics.LB_POOL_REUSE._values.get((), 0) >= 4
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+def test_pool_disabled_dials_per_request(monkeypatch):
+    monkeypatch.setenv('SKYT_LB_POOL_SIZE', '0')
+    replica = _start_replica(_CountingEcho, counting=True)
+    lb, server = _lb_for(_url(replica))
+    try:
+        for i in range(3):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{server.port}/r{i}',
+                    timeout=10) as r:
+                assert r.status == 200
+        assert replica.connection_count == 3
+        assert metrics.LB_POOL_REUSE._values.get((), 0) == 0
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+def test_client_keep_alive_across_requests():
+    replica = _start_replica(_EchoHandler)
+    lb, server = _lb_for(_url(replica))
+    try:
+        conn = http.client.HTTPConnection('127.0.0.1', server.port,
+                                          timeout=10)
+        for i in range(3):
+            conn.request('GET', f'/seq{i}')
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())['path'] == f'/seq{i}'
+        conn.close()
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+# -- streaming --------------------------------------------------------------
+
+
+def _read_streamed(port, path, deadline=15.0):
+    """Raw-socket client: returns [(arrival_monotonic, bytes)] so chunk
+    arrival TIMES are observable (urllib buffers)."""
+    sock = socket.create_connection(('127.0.0.1', port), timeout=deadline)
+    sock.sendall(f'GET {path} HTTP/1.1\r\nHost: lb\r\n'
+                 'Connection: close\r\n\r\n'.encode())
+    sock.settimeout(deadline)
+    arrivals = []
+    buf = b''
+    while b'0\r\n\r\n' not in buf:
+        data = sock.recv(65536)
+        if not data:
+            break
+        buf += data
+        arrivals.append((time.monotonic(), data))
+    sock.close()
+    return arrivals, buf
+
+
+def test_sse_stream_passes_through_unbuffered():
+    """First chunk must reach the client BEFORE the replica produces
+    the last one — the old proxy buffered the whole body (TTFT == total
+    completion time)."""
+    emit_times = []
+    replica = _start_replica(_make_sse_handler(3, 0.25, emit_times))
+    lb, server = _lb_for(_url(replica))
+    try:
+        arrivals, buf = _read_streamed(server.port, '/stream')
+        assert b'data: chunk0' in buf and b'data: chunk2' in buf
+        first_arrival = next(t for t, data in arrivals
+                             if b'data: chunk0' in data)
+        assert len(emit_times) == 3
+        last_emitted = emit_times[-1]
+        assert first_arrival < last_emitted, (
+            'first chunk arrived only after the replica finished '
+            'producing — the proxy is buffering the stream')
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+@pytest.mark.latency
+def test_streamed_ttft_well_below_total():
+    """Tier-1 smoke for the serving data plane: through the LB, TTFT of
+    a slow streaming response is bounded by the first-chunk time, far
+    below the total response time (generous bounds — never exact
+    timings)."""
+    emit_times = []
+    # ~1s total stream (5 chunks, 0.25s apart).
+    replica = _start_replica(_make_sse_handler(5, 0.25, emit_times))
+    lb, server = _lb_for(_url(replica))
+    try:
+        start = time.monotonic()
+        arrivals, buf = _read_streamed(server.port, '/stream')
+        assert b'data: chunk4' in buf
+        ttft = next(t for t, data in arrivals
+                    if b'data: chunk0' in data) - start
+        total = arrivals[-1][0] - start
+        assert total > 0.6, f'stream finished too fast ({total:.3f}s)'
+        assert ttft < total / 2, (
+            f'TTFT {ttft:.3f}s should be well below total {total:.3f}s '
+            '(a buffering proxy pins TTFT ~= total)')
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+# -- failover + retry safety ------------------------------------------------
+
+
+def test_get_retried_when_first_replica_dies_after_read():
+    seen = []
+    dying = _start_dying_replica(seen)
+    healthy = _start_replica(_EchoHandler)
+    # round_robin picks replica 1 (the dying one) first.
+    lb, server = _lb_for(f'http://127.0.0.1:{dying.getsockname()[1]}',
+                         _url(healthy))
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/idem', timeout=10) as r:
+            assert r.status == 200
+        assert seen == ['GET']  # the dying replica did receive it
+        assert _wait_outcome('ok', 1) == 1
+    finally:
+        server.shutdown()
+        healthy.shutdown()
+        dying.close()
+
+
+def test_post_not_replayed_after_body_was_sent():
+    """The replica read the request (body bytes were sent) then died:
+    replaying could duplicate a non-idempotent effect. The client gets
+    502 and the healthy replica must never see the request."""
+    seen = []
+    dying = _start_dying_replica(seen)
+    healthy = _start_replica(_EchoHandler)
+    lb, server = _lb_for(f'http://127.0.0.1:{dying.getsockname()[1]}',
+                         _url(healthy))
+    try:
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{server.port}/gen',
+            data=b'{"prompt": "expensive"}', method='POST')
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 502
+        assert seen == ['POST']
+        assert _outcome_count('no_retry') == 1
+        # The healthy replica never saw a duplicate:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/check',
+                timeout=10) as r:
+            # round_robin moved on; whichever replica answers, the
+            # duplicate-check is the dying replica's log:
+            assert seen == ['POST']
+    finally:
+        server.shutdown()
+        healthy.shutdown()
+        dying.close()
+
+
+def test_bodyless_post_not_replayed_after_head_was_sent():
+    """Even with zero body bytes, a delivered request head can trigger
+    a mutation (POST /cancel): once any request bytes reached the
+    replica, non-idempotent methods are not replayed."""
+    seen = []
+    dying = _start_dying_replica(seen)
+    healthy = _start_replica(_EchoHandler)
+    lb, server = _lb_for(f'http://127.0.0.1:{dying.getsockname()[1]}',
+                         _url(healthy))
+    try:
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{server.port}/cancel', data=b'',
+            method='POST')
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 502
+        assert seen == ['POST']
+    finally:
+        server.shutdown()
+        healthy.shutdown()
+        dying.close()
+
+
+def test_post_retried_when_nothing_was_sent():
+    """Connection refused = zero bytes reached the replica: replaying a
+    POST is safe and required."""
+    closed = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    closed.bind(('127.0.0.1', 0))
+    refused_port = closed.getsockname()[1]
+    closed.close()  # nothing listens here now
+    healthy = _start_replica(_EchoHandler)
+    lb, server = _lb_for(f'http://127.0.0.1:{refused_port}',
+                         _url(healthy))
+    try:
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{server.port}/gen', data=b'body',
+            method='POST')
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())['method'] == 'POST'
+    finally:
+        server.shutdown()
+        healthy.shutdown()
+
+
+def test_no_replica_503_has_retry_after_and_metric():
+    lb = LoadBalancer(LoadBalancingPolicy.make('least_load'),
+                      retry_after_seconds=7)
+    server = start_load_balancer(lb, '127.0.0.1', 0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/x', timeout=10)
+        assert err.value.code == 503
+        assert err.value.headers['Retry-After'] == '7'
+        assert _outcome_count('no_replica') == 1
+    finally:
+        server.shutdown()
+
+
+def test_saturation_fast_fails_503(monkeypatch):
+    monkeypatch.setenv('SKYT_LB_MAX_INFLIGHT', '1')
+    emit_times = []
+    # Slow replica: one in-flight stream occupies the single slot.
+    replica = _start_replica(_make_sse_handler(2, 0.8, emit_times))
+    lb, server = _lb_for(_url(replica))
+    try:
+        blocker = threading.Thread(
+            target=lambda: _read_streamed(server.port, '/slow'),
+            daemon=True)
+        blocker.start()
+        deadline = time.monotonic() + 5
+        saw_503 = None
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{server.port}/second', timeout=5)
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    saw_503 = e
+                    break
+            time.sleep(0.05)
+        assert saw_503 is not None, 'saturated LB never fast-failed'
+        assert saw_503.headers['Retry-After'] is not None
+        assert _outcome_count('saturated') >= 1
+        blocker.join(timeout=10)
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+# -- load sensing -----------------------------------------------------------
+
+
+def test_qps_ring_uses_monotonic_clock(monkeypatch):
+    """A wall-clock step must not corrupt the QPS window (the
+    autoscaler's signal)."""
+    lb = LoadBalancer(LoadBalancingPolicy.make('least_load'),
+                      qps_window_seconds=60.0)
+    for _ in range(30):
+        lb.record_request()
+    # Jump wall-clock a day ahead: monotonic ring is unaffected.
+    real_time = time.time
+
+    monkeypatch.setattr(time, 'time', lambda: real_time() + 86400)
+    stats = lb.load_stats()
+    assert stats.qps == pytest.approx(30 / 60.0)
+
+
+def test_load_stats_carries_replica_latency():
+    lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+    lb.sync_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0)])
+    lb.observe_latency(1, 0.010)
+    lb.observe_latency(2, 0.200)
+    stats = lb.load_stats()
+    assert stats.replica_latency_ms[1] == pytest.approx(10.0)
+    assert stats.replica_latency_ms[2] == pytest.approx(200.0)
+    state = lb.lb_state()
+    assert state[1]['ewma_ms'] == pytest.approx(10.0)
+    assert not state[1]['ejected']
+
+
+# -- p2c_ewma policy --------------------------------------------------------
+
+
+def test_p2c_ewma_prefers_faster_replica():
+    policy = LoadBalancingPolicy.make('p2c_ewma')
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0)])
+    # With two replicas p2c compares both every time: the 10x-faster
+    # one wins at equal in-flight.
+    latencies = {1: 0.010, 2: 0.100}
+    picks = {policy.select({1: 1, 2: 1}, latencies=latencies)[0]
+             for _ in range(20)}
+    assert picks == {1}
+
+
+def test_p2c_ewma_latency_trades_against_load():
+    policy = LoadBalancingPolicy.make('p2c_ewma')
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0)])
+    # Fast replica drowning in requests loses to slow-but-idle:
+    # (20+1)*0.01 = 0.21 > (0+1)*0.1 = 0.1.
+    latencies = {1: 0.010, 2: 0.100}
+    assert policy.select({1: 20, 2: 0}, latencies=latencies)[0] == 2
+
+
+def test_p2c_ewma_respects_capacity_weights():
+    policy = LoadBalancingPolicy.make('p2c_ewma')
+    # Replica 2 has 4x the chips: equal latency and load, it wins.
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 4.0)])
+    latencies = {1: 0.050, 2: 0.050}
+    assert policy.select({1: 2, 2: 2}, latencies=latencies)[0] == 2
+
+
+def test_p2c_ewma_never_picks_excluded_or_ejected():
+    policy = LoadBalancingPolicy.make('p2c_ewma')
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0),
+                         (3, 'http://c', 1.0)])
+    latencies = {1: 0.001, 2: 0.5, 3: 0.5}
+    # Replica 1 is by far the fastest but excluded (failed this request
+    # or breaker-ejected): it must never be picked.
+    for _ in range(50):
+        entry = policy.select({}, exclude={1}, latencies=latencies)
+        assert entry[0] in (2, 3)
+    assert policy.select({}, exclude={1, 2, 3},
+                         latencies=latencies) is None
+
+
+def test_p2c_ewma_cold_replica_gets_probed():
+    policy = LoadBalancingPolicy.make('p2c_ewma')
+    policy.set_replicas([(1, 'http://a', 1.0), (2, 'http://b', 1.0)])
+    # Replica 2 has no sample yet: it must be attractive (probed), not
+    # starved behind the measured one.
+    assert policy.select({}, latencies={1: 0.050})[0] == 2
+
+
+# -- ejection + re-probe (chaos) --------------------------------------------
+
+
+@pytest.mark.chaos
+def test_ejection_and_timed_reprobe_recovers_flapping_replica(monkeypatch):
+    """SKYT_FAULT_SPEC makes the forward path fail 3 times (the
+    ejection threshold): the replica is ejected, requests keep being
+    served... and once the ejection window lapses the re-probe finds
+    the replica healthy again and clears the breaker."""
+    monkeypatch.setenv('SKYT_LB_EJECT_THRESHOLD', '3')
+    monkeypatch.setenv('SKYT_LB_EJECT_SECONDS', '0.4')
+    replica = _start_replica(_EchoHandler)
+    lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+    lb.sync_replicas([(1, _url(replica), 1.0)])
+    server = start_load_balancer(lb, '127.0.0.1', 0)
+    try:
+        with inject_faults(
+                'load_balancer.forward:ConnectionError:times=3'):
+            # Each request fails once on the (only) replica — failover
+            # never re-picks a tried replica — so three requests reach
+            # the consecutive-failure threshold and trip the breaker.
+            for _ in range(3):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(
+                        f'http://127.0.0.1:{server.port}/x', timeout=10)
+                assert err.value.code == 502
+            assert 1 in lb.ejected_snapshot()
+            assert lb.lb_state()[1]['ejected']
+            # Faults exhausted (times=3): the ejection window lapses,
+            # the next request re-probes and succeeds, breaker clears.
+            time.sleep(0.5)
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{server.port}/y', timeout=10) as r:
+                assert r.status == 200
+            assert lb.ejected_snapshot() == {}
+            assert lb.lb_state()[1]['consecutive_failures'] == 0
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+@pytest.mark.chaos
+def test_ejected_replica_skipped_while_peer_serves(monkeypatch):
+    monkeypatch.setenv('SKYT_LB_EJECT_THRESHOLD', '1')
+    monkeypatch.setenv('SKYT_LB_EJECT_SECONDS', '30')
+    seen = []
+    dying = _start_dying_replica(seen)
+    healthy = _start_replica(_EchoHandler)
+    lb, server = _lb_for(f'http://127.0.0.1:{dying.getsockname()[1]}',
+                         _url(healthy))
+    try:
+        # First GET fails over to the healthy replica and ejects the
+        # dead one (threshold 1).
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/a', timeout=10) as r:
+            assert r.status == 200
+        assert 1 in lb.ejected_snapshot()
+        before = len(seen)
+        # Subsequent requests never touch the ejected replica.
+        for i in range(4):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{server.port}/b{i}',
+                    timeout=10) as r:
+                assert r.status == 200
+        assert len(seen) == before
+    finally:
+        server.shutdown()
+        healthy.shutdown()
+        dying.close()
+
+
+# -- metrics surface --------------------------------------------------------
+
+
+def test_lb_metrics_endpoint_served_locally():
+    replica = _start_replica(_EchoHandler)
+    lb, server = _lb_for(_url(replica))
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/ok', timeout=10) as r:
+            assert r.status == 200
+        assert _wait_outcome('ok', 1) == 1
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{server.port}/-/lb/metrics',
+                timeout=10) as r:
+            text = r.read().decode()
+        assert 'skyt_lb_requests_total{outcome="ok"} 1' in text
+        assert 'skyt_lb_ttfb_seconds_count' in text
+    finally:
+        server.shutdown()
+        replica.shutdown()
