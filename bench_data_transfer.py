@@ -1,0 +1,216 @@
+#!/usr/bin/env python3
+"""Data-plane transfer bench: parallel delta-aware engine vs the serial
+baseline, against a latency/bandwidth-injected fake S3 endpoint
+(tests/fake_s3.py).
+
+CPU-only; no cloud credentials. Three scenarios from ISSUE 5:
+
+1. many-small-files tree (64 x 2 KiB, 20 ms injected RTT): the old
+   serial one-object-at-a-time path (reimplemented here as the
+   baseline, since the code path was replaced) vs the engine's bounded
+   worker pool. Acceptance: >=4x p50 on sync.
+2. one large object (32 MiB, 10 ms RTT, 64 MiB/s per-connection
+   throttle): single-stream GET/PUT vs ranged parallel GET / multipart
+   parallel PUT. Acceptance: >=2x p50 on the ranged GET.
+3. warm re-sync of the unchanged 64-file tree: must move ZERO object
+   bodies (delta manifest; the stub counts body ops).
+
+Emits one JSON document on stdout; run_benches.sh tees it into
+``BENCH_data_transfer_<suffix>.json`` and the tables land in PERF.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(REPO, 'tests'))
+
+from fake_s3 import FakeS3Server  # noqa: E402
+
+from skypilot_tpu.data import s3 as s3_lib  # noqa: E402
+from skypilot_tpu.data import transfer_engine  # noqa: E402
+
+ITERS = 3
+
+
+def p50(samples):
+    return sorted(samples)[len(samples) // 2]
+
+
+def timed(fn):
+    started = time.monotonic()
+    fn()
+    return time.monotonic() - started
+
+
+# -- the replaced serial path, kept as the baseline --------------------
+
+
+def serial_sync_up(client, local_dir, bucket, prefix=''):
+    """Pre-engine S3Client.sync_up: whole-file read + one PUT at a
+    time."""
+    count = 0
+    for dirpath, _, filenames in os.walk(local_dir):
+        for filename in filenames:
+            path = os.path.join(dirpath, filename)
+            rel = os.path.relpath(path, local_dir)
+            key = os.path.join(prefix, rel) if prefix else rel
+            with open(path, 'rb') as f:
+                client.put_object(bucket, key.replace(os.sep, '/'),
+                                  f.read())
+            count += 1
+    return count
+
+
+def serial_sync_down(client, bucket, prefix, dest):
+    """Pre-engine S3Client.sync_down: one buffered GET at a time."""
+    count = 0
+    for key in client.list_objects(bucket, prefix):
+        rel = key[len(prefix):].lstrip('/') if prefix else key
+        target = os.path.join(dest, rel)
+        os.makedirs(os.path.dirname(target) or dest, exist_ok=True)
+        with open(target, 'wb') as f:
+            f.write(client.get_object(bucket, key))
+        count += 1
+    return count
+
+
+def make_tree(root, n, size):
+    for i in range(n):
+        sub = os.path.join(root, f'd{i % 4}')
+        os.makedirs(sub, exist_ok=True)
+        with open(os.path.join(sub, f'f{i}.bin'), 'wb') as f:
+            f.write(os.urandom(size))
+
+
+def fresh_dir(base):
+    path = tempfile.mkdtemp(dir=base)
+    return path
+
+
+def bench_small_tree(tmp):
+    # 50 ms injected RTT: a cross-region object-store round trip. The
+    # serial path pays it once per object; the pool amortizes it.
+    n, size, latency = 64, 2048, 0.05
+    out = {'files': n, 'file_bytes': size, 'latency_s': latency,
+           'iters': ITERS}
+    with FakeS3Server(latency=latency, page_size=1000) as srv:
+        os.environ['SKYT_S3_ENDPOINT_URL'] = srv.url
+        client = s3_lib.S3Client(s3_lib.S3Config.load())
+        src = fresh_dir(tmp)
+        make_tree(src, n, size)
+        serial_up, serial_down = [], []
+        engine_up, engine_down = [], []
+        engine = transfer_engine.TransferEngine()
+        for i in range(ITERS):
+            client.create_bucket(f'ser{i}')
+            serial_up.append(timed(
+                lambda: serial_sync_up(client, src, f'ser{i}')))
+            dest = fresh_dir(tmp)
+            serial_down.append(timed(
+                lambda: serial_sync_down(client, f'ser{i}', '', dest)))
+            client.create_bucket(f'eng{i}')
+            adapter = transfer_engine.S3Adapter(client, f'eng{i}')
+            engine_up.append(timed(
+                lambda: engine.sync_up(src, adapter)))
+            dest2 = fresh_dir(tmp)
+            engine_down.append(timed(
+                lambda: engine.sync_down(adapter, '', dest2)))
+        out['serial_up_p50_s'] = round(p50(serial_up), 4)
+        out['engine_up_p50_s'] = round(p50(engine_up), 4)
+        out['speedup_up'] = round(p50(serial_up) / p50(engine_up), 2)
+        out['serial_down_p50_s'] = round(p50(serial_down), 4)
+        out['engine_down_p50_s'] = round(p50(engine_down), 4)
+        out['speedup_down'] = round(
+            p50(serial_down) / p50(engine_down), 2)
+
+        # Scenario 3 rides the same server: warm re-sync of eng0.
+        adapter = transfer_engine.S3Adapter(client, 'eng0')
+        warm = []
+        bodies_before = srv.body_ops()
+        for _ in range(ITERS):
+            warm.append(timed(lambda: engine.sync_up(src, adapter)))
+        out_warm = {
+            'files': n, 'iters': ITERS,
+            'second_sync_p50_s': round(p50(warm), 4),
+            'object_bodies_moved': srv.body_ops() - bodies_before,
+            'cold_sync_p50_s': out['engine_up_p50_s'],
+        }
+    return out, out_warm
+
+
+def bench_large_object(tmp):
+    size = 32 * 1024 * 1024
+    latency, bandwidth = 0.01, 64 * 1024 * 1024
+    part = 4 * 1024 * 1024
+    out = {'size_bytes': size, 'latency_s': latency,
+           'bandwidth_Bps': bandwidth, 'part_size': part,
+           'iters': ITERS}
+    with FakeS3Server(latency=latency, bandwidth=bandwidth,
+                      page_size=1000) as srv:
+        os.environ['SKYT_S3_ENDPOINT_URL'] = srv.url
+        client = s3_lib.S3Client(s3_lib.S3Config.load())
+        src = fresh_dir(tmp)
+        path = os.path.join(src, 'ckpt.bin')
+        with open(path, 'wb') as f:
+            f.write(os.urandom(size))
+        engine = transfer_engine.TransferEngine(
+            part_size=part, multipart_threshold=2 * part)
+        client.create_bucket('big')
+        serial_up, engine_up = [], []
+        serial_down, engine_down = [], []
+        for _ in range(ITERS):
+            serial_up.append(timed(lambda: client.put_object_from_file(
+                'big', 'serial.bin', path)))
+            # Fresh-key uploads each iter (delta would skip repeats).
+            client.delete_object('big', 'serial.bin')
+        for i in range(ITERS):
+            adapter = transfer_engine.S3Adapter(client, 'big')
+            dest = fresh_dir(tmp)
+            engine.delta = False
+            engine_up.append(timed(
+                lambda: engine.sync_up(src, adapter, f'e{i}')))
+            serial_down.append(timed(lambda: client.get_object_to_file(
+                'big', f'e{i}/ckpt.bin',
+                os.path.join(dest, 'serial-down.bin'))))
+            dest2 = fresh_dir(tmp)
+            engine_down.append(timed(
+                lambda: engine.sync_down(adapter, f'e{i}', dest2)))
+        out['serial_up_p50_s'] = round(p50(serial_up), 4)
+        out['engine_up_p50_s'] = round(p50(engine_up), 4)
+        out['speedup_up'] = round(p50(serial_up) / p50(engine_up), 2)
+        out['serial_down_p50_s'] = round(p50(serial_down), 4)
+        out['engine_down_p50_s'] = round(p50(engine_down), 4)
+        out['speedup_down'] = round(
+            p50(serial_down) / p50(engine_down), 2)
+    return out
+
+
+def main():
+    os.environ.setdefault('AWS_ACCESS_KEY_ID', 'bench-key')
+    os.environ.setdefault('AWS_SECRET_ACCESS_KEY', 'bench-secret')
+    tmp = tempfile.mkdtemp(prefix='skyt-bench-transfer-')
+    os.environ['SKYT_STATE_DIR'] = os.path.join(tmp, 'state')
+    try:
+        small, warm = bench_small_tree(tmp)
+        large = bench_large_object(tmp)
+        workers = transfer_engine.TransferEngine().workers
+        doc = {
+            'bench': 'data_transfer',
+            'workers': workers,
+            'small_tree': small,
+            'large_object': large,
+            'warm_resync': warm,
+        }
+        print(json.dumps(doc, indent=2))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == '__main__':
+    main()

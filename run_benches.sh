@@ -34,6 +34,14 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_serve_lb.py \
   | tee "BENCH_serve_lb_${suffix}.json"
 echo "rc=$? -> BENCH_serve_lb_${suffix}.json" >&2
 
+# Storage data-plane bench: CPU-only — parallel delta-aware transfer
+# engine vs the serial per-object baseline on a latency/bandwidth-
+# injected fake S3 (docs/data_plane.md, numbers in PERF.md).
+echo "=== bench data-transfer ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_data_transfer.py \
+  | tee "BENCH_data_transfer_${suffix}.json"
+echo "rc=$? -> BENCH_data_transfer_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

@@ -27,7 +27,7 @@ import sys
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 from xml.etree import ElementTree
 
 from skypilot_tpu import exceptions
@@ -45,7 +45,7 @@ class AzureHttpError(exceptions.StorageError):
     substring — a container named 'x-404' must not read as missing)."""
 
     def __init__(self, message: str, code: int) -> None:
-        super().__init__(message)
+        super().__init__(message, http_status=code)
         self.code = code
 
 
@@ -148,17 +148,18 @@ class AzureBlobClient:
         return urllib.request.Request(url, data=body,
                                       headers=headers, method=method)
 
-    def _call(self, method: str, container: str, blob: str = '',
-              query: Optional[Dict[str, str]] = None,
-              body: bytes = b'',
-              extra_headers: Optional[Dict[str, str]] = None) -> bytes:
+    def _call_full(self, method: str, container: str, blob: str = '',
+                   query: Optional[Dict[str, str]] = None,
+                   body: bytes = b'',
+                   extra_headers: Optional[Dict[str, str]] = None):
+        """Returns (response headers, body)."""
         req = self._signed_request(method, container, blob, query, body,
                                    extra_headers)
         try:
             # data always set (b'' included) so urllib emits
             # Content-Length: 0 — Azure 411s length-less PUTs.
             with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.read()
+                return resp.headers, resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode('utf-8', errors='replace')[:300]
             raise AzureHttpError(
@@ -167,6 +168,14 @@ class AzureBlobClient:
         except urllib.error.URLError as e:
             raise exceptions.StorageError(
                 f'Azure Blob endpoint unreachable: {e}') from None
+
+    def _call(self, method: str, container: str, blob: str = '',
+              query: Optional[Dict[str, str]] = None,
+              body: bytes = b'',
+              extra_headers: Optional[Dict[str, str]] = None) -> bytes:
+        _, payload = self._call_full(method, container, blob, query,
+                                     body, extra_headers)
+        return payload
 
     # -- operations ----------------------------------------------------
 
@@ -187,22 +196,46 @@ class AzureBlobClient:
             if e.code != 409:  # 409 = already exists
                 raise
 
-    def put_blob(self, container: str, blob: str, data: bytes) -> None:
+    def put_blob(self, container: str, blob: str, data: bytes) -> str:
+        """Single-request Put Blob; returns the service ETag ('' if
+        absent)."""
+        headers, _ = self._call_full(
+            'PUT', container, blob, body=data,
+            extra_headers={'x-ms-blob-type': 'BlockBlob',
+                           'Content-Type':
+                               'application/octet-stream'})
+        return (headers.get('ETag') or '').strip('"')
+
+    def put_block(self, container: str, blob: str, block_id: str,
+                  data: bytes) -> None:
+        """Stage one block (blocks of one blob may upload in
+        parallel)."""
         self._call('PUT', container, blob, body=data,
-                   extra_headers={'x-ms-blob-type': 'BlockBlob',
-                                  'Content-Type':
-                                      'application/octet-stream'})
+                   query={'comp': 'block', 'blockid': block_id})
+
+    def put_block_list(self, container: str, blob: str,
+                       block_ids: List[str]) -> str:
+        """Commit staged blocks in order; returns the blob ETag."""
+        manifest = ('<?xml version="1.0" encoding="utf-8"?><BlockList>'
+                    + ''.join(f'<Latest>{bid}</Latest>'
+                              for bid in block_ids)
+                    + '</BlockList>').encode()
+        headers, _ = self._call_full(
+            'PUT', container, blob, body=manifest,
+            query={'comp': 'blocklist'},
+            extra_headers={'Content-Type': 'application/xml'})
+        return (headers.get('ETag') or '').strip('"')
 
     def put_blob_from_file(self, container: str, blob: str,
                            path: str,
-                           block_size: int = BLOCK_SIZE) -> None:
+                           block_size: int = BLOCK_SIZE) -> str:
         """Upload a file; large files stream as Put Block + Put Block
-        List (bounded memory, no single-put size limit)."""
+        List (bounded memory, no single-put size limit). Returns the
+        blob ETag."""
         size = os.path.getsize(path)
         if size <= SINGLE_PUT_LIMIT and size <= block_size * 2:
             with open(path, 'rb') as f:
-                self.put_blob(container, blob, f.read())
-            return
+                return self.put_blob(container, blob, f.read())
         block_ids: List[str] = []
         with open(path, 'rb') as f:
             index = 0
@@ -212,38 +245,72 @@ class AzureBlobClient:
                     break
                 block_id = base64.b64encode(
                     f'{index:08d}'.encode()).decode()
-                self._call('PUT', container, blob, body=chunk,
-                           query={'comp': 'block',
-                                  'blockid': block_id})
+                self.put_block(container, blob, block_id, chunk)
                 block_ids.append(block_id)
                 index += 1
-        manifest = ('<?xml version="1.0" encoding="utf-8"?><BlockList>'
-                    + ''.join(f'<Latest>{bid}</Latest>'
-                              for bid in block_ids)
-                    + '</BlockList>').encode()
-        self._call('PUT', container, blob, body=manifest,
-                   query={'comp': 'blocklist'},
-                   extra_headers={'Content-Type': 'application/xml'})
+        return self.put_block_list(container, blob, block_ids)
 
     def get_blob(self, container: str, blob: str) -> bytes:
         return self._call('GET', container, blob)
 
+    def get_blob_range(self, container: str, blob: str, start: int,
+                       length: int) -> bytes:
+        """Ranged read via ``x-ms-range`` (signed as an x-ms header, so
+        no Range slot gymnastics in the SharedKey string-to-sign)."""
+        end = start + length - 1
+        req = self._signed_request(
+            'GET', container, blob,
+            extra_headers={'x-ms-range': f'bytes={start}-{end}'})
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                if resp.status == 206:
+                    return resp.read()
+                # Endpoint ignored the range header (some emulators):
+                # stream to the slice and close — never buffer the
+                # whole blob per part request.
+                from skypilot_tpu.data.s3 import _read_slice
+                return _read_slice(resp, start, length)
+        except urllib.error.HTTPError as e:
+            e.read()
+            raise AzureHttpError(
+                f'Azure Blob ranged GET {container}/{blob} '
+                f'[{start}-{end}]: HTTP {e.code}', code=e.code) from None
+        except urllib.error.URLError as e:
+            raise exceptions.StorageError(
+                f'Azure Blob endpoint unreachable: {e}') from None
+
     def get_blob_to_file(self, container: str, blob: str,
-                         path: str) -> None:
-        """Stream a blob to disk (no full-blob buffer)."""
-        import shutil
+                         path: str) -> str:
+        """Stream a blob to disk (no full-blob buffer), atomically:
+        the bytes land in a same-dir .tmp renamed into place, so a kill
+        mid-download never leaves a truncated ``path``. Returns the md5
+        hex of the content."""
+        tmp = f'{path}.skyt-tmp.{os.getpid()}'
+        md5 = hashlib.md5()
         req = self._signed_request('GET', container, blob)
         try:
             with urllib.request.urlopen(req, timeout=300) as resp, \
-                    open(path, 'wb') as f:
-                shutil.copyfileobj(resp, f, length=1024 * 1024)
+                    open(tmp, 'wb') as f:
+                while True:
+                    chunk = resp.read(1024 * 1024)
+                    if not chunk:
+                        break
+                    md5.update(chunk)
+                    f.write(chunk)
+            os.replace(tmp, path)
+            return md5.hexdigest()
         except urllib.error.HTTPError as e:
             raise AzureHttpError(
                 f'Azure Blob GET {container}/{blob}: HTTP {e.code}',
                 code=e.code) from None
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
-    def list_blobs(self, container: str,
-                   prefix: str = '') -> Iterator[str]:
+    def list_blobs_meta(self, container: str, prefix: str = ''
+                        ) -> Iterator[Tuple[str, int, str]]:
+        """Yield (name, size, etag) per blob; size -1 / etag '' when
+        the listing omits Properties."""
         marker = ''
         while True:
             query = {'restype': 'container', 'comp': 'list'}
@@ -253,13 +320,34 @@ class AzureBlobClient:
                 query['marker'] = marker
             root = ElementTree.fromstring(
                 self._call('GET', container, query=query))
-            for el in root.iter('Name'):
-                yield el.text or ''
+            for blob_el in root.iter('Blob'):
+                name_el = blob_el.find('Name')
+                if name_el is None:
+                    continue
+                name = name_el.text or ''
+                size, etag = -1, ''
+                props = blob_el.find('Properties')
+                if props is not None:
+                    size_el = props.find('Content-Length')
+                    etag_el = props.find('Etag')
+                    try:
+                        size = int(size_el.text) if size_el is not None \
+                            and size_el.text else -1
+                    except ValueError:
+                        size = -1
+                    etag = (etag_el.text or '') if etag_el is not None \
+                        else ''
+                yield name, size, etag
             marker_el = root.find('NextMarker')
             marker = (marker_el.text or '') if marker_el is not None \
                 else ''
             if not marker:
                 return
+
+    def list_blobs(self, container: str,
+                   prefix: str = '') -> Iterator[str]:
+        for name, _, _ in self.list_blobs_meta(container, prefix):
+            yield name
 
     def delete_blob(self, container: str, blob: str) -> None:
         self._call('DELETE', container, blob)
@@ -267,46 +355,28 @@ class AzureBlobClient:
     def delete_container(self, container: str) -> None:
         self._call('DELETE', container, query={'restype': 'container'})
 
-    # -- sync helpers (store + CLI surface) ----------------------------
+    # -- sync helpers (store + CLI surface; parallel delta engine) -----
 
     def sync_up(self, local_dir: str, container: str,
                 prefix: str = '') -> int:
-        local_dir = os.path.expanduser(local_dir)
-        count = 0
-        if os.path.isfile(local_dir):
-            name = (f'{prefix.rstrip("/")}/' if prefix else '') + \
-                os.path.basename(local_dir)
-            self.put_blob_from_file(container, name, local_dir)
-            return 1
-        for root, _dirs, files in os.walk(local_dir):
-            for fn in files:
-                full = os.path.join(root, fn)
-                rel = os.path.relpath(full, local_dir)
-                name = (f'{prefix.rstrip("/")}/' if prefix else '') + rel
-                self.put_blob_from_file(container,
-                                        name.replace(os.sep, '/'), full)
-                count += 1
-        return count
+        """Upload a file or directory tree; returns object count
+        (transferred + delta-skipped)."""
+        from skypilot_tpu.data import transfer_engine
+        engine = transfer_engine.TransferEngine()
+        return engine.sync_up(
+            local_dir, transfer_engine.AzureAdapter(self, container),
+            prefix).count
 
     def sync_down(self, container: str, prefix: str, dest: str) -> int:
-        dest = os.path.abspath(os.path.expanduser(dest))
-        count = 0
-        for name in self.list_blobs(container, prefix):
-            rel = name[len(prefix):].lstrip('/') if prefix else name
-            target = os.path.join(dest, rel) if rel else os.path.join(
-                dest, os.path.basename(name))
-            # Server-supplied names must not escape dest ('..'
-            # segments would let a shared bucket overwrite arbitrary
-            # host files).
-            target = os.path.normpath(target)
-            if os.path.commonpath([dest, target]) != dest:
-                raise exceptions.StorageError(
-                    f'refusing blob name escaping the destination: '
-                    f'{name!r}')
-            os.makedirs(os.path.dirname(target) or '.', exist_ok=True)
-            self.get_blob_to_file(container, name, target)
-            count += 1
-        return count
+        """Download all blobs under prefix into dest; returns count
+        (transferred + delta-skipped). The engine enforces the
+        traversal guard (blob names may not escape ``dest``) and atomic
+        placement."""
+        from skypilot_tpu.data import transfer_engine
+        engine = transfer_engine.TransferEngine()
+        return engine.sync_down(
+            transfer_engine.AzureAdapter(self, container), prefix,
+            dest).count
 
 
 def main(argv: Optional[List[str]] = None) -> int:

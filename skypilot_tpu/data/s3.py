@@ -35,6 +35,24 @@ from xml.etree import ElementTree
 from skypilot_tpu import exceptions
 
 
+def _read_slice(resp, start: int, length: int) -> bytes:
+    """Read ``[start, start+length)`` from a response stream without
+    buffering the rest; closing the response abandons the tail."""
+    to_skip = start
+    while to_skip > 0:
+        chunk = resp.read(min(1024 * 1024, to_skip))
+        if not chunk:
+            return b''
+        to_skip -= len(chunk)
+    out = bytearray()
+    while len(out) < length:
+        chunk = resp.read(min(1024 * 1024, length - len(out)))
+        if not chunk:
+            break
+        out += chunk
+    return bytes(out)
+
+
 @dataclasses.dataclass
 class S3Config:
     endpoint_url: str
@@ -89,7 +107,22 @@ class S3Client:
 
     def _signed_request(self, method: str, bucket: str, key: str = '',
                         query: Optional[Dict[str, str]] = None,
-                        body: bytes = b'') -> urllib.request.Request:
+                        body: bytes = b'',
+                        unsigned_headers: Optional[Dict[str, str]] = None,
+                        body_stream=None,
+                        content_length: Optional[int] = None,
+                        payload_sha256: Optional[str] = None
+                        ) -> urllib.request.Request:
+        """Build a SigV4-signed request.
+
+        ``body`` is hashed and sent as usual; alternatively pass
+        ``body_stream`` (a file-like object) with ``content_length`` and
+        a precomputed ``payload_sha256`` to stream a large payload in
+        chunks instead of buffering it (constant memory — the hash pass
+        reads the file once, the send pass streams it). Headers in
+        ``unsigned_headers`` (e.g. ``Range``) ride outside the
+        signature, which SigV4 permits for anything not listed in
+        SignedHeaders."""
         cfg = self.cfg
         parsed = urllib.parse.urlparse(cfg.endpoint_url)
         host = parsed.netloc
@@ -104,7 +137,7 @@ class S3Client:
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime('%Y%m%dT%H%M%SZ')
         datestamp = now.strftime('%Y%m%d')
-        payload_hash = hashlib.sha256(body).hexdigest()
+        payload_hash = payload_sha256 or hashlib.sha256(body).hexdigest()
         headers = {
             'host': host,
             'x-amz-content-sha256': payload_hash,
@@ -134,28 +167,38 @@ class S3Client:
         url = f'{parsed.scheme}://{host}{path}'
         if canonical_query:
             url += f'?{canonical_query}'
-        req = urllib.request.Request(url, data=body or None, method=method)
+        data = body_stream if body_stream is not None else (body or None)
+        req = urllib.request.Request(url, data=data, method=method)
         req.add_header('Authorization', auth)
         for k, v in headers.items():
             if k != 'host':
                 req.add_header(k, v)
+        if content_length is not None:
+            req.add_header('Content-Length', str(content_length))
+        for k, v in (unsigned_headers or {}).items():
+            req.add_header(k, v)
         return req
 
-    def _call(self, method: str, bucket: str, key: str = '',
-              query: Optional[Dict[str, str]] = None,
-              body: bytes = b'') -> Tuple[int, bytes]:
-        """Returns (status, body); HTTP errors are returned, not raised
-        (callers decide which codes are acceptable per operation)."""
-        req = self._signed_request(method, bucket, key, query, body)
+    def _send(self, req: urllib.request.Request,
+              timeout: float = 120):
+        """Returns (status, headers, body); HTTP errors are returned,
+        not raised (callers decide which codes are acceptable)."""
         try:
-            with urllib.request.urlopen(req, timeout=120) as resp:
-                return resp.status, resp.read()
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.headers, resp.read()
         except urllib.error.HTTPError as e:
-            return e.code, e.read()
+            return e.code, e.headers, e.read()
         except urllib.error.URLError as e:
             raise exceptions.StorageError(
                 f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
                 f'{e.reason}') from e
+
+    def _call(self, method: str, bucket: str, key: str = '',
+              query: Optional[Dict[str, str]] = None,
+              body: bytes = b'') -> Tuple[int, bytes]:
+        status, _, payload = self._send(
+            self._signed_request(method, bucket, key, query, body))
+        return status, payload
 
     # -- operations ----------------------------------------------------
 
@@ -173,18 +216,167 @@ class S3Client:
         code, body = self._call('PUT', bucket, key, body=data)
         if code not in (200, 204):
             raise exceptions.StorageError(
-                f'put {bucket}/{key}: HTTP {code} {body[:300]!r}')
+                f'put {bucket}/{key}: HTTP {code} {body[:300]!r}',
+                http_status=code)
 
     def get_object(self, bucket: str, key: str) -> bytes:
         code, body = self._call('GET', bucket, key)
         if code != 200:
             raise exceptions.StorageError(
-                f'get {bucket}/{key}: HTTP {code} {body[:300]!r}')
+                f'get {bucket}/{key}: HTTP {code} {body[:300]!r}',
+                http_status=code)
         return body
 
-    def list_objects(self, bucket: str,
-                     prefix: str = '') -> Iterator[str]:
-        """Yield keys under prefix (ListObjectsV2, paginated)."""
+    def get_object_to_file(self, bucket: str, key: str,
+                           path: str) -> str:
+        """Stream an object to ``path`` in chunks (constant memory);
+        returns the md5 hex of the content."""
+        req = self._signed_request('GET', bucket, key)
+        md5 = hashlib.md5()
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp, \
+                    open(path, 'wb') as f:
+                while True:
+                    chunk = resp.read(1024 * 1024)
+                    if not chunk:
+                        break
+                    md5.update(chunk)
+                    f.write(chunk)
+            return md5.hexdigest()
+        except urllib.error.HTTPError as e:
+            raise exceptions.StorageError(
+                f'get {bucket}/{key}: HTTP {e.code}',
+                http_status=e.code) from None
+        except urllib.error.URLError as e:
+            raise exceptions.StorageError(
+                f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
+                f'{e.reason}') from e
+
+    def get_object_range(self, bucket: str, key: str, start: int,
+                         length: int) -> bytes:
+        """Ranged GET of ``length`` bytes at ``start`` (parallel large-
+        object downloads fetch disjoint ranges concurrently)."""
+        end = start + length - 1
+        req = self._signed_request(
+            'GET', bucket, key,
+            unsigned_headers={'Range': f'bytes={start}-{end}'})
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                if resp.status == 206:
+                    return resp.read()
+                if resp.status == 200:
+                    # Endpoint ignored Range (some S3 compats do):
+                    # stream to the slice and close — never buffer the
+                    # whole object per part request.
+                    return _read_slice(resp, start, length)
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            raise exceptions.StorageError(
+                f'ranged get {bucket}/{key} [{start}-{end}]: HTTP '
+                f'{e.code}', http_status=e.code) from None
+        except urllib.error.URLError as e:
+            raise exceptions.StorageError(
+                f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
+                f'{e.reason}') from e
+        raise exceptions.StorageError(
+            f'ranged get {bucket}/{key} [{start}-{end}]: HTTP '
+            f'{resp.status} {body[:300]!r}', http_status=resp.status)
+
+    def put_object_from_file(self, bucket: str, key: str,
+                             path: str) -> str:
+        """Streamed single-request PUT: one hash pass (SigV4 payload
+        sha256) then a chunked send — the file is never held in memory.
+        Returns the object ETag the endpoint reported ('' if none)."""
+        size = os.path.getsize(path)
+        sha = hashlib.sha256()
+        with open(path, 'rb') as f:
+            for chunk in iter(lambda: f.read(1024 * 1024), b''):
+                sha.update(chunk)
+        with open(path, 'rb') as f:
+            req = self._signed_request(
+                'PUT', bucket, key, body_stream=f, content_length=size,
+                payload_sha256=sha.hexdigest())
+            status, headers, body = self._send(req, timeout=300)
+        if status not in (200, 204):
+            raise exceptions.StorageError(
+                f'put {bucket}/{key}: HTTP {status} {body[:300]!r}',
+                http_status=status)
+        return (headers.get('ETag') or '').strip('"')
+
+    # -- multipart upload ----------------------------------------------
+
+    def create_multipart_upload(self, bucket: str, key: str) -> str:
+        code, body = self._call('POST', bucket, key,
+                                query={'uploads': ''})
+        if code != 200:
+            raise exceptions.StorageError(
+                f'initiate multipart {bucket}/{key}: HTTP {code} '
+                f'{body[:300]!r}', http_status=code)
+        root = ElementTree.fromstring(body)
+        ns = root.tag.split('}')[0] + '}' if root.tag.startswith('{') \
+            else ''
+        el = root.find(f'{ns}UploadId')
+        if el is None or not el.text:
+            raise exceptions.StorageError(
+                f'initiate multipart {bucket}/{key}: no UploadId in '
+                f'{body[:300]!r}')
+        return el.text
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        req = self._signed_request(
+            'PUT', bucket, key,
+            query={'partNumber': str(part_number),
+                   'uploadId': upload_id}, body=data)
+        status, headers, body = self._send(req, timeout=300)
+        if status not in (200, 204):
+            raise exceptions.StorageError(
+                f'upload part {part_number} of {bucket}/{key}: HTTP '
+                f'{status} {body[:300]!r}', http_status=status)
+        etag = (headers.get('ETag') or '').strip('"')
+        return etag or hashlib.md5(data).hexdigest()
+
+    def complete_multipart_upload(self, bucket: str, key: str,
+                                  upload_id: str,
+                                  parts: List[Tuple[int, str]]) -> str:
+        """``parts`` is [(part_number, etag)]; returns the final ETag."""
+        manifest = '<CompleteMultipartUpload>' + ''.join(
+            f'<Part><PartNumber>{n}</PartNumber><ETag>"{etag}"</ETag>'
+            f'</Part>' for n, etag in sorted(parts)) + \
+            '</CompleteMultipartUpload>'
+        code, body = self._call('POST', bucket, key,
+                                query={'uploadId': upload_id},
+                                body=manifest.encode())
+        if code != 200:
+            raise exceptions.StorageError(
+                f'complete multipart {bucket}/{key}: HTTP {code} '
+                f'{body[:300]!r}', http_status=code)
+        root = ElementTree.fromstring(body)
+        # S3 can answer CompleteMultipartUpload with HTTP 200 whose body
+        # is an <Error> document (e.g. InternalError after its internal
+        # retry window) — 200 alone does not mean the object assembled.
+        if root.tag.endswith('Error'):
+            raise exceptions.StorageError(
+                f'complete multipart {bucket}/{key}: HTTP 200 with '
+                f'error body {body[:300]!r}')
+        ns = root.tag.split('}')[0] + '}' if root.tag.startswith('{') \
+            else ''
+        el = root.find(f'{ns}ETag')
+        return (el.text or '').strip('"') if el is not None else ''
+
+    def abort_multipart_upload(self, bucket: str, key: str,
+                               upload_id: str) -> None:
+        """Best-effort AbortMultipartUpload so a failed upload does not
+        leave billed orphan parts behind."""
+        self._call('DELETE', bucket, key, query={'uploadId': upload_id})
+
+    # -- listing -------------------------------------------------------
+
+    def list_objects_meta(self, bucket: str, prefix: str = ''
+                          ) -> Iterator[Tuple[str, int, str]]:
+        """Yield (key, size, etag) under prefix (ListObjectsV2,
+        paginated). ``etag`` keeps its raw quoting; size is -1 when the
+        endpoint omits it."""
         token: Optional[str] = None
         while True:
             query = {'list-type': '2'}
@@ -202,8 +394,18 @@ class S3Client:
                 ns = root.tag.split('}')[0] + '}'
             for el in root.findall(f'{ns}Contents'):
                 key_el = el.find(f'{ns}Key')
-                if key_el is not None and key_el.text:
-                    yield key_el.text
+                if key_el is None or not key_el.text:
+                    continue
+                size_el = el.find(f'{ns}Size')
+                etag_el = el.find(f'{ns}ETag')
+                try:
+                    size = int(size_el.text) if size_el is not None \
+                        and size_el.text else -1
+                except ValueError:
+                    size = -1
+                yield (key_el.text, size,
+                       (etag_el.text or '') if etag_el is not None
+                       else '')
             truncated = root.find(f'{ns}IsTruncated')
             if truncated is None or truncated.text != 'true':
                 return
@@ -211,6 +413,12 @@ class S3Client:
             token = token_el.text if token_el is not None else None
             if not token:
                 return
+
+    def list_objects(self, bucket: str,
+                     prefix: str = '') -> Iterator[str]:
+        """Yield keys under prefix (ListObjectsV2, paginated)."""
+        for key, _, _ in self.list_objects_meta(bucket, prefix):
+            yield key
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._call('DELETE', bucket, key)
@@ -223,41 +431,25 @@ class S3Client:
         self.delete_prefix(bucket)
         self._call('DELETE', bucket)
 
-    # -- directory sync ------------------------------------------------
+    # -- directory sync (parallel delta-aware engine) ------------------
 
     def sync_up(self, local_dir: str, bucket: str, prefix: str = '') -> int:
-        """Upload a file or directory tree; returns object count."""
-        local_dir = os.path.expanduser(local_dir)
-        count = 0
-        if os.path.isfile(local_dir):
-            with open(local_dir, 'rb') as f:
-                key = os.path.join(prefix, os.path.basename(local_dir)) \
-                    if prefix else os.path.basename(local_dir)
-                self.put_object(bucket, key, f.read())
-            return 1
-        for dirpath, _, filenames in os.walk(local_dir):
-            for filename in filenames:
-                path = os.path.join(dirpath, filename)
-                rel = os.path.relpath(path, local_dir)
-                key = os.path.join(prefix, rel) if prefix else rel
-                with open(path, 'rb') as f:
-                    self.put_object(bucket, key.replace(os.sep, '/'),
-                                    f.read())
-                count += 1
-        return count
+        """Upload a file or directory tree; returns object count
+        (transferred + delta-skipped)."""
+        from skypilot_tpu.data import transfer_engine
+        engine = transfer_engine.TransferEngine()
+        return engine.sync_up(
+            local_dir, transfer_engine.S3Adapter(self, bucket),
+            prefix).count
 
     def sync_down(self, bucket: str, prefix: str, dest: str) -> int:
-        """Download all objects under prefix into dest; returns count."""
-        dest = os.path.expanduser(dest)
-        count = 0
-        for key in self.list_objects(bucket, prefix):
-            rel = key[len(prefix):].lstrip('/') if prefix else key
-            target = os.path.join(dest, rel)
-            os.makedirs(os.path.dirname(target) or dest, exist_ok=True)
-            with open(target, 'wb') as f:
-                f.write(self.get_object(bucket, key))
-            count += 1
-        return count
+        """Download all objects under prefix into dest; returns count
+        (transferred + delta-skipped). Writes are atomic (same-dir .tmp
+        + rename) and keys may not escape ``dest``."""
+        from skypilot_tpu.data import transfer_engine
+        engine = transfer_engine.TransferEngine()
+        return engine.sync_down(
+            transfer_engine.S3Adapter(self, bucket), prefix, dest).count
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -326,14 +326,24 @@ class LocalStore(AbstractStore):
         os.makedirs(self.bucket_dir, exist_ok=True)
 
     def upload(self, local_source: str, prefix: str = '') -> None:
+        from skypilot_tpu.data import transfer_engine
         src = os.path.expanduser(local_source)
         dest = (os.path.join(self.bucket_dir, prefix) if prefix
                 else self.bucket_dir)
         os.makedirs(dest, exist_ok=True)
+        # Same parallel delta engine as the cloud stores: warm re-syncs
+        # of an unchanged tree copy nothing. The engine only moves
+        # files, so mirror empty directories first (jobs pre-create
+        # e.g. logs/ dirs and expect them in the bucket).
         if os.path.isdir(src):
-            shutil.copytree(src, dest, dirs_exist_ok=True)
-        else:
-            shutil.copy2(src, dest)
+            for dirpath, _, _ in os.walk(src):
+                rel = os.path.relpath(dirpath, src)
+                os.makedirs(dest if rel == '.'
+                            else os.path.join(dest, rel), exist_ok=True)
+        engine = transfer_engine.TransferEngine()
+        engine.sync_up(src,
+                       transfer_engine.LocalFSAdapter(self.bucket_dir),
+                       prefix)
 
     def delete(self) -> None:
         shutil.rmtree(self.bucket_dir, ignore_errors=True)

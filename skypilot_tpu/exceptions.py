@@ -141,7 +141,20 @@ class ServiceAlreadyExistsError(SkytError):
 
 
 class StorageError(SkytError):
-    """Bucket/storage operation failure."""
+    """Bucket/storage operation failure.
+
+    ``http_status`` (optional) carries the backend HTTP status so
+    callers can classify retryability structurally — never by message
+    substring (an object named 'x-404' must not read as missing).
+    ``permanent=True`` marks failures no retry can fix (e.g. a
+    path-traversal rejection) independent of any HTTP exchange."""
+
+    def __init__(self, message: str = '',
+                 http_status: 'int | None' = None,
+                 permanent: bool = False) -> None:
+        super().__init__(message)
+        self.http_status = http_status
+        self.permanent = permanent
 
 
 class NotSupportedError(SkytError):

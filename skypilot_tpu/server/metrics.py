@@ -173,8 +173,30 @@ LB_POOL_REUSE = Counter(
 
 _LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE]
 
+# -- storage/checkpoint data plane (incremented in-process by the
+# transfer engine, client- or cluster-side) ----------------------------
+
+_TRANSFER_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120,
+                     300, 600, float('inf'))
+
+TRANSFER_BYTES = Counter(
+    'skyt_transfer_bytes_total',
+    'Transfer-engine object bytes moved by direction (up, down, copy) '
+    'and outcome')
+TRANSFER_OBJECTS = Counter(
+    'skyt_transfer_objects_total',
+    'Transfer-engine objects by direction and outcome (ok, skipped = '
+    'delta-sync hit, retried = per-attempt retries, error)')
+TRANSFER_SECONDS = Histogram(
+    'skyt_transfer_seconds',
+    'Wall-clock seconds per transfer-engine sync/copy operation',
+    buckets=_TRANSFER_BUCKETS)
+
+_TRANSFER_METRICS = [TRANSFER_BYTES, TRANSFER_OBJECTS, TRANSFER_SECONDS]
+
 _ALL = [REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
-        RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS] + _LB_METRICS
+        RUNTIME_EVENTS, EVENT_WAKEUPS,
+        NOTIFICATIONS] + _LB_METRICS + _TRANSFER_METRICS
 
 
 def collect_from_db() -> None:

@@ -2,25 +2,55 @@
 SURVEY §4: 'a moto-equivalent fake' for offline provider testing).
 
 Implements the path-style subset the client uses: bucket HEAD/PUT/DELETE,
-object PUT/GET/DELETE, ListObjectsV2 with prefix + pagination. Requires a
-SigV4 Authorization header on every request (verifying the client signs)
-but does not validate the signature."""
+object PUT/GET/DELETE with ETag + ranged GET (206), multipart upload
+(initiate / UploadPart / complete), ListObjectsV2 with prefix +
+pagination + Size/ETag metadata. Requires a SigV4 Authorization header
+on every request (verifying the client signs) but does not validate the
+signature.
+
+Knobs for bench/latency tests:
+* ``latency`` — seconds slept before serving each request (models RTT;
+  a serial client pays it once per object, a parallel one amortizes);
+* ``bandwidth`` — bytes/sec throttle per response body (models
+  per-connection throughput; parallel ranged GETs of one object stream
+  over independent connections and multiply it);
+* ``page_size`` — ListObjectsV2 page length (2 by default so ordinary
+  tests exercise pagination; benches raise it to realistic values).
+
+``server.state.counters`` tallies operations ('put_object',
+'get_object', 'get_range', 'put_part', 'list', ...) so delta-sync tests
+can assert a warm re-sync moved ZERO object bodies.
+"""
 from __future__ import annotations
 
+import collections
+import hashlib
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
+from typing import Dict, Tuple
 from xml.sax.saxutils import escape
 
 
 class _State:
     def __init__(self) -> None:
         self.buckets: Dict[str, Dict[str, bytes]] = {}
+        self.etags: Dict[Tuple[str, str], str] = {}
+        self.uploads: Dict[str, Dict] = {}  # id -> {bucket,key,parts}
+        self.counters: collections.Counter = collections.Counter()
+        self.next_upload_id = 0
         self.lock = threading.Lock()
 
+    def record_put(self, bucket: str, key: str, data: bytes) -> str:
+        etag = hashlib.md5(data).hexdigest()
+        self.buckets[bucket][key] = data
+        self.etags[(bucket, key)] = etag
+        return etag
 
-def _handler_for(state: _State):
+
+def _handler_for(state: _State, latency: float, bandwidth,
+                 page_size: int):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = 'HTTP/1.1'
@@ -34,19 +64,54 @@ def _handler_for(state: _State):
             bucket = parts[0]
             key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ''
             query = {k: v[0] for k, v in
-                     urllib.parse.parse_qs(parsed.query).items()}
+                     urllib.parse.parse_qs(
+                         parsed.query, keep_blank_values=True).items()}
             return bucket, key, query
 
+        def _write_throttled(self, body: bytes) -> None:
+            if not bandwidth:
+                self.wfile.write(body)
+                return
+            chunk = 256 * 1024
+            for off in range(0, len(body), chunk):
+                piece = body[off:off + chunk]
+                self.wfile.write(piece)
+                time.sleep(len(piece) / bandwidth)
+
+        def _read_body(self) -> bytes:
+            """Request-body read with the same per-connection throttle
+            (models upload bandwidth for multipart-vs-single PUTs)."""
+            length = int(self.headers.get('Content-Length', 0))
+            if not length:
+                return b''
+            if not bandwidth:
+                return self.rfile.read(length)
+            pieces = []
+            remaining = length
+            while remaining > 0:
+                piece = self.rfile.read(min(256 * 1024, remaining))
+                if not piece:
+                    break
+                pieces.append(piece)
+                remaining -= len(piece)
+                time.sleep(len(piece) / bandwidth)
+            return b''.join(pieces)
+
         def _reply(self, code: int, body: bytes = b'',
-                   ctype: str = 'application/xml'):
+                   ctype: str = 'application/xml',
+                   headers: Dict[str, str] = None):
             self.send_response(code)
             self.send_header('Content-Type', ctype)
             self.send_header('Content-Length', str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             if body:
-                self.wfile.write(body)
+                self._write_throttled(body)
 
         def _check_auth(self) -> bool:
+            if latency:
+                time.sleep(latency)
             auth = self.headers.get('Authorization', '')
             if not auth.startswith('AWS4-HMAC-SHA256'):
                 self._reply(403, b'<Error><Code>AccessDenied</Code></Error>')
@@ -58,19 +123,26 @@ def _handler_for(state: _State):
                 return
             bucket, key, _ = self._split()
             with state.lock:
+                state.counters['head'] += 1
                 if bucket not in state.buckets:
                     self._reply(404)
                 elif key and key not in state.buckets[bucket]:
                     self._reply(404)
+                elif key:
+                    obj = state.buckets[bucket][key]
+                    self.send_response(200)
+                    self.send_header('Content-Length', str(len(obj)))
+                    self.send_header(
+                        'ETag', f'"{state.etags[(bucket, key)]}"')
+                    self.end_headers()
                 else:
                     self._reply(200)
 
         def do_PUT(self):  # noqa: N802
             if not self._check_auth():
                 return
-            bucket, key, _ = self._split()
-            length = int(self.headers.get('Content-Length', 0))
-            data = self.rfile.read(length) if length else b''
+            bucket, key, query = self._split()
+            data = self._read_body()
             with state.lock:
                 if not key:
                     state.buckets.setdefault(bucket, {})
@@ -80,36 +152,136 @@ def _handler_for(state: _State):
                     self._reply(404, b'<Error><Code>NoSuchBucket</Code>'
                                      b'</Error>')
                     return
-                state.buckets[bucket][key] = data
-            self._reply(200)
+                if 'partNumber' in query and 'uploadId' in query:
+                    upload = state.uploads.get(query['uploadId'])
+                    if upload is None:
+                        self._reply(404, b'<Error><Code>NoSuchUpload'
+                                         b'</Code></Error>')
+                        return
+                    part_no = int(query['partNumber'])
+                    upload['parts'][part_no] = data
+                    state.counters['put_part'] += 1
+                    etag = hashlib.md5(data).hexdigest()
+                    self._reply(200, headers={'ETag': f'"{etag}"'})
+                    return
+                etag = state.record_put(bucket, key, data)
+                state.counters['put_object'] += 1
+            self._reply(200, headers={'ETag': f'"{etag}"'})
+
+        def do_POST(self):  # noqa: N802
+            if not self._check_auth():
+                return
+            bucket, key, query = self._split()
+            length = int(self.headers.get('Content-Length', 0))
+            body = self.rfile.read(length) if length else b''
+            with state.lock:
+                if bucket not in state.buckets:
+                    self._reply(404, b'<Error><Code>NoSuchBucket</Code>'
+                                     b'</Error>')
+                    return
+                if 'uploads' in query:
+                    state.next_upload_id += 1
+                    upload_id = f'upload-{state.next_upload_id}'
+                    state.uploads[upload_id] = {
+                        'bucket': bucket, 'key': key, 'parts': {}}
+                    state.counters['initiate'] += 1
+                    xml = (f'<?xml version="1.0"?>'
+                           f'<InitiateMultipartUploadResult>'
+                           f'<Bucket>{escape(bucket)}</Bucket>'
+                           f'<Key>{escape(key)}</Key>'
+                           f'<UploadId>{upload_id}</UploadId>'
+                           f'</InitiateMultipartUploadResult>')
+                    self._reply(200, xml.encode())
+                    return
+                if 'uploadId' in query:
+                    upload = state.uploads.pop(query['uploadId'], None)
+                    if upload is None or upload['key'] != key:
+                        self._reply(404, b'<Error><Code>NoSuchUpload'
+                                         b'</Code></Error>')
+                        return
+                    parts = [upload['parts'][n]
+                             for n in sorted(upload['parts'])]
+                    blob = b''.join(parts)
+                    # Real S3 multipart ETag: md5 of the binary part
+                    # md5s, dash, part count.
+                    md5s = b''.join(hashlib.md5(p).digest()
+                                    for p in parts)
+                    etag = (f'{hashlib.md5(md5s).hexdigest()}'
+                            f'-{len(parts)}')
+                    state.buckets[bucket][key] = blob
+                    state.etags[(bucket, key)] = etag
+                    state.counters['complete'] += 1
+                    xml = (f'<?xml version="1.0"?>'
+                           f'<CompleteMultipartUploadResult>'
+                           f'<Key>{escape(key)}</Key>'
+                           f'<ETag>"{etag}"</ETag>'
+                           f'</CompleteMultipartUploadResult>')
+                    self._reply(200, xml.encode())
+                    return
+            self._reply(400, b'<Error><Code>InvalidRequest</Code>'
+                             b'</Error>')
 
         def do_GET(self):  # noqa: N802
             if not self._check_auth():
                 return
             bucket, key, query = self._split()
+            if key:
+                # Capture under the lock, stream OUTSIDE it — a
+                # bandwidth-throttled body write must not serialize the
+                # other connections.
+                with state.lock:
+                    if bucket not in state.buckets:
+                        self._reply(404, b'<Error><Code>NoSuchBucket'
+                                         b'</Code></Error>')
+                        return
+                    payload = state.buckets[bucket].get(key)
+                    if payload is None:
+                        self._reply(404, b'<Error><Code>NoSuchKey'
+                                         b'</Code></Error>')
+                        return
+                    etag = state.etags[(bucket, key)]
+                    rng = self.headers.get('Range', '')
+                    if rng.startswith('bytes='):
+                        state.counters['get_range'] += 1
+                    else:
+                        state.counters['get_object'] += 1
+                if rng.startswith('bytes='):
+                    start_s, _, end_s = rng[len('bytes='):].partition('-')
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(payload) - 1
+                    end = min(end, len(payload) - 1)
+                    self._reply(
+                        206, payload[start:end + 1],
+                        ctype='application/octet-stream',
+                        headers={
+                            'ETag': f'"{etag}"',
+                            'Content-Range':
+                                f'bytes {start}-{end}/{len(payload)}',
+                        })
+                    return
+                self._reply(200, payload,
+                            ctype='application/octet-stream',
+                            headers={'ETag': f'"{etag}"'})
+                return
             with state.lock:
                 if bucket not in state.buckets:
                     self._reply(404, b'<Error><Code>NoSuchBucket</Code>'
                                      b'</Error>')
                     return
                 objs = state.buckets[bucket]
-                if key:
-                    if key not in objs:
-                        self._reply(404, b'<Error><Code>NoSuchKey</Code>'
-                                         b'</Error>')
-                        return
-                    self._reply(200, objs[key],
-                                ctype='application/octet-stream')
-                    return
                 # ListObjectsV2 with small pages to exercise pagination
+                state.counters['list'] += 1
                 prefix = query.get('prefix', '')
                 token = query.get('continuation-token', '')
                 keys = sorted(k for k in objs if k.startswith(prefix))
                 if token:
                     keys = [k for k in keys if k > token]
-                page, rest = keys[:2], keys[2:]
+                page, rest = keys[:page_size], keys[page_size:]
                 contents = ''.join(
-                    f'<Contents><Key>{escape(k)}</Key></Contents>'
+                    f'<Contents><Key>{escape(k)}</Key>'
+                    f'<Size>{len(objs[k])}</Size>'
+                    f'<ETag>&quot;{state.etags[(bucket, k)]}&quot;'
+                    f'</ETag></Contents>'
                     for k in page)
                 truncated = 'true' if rest else 'false'
                 next_token = (f'<NextContinuationToken>{escape(page[-1])}'
@@ -125,24 +297,45 @@ def _handler_for(state: _State):
                 return
             bucket, key, _ = self._split()
             with state.lock:
+                state.counters['delete'] += 1
                 if key:
                     state.buckets.get(bucket, {}).pop(key, None)
+                    state.etags.pop((bucket, key), None)
                 else:
+                    for k in list(state.etags):
+                        if k[0] == bucket:
+                            state.etags.pop(k, None)
                     state.buckets.pop(bucket, None)
             self._reply(204)
 
     return Handler
 
 
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # The stock backlog of 5 drops SYNs when 16+ workers dial at once;
+    # the kernel retransmits after ~1 s, which would masquerade as a
+    # fake-server 'latency' and poison parallel-transfer timings.
+    request_queue_size = 128
+
+
 class FakeS3Server:
     """`with FakeS3Server() as url:` -- a live endpoint on 127.0.0.1."""
 
-    def __init__(self) -> None:
+    def __init__(self, latency: float = 0.0, bandwidth=None,
+                 page_size: int = 2) -> None:
         self.state = _State()
-        self.httpd = ThreadingHTTPServer(('127.0.0.1', 0),
-                                         _handler_for(self.state))
-        self.httpd.daemon_threads = True
+        self.httpd = _Server(
+            ('127.0.0.1', 0),
+            _handler_for(self.state, latency, bandwidth, page_size))
         self.url = f'http://127.0.0.1:{self.httpd.server_address[1]}'
+
+    def body_ops(self) -> int:
+        """Requests that moved an object body (delta-sync warm re-syncs
+        must not grow this)."""
+        c = self.state.counters
+        return (c['put_object'] + c['get_object'] + c['get_range'] +
+                c['put_part'])
 
     def __enter__(self) -> 'FakeS3Server':
         threading.Thread(target=self.httpd.serve_forever,

@@ -80,9 +80,11 @@ def _handler_for(state):
                      urllib.parse.parse_qs(parsed.query).items()}
             return container, blob, query
 
-        def _reply(self, code, body=b''):
+        def _reply(self, code, body=b'', headers=None):
             self.send_response(code)
             self.send_header('Content-Length', str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             if body:
                 self.wfile.write(body)
@@ -117,7 +119,9 @@ def _handler_for(state):
                 if query.get('comp') == 'block':
                     state.blocks.setdefault((container, blob), {})[
                         query['blockid']] = data
-                elif query.get('comp') == 'blocklist':
+                    self._reply(201)
+                    return
+                if query.get('comp') == 'blocklist':
                     import re
                     ids = re.findall(r'<Latest>([^<]+)</Latest>',
                                      data.decode())
@@ -126,7 +130,13 @@ def _handler_for(state):
                         staged[i] for i in ids)
                 else:
                     state.containers[container][blob] = data
-            self._reply(201)
+                # Real Azure returns the blob ETag on Put Blob / Put
+                # Block List; the fake models it as the content md5
+                # (stands in for Content-MD5 semantics).
+                import hashlib
+                etag = hashlib.md5(
+                    state.containers[container][blob]).hexdigest()
+            self._reply(201, headers={'ETag': f'"{etag}"'})
 
         def do_GET(self):  # noqa: N802
             if not self._authed():
@@ -138,9 +148,14 @@ def _handler_for(state):
                     return
                 blobs = state.containers[container]
                 if query.get('comp') == 'list':
+                    import hashlib
                     prefix = query.get('prefix', '')
                     names = ''.join(
-                        f'<Blob><Name>{escape(n)}</Name></Blob>'
+                        f'<Blob><Name>{escape(n)}</Name><Properties>'
+                        f'<Content-Length>{len(blobs[n])}'
+                        f'</Content-Length>'
+                        f'<Etag>{hashlib.md5(blobs[n]).hexdigest()}'
+                        f'</Etag></Properties></Blob>'
                         for n in sorted(blobs) if n.startswith(prefix))
                     body = (f'<?xml version="1.0"?><EnumerationResults>'
                             f'<Blobs>{names}</Blobs>'
@@ -154,7 +169,16 @@ def _handler_for(state):
                 if blob not in blobs:
                     self._reply(404)
                     return
-                self._reply(200, blobs[blob])
+                payload = blobs[blob]
+                rng = self.headers.get('x-ms-range', '')
+                if rng.startswith('bytes='):
+                    start_s, _, end_s = rng[len('bytes='):].partition('-')
+                    start = int(start_s)
+                    end = min(int(end_s) if end_s
+                              else len(payload) - 1, len(payload) - 1)
+                    self._reply(206, payload[start:end + 1])
+                    return
+                self._reply(200, payload)
 
         def do_DELETE(self):  # noqa: N802
             if not self._authed():
