@@ -1,0 +1,180 @@
+"""Elastic recovery bench: relaunch vs shrink, preemption → next step.
+
+Quantifies the ElasticStrategy (jobs/recovery_strategy.py, ISSUE 6)
+against the rigid FAILOVER relaunch it replaces for gang-scheduled
+multi-slice jobs. Both cases run a real detached managed-job
+controller against the fake provider with a resumable step-counter
+payload (the checkpoint contract pretrain.py implements for real);
+the fake cloud injects a provisioning latency so a full relaunch pays
+what a real TPU pod re-provision pays, while an elastic shrink — which
+tears down only the dead slice and re-execs on the survivors — does
+not.
+
+Measured: wall-clock from ``fake.preempt_slice`` (one slice of a
+2-slice gang dies) to the payload's FIRST step after recovery, i.e.
+the training downtime a preemption costs.
+
+* ``relaunch`` — rigid FAILOVER: teardown the whole gang, re-provision
+  at full size (pays the injected create latency), resume.
+* ``shrink``   — elastic: keep the gang, drop the dead slice, re-exec
+  on the survivors from the same step counter.
+
+CPU-only, no cloud or TPU access; one JSON document on stdout (wired
+into run_benches.sh → ``BENCH_elastic_<suffix>.json``; measured
+numbers land in PERF.md and docs/elastic_training.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _setup_env(slow_create: float) -> None:
+    home = tempfile.mkdtemp(prefix='skyt-bench-elastic-')
+    os.environ['HOME'] = home
+    os.environ['SKYT_STATE_DIR'] = os.path.join(home, '.skyt')
+    os.environ['SKYT_JOBS_CONTROLLER_POLL'] = '0.2'
+    os.environ['SKYT_JOBS_LAUNCH_RETRY_GAP'] = '0.2'
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    del slow_create
+
+
+# Each incarnation logs one 'start' line, then a 'step N' line per
+# step — the bench measures to the first step of the NEW incarnation
+# (the old one keeps looping until the controller kills it; real TPU
+# ranks would be blocked on dead DCN peers, the stub is not).
+_PAYLOAD = (
+    'echo start >> "$CKPT.log"; '
+    'step=$(cat "$CKPT" 2>/dev/null || echo 0); '
+    'while [ "$step" -lt 100000 ]; do '
+    '  step=$((step+1)); echo "$step" > "$CKPT"; '
+    '  echo "step $step" >> "$CKPT.log"; '
+    '  if [ -n "${SKYT_RESIZE_SIGNAL:-}" ] && '
+    '     [ -f "$SKYT_RESIZE_SIGNAL" ]; then exit 0; fi; '
+    '  sleep 0.05; '
+    'done')
+
+
+def _step(ckpt: str) -> int:
+    try:
+        with open(ckpt, encoding='utf-8') as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+def _log_lines(ckpt: str) -> list:
+    try:
+        with open(ckpt + '.log', encoding='utf-8') as f:
+            return f.read().splitlines()
+    except OSError:
+        return []
+
+
+def _stepped_after_incarnation(ckpt: str, min_starts: int) -> bool:
+    """True once incarnation #min_starts (1-based) logged a step."""
+    lines = _log_lines(ckpt)
+    starts = 0
+    for i, line in enumerate(lines):
+        if line.startswith('start'):
+            starts += 1
+            if starts >= min_starts:
+                return any(l.startswith('step') for l in lines[i + 1:])
+    return False
+
+
+def _wait(pred, what: str, timeout: float = 90.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise SystemExit(f'bench_elastic: timed out waiting for {what}')
+
+
+def run_case(elastic: bool, slow_create: float) -> dict:
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.provision import fake
+    from skypilot_tpu.spec.resources import Resources
+    from skypilot_tpu.spec.task import Task
+
+    fake.reset()
+    # Every run_instances call (initial launch AND any relaunch) pays
+    # this — the stand-in for real TPU pod re-provisioning latency.
+    # Trim/grow of an existing gang does not call run_instances.
+    fake.inject_slow_create(slow_create)
+
+    ckpt = os.path.join(tempfile.mkdtemp(prefix='skyt-bench-el-'), 'ckpt')
+    kwargs = {}
+    if elastic:
+        # grow_check high: the measurement window must see the shrink
+        # only, not a concurrent grow-back.
+        kwargs['elastic'] = {'min_slices': 1, 'max_slices': 2,
+                             'grow_check_seconds': 300,
+                             'drain_seconds': 3}
+    task = Task(name='bench-el' if elastic else 'bench-rigid',
+                run=_PAYLOAD, envs={'CKPT': ckpt},
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8',
+                                    num_slices=2, use_spot=True),
+                **kwargs)
+    job_id = jobs_core.launch(task)
+    _wait(lambda: (jobs_state.get(job_id).status.value == 'RUNNING' and
+                   _step(ckpt) >= 2),
+          'initial RUNNING + first steps')
+    record = jobs_state.get(job_id)
+
+    starts_before = sum(
+        1 for l in _log_lines(ckpt) if l.startswith('start'))
+    t0 = time.monotonic()
+    fake.preempt_slice(record.cluster_name, 1, hosts_per_slice=1)
+    _wait(lambda: _stepped_after_incarnation(ckpt, starts_before + 1),
+          'first step of the recovered incarnation')
+    recovery_seconds = time.monotonic() - t0
+
+    modes = [e['mode'] for e in jobs_state.recovery_events(job_id)]
+    jobs_core.cancel(job_id)
+    _wait(lambda: jobs_state.get(job_id).status.value == 'CANCELLED',
+          'cancel', timeout=30)
+    fake.reset()
+    return {
+        'mode': 'shrink' if elastic else 'relaunch',
+        'preempt_to_next_step_seconds': round(recovery_seconds, 3),
+        'recovery_modes': modes,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(__doc__)
+    parser.add_argument('--slow-create', type=float, default=2.0,
+                        help='Injected provisioning latency per '
+                             'run_instances call (the cost a relaunch '
+                             'pays and a shrink avoids).')
+    args = parser.parse_args(argv)
+    _setup_env(args.slow_create)
+
+    relaunch = run_case(elastic=False, slow_create=args.slow_create)
+    shrink = run_case(elastic=True, slow_create=args.slow_create)
+    assert 'shrink' in shrink['recovery_modes'], shrink
+    assert 'shrink' not in relaunch['recovery_modes'], relaunch
+
+    result = {
+        'bench': 'elastic_recovery',
+        'injected_provision_seconds': args.slow_create,
+        'relaunch': relaunch,
+        'shrink': shrink,
+        'speedup': round(
+            relaunch['preempt_to_next_step_seconds'] /
+            max(shrink['preempt_to_next_step_seconds'], 1e-9), 2),
+    }
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -42,6 +42,14 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_data_transfer.py \
   | tee "BENCH_data_transfer_${suffix}.json"
 echo "rc=$? -> BENCH_data_transfer_${suffix}.json" >&2
 
+# Elastic recovery bench: CPU-only — preemption-to-next-step downtime
+# for rigid relaunch vs elastic shrink on the fault-injected fake
+# provider (docs/elastic_training.md, numbers in PERF.md).
+echo "=== bench elastic ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_elastic.py \
+  | tee "BENCH_elastic_${suffix}.json"
+echo "rc=$? -> BENCH_elastic_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

@@ -12,6 +12,13 @@ spawned by the scheduler. The monitor loop watches two signals:
 On preemption it enters RECOVERING and delegates to the job's recovery
 strategy; on user-code failure it restarts in place up to
 ``max_restarts_on_errors`` times (ref recovery_strategy.py:92).
+
+The monitor ticks are event-driven: the loop waits on the CLUSTERS
+notification topic (utils/events.py) with POLL_SECONDS as the degraded
+fallback, so a provider health write (preemption, capacity return)
+wakes the controller in milliseconds instead of a poll interval. For
+elastic jobs (ElasticStrategy) the same wakeups drive the grow-back
+watcher that re-expands a shrunken gang when capacity returns.
 """
 from __future__ import annotations
 
@@ -28,11 +35,28 @@ from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
 from skypilot_tpu.jobs.state import ManagedJobStatus
 from skypilot_tpu.provision.api import ClusterInfo, get_provider
 from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import events
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
+from skypilot_tpu.utils import resilience
 
 logger = log.init_logger(__name__)
 
 POLL_SECONDS = float(os.environ.get('SKYT_JOBS_CONTROLLER_POLL', '10'))
+# The CLUSTERS topic is global: every cluster write anywhere wakes every
+# controller. The first wake after a quiet period ticks immediately
+# (preemption -> shrink stays at event latency); bursts are coalesced so
+# one controller never probes its runtime job table more than once per
+# gap, no matter how busy the fleet's cluster table is.
+EVENT_MIN_GAP = float(os.environ.get('SKYT_JOBS_EVENT_MIN_GAP', '0.5'))
+# Consecutive failed monitor probes (jobs.controller.monitor faults, DB
+# contention) tolerated before the controller stops trusting its view
+# and degrades to recovery — bounded, so injected faults can never
+# hang the loop in a probe-retry spin.
+MONITOR_FAULT_LIMIT = 3
+# Transient-failure retry budget for one recovery attempt
+# (jobs.controller.recover site): ~6 tries over a few seconds.
+RECOVER_RETRIES = 6
 
 
 class JobController:
@@ -53,6 +77,14 @@ class JobController:
                                               self.task, self.cluster_name)
         self.backend = TpuPodBackend()
         self.restarts_left = record.max_restarts_on_errors
+        # Event-driven monitor: wake on cluster-state writes (preemption
+        # marks, capacity events) instead of sleeping the full poll
+        # interval; POLL_SECONDS stays as the degraded fallback cadence.
+        self._clusters_signal = state.change_signal()
+        self._clusters_cursor = events.cursor(events.CLUSTERS)
+        self._monitor_failures = 0
+        self._last_event_tick = 0.0
+        self._last_grow_attempt = 0.0
 
     # -- cluster probes ------------------------------------------------
 
@@ -102,10 +134,12 @@ class JobController:
         jobs_state.set_status(self.job_id, status, failure_reason=reason)
         logger.info('Managed job %s: %s', self.job_id, status.value)
 
-    def _recover(self) -> Optional[int]:
+    def _recover(self,
+                 cluster_job_id: Optional[int] = None) -> Optional[int]:
         if jobs_state.cancel_requested(self.job_id):
             self._finalize(ManagedJobStatus.CANCELLED)
             return None
+        detect_t0 = time.monotonic()
         jobs_state.set_status(self.job_id, ManagedJobStatus.RECOVERING)
         jobs_state.bump_recovery(self.job_id)
         if self.record.group_name:
@@ -114,8 +148,23 @@ class JobController:
             # barrier's in-memory env).
             from skypilot_tpu.jobs import job_groups
             self.task.update_envs(job_groups.rebuild_env(self.record))
+        if self.strategy.is_elastic:
+            # The elastic shrink path cancels the survivors' ranks
+            # before re-forming the world at the smaller topology.
+            self.strategy.prev_cluster_job_id = cluster_job_id
+        def _attempt():
+            fault_injection.inject('jobs.controller.recover')
+            return self.strategy.recover()
+
         try:
-            cluster_job_id = self.strategy.recover()
+            # Transient chaos / DB contention around the recovery
+            # machinery itself gets bounded retries
+            # (resilience.call_with_retry); ResourcesUnavailableError is
+            # the strategy's final word and is never retried here.
+            new_cluster_job_id = resilience.call_with_retry(
+                _attempt, base=0.2, cap=2.0, deadline=None,
+                max_attempts=RECOVER_RETRIES,
+                what=f'managed job {self.job_id} recover')
         except exceptions.ResourcesUnavailableError as e:
             self._finalize(ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
             return None
@@ -125,7 +174,13 @@ class JobController:
             from skypilot_tpu.jobs import job_groups
             job_groups.publish_hosts(self.job_id, self.cluster_name)
         jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
-        return cluster_job_id
+        jobs_state.record_recovery(
+            self.job_id,
+            getattr(self.strategy, 'last_mode', None) or 'relaunch',
+            getattr(self.strategy, 'last_from_slices', None),
+            getattr(self.strategy, 'last_to_slices', None),
+            time.monotonic() - detect_t0)
+        return new_cluster_job_id
 
     def _gang_launch(self) -> int:
         """Group member: provision+setup, publish hosts, barrier, exec
@@ -153,11 +208,94 @@ class JobController:
                 f'{self.cluster_name} vanished between barrier and exec')
         return self.backend.execute(info, self.task, detach=True)
 
+    def _wait_tick(self) -> str:
+        """One monitor-loop wait: returns early on a CLUSTERS topic
+        wake (preemption/health/capacity write from any process), else
+        after POLL_SECONDS. Returns the wake source."""
+        self._clusters_cursor, source = events.wait_for(
+            events.CLUSTERS, self._clusters_cursor, POLL_SECONDS,
+            external=self._clusters_signal)
+        if source != 'fallback':
+            # Coalesce event bursts (see EVENT_MIN_GAP). Only
+            # event-triggered ticks arm the gap: a lone preemption event
+            # after a quiet stretch still reacts at event latency, while
+            # writes landing during the gap are already past our cursor,
+            # so a burst costs one probe per gap instead of one per
+            # write.
+            remaining = (EVENT_MIN_GAP -
+                         (time.monotonic() - self._last_event_tick))
+            if remaining > 0:
+                time.sleep(remaining)
+            self._last_event_tick = time.monotonic()
+        return source
+
+    def _record_initial_topology(self) -> None:
+        """Seed the world-size history at first RUNNING (elastic jobs
+        track current_slices from the start; the initial row makes the
+        recovery_events trajectory complete: launch -> shrink -> grow)."""
+        if not self.strategy.is_elastic:
+            return
+        record = jobs_state.get(self.job_id)
+        if record is not None and record.current_slices:
+            # HA replacement adopting a (possibly shrunken) gang: the
+            # topology history is already being written.
+            return
+        jobs_state.set_current_slices(self.job_id,
+                                      self.strategy.full_slices)
+        jobs_state.record_recovery(self.job_id, 'launch', None,
+                                   self.strategy.full_slices)
+
+    def _exec_task(self):
+        """The task for a restart-in-place (user-code failure with
+        restarts budget): at the gang's CURRENT topology when elastic —
+        a shrunken gang must not re-exec the full-size task, whose envs
+        and mesh describe more devices than survive."""
+        if self.strategy.is_elastic:
+            return self.strategy.exec_task()
+        return self.task
+
+    def _maybe_grow(self, cluster_job_id: int, source: str
+                    ) -> Optional[int]:
+        """Grow-back watcher: when an elastic gang runs shrunken, retry
+        re-expansion every ``grow_check_seconds`` — and immediately on a
+        cluster-event wake (capacity returning IS a cluster-state
+        write), floored at 1s so a write-busy control plane doesn't
+        spin the optimizer. Returns the new cluster job id after a
+        successful grow, else None. Exceptions propagate: a failure
+        after the drain started must fall into normal recovery, not be
+        swallowed (the old payload may already be cancelled)."""
+        strategy = self.strategy
+        if not strategy.is_elastic:
+            return None
+        if strategy.current_slices() >= strategy.full_slices:
+            return None
+        now = time.monotonic()
+        elapsed = now - self._last_grow_attempt
+        due = elapsed >= strategy.grow_check_seconds
+        if not due and not (source != 'fallback' and elapsed >= 1.0):
+            return None
+        self._last_grow_attempt = now
+        t0 = time.monotonic()
+        strategy.prev_cluster_job_id = cluster_job_id
+        new_cluster_job_id = strategy.try_grow()
+        if new_cluster_job_id is None:
+            return None
+        jobs_state.record_recovery(
+            self.job_id, 'grow', strategy.last_from_slices,
+            strategy.last_to_slices, time.monotonic() - t0)
+        return new_cluster_job_id
+
     def _reattach(self) -> Optional[int]:
         """Replacement-controller path (HA recovery): adopt the live
         cluster job if there is one; finalize directly if it already
         finished; otherwise fall back to a normal recovery. Returns the
         cluster job id to monitor, or None when the job is finalized."""
+        # The dead controller may have been mid-drain: a leftover
+        # resize-signal file would make every later payload incarnation
+        # checkpoint and exit 0 at its first step boundary, finalizing a
+        # half-trained job as SUCCEEDED.
+        if self.strategy.is_elastic:
+            self.strategy.clear_resize_signal()
         # A transient queue-read failure must NOT look like an empty
         # queue: falling into recovery while the original cluster job
         # still runs would execute the workload twice. Keep probing as
@@ -200,7 +338,7 @@ class JobController:
                                           ManagedJobStatus.RECOVERING)
                     jobs_state.bump_recovery(self.job_id)
                     cluster_job_id = self.backend.execute(
-                        info, self.task, detach=True)
+                        info, self._exec_task(), detach=True)
                     jobs_state.set_status(self.job_id,
                                           ManagedJobStatus.RUNNING)
                     return cluster_job_id
@@ -249,9 +387,10 @@ class JobController:
                 return
             scheduler.launch_done(self.job_id)
             jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
+        self._record_initial_topology()
 
         while True:
-            time.sleep(POLL_SECONDS)
+            source = self._wait_tick()
             if jobs_state.cancel_requested(self.job_id):
                 info = self._cluster_info()
                 if info is not None and cluster_job_id is not None:
@@ -261,6 +400,26 @@ class JobController:
                         pass
                 self._finalize(ManagedJobStatus.CANCELLED)
                 return
+
+            try:
+                fault_injection.inject('jobs.controller.monitor')
+                self._monitor_failures = 0
+            except resilience.transient_db_errors() as e:
+                # Chaos/DB faults on the probe path: a broken view must
+                # degrade to recovery after a bounded number of ticks,
+                # never hang the monitor (tests/test_elastic_training).
+                self._monitor_failures += 1
+                logger.warning(
+                    'Managed job %s: monitor probe fault (%d/%d): %s',
+                    self.job_id, self._monitor_failures,
+                    MONITOR_FAULT_LIMIT, e)
+                if self._monitor_failures < MONITOR_FAULT_LIMIT:
+                    continue
+                self._monitor_failures = 0
+                cluster_job_id = self._recover(cluster_job_id)
+                if cluster_job_id is None:
+                    return
+                continue
 
             job_status = self._job_status(cluster_job_id)
             if job_status == 'SUCCEEDED':
@@ -289,7 +448,7 @@ class JobController:
                     if info is None or not self._cluster_healthy():
                         # Cluster died between the failure and the restart:
                         # this is a preemption, not a user-code retry.
-                        cluster_job_id = self._recover()
+                        cluster_job_id = self._recover(cluster_job_id)
                         if cluster_job_id is None:
                             return
                         continue
@@ -301,8 +460,8 @@ class JobController:
                     jobs_state.set_status(self.job_id,
                                           ManagedJobStatus.RECOVERING)
                     jobs_state.bump_recovery(self.job_id)
-                    cluster_job_id = self.backend.execute(info, self.task,
-                                                          detach=True)
+                    cluster_job_id = self.backend.execute(
+                        info, self._exec_task(), detach=True)
                     jobs_state.set_status(self.job_id,
                                           ManagedJobStatus.RUNNING)
                     continue
@@ -315,17 +474,41 @@ class JobController:
             if job_status in ('PENDING', 'SETTING_UP', 'RUNNING'):
                 if not self._cluster_healthy():
                     # Preempted mid-run (TPU slices vanish as a unit).
+                    # Checked BEFORE any grow attempt: the runtime job
+                    # table can still answer RUNNING after a preemption,
+                    # and growing an unhealthy gang would top up around
+                    # dead hosts and re-exec onto them.
                     logger.warning(
                         'Managed job %s: cluster %s unhealthy; '
                         'recovering.', self.job_id, self.cluster_name)
-                    cluster_job_id = self._recover()
+                    cluster_job_id = self._recover(cluster_job_id)
                     if cluster_job_id is None:
                         return
+                    continue
+                # Grow only while the payload is live: attempting it
+                # before the status read could drain and re-expand a job
+                # that already SUCCEEDED this tick, re-running finished
+                # work at full size instead of finalizing it.
+                try:
+                    grown = self._maybe_grow(cluster_job_id, source)
+                except Exception as e:  # pylint: disable=broad-except
+                    # The drain may already have stopped the shrunken
+                    # payload — a failed grow is a preemption-equivalent.
+                    logger.warning(
+                        'Managed job %s: grow-back failed mid-flight '
+                        '(%s: %s); entering recovery.', self.job_id,
+                        type(e).__name__, e)
+                    cluster_job_id = self._recover(cluster_job_id)
+                    if cluster_job_id is None:
+                        return
+                    continue
+                if grown is not None:
+                    cluster_job_id = grown
                 continue
             # Job table unreachable: the cluster is gone.
             logger.warning('Managed job %s: lost cluster %s; recovering.',
                            self.job_id, self.cluster_name)
-            cluster_job_id = self._recover()
+            cluster_job_id = self._recover(cluster_job_id)
             if cluster_job_id is None:
                 return
 

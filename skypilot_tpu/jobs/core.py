@@ -32,10 +32,21 @@ def launch(task: Task, name: Optional[str] = None) -> int:
         else:
             strategy = recovery.get('strategy') or 'FAILOVER'
             max_restarts = int(recovery.get('max_restarts_on_errors', 0))
+    if task.elastic:
+        # An elastic spec needs the elastic recovery machinery; an
+        # explicit conflicting job_recovery strategy would silently
+        # disable shrink-to-surviving-slices, so elastic wins loudly.
+        if strategy not in ('FAILOVER', 'ELASTIC'):
+            logger.warning(
+                'Task requests job_recovery strategy %s AND an elastic '
+                'block; elastic recovery (ELASTIC) takes precedence.',
+                strategy)
+        strategy = 'ELASTIC'
     job_id = jobs_state.submit(task.to_yaml_config(),
                                name or task.name,
                                strategy=strategy,
-                               max_restarts_on_errors=max_restarts)
+                               max_restarts_on_errors=max_restarts,
+                               elastic=task.elastic)
     logger.info('Managed job %s submitted (strategy=%s).', job_id,
                 strategy)
     scheduler.maybe_schedule_next_jobs()
@@ -60,6 +71,16 @@ def launch_group(tasks: List[Task],
     job_ids = []
     for task in tasks:
         task = admin_policy.apply(task, 'jobs.launch')
+        if task.elastic:
+            # Group members barrier on each other's host IPs at start;
+            # resizing one member would invalidate the gang's env, so
+            # elastic recovery is not supported here — say so instead of
+            # silently running the member rigid.
+            logger.warning(
+                'Job group %s: task %s has an elastic block, but job '
+                'groups do not support elastic recovery; the member '
+                'will use rigid FAILOVER relaunch.', group_name,
+                task.name)
         job_ids.append(
             jobs_state.submit(task.to_yaml_config(), task.name,
                               strategy='FAILOVER',

@@ -18,14 +18,18 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions, execution, state
 from skypilot_tpu.backend.tpu_backend import TpuPodBackend
+from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
+                                        ProvisionRequest, get_provider)
 from skypilot_tpu.provision.provisioner import Blocklist
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
+from skypilot_tpu.utils import resilience
 from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
 
 logger = log.init_logger(__name__)
@@ -33,6 +37,24 @@ logger = log.init_logger(__name__)
 # Initial-launch retry cadence on full stockout. Env > per-task config
 # (`config: {jobs: {launch_retry_gap: N}}`) > global config > default
 # (the reference backs off up to RETRY_INIT_GAP_SECONDS=60).
+
+
+def _record_slices(job_id: int, slices: int) -> None:
+    """Durable world-size bookkeeping AFTER the gang is already running:
+    retried briefly, then logged and dropped — a transient DB blip must
+    not bubble out of a recover()/resize that already succeeded (the
+    controller would re-run it, tearing down the just-launched payload;
+    the next resize re-derives the census from the provider anyway)."""
+    from skypilot_tpu.jobs import state as jobs_state
+    try:
+        resilience.call_with_retry(
+            lambda: jobs_state.set_current_slices(job_id, slices),
+            deadline=5.0, what='set_current_slices')
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(
+            'Job %s: failed to record current_slices=%d (%s: %s); '
+            'continuing with the gang up.', job_id, slices,
+            type(e).__name__, e)
 
 
 def _retry_gap(task: Task) -> float:
@@ -55,6 +77,10 @@ def _max_retries(task: Task) -> int:
 
 class StrategyExecutor:
     """Drives launch/recover for one managed job (ref :75)."""
+
+    # ElasticStrategy overrides to True; the controller branches on it
+    # for resize bookkeeping, grow-back, and current-topology exec.
+    is_elastic = False
 
     def __init__(self, job_id: int, task: Task, cluster_name: str) -> None:
         self.job_id = job_id
@@ -89,6 +115,7 @@ class StrategyExecutor:
 
     def _relaunch_once(self, blocklist: Blocklist) -> Optional[int]:
         """One launch attempt with the given blocklist (no retry loop)."""
+        fault_injection.inject('jobs.recovery.launch')
         results = execution.launch(self.task,
                                    self.cluster_name,
                                    detach_run=True,
@@ -135,6 +162,17 @@ class StrategyExecutor:
                 # transient) and wait for capacity.
                 blocklist.zones.clear()
                 blocklist.regions.clear()
+            except resilience.transient_db_errors() as e:
+                # Infra blips (DB contention, provider API resets, the
+                # jobs.recovery.launch chaos site) spend the same retry
+                # budget; blocklists stay — the locations weren't probed.
+                logger.warning(
+                    'Job %s: transient launch failure (attempt %d/%d): '
+                    '%s', self.job_id, attempt + 1, max_retries, e)
+            if attempt + 1 < max_retries:
+                # No sleep after the FINAL failure: the raise below is
+                # imminent and a trailing backoff (up to gap*10 s) would
+                # only delay the FAILED_NO_RESOURCE verdict.
                 time.sleep(backoff.current_backoff())
         raise exceptions.ResourcesUnavailableError(
             f'Managed job {self.job_id}: exhausted {max_retries} '
@@ -184,6 +222,331 @@ class EagerNextRegionStrategy(StrategyExecutor):
         except exceptions.ResourcesUnavailableError:
             # Every other region is out too; allow the original again.
             return self._launch_with_retries(Blocklist())
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register('ELASTIC')
+class ElasticStrategy(FailoverStrategy):
+    """Elastic world-size recovery for gang-scheduled multi-slice jobs.
+
+    On preemption of a strict subset of the gang's pod slices, shrink to
+    the surviving slices (teardown only the dead slice, keep the gang)
+    and resume from the latest checkpoint at the new topology — roughly
+    one checkpoint-restore of downtime instead of a full teardown +
+    re-provision + wait-for-full-capacity (the Bamboo/Oobleck result,
+    ISSUE 6). A grow-back watcher (driven by the controller loop)
+    re-expands to ``max_slices`` once the optimizer finds capacity on
+    the gang's placement again. Falls back to the FAILOVER relaunch when
+    fewer than ``min_slices`` survive, when the provider lacks the
+    trim/grow capability, or when anything in the shrink path fails.
+    """
+
+    is_elastic = True
+
+    def __init__(self, job_id: int, task: Task, cluster_name: str) -> None:
+        super().__init__(job_id, task, cluster_name)
+        spec = task.elastic or {}
+        resources = task.resources[0] if task.resources else None
+        full = (resources.num_slices
+                if resources is not None and resources.is_tpu else 1)
+        self.full_slices = int(spec.get('max_slices', full) or full)
+        self.min_slices = int(spec.get('min_slices', 1))
+        self.drain_seconds = float(spec.get('drain_seconds', 30.0))
+        self.grow_check_seconds = float(
+            spec.get('grow_check_seconds', 30.0))
+        # The cluster job the gang is currently running — set by the
+        # controller before recover()/try_grow() so the old gang can be
+        # cancelled (shrink) or drained at a step boundary (grow).
+        self.prev_cluster_job_id: Optional[int] = None
+        # What the last recover()/try_grow() actually did, for the
+        # controller's recovery_events row (metrics + history).
+        self.last_mode: Optional[str] = None
+        self.last_from_slices: Optional[int] = None
+        self.last_to_slices: Optional[int] = None
+        # The INITIAL launch runs at the full world size; exporting the
+        # elastic envs from the start means the payload resolves its
+        # mesh the same way on every incarnation (full, shrunken,
+        # grown-back) and watches the resize signal from step one.
+        task.update_envs(self.elastic_envs(self.full_slices))
+
+    # -- topology census -----------------------------------------------
+
+    def _hosts_per_slice(self) -> int:
+        resources = self.task.resources[0] if self.task.resources else None
+        if resources is not None and resources.is_tpu:
+            return resources.tpu.hosts_per_slice
+        return 1
+
+    def current_slices(self) -> int:
+        from skypilot_tpu.jobs import state as jobs_state
+        record = jobs_state.get(self.job_id)
+        if record is not None and record.current_slices:
+            return record.current_slices
+        return self.full_slices
+
+    def resize_signal_path(self) -> str:
+        """Step-boundary resize handshake file: the controller touches
+        it, the payload checkpoints and exits at its next step boundary
+        (SKYT_RESIZE_SIGNAL env contract, docs/elastic_training.md)."""
+        from skypilot_tpu.jobs import state as jobs_state
+        return os.path.join(jobs_state.jobs_dir(),
+                            f'resize-{self.job_id}.signal')
+
+    def elastic_envs(self, slices: int) -> Dict[str, str]:
+        return {
+            'SKYT_ELASTIC': '1',
+            'SKYT_ELASTIC_SLICES': str(slices),
+            'SKYT_RESIZE_SIGNAL': self.resize_signal_path(),
+        }
+
+    def _slice_census(self) -> Optional[Tuple[List[int],
+                                              Dict[int, List[HostInfo]],
+                                              'state.ClusterRecord']]:
+        """(surviving slice ids, slice->hosts, cluster record) from the
+        provider's instance states; None when the cluster is gone or the
+        provider is unreachable (both mean: full relaunch)."""
+        record = state.get_cluster(self.cluster_name)
+        if record is None or record.cloud is None or not record.handle:
+            return None
+        try:
+            states = get_provider(record.cloud).query_instances(
+                self.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        if not states:
+            return None
+        info = ClusterInfo.from_dict(record.handle)
+        per_slice = self._hosts_per_slice()
+        slices: Dict[int, List[HostInfo]] = {}
+        for host in info.hosts:
+            slices.setdefault(host.worker_index // per_slice,
+                              []).append(host)
+        surviving = [
+            sid for sid, hosts in sorted(slices.items())
+            if all(states.get(h.instance_id) == 'running' for h in hosts)
+        ]
+        return surviving, slices, record
+
+    def exec_task(self) -> Task:
+        """The task to (re-)execute at the gang's CURRENT topology.
+
+        A restart-in-place (user-code failure, max_restarts_on_errors)
+        on a shrunken gang must not run the full-size task: its envs say
+        SKYT_ELASTIC_SLICES=full and its mesh would not fit the
+        surviving slices' devices."""
+        current = self.current_slices()
+        if current >= self.full_slices:
+            return self.task
+        task, _ = self._resized_task(current)
+        return task
+
+    def clear_resize_signal(self) -> None:
+        """Remove a leftover resize-signal file. A controller that died
+        between writing the signal and its finally-removal must not make
+        every later payload incarnation checkpoint and exit 0 at its
+        first step boundary (which would finalize a half-trained job as
+        SUCCEEDED)."""
+        try:
+            os.remove(self.resize_signal_path())
+        except OSError:
+            pass
+
+    def launch(self) -> int:
+        self.clear_resize_signal()
+        return super().launch()
+
+    def _resized_task(self, slices: int) -> Tuple[Task, 'object']:
+        """A derived exec task at the given topology. The elastic block
+        is dropped (it describes the FULL job, and would fail validation
+        against the shrunken resources); SKYT_ELASTIC_* envs carry the
+        degraded world size to the payload instead."""
+        config = self.task.to_yaml_config()
+        config.pop('elastic', None)
+        task = Task.from_yaml_config(config)
+        resources = task.resources[0]
+        if resources.is_tpu:
+            resources = resources.copy(num_slices=slices)
+            task.set_resources(resources)
+        task.update_envs(self.elastic_envs(slices))
+        return task, resources
+
+    # -- recover: shrink if possible, else relaunch ----------------------
+
+    def recover(self) -> int:
+        from_slices = self.current_slices()
+        self.last_mode = 'relaunch'
+        self.last_from_slices = from_slices
+        self.last_to_slices = self.full_slices
+        census = self._slice_census()
+        if census is not None:
+            surviving, slices, record = census
+            if (surviving and len(surviving) < from_slices and
+                    len(surviving) >= self.min_slices):
+                try:
+                    return self._shrink(surviving, slices, record)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.warning(
+                        'Job %s: elastic shrink to %d slices failed '
+                        '(%s: %s); falling back to full relaunch.',
+                        self.job_id, len(surviving), type(e).__name__, e)
+            elif surviving and len(surviving) < self.min_slices:
+                logger.info(
+                    'Job %s: only %d/%d slices survive (< min_slices '
+                    '%d); full relaunch.', self.job_id, len(surviving),
+                    from_slices, self.min_slices)
+        job_id = super().recover()
+        # A full relaunch restores the full gang.
+        self.last_mode = 'relaunch'
+        self.last_to_slices = self.full_slices
+        _record_slices(self.job_id, self.full_slices)
+        return job_id
+
+    def _shrink(self, surviving: List[int],
+                slices: Dict[int, List[HostInfo]], record) -> int:
+        provider = get_provider(record.cloud)
+        old_info = ClusterInfo.from_dict(record.handle)
+        # Stop the survivors' ranks first: they are blocked on dead DCN
+        # peers and must not keep running when the world re-forms.
+        if self.prev_cluster_job_id is not None:
+            try:
+                self.backend.cancel(old_info, self.prev_cluster_job_id)
+            except Exception:  # pylint: disable=broad-except
+                pass
+        keep = [h.instance_id for sid in surviving for h in slices[sid]]
+        # Teardown ONLY the dead slice (raises NotImplementedError on
+        # providers without the capability -> caller relaunches fully).
+        provider.trim_instances(self.cluster_name, keep)
+        new_info = provider.get_cluster_info(self.cluster_name)
+        if new_info is None:
+            raise exceptions.ClusterNotUpError(
+                f'{self.cluster_name} vanished during elastic trim')
+        to_slices = len(surviving)
+        task, resources = self._resized_task(to_slices)
+        state.add_or_update_cluster(
+            self.cluster_name,
+            status=state.ClusterStatus.UP,
+            resources=resources.to_yaml_config(),
+            handle=new_info.to_dict())
+        state.add_cluster_event(
+            self.cluster_name, 'ELASTIC_SHRINK',
+            f'{self.last_from_slices}->{to_slices} slices')
+        cluster_job_id = self.backend.execute(new_info, task, detach=True)
+        _record_slices(self.job_id, to_slices)
+        self.last_mode = 'shrink'
+        self.last_to_slices = to_slices
+        logger.info(
+            'Job %s: shrank gang %d -> %d slices; resumed as cluster '
+            'job %s from the latest checkpoint.', self.job_id,
+            self.last_from_slices, to_slices, cluster_job_id)
+        return cluster_job_id
+
+    # -- grow-back watcher (driven by the controller loop) ---------------
+
+    def try_grow(self) -> Optional[int]:
+        """Re-expand a shrunken gang to ``full_slices`` if capacity is
+        back; returns the new cluster job id, or None (quietly) while
+        capacity is still short. The running shrunken job is drained at
+        a step boundary via the resize-signal handshake first."""
+        from_slices = self.current_slices()
+        if from_slices >= self.full_slices:
+            return None
+        record = state.get_cluster(self.cluster_name)
+        if record is None or record.cloud is None or not record.handle:
+            return None
+        full_task, full_resources = self._resized_task(self.full_slices)
+        # DCN-aware placement gate: the joint optimizer must still rank
+        # the gang's current (cloud, region) feasible at FULL size —
+        # slices of one gang ride DCN within a locality; growing onto a
+        # different region would be a different job.
+        try:
+            from skypilot_tpu.optimizer import Optimizer
+            candidates = Optimizer.plan_task(full_task)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        if not any(c.resources.cloud == record.cloud and
+                   c.resources.region == record.region
+                   for c in candidates):
+            return None
+        launchable = full_resources.copy(
+            cloud=record.cloud, region=record.region, zone=record.zone)
+        request = ProvisionRequest(
+            cluster_name=self.cluster_name,
+            resources=launchable,
+            num_nodes=self.task.num_nodes,
+            region=record.region,
+            zone=record.zone)
+        provider = get_provider(record.cloud)
+        try:
+            new_info = provider.grow_instances(request)
+        except NotImplementedError:
+            return None
+        except (exceptions.CapacityError,
+                exceptions.QuotaExceededError) as e:
+            logger.debug('Job %s: grow-back still blocked: %s',
+                         self.job_id, e)
+            return None
+        # Capacity secured BEFORE pausing the shrunken gang: drain at a
+        # step boundary, then restart at the full topology (full_task
+        # already carries the full-size SKYT_ELASTIC_* envs from
+        # _resized_task).
+        self._drain_at_step_boundary(ClusterInfo.from_dict(record.handle))
+        state.add_or_update_cluster(
+            self.cluster_name,
+            status=state.ClusterStatus.UP,
+            resources=launchable.to_yaml_config(),
+            handle=new_info.to_dict())
+        state.add_cluster_event(
+            self.cluster_name, 'ELASTIC_GROW',
+            f'{from_slices}->{self.full_slices} slices')
+        try:
+            from skypilot_tpu.backend import runtime_setup
+            runtime_setup.ensure_runtime(new_info)
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('Job %s: runtime re-ensure after grow failed; '
+                           'relying on the existing daemon.', self.job_id)
+        cluster_job_id = self.backend.execute(new_info, full_task,
+                                              detach=True)
+        _record_slices(self.job_id, self.full_slices)
+        self.last_mode = 'grow'
+        self.last_from_slices = from_slices
+        self.last_to_slices = self.full_slices
+        logger.info(
+            'Job %s: grew gang back %d -> %d slices as cluster job %s.',
+            self.job_id, from_slices, self.full_slices, cluster_job_id)
+        return cluster_job_id
+
+    def _drain_at_step_boundary(self, info: ClusterInfo) -> None:
+        """Signal the payload to checkpoint + exit at its next step
+        boundary; cancel after ``drain_seconds`` if it doesn't."""
+        signal_path = self.resize_signal_path()
+        drained = False
+        try:
+            os.makedirs(os.path.dirname(signal_path), exist_ok=True)
+            with open(signal_path, 'w', encoding='utf-8') as f:
+                f.write('grow\n')
+            deadline = time.monotonic() + self.drain_seconds
+            while time.monotonic() < deadline:
+                if self.prev_cluster_job_id is None:
+                    break
+                try:
+                    jobs = {j['job_id']: j['status']
+                            for j in self.backend.queue(info)}
+                except Exception:  # pylint: disable=broad-except
+                    break
+                if jobs.get(self.prev_cluster_job_id) in (
+                        'SUCCEEDED', 'FAILED', 'CANCELLED', None):
+                    drained = True
+                    break
+                time.sleep(0.1)
+        finally:
+            try:
+                os.remove(signal_path)
+            except OSError:
+                pass
+        if not drained and self.prev_cluster_job_id is not None:
+            try:
+                self.backend.cancel(info, self.prev_cluster_job_id)
+            except Exception:  # pylint: disable=broad-except
+                pass
 
 
 def _other_regions(task: Task, cloud: str, keep_region: str) -> list:

@@ -111,6 +111,15 @@ def _db():
                                             -- at provision for sibling
                                             -- discovery
             );
+            CREATE TABLE IF NOT EXISTS recovery_events (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                job_id INTEGER NOT NULL,
+                ts REAL NOT NULL,
+                mode TEXT NOT NULL,         -- launch|relaunch|shrink|grow
+                from_slices INTEGER,
+                to_slices INTEGER,
+                seconds REAL                -- detection -> RUNNING again
+            );
         """)
         cols = {r['name'] for r in
                 conn.execute('PRAGMA table_info(jobs)')}
@@ -139,6 +148,15 @@ def _db():
             # controller (NULL = a local process on the server).
             _add_column('ALTER TABLE jobs ADD COLUMN '
                         'controller_cluster TEXT')
+        if 'elastic' not in cols:
+            # JSON elastic spec ({min_slices, max_slices, ...}); NULL =
+            # rigid world size (always full relaunch on preemption).
+            _add_column('ALTER TABLE jobs ADD COLUMN elastic TEXT')
+        if 'current_slices' not in cols:
+            # Current gang topology (slices actually running); the
+            # world-size HISTORY is the recovery_events table.
+            _add_column('ALTER TABLE jobs ADD COLUMN '
+                        'current_slices INTEGER')
         conn.commit()
 
     os.makedirs(jobs_dir(), exist_ok=True)
@@ -173,6 +191,9 @@ class JobRecord:
         self.controller_claimed_at: Optional[float] = (
             row['controller_claimed_at'])
         self.controller_cluster: Optional[str] = row['controller_cluster']
+        self.elastic: Optional[Dict[str, Any]] = (
+            json.loads(row['elastic']) if row['elastic'] else None)
+        self.current_slices: Optional[int] = row['current_slices']
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -188,6 +209,8 @@ class JobRecord:
             'started_at': self.started_at,
             'ended_at': self.ended_at,
             'group_name': self.group_name,
+            'elastic': self.elastic,
+            'current_slices': self.current_slices,
         }
 
 
@@ -195,7 +218,8 @@ def submit(task_config: Dict[str, Any],
            name: Optional[str],
            strategy: str,
            max_restarts_on_errors: int,
-           group_name: Optional[str] = None) -> int:
+           group_name: Optional[str] = None,
+           elastic: Optional[Dict[str, Any]] = None) -> int:
     # The submitter's workspace is PERSISTED: controllers (and their HA
     # replacements, spawned later by arbitrary processes) must run in
     # the job's workspace, not the spawner's.
@@ -203,11 +227,12 @@ def submit(task_config: Dict[str, Any],
     conn = _db()
     sql = ('INSERT INTO jobs (name, task_config, status, schedule_state, '
            'strategy, max_restarts_on_errors, submitted_at, group_name, '
-           'workspace) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)')
+           'workspace, elastic) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)')
     params = (name, json.dumps(task_config),
               ManagedJobStatus.PENDING.value, ScheduleState.WAITING.value,
               strategy, max_restarts_on_errors, time.time(), group_name,
-              workspaces.active_workspace())
+              workspaces.active_workspace(),
+              json.dumps(elastic) if elastic else None)
     if getattr(conn, 'is_postgres', False):
         job_id = conn.insert_returning(sql, params, 'job_id')
     else:
@@ -455,3 +480,65 @@ def bump_recovery(job_id: int) -> None:
         'UPDATE jobs SET recovery_count = recovery_count + 1, '
         'last_recovered_at = ? WHERE job_id = ?', (time.time(), job_id))
     conn.commit()
+
+
+# -- elastic topology bookkeeping ---------------------------------------
+
+
+def set_current_slices(job_id: int, slices: int) -> None:
+    """Record the gang's live topology (shrunken or full)."""
+    conn = _db()
+    conn.execute('UPDATE jobs SET current_slices = ? WHERE job_id = ?',
+                 (slices, job_id))
+    conn.commit()
+    events.publish(events.MANAGED_JOBS, conn=conn)
+
+
+def record_recovery(job_id: int,
+                    mode: str,
+                    from_slices: Optional[int],
+                    to_slices: Optional[int],
+                    seconds: Optional[float] = None) -> None:
+    """Append one world-size transition to the job's topology history.
+
+    ``mode``: launch (initial topology), relaunch (rigid full recovery),
+    shrink (elastic degrade to surviving slices), grow (elastic
+    re-expansion). ``seconds`` is detection→RUNNING-again; /api/metrics
+    derives skyt_job_recoveries_total and skyt_job_resize_seconds from
+    these rows (controllers run out-of-process, so the DB is the only
+    durable metrics source)."""
+    conn = _db()
+    conn.execute(
+        'INSERT INTO recovery_events (job_id, ts, mode, from_slices, '
+        'to_slices, seconds) VALUES (?, ?, ?, ?, ?, ?)',
+        (job_id, time.time(), mode, from_slices, to_slices, seconds))
+    conn.commit()
+    events.publish(events.MANAGED_JOBS, conn=conn)
+
+
+def recovery_events(job_id: Optional[int] = None,
+                    after_id: int = 0) -> List[Dict[str, Any]]:
+    """World-size history, oldest first (one job or all jobs).
+
+    ``after_id`` returns only rows past that event id — the append-only
+    table grows for the deployment's lifetime, so incremental consumers
+    (/api/metrics) page from their cursor instead of re-reading it all.
+    """
+    conn = _db()
+    if job_id is None:
+        rows = conn.execute(
+            'SELECT * FROM recovery_events WHERE id > ? ORDER BY id',
+            (after_id,)).fetchall()
+    else:
+        rows = conn.execute(
+            'SELECT * FROM recovery_events WHERE job_id = ? AND id > ? '
+            'ORDER BY id', (job_id, after_id)).fetchall()
+    return [{
+        'id': r['id'],
+        'job_id': r['job_id'],
+        'ts': r['ts'],
+        'mode': r['mode'],
+        'from_slices': r['from_slices'],
+        'to_slices': r['to_slices'],
+        'seconds': r['seconds'],
+    } for r in rows]

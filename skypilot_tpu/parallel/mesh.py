@@ -49,8 +49,74 @@ class MeshConfig:
     # splits the `data` axis into a DCN-level product; 1 = single slice.
     num_slices: int = 1
 
-    def resolve(self, num_devices: int) -> 'MeshConfig':
-        """Fill in -1 axes so the product equals num_devices."""
+    def with_num_slices(self, num_slices: int) -> 'MeshConfig':
+        """Re-solve the DCN axes for a changed slice count.
+
+        Elastic shrink/grow (jobs/recovery_strategy.py ElasticStrategy):
+        the surviving slice set no longer matches the configured DCN
+        product, so the slice-crossing component of each DCN axis is
+        re-derived for ``num_slices`` while the within-slice (ICI)
+        components stay fixed — a 2-slice ``data=2, fsdp=-1`` recipe
+        shrinks to ``data=1`` over one slice's devices and grows back.
+        Pipeline stages across DCN cannot resize elastically (stage
+        count is baked into the layer split), so a ``stage`` axis with
+        a DCN component raises.
+        """
+        if num_slices < 1:
+            raise ValueError(f'num_slices must be >= 1, got {num_slices}')
+        if num_slices == self.num_slices:
+            return self
+        sizes = {name: getattr(self, name) for name in MESH_AXIS_NAMES}
+        # Decompose each DCN axis into (slice-crossing, within-slice)
+        # components exactly as build_mesh lays the hybrid mesh out.
+        remaining = self.num_slices
+        dcn = {}
+        ici = {}
+        for name in MESH_AXIS_NAMES:
+            size = sizes[name]
+            if name in DCN_AXIS_NAMES and remaining > 1 and size == -1:
+                # 'All remaining devices' absorbs the whole
+                # slice-crossing product; the axis stays -1 and
+                # re-resolves against the surviving devices, scaling
+                # with the slice count exactly as a rigid build does.
+                dcn[name] = remaining
+                ici[name] = -1
+                remaining = 1
+            elif name in DCN_AXIS_NAMES and remaining > 1:
+                take = math.gcd(size, remaining)
+                dcn[name] = take
+                ici[name] = size // take
+                remaining //= take
+            else:
+                dcn[name] = 1
+                ici[name] = size
+        if remaining != 1:
+            raise ValueError(
+                f'num_slices={self.num_slices} does not divide into DCN '
+                f'axes {DCN_AXIS_NAMES} of mesh {sizes}')
+        if dcn['stage'] > 1:
+            raise ValueError(
+                'Pipeline stages span slice boundaries '
+                f'(stage={sizes["stage"]} with {self.num_slices} '
+                'slices); the stage split cannot resize elastically — '
+                'use a data-parallel DCN layout for elastic jobs.')
+        new_sizes = dict(sizes)
+        if ici['data'] != -1:
+            new_sizes['data'] = ici['data'] * num_slices
+        return dataclasses.replace(self, num_slices=num_slices,
+                                   **new_sizes)
+
+    def resolve(self, num_devices: int, *,
+                num_slices: Optional[int] = None) -> 'MeshConfig':
+        """Fill in -1 axes so the product equals num_devices.
+
+        ``num_slices`` (elastic degraded resolve): first re-solve the
+        DCN axes for that slice count via :meth:`with_num_slices` —
+        the payload passes the SKYT_ELASTIC_SLICES world size here so
+        a recipe written for the full gang runs on the survivors.
+        """
+        if num_slices is not None and num_slices != self.num_slices:
+            return self.with_num_slices(num_slices).resolve(num_devices)
         sizes = {
             name: getattr(self, name) for name in MESH_AXIS_NAMES
         }
@@ -148,10 +214,18 @@ def _block_hybrid_mesh(devices: Sequence[jax.Device],
 
 
 def use_mesh(mesh: Mesh):
-    """Ambient-mesh context manager, across jax renames (use_mesh/set_mesh)."""
+    """Ambient-mesh context manager, across jax renames.
+
+    Newer jax spells it ``jax.sharding.use_mesh`` (briefly
+    ``set_mesh``); on versions predating both, ``Mesh`` itself is the
+    context manager (the legacy global-mesh context), which is all the
+    jit-with-NamedSharding call sites here need.
+    """
     if hasattr(jax.sharding, 'use_mesh'):
         return jax.sharding.use_mesh(mesh)
-    return jax.sharding.set_mesh(mesh)
+    if hasattr(jax.sharding, 'set_mesh'):
+        return jax.sharding.set_mesh(mesh)
+    return mesh
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:

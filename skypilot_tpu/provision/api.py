@@ -164,6 +164,28 @@ class Provider(abc.ABC):
     def open_ports(self, cluster_name: str, ports: List[str]) -> None:
         del cluster_name, ports  # default: no-op
 
+    # -- elastic gang resize (optional capability) ---------------------
+    #
+    # Providers that can tear down / re-add individual pod slices of a
+    # multi-slice cluster implement these; the default NotImplementedError
+    # makes ElasticStrategy fall back to a full relaunch (the rigid
+    # legacy path) on clouds without the capability.
+
+    def trim_instances(self, cluster_name: str,
+                       keep_instance_ids: List[str]) -> None:
+        """Terminate every host NOT in ``keep_instance_ids`` (the dead
+        slice) and renumber the survivors' worker indices contiguously,
+        keeping the cluster itself alive."""
+        raise NotImplementedError(
+            f'{self.name} cannot trim individual slices')
+
+    def grow_instances(self, request: 'ProvisionRequest') -> ClusterInfo:
+        """Add hosts to an existing (shrunken) cluster until it matches
+        ``request.resources`` again. Raises CapacityError when the cloud
+        still has no capacity (the grow-back watcher retries later)."""
+        raise NotImplementedError(
+            f'{self.name} cannot grow an existing gang')
+
 
 def get_provider(cloud: str) -> Provider:
     provider_cls = CLOUD_REGISTRY.get(cloud)

@@ -79,7 +79,30 @@ def inject_slow_create(seconds: float) -> None:
 
 def clear_faults() -> None:
     with _Store() as data:
+        had_faults = bool(data['faults'])
+        names = list(data['clusters']) if had_faults else []
         data['faults'] = {}
+    # Capacity returning IS a health change: shrunken elastic gangs
+    # waiting on the CLUSTERS topic should retry their grow-back now,
+    # not at the next poll tick. Signalled per live cluster (the gangs
+    # that could grow), and only when faults were actually cleared —
+    # hygiene calls from test setup must not pollute the durable
+    # cluster_events table or broadcast-wake every controller.
+    for name in names:
+        _signal_cluster_change(name, 'CAPACITY_CHANGED', '')
+
+
+def _signal_cluster_change(cluster_name: str, event: str,
+                           detail: str) -> None:
+    """Ripple a fake-cloud mutation into the shared cluster-state DB so
+    out-of-process watchers (job controllers) wake on the CLUSTERS
+    topic's external signal instead of their poll fallback. Best-effort:
+    the fake store stays authoritative either way."""
+    try:
+        from skypilot_tpu import state
+        state.add_cluster_event(cluster_name, event, detail)
+    except Exception:  # pylint: disable=broad-except
+        pass
 
 
 def preempt_cluster(cluster_name: str) -> None:
@@ -90,6 +113,26 @@ def preempt_cluster(cluster_name: str) -> None:
             for host in cluster['hosts']:
                 host['state'] = 'preempted'
             cluster['state'] = 'preempted'
+    _signal_cluster_change(cluster_name, 'PREEMPTED', 'all slices')
+
+
+def preempt_slice(cluster_name: str, slice_index: int,
+                  hosts_per_slice: int = 1) -> List[str]:
+    """Preempt ONE pod slice of a multi-slice cluster (TPU slices vanish
+    as a unit, but independent slices of a gang die independently).
+    Returns the instance ids taken. Hosts are slice-blocked by
+    worker_index, mirroring codegen._slice_of."""
+    taken: List[str] = []
+    with _Store() as data:
+        cluster = data['clusters'].get(cluster_name)
+        if cluster:
+            for host in cluster['hosts']:
+                if host['worker_index'] // hosts_per_slice == slice_index:
+                    host['state'] = 'preempted'
+                    taken.append(host['instance_id'])
+    _signal_cluster_change(cluster_name, 'PREEMPTED',
+                           f'slice {slice_index}')
+    return taken
 
 
 def reset() -> None:
@@ -252,6 +295,86 @@ class FakeProvider(Provider):
         with open(tmp, 'w', encoding='utf-8') as f:
             json.dump(existing, f)
         os.replace(tmp, map_path)
+
+    # -- elastic gang resize -------------------------------------------
+
+    def trim_instances(self, cluster_name: str,
+                       keep_instance_ids: List[str]) -> None:
+        """Drop the dead slice's hosts; survivors get contiguous worker
+        indices (slice ids re-derive as worker_index // hosts_per_slice,
+        so a surviving slice 1 becomes slice 0 of the shrunken gang)."""
+        keep = set(keep_instance_ids)
+        with _Store() as data:
+            cluster = data['clusters'].get(cluster_name)
+            if cluster is None:
+                raise exceptions.ClusterDoesNotExist(cluster_name)
+            survivors = [h for h in cluster['hosts']
+                         if h['instance_id'] in keep]
+            if not survivors:
+                raise exceptions.ProvisionError(
+                    f'trim of {cluster_name} would leave zero hosts')
+            for idx, host in enumerate(survivors):
+                host['worker_index'] = idx
+                host['index'] = idx
+                host['state'] = 'running'
+            cluster['hosts'] = survivors
+            cluster['state'] = 'running'
+        _signal_cluster_change(cluster_name, 'SHRUNK',
+                               f'{len(survivors)} hosts kept')
+
+    def grow_instances(self, request: ProvisionRequest) -> ClusterInfo:
+        """Append hosts until the cluster matches the request again;
+        capacity faults apply exactly as on a fresh create (a grow-back
+        races real provisioning demand)."""
+        res = request.resources
+        with _Store() as data:
+            cluster = data['clusters'].get(request.cluster_name)
+            if cluster is None:
+                raise exceptions.ClusterDoesNotExist(request.cluster_name)
+            zone = cluster.get('zone') or f"{cluster['region']}-a"
+            quota_hit = _consume_fault(data, 'quota', cluster['region'])
+            stockout_hit = (not quota_hit and
+                            _consume_fault(data, 'stockout', zone))
+        if quota_hit:
+            raise exceptions.QuotaExceededError(
+                f'Quota exceeded for {res.accelerators} in region '
+                f'{request.region} (fake)')
+        if stockout_hit:
+            raise exceptions.CapacityError(
+                f'The zone {zone} does not have enough resources '
+                f'available to grow the gang (fake stockout)')
+        if res.is_tpu:
+            target = res.tpu.hosts_per_slice * res.tpu.num_slices
+        else:
+            target = request.num_nodes
+        with _Store() as data:
+            cluster = data['clusters'][request.cluster_name]
+            hosts = cluster['hosts']
+            node = hosts[0]['node_index'] if hosts else 0
+            used_ips = {h['internal_ip'] for h in hosts}
+            octet = 2
+            while len(hosts) < target:
+                worker = len(hosts)
+                # Survivors kept their original IPs through the trim's
+                # renumbering, so fresh hosts probe for a free octet.
+                while f'10.0.{node}.{octet}' in used_ips:
+                    octet += 1
+                used_ips.add(f'10.0.{node}.{octet}')
+                hosts.append({
+                    'instance_id': f'fake-{uuid.uuid4().hex[:8]}',
+                    'internal_ip': f'10.0.{node}.{octet}',
+                    'external_ip': f'34.0.{node}.{octet}',
+                    'node_index': node,
+                    'worker_index': worker,
+                    'state': 'running',
+                    'index': worker,
+                })
+            cluster['resources'] = res.to_yaml_config()
+            cluster['state'] = 'running'
+            info = self._to_cluster_info(request.cluster_name, cluster)
+        _signal_cluster_change(request.cluster_name, 'GROWN',
+                               f'{target} hosts')
+        return info
 
     def stop_instances(self, cluster_name: str) -> None:
         with _Store() as data:

@@ -194,9 +194,31 @@ TRANSFER_SECONDS = Histogram(
 
 _TRANSFER_METRICS = [TRANSFER_BYTES, TRANSFER_OBJECTS, TRANSFER_SECONDS]
 
-_ALL = [REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
-        RUNTIME_EVENTS, EVENT_WAKEUPS,
-        NOTIFICATIONS] + _LB_METRICS + _TRANSFER_METRICS
+# -- managed-job recovery / elastic resize (derived from the durable
+# jobs-DB recovery_events table on scrape: controllers run as detached
+# processes, so in-process counters would be lost) ---------------------
+
+_RESIZE_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600, 1800,
+                   float('inf'))
+
+JOB_RECOVERIES = Counter(
+    'skyt_job_recoveries_total',
+    'Managed-job world-size transitions by mode (launch = initial '
+    'topology, relaunch = rigid full recovery, shrink = elastic '
+    'degrade to surviving slices, grow = elastic re-expansion)')
+JOB_RESIZE_SECONDS = Histogram(
+    'skyt_job_resize_seconds',
+    'Managed-job recovery latency by mode: preemption detection (or '
+    'grow trigger) to the payload running again at the new topology',
+    buckets=_RESIZE_BUCKETS)
+
+_JOB_METRICS = [JOB_RECOVERIES, JOB_RESIZE_SECONDS]
+# Highest recovery_events row id already folded into _JOB_METRICS.
+_recovery_cursor = 0
+
+_ALL = ([REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
+         RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS]
+        + _LB_METRICS + _TRANSFER_METRICS + _JOB_METRICS)
 
 
 def collect_from_db() -> None:
@@ -240,6 +262,17 @@ def collect_from_db() -> None:
                                               cloud=record.cloud or '?')
                 except (TypeError, ValueError):
                     pass
+    # recovery_events is append-only and never pruned: page from a
+    # cursor so scrape cost stays proportional to NEW recoveries, not
+    # the deployment's lifetime history.
+    global _recovery_cursor
+    from skypilot_tpu.jobs import state as jobs_state
+    for event in jobs_state.recovery_events(after_id=_recovery_cursor):
+        JOB_RECOVERIES.inc(mode=event['mode'])
+        if event['seconds'] is not None:
+            JOB_RESIZE_SECONDS.observe(float(event['seconds']),
+                                       mode=event['mode'])
+        _recovery_cursor = event['id']
 
 
 def render_text() -> str:
@@ -262,7 +295,9 @@ def render_lb_text() -> str:
 
 
 def reset_for_tests() -> None:
+    global _recovery_cursor
     with _lock:
+        _recovery_cursor = 0
         for metric in _ALL:
             for attr in ('_values', '_counts', '_sums', '_totals',
                          '_samples'):

@@ -281,6 +281,15 @@ class Resources:
         config = self.to_yaml_config()
         # autostop round-trips via yaml config
         config.update(override)
+        if 'num_slices' in override:
+            # The constructor prefers accelerator_args['num_slices'] over
+            # the top-level field; an explicit override must win over the
+            # round-tripped accelerator_args copy.
+            args = config.get('accelerator_args')
+            if args and 'num_slices' in args:
+                args = dict(args)
+                args['num_slices'] = override['num_slices']
+                config['accelerator_args'] = args
         return Resources.from_yaml_config(config)
 
     def assert_launchable(self) -> None:

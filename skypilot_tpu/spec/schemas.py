@@ -191,6 +191,24 @@ TASK_SCHEMA: Dict[str, Any] = {
         # Explicit DAG edges (fan-out graphs): names of tasks in the
         # same multi-document YAML this one waits on.
         'depends_on': {'type': 'array', 'items': {'type': 'string'}},
+        # Elastic world-size recovery: shrink the gang to the surviving
+        # slices on preemption (>= min_slices) instead of relaunching,
+        # grow back to max_slices when capacity returns.
+        'elastic': {
+            'type': ['object', 'null'],
+            'additionalProperties': False,
+            'properties': {
+                'min_slices': {'type': 'integer', 'minimum': 1},
+                'max_slices': {'type': 'integer', 'minimum': 1},
+                # How often a shrunken job re-checks for capacity.
+                'grow_check_seconds': {'type': 'number',
+                                       'exclusiveMinimum': 0},
+                # Grace for the step-boundary checkpoint before a
+                # voluntary resize restarts the gang (SKYT_RESIZE_SIGNAL
+                # contract, docs/elastic_training.md).
+                'drain_seconds': {'type': 'number', 'minimum': 0},
+            },
+        },
         # Internal round-trip marker (admin policy already applied);
         # present when a task exported by to_yaml is re-imported.
         '_policy_applied': {'type': 'boolean'},

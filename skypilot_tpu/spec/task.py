@@ -47,6 +47,7 @@ class Task:
         inputs_region: Optional[str] = None,
         estimated_outputs_gb: Optional[float] = None,
         depends_on: Optional[List[str]] = None,
+        elastic: Optional[Dict[str, Any]] = None,
     ) -> None:
         if name is not None and not _VALID_NAME_RE.fullmatch(name):
             raise exceptions.InvalidSpecError(f'Invalid task name {name!r}')
@@ -87,6 +88,13 @@ class Task:
         # Explicit DAG edges: names of tasks this one waits on. Absent
         # everywhere -> the DAG is an implicit chain (document order).
         self.depends_on: List[str] = [str(d) for d in (depends_on or [])]
+        # Elastic gang training: on slice preemption the managed-job
+        # controller shrinks the gang to the surviving slices (down to
+        # min_slices) instead of relaunching, then grows back to
+        # max_slices when capacity returns (jobs/recovery_strategy.py
+        # ElasticStrategy). None = rigid world size (legacy behavior).
+        self.elastic: Optional[Dict[str, Any]] = (
+            dict(elastic) if elastic else None)
         # Per-task config layer (the `config:` YAML section), threaded
         # into config.get_nested(... override_configs=...) by consumers.
         self.config_overrides: Dict[str, Any] = {}
@@ -119,6 +127,41 @@ class Task:
                     raise exceptions.InvalidSpecError(
                         'Use either num_nodes>1 (one slice per node) or '
                         'resources.num_slices>1, not both.')
+        if self.elastic is not None:
+            self._validate_elastic()
+
+    def _validate_elastic(self) -> None:
+        assert self.elastic is not None
+        known = {'min_slices', 'max_slices', 'grow_check_seconds',
+                 'drain_seconds'}
+        unknown = set(self.elastic) - known
+        if unknown:
+            raise exceptions.InvalidSpecError(
+                f'Unknown elastic fields: {sorted(unknown)} '
+                f'(known: {sorted(known)})')
+        full = max((r.num_slices for r in self.resources if r.is_tpu),
+                   default=1)
+        min_slices = int(self.elastic.get('min_slices', 1))
+        max_slices = int(self.elastic.get('max_slices', full))
+        if min_slices < 1:
+            raise exceptions.InvalidSpecError(
+                f'elastic.min_slices must be >= 1, got {min_slices}')
+        if max_slices < min_slices:
+            raise exceptions.InvalidSpecError(
+                f'elastic.max_slices ({max_slices}) must be >= '
+                f'min_slices ({min_slices})')
+        if max_slices != full:
+            # The initial launch always provisions resources.num_slices
+            # slices, so a smaller max_slices would desynchronize the
+            # payload's world size from the real cluster from step one
+            # (and a larger one can't be grown into).
+            raise exceptions.InvalidSpecError(
+                f'elastic.max_slices ({max_slices}) must equal the '
+                f'requested resources.num_slices ({full}); the gang '
+                'launches — and grows back to — exactly what was '
+                'gang-scheduled.')
+        self.elastic['min_slices'] = min_slices
+        self.elastic['max_slices'] = max_slices
 
     # ---------- YAML ----------
 
@@ -130,7 +173,7 @@ class Task:
             'secrets', 'file_mounts', 'storage_mounts', 'volumes',
             'resources', 'service', 'config', '_policy_applied',
             'estimated_flops', 'estimated_inputs_gb', 'inputs_region',
-            'estimated_outputs_gb', 'depends_on',
+            'estimated_outputs_gb', 'depends_on', 'elastic',
         }
         unknown = set(config) - known
         if unknown:
@@ -166,6 +209,7 @@ class Task:
             inputs_region=config.get('inputs_region'),
             estimated_outputs_gb=config.get('estimated_outputs_gb'),
             depends_on=config.get('depends_on'),
+            elastic=config.get('elastic'),
         )
         task.config_overrides = dict(config.get('config') or {})
         task.policy_applied = bool(config.get('_policy_applied', False))
@@ -265,6 +309,8 @@ class Task:
             config['inputs_region'] = self.inputs_region
         if self.depends_on:
             config['depends_on'] = list(self.depends_on)
+        if self.elastic:
+            config['elastic'] = dict(self.elastic)
         if self.policy_applied:
             config['_policy_applied'] = True
         return config

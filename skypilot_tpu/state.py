@@ -20,6 +20,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import events
 
 
 class ClusterStatus(enum.Enum):
@@ -110,6 +111,15 @@ def _db():
         _local, _pg_schema_ready, url=db_url(),
         sqlite_path=os.path.join(_state_dir(), 'state.db'),
         init_schema=init_schema)
+
+
+def change_signal() -> 'events.ExternalSignal | None':
+    """Cross-process change signal for the cluster state DB: managed-job
+    controllers wake on preemption/health/teardown writes made by other
+    processes (the fake provider's chaos hooks, request children, peer
+    controllers) within milliseconds instead of their poll interval."""
+    return events.external_signal(
+        db_url(), os.path.join(_state_dir(), 'state.db'), events.CLUSTERS)
 
 
 class ClusterRecord:
@@ -206,6 +216,7 @@ def add_or_update_cluster(name: str,
         db.execute(f'UPDATE clusters SET {sets} WHERE name=?',
                    (*updates.values(), name))
     db.commit()
+    events.publish(events.CLUSTERS, conn=db)
 
 
 def get_cluster(name: str) -> Optional[ClusterRecord]:
@@ -230,6 +241,7 @@ def remove_cluster(name: str) -> None:
     db = _db()
     db.execute('DELETE FROM clusters WHERE name=?', (name,))
     db.commit()
+    events.publish(events.CLUSTERS, conn=db)
 
 
 def set_cluster_status(name: str, status: ClusterStatus) -> None:
@@ -237,6 +249,7 @@ def set_cluster_status(name: str, status: ClusterStatus) -> None:
     db.execute('UPDATE clusters SET status=? WHERE name=?',
                (status.value, name))
     db.commit()
+    events.publish(events.CLUSTERS, conn=db)
 
 
 def touch_cluster(name: str) -> None:
@@ -253,6 +266,9 @@ def add_cluster_event(name: str, event: str, detail: str = '') -> None:
         'INSERT INTO cluster_events (cluster_name, ts, event, detail) '
         'VALUES (?,?,?,?)', (name, time.time(), event, detail))
     db.commit()
+    # PREEMPTED/CAPACITY events are how providers signal health changes;
+    # controllers waiting on the CLUSTERS topic react in milliseconds.
+    events.publish(events.CLUSTERS, conn=db)
 
 
 def get_cluster_events(name: str) -> List[Dict[str, Any]]:

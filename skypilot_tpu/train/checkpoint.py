@@ -5,53 +5,114 @@ jobs writing checkpoints to a MOUNT_CACHED bucket and resuming after
 recovery (SURVEY.md §5, docs/source/examples/checkpointing.rst). Here the
 in-tree trainer implements that pattern natively: save to a local dir
 (which a storage mount maps to a bucket), restore-latest on startup.
+
+Topology-change restore (elastic training): ``restore``/``restore_latest``
+take the *target's* shardings as truth — orbax ``StandardRestore`` reads
+the checkpoint written at the old world size and re-shards params and
+optimizer state into the new mesh's layout, so a gang that shrank to the
+surviving slices resumes from the same step at the smaller topology
+(docs/elastic_training.md).
+
+Managers are cached per directory (orbax CheckpointManager construction
+is expensive and holds a thread pool); reads are non-mutating — a
+``latest_step`` probe on a job that never checkpointed must not create
+the directory.
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Optional
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from skypilot_tpu.utils import log
 
 logger = log.init_logger(__name__)
 
+_managers: Dict[str, Tuple[Any, int]] = {}
+_managers_lock = threading.Lock()
 
-def _manager(directory: str, max_to_keep: int = 3):
+
+def _manager(directory: str, max_to_keep: Optional[int] = None):
+    """The cached per-directory CheckpointManager.
+
+    Never creates ``directory`` (``create=False``): writers make it
+    first (see :func:`save`), readers must stay side-effect free.
+    Readers pass ``max_to_keep=None`` and reuse whatever manager exists
+    (retention is a writer concern); only a WRITER with a different
+    ``max_to_keep`` rebuilds the manager — otherwise alternating
+    save/read calls with non-default retention would close and
+    reconstruct it on every call, defeating the cache.
+    """
     import orbax.checkpoint as ocp
     directory = os.path.abspath(os.path.expanduser(directory))
-    os.makedirs(directory, exist_ok=True)
-    options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                           create=True)
-    return ocp.CheckpointManager(directory, options=options)
+    with _managers_lock:
+        cached = _managers.get(directory)
+        if cached is not None and (max_to_keep is None or
+                                   cached[1] == max_to_keep):
+            return cached[0]
+        if cached is not None:
+            cached[0].close()
+        keep = 3 if max_to_keep is None else max_to_keep
+        options = ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                               create=False)
+        mgr = ocp.CheckpointManager(directory, options=options)
+        _managers[directory] = (mgr, keep)
+        return mgr
+
+
+def close_managers() -> None:
+    """Close and drop every cached manager (tests / process teardown)."""
+    with _managers_lock:
+        for mgr, _ in _managers.values():
+            try:
+                mgr.close()
+            except Exception:  # pylint: disable=broad-except
+                pass
+        _managers.clear()
 
 
 def save(directory: str, step: int, tree: Any,
          max_to_keep: int = 3) -> None:
     import orbax.checkpoint as ocp
+    directory = os.path.abspath(os.path.expanduser(directory))
+    os.makedirs(directory, exist_ok=True)
     mgr = _manager(directory, max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(tree))
     mgr.wait_until_finished()
-    mgr.close()
     logger.info('Saved checkpoint step %d to %s', step, directory)
 
 
 def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpointed step, or None. Pure read: no directory is
+    created and no manager is torn down per call."""
     directory = os.path.abspath(os.path.expanduser(directory))
     if not os.path.isdir(directory):
         return None
     mgr = _manager(directory)
-    step = mgr.latest_step()
-    mgr.close()
-    return step
+    # The cached manager snapshots the step list at construction; a
+    # checkpoint written by ANOTHER process (the pre-preemption
+    # incarnation of this job) must still be visible.
+    reload_fn = getattr(mgr, 'reload', None)
+    if reload_fn is not None:
+        try:
+            reload_fn()
+        except Exception:  # pylint: disable=broad-except
+            pass
+    return mgr.latest_step()
 
 
 def restore(directory: str, step: int, target: Any) -> Any:
-    """Restore `step` into the structure/shardings of `target`."""
+    """Restore `step` into the structure/shardings of `target`.
+
+    `target` may be laid out on a DIFFERENT mesh than the writer used
+    (elastic shrink/grow): StandardRestore re-shards every leaf into
+    the target's shardings.
+    """
     import orbax.checkpoint as ocp
+    directory = os.path.abspath(os.path.expanduser(directory))
     mgr = _manager(directory)
     restored = mgr.restore(
         step, args=ocp.args.StandardRestore(target))
-    mgr.close()
     logger.info('Restored checkpoint step %d from %s', step, directory)
     return restored
 
@@ -62,7 +123,8 @@ def restore_latest(directory: str,
 
     The managed-job recovery contract: a relaunched task calls this and
     transparently resumes (tests force preemption and assert the step
-    counter survives).
+    counter survives) — including at a different world size, where the
+    init_fn's shardings describe the new topology.
     """
     step = latest_step(directory)
     target = init_fn()

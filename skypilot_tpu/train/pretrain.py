@@ -141,7 +141,8 @@ def main(argv=None) -> int:
     from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
     from skypilot_tpu.train import checkpoint as ckpt_lib
     from skypilot_tpu.train.step import (TrainHParams, create_train_state,
-                                         make_train_step, state_shardings)
+                                         make_train_step, resize_requested,
+                                         state_shardings)
 
     overrides = {}
     if args.param_dtype:
@@ -158,7 +159,16 @@ def main(argv=None) -> int:
                       warmup_steps=args.warmup_steps,
                       total_steps=max(args.steps, args.warmup_steps + 1),
                       optimizer=args.optimizer)
-    mesh = build_mesh(MeshConfig(**parse_mesh(args.mesh)))
+    mesh_config = MeshConfig(**parse_mesh(args.mesh))
+    elastic_slices = os.environ.get('SKYT_ELASTIC_SLICES')
+    if elastic_slices:
+        # Elastic world size (jobs/recovery_strategy.py): the recipe's
+        # mesh string describes the FULL gang; the controller exports
+        # the surviving slice count and the DCN axes re-solve for it —
+        # the same --mesh runs shrunken and grown-back alike.
+        mesh_config = mesh_config.resolve(
+            len(jax.devices()), num_slices=int(elastic_slices))
+    mesh = build_mesh(mesh_config)
     # The global batch shards over (data, fsdp) and seq over (seq): round
     # up so every shard is non-empty regardless of device count.
     batch_div = mesh.shape['data'] * mesh.shape['fsdp']
@@ -175,9 +185,17 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         latest = ckpt_lib.latest_step(args.checkpoint_dir)
         if latest is not None:
+            # Topology-change restore: `state` is laid out on the
+            # CURRENT mesh (possibly a shrunken/grown elastic world);
+            # StandardRestore re-shards params + optimizer state from
+            # whatever world size wrote the checkpoint.
             state = ckpt_lib.restore(args.checkpoint_dir, latest, state)
             start_step = int(state.step)
             print(json.dumps({'resumed_from_step': start_step}), flush=True)
+            print(json.dumps({
+                'mesh_devices': mesh.devices.size,
+                'num_slices': mesh_config.num_slices,
+            }), flush=True)
     step_fn = make_train_step(cfg, hp, mesh, shardings=shardings)
 
     if args.data == 'synthetic':
@@ -223,11 +241,22 @@ def main(argv=None) -> int:
                 }), flush=True)
             window_t0 = time.perf_counter()
             window_tokens = 0
-        if (args.checkpoint_dir and
-                ((step + 1) % args.checkpoint_every == 0 or
-                 step + 1 == args.steps)):
-            if is_main:
+        saved_this_step = (args.checkpoint_dir and
+                           ((step + 1) % args.checkpoint_every == 0 or
+                            step + 1 == args.steps))
+        if saved_this_step and is_main:
+            ckpt_lib.save(args.checkpoint_dir, step + 1, state)
+        if resize_requested():
+            # Step boundary = the only resize-safe point (params and
+            # optimizer state are consistent). Checkpoint here and exit
+            # 0: the elastic controller re-execs this driver at the new
+            # world size and the restore path above re-shards into it.
+            if args.checkpoint_dir and is_main and not saved_this_step:
                 ckpt_lib.save(args.checkpoint_dir, step + 1, state)
+            if is_main:
+                print(json.dumps({'resize_exit_at_step': step + 1}),
+                      flush=True)
+            return 0
     if is_main:
         print(json.dumps({'done': True, 'final_step': args.steps}),
               flush=True)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -24,6 +25,24 @@ from skypilot_tpu.parallel.sharding import (DEFAULT_RULES, LogicalAxisRules,
 from skypilot_tpu.train.loss import cross_entropy_loss
 
 Params = Dict[str, Any]
+
+# Elastic resize handshake (jobs/recovery_strategy.py ElasticStrategy):
+# the controller touches the file named by this env var when it wants
+# the gang restarted at a different world size; the training loop
+# checks at each step boundary — the only point where params/opt-state
+# are consistent — checkpoints, and exits 0 so the controller can
+# re-exec at the new topology (docs/elastic_training.md).
+RESIZE_SIGNAL_ENV = 'SKYT_RESIZE_SIGNAL'
+
+
+def resize_requested() -> bool:
+    """True when the controller asked for a step-boundary resize.
+
+    Cheap enough for the hot loop: one env lookup, and one stat only
+    when the job runs under an elastic controller.
+    """
+    path = os.environ.get(RESIZE_SIGNAL_ENV)
+    return bool(path) and os.path.exists(path)
 
 
 @dataclasses.dataclass

@@ -60,6 +60,9 @@ REQUESTS = 'requests'          # API request table (server/requests_db)
 MANAGED_JOBS = 'managed-jobs'  # managed-jobs table (jobs/state)
 SERVE = 'serve'                # serve services/replicas (serve/serve_state)
 RUNTIME_JOBS = 'runtime-jobs'  # cluster-local job table (runtime/job_lib)
+CLUSTERS = 'clusters'          # cluster records/events (state.py) — job
+                               # controllers wake on preemption/health
+                               # writes instead of their poll cadence
 
 DISABLE_ENV = 'SKYT_EVENTS_DISABLED'
 SLICE_ENV = 'SKYT_EVENTS_SLICE'
