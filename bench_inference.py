@@ -1,0 +1,415 @@
+"""Inference-engine bench: paged KV + chunked prefill + prefix reuse
+vs the pre-change monolithic slot engine, at EQUAL simulated HBM.
+
+The baseline is the seed ``ContinuousBatchingEngine`` (one full
+``max_len`` KV reservation per slot, whole-prompt bucketed prefill run
+inline on the serving-loop thread), reimplemented here verbatim from
+the pre-change source since the old code path was replaced, not kept.
+Both engines run the same tiny model on CPU — numbers are simulated
+(relative, not TPU-absolute), but the three effects they demonstrate
+are structural:
+
+* **Mixed-length throughput** — at the same KV token budget the paged
+  engine fits 2x the concurrent slots (blocks proportional to actual
+  length vs full-context reservation), so generated tokens/s rises.
+* **Inter-token p99 under an arriving long prompt** — the baseline
+  freezes every active decoder for the whole inline prefill; chunked
+  prefill bounds the stall at one chunk of compute per decode step.
+* **Prefix reuse** — N requests sharing a system prompt prefill the
+  shared blocks once; later requests only chunk their private suffix.
+
+One JSON document on stdout; measured numbers land in
+``BENCH_inference_r10.json``, PERF.md, and docs/inference_engine.md.
+Wired into run_benches.sh (CPU-only, no TPU/tunnel needed).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import List, Optional
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+from skypilot_tpu.models import decode as decode_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.config import get_model_config
+
+MAX_LEN = 128                        # the tiny model's full context
+BASE_SLOTS = 4                       # the simulated-HBM anchor
+BLOCK_SIZE = 16
+PREFILL_CHUNK = 32
+PAGED_SLOTS = 8
+MIXED_LENS = [16, 24, 40, 64, 96]    # cycled across the request fan
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[idx]
+
+
+def _bucket(n: int) -> int:
+    bucket = 16
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+class SlotEngine:
+    """The pre-change slot engine, reimplemented as the bench baseline:
+    monolithic ``max_slots x max_len`` KV cache, whole-prompt bucketed
+    prefill spliced in INLINE on the serving-loop thread (the stall the
+    chunked path removes). Greedy-only subset of the old public API —
+    exactly the decode/prefill compute the seed engine ran."""
+
+    def __init__(self, max_slots: int, max_len: int) -> None:
+        self.cfg = get_model_config('tiny')
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.params = llama.init_params(jax.random.key(0), self.cfg)
+        self.cache = decode_lib.init_cache(self.cfg, max_slots, max_len)
+        self._slots: List[Optional[dict]] = [None] * max_slots
+        self._last_logits = jnp.zeros((max_slots, self.cfg.vocab_size),
+                                      jnp.float32)
+        self._pending: List[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._decode_fn = jax.jit(self._decode_all)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _decode_all(self, params, last_logits, cache, active):
+        tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        logits, cache = decode_lib.decode_step(params, tokens, cache,
+                                               self.cfg, active=active)
+        return tokens, logits, cache
+
+    def _prefill_slot(self, request: dict, slot: int) -> None:
+        ids = request['ids']
+        bucket = min(_bucket(len(ids)), self.max_len)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(ids)] = ids
+        lengths = jnp.array([len(ids)], jnp.int32)
+        logits, small = decode_lib.prefill(self.params,
+                                           jnp.asarray(tokens), lengths,
+                                           self.cfg, self.max_len)
+
+        def splice(big, one):
+            return jax.lax.dynamic_update_slice_in_dim(big, one, slot,
+                                                       axis=1)
+
+        self.cache = decode_lib.KVCache(
+            k=splice(self.cache.k, small.k),
+            v=splice(self.cache.v, small.v),
+            lengths=self.cache.lengths.at[slot].set(lengths[0]))
+        jax.block_until_ready(self.cache.k)   # the inline stall
+        self._last_logits = self._last_logits.at[slot].set(
+            logits[0].astype(jnp.float32))
+        self._slots[slot] = request
+
+    def _admit(self) -> None:
+        for slot in range(self.max_slots):
+            if self._slots[slot] is not None:
+                continue
+            with self._lock:
+                if not self._pending:
+                    break
+                request = self._pending.pop(0)
+            self._prefill_slot(request, slot)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._admit()
+            active_mask = np.array([r is not None for r in self._slots])
+            if not active_mask.any():
+                self._wake.wait(0.01)
+                self._wake.clear()
+                continue
+            tokens, logits, cache = self._decode_fn(
+                self.params, self._last_logits, self.cache,
+                jnp.asarray(active_mask))
+            self.cache = cache
+            self._last_logits = logits
+            host_tokens = np.asarray(tokens)
+            lengths = np.asarray(cache.lengths)
+            for slot, request in enumerate(self._slots):
+                if request is None:
+                    continue
+                request['generated'].append(int(host_tokens[slot]))
+                if (len(request['generated']) >= request['max_new'] or
+                        lengths[slot] >= self.max_len):
+                    request['done'].set()
+                    self._slots[slot] = None
+
+    def generate_ids(self, ids: List[int], max_new_tokens: int,
+                     timeout: float = 600.0) -> List[int]:
+        request = self.stream_ids(ids, max_new_tokens)
+        if not request['done'].wait(timeout):
+            raise TimeoutError('baseline generation timed out')
+        return request['generated']
+
+    def stream_ids(self, ids: List[int], max_new_tokens: int) -> dict:
+        request = {'ids': ids, 'max_new': max_new_tokens,
+                   'generated': [], 'done': threading.Event()}
+        with self._lock:
+            self._pending.append(request)
+        self._wake.set()
+        return request
+
+    def kv_bytes(self) -> int:
+        return self.cache.k.size * self.cache.k.dtype.itemsize * 2
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+
+def make_paged(prefix_cache: bool = True) -> ContinuousBatchingEngine:
+    # Equal simulated HBM: the pool holds exactly BASE_SLOTS * MAX_LEN
+    # KV tokens (what the baseline's monolithic cache reserves), plus
+    # the reserved null block.
+    return ContinuousBatchingEngine(
+        'tiny', max_slots=PAGED_SLOTS, max_len=MAX_LEN,
+        block_size=BLOCK_SIZE, prefill_chunk=PREFILL_CHUNK,
+        num_blocks=BASE_SLOTS * MAX_LEN // BLOCK_SIZE + 1,
+        prefix_cache=prefix_cache)
+
+
+def _mixed_prompts(n: int) -> List[List[int]]:
+    return [[(i * 37 + j * 7 + 11) % 512
+             for j in range(MIXED_LENS[i % len(MIXED_LENS)])]
+            for i in range(n)]
+
+
+def _run_fan(submit, prompts, max_new: int) -> float:
+    """Submit every prompt concurrently; wall seconds to full drain."""
+    outs = [None] * len(prompts)
+
+    def run(i):
+        try:
+            outs[i] = submit(prompts[i], max_new)
+        except BaseException as e:  # surfaced by the assert below
+            outs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    for i, out in enumerate(outs):
+        assert isinstance(out, list) and len(out) == max_new, (i, out)
+    return wall
+
+
+def bench_throughput(requests: int, max_new: int) -> dict:
+    prompts = _mixed_prompts(requests)
+    total_tokens = requests * max_new
+
+    base = SlotEngine(BASE_SLOTS, MAX_LEN)
+    try:
+        base_hbm = base.kv_bytes()
+        # Warm every prefill bucket + the decode program outside the
+        # timed window (compile time is not engine throughput).
+        for n in sorted({_bucket(len(p)) for p in prompts}):
+            base.generate_ids(list(range(2, n + 1)), 1)
+        base_wall = _run_fan(
+            lambda ids, m: base.generate_ids(ids, m), prompts, max_new)
+    finally:
+        base.shutdown()
+
+    paged = make_paged(prefix_cache=False)  # distinct prompts: isolate
+    try:                                    # paging + chunking effects
+        paged_hbm = (paged.cache.k.size * paged.cache.k.dtype.itemsize
+                     * 2)
+        paged.generate_ids(list(range(2, 40)), max_new_tokens=1)
+        paged_wall = _run_fan(
+            lambda ids, m: paged.generate_ids(ids, max_new_tokens=m),
+            prompts, max_new)
+        paged_stats = paged.stats()
+    finally:
+        paged.shutdown()
+
+    return {
+        'requests': requests,
+        'max_new_tokens': max_new,
+        'prompt_lengths': MIXED_LENS,
+        'simulated_hbm_bytes': {'slot': base_hbm, 'paged': paged_hbm},
+        'slots': {'slot': BASE_SLOTS, 'paged': PAGED_SLOTS},
+        'slot_engine': {'wall_s': round(base_wall, 3),
+                        'tokens_per_s': round(total_tokens / base_wall,
+                                              1)},
+        'paged_engine': {'wall_s': round(paged_wall, 3),
+                         'tokens_per_s': round(total_tokens / paged_wall,
+                                               1),
+                         'preemptions': paged_stats['preemptions']},
+        'speedup': round(base_wall / paged_wall, 2),
+    }
+
+
+def _gaps_during_long_prompt(first_token_stream, submit_long,
+                             long_ids) -> dict:
+    """Start a short stream, let it emit one token, then land a long
+    prompt and record the short stream's inter-token gaps."""
+    stream = first_token_stream()
+    long_done = threading.Event()
+
+    def run_long():
+        submit_long(long_ids)
+        long_done.set()
+
+    thread = threading.Thread(target=run_long)
+    thread.start()
+    gaps, last = [], time.perf_counter()
+    during = 0
+    for _ in stream:
+        now = time.perf_counter()
+        gaps.append(now - last)
+        last = now
+        if not long_done.is_set():
+            during += 1
+    thread.join(timeout=600)
+    return {
+        'inter_token_p50_ms': round(_percentile(gaps, 0.5) * 1e3, 2),
+        'inter_token_p99_ms': round(_percentile(gaps, 0.99) * 1e3, 2),
+        'inter_token_max_ms': round(max(gaps) * 1e3, 2),
+        'tokens_during_absorb': during,
+    }
+
+
+def bench_intertoken(short_new: int, long_len: int) -> dict:
+    short_ids = [3, 1, 4, 1, 5]
+    long_ids = [(i * 13 + 5) % 512 for i in range(long_len)]
+
+    base = SlotEngine(BASE_SLOTS, MAX_LEN)
+    try:
+        for n in (_bucket(len(short_ids)), _bucket(long_len)):
+            base.generate_ids(list(range(2, min(n, MAX_LEN - 1))), 1)
+
+        def base_stream():
+            req = base.stream_ids(short_ids, short_new)
+            emitted = 0
+            while True:                      # tail the request dict
+                if emitted < len(req['generated']):
+                    emitted += 1
+                    yield req['generated'][emitted - 1]
+                    continue
+                if req['done'].is_set() and \
+                        emitted >= len(req['generated']):
+                    return
+                time.sleep(0.001)
+
+        stream = base_stream()
+        next(stream)                         # short is decoding
+        base_out = _gaps_during_long_prompt(
+            lambda: stream,
+            lambda ids: base.generate_ids(ids, 2), long_ids)
+    finally:
+        base.shutdown()
+
+    paged = make_paged()
+    try:
+        paged.generate_ids(list(range(2, 40)), max_new_tokens=1)
+        stream = paged.stream_ids(short_ids, max_new_tokens=short_new,
+                                  timeout=600)
+        next(stream)
+        paged_out = _gaps_during_long_prompt(
+            lambda: stream,
+            lambda ids: paged.generate_ids(ids, max_new_tokens=2,
+                                           timeout=600), long_ids)
+        paged_out['prefill_chunks'] = paged.stats()['prefill_chunks']
+    finally:
+        paged.shutdown()
+
+    return {
+        'short_max_new': short_new,
+        'long_prompt_tokens': long_len,
+        'prefill_chunk': PREFILL_CHUNK,
+        'slot_engine': base_out,
+        'paged_engine': paged_out,
+        'p99_stall_ratio': round(
+            base_out['inter_token_p99_ms'] /
+            max(paged_out['inter_token_p99_ms'], 1e-3), 2),
+    }
+
+
+def bench_prefix_reuse(requests: int, system_len: int) -> dict:
+    """Time-to-first-token for requests sharing a system prompt: the
+    first request chunks the whole prompt; later ones reference its
+    cached blocks and only chunk their private suffix."""
+    system = [(i * 5 + 3) % 512 for i in range(system_len)]
+    prompts = [system + [(i * 11 + 7) % 512 for i in range(8)]
+               for i in range(requests)]
+
+    def ttft(eng, ids) -> float:
+        t0 = time.perf_counter()
+        next(eng.stream_ids(ids, max_new_tokens=1, timeout=600))
+        return time.perf_counter() - t0
+
+    eng = make_paged(prefix_cache=True)
+    try:
+        eng.generate_ids(list(range(2, 40)), max_new_tokens=1)
+        before = eng.stats()['prefill_chunks']
+        cold_ttft = ttft(eng, prompts[0])
+        cold_chunks = eng.stats()['prefill_chunks'] - before
+        warm = [ttft(eng, ids) for ids in prompts[1:]]
+        stats = eng.stats()
+        warm_chunks = (stats['prefill_chunks'] - before -
+                       cold_chunks) / (requests - 1)
+    finally:
+        eng.shutdown()
+    warm_p50 = _percentile(warm, 0.5)
+    return {
+        'requests': requests,
+        'system_prompt_tokens': system_len,
+        'cold': {'ttft_ms': round(cold_ttft * 1e3, 2),
+                 'prefill_chunks': cold_chunks},
+        'warm': {'ttft_p50_ms': round(warm_p50 * 1e3, 2),
+                 'prefill_chunks_avg': round(warm_chunks, 2)},
+        'prefix_hits': stats['prefix_cache_hits'],
+        'prefix_tokens_reused': stats['prefix_tokens_reused'],
+        'ttft_speedup': round(cold_ttft / warm_p50, 2),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--requests', type=int, default=24)
+    parser.add_argument('--max-new', type=int, default=24)
+    parser.add_argument('--long-prompt', type=int, default=100)
+    args = parser.parse_args(argv)
+
+    result = {
+        'bench': 'inference_engine',
+        'model': 'tiny',
+        'device': jax.devices()[0].platform,
+        'max_len': MAX_LEN,
+        'block_size': BLOCK_SIZE,
+        'throughput_mixed_lengths': bench_throughput(args.requests,
+                                                     args.max_new),
+        'intertoken_under_long_prefill': bench_intertoken(
+            48, args.long_prompt),
+        'prefix_reuse': bench_prefix_reuse(8, 96),
+    }
+    json.dump(result, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

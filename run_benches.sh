@@ -11,6 +11,13 @@ cd "$(dirname "$0")"
 suffix="${1:-r05_measured}"
 export SKYT_BENCH_PROBE_TRIES="${SKYT_BENCH_PROBE_TRIES:-1}"
 
+# Orphaned skypilot daemons from prior runs (api server, serve
+# controllers, pool runners, channel brokers) steal CPU and have
+# skewed bench numbers on this image — kill them before measuring.
+pkill -f 'skypilot_tpu.*(daemon|serve|runner|broker|api_server)' \
+  2>/dev/null && sleep 1
+echo "preamble: orphaned skypilot daemons killed (if any)" >&2
+
 run() {
   local out="$1"; shift
   echo "=== bench $* ($(date -u +%H:%M:%SZ)) ===" >&2
@@ -41,6 +48,14 @@ echo "=== bench data-transfer ($(date -u +%H:%M:%SZ)) ===" >&2
 timeout 600 env JAX_PLATFORMS=cpu python bench_data_transfer.py \
   | tee "BENCH_data_transfer_${suffix}.json"
 echo "rc=$? -> BENCH_data_transfer_${suffix}.json" >&2
+
+# Inference-engine bench: CPU-only — paged KV + chunked prefill +
+# prefix reuse vs the pre-change monolithic slot engine at equal
+# simulated HBM (docs/inference_engine.md, numbers in PERF.md).
+echo "=== bench inference ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 900 env JAX_PLATFORMS=cpu python bench_inference.py \
+  | tee "BENCH_inference_${suffix}.json"
+echo "rc=$? -> BENCH_inference_${suffix}.json" >&2
 
 # Elastic recovery bench: CPU-only — preemption-to-next-step downtime
 # for rigid relaunch vs elastic shrink on the fault-injected fake

@@ -60,6 +60,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.server import metrics
+from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils import resilience
@@ -93,12 +94,7 @@ GET_SITE = 'data.get_object'
 
 
 def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, '')
-    try:
-        value = int(raw)
-        return value if value > 0 else default
-    except ValueError:
-        return default
+    return common_utils.env_int(name, default, minimum=1)
 
 
 def norm_etag(etag: Optional[str]) -> str:

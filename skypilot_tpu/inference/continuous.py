@@ -1,28 +1,44 @@
-"""Continuous batching: slot-based serving over a fixed decode program.
+"""Continuous batching over a paged KV pool with chunked prefill.
 
-The JetStream/vLLM serving core, TPU-first: the KV cache is allocated
-ONCE for ``max_slots`` sequences, decode is ONE jitted program stepping
-all slots together (static shapes — nothing recompiles as traffic
-changes), and a scheduler thread admits requests into free slots as
-others finish. Unlike the batch-synchronous ``InferenceEngine`` (a new
-request waits for the whole batch), a finished sequence's slot is
-refilled immediately — the latency/throughput profile that makes
-serving economical on TPU.
+The JetStream/vLLM serving core, TPU-first, three layers deep:
 
-Prefill is per-request (its own bucketed program) and its KV rows are
-spliced into the shared cache at the slot index; decode masks inactive
-slots (models/decode.py decode_step(active=...)).
+* **Paged KV pool** (vLLM PagedAttention shape): instead of one
+  ``max_slots * max_len`` monolithic cache, KV lives in a fixed pool of
+  ``block_size``-token blocks; each slot maps logical positions through
+  a block table, so a sequence consumes HBM proportional to its actual
+  length and ``max_slots`` can rise several-fold at the same HBM.
+  Shapes stay static — the pool block count is fixed and the jitted
+  step gathers/scatters by block index — so nothing recompiles as
+  traffic changes.
+* **Chunked prefill** (Sarathi-Serve shape): a prompt is absorbed in
+  fixed-size chunks interleaved between decode steps instead of one
+  inline whole-prompt prefill, so inter-token latency for active
+  decoders is bounded by the chunk budget, not by arriving prompt
+  length.
+* **Prefix cache**: full prompt blocks are digest-keyed and shared
+  read-only across requests (``inference/paged.py``) — a common system
+  prompt prefills once; later requests reference the same blocks
+  copy-on-write style and only compute their private suffix.
+
+Decode is ONE jitted program stepping all slots together; the scheduler
+thread admits requests into free slots as others finish. Public
+surface (``generate_ids``/``stream_ids``/...) is unchanged from the
+monolithic-cache engine.
 """
 from __future__ import annotations
 
+import functools
+import math
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.inference.paged import BlockPool, PrefixCache
 from skypilot_tpu.inference.tokenizer import get_tokenizer
 from skypilot_tpu.models import decode as decode_lib
 from skypilot_tpu.models import llama
@@ -31,12 +47,36 @@ from skypilot_tpu.utils import log
 
 logger = log.init_logger(__name__)
 
+DEFAULT_BLOCK_SIZE = 16
+DEFAULT_PREFILL_CHUNK = 64
 
-def _bucket(n: int, minimum: int = 16) -> int:
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+
+# Module-level jitted steps with the (frozen, hashable) ModelConfig as
+# a static arg: every engine with the same config + shapes shares one
+# compiled program — repeated engine construction (tests, serving
+# restarts) stops paying XLA compilation over and over.
+
+@functools.partial(jax.jit, static_argnames=('cfg',))
+def _decode_all_step(params, last_logits, cache, active, temps, rngs,
+                     *, cfg):
+    """One step for every slot: sample from last logits, advance."""
+    keys = jax.vmap(jax.random.fold_in)(rngs, cache.lengths)
+    greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    sampled = jax.vmap(
+        lambda k, l, t: jax.random.categorical(
+            k, l / jnp.maximum(t, 1e-6)))(keys, last_logits,
+                                          temps).astype(jnp.int32)
+    tokens = jnp.where(temps <= 0.0, greedy, sampled)
+    logits, cache = decode_lib.paged_decode_step(
+        params, tokens, cache, cfg, active=active)
+    return tokens, logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=('cfg',))
+def _prefill_chunk_step(params, tokens, start, n_new, slot, cache,
+                        *, cfg):
+    return decode_lib.prefill_chunk(params, tokens, start, n_new,
+                                    slot, cache, cfg)
 
 
 class _Request:
@@ -48,9 +88,31 @@ class _Request:
         self.temperature = temperature
         self.eos_id = eos_id
         self.seed = seed
+        self.arrival = time.monotonic()
+        self.admitted = False  # queue-wait counted once, not per resume
         self.generated: List[int] = []
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
+
+
+class _PrefillState:
+    """A slot mid-prefill: ``pos`` = next index of ``ids`` to absorb.
+
+    ``ids`` is the prompt PLUS any tokens generated before a
+    preemption: a preempted request resumes by re-prefilling its whole
+    visible sequence (chunked, possibly prefix-cache-accelerated) and
+    continuing to decode — sampling folds the rng into the position,
+    so the rng stream is exactly what it would have been. (The resume
+    logits come through the chunk-prefill attention rather than the
+    decode kernel; on backends where those reductions differ by ULPs,
+    a near-tie at temperature>0 can still resolve differently.)"""
+
+    def __init__(self, request: _Request, slot: int, pos: int,
+                 ids: List[int]) -> None:
+        self.request = request
+        self.slot = slot
+        self.pos = pos
+        self.ids = ids
 
 
 class ContinuousBatchingEngine:
@@ -65,6 +127,10 @@ class ContinuousBatchingEngine:
                  hf_checkpoint: Optional[str] = None,
                  max_slots: int = 4,
                  max_len: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
                  seed: int = 0,
                  quantize: bool = False,
                  quantize_kv: bool = False,
@@ -85,10 +151,25 @@ class ContinuousBatchingEngine:
                 f'Model vocab {self.cfg.vocab_size} < tokenizer '
                 f'vocab {self.tokenizer.vocab_size}')
         self.max_slots = max_slots
-        # Cache length defaults to the model's full context (the cache
-        # is allocated once: max_slots * max_len rows).
         self.max_len = min(max_len or self.cfg.max_seq_len,
                            self.cfg.max_seq_len)
+        from skypilot_tpu.utils.common_utils import env_int
+        self.block_size = (block_size or
+                           env_int('SKYT_INFER_BLOCK_SIZE',
+                                   DEFAULT_BLOCK_SIZE))
+        if self.block_size < 1:
+            raise ValueError(f'block_size must be >= 1, got '
+                             f'{self.block_size}')
+        self.prefill_chunk = max(1, min(
+            prefill_chunk or env_int('SKYT_INFER_PREFILL_CHUNK',
+                                     DEFAULT_PREFILL_CHUNK),
+            self.max_len))
+        self.blocks_per_slot = math.ceil(self.max_len / self.block_size)
+        # Default pool = the HBM the monolithic max_slots*max_len cache
+        # used (+1 for the reserved null block). Block granularity +
+        # prefix sharing is what lets max_slots rise at the same HBM.
+        self.num_blocks = (num_blocks or
+                           max_slots * self.blocks_per_slot + 1)
         if params is not None:
             self.params = params
         elif checkpoint_dir:
@@ -103,71 +184,325 @@ class ContinuousBatchingEngine:
             self.params = llama.init_params(jax.random.key(seed),
                                             self.cfg)
         # Mesh placement first, then quantization (see engine.py note).
-        from skypilot_tpu.inference.sharding import prepare_engine
+        from skypilot_tpu.inference.sharding import (prepare_engine,
+                                                     shard_paged_cache)
         self.params, self.cfg, self._mesh = prepare_engine(
             self.params, self.cfg, mesh)
         from skypilot_tpu.models.quant import maybe_quantize
         self.params = maybe_quantize(self.params, quantize)
-        self.cache = decode_lib.init_cache(self.cfg, max_slots,
-                                           self.max_len)
+        self.cache = shard_paged_cache(
+            decode_lib.init_paged_cache(self.cfg, self.num_blocks,
+                                        self.block_size, max_slots,
+                                        self.blocks_per_slot),
+            self._mesh, self.cfg)
+        # Host-side bookkeeping (serving-loop thread only).
+        self._pool = BlockPool(self.num_blocks)
+        self._prefix: Optional[PrefixCache] = (
+            PrefixCache(self._pool, self.block_size)
+            if prefix_cache and self.block_size <= self.max_len else None)
+        self._host_bt = np.zeros((max_slots, self.blocks_per_slot),
+                                 np.int32)
+        self._host_len = np.zeros((max_slots,), np.int64)
+        self._slot_blocks: List[List[int]] = [[] for _ in
+                                              range(max_slots)]
+        self._bt_dirty = False
         self._slots: List[Optional[_Request]] = [None] * max_slots
+        self._decoding = [False] * max_slots
+        self._admit_order = [0] * max_slots  # preemption victim pick
+        self._admit_seq = 0
+        self._prefilling: List[_PrefillState] = []
+        self._waiting: List[_Request] = []  # admitted FIFO, blocked on HBM
+        # Pool version at the last admission attempt that failed on
+        # HBM pressure: until it changes, retrying is pure waste
+        # (prefix re-hash + reclaimable scan on the serving loop).
+        self._blocked_at_version: Optional[int] = None
         self._rngs = [jax.random.key(seed + 1 + i)
                       for i in range(max_slots)]
         self._last_logits = jnp.zeros((max_slots, self.cfg.vocab_size),
                                       jnp.float32)
         self._pending: 'queue.Queue[_Request]' = queue.Queue()
+        # Counters (monotonic; surfaced as Prometheus counters).
         self._requests_total = 0
+        self._completions_total = 0
+        self._errors_total = 0
+        self._prefill_errors_total = 0
+        self._prefill_chunks_total = 0
         self._tokens_total = 0
         self._decode_seconds_total = 0.0
+        self._queue_wait_seconds_total = 0.0
+        self._prefix_hits_total = 0
+        self._prefix_misses_total = 0
+        self._prefix_tokens_reused_total = 0
+        self._preemptions_total = 0
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name='continuous-batching',
                                         daemon=True)
-        self._decode_fn = jax.jit(self._decode_all)
+        self._decode_fn = functools.partial(_decode_all_step,
+                                            cfg=self.cfg)
+        self._prefill_fn = functools.partial(_prefill_chunk_step,
+                                             cfg=self.cfg)
         self._thread.start()
 
-    # -- jitted pieces --------------------------------------------------
+    # -- block-table plumbing -------------------------------------------
 
-    def _decode_all(self, params, last_logits, cache, active, temps,
-                    rngs):
-        """One step for every slot: sample from last logits, advance."""
-        keys = jax.vmap(jax.random.fold_in)(rngs, cache.lengths)
-        greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        sampled = jax.vmap(
-            lambda k, l, t: jax.random.categorical(
-                k, l / jnp.maximum(t, 1e-6)))(keys, last_logits,
-                                              temps).astype(jnp.int32)
-        tokens = jnp.where(temps <= 0.0, greedy, sampled)
-        logits, cache = decode_lib.decode_step(params, tokens, cache,
-                                               self.cfg, active=active)
-        return tokens, logits, cache
+    def _sync_tables(self) -> None:
+        """Push host block-table/length edits to the device cache."""
+        if not self._bt_dirty:
+            return
+        import dataclasses
+        self.cache = dataclasses.replace(
+            self.cache,
+            block_tables=jnp.asarray(self._host_bt),
+            lengths=jnp.asarray(self._host_len, np.int32))
+        self._bt_dirty = False
 
-    def _prefill_slot(self, request: _Request, slot: int) -> None:
-        ids = request.token_ids
-        bucket = min(_bucket(len(ids)), self.max_len)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :len(ids)] = ids
-        lengths = jnp.array([len(ids)], jnp.int32)
-        logits, small = decode_lib.prefill(self.params,
-                                           jnp.asarray(tokens), lengths,
-                                           self.cfg, self.max_len)
-        # Splice the single-sequence cache into the shared one at `slot`.
-        def splice(big, one):
-            return jax.lax.dynamic_update_slice_in_dim(big, one, slot,
-                                                       axis=1)
-        self.cache = decode_lib.KVCache(
-            k=splice(self.cache.k, small.k),
-            v=splice(self.cache.v, small.v),
-            lengths=self.cache.lengths.at[slot].set(lengths[0]),
-            k_scale=(splice(self.cache.k_scale, small.k_scale)
-                     if self.cache.quantized else None),
-            v_scale=(splice(self.cache.v_scale, small.v_scale)
-                     if self.cache.quantized else None))
-        self._last_logits = self._last_logits.at[slot].set(
-            logits[0].astype(jnp.float32))
-        self._rngs[slot] = jax.random.key(request.seed)
+    def _alloc_block(self) -> Optional[int]:
+        """Pool alloc with prefix-cache LRU eviction under pressure.
+        Only reclaimable entries are evicted — dropping entries whose
+        blocks live slots still share frees nothing and would wipe the
+        reusable prefix chains exactly when the pool is busiest."""
+        block = self._pool.alloc()
+        while block is None and self._prefix is not None:
+            if not self._prefix.evict_reclaimable():
+                break
+            block = self._pool.alloc()
+        return block
+
+    def _release_slot(self, slot: int) -> None:
+        for block in self._slot_blocks[slot]:
+            self._pool.decref(block)
+        self._slot_blocks[slot] = []
+        self._host_bt[slot, :] = 0
+        self._host_len[slot] = 0
+        self._slots[slot] = None
+        self._decoding[slot] = False
+        self._bt_dirty = True
+
+    def _finish(self, request: _Request,
+                error: Optional[BaseException] = None) -> None:
+        """Single exit point: keeps requests == completions + errors +
+        in-flight, whatever path a request dies on."""
+        if error is not None:
+            request.error = error
+            self._errors_total += 1
+        else:
+            self._completions_total += 1
+        request.done.set()
+
+    def _fail_slot(self, slot: int, error: BaseException,
+                   prefill: bool = False) -> None:
+        request = self._slots[slot]
+        self._release_slot(slot)
+        if prefill:
+            self._prefill_errors_total += 1
+        if request is not None:
+            self._finish(request, error)
+
+    # -- admission + chunked prefill ------------------------------------
+
+    def _admit(self) -> None:
+        """Bookkeeping-only admission: assign a free slot, reference
+        cached prefix blocks, allocate private blocks for the prompt.
+        The compute (chunked prefill) happens in ``_prefill_tick``,
+        interleaved with decode steps — never inline here."""
+        while True:
+            try:
+                self._waiting.append(self._pending.get_nowait())
+            except queue.Empty:
+                break
+        while self._waiting:
+            slot = next((s for s in range(self.max_slots)
+                         if self._slots[s] is None), None)
+            if slot is None:
+                return
+            if self._blocked_at_version == self._pool.version:
+                return  # still HBM-blocked; nothing changed since
+            request = self._waiting[0]
+            try:
+                if not self._begin_prefill(request, slot):
+                    # HBM pressure: keep FIFO order; retry only once
+                    # the pool's alloc/ref state has moved.
+                    self._blocked_at_version = self._pool.version
+                    return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception('prefill admission failed')
+                self._waiting.pop(0)
+                self._prefill_errors_total += 1
+                self._finish(request, e)
+                continue
+            self._blocked_at_version = None
+            self._waiting.pop(0)
+
+    def _begin_prefill(self, request: _Request, slot: int) -> bool:
+        """Returns False when the pool can't fit the prompt right now
+        (request stays queued); raises when it never can.
+
+        A preempted request carries its already-generated tokens: they
+        re-prefill as part of the visible sequence and decode resumes
+        where it left off."""
+        ids = request.token_ids + request.generated
+        plen = len(ids)
+        needed_total = math.ceil(plen / self.block_size)
+        if needed_total > self._pool.total_blocks:
+            raise RuntimeError(
+                f'prompt needs {needed_total} KV blocks; pool has '
+                f'{self._pool.total_blocks} (raise num_blocks or '
+                f'SKYT_INFER_BLOCK_SIZE granularity)')
+        shared: List[int] = []
+        if self._prefix is not None:
+            # Leave >= 1 prompt token to compute: the last token's
+            # logits seed sampling and are never cached. Hit/miss
+            # counters are bumped only once admission COMMITS below —
+            # a blocked retry must not re-count reuse that never
+            # happened.
+            shared = self._prefix.lookup(ids, limit_tokens=plen - 1)
+        blocks = list(shared)
+        # Admission watermark: keep one tail block of headroom per
+        # active decoder so admitting this prompt can't immediately
+        # force a preemption storm. Only RECLAIMABLE prefix entries
+        # count as available (this request's own shared refs and
+        # blocks live slots share free nothing when evicted).
+        need_private = needed_total - len(shared)
+        avail = self._pool.free_blocks + (
+            self._prefix.reclaimable_blocks if self._prefix is not None
+            else 0)
+        if avail < need_private + sum(self._decoding):
+            for block in blocks:
+                self._pool.decref(block)
+            return False
+        ok = True
+        while len(blocks) < needed_total:
+            block = self._alloc_block()
+            if block is None:
+                ok = False
+                break
+            blocks.append(block)
+        if not ok:
+            for block in blocks:
+                self._pool.decref(block)
+            return False
+        start = len(shared) * self.block_size
+        if self._prefix is not None:
+            if shared:
+                self._prefix_hits_total += 1
+                self._prefix_tokens_reused_total += start
+            else:
+                self._prefix_misses_total += 1
+        if not request.admitted:
+            request.admitted = True
+            self._queue_wait_seconds_total += max(
+                0.0, time.monotonic() - request.arrival)
+        self._slot_blocks[slot] = blocks
+        self._host_bt[slot, :] = 0
+        self._host_bt[slot, :len(blocks)] = blocks
+        self._host_len[slot] = start
+        self._bt_dirty = True
         self._slots[slot] = request
+        self._decoding[slot] = False
+        self._admit_seq += 1
+        self._admit_order[slot] = self._admit_seq
+        self._prefilling.append(_PrefillState(request, slot, start, ids))
+        return True
+
+    def _prefill_tick(self) -> None:
+        """Absorb ONE chunk of ONE prefilling prompt (FIFO). Called
+        up to twice per loop iteration (once before the decode step,
+        once overlapped with its host readback), so active decoders
+        stall for at most TWO chunks of prefill compute per generated
+        token — still bounded by the chunk budget, never by arriving
+        prompt length."""
+        if not self._prefilling:
+            return
+        state = self._prefilling[0]
+        request, slot = state.request, state.slot
+        ids = state.ids
+        chunk = ids[state.pos:state.pos + self.prefill_chunk]
+        tokens = np.zeros((1, self.prefill_chunk), np.int32)
+        tokens[0, :len(chunk)] = chunk
+        self._sync_tables()
+        try:
+            last, cache = self._prefill_fn(
+                self.params, jnp.asarray(tokens),
+                jnp.int32(state.pos), jnp.int32(len(chunk)),
+                jnp.int32(slot), self.cache)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('chunked prefill failed')
+            self._prefilling.pop(0)
+            self._fail_slot(slot, e, prefill=True)
+            return
+        self.cache = cache
+        state.pos += len(chunk)
+        self._host_len[slot] = state.pos
+        self._prefill_chunks_total += 1
+        if state.pos >= len(ids):
+            self._prefilling.pop(0)
+            self._last_logits = self._last_logits.at[slot].set(
+                last[0].astype(jnp.float32))
+            self._rngs[slot] = jax.random.key(request.seed)
+            self._decoding[slot] = True
+            if self._prefix is not None:
+                self._prefix.insert(ids, self._slot_blocks[slot])
+
+    def _preempt(self, slot: int, active_mask: np.ndarray) -> None:
+        """Release a slot's blocks (decoding OR mid-prefill) and
+        requeue its request at the FRONT of the admission queue (it
+        resumes by re-prefilling prompt + generated-so-far; fold-in-
+        position sampling keeps the rng stream identical — see the
+        _PrefillState note on kernel-level logits equivalence). The
+        HBM-pressure valve: oversubscribed pools degrade to queueing,
+        never to corrupt or dead requests."""
+        request = self._slots[slot]
+        self._prefilling = [s for s in self._prefilling
+                            if s.slot != slot]
+        self._release_slot(slot)
+        active_mask[slot] = False
+        self._preemptions_total += 1
+        if request is not None:
+            self._waiting.insert(0, request)
+            self._wake.set()
+
+    def _ensure_decode_blocks(self, active_mask: np.ndarray) -> None:
+        """A slot crossing a block boundary needs its next tail block
+        BEFORE the step writes position ``length``. When the pool is
+        exhausted even after prefix-cache eviction, the most recently
+        admitted decoding request is preempted (vLLM policy: the oldest
+        request always progresses, so the system drains)."""
+        for slot in range(self.max_slots):
+            if not active_mask[slot]:
+                continue
+            length = int(self._host_len[slot])
+            if length % self.block_size != 0:
+                continue
+            index = length // self.block_size
+            if index >= self.blocks_per_slot:
+                continue  # finish check retires it this step
+            if self._host_bt[slot, index] != 0:
+                continue
+            while True:
+                block = self._alloc_block()
+                if block is not None:
+                    self._slot_blocks[slot].append(block)
+                    self._host_bt[slot, index] = block
+                    self._bt_dirty = True
+                    break
+                # Victims: any OTHER slot holding blocks — decoding or
+                # mid-prefill (a pool drained into prefills must not
+                # strand the decoders).
+                victims = [s for s in range(self.max_slots)
+                           if s != slot and self._slots[s] is not None]
+                if not victims:
+                    # Nothing left to steal from: this request alone
+                    # outgrew the pool — fail it loudly.
+                    active_mask[slot] = False
+                    self._fail_slot(slot, RuntimeError(
+                        'KV block pool exhausted mid-decode (raise '
+                        'num_blocks or lower max_slots)'))
+                    break
+                victim = max(victims,
+                             key=lambda s: self._admit_order[s])
+                self._preempt(victim, active_mask)
 
     # -- serving loop ---------------------------------------------------
 
@@ -179,15 +514,22 @@ class ContinuousBatchingEngine:
     def _loop_body(self) -> None:
         while not self._stop.is_set():
             self._admit()
-            active_mask = np.array([r is not None for r in self._slots])
+            self._prefill_tick()
+            active_mask = np.array(self._decoding, bool)
             if not active_mask.any():
+                if self._prefilling or self._waiting or \
+                        not self._pending.empty():
+                    continue  # keep absorbing prefill chunks
                 self._wake.wait(0.01)
                 self._wake.clear()
                 continue
+            self._ensure_decode_blocks(active_mask)
+            if not active_mask.any():
+                continue
+            self._sync_tables()
             temps = np.array([r.temperature if r else 0.0
                               for r in self._slots], np.float32)
-            import time as time_lib
-            step_t0 = time_lib.perf_counter()
+            step_t0 = time.perf_counter()
             try:
                 tokens, logits, cache = self._decode_fn(
                     self.params, self._last_logits, self.cache,
@@ -195,20 +537,37 @@ class ContinuousBatchingEngine:
                     jnp.stack(self._rngs))
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('continuous decode step failed')
-                for slot, request in enumerate(self._slots):
-                    if request is not None:
-                        request.error = e
-                        request.done.set()
-                        self._slots[slot] = None
+                for slot in range(self.max_slots):
+                    if active_mask[slot] and self._slots[slot] is not None:
+                        self._fail_slot(slot, e)
                 continue
             self.cache = cache
             self._last_logits = logits
+            # The step advanced every active slot by one position
+            # (deterministic) — mirror it on the host now so overlap
+            # work below sees consistent lengths.
+            self._host_len[active_mask] += 1
+            # Overlap the host readback with useful work: start the
+            # async device->host copy, then dispatch the next prefill
+            # chunk / admission bookkeeping while the step (and the
+            # copy) complete — no hard sync in the middle of the loop.
+            try:
+                tokens.copy_to_host_async()
+            except AttributeError:
+                pass
+            overlap_t0 = time.perf_counter()
+            self._admit()
+            self._prefill_tick()
+            # decode_seconds feeds tokens/s derivations: exclude the
+            # host-side admission/prefill bookkeeping done in the
+            # overlap window from the decode-step accounting.
+            overlap_cost = time.perf_counter() - overlap_t0
             host_tokens = np.asarray(tokens)
-            lengths = np.asarray(cache.lengths)
-            self._decode_seconds_total += (time_lib.perf_counter() -
-                                           step_t0)
-            for slot, request in enumerate(self._slots):
-                if request is None:
+            self._decode_seconds_total += (time.perf_counter() -
+                                           step_t0 - overlap_cost)
+            for slot in range(self.max_slots):
+                request = self._slots[slot]
+                if request is None or not active_mask[slot]:
                     continue
                 token = int(host_tokens[slot])
                 self._tokens_total += 1
@@ -217,25 +576,10 @@ class ContinuousBatchingEngine:
                     (request.eos_id is not None and
                      token == request.eos_id) or
                     len(request.generated) >= request.max_new_tokens or
-                    lengths[slot] >= self.max_len)
+                    self._host_len[slot] >= self.max_len)
                 if finished:
-                    request.done.set()
-                    self._slots[slot] = None  # slot free for admission
-
-    def _admit(self) -> None:
-        for slot in range(self.max_slots):
-            if self._slots[slot] is not None:
-                continue
-            try:
-                request = self._pending.get_nowait()
-            except queue.Empty:
-                break
-            try:
-                self._prefill_slot(request, slot)
-            except Exception as e:  # pylint: disable=broad-except
-                logger.exception('prefill failed')
-                request.error = e
-                request.done.set()
+                    self._finish(request)
+                    self._release_slot(slot)  # blocks back to the pool
 
     # -- public API -----------------------------------------------------
 
@@ -292,13 +636,12 @@ class ContinuousBatchingEngine:
 
         Validation/admission happens EAGERLY (same as generate_ids: an
         over-long prompt raises here, not at first iteration)."""
-        import time as time_lib
         request = self._submit(token_ids, max_new_tokens, temperature,
                                eos_id, seed)
 
         def tail():
             emitted = 0
-            deadline = time_lib.time() + timeout
+            deadline = time.time() + timeout
             while True:
                 finished = request.done.is_set()
                 generated = request.generated
@@ -312,9 +655,9 @@ class ContinuousBatchingEngine:
                     if request.error is not None:
                         raise request.error
                     return
-                if time_lib.time() > deadline:
+                if time.time() > deadline:
                     raise TimeoutError('generation timed out')
-                time_lib.sleep(0.005)
+                time.sleep(0.005)
 
         return tail()
 
@@ -349,14 +692,34 @@ class ContinuousBatchingEngine:
             return [f.result() for f in futures]
 
     def stats(self) -> Dict[str, float]:
+        total = self._pool.total_blocks
+        free = self._pool.free_blocks
         return {
             'slots': self.max_slots,
             'active': sum(r is not None for r in self._slots),
-            'pending': self._pending.qsize(),
+            'pending': self._pending.qsize() + len(self._waiting),
             # Monotonic counters (Prometheus counter type on /metrics).
             'requests': self._requests_total,
+            'completions': self._completions_total,
+            'request_errors': self._errors_total,
+            'prefill_errors': self._prefill_errors_total,
+            'prefill_chunks': self._prefill_chunks_total,
             'tokens_generated': self._tokens_total,
             'decode_seconds': round(self._decode_seconds_total, 4),
+            'queue_wait_seconds': round(self._queue_wait_seconds_total,
+                                        4),
+            'prefix_cache_hits': self._prefix_hits_total,
+            'prefix_cache_misses': self._prefix_misses_total,
+            'prefix_tokens_reused': self._prefix_tokens_reused_total,
+            'preemptions': self._preemptions_total,
+            # Point-in-time gauges: paged-pool pressure.
+            'block_size': self.block_size,
+            'blocks_total': total,
+            'blocks_free': free,
+            'blocks_cached': (self._prefix.cached_blocks
+                              if self._prefix is not None else 0),
+            'block_occupancy': round((total - free) / total, 4)
+            if total else 0.0,
         }
 
     def shutdown(self) -> None:

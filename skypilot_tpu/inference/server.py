@@ -64,8 +64,15 @@ def make_handler(engine: InferenceEngine):
 
         # Monotonic counters vs point-in-time gauges (Prometheus type
         # correctness: rate() over a gauge breaks scrapers/linters).
+        # slots/active/pending and the paged-pool block_* occupancy
+        # stats stay gauges.
         _COUNTERS = frozenset({'requests', 'tokens_generated',
-                               'decode_seconds'})
+                               'decode_seconds', 'completions',
+                               'request_errors', 'prefill_errors',
+                               'prefill_chunks', 'queue_wait_seconds',
+                               'prefix_cache_hits',
+                               'prefix_cache_misses',
+                               'prefix_tokens_reused', 'preemptions'})
 
         def do_GET(self):
             if self.path == '/health':
@@ -287,6 +294,18 @@ def main(argv=None) -> int:
     parser.add_argument('--max-len', type=int, default=None,
                         help='KV-cache length per slot (continuous '
                              'engine; default: the model context).')
+    parser.add_argument('--block-size', type=int, default=None,
+                        help='paged KV block granularity in tokens '
+                             '(continuous engine; default '
+                             '$SKYT_INFER_BLOCK_SIZE or 16).')
+    parser.add_argument('--prefill-chunk', type=int, default=None,
+                        help='chunked-prefill budget in tokens per '
+                             'decode step (continuous engine; default '
+                             '$SKYT_INFER_PREFILL_CHUNK or 64).')
+    parser.add_argument('--kv-blocks', type=int, default=None,
+                        help='total paged KV pool blocks (continuous '
+                             'engine; default sized to max_slots * '
+                             'max_len, i.e. the monolithic-cache HBM).')
     parser.add_argument('--quantize', action='store_true',
                         help='int8 W8A8 weights (half the decode HBM '
                              'traffic, 2x MXU int8 rate).')
@@ -307,6 +326,9 @@ def main(argv=None) -> int:
             hf_checkpoint=args.hf_checkpoint,
             max_slots=args.max_batch,
             max_len=args.max_len,
+            block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk,
+            num_blocks=args.kv_blocks,
             quantize=args.quantize,
             quantize_kv=args.quantize_kv,
             mesh=args.mesh)

@@ -101,6 +101,39 @@ def prepare_engine(params: Params, cfg: ModelConfig,
     return shard_inference_params(params, mesh, cfg), cfg, mesh
 
 
+def shard_paged_cache(cache, mesh: Optional[Mesh], cfg: ModelConfig):
+    """Place a ``PagedKVCache`` pool on the mesh: k/v (and int8 scales)
+    shard along the kv-head axis over ``tensor`` — the same axis the
+    decode kernel shard_maps over — while block tables and lengths
+    replicate. Gather/scatter by block index only touches the
+    pool/position axes, so GSPMD keeps the head sharding through the
+    jitted step. Non-dividing head counts replicate (the XLA fallback
+    path partitions itself)."""
+    if mesh is None or mesh.size == 1:
+        return cache
+    tp = dict(mesh.shape).get('tensor', 1)
+    if tp <= 1 or cfg.n_kv_heads % tp:
+        return cache
+    import dataclasses
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    kv_spec = P(None, None, None, 'tensor', None)
+    scale_spec = P(None, None, None, 'tensor')
+    return dataclasses.replace(
+        cache,
+        k=put(cache.k, kv_spec), v=put(cache.v, kv_spec),
+        lengths=put(cache.lengths, P()),
+        block_tables=put(cache.block_tables, P()),
+        k_scale=(put(cache.k_scale, scale_spec)
+                 if cache.k_scale is not None else None),
+        v_scale=(put(cache.v_scale, scale_spec)
+                 if cache.v_scale is not None else None))
+
+
 def mesh_context(mesh: Optional[Mesh]):
     """``set_mesh(mesh)`` (or a no-op) for wrapping engine compute calls.
 

@@ -314,6 +314,313 @@ def decode_step(params: Params, tokens: jax.Array, cache: KVCache,
 
 
 # ---------------------------------------------------------------------------
+# Paged KV cache: block-granular pool + per-slot block tables
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Block-granular KV pool + per-slot block tables (PagedAttention).
+
+    Instead of one ``[slots, max_len]`` monolithic cache, KV lives in a
+    pool of fixed-size blocks and each slot maps its logical positions
+    through a block table — a sequence consumes HBM proportional to its
+    actual length, and read-only blocks (shared prompt prefixes) can be
+    referenced by many slots at once. All shapes are static: the pool
+    has a fixed block count, slots gather/scatter by block index inside
+    the jitted step.
+
+    Block id 0 is the reserved null block: unused table entries point
+    at it, and masked (inactive / padding) writes land in it.
+
+    ``cfg.kv_cache_dtype == 'int8'`` stores int8 k/v with per-row
+    fp32 scales, exactly like the monolithic ``KVCache``.
+    """
+    k: jax.Array        # [L, num_blocks, block_size, kv_heads, head_dim]
+    v: jax.Array        # [L, num_blocks, block_size, kv_heads, head_dim]
+    lengths: jax.Array      # [slots] int32 valid positions per slot
+    block_tables: jax.Array  # [slots, blocks_per_slot] int32 pool ids
+    k_scale: Optional[jax.Array] = None  # [L, num_blocks, block, kvh] f32
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def blocks_per_slot(self) -> int:
+        return self.block_tables.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.blocks_per_slot * self.block_size
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_paged_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
+                     slots: int, blocks_per_slot: int) -> PagedKVCache:
+    if cfg.kv_cache_dtype not in ('compute', 'int8'):
+        raise ValueError(
+            f"kv_cache_dtype must be 'compute' or 'int8', got "
+            f'{cfg.kv_cache_dtype!r}')
+    if num_blocks < 2:
+        raise ValueError('num_blocks must be >= 2 (block 0 is reserved)')
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.resolved_head_dim)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    tables = jnp.zeros((slots, blocks_per_slot), jnp.int32)
+    if cfg.kv_cache_dtype == 'int8':
+        return PagedKVCache(k=jnp.zeros(shape, jnp.int8),
+                            v=jnp.zeros(shape, jnp.int8),
+                            lengths=lengths, block_tables=tables,
+                            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                            v_scale=jnp.zeros(shape[:-1], jnp.float32))
+    dt = cfg.compute_dtype
+    return PagedKVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                        lengths=lengths, block_tables=tables)
+
+
+def _view_rows(block_tables: jax.Array, block_size: int) -> jax.Array:
+    """Block tables [..., BPS] -> flat pool row per view position
+    [..., BPS*block_size] (the gather index for a slot's logical
+    cache view)."""
+    off = jnp.arange(block_size, dtype=block_tables.dtype)
+    rows = block_tables[..., :, None] * block_size + off
+    return rows.reshape(*block_tables.shape[:-1], -1)
+
+
+def _chunk_attention(q: jax.Array, k_view: jax.Array, v_view: jax.Array,
+                     q_pos: jax.Array,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None) -> jax.Array:
+    """Chunked-prefill attention: chunk queries over a gathered cache
+    view that already contains the chunk's own rows.
+
+    q: [1, C, H, D] at absolute positions ``q_pos`` [C]; k_view/v_view:
+    [1, T, KVH, D] (T = the slot's full logical view; rows at or past a
+    query's position+1 are masked). Mirrors ``ops.attention.
+    xla_attention`` numerics exactly (fp32 softmax, NEG_INF mask) so a
+    single-chunk prefill is bit-compatible with the whole-prompt path.
+    """
+    from skypilot_tpu.ops.attention import NEG_INF as ATTN_NEG_INF
+    from skypilot_tpu.ops.attention import repeat_kv
+    _, _, h, d = q.shape
+    kvh = k_view.shape[2]
+    if k_scale is not None:
+        k_view = k_view.astype(jnp.float32) * k_scale[..., None]
+        v_view = (v_view.astype(jnp.float32) *
+                  v_scale[..., None]).astype(q.dtype)
+    k_view = repeat_kv(k_view, h // kvh)
+    v_view = repeat_kv(v_view, h // kvh)
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k_view,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    t = k_view.shape[1]
+    mask = (jnp.arange(t)[None, :] <= q_pos[:, None])[None, None]
+    logits = jnp.where(mask, logits, ATTN_NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', weights.astype(v_view.dtype),
+                      v_view)
+
+
+def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
+                  n_new: jax.Array, slot: jax.Array, cache: PagedKVCache,
+                  cfg: ModelConfig) -> Tuple[jax.Array, PagedKVCache]:
+    """Absorb one prompt chunk for one slot into the paged pool.
+
+    tokens: [1, C] int32 right-padded chunk; ``start``: positions
+    already cached for the slot (shared-prefix blocks + earlier
+    chunks); ``n_new``: valid tokens in this chunk; ``slot``: slot row
+    in the block table. Chunk queries attend to the slot's cached rows
+    ``[0, start)`` plus causally within the chunk (Sarathi-style
+    chunked prefill: one fixed-shape program regardless of prompt
+    length). Returns (last-valid-token logits [1, V], updated cache) —
+    the logits are meaningful on the final chunk of a prompt.
+    """
+    _, c = tokens.shape
+    dt = cfg.compute_dtype
+    offs = start + jnp.arange(c)                             # [C] abs pos
+    sin, cos = rope_table_for(cfg, offs)
+    x = _embed(params, tokens, cfg)                          # [1, C, D]
+
+    bs = cache.block_size
+    bps = cache.blocks_per_slot
+    nb = cache.num_blocks
+    bt_slot = jnp.take(cache.block_tables, slot, axis=0)     # [BPS]
+    valid_tok = jnp.arange(c) < n_new
+    blk = jnp.clip(offs // bs, 0, bps - 1)
+    write_rows = jnp.where(valid_tok,
+                           jnp.take(bt_slot, blk) * bs + offs % bs,
+                           0)                                # [C]
+    view_rows = _view_rows(bt_slot, bs)                      # [T]
+    quantized = cache.quantized
+
+    def layer(carry, scanned):
+        x = carry
+        if quantized:
+            lp, kp, vp, ksp, vsp = scanned
+        else:
+            lp, kp, vp = scanned
+            ksp = vsp = None
+        h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
+        q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
+        k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
+        v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        kf = kp.reshape(nb * bs, *kp.shape[2:])
+        vf = vp.reshape(nb * bs, *vp.shape[2:])
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kf = kf.at[write_rows].set(k_q[0])
+            vf = vf.at[write_rows].set(v_q[0])
+            ksf = ksp.reshape(nb * bs, -1).at[write_rows].set(k_s[0])
+            vsf = vsp.reshape(nb * bs, -1).at[write_rows].set(v_s[0])
+            k_view = kf[view_rows][None]
+            v_view = vf[view_rows][None]
+            attn = _chunk_attention(q, k_view, v_view, offs,
+                                    k_scale=ksf[view_rows][None],
+                                    v_scale=vsf[view_rows][None])
+        else:
+            kf = kf.at[write_rows].set(k[0].astype(kf.dtype))
+            vf = vf.at[write_rows].set(v[0].astype(vf.dtype))
+            attn = _chunk_attention(q, kf[view_rows][None],
+                                    vf[view_rows][None], offs)
+        x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
+        h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
+        x = x + _mlp(h, lp, cfg)
+        if quantized:
+            return x, (kf.reshape(kp.shape), vf.reshape(vp.shape),
+                       ksf.reshape(ksp.shape), vsf.reshape(vsp.shape))
+        return x, (kf.reshape(kp.shape), vf.reshape(vp.shape))
+
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params['layers'], cache.k, cache.v,
+                       cache.k_scale, cache.v_scale))
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params['layers'], cache.k, cache.v))
+        ks_new = vs_new = None
+    logits = _lm_head(params, x, cfg)                        # [1, C, V]
+    last = jnp.take(logits[0], jnp.maximum(n_new - 1, 0),
+                    axis=0)[None]                            # [1, V]
+    new_cache = PagedKVCache(
+        k=k_new, v=v_new,
+        lengths=cache.lengths.at[slot].set(
+            (start + n_new).astype(jnp.int32)),
+        block_tables=cache.block_tables,
+        k_scale=ks_new, v_scale=vs_new)
+    return last, new_cache
+
+
+def paged_decode_step(params: Params, tokens: jax.Array,
+                      cache: PagedKVCache, cfg: ModelConfig,
+                      active: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, PagedKVCache]:
+    """One autoregressive step over the paged pool. tokens: [B] int32.
+
+    Same contract as ``decode_step`` (inactive slots neither write nor
+    advance), but KV rows scatter into the slot's current tail block
+    and attention runs over the block-table-gathered view — the same
+    length-aware decode kernel sees a contiguous [B, T, KVH, D] view,
+    so the Pallas path is unchanged. Inactive slots' writes are routed
+    to the null block (id 0).
+
+    Known headroom (ROADMAP item 2): the per-layer view gather
+    materializes the slot's FULL logical view (blocks_per_slot *
+    block_size rows) before the kernel's length-aware partial read —
+    a fused block-table-aware attention kernel would read only the
+    valid blocks and drop that copy.
+    """
+    b = tokens.shape[0]
+    if active is None:
+        active = jnp.ones((b,), bool)
+    dt = cfg.compute_dtype
+    positions = cache.lengths[:, None]                       # [B, 1]
+    sin, cos = rope_table_for(cfg, positions)
+    x = _embed(params, tokens[:, None], cfg)                 # [B, 1, D]
+
+    bs = cache.block_size
+    bps = cache.blocks_per_slot
+    nb = cache.num_blocks
+    lens = cache.lengths
+    blk = jnp.clip(lens // bs, 0, bps - 1)
+    tail = jnp.take_along_axis(cache.block_tables, blk[:, None],
+                               axis=1)[:, 0]                 # [B]
+    write_rows = jnp.where(active, tail * bs + lens % bs, 0)  # [B]
+    view_rows = _view_rows(cache.block_tables, bs)           # [B, T]
+    n_valid = lens + 1
+    quantized = cache.quantized
+    impl = cfg.decode_attention_impl or cfg.attention_impl
+
+    def layer(carry, scanned):
+        x = carry
+        if quantized:
+            lp, kp, vp, ksp, vsp = scanned
+        else:
+            lp, kp, vp = scanned
+            ksp = vsp = None
+        h = rms_norm(x, lp['ln_attn']['scale'], cfg.norm_eps)
+        q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
+        k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
+        v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        kf = kp.reshape(nb * bs, *kp.shape[2:])
+        vf = vp.reshape(nb * bs, *vp.shape[2:])
+        if quantized:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            kf = kf.at[write_rows].set(k_q[:, 0])
+            vf = vf.at[write_rows].set(v_q[:, 0])
+            ksf = ksp.reshape(nb * bs, -1).at[write_rows].set(k_s[:, 0])
+            vsf = vsp.reshape(nb * bs, -1).at[write_rows].set(v_s[:, 0])
+            k_view_scale = ksf[view_rows]                    # [B, T, KVH]
+            v_view_scale = vsf[view_rows]
+        else:
+            kf = kf.at[write_rows].set(k[:, 0].astype(kf.dtype))
+            vf = vf.at[write_rows].set(v[:, 0].astype(vf.dtype))
+            ksf = vsf = None
+            k_view_scale = v_view_scale = None
+        from skypilot_tpu.ops.pallas.decode_attention import (
+            decode_attention)
+        attn = decode_attention(
+            q, kf[view_rows], vf[view_rows], n_valid,
+            k_scale=k_view_scale, v_scale=v_view_scale, impl=impl)
+        x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
+        h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
+        x = x + _mlp(h, lp, cfg)
+        if quantized:
+            return x, (kf.reshape(kp.shape), vf.reshape(vp.shape),
+                       ksf.reshape(ksp.shape), vsf.reshape(vsp.shape))
+        return x, (kf.reshape(kp.shape), vf.reshape(vp.shape))
+
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params['layers'], cache.k, cache.v,
+                       cache.k_scale, cache.v_scale))
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params['layers'], cache.k, cache.v))
+        ks_new = vs_new = None
+    logits = _lm_head(params, x, cfg)[:, 0]                  # [B, V]
+    new_cache = PagedKVCache(
+        k=k_new, v=v_new,
+        lengths=cache.lengths + active.astype(jnp.int32),
+        block_tables=cache.block_tables,
+        k_scale=ks_new, v_scale=vs_new)
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
 # Sampling + generate loop
 # ---------------------------------------------------------------------------
 

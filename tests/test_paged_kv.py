@@ -1,0 +1,337 @@
+"""Paged KV pool, chunked prefill, and prefix cache.
+
+Covers the three layers of the rebuilt continuous-batching core: the
+host-side block pool (alloc/free/refcount recycling), the digest-chain
+prefix cache (hit produces IDENTICAL output to a cold prefill), chunked
+prefill correctness (multi-chunk prompt == whole-prompt reference), the
+pool-pressure paths (queueing vs clean failure), metrics accounting,
+and a latency-marked smoke asserting decode cadence stays bounded while
+a long prompt is being absorbed in chunks.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+from skypilot_tpu.inference.paged import BlockPool, PrefixCache
+from skypilot_tpu.models import decode as decode_lib
+
+
+# ---------------------------------------------------------------------------
+# Host-side pool + prefix cache (no device work)
+# ---------------------------------------------------------------------------
+
+def test_block_pool_alloc_free_refcount_recycling():
+    pool = BlockPool(5)          # blocks 1..4 allocatable, 0 reserved
+    assert pool.total_blocks == 4 and pool.free_blocks == 4
+    got = [pool.alloc() for _ in range(4)]
+    assert got == [1, 2, 3, 4]   # deterministic order, never the null 0
+    assert pool.alloc() is None  # exhausted
+    # Sharing: a second reference keeps the block out of the free list.
+    pool.incref(2)
+    pool.decref(2)
+    assert pool.free_blocks == 0
+    pool.decref(2)
+    assert pool.free_blocks == 1
+    assert pool.alloc() == 2     # recycled
+    # Double free / bad refs are loud.
+    pool.decref(3)
+    with pytest.raises(ValueError, match='double free'):
+        pool.decref(3)
+    with pytest.raises(ValueError, match='unallocated'):
+        pool.incref(3)
+    with pytest.raises(ValueError, match='unallocated'):
+        pool.incref(0)
+
+
+def test_prefix_cache_chain_lookup_insert_evict():
+    pool = BlockPool(9)
+    cache = PrefixCache(pool, block_size=4)
+    ids = list(range(11))            # 2 full blocks + partial tail
+    blocks = [pool.alloc() for _ in range(3)]
+    cache.insert(ids, blocks)
+    assert cache.cached_blocks == 2  # only FULL blocks are cached
+    assert pool.refcount(blocks[0]) == 2   # slot ref + cache ref
+    assert pool.refcount(blocks[2]) == 1   # partial tail never shared
+    # Full-prefix chain match (capped below the last token).
+    assert cache.lookup(ids, limit_tokens=10) == blocks[:2]
+    pool.decref(blocks[0])
+    pool.decref(blocks[1])
+    # Diverging second block breaks the chain after one block.
+    other = [0, 1, 2, 3, 99, 99, 99, 99]
+    assert cache.lookup(other, limit_tokens=8) == blocks[:1]
+    pool.decref(blocks[0])
+    # limit_tokens caps the match even when more blocks are cached.
+    assert cache.lookup(ids, limit_tokens=4) == blocks[:1]
+    pool.decref(blocks[0])
+    # Eviction releases the cache's block references.
+    for b in blocks:
+        pool.decref(b)               # drop the slot refs
+    assert pool.free_blocks == 6      # tail freed; 2 cached blocks held
+    assert cache.evict_one() and cache.evict_one()
+    assert not cache.evict_one()
+    assert pool.free_blocks == 8
+
+
+def test_prefix_pressure_eviction_skips_blocks_shared_with_slots():
+    """Pool-pressure eviction must only drop entries whose block it
+    alone holds: evicting entries shared with live slots frees nothing
+    and would wipe the reusable prefix chains for no gain."""
+    pool = BlockPool(3)                  # blocks 1..2 allocatable
+    cache = PrefixCache(pool, block_size=4)
+    ids = list(range(8))                 # 2 full blocks
+    blocks = [pool.alloc(), pool.alloc()]
+    cache.insert(ids, blocks)            # cache ref on both (ref 2)
+    assert pool.free_blocks == 0
+    pool.decref(blocks[1])               # "slot" released block 2 only
+    assert cache.reclaimable_blocks == 1
+    assert cache.evict_reclaimable()     # frees the cache-only block
+    assert pool.free_blocks == 1
+    # The surviving entry's block is still held by the "slot": not
+    # evictable under pressure, chain survives.
+    assert not cache.evict_reclaimable()
+    assert cache.cached_blocks == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: chunked prefill + prefix reuse correctness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope='module')
+def paged_engine():
+    eng = ContinuousBatchingEngine('tiny', max_slots=2, max_len=96,
+                                   block_size=8, prefill_chunk=8)
+    yield eng
+    eng.shutdown()
+
+
+def _reference_greedy(engine, ids, max_new_tokens):
+    tokens = jnp.asarray([ids], jnp.int32)
+    lengths = jnp.asarray([len(ids)], jnp.int32)
+    generated, gen_len = decode_lib.generate(
+        engine.params, tokens, lengths, engine.cfg,
+        max_new_tokens=max_new_tokens, temperature=0.0)
+    return list(np.asarray(generated)[0][:int(gen_len[0])])
+
+
+def test_multi_chunk_prefill_matches_whole_prompt(paged_engine):
+    """A 21-token prompt through 8-token chunks (3 chunks, one partial,
+    crossing block boundaries) equals the single-pass reference."""
+    ids = [(7 * i + 3) % 512 for i in range(21)]
+    out = paged_engine.generate_ids(ids, max_new_tokens=8)
+    assert out == _reference_greedy(paged_engine, ids, 8)
+    assert paged_engine.stats()['prefill_chunks'] >= 3
+
+
+def test_block_boundary_prompt_lengths(paged_engine):
+    """Prompt lengths at exact block/chunk multiples are the classic
+    off-by-one spots: first decode write needs a fresh tail block."""
+    for n in (8, 16, 24):
+        ids = [(5 * i + 1) % 512 for i in range(n)]
+        out = paged_engine.generate_ids(ids, max_new_tokens=6)
+        assert out == _reference_greedy(paged_engine, ids, 6), n
+
+
+def test_prefix_cache_hit_identical_output_and_counters(paged_engine):
+    """The second request over a shared prefix reuses cached blocks
+    (no recompute) and MUST produce identical tokens."""
+    ids = [(3 * i + 11) % 512 for i in range(20)]
+    before = paged_engine.stats()
+    first = paged_engine.generate_ids(ids, max_new_tokens=8)
+    mid = paged_engine.stats()
+    second = paged_engine.generate_ids(ids, max_new_tokens=8)
+    after = paged_engine.stats()
+    assert first == second == _reference_greedy(paged_engine, ids, 8)
+    assert mid['prefix_cache_misses'] == before['prefix_cache_misses'] + 1
+    assert after['prefix_cache_hits'] == mid['prefix_cache_hits'] + 1
+    # 20 tokens = 2 full 8-token blocks reusable.
+    assert (after['prefix_tokens_reused'] >=
+            mid['prefix_tokens_reused'] + 16)
+    # The hit skipped the shared blocks' prefill compute: the second
+    # pass only chunks the private suffix (4 tokens = 1 chunk).
+    assert (after['prefill_chunks'] - mid['prefill_chunks'] <
+            mid['prefill_chunks'] - before['prefill_chunks'])
+
+
+def test_shared_prefix_divergent_suffixes_concurrent(paged_engine):
+    """Two live slots referencing the SAME prefix blocks with different
+    private tails — the copy-on-write read path must not cross-talk."""
+    prefix = [(9 * i + 2) % 512 for i in range(16)]
+    a = prefix + [401, 17]
+    b = prefix + [88]
+    paged_engine.generate_ids(prefix + [250], max_new_tokens=2)  # seed cache
+    outs = {}
+
+    def run(name, ids):
+        outs[name] = paged_engine.generate_ids(ids, max_new_tokens=8)
+
+    threads = [threading.Thread(target=run, args=('a', a)),
+               threading.Thread(target=run, args=('b', b))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert outs['a'] == _reference_greedy(paged_engine, a, 8)
+    assert outs['b'] == _reference_greedy(paged_engine, b, 8)
+
+
+def test_stats_block_gauges(paged_engine):
+    stats = paged_engine.stats()
+    assert stats['blocks_total'] == paged_engine.num_blocks - 1
+    assert 0 <= stats['blocks_free'] <= stats['blocks_total']
+    assert 0.0 <= stats['block_occupancy'] <= 1.0
+    assert stats['block_size'] == 8
+    # Accounting invariant: every submitted request is completed,
+    # errored, or still in flight.
+    in_flight = stats['active'] + stats['pending']
+    assert stats['requests'] == (stats['completions'] +
+                                 stats['request_errors'] + in_flight)
+
+
+# ---------------------------------------------------------------------------
+# Pool-pressure paths
+# ---------------------------------------------------------------------------
+
+def test_pool_pressure_queues_requests_not_fails():
+    """More concurrent work than the pool can hold at once: admission
+    waits for blocks instead of failing, and every request completes
+    correctly (HBM oversubscription degrades to queueing)."""
+    eng = ContinuousBatchingEngine('tiny', max_slots=4, max_len=64,
+                                   block_size=8, prefill_chunk=8,
+                                   num_blocks=9,  # 8 usable = 64 tokens
+                                   prefix_cache=False)
+    try:
+        prompts = [[(i * 13 + j) % 512 for j in range(12)]
+                   for i in range(4)]
+        outs = [None] * 4
+
+        def run(i):
+            outs[i] = eng.generate_ids(prompts[i], max_new_tokens=6)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i in range(4):
+            assert outs[i] == _reference_greedy(eng, prompts[i], 6), i
+        stats = eng.stats()
+        assert stats['completions'] == 4
+        assert stats['blocks_free'] == stats['blocks_total']
+        # 4 slots x 3 blocks of demand against 8 usable blocks: the
+        # engine MUST have preempted (and deterministically resumed)
+        # at least one request rather than failing it.
+        assert stats['preemptions'] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_impossible_prompt_fails_cleanly():
+    """A prompt that can NEVER fit the pool fails loudly instead of
+    stalling the queue forever."""
+    eng = ContinuousBatchingEngine('tiny', max_slots=2, max_len=64,
+                                   block_size=8, prefill_chunk=8,
+                                   num_blocks=3)  # 2 usable = 16 tokens
+    try:
+        with pytest.raises(RuntimeError, match='KV blocks'):
+            eng.generate_ids(list(range(30)), max_new_tokens=4,
+                             timeout=30)
+        stats = eng.stats()
+        assert stats['request_errors'] == 1
+        assert stats['blocks_free'] == stats['blocks_total']
+    finally:
+        eng.shutdown()
+
+
+def test_prefill_error_counts_and_frees_blocks(monkeypatch):
+    """ISSUE 7 satellite: a prefill failure must land in the
+    prefill_errors counter, keep requests == completions + errors, and
+    return the slot's blocks to the pool."""
+    # Same shapes as the module fixture: the module-level jit cache
+    # makes this engine build compile-free.
+    eng = ContinuousBatchingEngine('tiny', max_slots=2, max_len=96,
+                                   block_size=8, prefill_chunk=8)
+    try:
+        def boom(*a, **k):
+            raise RuntimeError('injected prefill failure')
+
+        monkeypatch.setattr(eng, '_prefill_fn', boom)
+        with pytest.raises(RuntimeError, match='injected'):
+            eng.generate_ids([1, 2, 3, 4, 5], max_new_tokens=4,
+                             timeout=30)
+        stats = eng.stats()
+        assert stats['prefill_errors'] == 1
+        assert stats['request_errors'] == 1
+        assert stats['requests'] == (stats['completions'] +
+                                     stats['request_errors'])
+        assert stats['blocks_free'] == stats['blocks_total']
+        monkeypatch.undo()
+        # The engine keeps serving after the failure.
+        out = eng.generate_ids([5, 6, 7], max_new_tokens=4)
+        assert out == _reference_greedy(eng, [5, 6, 7], 4)
+    finally:
+        eng.shutdown()
+
+
+def test_queue_wait_metric_advances(paged_engine):
+    before = paged_engine.stats()
+    paged_engine.generate_ids([1, 2, 3], max_new_tokens=2)
+    after = paged_engine.stats()
+    assert after['queue_wait_seconds'] >= before['queue_wait_seconds']
+    assert after['completions'] == before['completions'] + 1
+
+
+# ---------------------------------------------------------------------------
+# Decode cadence under chunked prefill (tier-1 latency smoke)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.latency
+def test_decode_cadence_bounded_while_long_prompt_prefills(paged_engine):
+    """Sarathi property, structurally: while a LONG prompt is being
+    absorbed, an already-decoding request keeps emitting tokens —
+    chunks interleave with decode steps instead of freezing the loop
+    for the whole prefill. Asserted on interleaving order (per-chunk
+    scheduling is deterministic), with only a generous wall-clock
+    sanity bound — never exact timings."""
+    eng = paged_engine
+    chunks_before = eng.stats()['prefill_chunks']
+    long_ids = [(i * 7 + 1) % 512 for i in range(80)]  # 10 chunks
+    short = eng.stream_ids([3, 1, 4, 1], max_new_tokens=40,
+                           timeout=120)
+    first = next(short)                    # short is decoding
+    assert isinstance(first, int)
+    long_done = threading.Event()
+    long_out = {}
+
+    def run_long():
+        long_out['ids'] = eng.generate_ids(long_ids,
+                                           max_new_tokens=2,
+                                           timeout=120)
+        long_done.set()
+
+    thread = threading.Thread(target=run_long)
+    thread.start()
+    interleaved = 0
+    gaps = []
+    last = time.monotonic()
+    for tok in short:
+        now = time.monotonic()
+        gaps.append(now - last)
+        last = now
+        if not long_done.is_set():
+            interleaved += 1
+    thread.join(timeout=120)
+    # The short request made progress DURING the long absorb: with
+    # one chunk per decode step, ~10 chunks must interleave >= a
+    # couple of short-request tokens before the long one finishes.
+    assert interleaved >= 2, (interleaved, gaps)
+    # Generous sanity bound: no single inter-token stall anywhere
+    # near the full-prefill freeze of the old inline path.
+    assert max(gaps) < 5.0, max(gaps)
+    assert len(long_out['ids']) == 2
+    assert eng.stats()['prefill_chunks'] >= chunks_before + 10
