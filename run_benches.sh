@@ -11,6 +11,14 @@ cd "$(dirname "$0")"
 suffix="${1:-r05_measured}"
 export SKYT_BENCH_PROBE_TRIES="${SKYT_BENCH_PROBE_TRIES:-1}"
 
+# Invariant gate first (skylint, docs/static_analysis.md): never burn a
+# tunnel window benchmarking code that fails its own static checks.
+if ! ./tools/lint.sh; then
+  echo "preamble: skylint failed — fix findings (or baseline with a" >&2
+  echo "reviewed reason) before benchmarking" >&2
+  exit 1
+fi
+
 # Orphaned skypilot daemons from prior runs (api server, serve
 # controllers, pool runners, channel brokers) steal CPU and have
 # skewed bench numbers on this image — kill them before measuring.

@@ -241,8 +241,8 @@ def _ship_runtime_to_host(runner: CommandRunner, tarball: str,
     # The import probe catches broken installs on real clusters but
     # costs a ~2s python start per host; test harnesses (which install
     # the very package they run from) may skip it.
-    skip_verify = os.environ.get('SKYT_RUNTIME_SKIP_IMPORT_CHECK',
-                                 '0') not in ('', '0')
+    from skypilot_tpu.utils import env_registry
+    skip_verify = env_registry.get_bool('SKYT_RUNTIME_SKIP_IMPORT_CHECK')
     verify = ('true' if skip_verify
               else f'PYTHONPATH={REMOTE_PKG_DIR} python3 -c '
                    f'"import skypilot_tpu"')

@@ -300,8 +300,8 @@ class TpuPodBackend(Backend):
         if runtime_setup.is_local_style(info):
             return False
         if grace is None:
-            grace = float(os_lib.environ.get('SKYT_DAEMON_START_GRACE',
-                                             '20'))
+            from skypilot_tpu.utils import env_registry
+            grace = env_registry.get_float('SKYT_DAEMON_START_GRACE')
         deadline = time_lib.time() + grace
         while time_lib.time() < deadline:
             time_lib.sleep(2.0)

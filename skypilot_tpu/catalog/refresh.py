@@ -48,7 +48,9 @@ def _feed_url() -> Optional[str]:
 
 
 def _ttl_seconds() -> float:
-    hours = os.environ.get('SKYT_CATALOG_TTL_HOURS')
+    from skypilot_tpu.utils import env_registry
+    hours = env_registry.get_float('SKYT_CATALOG_TTL_HOURS',
+                                   default=None)
     if hours is None:
         from skypilot_tpu import config as config_lib
         hours = config_lib.get_nested(('catalog', 'refresh_ttl_hours'), 24)

@@ -16,7 +16,8 @@ _cache: Dict[str, Tuple[float, Tuple[bool, str]]] = {}
 
 
 def _ttl() -> float:
-    return float(os.environ.get('SKYT_CHECK_CACHE_TTL', 300))
+    from skypilot_tpu.utils import env_registry
+    return env_registry.get_float('SKYT_CHECK_CACHE_TTL')
 
 
 def _check_gcp() -> Tuple[bool, str]:
@@ -35,7 +36,8 @@ def _check_gcp() -> Tuple[bool, str]:
 
 
 def _check_kubernetes() -> Tuple[bool, str]:
-    if os.environ.get('SKYT_K8S_FAKE'):
+    from skypilot_tpu.utils import env_registry
+    if env_registry.get_bool('SKYT_K8S_FAKE'):
         return True, 'fake apiserver (SKYT_K8S_FAKE)'
     from skypilot_tpu.provision.kubernetes import find_kubeconfig
     path = find_kubeconfig()

@@ -24,7 +24,7 @@ from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.app import DEFAULT_PORT
 from skypilot_tpu.spec.dag import Dag
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import log, subprocess_utils
+from skypilot_tpu.utils import env_registry, log, subprocess_utils
 
 logger = log.init_logger(__name__)
 
@@ -190,7 +190,7 @@ _RETRYABLE = (requests_lib.exceptions.ConnectionError,
 
 
 def _retries() -> int:
-    return int(os.environ.get('SKYT_CLIENT_RETRIES', '4'))
+    return env_registry.get_int('SKYT_CLIENT_RETRIES')
 
 
 def _request_with_retries(method: str, url: str, **kwargs: Any):

@@ -60,7 +60,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.server import metrics
-from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils import resilience
@@ -91,10 +91,6 @@ def _is_retryable(exc: BaseException) -> bool:
 
 PUT_SITE = 'data.put_object'
 GET_SITE = 'data.get_object'
-
-
-def _env_int(name: str, default: int) -> int:
-    return common_utils.env_int(name, default, minimum=1)
 
 
 def norm_etag(etag: Optional[str]) -> str:
@@ -429,15 +425,17 @@ class TransferEngine:
                  multipart_threshold: Optional[int] = None,
                  max_attempts: Optional[int] = None,
                  delta: Optional[bool] = None) -> None:
-        self.workers = workers or _env_int('SKYT_TRANSFER_WORKERS', 16)
-        self.part_size = part_size or _env_int('SKYT_TRANSFER_PART_SIZE',
-                                               8 * 1024 * 1024)
-        self.multipart_threshold = multipart_threshold or _env_int(
-            'SKYT_TRANSFER_MULTIPART_THRESHOLD', 2 * self.part_size)
-        self.max_attempts = max_attempts or _env_int(
-            'SKYT_TRANSFER_RETRIES', 4)
+        self.workers = workers or env_registry.get_int(
+            'SKYT_TRANSFER_WORKERS', minimum=1)
+        self.part_size = part_size or env_registry.get_int(
+            'SKYT_TRANSFER_PART_SIZE', minimum=1)
+        self.multipart_threshold = multipart_threshold or \
+            env_registry.get_int('SKYT_TRANSFER_MULTIPART_THRESHOLD',
+                                 default=2 * self.part_size, minimum=1)
+        self.max_attempts = max_attempts or env_registry.get_int(
+            'SKYT_TRANSFER_RETRIES', minimum=1)
         if delta is None:
-            delta = os.environ.get('SKYT_TRANSFER_DELTA', '1') != '0'
+            delta = env_registry.get_bool('SKYT_TRANSFER_DELTA')
         self.delta = delta
 
     # -- shared machinery ----------------------------------------------

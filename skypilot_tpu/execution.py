@@ -15,7 +15,7 @@ from skypilot_tpu.backend.tpu_backend import TpuPodBackend
 from skypilot_tpu.optimizer import Optimizer
 from skypilot_tpu.spec.dag import Dag, DagExecution
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import common_utils, log
+from skypilot_tpu.utils import common_utils, env_registry, log
 
 logger = log.init_logger(__name__)
 
@@ -211,8 +211,8 @@ def _launch_graph(dag: Dag, cluster_name: Optional[str],
     ready = [t.name for t in dag.tasks if pending_parents[t.name] == 0]
     results: dict = {}
     statuses: dict = {}
-    max_workers = max(1, int(os.environ.get('SKYT_DAG_MAX_CONCURRENCY',
-                                            '16')))
+    max_workers = env_registry.get_int('SKYT_DAG_MAX_CONCURRENCY',
+                                       minimum=1)
     with ThreadPoolExecutor(
             max_workers=min(max_workers, len(dag.tasks))) as pool:
         futures = {}
@@ -268,15 +268,14 @@ def _wait_terminal(backend: TpuPodBackend, cluster_name: str,
     can never finish): returns the last status seen, which the caller
     treats as failure. Transient queue/SSH errors are retried; only
     ``SKYT_PIPELINE_POLL_RETRIES`` consecutive failures raise."""
-    import os
     import time
-    interval = float(os.environ.get('SKYT_PIPELINE_POLL_SECONDS', '5'))
-    max_errors = int(os.environ.get('SKYT_PIPELINE_POLL_RETRIES', '10'))
+    interval = env_registry.get_float('SKYT_PIPELINE_POLL_SECONDS')
+    max_errors = env_registry.get_int('SKYT_PIPELINE_POLL_RETRIES')
     # Declare the remote daemon dead only after this much wall-clock
     # (it heartbeats on its own cadence; checking too early races
     # daemon startup on a freshly provisioned cluster).
-    daemon_grace = float(
-        os.environ.get('SKYT_PIPELINE_DAEMON_GRACE_SECONDS', '60'))
+    daemon_grace = env_registry.get_float(
+        'SKYT_PIPELINE_DAEMON_GRACE_SECONDS')
     from skypilot_tpu.provision.api import ClusterInfo
     from skypilot_tpu.runtime.job_client import job_table_for
     from skypilot_tpu.runtime.job_lib import TERMINAL_STATUSES

@@ -153,16 +153,17 @@ class ContinuousBatchingEngine:
         self.max_slots = max_slots
         self.max_len = min(max_len or self.cfg.max_seq_len,
                            self.cfg.max_seq_len)
-        from skypilot_tpu.utils.common_utils import env_int
+        from skypilot_tpu.utils import env_registry
         self.block_size = (block_size or
-                           env_int('SKYT_INFER_BLOCK_SIZE',
-                                   DEFAULT_BLOCK_SIZE))
+                           env_registry.get_int('SKYT_INFER_BLOCK_SIZE',
+                                                default=DEFAULT_BLOCK_SIZE))
         if self.block_size < 1:
             raise ValueError(f'block_size must be >= 1, got '
                              f'{self.block_size}')
         self.prefill_chunk = max(1, min(
-            prefill_chunk or env_int('SKYT_INFER_PREFILL_CHUNK',
-                                     DEFAULT_PREFILL_CHUNK),
+            prefill_chunk or env_registry.get_int(
+                'SKYT_INFER_PREFILL_CHUNK',
+                default=DEFAULT_PREFILL_CHUNK),
             self.max_len))
         self.blocks_per_slot = math.ceil(self.max_len / self.block_size)
         # Default pool = the HBM the monolithic max_slots*max_len cache

@@ -34,6 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from skypilot_tpu.inference.engine import InferenceEngine
+from skypilot_tpu.server import metrics as server_metrics
 from skypilot_tpu.utils import log
 
 logger = log.init_logger(__name__)
@@ -64,15 +65,11 @@ def make_handler(engine: InferenceEngine):
 
         # Monotonic counters vs point-in-time gauges (Prometheus type
         # correctness: rate() over a gauge breaks scrapers/linters).
-        # slots/active/pending and the paged-pool block_* occupancy
-        # stats stay gauges.
-        _COUNTERS = frozenset({'requests', 'tokens_generated',
-                               'decode_seconds', 'completions',
-                               'request_errors', 'prefill_errors',
-                               'prefill_chunks', 'queue_wait_seconds',
-                               'prefix_cache_hits',
-                               'prefix_cache_misses',
-                               'prefix_tokens_reused', 'preemptions'})
+        # The split is declared ONCE, next to the static metric
+        # registry (server/metrics.py) where skylint SKYT003 audits
+        # it; slots/active/pending and the paged-pool block_*
+        # occupancy stats stay gauges.
+        _COUNTERS = server_metrics.INFERENCE_COUNTER_STATS
 
         def do_GET(self):
             if self.path == '/health':
@@ -90,6 +87,11 @@ def make_handler(engine: InferenceEngine):
                     if isinstance(value, (int, float)):
                         kind = ('counter' if key in self._COUNTERS
                                 else 'gauge')
+                        if kind == 'gauge' and key.endswith('_total'):
+                            # A gauge family must not end _total
+                            # (scrapers rate() it): blocks_total is
+                            # the pool CAPACITY, expose it as such.
+                            key = key[:-len('_total')] + '_capacity'
                         name = f'skyt_inference_{key}'
                         if kind == 'counter':
                             name += '_total'

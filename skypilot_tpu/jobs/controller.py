@@ -37,18 +37,19 @@ from skypilot_tpu.provision.api import ClusterInfo, get_provider
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import events
 from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils import resilience
 
 logger = log.init_logger(__name__)
 
-POLL_SECONDS = float(os.environ.get('SKYT_JOBS_CONTROLLER_POLL', '10'))
+POLL_SECONDS = env_registry.get_float('SKYT_JOBS_CONTROLLER_POLL')
 # The CLUSTERS topic is global: every cluster write anywhere wakes every
 # controller. The first wake after a quiet period ticks immediately
 # (preemption -> shrink stays at event latency); bursts are coalesced so
 # one controller never probes its runtime job table more than once per
 # gap, no matter how busy the fleet's cluster table is.
-EVENT_MIN_GAP = float(os.environ.get('SKYT_JOBS_EVENT_MIN_GAP', '0.5'))
+EVENT_MIN_GAP = env_registry.get_float('SKYT_JOBS_EVENT_MIN_GAP')
 # Consecutive failed monitor probes (jobs.controller.monitor faults, DB
 # contention) tolerated before the controller stops trusting its view
 # and degrades to recovery — bounded, so injected faults can never
@@ -197,8 +198,8 @@ class JobController:
         job_groups.publish_hosts(self.job_id, self.cluster_name)
         env = job_groups.barrier_and_env(
             self.record,
-            timeout=float(os.environ.get('SKYT_JOBGROUP_BARRIER_TIMEOUT',
-                                         '1800')))
+            timeout=env_registry.get_float(
+                'SKYT_JOBGROUP_BARRIER_TIMEOUT'))
         # The env lands on the task itself so recoveries (full
         # relaunches) keep the rendezvous map.
         self.task.update_envs(env)

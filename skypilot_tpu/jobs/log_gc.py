@@ -22,7 +22,7 @@ import time
 from typing import Optional
 
 from skypilot_tpu.jobs import state as jobs_state
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 
 logger = log.init_logger(__name__)
 
@@ -32,9 +32,10 @@ _LOG_RE = re.compile(r'^controller-(\d+)\.log$')
 
 
 def retention_seconds() -> float:
-    env = os.environ.get('SKYT_JOBS_LOG_RETENTION_HOURS')
+    env = env_registry.get_float('SKYT_JOBS_LOG_RETENTION_HOURS',
+                                 default=None)
     if env is not None:
-        return float(env) * 3600.0
+        return env * 3600.0
     from skypilot_tpu import config
     hours = config.get_nested(('jobs', 'log_retention_hours'),
                               DEFAULT_RETENTION_HOURS)

@@ -27,6 +27,7 @@ from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
 from skypilot_tpu.provision.provisioner import Blocklist
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils import resilience
@@ -58,8 +59,9 @@ def _record_slices(job_id: int, slices: int) -> None:
 
 
 def _retry_gap(task: Task) -> float:
-    if 'SKYT_JOBS_LAUNCH_RETRY_GAP' in os.environ:
-        return float(os.environ['SKYT_JOBS_LAUNCH_RETRY_GAP'])
+    env = env_registry.get_float('SKYT_JOBS_LAUNCH_RETRY_GAP')
+    if env is not None:
+        return env
     from skypilot_tpu import config
     return float(config.get_nested(
         ('jobs', 'launch_retry_gap'), 20,
@@ -67,8 +69,9 @@ def _retry_gap(task: Task) -> float:
 
 
 def _max_retries(task: Task) -> int:
-    if 'SKYT_JOBS_MAX_LAUNCH_RETRIES' in os.environ:
-        return int(os.environ['SKYT_JOBS_MAX_LAUNCH_RETRIES'])
+    env = env_registry.get_int('SKYT_JOBS_MAX_LAUNCH_RETRIES')
+    if env is not None:
+        return env
     from skypilot_tpu import config
     return int(config.get_nested(
         ('jobs', 'max_launch_retries'), 30,

@@ -28,7 +28,7 @@ import sys
 import psutil
 
 from skypilot_tpu.jobs import state as jobs_state
-from skypilot_tpu.utils import log, subprocess_utils
+from skypilot_tpu.utils import env_registry, log, subprocess_utils
 
 logger = log.init_logger(__name__)
 
@@ -36,15 +36,17 @@ logger = log.init_logger(__name__)
 def _max_launching() -> int:
     """Env > config > default (ref: controller CPU-bounded limits)."""
     from skypilot_tpu import config
-    if 'SKYT_JOBS_MAX_LAUNCHING' in os.environ:
-        return int(os.environ['SKYT_JOBS_MAX_LAUNCHING'])
+    env = env_registry.get_int('SKYT_JOBS_MAX_LAUNCHING')
+    if env is not None:
+        return env
     return int(config.get_nested(('jobs', 'max_launching'), 8))
 
 
 def _max_alive() -> int:
     from skypilot_tpu import config
-    if 'SKYT_JOBS_MAX_ALIVE' in os.environ:
-        return int(os.environ['SKYT_JOBS_MAX_ALIVE'])
+    env = env_registry.get_int('SKYT_JOBS_MAX_ALIVE')
+    if env is not None:
+        return env
     return int(config.get_nested(('jobs', 'max_alive'), 64))
 
 
@@ -178,8 +180,9 @@ def job_done(job_id: int) -> None:
 
 def _controller_max_restarts() -> int:
     from skypilot_tpu import config
-    if 'SKYT_JOBS_CONTROLLER_MAX_RESTARTS' in os.environ:
-        return int(os.environ['SKYT_JOBS_CONTROLLER_MAX_RESTARTS'])
+    env = env_registry.get_int('SKYT_JOBS_CONTROLLER_MAX_RESTARTS')
+    if env is not None:
+        return env
     return int(config.get_nested(('jobs', 'controller_max_restarts'), 3))
 
 

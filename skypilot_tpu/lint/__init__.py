@@ -1,0 +1,27 @@
+"""skylint: AST-based invariant checker for the control plane.
+
+``python -m skypilot_tpu.lint`` runs eight passes over the package
+(stdlib ``ast`` only) and exits non-zero on any non-baselined finding:
+
+=======  ==========================================================
+SKYT001  blocking call inside ``async def`` (event-loop stalls)
+SKYT002  SKYT_* env knob not in the typed registry (+ dead knobs)
+SKYT003  skyt_* metric family/type/label drift vs server/metrics.py
+SKYT004  chaos-site cross-check (dead sites, tests on ghost sites)
+SKYT005  event-bus topic cross-check (no-subscriber / no-publisher)
+SKYT006  lock-acquisition-order cycles (potential deadlocks)
+SKYT007  sqlite dialect portability (RETURNING / ON CONFLICT)
+SKYT008  host-side effects inside jitted functions
+=======  ==========================================================
+
+``SKYT000`` is the runner's own meta code (parse errors, stale or
+unreviewed baseline entries, generated docs out of sync).
+
+See ``docs/static_analysis.md`` for the checker catalogue and the
+baseline workflow; ``tests/test_skylint.py`` gates tier-1 on a clean
+run.
+"""
+from skypilot_tpu.lint.core import (Context, Finding, all_checkers,
+                                    run_checks)
+
+__all__ = ['Context', 'Finding', 'all_checkers', 'run_checks']

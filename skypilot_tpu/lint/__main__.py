@@ -1,0 +1,154 @@
+"""skylint CLI.
+
+Usage::
+
+    python -m skypilot_tpu.lint                 # human output, exit 1
+                                                # on active findings
+    python -m skypilot_tpu.lint --json          # machine output for CI
+    python -m skypilot_tpu.lint --write-baseline  # snapshot current
+                                                # findings as
+                                                # UNREVIEWED entries
+    python -m skypilot_tpu.lint --dump-env-docs  # docs/env_vars.md to
+                                                # stdout
+
+The baseline path comes from ``[tool.skylint] baseline = "..."`` in
+pyproject.toml (default ``lint_baseline.json`` at the repo root). A
+default run also verifies the committed ``docs/env_vars.md`` matches
+the env-registry table (SKYT000 finding when it drifts).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+from skypilot_tpu.lint import core
+from skypilot_tpu.utils import env_registry
+
+ENV_DOCS_REL = os.path.join('docs', 'env_vars.md')
+
+
+def baseline_path_from_pyproject(repo_root: str) -> str:
+    """``[tool.skylint] baseline`` (tomllib is 3.11+; a targeted regex
+    keeps the linter runnable on the 3.10 runners)."""
+    default = os.path.join(repo_root, 'lint_baseline.json')
+    pyproject = os.path.join(repo_root, 'pyproject.toml')
+    try:
+        with open(pyproject, encoding='utf-8') as f:
+            text = f.read()
+    except OSError:
+        return default
+    section = re.search(r'^\[tool\.skylint\]\s*$(.*?)(?=^\[|\Z)', text,
+                        re.M | re.S)
+    if not section:
+        return default
+    match = re.search(r'^baseline\s*=\s*"([^"]+)"', section.group(1),
+                      re.M)
+    if not match:
+        return default
+    return os.path.join(repo_root, match.group(1))
+
+
+def check_env_docs(repo_root: str) -> List[core.Finding]:
+    """SKYT000 when the committed generated doc drifts from the
+    registry table."""
+    path = os.path.join(repo_root, ENV_DOCS_REL)
+    expected = env_registry.render_docs()
+    try:
+        with open(path, encoding='utf-8') as f:
+            actual = f.read()
+    except OSError:
+        return [core.Finding(
+            core.META_CODE, ENV_DOCS_REL, 0,
+            'generated env-var doc is missing — run `python -m '
+            'skypilot_tpu.lint --dump-env-docs > docs/env_vars.md`',
+            slug='env-docs-missing')]
+    if actual != expected:
+        return [core.Finding(
+            core.META_CODE, ENV_DOCS_REL, 0,
+            'generated env-var doc is out of sync with '
+            'utils/env_registry.py — regenerate with `python -m '
+            'skypilot_tpu.lint --dump-env-docs > docs/env_vars.md`',
+            slug='env-docs-stale')]
+    return []
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.lint',
+        description='AST-based invariant checker for the skypilot-tpu '
+                    'control plane (SKYT001..SKYT008).')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the JSON report (what CI consumes)')
+    parser.add_argument('--baseline', default=None,
+                        help='baseline file override (default: '
+                             '[tool.skylint] in pyproject.toml)')
+    parser.add_argument('--no-baseline', action='store_true',
+                        help='ignore the baseline (show everything)')
+    parser.add_argument('--write-baseline', action='store_true',
+                        help='snapshot active findings as UNREVIEWED '
+                             'suppressions (each must then be '
+                             'justified or fixed)')
+    parser.add_argument('--dump-env-docs', action='store_true',
+                        help='print generated docs/env_vars.md and '
+                             'exit')
+    parser.add_argument('--root', default=None,
+                        help='repo root override (tests)')
+    args = parser.parse_args(argv)
+
+    if args.dump_env_docs:
+        sys.stdout.write(env_registry.render_docs())
+        return 0
+
+    repo_root = args.root or core.find_repo_root()
+    package_files, test_files, doc_files = core.repo_paths(repo_root)
+    ctx = core.Context(repo_root, package_files, test_files, doc_files)
+    findings = core.run_checks(ctx)
+    findings.extend(check_env_docs(repo_root))
+
+    baseline_path = args.baseline or baseline_path_from_pyproject(
+        repo_root)
+    if args.write_baseline:
+        count = core.write_baseline(findings, baseline_path)
+        print(f'wrote {count} UNREVIEWED suppressions to '
+              f'{baseline_path} — justify or fix each before '
+              'committing')
+        return 0
+    if not args.no_baseline:
+        try:
+            entries = core.load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f'error: bad baseline {baseline_path}: {e}',
+                  file=sys.stderr)
+            return 2
+        findings = core.apply_baseline(findings, entries, baseline_path)
+        findings.sort(key=lambda f: (f.path, f.line, f.code, f.slug))
+
+    active = [f for f in findings if not f.baselined]
+    if args.json:
+        report = {
+            'version': 1,
+            'findings': [f.to_json() for f in findings],
+            'summary': {
+                'files_scanned': len(ctx.package_modules),
+                'active': len(active),
+                'baselined': len(findings) - len(active),
+            },
+        }
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write('\n')
+    else:
+        for finding in findings:
+            print(finding.render())
+        baselined = len(findings) - len(active)
+        print(f'skylint: {len(ctx.package_modules)} files, '
+              f'{len(active)} active finding(s), {baselined} '
+              'baselined')
+    return 1 if active else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

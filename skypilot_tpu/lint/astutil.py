@@ -1,0 +1,106 @@
+"""Shared AST helpers for the skylint passes (stdlib ``ast`` only)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Dotted source name for a Name/Attribute chain ('os.environ.get'),
+    None for anything dynamic (subscripts, calls, lambdas)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_head(node: ast.AST) -> Optional[str]:
+    """Leading literal text of an f-string / string concatenation, for
+    prefix-pattern matching (f'SKYT_RANK_{x}' -> 'SKYT_RANK_')."""
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return const_str(node.values[0])
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return const_str(node.left) or fstring_head(node.left)
+    return None
+
+
+def import_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> fully qualified module/attr it was imported as.
+
+    ``from skypilot_tpu.server import metrics`` -> {'metrics':
+    'skypilot_tpu.server.metrics'}; ``import os`` -> {'os': 'os'};
+    ``from x import y as z`` -> {'z': 'x.y'}.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split('.')[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = (
+                    f'{node.module}.{alias.name}')
+    return out
+
+
+def resolve_call(func: ast.AST, imports: Dict[str, str]
+                 ) -> Optional[str]:
+    """Fully-qualified dotted name of a call target, resolving the
+    leading segment through the module's imports."""
+    name = dotted(func)
+    if name is None:
+        return None
+    head, _, rest = name.partition('.')
+    base = imports.get(head)
+    if base is None:
+        return name
+    return f'{base}.{rest}' if rest else base
+
+
+def walk_strings(tree: ast.AST) -> Iterator[Tuple[str, int]]:
+    """All string constants (including f-string literal parts) with
+    their line numbers."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node.lineno
+
+
+def docstring_nodes(tree: ast.AST) -> set:
+    """id()s of docstring Constant nodes (module/class/function), so
+    passes can skip prose."""
+    out = set()
+    nodes = [tree] if isinstance(tree, ast.Module) else []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            nodes.append(node)
+    for node in nodes:
+        body = getattr(node, 'body', [])
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            out.add(id(body[0].value))
+    return out
+
+
+class ParentedVisit:
+    """ast.walk with a parent map, built lazily once per tree."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))

@@ -1,0 +1,99 @@
+"""SKYT001 — blocking call inside ``async def``.
+
+The serve data plane runs ONE event loop per service process
+(serve/load_balancer.py): a single synchronous call — ``time.sleep``,
+an sqlite query through the state stores, ``subprocess.run`` — freezes
+every in-flight stream through the proxy at once. PR-4's review caught
+one of these by hand; this pass makes the catch permanent for every
+current and future async module.
+
+Flagged inside any ``async def`` (including sync helpers lexically
+nested in one — they execute on the loop when called):
+
+* ``time.sleep`` (use ``asyncio.sleep``);
+* subprocess entry points (``run``/``call``/``check_call``/
+  ``check_output``/``getoutput``/``Popen``, ``os.system``);
+* ``sqlite3.connect`` and ANY call into the synchronous DB/state
+  layers (requests_db, serve_state, jobs/runtime/users state stores,
+  the pg adapter, distributed locks) — these block on I/O and file
+  locks (route through ``loop.run_in_executor`` instead);
+* blocking socket/HTTP constructors (``socket.create_connection``,
+  ``urllib.request.urlopen``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT001'
+
+# Exact fully-qualified call targets that block the loop.
+BLOCKING_CALLS = frozenset({
+    'time.sleep',
+    'os.system',
+    'os.popen',
+    'subprocess.run',
+    'subprocess.call',
+    'subprocess.check_call',
+    'subprocess.check_output',
+    'subprocess.getoutput',
+    'subprocess.Popen',
+    'sqlite3.connect',
+    'socket.create_connection',
+    'urllib.request.urlopen',
+})
+
+# Any call into these modules is synchronous DB/lock I/O.
+BLOCKING_MODULES = (
+    'skypilot_tpu.server.requests_db',
+    'skypilot_tpu.serve.serve_state',
+    'skypilot_tpu.jobs.state',
+    'skypilot_tpu.runtime.job_lib',
+    'skypilot_tpu.users.users_db',
+    'skypilot_tpu.utils.pg',
+    'skypilot_tpu.utils.locks',
+    'skypilot_tpu.state',
+)
+
+
+class AsyncBlockingChecker:
+    code = CODE
+    name = 'blocking call in async def'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            imports = astutil.import_map(mod.tree)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    yield from self._check_async_fn(mod, node, imports)
+
+    def _check_async_fn(self, mod, fn: ast.AsyncFunctionDef,
+                        imports) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            target = astutil.resolve_call(node.func, imports)
+            if target is None:
+                continue
+            reason = self._blocking_reason(target)
+            if reason:
+                yield Finding(
+                    CODE, mod.rel, node.lineno,
+                    f'blocking call {target}() inside async def '
+                    f'{fn.name}() {reason}',
+                    slug=f'{fn.name}:{target}')
+
+    @staticmethod
+    def _blocking_reason(target: str) -> str:
+        if target in BLOCKING_CALLS:
+            if target == 'time.sleep':
+                return '(use asyncio.sleep)'
+            return '(stalls the event loop; run it in an executor)'
+        for module in BLOCKING_MODULES:
+            if target.startswith(module + '.'):
+                return ('(synchronous DB/lock I/O; use '
+                        'loop.run_in_executor)')
+        return ''

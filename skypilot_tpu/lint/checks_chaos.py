@@ -1,0 +1,165 @@
+"""SKYT004 — chaos coverage cross-check: fault-injection sites in code
+vs the sites the chaos suites and docs actually target.
+
+Two failure modes, both historically silent:
+
+* a test (or doc example) targets a site string that no
+  ``fault_injection.inject(...)`` call implements — the chaos test is
+  vacuously green (the PR-2 design made malformed *specs* raise, but a
+  well-formed spec naming a nonexistent site injects nothing);
+* an instrumented site exists in code but nothing references it — the
+  failure path has no chaos coverage and the operator docs don't know
+  the site exists.
+
+Site collection from code: literal ``inject('site')`` args; f-string
+args (``inject(f'events.publish.{name}')``) become prefix patterns
+(``events.publish.*``); variable args are resolved through
+module-level string constants that look like sites (the transfer
+engine's ``PUT_SITE = 'data.put_object'`` idiom).
+
+Reference collection: spec-clause strings (``site:Exception[...]``) in
+test sources and docs, the first argument of the ``clause(...)`` test
+helper, plus any bare string/backtick token exactly equal to a known
+code site.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT004'
+
+SITE_RE = re.compile(r'^[a-z_][a-z0-9_]*(\.[a-z0-9_]+)+$')
+CLAUSE_RE = re.compile(
+    r'([a-z_][a-z0-9_]*(?:\.[a-z0-9_*]+)+|[a-z0-9_.]*\*)'
+    r':(?:OperationalError|PgError|OSError|ConnectionError|'
+    r'TimeoutError|Exception)\b')
+BACKTICK_RE = re.compile(r'`([a-z_][a-z0-9_]*(?:\.[a-z0-9_*]+)+)`')
+
+
+class ChaosCoverageChecker:
+    code = CODE
+    name = 'SKYT_FAULT_SPEC site coverage'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        # site/pattern -> first (rel, line) where inject() implements it
+        sites: Dict[str, Tuple[str, int]] = {}
+        for mod in ctx.package_modules:
+            for site, line in self._code_sites(mod):
+                sites.setdefault(site, (mod.rel, line))
+
+        def implemented(ref: str) -> bool:
+            if ref in sites:
+                return True
+            if ref.endswith('*'):
+                prefix = ref[:-1]
+                return any(s.startswith(prefix) or
+                           (s.endswith('*') and s[:-1].startswith(prefix))
+                           for s in sites)
+            return any(s.endswith('*') and ref.startswith(s[:-1])
+                       for s in sites)
+
+        covered: set = set()
+
+        def cover(ref: str) -> None:
+            for site in sites:
+                if site == ref:
+                    covered.add(site)
+                elif site.endswith('*') and ref.startswith(site[:-1]):
+                    covered.add(site)
+                elif ref.endswith('*') and site.startswith(ref[:-1]):
+                    covered.add(site)
+
+        # Validated references: spec clauses + clause() helper args.
+        for rel, refs in self._references(ctx):
+            for ref, line, validated in refs:
+                if validated and not implemented(ref):
+                    yield Finding(
+                        CODE, rel, line,
+                        f'chaos reference targets nonexistent fault '
+                        f'site {ref!r} (no fault_injection.inject() '
+                        'implements it — the test injects nothing)',
+                        slug=f'nonexistent:{ref}')
+                cover(ref)
+
+        for site in sorted(sites):
+            if site not in covered:
+                rel, line = sites[site]
+                yield Finding(
+                    CODE, rel, line,
+                    f'fault site {site!r} has no chaos test or doc '
+                    'reference (dead site: its failure path is never '
+                    'exercised)', slug=f'dead:{site}')
+
+    # -- collection -----------------------------------------------------
+
+    def _code_sites(self, mod) -> Iterator[Tuple[str, int]]:
+        module_strings = None
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, (ast.Attribute, ast.Name))
+                    and (astutil.dotted(node.func) or ''
+                         ).split('.')[-1] == 'inject'
+                    and node.args):
+                continue
+            arg = node.args[0]
+            literal = astutil.const_str(arg)
+            if literal is not None:
+                if SITE_RE.match(literal):
+                    yield literal, node.lineno
+                continue
+            head = astutil.fstring_head(arg)
+            if head is not None:
+                if head.endswith('.'):
+                    yield head + '*', node.lineno
+                continue
+            # Variable arg: fall back to module-level site constants.
+            if module_strings is None:
+                module_strings = self._module_site_constants(mod)
+            for site, line in module_strings:
+                yield site, line
+
+    @staticmethod
+    def _module_site_constants(mod) -> List[Tuple[str, int]]:
+        out = []
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                    and SITE_RE.match(node.value.value)):
+                out.append((node.value.value, node.lineno))
+        return out
+
+    def _references(self, ctx: Context):
+        """Per source: [(ref, line, validated)] — validated refs must
+        resolve to an implemented site; unvalidated ones (bare exact
+        matches) only count as coverage."""
+        for mod in ctx.test_modules:
+            refs: List[Tuple[str, int, bool]] = []
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Call)
+                        and (astutil.dotted(node.func) or ''
+                             ).split('.')[-1] == 'clause'
+                        and node.args):
+                    literal = astutil.const_str(node.args[0])
+                    if literal is not None:
+                        refs.append((literal, node.lineno, True))
+            for text, line in astutil.walk_strings(mod.tree):
+                for match in CLAUSE_RE.finditer(text):
+                    refs.append((match.group(1), line, True))
+                if SITE_RE.match(text) or (
+                        text.endswith('*')
+                        and SITE_RE.match(text[:-1] + 'x')):
+                    refs.append((text, line, False))
+            yield mod.rel, refs
+        for rel, text in ctx.doc_texts.items():
+            refs = []
+            for match in CLAUSE_RE.finditer(text):
+                refs.append((match.group(1), 0, True))
+            for match in BACKTICK_RE.finditer(text):
+                refs.append((match.group(1), 0, False))
+            yield rel, refs

@@ -1,0 +1,209 @@
+"""SKYT006 — lock-acquisition-order graph (lockdep-lite).
+
+The control plane holds 30+ ``threading.Lock``s across three
+concurrency regimes; nothing enforces a consistent acquisition order,
+and an inverted pair deadlocks only under the exact interleaving a
+chaos run may never hit. This pass builds a directed
+acquired-while-holding graph from lexical ``with`` nesting and reports
+cycles.
+
+Lock identity (conservative, per-module — two modules' ``_lock``s are
+distinct):
+
+* module-level ``X = threading.Lock()/RLock()``      -> ``mod:X``
+* ``self._x = threading.Lock()`` in class ``C``      -> ``mod:C._x``
+* function-local ``x = threading.Lock()``            -> ``mod:fn.x``
+
+Edges come from ``with A: ... with B:`` nesting inside one function
+body (including ``with A, B:`` multi-item forms, left to right).
+Cross-function holds (call a lock-taking helper while holding a lock)
+are out of scope — the graph under-approximates, so every reported
+cycle is a real ordering inversion in the source.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT006'
+
+LOCK_CTORS = frozenset({'threading.Lock', 'threading.RLock'})
+
+
+class LockOrderChecker:
+    code = CODE
+    name = 'lock acquisition order'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        # edge (a, b): b acquired while holding a; value = first site.
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for mod in ctx.package_modules:
+            self._collect_module(mod, edges)
+        yield from self._report_cycles(edges)
+
+    # -- collection -----------------------------------------------------
+
+    def _collect_module(self, mod, edges) -> None:
+        imports = astutil.import_map(mod.tree)
+
+        def is_lock_ctor(node: ast.AST) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            return astutil.resolve_call(node.func, imports) in LOCK_CTORS
+
+        module_locks: Set[str] = set()
+        class_locks: Dict[str, Set[str]] = {}
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and is_lock_ctor(node.value)):
+                module_locks.add(node.targets[0].id)
+            elif isinstance(node, ast.ClassDef):
+                attrs: Set[str] = set()
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Assign)
+                            and len(sub.targets) == 1
+                            and isinstance(sub.targets[0], ast.Attribute)
+                            and isinstance(sub.targets[0].value, ast.Name)
+                            and sub.targets[0].value.id == 'self'
+                            and is_lock_ctor(sub.value)):
+                        attrs.add(sub.targets[0].attr)
+                if attrs:
+                    class_locks[node.name] = attrs
+
+        # Walk every function with (class, function) context.
+        def visit_scope(body, class_name: Optional[str],
+                        fn_name: Optional[str]) -> None:
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    visit_scope(node.body, node.name, None)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    local_locks = {
+                        t.targets[0].id
+                        for t in ast.walk(node)
+                        if isinstance(t, ast.Assign)
+                        and len(t.targets) == 1
+                        and isinstance(t.targets[0], ast.Name)
+                        and is_lock_ctor(t.value)}
+
+                    def resolve(expr: ast.AST) -> Optional[str]:
+                        name = astutil.dotted(expr)
+                        if name is None:
+                            return None
+                        if name.startswith('self.') and class_name:
+                            attr = name[len('self.'):]
+                            if attr in class_locks.get(class_name, ()):
+                                return f'{mod.rel}:{class_name}.{attr}'
+                            return None
+                        if name in local_locks:
+                            return f'{mod.rel}:{node.name}.{name}'
+                        if name in module_locks:
+                            return f'{mod.rel}:{name}'
+                        return None
+
+                    self._walk_withs(node.body, [], resolve, mod, edges)
+                    visit_scope(node.body, class_name, node.name)
+
+        visit_scope(mod.tree.body, None, None)
+
+    def _walk_withs(self, body: List[ast.stmt], held: List[str],
+                    resolve, mod, edges) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in stmt.items:
+                    lock = resolve(item.context_expr)
+                    if lock is None:
+                        continue
+                    for holder in held + acquired:
+                        if holder != lock:
+                            edges.setdefault(
+                                (holder, lock), (mod.rel, stmt.lineno))
+                    acquired.append(lock)
+                self._walk_withs(stmt.body, held + acquired, resolve,
+                                 mod, edges)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                continue   # new scope: handled by visit_scope
+            else:
+                for field in ('body', 'orelse', 'finalbody'):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        self._walk_withs(sub, held, resolve, mod, edges)
+                for handler in getattr(stmt, 'handlers', ()) or ():
+                    self._walk_withs(handler.body, held, resolve, mod,
+                                     edges)
+
+    # -- cycle detection ------------------------------------------------
+
+    def _report_cycles(self, edges) -> Iterator[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        # Tarjan SCC, iterative.
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+
+        def strongconnect(root: str):
+            work = [(root, iter(sorted(graph[root])))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for succ in it:
+                    if succ not in index:
+                        index[succ] = low[succ] = counter[0]
+                        counter[0] += 1
+                        stack.append(succ)
+                        on_stack.add(succ)
+                        work.append((succ, iter(sorted(graph[succ]))))
+                        advanced = True
+                        break
+                    if succ in on_stack:
+                        low[node] = min(low[node], index[succ])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        member = stack.pop()
+                        on_stack.discard(member)
+                        scc.append(member)
+                        if member == node:
+                            break
+                    if len(scc) > 1 or (node, node) in edges:
+                        yield tuple(sorted(scc))
+
+        sccs = []
+        for node in sorted(graph):
+            if node not in index:
+                sccs.extend(strongconnect(node))
+        for scc in sccs:
+            if scc in seen_cycles:
+                continue
+            seen_cycles.add(scc)
+            rel, line = next(
+                (edges[(a, b)] for a in scc for b in scc
+                 if (a, b) in edges), ('?', 0))
+            yield Finding(
+                CODE, rel, line,
+                'lock-order cycle (potential deadlock): '
+                + ' <-> '.join(scc)
+                + ' — pick one acquisition order and stick to it',
+                slug='cycle:' + '|'.join(scc))

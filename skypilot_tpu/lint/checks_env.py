@@ -1,0 +1,131 @@
+"""SKYT002 — every ``SKYT_*`` env reference resolves against the typed
+declaration table (``skypilot_tpu/utils/env_registry.py``).
+
+The registry is the single source of truth for the platform's ~100
+knobs: name, type, default, doc. This pass collects every place the
+package touches a SKYT_* name — a string literal that IS exactly a
+``SKYT_*`` token (or an f-string with a ``SKYT_..._`` literal head) in
+any *structured* position:
+
+* a call argument (``os.environ.get('X')``, ``os.getenv``, the typed
+  ``env_registry.get_*`` accessors, helper calls like ``pick(...)``);
+* a subscript key (``os.environ['X']`` reads AND ``envs['X'] = ...``
+  child-environment construction — a typo here ships a knob nobody
+  reads) or a dict-literal key;
+* an ``'X' in os.environ`` membership test;
+* a module-level name constant (``SPEC_ENV = 'SKYT_FAULT_SPEC'``);
+
+prose (docstrings, embedded shell/JS text) never fullmatches, so it
+never counts. Any collected name with no declaration is flagged. It also
+flags declarations nothing references (dead knobs rot docs), except
+those marked ``external=True`` (consumed by recipe payloads / shell
+templates outside the package's python sources).
+
+The committed ``docs/env_vars.md`` is generated from the same table;
+the in-sync check lives in the runner (SKYT000) so CI fails when the
+table changes without regenerating the doc.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+from skypilot_tpu.utils import env_registry
+
+CODE = 'SKYT002'
+
+ENV_NAME_RE = re.compile(r'^SKYT_[A-Z0-9_]+$')
+ENV_PREFIX_RE = re.compile(r'^SKYT_[A-Z0-9_]*_$')
+
+
+class EnvRegistryChecker:
+    code = CODE
+    name = 'SKYT_* env registry'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        referenced: Dict[str, List[Tuple[str, int]]] = {}
+
+        def note(name: str, mod, line: int) -> None:
+            referenced.setdefault(name, []).append((mod.rel, line))
+
+        for mod in ctx.package_modules:
+            for node in ast.walk(mod.tree):
+                for name, line in self._env_names(node):
+                    note(name, mod, line)
+
+        # Undeclared references.
+        for name in sorted(referenced):
+            if env_registry.lookup(name) is not None:
+                continue
+            # Prefix references (f-string heads) resolve through
+            # patterns only; a concrete declared name that extends the
+            # prefix is NOT enough — the suffix space is unbounded.
+            rel, line = referenced[name][0]
+            kind = 'dynamic prefix' if name.endswith('_') else 'knob'
+            yield Finding(
+                CODE, rel, line,
+                f'undeclared SKYT_* {kind} {name!r}: declare it in '
+                'skypilot_tpu/utils/env_registry.py (name, type, '
+                'default, doc)',
+                slug=f'undeclared:{name}')
+
+        # Declarations nothing references.
+        reg_mod = ctx.module('utils/env_registry.py')
+        for var in env_registry.DECLARATIONS:
+            if var.external:
+                continue
+            if var.is_pattern:
+                prefix = var.name[:-1]
+                hit = any(n.startswith(prefix) for n in referenced)
+            else:
+                hit = var.name in referenced
+            if not hit:
+                yield Finding(
+                    CODE, reg_mod.rel if reg_mod else
+                    'skypilot_tpu/utils/env_registry.py', 0,
+                    f'declared knob {var.name} is never referenced in '
+                    'the package (delete the declaration or mark it '
+                    'external=True)',
+                    slug=f'unreferenced:{var.name}')
+
+    def _env_names(self, node: ast.AST) -> Iterator[Tuple[str, int]]:
+        """SKYT_* names/prefixes referenced by this node."""
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [
+                    kw.value for kw in node.keywords]:
+                yield from self._name_arg(arg)
+        elif isinstance(node, ast.Subscript):
+            # os.environ['X'] (read/write/del) and env-dict builds
+            # (envs['SKYT_X'] = ...). Non-SKYT keys are ignored.
+            yield from self._name_arg(node.slice, line=node.lineno)
+        elif isinstance(node, ast.Dict):
+            for key in node.keys:
+                if key is not None:
+                    yield from self._name_arg(key)
+        elif isinstance(node, ast.Compare):
+            # 'X' in os.environ
+            if (len(node.ops) == 1 and isinstance(node.ops[0], ast.In)
+                    and (astutil.dotted(node.comparators[0]) or ''
+                         ).endswith('environ')):
+                yield from self._name_arg(node.left)
+        elif isinstance(node, ast.Assign):
+            # Module/class-level env-name constants:
+            # SPEC_ENV = 'SKYT_FAULT_SPEC'.
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                yield from self._name_arg(node.value)
+
+    def _name_arg(self, node: ast.AST, line: int = 0
+                  ) -> Iterator[Tuple[str, int]]:
+        lineno = getattr(node, 'lineno', line)
+        literal = astutil.const_str(node)
+        if literal is not None:
+            if ENV_NAME_RE.match(literal):
+                yield literal, lineno
+            return
+        head = astutil.fstring_head(node)
+        if head is not None and ENV_PREFIX_RE.match(head):
+            yield head, lineno

@@ -1,0 +1,156 @@
+"""SKYT005 — event-bus topic cross-check.
+
+Topics are declared once in ``utils/events.py`` (module-level
+UPPER_CASE string constants). Writers ``events.publish(topic)`` after
+commit; consumers ``events.wait_for(topic, ...)`` / ``events.cursor``
+/ ``events.external_signal(..., topic)``. The bus carries no payloads,
+so a topic mismatch never errors — it just degrades that loop to its
+fallback poll forever. This pass flags:
+
+* publish/wait of a topic that is not declared in utils/events.py
+  (string-literal topics included: a typo'd literal silently makes a
+  private topic nobody else sees);
+* a declared topic that is published but never referenced anywhere
+  else (publish-without-subscriber — every write pays notify cost for
+  a wakeup nobody gets);
+* a topic waited on but never published (wait-on-never-published —
+  that consumer lives on its fallback interval and the event layer is
+  dead weight).
+
+Consumer references are counted structurally (wait_for/cursor/
+external_signal args) AND as any other ``events.TOPIC`` attribute use
+(daemon constructors take ``topic=events.MANAGED_JOBS``), so indirect
+subscriptions register.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set, Tuple
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT005'
+
+EVENTS_MODULE = 'utils/events.py'
+PUBLISH_FNS = frozenset({'publish'})
+WAIT_FNS = frozenset({'wait_for', 'cursor', 'external_cursor'})
+
+
+def declared_topics(events_mod) -> Dict[str, str]:
+    """CONST name -> topic string, from utils/events.py."""
+    out: Dict[str, str] = {}
+    for node in events_mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.isupper()
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            name = node.targets[0].id
+            # Skip non-topic string constants (env-var names etc.).
+            if name.endswith('_ENV') or name == 'SOURCES':
+                continue
+            out[name] = node.value.value
+    return out
+
+
+class EventTopicChecker:
+    code = CODE
+    name = 'event-bus topic cross-check'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        events_mod = ctx.module(EVENTS_MODULE)
+        if events_mod is None:
+            return
+        consts = declared_topics(events_mod)
+        topics = set(consts.values())
+
+        published: Dict[str, Tuple[str, int]] = {}
+        waited: Dict[str, Tuple[str, int]] = {}
+        referenced: Set[str] = set()
+
+        for mod in ctx.package_modules:
+            if mod is events_mod:
+                continue
+            imports = astutil.import_map(mod.tree)
+            publish_args: Set[int] = set()
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = astutil.resolve_call(node.func, imports) or ''
+                leaf = target.split('.')[-1]
+                if not target.startswith('skypilot_tpu.utils.events.'):
+                    continue
+                if leaf in PUBLISH_FNS and node.args:
+                    topic, ok = self._topic_of(node.args[0], consts)
+                    publish_args.add(id(node.args[0]))
+                    if topic is not None:
+                        published.setdefault(topic,
+                                             (mod.rel, node.lineno))
+                        if not ok:
+                            yield Finding(
+                                CODE, mod.rel, node.lineno,
+                                f'publish of undeclared topic '
+                                f'{topic!r} — declare it as a constant '
+                                'in utils/events.py',
+                                slug=f'undeclared:{topic}')
+                elif leaf in WAIT_FNS and node.args:
+                    topic, ok = self._topic_of(node.args[0], consts)
+                    publish_args.add(id(node.args[0]))
+                    if topic is not None:
+                        waited.setdefault(topic, (mod.rel, node.lineno))
+                        referenced.add(topic)
+                        if not ok:
+                            yield Finding(
+                                CODE, mod.rel, node.lineno,
+                                f'wait on undeclared topic {topic!r} — '
+                                'declare it as a constant in '
+                                'utils/events.py',
+                                slug=f'undeclared:{topic}')
+                elif leaf == 'external_signal' and len(node.args) >= 3:
+                    topic, _ = self._topic_of(node.args[2], consts)
+                    publish_args.add(id(node.args[2]))
+                    if topic is not None:
+                        referenced.add(topic)
+            # Any other events.TOPIC mention counts as a consumer-side
+            # reference (constructor args, stored topics).
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Attribute)
+                        and id(node) not in publish_args
+                        and (astutil.dotted(node.value) or ''
+                             ).split('.')[-1] == 'events'
+                        and node.attr in consts):
+                    referenced.add(consts[node.attr])
+
+        for topic in sorted(published):
+            if topic in topics and topic not in referenced:
+                rel, line = published[topic]
+                yield Finding(
+                    CODE, rel, line,
+                    f'topic {topic!r} is published but nothing '
+                    'subscribes (publish-without-subscriber: every '
+                    'write pays notify cost for no wakeup)',
+                    slug=f'nosub:{topic}')
+        for topic in sorted(waited):
+            if topic in topics and topic not in published:
+                rel, line = waited[topic]
+                yield Finding(
+                    CODE, rel, line,
+                    f'topic {topic!r} is waited on but never '
+                    'published (that loop only ever wakes on its '
+                    'fallback poll)', slug=f'nopub:{topic}')
+
+    @staticmethod
+    def _topic_of(node: ast.AST, consts: Dict[str, str]):
+        """(topic, declared?) or (None, True) when dynamic."""
+        literal = astutil.const_str(node)
+        if literal is not None:
+            return literal, literal in consts.values()
+        name = astutil.dotted(node)
+        if name is not None:
+            leaf = name.split('.')[-1]
+            if leaf in consts:
+                return consts[leaf], True
+            if leaf.isupper():
+                return None, True      # unknown constant: dynamic
+        return None, True

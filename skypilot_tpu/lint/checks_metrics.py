@@ -1,0 +1,196 @@
+"""SKYT003 — metrics registry: every ``skyt_*`` family declared once,
+with the right instrument type and a fixed label set.
+
+Declarations are the module-level ``NAME = Counter/Gauge/Histogram(
+'skyt_family', help, labels=(...))`` constructors in
+``server/metrics.py`` (parsed from AST — the checker never imports the
+server). This pass enforces:
+
+* family names are unique, ``skyt_``-prefixed, and follow Prometheus
+  conventions (counters end ``_total``; gauges/histograms don't);
+* every declaration carries an explicit ``labels=(...)`` tuple — the
+  label schema is part of the contract, not the help string;
+* every emitter call (``X.inc`` / ``X.set`` / ``X.observe`` on a
+  declared metric, however imported) uses the method matching the
+  instrument (``rate()`` over a gauge is silently wrong on scrape) and
+  passes EXACTLY the declared label keys — a missing label forks a
+  second timeseries; an extra one explodes cardinality;
+* dynamically named families (the inference server's
+  ``skyt_inference_<stat>`` exposition) may only use prefixes listed
+  in ``DYNAMIC_FAMILY_PREFIXES`` in server/metrics.py — their
+  counter-vs-gauge split lives there too (``INFERENCE_COUNTER_STATS``)
+  so the emitting module cannot drift from the declared typing.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, NamedTuple, Optional
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT003'
+
+METRICS_MODULE = 'server/metrics.py'
+KINDS = {'Counter': 'inc', 'Gauge': 'set', 'Histogram': 'observe'}
+EMIT_METHODS = frozenset(KINDS.values())
+
+
+class MetricDecl(NamedTuple):
+    var: str
+    family: str
+    kind: str                  # Counter | Gauge | Histogram
+    labels: Optional[tuple]    # None = labels= missing (a finding)
+    line: int
+
+
+def parse_declarations(metrics_mod) -> Dict[str, MetricDecl]:
+    """var name -> declaration, from module-level assignments."""
+    decls: Dict[str, MetricDecl] = {}
+    for node in metrics_mod.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        call = node.value
+        if not (isinstance(target, ast.Name)
+                and isinstance(call, ast.Call)):
+            continue
+        ctor = astutil.dotted(call.func)
+        if ctor not in KINDS:
+            continue
+        family = astutil.const_str(call.args[0]) if call.args else None
+        labels: Optional[tuple] = None
+        for kw in call.keywords:
+            if kw.arg == 'labels' and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                labels = tuple(
+                    astutil.const_str(e) for e in kw.value.elts)
+        decls[target.id] = MetricDecl(
+            target.id, family or '?', ctor, labels, node.lineno)
+    return decls
+
+
+def parse_dynamic_prefixes(metrics_mod) -> tuple:
+    """The ``DYNAMIC_FAMILY_PREFIXES`` tuple from server/metrics.py —
+    allowed prefixes for families whose full name is computed at
+    runtime (e.g. the inference server's per-stat exposition)."""
+    for node in metrics_mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == 'DYNAMIC_FAMILY_PREFIXES'
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return tuple(astutil.const_str(e) for e in node.value.elts)
+    return ()
+
+
+class MetricsRegistryChecker:
+    code = CODE
+    name = 'skyt_* metrics registry'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        metrics_mod = ctx.module(METRICS_MODULE)
+        if metrics_mod is None:
+            return
+        decls = parse_declarations(metrics_mod)
+        dynamic_prefixes = parse_dynamic_prefixes(metrics_mod)
+        yield from self._check_declarations(metrics_mod, decls)
+        for mod in ctx.package_modules:
+            yield from self._check_emitters(mod, decls)
+            if mod is not metrics_mod:
+                yield from self._check_dynamic(mod, dynamic_prefixes)
+
+    def _check_declarations(self, mod, decls) -> Iterator[Finding]:
+        seen: Dict[str, str] = {}
+        for decl in decls.values():
+            if not decl.family.startswith('skyt_'):
+                yield Finding(
+                    CODE, mod.rel, decl.line,
+                    f'metric family {decl.family!r} must be '
+                    "skyt_-prefixed", slug=f'prefix:{decl.var}')
+            if decl.family in seen:
+                yield Finding(
+                    CODE, mod.rel, decl.line,
+                    f'metric family {decl.family!r} declared twice '
+                    f'({seen[decl.family]} and {decl.var})',
+                    slug=f'dup:{decl.family}')
+            seen[decl.family] = decl.var
+            is_total = decl.family.endswith('_total')
+            if decl.kind == 'Counter' and not is_total:
+                yield Finding(
+                    CODE, mod.rel, decl.line,
+                    f'counter {decl.family!r} must end in _total '
+                    '(Prometheus naming convention)',
+                    slug=f'total:{decl.var}')
+            if decl.kind != 'Counter' and is_total:
+                yield Finding(
+                    CODE, mod.rel, decl.line,
+                    f'{decl.kind.lower()} {decl.family!r} must not end '
+                    'in _total (scrapers treat _total as a counter)',
+                    slug=f'total:{decl.var}')
+            if decl.labels is None:
+                yield Finding(
+                    CODE, mod.rel, decl.line,
+                    f'{decl.var} ({decl.family}) has no labels=(...) '
+                    'declaration — the label schema is part of the '
+                    'metric contract', slug=f'nolabels:{decl.var}')
+
+    def _check_emitters(self, mod, decls) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in EMIT_METHODS):
+                continue
+            base = node.func.value
+            var = None
+            if isinstance(base, ast.Name):
+                var = base.id
+            elif isinstance(base, ast.Attribute):
+                var = base.attr           # metrics.LB_REQUESTS.inc
+            decl = decls.get(var or '')
+            if decl is None:
+                continue
+            method = node.func.attr
+            expected = KINDS[decl.kind]
+            if method != expected:
+                yield Finding(
+                    CODE, mod.rel, node.lineno,
+                    f'{decl.var} is a {decl.kind} ({decl.family}); '
+                    f'.{method}() is the '
+                    f'{self._kind_of_method(method)} API — use '
+                    f'.{expected}() or fix the declaration',
+                    slug=f'kind:{decl.var}:{method}')
+                continue
+            if decl.labels is None:
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue                   # **labels: not checkable
+            passed = tuple(sorted(kw.arg for kw in node.keywords))
+            declared = tuple(sorted(l for l in decl.labels if l))
+            if passed != declared:
+                yield Finding(
+                    CODE, mod.rel, node.lineno,
+                    f'{decl.var} ({decl.family}) emitted with labels '
+                    f'{list(passed)} but declared {list(declared)} — '
+                    'label drift forks/explodes the timeseries',
+                    slug=f'labels:{decl.var}:{",".join(passed)}')
+
+    def _check_dynamic(self, mod, prefixes) -> Iterator[Finding]:
+        """Computed family names (f'skyt_...{x}') outside metrics.py
+        must use a declared dynamic prefix."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.JoinedStr):
+                continue
+            head = astutil.fstring_head(node)
+            if head is None or not head.startswith('skyt_'):
+                continue
+            if not any(p and head.startswith(p) for p in prefixes):
+                yield Finding(
+                    CODE, mod.rel, node.lineno,
+                    f'computed metric family prefix {head!r} is not in '
+                    'DYNAMIC_FAMILY_PREFIXES (server/metrics.py) — '
+                    'declare the dynamic family there',
+                    slug=f'dynamic:{head}')
+
+    @staticmethod
+    def _kind_of_method(method: str) -> str:
+        return {v: k for k, v in KINDS.items()}[method]

@@ -1,0 +1,159 @@
+"""SKYT007 (sqlite portability) and SKYT008 (JAX purity).
+
+SKYT007: the PR-2 outage class — ``UPDATE .. RETURNING`` killed every
+runner on sqlite < 3.35, and ``ON CONFLICT`` upserts need >= 3.24 —
+must stay mechanically impossible. The only places allowed to emit
+these dialect features are the adaptive helpers that probe backend
+support and fall back (``server/requests_db.py``, ``utils/locks.py``,
+``utils/pg.py``). Any other module embedding them in SQL text is a
+portability regression.
+
+SKYT008: host-side effects inside ``@jax.jit``/``pjit``-traced
+functions (``time.time``, the stdlib ``random`` module, ``print``,
+env reads, ``open``) execute ONCE at trace time and then bake their
+value into the compiled program — a step function that "reads a knob
+per step" actually reads it per *compile*. Flags impure calls inside
+functions that are jit-decorated (including
+``functools.partial(jax.jit, ...)``) or wrapped via ``jax.jit(fn)``
+in the same module (the train/step.py idiom).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+SQL_CODE = 'SKYT007'
+JAX_CODE = 'SKYT008'
+
+# -- SKYT007 ------------------------------------------------------------
+
+SQL_ALLOWED = ('server/requests_db.py', 'utils/locks.py', 'utils/pg.py')
+SQL_DIALECT_RE = re.compile(r'\b(RETURNING|ON\s+CONFLICT)\b')
+SQL_STMT_RE = re.compile(r'\b(INSERT|UPDATE|DELETE|SELECT)\b')
+
+
+class SqlitePortabilityChecker:
+    code = SQL_CODE
+    name = 'sqlite dialect portability'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            rel = mod.rel.replace('\\', '/')
+            if rel.endswith(SQL_ALLOWED):
+                continue
+            docstrings = astutil.docstring_nodes(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and id(node) not in docstrings):
+                    continue
+                text = node.value
+                dialect = SQL_DIALECT_RE.search(text)
+                if dialect and SQL_STMT_RE.search(text):
+                    feature = ' '.join(dialect.group(1).split())
+                    yield Finding(
+                        SQL_CODE, mod.rel, node.lineno,
+                        f'SQL uses {feature!r}: breaks sqlite < '
+                        f'{"3.35" if feature == "RETURNING" else "3.24"}'
+                        ' runners — route through the adaptive helpers '
+                        'in requests_db.py/locks.py or write the '
+                        'portable two-step form',
+                        slug=f'{feature.lower().replace(" ", "-")}'
+                             f':{node.lineno}')
+        return
+
+# -- SKYT008 ------------------------------------------------------------
+
+
+IMPURE_EXACT = {
+    'time.time': 'wall-clock is frozen at trace time',
+    'time.monotonic': 'wall-clock is frozen at trace time',
+    'time.perf_counter': 'wall-clock is frozen at trace time',
+    'time.sleep': 'sleeps at trace time only, never per step',
+    'os.getenv': 'env is read once at trace time',
+    'os.environ.get': 'env is read once at trace time',
+    'print': 'prints at trace time only (use jax.debug.print)',
+    'input': 'blocks tracing',
+    'open': 'file I/O does not belong in a traced function',
+}
+IMPURE_PREFIXES = {
+    'random.': 'stdlib random is traced once (use jax.random with '
+               'explicit keys)',
+    'np.random.': 'numpy RNG is traced once (use jax.random)',
+    'numpy.random.': 'numpy RNG is traced once (use jax.random)',
+}
+JIT_NAMES = ('jax.jit', 'jit', 'pjit', 'jax.pjit')
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / pjit / functools.partial(jax.jit, ...) expressions."""
+    name = astutil.dotted(node)
+    if name in JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fn = astutil.dotted(node.func)
+        if fn in JIT_NAMES:
+            return True
+        if fn in ('functools.partial', 'partial') and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class JaxPurityChecker:
+    code = JAX_CODE
+    name = 'JAX purity in jitted functions'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            defs: Dict[str, List[ast.AST]] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, []).append(node)
+            jitted: List[ast.AST] = []
+            seen: Set[int] = set()
+
+            def add(fn) -> None:
+                if fn is not None and id(fn) not in seen:
+                    seen.add(id(fn))
+                    jitted.append(fn)
+
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    if any(_is_jit_expr(d) for d in node.decorator_list):
+                        add(node)
+                elif isinstance(node, ast.Call):
+                    # jax.jit(fn, ...) wrapping a same-module def.
+                    if astutil.dotted(node.func) in JIT_NAMES \
+                            and node.args:
+                        target = node.args[0]
+                        if isinstance(target, ast.Name):
+                            for fn in defs.get(target.id, ()):
+                                add(fn)
+            for fn in jitted:
+                yield from self._check_fn(mod, fn)
+
+    def _check_fn(self, mod, fn) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.dotted(node.func)
+            if name is None:
+                continue
+            why = IMPURE_EXACT.get(name)
+            if why is None:
+                for prefix, reason in IMPURE_PREFIXES.items():
+                    if name.startswith(prefix):
+                        why = reason
+                        break
+            if why:
+                yield Finding(
+                    JAX_CODE, mod.rel, node.lineno,
+                    f'impure call {name}() inside jitted function '
+                    f'{fn.name}(): {why}',
+                    slug=f'{fn.name}:{name}')

@@ -1,0 +1,255 @@
+"""skylint core: file model, finding model, baseline, runner.
+
+The linter is a plain AST pass (stdlib ``ast`` only — no third-party
+deps, importable on the leanest runner). Checkers live in the
+``checks_*`` modules; each exposes a class with:
+
+* ``code``  — the stable finding code (``SKYT001``..``SKYT008``);
+* ``name``  — short human label;
+* ``run(ctx)`` — yields :class:`Finding`s over a :class:`Context`.
+
+``SKYT000`` is reserved for meta findings the runner itself emits
+(unparsable file, stale/unreviewed baseline entry, generated docs out
+of sync).
+
+Baseline: a committed JSON file of *reviewed* suppressions. Each entry
+is ``{"code", "key", "reason"}`` — ``key`` is a stable identifier the
+checker derives from the finding's content (never a line number, so
+unrelated churn doesn't invalidate it), and ``reason`` must be a real
+justification: empty or ``UNREVIEWED``-prefixed reasons fail the run.
+Stale entries (matching no current finding) fail the run too, so the
+baseline can only shrink or be consciously re-reviewed.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+META_CODE = 'SKYT000'
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str
+    path: str          # repo-relative
+    line: int
+    message: str
+    slug: str          # stable content-derived id (baseline matching)
+    baselined: bool = False
+
+    @property
+    def key(self) -> str:
+        return f'{self.path}:{self.slug}'
+
+    def render(self) -> str:
+        mark = ' [baselined]' if self.baselined else ''
+        return f'{self.path}:{self.line}: {self.code} {self.message}{mark}'
+
+    def to_json(self) -> Dict:
+        return {'code': self.code, 'path': self.path, 'line': self.line,
+                'message': self.message, 'key': self.key,
+                'baselined': self.baselined}
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, path: str, rel: str, source: str,
+                 tree: ast.Module) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+
+    @classmethod
+    def load(cls, path: str, rel: str) -> 'Module':
+        with open(path, encoding='utf-8') as f:
+            source = f.read()
+        return cls(path, rel, source, ast.parse(source, filename=path))
+
+
+class Context:
+    """Everything a checker may look at.
+
+    ``package_modules`` are the lint subjects; ``test_modules`` and
+    ``doc_texts`` feed the cross-reference passes (chaos-site and
+    event-topic coverage). Tests construct Contexts over fixture file
+    sets; the CLI builds one over the real repo.
+    """
+
+    def __init__(self, repo_root: str,
+                 package_files: Sequence[str],
+                 test_files: Sequence[str] = (),
+                 doc_files: Sequence[str] = ()) -> None:
+        self.repo_root = repo_root
+        self.package_modules: List[Module] = []
+        self.test_modules: List[Module] = []
+        self.doc_texts: Dict[str, str] = {}
+        self.parse_errors: List[Finding] = []
+        for path in package_files:
+            self._load(path, self.package_modules)
+        for path in test_files:
+            self._load(path, self.test_modules)
+        for path in doc_files:
+            rel = os.path.relpath(path, repo_root)
+            try:
+                with open(path, encoding='utf-8') as f:
+                    self.doc_texts[rel] = f.read()
+            except OSError as e:
+                self.parse_errors.append(Finding(
+                    META_CODE, rel, 0, f'unreadable doc: {e}',
+                    slug=f'unreadable:{rel}'))
+
+    def _load(self, path: str, into: List[Module]) -> None:
+        rel = os.path.relpath(path, self.repo_root)
+        try:
+            into.append(Module.load(path, rel))
+        except (OSError, SyntaxError) as e:
+            self.parse_errors.append(Finding(
+                META_CODE, rel, getattr(e, 'lineno', 0) or 0,
+                f'unparsable file: {e}', slug=f'unparsable:{rel}'))
+
+    def module(self, rel_suffix: str) -> Optional[Module]:
+        """The package module whose repo-relative path ends with
+        ``rel_suffix`` (e.g. 'server/metrics.py')."""
+        for mod in self.package_modules:
+            if mod.rel.replace(os.sep, '/').endswith(rel_suffix):
+                return mod
+        return None
+
+
+# -- repo discovery -----------------------------------------------------
+
+def repo_paths(repo_root: str) -> Tuple[List[str], List[str], List[str]]:
+    """(package_files, test_files, doc_files) for a real repo run.
+
+    ``tests/lint_fixtures`` is excluded from the test scan: fixtures
+    contain deliberate violations for the linter's own test suite.
+    """
+    package_files: List[str] = []
+    pkg_root = os.path.join(repo_root, 'skypilot_tpu')
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != '__pycache__']
+        for name in sorted(filenames):
+            if name.endswith('.py'):
+                package_files.append(os.path.join(dirpath, name))
+    test_files: List[str] = []
+    tests_root = os.path.join(repo_root, 'tests')
+    if os.path.isdir(tests_root):
+        for dirpath, dirnames, filenames in os.walk(tests_root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ('__pycache__', 'lint_fixtures')]
+            for name in sorted(filenames):
+                if name.endswith('.py'):
+                    test_files.append(os.path.join(dirpath, name))
+    doc_files: List[str] = []
+    docs_root = os.path.join(repo_root, 'docs')
+    if os.path.isdir(docs_root):
+        for dirpath, dirnames, filenames in os.walk(docs_root):
+            for name in sorted(filenames):
+                if name.endswith('.md'):
+                    doc_files.append(os.path.join(dirpath, name))
+    readme = os.path.join(repo_root, 'README.md')
+    if os.path.exists(readme):
+        doc_files.append(readme)
+    return package_files, test_files, doc_files
+
+
+def find_repo_root() -> str:
+    """The checkout root: parent of the installed/source package dir."""
+    here = os.path.dirname(os.path.abspath(__file__))   # .../lint
+    return os.path.dirname(os.path.dirname(here))        # repo root
+
+
+# -- baseline -----------------------------------------------------------
+
+UNREVIEWED_PREFIX = 'UNREVIEWED'
+
+
+def load_baseline(path: str) -> List[Dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    entries = data.get('suppressions', [])
+    if not isinstance(entries, list):
+        raise ValueError(f'{path}: "suppressions" must be a list')
+    return entries
+
+
+def apply_baseline(findings: List[Finding], entries: List[Dict],
+                   baseline_path: str) -> List[Finding]:
+    """Mark baselined findings; append meta findings for stale or
+    unreviewed entries. Returns the merged list."""
+    by_key: Dict[Tuple[str, str], Finding] = {
+        (f.code, f.key): f for f in findings}
+    meta: List[Finding] = []
+    rel = os.path.basename(baseline_path)
+    for i, entry in enumerate(entries):
+        code = entry.get('code', '')
+        key = entry.get('key', '')
+        reason = (entry.get('reason') or '').strip()
+        if not reason or reason.startswith(UNREVIEWED_PREFIX):
+            meta.append(Finding(
+                META_CODE, rel, 0,
+                f'baseline entry {code}:{key} has no reviewed reason '
+                '(write a justification or fix the finding)',
+                slug=f'unreviewed:{code}:{key}'))
+            continue
+        finding = by_key.get((code, key))
+        if finding is None:
+            meta.append(Finding(
+                META_CODE, rel, 0,
+                f'stale baseline entry {code}:{key} matches no current '
+                'finding (delete it)',
+                slug=f'stale:{code}:{key}'))
+        else:
+            finding.baselined = True
+    return findings + meta
+
+
+def write_baseline(findings: Iterable[Finding], path: str) -> int:
+    """--write-baseline: dump every ACTIVE finding as an UNREVIEWED
+    suppression. Each entry must then be hand-reviewed (reason filled
+    in) or fixed — the linter fails on UNREVIEWED reasons."""
+    entries = [{
+        'code': f.code,
+        'key': f.key,
+        'reason': f'{UNREVIEWED_PREFIX} — justify or fix: {f.message}',
+    } for f in findings if not f.baselined and f.code != META_CODE]
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'version': 1, 'suppressions': entries}, f, indent=2,
+                  sort_keys=True)
+        f.write('\n')
+    return len(entries)
+
+
+# -- runner -------------------------------------------------------------
+
+def all_checkers() -> List:
+    from skypilot_tpu.lint import (checks_async, checks_chaos,
+                                   checks_concurrency, checks_env,
+                                   checks_events, checks_metrics,
+                                   checks_portability)
+    return [
+        checks_async.AsyncBlockingChecker(),        # SKYT001
+        checks_env.EnvRegistryChecker(),            # SKYT002
+        checks_metrics.MetricsRegistryChecker(),    # SKYT003
+        checks_chaos.ChaosCoverageChecker(),        # SKYT004
+        checks_events.EventTopicChecker(),          # SKYT005
+        checks_concurrency.LockOrderChecker(),      # SKYT006
+        checks_portability.SqlitePortabilityChecker(),  # SKYT007
+        checks_portability.JaxPurityChecker(),      # SKYT008
+    ]
+
+
+def run_checks(ctx: Context, checkers: Optional[List] = None
+               ) -> List[Finding]:
+    findings: List[Finding] = list(ctx.parse_errors)
+    for checker in (checkers if checkers is not None else all_checkers()):
+        findings.extend(checker.run(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.slug))
+    return findings

@@ -254,7 +254,8 @@ class FakeProvider(Provider):
                 worker_index=h['worker_index'],
             ) for h in record['hosts'] if h['state'] == 'running'
         ]
-        if os.environ.get('SKYT_FAKE_SSH_MODE'):
+        from skypilot_tpu.utils import env_registry
+        if env_registry.get_bool('SKYT_FAKE_SSH_MODE'):
             # SSH mode: the backend sees a *real* (non-local-style)
             # cluster and goes down the SSHCommandRunner + runtime-ship +
             # remote-daemon path; the `ssh`/`rsync` binaries are the

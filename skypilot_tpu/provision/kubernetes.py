@@ -38,7 +38,7 @@ import yaml
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
                                         ProvisionRequest, Provider)
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 logger = log.init_logger(__name__)
@@ -61,7 +61,7 @@ DEFAULT_IMAGE = os.environ.get(
 
 
 def _provision_timeout() -> float:
-    return float(os.environ.get('SKYT_K8S_PROVISION_TIMEOUT', '600'))
+    return env_registry.get_float('SKYT_K8S_PROVISION_TIMEOUT')
 
 
 def gke_tpu_selectors(resources) -> Dict[str, str]:
@@ -580,7 +580,7 @@ class KubernetesProvider(Provider):
                  namespace: Optional[str] = None) -> None:
         if api is not None:
             self.api: KubernetesApi = api
-        elif os.environ.get('SKYT_K8S_FAKE'):
+        elif env_registry.get_bool('SKYT_K8S_FAKE'):
             self.api = FakeKubernetesApi()
         else:
             self.api = RestKubernetesApi()

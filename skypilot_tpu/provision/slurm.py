@@ -14,7 +14,6 @@ Partitions map to the ``region`` field.
 """
 from __future__ import annotations
 
-import os
 import shlex
 import subprocess
 import time
@@ -23,7 +22,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import config, exceptions
 from skypilot_tpu.provision.api import (ClusterInfo, HostInfo,
                                         ProvisionRequest, Provider)
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 logger = log.init_logger(__name__)
@@ -178,8 +177,7 @@ class SlurmProvider(Provider):
                 return self._info(request.cluster_name,
                                   request.region or 'slurm', nodes,
                                   job['job_id'])
-            time.sleep(float(os.environ.get('SKYT_SLURM_POLL_SECONDS',
-                                            '2')))
+            time.sleep(env_registry.get_float('SKYT_SLURM_POLL_SECONDS'))
         raise exceptions.CapacityError(
             f'slurm: allocation for {request.cluster_name} still pending '
             f'after {timeout}s (queue full?)')

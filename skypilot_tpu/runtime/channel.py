@@ -16,7 +16,6 @@ can be established, keeping the job_cli shim as the fallback transport.
 from __future__ import annotations
 
 import atexit
-import os
 import queue
 import threading
 from typing import Any, Callable, Dict, IO, Optional
@@ -27,11 +26,11 @@ from skypilot_tpu.runtime.job_client import (REMOTE_PKG_DIR,
                                              REMOTE_RUNTIME_DIR,
                                              encode_b64_json,
                                              encode_submit_payload)
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 
 logger = log.init_logger(__name__)
 
-DEFAULT_TIMEOUT = float(os.environ.get('SKYT_CHANNEL_TIMEOUT', '120'))
+DEFAULT_TIMEOUT = env_registry.get_float('SKYT_CHANNEL_TIMEOUT')
 
 
 class ChannelError(exceptions.CommandError):
@@ -231,7 +230,7 @@ _channels_lock = threading.Lock()
 
 
 def channels_enabled() -> bool:
-    return os.environ.get('SKYT_RUNTIME_CHANNEL', '1') != '0'
+    return env_registry.get_bool('SKYT_RUNTIME_CHANNEL')
 
 
 def _spawn(info) -> Optional[ChannelClient]:

@@ -39,12 +39,12 @@ from typing import Any, Dict, IO, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu.runtime.channel import ChannelError
 from skypilot_tpu.runtime.channel_server import read_frame, write_frame
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 
 logger = log.init_logger(__name__)
 
 BROKER_SOCK_ENV = 'SKYT_CHANNEL_BROKER_SOCK'
-DEFAULT_TIMEOUT = float(os.environ.get('SKYT_CHANNEL_TIMEOUT', '120'))
+DEFAULT_TIMEOUT = env_registry.get_float('SKYT_CHANNEL_TIMEOUT')
 
 
 def _sock_dir() -> str:

@@ -37,7 +37,7 @@ import time
 from typing import Any, Dict
 
 from skypilot_tpu.runtime import job_cli, job_lib, log_lib
-from skypilot_tpu.utils import events
+from skypilot_tpu.utils import env_registry, events
 
 _LEN = struct.Struct('>I')
 MAX_FRAME = 64 << 20
@@ -49,7 +49,7 @@ MAX_FRAME = 64 << 20
 # that bounds staleness when both signals are lost; head-local sqlite
 # reads are ~free, so the legacy 0.3 s default keeps even the degraded
 # path inside the "<2 s without a poll tick (server-side)" bar.
-WATCH_PERIOD = float(os.environ.get('SKYT_CHANNEL_WATCH_PERIOD', '0.3'))
+WATCH_PERIOD = env_registry.get_float('SKYT_CHANNEL_WATCH_PERIOD')
 
 
 def read_frame(stream) -> Dict[str, Any]:
@@ -172,12 +172,9 @@ class ChannelServer:
         wakeups come from the bus/data_version within ~ms and this only
         bounds staleness after a LOST signal — capped at 2 s so even
         the degraded mode meets the <2 s push bar."""
-        env = os.environ.get('SKYT_CHANNEL_WATCH_FALLBACK')
-        if env:
-            try:
-                return float(env)
-            except ValueError:
-                pass  # fall through to the computed default
+        env = env_registry.get_float('SKYT_CHANNEL_WATCH_FALLBACK')
+        if env is not None:
+            return env
         if not events.enabled():
             return WATCH_PERIOD
         return max(WATCH_PERIOD, min(2.0, 10 * WATCH_PERIOD))

@@ -34,14 +34,14 @@ import psutil
 
 from skypilot_tpu.runtime import cluster_spec as spec_lib
 from skypilot_tpu.runtime import job_lib
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 from skypilot_tpu.utils.subprocess_utils import kill_process_tree
 
 logger = log.init_logger(__name__)
 
 # Daemon loop cadence. Injectable so tests (and latency-sensitive local
 # deployments) can run the scheduler at 10-50 ms instead of 1 Hz.
-EVENT_PERIOD_SECONDS = float(os.environ.get('SKYT_DAEMON_PERIOD', '1.0'))
+EVENT_PERIOD_SECONDS = env_registry.get_float('SKYT_DAEMON_PERIOD')
 
 # First line an SSH rank prints once its remote shell is up (stdout is the
 # head-side rank log, so the head can observe remote liveness without an
@@ -189,10 +189,12 @@ class Daemon:
         self.cluster_name = self.spec.cluster_name
         self.supervisors: Dict[int, JobSupervisor] = {}
         self.started_at = time.time()
-        self.gang_start_deadline = float(os.environ.get(
-            'SKYT_GANG_START_DEADLINE', DEFAULT_GANG_START_DEADLINE))
-        self.max_concurrent_jobs = int(os.environ.get(
-            'SKYT_MAX_CONCURRENT_JOBS', DEFAULT_MAX_CONCURRENT_JOBS))
+        self.gang_start_deadline = env_registry.get_float(
+            'SKYT_GANG_START_DEADLINE',
+            default=DEFAULT_GANG_START_DEADLINE)
+        self.max_concurrent_jobs = env_registry.get_int(
+            'SKYT_MAX_CONCURRENT_JOBS',
+            default=DEFAULT_MAX_CONCURRENT_JOBS)
 
     # ------------------------------------------------------------------
     # Rank launch

@@ -30,6 +30,7 @@ import time
 # stdlib-only imports at module level: this runs on cluster hosts where
 # only the shipped runtime package is guaranteed importable.
 from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.utils import env_registry
 
 
 def _touch_last_use(runtime_dir: str) -> None:
@@ -128,7 +129,7 @@ def follow_stop_condition(runtime_dir: str, job_id: int):
     job, and stop on a DEAD daemon — a non-terminal job nobody
     supervises never finishes, so following it hangs the client
     forever. The grace covers a daemon still starting up."""
-    grace = float(os.environ.get('SKYT_TAIL_DAEMON_GRACE', '45'))
+    grace = env_registry.get_float('SKYT_TAIL_DAEMON_GRACE')
     stream_started = time.time()
 
     def job_done() -> bool:

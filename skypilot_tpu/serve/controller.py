@@ -24,11 +24,11 @@ from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import events, log
+from skypilot_tpu.utils import env_registry, events, log
 
 logger = log.init_logger(__name__)
 
-POLL_SECONDS = float(os.environ.get('SKYT_SERVE_CONTROLLER_POLL', '10'))
+POLL_SECONDS = env_registry.get_float('SKYT_SERVE_CONTROLLER_POLL')
 
 
 def _replica_weight(record: serve_state.ReplicaRecord) -> float:
@@ -300,7 +300,7 @@ class ServeController:
         """Has a replacement controller (or a restart claim) taken this
         service over from this process? Offloaded controllers are
         identified by cluster job id, not pid — no self-fence there."""
-        if os.environ.get('SKYT_SERVE_ON_CLUSTER'):
+        if env_registry.get_bool('SKYT_SERVE_ON_CLUSTER'):
             return False
         if record.controller_pid is not None:
             return record.controller_pid != os.getpid()

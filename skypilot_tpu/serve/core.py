@@ -33,7 +33,8 @@ from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import common_utils, log, subprocess_utils
+from skypilot_tpu.utils import (common_utils, env_registry, log,
+                                subprocess_utils)
 
 logger = log.init_logger(__name__)
 
@@ -75,8 +76,9 @@ def _same_local_process(pid: int,
 
 def _controller_max_restarts() -> int:
     from skypilot_tpu import config
-    if 'SKYT_SERVE_CONTROLLER_MAX_RESTARTS' in os.environ:
-        return int(os.environ['SKYT_SERVE_CONTROLLER_MAX_RESTARTS'])
+    env = env_registry.get_int('SKYT_SERVE_CONTROLLER_MAX_RESTARTS')
+    if env is not None:
+        return env
     return int(config.get_nested(('serve', 'controller_max_restarts'), 3))
 
 
@@ -349,8 +351,7 @@ def down(service_name: str, purge: bool = False) -> None:
             # outlive our row DELETE as a leaked cluster. Wait bounded;
             # if the row persists the controller is gone/stuck and we
             # take over the teardown.
-            poll = float(os.environ.get('SKYT_SERVE_CONTROLLER_POLL',
-                                        '10'))
+            poll = env_registry.get_float('SKYT_SERVE_CONTROLLER_POLL')
             deadline = time.time() + 2 * poll + 5
             while time.time() < deadline:
                 if serve_state.get_service(service_name) is None:

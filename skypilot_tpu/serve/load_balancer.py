@@ -44,7 +44,6 @@ from __future__ import annotations
 
 import asyncio
 import collections
-import os
 import socket
 import threading
 import time
@@ -53,6 +52,7 @@ from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 from skypilot_tpu.serve.autoscalers import LoadStats
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         ReplicaEntry)
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
 
@@ -73,13 +73,6 @@ _MAX_HEAD_BYTES = 65536
 LB_METRICS_PATH = '/-/lb/metrics'
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 class LoadBalancer:
     """Policy + stats + replica health shared between the async proxy,
     the controller loop, and the autoscaler."""
@@ -96,10 +89,11 @@ class LoadBalancer:
         self._request_times: collections.deque = collections.deque()
         self._in_flight: Dict[int, int] = collections.defaultdict(int)
         # -- replica health (EWMA latency + circuit breaker) ----------
-        self._ewma_alpha = _env_float('SKYT_LB_EWMA_ALPHA', 0.3)
-        self._eject_threshold = int(
-            _env_float('SKYT_LB_EJECT_THRESHOLD', 3))
-        self._eject_seconds = _env_float('SKYT_LB_EJECT_SECONDS', 10.0)
+        self._ewma_alpha = env_registry.get_float('SKYT_LB_EWMA_ALPHA')
+        self._eject_threshold = env_registry.get_int(
+            'SKYT_LB_EJECT_THRESHOLD')
+        self._eject_seconds = env_registry.get_float(
+            'SKYT_LB_EJECT_SECONDS')
         self._ewma: Dict[int, float] = {}            # seconds (TTFB)
         self._failures: Dict[int, int] = {}          # consecutive
         self._ejected_until: Dict[int, float] = {}   # monotonic deadline
@@ -381,12 +375,12 @@ class _AsyncProxy:
 
     def __init__(self, lb: LoadBalancer) -> None:
         self.lb = lb
-        self.pool_size = int(_env_float('SKYT_LB_POOL_SIZE', 8))
-        self.pool_idle_seconds = _env_float('SKYT_LB_POOL_IDLE_SECONDS',
-                                            30.0)
-        self.max_inflight = int(_env_float('SKYT_LB_MAX_INFLIGHT', 256))
-        self.upstream_timeout = _env_float('SKYT_LB_UPSTREAM_TIMEOUT',
-                                           300.0)
+        self.pool_size = env_registry.get_int('SKYT_LB_POOL_SIZE')
+        self.pool_idle_seconds = env_registry.get_float(
+            'SKYT_LB_POOL_IDLE_SECONDS')
+        self.max_inflight = env_registry.get_int('SKYT_LB_MAX_INFLIGHT')
+        self.upstream_timeout = env_registry.get_float(
+            'SKYT_LB_UPSTREAM_TIMEOUT')
         self._pools: Dict[Tuple[str, int], _UpstreamPool] = {}
         self._inflight = 0
         self.server: Optional[asyncio.base_events.Server] = None

@@ -10,7 +10,6 @@ cluster's hosts still exist (a spot TPU slice vanishes as a unit).
 from __future__ import annotations
 
 import http.client
-import os
 import socket
 import threading
 import time
@@ -25,12 +24,12 @@ from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import common_utils, log
+from skypilot_tpu.utils import common_utils, env_registry, log
 
 logger = log.init_logger(__name__)
 
-NOT_READY_THRESHOLD = int(os.environ.get('SKYT_SERVE_NOT_READY_THRESHOLD',
-                                         '3'))
+NOT_READY_THRESHOLD = env_registry.get_int(
+    'SKYT_SERVE_NOT_READY_THRESHOLD')
 
 REPLICA_PORT_ENV = 'SKYT_SERVE_REPLICA_PORT'
 REPLICA_ID_ENV = 'SKYT_SERVE_REPLICA_ID'

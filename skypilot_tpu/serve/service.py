@@ -19,7 +19,7 @@ from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import env_registry, log
 
 logger = log.init_logger(__name__)
 
@@ -29,7 +29,7 @@ def run_service(service_name: str) -> None:
     assert record is not None, f'service {service_name} not in DB'
     spec = ServiceSpec.from_yaml_config(record.spec)
     task = Task.from_yaml_config(record.task_config)
-    if not os.environ.get('SKYT_SERVE_ON_CLUSTER'):
+    if not env_registry.get_bool('SKYT_SERVE_ON_CLUSTER'):
         # Offloaded controllers are identified by their cluster job id,
         # recorded by the spawner — the remote pid must not clobber it.
         # Re-stamp the owner fence too (SKYT_SERVER_ID is inherited

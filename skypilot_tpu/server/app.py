@@ -32,7 +32,7 @@ from skypilot_tpu.server import executor as executor_lib
 from skypilot_tpu.server import payloads, requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.users import rbac, users_db
-from skypilot_tpu.utils import events, log
+from skypilot_tpu.utils import env_registry, events, log
 
 logger = log.init_logger(__name__)
 
@@ -57,7 +57,7 @@ _BROWSER_TOKEN_LOCK = threading.Lock()
 # ordinary requests (r3 verdict weak #4). Saturation answers 503 +
 # Retry-After so well-behaved clients back off. Short requests are
 # bounded separately by the executor worker pools.
-MAX_STREAMS = int(os.environ.get('SKYT_MAX_STREAMS', '64'))
+MAX_STREAMS = env_registry.get_int('SKYT_MAX_STREAMS')
 _STREAM_SLOTS = threading.BoundedSemaphore(MAX_STREAMS)
 
 
@@ -993,7 +993,7 @@ class ApiServer:
         # per cluster; runner/request processes proxy through the
         # socket instead of spawning per-request SSH channels.
         self.broker = None
-        if os.environ.get('SKYT_CHANNEL_BROKER', '1') != '0':
+        if env_registry.get_bool('SKYT_CHANNEL_BROKER'):
             from skypilot_tpu.runtime.channel_broker import ChannelBroker
             try:
                 self.broker = ChannelBroker()

@@ -18,7 +18,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-from skypilot_tpu.utils import events, log, resilience
+from skypilot_tpu.utils import env_registry, events, log, resilience
 
 logger = log.init_logger(__name__)
 
@@ -343,9 +343,10 @@ def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
     daemons = []
     if server_id is not None:
         def _ha_interval() -> float:
-            env = os.environ.get('SKYT_REQUESTS_HA_INTERVAL')
-            if env:           # helm: ha.requestsTickSeconds
-                return float(env)
+            # helm: ha.requestsTickSeconds
+            env = env_registry.get_float('SKYT_REQUESTS_HA_INTERVAL')
+            if env is not None:
+                return env
             return _interval('requests_ha_interval', 5.0)()
 
         daemons.append(

@@ -31,14 +31,14 @@ from typing import Dict, List, Optional
 
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus, ScheduleType
-from skypilot_tpu.utils import events, log, resilience
+from skypilot_tpu.utils import env_registry, events, log, resilience
 from skypilot_tpu.utils.subprocess_utils import kill_process_tree
 
 logger = log.init_logger(__name__)
 
 DEFAULT_WORKERS = {
-    ScheduleType.LONG: int(os.environ.get('SKYT_LONG_WORKERS', '4')),
-    ScheduleType.SHORT: int(os.environ.get('SKYT_SHORT_WORKERS', '16')),
+    ScheduleType.LONG: env_registry.get_int('SKYT_LONG_WORKERS'),
+    ScheduleType.SHORT: env_registry.get_int('SKYT_SHORT_WORKERS'),
 }
 
 # How long a RUNNING request may have a dead pid before the monitor
@@ -58,13 +58,9 @@ def _idle_wait_cap(has_wake_source: bool = True) -> float:
     working wake source (eventing disabled, or a runner whose external
     signal failed to build — it has no in-process publishers either),
     the legacy 0.5 s cap stays the latency floor."""
-    env = os.environ.get('SKYT_EXECUTOR_IDLE_FALLBACK')
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            logger.warning('ignoring malformed '
-                           'SKYT_EXECUTOR_IDLE_FALLBACK=%r', env)
+    env = env_registry.get_float('SKYT_EXECUTOR_IDLE_FALLBACK')
+    if env is not None:
+        return env
     return 2.0 if (events.enabled() and has_wake_source) else 0.5
 
 

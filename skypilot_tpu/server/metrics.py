@@ -21,6 +21,32 @@ from typing import Dict, Iterable, List, Optional, Tuple
 _lock = threading.Lock()
 
 
+class _LabelSchema:
+    """Declared label keys for one family. Emitting with a different
+    key set raises: a missing label silently forks a second timeseries
+    and an extra one explodes cardinality — the skylint SKYT003 pass
+    checks call sites statically, this catches dynamic **labels.
+    ``keys=None`` (ad-hoc/test metrics) disables the check; every
+    metric declared in THIS module carries an explicit schema (skylint
+    rejects declarations without one)."""
+
+    __slots__ = ('name', 'keys')
+
+    def __init__(self, name: str,
+                 keys: Optional[Tuple[str, ...]]) -> None:
+        self.name = name
+        self.keys = None if keys is None else tuple(sorted(keys))
+
+    def validate(self, labels: Dict[str, str]) -> None:
+        if self.keys is None:
+            return
+        passed = tuple(sorted(labels))
+        if passed != self.keys:
+            raise ValueError(
+                f'{self.name} emitted with labels {list(passed)} but '
+                f'declared {list(self.keys)}')
+
+
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(labels.items()))
 
@@ -33,12 +59,15 @@ def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
 
 
 class Counter:
-    def __init__(self, name: str, help_text: str) -> None:
+    def __init__(self, name: str, help_text: str,
+                 labels: Optional[Tuple[str, ...]] = None) -> None:
         self.name = name
         self.help = help_text
+        self.schema = _LabelSchema(name, labels)
         self._values: Dict[Tuple, float] = {}
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
+        self.schema.validate(labels)
         key = _label_key(labels)
         with _lock:
             self._values[key] = self._values.get(key, 0.0) + amount
@@ -53,12 +82,15 @@ class Counter:
 
 
 class Gauge:
-    def __init__(self, name: str, help_text: str) -> None:
+    def __init__(self, name: str, help_text: str,
+                 labels: Optional[Tuple[str, ...]] = None) -> None:
         self.name = name
         self.help = help_text
+        self.schema = _LabelSchema(name, labels)
         self._values: Dict[Tuple, float] = {}
 
     def set(self, value: float, **labels: str) -> None:
+        self.schema.validate(labels)
         with _lock:
             self._values[_label_key(labels)] = float(value)
 
@@ -76,9 +108,11 @@ _DEFAULT_BUCKETS = (1, 5, 10, 30, 60, 120, 300, 600, 1800, float('inf'))
 
 class Histogram:
     def __init__(self, name: str, help_text: str,
-                 buckets: Iterable[float] = _DEFAULT_BUCKETS) -> None:
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS,
+                 labels: Optional[Tuple[str, ...]] = None) -> None:
         self.name = name
         self.help = help_text
+        self.schema = _LabelSchema(name, labels)
         self.buckets = tuple(sorted(buckets))
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
@@ -86,6 +120,7 @@ class Histogram:
         self._samples: Dict[Tuple, List[float]] = {}
 
     def observe(self, value: float, **labels: str) -> None:
+        self.schema.validate(labels)
         key = _label_key(labels)
         with _lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
@@ -130,25 +165,32 @@ class Histogram:
 # -- the server's registry ---------------------------------------------
 
 REQUESTS_TOTAL = Counter(
-    'skyt_requests_total', 'API requests by payload name and final status')
+    'skyt_requests_total', 'API requests by payload name and final status',
+    labels=('name', 'status'))
 QUEUE_DEPTH = Gauge(
-    'skyt_request_queue_depth', 'Pending requests per executor queue')
+    'skyt_request_queue_depth', 'Pending requests per executor queue',
+    labels=('queue',))
 PROVISION_SECONDS = Histogram(
-    'skyt_provision_seconds', 'Cluster provision latency (seconds)')
+    'skyt_provision_seconds', 'Cluster provision latency (seconds)',
+    labels=('cloud',))
 DAEMON_TICKS = Counter(
-    'skyt_daemon_ticks_total', 'Background daemon loop iterations')
+    'skyt_daemon_ticks_total', 'Background daemon loop iterations',
+    labels=('daemon',))
 RUNTIME_EVENTS = Counter(
     'skyt_runtime_events_total',
-    'Job-state transitions pushed over cluster runtime channels')
+    'Job-state transitions pushed over cluster runtime channels',
+    labels=('status',))
 EVENT_WAKEUPS = Counter(
     'skyt_event_wakeups_total',
     'Control-plane loop wakeups by notification-bus topic and source '
     '(event=in-process notify, external=LISTEN/NOTIFY or data_version, '
-    'catchup=lost notify found at fallback, fallback=degraded poll)')
+    'catchup=lost notify found at fallback, fallback=degraded poll)',
+    labels=('topic', 'source'))
 NOTIFICATIONS = Counter(
     'skyt_notifications_total',
     'Notification-bus publishes by topic and outcome '
-    '(delivered vs suppressed)')
+    '(delivered vs suppressed)',
+    labels=('topic', 'outcome'))
 
 # -- serve data plane (incremented by the async LB inside each service
 # process; scraped from the LB's own /-/lb/metrics path, since the LB
@@ -160,16 +202,19 @@ _TTFB_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 LB_REQUESTS = Counter(
     'skyt_lb_requests_total',
     'Serve LB proxied requests by outcome (ok, no_replica, saturated, '
-    'upstream_error, no_retry, aborted, client_abort)')
+    'upstream_error, no_retry, aborted, client_abort)',
+    labels=('outcome',))
 LB_TTFB = Histogram(
     'skyt_lb_ttfb_seconds',
     'Serve LB time from request arrival to upstream response head '
     '(the streamed-TTFT floor through the proxy)',
-    buckets=_TTFB_BUCKETS)
+    buckets=_TTFB_BUCKETS,
+    labels=())
 LB_POOL_REUSE = Counter(
     'skyt_lb_pool_reuse_total',
     'Serve LB upstream requests served over a reused keep-alive '
-    'connection (vs a fresh TCP dial)')
+    'connection (vs a fresh TCP dial)',
+    labels=())
 
 _LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE]
 
@@ -182,15 +227,18 @@ _TRANSFER_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120,
 TRANSFER_BYTES = Counter(
     'skyt_transfer_bytes_total',
     'Transfer-engine object bytes moved by direction (up, down, copy) '
-    'and outcome')
+    'and outcome',
+    labels=('direction', 'outcome'))
 TRANSFER_OBJECTS = Counter(
     'skyt_transfer_objects_total',
     'Transfer-engine objects by direction and outcome (ok, skipped = '
-    'delta-sync hit, retried = per-attempt retries, error)')
+    'delta-sync hit, retried = per-attempt retries, error)',
+    labels=('direction', 'outcome'))
 TRANSFER_SECONDS = Histogram(
     'skyt_transfer_seconds',
     'Wall-clock seconds per transfer-engine sync/copy operation',
-    buckets=_TRANSFER_BUCKETS)
+    buckets=_TRANSFER_BUCKETS,
+    labels=('direction',))
 
 _TRANSFER_METRICS = [TRANSFER_BYTES, TRANSFER_OBJECTS, TRANSFER_SECONDS]
 
@@ -205,14 +253,33 @@ JOB_RECOVERIES = Counter(
     'skyt_job_recoveries_total',
     'Managed-job world-size transitions by mode (launch = initial '
     'topology, relaunch = rigid full recovery, shrink = elastic '
-    'degrade to surviving slices, grow = elastic re-expansion)')
+    'degrade to surviving slices, grow = elastic re-expansion)',
+    labels=('mode',))
 JOB_RESIZE_SECONDS = Histogram(
     'skyt_job_resize_seconds',
     'Managed-job recovery latency by mode: preemption detection (or '
     'grow trigger) to the payload running again at the new topology',
-    buckets=_RESIZE_BUCKETS)
+    buckets=_RESIZE_BUCKETS,
+    labels=('mode',))
 
 _JOB_METRICS = [JOB_RECOVERIES, JOB_RESIZE_SECONDS]
+
+# -- dynamically named families ----------------------------------------
+# Families whose full name is computed at emission time (the inference
+# server renders one gauge/counter per engine stat). skylint SKYT003
+# rejects computed skyt_* names outside these prefixes, and the
+# counter-vs-gauge split for the inference stats is declared HERE so
+# the emitting module cannot drift from it: cumulative quantities are
+# counters (rate()-able), point-in-time quantities stay gauges.
+DYNAMIC_FAMILY_PREFIXES = ('skyt_inference_',)
+
+INFERENCE_COUNTER_STATS = frozenset({
+    'requests', 'completions', 'request_errors',
+    'tokens_generated', 'decode_seconds', 'queue_wait_seconds',
+    'prefill_chunks', 'prefill_errors',
+    'prefix_cache_hits', 'prefix_cache_misses', 'prefix_tokens_reused',
+    'preemptions',
+})
 # Highest recovery_events row id already folded into _JOB_METRICS.
 _recovery_cursor = 0
 

@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import events
 from skypilot_tpu.utils import fault_injection
 
@@ -514,9 +515,11 @@ def default_stale_seconds() -> float:
     requests requeue daemon AND the serve controller fencing so one
     knob governs when a replica counts as dead."""
     from skypilot_tpu import config
+    env = env_registry.get_float('SKYT_SERVER_STALE_S', default=None)
+    if env is not None:
+        return env
     return float(
-        os.environ.get('SKYT_SERVER_STALE_S')
-        or config.get_nested(('api_server', 'server_stale_seconds'), 15.0))
+        config.get_nested(('api_server', 'server_stale_seconds'), 15.0))
 
 
 # -- shared self-DB-health gate ---------------------------------------------

@@ -253,11 +253,24 @@ def set_workspace_role(workspace: str, user_name: str, role: str) -> None:
             f'service account {user_name!r} cannot be a workspace '
             "admin (use 'editor' or 'viewer')")
     conn = _db()
-    conn.execute(
-        'INSERT INTO workspace_roles (workspace, user_name, role) '
-        'VALUES (?, ?, ?) ON CONFLICT (workspace, user_name) '
-        'DO UPDATE SET role = excluded.role',
-        (workspace, user_name, role))
+    # Portable upsert (skylint SKYT007): ON CONFLICT .. DO UPDATE
+    # needs sqlite >= 3.24 — the same runner class that PR 2's
+    # UPDATE..RETURNING outage hit. UPDATE, INSERT on miss, and if a
+    # concurrent writer wins the INSERT race, re-UPDATE so both
+    # callers succeed (matching the old upsert's no-error semantics).
+    cur = conn.execute(
+        'UPDATE workspace_roles SET role = ? WHERE workspace = ? '
+        'AND user_name = ?', (role, workspace, user_name))
+    if cur.rowcount == 0:
+        try:
+            conn.execute(
+                'INSERT INTO workspace_roles (workspace, user_name, '
+                'role) VALUES (?, ?, ?)', (workspace, user_name, role))
+        except sqlite3.IntegrityError:
+            conn.execute(
+                'UPDATE workspace_roles SET role = ? WHERE '
+                'workspace = ? AND user_name = ?',
+                (role, workspace, user_name))
     conn.commit()
 
 

@@ -86,26 +86,6 @@ def new_request_id() -> str:
     return uuid.uuid4().hex
 
 
-def env_int(name: str, default: int,
-            minimum: Optional[int] = None) -> int:
-    """Integer env knob: ``default`` when unset, non-integer, or below
-    ``minimum`` (shared by SKYT_* tuning knobs so parsing semantics
-    can't drift between subsystems)."""
-    raw = os.environ.get(name, '').strip()
-    if not raw:
-        return default
-    try:
-        value = int(raw)
-    except ValueError:
-        from skypilot_tpu.utils import log
-        log.init_logger(__name__).warning(
-            'ignoring non-integer %s=%r', name, raw)
-        return default
-    if minimum is not None and value < minimum:
-        return default
-    return value
-
-
 class Backoff:
     """Decorrelated-jitter exponential backoff (provisioner retry loops;
 

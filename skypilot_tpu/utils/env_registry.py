@@ -1,0 +1,440 @@
+"""Typed registry of every ``SKYT_*`` environment knob.
+
+The platform grew ~100 env knobs by convention — each one parsed ad hoc
+(`int(os.environ.get(...))`, `!= '0'`, `in ('1','true','yes')`) at its
+read site, with no central list, no types, and no docs. This module is
+the single source of truth:
+
+* **Declarations** — :data:`REGISTRY` maps every knob to an
+  :class:`EnvVar` (name, type, default, one-line doc). Dynamic families
+  (``SKYT_JOBGROUP_HOSTS_<TASK>``) are declared as ``*`` patterns.
+* **Typed accessors** — :func:`get_int` / :func:`get_float` /
+  :func:`get_bool` / :func:`get_str` replace scattered raw parsing
+  (semantics follow ``common_utils.env_int``: unset or unparsable
+  reads as the declared default, never an exception on a hot path).
+  Accessing an UNDECLARED name raises ``KeyError`` — a typo'd knob
+  fails loudly in tests instead of silently reading its default.
+* **Lint + docs** — the ``skylint`` SKYT002 pass cross-checks every
+  env reference in the package against this table, and
+  ``python -m skypilot_tpu.lint --dump-env-docs`` renders it as
+  ``docs/env_vars.md`` (committed copy is verified in sync).
+
+Keep declarations sorted by name within their group; a new knob MUST be
+declared here before code reads it (skylint enforces this in tier-1).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, NamedTuple, Optional
+
+# Valid declaration types. 'path' and 'url' parse as strings; the
+# distinction is documentation (and lets docs/env_vars.md group them).
+TYPES = ('str', 'int', 'float', 'bool', 'path', 'url')
+
+
+class EnvVar(NamedTuple):
+    name: str
+    type: str
+    default: object  # rendered into docs; None = unset/disabled
+    doc: str
+    # True for knobs consumed outside the package's own python sources
+    # (recipe payloads, shell templates): the SKYT002
+    # declared-but-unreferenced check exempts them.
+    external: bool = False
+
+    @property
+    def is_pattern(self) -> bool:
+        return self.name.endswith('*')
+
+
+def _decl(entries: Iterable[tuple]) -> List[EnvVar]:
+    out = []
+    for entry in entries:
+        var = EnvVar(*entry)
+        assert var.type in TYPES, f'{var.name}: bad type {var.type!r}'
+        out.append(var)
+    return out
+
+
+DECLARATIONS: List[EnvVar] = _decl([
+    # -- core state / identity --------------------------------------
+    ('SKYT_STATE_DIR', 'path', '~/.skyt',
+     'Root directory for all local state (DBs, logs, catalogs, '
+     'transfer manifests).'),
+    ('SKYT_DB_URL', 'url', None,
+     'Postgres URL for shared control-plane state; unset = local '
+     'sqlite under SKYT_STATE_DIR.'),
+    ('SKYT_CONFIG', 'path', None,
+     'Explicit layered-config YAML path (overrides project/user '
+     'config discovery).'),
+    ('SKYT_WORKSPACE', 'str', None,
+     'Active workspace; exported to request children and job '
+     'controllers for multi-tenant scoping.'),
+    ('SKYT_USER_HASH', 'str', None,
+     'Override the stable 8-hex user/machine id.'),
+    ('SKYT_LOG_LEVEL', 'str', 'INFO',
+     'Root logger level (DEBUG/INFO/WARNING/ERROR).'),
+    ('SKYT_TIMELINE_FILE', 'path', None,
+     'Write opt-in Chrome-trace timeline JSON to this path.'),
+    ('SKYT_CHECK_CACHE_TTL', 'float', 300.0,
+     'Cloud-credential check cache TTL (seconds).'),
+    ('SKYT_FAULT_SPEC', 'str', None,
+     'Deterministic fault-injection spec '
+     '(site:Exception[:p=..][:seed=..][:times=..], comma-separated; '
+     'docs/fault_tolerance.md).'),
+
+    # -- notification bus -------------------------------------------
+    ('SKYT_EVENTS_DISABLED', 'bool', False,
+     'Disable the notification bus; control-plane loops fall back to '
+     'their legacy fixed-cadence polls.'),
+    ('SKYT_EVENTS_SLICE', 'float', 0.02,
+     'External-signal (LISTEN/NOTIFY, data_version) check cadence '
+     'inside event waits (seconds).'),
+
+    # -- API server + executor --------------------------------------
+    ('SKYT_SERVER_DIR', 'path', None,
+     'API-server state dir override (default: SKYT_STATE_DIR/server).'),
+    ('SKYT_SERVER_ID', 'str', None,
+     'Stable API-server replica identity (HA fencing / heartbeats).'),
+    ('SKYT_API_SERVER_URL', 'url', None,
+     'Client: remote API server base URL (unset = in-process local '
+     'mode).'),
+    ('SKYT_API_SERVER_TOKEN', 'str', None,
+     'Server: static bearer token accepted for API auth.'),
+    ('SKYT_API_TOKEN', 'str', None,
+     'Client: bearer token sent with API requests.'),
+    ('SKYT_CLIENT_RETRIES', 'int', 4,
+     'Client HTTP retry attempts against the API server.'),
+    ('SKYT_MAX_STREAMS', 'int', 64,
+     'Concurrent log-stream responses before the server sheds with '
+     '429.'),
+    ('SKYT_LONG_WORKERS', 'int', 4,
+     'Executor worker slots for the LONG request queue.'),
+    ('SKYT_SHORT_WORKERS', 'int', 16,
+     'Executor worker slots for the SHORT request queue.'),
+    ('SKYT_EXECUTOR_IDLE_FALLBACK', 'float', None,
+     'Executor idle fallback-poll seconds override (default 2.0 '
+     'event-driven, 0.5 degraded).'),
+    ('SKYT_REQUESTS_HA_INTERVAL', 'float', None,
+     'HA requeue daemon tick override (seconds).'),
+    ('SKYT_SERVER_STALE_S', 'float', 15.0,
+     'Heartbeat age before a peer API server counts as dead and its '
+     'requests are requeued.'),
+    ('SKYT_CHANNEL_BROKER', 'bool', True,
+     'Run the channel-broker socket in the API server (0 disables).'),
+    ('SKYT_DAG_MAX_CONCURRENCY', 'int', 16,
+     'DAG executor thread cap for pipeline fan-out.'),
+    ('SKYT_PIPELINE_POLL_SECONDS', 'float', 5.0,
+     'Pipeline stage-wait poll cadence (seconds).'),
+    ('SKYT_PIPELINE_POLL_RETRIES', 'int', 10,
+     'Transient status-poll error budget before a pipeline wait '
+     'fails.'),
+    ('SKYT_PIPELINE_DAEMON_GRACE_SECONDS', 'float', 60.0,
+     'Pipeline daemon shutdown grace (seconds).'),
+
+    # -- catalog ----------------------------------------------------
+    ('SKYT_CATALOG_FEED', 'url', None,
+     'Hardware catalog feed (https://, file://, or plain path to the '
+     'fetcher JSON).'),
+    ('SKYT_CATALOG_TTL_HOURS', 'float', 24.0,
+     'Catalog refresh TTL (hours).'),
+
+    # -- cluster runtime (on-node daemon, channels) -----------------
+    ('SKYT_RUNTIME_CHANNEL', 'bool', True,
+     'Use the persistent runtime channel for job-table ops (0 = SSH '
+     'fallback).'),
+    ('SKYT_RUNTIME_SKIP_IMPORT_CHECK', 'bool', False,
+     'Skip the remote runtime import verification after setup.'),
+    ('SKYT_RUNTIME_PKG_CACHE', 'path', None,
+     'Runtime tarball cache dir (default: SKYT_STATE_DIR/'
+     'runtime_pkg).'),
+    ('SKYT_CHANNEL_TIMEOUT', 'float', 120.0,
+     'Runtime channel RPC timeout (seconds).'),
+    ('SKYT_CHANNEL_BROKER_SOCK', 'path', None,
+     'Inherited channel-broker unix socket path (request children '
+     'proxy job-table ops through it).'),
+    ('SKYT_CHANNEL_WATCH_PERIOD', 'float', 0.3,
+     'Channel server job-table watch cadence (seconds).'),
+    ('SKYT_CHANNEL_WATCH_FALLBACK', 'float', None,
+     'Channel watcher degraded-poll override (seconds).'),
+    ('SKYT_DAEMON_PERIOD', 'float', 1.0,
+     'On-node daemon event-loop cadence (seconds).'),
+    ('SKYT_DAEMON_START_GRACE', 'float', 20.0,
+     'Seconds to wait for the on-node daemon startup marker.'),
+    ('SKYT_TAIL_DAEMON_GRACE', 'float', 45.0,
+     'Log-tail daemon linger after the job finishes (seconds).'),
+    ('SKYT_GANG_START_DEADLINE', 'float', 60.0,
+     'Gang start barrier deadline across pod-slice hosts (seconds).'),
+    ('SKYT_MAX_CONCURRENT_JOBS', 'int', 16,
+     'Per-node concurrent job cap in the runtime daemon.'),
+
+    # -- payload topology (exported to tasks by codegen) ------------
+    ('SKYT_NODE_RANK', 'int', None,
+     'Payload: this host\'s node index within its slice.', True),
+    ('SKYT_NODE_IPS', 'str', None,
+     'Payload: newline-separated internal IPs of the slice.', True),
+    ('SKYT_NUM_NODES', 'int', None,
+     'Payload: node count of the slice.', True),
+    ('SKYT_COORDINATOR_ADDRESS', 'str', None,
+     'Payload: jax.distributed coordinator host:port.', True),
+    ('SKYT_CLUSTER_NAME', 'str', None,
+     'Payload: owning cluster name.', True),
+    ('SKYT_TPU_ACCELERATOR', 'str', None,
+     'Payload: TPU accelerator name (e.g. v5p-128).', True),
+    ('SKYT_TPU_TOPOLOGY', 'str', None,
+     'Payload: TPU ICI topology string.', True),
+
+    # -- managed jobs -----------------------------------------------
+    ('SKYT_JOBS_CONTROLLER_POLL', 'float', 10.0,
+     'Managed-jobs controller fallback poll (seconds); preemption '
+     'reaction normally rides CLUSTERS events.'),
+    ('SKYT_JOBS_EVENT_MIN_GAP', 'float', 0.5,
+     'Coalescing window for CLUSTERS event bursts in the jobs '
+     'controller (seconds).'),
+    ('SKYT_JOBS_CONTROLLER_CLUSTER', 'str', None,
+     'Run managed-job controllers on this cluster instead of '
+     'locally.'),
+    ('SKYT_JOBS_CONTROLLER_MAX_RESTARTS', 'int', None,
+     'Supervision restart budget for job controllers.'),
+    ('SKYT_JOBS_MAX_LAUNCHING', 'int', None,
+     'Scheduler cap on concurrently-launching managed jobs.'),
+    ('SKYT_JOBS_MAX_ALIVE', 'int', None,
+     'Scheduler cap on alive managed jobs.'),
+    ('SKYT_JOBS_MAX_LAUNCH_RETRIES', 'int', None,
+     'Launch retry budget per recovery attempt.'),
+    ('SKYT_JOBS_LAUNCH_RETRY_GAP', 'float', None,
+     'Gap between managed-job launch retries (seconds).'),
+    ('SKYT_JOBS_LOG_RETENTION_HOURS', 'float', 24.0,
+     'Managed-job log GC retention (hours).'),
+    ('SKYT_JOBGROUP', 'str', None,
+     'Payload: gang-scheduled job-group name.', True),
+    ('SKYT_JOBGROUP_HOSTS_*', 'str', None,
+     'Payload: comma-separated host IPs per group member task '
+     '(suffix = sanitized task name).', True),
+    ('SKYT_JOBGROUP_BARRIER_TIMEOUT', 'float', 1800.0,
+     'Job-group provision barrier timeout (seconds).'),
+    ('SKYT_POOL', 'str', None,
+     'Payload: pool name a batch worker should claim work from '
+     '(recipes).', True),
+    ('SKYT_ELASTIC', 'bool', False,
+     'Payload: set when the gang runs under the elastic recovery '
+     'strategy.', True),
+    ('SKYT_ELASTIC_SLICES', 'int', None,
+     'Payload: current elastic world size (slice count) to resolve '
+     'the mesh for.', True),
+    ('SKYT_RESIZE_SIGNAL', 'path', None,
+     'Payload: path of the resize handshake file; the trainer exits '
+     'at the next step boundary when it appears.', True),
+
+    # -- serve ------------------------------------------------------
+    ('SKYT_SERVE_CONTROLLER_POLL', 'float', 10.0,
+     'Serve controller probe/reconcile cadence (seconds).'),
+    ('SKYT_SERVE_CONTROLLER_CLUSTER', 'str', None,
+     'Run serve controllers on this cluster instead of locally.'),
+    ('SKYT_SERVE_CONTROLLER_MAX_RESTARTS', 'int', None,
+     'Supervision restart budget for serve controllers.'),
+    ('SKYT_SERVE_ON_CLUSTER', 'bool', False,
+     'Set inside cluster-hosted serve controllers (changes state-dir '
+     'resolution).'),
+    ('SKYT_SERVE_LB_HOST', 'str', '127.0.0.1',
+     'Bind host for service load balancers.'),
+    ('SKYT_SERVE_ENDPOINT_HOST', 'str', None,
+     'Advertised endpoint host override for serve services.'),
+    ('SKYT_SERVE_NOT_READY_THRESHOLD', 'int', 3,
+     'Consecutive failed probes before a replica is NOT_READY.'),
+    ('SKYT_SERVE_REPLICA_PORT', 'int', None,
+     'Payload: port a serve replica must listen on.', True),
+    ('SKYT_SERVE_REPLICA_ID', 'int', None,
+     'Payload: replica id within its service.', True),
+    ('SKYT_LB_POOL_SIZE', 'int', 8,
+     'LB: max idle keep-alive connections kept per replica (0 '
+     'disables pooling).'),
+    ('SKYT_LB_POOL_IDLE_SECONDS', 'float', 30.0,
+     'LB: idle connection lifetime before reaping (seconds).'),
+    ('SKYT_LB_MAX_INFLIGHT', 'int', 256,
+     'LB: concurrent proxied requests before fast-fail 503.'),
+    ('SKYT_LB_EJECT_THRESHOLD', 'int', 3,
+     'LB: consecutive upstream failures before passive ejection.'),
+    ('SKYT_LB_EJECT_SECONDS', 'float', 10.0,
+     'LB: ejection duration before a half-open re-probe (seconds).'),
+    ('SKYT_LB_EWMA_ALPHA', 'float', 0.3,
+     'LB: TTFB EWMA smoothing factor for the p2c_ewma policy.'),
+    ('SKYT_LB_UPSTREAM_TIMEOUT', 'float', 300.0,
+     'LB: per-read upstream timeout (seconds).'),
+
+    # -- data plane -------------------------------------------------
+    ('SKYT_TRANSFER_WORKERS', 'int', 16,
+     'Transfer engine bounded worker-pool size.'),
+    ('SKYT_TRANSFER_PART_SIZE', 'int', 8 * 1024 * 1024,
+     'Transfer engine part size for multipart/ranged I/O (bytes).'),
+    ('SKYT_TRANSFER_MULTIPART_THRESHOLD', 'int', None,
+     'Object size that triggers multipart/ranged transfer (default '
+     '2x part size).'),
+    ('SKYT_TRANSFER_RETRIES', 'int', 4,
+     'Transfer engine per-object attempt budget.'),
+    ('SKYT_TRANSFER_DELTA', 'bool', True,
+     'Manifest-based delta sync (0 forces full re-transfer).'),
+    ('SKYT_S3_ENDPOINT_URL', 'url', None,
+     'S3-compatible endpoint override (tests point it at fake_s3).'),
+    ('SKYT_AZURE_BLOB_ENDPOINT', 'url', None,
+     'Azure Blob endpoint override (tests point it at the fake).'),
+
+    # -- inference --------------------------------------------------
+    ('SKYT_INFER_BLOCK_SIZE', 'int', 16,
+     'Paged KV cache block size (tokens per block).'),
+    ('SKYT_INFER_PREFILL_CHUNK', 'int', 64,
+     'Chunked-prefill budget interleaved per decode step (tokens).'),
+
+    # -- provisioning -----------------------------------------------
+    ('SKYT_K8S_FAKE', 'bool', False,
+     'Use the in-repo fake kubernetes API (tests).'),
+    ('SKYT_K8S_IMAGE', 'str', 'python:3.11-slim',
+     'Pod image for kubernetes-provisioned nodes.'),
+    ('SKYT_K8S_PROVISION_TIMEOUT', 'float', 600.0,
+     'Kubernetes pod provision deadline (seconds).'),
+    ('SKYT_SLURM_POLL_SECONDS', 'float', 2.0,
+     'Slurm job state poll cadence (seconds).'),
+    ('SKYT_SSH_NODE_POOLS', 'path', None,
+     'SSH node-pool inventory YAML (default: SKYT_STATE_DIR/'
+     'ssh_node_pools.yaml).'),
+    ('SKYT_FAKE_SSH_MODE', 'bool', False,
+     'Fake provider: expose nodes over fake SSH instead of '
+     'local-style exec (tests).'),
+    ('SKYT_FAKE_SSH_MAP', 'path', None,
+     'Fake provider: host->workdir map file (default: '
+     'SKYT_STATE_DIR/fake_ssh_map.json).'),
+])
+
+REGISTRY: Dict[str, EnvVar] = {
+    v.name: v for v in DECLARATIONS if not v.is_pattern}
+PATTERNS: List[EnvVar] = [v for v in DECLARATIONS if v.is_pattern]
+
+assert len(REGISTRY) + len(PATTERNS) == len(DECLARATIONS), (
+    'duplicate SKYT_* declaration')
+
+
+def lookup(name: str) -> Optional[EnvVar]:
+    """The declaration for ``name``, resolving dynamic families
+    through their ``*`` patterns. ``None`` = undeclared."""
+    var = REGISTRY.get(name)
+    if var is not None:
+        return var
+    for pat in PATTERNS:
+        if name.startswith(pat.name[:-1]):
+            return pat
+    return None
+
+
+def _require(name: str) -> EnvVar:
+    var = lookup(name)
+    if var is None:
+        raise KeyError(
+            f'{name} is not a declared SKYT_* knob; add it to '
+            'skypilot_tpu/utils/env_registry.py (skylint SKYT002 '
+            'enforces this)')
+    return var
+
+
+def _warn(name: str, raw: str) -> None:
+    from skypilot_tpu.utils import log
+    log.init_logger(__name__).warning(
+        'ignoring unparsable %s=%r (using declared default)', name, raw)
+
+
+def get_str(name: str, default: object = REGISTRY) -> Optional[str]:
+    """String/path/url knob; ``None`` when unset and no default.
+    (The ``REGISTRY`` sentinel means "use the declared default".)"""
+    var = _require(name)
+    raw = os.environ.get(name)
+    if raw:
+        return raw
+    return var.default if default is REGISTRY else default
+
+
+def get_int(name: str, default: object = REGISTRY,
+            minimum: Optional[int] = None) -> Optional[int]:
+    """Integer knob: declared default when unset, unparsable, or below
+    ``minimum`` (same semantics as ``common_utils.env_int``)."""
+    var = _require(name)
+    fallback = var.default if default is REGISTRY else default
+    raw = os.environ.get(name, '').strip()
+    if not raw:
+        return fallback
+    try:
+        value = int(raw)
+    except ValueError:
+        _warn(name, raw)
+        return fallback
+    if minimum is not None and value < minimum:
+        return fallback
+    return value
+
+
+def get_float(name: str, default: object = REGISTRY,
+              minimum: Optional[float] = None) -> Optional[float]:
+    var = _require(name)
+    fallback = var.default if default is REGISTRY else default
+    raw = os.environ.get(name, '').strip()
+    if not raw:
+        return fallback
+    try:
+        value = float(raw)
+    except ValueError:
+        _warn(name, raw)
+        return fallback
+    if minimum is not None and value < minimum:
+        return fallback
+    return value
+
+
+_FALSE = frozenset(('', '0', 'false', 'no', 'off'))
+
+
+def get_bool(name: str, default: object = REGISTRY) -> bool:
+    """Boolean knob: unset -> declared default; '0'/'false'/'no'/'off'
+    (case-insensitive) -> False; anything else set -> True. This
+    subsumes both legacy idioms (``!= '0'`` default-on knobs and
+    ``in ('1','true','yes')`` default-off knobs)."""
+    var = _require(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        fallback = var.default if default is REGISTRY else default
+        return bool(fallback)
+    return raw.strip().lower() not in _FALSE
+
+
+def is_set(name: str) -> bool:
+    """Whether the (declared) knob is present in the environment at
+    all — for call sites whose default depends on other state."""
+    _require(name)
+    return name in os.environ
+
+
+def render_docs() -> str:
+    """``docs/env_vars.md`` content, generated from the table (the
+    committed copy is checked in-sync by the lint pass)."""
+    lines = [
+        '# SKYT_* environment knobs',
+        '',
+        '<!-- GENERATED FILE — do not edit by hand. -->',
+        '<!-- Regenerate: python -m skypilot_tpu.lint --dump-env-docs '
+        '> docs/env_vars.md -->',
+        '',
+        'Every `SKYT_*` knob the platform reads, generated from the '
+        'typed declaration table in `skypilot_tpu/utils/'
+        'env_registry.py`. The skylint SKYT002 pass fails if code '
+        'references a knob missing from this table (or if this file '
+        'drifts from the table).',
+        '',
+        '| Name | Type | Default | Description |',
+        '| --- | --- | --- | --- |',
+    ]
+    for var in sorted(DECLARATIONS, key=lambda v: v.name):
+        default = '(unset)' if var.default is None else f'`{var.default}`'
+        name = var.name.replace('*', '\\*')
+        lines.append(f'| `{name}` | {var.type} | {default} | '
+                     f'{var.doc} |')
+    lines.append('')
+    lines.append(f'{len(DECLARATIONS)} declarations '
+                 f'({len(PATTERNS)} dynamic patterns).')
+    return '\n'.join(lines) + '\n'

@@ -51,7 +51,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from skypilot_tpu.utils import fault_injection, log
+from skypilot_tpu.utils import env_registry, fault_injection, log
 
 logger = log.init_logger(__name__)
 
@@ -79,14 +79,11 @@ SOURCES = ('event', 'external', 'catchup', 'fallback', 'stop')
 
 
 def enabled() -> bool:
-    return os.environ.get(DISABLE_ENV, '') not in ('1', 'true', 'yes')
+    return not env_registry.get_bool(DISABLE_ENV)
 
 
 def _slice_interval() -> float:
-    try:
-        return max(0.005, float(os.environ.get(SLICE_ENV, '0.02')))
-    except ValueError:
-        return 0.02
+    return max(0.005, env_registry.get_float(SLICE_ENV))
 
 
 def pg_channel(topic: str) -> str:

@@ -1,0 +1,6 @@
+# Fixture snippets for the skylint test suite (tests/test_skylint.py).
+# Each skyt00N_pos.py seeds exactly the violations its checker must
+# catch; each skyt00N_neg.py is the compliant twin. These files are
+# PARSED, never imported — and tests/lint_fixtures is excluded from the
+# real repo lint run (core.repo_paths), so deliberate violations here
+# can't fail the tier-1 gate.

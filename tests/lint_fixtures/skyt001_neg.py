@@ -1,0 +1,18 @@
+"""SKYT001 negative: async code done right, sync code unrestricted."""
+import asyncio
+import time
+
+from skypilot_tpu.server import requests_db
+
+
+async def handle_request(request_id):
+    await asyncio.sleep(0.5)
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, requests_db.get_request, request_id)
+
+
+def sync_helper():
+    # Blocking calls are fine OUTSIDE async defs.
+    time.sleep(0.5)
+    return requests_db.get_request('x')

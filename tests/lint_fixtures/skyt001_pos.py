@@ -1,0 +1,24 @@
+"""SKYT001 positive: blocking calls inside async defs."""
+import subprocess
+import time
+
+from skypilot_tpu.server import requests_db
+
+
+async def handle_request(request_id):
+    time.sleep(0.5)                       # stalls the event loop
+    return requests_db.get_request(request_id)   # sync sqlite I/O
+
+
+async def run_hook(cmd):
+    subprocess.run(cmd, check=True)       # blocks the loop
+
+
+class Proxy:
+    async def forward(self, conn):
+        def _read():
+            # Sync helper nested in an async def still runs on the
+            # loop when called.
+            time.sleep(0.1)
+        _read()
+        return conn

@@ -1,0 +1,21 @@
+"""SKYT002 negative: declared knobs, declared patterns, plain prose."""
+import os
+
+from skypilot_tpu.utils import env_registry
+
+
+def read_declared():
+    state = os.environ.get('SKYT_STATE_DIR', '~/.skyt')
+    retries = env_registry.get_int('SKYT_CLIENT_RETRIES')
+    return state, retries
+
+
+def build_child_env(task_name):
+    # Concrete name under the declared SKYT_JOBGROUP_HOSTS_* pattern.
+    return {f'SKYT_JOBGROUP_HOSTS_{task_name}': '10.0.0.1'}
+
+
+def docstring_mention():
+    """Prose mentioning SKYT_NOT_A_REAL_KNOB never counts — only
+    structured positions (call args, dict keys, subscripts) do."""
+    return None

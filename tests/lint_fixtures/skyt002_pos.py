@@ -1,0 +1,13 @@
+"""SKYT002 positive: undeclared / typo'd SKYT_* knobs."""
+import os
+
+
+def read_bogus_knob():
+    return os.environ.get('SKYT_TOTALLY_UNDECLARED_KNOB', '1')
+
+
+def build_child_env(name):
+    envs = {'SKYT_TYPOD_WORKSPAACE': 'w'}        # dict-literal typo
+    envs['SKYT_ANOTHER_TYPO_KNOB'] = '1'         # subscript-store typo
+    envs[f'SKYT_BOGUS_PREFIX_{name}'] = '1'      # undeclared pattern
+    return envs

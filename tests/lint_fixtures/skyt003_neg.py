@@ -1,0 +1,15 @@
+"""SKYT003 negative: emissions matching the declared schemas."""
+from skypilot_tpu.server import metrics
+
+
+def emit_correct(outcome, seconds):
+    metrics.QUEUE_DEPTH.set(3, queue='LONG')
+    metrics.LB_REQUESTS.inc(outcome=outcome)
+    metrics.TRANSFER_OBJECTS.inc(direction='up', outcome=outcome)
+    metrics.TRANSFER_SECONDS.observe(seconds, direction='up')
+    metrics.LB_POOL_REUSE.inc()
+
+
+def emit_dynamic(stat):
+    # Declared dynamic prefix.
+    return f'skyt_inference_{stat}'

@@ -1,0 +1,17 @@
+"""SKYT003 positive: metric type and label drift against the declared
+registry (the real server/metrics.py is part of the lint context)."""
+from skypilot_tpu.server import metrics
+
+
+def emit_drifted(outcome):
+    # Wrong method for the instrument: QUEUE_DEPTH is a Gauge.
+    metrics.QUEUE_DEPTH.inc(queue='LONG')
+    # Label drift: declared labels are ('outcome',).
+    metrics.LB_REQUESTS.inc(result=outcome)
+    # Missing label: TRANSFER_OBJECTS declares (direction, outcome).
+    metrics.TRANSFER_OBJECTS.inc(direction='up')
+
+
+def emit_dynamic(stat):
+    # Computed family outside DYNAMIC_FAMILY_PREFIXES.
+    return f'skyt_rogue_{stat}'

@@ -1,0 +1,14 @@
+"""SKYT004 fixture "package" module: two instrumented fault sites.
+
+``fixture.live_site`` is referenced by skyt004_test.py (covered);
+``fixture.dead_site`` is referenced by nothing (dead-site finding).
+"""
+from skypilot_tpu.utils import fault_injection
+
+
+def covered_path():
+    fault_injection.inject('fixture.live_site')
+
+
+def uncovered_path():
+    fault_injection.inject('fixture.dead_site')

@@ -1,0 +1,14 @@
+"""SKYT004 fixture "test" module (fed to the checker as a test file):
+one spec targets a real site, one targets a ghost site."""
+from tests.fault_injection import inject_faults
+
+
+def test_live_site_chaos():
+    with inject_faults('fixture.live_site:OperationalError:p=0.5'):
+        pass
+
+
+def test_ghost_site_chaos():
+    # No inject() implements this site: the chaos test is vacuous.
+    with inject_faults('fixture.no_such_site:OperationalError'):
+        pass

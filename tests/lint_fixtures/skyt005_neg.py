@@ -1,0 +1,12 @@
+"""SKYT005 negative: a declared topic with both a publisher and a
+subscriber in the context."""
+from skypilot_tpu.utils import events
+
+
+def writer(conn):
+    events.publish(events.REQUESTS, conn=conn)
+
+
+def reader():
+    cursor, source = events.wait_for(events.REQUESTS, 0, 1.0)
+    return cursor, source

@@ -1,0 +1,19 @@
+"""SKYT005 positive: undeclared topic, wait-without-publisher,
+publish-without-subscriber (real utils/events.py is in the context)."""
+from skypilot_tpu.utils import events
+
+
+def publish_typo(conn):
+    # Literal topic not declared in utils/events.py.
+    events.publish('requsts', conn=conn)
+
+
+def wait_never_published():
+    # SERVE is declared, but nothing in THIS context publishes it.
+    cursor, _ = events.wait_for(events.SERVE, 0, 1.0)
+    return cursor
+
+
+def publish_unheard(conn):
+    # CLUSTERS is declared, published here, referenced nowhere else.
+    events.publish(events.CLUSTERS, conn=conn)

@@ -1,0 +1,22 @@
+"""SKYT006 negative: consistent acquisition order everywhere."""
+import threading
+
+_outer_lock = threading.Lock()
+_inner_lock = threading.Lock()
+
+
+def path_one():
+    with _outer_lock:
+        with _inner_lock:
+            return 'ab'
+
+
+def path_two():
+    with _outer_lock:
+        with _inner_lock:
+            return 'ab again'
+
+
+def inner_only():
+    with _inner_lock:
+        return 'b'

@@ -1,0 +1,37 @@
+"""SKYT006 positive: a seeded lock-order cycle.
+
+``claim_then_publish`` holds _claim_lock and takes _publish_lock;
+``publish_then_claim`` inverts the order — the classic AB/BA deadlock
+an unlucky interleaving turns real.
+"""
+import threading
+
+_claim_lock = threading.Lock()
+_publish_lock = threading.Lock()
+
+
+def claim_then_publish():
+    with _claim_lock:
+        with _publish_lock:
+            return 'ab'
+
+
+def publish_then_claim():
+    with _publish_lock:
+        with _claim_lock:
+            return 'ba'
+
+
+class Store:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a, self._b:
+            return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                return 2

@@ -1,0 +1,9 @@
+"""SKYT007 negative: portable SQL, and prose that merely mentions the
+keywords."""
+
+
+def portable_upsert(conn, key, value):
+    """Docstrings may discuss RETURNING or ON CONFLICT freely."""
+    cur = conn.execute('UPDATE kv SET v = ? WHERE k = ?', (value, key))
+    if cur.rowcount == 0:
+        conn.execute('INSERT INTO kv (k, v) VALUES (?, ?)', (key, value))

@@ -1,0 +1,14 @@
+"""SKYT007 positive: sqlite dialect features outside the adaptive
+helpers."""
+
+
+def upsert(conn, key, value):
+    conn.execute(
+        'INSERT INTO kv (k, v) VALUES (?, ?) '
+        'ON CONFLICT (k) DO UPDATE SET v = excluded.v', (key, value))
+
+
+def claim(conn, request_id):
+    return conn.execute(
+        'UPDATE requests SET status = ? WHERE request_id = ? '
+        'RETURNING request_id', ('RUNNING', request_id))

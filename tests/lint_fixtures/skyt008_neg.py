@@ -1,0 +1,18 @@
+"""SKYT008 negative: pure jitted code; impure host code outside jit."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_step(state, key):
+    noise = jax.random.normal(key, state.shape)   # explicit-key RNG
+    jax.debug.print('step {}', state)             # runs per call
+    return state + noise
+
+
+def host_loop(state, key):
+    started = time.time()          # fine: not traced
+    print('starting', started)
+    return pure_step(state, key)

@@ -1,0 +1,27 @@
+"""SKYT008 positive: host-side effects inside jitted functions."""
+import functools
+import random
+import time
+
+import jax
+
+
+@jax.jit
+def decorated_step(state):
+    print('step', state)          # trace-time only
+    t0 = time.time()              # frozen at trace time
+    return state, t0
+
+
+@functools.partial(jax.jit, static_argnames=('cfg',))
+def partial_decorated_step(state, cfg):
+    noise = random.random()       # traced once, constant thereafter
+    return state, noise, cfg
+
+
+def wrapped_step(state):
+    jitter = random.random()
+    return state, jitter
+
+
+wrapped = jax.jit(wrapped_step)

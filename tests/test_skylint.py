@@ -1,0 +1,271 @@
+"""skylint: the linter's own suite + the tier-1 repo gate.
+
+Two layers:
+
+* fixture tests — every checker (SKYT001..SKYT008) has a positive
+  fixture that must produce its finding and a negative twin that must
+  not, driven through the public ``Context``/``run_checks`` API over
+  ``tests/lint_fixtures/``;
+* the repo gate — ``python -m skypilot_tpu.lint`` (via its ``main()``)
+  must exit 0 over the real repository: zero non-baselined findings,
+  baseline entries all reviewed and live, ``docs/env_vars.md`` in sync
+  with the env-registry table.
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.lint import __main__ as lint_cli
+from skypilot_tpu.lint import core
+from skypilot_tpu.lint.checks_async import AsyncBlockingChecker
+from skypilot_tpu.lint.checks_chaos import ChaosCoverageChecker
+from skypilot_tpu.lint.checks_concurrency import LockOrderChecker
+from skypilot_tpu.lint.checks_env import EnvRegistryChecker
+from skypilot_tpu.lint.checks_events import EventTopicChecker
+from skypilot_tpu.lint.checks_metrics import MetricsRegistryChecker
+from skypilot_tpu.lint.checks_portability import (JaxPurityChecker,
+                                                  SqlitePortabilityChecker)
+from skypilot_tpu.utils import env_registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, 'tests', 'lint_fixtures')
+METRICS_PY = os.path.join(REPO_ROOT, 'skypilot_tpu', 'server',
+                          'metrics.py')
+EVENTS_PY = os.path.join(REPO_ROOT, 'skypilot_tpu', 'utils',
+                         'events.py')
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def run_fixture(checker, package, tests=(), docs=()):
+    ctx = core.Context(FIXTURES, [fixture(f) for f in package],
+                       [fixture(f) for f in tests], list(docs))
+    assert not ctx.parse_errors, ctx.parse_errors
+    return list(checker.run(ctx))
+
+
+def slugs(findings, code):
+    return {f.slug for f in findings if f.code == code}
+
+
+# -- SKYT001 ------------------------------------------------------------
+
+def test_skyt001_flags_blocking_in_async():
+    found = slugs(run_fixture(AsyncBlockingChecker(),
+                              ['skyt001_pos.py']), 'SKYT001')
+    assert 'handle_request:time.sleep' in found
+    assert ('handle_request:skypilot_tpu.server.requests_db.'
+            'get_request') in found
+    assert 'run_hook:subprocess.run' in found
+    # Sync helper lexically nested in an async def.
+    assert 'forward:time.sleep' in found
+
+
+def test_skyt001_clean_async_passes():
+    assert not run_fixture(AsyncBlockingChecker(), ['skyt001_neg.py'])
+
+
+# -- SKYT002 ------------------------------------------------------------
+
+def test_skyt002_flags_undeclared_knobs():
+    found = slugs(run_fixture(EnvRegistryChecker(),
+                              ['skyt002_pos.py']), 'SKYT002')
+    assert 'undeclared:SKYT_TOTALLY_UNDECLARED_KNOB' in found
+    assert 'undeclared:SKYT_TYPOD_WORKSPAACE' in found
+    assert 'undeclared:SKYT_ANOTHER_TYPO_KNOB' in found
+    assert 'undeclared:SKYT_BOGUS_PREFIX_' in found
+
+
+def test_skyt002_declared_reads_pass():
+    found = slugs(run_fixture(EnvRegistryChecker(),
+                              ['skyt002_neg.py']), 'SKYT002')
+    undeclared = {s for s in found if s.startswith('undeclared:')}
+    assert not undeclared, undeclared
+
+
+def test_skyt002_registry_types_are_valid():
+    for var in env_registry.DECLARATIONS:
+        assert var.type in env_registry.TYPES
+        assert var.doc.strip()
+    # Typed accessors refuse undeclared names outright.
+    with pytest.raises(KeyError):
+        env_registry.get_int('SKYT_NO_SUCH_KNOB_EVER')
+
+
+# -- SKYT003 ------------------------------------------------------------
+
+def test_skyt003_flags_type_and_label_drift():
+    found = slugs(run_fixture(MetricsRegistryChecker(),
+                              ['skyt003_pos.py', METRICS_PY]),
+                  'SKYT003')
+    assert 'kind:QUEUE_DEPTH:inc' in found
+    assert 'labels:LB_REQUESTS:result' in found
+    assert 'labels:TRANSFER_OBJECTS:direction' in found
+    assert 'dynamic:skyt_rogue_' in found
+
+
+def test_skyt003_correct_emitters_pass():
+    assert not run_fixture(MetricsRegistryChecker(),
+                           ['skyt003_neg.py', METRICS_PY])
+
+
+def test_skyt003_runtime_schema_guard():
+    from skypilot_tpu.server import metrics
+    with pytest.raises(ValueError):
+        metrics.LB_REQUESTS.inc(bogus='x')
+    metrics.LB_REQUESTS.inc(outcome='test_ok')   # declared set: fine
+
+
+# -- SKYT004 ------------------------------------------------------------
+
+def test_skyt004_dead_and_ghost_sites():
+    found = slugs(run_fixture(ChaosCoverageChecker(),
+                              ['skyt004_code.py'], ['skyt004_test.py']),
+                  'SKYT004')
+    assert 'dead:fixture.dead_site' in found
+    assert 'nonexistent:fixture.no_such_site' in found
+    assert 'dead:fixture.live_site' not in found
+
+
+def test_skyt004_doc_reference_counts_as_coverage(tmp_path):
+    doc = tmp_path / 'ops.md'
+    doc.write_text('Operators can inject `fixture.dead_site` faults.\n')
+    found = slugs(run_fixture(ChaosCoverageChecker(),
+                              ['skyt004_code.py'], ['skyt004_test.py'],
+                              docs=[str(doc)]), 'SKYT004')
+    assert 'dead:fixture.dead_site' not in found
+
+
+# -- SKYT005 ------------------------------------------------------------
+
+def test_skyt005_topic_crosscheck():
+    found = slugs(run_fixture(EventTopicChecker(),
+                              ['skyt005_pos.py', EVENTS_PY]),
+                  'SKYT005')
+    assert 'undeclared:requsts' in found
+    assert 'nopub:serve' in found
+    assert 'nosub:clusters' in found
+
+
+def test_skyt005_matched_pub_sub_passes():
+    assert not run_fixture(EventTopicChecker(),
+                           ['skyt005_neg.py', EVENTS_PY])
+
+
+# -- SKYT006 ------------------------------------------------------------
+
+def test_skyt006_detects_seeded_cycles():
+    findings = run_fixture(LockOrderChecker(), ['skyt006_pos.py'])
+    cycles = [f for f in findings if f.code == 'SKYT006']
+    assert len(cycles) == 2          # module-level pair + Store pair
+    joined = ' '.join(f.slug for f in cycles)
+    assert '_claim_lock' in joined and '_publish_lock' in joined
+    assert 'Store._a' in joined and 'Store._b' in joined
+
+
+def test_skyt006_consistent_order_passes():
+    assert not run_fixture(LockOrderChecker(), ['skyt006_neg.py'])
+
+
+# -- SKYT007 ------------------------------------------------------------
+
+def test_skyt007_flags_dialect_sql():
+    findings = run_fixture(SqlitePortabilityChecker(),
+                           ['skyt007_pos.py'])
+    messages = ' '.join(f.message for f in findings)
+    assert len(findings) == 2
+    assert 'ON CONFLICT' in messages and 'RETURNING' in messages
+
+
+def test_skyt007_portable_sql_and_docstrings_pass():
+    assert not run_fixture(SqlitePortabilityChecker(),
+                           ['skyt007_neg.py'])
+
+
+def test_skyt007_adaptive_helpers_are_exempt():
+    requests_db = os.path.join(REPO_ROOT, 'skypilot_tpu', 'server',
+                               'requests_db.py')
+    assert not run_fixture(SqlitePortabilityChecker(), [requests_db])
+
+
+# -- SKYT008 ------------------------------------------------------------
+
+def test_skyt008_flags_impure_jitted_functions():
+    found = slugs(run_fixture(JaxPurityChecker(), ['skyt008_pos.py']),
+                  'SKYT008')
+    assert 'decorated_step:print' in found
+    assert 'decorated_step:time.time' in found
+    assert 'partial_decorated_step:random.random' in found
+    # jax.jit(fn) wrapping resolves to the same-module def.
+    assert 'wrapped_step:random.random' in found
+
+
+def test_skyt008_pure_jit_passes():
+    assert not run_fixture(JaxPurityChecker(), ['skyt008_neg.py'])
+
+
+# -- baseline workflow --------------------------------------------------
+
+def test_baseline_suppresses_and_rejects_stale(tmp_path):
+    findings = run_fixture(SqlitePortabilityChecker(),
+                           ['skyt007_pos.py'])
+    entries = [
+        {'code': findings[0].code, 'key': findings[0].key,
+         'reason': 'fixture: reviewed, suppression exercised by test'},
+        {'code': 'SKYT007', 'key': 'gone.py:returning:1',
+         'reason': 'points at nothing'},
+        {'code': findings[1].code, 'key': findings[1].key,
+         'reason': 'UNREVIEWED — placeholder'},
+    ]
+    merged = core.apply_baseline(list(findings), entries,
+                                 str(tmp_path / 'baseline.json'))
+    by_slug = {f.slug: f for f in merged}
+    assert by_slug[findings[0].slug].baselined
+    assert not by_slug[findings[1].slug].baselined   # UNREVIEWED
+    metas = {f.slug for f in merged if f.code == core.META_CODE}
+    assert any(s.startswith('stale:') for s in metas)
+    assert any(s.startswith('unreviewed:') for s in metas)
+
+
+def test_write_baseline_round_trip(tmp_path):
+    findings = run_fixture(SqlitePortabilityChecker(),
+                           ['skyt007_pos.py'])
+    path = tmp_path / 'baseline.json'
+    count = core.write_baseline(findings, str(path))
+    assert count == len(findings)
+    entries = core.load_baseline(str(path))
+    # Freshly written entries are UNREVIEWED: applying them must NOT
+    # suppress anything until a human writes a real reason.
+    merged = core.apply_baseline(list(findings), entries, str(path))
+    assert all(not f.baselined for f in merged
+               if f.code != core.META_CODE)
+
+
+# -- the tier-1 repo gate ----------------------------------------------
+
+def test_repo_lint_is_clean(capsys):
+    """`python -m skypilot_tpu.lint` over the real repo: exit 0, no
+    active findings (the committed baseline holds only reviewed
+    suppressions; docs/env_vars.md is in sync)."""
+    rc = lint_cli.main(['--json', '--root', REPO_ROOT])
+    report = json.loads(capsys.readouterr().out)
+    active = [f for f in report['findings'] if not f['baselined']]
+    assert rc == 0, (
+        'skylint found invariant violations:\n'
+        + '\n'.join(f"{f['path']}:{f['line']}: {f['code']} "
+                    f"{f['message']}" for f in active))
+    assert report['summary']['active'] == 0
+    assert report['summary']['files_scanned'] > 150
+
+
+def test_env_docs_in_sync():
+    with open(os.path.join(REPO_ROOT, 'docs', 'env_vars.md'),
+              encoding='utf-8') as f:
+        committed = f.read()
+    assert committed == env_registry.render_docs(), (
+        'docs/env_vars.md is stale — regenerate with '
+        '`python -m skypilot_tpu.lint --dump-env-docs > '
+        'docs/env_vars.md`')

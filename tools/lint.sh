@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# skylint wrapper: the project's own invariant gate (SKYT001..SKYT008).
+#
+#   ./tools/lint.sh            # human output; exit 1 on any active
+#                              # (non-baselined) finding
+#   ./tools/lint.sh --json     # the JSON report CI consumes
+#
+# Runs stdlib-only AST passes — safe on the leanest runner, no TPU, no
+# network. run_benches.sh invokes this first so benchmark numbers are
+# never captured from code that fails its own invariants; tier-1 runs
+# the same gate via tests/test_skylint.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m skypilot_tpu.lint "$@"
