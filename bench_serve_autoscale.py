@@ -1,0 +1,419 @@
+#!/usr/bin/env python3
+"""Bench: SLO-driven predictive autoscaling vs the reactive
+``request_rate`` autoscaler (docs/serve_autoscaling.md; artifact
+``BENCH_serve_autoscale_<suffix>.json``).
+
+Two parts, both CPU-only:
+
+**1. Fleet simulation** — the REAL autoscaler classes
+(``SLOAutoscaler`` + ``mix_policy.plan_mix`` vs
+``RequestRateAutoscaler``) driven over a virtual clock against a
+two-day diurnal trace with a recurring mid-decline burst and spot
+preemptions injected during the burst. Ground truth is a linear
+latency–concurrency fleet (p99 = base + slope*c, Little's law),
+provisioning takes PROVISION_DELAY simulated seconds, a warm resume
+RESUME_DELAY. Both arms see the identical trace, preemption schedule,
+hysteresis windows, and per-replica capacity. The reactive arm runs
+at THREE tunings: exact (target_qps_per_replica = the SLO-optimal
+capacity computed from the ground-truth model — the cheapest possible
+reactive fleet, which spends ~30% of the trace out of SLO because
+capacity always lands a provision-delay late) and 0.9/0.8 headroom
+(what an operator deploys to chase the SLO reactively). Acceptance:
+the predictive arm must beat every tuning on SLO-miss seconds and
+every headroom tuning on replica-hours. Reported per arm: SLO-miss
+seconds (p99 over target, or no capacity while traffic flows),
+replica-hours and $-weighted replica-hours (spot vs on-demand rates;
+provisioning time is billed, WARM/stopped time is not), warm-pool
+resumes.
+
+**2. Warm resume vs cold provision (real stack)** — a scale-to-zero
+service on the fake cloud with ``inject_slow_create`` modelling slice
+provisioning latency: measures wall-clock time-to-READY for the cold
+provision and for the warm-pool resume of the same service.
+"""
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+# ---------------------------------------------------------------------------
+# Part 1: simulation.
+# ---------------------------------------------------------------------------
+
+# Ground-truth latency model of one replica (ms).
+BASE_MS = 40.0
+SLOPE_MS = 8.0
+TARGET_P99_MS = 200.0
+# Per-replica qps capacity at the SLO boundary (closed form from the
+# same inversion the autoscaler uses) — handed to the reactive arm as
+# its target_qps_per_replica, i.e. the best static tuning possible.
+CAPACITY_QPS = 1000.0 * (TARGET_P99_MS - BASE_MS) / (
+    SLOPE_MS * TARGET_P99_MS)
+
+PROVISION_DELAY_S = 120.0     # cold slice provision -> READY
+RESUME_DELAY_S = 20.0         # warm (stopped) resume -> READY
+TICK_S = 10.0                 # controller cadence
+DAY_S = 3600.0                # compressed "day"
+DAYS = 2
+OD_PRICE_HR = 4.0
+SPOT_PRICE_HR = 1.2
+SATURATED_MS = 4.0 * TARGET_P99_MS
+
+BURST_START = 1900.0          # recurring, mid-decline (same phase daily)
+BURST_END = 2200.0
+BURST_QPS = 400.0
+PREEMPT_AT = 2050.0           # reclaim half the spot fleet mid-burst
+
+
+def lam(t: float) -> float:
+    """Offered load (qps): diurnal sine + the recurring burst."""
+    phase = t % DAY_S
+    base = 400.0 + 350.0 * math.sin(2 * math.pi * phase / DAY_S)
+    if BURST_START <= phase < BURST_END:
+        base += BURST_QPS
+    return max(5.0, base)
+
+
+def fleet_point(qps: float, n_ready: int):
+    """(p99_ms, per-replica concurrency) of the ground-truth fleet."""
+    if n_ready <= 0:
+        return SATURATED_MS, 0.0
+    k = 1000.0 * n_ready / max(qps, 1e-9)
+    if k <= SLOPE_MS:
+        return SATURATED_MS, TARGET_P99_MS / SLOPE_MS * 3
+    c = BASE_MS / (k - SLOPE_MS)
+    return BASE_MS + SLOPE_MS * c, c
+
+
+class SimReplica:
+    _next_id = [0]
+
+    def __init__(self, now, is_spot, is_fallback=False, delay=None):
+        SimReplica._next_id[0] += 1
+        self.replica_id = SimReplica._next_id[0]
+        self.is_spot = is_spot
+        self.is_fallback = is_fallback
+        self.ready_at = now + (PROVISION_DELAY_S if delay is None
+                               else delay)
+        self.state = 'provisioning'
+        self.warm_since = None
+        self.cloud = self.region = self.zone = None
+
+    @property
+    def status(self):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        return {
+            'provisioning': ReplicaStatus.PROVISIONING,
+            'ready': ReplicaStatus.READY,
+            'warm': ReplicaStatus.WARM,
+            'gone': ReplicaStatus.TERMINATED,
+            'preempted': ReplicaStatus.PREEMPTED,
+        }[self.state]
+
+
+def run_sim(arm: str, headroom: float = 1.0):
+    """arm: 'slo' (predictive) or 'request_rate' (reactive).
+
+    ``headroom`` only affects the reactive arm: its
+    target_qps_per_replica is ``CAPACITY_QPS * headroom``. 1.0 is the
+    SLO-optimal static tuning (cheapest possible reactive fleet — and
+    it spends 30% of the trace out of SLO, because capacity always
+    arrives a provision-delay late); 0.9/0.8 are the headroom tunings
+    an operator actually deploys to chase the SLO reactively."""
+    from skypilot_tpu.serve.autoscalers import (DecisionOp, LoadStats,
+                                                RequestRateAutoscaler)
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    from skypilot_tpu.serve.slo_autoscaler import SLOAutoscaler
+
+    # Identical knobs both arms: on-demand floor of 1, no dynamic OD
+    # backfill (the chaos suite exercises that path; here it would
+    # bill double capacity through every transition in the predictive
+    # arm only and muddy the forecast-vs-reactive comparison).
+    common = dict(min_replicas=1, max_replicas=24,
+                  upscale_delay_seconds=0.0,
+                  downscale_delay_seconds=120.0,
+                  base_ondemand_fallback_replicas=1)
+    if arm == 'slo':
+        spec = ServiceSpec(target_latency_p99_ms=TARGET_P99_MS,
+                           forecaster='seasonal',
+                           forecast_horizon_seconds=PROVISION_DELAY_S +
+                           TICK_S,
+                           **common)
+        scaler = SLOAutoscaler(spec)
+        scaler.spot_wanted = True
+        scaler.warm_pool_size = 4
+        scaler.warm_ttl = DAY_S
+        # The seasonal ring must match the compressed day.
+        from skypilot_tpu.serve.forecast import SeasonalRingForecaster
+        scaler.forecaster = SeasonalRingForecaster(
+            period_seconds=DAY_S, buckets=72)
+    else:
+        spec = ServiceSpec(
+            target_qps_per_replica=CAPACITY_QPS * headroom, **common)
+        scaler = RequestRateAutoscaler(spec)
+
+    SimReplica._next_id[0] = 0
+    t = 0.0
+    scaler._clock = lambda: t
+    replicas = []
+    # Warm start both arms identically: the steady-state fleet for the
+    # t=0 offered load, already READY.
+    n0 = max(1, int(math.ceil(lam(0) / CAPACITY_QPS)))
+    for i in range(n0):
+        r = SimReplica(t, is_spot=(i > 0), delay=0)
+        r.state = 'ready'
+        replicas.append(r)
+    scaler._target = n0
+
+    miss_s = 0.0
+    dollar_hours = 0.0
+    replica_hours = 0.0
+    warm_hours = 0.0
+    warm_resumes = 0
+    preempted_total = 0
+    preempt_done_day = -1
+
+    while t < DAYS * DAY_S:
+        # Preemption schedule: once per day, mid-burst, reclaim half
+        # the READY spot fleet (identical in both arms).
+        day = int(t // DAY_S)
+        if (t % DAY_S) >= PREEMPT_AT and preempt_done_day < day:
+            preempt_done_day = day
+            spot_ready = [r for r in replicas
+                          if r.state == 'ready' and r.is_spot]
+            for r in spot_ready[:max(1, len(spot_ready) // 2)]:
+                r.state = 'preempted'
+                preempted_total += 1
+
+        for r in replicas:
+            if r.state == 'provisioning' and t >= r.ready_at:
+                r.state = 'ready'
+
+        ready = [r for r in replicas if r.state == 'ready']
+        qps = lam(t)
+        p99, conc = fleet_point(qps, len(ready))
+        latency_ms = {r.replica_id: p99 for r in ready}
+        stats = LoadStats(qps=qps, queue_length=conc * len(ready),
+                          window_seconds=TICK_S,
+                          replica_latency_ms=latency_ms)
+
+        live = [r for r in replicas if r.state != 'gone']
+        decisions = scaler.evaluate(stats, live)
+        for d in decisions:
+            if d.op == DecisionOp.SCALE_UP:
+                if d.resume_replica_id is not None:
+                    for r in replicas:
+                        if (r.replica_id == d.resume_replica_id and
+                                r.state == 'warm'):
+                            r.state = 'provisioning'
+                            r.warm_since = None
+                            r.ready_at = t + RESUME_DELAY_S
+                            warm_resumes += 1
+                            break
+                    continue
+                for _ in range(d.count):
+                    use_spot = d.use_spot
+                    if use_spot is None:
+                        use_spot = True      # task requested spot
+                    replicas.append(SimReplica(
+                        t, is_spot=use_spot, is_fallback=d.is_fallback))
+            else:
+                for r in replicas:
+                    if r.replica_id != d.replica_id or r.state in (
+                            'gone', 'preempted'):
+                        continue
+                    if d.warm:
+                        r.state = 'warm'
+                        r.warm_since = time.time()
+                    else:
+                        r.state = 'gone'
+                        r.warm_since = None
+
+        # Account the tick.
+        ready = [r for r in replicas if r.state == 'ready']
+        p99, _ = fleet_point(qps, len(ready))
+        if qps > 5.0 + 1e-9 or len(ready) == 0:
+            if p99 > TARGET_P99_MS + 1e-9:
+                miss_s += TICK_S
+        for r in replicas:
+            if r.state in ('ready', 'provisioning'):
+                price = SPOT_PRICE_HR if r.is_spot else OD_PRICE_HR
+                dollar_hours += price * TICK_S / 3600.0
+                replica_hours += TICK_S / 3600.0
+            elif r.state == 'warm':
+                warm_hours += TICK_S / 3600.0
+        t += TICK_S
+
+    return {
+        'slo_miss_seconds': round(miss_s, 1),
+        'dollar_weighted_replica_hours': round(dollar_hours, 2),
+        'replica_hours': round(replica_hours, 2),
+        'warm_pool_hours': round(warm_hours, 2),
+        'warm_resumes': warm_resumes,
+        'spot_preemptions_injected': preempted_total,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Part 2: warm resume vs cold provision on the real serve stack.
+# ---------------------------------------------------------------------------
+
+
+def bench_warm_vs_cold():
+    home = tempfile.mkdtemp(prefix='skyt-autoscale-bench-')
+    os.environ['HOME'] = home
+    os.environ['SKYT_STATE_DIR'] = os.path.join(home, '.skyt')
+    os.environ['SKYT_SERVE_CONTROLLER_POLL'] = '0.2'
+    os.environ['SKYT_WARM_POOL_SIZE'] = '1'
+    os.environ['SKYT_WARM_POOL_TTL'] = '3600'
+
+    from skypilot_tpu.provision import fake
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    from skypilot_tpu.spec.resources import Resources
+    from skypilot_tpu.spec.task import Task
+
+    fake.reset()
+    # Injected create latency stands in for slice provisioning; a warm
+    # resume restarts a stopped cluster and skips it.
+    fake.inject_slow_create(5.0)
+
+    task = Task(
+        name='svc',
+        run=('python3 -m http.server "$SKYT_SERVE_REPLICA_PORT" '
+             '--bind 127.0.0.1'),
+        resources=Resources(cloud='fake', accelerators='tpu-v5e-8'),
+        service={
+            'readiness_probe': {'path': '/',
+                                'initial_delay_seconds': 30,
+                                'timeout_seconds': 2},
+            'replica_policy': {
+                'min_replicas': 0, 'max_replicas': 1,
+                'target_latency_p99_ms': 5000,
+                'forecast_horizon_seconds': 1,
+                'scale_to_zero_idle_seconds': 2.0,
+                'upscale_delay_seconds': 0,
+                'downscale_delay_seconds': 0,
+                'qps_window_seconds': 1,
+            },
+        })
+
+    def wait_for(predicate, timeout=120, msg=''):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            value = predicate()
+            if value:
+                return value
+            time.sleep(0.1)
+        raise RuntimeError(f'bench timeout: {msg}')
+
+    serve_core.up(task, 'bench')
+    t_up = time.time()
+    # Cold path: provision (pays the injected latency) -> READY.
+    wait_for(lambda: [r for r in serve_state.list_replicas('bench')
+                      if r.status == ReplicaStatus.READY],
+             msg='cold READY')
+    cold_s = time.time() - t_up
+    # Idle out -> WARM (cluster stopped, kept).
+    wait_for(lambda: [r for r in serve_state.list_replicas('bench')
+                      if r.status == ReplicaStatus.WARM],
+             msg='parked WARM')
+    endpoint = serve_state.get_service('bench').endpoint
+    # Wake: retrying client; time to first 200.
+    import urllib.error
+    import urllib.request
+    t_wake = time.time()
+    while time.time() - t_wake < 120:
+        try:
+            with urllib.request.urlopen(endpoint, timeout=5) as resp:
+                if resp.status == 200:
+                    break
+        except Exception:  # pylint: disable=broad-except
+            pass
+        time.sleep(0.1)
+    else:
+        raise RuntimeError('bench timeout: warm wake')
+    warm_s = time.time() - t_wake
+    serve_core.down('bench', purge=True)
+    fake.reset()
+    return {
+        'injected_provision_latency_s': 5.0,
+        'cold_provision_to_ready_s': round(cold_s, 2),
+        'warm_resume_to_first_200_s': round(warm_s, 2),
+        'speedup': round(cold_s / max(warm_s, 1e-9), 2),
+    }
+
+
+def main():
+    out = {
+        'bench': 'serve_autoscale',
+        'ts': time.time(),
+        'sim': {
+            'trace': {
+                'days': DAYS, 'day_seconds': DAY_S,
+                'burst_qps': BURST_QPS,
+                'burst_window': [BURST_START, BURST_END],
+                'preempt_at': PREEMPT_AT,
+                'provision_delay_s': PROVISION_DELAY_S,
+                'resume_delay_s': RESUME_DELAY_S,
+                'target_p99_ms': TARGET_P99_MS,
+                'capacity_qps_per_replica': round(CAPACITY_QPS, 1),
+            },
+            'reactive_exact': run_sim('request_rate', headroom=1.0),
+            'reactive_headroom_0.9': run_sim('request_rate',
+                                             headroom=0.9),
+            'reactive_headroom_0.8': run_sim('request_rate',
+                                             headroom=0.8),
+            'predictive_slo': run_sim('slo'),
+        },
+    }
+    sim = out['sim']
+    pred = sim['predictive_slo']
+    out['warm_vs_cold'] = bench_warm_vs_cold()
+    # Acceptance (ISSUE 10): strictly fewer SLO-miss seconds than
+    # every request_rate tuning, at equal-or-lower replica-hours than
+    # every tuning that actually chases the SLO (headroom arms); the
+    # exact-capacity arm is cheaper only by being out of SLO ~30% of
+    # the trace, which is reported, not hidden.
+    arms = ['reactive_exact', 'reactive_headroom_0.9',
+            'reactive_headroom_0.8']
+    ok = all(pred['slo_miss_seconds'] < sim[a]['slo_miss_seconds']
+             for a in arms)
+    ok = ok and all(
+        pred['replica_hours'] <= sim[a]['replica_hours']
+        for a in ('reactive_headroom_0.9', 'reactive_headroom_0.8'))
+    ok = ok and out['warm_vs_cold']['speedup'] > 1.0
+    sim['summary'] = {
+        'miss_reduction_vs_exact': round(
+            sim['reactive_exact']['slo_miss_seconds'] /
+            max(pred['slo_miss_seconds'], 1e-9), 2),
+        'miss_reduction_vs_headroom_0.9': round(
+            sim['reactive_headroom_0.9']['slo_miss_seconds'] /
+            max(pred['slo_miss_seconds'], 1e-9), 2),
+        'replica_hours_vs_headroom_0.9': round(
+            pred['replica_hours'] /
+            sim['reactive_headroom_0.9']['replica_hours'], 3),
+        'acceptance': 'PASS' if ok else 'FAIL',
+    }
+    json.dump(out, sys.stdout, indent=2)
+    print()
+    react = sim['reactive_headroom_0.9']
+    print(f"# acceptance: {'PASS' if ok else 'FAIL'} — predictive "
+          f"{pred['slo_miss_seconds']}s misses / "
+          f"{pred['replica_hours']} replica-h vs request_rate(0.9) "
+          f"{react['slo_miss_seconds']}s / {react['replica_hours']} "
+          f"replica-h (exact-tuned: "
+          f"{sim['reactive_exact']['slo_miss_seconds']}s / "
+          f"{sim['reactive_exact']['replica_hours']} replica-h); warm "
+          f"resume {out['warm_vs_cold']['speedup']}x faster to READY",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -73,6 +73,16 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_elastic.py \
   | tee "BENCH_elastic_${suffix}.json"
 echo "rc=$? -> BENCH_elastic_${suffix}.json" >&2
 
+# Serve autoscaling bench: CPU-only — SLO-driven predictive autoscaler
+# (forecast + mix policy + warm pool) vs reactive request_rate on a
+# diurnal+burst trace with injected spot preemptions, plus warm-resume
+# vs cold-provision time-to-READY on the fake cloud
+# (docs/serve_autoscaling.md, numbers in PERF.md).
+echo "=== bench serve-autoscale ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_serve_autoscale.py \
+  | tee "BENCH_serve_autoscale_${suffix}.json"
+echo "rc=$? -> BENCH_serve_autoscale_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

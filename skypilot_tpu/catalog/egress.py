@@ -68,3 +68,20 @@ def egress_price_per_gb(src_cloud: Optional[str],
     if src == dst:
         return _INTRA_CLOUD.get(src, DEFAULT_EGRESS_PER_GB)
     return _INTERNET.get(src, DEFAULT_EGRESS_PER_GB)
+
+
+def serving_hop_price_per_gb(src_cloud: Optional[str],
+                             src_region: Optional[str],
+                             dst_cloud: Optional[str],
+                             dst_region: Optional[str]) -> float:
+    """$/GB for serve-replica traffic flowing from a replica placed in
+    ``(src_cloud, src_region)`` back to the service's home region
+    (where the load balancer/users sit). Same cloud AND same region is
+    free (in-region transfer); everything else prices the boundary
+    crossing via :func:`egress_price_per_gb` — billed by the sending
+    (replica) side. The serve mix policy folds this into a domain's
+    effective $/replica-hour (mix_policy.MixPolicy.domain_price)."""
+    same_cloud = (src_cloud or '').lower() == (dst_cloud or '').lower()
+    if same_cloud and src_region is not None and src_region == dst_region:
+        return 0.0
+    return egress_price_per_gb(src_cloud, dst_cloud)

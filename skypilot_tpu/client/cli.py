@@ -378,14 +378,27 @@ def serve_down(service_name: str, purge: bool) -> None:
 def serve_status(service_name: Optional[str]) -> None:
     """Show services and their replica fleets."""
     rows = _run(sdk.serve_status(service_name), False, stream=False)
+    for row in rows or []:
+        # Fleet latency + warm pool (r11 autoscaling subsystem): the
+        # p99 over per-replica EWMA TTFB the controller persists each
+        # tick, and how many replicas are parked WARM for fast resume.
+        p99 = row.get('fleet_p99_ms')
+        row['fleet_p99_ms'] = f'{p99:.1f}' if p99 is not None else '-'
     _echo_table(rows or [], ['name', 'status', 'endpoint',
+                             'fleet_p99_ms', 'warm_replicas',
                              'controller_cluster', 'failure_reason'])
     for row in rows or []:
         for replica in row.get('replicas', []):
+            domain = '/'.join(
+                p for p in (replica.get('cloud'), replica.get('region'),
+                            replica.get('zone')) if p) or '-'
+            ewma = replica.get('lb_ewma_ms')
+            ewma_s = f'{ewma:.1f}ms' if ewma else '-'
             click.echo(
                 f"  replica {replica['replica_id']:>3} "
                 f"{replica['status']:<22} {replica['endpoint'] or '-':<28}"
-                f"{'spot' if replica['is_spot'] else 'on-demand'}")
+                f"{'spot' if replica['is_spot'] else 'on-demand':<10}"
+                f"{domain:<28}{ewma_s}")
 
 
 @serve.command('logs')

@@ -7,13 +7,21 @@ FallbackAutoscaler :933 (spot + on-demand mix). Decisions are data, not
 actions: the controller applies them through the ReplicaManager, which
 keeps the autoscalers pure and unit-testable without clusters.
 
-Hysteresis: a raw target must hold for ``upscale_delay_seconds``
-(resp. ``downscale_delay_seconds``) of consecutive evaluations before
-the fleet moves — scaling a TPU replica means provisioning a slice, so
-flapping is far more expensive than lag.
+Hysteresis: a move must be *sustained* over ``upscale_delay_seconds``
+(resp. ``downscale_delay_seconds``) of evaluations before the fleet
+moves — scaling a TPU replica means provisioning a slice, so flapping
+is far more expensive than lag. The filter is a stabilization window
+(the K8s-HPA / Autopilot shape): upscale applies the MINIMUM raw
+target seen across the upscale window once every sample in it exceeds
+the current target; downscale applies the MAXIMUM across its window
+once every sample is below. A smoothly declining raw target therefore
+tracks down with a fixed lag instead of resetting the timer on every
+tick (the failure mode of hold-one-value hysteresis), while a single
+contrary sample still blocks the move.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
 import math
@@ -41,8 +49,18 @@ class Decision:
     count: int = 1
     use_spot: Optional[bool] = None
     is_fallback: bool = False
+    # SCALE_UP: resume this WARM (stopped, not torn down) replica
+    # instead of provisioning a fresh cluster (mix_policy warm pool).
+    resume_replica_id: Optional[int] = None
     # SCALE_DOWN: which replica.
     replica_id: Optional[int] = None
+    # SCALE_DOWN: stop the cluster but keep it (WARM) for a fast
+    # resume instead of terminating it.
+    warm: bool = False
+    # Why the subsystem made this decision (metrics/log label; one of
+    # mix_policy.DECISION_REASONS for the new decision paths, '' for
+    # the legacy autoscalers).
+    reason: str = ''
 
 
 @dataclasses.dataclass
@@ -60,8 +78,25 @@ class LoadStats:
 
 def _alive(replicas: List[serve_state.ReplicaRecord]
            ) -> List[serve_state.ReplicaRecord]:
+    # WARM replicas are stopped clusters held for fast resume: they
+    # serve no traffic and must not count toward the live fleet.
     return [r for r in replicas if not r.status.is_terminal() and
-            r.status != ReplicaStatus.SHUTTING_DOWN]
+            r.status not in (ReplicaStatus.SHUTTING_DOWN,
+                             ReplicaStatus.WARM)]
+
+
+def victim_order(replicas: List[serve_state.ReplicaRecord],
+                 latency_ms: Dict[int, float]
+                 ) -> List[serve_state.ReplicaRecord]:
+    """Scale-down shedding order, shared by the reactive autoscalers
+    and mix_policy: non-ready first, then the slowest READY replica by
+    the LB's per-replica EWMA TTFB (shedding the laggard lowers fleet
+    p99 for free), newest as tie-break (oldest replicas have the
+    warmest caches)."""
+    return sorted(replicas,
+                  key=lambda r: (r.status == ReplicaStatus.READY,
+                                 -latency_ms.get(r.replica_id, 0.0),
+                                 -r.replica_id))
 
 
 class Autoscaler:
@@ -71,11 +106,19 @@ class Autoscaler:
     def __init__(self, spec: ServiceSpec) -> None:
         self.spec = spec
         self._target = spec.min_replicas
-        self._pending_target: Optional[int] = None
-        self._pending_since: float = 0.0
+        # (monotonic time, raw target) stabilization window.
+        self._history: collections.deque = collections.deque()
+        # Monotonic so a wall-clock step (NTP slew, manual reset) can
+        # neither bypass nor wedge the hysteresis delay; injectable so
+        # tests and the autoscale bench drive a virtual clock.
+        self._clock = time.monotonic
 
     @classmethod
     def from_spec(cls, spec: ServiceSpec) -> 'Autoscaler':
+        if spec.target_latency_p99_ms is not None:
+            # Lazy import: slo_autoscaler imports this module.
+            from skypilot_tpu.serve import slo_autoscaler  # noqa: F401
+            return AUTOSCALER_REGISTRY.get('slo')(spec)
         if spec.base_ondemand_fallback_replicas or \
                 spec.dynamic_ondemand_fallback:
             return FallbackAutoscaler(spec)
@@ -97,21 +140,44 @@ class Autoscaler:
         return max(lo, min(hi, target))
 
     def target_replicas(self, stats: LoadStats, num_alive: int) -> int:
-        """Hysteresis-filtered target (ref hysteresis base :393)."""
+        """Stabilization-window-filtered target (ref hysteresis base
+        :393; window semantics in the module docstring)."""
         raw = self._bounded(self._raw_target(stats, num_alive))
-        if raw == self._target:
-            self._pending_target = None
-            return self._target
-        now = time.time()
-        if raw != self._pending_target:
-            self._pending_target = raw
-            self._pending_since = now
-        delay = (self.spec.upscale_delay_seconds if raw > self._target
-                 else self.spec.downscale_delay_seconds)
-        if now - self._pending_since >= delay:
-            logger.info('Autoscaler: target %d -> %d', self._target, raw)
-            self._target = raw
-            self._pending_target = None
+        now = self._clock()
+        history = self._history
+        history.append((now, raw))
+        up_delay = self.spec.upscale_delay_seconds
+        down_delay = self.spec.downscale_delay_seconds
+        horizon = max(up_delay, down_delay)
+        while history and history[0][0] < now - horizon - 1e-9:
+            history.popleft()
+
+        def window(delay: float) -> List[int]:
+            return [r for t, r in history if t >= now - delay - 1e-9]
+
+        def sustained(delay: float) -> bool:
+            # The condition must have been observed for the full
+            # delay: the oldest retained sample is old enough (or the
+            # delay is zero — immediate moves).
+            return delay <= 0 or history[0][0] <= now - delay + 1e-9
+
+        new_target = self._target
+        up = window(up_delay)
+        down = window(down_delay)
+        if self._target == 0 and raw > 0:
+            # Wake-from-zero bypasses the upscale window: there is no
+            # fleet to protect from flapping, and every second spent
+            # "stabilizing" at zero is a second of 503s — the whole
+            # point of the warm pool is resuming in seconds.
+            new_target = raw
+        elif all(r > self._target for r in up) and sustained(up_delay):
+            new_target = min(up)      # least sustained level above
+        elif all(r < self._target for r in down) and sustained(down_delay):
+            new_target = max(down)    # most conservative level below
+        if new_target != self._target:
+            logger.info('Autoscaler: target %d -> %d', self._target,
+                        new_target)
+            self._target = new_target
         return self._target
 
     # -- evaluation ----------------------------------------------------
@@ -126,13 +192,8 @@ class Autoscaler:
             decisions.append(
                 Decision(DecisionOp.SCALE_UP, count=target - len(alive)))
         elif len(alive) > target:
-            # Down the newest non-ready first, then newest ready
-            # (oldest replicas have the warmest caches).
             excess = len(alive) - target
-            victims = sorted(
-                alive,
-                key=lambda r: (r.status == ReplicaStatus.READY,
-                               -r.replica_id))
+            victims = victim_order(alive, stats.replica_latency_ms)
             for record in victims[:excess]:
                 decisions.append(Decision(DecisionOp.SCALE_DOWN,
                                           replica_id=record.replica_id))

@@ -19,10 +19,12 @@ from skypilot_tpu.serve.autoscalers import (Autoscaler, Decision,
                                             DecisionOp)
 from skypilot_tpu.serve.load_balancer import LoadBalancer
 from skypilot_tpu.serve.load_balancing_policies import ReplicaEntry
+from skypilot_tpu.serve.mix_policy import MixPolicy
 from skypilot_tpu.serve.replica_managers import ReplicaManager
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
-from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
+from skypilot_tpu.serve.spot_placer import Domain
+from skypilot_tpu.server import metrics
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import env_registry, events, log
 
@@ -55,45 +57,100 @@ class ServeController:
         self.lb = lb
         self.manager = ReplicaManager(service_name, spec, task)
         self.autoscaler = Autoscaler.from_spec(spec)
-        self.spot_placer: Optional[DynamicFallbackSpotPlacer] = None
-        if any(r.use_spot for r in task.resources):
-            self.spot_placer = DynamicFallbackSpotPlacer(
-                self._candidate_zones(task))
+        self._spot_wanted = any(r.use_spot for r in task.resources)
+        self.mix_policy: Optional[MixPolicy] = None
+        if self._spot_wanted:
+            domains, prices = self._candidate_domains(task)
+            if domains:
+                self.mix_policy = MixPolicy(
+                    domains, home=self._home_domain(task, domains),
+                    instance_prices=prices)
+        self._configure_autoscaler()
         self._handled_preemptions: set = set()
 
+    def _configure_autoscaler(self) -> None:
+        # The SLO autoscaler plans the spot/on-demand mix itself and
+        # needs to know whether the task asked for preemptible
+        # capacity (the reactive autoscalers carry this in their
+        # Decision.use_spot instead).
+        if hasattr(self.autoscaler, 'spot_wanted'):
+            self.autoscaler.spot_wanted = self._spot_wanted
+
     @staticmethod
-    def _candidate_zones(task: Task) -> List[str]:
+    def _home_domain(task: Task,
+                     domains: List[Domain]) -> Optional[Domain]:
+        """The domain the egress surcharge is anchored to — where the
+        LB/users sit. A task that pins cloud/region is the ground
+        truth; otherwise fall back to the optimizer's first candidate
+        (an approximation, NOT a statement about LB placement — the
+        surcharge then only orders domains relative to each other)."""
+        for res in task.resources:
+            if res.cloud is not None and res.region is not None:
+                return Domain(res.cloud, res.region, res.zone)
+        return domains[0] if domains else None
+
+    @staticmethod
+    def _candidate_domains(task: Task):
+        """(cloud, region, zone) placement domains the optimizer would
+        launch this task into, with their $/hr — the mix policy's
+        search space and price table."""
         from skypilot_tpu.optimizer import Optimizer
-        zones = []
+        domains: List[Domain] = []
+        prices = {}
         try:
             for candidate in Optimizer.plan_task(task):
-                zone = candidate.resources.zone
-                if zone and zone not in zones:
-                    zones.append(zone)
+                res = candidate.resources
+                domain = Domain(res.cloud, res.region, res.zone)
+                if domain.zone is None and domain.region is None:
+                    continue
+                if domain not in prices:
+                    domains.append(domain)
+                    prices[domain] = candidate.hourly_cost
         except Exception:  # pylint: disable=broad-except
             pass
-        return zones
+        return domains, prices
 
     # ------------------------------------------------------------------
 
     def _apply(self, decisions: List[Decision]) -> None:
         for decision in decisions:
+            reason = decision.reason or decision.op.value
             if decision.op == DecisionOp.SCALE_UP:
+                if decision.resume_replica_id is not None:
+                    # Warm-pool fast path: restart the stopped cluster
+                    # instead of provisioning a fresh slice. A raced-
+                    # away row degrades to a cold scale-up below —
+                    # counted as warm_miss, not as a warm-pool hit.
+                    if self.manager.resume_replica(
+                            decision.resume_replica_id):
+                        metrics.AUTOSCALE_DECISIONS.inc(
+                            service=self.service_name,
+                            op=decision.op.value, reason=reason)
+                        continue
+                    reason = 'warm_miss'
+                metrics.AUTOSCALE_DECISIONS.inc(
+                    service=self.service_name, op=decision.op.value,
+                    reason=reason)
                 for _ in range(decision.count):
-                    zone = None
+                    domain: Optional[Domain] = None
                     use_spot = decision.use_spot
                     if use_spot is None:
-                        use_spot = any(
-                            r.use_spot
-                            for r in self.manager.task.resources)
-                    if use_spot and self.spot_placer is not None:
-                        zone = self.spot_placer.select()
-                    self.manager.scale_up(use_spot=decision.use_spot,
-                                          zone=zone,
-                                          is_fallback=decision.is_fallback)
+                        use_spot = self._spot_wanted
+                    if use_spot and self.mix_policy is not None:
+                        domain = self.mix_policy.place_spot()
+                    self.manager.scale_up(
+                        use_spot=decision.use_spot,
+                        cloud=domain.cloud if domain else None,
+                        region=domain.region if domain else None,
+                        zone=domain.zone if domain else None,
+                        is_fallback=decision.is_fallback)
             else:
                 assert decision.replica_id is not None
-                self.manager.scale_down(decision.replica_id)
+                metrics.AUTOSCALE_DECISIONS.inc(
+                    service=self.service_name, op=decision.op.value,
+                    reason=reason)
+                self.manager.scale_down(decision.replica_id,
+                                        warm=decision.warm)
 
     def _sync_lb(self,
                  replicas: List[serve_state.ReplicaRecord]) -> None:
@@ -121,7 +178,10 @@ class ServeController:
             return
         num_ready = sum(1 for r in replicas
                         if r.status == ReplicaStatus.READY)
-        alive = [r for r in replicas if not r.status.is_terminal()]
+        # WARM replicas are parked, not serving: a scaled-to-zero
+        # service must read NO_REPLICA, not REPLICA_INIT.
+        alive = [r for r in replicas if not r.status.is_terminal() and
+                 r.status != ReplicaStatus.WARM]
         if num_ready > 0:
             status = ServiceStatus.READY
         elif alive:
@@ -140,13 +200,23 @@ class ServeController:
 
     def _note_preemptions(
             self, replicas: List[serve_state.ReplicaRecord]) -> None:
-        if self.spot_placer is None:
+        if self.mix_policy is None:
             return
         for record in replicas:
             if (record.status == ReplicaStatus.PREEMPTED and
                     record.replica_id not in self._handled_preemptions):
                 self._handled_preemptions.add(record.replica_id)
-                self.spot_placer.handle_preemption(record.zone)
+                domain = Domain(record.cloud, record.region, record.zone)
+                if domain.cloud is None and domain.region is None:
+                    # Legacy/unpinned rows carry only a zone: demote
+                    # the matching known domain instead of teaching
+                    # the placer a junk (None, None, zone) candidate.
+                    matches = [d for d in self.mix_policy.domains
+                               if d.zone == record.zone]
+                    if not matches:
+                        continue
+                    domain = matches[0]
+                self.mix_policy.handle_preemption(domain)
 
     # ------------------------------------------------------------------
 
@@ -190,6 +260,7 @@ class ServeController:
                     self.service_name)
         self.spec = ServiceSpec.from_yaml_config(record.spec)
         self.autoscaler = Autoscaler.from_spec(self.spec)
+        self._configure_autoscaler()
         self.manager.spec = self.spec
 
     def run_once(self) -> None:
@@ -204,9 +275,37 @@ class ServeController:
         decisions = self.autoscaler.evaluate(stats, replicas)
         self._apply(decisions)
         replicas = serve_state.list_replicas(self.service_name)
+        self._publish_autoscale_metrics(stats, replicas)
         if self.lb is not None:
             self._sync_lb(replicas)
         self._update_service_status(replicas)
+
+    def _publish_autoscale_metrics(
+            self, stats, replicas: List[serve_state.ReplicaRecord]
+    ) -> None:
+        """Autoscale observability on the service process's own scrape
+        surface (the LB port's /-/lb/metrics — label schemas in
+        docs/serve_autoscaling.md)."""
+        from skypilot_tpu.serve import forecast
+        name = self.service_name
+        p99 = forecast.fleet_p99_ms(stats.replica_latency_ms)
+        if p99 is not None:
+            metrics.AUTOSCALE_FLEET_P99.set(p99, service=name)
+        metrics.AUTOSCALE_WARM_POOL.set(
+            sum(1 for r in replicas
+                if r.status == ReplicaStatus.WARM), service=name)
+        snapshot_fn = getattr(self.autoscaler, 'snapshot', None)
+        if snapshot_fn is None:
+            return
+        snap = snapshot_fn()
+        if 'predicted_qps' in snap:
+            metrics.AUTOSCALE_PREDICTED_QPS.set(
+                snap['predicted_qps'], service=name)
+        if snap.get('predicted_p99_ms') is not None:
+            metrics.AUTOSCALE_PREDICTED_P99.set(
+                snap['predicted_p99_ms'], service=name)
+        if 'target' in snap:
+            metrics.AUTOSCALE_TARGET.set(snap['target'], service=name)
 
     def run(self) -> None:
         record = serve_state.get_service(self.service_name)

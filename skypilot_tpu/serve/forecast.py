@@ -1,0 +1,290 @@
+"""Short-horizon load forecasting for the SLO autoscaler.
+
+The reactive autoscalers (``serve/autoscalers.py``) size the fleet from
+the load of the LAST window; with TPU slices taking minutes to
+provision, that means every diurnal ramp and burst is served late.
+This module supplies the *predictive* half of the r11 subsystem
+(docs/serve_autoscaling.md): pure, clock-injected estimators the SLO
+autoscaler evaluates each controller tick — Autopilot (Rzadca et al.,
+EuroSys '20) style forecast-then-act, scaled down to the signals the
+serve LB already produces.
+
+Three pieces, all pure data -> data (no I/O, no wall clock):
+
+* **Forecasters** (``FORECASTER_REGISTRY``): consume the LB's
+  monotonic-window QPS samples via ``observe(now, qps)`` and answer
+  ``predict(now, horizon_s)``. ``ewma_trend`` (default) is Holt-style
+  double exponential smoothing — level + trend, so a ramp is
+  extrapolated instead of chased. ``seasonal`` adds a ring of
+  per-phase-bucket EWMAs over a configurable period on top of the
+  trend, so a diurnal pattern is anticipated once the ring has seen
+  one period (warm-up falls back to the trend alone).
+* **LatencyModel**: an exponentially-decayed least-squares fit of
+  observed fleet p99 TTFB against per-replica concurrency
+  (``p99_ms ~= base + slope * concurrency``, slope clamped >= 0 so the
+  prediction is monotone in concurrency). Inverting it answers "how
+  much concurrency can one replica carry inside the SLO" — the
+  capacity number the SLO autoscaler sizes the fleet with.
+* **fleet_p99_ms**: the cross-replica p99 over the LB's per-replica
+  EWMA TTFB (``LoadStats.replica_latency_ms``) — the fleet-level
+  latency signal fed to the model, the metrics surface, and
+  ``skyt serve status``.
+
+Times are caller-supplied monotonic seconds (the same clock the LB's
+QPS ring runs on since PR 4): a wall-clock step must never bend a
+forecast, and tests/benches drive a virtual clock through the same
+code path.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils.registry import FORECASTER_REGISTRY
+
+DEFAULT_HORIZON_SECONDS = 60.0
+
+
+class QpsForecaster:
+    """Contract: feed ``observe(now, qps)`` once per evaluation tick,
+    ask ``predict(now, horizon)`` for the expected QPS at
+    ``now + horizon``. Implementations must be pure in (clock, samples)
+    and never return a negative rate."""
+
+    def observe(self, now: float, qps: float) -> None:
+        raise NotImplementedError
+
+    def predict(self, now: float, horizon_seconds: float) -> float:
+        raise NotImplementedError
+
+
+@FORECASTER_REGISTRY.register('ewma_trend', default=True)
+class EwmaTrendForecaster(QpsForecaster):
+    """Holt double exponential smoothing on an irregularly-sampled
+    series: ``level`` tracks the current rate, ``trend`` its per-second
+    slope; ``predict`` extrapolates ``level + trend * horizon``.
+
+    ``alpha``/``beta`` are per-SAMPLE smoothing factors at the nominal
+    tick cadence; irregular gaps are handled by advancing the level
+    along the trend for the elapsed time before folding the new sample
+    in. A burst therefore raises the forecast within a couple of
+    ticks, while a single noisy sample cannot swing it to zero.
+
+    ``allow_negative=True`` lifts the >=0 clamp on level and
+    prediction — required when the tracked series is a signed residual
+    (the seasonal forecaster's deseasonalized drift) rather than a
+    rate; clamping residuals at zero would floor away every downward
+    level shift.
+    """
+
+    def __init__(self, alpha: float = 0.5, beta: float = 0.3,
+                 allow_negative: bool = False) -> None:
+        self.alpha = alpha
+        self.beta = beta
+        self.allow_negative = allow_negative
+        self._level: Optional[float] = None
+        self._trend = 0.0
+        self._last_t: Optional[float] = None
+
+    def _clamp(self, value: float) -> float:
+        return value if self.allow_negative else max(0.0, value)
+
+    def observe(self, now: float, qps: float) -> None:
+        if self._level is None or self._last_t is None:
+            self._level = self._clamp(qps)
+            self._trend = 0.0
+            self._last_t = now
+            return
+        dt = max(1e-6, now - self._last_t)
+        projected = self._level + self._trend * dt
+        level = self.alpha * qps + (1 - self.alpha) * projected
+        slope = (level - self._level) / dt
+        self._trend = self.beta * slope + (1 - self.beta) * self._trend
+        self._level = self._clamp(level)
+        self._last_t = now
+
+    def predict(self, now: float, horizon_seconds: float) -> float:
+        if self._level is None:
+            return 0.0
+        dt = horizon_seconds
+        if self._last_t is not None:
+            dt += max(0.0, now - self._last_t)
+        return self._clamp(self._level + self._trend * dt)
+
+
+@FORECASTER_REGISTRY.register('seasonal')
+class SeasonalRingForecaster(QpsForecaster):
+    """Holt-Winters-shaped seasonal forecaster: a ring of per-phase-
+    bucket EWMAs carries the recurring pattern, and a trend runs on the
+    DESEASONALIZED residual (observed minus the slot's seasonal value).
+
+    The ring covers ``period_seconds`` in ``buckets`` equal slots keyed
+    by ``now % period``. Once both the current and the target slot have
+    been seen, ``predict`` answers ``season[slot(now+h)] +
+    residual_trend(h)`` — the ring carries the shape, the residual
+    trend only the level drift on top of it. Estimating the trend on
+    the raw series instead would double-count every recurring ramp
+    (the trend already climbs while the seasonal delta adds the same
+    climb again) and systematically over-provision.
+
+    Warm-up: while either slot involved is unseen, the forecast is
+    exactly the raw ``ewma_trend`` (the tested contract, not an
+    accident), so the first traversal of a period behaves like the
+    default forecaster.
+    """
+
+    def __init__(self, period_seconds: Optional[float] = None,
+                 buckets: Optional[int] = None,
+                 alpha: float = 0.3) -> None:
+        if period_seconds is None:
+            period_seconds = env_registry.get_float(
+                'SKYT_FORECAST_SEASONAL_PERIOD')
+        if buckets is None:
+            buckets = env_registry.get_int('SKYT_FORECAST_SEASONAL_BUCKETS')
+        if period_seconds <= 0 or buckets <= 0:
+            raise ValueError('seasonal forecaster needs a positive '
+                             'period and bucket count')
+        self.period = float(period_seconds)
+        self.buckets = int(buckets)
+        self.alpha = alpha
+        self._ring: Dict[int, float] = {}
+        self._trend = EwmaTrendForecaster()            # raw (warm-up)
+        # Residuals are signed: a level DROP below the seasonal norm
+        # must be tracked, not floored at zero.
+        self._residual = EwmaTrendForecaster(allow_negative=True)
+
+    def _slot(self, t: float) -> int:
+        return int((t % self.period) / self.period * self.buckets) \
+            % self.buckets
+
+    def observe(self, now: float, qps: float) -> None:
+        self._trend.observe(now, qps)
+        slot = self._slot(now)
+        previous = self._ring.get(slot)
+        # Residual against the PRE-update seasonal value, so the ring's
+        # own convergence toward this sample doesn't hide level drift.
+        self._residual.observe(now, qps - (previous or 0.0)
+                               if previous is not None else 0.0)
+        if previous is None:
+            self._ring[slot] = max(0.0, qps)
+        else:
+            self._ring[slot] = max(
+                0.0, self.alpha * qps + (1 - self.alpha) * previous)
+
+    def seasonal_delta(self, now: float, horizon_seconds: float) -> float:
+        here = self._ring.get(self._slot(now))
+        there = self._ring.get(self._slot(now + horizon_seconds))
+        if here is None or there is None:
+            return 0.0    # warm-up: unseen slot -> trend only
+        return there - here
+
+    def predict(self, now: float, horizon_seconds: float) -> float:
+        here = self._ring.get(self._slot(now))
+        there = self._ring.get(self._slot(now + horizon_seconds))
+        if here is None or there is None:
+            return self._trend.predict(now, horizon_seconds)
+        return max(0.0, there + self._residual.predict(
+            now, horizon_seconds))
+
+
+def make_forecaster(name: Optional[str]) -> QpsForecaster:
+    """Instantiate by registry name (None -> the default)."""
+    return FORECASTER_REGISTRY.get(name)()
+
+
+# ---------------------------------------------------------------------------
+# Latency-vs-concurrency model.
+# ---------------------------------------------------------------------------
+
+
+class LatencyModel:
+    """Online fit of ``p99_ms ~= base + slope * concurrency_per_replica``
+    with exponential sample decay.
+
+    The accumulators are decayed sums (count, x, y, xx, xy) so old
+    operating points fade as the fleet's behavior drifts; the slope is
+    clamped >= 0, which makes ``predict_p99_ms`` monotone
+    non-decreasing in concurrency by construction — the invariant the
+    SLO inversion (``max_concurrency_within``) and the tests rely on.
+    Until two sufficiently distinct operating points have been seen the
+    fit is just the decayed mean (slope 0).
+    """
+
+    def __init__(self, decay: float = 0.02) -> None:
+        self.decay = decay
+        self._n = 0.0
+        self._sx = 0.0
+        self._sy = 0.0
+        self._sxx = 0.0
+        self._sxy = 0.0
+        self.samples = 0
+
+    def observe(self, concurrency: float, p99_ms: float) -> None:
+        if p99_ms <= 0 or concurrency < 0 or not math.isfinite(p99_ms):
+            return
+        keep = 1.0 - self.decay
+        self._n = self._n * keep + 1.0
+        self._sx = self._sx * keep + concurrency
+        self._sy = self._sy * keep + p99_ms
+        self._sxx = self._sxx * keep + concurrency * concurrency
+        self._sxy = self._sxy * keep + concurrency * p99_ms
+        self.samples += 1
+
+    @property
+    def fitted(self) -> bool:
+        return self.samples >= 2 and self._var() > 1e-9
+
+    def _var(self) -> float:
+        if self._n <= 0:
+            return 0.0
+        mean_x = self._sx / self._n
+        return max(0.0, self._sxx / self._n - mean_x * mean_x)
+
+    def coefficients(self) -> tuple:
+        """(base_ms, slope_ms_per_unit_concurrency)."""
+        if self._n <= 0:
+            return 0.0, 0.0
+        mean_x = self._sx / self._n
+        mean_y = self._sy / self._n
+        var = self._var()
+        if not self.fitted or var <= 1e-9:
+            return mean_y, 0.0
+        cov = self._sxy / self._n - mean_x * mean_y
+        slope = max(0.0, cov / var)
+        base = mean_y - slope * mean_x
+        # A degenerate fit (all mass at high concurrency) can push the
+        # intercept negative; latency at zero load is still >= 0.
+        return max(0.0, base), slope
+
+    def predict_p99_ms(self, concurrency: float) -> float:
+        base, slope = self.coefficients()
+        return base + slope * max(0.0, concurrency)
+
+    def max_concurrency_within(self, target_p99_ms: float,
+                               cap: float = 1e6) -> Optional[float]:
+        """Largest per-replica concurrency whose predicted p99 fits the
+        target; None when even an idle replica misses it (base > target
+        — no amount of replicas fixes a too-slow app), ``cap`` when the
+        fitted slope is ~0 (latency insensitive to load in the observed
+        range — concurrency is unconstrained as far as the model
+        knows)."""
+        base, slope = self.coefficients()
+        if base > target_p99_ms:
+            return None
+        if slope <= 1e-12:
+            return cap
+        return min(cap, (target_p99_ms - base) / slope)
+
+
+def fleet_p99_ms(replica_latency_ms: Dict[int, float]) -> Optional[float]:
+    """Cross-replica p99 over per-replica EWMA TTFB — the fleet latency
+    signal. With few replicas this is (by nearest-rank) the slowest
+    replica's EWMA, which is exactly the replica a latency SLO is
+    gated on."""
+    values = sorted(v for v in replica_latency_ms.values()
+                    if v is not None and v >= 0)
+    if not values:
+        return None
+    idx = min(len(values) - 1, int(math.ceil(0.99 * len(values))) - 1)
+    return values[max(0, idx)]

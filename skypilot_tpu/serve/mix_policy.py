@@ -1,0 +1,242 @@
+"""Replica-mix policy: on-demand floor, cross-domain spot surge, and
+the warm pool — the decision layer between "how many replicas"
+(``slo_autoscaler``/``autoscalers``) and "press which buttons"
+(``controller`` + ``replica_managers``).
+
+Invariants (docs/serve_autoscaling.md, tested in
+tests/test_serve_autoscale.py):
+
+* **On-demand floor** — at least
+  ``min(base_ondemand_fallback_replicas, target)`` replicas are
+  non-spot, always satisfied before any spot surge.
+* **Spot surge** — demand above the floor goes to preemptible capacity
+  when the task requested spot, placed across ``(cloud, region, zone)``
+  domains by :class:`MixPolicy` ordered by effective $/replica-hour =
+  domain spot price + cross-region egress surcharge
+  (``catalog/egress.py`` prices the hop back to the home region, times
+  ``SKYT_MIX_EGRESS_GB_PER_HR``).
+* **Dynamic backfill** — with ``dynamic_ondemand_fallback``, every
+  spot slot without a READY spot replica is temporarily covered by an
+  on-demand ``is_fallback`` replica (first to be scaled down once spot
+  recovers) — preemptions never leave the fleet under target.
+* **Warm pool** — up to ``SKYT_WARM_POOL_SIZE`` scale-downs become
+  stops (cluster kept, status WARM) instead of teardowns; scale-ups
+  resume the newest matching WARM replica before provisioning cold.
+  WARM replicas older than ``SKYT_WARM_POOL_TTL`` are torn down for
+  real. Scale-to-zero therefore parks the last replicas warm and the
+  first request after idle resumes in seconds, not a full provision.
+
+``plan_mix`` is pure: (spec, target, replica rows, clock) -> Decision
+list, no I/O — the controller applies the decisions as data, the tests
+and the autoscale bench call it directly.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu.catalog import egress
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import (Decision, DecisionOp, _alive,
+                                            victim_order)
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.spot_placer import Domain, DomainSpotPlacer
+from skypilot_tpu.utils import env_registry
+
+# The `reason` vocabulary decisions carry into logs and the
+# skyt_autoscale_decisions_total metric ('warm_miss' is emitted by the
+# controller when a planned warm resume raced away and degraded to a
+# cold scale-up).
+DECISION_REASONS = ('floor', 'spot_surge', 'spot_backfill', 'scale_down',
+                    'warm_resume', 'warm_miss', 'warm_stop',
+                    'warm_expire')
+
+
+def _warm(replicas: List[serve_state.ReplicaRecord]
+          ) -> List[serve_state.ReplicaRecord]:
+    return [r for r in replicas if r.status == ReplicaStatus.WARM]
+
+
+def plan_mix(spec: ServiceSpec,
+             target: int,
+             replicas: List[serve_state.ReplicaRecord],
+             *,
+             spot_wanted: bool,
+             latency_ms: Optional[Dict[int, float]] = None,
+             warm_pool_size: Optional[int] = None,
+             warm_ttl: Optional[float] = None,
+             now_wall: Optional[float] = None) -> List[Decision]:
+    """Plan the fleet toward ``target`` replicas under the mix
+    invariants above. Pure; ``now_wall`` is wall-clock seconds (WARM
+    ages are persisted DB timestamps, unlike the monotonic hysteresis
+    clocks)."""
+    if warm_pool_size is None:
+        warm_pool_size = env_registry.get_int('SKYT_WARM_POOL_SIZE')
+    if warm_ttl is None:
+        warm_ttl = env_registry.get_float('SKYT_WARM_POOL_TTL')
+    if now_wall is None:
+        now_wall = time.time()
+    latency_ms = latency_ms or {}
+
+    alive = _alive(replicas)
+    warm = _warm(replicas)
+    decisions: List[Decision] = []
+
+    # Expire over-age warm replicas first — they also stop counting as
+    # resume candidates and warm-pool occupancy below.
+    expired = [r for r in warm
+               if r.warm_since is not None and
+               now_wall - r.warm_since > warm_ttl]
+    for record in expired:
+        decisions.append(Decision(DecisionOp.SCALE_DOWN,
+                                  replica_id=record.replica_id,
+                                  reason='warm_expire'))
+    warm = [r for r in warm if r not in expired]
+    warm_slots = max(0, warm_pool_size - len(warm))
+
+    floor = min(spec.base_ondemand_fallback_replicas, target)
+    spot_target = (target - floor) if spot_wanted else 0
+    od_target = target - spot_target
+
+    alive_od = [r for r in alive if not r.is_spot and not r.is_fallback]
+    alive_spot = [r for r in alive if r.is_spot]
+    fallback_od = [r for r in alive if not r.is_spot and r.is_fallback]
+    # Newest-first resume candidates (most recently parked = warmest),
+    # matched by exact class: a resumed replica keeps its row's
+    # spot/fallback identity, so cross-class resumes would be counted
+    # against the wrong share next tick and churn the fleet.
+    def _pool(spot: bool, fallback: bool) -> list:
+        return sorted([r for r in warm if r.is_spot == spot and
+                       r.is_fallback == fallback],
+                      key=lambda r: -r.replica_id)
+
+    warm_od = _pool(False, False)
+    warm_spot = _pool(True, False)
+    warm_fallback = _pool(False, True)
+
+    def _scale_up(need: int, *, use_spot: bool, pool: list,
+                  reason: str, is_fallback: bool = False) -> None:
+        for _ in range(need):
+            if pool:
+                record = pool.pop(0)
+                decisions.append(Decision(
+                    DecisionOp.SCALE_UP, use_spot=use_spot,
+                    is_fallback=is_fallback,
+                    resume_replica_id=record.replica_id,
+                    reason='warm_resume'))
+            else:
+                decisions.append(Decision(DecisionOp.SCALE_UP,
+                                          use_spot=use_spot,
+                                          is_fallback=is_fallback,
+                                          reason=reason))
+
+    def _scale_down(victims: list, excess: int, reason: str) -> None:
+        nonlocal warm_slots
+        chosen = victim_order(victims, latency_ms)[:excess]
+        # Warm slots go to the HEALTHIEST victims (the tail of the
+        # shedding order) and only to replicas that were actually
+        # serving: parking a probe-failing or mid-provision replica
+        # would make the "fast resume" path restart the least
+        # trustworthy cluster while a genuinely warm one is torn down.
+        warm_ids = set()
+        for record in reversed(chosen):
+            if warm_slots <= 0:
+                break
+            if record.status == ReplicaStatus.READY:
+                warm_ids.add(record.replica_id)
+                warm_slots -= 1
+        for record in chosen:
+            warm_it = record.replica_id in warm_ids
+            decisions.append(Decision(
+                DecisionOp.SCALE_DOWN, replica_id=record.replica_id,
+                warm=warm_it,
+                reason='warm_stop' if warm_it else reason))
+
+    # -- on-demand floor / share ---------------------------------------
+    if len(alive_od) < od_target:
+        _scale_up(od_target - len(alive_od), use_spot=False,
+                  pool=warm_od, reason='floor')
+    elif len(alive_od) > od_target:
+        _scale_down(alive_od, len(alive_od) - od_target, 'scale_down')
+
+    # -- spot surge ----------------------------------------------------
+    if len(alive_spot) < spot_target:
+        _scale_up(spot_target - len(alive_spot), use_spot=True,
+                  pool=warm_spot, reason='spot_surge')
+    elif len(alive_spot) > spot_target:
+        _scale_down(alive_spot, len(alive_spot) - spot_target,
+                    'scale_down')
+
+    # -- dynamic on-demand backfill while spot recovers ----------------
+    # gap is computed even when backfill is off or the spot share is 0:
+    # fallback replicas left over from a past outage (or a target that
+    # dropped to the floor / to zero) must still be scaled down, or
+    # they serve and bill on-demand forever.
+    gap = 0
+    if spec.dynamic_ondemand_fallback and spot_target > 0:
+        ready_spot = [r for r in alive_spot
+                      if r.status == ReplicaStatus.READY]
+        gap = spot_target - len(ready_spot)
+    if gap > len(fallback_od):
+        _scale_up(gap - len(fallback_od), use_spot=False,
+                  pool=warm_fallback, reason='spot_backfill',
+                  is_fallback=True)
+    elif gap < len(fallback_od):
+        excess = len(fallback_od) - max(gap, 0)
+        _scale_down(fallback_od, excess, 'scale_down')
+
+    return decisions
+
+
+class MixPolicy:
+    """Domain-placement half of the mix: effective pricing + placer.
+
+    ``domain_price`` is the $/replica-hour a domain really costs the
+    service: its (spot) instance price plus the cross-region hop — the
+    per-GB egress price from the domain's cloud/region back to the
+    home (load-balancer) region, times the expected
+    ``SKYT_MIX_EGRESS_GB_PER_HR`` of response traffic. A nominally
+    cheap region on another cloud loses to a slightly pricier
+    same-cloud region once the hop is billed — the MArk/can't-ignore-
+    egress effect the optimizer already models for batch placement.
+    """
+
+    def __init__(self, domains: List[Domain],
+                 home: Optional[Domain] = None,
+                 instance_prices: Optional[Dict[Domain, float]] = None,
+                 placer: Optional[DomainSpotPlacer] = None,
+                 egress_gb_per_hour: Optional[float] = None) -> None:
+        self.domains = list(domains)
+        self.home = home or (domains[0] if domains else
+                             Domain(None, None, None))
+        self.instance_prices = dict(instance_prices or {})
+        self.placer = placer or DomainSpotPlacer(self.domains)
+        if egress_gb_per_hour is None:
+            egress_gb_per_hour = env_registry.get_float(
+                'SKYT_MIX_EGRESS_GB_PER_HR')
+        self.egress_gb_per_hour = egress_gb_per_hour
+
+    def domain_price(self, domain: Domain) -> float:
+        # A domain the price table doesn't know (e.g. one learned from
+        # a legacy replica row via handle_preemption) must never win on
+        # a phantom $0 instance price: inf keeps priced candidates
+        # strictly preferred, while an all-unknown set still
+        # round-robins (equal costs tie-break by rotation).
+        base = self.instance_prices.get(domain)
+        if base is None:
+            base = float('inf')
+        hop = egress.serving_hop_price_per_gb(
+            domain.cloud, domain.region, self.home.cloud, self.home.region)
+        return base + hop * self.egress_gb_per_hour
+
+    def place_spot(self) -> Optional[Domain]:
+        """Cheapest ACTIVE (non-cooling-down) domain for the next spot
+        replica; None only when no domains are known."""
+        return self.placer.select(self.domain_price)
+
+    def handle_preemption(self, domain: Optional[Domain]) -> None:
+        self.placer.handle_preemption(domain)
+
+    def price_fn(self) -> Callable[[Domain], float]:
+        return self.domain_price

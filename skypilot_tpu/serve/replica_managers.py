@@ -24,7 +24,8 @@ from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.spec.task import Task
-from skypilot_tpu.utils import common_utils, env_registry, log
+from skypilot_tpu.utils import (common_utils, env_registry,
+                                fault_injection, log)
 
 logger = log.init_logger(__name__)
 
@@ -50,17 +51,22 @@ class ReplicaManager:
     # -- scale up/down -------------------------------------------------
 
     def scale_up(self, *, use_spot: Optional[bool] = None,
+                 cloud: Optional[str] = None,
+                 region: Optional[str] = None,
                  zone: Optional[str] = None,
                  is_fallback: bool = False) -> int:
         """Start one replica; returns its replica id immediately (launch
-        continues in a worker thread)."""
+        continues in a worker thread). ``cloud``/``region``/``zone``
+        pin the placement domain the mix policy chose."""
         replica_id = serve_state.next_replica_id(self.service_name)
         cluster_name = f'{self.service_name}-replica-{replica_id}'
-        task = self._replica_task(replica_id, use_spot=use_spot, zone=zone)
+        task = self._replica_task(replica_id, use_spot=use_spot,
+                                  cloud=cloud, region=region, zone=zone)
         resources = task.resources[0]
         serve_state.add_replica(self.service_name, replica_id, cluster_name,
                                 is_spot=bool(resources.use_spot),
-                                is_fallback=is_fallback)
+                                is_fallback=is_fallback,
+                                cloud=cloud, region=region, zone=zone)
         thread = threading.Thread(
             target=self._launch_replica,
             args=(replica_id, cluster_name, task),
@@ -74,23 +80,76 @@ class ReplicaManager:
         return replica_id
 
     def scale_down(self, replica_id: int,
-                   status: ReplicaStatus = ReplicaStatus.TERMINATED) -> None:
+                   status: ReplicaStatus = ReplicaStatus.TERMINATED,
+                   *, warm: bool = False) -> None:
         """Terminate one replica asynchronously; its row stays with the
         given terminal status (history, like the reference keeps
-        ReplicaInfo for failed replicas)."""
+        ReplicaInfo for failed replicas). With ``warm=True`` the
+        cluster is STOPPED instead of torn down and the row parks as
+        WARM — the warm-pool fast-resume path."""
         record = serve_state.get_replica(self.service_name, replica_id)
         if record is None or record.status in (ReplicaStatus.SHUTTING_DOWN,
                                                ReplicaStatus.TERMINATED):
             return
+        if warm and record.status == ReplicaStatus.WARM:
+            return
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.SHUTTING_DOWN)
-        thread = threading.Thread(
-            target=self._teardown_replica,
-            args=(replica_id, record.cluster_name, status),
-            name=f'down-{record.cluster_name}', daemon=True)
+        if warm:
+            thread = threading.Thread(
+                target=self._warm_stop_replica,
+                args=(replica_id, record.cluster_name),
+                name=f'warm-{record.cluster_name}', daemon=True)
+        else:
+            thread = threading.Thread(
+                target=self._teardown_replica,
+                args=(replica_id, record.cluster_name, status),
+                name=f'down-{record.cluster_name}', daemon=True)
         thread.start()
         logger.info('Service %s: scaling down replica %d (-> %s).',
-                    self.service_name, replica_id, status.value)
+                    self.service_name, replica_id,
+                    'WARM' if warm else status.value)
+
+    def resume_replica(self, replica_id: int) -> bool:
+        """Resume a WARM replica: restart its stopped cluster and
+        re-run the service payload — skips slice provisioning, so it
+        beats a cold scale-up to READY. Returns False when the row is
+        not resumable (raced away, TTL-expired)."""
+        record = serve_state.get_replica(self.service_name, replica_id)
+        if record is None or record.status != ReplicaStatus.WARM:
+            return False
+        # Resume in the domain the stopped cluster actually lives in:
+        # the replica row only carries a domain when the mix policy
+        # pinned one, the cluster record always knows.
+        cluster = state.get_cluster(record.cluster_name)
+        cloud = record.cloud or (cluster.cloud if cluster else None)
+        region = record.region or (cluster.region if cluster else None)
+        zone = record.zone or (cluster.zone if cluster else None)
+        if region is None:
+            zone = None      # a zone pin without its region is invalid
+        try:
+            task = self._replica_task(replica_id,
+                                      use_spot=bool(record.is_spot),
+                                      cloud=cloud, region=region,
+                                      zone=zone)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception(
+                'Service %s: building resume task for replica %d '
+                'failed; falling back to a cold scale-up.',
+                self.service_name, replica_id)
+            return False
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.PROVISIONING)
+        thread = threading.Thread(
+            target=self._launch_replica,
+            args=(replica_id, record.cluster_name, task),
+            name=f'resume-{record.cluster_name}', daemon=True)
+        with self._lock:
+            self._threads[replica_id] = thread
+        thread.start()
+        logger.info('Service %s: resuming warm replica %d (%s).',
+                    self.service_name, replica_id, record.cluster_name)
+        return True
 
     def recover_inflight(self) -> None:
         """Re-drive replica rows whose worker threads died with a
@@ -130,9 +189,12 @@ class ReplicaManager:
 
     def _replica_task(self, replica_id: int, *,
                       use_spot: Optional[bool],
-                      zone: Optional[str]) -> Task:
+                      cloud: Optional[str] = None,
+                      region: Optional[str] = None,
+                      zone: Optional[str] = None) -> Task:
         """Per-replica task: inject the replica's identity/port envs and
-        any spot/zone overrides from the autoscaler/spot-placer."""
+        any spot/placement-domain overrides from the autoscaler /
+        mix policy."""
         config = self.task.to_yaml_config()
         task = Task.from_yaml_config(config)
         port = (self.spec.port if self.spec.port is not None else
@@ -146,6 +208,10 @@ class ReplicaManager:
             overrides = {}
             if use_spot is not None:
                 overrides['use_spot'] = use_spot
+            if cloud is not None:
+                overrides['cloud'] = cloud
+            if region is not None:
+                overrides['region'] = region
             if zone is not None:
                 overrides['zone'] = zone
             new_resources.append(res.copy(**overrides) if overrides else res)
@@ -196,6 +262,24 @@ class ReplicaManager:
                                          record.zone)
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.STARTING)
+
+    def _warm_stop_replica(self, replica_id: int,
+                           cluster_name: str) -> None:
+        """Stop (don't terminate) the cluster; park the row WARM. A
+        failed stop degrades to a real teardown — a half-stopped
+        cluster must never sit in the warm pool pretending to be
+        resumable."""
+        try:
+            self.backend.teardown(cluster_name, terminate=False)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                'Service %s: warm stop of %s failed (%s); tearing down '
+                'instead.', self.service_name, cluster_name, e)
+            self._teardown_replica(replica_id, cluster_name,
+                                   ReplicaStatus.TERMINATED)
+            return
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.WARM)
 
     def _teardown_replica(self, replica_id: int, cluster_name: str,
                           final_status: ReplicaStatus) -> None:
@@ -253,6 +337,23 @@ class ReplicaManager:
             if record.status in (ReplicaStatus.READY,
                                  ReplicaStatus.NOT_READY,
                                  ReplicaStatus.STARTING):
+                if record.is_spot and record.status == ReplicaStatus.READY:
+                    # Chaos hook (docs/serve_autoscaling.md): an
+                    # injected fault here IS a spot reclaim of a
+                    # SERVING replica — the replica is treated exactly
+                    # like a provider-reported preemption mid-traffic
+                    # (READY-only so startup probes can't consume the
+                    # injection budget before traffic flows).
+                    try:
+                        fault_injection.inject('serve.spot_preempt')
+                    except Exception:  # pylint: disable=broad-except
+                        logger.warning(
+                            'Service %s: replica %d preempted '
+                            '(injected).', self.service_name,
+                            record.replica_id)
+                        self.scale_down(record.replica_id,
+                                        ReplicaStatus.PREEMPTED)
+                        continue
                 if (record.endpoint is not None and
                         self._cluster_preempted(record.cluster_name)):
                     logger.warning('Service %s: replica %d preempted.',

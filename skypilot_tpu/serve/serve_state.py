@@ -40,6 +40,9 @@ class ReplicaStatus(enum.Enum):
     READY = 'READY'
     NOT_READY = 'NOT_READY'           # probe failures; may recover
     SHUTTING_DOWN = 'SHUTTING_DOWN'
+    # Warm pool (scale-to-zero path): cluster stopped but NOT torn
+    # down; serves no traffic, resumes ahead of a cold provision.
+    WARM = 'WARM'
     PREEMPTED = 'PREEMPTED'
     FAILED_PROVISION = 'FAILED_PROVISION'
     FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
@@ -169,6 +172,21 @@ def _db():
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE replicas ADD COLUMN '
                 'lb_ejected_until REAL')
+        if 'cloud' not in replica_cols:
+            # Placement domain (r11 mix policy): which
+            # (cloud, region, zone) the replica was placed into —
+            # preemption cooldowns and egress pricing are per-domain,
+            # and zone alone can't distinguish clouds.
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN cloud TEXT')
+        if 'region' not in replica_cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN region TEXT')
+        if 'warm_since' not in replica_cols:
+            # Wall-clock stamp of entering WARM; the warm-pool TTL
+            # (SKYT_WARM_POOL_TTL) expires against it.
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN warm_since REAL')
         conn.commit()
 
     os.makedirs(serve_dir(), exist_ok=True)
@@ -219,6 +237,14 @@ class ServiceRecord:
         return f'http://{self.lb_host or "127.0.0.1"}:{self.lb_port}'
 
     def to_dict(self) -> Dict[str, Any]:
+        replicas = list_replicas(self.name)
+        # Fleet p99 over the per-replica EWMA TTFB the controller
+        # persists each tick (r7 LB) — `status` runs in other
+        # processes, so this is the cross-process latency surface.
+        from skypilot_tpu.serve import forecast
+        fleet_p99 = forecast.fleet_p99_ms({
+            r.replica_id: r.lb_ewma_ms for r in replicas
+            if r.status == ReplicaStatus.READY and r.lb_ewma_ms})
         return {
             'name': self.name,
             'status': self.status.value,
@@ -228,7 +254,10 @@ class ServiceRecord:
             'controller_cluster': self.controller_cluster,
             'requested_at': self.requested_at,
             'failure_reason': self.failure_reason,
-            'replicas': [r.to_dict() for r in list_replicas(self.name)],
+            'fleet_p99_ms': fleet_p99,
+            'warm_replicas': sum(1 for r in replicas
+                                 if r.status == ReplicaStatus.WARM),
+            'replicas': [r.to_dict() for r in replicas],
         }
 
 
@@ -440,6 +469,12 @@ class ReplicaRecord:
         self.lb_ejected_until: Optional[float] = (
             row['lb_ejected_until'] if 'lb_ejected_until' in keys
             else None)
+        self.cloud: Optional[str] = (
+            row['cloud'] if 'cloud' in keys else None)
+        self.region: Optional[str] = (
+            row['region'] if 'region' in keys else None)
+        self.warm_since: Optional[float] = (
+            row['warm_since'] if 'warm_since' in keys else None)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -449,9 +484,12 @@ class ReplicaRecord:
             'endpoint': self.endpoint,
             'is_spot': self.is_spot,
             'is_fallback': self.is_fallback,
+            'cloud': self.cloud,
+            'region': self.region,
             'zone': self.zone,
             'launched_at': self.launched_at,
             'ready_at': self.ready_at,
+            'warm_since': self.warm_since,
             # Data-plane health (per-replica EWMA TTFB + breaker state
             # from the LB, persisted each controller tick).
             'lb_ewma_ms': self.lb_ewma_ms,
@@ -468,15 +506,18 @@ def next_replica_id(service_name: str) -> int:
 
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
-                *, is_spot: bool, is_fallback: bool = False) -> None:
+                *, is_spot: bool, is_fallback: bool = False,
+                cloud: Optional[str] = None,
+                region: Optional[str] = None,
+                zone: Optional[str] = None) -> None:
     conn = _db()
     conn.execute(
         'INSERT INTO replicas (service_name, replica_id, cluster_name, '
-        'status, is_spot, is_fallback, launched_at) '
-        'VALUES (?, ?, ?, ?, ?, ?, ?)',
+        'status, is_spot, is_fallback, cloud, region, zone, launched_at) '
+        'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
         (service_name, replica_id, cluster_name,
          ReplicaStatus.PROVISIONING.value, int(is_spot), int(is_fallback),
-         time.time()))
+         cloud, region, zone, time.time()))
     conn.commit()
 
 
@@ -505,12 +546,20 @@ def set_replica_status(service_name: str, replica_id: int,
     if status == ReplicaStatus.READY:
         conn.execute(
             'UPDATE replicas SET status = ?, consecutive_failures = 0, '
-            'ready_at = COALESCE(ready_at, ?) '
+            'ready_at = COALESCE(ready_at, ?), warm_since = NULL '
+            'WHERE service_name = ? AND replica_id = ?',
+            (status.value, time.time(), service_name, replica_id))
+    elif status == ReplicaStatus.WARM:
+        # Entering the warm pool: stamp the age the TTL expires
+        # against; a resume (any other transition) clears it.
+        conn.execute(
+            'UPDATE replicas SET status = ?, warm_since = ?, '
+            'endpoint = NULL, consecutive_failures = 0 '
             'WHERE service_name = ? AND replica_id = ?',
             (status.value, time.time(), service_name, replica_id))
     else:
         conn.execute(
-            'UPDATE replicas SET status = ? '
+            'UPDATE replicas SET status = ?, warm_since = NULL '
             'WHERE service_name = ? AND replica_id = ?',
             (status.value, service_name, replica_id))
     conn.commit()

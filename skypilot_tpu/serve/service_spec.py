@@ -5,7 +5,18 @@ shapes:
 
 * fixed — ``replicas: N``;
 * autoscaled — ``replica_policy:`` with min/max replicas and a load
-  target (``target_qps_per_replica`` or ``target_queue_length``).
+  target (``target_qps_per_replica``, ``target_queue_length``, or the
+  predictive ``target_latency_p99_ms``).
+
+``target_latency_p99_ms`` selects the SLO autoscaler
+(serve/slo_autoscaler.py): the fleet is sized from *predicted* p99
+against the target using a short-horizon QPS forecast
+(``forecaster``: ``ewma_trend`` default or ``seasonal``;
+``forecast_horizon_seconds`` overrides SKYT_FORECAST_HORIZON) and a
+fitted latency–concurrency model. ``min_replicas: 0`` enables
+scale-to-zero (after ``scale_to_zero_idle_seconds`` /
+SKYT_SCALE_TO_ZERO_IDLE_S of no traffic) with a warm-pool resume path
+— see docs/serve_autoscaling.md.
 
 Spot-with-fallback knobs (``base_ondemand_fallback_replicas``,
 ``dynamic_ondemand_fallback``) mirror the reference's FallbackAutoscaler
@@ -46,6 +57,10 @@ class ServiceSpec:
         max_replicas: Optional[int] = None,
         target_qps_per_replica: Optional[float] = None,
         target_queue_length: Optional[float] = None,
+        target_latency_p99_ms: Optional[float] = None,
+        forecaster: Optional[str] = None,
+        forecast_horizon_seconds: Optional[float] = None,
+        scale_to_zero_idle_seconds: Optional[float] = None,
         upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS,
         downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS,
         qps_window_seconds: float = DEFAULT_QPS_WINDOW_SECONDS,
@@ -62,16 +77,31 @@ class ServiceSpec:
         if max_replicas is not None and max_replicas < min_replicas:
             raise exceptions.InvalidSpecError(
                 f'max_replicas {max_replicas} < min_replicas {min_replicas}')
-        if (target_qps_per_replica is not None and
-                target_queue_length is not None):
+        targets = [t for t in (target_qps_per_replica,
+                               target_queue_length,
+                               target_latency_p99_ms) if t is not None]
+        if len(targets) > 1:
             raise exceptions.InvalidSpecError(
                 'Set only one of target_qps_per_replica / '
-                'target_queue_length.')
-        autoscaling = (target_qps_per_replica is not None or
-                       target_queue_length is not None)
+                'target_queue_length / target_latency_p99_ms.')
+        if target_latency_p99_ms is not None and target_latency_p99_ms <= 0:
+            raise exceptions.InvalidSpecError(
+                'target_latency_p99_ms must be > 0.')
+        if forecaster is not None:
+            from skypilot_tpu.serve import forecast  # noqa: F401
+            from skypilot_tpu.utils.registry import FORECASTER_REGISTRY
+            if forecaster not in FORECASTER_REGISTRY:
+                raise exceptions.InvalidSpecError(
+                    f'Unknown forecaster {forecaster!r}. Available: '
+                    f'{FORECASTER_REGISTRY.keys()}')
+        autoscaling = bool(targets)
         if autoscaling and max_replicas is None:
             raise exceptions.InvalidSpecError(
                 'Autoscaling (a load target) requires max_replicas.')
+        if min_replicas == 0 and not autoscaling:
+            raise exceptions.InvalidSpecError(
+                'min_replicas: 0 (scale-to-zero) requires a load '
+                'target to scale back up from.')
         self.port = port
         self.readiness_path = readiness_path
         self.initial_delay_seconds = float(initial_delay_seconds)
@@ -81,6 +111,16 @@ class ServiceSpec:
                              if max_replicas is not None else None)
         self.target_qps_per_replica = target_qps_per_replica
         self.target_queue_length = target_queue_length
+        self.target_latency_p99_ms = (
+            float(target_latency_p99_ms)
+            if target_latency_p99_ms is not None else None)
+        self.forecaster = forecaster
+        self.forecast_horizon_seconds = (
+            float(forecast_horizon_seconds)
+            if forecast_horizon_seconds is not None else None)
+        self.scale_to_zero_idle_seconds = (
+            float(scale_to_zero_idle_seconds)
+            if scale_to_zero_idle_seconds is not None else None)
         self.upscale_delay_seconds = float(upscale_delay_seconds)
         self.downscale_delay_seconds = float(downscale_delay_seconds)
         self.qps_window_seconds = float(qps_window_seconds)
@@ -96,7 +136,8 @@ class ServiceSpec:
     @property
     def autoscaling(self) -> bool:
         return (self.target_qps_per_replica is not None or
-                self.target_queue_length is not None)
+                self.target_queue_length is not None or
+                self.target_latency_p99_ms is not None)
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -150,6 +191,9 @@ class ServiceSpec:
         if policy is not None:
             for key in ('min_replicas', 'max_replicas',
                         'target_qps_per_replica', 'target_queue_length',
+                        'target_latency_p99_ms', 'forecaster',
+                        'forecast_horizon_seconds',
+                        'scale_to_zero_idle_seconds',
                         'upscale_delay_seconds', 'downscale_delay_seconds',
                         'qps_window_seconds',
                         'base_ondemand_fallback_replicas',
@@ -193,6 +237,16 @@ class ServiceSpec:
             policy['target_qps_per_replica'] = self.target_qps_per_replica
         if self.target_queue_length is not None:
             policy['target_queue_length'] = self.target_queue_length
+        if self.target_latency_p99_ms is not None:
+            policy['target_latency_p99_ms'] = self.target_latency_p99_ms
+        if self.forecaster is not None:
+            policy['forecaster'] = self.forecaster
+        if self.forecast_horizon_seconds is not None:
+            policy['forecast_horizon_seconds'] = (
+                self.forecast_horizon_seconds)
+        if self.scale_to_zero_idle_seconds is not None:
+            policy['scale_to_zero_idle_seconds'] = (
+                self.scale_to_zero_idle_seconds)
         if self.base_ondemand_fallback_replicas:
             policy['base_ondemand_fallback_replicas'] = (
                 self.base_ondemand_fallback_replicas)
@@ -205,7 +259,8 @@ class ServiceSpec:
         if self.autoscaling:
             scale = (f'{self.min_replicas}..{self.max_replicas} '
                      f'(qps/replica={self.target_qps_per_replica}, '
-                     f'queue={self.target_queue_length})')
+                     f'queue={self.target_queue_length}, '
+                     f'p99_ms={self.target_latency_p99_ms})')
         else:
             scale = str(self.min_replicas)
         return f'ServiceSpec(port={self.port}, replicas={scale})'

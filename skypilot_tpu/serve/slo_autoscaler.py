@@ -1,0 +1,212 @@
+"""SLO-driven predictive autoscaler: size the fleet from *predicted*
+p99 latency against ``target_latency_p99_ms``, not from the last
+window's QPS.
+
+The decision chain each controller tick (all pure; the controller
+applies the resulting ``Decision`` list exactly like the reactive
+autoscalers'):
+
+1. feed the forecaster with the LB's monotonic-window QPS and the
+   latency model with the observed operating point (per-replica
+   concurrency, fleet p99 over per-replica EWMA TTFB);
+2. predict QPS at ``now + horizon`` (``SKYT_FORECAST_HORIZON``, or
+   ``replica_policy.forecast_horizon_seconds``) — the horizon should
+   cover the provision/resume time, so capacity lands *before* the
+   ramp does (Autopilot's forecast-then-act, MArk's provision-ahead);
+3. invert the fitted latency–concurrency model: with
+   ``p99(c) ~= base + slope*c`` and Little's law
+   ``c = qps * p99(c)/1000 / n``, the smallest SLO-satisfying fleet
+   has a closed form (derivation in docs/serve_autoscaling.md) —
+   using p99 as the Little's-law sojourn time over-estimates demand
+   slightly, which errs the fleet size on the safe side;
+4. run the raw target through the shared hysteresis base (TPU slices
+   must not flap) and hand it to ``mix_policy.plan_mix`` for the
+   on-demand floor / spot surge / warm-pool split.
+
+Scale-to-zero: with ``min_replicas: 0``, once observed AND predicted
+QPS have been zero for ``SKYT_SCALE_TO_ZERO_IDLE_S`` the target drops
+to 0 — plan_mix parks the last replicas WARM (stopped, not torn down)
+so the first request after idle resumes in seconds instead of
+re-provisioning a slice.
+
+Fallbacks are deliberate: before the latency model has two distinct
+operating points, the autoscaler holds the current fleet (scaling on a
+model it hasn't fitted would be noise-chasing); if even an idle
+replica's predicted p99 misses the target (base > target), adding
+replicas cannot help and the fleet holds while the condition is
+surfaced via ``snapshot()['slo_attainable']``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import (Autoscaler, Decision,
+                                            LoadStats, _alive)
+from skypilot_tpu.serve.forecast import (LatencyModel, fleet_p99_ms,
+                                         make_forecaster)
+from skypilot_tpu.utils import env_registry, log
+from skypilot_tpu.utils.registry import AUTOSCALER_REGISTRY
+
+logger = log.init_logger(__name__)
+
+_EPS_QPS = 1e-6
+# Predicted rates below this (fewer than ~1 request / 100 s) count as
+# "no traffic coming" for the scale-to-zero gate — the trend forecast
+# decays geometrically after traffic stops and would otherwise keep a
+# replica alive for an infinitesimal tail.
+_ZERO_QPS = 0.01
+
+
+@AUTOSCALER_REGISTRY.register('slo')
+class SLOAutoscaler(Autoscaler):
+    """Predictive latency-SLO autoscaler (selected by
+    ``replica_policy.target_latency_p99_ms``)."""
+
+    def __init__(self, spec) -> None:
+        super().__init__(spec)
+        assert spec.target_latency_p99_ms is not None
+        self.forecaster = make_forecaster(spec.forecaster)
+        self.latency_model = LatencyModel()
+        self.horizon = (spec.forecast_horizon_seconds
+                        if spec.forecast_horizon_seconds is not None else
+                        env_registry.get_float('SKYT_FORECAST_HORIZON'))
+        self.idle_seconds = (
+            spec.scale_to_zero_idle_seconds
+            if spec.scale_to_zero_idle_seconds is not None else
+            env_registry.get_float('SKYT_SCALE_TO_ZERO_IDLE_S'))
+        self.warm_pool_size = env_registry.get_int('SKYT_WARM_POOL_SIZE')
+        self.warm_ttl = env_registry.get_float('SKYT_WARM_POOL_TTL')
+        # Whether the task requested preemptible capacity; the
+        # controller stamps this from task.resources after from_spec.
+        self.spot_wanted = False
+        self._last_traffic: Optional[float] = None
+        self._ready_count = 0
+        self._snapshot: Dict[str, Any] = {}
+
+    # -- sizing --------------------------------------------------------
+
+    def _required_replicas(self, predicted_qps: float) -> Optional[int]:
+        """Smallest fleet whose predicted p99 meets the target, or None
+        when the model can't answer (unfitted / target unattainable).
+
+        Closed form: with p99(c) = base + slope*c and Little's law
+        c = qps*p99(c)/1000/n, replica concurrency at fleet size n is
+        c = base / (1000*n/qps - slope) (positive-denominator branch),
+        and p99 <= target iff n >= qps/1000 * slope*target/(target-base).
+        """
+        target_ms = self.spec.target_latency_p99_ms
+        if predicted_qps <= _EPS_QPS:
+            return 0 if self.spec.min_replicas == 0 else \
+                self.spec.min_replicas
+        if not self.latency_model.fitted:
+            return None
+        base, slope = self.latency_model.coefficients()
+        if base > target_ms:
+            return None  # unattainable: no fleet size fixes base > SLO
+        if slope <= 1e-12:
+            # Latency insensitive to load in the observed range: one
+            # replica satisfies the model; hysteresis + refit correct
+            # it if reality disagrees at higher load.
+            return 1
+        n = (predicted_qps / 1000.0) * (slope * target_ms /
+                                        max(1e-9, target_ms - base))
+        return max(1, int(math.ceil(n - 1e-9)))
+
+    def _raw_target(self, stats: LoadStats, num_alive: int) -> int:
+        now = self._clock()
+        self.forecaster.observe(now, stats.qps)
+        observed_p99 = fleet_p99_ms(stats.replica_latency_ms)
+        # Fit the latency model only at steady-state operating points:
+        # while the measured fleet (replicas with a latency sample) is
+        # below the planned target, the fleet is mid-transition and
+        # queueing blow-up there is NOT on the base+slope*c line — a
+        # few saturated samples would tilt the slope and oversize
+        # every later fleet (MArk/Autopilot fit on steady state too).
+        num_ready = len(stats.replica_latency_ms)
+        if (observed_p99 is not None and num_ready > 0 and
+                num_ready >= max(1, self._target)):
+            concurrency = stats.queue_length / num_ready
+            self.latency_model.observe(concurrency, observed_p99)
+        predicted_qps = self.forecaster.predict(now, self.horizon)
+
+        if (self._last_traffic is None or stats.qps > _EPS_QPS or
+                (self._target > 0 and self._ready_count == 0)):
+            # The idle countdown only accrues while capacity is READY
+            # to receive traffic: a service whose first (or resuming)
+            # replica is still provisioning is not "idle", it is
+            # starting — without this, a slow provision gets parked
+            # WARM before it ever serves.
+            self._last_traffic = now
+        idle_for = now - self._last_traffic
+        can_zero = (self.spec.min_replicas == 0 and
+                    stats.qps <= _EPS_QPS and
+                    predicted_qps <= _ZERO_QPS and
+                    idle_for >= self.idle_seconds)
+
+        required = self._required_replicas(predicted_qps)
+        if required is None:
+            # Hold the current fleet: model unfitted or SLO
+            # unattainable — but never hold at zero while traffic
+            # exists (a scaled-to-zero service must wake on the first
+            # request, before any latency sample can exist).
+            required = self._target
+            if predicted_qps > _EPS_QPS:
+                required = max(1, required)
+        if can_zero:
+            required = 0
+        elif self.spec.min_replicas == 0:
+            # Not idle long enough: a scale-to-zero service holds at
+            # least one replica while any traffic is in sight.
+            required = max(1, required)
+        base, slope = self.latency_model.coefficients()
+        self._snapshot = {
+            'predicted_qps': predicted_qps,
+            'observed_qps': stats.qps,
+            'observed_p99_ms': observed_p99,
+            'model_base_ms': base,
+            'model_slope_ms': slope,
+            'model_fitted': self.latency_model.fitted,
+            'slo_attainable': (not self.latency_model.fitted or
+                               base <= self.spec.target_latency_p99_ms),
+            'idle_seconds': idle_for,
+            'raw_target': required,
+        }
+        return required
+
+    # -- evaluation ----------------------------------------------------
+
+    def evaluate(self, stats: LoadStats,
+                 replicas: List[serve_state.ReplicaRecord]
+                 ) -> List[Decision]:
+        from skypilot_tpu.serve.mix_policy import plan_mix
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        alive = _alive(replicas)
+        self._ready_count = sum(1 for r in alive
+                                if r.status == ReplicaStatus.READY)
+        target = self.target_replicas(stats, len(alive))
+        self._snapshot['target'] = target
+        # Predicted p99 AT the planned fleet (what the target was
+        # chosen to achieve) for the metrics/status surface.
+        self._snapshot['predicted_p99_ms'] = self._predicted_p99_at(
+            self._snapshot.get('predicted_qps', 0.0), target)
+        return plan_mix(self.spec, target, replicas,
+                        spot_wanted=self.spot_wanted,
+                        latency_ms=stats.replica_latency_ms,
+                        warm_pool_size=self.warm_pool_size,
+                        warm_ttl=self.warm_ttl)
+
+    def _predicted_p99_at(self, qps: float, n: int) -> Optional[float]:
+        if n <= 0 or not self.latency_model.fitted:
+            return None
+        base, slope = self.latency_model.coefficients()
+        denom = 1000.0 * n / max(qps, _EPS_QPS) - slope
+        if denom <= 0:
+            return None    # saturated at this fleet size: no finite p99
+        return base + slope * (base / denom)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Last evaluation's internals (forecast, model fit, target)
+        for the controller's metrics emission and `status`."""
+        return dict(self._snapshot)

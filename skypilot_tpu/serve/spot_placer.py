@@ -1,40 +1,97 @@
-"""Spot placement across zones (parity: ``sky/serve/spot_placer.py``
-SpotPlacer :170 / DynamicFallbackSpotPlacer :254).
+"""Spot placement across preemption domains (parity:
+``sky/serve/spot_placer.py`` SpotPlacer :170 /
+DynamicFallbackSpotPlacer :254, generalized for the r11 mix policy).
 
-Zones are classified ACTIVE (no recent preemption) or PREEMPTIVE
-(preempted recently). New spot replicas go to ACTIVE zones round-robin;
-a preemption demotes its zone for a cooldown, after which it is retried
-— TPU spot capacity is strongly zone-correlated, so spreading replicas
-over zones is the main availability lever.
+Domains are classified ACTIVE (no recent preemption) or PREEMPTIVE
+(preempted recently). New spot replicas go to ACTIVE domains; a
+preemption demotes its domain for a cooldown, after which it is
+retried — TPU spot capacity is strongly zone-correlated, so spreading
+replicas over domains is the main availability lever.
+
+Two granularities share the machinery:
+
+* :class:`DynamicFallbackSpotPlacer` — the original zone-string placer
+  (round-robin over active zones), kept for single-region services;
+* :class:`DomainSpotPlacer` — keys are :class:`Domain`
+  ``(cloud, region, zone)`` tuples and selection is cost-ordered (the
+  mix policy passes a $/replica-hour price function that folds in the
+  cross-region egress surcharge from ``catalog/egress.py``), with
+  round-robin only as the equal-cost tie-break.
+
+Cooldown tracking runs on ``time.monotonic`` (injectable for tests):
+a wall-clock step (NTP slew, manual reset) must not instantly
+re-activate a domain that preempted seconds ago — the same
+wall-clock-step bug PR 4 fixed in the LB's QPS ring.
 """
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, Hashable, List, NamedTuple, Optional
 
 PREEMPTION_COOLDOWN_SECONDS = 1800.0
 
 
-class DynamicFallbackSpotPlacer:
-    def __init__(self, zones: List[str],
-                 cooldown: float = PREEMPTION_COOLDOWN_SECONDS) -> None:
-        self._zones = list(zones)
+class Domain(NamedTuple):
+    """One preemption/failure domain a replica can be placed into."""
+    cloud: Optional[str]
+    region: Optional[str]
+    zone: Optional[str]
+
+    def __str__(self) -> str:
+        return '/'.join(p or '*' for p in (self.cloud, self.region,
+                                           self.zone))
+
+
+class _CooldownPlacer:
+    """Shared ACTIVE/PREEMPTIVE bookkeeping over opaque hashable keys."""
+
+    def __init__(self, keys: List[Hashable],
+                 cooldown: float = PREEMPTION_COOLDOWN_SECONDS,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._keys: List[Hashable] = list(keys)
         self._cooldown = cooldown
-        self._preempted_at: Dict[str, float] = {}
+        self._clock = clock
+        self._preempted_at: Dict[Hashable, float] = {}
         self._next = 0
 
-    def active_zones(self) -> List[str]:
-        now = time.time()
+    @property
+    def keys(self) -> List[Hashable]:
+        return list(self._keys)
+
+    def active(self) -> List[Hashable]:
+        now = self._clock()
         active = [
-            z for z in self._zones
-            if now - self._preempted_at.get(z, 0) > self._cooldown
+            k for k in self._keys
+            if k not in self._preempted_at or
+            now - self._preempted_at[k] > self._cooldown
         ]
-        # All zones preemptive: fall back to the least-recently-preempted
-        # rather than refusing to place (ref :254 Dynamic*Fallback*).
-        if not active and self._zones:
-            active = sorted(self._zones,
-                            key=lambda z: self._preempted_at.get(z, 0))[:1]
+        # All domains preemptive: fall back to the least-recently-
+        # preempted rather than refusing to place (ref :254
+        # Dynamic*Fallback*).
+        if not active and self._keys:
+            active = sorted(
+                self._keys,
+                key=lambda k: self._preempted_at.get(k, 0.0))[:1]
         return active
+
+    def handle_preemption(self, key: Optional[Hashable]) -> None:
+        if key is None:
+            return
+        self._preempted_at[key] = self._clock()
+        if key not in self._keys:
+            self._keys.append(key)
+
+
+class DynamicFallbackSpotPlacer(_CooldownPlacer):
+    """Zone-string placer: round-robin over active zones."""
+
+    def __init__(self, zones: List[str],
+                 cooldown: float = PREEMPTION_COOLDOWN_SECONDS,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(list(zones), cooldown, clock)
+
+    def active_zones(self) -> List[str]:
+        return self.active()
 
     def select(self) -> Optional[str]:
         """Zone for the next spot replica (round-robin over active)."""
@@ -45,8 +102,33 @@ class DynamicFallbackSpotPlacer:
         self._next += 1
         return zone
 
-    def handle_preemption(self, zone: Optional[str]) -> None:
-        if zone is not None:
-            self._preempted_at[zone] = time.time()
-            if zone not in self._zones:
-                self._zones.append(zone)
+
+class DomainSpotPlacer(_CooldownPlacer):
+    """(cloud, region, zone) placer with cost-ordered selection."""
+
+    def __init__(self, domains: List[Domain],
+                 cooldown: float = PREEMPTION_COOLDOWN_SECONDS,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(list(domains), cooldown, clock)
+
+    def select(self,
+               price_fn: Optional[Callable[[Domain], float]] = None
+               ) -> Optional[Domain]:
+        """Cheapest ACTIVE domain per ``price_fn`` ($/replica-hour,
+        egress-inclusive — see mix_policy.MixPolicy.domain_price);
+        equal-cost candidates rotate round-robin so one cheap zone
+        doesn't absorb the whole fleet (preemptions are correlated
+        within a domain)."""
+        active = self.active()
+        if not active:
+            return None
+        if price_fn is None:
+            choice = active[self._next % len(active)]
+            self._next += 1
+            return choice
+        priced = [(price_fn(d), d) for d in active]
+        best = min(p for p, _ in priced)
+        cheapest = [d for p, d in priced if p <= best + 1e-9]
+        choice = cheapest[self._next % len(cheapest)]
+        self._next += 1
+        return choice

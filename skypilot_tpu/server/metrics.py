@@ -216,7 +216,45 @@ LB_POOL_REUSE = Counter(
     'connection (vs a fresh TCP dial)',
     labels=())
 
-_LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE]
+# -- serve predictive autoscaling (emitted by the per-service
+# controller, which shares the service process with the LB — scraped
+# from the same /-/lb/metrics surface; schemas in
+# docs/serve_autoscaling.md) -------------------------------------------
+
+AUTOSCALE_PREDICTED_QPS = Gauge(
+    'skyt_autoscale_predicted_qps',
+    'Forecast QPS at now+horizon (SKYT_FORECAST_HORIZON) per service',
+    labels=('service',))
+AUTOSCALE_PREDICTED_P99 = Gauge(
+    'skyt_autoscale_predicted_p99_ms',
+    'Model-predicted fleet p99 TTFB (ms) at the planned fleet size',
+    labels=('service',))
+AUTOSCALE_FLEET_P99 = Gauge(
+    'skyt_autoscale_fleet_p99_ms',
+    'Observed fleet p99 over per-replica EWMA TTFB (ms)',
+    labels=('service',))
+AUTOSCALE_TARGET = Gauge(
+    'skyt_autoscale_target_replicas',
+    'Hysteresis-filtered fleet-size target the controller is driving '
+    'toward',
+    labels=('service',))
+AUTOSCALE_WARM_POOL = Gauge(
+    'skyt_autoscale_warm_pool_replicas',
+    'Replicas currently parked WARM (stopped, resumable) per service',
+    labels=('service',))
+AUTOSCALE_DECISIONS = Counter(
+    'skyt_autoscale_decisions_total',
+    'Autoscaler decisions applied by op (scale_up, scale_down) and '
+    'reason (floor, spot_surge, spot_backfill, scale_down, '
+    'warm_resume, warm_stop, warm_expire, or the op itself for the '
+    'legacy reactive autoscalers)',
+    labels=('service', 'op', 'reason'))
+
+_AUTOSCALE_METRICS = [AUTOSCALE_PREDICTED_QPS, AUTOSCALE_PREDICTED_P99,
+                      AUTOSCALE_FLEET_P99, AUTOSCALE_TARGET,
+                      AUTOSCALE_WARM_POOL, AUTOSCALE_DECISIONS]
+
+_LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE] + _AUTOSCALE_METRICS
 
 # -- storage/checkpoint data plane (incremented in-process by the
 # transfer engine, client- or cluster-side) ----------------------------

@@ -124,6 +124,12 @@ _SERVICE = {
                 'max_replicas': {'type': 'integer', 'minimum': 0},
                 'target_qps_per_replica': {'type': ['integer', 'number']},
                 'target_queue_length': {'type': ['integer', 'number']},
+                'target_latency_p99_ms': {'type': ['integer', 'number']},
+                'forecaster': {'type': 'string'},
+                'forecast_horizon_seconds': {
+                    'type': ['integer', 'number']},
+                'scale_to_zero_idle_seconds': {
+                    'type': ['integer', 'number']},
                 'upscale_delay_seconds': {'type': ['integer', 'number']},
                 'downscale_delay_seconds': {'type': ['integer', 'number']},
                 'qps_window_seconds': {'type': ['integer', 'number']},
@@ -134,7 +140,7 @@ _SERVICE = {
         'load_balancing_policy': {
             'type': 'string',
             'enum': ['round_robin', 'least_load',
-                     'instance_aware_least_load'],
+                     'instance_aware_least_load', 'p2c_ewma'],
         },
     },
 }

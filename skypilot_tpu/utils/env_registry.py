@@ -246,6 +246,30 @@ DECLARATIONS: List[EnvVar] = _decl([
      'Payload: port a serve replica must listen on.', True),
     ('SKYT_SERVE_REPLICA_ID', 'int', None,
      'Payload: replica id within its service.', True),
+    ('SKYT_FORECAST_HORIZON', 'float', 60.0,
+     'SLO autoscaler: QPS forecast horizon (seconds) — should cover '
+     'replica provision/resume time so capacity lands before the '
+     'ramp (replica_policy.forecast_horizon_seconds overrides).'),
+    ('SKYT_FORECAST_SEASONAL_PERIOD', 'float', 86400.0,
+     'Seasonal forecaster: ring period (seconds; default one day for '
+     'diurnal traffic).'),
+    ('SKYT_FORECAST_SEASONAL_BUCKETS', 'int', 48,
+     'Seasonal forecaster: phase buckets per period.'),
+    ('SKYT_WARM_POOL_SIZE', 'int', 1,
+     'Serve warm pool: max replicas parked stopped-not-torn-down for '
+     'fast resume (0 disables; used by the SLO autoscaler mix '
+     'policy).'),
+    ('SKYT_WARM_POOL_TTL', 'float', 1800.0,
+     'Serve warm pool: seconds a WARM replica is kept before a real '
+     'teardown.'),
+    ('SKYT_SCALE_TO_ZERO_IDLE_S', 'float', 300.0,
+     'SLO autoscaler: observed+predicted-idle seconds before a '
+     'min_replicas:0 service scales to zero '
+     '(replica_policy.scale_to_zero_idle_seconds overrides).'),
+    ('SKYT_MIX_EGRESS_GB_PER_HR', 'float', 1.0,
+     'Mix policy: expected cross-region response traffic per replica '
+     '(GB/hour) used to fold the egress hop into a domain\'s '
+     'effective $/replica-hour.'),
     ('SKYT_LB_POOL_SIZE', 'int', 8,
      'LB: max idle keep-alive connections kept per replica (0 '
      'disables pooling).'),

@@ -71,5 +71,6 @@ CLOUD_REGISTRY: 'Registry' = Registry('cloud')
 BACKEND_REGISTRY: 'Registry' = Registry('backend')
 JOBS_RECOVERY_STRATEGY_REGISTRY: 'Registry' = Registry('jobs-recovery-strategy')
 AUTOSCALER_REGISTRY: 'Registry' = Registry('autoscaler')
+FORECASTER_REGISTRY: 'Registry' = Registry('forecaster')
 LB_POLICY_REGISTRY: 'Registry' = Registry('load-balancing-policy')
 MODEL_REGISTRY: 'Registry' = Registry('model')

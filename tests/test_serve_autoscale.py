@@ -1,0 +1,757 @@
+"""SLO-driven predictive autoscaling (r11 subsystem): forecaster
+numerics, latency-model fitting, SLO fleet sizing + hysteresis, mix
+policy invariants (floor / spot surge / warm pool / domain pricing),
+monotonic-clock satellites, the scale-to-zero -> warm-resume round
+trip on the fake cloud, and the spot-preemption chaos/latency smoke
+(docs/serve_autoscaling.md)."""
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.catalog import egress
+from skypilot_tpu.provision import fake
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import (Autoscaler, DecisionOp,
+                                            LoadStats,
+                                            RequestRateAutoscaler)
+from skypilot_tpu.serve.forecast import (EwmaTrendForecaster, LatencyModel,
+                                         SeasonalRingForecaster,
+                                         fleet_p99_ms, make_forecaster)
+from skypilot_tpu.serve.mix_policy import MixPolicy, plan_mix
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.slo_autoscaler import SLOAutoscaler
+from skypilot_tpu.serve.spot_placer import Domain, DomainSpotPlacer
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+from tests.fault_injection import clause, inject_faults
+
+ECHO_SERVER = ('python3 -m http.server "$SKYT_SERVE_REPLICA_PORT" '
+               '--bind 127.0.0.1')
+
+
+def _spec(**kw):
+    defaults = dict(min_replicas=1, max_replicas=8,
+                    target_latency_p99_ms=150.0,
+                    upscale_delay_seconds=0, downscale_delay_seconds=0)
+    defaults.update(kw)
+    return ServiceSpec(**defaults)
+
+
+class _R:
+    """Replica-row stand-in for the pure planners."""
+
+    def __init__(self, replica_id, status=ReplicaStatus.READY,
+                 is_spot=False, is_fallback=False, warm_since=None):
+        self.replica_id = replica_id
+        self.status = status
+        self.is_spot = is_spot
+        self.is_fallback = is_fallback
+        self.warm_since = warm_since
+        self.cloud = self.region = self.zone = None
+
+
+# -- forecaster numerics ----------------------------------------------------
+
+
+def test_ewma_trend_tracks_step_load():
+    f = EwmaTrendForecaster()
+    t = 0.0
+    for _ in range(5):
+        f.observe(t, 0.0)
+        t += 10
+    for _ in range(10):
+        f.observe(t, 10.0)
+        t += 10
+    # Sustained step: the forecast converges near the new rate and
+    # never goes negative.
+    assert 8.0 <= f.predict(t, 30.0) <= 14.0
+    assert f.predict(t, 1e6) >= 0.0
+
+
+def test_ewma_trend_extrapolates_ramp():
+    f = EwmaTrendForecaster()
+    for i in range(30):
+        f.observe(i * 10.0, float(i))  # +0.1 qps/s ramp
+    now = 300.0
+    ahead = f.predict(now, 100.0)
+    # The horizon forecast must be ABOVE the current level — a purely
+    # reactive window can only ever see the past.
+    assert ahead > f.predict(now, 0.0)
+    assert ahead == pytest.approx(f.predict(now, 0.0) + 0.1 * 100.0,
+                                  rel=0.5)
+
+
+def test_seasonal_ring_warmup_falls_back_to_trend():
+    f = SeasonalRingForecaster(period_seconds=60, buckets=6)
+    for i in range(3):
+        f.observe(i * 10.0, 5.0)   # slots 0..2 seen, 3..5 never
+    now = 25.0
+    # Horizon landing in an unseen slot: no seasonal correction.
+    assert f.seasonal_delta(now, 20.0) == 0.0
+    assert f.predict(now, 20.0) == pytest.approx(
+        f._trend.predict(now, 20.0))
+
+
+def test_seasonal_ring_anticipates_recurring_burst():
+    f = SeasonalRingForecaster(period_seconds=60, buckets=6)
+    # Two periods of a square wave: slots 0-2 low (2 qps), 3-5 high
+    # (20 qps).
+    t = 0.0
+    for _ in range(2):
+        for _ in range(6):
+            qps = 2.0 if (t % 60) < 30 else 20.0
+            f.observe(t, qps)
+            t += 10
+    now = t + 5  # low phase (slot 0), high phase starts in 25 s
+    low_now = f.predict(now, 0.0)
+    into_high = f.predict(now, 30.0)
+    assert into_high > low_now + 5.0   # ring anticipates the burst
+    assert f.seasonal_delta(now, 30.0) > 10.0
+
+
+def test_latency_model_monotone_and_clamped():
+    m = LatencyModel()
+    for _ in range(30):
+        m.observe(1.0, 62.0)
+        m.observe(5.0, 98.0)
+        m.observe(9.0, 142.0)
+    assert m.fitted
+    prev = -1.0
+    for c in range(0, 20):
+        p = m.predict_p99_ms(float(c))
+        assert p >= prev     # monotone non-decreasing in concurrency
+        prev = p
+    # Anti-correlated samples must clamp to slope 0, never negative.
+    m2 = LatencyModel()
+    for _ in range(20):
+        m2.observe(1.0, 100.0)
+        m2.observe(9.0, 50.0)
+    base, slope = m2.coefficients()
+    assert slope == 0.0
+    assert m2.predict_p99_ms(100.0) == m2.predict_p99_ms(0.0)
+
+
+def test_latency_model_inversion():
+    m = LatencyModel()
+    for _ in range(10):
+        m.observe(0.0, 50.0)
+        m.observe(10.0, 150.0)   # base 50, slope 10
+    c_max = m.max_concurrency_within(150.0)
+    assert c_max == pytest.approx(10.0, rel=0.05)
+    assert m.max_concurrency_within(40.0) is None  # base > target
+
+
+def test_fleet_p99():
+    assert fleet_p99_ms({}) is None
+    assert fleet_p99_ms({1: 10.0}) == 10.0
+    assert fleet_p99_ms({1: 10.0, 2: 90.0, 3: 50.0}) == 90.0
+
+
+def test_forecaster_registry():
+    assert isinstance(make_forecaster(None), EwmaTrendForecaster)
+    assert isinstance(make_forecaster('seasonal'), SeasonalRingForecaster)
+    with pytest.raises(KeyError):
+        make_forecaster('nope')
+
+
+# -- SLO autoscaler ---------------------------------------------------------
+
+
+def _prime_model(scaler, base=50.0, slope=10.0):
+    for _ in range(10):
+        scaler.latency_model.observe(0.0, base)
+        scaler.latency_model.observe(10.0, base + slope * 10.0)
+
+
+def _sim_clock(scaler):
+    clock = {'t': 0.0}
+    scaler._clock = lambda: clock['t']
+    return clock
+
+
+def test_slo_sizes_fleet_from_predicted_p99():
+    scaler = SLOAutoscaler(_spec())
+    clock = _sim_clock(scaler)
+    _prime_model(scaler)     # base 50ms, slope 10ms/conc, target 150ms
+    replicas = [_R(1)]
+    # Converge the forecast level onto 400 qps (horizon default 60 s,
+    # zero trend once converged).
+    for _ in range(25):
+        clock['t'] += 10
+        decisions = scaler.evaluate(LoadStats(qps=400.0), replicas)
+    # Closed form: n = qps/1000 * slope*target/(target-base)
+    #            = 0.4 * 10*150/100 = 6.
+    assert scaler.snapshot()['target'] == 6
+    ups = [d for d in decisions if d.op == DecisionOp.SCALE_UP]
+    assert sum(d.count for d in ups) == 5
+    # Predicted p99 at the planned fleet respects the target.
+    assert scaler.snapshot()['predicted_p99_ms'] <= 150.0 + 1e-6
+
+
+def test_slo_holds_fleet_without_latency_signal():
+    scaler = SLOAutoscaler(_spec(min_replicas=2))
+    _sim_clock(scaler)
+    replicas = [_R(1), _R(2)]
+    decisions = scaler.evaluate(LoadStats(qps=500.0), replicas)
+    # Model unfitted: never scale on noise, hold the current fleet.
+    assert decisions == []
+    assert scaler.snapshot()['model_fitted'] is False
+
+
+def test_slo_unattainable_target_holds_and_reports():
+    scaler = SLOAutoscaler(_spec(target_latency_p99_ms=30.0))
+    clock = _sim_clock(scaler)
+    _prime_model(scaler)   # base 50ms > 30ms target
+    replicas = [_R(1)]
+    for _ in range(5):
+        clock['t'] += 10
+        decisions = scaler.evaluate(LoadStats(qps=100.0), replicas)
+    assert decisions == []
+    assert scaler.snapshot()['slo_attainable'] is False
+
+
+def test_slo_hysteresis_delays_upscale():
+    scaler = SLOAutoscaler(_spec(upscale_delay_seconds=300))
+    clock = _sim_clock(scaler)
+    _prime_model(scaler)
+    replicas = [_R(1)]
+    stats = LoadStats(qps=400.0)
+    fired_at = None
+    for _ in range(60):
+        clock['t'] += 10
+        decisions = scaler.evaluate(stats, replicas)
+        if any(d.op == DecisionOp.SCALE_UP for d in decisions):
+            fired_at = clock['t']
+            break
+    # The move must be sustained across the stabilization window: no
+    # upscale before 300 s of continuously-high demand, but it does
+    # fire once the window is covered.
+    assert fired_at is not None
+    assert fired_at >= 300.0
+
+
+def test_slo_scale_to_zero_after_idle_parks_warm():
+    scaler = SLOAutoscaler(_spec(min_replicas=0,
+                                 scale_to_zero_idle_seconds=100))
+    clock = _sim_clock(scaler)
+    scaler.warm_pool_size = 1
+    replicas = [_R(1), _R(2)]
+    clock['t'] = 10
+    assert scaler.evaluate(LoadStats(qps=5.0), replicas) == [] or True
+    # Traffic stops; before the idle threshold the fleet holds >= 1.
+    clock['t'] = 50
+    decisions = scaler.evaluate(LoadStats(qps=0.0), replicas)
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    assert len(downs) <= 1          # may trim toward 1, never to zero
+    # Idle past the threshold (and the forecast has decayed): target 0,
+    # the first victim parks WARM, the rest tear down.
+    for step in range(30):
+        clock['t'] = 120 + step * 10
+        decisions = scaler.evaluate(LoadStats(qps=0.0), replicas)
+        if decisions and scaler.snapshot()['target'] == 0:
+            break
+    assert scaler.snapshot()['target'] == 0
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    assert len(downs) == 2
+    assert sum(1 for d in downs if d.warm) == 1
+    assert {d.reason for d in downs} == {'warm_stop', 'scale_down'}
+
+
+def test_wake_from_zero_bypasses_upscale_window():
+    """Scale-from-zero must not wait out the upscale stabilization
+    window: at target 0 there is no fleet to protect from flapping —
+    every stabilized second is a second of 503s."""
+    scaler = SLOAutoscaler(_spec(min_replicas=0,
+                                 upscale_delay_seconds=600))
+    clock = _sim_clock(scaler)
+    scaler._target = 0            # previously scaled to zero
+    clock['t'] = 10
+    scaler.evaluate(LoadStats(qps=0.0), [])   # idle sample in window
+    clock['t'] = 20
+    decisions = scaler.evaluate(LoadStats(qps=3.0), [])
+    assert any(d.op == DecisionOp.SCALE_UP for d in decisions)
+
+
+def test_warm_slot_goes_to_healthiest_victim():
+    """The warm-pool slot parks a READY victim, never a probe-failing
+    or mid-provision one — resume must restart a cluster that was
+    actually serving."""
+    spec = _spec(min_replicas=0, max_replicas=8)
+    replicas = [_R(1), _R(2, ReplicaStatus.NOT_READY)]
+    decisions = plan_mix(spec, 0, replicas, spot_wanted=False,
+                         warm_pool_size=1, warm_ttl=1e9)
+    downs = {d.replica_id: d for d in decisions
+             if d.op == DecisionOp.SCALE_DOWN}
+    assert set(downs) == {1, 2}
+    assert downs[1].warm and downs[1].reason == 'warm_stop'
+    assert not downs[2].warm
+
+
+def test_slo_wakes_from_zero_on_first_traffic():
+    scaler = SLOAutoscaler(_spec(min_replicas=0))
+    clock = _sim_clock(scaler)
+    scaler._target = 0           # previously scaled to zero
+    warm = _R(7, status=ReplicaStatus.WARM, warm_since=time.time())
+    clock['t'] = 10
+    decisions = scaler.evaluate(LoadStats(qps=2.0), [warm])
+    ups = [d for d in decisions if d.op == DecisionOp.SCALE_UP]
+    assert len(ups) == 1
+    # The warm replica is resumed, not a cold provision.
+    assert ups[0].resume_replica_id == 7
+    assert ups[0].reason == 'warm_resume'
+
+
+# -- mix policy -------------------------------------------------------------
+
+
+def test_plan_mix_keeps_ondemand_floor():
+    spec = _spec(min_replicas=3, max_replicas=3,
+                 base_ondemand_fallback_replicas=1)
+    decisions = plan_mix(spec, 3, [], spot_wanted=True,
+                         warm_pool_size=0, warm_ttl=1e9)
+    od = [d for d in decisions if d.op == DecisionOp.SCALE_UP
+          and d.use_spot is False]
+    spot = [d for d in decisions if d.op == DecisionOp.SCALE_UP
+            and d.use_spot]
+    assert len(od) == 1 and od[0].reason == 'floor'
+    assert len(spot) == 2
+    assert all(d.reason == 'spot_surge' for d in spot)
+
+
+def test_plan_mix_dynamic_backfill_and_recovery():
+    spec = _spec(min_replicas=2, max_replicas=2,
+                 dynamic_ondemand_fallback=True)
+    provisioning = [
+        _R(1, ReplicaStatus.PROVISIONING, is_spot=True),
+        _R(2, ReplicaStatus.PROVISIONING, is_spot=True),
+    ]
+    decisions = plan_mix(spec, 2, provisioning, spot_wanted=True,
+                         warm_pool_size=0, warm_ttl=1e9)
+    backfills = [d for d in decisions if d.is_fallback]
+    assert sum(1 for d in backfills) == 2
+    assert all(d.reason == 'spot_backfill' for d in backfills)
+    # Spot READY again: the fallback replicas are the first to go.
+    recovered = [
+        _R(1, is_spot=True), _R(2, is_spot=True),
+        _R(3, is_fallback=True), _R(4, is_fallback=True),
+    ]
+    decisions = plan_mix(spec, 2, recovered, spot_wanted=True,
+                         warm_pool_size=0, warm_ttl=1e9)
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    assert {d.replica_id for d in downs} == {3, 4}
+
+
+def test_plan_mix_cleans_up_orphaned_fallbacks():
+    """Fallback OD replicas left over from a spot outage must be
+    scaled down once the spot share drops to zero (floor-only target
+    or scale-to-zero) — they'd serve and bill on-demand forever."""
+    spec = _spec(min_replicas=0, max_replicas=4,
+                 dynamic_ondemand_fallback=True)
+    leftovers = [_R(3, is_fallback=True), _R(4, is_fallback=True)]
+    decisions = plan_mix(spec, 0, leftovers, spot_wanted=True,
+                         warm_pool_size=0, warm_ttl=1e9)
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    assert {d.replica_id for d in downs} == {3, 4}
+    # Same with backfill disabled in the (hot-reloaded) spec.
+    spec2 = _spec(min_replicas=1, max_replicas=4)
+    decisions = plan_mix(spec2, 1, [_R(1)] + leftovers,
+                         spot_wanted=False,
+                         warm_pool_size=0, warm_ttl=1e9)
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    assert {d.replica_id for d in downs} == {3, 4}
+
+
+def test_seasonal_tracks_downward_level_shift():
+    """Residual trend must be signed: after traffic permanently halves
+    relative to the seasonal norm, the forecast follows it DOWN
+    instead of flooring the residual at zero and over-provisioning."""
+    f = SeasonalRingForecaster(period_seconds=60, buckets=6)
+    t = 0.0
+    for _ in range(6):                 # one period at 100 qps
+        f.observe(t, 100.0)
+        t += 10
+    for _ in range(6):                 # traffic halves for a period
+        f.observe(t, 50.0)
+        t += 10
+    predicted = f.predict(t, 10.0)
+    assert predicted < 75.0            # follows the drop…
+    assert predicted >= 0.0            # …but a rate is still >= 0
+
+
+def test_unknown_domain_never_wins_on_phantom_price():
+    """A domain learned via handle_preemption (legacy replica row)
+    with no price-table entry must not hijack placement with a $0
+    instance price."""
+    real = Domain('gcp', 'us-central2', 'us-central2-b')
+    policy = MixPolicy([real], home=real,
+                       instance_prices={real: 3.0},
+                       egress_gb_per_hour=1.0)
+    junk = Domain(None, None, 'legacy-zone')
+    clock = {'t': 0.0}
+    policy.placer._clock = lambda: clock['t']
+    policy.handle_preemption(junk)     # appended to candidates
+    clock['t'] = 1e6                   # cooldown long lapsed
+    assert policy.domain_price(junk) == float('inf')
+    assert policy.place_spot() == real
+
+
+def test_plan_mix_warm_ttl_expiry():
+    spec = _spec(min_replicas=0)
+    old = _R(1, ReplicaStatus.WARM, warm_since=1000.0)
+    fresh = _R(2, ReplicaStatus.WARM, warm_since=4000.0)
+    decisions = plan_mix(spec, 0, [old, fresh], spot_wanted=False,
+                         warm_pool_size=2, warm_ttl=600.0,
+                         now_wall=4500.0)
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert (d.op, d.replica_id, d.warm, d.reason) == (
+        DecisionOp.SCALE_DOWN, 1, False, 'warm_expire')
+
+
+def test_plan_mix_latency_aware_victims():
+    spec = _spec(min_replicas=1, max_replicas=8)
+    replicas = [_R(1), _R(2), _R(3)]
+    decisions = plan_mix(spec, 2, replicas, spot_wanted=False,
+                         latency_ms={1: 20.0, 2: 900.0, 3: 30.0},
+                         warm_pool_size=0, warm_ttl=1e9)
+    downs = [d for d in decisions if d.op == DecisionOp.SCALE_DOWN]
+    # The slowest READY replica is shed, not the newest.
+    assert [d.replica_id for d in downs] == [2]
+
+
+def test_reactive_autoscaler_latency_aware_victims():
+    """Satellite: LoadStats.replica_latency_ms now feeds the existing
+    reactive scale-down path too."""
+    spec = ServiceSpec(min_replicas=1, max_replicas=4,
+                       target_qps_per_replica=10,
+                       upscale_delay_seconds=0, downscale_delay_seconds=0)
+    scaler = RequestRateAutoscaler(spec)
+    replicas = [_R(1), _R(2), _R(3)]
+    stats = LoadStats(qps=10.0, replica_latency_ms={1: 15.0, 2: 800.0,
+                                                    3: 25.0})
+    downs = [d for d in scaler.evaluate(stats, replicas)
+             if d.op == DecisionOp.SCALE_DOWN]
+    assert len(downs) == 2
+    assert downs[0].replica_id == 2   # slowest goes first
+
+
+def test_domain_placer_cheapest_active_with_cooldown():
+    clock = {'t': 0.0}
+    cheap = Domain('gcp', 'us-central2', 'us-central2-b')
+    pricey = Domain('gcp', 'europe-west4', 'europe-west4-a')
+    placer = DomainSpotPlacer([cheap, pricey], cooldown=600,
+                              clock=lambda: clock['t'])
+    prices = {cheap: 1.0, pricey: 3.0}
+    assert placer.select(prices.get) == cheap
+    placer.handle_preemption(cheap)
+    assert placer.select(prices.get) == pricey   # cooling down
+    clock['t'] = 601.0
+    assert placer.select(prices.get) == cheap    # cooldown lapsed
+
+
+def test_domain_cooldown_survives_wallclock_step(monkeypatch):
+    """Satellite: cooldown tracking is monotonic — a wall-clock jump
+    must not re-activate a freshly preempted domain."""
+    d1 = Domain('gcp', 'us-central2', 'us-central2-b')
+    d2 = Domain('gcp', 'europe-west4', 'europe-west4-a')
+    placer = DomainSpotPlacer([d1, d2], cooldown=600)
+    placer.handle_preemption(d1)
+    # A huge wall-clock step: time.time moves, the placer doesn't care.
+    monkeypatch.setattr(time, 'time', lambda: 1e12)
+    assert placer.active() == [d2]
+    assert placer.select() == d2
+
+
+def test_hysteresis_clock_is_monotonic(monkeypatch):
+    """Satellite: the hysteresis timer must ignore wall-clock steps."""
+    spec = ServiceSpec(min_replicas=1, max_replicas=4,
+                       target_qps_per_replica=10,
+                       upscale_delay_seconds=3600,
+                       downscale_delay_seconds=3600)
+    scaler = RequestRateAutoscaler(spec)
+    replicas = [_R(1)]
+    assert scaler.evaluate(LoadStats(qps=40.0), replicas) == []
+    # A 10^7 s wall-clock jump: time.time moves, monotonic doesn't.
+    monkeypatch.setattr(time, 'time', lambda: time.monotonic() + 1e7)
+    assert scaler.evaluate(LoadStats(qps=40.0), replicas) == []
+
+
+def test_mix_policy_egress_prices_the_hop():
+    home = Domain('gcp', 'us-central2', 'us-central2-b')
+    far = Domain('aws', 'us-east-1', 'us-east-1a')
+    near = Domain('gcp', 'us-west4', 'us-west4-a')
+    policy = MixPolicy([home, near, far], home=home,
+                       instance_prices={home: 5.0, near: 2.0, far: 1.9},
+                       egress_gb_per_hour=20.0)
+    # aws is nominally cheaper than the gcp sibling region, but its
+    # hop home pays aws INTERNET egress (0.09 $/GB) while gcp pays the
+    # inter-region rate (0.08): at 20 GB/hr the effective order flips
+    # (near 2.0+1.6=3.6 < far 1.9+1.8=3.7). Same region is hop-free.
+    assert policy.domain_price(home) == pytest.approx(5.0)
+    assert policy.domain_price(near) == pytest.approx(
+        2.0 + egress.egress_price_per_gb('gcp', 'gcp') * 20.0)
+    assert policy.domain_price(far) == pytest.approx(
+        1.9 + egress.egress_price_per_gb('aws', 'gcp') * 20.0)
+    assert policy.place_spot() == near
+
+
+def test_serving_hop_price_same_region_free():
+    assert egress.serving_hop_price_per_gb('gcp', 'us-central2',
+                                           'gcp', 'us-central2') == 0.0
+    assert egress.serving_hop_price_per_gb(
+        'gcp', 'us-central2', 'gcp', 'europe-west4') == \
+        egress.egress_price_per_gb('gcp', 'gcp')
+    assert egress.serving_hop_price_per_gb(
+        'aws', 'us-east-1', 'gcp', 'us-central2') == \
+        egress.egress_price_per_gb('aws', 'gcp')
+
+
+# -- DB/state surfaces ------------------------------------------------------
+
+
+def test_status_surfaces_fleet_p99_and_warm(tmp_home):
+    serve_state.add_service('svc', {'replica_policy': {'min_replicas': 1}},
+                            {}, lb_port=12345)
+    serve_state.add_replica('svc', 1, 'svc-replica-1', is_spot=False,
+                            cloud='fake', region='us-central1',
+                            zone='us-central1-a')
+    serve_state.add_replica('svc', 2, 'svc-replica-2', is_spot=True)
+    serve_state.set_replica_status('svc', 1, ReplicaStatus.READY)
+    serve_state.set_replica_status('svc', 2, ReplicaStatus.WARM)
+    serve_state.set_replica_lb_state('svc', {
+        1: {'ewma_ms': 42.5, 'ejected': 0.0, 'ejected_for': 0.0,
+            'consecutive_failures': 0.0},
+    })
+    record = serve_state.get_service('svc')
+    d = record.to_dict()
+    assert d['fleet_p99_ms'] == pytest.approx(42.5)
+    assert d['warm_replicas'] == 1
+    warm_row = [r for r in d['replicas'] if r['replica_id'] == 2][0]
+    assert warm_row['status'] == 'WARM'
+    assert warm_row['warm_since'] is not None
+    assert d['replicas'][0]['cloud'] == 'fake'
+    assert d['replicas'][0]['region'] == 'us-central1'
+
+
+def test_task_yaml_schema_accepts_slo_policy(tmp_path):
+    """The CLI path (`skyt serve up task.yaml`) validates against the
+    JSON schema in spec/schemas.py, which the direct-construction
+    tests bypass — the new replica_policy keys (and p2c_ewma) must
+    survive a real YAML load end to end."""
+    yaml_path = tmp_path / 'svc.yaml'
+    yaml_path.write_text("""\
+name: demo
+resources:
+  cloud: fake
+  accelerators: tpu-v5e-8
+run: echo hi
+service:
+  load_balancing_policy: p2c_ewma
+  replica_policy:
+    min_replicas: 0
+    max_replicas: 2
+    target_latency_p99_ms: 2000
+    forecaster: seasonal
+    forecast_horizon_seconds: 30
+    scale_to_zero_idle_seconds: 60
+""")
+    task = Task.from_yaml(str(yaml_path))
+    spec = ServiceSpec.from_yaml_config(task.service)
+    assert spec.target_latency_p99_ms == 2000
+    assert spec.forecaster == 'seasonal'
+    assert spec.load_balancing_policy == 'p2c_ewma'
+    assert isinstance(Autoscaler.from_spec(spec), SLOAutoscaler)
+
+
+def test_spec_roundtrip_and_validation():
+    spec = ServiceSpec.from_yaml_config({
+        'port': 9000,
+        'replica_policy': {
+            'min_replicas': 0,
+            'max_replicas': 6,
+            'target_latency_p99_ms': 200,
+            'forecaster': 'seasonal',
+            'forecast_horizon_seconds': 120,
+            'scale_to_zero_idle_seconds': 45,
+        },
+    })
+    spec2 = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert spec2.target_latency_p99_ms == 200
+    assert spec2.forecaster == 'seasonal'
+    assert spec2.forecast_horizon_seconds == 120
+    assert spec2.scale_to_zero_idle_seconds == 45
+    assert spec2.autoscaling
+    assert isinstance(Autoscaler.from_spec(spec2), SLOAutoscaler)
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidSpecError):
+        ServiceSpec(min_replicas=1, max_replicas=2,
+                    target_qps_per_replica=1, target_latency_p99_ms=100)
+    with pytest.raises(exceptions.InvalidSpecError):
+        ServiceSpec(min_replicas=1, max_replicas=2,
+                    target_latency_p99_ms=100, forecaster='bogus')
+    with pytest.raises(exceptions.InvalidSpecError):
+        ServiceSpec(min_replicas=0, max_replicas=2)  # no target to wake
+
+
+# -- end to end (fake cloud) ------------------------------------------------
+
+
+@pytest.fixture()
+def fast_serve(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYT_SERVE_CONTROLLER_POLL', '0.2')
+    monkeypatch.setenv('SKYT_SERVE_NOT_READY_THRESHOLD', '2')
+    fake.reset()
+    yield
+    from skypilot_tpu import exceptions
+    for record in serve_state.list_services():
+        try:
+            serve_core.down(record.name, purge=True)
+        except exceptions.SkytError:
+            pass
+    fake.reset()
+
+
+def _autoscale_task(use_spot=False, **policy):
+    service = {
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+        'replica_policy': policy,
+    }
+    return Task(name='svc', run=ECHO_SERVER,
+                resources=Resources(cloud='fake',
+                                    accelerators='tpu-v5e-8',
+                                    use_spot=use_spot),
+                service=service)
+
+
+def _wait(predicate, timeout=60, interval=0.2, msg='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f'timed out waiting for {msg}')
+
+
+def test_scale_to_zero_warm_resume_roundtrip(fast_serve, monkeypatch):
+    """min_replicas:0 service goes WARM after idle (cluster stopped,
+    NOT terminated), then the first request wakes it back to READY by
+    resuming the same cluster — the cold provision path is never
+    taken twice."""
+    monkeypatch.setenv('SKYT_WARM_POOL_SIZE', '1')
+    monkeypatch.setenv('SKYT_WARM_POOL_TTL', '3600')
+    result = serve_core.up(
+        _autoscale_task(min_replicas=0, max_replicas=2,
+                        target_latency_p99_ms=5000,
+                        forecast_horizon_seconds=1,
+                        scale_to_zero_idle_seconds=3.0,
+                        upscale_delay_seconds=0,
+                        downscale_delay_seconds=0,
+                        qps_window_seconds=1), 'wrm')
+    endpoint = result['endpoint']
+    # No traffic after startup: past the idle threshold the replica
+    # parks WARM and the fake cluster still exists (stopped), never
+    # torn down.
+    warm = _wait(
+        lambda: [r for r in serve_state.list_replicas('wrm')
+                 if r.status == ReplicaStatus.WARM],
+        timeout=120, msg='replica parked WARM')
+    cluster = warm[0].cluster_name
+    assert cluster in fake.list_fake_clusters()
+    assert serve_state.get_service('wrm').to_dict()['warm_replicas'] == 1
+    # Wake: a retrying client (503 + Retry-After until the resume
+    # lands). The traffic itself is what keeps the service awake.
+    resumed_from = time.time()
+    first_code = None
+    status = None
+    while time.time() - resumed_from < 90:
+        try:
+            with urllib.request.urlopen(endpoint, timeout=5) as resp:
+                status = resp.status
+                break
+        except urllib.error.HTTPError as e:
+            if first_code is None:
+                first_code = e.code
+                assert e.code == 503
+                assert e.headers.get('Retry-After') is not None
+        except Exception:  # pylint: disable=broad-except
+            pass
+        time.sleep(0.3)
+    assert status == 200, 'service never woke from zero'
+    assert first_code == 503   # it really was scaled to zero
+    resume_seconds = time.time() - resumed_from
+    records = serve_state.list_replicas('wrm')
+    ready = [r for r in records if r.status == ReplicaStatus.READY]
+    # Round trip: the SAME cluster resumed — one replica row ever
+    # existed, no second provision.
+    assert [r.cluster_name for r in ready] == [cluster]
+    assert len(records) == 1
+    assert ready[0].warm_since is None
+    assert resume_seconds < 90
+
+
+@pytest.mark.chaos
+@pytest.mark.latency
+def test_spot_preemption_midtraffic_error_rate_near_zero(fast_serve):
+    """SKYT_FAULT_SPEC reclaims a READY spot replica while requests
+    flow; the r7 ejection/failover machinery keeps the client error
+    rate ~0 and the SLO autoscaler's mix policy backfills on-demand
+    (dynamic_ondemand_fallback) while a replacement spot replica
+    provisions. Latency smoke: recovery is bounded by a generous
+    multiple of the poll cadence, never exact timings."""
+    with inject_faults(clause('serve.spot_preempt', 'ConnectionError',
+                              times=1)):
+        result = serve_core.up(
+            _autoscale_task(use_spot=True, min_replicas=2,
+                            max_replicas=3,
+                            target_latency_p99_ms=5000,
+                            dynamic_ondemand_fallback=True,
+                            upscale_delay_seconds=0,
+                            downscale_delay_seconds=0,
+                            qps_window_seconds=5), 'chaos')
+        endpoint = result['endpoint']
+        _wait(lambda: len([
+            r for r in serve_state.list_replicas('chaos')
+            if r.status == ReplicaStatus.READY]) >= 2,
+            timeout=150, msg='2 spot replicas READY')
+        # Drive traffic through the preemption window. The injected
+        # reclaim fires on the next controller probe tick (READY-only
+        # site), tearing one serving replica down mid-stream.
+        errors = 0
+        total = 0
+        deadline = time.time() + 6.0
+        while time.time() < deadline:
+            total += 1
+            try:
+                with urllib.request.urlopen(endpoint, timeout=10) as r:
+                    if r.status != 200:
+                        errors += 1
+            except Exception:  # pylint: disable=broad-except
+                errors += 1
+            time.sleep(0.02)
+        preempted = [r for r in serve_state.list_replicas('chaos')
+                     if r.status == ReplicaStatus.PREEMPTED]
+        assert preempted, 'injected preemption never fired'
+        assert total > 50
+        # ~0: failover + ejection absorb the reclaim (GETs are
+        # replay-safe; the bound allows only stray in-flight cuts).
+        assert errors <= max(1, int(0.02 * total)), (
+            f'{errors}/{total} errors through preemption')
+        # The mix policy backfilled on-demand while spot recovers and
+        # replaces the preempted spot replica (the fallback row may
+        # already be scaled back down once spot is READY again — any
+        # row with is_fallback is the evidence it happened).
+        _wait(lambda: any(
+            r.is_fallback and not r.is_spot
+            for r in serve_state.list_replicas('chaos')),
+            timeout=60, msg='on-demand backfill replica')
+        _wait(lambda: len([
+            r for r in serve_state.list_replicas('chaos')
+            if r.status == ReplicaStatus.READY]) >= 2,
+            timeout=150, msg='fleet recovered to 2 READY')
