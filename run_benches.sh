@@ -13,9 +13,23 @@ export SKYT_BENCH_PROBE_TRIES="${SKYT_BENCH_PROBE_TRIES:-1}"
 
 # Invariant gate first (skylint, docs/static_analysis.md): never burn a
 # tunnel window benchmarking code that fails its own static checks.
+# Budget-asserted: the expanded suite (8 syntactic + 4 dataflow passes)
+# must stay under 30 s or it stops being a preamble and starts eating
+# the tunnel window — treat a slow linter as a preamble FAILURE.
+# /proc/uptime is the shell's monotonic clock (SKYT009 discipline:
+# never measure a duration on the wall clock — an NTP step would
+# abort, or silently pass, the budget).
+lint_start=$(awk '{print int($1)}' /proc/uptime)
 if ! ./tools/lint.sh; then
   echo "preamble: skylint failed — fix findings (or baseline with a" >&2
   echo "reviewed reason) before benchmarking" >&2
+  exit 1
+fi
+lint_elapsed=$(( $(awk '{print int($1)}' /proc/uptime) - lint_start ))
+echo "preamble: skylint clean in ${lint_elapsed}s" >&2
+if [ "${lint_elapsed}" -gt 30 ]; then
+  echo "preamble: skylint took ${lint_elapsed}s (> 30 s budget) —" >&2
+  echo "profile the new passes before benchmarking" >&2
   exit 1
 fi
 

@@ -100,13 +100,22 @@ def package_runtime() -> tuple:
         # and os.replace a corrupt archive.
         import tempfile
         fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix='.tmp')
-        os.close(fd)
-        with tarfile.open(tmp, 'w:gz') as tar:
-            for path in files:
-                arcname = os.path.join('skypilot_tpu',
-                                       os.path.relpath(path, root))
-                tar.add(path, arcname=arcname)
-        os.replace(tmp, tarball)
+        try:
+            os.close(fd)
+            with tarfile.open(tmp, 'w:gz') as tar:
+                for path in files:
+                    arcname = os.path.join(
+                        'skypilot_tpu', os.path.relpath(path, root))
+                    tar.add(path, arcname=arcname)
+            os.replace(tmp, tarball)
+        except BaseException:
+            # A failed build must not leak half-written .tmp archives
+            # into the long-lived cache dir.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         logger.info('Packaged runtime %s (%d files)', content_hash,
                     len(files))
     return tarball, content_hash

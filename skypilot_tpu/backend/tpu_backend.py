@@ -302,8 +302,8 @@ class TpuPodBackend(Backend):
         if grace is None:
             from skypilot_tpu.utils import env_registry
             grace = env_registry.get_float('SKYT_DAEMON_START_GRACE')
-        deadline = time_lib.time() + grace
-        while time_lib.time() < deadline:
+        deadline = time_lib.monotonic() + grace
+        while time_lib.monotonic() < deadline:
             time_lib.sleep(2.0)
             if job_table.daemon_alive():
                 return True

@@ -800,21 +800,21 @@ def main() -> None:
     from skypilot_tpu.utils import usage
     plugins.load_plugins()
     verb = _telemetry_verb(sys.argv[1:])
-    start = time.time()
+    start = time.monotonic()
     try:
         cli()
         # Unreachable in practice: click's standalone mode exits via
         # SystemExit even on success (handled below).
     except KeyboardInterrupt:
         usage.record(f'cli.{verb}', outcome='interrupted',
-                     duration_s=time.time() - start)
+                     duration_s=time.monotonic() - start)
         sys.exit(130)
     except SystemExit as e:
         code = e.code if isinstance(e.code, int) else (0 if e.code is None
                                                        else 1)
         usage.record(f'cli.{verb}',
                      outcome='ok' if code == 0 else f'exit_{code}',
-                     duration_s=time.time() - start)
+                     duration_s=time.monotonic() - start)
         raise
 
 

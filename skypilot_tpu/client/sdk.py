@@ -157,8 +157,8 @@ def ensure_api_server() -> str:
         [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
          str(port)],
         log_path=os.path.join(requests_db.server_dir(), 'server.log'))
-    deadline = time.time() + 30
-    while time.time() < deadline:
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
         if api_is_healthy(url):
             return url
         time.sleep(0.2)
@@ -248,7 +248,7 @@ def get(request_id: str, timeout: Optional[float] = None) -> Any:
 
     Parity: sdk.get :2313."""
     url = ensure_api_server()
-    deadline = None if timeout is None else time.time() + timeout
+    deadline = None if timeout is None else time.monotonic() + timeout
     while True:
         resp = _request_with_retries(
             'GET', f'{url}/api/get',
@@ -271,7 +271,7 @@ def get(request_id: str, timeout: Optional[float] = None) -> Any:
         if status == requests_db.RequestStatus.CANCELLED:
             raise exceptions.RequestCancelledError(
                 f'Request {request_id} was cancelled.')
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and time.monotonic() > deadline:
             raise TimeoutError(
                 f'Request {request_id} still {status.value} after '
                 f'{timeout}s.')

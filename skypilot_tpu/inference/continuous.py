@@ -642,7 +642,7 @@ class ContinuousBatchingEngine:
 
         def tail():
             emitted = 0
-            deadline = time.time() + timeout
+            deadline = time.monotonic() + timeout
             while True:
                 finished = request.done.is_set()
                 generated = request.generated
@@ -656,7 +656,7 @@ class ContinuousBatchingEngine:
                     if request.error is not None:
                         raise request.error
                     return
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError('generation timed out')
                 time.sleep(0.005)
 

@@ -88,8 +88,8 @@ def barrier_and_env(record: jobs_state.JobRecord,
     """Wait for every group member to publish hosts; return the
     rendezvous env map. Raises GangAborted if a sibling fails first."""
     assert record.group_name is not None
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         failed = sibling_failed(record)
         if failed is not None:
             raise GangAborted(

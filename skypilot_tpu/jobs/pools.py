@@ -84,8 +84,8 @@ def wait_ready(pool_name: str, min_workers: int = 1,
                timeout: float = 300.0) -> List[str]:
     """Block until >= min_workers are READY; returns their clusters."""
     import time
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         workers = ready_workers(pool_name)
         if len(workers) >= min_workers:
             return workers

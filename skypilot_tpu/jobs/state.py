@@ -462,7 +462,13 @@ def reclaim_stale_controller_claim(job_id: int,
     """Claim a job whose previous claimant died between NULLing the pid
     and spawning the replacement (the claim-window orphan). Atomic: the
     conditional UPDATE on (pid IS NULL, old claim time) lets exactly one
-    caller through."""
+    caller through.
+
+    Deliberately WALL clock on both sides (skylint SKYT009's
+    persisted-timestamp exemption): ``controller_claimed_at`` is
+    written by one process and judged by another, so a monotonic
+    reading would be meaningless — staleness here must ride the
+    shared wall clock, same as the server heartbeat table."""
     conn = _db()
     cur = conn.execute(
         'UPDATE jobs SET controller_claimed_at = ? '

@@ -1,6 +1,6 @@
-"""skylint: AST-based invariant checker for the control plane.
+"""skylint: AST + dataflow invariant checker for the control plane.
 
-``python -m skypilot_tpu.lint`` runs eight passes over the package
+``python -m skypilot_tpu.lint`` runs twelve passes over the package
 (stdlib ``ast`` only) and exits non-zero on any non-baselined finding:
 
 =======  ==========================================================
@@ -12,7 +12,18 @@ SKYT005  event-bus topic cross-check (no-subscriber / no-publisher)
 SKYT006  lock-acquisition-order cycles (potential deadlocks)
 SKYT007  sqlite dialect portability (RETURNING / ON CONFLICT)
 SKYT008  host-side effects inside jitted functions
+SKYT009  wall-clock ``time.time()`` in duration/deadline arithmetic
+SKYT010  blocking work / bare publish / abandonment in transactions
+SKYT011  acquire/release pairing on every CFG path (locks, uploads,
+         tempfiles, BlockPool refcounts)
+SKYT012  module mutables written from ≥2 threads, no common lock
 =======  ==========================================================
+
+SKYT009..012 ride a shared CFG + reaching-definitions layer
+(``lint/dataflow.py``); their runtime companion — an Eraser-style
+lockset race detector and wait-for-graph deadlock watchdog behind
+``SKYT_LINT_DYNAMIC`` — lives in ``lint/dynamic.py`` and rides the
+``chaos`` pytest marker.
 
 ``SKYT000`` is the runner's own meta code (parse errors, stale or
 unreviewed baseline entries, generated docs out of sync).

@@ -22,13 +22,41 @@ import argparse
 import json
 import os
 import re
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from skypilot_tpu.lint import core
 from skypilot_tpu.utils import env_registry
 
 ENV_DOCS_REL = os.path.join('docs', 'env_vars.md')
+
+# --json report schema version. Bump when the report SHAPE changes;
+# consumers gate on it instead of sniffing fields (docs/
+# static_analysis.md "CI / JSON contract"). v2 added this field and
+# the SKYT009..SKYT012 dataflow passes.
+REPORT_SCHEMA = 2
+
+
+def changed_files(repo_root: str) -> Optional[Set[str]]:
+    """Repo-relative paths touched vs HEAD (staged + unstaged +
+    untracked), or None when git is unavailable (fail open: a broken
+    git must widen the run, never narrow it)."""
+    try:
+        out = subprocess.run(
+            ['git', 'status', '--porcelain'], cwd=repo_root,
+            capture_output=True, text=True, timeout=30, check=True)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    paths: Set[str] = set()
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if ' -> ' in path:   # rename: take the new side
+            path = path.split(' -> ', 1)[1]
+        paths.add(path.strip('"'))
+    return paths
 
 
 def baseline_path_from_pyproject(repo_root: str) -> str:
@@ -50,6 +78,20 @@ def baseline_path_from_pyproject(repo_root: str) -> str:
     if not match:
         return default
     return os.path.join(repo_root, match.group(1))
+
+
+def filter_changed(findings: List[core.Finding],
+                   changed: Optional[Set[str]]) -> List[core.Finding]:
+    """--changed-only scopes the REPORT, not the scan: cross-file
+    passes (chaos coverage, event topics, lock graphs) need the whole
+    repo to judge correctly; only the rendered findings narrow. Meta
+    findings (baseline rot, docs drift) always show, and an unreadable
+    git (``changed is None``) fails open to the full report."""
+    if changed is None:
+        return findings
+    return [f for f in findings
+            if f.path.replace(os.sep, '/') in changed
+            or f.code == core.META_CODE]
 
 
 def check_env_docs(repo_root: str) -> List[core.Finding]:
@@ -80,7 +122,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog='python -m skypilot_tpu.lint',
         description='AST-based invariant checker for the skypilot-tpu '
-                    'control plane (SKYT001..SKYT008).')
+                    'control plane (SKYT001..SKYT012).')
     parser.add_argument('--json', action='store_true',
                         help='emit the JSON report (what CI consumes)')
     parser.add_argument('--baseline', default=None,
@@ -95,6 +137,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument('--dump-env-docs', action='store_true',
                         help='print generated docs/env_vars.md and '
                              'exit')
+    parser.add_argument('--changed-only', action='store_true',
+                        help='report only findings in files the git '
+                             'working tree changed vs HEAD (fast '
+                             'iteration; the full scan still runs so '
+                             'cross-file passes stay correct)')
     parser.add_argument('--root', default=None,
                         help='repo root override (tests)')
     args = parser.parse_args(argv)
@@ -127,10 +174,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings = core.apply_baseline(findings, entries, baseline_path)
         findings.sort(key=lambda f: (f.path, f.line, f.code, f.slug))
 
+    if args.changed_only:
+        findings = filter_changed(findings, changed_files(repo_root))
+
     active = [f for f in findings if not f.baselined]
     if args.json:
         report = {
             'version': 1,
+            'schema': REPORT_SCHEMA,
             'findings': [f.to_json() for f in findings],
             'summary': {
                 'files_scanned': len(ctx.package_modules),

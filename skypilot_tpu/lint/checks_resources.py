@@ -1,0 +1,369 @@
+"""SKYT011 — resource acquire/release pairing on every CFG path.
+
+Four resource vocabularies whose leak mode is silent and cumulative:
+
+* **bare lock ``.acquire()``** (receiver named ``*lock*``/``*sem*``)
+  without a ``.release()`` reachable on every path — a raised
+  exception between them deadlocks the next acquirer forever. The
+  ``with`` form never flags (the context manager IS the pairing).
+  Try-lock calls (``blocking=False`` / ``timeout=``) are exempt: their
+  conditional release is matched to the conditional claim by hand.
+* **multipart uploads**: ``create_multipart_upload`` must reach
+  ``complete_…``/``abort_…`` — an abandoned upload id is billed
+  storage forever (the exact orphan PR 5's review fixed once).
+* **tempfiles**: ``tempfile.mkstemp``/``mktemp``/
+  ``NamedTemporaryFile(delete=False)`` must reach
+  ``os.unlink``/``os.remove``/``os.replace``/``os.rename``/
+  ``shutil.move`` — a failure before the final rename leaks spool
+  files into long-lived cache dirs.
+* **BlockPool refcounts**: ``.incref(x)`` / ``.decref(x)`` on a
+  ``*pool*`` receiver must balance. Only functions that already
+  mention a ``decref`` on the same receiver are analyzed — a function
+  that increfs and hands the reference to a long-lived structure (the
+  prefix cache) transfers ownership by design.
+
+The analysis is a may-leak forward pass over the shared CFG with
+exception edges: the state is the set of outstanding resources; an
+open statement's OWN exception edge carries the pre-state (if the
+acquire itself raised, nothing was acquired); any other raising
+statement propagates the open state to the innermost handler/finally
+or out of the function. Ownership escapes (returning the token,
+storing it into an attribute/container, yielding it, passing an
+upload context to a helper) kill tracking silently — imprecision
+degrades to silence, not noise.
+
+Context-manager classes get a protocol check instead: an ``__enter__``
+that acquires ``self._lock`` pairs with its class's ``__exit__``,
+which must release on EVERY path — an ``__exit__`` that only releases
+after a successful flush keeps the lock when the flush raises.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint import astutil, dataflow
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT011'
+
+_TMP_OPENERS = frozenset({'tempfile.mkstemp', 'tempfile.mktemp'})
+_TMP_CLOSERS = frozenset({'os.unlink', 'os.remove', 'os.replace',
+                          'os.rename', 'shutil.move'})
+_LOCKISH = ('lock', 'sem')
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+class _Facts:
+    """Resource effects of one statement."""
+
+    __slots__ = ('opens', 'closes', 'escapes_all')
+
+    def __init__(self) -> None:
+        self.opens: List[Tuple[object, int]] = []   # (token, lineno)
+        self.closes: List[object] = []   # exact token or ('by-name',
+        #                                   kind, frozenset(names))
+        # Names whose tokens escape (returned/stored/yielded).
+        self.escapes_all: Set[str] = set()
+
+
+def _token_names(token) -> Set[str]:
+    if token[0] in ('upload', 'tmp'):
+        return set(token[1])
+    return set()
+
+
+class ResourcePairingChecker:
+    code = CODE
+    name = 'resource acquire/release pairing'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            imports = astutil.import_map(mod.tree)
+            fns = list(dataflow.functions_of(mod.tree))
+            exempt, proto_findings = self._protocol_pairs(mod, fns,
+                                                          imports)
+            yield from proto_findings
+            for class_name, fn in fns:
+                if fn.name in ('acquire', 'release', '__exit__'):
+                    continue   # wrapper / protocol counterpart
+                if (class_name, fn.name) in exempt:
+                    continue   # __enter__ paired with checked __exit__
+                yield from self._check_fn(mod, class_name, fn, imports)
+
+    # -- __enter__/__exit__ protocol ------------------------------------
+
+    def _protocol_pairs(self, mod, fns, imports):
+        by_class: Dict[str, Dict[str, ast.AST]] = {}
+        for class_name, fn in fns:
+            if class_name and fn.name in ('__enter__', '__exit__'):
+                by_class.setdefault(class_name, {})[fn.name] = fn
+        exempt: Set[Tuple[str, str]] = set()
+        findings: List[Finding] = []
+        for class_name, pair in sorted(by_class.items()):
+            enter = pair.get('__enter__')
+            exit_fn = pair.get('__exit__')
+            if enter is None:
+                continue
+            receivers = sorted({
+                recv for c in ast.walk(enter)
+                if isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == 'acquire'
+                and not _is_tryacquire(c)
+                for recv in [astutil.dotted(c.func.value)]
+                if recv and _is_lockish(recv)})
+            if not receivers:
+                continue
+            exempt.add((class_name, '__enter__'))
+            if exit_fn is None:
+                findings.append(Finding(
+                    CODE, mod.rel, enter.lineno,
+                    f'{class_name}.__enter__ acquires {receivers} but '
+                    'the class has no __exit__ to release it',
+                    slug=f'proto-noexit:{class_name}'))
+                continue
+            for recv in receivers:
+                if self._exit_may_skip_release(exit_fn, recv):
+                    findings.append(Finding(
+                        CODE, mod.rel, exit_fn.lineno,
+                        f'{class_name}.__exit__ releases `{recv}` only '
+                        'on the no-exception path — an error before '
+                        'the release keeps the lock held forever '
+                        '(wrap the body in try/finally)',
+                        slug=f'proto-leak:{class_name}:{recv}'))
+        return exempt, findings
+
+    def _exit_may_skip_release(self, exit_fn, recv: str) -> bool:
+        cfg = dataflow.CFG(exit_fn)
+
+        def transfer(node, state):
+            stmt = node.stmt
+            if stmt is not None and state == 'open':
+                for call in dataflow.owned_calls(stmt):
+                    if (isinstance(call.func, ast.Attribute)
+                            and call.func.attr == 'release'
+                            and astutil.dotted(call.func.value) == recv):
+                        return 'closed', 'closed'
+            return state, state
+
+        def merge(a, b):
+            return 'open' if 'open' in (a, b) else 'closed'
+
+        in_states = dataflow.forward(cfg, 'open', transfer, merge)
+        return in_states.get(id(cfg.exit)) == 'open'
+
+    # -- per-function may-leak analysis ---------------------------------
+
+    def _check_fn(self, mod, class_name, fn, imports
+                  ) -> Iterator[Finding]:
+        cfg = dataflow.CFG(fn)
+        decref_receivers = {
+            recv for c in ast.walk(fn) if isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Attribute)
+            and c.func.attr == 'decref'
+            for recv in [astutil.dotted(c.func.value)] if recv}
+
+        facts_by_node: Dict[int, _Facts] = {}
+        open_lines: Dict[object, int] = {}
+        for node in dataflow.statement_nodes(cfg):
+            facts = self._stmt_facts(node.stmt, imports,
+                                     decref_receivers)
+            if isinstance(node.stmt, (ast.For, ast.AsyncFor,
+                                      ast.While)):
+                # Cleanup loops (`for b in blocks: pool.decref(b)`)
+                # iterate the same collection as their open loops;
+                # apply their closes at the loop head too, so the
+                # zero-iteration CFG path (empty collection = nothing
+                # was opened either) doesn't read as a leak.
+                body_closes = self._subtree_closes(
+                    node.stmt, imports, decref_receivers)
+                if body_closes:
+                    facts = facts or _Facts()
+                    facts.closes.extend(body_closes)
+            if facts is not None:
+                facts_by_node[id(node)] = facts
+                for token, line in facts.opens:
+                    open_lines.setdefault(token, line)
+        if not open_lines:
+            return
+
+        def closes_token(close, token) -> bool:
+            if isinstance(close, tuple) and close[0] == 'by-name':
+                _, kind, names = close
+                return token[0] == kind and bool(
+                    _token_names(token) & names)
+            return close == token
+
+        def transfer(node, state):
+            facts = facts_by_node.get(id(node))
+            if facts is None:
+                return state, state
+            normal = set(state)
+            opened_here = set()
+            for close in facts.closes:
+                normal = {t for t in normal
+                          if not closes_token(close, t)}
+            if facts.escapes_all:
+                normal = {t for t in normal
+                          if not (_token_names(t) & facts.escapes_all)}
+            for token, _ in facts.opens:
+                normal.add(token)
+                opened_here.add(token)
+            # The open call's own exception edge drops its token: a
+            # raising acquire acquired nothing (loop-carried re-opens
+            # of the same token read the same way — silence over
+            # noise when iterations are indistinguishable).
+            exc = normal - opened_here
+            return frozenset(normal), frozenset(exc)
+
+        in_states = dataflow.forward(
+            cfg, frozenset(), transfer,
+            merge=lambda a, b: frozenset(a | b))
+        leaked = in_states.get(id(cfg.exit), frozenset())
+        qual = f'{class_name}.{fn.name}' if class_name else fn.name
+        for token in sorted(leaked, key=repr):
+            desc = _describe(token)
+            yield Finding(
+                CODE, mod.rel, open_lines.get(token, fn.lineno),
+                f'{desc} in {qual}() may leak on some path (including '
+                'exception edges) — pair it in a finally/with, or '
+                'abort/release before raising',
+                slug=f'leak:{qual}:{desc}')
+
+    # -- statement classification ---------------------------------------
+
+    def _subtree_closes(self, stmt, imports, decref_receivers):
+        """Close operations anywhere in a compound statement's body."""
+        closes: List[object] = []
+        for sub in ast.walk(stmt):
+            if sub is stmt or not isinstance(sub, ast.stmt):
+                continue
+            facts = self._stmt_facts(sub, imports, decref_receivers)
+            if facts is not None:
+                closes.extend(facts.closes)
+        return closes
+
+    def _stmt_facts(self, stmt, imports, decref_receivers
+                    ) -> Optional[_Facts]:
+        facts = _Facts()
+        assigned: Tuple[str, ...] = ()
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            assigned = tuple(sorted(
+                name for name, _ in dataflow._assign_pairs(
+                    stmt.targets[0], dataflow.UNKNOWN)))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            names: List[str] = []
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    names.extend(n for n, _ in dataflow._assign_pairs(
+                        item.optional_vars, dataflow.UNKNOWN))
+            assigned = tuple(sorted(names))
+
+        for call in dataflow.owned_calls(stmt):
+            resolved = astutil.resolve_call(call.func, imports) or ''
+            tail = resolved.rsplit('.', 1)[-1]
+            recv = (astutil.dotted(call.func.value)
+                    if isinstance(call.func, ast.Attribute) else None)
+
+            if (tail == 'acquire' and recv and _is_lockish(recv)
+                    and not isinstance(stmt, (ast.With, ast.AsyncWith))
+                    and not _is_tryacquire(call)):
+                facts.opens.append((('lock', recv), call.lineno))
+            elif tail == 'release' and recv:
+                facts.closes.append(('lock', recv))
+
+            elif tail == 'create_multipart_upload' and assigned:
+                facts.opens.append((('upload', assigned), call.lineno))
+            elif ('multipart' in tail
+                  and ('abort' in tail or 'complete' in tail)):
+                facts.closes.append(
+                    ('by-name', 'upload', _call_arg_names(call)))
+
+            elif ((resolved in _TMP_OPENERS
+                   or (tail == 'NamedTemporaryFile'
+                       and _kw_false(call, 'delete')))
+                  and assigned):
+                facts.opens.append((('tmp', assigned), call.lineno))
+            elif resolved in _TMP_CLOSERS:
+                facts.closes.append(
+                    ('by-name', 'tmp', _call_arg_names(call)))
+
+            elif (tail == 'incref' and recv and 'pool' in recv.lower()
+                  and recv in decref_receivers and call.args):
+                arg = astutil.dotted(call.args[0])
+                if arg:
+                    facts.opens.append((('ref', recv, arg),
+                                        call.lineno))
+            elif tail == 'decref' and recv and call.args:
+                arg = astutil.dotted(call.args[0])
+                if arg:
+                    facts.closes.append(('ref', recv, arg))
+
+
+        escape_names: Set[str] = set()
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            escape_names |= _names_in(stmt.value)
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, (ast.Attribute, ast.Subscript)):
+                    escape_names |= _names_in(stmt.value)
+        for expr in dataflow.owned_exprs(stmt):
+            for sub in ast.walk(expr):
+                if isinstance(sub, (ast.Yield, ast.YieldFrom)) and \
+                        getattr(sub, 'value', None) is not None:
+                    escape_names |= _names_in(sub.value)
+        facts.escapes_all |= escape_names
+        if facts.opens or facts.closes or facts.escapes_all:
+            return facts
+        return None
+
+
+def _is_lockish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    last = name.rsplit('.', 1)[-1].lower()
+    return any(part in last for part in _LOCKISH)
+
+
+def _is_tryacquire(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == 'blocking' and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == 'timeout':
+            return True
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    return False
+
+
+def _kw_false(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    return False
+
+
+def _call_arg_names(call: ast.Call) -> frozenset:
+    names: Set[str] = set()
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        names |= _names_in(arg)
+    return frozenset(names)
+
+
+def _describe(token) -> str:
+    kind = token[0]
+    if kind == 'lock':
+        return f'bare {token[1]}.acquire()'
+    if kind == 'upload':
+        return f'multipart upload `{"/".join(token[1])}`'
+    if kind == 'tmp':
+        return f'tempfile `{"/".join(token[1])}`'
+    if kind == 'ref':
+        return f'{token[1]}.incref({token[2]})'
+    return repr(token)

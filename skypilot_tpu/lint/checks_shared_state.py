@@ -1,0 +1,349 @@
+"""SKYT012 — module-level mutables written from ≥2 threads, no lock.
+
+RacerD-style ownership reasoning, scaled to this codebase's threading
+idiom: threads are born at known sites (``threading.Thread(target=…)``,
+``resilience.SupervisedThread``/``supervised_thread``), so a module's
+thread entrypoints are statically enumerable. For every module-level
+mutable (dict/list/set literal or constructor) the pass collects every
+WRITE — rebinds under a ``global`` declaration, subscript stores,
+mutator calls (``append``/``add``/``setdefault``/``pop``/…) — together
+with the statically-held lockset at the write:
+
+* the lexical ``with <lock>:`` nesting around the write, plus
+* locks guaranteed held at every same-module call site on the path
+  from the thread entrypoint to the writing function (meet over call
+  chains — a helper only counts as locked if ALL its callers lock).
+
+A mutable written from two different thread entrypoints (a writer
+that is reachable from no entrypoint runs on the spawning thread and
+counts as one more) whose write locksets share NO common lock is a
+candidate race. Modules that spawn no threads are skipped entirely —
+this pass only reasons where it can see the concurrency. Test-only
+mutators (``reset_for_tests``-style helpers) are ignored: they race
+with daemons by design and only in test teardown.
+
+Static companion to the dynamic Eraser-style detector in
+``skypilot_tpu/lint/dynamic.py`` — this pass sees code that never ran,
+the dynamic one sees objects and locks the AST cannot name.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint import astutil, dataflow
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT012'
+
+_MUTABLE_CTORS = frozenset({'dict', 'list', 'set', 'collections.deque',
+                            'collections.defaultdict',
+                            'collections.OrderedDict'})
+_MUTATORS = frozenset({'append', 'add', 'update', 'pop', 'setdefault',
+                       'clear', 'extend', 'remove', 'insert',
+                       'appendleft', 'popleft', 'discard',
+                       '__setitem__'})
+_THREAD_CTOR_TAILS = ('Thread', 'SupervisedThread')
+_THREAD_FN_TAILS = ('supervised_thread',)
+_MAIN = '<spawning-thread>'
+
+
+class _Write:
+    __slots__ = ('global_name', 'func', 'locks', 'line')
+
+    def __init__(self, global_name: str, func: str,
+                 locks: frozenset, line: int) -> None:
+        self.global_name = global_name
+        self.func = func
+        self.locks = locks
+        self.line = line
+
+
+class SharedStateChecker:
+    code = CODE
+    name = 'unsynchronized shared state'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            yield from self._check_module(mod)
+
+    # ------------------------------------------------------------------
+
+    def _check_module(self, mod) -> Iterator[Finding]:
+        imports = astutil.import_map(mod.tree)
+        fns = {self._qual(cls, fn.name): (cls, fn)
+               for cls, fn in dataflow.functions_of(mod.tree)}
+
+        entries = self._thread_entries(mod.tree, imports, fns)
+        if not entries:
+            return
+
+        mutables = self._module_mutables(mod.tree, imports)
+        if not mutables:
+            return
+
+        lock_names = self._lock_names(mod.tree, imports)
+
+        # Per function: writes (with lexical locks) and same-module
+        # call edges (with locks held at the call site).
+        writes: Dict[str, List[_Write]] = {}
+        edges: Dict[str, List[Tuple[str, frozenset]]] = {}
+        for qual, (cls, fn) in fns.items():
+            if self._test_only(fn.name):
+                continue
+            fn_writes, fn_edges = self._scan_fn(qual, cls, fn, fns,
+                                                mutables, lock_names)
+            if fn_writes:
+                writes[qual] = fn_writes
+            if fn_edges:
+                edges[qual] = fn_edges
+
+        if not writes:
+            return
+
+        # Guaranteed-held locks per (entry, function): meet over call
+        # chains from the entrypoint.
+        held = {entry: self._held_from(entry, edges, fns)
+                for entry in entries}
+
+        reported: Set[str] = set()
+        for global_name in sorted(mutables):
+            per_entry: Dict[str, List[Tuple[frozenset, _Write]]] = {}
+            for qual, fn_writes in writes.items():
+                for write in fn_writes:
+                    if write.global_name != global_name:
+                        continue
+                    owners = [entry for entry in entries
+                              if qual in held[entry]]
+                    if not owners:
+                        owners = [_MAIN]
+                    for entry in owners:
+                        base = (frozenset() if entry == _MAIN
+                                else held[entry].get(qual, frozenset()))
+                        per_entry.setdefault(entry, []).append(
+                            (base | write.locks, write))
+            real = [e for e in per_entry if e != _MAIN]
+            if len(per_entry) < 2 or not real:
+                continue
+            all_locksets = [locks for entry_writes in per_entry.values()
+                            for locks, _ in entry_writes]
+            common = frozenset.intersection(*all_locksets) \
+                if all_locksets else frozenset()
+            if common:
+                continue
+            first = min((w for ws in per_entry.values() for _, w in ws),
+                        key=lambda w: w.line)
+            slug = f'race:{global_name}'
+            if slug in reported:
+                continue
+            reported.add(slug)
+            entries_desc = ', '.join(sorted(per_entry))
+            yield Finding(
+                CODE, mod.rel, first.line,
+                f'module-level `{global_name}` is written from '
+                f'multiple threads ({entries_desc}) with no common '
+                'lock — guard every write with one lock (or confine '
+                'the state to a single thread)',
+                slug=slug)
+
+    # -- discovery ------------------------------------------------------
+
+    def _qual(self, cls: Optional[str], name: str) -> str:
+        return f'{cls}.{name}' if cls else name
+
+    def _test_only(self, name: str) -> bool:
+        return name.endswith('_for_tests') or name.startswith('reset_')
+
+    def _module_mutables(self, tree, imports) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in tree.body:
+            targets = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                targets, value = [stmt.target], stmt.value
+            if value is None:
+                continue
+            is_mutable = isinstance(value, (ast.Dict, ast.List,
+                                            ast.Set, ast.ListComp,
+                                            ast.DictComp, ast.SetComp))
+            if isinstance(value, ast.Call):
+                resolved = astutil.resolve_call(value.func, imports)
+                is_mutable = resolved in _MUTABLE_CTORS
+            if not is_mutable:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    out.add(target.id)
+        return out
+
+    def _lock_names(self, tree, imports) -> Set[str]:
+        """Module-level and self-attribute lock identities (dotted
+        receiver strings as they appear in ``with`` statements)."""
+        out: Set[str] = set()
+        for stmt in tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                resolved = astutil.resolve_call(stmt.value.func, imports)
+                if resolved in ('threading.Lock', 'threading.RLock',
+                                'threading.Condition'):
+                    out.add(stmt.targets[0].id)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == 'self'
+                    and isinstance(node.value, ast.Call)):
+                resolved = astutil.resolve_call(node.value.func, imports)
+                if resolved in ('threading.Lock', 'threading.RLock',
+                                'threading.Condition'):
+                    out.add(f'self.{node.targets[0].attr}')
+        return out
+
+    def _thread_entries(self, tree, imports, fns) -> Set[str]:
+        """Qualified names of functions run on spawned threads."""
+        out: Set[str] = set()
+
+        def add_target(expr, cls_ctx: Optional[str]) -> None:
+            if isinstance(expr, ast.Name) and expr.id in fns:
+                out.add(expr.id)
+                return
+            name = astutil.dotted(expr)
+            if name and name.startswith('self.') and cls_ctx:
+                qual = f'{cls_ctx}.{name[len("self."):]}'
+                if qual in fns:
+                    out.add(qual)
+
+        for cls, fn in dataflow.functions_of(tree):
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                resolved = astutil.resolve_call(call.func, imports) or ''
+                tail = resolved.rsplit('.', 1)[-1]
+                if tail in _THREAD_CTOR_TAILS:
+                    for kw in call.keywords:
+                        if kw.arg == 'target':
+                            add_target(kw.value, cls)
+                elif tail in _THREAD_FN_TAILS and call.args:
+                    add_target(call.args[0], cls)
+        return out
+
+    # -- per-function scan ----------------------------------------------
+
+    def _scan_fn(self, qual, cls, fn, fns, mutables, lock_names):
+        writes: List[_Write] = []
+        edges: List[Tuple[str, frozenset]] = []
+        globals_declared = {
+            name for node in ast.walk(fn)
+            if isinstance(node, ast.Global) for name in node.names}
+        local_names = {
+            n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+            and n.id not in globals_declared}
+
+        def is_global_mutable(name: str) -> bool:
+            return (name in mutables
+                    and (name in globals_declared
+                         or name not in local_names))
+
+        def walk(body, held: frozenset) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    acquired = set()
+                    for item in stmt.items:
+                        name = astutil.dotted(item.context_expr)
+                        if name and (name in lock_names
+                                     or _lockish(name)):
+                            acquired.add(name)
+                    self._stmt_effects(stmt, held, is_global_mutable,
+                                       qual, cls, fns, writes, edges)
+                    walk(stmt.body, held | frozenset(acquired))
+                    continue
+                self._stmt_effects(stmt, held, is_global_mutable,
+                                   qual, cls, fns, writes, edges)
+                for field in ('body', 'orelse', 'finalbody'):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        walk(sub, held)
+                for handler in getattr(stmt, 'handlers', ()) or ():
+                    walk(handler.body, held)
+
+        walk(fn.body, frozenset())
+        return writes, edges
+
+    def _stmt_effects(self, stmt, held, is_global_mutable, qual, cls,
+                      fns, writes, edges) -> None:
+        exprs = dataflow.owned_exprs(stmt)
+        # Writes: subscript stores / del / augassign on the mutable.
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        for target in targets:
+            base = target
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name):
+                if isinstance(target, ast.Subscript) \
+                        and is_global_mutable(base.id):
+                    writes.append(_Write(base.id, qual, held,
+                                         stmt.lineno))
+                elif (isinstance(target, ast.Name)
+                      and is_global_mutable(target.id)):
+                    writes.append(_Write(target.id, qual, held,
+                                         stmt.lineno))
+        for expr in exprs:
+            for call in (n for n in ast.walk(expr)
+                         if isinstance(n, ast.Call)):
+                func = call.func
+                if isinstance(func, ast.Attribute):
+                    if (isinstance(func.value, ast.Name)
+                            and func.attr in _MUTATORS
+                            and is_global_mutable(func.value.id)):
+                        writes.append(_Write(func.value.id, qual, held,
+                                             call.lineno))
+                        continue
+                    # self.method() call edge.
+                    name = astutil.dotted(func)
+                    if name and name.startswith('self.') and cls:
+                        callee = f'{cls}.{name[len("self."):]}'
+                        if callee in fns:
+                            edges.append((callee, held))
+                elif isinstance(func, ast.Name) and func.id in fns:
+                    edges.append((func.id, held))
+
+    def _held_from(self, entry, edges, fns
+                   ) -> Dict[str, frozenset]:
+        """function -> locks guaranteed held when reached from
+        ``entry`` (meet over call chains)."""
+        if entry not in fns:
+            return {}
+        held: Dict[str, frozenset] = {entry: frozenset()}
+        worklist = [entry]
+        while worklist:
+            func = worklist.pop()
+            base = held[func]
+            for callee, site_locks in edges.get(func, ()):
+                candidate = base | site_locks
+                prev = held.get(callee)
+                new = candidate if prev is None else (prev & candidate)
+                if new != prev:
+                    held[callee] = new
+                    worklist.append(callee)
+        return held
+
+
+def _lockish(name: str) -> bool:
+    last = name.rsplit('.', 1)[-1].lower()
+    return 'lock' in last or 'cond' in last

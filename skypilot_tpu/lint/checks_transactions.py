@@ -1,0 +1,280 @@
+"""SKYT010 — transaction hygiene in the control-plane DB modules.
+
+Two invariants over the ``conn = _db()`` idiom the state stores share
+(requests_db, jobs/state, serve_state, users_db, state.py):
+
+1. **No blocking work and no bare event publish inside an open
+   transaction.** sqlite serializes writers on ONE file lock and
+   Postgres holds row locks until commit — a ``time.sleep``, a network
+   call, a subprocess, or a deterministic-chaos ``inject()`` inside an
+   open write transaction stalls every other writer in the deployment
+   for its duration. ``events.publish(topic)`` without ``conn=`` is the
+   subtler bug: in-process waiters wake IMMEDIATELY, re-read the store,
+   and see the pre-commit snapshot — the publish must ride the
+   writer's connection (``conn=conn``, requests_db.create's form) so
+   cross-replica NOTIFY delivery is transactional, or simply move
+   after the commit.
+
+2. **No path abandons an open transaction.** An execute that raised
+   (or a guard that ``raise``s after a write) leaves the implicit
+   transaction open on the per-thread connection — the write lock is
+   then held for the THREAD's lifetime, starving every claimant (the
+   exact outage requests_db.create's rollback comment documents).
+   Every explicit ``raise`` reachable with an open transaction, and
+   every normal exit without commit/rollback, is flagged — for
+   functions that obtained the connection themselves (helpers taking
+   ``conn`` as a parameter hand commit responsibility to the caller).
+
+The pass is CFG-based (dataflow.forward): "open" is tracked through
+branches, loops and exception edges — a failed INSERT's exception edge
+carries the open state into the handler, so a handler that re-raises
+without ``rollback()`` is a finding while requests_db.create (which
+rolls back first) is not.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint import astutil, dataflow
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT010'
+
+_WRITE_KEYWORDS = frozenset({'INSERT', 'UPDATE', 'DELETE', 'REPLACE'})
+_EXEC_METHODS = frozenset({'execute', 'executemany', 'executescript'})
+_CLOSE_METHODS = frozenset({'commit', 'rollback', 'close'})
+# Adapter methods that commit internally.
+_SELF_COMMITTING = frozenset({'insert_returning'})
+_CONN_FACTORY_TAILS = ('_db', 'connect_dual_backend', 'connect',
+                       'from_url')
+_BLOCKING_HEADS = ('requests', 'urllib', 'socket', 'http',
+                   'subprocess')
+
+
+def _sql_keyword(arg: ast.AST, rd_vals) -> Optional[str]:
+    """First SQL keyword of an execute() argument: literal, f-string
+    head, or a local name with a single constant reaching definition."""
+    text = astutil.const_str(arg) or astutil.fstring_head(arg)
+    if text is None and isinstance(arg, ast.Name) and rd_vals:
+        defs = rd_vals.get(arg.id, set())
+        consts = {astutil.const_str(d.value)
+                  for d in defs
+                  if d.value is not dataflow.UNKNOWN
+                  and isinstance(d.value, ast.AST)}
+        if len(consts) == 1 and None not in consts:
+            text = next(iter(consts))
+    if text is None:
+        return None
+    stripped = text.lstrip().lstrip('(')
+    return stripped.split(None, 1)[0].upper() if stripped.split() else None
+
+
+class _FnScan:
+    """Per-statement facts for one function."""
+
+    def __init__(self, fn, imports) -> None:
+        self.fn = fn
+        self.imports = imports
+        self.cfg = dataflow.CFG(fn)
+        self.rd = dataflow.ReachingDefs(self.cfg)
+        self.conns: Set[str] = set()       # locally-obtained connections
+        self.param_conns: Set[str] = set()  # caller-owned connections
+        args = getattr(fn, 'args', None)
+        if args is not None:
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                if a.arg in ('conn', 'db'):
+                    self.param_conns.add(a.arg)
+        for node in dataflow.statement_nodes(self.cfg):
+            stmt = node.stmt
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not (len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            if isinstance(stmt.value, ast.Call):
+                resolved = astutil.resolve_call(stmt.value.func,
+                                                imports) or ''
+                tail = resolved.rsplit('.', 1)[-1]
+                if tail in _CONN_FACTORY_TAILS:
+                    self.conns.add(stmt.targets[0].id)
+
+    def all_conns(self) -> Set[str]:
+        return self.conns | self.param_conns
+
+
+class TransactionHygieneChecker:
+    code = CODE
+    name = 'transaction hygiene'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            imports = astutil.import_map(mod.tree)
+            for class_name, fn in dataflow.functions_of(mod.tree):
+                del class_name
+                scan = _FnScan(fn, imports)
+                if not scan.all_conns():
+                    continue
+                yield from self._check_fn(mod, scan)
+
+    # ------------------------------------------------------------------
+
+    def _check_fn(self, mod, scan: _FnScan) -> Iterator[Finding]:
+        conns = scan.all_conns()
+        lexical_open = self._with_conn_statements(scan.fn, conns)
+
+        def effects(node) -> Tuple[Set[str], Set[str]]:
+            """(opens, closes) conn names for one statement node."""
+            opens: Set[str] = set()
+            closes: Set[str] = set()
+            stmt = node.stmt
+            if id(stmt) in lexical_open:
+                # Writes inside `with conn:` are closed by the context
+                # manager at block exit (commit/rollback both ways);
+                # only the blocking-work rule applies there.
+                return opens, closes
+            for call in _calls_of(stmt):
+                recv = _conn_receiver(call, conns)
+                if recv is None:
+                    continue
+                attr = call.func.attr
+                if attr in _EXEC_METHODS:
+                    keyword = None
+                    if call.args:
+                        keyword = _sql_keyword(call.args[0],
+                                               scan.rd.at(node))
+                    if (keyword in _WRITE_KEYWORDS
+                            or attr == 'executescript'):
+                        opens.add(recv)
+                elif attr in _CLOSE_METHODS or attr in _SELF_COMMITTING:
+                    closes.add(recv)
+            return opens, closes
+
+        def transfer(node, state):
+            if node.stmt is None:
+                return state, state
+            opens, closes = effects(node)
+            out = frozenset((state - closes) | opens)
+            # A failed write statement ALSO leaves its transaction
+            # open (BEGIN ran before the statement errored) — the
+            # exception edge carries the open state.
+            return out, out
+
+        init = frozenset()
+        in_states = dataflow.forward(
+            scan.cfg, init, transfer,
+            merge=lambda a, b: frozenset(a | b))
+
+        fn_name = scan.fn.name
+        reported: Set[str] = set()
+        for node in dataflow.statement_nodes(scan.cfg):
+            state = in_states.get(id(node), frozenset())
+            stmt = node.stmt
+            in_txn = bool(state) or id(stmt) in lexical_open
+            if not in_txn:
+                continue
+            for call in _calls_of(stmt):
+                label = self._blocking_label(call, scan.imports)
+                if label is None:
+                    continue
+                slug = f'txn-blocking:{fn_name}:{label}'
+                if slug in reported:
+                    continue
+                reported.add(slug)
+                yield Finding(
+                    CODE, mod.rel, call.lineno,
+                    f'`{label}` inside an open transaction in '
+                    f'{fn_name}() — blocking work and bare publishes '
+                    'must move past the commit (publish may ride '
+                    '`conn=` instead)',
+                    slug=slug)
+            # Explicit raise while a transaction this function owns is
+            # open: the write lock outlives the call.
+            if (isinstance(stmt, ast.Raise)
+                    and (state & scan.conns)
+                    and id(stmt) not in lexical_open):
+                conn = sorted(state & scan.conns)[0]
+                slug = f'txn-raise:{fn_name}:{conn}'
+                if slug not in reported:
+                    reported.add(slug)
+                    yield Finding(
+                        CODE, mod.rel, stmt.lineno,
+                        f'raise with transaction on `{conn}` still '
+                        f'open in {fn_name}() — rollback before '
+                        'raising or the per-thread connection holds '
+                        'the write lock forever',
+                        slug=slug)
+
+        # Normal exit with an owned transaction open: some return/
+        # fallthrough path (including returns from an except handler
+        # that never rolled back) ends the function holding the write
+        # lock. Only NORMAL edges into the exit node count — an
+        # uncaught exception propagating out of a DB call is the
+        # caller's cleanup problem and flagging every such call would
+        # be noise.
+        exit_open: Set[str] = set()
+        for pred, kind in scan.cfg.exit.preds:
+            if kind != dataflow.NORMAL:
+                continue
+            pred_state = in_states.get(id(pred))
+            if pred_state is None:
+                continue
+            out_normal, _ = transfer(pred, pred_state)
+            exit_open |= out_normal
+        for conn in sorted(exit_open & scan.conns):
+            slug = f'txn-open-exit:{fn_name}:{conn}'
+            if slug not in reported:
+                reported.add(slug)
+                yield Finding(
+                    CODE, mod.rel, scan.fn.lineno,
+                    f'{fn_name}() can return with the transaction on '
+                    f'`{conn}` still open — commit/rollback on every '
+                    'path',
+                    slug=slug)
+
+    def _with_conn_statements(self, fn, conns) -> Set[int]:
+        """ids of statements lexically inside a ``with conn:`` body
+        (the context manager commits/rolls back at exit, so only rule
+        1 applies there)."""
+        out: Set[int] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(isinstance(item.context_expr, ast.Name)
+                       and item.context_expr.id in conns
+                       for item in node.items):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    out.add(id(sub))
+        return out
+
+    def _blocking_label(self, call: ast.Call, imports) -> Optional[str]:
+        resolved = astutil.resolve_call(call.func, imports)
+        if resolved is None:
+            return None
+        if resolved == 'time.sleep':
+            return 'time.sleep'
+        tail = resolved.rsplit('.', 1)[-1]
+        if tail == 'inject' and 'fault_injection' in resolved:
+            return 'fault_injection.inject'
+        if resolved.endswith('events.publish') or resolved == 'publish':
+            has_conn = any(kw.arg == 'conn' for kw in call.keywords)
+            return None if has_conn else 'events.publish'
+        head = resolved.split('.', 1)[0]
+        if head in _BLOCKING_HEADS:
+            return resolved
+        return None
+
+
+def _calls_of(stmt: ast.stmt) -> List[ast.Call]:
+    return dataflow.owned_calls(stmt)
+
+
+def _conn_receiver(call: ast.Call, conns: Set[str]) -> Optional[str]:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    base = call.func.value
+    if isinstance(base, ast.Name) and base.id in conns:
+        return base.id
+    return None

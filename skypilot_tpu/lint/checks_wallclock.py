@@ -1,0 +1,381 @@
+"""SKYT009 — wall-clock ``time.time()`` flowing into duration math.
+
+The wall clock steps (NTP slew, suspend/resume, manual set); durations,
+deadlines, cooldowns and rate windows measured with it silently stretch
+or go negative. This exact bug was fixed by hand twice before this pass
+existed (PR 4: the LB QPS ring; PR 9: the spot-placer cooldown and the
+autoscaler hysteresis timer) while 131 other ``time.time()`` sites went
+unreviewed. The pass automates the review with a taint analysis over
+the shared CFG/reaching-definitions layer:
+
+* a value is **wall-tainted** when every definition that reaches its
+  use is derived from ``time.time()`` (possibly through ``+``/``-``
+  with a plain number, ``int()``/``float()``, ``min``/``max`` of
+  all-tainted args, or a module/class attribute or dict that is only
+  ever assigned wall readings);
+* a finding is a ``-`` or an ordering comparison where BOTH operands
+  are wall-tainted — i.e. an elapsed-time or deadline computation done
+  entirely on the local wall clock.
+
+Requiring both sides tainted is what makes persisted/displayed
+timestamps pass untouched: ``created_at=time.time()`` is never
+arithmetic; ``time.time() - stale_after`` (a wall cutoff compared to
+DB-persisted heartbeats) has an untainted operand; a DB row's
+timestamp compared against ``time.time()`` is untainted on one side.
+Every finding is a duration measured wall-to-wall in one process —
+precisely the class where ``time.monotonic()`` is the fix.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.lint import astutil, dataflow
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT009'
+
+WALL_CALLS = frozenset({'time.time'})
+# Positional/keyword wrappers through which taint flows unchanged.
+_CAST_FNS = frozenset({'int', 'float', 'abs', 'round'})
+_ALLTAINT_FNS = frozenset({'min', 'max'})
+_ORDER_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+TAINTED, NEUTRAL, CLEAN = 'T', 'N', 'C'
+
+
+def _is_neutral_const(expr: ast.AST) -> bool:
+    """Numeric literals / None are sentinels (``last = 0.0``), not
+    evidence about the clock a name is measured with."""
+    if isinstance(expr, ast.Constant):
+        return expr.value is None or isinstance(expr.value, (int, float))
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.operand,
+                                                    ast.Constant):
+        return isinstance(expr.operand.value, (int, float))
+    if isinstance(expr, (ast.Dict, ast.List, ast.Set)):
+        return not expr.keys if isinstance(expr, ast.Dict) \
+            else not expr.elts
+    return False
+
+
+class _FnInfo:
+    """CFG + reaching defs + per-def taint states for one function."""
+
+    def __init__(self, class_name: Optional[str],
+                 fn: ast.AST) -> None:
+        self.class_name = class_name
+        self.fn = fn
+        self.cfg = dataflow.CFG(fn)
+        self.rd = dataflow.ReachingDefs(self.cfg)
+        self.def_state: Dict[int, str] = {}
+        self.globals_declared: Set[str] = {
+            name for node in ast.walk(fn)
+            if isinstance(node, ast.Global) for name in node.names}
+
+
+class WallClockChecker:
+    code = CODE
+    name = 'wall clock in duration arithmetic'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            yield from self._check_module(mod)
+
+    # ------------------------------------------------------------------
+
+    def _check_module(self, mod) -> Iterator[Finding]:
+        imports = astutil.import_map(mod.tree)
+        fns = [_FnInfo(cls, fn)
+               for cls, fn in dataflow.functions_of(mod.tree)]
+        module_names = {
+            t.id for s in mod.tree.body
+            if isinstance(s, (ast.Assign, ast.AnnAssign))
+            for t in (s.targets if isinstance(s, ast.Assign)
+                      else [s.target])
+            if isinstance(t, ast.Name)}
+
+        # Module/class locations only ever assigned wall readings.
+        # Iterated: a location tainted via a name that is tainted via
+        # another location needs a second round to settle.
+        locations: Dict[Tuple, bool] = {}
+        for _ in range(3):
+            for info in fns:
+                self._solve_fn(info, imports, locations)
+            new_locations = self._collect_locations(
+                mod, fns, imports, locations, module_names)
+            if new_locations == locations:
+                break
+            locations = new_locations
+
+        for info in fns:
+            yield from self._find(mod, info, imports, locations)
+
+    # -- location (module/class attr) taint -----------------------------
+
+    def _collect_locations(self, mod, fns, imports, locations,
+                           module_names) -> Dict[Tuple, bool]:
+        votes: Dict[Tuple, List[str]] = {}
+
+        def vote(key, state):
+            votes.setdefault(key, []).append(state)
+
+        # Module top level.
+        for stmt in mod.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            state = self._module_expr_state(value, imports, locations)
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    vote(('g', target.id), state)
+
+        # Inside functions.
+        for info in fns:
+            for node in dataflow.statement_nodes(info.cfg):
+                stmt = node.stmt
+                if isinstance(stmt, ast.Assign):
+                    value, targets = stmt.value, stmt.targets
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    value, targets = stmt.value, [stmt.target]
+                else:
+                    continue
+                state = self._expr_state(value, info, node, imports,
+                                         locations)
+                for target in targets:
+                    key = self._location_key(target, info, module_names)
+                    if key is not None:
+                        vote(key, state)
+
+        out: Dict[Tuple, bool] = {}
+        for key, states in votes.items():
+            out[key] = (TAINTED in states) and (CLEAN not in states)
+        return out
+
+    def _location_key(self, target, info, module_names
+                      ) -> Optional[Tuple]:
+        if isinstance(target, ast.Name):
+            if target.id in info.globals_declared:
+                return ('g', target.id)
+            return None
+        if isinstance(target, ast.Attribute):
+            name = astutil.dotted(target)
+            if (name and name.startswith('self.')
+                    and info.class_name and name.count('.') == 1):
+                return ('c', info.class_name, target.attr)
+            return None
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            if (isinstance(base, ast.Name)
+                    and base.id in module_names
+                    and base.id not in info.rd.local_names):
+                return ('gd', base.id)
+            base_name = astutil.dotted(base)
+            if (base_name and base_name.startswith('self.')
+                    and info.class_name and base_name.count('.') == 1):
+                return ('cd', info.class_name, base.attr)
+        return None
+
+    def _module_expr_state(self, expr, imports, locations) -> str:
+        """Taint state of a module-top-level expression (no locals)."""
+        if _is_neutral_const(expr):
+            return NEUTRAL
+        dummy = _ModuleScope(imports, locations)
+        return TAINTED if dummy.tainted(expr) else CLEAN
+
+    # -- per-function def-state fixpoint --------------------------------
+
+    def _solve_fn(self, info: _FnInfo, imports, locations) -> None:
+        info.def_state = {id(d): CLEAN for d in info.rd.defs}
+        for _ in range(len(info.rd.defs) + 2):
+            changed = False
+            for d in info.rd.defs:
+                state = self._def_state(d, info, imports, locations)
+                if state != info.def_state[id(d)]:
+                    info.def_state[id(d)] = state
+                    changed = True
+            if not changed:
+                break
+
+    def _def_state(self, d, info, imports, locations) -> str:
+        if d.value is dataflow.UNKNOWN:
+            return CLEAN
+        if isinstance(d.value, ast.AugAssign):
+            stmt = d.value
+            old = self._name_tainted(d.name, info, d.node, imports,
+                                     locations, exclude=d)
+            operand = self._expr_state(stmt.value, info, d.node,
+                                       imports, locations)
+            return TAINTED if (old or operand == TAINTED) else CLEAN
+        return self._expr_state(d.value, info, d.node, imports,
+                                locations)
+
+    def _expr_state(self, expr, info, node, imports, locations) -> str:
+        if _is_neutral_const(expr):
+            return NEUTRAL
+        return TAINTED if self._tainted(expr, info, node, imports,
+                                        locations) else CLEAN
+
+    # -- expression taint -----------------------------------------------
+
+    def _name_tainted(self, name, info, node, imports, locations,
+                      exclude=None) -> bool:
+        defs = info.rd.at(node).get(name) if node is not None else None
+        if name in info.rd.local_names:
+            if not defs:
+                return False
+            states = [info.def_state.get(id(d), CLEAN)
+                      for d in defs if d is not exclude]
+            if not states:
+                return False
+            return TAINTED in states and CLEAN not in states
+        return bool(locations.get(('g', name)))
+
+    def _tainted(self, expr, info, node, imports, locations) -> bool:
+        taint = lambda e: self._tainted(e, info, node, imports,  # noqa: E731
+                                        locations)
+        if isinstance(expr, ast.Call):
+            resolved = astutil.resolve_call(expr.func, imports)
+            if resolved in WALL_CALLS:
+                return True
+            if resolved in _CAST_FNS and expr.args:
+                return taint(expr.args[0])
+            if resolved in _ALLTAINT_FNS and expr.args:
+                return all(taint(a) for a in expr.args)
+            if (isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr in ('get', 'pop', 'setdefault')):
+                if self._container_tainted(expr.func.value, info,
+                                           locations):
+                    return True
+                if (expr.func.attr == 'setdefault'
+                        and len(expr.args) >= 2):
+                    return taint(expr.args[1])
+            return False
+        if isinstance(expr, ast.Name):
+            return self._name_tainted(expr.id, info, node, imports,
+                                      locations)
+        if isinstance(expr, ast.Attribute):
+            name = astutil.dotted(expr)
+            if (name and name.startswith('self.')
+                    and info.class_name and name.count('.') == 1):
+                return bool(locations.get(
+                    ('c', info.class_name, expr.attr)))
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self._container_tainted(expr.value, info, locations)
+        if isinstance(expr, ast.BinOp) and isinstance(
+                expr.op, (ast.Add, ast.Sub)):
+            return taint(expr.left) or taint(expr.right)
+        if isinstance(expr, ast.IfExp):
+            return taint(expr.body) or taint(expr.orelse)
+        if isinstance(expr, ast.BoolOp):
+            return any(taint(v) for v in expr.values)
+        if isinstance(expr, ast.NamedExpr):
+            return taint(expr.value)
+        return False
+
+    def _container_tainted(self, base, info, locations) -> bool:
+        if isinstance(base, ast.Name):
+            if base.id in info.rd.local_names:
+                return False
+            return bool(locations.get(('gd', base.id)))
+        name = astutil.dotted(base)
+        if (name and name.startswith('self.') and info.class_name
+                and name.count('.') == 1):
+            return bool(locations.get(
+                ('cd', info.class_name, base.attr)))
+        return False
+
+    # -- findings -------------------------------------------------------
+
+    def _find(self, mod, info, imports, locations) -> Iterator[Finding]:
+        fn_name = info.fn.name
+        for node in dataflow.statement_nodes(info.cfg):
+            for expr in dataflow.owned_exprs(node.stmt):
+                for sub in ast.walk(expr):
+                    hit = self._site(sub, info, node, imports,
+                                     locations)
+                    if hit is None:
+                        continue
+                    what, render = hit
+                    yield Finding(
+                        CODE, mod.rel, sub.lineno,
+                        f'wall-clock {what} `{render}` — measure '
+                        'durations/deadlines with time.monotonic() '
+                        '(persisted or displayed timestamps stay on '
+                        'time.time())',
+                        slug=f'wall:{fn_name}:{render}')
+
+    def _site(self, sub, info, node, imports, locations):
+        if (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub)
+                and self._tainted(sub.left, info, node, imports,
+                                  locations)
+                and self._tainted(sub.right, info, node, imports,
+                                  locations)):
+            return 'elapsed/interval arithmetic', _render(sub)
+        if isinstance(sub, ast.Compare):
+            operands = [sub.left] + list(sub.comparators)
+            for i, op in enumerate(sub.ops):
+                if not isinstance(op, _ORDER_OPS):
+                    continue
+                left, right = operands[i], operands[i + 1]
+                # Skip when either side already reports as a tainted
+                # subtraction (one finding per root cause).
+                if _has_tainted_sub(left, self, info, node, imports,
+                                    locations) or _has_tainted_sub(
+                                        right, self, info, node,
+                                        imports, locations):
+                    continue
+                if (self._tainted(left, info, node, imports, locations)
+                        and self._tainted(right, info, node, imports,
+                                          locations)):
+                    return 'deadline comparison', _render(sub)
+        return None
+
+
+def _has_tainted_sub(expr, checker, info, node, imports,
+                     locations) -> bool:
+    for sub in ast.walk(expr):
+        if (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub)
+                and checker._tainted(sub.left, info, node, imports,
+                                     locations)
+                and checker._tainted(sub.right, info, node, imports,
+                                     locations)):
+            return True
+    return False
+
+
+def _render(expr) -> str:
+    try:
+        text = ast.unparse(expr)
+    except Exception:  # pylint: disable=broad-except
+        text = '<expr>'
+    return ' '.join(text.split())[:80]
+
+
+class _ModuleScope:
+    """Minimal taint evaluator for module-top-level expressions."""
+
+    def __init__(self, imports, locations) -> None:
+        self.imports = imports
+        self.locations = locations
+
+    def tainted(self, expr) -> bool:
+        if isinstance(expr, ast.Call):
+            resolved = astutil.resolve_call(expr.func, self.imports)
+            if resolved in WALL_CALLS:
+                return True
+            if resolved in _CAST_FNS and expr.args:
+                return self.tainted(expr.args[0])
+            return False
+        if isinstance(expr, ast.Name):
+            return bool(self.locations.get(('g', expr.id)))
+        if isinstance(expr, ast.BinOp) and isinstance(
+                expr.op, (ast.Add, ast.Sub)):
+            return self.tainted(expr.left) or self.tainted(expr.right)
+        return False

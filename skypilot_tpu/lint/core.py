@@ -4,7 +4,7 @@ The linter is a plain AST pass (stdlib ``ast`` only — no third-party
 deps, importable on the leanest runner). Checkers live in the
 ``checks_*`` modules; each exposes a class with:
 
-* ``code``  — the stable finding code (``SKYT001``..``SKYT008``);
+* ``code``  — the stable finding code (``SKYT001``..``SKYT012``);
 * ``name``  — short human label;
 * ``run(ctx)`` — yields :class:`Finding`s over a :class:`Context`.
 
@@ -233,7 +233,11 @@ def all_checkers() -> List:
     from skypilot_tpu.lint import (checks_async, checks_chaos,
                                    checks_concurrency, checks_env,
                                    checks_events, checks_metrics,
-                                   checks_portability)
+                                   checks_portability,
+                                   checks_resources,
+                                   checks_shared_state,
+                                   checks_transactions,
+                                   checks_wallclock)
     return [
         checks_async.AsyncBlockingChecker(),        # SKYT001
         checks_env.EnvRegistryChecker(),            # SKYT002
@@ -243,6 +247,10 @@ def all_checkers() -> List:
         checks_concurrency.LockOrderChecker(),      # SKYT006
         checks_portability.SqlitePortabilityChecker(),  # SKYT007
         checks_portability.JaxPurityChecker(),      # SKYT008
+        checks_wallclock.WallClockChecker(),        # SKYT009
+        checks_transactions.TransactionHygieneChecker(),  # SKYT010
+        checks_resources.ResourcePairingChecker(),  # SKYT011
+        checks_shared_state.SharedStateChecker(),   # SKYT012
     ]
 
 

@@ -1,0 +1,470 @@
+"""Intraprocedural CFG + reaching definitions for the dataflow passes.
+
+PR 8's passes were syntactic (one AST walk, no flow). The SKYT009..012
+passes need to answer flow questions — "which definitions of ``now``
+reach this subtraction", "is a transaction still open when this call
+runs", "does every path out of this function (including exception
+edges) balance this acquire" — so this module builds, per function:
+
+* a statement-granularity **control-flow graph** with labelled edges
+  (``normal`` / ``exc``). Exception edges are emitted from every
+  statement that contains a call (the conservative "any call may
+  raise") to the innermost enclosing handler/finally, or to the exit
+  node when nothing encloses it. ``break``/``continue``/``return``/
+  ``raise`` are wired exactly.
+* **reaching definitions** over that CFG: for each node and local
+  name, the set of definition sites (with their value expressions
+  where syntactically recoverable) that may flow there.
+* a tiny generic **forward engine** (:func:`forward`) the passes
+  instantiate with their own lattices (transaction state, outstanding
+  resource sets).
+
+Everything is stdlib ``ast`` only, same as the rest of the linter.
+The graph deliberately UNDER-approximates interprocedural effects
+(calls are opaque); passes built on it must choose gen/kill rules so
+that imprecision degrades to silence, not noise.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+NORMAL = 'normal'
+EXC = 'exc'
+
+# Sentinel value-expression for definitions whose value is not a plain
+# expression (loop targets, except aliases, parameters, with-as names).
+UNKNOWN = object()
+
+
+class Node:
+    """One CFG node: a statement, or a synthetic entry/exit/join."""
+
+    __slots__ = ('stmt', 'label', 'succs', 'preds')
+
+    def __init__(self, stmt: Optional[ast.stmt], label: str) -> None:
+        self.stmt = stmt
+        self.label = label                      # 'stmt'|'entry'|'exit'|'join'
+        self.succs: List[Tuple['Node', str]] = []
+        self.preds: List[Tuple['Node', str]] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        line = getattr(self.stmt, 'lineno', '?')
+        return f'<Node {self.label}@{line}>'
+
+
+def _link(a: Node, b: Node, kind: str = NORMAL) -> None:
+    for succ, k in a.succs:
+        if succ is b and k == kind:
+            return
+    a.succs.append((b, kind))
+    b.preds.append((a, kind))
+
+
+def stmt_may_raise(stmt: ast.stmt) -> bool:
+    """Conservative: a statement that evaluates any call may raise.
+    Compound statements only evaluate their HEADER expressions at
+    their own CFG node (bodies are separate nodes with their own
+    edges); nested function/class bodies are nobody's calls."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for expr in owned_exprs(stmt):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                return True
+    return False
+
+
+class CFG:
+    """Control-flow graph of one function body.
+
+    Compound statements contribute a node for their header (test/iter/
+    context managers) with the body wired structurally; simple
+    statements are one node each.
+    """
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.entry = Node(None, 'entry')
+        self.exit = Node(None, 'exit')
+        self.nodes: List[Node] = [self.entry, self.exit]
+        # (loop-head, break-sinks) stack and exception-target stack are
+        # builder-local; kept on self for the recursive helpers.
+        self._loops: List[Tuple[Node, List[Node]]] = []
+        self._exc_targets: List[List[Node]] = [[self.exit]]
+        # Innermost-first stack of (finally-entry join, loop depth at
+        # push): return/break/continue inside a try..finally run the
+        # finally first. The loop depth decides whether a break/
+        # continue crosses the finally (finally inside the loop) or
+        # not (loop inside the finally).
+        self._finallys: List[Tuple[Node, int]] = []
+        frontier = self._stmts(list(getattr(fn, 'body', [])),
+                               [self.entry])
+        for node in frontier:
+            _link(node, self.exit)
+
+    # -- construction ---------------------------------------------------
+
+    def _new(self, stmt: Optional[ast.stmt], label: str = 'stmt') -> Node:
+        node = Node(stmt, label)
+        self.nodes.append(node)
+        return node
+
+    def _exc_edges(self, node: Node) -> None:
+        """Wire ``node`` to the innermost exception targets."""
+        if node.stmt is None or not stmt_may_raise(node.stmt):
+            return
+        for target in self._exc_targets[-1]:
+            _link(node, target, EXC)
+
+    def _stmts(self, body: List[ast.stmt],
+               frontier: List[Node]) -> List[Node]:
+        for stmt in body:
+            if not frontier:
+                break   # unreachable code after return/raise/break
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt, frontier: List[Node]) -> List[Node]:
+        if isinstance(stmt, ast.If):
+            test = self._new(stmt)
+            for node in frontier:
+                _link(node, test)
+            self._exc_edges(test)
+            then_exits = self._stmts(stmt.body, [test])
+            else_exits = (self._stmts(stmt.orelse, [test])
+                          if stmt.orelse else [test])
+            return then_exits + else_exits
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._new(stmt)
+            for node in frontier:
+                _link(node, head)
+            self._exc_edges(head)
+            breaks: List[Node] = []
+            self._loops.append((head, breaks))
+            body_exits = self._stmts(stmt.body, [head])
+            self._loops.pop()
+            for node in body_exits:
+                _link(node, head)
+            orelse_exits = (self._stmts(stmt.orelse, [head])
+                            if stmt.orelse else [])
+            return [head] + breaks + orelse_exits
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._new(stmt)
+            for node in frontier:
+                _link(node, head)
+            self._exc_edges(head)
+            return self._stmts(stmt.body, [head])
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            node = self._new(stmt)   # definition only; body not walked
+            for pred in frontier:
+                _link(pred, node)
+            return [node]
+        # -- simple statements -----------------------------------------
+        node = self._new(stmt)
+        for pred in frontier:
+            _link(pred, node)
+        if isinstance(stmt, ast.Return):
+            self._exc_edges(node)
+            # A return inside try..finally runs the finally first; the
+            # finally's continuation edges carry the path to the exit.
+            _link(node, self._finallys[-1][0] if self._finallys
+                  else self.exit)
+            return []
+        if isinstance(stmt, ast.Raise):
+            for target in self._exc_targets[-1]:
+                _link(node, target, EXC)
+            return []
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            crosses_finally = (
+                self._finallys
+                and self._finallys[-1][1] >= len(self._loops))
+            if crosses_finally:
+                _link(node, self._finallys[-1][0])
+            elif self._loops:
+                if isinstance(stmt, ast.Break):
+                    self._loops[-1][1].append(node)
+                else:
+                    _link(node, self._loops[-1][0])
+            return []
+        self._exc_edges(node)
+        return [node]
+
+    def _try(self, stmt: ast.Try, frontier: List[Node]) -> List[Node]:
+        has_final = bool(stmt.finalbody)
+        finally_join = self._new(None, 'join') if has_final else None
+
+        handler_nodes: List[Node] = []
+        for handler in stmt.handlers:
+            handler_nodes.append(self._new(None, 'join'))
+
+        # Exceptions inside the body go to the handlers (approximation:
+        # all of them), else to finally, else to the outer targets.
+        if handler_nodes:
+            inner_targets: List[Node] = list(handler_nodes)
+            if has_final:
+                # A raise matching no handler still runs finally.
+                inner_targets.append(finally_join)
+        elif has_final:
+            inner_targets = [finally_join]
+        else:
+            inner_targets = self._exc_targets[-1]
+        if has_final:
+            self._finallys.append((finally_join, len(self._loops)))
+        self._exc_targets.append(inner_targets)
+        body_exits = self._stmts(stmt.body, frontier)
+        self._exc_targets.pop()
+
+        # `else:` bodies and handler bodies share exception targets:
+        # their raises are NOT caught by this try's handlers, but they
+        # DO run the finally before propagating outward.
+        outer_targets = ([finally_join] if has_final
+                         else self._exc_targets[-1])
+        self._exc_targets.append(outer_targets)
+        orelse_exits = (self._stmts(stmt.orelse, body_exits)
+                        if stmt.orelse else body_exits)
+        handler_exits: List[Node] = []
+        for handler, hnode in zip(stmt.handlers, handler_nodes):
+            handler_exits.extend(self._stmts(handler.body, [hnode]))
+        self._exc_targets.pop()
+
+        if has_final:
+            self._finallys.pop()
+            for node in orelse_exits + handler_exits:
+                _link(node, finally_join)
+            final_exits = self._stmts(stmt.finalbody, [finally_join])
+            # The finally block also sits on the exceptional path: after
+            # it runs, an in-flight exception continues outward.
+            for node in final_exits:
+                for target in self._exc_targets[-1]:
+                    _link(node, target, EXC)
+            return final_exits
+        return orelse_exits + handler_exits
+
+
+# -- reaching definitions ----------------------------------------------
+
+
+class Def:
+    """One definition site of a local name."""
+
+    __slots__ = ('name', 'node', 'value', 'index')
+
+    def __init__(self, name: str, node: Optional[Node], value,
+                 index: int) -> None:
+        self.name = name
+        self.node = node          # None for parameter defs (entry)
+        self.value = value        # ast.expr | UNKNOWN
+        self.index = index        # stable id within the function
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f'<Def {self.name}#{self.index}>'
+
+
+def _assign_pairs(target: ast.expr, value) -> Iterable[Tuple[str, object]]:
+    """(name, value_expr|UNKNOWN) pairs defined by one assign target."""
+    if isinstance(target, ast.Name):
+        yield target.id, value
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        elts = target.elts
+        velts = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                 and len(value.elts) == len(elts) else None)
+        for i, elt in enumerate(elts):
+            if isinstance(elt, ast.Starred):
+                elt = elt.value
+            sub = velts[i] if velts is not None else UNKNOWN
+            yield from _assign_pairs(elt, sub)
+    # Attribute/Subscript targets are not local defs.
+
+
+def node_defs(node: Node) -> List[Tuple[str, object]]:
+    """Local (name, value) definitions a CFG node generates. AugAssign
+    is reported with the whole statement as value so taint evaluators
+    can treat it as a pass-through of the old value and the operand."""
+    stmt = node.stmt
+    out: List[Tuple[str, object]] = []
+    if stmt is None:
+        return out
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            out.extend(_assign_pairs(target, stmt.value))
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        out.extend(_assign_pairs(stmt.target, stmt.value))
+    elif isinstance(stmt, ast.AugAssign):
+        if isinstance(stmt.target, ast.Name):
+            out.append((stmt.target.id, stmt))
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        out.extend(_assign_pairs(stmt.target, UNKNOWN))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out.extend(_assign_pairs(item.optional_vars, UNKNOWN))
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            out.append(((alias.asname or alias.name.split('.')[0]),
+                        UNKNOWN))
+    # Walrus targets anywhere in the statement's expressions.
+    if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.NamedExpr) and isinstance(
+                    sub.target, ast.Name):
+                out.append((sub.target.id, sub.value))
+    return out
+
+
+class ReachingDefs:
+    """Reaching definitions over one CFG.
+
+    ``at(node)`` returns the IN map ``{name: {Def, ...}}`` for the
+    node; names never defined locally (true globals) are absent.
+    """
+
+    def __init__(self, cfg: CFG) -> None:
+        self.cfg = cfg
+        self.defs: List[Def] = []
+        self._gen: Dict[int, List[Def]] = {}
+        counter = 0
+        param_defs: List[Def] = []
+        args = getattr(cfg.fn, 'args', None)
+        if args is not None:
+            names = [a.arg for a in (args.posonlyargs + args.args
+                                     + args.kwonlyargs)]
+            if args.vararg:
+                names.append(args.vararg.arg)
+            if args.kwarg:
+                names.append(args.kwarg.arg)
+            for name in names:
+                d = Def(name, None, UNKNOWN, counter)
+                counter += 1
+                param_defs.append(d)
+                self.defs.append(d)
+        self._gen[id(cfg.entry)] = param_defs
+        for node in cfg.nodes:
+            if node.stmt is None:
+                continue
+            gen = []
+            for name, value in node_defs(node):
+                d = Def(name, node, value, counter)
+                counter += 1
+                gen.append(d)
+                self.defs.append(d)
+            if gen:
+                self._gen[id(node)] = gen
+        self.local_names: Set[str] = {d.name for d in self.defs}
+        self._in: Dict[int, Dict[str, Set[Def]]] = {}
+        self._solve()
+
+    def _solve(self) -> None:
+        out: Dict[int, Dict[str, Set[Def]]] = {}
+        worklist = list(self.cfg.nodes)
+        while worklist:
+            node = worklist.pop()
+            in_map: Dict[str, Set[Def]] = {}
+            for pred, _ in node.preds:
+                for name, defs in out.get(id(pred), {}).items():
+                    in_map.setdefault(name, set()).update(defs)
+            self._in[id(node)] = in_map
+            new_out = {name: set(defs) for name, defs in in_map.items()}
+            for d in self._gen.get(id(node), []):
+                new_out[d.name] = {d}
+            # AugAssign / multi-def nodes: later defs of the same name
+            # in one node overwrite earlier ones (handled by dict).
+            if new_out != out.get(id(node)):
+                out[id(node)] = new_out
+                for succ, _ in node.succs:
+                    worklist.append(succ)
+
+    def at(self, node: Node) -> Dict[str, Set[Def]]:
+        return self._in.get(id(node), {})
+
+
+# -- generic forward engine --------------------------------------------
+
+
+def forward(cfg: CFG,
+            init,
+            transfer: Callable[[Node, object], Tuple[object, object]],
+            merge: Callable[[object, object], object]
+            ) -> Dict[int, object]:
+    """Forward dataflow to fixpoint.
+
+    ``transfer(node, in_state) -> (out_normal, out_exc)`` — the second
+    element flows along ``exc`` edges (letting passes send the
+    PRE-state of a partially-executed statement down its exception
+    edge when that is the right semantics). States must support ``==``.
+    Returns ``{id(node): in_state}``.
+    """
+    in_states: Dict[int, object] = {id(cfg.entry): init}
+    out_states: Dict[int, Tuple[object, object]] = {}
+    worklist: List[Node] = [cfg.entry]
+    iterations = 0
+    limit = 50 * max(1, len(cfg.nodes)) * max(1, len(cfg.nodes))
+    while worklist and iterations < limit:
+        iterations += 1
+        node = worklist.pop()
+        state = in_states.get(id(node))
+        if state is None:
+            continue
+        outs = transfer(node, state)
+        if outs == out_states.get(id(node)):
+            continue
+        out_states[id(node)] = outs
+        out_normal, out_exc = outs
+        for succ, kind in node.succs:
+            flowing = out_exc if kind == EXC else out_normal
+            prev = in_states.get(id(succ))
+            merged = flowing if prev is None else merge(prev, flowing)
+            if merged != prev:
+                in_states[id(succ)] = merged
+                worklist.append(succ)
+    return in_states
+
+
+def statement_nodes(cfg: CFG) -> List[Node]:
+    return [n for n in cfg.nodes if n.stmt is not None]
+
+
+def owned_exprs(stmt: ast.stmt) -> List[ast.expr]:
+    """Expressions evaluated AT a CFG node. Compound statements own
+    only their header expressions — their bodies are separate nodes —
+    so passes that attribute expression facts to nodes must walk these
+    instead of ``ast.walk(stmt)``."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return []
+    return [child for child in ast.iter_child_nodes(stmt)
+            if isinstance(child, ast.expr)]
+
+
+def owned_calls(stmt: ast.stmt) -> List[ast.Call]:
+    """Call expressions evaluated at a CFG node (see owned_exprs)."""
+    out: List[ast.Call] = []
+    for expr in owned_exprs(stmt):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                out.append(node)
+    return out
+
+
+def functions_of(tree: ast.Module):
+    """Yield (class_name_or_None, function_node) for every def in the
+    module, including methods and nested defs."""
+    def visit(body, class_name):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                yield from visit(node.body, node.name)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                yield class_name, node
+                yield from visit(node.body, class_name)
+    yield from visit(tree.body, None)

@@ -1,0 +1,461 @@
+"""Dynamic race detection: Eraser-style locksets + a deadlock watchdog.
+
+The static passes (SKYT006 lock-order cycles, SKYT012 shared-state
+locksets) reason about locks and state the AST can NAME. This module
+covers the rest at runtime, behind the ``SKYT_LINT_DYNAMIC`` knob:
+
+* **Lockset tracking** (Eraser, Savage et al. 1997): ``instrument()``
+  patches ``threading.Lock``/``RLock`` factories so locks created in
+  the instrumented window record, per thread, the set currently held.
+  Objects registered with :func:`watch` get their attribute WRITES
+  intercepted; each (object, attribute) keeps a candidate lockset
+  ``C(v)`` — intersected with the writer's held set on every access.
+  Once a second thread writes with ``C(v)`` empty, the pair is
+  reported as a candidate race with both stacks.
+* **Wait-for-graph deadlock watchdog**: instrumented locks also
+  record who HOLDS and who WAITS; a daemon thread rebuilds the
+  thread→lock→thread graph on a short cadence and reports any cycle
+  that persists across two consecutive scans (one scan can witness a
+  transient hand-off). This complements static SKYT006: the watchdog
+  sees locks acquired through call chains and dynamic containers that
+  lexical ``with``-nesting analysis cannot.
+
+Reports accumulate in-process and are written as JSON at
+:func:`write_report` (the pytest plugin in tests/conftest.py calls it
+at session end; plain processes can ``atexit`` it). Schema::
+
+    {"schema": "skylint-dynamic/v1",
+     "races":     [{"object", "attribute", "threads", "stacks"}],
+     "deadlocks": [{"cycle": [{"thread", "waiting_for", "holding"}]}]}
+
+Enabling: ``SKYT_LINT_DYNAMIC=1`` turns instrumentation on;
+a path-looking value (contains a separator or ends in ``.json``)
+additionally chooses the report destination. The pytest plugin rides
+the existing ``chaos`` marker, so tier-1 fault-injection runs double
+as race hunts with zero new test surface — and a clean run must stay
+silent: only locks created inside, and objects watched inside, the
+instrumented window are observed.
+
+Everything here is stdlib-only and off by default; production code
+never imports this module (the knob is read by the test plugin).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+KNOB = 'SKYT_LINT_DYNAMIC'
+SCHEMA = 'skylint-dynamic/v1'
+
+_DEFAULT_REPORT = 'skylint_dynamic_report.json'
+
+
+def enabled() -> bool:
+    value = os.environ.get(KNOB, '')
+    return bool(value) and value.lower() not in ('0', 'false', 'no')
+
+
+def report_path() -> str:
+    value = os.environ.get(KNOB, '')
+    if os.sep in value or value.endswith('.json'):
+        return value
+    state_dir = os.environ.get('SKYT_STATE_DIR',
+                               os.path.expanduser('~/.skyt'))
+    return os.path.join(state_dir, _DEFAULT_REPORT)
+
+
+# -- registry -----------------------------------------------------------
+
+_registry_lock = threading.Lock()
+_held: Dict[int, List['TrackedLock']] = {}       # thread id -> locks
+_waiting: Dict[int, 'TrackedLock'] = {}          # thread id -> lock
+_races: List[Dict[str, Any]] = []
+_deadlocks: List[Dict[str, Any]] = []
+_race_keys: Set[Tuple[int, str]] = set()
+_deadlock_keys: Set[frozenset] = set()
+
+
+def _thread_held(ident: Optional[int] = None) -> List['TrackedLock']:
+    ident = threading.get_ident() if ident is None else ident
+    with _registry_lock:
+        return list(_held.get(ident, ()))
+
+
+class TrackedLock:
+    """A Lock/RLock wrapper recording holders and waiters.
+
+    Delegates the full lock protocol (including the private methods
+    ``Condition`` probes for) to the real lock, so instrumented locks
+    keep working inside Conditions/Events created in the window.
+    """
+
+    _seq = [0]
+
+    def __init__(self, real) -> None:
+        self._real = real
+        with _registry_lock:
+            TrackedLock._seq[0] += 1
+            self.lock_id = TrackedLock._seq[0]
+        self.name = f'lock#{self.lock_id}'
+        self._owners: List[int] = []    # thread idents (RLock: dups)
+
+    # -- protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ident = threading.get_ident()
+        if blocking:
+            with _registry_lock:
+                _waiting[ident] = self
+        try:
+            got = self._real.acquire(blocking, timeout)
+        finally:
+            if blocking:
+                with _registry_lock:
+                    _waiting.pop(ident, None)
+        if got:
+            with _registry_lock:
+                self._owners.append(ident)
+                _held.setdefault(ident, []).append(self)
+        return got
+
+    def release(self) -> None:
+        ident = threading.get_ident()
+        self._real.release()
+        with _registry_lock:
+            if ident in self._owners:
+                self._owners.remove(ident)
+            held = _held.get(ident)
+            if held and self in held:
+                held.remove(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def owners(self) -> List[int]:
+        with _registry_lock:
+            return list(self._owners)
+
+    # Condition() adopts these when present on its lock; fall back to
+    # plain acquire/release when the wrapped lock is not an RLock
+    # (CPython's Condition does the same via AttributeError).
+    def _acquire_restore(self, state):   # pragma: no cover - glue
+        try:
+            return self._real._acquire_restore(state)
+        except AttributeError:
+            self._real.acquire()
+            return None
+
+    def _release_save(self):             # pragma: no cover - glue
+        try:
+            return self._real._release_save()
+        except AttributeError:
+            self._real.release()
+            return None
+
+    def _is_owned(self):                 # pragma: no cover - RLock glue
+        try:
+            return self._real._is_owned()
+        except AttributeError:
+            if self._real.acquire(False):
+                self._real.release()
+                return False
+            return True
+
+    def __repr__(self) -> str:
+        return f'<TrackedLock {self.name}>'
+
+
+# -- instrumentation window ---------------------------------------------
+
+_real_lock = None
+_real_rlock = None
+_instrumented = False
+
+
+def instrument() -> None:
+    """Patch threading.Lock/RLock factories; idempotent."""
+    global _real_lock, _real_rlock, _instrumented
+    if _instrumented:
+        return
+    _real_lock = threading.Lock
+    _real_rlock = threading.RLock
+
+    def make_lock():
+        return TrackedLock(_real_lock())
+
+    def make_rlock():
+        return TrackedLock(_real_rlock())
+
+    threading.Lock = make_lock          # type: ignore[assignment]
+    threading.RLock = make_rlock        # type: ignore[assignment]
+    _instrumented = True
+    _watchdog_start()
+
+
+def restore() -> None:
+    """Undo instrument(); existing TrackedLocks keep functioning."""
+    global _instrumented
+    if not _instrumented:
+        return
+    threading.Lock = _real_lock         # type: ignore[assignment]
+    threading.RLock = _real_rlock       # type: ignore[assignment]
+    _instrumented = False
+    _watchdog_stop()
+
+
+class instrumented:
+    """Context manager form: ``with dynamic.instrumented(): ...``."""
+
+    def __enter__(self):
+        instrument()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        restore()
+        return False
+
+
+# -- Eraser lockset state machine ---------------------------------------
+
+_VIRGIN, _EXCLUSIVE, _SHARED_MOD = 'virgin', 'exclusive', 'shared-mod'
+
+
+class _AttrState:
+    __slots__ = ('state', 'first_thread', 'lockset', 'threads')
+
+    def __init__(self) -> None:
+        self.state = _VIRGIN
+        self.first_thread: Optional[int] = None
+        self.lockset: Optional[Set[int]] = None   # candidate C(v)
+        self.threads: Set[int] = set()
+
+
+_watched: Dict[int, Dict[str, _AttrState]] = {}
+_watched_names: Dict[int, str] = {}
+
+
+def note_write(obj: Any, attr: str) -> None:
+    """Record one write to ``obj.attr`` by the current thread; report
+    a race when the candidate lockset empties under a second thread."""
+    ident = threading.get_ident()
+    held_ids = {lock.lock_id for lock in _thread_held(ident)}
+    key = id(obj)
+    with _registry_lock:
+        attrs = _watched.get(key)
+        if attrs is None:
+            return
+        st = attrs.setdefault(attr, _AttrState())
+        st.threads.add(ident)
+        if st.state == _VIRGIN:
+            st.state = _EXCLUSIVE
+            st.first_thread = ident
+            st.lockset = set(held_ids)
+            return
+        if st.state == _EXCLUSIVE and ident == st.first_thread:
+            st.lockset &= held_ids
+            return
+        st.state = _SHARED_MOD
+        st.lockset = (set(held_ids) if st.lockset is None
+                      else st.lockset & held_ids)
+        if st.lockset:
+            return
+        race_key = (key, attr)
+        if race_key in _race_keys:
+            return
+        _race_keys.add(race_key)
+        _races.append({
+            'object': _watched_names.get(key, f'obj@{key:#x}'),
+            'attribute': attr,
+            'threads': sorted(st.threads),
+            'stacks': [''.join(traceback.format_stack(limit=8))],
+        })
+
+
+class _Watched:
+    """Subclass template whose __setattr__ reports to note_write."""
+
+    def __setattr__(self, name, value):
+        note_write(self, name)
+        super().__setattr__(name, value)
+
+
+def watch(obj: Any, name: Optional[str] = None) -> Any:
+    """Track attribute writes on ``obj`` (Eraser candidate locksets).
+
+    Swaps the instance's class for a generated subclass overriding
+    ``__setattr__`` — no proxy, so identity and isinstance stay
+    intact. Returns ``obj``. Objects with ``__slots__``-only classes
+    or C types are rejected (their class cannot be swapped)."""
+    cls = type(obj)
+    sub = type(f'Tracked{cls.__name__}', (_Watched, cls), {})
+    with _registry_lock:
+        _watched[id(obj)] = {}
+        _watched_names[id(obj)] = name or f'{cls.__name__}@{id(obj):#x}'
+    obj.__class__ = sub
+    return obj
+
+
+# -- wait-for-graph deadlock watchdog ------------------------------------
+
+_watchdog_thread: Optional[threading.Thread] = None
+_watchdog_stop_event: Optional[threading.Event] = None
+WATCHDOG_INTERVAL = 0.05
+
+
+def _wait_graph() -> Dict[int, Tuple['TrackedLock', List[int]]]:
+    """thread -> (lock it waits for, that lock's owners)."""
+    with _registry_lock:
+        waiting = dict(_waiting)
+    return {ident: (lock, lock.owners())
+            for ident, lock in waiting.items()}
+
+
+def _find_cycle() -> Optional[List[Tuple[int, 'TrackedLock']]]:
+    graph = _wait_graph()
+    for start in graph:
+        path: List[Tuple[int, TrackedLock]] = []
+        seen: Set[int] = set()
+        node = start
+        while node in graph and node not in seen:
+            seen.add(node)
+            lock, owners = graph[node]
+            path.append((node, lock))
+            # Follow any owner that is itself waiting.
+            nxt = next((o for o in owners if o in graph), None)
+            if nxt is None:
+                break
+            node = nxt
+            if node == start:
+                return path
+    return None
+
+
+def _watchdog_loop(stop: threading.Event) -> None:
+    pending: Optional[frozenset] = None
+    while not stop.wait(WATCHDOG_INTERVAL):
+        cycle = _find_cycle()
+        if not cycle:
+            pending = None
+            continue
+        key = frozenset(ident for ident, _ in cycle)
+        if pending != key:
+            pending = key      # must persist across two scans
+            continue
+        with _registry_lock:
+            if key in _deadlock_keys:
+                continue
+            _deadlock_keys.add(key)
+            names = {t.ident: t.name for t in threading.enumerate()}
+            _deadlocks.append({
+                'cycle': [{
+                    'thread': names.get(ident, str(ident)),
+                    'waiting_for': lock.name,
+                    'holding': [l.name for l in _held.get(ident, ())],
+                } for ident, lock in cycle],
+            })
+
+
+def _watchdog_start() -> None:
+    global _watchdog_thread, _watchdog_stop_event
+    if _watchdog_thread is not None and _watchdog_thread.is_alive():
+        return
+    _watchdog_stop_event = threading.Event()
+    _watchdog_thread = threading.Thread(
+        target=_watchdog_loop, args=(_watchdog_stop_event,),
+        name='skylint-deadlock-watchdog', daemon=True)
+    _watchdog_thread.start()
+
+
+def _watchdog_stop() -> None:
+    global _watchdog_thread
+    if _watchdog_stop_event is not None:
+        _watchdog_stop_event.set()
+    if _watchdog_thread is not None:
+        _watchdog_thread.join(timeout=1.0)
+    _watchdog_thread = None
+
+
+# -- reporting -----------------------------------------------------------
+
+
+def report() -> Dict[str, Any]:
+    with _registry_lock:
+        return {
+            'schema': SCHEMA,
+            'races': list(_races),
+            'deadlocks': list(_deadlocks),
+        }
+
+
+def write_report(path: Optional[str] = None) -> Optional[str]:
+    """Write the JSON report; returns the path, or None when there is
+    nothing to report (no file is created for a clean run)."""
+    data = report()
+    if not data['races'] and not data['deadlocks']:
+        return None
+    path = path or report_path()
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(data, f, indent=2)
+        f.write('\n')
+    return path
+
+
+def register_atexit() -> None:
+    atexit.register(write_report)
+
+
+def snapshot() -> Dict[str, Any]:
+    """Capture the full detector state so a test can isolate itself
+    WITHOUT erasing findings (or live lock bookkeeping of still-running
+    threads) accumulated earlier in the session — the session-end
+    report must survive the detector's own test suite running."""
+    with _registry_lock:
+        return {
+            'races': list(_races),
+            'deadlocks': list(_deadlocks),
+            'race_keys': set(_race_keys),
+            'deadlock_keys': set(_deadlock_keys),
+            'watched': dict(_watched),
+            'watched_names': dict(_watched_names),
+            'held': {k: list(v) for k, v in _held.items()},
+            'waiting': dict(_waiting),
+        }
+
+
+def restore_snapshot(snap: Dict[str, Any]) -> None:
+    with _registry_lock:
+        _races[:] = snap['races']
+        _deadlocks[:] = snap['deadlocks']
+        _race_keys.clear()
+        _race_keys.update(snap['race_keys'])
+        _deadlock_keys.clear()
+        _deadlock_keys.update(snap['deadlock_keys'])
+        _watched.clear()
+        _watched.update(snap['watched'])
+        _watched_names.clear()
+        _watched_names.update(snap['watched_names'])
+        _held.clear()
+        _held.update({k: list(v) for k, v in snap['held'].items()})
+        _waiting.clear()
+        _waiting.update(snap['waiting'])
+
+
+def reset_for_tests() -> None:
+    restore_snapshot({
+        'races': [], 'deadlocks': [], 'race_keys': set(),
+        'deadlock_keys': set(), 'watched': {}, 'watched_names': {},
+        'held': {}, 'waiting': {},
+    })

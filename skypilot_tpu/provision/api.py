@@ -151,12 +151,12 @@ class Provider(abc.ABC):
                        timeout: float = 600) -> None:
         """Default: poll query_instances until all hosts reach `state`."""
         import time
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             states = self.query_instances(cluster_name)
             if states and all(s == state for s in states.values()):
                 return
-            time.sleep(min(2, max(0.01, deadline - time.time())))
+            time.sleep(min(2, max(0.01, deadline - time.monotonic())))
         raise TimeoutError(
             f'{cluster_name}: instances did not reach {state!r} in '
             f'{timeout}s: {self.query_instances(cluster_name)}')

@@ -390,8 +390,8 @@ class AzureProvider(Provider):
 
     def _wait_provisioned(self, cluster: str, num_nodes: int,
                           timeout: float = 900.0) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             vms = self._list_vms(cluster)
             states = [vm.get('properties', {}).get('provisioningState')
                       for vm in vms]

@@ -352,7 +352,7 @@ class GcpTpuProvider(Provider):
                                timeout: float) -> None:
         """Poll until every slice is ACTIVE (parity: queued-resource wait,
         instance_utils.py:1491)."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         tpu = request.resources.tpu
         names = [
             self._qr_name(request.cluster_name, n, s)
@@ -360,7 +360,7 @@ class GcpTpuProvider(Provider):
             for s in range(tpu.num_slices)
         ]
         interval = 5.0
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             states = {}
             for name in names:
                 resp = self._request(

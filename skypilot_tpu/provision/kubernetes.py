@@ -461,12 +461,16 @@ class _FakeStore:
         return self._data
 
     def __exit__(self, exc_type, *args) -> None:
-        if exc_type is None:
-            tmp = self._path + '.tmp'
-            with open(tmp, 'w', encoding='utf-8') as f:
-                json.dump(self._data, f)
-            os.replace(tmp, self._path)
-        self._lock.release()
+        # release() in a finally: a failed flush must not keep the
+        # file lock held forever for every other process.
+        try:
+            if exc_type is None:
+                tmp = self._path + '.tmp'
+                with open(tmp, 'w', encoding='utf-8') as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self._path)
+        finally:
+            self._lock.release()
 
 
 def fake_inject_unschedulable(selector_value: str, count: int = -1) -> None:
@@ -646,7 +650,7 @@ class KubernetesProvider(Provider):
     def _wait_pods_running(self,
                            request: ProvisionRequest) -> ClusterInfo:
         timeout = _provision_timeout()
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         selector = self._selector(request.cluster_name)
         while True:
             pods = self.api.list_pods(self.namespace, selector)
@@ -656,13 +660,13 @@ class KubernetesProvider(Provider):
             for pod in pods:
                 for cond in pod.get('status', {}).get('conditions', []):
                     if cond.get('reason') == 'Unschedulable':
-                        if time.time() > deadline:
+                        if time.monotonic() > deadline:
                             self._cleanup(request.cluster_name)
                             raise exceptions.CapacityError(
                                 f'{request.cluster_name}: TPU pods '
                                 'unschedulable (no node pool capacity '
                                 f'for {pod["spec"].get("nodeSelector")})')
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 self._cleanup(request.cluster_name)
                 raise exceptions.ProvisionError(
                     f'{request.cluster_name}: pods not Running after '

@@ -416,10 +416,10 @@ class OciProvider(Provider):
         wait only succeeds once at least that many instances are
         visible AND in the target state — never on a subset."""
         import time
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         region = region_hint or self._region_of(cluster_name)
         states: Dict[str, str] = {}
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             states = {
                 inst['id']: self._STATE_MAP.get(
                     inst['lifecycleState'],
@@ -429,7 +429,7 @@ class OciProvider(Provider):
                     (expected is None or len(states) >= expected) and
                     all(s == state for s in states.values())):
                 return
-            time.sleep(min(2, max(0.01, deadline - time.time())))
+            time.sleep(min(2, max(0.01, deadline - time.monotonic())))
         raise TimeoutError(
             f'{cluster_name}: OCI instances did not reach {state!r} '
             f'in {timeout}s'

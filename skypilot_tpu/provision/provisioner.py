@@ -98,7 +98,7 @@ def provision_with_failover(
         logger.info('Provisioning %s on %s (%s)...', cluster_name, where,
                     res)
         state.add_cluster_event(cluster_name, 'PROVISION_ATTEMPT', where)
-        attempt_start = time.time()
+        attempt_start = time.monotonic()
         try:
             info = provider.run_instances(request)
             provider.wait_instances(cluster_name, 'running')
@@ -107,7 +107,7 @@ def provision_with_failover(
             # skyt_provision_seconds histogram (the BASELINE p50
             # orchestration metric) from these events.
             state.add_cluster_event(cluster_name, 'PROVISION_DONE',
-                                    f'{time.time() - attempt_start:.3f}')
+                                    f'{time.monotonic() - attempt_start:.3f}')
             return info, candidate
         except exceptions.ProvisionError as e:
             logger.warning('Provision failed on %s: %s', where, e)

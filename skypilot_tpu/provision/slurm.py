@@ -159,8 +159,8 @@ class SlurmProvider(Provider):
 
     def _wait_allocation(self, request: ProvisionRequest,
                          timeout: float = 600) -> ClusterInfo:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             job = self._squeue(request.cluster_name)
             if job is None:
                 # _squeue only reports ACTIVE jobs: gone means rejected,

@@ -92,12 +92,16 @@ class _Allocations:
         return self._data
 
     def __exit__(self, exc_type, *args) -> None:
-        if exc_type is None:
-            tmp = self._path + '.tmp'
-            with open(tmp, 'w', encoding='utf-8') as f:
-                json.dump(self._data, f)
-            os.replace(tmp, self._path)
-        self._lock.release()
+        # release() in a finally: a failed flush must not keep the
+        # file lock held forever for every other process.
+        try:
+            if exc_type is None:
+                tmp = self._path + '.tmp'
+                with open(tmp, 'w', encoding='utf-8') as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self._path)
+        finally:
+            self._lock.release()
 
 
 @CLOUD_REGISTRY.register('ssh')

@@ -140,7 +140,9 @@ class JobSupervisor:
         self.uses_tpu = uses_tpu
         self.failure_message: Optional[str] = None
         self._gang_started = False
-        self._start_deadline = (time.time() + start_deadline
+        # Monotonic: an NTP step mid-spawn must not shrink (or stretch)
+        # the gang-start budget.
+        self._start_deadline = (time.monotonic() + start_deadline
                                 if start_deadline else None)
 
     def poll(self) -> Optional[int]:
@@ -151,7 +153,7 @@ class JobSupervisor:
             if not missing:
                 self._gang_started = True
             elif (self._start_deadline is not None
-                  and time.time() > self._start_deadline):
+                  and time.monotonic() > self._start_deadline):
                 self.failure_message = (
                     f'rank(s) {missing} never started (no remote '
                     f'liveness within the gang-start deadline); '

@@ -227,10 +227,10 @@ class ServeController:
         for record in serve_state.list_replicas(self.service_name,
                                                 include_terminal=False):
             self.manager.scale_down(record.replica_id)
-        deadline = time.time() + 300
+        deadline = time.monotonic() + 300
         remaining = serve_state.list_replicas(self.service_name,
                                               include_terminal=False)
-        while remaining and time.time() < deadline:
+        while remaining and time.monotonic() < deadline:
             time.sleep(min(POLL_SECONDS, 1.0))
             remaining = serve_state.list_replicas(self.service_name,
                                                   include_terminal=False)

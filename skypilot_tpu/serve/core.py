@@ -352,8 +352,8 @@ def down(service_name: str, purge: bool = False) -> None:
             # if the row persists the controller is gone/stuck and we
             # take over the teardown.
             poll = env_registry.get_float('SKYT_SERVE_CONTROLLER_POLL')
-            deadline = time.time() + 2 * poll + 5
-            while time.time() < deadline:
+            deadline = time.monotonic() + 2 * poll + 5
+            while time.monotonic() < deadline:
                 if serve_state.get_service(service_name) is None:
                     return
                 time.sleep(min(max(poll / 4, 0.1), 1.0))
@@ -392,8 +392,8 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
 
 def wait_ready(service_name: str, timeout: float = 300.0) -> Dict[str, Any]:
     """Block until the service is READY (helper for tests/CLI --wait)."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         record = serve_state.get_service(service_name)
         if record is None:
             raise exceptions.ServiceNotFoundError(
@@ -411,8 +411,8 @@ def wait_ready(service_name: str, timeout: float = 300.0) -> Dict[str, Any]:
 
 def wait_gone(service_name: str, timeout: float = 120.0) -> None:
     """Block until the service record is removed (post-`down` helper)."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         if serve_state.get_service(service_name) is None:
             return
         time.sleep(0.5)

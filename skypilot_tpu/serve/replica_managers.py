@@ -179,11 +179,11 @@ class ReplicaManager:
 
     def join(self, timeout: float = 120.0) -> None:
         """Wait for in-flight launch threads (used on shutdown)."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._lock:
             threads = list(self._threads.values())
         for thread in threads:
-            thread.join(max(0.0, deadline - time.time()))
+            thread.join(max(0.0, deadline - time.monotonic()))
 
     # -- internals -----------------------------------------------------
 

@@ -275,8 +275,12 @@ def add_service(name: str, spec: Dict[str, Any],
         events.publish(events.SERVE, conn=conn)
         return True
     except sqlite3.IntegrityError:
+        # The failed INSERT opened a write transaction; without the
+        # rollback it holds the DB write lock for this thread's life.
+        conn.rollback()
         return False
     except pg.PgError as e:
+        conn.rollback()
         # 23505 = unique_violation; fake_pg surfaces sqlite's message.
         if e.code == '23505' or 'UNIQUE constraint' in str(e):
             return False

@@ -830,7 +830,7 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
         query = self._query
         request_id = query.get('request_id', '')
         timeout = min(float(query.get('timeout', 15)), 30.0)
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         signal = _requests_signal()
         cursor = events.cursor(events.REQUESTS)
         while True:
@@ -847,7 +847,7 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                             f'no view access to workspace '
                             f'{request.workspace!r}')
                 return
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if request.status.is_terminal() or remaining <= 0:
                 self._reply(request.to_dict())
                 return

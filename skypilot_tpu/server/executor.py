@@ -135,7 +135,7 @@ def _run_request_in_child(request_id: str,
     from skypilot_tpu.server import payloads
     from skypilot_tpu.utils import usage
     fn, _ = payloads.PAYLOADS[request.name]
-    started = time.time()
+    started = time.monotonic()
     try:
         result = fn(**request.body)
         try:
@@ -145,14 +145,14 @@ def _run_request_in_child(request_id: str,
         requests_db.finalize(request_id, RequestStatus.SUCCEEDED, result,
                              owner=server_id)
         usage.record(f'request.{request.name}',
-                     duration_s=time.time() - started)
+                     duration_s=time.monotonic() - started)
     except BaseException as e:  # pylint: disable=broad-except
         traceback.print_exc()
         requests_db.finalize(request_id, RequestStatus.FAILED,
                              error=f'{type(e).__name__}: {e}',
                              owner=server_id)
         usage.record(f'request.{request.name}', outcome='failed',
-                     duration_s=time.time() - started)
+                     duration_s=time.monotonic() - started)
     finally:
         # The child exits via os._exit (no atexit): flush any buffered
         # timeline spans explicitly or they are lost.
@@ -306,6 +306,8 @@ class Executor:
             self._caps.update(workers)
         self._runners: Dict[ScheduleType, List[subprocess.Popen]] = {
             t: [] for t in ScheduleType}
+        # First-seen stamps below are time.monotonic(): they only feed
+        # grace-window arithmetic, never persistence.
         self._dead_pids: Dict[int, float] = {}  # request pid -> first-seen
         self._pidless: Dict[str, float] = {}    # RUNNING w/o pid -> seen
         self._term_sent: Dict[str, float] = {}  # cancelled req -> TERM ts
@@ -344,11 +346,11 @@ class Executor:
             for proc in pool:
                 if proc.poll() is None:
                     kill_process_tree(proc.pid, signal.SIGTERM)
-        deadline = time.time() + 5
+        deadline = time.monotonic() + 5
         for pool in self._runners.values():
             for proc in pool:
                 try:
-                    proc.wait(timeout=max(0.1, deadline - time.time()))
+                    proc.wait(timeout=max(0.1, deadline - time.monotonic()))
                 except subprocess.TimeoutExpired:
                     kill_process_tree(proc.pid, signal.SIGKILL)
 
@@ -391,7 +393,7 @@ class Executor:
                                                    wake_signal)
                 try:
                     saw_backlog = self._tick(runner_log)
-                    now = time.time()
+                    now = time.monotonic()
                     if now - last_orphan_scan > 1.0:
                         self._reap_orphans(now)
                         self._kill_cancelled_own(now)
@@ -523,8 +525,12 @@ class Executor:
         request cancelled late is still seen. SIGTERM first; a worker
         still alive 10s after the first signal gets SIGKILL — without
         the escalation, a TERM-masking worker outlives the scan window
-        and runs to completion despite the cancel."""
-        for request in requests_db.cancelled_since(now - 300):
+        and runs to completion despite the cancel.
+
+        ``now`` is monotonic (grace/escalation windows); the DB cutoff
+        below stays on the wall clock — ``finished_at`` is persisted.
+        """
+        for request in requests_db.cancelled_since(time.time() - 300):
             if (request.server_id != self._server_id or
                     not request.pid):
                 continue
@@ -569,8 +575,8 @@ def cancel_request(request_id: str,
     if request.status == RequestStatus.RUNNING and not request.pid:
         # Claimed but the forked child hasn't recorded its pid yet; wait
         # briefly so we kill the work instead of just flipping the status.
-        deadline = time.time() + 2
-        while time.time() < deadline and not request.pid:
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline and not request.pid:
             time.sleep(0.05)
             request = requests_db.get(request_id)
             if request is None or request.status.is_terminal():
@@ -591,8 +597,8 @@ def cancel_request(request_id: str,
     pid = request.pid if request is not None else None
     if pid:
         kill_process_tree(pid, signal.SIGTERM)
-        deadline = time.time() + 5
-        while time.time() < deadline:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
             try:
                 os.kill(pid, 0)
             except ProcessLookupError:

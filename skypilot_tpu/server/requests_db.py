@@ -209,16 +209,17 @@ def create(name: str,
              workspace, time.time()))
         conn.commit()
     except (sqlite3.IntegrityError, pg.PgError) as e:
+        # Roll back FIRST, on every branch — the failed INSERT opened
+        # a write transaction that would otherwise hold the DB write
+        # lock for this thread's lifetime, starving every runner's
+        # claim (the re-raise below used to skip this).
+        conn.rollback()
         if isinstance(e, pg.PgError) and not (
                 e.code == '23505' or 'UNIQUE constraint' in str(e)):
             raise
         # idem_key collision: the earlier attempt reached us (possibly
         # through ANOTHER replica — the shared DB makes client retries
-        # converge on one request). Roll back first — the failed INSERT
-        # opened a write transaction that would otherwise hold the DB
-        # write lock for this thread's lifetime, starving every
-        # runner's claim.
-        conn.rollback()
+        # converge on one request).
         row = conn.execute(
             'SELECT request_id FROM requests WHERE idem_key = ?',
             (idem_key,)).fetchone()
@@ -293,13 +294,16 @@ def claim_next(schedule_type: ScheduleType,
                     conn.commit()
                     request_id = row['request_id'] if row else None
                 except Exception as e:  # pylint: disable=broad-except
+                    # Rollback before ANY exit: a non-OperationalError
+                    # (e.g. a PgError) re-raised here would escape the
+                    # outer handler with the claim transaction open.
+                    conn.rollback()
                     if 'returning' not in str(e).lower():
                         raise
                     # The backend advertised new enough but the SQL
                     # layer under it doesn't parse RETURNING (e.g. an
                     # sqlite-backed Postgres stand-in): remember and
                     # take the portable path from now on.
-                    conn.rollback()
                     _mark_returning_unsupported()
                     request_id = _claim_next_no_returning(
                         conn, schedule_type, server_id)
@@ -544,13 +548,16 @@ def note_db_health(key: str, healthy: bool) -> None:
     if not healthy:
         _db_healthy_since[key] = None
     elif _db_healthy_since.get(key) is None:
-        _db_healthy_since[key] = time.time()
+        # Monotonic: this window is purely in-process duration math —
+        # a wall-clock step must not grant (or revoke) judgment
+        # rights early (the bug class SKYT009 exists to catch).
+        _db_healthy_since[key] = time.monotonic()
 
 
 def db_healthy_window_elapsed(key: str, window: float) -> bool:
     """Has ``key`` seen continuous DB health for a full ``window``?"""
     since = _db_healthy_since.get(key)
-    return since is not None and time.time() - since >= window
+    return since is not None and time.monotonic() - since >= window
 
 
 def requeue_dead_server_requests(own_server_id: str,

@@ -61,6 +61,10 @@ def _sign(payload: str) -> str:
 
 
 def mint(user_name: str, ttl_seconds: float = DEFAULT_TTL_SECONDS) -> str:
+    # Deliberately WALL clock (skylint SKYT009's persisted-timestamp
+    # exemption): the absolute expiry is embedded in the cookie and
+    # verified by whichever replica/process sees it next — a
+    # monotonic reading is meaningless across processes.
     expiry = int(time.time() + ttl_seconds)
     payload = f'{user_name}|{expiry}'
     return f'{payload}|{_sign(payload)}'

@@ -116,6 +116,9 @@ def create_user(name: str, role: str = ROLE_USER) -> UserRecord:
             'INSERT INTO users (name, role, created_at) VALUES (?, ?, ?)',
             (name, role, now))
     except sqlite3.IntegrityError as e:
+        # The failed INSERT opened a write transaction on this
+        # thread's connection; release the write lock before raising.
+        conn.rollback()
         raise ValueError(f'user {name!r} already exists') from e
     conn.commit()
     return UserRecord(name=name, role=role, created_at=now)
@@ -143,6 +146,9 @@ def set_role(name: str, role: str) -> None:
     cur = conn.execute('UPDATE users SET role = ? WHERE name = ?',
                        (role, name))
     if cur.rowcount == 0:
+        # The no-op UPDATE still opened a transaction — close it
+        # before raising or the write lock outlives the call.
+        conn.rollback()
         raise ValueError(f'no user {name!r}')
     conn.commit()
 

@@ -82,6 +82,11 @@ DECLARATIONS: List[EnvVar] = _decl([
      'Deterministic fault-injection spec '
      '(site:Exception[:p=..][:seed=..][:times=..], comma-separated; '
      'docs/fault_tolerance.md).'),
+    ('SKYT_LINT_DYNAMIC', 'str', None,
+     'Enable the dynamic lockset race detector + deadlock watchdog '
+     '(skypilot_tpu/lint/dynamic.py) on chaos-marked tests; a '
+     'path-like value also sets the JSON report destination '
+     '(docs/static_analysis.md).'),
 
     # -- notification bus -------------------------------------------
     ('SKYT_EVENTS_DISABLED', 'bool', False,

@@ -72,7 +72,7 @@ class _PostgresLockBackend:
         # and the abandoned session would later be GRANTED the lock
         # server-side with nobody using it — a cross-replica deadlock.
         deadline = (None if self._timeout is None
-                    else time.time() + self._timeout)
+                    else time.monotonic() + self._timeout)
         while True:
             row = self._conn.execute(
                 f'SELECT pg_try_advisory_lock({self._key}) AS ok'
@@ -81,7 +81,7 @@ class _PostgresLockBackend:
             if value is True or value == 't':
                 self._held = True
                 return
-            if deadline is not None and time.time() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 raise LockTimeout(
                     f'advisory lock {self._name!r} not acquired within '
                     f'{self._timeout}s')

@@ -52,8 +52,8 @@ def main(argv=None) -> int:
                 os.kill(pid, sig)
             except (ProcessLookupError, PermissionError):
                 pass
-        deadline = time.time() + 5
-        while time.time() < deadline and any(_alive(p) for p in victims):
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(_alive(p) for p in victims):
             time.sleep(0.1)
         if not any(_alive(p) for p in victims):
             break

@@ -35,20 +35,27 @@ class Event:
         self._name = name
         self._args = args
         self._begin: Optional[float] = None
+        self._begin_mono: Optional[float] = None
 
     def __enter__(self) -> 'Event':
+        # Wall clock for the displayed 'ts' (trace viewers align
+        # processes on it); monotonic for 'dur' so a wall-clock step
+        # mid-span can't stretch or negate the measured duration.
         self._begin = time.time()
+        self._begin_mono = time.monotonic()
         return self
 
     def __exit__(self, *exc) -> None:
         if not enabled() or self._begin is None:
             return
-        end = time.time()
+        dur = time.monotonic() - (self._begin_mono
+                                  if self._begin_mono is not None
+                                  else 0.0)
         record = {
             'name': self._name,
             'ph': 'X',                          # complete event
             'ts': self._begin * 1e6,            # microseconds
-            'dur': (end - self._begin) * 1e6,
+            'dur': dur * 1e6,
             'pid': os.getpid(),
             'tid': threading.get_ident() % 1_000_000,
         }

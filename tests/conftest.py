@@ -116,6 +116,37 @@ def pytest_sessionfinish(session, exitstatus):
     n = _reap_marked()
     if n:
         print(f'\n[conftest] reaped {n} leftover test processes')
+    # Dynamic race/deadlock findings accumulated by the SKYT_LINT_DYNAMIC
+    # plugin below land in one JSON report at session end.
+    from skypilot_tpu.lint import dynamic as lint_dynamic
+    if lint_dynamic.enabled():
+        path = lint_dynamic.write_report()
+        if path:
+            print(f'\n[skylint-dynamic] race/deadlock report: {path}')
+
+
+# -- dynamic race detection on chaos tests (skylint, opt-in) -----------
+#
+# With SKYT_LINT_DYNAMIC set, every `chaos`-marked test runs with the
+# Eraser-style lockset detector + deadlock watchdog instrumented
+# (skypilot_tpu/lint/dynamic.py): locks created during the test are
+# tracked, watched objects get per-(object, attribute) candidate
+# locksets, and a wait-for-graph watchdog reports persisting cycles.
+# Fault-injection runs thus double as race hunts — and a clean chaos
+# run must produce an empty report (docs/static_analysis.md).
+
+def pytest_runtest_setup(item):
+    from skypilot_tpu.lint import dynamic as lint_dynamic
+    if (lint_dynamic.enabled()
+            and item.get_closest_marker('chaos') is not None):
+        lint_dynamic.instrument()
+
+
+def pytest_runtest_teardown(item, nextitem):
+    from skypilot_tpu.lint import dynamic as lint_dynamic
+    if (lint_dynamic.enabled()
+            and item.get_closest_marker('chaos') is not None):
+        lint_dynamic.restore()
 
 
 @pytest.fixture()

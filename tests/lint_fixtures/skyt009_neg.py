@@ -1,0 +1,48 @@
+"""SKYT009 negatives: legitimate wall-clock uses that must not flag.
+
+Persisted timestamps, cutoffs compared against DB values, monotonic
+duration math, and values of unknown (parameter/row) provenance.
+"""
+import time
+
+
+def persist_created(conn):
+    # Stored timestamp: wall clock is CORRECT here.
+    conn.execute('INSERT INTO t (created_at) VALUES (?)',
+                 (time.time(),))
+    conn.commit()
+
+
+def stale_cutoff(conn, stale_after):
+    # Wall cutoff compared against persisted wall timestamps: the
+    # other operand is a plain duration, not a second local reading.
+    return conn.execute('SELECT * FROM beats WHERE last_beat >= ?',
+                        (time.time() - stale_after,)).fetchall()
+
+
+def age_of_row(row):
+    # Row timestamp has unknown provenance — comparing wall-now to a
+    # persisted wall stamp is the only cross-process option.
+    return time.time() - row['created_at']
+
+
+def monotonic_deadline(timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        do_work()
+    return time.monotonic() - deadline
+
+
+def mixed_last_activity(started_at, path_mtime):
+    # max() over mixed provenance (persisted + local) stays unflagged.
+    last = max(started_at, time.time(), path_mtime)
+    return time.time() - last
+
+
+def cookie_expiry(ttl_seconds):
+    # Displayed/persisted absolute expiry (crosses processes).
+    return int(time.time() + ttl_seconds)
+
+
+def do_work():
+    pass

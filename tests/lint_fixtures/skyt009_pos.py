@@ -1,0 +1,51 @@
+"""SKYT009 positives: wall-clock readings used as durations/deadlines.
+
+Every function below measures elapsed time or builds a deadline from
+two LOCAL ``time.time()`` readings — the exact math an NTP step breaks.
+"""
+import time
+
+
+def elapsed_simple():
+    start = time.time()
+    do_work()
+    return time.time() - start                       # finding
+
+
+def deadline_loop(timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:                    # finding
+        do_work()
+
+
+def zero_init_loop():
+    last_scan = 0.0
+    while True:
+        now = time.time()
+        if now - last_scan > 1.0:                    # finding
+            do_work()
+            last_scan = now
+
+
+class Supervisor:
+    def __init__(self, budget):
+        self._deadline = time.time() + budget
+
+    def expired(self):
+        return time.time() > self._deadline          # finding
+
+
+_HEALTH_SINCE = {}
+
+
+def note_health(key):
+    _HEALTH_SINCE[key] = time.time()
+
+
+def window_elapsed(key, window):
+    since = _HEALTH_SINCE.get(key)
+    return since is not None and time.time() - since >= window   # finding
+
+
+def do_work():
+    pass

@@ -1,0 +1,66 @@
+"""SKYT010 negatives: the hygienic forms of every positive pattern."""
+import sqlite3
+import time
+
+from skypilot_tpu.utils import events, fault_injection
+
+
+def _db():
+    return sqlite3.connect(':memory:')
+
+
+def publish_after_commit(value):
+    conn = _db()
+    conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+    conn.commit()
+    events.publish(events.REQUESTS, conn=conn)       # post-commit: fine
+
+
+def deferred_publish_in_txn(value):
+    conn = _db()
+    with conn:
+        conn.execute('UPDATE t SET v = ?', (value,))
+        # conn= rides the writer's connection: NOTIFY is transactional.
+        events.publish(events.REQUESTS, conn=conn)
+
+
+def inject_before_txn(value):
+    fault_injection.inject('fixture.site')           # before any write
+    conn = _db()
+    conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+    conn.commit()
+
+
+def rollback_then_raise(value):
+    conn = _db()
+    try:
+        conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+    except sqlite3.IntegrityError as e:
+        conn.rollback()
+        raise ValueError('duplicate') from e
+    conn.commit()
+
+
+def rollback_then_return(value):
+    conn = _db()
+    cur = conn.execute('UPDATE t SET v = ?', (value,))
+    if cur.rowcount == 0:
+        conn.rollback()
+        return False
+    conn.commit()
+    return True
+
+
+def sleep_between_txns(value):
+    conn = _db()
+    conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+    conn.commit()
+    time.sleep(0.1)                                  # no txn open
+    conn.execute('UPDATE t SET v = ?', (value,))
+    conn.commit()
+
+
+def helper_with_caller_conn(conn, value):
+    # Caller-owned connection: commit responsibility is theirs.
+    cur = conn.execute('SELECT v FROM t WHERE v = ?', (value,))
+    return cur.fetchone()

@@ -1,0 +1,50 @@
+"""SKYT010 positives: blocking work / bare publishes / abandoned
+transactions inside the control-plane DB idiom."""
+import sqlite3
+import time
+
+from skypilot_tpu.utils import events, fault_injection
+
+
+def _db():
+    return sqlite3.connect(':memory:')
+
+
+def sleep_in_txn(value):
+    conn = _db()
+    conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+    time.sleep(0.5)                                  # finding
+    conn.commit()
+
+
+def bare_publish_in_txn(value):
+    conn = _db()
+    conn.execute('UPDATE t SET v = ?', (value,))
+    # Wakes in-process listeners BEFORE the commit is visible.
+    events.publish(events.REQUESTS)                  # finding
+    conn.commit()
+
+
+def inject_in_with_conn(value):
+    conn = _db()
+    with conn:
+        conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+        fault_injection.inject('fixture.site')       # finding
+
+
+def raise_leaves_open(value):
+    conn = _db()
+    try:
+        conn.execute('INSERT INTO t (v) VALUES (?)', (value,))
+    except sqlite3.IntegrityError as e:
+        raise ValueError('duplicate') from e         # finding
+    conn.commit()
+
+
+def return_leaves_open(value):
+    conn = _db()
+    cur = conn.execute('UPDATE t SET v = ?', (value,))
+    if cur.rowcount == 0:
+        return False                                 # finding (exit)
+    conn.commit()
+    return True

@@ -1,0 +1,105 @@
+"""SKYT011 negatives: properly paired / escaping resources."""
+import os
+import tempfile
+import threading
+
+_lock = threading.Lock()
+
+
+def with_form(risky):
+    with _lock:
+        risky()
+
+
+def try_finally_acquire(risky):
+    _lock.acquire()
+    try:
+        risky()
+    finally:
+        _lock.release()
+
+
+def try_lock_is_exempt(risky):
+    if _lock.acquire(blocking=False):
+        risky()
+        _lock.release()
+
+
+def else_block_covered_by_finally(risky):
+    # An exception raised in the `else:` body still runs the finally.
+    _lock.acquire()
+    try:
+        x = 1
+    except KeyError:
+        pass
+    else:
+        risky()
+    finally:
+        _lock.release()
+    return x
+
+
+def tmp_cleaned_on_failure(build, dest):
+    fd, tmp = tempfile.mkstemp()
+    try:
+        os.close(fd)
+        build(tmp)
+        os.replace(tmp, dest)
+    except BaseException:
+        os.unlink(tmp)
+        raise
+
+
+def upload_aborts_on_error(client, bucket, key, parts):
+    upload_id = client.create_multipart_upload(bucket, key)
+    try:
+        etags = [client.upload_part(bucket, key, upload_id, i, p)
+                 for i, p in enumerate(parts)]
+        client.complete_multipart_upload(bucket, key, upload_id, etags)
+    except BaseException:
+        client.abort_multipart_upload(bucket, key, upload_id)
+        raise
+
+
+def upload_ownership_returned(client, bucket, key):
+    # Returning the context transfers ownership to the caller.
+    upload_id = client.create_multipart_upload(bucket, key)
+    return {'key': key, 'upload_id': upload_id}
+
+
+def incref_ownership_stored(pool, cache, block):
+    # No decref in this function: the reference lives in the cache.
+    pool.incref(block)
+    cache[block] = True
+
+
+def incref_balanced_on_error(pool, blocks, risky):
+    for block in blocks:
+        pool.incref(block)
+    try:
+        risky()
+    finally:
+        for block in blocks:
+            pool.decref(block)
+
+
+class FullyReleased:
+    def __init__(self, path):
+        self._path = path
+        self._lock = threading.Lock()
+        self._data = None
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, exc_type, *args):
+        try:
+            if exc_type is None:
+                flush(self._path, self._data)
+        finally:
+            self._lock.release()
+
+
+def flush(path, data):
+    del path, data

@@ -1,0 +1,57 @@
+"""SKYT011 positives: resources that leak on some CFG path."""
+import os
+import tempfile
+import threading
+
+_lock = threading.Lock()
+
+
+def bare_acquire_leaks(risky):
+    _lock.acquire()
+    risky()                      # may raise: lock held forever
+    _lock.release()              # finding (exception edge skips this)
+
+
+def tmp_leaks_on_failure(build, dest):
+    fd, tmp = tempfile.mkstemp()
+    os.close(fd)
+    build(tmp)                   # may raise: .tmp orphaned
+    os.replace(tmp, dest)        # finding (exception edge skips this)
+
+
+def upload_leaks_on_error(client, bucket, key, parts):
+    upload_id = client.create_multipart_upload(bucket, key)
+    etags = [client.upload_part(bucket, key, upload_id, i, p)
+             for i, p in enumerate(parts)]           # may raise
+    client.complete_multipart_upload(bucket, key, upload_id, etags)
+    # finding: no abort on the exception path
+
+
+def incref_unbalanced(pool, blocks, risky):
+    for block in blocks:
+        pool.incref(block)
+    risky()                      # may raise with refs elevated
+    for block in blocks:
+        pool.decref(block)       # finding
+
+
+class HalfReleased:
+    """__exit__ that skips release when the flush raises."""
+
+    def __init__(self, path):
+        self._path = path
+        self._lock = threading.Lock()
+        self._data = None
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, exc_type, *args):
+        if exc_type is None:
+            flush(self._path, self._data)    # may raise
+        self._lock.release()                 # finding (proto-leak)
+
+
+def flush(path, data):
+    del path, data

@@ -1,0 +1,51 @@
+"""SKYT012 negatives: shared state correctly confined or locked."""
+import threading
+
+_counts = {}
+_counts_lock = threading.Lock()
+_single_owner = {}       # only ever written by one daemon thread
+_helper_state = {}       # written via a helper all callers lock
+
+
+def count_loop():
+    while True:
+        with _counts_lock:
+            _counts['ticks'] = _counts.get('ticks', 0) + 1
+
+
+def record(name):
+    with _counts_lock:
+        _counts[name] = _counts.get(name, 0) + 1
+
+
+def owner_loop():
+    while True:
+        _single_owner['beat'] = 1        # one thread: confinement
+
+
+def _bump(key):
+    _helper_state[key] = 1               # callers hold the lock
+
+
+def helper_loop():
+    while True:
+        with _counts_lock:
+            _bump('a')
+
+
+def helper_submit():
+    with _counts_lock:
+        _bump('b')
+
+
+def reset_for_tests():
+    # Test-teardown helpers are exempt by design.
+    _counts.clear()
+    _single_owner.clear()
+    _helper_state.clear()
+
+
+def start():
+    threading.Thread(target=count_loop, daemon=True).start()
+    threading.Thread(target=owner_loop, daemon=True).start()
+    threading.Thread(target=helper_loop, daemon=True).start()

@@ -1,0 +1,50 @@
+"""SKYT012 positives: shared module state written from several
+threads with no common lock."""
+import threading
+
+_pending = {}            # written from two daemon threads, unlocked
+_results = []            # written from a daemon AND the main thread
+_guarded = {}            # lock held on one side only
+_state_lock = threading.Lock()
+
+
+def claim_loop():
+    while True:
+        _pending['claim'] = 1                        # no lock
+
+
+def requeue_loop():
+    while True:
+        _pending.pop('claim', None)                  # no lock
+
+
+def collector_loop():
+    while True:
+        _results.append(1)                           # no lock
+
+
+def submit(value):
+    # Called on the spawning thread while collector_loop runs.
+    _results.append(value)
+
+
+def half_guarded_loop():
+    while True:
+        with _state_lock:
+            _guarded['x'] = 1
+
+
+def unguarded_write(value):
+    _guarded['y'] = value                            # misses the lock
+
+
+def start():
+    threading.Thread(target=claim_loop, daemon=True).start()
+    threading.Thread(target=requeue_loop, daemon=True).start()
+    threading.Thread(target=collector_loop, daemon=True).start()
+    threading.Thread(target=half_guarded_loop, daemon=True).start()
+    threading.Thread(target=unguarded_thread, daemon=True).start()
+
+
+def unguarded_thread():
+    unguarded_write(2)

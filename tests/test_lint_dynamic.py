@@ -1,0 +1,210 @@
+"""Dynamic race/deadlock detector (skypilot_tpu/lint/dynamic.py).
+
+Seeded failures the detector MUST catch, and clean patterns it must
+stay silent on — the acceptance contract for riding chaos-marked
+tier-1 runs without noise.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.lint import dynamic
+
+
+@pytest.fixture(autouse=True)
+def _clean_detector():
+    # Snapshot/restore, NOT a blind reset: in a `-m chaos` session the
+    # conftest plugin accumulates findings across tests for one
+    # session-end report — this suite's deliberate seeded races must
+    # neither leak into it nor erase what earlier tests recorded.
+    saved = dynamic.snapshot()
+    dynamic.reset_for_tests()
+    yield
+    dynamic.restore()
+    dynamic.restore_snapshot(saved)
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+
+def test_seeded_two_thread_race_is_flagged():
+    with dynamic.instrumented():
+        counter = dynamic.watch(Counter(), name='counter')
+        barrier = threading.Barrier(2)
+
+        def writer():
+            barrier.wait(timeout=5)
+            for _ in range(200):
+                counter.value += 1       # no lock: the seeded race
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+    report = dynamic.report()
+    assert report['schema'] == dynamic.SCHEMA
+    races = report['races']
+    assert any(r['object'] == 'counter' and r['attribute'] == 'value'
+               for r in races), races
+    assert len(races[0]['threads']) >= 2
+
+
+def test_locked_writes_stay_silent():
+    with dynamic.instrumented():
+        lock = threading.Lock()          # instrumented factory
+        counter = dynamic.watch(Counter(), name='counter')
+        barrier = threading.Barrier(2)
+
+        def writer():
+            barrier.wait(timeout=5)
+            for _ in range(200):
+                with lock:
+                    counter.value += 1
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+    assert dynamic.report()['races'] == []
+
+
+def test_single_thread_writes_stay_silent():
+    with dynamic.instrumented():
+        counter = dynamic.watch(Counter(), name='counter')
+        for _ in range(100):
+            counter.value += 1           # exclusive: never a race
+    assert dynamic.report()['races'] == []
+
+
+def test_seeded_abba_deadlock_is_reported():
+    with dynamic.instrumented():
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        barrier = threading.Barrier(2)
+
+        def ab():
+            with lock_a:
+                barrier.wait(timeout=5)
+                # Timed acquire: the test unsticks itself after the
+                # watchdog has had many scan windows to see the cycle.
+                if lock_b.acquire(timeout=2.0):
+                    lock_b.release()
+
+        def ba():
+            with lock_b:
+                barrier.wait(timeout=5)
+                if lock_a.acquire(timeout=2.0):
+                    lock_a.release()
+
+        threads = [threading.Thread(target=ab, daemon=True),
+                   threading.Thread(target=ba, daemon=True)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if dynamic.report()['deadlocks']:
+                break
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=10)
+    deadlocks = dynamic.report()['deadlocks']
+    assert deadlocks, 'watchdog missed the seeded ABBA deadlock'
+    cycle = deadlocks[0]['cycle']
+    assert len(cycle) == 2
+    waited_for = {entry['waiting_for'] for entry in cycle}
+    assert len(waited_for) == 2
+    for entry in cycle:
+        assert entry['holding'], entry
+
+
+def test_ordered_lock_use_reports_no_deadlock():
+    with dynamic.instrumented():
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def worker():
+            for _ in range(50):
+                with lock_a:
+                    with lock_b:
+                        pass
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        time.sleep(3 * dynamic.WATCHDOG_INTERVAL)
+    assert dynamic.report()['deadlocks'] == []
+
+
+def test_report_json_written(tmp_path):
+    with dynamic.instrumented():
+        counter = dynamic.watch(Counter(), name='c')
+        barrier = threading.Barrier(2)
+
+        def writer():
+            barrier.wait(timeout=5)
+            for _ in range(100):
+                counter.value += 1
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+    path = tmp_path / 'report.json'
+    written = dynamic.write_report(str(path))
+    assert written == str(path)
+    data = json.loads(path.read_text())
+    assert data['schema'] == dynamic.SCHEMA
+    assert data['races']
+
+
+def test_clean_run_writes_no_report(tmp_path):
+    with dynamic.instrumented():
+        lock = threading.Lock()
+        with lock:
+            pass
+    assert dynamic.write_report(str(tmp_path / 'none.json')) is None
+    assert not (tmp_path / 'none.json').exists()
+
+
+def test_knob_parsing(monkeypatch):
+    monkeypatch.delenv(dynamic.KNOB, raising=False)
+    assert not dynamic.enabled()
+    monkeypatch.setenv(dynamic.KNOB, '0')
+    assert not dynamic.enabled()
+    monkeypatch.setenv(dynamic.KNOB, '1')
+    assert dynamic.enabled()
+    monkeypatch.setenv(dynamic.KNOB, '/tmp/r.json')
+    assert dynamic.enabled()
+    assert dynamic.report_path() == '/tmp/r.json'
+
+
+@pytest.mark.chaos
+def test_chaos_marked_clean_locking_stays_silent():
+    """The pytest plugin instruments chaos tests when the knob is on;
+    this one exercises instrumented locks + watched state used
+    CORRECTLY and must contribute nothing to the session report."""
+    with dynamic.instrumented():
+        lock = threading.Lock()
+        counter = dynamic.watch(Counter(), name='clean')
+
+        def worker():
+            for _ in range(100):
+                with lock:
+                    counter.value += 1
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+    report = dynamic.report()
+    assert report['races'] == [] and report['deadlocks'] == []

@@ -26,6 +26,11 @@ from skypilot_tpu.lint.checks_events import EventTopicChecker
 from skypilot_tpu.lint.checks_metrics import MetricsRegistryChecker
 from skypilot_tpu.lint.checks_portability import (JaxPurityChecker,
                                                   SqlitePortabilityChecker)
+from skypilot_tpu.lint.checks_resources import ResourcePairingChecker
+from skypilot_tpu.lint.checks_shared_state import SharedStateChecker
+from skypilot_tpu.lint.checks_transactions import (
+    TransactionHygieneChecker)
+from skypilot_tpu.lint.checks_wallclock import WallClockChecker
 from skypilot_tpu.utils import env_registry
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -207,6 +212,72 @@ def test_skyt008_pure_jit_passes():
     assert not run_fixture(JaxPurityChecker(), ['skyt008_neg.py'])
 
 
+# -- SKYT009 ------------------------------------------------------------
+
+def test_skyt009_flags_wall_clock_durations():
+    findings = run_fixture(WallClockChecker(), ['skyt009_pos.py'])
+    by_fn = {f.slug.split(':')[1] for f in findings
+             if f.code == 'SKYT009'}
+    assert {'elapsed_simple', 'deadline_loop', 'zero_init_loop',
+            'expired', 'window_elapsed'} <= by_fn
+    # One finding per root cause: the deadline loop's compare is one
+    # site, not compare + operand.
+    loop = [f for f in findings if ':deadline_loop:' in f.slug]
+    assert len(loop) == 1
+
+
+def test_skyt009_persisted_and_monotonic_pass():
+    assert not run_fixture(WallClockChecker(), ['skyt009_neg.py'])
+
+
+# -- SKYT010 ------------------------------------------------------------
+
+def test_skyt010_flags_transaction_hygiene():
+    found = slugs(run_fixture(TransactionHygieneChecker(),
+                              ['skyt010_pos.py']), 'SKYT010')
+    assert 'txn-blocking:sleep_in_txn:time.sleep' in found
+    assert 'txn-blocking:bare_publish_in_txn:events.publish' in found
+    assert ('txn-blocking:inject_in_with_conn:fault_injection.inject'
+            in found)
+    assert 'txn-raise:raise_leaves_open:conn' in found
+    assert 'txn-open-exit:return_leaves_open:conn' in found
+
+
+def test_skyt010_hygienic_forms_pass():
+    assert not run_fixture(TransactionHygieneChecker(),
+                           ['skyt010_neg.py'])
+
+
+# -- SKYT011 ------------------------------------------------------------
+
+def test_skyt011_flags_unbalanced_resources():
+    found = slugs(run_fixture(ResourcePairingChecker(),
+                              ['skyt011_pos.py']), 'SKYT011')
+    assert any(s.startswith('leak:bare_acquire_leaks:') for s in found)
+    assert any(s.startswith('leak:tmp_leaks_on_failure:')
+               for s in found)
+    assert any(s.startswith('leak:upload_leaks_on_error:')
+               for s in found)
+    assert any(s.startswith('leak:incref_unbalanced:') for s in found)
+    assert 'proto-leak:HalfReleased:self._lock' in found
+
+
+def test_skyt011_paired_and_escaping_pass():
+    assert not run_fixture(ResourcePairingChecker(), ['skyt011_neg.py'])
+
+
+# -- SKYT012 ------------------------------------------------------------
+
+def test_skyt012_flags_unlocked_shared_writes():
+    found = slugs(run_fixture(SharedStateChecker(),
+                              ['skyt012_pos.py']), 'SKYT012')
+    assert found == {'race:_pending', 'race:_results', 'race:_guarded'}
+
+
+def test_skyt012_locked_or_confined_pass():
+    assert not run_fixture(SharedStateChecker(), ['skyt012_neg.py'])
+
+
 # -- baseline workflow --------------------------------------------------
 
 def test_baseline_suppresses_and_rejects_stale(tmp_path):
@@ -259,6 +330,30 @@ def test_repo_lint_is_clean(capsys):
                     f"{f['message']}" for f in active))
     assert report['summary']['active'] == 0
     assert report['summary']['files_scanned'] > 150
+    # Versioned report contract: CI gates on `schema`, not field
+    # sniffing (docs/static_analysis.md).
+    assert report['schema'] == lint_cli.REPORT_SCHEMA
+
+
+# -- --changed-only -----------------------------------------------------
+
+def test_changed_files_reads_git_status():
+    changed = lint_cli.changed_files(REPO_ROOT)
+    assert changed is None or isinstance(changed, set)
+    assert lint_cli.changed_files('/nonexistent-dir-xyz') is None
+
+
+def test_filter_changed_scopes_report():
+    findings = [
+        core.Finding('SKYT009', 'skypilot_tpu/a.py', 1, 'm', slug='a'),
+        core.Finding('SKYT009', 'skypilot_tpu/b.py', 1, 'm', slug='b'),
+        core.Finding(core.META_CODE, 'lint_baseline.json', 0, 'meta',
+                     slug='meta'),
+    ]
+    scoped = lint_cli.filter_changed(findings, {'skypilot_tpu/a.py'})
+    assert {f.slug for f in scoped} == {'a', 'meta'}
+    # Unreadable git fails OPEN: the full report, never a narrowed one.
+    assert lint_cli.filter_changed(findings, None) == findings
 
 
 def test_env_docs_in_sync():
