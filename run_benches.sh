@@ -40,6 +40,33 @@ pkill -f 'skypilot_tpu.*(daemon|serve|runner|broker|api_server)' \
   2>/dev/null && sleep 1
 echo "preamble: orphaned skypilot daemons killed (if any)" >&2
 
+# Trace artifact: one head-sampled end-to-end fake launch with the
+# distributed-tracing subsystem armed, exported as Perfetto JSON
+# (open in ui.perfetto.dev; docs/observability.md). Non-fatal — a
+# broken trace pipeline must not eat the tunnel window.
+echo "preamble: capturing sampled control-plane trace" >&2
+timeout 180 env JAX_PLATFORMS=cpu SKYT_TRACE_SAMPLE=1 python - \
+  "BENCH_trace_${suffix}.json" <<'PYEOF' \
+  || echo "preamble: trace capture failed (non-fatal)" >&2
+import os, sys, tempfile
+os.environ['SKYT_STATE_DIR'] = tempfile.mkdtemp(prefix='skyt-trace-')
+from skypilot_tpu import execution
+from skypilot_tpu.provision import fake
+from skypilot_tpu.spec.resources import Resources
+from skypilot_tpu.spec.task import Task
+from skypilot_tpu.utils import timeline, tracing
+fake.reset()
+with tracing.span('bench.launch', service='bench') as sp:
+    trace_id = sp.context.trace_id
+    execution.launch(
+        Task(name='t', run='echo traced',
+             resources=Resources(cloud='fake',
+                                 accelerators='tpu-v5e-8')),
+        cluster_name='trace-bench')
+path = timeline.save(sys.argv[1], trace_id=trace_id)
+print(f'trace artifact: {path} (trace {trace_id})')
+PYEOF
+
 run() {
   local out="$1"; shift
   echo "=== bench $* ($(date -u +%H:%M:%SZ)) ===" >&2

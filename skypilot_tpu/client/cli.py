@@ -510,6 +510,79 @@ def api_cancel(request_id: str) -> None:
     click.echo('Cancelled.' if ok else 'Not cancellable.')
 
 
+@cli.command('trace')
+@click.argument('request_id')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Raw /api/trace payload instead of the waterfall.')
+@click.option('--width', default=48, help='Waterfall bar width (cols).')
+def trace_cmd(request_id: str, as_json: bool, width: int) -> None:
+    """Show the distributed trace of a request: span waterfall +
+    critical-path breakdown (requires SKYT_TRACE_SAMPLE at submit, or
+    a tail-kept errored/slow request — docs/observability.md)."""
+    try:
+        view = sdk.api_trace(request_id)
+    except exceptions.SkytError as e:
+        raise click.ClickException(str(e)) from e
+    if as_json:
+        click.echo(json.dumps(view, indent=2, default=str))
+        return
+    _render_waterfall(view, max(16, width))
+
+
+def _render_waterfall(view: dict, width: int) -> None:
+    spans = view.get('spans') or []
+    total_ms = max(float(view.get('total_ms') or 0.0), 0.001)
+    crit = set(view.get('critical_span_ids') or [])
+    click.echo(f"trace {view.get('trace_id')}  "
+               f"request {view.get('request_id') or '-'}  "
+               f"{len(spans)} spans / "
+               f"{len(view.get('processes') or [])} processes  "
+               f"total {total_ms:.1f}ms")
+    # Depth via parent links; children render under their parent in
+    # start order (the classic trace-viewer waterfall, in a terminal).
+    by_id = {s['span_id']: s for s in spans}
+    children: dict = {}
+    roots = []
+    for s in spans:
+        parent = s.get('parent_span_id')
+        if parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    name_col = min(36, max((len(s.get('name', '')) for s in spans),
+                           default=8) + 8)
+
+    def emit(span: dict, depth: int) -> None:
+        start_ms = float(span.get('start_ms') or 0.0)
+        dur_ms = float(span.get('dur_ms') or 0.0)
+        lead = int(width * start_ms / total_ms)
+        bar = max(1, int(width * dur_ms / total_ms))
+        bar = min(bar, width - min(lead, width - 1))
+        mark = '*' if span['span_id'] in crit else ' '
+        flag = ' !' if span.get('status') == 'error' else ''
+        label = ('  ' * depth + span.get('name', '?'))[:name_col]
+        click.echo(f'{label:<{name_col}} '
+                   f'{" " * min(lead, width - 1)}{"█" * bar}'
+                   f'{" " * max(0, width - lead - bar)} '
+                   f'{dur_ms:9.1f}ms {mark} '
+                   f'[{span.get("service", "?")}/{span.get("pid")}]'
+                   f'{flag}')
+        for child in sorted(children.get(span['span_id'], []),
+                            key=lambda c: c.get('start_ms', 0.0)):
+            emit(child, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s.get('start_ms', 0.0)):
+        emit(root, 0)
+    path = view.get('critical_path') or []
+    if path:
+        click.echo('\ncritical path (self-time per hop, * above):')
+        for seg in path:
+            pct = 100.0 * float(seg.get('self_ms', 0.0)) / total_ms
+            click.echo(f"  {seg.get('name', '?'):<{name_col}} "
+                       f"{float(seg.get('self_ms', 0.0)):9.1f}ms "
+                       f"{pct:5.1f}%  [{seg.get('service', '?')}]")
+
+
 @cli.group()
 def recipes() -> None:
     """Curated launchable recipes (`skyt launch recipe://NAME`)."""

@@ -25,6 +25,7 @@ from skypilot_tpu.server.app import DEFAULT_PORT
 from skypilot_tpu.spec.dag import Dag
 from skypilot_tpu.spec.task import Task
 from skypilot_tpu.utils import env_registry, log, subprocess_utils
+from skypilot_tpu.utils import tracing
 
 logger = log.init_logger(__name__)
 
@@ -231,12 +232,20 @@ def _post(route: str, body: Dict[str, Any]) -> RequestId:
     headers['X-Skyt-Idempotency-Key'] = os.urandom(16).hex()
     from skypilot_tpu import workspaces
     headers['X-Skyt-Workspace'] = workspaces.active_workspace()
-    resp = _request_with_retries('POST', f'{url}/{route}', json=body,
-                                 timeout=30, headers=headers)
-    payload = resp.json()
-    if resp.status_code != 200:
-        raise exceptions.ApiServerError(
-            payload.get('error', f'HTTP {resp.status_code}'))
+    # Distributed tracing: every submission carries a W3C traceparent
+    # so the server's submit span (and everything under it) joins the
+    # CLIENT's trace — the client is where the request truly begins.
+    with tracing.span(f'client.{route}', service='client') as sp:
+        traceparent = sp.traceparent()
+        if traceparent is not None:
+            headers[tracing.TRACEPARENT_HEADER] = traceparent
+        resp = _request_with_retries('POST', f'{url}/{route}', json=body,
+                                     timeout=30, headers=headers)
+        payload = resp.json()
+        if resp.status_code != 200:
+            raise exceptions.ApiServerError(
+                payload.get('error', f'HTTP {resp.status_code}'))
+        sp.annotate(request_id=payload['request_id'])
     return RequestId(payload['request_id'])
 
 
@@ -416,6 +425,23 @@ def api_cancel(request_id: str) -> bool:
         raise exceptions.ApiServerError(
             payload.get('error', f'HTTP {resp.status_code}'))
     return bool(payload.get('cancelled'))
+
+
+def api_trace(request_id: str) -> Dict[str, Any]:
+    """The collected trace of a request (or a raw trace_id): span tree
+    + critical path, straight from GET /api/trace/<id>."""
+    url = ensure_api_server()
+    resp = _request_with_retries(
+        'GET', f'{url}/api/trace/{urllib.parse.quote(request_id)}',
+        timeout=30, headers=_auth_headers())
+    payload = resp.json()
+    if resp.status_code == 404:
+        raise exceptions.RequestDoesNotExist(
+            payload.get('error', f'no trace for {request_id!r}'))
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return payload
 
 
 def api_status(status: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -64,6 +64,7 @@ from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
 from skypilot_tpu.utils import resilience
+from skypilot_tpu.utils import timeline
 
 logger = log.init_logger(__name__)
 
@@ -555,6 +556,11 @@ class TransferEngine:
 
     # -- upload (local -> store) ---------------------------------------
 
+    # transfer.* timeline events double as distributed-tracing spans
+    # when a request trace is ambient (an executor child syncing a
+    # workdir/file mount): the data-plane hop shows up on the critical
+    # path without a second instrumentation layer.
+    @timeline.event('transfer.sync_up')
     def sync_up(self, local_root: str, adapter, prefix: str = ''
                 ) -> TransferResult:
         started = time.monotonic()
@@ -742,6 +748,7 @@ class TransferEngine:
 
     # -- download (store -> local) -------------------------------------
 
+    @timeline.event('transfer.sync_down')
     def sync_down(self, adapter, prefix: str, dest: str
                   ) -> TransferResult:
         started = time.monotonic()
@@ -889,6 +896,7 @@ class TransferEngine:
 
     # -- copy (store -> store) -----------------------------------------
 
+    @timeline.event('transfer.copy')
     def copy(self, src_adapter, src_prefix: str, dst_adapter,
              dst_prefix: str = '') -> TransferResult:
         """Bucket-to-bucket, streamed through this host part-by-part

@@ -379,7 +379,9 @@ def _execute_task(task: Task, cluster_name: str, backend: TpuPodBackend,
     if Stage.EXEC in stages and task.run is not None:
         state.add_cluster_event(cluster_name, 'JOB_SUBMIT',
                                 task.name or '')
-        job_id = backend.execute(info, task, detach=detach)
+        with timeline.Event('execute', cluster=cluster_name,
+                            detach=detach):
+            job_id = backend.execute(info, task, detach=detach)
     if down and Stage.DOWN in stages:
         if detach and job_id is not None:
             # The job is queued, not finished: autodown via the runtime

@@ -82,17 +82,24 @@ def _prefill_chunk_step(params, tokens, start, n_new, slot, cache,
 class _Request:
     def __init__(self, token_ids: List[int], max_new_tokens: int,
                  temperature: float, eos_id: Optional[int],
-                 seed: int) -> None:
+                 seed: int, trace_ctx=None) -> None:
         self.token_ids = token_ids
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.eos_id = eos_id
         self.seed = seed
         self.arrival = time.monotonic()
+        self.arrival_wall = time.time()
         self.admitted = False  # queue-wait counted once, not per resume
         self.generated: List[int] = []
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
+        # Distributed tracing (armed deployments with an incoming
+        # context only): the per-request engine span; queue-wait /
+        # prefill-chunk / decode / preempt child spans hang off it.
+        self.span = None
+        self.decode_start_wall: Optional[float] = None
+        self.decode_start_mono: Optional[float] = None
 
 
 class _PrefillState:
@@ -290,7 +297,30 @@ class ContinuousBatchingEngine:
             self._errors_total += 1
         else:
             self._completions_total += 1
+        if request.span is not None:
+            self._record_decode_segment(request)
+            request.span.finish(error=error,
+                                tokens=len(request.generated))
+            request.span = None
         request.done.set()
+
+    @staticmethod
+    def _record_decode_segment(request: _Request) -> None:
+        """Close the current infer.decode segment (finish OR preempt).
+        Segments end at preemption — otherwise one span would absorb
+        the requeue wait and re-prefill, billing them as decode on the
+        critical-path breakdown."""
+        if request.span is None or request.decode_start_wall is None \
+                or request.decode_start_mono is None:
+            return
+        from skypilot_tpu.utils import tracing
+        tracing.record_span(
+            'infer.decode', request.span.context,
+            request.decode_start_wall,
+            max(0.0, time.monotonic() - request.decode_start_mono),
+            service='inference', tokens=len(request.generated))
+        request.decode_start_wall = None
+        request.decode_start_mono = None
 
     def _fail_slot(self, slot: int, error: BaseException,
                    prefill: bool = False) -> None:
@@ -393,8 +423,14 @@ class ContinuousBatchingEngine:
                 self._prefix_misses_total += 1
         if not request.admitted:
             request.admitted = True
-            self._queue_wait_seconds_total += max(
-                0.0, time.monotonic() - request.arrival)
+            wait_s = max(0.0, time.monotonic() - request.arrival)
+            self._queue_wait_seconds_total += wait_s
+            if request.span is not None:
+                from skypilot_tpu.utils import tracing
+                tracing.record_span('infer.queue_wait',
+                                    request.span.context,
+                                    request.arrival_wall, wait_s,
+                                    service='inference')
         self._slot_blocks[slot] = blocks
         self._host_bt[slot, :] = 0
         self._host_bt[slot, :len(blocks)] = blocks
@@ -423,6 +459,8 @@ class ContinuousBatchingEngine:
         tokens = np.zeros((1, self.prefill_chunk), np.int32)
         tokens[0, :len(chunk)] = chunk
         self._sync_tables()
+        chunk_wall = time.time()
+        chunk_mono = time.monotonic()
         try:
             last, cache = self._prefill_fn(
                 self.params, jnp.asarray(tokens),
@@ -437,12 +475,22 @@ class ContinuousBatchingEngine:
         state.pos += len(chunk)
         self._host_len[slot] = state.pos
         self._prefill_chunks_total += 1
+        if request.span is not None:
+            from skypilot_tpu.utils import tracing
+            tracing.record_span(
+                'infer.prefill_chunk', request.span.context, chunk_wall,
+                max(0.0, time.monotonic() - chunk_mono),
+                service='inference', tokens=len(chunk), slot=slot,
+                pos=state.pos)
         if state.pos >= len(ids):
             self._prefilling.pop(0)
             self._last_logits = self._last_logits.at[slot].set(
                 last[0].astype(jnp.float32))
             self._rngs[slot] = jax.random.key(request.seed)
             self._decoding[slot] = True
+            if request.decode_start_wall is None:
+                request.decode_start_wall = time.time()
+                request.decode_start_mono = time.monotonic()
             if self._prefix is not None:
                 self._prefix.insert(ids, self._slot_blocks[slot])
 
@@ -461,6 +509,15 @@ class ContinuousBatchingEngine:
         active_mask[slot] = False
         self._preemptions_total += 1
         if request is not None:
+            if request.span is not None:
+                from skypilot_tpu.utils import tracing
+                # Close the decode segment HERE: the requeue wait and
+                # the resume's re-prefill must not be billed as decode.
+                self._record_decode_segment(request)
+                tracing.record_span(
+                    'infer.preempt', request.span.context, time.time(),
+                    0.0, service='inference', slot=slot,
+                    generated=len(request.generated))
             self._waiting.insert(0, request)
             self._wake.set()
 
@@ -586,9 +643,13 @@ class ContinuousBatchingEngine:
 
     def _submit(self, token_ids: List[int], max_new_tokens: int,
                 temperature: float, eos_id: Optional[int],
-                seed: int) -> _Request:
+                seed: int, trace_ctx=None) -> _Request:
         """Shared admission path: validate + enqueue (both the blocking
-        and streaming entries; the policy must not drift between them)."""
+        and streaming entries; the policy must not drift between them).
+
+        ``trace_ctx`` (a tracing.SpanContext, e.g. parsed from the
+        serving request's traceparent) opens a per-request engine span
+        with queue-wait / prefill-chunk / decode / preempt children."""
         if len(token_ids) >= self.max_len:
             # Reject loudly: silently truncating a prompt answers a
             # question the caller never asked.
@@ -596,7 +657,13 @@ class ContinuousBatchingEngine:
                 f'prompt is {len(token_ids)} tokens; engine max_len is '
                 f'{self.max_len} (prompt + generation must fit)')
         request = _Request(token_ids, max_new_tokens, temperature,
-                           eos_id, seed)
+                           eos_id, seed, trace_ctx=trace_ctx)
+        if trace_ctx is not None:
+            from skypilot_tpu.utils import tracing
+            request.span = tracing.start_span(
+                'infer.request', parent=trace_ctx, service='inference',
+                prompt_tokens=len(token_ids),
+                max_new_tokens=max_new_tokens)
         self._requests_total += 1
         self._pending.put(request)
         self._wake.set()
@@ -607,9 +674,10 @@ class ContinuousBatchingEngine:
                      temperature: float = 0.0,
                      eos_id: Optional[int] = None,
                      seed: int = 0,
-                     timeout: float = 300.0) -> List[int]:
+                     timeout: float = 300.0,
+                     trace_ctx=None) -> List[int]:
         request = self._submit(token_ids, max_new_tokens, temperature,
-                               eos_id, seed)
+                               eos_id, seed, trace_ctx=trace_ctx)
         if not request.done.wait(timeout):
             raise TimeoutError('generation timed out')
         if request.error is not None:
@@ -630,7 +698,8 @@ class ContinuousBatchingEngine:
                    temperature: float = 0.0,
                    eos_id: Optional[int] = None,
                    seed: int = 0,
-                   timeout: float = 300.0):
+                   timeout: float = 300.0,
+                   trace_ctx=None):
         """Yield generated token ids AS THEY LAND in the slot loop
         (the decode thread appends to request.generated; this iterator
         tails it) — the vLLM/JetStream streaming serving shape.
@@ -638,7 +707,7 @@ class ContinuousBatchingEngine:
         Validation/admission happens EAGERLY (same as generate_ids: an
         over-long prompt raises here, not at first iteration)."""
         request = self._submit(token_ids, max_new_tokens, temperature,
-                               eos_id, seed)
+                               eos_id, seed, trace_ctx=trace_ctx)
 
         def tail():
             emitted = 0

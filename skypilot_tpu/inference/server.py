@@ -71,6 +71,18 @@ def make_handler(engine: InferenceEngine):
         # occupancy stats stay gauges.
         _COUNTERS = server_metrics.INFERENCE_COUNTER_STATS
 
+        def _trace_kwargs(self):
+            """Incoming traceparent (forwarded by the serve LB) ->
+            engine trace_ctx kwarg, so queue-wait/prefill/decode spans
+            join the caller's distributed trace. Continuous engine
+            only — the batch engine has no per-request lifecycle."""
+            from skypilot_tpu.utils import tracing
+            if not tracing.armed() or not hasattr(engine, 'stream_ids'):
+                return {}
+            ctx = tracing.parse_traceparent(
+                self.headers.get(tracing.TRACEPARENT_HEADER))
+            return {'trace_ctx': ctx} if ctx is not None else {}
+
         def do_GET(self):
             if self.path == '/health':
                 self._json(200, {'status': 'ok',
@@ -128,6 +140,7 @@ def make_handler(engine: InferenceEngine):
                 temperature=float(req.get('temperature', 0.0)),
                 seed=int(req.get('seed', 0)))
             if hasattr(engine, 'generate_texts'):
+                kwargs.update(self._trace_kwargs())
                 outputs = engine.generate_texts(prompts, **kwargs)
             else:
                 outputs = engine.generate_text(prompts, **kwargs)
@@ -159,6 +172,7 @@ def make_handler(engine: InferenceEngine):
             kwargs = dict(
                 max_new_tokens=max_tokens,
                 temperature=float(req.get('temperature') or 0.0))
+            kwargs.update(self._trace_kwargs())
             rid = f'cmpl-{os.urandom(8).hex()}'
             model = engine.cfg.name
             if req.get('stream'):

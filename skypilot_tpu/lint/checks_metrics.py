@@ -34,6 +34,10 @@ CODE = 'SKYT003'
 METRICS_MODULE = 'server/metrics.py'
 KINDS = {'Counter': 'inc', 'Gauge': 'set', 'Histogram': 'observe'}
 EMIT_METHODS = frozenset(KINDS.values())
+# Emitter keywords that are NOT labels: 'amount' is Counter.inc's
+# increment, 'exemplar' is Histogram.observe's OpenMetrics trace_id
+# attachment — neither forks a timeseries.
+NON_LABEL_KWARGS = frozenset({'amount', 'exemplar'})
 
 
 class MetricDecl(NamedTuple):
@@ -164,7 +168,8 @@ class MetricsRegistryChecker:
                 continue
             if any(kw.arg is None for kw in node.keywords):
                 continue                   # **labels: not checkable
-            passed = tuple(sorted(kw.arg for kw in node.keywords))
+            passed = tuple(sorted(kw.arg for kw in node.keywords
+                                  if kw.arg not in NON_LABEL_KWARGS))
             declared = tuple(sorted(l for l in decl.labels if l))
             if passed != declared:
                 yield Finding(

@@ -55,6 +55,7 @@ from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log
+from skypilot_tpu.utils import tracing
 
 logger = log.init_logger(__name__)
 
@@ -301,6 +302,11 @@ class _Request:
         self.version = version
         self.headers = headers
         self.body = body
+        # Per-request tracing (set by _proxy_one when armed): the LB
+        # span whose context is forwarded upstream, and the observed
+        # TTFB the span is annotated with.
+        self.trace_span = None
+        self.ttfb_ms: Optional[float] = None
 
     def header(self, name: str) -> Optional[str]:
         name = name.lower()
@@ -308,6 +314,12 @@ class _Request:
             if key.lower() == name:
                 return value
         return None
+
+    def set_header(self, name: str, value: str) -> None:
+        low = name.lower()
+        self.headers = [(k, v) for k, v in self.headers
+                        if k.lower() != low]
+        self.headers.append((name, value))
 
     @property
     def keep_alive(self) -> bool:
@@ -468,10 +480,17 @@ class _AsyncProxy:
                                                b'malformed request\n')
                     return
                 if request.target == LB_METRICS_PATH:
-                    payload = self._metrics().render_lb_text().encode()
+                    openmetrics = 'application/openmetrics-text' in (
+                        request.header('accept') or '')
+                    payload = self._metrics().render_lb_text(
+                        openmetrics=openmetrics).encode()
                     await self._respond_simple(
                         writer, 200, 'OK', payload,
-                        content_type='text/plain; version=0.0.4')
+                        content_type=(
+                            'application/openmetrics-text; '
+                            'version=1.0.0; charset=utf-8'
+                            if openmetrics
+                            else 'text/plain; version=0.0.4'))
                     if not request.keep_alive:
                         return
                     continue
@@ -497,6 +516,40 @@ class _AsyncProxy:
 
     # -- the proxy core ------------------------------------------------
 
+    def _begin_span(self, request: _Request) -> None:
+        """Open the per-request LB span (armed deployments only) and
+        forward ITS context upstream — the replica's engine spans then
+        parent under the LB hop, not beside it."""
+        if not tracing.armed():
+            return
+        parent = tracing.parse_traceparent(
+            request.header(tracing.TRACEPARENT_HEADER))
+        span = tracing.start_span('lb.request', parent=parent,
+                                  service='serve-lb',
+                                  method=request.method,
+                                  path=request.target)
+        if span is not None:
+            request.trace_span = span
+            request.set_header(tracing.TRACEPARENT_HEADER,
+                               span.traceparent())
+
+    def _finish_span(self, request: _Request, outcome: str,
+                     replica_id: Optional[int], tried: Set[int]) -> None:
+        span = request.trace_span
+        if span is None:
+            return
+        request.trace_span = None
+        failed = outcome in ('upstream_error', 'aborted', 'no_retry',
+                             'no_replica')
+        span.finish(
+            error=RuntimeError(outcome) if failed else None,
+            outcome=outcome,
+            replica=replica_id,
+            retries=max(0, len(tried) - 1),
+            ttfb_ms=(round(request.ttfb_ms, 3)
+                     if request.ttfb_ms is not None else None),
+            ejected=len(self.lb.ejected_snapshot()) or None)
+
     async def _proxy_one(self, request: _Request,
                          client: asyncio.StreamWriter) -> bool:
         """Proxy one request; returns whether the client connection is
@@ -504,8 +557,10 @@ class _AsyncProxy:
         metrics = self._metrics()
         lb = self.lb
         lb.record_request()
+        self._begin_span(request)
         if self._inflight >= self.max_inflight:
             metrics.LB_REQUESTS.inc(outcome='saturated')
+            self._finish_span(request, 'saturated', None, set())
             await self._respond_simple(
                 client, 503, 'Service Unavailable',
                 b'Load balancer saturated\n',
@@ -528,11 +583,14 @@ class _AsyncProxy:
                     usable = await self._attempt(request, client, pool,
                                                  replica_id, state, start)
                     metrics.LB_REQUESTS.inc(outcome='ok')
+                    self._finish_span(request, 'ok', replica_id, tried)
                     return usable
                 except _ClientGone:
                     # The *client* went away mid-stream: not a replica
                     # failure, nothing to retry.
                     metrics.LB_REQUESTS.inc(outcome='client_abort')
+                    self._finish_span(request, 'client_abort',
+                                      replica_id, tried)
                     return False
                 except (ConnectionError, OSError, asyncio.TimeoutError,
                         asyncio.IncompleteReadError,
@@ -545,6 +603,8 @@ class _AsyncProxy:
                         # client — the only honest move is to cut the
                         # connection so the client sees the truncation.
                         metrics.LB_REQUESTS.inc(outcome='aborted')
+                        self._finish_span(request, 'aborted',
+                                          replica_id, tried)
                         return False
                     if (state.request_sent and
                             request.method not in _IDEMPOTENT_METHODS):
@@ -553,6 +613,8 @@ class _AsyncProxy:
                         # is delivered): replaying could duplicate a
                         # non-idempotent effect.
                         metrics.LB_REQUESTS.inc(outcome='no_retry')
+                        self._finish_span(request, 'no_retry',
+                                          replica_id, tried)
                         await self._respond_simple(
                             client, 502, 'Bad Gateway',
                             b'Replica failed after request was sent; '
@@ -564,12 +626,15 @@ class _AsyncProxy:
             retry_after = str(lb.retry_after_seconds)
             if not tried:
                 metrics.LB_REQUESTS.inc(outcome='no_replica')
+                self._finish_span(request, 'no_replica', None, tried)
                 await self._respond_simple(
                     client, 503, 'Service Unavailable',
                     b'No ready replicas\n',
                     (('Retry-After', retry_after),))
             else:
                 metrics.LB_REQUESTS.inc(outcome='upstream_error')
+                self._finish_span(request, 'upstream_error', None,
+                                  tried)
                 await self._respond_simple(
                     client, 502, 'Bad Gateway',
                     b'All attempted replicas failed\n',
@@ -611,8 +676,14 @@ class _AsyncProxy:
             # The histogram is the client's view (request arrival ->
             # response head); the EWMA is the replica's: a failed
             # earlier attempt's latency must not be billed to the
-            # replica that actually answered.
-            metrics.LB_TTFB.observe(now - start)
+            # replica that actually answered. Traced requests stamp
+            # their trace_id as the bucket's exemplar — the slow-TTFB
+            # bucket points at the exact trace to pull.
+            request.ttfb_ms = (now - start) * 1000.0
+            metrics.LB_TTFB.observe(
+                now - start,
+                exemplar=(request.trace_span.context.trace_id
+                          if request.trace_span is not None else None))
             self.lb.observe_latency(replica_id, now - attempt_start)
             client_keep = await self._stream_response(
                 client, status, reason, resp_headers, body_iter,

@@ -32,7 +32,7 @@ from skypilot_tpu.server import executor as executor_lib
 from skypilot_tpu.server import payloads, requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.users import rbac, users_db
-from skypilot_tpu.utils import env_registry, events, log
+from skypilot_tpu.utils import env_registry, events, log, tracing
 
 logger = log.init_logger(__name__)
 
@@ -307,12 +307,24 @@ class ApiHandler(BaseHTTPRequestHandler):
                 rbac.require_workspace_access(user, workspace or 'default',
                                               'use')
                 _, schedule_type = payloads.PAYLOADS[name]
-                request_id = requests_db.create(
-                    name, body, schedule_type,
-                    user=(user.name if user else
-                          self.headers.get('X-Skyt-User')),
-                    idem_key=self.headers.get('X-Skyt-Idempotency-Key'),
-                    workspace=workspace)
+                # Trace identity: extract the client's context (or mint
+                # a root) and persist THIS span's context on the row —
+                # the executor exports it into the request child, so
+                # every later hop parents under server.submit.
+                parent = tracing.parse_traceparent(
+                    self.headers.get(tracing.TRACEPARENT_HEADER))
+                with tracing.span('server.submit', parent=parent,
+                                  service='api-server',
+                                  payload=name) as sp:
+                    request_id = requests_db.create(
+                        name, body, schedule_type,
+                        user=(user.name if user else
+                              self.headers.get('X-Skyt-User')),
+                        idem_key=self.headers.get(
+                            'X-Skyt-Idempotency-Key'),
+                        workspace=workspace,
+                        trace_context=sp.traceparent())
+                    sp.annotate(request_id=request_id)
                 self._reply({'request_id': request_id})
             else:
                 self._error(HTTPStatus.NOT_FOUND, f'no route {route}')
@@ -774,13 +786,29 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                     self._query.get('name', '')))
             elif route == '/api/metrics':
                 from skypilot_tpu.server import metrics
-                body = metrics.render_text().encode()
+                # Exemplars only exist in the OpenMetrics exposition
+                # (a mid-line '#' breaks v0 parsers) — negotiate on
+                # Accept, like prometheus_client does.
+                accept = self.headers.get('Accept', '')
+                openmetrics = 'application/openmetrics-text' in accept
+                app = getattr(self.server, 'skyt_app', None)
+                body = metrics.render_text(
+                    openmetrics=openmetrics,
+                    server_id=(app.server_id if app is not None
+                               else getattr(self.server,
+                                            'skyt_server_id', None))
+                ).encode()
                 self.send_response(200)
-                self.send_header('Content-Type',
-                                 'text/plain; version=0.0.4')
+                self.send_header(
+                    'Content-Type',
+                    'application/openmetrics-text; version=1.0.0; '
+                    'charset=utf-8' if openmetrics
+                    else 'text/plain; version=0.0.4')
                 self.send_header('Content-Length', str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif route.startswith('/api/trace/'):
+                self._handle_trace(route[len('/api/trace/'):], user)
             elif route == '/api/get':
                 self._handle_get(user)
             elif route == '/api/stream':
@@ -818,6 +846,68 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
             except (BrokenPipeError, ConnectionResetError):
                 pass
 
+    def _handle_trace(self, ident: str, user=None) -> None:
+        """GET /api/trace/<request_id|trace_id>: the assembled span
+        tree + critical path for one collected trace (docs/
+        observability.md). Request ids resolve through the persisted
+        trace_context (same view gate as the request itself); raw
+        trace ids resolve directly."""
+        from skypilot_tpu.utils import trace_store
+        ident = ident.strip('/')
+        request = requests_db.get(ident) if ident else None
+        trace_id = None
+        request_id = None
+        if request is not None:
+            if not _can_view(user, request):
+                self._error(HTTPStatus.FORBIDDEN,
+                            f'no view access to workspace '
+                            f'{request.workspace!r}')
+                return
+            request_id = request.request_id
+            trace_id = request.trace_id
+            if trace_id is None:
+                self._error(HTTPStatus.NOT_FOUND,
+                            f'request {request.request_id} has no '
+                            'trace (was SKYT_TRACE_SAMPLE set at '
+                            'submit?)')
+                return
+        else:
+            try:
+                trace_store.trace_path(ident)
+                trace_id = ident
+            except ValueError:
+                self._error(HTTPStatus.NOT_FOUND,
+                            f'no request or trace {ident!r}')
+                return
+            # A raw trace id must not bypass the workspace gate the
+            # request-id path enforces (trace ids leak via the
+            # auth-exempt /api/metrics exemplars): resolve the owning
+            # request row and apply the SAME view check. Traces with
+            # no owning request (serve LB / inference data plane) are
+            # admin-only when auth is on.
+            owner = requests_db.get_by_trace_id(trace_id)
+            if owner is not None:
+                if not _can_view(user, owner):
+                    self._error(HTTPStatus.FORBIDDEN,
+                                f'no view access to workspace '
+                                f'{owner.workspace!r}')
+                    return
+                request_id = owner.request_id
+            elif user is not None and user.role != 'admin':
+                self._error(HTTPStatus.FORBIDDEN,
+                            'raw trace-id lookup of non-request '
+                            'traces requires admin')
+                return
+        spans = trace_store.load_trace(trace_id)
+        if not spans:
+            self._error(HTTPStatus.NOT_FOUND,
+                        f'no spans stored for trace {trace_id} (not '
+                        'sampled and no tail-keep trigger?)')
+            return
+        view = trace_store.build_view(spans)
+        view['request_id'] = request_id
+        self._reply(view)
+
     def _handle_get(self, user=None) -> None:
         """Block (bounded) until the request is terminal; client re-polls.
 
@@ -833,6 +923,8 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
         deadline = time.monotonic() + timeout
         signal = _requests_signal()
         cursor = events.cursor(events.REQUESTS)
+        get_span = None
+        last_source = None
         while True:
             # Snapshot BEFORE the row read: a finalize landing between
             # this read and the wait below fires the wait immediately.
@@ -847,8 +939,41 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                             f'no view access to workspace '
                             f'{request.workspace!r}')
                 return
+            if get_span is None and tracing.armed() and \
+                    request.trace_context:
+                # The long-poll joins the request's trace: one span per
+                # poll, annotated with what ended the wait. Guarded on
+                # armed() so the disabled hot path costs one env read.
+                # observer=True: the long-poll WAITS on the request; it
+                # must not absorb the executor chain's time on the
+                # critical path (trace_store excludes observers).
+                get_span = tracing.start_span(
+                    'server.get',
+                    parent=tracing.parse_traceparent(
+                        request.trace_context),
+                    service='api-server', request_id=request_id,
+                    observer=True)
             remaining = deadline - time.monotonic()
             if request.status.is_terminal() or remaining <= 0:
+                if get_span is not None:
+                    if last_source == 'event':
+                        # Causal edge: the in-process publish (finalize
+                        # or cancel on this replica) that woke us.
+                        link = events.last_context(events.REQUESTS)
+                        if link is not None and \
+                                link[0] == get_span.context.trace_id:
+                            get_span.annotate(wakeup_span_id=link[1])
+                    failed = request.status == RequestStatus.FAILED
+                    get_span.finish(
+                        error=(RuntimeError(request.error or 'failed')
+                               if failed else None),
+                        status=request.status.value,
+                        wake_source=last_source)
+                    if failed:
+                        # Tail-keep: a FAILED request's trace matters
+                        # even at sample rate 0 — promote whatever this
+                        # process buffered for it.
+                        tracing.flush(get_span.context.trace_id)
                 self._reply(request.to_dict())
                 return
             # Relax the re-SELECT only when a wake source actually
@@ -857,10 +982,9 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
             # without one, keep the legacy 50ms poll.
             recheck = 0.5 if (events.enabled() and
                               signal is not None) else 0.05
-            cursor, _ = events.wait_for(events.REQUESTS, cursor,
-                                        min(recheck, remaining),
-                                        external=signal,
-                                        external_base=ext_base)
+            cursor, last_source = events.wait_for(
+                events.REQUESTS, cursor, min(recheck, remaining),
+                external=signal, external_base=ext_base)
 
     def _handle_sse_tail(self) -> None:
         """Server-Sent-Events live tail of a cluster job's rank-0 log
@@ -977,6 +1101,7 @@ class ApiServer:
                  server_id: Optional[str] = None) -> None:
         from skypilot_tpu import plugins
         plugins.load_plugins()
+        tracing.set_service('api-server')
         self.httpd = ThreadingHTTPServer((host, port), ApiHandler)
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus, ScheduleType
 from skypilot_tpu.utils import env_registry, events, log, resilience
+from skypilot_tpu.utils import tracing
 from skypilot_tpu.utils.subprocess_utils import kill_process_tree
 
 logger = log.init_logger(__name__)
@@ -136,8 +137,20 @@ def _run_request_in_child(request_id: str,
     from skypilot_tpu.utils import usage
     fn, _ = payloads.PAYLOADS[request.name]
     started = time.monotonic()
+    # The request's trace: SKYT_TRACE_CONTEXT (exported by the runner
+    # around the fork) makes the dispatch span ambient here; fall back
+    # to the row's persisted context for requests claimed by paths that
+    # didn't export it. The payload body runs inside executor.request,
+    # so backend/provision/sync spans (timeline.Event dual-emit) parent
+    # under it. An errored payload marks the span failed -> tail-keep
+    # promotes this process's spans even at sample rate 0.
+    parent = tracing.ambient() or tracing.parse_traceparent(
+        request.trace_context)
     try:
-        result = fn(**request.body)
+        with tracing.span('executor.request', parent=parent,
+                          service='executor', payload=request.name,
+                          request_id=request_id):
+            result = fn(**request.body)
         try:
             json.dumps(result)
         except TypeError:
@@ -165,6 +178,7 @@ def runner_main(schedule_type_value: str,
                 server_id: Optional[str] = None) -> None:
     """Body of one pool runner process (single-threaded; safe to fork)."""
     schedule_type = ScheduleType(schedule_type_value)
+    tracing.set_service('executor')
     # Import the payload entrypoints (core/execution — the heavy modules)
     # once in the runner, so every forked request child inherits them warm
     # and starts executing immediately. Plugins load here too: their
@@ -240,6 +254,24 @@ def runner_main(schedule_type_value: str,
                              _idle_wait_cap(claim_signal is not None))
             continue
         idle_sleep = 0.05
+        # Trace the dispatch hop (claim -> child exit) and export its
+        # context into the fork via SKYT_TRACE_CONTEXT, so the child's
+        # executor.request span parents under it (runner and child are
+        # distinct processes — env is the only channel the fork
+        # inherits for free). The runner is single-threaded: the env
+        # mutation cannot race another claim.
+        dispatch_span = None
+        if tracing.armed() and request.trace_context:
+            dispatch_span = tracing.start_span(
+                'executor.dispatch',
+                parent=tracing.parse_traceparent(request.trace_context),
+                service='executor', queue=schedule_type.value,
+                request_id=request.request_id)
+        if dispatch_span is not None:
+            os.environ[tracing.CONTEXT_ENV] = \
+                dispatch_span.traceparent()
+        else:
+            os.environ.pop(tracing.CONTEXT_ENV, None)
         pid = os.fork()
         if pid == 0:
             try:
@@ -247,6 +279,7 @@ def runner_main(schedule_type_value: str,
                 _run_request_in_child(request.request_id, server_id)
             finally:
                 os._exit(0)
+        os.environ.pop(tracing.CONTEXT_ENV, None)
         current_child['pid'] = pid
         # A hard-killed runner (kill -9/OOM) cannot clean up its child:
         # PDEATHSIG (set in the child) covers the child itself for free;
@@ -260,6 +293,11 @@ def runner_main(schedule_type_value: str,
             spawn_orphan_reaper(os.getpid(), pid)
         _, raw_status = os.waitpid(pid, 0)
         current_child['pid'] = None
+        if dispatch_span is not None:
+            code = (os.waitstatus_to_exitcode(raw_status)
+                    if hasattr(os, 'waitstatus_to_exitcode')
+                    else raw_status)
+            dispatch_span.finish(child_pid=pid, exit_code=code)
 
         def _finalize_if_orphaned() -> None:
             refreshed = requests_db.get(request.request_id)

@@ -96,6 +96,7 @@ def _db():
                 server_id TEXT,            -- claiming replica (HA)
                 requeues INTEGER DEFAULT 0,
                 pid_created REAL,          -- worker process start time
+                trace_context TEXT,        -- W3C traceparent (tracing)
                 created_at REAL,
                 finished_at REAL
             );
@@ -129,6 +130,10 @@ def _db():
         if 'pid_created' not in cols:
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE requests ADD COLUMN pid_created REAL')
+        if 'trace_context' not in cols:
+            common_utils.add_column_if_missing(
+                conn,
+                'ALTER TABLE requests ADD COLUMN trace_context TEXT')
         conn.commit()
 
     os.makedirs(server_dir(), exist_ok=True)
@@ -167,6 +172,15 @@ class Request:
         self.server_id: Optional[str] = row['server_id']
         self.requeues: int = row['requeues'] or 0
         self.pid_created: Optional[float] = row['pid_created']
+        self.trace_context: Optional[str] = row['trace_context']
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """trace id parsed from the persisted traceparent (the handle
+        /api/trace and metric exemplars resolve)."""
+        from skypilot_tpu.utils import tracing
+        ctx = tracing.parse_traceparent(self.trace_context)
+        return ctx.trace_id if ctx is not None else None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -181,6 +195,7 @@ class Request:
             'workspace': self.workspace,
             'created_at': self.created_at,
             'finished_at': self.finished_at,
+            'trace_id': self.trace_id,
         }
 
 
@@ -189,12 +204,17 @@ def create(name: str,
            schedule_type: ScheduleType,
            user: Optional[str] = None,
            idem_key: Optional[str] = None,
-           workspace: Optional[str] = None) -> str:
+           workspace: Optional[str] = None,
+           trace_context: Optional[str] = None) -> str:
     """Insert a PENDING request; return its id.
 
     ``idem_key`` makes submission retry-safe: a client resubmitting after a
     dropped connection (chaos: tests/chaos_proxy.py) gets the original
     request_id back instead of double-scheduling the work.
+
+    ``trace_context`` (W3C traceparent) is the distributed-tracing
+    identity: the executor exports it into the request child so every
+    backend span parents under the submitting span.
     """
     from skypilot_tpu.utils import pg
     request_id = common_utils.new_request_id()
@@ -202,11 +222,11 @@ def create(name: str,
     try:
         conn.execute(
             'INSERT INTO requests (request_id, name, body, status, '
-            'schedule_type, "user", idem_key, workspace, created_at) '
-            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
+            'schedule_type, "user", idem_key, workspace, trace_context, '
+            'created_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
             (request_id, name, json.dumps(body), RequestStatus.PENDING.value,
              schedule_type.value, user or common_utils.get_user(), idem_key,
-             workspace, time.time()))
+             workspace, trace_context, time.time()))
         conn.commit()
     except (sqlite3.IntegrityError, pg.PgError) as e:
         # Roll back FIRST, on every branch — the failed INSERT opened
@@ -230,6 +250,16 @@ def create(name: str,
     # poll tick.
     events.publish(events.REQUESTS, conn=conn)
     return request_id
+
+
+def get_by_trace_id(trace_id: str) -> Optional[Request]:
+    """The request row owning ``trace_id`` (persisted traceparent is
+    '00-<trace_id>-...'), so /api/trace can apply the SAME workspace
+    view gate to raw-trace-id lookups as to request-id ones."""
+    row = _db().execute(
+        'SELECT * FROM requests WHERE trace_context LIKE ? LIMIT 1',
+        (f'00-{trace_id}-%',)).fetchone()
+    return Request(row) if row is not None else None
 
 
 def get(request_id: str) -> Optional[Request]:
@@ -457,6 +487,29 @@ def pending_depth_by_queue() -> Dict[str, int]:
         (RequestStatus.PENDING.value,)).fetchall()
     out = {t.value: 0 for t in ScheduleType}
     out.update({r['schedule_type']: r['n'] for r in rows})
+    return out
+
+
+def terminal_durations(limit: int = 500
+                       ) -> List[Tuple[str, str, float, Optional[str]]]:
+    """(name, status, seconds, trace_id) of the most recently finished
+    requests — feeds the skyt_request_exec_seconds histogram (and its
+    OpenMetrics exemplars) on /api/metrics scrape. Durations come from
+    persisted wall timestamps (the only clock that survives the
+    process), windowed so scrape cost stays bounded."""
+    from skypilot_tpu.utils import tracing
+    rows = _db().execute(
+        'SELECT name, status, created_at, finished_at, trace_context '
+        'FROM requests WHERE finished_at IS NOT NULL '
+        f'ORDER BY finished_at DESC LIMIT {int(limit)}').fetchall()
+    out: List[Tuple[str, str, float, Optional[str]]] = []
+    for r in rows:
+        if r['created_at'] is None:
+            continue
+        seconds = max(0.0, r['finished_at'] - r['created_at'])
+        ctx = tracing.parse_traceparent(r['trace_context'])
+        out.append((r['name'], r['status'], seconds,
+                    ctx.trace_id if ctx is not None else None))
     return out
 
 

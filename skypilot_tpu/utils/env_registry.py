@@ -75,7 +75,27 @@ DECLARATIONS: List[EnvVar] = _decl([
     ('SKYT_LOG_LEVEL', 'str', 'INFO',
      'Root logger level (DEBUG/INFO/WARNING/ERROR).'),
     ('SKYT_TIMELINE_FILE', 'path', None,
-     'Write opt-in Chrome-trace timeline JSON to this path.'),
+     'Write opt-in Chrome-trace timeline JSONL to this path '
+     '(timeline.load()/export() convert to viewer JSON).'),
+    ('SKYT_TRACE_BUFFER', 'int', 512,
+     'Distributed tracing: max non-head-sampled spans buffered '
+     'per process awaiting a tail-keep trigger (oldest trace '
+     'evicted past it).'),
+    ('SKYT_TRACE_CONTEXT', 'str', None,
+     'Distributed tracing: W3C traceparent inherited by child '
+     'processes (exported by the executor runner so backend/'
+     'provision spans parent under the request trace).'),
+    ('SKYT_TRACE_DIR', 'path', None,
+     'Distributed tracing: span store directory override (default: '
+     '<server_dir>/traces).'),
+    ('SKYT_TRACE_SAMPLE', 'float', None,
+     'Distributed tracing: head-sampling rate in [0,1]. Unset '
+     'disables tracing entirely; 0 still tail-keeps errored/slow '
+     'requests (docs/observability.md).'),
+    ('SKYT_TRACE_SLOW_MS', 'float', 10000.0,
+     'Distributed tracing: spans at/over this duration promote '
+     'their whole trace to the store even when not head-sampled '
+     '(tail keep for deadline-busting requests).'),
     ('SKYT_CHECK_CACHE_TTL', 'float', 300.0,
      'Cloud-credential check cache TTL (seconds).'),
     ('SKYT_FAULT_SPEC', 'str', None,

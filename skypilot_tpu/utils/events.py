@@ -92,11 +92,15 @@ def pg_channel(topic: str) -> str:
 
 
 class _Topic:
-    __slots__ = ('cond', 'seq')
+    __slots__ = ('cond', 'seq', 'last_ctx')
 
     def __init__(self) -> None:
         self.cond = threading.Condition()
         self.seq = 0
+        # (trace_id, span_id) of the most recent publisher's ambient
+        # tracing span — wakeups become causal edges: a woken waiter
+        # annotates its span with the publish that caused it.
+        self.last_ctx: Optional[Tuple[str, str]] = None
 
 
 _topics: Dict[str, _Topic] = {}
@@ -123,6 +127,15 @@ def cursor(name: str) -> int:
     you wait on, so a write landing in between reads as ``seq > cursor``
     and the next :func:`wait_for` returns immediately."""
     return _topic(name).seq
+
+
+def last_context(name: str) -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the most recent IN-PROCESS publisher on
+    ``name``, for causal-edge annotations after an 'event' wake. Cross-
+    process transports (LISTEN/NOTIFY, data_version) carry no payload,
+    so external wakes read the last local publish — callers should only
+    link when the wake source was 'event' (see docs/observability.md)."""
+    return _topic(name).last_ctx
 
 
 def _count_wakeup(name: str, source: str) -> None:
@@ -153,9 +166,16 @@ def publish(name: str, conn=None) -> int:
         fault_injection.inject(f'events.publish.{name}')
     except Exception:  # pylint: disable=broad-except
         suppressed = True
+    # Capture the publisher's tracing context (None when tracing is
+    # disarmed — one env lookup) so in-process waiters can link their
+    # wakeup back to the write that caused it.
+    from skypilot_tpu.utils import tracing
+    publish_ctx = tracing.current_ids()
     with topic.cond:
         topic.seq += 1
         seq = topic.seq
+        if publish_ctx is not None:
+            topic.last_ctx = publish_ctx
         if not suppressed:
             topic.cond.notify_all()
     with _counts_lock:

@@ -4,8 +4,22 @@ Parity: ``sky/utils/timeline.py:23`` -- opt-in tracing written as Chrome
 ``chrome://tracing`` / Perfetto JSON when ``SKYT_TIMELINE_FILE`` is set.
 ``@timeline.event('name')`` decorates hot functions (launch / provision /
 sync / setup stages); ``with timeline.Event('name'):`` wraps ad-hoc
-spans. Events are buffered in-process and flushed on exit (and on every
-``save()``), one complete-event (ph='X') per span.
+spans.
+
+On-disk format: **JSONL, one complete-event per line**, flushed with an
+flock'd append — multi-process runs (executor forks) accumulate by
+appending, instead of the old read-merge-rewrite of the whole JSON
+under flock (O(n^2) across flushes, and two children racing the rewrite
+window could still drop spans). Conversion to the Chrome/Perfetto dict
+happens at READ time: :func:`load` parses the JSONL (accepting legacy
+whole-JSON files), and ``save(path, trace_id=...)`` exports a stored
+distributed trace (utils/trace_store.py) in the same viewer format.
+
+``Event`` is also the bridge into distributed tracing: when tracing is
+armed (``SKYT_TRACE_SAMPLE``) and an ambient trace context exists (an
+executor child running a traced request), every timeline event ALSO
+records a child span — provision/sync/setup/transfer hops show up in
+``skyt trace`` without a second instrumentation pass.
 """
 from __future__ import annotations
 
@@ -29,13 +43,15 @@ def enabled() -> bool:
 
 
 class Event:
-    """Context manager recording one complete trace event."""
+    """Context manager recording one complete trace event (and, when a
+    distributed trace is ambient, one tracing span)."""
 
     def __init__(self, name: str, **args: Any) -> None:
         self._name = name
         self._args = args
         self._begin: Optional[float] = None
         self._begin_mono: Optional[float] = None
+        self._tspan = None
 
     def __enter__(self) -> 'Event':
         # Wall clock for the displayed 'ts' (trace viewers align
@@ -43,11 +59,19 @@ class Event:
         # mid-span can't stretch or negate the measured duration.
         self._begin = time.time()
         self._begin_mono = time.monotonic()
+        from skypilot_tpu.utils import tracing
+        if tracing.armed() and tracing.ambient() is not None:
+            self._tspan = tracing.span(self._name, **self._args)
+            self._tspan.__enter__()
         return self
 
     def __exit__(self, *exc) -> None:
+        if self._tspan is not None:
+            self._tspan.__exit__(*exc)
+            self._tspan = None
         if not enabled() or self._begin is None:
             return
+        from skypilot_tpu.utils import tracing
         dur = time.monotonic() - (self._begin_mono
                                   if self._begin_mono is not None
                                   else 0.0)
@@ -57,7 +81,9 @@ class Event:
             'ts': self._begin * 1e6,            # microseconds
             'dur': dur * 1e6,
             'pid': os.getpid(),
-            'tid': threading.get_ident() % 1_000_000,
+            # Stable small per-thread lane (get_ident() % 1e6 could
+            # collide two threads into one lane).
+            'tid': tracing.stable_tid(),
         }
         if self._args:
             record['args'] = {k: str(v) for k, v in self._args.items()}
@@ -75,7 +101,8 @@ def event(name_or_fn=None, **event_args):
     def wrap(fn: Callable, name: str):
         @functools.wraps(fn)
         def inner(*args, **kwargs):
-            if not enabled():
+            from skypilot_tpu.utils import tracing
+            if not enabled() and not tracing.armed():
                 return fn(*args, **kwargs)
             with Event(name, **event_args):
                 return fn(*args, **kwargs)
@@ -89,40 +116,125 @@ def event(name_or_fn=None, **event_args):
     return deco
 
 
-def save(path: Optional[str] = None) -> Optional[str]:
-    """Flush buffered events as a Chrome trace JSON; returns the path."""
+def save(path: Optional[str] = None, *,
+         trace_id: Optional[str] = None) -> Optional[str]:
+    """Flush buffered events as flock'd JSONL appends; returns the path.
+
+    With ``trace_id``, instead export that stored distributed trace
+    (utils/trace_store.py) as a Chrome/Perfetto JSON file at ``path`` —
+    the existing viewer path works on any collected trace.
+    """
+    if trace_id is not None:
+        return _export_trace(trace_id, path)
     path = path or os.environ.get(ENV_VAR)
     if not path:
         return None
     with _lock:
-        events = list(_events)
+        events, _events[:] = list(_events), []
     if not events:
-        return None
+        return path if os.path.exists(os.path.expanduser(path)) else None
     path = os.path.expanduser(path)
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
-    # Merge with an existing file so multi-process runs (executor forks)
-    # accumulate into one trace; the read-merge-replace is serialized
-    # with flock or two children flushing together would drop spans.
     import fcntl
-    lock_path = path + '.lock'
-    with open(lock_path, 'w', encoding='utf-8') as lock_file:
-        fcntl.flock(lock_file, fcntl.LOCK_EX)
-        existing: List[Dict[str, Any]] = []
-        if os.path.exists(path):
-            try:
-                with open(path, encoding='utf-8') as f:
-                    existing = json.load(f).get('traceEvents', [])
-            except (json.JSONDecodeError, OSError):
-                existing = []
-        seen = {(e['pid'], e['tid'], e['ts'], e['name'])
-                for e in existing}
-        merged = existing + [
-            e for e in events
-            if (e['pid'], e['tid'], e['ts'], e['name']) not in seen]
-        tmp = f'{path}.{os.getpid()}.tmp'
-        with open(tmp, 'w', encoding='utf-8') as f:
-            json.dump({'traceEvents': merged, 'displayTimeUnit': 'ms'}, f)
-        os.replace(tmp, path)
+    payload = ''.join(json.dumps(e) + '\n' for e in events)
+    with open(path, 'a', encoding='utf-8') as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        f.write(payload)
+        f.flush()
+    return path
+
+
+def load(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Read a timeline file into the Chrome trace dict
+    (``{'traceEvents': [...], 'displayTimeUnit': 'ms'}``). Accepts both
+    the JSONL format written by :func:`save` and legacy whole-JSON
+    files from older versions."""
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        text = f.read()
+    events: List[Dict[str, Any]] = []
+    stripped = text.lstrip()
+    if stripped.startswith('{') and '\n{' not in text.strip():
+        try:  # legacy single-dict file
+            doc = json.loads(text)
+            if isinstance(doc, dict) and 'traceEvents' in doc:
+                return doc
+        except json.JSONDecodeError:
+            pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail line from a crashed writer
+        if isinstance(record, dict) and 'traceEvents' in record:
+            events.extend(record['traceEvents'])  # legacy line
+        elif isinstance(record, dict):
+            events.append(record)
+    return {'traceEvents': events, 'displayTimeUnit': 'ms'}
+
+
+def export(path: str, out_path: str) -> str:
+    """JSONL timeline -> Chrome JSON file (for viewers that want the
+    classic single-document form)."""
+    doc = load(path) or {'traceEvents': [], 'displayTimeUnit': 'ms'}
+    out_path = os.path.expanduser(out_path)
+    os.makedirs(os.path.dirname(out_path) or '.', exist_ok=True)
+    tmp = f'{out_path}.{os.getpid()}.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def _export_trace(trace_id: str, path: Optional[str]) -> Optional[str]:
+    """A stored distributed trace as Chrome/Perfetto JSON: one X event
+    per span plus process_name metadata per (pid, service)."""
+    from skypilot_tpu.utils import trace_store
+    spans = trace_store.load_trace(trace_id)
+    if not spans:
+        return None
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    events: List[Dict[str, Any]] = []
+    seen_procs = set()
+    for s in spans:
+        pid = s.get('pid', 0)
+        service = s.get('service', '?')
+        if pid not in seen_procs:
+            seen_procs.add(pid)
+            events.append({'ph': 'M', 'name': 'process_name',
+                           'pid': pid, 'tid': 0,
+                           'args': {'name': f'{service} ({pid})'}})
+        args = dict(s.get('annotations') or {})
+        args['span_id'] = s.get('span_id')
+        if s.get('parent_span_id'):
+            args['parent_span_id'] = s['parent_span_id']
+        if s.get('status') == 'error':
+            args['error'] = s.get('error', 'error')
+        events.append({
+            'name': s.get('name', '?'),
+            'ph': 'X',
+            'ts': s.get('start', 0.0) * 1e6,
+            'dur': s.get('dur_ms', 0.0) * 1e3,
+            'pid': pid,
+            'tid': s.get('tid', 0),
+            'args': {k: str(v) for k, v in args.items()},
+        })
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    tmp = f'{path}.{os.getpid()}.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    os.replace(tmp, path)
     return path
 
 

@@ -1,0 +1,206 @@
+"""Durable per-trace span store + the critical-path computation.
+
+Spans are appended as flock'd JSONL, one file per trace, next to the
+request logs (``<server_dir>/traces/<trace_id>.jsonl``) — the same
+durability story as the request log itself: any process that shares the
+state dir (server threads, executor runners, forked request children,
+serve/service processes) appends; ``GET /api/trace/<request_id>``
+re-reads and assembles.
+
+The read side turns the flat span list into the artifact that matters
+(Mystery Machine's lesson: the *critical path*, not the spans): a
+synthetic root covering the trace's full extent, a parent/child tree,
+and the longest blocking chain with per-hop self-time — walked over
+*subtree* extents so asynchronous children (a request child finishing
+long after the submit span that spawned it) stay on the path.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import env_registry
+
+_EPS_MS = 0.001
+
+
+def traces_dir() -> str:
+    override = env_registry.get_str('SKYT_TRACE_DIR')
+    if override:
+        return os.path.expanduser(override)
+    from skypilot_tpu.server import requests_db
+    return os.path.join(requests_db.server_dir(), 'traces')
+
+
+def _valid_trace_id(trace_id: str) -> bool:
+    return (len(trace_id) == 32 and
+            all(c in '0123456789abcdef' for c in trace_id))
+
+
+def trace_path(trace_id: str) -> str:
+    if not _valid_trace_id(trace_id):
+        raise ValueError(f'malformed trace id {trace_id!r}')
+    return os.path.join(traces_dir(), f'{trace_id}.jsonl')
+
+
+def append_spans(trace_id: str, spans: List[dict]) -> str:
+    """flock'd JSONL append — concurrent writers (runner + child +
+    server threads) interleave whole lines, never torn ones."""
+    import fcntl
+    path = trace_path(trace_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = ''.join(json.dumps(s) + '\n' for s in spans)
+    with open(path, 'a', encoding='utf-8') as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        f.write(payload)
+        f.flush()
+    return path
+
+
+def load_trace(trace_id: str) -> List[dict]:
+    """All spans of a trace, deduplicated by span_id (last write wins —
+    a re-flushed buffer must not double spans)."""
+    path = trace_path(trace_id)
+    if not os.path.exists(path):
+        return []
+    by_id: Dict[str, dict] = {}
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                span = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a crashed writer
+            if isinstance(span, dict) and span.get('span_id'):
+                by_id[span['span_id']] = span
+    return sorted(by_id.values(), key=lambda s: s.get('start', 0.0))
+
+
+def list_traces(limit: int = 100) -> List[str]:
+    d = traces_dir()
+    if not os.path.isdir(d):
+        return []
+    names = [f[:-6] for f in os.listdir(d) if f.endswith('.jsonl')]
+    names.sort(key=lambda n: os.path.getmtime(
+        os.path.join(d, n + '.jsonl')), reverse=True)
+    return names[:limit]
+
+
+# -- tree + critical path ----------------------------------------------
+
+
+def _end(span: dict) -> float:
+    return span.get('start', 0.0) + span.get('dur_ms', 0.0) / 1000.0
+
+
+def build_view(spans: List[dict]) -> Dict[str, Any]:
+    """Assemble the /api/trace payload: the span list (with relative
+    times), the parent/child tree, and the critical path."""
+    if not spans:
+        return {'spans': [], 'critical_path': [], 'total_ms': 0.0}
+    t0 = min(s['start'] for s in spans)
+    t_end = max(_end(s) for s in spans)
+    # Observer spans (annotations.observer, e.g. the /api/get long-poll)
+    # passively WAIT on the work; left in, the poll span would absorb
+    # the whole wait as its own self-time and hide the executor chain
+    # underneath. They stay in the span list but not in the path walk.
+    path_spans = [s for s in spans
+                  if not (s.get('annotations') or {}).get('observer')]
+    by_id = {s['span_id']: s for s in path_spans}
+    children: Dict[Optional[str], List[dict]] = {}
+    for s in path_spans:
+        parent = s.get('parent_span_id')
+        if parent not in by_id:
+            parent = None  # stored-orphan -> root
+        children.setdefault(parent, []).append(s)
+
+    # Subtree extent: an async child (executor work outliving the
+    # submit span) extends its ancestors' effective window.
+    eff_end: Dict[str, float] = {}
+
+    def _eff(span: dict) -> float:
+        sid = span['span_id']
+        if sid not in eff_end:
+            eff_end[sid] = max([_end(span)] + [
+                _eff(c) for c in children.get(sid, [])])
+        return eff_end[sid]
+
+    roots = children.get(None, [])
+    for root in roots:
+        _eff(root)
+
+    critical = []
+    if roots:
+        critical = _critical_path(
+            roots, children, _eff,
+            min(s['start'] for s in roots),
+            max(_eff(r) for r in roots))
+    out_spans = []
+    for s in spans:
+        entry = dict(s)
+        entry['start_ms'] = round((s['start'] - t0) * 1000.0, 3)
+        out_spans.append(entry)
+    crit_ids = {c['span_id'] for c in critical if c['span_id']}
+    return {
+        'trace_id': spans[0].get('trace_id'),
+        'span_count': len(spans),
+        'total_ms': round((t_end - t0) * 1000.0, 3),
+        'services': sorted({s.get('service', '?') for s in spans}),
+        'processes': sorted({s.get('pid', 0) for s in spans}),
+        'spans': out_spans,
+        'critical_path': [
+            {**c, 'start_ms': round((c['start'] - t0) * 1000.0, 3)}
+            for c in critical],
+        'critical_span_ids': sorted(crit_ids),
+    }
+
+
+def _critical_path(roots: List[dict],
+                   children: Dict[Optional[str], List[dict]],
+                   eff, window_start: float,
+                   window_end: float) -> List[dict]:
+    """Last-finishing-child walk (Mystery Machine shape): from the end
+    of the window, repeatedly descend into the child whose subtree
+    finished last before the cursor; the gaps between children are the
+    parent's self-time on the path. Returns chronological segments
+    ``{span_id, name, service, start, self_ms}``."""
+
+    def walk(span: Optional[dict], kids: List[dict], start: float,
+             cursor: float, depth: int) -> List[dict]:
+        if depth > 200:  # defensive: cyclic/corrupt parent links
+            return []
+        segments: List[dict] = []
+        for child in sorted(kids, key=eff, reverse=True):
+            child_end = min(eff(child), cursor)
+            if child_end <= start + _EPS_MS / 1000.0:
+                continue
+            if eff(child) > cursor + _EPS_MS / 1000.0:
+                # Child extends past the cursor (overlaps a later
+                # sibling already on the path): not the blocker here.
+                continue
+            gap_ms = (cursor - child_end) * 1000.0
+            if span is not None and gap_ms > _EPS_MS:
+                segments.append(_segment(span, child_end, gap_ms))
+            segments.extend(
+                walk(child, children.get(child['span_id'], []),
+                     child['start'], child_end, depth + 1))
+            cursor = min(cursor, child['start'])
+        if span is not None and (cursor - start) * 1000.0 > _EPS_MS:
+            segments.append(_segment(span, start, (cursor - start) * 1000.0))
+        segments.sort(key=lambda seg: seg['start'])
+        return segments
+
+    return walk(None, roots, window_start, window_end, 0)
+
+
+def _segment(span: dict, start: float, self_ms: float) -> dict:
+    return {
+        'span_id': span['span_id'],
+        'name': span.get('name', '?'),
+        'service': span.get('service', '?'),
+        'start': start,
+        'self_ms': round(self_ms, 3),
+    }

@@ -10,6 +10,15 @@ def emit_correct(outcome, seconds):
     metrics.LB_POOL_REUSE.inc()
 
 
+def emit_exemplar(seconds, trace_id, name):
+    # 'exemplar' (the OpenMetrics trace attachment) and 'amount' are
+    # NOT labels — the label-set check must skip them.
+    metrics.REQUEST_EXEC_SECONDS.observe(
+        seconds, exemplar=trace_id, name=name, status='SUCCEEDED')
+    metrics.LB_TTFB.observe(seconds, exemplar=trace_id)
+    metrics.LB_POOL_REUSE.inc(amount=2)
+
+
 def emit_dynamic(stat):
     # Declared dynamic prefix.
     return f'skyt_inference_{stat}'

@@ -41,12 +41,74 @@ def test_timeline_records_launch_stages(tmp_path, monkeypatch):
     execution.launch(task, cluster_name='tl')
     path = timeline.save()
     assert path == str(trace)
-    data = json.loads(trace.read_text())
+    # On-disk format is JSONL (flock'd appends); load() converts to
+    # the Chrome dict at read time.
+    data = timeline.load(path)
     names = {e['name'] for e in data['traceEvents']}
     assert 'provision' in names and 'setup' in names
     prov = next(e for e in data['traceEvents'] if e['name'] == 'provision')
     assert prov['ph'] == 'X' and prov['dur'] > 0
     assert prov['args']['cluster'] == 'tl'
+
+
+def test_timeline_jsonl_appends_accumulate(tmp_path, monkeypatch):
+    """Repeated saves append (multi-process accumulation shape) and
+    drain the buffer — no O(n^2) re-merge, no duplicated events."""
+    trace = tmp_path / 'trace.jsonl'
+    monkeypatch.setenv(timeline.ENV_VAR, str(trace))
+    with timeline.Event('first'):
+        pass
+    assert timeline.save() == str(trace)
+    with timeline.Event('second'):
+        pass
+    timeline.save()
+    timeline.save()  # empty flush must not duplicate
+    data = timeline.load(str(trace))
+    names = [e['name'] for e in data['traceEvents']]
+    assert sorted(names) == ['first', 'second']
+    # Raw file is line-delimited JSON (one record per line).
+    lines = [l for l in trace.read_text().splitlines() if l.strip()]
+    assert len(lines) == 2
+    assert all(json.loads(l)['ph'] == 'X' for l in lines)
+
+
+def test_timeline_load_accepts_legacy_whole_json(tmp_path):
+    legacy = tmp_path / 'legacy.json'
+    legacy.write_text(json.dumps({
+        'traceEvents': [{'name': 'old', 'ph': 'X', 'ts': 1, 'dur': 2,
+                         'pid': 1, 'tid': 1}],
+        'displayTimeUnit': 'ms'}))
+    data = timeline.load(str(legacy))
+    assert [e['name'] for e in data['traceEvents']] == ['old']
+
+
+def test_timeline_thread_lanes_are_stable_and_distinct(tmp_path,
+                                                       monkeypatch):
+    """Two threads must land in two lanes (get_ident() % 1e6 could
+    collide them), and one thread keeps one lane across events."""
+    import threading
+    trace = tmp_path / 'tids.jsonl'
+    monkeypatch.setenv(timeline.ENV_VAR, str(trace))
+
+    def work(name):
+        with timeline.Event(name):
+            pass
+        with timeline.Event(name + '-again'):
+            pass
+
+    threads = [threading.Thread(target=work, args=(f'w{i}',))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    timeline.save()
+    events = timeline.load(str(trace))['traceEvents']
+    by_name = {e['name']: e['tid'] for e in events}
+    assert by_name['w0'] == by_name['w0-again']
+    assert by_name['w1'] == by_name['w1-again']
+    assert by_name['w0'] != by_name['w1']
+    assert all(0 < e['tid'] < 10_000 for e in events)
 
 
 def test_timeline_disabled_is_noop(tmp_path, monkeypatch):
@@ -65,7 +127,7 @@ def test_timeline_decorator(monkeypatch, tmp_path):
 
     assert fn() == 42
     path = timeline.save()
-    data = json.loads(open(path).read())
+    data = timeline.load(path)
     assert any(e['name'] == 'my-span' for e in data['traceEvents'])
 
 
@@ -110,13 +172,27 @@ def test_metrics_endpoint_shows_provision_p50(monkeypatch):
         resp = requests_lib.get(f'{srv.url}/api/metrics', timeout=10)
         assert resp.status_code == 200
         text = resp.text
+        # Every /api/metrics sample carries the serving replica's
+        # identity as a render-time constant label (HA scrapes stay
+        # distinguishable) ...
+        sid = srv.server_id
         # provision latency histogram present with >=1 sample
-        assert 'skyt_provision_seconds_count{cloud="fake"} 1' in text
+        assert (f'skyt_provision_seconds_count{{cloud="fake",'
+                f'server_id="{sid}"}} 1') in text
         # request counter reflects the launch payload
-        assert 'skyt_requests_total{name="launch",status="SUCCEEDED"}' \
-            in text
+        assert (f'skyt_requests_total{{name="launch",'
+                f'server_id="{sid}",status="SUCCEEDED"}}') in text
         # queue gauges render for both queues
-        assert 'skyt_request_queue_depth{queue="LONG"}' in text
+        assert 'skyt_request_queue_depth{queue="LONG"' in text
+        # ... plus the build-info gauge.
+        import skypilot_tpu
+        assert (f'skyt_build_info{{server_id="{sid}",'
+                f'version="{skypilot_tpu.__version__}"}} 1') in text
+        # Direct renders (no replica identity passed) stay unstamped —
+        # the LB surface and in-process test renders must not inherit
+        # another server's id.
+        assert 'server_id=' not in '\n'.join(
+            metrics.QUEUE_DEPTH.render())
         # p50 computable from the durable samples
         metrics.collect_from_db()
         assert metrics.PROVISION_SECONDS.quantile(0.5, cloud='fake') > 0

@@ -335,3 +335,38 @@ def test_decode_cadence_bounded_while_long_prompt_prefills(paged_engine):
     assert max(gaps) < 5.0, max(gaps)
     assert len(long_out['ids']) == 2
     assert eng.stats()['prefill_chunks'] >= chunks_before + 10
+
+
+def test_engine_emits_trace_spans_per_request(paged_engine, tmp_home,
+                                              monkeypatch):
+    """Distributed tracing through the engine: a request submitted with
+    a trace context records an infer.request span with queue-wait /
+    prefill-chunk / decode children sharing the caller's trace_id
+    (docs/observability.md)."""
+    from skypilot_tpu.utils import trace_store, tracing
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '1')
+    tracing.reset_for_tests()
+    ctx = tracing.SpanContext.new_root()
+    ids = [(3 * i + 5) % 512 for i in range(21)]  # 3 chunks
+    out = paged_engine.generate_ids(ids, max_new_tokens=4,
+                                    trace_ctx=ctx)
+    assert len(out) <= 4
+    spans = trace_store.load_trace(ctx.trace_id)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s['name'], []).append(s)
+    assert len(by_name['infer.request']) == 1
+    request_span = by_name['infer.request'][0]
+    assert request_span['parent_span_id'] == ctx.span_id
+    assert request_span['annotations']['tokens'] == len(out)
+    assert len(by_name['infer.prefill_chunk']) >= 3
+    for child_name in ('infer.queue_wait', 'infer.prefill_chunk',
+                       'infer.decode'):
+        for child in by_name[child_name]:
+            assert child['parent_span_id'] == request_span['span_id']
+    decode = by_name['infer.decode'][0]
+    assert decode['annotations']['tokens'] == len(out)
+    # Untraced requests stay span-free (no ctx -> no bookkeeping).
+    paged_engine.generate_ids([1, 2, 3], max_new_tokens=2)
+    assert len(trace_store.load_trace(ctx.trace_id)) == len(spans)
+    tracing.reset_for_tests()
