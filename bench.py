@@ -236,6 +236,43 @@ def _kernels_smoke(on_accel: bool) -> int:
                                        impl='pallas'),
            ref_dec, 0.12)  # int8 cache quantization error floor
 
+    # Fused paged-attention kernel (r13): block tables feed the KV
+    # BlockSpec index maps — pool blocks DMA directly, no gathered
+    # view. bs=32 keeps the int8 variant tileable (32-sublane tile).
+    from skypilot_tpu.ops.pallas import paged_attention as pa
+    bs_pool = 32
+    bps = t // bs_pool
+    nb = b * bps + 1
+    k_pool = jax.random.normal(ks[1], (nb, bs_pool, kv, d), dt)
+    v_pool = jax.random.normal(ks[2], (nb, bs_pool, kv, d), dt)
+    # Shuffled non-contiguous tables: a row-order bug cannot hide
+    # behind an identity layout.
+    ids_pool = np.arange(1, nb)
+    np.random.RandomState(0).shuffle(ids_pool)
+    btab = jnp.asarray(ids_pool[:b * bps].reshape(b, bps), jnp.int32)
+    ref_paged = pa.xla_paged_attention(q1, k_pool, v_pool, btab, n_valid)
+    record('paged_kernel',
+           lambda: pa.paged_attention(q1, k_pool, v_pool, btab, n_valid,
+                                      impl='pallas'),
+           ref_paged, fwd_tol)
+    kpq, kps = quantize_kv(k_pool)
+    vpq, vps = quantize_kv(v_pool)
+    ref_paged8 = pa.xla_paged_attention(q1, kpq, vpq, btab, n_valid,
+                                        k_scale=kps, v_scale=vps)
+    record('paged_kernel_int8kv',
+           lambda: pa.paged_attention(q1, kpq, vpq, btab, n_valid,
+                                      k_scale=kps, v_scale=vps,
+                                      impl='pallas'),
+           ref_paged8, 0.12)
+    # Multi-query verify window (speculative decoding's batched check).
+    q4 = jax.random.normal(ks[0], (b, 4, h, d), dt)
+    ref_verify = pa.xla_paged_attention(q4, k_pool, v_pool, btab,
+                                        n_valid)
+    record('paged_verify_kernel',
+           lambda: pa.paged_attention(q4, k_pool, v_pool, btab, n_valid,
+                                      impl='pallas'),
+           ref_verify, fwd_tol)
+
     all_ok = all(c['ok'] for c in checks.values())
     print(json.dumps({
         'metric': f'pallas_kernels_lowering_{jax.default_backend()}',

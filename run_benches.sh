@@ -100,7 +100,10 @@ echo "rc=$? -> BENCH_data_transfer_${suffix}.json" >&2
 
 # Inference-engine bench: CPU-only — paged KV + chunked prefill +
 # prefix reuse vs the pre-change monolithic slot engine at equal
-# simulated HBM (docs/inference_engine.md, numbers in PERF.md).
+# simulated HBM, plus the r13 arms: fused block-table attention vs
+# the materialized view, and speculative decoding (high-acceptance
+# repeated-query trace + adversarial low-acceptance trace + spec
+# inter-token p99) (docs/inference_engine.md, numbers in PERF.md).
 echo "=== bench inference ($(date -u +%H:%M:%SZ)) ===" >&2
 timeout 900 env JAX_PLATFORMS=cpu python bench_inference.py \
   | tee "BENCH_inference_${suffix}.json"

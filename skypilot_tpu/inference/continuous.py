@@ -24,9 +24,30 @@ Decode is ONE jitted program stepping all slots together; the scheduler
 thread admits requests into free slots as others finish. Public
 surface (``generate_ids``/``stream_ids``/...) is unchanged from the
 monolithic-cache engine.
+
+Two r13 layers on top:
+
+* **Fused paged attention**: the decode/verify programs read the pool
+  through the block table inside the attention kernel
+  (``ops/pallas/paged_attention.py``) — no materialized logical-view
+  copy per layer per step.
+* **Speculative decoding** (``SKYT_SPEC_DECODE`` / ``spec_decode=``): a
+  host-side draft (n-gram prompt-lookup by default,
+  ``inference/speculative.py``) proposes up to ``draft_k`` tokens after
+  the pending one; ONE fused verify program scores the whole window;
+  the engine emits the longest prefix matching what the target model
+  samples at each position. Sampling folds the rng into the POSITION,
+  so the accepted stream is token-for-token identical to the
+  non-speculative engine (greedy exactly; temperature>0 up to kernel
+  ULP near-ties, same caveat as preemption resume). Rejected suffixes
+  roll back: lengths truncate and over-allocated tail blocks decref
+  back to the pool. Verify steps schedule exactly like decode steps —
+  chunked prefill still interleaves, preemption semantics unchanged —
+  so inter-token p99 stays bounded by the chunk budget.
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 import queue
@@ -56,20 +77,78 @@ DEFAULT_PREFILL_CHUNK = 64
 # compiled program — repeated engine construction (tests, serving
 # restarts) stops paying XLA compilation over and over.
 
+def _sample_tokens(logits, rngs, positions, temps):
+    """Fold-in-POSITION sampling, the single definition every sampling
+    site shares (plain decode, speculative targets, pending-token
+    re-seed): the token at position p is a pure function of
+    (seed, p, logits), so a speculative or resumed stream reproduces
+    the plain stream exactly."""
+    keys = jax.vmap(jax.random.fold_in)(rngs, positions)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampled = jax.vmap(
+        lambda k, l, t: jax.random.categorical(
+            k, l / jnp.maximum(t, 1e-6)))(keys, logits,
+                                          temps).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
 @functools.partial(jax.jit, static_argnames=('cfg',))
 def _decode_all_step(params, last_logits, cache, active, temps, rngs,
                      *, cfg):
     """One step for every slot: sample from last logits, advance."""
-    keys = jax.vmap(jax.random.fold_in)(rngs, cache.lengths)
-    greedy = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-    sampled = jax.vmap(
-        lambda k, l, t: jax.random.categorical(
-            k, l / jnp.maximum(t, 1e-6)))(keys, last_logits,
-                                          temps).astype(jnp.int32)
-    tokens = jnp.where(temps <= 0.0, greedy, sampled)
+    tokens = _sample_tokens(last_logits, rngs, cache.lengths, temps)
     logits, cache = decode_lib.paged_decode_step(
         params, tokens, cache, cfg, active=active)
     return tokens, logits, cache
+
+
+@jax.jit
+def _sample_pending_step(logits_row, rng, length, temp):
+    """The pending token a slot enters speculative mode with: what the
+    plain engine would sample next (same fold-in-position math, batch
+    of one)."""
+    return _sample_tokens(logits_row[None], rng[None], length[None],
+                          temp[None])[0]
+
+
+@functools.partial(jax.jit, static_argnames=('cfg', 'q_len'))
+def _spec_verify_all_step(params, cache, inputs, n_input, active, temps,
+                          rngs, *, cfg, q_len):
+    """One speculative verify step for every slot.
+
+    ``inputs`` [B, Q]: the pending token then the draft proposals
+    (slot rows beyond ``n_input`` are padding). The fused verify
+    program writes all window rows and returns per-position logits;
+    the target token for each position is then sampled with the SAME
+    fold-in-position keys the plain step would use, and a draft is
+    accepted while it equals its target. ``n_emit`` = 1 + accepted
+    prefix (the pending token always lands); ``pending`` = the first
+    non-matching target (or the bonus token after a fully-accepted
+    window) — exactly the next plain-engine token, carried to the next
+    step instead of being emitted twice. Lengths advance by ``n_emit``
+    on-device; the host rolls back further (eos, caps) by truncating
+    its mirror.
+    """
+    lengths0 = cache.lengths
+    logits, cache = decode_lib.paged_verify_step(
+        params, inputs, cache, cfg, active=active, n_input=n_input)
+    targets = [
+        _sample_tokens(logits[:, j], rngs, lengths0 + 1 + j, temps)
+        for j in range(q_len)]
+    g = jnp.stack(targets, axis=1)                           # [B, Q]
+    if q_len > 1:
+        match = (g[:, :-1] == inputs[:, 1:])
+        match &= jnp.arange(1, q_len)[None, :] < n_input[:, None]
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        n_emit = 1 + jnp.sum(accepted, axis=1)
+    else:
+        n_emit = jnp.ones_like(lengths0)
+    n_emit = jnp.where(active, jnp.minimum(n_emit, n_input),
+                       0).astype(jnp.int32)
+    pending = jnp.take_along_axis(
+        g, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    cache = dataclasses.replace(cache, lengths=lengths0 + n_emit)
+    return n_emit, pending, cache
 
 
 @functools.partial(jax.jit, static_argnames=('cfg',))
@@ -141,7 +220,10 @@ class ContinuousBatchingEngine:
                  seed: int = 0,
                  quantize: bool = False,
                  quantize_kv: bool = False,
-                 mesh: Optional[Any] = None) -> None:
+                 mesh: Optional[Any] = None,
+                 spec_decode: Optional[bool] = None,
+                 draft_k: Optional[int] = None,
+                 draft: Optional[Any] = None) -> None:
         # Real-weights path: see engine.py (models/hf_interop.py).
         if hf_checkpoint:
             from skypilot_tpu.models import hf_interop
@@ -161,6 +243,11 @@ class ContinuousBatchingEngine:
         self.max_len = min(max_len or self.cfg.max_seq_len,
                            self.cfg.max_seq_len)
         from skypilot_tpu.utils import env_registry
+        paged_block_k = env_registry.get_int('SKYT_PAGED_BLOCK_K',
+                                             default=0)
+        if paged_block_k and not self.cfg.paged_block_k:
+            self.cfg = dataclasses.replace(self.cfg,
+                                           paged_block_k=paged_block_k)
         self.block_size = (block_size or
                            env_registry.get_int('SKYT_INFER_BLOCK_SIZE',
                                                 default=DEFAULT_BLOCK_SIZE))
@@ -224,6 +311,26 @@ class ContinuousBatchingEngine:
         # HBM pressure: until it changes, retrying is pure waste
         # (prefix re-hash + reclaimable scan on the serving loop).
         self._blocked_at_version: Optional[int] = None
+        # Speculative decoding: verify window = pending token + up to
+        # draft_k proposals. Off (window 1) unless asked for via arg or
+        # SKYT_SPEC_DECODE; the draft is pluggable (speculative.py),
+        # n-gram prompt-lookup by default.
+        if spec_decode is None:
+            spec_decode = env_registry.get_bool('SKYT_SPEC_DECODE')
+        k_draft = (draft_k if draft_k is not None
+                   else env_registry.get_int('SKYT_SPEC_DRAFT_K'))
+        self._spec_window = 1 + max(0, min(int(k_draft),
+                                           self.max_len - 1)) \
+            if spec_decode else 1
+        if spec_decode and self._spec_window > 1:
+            from skypilot_tpu.inference.speculative import NGramDraft
+            self._draft = draft or NGramDraft(
+                env_registry.get_int('SKYT_SPEC_NGRAM_MAX'),
+                corpus_entries=8192)
+        else:
+            self._draft = None
+        self.spec_decode = self._draft is not None
+        self._pending_tok = np.zeros((max_slots,), np.int64)
         self._rngs = [jax.random.key(seed + 1 + i)
                       for i in range(max_slots)]
         self._last_logits = jnp.zeros((max_slots, self.cfg.vocab_size),
@@ -242,6 +349,9 @@ class ContinuousBatchingEngine:
         self._prefix_misses_total = 0
         self._prefix_tokens_reused_total = 0
         self._preemptions_total = 0
+        self._draft_tokens_total = 0
+        self._accepted_tokens_total = 0
+        self._verify_steps_total = 0
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop,
@@ -251,6 +361,9 @@ class ContinuousBatchingEngine:
                                             cfg=self.cfg)
         self._prefill_fn = functools.partial(_prefill_chunk_step,
                                              cfg=self.cfg)
+        self._spec_fn = functools.partial(_spec_verify_all_step,
+                                          cfg=self.cfg,
+                                          q_len=self._spec_window)
         self._thread.start()
 
     # -- block-table plumbing -------------------------------------------
@@ -286,6 +399,7 @@ class ContinuousBatchingEngine:
         self._host_len[slot] = 0
         self._slots[slot] = None
         self._decoding[slot] = False
+        self._pending_tok[slot] = 0
         self._bt_dirty = True
 
     def _finish(self, request: _Request,
@@ -487,6 +601,14 @@ class ContinuousBatchingEngine:
             self._last_logits = self._last_logits.at[slot].set(
                 last[0].astype(jnp.float32))
             self._rngs[slot] = jax.random.key(request.seed)
+            if self._draft is not None:
+                # Seed the speculative pending token: exactly what the
+                # plain step would sample next (same key, same logits).
+                # One scalar readback per prefill completion.
+                self._pending_tok[slot] = int(_sample_pending_step(
+                    last[0].astype(jnp.float32), self._rngs[slot],
+                    jnp.int32(state.pos),
+                    jnp.float32(request.temperature)))
             self._decoding[slot] = True
             if request.decode_start_wall is None:
                 request.decode_start_wall = time.time()
@@ -521,46 +643,186 @@ class ContinuousBatchingEngine:
             self._waiting.insert(0, request)
             self._wake.set()
 
-    def _ensure_decode_blocks(self, active_mask: np.ndarray) -> None:
-        """A slot crossing a block boundary needs its next tail block
-        BEFORE the step writes position ``length``. When the pool is
-        exhausted even after prefix-cache eviction, the most recently
-        admitted decoding request is preempted (vLLM policy: the oldest
-        request always progresses, so the system drains)."""
+    def _ensure_decode_blocks(self, active_mask: np.ndarray,
+                              needed: Optional[np.ndarray] = None
+                              ) -> None:
+        """A slot about to write positions ``length .. length+n-1``
+        needs tail blocks covering them BEFORE the step (``needed``
+        per-slot token counts; default 1 — the plain decode boundary
+        case). When the pool is exhausted even after prefix-cache
+        eviction, the most recently admitted decoding request is
+        preempted (vLLM policy: the oldest request always progresses,
+        so the system drains)."""
         for slot in range(self.max_slots):
             if not active_mask[slot]:
                 continue
             length = int(self._host_len[slot])
-            if length % self.block_size != 0:
+            n_new = 1 if needed is None else int(needed[slot])
+            if n_new <= 0:
                 continue
-            index = length // self.block_size
-            if index >= self.blocks_per_slot:
-                continue  # finish check retires it this step
-            if self._host_bt[slot, index] != 0:
+            last_pos = min(length + n_new, self.max_len) - 1
+            failed = False
+            for index in range(length // self.block_size,
+                               last_pos // self.block_size + 1):
+                if index >= self.blocks_per_slot:
+                    break  # finish check retires it this step
+                if self._host_bt[slot, index] != 0:
+                    continue
+                while True:
+                    block = self._alloc_block()
+                    if block is not None:
+                        self._slot_blocks[slot].append(block)
+                        self._host_bt[slot, index] = block
+                        self._bt_dirty = True
+                        break
+                    # Victims: any OTHER slot holding blocks — decoding
+                    # or mid-prefill (a pool drained into prefills must
+                    # not strand the decoders).
+                    victims = [s for s in range(self.max_slots)
+                               if s != slot and
+                               self._slots[s] is not None]
+                    if not victims:
+                        # Nothing left to steal from: this request
+                        # alone outgrew the pool — fail it loudly.
+                        active_mask[slot] = False
+                        self._fail_slot(slot, RuntimeError(
+                            'KV block pool exhausted mid-decode (raise '
+                            'num_blocks or lower max_slots)'))
+                        failed = True
+                        break
+                    victim = max(victims,
+                                 key=lambda s: self._admit_order[s])
+                    self._preempt(victim, active_mask)
+                if failed:
+                    break
+
+    def _trim_slot_blocks(self, slot: int, new_len: int) -> None:
+        """Speculative rollback: positions >= ``new_len`` are dead
+        (rejected drafts / post-eos rows) — decref any tail blocks past
+        the last live one back to the pool, exactly the block state a
+        non-speculative run at ``new_len`` would hold. Shared prefix
+        blocks always cover positions < the prefill length <= new_len,
+        so only private verify-window blocks are ever trimmed."""
+        keep = -(-new_len // self.block_size)
+        blocks = self._slot_blocks[slot]
+        if len(blocks) <= keep:
+            return
+        for index in range(keep, len(blocks)):
+            self._pool.decref(blocks[index])
+            self._host_bt[slot, index] = 0
+        self._slot_blocks[slot] = blocks[:keep]
+        self._bt_dirty = True
+
+    # -- speculative verify step ----------------------------------------
+
+    def _spec_step(self, active_mask: np.ndarray) -> None:
+        """Draft → batched verify → accept/rollback for every decoding
+        slot; scheduled exactly like a decode step (the caller's
+        prefill interleave and preemption paths are unchanged)."""
+        q_len = self._spec_window
+        inputs = np.zeros((self.max_slots, q_len), np.int32)
+        n_input = np.ones((self.max_slots,), np.int32)
+        for slot in range(self.max_slots):
+            if not active_mask[slot]:
                 continue
-            while True:
-                block = self._alloc_block()
-                if block is not None:
-                    self._slot_blocks[slot].append(block)
-                    self._host_bt[slot, index] = block
-                    self._bt_dirty = True
-                    break
-                # Victims: any OTHER slot holding blocks — decoding or
-                # mid-prefill (a pool drained into prefills must not
-                # strand the decoders).
-                victims = [s for s in range(self.max_slots)
-                           if s != slot and self._slots[s] is not None]
-                if not victims:
-                    # Nothing left to steal from: this request alone
-                    # outgrew the pool — fail it loudly.
-                    active_mask[slot] = False
-                    self._fail_slot(slot, RuntimeError(
-                        'KV block pool exhausted mid-decode (raise '
-                        'num_blocks or lower max_slots)'))
-                    break
-                victim = max(victims,
-                             key=lambda s: self._admit_order[s])
-                self._preempt(victim, active_mask)
+            request = self._slots[slot]
+            length = int(self._host_len[slot])
+            # Never draft past what the request can still emit or the
+            # slot can still hold: a shrunken window degrades to a
+            # plain decode step, not a stall.
+            cap = max(1, min(q_len,
+                             request.max_new_tokens -
+                             len(request.generated),
+                             self.max_len - length))
+            pending = int(self._pending_tok[slot])
+            inputs[slot, 0] = pending
+            n = 1
+            if cap > 1:
+                history = (request.token_ids + request.generated +
+                           [pending])
+                for tok in self._draft.propose(history, cap - 1):
+                    inputs[slot, n] = int(tok)
+                    n += 1
+            n_input[slot] = n
+        self._ensure_decode_blocks(active_mask, needed=n_input)
+        if not active_mask.any():
+            return
+        self._draft_tokens_total += int(
+            (n_input[active_mask] - 1).sum())
+        self._sync_tables()
+        temps = np.array([r.temperature if r else 0.0
+                          for r in self._slots], np.float32)
+        step_t0 = time.perf_counter()
+        try:
+            n_emit, pending_next, cache = self._spec_fn(
+                self.params, self.cache, jnp.asarray(inputs),
+                jnp.asarray(n_input), jnp.asarray(active_mask),
+                jnp.asarray(temps), jnp.stack(self._rngs))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('speculative verify step failed')
+            for slot in range(self.max_slots):
+                if active_mask[slot] and self._slots[slot] is not None:
+                    self._fail_slot(slot, e)
+            return
+        self.cache = cache
+        self._verify_steps_total += 1
+        # Overlap the readback with admission BOOKKEEPING only: a
+        # prefill tick would _sync_tables, and the host length mirror
+        # for verified slots is stale until n_emit lands (the plain
+        # step can mirror +1 eagerly; the verify advance is
+        # data-dependent).
+        try:
+            n_emit.copy_to_host_async()
+            pending_next.copy_to_host_async()
+        except AttributeError:
+            pass
+        overlap_t0 = time.perf_counter()
+        self._admit()
+        overlap_cost = time.perf_counter() - overlap_t0
+        host_emit = np.asarray(n_emit)
+        host_pending = np.asarray(pending_next)
+        self._decode_seconds_total += (time.perf_counter() - step_t0 -
+                                       overlap_cost)
+        for slot in range(self.max_slots):
+            request = self._slots[slot]
+            if request is None or not active_mask[slot]:
+                continue
+            m = int(host_emit[slot])
+            if m <= 0:
+                continue
+            emitted = []
+            for tok in inputs[slot, :m]:
+                emitted.append(int(tok))
+                if (request.eos_id is not None and
+                        int(tok) == request.eos_id):
+                    break  # post-eos acceptances are dead rows
+            # Count acceptance AFTER truncation: device-accepted drafts
+            # discarded as post-eos dead rows were never delivered, and
+            # the derivable acceptance rate must track delivered tokens.
+            self._accepted_tokens_total += len(emitted) - 1
+            request.generated.extend(emitted)
+            self._tokens_total += len(emitted)
+            length0 = int(self._host_len[slot])
+            new_len = length0 + len(emitted)
+            self._host_len[slot] = new_len
+            if len(emitted) < m:
+                # Device advanced by m: re-sync the truncated mirror.
+                self._bt_dirty = True
+            self._trim_slot_blocks(slot, new_len)
+            self._pending_tok[slot] = int(host_pending[slot])
+            finished = (
+                (request.eos_id is not None and
+                 emitted[-1] == request.eos_id) or
+                len(request.generated) >= request.max_new_tokens or
+                new_len >= self.max_len)
+            if finished:
+                observe = getattr(self._draft, 'observe', None)
+                if observe is not None:
+                    # Feed the completion corpus: repeated/near-repeated
+                    # queries draft their answer from the last one.
+                    observe(request.token_ids + request.generated)
+                self._finish(request)
+                self._release_slot(slot)  # blocks back to the pool
 
     # -- serving loop ---------------------------------------------------
 
@@ -580,6 +842,9 @@ class ContinuousBatchingEngine:
                     continue  # keep absorbing prefill chunks
                 self._wake.wait(0.01)
                 self._wake.clear()
+                continue
+            if self._draft is not None:
+                self._spec_step(active_mask)
                 continue
             self._ensure_decode_blocks(active_mask)
             if not active_mask.any():
@@ -782,6 +1047,13 @@ class ContinuousBatchingEngine:
             'prefix_cache_misses': self._prefix_misses_total,
             'prefix_tokens_reused': self._prefix_tokens_reused_total,
             'preemptions': self._preemptions_total,
+            # Speculative decoding: acceptance rate is derivable as
+            # accepted_tokens / draft_tokens (both counters, so it
+            # rate()s correctly over any window).
+            'draft_tokens': self._draft_tokens_total,
+            'accepted_tokens': self._accepted_tokens_total,
+            'verify_steps': self._verify_steps_total,
+            'spec_window': self._spec_window,
             # Point-in-time gauges: paged-pool pressure.
             'block_size': self.block_size,
             'blocks_total': total,

@@ -322,6 +322,15 @@ def main(argv=None) -> int:
                         help='total paged KV pool blocks (continuous '
                              'engine; default sized to max_slots * '
                              'max_len, i.e. the monolithic-cache HBM).')
+    parser.add_argument('--spec-decode', action='store_true',
+                        default=None,
+                        help='speculative decoding (continuous engine): '
+                             'n-gram drafts + one fused verify step per '
+                             'window; greedy output is identical to the '
+                             'plain engine (default $SKYT_SPEC_DECODE).')
+    parser.add_argument('--draft-k', type=int, default=None,
+                        help='draft tokens per speculative verify step '
+                             '(default $SKYT_SPEC_DRAFT_K or 4).')
     parser.add_argument('--quantize', action='store_true',
                         help='int8 W8A8 weights (half the decode HBM '
                              'traffic, 2x MXU int8 rate).')
@@ -347,7 +356,9 @@ def main(argv=None) -> int:
             num_blocks=args.kv_blocks,
             quantize=args.quantize,
             quantize_kv=args.quantize_kv,
-            mesh=args.mesh)
+            mesh=args.mesh,
+            spec_decode=args.spec_decode,
+            draft_k=args.draft_k)
         engine.generate_text('warmup', max_new_tokens=8)
     else:
         engine = InferenceEngine(args.model,

@@ -1,0 +1,164 @@
+"""Draft proposers for speculative decoding (Leviathan et al., ICML 2023).
+
+The continuous engine's speculative mode turns one verify step into up
+to ``draft_k + 1`` emitted tokens: a draft proposes ``draft_k`` tokens
+after the pending one, the fused paged kernel verifies the whole window
+in one program, and the engine accepts the longest prefix that matches
+what the target model would have sampled at each position (fold-in-
+position sampling makes that target deterministic, so the accepted
+stream is token-for-token the non-speculative stream).
+
+Drafts are HOST-side and must be cheap — they run on the serving-loop
+thread between device steps. Two proposers behind one interface:
+
+* :class:`NGramDraft` — prompt-lookup decoding (Saxena's PLD / vLLM's
+  ``ngram`` speculator): find the most recent occurrence of the
+  history's trailing n-gram and propose the tokens that followed it.
+  Free (no model), and very effective on the agentic/RAG shape where
+  generation quotes its own context. The default.
+* :class:`ModelDraft` — a small draft model behind the same interface
+  (``models/decode.generate`` greedy over the history tail). A
+  reference implementation of the pluggable-model contract: it
+  re-prefills per call, so use it with genuinely small configs or swap
+  in an incremental implementation for production.
+
+A proposer may return FEWER than ``k`` tokens (including none) — the
+engine shrinks that slot's verify window accordingly, so a miss costs
+one ordinary decode step, never a stall.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+
+class DraftModel:
+    """Interface: propose up to ``k`` tokens continuing ``history``.
+
+    ``history`` is the slot's full visible token stream — prompt,
+    accepted generations, and the pending (sampled-but-unverified)
+    token last. Implementations must be pure lookups or cheap model
+    calls; they run on the engine's serving-loop thread.
+    """
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+
+class NGramDraft(DraftModel):
+    """Prompt-lookup + recent-completion retrieval.
+
+    Two lookup tiers; at equal n-gram order the slot's own history
+    wins (local recency), but the corpus outranks LOW-order history
+    backoff — a 1-gram history guess fires on almost any natural text
+    and must not shadow a ``max_ngram`` retrieval hit:
+
+    1. **Slot history** (Saxena-style PLD): take the history's last n
+       tokens, find their most recent earlier occurrence, and propose
+       what followed it. O(len(history) * max_ngram) over a
+       max_len-bounded history.
+    2. **Completion corpus** (REST-shaped retrieval, He et al. 2023):
+       the engine ``observe``s finished streams; their ``max_ngram``-
+       grams index short continuations in a dict, and a trailing-n-gram
+       hit drafts the remembered continuation. This is what fires on
+       the agentic fleet shape — repeated/near-repeated queries whose
+       answers were just generated (the decode-side sibling of the
+       prefill prefix cache). O(1) per proposal; the index is bounded
+       by ``corpus_entries`` (crudely cleared when full — recency
+       rebuilds it in a few requests, and a draft miss only costs the
+       speculation, never correctness).
+    """
+
+    DRAFT_LEN = 16  # continuation tokens remembered per indexed n-gram
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 corpus_entries: int = 0) -> None:
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f'need 1 <= min_ngram <= max_ngram, got '
+                f'({min_ngram}, {max_ngram})')
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.corpus_entries = corpus_entries
+        self._index: dict = {}
+
+    def observe(self, tokens: Sequence[int]) -> None:
+        """Index a finished stream's n-grams (most recent wins)."""
+        if not self.corpus_entries:
+            return
+        toks = list(tokens)
+        n = self.max_ngram
+        if len(self._index) + max(len(toks) - n, 0) > self.corpus_entries:
+            self._index.clear()
+        for i in range(len(toks) - n):
+            cont = tuple(toks[i + n:i + n + self.DRAFT_LEN])
+            if cont:
+                self._index[tuple(toks[i:i + n])] = cont
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        hist = list(history)
+        n_hist = len(hist)
+        if k <= 0 or n_hist < self.min_ngram + 1:
+            return []
+        # Priority: slot history at the corpus's own n-gram order or
+        # longer (local recency wins ties), then the corpus, then
+        # shorter history n-grams — a max_ngram retrieval hit must not
+        # be shadowed by a low-order (often 1-gram) history guess,
+        # which on natural text would fire almost every step.
+        n_top = min(self.max_ngram, n_hist - 1)
+        cont = self._history_lookup(hist, n_top, k)
+        if cont:
+            return cont
+        if self._index and n_hist >= self.max_ngram:
+            indexed = self._index.get(tuple(hist[-self.max_ngram:]))
+            if indexed:
+                return list(indexed[:k])
+        for n in range(n_top - 1, self.min_ngram - 1, -1):
+            cont = self._history_lookup(hist, n, k)
+            if cont:
+                return cont
+        return []
+
+    @staticmethod
+    def _history_lookup(hist: List[int], n: int, k: int) -> List[int]:
+        """Most recent earlier occurrence of the trailing n-gram; the
+        (always non-empty, since i + n < len(hist)) continuation that
+        followed it."""
+        if n < 1:
+            return []
+        suffix = hist[-n:]
+        for i in range(len(hist) - n - 1, -1, -1):
+            if hist[i:i + n] == suffix:
+                return hist[i + n:i + n + k]
+        return []
+
+
+class ModelDraft(DraftModel):
+    """Greedy draft from a (small) model — the pluggable-model shape.
+
+    Wraps ``models/decode.generate`` over the history tail. Reference
+    implementation: it pays a fresh prefill every call (fine for tiny
+    draft configs and tests; a production draft would keep its own
+    incremental KV state behind this same interface).
+    """
+
+    def __init__(self, params: Any, cfg: Any,
+                 context_tokens: int = 64) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.context_tokens = max(1, context_tokens)
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        if k <= 0 or not history:
+            return []
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.models import decode as decode_lib
+        window = min(self.context_tokens, self.cfg.max_seq_len - k)
+        ids = list(history)[-window:]
+        tokens = jnp.asarray([ids], jnp.int32)
+        lengths = jnp.asarray([len(ids)], jnp.int32)
+        generated, gen_len = decode_lib.generate(
+            self.params, tokens, lengths, self.cfg,
+            max_new_tokens=k, temperature=0.0)
+        return [int(t) for t in
+                np.asarray(generated)[0][:int(gen_len[0])]]

@@ -77,6 +77,11 @@ class ModelConfig:
     # keep prefill on the (GSPMD-partitionable) XLA path while the decode
     # kernel runs per-shard under shard_map (inference/sharding.py).
     decode_attention_impl: Optional[str] = None
+    # Paged-attention kernel kv-block override: sub-divides a large KV
+    # pool block for VMEM shaping (must divide the pool block_size;
+    # 0 = one kernel block per pool block). Engines seed it from
+    # $SKYT_PAGED_BLOCK_K (ops/pallas/paged_attention.py).
+    paged_block_k: int = 0
     # KV cache storage: 'compute' (= compute_dtype) | 'int8' (per-row
     # scales: half the cache memory -> 2x context/slots per chip, and the
     # decode kernel dequantizes in-VMEM so the cache read stream halves).

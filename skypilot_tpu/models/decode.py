@@ -529,37 +529,76 @@ def paged_decode_step(params: Params, tokens: jax.Array,
 
     Same contract as ``decode_step`` (inactive slots neither write nor
     advance), but KV rows scatter into the slot's current tail block
-    and attention runs over the block-table-gathered view — the same
-    length-aware decode kernel sees a contiguous [B, T, KVH, D] view,
-    so the Pallas path is unchanged. Inactive slots' writes are routed
-    to the null block (id 0).
-
-    Known headroom (ROADMAP item 2): the per-layer view gather
-    materializes the slot's FULL logical view (blocks_per_slot *
-    block_size rows) before the kernel's length-aware partial read —
-    a fused block-table-aware attention kernel would read only the
-    valid blocks and drop that copy.
+    and attention runs FUSED over the pool: the block table feeds the
+    kernel's KV index maps (``ops/pallas/paged_attention.py``) so the
+    gather happens inside the attention loop — no materialized
+    ``_view_rows`` copy, and HBM reads scale with ``ceil(len/block)``
+    per slot instead of the full logical view (the headroom the r10
+    ROADMAP named; ``impl='xla'`` keeps the old gathered-view path for
+    unsupported shapes). Inactive slots' writes are routed to the null
+    block (id 0).
     """
-    b = tokens.shape[0]
+    logits, new_cache = paged_verify_step(
+        params, tokens[:, None], cache, cfg, active=active)
+    new_cache = dataclasses.replace(
+        new_cache,
+        lengths=cache.lengths + (jnp.ones_like(cache.lengths)
+                                 if active is None
+                                 else active.astype(jnp.int32)))
+    return logits[:, 0], new_cache
+
+
+def paged_verify_step(params: Params, tokens: jax.Array,
+                      cache: PagedKVCache, cfg: ModelConfig,
+                      active: Optional[jax.Array] = None,
+                      n_input: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, PagedKVCache]:
+    """Process a Q-token window per slot in ONE program (speculative
+    verify; Q == 1 is plain decode). tokens: [B, Q] int32 — position
+    ``lengths[b] + j`` holds ``tokens[b, j]``; ``n_input`` ([B], default
+    Q) masks slots with fewer real inputs (their padded rows write to
+    the null block and their padded logits are garbage the caller must
+    discard). Every row scatters into the slot's tail block(s) — the
+    caller must have block-table entries covering ``lengths + n_input``
+    rows — and attention runs fused over the pool with causal masking
+    inside the window (query j sees rows ``< lengths + j + 1``).
+
+    Returns (logits [B, Q, V], cache with KV written and lengths
+    UNCHANGED) — the caller decides how many of the Q rows survive
+    (speculative accept/reject) and advances or rolls back lengths
+    itself. ``paged_decode_step`` is the Q=1 wrapper that advances by
+    one.
+    """
+    b, q_len = tokens.shape
     if active is None:
         active = jnp.ones((b,), bool)
+    if n_input is None:
+        n_input = jnp.full((b,), q_len, jnp.int32)
     dt = cfg.compute_dtype
-    positions = cache.lengths[:, None]                       # [B, 1]
-    sin, cos = rope_table_for(cfg, positions)
-    x = _embed(params, tokens[:, None], cfg)                 # [B, 1, D]
+    lens = cache.lengths
+    offs = lens[:, None] + jnp.arange(q_len)[None, :]        # [B, Q]
+    sin, cos = rope_table_for(cfg, offs)
+    x = _embed(params, tokens, cfg)                          # [B, Q, D]
 
     bs = cache.block_size
     bps = cache.blocks_per_slot
     nb = cache.num_blocks
-    lens = cache.lengths
-    blk = jnp.clip(lens // bs, 0, bps - 1)
-    tail = jnp.take_along_axis(cache.block_tables, blk[:, None],
-                               axis=1)[:, 0]                 # [B]
-    write_rows = jnp.where(active, tail * bs + lens % bs, 0)  # [B]
-    view_rows = _view_rows(cache.block_tables, bs)           # [B, T]
-    n_valid = lens + 1
+    valid_q = ((jnp.arange(q_len)[None, :] < n_input[:, None]) &
+               active[:, None])                              # [B, Q]
+    blk = jnp.clip(offs // bs, 0, bps - 1)
+    write_rows = jnp.where(
+        valid_q,
+        jnp.take_along_axis(cache.block_tables, blk, axis=1) * bs +
+        offs % bs,
+        0)                                                   # [B, Q]
+    # Kernel mask base: rows INCLUDING the whole window. Padded window
+    # positions (j >= n_input) would attend stale rows, but their
+    # outputs are discarded by contract and the rows they'd see sit in
+    # unallocated (null) table entries, never in live blocks.
+    n_valid = jnp.where(active, lens + q_len, 1)
     quantized = cache.quantized
     impl = cfg.decode_attention_impl or cfg.attention_impl
+    block_k = cfg.paged_block_k or None
 
     def layer(carry, scanned):
         x = carry
@@ -579,22 +618,23 @@ def paged_decode_step(params: Params, tokens: jax.Array,
         if quantized:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
-            kf = kf.at[write_rows].set(k_q[:, 0])
-            vf = vf.at[write_rows].set(v_q[:, 0])
-            ksf = ksp.reshape(nb * bs, -1).at[write_rows].set(k_s[:, 0])
-            vsf = vsp.reshape(nb * bs, -1).at[write_rows].set(v_s[:, 0])
-            k_view_scale = ksf[view_rows]                    # [B, T, KVH]
-            v_view_scale = vsf[view_rows]
+            kf = kf.at[write_rows].set(k_q)
+            vf = vf.at[write_rows].set(v_q)
+            ksf = ksp.reshape(nb * bs, -1).at[write_rows].set(k_s)
+            vsf = vsp.reshape(nb * bs, -1).at[write_rows].set(v_s)
+            k_pool_scale = ksf.reshape(nb, bs, -1)
+            v_pool_scale = vsf.reshape(nb, bs, -1)
         else:
-            kf = kf.at[write_rows].set(k[:, 0].astype(kf.dtype))
-            vf = vf.at[write_rows].set(v[:, 0].astype(vf.dtype))
+            kf = kf.at[write_rows].set(k.astype(kf.dtype))
+            vf = vf.at[write_rows].set(v.astype(vf.dtype))
             ksf = vsf = None
-            k_view_scale = v_view_scale = None
-        from skypilot_tpu.ops.pallas.decode_attention import (
-            decode_attention)
-        attn = decode_attention(
-            q, kf[view_rows], vf[view_rows], n_valid,
-            k_scale=k_view_scale, v_scale=v_view_scale, impl=impl)
+            k_pool_scale = v_pool_scale = None
+        from skypilot_tpu.ops.pallas.paged_attention import paged_attention
+        attn = paged_attention(
+            q, kf.reshape(kp.shape), vf.reshape(vp.shape),
+            cache.block_tables, n_valid,
+            k_scale=k_pool_scale, v_scale=v_pool_scale, impl=impl,
+            block_k=block_k)
         x = x + weight_einsum('bshk,hkd->bsd', attn, lp['attn']['wo'], dt)
         h = rms_norm(x, lp['ln_mlp']['scale'], cfg.norm_eps)
         x = x + _mlp(h, lp, cfg)
@@ -611,10 +651,9 @@ def paged_decode_step(params: Params, tokens: jax.Array,
         x, (k_new, v_new) = jax.lax.scan(
             layer, x, (params['layers'], cache.k, cache.v))
         ks_new = vs_new = None
-    logits = _lm_head(params, x, cfg)[:, 0]                  # [B, V]
+    logits = _lm_head(params, x, cfg)                        # [B, Q, V]
     new_cache = PagedKVCache(
-        k=k_new, v=v_new,
-        lengths=cache.lengths + active.astype(jnp.int32),
+        k=k_new, v=v_new, lengths=cache.lengths,
         block_tables=cache.block_tables,
         k_scale=ks_new, v_scale=vs_new)
     return logits, new_cache

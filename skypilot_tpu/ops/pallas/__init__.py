@@ -1,6 +1,8 @@
-"""Pallas TPU kernels (flash attention, fused norms).
+"""Pallas TPU kernels (flash attention, decode + paged attention).
 
 Written against the playbook in /opt/skills/guides/pallas_guide.md. Every
-kernel has an XLA reference implementation in ops/ used for numerics tests
-on CPU meshes; dispatch happens in ops/attention.py.
+kernel has an XLA reference implementation used for numerics tests on CPU
+meshes; dispatch happens in ops/attention.py (training flash),
+decode_attention.py (monolithic-cache decode), and paged_attention.py
+(block-table-fused decode/verify over the paged KV pool).
 """

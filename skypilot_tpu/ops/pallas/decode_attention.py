@@ -41,13 +41,19 @@ DEFAULT_BLOCK_K = 512
 
 def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
-                   num_blocks: int, ks_ref=None, vs_ref=None):
-    """Grid (B, KVH, NT). q_ref [G, D]; k/v_ref [block_k, D]; o_ref [G, D].
+                   num_blocks: int, q_len: int = 1, group: int = 0,
+                   ks_ref=None, vs_ref=None):
+    """Grid (B, KVH, NT). q_ref [Q*G, D]; k/v_ref [block_k, D].
 
     Flash-style running max/sum across the (sequential, innermost) kv
     block axis; scratch persists between grid steps. Blocks at or past
     the sequence's length are skipped (their index map aliased them to
     an already-resident block, so they also cost no DMA). With
+    ``q_len > 1`` (a speculative verify window) query row ``r`` belongs
+    to window position ``r // group`` and masks
+    ``pos < n_valid - (q_len - 1 - r // group)`` — causal inside the
+    window, everything before it; each query row's math is independent,
+    so position j reproduces the single-query step bitwise. With
     ``ks_ref``/``vs_ref`` ([block_k] per-row scales) the cache is int8
     and dequantizes here in VMEM — the HBM stream stays int8.
     """
@@ -64,16 +70,21 @@ def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(ti * block_k < n_valid)
     def _block():
-        q = q_ref[:].astype(jnp.float32) * scale            # [G, D]
+        q = q_ref[:].astype(jnp.float32) * scale            # [QG, D]
         k = k_ref[:].astype(jnp.float32)                    # [bk, D]
         if ks_ref is not None:
             k = k * ks_ref[:][:, None]
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [G, bk]
+            preferred_element_type=jnp.float32)             # [QG, bk]
         pos = (ti * block_k +
                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-        s = jnp.where(pos < n_valid, s, NEG_INF)
+        if q_len > 1:
+            qj = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                  // group)
+            s = jnp.where(pos < n_valid - (q_len - 1 - qj), s, NEG_INF)
+        else:
+            s = jnp.where(pos < n_valid, s, NEG_INF)
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -97,20 +108,24 @@ def _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _decode_kernel_quant(n_valid_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                          o_ref, m_ref, l_ref, acc_ref, *, block_k: int,
-                         scale: float, num_blocks: int):
+                         scale: float, num_blocks: int, q_len: int = 1,
+                         group: int = 0):
     _decode_kernel(n_valid_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                    acc_ref, block_k=block_k, scale=scale,
-                   num_blocks=num_blocks, ks_ref=ks_ref, vs_ref=vs_ref)
+                   num_blocks=num_blocks, q_len=q_len, group=group,
+                   ks_ref=ks_ref, vs_ref=vs_ref)
 
 
 def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                    n_valid: jax.Array, scale: float, block_k: int,
                    k_scale: Optional[jax.Array] = None,
-                   v_scale: Optional[jax.Array] = None) -> jax.Array:
-    """q [B, KVH, G, D]; caches [B, T, KVH, D] (+ optional [B, KVH, T]
+                   v_scale: Optional[jax.Array] = None,
+                   q_len: int = 1) -> jax.Array:
+    """q [B, KVH, Q*G, D]; caches [B, T, KVH, D] (+ optional [B, KVH, T]
     int8 row scales, T minor for lane tiling); n_valid [B] ->
-    [B, KVH, G, D]."""
-    b, kvh, g, d = q.shape
+    [B, KVH, Q*G, D]."""
+    b, kvh, qg, d = q.shape
+    g = qg // q_len
     t = k_cache.shape[1]
     nt = t // block_k
     grid = (b, kvh, nt)
@@ -132,7 +147,7 @@ def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # by the Blocked index hi (offset hi*d), identical DMA pattern.
     kv_view = (b, t, kvh * d)
     in_specs = [
-        pl.BlockSpec((None, None, g, d),
+        pl.BlockSpec((None, None, qg, d),
                      lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
         pl.BlockSpec((None, block_k, d), kv_index),
         pl.BlockSpec((None, block_k, d), kv_index),
@@ -147,28 +162,30 @@ def _pallas_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pl.BlockSpec((None, None, block_k, None), scale_index)]
         operands += [k_scale[..., None], v_scale[..., None]]
         kernel = functools.partial(_decode_kernel_quant, block_k=block_k,
-                                   scale=scale, num_blocks=nt)
+                                   scale=scale, num_blocks=nt,
+                                   q_len=q_len, group=g)
     else:
         kernel = functools.partial(_decode_kernel, block_k=block_k,
-                                   scale=scale, num_blocks=nt)
+                                   scale=scale, num_blocks=nt,
+                                   q_len=q_len, group=g)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, None, g, d),
+        out_specs=pl.BlockSpec((None, None, qg, d),
                                lambda bi, hi, ti, n_valid: (bi, hi, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),    # running max
-            pltpu.VMEM((g, 1), jnp.float32),    # running sum
-            pltpu.VMEM((g, d), jnp.float32),    # output accumulator
+            pltpu.VMEM((qg, 1), jnp.float32),    # running max
+            pltpu.VMEM((qg, 1), jnp.float32),    # running sum
+            pltpu.VMEM((qg, d), jnp.float32),    # output accumulator
         ],
     )
     out_dtype = q.dtype
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, qg, d), out_dtype),
         interpret=interpret_mode(),
     )(n_valid, *operands)
 
@@ -184,25 +201,33 @@ def xla_decode_attention(q: jax.Array, k_cache: jax.Array,
                          v_scale: Optional[jax.Array] = None) -> jax.Array:
     """Reference path: full-cache masked attention (reads all T rows).
 
-    q [B, 1, H, D]; caches [B, T, KVH, D]; n_valid [B] -> [B, 1, H, D].
-    ``k_scale``/``v_scale`` ([B, T, KVH]) dequantize an int8 cache.
+    q [B, Q, H, D]; caches [B, T, KVH, D]; n_valid [B] -> [B, Q, H, D].
+    Query j of a Q-window masks ``pos < n_valid - (Q - 1 - j)`` (Q == 1
+    is the classic ``pos < n_valid``). ``k_scale``/``v_scale``
+    ([B, T, KVH]) dequantize an int8 cache.
     """
-    b, _, h, d = q.shape
+    b, q_len, h, d = q.shape
     kvh = k_cache.shape[2]
     g = h // kvh
     if k_scale is not None:
         k_cache = k_cache.astype(jnp.float32) * k_scale[..., None]
         v_cache = (v_cache.astype(jnp.float32) *
                    v_scale[..., None]).astype(q.dtype)
-    qg = q.reshape(b, 1, kvh, g, d)
+    qg = q.reshape(b, q_len, kvh, g, d)
     scores = jnp.einsum('bqhgk,bthk->bhgqt', qg.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * (d ** -0.5)
     t = k_cache.shape[1]
-    valid = jnp.arange(t)[None, :] < n_valid[:, None]        # [B, T]
-    scores = jnp.where(valid[:, None, None, None, :], scores, -jnp.inf)
+    limit = (n_valid[:, None] - (q_len - 1) +
+             jnp.arange(q_len)[None, :])                     # [B, Q]
+    valid = (jnp.arange(t)[None, None, :] <
+             limit[:, :, None])                              # [B, Q, T]
+    # NEG_INF (not -inf): a fully-masked query row (a padded window
+    # position the caller discards) degrades to uniform weights over
+    # garbage instead of NaN poisoning the padded row downstream.
+    scores = jnp.where(valid[:, None, None, :, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     attn = jnp.einsum('bhgqt,bthk->bqhgk', probs, v_cache)
-    return attn.reshape(b, 1, h, d)
+    return attn.reshape(b, q_len, h, d)
 
 
 def _supported(d: int, t: int, block_k: int) -> bool:
@@ -219,18 +244,20 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      v_scale: Optional[jax.Array] = None,
                      impl: str = 'auto',
                      block_k: Optional[int] = None) -> jax.Array:
-    """Single-token attention over a KV cache with per-sequence lengths.
+    """Length-aware attention over a KV cache view.
 
-    q: [B, 1, H, D] (the new token's queries); k_cache/v_cache:
-    [B, T, KVH, D]; n_valid: [B] int32 count of valid cache rows;
-    ``k_scale``/``v_scale``: [B, T, KVH] per-row scales of an int8
-    cache (dequantized in-kernel, so the HBM stream stays int8).
-    Returns [B, 1, H, D]. ``impl``: 'auto' (kernel when tileable) |
-    'pallas' (kernel, XLA fallback WITH a warning when untileable) |
-    'xla'.
+    q: [B, Q, H, D] — Q = 1 is the classic single-token decode; Q > 1
+    is a speculative verify window whose rows are already in the cache
+    (query j masks ``pos < n_valid - (Q - 1 - j)``; each query row's
+    kernel math is independent, so position j reproduces the Q = 1
+    step bitwise). k_cache/v_cache: [B, T, KVH, D]; n_valid: [B] int32
+    count of valid cache rows INCLUDING the window; ``k_scale``/
+    ``v_scale``: [B, T, KVH] per-row scales of an int8 cache
+    (dequantized in-kernel, so the HBM stream stays int8). Returns
+    [B, Q, H, D]. ``impl``: 'auto' (kernel when tileable) | 'pallas'
+    (kernel, XLA fallback WITH a warning when untileable) | 'xla'.
     """
-    b, one, h, d = q.shape
-    assert one == 1, 'decode_attention takes a single query position'
+    b, q_len, h, d = q.shape
     t = k_cache.shape[1]
     kvh = k_cache.shape[2]
     assert h % kvh == 0, (h, kvh)
@@ -262,7 +289,9 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                 f'shape (T={t}, D={d}, block_k={bk})')
         return xla_decode_attention(q, k_cache, v_cache, n_valid,
                                     k_scale, v_scale)
-    qg = q.reshape(b, 1, kvh, h // kvh, d)[:, 0]             # [B,KVH,G,D]
+    g = h // kvh
+    qg = q.reshape(b, q_len, kvh, g, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, kvh, q_len * g, d)                    # [B,KVH,QG,D]
     n_valid = n_valid.astype(jnp.int32)
     if k_scale is not None:
         # Kernel layout: [B, KVH, T] (T minor-most for lane tiling).
@@ -273,7 +302,7 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
         def fn(qg_, k_, v_, nv_, ks_=None, vs_=None):
             return _pallas_decode(qg_, k_, v_, nv_, d ** -0.5, bk,
-                                  ks_, vs_)
+                                  ks_, vs_, q_len=q_len)
 
         in_specs = [P(None, 'tensor', None, None),   # q: kv-head shard
                     P(None, None, 'tensor', None),   # k cache
@@ -290,5 +319,6 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         )(*operands)
     else:
         out = _pallas_decode(qg, k_cache, v_cache, n_valid, d ** -0.5, bk,
-                             k_scale, v_scale)
-    return out.reshape(b, 1, h, d)
+                             k_scale, v_scale, q_len=q_len)
+    out = out.reshape(b, kvh, q_len, g, d).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, q_len, h, d)

@@ -1,0 +1,458 @@
+"""Fused paged attention: block-table-indexed decode/verify kernel.
+
+The r10 engine's inner loop gathered each slot's logical KV view out of
+the paged pool in XLA (``models/decode.py:_view_rows``) before calling
+the length-aware decode kernel — a materialized ``[B, T, KVH, D]`` copy
+per layer per step, T = blocks_per_slot * block_size regardless of how
+much of the slot is actually filled. This module fuses the block-table
+indirection into the attention loop (the PagedAttention / flash-decoding
+shape every production engine converged on, Kwon et al. SOSP 2023): the
+per-sequence block indices are scalar-prefetched and feed the KV
+BlockSpec index maps, so the kernel DMAs pool blocks directly — no
+materialized view, and HBM traffic scales with ``ceil(len/block_size)``
+per sequence instead of T (out-of-range grid steps alias to an
+already-resident block, eliding the DMA).
+
+Three implementations behind one dispatch:
+
+* **Pallas kernel** (TPU default via ``impl='auto'``): grid
+  ``(batch, kv_head, kv_block)``, flash running max/sum across the
+  block axis, fp and int8-with-per-row-scales variants. ``block_k``
+  may sub-divide a large pool block for VMEM shaping; it must divide
+  ``block_size``. ``impl='pallas'`` runs it interpret-mode on CPU
+  (unit parity tests).
+* **Fused XLA emulation** (``impl='fused'`` on CPU): the same
+  algorithm — identical block order and running-softmax math — as a
+  ``fori_loop`` over pool blocks with a dynamic trip count
+  ``ceil(max(n_valid)/block_size)``, one block-table-indexed gather per
+  step. Unlike the materialized view, compute and reads scale with the
+  batch's actual lengths, and unlike the Pallas interpreter it runs at
+  XLA speed — what bench_inference A/Bs against the gathered view.
+* **Materialized gathered view** (CPU ``impl='auto'``; the fallback
+  for untileable shapes / non-dividing TP): gather the full logical
+  view, then the length-aware decode kernel family over it — BITWISE
+  the r10 inner loop, which keeps the engine's exact-equality tests
+  against the monolithic cache meaningful on CPU tier-1.
+  GSPMD-partitionable and shape-unconstrained.
+
+Multi-query (speculative verify): ``q`` carries ``q_len`` positions per
+sequence; query ``j`` attends ``pos < n_valid - (q_len - 1 - j)``
+(causal within the window, everything before it). ``q_len == 1`` is
+plain decode with ``pos < n_valid``. All three impls share the mask.
+
+GQA runs natively: queries regroup per kv head, so K/V are never
+repeated (same trick as decode_attention.py / flash_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from skypilot_tpu.ops.pallas.common import (NEG_INF, interpret_mode,
+                                            warn_fallback_once)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(n_valid_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
+                  num_blocks: int, q_len: int, group: int,
+                  ks_ref=None, vs_ref=None):
+    """Grid (B, KVH, NSUB). q_ref [Q*G, D]; k/v_ref [block_k, D].
+
+    Flash running max/sum across the (sequential, innermost) kv block
+    axis; scratch persists between grid steps. Blocks at or past the
+    sequence's valid rows are skipped (their index map aliased them to
+    an already-resident block, so they also cost no DMA). Query row
+    ``r`` belongs to window position ``r // group`` and masks
+    ``pos < n_valid - (q_len - 1 - r // group)``. With ``ks_ref``/
+    ``vs_ref`` ([block_k, 1] per-row scales) the pool is int8 and
+    dequantizes here in VMEM — the HBM stream stays int8.
+    """
+    bi = pl.program_id(0)
+    ti = pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n_valid = n_valid_ref[bi]
+
+    @pl.when(ti * block_k < n_valid)
+    def _block():
+        q = q_ref[:].astype(jnp.float32) * scale            # [QG, D]
+        k = k_ref[:].astype(jnp.float32)                    # [bk, D]
+        if ks_ref is not None:
+            k = k * ks_ref[:].reshape(-1, 1)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [QG, bk]
+        pos = (ti * block_k +
+               jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        qj = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        s = jnp.where(pos < n_valid - (q_len - 1 - qj), s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        if vs_ref is not None:
+            v = v_ref[:].astype(jnp.float32) * vs_ref[:].reshape(-1, 1)
+        else:
+            v = v_ref[:]
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ti == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[:] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_kernel_quant(n_valid_ref, bt_ref, q_ref, k_ref, v_ref,
+                        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                        block_k: int, scale: float, num_blocks: int,
+                        q_len: int, group: int):
+    _paged_kernel(n_valid_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, block_k=block_k, scale=scale,
+                  num_blocks=num_blocks, q_len=q_len, group=group,
+                  ks_ref=ks_ref, vs_ref=vs_ref)
+
+
+def _pallas_paged(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                  block_tables: jax.Array, n_valid: jax.Array,
+                  scale: float, block_k: int, q_len: int,
+                  k_scale: Optional[jax.Array] = None,
+                  v_scale: Optional[jax.Array] = None) -> jax.Array:
+    """q [B, KVH, Q*G, D]; pools [NB, BS, KVH, D] (+ optional
+    [NB, BS, KVH] int8 row scales); bt [B, BPS]; n_valid [B] ->
+    [B, KVH, Q*G, D]."""
+    b, kvh, qg, d = q.shape
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    bps = block_tables.shape[1]
+    sub = bs // block_k            # kernel sub-blocks per pool block
+    nsub = bps * sub
+    grid = (b, kvh, nsub)
+    group = qg // q_len
+
+    def kv_index(bi, hi, ti, n_valid, bt):
+        # Clamp to the last sub-block holding valid rows: skipped steps
+        # re-map to an already-fetched block => the DMA is elided. The
+        # pool block comes out of the scalar-prefetched table.
+        last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
+        ti_c = jnp.minimum(ti, last)
+        return (bt[bi, ti_c // sub], ti_c % sub, hi)
+
+    def scale_index(bi, hi, ti, n_valid, bt):
+        last = jnp.maximum(pl.cdiv(n_valid[bi], block_k) - 1, 0)
+        ti_c = jnp.minimum(ti, last)
+        return (bt[bi, ti_c // sub], hi, ti_c % sub, 0)
+
+    # Mosaic validates the LAST TWO dims of every block against the
+    # tile shape — the pools view as [NB, BS, KVH*D] (contiguous minor
+    # dims, no copy) so the trailing block dims are (block_k, d) and
+    # the head is selected by the Blocked index hi (same layout trick
+    # as decode_attention.py).
+    kv_view = (nb, bs, kvh * d)
+    in_specs = [
+        pl.BlockSpec((None, None, qg, d),
+                     lambda bi, hi, ti, n_valid, bt: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, block_k, d), kv_index),
+        pl.BlockSpec((None, block_k, d), kv_index),
+    ]
+    operands = [q, k_pool.reshape(kv_view), v_pool.reshape(kv_view)]
+    if k_scale is not None:
+        # Scales arrive [NB, BS, KVH]; kernel layout [NB, KVH, BS, 1]
+        # (BS minor for lane tiling, trailing singleton so the checked
+        # trailing dims are (block_k, 1)).
+        in_specs += [
+            pl.BlockSpec((None, None, block_k, None), scale_index),
+            pl.BlockSpec((None, None, block_k, None), scale_index)]
+        operands += [k_scale.transpose(0, 2, 1)[..., None],
+                     v_scale.transpose(0, 2, 1)[..., None]]
+        kernel = functools.partial(_paged_kernel_quant, block_k=block_k,
+                                   scale=scale, num_blocks=nsub,
+                                   q_len=q_len, group=group)
+    else:
+        kernel = functools.partial(_paged_kernel, block_k=block_k,
+                                   scale=scale, num_blocks=nsub,
+                                   q_len=q_len, group=group)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (None, None, qg, d),
+            lambda bi, hi, ti, n_valid, bt: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qg, 1), jnp.float32),    # running max
+            pltpu.VMEM((qg, 1), jnp.float32),    # running sum
+            pltpu.VMEM((qg, d), jnp.float32),    # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, qg, d), q.dtype),
+        interpret=interpret_mode(),
+    )(n_valid, block_tables, *operands)
+
+
+# ---------------------------------------------------------------------------
+# Fused XLA emulation (CPU path): same algorithm, fori_loop over blocks
+# ---------------------------------------------------------------------------
+
+def _fused_xla_paged(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                     block_tables: jax.Array, n_valid: jax.Array,
+                     scale: float, q_len: int,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None) -> jax.Array:
+    """Block-order- and math-identical XLA form of the kernel: a
+    ``fori_loop`` with trip count ``ceil(max(n_valid)/block_size)``
+    gathers ONE pool block per step through the block table and folds
+    it into the running softmax. Nothing T-sized is ever materialized
+    and compute scales with the batch's actual lengths — on CPU this is
+    what makes the fused path structurally faster than the gathered
+    view (the Pallas interpreter would pay per-grid-step overhead
+    instead). Blocks a slot has outgrown contribute exactly zero
+    (``exp(NEG_INF - m) == 0``), so results are independent of other
+    slots' lengths."""
+    b, kvh, qg, d = q.shape
+    bs = k_pool.shape[1]
+    group = qg // q_len
+    qf = q.astype(jnp.float32) * scale
+    nblk = jax.lax.div(jnp.max(n_valid) + bs - 1, bs)
+    qj = (jnp.arange(qg) // group)[None, None, :, None]     # [1,1,QG,1]
+    limit = n_valid[:, None, None, None] - (q_len - 1) + qj  # [B,1,QG,1]
+
+    def body(ti, carry):
+        m, l, acc = carry
+        blk = jax.lax.dynamic_slice_in_dim(block_tables, ti, 1,
+                                           axis=1)[:, 0]    # [B]
+        k = k_pool[blk].astype(jnp.float32)                 # [B,BS,KVH,D]
+        v = v_pool[blk].astype(jnp.float32)
+        if k_scale is not None:
+            k = k * k_scale[blk][..., None]
+            v = v * v_scale[blk][..., None]
+        s = jnp.einsum('bhqd,bkhd->bhqk', qf, k)            # [B,KVH,QG,BS]
+        pos = (ti * bs + jnp.arange(bs))[None, None, None, :]
+        s = jnp.where(pos < limit, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum('bhqk,bkhd->bhqd', p, v)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((b, kvh, qg, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, qg, 1), jnp.float32)
+    a0 = jnp.zeros((b, kvh, qg, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, a0))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Materialized gathered-view reference (the r10 inner loop; fallback)
+# ---------------------------------------------------------------------------
+
+def _gather_view(q, k_pool, v_pool, block_tables, k_scale, v_scale):
+    """Materialize the slots' full logical views through the block
+    table (``_view_rows`` semantics: [B, BPS*BS, KVH, D] + [B, T, KVH]
+    scales) — the r10 inner-loop layout the length-aware decode kernel
+    consumes."""
+    b = q.shape[0]
+    nb, bs, kvh, d = k_pool.shape
+    off = jnp.arange(bs, dtype=block_tables.dtype)
+    rows = (block_tables[..., :, None] * bs + off).reshape(b, -1)
+    k_view = k_pool.reshape(nb * bs, kvh, d)[rows]          # [B,T,KVH,D]
+    v_view = v_pool.reshape(nb * bs, kvh, d)[rows]
+    ks = vs = None
+    if k_scale is not None:
+        ks = k_scale.reshape(nb * bs, kvh)[rows]            # [B, T, KVH]
+        vs = v_scale.reshape(nb * bs, kvh)[rows]
+    return k_view, v_view, ks, vs
+
+
+def _gathered(q, k_pool, v_pool, block_tables, n_valid, k_scale,
+              v_scale, inner_impl: str) -> jax.Array:
+    """The materialized fallback: gather the view, then the length-
+    aware decode kernel family (``decode_attention``) over it — byte
+    for byte the pre-fusion r10 inner loop when ``inner_impl='auto'``.
+    GSPMD-partitionable (the gather partitions; decode_attention
+    shard_maps or falls back itself) and shape-unconstrained."""
+    from skypilot_tpu.ops.pallas.decode_attention import decode_attention
+    k_view, v_view, ks, vs = _gather_view(q, k_pool, v_pool,
+                                          block_tables, k_scale, v_scale)
+    return decode_attention(q, k_view, v_view, n_valid, k_scale=ks,
+                            v_scale=vs, impl=inner_impl)
+
+
+def xla_paged_attention(q: jax.Array, k_pool: jax.Array,
+                        v_pool: jax.Array, block_tables: jax.Array,
+                        n_valid: jax.Array,
+                        k_scale: Optional[jax.Array] = None,
+                        v_scale: Optional[jax.Array] = None) -> jax.Array:
+    """Pure-XLA oracle: gathered view + reference masked attention
+    (``xla_decode_attention``). Used by tests and the kernels bench as
+    the parity target.
+
+    q [B, Q, H, D]; pools [NB, BS, KVH, D]; bt [B, BPS]; n_valid [B]
+    (+ optional [NB, BS, KVH] int8 row scales) -> [B, Q, H, D].
+    """
+    from skypilot_tpu.ops.pallas.decode_attention import (
+        xla_decode_attention)
+    k_view, v_view, ks, vs = _gather_view(q, k_pool, v_pool,
+                                          block_tables, k_scale, v_scale)
+    return xla_decode_attention(q, k_view, v_view, n_valid, ks, vs)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+def _fit_sub_block(bs: int, block_k: Optional[int]) -> int:
+    """Kernel kv block: ``block_k`` when it divides the pool block
+    (VMEM shaping for large pool blocks), else the pool block itself."""
+    if block_k and 0 < block_k < bs and bs % block_k == 0:
+        return block_k
+    return bs
+
+
+def _supported(d: int, bk: int, kv_dtype) -> bool:
+    if interpret_mode():
+        return True            # interpreter has no tiling constraints
+    sublane = {jnp.dtype(jnp.float32): 8, jnp.dtype(jnp.bfloat16): 16,
+               jnp.dtype(jnp.int8): 32}.get(jnp.dtype(kv_dtype), 8)
+    return d % 128 == 0 and bk % sublane == 0
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    block_tables: jax.Array, n_valid: jax.Array, *,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None,
+                    impl: str = 'auto',
+                    block_k: Optional[int] = None) -> jax.Array:
+    """Attention over a paged KV pool, indexed through block tables.
+
+    q: [B, Q, H, D] — Q query positions per sequence (1 = decode; >1 =
+    a speculative verify window whose KV rows are already scattered
+    into the pool). k_pool/v_pool: [num_blocks, block_size, KVH, D];
+    block_tables: [B, blocks_per_slot] pool ids (0 = the reserved null
+    block); n_valid: [B] int32 valid rows per sequence INCLUDING the Q
+    window rows — query j attends ``pos < n_valid - (Q - 1 - j)``.
+    ``k_scale``/``v_scale``: [num_blocks, block_size, KVH] per-row
+    scales of an int8 pool (dequantized in-kernel; the HBM stream
+    stays int8). Returns [B, Q, H, D].
+
+    ``impl``:
+
+    * 'auto' — fused Pallas kernel on TPU when tileable; on CPU (and
+      for untileable shapes) the materialized gathered view through
+      the length-aware decode kernel — BITWISE the r10 inner loop, so
+      CPU tier-1 equality against the monolithic engine holds exactly.
+    * 'fused' — the fused algorithm everywhere: the Pallas kernel on
+      TPU, the fori_loop XLA emulation on CPU (same block order and
+      running-softmax math at XLA speed — what the engine bench A/Bs
+      against the gathered view).
+    * 'pallas' — the fused kernel itself, interpret-mode on CPU (unit
+      parity tests); warns + gathered-view fallback when untileable.
+    * 'xla' — gathered view + reference masked attention.
+
+    ``block_k`` sub-divides a large pool block for the kernel (must
+    divide block_size; ignored otherwise).
+    """
+    b, q_len, h, d = q.shape
+    bs = k_pool.shape[1]
+    kvh = k_pool.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    g = h // kvh
+    bk = _fit_sub_block(bs, block_k)
+    supported = _supported(d, bk, k_pool.dtype)
+    n_valid = n_valid.astype(jnp.int32)
+
+    if impl == 'xla':
+        return _gathered(q, k_pool, v_pool, block_tables, n_valid,
+                         k_scale, v_scale, 'xla')
+    if impl == 'auto' and interpret_mode():
+        # CPU serving default: the r10 gathered-view + length-aware
+        # kernel path, kept bitwise so paged == monolithic equality
+        # tests stay exact. The fused emulation is an explicit opt-in
+        # ('fused') because its flash partitioning differs at ULP
+        # level from the kernel-on-view family.
+        return _gathered(q, k_pool, v_pool, block_tables, n_valid,
+                         k_scale, v_scale, 'auto')
+
+    # Under an ambient mesh with a tensor axis (TP serving), the fused
+    # path runs per-kv-head-shard via shard_map (the grid is already
+    # per-kv-head, so splitting kv heads over 'tensor' needs no
+    # collectives); the pool shards on its kv-head axis
+    # (sharding.shard_paged_cache) and block tables/lengths replicate.
+    # Otherwise a multi-device mesh falls back to the gathered view —
+    # a bare pallas_call is opaque to the partitioner, while the
+    # gather + decode_attention path partitions itself.
+    from skypilot_tpu.parallel.sharding import (ambient_tensor_parallelism,
+                                                tensor_shard_map)
+    mesh, tp = ambient_tensor_parallelism()
+    multi_device = mesh is not None and mesh.size > 1
+    if multi_device and (tp <= 1 or kvh % tp or not supported):
+        if impl == 'pallas':
+            warn_fallback_once(
+                'paged attention',
+                f'mesh {dict(mesh.shape)} (kv_heads={kvh} not divisible '
+                f'by tensor={tp}, or untileable shape)')
+        return _gathered(q, k_pool, v_pool, block_tables, n_valid,
+                         k_scale, v_scale, 'auto')
+    if not supported:
+        if impl == 'pallas':
+            warn_fallback_once(
+                'paged attention',
+                f'shape (block_size={bs}, D={d}, block_k={bk}, '
+                f'kv dtype={k_pool.dtype})')
+        return _gathered(q, k_pool, v_pool, block_tables, n_valid,
+                         k_scale, v_scale, 'auto')
+
+    use_emulation = interpret_mode() and impl != 'pallas'
+    qg = q.reshape(b, q_len, kvh, g, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, kvh, q_len * g, d)
+
+    def fn(qg_, k_, v_, nv_, bt_, ks_=None, vs_=None):
+        if use_emulation:
+            return _fused_xla_paged(qg_, k_, v_, bt_, nv_, d ** -0.5,
+                                    q_len, ks_, vs_)
+        return _pallas_paged(qg_, k_, v_, bt_, nv_, d ** -0.5, bk,
+                             q_len, ks_, vs_)
+
+    if multi_device:
+        from jax.sharding import PartitionSpec as P
+        in_specs = [P(None, 'tensor', None, None),   # q: kv-head shard
+                    P(None, None, 'tensor', None),   # k pool
+                    P(None, None, 'tensor', None),   # v pool
+                    P(),                             # lengths replicate
+                    P()]                             # tables replicate
+        operands = [qg, k_pool, v_pool, n_valid, block_tables]
+        if k_scale is not None:
+            in_specs += [P(None, None, 'tensor'), P(None, None, 'tensor')]
+            operands += [k_scale, v_scale]
+        out = tensor_shard_map(
+            fn, mesh,
+            in_specs=tuple(in_specs),
+            out_specs=P(None, 'tensor', None, None),
+        )(*operands)
+    else:
+        out = fn(qg, k_pool, v_pool, n_valid, block_tables,
+                 k_scale, v_scale)
+    out = out.reshape(b, kvh, q_len, g, d).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, q_len, h, d)

@@ -380,6 +380,9 @@ INFERENCE_COUNTER_STATS = frozenset({
     'prefill_chunks', 'prefill_errors',
     'prefix_cache_hits', 'prefix_cache_misses', 'prefix_tokens_reused',
     'preemptions',
+    # Speculative decoding (r13): acceptance rate = rate(accepted) /
+    # rate(draft); spec_window stays a gauge.
+    'draft_tokens', 'accepted_tokens', 'verify_steps',
 })
 # Highest recovery_events row id already folded into _JOB_METRICS.
 _recovery_cursor = 0

@@ -333,6 +333,20 @@ DECLARATIONS: List[EnvVar] = _decl([
      'Paged KV cache block size (tokens per block).'),
     ('SKYT_INFER_PREFILL_CHUNK', 'int', 64,
      'Chunked-prefill budget interleaved per decode step (tokens).'),
+    ('SKYT_PAGED_BLOCK_K', 'int', 0,
+     'Paged-attention kernel kv-block override: sub-divides a large '
+     'KV pool block for VMEM shaping (must divide the block size; '
+     '0 = one kernel block per pool block).'),
+    ('SKYT_SPEC_DECODE', 'bool', False,
+     'Speculative decoding in the continuous engine: draft + batched '
+     'verify over the paged pool (greedy output stays identical to '
+     'the plain engine).'),
+    ('SKYT_SPEC_DRAFT_K', 'int', 4,
+     'Draft tokens proposed per speculative verify step (the verify '
+     'window is draft_k + 1).'),
+    ('SKYT_SPEC_NGRAM_MAX', 'int', 3,
+     'Longest trailing n-gram the prompt-lookup draft matches on '
+     '(it backs off to shorter n-grams).'),
 
     # -- provisioning -----------------------------------------------
     ('SKYT_K8S_FAKE', 'bool', False,

@@ -1,0 +1,246 @@
+"""Fused paged-attention kernel: block-table indexing parity.
+
+The r13 kernel (``ops/pallas/paged_attention.py``) consumes the paged
+pool + block tables directly — these tests pin every implementation
+(Pallas kernel in interpret mode, fused XLA emulation, materialized
+gathered-view fallback) to the pure-XLA oracle across ragged lengths,
+block boundaries, GQA groupings, fp and int8 pools, multi-query verify
+windows, and the ``block_k`` sub-blocking override; plus the
+verify-step ≡ sequential-decode-steps contract at the models layer.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode as decode_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.config import get_model_config
+from skypilot_tpu.models.decode import quantize_kv
+from skypilot_tpu.ops.pallas.paged_attention import (paged_attention,
+                                                     xla_paged_attention)
+
+
+def _pool_setup(b=4, kvh=2, g=2, d=16, bs=8, bps=6, seed=0,
+                dtype=jnp.float32):
+    nb = b * bps + 1
+    ks = jax.random.split(jax.random.key(seed), 3)
+    k_pool = jax.random.normal(ks[0], (nb, bs, kvh, d), dtype)
+    v_pool = jax.random.normal(ks[1], (nb, bs, kvh, d), dtype)
+    # Shuffled tables: pool blocks are deliberately non-contiguous so a
+    # row-order bug cannot hide behind an identity layout.
+    perm = np.random.RandomState(seed).permutation(np.arange(1, nb))
+    bt = jnp.asarray(perm[:b * bps].reshape(b, bps).astype(np.int32))
+    q_key = ks[2]
+    return k_pool, v_pool, bt, q_key, (b, kvh, g, d, bs, bps)
+
+
+# Ragged lengths hit the off-by-one spots: length 1, mid-block, exact
+# block boundaries, and the completely full view.
+RAGGED = [1, 9, 24, 48]
+BOUNDARY = [8, 16, 32, 40]
+
+
+@pytest.mark.parametrize('impl', ['pallas', 'fused'])
+@pytest.mark.parametrize('lengths', [RAGGED, BOUNDARY])
+def test_fused_matches_gathered_view_fp(impl, lengths):
+    k_pool, v_pool, bt, qk, (b, kvh, g, d, _, _) = _pool_setup()
+    q = jax.random.normal(qk, (b, 1, kvh * g, d), jnp.float32)
+    nv = jnp.asarray(lengths, jnp.int32)
+    ref = xla_paged_attention(q, k_pool, v_pool, bt, nv)
+    out = paged_attention(q, k_pool, v_pool, bt, nv, impl=impl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize('impl', ['pallas', 'fused'])
+@pytest.mark.parametrize('lengths', [RAGGED, BOUNDARY])
+def test_fused_matches_gathered_view_int8(impl, lengths):
+    k_pool, v_pool, bt, qk, (b, kvh, g, d, _, _) = _pool_setup(seed=1)
+    kq, kscale = quantize_kv(k_pool)
+    vq, vscale = quantize_kv(v_pool)
+    q = jax.random.normal(qk, (b, 1, kvh * g, d), jnp.float32)
+    nv = jnp.asarray(lengths, jnp.int32)
+    ref = xla_paged_attention(q, kq, vq, bt, nv, k_scale=kscale,
+                              v_scale=vscale)
+    out = paged_attention(q, kq, vq, bt, nv, k_scale=kscale,
+                          v_scale=vscale, impl=impl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize('impl', ['pallas', 'fused'])
+def test_multi_query_verify_window(impl):
+    """q_len=4 verify window: query j attends rows < n_valid-(3-j)."""
+    k_pool, v_pool, bt, qk, (b, kvh, g, d, _, _) = _pool_setup(seed=2)
+    q = jax.random.normal(qk, (b, 4, kvh * g, d), jnp.float32)
+    nv = jnp.asarray([4, 11, 24, 48], jnp.int32)
+    ref = xla_paged_attention(q, k_pool, v_pool, bt, nv)
+    out = paged_attention(q, k_pool, v_pool, bt, nv, impl=impl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+    # The window's LAST query must equal a single-query call at the
+    # same n_valid (it sees exactly the same rows).
+    out1 = paged_attention(q[:, 3:], k_pool, v_pool, bt, nv, impl=impl)
+    np.testing.assert_allclose(np.asarray(out[:, 3]),
+                               np.asarray(out1[:, 0]), atol=2e-5)
+
+
+def test_block_k_sub_blocking_and_bad_values():
+    """block_k divides the pool block -> same result; non-dividing or
+    oversized values are ignored, never mis-tiled."""
+    k_pool, v_pool, bt, qk, (b, kvh, g, d, _, _) = _pool_setup(seed=3)
+    q = jax.random.normal(qk, (b, 1, kvh * g, d), jnp.float32)
+    nv = jnp.asarray(RAGGED, jnp.int32)
+    ref = xla_paged_attention(q, k_pool, v_pool, bt, nv)
+    for block_k in (2, 4, 3, 16, 0, None):
+        out = paged_attention(q, k_pool, v_pool, bt, nv, impl='pallas',
+                              block_k=block_k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, err_msg=str(block_k))
+
+
+def test_gqa_groupings():
+    for g in (1, 4):
+        k_pool, v_pool, bt, qk, (b, kvh, _, d, _, _) = _pool_setup(
+            g=g, seed=4)
+        q = jax.random.normal(qk, (b, 1, kvh * g, d), jnp.float32)
+        nv = jnp.asarray(RAGGED, jnp.int32)
+        ref = xla_paged_attention(q, k_pool, v_pool, bt, nv)
+        out = paged_attention(q, k_pool, v_pool, bt, nv, impl='pallas')
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, err_msg=str(g))
+
+
+def test_fused_per_slot_independence():
+    """The fused emulation's trip count follows the batch max length —
+    a slot's result must not change when ANOTHER slot's length grows
+    (blocks it has outgrown contribute exactly zero)."""
+    k_pool, v_pool, bt, qk, (b, kvh, g, d, _, _) = _pool_setup(seed=5)
+    q = jax.random.normal(qk, (b, 1, kvh * g, d), jnp.float32)
+    short = jnp.asarray([5, 5, 5, 5], jnp.int32)
+    mixed = jnp.asarray([5, 48, 17, 33], jnp.int32)
+    out_short = paged_attention(q, k_pool, v_pool, bt, short,
+                                impl='fused')
+    out_mixed = paged_attention(q, k_pool, v_pool, bt, mixed,
+                                impl='fused')
+    np.testing.assert_array_equal(np.asarray(out_short[0]),
+                                  np.asarray(out_mixed[0]))
+
+
+def test_stale_rows_never_leak():
+    """Rows past n_valid (rejected speculative suffixes, recycled
+    blocks) must not influence the output, whatever garbage they hold."""
+    k_pool, v_pool, bt, qk, (b, kvh, g, d, bs, _) = _pool_setup(seed=6)
+    q = jax.random.normal(qk, (b, 1, kvh * g, d), jnp.float32)
+    nv = jnp.asarray([5, 9, 17, 30], jnp.int32)
+    clean = paged_attention(q, k_pool, v_pool, bt, nv, impl='pallas')
+    # Poison every row of every block past each slot's length via a
+    # pool-wide overwrite of rows >= n_valid (per slot's own table).
+    k_dirty, v_dirty = np.asarray(k_pool).copy(), np.asarray(v_pool).copy()
+    for slot in range(b):
+        for idx, blk in enumerate(np.asarray(bt)[slot]):
+            for r in range(bs):
+                if idx * bs + r >= int(nv[slot]):
+                    k_dirty[blk, r] = 7e3
+                    v_dirty[blk, r] = -7e3
+    dirty = paged_attention(q, jnp.asarray(k_dirty), jnp.asarray(v_dirty),
+                            bt, nv, impl='pallas')
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
+
+
+# ---------------------------------------------------------------------------
+# Models layer: verify window == sequential decode steps
+# ---------------------------------------------------------------------------
+
+def _fresh_paged(cfg, slots, bs, bps):
+    cache = decode_lib.init_paged_cache(cfg, num_blocks=slots * bps + 1,
+                                        block_size=bs, slots=slots,
+                                        blocks_per_slot=bps)
+    tables = np.zeros((slots, bps), np.int32)
+    nxt = 1
+    for s in range(slots):
+        for i in range(bps):
+            tables[s, i] = nxt
+            nxt += 1
+    return dataclasses.replace(cache,
+                               block_tables=jnp.asarray(tables))
+
+
+@pytest.mark.parametrize('quantized', [False, True])
+def test_verify_window_equals_sequential_decode(quantized):
+    """paged_verify_step over a K-token window reproduces K sequential
+    paged_decode_steps: same logits argmax at every position, same
+    final KV rows (the contract speculative acceptance rests on)."""
+    cfg = get_model_config('tiny')
+    if quantized:
+        from skypilot_tpu.models.config import with_int8_kv_cache
+        cfg = with_int8_kv_cache(cfg)
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = [(5 * i + 2) % 512 for i in range(11)]
+    k_tokens = [17, 403, 88, 251]
+
+    def prefill(cache):
+        buf = np.zeros((1, 16), np.int32)
+        buf[0, :len(prompt)] = prompt
+        _, cache = decode_lib.prefill_chunk(
+            params, jnp.asarray(buf), jnp.int32(0),
+            jnp.int32(len(prompt)), jnp.int32(0), cache, cfg)
+        return cache
+
+    seq_cache = prefill(_fresh_paged(cfg, 1, 8, 4))
+    seq_logits = []
+    for tok in k_tokens:
+        logits, seq_cache = decode_lib.paged_decode_step(
+            params, jnp.asarray([tok], jnp.int32), seq_cache, cfg)
+        seq_logits.append(np.asarray(logits[0]))
+
+    ver_cache = prefill(_fresh_paged(cfg, 1, 8, 4))
+    ver_logits, ver_cache = decode_lib.paged_verify_step(
+        params, jnp.asarray([k_tokens], jnp.int32), ver_cache, cfg)
+    for j in range(len(k_tokens)):
+        np.testing.assert_allclose(np.asarray(ver_logits[0, j]),
+                                   seq_logits[j], atol=1e-4)
+        assert (int(np.argmax(ver_logits[0, j])) ==
+                int(np.argmax(seq_logits[j]))), j
+    # Verify leaves lengths for the CALLER to advance.
+    assert int(ver_cache.lengths[0]) == len(prompt)
+    # The written KV rows are identical to the sequential run's.
+    np.testing.assert_allclose(
+        np.asarray(ver_cache.k, np.float32),
+        np.asarray(seq_cache.k, np.float32), atol=1e-6)
+
+
+def test_verify_n_input_masks_padded_positions():
+    """Padded window rows (j >= n_input) write to the null block and
+    leave live state untouched: a window of n_input=2 out of Q=4 must
+    equal a plain 2-step run on every live row."""
+    cfg = get_model_config('tiny')
+    params = llama.init_params(jax.random.key(1), cfg)
+    prompt = [(3 * i + 1) % 512 for i in range(9)]
+
+    def prefill(cache):
+        buf = np.zeros((1, 16), np.int32)
+        buf[0, :len(prompt)] = prompt
+        _, cache = decode_lib.prefill_chunk(
+            params, jnp.asarray(buf), jnp.int32(0),
+            jnp.int32(len(prompt)), jnp.int32(0), cache, cfg)
+        return cache
+
+    seq_cache = prefill(_fresh_paged(cfg, 1, 8, 4))
+    seq_logits = []
+    for tok in (44, 317):
+        logits, seq_cache = decode_lib.paged_decode_step(
+            params, jnp.asarray([tok], jnp.int32), seq_cache, cfg)
+        seq_logits.append(np.asarray(logits[0]))
+
+    ver_cache = prefill(_fresh_paged(cfg, 1, 8, 4))
+    window = jnp.asarray([[44, 317, 0, 0]], jnp.int32)
+    ver_logits, _ = decode_lib.paged_verify_step(
+        params, window, ver_cache, cfg,
+        n_input=jnp.asarray([2], jnp.int32))
+    for j in range(2):
+        np.testing.assert_allclose(np.asarray(ver_logits[0, j]),
+                                   seq_logits[j], atol=1e-4)
