@@ -67,6 +67,46 @@ path = timeline.save(sys.argv[1], trace_id=trace_id)
 print(f'trace artifact: {path} (trace {trace_id})')
 PYEOF
 
+# Telemetry snapshot artifact: arm the fleet telemetry plane against a
+# live in-process API server, scrape a few federation rounds, and dump
+# the stored series + alert table (docs/observability.md). Non-fatal —
+# a broken telemetry pipeline must not eat the tunnel window.
+echo "preamble: capturing telemetry-plane snapshot" >&2
+timeout 180 env JAX_PLATFORMS=cpu python - \
+  "BENCH_telemetry_${suffix}.json" <<'PYEOF' \
+  || echo "preamble: telemetry snapshot failed (non-fatal)" >&2
+import json, os, sys, tempfile, time
+os.environ['SKYT_STATE_DIR'] = tempfile.mkdtemp(prefix='skyt-telem-')
+os.environ['SKYT_TELEMETRY_INTERVAL'] = '0.5'
+from skypilot_tpu.client import sdk
+from skypilot_tpu.server.app import ApiServer
+srv = ApiServer(port=0)
+srv.start_background()
+os.environ['SKYT_API_SERVER_URL'] = srv.url
+try:
+    for _ in range(3):
+        sdk.get(sdk.status(), timeout=60)
+    for _ in range(3):
+        srv.telemetry.tick()
+        time.sleep(0.3)
+    now = time.time()
+    snapshot = {
+        'series_names': srv.telemetry.store.series_names(),
+        'alerts': srv.telemetry.alerts.snapshot(),
+        'queries': {
+            name: srv.telemetry.query(name, now - 600, now)
+            for name in ('skyt_requests_total',
+                         'skyt_request_queue_depth',
+                         'workspace:request_exec_seconds:p99')},
+    }
+finally:
+    srv.shutdown()
+with open(sys.argv[1], 'w', encoding='utf-8') as f:
+    json.dump(snapshot, f, indent=1)
+print(f'telemetry artifact: {sys.argv[1]} '
+      f'({len(snapshot["series_names"])} series)')
+PYEOF
+
 run() {
   local out="$1"; shift
   echo "=== bench $* ($(date -u +%H:%M:%SZ)) ===" >&2

@@ -583,6 +583,139 @@ def _render_waterfall(view: dict, width: int) -> None:
                        f"{pct:5.1f}%  [{seg.get('service', '?')}]")
 
 
+@cli.command('alerts')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Raw /api/alerts payload.')
+def alerts_cmd(as_json: bool) -> None:
+    """Show SLO burn-rate alerts (pending/firing/resolved) from the
+    server's telemetry plane (docs/observability.md)."""
+    try:
+        payload = sdk.api_alerts()
+    except exceptions.SkytError as e:
+        raise click.ClickException(str(e)) from e
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+        return
+    alerts = payload.get('alerts') or []
+    if not alerts:
+        click.echo('(no alerts — every SLO inside budget)')
+        return
+    import time as time_lib
+    rows = []
+    for a in alerts:
+        since = a.get('firing_since') or a.get('pending_since')
+        rows.append({
+            'slo': a['slo'],
+            'severity': a['severity'],
+            'state': a['state'].upper(),
+            'burn': (f"{a.get('burn_short', 0):g}x/"
+                     f"{a.get('burn_long', 0):g}x "
+                     f"(>{a.get('burn_threshold', 0):g}x)"),
+            'windows': '/'.join(
+                common_utils.readable_duration(w)
+                for w in a.get('windows_seconds', [])),
+            'since': (common_utils.readable_duration(
+                max(0.0, time_lib.time() - since)) + ' ago'
+                if since else '-'),
+        })
+    _echo_table(rows, ['slo', 'severity', 'state', 'burn', 'windows',
+                       'since'])
+
+
+_SPARK_BLOCKS = '▁▂▃▄▅▆▇█'
+
+
+def _sparkline(values: List[float], width: int) -> str:
+    if not values:
+        return ''
+    if len(values) > width:
+        # Bucket-mean compress onto the terminal width.
+        out = []
+        for i in range(width):
+            lo = i * len(values) // width
+            hi = max(lo + 1, (i + 1) * len(values) // width)
+            window = values[lo:hi]
+            out.append(sum(window) / len(window))
+        values = out
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return ''.join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * len(_SPARK_BLOCKS)))]
+        for v in values)
+
+
+def _parse_duration(text: str) -> float:
+    units = {'s': 1.0, 'm': 60.0, 'h': 3600.0, 'd': 86400.0}
+    text = text.strip().lower()
+    if text and text[-1] in units:
+        return float(text[:-1]) * units[text[-1]]
+    return float(text)
+
+
+@cli.group('metrics')
+def metrics_group() -> None:
+    """Query the server's durable telemetry history."""
+
+
+@metrics_group.command('query')
+@click.argument('name')
+@click.option('--since', default='1h',
+              help='Trailing window (e.g. 30m, 1h, 2d).')
+@click.option('--step', default=None,
+              help='Resample step (e.g. 60s); default raw points.')
+@click.option('--label', 'label_opts', multiple=True,
+              help='KEY=VALUE series filter (repeatable).')
+@click.option('--agg', default='mean',
+              type=click.Choice(['mean', 'max']),
+              help='Rollup column for windows older than raw '
+                   'retention.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Raw /api/metrics/query payload.')
+@click.option('--width', default=60, help='Sparkline width (cols).')
+def metrics_query(name: str, since: str, step: Optional[str],
+                  label_opts, agg: str, as_json: bool,
+                  width: int) -> None:
+    """Range-query one metric and render a terminal sparkline per
+    series (`skyt metrics query skyt_request_exec_seconds_count
+    --since 2h`)."""
+    try:
+        labels = dict(l.split('=', 1) for l in label_opts)
+    except ValueError:
+        raise click.ClickException('--label takes KEY=VALUE')
+    import time as time_lib
+    end = time_lib.time()
+    try:
+        payload = sdk.api_metrics_query(
+            name, start=end - _parse_duration(since), end=end,
+            step=_parse_duration(step) if step else None,
+            labels=labels or None, agg=agg)
+    except exceptions.SkytError as e:
+        raise click.ClickException(str(e)) from e
+    except ValueError as e:
+        raise click.ClickException(f'bad duration: {e}')
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+        return
+    series = payload.get('series') or []
+    if not series:
+        click.echo(f'(no data for {name} in the last {since})')
+        return
+    width = max(8, width)
+    for entry in series:
+        labels_str = ','.join(f'{k}={v}' for k, v in
+                              sorted((entry.get('labels') or {}).items())
+                              if k not in ('instance',))
+        points = entry.get('points') or []
+        values = [v for _, v in points]
+        if not values:
+            continue
+        click.echo(f'{name}{{{labels_str}}}  ({len(points)} pts)')
+        click.echo(f'  {_sparkline(values, width)}')
+        click.echo(f'  min {min(values):g}  max {max(values):g}  '
+                   f'last {values[-1]:g}')
+
+
 @cli.group()
 def recipes() -> None:
     """Curated launchable recipes (`skyt launch recipe://NAME`)."""

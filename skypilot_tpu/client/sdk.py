@@ -444,6 +444,50 @@ def api_trace(request_id: str) -> Dict[str, Any]:
     return payload
 
 
+def api_alerts(wait: float = 0.0) -> Dict[str, Any]:
+    """The SLO burn-rate alert table from GET /api/alerts.
+    ``wait`` long-polls on the server's ALERTS topic (bounded)."""
+    url = ensure_api_server()
+    params = {'wait': wait} if wait > 0 else {}
+    resp = _request_with_retries('GET', f'{url}/api/alerts',
+                                 params=params,
+                                 timeout=max(35.0, wait + 10.0),
+                                 headers=_auth_headers())
+    payload = resp.json()
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return payload
+
+
+def api_metrics_query(name: str,
+                      start: Optional[float] = None,
+                      end: Optional[float] = None,
+                      step: Optional[float] = None,
+                      labels: Optional[Dict[str, str]] = None,
+                      agg: str = 'mean') -> Dict[str, Any]:
+    """Range query over the server's durable telemetry store
+    (GET /api/metrics/query)."""
+    url = ensure_api_server()
+    params: Dict[str, Any] = {'name': name, 'agg': agg}
+    if start is not None:
+        params['start'] = start
+    if end is not None:
+        params['end'] = end
+    if step is not None:
+        params['step'] = step
+    for key, value in (labels or {}).items():
+        params[f'label.{key}'] = value
+    resp = _request_with_retries('GET', f'{url}/api/metrics/query',
+                                 params=params, timeout=30,
+                                 headers=_auth_headers())
+    payload = resp.json()
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            payload.get('error', f'HTTP {resp.status_code}'))
+    return payload
+
+
 def api_status(status: Optional[str] = None) -> List[Dict[str, Any]]:
     url = ensure_api_server()
     params = {'status': status} if status else {}

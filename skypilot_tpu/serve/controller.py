@@ -67,6 +67,28 @@ class ServeController:
                     instance_prices=prices)
         self._configure_autoscaler()
         self._handled_preemptions: set = set()
+        self._hydrate_from_telemetry()
+
+    def _hydrate_from_telemetry(self) -> None:
+        """Replay the durable telemetry history into the fresh
+        autoscaler: a restarted (or scale-to-zero-resumed) controller
+        resumes with the seasonal forecaster's learned traffic shape
+        and the last observed fleet p99 instead of cold state.
+        Best-effort — no telemetry store just means a cold start, the
+        pre-telemetry behavior."""
+        if not hasattr(self.autoscaler, 'forecaster'):
+            return
+        from skypilot_tpu.server import telemetry
+        hydrated = telemetry.hydrate_autoscaler(self.service_name,
+                                                self.autoscaler)
+        if hydrated['qps_samples']:
+            logger.info(
+                'Service %s: forecaster hydrated with %d stored QPS '
+                'samples (last fleet p99: %s ms).', self.service_name,
+                hydrated['qps_samples'], hydrated['fleet_p99_ms'])
+        if hydrated['fleet_p99_ms'] is not None:
+            metrics.AUTOSCALE_FLEET_P99.set(hydrated['fleet_p99_ms'],
+                                            service=self.service_name)
 
     def _configure_autoscaler(self) -> None:
         # The SLO autoscaler plans the spot/on-demand mix itself and
@@ -288,6 +310,9 @@ class ServeController:
         docs/serve_autoscaling.md)."""
         from skypilot_tpu.serve import forecast
         name = self.service_name
+        # Observed QPS is the series the telemetry plane persists and
+        # a restarted controller's forecaster hydrates from.
+        metrics.AUTOSCALE_OBSERVED_QPS.set(stats.qps, service=name)
         p99 = forecast.fleet_p99_ms(stats.replica_latency_ms)
         if p99 is not None:
             metrics.AUTOSCALE_FLEET_P99.set(p99, service=name)

@@ -172,6 +172,12 @@ class SeasonalRingForecaster(QpsForecaster):
             self._ring[slot] = max(
                 0.0, self.alpha * qps + (1 - self.alpha) * previous)
 
+    @property
+    def ring_occupancy(self) -> int:
+        """Seen phase buckets (0 = cold start; telemetry hydration and
+        `serve status` read this to show how warm the ring is)."""
+        return len(self._ring)
+
     def seasonal_delta(self, now: float, horizon_seconds: float) -> float:
         here = self._ring.get(self._slot(now))
         there = self._ring.get(self._slot(now + horizon_seconds))

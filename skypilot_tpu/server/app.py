@@ -44,7 +44,8 @@ DEFAULT_PORT = 46590
 # matching the reference's separate unauthenticated metrics port).
 # /auth/login is the browser entry point — it must render unauthenticated
 # and then SET the session (the dashboard itself requires it).
-_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics', '/auth/login'})
+_AUTH_EXEMPT = frozenset({'/api/health', '/api/metrics',
+                          '/api/metrics/federate', '/auth/login'})
 
 # Serializes browser-login mint+revoke per process: two concurrent logins
 # for the same user must not revoke each other's freshly minted token
@@ -713,6 +714,17 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                     if not executor_health['alive'] or any(
                             not d['alive'] for d in body['daemons']):
                         body['status'] = 'degraded'
+                    # Firing SLO burn-rate alerts degrade the replica's
+                    # health surface: "up but burning its error budget"
+                    # is exactly what an LB health check should see.
+                    telemetry = getattr(app, 'telemetry', None)
+                    if telemetry is not None:
+                        firing = telemetry.alerts.firing()
+                        body['alerts_firing'] = [
+                            f'{a["slo"]}/{a["severity"]}'
+                            for a in firing]
+                        if firing:
+                            body['status'] = 'degraded'
                 self._reply(body)
             elif route == '/api/users':
                 self._reply([u.to_dict() for u in users_db.list_users()])
@@ -784,6 +796,12 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 from skypilot_tpu.server import dashboard
                 self._reply_text(dashboard.recipe_yaml(
                     self._query.get('name', '')))
+            elif route == '/api/alerts':
+                self._handle_alerts()
+            elif route == '/api/metrics/query':
+                self._handle_metrics_query()
+            elif route == '/api/metrics/federate':
+                self._handle_federate()
             elif route == '/api/metrics':
                 from skypilot_tpu.server import metrics
                 # Exemplars only exist in the OpenMetrics exposition
@@ -907,6 +925,95 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
         view = trace_store.build_view(spans)
         view['request_id'] = request_id
         self._reply(view)
+
+    def _telemetry(self):
+        app = getattr(self.server, 'skyt_app', None)
+        return getattr(app, 'telemetry', None) if app is not None \
+            else None
+
+    def _handle_alerts(self) -> None:
+        """GET /api/alerts: the SLO burn-rate alert table. ``?wait=N``
+        long-polls on the ALERTS topic so watchers see transitions the
+        moment the engine publishes them (bounded; the reply is always
+        the current table)."""
+        from skypilot_tpu.server import telemetry as telemetry_lib
+        query = self._query
+        try:
+            wait = min(float(query.get('wait', 0) or 0), 30.0)
+        except ValueError as e:
+            self._error(HTTPStatus.BAD_REQUEST, f'bad wait: {e}')
+            return
+        if wait > 0:
+            cursor = events.cursor(events.ALERTS)
+            events.wait_for(events.ALERTS, cursor, wait)
+        plane = self._telemetry()
+        if plane is not None:
+            alerts = plane.alerts.snapshot()
+        else:
+            # No live plane in this process (telemetry disabled, or an
+            # in-process test server): serve the persisted table.
+            alerts = telemetry_lib.read_persisted_alerts()
+        self._reply({'alerts': alerts,
+                     'firing': [a for a in alerts
+                                if a['state'] == 'firing']})
+
+    def _handle_metrics_query(self) -> None:
+        """GET /api/metrics/query: range query over the durable
+        telemetry store. Params: ``name`` (required), ``start``/``end``
+        (unix seconds; default = the last hour), ``step`` (optional
+        resample), ``agg`` (mean|max for rollup-backed windows), plus
+        ``label.<key>=<value>`` filters."""
+        plane = self._telemetry()
+        if plane is None:
+            self._error(HTTPStatus.SERVICE_UNAVAILABLE,
+                        'telemetry plane disabled '
+                        '(SKYT_TELEMETRY_ENABLED=0)')
+            return
+        query = self._query
+        name = query.get('name', '')
+        if not name:
+            self._error(HTTPStatus.BAD_REQUEST, 'name is required')
+            return
+        now = time.time()
+        try:
+            end = float(query.get('end', now))
+            start = float(query.get('start', end - 3600.0))
+            step = float(query['step']) if 'step' in query else None
+        except ValueError as e:
+            self._error(HTTPStatus.BAD_REQUEST, f'bad range: {e}')
+            return
+        labels = {k[len('label.'):]: v for k, v in query.items()
+                  if k.startswith('label.')}
+        agg = query.get('agg', 'mean')
+        if agg not in ('mean', 'max'):
+            self._error(HTTPStatus.BAD_REQUEST,
+                        f'agg must be mean or max, got {agg!r}')
+            return
+        self._reply(plane.query(name, start, end, labels or None,
+                                step=step, agg=agg))
+
+    def _handle_federate(self) -> None:
+        """GET /api/metrics/federate: latest sample of every stored
+        series (v0 text + ms timestamps) — the surface an external
+        Prometheus federates the whole fleet from."""
+        plane = self._telemetry()
+        if plane is None:
+            self._error(HTTPStatus.SERVICE_UNAVAILABLE,
+                        'telemetry plane disabled '
+                        '(SKYT_TELEMETRY_ENABLED=0)')
+            return
+        accept = self.headers.get('Accept', '')
+        openmetrics = 'application/openmetrics-text' in accept
+        body = plane.federate_text(openmetrics=openmetrics).encode()
+        self.send_response(200)
+        self.send_header(
+            'Content-Type',
+            'application/openmetrics-text; version=1.0.0; '
+            'charset=utf-8' if openmetrics
+            else 'text/plain; version=0.0.4')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _handle_get(self, user=None) -> None:
         """Block (bounded) until the request is terminal; client re-polls.
@@ -1128,6 +1235,18 @@ class ApiServer:
                 self.broker = None
         self.httpd.skyt_server_id = self.server_id
         self.httpd.skyt_app = self
+        # Fleet telemetry plane (scrape federation + durable history +
+        # SLO alerting). Disabled = None everywhere: the /api/get hot
+        # path never touches it either way (a tier-1 latency smoke
+        # pins this).
+        self.telemetry = None
+        if env_registry.get_bool('SKYT_TELEMETRY_ENABLED'):
+            from skypilot_tpu.server import telemetry as telemetry_lib
+            try:
+                self.telemetry = telemetry_lib.TelemetryPlane(
+                    server_id=self.server_id)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('telemetry plane disabled: %s', e)
         self.executor = executor_lib.Executor(
             server_id=self.server_id,
             broker_sock=self.broker.sock_path if self.broker else None)
@@ -1141,7 +1260,8 @@ class ApiServer:
         from skypilot_tpu.server import daemons as daemons_lib
         if not config.get_nested(('api_server', 'daemons_enabled'), True):
             return
-        self.daemons = daemons_lib.start_all(server_id=self.server_id)
+        self.daemons = daemons_lib.start_all(server_id=self.server_id,
+                                             telemetry=self.telemetry)
 
     @property
     def url(self) -> str:
@@ -1172,6 +1292,11 @@ class ApiServer:
         self.executor.shutdown()
         if self.broker is not None:
             self.broker.stop()
+        if self.telemetry is not None:
+            try:
+                self.telemetry.close()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug('telemetry close failed: %s', e)
 
 
 def main(argv: Optional[list] = None) -> None:

@@ -339,8 +339,25 @@ def _interval(key: str, default: float) -> Callable[[], float]:
     return get
 
 
-def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
+def _telemetry_interval() -> float:
+    """Scrape cadence with fractional jitter: a fleet of API-server
+    replicas on the same config must not pull every LB/replica
+    exposition in lockstep (the classic scrape thundering herd)."""
+    import random
+    base = env_registry.get_float('SKYT_TELEMETRY_INTERVAL')
+    jitter = max(0.0, min(0.9,
+                          env_registry.get_float('SKYT_TELEMETRY_JITTER')))
+    return max(0.25, base * random.uniform(1.0 - jitter, 1.0 + jitter))
+
+
+def build_daemons(server_id: Optional[str] = None,
+                  telemetry=None) -> List[Daemon]:
     daemons = []
+    if telemetry is not None:
+        # Scrape federation + recording rules + SLO evaluation, one
+        # supervised loop (server/telemetry.py TelemetryPlane.tick).
+        daemons.append(
+            Daemon('telemetry', _telemetry_interval, telemetry.tick))
     if server_id is not None:
         def _ha_interval() -> float:
             # helm: ha.requestsTickSeconds
@@ -381,8 +398,9 @@ def build_daemons(server_id: Optional[str] = None) -> List[Daemon]:
     ]
 
 
-def start_all(server_id: Optional[str] = None) -> List[Daemon]:
-    daemons = build_daemons(server_id)
+def start_all(server_id: Optional[str] = None,
+              telemetry=None) -> List[Daemon]:
+    daemons = build_daemons(server_id, telemetry=telemetry)
     for d in daemons:
         d.start()
     logger.info('Started %d background daemons: %s', len(daemons),

@@ -7,7 +7,9 @@ gauges, and histograms with labels, rendered in the v0 text format that
 any Prometheus scraper ingests from ``GET /api/metrics``.
 
 Tracked out of the box:
-* ``skyt_requests_total{name,status}`` -- API requests by payload+status;
+* ``skyt_requests_total{name,status,workspace}`` -- terminal API
+  requests by payload+status+tenant (in-flight rows:
+  ``skyt_requests_in_flight{status}``);
 * ``skyt_request_queue_depth{queue}``  -- LONG/SHORT executor backlogs;
 * ``skyt_provision_seconds``           -- provision latency histogram
   (the BASELINE.md orchestration metric: pod provision p50);
@@ -215,8 +217,15 @@ class Histogram:
 # -- the server's registry ---------------------------------------------
 
 REQUESTS_TOTAL = Counter(
-    'skyt_requests_total', 'API requests by payload name and final status',
-    labels=('name', 'status'))
+    'skyt_requests_total',
+    'API requests that reached a terminal status, by payload name, '
+    'status, and submitting workspace (cursor-paged from the durable '
+    'rows; in-flight rows live in skyt_requests_in_flight)',
+    labels=('name', 'status', 'workspace'))
+REQUESTS_IN_FLIGHT = Gauge(
+    'skyt_requests_in_flight',
+    'PENDING/RUNNING request rows by status (point-in-time)',
+    labels=('status',))
 QUEUE_DEPTH = Gauge(
     'skyt_request_queue_depth', 'Pending requests per executor queue',
     labels=('queue',))
@@ -234,11 +243,12 @@ BUILD_INFO = Gauge(
 REQUEST_EXEC_SECONDS = Histogram(
     'skyt_request_exec_seconds',
     'End-to-end API request latency (created -> finalized) by payload '
-    'name and terminal status, derived from the durable requests '
-    'table on scrape; OpenMetrics exemplars carry the trace_id that '
-    'produced each bucket\'s latest observation (resolve via '
-    '/api/trace/<trace_id>)',
-    labels=('name', 'status'))
+    'name, terminal status, and workspace — the per-tenant source '
+    'series for the telemetry plane\'s recording rules — derived from '
+    'the durable requests table on scrape; OpenMetrics exemplars carry '
+    'the trace_id that produced each bucket\'s latest observation '
+    '(resolve via /api/trace/<trace_id>)',
+    labels=('name', 'status', 'workspace'))
 RUNTIME_EVENTS = Counter(
     'skyt_runtime_events_total',
     'Job-state transitions pushed over cluster runtime channels',
@@ -312,10 +322,17 @@ AUTOSCALE_DECISIONS = Counter(
     'warm_resume, warm_stop, warm_expire, or the op itself for the '
     'legacy reactive autoscalers)',
     labels=('service', 'op', 'reason'))
+AUTOSCALE_OBSERVED_QPS = Gauge(
+    'skyt_autoscale_observed_qps',
+    'Observed LB window QPS per service — the series the telemetry '
+    'plane persists and a restarted controller replays into its '
+    'seasonal forecaster (telemetry.hydrate_autoscaler)',
+    labels=('service',))
 
 _AUTOSCALE_METRICS = [AUTOSCALE_PREDICTED_QPS, AUTOSCALE_PREDICTED_P99,
                       AUTOSCALE_FLEET_P99, AUTOSCALE_TARGET,
-                      AUTOSCALE_WARM_POOL, AUTOSCALE_DECISIONS]
+                      AUTOSCALE_WARM_POOL, AUTOSCALE_DECISIONS,
+                      AUTOSCALE_OBSERVED_QPS]
 
 _LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE] + _AUTOSCALE_METRICS
 
@@ -365,6 +382,22 @@ JOB_RESIZE_SECONDS = Histogram(
 
 _JOB_METRICS = [JOB_RECOVERIES, JOB_RESIZE_SECONDS]
 
+# -- fleet telemetry plane (scrape federation + SLO engine; emitted by
+# the telemetry daemon in the API-server process) ----------------------
+
+TELEMETRY_SCRAPES = Counter(
+    'skyt_telemetry_scrapes_total',
+    'Federation daemon scrape attempts by target service and outcome '
+    '(ok, error)',
+    labels=('service', 'outcome'))
+ALERTS_FIRING = Gauge(
+    'skyt_alerts_firing',
+    'SLO burn-rate alert state per slo/severity (1 = firing; pending '
+    'and resolved read 0)',
+    labels=('slo', 'severity'))
+
+_TELEMETRY_METRICS = [TELEMETRY_SCRAPES, ALERTS_FIRING]
+
 # -- dynamically named families ----------------------------------------
 # Families whose full name is computed at emission time (the inference
 # server renders one gauge/counter per engine stat). skylint SKYT003
@@ -386,11 +419,25 @@ INFERENCE_COUNTER_STATS = frozenset({
 })
 # Highest recovery_events row id already folded into _JOB_METRICS.
 _recovery_cursor = 0
+# Paging cursor over terminal request rows already folded into
+# REQUESTS_TOTAL / REQUEST_EXEC_SECONDS, and the highest
+# cluster_events row id folded into PROVISION_SECONDS — the same
+# page-from-a-cursor stance as _recovery_cursor, so scrape cost is
+# proportional to NEW rows, not the deployment's lifetime history
+# (the old collect re-scanned and re-aggregated everything per render).
+# Built lazily: requests_db imports this module's sibling surface.
+_terminal_cursor = None
+_provision_cursor = 0
+# Serializes collect passes: concurrent scrapes (HTTP thread + the
+# telemetry daemon) paging the same cursor would double-count rows.
+_collect_lock = threading.Lock()
 
-_ALL = ([REQUESTS_TOTAL, QUEUE_DEPTH, PROVISION_SECONDS, DAEMON_TICKS,
+_ALL = ([REQUESTS_TOTAL, REQUESTS_IN_FLIGHT, QUEUE_DEPTH,
+         PROVISION_SECONDS, DAEMON_TICKS,
          RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS, BUILD_INFO,
          REQUEST_EXEC_SECONDS]
-        + _LB_METRICS + _TRANSFER_METRICS + _JOB_METRICS)
+        + _LB_METRICS + _TRANSFER_METRICS + _JOB_METRICS
+        + _TELEMETRY_METRICS)
 
 
 def collect_from_db() -> None:
@@ -399,61 +446,75 @@ def collect_from_db() -> None:
     Request execution forks per request (executor.py), so counters
     incremented in children would be lost -- the requests/cluster-event
     DBs are the durable source of truth; /api/metrics recomputes from
-    them on scrape.
+    them on scrape. Cumulative families (request totals, exec-latency
+    and provision histograms, job recoveries) page NEW rows from
+    cursors and accumulate; only the cheap point-in-time families are
+    recomputed per render.
     """
     from skypilot_tpu import state
     from skypilot_tpu.server import requests_db
     from skypilot_tpu.utils import events
-    with _lock:
-        REQUESTS_TOTAL._values.clear()
-        for hist in (PROVISION_SECONDS, REQUEST_EXEC_SECONDS):
-            hist._counts.clear()
-            hist._sums.clear()
-            hist._totals.clear()
-            hist._samples.clear()
-            hist._exemplars.clear()
-        EVENT_WAKEUPS._values.clear()
-        NOTIFICATIONS._values.clear()
-    # Notification-bus health (this process's loops: executor spawner,
-    # /api/get long-polls, daemons): delivered-vs-fallback ratios show
-    # whether eventing is working or the control plane is living on the
-    # degraded poll path.
-    for (topic, source), count in events.wakeup_counts().items():
-        EVENT_WAKEUPS.inc(count, topic=topic, source=source)
-    for topic, count in events.publish_counts().items():
-        NOTIFICATIONS.inc(count, topic=topic, outcome='delivered')
-    for topic, count in events.suppressed_counts().items():
-        NOTIFICATIONS.inc(count, topic=topic, outcome='suppressed')
-    for name, status, count in requests_db.count_by_name_status():
-        REQUESTS_TOTAL.inc(count, name=name, status=status)
-    # Request-execution latency with trace exemplars: the durable rows
-    # carry the traceparent, so slow buckets point at the exact trace
-    # to pull (the percentile -> request bridge).
-    for name, status, seconds, trace_id in \
-            requests_db.terminal_durations():
-        REQUEST_EXEC_SECONDS.observe(seconds, exemplar=trace_id,
-                                     name=name, status=status)
-    for queue, depth in requests_db.pending_depth_by_queue().items():
-        QUEUE_DEPTH.set(depth, queue=queue)
-    for record in state.get_clusters():
-        for event in state.get_cluster_events(record.name):
-            if event['event'] == 'PROVISION_DONE':
-                try:
-                    PROVISION_SECONDS.observe(float(event['detail']),
-                                              cloud=record.cloud or '?')
-                except (TypeError, ValueError):
-                    pass
-    # recovery_events is append-only and never pruned: page from a
-    # cursor so scrape cost stays proportional to NEW recoveries, not
-    # the deployment's lifetime history.
-    global _recovery_cursor
-    from skypilot_tpu.jobs import state as jobs_state
-    for event in jobs_state.recovery_events(after_id=_recovery_cursor):
-        JOB_RECOVERIES.inc(mode=event['mode'])
-        if event['seconds'] is not None:
-            JOB_RESIZE_SECONDS.observe(float(event['seconds']),
-                                       mode=event['mode'])
-        _recovery_cursor = event['id']
+    global _recovery_cursor, _terminal_cursor, _provision_cursor
+    with _collect_lock:
+        with _lock:
+            EVENT_WAKEUPS._values.clear()
+            NOTIFICATIONS._values.clear()
+        # Notification-bus health (this process's loops: executor
+        # spawner, /api/get long-polls, daemons): delivered-vs-fallback
+        # ratios show whether eventing is working or the control plane
+        # is living on the degraded poll path.
+        for (topic, source), count in events.wakeup_counts().items():
+            EVENT_WAKEUPS.inc(count, topic=topic, source=source)
+        for topic, count in events.publish_counts().items():
+            NOTIFICATIONS.inc(count, topic=topic, outcome='delivered')
+        for topic, count in events.suppressed_counts().items():
+            NOTIFICATIONS.inc(count, topic=topic, outcome='suppressed')
+        # Terminal transitions: counted once each, with the submitting
+        # workspace (the per-tenant source series); exec latency rides
+        # the same page with trace exemplars, so slow buckets point at
+        # the exact trace to pull (the percentile -> request bridge).
+        if _terminal_cursor is None:
+            _terminal_cursor = requests_db.TerminalCursor()
+        page_limit = 2000
+        while True:
+            page = _terminal_cursor.page(limit=page_limit)
+            for row in page:
+                workspace = row['workspace'] or 'default'
+                REQUESTS_TOTAL.inc(name=row['name'],
+                                   status=row['status'],
+                                   workspace=workspace)
+                if row['created_at'] is not None:
+                    seconds = max(0.0,
+                                  row['finished_at'] - row['created_at'])
+                    REQUEST_EXEC_SECONDS.observe(
+                        seconds, exemplar=row['trace_id'],
+                        name=row['name'], status=row['status'],
+                        workspace=workspace)
+            if len(page) < page_limit:
+                break
+        for status, count in requests_db.in_flight_by_status().items():
+            REQUESTS_IN_FLIGHT.set(count, status=status)
+        for queue, depth in requests_db.pending_depth_by_queue().items():
+            QUEUE_DEPTH.set(depth, queue=queue)
+        for event in state.cluster_events_after(_provision_cursor,
+                                                event='PROVISION_DONE'):
+            try:
+                PROVISION_SECONDS.observe(float(event['detail']),
+                                          cloud=event['cloud'] or '?')
+            except (TypeError, ValueError):
+                pass
+            _provision_cursor = event['id']
+        # recovery_events is append-only and never pruned: page from a
+        # cursor so scrape cost stays proportional to NEW recoveries,
+        # not the deployment's lifetime history.
+        from skypilot_tpu.jobs import state as jobs_state
+        for event in jobs_state.recovery_events(
+                after_id=_recovery_cursor):
+            JOB_RECOVERIES.inc(mode=event['mode'])
+            if event['seconds'] is not None:
+                JOB_RESIZE_SECONDS.observe(float(event['seconds']),
+                                           mode=event['mode'])
+            _recovery_cursor = event['id']
 
 
 def render_text(openmetrics: bool = False,
@@ -488,9 +549,11 @@ def render_lb_text(openmetrics: bool = False) -> str:
 
 
 def reset_for_tests() -> None:
-    global _recovery_cursor
+    global _recovery_cursor, _terminal_cursor, _provision_cursor
     with _lock:
         _recovery_cursor = 0
+        _terminal_cursor = None
+        _provision_cursor = 0
         for metric in _ALL:
             for attr in ('_values', '_counts', '_sums', '_totals',
                          '_samples', '_exemplars'):

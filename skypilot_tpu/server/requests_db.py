@@ -102,6 +102,9 @@ def _db():
             );
             CREATE INDEX IF NOT EXISTS idx_requests_status
                 ON requests (status, schedule_type);
+            CREATE INDEX IF NOT EXISTS idx_requests_finished
+                ON requests (finished_at)
+                WHERE finished_at IS NOT NULL;
             CREATE UNIQUE INDEX IF NOT EXISTS idx_requests_idem
                 ON requests (idem_key) WHERE idem_key IS NOT NULL;
             CREATE TABLE IF NOT EXISTS server_heartbeats (
@@ -468,12 +471,29 @@ def finalize(request_id: str,
     return cur.rowcount == 1
 
 
-def count_by_name_status() -> List[Tuple[str, str, int]]:
-    """(payload name, status, count) aggregates for /api/metrics."""
+def in_flight_by_status() -> Dict[str, int]:
+    """PENDING/RUNNING row counts (point-in-time, indexed — the
+    terminal transitions feed skyt_requests_total via the
+    :func:`terminal_page` cursor instead of a full-table GROUP BY)."""
     rows = _db().execute(
-        'SELECT name, status, COUNT(*) AS n FROM requests '
-        'GROUP BY name, status').fetchall()
-    return [(r['name'], r['status'], r['n']) for r in rows]
+        'SELECT status, COUNT(*) AS n FROM requests '
+        'WHERE status IN (?, ?) GROUP BY status',
+        (RequestStatus.PENDING.value,
+         RequestStatus.RUNNING.value)).fetchall()
+    out = {RequestStatus.PENDING.value: 0,
+           RequestStatus.RUNNING.value: 0}
+    out.update({r['status']: r['n'] for r in rows})
+    return out
+
+
+def pending_by_workspace() -> Dict[str, int]:
+    """PENDING backlog per workspace — the per-tenant queue-depth
+    source for the telemetry plane's recording rules."""
+    rows = _db().execute(
+        'SELECT workspace, COUNT(*) AS n FROM requests '
+        'WHERE status = ? GROUP BY workspace',
+        (RequestStatus.PENDING.value,)).fetchall()
+    return {(r['workspace'] or 'default'): r['n'] for r in rows}
 
 
 def pending_depth_by_queue() -> Dict[str, int]:
@@ -490,27 +510,79 @@ def pending_depth_by_queue() -> Dict[str, int]:
     return out
 
 
-def terminal_durations(limit: int = 500
-                       ) -> List[Tuple[str, str, float, Optional[str]]]:
-    """(name, status, seconds, trace_id) of the most recently finished
-    requests — feeds the skyt_request_exec_seconds histogram (and its
-    OpenMetrics exemplars) on /api/metrics scrape. Durations come from
-    persisted wall timestamps (the only clock that survives the
-    process), windowed so scrape cost stays bounded."""
-    from skypilot_tpu.utils import tracing
-    rows = _db().execute(
-        'SELECT name, status, created_at, finished_at, trace_context '
-        'FROM requests WHERE finished_at IS NOT NULL '
-        f'ORDER BY finished_at DESC LIMIT {int(limit)}').fetchall()
-    out: List[Tuple[str, str, float, Optional[str]]] = []
-    for r in rows:
-        if r['created_at'] is None:
-            continue
-        seconds = max(0.0, r['finished_at'] - r['created_at'])
-        ctx = tracing.parse_traceparent(r['trace_context'])
-        out.append((r['name'], r['status'], seconds,
-                    ctx.trace_id if ctx is not None else None))
-    return out
+# finalize() stamps finished_at BEFORE taking the DB write lock, so
+# two workers can commit out of timestamp order; the cursor therefore
+# re-reads a trailing overlap window and dedupes by request_id — a row
+# whose commit lagged its stamp by up to this many seconds is still
+# counted exactly once, instead of falling permanently behind the
+# cursor (a stall longer than this is a wedged worker, not a commit
+# gap).
+TERMINAL_OVERLAP_S = 10.0
+
+
+class TerminalCursor:
+    """Paging cursor over rows that reached a terminal status — the
+    O(new)-per-scrape walk behind skyt_requests_total /
+    skyt_request_exec_seconds and the telemetry plane's per-workspace
+    recording rules (the old rescans re-read full history on every
+    render; this pages like the recovery_events cursor already does).
+    Each consumer owns one instance; rows are yielded exactly once.
+    Durations come from persisted wall timestamps (the only clock that
+    survives the process)."""
+
+    def __init__(self, start_ts: float = 0.0) -> None:
+        """``start_ts`` skips history older than it — consumers that
+        only ever look a bounded window back (the telemetry recording
+        rules) must not replay a deployment's lifetime on restart;
+        cumulative consumers (metrics totals) start at 0."""
+        self.ts = max(0.0, start_ts)
+        # request_id -> finished_at for rows already yielded inside
+        # the overlap window (pruned as the cursor advances).
+        self._seen: Dict[str, float] = {}
+
+    def page(self, limit: int = 2000) -> List[Dict[str, Any]]:
+        """Up to ``limit`` unseen terminal rows (ascending by
+        (finished_at, request_id)). A page shorter than ``limit``
+        means the walk is caught up; callers loop otherwise. The scan
+        re-enters the trailing overlap window each call (skipping
+        already-seen ids via a compound scan cursor, so a window full
+        of duplicates still makes progress)."""
+        from skypilot_tpu.utils import tracing
+        conn = _db()
+        scan_ts = self.ts - TERMINAL_OVERLAP_S
+        scan_id = ''
+        out: List[Dict[str, Any]] = []
+        while len(out) < limit:
+            rows = conn.execute(
+                'SELECT request_id, name, status, workspace, '
+                'created_at, finished_at, trace_context FROM requests '
+                'WHERE finished_at IS NOT NULL AND '
+                '(finished_at > ? OR '
+                '(finished_at = ? AND request_id > ?)) '
+                'ORDER BY finished_at, request_id LIMIT ?',
+                (scan_ts, scan_ts, scan_id, int(limit))).fetchall()
+            for r in rows:
+                scan_ts, scan_id = r['finished_at'], r['request_id']
+                self.ts = max(self.ts, r['finished_at'])
+                if r['request_id'] in self._seen:
+                    continue
+                self._seen[r['request_id']] = r['finished_at']
+                ctx = tracing.parse_traceparent(r['trace_context'])
+                out.append({
+                    'request_id': r['request_id'],
+                    'name': r['name'],
+                    'status': r['status'],
+                    'workspace': r['workspace'],
+                    'created_at': r['created_at'],
+                    'finished_at': r['finished_at'],
+                    'trace_id': (ctx.trace_id if ctx is not None
+                                 else None),
+                })
+            if len(rows) < limit:
+                break
+        cutoff = self.ts - TERMINAL_OVERLAP_S
+        self._seen = {k: v for k, v in self._seen.items() if v > cutoff}
+        return out
 
 
 def cancelled_since(ts: float) -> List[Request]:

@@ -1,0 +1,909 @@
+"""Fleet telemetry plane: scrape federation, recording rules, and SLO
+burn-rate alerting over the durable time-series store.
+
+Three layers, all driven by the supervised ``telemetry`` daemon inside
+the API-server process (``server/daemons.py``):
+
+1. **Scrape federation** — every tick (``SKYT_TELEMETRY_INTERVAL``,
+   jittered so a fleet of replicas doesn't thundering-herd its
+   targets), the daemon pulls every exposition surface the platform
+   has: the API server's own ``/api/metrics`` (rendered in-process —
+   same surface, no self-HTTP), each serve LB's ``/-/lb/metrics``, and
+   each READY inference replica's ``/metrics``. Samples are stamped
+   with ``instance``/``service`` source labels (scraped labels win on
+   collision) and land in the :mod:`skypilot_tpu.utils.tsdb` store
+   under ``<server_dir>/telemetry/`` — compressed, retained, and
+   rollup-downsampled, so history survives every process involved.
+2. **Recording rules** — per-workspace request-latency quantiles
+   (``workspace:request_exec_seconds:p50|p95|p99{workspace=...}``) and
+   queue depths (``workspace:request_queue_depth:sum``) are derived
+   from the durable requests rows (cursor-paged — scrape cost is
+   proportional to NEW terminal rows) and written back into the store:
+   the per-tenant p99 surface the control-plane scale harness
+   (ROADMAP item 1) reads.
+3. **SLO engine** — declarative ``slos:`` specs in the layered config
+   (objective + window + an availability/latency indicator over stored
+   series) are evaluated as multi-window multi-burn-rate alerts
+   (Beyer et al., *The Site Reliability Workbook* ch. 5): the ``page``
+   severity fires when both the 5 m and 1 h burn rates exceed 14.4×
+   budget, ``ticket`` when both 30 m and 6 h exceed 6×. Alerts walk a
+   pending→firing→resolved state machine, publish on the ``ALERTS``
+   events topic, degrade ``/api/health``, and surface on
+   ``GET /api/alerts`` + the ``skyt alerts`` CLI.
+
+Read surfaces: ``GET /api/metrics/query`` (range queries; ``skyt
+metrics query`` renders them as a terminal sparkline), ``GET
+/api/metrics/federate`` (latest sample of every stored series, v0
+text — point an external Prometheus at it), and
+:func:`hydrate_autoscaler` (the serve controller replays the stored
+QPS history into its seasonal forecaster on restart, so scale-to-zero
+no longer amnesia-wipes the learned traffic shape).
+
+Spec shape (config ``slos:`` list)::
+
+    slos:
+      - name: lb-availability
+        objective: 0.999            # error budget = 1 - objective
+        window_seconds: 2592000     # budget window (default 30 d)
+        indicator:
+          type: availability
+          metric: skyt_lb_requests_total
+          bad_labels: {outcome: upstream_error}
+          labels: {service: my-svc}   # optional extra filter
+      - name: api-latency
+        objective: 0.99
+        indicator:
+          type: latency
+          metric: skyt_request_exec_seconds   # histogram base name
+          threshold_s: 30
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Tuple)
+
+from skypilot_tpu.utils import env_registry, events, fault_injection, log
+from skypilot_tpu.utils import tsdb
+
+logger = log.init_logger(__name__)
+
+# Rolling window the per-workspace latency quantiles are computed over.
+_QUANTILE_WINDOW_S = 900.0
+# Retention sweeps are cheap but pointless at scrape cadence.
+_RETENTION_SWEEP_S = 600.0
+# Series whose last sample is older than this drop off /federate.
+_FEDERATE_MAX_AGE_S = 600.0
+
+# Multi-window multi-burn-rate defaults (SRE workbook ch. 5, for a
+# 30-day window): (short_window_s, long_window_s, burn_threshold).
+DEFAULT_FAST = (300.0, 3600.0, 14.4)
+DEFAULT_SLOW = (1800.0, 21600.0, 6.0)
+# The canonical budget fractions behind those thresholds: page when 2%
+# of the budget burns inside the fast long-window, ticket at 5% inside
+# the slow one (threshold = fraction * budget_window / alert_window —
+# 0.02 * 30 d / 1 h = 14.4; 0.05 * 30 d / 6 h = 6). Specs with a
+# non-default window_seconds get their default thresholds re-derived
+# from the same fractions, so the configured budget window is
+# MEANINGFUL, not decorative.
+_FAST_BUDGET_FRACTION = 0.02
+_SLOW_BUDGET_FRACTION = 0.05
+
+
+def telemetry_root() -> str:
+    override = env_registry.get_str('SKYT_TELEMETRY_DIR')
+    if override:
+        return os.path.expanduser(override)
+    from skypilot_tpu.server import requests_db
+    return os.path.join(requests_db.server_dir(), 'telemetry')
+
+
+def open_store(root: Optional[str] = None) -> tsdb.TSDB:
+    """A store handle on the telemetry directory with the declared
+    retention knobs (writer in the API server; read-only elsewhere)."""
+    return tsdb.TSDB(
+        root or telemetry_root(),
+        raw_retention_s=env_registry.get_float(
+            'SKYT_TELEMETRY_RAW_RETENTION_S'),
+        rollup_retention_s=env_registry.get_float(
+            'SKYT_TELEMETRY_ROLLUP_RETENTION_S'),
+        rollup_bucket_s=env_registry.get_float(
+            'SKYT_TELEMETRY_ROLLUP_BUCKET_S'))
+
+
+# -- exposition parsing -------------------------------------------------
+
+
+def _parse_labels(raw: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    i = 0
+    n = len(raw)
+    while i < n:
+        eq = raw.find('=', i)
+        if eq < 0:
+            break
+        key = raw[i:eq].strip().strip(',')
+        i = eq + 1
+        if i >= n or raw[i] != '"':
+            break
+        i += 1
+        out = []
+        while i < n:
+            ch = raw[i]
+            if ch == '\\' and i + 1 < n:
+                nxt = raw[i + 1]
+                out.append({'n': '\n', '"': '"', '\\': '\\'}.get(nxt, nxt))
+                i += 2
+                continue
+            if ch == '"':
+                break
+            out.append(ch)
+            i += 1
+        labels[key] = ''.join(out)
+        i += 1
+        while i < n and raw[i] in ', ':
+            i += 1
+    return labels
+
+
+def _label_block_end(raw: str, start: int) -> int:
+    """Index of the '}' closing the label block opened at ``start``,
+    honoring quoting/escapes (a '}' or ' # ' INSIDE a label value must
+    not end the block); -1 when unterminated."""
+    in_quote = False
+    i = start + 1
+    while i < len(raw):
+        ch = raw[i]
+        if in_quote:
+            if ch == '\\':
+                i += 2
+                continue
+            if ch == '"':
+                in_quote = False
+        elif ch == '"':
+            in_quote = True
+        elif ch == '}':
+            return i
+        i += 1
+    return -1
+
+
+def parse_exposition(text: str
+                     ) -> Tuple[List[Tuple[str, Dict[str, str], float]],
+                                Dict[str, str]]:
+    """Parse a Prometheus text/OpenMetrics exposition into
+    ``([(name, labels, value), ...], {family: type})``. Exemplars and
+    trailing timestamps are ignored; malformed lines are skipped."""
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith('#'):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == 'TYPE':
+                types[parts[2]] = parts[3].strip()
+            continue
+        brace = line.find('{')
+        if brace >= 0:
+            # Quote-aware close scan: a '}' or ' # ' inside a label
+            # value must not truncate the block.
+            close = _label_block_end(line, brace)
+            if close < 0:
+                continue
+            name = line[:brace]
+            labels = _parse_labels(line[brace + 1:close])
+            value_part = line[close + 1:].strip()
+        else:
+            fields = line.split()
+            if len(fields) < 2:
+                continue
+            name, value_part = fields[0], ' '.join(fields[1:])
+            labels = {}
+        # value [timestamp] [# exemplar...] — the first token is the
+        # value; OpenMetrics exemplars trail and are ignored.
+        value_fields = value_part.split()
+        if not value_fields:
+            continue
+        try:
+            value = float(value_fields[0])
+        except ValueError:
+            continue
+        samples.append((name.strip(), labels, value))
+    return samples, types
+
+
+def sample_kind(name: str, types: Dict[str, str]) -> str:
+    """counter vs gauge for one sample name, from the exposition's TYPE
+    lines (histogram/summary components are counters; untyped ``_total``
+    names default to counter)."""
+    t = types.get(name)
+    if t == 'counter':
+        return tsdb.KIND_COUNTER
+    if t is not None:
+        return tsdb.KIND_GAUGE
+    for suffix in ('_bucket', '_count', '_sum'):
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            if types.get(base) in ('histogram', 'summary'):
+                return tsdb.KIND_COUNTER
+    if name.endswith('_total'):
+        # OpenMetrics names counter families by the base name.
+        if types.get(name[:-len('_total')]) == 'counter':
+            return tsdb.KIND_COUNTER
+        if name[:-len('_total')] not in types and name not in types:
+            return tsdb.KIND_COUNTER
+    return tsdb.KIND_GAUGE
+
+
+# -- scrape targets -----------------------------------------------------
+
+
+class ScrapeTarget(NamedTuple):
+    kind: str                       # api-server | serve-lb | replica
+    service: str
+    instance: str
+    fetch: Callable[[], str]
+
+
+def _http_fetch(url: str, timeout: float) -> Callable[[], str]:
+    def fetch() -> str:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode('utf-8', 'replace')
+    return fetch
+
+
+# -- SLO specs ----------------------------------------------------------
+
+
+class SLOSpec:
+    """One validated ``slos:`` entry (see module docstring)."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        if not isinstance(config, dict):
+            raise ValueError('slo spec must be a mapping')
+        self.name = str(config.get('name') or '')
+        if not self.name:
+            raise ValueError('slo spec needs a name')
+        self.objective = float(config['objective'])
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f'slo {self.name}: objective must be in (0, 1)')
+        self.window_seconds = float(
+            config.get('window_seconds', 30 * 86400.0))
+        indicator = config.get('indicator') or {}
+        self.indicator_type = indicator.get('type', 'availability')
+        if self.indicator_type not in ('availability', 'latency'):
+            raise ValueError(
+                f'slo {self.name}: unknown indicator type '
+                f'{self.indicator_type!r}')
+        self.metric = str(indicator.get('metric') or '')
+        if not self.metric:
+            raise ValueError(f'slo {self.name}: indicator needs a metric')
+        self.labels: Dict[str, str] = {
+            str(k): str(v)
+            for k, v in (indicator.get('labels') or {}).items()}
+        self.bad_labels: Dict[str, str] = {
+            str(k): str(v)
+            for k, v in (indicator.get('bad_labels') or {}).items()}
+        if self.indicator_type == 'availability' and not self.bad_labels:
+            raise ValueError(
+                f'slo {self.name}: availability indicator needs '
+                'bad_labels')
+        self.threshold_s = float(indicator.get('threshold_s', 0.0))
+        if self.indicator_type == 'latency' and self.threshold_s <= 0:
+            raise ValueError(
+                f'slo {self.name}: latency indicator needs threshold_s')
+        self.fast = self._windows(config, 'fast', DEFAULT_FAST,
+                                  _FAST_BUDGET_FRACTION)
+        self.slow = self._windows(config, 'slow', DEFAULT_SLOW,
+                                  _SLOW_BUDGET_FRACTION)
+        self.for_seconds = float(
+            config.get('for_seconds',
+                       env_registry.get_float('SKYT_SLO_FOR_SECONDS')))
+
+    def _windows(self, config: Dict[str, Any], key: str,
+                 default: Tuple[float, float, float],
+                 budget_fraction: float
+                 ) -> Tuple[float, float, float]:
+        windows = config.get(f'{key}_window_seconds')
+        burn = config.get(f'{key}_burn')
+        short, long_, thr = default
+        if isinstance(windows, (list, tuple)) and len(windows) == 2:
+            short, long_ = float(windows[0]), float(windows[1])
+        if burn is not None:
+            thr = float(burn)
+        else:
+            # No explicit threshold: derive it from the spec's budget
+            # window and alert long-window via the canonical fraction
+            # (reduces to the workbook's 14.4/6 at 30 d + 1 h/6 h).
+            thr = budget_fraction * self.window_seconds / max(1.0, long_)
+        return short, long_, thr
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+
+def load_slo_specs() -> List[SLOSpec]:
+    """Validated specs from the layered config; invalid entries are
+    logged and skipped (a typo'd spec must not kill the daemon)."""
+    from skypilot_tpu import config
+    specs: List[SLOSpec] = []
+    for entry in config.get_nested(('slos',), None) or []:
+        try:
+            specs.append(SLOSpec(entry))
+        except (ValueError, TypeError, KeyError) as e:
+            logger.warning('ignoring invalid slo spec %r: %s', entry, e)
+    return specs
+
+
+# -- burn-rate math -----------------------------------------------------
+
+
+def _increase(store: tsdb.TSDB, name: str, labels: Dict[str, str],
+              start: float, end: float) -> Optional[float]:
+    """Summed counter increase over [start, end] across matching
+    series (stored counters are reset-adjusted, so a plain difference
+    is correct across exporter restarts). ``None`` = no data."""
+    total = 0.0
+    found = False
+    for series in store.query_range(name, start - 120.0, end,
+                                    labels or None):
+        base = last = None
+        for ts, v in series.points:
+            if ts <= start:
+                base = v
+            if ts <= end:
+                last = v
+        if last is None:
+            continue
+        if base is None:
+            # Series younger than the window: its first sample is the
+            # baseline (everything before it is zero increase).
+            base = series.points[0][1]
+        found = True
+        total += max(0.0, last - base)
+    return total if found else None
+
+
+def error_rate(store: tsdb.TSDB, spec: SLOSpec, now: float,
+               window: float) -> Optional[float]:
+    """Fraction of bad events over the trailing ``window`` (None when
+    the store has no matching data or saw no events)."""
+    start = now - window
+    if spec.indicator_type == 'availability':
+        total = _increase(store, spec.metric, spec.labels, start, now)
+        bad_labels = dict(spec.labels)
+        bad_labels.update(spec.bad_labels)
+        bad = _increase(store, spec.metric, bad_labels, start, now)
+        if total is None or total <= 0:
+            return None
+        return min(1.0, (bad or 0.0) / total)
+    # Latency: good = observations under the smallest histogram bucket
+    # bound that covers the threshold; total = the +Inf bucket.
+    inf_labels = dict(spec.labels)
+    inf_labels['le'] = '+Inf'
+    total = _increase(store, spec.metric + '_bucket', inf_labels,
+                      start, now)
+    if total is None or total <= 0:
+        return None
+    good = None
+    best_le = None
+    for series in store.query_range(spec.metric + '_bucket',
+                                    start - 120.0, now,
+                                    spec.labels or None):
+        raw_le = series.labels.get('le')
+        if raw_le in (None, '+Inf'):
+            continue
+        try:
+            le = float(raw_le)
+        except ValueError:
+            continue
+        if le >= spec.threshold_s and (best_le is None or le < best_le):
+            best_le = le
+    if best_le is not None:
+        le_labels = dict(spec.labels)
+        le_labels['le'] = f'{best_le:g}'
+        good = _increase(store, spec.metric + '_bucket', le_labels,
+                         start, now)
+    if good is None:
+        return None
+    return min(1.0, max(0.0, (total - good) / total))
+
+
+def burn_rate(store: tsdb.TSDB, spec: SLOSpec, now: float,
+              window: float) -> Optional[float]:
+    rate = error_rate(store, spec, now, window)
+    if rate is None:
+        return None
+    return rate / max(1e-9, spec.budget)
+
+
+# -- alert state machine ------------------------------------------------
+
+PENDING = 'pending'
+FIRING = 'firing'
+RESOLVED = 'resolved'
+
+
+class Alert:
+    __slots__ = ('slo', 'severity', 'state', 'pending_since',
+                 'firing_since', 'resolved_at', 'burn_short',
+                 'burn_long', 'windows', 'threshold', 'objective')
+
+    def __init__(self, slo: str, severity: str,
+                 windows: Tuple[float, float], threshold: float,
+                 objective: float, now: float) -> None:
+        self.slo = slo
+        self.severity = severity
+        self.state = PENDING
+        self.pending_since = now
+        self.firing_since: Optional[float] = None
+        self.resolved_at: Optional[float] = None
+        self.burn_short = 0.0
+        self.burn_long = 0.0
+        self.windows = windows
+        self.threshold = threshold
+        self.objective = objective
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'slo': self.slo,
+            'severity': self.severity,
+            'state': self.state,
+            'pending_since': self.pending_since,
+            'firing_since': self.firing_since,
+            'resolved_at': self.resolved_at,
+            'burn_short': round(self.burn_short, 3),
+            'burn_long': round(self.burn_long, 3),
+            'windows_seconds': list(self.windows),
+            'burn_threshold': self.threshold,
+            'objective': self.objective,
+        }
+
+
+class AlertManager:
+    """pending→firing→resolved over multi-window burn rates; every
+    transition publishes on the ALERTS events topic and persists the
+    alert table (``alerts.json`` next to the store) so other processes
+    (CLI against a restarted server) read a warm surface."""
+
+    def __init__(self, state_path: Optional[str] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self._alerts: Dict[Tuple[str, str], Alert] = {}
+        self._state_path = state_path
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.resolved_keep_s = env_registry.get_float(
+            'SKYT_SLO_RESOLVED_KEEP_S')
+
+    def evaluate(self, store: tsdb.TSDB, specs: List[SLOSpec],
+                 now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns the transitions that happened
+        (each a dict with slo/severity/from/to)."""
+        if now is None:
+            now = self._clock()
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            live_keys = set()
+            for spec in specs:
+                for severity, (short_s, long_s, threshold) in (
+                        ('page', spec.fast), ('ticket', spec.slow)):
+                    key = (spec.name, severity)
+                    live_keys.add(key)
+                    burn_short = burn_rate(store, spec, now, short_s)
+                    burn_long = burn_rate(store, spec, now, long_s)
+                    breached = (burn_short is not None and
+                                burn_long is not None and
+                                burn_short > threshold and
+                                burn_long > threshold)
+                    transitions.extend(self._advance(
+                        key, spec, severity, (short_s, long_s),
+                        threshold, breached, burn_short, burn_long,
+                        now))
+            # Specs removed from config drop their alerts.
+            for key in [k for k in self._alerts if k not in live_keys]:
+                del self._alerts[key]
+            self._gc(now)
+        if transitions:
+            for t in transitions:
+                logger.warning('slo alert %s/%s: %s -> %s '
+                               '(burn %s/%s over %ss/%ss)',
+                               t['slo'], t['severity'], t['from'],
+                               t['to'], t['burn_short'], t['burn_long'],
+                               t['windows'][0], t['windows'][1])
+            self._persist()
+            events.publish(events.ALERTS)
+        return transitions
+
+    def _advance(self, key, spec: SLOSpec, severity: str,
+                 windows: Tuple[float, float], threshold: float,
+                 breached: bool, burn_short: Optional[float],
+                 burn_long: Optional[float], now: float) -> List[Dict]:
+        alert = self._alerts.get(key)
+        out: List[Dict[str, Any]] = []
+
+        def note(prev: str, new: str) -> None:
+            out.append({'slo': spec.name, 'severity': severity,
+                        'from': prev, 'to': new,
+                        'burn_short': burn_short, 'burn_long': burn_long,
+                        'windows': windows})
+
+        if breached:
+            if alert is None or alert.state == RESOLVED:
+                alert = Alert(spec.name, severity, windows, threshold,
+                              spec.objective, now)
+                self._alerts[key] = alert
+                note('inactive', PENDING)
+            alert.burn_short = burn_short or 0.0
+            alert.burn_long = burn_long or 0.0
+            if (alert.state == PENDING and
+                    now - alert.pending_since >= spec.for_seconds):
+                alert.state = FIRING
+                alert.firing_since = now
+                note(PENDING, FIRING)
+        elif alert is not None:
+            if alert.state == FIRING:
+                alert.state = RESOLVED
+                alert.resolved_at = now
+                alert.burn_short = burn_short or 0.0
+                alert.burn_long = burn_long or 0.0
+                note(FIRING, RESOLVED)
+            elif alert.state == PENDING:
+                # Never fired: drop silently (a blip that healed inside
+                # the for-window is not operator-visible noise).
+                del self._alerts[key]
+        return out
+
+    def _gc(self, now: float) -> None:
+        for key, alert in list(self._alerts.items()):
+            if (alert.state == RESOLVED and alert.resolved_at is not None
+                    and now - alert.resolved_at > self.resolved_keep_s):
+                del self._alerts[key]
+
+    def _persist(self) -> None:
+        if self._state_path is None:
+            return
+        try:
+            os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+            tmp = self._state_path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump({'alerts': self.snapshot()}, f)
+            os.replace(tmp, self._state_path)
+        except OSError as e:
+            logger.debug('alert persist failed: %s', e)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return sorted((a.to_dict() for a in self._alerts.values()),
+                          key=lambda d: (d['slo'], d['severity']))
+
+    def firing(self) -> List[Dict[str, Any]]:
+        return [a for a in self.snapshot() if a['state'] == FIRING]
+
+
+def read_persisted_alerts(root: Optional[str] = None
+                          ) -> List[Dict[str, Any]]:
+    """The last persisted alert table (fallback read surface for
+    processes without a live TelemetryPlane)."""
+    path = os.path.join(root or telemetry_root(), 'alerts.json')
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f).get('alerts', [])
+    except (OSError, ValueError):
+        return []
+
+
+# -- the plane ----------------------------------------------------------
+
+
+class TelemetryPlane:
+    """Store + scraper + recording rules + SLO engine, owned by the
+    API-server process and ticked by the ``telemetry`` daemon."""
+
+    def __init__(self, server_id: Optional[str] = None,
+                 root: Optional[str] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.server_id = server_id
+        self.root = root or telemetry_root()
+        self._clock = clock
+        self.store = open_store(self.root)
+        self.alerts = AlertManager(
+            state_path=os.path.join(self.root, 'alerts.json'),
+            clock=clock)
+        self.scrape_timeout = env_registry.get_float(
+            'SKYT_TELEMETRY_SCRAPE_TIMEOUT')
+        self.flush_interval_s = env_registry.get_float(
+            'SKYT_TELEMETRY_FLUSH_S')
+        self._lock = threading.Lock()
+        self._terminal_cursor = None   # requests_db.TerminalCursor
+        self._ws_windows: Dict[str, collections.deque] = {}
+        self._depth_workspaces: set = set()
+        self._alert_gauge_keys: set = set()
+        self._last_force_flush = 0.0       # monotonic
+        self._last_retention = 0.0         # monotonic
+
+    # -- scrape federation ---------------------------------------------
+
+    def scrape_targets(self) -> List[ScrapeTarget]:
+        from skypilot_tpu.server import metrics
+        server_id = self.server_id
+        targets = [ScrapeTarget(
+            'api-server', 'api-server', server_id or 'local',
+            lambda: metrics.render_text(server_id=server_id))]
+        try:
+            from skypilot_tpu.serve import serve_state
+            for svc in serve_state.list_services():
+                if svc.lb_port:
+                    host = svc.lb_host or '127.0.0.1'
+                    targets.append(ScrapeTarget(
+                        'serve-lb', svc.name, f'{host}:{svc.lb_port}',
+                        _http_fetch(
+                            f'http://{host}:{svc.lb_port}/-/lb/metrics',
+                            self.scrape_timeout)))
+                for rep in serve_state.list_replicas(
+                        svc.name, include_terminal=False):
+                    if (rep.status == serve_state.ReplicaStatus.READY
+                            and rep.endpoint):
+                        instance = rep.endpoint.split('//', 1)[-1]
+                        targets.append(ScrapeTarget(
+                            'replica', svc.name, instance,
+                            _http_fetch(f'{rep.endpoint}/metrics',
+                                        self.scrape_timeout)))
+        except Exception as e:  # pylint: disable=broad-except
+            # Serve state unreadable: scrape what we can this tick.
+            logger.debug('serve target discovery failed: %s', e)
+        return targets
+
+    def scrape_once(self) -> int:
+        """Pull every target into the store; returns samples ingested.
+        Fetches run concurrently and OUTSIDE the plane lock — a few
+        hung targets must cost one scrape timeout, not
+        targets × timeout, and must never block the query surfaces."""
+        from concurrent.futures import ThreadPoolExecutor
+        from skypilot_tpu.server import metrics
+        now = self._clock()
+        targets = self.scrape_targets()
+
+        def fetch(target: ScrapeTarget):
+            try:
+                # Chaos site: a hung/dead target must only cost its
+                # own samples (tests/test_telemetry.py).
+                fault_injection.inject('telemetry.scrape')
+                return target, target.fetch(), None
+            except Exception as e:  # pylint: disable=broad-except
+                return target, None, e
+
+        results = []
+        if targets:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(targets)),
+                    thread_name_prefix='telemetry-scrape') as pool:
+                results = list(pool.map(fetch, targets))
+        ingested = 0
+        with self._lock:
+            for target, text, error in results:
+                if text is None:
+                    logger.debug('scrape %s (%s) failed: %s',
+                                 target.service, target.instance, error)
+                    metrics.TELEMETRY_SCRAPES.inc(
+                        service=target.service, outcome='error')
+                    continue
+                samples, types = parse_exposition(text)
+                for name, labels, value in samples:
+                    labels.setdefault('instance', target.instance)
+                    labels.setdefault('service', target.service)
+                    self.store.ingest(name, labels, value, ts=now,
+                                      kind=sample_kind(name, types))
+                ingested += len(samples)
+                metrics.TELEMETRY_SCRAPES.inc(service=target.service,
+                                              outcome='ok')
+            self._recording_rules(now)
+            self._maintain()
+        return ingested
+
+    def _maintain(self) -> None:
+        """Durability + retention housekeeping (cadence on the
+        monotonic clock: it gates in-process maintenance, not data)."""
+        mono = time.monotonic()
+        force = mono - self._last_force_flush >= self.flush_interval_s
+        if force:
+            self._last_force_flush = mono
+        self.store.flush(force=force)
+        if mono - self._last_retention >= _RETENTION_SWEEP_S:
+            self._last_retention = mono
+            self.store.enforce_retention()
+
+    # -- recording rules -----------------------------------------------
+
+    def _recording_rules(self, now: float) -> None:
+        try:
+            from skypilot_tpu.server import requests_db
+            if self._terminal_cursor is None:
+                # Seeded at the quantile window's edge: the rules only
+                # ever look _QUANTILE_WINDOW_S back, so a restart must
+                # cost O(window), not O(deployment lifetime).
+                self._terminal_cursor = requests_db.TerminalCursor(
+                    start_ts=now - _QUANTILE_WINDOW_S
+                    - requests_db.TERMINAL_OVERLAP_S)
+            while True:
+                rows = self._terminal_cursor.page(limit=2000)
+                for row in rows:
+                    workspace = row['workspace'] or 'default'
+                    if row['created_at'] is not None:
+                        window = self._ws_windows.setdefault(
+                            workspace, collections.deque())
+                        window.append((
+                            row['finished_at'],
+                            max(0.0,
+                                row['finished_at'] - row['created_at'])))
+                if len(rows) < 2000:
+                    break
+            cutoff = now - _QUANTILE_WINDOW_S
+            for workspace, window in list(self._ws_windows.items()):
+                while window and window[0][0] < cutoff:
+                    window.popleft()
+                if not window:
+                    del self._ws_windows[workspace]
+                    continue
+                values = sorted(v for _, v in window)
+                for q, suffix in ((0.5, 'p50'), (0.95, 'p95'),
+                                  (0.99, 'p99')):
+                    idx = min(len(values) - 1, int(q * len(values)))
+                    self.store.ingest(
+                        'workspace:request_exec_seconds:' + suffix,
+                        {'workspace': workspace}, values[idx], ts=now)
+            depths = requests_db.pending_by_workspace()
+            # A workspace draining to zero must RECORD the zero: its
+            # series stopping at the last nonzero value would leave a
+            # phantom backlog on /federate and in range queries.
+            for workspace in self._depth_workspaces - set(depths):
+                depths[workspace] = 0
+            self._depth_workspaces = {ws for ws, d in depths.items()
+                                      if d > 0}
+            for workspace, depth in depths.items():
+                self.store.ingest('workspace:request_queue_depth:sum',
+                                  {'workspace': workspace},
+                                  float(depth), ts=now)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug('recording rules skipped: %s', e)
+
+    # -- SLO evaluation ------------------------------------------------
+
+    def evaluate_slos(self, now: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+        from skypilot_tpu.server import metrics
+        transitions = self.alerts.evaluate(self.store, load_slo_specs(),
+                                           now=now)
+        live_keys = set()
+        for alert in self.alerts.snapshot():
+            live_keys.add((alert['slo'], alert['severity']))
+            metrics.ALERTS_FIRING.set(
+                1.0 if alert['state'] == FIRING else 0.0,
+                slo=alert['slo'], severity=alert['severity'])
+        # Alerts dropped from the table (spec removed from config, GC'd
+        # resolved) must not strand their gauge series at 1.
+        for slo, severity in self._alert_gauge_keys - live_keys:
+            metrics.ALERTS_FIRING.set(0.0, slo=slo, severity=severity)
+        self._alert_gauge_keys = live_keys
+        return transitions
+
+    def tick(self) -> None:
+        """One daemon tick: scrape, derive, evaluate."""
+        self.scrape_once()
+        self.evaluate_slos()
+
+    # -- read surfaces -------------------------------------------------
+
+    def query(self, name: str, start: float, end: float,
+              labels: Optional[Dict[str, str]] = None,
+              step: Optional[float] = None,
+              agg: str = 'mean') -> Dict[str, Any]:
+        series_list = self.store.query_range(name, start, end, labels,
+                                             agg=agg)
+        out = []
+        for series in series_list:
+            points = series.points
+            if step and step > 0 and points:
+                # Last-in-bucket downsample to the requested step.
+                buckets: Dict[int, Tuple[float, float]] = {}
+                for ts, v in points:
+                    buckets[int(ts // step)] = (ts, v)
+                points = [buckets[b] for b in sorted(buckets)]
+            out.append({'name': series.name, 'labels': series.labels,
+                        'points': [[round(ts, 3), v]
+                                   for ts, v in points]})
+        return {'name': name, 'start': start, 'end': end, 'series': out}
+
+    def federate_text(self, openmetrics: bool = False) -> str:
+        """Latest sample of every live stored series, Prometheus v0
+        text with millisecond timestamps — the surface an external
+        Prometheus federates from."""
+
+        def esc(raw: str) -> str:
+            # Ingest unescaped label values; re-escape on render or a
+            # quote/backslash/newline in one value breaks the whole
+            # scrape for a strict parser.
+            return (raw.replace('\\', '\\\\').replace('"', '\\"')
+                    .replace('\n', '\\n'))
+
+        lines: List[str] = []
+        # One index walk for every series (a per-name latest() loop
+        # re-walks the whole chunk index once per metric name — and
+        # this surface is auth-exempt).
+        for series in self.store.latest_all(_FEDERATE_MAX_AGE_S):
+            ts, value = series.points[-1]
+            if series.labels:
+                inner = ','.join(
+                    f'{k}="{esc(v)}"'
+                    for k, v in sorted(series.labels.items()))
+                label_str = '{' + inner + '}'
+            else:
+                label_str = ''
+            # repr-precision value: %g's 6 significant digits would
+            # corrupt large counters on the wire. Timestamp units
+            # differ by spec: v0 takes milliseconds, OpenMetrics takes
+            # seconds (ms there would date samples ~year 56000 and a
+            # strict scraper would drop every sample).
+            ts_str = f'{ts:.3f}' if openmetrics else str(int(ts * 1000))
+            lines.append(f'{series.name}{label_str} {value!r} {ts_str}')
+        if openmetrics:
+            lines.append('# EOF')
+        return '\n'.join(lines) + '\n'
+
+    def close(self) -> None:
+        with self._lock:
+            self.store.close()
+
+
+# -- forecaster hydration ----------------------------------------------
+
+
+def hydrate_autoscaler(service_name: str, autoscaler,
+                       root: Optional[str] = None) -> Dict[str, Any]:
+    """Replay the stored QPS history of ``service_name`` into a
+    freshly-constructed autoscaler's forecaster (and seed its observed
+    fleet p99), so a restarted controller resumes with the learned
+    traffic shape instead of a cold ring. Stored wall timestamps are
+    mapped onto the autoscaler's (monotonic) clock by their age, which
+    preserves the relative phase the seasonal ring keys on. Best-effort:
+    any failure leaves the autoscaler exactly as constructed."""
+    result: Dict[str, Any] = {'qps_samples': 0, 'fleet_p99_ms': None}
+    forecaster = getattr(autoscaler, 'forecaster', None)
+    if forecaster is None:
+        return result
+    try:
+        store = open_store(root)
+        wall_now = time.time()
+        lookback = max(float(getattr(forecaster, 'period', 0.0) or 0.0),
+                       6 * 3600.0)
+        merged: Dict[float, float] = {}
+        for series in store.query_range('skyt_autoscale_observed_qps',
+                                        wall_now - lookback, wall_now,
+                                        {'service': service_name}):
+            for ts, value in series.points:
+                merged[ts] = value
+        clock_now = autoscaler._clock()  # pylint: disable=protected-access
+        for ts in sorted(merged):
+            age = wall_now - ts
+            if age <= 0:
+                continue
+            forecaster.observe(clock_now - age, merged[ts])
+            result['qps_samples'] += 1
+        for series in store.latest('skyt_autoscale_fleet_p99_ms',
+                                   {'service': service_name}):
+            result['fleet_p99_ms'] = series.points[-1][1]
+        snapshot = getattr(autoscaler, '_snapshot', None)
+        if result['fleet_p99_ms'] is not None and \
+                isinstance(snapshot, dict):
+            snapshot.setdefault('observed_p99_ms', result['fleet_p99_ms'])
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug('autoscaler hydration skipped: %s', e)
+    return result

@@ -276,3 +276,23 @@ def get_cluster_events(name: str) -> List[Dict[str, Any]]:
         'SELECT ts, event, detail FROM cluster_events WHERE cluster_name=? '
         'ORDER BY ts', (name,)).fetchall()
     return [dict(r) for r in rows]
+
+
+def cluster_events_after(after_id: int,
+                         event: Optional[str] = None
+                         ) -> List[Dict[str, Any]]:
+    """Cluster events past an id cursor, across ALL clusters, joined
+    with the owning cluster's cloud — the O(new)-per-scrape read behind
+    the /api/metrics provision histogram (the per-cluster
+    get_cluster_events walk re-read full history every render). The
+    LEFT JOIN keeps events of since-deleted clusters (cloud None)."""
+    sql = ('SELECT e.id, e.cluster_name, e.ts, e.event, e.detail, '
+           'c.cloud FROM cluster_events e '
+           'LEFT JOIN clusters c ON c.name = e.cluster_name '
+           'WHERE e.id > ?')
+    args: List[Any] = [int(after_id)]
+    if event is not None:
+        sql += ' AND e.event = ?'
+        args.append(event)
+    rows = _db().execute(sql + ' ORDER BY e.id', args).fetchall()
+    return [dict(r) for r in rows]

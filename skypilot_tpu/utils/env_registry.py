@@ -108,6 +108,41 @@ DECLARATIONS: List[EnvVar] = _decl([
      'path-like value also sets the JSON report destination '
      '(docs/static_analysis.md).'),
 
+    # -- fleet telemetry plane --------------------------------------
+    ('SKYT_SLO_FOR_SECONDS', 'float', 60.0,
+     'SLO engine: default seconds a burn-rate breach must persist '
+     'before a pending alert fires (spec for_seconds overrides).'),
+    ('SKYT_SLO_RESOLVED_KEEP_S', 'float', 3600.0,
+     'SLO engine: seconds a resolved alert stays on /api/alerts '
+     'before it is dropped.'),
+    ('SKYT_TELEMETRY_DIR', 'path', None,
+     'Telemetry store directory override (default: '
+     '<server_dir>/telemetry).'),
+    ('SKYT_TELEMETRY_ENABLED', 'bool', True,
+     'Run the scrape-federation telemetry daemon in the API server '
+     '(0 disables the whole plane; /api/get stays untouched either '
+     'way).'),
+    ('SKYT_TELEMETRY_FLUSH_S', 'float', 60.0,
+     'Telemetry store: cadence of forced head-chunk flushes (bounds '
+     'how far cross-process readers lag the scraper).'),
+    ('SKYT_TELEMETRY_INTERVAL', 'float', 15.0,
+     'Scrape-federation cadence (seconds); each tick is jittered by '
+     'SKYT_TELEMETRY_JITTER.'),
+    ('SKYT_TELEMETRY_JITTER', 'float', 0.2,
+     'Fractional jitter applied to every scrape interval (0.2 = '
+     '+/-20%) so replica fleets do not scrape in lockstep.'),
+    ('SKYT_TELEMETRY_RAW_RETENTION_S', 'float', 6 * 3600.0,
+     'Telemetry store: raw-resolution retention (seconds); older '
+     'segments are reclaimed, their history lives on in the '
+     'rollups.'),
+    ('SKYT_TELEMETRY_ROLLUP_BUCKET_S', 'float', 300.0,
+     'Telemetry store: downsample bucket width (seconds; mean and '
+     'max are kept per bucket).'),
+    ('SKYT_TELEMETRY_ROLLUP_RETENTION_S', 'float', 14 * 86400.0,
+     'Telemetry store: rollup retention (seconds).'),
+    ('SKYT_TELEMETRY_SCRAPE_TIMEOUT', 'float', 2.0,
+     'Per-target HTTP timeout for federation scrapes (seconds).'),
+
     # -- notification bus -------------------------------------------
     ('SKYT_EVENTS_DISABLED', 'bool', False,
      'Disable the notification bus; control-plane loops fall back to '

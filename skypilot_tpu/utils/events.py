@@ -63,6 +63,10 @@ RUNTIME_JOBS = 'runtime-jobs'  # cluster-local job table (runtime/job_lib)
 CLUSTERS = 'clusters'          # cluster records/events (state.py) — job
                                # controllers wake on preemption/health
                                # writes instead of their poll cadence
+ALERTS = 'alerts'              # SLO burn-rate alert transitions
+                               # (server/telemetry.py) — /api/alerts
+                               # long-polls wake on pending/firing/
+                               # resolved edges
 
 DISABLE_ENV = 'SKYT_EVENTS_DISABLED'
 SLICE_ENV = 'SKYT_EVENTS_SLICE'

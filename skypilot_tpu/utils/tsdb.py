@@ -1,0 +1,880 @@
+"""Durable on-disk time-series store for the fleet telemetry plane.
+
+Every metrics surface this platform grew (``/api/metrics``, the serve
+LB's ``/-/lb/metrics``, replica ``/metrics``) is a point-in-time
+snapshot that dies with its process — history, the thing every "did
+p99 regress" and "what did traffic look like yesterday" question needs,
+lived nowhere. This module is the history: an append-only store of
+compressed time-series chunks under ``<server_dir>/telemetry/``, fed by
+the scrape-federation daemon (``server/telemetry.py``) and read by
+range queries, the SLO burn-rate engine, and the serve forecaster's
+restart hydration.
+
+Layout follows Gorilla (Pelkonen et al., VLDB 2015), scaled to a
+single-node control plane:
+
+* **Chunk encoding** — per chunk, timestamps are delta-of-delta coded
+  (a steady scrape cadence costs ~1 bit/sample) and values are
+  XOR-coded against their predecessor (unchanged gauges cost 1 bit;
+  slowly-moving floats store only their meaningful mantissa window).
+* **Segments** — chunks append to ``raw/seg-<ts>.tsdb`` files rotated
+  on a fixed cadence; a torn trailing record (crash mid-append) is
+  ignored on read. Readers in OTHER processes (the serve controller
+  hydrating its forecaster) scan the same files read-only.
+* **Downsampling** — every raw sample also feeds a per-series rollup
+  bucket (``SKYT_TELEMETRY_ROLLUP_BUCKET_S``, default 5 min); when the
+  bucket rolls over, its mean and max land in the ``rollup/`` segment
+  set. Retention is two-tier: raw segments are deleted after
+  ``SKYT_TELEMETRY_RAW_RETENTION_S``, rollups after the (much longer)
+  ``SKYT_TELEMETRY_ROLLUP_RETENTION_S`` — queries stitch rollup points
+  in where raw has been reclaimed.
+* **Counter-reset detection at ingest** — counters are stored as a
+  monotone *adjusted* cumulative: when a scraped value drops below its
+  predecessor (the exporting process restarted), the previous peak is
+  folded into a per-series offset, so a restart reads as a rate
+  discontinuity instead of a huge negative spike. The offset state
+  itself survives store restarts by seeding from the persisted tail.
+
+Timestamps are wall-clock seconds (persisted — the SKYT009 exemption
+class); internally they are millisecond integers so delta-of-delta
+stays exact.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import struct
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+# Resolutions a chunk can carry.
+RES_RAW = 0
+RES_ROLLUP_MEAN = 1
+RES_ROLLUP_MAX = 2
+
+KIND_GAUGE = 'gauge'
+KIND_COUNTER = 'counter'
+
+_MAGIC = b'SKTSDB1\n'
+# Record header: marker, flags (bit0: counter; bits 1-2: resolution),
+# key length, sample count, payload length, start/end ts (ms).
+_REC = struct.Struct('<cBHHIqq')
+_REC_MARK = b'C'
+
+
+def series_key(name: str, labels: Dict[str, str]) -> str:
+    """Canonical identity of one series (name + sorted label pairs)."""
+    return json.dumps([name, sorted(labels.items())],
+                      separators=(',', ':'))
+
+
+def parse_key(key: str) -> Tuple[str, Dict[str, str]]:
+    name, pairs = json.loads(key)
+    return name, dict(pairs)
+
+
+# -- bit-level codec ----------------------------------------------------
+
+
+class _BitWriter:
+    __slots__ = ('_buf', '_cur', '_nbits')
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._cur = 0
+        self._nbits = 0
+
+    def write(self, value: int, nbits: int) -> None:
+        """Append the low ``nbits`` of ``value`` (MSB first)."""
+        cur, filled = self._cur, self._nbits
+        cur = (cur << nbits) | (value & ((1 << nbits) - 1))
+        filled += nbits
+        while filled >= 8:
+            filled -= 8
+            self._buf.append((cur >> filled) & 0xFF)
+        self._cur = cur & ((1 << filled) - 1)
+        self._nbits = filled
+
+    def getvalue(self) -> bytes:
+        out = bytes(self._buf)
+        if self._nbits:
+            out += bytes([(self._cur << (8 - self._nbits)) & 0xFF])
+        return out
+
+
+class _BitReader:
+    __slots__ = ('_data', '_pos')
+
+    def __init__(self, data: bytes) -> None:
+        self._data = data
+        self._pos = 0
+
+    def read(self, nbits: int) -> int:
+        out = 0
+        pos = self._pos
+        data = self._data
+        for _ in range(nbits):
+            byte = data[pos >> 3]
+            out = (out << 1) | ((byte >> (7 - (pos & 7))) & 1)
+            pos += 1
+        self._pos = pos
+        return out
+
+
+def _zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v < 0 else (v << 1)
+
+
+def _unzigzag(z: int) -> int:
+    return (z >> 1) ^ -(z & 1)
+
+
+def _float_bits(v: float) -> int:
+    return struct.unpack('<Q', struct.pack('<d', v))[0]
+
+
+def _bits_float(b: int) -> float:
+    return struct.unpack('<d', struct.pack('<Q', b))[0]
+
+
+def encode_chunk(samples: List[Tuple[int, float]]) -> bytes:
+    """Gorilla-encode ``[(ts_ms, value), ...]`` (ascending ts)."""
+    w = _BitWriter()
+    prev_ts = prev_delta = 0
+    prev_bits = 0
+    prev_lead = prev_mlen = -1
+    for i, (ts, value) in enumerate(samples):
+        bits = _float_bits(value)
+        if i == 0:
+            w.write(ts, 64)
+            w.write(bits, 64)
+        else:
+            delta = ts - prev_ts
+            dod = delta - prev_delta
+            z = _zigzag(dod)
+            if z == 0:
+                w.write(0b0, 1)
+            elif z < (1 << 7):
+                w.write(0b10, 2)
+                w.write(z, 7)
+            elif z < (1 << 9):
+                w.write(0b110, 3)
+                w.write(z, 9)
+            elif z < (1 << 12):
+                w.write(0b1110, 4)
+                w.write(z, 12)
+            else:
+                w.write(0b1111, 4)
+                w.write(z, 64)
+            prev_delta = delta
+            xor = bits ^ prev_bits
+            if xor == 0:
+                w.write(0b0, 1)
+            else:
+                # Clamp the leading-zero count to the 5-bit field FIRST
+                # and derive the meaningful length from the clamped
+                # value — encoder and decoder must agree on the window.
+                lead = min(64 - xor.bit_length(), 31)
+                trail = (xor & -xor).bit_length() - 1
+                mlen = 64 - lead - trail
+                if (prev_lead >= 0 and lead >= prev_lead and
+                        (64 - prev_lead - prev_mlen) <= trail):
+                    # Fits the previous meaningful window: reuse it.
+                    w.write(0b10, 2)
+                    w.write(xor >> (64 - prev_lead - prev_mlen),
+                            prev_mlen)
+                else:
+                    w.write(0b11, 2)
+                    w.write(lead, 5)
+                    w.write(mlen - 1, 6)
+                    w.write(xor >> trail, mlen)
+                    prev_lead, prev_mlen = lead, mlen
+        if i == 0:
+            prev_delta = 0
+        prev_ts, prev_bits = ts, bits
+    return w.getvalue()
+
+
+def decode_chunk(payload: bytes, count: int) -> List[Tuple[int, float]]:
+    """Inverse of :func:`encode_chunk`."""
+    if count == 0:
+        return []
+    r = _BitReader(payload)
+    out: List[Tuple[int, float]] = []
+    ts = r.read(64)
+    bits = r.read(64)
+    out.append((ts, _bits_float(bits)))
+    delta = 0
+    lead = mlen = -1
+    for _ in range(count - 1):
+        if r.read(1) == 0:
+            dod = 0
+        elif r.read(1) == 0:
+            dod = _unzigzag(r.read(7))
+        elif r.read(1) == 0:
+            dod = _unzigzag(r.read(9))
+        elif r.read(1) == 0:
+            dod = _unzigzag(r.read(12))
+        else:
+            dod = _unzigzag(r.read(64))
+        delta += dod
+        ts += delta
+        if r.read(1) == 1:
+            if r.read(1) == 0:
+                xor = r.read(mlen) << (64 - lead - mlen)
+            else:
+                lead = r.read(5)
+                mlen = r.read(6) + 1
+                xor = r.read(mlen) << (64 - lead - mlen)
+            bits ^= xor
+        out.append((ts, _bits_float(bits)))
+    return out
+
+
+# -- chunk frames -------------------------------------------------------
+
+
+class Chunk(NamedTuple):
+    key: str
+    kind: str                   # gauge | counter
+    resolution: int             # RES_*
+    start_ms: int
+    end_ms: int
+    count: int
+    payload: bytes
+
+    def samples(self) -> List[Tuple[int, float]]:
+        return decode_chunk(self.payload, self.count)
+
+
+def _frame(chunk: Chunk) -> bytes:
+    key_bytes = chunk.key.encode('utf-8')
+    flags = ((1 if chunk.kind == KIND_COUNTER else 0)
+             | (chunk.resolution << 1))
+    return _REC.pack(_REC_MARK, flags, len(key_bytes), chunk.count,
+                     len(chunk.payload), chunk.start_ms,
+                     chunk.end_ms) + key_bytes + chunk.payload
+
+
+def _scan_segment(path: str) -> List[Chunk]:
+    """Decode every complete record in a segment; a torn trailing
+    record (crash mid-append) is silently dropped."""
+    chunks: List[Chunk] = []
+    try:
+        with open(path, 'rb') as f:
+            header = f.read(len(_MAGIC))
+            if header != _MAGIC:
+                return []
+            while True:
+                head = f.read(_REC.size)
+                if len(head) < _REC.size:
+                    break
+                mark, flags, key_len, count, payload_len, start, end = \
+                    _REC.unpack(head)
+                if mark != _REC_MARK:
+                    break
+                body = f.read(key_len + payload_len)
+                if len(body) < key_len + payload_len:
+                    break
+                chunks.append(Chunk(
+                    body[:key_len].decode('utf-8'),
+                    KIND_COUNTER if flags & 1 else KIND_GAUGE,
+                    (flags >> 1) & 0x3, start, end, count,
+                    body[key_len:]))
+    except OSError:
+        return []
+    return chunks
+
+
+class Series(NamedTuple):
+    """One query result series."""
+    name: str
+    labels: Dict[str, str]
+    points: List[Tuple[float, float]]    # (ts seconds, value)
+
+
+class _Head:
+    """The in-memory appending chunk of one series."""
+    __slots__ = ('kind', 'samples')
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self.samples: List[Tuple[int, float]] = []
+
+
+class _RollupBucket:
+    __slots__ = ('start', 'total', 'count', 'maximum')
+
+    def __init__(self, start: float) -> None:
+        self.start = start
+        self.total = 0.0
+        self.count = 0
+        self.maximum = float('-inf')
+
+
+class TSDB:
+    """Append-only compressed time-series store (one writer process;
+    any number of read-only openers)."""
+
+    def __init__(self, root: str,
+                 raw_retention_s: float = 6 * 3600.0,
+                 rollup_retention_s: float = 14 * 86400.0,
+                 rollup_bucket_s: float = 300.0,
+                 chunk_samples: int = 240,
+                 segment_seconds: float = 3600.0,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.root = root
+        self.raw_retention_s = float(raw_retention_s)
+        self.rollup_retention_s = float(rollup_retention_s)
+        self.rollup_bucket_s = max(1.0, float(rollup_bucket_s))
+        self.chunk_samples = max(2, int(chunk_samples))
+        self.segment_seconds = max(1.0, float(segment_seconds))
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._heads: Dict[Tuple[str, int], _Head] = {}
+        self._sealed: List[Chunk] = []
+        # counter key -> (last adjusted value, reset offset); the
+        # adjusted series is what gets stored (monotone across resets).
+        # Persisted to counters.json on forced flushes: the adjusted
+        # tail alone cannot reconstruct the offset, and seeding a
+        # restart with offset=0 would misread the exporter's (lower)
+        # raw value as ANOTHER reset and double-count it.
+        self._counter_state: Dict[str, Tuple[float, float]] = {}
+        self._load_counter_state()
+        self._rollups: Dict[str, _RollupBucket] = {}
+        self._rollup_kind: Dict[str, str] = {}
+        # (path, mtime, size) -> parsed chunks; segments are append-only
+        # so a (size, mtime) match means the cache is current.
+        self._segment_cache: Dict[str, Tuple[float, int, List[Chunk]]] = {}
+        # heads-<pid>.json sidecar cache, same invalidation stance.
+        self._heads_cache: Dict[str, Tuple[Tuple[float, int], list]] = {}
+        self.dropped_out_of_order = 0
+
+    # -- paths ---------------------------------------------------------
+
+    def _dir(self, resolution: int) -> str:
+        return os.path.join(
+            self.root, 'raw' if resolution == RES_RAW else 'rollup')
+
+    def _segments(self, resolution: int) -> List[str]:
+        d = self._dir(resolution)
+        try:
+            names = sorted(n for n in os.listdir(d)
+                           if n.startswith('seg-') and n.endswith('.tsdb'))
+        except OSError:
+            return []
+        return [os.path.join(d, n) for n in names]
+
+    def _current_segment(self, resolution: int, now: float) -> str:
+        """The segment file new chunks append to: rotate on a fixed
+        wall cadence so retention can reclaim whole files."""
+        bucket = int(now // self.segment_seconds * self.segment_seconds)
+        d = self._dir(resolution)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f'seg-{bucket}.tsdb')
+
+    # -- ingest --------------------------------------------------------
+
+    def ingest(self, name: str, labels: Dict[str, str], value: float,
+               ts: Optional[float] = None, kind: str = KIND_GAUGE) -> None:
+        """Append one observation. Counter values are reset-adjusted
+        (see module docstring); non-finite values are dropped."""
+        if not isinstance(value, (int, float)) or value != value or \
+                value in (float('inf'), float('-inf')):
+            return
+        if ts is None:
+            ts = self._clock()
+        key = series_key(name, labels)
+        with self._lock:
+            if kind == KIND_COUNTER:
+                value = self._adjust_counter(key, float(value))
+            self._append(key, kind, RES_RAW, ts, float(value))
+            self._feed_rollup(key, kind, ts, float(value))
+
+    def _adjust_counter(self, key: str, value: float) -> float:
+        state = self._counter_state.get(key)
+        if state is None:
+            # First sight since (re)start: seed from the persisted tail
+            # so a scraper restart doesn't itself read as a reset (and
+            # a raw value BELOW the tail folds into an offset below).
+            tail = self._tail_value(key)
+            state = (tail if tail is not None else 0.0, 0.0)
+        last, offset = state
+        adjusted = value + offset
+        if adjusted < last:
+            # The exporter restarted (raw value fell): fold the
+            # previous peak into the offset — the stored series stays
+            # monotone and rate() reads a discontinuity, not a
+            # negative spike.
+            offset = last
+            adjusted = value + offset
+        self._counter_state[key] = (adjusted, offset)
+        return adjusted
+
+    def _tail_value(self, key: str) -> Optional[float]:
+        best_ts = None
+        best_val = None
+        for chunk in self._iter_chunks(RES_RAW):
+            if chunk.key != key:
+                continue
+            if best_ts is None or chunk.end_ms >= best_ts:
+                samples = chunk.samples()
+                if samples:
+                    best_ts = samples[-1][0]
+                    best_val = samples[-1][1]
+        for entry_key, _kind, resolution, samples in \
+                self._iter_head_entries():
+            if resolution != RES_RAW or entry_key != key or not samples:
+                continue
+            ts, value = samples[-1]
+            if best_ts is None or ts >= best_ts:
+                best_ts, best_val = ts, value
+        return best_val
+
+    def _append(self, key: str, kind: str, resolution: int, ts: float,
+                value: float) -> None:
+        head = self._heads.get((key, resolution))
+        if head is None:
+            head = self._heads[(key, resolution)] = _Head(kind)
+        ts_ms = int(round(ts * 1000.0))
+        if head.samples and ts_ms <= head.samples[-1][0]:
+            self.dropped_out_of_order += 1
+            return
+        head.samples.append((ts_ms, value))
+        if len(head.samples) >= self.chunk_samples:
+            self._seal(key, resolution, head)
+
+    def _seal(self, key: str, resolution: int, head: _Head) -> None:
+        if not head.samples:
+            return
+        self._sealed.append(Chunk(
+            key, head.kind, resolution, head.samples[0][0],
+            head.samples[-1][0], len(head.samples),
+            encode_chunk(head.samples)))
+        head.samples = []
+
+    def _feed_rollup(self, key: str, kind: str, ts: float,
+                     value: float) -> None:
+        bucket_start = ts // self.rollup_bucket_s * self.rollup_bucket_s
+        bucket = self._rollups.get(key)
+        self._rollup_kind[key] = kind
+        if bucket is not None and bucket_start > bucket.start:
+            self._emit_rollup(key, bucket)
+            bucket = None
+        if bucket is None:
+            bucket = self._rollups[key] = _RollupBucket(bucket_start)
+        bucket.total += value
+        bucket.count += 1
+        bucket.maximum = max(bucket.maximum, value)
+
+    def _emit_rollup(self, key: str, bucket: _RollupBucket) -> None:
+        if bucket.count == 0:
+            return
+        kind = self._rollup_kind.get(key, KIND_GAUGE)
+        # Rollup points are stamped at the bucket END (the moment the
+        # aggregate became final).
+        ts = bucket.start + self.rollup_bucket_s
+        self._append(key, kind, RES_ROLLUP_MEAN, ts,
+                     bucket.total / bucket.count)
+        self._append(key, kind, RES_ROLLUP_MAX, ts, bucket.maximum)
+
+    # -- durability ----------------------------------------------------
+
+    def _counter_state_path(self) -> str:
+        return os.path.join(self.root, 'counters.json')
+
+    def _load_counter_state(self) -> None:
+        try:
+            with open(self._counter_state_path(),
+                      encoding='utf-8') as f:
+                raw = json.load(f)
+            self._counter_state = {
+                key: (float(pair[0]), float(pair[1]))
+                for key, pair in raw.items()}
+        except (OSError, ValueError, TypeError, IndexError):
+            self._counter_state = {}
+
+    def _save_counter_state(self) -> None:
+        """Best-effort: a crash inside the flush window can lose up to
+        one window of offset updates (a reset in that gap reads as a
+        bounded dip on restart); a clean close() always saves."""
+        if not self._counter_state:
+            return
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            tmp = self._counter_state_path() + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump({k: list(v)
+                           for k, v in self._counter_state.items()}, f)
+            os.replace(tmp, self._counter_state_path())
+        except OSError as e:
+            logger.debug('counter-state save failed: %s', e)
+
+    def _heads_file(self) -> str:
+        return os.path.join(self.root, f'heads-{os.getpid()}.json')
+
+    def _write_heads_snapshot(self) -> None:
+        """Durability for not-yet-sealed head samples WITHOUT sealing
+        them: sealing on every forced flush would emit 1-4-sample
+        chunks whose frame overhead defeats the Gorilla compression
+        entirely. The snapshot is a small overwritable sidecar
+        (atomic-replace) that readers merge with the segment chunks;
+        close() seals for real and removes it. Duplicate samples
+        (snapshot taken before a later seal) merge away on read — the
+        (series, ts) dict keeps one value."""
+        entries = [[key, head.kind, resolution, head.samples]
+                   for (key, resolution), head in self._heads.items()
+                   if head.samples]
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            tmp = self._heads_file() + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump({'series': entries}, f)
+            os.replace(tmp, self._heads_file())
+        except OSError as e:
+            logger.debug('heads snapshot failed: %s', e)
+
+    def _iter_head_entries(self) -> list:
+        """Entries ``[key, kind, resolution, [[ts_ms, v], ...]]`` from
+        every heads sidecar in the root (all writers', own included —
+        a fresh same-pid opener must see its predecessor's data)."""
+        try:
+            names = [n for n in os.listdir(self.root)
+                     if n.startswith('heads-') and n.endswith('.json')]
+        except OSError:
+            return []
+        out: list = []
+        for name in names:
+            path = os.path.join(self.root, name)
+            try:
+                stat = os.stat(path)
+            except OSError:
+                continue
+            fingerprint = (stat.st_mtime, stat.st_size)
+            cached = self._heads_cache.get(path)
+            if cached is None or cached[0] != fingerprint:
+                try:
+                    with open(path, encoding='utf-8') as f:
+                        entries = json.load(f).get('series', [])
+                except (OSError, ValueError):
+                    entries = []
+                cached = (fingerprint, entries)
+                self._heads_cache[path] = cached
+            out.extend(cached[1])
+        return out
+
+    def flush(self, force: bool = False) -> int:
+        """Persist sealed chunks; ``force=True`` additionally snapshots
+        the open heads + counter state so other processes (and a
+        restart) see data up to now. Returns chunks written."""
+        with self._lock:
+            if force:
+                self._write_heads_snapshot()
+                self._save_counter_state()
+            sealed, self._sealed = self._sealed, []
+            if not sealed:
+                return 0
+            now = self._clock()
+            by_seg: Dict[int, List[Chunk]] = {}
+            for chunk in sealed:
+                # Mean and max rollups share the rollup segment set.
+                seg_res = RES_RAW if chunk.resolution == RES_RAW else \
+                    RES_ROLLUP_MEAN
+                by_seg.setdefault(seg_res, []).append(chunk)
+            for seg_res, chunks in by_seg.items():
+                path = self._current_segment(seg_res, now)
+                # flock'd append (same stance as trace_store): two
+                # API-server replicas sharing a state dir must not
+                # interleave buffered writes mid-frame or both write
+                # the header — either would silently truncate every
+                # read past the corruption point.
+                import fcntl
+                with open(path, 'ab') as f:
+                    fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+                    try:
+                        if os.fstat(f.fileno()).st_size == 0:
+                            f.write(_MAGIC)
+                        for chunk in chunks:
+                            f.write(_frame(chunk))
+                        f.flush()
+                    finally:
+                        fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            return len(sealed)
+
+    def enforce_retention(self, now: Optional[float] = None) -> int:
+        """Delete whole segment files past their tier's retention
+        (raw first — their data lives on in the rollups). Returns the
+        number of files removed."""
+        if now is None:
+            now = self._clock()
+        removed = 0
+        for resolution, retention in ((RES_RAW, self.raw_retention_s),
+                                      (RES_ROLLUP_MEAN,
+                                       self.rollup_retention_s)):
+            for path in self._segments(resolution):
+                try:
+                    if os.path.getmtime(path) < now - retention:
+                        os.remove(path)
+                        self._segment_cache.pop(path, None)
+                        removed += 1
+                except OSError:
+                    continue
+        # Dead writers' heads sidecars (a live writer rewrites its own
+        # every forced flush) age out on the raw tier's clock.
+        try:
+            for name in os.listdir(self.root):
+                if not (name.startswith('heads-') and
+                        name.endswith('.json')):
+                    continue
+                path = os.path.join(self.root, name)
+                try:
+                    if os.path.getmtime(path) < now - \
+                            self.raw_retention_s:
+                        os.remove(path)
+                        self._heads_cache.pop(path, None)
+                        removed += 1
+                except OSError:
+                    continue
+        except OSError:
+            pass
+        return removed
+
+    def close(self) -> None:
+        with self._lock:
+            # Drain open rollup buckets: the final partial bucket of
+            # every series would otherwise never reach the rollup tier
+            # and leave a permanent gap once raw retention reclaims the
+            # window. (Partial-at-close is approximate by design; a
+            # restarted writer re-emitting the same bucket end is
+            # dropped as out-of-order, keeping the first emission.)
+            for key, bucket in list(self._rollups.items()):
+                self._emit_rollup(key, bucket)
+            self._rollups.clear()
+            # The real seal: heads become proper compressed chunks and
+            # the sidecar snapshot is retired.
+            for (key, resolution), head in list(self._heads.items()):
+                self._seal(key, resolution, head)
+            self._save_counter_state()
+        self.flush()
+        try:
+            os.remove(self._heads_file())
+        except OSError:
+            pass
+
+    # -- read path -----------------------------------------------------
+
+    def _iter_chunks(self, resolution: int) -> Iterable[Chunk]:
+        """Persisted chunks of one resolution tier (mean and max rollup
+        chunks are distinguished by their record flag)."""
+        seg_res = RES_RAW if resolution == RES_RAW else RES_ROLLUP_MEAN
+        for path in self._segments(seg_res):
+            try:
+                stat = os.stat(path)
+            except OSError:
+                continue
+            cached = self._segment_cache.get(path)
+            if cached is None or cached[0] != stat.st_mtime or \
+                    cached[1] != stat.st_size:
+                cached = (stat.st_mtime, stat.st_size,
+                          _scan_segment(path))
+                self._segment_cache[path] = cached
+            for chunk in cached[2]:
+                if chunk.resolution == resolution:
+                    yield chunk
+
+    def _match(self, chunk_key: str, name: str,
+               labels: Optional[Dict[str, str]]) -> Optional[str]:
+        try:
+            chunk_name, chunk_labels = parse_key(chunk_key)
+        except (ValueError, TypeError):
+            return None
+        if chunk_name != name:
+            return None
+        if labels:
+            for k, v in labels.items():
+                if chunk_labels.get(k) != v:
+                    return None
+        return chunk_key
+
+    def query_range(self, name: str, start: float, end: float,
+                    labels: Optional[Dict[str, str]] = None,
+                    agg: str = 'mean') -> List[Series]:
+        """Every series matching ``name`` (+ label subset) with its
+        points in ``[start, end]``. Raw points are preferred; where raw
+        has been reclaimed by retention, rollup points (``agg`` =
+        ``mean`` or ``max``) fill the older part of the window."""
+        # Floor/ceil the bounds: ingest ROUNDS to ms, so truncating the
+        # end bound would (half the time) exclude a sample taken in the
+        # same millisecond as the query — read-after-write must see it.
+        start_ms = math.floor(start * 1000.0)
+        end_ms = math.ceil(end * 1000.0)
+        rollup_res = RES_ROLLUP_MAX if agg == 'max' else RES_ROLLUP_MEAN
+        with self._lock:
+            raw = self._collect_points(name, labels, RES_RAW,
+                                       start_ms, end_ms)
+            rollup = self._collect_points(name, labels, rollup_res,
+                                          start_ms, end_ms)
+        out: List[Series] = []
+        for key in sorted(set(raw) | set(rollup)):
+            raw_pts = raw.get(key, [])
+            pts = list(raw_pts)
+            if key in rollup:
+                # Rollups only fill where raw is missing (older than
+                # the oldest raw point) — never double-report a window.
+                raw_floor = raw_pts[0][0] if raw_pts else float('inf')
+                pts = [p for p in rollup[key] if p[0] < raw_floor] + pts
+            series_name, series_labels = parse_key(key)
+            out.append(Series(series_name, series_labels,
+                              [(ts / 1000.0, v) for ts, v in pts]))
+        return out
+
+    def _collect_points(self, name: str,
+                        labels: Optional[Dict[str, str]],
+                        resolution: int, start_ms: int, end_ms: int
+                        ) -> Dict[str, List[Tuple[int, float]]]:
+        merged: Dict[str, Dict[int, float]] = {}
+        for chunk in self._iter_chunks(resolution):
+            if chunk.end_ms < start_ms or chunk.start_ms > end_ms:
+                continue
+            if self._match(chunk.key, name, labels) is None:
+                continue
+            bucket = merged.setdefault(chunk.key, {})
+            for ts, v in chunk.samples():
+                if start_ms <= ts <= end_ms:
+                    bucket[ts] = v
+        # In-memory (unflushed) data is part of the readable window for
+        # the owning process...
+        for (key, head_res), head in self._heads.items():
+            if head_res != resolution:
+                continue
+            if self._match(key, name, labels) is None:
+                continue
+            bucket = merged.setdefault(key, {})
+            for ts, v in head.samples:
+                if start_ms <= ts <= end_ms:
+                    bucket[ts] = v
+        # ...and writers' snapshot sidecars cover it for everyone else
+        # (duplicates against segments/own heads merge away by ts).
+        for key, _kind, head_res, samples in self._iter_head_entries():
+            if head_res != resolution:
+                continue
+            if self._match(key, name, labels) is None:
+                continue
+            bucket = merged.setdefault(key, {})
+            for ts, v in samples:
+                ts = int(ts)
+                if start_ms <= ts <= end_ms:
+                    bucket[ts] = v
+        for chunk in self._sealed:
+            if chunk.resolution != resolution:
+                continue
+            if self._match(chunk.key, name, labels) is None:
+                continue
+            bucket = merged.setdefault(chunk.key, {})
+            for ts, v in chunk.samples():
+                if start_ms <= ts <= end_ms:
+                    bucket[ts] = v
+        return {key: sorted(points.items())
+                for key, points in merged.items()}
+
+    def latest(self, name: str,
+               labels: Optional[Dict[str, str]] = None,
+               max_age_s: Optional[float] = None) -> List[Series]:
+        """The most recent point of each matching series (hydration
+        seeds). ``max_age_s`` drops series whose last sample is older
+        (dead targets)."""
+        now = self._clock()
+        start = now - (max_age_s if max_age_s is not None
+                       else self.raw_retention_s)
+        out: List[Series] = []
+        for series in self.query_range(name, start, now, labels):
+            if series.points:
+                out.append(Series(series.name, series.labels,
+                                  [series.points[-1]]))
+        return out
+
+    def latest_all(self, max_age_s: float) -> List[Series]:
+        """The most recent point of EVERY live series in one index walk
+        (the federate surface — a per-name latest() loop would re-walk
+        the whole chunk index once per metric name)."""
+        now = self._clock()
+        start_ms = math.floor((now - max_age_s) * 1000.0)
+        end_ms = math.ceil(now * 1000.0)
+        best: Dict[str, Tuple[int, float]] = {}
+
+        def consider(key: str, ts: int, value: float) -> None:
+            if start_ms <= ts <= end_ms:
+                held = best.get(key)
+                if held is None or ts >= held[0]:
+                    best[key] = (ts, value)
+
+        with self._lock:
+            for chunk in self._iter_chunks(RES_RAW):
+                if chunk.end_ms < start_ms:
+                    continue
+                for ts, value in chunk.samples():
+                    consider(chunk.key, ts, value)
+            for chunk in self._sealed:
+                if chunk.resolution != RES_RAW:
+                    continue
+                for ts, value in chunk.samples():
+                    consider(chunk.key, ts, value)
+            for (key, resolution), head in self._heads.items():
+                if resolution != RES_RAW:
+                    continue
+                for ts, value in head.samples:
+                    consider(key, ts, value)
+            for key, _kind, resolution, samples in \
+                    self._iter_head_entries():
+                if resolution != RES_RAW:
+                    continue
+                for ts, value in samples:
+                    consider(key, int(ts), value)
+        out: List[Series] = []
+        for key in sorted(best):
+            try:
+                name, labels = parse_key(key)
+            except (ValueError, TypeError):
+                continue
+            ts, value = best[key]
+            out.append(Series(name, labels, [(ts / 1000.0, value)]))
+        return out
+
+    def series_names(self) -> List[str]:
+        """Every distinct metric name with any stored data."""
+        names = set()
+        with self._lock:
+            for chunk in self._iter_chunks(RES_RAW):
+                try:
+                    names.add(parse_key(chunk.key)[0])
+                except (ValueError, TypeError):
+                    continue
+            for chunk in self._iter_chunks(RES_ROLLUP_MEAN):
+                try:
+                    names.add(parse_key(chunk.key)[0])
+                except (ValueError, TypeError):
+                    continue
+            for (key, _), head in self._heads.items():
+                if head.samples:
+                    try:
+                        names.add(parse_key(key)[0])
+                    except (ValueError, TypeError):
+                        continue
+            for key, _kind, _res, samples in self._iter_head_entries():
+                if samples:
+                    try:
+                        names.add(parse_key(key)[0])
+                    except (ValueError, TypeError):
+                        continue
+            for chunk in self._sealed:
+                try:
+                    names.add(parse_key(chunk.key)[0])
+                except (ValueError, TypeError):
+                    continue
+        return sorted(names)

@@ -14,7 +14,8 @@ def emit_exemplar(seconds, trace_id, name):
     # 'exemplar' (the OpenMetrics trace attachment) and 'amount' are
     # NOT labels — the label-set check must skip them.
     metrics.REQUEST_EXEC_SECONDS.observe(
-        seconds, exemplar=trace_id, name=name, status='SUCCEEDED')
+        seconds, exemplar=trace_id, name=name, status='SUCCEEDED',
+        workspace='default')
     metrics.LB_TTFB.observe(seconds, exemplar=trace_id)
     metrics.LB_POOL_REUSE.inc(amount=2)
 

@@ -10,6 +10,10 @@ def emit_drifted(outcome):
     metrics.LB_REQUESTS.inc(result=outcome)
     # Missing label: TRANSFER_OBJECTS declares (direction, outcome).
     metrics.TRANSFER_OBJECTS.inc(direction='up')
+    # Missing per-tenant label: REQUESTS_TOTAL declares
+    # (name, status, workspace) — dropping workspace forks the series
+    # the telemetry plane's recording rules aggregate by.
+    metrics.REQUESTS_TOTAL.inc(name='launch', status='SUCCEEDED')
 
 
 def emit_dynamic(stat):

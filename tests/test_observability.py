@@ -179,9 +179,12 @@ def test_metrics_endpoint_shows_provision_p50(monkeypatch):
         # provision latency histogram present with >=1 sample
         assert (f'skyt_provision_seconds_count{{cloud="fake",'
                 f'server_id="{sid}"}} 1') in text
-        # request counter reflects the launch payload
+        # request counter reflects the launch payload, with the
+        # per-tenant workspace label (telemetry recording rules key
+        # on it)
         assert (f'skyt_requests_total{{name="launch",'
-                f'server_id="{sid}",status="SUCCEEDED"}}') in text
+                f'server_id="{sid}",status="SUCCEEDED",'
+                f'workspace="default"}}') in text
         # queue gauges render for both queues
         assert 'skyt_request_queue_depth{queue="LONG"' in text
         # ... plus the build-info gauge.

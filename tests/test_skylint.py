@@ -109,6 +109,7 @@ def test_skyt003_flags_type_and_label_drift():
     assert 'kind:QUEUE_DEPTH:inc' in found
     assert 'labels:LB_REQUESTS:result' in found
     assert 'labels:TRANSFER_OBJECTS:direction' in found
+    assert 'labels:REQUESTS_TOTAL:name,status' in found
     assert 'dynamic:skyt_rogue_' in found
 
 

@@ -1,0 +1,898 @@
+"""Fleet telemetry plane: TSDB codec/rollups/counter-resets, scrape
+federation, per-workspace recording rules, SLO burn-rate alerting, and
+forecaster hydration (ISSUE 14; docs/observability.md)."""
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.server import metrics, requests_db, telemetry
+from skypilot_tpu.server.app import ApiServer
+from skypilot_tpu.utils import events, tsdb
+from tests.fault_injection import inject_faults
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_home):
+    requests_db.reset_db_for_tests()
+    metrics.reset_for_tests()
+    events.reset_for_tests()
+    yield
+    requests_db.reset_db_for_tests()
+    metrics.reset_for_tests()
+    events.reset_for_tests()
+
+
+# -- codec --------------------------------------------------------------
+
+
+def test_chunk_codec_roundtrips_exactly():
+    rng = random.Random(7)
+    ts = 1_700_000_000_000
+    value = 10.0
+    samples = []
+    for _ in range(500):
+        ts += rng.choice([2000, 2000, 2000, 1999, 2003, 60000])
+        roll = rng.random()
+        if roll < 0.2:
+            value += rng.uniform(-1e6, 1e6)
+        elif roll < 0.6:
+            value += rng.uniform(-0.1, 0.1)
+        samples.append((ts, value))
+    assert tsdb.decode_chunk(tsdb.encode_chunk(samples),
+                             len(samples)) == samples
+
+
+def test_chunk_codec_edge_shapes():
+    for samples in (
+            [(1000, 1.5)],
+            [(0, 0.0), (1, 0.0), (2, 0.0)],
+            [(10, -1e300), (20, 1e-300), (30, float(2 ** 52))],
+            [(5, 3.25), (1_000_000_005, -3.25)],
+    ):
+        assert tsdb.decode_chunk(tsdb.encode_chunk(samples),
+                                 len(samples)) == samples
+
+
+def test_chunk_codec_compresses_steady_series():
+    """The whole point of Gorilla: a steady scrape cadence with a flat
+    gauge costs well under a byte per sample."""
+    samples = [(1_700_000_000_000 + i * 2000, 42.0) for i in range(240)]
+    assert len(tsdb.encode_chunk(samples)) < 240  # < 1 byte/sample
+
+
+# -- store: ingest / flush / restart ------------------------------------
+
+
+def _store(tmp_path, **kwargs):
+    now = [1_700_000_000.0]
+    kwargs.setdefault('clock', lambda: now[0])
+    db = tsdb.TSDB(str(tmp_path / 'tsdb'), **kwargs)
+    return db, now
+
+
+def test_store_survives_restart_and_torn_tail(tmp_path):
+    # Small chunks so sealed segments exist alongside the heads
+    # snapshot (the torn-tail poison targets a segment file).
+    db, now = _store(tmp_path, chunk_samples=8)
+    for i in range(20):
+        db.ingest('m', {'k': 'v'}, float(i), ts=now[0] + i)
+    db.flush(force=True)
+    # Torn trailing record (crash mid-append) must not poison reads.
+    seg = db._segments(tsdb.RES_RAW)[0]
+    with open(seg, 'ab') as f:
+        f.write(b'C\x01garbage')
+    db2 = tsdb.TSDB(str(tmp_path / 'tsdb'),
+                    clock=lambda: now[0] + 100)
+    points = db2.query_range('m', 0, now[0] + 50)[0].points
+    assert [v for _, v in points] == [float(i) for i in range(20)]
+
+
+def test_counter_reset_reads_as_discontinuity_not_negative_spike(
+        tmp_path):
+    """A scraped counter dropping (exporter restart) must fold into a
+    monotone adjusted series — increase() over the window stays
+    correct, never negative."""
+    db, now = _store(tmp_path)
+    for v in (0.0, 10.0, 25.0):
+        db.ingest('c_total', {}, v, ts=now[0], kind='counter')
+        now[0] += 10
+    # Reset: the process restarted and counts from 3.
+    db.ingest('c_total', {}, 3.0, ts=now[0], kind='counter')
+    now[0] += 10
+    db.ingest('c_total', {}, 7.0, ts=now[0], kind='counter')
+    points = db.query_range('c_total', 0, now[0] + 1)[0].points
+    values = [v for _, v in points]
+    assert values == sorted(values), 'adjusted series must be monotone'
+    # Total increase = 25 (pre-reset) + 7 (post-reset).
+    assert values[-1] == 25.0 + 7.0
+    assert all(b >= a for a, b in zip(values, values[1:]))
+
+
+def test_counter_reset_detected_across_store_restart(tmp_path):
+    """The scraper itself restarting loses in-memory offset state: the
+    first post-restart ingest must seed from the persisted tail, so a
+    LOWER raw value still reads as a reset."""
+    db, now = _store(tmp_path)
+    for v in (5.0, 50.0):
+        db.ingest('c_total', {}, v, ts=now[0], kind='counter')
+        now[0] += 10
+    db.flush(force=True)
+    db2 = tsdb.TSDB(str(tmp_path / 'tsdb'), clock=lambda: now[0])
+    db2.ingest('c_total', {}, 2.0, ts=now[0], kind='counter')
+    points = db2.query_range('c_total', 0, now[0] + 1)[0].points
+    assert points[-1][1] == 50.0 + 2.0
+
+
+def test_counter_offset_survives_scraper_restart_after_reset(tmp_path):
+    """The reset offset is persisted (counters.json): a scraper
+    restart AFTER an exporter reset must not misread the continuing
+    (lower) raw values as another reset and double-count."""
+    db, now = _store(tmp_path)
+    for v in (50.0, 40.0):       # reset: 50 -> 40, offset becomes 50
+        db.ingest('c_total', {}, v, ts=now[0], kind='counter')
+        now[0] += 10
+    db.close()                    # adjusted tail = 90, offset = 50
+    db2 = tsdb.TSDB(str(tmp_path / 'tsdb'), clock=lambda: now[0])
+    db2.ingest('c_total', {}, 41.0, ts=now[0], kind='counter')
+    points = db2.query_range('c_total', 0, now[0] + 1)[0].points
+    assert points[-1][1] == 91.0   # NOT 131 (offset seeded from disk)
+
+
+def test_close_drains_partial_rollup_bucket(tmp_path):
+    """The final open bucket of a series must reach the rollup tier on
+    close — otherwise every shutdown leaves a permanent gap once raw
+    retention reclaims the window."""
+    db, now = _store(tmp_path, rollup_bucket_s=60.0)
+    base = now[0] - (now[0] % 60.0)
+    db.ingest('g', {}, 4.0, ts=base + 10)
+    db.ingest('g', {}, 8.0, ts=base + 20)
+    db.close()
+    db2 = tsdb.TSDB(str(tmp_path / 'tsdb'), clock=lambda: now[0])
+    rollup = db2._collect_points('g', None, tsdb.RES_ROLLUP_MEAN,
+                                 0, int((base + 120) * 1000))
+    (_, samples), = rollup.items()
+    assert [v for _, v in samples] == [6.0]
+
+
+def test_rollup_math_mean_and_max(tmp_path):
+    """Raw -> 5-min-style rollup downsampling: each bucket's mean and
+    max must be exact."""
+    db, now = _store(tmp_path, rollup_bucket_s=60.0)
+    base = now[0] - (now[0] % 60.0)   # align to a bucket edge
+    # Bucket 1: 10, 20, 30 -> mean 20, max 30. Bucket 2: 5 -> 5/5.
+    for offset, v in ((0, 10.0), (20, 20.0), (40, 30.0), (70, 5.0)):
+        db.ingest('g', {'s': 'x'}, v, ts=base + offset)
+    # A sample in bucket 3 finalizes bucket 2.
+    db.ingest('g', {'s': 'x'}, 1.0, ts=base + 130)
+    mean = {ts: v for ts, v in db.query_range(
+        'g', 0, base + 200, agg='mean')[0].points}
+    # Rollup points are hidden while raw covers the window; read the
+    # rollup tier directly.
+    mean_pts = db._collect_points('g', None, tsdb.RES_ROLLUP_MEAN,
+                                  0, int((base + 200) * 1000))
+    max_pts = db._collect_points('g', None, tsdb.RES_ROLLUP_MAX,
+                                 0, int((base + 200) * 1000))
+    (key, mean_samples), = mean_pts.items()
+    (_, max_samples), = max_pts.items()
+    assert [v for _, v in mean_samples] == [20.0, 5.0]
+    assert [v for _, v in max_samples] == [30.0, 5.0]
+    # Bucket timestamps are the bucket END, in ms.
+    assert mean_samples[0][0] == int((base + 60) * 1000)
+    assert mean  # raw still serves the recent window
+
+
+def test_query_stitches_rollups_where_raw_was_reclaimed(tmp_path):
+    """After raw retention deletes old segments, a range query over the
+    full window returns rollup points for the old part and raw for the
+    recent part."""
+    db, now = _store(tmp_path, raw_retention_s=100.0,
+                     rollup_bucket_s=60.0, segment_seconds=60.0,
+                     chunk_samples=5)
+    t0 = now[0]
+    for i in range(30):
+        db.ingest('g', {}, float(i), ts=now[0])
+        now[0] += 20
+        db.flush(force=True)
+    # Age the early segments past raw retention.
+    old = now[0] - 150
+    for seg in db._segments(tsdb.RES_RAW):
+        os.utime(seg, (old, old))
+    removed = db.enforce_retention()
+    assert removed > 0
+    series = db.query_range('g', t0 - 60, now[0])
+    assert series, 'rollups must keep serving the reclaimed window'
+    points = series[0].points
+    assert len(points) > 3
+    # Values stay ordered (rollup means of an increasing series).
+    values = [v for _, v in points]
+    assert values == sorted(values)
+
+
+# -- exposition parsing -------------------------------------------------
+
+
+def test_parse_exposition_labels_types_and_exemplars():
+    text = '\n'.join([
+        '# HELP skyt_x help text',
+        '# TYPE skyt_x_total counter',
+        'skyt_x_total{a="1",b="with,comma"} 5',
+        '# TYPE skyt_h histogram',
+        'skyt_h_bucket{le="+Inf"} 3 # {trace_id="abc"} 1.0 169',
+        'skyt_h_sum 4.5',
+        'skyt_gauge 2 1699999999000',
+        'garbage line without value',
+    ])
+    samples, types = telemetry.parse_exposition(text)
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name['skyt_x_total'] == [({'a': '1', 'b': 'with,comma'},
+                                        5.0)]
+    assert by_name['skyt_h_bucket'] == [({'le': '+Inf'}, 3.0)]
+    assert by_name['skyt_gauge'] == [({}, 2.0)]
+    assert telemetry.sample_kind('skyt_x_total', types) == 'counter'
+    assert telemetry.sample_kind('skyt_h_bucket', types) == 'counter'
+    assert telemetry.sample_kind('skyt_h_sum', types) == 'counter'
+    assert telemetry.sample_kind('skyt_gauge', types) == 'gauge'
+    assert telemetry.sample_kind('untyped_total', {}) == 'counter'
+
+
+def test_parse_exposition_quoted_hash_and_brace_in_label_values():
+    """' # ' and '}' inside a quoted label value must not truncate the
+    sample (the exemplar-strip and close-brace scans are quote-aware)."""
+    samples, _ = telemetry.parse_exposition(
+        'skyt_x{msg="phase # 2",shape="a}b"} 7\n')
+    assert samples == [('skyt_x', {'msg': 'phase # 2', 'shape': 'a}b'},
+                        7.0)]
+
+
+def test_federate_full_precision_and_label_escaping(tmp_path):
+    """Large counters keep full precision on /federate (%g's 6
+    significant digits would corrupt them) and label values re-escape
+    quotes/backslashes so one odd series can't break the scrape."""
+    plane = telemetry.TelemetryPlane(server_id='t',
+                                     root=str(tmp_path / 'tele'))
+    plane.store.ingest('big_total', {'k': 'has"quote\\slash'},
+                       1234567.0, ts=time.time(), kind='counter')
+    text = plane.federate_text()
+    assert 'big_total{k="has\\"quote\\\\slash"} 1234567.0' in text
+    # Round-trips through our own parser.
+    samples, _ = telemetry.parse_exposition(text)
+    assert ('big_total', {'k': 'has"quote\\slash'}, 1234567.0) in samples
+    # Timestamp units per spec: v0 milliseconds, OpenMetrics SECONDS
+    # (ms there would date every sample ~year 56000).
+    v0_ts = int(text.split()[-1])
+    om = plane.federate_text(openmetrics=True)
+    om_ts = float(om.splitlines()[0].split()[-1])
+    assert om.rstrip().endswith('# EOF')
+    assert abs(om_ts - v0_ts / 1000.0) < 1.0
+    assert om_ts == pytest.approx(time.time(), abs=60)
+    plane.close()
+
+
+# -- cursor-paged collection (satellite) --------------------------------
+
+
+def test_terminal_cursor_walks_every_row_exactly_once():
+    ids = []
+    for i in range(5):
+        rid = requests_db.create(f'op{i}', {}, requests_db.ScheduleType.SHORT,
+                                 workspace='ws-a' if i % 2 else None)
+        requests_db.finalize(rid, requests_db.RequestStatus.SUCCEEDED)
+        ids.append(rid)
+    cursor = requests_db.TerminalCursor()
+    seen = []
+    while True:
+        page = cursor.page(limit=2)
+        if not page:
+            break
+        seen.extend(row['request_id'] for row in page)
+    assert sorted(seen) == sorted(ids)
+    # Caught up: further pages yield nothing (overlap rows dedupe).
+    assert cursor.page() == []
+
+
+def test_terminal_cursor_catches_out_of_timestamp_order_commits():
+    """finalize() stamps finished_at before taking the write lock, so
+    a stalled worker can commit an OLDER timestamp after a newer one
+    was already paged — the overlap re-read must still count it,
+    exactly once."""
+    rid_late = requests_db.create('late', {},
+                                  requests_db.ScheduleType.SHORT)
+    rid_fast = requests_db.create('fast', {},
+                                  requests_db.ScheduleType.SHORT)
+    conn = requests_db._db()
+    now = time.time()
+    # 'fast' commits with the NEWER stamp first...
+    conn.execute('UPDATE requests SET status = ?, finished_at = ? '
+                 'WHERE request_id = ?',
+                 ('SUCCEEDED', now, rid_fast))
+    conn.commit()
+    cursor = requests_db.TerminalCursor()
+    assert [r['request_id'] for r in cursor.page()] == [rid_fast]
+    # ...then 'late' lands with a stamp BEHIND the cursor (inside the
+    # overlap window).
+    conn.execute('UPDATE requests SET status = ?, finished_at = ? '
+                 'WHERE request_id = ?',
+                 ('SUCCEEDED', now - 2.0, rid_late))
+    conn.commit()
+    assert [r['request_id'] for r in cursor.page()] == [rid_late]
+    assert cursor.page() == []
+
+
+def test_collect_from_db_accumulates_with_workspace_label():
+    rid = requests_db.create('launch', {}, requests_db.ScheduleType.LONG,
+                             workspace='team-a')
+    requests_db.finalize(rid, requests_db.RequestStatus.SUCCEEDED)
+    metrics.collect_from_db()
+    metrics.collect_from_db()   # idempotent: cursor prevents recount
+    text = '\n'.join(metrics.REQUESTS_TOTAL.render())
+    assert ('skyt_requests_total{name="launch",status="SUCCEEDED",'
+            'workspace="team-a"} 1.0') in text
+    # In-flight rows live in the gauge, not the counter.
+    rid2 = requests_db.create('status', {},
+                              requests_db.ScheduleType.SHORT)
+    metrics.collect_from_db()
+    text = '\n'.join(metrics.REQUESTS_TOTAL.render())
+    assert 'status="PENDING"' not in text
+    flight = '\n'.join(metrics.REQUESTS_IN_FLIGHT.render())
+    assert 'skyt_requests_in_flight{status="PENDING"} 1' in flight
+    exec_text = '\n'.join(metrics.REQUEST_EXEC_SECONDS.render())
+    assert 'workspace="team-a"' in exec_text
+
+
+def test_pending_by_workspace():
+    requests_db.create('a', {}, requests_db.ScheduleType.SHORT,
+                       workspace='ws1')
+    requests_db.create('b', {}, requests_db.ScheduleType.SHORT,
+                       workspace='ws1')
+    requests_db.create('c', {}, requests_db.ScheduleType.SHORT)
+    assert requests_db.pending_by_workspace() == {'ws1': 2, 'default': 1}
+
+
+# -- recording rules ----------------------------------------------------
+
+
+def test_recording_rules_derive_per_workspace_series(tmp_path):
+    for workspace, n in (('team-a', 3), ('team-b', 1)):
+        for _ in range(n):
+            rid = requests_db.create('launch', {},
+                                     requests_db.ScheduleType.LONG,
+                                     workspace=workspace)
+            requests_db.finalize(rid,
+                                 requests_db.RequestStatus.SUCCEEDED)
+    requests_db.create('queued', {}, requests_db.ScheduleType.SHORT,
+                       workspace='team-a')
+    plane = telemetry.TelemetryPlane(server_id='t',
+                                     root=str(tmp_path / 'tele'))
+    plane.scrape_once()
+    now = time.time()
+    p99 = plane.store.query_range('workspace:request_exec_seconds:p99',
+                                  now - 60, now + 60)
+    workspaces = {s.labels['workspace'] for s in p99}
+    assert workspaces == {'team-a', 'team-b'}
+    depth = plane.store.query_range('workspace:request_queue_depth:sum',
+                                    now - 60, now + 60,
+                                    {'workspace': 'team-a'})
+    assert depth and depth[0].points[-1][1] == 1.0
+    # Backlog draining to zero RECORDS the zero (no phantom depth on
+    # the federate surface).
+    conn = requests_db._db()
+    conn.execute("UPDATE requests SET status = 'CANCELLED', "
+                 'finished_at = ? WHERE status = ?',
+                 (time.time(), 'PENDING'))
+    conn.commit()
+    plane.scrape_once()
+    depth = plane.store.query_range('workspace:request_queue_depth:sum',
+                                    now - 60, time.time() + 60,
+                                    {'workspace': 'team-a'})
+    assert depth[0].points[-1][1] == 0.0
+    plane.close()
+
+
+# -- scrape robustness (chaos) ------------------------------------------
+
+
+@pytest.mark.chaos
+def test_scrape_fault_only_costs_that_tick(tmp_path):
+    """An injected failure at the telemetry.scrape site (a hung or
+    dead target) must count an error outcome and leave later ticks
+    working."""
+    plane = telemetry.TelemetryPlane(server_id='t',
+                                     root=str(tmp_path / 'tele'))
+    with inject_faults('telemetry.scrape:ConnectionError:times=1'):
+        plane.scrape_once()
+        errors = metrics.TELEMETRY_SCRAPES._values.get(
+            (('outcome', 'error'), ('service', 'api-server')))
+        assert errors == 1.0
+        assert plane.scrape_once() > 0   # budget spent: scrapes work
+    ok = metrics.TELEMETRY_SCRAPES._values.get(
+        (('outcome', 'ok'), ('service', 'api-server')))
+    assert ok >= 1.0
+    plane.close()
+
+
+# -- SLO engine ---------------------------------------------------------
+
+
+def test_slo_spec_validation():
+    good = telemetry.SLOSpec({
+        'name': 's', 'objective': 0.99,
+        'indicator': {'type': 'availability', 'metric': 'm_total',
+                      'bad_labels': {'outcome': 'err'}}})
+    assert good.budget == pytest.approx(0.01)
+    assert good.fast == telemetry.DEFAULT_FAST
+    assert good.slow == telemetry.DEFAULT_SLOW
+    # window_seconds is meaningful: default thresholds re-derive from
+    # the configured budget window (7 d -> 14.4 * 7/30 etc.).
+    week = telemetry.SLOSpec({
+        'name': 'w', 'objective': 0.99,
+        'window_seconds': 7 * 86400.0,
+        'indicator': {'type': 'availability', 'metric': 'm_total',
+                      'bad_labels': {'outcome': 'err'}}})
+    assert week.fast[2] == pytest.approx(14.4 * 7 / 30)
+    assert week.slow[2] == pytest.approx(6.0 * 7 / 30)
+    with pytest.raises(ValueError):
+        telemetry.SLOSpec({'name': 'x', 'objective': 1.5,
+                           'indicator': {'metric': 'm'}})
+    with pytest.raises(ValueError):
+        telemetry.SLOSpec({'name': 'x', 'objective': 0.9,
+                           'indicator': {'type': 'availability',
+                                         'metric': 'm'}})
+    with pytest.raises(ValueError):
+        telemetry.SLOSpec({'name': 'x', 'objective': 0.9,
+                           'indicator': {'type': 'latency',
+                                         'metric': 'm'}})
+
+
+def test_burn_rate_math(tmp_path):
+    db, now = _store(tmp_path)
+    spec = telemetry.SLOSpec({
+        'name': 's', 'objective': 0.9, 'window_seconds': 3600,
+        'indicator': {'type': 'availability',
+                      'metric': 'req_total',
+                      'bad_labels': {'outcome': 'err'}}})
+    t = now[0]
+    # 100 total (80 ok + 20 err) over 100s -> error rate 0.2, budget
+    # 0.1 -> burn 2.0.
+    for i in range(11):
+        db.ingest('req_total', {'outcome': 'ok'}, 8.0 * i,
+                  ts=t + i * 10, kind='counter')
+        db.ingest('req_total', {'outcome': 'err'}, 2.0 * i,
+                  ts=t + i * 10, kind='counter')
+    now[0] = t + 100
+    assert telemetry.error_rate(db, spec, now[0], 100.0) == \
+        pytest.approx(0.2, abs=0.02)
+    assert telemetry.burn_rate(db, spec, now[0], 100.0) == \
+        pytest.approx(2.0, abs=0.2)
+    # No data in the window -> None, not 0 (an idle service must not
+    # look healthy-by-omission or alert-by-omission).
+    assert telemetry.burn_rate(db, spec, now[0] + 10_000, 50.0) is None
+
+
+def test_latency_slo_uses_histogram_buckets(tmp_path):
+    db, now = _store(tmp_path)
+    spec = telemetry.SLOSpec({
+        'name': 'lat', 'objective': 0.9,
+        'indicator': {'type': 'latency', 'metric': 'exec_seconds',
+                      'threshold_s': 5.0}})
+    t = now[0]
+    # 10 observations/step, 7 under 5s -> error rate 0.3.
+    for step in range(2):
+        scale = step + 1.0
+        ts = t + step * 30
+        db.ingest('exec_seconds_bucket', {'le': '1'}, 4.0 * scale,
+                  ts=ts, kind='counter')
+        db.ingest('exec_seconds_bucket', {'le': '5'}, 7.0 * scale,
+                  ts=ts, kind='counter')
+        db.ingest('exec_seconds_bucket', {'le': '+Inf'}, 10.0 * scale,
+                  ts=ts, kind='counter')
+    now[0] = t + 60
+    rate = telemetry.error_rate(db, spec, now[0], 60.0)
+    assert rate == pytest.approx(0.3, abs=0.05)
+
+
+def test_alert_state_machine_pending_firing_resolved(tmp_path):
+    db, now = _store(tmp_path)
+    spec = telemetry.SLOSpec({
+        'name': 'avail', 'objective': 0.9,
+        'fast_window_seconds': [30, 60], 'fast_burn': 1.0,
+        'slow_window_seconds': [30, 60], 'slow_burn': 1e9,
+        'for_seconds': 15,
+        'indicator': {'type': 'availability', 'metric': 'r_total',
+                      'bad_labels': {'outcome': 'err'}}})
+    manager = telemetry.AlertManager(
+        state_path=str(tmp_path / 'alerts.json'),
+        clock=lambda: now[0])
+    t = now[0]
+
+    def feed(ok, err, ts):
+        db.ingest('r_total', {'outcome': 'ok'}, ok, ts=ts,
+                  kind='counter')
+        db.ingest('r_total', {'outcome': 'err'}, err, ts=ts,
+                  kind='counter')
+
+    feed(10, 0, t)
+    now[0] = t + 10
+    assert manager.evaluate(db, [spec]) == []        # healthy
+    # Error burst: 50% errors -> burn 5x > 1x threshold.
+    feed(20, 10, now[0])
+    now[0] += 1
+    transitions = manager.evaluate(db, [spec])
+    assert [(x['from'], x['to']) for x in transitions] == \
+        [('inactive', 'pending')]
+    cursor_before = events.cursor(events.ALERTS)
+    # Still breached past for_seconds -> firing (+ ALERTS publish).
+    now[0] += 20
+    feed(21, 11, now[0])
+    transitions = manager.evaluate(db, [spec])
+    assert [(x['from'], x['to']) for x in transitions] == \
+        [('pending', 'firing')]
+    assert events.cursor(events.ALERTS) > cursor_before
+    assert manager.firing()
+    # Recovery: errors age out of both windows -> resolved.
+    now[0] += 70
+    feed(200, 11, now[0])
+    now[0] += 1
+    transitions = manager.evaluate(db, [spec])
+    assert [(x['from'], x['to']) for x in transitions] == \
+        [('firing', 'resolved')]
+    snapshot = manager.snapshot()
+    assert snapshot and snapshot[0]['state'] == 'resolved'
+    # Persisted table is readable by other processes.
+    persisted = telemetry.read_persisted_alerts(str(tmp_path))
+    assert persisted and persisted[0]['slo'] == 'avail'
+
+
+def test_pending_blip_inside_for_window_never_fires(tmp_path):
+    db, now = _store(tmp_path)
+    spec = telemetry.SLOSpec({
+        'name': 'avail', 'objective': 0.9,
+        'fast_window_seconds': [30, 60], 'fast_burn': 1.0,
+        'slow_window_seconds': [30, 60], 'slow_burn': 1e9,
+        'for_seconds': 60,
+        'indicator': {'type': 'availability', 'metric': 'r_total',
+                      'bad_labels': {'outcome': 'err'}}})
+    manager = telemetry.AlertManager(clock=lambda: now[0])
+    db.ingest('r_total', {'outcome': 'ok'}, 10, ts=now[0],
+              kind='counter')
+    db.ingest('r_total', {'outcome': 'err'}, 0, ts=now[0],
+              kind='counter')
+    db.ingest('r_total', {'outcome': 'ok'}, 10, ts=now[0] + 5,
+              kind='counter')
+    db.ingest('r_total', {'outcome': 'err'}, 5, ts=now[0] + 5,
+              kind='counter')
+    now[0] += 10
+    assert [(x['from'], x['to'])
+            for x in manager.evaluate(db, [spec])] == \
+        [('inactive', 'pending')]
+    # Healed before for_seconds: the pending alert just disappears.
+    now[0] += 65
+    db.ingest('r_total', {'outcome': 'ok'}, 100, ts=now[0],
+              kind='counter')
+    assert manager.evaluate(db, [spec]) == []
+    assert manager.snapshot() == []
+
+
+# -- end-to-end: LB chaos -> availability SLO lifecycle -----------------
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        body = b'ok'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def _start_replica():
+    server = ThreadingHTTPServer(('127.0.0.1', 0), _EchoHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+@pytest.mark.chaos
+def test_lb_error_burst_walks_availability_slo_end_to_end(
+        tmp_path, monkeypatch, tmp_home):
+    """The acceptance demo: a live LB is scraped by the federation
+    plane; an injected error burst at the LB forward site drives the
+    fast burn-rate alert pending -> firing inside its window, and
+    recovery resolves it."""
+    from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                                  start_load_balancer)
+    from skypilot_tpu.serve.load_balancing_policies import \
+        LoadBalancingPolicy
+    from skypilot_tpu.serve import serve_state
+    monkeypatch.setenv('SKYT_LB_EJECT_THRESHOLD', '1000')
+    config_path = tmp_home / '.skyt' / 'config.yaml'
+    config_path.parent.mkdir(parents=True, exist_ok=True)
+    config_path.write_text(json.dumps({'slos': [{
+        'name': 'lb-availability',
+        'objective': 0.9,
+        'window_seconds': 3600,
+        'fast_window_seconds': [1.0, 3.0],
+        'fast_burn': 1.0,
+        'slow_window_seconds': [1.0, 3.0],
+        'slow_burn': 1e9,
+        'for_seconds': 0.2,
+        'indicator': {
+            'type': 'availability',
+            'metric': 'skyt_lb_requests_total',
+            'bad_labels': {'outcome': 'upstream_error'},
+        },
+    }]}))
+    replica = _start_replica()
+    lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+    lb.sync_replicas(
+        [(1, f'http://127.0.0.1:{replica.server_address[1]}', 1.0)])
+    lb_server = start_load_balancer(lb, '127.0.0.1', 0)
+    serve_state.add_service('tsvc', {}, {}, lb_port=lb_server.port)
+    plane = telemetry.TelemetryPlane(server_id='t',
+                                     root=str(tmp_path / 'tele'))
+
+    def drive(n, expect_ok):
+        for i in range(n):
+            try:
+                with urllib.request.urlopen(
+                        f'http://127.0.0.1:{lb_server.port}/q{i}',
+                        timeout=10) as resp:
+                    assert resp.status == 200
+                assert expect_ok
+            except urllib.error.HTTPError as e:
+                assert not expect_ok and e.code == 502
+
+    states = []
+
+    def tick():
+        plane.scrape_once()
+        for t in plane.evaluate_slos():
+            states.append((t['severity'], t['from'], t['to']))
+
+    try:
+        drive(5, expect_ok=True)
+        tick()
+        # Error burst: every forward attempt fails (one replica, no
+        # failover target) -> outcome=upstream_error counts up.
+        with inject_faults(
+                'load_balancer.forward:ConnectionError:times=1000'):
+            deadline = time.monotonic() + 10
+            while ('page', 'pending', 'firing') not in states and \
+                    time.monotonic() < deadline:
+                drive(3, expect_ok=False)
+                tick()
+                time.sleep(0.15)
+        assert ('page', 'inactive', 'pending') in states
+        assert ('page', 'pending', 'firing') in states
+        assert plane.alerts.firing()
+        # Recovery: healthy traffic until the burst ages out of the
+        # 3 s long window.
+        deadline = time.monotonic() + 15
+        while ('page', 'firing', 'resolved') not in states and \
+                time.monotonic() < deadline:
+            drive(3, expect_ok=True)
+            tick()
+            time.sleep(0.2)
+        assert ('page', 'firing', 'resolved') in states
+        assert not plane.alerts.firing()
+    finally:
+        plane.close()
+        lb_server.shutdown()
+        replica.shutdown()
+
+
+# -- end-to-end: federation daemon + query surface + hydration ----------
+
+
+def test_federation_daemon_scrapes_live_server_and_lb(
+        tmp_home, monkeypatch):
+    """Acceptance: the supervised daemon inside the API server scrapes
+    the server's own surface AND a live LB over HTTP; a range query
+    over /api/metrics/query returns the stored series; /federate and
+    /api/alerts serve."""
+    from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                                  start_load_balancer)
+    from skypilot_tpu.serve.load_balancing_policies import \
+        LoadBalancingPolicy
+    from skypilot_tpu.serve import serve_state
+    monkeypatch.setenv('SKYT_TELEMETRY_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYT_TELEMETRY_JITTER', '0')
+    replica = _start_replica()
+    lb = LoadBalancer(LoadBalancingPolicy.make('least_load'))
+    lb.sync_replicas(
+        [(1, f'http://127.0.0.1:{replica.server_address[1]}', 1.0)])
+    lb_server = start_load_balancer(lb, '127.0.0.1', 0)
+    serve_state.add_service('fsvc', {}, {}, lb_port=lb_server.port)
+    # Fence the reaper daemon off our fake service: a live local pid
+    # is never judged dead.
+    serve_state.set_controller_pid('fsvc', os.getpid())
+    srv = ApiServer(port=0)
+    assert srv.telemetry is not None
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        for i in range(4):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_server.port}/r{i}',
+                    timeout=10) as resp:
+                assert resp.status == 200
+        from skypilot_tpu.client import sdk
+        rid = sdk.status()
+        sdk.get(rid, timeout=60)
+        # The daemon (0.2 s cadence) must land samples in the store.
+        def poll_series(name, labels):
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                payload = sdk.api_metrics_query(name, labels=labels)
+                series = payload['series']
+                if series and series[0]['points']:
+                    return series
+                time.sleep(0.2)
+            raise AssertionError(
+                f'federation daemon never stored {name} {labels}')
+
+        series = poll_series('skyt_lb_requests_total',
+                             {'service': 'fsvc', 'outcome': 'ok'})
+        assert series[0]['labels']['service'] == 'fsvc'
+        assert series[0]['labels']['instance'].endswith(
+            str(lb_server.port))
+        assert series[0]['points'][-1][1] >= 4.0
+        # The server's own surface federates too (with its identity).
+        poll_series('skyt_requests_total', {'service': 'api-server'})
+        fed = requests_lib.get(f'{srv.url}/api/metrics/federate',
+                               timeout=10)
+        assert fed.status_code == 200
+        assert 'skyt_lb_requests_total' in fed.text
+        assert 'service="fsvc"' in fed.text
+        alerts = requests_lib.get(f'{srv.url}/api/alerts', timeout=10)
+        assert alerts.status_code == 200
+        assert alerts.json()['alerts'] == []
+        health = requests_lib.get(f'{srv.url}/api/health',
+                                  timeout=10).json()
+        assert health['alerts_firing'] == []
+        assert any(d['name'] == 'telemetry' for d in health['daemons'])
+    finally:
+        srv.shutdown()
+        lb_server.shutdown()
+        replica.shutdown()
+        requests_db.reset_db_for_tests()
+
+
+def test_restarted_controller_hydrates_seasonal_ring(tmp_path,
+                                                     monkeypatch):
+    """Acceptance: a controller restart (scale-to-zero wake, crash
+    replacement) replays the stored QPS history — the seasonal ring
+    resumes non-empty and anticipates the learned pattern."""
+    from skypilot_tpu.serve import forecast
+    monkeypatch.setenv('SKYT_FORECAST_SEASONAL_PERIOD', '120')
+    monkeypatch.setenv('SKYT_FORECAST_SEASONAL_BUCKETS', '12')
+    root = str(tmp_path / 'tele')
+    plane = telemetry.TelemetryPlane(server_id='t', root=root)
+    now = time.time()
+    # Two 120 s periods of a square-wave pattern: high in the second
+    # half of each period.
+    for age in range(240, 0, -10):
+        ts = now - age
+        phase = (ts % 120.0) / 120.0
+        qps = 50.0 if phase >= 0.5 else 2.0
+        plane.store.ingest('skyt_autoscale_observed_qps',
+                           {'service': 'svc', 'instance': 'i'},
+                           qps, ts=ts)
+    plane.store.ingest('skyt_autoscale_fleet_p99_ms',
+                       {'service': 'svc', 'instance': 'i'},
+                       87.5, ts=now - 5)
+    plane.store.flush(force=True)
+    plane.close()
+
+    class _FreshController:
+        """The forecaster-bearing shape hydrate_autoscaler targets."""
+        forecaster = forecast.make_forecaster('seasonal')
+        _snapshot: dict = {}
+        _clock = staticmethod(time.monotonic)
+
+    scaler = _FreshController()
+    assert scaler.forecaster.ring_occupancy == 0
+    hydrated = telemetry.hydrate_autoscaler('svc', scaler, root=root)
+    assert hydrated['qps_samples'] >= 20
+    assert scaler.forecaster.ring_occupancy > 0
+    assert hydrated['fleet_p99_ms'] == 87.5
+    assert scaler._snapshot['observed_p99_ms'] == 87.5
+    # The hydrated ring anticipates the recurring high phase: the
+    # seasonal delta between a low-phase slot and a high-phase slot
+    # is large and positive.
+    mono_now = time.monotonic()
+    wall_phase = (time.time() % 120.0) / 120.0
+    # Find a horizon landing mid-high-phase (0.75) from now.
+    horizon = ((0.75 - wall_phase) % 1.0) * 120.0
+    predicted = scaler.forecaster.predict(mono_now, horizon)
+    assert predicted > 20.0, (
+        f'hydrated ring should anticipate the high phase, got '
+        f'{predicted}')
+    # An unknown service hydrates nothing (and does not throw).
+    fresh = _FreshController()
+    fresh.forecaster = forecast.make_forecaster('seasonal')
+    empty = telemetry.hydrate_autoscaler('nope', fresh, root=root)
+    assert empty['qps_samples'] == 0
+
+
+# -- hot-path overhead (latency smoke) ----------------------------------
+
+
+@pytest.mark.latency
+def test_disabled_federation_adds_no_get_overhead(tmp_home,
+                                                  monkeypatch):
+    """Tier-1 guard: with SKYT_TELEMETRY_ENABLED=0 there is no plane,
+    no daemon, and /api/get stays a cheap row read (same stance and
+    bound as the tracing-disabled smoke)."""
+    monkeypatch.setenv('SKYT_TELEMETRY_ENABLED', '0')
+    srv = ApiServer(port=0)
+    assert srv.telemetry is None
+    srv.start_background()
+    assert not any(d.name == 'telemetry' for d in srv.daemons)
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        from skypilot_tpu.client import sdk
+        rid = sdk.status()
+        sdk.get(rid, timeout=60)
+        url = f'{srv.url}/api/get'
+        session = requests_lib.Session()
+        for _ in range(5):
+            session.get(url, params={'request_id': rid}, timeout=10)
+        samples = []
+        for _ in range(60):
+            t0 = time.monotonic()
+            resp = session.get(url, params={'request_id': rid},
+                               timeout=10)
+            samples.append(time.monotonic() - t0)
+            assert resp.status_code == 200
+        samples.sort()
+        p50 = samples[len(samples) // 2]
+        assert p50 < 0.05, f'/api/get p50 {p50 * 1000:.1f}ms'
+        # And no telemetry directory was created as a side effect.
+        assert not os.path.isdir(telemetry.telemetry_root())
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
+
+
+# -- CLI helpers --------------------------------------------------------
+
+
+def test_cli_sparkline_and_duration_helpers():
+    from skypilot_tpu.client import cli
+    spark = cli._sparkline([0.0, 1.0, 2.0, 3.0], width=4)
+    assert len(spark) == 4
+    assert spark[0] == cli._SPARK_BLOCKS[0]
+    assert spark[-1] == cli._SPARK_BLOCKS[-1]
+    # Wider series compress onto the terminal width.
+    assert len(cli._sparkline(list(range(100)), width=10)) == 10
+    assert cli._parse_duration('30m') == 1800.0
+    assert cli._parse_duration('2h') == 7200.0
+    assert cli._parse_duration('45') == 45.0
+
+
+def test_alerts_cli_renders_table(tmp_home, monkeypatch):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    monkeypatch.setenv('SKYT_TELEMETRY_ENABLED', '0')
+    srv = ApiServer(port=0)
+    srv.start_background()
+    monkeypatch.setenv('SKYT_API_SERVER_URL', srv.url)
+    try:
+        result = CliRunner().invoke(cli_mod.cli, ['alerts'])
+        assert result.exit_code == 0, result.output
+        assert 'no alerts' in result.output
+    finally:
+        srv.shutdown()
+        requests_db.reset_db_for_tests()
