@@ -122,6 +122,16 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_control_plane.py \
   | tee "BENCH_control_plane_${suffix}.json"
 echo "rc=$? -> BENCH_control_plane_${suffix}.json" >&2
 
+# Control-plane SCALE bench: CPU-only — per-tenant claimed-latency p99
+# under a 100x hot tenant on the workspace-sharded DRR queue vs the
+# legacy global FIFO, + uniform-load no-regression guard + Zipf tail +
+# shared-DB (pg stand-in) fidelity smoke (docs/control_plane_scale.md,
+# numbers in PERF.md).
+echo "=== bench control-scale ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_control_scale.py \
+  | tee "BENCH_control_scale_${suffix}.json"
+echo "rc=$? -> BENCH_control_scale_${suffix}.json" >&2
+
 # Serve data-plane bench: also CPU-only — async streaming LB vs the old
 # buffering thread-proxy (TTFT passthrough + keep-alive pooling at
 # concurrency 1/16/64; docs/serve_data_plane.md, numbers in PERF.md).

@@ -39,7 +39,20 @@ def _run(request_id: str, async_: bool, stream: bool = True):
     try:
         if stream:
             return sdk.stream_and_get(request_id)
-        return sdk.get(request_id)
+        # Non-streamed waits (status-style verbs) echo the server's
+        # queue-position hint while the request is still queued, so a
+        # user behind a backlog sees movement instead of silence.
+        last_pos = [None]
+
+        def _pending_hint(payload) -> None:
+            pos = payload.get('queue_position')
+            if pos is not None and pos != last_pos[0]:
+                last_pos[0] = pos
+                click.echo(f'queued: position {pos} in the '
+                           f'{payload.get("name", "request")} queue',
+                           err=True)
+
+        return sdk.get(request_id, on_pending=_pending_hint)
     except exceptions.SkytError as e:
         raise click.ClickException(str(e)) from e
 

@@ -194,8 +194,29 @@ def _retries() -> int:
     return env_registry.get_int('SKYT_CLIENT_RETRIES')
 
 
+def _retry_after_seconds(resp, payload) -> Optional[float]:
+    """The server's backoff directive: prefer the precise float the
+    overload/quota rejections carry in their JSON body (``payload``,
+    parsed once by the caller), fall back to the integer Retry-After
+    header. None = no directive."""
+    if isinstance(payload, dict) and \
+            payload.get('retry_after') is not None:
+        try:
+            return float(payload['retry_after'])
+        except (TypeError, ValueError):
+            pass
+    header = resp.headers.get('Retry-After')
+    if header is None:
+        return None
+    try:
+        return float(header)
+    except ValueError:
+        return None
+
+
 def _request_with_retries(method: str, url: str, **kwargs: Any):
-    """requests.request with backoff on transient transport errors.
+    """requests.request with jittered backoff on transient transport
+    errors AND server overload signals.
 
     Safe for POSTs because every submission carries an idempotency key the
     server dedupes on (parity target: the reference's chaos-proxy suite,
@@ -203,9 +224,18 @@ def _request_with_retries(method: str, url: str, **kwargs: Any):
     A 200 whose body fails to parse as JSON is also transient: a response
     truncated mid-headers can surface as a 'successful' garbage response
     rather than a transport error.
+
+    A 429/503 carrying Retry-After (admission control: per-tenant quota
+    or the overload gate shedding — docs/control_plane_scale.md) is
+    retried after max(server's Retry-After, the jittered backoff
+    schedule): the server's directive is a FLOOR, and the decorrelated
+    jitter (resilience.backoff_delays) keeps a shed client herd from
+    re-arriving in lockstep. A 429/503 with NO Retry-After is a plain
+    server error and is raised to the caller as before.
     """
+    from skypilot_tpu.utils import resilience
     attempts = _retries()
-    delay = 0.2
+    delays = resilience.backoff_delays(base=0.2, cap=5.0)
     for attempt in range(attempts):
         try:
             resp = requests_lib.request(method, url, **kwargs)
@@ -215,14 +245,34 @@ def _request_with_retries(method: str, url: str, **kwargs: Any):
                 except ValueError as e:
                     raise requests_lib.exceptions.ChunkedEncodingError(
                         f'malformed response body: {e}')
+            if resp.status_code in (429, 503) and attempt < attempts - 1:
+                try:
+                    payload = resp.json()
+                except ValueError:
+                    payload = None
+                retry_after = _retry_after_seconds(resp, payload)
+                if retry_after is not None:
+                    delay = max(retry_after, next(delays))
+                    hint = ''
+                    if isinstance(payload, dict) and \
+                            payload.get('queue_position') is not None:
+                        hint = (' (queue position '
+                                f'{payload["queue_position"]})')
+                    logger.info(
+                        'Server overloaded (HTTP %d)%s; honoring '
+                        'Retry-After: retrying in %.1fs',
+                        resp.status_code, hint, delay)
+                    time.sleep(delay)
+                    continue
             return resp
         except _RETRYABLE:
             if attempt == attempts - 1:
                 raise
-            logger.debug('Transient %s %s failure; retry %d/%d', method,
-                         url, attempt + 1, attempts - 1)
+            delay = next(delays)
+            logger.debug('Transient %s %s failure; retry %d/%d in '
+                         '%.1fs', method, url, attempt + 1,
+                         attempts - 1, delay)
             time.sleep(delay)
-            delay = min(delay * 2, 2.0)
     raise AssertionError('unreachable')
 
 
@@ -251,17 +301,26 @@ def _post(route: str, body: Dict[str, Any]) -> RequestId:
 
 # -- async request lifecycle ------------------------------------------
 
+# Server-side long-poll window per /api/get round trip (tests shrink it
+# to observe PENDING polls quickly).
+_GET_POLL_S = 15.0
 
-def get(request_id: str, timeout: Optional[float] = None) -> Any:
+
+def get(request_id: str, timeout: Optional[float] = None,
+        on_pending: Optional[Any] = None) -> Any:
     """Block until the request finishes; return its value or raise.
 
-    Parity: sdk.get :2313."""
+    ``on_pending`` (callable taking the poll payload dict) fires each
+    time a poll window expires with the request still PENDING — the
+    payload carries ``queue_position``, the server's queue-position
+    hint, which CLI waits echo so a queued-under-load user sees
+    progress instead of silence. Parity: sdk.get :2313."""
     url = ensure_api_server()
     deadline = None if timeout is None else time.monotonic() + timeout
     while True:
         resp = _request_with_retries(
             'GET', f'{url}/api/get',
-            params={'request_id': request_id, 'timeout': 15},
+            params={'request_id': request_id, 'timeout': _GET_POLL_S},
             timeout=60, headers=_auth_headers())
         if resp.status_code == 404:
             raise exceptions.RequestDoesNotExist(
@@ -271,6 +330,12 @@ def get(request_id: str, timeout: Optional[float] = None) -> Any:
             raise exceptions.ApiServerError(
                 payload.get('error', f'HTTP {resp.status_code}'))
         status = requests_db.RequestStatus(payload['status'])
+        if status == requests_db.RequestStatus.PENDING and \
+                on_pending is not None:
+            try:
+                on_pending(payload)
+            except Exception:  # pylint: disable=broad-except
+                pass  # a hint printer must never kill the wait
         if status == requests_db.RequestStatus.SUCCEEDED:
             return payload['return_value']
         if status == requests_db.RequestStatus.FAILED:

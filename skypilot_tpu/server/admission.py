@@ -1,0 +1,278 @@
+"""Front-door admission control for the API server.
+
+Two layers, checked at submit time (``app.do_POST``) BEFORE a request
+row is created — work the executor cannot reach is refused at the door
+with ``429 + Retry-After`` instead of queued into a backlog nobody
+drains (the collapse mode DAGOR's authors call "queuing up dead
+requests"):
+
+* **Per-tenant pending quota** — a workspace whose PENDING depth in a
+  queue reaches its ``max_pending`` bound (config
+  ``api_server.tenants.<ws>.max_pending``, default
+  ``SKYT_TENANT_MAX_PENDING``) is refused with its queue position as a
+  hint. Quotas are per (tenant, queue): a LONG flood from one tenant
+  can never consume another tenant's — or its own — SHORT budget, so
+  status/logs traffic keeps flowing during a launch storm.
+
+* **Global overload gate** (:class:`OverloadGate`) — a DAGOR-style
+  controller over the claimed-latency signal
+  (``requests_db.claim_wait_signal_ms``: max of recently-claimed queue
+  wait and the pending-head age). When the signal's EWMA exceeds
+  ``SKYT_ADMIT_TARGET_MS`` the gate sheds the lowest-priority tenant
+  band first and escalates one band per step while still overloaded;
+  recovery is hysteretic — one band restored only after
+  ``SKYT_ADMIT_HOLD_S`` of continuously healthy signal (below
+  ``recover_ratio * target``), so a queue hovering at the target can
+  never oscillate open/closed. SHORT traffic is never gated.
+
+The gate state machine (documented with a tuning table in
+``docs/control_plane_scale.md``)::
+
+    NORMAL --signal EWMA > target--> SHEDDING (shed next band, at most
+       ^                              once per step_s while overloaded)
+       |                                    |
+       +-- RECOVERING: EWMA < recover_ratio*target continuously for
+           hold_s --> restore one band (repeat until no bands shed)
+
+Failure policy: the admission path itself failing (DB blip while
+reading the quota count, chaos site ``server.admit``) fails OPEN — an
+admission-control outage must degrade to "no admission control", not
+to a 100%-reject front door.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.requests_db import ScheduleType
+from skypilot_tpu.utils import env_registry, fault_injection, log
+
+logger = log.init_logger(__name__)
+
+# Default priority band for tenants with no explicit config (matches
+# requests_db.tenant_config).
+DEFAULT_PRIORITY = 100
+
+NORMAL = 'normal'
+SHEDDING = 'shedding'
+RECOVERING = 'recovering'
+
+
+class OverloadGate:
+    """Hysteretic priority-shedding controller (one per process).
+
+    ``signal_fn``/``clock`` are injectable so the state machine is
+    unit-testable without a live requests DB or real time.
+    """
+
+    def __init__(self,
+                 signal_fn=None,
+                 clock=time.monotonic,
+                 sample_interval_s: float = 0.25,
+                 step_s: float = 1.0,
+                 recover_ratio: float = 0.7) -> None:
+        self._lock = threading.Lock()
+        self._signal_fn = signal_fn or requests_db.claim_wait_signal_ms
+        self._clock = clock
+        self._sample_interval_s = sample_interval_s
+        self._step_s = step_s
+        self._recover_ratio = recover_ratio
+        self.ewma_ms: Optional[float] = None
+        self.state = NORMAL
+        # Number of priority bands currently shed (0 = admit all).
+        self.shed_levels = 0
+        self._last_sample = 0.0
+        self._last_step = 0.0
+        self._healthy_since: Optional[float] = None
+
+    # -- knobs (read per decision so tests/operators can retune live) --
+
+    @staticmethod
+    def target_ms() -> float:
+        return env_registry.get_float('SKYT_ADMIT_TARGET_MS')
+
+    @staticmethod
+    def hold_s() -> float:
+        return env_registry.get_float('SKYT_ADMIT_HOLD_S')
+
+    def enabled(self) -> bool:
+        return self.target_ms() > 0
+
+    # -- priority bands ------------------------------------------------
+
+    @staticmethod
+    def _bands() -> List[int]:
+        """Distinct tenant priorities, lowest first — the shedding
+        order. Built from the configured tenant table plus the default
+        band every unconfigured tenant lives in."""
+        priorities = {DEFAULT_PRIORITY}
+        for ws in requests_db._tenants_config():  # pylint: disable=protected-access
+            priorities.add(requests_db.tenant_config(ws)['priority'])
+        return sorted(priorities)
+
+    def shed_threshold(self) -> Optional[int]:
+        """Highest priority currently shed (tenants with priority <=
+        it are refused); None when nothing is shed."""
+        bands = self._bands()
+        levels = min(self.shed_levels, len(bands))
+        return bands[levels - 1] if levels > 0 else None
+
+    # -- state machine -------------------------------------------------
+
+    def update(self, now: Optional[float] = None) -> None:
+        """Sample the overload signal (TTL-gated) and advance the
+        state machine. Called from the submit path; cheap when the
+        sample interval has not elapsed."""
+        if not self.enabled():
+            with self._lock:
+                self.state = NORMAL
+                self.shed_levels = 0
+                self._healthy_since = None
+            return
+        now = self._clock() if now is None else now
+        # Claim the sample slot under the lock, but run the DB-backed
+        # signal query OUTSIDE it: under overload (exactly when this
+        # runs) holding the gate lock across a contended-DB query
+        # would serialize every concurrent submit behind the sampler.
+        with self._lock:
+            if now - self._last_sample < self._sample_interval_s:
+                return
+            self._last_sample = now
+        sample = float(self._signal_fn())
+        with self._lock:
+            alpha = min(1.0, max(0.01, env_registry.get_float(
+                'SKYT_ADMIT_EWMA_ALPHA')))
+            self.ewma_ms = (sample if self.ewma_ms is None
+                            else alpha * sample +
+                            (1 - alpha) * self.ewma_ms)
+            target = self.target_ms()
+            n_bands = len(self._bands())
+            if self.ewma_ms > target:
+                self._healthy_since = None
+                if (self.shed_levels < n_bands and
+                        now - self._last_step >= self._step_s):
+                    self.shed_levels += 1
+                    self._last_step = now
+                    self.state = SHEDDING
+                    logger.warning(
+                        'overload gate: claimed-latency EWMA %.0fms > '
+                        'target %.0fms; shedding %d/%d priority '
+                        'band(s)', self.ewma_ms, target,
+                        self.shed_levels, n_bands)
+            elif self.ewma_ms < target * self._recover_ratio:
+                if self.shed_levels == 0:
+                    self.state = NORMAL
+                    self._healthy_since = None
+                else:
+                    self.state = RECOVERING
+                    if self._healthy_since is None:
+                        self._healthy_since = now
+                    elif now - self._healthy_since >= self.hold_s():
+                        self.shed_levels -= 1
+                        self._healthy_since = now
+                        self._last_step = now
+                        if self.shed_levels == 0:
+                            self.state = NORMAL
+                        logger.info(
+                            'overload gate: recovered one band '
+                            '(%d still shed)', self.shed_levels)
+            else:
+                # Between recover threshold and target: hold — the
+                # hysteresis dead zone that prevents oscillation.
+                self._healthy_since = None
+
+    def admit(self, workspace: str,
+              schedule_type: ScheduleType) -> Optional[Dict[str, Any]]:
+        """None = admitted; else a rejection payload. SHORT traffic
+        (status/logs/cancel — the calls operators need DURING an
+        overload) is never gated."""
+        if schedule_type != ScheduleType.LONG or not self.enabled():
+            return None
+        self.update()
+        threshold = self.shed_threshold()
+        if threshold is None:
+            return None
+        priority = requests_db.tenant_config(workspace)['priority']
+        if priority > threshold:
+            return None
+        return {
+            'error': (f'server overloaded (claimed-latency EWMA '
+                      f'{self.ewma_ms:.0f}ms > target '
+                      f'{self.target_ms():.0f}ms); tenant priority '
+                      f'{priority} is currently shed'),
+            'reason': 'shed',
+            'workspace': workspace,
+            'retry_after': self.hold_s(),
+        }
+
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'enabled': self.enabled(),
+                'state': self.state,
+                'shed_levels': self.shed_levels,
+                'shed_threshold': self.shed_threshold(),
+                'ewma_ms': self.ewma_ms,
+                'target_ms': self.target_ms(),
+            }
+
+
+_gate: Optional[OverloadGate] = None
+_gate_lock = threading.Lock()
+
+
+def gate() -> OverloadGate:
+    global _gate
+    with _gate_lock:
+        if _gate is None:
+            _gate = OverloadGate()
+        return _gate
+
+
+def reset_for_tests() -> None:
+    global _gate
+    with _gate_lock:
+        _gate = None
+
+
+def check_submit(workspace: str, schedule_type: ScheduleType
+                 ) -> Optional[Tuple[int, Dict[str, Any], float]]:
+    """Full submit-time admission decision.
+
+    Returns None (admit) or ``(http_status, body, retry_after_s)``.
+    Any internal failure fails OPEN: an admission outage must not
+    become a total outage."""
+    from skypilot_tpu.server import metrics
+    try:
+        fault_injection.inject('server.admit')
+        cfg = requests_db.tenant_config(workspace)
+        if cfg['max_pending'] > 0:
+            pending = requests_db.pending_for(workspace, schedule_type)
+            if pending >= cfg['max_pending']:
+                retry_after = max(1.0, min(30.0, pending / 20.0))
+                metrics.ADMISSION_DECISIONS.inc(
+                    outcome='quota', queue=schedule_type.value)
+                return (429, {
+                    'error': (f'workspace {workspace!r} has {pending} '
+                              f'pending {schedule_type.value} '
+                              f'request(s), at its max_pending quota '
+                              f'({cfg["max_pending"]})'),
+                    'reason': 'quota',
+                    'workspace': workspace,
+                    'queue_position': pending,
+                    'retry_after': retry_after,
+                }, retry_after)
+        rejection = gate().admit(workspace, schedule_type)
+        if rejection is not None:
+            metrics.ADMISSION_DECISIONS.inc(
+                outcome='shed', queue=schedule_type.value)
+            return (429, rejection, float(rejection['retry_after']))
+        metrics.ADMISSION_DECISIONS.inc(
+            outcome='admitted', queue=schedule_type.value)
+        return None
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('admission check failed open: %s: %s',
+                       type(e).__name__, e)
+        return None

@@ -172,10 +172,13 @@ class ApiHandler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length))
 
-    def _reply(self, payload: Any, code: int = 200) -> None:
+    def _reply(self, payload: Any, code: int = 200,
+               extra_headers: Tuple = ()) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header('Content-Type', 'application/json')
+        for key, value in extra_headers:
+            self.send_header(key, value)
         self.send_header('Content-Length', str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -308,6 +311,32 @@ class ApiHandler(BaseHTTPRequestHandler):
                 rbac.require_workspace_access(user, workspace or 'default',
                                               'use')
                 _, schedule_type = payloads.PAYLOADS[name]
+                # Idempotent resubmission first: a client retrying a
+                # POST whose response was lost must converge on its
+                # original request_id even while the tenant is at
+                # quota / being shed — the work already exists, no
+                # new row is admitted.
+                idem_key = self.headers.get('X-Skyt-Idempotency-Key')
+                if idem_key:
+                    existing = requests_db.get_by_idem_key(
+                        idem_key, workspace=workspace)
+                    if existing is not None:
+                        self._reply(
+                            {'request_id': existing.request_id})
+                        return
+                # Front-door admission: per-tenant pending quota +
+                # overload gate — refuse work the executor can't reach
+                # instead of queuing it (docs/control_plane_scale.md).
+                from skypilot_tpu.server import admission
+                verdict = admission.check_submit(
+                    workspace or 'default', schedule_type)
+                if verdict is not None:
+                    status_code, payload, retry_after = verdict
+                    import math
+                    self._reply(payload, status_code, extra_headers=(
+                        ('Retry-After',
+                         str(max(1, int(math.ceil(retry_after))))),))
+                    return
                 # Trace identity: extract the client's context (or mint
                 # a root) and persist THIS span's context on the row —
                 # the executor exports it into the request child, so
@@ -321,8 +350,7 @@ class ApiHandler(BaseHTTPRequestHandler):
                         name, body, schedule_type,
                         user=(user.name if user else
                               self.headers.get('X-Skyt-User')),
-                        idem_key=self.headers.get(
-                            'X-Skyt-Idempotency-Key'),
+                        idem_key=idem_key,
                         workspace=workspace,
                         trace_context=sp.traceparent())
                     sp.annotate(request_id=request_id)
@@ -708,6 +736,18 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                 app = getattr(self.server, 'skyt_app', None)
                 if app is not None:
                     executor_health = app.executor.health()
+                    # Per-shard backlog + admission state: operators
+                    # see WHICH tenant owns a backlog and whether the
+                    # front door is shedding, on the same surface LB
+                    # health checks already poll. Guarded: a DB blip
+                    # must not turn the health endpoint into a 500.
+                    try:
+                        executor_health['queue_shards'] = (
+                            requests_db.pending_by_workspace())
+                    except Exception:  # pylint: disable=broad-except
+                        executor_health['queue_shards'] = None
+                    from skypilot_tpu.server import admission
+                    body['admission'] = admission.gate().health()
                     body['server_id'] = app.server_id
                     body['executor'] = executor_health
                     body['daemons'] = [d.health() for d in app.daemons]
@@ -1081,7 +1121,16 @@ input{{width:100%;margin:.3em 0;padding:.5em}}</style></head><body>
                         # even at sample rate 0 — promote whatever this
                         # process buffered for it.
                         tracing.flush(get_span.context.trace_id)
-                self._reply(request.to_dict())
+                payload = request.to_dict()
+                if request.status == RequestStatus.PENDING:
+                    # Queue-position hint for clients still waiting
+                    # out the timeout (CLI waits echo it).
+                    try:
+                        payload['queue_position'] = (
+                            requests_db.queue_position(request))
+                    except Exception:  # pylint: disable=broad-except
+                        pass
+                self._reply(payload)
                 return
             # Relax the re-SELECT only when a wake source actually
             # covers the writer (finalize happens in a forked child, so

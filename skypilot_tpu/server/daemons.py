@@ -222,6 +222,22 @@ def _requests_ha_tick(server_id: str) -> None:
             '(requeue budget spent).', failed)
 
 
+def _request_gc_tick() -> None:
+    """Terminal-request retention: archive + purge rows older than
+    SKYT_REQUEST_RETENTION_S so the requests table stops growing
+    without bound (the telemetry cursor pages ascending finished_at
+    and never revisits the purged window — see
+    requests_db.gc_terminal_requests)."""
+    from skypilot_tpu.server import requests_db
+    retention = env_registry.get_float('SKYT_REQUEST_RETENTION_S')
+    if retention is None or retention <= 0:
+        return
+    purged = requests_db.gc_terminal_requests(retention)
+    if purged:
+        logger.info('request GC archived+purged %d terminal row(s) '
+                    'older than %.0fs', purged, retention)
+
+
 def _log_ship_tick() -> None:
     """Ship finished jobs' logs to the configured external store
     (parity: sky/logs/__init__.py:12 get_logging_agent → GCP Cloud
@@ -392,6 +408,9 @@ def build_daemons(server_id: Optional[str] = None,
         Daemon('log-shipper',
                _interval('log_ship_interval', 60.0),
                _log_ship_tick),
+        Daemon('request-gc',
+               lambda: env_registry.get_float('SKYT_REQUEST_GC_INTERVAL'),
+               _request_gc_tick),
         Daemon('runtime-events',
                _interval('runtime_events_interval', 5.0),
                _runtime_events_tick),

@@ -209,9 +209,23 @@ def runner_main(schedule_type_value: str,
         claim_signal = None
     signal_retry_at = time.monotonic() + 30.0
     claim_cursor = events.cursor(events.REQUESTS)
+    # Multi-replica work stealing: claim this replica's
+    # rendezvous-owned shards first, steal from the deepest shard when
+    # they are dry (requests_db.stealing_preference; None = no peers =
+    # no preference). The live-replica set is TTL-cached; per-shard
+    # ownership is hashed inside the claim. A lookup failure degrades
+    # to no preference, never to no claiming.
+    prefer = None
+    prefer_at = 0.0
     while True:
         if os.getppid() == 1:  # server died; orphaned runner exits
             return
+        if server_id and time.monotonic() >= prefer_at:
+            prefer_at = time.monotonic() + 2.0
+            try:
+                prefer = requests_db.stealing_preference(server_id)
+            except Exception:  # pylint: disable=broad-except
+                prefer = None
         if (claim_signal is None and events.enabled() and
                 time.monotonic() >= signal_retry_at):
             # Bounded rebuild after a boot-time blip — without it this
@@ -225,7 +239,8 @@ def runner_main(schedule_type_value: str,
         claim_base = events.external_cursor(events.REQUESTS,
                                             claim_signal)
         try:
-            request = requests_db.claim_next(schedule_type, server_id)
+            request = requests_db.claim_next(schedule_type, server_id,
+                                             prefer=prefer)
         except resilience.transient_db_errors() as e:
             # A transient DB fault (sqlite lock that escaped claim_next's
             # contention filter, Postgres blip) must not kill the runner

@@ -10,7 +10,10 @@ Tracked out of the box:
 * ``skyt_requests_total{name,status,workspace}`` -- terminal API
   requests by payload+status+tenant (in-flight rows:
   ``skyt_requests_in_flight{status}``);
-* ``skyt_request_queue_depth{queue}``  -- LONG/SHORT executor backlogs;
+* ``skyt_request_queue_depth{queue,workspace}`` -- executor backlog
+  per (LONG/SHORT, tenant) shard;
+* ``skyt_admission_decisions_total{outcome,queue}`` -- submit-time
+  admission outcomes (admitted / quota / shed);
 * ``skyt_provision_seconds``           -- provision latency histogram
   (the BASELINE.md orchestration metric: pod provision p50);
 * ``skyt_daemon_ticks_total{daemon}``  -- background reconcile liveness.
@@ -227,8 +230,17 @@ REQUESTS_IN_FLIGHT = Gauge(
     'PENDING/RUNNING request rows by status (point-in-time)',
     labels=('status',))
 QUEUE_DEPTH = Gauge(
-    'skyt_request_queue_depth', 'Pending requests per executor queue',
-    labels=('queue',))
+    'skyt_request_queue_depth',
+    'Pending requests per executor queue shard (queue x submitting '
+    'workspace) — the per-tenant backlog the telemetry plane and SLO '
+    'alerts watch directly',
+    labels=('queue', 'workspace'))
+ADMISSION_DECISIONS = Counter(
+    'skyt_admission_decisions_total',
+    'Submit-time admission decisions by outcome (admitted, quota = '
+    'per-tenant max_pending bound, shed = overload-gate priority '
+    'shedding) and executor queue',
+    labels=('outcome', 'queue'))
 PROVISION_SECONDS = Histogram(
     'skyt_provision_seconds', 'Cluster provision latency (seconds)',
     labels=('cloud',))
@@ -433,6 +445,7 @@ _provision_cursor = 0
 _collect_lock = threading.Lock()
 
 _ALL = ([REQUESTS_TOTAL, REQUESTS_IN_FLIGHT, QUEUE_DEPTH,
+         ADMISSION_DECISIONS,
          PROVISION_SECONDS, DAEMON_TICKS,
          RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS, BUILD_INFO,
          REQUEST_EXEC_SECONDS]
@@ -494,8 +507,16 @@ def collect_from_db() -> None:
                 break
         for status, count in requests_db.in_flight_by_status().items():
             REQUESTS_IN_FLIGHT.set(count, status=status)
-        for queue, depth in requests_db.pending_depth_by_queue().items():
-            QUEUE_DEPTH.set(depth, queue=queue)
+        # Per-shard depths: cleared first so a drained workspace's
+        # series drops to the seeded zero rows instead of freezing at
+        # its last backlog (gauges are point-in-time).
+        with _lock:
+            QUEUE_DEPTH._values.clear()
+        shard_depths = requests_db.pending_by_queue_workspace()
+        for queue in ('LONG', 'SHORT'):
+            shard_depths.setdefault((queue, 'default'), 0)
+        for (queue, workspace), depth in shard_depths.items():
+            QUEUE_DEPTH.set(depth, queue=queue, workspace=workspace)
         for event in state.cluster_events_after(_provision_cursor,
                                                 event='PROVISION_DONE'):
             try:

@@ -97,11 +97,18 @@ def _db():
                 requeues INTEGER DEFAULT 0,
                 pid_created REAL,          -- worker process start time
                 trace_context TEXT,        -- W3C traceparent (tracing)
+                claimed_at REAL,           -- PENDING->RUNNING stamp
                 created_at REAL,
                 finished_at REAL
             );
             CREATE INDEX IF NOT EXISTS idx_requests_status
                 ON requests (status, schedule_type);
+            CREATE INDEX IF NOT EXISTS idx_requests_shard
+                ON requests (status, schedule_type, workspace,
+                             created_at);
+            CREATE INDEX IF NOT EXISTS idx_requests_claimed
+                ON requests (claimed_at)
+                WHERE claimed_at IS NOT NULL;
             CREATE INDEX IF NOT EXISTS idx_requests_finished
                 ON requests (finished_at)
                 WHERE finished_at IS NOT NULL;
@@ -137,6 +144,16 @@ def _db():
             common_utils.add_column_if_missing(
                 conn,
                 'ALTER TABLE requests ADD COLUMN trace_context TEXT')
+        if 'claimed_at' not in cols:
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE requests ADD COLUMN claimed_at REAL')
+            conn.execute(
+                'CREATE INDEX IF NOT EXISTS idx_requests_claimed '
+                'ON requests (claimed_at) WHERE claimed_at IS NOT NULL')
+            conn.execute(
+                'CREATE INDEX IF NOT EXISTS idx_requests_shard '
+                'ON requests (status, schedule_type, workspace, '
+                'created_at)')
         conn.commit()
 
     os.makedirs(server_dir(), exist_ok=True)
@@ -176,6 +193,7 @@ class Request:
         self.requeues: int = row['requeues'] or 0
         self.pid_created: Optional[float] = row['pid_created']
         self.trace_context: Optional[str] = row['trace_context']
+        self.claimed_at: Optional[float] = row['claimed_at']
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -197,6 +215,7 @@ class Request:
             'user': self.user,
             'workspace': self.workspace,
             'created_at': self.created_at,
+            'claimed_at': self.claimed_at,
             'finished_at': self.finished_at,
             'trace_id': self.trace_id,
         }
@@ -242,17 +261,43 @@ def create(name: str,
             raise
         # idem_key collision: the earlier attempt reached us (possibly
         # through ANOTHER replica — the shared DB makes client retries
-        # converge on one request).
+        # converge on one request). Converge only within the SAME
+        # workspace: handing tenant B tenant A's request_id on a
+        # cross-tenant key collision would silently drop B's work and
+        # leak A's request handle — surface it as a client error
+        # instead (random keys never collide; deterministic-key
+        # clients get an actionable message).
         row = conn.execute(
-            'SELECT request_id FROM requests WHERE idem_key = ?',
-            (idem_key,)).fetchone()
+            'SELECT request_id, workspace FROM requests '
+            'WHERE idem_key = ?', (idem_key,)).fetchone()
         assert row is not None, idem_key
+        if (row['workspace'] or 'default') != (workspace or 'default'):
+            raise ValueError(
+                f'idempotency key {idem_key!r} is already in use by '
+                'another workspace; use a fresh key')
         return row['request_id']
     # Wake claimants (executor spawner + pool runners) the moment the
     # PENDING row is committed — submit→claimed no longer waits out a
     # poll tick.
     events.publish(events.REQUESTS, conn=conn)
     return request_id
+
+
+def get_by_idem_key(idem_key: str,
+                    workspace: Optional[str] = None) -> Optional[Request]:
+    """The request already created under ``idem_key``, if any — the
+    submit path checks this BEFORE admission control so a client
+    retrying a POST whose response was lost converges on its original
+    request instead of eating a 429 for work that is already
+    queued/running. Scoped to the caller's ``workspace``: a
+    cross-tenant key collision must fall through to create() (whose
+    unique index keeps the legacy global-dedupe semantics) rather
+    than silently handing one tenant another tenant's request_id."""
+    row = _db().execute(
+        'SELECT * FROM requests WHERE idem_key = ? '
+        "AND COALESCE(workspace, 'default') = ?",
+        (idem_key, workspace or 'default')).fetchone()
+    return Request(row) if row is not None else None
 
 
 def get_by_trace_id(trace_id: str) -> Optional[Request]:
@@ -294,10 +339,29 @@ def list_requests(status: Optional[RequestStatus] = None,
     return [Request(r) for r in rows]
 
 
+def fair_queue_enabled() -> bool:
+    """Workspace-sharded weighted fair claiming (the default).
+    SKYT_FAIR_QUEUE=0 restores the legacy global-FIFO pop — kept as
+    the bench baseline and an operational escape hatch."""
+    return env_registry.get_bool('SKYT_FAIR_QUEUE')
+
+
 def claim_next(schedule_type: ScheduleType,
-               server_id: Optional[str] = None) -> Optional[Request]:
-    """Atomically pop the oldest PENDING request of this type, stamping
-    the claiming replica's identity.
+               server_id: Optional[str] = None,
+               prefer: Optional[frozenset] = None) -> Optional[Request]:
+    """Atomically pop the next PENDING request of this type, stamping
+    the claiming replica's identity and the claim time.
+
+    Fair mode (default): the PENDING queue is logically sharded by
+    workspace and the winning shard is chosen by weighted
+    deficit-round-robin (docs/control_plane_scale.md) — each
+    backlogged tenant accrues credit proportional to its configured
+    weight, a claim spends one credit, and idle shards accrue nothing
+    (their capacity flows to backlogged tenants, so utilization never
+    drops below the single-queue behavior). Per-tenant max-in-flight
+    quotas are enforced here; ``prefer`` (multi-replica work stealing)
+    restricts the DRR pass to this replica's preferred shards first
+    and falls back to stealing from the globally deepest shard.
 
     Claimants are separate runner PROCESSES (executor worker pool) and,
     in HA mode, processes on OTHER replicas — the pop must be atomic at
@@ -312,37 +376,12 @@ def claim_next(schedule_type: ScheduleType,
     conn = _db()
     with _claim_lock:
         try:
-            if _returning_supported():
-                try:
-                    row = conn.execute(
-                        'UPDATE requests SET status = ?, server_id = ? '
-                        'WHERE request_id = ('
-                        '  SELECT request_id FROM requests'
-                        '  WHERE status = ? AND schedule_type = ?'
-                        '  ORDER BY created_at LIMIT 1'
-                        ') AND status = ? RETURNING request_id',
-                        (RequestStatus.RUNNING.value, server_id,
-                         RequestStatus.PENDING.value, schedule_type.value,
-                         RequestStatus.PENDING.value)).fetchone()
-                    conn.commit()
-                    request_id = row['request_id'] if row else None
-                except Exception as e:  # pylint: disable=broad-except
-                    # Rollback before ANY exit: a non-OperationalError
-                    # (e.g. a PgError) re-raised here would escape the
-                    # outer handler with the claim transaction open.
-                    conn.rollback()
-                    if 'returning' not in str(e).lower():
-                        raise
-                    # The backend advertised new enough but the SQL
-                    # layer under it doesn't parse RETURNING (e.g. an
-                    # sqlite-backed Postgres stand-in): remember and
-                    # take the portable path from now on.
-                    _mark_returning_unsupported()
-                    request_id = _claim_next_no_returning(
-                        conn, schedule_type, server_id)
+            if fair_queue_enabled():
+                request_id = _claim_fair(conn, schedule_type, server_id,
+                                         prefer)
             else:
-                request_id = _claim_next_no_returning(
-                    conn, schedule_type, server_id)
+                request_id = _claim_row(conn, schedule_type, server_id,
+                                        attempts=8)
         except sqlite3.OperationalError as e:
             conn.rollback()
             # Lock contention (another claimant won) is the expected
@@ -356,6 +395,89 @@ def claim_next(schedule_type: ScheduleType,
         if request_id is None:
             return None
     return get(request_id)
+
+
+def _claim_fair(conn, schedule_type: ScheduleType,
+                server_id: Optional[str],
+                prefer: Optional[frozenset]) -> Optional[str]:
+    """One fair-claim pass: pick a shard by DRR credit, pop its oldest
+    row. Bounded retries: a miss means another claimant drained the
+    chosen shard between the depth read and the pop."""
+    for _ in range(8):
+        depths = _pending_ws_depths(conn, schedule_type)
+        if not depths:
+            return None
+        eligible = _apply_inflight_quota(conn, depths, schedule_type)
+        if not eligible:
+            return None  # every backlogged tenant is at max in-flight
+        shard = _pick_shard(eligible, schedule_type, prefer)
+        # Chaos site: a replica dying BETWEEN shard selection and the
+        # row pop (kill/partition mid-claim) — the surviving replicas'
+        # heartbeat requeue + stealing must drain its shard.
+        fault_injection.inject('requests_db.claim.pick')
+        request_id = _claim_row(conn, schedule_type, server_id,
+                                workspace=shard, attempts=1)
+        if request_id is not None:
+            _charge_credit(schedule_type, shard)
+            return request_id
+    return None
+
+
+def _claim_row(conn, schedule_type: ScheduleType,
+               server_id: Optional[str],
+               workspace: Optional[str] = None,
+               attempts: int = 8) -> Optional[str]:
+    """Atomic pop of the oldest PENDING row in (queue[, shard]),
+    stamping claimed_at. ``workspace`` filters on the normalized shard
+    key (NULL rows belong to 'default')."""
+    where = 'status = ? AND schedule_type = ?'
+    args: List[Any] = [RequestStatus.PENDING.value, schedule_type.value]
+    if workspace is not None:
+        where += " AND COALESCE(workspace, 'default') = ?"
+        args.append(workspace)
+    if _returning_supported():
+        try:
+            row = conn.execute(
+                'UPDATE requests SET status = ?, server_id = ?, '
+                'claimed_at = ? WHERE request_id = ('
+                f'  SELECT request_id FROM requests WHERE {where}'
+                '  ORDER BY created_at LIMIT 1'
+                ') AND status = ? RETURNING request_id',
+                [RequestStatus.RUNNING.value, server_id, time.time()]
+                + args + [RequestStatus.PENDING.value]).fetchone()
+            conn.commit()
+            return row['request_id'] if row else None
+        except Exception as e:  # pylint: disable=broad-except
+            # Rollback before ANY exit: a non-OperationalError
+            # (e.g. a PgError) re-raised here would escape the
+            # outer handler with the claim transaction open.
+            conn.rollback()
+            if 'returning' not in str(e).lower():
+                raise
+            # The backend advertised new enough but the SQL
+            # layer under it doesn't parse RETURNING (e.g. an
+            # sqlite-backed Postgres stand-in): remember and
+            # take the portable path from now on.
+            _mark_returning_unsupported()
+    # Portable two-step pop with the SAME atomicity: the conditional
+    # UPDATE on (request_id, status=PENDING) is serialized by sqlite's
+    # write lock, so of N concurrent claimants exactly one flips the
+    # row and losers re-select the next candidate.
+    for _ in range(max(1, attempts)):  # bounded: a miss = someone won
+        row = conn.execute(
+            f'SELECT request_id FROM requests WHERE {where} '
+            'ORDER BY created_at LIMIT 1', args).fetchone()
+        if row is None:
+            return None
+        cur = conn.execute(
+            'UPDATE requests SET status = ?, server_id = ?, '
+            'claimed_at = ? WHERE request_id = ? AND status = ?',
+            (RequestStatus.RUNNING.value, server_id, time.time(),
+             row['request_id'], RequestStatus.PENDING.value))
+        conn.commit()
+        if cur.rowcount == 1:
+            return row['request_id']
+    return None
 
 
 # Per-backend UPDATE..RETURNING support (True/False), keyed by the DB
@@ -387,32 +509,166 @@ def _mark_returning_unsupported() -> None:
     _returning_ok[_backend_key()] = False
 
 
-def _claim_next_no_returning(conn, schedule_type: ScheduleType,
-                             server_id: Optional[str]) -> Optional[str]:
-    """Portable two-step pop with the SAME atomicity: the conditional
-    UPDATE on (request_id, status=PENDING) is serialized by sqlite's
-    write lock, so of N concurrent claimants exactly one flips the row
-    and losers re-select the next candidate."""
-    for _ in range(8):  # bounded: each miss means someone else won
-        row = conn.execute(
-            'SELECT request_id FROM requests '
-            'WHERE status = ? AND schedule_type = ? '
-            'ORDER BY created_at LIMIT 1',
-            (RequestStatus.PENDING.value, schedule_type.value)).fetchone()
-        if row is None:
-            return None
-        cur = conn.execute(
-            'UPDATE requests SET status = ?, server_id = ? '
-            'WHERE request_id = ? AND status = ?',
-            (RequestStatus.RUNNING.value, server_id,
-             row['request_id'], RequestStatus.PENDING.value))
-        conn.commit()
-        if cur.rowcount == 1:
-            return row['request_id']
-    return None
-
-
 _claim_lock = threading.Lock()
+
+
+# -- tenant scheduling: weights, quotas, DRR credits -------------------
+#
+# Tenant = workspace. Weights/quotas/priorities come from the layered
+# config (api_server.tenants.<ws>.{weight,max_pending,max_inflight,
+# priority}) with SKYT_TENANT_* env defaults; lookups are TTL-cached so
+# the claim hot path never re-reads the config file per pop. DRR
+# credits are in-process (per claimant) under _claim_lock: fairness is
+# a statistical long-run property, and per-process DRR over the SAME
+# global shard depths converges to weighted shares without adding a
+# write-contended credit table to every claim.
+
+_TENANT_CFG_TTL_S = 5.0
+_tenant_cfg_cache: Tuple[float, Dict[str, Dict[str, Any]]] = (0.0, {})
+# (backend_key, schedule_type) -> {workspace: credit}
+_drr_credits: Dict[Tuple[str, str], Dict[str, float]] = {}
+
+
+def _tenants_config() -> Dict[str, Dict[str, Any]]:
+    global _tenant_cfg_cache
+    now = time.monotonic()
+    cached_at, cached = _tenant_cfg_cache
+    if cached_at and now - cached_at < _TENANT_CFG_TTL_S:
+        return cached
+    from skypilot_tpu import config
+    raw = config.get_nested(('api_server', 'tenants'), None) or {}
+    table = {str(ws): dict(cfg) for ws, cfg in raw.items()
+             if isinstance(cfg, dict)}
+    _tenant_cfg_cache = (now, table)
+    _tenant_effective.clear()
+    return table
+
+
+_tenant_effective: Dict[str, Dict[str, Any]] = {}
+
+
+def tenant_config(workspace: str) -> Dict[str, Any]:
+    """Effective scheduling config for one tenant: config overlay on
+    the SKYT_TENANT_* defaults, memoized on the same TTL as the raw
+    table (the claim hot path reads this per eligible shard).
+    ``priority`` orders DAGOR-style shedding (lower sheds first)."""
+    # TTL revalidation first: a refresh clears the memo, so a hit
+    # below is guaranteed current.
+    table = _tenants_config()
+    cached = _tenant_effective.get(workspace)
+    if cached is not None:
+        return cached
+    cfg = table.get(workspace, {})
+    effective = {
+        'weight': max(1e-6, float(cfg.get(
+            'weight',
+            env_registry.get_float('SKYT_TENANT_WEIGHT_DEFAULT')))),
+        'max_pending': int(cfg.get(
+            'max_pending',
+            env_registry.get_int('SKYT_TENANT_MAX_PENDING'))),
+        'max_inflight': int(cfg.get(
+            'max_inflight',
+            env_registry.get_int('SKYT_TENANT_MAX_INFLIGHT'))),
+        'priority': int(cfg.get('priority', 100)),
+    }
+    _tenant_effective[workspace] = effective
+    return effective
+
+
+def _pending_ws_depths(conn, schedule_type: ScheduleType
+                       ) -> Dict[str, int]:
+    rows = conn.execute(
+        "SELECT COALESCE(workspace, 'default') AS ws, COUNT(*) AS n "
+        'FROM requests WHERE status = ? AND schedule_type = ? '
+        'GROUP BY ws',
+        (RequestStatus.PENDING.value, schedule_type.value)).fetchall()
+    return {r['ws']: r['n'] for r in rows}
+
+
+def _apply_inflight_quota(conn, depths: Dict[str, int],
+                          schedule_type: ScheduleType) -> Dict[str, int]:
+    """Drop shards whose tenant is at its max-in-flight quota. The
+    RUNNING group-by only runs when some quota is actually configured
+    (the common unbounded case stays one query per claim)."""
+    caps = {ws: tenant_config(ws)['max_inflight'] for ws in depths}
+    if not any(cap > 0 for cap in caps.values()):
+        return depths
+    rows = conn.execute(
+        "SELECT COALESCE(workspace, 'default') AS ws, COUNT(*) AS n "
+        'FROM requests WHERE status = ? AND schedule_type = ? '
+        'GROUP BY ws',
+        (RequestStatus.RUNNING.value, schedule_type.value)).fetchall()
+    running = {r['ws']: r['n'] for r in rows}
+    return {ws: d for ws, d in depths.items()
+            if caps[ws] <= 0 or running.get(ws, 0) < caps[ws]}
+
+
+class ReplicaSet(frozenset):
+    """The live replica ids plus this replica's identity. When passed
+    as ``prefer``, shard ownership is rendezvous-hashed PER CLAIM over
+    the eligible shards — never derived from a cached pending
+    snapshot, which would leave a newly-backlogged shard owned by
+    nobody (and starved behind steal traffic) for a TTL."""
+
+    def __new__(cls, replicas, server_id: str):
+        obj = super().__new__(cls, replicas)
+        obj.server_id = server_id
+        return obj
+
+
+def _pick_shard(eligible: Dict[str, int], schedule_type: ScheduleType,
+                prefer: Optional[frozenset]) -> str:
+    """DRR winner among the backlogged shards. With ``prefer`` set
+    (multi-replica), DRR runs over this replica's preferred shards
+    when any are backlogged; otherwise STEAL from the globally deepest
+    shard — a dead replica's backlog drains through its peers at event
+    latency instead of waiting for reassignment."""
+    if isinstance(prefer, ReplicaSet):
+        replicas = sorted(prefer)
+        prefer = frozenset(
+            ws for ws in eligible
+            if _rendezvous_owner(ws, replicas) == prefer.server_id)
+    if prefer is not None:
+        pool = {ws: d for ws, d in eligible.items() if ws in prefer}
+        if not pool:
+            return max(eligible.items(),
+                       key=lambda kv: (kv[1], kv[0]))[0]
+    else:
+        pool = eligible
+    credits = _drr_credits.setdefault(
+        (_backend_key(), schedule_type.value), {})
+    # Idle-shard credit redistribution: shards with no backlog drop
+    # out of the round entirely (and forfeit stale credit), so their
+    # share flows to backlogged tenants — work conserving by
+    # construction.
+    for ws in list(credits):
+        if ws not in pool:
+            del credits[ws]
+    weights = {ws: tenant_config(ws)['weight'] for ws in pool}
+    for ws in pool:
+        credits.setdefault(ws, 0.0)
+    if max(credits.values()) < 1.0:
+        # Top up every backlogged tenant by the minimum number of
+        # whole rounds that lets someone afford a claim; cap bounds
+        # the burst a tenant can bank.
+        rounds = min(
+            int(-(-(1.0 - credits[ws]) // weights[ws]))  # ceil
+            for ws in pool)
+        rounds = max(1, rounds)
+        for ws in pool:
+            cap = max(1.0, weights[ws])
+            credits[ws] = min(cap, credits[ws] + rounds * weights[ws])
+    # Deterministic: highest credit, then heaviest weight, then the
+    # deeper backlog, then name — a stable order the fairness property
+    # test can rely on.
+    return max(pool,
+               key=lambda ws: (credits[ws], weights[ws], pool[ws], ws))
+
+
+def _charge_credit(schedule_type: ScheduleType, workspace: str) -> None:
+    credits = _drr_credits.get((_backend_key(), schedule_type.value))
+    if credits is not None and workspace in credits:
+        credits[workspace] -= 1.0
 
 
 def set_pid(request_id: str, pid: int,
@@ -488,12 +744,92 @@ def in_flight_by_status() -> Dict[str, int]:
 
 def pending_by_workspace() -> Dict[str, int]:
     """PENDING backlog per workspace — the per-tenant queue-depth
-    source for the telemetry plane's recording rules."""
+    source for the telemetry plane's recording rules, /api/health's
+    executor shard view, and the stealing preference map."""
     rows = _db().execute(
         'SELECT workspace, COUNT(*) AS n FROM requests '
         'WHERE status = ? GROUP BY workspace',
         (RequestStatus.PENDING.value,)).fetchall()
     return {(r['workspace'] or 'default'): r['n'] for r in rows}
+
+
+def pending_by_queue_workspace() -> Dict[Tuple[str, str], int]:
+    """PENDING backlog per (queue, workspace) — the per-shard depth
+    behind the skyt_request_queue_depth{queue,workspace} gauges."""
+    rows = _db().execute(
+        "SELECT schedule_type, COALESCE(workspace, 'default') AS ws, "
+        'COUNT(*) AS n FROM requests WHERE status = ? '
+        'GROUP BY schedule_type, ws',
+        (RequestStatus.PENDING.value,)).fetchall()
+    return {(r['schedule_type'], r['ws']): r['n'] for r in rows}
+
+
+def pending_for(workspace: str,
+                schedule_type: ScheduleType) -> int:
+    """One tenant's PENDING depth in one queue (the submit-side quota
+    read — indexed, one COUNT per admission check)."""
+    row = _db().execute(
+        'SELECT COUNT(*) AS n FROM requests WHERE status = ? AND '
+        "schedule_type = ? AND COALESCE(workspace, 'default') = ?",
+        (RequestStatus.PENDING.value, schedule_type.value,
+         workspace)).fetchone()
+    return row['n']
+
+
+def queue_position(request: 'Request') -> Optional[int]:
+    """1-based position of a PENDING request in its queue (FIFO-order
+    hint for clients/CLI waits; under fair claiming the true order
+    depends on tenant credit, so this is an upper bound within the
+    queue)."""
+    if request.status != RequestStatus.PENDING:
+        return None
+    row = _db().execute(
+        'SELECT COUNT(*) AS n FROM requests WHERE status = ? AND '
+        'schedule_type = ? AND (created_at < ? OR '
+        '(created_at = ? AND request_id < ?))',
+        (RequestStatus.PENDING.value, request.schedule_type.value,
+         request.created_at, request.created_at,
+         request.request_id)).fetchone()
+    return row['n'] + 1
+
+
+def claim_wait_signal_ms(schedule_type: ScheduleType = ScheduleType.LONG,
+                         window_s: float = 10.0) -> float:
+    """The overload gate's input, in ms. Under a FAIR scheduler a
+    global max-wait would be the wrong signal: one tenant's deep but
+    quota-permitted backlog keeps its own waits huge forever (self-
+    inflicted queueing) and would shed innocent tenants. Instead:
+
+    * with recent claims: the BEST-OFF tenant's worst claimed wait
+      (min over workspaces of that workspace's max wait) — if even
+      the best-served backlogged tenant waits past the target, the
+      plane is genuinely overloaded, not just one shard deep.
+      Requeued rows are excluded: their second claim's
+      ``claimed_at - created_at`` spans the first execution and a
+      replica death would otherwise read as an overload storm.
+    * with NO recent claims but a pending backlog: the pending-head
+      age — claiming has stalled entirely, and the no-samples case
+      must not read as healthy.
+
+    All operands are persisted wall timestamps — the only clock that
+    spans the submitting and claiming processes."""
+    conn = _db()
+    now = time.time()
+    rows = conn.execute(
+        "SELECT COALESCE(workspace, 'default') AS ws, "
+        'MAX(claimed_at - created_at) AS w FROM requests '
+        'WHERE claimed_at IS NOT NULL AND claimed_at >= ? '
+        'AND schedule_type = ? AND COALESCE(requeues, 0) = 0 '
+        'GROUP BY ws',
+        (now - window_s, schedule_type.value)).fetchall()
+    if rows:
+        return min(r['w'] or 0.0 for r in rows) * 1000.0
+    row = conn.execute(
+        'SELECT MIN(created_at) AS head FROM requests '
+        'WHERE status = ? AND schedule_type = ?',
+        (RequestStatus.PENDING.value, schedule_type.value)).fetchone()
+    return ((now - row['head']) * 1000.0
+            if row['head'] is not None else 0.0)
 
 
 def pending_depth_by_queue() -> Dict[str, int]:
@@ -594,6 +930,129 @@ def cancelled_since(ts: float) -> List[Request]:
         'SELECT * FROM requests WHERE status = ? AND finished_at >= ?',
         (RequestStatus.CANCELLED.value, ts)).fetchall()
     return [Request(r) for r in rows]
+
+
+# -- terminal-row retention (request-gc daemon) -----------------------------
+
+
+def archive_dir() -> str:
+    return os.path.join(server_dir(), 'archive')
+
+
+def gc_terminal_requests(retention_s: float,
+                         batch: int = 500,
+                         archive: bool = True) -> int:
+    """Archive + delete terminal rows older than ``retention_s``.
+
+    Rows are appended (JSONL, one file per UTC day) to
+    ``<server_dir>/archive`` BEFORE the delete commits, so a purged
+    request is always recoverable from disk. Paging cursors
+    (:class:`TerminalCursor`) stay correct across the purge: they walk
+    ascending ``(finished_at, request_id)``, and only rows older than
+    the retention window — far behind any live cursor — are removed.
+    Idempotency dedup for purged rows is gone with them; retention
+    must comfortably exceed the client retry horizon (docs). Returns
+    the number of rows purged."""
+    fault_injection.inject('requests_db.gc')
+    if retention_s <= 0:
+        return 0
+    conn = _db()
+    cutoff = time.time() - retention_s
+    purged = 0
+    while True:
+        rows = conn.execute(
+            'SELECT * FROM requests WHERE finished_at IS NOT NULL '
+            'AND finished_at < ? ORDER BY finished_at LIMIT ?',
+            (cutoff, int(batch))).fetchall()
+        if not rows:
+            break
+        if archive:
+            _archive_rows(rows)
+        ids = [r['request_id'] for r in rows]
+        marks = ','.join('?' * len(ids))
+        # Condition on finished_at again: terminal rows never revert,
+        # but the guard keeps the delete safe against any future
+        # resurrection path.
+        conn.execute(
+            f'DELETE FROM requests WHERE request_id IN ({marks}) '
+            'AND finished_at IS NOT NULL', ids)
+        conn.commit()
+        purged += len(rows)
+        if len(rows) < batch:
+            break
+    return purged
+
+
+def _archive_rows(rows) -> None:
+    """Append purged rows to the day-partitioned JSONL archive, synced
+    to disk before the caller deletes them. RAW column values — not
+    the API-shaped to_dict(), which drops schedule_type/idem_key/
+    requeues/server_id — so an archived request is fully
+    reconstructable (body stays its stored JSON string)."""
+    os.makedirs(archive_dir(), exist_ok=True)
+    by_day: Dict[str, List[str]] = {}
+    for r in rows:
+        day = time.strftime('%Y%m%d', time.gmtime(r['finished_at']))
+        by_day.setdefault(day, []).append(
+            json.dumps({key: r[key] for key in r.keys()},
+                       sort_keys=True))
+    for day, lines in by_day.items():
+        path = os.path.join(archive_dir(), f'requests-{day}.jsonl')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write('\n'.join(lines) + '\n')
+            f.flush()
+            os.fsync(f.fileno())
+
+
+# -- multi-replica work stealing: shard preference --------------------------
+
+# server_id -> (built_at monotonic, frozenset of preferred workspaces)
+_preferred_cache: Dict[str, Tuple[float, Optional[frozenset]]] = {}
+
+
+def _rendezvous_owner(workspace: str, replicas: List[str]) -> str:
+    """Highest-random-weight (rendezvous) hash: every replica computes
+    the same owner for a shard from the live-replica set alone — no
+    coordination, and a membership change only moves the shards that
+    hashed to the departed replica."""
+    import hashlib
+    return max(replicas,
+               key=lambda r: hashlib.sha1(
+                   f'{r}|{workspace}'.encode()).hexdigest())
+
+
+def stealing_preference(server_id: str,
+                        ttl_s: float = 2.0) -> Optional[ReplicaSet]:
+    """The claim-time stealing preference for ``server_id``: the live
+    replica set (ownership of each ELIGIBLE shard is rendezvous-hashed
+    inside the claim, so a shard that becomes backlogged a millisecond
+    later is owned immediately). ``None`` = single live replica — no
+    preference and none of the extra queries. The LIVENESS set is what
+    gets cached for ``ttl_s``: membership changes slower than
+    backlog."""
+    cached = _preferred_cache.get(server_id)
+    now = time.monotonic()
+    if cached is not None and now - cached[0] < ttl_s:
+        return cached[1]
+    live = live_server_ids(default_stale_seconds())
+    live.add(server_id)
+    result = (ReplicaSet(live, server_id) if len(live) > 1 else None)
+    _preferred_cache[server_id] = (now, result)
+    return result
+
+
+def preferred_workspaces(server_id: str,
+                         ttl_s: float = 2.0) -> Optional[frozenset]:
+    """Snapshot view of the shards ``server_id`` currently owns among
+    the PENDING backlog (introspection/tests; the claim path uses
+    :func:`stealing_preference`, which hashes per claim instead)."""
+    replica_set = stealing_preference(server_id, ttl_s=ttl_s)
+    if replica_set is None:
+        return None
+    replicas = sorted(replica_set)
+    return frozenset(
+        ws for ws in pending_by_workspace()
+        if _rendezvous_owner(ws, replicas) == server_id)
 
 
 # -- HA: replica heartbeats + orphan requeue --------------------------------
@@ -730,7 +1189,7 @@ def requeue_dead_server_requests(own_server_id: str,
             continue
         cur = conn.execute(
             'UPDATE requests SET status = ?, server_id = NULL, '
-            'pid = NULL, requeues = requeues + 1 '
+            'pid = NULL, claimed_at = NULL, requeues = requeues + 1 '
             'WHERE request_id = ? AND status = ? AND server_id = ?',
             (RequestStatus.PENDING.value, request.request_id,
              RequestStatus.RUNNING.value, request.server_id))
@@ -778,6 +1237,7 @@ def _purge_unreferenced_heartbeats(conn, stale_after: float) -> None:
 
 
 def reset_db_for_tests() -> None:
+    global _tenant_cfg_cache
     conn = getattr(_local, 'conn', None)
     if conn is not None:
         conn.close()
@@ -785,3 +1245,7 @@ def reset_db_for_tests() -> None:
     _pg_schema_ready.clear()
     _db_healthy_since.clear()
     _returning_ok.clear()
+    _drr_credits.clear()
+    _preferred_cache.clear()
+    _tenant_cfg_cache = (0.0, {})
+    _tenant_effective.clear()

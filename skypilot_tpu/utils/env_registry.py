@@ -180,6 +180,32 @@ DECLARATIONS: List[EnvVar] = _decl([
     ('SKYT_SERVER_STALE_S', 'float', 15.0,
      'Heartbeat age before a peer API server counts as dead and its '
      'requests are requeued.'),
+    ('SKYT_FAIR_QUEUE', 'bool', True,
+     'Workspace-sharded weighted fair (DRR) claim order in the '
+     'request executor (0 = legacy global FIFO).'),
+    ('SKYT_TENANT_WEIGHT_DEFAULT', 'float', 1.0,
+     'Fair-share weight for workspaces with no explicit '
+     'api_server.tenants.<ws>.weight config.'),
+    ('SKYT_TENANT_MAX_PENDING', 'int', 1000,
+     'Default per-workspace PENDING cap per queue; submits past it '
+     'get 429 + Retry-After (0 = unbounded).'),
+    ('SKYT_TENANT_MAX_INFLIGHT', 'int', 0,
+     'Default per-workspace RUNNING cap per queue enforced at claim '
+     '(0 = unbounded).'),
+    ('SKYT_ADMIT_TARGET_MS', 'float', 0.0,
+     'Overload gate: claimed-latency target in ms; EWMA above it '
+     'sheds lowest-priority tenants first (0 = gate disabled).'),
+    ('SKYT_ADMIT_HOLD_S', 'float', 5.0,
+     'Overload gate hysteresis: continuous healthy seconds required '
+     'before one shed level is restored.'),
+    ('SKYT_ADMIT_EWMA_ALPHA', 'float', 0.3,
+     'Overload gate EWMA smoothing factor for the claimed-latency '
+     'signal.'),
+    ('SKYT_REQUEST_RETENTION_S', 'float', 7 * 86400.0,
+     'Terminal request rows older than this are archived+purged by '
+     'the request-gc daemon (0 = keep forever).'),
+    ('SKYT_REQUEST_GC_INTERVAL', 'float', 300.0,
+     'request-gc daemon tick cadence (seconds).'),
     ('SKYT_CHANNEL_BROKER', 'bool', True,
      'Run the channel-broker socket in the API server (0 disables).'),
     ('SKYT_DAG_MAX_CONCURRENCY', 'int', 16,

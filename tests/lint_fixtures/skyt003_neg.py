@@ -3,7 +3,7 @@ from skypilot_tpu.server import metrics
 
 
 def emit_correct(outcome, seconds):
-    metrics.QUEUE_DEPTH.set(3, queue='LONG')
+    metrics.QUEUE_DEPTH.set(3, queue='LONG', workspace='default')
     metrics.LB_REQUESTS.inc(outcome=outcome)
     metrics.TRANSFER_OBJECTS.inc(direction='up', outcome=outcome)
     metrics.TRANSFER_SECONDS.observe(seconds, direction='up')

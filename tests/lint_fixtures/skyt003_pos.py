@@ -5,7 +5,7 @@ from skypilot_tpu.server import metrics
 
 def emit_drifted(outcome):
     # Wrong method for the instrument: QUEUE_DEPTH is a Gauge.
-    metrics.QUEUE_DEPTH.inc(queue='LONG')
+    metrics.QUEUE_DEPTH.inc(queue='LONG', workspace='default')
     # Label drift: declared labels are ('outcome',).
     metrics.LB_REQUESTS.inc(result=outcome)
     # Missing label: TRANSFER_OBJECTS declares (direction, outcome).
