@@ -195,12 +195,13 @@ def run_hot_tenant(fair: bool, *, light_tenants=12, light_rate=2.0,
     submitted = {'light': 0, 'hot': 0}
 
     def light_submitter(ws: str, seed: int) -> None:
-        rng = random.Random(seed)
+        from skypilot_tpu.sim import traffic
+        # Poisson arrivals: periodic submitters would synchronize
+        # into a deterministic stream with no queueing at all.
+        gaps = traffic.arrival_gaps(random.Random(seed), light_rate)
         seq = 0
         while True:
-            # Poisson arrivals: periodic submitters would synchronize
-            # into a deterministic stream with no queueing at all.
-            time.sleep(rng.expovariate(light_rate))
+            time.sleep(next(gaps))
             if time.monotonic() >= stop_submit:
                 return
             seq += 1
@@ -329,17 +330,11 @@ def run_zipf(fair: bool, *, tenants=32, requests=600, alpha=1.1,
     import random
     _fresh_state('zipf-' + ('fair' if fair else 'global'), fair)
     from skypilot_tpu.server import requests_db as rdb
+    from skypilot_tpu.sim import traffic
     rng = random.Random(1234)
-    weights = [1.0 / ((i + 1) ** alpha) for i in range(tenants)]
-    total = sum(weights)
-    probs = [w / total for w in weights]
+    probs = traffic.zipf_weights(tenants, alpha)
     for _ in range(requests):
-        r, acc, idx = rng.random(), 0.0, 0
-        for i, p in enumerate(probs):
-            acc += p
-            if r <= acc:
-                idx = i
-                break
+        idx = traffic.pick_weighted(rng, probs)
         rdb.create('launch', {}, rdb.ScheduleType.LONG,
                    workspace=f'z{idx}')
     plane = ClaimPlane(replicas=replicas, workers=workers,

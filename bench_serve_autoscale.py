@@ -7,16 +7,19 @@ Two parts, both CPU-only:
 
 **1. Fleet simulation** — the REAL autoscaler classes
 (``SLOAutoscaler`` + ``mix_policy.plan_mix`` vs
-``RequestRateAutoscaler``) driven over a virtual clock against a
-two-day diurnal trace with a recurring mid-decline burst and spot
-preemptions injected during the burst. Ground truth is a linear
-latency–concurrency fleet (p99 = base + slope*c, Little's law),
-provisioning takes PROVISION_DELAY simulated seconds, a warm resume
-RESUME_DELAY. Both arms see the identical trace, preemption schedule,
-hysteresis windows, and per-replica capacity. The reactive arm runs
+``RequestRateAutoscaler``) A/B'd through ``skypilot_tpu.sim`` (the
+r16 simkit, whose fleet model this bench's r11 hand-rolled trace loop
+was the ancestor of): each arm is a declarative Scenario sharing one
+two-day diurnal trace with a recurring mid-decline burst and a
+half-the-spot-fleet reclaim injected during each day's burst, run
+through the same ``run_scenario`` the tier-1 invariant tests use —
+same seed, so both arms see the identical Poisson arrival sequence.
+Ground truth is the sim's linear latency–concurrency fleet (p99 =
+base + slope*c, Little's law), provisioning takes PROVISION_DELAY
+simulated seconds, a warm resume RESUME_DELAY. The reactive arm runs
 at THREE tunings: exact (target_qps_per_replica = the SLO-optimal
 capacity computed from the ground-truth model — the cheapest possible
-reactive fleet, which spends ~30% of the trace out of SLO because
+reactive fleet, which spends much of the trace out of SLO because
 capacity always lands a provision-delay late) and 0.9/0.8 headroom
 (what an operator deploys to chase the SLO reactively). Acceptance:
 the predictive arm must beat every tuning on SLO-miss seconds and
@@ -24,7 +27,7 @@ every headroom tuning on replica-hours. Reported per arm: SLO-miss
 seconds (p99 over target, or no capacity while traffic flows),
 replica-hours and $-weighted replica-hours (spot vs on-demand rates;
 provisioning time is billed, WARM/stopped time is not), warm-pool
-resumes.
+resumes, and the run's reproducibility digest.
 
 **2. Warm resume vs cold provision (real stack)** — a scale-to-zero
 service on the fake cloud with ``inject_slow_create`` modelling slice
@@ -69,8 +72,13 @@ BURST_QPS = 400.0
 PREEMPT_AT = 2050.0           # reclaim half the spot fleet mid-burst
 
 
+SEED = 11                     # one seed: both arms see one arrival trace
+
+
 def lam(t: float) -> float:
-    """Offered load (qps): diurnal sine + the recurring burst."""
+    """Offered load (qps): diurnal sine + the recurring burst (used
+    for the warm-start fleet size; the scenario tenants below express
+    the same trace declaratively)."""
     phase = t % DAY_S
     base = 400.0 + 350.0 * math.sin(2 * math.pi * phase / DAY_S)
     if BURST_START <= phase < BURST_END:
@@ -78,41 +86,81 @@ def lam(t: float) -> float:
     return max(5.0, base)
 
 
-def fleet_point(qps: float, n_ready: int):
-    """(p99_ms, per-replica concurrency) of the ground-truth fleet."""
-    if n_ready <= 0:
-        return SATURATED_MS, 0.0
-    k = 1000.0 * n_ready / max(qps, 1e-9)
-    if k <= SLOPE_MS:
-        return SATURATED_MS, TARGET_P99_MS / SLOPE_MS * 3
-    c = BASE_MS / (k - SLOPE_MS)
-    return BASE_MS + SLOPE_MS * c, c
+def _arm_scenario(arm: str, headroom: float):
+    """One bench arm as a simkit Scenario: same trace, seed, fleet
+    physics, and fault timeline for every arm — only the ``service``
+    block (which autoscaler runs) differs."""
+    from skypilot_tpu.sim import Scenario
 
+    service = dict(min_replicas=1, max_replicas=24,
+                   upscale_delay_seconds=0.0,
+                   downscale_delay_seconds=120.0,
+                   base_ondemand_fallback_replicas=1)
+    autoscaler = {}
+    if arm == 'slo':
+        service.update(target_latency_p99_ms=TARGET_P99_MS,
+                       forecaster='seasonal',
+                       forecast_horizon_seconds=PROVISION_DELAY_S +
+                       TICK_S)
+        # The seasonal ring must match the compressed day.
+        autoscaler = {'warm_pool_size': 4, 'warm_ttl': DAY_S,
+                      'spot_wanted': True,
+                      'seasonal_period_s': DAY_S,
+                      'seasonal_buckets': 72}
+    else:
+        service.update(
+            target_qps_per_replica=CAPACITY_QPS * headroom)
+        # from_spec would wrap the OD floor in FallbackAutoscaler;
+        # this arm IS the plain reactive scaler.
+        autoscaler = {'kind': 'request_rate'}
 
-class SimReplica:
-    _next_id = [0]
-
-    def __init__(self, now, is_spot, is_fallback=False, delay=None):
-        SimReplica._next_id[0] += 1
-        self.replica_id = SimReplica._next_id[0]
-        self.is_spot = is_spot
-        self.is_fallback = is_fallback
-        self.ready_at = now + (PROVISION_DELAY_S if delay is None
-                               else delay)
-        self.state = 'provisioning'
-        self.warm_since = None
-        self.cloud = self.region = self.zone = None
-
-    @property
-    def status(self):
-        from skypilot_tpu.serve.serve_state import ReplicaStatus
-        return {
-            'provisioning': ReplicaStatus.PROVISIONING,
-            'ready': ReplicaStatus.READY,
-            'warm': ReplicaStatus.WARM,
-            'gone': ReplicaStatus.TERMINATED,
-            'preempted': ReplicaStatus.PREEMPTED,
-        }[self.state]
+    # Warm start both arms identically: the steady-state fleet for the
+    # t=0 offered load plus one replica of headroom (launching exactly
+    # at capacity saturates the fluid queue on tick one and starves
+    # the SLO arm's latency model of unclamped samples), already
+    # READY, first replica on-demand.
+    n0 = max(1, int(math.ceil(lam(0) / CAPACITY_QPS))) + 1
+    return Scenario.from_dict({
+        'name': f'serve_autoscale_{arm}_{headroom:g}',
+        'seed': SEED,
+        'duration_s': DAYS * DAY_S,
+        'tick_s': TICK_S,
+        'service': service,
+        'autoscaler': autoscaler,
+        'fleet': {
+            'initial_replicas': n0,
+            'base_latency_ms': BASE_MS,
+            'latency_slope_ms': SLOPE_MS,
+            'provision_delay_s': PROVISION_DELAY_S,
+            'resume_delay_s': RESUME_DELAY_S,
+            'spot': True,
+            'od_price_hr': OD_PRICE_HR,
+            # Both arms graded against the same ground-truth SLO line
+            # (the reactive arm's spec doesn't carry it).
+            'slo_target_p99_ms': TARGET_P99_MS,
+            'max_queue_per_replica': 200.0,
+            'domains': [{'cloud': 'fake', 'region': 'r1', 'zone': 'a',
+                         'price': SPOT_PRICE_HR}],
+        },
+        'tenants': [
+            {'name': 'diurnal',
+             'rate': {'shape': 'diurnal', 'base_qps': 400.0,
+                      'amplitude_qps': 350.0, 'period_s': DAY_S}},
+        ] + [
+            {'name': f'burst_day{day}',
+             'rate': {'shape': 'burst',
+                      'start_s': day * DAY_S + BURST_START,
+                      'end_s': day * DAY_S + BURST_END,
+                      'qps': BURST_QPS}}
+            for day in range(DAYS)
+        ],
+        # Once per day, mid-burst: reclaim half the live spot fleet.
+        'faults': [
+            {'at': day * DAY_S + PREEMPT_AT, 'kind': 'spot_reclaim',
+             'fraction': 0.5}
+            for day in range(DAYS)
+        ],
+    })
 
 
 def run_sim(arm: str, headroom: float = 1.0):
@@ -121,140 +169,23 @@ def run_sim(arm: str, headroom: float = 1.0):
     ``headroom`` only affects the reactive arm: its
     target_qps_per_replica is ``CAPACITY_QPS * headroom``. 1.0 is the
     SLO-optimal static tuning (cheapest possible reactive fleet — and
-    it spends 30% of the trace out of SLO, because capacity always
+    it spends much of the trace out of SLO, because capacity always
     arrives a provision-delay late); 0.9/0.8 are the headroom tunings
     an operator actually deploys to chase the SLO reactively."""
-    from skypilot_tpu.serve.autoscalers import (DecisionOp, LoadStats,
-                                                RequestRateAutoscaler)
-    from skypilot_tpu.serve.service_spec import ServiceSpec
-    from skypilot_tpu.serve.slo_autoscaler import SLOAutoscaler
+    from skypilot_tpu.sim import run_scenario
 
-    # Identical knobs both arms: on-demand floor of 1, no dynamic OD
-    # backfill (the chaos suite exercises that path; here it would
-    # bill double capacity through every transition in the predictive
-    # arm only and muddy the forecast-vs-reactive comparison).
-    common = dict(min_replicas=1, max_replicas=24,
-                  upscale_delay_seconds=0.0,
-                  downscale_delay_seconds=120.0,
-                  base_ondemand_fallback_replicas=1)
-    if arm == 'slo':
-        spec = ServiceSpec(target_latency_p99_ms=TARGET_P99_MS,
-                           forecaster='seasonal',
-                           forecast_horizon_seconds=PROVISION_DELAY_S +
-                           TICK_S,
-                           **common)
-        scaler = SLOAutoscaler(spec)
-        scaler.spot_wanted = True
-        scaler.warm_pool_size = 4
-        scaler.warm_ttl = DAY_S
-        # The seasonal ring must match the compressed day.
-        from skypilot_tpu.serve.forecast import SeasonalRingForecaster
-        scaler.forecaster = SeasonalRingForecaster(
-            period_seconds=DAY_S, buckets=72)
-    else:
-        spec = ServiceSpec(
-            target_qps_per_replica=CAPACITY_QPS * headroom, **common)
-        scaler = RequestRateAutoscaler(spec)
-
-    SimReplica._next_id[0] = 0
-    t = 0.0
-    scaler._clock = lambda: t
-    replicas = []
-    # Warm start both arms identically: the steady-state fleet for the
-    # t=0 offered load, already READY.
-    n0 = max(1, int(math.ceil(lam(0) / CAPACITY_QPS)))
-    for i in range(n0):
-        r = SimReplica(t, is_spot=(i > 0), delay=0)
-        r.state = 'ready'
-        replicas.append(r)
-    scaler._target = n0
-
-    miss_s = 0.0
-    dollar_hours = 0.0
-    replica_hours = 0.0
-    warm_hours = 0.0
-    warm_resumes = 0
-    preempted_total = 0
-    preempt_done_day = -1
-
-    while t < DAYS * DAY_S:
-        # Preemption schedule: once per day, mid-burst, reclaim half
-        # the READY spot fleet (identical in both arms).
-        day = int(t // DAY_S)
-        if (t % DAY_S) >= PREEMPT_AT and preempt_done_day < day:
-            preempt_done_day = day
-            spot_ready = [r for r in replicas
-                          if r.state == 'ready' and r.is_spot]
-            for r in spot_ready[:max(1, len(spot_ready) // 2)]:
-                r.state = 'preempted'
-                preempted_total += 1
-
-        for r in replicas:
-            if r.state == 'provisioning' and t >= r.ready_at:
-                r.state = 'ready'
-
-        ready = [r for r in replicas if r.state == 'ready']
-        qps = lam(t)
-        p99, conc = fleet_point(qps, len(ready))
-        latency_ms = {r.replica_id: p99 for r in ready}
-        stats = LoadStats(qps=qps, queue_length=conc * len(ready),
-                          window_seconds=TICK_S,
-                          replica_latency_ms=latency_ms)
-
-        live = [r for r in replicas if r.state != 'gone']
-        decisions = scaler.evaluate(stats, live)
-        for d in decisions:
-            if d.op == DecisionOp.SCALE_UP:
-                if d.resume_replica_id is not None:
-                    for r in replicas:
-                        if (r.replica_id == d.resume_replica_id and
-                                r.state == 'warm'):
-                            r.state = 'provisioning'
-                            r.warm_since = None
-                            r.ready_at = t + RESUME_DELAY_S
-                            warm_resumes += 1
-                            break
-                    continue
-                for _ in range(d.count):
-                    use_spot = d.use_spot
-                    if use_spot is None:
-                        use_spot = True      # task requested spot
-                    replicas.append(SimReplica(
-                        t, is_spot=use_spot, is_fallback=d.is_fallback))
-            else:
-                for r in replicas:
-                    if r.replica_id != d.replica_id or r.state in (
-                            'gone', 'preempted'):
-                        continue
-                    if d.warm:
-                        r.state = 'warm'
-                        r.warm_since = time.time()
-                    else:
-                        r.state = 'gone'
-                        r.warm_since = None
-
-        # Account the tick.
-        ready = [r for r in replicas if r.state == 'ready']
-        p99, _ = fleet_point(qps, len(ready))
-        if qps > 5.0 + 1e-9 or len(ready) == 0:
-            if p99 > TARGET_P99_MS + 1e-9:
-                miss_s += TICK_S
-        for r in replicas:
-            if r.state in ('ready', 'provisioning'):
-                price = SPOT_PRICE_HR if r.is_spot else OD_PRICE_HR
-                dollar_hours += price * TICK_S / 3600.0
-                replica_hours += TICK_S / 3600.0
-            elif r.state == 'warm':
-                warm_hours += TICK_S / 3600.0
-        t += TICK_S
-
+    report = run_scenario(_arm_scenario(arm, headroom))
+    s = report.summary
     return {
-        'slo_miss_seconds': round(miss_s, 1),
-        'dollar_weighted_replica_hours': round(dollar_hours, 2),
-        'replica_hours': round(replica_hours, 2),
-        'warm_pool_hours': round(warm_hours, 2),
-        'warm_resumes': warm_resumes,
-        'spot_preemptions_injected': preempted_total,
+        'slo_miss_seconds': s['slo_miss_seconds'],
+        'dollar_weighted_replica_hours':
+            s['dollar_weighted_replica_hours'],
+        'replica_hours': s['replica_hours'],
+        'warm_pool_hours': s['warm_pool_hours'],
+        'warm_resumes': s['warm_resumes'],
+        'spot_preemptions_injected': s['preemptions'],
+        'shed_requests': s['shed_total'],
+        'digest': report.digest(),
     }
 
 

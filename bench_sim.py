@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Bench: simkit throughput + the scenario-library sweep.
+
+(docs/simulation.md; artifact ``BENCH_sim_<suffix>.json``.)
+
+Three parts, all CPU-only and all on the virtual clock:
+
+* **kernel** — raw event-loop throughput: how many scheduled events
+  the discrete-event kernel retires per wall second (timer churn with
+  live cancellations, the pattern the fleet model produces).
+* **headline** — the acceptance number from the r16 issue: one full
+  10k-replica, multi-region, day-long ``region_outage`` scenario
+  (1440 controller ticks over 86400 simulated seconds, ~52B simulated
+  requests) through the REAL autoscaler stack, reported as wall
+  seconds and simulated-seconds-per-wall-second, with its invariant
+  results and reproducibility digest. Acceptance: < 60 s wall and
+  every invariant holds.
+* **library sweep** — every scenario in the in-tree library at 5%
+  scale (2% for the 10k headline scenario, which already ran at full
+  scale above): invariant results + digest each, plus a same-seed
+  re-run of one scenario proving bit-reproducibility inside the bench
+  artifact itself.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ.setdefault('SKYT_LOG_LEVEL', 'WARNING')
+
+# Full-scale acceptance bound (wall seconds) for the 10k-replica day.
+HEADLINE_SCENARIO = 'region_outage'
+HEADLINE_BUDGET_S = 60.0
+KERNEL_EVENTS = 200_000
+SWEEP_SCALE = 0.05
+HEADLINE_SWEEP_SCALE = 0.02
+
+
+def bench_kernel():
+    """Event-loop throughput: interleaved periodic timers, one-shots,
+    and cancellations — the mix a fleet tick schedule produces."""
+    from skypilot_tpu.sim.kernel import EventLoop
+
+    loop = EventLoop(seed=7)
+    fired = [0]
+
+    def on_tick():
+        fired[0] += 1
+        return fired[0] < KERNEL_EVENTS
+
+    # 16 interleaved periodic streams with co-prime-ish periods, plus
+    # a rolling window of one-shots where half get tombstoned.
+    for i in range(16):
+        loop.every(1.0 + 0.1 * i, on_tick)
+
+    def spawn_and_cancel():
+        handles = [loop.after(0.5 + 0.01 * j, on_tick)
+                   for j in range(8)]
+        for handle in handles[::2]:
+            handle.cancel()
+        return fired[0] < KERNEL_EVENTS
+
+    loop.every(2.0, spawn_and_cancel)
+    t0 = time.perf_counter()
+    loop.run()
+    wall = time.perf_counter() - t0
+    return {
+        'events_fired': loop.fired,
+        'wall_s': round(wall, 3),
+        'events_per_sec': round(loop.fired / max(wall, 1e-9)),
+    }
+
+
+def _run(scenario):
+    from skypilot_tpu.sim import run_scenario
+    t0 = time.perf_counter()
+    report = run_scenario(scenario)
+    wall = time.perf_counter() - t0
+    checks = report.check_invariants(scenario.invariants)
+    summary = report.summary
+    return {
+        'wall_s': round(wall, 2),
+        'sim_seconds_per_wall_second': round(
+            scenario.duration_s / max(wall, 1e-9)),
+        'digest': report.digest(),
+        'invariants_ok': all(c['ok'] for c in checks),
+        'invariants': checks,
+        'summary': {k: summary[k] for k in
+                    ('ticks', 'arrived_total', 'served_total',
+                     'shed_total', 'slo_miss_seconds', 'target_flips',
+                     'preemptions', 'final_ready')},
+    }
+
+
+def bench_headline():
+    from skypilot_tpu.sim import load_library
+    scenario = load_library(HEADLINE_SCENARIO)
+    result = _run(scenario)
+    result['scenario'] = HEADLINE_SCENARIO
+    result['initial_replicas'] = scenario.fleet['initial_replicas']
+    result['duration_s'] = scenario.duration_s
+    result['within_budget'] = result['wall_s'] < HEADLINE_BUDGET_S
+    return result
+
+
+def bench_library():
+    from skypilot_tpu.sim import library_names, load_library
+    out = {}
+    for name in library_names():
+        scale = (HEADLINE_SWEEP_SCALE if name == HEADLINE_SCENARIO
+                 else SWEEP_SCALE)
+        out[name] = _run(load_library(name).scale(scale))
+        out[name]['scale'] = scale
+    return out
+
+
+def bench_reproducibility():
+    """Same scenario + seed twice -> byte-identical logs; seed+1
+    diverges. The tier-1 suite asserts this too — repeating it here
+    stamps the guarantee into every bench artifact."""
+    from skypilot_tpu.sim import load_library, run_scenario
+    scenario = load_library('thundering_herd_wake').scale(SWEEP_SCALE)
+    a = run_scenario(scenario)
+    b = run_scenario(scenario)
+    c = run_scenario(scenario.with_overrides(seed=scenario.seed + 1))
+    return {
+        'scenario': 'thundering_herd_wake',
+        'digest': a.digest(),
+        'bit_identical': (a.digest() == b.digest() and
+                          a.event_log_bytes() == b.event_log_bytes()),
+        'seed_diverges': a.digest() != c.digest(),
+    }
+
+
+def main():
+    out = {'bench': 'sim', 'ts': time.time()}
+    out['kernel'] = bench_kernel()
+    out['headline_10k_day'] = bench_headline()
+    out['library'] = bench_library()
+    out['reproducibility'] = bench_reproducibility()
+
+    ok = (out['headline_10k_day']['within_budget'] and
+          out['headline_10k_day']['invariants_ok'] and
+          all(r['invariants_ok'] for r in out['library'].values()) and
+          out['reproducibility']['bit_identical'] and
+          out['reproducibility']['seed_diverges'])
+    out['acceptance'] = 'PASS' if ok else 'FAIL'
+    json.dump(out, sys.stdout, indent=1)
+    print()
+    head = out['headline_10k_day']
+    print(f"# acceptance: {out['acceptance']} — 10k-replica day in "
+          f"{head['wall_s']}s wall "
+          f"({head['sim_seconds_per_wall_second']}x real time), "
+          f"kernel {out['kernel']['events_per_sec']} events/s, "
+          f"{len(out['library'])} library scenarios invariant-clean, "
+          f"digests reproducible", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -177,6 +177,16 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_serve_autoscale.py \
   | tee "BENCH_serve_autoscale_${suffix}.json"
 echo "rc=$? -> BENCH_serve_autoscale_${suffix}.json" >&2
 
+# simkit bench: CPU-only — discrete-event kernel throughput, the full
+# 10k-replica day-long region_outage scenario through the real
+# autoscaler stack (acceptance: < 60 s wall, invariants hold), the
+# scenario-library sweep at small scale, and an in-artifact
+# bit-reproducibility proof (docs/simulation.md, numbers in PERF.md).
+echo "=== bench sim ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_sim.py \
+  | tee "BENCH_sim_${suffix}.json"
+echo "rc=$? -> BENCH_sim_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

@@ -1,0 +1,155 @@
+"""SKYT013 — ambient clock/RNG calls in sim-reachable modules.
+
+simkit (``skypilot_tpu/sim``) replays the real serve decision stack —
+autoscalers, mix policy, spot placer, LB policies — on a virtual clock
+and seeded RNG streams so a simulated day is bit-reproducible. That
+contract holds only while every module on the sim-reachable path draws
+time and randomness through an injectable parameter (``clock=``,
+``rng=``, ``self._clock``): one stray ``time.monotonic()`` or
+``random.random()`` re-couples the run to the host and silently breaks
+replay determinism (this is FoundationDB's simulation discipline — the
+whole fleet shares one logical clock and one seed).
+
+The pass flags direct ``time.time()`` / ``time.monotonic()`` (and the
+``_ns``/``perf_counter`` variants) and module-level ``random.*()``
+calls in the modules listed in :data:`SIM_REACHABLE` — the in-tree
+registry of what the simulator can reach. Sanctioned idioms pass:
+
+* the injectable-fallback ``if x is None: x = time.time()`` (the
+  parameter IS the injection point; the sim always supplies it);
+* ``random.Random(seed)`` — constructing a seeded instance is itself
+  deterministic (it is how ``SimRng`` mints child streams);
+* bare references without a call (``self._clock = time.monotonic`` as
+  an injectable default) — only *calls* couple to the host.
+
+Modules outside the registry can opt in with a ``# skylint:
+sim-reachable`` pragma anywhere in the file (the fixture tests use
+this; so should any new module the sim grows to reach).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Set
+
+from skypilot_tpu.lint import astutil
+from skypilot_tpu.lint.core import Context, Finding
+
+CODE = 'SKYT013'
+
+# Repo-relative path suffixes of everything a scenario run can reach.
+# Grow this list when the sim grows a new dependency; the module then
+# has to keep its clock/RNG injectable to stay lint-clean.
+SIM_REACHABLE = (
+    'serve/autoscalers.py',
+    'serve/slo_autoscaler.py',
+    'serve/mix_policy.py',
+    'serve/forecast.py',
+    'serve/spot_placer.py',
+    'serve/load_balancing_policies.py',
+    'serve/controller.py',
+    'utils/fault_injection.py',
+    'sim/kernel.py',
+    'sim/traffic.py',
+    'sim/scenario.py',
+    'sim/fleet.py',
+    'sim/faults.py',
+    'sim/report.py',
+    'sim/runner.py',
+)
+
+PRAGMA = 'skylint: sim-reachable'
+
+_CLOCK_CALLS = frozenset({
+    'time.time', 'time.monotonic', 'time.time_ns', 'time.monotonic_ns',
+    'time.perf_counter', 'time.perf_counter_ns',
+})
+# random.Random(seed) mints a deterministic child stream; everything
+# else on the module (`random.random`, `random.uniform`, ...) draws
+# from the shared ambient state. SystemRandom is never reproducible.
+_SEEDED_CTOR = 'random.Random'
+
+
+class SimReachDeterminismChecker:
+    code = CODE
+    name = 'ambient clock/RNG on a sim-reachable path'
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for mod in ctx.package_modules:
+            rel = mod.rel.replace(os.sep, '/')
+            if not (rel.endswith(SIM_REACHABLE) or PRAGMA in mod.source):
+                continue
+            imports = astutil.import_map(mod.tree)
+            sanctioned = _fallback_calls(mod.tree)
+            counts: Dict[str, int] = {}
+            for qual, call in _calls_with_scope(mod.tree):
+                name = astutil.resolve_call(call.func, imports)
+                if name is None:
+                    continue
+                if name in _CLOCK_CALLS:
+                    kind = 'clock'
+                elif (name.startswith('random.') and
+                      name != _SEEDED_CTOR and name.count('.') == 1):
+                    kind = 'rng'
+                else:
+                    continue
+                if id(call) in sanctioned:
+                    continue
+                slot = f'{qual}:{name}'
+                ordinal = counts.get(slot, 0)
+                counts[slot] = ordinal + 1
+                yield Finding(
+                    CODE, mod.rel, call.lineno,
+                    f'{name}() in sim-reachable scope {qual}: ambient '
+                    f'{"clock" if kind == "clock" else "RNG"} breaks '
+                    f'simulation replay — take an injectable '
+                    f'clock/rng parameter instead',
+                    slug=f'ambient-{kind}:{slot}:{ordinal}')
+
+
+def _fallback_calls(tree: ast.Module) -> Set[int]:
+    """ids of Call nodes inside the injectable-fallback idiom
+    ``if x is None: x = <call>()`` (x a name or self attribute)."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1 and
+                isinstance(test.ops[0], ast.Is) and
+                isinstance(test.comparators[0], ast.Constant) and
+                test.comparators[0].value is None):
+            continue
+        guard = astutil.dotted(test.left)
+        if guard is None:
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                target, value = stmt.target, stmt.value
+            else:
+                continue
+            if astutil.dotted(target) == guard and \
+                    isinstance(value, ast.Call):
+                out.add(id(value))
+    return out
+
+
+def _calls_with_scope(tree: ast.Module):
+    """Yield ``(enclosing_qualname, Call)`` pairs, qualname like
+    ``Class.method`` / ``fn`` / ``<module>`` — stable slug material."""
+    results: List = []
+
+    def walk(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                walk(child, stack + [child.name])
+            else:
+                if isinstance(child, ast.Call):
+                    results.append(('.'.join(stack) or '<module>', child))
+                walk(child, stack)
+
+    walk(tree, [])
+    return results

@@ -4,7 +4,7 @@ The linter is a plain AST pass (stdlib ``ast`` only — no third-party
 deps, importable on the leanest runner). Checkers live in the
 ``checks_*`` modules; each exposes a class with:
 
-* ``code``  — the stable finding code (``SKYT001``..``SKYT012``);
+* ``code``  — the stable finding code (``SKYT001``..``SKYT013``);
 * ``name``  — short human label;
 * ``run(ctx)`` — yields :class:`Finding`s over a :class:`Context`.
 
@@ -236,6 +236,7 @@ def all_checkers() -> List:
                                    checks_portability,
                                    checks_resources,
                                    checks_shared_state,
+                                   checks_simreach,
                                    checks_transactions,
                                    checks_wallclock)
     return [
@@ -251,6 +252,7 @@ def all_checkers() -> List:
         checks_transactions.TransactionHygieneChecker(),  # SKYT010
         checks_resources.ResourcePairingChecker(),  # SKYT011
         checks_shared_state.SharedStateChecker(),   # SKYT012
+        checks_simreach.SimReachDeterminismChecker(),   # SKYT013
     ]
 
 

@@ -76,13 +76,17 @@ class LoadStats:
         default_factory=dict)
 
 
+# WARM replicas are stopped clusters held for fast resume: they serve
+# no traffic and must not count toward the live fleet. One frozenset
+# so the per-replica check is a single membership test (this runs
+# twice per evaluate over the whole fleet).
+_NOT_ALIVE = serve_state.REPLICA_TERMINAL_STATUSES | {
+    ReplicaStatus.SHUTTING_DOWN, ReplicaStatus.WARM}
+
+
 def _alive(replicas: List[serve_state.ReplicaRecord]
            ) -> List[serve_state.ReplicaRecord]:
-    # WARM replicas are stopped clusters held for fast resume: they
-    # serve no traffic and must not count toward the live fleet.
-    return [r for r in replicas if not r.status.is_terminal() and
-            r.status not in (ReplicaStatus.SHUTTING_DOWN,
-                             ReplicaStatus.WARM)]
+    return [r for r in replicas if r.status not in _NOT_ALIVE]
 
 
 def victim_order(replicas: List[serve_state.ReplicaRecord],
@@ -110,8 +114,13 @@ class Autoscaler:
         self._history: collections.deque = collections.deque()
         # Monotonic so a wall-clock step (NTP slew, manual reset) can
         # neither bypass nor wedge the hysteresis delay; injectable so
-        # tests and the autoscale bench drive a virtual clock.
+        # tests, the autoscale bench, and simkit drive a virtual clock.
         self._clock = time.monotonic
+        # Wall clock for ages persisted as DB timestamps (warm_since /
+        # plan_mix TTL expiry) — a separate injection point because the
+        # sim must pin BOTH clocks to its virtual time, while in
+        # production they are genuinely different clocks.
+        self._wall_clock = time.time
 
     @classmethod
     def from_spec(cls, spec: ServiceSpec) -> 'Autoscaler':

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from skypilot_tpu import state
 from skypilot_tpu.serve import serve_state
@@ -51,10 +51,15 @@ def _replica_weight(record: serve_state.ReplicaRecord) -> float:
 
 class ServeController:
     def __init__(self, service_name: str, spec: ServiceSpec, task: Task,
-                 lb: Optional[LoadBalancer] = None) -> None:
+                 lb: Optional[LoadBalancer] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.service_name = service_name
         self.spec = spec
         self.lb = lb
+        # Injectable monotonic clock (simkit / tests): every pacing
+        # deadline in this controller reads it instead of the host
+        # clock, so a virtual-time driver controls when probes fire.
+        self._clock = clock
         self.manager = ReplicaManager(service_name, spec, task)
         self.autoscaler = Autoscaler.from_spec(spec)
         self._spot_wanted = any(r.use_spot for r in task.resources)
@@ -249,10 +254,10 @@ class ServeController:
         for record in serve_state.list_replicas(self.service_name,
                                                 include_terminal=False):
             self.manager.scale_down(record.replica_id)
-        deadline = time.monotonic() + 300
+        deadline = self._clock() + 300
         remaining = serve_state.list_replicas(self.service_name,
                                               include_terminal=False)
-        while remaining and time.monotonic() < deadline:
+        while remaining and self._clock() < deadline:
             time.sleep(min(POLL_SECONDS, 1.0))
             remaining = serve_state.list_replicas(self.service_name,
                                                   include_terminal=False)
@@ -359,7 +364,7 @@ class ServeController:
             except Exception:  # pylint: disable=broad-except
                 signal = None
         cursor = events.cursor(events.SERVE)
-        next_probe = time.monotonic()  # first pass runs immediately
+        next_probe = self._clock()  # first pass runs immediately
         while True:
             # Snapshot BEFORE the control reads: a `down`/spec write
             # landing mid-pass fires the wait instead of being adopted
@@ -390,16 +395,16 @@ class ServeController:
                         'standing down.', self.service_name,
                         record.controller_pid, os.getpid())
                     return
-                if time.monotonic() >= next_probe:
+                if self._clock() >= next_probe:
                     self.run_once()
-                    next_probe = time.monotonic() + POLL_SECONDS
+                    next_probe = self._clock() + POLL_SECONDS
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('Service %s: controller tick failed',
                                  self.service_name)
                 # A failed pass must not retry hot: push the next
                 # attempt a full poll interval out (matching the old
                 # sleep-per-iteration behavior).
-                next_probe = time.monotonic() + POLL_SECONDS
+                next_probe = self._clock() + POLL_SECONDS
                 if isinstance(e, resilience.transient_db_errors()):
                     # Bounded extra (jittered) backoff on DB faults:
                     # don't hammer a locked/flapping store at the poll
@@ -413,7 +418,7 @@ class ServeController:
             # Sleep until the next probe is due OR a serve-DB write
             # wakes us early (shutdown/spec-change reaction in ms, with
             # the probe cadence as the supervised fallback bound).
-            wait = max(0.05, next_probe - time.monotonic())
+            wait = max(0.05, next_probe - self._clock())
             cursor, _ = events.wait_for(events.SERVE, cursor,
                                         min(wait, POLL_SECONDS),
                                         external=signal,

@@ -131,9 +131,12 @@ class P2cEwmaPolicy(LoadBalancingPolicy):
     # first request lands and produces a real sample.
     _COLD_LATENCY = 1e-3
 
-    def __init__(self) -> None:
+    def __init__(self, rng: Optional[random.Random] = None) -> None:
         super().__init__()
-        self._rng = random.Random()
+        # Injectable so simkit (and seeded tests) make the two-choice
+        # sample sequence a pure function of the seed; defaults to the
+        # module-level source.
+        self._rng = rng if rng is not None else random
 
     def _cost(self, entry: ReplicaEntry, in_flight: Dict[int, int],
               latencies: Dict[int, float]) -> float:

@@ -128,7 +128,19 @@ class SLOAutoscaler(Autoscaler):
         if (observed_p99 is not None and num_ready > 0 and
                 num_ready >= max(1, self._target)):
             concurrency = stats.queue_length / num_ready
-            self.latency_model.observe(concurrency, observed_p99)
+            # Saturation guard (found by simkit's spot-reclaim drill):
+            # a fleet AT target can still be draining a backlog, where
+            # measured concurrency is queue-driven and far above the
+            # Little's-law value for the current arrival rate. Those
+            # points are queueing blow-up, not the base+slope*c line —
+            # one of them flattens the slope and collapses the
+            # required-fleet inversion (a metastable shrink-while-
+            # overloaded spiral). Fit only when concurrency is
+            # consistent with Little's law at the observed rate.
+            little_c = (stats.qps * observed_p99 / 1000.0 /
+                        max(num_ready, 1))
+            if concurrency <= 2.0 * little_c + 1.0:
+                self.latency_model.observe(concurrency, observed_p99)
         predicted_qps = self.forecaster.predict(now, self.horizon)
 
         if (self._last_traffic is None or stats.qps > _EPS_QPS or
@@ -195,7 +207,8 @@ class SLOAutoscaler(Autoscaler):
                         spot_wanted=self.spot_wanted,
                         latency_ms=stats.replica_latency_ms,
                         warm_pool_size=self.warm_pool_size,
-                        warm_ttl=self.warm_ttl)
+                        warm_ttl=self.warm_ttl,
+                        now_wall=self._wall_clock())
 
     def _predicted_p99_at(self, qps: float, n: int) -> Optional[float]:
         if n <= 0 or not self.latency_model.fitted:

@@ -355,15 +355,19 @@ def _interval(key: str, default: float) -> Callable[[], float]:
     return get
 
 
-def _telemetry_interval() -> float:
+def _telemetry_interval(rng=None) -> float:
     """Scrape cadence with fractional jitter: a fleet of API-server
     replicas on the same config must not pull every LB/replica
-    exposition in lockstep (the classic scrape thundering herd)."""
+    exposition in lockstep (the classic scrape thundering herd).
+    ``rng`` is injectable (seeded tests / simkit); defaults to the
+    module-level source."""
     import random
+    if rng is None:
+        rng = random
     base = env_registry.get_float('SKYT_TELEMETRY_INTERVAL')
     jitter = max(0.0, min(0.9,
                           env_registry.get_float('SKYT_TELEMETRY_JITTER')))
-    return max(0.25, base * random.uniform(1.0 - jitter, 1.0 + jitter))
+    return max(0.25, base * rng.uniform(1.0 - jitter, 1.0 + jitter))
 
 
 def build_daemons(server_id: Optional[str] = None,

@@ -1,0 +1,46 @@
+"""simkit: deterministic fleet-in-a-process simulation.
+
+A discrete-event harness where the serve control loop (SLO autoscaler +
+forecaster, mix policy, LB policy), replica lifecycle, provider model
+(provision latency, spot preemption), tenant traffic generators, and
+fault injection all share ONE virtual clock and ONE seeded RNG — so a
+10k-replica / multi-region day of traffic runs in seconds in a single
+process, bit-reproducible from a declarative scenario file.
+
+The pieces:
+
+* :mod:`skypilot_tpu.sim.kernel` — ``SimClock`` / ``SimRng`` /
+  ``EventLoop``: the event heap and the ``at``/``after``/``every``
+  primitives. No real threads on the hot path.
+* :mod:`skypilot_tpu.sim.scenario` — the declarative ``Scenario``
+  spec (YAML: fleet, tenant mixes, arrival processes, fault timeline,
+  invariant bounds) plus the in-tree scenario library.
+* :mod:`skypilot_tpu.sim.traffic` — arrival processes (diurnal,
+  burst, flood, constant) and seeded Poisson sampling.
+* :mod:`skypilot_tpu.sim.fleet` — the fleet model: drives the REAL
+  autoscaler classes (``SLOAutoscaler``/``RequestRateAutoscaler`` +
+  ``mix_policy.plan_mix`` + the registered LB policies) against a
+  ground-truth latency-concurrency fleet with provision/resume delays
+  and domain-correlated preemptions.
+* :mod:`skypilot_tpu.sim.faults` — the scenario fault timeline
+  (region outage, correlated spot reclamation, provision slowdown,
+  recorded ``SKYT_FAULT_SPEC`` replay).
+* :mod:`skypilot_tpu.sim.report` — ``SimReport``: canonical event
+  log (digestable), metric stream (exportable into the r14 telemetry
+  TSDB so sim output is queryable via ``/api/metrics/query``), and
+  per-scenario invariant evaluation.
+* :mod:`skypilot_tpu.sim.runner` — ``run_scenario()`` and the
+  ``python -m skypilot_tpu.sim`` CLI.
+
+Determinism contract (docs/simulation.md): a run is a pure function of
+``(scenario file, seed)``. Identical inputs produce byte-identical
+event logs and metric series; different seeds diverge.
+"""
+from skypilot_tpu.sim.kernel import EventLoop, SimClock, SimRng
+from skypilot_tpu.sim.report import SimReport
+from skypilot_tpu.sim.runner import run_scenario
+from skypilot_tpu.sim.scenario import (Scenario, library_names,
+                                       load_library)
+
+__all__ = ['EventLoop', 'Scenario', 'SimClock', 'SimReport', 'SimRng',
+           'library_names', 'load_library', 'run_scenario']

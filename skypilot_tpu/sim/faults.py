@@ -1,0 +1,175 @@
+"""Scenario fault timeline: virtual-time failure injection.
+
+Fault kinds (scenario ``faults:`` entries, all with an ``at`` virtual
+time):
+
+* ``region_outage`` — every replica in ``region`` dies, the region is
+  unplaceable for ``duration_s`` (in-flight provisions into it fail
+  when they land), and each of its domains takes a preemption
+  cooldown.
+* ``spot_reclaim`` — correlated spot reclamation: ``fraction`` of the
+  live spot replicas in ``zone`` (or the whole fleet when no zone) are
+  preempted at one instant, sampled from the ``faults`` RNG stream.
+* ``provision_slowdown`` — cold-provision latency multiplied by
+  ``factor`` for ``duration_s`` (capacity crunch: the autoscaler's
+  horizon is suddenly too short).
+* ``rollout`` — a weight rollout: rolling restart of the fleet in
+  ``batch``-sized waves every ``interval_s``, each wave NOT READY for
+  ``restart_s`` (generalizes the weight-rollout-during-surge drill).
+* ``fault_spec`` — replay a recorded ``SKYT_FAULT_SPEC`` value for
+  ``duration_s``: the sim's controller tick runs
+  ``fault_injection.inject('sim.controller.tick')``, so a clause like
+  ``sim.controller.tick:OperationalError:p=0.3:seed=7`` crashes a
+  deterministic subsequence of ticks — the same chaos grammar the
+  real control plane is drilled with, on the virtual clock.
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List
+
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.utils import fault_injection
+
+__all__ = ['install_faults']
+
+
+def install_faults(fleet: 'FleetSim', faults: List[Dict]) -> None:
+    """Schedule every scenario fault on the fleet's event loop."""
+    for fault in faults:
+        kind = fault['kind']
+        at = float(fault['at'])
+        if kind == 'region_outage':
+            _install_region_outage(fleet, at, fault)
+        elif kind == 'spot_reclaim':
+            _install_spot_reclaim(fleet, at, fault)
+        elif kind == 'provision_slowdown':
+            _install_provision_slowdown(fleet, at, fault)
+        elif kind == 'rollout':
+            _install_rollout(fleet, at, fault)
+        elif kind == 'fault_spec':
+            _install_fault_spec(fleet, at, fault)
+        else:  # scenario validation already rejected this
+            raise ValueError(f'unknown fault kind {kind!r}')
+
+
+def _install_region_outage(fleet, at: float, fault: Dict) -> None:
+    region = fault['region']
+    duration = float(fault.get('duration_s', 3600.0))
+
+    def start() -> None:
+        fleet.down_regions.add(region)
+        killed = 0
+        for record in fleet.replicas:
+            if record.region == region and \
+                    not record.status.is_terminal():
+                fleet.preempt(record, 'region_outage')
+                killed += 1
+        for domain in fleet.domains:
+            if domain.region == region:
+                fleet.placer.handle_preemption(domain)
+        fleet.report.event(fleet.clock.now(), 'region_outage_start',
+                           region=region, killed=killed)
+
+    def end() -> None:
+        fleet.down_regions.discard(region)
+        fleet.report.event(fleet.clock.now(), 'region_outage_end',
+                           region=region)
+
+    fleet.loop.at(at, start)
+    fleet.loop.at(at + duration, end)
+
+
+def _install_spot_reclaim(fleet, at: float, fault: Dict) -> None:
+    zone = fault.get('zone')
+    fraction = float(fault.get('fraction', 0.5))
+
+    def reclaim() -> None:
+        rng = fleet.loop.rng.stream('faults')
+        victims = [r for r in fleet.replicas
+                   if r.is_spot and not r.status.is_terminal() and
+                   r.status != ReplicaStatus.WARM and
+                   (zone is None or r.zone == zone)]
+        count = int(math.ceil(len(victims) * fraction))
+        # Deterministic sample: stable order in, seeded draw out.
+        victims.sort(key=lambda r: r.replica_id)
+        chosen = rng.sample(victims, count) if count < len(victims) \
+            else victims
+        for record in chosen:
+            fleet.preempt(record, 'spot_reclaim')
+        fleet.report.event(fleet.clock.now(), 'spot_reclaim',
+                           zone=zone or '*', reclaimed=len(chosen))
+
+    fleet.loop.at(at, reclaim)
+
+
+def _install_provision_slowdown(fleet, at: float, fault: Dict) -> None:
+    factor = float(fault.get('factor', 4.0))
+    duration = float(fault.get('duration_s', 3600.0))
+
+    def start() -> None:
+        fleet._provision_factor = factor
+        fleet.report.event(fleet.clock.now(), 'provision_slowdown_start',
+                           factor=factor)
+
+    def end() -> None:
+        fleet._provision_factor = 1.0
+        fleet.report.event(fleet.clock.now(), 'provision_slowdown_end')
+
+    fleet.loop.at(at, start)
+    fleet.loop.at(at + duration, end)
+
+
+def _install_rollout(fleet, at: float, fault: Dict) -> None:
+    batch = int(fault.get('batch', 1))
+    interval = float(fault.get('interval_s', 60.0))
+    restart_s = float(fault.get('restart_s', 30.0))
+    pending: List[int] = []
+
+    def start() -> None:
+        # Snapshot the fleet to roll: replicas launched later already
+        # run the new weights.
+        pending.extend(sorted(
+            r.replica_id for r in fleet.replicas
+            if r.status == ReplicaStatus.READY))
+        fleet.report.event(fleet.clock.now(), 'rollout_start',
+                           replicas=len(pending))
+        wave()
+
+    def wave() -> None:
+        if not pending:
+            fleet.report.event(fleet.clock.now(), 'rollout_done')
+            return
+        by_id = {r.replica_id: r for r in fleet.replicas}
+        rolled = 0
+        while pending and rolled < batch:
+            record = by_id.get(pending.pop(0))
+            if record is None or \
+                    record.status != ReplicaStatus.READY:
+                continue    # preempted/scaled down since the snapshot
+            record.status = ReplicaStatus.STARTING
+            record.ready_eta = fleet.clock.now() + restart_s
+            rolled += 1
+        fleet.loop.after(interval, wave)
+
+    fleet.loop.at(at, start)
+
+
+def _install_fault_spec(fleet, at: float, fault: Dict) -> None:
+    spec = fault['spec']
+    duration = float(fault.get('duration_s', 600.0))
+
+    def start() -> None:
+        os.environ[fault_injection.SPEC_ENV] = spec
+        fault_injection.reset()
+        fleet.report.event(fleet.clock.now(), 'fault_spec_start',
+                           spec=spec)
+
+    def end() -> None:
+        os.environ.pop(fault_injection.SPEC_ENV, None)
+        fault_injection.reset()
+        fleet.report.event(fleet.clock.now(), 'fault_spec_end')
+
+    fleet.loop.at(at, start)
+    fleet.loop.at(at + duration, end)

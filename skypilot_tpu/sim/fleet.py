@@ -1,0 +1,512 @@
+"""Fleet model: the REAL serve decision stack over a virtual fleet.
+
+This is deliberately not a mock of the autoscaler — it IS the
+autoscaler. Each controller tick the sim builds ``LoadStats`` from the
+ground-truth fleet and calls ``Autoscaler.evaluate`` (which for the
+SLO arm runs the real forecaster, latency model, hysteresis window,
+and ``mix_policy.plan_mix``), then applies the returned ``Decision``
+list to simulated replicas whose lifecycle (provision delay, warm
+resume, preemption, readiness) plays out on the virtual clock. The
+r11 autoscale bench's hand-rolled trace loop is this model's direct
+ancestor (and now a caller — see ``bench_serve_autoscale.py``).
+
+Ground truth is the same linear latency–concurrency fleet the bench
+used: one replica's p99 is ``base + slope*c`` with Little's-law
+concurrency, capacity per replica at the SLO boundary has the closed
+form ``1000*(target-base)/(slope*target)``, and demand above fleet
+capacity accumulates in a fluid queue whose conservation law
+(``arrived == served + queued + shed``) is asserted every tick.
+
+Everything random draws from named :class:`~.kernel.SimRng` streams
+(``traffic.<tenant>``, ``faults``, ``lb``), so runs are bit-
+reproducible and adding a consumer never perturbs the others.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from skypilot_tpu.serve.autoscalers import (Autoscaler, DecisionOp,
+                                            LoadStats)
+from skypilot_tpu.serve.serve_state import (REPLICA_TERMINAL_STATUSES,
+                                            ReplicaStatus)
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.spot_placer import Domain, DomainSpotPlacer
+from skypilot_tpu.sim import traffic as traffic_lib
+from skypilot_tpu.sim.kernel import EventLoop
+from skypilot_tpu.sim.report import SimReport
+from skypilot_tpu.sim.scenario import Scenario
+
+# Price defaults for the $-weighted replica-hours metric (override per
+# scenario via fleet.od_price_hr / per-domain `price`).
+OD_PRICE_HR = 4.0
+
+# Behavioral LB probe bounds: the fluid model owns throughput; the
+# probe only exercises the real policy's pick distribution, so it runs
+# over a bounded replica subsample and a bounded request sample.
+_LB_REPLICA_SAMPLE = 128
+_LB_REQUEST_SAMPLE = 32
+
+# Tick-loop status sets: membership tests, not method calls — these
+# run once per replica per tick across a 10k-replica fleet.
+_PENDING = frozenset({ReplicaStatus.PROVISIONING, ReplicaStatus.STARTING})
+_BILLABLE = frozenset({ReplicaStatus.READY, ReplicaStatus.PROVISIONING,
+                       ReplicaStatus.STARTING})
+
+
+class SimReplicaRecord:
+    """Duck-types ``serve_state.ReplicaRecord`` for everything the
+    decision layer touches (``_alive``/``victim_order``/``plan_mix``
+    are attribute-only)."""
+
+    __slots__ = ('service_name', 'replica_id', 'cluster_name', 'status',
+                 'endpoint', 'is_spot', 'is_fallback', 'zone',
+                 'launched_at', 'ready_at', 'consecutive_failures',
+                 'lb_ewma_ms', 'lb_ejected', 'lb_ejected_until', 'cloud',
+                 'region', 'warm_since', 'ready_eta', '_domain')
+
+    def __init__(self, replica_id: int, now: float, *, is_spot: bool,
+                 is_fallback: bool = False,
+                 domain: Optional[Domain] = None,
+                 provision_delay: float = 0.0) -> None:
+        self.service_name = 'sim'
+        self.replica_id = replica_id
+        self.cluster_name = f'sim-{replica_id}'
+        self.status = (ReplicaStatus.READY if provision_delay <= 0
+                       else ReplicaStatus.PROVISIONING)
+        self.endpoint = None
+        self.is_spot = is_spot
+        self.is_fallback = is_fallback
+        self.cloud = domain.cloud if domain else None
+        self.region = domain.region if domain else None
+        self.zone = domain.zone if domain else None
+        self.launched_at = now
+        self.ready_at = now if provision_delay <= 0 else None
+        self.consecutive_failures = 0
+        self.lb_ewma_ms = None
+        self.lb_ejected = False
+        self.lb_ejected_until = None
+        self.warm_since = None
+        # Virtual time at which the pending provision/resume lands.
+        self.ready_eta = now + provision_delay
+        self._domain = domain
+
+    def domain(self) -> Domain:
+        if self._domain is None:
+            self._domain = Domain(self.cloud, self.region, self.zone)
+        return self._domain
+
+
+def fleet_point(qps: float, n_ready: int, base_ms: float,
+                slope_ms: float, saturated_ms: float):
+    """(p99_ms, per-replica concurrency) of the ground-truth fleet at
+    offered load ``qps`` — the bench's closed form, parameterized."""
+    if n_ready <= 0:
+        return saturated_ms, 0.0
+    k = 1000.0 * n_ready / max(qps, 1e-9)
+    if k <= slope_ms:
+        return saturated_ms, saturated_ms / slope_ms
+    c = base_ms / (k - slope_ms)
+    return base_ms + slope_ms * c, c
+
+
+class FleetSim:
+    """One scenario's fleet, wired onto an :class:`EventLoop`.
+
+    ``install()`` schedules the controller tick and the fault
+    timeline; the caller then drives ``loop.run_until(duration)``.
+    """
+
+    def __init__(self, scenario: Scenario, loop: EventLoop,
+                 report: SimReport) -> None:
+        self.scenario = scenario
+        self.loop = loop
+        self.clock = loop.clock
+        self.report = report
+        fleet = scenario.fleet
+        self.base_ms = float(fleet['base_latency_ms'])
+        self.slope_ms = float(fleet['latency_slope_ms'])
+        self.provision_delay_s = float(fleet['provision_delay_s'])
+        self.resume_delay_s = float(fleet['resume_delay_s'])
+        self.spot = bool(fleet['spot'])
+        self.max_queue_per_replica = float(fleet['max_queue_per_replica'])
+        self.od_price_hr = float(fleet.get('od_price_hr', OD_PRICE_HR))
+
+        self.spec = ServiceSpec(**scenario.service)
+        # Ground-truth SLO the sim GRADES against (slo_miss_seconds).
+        # Defaults to the control target; fleet.slo_target_p99_ms lets
+        # an A/B arm whose autoscaler doesn't know the SLO (e.g. a
+        # request_rate bench arm) still be graded on the same line.
+        slo_target = fleet.get('slo_target_p99_ms',
+                               self.spec.target_latency_p99_ms)
+        self.slo_target_ms = (float(slo_target)
+                              if slo_target is not None else None)
+        cap = fleet.get('capacity_qps_per_replica')
+        if cap is None:
+            if self.slo_target_ms is None:
+                raise ValueError(
+                    'scenario needs fleet.capacity_qps_per_replica, '
+                    'fleet.slo_target_p99_ms, or '
+                    'service.target_latency_p99_ms to size capacity')
+            cap = 1000.0 * (self.slo_target_ms - self.base_ms) / (
+                self.slope_ms * self.slo_target_ms)
+        self.capacity_qps = float(cap)
+        self.saturated_ms = 4.0 * (
+            self.slo_target_ms if self.slo_target_ms is not None else
+            self.base_ms + self.slope_ms * self.max_queue_per_replica)
+
+        # -- placement domains ----------------------------------------
+        self.domains: List[Domain] = []
+        self.domain_price: Dict[Domain, float] = {}
+        for entry in fleet['domains']:
+            domain = Domain(entry.get('cloud'), entry['region'],
+                            entry['zone'])
+            self.domains.append(domain)
+            self.domain_price[domain] = float(entry.get('price', 1.0))
+        self.placer = DomainSpotPlacer(self.domains,
+                                       clock=self.clock.now)
+        self.down_regions: set = set()
+        self._od_rr = 0
+
+        # -- the real decision stack ----------------------------------
+        overrides = scenario.to_dict().get('autoscaler', {})
+        if 'kind' in overrides:
+            # Force a registry arm (bench A/B runs pit e.g. the plain
+            # request_rate scaler against what from_spec would pick).
+            from skypilot_tpu.utils.registry import AUTOSCALER_REGISTRY
+            self.scaler = AUTOSCALER_REGISTRY.get(
+                overrides['kind'])(self.spec)
+        else:
+            self.scaler = Autoscaler.from_spec(self.spec)
+        # Both the monotonic hysteresis clock and the wall clock the
+        # warm-pool TTL ages against are the ONE virtual clock.
+        self.scaler._clock = self.clock.now
+        self.scaler._wall_clock = self.clock.now
+        for knob in ('warm_pool_size', 'warm_ttl', 'horizon',
+                     'idle_seconds', 'spot_wanted'):
+            if knob in overrides and hasattr(self.scaler, knob):
+                setattr(self.scaler, knob, overrides[knob])
+        if hasattr(self.scaler, 'spot_wanted') and \
+                'spot_wanted' not in overrides:
+            self.scaler.spot_wanted = self.spot
+        if 'seasonal_period_s' in overrides and \
+                hasattr(self.scaler, 'forecaster'):
+            from skypilot_tpu.serve.forecast import SeasonalRingForecaster
+            self.scaler.forecaster = SeasonalRingForecaster(
+                period_seconds=float(overrides['seasonal_period_s']),
+                buckets=int(overrides.get('seasonal_buckets', 72)))
+
+        # -- LB behavioral probe --------------------------------------
+        self.lb_policy = None
+        if scenario.lb_policy:
+            from skypilot_tpu.serve import load_balancing_policies as lbp
+            self.lb_policy = lbp.LoadBalancingPolicy.make(
+                scenario.lb_policy)
+            if hasattr(self.lb_policy, '_rng'):
+                self.lb_policy._rng = loop.rng.stream('lb')
+        self.lb_max_share = 0.0
+
+        # -- tenants ---------------------------------------------------
+        self.tenants = []
+        for tenant in scenario.tenants:
+            self.tenants.append(
+                (tenant['name'], traffic_lib.make_rate(tenant['rate']),
+                 loop.rng.stream(f'traffic.{tenant["name"]}')))
+
+        # -- fleet state ----------------------------------------------
+        self.replicas: List[SimReplicaRecord] = []
+        self._next_id = 0
+        initial = int(fleet['initial_replicas'])
+        for index in range(initial):
+            record = self._new_replica(
+                is_spot=self.spot and index >= (
+                    self.spec.base_ondemand_fallback_replicas),
+                provision_delay=0.0)
+            record.status = ReplicaStatus.READY
+        if initial:
+            self.scaler._target = initial
+
+        # -- counters --------------------------------------------------
+        self.queue = 0.0
+        self.arrived_total = 0
+        self.served_total = 0.0
+        self.shed_total = 0.0
+        self.slo_miss_s = 0.0
+        self.replica_hours = 0.0
+        self.dollar_hours = 0.0
+        self.warm_hours = 0.0
+        self.warm_resumes = 0
+        self.preemptions = 0
+        self.provision_failures = 0
+        self.controller_faults = 0
+        self.target_flips = 0
+        self._last_target = self.scaler._target
+        self._last_direction = 0
+        self.ticks = 0
+        self._provision_factor = 1.0
+
+    # -- wiring --------------------------------------------------------
+
+    def install(self) -> None:
+        from skypilot_tpu.sim.faults import install_faults
+        self.loop.every(self.scenario.tick_s, self.tick)
+        install_faults(self, self.scenario.faults)
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def _new_replica(self, *, is_spot: bool, is_fallback: bool = False,
+                     provision_delay: Optional[float] = None
+                     ) -> SimReplicaRecord:
+        self._next_id += 1
+        now = self.clock.now()
+        if provision_delay is None:
+            provision_delay = self.provision_delay_s * \
+                self._provision_factor
+        domain = self._place(is_spot)
+        record = SimReplicaRecord(self._next_id, now, is_spot=is_spot,
+                                  is_fallback=is_fallback, domain=domain,
+                                  provision_delay=provision_delay)
+        self.replicas.append(record)
+        return record
+
+    def _place(self, is_spot: bool) -> Optional[Domain]:
+        up = [d for d in self.domains
+              if d.region not in self.down_regions]
+        if not up:
+            up = self.domains
+        if is_spot:
+            def price(domain: Domain) -> float:
+                if domain.region in self.down_regions:
+                    return 1e18     # still selectable, never preferred
+                return self.domain_price.get(domain, float('inf'))
+            return self.placer.select(price)
+        choice = up[self._od_rr % len(up)]
+        self._od_rr += 1
+        return choice
+
+    def preempt(self, record: SimReplicaRecord, reason: str) -> None:
+        record.status = ReplicaStatus.PREEMPTED
+        record.warm_since = None
+        self.preemptions += 1
+        self.placer.handle_preemption(record.domain())
+
+    # -- the controller tick -------------------------------------------
+
+    def tick(self) -> None:
+        t = self.clock.now()
+        dt = self.scenario.tick_s
+        self.ticks += 1
+
+        # 1. readiness: pending provisions/resumes land (or fail, if
+        # their region went down while they were in flight). One pass
+        # also collects the READY set — the fleet scan is the hot loop.
+        ready = []
+        for record in self.replicas:
+            status = record.status
+            if status in _PENDING and t >= record.ready_eta:
+                if record.region in self.down_regions:
+                    record.status = ReplicaStatus.FAILED_PROVISION
+                    self.provision_failures += 1
+                    continue
+                record.status = status = ReplicaStatus.READY
+                record.ready_at = t
+            if status is ReplicaStatus.READY:
+                ready.append(record)
+        n_ready = len(ready)
+
+        # 2. arrivals (seeded Poisson per tenant).
+        arrived = 0
+        offered_qps = 0.0
+        for _name, rate, rng in self.tenants:
+            lam = rate(t)
+            offered_qps += lam
+            arrived += traffic_lib.poisson_count(rng, lam * dt)
+        self.arrived_total += arrived
+
+        # 3. fluid queue: serve up to capacity, shed past the cap.
+        capacity = n_ready * self.capacity_qps * dt
+        backlog = self.queue + arrived
+        served = min(backlog, capacity)
+        self.queue = backlog - served
+        queue_cap = self.max_queue_per_replica * max(n_ready, 1)
+        shed = max(0.0, self.queue - queue_cap)
+        self.queue -= shed
+        self.served_total += served
+        self.shed_total += shed
+        conservation = (self.arrived_total -
+                        (self.served_total + self.queue +
+                         self.shed_total))
+        if abs(conservation) > 1e-6 * max(1.0, self.arrived_total):
+            raise AssertionError(
+                f'request conservation violated at t={t}: '
+                f'residual {conservation}')
+
+        # 4. ground-truth latency; queue backlog saturates the fleet.
+        demand_qps = arrived / dt
+        p99, conc = fleet_point(demand_qps, n_ready, self.base_ms,
+                                self.slope_ms, self.saturated_ms)
+        if self.queue > 1.0:
+            p99 = self.saturated_ms
+            conc = self.queue / max(n_ready, 1)
+
+        target_ms = self.slo_target_ms
+        if target_ms is not None and \
+                (demand_qps > 1e-9 or (self.queue > 1.0)) and \
+                (p99 > target_ms + 1e-9 or n_ready == 0):
+            self.slo_miss_s += dt
+
+        # 5. the real decision stack (may be felled by injected chaos —
+        # a crashed controller tick skips decisions, not the world).
+        latency_ms = {r.replica_id: p99 for r in ready}
+        stats = LoadStats(qps=demand_qps,
+                          queue_length=conc * n_ready,
+                          window_seconds=dt,
+                          replica_latency_ms=latency_ms)
+        live = [r for r in self.replicas
+                if r.status not in REPLICA_TERMINAL_STATUSES]
+        try:
+            from skypilot_tpu.utils import fault_injection
+            fault_injection.inject('sim.controller.tick')
+            decisions = self.scaler.evaluate(stats, live)
+        except Exception as exc:  # injected chaos only
+            self.controller_faults += 1
+            self.report.event(t, 'controller_fault',
+                              error=type(exc).__name__)
+            decisions = []
+        self._apply(decisions, t)
+
+        target = self.scaler._target
+        if target != self._last_target:
+            direction = 1 if target > self._last_target else -1
+            if direction == -self._last_direction:
+                self.target_flips += 1
+            self._last_direction = direction
+            self._last_target = target
+
+        # 6. accounting + compaction in one pass (terminal rows drop
+        # out so the scan stays O(live fleet) across a churny day).
+        billed = 0
+        warm = 0
+        dollars = 0.0
+        survivors = []
+        for record in self.replicas:
+            status = record.status
+            if status in REPLICA_TERMINAL_STATUSES:
+                continue
+            survivors.append(record)
+            if status in _BILLABLE:
+                billed += 1
+                if record.is_spot:
+                    dollars += self.domain_price.get(
+                        record._domain, 1.0)
+                else:
+                    dollars += self.od_price_hr
+            elif status is ReplicaStatus.WARM:
+                # Stopped, unbilled — tracked so cost benches can show
+                # warm-pool occupancy next to paid replica-hours.
+                warm += 1
+        self.replicas = survivors
+        self.replica_hours += billed * dt / 3600.0
+        self.dollar_hours += dollars * dt / 3600.0
+        self.warm_hours += warm * dt / 3600.0
+
+        # 7. behavioral LB probe (bounded sample through the real
+        # policy; the fluid model owns throughput).
+        if self.lb_policy is not None and n_ready > 0 and arrived > 0:
+            self._lb_probe(ready, min(arrived, _LB_REQUEST_SAMPLE))
+
+        # 8. emit the tick's metric points.
+        report = self.report
+        report.metric('sim_qps_offered', t, offered_qps)
+        report.metric('sim_qps_arrived', t, demand_qps)
+        report.metric('sim_ready_replicas', t, float(n_ready))
+        report.metric('sim_target_replicas', t, float(target))
+        report.metric('sim_p99_ms', t, p99)
+        report.metric('sim_queue', t, self.queue)
+        report.metric('sim_shed_total', t, self.shed_total)
+        report.metric('sim_slo_miss_seconds', t, self.slo_miss_s)
+
+    def _apply(self, decisions, t: float) -> None:
+        ups = downs = warm_stops = resumes = 0
+        by_id = None
+        for decision in decisions:
+            if decision.op == DecisionOp.SCALE_UP:
+                if decision.resume_replica_id is not None:
+                    if by_id is None:
+                        by_id = {r.replica_id: r for r in self.replicas}
+                    record = by_id.get(decision.resume_replica_id)
+                    if record is not None and \
+                            record.status == ReplicaStatus.WARM:
+                        record.status = ReplicaStatus.PROVISIONING
+                        record.warm_since = None
+                        record.ready_eta = t + self.resume_delay_s
+                        self.warm_resumes += 1
+                        resumes += 1
+                    continue
+                for _ in range(max(1, decision.count)):
+                    use_spot = decision.use_spot
+                    if use_spot is None:
+                        use_spot = self.spot
+                    self._new_replica(is_spot=use_spot,
+                                      is_fallback=decision.is_fallback)
+                    ups += 1
+            else:
+                if by_id is None:
+                    by_id = {r.replica_id: r for r in self.replicas}
+                record = by_id.get(decision.replica_id)
+                if record is None or record.status.is_terminal():
+                    continue
+                if decision.warm:
+                    record.status = ReplicaStatus.WARM
+                    record.warm_since = t
+                    warm_stops += 1
+                else:
+                    record.status = ReplicaStatus.TERMINATED
+                    record.warm_since = None
+                    downs += 1
+        if ups or downs or warm_stops or resumes:
+            self.report.event(t, 'decisions', up=ups, down=downs,
+                              warm_stop=warm_stops, resume=resumes)
+
+    def _lb_probe(self, ready: List[SimReplicaRecord],
+                  n_requests: int) -> None:
+        sample = ready[:_LB_REPLICA_SAMPLE]
+        self.lb_policy.set_replicas(
+            [(r.replica_id, '', 1.0) for r in sample])
+        in_flight: Dict[int, int] = {}
+        picks: Dict[int, int] = {}
+        for _ in range(n_requests):
+            entry = self.lb_policy.select(in_flight)
+            if entry is None:
+                break
+            rid = entry[0]
+            in_flight[rid] = in_flight.get(rid, 0) + 1
+            picks[rid] = picks.get(rid, 0) + 1
+        if picks:
+            share = max(picks.values()) * len(sample) / max(
+                1, sum(picks.values()))
+            self.lb_max_share = max(self.lb_max_share, share)
+
+    # -- results -------------------------------------------------------
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            'ticks': self.ticks,
+            'arrived_total': self.arrived_total,
+            'served_total': round(self.served_total, 1),
+            'shed_total': round(self.shed_total, 1),
+            'final_queue': round(self.queue, 1),
+            'slo_miss_seconds': round(self.slo_miss_s, 1),
+            'replica_hours': round(self.replica_hours, 2),
+            'dollar_weighted_replica_hours': round(self.dollar_hours, 2),
+            'warm_pool_hours': round(self.warm_hours, 2),
+            'warm_resumes': self.warm_resumes,
+            'preemptions': self.preemptions,
+            'provision_failures': self.provision_failures,
+            'controller_faults': self.controller_faults,
+            'target_flips': self.target_flips,
+            'final_ready': sum(
+                1 for r in self.replicas
+                if r.status == ReplicaStatus.READY),
+            'final_target': self.scaler._target,
+            'lb_max_share': round(self.lb_max_share, 2),
+        }

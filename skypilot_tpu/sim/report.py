@@ -1,0 +1,166 @@
+"""SimReport: canonical event log, metric stream, invariant verdicts.
+
+Determinism is *proven* here, not assumed: the event log and metric
+stream serialize to canonical JSON (sorted keys, fixed float
+formatting, no timestamps from the host), so two runs of the same
+scenario+seed produce byte-identical bytes and equal sha256 digests —
+the property ``tests/test_sim.py`` pins.
+
+``to_store()`` exports the metric stream into a
+:class:`skypilot_tpu.utils.tsdb.TSDB` directory at the sim's VIRTUAL
+timestamps. Point an API server's ``SKYT_TELEMETRY_DIR`` at that
+directory and the run is queryable through the production
+``/api/metrics/query`` surface — one Grafana-shaped pane of glass for
+real fleets and simulated ones.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ['SimReport']
+
+# Invariant keys a scenario may assert (docs/simulation.md):
+#   no_lost_requests: true        -> shed_total == 0
+#   max_shed_requests: N          -> shed_total <= N
+#   max_slo_miss_seconds: S       -> slo_miss_seconds <= S
+#   max_target_flips: N           -> autoscaler direction reversals <= N
+#   max_final_queue: N            -> backlog drained by scenario end
+#   min_served_fraction: f        -> served_total/arrived_total >= f
+#   max_controller_faults: N      -> injected tick crashes tolerated
+_INVARIANT_KEYS = ('no_lost_requests', 'max_shed_requests',
+                   'max_slo_miss_seconds', 'max_target_flips',
+                   'max_final_queue', 'min_served_fraction',
+                   'max_controller_faults')
+
+
+class SimReport:
+    """Accumulates one run's events + metrics; owns serialization,
+    digests, invariant evaluation, and the TSDB export."""
+
+    def __init__(self, scenario_name: str, seed: int) -> None:
+        self.scenario_name = scenario_name
+        self.seed = seed
+        self.events: List[Dict[str, Any]] = []
+        # name -> [(t, value)]; insertion order is deterministic
+        # (fleet emits in a fixed order every tick).
+        self.metrics: Dict[str, List[Tuple[float, float]]] = {}
+        self.summary: Dict[str, Any] = {}
+
+    # -- accumulation --------------------------------------------------
+
+    def event(self, t: float, kind: str, **fields: Any) -> None:
+        entry = {'t': round(float(t), 6), 'kind': kind}
+        entry.update(fields)
+        self.events.append(entry)
+
+    def metric(self, name: str, t: float, value: float) -> None:
+        self.metrics.setdefault(name, []).append(
+            (round(float(t), 6), float(value)))
+
+    # -- canonical serialization ---------------------------------------
+
+    def event_log_bytes(self) -> bytes:
+        """Canonical JSON-lines event log (sorted keys, repr floats)."""
+        lines = [json.dumps(e, sort_keys=True, separators=(',', ':'))
+                 for e in self.events]
+        return ('\n'.join(lines) + '\n').encode()
+
+    def metric_stream_bytes(self) -> bytes:
+        """Canonical metric stream: one JSON line per series."""
+        lines = [
+            json.dumps({'name': name, 'points': self.metrics[name]},
+                       sort_keys=True, separators=(',', ':'))
+            for name in sorted(self.metrics)
+        ]
+        return ('\n'.join(lines) + '\n').encode()
+
+    def digest(self) -> str:
+        """sha256 over event log + metric stream — the one number two
+        runs must agree on for the scenario to count as reproducible."""
+        h = hashlib.sha256()
+        h.update(self.event_log_bytes())
+        h.update(b'\x00')
+        h.update(self.metric_stream_bytes())
+        return h.hexdigest()
+
+    # -- invariants ----------------------------------------------------
+
+    def check_invariants(self, invariants: Dict[str, Any]
+                         ) -> List[Dict[str, Any]]:
+        """Evaluate a scenario's invariant block against the run
+        summary. Returns one verdict dict per declared invariant;
+        unknown keys fail loudly (a typo must not pass vacuously)."""
+        s = self.summary
+        verdicts = []
+        for key, bound in invariants.items():
+            if key not in _INVARIANT_KEYS:
+                raise ValueError(
+                    f'unknown invariant {key!r}; one of '
+                    f'{_INVARIANT_KEYS}')
+            if key == 'no_lost_requests':
+                ok = (not bound) or s['shed_total'] == 0
+                actual = s['shed_total']
+            elif key == 'max_shed_requests':
+                actual = s['shed_total']
+                ok = actual <= bound
+            elif key == 'max_slo_miss_seconds':
+                actual = s['slo_miss_seconds']
+                ok = actual <= bound
+            elif key == 'max_target_flips':
+                actual = s['target_flips']
+                ok = actual <= bound
+            elif key == 'max_final_queue':
+                actual = s['final_queue']
+                ok = actual <= bound
+            elif key == 'min_served_fraction':
+                actual = (s['served_total'] /
+                          max(1, s['arrived_total']))
+                ok = actual >= bound
+            else:  # max_controller_faults
+                actual = s['controller_faults']
+                ok = actual <= bound
+            verdicts.append({'invariant': key, 'bound': bound,
+                             'actual': actual, 'ok': bool(ok)})
+        return verdicts
+
+    def failed_invariants(self, invariants: Dict[str, Any]
+                          ) -> List[Dict[str, Any]]:
+        return [v for v in self.check_invariants(invariants)
+                if not v['ok']]
+
+    # -- TSDB export ---------------------------------------------------
+
+    def to_store(self, root: str,
+                 labels: Optional[Dict[str, str]] = None) -> int:
+        """Write the metric stream into a TSDB directory at the sim's
+        virtual timestamps; returns points written. Retention is set
+        far past any virtual day so small virtual timestamps are never
+        reclaimed against the wall clock at flush time."""
+        from skypilot_tpu.utils import tsdb
+        labels = dict(labels or {})
+        labels.setdefault('scenario', self.scenario_name)
+        labels.setdefault('seed', str(self.seed))
+        store = tsdb.TSDB(root,
+                          raw_retention_s=365 * 86400.0,
+                          rollup_retention_s=365 * 86400.0)
+        written = 0
+        for name in sorted(self.metrics):
+            for t, value in self.metrics[name]:
+                store.ingest(name, labels, value, ts=t)
+                written += 1
+        store.flush(force=True)
+        return written
+
+    # -- full artifact -------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'scenario': self.scenario_name,
+            'seed': self.seed,
+            'summary': dict(self.summary),
+            'digest': self.digest(),
+            'events': len(self.events),
+            'metric_series': sorted(self.metrics),
+        }

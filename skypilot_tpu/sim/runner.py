@@ -1,0 +1,70 @@
+"""Scenario runner: wire kernel + fleet + faults + report, run, check.
+
+``run_scenario`` is the one entry point everything shares — tier-1
+invariant tests, ``bench_sim.py``, and the
+``python -m skypilot_tpu.sim`` CLI — so they cannot drift apart on
+setup details that would break reproducibility.
+
+Env knobs (see docs/env_vars.md):
+
+* ``SKYT_SIM_SEED`` — overrides the scenario's seed when >= 0;
+* ``SKYT_SIM_SCALE`` — proportional fleet/traffic scale factor
+  applied by the CLI and bench (1.0 = as written);
+* ``SKYT_SIM_TELEMETRY_EXPORT`` — when set, every run exports its
+  metric stream into this TSDB directory (then queryable via
+  ``/api/metrics/query`` by pointing ``SKYT_TELEMETRY_DIR`` at it).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu.sim.kernel import EventLoop
+from skypilot_tpu.sim.report import SimReport
+from skypilot_tpu.sim.scenario import Scenario
+from skypilot_tpu.utils import env_registry, fault_injection
+
+__all__ = ['run_scenario']
+
+
+def run_scenario(scenario: Scenario,
+                 seed: Optional[int] = None,
+                 store_root: Optional[str] = None) -> SimReport:
+    """Run one scenario to its horizon; returns the populated report.
+
+    ``seed`` overrides the scenario's (explicit arg > SKYT_SIM_SEED
+    env > scenario file). ``store_root`` (or the
+    SKYT_SIM_TELEMETRY_EXPORT env) exports the metric stream into a
+    TSDB directory after the run.
+    """
+    if seed is None:
+        env_seed = env_registry.get_int('SKYT_SIM_SEED')
+        seed = env_seed if env_seed >= 0 else scenario.seed
+    if store_root is None:
+        store_root = env_registry.get_str(
+            'SKYT_SIM_TELEMETRY_EXPORT') or None
+
+    # A scenario's fault_spec timeline mutates SKYT_FAULT_SPEC for its
+    # window; snapshot + restore so an exception mid-run (or a window
+    # outliving the horizon) can't leak chaos into the caller.
+    fault_env_before = os.environ.get(fault_injection.SPEC_ENV)
+    from skypilot_tpu.sim.fleet import FleetSim
+    loop = EventLoop(seed=seed)
+    report = SimReport(scenario.name, seed)
+    fleet = FleetSim(scenario, loop, report)
+    fleet.install()
+    try:
+        loop.run_until(scenario.duration_s)
+    finally:
+        if fault_env_before is None:
+            os.environ.pop(fault_injection.SPEC_ENV, None)
+        else:
+            os.environ[fault_injection.SPEC_ENV] = fault_env_before
+        fault_injection.reset()
+
+    report.summary = fleet.summary()
+    report.summary['events_fired'] = loop.fired
+    report.summary['duration_s'] = scenario.duration_s
+    if store_root:
+        report.to_store(store_root)
+    return report

@@ -1,0 +1,249 @@
+"""Declarative scenario spec + the in-tree scenario library.
+
+A scenario is a YAML document (or plain dict) that fully determines a
+simulation run::
+
+    name: region_outage
+    seed: 42
+    duration_s: 86400          # one simulated day
+    tick_s: 60                 # controller cadence
+    service:                   # ServiceSpec kwargs (service_spec.py)
+      min_replicas: 8
+      max_replicas: 12000
+      target_latency_p99_ms: 200
+      forecaster: seasonal
+    fleet:
+      initial_replicas: 10000  # warm-started READY fleet at t=0
+      base_latency_ms: 40      # ground-truth p99 ~= base + slope*c
+      latency_slope_ms: 8
+      provision_delay_s: 120
+      resume_delay_s: 20
+      spot: true
+      max_queue_per_replica: 200
+      domains:                 # placement/failure domains
+        - {cloud: gcp, region: us-central1, zone: a, price: 1.2}
+    lb_policy: p2c_ewma        # behavioral probe (bounded sample)
+    tenants:
+      - name: base
+        rate: {shape: diurnal, base_qps: 300, amplitude_qps: 250}
+    faults:                    # virtual-time fault timeline
+      - {at: 30000, kind: region_outage, region: us-central1,
+         duration_s: 3600}
+    invariants:
+      no_lost_requests: true
+      max_slo_miss_seconds: 1800
+      max_target_flips: 40
+
+Everything is data: the same file drives tier-1 invariant tests,
+``bench_sim.py``, and ``python -m skypilot_tpu.sim run <file>``.
+``Scenario.scale(f)`` shrinks/grows a scenario (fleet, traffic, queue
+caps) so the 10k-replica library scenarios double as fast smoke tests.
+"""
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ['Scenario', 'library_dir', 'library_names', 'load_library']
+
+_FAULT_KINDS = ('region_outage', 'spot_reclaim', 'provision_slowdown',
+                'rollout', 'fault_spec')
+
+_FLEET_DEFAULTS = {
+    'initial_replicas': 0,
+    'base_latency_ms': 40.0,
+    'latency_slope_ms': 8.0,
+    'provision_delay_s': 120.0,
+    'resume_delay_s': 20.0,
+    'spot': False,
+    'max_queue_per_replica': 200.0,
+    'domains': [{'cloud': 'gcp', 'region': 'us-central1', 'zone': 'a',
+                 'price': 1.0}],
+}
+
+
+class Scenario:
+    """Validated scenario spec. Construct via :meth:`from_dict`,
+    :meth:`from_file`, or :func:`load_library`."""
+
+    def __init__(self, data: Dict[str, Any],
+                 source: Optional[str] = None) -> None:
+        self._data = copy.deepcopy(data)
+        self.source = source
+        self._validate()
+
+    # -- accessors -----------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._data['name']
+
+    @property
+    def seed(self) -> int:
+        return int(self._data.get('seed', 0))
+
+    @property
+    def duration_s(self) -> float:
+        return float(self._data['duration_s'])
+
+    @property
+    def tick_s(self) -> float:
+        return float(self._data.get('tick_s', 10.0))
+
+    @property
+    def service(self) -> Dict[str, Any]:
+        return dict(self._data.get('service', {}))
+
+    @property
+    def fleet(self) -> Dict[str, Any]:
+        merged = dict(_FLEET_DEFAULTS)
+        merged.update(self._data.get('fleet', {}))
+        return merged
+
+    @property
+    def lb_policy(self) -> Optional[str]:
+        return self._data.get('lb_policy')
+
+    @property
+    def tenants(self) -> List[Dict[str, Any]]:
+        return [dict(t) for t in self._data.get('tenants', [])]
+
+    @property
+    def faults(self) -> List[Dict[str, Any]]:
+        return [dict(f) for f in self._data.get('faults', [])]
+
+    @property
+    def invariants(self) -> Dict[str, Any]:
+        return dict(self._data.get('invariants', {}))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self._data)
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any],
+                  source: Optional[str] = None) -> 'Scenario':
+        return cls(data, source=source)
+
+    @classmethod
+    def from_file(cls, path: str) -> 'Scenario':
+        import yaml
+        with open(path, encoding='utf-8') as f:
+            data = yaml.safe_load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f'scenario file {path} is not a mapping')
+        return cls(data, source=path)
+
+    def with_overrides(self, **overrides: Any) -> 'Scenario':
+        """Copy with top-level keys replaced (``seed=...`` etc.)."""
+        data = self.to_dict()
+        data.update(overrides)
+        return Scenario(data, source=self.source)
+
+    def scale(self, factor: float) -> 'Scenario':
+        """Shrink (factor < 1) or grow a scenario proportionally:
+        fleet size, replica bounds, and every tenant's traffic scale
+        together so per-replica load — and therefore the emergent
+        behavior under test — is preserved."""
+        if factor <= 0:
+            raise ValueError(f'scale factor must be > 0, got {factor}')
+        data = self.to_dict()
+        fleet = data.setdefault('fleet', {})
+        base = self.fleet
+        fleet['initial_replicas'] = max(
+            0, int(round(base['initial_replicas'] * factor)))
+        service = data.setdefault('service', {})
+        for key in ('min_replicas', 'max_replicas',
+                    'base_ondemand_fallback_replicas'):
+            if service.get(key):
+                service[key] = max(1, int(round(service[key] * factor)))
+        for tenant in data.get('tenants', []):
+            tenant['rate'] = _scale_rate(tenant.get('rate', {}), factor)
+        for fault in data.get('faults', []):
+            # Count-valued fault knobs (rollout wave size) scale with
+            # the fleet; fraction-valued ones are scale-invariant.
+            if 'batch' in fault:
+                fault['batch'] = max(1, int(round(fault['batch'] *
+                                                  factor)))
+        for inv in ('max_shed_requests',):
+            if data.get('invariants', {}).get(inv):
+                data['invariants'][inv] = int(
+                    round(data['invariants'][inv] * factor))
+        return Scenario(data, source=self.source)
+
+    # -- validation ----------------------------------------------------
+
+    def _validate(self) -> None:
+        data = self._data
+        for key in ('name', 'duration_s'):
+            if key not in data:
+                raise ValueError(f'scenario missing required key {key!r}')
+        if float(data['duration_s']) <= 0:
+            raise ValueError('duration_s must be > 0')
+        if self.tick_s <= 0:
+            raise ValueError('tick_s must be > 0')
+        for tenant in data.get('tenants', []):
+            if 'name' not in tenant or 'rate' not in tenant:
+                raise ValueError(
+                    f'tenant entry {tenant!r} needs name and rate')
+            # Fail at load, not mid-run: build (and discard) the rate.
+            from skypilot_tpu.sim import traffic
+            traffic.make_rate(tenant['rate'])
+        for fault in data.get('faults', []):
+            kind = fault.get('kind')
+            if kind not in _FAULT_KINDS:
+                raise ValueError(
+                    f'unknown fault kind {kind!r}; one of {_FAULT_KINDS}')
+            if 'at' not in fault:
+                raise ValueError(f'fault {fault!r} needs an `at` time')
+            if kind == 'fault_spec':
+                # Parse at load, not mid-run: a malformed spec would
+                # otherwise raise inside every controller tick and be
+                # mistaken for injected chaos.
+                from skypilot_tpu.utils import fault_injection
+                fault_injection.parse_spec(fault['spec'])
+        domains = self.fleet['domains']
+        if not domains:
+            raise ValueError('fleet.domains must be non-empty')
+        for domain in domains:
+            if 'region' not in domain or 'zone' not in domain:
+                raise ValueError(
+                    f'domain {domain!r} needs region and zone')
+
+
+def _scale_rate(rate: Dict[str, Any], factor: float) -> Dict[str, Any]:
+    rate = copy.deepcopy(rate)
+    if 'compose' in rate:
+        rate['compose'] = [_scale_rate(r, factor)
+                           for r in rate['compose']]
+        return rate
+    for key in ('qps', 'base_qps', 'amplitude_qps', 'to_qps',
+                'from_qps', 'peak_qps'):
+        if key in rate:
+            rate[key] = rate[key] * factor
+    return rate
+
+
+# -- scenario library -------------------------------------------------------
+
+
+def library_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), 'scenarios')
+
+
+def library_names() -> List[str]:
+    return sorted(
+        os.path.splitext(f)[0] for f in os.listdir(library_dir())
+        if f.endswith('.yaml'))
+
+
+def load_library(name: str) -> Scenario:
+    """Load a library scenario by stem name (``region_outage``)."""
+    path = os.path.join(library_dir(), f'{name}.yaml')
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f'no library scenario {name!r}; available: '
+            f'{library_names()}')
+    return Scenario.from_file(path)

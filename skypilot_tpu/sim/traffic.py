@@ -1,0 +1,157 @@
+"""Traffic generators: deterministic arrival processes for sim tenants.
+
+A *rate function* maps virtual time to instantaneous demand (QPS).
+Scenario tenants declare one of the registered shapes (constant,
+diurnal, burst, ramp, flood) or compose several additively; the fleet
+model samples per-tick arrival counts from a seeded Poisson stream.
+
+These are also the library the control-plane benches draw from:
+``bench_control_scale.py``'s Poisson/paced submitters use
+:func:`arrival_gaps` and :func:`zipf_weights` instead of hand-rolled
+``random.Random`` loops (r16 dedup satellite).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Dict, List, Sequence
+
+RateFn = Callable[[float], float]
+
+__all__ = ['RateFn', 'arrival_gaps', 'make_rate', 'pick_weighted',
+           'poisson_count', 'sum_rates', 'zipf_weights']
+
+
+# -- rate shapes ------------------------------------------------------------
+
+
+def constant(qps: float) -> RateFn:
+    return lambda t: qps
+
+
+def diurnal(base_qps: float, amplitude_qps: float,
+            period_s: float = 86400.0, phase_s: float = 0.0) -> RateFn:
+    """Sinusoidal day shape: ``base + amp * sin(2*pi*(t+phase)/period)``
+    clamped at zero (the r11 autoscale bench's ``lam(t)``,
+    generalized)."""
+
+    def rate(t: float) -> float:
+        return max(0.0, base_qps + amplitude_qps *
+                   math.sin(2.0 * math.pi * (t + phase_s) / period_s))
+
+    return rate
+
+
+def burst(start_s: float, end_s: float, qps: float) -> RateFn:
+    """Additive square burst in ``[start, end)`` — compose with a
+    baseline via :func:`sum_rates`."""
+    return lambda t: qps if start_s <= t < end_s else 0.0
+
+
+def ramp(start_s: float, end_s: float, to_qps: float,
+         from_qps: float = 0.0) -> RateFn:
+    """Linear ramp from ``from_qps`` at ``start`` to ``to_qps`` at
+    ``end``, holding ``to_qps`` after."""
+
+    def rate(t: float) -> float:
+        if t < start_s:
+            return from_qps
+        if t >= end_s:
+            return to_qps
+        frac = (t - start_s) / max(end_s - start_s, 1e-9)
+        return from_qps + (to_qps - from_qps) * frac
+
+    return rate
+
+
+def flood(start_s: float, duration_s: float, peak_qps: float,
+          attack_s: float = 60.0) -> RateFn:
+    """Hot-tenant flood (the r15 trace generalized): near-instant
+    attack to ``peak_qps``, sustained for ``duration_s``, then gone."""
+
+    def rate(t: float) -> float:
+        if t < start_s or t >= start_s + duration_s:
+            return 0.0
+        ramp_frac = min(1.0, (t - start_s) / max(attack_s, 1e-9))
+        return peak_qps * ramp_frac
+
+    return rate
+
+
+_SHAPES: Dict[str, Callable[..., RateFn]] = {
+    'constant': constant,
+    'diurnal': diurnal,
+    'burst': burst,
+    'ramp': ramp,
+    'flood': flood,
+}
+
+
+def make_rate(spec: dict) -> RateFn:
+    """Build a rate function from a scenario dict:
+    ``{shape: diurnal, base_qps: 300, amplitude_qps: 250}``. A list
+    under ``compose`` sums sub-shapes."""
+    if 'compose' in spec:
+        return sum_rates([make_rate(s) for s in spec['compose']])
+    spec = dict(spec)
+    shape = spec.pop('shape', 'constant')
+    if shape not in _SHAPES:
+        raise ValueError(
+            f'unknown traffic shape {shape!r}; one of {sorted(_SHAPES)}')
+    return _SHAPES[shape](**spec)
+
+
+def sum_rates(rates: Sequence[RateFn]) -> RateFn:
+    rates = list(rates)
+    return lambda t: sum(r(t) for r in rates)
+
+
+# -- sampling ---------------------------------------------------------------
+
+
+def poisson_count(rng: random.Random, lam: float) -> int:
+    """One Poisson(lam) draw. Knuth's product method below ~30 (exact),
+    a rounded normal approximation above (lam that large is an
+    aggregate count where the approximation error is far below the
+    model's own fidelity — and it keeps 10k-replica ticks O(1))."""
+    if lam <= 0.0:
+        return 0
+    if lam < 30.0:
+        limit = math.exp(-lam)
+        count, product = 0, rng.random()
+        while product > limit:
+            count += 1
+            product *= rng.random()
+        return count
+    return max(0, int(round(rng.normalvariate(lam, math.sqrt(lam)))))
+
+
+def arrival_gaps(rng: random.Random, qps: float):
+    """Infinite generator of exponential inter-arrival gaps (seconds)
+    for a Poisson process at ``qps`` — the primitive the control-plane
+    bench's open-loop submitters pace themselves with."""
+    if qps <= 0:
+        raise ValueError(f'qps must be > 0, got {qps}')
+    while True:
+        yield rng.expovariate(qps)
+
+
+def zipf_weights(n: int, s: float = 1.1) -> List[float]:
+    """Zipf(s) popularity weights over ``n`` items (heavy-head tenant
+    mix; item 0 is the hot tenant)."""
+    if n <= 0:
+        raise ValueError(f'n must be > 0, got {n}')
+    weights = [1.0 / (rank ** s) for rank in range(1, n + 1)]
+    total = sum(weights)
+    return [w / total for w in weights]
+
+
+def pick_weighted(rng: random.Random, weights: Sequence[float]) -> int:
+    """Index draw from a normalized weight vector."""
+    roll = rng.random()
+    acc = 0.0
+    for index, weight in enumerate(weights):
+        acc += weight
+        if roll < acc:
+            return index
+    return len(weights) - 1

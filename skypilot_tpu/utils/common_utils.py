@@ -95,14 +95,18 @@ class Backoff:
     def __init__(self,
                  initial: float = 1.0,
                  max_backoff: float = 30.0,
-                 multiplier: float = 1.6) -> None:
+                 multiplier: float = 1.6,
+                 rng: Optional[random.Random] = None) -> None:
         self._initial = initial
         self._max = max_backoff
         self._mult = multiplier
         self._current = initial
+        # Injectable jitter source (seeded tests / simkit); defaults
+        # to the module-level source.
+        self._rng = rng if rng is not None else random
 
     def current_backoff(self) -> float:
-        delay = min(self._current * random.uniform(0.8, 1.2), self._max)
+        delay = min(self._current * self._rng.uniform(0.8, 1.2), self._max)
         self._current = min(self._current * self._mult, self._max)
         return delay
 

@@ -372,6 +372,19 @@ DECLARATIONS: List[EnvVar] = _decl([
     ('SKYT_LB_UPSTREAM_TIMEOUT', 'float', 300.0,
      'LB: per-read upstream timeout (seconds).'),
 
+    # -- simulation (simkit) ----------------------------------------
+    ('SKYT_SIM_SEED', 'int', -1,
+     'Simkit: RNG seed override for scenario runs (-1 uses the '
+     'scenario file\'s seed).'),
+    ('SKYT_SIM_SCALE', 'float', 1.0,
+     'Simkit: proportional fleet/traffic scale factor applied by the '
+     'CLI and bench_sim.py (0.1 shrinks a 10k-replica scenario to '
+     '1k).'),
+    ('SKYT_SIM_TELEMETRY_EXPORT', 'path', None,
+     'Simkit: when set, every run exports its metric stream into '
+     'this TSDB directory (point SKYT_TELEMETRY_DIR at it to query '
+     'sim output via /api/metrics/query).'),
+
     # -- data plane -------------------------------------------------
     ('SKYT_TRANSFER_WORKERS', 'int', 16,
      'Transfer engine bounded worker-pool size.'),

@@ -1,0 +1,31 @@
+# skylint: sim-reachable
+"""SKYT013 negatives: every sanctioned injectable idiom."""
+import random
+import time
+from typing import Callable, Optional
+
+
+class Scaler:
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        # bare reference as an injectable default: not a call
+        self._clock = clock
+
+    def expired(self, last_change: float) -> bool:
+        return self._clock() - last_change > 30.0
+
+
+def plan(now_wall: Optional[float] = None) -> float:
+    if now_wall is None:
+        now_wall = time.time()  # injectable fallback: param wins
+    return now_wall
+
+
+def child_stream(seed: int) -> random.Random:
+    # seeded construction is deterministic — it IS the sim idiom
+    return random.Random(seed)
+
+
+def jitter(base: float, rng: Optional[random.Random] = None) -> float:
+    if rng is None:
+        rng = random  # reference, not a call
+    return base * rng.uniform(0.8, 1.2)

@@ -1,0 +1,27 @@
+# skylint: sim-reachable
+"""SKYT013 positives: ambient clock/RNG on a sim-reachable path."""
+import random
+import time
+
+
+def hysteresis_expired(last_change: float) -> bool:
+    # direct monotonic read: the sim cannot advance this
+    return time.monotonic() - last_change > 30.0
+
+
+def warm_age(warm_since: float) -> float:
+    return time.time() - warm_since  # ambient wall clock
+
+
+class Jittered:
+    def delay(self, base: float) -> float:
+        return base * random.uniform(0.8, 1.2)  # ambient RNG
+
+    def pick(self, items):
+        return random.choice(items)  # ambient RNG
+
+
+def two_reads() -> float:
+    # two findings in one scope: slugs must stay distinct
+    start = time.monotonic()
+    return time.monotonic() - start

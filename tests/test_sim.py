@@ -1,0 +1,332 @@
+"""simkit: kernel units, bit-reproducibility, library invariants,
+chaos replay, telemetry export, and the control-plane latency smoke.
+
+The load-bearing property is pinned first: a scenario run is a pure
+function of (scenario, seed) — identical event log + metric stream
+bytes across runs, divergent under a different seed. Everything else
+(scenario library invariants, SKYT_FAULT_SPEC replay, the
+``/api/metrics/query`` pane of glass) builds on it.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.sim import (EventLoop, Scenario, SimClock, SimRng,
+                              run_scenario)
+from skypilot_tpu.sim import scenario as scenario_lib
+
+# Small but non-trivial: two tenants, spot fleet across two zones, a
+# mid-run reclaim, and a p2c (seeded-RNG) balancer probe — every named
+# RNG stream and the fault path participate in the digest.
+TINY = {
+    'name': 'tiny',
+    'seed': 3,
+    'duration_s': 600,
+    'tick_s': 10,
+    'service': {
+        'min_replicas': 2,
+        'max_replicas': 64,
+        'target_latency_p99_ms': 200,
+        'forecast_horizon_seconds': 60,
+        'upscale_delay_seconds': 0,
+        'downscale_delay_seconds': 120,
+        'base_ondemand_fallback_replicas': 4,
+    },
+    'fleet': {
+        'initial_replicas': 20,
+        'spot': True,
+        'max_queue_per_replica': 100000,
+        'domains': [
+            {'cloud': 'gcp', 'region': 'us-central1', 'zone': 'a',
+             'price': 1.0},
+            {'cloud': 'gcp', 'region': 'us-central1', 'zone': 'b',
+             'price': 1.2},
+        ],
+    },
+    'lb_policy': 'p2c_ewma',
+    'tenants': [
+        {'name': 'steady', 'rate': {'shape': 'constant', 'qps': 1200}},
+        {'name': 'bursty',
+         'rate': {'shape': 'burst', 'start_s': 200, 'end_s': 300,
+                  'qps': 400}},
+    ],
+    'faults': [
+        {'at': 250, 'kind': 'spot_reclaim', 'zone': 'a',
+         'fraction': 0.5},
+    ],
+}
+
+
+def tiny(**overrides):
+    return Scenario.from_dict(dict(TINY)).with_overrides(**overrides)
+
+
+# -- kernel ------------------------------------------------------------
+
+
+def test_events_fire_in_time_then_schedule_order():
+    loop = EventLoop(seed=0)
+    order = []
+    loop.at(5.0, lambda: order.append('b'))
+    loop.at(1.0, lambda: order.append('a'))
+    loop.at(5.0, lambda: order.append('c'))   # same instant, later seq
+    loop.at(2.0, lambda: order.append('ab'))
+    loop.run_until(10.0)
+    assert order == ['a', 'ab', 'b', 'c']
+    assert loop.clock.now() == 10.0           # rests at the horizon
+
+
+def test_same_instant_reentry_fires_after_queued_siblings():
+    loop = EventLoop(seed=0)
+    order = []
+
+    def first():
+        order.append('first')
+        # schedule at the CURRENT instant: fires this instant, but
+        # after the already-queued same-time sibling.
+        loop.at(loop.clock.now(), lambda: order.append('reentrant'))
+
+    loop.at(1.0, first)
+    loop.at(1.0, lambda: order.append('sibling'))
+    loop.run()
+    assert order == ['first', 'sibling', 'reentrant']
+
+
+def test_cancellation_is_a_tombstone():
+    loop = EventLoop(seed=0)
+    fired = []
+    keep = loop.at(2.0, lambda: fired.append('keep'))
+    drop = loop.at(1.0, lambda: fired.append('drop'))
+    drop.cancel()
+    assert loop.pending() == 1
+    loop.run()
+    assert fired == ['keep']
+    assert keep.time == 2.0
+
+
+def test_every_period_stop_and_cancel():
+    loop = EventLoop(seed=0)
+    ticks = []
+    loop.every(10.0, lambda: ticks.append(loop.clock.now()))
+    loop.run_until(35.0)
+    assert ticks == [10.0, 20.0, 30.0]
+
+    stopping = []
+    loop.every(10.0, lambda: (stopping.append(1),
+                              False if len(stopping) >= 2 else None)[1])
+    loop.run_until(100.0)
+    assert len(stopping) == 2                 # fn() False stops series
+
+    cancelled = []
+    handle = loop.every(10.0, lambda: cancelled.append(1))
+    loop.run_until(120.0)
+    handle.cancel()
+    loop.run_until(200.0)
+    assert len(cancelled) == 2                # 110, 120; none after
+
+
+def test_clock_never_goes_backwards():
+    clock = SimClock(start=5.0)
+    with pytest.raises(ValueError):
+        clock._advance_to(4.0)
+    loop = EventLoop(seed=0)
+    loop.run_until(10.0)
+    with pytest.raises(ValueError):
+        loop.at(3.0, lambda: None)
+
+
+def test_rng_streams_are_independent_and_stable():
+    a = SimRng(seed=42)
+    b = SimRng(seed=42)
+    # Same (seed, name) -> same sequence, across instances.
+    assert [a.stream('x').random() for _ in range(4)] == \
+           [b.stream('x').random() for _ in range(4)]
+    # Draws on one stream never perturb another: interleave heavily.
+    c = SimRng(seed=42)
+    for _ in range(100):
+        c.stream('noise').random()
+    fresh = SimRng(seed=42)
+    assert c.stream('x').random() == fresh.stream('x').random()
+    # Different names / different seeds diverge.
+    assert SimRng(7).stream('x').random() != \
+           SimRng(7).stream('y').random()
+    assert SimRng(7).stream('x').random() != \
+           SimRng(8).stream('x').random()
+
+
+# -- bit-reproducibility ----------------------------------------------
+
+
+def test_same_scenario_and_seed_is_bit_identical():
+    first = run_scenario(tiny())
+    second = run_scenario(tiny())
+    assert first.event_log_bytes() == second.event_log_bytes()
+    assert first.metric_stream_bytes() == second.metric_stream_bytes()
+    assert first.digest() == second.digest()
+    assert first.summary == second.summary
+    # The run did real work (reclaim fired, autoscaler acted).
+    assert first.summary['preemptions'] > 0
+    assert first.summary['arrived_total'] > 0
+
+
+def test_different_seed_diverges():
+    base = run_scenario(tiny())
+    other = run_scenario(tiny(), seed=TINY['seed'] + 1)
+    assert base.digest() != other.digest()
+
+
+def test_seed_precedence_env_vs_file(monkeypatch):
+    monkeypatch.setenv('SKYT_SIM_SEED', str(TINY['seed'] + 1))
+    via_env = run_scenario(tiny())
+    monkeypatch.delenv('SKYT_SIM_SEED')
+    explicit = run_scenario(tiny(), seed=TINY['seed'] + 1)
+    assert via_env.digest() == explicit.digest()
+
+
+def test_scale_preserves_per_replica_load():
+    big = tiny()
+    small = big.scale(0.5)
+    assert small.fleet['initial_replicas'] == 10
+    assert small.tenants[0]['rate']['qps'] == 600
+    report = run_scenario(small)
+    assert report.summary['arrived_total'] > 0
+
+
+# -- scenario library: every drill passes its own invariants -----------
+
+# Scale factors keep tier-1 fast while preserving per-replica load
+# (region_outage is a 10k-replica day; 2% is a 200-replica day).
+_LIBRARY_SCALE = {
+    'region_outage': 0.02,
+    'spot_reclaim_az': 0.05,
+    'thundering_herd_wake': 0.05,
+    'hot_tenant_flood': 0.05,
+    'weight_rollout_surge': 0.05,
+}
+
+
+def test_library_is_fully_covered():
+    assert set(scenario_lib.library_names()) == set(_LIBRARY_SCALE)
+
+
+@pytest.mark.parametrize('name', sorted(_LIBRARY_SCALE))
+def test_library_scenario_invariants(name):
+    scenario = scenario_lib.load_library(name)
+    assert scenario.invariants, f'{name} declares no invariants'
+    report = run_scenario(scenario.scale(_LIBRARY_SCALE[name]))
+    failed = report.failed_invariants(scenario.invariants)
+    assert not failed, f'{name}: {failed}'
+
+
+def test_unknown_invariant_key_fails_loudly():
+    report = run_scenario(tiny(duration_s=50))
+    with pytest.raises(ValueError, match='unknown invariant'):
+        report.check_invariants({'max_shed_requsts': 1})
+
+
+# -- chaos: SKYT_FAULT_SPEC replay ------------------------------------
+
+
+@pytest.mark.chaos
+def test_fault_spec_window_crashes_controller_deterministically(
+        monkeypatch):
+    """A fault_spec timeline entry arms SKYT_FAULT_SPEC at
+    sim.controller.tick for a window: the controller tick crashes
+    (decisions skipped, world keeps moving), the crash count is exact,
+    and the whole chaotic run replays bit-identically."""
+    monkeypatch.delenv('SKYT_FAULT_SPEC', raising=False)
+    chaotic = tiny(faults=[
+        {'at': 100, 'kind': 'fault_spec', 'duration_s': 200,
+         'spec': 'sim.controller.tick:Exception:p=1.0:times=3'},
+    ])
+    first = run_scenario(chaotic)
+    assert first.summary['controller_faults'] == 3
+    kinds = [e['kind'] for e in first.events]
+    assert kinds.count('controller_fault') == 3
+    # The window restored the pre-run env.
+    import os
+    assert 'SKYT_FAULT_SPEC' not in os.environ
+    second = run_scenario(chaotic)
+    assert first.digest() == second.digest()
+
+
+@pytest.mark.chaos
+def test_controller_crash_tolerance_invariant():
+    chaotic = tiny(faults=[
+        {'at': 100, 'kind': 'fault_spec', 'duration_s': 100,
+         'spec': 'sim.controller.tick:Exception:p=1.0:times=2'},
+    ], invariants={'max_controller_faults': 2,
+                   'min_served_fraction': 0.99})
+    report = run_scenario(chaotic)
+    assert not report.failed_invariants(
+        {'max_controller_faults': 2, 'min_served_fraction': 0.99})
+
+
+# -- telemetry export: the production query pane ----------------------
+
+
+def test_metric_stream_exports_to_tsdb(tmp_path):
+    report = run_scenario(tiny(), store_root=str(tmp_path))
+    from skypilot_tpu.utils import tsdb
+    store = tsdb.TSDB(str(tmp_path), raw_retention_s=365 * 86400.0,
+                      rollup_retention_s=365 * 86400.0)
+    series = store.query_range('sim_ready_replicas', 0.0, 600.0,
+                               {'scenario': 'tiny'})
+    assert series, 'exported series not found'
+    points = series[0].points
+    # Virtual timestamps, one per tick, matching the report stream.
+    assert [p[0] for p in points] == \
+           [t for t, _ in report.metrics['sim_ready_replicas']]
+
+
+def test_sim_metrics_queryable_via_api(tmp_path, monkeypatch):
+    """Acceptance: point SKYT_TELEMETRY_DIR at a sim export and the
+    run is queryable through the real GET /api/metrics/query."""
+    run_scenario(tiny(), store_root=str(tmp_path))
+    monkeypatch.setenv('SKYT_TELEMETRY_DIR', str(tmp_path))
+    monkeypatch.setenv('SKYT_TELEMETRY_INTERVAL', '3600')
+    from skypilot_tpu.server.app import ApiServer
+    srv = ApiServer(port=0)
+    srv.start_background()
+    try:
+        url = (f'{srv.url}/api/metrics/query?name=sim_p99_ms'
+               f'&start=0&end=600&label.scenario=tiny')
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.load(resp)
+        assert body['series'], body
+        assert body['series'][0]['labels']['scenario'] == 'tiny'
+        assert len(body['series'][0]['points']) > 0
+    finally:
+        srv.shutdown()
+
+
+# -- control-plane latency smoke --------------------------------------
+
+
+@pytest.mark.latency
+def test_thousand_replica_hour_simulates_in_seconds():
+    """A 1k-replica fleet serving a simulated hour must stay
+    interactive (this is the whole point of a fleet-in-a-process):
+    generous bound, single-core CI box."""
+    scenario = scenario_lib.load_library('region_outage').scale(0.1)
+    scenario = scenario.with_overrides(duration_s=3600.0)
+    started = time.monotonic()
+    report = run_scenario(scenario)
+    wall = time.monotonic() - started
+    assert report.summary['ticks'] == 60
+    assert wall < 20.0, f'1k-replica hour took {wall:.1f}s'
+
+
+@pytest.mark.slow
+def test_ten_thousand_replica_day_acceptance():
+    """The r16 acceptance drill: the full 10k-replica region_outage
+    day passes its invariants and stays under a minute of wall clock
+    (excluded from tier-1; bench_sim.py reports the same numbers)."""
+    scenario = scenario_lib.load_library('region_outage')
+    started = time.monotonic()
+    report = run_scenario(scenario)
+    wall = time.monotonic() - started
+    assert not report.failed_invariants(scenario.invariants)
+    assert wall < 60.0, f'10k-replica day took {wall:.1f}s'

@@ -28,6 +28,7 @@ from skypilot_tpu.lint.checks_portability import (JaxPurityChecker,
                                                   SqlitePortabilityChecker)
 from skypilot_tpu.lint.checks_resources import ResourcePairingChecker
 from skypilot_tpu.lint.checks_shared_state import SharedStateChecker
+from skypilot_tpu.lint.checks_simreach import SimReachDeterminismChecker
 from skypilot_tpu.lint.checks_transactions import (
     TransactionHygieneChecker)
 from skypilot_tpu.lint.checks_wallclock import WallClockChecker
@@ -277,6 +278,35 @@ def test_skyt012_flags_unlocked_shared_writes():
 
 def test_skyt012_locked_or_confined_pass():
     assert not run_fixture(SharedStateChecker(), ['skyt012_neg.py'])
+
+
+# -- SKYT013 ------------------------------------------------------------
+
+def test_skyt013_flags_ambient_clock_and_rng():
+    findings = run_fixture(SimReachDeterminismChecker(),
+                           ['skyt013_pos.py'])
+    found = slugs(findings, 'SKYT013')
+    assert 'ambient-clock:hysteresis_expired:time.monotonic:0' in found
+    assert 'ambient-clock:warm_age:time.time:0' in found
+    assert 'ambient-rng:Jittered.delay:random.uniform:0' in found
+    assert 'ambient-rng:Jittered.pick:random.choice:0' in found
+    # Two reads in one scope keep distinct, stable slugs.
+    assert 'ambient-clock:two_reads:time.monotonic:0' in found
+    assert 'ambient-clock:two_reads:time.monotonic:1' in found
+    assert len(found) == 6
+
+
+def test_skyt013_injectable_idioms_pass():
+    assert not run_fixture(SimReachDeterminismChecker(),
+                           ['skyt013_neg.py'])
+
+
+def test_skyt013_ignores_unregistered_modules():
+    # Same offending code, but no pragma and not in SIM_REACHABLE:
+    # out of scope for this pass (SKYT009 owns general wall-clock
+    # hygiene).
+    assert not run_fixture(SimReachDeterminismChecker(),
+                           ['skyt009_pos.py'])
 
 
 # -- baseline workflow --------------------------------------------------
