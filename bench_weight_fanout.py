@@ -1,0 +1,245 @@
+#!/usr/bin/env python3
+"""Weight-distribution bench: binary-tree peer fan-out vs bucket-direct
+cold start, through the real FanoutPuller/manifest stack against
+bandwidth-throttled in-process sources (ISSUE 17).
+
+CPU-only; no cloud credentials. The physics under test: the bucket is
+one origin with a fixed aggregate uplink, while every weight-complete
+peer adds its own uplink — so bucket-direct cold start is O(N) in
+fleet size and fan-out is O(log N). Arms:
+
+1. cold start at 1 / 8 / 64 replicas: every replica pulls the full
+   manifest; bucket-direct (all N convoy on the origin) vs fan-out
+   (tree peers + lease-bounded bucket reads). Acceptance: fan-out
+   beats bucket-direct at 64 replicas.
+2. heal latency: 8-replica fan-out with one peer killed mid-transfer —
+   children heal up the ancestor chain; the fleet must still converge.
+3. warm delta refresh: re-pull after 1 of 4 shards changed at the
+   source — only the changed shard moves.
+
+Emits one JSON document on stdout; run_benches.sh tees it into
+``BENCH_fanout_<suffix>.json`` and the tables land in PERF.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+from skypilot_tpu.data import ckpt_manifest
+from skypilot_tpu.data import fanout
+
+ITERS = 3
+SHARDS = 4
+SHARD_BYTES = 256 * 1024            # 1 MiB of weights per replica
+BUCKET_BW = 16 * 1024 * 1024        # origin aggregate uplink, bytes/s
+PEER_BW = 16 * 1024 * 1024          # per-peer uplink, bytes/s
+
+
+def p50(samples):
+    return sorted(samples)[len(samples) // 2]
+
+
+class Throttle:
+    """Shared-pipe model: every transfer through one instance is
+    serialized onto `rate` bytes/s of aggregate bandwidth, so N
+    concurrent readers each see rate/N."""
+
+    def __init__(self, rate: float) -> None:
+        self.rate = float(rate)
+        self._lock = threading.Lock()
+        self._ready_at = time.monotonic()
+        self.bytes = 0
+
+    def take(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes += nbytes
+            now = time.monotonic()
+            start = max(now, self._ready_at)
+            self._ready_at = start + nbytes / self.rate
+            delay = self._ready_at - now
+        if delay > 0:
+            time.sleep(delay)
+
+
+def make_weights(root: str) -> dict:
+    os.makedirs(root, exist_ok=True)
+    for i in range(SHARDS):
+        with open(os.path.join(root, f'shard-{i}.bin'), 'wb') as f:
+            f.write(os.urandom(SHARD_BYTES))
+    payload = ckpt_manifest.build(root, step=17)
+    ckpt_manifest.write(root, payload)
+    return payload
+
+
+def dir_source(name, root, throttle, is_peer=True, gate=None,
+               kill_after=None):
+    """Serve shards out of `root` through `throttle`. `gate` (an
+    Event) models a peer that only serves once its own pull finished;
+    `kill_after` kills the peer after that many fetches
+    (mid-transfer death for the heal arm)."""
+    calls = [0]
+
+    def fn(shard, offset):
+        if gate is not None and not gate.wait(timeout=60):
+            raise fanout.PeerUnavailable(f'{name} never became ready')
+        calls[0] += 1
+        if kill_after is not None and calls[0] > kill_after:
+            raise fanout.PeerUnavailable(f'{name} died mid-transfer')
+        with open(os.path.join(root, shard['path']), 'rb') as f:
+            f.seek(offset)
+            data = f.read()
+        throttle.take(len(data))
+        return data
+
+    return fanout.CallableSource(name, fn, is_peer=is_peer)
+
+
+def cold_start(n, src, manifest, work, *, tree, dead_peer=None):
+    """Launch n replicas at t=0; return (makespan, per-replica times,
+    total heals). `tree=False` = bucket-direct convoy (no peers, no
+    lease)."""
+    bucket_throttle = Throttle(BUCKET_BW)
+    peer_throttles = {}
+    ready = [threading.Event() for _ in range(n)]
+    dests = [os.path.join(work, f'replica-{i}') for i in range(n)]
+    lease = (fanout.LeaseManager(fanout.bucket_lease_bound(n), ttl=300)
+             if tree else None)
+    times = [0.0] * n
+    heals = [0] * n
+    errors = []
+
+    def run(pos):
+        started = time.monotonic()
+        try:
+            sources = []
+            if tree:
+                for anc in fanout.tree_ancestors(pos):
+                    throttle = peer_throttles.setdefault(
+                        anc, Throttle(PEER_BW))
+                    sources.append(dir_source(
+                        f'peer:{anc}', dests[anc], throttle,
+                        gate=ready[anc],
+                        kill_after=(2 if anc == dead_peer else None)))
+            puller = fanout.FanoutPuller(
+                manifest, dests[pos], sources,
+                dir_source('bucket', src, bucket_throttle,
+                           is_peer=False),
+                lease=lease, holder=f'replica-{pos}')
+            result = puller.pull()
+            heals[pos] = int(result['heals'])
+            times[pos] = time.monotonic() - started
+            ready[pos].set()
+        except BaseException as exc:  # pragma: no cover - bench guard
+            errors.append(f'replica {pos}: {exc!r}')
+            ready[pos].set()
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n)]
+    started = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    makespan = time.monotonic() - started
+    if errors:
+        raise RuntimeError('; '.join(errors[:3]))
+    for d in dests:
+        if ckpt_manifest.read(d) is None:
+            raise RuntimeError(f'{d}: manifest never committed')
+    return makespan, times, sum(heals), bucket_throttle.bytes
+
+
+def bench_cold_start(src, manifest, tmp):
+    out = {}
+    for n in (1, 8, 64):
+        direct, fanned = [], []
+        for i in range(ITERS):
+            work = os.path.join(tmp, f'direct-{n}-{i}')
+            direct.append(cold_start(n, src, manifest, work,
+                                     tree=False)[0])
+            shutil.rmtree(work)
+            work = os.path.join(tmp, f'fanout-{n}-{i}')
+            fanned.append(cold_start(n, src, manifest, work,
+                                     tree=True)[0])
+            shutil.rmtree(work)
+        out[str(n)] = {
+            'bucket_direct_makespan_s': round(p50(direct), 3),
+            'fanout_makespan_s': round(p50(fanned), 3),
+            'speedup': round(p50(direct) / p50(fanned), 2),
+        }
+    return out
+
+
+def bench_heal(src, manifest, tmp):
+    clean = cold_start(8, src, manifest, os.path.join(tmp, 'h-clean'),
+                       tree=True)
+    healed = cold_start(8, src, manifest, os.path.join(tmp, 'h-dead'),
+                        tree=True, dead_peer=1)
+    return {
+        'clean_makespan_s': round(clean[0], 3),
+        'dead_peer_makespan_s': round(healed[0], 3),
+        'heal_events': healed[2],
+        'converged': True,  # cold_start raises otherwise
+    }
+
+
+def bench_warm_delta(src, manifest, tmp):
+    dest = os.path.join(tmp, 'warm')
+    throttle = Throttle(BUCKET_BW)
+
+    def pull(payload):
+        started = time.monotonic()
+        result = fanout.FanoutPuller(
+            payload, dest, [],
+            dir_source('bucket', src, throttle, is_peer=False)).pull()
+        return time.monotonic() - started, result
+
+    cold_s, cold = pull(manifest)
+    with open(os.path.join(src, 'shard-0.bin'), 'wb') as f:
+        f.write(os.urandom(SHARD_BYTES))
+    refreshed = ckpt_manifest.build(src, step=18)
+    ckpt_manifest.write(src, refreshed)
+    warm_s, warm = pull(refreshed)
+    return {
+        'cold_s': round(cold_s, 3),
+        'warm_s': round(warm_s, 3),
+        'cold_fetched': cold['fetched'],
+        'warm_fetched': warm['fetched'],
+        'warm_skipped': warm['skipped'],
+    }
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix='skyt-fanout-bench-')
+    try:
+        src = os.path.join(tmp, 'bucket')
+        manifest = make_weights(src)
+        doc = {
+            'bench': 'weight_fanout',
+            'config': {
+                'shards': SHARDS, 'shard_bytes': SHARD_BYTES,
+                'bucket_bw_mibs': BUCKET_BW / 2**20,
+                'peer_bw_mibs': PEER_BW / 2**20, 'iters': ITERS,
+            },
+            'cold_start': bench_cold_start(src, manifest, tmp),
+            'heal': bench_heal(src, manifest, tmp),
+            'warm_delta': bench_warm_delta(src, manifest, tmp),
+        }
+        at64 = doc['cold_start']['64']
+        doc['acceptance'] = {
+            'fanout_beats_bucket_direct_at_64': at64['speedup'] > 1.0,
+        }
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+        return 0 if at64['speedup'] > 1.0 else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

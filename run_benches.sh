@@ -177,6 +177,16 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_serve_autoscale.py \
   | tee "BENCH_serve_autoscale_${suffix}.json"
 echo "rc=$? -> BENCH_serve_autoscale_${suffix}.json" >&2
 
+# Weight fan-out bench: CPU-only — binary-tree peer distribution vs
+# bucket-direct cold start at 1/8/64 replicas through the real
+# FanoutPuller/manifest stack on bandwidth-throttled sources, plus
+# heal-latency (peer killed mid-transfer) and warm-delta-refresh arms
+# (docs/weight_distribution.md, numbers in PERF.md).
+echo "=== bench weight-fanout ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_weight_fanout.py \
+  | tee "BENCH_fanout_${suffix}.json"
+echo "rc=$? -> BENCH_fanout_${suffix}.json" >&2
+
 # simkit bench: CPU-only — discrete-event kernel throughput, the full
 # 10k-replica day-long region_outage scenario through the real
 # autoscaler stack (acceptance: < 60 s wall, invariants hold), the

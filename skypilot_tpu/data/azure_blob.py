@@ -31,6 +31,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from xml.etree import ElementTree
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.data import s3
 
 API_VERSION = '2021-08-06'
 # Files above this stream as Put Block / Put Block List instead of one
@@ -44,8 +45,10 @@ class AzureHttpError(exceptions.StorageError):
     """Storage error carrying the HTTP status (never classify by
     substring — a container named 'x-404' must not read as missing)."""
 
-    def __init__(self, message: str, code: int) -> None:
-        super().__init__(message, http_status=code)
+    def __init__(self, message: str, code: int,
+                 retry_after: Optional[float] = None) -> None:
+        super().__init__(message, http_status=code,
+                         retry_after=retry_after)
         self.code = code
 
 
@@ -164,7 +167,9 @@ class AzureBlobClient:
             detail = e.read().decode('utf-8', errors='replace')[:300]
             raise AzureHttpError(
                 f'Azure Blob {method} {container}/{blob}: HTTP '
-                f'{e.code} {detail}', code=e.code) from None
+                f'{e.code} {detail}', code=e.code,
+                retry_after=s3._retry_after_seconds(e.code, e.headers)
+            ) from None
         except urllib.error.URLError as e:
             raise exceptions.StorageError(
                 f'Azure Blob endpoint unreachable: {e}') from None
@@ -274,7 +279,9 @@ class AzureBlobClient:
             e.read()
             raise AzureHttpError(
                 f'Azure Blob ranged GET {container}/{blob} '
-                f'[{start}-{end}]: HTTP {e.code}', code=e.code) from None
+                f'[{start}-{end}]: HTTP {e.code}', code=e.code,
+                retry_after=s3._retry_after_seconds(e.code, e.headers)
+            ) from None
         except urllib.error.URLError as e:
             raise exceptions.StorageError(
                 f'Azure Blob endpoint unreachable: {e}') from None
@@ -302,7 +309,9 @@ class AzureBlobClient:
         except urllib.error.HTTPError as e:
             raise AzureHttpError(
                 f'Azure Blob GET {container}/{blob}: HTTP {e.code}',
-                code=e.code) from None
+                code=e.code,
+                retry_after=s3._retry_after_seconds(e.code, e.headers)
+            ) from None
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)

@@ -35,6 +35,22 @@ from xml.etree import ElementTree
 from skypilot_tpu import exceptions
 
 
+def _retry_after_seconds(status: int, headers) -> Optional[float]:
+    """Parse a ``Retry-After`` header into seconds on a 429/503 answer.
+
+    Only the numeric form is honored (HTTP-date values are rare from
+    object stores and would need wall-clock math); absent or malformed
+    values yield ``None`` so callers fall back to their own backoff.
+    """
+    if status not in (429, 503) or headers is None:
+        return None
+    value = headers.get('Retry-After')
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
+
+
 def _read_slice(resp, start: int, length: int) -> bytes:
     """Read ``[start, start+length)`` from a response stream without
     buffering the rest; closing the response abandons the tail."""
@@ -195,36 +211,42 @@ class S3Client:
 
     def _call(self, method: str, bucket: str, key: str = '',
               query: Optional[Dict[str, str]] = None,
-              body: bytes = b'') -> Tuple[int, bytes]:
-        status, _, payload = self._send(
+              body: bytes = b''
+              ) -> Tuple[int, bytes, Optional[float]]:
+        """Returns (status, body, retry_after): the third element is
+        the parsed Retry-After on a 429/503 answer (None otherwise)
+        so raise sites can hand server backpressure to retry loops."""
+        status, headers, payload = self._send(
             self._signed_request(method, bucket, key, query, body))
-        return status, payload
+        return status, payload, _retry_after_seconds(status, headers)
 
     # -- operations ----------------------------------------------------
 
     def bucket_exists(self, bucket: str) -> bool:
-        code, _ = self._call('HEAD', bucket)
+        code, _, _ = self._call('HEAD', bucket)
         return code == 200
 
     def create_bucket(self, bucket: str) -> None:
-        code, body = self._call('PUT', bucket)
+        code, body, retry_after = self._call('PUT', bucket)
         if code not in (200, 204) and b'BucketAlreadyOwnedByYou' not in body:
             raise exceptions.StorageError(
-                f'create bucket {bucket}: HTTP {code} {body[:300]!r}')
+                f'create bucket {bucket}: HTTP {code} {body[:300]!r}',
+                http_status=code, retry_after=retry_after)
 
     def put_object(self, bucket: str, key: str, data: bytes) -> None:
-        code, body = self._call('PUT', bucket, key, body=data)
+        code, body, retry_after = self._call('PUT', bucket, key,
+                                             body=data)
         if code not in (200, 204):
             raise exceptions.StorageError(
                 f'put {bucket}/{key}: HTTP {code} {body[:300]!r}',
-                http_status=code)
+                http_status=code, retry_after=retry_after)
 
     def get_object(self, bucket: str, key: str) -> bytes:
-        code, body = self._call('GET', bucket, key)
+        code, body, retry_after = self._call('GET', bucket, key)
         if code != 200:
             raise exceptions.StorageError(
                 f'get {bucket}/{key}: HTTP {code} {body[:300]!r}',
-                http_status=code)
+                http_status=code, retry_after=retry_after)
         return body
 
     def get_object_to_file(self, bucket: str, key: str,
@@ -246,7 +268,9 @@ class S3Client:
         except urllib.error.HTTPError as e:
             raise exceptions.StorageError(
                 f'get {bucket}/{key}: HTTP {e.code}',
-                http_status=e.code) from None
+                http_status=e.code,
+                retry_after=_retry_after_seconds(e.code, e.headers)
+            ) from None
         except urllib.error.URLError as e:
             raise exceptions.StorageError(
                 f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
@@ -273,7 +297,9 @@ class S3Client:
         except urllib.error.HTTPError as e:
             raise exceptions.StorageError(
                 f'ranged get {bucket}/{key} [{start}-{end}]: HTTP '
-                f'{e.code}', http_status=e.code) from None
+                f'{e.code}', http_status=e.code,
+                retry_after=_retry_after_seconds(e.code, e.headers)
+            ) from None
         except urllib.error.URLError as e:
             raise exceptions.StorageError(
                 f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
@@ -300,18 +326,20 @@ class S3Client:
         if status not in (200, 204):
             raise exceptions.StorageError(
                 f'put {bucket}/{key}: HTTP {status} {body[:300]!r}',
-                http_status=status)
+                http_status=status,
+                retry_after=_retry_after_seconds(status, headers))
         return (headers.get('ETag') or '').strip('"')
 
     # -- multipart upload ----------------------------------------------
 
     def create_multipart_upload(self, bucket: str, key: str) -> str:
-        code, body = self._call('POST', bucket, key,
-                                query={'uploads': ''})
+        code, body, retry_after = self._call('POST', bucket, key,
+                                             query={'uploads': ''})
         if code != 200:
             raise exceptions.StorageError(
                 f'initiate multipart {bucket}/{key}: HTTP {code} '
-                f'{body[:300]!r}', http_status=code)
+                f'{body[:300]!r}', http_status=code,
+                retry_after=retry_after)
         root = ElementTree.fromstring(body)
         ns = root.tag.split('}')[0] + '}' if root.tag.startswith('{') \
             else ''
@@ -332,7 +360,8 @@ class S3Client:
         if status not in (200, 204):
             raise exceptions.StorageError(
                 f'upload part {part_number} of {bucket}/{key}: HTTP '
-                f'{status} {body[:300]!r}', http_status=status)
+                f'{status} {body[:300]!r}', http_status=status,
+                retry_after=_retry_after_seconds(status, headers))
         etag = (headers.get('ETag') or '').strip('"')
         return etag or hashlib.md5(data).hexdigest()
 
@@ -344,13 +373,14 @@ class S3Client:
             f'<Part><PartNumber>{n}</PartNumber><ETag>"{etag}"</ETag>'
             f'</Part>' for n, etag in sorted(parts)) + \
             '</CompleteMultipartUpload>'
-        code, body = self._call('POST', bucket, key,
-                                query={'uploadId': upload_id},
-                                body=manifest.encode())
+        code, body, retry_after = self._call(
+            'POST', bucket, key, query={'uploadId': upload_id},
+            body=manifest.encode())
         if code != 200:
             raise exceptions.StorageError(
                 f'complete multipart {bucket}/{key}: HTTP {code} '
-                f'{body[:300]!r}', http_status=code)
+                f'{body[:300]!r}', http_status=code,
+                retry_after=retry_after)
         root = ElementTree.fromstring(body)
         # S3 can answer CompleteMultipartUpload with HTTP 200 whose body
         # is an <Error> document (e.g. InternalError after its internal
@@ -384,10 +414,13 @@ class S3Client:
                 query['prefix'] = prefix
             if token:
                 query['continuation-token'] = token
-            code, body = self._call('GET', bucket, query=query)
+            code, body, retry_after = self._call('GET', bucket,
+                                                 query=query)
             if code != 200:
                 raise exceptions.StorageError(
-                    f'list {bucket}/{prefix}: HTTP {code} {body[:300]!r}')
+                    f'list {bucket}/{prefix}: HTTP {code} '
+                    f'{body[:300]!r}', http_status=code,
+                    retry_after=retry_after)
             root = ElementTree.fromstring(body)
             ns = ''
             if root.tag.startswith('{'):

@@ -90,6 +90,24 @@ def _is_retryable(exc: BaseException) -> bool:
         return False
     return True
 
+def _retry_reason(exc: BaseException, retry_after) -> str:
+    """Classify a deferred attempt for skyt_transfer_retries_total.
+
+    ``server_backpressure`` means the server named its own recovery
+    horizon (Retry-After present) — the signal operators watch when
+    deciding whether slowness is ours or the store's."""
+    if retry_after is not None:
+        return 'server_backpressure'
+    status = getattr(exc, 'http_status', None)
+    if status in (429, 503):
+        return 'throttled'
+    if isinstance(exc, TimeoutError):
+        return 'timeout'
+    if isinstance(exc, ConnectionError):
+        return 'connection'
+    return 'other'
+
+
 PUT_SITE = 'data.put_object'
 GET_SITE = 'data.get_object'
 
@@ -472,6 +490,15 @@ class TransferEngine:
                 metrics.TRANSFER_OBJECTS.inc(direction=direction,
                                              outcome='retried')
                 delay = next(delays)
+                # A Retry-After from a 429/503 is the server telling us
+                # when capacity returns; honoring it as a *floor* under
+                # our own jittered backoff keeps us polite without ever
+                # retrying sooner than we otherwise would.
+                retry_after = getattr(e, 'retry_after', None)
+                metrics.TRANSFER_RETRIES.inc(
+                    reason=_retry_reason(e, retry_after))
+                if retry_after is not None:
+                    delay = max(delay, retry_after)
                 logger.debug('transfer %s failed (%s: %s); retry %d '
                              'in %.2fs', what, type(e).__name__, e,
                              attempt, delay)

@@ -147,14 +147,19 @@ class StorageError(SkytError):
     callers can classify retryability structurally — never by message
     substring (an object named 'x-404' must not read as missing).
     ``permanent=True`` marks failures no retry can fix (e.g. a
-    path-traversal rejection) independent of any HTTP exchange."""
+    path-traversal rejection) independent of any HTTP exchange.
+    ``retry_after`` carries the backend's Retry-After (seconds) from a
+    429/503 so retry loops can honor server backpressure as a floor
+    under their own jittered backoff (transfer_engine._attempt)."""
 
     def __init__(self, message: str = '',
                  http_status: 'int | None' = None,
-                 permanent: bool = False) -> None:
+                 permanent: bool = False,
+                 retry_after: 'float | None' = None) -> None:
         super().__init__(message)
         self.http_status = http_status
         self.permanent = permanent
+        self.retry_after = retry_after
 
 
 class NotSupportedError(SkytError):

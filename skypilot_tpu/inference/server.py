@@ -111,6 +111,23 @@ def make_handler(engine: InferenceEngine):
                         lines.append(f'{name} {value}')
                 self._body(200, ('\n'.join(lines) + '\n').encode(),
                            'text/plain; version=0.0.4')
+            elif self.path.startswith('/fanout/'):
+                # Peer weight-serving surface: sibling replicas pull
+                # committed checkpoint shards from here instead of
+                # the bucket (data/fanout.py; the weights dir comes
+                # from SKYT_FANOUT_DIR).
+                from skypilot_tpu.data import fanout
+                status, headers, body = fanout.handle_peer_get(
+                    self.path, range_header=self.headers.get('Range'))
+                ctype = headers.pop('Content-Type',
+                                    'application/json')
+                self.send_response(status)
+                for key, value in headers.items():
+                    self.send_header(key, value)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {'error': 'not found'})
 

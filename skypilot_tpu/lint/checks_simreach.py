@@ -49,6 +49,7 @@ SIM_REACHABLE = (
     'serve/load_balancing_policies.py',
     'serve/controller.py',
     'utils/fault_injection.py',
+    'data/fanout.py',
     'sim/kernel.py',
     'sim/traffic.py',
     'sim/scenario.py',

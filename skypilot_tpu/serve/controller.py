@@ -306,6 +306,23 @@ class ServeController:
         if self.lb is not None:
             self._sync_lb(replicas)
         self._update_service_status(replicas)
+        self._publish_fanout_metrics(replicas)
+
+    def _publish_fanout_metrics(
+            self, replicas: List[serve_state.ReplicaRecord]) -> None:
+        """Weight fan-out observability (docs/weight_distribution.md):
+        live bucket-read leases vs the O(log N) bound, and how many
+        peers sit in integrity quarantine. Reading the lease table
+        each tick also expires leases abandoned by dead pullers."""
+        if not env_registry.get_bool('SKYT_FANOUT'):
+            return
+        name = self.service_name
+        ttl = env_registry.get_float('SKYT_FANOUT_LEASE_TTL')
+        metrics.FANOUT_BUCKET_LEASES.set(
+            serve_state.count_fanout_leases(name, ttl), service=name)
+        metrics.FANOUT_QUARANTINED.set(
+            sum(1 for r in replicas if r.fanout_quarantined),
+            service=name)
 
     def _publish_autoscale_metrics(
             self, stats, replicas: List[serve_state.ReplicaRecord]

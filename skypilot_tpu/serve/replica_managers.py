@@ -203,6 +203,16 @@ class ReplicaManager:
             REPLICA_ID_ENV: str(replica_id),
             REPLICA_PORT_ENV: str(port),
         })
+        if env_registry.get_bool('SKYT_FANOUT'):
+            # Hand the replica its fan-out peer plan: the ancestor
+            # chain over the current READY fleet it pulls weight
+            # shards from, healing upward to the lease-bounded
+            # bucket (data/fanout.py, docs/weight_distribution.md).
+            import json as _json
+            from skypilot_tpu.data import fanout
+            plan = fanout.plan_for_new_replica(self.service_name,
+                                               replica_id)
+            task.update_envs({fanout.PEERS_ENV: _json.dumps(plan)})
         new_resources = []
         for res in task.resources:
             overrides = {}

@@ -130,6 +130,17 @@ def _db():
                 consecutive_failures INTEGER DEFAULT 0,
                 PRIMARY KEY (service_name, replica_id)
             );
+            -- Bucket-read leases for weight fan-out convoy control
+            -- (data/fanout.py): at most O(log N) holders, with
+            -- acquired_at backing TTL expiry so a dead puller frees
+            -- its slot. NOTE no semicolons in these comments: the
+            -- dual-backend script runner splits on them.
+            CREATE TABLE IF NOT EXISTS fanout_leases (
+                service_name TEXT NOT NULL,
+                replica_id INTEGER NOT NULL,
+                acquired_at REAL NOT NULL,
+                PRIMARY KEY (service_name, replica_id)
+            );
         """)
         cols = {r['name'] for r in
                 conn.execute('PRAGMA table_info(services)')}
@@ -201,6 +212,14 @@ def _db():
             # (SKYT_WARM_POOL_TTL) expires against it.
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE replicas ADD COLUMN warm_since REAL')
+        if 'fanout_quarantined' not in replica_cols:
+            # Weight fan-out integrity quarantine (data/fanout.py): a
+            # replica caught serving corrupt shards is excluded
+            # fleet-wide from peer plans so one flipped bit can never
+            # propagate down the distribution tree.
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE replicas ADD COLUMN '
+                'fanout_quarantined INTEGER DEFAULT 0')
         conn.commit()
 
     os.makedirs(serve_dir(), exist_ok=True)
@@ -493,6 +512,9 @@ class ReplicaRecord:
             row['region'] if 'region' in keys else None)
         self.warm_since: Optional[float] = (
             row['warm_since'] if 'warm_since' in keys else None)
+        self.fanout_quarantined: bool = bool(
+            row['fanout_quarantined']
+            if 'fanout_quarantined' in keys else 0)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -513,6 +535,7 @@ class ReplicaRecord:
             'lb_ewma_ms': self.lb_ewma_ms,
             'lb_ejected': self.lb_ejected,
             'lb_ejected_until': self.lb_ejected_until,
+            'fanout_quarantined': self.fanout_quarantined,
         }
 
 
@@ -636,3 +659,86 @@ def reset_replica_failures(service_name: str, replica_id: int) -> None:
         'WHERE service_name = ? AND replica_id = ?',
         (service_name, replica_id))
     conn.commit()
+
+
+# -- weight fan-out: quarantine + bucket-read leases ------------------------
+
+
+def set_fanout_quarantined(service_name: str, replica_id: int,
+                           quarantined: bool = True) -> None:
+    """Flip the fleet-wide integrity quarantine bit: a quarantined
+    replica is excluded from every future fan-out peer plan
+    (data/fanout.py). The row survives so operators can see WHY a
+    replica stopped serving peers."""
+    conn = _db()
+    conn.execute(
+        'UPDATE replicas SET fanout_quarantined = ? '
+        'WHERE service_name = ? AND replica_id = ?',
+        (int(bool(quarantined)), service_name, replica_id))
+    conn.commit()
+
+
+def list_fanout_quarantined(service_name: str) -> List[int]:
+    rows = _db().execute(
+        'SELECT replica_id FROM replicas WHERE service_name = ? '
+        'AND fanout_quarantined = 1', (service_name,)).fetchall()
+    return sorted(r['replica_id'] for r in rows)
+
+
+def try_acquire_fanout_lease(service_name: str, replica_id: int,
+                             bound: int, ttl: float,
+                             now: Optional[float] = None) -> bool:
+    """Crash-consistent bucket-read lease (convoy control): at most
+    ``bound`` live leases per service; a lease older than ``ttl``
+    is expired in-line so a puller that died holding one cannot
+    wedge the fleet. Re-acquiring an own live lease renews it.
+    Portable two-step upsert (sqlite < 3.24 has no upsert clause):
+    renewal UPDATE first, then a guarded INSERT..SELECT that keeps
+    the bound atomic under concurrent pullers on both sqlite and
+    Postgres."""
+    if now is None:
+        now = time.time()
+    horizon = now - ttl
+    conn = _db()
+    conn.execute('DELETE FROM fanout_leases WHERE service_name = ? '
+                 'AND acquired_at <= ?', (service_name, horizon))
+    cur = conn.execute(
+        'UPDATE fanout_leases SET acquired_at = ? '
+        'WHERE service_name = ? AND replica_id = ?',
+        (now, service_name, replica_id))
+    if cur.rowcount == 0:
+        conn.execute(
+            'INSERT INTO fanout_leases (service_name, replica_id, '
+            'acquired_at) '
+            'SELECT ?, ?, ? '
+            'WHERE (SELECT COUNT(*) FROM fanout_leases '
+            '       WHERE service_name = ? AND acquired_at > ?) < ?',
+            (service_name, replica_id, now, service_name, horizon,
+             int(bound)))
+    row = conn.execute(
+        'SELECT acquired_at FROM fanout_leases '
+        'WHERE service_name = ? AND replica_id = ?',
+        (service_name, replica_id)).fetchone()
+    conn.commit()
+    return row is not None and row['acquired_at'] > horizon
+
+
+def release_fanout_lease(service_name: str, replica_id: int) -> None:
+    conn = _db()
+    conn.execute(
+        'DELETE FROM fanout_leases WHERE service_name = ? '
+        'AND replica_id = ?', (service_name, replica_id))
+    conn.commit()
+
+
+def count_fanout_leases(service_name: str, ttl: float,
+                        now: Optional[float] = None) -> int:
+    """Live (unexpired) bucket-read leases — the controller exports
+    this as a gauge each tick."""
+    if now is None:
+        now = time.time()
+    row = _db().execute(
+        'SELECT COUNT(*) AS n FROM fanout_leases '
+        'WHERE service_name = ? AND acquired_at > ?',
+        (service_name, now - ttl)).fetchone()
+    return int(row['n']) if row else 0

@@ -369,8 +369,61 @@ TRANSFER_SECONDS = Histogram(
     'Wall-clock seconds per transfer-engine sync/copy operation',
     buckets=_TRANSFER_BUCKETS,
     labels=('direction',))
+TRANSFER_RETRIES = Counter(
+    'skyt_transfer_retries_total',
+    'Transfer-engine retry attempts by reason (server_backpressure = '
+    'delay floored by a 429/503 Retry-After, throttled = 429/503 '
+    'without one, timeout, connection, other)',
+    labels=('reason',))
 
-_TRANSFER_METRICS = [TRANSFER_BYTES, TRANSFER_OBJECTS, TRANSFER_SECONDS]
+# -- fleet weight distribution (data/fanout.py: peer fan-out with
+# integrity quarantine + lease-bounded bucket reads) -------------------
+
+FANOUT_SHARDS = Counter(
+    'skyt_fanout_shards_total',
+    'Fan-out shard fetches by source (peer, bucket) and outcome (ok, '
+    'corrupt = digest mismatch, error = source died/timed out, '
+    'resumed = continued a partial shard)',
+    labels=('source', 'outcome'))
+FANOUT_BYTES = Counter(
+    'skyt_fanout_bytes_total',
+    'Fan-out weight bytes received by source (peer, bucket)',
+    labels=('source',))
+FANOUT_HEALS = Counter(
+    'skyt_fanout_heals_total',
+    'Fan-out tree re-parent events by reason (dead = peer '
+    'unavailable/timeout, corrupt = digest mismatch)',
+    labels=('reason',))
+FANOUT_PULLS = Counter(
+    'skyt_fanout_pulls_total',
+    'Completed fan-out pulls by outcome (a pull = one replica '
+    'reaching verified-complete weights)',
+    labels=('outcome',))
+FANOUT_QUARANTINES = Counter(
+    'skyt_fanout_quarantines_total',
+    'Peers quarantined fleet-wide for serving corrupt shards',
+    labels=('service',))
+FANOUT_LEASE_WAIT = Histogram(
+    'skyt_fanout_lease_wait_seconds',
+    'Seconds a puller waited for a bucket-read lease (convoy '
+    'control: bounded to O(log N) concurrent origin readers)',
+    buckets=_TRANSFER_BUCKETS,
+    labels=())
+FANOUT_BUCKET_LEASES = Gauge(
+    'skyt_fanout_bucket_leases',
+    'Live bucket-read leases per service (controller tick; the '
+    'lease bound is ceil(log2(fleet+1)) unless overridden)',
+    labels=('service',))
+FANOUT_QUARANTINED = Gauge(
+    'skyt_fanout_quarantined_replicas',
+    'Replicas currently in fleet-wide integrity quarantine',
+    labels=('service',))
+
+_TRANSFER_METRICS = [TRANSFER_BYTES, TRANSFER_OBJECTS, TRANSFER_SECONDS,
+                     TRANSFER_RETRIES, FANOUT_SHARDS, FANOUT_BYTES,
+                     FANOUT_HEALS, FANOUT_PULLS, FANOUT_QUARANTINES,
+                     FANOUT_LEASE_WAIT, FANOUT_BUCKET_LEASES,
+                     FANOUT_QUARANTINED]
 
 # -- managed-job recovery / elastic resize (derived from the durable
 # jobs-DB recovery_events table on scrape: controllers run as detached

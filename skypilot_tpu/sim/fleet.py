@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from skypilot_tpu.data.fanout import bucket_lease_bound
 from skypilot_tpu.serve.autoscalers import (Autoscaler, DecisionOp,
                                             LoadStats)
 from skypilot_tpu.serve.serve_state import (REPLICA_TERMINAL_STATUSES,
@@ -62,7 +63,9 @@ class SimReplicaRecord:
                  'endpoint', 'is_spot', 'is_fallback', 'zone',
                  'launched_at', 'ready_at', 'consecutive_failures',
                  'lb_ewma_ms', 'lb_ejected', 'lb_ejected_until', 'cloud',
-                 'region', 'warm_since', 'ready_eta', '_domain')
+                 'region', 'warm_since', 'ready_eta', '_domain',
+                 'weights_ready', 'weights_eta', 'weights_src',
+                 'weights_wait_since')
 
     def __init__(self, replica_id: int, now: float, *, is_spot: bool,
                  is_fallback: bool = False,
@@ -89,6 +92,12 @@ class SimReplicaRecord:
         # Virtual time at which the pending provision/resume lands.
         self.ready_eta = now + provision_delay
         self._domain = domain
+        # Weight fan-out state (fleet.weights scenarios): a replica
+        # whose provision landed still gates READY on its weight pull.
+        self.weights_ready = provision_delay <= 0
+        self.weights_eta = None
+        self.weights_src = None
+        self.weights_wait_since = None
 
     def domain(self) -> Domain:
         if self._domain is None:
@@ -130,6 +139,23 @@ class FleetSim:
         self.spot = bool(fleet['spot'])
         self.max_queue_per_replica = float(fleet['max_queue_per_replica'])
         self.od_price_hr = float(fleet.get('od_price_hr', OD_PRICE_HR))
+
+        # -- weight distribution (fleet.weights) -----------------------
+        # Models the data/fanout.py pull path as fluid slots: a new
+        # replica's provision landing does NOT make it READY until its
+        # weight pull finishes; pulls ride a peer slot (each weight-
+        # complete replica serves `fanout` children) or one of the
+        # bucket_lease_bound(N) bucket leases.
+        weights_cfg = fleet.get('weights') or {}
+        self.weights_enabled = bool(
+            weights_cfg.get('enabled', bool(weights_cfg)))
+        self.weights_bucket_pull_s = float(
+            weights_cfg.get('bucket_pull_s', 60.0))
+        self.weights_peer_pull_s = float(
+            weights_cfg.get('peer_pull_s', 15.0))
+        self.weights_fanout = int(weights_cfg.get('fanout', 2))
+        self.weights_bucket_leases = int(
+            weights_cfg.get('bucket_leases', 0))
 
         self.spec = ServiceSpec(**scenario.service)
         # Ground-truth SLO the sim GRADES against (slo_miss_seconds).
@@ -243,6 +269,12 @@ class FleetSim:
         self._last_direction = 0
         self.ticks = 0
         self._provision_factor = 1.0
+        self.max_bucket_readers = 0
+        self.bucket_pulls = 0
+        self.peer_pulls = 0
+        self._bucket_inflight = 0
+        self._peer_inflight = 0
+        self.weights_times: List[float] = []
 
     # -- wiring --------------------------------------------------------
 
@@ -284,10 +316,72 @@ class FleetSim:
         return choice
 
     def preempt(self, record: SimReplicaRecord, reason: str) -> None:
+        self._release_weights_slot(record)
         record.status = ReplicaStatus.PREEMPTED
         record.warm_since = None
         self.preemptions += 1
         self.placer.handle_preemption(record.domain())
+
+    # -- weight distribution -------------------------------------------
+
+    def _assign_weight_sources(self, pending: List[SimReplicaRecord],
+                               t: float, n_ready: int) -> None:
+        """FIFO source assignment for replicas whose provision landed
+        but whose weight pull hasn't started. Peer slots go first (the
+        binary-tree rendezvous collapsed to a fluid slot count: every
+        weight-complete replica serves ``fanout`` children); the
+        bucket accepts at most ``bucket_lease_bound(N)`` concurrent
+        readers — the same lease rule the controller enforces."""
+        live = sum(1 for r in self.replicas
+                   if not r.status.is_terminal())
+        bound = self.weights_bucket_leases or bucket_lease_bound(live)
+        peer_free = n_ready * self.weights_fanout - self._peer_inflight
+        for record in pending:
+            if peer_free > 0:
+                peer_free -= 1
+                self._peer_inflight += 1
+                self.peer_pulls += 1
+                record.weights_src = 'peer'
+                record.weights_eta = t + self.weights_peer_pull_s
+            elif self._bucket_inflight < bound:
+                self._bucket_inflight += 1
+                self.bucket_pulls += 1
+                record.weights_src = 'bucket'
+                record.weights_eta = t + self.weights_bucket_pull_s
+            # else: every slot is busy — wait for the next tick.
+        if self._bucket_inflight > self.max_bucket_readers:
+            self.max_bucket_readers = self._bucket_inflight
+
+    def _finish_weights(self, record: SimReplicaRecord,
+                        t: float) -> None:
+        if record.weights_src == 'bucket':
+            self._bucket_inflight -= 1
+        elif record.weights_src == 'peer':
+            self._peer_inflight -= 1
+        record.weights_ready = True
+        record.weights_eta = None
+        record.weights_src = None
+        if record.weights_wait_since is not None:
+            self.weights_times.append(t - record.weights_wait_since)
+            record.weights_wait_since = None
+
+    def _release_weights_slot(self, record: SimReplicaRecord) -> None:
+        """A replica died mid-pull (preemption, failed provision):
+        free its transfer slot so the convoy doesn't leak capacity."""
+        if record.weights_ready or record.weights_eta is None:
+            return
+        if record.weights_src == 'bucket':
+            self._bucket_inflight -= 1
+        elif record.weights_src == 'peer':
+            self._peer_inflight -= 1
+        record.weights_eta = None
+        record.weights_src = None
+
+    def _weights_p99(self) -> float:
+        if not self.weights_times:
+            return 0.0
+        xs = sorted(self.weights_times)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
 
     # -- the controller tick -------------------------------------------
 
@@ -299,19 +393,37 @@ class FleetSim:
         # 1. readiness: pending provisions/resumes land (or fail, if
         # their region went down while they were in flight). One pass
         # also collects the READY set — the fleet scan is the hot loop.
+        # With fleet.weights, a landed provision holds in STARTING
+        # until its weight pull completes (warm resumes keep their
+        # weights — the delta-refresh path — so they skip the gate).
         ready = []
+        weights_pending = []
         for record in self.replicas:
             status = record.status
             if status in _PENDING and t >= record.ready_eta:
                 if record.region in self.down_regions:
                     record.status = ReplicaStatus.FAILED_PROVISION
                     self.provision_failures += 1
+                    self._release_weights_slot(record)
                     continue
+                if self.weights_enabled and not record.weights_ready:
+                    record.status = ReplicaStatus.STARTING
+                    if record.weights_eta is not None and \
+                            t >= record.weights_eta:
+                        self._finish_weights(record, t)
+                    else:
+                        if record.weights_wait_since is None:
+                            record.weights_wait_since = t
+                        if record.weights_eta is None:
+                            weights_pending.append(record)
+                        continue
                 record.status = status = ReplicaStatus.READY
                 record.ready_at = t
             if status is ReplicaStatus.READY:
                 ready.append(record)
         n_ready = len(ready)
+        if weights_pending:
+            self._assign_weight_sources(weights_pending, t, n_ready)
 
         # 2. arrivals (seeded Poisson per tenant).
         arrived = 0
@@ -424,6 +536,11 @@ class FleetSim:
         report.metric('sim_queue', t, self.queue)
         report.metric('sim_shed_total', t, self.shed_total)
         report.metric('sim_slo_miss_seconds', t, self.slo_miss_s)
+        if self.weights_enabled:
+            report.metric('sim_bucket_readers', t,
+                          float(self._bucket_inflight))
+            report.metric('sim_peer_pulls_inflight', t,
+                          float(self._peer_inflight))
 
     def _apply(self, decisions, t: float) -> None:
         ups = downs = warm_stops = resumes = 0
@@ -509,4 +626,8 @@ class FleetSim:
                 if r.status == ReplicaStatus.READY),
             'final_target': self.scaler._target,
             'lb_max_share': round(self.lb_max_share, 2),
+            'max_bucket_readers': self.max_bucket_readers,
+            'bucket_pulls': self.bucket_pulls,
+            'peer_pulls': self.peer_pulls,
+            'time_to_weights_p99_s': round(self._weights_p99(), 1),
         }

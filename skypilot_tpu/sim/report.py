@@ -29,10 +29,14 @@ __all__ = ['SimReport']
 #   max_final_queue: N            -> backlog drained by scenario end
 #   min_served_fraction: f        -> served_total/arrived_total >= f
 #   max_controller_faults: N      -> injected tick crashes tolerated
+#   max_bucket_readers: N         -> weight convoy stayed inside the
+#                                    bucket lease bound (fleet.weights)
+#   max_time_to_weights_p99_s: S  -> p99 landed-to-weights latency
 _INVARIANT_KEYS = ('no_lost_requests', 'max_shed_requests',
                    'max_slo_miss_seconds', 'max_target_flips',
                    'max_final_queue', 'min_served_fraction',
-                   'max_controller_faults')
+                   'max_controller_faults', 'max_bucket_readers',
+                   'max_time_to_weights_p99_s')
 
 
 class SimReport:
@@ -118,6 +122,12 @@ class SimReport:
                 actual = (s['served_total'] /
                           max(1, s['arrived_total']))
                 ok = actual >= bound
+            elif key == 'max_bucket_readers':
+                actual = s['max_bucket_readers']
+                ok = actual <= bound
+            elif key == 'max_time_to_weights_p99_s':
+                actual = s['time_to_weights_p99_s']
+                ok = actual <= bound
             else:  # max_controller_faults
                 actual = s['controller_faults']
                 ok = actual <= bound

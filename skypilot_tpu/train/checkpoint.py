@@ -22,11 +22,17 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from skypilot_tpu.utils import log
+from skypilot_tpu.data import ckpt_manifest
+from skypilot_tpu.utils import fault_injection, log
 
 logger = log.init_logger(__name__)
+
+# Chaos site between orbax's shard writes and the manifest commit —
+# the window where a killed save must stay invisible to latest_step
+# (tests/test_checkpoint_manifest.py injects a kill here).
+COMMIT_SITE = 'train.ckpt.commit'
 
 _managers: Dict[str, Tuple[Any, int]] = {}
 _managers_lock = threading.Lock()
@@ -73,21 +79,99 @@ def close_managers() -> None:
 
 def save(directory: str, step: int, tree: Any,
          max_to_keep: int = 3) -> None:
+    """Write step ``step`` and COMMIT it: after orbax finishes the
+    shard files, a content-addressed manifest (per-shard sha256) is
+    written tmp+rename-last into the step directory. The manifest is
+    the commit marker — :func:`latest_step` only reports steps that
+    have one, so a save killed between shard writes and the commit
+    is invisible rather than a restorable-looking torn checkpoint.
+    The manifest also feeds fleet weight fan-out and incremental
+    refresh (data/fanout.py, docs/weight_distribution.md)."""
     import orbax.checkpoint as ocp
     directory = os.path.abspath(os.path.expanduser(directory))
     os.makedirs(directory, exist_ok=True)
     mgr = _manager(directory, max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(tree))
     mgr.wait_until_finished()
+    fault_injection.inject(COMMIT_SITE)
+    step_dir = _step_dir(directory, step)
+    if step_dir is not None:
+        ckpt_manifest.write(step_dir,
+                            ckpt_manifest.build(step_dir, step=step))
+    else:  # pragma: no cover - orbax layout changed under us
+        logger.warning('step dir for %d not found under %s; manifest '
+                       'not committed', step, directory)
     logger.info('Saved checkpoint step %d to %s', step, directory)
 
 
+def _step_dir(directory: str, step: int) -> Optional[str]:
+    """The on-disk directory orbax wrote ``step`` into (digit-named
+    child whose int value is the step — tolerant of zero-padding)."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    for name in entries:
+        if name.isdigit() and int(name) == step:
+            full = os.path.join(directory, name)
+            if os.path.isdir(full):
+                return full
+    return None
+
+
+def _committed_steps(directory: str) -> Tuple[List[int], List[int]]:
+    """``(committed, uncommitted)`` step numbers by manifest
+    presence. A torn manifest reads as absent (ckpt_manifest.read),
+    so a crash mid-commit lands in ``uncommitted``."""
+    committed: List[int] = []
+    uncommitted: List[int] = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return committed, uncommitted
+    for name in entries:
+        full = os.path.join(directory, name)
+        if not (name.isdigit() and os.path.isdir(full)):
+            continue
+        if ckpt_manifest.read(full) is not None:
+            committed.append(int(name))
+        else:
+            uncommitted.append(int(name))
+    return committed, uncommitted
+
+
+def step_manifest(directory: str, step: int) -> Optional[dict]:
+    """The committed shard manifest of one step (None = step absent
+    or uncommitted) — what the serve controller hands fan-out
+    pullers and what incremental refresh diffs against."""
+    directory = os.path.abspath(os.path.expanduser(directory))
+    step_dir = _step_dir(directory, step)
+    if step_dir is None:
+        return None
+    return ckpt_manifest.read(step_dir)
+
+
 def latest_step(directory: str) -> Optional[int]:
-    """Newest checkpointed step, or None. Pure read: no directory is
-    created and no manager is torn down per call."""
+    """Newest COMMITTED step, or None. Pure read: no directory is
+    created and no manager is torn down per call.
+
+    Discovery is gated on the manifest commit marker: a step whose
+    save died between orbax's shard writes and the manifest commit
+    must not be offered for restore. Legacy directories written
+    before manifests existed (steps present, no manifest anywhere)
+    fall back to orbax's own discovery so old checkpoints stay
+    restorable."""
     directory = os.path.abspath(os.path.expanduser(directory))
     if not os.path.isdir(directory):
         return None
+    committed, uncommitted = _committed_steps(directory)
+    if committed:
+        if uncommitted:
+            logger.warning(
+                'Ignoring uncommitted checkpoint step(s) %s in %s '
+                '(save died before manifest commit)',
+                sorted(uncommitted), directory)
+        return max(committed)
     mgr = _manager(directory)
     # The cached manager snapshots the step list at construction; a
     # checkpoint written by ANOTHER process (the pre-preemption
@@ -98,7 +182,13 @@ def latest_step(directory: str) -> Optional[int]:
             reload_fn()
         except Exception:  # pylint: disable=broad-except
             pass
-    return mgr.latest_step()
+    step = mgr.latest_step()
+    if step is not None and uncommitted:
+        logger.warning(
+            'Directory %s has pre-manifest checkpoints; returning '
+            'orbax latest step %d without commit-marker gating',
+            directory, step)
+    return step
 
 
 def restore(directory: str, step: int, target: Any) -> Any:

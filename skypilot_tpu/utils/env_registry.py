@@ -402,6 +402,30 @@ DECLARATIONS: List[EnvVar] = _decl([
     ('SKYT_AZURE_BLOB_ENDPOINT', 'url', None,
      'Azure Blob endpoint override (tests point it at the fake).'),
 
+    # -- weight fan-out (data/fanout.py) ----------------------------
+    ('SKYT_FANOUT', 'bool', False,
+     'Peer weight fan-out for serve replicas: new replicas pull '
+     'checkpoint shards from READY peers over a binary tree instead '
+     'of each hitting the bucket (docs/weight_distribution.md).'),
+    ('SKYT_FANOUT_DEGREE', 'int', 2,
+     'Fan-out tree arity: children a serving peer feeds '
+     'concurrently.'),
+    ('SKYT_FANOUT_BUCKET_LEASES', 'int', 0,
+     'Concurrent bucket-read leases during fan-out (convoy '
+     'control); 0 = auto ceil(log2(fleet+1)).'),
+    ('SKYT_FANOUT_LEASE_TTL', 'float', 120.0,
+     'Seconds before a bucket-read lease held by a dead puller '
+     'expires and frees its slot.'),
+    ('SKYT_FANOUT_PEER_TIMEOUT', 'float', 30.0,
+     'Per-request timeout on peer shard fetches; a slow/hung peer '
+     'is healed past after this long.'),
+    ('SKYT_FANOUT_PEERS', 'str', None,
+     'Payload: JSON peer plan (ancestor chain) the controller hands '
+     'a launching replica.', True),
+    ('SKYT_FANOUT_DIR', 'path', None,
+     'Payload: directory a replica pulls weights into and serves '
+     'peers from (/fanout endpoints).', True),
+
     # -- inference --------------------------------------------------
     ('SKYT_INFER_BLOCK_SIZE', 'int', 16,
      'Paged KV cache block size (tokens per block).'),

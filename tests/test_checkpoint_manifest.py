@@ -1,0 +1,153 @@
+"""Content-addressed checkpoint manifests + the commit-marker gate.
+
+The contract under test (docs/weight_distribution.md): a checkpoint
+directory is restorable iff its manifest committed — a save killed
+between shard writes and the manifest commit must be invisible to
+``latest_step`` (never offered for restore), and a torn manifest reads
+as absent rather than as an error (the r14 torn-tail rule).
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.data import ckpt_manifest
+
+from fault_injection import clause, inject_faults
+
+
+def _write_shards(root, files):
+    for rel, data in files.items():
+        full = os.path.join(root, *rel.split('/'))
+        os.makedirs(os.path.dirname(full) or str(root), exist_ok=True)
+        with open(full, 'wb') as f:
+            f.write(data)
+
+
+# -- manifest mechanics ------------------------------------------------
+
+
+def test_build_write_read_roundtrip(tmp_path):
+    root = str(tmp_path)
+    _write_shards(root, {'a.bin': b'alpha', 'sub/b.bin': b'beta' * 100})
+    payload = ckpt_manifest.build(root, step=7)
+    assert payload['step'] == 7
+    assert [s['path'] for s in payload['shards']] == ['a.bin',
+                                                      'sub/b.bin']
+    ckpt_manifest.write(root, payload)
+    assert ckpt_manifest.read(root) == payload
+    # The manifest never lists itself or tmp files.
+    _write_shards(root, {f'c{ckpt_manifest.TMP_INFIX}.part': b'x'})
+    rebuilt = ckpt_manifest.build(root)
+    assert [s['path'] for s in rebuilt['shards']] == ['a.bin',
+                                                      'sub/b.bin']
+
+
+def test_missing_and_torn_manifests_read_as_absent(tmp_path):
+    root = str(tmp_path)
+    assert ckpt_manifest.read(root) is None
+    _write_shards(root, {'a.bin': b'alpha'})
+    path = ckpt_manifest.write(root, ckpt_manifest.build(root))
+    # Torn tail: truncate mid-document.
+    with open(path, 'rb') as f:
+        raw = f.read()
+    with open(path, 'wb') as f:
+        f.write(raw[:len(raw) // 2])
+    assert ckpt_manifest.read(root) is None
+    # Parseable but checksum-failing payload (bit flip after commit).
+    doc = json.loads(raw)
+    doc['payload']['shards'][0]['sha256'] = '0' * 64
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(doc, f)
+    assert ckpt_manifest.read(root) is None
+    # Wrong format marker.
+    doc = json.loads(raw)
+    doc['format'] = 'someone-elses-manifest'
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(doc, f)
+    assert ckpt_manifest.read(root) is None
+
+
+def test_diff_moves_only_changed_shards(tmp_path):
+    old_dir, new_dir = str(tmp_path / 'old'), str(tmp_path / 'new')
+    _write_shards(old_dir, {'a.bin': b'alpha', 'b.bin': b'beta'})
+    _write_shards(new_dir, {'a.bin': b'alpha', 'b.bin': b'BETA2',
+                            'c.bin': b'new'})
+    old = ckpt_manifest.build(old_dir)
+    new = ckpt_manifest.build(new_dir)
+    # Cold start: everything moves.
+    assert ckpt_manifest.diff(None, new) == new['shards']
+    moved = [s['path'] for s in ckpt_manifest.diff(old, new)]
+    assert moved == ['b.bin', 'c.bin']
+    assert ckpt_manifest.diff(new, new) == []
+
+
+def test_verify_flags_missing_and_corrupt_shards(tmp_path):
+    root = str(tmp_path)
+    _write_shards(root, {'a.bin': b'alpha', 'b.bin': b'beta'})
+    payload = ckpt_manifest.build(root)
+    assert ckpt_manifest.verify(root, payload) == []
+    os.remove(os.path.join(root, 'a.bin'))
+    with open(os.path.join(root, 'b.bin'), 'wb') as f:
+        f.write(b'bXta')
+    bad = sorted(s['path'] for s in ckpt_manifest.verify(root, payload))
+    assert bad == ['a.bin', 'b.bin']
+
+
+# -- the save commit marker --------------------------------------------
+
+
+def _tiny_tree(scale=1.0):
+    import numpy as np
+    return {'w': np.arange(16, dtype=np.float32) * scale,
+            'b': np.ones((4,), dtype=np.float32) * scale}
+
+
+def test_save_commits_manifest_and_latest_step_reads_it(tmp_path):
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    d = str(tmp_path / 'ck')
+    ckpt_lib.save(d, 3, _tiny_tree())
+    assert ckpt_lib.latest_step(d) == 3
+    manifest = ckpt_lib.step_manifest(d, 3)
+    assert manifest is not None and manifest['step'] == 3
+    assert manifest['shards'], 'orbax wrote no shard files?'
+    step_dir = ckpt_lib._step_dir(d, 3)
+    assert ckpt_manifest.verify(step_dir, manifest) == []
+
+
+@pytest.mark.chaos
+def test_save_killed_before_commit_is_invisible(tmp_path):
+    """Regression (ISSUE r17 satellite): a save killed between orbax's
+    shard writes and the manifest commit must never be offered for
+    restore — latest_step keeps returning the previous committed step,
+    and a subsequent save recovers."""
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    d = str(tmp_path / 'ck')
+    ckpt_lib.save(d, 1, _tiny_tree())
+    assert ckpt_lib.latest_step(d) == 1
+
+    with inject_faults(clause(ckpt_lib.COMMIT_SITE, 'OSError',
+                              times=1)):
+        with pytest.raises(OSError):
+            ckpt_lib.save(d, 2, _tiny_tree(2.0))
+
+    # Step 2's shard files exist on disk, but without its commit
+    # marker the checkpoint is invisible.
+    assert ckpt_lib._step_dir(d, 2) is not None
+    assert ckpt_lib.step_manifest(d, 2) is None
+    assert ckpt_lib.latest_step(d) == 1
+
+    # The relaunched job saves the next step; discovery moves on.
+    ckpt_lib.save(d, 3, _tiny_tree(3.0))
+    assert ckpt_lib.latest_step(d) == 3
+
+
+def test_latest_step_legacy_fallback_without_manifests(tmp_path):
+    """Directories written before manifests existed (no step has one)
+    still restore via orbax's own discovery."""
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    d = str(tmp_path / 'ck')
+    ckpt_lib.save(d, 5, _tiny_tree())
+    step_dir = ckpt_lib._step_dir(d, 5)
+    os.remove(ckpt_manifest.manifest_path(step_dir))
+    assert ckpt_lib.latest_step(d) == 5

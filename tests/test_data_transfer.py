@@ -426,3 +426,87 @@ def test_stat_miss_hash_confirm_skips_unchanged(s3_env, tmp_path,
 
 # Reuse the SharedKey fake from the Azure suite (fixture defined there).
 from test_azure_blob import fake_azure  # noqa: E402,F401
+
+
+# -- Retry-After backpressure floor (ISSUE r17 satellite) --------------
+
+
+def test_retry_after_header_parsing():
+    parse = s3_lib._retry_after_seconds
+    assert parse(503, {'Retry-After': '2.5'}) == 2.5
+    assert parse(429, {'Retry-After': '0'}) == 0.0
+    assert parse(429, {'Retry-After': '-3'}) == 0.0  # clamped at 0
+    assert parse(200, {'Retry-After': '2'}) is None  # only 429/503
+    assert parse(503, {}) is None
+    assert parse(503, None) is None
+    # HTTP-date form is not honored (needs wall-clock math) — callers
+    # fall back to their own backoff rather than mis-sleep.
+    assert parse(503,
+                 {'Retry-After': 'Wed, 21 Oct 2015 07:28:00 GMT'}) \
+        is None
+
+
+def test_retry_after_floors_backoff_and_counts_reasons(monkeypatch):
+    """A 503 carrying Retry-After must delay AT LEAST that long (the
+    server named its recovery horizon; our jittered backoff base is
+    0.05s) and count as server_backpressure; a bare 429 keeps the
+    jittered delay and counts as throttled."""
+    import threading
+    naps = []
+    monkeypatch.setattr(transfer_engine.time, 'sleep', naps.append)
+    engine = transfer_engine.TransferEngine(max_attempts=3)
+    result = transfer_engine.TransferResult()
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] == 1:
+            raise exceptions.StorageError(
+                'slow down', http_status=503, retry_after=7.0)
+        if calls[0] == 2:
+            raise exceptions.StorageError('throttled', http_status=429)
+        return 'ok'
+
+    before_bp = _counter_value(metrics.TRANSFER_RETRIES,
+                               reason='server_backpressure')
+    before_th = _counter_value(metrics.TRANSFER_RETRIES,
+                               reason='throttled')
+    assert engine._attempt('up', result, threading.Lock(),
+                           flaky) == 'ok'
+    assert result.retries == 2
+    assert naps[0] >= 7.0, 'Retry-After must floor the backoff delay'
+    assert naps[1] < 7.0, 'no floor without the header'
+    assert _counter_value(metrics.TRANSFER_RETRIES,
+                          reason='server_backpressure') == before_bp + 1
+    assert _counter_value(metrics.TRANSFER_RETRIES,
+                          reason='throttled') == before_th + 1
+
+
+def test_retry_reason_classification():
+    reason = transfer_engine._retry_reason
+    err = exceptions.StorageError
+    assert reason(err('x', http_status=503, retry_after=1.0),
+                  1.0) == 'server_backpressure'
+    assert reason(err('x', http_status=429), None) == 'throttled'
+    assert reason(TimeoutError(), None) == 'timeout'
+    assert reason(ConnectionResetError(), None) == 'connection'
+    assert reason(err('x', http_status=500), None) == 'other'
+    assert reason(OSError('io'), None) == 'other'
+
+
+def test_s3_storage_errors_carry_retry_after(s3_env, monkeypatch):
+    """End to end through the real HTTP client: a 429/503 answer with
+    a numeric Retry-After lands on StorageError.retry_after."""
+    client = _client()
+    client.create_bucket('rb')
+    real_send = client._send
+
+    def throttling_send(req, timeout=60):
+        status, headers, body = real_send(req, timeout=timeout)
+        return 503, {'Retry-After': '9'}, body
+
+    monkeypatch.setattr(client, '_send', throttling_send)
+    with pytest.raises(exceptions.StorageError) as err:
+        client.put_object('rb', 'k', b'data')
+    assert err.value.http_status == 503
+    assert err.value.retry_after == 9.0

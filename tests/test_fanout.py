@@ -1,0 +1,485 @@
+"""Self-healing fleet weight fan-out (data/fanout.py).
+
+Covers the full failure matrix of docs/weight_distribution.md: tree
+topology, lease-bounded bucket convoy control, peer death re-parenting
+(parent -> grandparent -> bucket), corrupt-peer quarantine (digest
+mismatch on single-source bytes), cross-source resume of partial
+shards, and the chaos drill — 30% of peers killed mid-fan-out plus one
+corrupt-serving peer, with every replica required to land a
+verified-complete copy and bucket reads bounded by the lease.
+
+Chaos sites exercised here: ``data.fanout.peer_get`` and
+``data.fanout.lease`` (SKYT_FAULT_SPEC grammar).
+"""
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.data import ckpt_manifest, fanout
+from skypilot_tpu.server import metrics
+
+from fault_injection import clause, inject_faults
+
+
+# -- fixtures ----------------------------------------------------------
+
+
+def _make_weights(root, files=None):
+    files = files or {'model/a.bin': b'alpha' * 4000,
+                      'model/b.bin': b'beta' * 2000,
+                      'meta.json': b'{"step": 1}'}
+    for rel, data in files.items():
+        full = os.path.join(root, *rel.split('/'))
+        os.makedirs(os.path.dirname(full) or root, exist_ok=True)
+        with open(full, 'wb') as f:
+            f.write(data)
+    payload = ckpt_manifest.build(root, step=1)
+    ckpt_manifest.write(root, payload)
+    return payload
+
+
+def _dir_source(name, root, is_peer=True):
+    """A CallableSource serving shard bytes from a weights dir."""
+    def fn(shard, offset):
+        full = os.path.join(root, *shard['path'].split('/'))
+        with open(full, 'rb') as f:
+            f.seek(offset)
+            return f.read()
+    return fanout.CallableSource(name, fn, is_peer=is_peer)
+
+
+def _counter_value(counter, **labels):
+    key = tuple(sorted(labels.items()))
+    return counter._values.get(key, 0.0)
+
+
+# -- topology ----------------------------------------------------------
+
+
+def test_tree_topology_and_heal_order():
+    assert fanout.tree_parent(0) is None
+    assert fanout.tree_parent(1) == 0
+    assert fanout.tree_parent(2) == 0
+    assert fanout.tree_parent(5) == 2
+    assert fanout.tree_ancestors(0) == []
+    # Heal order is parent-first, ending at the root (the bucket's
+    # first child).
+    assert fanout.tree_ancestors(5) == [2, 0]
+    assert fanout.tree_ancestors(14, arity=2) == [6, 2, 0]
+    # Higher arity flattens the tree.
+    assert fanout.tree_ancestors(5, arity=4) == [1, 0]
+
+
+def test_bucket_lease_bound_is_logarithmic():
+    assert fanout.bucket_lease_bound(0) == 1
+    assert fanout.bucket_lease_bound(1) == 1
+    assert fanout.bucket_lease_bound(7) == 3
+    assert fanout.bucket_lease_bound(1000) == 10
+    assert fanout.bucket_lease_bound(10000) == 14
+    # Explicit override wins.
+    assert fanout.bucket_lease_bound(10000, configured=3) == 3
+
+
+# -- leases ------------------------------------------------------------
+
+
+def test_lease_manager_bound_renewal_and_ttl():
+    clock = [0.0]
+    lease = fanout.LeaseManager(bound=2, ttl=60.0,
+                                clock=lambda: clock[0])
+    assert lease.try_acquire('a')
+    assert lease.try_acquire('b')
+    assert not lease.try_acquire('c'), 'bound=2 must refuse a third'
+    # Re-acquire renews, not double-counts.
+    assert lease.try_acquire('a')
+    assert lease.active() == 2
+    lease.release('a')
+    assert lease.try_acquire('c')
+    # A holder that dies frees its slot after the TTL.
+    clock[0] = 61.0
+    assert lease.try_acquire('d')
+    assert lease.max_active == 2
+
+
+@pytest.mark.chaos
+def test_lease_site_faults_surface_to_caller():
+    lease = fanout.LeaseManager(bound=1)
+    with inject_faults(clause(fanout.LEASE_SITE, 'OSError', times=1)):
+        with pytest.raises(OSError):
+            lease.try_acquire('a')
+        assert lease.try_acquire('a')
+
+
+def test_db_lease_bound_ttl_and_release(tmp_home):
+    from skypilot_tpu.serve import serve_state as ss
+    now = 1000.0
+    assert ss.try_acquire_fanout_lease('svc', 1, 2, 120.0, now=now)
+    assert ss.try_acquire_fanout_lease('svc', 2, 2, 120.0, now=now)
+    assert not ss.try_acquire_fanout_lease('svc', 3, 2, 120.0, now=now)
+    # Renewal of an own live lease succeeds without consuming a slot.
+    assert ss.try_acquire_fanout_lease('svc', 1, 2, 120.0, now=now + 5)
+    assert ss.count_fanout_leases('svc', 120.0, now=now + 5) == 2
+    ss.release_fanout_lease('svc', 2)
+    assert ss.try_acquire_fanout_lease('svc', 3, 2, 120.0, now=now + 6)
+    # Stale leases expire: far future, everything is reclaimable.
+    assert ss.try_acquire_fanout_lease('svc', 4, 2, 120.0,
+                                       now=now + 500)
+    assert ss.count_fanout_leases('svc', 120.0, now=now + 500) == 1
+
+
+# -- peer-serving endpoint ---------------------------------------------
+
+
+def test_handle_peer_get_serves_manifest_and_shards(tmp_path):
+    root = str(tmp_path)
+    payload = _make_weights(root)
+    status, _, body = fanout.handle_peer_get('/fanout/manifest', root)
+    assert status == 200
+    assert json.loads(body) == payload
+    shard = payload['shards'][0]
+    status, headers, body = fanout.handle_peer_get(
+        f'/fanout/shard/{shard["sha256"]}', root)
+    assert status == 200
+    assert len(body) == shard['size']
+    assert headers['X-Skyt-Shard-Sha256'] == shard['sha256']
+    # Range resume: the tail from a byte offset, 206 + Content-Range.
+    status, headers, tail = fanout.handle_peer_get(
+        f'/fanout/shard/{shard["sha256"]}', root,
+        range_header='bytes=100-')
+    assert status == 206
+    assert tail == body[100:]
+    assert headers['Content-Range'].startswith('bytes 100-')
+    # Unknown digest, torn manifest, unconfigured dir.
+    assert fanout.handle_peer_get('/fanout/shard/' + '0' * 64,
+                                  root)[0] == 404
+    os.remove(ckpt_manifest.manifest_path(root))
+    assert fanout.handle_peer_get('/fanout/manifest', root)[0] == 404
+    assert fanout.handle_peer_get('/fanout/manifest', '')[0] == 503
+
+
+def test_peer_server_http_roundtrip_with_resume(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    payload = _make_weights(src)
+    with fanout.PeerServer(src) as server:
+        with urllib.request.urlopen(
+                f'{server.endpoint}/fanout/manifest') as resp:
+            assert json.loads(resp.read()) == payload
+        source = fanout.HTTPPeerSource(1, server.endpoint, timeout=5.0)
+        bucket = _dir_source('bucket', src, is_peer=False)
+        result = fanout.FanoutPuller(payload, dst, [source],
+                                     bucket).pull()
+    assert result['fetched'] == len(payload['shards'])
+    assert set(result['sources'].values()) == {'peer:1'}
+    assert ckpt_manifest.verify(dst, payload) == []
+    assert ckpt_manifest.read(dst) == payload
+
+
+def test_http_peer_death_surfaces_as_peer_unavailable(tmp_path):
+    src = str(tmp_path / 'src')
+    payload = _make_weights(src)
+    server = fanout.PeerServer(src)
+    with server:
+        pass  # started and stopped: the port is now dead
+    source = fanout.HTTPPeerSource(1, server.endpoint, timeout=0.5)
+    with pytest.raises(fanout.PeerUnavailable):
+        list(source.fetch(payload['shards'][0], 0))
+
+
+# -- the puller: delta refresh, resume, healing ------------------------
+
+
+def test_warm_delta_refresh_moves_only_changed_shards(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    old = _make_weights(src)
+    bucket = _dir_source('bucket', src, is_peer=False)
+    first = fanout.FanoutPuller(old, dst, [], bucket).pull()
+    assert first['fetched'] == 3
+
+    # New step: one shard changes, the rest are content-identical.
+    with open(os.path.join(src, 'model', 'a.bin'), 'wb') as f:
+        f.write(b'ALPHA2' * 4000)
+    new = ckpt_manifest.build(src, step=2)
+    ckpt_manifest.write(src, new)
+    second = fanout.FanoutPuller(new, dst, [], bucket).pull()
+    assert second['fetched'] == 1, 'delta refresh must move only the '\
+        'changed shard'
+    assert second['skipped'] == 2
+    assert ckpt_manifest.verify(dst, new) == []
+
+
+def test_partial_shard_resumes_from_byte_offset(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    payload = _make_weights(src)
+    shard = payload['shards'][0]
+    # A previous (preempted) pull left half the shard in the
+    # deterministic tmp file.
+    full_src = os.path.join(src, *shard['path'].split('/'))
+    with open(full_src, 'rb') as f:
+        half = f.read(shard['size'] // 2)
+    final = os.path.join(dst, *shard['path'].split('/'))
+    os.makedirs(os.path.dirname(final))
+    with open(f'{final}{ckpt_manifest.TMP_INFIX}.part', 'wb') as f:
+        f.write(half)
+
+    offsets = []
+
+    def fn(s, offset):
+        offsets.append((s['path'], offset))
+        with open(os.path.join(src, *s['path'].split('/')), 'rb') as f:
+            f.seek(offset)
+            return f.read()
+
+    bucket = fanout.CallableSource('bucket', fn, is_peer=False)
+    fanout.FanoutPuller(payload, dst, [], bucket).pull()
+    assert (shard['path'], len(half)) in offsets, \
+        'resume must request the remainder, not the whole shard'
+    assert ckpt_manifest.verify(dst, payload) == []
+
+
+def test_oversized_partial_is_discarded_not_resumed(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    payload = _make_weights(src)
+    shard = payload['shards'][0]
+    final = os.path.join(dst, *shard['path'].split('/'))
+    os.makedirs(os.path.dirname(final))
+    with open(f'{final}{ckpt_manifest.TMP_INFIX}.part', 'wb') as f:
+        f.write(b'x' * (shard['size'] + 100))
+    bucket = _dir_source('bucket', src, is_peer=False)
+    fanout.FanoutPuller(payload, dst, [], bucket).pull()
+    assert ckpt_manifest.verify(dst, payload) == []
+
+
+def test_dead_parent_heals_to_grandparent_then_bucket(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    payload = _make_weights(src)
+
+    def dead(shard, offset):
+        raise ConnectionError('injected: peer died')
+
+    parent = fanout.CallableSource('peer:parent', dead)
+    grandparent = _dir_source('peer:grandparent', src)
+    bucket = _dir_source('bucket', src, is_peer=False)
+    puller = fanout.FanoutPuller(payload, dst, [parent, grandparent],
+                                 bucket)
+    result = puller.pull()
+    assert result['heals'] == 1
+    assert puller.heals[0][0] == 'peer:parent'
+    assert set(result['sources'].values()) == {'peer:grandparent'}
+    assert ckpt_manifest.verify(dst, payload) == []
+
+
+def test_corrupt_peer_is_reported_and_healed(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    payload = _make_weights(src)
+
+    corrupt = fanout.CallableSource(
+        'peer:evil', lambda s, o: b'\x00' * (s['size'] - o))
+    bucket = _dir_source('bucket', src, is_peer=False)
+    reported = []
+    lease = fanout.LeaseManager(bound=1)
+    puller = fanout.FanoutPuller(
+        payload, dst, [corrupt], bucket, lease=lease,
+        on_corrupt=lambda source, shard: reported.append(source.name))
+    result = puller.pull()
+    assert reported == ['peer:evil'], \
+        'whole-shard digest mismatch must report exactly one corruption'
+    assert result['heals'] == 1
+    assert set(result['sources'].values()) == {'bucket'}
+    assert ckpt_manifest.verify(dst, payload) == []
+
+
+def test_bucket_digest_mismatch_is_authoritative(tmp_path):
+    src = str(tmp_path / 'src')
+    dst = str(tmp_path / 'dst')
+    payload = _make_weights(src)
+    bad_bucket = fanout.CallableSource(
+        'bucket', lambda s, o: b'\xff' * (s['size'] - o),
+        is_peer=False)
+    with pytest.raises(fanout.ShardCorrupt):
+        fanout.FanoutPuller(payload, dst, [], bad_bucket).pull()
+    # No manifest committed for the failed pull.
+    assert ckpt_manifest.read(dst) is None
+
+
+def test_lease_gates_bucket_and_times_out(tmp_path):
+    src = str(tmp_path / 'src')
+    payload = _make_weights(src)
+    bucket = _dir_source('bucket', src, is_peer=False)
+    lease = fanout.LeaseManager(bound=1, ttl=3600.0)
+    assert lease.try_acquire('hog')
+    naps = []
+    puller = fanout.FanoutPuller(
+        payload, str(tmp_path / 'dst'), [], bucket, lease=lease,
+        holder='puller', lease_wait_s=0.5, sleep=naps.append)
+    with pytest.raises(fanout.PeerUnavailable, match='lease'):
+        puller.pull()
+    assert naps, 'the puller must back off while waiting'
+    lease.release('hog')
+    result = puller.pull()
+    assert result['fetched'] + result['skipped'] == 3
+    assert lease.active() == 0, 'lease released after the pull'
+
+
+# -- controller planning + quarantine ----------------------------------
+
+
+def _seed_fleet(service, n):
+    from skypilot_tpu.serve import serve_state as ss
+    for rid in range(1, n + 1):
+        ss.add_replica(service, rid, f'c{rid}', is_spot=False)
+        ss.set_replica_status(service, rid, ss.ReplicaStatus.READY)
+        ss.set_replica_endpoint(service, rid,
+                                f'http://10.0.0.{rid}:8000', None)
+
+
+def test_plan_for_new_replica_hands_out_ancestor_chain(tmp_home):
+    _seed_fleet('plansvc', 3)
+    plan = fanout.plan_for_new_replica('plansvc', 99, arity=2)
+    assert plan['position'] == 3
+    # Ancestors of heap position 3 are [1, 0] -> replicas 2 and 1
+    # (join order is ready_at then id).
+    assert [p['replica_id'] for p in plan['peers']] == [2, 1]
+    assert all(p['endpoint'].startswith('http://')
+               for p in plan['peers'])
+    sources = fanout.sources_from_plan(plan, timeout=1.0)
+    assert [s.replica_id for s in sources] == [2, 1]
+
+
+def test_quarantined_peer_is_excluded_from_future_plans(tmp_home):
+    from skypilot_tpu.serve import serve_state as ss
+    _seed_fleet('qsvc', 3)
+    before = _counter_value(metrics.FANOUT_QUARANTINES, service='qsvc')
+    fanout.quarantine_peer('qsvc', 2, 'digest mismatch on shard')
+    assert ss.list_fanout_quarantined('qsvc') == [2]
+    assert _counter_value(metrics.FANOUT_QUARANTINES,
+                          service='qsvc') == before + 1
+    plan = fanout.plan_for_new_replica('qsvc', 99, arity=2)
+    peer_ids = [p['replica_id'] for p in plan['peers']]
+    assert 2 not in peer_ids
+    # The fleet shrank to 2 eligible peers: position follows.
+    assert plan['position'] == 2
+    # Quarantine survives a fresh read and is idempotent.
+    fanout.quarantine_peer('qsvc', 2, 'again')
+    record = ss.get_replica('qsvc', 2)
+    assert record.fanout_quarantined
+    assert record.to_dict()['fanout_quarantined'] is True
+
+
+# -- the chaos drill ---------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_drill_30pct_peer_kill_plus_corrupt_peer_converges(tmp_path):
+    """The ISSUE r17 acceptance drill, in-process: a fleet fans out
+    from one bucket while ~30% of peer fetches die mid-transfer and
+    one peer serves corrupt bytes. Every replica must end with a
+    verified-complete copy (zero corrupt loads), the corrupt peer is
+    reported for quarantine, and concurrent bucket reads never exceed
+    the O(log N) lease bound."""
+    n = 16
+    src = str(tmp_path / 'bucket')
+    payload = _make_weights(src)
+    bound = fanout.bucket_lease_bound(n)
+    lease = fanout.LeaseManager(bound=bound, ttl=3600.0)
+    bucket = _dir_source('bucket', src, is_peer=False)
+    corrupt_reports = []
+    completed = []   # dests with a verified copy, join order
+
+    with inject_faults(
+            clause(fanout.PEER_GET_SITE, 'ConnectionError',
+                   p=0.3, seed=1702)):
+        for position in range(n):
+            dst = str(tmp_path / f'replica{position}')
+            sources = []
+            for ancestor in fanout.tree_ancestors(position, arity=2):
+                if ancestor == 1:
+                    # Peer 1 serves corrupt bytes to every child.
+                    sources.append(fanout.CallableSource(
+                        'peer:1',
+                        lambda s, o: b'\x00' * (s['size'] - o)))
+                elif ancestor < len(completed):
+                    sources.append(_dir_source(f'peer:{ancestor}',
+                                               completed[ancestor]))
+            puller = fanout.FanoutPuller(
+                payload, dst, sources, bucket, lease=lease,
+                holder=f'replica{position}', lease_wait_s=30.0,
+                sleep=lambda _s: None,
+                on_corrupt=lambda source, shard:
+                    corrupt_reports.append(source.name))
+            result = puller.pull()
+            assert result['fetched'] + result['skipped'] == \
+                len(payload['shards'])
+            completed.append(dst)
+
+    # Convergence: every replica holds a verified-complete copy.
+    assert len(completed) == n
+    for dst in completed:
+        assert ckpt_manifest.verify(dst, payload) == [], \
+            f'{dst} converged with corrupt/missing shards'
+        assert ckpt_manifest.read(dst) == payload
+    # Zero corrupt loads ever committed; the corrupt peer was caught.
+    assert set(corrupt_reports) == {'peer:1'}
+    # Convoy control held under churn.
+    assert lease.max_active <= bound
+
+
+@pytest.mark.chaos
+def test_drill_concurrent_pullers_respect_lease_bound(tmp_path):
+    """Threaded variant: every puller goes straight to the bucket at
+    once; the lease keeps concurrent bucket readers at the bound while
+    all of them eventually finish."""
+    n = 8
+    src = str(tmp_path / 'bucket')
+    payload = _make_weights(src)
+    bound = fanout.bucket_lease_bound(n)
+    lease = fanout.LeaseManager(bound=bound, ttl=3600.0)
+    in_bucket = []
+    peak = [0]
+    gate = threading.Lock()
+
+    def fn(shard, offset):
+        with gate:
+            in_bucket.append(1)
+            peak[0] = max(peak[0], len(in_bucket))
+        try:
+            with open(os.path.join(src, *shard['path'].split('/')),
+                      'rb') as f:
+                f.seek(offset)
+                return f.read()
+        finally:
+            with gate:
+                in_bucket.pop()
+
+    errors = []
+
+    def run(position):
+        try:
+            bucket = fanout.CallableSource('bucket', fn, is_peer=False)
+            fanout.FanoutPuller(
+                payload, str(tmp_path / f'r{position}'), [], bucket,
+                lease=lease, holder=f'r{position}',
+                lease_wait_s=30.0).pull()
+        except Exception as exc:  # pylint: disable=broad-except
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert peak[0] <= bound, \
+        f'{peak[0]} concurrent bucket readers exceeded bound {bound}'
+    for i in range(n):
+        assert ckpt_manifest.verify(str(tmp_path / f'r{i}'),
+                                    payload) == []

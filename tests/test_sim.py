@@ -204,6 +204,7 @@ _LIBRARY_SCALE = {
     'thundering_herd_wake': 0.05,
     'hot_tenant_flood': 0.05,
     'weight_rollout_surge': 0.05,
+    'cold_start_convoy': 0.05,
 }
 
 
