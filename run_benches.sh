@@ -197,6 +197,18 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_sim.py \
   | tee "BENCH_sim_${suffix}.json"
 echo "rc=$? -> BENCH_sim_${suffix}.json" >&2
 
+# disagg bench: CPU-only — disaggregated prefill/decode serving
+# (r18): measured colocated prefill->decode interference + the
+# DistServe fleet arithmetic (acceptance: >=1.3x goodput/chip at
+# equal HBM), per-replica TTFT under decode saturation, shared-prefix
+# delta migration block counters, the transfer keep-alive pool at
+# 16-way ranged pulls, and the disagg_saturation sim drill
+# (docs/disaggregated_serving.md, numbers in PERF.md).
+echo "=== bench disagg ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_disagg.py \
+  | tee "BENCH_disagg_${suffix}.json"
+echo "rc=$? -> BENCH_disagg_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

@@ -20,12 +20,15 @@ PYTHONPATH, so no extra tooling is needed on nodes.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import datetime
 import hashlib
 import hmac
+import http.client
 import os
 import sys
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -33,6 +36,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from xml.etree import ElementTree
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import env_registry
 
 
 def _retry_after_seconds(status: int, headers) -> Optional[float]:
@@ -67,6 +71,109 @@ def _read_slice(resp, start: int, length: int) -> bytes:
             break
         out += chunk
     return bytes(out)
+
+
+class TransferConnectionPool:
+    """Bounded keep-alive connections for the transfer engine's ranged
+    GETs. A 16-way parallel large-object download through ``urlopen``
+    dials a fresh TCP connection per part — against a far endpoint
+    that's one RTT of pure dial overhead per part, serialized with the
+    body bytes. Parts of one object all hit the same (scheme, host,
+    port), so a small idle pool (``SKYT_TRANSFER_POOL_SIZE``) turns N
+    dials into ~pool-width dials.
+
+    Thread-safe; connections are checked out exclusively, so the pool
+    holds only IDLE connections — the bound caps idle sockets kept
+    alive, not concurrency (a burst past the bound dials extra
+    connections and simply doesn't keep them)."""
+
+    def __init__(self, size: Optional[int] = None) -> None:
+        self._size = size
+        self._idle: Dict[Tuple[str, str, int], collections.deque] = \
+            collections.defaultdict(collections.deque)
+        self._lock = threading.Lock()
+        self.dials = 0
+        self.reuses = 0
+
+    def _bound(self) -> int:
+        if self._size is not None:
+            return self._size
+        return env_registry.get_int('SKYT_TRANSFER_POOL_SIZE')
+
+    def send(self, req: urllib.request.Request, timeout: float):
+        """Issue a urllib ``Request`` over a pooled connection. Returns
+        ``(status, headers, resp, finish)``; the caller reads ``resp``
+        and MUST call ``finish(reusable=...)`` — reusable=True returns
+        the connection to the pool if the response was drained and the
+        server kept the connection open. Raises OSError /
+        http.client.HTTPException on transport failure (a stale pooled
+        connection is retried once on a fresh dial)."""
+        parsed = urllib.parse.urlparse(req.full_url)
+        scheme = parsed.scheme or 'http'
+        port = parsed.port or (443 if scheme == 'https' else 80)
+        key = (scheme, parsed.hostname or '', port)
+        selector = parsed.path or '/'
+        if parsed.query:
+            selector += f'?{parsed.query}'
+        headers = dict(req.header_items())
+        headers.pop('Connection', None)
+        last_error: Optional[Exception] = None
+        for attempt in (0, 1):
+            conn, reused = self._acquire(key, timeout)
+            try:
+                conn.request(req.get_method(), selector, headers=headers)
+                resp = conn.getresponse()
+                break
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                last_error = e
+                if not (reused and attempt == 0):
+                    raise
+                # A keep-alive connection the server quietly closed:
+                # one retry on a guaranteed-fresh dial.
+        else:  # pragma: no cover - loop always breaks or raises
+            raise last_error  # type: ignore[misc]
+
+        def finish(reusable: bool) -> None:
+            if (reusable and not resp.will_close and resp.isclosed()
+                    and self._release(key, conn)):
+                return
+            conn.close()
+
+        return resp.status, resp.headers, resp, finish
+
+    def _acquire(self, key, timeout: float):
+        with self._lock:
+            idle = self._idle[key]
+            if idle:
+                self.reuses += 1
+                return idle.popleft(), True
+            self.dials += 1
+        scheme, host, port = key
+        if scheme == 'https':
+            conn = http.client.HTTPSConnection(host, port, timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        return conn, False
+
+    def _release(self, key, conn) -> bool:
+        with self._lock:
+            idle = self._idle[key]
+            if len(idle) < self._bound():
+                idle.append(conn)
+                return True
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            for idle in self._idle.values():
+                while idle:
+                    idle.popleft().close()
+
+
+# One process-wide pool: parallel part downloads across all transfer
+# threads share the same idle sockets (the bound is global by design).
+_RANGE_POOL = TransferConnectionPool()
 
 
 @dataclasses.dataclass
@@ -279,34 +386,46 @@ class S3Client:
     def get_object_range(self, bucket: str, key: str, start: int,
                          length: int) -> bytes:
         """Ranged GET of ``length`` bytes at ``start`` (parallel large-
-        object downloads fetch disjoint ranges concurrently)."""
+        object downloads fetch disjoint ranges concurrently). Goes
+        through the process-wide keep-alive pool: the N parts of one
+        object hit the same endpoint, so re-dialing per part would pay
+        one RTT of connection setup each (``SKYT_TRANSFER_POOL_SIZE``
+        bounds the idle sockets kept between parts)."""
         end = start + length - 1
         req = self._signed_request(
             'GET', bucket, key,
             unsigned_headers={'Range': f'bytes={start}-{end}'})
         try:
-            with urllib.request.urlopen(req, timeout=300) as resp:
-                if resp.status == 206:
-                    return resp.read()
-                if resp.status == 200:
-                    # Endpoint ignored Range (some S3 compats do):
-                    # stream to the slice and close — never buffer the
-                    # whole object per part request.
-                    return _read_slice(resp, start, length)
-                body = resp.read()
-        except urllib.error.HTTPError as e:
-            raise exceptions.StorageError(
-                f'ranged get {bucket}/{key} [{start}-{end}]: HTTP '
-                f'{e.code}', http_status=e.code,
-                retry_after=_retry_after_seconds(e.code, e.headers)
-            ) from None
-        except urllib.error.URLError as e:
+            status, headers, resp, finish = _RANGE_POOL.send(
+                req, timeout=300)
+        except (http.client.HTTPException, OSError) as e:
             raise exceptions.StorageError(
                 f'S3 endpoint {self.cfg.endpoint_url} unreachable: '
-                f'{e.reason}') from e
+                f'{e}') from e
+        try:
+            if status == 206:
+                body = resp.read()
+                finish(reusable=True)
+                return body
+            if status == 200:
+                # Endpoint ignored Range (some S3 compats do): stream
+                # to the slice and close — never buffer the whole
+                # object per part request (the undrained tail also
+                # makes the connection unpoolable: finish() closes it).
+                body = _read_slice(resp, start, length)
+                finish(reusable=False)
+                return body
+            error_body = resp.read()
+            finish(reusable=True)
+        except (http.client.HTTPException, OSError) as e:
+            finish(reusable=False)
+            raise exceptions.StorageError(
+                f'ranged get {bucket}/{key} [{start}-{end}]: '
+                f'{e}') from e
         raise exceptions.StorageError(
             f'ranged get {bucket}/{key} [{start}-{end}]: HTTP '
-            f'{resp.status} {body[:300]!r}', http_status=resp.status)
+            f'{status} {error_body[:300]!r}', http_status=status,
+            retry_after=_retry_after_seconds(status, headers))
 
     def put_object_from_file(self, bucket: str, key: str,
                              path: str) -> str:

@@ -59,7 +59,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from skypilot_tpu.inference.paged import BlockPool, PrefixCache
+from skypilot_tpu.inference import kv_migrate
+from skypilot_tpu.inference.paged import (BlockImporter, BlockPool,
+                                          PrefixCache, chain_digests)
 from skypilot_tpu.inference.tokenizer import get_tokenizer
 from skypilot_tpu.models import decode as decode_lib
 from skypilot_tpu.models import llama
@@ -179,6 +181,13 @@ class _Request:
         self.span = None
         self.decode_start_wall: Optional[float] = None
         self.decode_start_mono: Optional[float] = None
+        # Disaggregated serving: the engine-assigned id a prefill-role
+        # export is keyed by; ``migration`` holds the decode side's
+        # pulled KV (kv_migrate.PulledKv) until it imports or falls
+        # back to a local re-prefill.
+        self.request_id = ''
+        self.migration = None
+        self.handoff_start: Optional[float] = None
 
 
 class _PrefillState:
@@ -223,7 +232,8 @@ class ContinuousBatchingEngine:
                  mesh: Optional[Any] = None,
                  spec_decode: Optional[bool] = None,
                  draft_k: Optional[int] = None,
-                 draft: Optional[Any] = None) -> None:
+                 draft: Optional[Any] = None,
+                 role: Optional[str] = None) -> None:
         # Real-weights path: see engine.py (models/hf_interop.py).
         if hf_checkpoint:
             from skypilot_tpu.models import hf_interop
@@ -330,6 +340,24 @@ class ContinuousBatchingEngine:
         else:
             self._draft = None
         self.spec_decode = self._draft is not None
+        # Disaggregated serving role (docs/disaggregated_serving.md):
+        # '' = colocated, 'prefill' = chunked prefill only, finished KV
+        # parked in the exporter for the decode fleet to pull;
+        # 'decode' = imports migrated KV and batch-decodes (prefill
+        # only as the re-prefill fallback).
+        if role is None:
+            role = env_registry.get_str('SKYT_DISAGG_ROLE') or ''
+        if role not in ('', 'prefill', 'decode'):
+            raise ValueError(
+                f"SKYT_DISAGG_ROLE must be '', 'prefill' or 'decode', "
+                f'got {role!r}')
+        self.role = role
+        self.exporter = (kv_migrate.KvExporter()
+                         if role == 'prefill' else None)
+        self._request_seq = 0
+        self._kv_exports_total = 0
+        self._kv_imports_total = 0
+        self._kv_import_fallbacks_total = 0
         self._pending_tok = np.zeros((max_slots,), np.int64)
         self._rngs = [jax.random.key(seed + 1 + i)
                       for i in range(max_slots)]
@@ -487,6 +515,20 @@ class ContinuousBatchingEngine:
         A preempted request carries its already-generated tokens: they
         re-prefill as part of the visible sequence and decode resumes
         where it left off."""
+        if request.migration is not None:
+            try:
+                return self._import_migrated(request, slot,
+                                             request.migration)
+            except Exception:  # pylint: disable=broad-except
+                # Refcount-exact abort already ran: fall back to a
+                # local re-prefill of the same tokens — fold-in-
+                # position sampling keeps the stream identical.
+                logger.exception(
+                    'KV import failed; falling back to local '
+                    're-prefill')
+                self._kv_import_fallbacks_total += 1
+                request.migration = None
+                request.handoff_start = None
         ids = request.token_ids + request.generated
         plen = len(ids)
         needed_total = math.ceil(plen / self.block_size)
@@ -598,6 +640,13 @@ class ContinuousBatchingEngine:
                 pos=state.pos)
         if state.pos >= len(ids):
             self._prefilling.pop(0)
+            if self.role == 'prefill':
+                # Prefill fleet: never decode — serialize the slot's
+                # KV + last logits, park them for the decode side's
+                # pull, give the blocks straight back to the pool
+                # (the export holds host-memory copies).
+                self._export_prefill(request, slot, ids, last[0])
+                return
             self._last_logits = self._last_logits.at[slot].set(
                 last[0].astype(jnp.float32))
             self._rngs[slot] = jax.random.key(request.seed)
@@ -615,6 +664,199 @@ class ContinuousBatchingEngine:
                 request.decode_start_mono = time.monotonic()
             if self._prefix is not None:
                 self._prefix.insert(ids, self._slot_blocks[slot])
+
+    # -- disaggregated prefill/decode (docs/disaggregated_serving.md) ---
+
+    def _read_block_arrays(self, block_ids: List[int]
+                           ) -> List[Dict[str, np.ndarray]]:
+        """Host copies of the pool KV at ``block_ids`` (one batched
+        device read), one name->array dict per block."""
+        if not block_ids:
+            return []
+        idx = jnp.asarray(block_ids, jnp.int32)
+        k = np.asarray(self.cache.k[:, idx])
+        v = np.asarray(self.cache.v[:, idx])
+        k_scale = (np.asarray(self.cache.k_scale[:, idx])
+                   if self.cache.k_scale is not None else None)
+        v_scale = (np.asarray(self.cache.v_scale[:, idx])
+                   if self.cache.v_scale is not None else None)
+        out = []
+        for i in range(len(block_ids)):
+            arrays = {'k': k[:, i], 'v': v[:, i]}
+            if k_scale is not None:
+                arrays['k_scale'] = k_scale[:, i]
+                arrays['v_scale'] = v_scale[:, i]
+            out.append(arrays)
+        return out
+
+    def _write_block_arrays(self, writes: List[tuple]) -> None:
+        """Scatter ``(block_id, arrays)`` payloads into the pool (one
+        batched device write per field)."""
+        if not writes:
+            return
+        idx = jnp.asarray([b for b, _ in writes], jnp.int32)
+
+        def stacked(name, dtype):
+            return jnp.asarray(
+                np.stack([a[name] for _, a in writes], axis=1), dtype)
+
+        cache = self.cache
+        cache = dataclasses.replace(
+            cache,
+            k=cache.k.at[:, idx].set(stacked('k', cache.k.dtype)),
+            v=cache.v.at[:, idx].set(stacked('v', cache.v.dtype)))
+        if cache.k_scale is not None:
+            cache = dataclasses.replace(
+                cache,
+                k_scale=cache.k_scale.at[:, idx].set(
+                    stacked('k_scale', cache.k_scale.dtype)),
+                v_scale=cache.v_scale.at[:, idx].set(
+                    stacked('v_scale', cache.v_scale.dtype)))
+        self.cache = cache
+
+    def _export_prefill(self, request: _Request, slot: int,
+                        ids: List[int], last_row) -> None:
+        """Prefill-role completion: serialize the slot's KV (full
+        blocks individually — the migration delta unit — plus the
+        partial tail block and last-logits row as the opaque tail),
+        park it in the exporter, finish the request with zero
+        generated tokens, and release the slot."""
+        plen = len(ids)
+        n_full = plen // self.block_size
+        blocks = self._slot_blocks[slot]
+        host = self._read_block_arrays(blocks)
+        payloads = [kv_migrate.pack_arrays(host[i])
+                    for i in range(n_full)]
+        tail_arrays = {'logits': np.asarray(last_row, np.float32)}
+        if plen % self.block_size:
+            for name, array in host[n_full].items():
+                tail_arrays[f'tail_{name}'] = array
+        export = kv_migrate.KvExport(
+            request_id=request.request_id, ids=list(ids),
+            block_size=self.block_size,
+            digests=chain_digests(ids, self.block_size),
+            blocks=payloads, tail=kv_migrate.pack_arrays(tail_arrays),
+            meta={'seed': request.seed, 'n_tokens': plen},
+            created=time.monotonic())
+        self.exporter.put(export)
+        self._kv_exports_total += 1
+        if self._prefix is not None:
+            # Future prompts sharing this prefix prefill only their
+            # suffix — and their exports list the shared blocks with
+            # the same chain digests.
+            self._prefix.insert(ids, blocks)
+        self._finish(request)
+        self._release_slot(slot)
+
+    def _import_migrated(self, request: _Request, slot: int,
+                         pulled) -> bool:
+        """Decode-role admission of a migrated prefill: acquire blocks
+        through a refcount-exact import transaction (resident prefix
+        re-used in place, payloads written only into freshly allocated
+        blocks), seed the sampling state, and enter decode directly —
+        no prefill compute. Returns False when HBM can't fit it right
+        now (request stays queued); raises on any integrity problem —
+        the caller falls back to a local re-prefill with the pool and
+        prefix cache exactly as they were."""
+        ids = request.token_ids + request.generated
+        plen = len(ids)
+        manifest = pulled.manifest
+        if (manifest['n_tokens'] != plen or
+                manifest['block_size'] != self.block_size):
+            raise RuntimeError(
+                f'migration manifest mismatch: {manifest["n_tokens"]} '
+                f'tokens/bs={manifest["block_size"]} vs local '
+                f'{plen}/bs={self.block_size}')
+        digests = chain_digests(ids, self.block_size)
+        if [row['digest'] for row in manifest['blocks']] != digests:
+            raise RuntimeError('migration chain digests diverge from '
+                               'the local token stream')
+        n_full = plen // self.block_size
+        needed_total = math.ceil(plen / self.block_size)
+        if needed_total > self._pool.total_blocks:
+            raise RuntimeError(
+                f'migrated prompt needs {needed_total} KV blocks; '
+                f'pool has {self._pool.total_blocks}')
+        # Same admission watermark as _begin_prefill: keep one tail
+        # block of headroom per active decoder.
+        resident_now = (self._prefix.resident_chain(ids)
+                        if self._prefix is not None else [])
+        need_private = needed_total - len(resident_now)
+        avail = self._pool.free_blocks + (
+            self._prefix.reclaimable_blocks if self._prefix is not None
+            else 0)
+        if avail < need_private + sum(self._decoding):
+            return False
+        importer = BlockImporter(self._pool, self._prefix)
+        got = importer.begin(ids, needed_total,
+                             block_size=self.block_size,
+                             alloc=self._alloc_block)
+        if got is None:
+            return False
+        blocks, n_resident = got
+        try:
+            writes = []
+            for i in range(n_full):
+                if i < n_resident:
+                    continue  # resident: the cached copy is canonical
+                payload = pulled.payloads[i]
+                if payload is None:
+                    # The pull's residency probe was optimistic and the
+                    # entry was evicted since: the payload never moved.
+                    raise RuntimeError(
+                        f'block {i} evicted mid-migration and its '
+                        'payload was not pulled')
+                writes.append((blocks[i],
+                               kv_migrate.unpack_arrays(payload)))
+            tail = kv_migrate.unpack_arrays(pulled.tail)
+            if plen % self.block_size:
+                tail_block = {
+                    name[len('tail_'):]: array
+                    for name, array in tail.items()
+                    if name.startswith('tail_')}
+                if not tail_block:
+                    raise RuntimeError('migration tail payload is '
+                                       'missing the partial block')
+                writes.append((blocks[n_full], tail_block))
+            self._write_block_arrays(writes)
+            self._last_logits = self._last_logits.at[slot].set(
+                jnp.asarray(tail['logits'], jnp.float32))
+        except Exception:
+            importer.abort()
+            raise
+        importer.commit()
+        if not request.admitted:
+            request.admitted = True
+            self._queue_wait_seconds_total += max(
+                0.0, time.monotonic() - request.arrival)
+        self._slot_blocks[slot] = list(blocks)
+        self._host_bt[slot, :] = 0
+        self._host_bt[slot, :len(blocks)] = blocks
+        self._host_len[slot] = plen
+        self._bt_dirty = True
+        self._slots[slot] = request
+        self._admit_seq += 1
+        self._admit_order[slot] = self._admit_seq
+        self._rngs[slot] = jax.random.key(request.seed)
+        if self._draft is not None:
+            self._pending_tok[slot] = int(_sample_pending_step(
+                jnp.asarray(tail['logits'], jnp.float32),
+                self._rngs[slot], jnp.int32(plen),
+                jnp.float32(request.temperature)))
+        self._decoding[slot] = True
+        if request.decode_start_wall is None:
+            request.decode_start_wall = time.time()
+            request.decode_start_mono = time.monotonic()
+        if self._prefix is not None:
+            self._prefix.insert(ids, blocks)
+        request.migration = None  # a later preemption re-prefills
+        self._kv_imports_total += 1
+        if request.handoff_start is not None:
+            from skypilot_tpu.server import metrics
+            metrics.DISAGG_HANDOFF.observe(
+                max(0.0, time.monotonic() - request.handoff_start))
+            request.handoff_start = None
+        return True
 
     def _preempt(self, slot: int, active_mask: np.ndarray) -> None:
         """Release a slot's blocks (decoding OR mid-prefill) and
@@ -908,7 +1150,8 @@ class ContinuousBatchingEngine:
 
     def _submit(self, token_ids: List[int], max_new_tokens: int,
                 temperature: float, eos_id: Optional[int],
-                seed: int, trace_ctx=None) -> _Request:
+                seed: int, trace_ctx=None, migration=None,
+                handoff_start: Optional[float] = None) -> _Request:
         """Shared admission path: validate + enqueue (both the blocking
         and streaming entries; the policy must not drift between them).
 
@@ -921,8 +1164,16 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f'prompt is {len(token_ids)} tokens; engine max_len is '
                 f'{self.max_len} (prompt + generation must fit)')
+        if self.role == 'prefill' and max_new_tokens > 0:
+            raise RuntimeError(
+                'a prefill-role engine never decodes; use '
+                'prefill_and_export (or clear SKYT_DISAGG_ROLE)')
         request = _Request(token_ids, max_new_tokens, temperature,
                            eos_id, seed, trace_ctx=trace_ctx)
+        self._request_seq += 1
+        request.request_id = f'r{self._request_seq}'
+        request.migration = migration
+        request.handoff_start = handoff_start
         if trace_ctx is not None:
             from skypilot_tpu.utils import tracing
             request.span = tracing.start_span(
@@ -933,6 +1184,89 @@ class ContinuousBatchingEngine:
         self._pending.put(request)
         self._wake.set()
         return request
+
+    # -- disaggregated-serving public surface ---------------------------
+
+    def prefill_and_export(self, token_ids: List[int], *,
+                           temperature: float = 0.0,
+                           eos_id: Optional[int] = None,
+                           seed: int = 0,
+                           timeout: float = 300.0,
+                           trace_ctx=None) -> str:
+        """Prefill-role entry: absorb the prompt (chunked, prefix-
+        cache-accelerated) and park the serialized KV in
+        ``self.exporter``. Returns the request id the export is keyed
+        by — the decode side pulls ``/kv/manifest/<id>`` etc. from
+        this replica's migration surface."""
+        if self.role != 'prefill':
+            raise RuntimeError(
+                "prefill_and_export needs role='prefill' "
+                '(SKYT_DISAGG_ROLE)')
+        request = self._submit(token_ids, 0, temperature, eos_id, seed,
+                               trace_ctx=trace_ctx)
+        if not request.done.wait(timeout):
+            raise TimeoutError('prefill timed out')
+        if request.error is not None:
+            raise request.error
+        return request.request_id
+
+    def probe_resident(self, token_ids: List[int]) -> List[int]:
+        """Chain digests of the full-block prefix already resident in
+        this engine's PrefixCache — read-only and thread-safe, the
+        decode side's input to the migration delta manifest (those
+        blocks are skipped by the pull)."""
+        if self._prefix is None:
+            return []
+        return self._prefix.resident_chain(token_ids)
+
+    def submit_migrated(self, token_ids: List[int], pulled, *,
+                        max_new_tokens: int = 32,
+                        temperature: float = 0.0,
+                        eos_id: Optional[int] = None,
+                        seed: int = 0,
+                        trace_ctx=None,
+                        handoff_start: Optional[float] = None
+                        ) -> _Request:
+        """Decode-role entry: admit a pulled migration
+        (``kv_migrate.PulledKv``) — the serving loop imports the
+        blocks refcount-exactly and starts decoding WITHOUT a prefill
+        pass; any import failure falls back to a local re-prefill of
+        ``token_ids``, so the request always completes. Returns the
+        request handle; stream with :meth:`tail_tokens` or block on
+        ``request.done``. ``handoff_start`` (time.monotonic) stamps
+        ``skyt_disagg_handoff_seconds`` when the import lands."""
+        if self.role == 'prefill':
+            raise RuntimeError("a prefill-role engine never decodes; "
+                               "submit_migrated needs role='decode' "
+                               "(or colocated)")
+        return self._submit(token_ids, max_new_tokens, temperature,
+                            eos_id, seed, trace_ctx=trace_ctx,
+                            migration=pulled,
+                            handoff_start=handoff_start)
+
+    def tail_tokens(self, request: _Request, *,
+                    eos_id: Optional[int] = None,
+                    timeout: float = 300.0):
+        """Yield a submitted request's tokens as they land (the
+        streaming tail ``stream_ids`` is built on)."""
+        emitted = 0
+        deadline = time.monotonic() + timeout
+        while True:
+            finished = request.done.is_set()
+            generated = request.generated
+            while emitted < len(generated):
+                token = generated[emitted]
+                emitted += 1
+                if eos_id is not None and token == eos_id:
+                    return
+                yield token
+            if finished:
+                if request.error is not None:
+                    raise request.error
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError('generation timed out')
+            time.sleep(0.005)
 
     def generate_ids(self, token_ids: List[int], *,
                      max_new_tokens: int = 32,
@@ -973,28 +1307,7 @@ class ContinuousBatchingEngine:
         over-long prompt raises here, not at first iteration)."""
         request = self._submit(token_ids, max_new_tokens, temperature,
                                eos_id, seed, trace_ctx=trace_ctx)
-
-        def tail():
-            emitted = 0
-            deadline = time.monotonic() + timeout
-            while True:
-                finished = request.done.is_set()
-                generated = request.generated
-                while emitted < len(generated):
-                    token = generated[emitted]
-                    emitted += 1
-                    if eos_id is not None and token == eos_id:
-                        return
-                    yield token
-                if finished:
-                    if request.error is not None:
-                        raise request.error
-                    return
-                if time.monotonic() > deadline:
-                    raise TimeoutError('generation timed out')
-                time.sleep(0.005)
-
-        return tail()
+        return self.tail_tokens(request, eos_id=eos_id, timeout=timeout)
 
     def stream_text(self, prompt: str, **kwargs: Any):
         """Yield text DELTAS: ids decode cumulatively (single BPE
@@ -1047,6 +1360,12 @@ class ContinuousBatchingEngine:
             'prefix_cache_misses': self._prefix_misses_total,
             'prefix_tokens_reused': self._prefix_tokens_reused_total,
             'preemptions': self._preemptions_total,
+            # Disaggregated serving (zero in colocated engines).
+            'kv_exports': self._kv_exports_total,
+            'kv_imports': self._kv_imports_total,
+            'kv_import_fallbacks': self._kv_import_fallbacks_total,
+            'kv_exports_pending': (len(self.exporter)
+                                   if self.exporter is not None else 0),
             # Speculative decoding: acceptance rate is derivable as
             # accepted_tokens / draft_tokens (both counters, so it
             # rate()s correctly over any window).

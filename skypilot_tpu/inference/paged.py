@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import OrderedDict
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 NULL_BLOCK = 0
 
@@ -135,6 +135,27 @@ class PrefixCache:
             parent = digest
         return matched
 
+    def resident_chain(self, ids: Sequence[int]) -> List[int]:
+        """Chain digests of the cached full-block prefix of ``ids`` —
+        strictly read-only (no incref, no LRU touch), so the decode
+        side of a KV migration can plan its delta manifest from
+        OUTSIDE the serving loop. Residency can change before the
+        import lands; the import transaction re-walks the chain and
+        falls back to re-prefill on a shrink."""
+        bs = self._block_size
+        out: List[int] = []
+        parent = 0
+        for i in range(len(ids) // bs):
+            tokens = tuple(ids[i * bs:(i + 1) * bs])
+            digest = self._digest(parent, tokens)
+            entry = self._entries.get(digest)
+            if (entry is None or entry.tokens != tokens or
+                    entry.parent != parent):
+                break
+            out.append(digest)
+            parent = digest
+        return out
+
     def insert(self, ids: Sequence[int], blocks: Sequence[int]) -> None:
         """Register the full blocks of a freshly prefilled prompt.
 
@@ -200,3 +221,114 @@ class PrefixCache:
     def clear(self) -> None:
         while self.evict_one():
             pass
+
+
+# ---------------------------------------------------------------------
+# KV-block migration bookkeeping (disaggregated prefill/decode serving)
+# ---------------------------------------------------------------------
+
+
+def chain_digests(ids: Sequence[int], block_size: int) -> List[int]:
+    """Rolling chain digest of every FULL block of ``ids`` — the same
+    keying :class:`PrefixCache` uses, exported for the KV-migration
+    delta manifest: a block is resident on the decode side iff its
+    chain digest (and token tuple, verified by the cache walk) already
+    has an entry there, so only non-resident blocks ever move."""
+    out: List[int] = []
+    parent = 0
+    for i in range(len(ids) // block_size):
+        tokens = tuple(ids[i * block_size:(i + 1) * block_size])
+        parent = PrefixCache._digest(parent, tokens)  # noqa: SLF001
+        out.append(parent)
+    return out
+
+
+class BlockImporter:
+    """All-or-nothing block acquisition for a KV-block import.
+
+    A migration import must be *refcount-exact*: if the transfer dies
+    mid-flight (peer death, corrupt payload, timeout), the pool and
+    prefix cache must be returned to EXACTLY their pre-import state —
+    same refcounts, same cached entries — so the request can fall back
+    to a local re-prefill with zero leaked blocks (the r13 speculative
+    rollback discipline, applied to migration).
+
+    Usage::
+
+        importer = BlockImporter(pool, prefix)
+        got = importer.begin(ids, needed_total, block_size=bs)
+        if got is None:       # pool can't fit it right now; nothing held
+            ...
+        blocks, n_resident = got
+        try:
+            ... copy the non-resident block payloads in ...
+            importer.commit()     # refs now owned by the caller's slot
+        except Exception:
+            importer.abort()      # exact pre-import state restored
+            raise
+    """
+
+    def __init__(self, pool: BlockPool,
+                 prefix: Optional[PrefixCache] = None) -> None:
+        self._pool = pool
+        self._prefix = prefix
+        self._resident: List[int] = []
+        self._allocated: List[int] = []
+        self._active = False
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def begin(self, ids: Sequence[int], needed_total: int, *,
+              block_size: int,
+              alloc: Optional[Callable[[], Optional[int]]] = None
+              ) -> Optional[Tuple[List[int], int]]:
+        """Acquire ``needed_total`` blocks for token sequence ``ids``:
+        the cached full-block prefix first (shared — increfed through
+        the prefix cache, these blocks' payloads never move), then
+        freshly allocated private blocks for the remainder. Returns
+        ``(blocks, n_resident)``, or ``None`` when the pool cannot
+        supply the private blocks right now — in which case NOTHING is
+        retained (the failed attempt is invisible to the pool beyond
+        its version counter).
+
+        ``alloc`` overrides the raw allocator (the engine passes its
+        prefix-evicting ``_alloc_block``)."""
+        if self._active:
+            raise RuntimeError('BlockImporter already has an open import')
+        if alloc is None:
+            alloc = self._pool.alloc
+        resident: List[int] = []
+        if self._prefix is not None:
+            limit = min(len(ids), needed_total * block_size)
+            resident = self._prefix.lookup(ids, limit_tokens=limit)
+        self._resident = resident
+        self._allocated = []
+        self._active = True
+        while len(resident) + len(self._allocated) < needed_total:
+            block = alloc()
+            if block is None:
+                self.abort()
+                return None
+            self._allocated.append(block)
+        return list(resident) + list(self._allocated), len(resident)
+
+    def commit(self) -> None:
+        """The import landed: the caller's slot now owns every
+        reference this importer took."""
+        self._resident = []
+        self._allocated = []
+        self._active = False
+
+    def abort(self) -> None:
+        """Undo every reference this import took, newest first —
+        refcounts and prefix-cache entries end exactly where they were
+        before :meth:`begin`. Idempotent; a no-op after commit."""
+        for block in reversed(self._allocated):
+            self._pool.decref(block)
+        for block in reversed(self._resident):
+            self._pool.decref(block)
+        self._resident = []
+        self._allocated = []
+        self._active = False

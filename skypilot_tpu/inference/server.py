@@ -111,6 +111,24 @@ def make_handler(engine: InferenceEngine):
                         lines.append(f'{name} {value}')
                 self._body(200, ('\n'.join(lines) + '\n').encode(),
                            'text/plain; version=0.0.4')
+            elif self.path.startswith('/kv/'):
+                # KV-block migration surface (prefill role only): the
+                # decode fleet pulls manifests/blocks/tails from here
+                # (inference/kv_migrate.py).
+                from skypilot_tpu.inference import kv_migrate
+                exporter = getattr(engine, 'exporter', None)
+                if exporter is None:
+                    self._json(404, {'error': 'not a prefill replica'})
+                    return
+                status, headers, body = kv_migrate.handle_kv_get(
+                    self.path, exporter,
+                    range_header=self.headers.get('Range'))
+                self.send_response(status)
+                for key, value in headers.items():
+                    self.send_header(key, value)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path.startswith('/fanout/'):
                 # Peer weight-serving surface: sibling replicas pull
                 # committed checkpoint shards from here instead of
@@ -141,6 +159,18 @@ def make_handler(engine: InferenceEngine):
                     self._openai(req, chat=False)
                 elif self.path == '/v1/chat/completions':
                     self._openai(req, chat=True)
+                elif self.path == '/disagg/prefill':
+                    self._disagg_prefill(req)
+                elif self.path.startswith('/kv/release/'):
+                    from skypilot_tpu.inference import kv_migrate
+                    exporter = getattr(engine, 'exporter', None)
+                    if exporter is None:
+                        self._json(404,
+                                   {'error': 'not a prefill replica'})
+                        return
+                    status, _headers, body = kv_migrate.handle_kv_release(
+                        self.path, exporter)
+                    self._body(status, body, 'application/json')
                 else:
                     self._json(404, {'error': 'not found'})
             except Exception as e:  # pylint: disable=broad-except
@@ -150,6 +180,81 @@ def make_handler(engine: InferenceEngine):
                 except (BrokenPipeError, ConnectionResetError):
                     pass
 
+        # -- disaggregated serving (docs/disaggregated_serving.md) -----
+
+        def _prompt_ids(self, path, req):
+            """Token ids for the request's single prompt, derived the
+            SAME way on the prefill and decode replicas (both run this
+            exact code over the same body) — the import cross-checks
+            chain digests, so any divergence falls back to a local
+            re-prefill instead of decoding wrong KV. None for shapes
+            the two-hop route doesn't carry (multi-prompt batches)."""
+            tok = engine.tokenizer
+            if path == '/v1/chat/completions':
+                prompt = tok.apply_chat_template(req.get('messages') or [])
+                add_bos = not getattr(tok, 'chat_template', None)
+            elif path == '/v1/completions':
+                prompt = req.get('prompt', '')
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ''
+                add_bos = True
+            else:  # /generate
+                prompts = req.get('prompts') or [req.get('prompt', '')]
+                if len(prompts) != 1:
+                    return None
+                prompt = prompts[0]
+                add_bos = True
+            return tok.encode(prompt, add_bos=add_bos)
+
+        def _disagg_prefill(self, req):
+            """Hop 1 of the LB's two-hop route: absorb the prompt and
+            park the serialized KV for the decode fleet's pull. The
+            body is the CLIENT's body verbatim; X-Skyt-Disagg-Path
+            says which API shape to parse it as."""
+            if getattr(engine, 'role', '') != 'prefill':
+                self._json(400, {'error': 'not a prefill replica'})
+                return
+            path = self.headers.get('X-Skyt-Disagg-Path', '/generate')
+            ids = self._prompt_ids(path, req)
+            if ids is None:
+                self._json(400, {'error': 'not a single-prompt request'})
+                return
+            request_id = engine.prefill_and_export(
+                ids, temperature=float(req.get('temperature') or 0.0),
+                seed=int(req.get('seed') or 0), **self._trace_kwargs())
+            self._json(200, {'request_id': request_id,
+                             'n_tokens': len(ids)})
+
+        def _migrated_request(self, ids, kwargs):
+            """When the LB's prefill hop stamped this request with a KV
+            export (X-Skyt-Kv-* headers), pull the delta and enter
+            decode directly; None -> caller prefills locally (the
+            re-prefill fallback: a dead prefill replica or failed pull
+            costs latency, never the request)."""
+            request_id = self.headers.get('X-Skyt-Kv-Request-Id')
+            endpoint = self.headers.get('X-Skyt-Kv-Endpoint')
+            if (not request_id or not endpoint or
+                    not hasattr(engine, 'submit_migrated') or
+                    getattr(engine, 'role', '') == 'prefill'):
+                return None
+            from skypilot_tpu.inference import kv_migrate
+            handoff_start = time.monotonic()
+            try:
+                source = kv_migrate.HTTPKvSource(endpoint)
+                puller = kv_migrate.KvPuller(source)
+                pulled = puller.pull(
+                    request_id,
+                    resident_digests=engine.probe_resident(ids))
+                request = engine.submit_migrated(
+                    ids, pulled, handoff_start=handoff_start, **kwargs)
+                source.release(request_id)
+                return request
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    'KV pull for %s failed (%s: %s); falling back to '
+                    'local prefill', request_id, type(e).__name__, e)
+                return None
+
         def _generate(self, req):
             prompts = req.get('prompts') or [req.get('prompt', '')]
             kwargs = dict(
@@ -158,6 +263,16 @@ def make_handler(engine: InferenceEngine):
                 seed=int(req.get('seed', 0)))
             if hasattr(engine, 'generate_texts'):
                 kwargs.update(self._trace_kwargs())
+                tok = engine.tokenizer
+                ids = self._prompt_ids('/generate', req)
+                migrated = (self._migrated_request(
+                    ids, dict(eos_id=tok.eos_id, **kwargs))
+                    if ids is not None else None)
+                if migrated is not None:
+                    out_ids = list(engine.tail_tokens(
+                        migrated, eos_id=tok.eos_id))
+                    self._json(200, {'outputs': [tok.decode(out_ids)]})
+                    return
                 outputs = engine.generate_texts(prompts, **kwargs)
             else:
                 outputs = engine.generate_text(prompts, **kwargs)
@@ -206,8 +321,14 @@ def make_handler(engine: InferenceEngine):
             ids = tok.encode(prompt, add_bos=add_bos)
             if hasattr(engine, 'generate_texts'):
                 # continuous engine: single-request ids API
-                out_ids = engine.generate_ids(
-                    ids, eos_id=tok.eos_id, **kwargs)
+                migrated = self._migrated_request(
+                    ids, dict(eos_id=tok.eos_id, **kwargs))
+                if migrated is not None:
+                    out_ids = list(engine.tail_tokens(
+                        migrated, eos_id=tok.eos_id))
+                else:
+                    out_ids = engine.generate_ids(
+                        ids, eos_id=tok.eos_id, **kwargs)
             else:
                 # batch engine: list-in, list-out
                 out_ids = engine.generate_ids([ids], **kwargs)[0]
@@ -236,8 +357,16 @@ def make_handler(engine: InferenceEngine):
             # second status line would corrupt the stream).
             tok = engine.tokenizer
             ids = tok.encode(prompt, add_bos=add_bos)
-            token_iter = engine.stream_ids(ids, eos_id=tok.eos_id,
-                                           **kwargs)
+            migrated = self._migrated_request(
+                ids, dict(eos_id=tok.eos_id, **kwargs))
+            if migrated is not None:
+                # First decode tokens stream the moment the migration
+                # lands — the handoff is the TTFT, not a re-prefill.
+                token_iter = engine.tail_tokens(migrated,
+                                                eos_id=tok.eos_id)
+            else:
+                token_iter = engine.stream_ids(ids, eos_id=tok.eos_id,
+                                               **kwargs)
             self.send_response(200)
             self.send_header('Content-Type', 'text/event-stream')
             self.send_header('Cache-Control', 'no-cache')
@@ -358,6 +487,14 @@ def main(argv=None) -> int:
                         help="tensor-parallel serving, e.g. 'tensor=8' "
                              '(shards params over the local chips; how '
                              'flagship models span a slice).')
+    parser.add_argument('--role', default=None,
+                        choices=['prefill', 'decode'],
+                        help='disaggregated serving role (continuous '
+                             'engine; default $SKYT_DISAGG_ROLE): '
+                             'prefill replicas export KV for the '
+                             'decode fleet to pull, decode replicas '
+                             'import it and stream tokens '
+                             '(docs/disaggregated_serving.md).')
     args = parser.parse_args(argv)
     if args.engine == 'continuous':
         from skypilot_tpu.inference.continuous import (
@@ -375,8 +512,14 @@ def main(argv=None) -> int:
             quantize_kv=args.quantize_kv,
             mesh=args.mesh,
             spec_decode=args.spec_decode,
-            draft_k=args.draft_k)
-        engine.generate_text('warmup', max_new_tokens=8)
+            draft_k=args.draft_k,
+            role=args.role)
+        if engine.role == 'prefill':
+            # Warm the prefill program; drop the throwaway export.
+            engine.exporter.pop(engine.prefill_and_export(
+                engine.tokenizer.encode('warmup')))
+        else:
+            engine.generate_text('warmup', max_new_tokens=8)
     else:
         engine = InferenceEngine(args.model,
                                  checkpoint_dir=args.checkpoint_dir,

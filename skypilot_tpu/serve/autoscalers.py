@@ -61,6 +61,10 @@ class Decision:
     # mix_policy.DECISION_REASONS for the new decision paths, '' for
     # the legacy autoscalers).
     reason: str = ''
+    # Disaggregated serving: which specialized fleet this decision
+    # targets ('prefill' | 'decode'; '' = colocated). SCALE_UP launches
+    # the replica with SKYT_DISAGG_ROLE set accordingly.
+    role: str = ''
 
 
 @dataclasses.dataclass
@@ -73,6 +77,17 @@ class LoadStats:
     # proxy — latency-aware autoscalers (and the status surface) see
     # which replicas are slow, not just how many requests are in flight.
     replica_latency_ms: Dict[int, float] = dataclasses.field(
+        default_factory=dict)
+    # Per-replica in-flight requests at window close — the disagg
+    # autoscaler partitions concurrency by fleet role (the aggregate
+    # queue_length can't tell a saturated decode fleet from a busy
+    # prefill fleet).
+    replica_in_flight: Dict[int, int] = dataclasses.field(
+        default_factory=dict)
+    # Per-replica EWMA inter-chunk gap (ms) over streamed response
+    # bodies — the decode fleet's inter-token latency as the proxy
+    # observes it (gaps between SSE token frames).
+    replica_intertoken_ms: Dict[int, float] = dataclasses.field(
         default_factory=dict)
 
 
@@ -124,8 +139,11 @@ class Autoscaler:
 
     @classmethod
     def from_spec(cls, spec: ServiceSpec) -> 'Autoscaler':
-        if spec.target_latency_p99_ms is not None:
+        if spec.target_ttft_p99_ms is not None:
             # Lazy import: slo_autoscaler imports this module.
+            from skypilot_tpu.serve import slo_autoscaler  # noqa: F401
+            return AUTOSCALER_REGISTRY.get('disagg_slo')(spec)
+        if spec.target_latency_p99_ms is not None:
             from skypilot_tpu.serve import slo_autoscaler  # noqa: F401
             return AUTOSCALER_REGISTRY.get('slo')(spec)
         if spec.base_ondemand_fallback_replicas or \

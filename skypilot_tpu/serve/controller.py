@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import state
 from skypilot_tpu.serve import serve_state
@@ -170,7 +170,8 @@ class ServeController:
                         cloud=domain.cloud if domain else None,
                         region=domain.region if domain else None,
                         zone=domain.zone if domain else None,
-                        is_fallback=decision.is_fallback)
+                        is_fallback=decision.is_fallback,
+                        role=decision.role)
             else:
                 assert decision.replica_id is not None
                 metrics.AUTOSCALE_DECISIONS.inc(
@@ -182,11 +183,14 @@ class ServeController:
     def _sync_lb(self,
                  replicas: List[serve_state.ReplicaRecord]) -> None:
         entries: List[ReplicaEntry] = []
+        roles: Dict[int, str] = {}
         for record in replicas:
             if record.status == ReplicaStatus.READY and record.endpoint:
                 entries.append((record.replica_id, record.endpoint,
                                 _replica_weight(record)))
-        self.lb.sync_replicas(entries)
+                if record.role:
+                    roles[record.replica_id] = record.role
+        self.lb.sync_replicas(entries, roles=roles)
         # Publish the data plane's per-replica health (EWMA TTFB +
         # circuit-breaker state) to the serve DB: `status` runs in
         # other processes and can't read the LB's memory.

@@ -72,6 +72,9 @@ _MAX_HEAD_BYTES = 65536
 # The LB's own observability surface; leading "/-/" keeps it out of any
 # sane application's path space (documented in docs/serve_data_plane.md).
 LB_METRICS_PATH = '/-/lb/metrics'
+# Generate-shaped paths that take the disaggregated two-hop route when
+# both specialized fleets are ready (docs/disaggregated_serving.md).
+TWO_HOP_PATHS = ('/generate', '/v1/completions', '/v1/chat/completions')
 
 
 class LoadBalancer:
@@ -96,8 +99,12 @@ class LoadBalancer:
         self._eject_seconds = env_registry.get_float(
             'SKYT_LB_EJECT_SECONDS')
         self._ewma: Dict[int, float] = {}            # seconds (TTFB)
+        self._itl_ewma: Dict[int, float] = {}        # seconds/chunk gap
         self._failures: Dict[int, int] = {}          # consecutive
         self._ejected_until: Dict[int, float] = {}   # monotonic deadline
+        # Disaggregated fleets: replica_id -> 'prefill' | 'decode'
+        # (absent = colocated; see sync_replicas).
+        self._roles: Dict[int, str] = {}
 
     # -- stats ---------------------------------------------------------
 
@@ -134,15 +141,25 @@ class LoadBalancer:
             queue = sum(self._in_flight.values())
             latency_ms = {rid: ewma * 1000.0
                           for rid, ewma in self._ewma.items()}
+            intertoken_ms = {rid: ewma * 1000.0
+                             for rid, ewma in self._itl_ewma.items()}
+            in_flight = dict(self._in_flight)
         return LoadStats(qps=qps, queue_length=queue,
                          window_seconds=self._window,
-                         replica_latency_ms=latency_ms)
+                         replica_latency_ms=latency_ms,
+                         replica_in_flight=in_flight,
+                         replica_intertoken_ms=intertoken_ms)
 
     # -- replica health ------------------------------------------------
 
     def observe_latency(self, replica_id: int, seconds: float) -> None:
-        """A successful response head arrived: update the EWMA and close
-        any open circuit (success clears the breaker)."""
+        """A response head arrived: update the TTFB EWMA. This is a
+        LATENCY observation only — a streamed response can still die
+        after the first byte, so the circuit breaker clears in
+        :meth:`record_success` (full stream delivered), never here.
+        Clearing on the head let a replica that reliably truncated
+        mid-stream reset its own failure count every attempt and dodge
+        ejection forever."""
         with self._lock:
             previous = self._ewma.get(replica_id)
             if previous is None:
@@ -151,6 +168,26 @@ class LoadBalancer:
                 alpha = self._ewma_alpha
                 self._ewma[replica_id] = (alpha * seconds +
                                           (1 - alpha) * previous)
+
+    def observe_intertoken(self, replica_id: int, seconds: float) -> None:
+        """Gap between successive streamed body chunks — for a decode
+        replica emitting SSE token frames this IS its inter-token
+        latency, which the disagg autoscaler sizes the decode fleet
+        against (replica_intertoken_ms in LoadStats)."""
+        with self._lock:
+            previous = self._itl_ewma.get(replica_id)
+            if previous is None:
+                self._itl_ewma[replica_id] = seconds
+            else:
+                alpha = self._ewma_alpha
+                self._itl_ewma[replica_id] = (alpha * seconds +
+                                              (1 - alpha) * previous)
+
+    def record_success(self, replica_id: int) -> None:
+        """The FULL response reached the client: close any open
+        circuit. The success signal the breaker pairs with
+        :meth:`record_failure` — head-byte latency is not it."""
+        with self._lock:
             self._failures.pop(replica_id, None)
             if self._ejected_until.pop(replica_id, None) is not None:
                 logger.info('LB: replica %d recovered; ejection cleared.',
@@ -205,29 +242,80 @@ class LoadBalancer:
 
     # -- fleet ---------------------------------------------------------
 
-    def sync_replicas(self, replicas: List[ReplicaEntry]) -> None:
+    def sync_replicas(self, replicas: List[ReplicaEntry],
+                      roles: Optional[Dict[int, str]] = None) -> None:
+        """``roles`` maps replica_id -> '' | 'prefill' | 'decode'
+        (disaggregated serving); omitted/empty means a colocated
+        fleet."""
         self.policy.set_replicas(replicas)
         live = {entry[0] for entry in replicas}
         with self._lock:
-            for table in (self._ewma, self._failures, self._ejected_until):
+            self._roles = {rid: role for rid, role in (roles or {}).items()
+                           if rid in live and role}
+            for table in (self._ewma, self._itl_ewma, self._failures,
+                          self._ejected_until):
                 for rid in [r for r in table if r not in live]:
                     del table[rid]
 
-    def select(self, exclude: Optional[Set[int]] = None
+    def two_hop_ready(self) -> bool:
+        """Both specialized fleets have members: generate traffic takes
+        the prefill->decode two-hop route (decode-only fleets degrade
+        to single-hop — decode replicas can re-prefill locally)."""
+        with self._lock:
+            roles = set(self._roles.values())
+        return 'prefill' in roles and 'decode' in roles
+
+    def _role_excluded(self, role: Optional[str]) -> Set[int]:
+        if role is None:
+            return set()
+        with self._lock:
+            return {rid for rid, _url, _w in self.policy.replicas
+                    if self._roles.get(rid, '') != role}
+
+    def select(self, exclude: Optional[Set[int]] = None,
+               role: Optional[str] = None,
+               affinity_key: Optional[int] = None
                ) -> Optional[ReplicaEntry]:
+        """``role`` restricts to one specialized fleet; ``affinity_key``
+        (decode hop) rendezvous-hashes healthy candidates so requests
+        sharing a prompt prefix land on the SAME decode replica — its
+        PrefixCache then already holds the shared blocks and the KV
+        migration moves only the delta. Load still wins over affinity:
+        the rendezvous pick is skipped when it carries 2x the in-flight
+        of the fleet's lightest member."""
         now = time.monotonic()
         with self._lock:
             ejected = {rid for rid, until in self._ejected_until.items()
                        if until > now}
         latencies = self.ewma_snapshot()
         in_flight = self.in_flight_snapshot()
-        merged = set(exclude or ()) | ejected
+        role_excluded = self._role_excluded(role)
+        merged = set(exclude or ()) | ejected | role_excluded
+        if affinity_key is not None:
+            entry = self._affinity_pick(affinity_key, merged, in_flight)
+            if entry is not None:
+                return entry
         entry = self.policy.select(in_flight, merged, latencies=latencies)
         if entry is None and ejected:
             # Every healthy candidate is gone: trying an ejected replica
             # beats a guaranteed 503 (and doubles as its re-probe).
-            entry = self.policy.select(in_flight, set(exclude or ()),
-                                       latencies=latencies)
+            entry = self.policy.select(
+                in_flight, set(exclude or ()) | role_excluded,
+                latencies=latencies)
+        return entry
+
+    def _affinity_pick(self, affinity_key: int, excluded: Set[int],
+                       in_flight: Dict[int, int]
+                       ) -> Optional[ReplicaEntry]:
+        candidates = [e for e in self.policy.replicas
+                      if e[0] not in excluded]
+        if not candidates:
+            return None
+        entry = max(candidates,
+                    key=lambda e: hash((affinity_key, e[0])))
+        lightest = min(in_flight.get(e[0], 0) for e in candidates)
+        if in_flight.get(entry[0], 0) > max(2 * lightest, 1):
+            return None  # hot spot: let the load policy place it
         return entry
 
 
@@ -335,6 +423,7 @@ class _UpstreamState:
     def __init__(self) -> None:
         self.request_sent = False      # any request byte written upstream
         self.responded = False         # any response byte sent to client
+        self.upstream_complete = False  # upstream body fully consumed
 
 
 async def _read_head(reader: asyncio.StreamReader) -> bytes:
@@ -569,9 +658,35 @@ class _AsyncProxy:
         self._inflight += 1
         start = time.monotonic()
         tried: Set[int] = set()
+        role: Optional[str] = None
+        affinity: Optional[int] = None
+        kv_release: Optional[Tuple[str, str]] = None
+        if (request.method == 'POST' and
+                request.target in TWO_HOP_PATHS and lb.two_hop_ready()):
+            # Two-hop route: hop 1 prefills on the specialized fleet
+            # and parks the KV; hop 2 (the normal attempt loop below,
+            # restricted to decode replicas) carries the migration
+            # pointer in headers — the decode replica pulls the delta
+            # and streams the first tokens as soon as the import lands.
+            # Hop-1 failure is NOT fatal: decode replicas re-prefill
+            # locally.
+            hop = await self._prefill_hop(request)
+            if hop is not None:
+                request_id, prefill_url = hop
+                request.set_header('X-Skyt-Kv-Request-Id', request_id)
+                request.set_header('X-Skyt-Kv-Endpoint', prefill_url)
+                kv_release = (prefill_url, request_id)
+            role = 'decode'
+            # Prefix affinity: prompts sharing a leading body prefix
+            # (system prompt, few-shot header) hash to the same decode
+            # replica, whose PrefixCache then makes the migration a
+            # delta pull instead of a full one.
+            affinity = (hash(bytes(request.body[:256]))
+                        if request.body else None)
         try:
             for _ in range(MAX_ATTEMPTS):
-                entry = lb.select(exclude=tried)
+                entry = lb.select(exclude=tried, role=role,
+                                  affinity_key=affinity)
                 if entry is None:
                     break
                 replica_id, url, _weight = entry
@@ -587,7 +702,12 @@ class _AsyncProxy:
                     return usable
                 except _ClientGone:
                     # The *client* went away mid-stream: not a replica
-                    # failure, nothing to retry.
+                    # failure, nothing to retry. If the replica had
+                    # delivered its whole body, it proved healthy —
+                    # close any open circuit (the abort is the
+                    # client's, not the replica's).
+                    if state.upstream_complete:
+                        lb.record_success(replica_id)
                     metrics.LB_REQUESTS.inc(outcome='client_abort')
                     self._finish_span(request, 'client_abort',
                                       replica_id, tried)
@@ -623,6 +743,11 @@ class _AsyncProxy:
                     continue
                 finally:
                     lb.end(replica_id)
+            if kv_release is not None:
+                # No decode replica consumed the export: free the
+                # prefill replica's host memory (best-effort — a dead
+                # prefill replica has nothing left to free).
+                await self._kv_release(*kv_release)
             retry_after = str(lb.retry_after_seconds)
             if not tried:
                 metrics.LB_REQUESTS.inc(outcome='no_replica')
@@ -643,6 +768,103 @@ class _AsyncProxy:
         finally:
             self._inflight -= 1
 
+    # -- the two-hop disaggregated route (hop 1: prefill) ---------------
+
+    async def _prefill_hop(self, request: _Request
+                           ) -> Optional[Tuple[str, str]]:
+        """Drive a prefill-fleet replica's /disagg/prefill with the
+        client's body (p2c over the prefill fleet's EWMA). Returns
+        (request_id, prefill_url), or None to degrade to single-hop —
+        the decode replica then prefills locally."""
+        import json
+        lb = self.lb
+        tried: Set[int] = set()
+        for _ in range(MAX_ATTEMPTS):
+            entry = lb.select(exclude=tried, role='prefill')
+            if entry is None:
+                return None
+            replica_id, url, _weight = entry
+            tried.add(replica_id)
+            pool = self._pool_for(url)
+            start = time.monotonic()
+            lb.begin(replica_id)
+            try:
+                status, body = await self._json_request(
+                    pool, 'POST', '/disagg/prefill', request.body,
+                    extra_headers=(
+                        ('X-Skyt-Disagg-Path', request.target),))
+                if status != 200:
+                    raise ValueError(f'prefill hop status {status}')
+                payload = json.loads(body)
+                lb.observe_latency(replica_id,
+                                   time.monotonic() - start)
+                lb.record_success(replica_id)
+                return str(payload['request_id']), url
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, ValueError,
+                    KeyError) as e:
+                lb.record_failure(replica_id)
+                logger.warning('LB: prefill hop failed on replica %d '
+                               '(%s: %s).', replica_id,
+                               type(e).__name__, e)
+            finally:
+                lb.end(replica_id)
+        return None
+
+    async def _kv_release(self, prefill_url: str,
+                          request_id: str) -> None:
+        try:
+            pool = self._pool_for(prefill_url)
+            await self._json_request(pool, 'POST',
+                                     f'/kv/release/{request_id}', b'')
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError, ValueError):
+            pass
+
+    async def _json_request(self, pool: _UpstreamPool, method: str,
+                            path: str, body: bytes,
+                            extra_headers: Tuple[Tuple[str, str], ...]
+                            = ()) -> Tuple[int, bytes]:
+        """A small LB-originated JSON call over the replica's keep-alive
+        pool (the prefill hop + export release; client requests go
+        through _attempt)."""
+        reader, writer, reused = await pool.acquire()
+        if reused:
+            self._metrics().LB_POOL_REUSE.inc()
+        release = False
+        try:
+            lines = [f'{method} {path} HTTP/1.1'.encode(),
+                     f'Host: {pool.host}:{pool.port}'.encode(),
+                     b'Content-Type: application/json',
+                     b'Content-Length: ' + str(len(body)).encode(),
+                     b'Connection: keep-alive']
+            for key, value in extra_headers:
+                lines.append(f'{key}: {value}'.encode())
+            writer.write(b'\r\n'.join(lines) + b'\r\n\r\n' + body)
+            await writer.drain()
+            head = await asyncio.wait_for(
+                _read_head(reader), timeout=self.upstream_timeout)
+            status_line, _, header_block = head.partition(b'\r\n')
+            parts = status_line.decode('latin-1').split(None, 2)
+            if len(parts) < 2 or not parts[0].startswith('HTTP/'):
+                raise ValueError(f'bad status line: {status_line!r}')
+            status = int(parts[1])
+            mapping = {k.lower(): v
+                       for k, v in _parse_headers(header_block)}
+            length = int(mapping.get('content-length') or 0)
+            payload = b''
+            if length:
+                payload = await asyncio.wait_for(
+                    reader.readexactly(length),
+                    timeout=self.upstream_timeout)
+            release = 'close' not in mapping.get('connection', '').lower()
+            return status, payload
+        finally:
+            if release:
+                pool.release(reader, writer)
+            else:
+                writer.close()
+
     async def _attempt(self, request: _Request,
                        client: asyncio.StreamWriter, pool: _UpstreamPool,
                        replica_id: int, state: _UpstreamState,
@@ -657,6 +879,7 @@ class _AsyncProxy:
         if reused:
             metrics.LB_POOL_REUSE.inc()
         release = False
+        reusable = False
         try:
             self._write_request(writer, request, pool, state)
             await writer.drain()
@@ -667,6 +890,7 @@ class _AsyncProxy:
                 (status, reason, resp_headers, body_iter,
                  upstream_reusable) = self._parse_response(
                      reader, head, request.method, allow_chunked)
+                reusable = upstream_reusable
                 # Interim 1xx responses are not the final answer: read
                 # on (we never forward Expect upstream, so none are
                 # owed to the client).
@@ -686,15 +910,38 @@ class _AsyncProxy:
                           if request.trace_span is not None else None))
             self.lb.observe_latency(replica_id, now - attempt_start)
             client_keep = await self._stream_response(
-                client, status, reason, resp_headers, body_iter,
+                client, status, reason, resp_headers,
+                self._with_intertoken(body_iter, replica_id),
                 upstream_reusable, state)
+            # Only NOW is the replica's answer fully delivered — a
+            # stream that died after the first byte must count against
+            # the breaker, so success is recorded here, not at the head.
+            self.lb.record_success(replica_id)
             release = upstream_reusable
             return client_keep
         finally:
-            if release:
+            # A client abort (_ClientGone) after the upstream body was
+            # fully consumed leaves the upstream at a clean framing
+            # boundary: the connection is as reusable as on the normal
+            # path, so don't pay a re-dial for the client's rudeness.
+            if release or (reusable and state.upstream_complete):
                 pool.release(reader, writer)
             else:
                 writer.close()
+
+    async def _with_intertoken(self, body_iter: AsyncIterator[bytes],
+                               replica_id: int) -> AsyncIterator[bytes]:
+        """Pass chunks through, feeding the gap between successive
+        chunk arrivals to the replica's inter-token EWMA. Single-chunk
+        (plain JSON) responses observe nothing — only streams carry an
+        inter-token signal."""
+        last: Optional[float] = None
+        async for chunk in body_iter:
+            now = time.monotonic()
+            if last is not None:
+                self.lb.observe_intertoken(replica_id, now - last)
+            last = now
+            yield chunk
 
     def _write_request(self, writer: asyncio.StreamWriter,
                        request: _Request, pool: _UpstreamPool,
@@ -845,6 +1092,7 @@ class _AsyncProxy:
             try:
                 chunk = await body_iter.__anext__()
             except StopAsyncIteration:
+                state.upstream_complete = True
                 break
             try:
                 # write + drain per chunk: the whole point is that an
@@ -853,6 +1101,18 @@ class _AsyncProxy:
                 client.write(chunk)
                 await client.drain()
             except (ConnectionError, BrokenPipeError, OSError) as e:
+                # The client hung up. Whether the UPSTREAM completed is
+                # what the breaker needs to know — a client abort must
+                # not read as a replica truncation, so probe for the
+                # end-of-body that usually already sits in our buffer.
+                try:
+                    await asyncio.wait_for(body_iter.__anext__(),
+                                           timeout=0.2)
+                except StopAsyncIteration:
+                    state.upstream_complete = True
+                except (asyncio.TimeoutError, ConnectionError, OSError,
+                        asyncio.IncompleteReadError, ValueError):
+                    pass
                 raise _ClientGone() from e
         return client_keep
 

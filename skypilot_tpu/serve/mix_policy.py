@@ -66,11 +66,14 @@ def plan_mix(spec: ServiceSpec,
              latency_ms: Optional[Dict[int, float]] = None,
              warm_pool_size: Optional[int] = None,
              warm_ttl: Optional[float] = None,
-             now_wall: Optional[float] = None) -> List[Decision]:
+             now_wall: Optional[float] = None,
+             role: str = '') -> List[Decision]:
     """Plan the fleet toward ``target`` replicas under the mix
     invariants above. Pure; ``now_wall`` is wall-clock seconds (WARM
     ages are persisted DB timestamps, unlike the monotonic hysteresis
-    clocks)."""
+    clocks). ``role`` stamps every decision for disaggregated fleets
+    — the caller passes only that fleet's replica rows, so warm
+    resumes stay role-matched the same way they stay class-matched."""
     if warm_pool_size is None:
         warm_pool_size = env_registry.get_int('SKYT_WARM_POOL_SIZE')
     if warm_ttl is None:
@@ -91,7 +94,7 @@ def plan_mix(spec: ServiceSpec,
     for record in expired:
         decisions.append(Decision(DecisionOp.SCALE_DOWN,
                                   replica_id=record.replica_id,
-                                  reason='warm_expire'))
+                                  reason='warm_expire', role=role))
     warm = [r for r in warm if r not in expired]
     warm_slots = max(0, warm_pool_size - len(warm))
 
@@ -124,12 +127,12 @@ def plan_mix(spec: ServiceSpec,
                     DecisionOp.SCALE_UP, use_spot=use_spot,
                     is_fallback=is_fallback,
                     resume_replica_id=record.replica_id,
-                    reason='warm_resume'))
+                    reason='warm_resume', role=role))
             else:
                 decisions.append(Decision(DecisionOp.SCALE_UP,
                                           use_spot=use_spot,
                                           is_fallback=is_fallback,
-                                          reason=reason))
+                                          reason=reason, role=role))
 
     def _scale_down(victims: list, excess: int, reason: str) -> None:
         nonlocal warm_slots
@@ -151,7 +154,7 @@ def plan_mix(spec: ServiceSpec,
             decisions.append(Decision(
                 DecisionOp.SCALE_DOWN, replica_id=record.replica_id,
                 warm=warm_it,
-                reason='warm_stop' if warm_it else reason))
+                reason='warm_stop' if warm_it else reason, role=role))
 
     # -- on-demand floor / share ---------------------------------------
     if len(alive_od) < od_target:

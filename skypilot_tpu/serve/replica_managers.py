@@ -54,19 +54,24 @@ class ReplicaManager:
                  cloud: Optional[str] = None,
                  region: Optional[str] = None,
                  zone: Optional[str] = None,
-                 is_fallback: bool = False) -> int:
+                 is_fallback: bool = False,
+                 role: str = '') -> int:
         """Start one replica; returns its replica id immediately (launch
         continues in a worker thread). ``cloud``/``region``/``zone``
-        pin the placement domain the mix policy chose."""
+        pin the placement domain the mix policy chose; ``role``
+        specializes the replica for disaggregated serving (its engine
+        starts with SKYT_DISAGG_ROLE set)."""
         replica_id = serve_state.next_replica_id(self.service_name)
         cluster_name = f'{self.service_name}-replica-{replica_id}'
         task = self._replica_task(replica_id, use_spot=use_spot,
-                                  cloud=cloud, region=region, zone=zone)
+                                  cloud=cloud, region=region, zone=zone,
+                                  role=role)
         resources = task.resources[0]
         serve_state.add_replica(self.service_name, replica_id, cluster_name,
                                 is_spot=bool(resources.use_spot),
                                 is_fallback=is_fallback,
-                                cloud=cloud, region=region, zone=zone)
+                                cloud=cloud, region=region, zone=zone,
+                                role=role)
         thread = threading.Thread(
             target=self._launch_replica,
             args=(replica_id, cluster_name, task),
@@ -191,7 +196,8 @@ class ReplicaManager:
                       use_spot: Optional[bool],
                       cloud: Optional[str] = None,
                       region: Optional[str] = None,
-                      zone: Optional[str] = None) -> Task:
+                      zone: Optional[str] = None,
+                      role: str = '') -> Task:
         """Per-replica task: inject the replica's identity/port envs and
         any spot/placement-domain overrides from the autoscaler /
         mix policy."""
@@ -203,6 +209,11 @@ class ReplicaManager:
             REPLICA_ID_ENV: str(replica_id),
             REPLICA_PORT_ENV: str(port),
         })
+        if role:
+            # Disaggregated serving: the replica's engine reads this at
+            # startup and comes up prefill- or decode-specialized
+            # (docs/disaggregated_serving.md).
+            task.update_envs({'SKYT_DISAGG_ROLE': role})
         if env_registry.get_bool('SKYT_FANOUT'):
             # Hand the replica its fan-out peer plan: the ancestor
             # chain over the current READY fleet it pulls weight

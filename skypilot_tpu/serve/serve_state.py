@@ -220,6 +220,14 @@ def _db():
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE replicas ADD COLUMN '
                 'fanout_quarantined INTEGER DEFAULT 0')
+        if 'role' not in replica_cols:
+            # Disaggregated serving (docs/disaggregated_serving.md):
+            # 'prefill' or 'decode' for specialized fleets, empty for
+            # colocated replicas. The LB's two-hop route and the
+            # per-role autoscaler partition the fleet on this.
+            common_utils.add_column_if_missing(
+                conn, "ALTER TABLE replicas ADD COLUMN "
+                "role TEXT DEFAULT ''")
         conn.commit()
 
     os.makedirs(serve_dir(), exist_ok=True)
@@ -515,6 +523,7 @@ class ReplicaRecord:
         self.fanout_quarantined: bool = bool(
             row['fanout_quarantined']
             if 'fanout_quarantined' in keys else 0)
+        self.role: str = (row['role'] or '') if 'role' in keys else ''
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -536,6 +545,7 @@ class ReplicaRecord:
             'lb_ejected': self.lb_ejected,
             'lb_ejected_until': self.lb_ejected_until,
             'fanout_quarantined': self.fanout_quarantined,
+            'role': self.role,
         }
 
 
@@ -550,15 +560,16 @@ def add_replica(service_name: str, replica_id: int, cluster_name: str,
                 *, is_spot: bool, is_fallback: bool = False,
                 cloud: Optional[str] = None,
                 region: Optional[str] = None,
-                zone: Optional[str] = None) -> None:
+                zone: Optional[str] = None,
+                role: str = '') -> None:
     conn = _db()
     conn.execute(
         'INSERT INTO replicas (service_name, replica_id, cluster_name, '
-        'status, is_spot, is_fallback, cloud, region, zone, launched_at) '
-        'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+        'status, is_spot, is_fallback, cloud, region, zone, launched_at, '
+        'role) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
         (service_name, replica_id, cluster_name,
          ReplicaStatus.PROVISIONING.value, int(is_spot), int(is_fallback),
-         cloud, region, zone, time.time()))
+         cloud, region, zone, time.time(), role))
     conn.commit()
 
 

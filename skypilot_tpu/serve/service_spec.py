@@ -58,6 +58,8 @@ class ServiceSpec:
         target_qps_per_replica: Optional[float] = None,
         target_queue_length: Optional[float] = None,
         target_latency_p99_ms: Optional[float] = None,
+        target_ttft_p99_ms: Optional[float] = None,
+        target_intertoken_p99_ms: Optional[float] = None,
         forecaster: Optional[str] = None,
         forecast_horizon_seconds: Optional[float] = None,
         scale_to_zero_idle_seconds: Optional[float] = None,
@@ -77,16 +79,31 @@ class ServiceSpec:
         if max_replicas is not None and max_replicas < min_replicas:
             raise exceptions.InvalidSpecError(
                 f'max_replicas {max_replicas} < min_replicas {min_replicas}')
+        # The disagg pair (TTFT + inter-token) counts as ONE target:
+        # it sizes two fleets, but selects one autoscaler.
+        if (target_ttft_p99_ms is None) != (target_intertoken_p99_ms is
+                                            None):
+            raise exceptions.InvalidSpecError(
+                'Disaggregated serving needs BOTH target_ttft_p99_ms '
+                'and target_intertoken_p99_ms (each SLO sizes one '
+                'fleet).')
         targets = [t for t in (target_qps_per_replica,
                                target_queue_length,
-                               target_latency_p99_ms) if t is not None]
+                               target_latency_p99_ms,
+                               target_ttft_p99_ms) if t is not None]
         if len(targets) > 1:
             raise exceptions.InvalidSpecError(
                 'Set only one of target_qps_per_replica / '
-                'target_queue_length / target_latency_p99_ms.')
+                'target_queue_length / target_latency_p99_ms / the '
+                'target_ttft_p99_ms + target_intertoken_p99_ms pair.')
         if target_latency_p99_ms is not None and target_latency_p99_ms <= 0:
             raise exceptions.InvalidSpecError(
                 'target_latency_p99_ms must be > 0.')
+        for name, value in (('target_ttft_p99_ms', target_ttft_p99_ms),
+                            ('target_intertoken_p99_ms',
+                             target_intertoken_p99_ms)):
+            if value is not None and value <= 0:
+                raise exceptions.InvalidSpecError(f'{name} must be > 0.')
         if forecaster is not None:
             from skypilot_tpu.serve import forecast  # noqa: F401
             from skypilot_tpu.utils.registry import FORECASTER_REGISTRY
@@ -114,6 +131,12 @@ class ServiceSpec:
         self.target_latency_p99_ms = (
             float(target_latency_p99_ms)
             if target_latency_p99_ms is not None else None)
+        self.target_ttft_p99_ms = (
+            float(target_ttft_p99_ms)
+            if target_ttft_p99_ms is not None else None)
+        self.target_intertoken_p99_ms = (
+            float(target_intertoken_p99_ms)
+            if target_intertoken_p99_ms is not None else None)
         self.forecaster = forecaster
         self.forecast_horizon_seconds = (
             float(forecast_horizon_seconds)
@@ -137,7 +160,15 @@ class ServiceSpec:
     def autoscaling(self) -> bool:
         return (self.target_qps_per_replica is not None or
                 self.target_queue_length is not None or
-                self.target_latency_p99_ms is not None)
+                self.target_latency_p99_ms is not None or
+                self.target_ttft_p99_ms is not None)
+
+    @property
+    def disaggregated(self) -> bool:
+        """Two specialized fleets (prefill + decode) instead of one
+        colocated fleet — selected by the TTFT/inter-token SLO pair
+        (docs/disaggregated_serving.md)."""
+        return self.target_ttft_p99_ms is not None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -191,7 +222,8 @@ class ServiceSpec:
         if policy is not None:
             for key in ('min_replicas', 'max_replicas',
                         'target_qps_per_replica', 'target_queue_length',
-                        'target_latency_p99_ms', 'forecaster',
+                        'target_latency_p99_ms', 'target_ttft_p99_ms',
+                        'target_intertoken_p99_ms', 'forecaster',
                         'forecast_horizon_seconds',
                         'scale_to_zero_idle_seconds',
                         'upscale_delay_seconds', 'downscale_delay_seconds',
@@ -239,6 +271,11 @@ class ServiceSpec:
             policy['target_queue_length'] = self.target_queue_length
         if self.target_latency_p99_ms is not None:
             policy['target_latency_p99_ms'] = self.target_latency_p99_ms
+        if self.target_ttft_p99_ms is not None:
+            policy['target_ttft_p99_ms'] = self.target_ttft_p99_ms
+        if self.target_intertoken_p99_ms is not None:
+            policy['target_intertoken_p99_ms'] = (
+                self.target_intertoken_p99_ms)
         if self.forecaster is not None:
             policy['forecaster'] = self.forecaster
         if self.forecast_horizon_seconds is not None:

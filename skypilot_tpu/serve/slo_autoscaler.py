@@ -223,3 +223,261 @@ class SLOAutoscaler(Autoscaler):
         """Last evaluation's internals (forecast, model fit, target)
         for the controller's metrics emission and `status`."""
         return dict(self._snapshot)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated serving: two fleets, two SLOs, two inversions.
+# ---------------------------------------------------------------------------
+
+
+def _invert_slo(model: LatencyModel, target_ms: float, qps: float,
+                sojourn_scale: float = 1.0) -> Optional[int]:
+    """Smallest fleet whose predicted p99 meets ``target_ms`` at
+    ``qps``, from the fitted p99(c) = base + slope*c line and Little's
+    law c = qps * sojourn/1000 / n. ``sojourn_scale`` is how much
+    longer a request OCCUPIES a replica than the modeled latency: 1.0
+    for the prefill fleet (a request holds a prefill slot for ~its
+    TTFT), tokens-per-request for the decode fleet (a request holds a
+    decode slot for n_tokens inter-token intervals). Same closed form
+    as SLOAutoscaler._required_replicas with the sojourn scaled:
+    n >= qps/1000 * scale * slope*target/(target-base). None = model
+    can't answer (unfitted, base > target: unattainable, or slope ~ 0).
+
+    The slope ~ 0 case is a DEGENERATE fit, not a flat fleet: under
+    closed-loop control the fleet gets pinned at its SLO boundary, the
+    decayed samples cluster at one operating point, and the fitted
+    slope collapses toward zero. Serving latency always rises with
+    concurrency, so "latency doesn't depend on load → 1 replica" would
+    collapse the fleet into a saturation it can never refit its way
+    out of (saturated samples fail the steady-state guard). Holding
+    keeps the fleet where it was until load moves and the line becomes
+    identifiable again."""
+    if not model.fitted:
+        return None
+    base, slope = model.coefficients()
+    if base > target_ms:
+        return None
+    if slope <= 1e-12:
+        return None
+    n = (qps / 1000.0) * sojourn_scale * (
+        slope * target_ms / max(1e-9, target_ms - base))
+    return max(1, int(math.ceil(n - 1e-9)))
+
+
+class _FleetTrack(Autoscaler):
+    """Hysteresis/bounds carrier for ONE specialized fleet: the parent
+    computes the raw size, the track runs it through the shared
+    stabilization window so each fleet flaps (or rather, doesn't)
+    independently."""
+
+    def __init__(self, spec) -> None:
+        super().__init__(spec)
+        self.raw = spec.min_replicas
+
+    def _raw_target(self, stats, num_alive: int) -> int:
+        return self.raw
+
+
+@AUTOSCALER_REGISTRY.register('disagg_slo')
+class DisaggSLOAutoscaler(Autoscaler):
+    """Sizes the prefill and decode fleets INDEPENDENTLY, each from its
+    own SLO (selected by the ``target_ttft_p99_ms`` +
+    ``target_intertoken_p99_ms`` pair; docs/disaggregated_serving.md).
+
+    Why one autoscaler can't do it: in a colocated fleet a decode
+    saturation and a prefill saturation look the same (p99 up, add
+    replicas). Disaggregated, they are different fleets with different
+    latency–concurrency curves and different Little's-law sojourn
+    times — a request occupies a prefill slot for roughly its TTFT but
+    a decode slot for its whole generation. So:
+
+    * **prefill fleet** — TTFT model fitted on (prefill concurrency,
+      prefill-fleet p99 TTFB from the LB's hop-1 EWMA), inverted
+      against ``target_ttft_p99_ms`` with sojourn = the modeled TTFT;
+    * **decode fleet** — inter-token model fitted on (decode
+      concurrency, decode-fleet p99 over the LB's streamed inter-chunk
+      EWMA), inverted against ``target_intertoken_p99_ms`` with
+      sojourn = tokens-per-request × inter-token latency, where
+      tokens-per-request is estimated online from the decode fleet's
+      own Little's law (occupancy/qps ÷ observed inter-token) and
+      smoothed — no config knob to go stale.
+
+    One forecaster drives both inversions (every request crosses both
+    fleets), and each fleet's raw size runs through its own hysteresis
+    track before ``mix_policy.plan_mix`` plans each fleet separately
+    with role-stamped decisions. Replicas with no role (colocated
+    leftovers mid-migration) are planned with the decode fleet — they
+    can serve complete requests, so they drain rather than strand."""
+
+    _TOKENS_ALPHA = 0.2          # smoothing for tokens-per-request
+    _DEFAULT_TOKENS = 64.0       # sojourn scale before any observation
+
+    def __init__(self, spec) -> None:
+        super().__init__(spec)
+        assert spec.target_ttft_p99_ms is not None
+        assert spec.target_intertoken_p99_ms is not None
+        self.forecaster = make_forecaster(spec.forecaster)
+        self.prefill_model = LatencyModel()
+        self.decode_model = LatencyModel()
+        self.horizon = (spec.forecast_horizon_seconds
+                        if spec.forecast_horizon_seconds is not None else
+                        env_registry.get_float('SKYT_FORECAST_HORIZON'))
+        self.warm_pool_size = env_registry.get_int('SKYT_WARM_POOL_SIZE')
+        self.warm_ttl = env_registry.get_float('SKYT_WARM_POOL_TTL')
+        self.spot_wanted = False
+        self._tokens_per_request = self._DEFAULT_TOKENS
+        self._tracks = {'prefill': _FleetTrack(spec),
+                        'decode': _FleetTrack(spec)}
+        self._snapshot: Dict[str, Any] = {}
+
+    @staticmethod
+    def _split_roles(replicas: List[serve_state.ReplicaRecord]
+                     ) -> Dict[str, List[serve_state.ReplicaRecord]]:
+        fleets: Dict[str, List[serve_state.ReplicaRecord]] = {
+            'prefill': [], 'decode': []}
+        for record in replicas:
+            role = getattr(record, 'role', '')
+            fleets['prefill' if role == 'prefill' else 'decode'].append(
+                record)
+        return fleets
+
+    def _fit(self, stats: LoadStats, fleets) -> None:
+        """Fit each fleet's latency model at its own steady-state
+        operating point (same saturation guard as SLOAutoscaler: a
+        backlog-draining fleet's concurrency is queue-driven, not on
+        the base+slope*c line)."""
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        for role, model, latencies in (
+                ('prefill', self.prefill_model, stats.replica_latency_ms),
+                ('decode', self.decode_model,
+                 stats.replica_intertoken_ms)):
+            members = {r.replica_id for r in fleets[role]
+                       if r.status == ReplicaStatus.READY}
+            samples = {rid: ms for rid, ms in latencies.items()
+                       if rid in members}
+            p99 = fleet_p99_ms(samples)
+            if p99 is None or not samples:
+                continue
+            occupancy = sum(stats.replica_in_flight.get(rid, 0)
+                            for rid in members)
+            concurrency = occupancy / max(len(samples), 1)
+            little_c = (stats.qps * p99 / 1000.0 /
+                        max(len(samples), 1))
+            if concurrency <= 2.0 * little_c + 1.0 or role == 'decode':
+                # The decode guard differs: decode occupancy is
+                # LEGITIMATELY far above qps*itl/n (requests park for
+                # their whole generation), so the Little's-law
+                # consistency check would reject every decode sample.
+                model.observe(concurrency, p99)
+            if role == 'decode' and p99 > 1e-9 and stats.qps > _EPS_QPS:
+                # Online tokens-per-request: Little's law on the fleet
+                # itself — mean residency = occupancy/qps, in units of
+                # the observed inter-token interval.
+                est = (occupancy / stats.qps) * 1000.0 / p99
+                if est > 0:
+                    self._tokens_per_request += self._TOKENS_ALPHA * (
+                        est - self._tokens_per_request)
+
+    def evaluate(self, stats: LoadStats,
+                 replicas: List[serve_state.ReplicaRecord]
+                 ) -> List[Decision]:
+        from skypilot_tpu.serve.mix_policy import plan_mix
+        now = self._clock()
+        self.forecaster.observe(now, stats.qps)
+        fleets = self._split_roles(replicas)
+        alive = {role: _alive(members)
+                 for role, members in fleets.items()}
+        self._fit(stats, fleets)
+        predicted_qps = self.forecaster.predict(now, self.horizon)
+
+        raw = {
+            'prefill': _invert_slo(self.prefill_model,
+                                   self.spec.target_ttft_p99_ms,
+                                   predicted_qps),
+            'decode': _invert_slo(self.decode_model,
+                                  self.spec.target_intertoken_p99_ms,
+                                  predicted_qps,
+                                  sojourn_scale=self._tokens_per_request),
+        }
+        # Observed per-fleet p99 for the reactive breach check below.
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        ready_ids = {
+            role: {r.replica_id for r in members
+                   if r.status == ReplicaStatus.READY}
+            for role, members in fleets.items()}
+        observed = {
+            'prefill': fleet_p99_ms(
+                {rid: ms for rid, ms in stats.replica_latency_ms.items()
+                 if rid in ready_ids['prefill']}),
+            'decode': fleet_p99_ms(
+                {rid: ms
+                 for rid, ms in stats.replica_intertoken_ms.items()
+                 if rid in ready_ids['decode']}),
+        }
+        slo = {'prefill': self.spec.target_ttft_p99_ms,
+               'decode': self.spec.target_intertoken_p99_ms}
+
+        decisions: List[Decision] = []
+        targets: Dict[str, int] = {}
+        for role, track in self._tracks.items():
+            required = raw[role]
+            if required is None:
+                # Unfitted/unattainable: hold this fleet (but never at
+                # zero while traffic exists — a fleet must exist to
+                # produce the latency samples that fit its model).
+                required = track._target
+                if predicted_qps > _EPS_QPS:
+                    required = max(1, required)
+            # Reactive escape hatch: a saturated fleet produces NO
+            # fittable samples (the steady-state guard rejects queue-
+            # driven points), so a model frozen on a wrong line would
+            # hold the fleet undersized forever. While this fleet's
+            # OBSERVED p99 breaches its SLO, never plan at-or-below
+            # its current ready size — grow ~10%/round until the
+            # breach clears and the model can refit from reality.
+            n_role_ready = len(ready_ids[role])
+            if (observed[role] is not None and
+                    observed[role] > slo[role] + 1e-9 and
+                    required <= n_role_ready):
+                required = n_role_ready + max(
+                    1, -(-n_role_ready // 10))
+            track.raw = required
+            # The tracks share the parent's clocks so simkit's virtual
+            # time drives their hysteresis windows too.
+            track._clock = self._clock
+            track._wall_clock = self._wall_clock
+            targets[role] = track.target_replicas(stats,
+                                                  len(alive[role]))
+            for decision in plan_mix(
+                    self.spec, targets[role], fleets[role],
+                    spot_wanted=self.spot_wanted,
+                    latency_ms=stats.replica_latency_ms,
+                    warm_pool_size=self.warm_pool_size,
+                    warm_ttl=self.warm_ttl,
+                    now_wall=self._wall_clock(),
+                    role=role):
+                decisions.append(decision)
+
+        pre_base, pre_slope = self.prefill_model.coefficients()
+        dec_base, dec_slope = self.decode_model.coefficients()
+        self._snapshot = {
+            'predicted_qps': predicted_qps,
+            'observed_qps': stats.qps,
+            'target': targets['prefill'] + targets['decode'],
+            'prefill_target': targets['prefill'],
+            'decode_target': targets['decode'],
+            'ttft_model_base_ms': pre_base,
+            'ttft_model_slope_ms': pre_slope,
+            'intertoken_model_base_ms': dec_base,
+            'intertoken_model_slope_ms': dec_slope,
+            'tokens_per_request': self._tokens_per_request,
+            'ttft_attainable': (not self.prefill_model.fitted or
+                                pre_base <= self.spec.target_ttft_p99_ms),
+            'intertoken_attainable': (
+                not self.decode_model.fitted or
+                dec_base <= self.spec.target_intertoken_p99_ms),
+        }
+        return decisions
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._snapshot)

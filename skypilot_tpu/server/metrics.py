@@ -300,6 +300,13 @@ LB_POOL_REUSE = Counter(
     'Serve LB upstream requests served over a reused keep-alive '
     'connection (vs a fresh TCP dial)',
     labels=())
+DISAGG_HANDOFF = Histogram(
+    'skyt_disagg_handoff_seconds',
+    'Prefill->decode handoff latency: prefill completion to the '
+    'decode replica resuming the stream (KV migration + import; the '
+    'TTFT tax disaggregation pays for specialized fleets)',
+    buckets=_TTFB_BUCKETS,
+    labels=())
 
 # -- serve predictive autoscaling (emitted by the per-service
 # controller, which shares the service process with the LB — scraped
@@ -346,7 +353,8 @@ _AUTOSCALE_METRICS = [AUTOSCALE_PREDICTED_QPS, AUTOSCALE_PREDICTED_P99,
                       AUTOSCALE_WARM_POOL, AUTOSCALE_DECISIONS,
                       AUTOSCALE_OBSERVED_QPS]
 
-_LB_METRICS = [LB_REQUESTS, LB_TTFB, LB_POOL_REUSE] + _AUTOSCALE_METRICS
+_LB_METRICS = ([LB_REQUESTS, LB_TTFB, LB_POOL_REUSE, DISAGG_HANDOFF]
+               + _AUTOSCALE_METRICS)
 
 # -- storage/checkpoint data plane (incremented in-process by the
 # transfer engine, client- or cluster-side) ----------------------------
@@ -419,11 +427,30 @@ FANOUT_QUARANTINED = Gauge(
     'Replicas currently in fleet-wide integrity quarantine',
     labels=('service',))
 
+# -- disaggregated serving: prefill->decode KV-block migration
+# (inference/kv_migrate.py; incremented in the replica processes, the
+# same in-process stance as the fanout family) -------------------------
+
+KV_MIGRATE_BLOCKS = Counter(
+    'skyt_kv_migrate_blocks_total',
+    'KV blocks handled by prefill->decode migrations by outcome '
+    '(moved = payload crossed the wire, resident = delta-manifest hit '
+    'on the decode side\'s PrefixCache so nothing moved, '
+    'corrupt_retry = digest mismatch discarded and re-pulled — a '
+    'corrupt block is never decoded)',
+    labels=('outcome',))
+KV_MIGRATE_BYTES = Counter(
+    'skyt_kv_migrate_bytes_total',
+    'KV migration payload bytes by direction (push = served by the '
+    'prefill side, pull = received verified by the decode side)',
+    labels=('direction',))
+
 _TRANSFER_METRICS = [TRANSFER_BYTES, TRANSFER_OBJECTS, TRANSFER_SECONDS,
                      TRANSFER_RETRIES, FANOUT_SHARDS, FANOUT_BYTES,
                      FANOUT_HEALS, FANOUT_PULLS, FANOUT_QUARANTINES,
                      FANOUT_LEASE_WAIT, FANOUT_BUCKET_LEASES,
-                     FANOUT_QUARANTINED]
+                     FANOUT_QUARANTINED, KV_MIGRATE_BLOCKS,
+                     KV_MIGRATE_BYTES]
 
 # -- managed-job recovery / elastic resize (derived from the durable
 # jobs-DB recovery_events table on scrape: controllers run as detached
@@ -481,6 +508,9 @@ INFERENCE_COUNTER_STATS = frozenset({
     # Speculative decoding (r13): acceptance rate = rate(accepted) /
     # rate(draft); spec_window stays a gauge.
     'draft_tokens', 'accepted_tokens', 'verify_steps',
+    # Disaggregated serving (r18): cumulative KV migration counts;
+    # kv_exports_pending stays a gauge.
+    'kv_exports', 'kv_imports', 'kv_import_fallbacks',
 })
 # Highest recovery_events row id already folded into _JOB_METRICS.
 _recovery_cursor = 0

@@ -32,11 +32,16 @@ __all__ = ['SimReport']
 #   max_bucket_readers: N         -> weight convoy stayed inside the
 #                                    bucket lease bound (fleet.weights)
 #   max_time_to_weights_p99_s: S  -> p99 landed-to-weights latency
+#   max_ttft_p99_s: S             -> run-level p99 time-to-first-token
+#                                    (fleet.disagg prefill stage)
+#   max_intertoken_p99_ms: M      -> run-level p99 inter-token latency
+#                                    (fleet.disagg decode stage)
 _INVARIANT_KEYS = ('no_lost_requests', 'max_shed_requests',
                    'max_slo_miss_seconds', 'max_target_flips',
                    'max_final_queue', 'min_served_fraction',
                    'max_controller_faults', 'max_bucket_readers',
-                   'max_time_to_weights_p99_s')
+                   'max_time_to_weights_p99_s', 'max_ttft_p99_s',
+                   'max_intertoken_p99_ms')
 
 
 class SimReport:
@@ -127,6 +132,12 @@ class SimReport:
                 ok = actual <= bound
             elif key == 'max_time_to_weights_p99_s':
                 actual = s['time_to_weights_p99_s']
+                ok = actual <= bound
+            elif key == 'max_ttft_p99_s':
+                actual = s['ttft_p99_s']
+                ok = actual <= bound
+            elif key == 'max_intertoken_p99_ms':
+                actual = s['intertoken_p99_ms']
                 ok = actual <= bound
             else:  # max_controller_faults
                 actual = s['controller_faults']

@@ -154,6 +154,14 @@ class Scenario:
         base = self.fleet
         fleet['initial_replicas'] = max(
             0, int(round(base['initial_replicas'] * factor)))
+        for role in ('prefill', 'decode'):
+            block = (fleet.get('disagg') or {}).get(role)
+            if block and block.get('initial_replicas'):
+                # Per-role warm starts scale with the fleet; latency
+                # lines and tokens_per_request are per-replica and
+                # therefore scale-invariant.
+                block['initial_replicas'] = max(
+                    1, int(round(block['initial_replicas'] * factor)))
         service = data.setdefault('service', {})
         for key in ('min_replicas', 'max_replicas',
                     'base_ondemand_fallback_replicas'):
@@ -204,6 +212,15 @@ class Scenario:
                 # mistaken for injected chaos.
                 from skypilot_tpu.utils import fault_injection
                 fault_injection.parse_spec(fault['spec'])
+        if self.fleet.get('disagg'):
+            service = data.get('service', {})
+            if service.get('target_ttft_p99_ms') is None or \
+                    service.get('target_intertoken_p99_ms') is None:
+                raise ValueError(
+                    'fleet.disagg scenarios need service.'
+                    'target_ttft_p99_ms and service.'
+                    'target_intertoken_p99_ms (the pair selects the '
+                    'disagg_slo autoscaler)')
         domains = self.fleet['domains']
         if not domains:
             raise ValueError('fleet.domains must be non-empty')

@@ -397,6 +397,10 @@ DECLARATIONS: List[EnvVar] = _decl([
      'Transfer engine per-object attempt budget.'),
     ('SKYT_TRANSFER_DELTA', 'bool', True,
      'Manifest-based delta sync (0 forces full re-transfer).'),
+    ('SKYT_TRANSFER_POOL_SIZE', 'int', 8,
+     'Transfer engine: max idle keep-alive connections kept per '
+     '(host, port) for ranged GETs (0 disables pooling — every part '
+     'dials fresh).'),
     ('SKYT_S3_ENDPOINT_URL', 'url', None,
      'S3-compatible endpoint override (tests point it at fake_s3).'),
     ('SKYT_AZURE_BLOB_ENDPOINT', 'url', None,
@@ -445,6 +449,22 @@ DECLARATIONS: List[EnvVar] = _decl([
     ('SKYT_SPEC_NGRAM_MAX', 'int', 3,
      'Longest trailing n-gram the prompt-lookup draft matches on '
      '(it backs off to shorter n-grams).'),
+    ('SKYT_DISAGG_ROLE', 'str', '',
+     'Disaggregated serving role for this replica: "prefill" (chunked '
+     'prefill at full arithmetic intensity, exports finished KV '
+     'blocks, never decodes), "decode" (imports KV blocks, batched '
+     'decode, never prefill-interleaves except on re-prefill '
+     'fallback); empty = colocated engine '
+     '(docs/disaggregated_serving.md).'),
+    ('SKYT_KV_MIGRATE_TIMEOUT', 'float', 30.0,
+     'Per-request timeout on prefill->decode KV-block fetches; a '
+     'hung prefill source fails the migration (the decode side falls '
+     'back to a local re-prefill) after this long.'),
+    ('SKYT_KV_MIGRATE_RETRIES', 'int', 3,
+     'KV migration per-payload attempt budget: unavailable sources '
+     'are retried with Retry-After-floored backoff, corrupt blocks '
+     're-pulled from scratch, this many times before the decode side '
+     'gives up and re-prefills.'),
 
     # -- provisioning -----------------------------------------------
     ('SKYT_K8S_FAKE', 'bool', False,

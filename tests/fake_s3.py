@@ -58,6 +58,13 @@ def _handler_for(state: _State, latency: float, bandwidth,
         def log_message(self, *a):  # quiet
             pass
 
+        def setup(self):
+            # One TCP connection = one handler setup (keep-alive reuse
+            # tests assert parallel ranged GETs don't re-dial per part).
+            with state.lock:
+                state.counters['connections'] += 1
+            super().setup()
+
         def _split(self):
             parsed = urllib.parse.urlparse(self.path)
             parts = parsed.path.lstrip('/').split('/', 1)

@@ -510,3 +510,63 @@ def test_s3_storage_errors_carry_retry_after(s3_env, monkeypatch):
         client.put_object('rb', 'k', b'data')
     assert err.value.http_status == 503
     assert err.value.retry_after == 9.0
+
+
+# -- keep-alive transfer pool ------------------------------------------
+
+
+def test_ranged_get_pool_reuses_connections(s3_env, monkeypatch):
+    """Sequential part fetches against one endpoint ride ONE TCP
+    connection through the keep-alive pool — pre-pool, every part paid
+    a fresh dial (urlopen sends Connection: close)."""
+    payload = bytes(range(256)) * 256  # 64 KiB
+    client = _client()
+    client.create_bucket('b')
+    client.put_object('b', 'big.bin', payload)
+    pool = s3_lib.TransferConnectionPool(size=4)
+    monkeypatch.setattr(s3_lib, '_RANGE_POOL', pool)
+    before = s3_env.state.counters['connections']
+    parts = [client.get_object_range('b', 'big.bin', i * 1024, 1024)
+             for i in range(16)]
+    assert b''.join(parts) == payload[:16 * 1024]
+    assert pool.dials == 1
+    assert pool.reuses == 15
+    assert s3_env.state.counters['connections'] - before == 1
+
+
+def test_transfer_pool_bound_caps_idle_sockets():
+    pool = s3_lib.TransferConnectionPool(size=2)
+
+    class _Conn:
+        def close(self):
+            pass
+
+    kept = [pool._release(('http', 'h', 80), _Conn()) for _ in range(5)]
+    assert kept == [True, True, False, False, False]
+
+
+def test_transfer_pool_size_env_knob(monkeypatch):
+    monkeypatch.setenv('SKYT_TRANSFER_POOL_SIZE', '0')
+
+    class _Conn:
+        def close(self):
+            pass
+
+    pool = s3_lib.TransferConnectionPool()
+    assert pool._release(('http', 'h', 80), _Conn()) is False
+
+
+def test_pool_survives_stale_keepalive(s3_env, monkeypatch):
+    """A pooled connection the server closed between requests must be
+    retried on a fresh dial, not surfaced as a failure."""
+    client = _client()
+    client.create_bucket('b')
+    client.put_object('b', 'k.bin', b'0123456789')
+    pool = s3_lib.TransferConnectionPool(size=4)
+    monkeypatch.setattr(s3_lib, '_RANGE_POOL', pool)
+    assert client.get_object_range('b', 'k.bin', 0, 4) == b'0123'
+    # Sabotage the idle socket the way a server-side idle timeout does.
+    for idle in pool._idle.values():
+        for conn in idle:
+            conn.sock.close() if conn.sock else None
+    assert client.get_object_range('b', 'k.bin', 4, 4) == b'4567'

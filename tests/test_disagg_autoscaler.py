@@ -1,0 +1,170 @@
+"""Disaggregated SLO autoscaling: the TTFT SLO sizes the prefill
+fleet and the inter-token SLO sizes the decode fleet, each through its
+own latency model, Little's-law inversion, and hysteresis track
+(docs/disaggregated_serving.md)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve.autoscalers import (Autoscaler, DecisionOp,
+                                            LoadStats)
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.slo_autoscaler import DisaggSLOAutoscaler
+
+
+def _spec(**kw):
+    defaults = dict(min_replicas=1, max_replicas=32,
+                    target_ttft_p99_ms=200.0,
+                    target_intertoken_p99_ms=50.0,
+                    upscale_delay_seconds=0, downscale_delay_seconds=0)
+    defaults.update(kw)
+    return ServiceSpec(**defaults)
+
+
+class _R:
+    def __init__(self, replica_id, status=ReplicaStatus.READY,
+                 role='', warm_since=None):
+        self.replica_id = replica_id
+        self.status = status
+        self.role = role
+        self.is_spot = False
+        self.is_fallback = False
+        self.warm_since = warm_since
+        self.cloud = self.region = self.zone = None
+
+
+def _sim_clock(scaler):
+    clock = {'t': 0.0}
+    scaler._clock = lambda: clock['t']
+    scaler._wall_clock = lambda: clock['t']
+    return clock
+
+
+def _prime(model, base, slope):
+    for _ in range(10):
+        model.observe(0.0, base)
+        model.observe(10.0, base + slope * 10.0)
+
+
+# -- spec selection ----------------------------------------------------------
+
+
+def test_spec_pair_selects_disagg_autoscaler():
+    assert isinstance(Autoscaler.from_spec(_spec()), DisaggSLOAutoscaler)
+
+
+def test_spec_rejects_half_a_pair():
+    with pytest.raises(exceptions.InvalidSpecError, match='BOTH'):
+        ServiceSpec(min_replicas=1, max_replicas=4,
+                    target_ttft_p99_ms=200.0)
+
+
+def test_spec_rejects_mixing_with_other_targets():
+    with pytest.raises(exceptions.InvalidSpecError, match='only one'):
+        ServiceSpec(min_replicas=1, max_replicas=4,
+                    target_latency_p99_ms=100.0,
+                    target_ttft_p99_ms=200.0,
+                    target_intertoken_p99_ms=50.0)
+
+
+def test_spec_round_trips_disagg_targets():
+    spec = _spec()
+    again = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again.target_ttft_p99_ms == 200.0
+    assert again.target_intertoken_p99_ms == 50.0
+    assert again.disaggregated
+
+
+# -- independent sizing ------------------------------------------------------
+
+
+def test_two_inversions_size_fleets_independently():
+    """TTFT line: base 50 slope 10 against 200ms -> n_pre =
+    qps/1000 * 10*200/150 = qps/75. Inter-token line: base 10 slope 1
+    against 50ms with 20 tokens/request -> n_dec =
+    qps/1000 * 20 * 1*50/40 = qps/40. At 300 qps: 4 prefill,
+    8 decode — the SAME traffic needs twice the decode capacity, which
+    a single-model autoscaler cannot express."""
+    scaler = DisaggSLOAutoscaler(_spec())
+    clock = _sim_clock(scaler)
+    _prime(scaler.prefill_model, base=50.0, slope=10.0)
+    _prime(scaler.decode_model, base=10.0, slope=1.0)
+    scaler._tokens_per_request = 20.0
+    replicas = [_R(1, role='prefill'), _R(2, role='decode')]
+    for _ in range(25):
+        clock['t'] += 10
+        decisions = scaler.evaluate(LoadStats(qps=300.0), replicas)
+    snap = scaler.snapshot()
+    assert snap['prefill_target'] == 4
+    assert snap['decode_target'] == 8
+    ups = [d for d in decisions if d.op == DecisionOp.SCALE_UP]
+    assert sum(d.count for d in ups if d.role == 'prefill') == 3
+    assert sum(d.count for d in ups if d.role == 'decode') == 7
+    assert all(d.role in ('prefill', 'decode') for d in decisions)
+
+
+def test_unfitted_models_hold_one_replica_per_fleet():
+    scaler = DisaggSLOAutoscaler(_spec())
+    _sim_clock(scaler)
+    decisions = scaler.evaluate(LoadStats(qps=100.0), [])
+    ups = [d for d in decisions if d.op == DecisionOp.SCALE_UP]
+    assert {d.role for d in ups} == {'prefill', 'decode'}
+    assert sum(d.count for d in ups) == 2  # hold-at-one per fleet
+
+
+def test_decode_model_fits_from_intertoken_signal():
+    """The decode model learns from replica_intertoken_ms (the LB's
+    streamed inter-chunk EWMA), never from TTFB; tokens-per-request is
+    estimated from the decode fleet's own occupancy."""
+    scaler = DisaggSLOAutoscaler(_spec())
+    clock = _sim_clock(scaler)
+    replicas = [_R(1, role='prefill'), _R(2, role='decode'),
+                _R(3, role='decode')]
+    for i in range(30):
+        clock['t'] += 10
+        occupancy = 4 if i % 2 else 12
+        scaler.evaluate(
+            LoadStats(qps=10.0,
+                      replica_intertoken_ms={2: 20.0 + occupancy,
+                                             3: 22.0 + occupancy},
+                      replica_in_flight={1: 1, 2: occupancy,
+                                         3: occupancy}),
+            replicas)
+    assert scaler.decode_model.fitted
+    assert not scaler.prefill_model.fitted  # no TTFB samples given
+    # occupancy/qps/itl ~ (2*8avg)/10 * 1000 / ~30ms ~= 53 tokens.
+    assert 10.0 < scaler.snapshot()['tokens_per_request'] < 200.0
+
+
+def test_warm_resume_stays_role_matched():
+    """A parked prefill replica resumes into the prefill fleet only —
+    plan_mix is fed role-filtered rows, so a decode scale-up can never
+    grab a warm prefill cluster (whose engine would refuse decode)."""
+    scaler = DisaggSLOAutoscaler(_spec())
+    clock = _sim_clock(scaler)
+    clock['t'] = 100.0
+    replicas = [_R(1, status=ReplicaStatus.WARM, role='prefill',
+                   warm_since=90.0),
+                _R(2, role='decode')]
+    decisions = scaler.evaluate(LoadStats(qps=5.0), replicas)
+    resumes = [d for d in decisions if d.resume_replica_id is not None]
+    assert [d.role for d in resumes] == ['prefill']
+    assert resumes[0].resume_replica_id == 1
+    cold = [d for d in decisions if d.op == DecisionOp.SCALE_UP
+            and d.resume_replica_id is None]
+    assert cold == []  # decode fleet already has its replica
+
+
+def test_unattainable_intertoken_slo_reported():
+    scaler = DisaggSLOAutoscaler(_spec(target_intertoken_p99_ms=5.0))
+    clock = _sim_clock(scaler)
+    _prime(scaler.prefill_model, base=50.0, slope=10.0)
+    _prime(scaler.decode_model, base=10.0, slope=1.0)  # base > 5ms SLO
+    replicas = [_R(1, role='prefill'), _R(2, role='decode')]
+    for _ in range(5):
+        clock['t'] += 10
+        scaler.evaluate(LoadStats(qps=100.0), replicas)
+    snap = scaler.snapshot()
+    assert snap['ttft_attainable']
+    assert not snap['intertoken_attainable']
+    assert snap['decode_target'] >= 1  # held, not collapsed

@@ -1,0 +1,199 @@
+"""Disaggregated prefill/decode engine roles (ISSUE r18 tentpole).
+
+Correctness bar: a stream decoded from MIGRATED KV blocks is
+token-for-token identical to the colocated engine — greedy and
+temperature>0 — because the export carries the last-logits row and the
+decode side re-seeds fold-in-position sampling from the request seed.
+Failure bar: any import problem (evicted delta block, tampered
+manifest, corrupt payload) falls back to a local re-prefill that still
+completes the request, with the pool and prefix cache refcount-exact.
+"""
+import copy
+
+import pytest
+
+from skypilot_tpu.inference import kv_migrate
+from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+
+# 18 tokens @ block_size 16 -> one full (shareable) block + partial tail
+PROMPT = [5, 9, 42, 7, 11, 3, 2, 8, 19, 21, 4, 6, 13, 17, 23, 29, 31, 1]
+
+
+@pytest.fixture(scope='module')
+def fleets():
+    """One prefill-role, one decode-role, one colocated reference."""
+    pre = ContinuousBatchingEngine('tiny', max_slots=2, max_len=96,
+                                   role='prefill')
+    dec = ContinuousBatchingEngine('tiny', max_slots=2, max_len=96,
+                                   role='decode')
+    colo = ContinuousBatchingEngine('tiny', max_slots=2, max_len=96)
+    yield pre, dec, colo
+    pre.shutdown()
+    dec.shutdown()
+    colo.shutdown()
+
+
+def _migrate(pre, dec, ids, *, seed=0, temperature=0.0,
+             max_new_tokens=8, mutate=None, tamper=None):
+    """Drive the full path: prefill+export -> delta pull -> decode."""
+    rid = pre.prefill_and_export(ids, seed=seed, temperature=temperature)
+    puller = kv_migrate.KvPuller(
+        kv_migrate.LocalKvSource(pre.exporter, mutate=mutate),
+        sleep=lambda _s: None)
+    pulled = puller.pull(rid, resident_digests=dec.probe_resident(ids))
+    if tamper is not None:
+        tamper(pulled)
+    request = dec.submit_migrated(ids, pulled, seed=seed,
+                                  temperature=temperature,
+                                  max_new_tokens=max_new_tokens)
+    tokens = list(dec.tail_tokens(request))
+    return tokens, pulled, rid
+
+
+def test_migrated_stream_matches_colocated_greedy(fleets):
+    pre, dec, colo = fleets
+    tokens, _pulled, _rid = _migrate(pre, dec, PROMPT, seed=0)
+    assert tokens == colo.generate_ids(PROMPT, max_new_tokens=8, seed=0)
+    assert dec.stats()['kv_import_fallbacks'] == 0
+    assert pre.stats()['kv_exports'] >= 1
+    # The prefill fleet never decoded a token.
+    assert pre.stats()['tokens_generated'] == 0
+
+
+def test_migrated_stream_matches_colocated_temperature(fleets):
+    pre, dec, colo = fleets
+    tokens, _pulled, _rid = _migrate(pre, dec, PROMPT, seed=7,
+                                     temperature=0.9)
+    assert tokens == colo.generate_ids(PROMPT, max_new_tokens=8,
+                                       temperature=0.9, seed=7)
+
+
+def test_shared_prefix_moves_only_non_resident_blocks(fleets):
+    """Second migration of a prompt sharing the full-block prefix moves
+    ZERO full blocks — the decode side's PrefixCache already holds them
+    and the delta manifest says so (the ISSUE acceptance assert)."""
+    pre, dec, colo = fleets
+    _tokens, first, _rid = _migrate(pre, dec, PROMPT, seed=0)
+    assert first.moved + first.resident == len(PROMPT) // dec.block_size
+    tokens, second, _rid = _migrate(pre, dec, PROMPT, seed=3)
+    assert second.moved == 0
+    assert second.resident == len(PROMPT) // dec.block_size
+    assert tokens == colo.generate_ids(PROMPT, max_new_tokens=8, seed=3)
+
+
+def test_prefill_death_post_handoff_still_completes(fleets):
+    """Once the pull lands, the decode side holds everything locally:
+    dropping the export (the prefill replica dying) changes nothing."""
+    pre, dec, colo = fleets
+    prompt = [p + 200 for p in PROMPT]
+    rid = pre.prefill_and_export(prompt, seed=1)
+    puller = kv_migrate.KvPuller(kv_migrate.LocalKvSource(pre.exporter),
+                                 sleep=lambda _s: None)
+    pulled = puller.pull(rid,
+                         resident_digests=dec.probe_resident(prompt))
+    pre.exporter.pop(rid)  # the prefill replica is gone
+    request = dec.submit_migrated(prompt, pulled, seed=1,
+                                  max_new_tokens=8)
+    tokens = list(dec.tail_tokens(request))
+    assert tokens == colo.generate_ids(prompt, max_new_tokens=8, seed=1)
+    assert dec.stats()['kv_import_fallbacks'] == 0
+
+
+def test_decode_death_mid_migration_pull_raises_for_reroute():
+    """A decode replica dying mid-pull surfaces as MigrationUnavailable
+    /BlockCorrupt to the CALLER (the LB re-routes or re-prefills) —
+    never as a half-imported slot."""
+    exporter = kv_migrate.KvExporter()  # empty: peer is gone
+    puller = kv_migrate.KvPuller(kv_migrate.LocalKvSource(exporter),
+                                 retries=1, sleep=lambda _s: None)
+    with pytest.raises(kv_migrate.MigrationUnavailable):
+        puller.pull('dead')
+
+
+def _quiesce_free_blocks(engine):
+    """Pool free count once the prefix cache releases every entry it
+    alone holds (the engine is idle; reclaimable == all of them)."""
+    while engine._prefix.evict_reclaimable():
+        pass
+    return engine._pool.free_blocks
+
+
+def test_bad_import_falls_back_to_reprefill_zero_leaks(fleets):
+    """Evicted-delta-block race (payload None for a non-resident
+    block): the import aborts refcount-exactly and the request
+    completes via local re-prefill with the SAME tokens."""
+    pre, dec, colo = fleets
+    prompt = [p + 400 for p in PROMPT]
+    fallbacks0 = dec.stats()['kv_import_fallbacks']
+
+    def drop_block(pulled):
+        assert pulled.moved >= 1
+        pulled.payloads[0] = None  # claims resident; cache disagrees
+
+    tokens, _pulled, _rid = _migrate(pre, dec, prompt, seed=2,
+                                     tamper=drop_block)
+    assert tokens == colo.generate_ids(prompt, max_new_tokens=8, seed=2)
+    assert dec.stats()['kv_import_fallbacks'] == fallbacks0 + 1
+    # Zero refcount leaks: with the engine idle, evicting every
+    # reclaimable prefix entry returns the WHOLE pool to the free list.
+    assert _quiesce_free_blocks(dec) == dec._pool.total_blocks
+
+
+def test_tampered_manifest_falls_back_to_reprefill(fleets):
+    pre, dec, colo = fleets
+    prompt = [p + 600 for p in PROMPT]
+    fallbacks0 = dec.stats()['kv_import_fallbacks']
+
+    def tamper(pulled):
+        pulled.manifest = copy.deepcopy(pulled.manifest)
+        pulled.manifest['n_tokens'] += 1
+
+    tokens, _pulled, _rid = _migrate(pre, dec, prompt, seed=4,
+                                     tamper=tamper)
+    assert tokens == colo.generate_ids(prompt, max_new_tokens=8, seed=4)
+    assert dec.stats()['kv_import_fallbacks'] == fallbacks0 + 1
+    assert _quiesce_free_blocks(dec) == dec._pool.total_blocks
+
+
+def test_handoff_metric_observed_on_import(fleets):
+    import time
+    from skypilot_tpu.server import metrics
+    pre, dec, colo = fleets
+    prompt = [p + 800 for p in PROMPT]
+    metrics.reset_for_tests()
+    rid = pre.prefill_and_export(prompt, seed=5)
+    handoff_start = time.monotonic()
+    puller = kv_migrate.KvPuller(kv_migrate.LocalKvSource(pre.exporter),
+                                 sleep=lambda _s: None)
+    pulled = puller.pull(rid,
+                         resident_digests=dec.probe_resident(prompt))
+    request = dec.submit_migrated(prompt, pulled, seed=5,
+                                  max_new_tokens=4,
+                                  handoff_start=handoff_start)
+    list(dec.tail_tokens(request))
+    assert metrics.DISAGG_HANDOFF._totals.get((), 0) == 1
+
+
+def test_role_validation(fleets):
+    pre, dec, _colo = fleets
+    with pytest.raises(ValueError, match='SKYT_DISAGG_ROLE'):
+        ContinuousBatchingEngine('tiny', max_slots=1, max_len=32,
+                                 role='both')
+    with pytest.raises(RuntimeError, match='prefill'):
+        dec.prefill_and_export(PROMPT)
+    with pytest.raises(RuntimeError, match='never decodes'):
+        pre.submit_migrated(PROMPT, None)
+    with pytest.raises(RuntimeError, match='never decodes'):
+        pre.generate_ids(PROMPT, max_new_tokens=2)
+
+
+def test_prefill_role_slot_releases_immediately(fleets):
+    """The export holds HOST copies: after prefill_and_export returns,
+    the prefill pool is fully free again (modulo prefix cache entries,
+    which are reclaimable) — the slot turns over at prefill rate."""
+    pre, _dec, _colo = fleets
+    prompt = [p + 1000 for p in PROMPT]
+    rid = pre.prefill_and_export(prompt, seed=6)
+    assert pre.stats()['active'] == 0
+    assert _quiesce_free_blocks(pre) == pre._pool.total_blocks
+    assert pre.exporter.pop(rid) is not None

@@ -1,0 +1,245 @@
+"""Disaggregated serving through the serve data plane: the LB two-hop
+route (prefill fleet -> KV migration -> decode fleet), role-aware
+selection, prefix affinity, and the streamed-failure breaker fix
+(satellite: a stream dying AFTER the first byte must feed the
+replica's outlier-ejection breaker)."""
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                              start_load_balancer)
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.server import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_for_tests()
+    yield
+    metrics.reset_for_tests()
+
+
+# -- the breaker regression: mid-stream death must eject --------------------
+
+
+class _TruncatingStream(BaseHTTPRequestHandler):
+    """Sends a healthy 200 head + first chunk, then kills the socket —
+    the pathological replica whose failures all happen AFTER TTFB."""
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/event-stream')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+        frame = b'data: first\n\n'
+        self.wfile.write(f'{len(frame):x}\r\n'.encode() + frame + b'\r\n')
+        self.wfile.flush()
+        # Die mid-stream: no terminating chunk, hard close.
+        self.connection.shutdown(socket.SHUT_RDWR)
+        self.close_connection = True
+
+
+def test_midstream_stream_death_feeds_the_breaker():
+    """Every request gets a good head (which updates the EWMA) and a
+    dead body: consecutive failures must still accumulate and eject
+    the replica. Before the record_success split, the head's
+    observe_latency cleared the breaker each attempt, so a replica
+    that reliably truncated streams was never ejected."""
+    replica = ThreadingHTTPServer(('127.0.0.1', 0), _TruncatingStream)
+    threading.Thread(target=replica.serve_forever, daemon=True).start()
+    lb = LoadBalancer(LoadBalancingPolicy.make('round_robin'))
+    port = replica.server_address[1]
+    lb.sync_replicas([(1, f'http://127.0.0.1:{port}', 1.0)])
+    server = start_load_balancer(lb, '127.0.0.1', 0)
+    try:
+        for _ in range(3):  # SKYT_LB_EJECT_THRESHOLD default
+            try:
+                with urllib.request.urlopen(
+                        f'http://127.0.0.1:{server.port}/stream',
+                        timeout=10) as resp:
+                    resp.read()
+            except (urllib.error.URLError, ConnectionError,
+                    http.client.IncompleteRead):
+                pass
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline and not lb.ejected_snapshot():
+            time.sleep(0.01)
+        assert 1 in lb.ejected_snapshot()
+        # The EWMA still learned from the heads it did see.
+        assert lb.ewma_snapshot().get(1, 0.0) > 0.0
+    finally:
+        server.shutdown()
+        replica.shutdown()
+
+
+def test_observe_latency_no_longer_clears_the_breaker():
+    lb = LoadBalancer(LoadBalancingPolicy.make('round_robin'))
+    lb.sync_replicas([(1, 'http://a', 1.0)])
+    lb.record_failure(1)
+    lb.record_failure(1)
+    lb.observe_latency(1, 0.01)   # head arrived... stream later died
+    lb.record_failure(1)          # third consecutive failure
+    assert 1 in lb.ejected_snapshot()
+    lb.record_success(1)          # a FULL stream delivered clears it
+    assert 1 not in lb.ejected_snapshot()
+
+
+# -- role-aware selection + prefix affinity ---------------------------------
+
+
+def _role_lb(policy='round_robin'):
+    lb = LoadBalancer(LoadBalancingPolicy.make(policy))
+    lb.sync_replicas(
+        [(1, 'http://p1', 1.0), (2, 'http://p2', 1.0),
+         (3, 'http://d1', 1.0), (4, 'http://d2', 1.0)],
+        roles={1: 'prefill', 2: 'prefill', 3: 'decode', 4: 'decode'})
+    return lb
+
+
+def test_select_filters_by_role():
+    lb = _role_lb()
+    assert lb.two_hop_ready()
+    for _ in range(8):
+        assert lb.select(role='prefill')[0] in (1, 2)
+        assert lb.select(role='decode')[0] in (3, 4)
+
+
+def test_two_hop_not_ready_without_both_fleets():
+    lb = LoadBalancer(LoadBalancingPolicy.make('round_robin'))
+    lb.sync_replicas([(1, 'http://p1', 1.0), (2, 'http://d1', 1.0)],
+                     roles={2: 'decode'})
+    assert not lb.two_hop_ready()
+
+
+def test_affinity_key_is_sticky_until_overloaded():
+    lb = _role_lb(policy='least_load')
+    key = hash(b'{"prompt": "shared system prefix...')
+    picks = {lb.select(role='decode', affinity_key=key)[0]
+             for _ in range(8)}
+    assert len(picks) == 1          # same key -> same decode replica
+    sticky = picks.pop()
+    # Load the sticky replica: affinity yields to the load policy.
+    for _ in range(6):
+        lb.begin(sticky)
+    spread = lb.select(role='decode', affinity_key=key)[0]
+    assert spread != sticky
+    # A failed attempt excludes it, so failover still works.
+    other = lb.select(exclude={sticky}, role='decode',
+                      affinity_key=key)[0]
+    assert other != sticky
+
+
+# -- the two-hop route, end to end ------------------------------------------
+
+
+@pytest.fixture(scope='module')
+def disagg_stack():
+    """Real prefill-role and decode-role engines behind real inference
+    servers, fronted by the real LB."""
+    from skypilot_tpu.inference import server as srv_mod
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    engines = {
+        'prefill': ContinuousBatchingEngine('tiny', max_slots=2,
+                                            max_len=96, role='prefill'),
+        'decode': ContinuousBatchingEngine('tiny', max_slots=2,
+                                           max_len=96, role='decode'),
+    }
+    servers = {}
+    for role, engine in engines.items():
+        server = srv_mod.serve(engine, '127.0.0.1', 0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        servers[role] = server
+    lb = LoadBalancer(LoadBalancingPolicy.make('p2c_ewma'))
+    urls = {role: f'http://127.0.0.1:{s.server_address[1]}'
+            for role, s in servers.items()}
+    lb_server = start_load_balancer(lb, '127.0.0.1', 0)
+    yield engines, urls, lb, lb_server
+    lb_server.shutdown()
+    for server in servers.values():
+        server.shutdown()
+    for engine in engines.values():
+        engine.shutdown()
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{port}{path}',
+        data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+PROMPT = 'a shared system preamble that spans multiple KV blocks: rules'
+BODY = {'prompts': [PROMPT], 'max_new_tokens': 6, 'seed': 0}
+
+
+def test_two_hop_generate_matches_single_hop(disagg_stack):
+    engines, urls, lb, lb_server = disagg_stack
+    # Single-hop baseline: only the decode replica, no roles — it
+    # prefills locally like any colocated engine.
+    lb.sync_replicas([(2, urls['decode'], 1.0)])
+    baseline = _post(lb_server.port, '/generate', BODY)['outputs']
+    exports0 = engines['prefill'].stats()['kv_exports']
+    imports0 = engines['decode'].stats()['kv_imports']
+    # Two-hop: prefill fleet absorbs the prompt, decode fleet pulls
+    # the KV and streams — same tokens, no local prefill of the bulk.
+    lb.sync_replicas([(1, urls['prefill'], 1.0),
+                      (2, urls['decode'], 1.0)],
+                     roles={1: 'prefill', 2: 'decode'})
+    two_hop = _post(lb_server.port, '/generate', BODY)['outputs']
+    assert two_hop == baseline
+    assert engines['prefill'].stats()['kv_exports'] == exports0 + 1
+    assert engines['decode'].stats()['kv_imports'] == imports0 + 1
+    assert engines['decode'].stats()['kv_import_fallbacks'] == 0
+    # The handoff latency was observed (decode-side import).
+    assert metrics.DISAGG_HANDOFF._totals.get((), 0) >= 1
+    # The consumed export was released on the prefill side.
+    assert engines['prefill'].stats()['kv_exports_pending'] == 0
+
+
+def test_two_hop_openai_stream_first_tokens_after_handoff(disagg_stack):
+    engines, urls, lb, lb_server = disagg_stack
+    lb.sync_replicas([(1, urls['prefill'], 1.0),
+                      (2, urls['decode'], 1.0)],
+                     roles={1: 'prefill', 2: 'decode'})
+    imports0 = engines['decode'].stats()['kv_imports']
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{lb_server.port}/v1/completions',
+        data=json.dumps({'prompt': PROMPT, 'max_tokens': 4,
+                         'stream': True}).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        frames = [ln for ln in resp.read().split(b'\n') if ln]
+    assert frames[-1] == b'data: [DONE]'
+    assert engines['decode'].stats()['kv_imports'] == imports0 + 1
+
+
+def test_two_hop_survives_prefill_fleet_death(disagg_stack):
+    """Hop 1 pointing at a dead endpoint degrades to single-hop: the
+    decode replica re-prefills locally and the request completes."""
+    engines, urls, lb, lb_server = disagg_stack
+    dead = socket.socket()
+    dead.bind(('127.0.0.1', 0))  # bound but never accepting
+    try:
+        lb.sync_replicas(
+            [(1, f'http://127.0.0.1:{dead.getsockname()[1]}', 1.0),
+             (2, urls['decode'], 1.0)],
+            roles={1: 'prefill', 2: 'decode'})
+        out = _post(lb_server.port, '/generate', BODY)['outputs']
+        assert len(out) == 1 and isinstance(out[0], str)
+    finally:
+        dead.close()

@@ -1,0 +1,224 @@
+"""KV-block migration data plane (inference/kv_migrate.py): delta
+manifests, digest verification, ranged resume, backpressure, and the
+chaos sites `infer.kv_migrate.push` / `infer.kv_migrate.pull`."""
+import hashlib
+
+import pytest
+
+from skypilot_tpu.inference import kv_migrate
+from skypilot_tpu.inference.paged import chain_digests
+from skypilot_tpu.server import metrics
+
+from tests.fault_injection import clause, inject_faults
+
+BS = 4
+
+
+def _counter_value(counter, **labels):
+    key = tuple(sorted(labels.items()))
+    return counter._values.get(key, 0.0)
+
+
+def _export(request_id='req-1', n_blocks=3, tail=b'tail-state'):
+    ids = list(range(100, 100 + n_blocks * BS + 2))  # +2 partial tail
+    digests = chain_digests(ids, BS)
+    blocks = [bytes([7 + i]) * 64 for i in range(n_blocks)]
+    return kv_migrate.KvExport(
+        request_id=request_id, ids=ids, block_size=BS,
+        digests=digests, blocks=blocks, tail=tail,
+        meta={'seed': 42, 'generated': 0})
+
+
+def _no_sleep(_seconds):
+    pass
+
+
+# -- export + manifest -------------------------------------------------
+
+
+def test_manifest_carries_digests_and_shas_not_payloads():
+    export = _export()
+    manifest = export.manifest()
+    assert manifest['request_id'] == 'req-1'
+    assert manifest['block_size'] == BS
+    assert manifest['n_tokens'] == len(export.ids)
+    assert [r['digest'] for r in manifest['blocks']] == export.digests
+    for row, payload in zip(manifest['blocks'], export.blocks):
+        assert row['sha256'] == hashlib.sha256(payload).hexdigest()
+        assert row['nbytes'] == len(payload)
+        assert 'data' not in row
+    assert manifest['tail']['nbytes'] == len(export.tail)
+    assert manifest['meta']['seed'] == 42
+
+
+def test_export_rejects_misaligned_digests():
+    with pytest.raises(ValueError, match='digests'):
+        kv_migrate.KvExport(
+            request_id='r', ids=[1] * 8, block_size=4,
+            digests=[1, 2, 3], blocks=[b'x'], tail=b'', meta={})
+
+
+def test_exporter_put_get_pop_idempotent():
+    exporter = kv_migrate.KvExporter()
+    export = _export()
+    exporter.put(export)
+    assert exporter.request_ids() == ['req-1']
+    assert exporter.get('req-1') is export
+    assert exporter.pop('req-1') is export
+    assert exporter.pop('req-1') is None  # idempotent
+    with pytest.raises(KeyError):
+        exporter.get('req-1')
+
+
+# -- delta pull --------------------------------------------------------
+
+
+def test_pull_moves_only_non_resident_blocks():
+    metrics.reset_for_tests()
+    exporter = kv_migrate.KvExporter()
+    export = _export(n_blocks=4)
+    exporter.put(export)
+    puller = kv_migrate.KvPuller(
+        kv_migrate.LocalKvSource(exporter), sleep=_no_sleep)
+    # Decode side already holds the first two chain blocks.
+    resident = export.digests[:2]
+    pulled = puller.pull('req-1', resident_digests=resident)
+    assert pulled.resident == 2
+    assert pulled.moved == 2
+    assert pulled.payloads[:2] == [None, None]
+    assert pulled.payloads[2:] == export.blocks[2:]
+    assert pulled.tail == export.tail
+    assert _counter_value(metrics.KV_MIGRATE_BLOCKS,
+                          outcome='resident') == 2
+    assert _counter_value(metrics.KV_MIGRATE_BLOCKS,
+                          outcome='moved') == 2
+    # Only the moved payloads + tail crossed the wire.
+    moved_bytes = sum(len(b) for b in export.blocks[2:]) + \
+        len(export.tail)
+    assert _counter_value(metrics.KV_MIGRATE_BYTES,
+                          direction='pull') == moved_bytes
+
+
+def test_corrupt_block_repulled_never_returned():
+    metrics.reset_for_tests()
+    exporter = kv_migrate.KvExporter()
+    export = _export(n_blocks=1)
+    exporter.put(export)
+    flips = {'left': 1}
+
+    def mutate(kind, key, data):
+        if kind == 'block' and flips['left'] > 0:
+            flips['left'] -= 1
+            return b'\x00' + data[1:]
+        return data
+
+    puller = kv_migrate.KvPuller(
+        kv_migrate.LocalKvSource(exporter, mutate=mutate),
+        sleep=_no_sleep)
+    pulled = puller.pull('req-1')
+    assert pulled.payloads[0] == export.blocks[0]  # clean bytes won
+    assert puller.corrupt_retries == 1
+    assert _counter_value(metrics.KV_MIGRATE_BLOCKS,
+                          outcome='corrupt_retry') == 1
+
+
+def test_permanently_corrupt_block_raises_block_corrupt():
+    exporter = kv_migrate.KvExporter()
+    exporter.put(_export(n_blocks=1))
+    puller = kv_migrate.KvPuller(
+        kv_migrate.LocalKvSource(
+            exporter, mutate=lambda k, key, d: b'\xff' * len(d)),
+        retries=2, sleep=_no_sleep)
+    with pytest.raises(kv_migrate.BlockCorrupt):
+        puller.pull('req-1')
+
+
+def test_dead_source_exhausts_retries():
+    exporter = kv_migrate.KvExporter()  # empty: every lookup fails
+    puller = kv_migrate.KvPuller(
+        kv_migrate.LocalKvSource(exporter), retries=2, sleep=_no_sleep)
+    with pytest.raises(kv_migrate.MigrationUnavailable):
+        puller.pull('gone')
+    assert puller.unavailable_retries == 3  # budget fully spent
+
+
+# -- chaos sites -------------------------------------------------------
+
+
+def test_pull_chaos_fault_is_retried_to_success():
+    exporter = kv_migrate.KvExporter()
+    export = _export()
+    exporter.put(export)
+    puller = kv_migrate.KvPuller(
+        kv_migrate.LocalKvSource(exporter), sleep=_no_sleep)
+    with inject_faults(clause('infer.kv_migrate.pull',
+                              'ConnectionError', times=2)):
+        pulled = puller.pull('req-1')
+    assert pulled.moved == len(export.blocks)
+    assert puller.unavailable_retries >= 1
+
+
+def test_push_chaos_fault_sheds_with_retry_after():
+    exporter = kv_migrate.KvExporter()
+    exporter.put(_export())
+    with inject_faults(clause('infer.kv_migrate.push', 'OSError',
+                              times=1)):
+        status, headers, _body = kv_migrate.handle_kv_get(
+            '/kv/manifest/req-1', exporter)
+        assert status == 503
+        assert 'Retry-After' in headers
+        # Next attempt (fault budget spent) serves normally.
+        status, _headers, body = kv_migrate.handle_kv_get(
+            '/kv/manifest/req-1', exporter)
+    assert status == 200
+    assert b'req-1' in body
+
+
+# -- the HTTP surface --------------------------------------------------
+
+
+def test_http_pull_end_to_end_with_shed_and_release():
+    exporter = kv_migrate.KvExporter()
+    export = _export(n_blocks=3)
+    exporter.put(export)
+    with kv_migrate.KvServer(exporter) as server:
+        source = kv_migrate.HTTPKvSource(server.endpoint, timeout=10)
+        puller = kv_migrate.KvPuller(source, sleep=_no_sleep)
+        with inject_faults(clause('infer.kv_migrate.push', 'OSError',
+                                  times=1)):
+            # The 503+Retry-After shed surfaces as a retryable
+            # MigrationUnavailable carrying the floor.
+            pulled = puller.pull(
+                'req-1', resident_digests=export.digests[:1])
+        assert pulled.resident == 1
+        assert pulled.payloads[1:] == export.blocks[1:]
+        assert pulled.tail == export.tail
+        assert puller.unavailable_retries >= 1
+        source.release('req-1')
+    assert len(exporter) == 0
+
+
+def test_http_ranged_block_resume():
+    exporter = kv_migrate.KvExporter()
+    export = _export(n_blocks=1)
+    exporter.put(export)
+    with kv_migrate.KvServer(exporter) as server:
+        source = kv_migrate.HTTPKvSource(server.endpoint, timeout=10)
+        digest = export.digests[0]
+        whole = b''.join(source.fetch_block('req-1', digest, 0))
+        part = b''.join(source.fetch_block('req-1', digest, 10))
+        assert whole == export.blocks[0]
+        assert part == export.blocks[0][10:]
+
+
+def test_handle_kv_get_unknown_paths():
+    exporter = kv_migrate.KvExporter()
+    exporter.put(_export())
+    assert kv_migrate.handle_kv_get('/kv/manifest/nope',
+                                    exporter)[0] == 404
+    assert kv_migrate.handle_kv_get('/kv/block/req-1/123456',
+                                    exporter)[0] == 404
+    assert kv_migrate.handle_kv_get('/other', exporter)[0] == 404
+    status, _h, _b = kv_migrate.handle_kv_release('/kv/release/nope',
+                                                  exporter)
+    assert status == 200  # idempotent release

@@ -17,7 +17,8 @@ import numpy as np
 import pytest
 
 from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
-from skypilot_tpu.inference.paged import BlockPool, PrefixCache
+from skypilot_tpu.inference.paged import (BlockImporter, BlockPool,
+                                          PrefixCache, chain_digests)
 from skypilot_tpu.models import decode as decode_lib
 
 
@@ -95,6 +96,115 @@ def test_prefix_pressure_eviction_skips_blocks_shared_with_slots():
     # evictable under pressure, chain survives.
     assert not cache.evict_reclaimable()
     assert cache.cached_blocks == 1
+
+
+# ---------------------------------------------------------------------------
+# KV-migration import bookkeeping (disaggregated serving, ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _pool_snapshot(pool):
+    return ([pool.refcount(b) for b in range(pool.num_blocks)],
+            pool.free_blocks)
+
+
+def test_chain_digests_match_prefix_cache_keying():
+    """The exported chain digests ARE the prefix-cache keys: a block
+    whose digest appears in the decode-side cache is resident and must
+    never move."""
+    pool = BlockPool(9)
+    cache = PrefixCache(pool, block_size=4)
+    ids = list(range(12))
+    blocks = [pool.alloc() for _ in range(3)]
+    cache.insert(ids, blocks)
+    digests = chain_digests(ids, 4)
+    assert len(digests) == 3
+    # Same rolling keying: a lookup over the same ids walks exactly
+    # the digest chain (all 3 full blocks are cached).
+    hit = cache.lookup(ids, limit_tokens=12)
+    assert hit == blocks
+    for b in hit:
+        pool.decref(b)
+    # Divergence re-keys every later block in the chain.
+    other = list(range(12))
+    other[5] = 99
+    diverged = chain_digests(other, 4)
+    assert diverged[0] == digests[0]
+    assert diverged[1] != digests[1] and diverged[2] != digests[2]
+
+
+def test_block_importer_aborted_import_is_exactly_pre_import_state():
+    """The r13 rollback-parity property, for migration: an import that
+    dies mid-flight leaves refcounts AND prefix-cache entries exactly
+    where they were before the import began."""
+    pool = BlockPool(10)
+    cache = PrefixCache(pool, block_size=4)
+    shared_ids = list(range(8))                  # 2 full shared blocks
+    shared = [pool.alloc(), pool.alloc()]
+    cache.insert(shared_ids, shared)
+    ids = shared_ids + [50, 51, 52, 53, 54]      # + 2 more blocks (9 tok)
+    before_refs, before_free = _pool_snapshot(pool)
+    before_entries = cache.cached_blocks
+
+    importer = BlockImporter(pool, cache)
+    got = importer.begin(ids, needed_total=3, block_size=4)
+    assert got is not None
+    blocks, n_resident = got
+    assert n_resident == 2 and blocks[:2] == shared
+    assert len(blocks) == 3
+    # Mid-import state really moved: shared blocks gained a ref, a
+    # private block got allocated.
+    assert pool.refcount(shared[0]) == before_refs[shared[0]] + 1
+    assert pool.free_blocks == before_free - 1
+
+    importer.abort()                             # migration died
+    assert _pool_snapshot(pool) == (before_refs, before_free)
+    assert cache.cached_blocks == before_entries
+    # abort is idempotent — a second call must not double-free.
+    importer.abort()
+    assert _pool_snapshot(pool) == (before_refs, before_free)
+
+
+def test_block_importer_pool_exhaustion_retains_nothing():
+    pool = BlockPool(4)                          # 3 allocatable
+    cache = PrefixCache(pool, block_size=4)
+    shared = [pool.alloc()]
+    cache.insert(list(range(4)), shared)
+    before = _pool_snapshot(pool)
+    importer = BlockImporter(pool, cache)
+    # Needs 4 blocks (1 resident + 3 private) but only 2 are free.
+    got = importer.begin(list(range(16)), needed_total=4, block_size=4)
+    assert got is None
+    assert not importer.active
+    assert _pool_snapshot(pool) == before
+
+
+def test_block_importer_commit_transfers_ownership():
+    pool = BlockPool(6)
+    importer = BlockImporter(pool, None)         # no prefix cache
+    got = importer.begin([1, 2, 3, 4, 5, 6], needed_total=2,
+                         block_size=4)
+    assert got is not None
+    blocks, n_resident = got
+    assert n_resident == 0 and len(blocks) == 2
+    importer.commit()
+    # After commit the refs belong to the caller: abort is a no-op and
+    # the caller's decref is the one that frees.
+    importer.abort()
+    assert all(pool.refcount(b) == 1 for b in blocks)
+    for b in blocks:
+        pool.decref(b)
+    assert pool.free_blocks == pool.total_blocks
+
+
+def test_block_importer_rejects_overlapping_imports():
+    pool = BlockPool(6)
+    importer = BlockImporter(pool, None)
+    assert importer.begin([1, 2, 3, 4], needed_total=1,
+                          block_size=4) is not None
+    with pytest.raises(RuntimeError, match='open import'):
+        importer.begin([5, 6, 7, 8], needed_total=1, block_size=4)
+    importer.abort()
+    assert pool.free_blocks == pool.total_blocks
 
 
 # ---------------------------------------------------------------------------

@@ -581,6 +581,14 @@ def test_ejection_and_timed_reprobe_recovers_flapping_replica(monkeypatch):
                     f'http://127.0.0.1:{server.port}/y', timeout=10) as r:
                 assert r.status == 200
             assert lb.ejected_snapshot() == {}
+            # The breaker clears when the FULL stream is delivered (a
+            # truncating replica must not reset itself at the head), so
+            # the clear lands just after the client sees the response
+            # head — poll briefly instead of assuming head-time order.
+            deadline = time.time() + 2
+            while (lb.lb_state()[1]['consecutive_failures'] and
+                   time.time() < deadline):
+                time.sleep(0.01)
             assert lb.lb_state()[1]['consecutive_failures'] == 0
     finally:
         server.shutdown()

@@ -205,6 +205,7 @@ _LIBRARY_SCALE = {
     'hot_tenant_flood': 0.05,
     'weight_rollout_surge': 0.05,
     'cold_start_convoy': 0.05,
+    'disagg_saturation': 0.05,
 }
 
 
@@ -219,6 +220,33 @@ def test_library_scenario_invariants(name):
     report = run_scenario(scenario.scale(_LIBRARY_SCALE[name]))
     failed = report.failed_invariants(scenario.invariants)
     assert not failed, f'{name}: {failed}'
+
+
+def test_disagg_decode_saturation_grows_only_decode_fleet():
+    """The tokens_shift drill doubles generation lengths with NO qps
+    change — a signal only the disagg scaler's tokens-per-request
+    estimator can see. The decode fleet must grow through the window
+    while prefill sizing keeps tracking qps alone, and the whole run
+    must replay bit-identically (KV-migration order is deterministic)."""
+    scenario = scenario_lib.load_library('disagg_saturation')
+    scaled = scenario.scale(0.05)
+    report = run_scenario(scaled)
+    shift = scenario.fleet['disagg']['tokens_shift']
+    start, end = shift['at'], shift['at'] + shift['duration_s']
+
+    def window(name, lo, hi):
+        return [v for t, v in report.metrics[name] if lo <= t < hi]
+
+    dec_before = max(window('sim_decode_ready', start - 1800, start))
+    dec_during = max(window('sim_decode_ready', start, end + 1800))
+    assert dec_during >= dec_before * 1.4, (dec_before, dec_during)
+    pre_before = max(window('sim_prefill_ready', start - 1800, start))
+    pre_during = max(window('sim_prefill_ready', start, end + 1800))
+    assert pre_during <= pre_before + 2, (pre_before, pre_during)
+    # TTFT stays bounded straight through decode saturation: the
+    # prefill fleet and its queue never see the shift.
+    assert report.summary['ttft_p99_s'] <= 0.35
+    assert run_scenario(scaled).digest() == report.digest()
 
 
 def test_unknown_invariant_key_fails_loudly():
