@@ -159,6 +159,18 @@ timeout 900 env JAX_PLATFORMS=cpu python bench_inference.py \
   | tee "BENCH_inference_${suffix}.json"
 echo "rc=$? -> BENCH_inference_${suffix}.json" >&2
 
+# Multi-LoRA bench: CPU-only — one shared paged-adapter fleet vs a
+# dedicated-merged-fleet per adapter at 1/32/256 concurrent adapters
+# and equal simulated HBM (weight traffic charged over the fanout
+# bench's 16 MiB/s link; acceptance: >= 3x aggregate tokens/s at 256),
+# plus the base-traffic no-regression arm (< 5%) and the hot-adapter
+# DRR isolation arm (light-tenant inter-token p99 within 2x no-skew)
+# (docs/multi_lora_serving.md, numbers in PERF.md).
+echo "=== bench multi-lora ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 900 env JAX_PLATFORMS=cpu python bench_inference.py --multi-lora \
+  | tee "BENCH_lora_${suffix}.json"
+echo "rc=$? -> BENCH_lora_${suffix}.json" >&2
+
 # Elastic recovery bench: CPU-only — preemption-to-next-step downtime
 # for rigid relaunch vs elastic shrink on the fault-injected fake
 # provider (docs/elastic_training.md, numbers in PERF.md).

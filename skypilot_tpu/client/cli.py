@@ -412,6 +412,20 @@ def serve_status(service_name: Optional[str]) -> None:
                 f"{replica['status']:<22} {replica['endpoint'] or '-':<28}"
                 f"{'spot' if replica['is_spot'] else 'on-demand':<10}"
                 f"{domain:<28}{ewma_s}")
+        demand = row.get('adapter_demand') or {}
+        if demand:
+            # Multi-LoRA demand the controller persists each tick:
+            # which fine-tunes are hot and where their traffic sticks
+            # (docs/multi_lora_serving.md).
+            click.echo('  adapters:')
+            by_qps = sorted(demand.items(),
+                            key=lambda kv: -(kv[1].get('qps') or 0))
+            for adapter, info in by_qps:
+                replica = info.get('replica')
+                click.echo(
+                    f"    {adapter:<32}"
+                    f"{info.get('qps', 0):>8.2f} req/s   "
+                    f"replica {replica if replica is not None else '-'}")
 
 
 @serve.command('logs')

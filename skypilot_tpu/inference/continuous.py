@@ -60,18 +60,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.inference import kv_migrate
-from skypilot_tpu.inference.paged import (BlockImporter, BlockPool,
-                                          PrefixCache, chain_digests)
+from skypilot_tpu.inference.paged import (AdapterPagePool, BlockImporter,
+                                          BlockPool, PrefixCache,
+                                          adapter_chain_root,
+                                          chain_digests)
 from skypilot_tpu.inference.tokenizer import get_tokenizer
 from skypilot_tpu.models import decode as decode_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.models.config import ModelConfig, get_model_config
-from skypilot_tpu.utils import log
+from skypilot_tpu.utils import fault_injection, log
 
 logger = log.init_logger(__name__)
 
 DEFAULT_BLOCK_SIZE = 16
 DEFAULT_PREFILL_CHUNK = 64
+
+# Chaos sites (utils/fault_injection): the two host-side edges of
+# adapter residency — pulling a cold adapter into a device page, and
+# LRU-evicting an idle one to make room.
+LORA_FETCH_SITE = 'infer.lora.fetch'
+LORA_EVICT_SITE = 'infer.lora.evict'
 
 
 # Module-level jitted steps with the (frozen, hashable) ModelConfig as
@@ -96,11 +105,16 @@ def _sample_tokens(logits, rngs, positions, temps):
 
 @functools.partial(jax.jit, static_argnames=('cfg',))
 def _decode_all_step(params, last_logits, cache, active, temps, rngs,
-                     *, cfg):
-    """One step for every slot: sample from last logits, advance."""
+                     lora_pages=None, adapter_ids=None, *, cfg):
+    """One step for every slot: sample from last logits, advance.
+
+    ``lora_pages``/``adapter_ids`` are None on a non-LoRA engine —
+    None is part of the pytree structure, so the disabled trace is
+    EXACTLY the pre-multi-LoRA program (bitwise-base guarantee)."""
     tokens = _sample_tokens(last_logits, rngs, cache.lengths, temps)
     logits, cache = decode_lib.paged_decode_step(
-        params, tokens, cache, cfg, active=active)
+        params, tokens, cache, cfg, active=active,
+        lora_pages=lora_pages, adapter_ids=adapter_ids)
     return tokens, logits, cache
 
 
@@ -115,7 +129,8 @@ def _sample_pending_step(logits_row, rng, length, temp):
 
 @functools.partial(jax.jit, static_argnames=('cfg', 'q_len'))
 def _spec_verify_all_step(params, cache, inputs, n_input, active, temps,
-                          rngs, *, cfg, q_len):
+                          rngs, lora_pages=None, adapter_ids=None,
+                          *, cfg, q_len):
     """One speculative verify step for every slot.
 
     ``inputs`` [B, Q]: the pending token then the draft proposals
@@ -133,7 +148,8 @@ def _spec_verify_all_step(params, cache, inputs, n_input, active, temps,
     """
     lengths0 = cache.lengths
     logits, cache = decode_lib.paged_verify_step(
-        params, inputs, cache, cfg, active=active, n_input=n_input)
+        params, inputs, cache, cfg, active=active, n_input=n_input,
+        lora_pages=lora_pages, adapter_ids=adapter_ids)
     targets = [
         _sample_tokens(logits[:, j], rngs, lengths0 + 1 + j, temps)
         for j in range(q_len)]
@@ -155,20 +171,24 @@ def _spec_verify_all_step(params, cache, inputs, n_input, active, temps,
 
 @functools.partial(jax.jit, static_argnames=('cfg',))
 def _prefill_chunk_step(params, tokens, start, n_new, slot, cache,
-                        *, cfg):
+                        lora_pages=None, adapter_id=None, *, cfg):
     return decode_lib.prefill_chunk(params, tokens, start, n_new,
-                                    slot, cache, cfg)
+                                    slot, cache, cfg,
+                                    lora_pages=lora_pages,
+                                    adapter_id=adapter_id)
 
 
 class _Request:
     def __init__(self, token_ids: List[int], max_new_tokens: int,
                  temperature: float, eos_id: Optional[int],
-                 seed: int, trace_ctx=None) -> None:
+                 seed: int, trace_ctx=None,
+                 adapter: Optional[str] = None) -> None:
         self.token_ids = token_ids
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.eos_id = eos_id
         self.seed = seed
+        self.adapter = adapter  # registered LoRA adapter name or None
         self.arrival = time.monotonic()
         self.arrival_wall = time.time()
         self.admitted = False  # queue-wait counted once, not per resume
@@ -188,6 +208,105 @@ class _Request:
         self.request_id = ''
         self.migration = None
         self.handoff_start: Optional[float] = None
+
+
+class _DrrQueue:
+    """Deficit-round-robin admission queue keyed by adapter.
+
+    Mirrors what ``serve/requests_db.claim_next`` does for the control
+    plane, one layer down: each adapter (base traffic = key ``''``)
+    owns a FIFO lane; lanes are served round-robin with a per-visit
+    ``quantum`` of deficit measured in KV BLOCKS (the resource a
+    prompt actually consumes), and a lane may admit while its deficit
+    covers the head request's block cost. One 100x-hot adapter
+    therefore gets one quantum per round like everyone else — it
+    queues behind itself, not in front of the other 999. With a single
+    lane (no adapters in play) the order degenerates to exact FIFO, so
+    a base-only engine schedules precisely as before.
+    """
+
+    def __init__(self, block_size: int, quantum_blocks: int) -> None:
+        import collections
+        self._block_size = max(1, block_size)
+        self._quantum = max(1, quantum_blocks)
+        self._queues: Dict[str, Any] = {}
+        self._rotation = collections.deque()  # lane visit order
+        self._deficit: Dict[str, int] = {}
+        self._total = 0
+        self._deque = collections.deque
+
+    def __len__(self) -> int:
+        return self._total
+
+    @staticmethod
+    def _key(request: '_Request') -> str:
+        return request.adapter or ''
+
+    def _cost(self, request: '_Request') -> int:
+        tokens = len(request.token_ids) + len(request.generated)
+        return max(1, -(-tokens // self._block_size))
+
+    def push(self, request: '_Request') -> None:
+        key = self._key(request)
+        lane = self._queues.get(key)
+        if lane is None:
+            lane = self._queues[key] = self._deque()
+            self._rotation.append(key)
+            self._deficit.setdefault(key, 0)
+        lane.append(request)
+        self._total += 1
+
+    def push_front(self, request: '_Request') -> None:
+        """Head-of-lane requeue (preemption / HBM-blocked retry): the
+        request resumes first in ITS lane, and its pop's deficit
+        charge is refunded so the retry isn't double-billed."""
+        key = self._key(request)
+        lane = self._queues.get(key)
+        if lane is None:
+            lane = self._queues[key] = self._deque()
+            self._rotation.appendleft(key)
+            self._deficit.setdefault(key, 0)
+        lane.appendleft(request)
+        self._deficit[key] = self._deficit.get(key, 0) + \
+            self._cost(request)
+        self._total += 1
+
+    def pop(self, blocked=None) -> Optional['_Request']:
+        """Next request by DRR order, or None when the queue is empty
+        or every lane's head is ``blocked`` (per-adapter quota)."""
+        while self._total:
+            progressed = False
+            for _ in range(len(self._rotation)):
+                key = self._rotation[0]
+                lane = self._queues.get(key)
+                if not lane:
+                    self._rotation.popleft()
+                    self._queues.pop(key, None)
+                    self._deficit.pop(key, None)
+                    continue
+                head = lane[0]
+                if blocked is not None and blocked(head):
+                    self._rotation.rotate(-1)
+                    continue
+                cost = self._cost(head)
+                if self._deficit.get(key, 0) >= cost:
+                    lane.popleft()
+                    self._deficit[key] -= cost
+                    self._total -= 1
+                    if not lane:
+                        # An emptied lane forfeits leftover deficit —
+                        # it must not bank credit while idle.
+                        self._rotation.popleft()
+                        self._queues.pop(key, None)
+                        self._deficit.pop(key, None)
+                    return head
+                self._deficit[key] = self._deficit.get(key, 0) + \
+                    self._quantum
+                self._rotation.rotate(-1)
+                progressed = True
+            if not progressed:
+                return None  # every lane head quota-blocked
+        return None
 
 
 class _PrefillState:
@@ -233,7 +352,11 @@ class ContinuousBatchingEngine:
                  spec_decode: Optional[bool] = None,
                  draft_k: Optional[int] = None,
                  draft: Optional[Any] = None,
-                 role: Optional[str] = None) -> None:
+                 role: Optional[str] = None,
+                 lora_pages: Optional[int] = None,
+                 lora_max_rank: Optional[int] = None,
+                 lora_max_active: Optional[int] = None,
+                 base_digest: Optional[str] = None) -> None:
         # Real-weights path: see engine.py (models/hf_interop.py).
         if hf_checkpoint:
             from skypilot_tpu.models import hf_interop
@@ -316,7 +439,12 @@ class ContinuousBatchingEngine:
         self._admit_order = [0] * max_slots  # preemption victim pick
         self._admit_seq = 0
         self._prefilling: List[_PrefillState] = []
-        self._waiting: List[_Request] = []  # admitted FIFO, blocked on HBM
+        # Admission queue: DRR-fair across adapters (exact FIFO when
+        # only base traffic flows — a single lane degenerates to the
+        # pre-multi-LoRA order).
+        self._waiting = _DrrQueue(
+            self.block_size,
+            env_registry.get_int('SKYT_LORA_DRR_QUANTUM', default=4))
         # Pool version at the last admission attempt that failed on
         # HBM pressure: until it changes, retrying is pure waste
         # (prefix re-hash + reclaimable scan on the serving loop).
@@ -340,6 +468,46 @@ class ContinuousBatchingEngine:
         else:
             self._draft = None
         self.spec_decode = self._draft is not None
+        # Multi-LoRA serving (docs/multi_lora_serving.md): a fixed
+        # stack of device adapter pages fed from a host registry, with
+        # residency charged against the KV block pool (S-LoRA unified
+        # paging) and per-slot page indices gathered inside the jitted
+        # steps (Punica BGMV). 0 pages = disabled: the jitted programs
+        # and scheduler order are exactly the pre-LoRA engine.
+        n_lora = (lora_pages if lora_pages is not None
+                  else env_registry.get_int('SKYT_LORA_PAGES',
+                                            default=0))
+        self._lora_max_rank = max(1, (
+            lora_max_rank if lora_max_rank is not None
+            else env_registry.get_int('SKYT_LORA_MAX_RANK', default=8)))
+        self._lora_max_active = (
+            lora_max_active if lora_max_active is not None
+            else env_registry.get_int('SKYT_LORA_MAX_ACTIVE',
+                                      default=0))
+        self.base_digest = base_digest or ''
+        self._adapters: Dict[str, Dict[str, Any]] = {}
+        self._adapter_lock = threading.Lock()
+        self._adapter_demand: Dict[str, Dict[str, float]] = {}
+        self._slot_adapter = np.zeros((max_slots,), np.int32)
+        self._slot_adapter_name: List[Optional[str]] = \
+            [None] * max_slots
+        self._in_adapter_admit = False
+        if n_lora > 0:
+            kv_itemsize = self.cache.k.dtype.itemsize
+            block_bytes = (2 * self.cfg.n_layers * self.block_size *
+                           self.cfg.n_kv_heads *
+                           self.cfg.resolved_head_dim * kv_itemsize)
+            if self.cache.quantized:
+                block_bytes += (2 * self.cfg.n_layers *
+                                self.block_size * 4)
+            self._adapter_pool: Optional[AdapterPagePool] = \
+                AdapterPagePool(self._pool, n_lora, block_bytes)
+            self._lora_store = lora_lib.init_adapter_pages(
+                self.cfg, n_lora, self._lora_max_rank,
+                dtype=self.cfg.compute_dtype)
+        else:
+            self._adapter_pool = None
+            self._lora_store = None
         # Disaggregated serving role (docs/disaggregated_serving.md):
         # '' = colocated, 'prefill' = chunked prefill only, finished KV
         # parked in the exporter for the decode fleet to pull;
@@ -417,6 +585,16 @@ class ContinuousBatchingEngine:
             if not self._prefix.evict_reclaimable():
                 break
             block = self._pool.alloc()
+        # Last resort before preemption: reclaim idle adapter pages
+        # (KV pressure and adapter residency share one budget). The
+        # reentrancy guard keeps an in-flight admission's own eviction
+        # loop authoritative.
+        while (block is None and self._adapter_pool is not None and
+               not self._in_adapter_admit):
+            if self._adapter_pool.evict_lru(
+                    on_evict=self._note_adapter_evict) is None:
+                break
+            block = self._pool.alloc()
         return block
 
     def _release_slot(self, slot: int) -> None:
@@ -428,6 +606,13 @@ class ContinuousBatchingEngine:
         self._slots[slot] = None
         self._decoding[slot] = False
         self._pending_tok[slot] = 0
+        name = self._slot_adapter_name[slot]
+        if name is not None:
+            self._slot_adapter_name[slot] = None
+            self._slot_adapter[slot] = 0
+            if self._adapter_pool is not None and \
+                    self._adapter_pool.page_of(name) is not None:
+                self._adapter_pool.unpin(name)
         self._bt_dirty = True
 
     def _finish(self, request: _Request,
@@ -473,6 +658,139 @@ class ContinuousBatchingEngine:
         if request is not None:
             self._finish(request, error)
 
+    # -- multi-LoRA adapters --------------------------------------------
+
+    def register_adapter(self, name: str, lora: Any, *,
+                         alpha: float = lora_lib.DEFAULT_ALPHA,
+                         base_digest: Optional[str] = None) -> None:
+        """Make adapter ``name`` servable: host-side weights go into
+        the registry; the device page is populated lazily on first
+        request (prefetch-on-admission). ``lora`` is an
+        ``init_lora_params``-shaped pytree. ``base_digest`` (when both
+        sides declare one) must match the engine's base checkpoint —
+        an adapter trained against a different base is rejected here,
+        not discovered as garbage tokens in production."""
+        if not name:
+            raise ValueError('adapter name must be non-empty')
+        if self._adapter_pool is None:
+            raise RuntimeError(
+                'engine has no adapter pages (construct with '
+                'lora_pages=N or set SKYT_LORA_PAGES)')
+        rank = int(lora['wq_a'].shape[-1])
+        if rank > self._lora_max_rank:
+            raise ValueError(
+                f'adapter {name!r} rank {rank} exceeds the engine '
+                f'page max_rank {self._lora_max_rank} '
+                f'(SKYT_LORA_MAX_RANK)')
+        if lora['wq_a'].shape[0] != self.cfg.n_layers or \
+                lora['wq_a'].shape[1] != self.cfg.d_model:
+            raise ValueError(
+                f'adapter {name!r} shape {lora["wq_a"].shape} does '
+                f'not match the base model '
+                f'[{self.cfg.n_layers}, {self.cfg.d_model}, r]')
+        if base_digest and self.base_digest and \
+                base_digest != self.base_digest:
+            raise ValueError(
+                f'adapter {name!r} was trained against base '
+                f'{base_digest[:12]}...; this engine serves '
+                f'{self.base_digest[:12]}...')
+        host = {key: np.asarray(value) for key, value in lora.items()}
+        with self._adapter_lock:
+            self._adapters[name] = {
+                'lora': host,
+                'alpha': float(alpha),
+                'rank': rank,
+                'base_digest': base_digest or '',
+                'nbytes': lora_lib.adapter_nbytes(self.cfg, rank),
+            }
+            self._adapter_demand.setdefault(
+                name, {'requests': 0, 'last_request': 0.0,
+                       'last_evicted': 0.0})
+
+    def adapters(self) -> List[str]:
+        with self._adapter_lock:
+            return sorted(self._adapters)
+
+    def adapter_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-adapter demand/residency snapshot (serve status)."""
+        resident = (set(self._adapter_pool.resident_names())
+                    if self._adapter_pool is not None else set())
+        out: Dict[str, Dict[str, float]] = {}
+        with self._adapter_lock:
+            for name, entry in self._adapters.items():
+                demand = self._adapter_demand.get(name, {})
+                out[name] = {
+                    'rank': entry['rank'],
+                    'resident': float(name in resident),
+                    'active_slots': float(sum(
+                        1 for n in self._slot_adapter_name
+                        if n == name)),
+                    'requests': float(demand.get('requests', 0)),
+                    'last_request': float(demand.get('last_request',
+                                                     0.0)),
+                    'last_evicted': float(demand.get('last_evicted',
+                                                     0.0)),
+                }
+        return out
+
+    def _note_adapter_evict(self, name: str) -> None:
+        """Observes every adapter-page eviction (chaos site + demand
+        bookkeeping) BEFORE the pool mutates."""
+        fault_injection.inject(LORA_EVICT_SITE)
+        demand = self._adapter_demand.setdefault(
+            name, {'requests': 0, 'last_request': 0.0,
+                   'last_evicted': 0.0})
+        demand['last_evicted'] = time.time()
+
+    def _ensure_adapter_resident(self, name: str) -> Optional[int]:
+        """Device page for ``name``, admitting (host -> device upload)
+        on a miss. None = can't fit right now (HBM pressure — request
+        stays queued, nothing retained). Raises on unknown adapters or
+        injected fetch faults."""
+        with self._adapter_lock:
+            entry = self._adapters.get(name)
+        if entry is None:
+            raise KeyError(f'adapter {name!r} is not registered')
+        page = self._adapter_pool.lookup(name)
+        if page is not None:
+            return page
+        fault_injection.inject(LORA_FETCH_SITE)
+        self._in_adapter_admit = True
+        try:
+            page = self._adapter_pool.admit(
+                name, entry['nbytes'], alloc=self._alloc_block,
+                on_evict=self._note_adapter_evict)
+        finally:
+            self._in_adapter_admit = False
+        if page is None:
+            return None
+        self._lora_store = lora_lib.write_adapter_page(
+            self._lora_store, page,
+            {key: jnp.asarray(value)
+             for key, value in entry['lora'].items()},
+            alpha=entry['alpha'])
+        return page
+
+    def _quota_blocked(self, request: _Request) -> bool:
+        """Per-adapter concurrency quota (SKYT_LORA_MAX_ACTIVE): an
+        adapter at its cap waits in ITS lane; other lanes admit."""
+        if not request.adapter or self._lora_max_active <= 0:
+            return False
+        active = sum(1 for name in self._slot_adapter_name
+                     if name == request.adapter)
+        return active >= self._lora_max_active
+
+    def _lora_step_args(self):
+        """(lora_pages, adapter_ids) for the jitted steps — (None,
+        None) on a non-LoRA engine OR an all-base batch (no slot holds
+        an adapter page), keeping those traces bitwise-identical to
+        the pre-LoRA program: base-only traffic on a LoRA-enabled
+        engine skips the gather einsums entirely."""
+        if self._adapter_pool is None or \
+                not self._slot_adapter.any():
+            return (None, None)
+        return (self._lora_store, jnp.asarray(self._slot_adapter))
+
     # -- admission + chunked prefill ------------------------------------
 
     def _admit(self) -> None:
@@ -482,7 +800,7 @@ class ContinuousBatchingEngine:
         interleaved with decode steps — never inline here."""
         while True:
             try:
-                self._waiting.append(self._pending.get_nowait())
+                self._waiting.push(self._pending.get_nowait())
             except queue.Empty:
                 break
         while self._waiting:
@@ -492,21 +810,23 @@ class ContinuousBatchingEngine:
                 return
             if self._blocked_at_version == self._pool.version:
                 return  # still HBM-blocked; nothing changed since
-            request = self._waiting[0]
+            request = self._waiting.pop(blocked=self._quota_blocked)
+            if request is None:
+                return  # every lane head is quota-blocked
             try:
                 if not self._begin_prefill(request, slot):
-                    # HBM pressure: keep FIFO order; retry only once
-                    # the pool's alloc/ref state has moved.
+                    # HBM pressure: the request resumes first in its
+                    # lane (deficit refunded); retry only once the
+                    # pool's alloc/ref/pin state has moved.
+                    self._waiting.push_front(request)
                     self._blocked_at_version = self._pool.version
                     return
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('prefill admission failed')
-                self._waiting.pop(0)
                 self._prefill_errors_total += 1
                 self._finish(request, e)
                 continue
             self._blocked_at_version = None
-            self._waiting.pop(0)
 
     def _begin_prefill(self, request: _Request, slot: int) -> bool:
         """Returns False when the pool can't fit the prompt right now
@@ -537,6 +857,14 @@ class ContinuousBatchingEngine:
                 f'prompt needs {needed_total} KV blocks; pool has '
                 f'{self._pool.total_blocks} (raise num_blocks or '
                 f'SKYT_INFER_BLOCK_SIZE granularity)')
+        if request.adapter and self._adapter_pool is None:
+            raise RuntimeError(
+                f'request names adapter {request.adapter!r} but the '
+                'engine has no adapter pages (lora_pages=0)')
+        # LoRA v-deltas make cached V adapter-specific: each adapter
+        # hashes its prefix chains under its own root salt, so base
+        # and per-adapter chains share the pool but never collide.
+        root = adapter_chain_root(request.adapter)
         shared: List[int] = []
         if self._prefix is not None:
             # Leave >= 1 prompt token to compute: the last token's
@@ -544,7 +872,8 @@ class ContinuousBatchingEngine:
             # counters are bumped only once admission COMMITS below —
             # a blocked retry must not re-count reuse that never
             # happened.
-            shared = self._prefix.lookup(ids, limit_tokens=plen - 1)
+            shared = self._prefix.lookup(ids, limit_tokens=plen - 1,
+                                         root=root)
         blocks = list(shared)
         # Admission watermark: keep one tail block of headroom per
         # active decoder so admitting this prompt can't immediately
@@ -570,6 +899,25 @@ class ContinuousBatchingEngine:
             for block in blocks:
                 self._pool.decref(block)
             return False
+        adapter_page = 0
+        if request.adapter:
+            # After KV allocation (so adapter admission's own evictions
+            # can't race the blocks above — they're ref'd), before the
+            # commit. A raise here (unknown adapter, injected fetch
+            # fault) fails the request; None (HBM-blocked) requeues it
+            # with the pool exactly as it was.
+            try:
+                page = self._ensure_adapter_resident(request.adapter)
+            except BaseException:
+                for block in blocks:
+                    self._pool.decref(block)
+                raise
+            if page is None:
+                for block in blocks:
+                    self._pool.decref(block)
+                return False
+            adapter_page = page
+            self._adapter_pool.pin(request.adapter)
         start = len(shared) * self.block_size
         if self._prefix is not None:
             if shared:
@@ -594,6 +942,8 @@ class ContinuousBatchingEngine:
         self._bt_dirty = True
         self._slots[slot] = request
         self._decoding[slot] = False
+        self._slot_adapter[slot] = adapter_page
+        self._slot_adapter_name[slot] = request.adapter or None
         self._admit_seq += 1
         self._admit_order[slot] = self._admit_seq
         self._prefilling.append(_PrefillState(request, slot, start, ids))
@@ -617,11 +967,14 @@ class ContinuousBatchingEngine:
         self._sync_tables()
         chunk_wall = time.time()
         chunk_mono = time.monotonic()
+        lora_pages, _ = self._lora_step_args()
+        adapter_id = (jnp.int32(int(self._slot_adapter[slot]))
+                      if lora_pages is not None else None)
         try:
             last, cache = self._prefill_fn(
                 self.params, jnp.asarray(tokens),
                 jnp.int32(state.pos), jnp.int32(len(chunk)),
-                jnp.int32(slot), self.cache)
+                jnp.int32(slot), self.cache, lora_pages, adapter_id)
         except Exception as e:  # pylint: disable=broad-except
             logger.exception('chunked prefill failed')
             self._prefilling.pop(0)
@@ -663,7 +1016,9 @@ class ContinuousBatchingEngine:
                 request.decode_start_wall = time.time()
                 request.decode_start_mono = time.monotonic()
             if self._prefix is not None:
-                self._prefix.insert(ids, self._slot_blocks[slot])
+                self._prefix.insert(ids, self._slot_blocks[slot],
+                                    root=adapter_chain_root(
+                                        request.adapter))
 
     # -- disaggregated prefill/decode (docs/disaggregated_serving.md) ---
 
@@ -731,12 +1086,14 @@ class ContinuousBatchingEngine:
         if plen % self.block_size:
             for name, array in host[n_full].items():
                 tail_arrays[f'tail_{name}'] = array
+        root = adapter_chain_root(request.adapter)
         export = kv_migrate.KvExport(
             request_id=request.request_id, ids=list(ids),
             block_size=self.block_size,
-            digests=chain_digests(ids, self.block_size),
+            digests=chain_digests(ids, self.block_size, root=root),
             blocks=payloads, tail=kv_migrate.pack_arrays(tail_arrays),
-            meta={'seed': request.seed, 'n_tokens': plen},
+            meta={'seed': request.seed, 'n_tokens': plen,
+                  'adapter': request.adapter or ''},
             created=time.monotonic())
         self.exporter.put(export)
         self._kv_exports_total += 1
@@ -744,7 +1101,7 @@ class ContinuousBatchingEngine:
             # Future prompts sharing this prefix prefill only their
             # suffix — and their exports list the shared blocks with
             # the same chain digests.
-            self._prefix.insert(ids, blocks)
+            self._prefix.insert(ids, blocks, root=root)
         self._finish(request)
         self._release_slot(slot)
 
@@ -767,7 +1124,8 @@ class ContinuousBatchingEngine:
                 f'migration manifest mismatch: {manifest["n_tokens"]} '
                 f'tokens/bs={manifest["block_size"]} vs local '
                 f'{plen}/bs={self.block_size}')
-        digests = chain_digests(ids, self.block_size)
+        root = adapter_chain_root(request.adapter)
+        digests = chain_digests(ids, self.block_size, root=root)
         if [row['digest'] for row in manifest['blocks']] != digests:
             raise RuntimeError('migration chain digests diverge from '
                                'the local token stream')
@@ -779,7 +1137,7 @@ class ContinuousBatchingEngine:
                 f'pool has {self._pool.total_blocks}')
         # Same admission watermark as _begin_prefill: keep one tail
         # block of headroom per active decoder.
-        resident_now = (self._prefix.resident_chain(ids)
+        resident_now = (self._prefix.resident_chain(ids, root=root)
                         if self._prefix is not None else [])
         need_private = needed_total - len(resident_now)
         avail = self._pool.free_blocks + (
@@ -787,11 +1145,23 @@ class ContinuousBatchingEngine:
             else 0)
         if avail < need_private + sum(self._decoding):
             return False
+        adapter_page = 0
+        if request.adapter:
+            page = self._ensure_adapter_resident(request.adapter)
+            if page is None:
+                return False
+            adapter_page = page
+            # Pin NOW: the import's own allocations below route
+            # through _alloc_block, whose adapter-eviction fallback
+            # must not reclaim the page this import depends on.
+            self._adapter_pool.pin(request.adapter)
         importer = BlockImporter(self._pool, self._prefix)
         got = importer.begin(ids, needed_total,
                              block_size=self.block_size,
-                             alloc=self._alloc_block)
+                             alloc=self._alloc_block, root=root)
         if got is None:
+            if request.adapter:
+                self._adapter_pool.unpin(request.adapter)
             return False
         blocks, n_resident = got
         try:
@@ -823,6 +1193,8 @@ class ContinuousBatchingEngine:
                 jnp.asarray(tail['logits'], jnp.float32))
         except Exception:
             importer.abort()
+            if request.adapter:
+                self._adapter_pool.unpin(request.adapter)
             raise
         importer.commit()
         if not request.admitted:
@@ -835,6 +1207,8 @@ class ContinuousBatchingEngine:
         self._host_len[slot] = plen
         self._bt_dirty = True
         self._slots[slot] = request
+        self._slot_adapter[slot] = adapter_page
+        self._slot_adapter_name[slot] = request.adapter or None
         self._admit_seq += 1
         self._admit_order[slot] = self._admit_seq
         self._rngs[slot] = jax.random.key(request.seed)
@@ -848,7 +1222,7 @@ class ContinuousBatchingEngine:
             request.decode_start_wall = time.time()
             request.decode_start_mono = time.monotonic()
         if self._prefix is not None:
-            self._prefix.insert(ids, blocks)
+            self._prefix.insert(ids, blocks, root=root)
         request.migration = None  # a later preemption re-prefills
         self._kv_imports_total += 1
         if request.handoff_start is not None:
@@ -882,7 +1256,7 @@ class ContinuousBatchingEngine:
                     'infer.preempt', request.span.context, time.time(),
                     0.0, service='inference', slot=slot,
                     generated=len(request.generated))
-            self._waiting.insert(0, request)
+            self._waiting.push_front(request)
             self._wake.set()
 
     def _ensure_decode_blocks(self, active_mask: np.ndarray,
@@ -994,12 +1368,14 @@ class ContinuousBatchingEngine:
         self._sync_tables()
         temps = np.array([r.temperature if r else 0.0
                           for r in self._slots], np.float32)
+        lora_pages, adapter_ids = self._lora_step_args()
         step_t0 = time.perf_counter()
         try:
             n_emit, pending_next, cache = self._spec_fn(
                 self.params, self.cache, jnp.asarray(inputs),
                 jnp.asarray(n_input), jnp.asarray(active_mask),
-                jnp.asarray(temps), jnp.stack(self._rngs))
+                jnp.asarray(temps), jnp.stack(self._rngs),
+                lora_pages, adapter_ids)
         except Exception as e:  # pylint: disable=broad-except
             logger.exception('speculative verify step failed')
             for slot in range(self.max_slots):
@@ -1094,12 +1470,13 @@ class ContinuousBatchingEngine:
             self._sync_tables()
             temps = np.array([r.temperature if r else 0.0
                               for r in self._slots], np.float32)
+            lora_pages, adapter_ids = self._lora_step_args()
             step_t0 = time.perf_counter()
             try:
                 tokens, logits, cache = self._decode_fn(
                     self.params, self._last_logits, self.cache,
                     jnp.asarray(active_mask), jnp.asarray(temps),
-                    jnp.stack(self._rngs))
+                    jnp.stack(self._rngs), lora_pages, adapter_ids)
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('continuous decode step failed')
                 for slot in range(self.max_slots):
@@ -1151,7 +1528,8 @@ class ContinuousBatchingEngine:
     def _submit(self, token_ids: List[int], max_new_tokens: int,
                 temperature: float, eos_id: Optional[int],
                 seed: int, trace_ctx=None, migration=None,
-                handoff_start: Optional[float] = None) -> _Request:
+                handoff_start: Optional[float] = None,
+                adapter: Optional[str] = None) -> _Request:
         """Shared admission path: validate + enqueue (both the blocking
         and streaming entries; the policy must not drift between them).
 
@@ -1168,8 +1546,22 @@ class ContinuousBatchingEngine:
             raise RuntimeError(
                 'a prefill-role engine never decodes; use '
                 'prefill_and_export (or clear SKYT_DISAGG_ROLE)')
+        if adapter:
+            # Reject unknown adapters EAGERLY (callers get a clean
+            # error, not an async prefill failure) and count demand.
+            with self._adapter_lock:
+                if adapter not in self._adapters:
+                    raise ValueError(
+                        f'adapter {adapter!r} is not registered '
+                        f'(register_adapter first)')
+                demand = self._adapter_demand.setdefault(
+                    adapter, {'requests': 0, 'last_request': 0.0,
+                              'last_evicted': 0.0})
+                demand['requests'] += 1
+                demand['last_request'] = time.time()
         request = _Request(token_ids, max_new_tokens, temperature,
-                           eos_id, seed, trace_ctx=trace_ctx)
+                           eos_id, seed, trace_ctx=trace_ctx,
+                           adapter=adapter or None)
         self._request_seq += 1
         request.request_id = f'r{self._request_seq}'
         request.migration = migration
@@ -1210,14 +1602,18 @@ class ContinuousBatchingEngine:
             raise request.error
         return request.request_id
 
-    def probe_resident(self, token_ids: List[int]) -> List[int]:
+    def probe_resident(self, token_ids: List[int],
+                       adapter: Optional[str] = None) -> List[int]:
         """Chain digests of the full-block prefix already resident in
         this engine's PrefixCache — read-only and thread-safe, the
         decode side's input to the migration delta manifest (those
-        blocks are skipped by the pull)."""
+        blocks are skipped by the pull). Adapter chains live under
+        their own root salt, so probe with the same adapter the
+        request will decode with."""
         if self._prefix is None:
             return []
-        return self._prefix.resident_chain(token_ids)
+        return self._prefix.resident_chain(
+            token_ids, root=adapter_chain_root(adapter))
 
     def submit_migrated(self, token_ids: List[int], pulled, *,
                         max_new_tokens: int = 32,
@@ -1274,9 +1670,11 @@ class ContinuousBatchingEngine:
                      eos_id: Optional[int] = None,
                      seed: int = 0,
                      timeout: float = 300.0,
-                     trace_ctx=None) -> List[int]:
+                     trace_ctx=None,
+                     adapter: Optional[str] = None) -> List[int]:
         request = self._submit(token_ids, max_new_tokens, temperature,
-                               eos_id, seed, trace_ctx=trace_ctx)
+                               eos_id, seed, trace_ctx=trace_ctx,
+                               adapter=adapter)
         if not request.done.wait(timeout):
             raise TimeoutError('generation timed out')
         if request.error is not None:
@@ -1298,7 +1696,8 @@ class ContinuousBatchingEngine:
                    eos_id: Optional[int] = None,
                    seed: int = 0,
                    timeout: float = 300.0,
-                   trace_ctx=None):
+                   trace_ctx=None,
+                   adapter: Optional[str] = None):
         """Yield generated token ids AS THEY LAND in the slot loop
         (the decode thread appends to request.generated; this iterator
         tails it) — the vLLM/JetStream streaming serving shape.
@@ -1306,7 +1705,8 @@ class ContinuousBatchingEngine:
         Validation/admission happens EAGERLY (same as generate_ids: an
         over-long prompt raises here, not at first iteration)."""
         request = self._submit(token_ids, max_new_tokens, temperature,
-                               eos_id, seed, trace_ctx=trace_ctx)
+                               eos_id, seed, trace_ctx=trace_ctx,
+                               adapter=adapter)
         return self.tail_tokens(request, eos_id=eos_id, timeout=timeout)
 
     def stream_text(self, prompt: str, **kwargs: Any):
@@ -1373,6 +1773,24 @@ class ContinuousBatchingEngine:
             'accepted_tokens': self._accepted_tokens_total,
             'verify_steps': self._verify_steps_total,
             'spec_window': self._spec_window,
+            # Multi-LoRA (zero on engines with no adapter pages).
+            'lora_hits': (self._adapter_pool.hits
+                          if self._adapter_pool is not None else 0),
+            'lora_misses': (self._adapter_pool.misses
+                            if self._adapter_pool is not None else 0),
+            'lora_evictions': (self._adapter_pool.evictions
+                               if self._adapter_pool is not None
+                               else 0),
+            'lora_pages_total': (self._adapter_pool.n_pages
+                                 if self._adapter_pool is not None
+                                 else 0),
+            'lora_pages_resident': (self._adapter_pool.resident_pages
+                                    if self._adapter_pool is not None
+                                    else 0),
+            'lora_blocks_charged': (self._adapter_pool.blocks_charged
+                                    if self._adapter_pool is not None
+                                    else 0),
+            'lora_adapters_registered': len(self._adapters),
             # Point-in-time gauges: paged-pool pressure.
             'block_size': self.block_size,
             'blocks_total': total,

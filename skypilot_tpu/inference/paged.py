@@ -15,9 +15,17 @@ lives here, on the serving-loop thread:
   writes into a shared block: only FULL prompt blocks are ever shared,
   and a slot's tail block is always private. Entries hold their own
   block reference; LRU eviction releases it back to the pool when HBM
-  pressure needs the block.
+  pressure needs the block. Chains are ROOTED: multi-LoRA serving
+  salts the chain root per adapter (LoRA v-deltas make cached V rows
+  adapter-specific), so adapters never cross-hit each other's blocks
+  while the base-model chains (root 0) behave exactly as before.
+* ``AdapterPagePool`` — S-LoRA-style unified paging: a resident LoRA
+  adapter charges ``ceil(bytes / block_bytes)`` blocks against the
+  SAME :class:`BlockPool` as KV while it holds one of the fixed device
+  page slots, so the adapter working set and the KV working set
+  compete for one HBM budget instead of two static carve-outs.
 
-Both structures are single-threaded by design — they are only touched
+All structures are single-threaded by design — they are only touched
 from the engine's serving loop.
 """
 from __future__ import annotations
@@ -114,14 +122,16 @@ class PrefixCache:
     def _digest(parent: int, tokens: Tuple[int, ...]) -> int:
         return hash((parent, tokens))
 
-    def lookup(self, ids: Sequence[int], limit_tokens: int
-               ) -> List[int]:
+    def lookup(self, ids: Sequence[int], limit_tokens: int,
+               root: int = 0) -> List[int]:
         """Longest cached full-block prefix of ``ids`` covering at most
         ``limit_tokens`` tokens. Increfs and returns the matched block
-        ids (caller owns the references)."""
+        ids (caller owns the references). ``root`` seeds the chain
+        (0 = base model; adapter-salted roots keep per-adapter KV
+        chains disjoint)."""
         bs = self._block_size
         matched: List[int] = []
-        parent = 0
+        parent = root
         for i in range(min(len(ids), limit_tokens) // bs):
             tokens = tuple(ids[i * bs:(i + 1) * bs])
             digest = self._digest(parent, tokens)
@@ -135,7 +145,8 @@ class PrefixCache:
             parent = digest
         return matched
 
-    def resident_chain(self, ids: Sequence[int]) -> List[int]:
+    def resident_chain(self, ids: Sequence[int],
+                       root: int = 0) -> List[int]:
         """Chain digests of the cached full-block prefix of ``ids`` —
         strictly read-only (no incref, no LRU touch), so the decode
         side of a KV migration can plan its delta manifest from
@@ -144,7 +155,7 @@ class PrefixCache:
         falls back to re-prefill on a shrink."""
         bs = self._block_size
         out: List[int] = []
-        parent = 0
+        parent = root
         for i in range(len(ids) // bs):
             tokens = tuple(ids[i * bs:(i + 1) * bs])
             digest = self._digest(parent, tokens)
@@ -156,14 +167,16 @@ class PrefixCache:
             parent = digest
         return out
 
-    def insert(self, ids: Sequence[int], blocks: Sequence[int]) -> None:
+    def insert(self, ids: Sequence[int], blocks: Sequence[int],
+               root: int = 0) -> None:
         """Register the full blocks of a freshly prefilled prompt.
 
         ``blocks`` is the slot's block list (shared prefix first, then
         private). Blocks already cached along the chain are skipped —
-        the existing shared copy stays canonical."""
+        the existing shared copy stays canonical. ``root`` must match
+        the salt the prompt was prefilled under (see :meth:`lookup`)."""
         bs = self._block_size
-        parent = 0
+        parent = root
         for i in range(len(ids) // bs):
             if i >= len(blocks):
                 break
@@ -228,14 +241,17 @@ class PrefixCache:
 # ---------------------------------------------------------------------
 
 
-def chain_digests(ids: Sequence[int], block_size: int) -> List[int]:
+def chain_digests(ids: Sequence[int], block_size: int,
+                  root: int = 0) -> List[int]:
     """Rolling chain digest of every FULL block of ``ids`` — the same
     keying :class:`PrefixCache` uses, exported for the KV-migration
     delta manifest: a block is resident on the decode side iff its
     chain digest (and token tuple, verified by the cache walk) already
-    has an entry there, so only non-resident blocks ever move."""
+    has an entry there, so only non-resident blocks ever move.
+    ``root`` carries the adapter salt so migrated adapter KV never
+    aliases base-model chains."""
     out: List[int] = []
-    parent = 0
+    parent = root
     for i in range(len(ids) // block_size):
         tokens = tuple(ids[i * block_size:(i + 1) * block_size])
         parent = PrefixCache._digest(parent, tokens)  # noqa: SLF001
@@ -282,7 +298,8 @@ class BlockImporter:
 
     def begin(self, ids: Sequence[int], needed_total: int, *,
               block_size: int,
-              alloc: Optional[Callable[[], Optional[int]]] = None
+              alloc: Optional[Callable[[], Optional[int]]] = None,
+              root: int = 0
               ) -> Optional[Tuple[List[int], int]]:
         """Acquire ``needed_total`` blocks for token sequence ``ids``:
         the cached full-block prefix first (shared — increfed through
@@ -302,7 +319,8 @@ class BlockImporter:
         resident: List[int] = []
         if self._prefix is not None:
             limit = min(len(ids), needed_total * block_size)
-            resident = self._prefix.lookup(ids, limit_tokens=limit)
+            resident = self._prefix.lookup(ids, limit_tokens=limit,
+                                           root=root)
         self._resident = resident
         self._allocated = []
         self._active = True
@@ -332,3 +350,194 @@ class BlockImporter:
         self._resident = []
         self._allocated = []
         self._active = False
+
+
+# ---------------------------------------------------------------------
+# Multi-LoRA unified paging (adapter weight pages in the KV pool)
+# ---------------------------------------------------------------------
+
+
+def adapter_chain_root(adapter: Optional[str]) -> int:
+    """Prefix-chain root salt for an adapter (0 = base model).
+
+    LoRA v-projection deltas make cached V rows adapter-specific, so
+    each adapter's prefix chains must be disjoint from the base chains
+    and from every other adapter's. Never 0 for a named adapter."""
+    if not adapter:
+        return 0
+    return hash(('skyt-lora-root', adapter)) or 1
+
+
+@dataclasses.dataclass
+class _AdapterResidency:
+    page: int             # device page-slot index (1..n_pages)
+    blocks: List[int]     # charge blocks held against the shared pool
+    pins: int = 0         # live slots currently decoding this adapter
+
+
+class AdapterPagePool:
+    """Host-side policy for adapter weight pages in the shared pool.
+
+    The device side is a fixed stack of adapter page slots
+    (``models/lora.init_adapter_pages``; page 0 = base model, all
+    zeros). This class decides which adapter owns which page slot and
+    makes residency COST something: a resident adapter charges
+    ``ceil(nbytes / block_bytes)`` blocks against the same
+    :class:`BlockPool` the KV cache allocates from, held for as long
+    as the adapter is resident. A cold adapter therefore costs a pull
+    (host -> device upload into a page slot, possibly after LRU
+    eviction of an idle adapter), never a dedicated fleet — and KV
+    pressure and adapter pressure degrade each other gracefully
+    instead of one budget silently starving the other.
+
+    Refcount-exact by the same discipline as :class:`BlockImporter`:
+    a failed admission leaves the pool untouched, and evicting every
+    resident returns the pool to exactly its prior free count (the
+    teardown accounting tests assert this).
+
+    Pinning: a slot actively decoding with an adapter pins its
+    residency — pinned adapters are never evicted, so a mid-request
+    page can't be overwritten under the jitted step. Single-threaded
+    by design (serving-loop only), like the rest of this module.
+    """
+
+    def __init__(self, pool: BlockPool, n_pages: int,
+                 block_bytes: int) -> None:
+        if n_pages < 1:
+            raise ValueError('AdapterPagePool needs >= 1 page slot')
+        if block_bytes < 1:
+            raise ValueError('block_bytes must be >= 1')
+        self._pool = pool
+        self.n_pages = n_pages
+        self.block_bytes = block_bytes
+        # pop() order 1, 2, ... — deterministic, page 0 is the base.
+        self._free_pages: List[int] = list(range(n_pages, 0, -1))
+        self._resident: 'OrderedDict[str, _AdapterResidency]' = \
+            OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def resident_pages(self) -> int:
+        return len(self._resident)
+
+    @property
+    def blocks_charged(self) -> int:
+        return sum(len(r.blocks) for r in self._resident.values())
+
+    def blocks_for(self, nbytes: int) -> int:
+        """Charge-block count for an adapter of ``nbytes`` weights."""
+        return max(1, -(-int(nbytes) // self.block_bytes))
+
+    def resident_names(self) -> List[str]:
+        return list(self._resident)
+
+    def page_of(self, name: str) -> Optional[int]:
+        """Page index if resident (no LRU touch, no hit counting)."""
+        entry = self._resident.get(name)
+        return entry.page if entry is not None else None
+
+    def lookup(self, name: str) -> Optional[int]:
+        """Residency check on the request path: bumps LRU recency and
+        the hit/miss counters."""
+        entry = self._resident.get(name)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._resident.move_to_end(name)
+        self.hits += 1
+        return entry.page
+
+    def admit(self, name: str, nbytes: int, *,
+              alloc: Optional[Callable[[], Optional[int]]] = None,
+              on_evict: Optional[Callable[[str], None]] = None
+              ) -> Optional[int]:
+        """Make ``name`` resident: claim a page slot (LRU-evicting idle
+        adapters if every slot is taken) and the charge blocks.
+        Returns the page index, or None when it can't fit right now —
+        every page pinned, or the pool can't supply the charge blocks
+        even after evicting idle adapters. A None return retains
+        nothing. Raises when the adapter can NEVER fit the pool.
+
+        ``alloc`` overrides the raw allocator (the engine passes its
+        prefix-evicting ``_alloc_block``); ``on_evict`` observes each
+        LRU eviction (chaos hook + bookkeeping) BEFORE it mutates."""
+        if name in self._resident:
+            raise ValueError(f'adapter {name!r} is already resident')
+        if alloc is None:
+            alloc = self._pool.alloc
+        need = self.blocks_for(nbytes)
+        if need > self._pool.total_blocks:
+            raise ValueError(
+                f'adapter {name!r} needs {need} charge blocks; pool '
+                f'has {self._pool.total_blocks} total')
+        while not self._free_pages:
+            if self.evict_lru(on_evict=on_evict) is None:
+                return None
+        blocks: List[int] = []
+        try:
+            while len(blocks) < need:
+                block = alloc()
+                if block is not None:
+                    blocks.append(block)
+                    continue
+                if self.evict_lru(on_evict=on_evict) is None:
+                    for held in reversed(blocks):
+                        self._pool.decref(held)
+                    return None
+        except BaseException:
+            # A raising alloc/on_evict (chaos hooks) must not leak the
+            # charge blocks already held for this failed admission.
+            for held in reversed(blocks):
+                self._pool.decref(held)
+            raise
+        page = self._free_pages.pop()
+        self._resident[name] = _AdapterResidency(page=page,
+                                                 blocks=blocks)
+        return page
+
+    def evict_lru(self, on_evict: Optional[Callable[[str], None]] = None
+                  ) -> Optional[str]:
+        """Evict the least-recently-used UNPINNED resident back to the
+        host store: page slot and charge blocks return to their free
+        lists. Returns the evicted name, or None when every resident
+        is pinned (nothing evictable)."""
+        for name, entry in self._resident.items():   # LRU order
+            if entry.pins:
+                continue
+            if on_evict is not None:
+                on_evict(name)        # may raise; nothing mutated yet
+            del self._resident[name]
+            for block in reversed(entry.blocks):
+                self._pool.decref(block)
+            self._free_pages.append(entry.page)
+            self.evictions += 1
+            return name
+        return None
+
+    def pin(self, name: str) -> None:
+        entry = self._resident.get(name)
+        if entry is None:
+            raise ValueError(f'pin of non-resident adapter {name!r}')
+        entry.pins += 1
+        # Pin state gates admissibility just like refcounts do: bump
+        # the pool version so HBM-blocked admission retries re-run
+        # when a pin drops.
+        self._pool.version += 1
+
+    def unpin(self, name: str) -> None:
+        entry = self._resident.get(name)
+        if entry is None or entry.pins <= 0:
+            raise ValueError(f'unpin of unpinned adapter {name!r}')
+        entry.pins -= 1
+        self._pool.version += 1
+
+    def pins(self, name: str) -> int:
+        entry = self._resident.get(name)
+        return entry.pins if entry is not None else 0
+
+    def clear(self) -> None:
+        """Evict every unpinned resident (teardown accounting)."""
+        while self.evict_lru() is not None:
+            pass

@@ -71,6 +71,17 @@ def make_handler(engine: InferenceEngine):
         # occupancy stats stay gauges.
         _COUNTERS = server_metrics.INFERENCE_COUNTER_STATS
 
+        def _adapter_kwargs(self, req):
+            """Per-tenant LoRA adapter selection: JSON ``adapter``
+            field, or the ``X-Skyt-Adapter`` header the serve LB's
+            affinity routing stamps. Continuous engines only; unknown
+            adapters are rejected by the engine with a clean error."""
+            adapter = (req.get('adapter') or
+                       self.headers.get('X-Skyt-Adapter') or '')
+            if adapter and hasattr(engine, 'register_adapter'):
+                return {'adapter': str(adapter)}
+            return {}
+
         def _trace_kwargs(self):
             """Incoming traceparent (forwarded by the serve LB) ->
             engine trace_ctx kwarg, so queue-wait/prefill/decode spans
@@ -89,6 +100,11 @@ def make_handler(engine: InferenceEngine):
                                  'model': engine.cfg.name})
             elif self.path == '/stats':
                 self._json(200, self._stats())
+            elif self.path == '/adapters':
+                # Per-adapter demand/residency (skyt serve status and
+                # the controller's working-set tracking).
+                stats_fn = getattr(engine, 'adapter_stats', None)
+                self._json(200, stats_fn() if stats_fn else {})
             elif self.path == '/metrics':
                 # Prometheus text format for external scrapers
                 # (parity: vLLM's /metrics; the serve stack's
@@ -263,6 +279,7 @@ def make_handler(engine: InferenceEngine):
                 seed=int(req.get('seed', 0)))
             if hasattr(engine, 'generate_texts'):
                 kwargs.update(self._trace_kwargs())
+                kwargs.update(self._adapter_kwargs(req))
                 tok = engine.tokenizer
                 ids = self._prompt_ids('/generate', req)
                 migrated = (self._migrated_request(
@@ -305,6 +322,7 @@ def make_handler(engine: InferenceEngine):
                 max_new_tokens=max_tokens,
                 temperature=float(req.get('temperature') or 0.0))
             kwargs.update(self._trace_kwargs())
+            kwargs.update(self._adapter_kwargs(req))
             rid = f'cmpl-{os.urandom(8).hex()}'
             model = engine.cfg.name
             if req.get('stream'):
@@ -487,6 +505,21 @@ def main(argv=None) -> int:
                         help="tensor-parallel serving, e.g. 'tensor=8' "
                              '(shards params over the local chips; how '
                              'flagship models span a slice).')
+    parser.add_argument('--lora-pages', type=int, default=None,
+                        help='device adapter page slots for multi-LoRA '
+                             'serving (continuous engine; default '
+                             '$SKYT_LORA_PAGES or 0 = disabled). Each '
+                             'resident adapter charges KV blocks from '
+                             'the shared paged pool '
+                             '(docs/multi_lora_serving.md).')
+    parser.add_argument('--lora-max-rank', type=int, default=None,
+                        help='largest adapter rank the page stack '
+                             'holds (default $SKYT_LORA_MAX_RANK or 8).')
+    parser.add_argument('--lora-dir', default=None,
+                        help='adapter registry root: every committed '
+                             'adapter under it is registered at '
+                             'startup (base-digest checked against '
+                             'the served checkpoint).')
     parser.add_argument('--role', default=None,
                         choices=['prefill', 'decode'],
                         help='disaggregated serving role (continuous '
@@ -499,6 +532,14 @@ def main(argv=None) -> int:
     if args.engine == 'continuous':
         from skypilot_tpu.inference.continuous import (
             ContinuousBatchingEngine)
+        base_digest = None
+        if args.lora_dir:
+            # Bind the served base to its content digest so adapter
+            # registration can reject mispointed registries.
+            from skypilot_tpu.serve import adapter_registry
+            ckpt = args.hf_checkpoint or args.checkpoint_dir
+            if ckpt and os.path.isdir(ckpt):
+                base_digest = adapter_registry.checkpoint_digest(ckpt)
         engine = ContinuousBatchingEngine(
             args.model,
             checkpoint_dir=args.checkpoint_dir,
@@ -513,7 +554,16 @@ def main(argv=None) -> int:
             mesh=args.mesh,
             spec_decode=args.spec_decode,
             draft_k=args.draft_k,
-            role=args.role)
+            role=args.role,
+            lora_pages=args.lora_pages,
+            lora_max_rank=args.lora_max_rank,
+            base_digest=base_digest)
+        if args.lora_dir:
+            from skypilot_tpu.serve import adapter_registry
+            names = adapter_registry.load_registry_into(
+                engine, args.lora_dir)
+            logger.info('registered %d adapters from %s: %s',
+                        len(names), args.lora_dir, names)
         if engine.role == 'prefill':
             # Warm the prefill program; drop the throwaway export.
             engine.exporter.pop(engine.prefill_and_export(

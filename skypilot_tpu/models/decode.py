@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.models.config import ModelConfig
 from skypilot_tpu.models.llama import apply_rope, rope_table_for
 from skypilot_tpu.models.quant import QTensor, weight_einsum
@@ -387,6 +388,19 @@ def init_paged_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
                         lengths=lengths, block_tables=tables)
 
 
+def _mount_lora_pages(layers: Params, lora_pages) -> Params:
+    """Ride the adapter page store through the layer scan: pages are
+    ``[L, P, ...]`` (models/lora.init_adapter_pages), so mounting them
+    in the scanned pytree hands each layer body its ``[P, ...]``
+    slice. ``None`` (no multi-LoRA) leaves the pytree — and therefore
+    the traced program — exactly as it was."""
+    if lora_pages is None:
+        return layers
+    out = dict(layers)
+    out['lora_pages'] = lora_pages
+    return out
+
+
 def _view_rows(block_tables: jax.Array, block_size: int) -> jax.Array:
     """Block tables [..., BPS] -> flat pool row per view position
     [..., BPS*block_size] (the gather index for a slot's logical
@@ -431,7 +445,10 @@ def _chunk_attention(q: jax.Array, k_view: jax.Array, v_view: jax.Array,
 
 def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
                   n_new: jax.Array, slot: jax.Array, cache: PagedKVCache,
-                  cfg: ModelConfig) -> Tuple[jax.Array, PagedKVCache]:
+                  cfg: ModelConfig,
+                  lora_pages: Optional[Params] = None,
+                  adapter_id: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, PagedKVCache]:
     """Absorb one prompt chunk for one slot into the paged pool.
 
     tokens: [1, C] int32 right-padded chunk; ``start``: positions
@@ -442,6 +459,11 @@ def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
     chunked prefill: one fixed-shape program regardless of prompt
     length). Returns (last-valid-token logits [1, V], updated cache) —
     the logits are meaningful on the final chunk of a prompt.
+
+    ``lora_pages``/``adapter_id`` (multi-LoRA serving): the stacked
+    adapter page store and this slot's page index — q/v projection
+    deltas gather the page inside the scan (page 0 = base model,
+    exact-zero delta). None compiles the exact base program.
     """
     _, c = tokens.shape
     dt = cfg.compute_dtype
@@ -460,6 +482,9 @@ def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
                            0)                                # [C]
     view_rows = _view_rows(bt_slot, bs)                      # [T]
     quantized = cache.quantized
+    layers = _mount_lora_pages(params['layers'], lora_pages)
+    adapter_ids = (jnp.reshape(adapter_id, (1,)).astype(jnp.int32)
+                   if lora_pages is not None else None)
 
     def layer(carry, scanned):
         x = carry
@@ -472,6 +497,11 @@ def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
         q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
         k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
         v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
+        if lora_pages is not None:
+            dq, dv = lora_lib.apply_lora_pages(h, lp['lora_pages'],
+                                               adapter_ids)
+            q = q + dq
+            v = v + dv
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         kf = kp.reshape(nb * bs, *kp.shape[2:])
@@ -503,11 +533,11 @@ def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
 
     if quantized:
         x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
-            layer, x, (params['layers'], cache.k, cache.v,
+            layer, x, (layers, cache.k, cache.v,
                        cache.k_scale, cache.v_scale))
     else:
         x, (k_new, v_new) = jax.lax.scan(
-            layer, x, (params['layers'], cache.k, cache.v))
+            layer, x, (layers, cache.k, cache.v))
         ks_new = vs_new = None
     logits = _lm_head(params, x, cfg)                        # [1, C, V]
     last = jnp.take(logits[0], jnp.maximum(n_new - 1, 0),
@@ -523,7 +553,9 @@ def prefill_chunk(params: Params, tokens: jax.Array, start: jax.Array,
 
 def paged_decode_step(params: Params, tokens: jax.Array,
                       cache: PagedKVCache, cfg: ModelConfig,
-                      active: Optional[jax.Array] = None
+                      active: Optional[jax.Array] = None,
+                      lora_pages: Optional[Params] = None,
+                      adapter_ids: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, PagedKVCache]:
     """One autoregressive step over the paged pool. tokens: [B] int32.
 
@@ -539,7 +571,8 @@ def paged_decode_step(params: Params, tokens: jax.Array,
     block (id 0).
     """
     logits, new_cache = paged_verify_step(
-        params, tokens[:, None], cache, cfg, active=active)
+        params, tokens[:, None], cache, cfg, active=active,
+        lora_pages=lora_pages, adapter_ids=adapter_ids)
     new_cache = dataclasses.replace(
         new_cache,
         lengths=cache.lengths + (jnp.ones_like(cache.lengths)
@@ -551,7 +584,9 @@ def paged_decode_step(params: Params, tokens: jax.Array,
 def paged_verify_step(params: Params, tokens: jax.Array,
                       cache: PagedKVCache, cfg: ModelConfig,
                       active: Optional[jax.Array] = None,
-                      n_input: Optional[jax.Array] = None
+                      n_input: Optional[jax.Array] = None,
+                      lora_pages: Optional[Params] = None,
+                      adapter_ids: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, PagedKVCache]:
     """Process a Q-token window per slot in ONE program (speculative
     verify; Q == 1 is plain decode). tokens: [B, Q] int32 — position
@@ -599,6 +634,9 @@ def paged_verify_step(params: Params, tokens: jax.Array,
     quantized = cache.quantized
     impl = cfg.decode_attention_impl or cfg.attention_impl
     block_k = cfg.paged_block_k or None
+    layers = _mount_lora_pages(params['layers'], lora_pages)
+    if lora_pages is not None and adapter_ids is None:
+        adapter_ids = jnp.zeros((b,), jnp.int32)
 
     def layer(carry, scanned):
         x = carry
@@ -611,6 +649,11 @@ def paged_verify_step(params: Params, tokens: jax.Array,
         q = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wq'], dt)
         k = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wk'], dt)
         v = weight_einsum('bsd,dhk->bshk', h, lp['attn']['wv'], dt)
+        if lora_pages is not None:
+            dq, dv = lora_lib.apply_lora_pages(
+                h, lp['lora_pages'], adapter_ids)
+            q = q + dq
+            v = v + dv
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         kf = kp.reshape(nb * bs, *kp.shape[2:])
@@ -645,11 +688,11 @@ def paged_verify_step(params: Params, tokens: jax.Array,
 
     if quantized:
         x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
-            layer, x, (params['layers'], cache.k, cache.v,
+            layer, x, (layers, cache.k, cache.v,
                        cache.k_scale, cache.v_scale))
     else:
         x, (k_new, v_new) = jax.lax.scan(
-            layer, x, (params['layers'], cache.k, cache.v))
+            layer, x, (layers, cache.k, cache.v))
         ks_new = vs_new = None
     logits = _lm_head(params, x, cfg)                        # [B, Q, V]
     new_cache = PagedKVCache(

@@ -1,0 +1,203 @@
+"""Content-addressed LoRA adapter artifacts (the serving registry).
+
+An adapter directory is a tiny checkpoint: digest-named ``.npy``
+shards (one per A/B matrix pytree leaf) committed under the same
+``data/ckpt_manifest.py`` protocol real checkpoints use — so adapters
+ride the existing transfer machinery (fanout peer pulls, incremental
+refresh, integrity quarantine) with zero new wire formats. The
+manifest's ``adapter`` payload carries what serving must know before
+loading a single byte: the adapter's name, rank, alpha, and the
+content digest of the BASE checkpoint it was trained against.
+
+That last field is the contract: an engine serving base ``X`` refuses
+an adapter trained against base ``Y`` at registration time
+(``ContinuousBatchingEngine.register_adapter``), so a mispointed
+registry fails loudly instead of decoding garbage for one tenant.
+
+Layout (one directory per adapter under a registry root)::
+
+    <root>/<name>/
+        wq_a-<sha12>.npy  wq_b-<sha12>.npy
+        wv_a-<sha12>.npy  wv_b-<sha12>.npy
+        MANIFEST.skyt.json     # commit marker, adapter metadata
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu.data import ckpt_manifest
+from skypilot_tpu.utils import log
+
+logger = log.init_logger(__name__)
+
+ADAPTER_LEAVES = ('wq_a', 'wq_b', 'wv_a', 'wv_b')
+
+
+def params_digest(params: Any) -> str:
+    """Content digest of a params pytree (base-model identity): sha256
+    over every leaf's raw bytes in sorted key order. The in-process
+    twin of hashing a checkpoint directory — small models and tests
+    can bind adapters to a base without a directory on disk."""
+    import jax
+    sha = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+        sha.update(str(path).encode())
+        sha.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return sha.hexdigest()
+
+
+def checkpoint_digest(root: str) -> str:
+    """Content digest of a checkpoint directory. A committed
+    ``MANIFEST.skyt.json`` is authoritative (digest of its canonical
+    payload — what the transfer engine already verifies shard-by-
+    shard); otherwise hash the weight/config files directly."""
+    payload = ckpt_manifest.read(root)
+    if payload is not None:
+        import json
+        blob = json.dumps(payload, sort_keys=True,
+                          separators=(',', ':')).encode()
+        return hashlib.sha256(blob).hexdigest()
+    sha = hashlib.sha256()
+    names = sorted(
+        name for name in os.listdir(root)
+        if name.endswith(('.safetensors', '.json', '.npz'))
+        and ckpt_manifest.TMP_INFIX not in name)
+    for name in names:
+        entry = ckpt_manifest.hash_file(os.path.join(root, name))
+        sha.update(f'{name}:{entry["sha256"]}:{entry["size"]}'.encode())
+    return sha.hexdigest()
+
+
+def _save_leaf(directory: str, key: str, array: np.ndarray) -> str:
+    """Write one leaf as a digest-named .npy shard; returns the shard
+    file name. Content-addressed: re-exporting identical weights
+    reuses the same name, so incremental transfers move nothing."""
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(array))
+    data = buf.getvalue()
+    digest = hashlib.sha256(data).hexdigest()[:12]
+    name = f'{key}-{digest}.npy'
+    final = os.path.join(directory, name)
+    if not os.path.exists(final):
+        tmp = f'{final}{ckpt_manifest.TMP_INFIX}.{os.getpid()}'
+        with open(tmp, 'wb') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    return name
+
+
+def export_adapter(root: str, name: str, lora: Any, *,
+                   alpha: float, base_digest: str,
+                   step: Optional[int] = None,
+                   extra_meta: Optional[Dict[str, Any]] = None) -> str:
+    """Commit adapter ``name`` under registry ``root``: digest-named
+    A/B shards + the manifest commit marker. Returns the adapter
+    directory. Stale shards from a previous export of this name are
+    removed BEFORE the new manifest commits (a crash in between leaves
+    the old manifest pointing at old shards — still consistent)."""
+    if not name or '/' in name or name.startswith('.'):
+        raise ValueError(f'bad adapter name {name!r}')
+    directory = os.path.join(os.path.expanduser(root), name)
+    os.makedirs(directory, exist_ok=True)
+    host = {key: np.asarray(lora[key]) for key in ADAPTER_LEAVES}
+    rank = int(host['wq_a'].shape[-1])
+    files = {key: _save_leaf(directory, key, host[key])
+             for key in ADAPTER_LEAVES}
+    keep = set(files.values()) | {ckpt_manifest.MANIFEST_NAME}
+    for existing in os.listdir(directory):
+        if existing not in keep and \
+                ckpt_manifest.TMP_INFIX not in existing:
+            os.unlink(os.path.join(directory, existing))
+    meta: Dict[str, Any] = {
+        'name': name,
+        'base_digest': base_digest,
+        'rank': rank,
+        'alpha': float(alpha),
+        'files': files,
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    payload = ckpt_manifest.build(directory, step=step,
+                                  extra={'adapter': meta})
+    ckpt_manifest.write(directory, payload)
+    return directory
+
+
+def load_adapter(directory: str, *,
+                 expect_base_digest: Optional[str] = None
+                 ) -> Tuple[str, Dict[str, np.ndarray],
+                            Dict[str, Any]]:
+    """Load one committed adapter: ``(name, lora_pytree, meta)``.
+    Raises on a missing/torn manifest, shard digest mismatches
+    (corrupt or half-transferred copies never load), and — when
+    ``expect_base_digest`` is given — a base-checkpoint mismatch."""
+    payload = ckpt_manifest.read(directory)
+    if payload is None:
+        raise FileNotFoundError(
+            f'{directory} has no committed adapter manifest')
+    meta = payload.get('adapter')
+    if not isinstance(meta, dict) or 'files' not in meta:
+        raise ValueError(f'{directory} manifest has no adapter payload')
+    if expect_base_digest and meta.get('base_digest') and \
+            meta['base_digest'] != expect_base_digest:
+        raise ValueError(
+            f'adapter {meta.get("name")!r} was trained against base '
+            f'{meta["base_digest"][:12]}...; this deployment serves '
+            f'{expect_base_digest[:12]}... (re-export against the '
+            f'served base)')
+    bad = ckpt_manifest.verify(directory, payload)
+    if bad:
+        raise ValueError(
+            f'adapter shards failed verification in {directory}: '
+            f'{[s["path"] for s in bad]}')
+    lora = {}
+    for key in ADAPTER_LEAVES:
+        path = os.path.join(directory, meta['files'][key])
+        lora[key] = np.load(path)
+    return str(meta.get('name') or
+               os.path.basename(directory.rstrip('/'))), lora, meta
+
+
+def scan_registry(root: str) -> List[str]:
+    """Adapter directories with committed manifests under ``root``
+    (sorted by name; uncommitted/garbage subdirs are skipped)."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in sorted(os.listdir(root)):
+        directory = os.path.join(root, name)
+        if os.path.isdir(directory) and \
+                os.path.exists(ckpt_manifest.manifest_path(directory)):
+            out.append(directory)
+    return out
+
+
+def load_registry_into(engine: Any, root: str) -> List[str]:
+    """Register every committed adapter under ``root`` with a
+    continuous engine (base-digest checked twice: load_adapter against
+    the engine's digest, register_adapter as the backstop). Returns
+    the registered names; individually corrupt adapters are skipped
+    with a warning — one bad tenant must not take down the fleet."""
+    names = []
+    expect = getattr(engine, 'base_digest', '') or None
+    for directory in scan_registry(root):
+        try:
+            name, lora, meta = load_adapter(
+                directory, expect_base_digest=expect)
+            engine.register_adapter(
+                name, lora, alpha=float(meta.get('alpha', 16.0)),
+                base_digest=meta.get('base_digest') or None)
+            names.append(name)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('skipping adapter %s: %s: %s', directory,
+                           type(e).__name__, e)
+    return names

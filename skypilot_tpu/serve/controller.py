@@ -72,6 +72,10 @@ class ServeController:
                     instance_prices=prices)
         self._configure_autoscaler()
         self._handled_preemptions: set = set()
+        # Whether the last published adapter-demand payload was
+        # non-empty: lets a drained working set be cleared exactly once
+        # instead of rewriting an empty blob every tick.
+        self._had_adapter_demand = False
         self._hydrate_from_telemetry()
 
     def _hydrate_from_telemetry(self) -> None:
@@ -303,6 +307,8 @@ class ServeController:
         from skypilot_tpu.serve.load_balancer import LoadStats
         stats = (self.lb.load_stats() if self.lb is not None else
                  LoadStats(qps=0.0, queue_length=0, window_seconds=1.0))
+        if self.lb is not None:
+            self._publish_adapter_demand()
         decisions = self.autoscaler.evaluate(stats, replicas)
         self._apply(decisions)
         replicas = serve_state.list_replicas(self.service_name)
@@ -311,6 +317,30 @@ class ServeController:
             self._sync_lb(replicas)
         self._update_service_status(replicas)
         self._publish_fanout_metrics(replicas)
+
+    def _publish_adapter_demand(self) -> None:
+        """Multi-LoRA serving: fold the LB's per-adapter demand windows
+        into the serve DB each tick (adapter -> {qps, replica,
+        updated_at}) and hand the working-set size to the SLO
+        autoscaler. `status` runs in other processes and can't read
+        the LB's memory (docs/multi_lora_serving.md)."""
+        demand = self.lb.adapter_demand()
+        if hasattr(self.autoscaler, 'observe_adapter_demand'):
+            self.autoscaler.observe_adapter_demand(demand)
+        if not demand and not self._had_adapter_demand:
+            return
+        sticky = self.lb.adapter_sticky_snapshot()
+        now = self._clock()
+        payload = {name: {'qps': round(qps, 4),
+                          'replica': sticky.get(name),
+                          'updated_at': now}
+                   for name, qps in sorted(demand.items())}
+        self._had_adapter_demand = bool(payload)
+        try:
+            serve_state.set_adapter_demand(self.service_name, payload)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('Service %s: adapter-demand publish failed',
+                             self.service_name)
 
     def _publish_fanout_metrics(
             self, replicas: List[serve_state.ReplicaRecord]) -> None:

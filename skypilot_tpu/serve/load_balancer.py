@@ -105,6 +105,18 @@ class LoadBalancer:
         # Disaggregated fleets: replica_id -> 'prefill' | 'decode'
         # (absent = colocated; see sync_replicas).
         self._roles: Dict[int, str] = {}
+        # -- multi-LoRA adapter affinity (docs/multi_lora_serving.md) --
+        # adapter name -> replica the adapter's traffic last landed on.
+        # LRU-bounded: the table only has to cover the working set of
+        # concurrently-hot adapters, not every tenant ever seen.
+        self._adapter_sticky: 'collections.OrderedDict[str, int]' = \
+            collections.OrderedDict()
+        self._adapter_sticky_max = env_registry.get_int(
+            'SKYT_LORA_LB_STICKY', default=1024)
+        # adapter name -> request-arrival timestamps inside the QPS
+        # window (same ring discipline as _request_times); feeds the
+        # controller's per-adapter demand signal.
+        self._adapter_times: Dict[str, collections.deque] = {}
 
     # -- stats ---------------------------------------------------------
 
@@ -117,6 +129,56 @@ class LoadBalancer:
             while (self._request_times and
                    self._request_times[0] < now - self._window):
                 self._request_times.popleft()
+
+    def record_adapter_request(self, adapter: str) -> None:
+        """Count one arrival against ``adapter``'s demand window (same
+        monotonic ring as the fleet QPS window)."""
+        now = time.monotonic()
+        with self._lock:
+            ring = self._adapter_times.get(adapter)
+            if ring is None:
+                ring = self._adapter_times[adapter] = collections.deque()
+            ring.append(now)
+            while ring and ring[0] < now - self._window:
+                ring.popleft()
+
+    def adapter_demand(self) -> Dict[str, float]:
+        """Per-adapter request rate (requests/s over the QPS window) —
+        what the controller publishes and the SLO autoscaler sizes the
+        adapter working set from. Idle adapters age out of the map."""
+        now = time.monotonic()
+        out: Dict[str, float] = {}
+        with self._lock:
+            for adapter in list(self._adapter_times):
+                ring = self._adapter_times[adapter]
+                while ring and ring[0] < now - self._window:
+                    ring.popleft()
+                if not ring:
+                    del self._adapter_times[adapter]
+                    continue
+                out[adapter] = len(ring) / self._window
+        return out
+
+    def adapter_sticky_snapshot(self) -> Dict[str, int]:
+        """adapter -> the replica its traffic last landed on."""
+        with self._lock:
+            return dict(self._adapter_sticky)
+
+    def note_adapter_route(self, adapter: str, replica_id: int
+                           ) -> Tuple[str, Optional[str]]:
+        """Record where ``adapter``'s request landed. Returns
+        ``(outcome, evicted)``: outcome is ``'hit'`` when the request
+        stayed on the adapter's sticky replica (whose page pool then
+        already holds the pages resident) and ``'miss'`` on first
+        sight or a load-forced move; ``evicted`` names an adapter the
+        LRU bound pushed out of the sticky table, if any."""
+        with self._lock:
+            prev = self._adapter_sticky.pop(adapter, None)
+            self._adapter_sticky[adapter] = replica_id
+            evicted = None
+            if len(self._adapter_sticky) > self._adapter_sticky_max:
+                evicted, _ = self._adapter_sticky.popitem(last=False)
+        return ('hit' if prev == replica_id else 'miss'), evicted
 
     def begin(self, replica_id: int) -> None:
         with self._lock:
@@ -256,6 +318,9 @@ class LoadBalancer:
                           self._ejected_until):
                 for rid in [r for r in table if r not in live]:
                     del table[rid]
+            for adapter in [a for a, rid in self._adapter_sticky.items()
+                            if rid not in live]:
+                del self._adapter_sticky[adapter]
 
     def two_hop_ready(self) -> bool:
         """Both specialized fleets have members: generate traffic takes
@@ -639,6 +704,26 @@ class _AsyncProxy:
                      if request.ttfb_ms is not None else None),
             ejected=len(self.lb.ejected_snapshot()) or None)
 
+    @staticmethod
+    def _adapter_of(request: _Request) -> Optional[str]:
+        """Which LoRA adapter a request targets, if any: the
+        ``X-Skyt-Adapter`` header (cheap, preferred) or an ``adapter``
+        field in a JSON body. Body parsing is gated on a byte sniff so
+        adapter-less traffic never pays for a JSON decode."""
+        name = request.header('X-Skyt-Adapter')
+        if name:
+            return name
+        if request.body and b'"adapter"' in bytes(request.body[:1024]):
+            import json
+            try:
+                obj = json.loads(bytes(request.body))
+            except (ValueError, UnicodeDecodeError):
+                return None
+            name = obj.get('adapter') if isinstance(obj, dict) else None
+            if isinstance(name, str) and name:
+                return name
+        return None
+
     async def _proxy_one(self, request: _Request,
                          client: asyncio.StreamWriter) -> bool:
         """Proxy one request; returns whether the client connection is
@@ -683,6 +768,16 @@ class _AsyncProxy:
             # delta pull instead of a full one.
             affinity = (hash(bytes(request.body[:256]))
                         if request.body else None)
+        adapter = self._adapter_of(request)
+        if adapter is not None:
+            # Adapter affinity beats prefix affinity: all traffic for
+            # one fine-tune rendezvous-hashes to the same replica,
+            # whose AdapterPagePool then keeps the pages resident (a
+            # pool hit per request instead of a host refetch). Load
+            # still wins — _affinity_pick's 2x guard hands a hot
+            # adapter's overflow to the p2c policy.
+            affinity = hash(('skyt-lora', adapter))
+            lb.record_adapter_request(adapter)
         try:
             for _ in range(MAX_ATTEMPTS):
                 entry = lb.select(exclude=tried, role=role,
@@ -691,6 +786,15 @@ class _AsyncProxy:
                     break
                 replica_id, url, _weight = entry
                 tried.add(replica_id)
+                if adapter is not None:
+                    outcome, bumped = lb.note_adapter_route(
+                        adapter, replica_id)
+                    (metrics.LORA_ADAPTER_HITS if outcome == 'hit'
+                     else metrics.LORA_ADAPTER_MISSES).inc(
+                         adapter=adapter)
+                    if bumped is not None:
+                        metrics.LORA_ADAPTER_EVICTIONS.inc(
+                            adapter=bumped)
                 pool = self._pool_for(url)
                 state = _UpstreamState()
                 lb.begin(replica_id)

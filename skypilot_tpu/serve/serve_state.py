@@ -174,6 +174,14 @@ def _db():
             common_utils.add_column_if_missing(
                 conn, 'ALTER TABLE services ADD COLUMN '
                 'controller_server_id TEXT')
+        if 'adapter_demand' not in cols:
+            # Per-adapter demand JSON published by the controller each
+            # tick (multi-LoRA serving): adapter -> {qps, replica,
+            # updated_at}. `status` runs in other processes and can't
+            # read the LB's in-memory demand windows.
+            common_utils.add_column_if_missing(
+                conn, 'ALTER TABLE services ADD COLUMN '
+                'adapter_demand TEXT')
         if 'controller_pid_created' not in cols:
             # Process start time disambiguates pid reuse (container
             # restarts reset the pid namespace) — same fence as
@@ -270,6 +278,12 @@ class ServiceRecord:
             row['controller_server_id'])
         self.controller_pid_created: Optional[float] = (
             row['controller_pid_created'])
+        try:
+            self.adapter_demand: Dict[str, Any] = (
+                json.loads(row['adapter_demand'])
+                if row['adapter_demand'] else {})
+        except (ValueError, TypeError):
+            self.adapter_demand = {}
 
     @property
     def endpoint(self) -> Optional[str]:
@@ -298,6 +312,7 @@ class ServiceRecord:
             'fleet_p99_ms': fleet_p99,
             'warm_replicas': sum(1 for r in replicas
                                  if r.status == ReplicaStatus.WARM),
+            'adapter_demand': self.adapter_demand,
             'replicas': [r.to_dict() for r in replicas],
         }
 
@@ -645,6 +660,18 @@ def set_replica_lb_state(service_name: str,
             'WHERE service_name = ? AND replica_id = ?',
             (state.get('ewma_ms'), int(ejected), until,
              service_name, replica_id))
+    conn.commit()
+
+
+def set_adapter_demand(service_name: str,
+                       demand: Dict[str, Any]) -> None:
+    """Persist per-adapter demand (adapter -> {qps, replica,
+    updated_at}) published by the controller each tick — the
+    cross-process surface behind `skyt serve status`'s adapter table
+    and the SLO autoscaler's working-set sizing."""
+    conn = _db()
+    conn.execute('UPDATE services SET adapter_demand = ? WHERE name = ?',
+                 (json.dumps(demand), service_name))
     conn.commit()
 
 

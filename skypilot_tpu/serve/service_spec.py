@@ -68,6 +68,7 @@ class ServiceSpec:
         qps_window_seconds: float = DEFAULT_QPS_WINDOW_SECONDS,
         base_ondemand_fallback_replicas: int = 0,
         dynamic_ondemand_fallback: bool = False,
+        adapters_per_replica: Optional[int] = None,
         load_balancing_policy: str = 'least_load',
         pool: bool = False,
     ) -> None:
@@ -150,6 +151,17 @@ class ServiceSpec:
         self.base_ondemand_fallback_replicas = int(
             base_ondemand_fallback_replicas)
         self.dynamic_ondemand_fallback = bool(dynamic_ondemand_fallback)
+        if adapters_per_replica is not None and \
+                int(adapters_per_replica) <= 0:
+            raise exceptions.InvalidSpecError(
+                'adapters_per_replica must be > 0.')
+        # Multi-LoRA working-set floor (docs/multi_lora_serving.md):
+        # how many concurrently-hot adapters one replica's page pool
+        # comfortably holds resident — the SLO autoscaler floors the
+        # fleet at ceil(active_adapters / adapters_per_replica).
+        self.adapters_per_replica = (
+            int(adapters_per_replica)
+            if adapters_per_replica is not None else None)
         self.load_balancing_policy = load_balancing_policy
         # Pool mode (parity: `sky jobs pool`, built on the serve stack):
         # workers are plain clusters — no load balancer, no HTTP probe;
@@ -229,7 +241,8 @@ class ServiceSpec:
                         'upscale_delay_seconds', 'downscale_delay_seconds',
                         'qps_window_seconds',
                         'base_ondemand_fallback_replicas',
-                        'dynamic_ondemand_fallback'):
+                        'dynamic_ondemand_fallback',
+                        'adapters_per_replica'):
                 if key in policy:
                     kwargs[key] = policy[key]
         if 'load_balancing_policy' in config:
@@ -289,6 +302,8 @@ class ServiceSpec:
                 self.base_ondemand_fallback_replicas)
         if self.dynamic_ondemand_fallback:
             policy['dynamic_ondemand_fallback'] = True
+        if self.adapters_per_replica is not None:
+            policy['adapters_per_replica'] = self.adapters_per_replica
         config['replica_policy'] = policy
         return config
 

@@ -84,6 +84,16 @@ class SLOAutoscaler(Autoscaler):
         self._last_traffic: Optional[float] = None
         self._ready_count = 0
         self._snapshot: Dict[str, Any] = {}
+        # Multi-LoRA: distinct adapters with demand inside the LB's
+        # QPS window, fed by the controller each tick
+        # (observe_adapter_demand).
+        self._adapter_working_set = 0
+
+    def observe_adapter_demand(self, demand: Dict[str, float]) -> None:
+        """Controller tick input: the per-adapter request rates the LB
+        observed. Only the working-set SIZE feeds sizing — which
+        adapters are hot is the data plane's (affinity) problem."""
+        self._adapter_working_set = len(demand)
 
     # -- sizing --------------------------------------------------------
 
@@ -172,6 +182,17 @@ class SLOAutoscaler(Autoscaler):
             # Not idle long enough: a scale-to-zero service holds at
             # least one replica while any traffic is in sight.
             required = max(1, required)
+        adapter_floor = 0
+        if (not can_zero and self._adapter_working_set and
+                getattr(self.spec, 'adapters_per_replica', None)):
+            # Adapter working-set floor (multi-LoRA): enough replicas
+            # that the hot adapters fit resident across the fleet's
+            # page pools instead of thrashing host<->HBM on every
+            # request — latency alone can't see the thrash until it is
+            # already paying cold-fetch TTFTs.
+            adapter_floor = math.ceil(self._adapter_working_set /
+                                      self.spec.adapters_per_replica)
+            required = max(required, adapter_floor)
         base, slope = self.latency_model.coefficients()
         self._snapshot = {
             'predicted_qps': predicted_qps,
@@ -183,6 +204,8 @@ class SLOAutoscaler(Autoscaler):
             'slo_attainable': (not self.latency_model.fitted or
                                base <= self.spec.target_latency_p99_ms),
             'idle_seconds': idle_for,
+            'adapter_working_set': self._adapter_working_set,
+            'adapter_floor': adapter_floor,
             'raw_target': required,
         }
         return required

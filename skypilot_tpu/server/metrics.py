@@ -307,6 +307,23 @@ DISAGG_HANDOFF = Histogram(
     'TTFT tax disaggregation pays for specialized fleets)',
     buckets=_TTFB_BUCKETS,
     labels=())
+LORA_ADAPTER_HITS = Counter(
+    'skyt_lora_adapter_hits_total',
+    'Serve LB adapter-affinity hits: requests routed to the replica '
+    'already sticky for their adapter, whose page pool then holds the '
+    'adapter resident (docs/multi_lora_serving.md)',
+    labels=('adapter',))
+LORA_ADAPTER_MISSES = Counter(
+    'skyt_lora_adapter_misses_total',
+    'Serve LB adapter-affinity misses: first sight of an adapter or a '
+    'load-forced move off its sticky replica (the new replica likely '
+    'pages the adapter in from host)',
+    labels=('adapter',))
+LORA_ADAPTER_EVICTIONS = Counter(
+    'skyt_lora_adapter_evictions_total',
+    'Adapters aged out of the LB sticky table (SKYT_LORA_LB_STICKY '
+    'LRU bound) — the affinity working set exceeded the table',
+    labels=('adapter',))
 
 # -- serve predictive autoscaling (emitted by the per-service
 # controller, which shares the service process with the LB — scraped
@@ -353,7 +370,9 @@ _AUTOSCALE_METRICS = [AUTOSCALE_PREDICTED_QPS, AUTOSCALE_PREDICTED_P99,
                       AUTOSCALE_WARM_POOL, AUTOSCALE_DECISIONS,
                       AUTOSCALE_OBSERVED_QPS]
 
-_LB_METRICS = ([LB_REQUESTS, LB_TTFB, LB_POOL_REUSE, DISAGG_HANDOFF]
+_LB_METRICS = ([LB_REQUESTS, LB_TTFB, LB_POOL_REUSE, DISAGG_HANDOFF,
+                LORA_ADAPTER_HITS, LORA_ADAPTER_MISSES,
+                LORA_ADAPTER_EVICTIONS]
                + _AUTOSCALE_METRICS)
 
 # -- storage/checkpoint data plane (incremented in-process by the
@@ -511,6 +530,9 @@ INFERENCE_COUNTER_STATS = frozenset({
     # Disaggregated serving (r18): cumulative KV migration counts;
     # kv_exports_pending stays a gauge.
     'kv_exports', 'kv_imports', 'kv_import_fallbacks',
+    # Multi-LoRA paging (r19): adapter page-pool traffic; residency
+    # and registration counts stay gauges.
+    'lora_hits', 'lora_misses', 'lora_evictions',
 })
 # Highest recovery_events row id already folded into _JOB_METRICS.
 _recovery_cursor = 0

@@ -36,12 +36,23 @@ __all__ = ['SimReport']
 #                                    (fleet.disagg prefill stage)
 #   max_intertoken_p99_ms: M      -> run-level p99 inter-token latency
 #                                    (fleet.disagg decode stage)
+#   max_adapter_cold_ttft_p99_ms: M -> p99 first-token latency of
+#                                    requests whose adapter page was
+#                                    cold (fleet.lora runs)
+#   max_base_intertoken_p99_ms: M -> p99 inter-token latency of base
+#                                    (page-0) traffic while adapters
+#                                    churn (fleet.lora runs)
+#   min_adapter_hit_fraction: f   -> adapter page hit rate floor
+#                                    (fleet.lora runs)
 _INVARIANT_KEYS = ('no_lost_requests', 'max_shed_requests',
                    'max_slo_miss_seconds', 'max_target_flips',
                    'max_final_queue', 'min_served_fraction',
                    'max_controller_faults', 'max_bucket_readers',
                    'max_time_to_weights_p99_s', 'max_ttft_p99_s',
-                   'max_intertoken_p99_ms')
+                   'max_intertoken_p99_ms',
+                   'max_adapter_cold_ttft_p99_ms',
+                   'max_base_intertoken_p99_ms',
+                   'min_adapter_hit_fraction')
 
 
 class SimReport:
@@ -139,6 +150,15 @@ class SimReport:
             elif key == 'max_intertoken_p99_ms':
                 actual = s['intertoken_p99_ms']
                 ok = actual <= bound
+            elif key == 'max_adapter_cold_ttft_p99_ms':
+                actual = s['adapter_cold_ttft_p99_ms']
+                ok = actual <= bound
+            elif key == 'max_base_intertoken_p99_ms':
+                actual = s['base_intertoken_p99_ms']
+                ok = actual <= bound
+            elif key == 'min_adapter_hit_fraction':
+                actual = s['lora_hit_fraction']
+                ok = actual >= bound
             else:  # max_controller_faults
                 actual = s['controller_faults']
                 ok = actual <= bound

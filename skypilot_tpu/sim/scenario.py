@@ -162,6 +162,20 @@ class Scenario:
                 # therefore scale-invariant.
                 block['initial_replicas'] = max(
                     1, int(round(block['initial_replicas'] * factor)))
+        lora = fleet.get('lora')
+        if lora and lora.get('n_adapters'):
+            # The adapter population scales with the fleet so
+            # per-replica page pressure (distinct working set over
+            # n_ready * pages_per_replica page capacity) — and
+            # therefore the hit/eviction behavior under test — is
+            # preserved.
+            lora['n_adapters'] = max(
+                1, int(round(lora['n_adapters'] * factor)))
+            if lora.get('hot_set'):
+                # Rotation churn (cold fetches per period) also
+                # scales, keeping per-replica fetch pressure fixed.
+                lora['hot_set'] = max(
+                    1, int(round(lora['hot_set'] * factor)))
         service = data.setdefault('service', {})
         for key in ('min_replicas', 'max_replicas',
                     'base_ondemand_fallback_replicas'):
@@ -212,6 +226,16 @@ class Scenario:
                 # mistaken for injected chaos.
                 from skypilot_tpu.utils import fault_injection
                 fault_injection.parse_spec(fault['spec'])
+        lora = self.fleet.get('lora')
+        if lora:
+            if self.fleet.get('disagg'):
+                raise ValueError(
+                    'fleet.lora and fleet.disagg cannot be combined '
+                    '(the adapter LRU models the colocated decode '
+                    'path)')
+            for key in ('n_adapters', 'pages_per_replica'):
+                if not lora.get(key):
+                    raise ValueError(f'fleet.lora needs {key!r}')
         if self.fleet.get('disagg'):
             service = data.get('service', {})
             if service.get('target_ttft_p99_ms') is None or \

@@ -115,6 +115,17 @@ def main(argv=None) -> int:
     parser.add_argument('--export-dir', default=None,
                         help='write the finetuned model back as an '
                              'HF-layout checkpoint (LoRA merged)')
+    parser.add_argument('--adapter-export-dir', default=None,
+                        help='LoRA mode: also export the UNMERGED '
+                             'adapter as a content-addressed manifest '
+                             'artifact under this registry root '
+                             '(digest-named A/B shards + base-model '
+                             'digest), servable by the multi-LoRA '
+                             'engine (docs/multi_lora_serving.md)')
+    parser.add_argument('--adapter-name', default=None,
+                        help='registry name for --adapter-export-dir '
+                             '(default: the export dir basename or '
+                             '"adapter")')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=50)
     parser.add_argument('--log-every', type=int, default=10)
@@ -199,6 +210,26 @@ def main(argv=None) -> int:
                                'step': step + 1})
         final_params = lora_lib.merge(
             lora_lib.attach(params, lora_params))
+        if args.adapter_export_dir and is_main:
+            # The UNMERGED adapter, pinned to its base: the multi-LoRA
+            # engine rejects this artifact against any other base
+            # checkpoint (adapter_registry base_digest contract).
+            from skypilot_tpu.serve import adapter_registry
+            adapter_name = (args.adapter_name or
+                            (os.path.basename(
+                                os.path.normpath(args.export_dir))
+                             if args.export_dir else 'adapter'))
+            exported = adapter_registry.export_adapter(
+                args.adapter_export_dir, adapter_name,
+                jax.device_get(lora_params),
+                alpha=lora_lib.DEFAULT_ALPHA,
+                base_digest=adapter_registry.checkpoint_digest(
+                    args.hf_checkpoint),
+                step=args.steps,
+                extra_meta={'hf_checkpoint': args.hf_checkpoint})
+            print(json.dumps({'adapter_exported': exported,
+                              'adapter_name': adapter_name,
+                              'rank': args.lora_rank}), flush=True)
     else:
         from skypilot_tpu.train.step import (
             TrainHParams, create_train_state_from_params,

@@ -465,6 +465,27 @@ DECLARATIONS: List[EnvVar] = _decl([
      'are retried with Retry-After-floored backoff, corrupt blocks '
      're-pulled from scratch, this many times before the decode side '
      'gives up and re-prefills.'),
+    ('SKYT_LORA_PAGES', 'int', 0,
+     'Device adapter page slots in the continuous engine (S-LoRA '
+     'unified paging: each resident adapter charges KV blocks from '
+     'the shared pool); 0 = multi-LoRA serving disabled '
+     '(docs/multi_lora_serving.md).'),
+    ('SKYT_LORA_MAX_RANK', 'int', 8,
+     'Largest adapter rank the device page stack holds (lower ranks '
+     'are zero-padded; registration rejects adapters above it).'),
+    ('SKYT_LORA_MAX_ACTIVE', 'int', 0,
+     'Per-adapter concurrent decode-slot quota; an adapter at its '
+     'cap queues in its own DRR lane without blocking others '
+     '(0 = unlimited).'),
+    ('SKYT_LORA_DRR_QUANTUM', 'int', 4,
+     'Deficit-round-robin admission quantum in KV blocks per adapter '
+     'lane per round (mirrors SKYT_DB_DRR_QUANTUM one layer down: '
+     'a hot adapter queues behind itself, not in front of the other '
+     'tenants).'),
+    ('SKYT_LORA_LB_STICKY', 'int', 1024,
+     'LRU bound on the serve LB adapter-affinity sticky table '
+     '(adapter -> last replica); overflow counts as '
+     'skyt_lora_adapter_evictions_total.'),
 
     # -- provisioning -----------------------------------------------
     ('SKYT_K8S_FAKE', 'bool', False,

@@ -206,6 +206,7 @@ _LIBRARY_SCALE = {
     'weight_rollout_surge': 0.05,
     'cold_start_convoy': 0.05,
     'disagg_saturation': 0.05,
+    'adapter_churn': 0.05,
 }
 
 
@@ -247,6 +248,57 @@ def test_disagg_decode_saturation_grows_only_decode_fleet():
     # prefill fleet and its queue never see the shift.
     assert report.summary['ttft_p99_s'] <= 0.35
     assert run_scenario(scaled).digest() == report.digest()
+
+
+def _churn_probe(rotate_s):
+    """Small colocated fleet whose paged-adapter LRU (44 fleet pages)
+    covers all but the deepest tail of a steep 50-adapter Zipf — so a
+    frozen popularity misses almost never, and every extra miss is
+    attributable to the hot head rotating into the evicted region."""
+    return scenario_lib.Scenario.from_dict({
+        'name': 'churn_probe', 'seed': 7,
+        'duration_s': 3600, 'tick_s': 10,
+        'service': {'min_replicas': 4, 'max_replicas': 4,
+                    'target_latency_p99_ms': 200},
+        'fleet': {'initial_replicas': 4, 'base_latency_ms': 40,
+                  'latency_slope_ms': 8, 'provision_delay_s': 30,
+                  'resume_delay_s': 5, 'max_queue_per_replica': 500,
+                  'lora': {'n_adapters': 50, 'pages_per_replica': 11,
+                           'zipf_s': 2.0, 'hot_set': 10,
+                           'hot_rotate_period_s': rotate_s,
+                           'cold_fetch_ms': 100}},
+        'tenants': [{'name': 't', 'rate': {'qps': 50}}],
+    })
+
+
+def test_adapter_churn_rotation_drives_cold_fetches():
+    """The churn drill's mechanism check: rotating the Zipf head into
+    the LRU's evicted region must force strictly more cold fetches
+    and evictions than a frozen popularity — the misses ARE the
+    churn, not sampling noise — and the cold-TTFT series only exists
+    when misses happened. The run replays bit-identically (the
+    adapter draw stream is seeded)."""
+    rotating = run_scenario(_churn_probe(rotate_s=30))
+    frozen = run_scenario(_churn_probe(rotate_s=0))
+    assert rotating.summary['lora_misses'] > frozen.summary[
+        'lora_misses'] * 1.5, (rotating.summary, frozen.summary)
+    assert rotating.summary['lora_evictions'] > frozen.summary[
+        'lora_evictions']
+    assert rotating.summary['lora_hit_fraction'] < frozen.summary[
+        'lora_hit_fraction']
+    assert rotating.summary['adapter_cold_ttft_p99_ms'] > \
+        rotating.summary['base_intertoken_p99_ms']
+    assert run_scenario(_churn_probe(rotate_s=30)).digest() == \
+        rotating.digest()
+
+
+def test_lora_and_disagg_blocks_are_mutually_exclusive():
+    data = scenario_lib.load_library('adapter_churn').to_dict()
+    data['fleet']['disagg'] = {'prefill': {}, 'decode': {}}
+    data['service']['target_ttft_p99_ms'] = 300
+    data['service']['target_intertoken_p99_ms'] = 50
+    with pytest.raises(ValueError, match='cannot be combined'):
+        scenario_lib.Scenario.from_dict(data)
 
 
 def test_unknown_invariant_key_fails_loudly():
