@@ -1,0 +1,417 @@
+#!/usr/bin/env python3
+"""Live-sync GRPO rollout pipeline bench: delta weight refresh with
+generation running vs stop-the-world weight sync (ISSUE 20).
+
+CPU-only; no cloud credentials. Four arms over the same tiny-model
+rollout fleet (2 continuous-batching engines + 1 GRPO learner), each
+with its compile/warmup waves off the clock:
+
+1. ``flat_out_ceiling`` — generation only, no learner coupling, no
+   weight sync ever: the tokens/s the engines can emit (informational;
+   not an acceptance denominator).
+2. ``live`` — the real pipeline (``jobs/rl_pipeline.py``): the learner
+   commits delta manifests, replicas pull per-shard and swap at a step
+   boundary, staggered so generation never stops fleet-wide.
+   Weight-sync latency = the sync OPERATION (delta pull + in-place
+   swap) on one replica while the rest of the fleet keeps generating.
+3. ``no_refresh`` — the same pipeline with refreshes disabled: the
+   steady rollout tokens/s denominator for the >=90% claim (same
+   learner coupling, no sync cost, unbounded staleness).
+4. ``stop_the_world`` — the on-policy baseline every naive RL loop
+   ships: on each learner commit the WHOLE fleet halts (in-flight
+   waves drain), every replica pulls the FULL weight tree and swaps in
+   drain mode, then generation resumes. Weight-sync latency = the
+   fleet-wide generation-blocked window per sync.
+
+Acceptance (ISSUE 20): live weight-sync p50 at least 3x better than
+stop-the-world; live rollout tokens/s >= 90% of the no-refresh
+reference; max consumed staleness <= the max_staleness valve bound.
+
+Emits one JSON document on stdout; run_benches.sh tees it into
+``BENCH_rl_<suffix>.json`` and the tables land in PERF.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPLICAS = 2
+STEPS = 24
+PROMPTS_PER_STEP = 4
+GROUP_SIZE = 2
+PROMPT_LEN = 6
+MAX_NEW_TOKENS = 48
+MAX_STALENESS = 12
+QUEUE_BATCHES = 3
+
+
+def pct(samples, p):
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(p * len(s)))]
+
+
+def make_engines(cfg, params):
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    return [
+        ContinuousBatchingEngine(
+            cfg=cfg, params=params,
+            max_slots=PROMPTS_PER_STEP * GROUP_SIZE,
+            max_len=PROMPT_LEN + MAX_NEW_TOKENS + 1)
+        for _ in range(REPLICAS)
+    ]
+
+
+def make_waves(cfg):
+    import jax
+    import numpy as np
+    from skypilot_tpu.train import grpo
+    pool, pool_targets = grpo.make_prompts(
+        jax.random.key(42), 16, PROMPT_LEN, cfg.vocab_size)
+    pool = np.asarray(pool)
+    pool_targets = np.asarray(pool_targets)
+
+    def wave(rank, seq):
+        p, g = PROMPTS_PER_STEP, GROUP_SIZE
+        idx = ((seq * REPLICAS + rank) * p + np.arange(p)) % len(pool)
+        return (np.repeat(pool[idx], g, axis=0),
+                np.repeat(pool_targets[idx], g), g)
+
+    return wave
+
+
+def run_wave(engine, tiled, seq, rank):
+    from skypilot_tpu.train import grpo
+    generated, version = grpo.engine_rollouts(
+        engine, [list(map(int, row)) for row in tiled],
+        max_new_tokens=MAX_NEW_TOKENS, temperature=1.0,
+        step=(seq * 131 + rank))
+    return generated, version
+
+
+def bench_reference(cfg):
+    """Arm 1: the fleet generates flat out, no weight sync — the
+    steady tokens/s ceiling."""
+    import numpy as np
+    from skypilot_tpu.train import grpo
+    learner = grpo.GrpoLearner(cfg, learning_rate=1e-3)
+    engines = make_engines(cfg, learner.params)
+    wave = make_waves(cfg)
+    tokens = [0] * REPLICAS
+    waves_per_replica = STEPS  # comparable wall time to arm 2
+    warm = threading.Barrier(REPLICAS + 1)
+
+    def worker(rank):
+        tiled, _, _ = wave(rank, 0)
+        run_wave(engines[rank], tiled, 0, rank)  # compile, untimed
+        warm.wait()
+        for seq in range(1, waves_per_replica + 1):
+            tiled, _, _ = wave(rank, seq)
+            generated, _ = run_wave(engines[rank], tiled, seq, rank)
+            tokens[rank] += int(np.asarray(generated).size)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(REPLICAS)]
+    for t in threads:
+        t.start()
+    warm.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    for e in engines:
+        e.shutdown()
+    return {'rollout_tokens': sum(tokens),
+            'rollout_tokens_per_s': sum(tokens) / elapsed,
+            'elapsed_s': round(elapsed, 3)}
+
+
+WARMUP_STEPS = 2
+
+
+def timed_pipeline_run(pipe):
+    """Drive a built pipeline: consume WARMUP_STEPS off the clock
+    (engine + learner jit compiles, the cold first refresh), then time
+    STEPS more. Returns (elapsed_s, produced_tokens, summary)."""
+    pipe._build()
+    for worker in pipe.workers:
+        worker.start()
+    try:
+        done = 0
+        while done < WARMUP_STEPS:
+            if pipe._consume_one(timeout=30.0):
+                done += 1
+        tokens0 = sum(w.tokens for w in pipe.workers)
+        t0 = time.monotonic()
+        done = 0
+        while done < STEPS:
+            if pipe._consume_one(timeout=30.0):
+                done += 1
+        elapsed = time.monotonic() - t0
+        tokens = sum(w.tokens for w in pipe.workers) - tokens0
+    finally:
+        for worker in pipe.workers:
+            worker.stop()
+        for worker in pipe.workers:
+            worker.engine.shutdown()
+    return elapsed, tokens, pipe.summary(elapsed)
+
+
+def bench_live(cfg, root):
+    """Arm 2: the real pipeline — staggered in-place delta refresh.
+
+    Weight-sync latency here is the sync OPERATION (delta pull + swap
+    at the next step boundary): the window one replica spends inside a
+    refresh while the rest of the fleet — and this replica's own
+    in-flight requests, until the boundary — keep generating. The
+    stop-the-world arm's comparable window blocks the whole fleet."""
+    from skypilot_tpu.jobs.rl_pipeline import PipelineConfig, RLPipeline
+
+    class _OpLatencyPipeline(RLPipeline):
+        def _build(self):
+            super()._build()
+            for worker in self.workers:
+                # Detach the commit-wall map: refresh_latencies then
+                # time the pull+swap op itself, comparable to the STW
+                # window.
+                worker.publish_wall = {}
+                # Warm the replica's local copy (the distributed
+                # rollout role full-pulls before serving, too): the
+                # timed refreshes are deltas, not cold transfers.
+                self.store.pull(worker.pull_dest)
+
+    pcfg = PipelineConfig(rollout_replicas=REPLICAS,
+                          max_staleness=MAX_STALENESS,
+                          queue_batches=QUEUE_BATCHES,
+                          refresh_mode='step',
+                          refresh_concurrency=1,
+                          store=os.path.join(root, 'live'))
+    pipe = _OpLatencyPipeline(cfg, pcfg, steps=STEPS,
+                      prompts_per_step=PROMPTS_PER_STEP,
+                      group_size=GROUP_SIZE, prompt_len=PROMPT_LEN,
+                      max_new_tokens=MAX_NEW_TOKENS, num_prompts=16,
+                      max_slots=PROMPTS_PER_STEP * GROUP_SIZE)
+    elapsed, tokens, summary = timed_pipeline_run(pipe)
+    return {
+        'rollout_tokens': tokens,
+        'rollout_tokens_per_s': tokens / elapsed,
+        'elapsed_s': round(elapsed, 3),
+        'weight_sync_p50_s': round(summary['refresh_p50_s'], 4),
+        'weight_sync_p99_s': round(summary['refresh_p99_s'], 4),
+        'refreshes': summary['refreshes'],
+        'staleness_max': summary['staleness_max'],
+        'staleness_mean': round(summary['staleness_mean'], 3),
+        'valve_waits': summary['valve_waits'],
+        'batches_unretired': summary['batches_unretired'],
+    }
+
+
+def bench_no_refresh(cfg, root):
+    """Arm 2a: the SAME pipeline with weight sync disabled — the
+    steady pipeline tokens/s denominator for the >=90%-through-refresh
+    claim. (The flat-out arm above is learner-free, so it measures the
+    engines, not the pipeline; this arm keeps the learner coupling and
+    removes only the syncs.)"""
+    from skypilot_tpu.jobs.rl_pipeline import PipelineConfig, RLPipeline
+
+    class _NoRefreshPipeline(RLPipeline):
+        def _build(self):
+            super()._build()
+            for worker in self.workers:
+                worker.maybe_refresh = lambda: False
+
+    pcfg = PipelineConfig(rollout_replicas=REPLICAS,
+                          max_staleness=10 ** 6,  # never throttle
+                          queue_batches=QUEUE_BATCHES,
+                          refresh_mode='step',
+                          refresh_concurrency=1,
+                          store=os.path.join(root, 'noref'))
+    pipe = _NoRefreshPipeline(
+        cfg, pcfg, steps=STEPS, prompts_per_step=PROMPTS_PER_STEP,
+        group_size=GROUP_SIZE, prompt_len=PROMPT_LEN,
+        max_new_tokens=MAX_NEW_TOKENS, num_prompts=16,
+        max_slots=PROMPTS_PER_STEP * GROUP_SIZE)
+    elapsed, tokens, summary = timed_pipeline_run(pipe)
+    return {
+        'rollout_tokens': tokens,
+        'rollout_tokens_per_s': tokens / elapsed,
+        'elapsed_s': round(elapsed, 3),
+        'staleness_max': summary['staleness_max'],
+    }
+
+
+def bench_stop_the_world(cfg, root):
+    """Arm 3: on each commit the whole fleet halts — in-flight waves
+    drain, every replica pulls the FULL tree and swaps in drain mode —
+    then generation resumes. The sync latency is the fleet-wide
+    blocked window."""
+    import numpy as np
+    from skypilot_tpu.jobs.rl_pipeline import PolicyStore, RolloutQueue
+    from skypilot_tpu.train import grpo
+    learner = grpo.GrpoLearner(cfg, learning_rate=1e-3)
+    store = PolicyStore(os.path.join(root, 'stw'))
+    store.publish(learner.params, learner.version)
+    engines = make_engines(cfg, learner.params)
+    wave = make_waves(cfg)
+    queue = RolloutQueue(capacity=QUEUE_BATCHES)
+    halt = threading.Event()       # set = generation must stop
+    resume = threading.Event()
+    resume.set()
+    idle = [threading.Event() for _ in range(REPLICAS)]
+    stop = threading.Event()
+    tokens = [0] * REPLICAS
+
+    def reward(generated, targets):
+        import jax.numpy as jnp
+        return np.asarray(grpo.reward_fn(jnp.asarray(generated),
+                                         jnp.asarray(targets)))
+
+    def worker(rank):
+        from skypilot_tpu.jobs.rl_pipeline import RolloutBatch
+        seq = 0
+        pending = None
+        while not stop.is_set():
+            if halt.is_set():
+                # Mid-put batches are held, not dropped: the worker
+                # parks idle and finishes the hand-off after resume.
+                idle[rank].set()
+                resume.wait(timeout=0.5)
+                continue
+            idle[rank].clear()
+            if pending is None:
+                tiled, targets, g = wave(rank, seq)
+                generated, version = run_wave(engines[rank], tiled,
+                                              seq, rank)
+                tokens[rank] += int(np.asarray(generated).size)
+                pending = RolloutBatch(
+                    prompts=np.asarray(tiled, np.int32),
+                    generated=np.asarray(generated, np.int32),
+                    rewards=reward(generated, targets), group_size=g,
+                    policy_version=int(version), rank=rank, seq=seq)
+                seq += 1
+            if queue.put(pending, timeout=0.2):
+                pending = None
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(REPLICAS)]
+    for t in threads:
+        t.start()
+    sync_latencies = []
+    staleness = []
+    t0 = None
+    for step in range(WARMUP_STEPS + STEPS):
+        if step == WARMUP_STEPS:
+            # Clock starts after the compile-heavy warmup steps, same
+            # as the pipeline arms.
+            sync_latencies.clear()
+            staleness.clear()
+            for rank in range(REPLICAS):
+                tokens[rank] = 0
+            t0 = time.monotonic()
+        batch = queue.pop(timeout=120)
+        assert batch is not None, 'stop-the-world learner starved'
+        consumed_at = learner.version
+        learner.learn_rollouts(batch.prompts, batch.generated,
+                               batch.rewards, batch.group_size)
+        staleness.append(max(0, consumed_at - batch.policy_version))
+        queue.ack(batch)
+        store.publish(learner.params, learner.version)
+        # THE stop-the-world window: halt, drain, full pull, swap.
+        sync_t0 = time.monotonic()
+        resume.clear()
+        halt.set()
+        for flag in idle:
+            flag.wait(timeout=120)
+        for rank, engine in enumerate(engines):
+            dest = os.path.join(root, 'stw', f'replica-{rank}')
+            shutil.rmtree(dest, ignore_errors=True)  # full, not delta
+            pulled = store.pull(dest)
+            engine.refresh_weights(pulled['updates'],
+                                   version=pulled['version'],
+                                   mode='drain')
+        halt.clear()
+        resume.set()
+        sync_latencies.append(time.monotonic() - sync_t0)
+    stop.set()
+    resume.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.monotonic() - t0
+    for e in engines:
+        e.shutdown()
+    return {
+        'rollout_tokens': sum(tokens),
+        'rollout_tokens_per_s': sum(tokens) / elapsed,
+        'elapsed_s': round(elapsed, 3),
+        'weight_sync_p50_s': round(pct(sync_latencies, 0.50), 4),
+        'weight_sync_p99_s': round(pct(sync_latencies, 0.99), 4),
+        'syncs': len(sync_latencies),
+        'staleness_max': max(staleness, default=0),
+    }
+
+
+def main() -> int:
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    from skypilot_tpu.models.config import get_model_config
+    cfg = get_model_config('tiny')
+    root = tempfile.mkdtemp(prefix='skyt-bench-rl-')
+    try:
+        print('arm 1/4: flat-out generation ceiling',
+              file=sys.stderr)
+        reference = bench_reference(cfg)
+        print('arm 2/4: live delta refresh (the pipeline)',
+              file=sys.stderr)
+        live = bench_live(cfg, root)
+        print('arm 3/4: pipeline with sync disabled (steady '
+              'denominator)', file=sys.stderr)
+        no_refresh = bench_no_refresh(cfg, root)
+        print('arm 4/4: stop-the-world sync baseline', file=sys.stderr)
+        stw = bench_stop_the_world(cfg, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    sync_speedup = (stw['weight_sync_p50_s'] /
+                    max(live['weight_sync_p50_s'], 1e-9))
+    # Steady throughput: the learner consumes faster than the fleet
+    # produces (post-warmup), so both pipeline arms are rollout-bound
+    # and produced tokens/s IS the fleet's steady generation rate —
+    # with refreshes interleaved (live) vs without (no_refresh).
+    throughput_fraction = (live['rollout_tokens_per_s'] /
+                           max(no_refresh['rollout_tokens_per_s'],
+                               1e-9))
+    doc = {
+        'bench': 'rl_pipeline',
+        'config': {'replicas': REPLICAS, 'steps': STEPS,
+                   'prompts_per_step': PROMPTS_PER_STEP,
+                   'group_size': GROUP_SIZE, 'prompt_len': PROMPT_LEN,
+                   'max_new_tokens': MAX_NEW_TOKENS,
+                   'max_staleness': MAX_STALENESS, 'model': 'tiny'},
+        'flat_out_ceiling': reference,
+        'live': live,
+        'no_refresh': no_refresh,
+        'stop_the_world': stw,
+        'acceptance': {
+            'weight_sync_p50_speedup': round(sync_speedup, 2),
+            'weight_sync_p50_speedup_ok': sync_speedup >= 3.0,
+            'throughput_fraction_of_no_refresh':
+                round(throughput_fraction, 4),
+            'throughput_fraction_ok': throughput_fraction >= 0.9,
+            'staleness_bounded':
+                live['staleness_max'] <= MAX_STALENESS,
+        },
+    }
+    print(json.dumps(doc, indent=2))
+    ok = doc['acceptance']
+    return 0 if (ok['weight_sync_p50_speedup_ok'] and
+                 ok['throughput_fraction_ok'] and
+                 ok['staleness_bounded']) else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

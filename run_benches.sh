@@ -221,6 +221,18 @@ timeout 600 env JAX_PLATFORMS=cpu python bench_disagg.py \
   | tee "BENCH_disagg_${suffix}.json"
 echo "rc=$? -> BENCH_disagg_${suffix}.json" >&2
 
+# rl bench: CPU-only — live-sync GRPO rollout pipeline (r20): four
+# arms over the same tiny-model fleet (flat-out ceiling, live delta
+# refresh, refresh-disabled denominator, stop-the-world baseline).
+# Acceptance: live weight-sync p50 >=3x better than stop-the-world,
+# live rollout tokens/s >=90% of no-refresh, consumed staleness never
+# above the max_staleness valve (docs/rl_pipeline.md, numbers in
+# PERF.md).
+echo "=== bench rl ($(date -u +%H:%M:%SZ)) ===" >&2
+timeout 600 env JAX_PLATFORMS=cpu python bench_rl.py \
+  | tee "BENCH_rl_${suffix}.json"
+echo "rc=$? -> BENCH_rl_${suffix}.json" >&2
+
 run "BENCH_train_${suffix}.json"
 # The decode A/B/C axes from PERF.md: xla vs pallas vs pallas+int8.
 run "BENCH_decode_xla_${suffix}.json"    --mode decode --attention-impl xla

@@ -275,6 +275,59 @@ class CallableSource:
             yield data[i:i + _CHUNK]
 
 
+class DirectorySource:
+    """Local-directory bucket stand-in: serves shard bytes straight
+    from a committed weights tree (the RL pipeline's policy store and
+    the benches pull learner deltas through the same verified-ranged
+    path remote buckets use)."""
+
+    def __init__(self, root: str, name: str = 'bucket:dir') -> None:
+        self.root = root
+        self.name = name
+        self.replica_id: Optional[int] = None
+
+    def fetch(self, shard: Dict[str, Any],
+              offset: int) -> Iterator[bytes]:
+        path = os.path.join(self.root, shard['path'])
+        try:
+            with open(path, 'rb') as f:
+                if offset:
+                    f.seek(offset)
+                while True:
+                    chunk = f.read(_CHUNK)
+                    if not chunk:
+                        return
+                    yield chunk
+        except OSError as e:
+            raise PeerUnavailable(f'{self.name}: {e}') from None
+
+
+def fetch_manifest(endpoint: str,
+                   timeout: Optional[float] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """Fetch a peer's committed manifest (``/fanout/manifest``).
+
+    Returns None when the peer has no committed manifest yet (404) —
+    the same torn-reads-read-as-absent stance as the local
+    ``ckpt_manifest.read``. Connection errors surface as
+    :class:`PeerUnavailable` so pollers heal instead of crashing."""
+    if timeout is None:
+        timeout = env_registry.get_float('SKYT_FANOUT_PEER_TIMEOUT')
+    url = f'{endpoint.rstrip("/")}/fanout/manifest'
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                raise PeerUnavailable(f'manifest: HTTP {resp.status}')
+            return json.loads(resp.read().decode('utf-8'))
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise PeerUnavailable(f'manifest: HTTP {e.code}') from None
+    except (urllib.error.URLError, TimeoutError, OSError,
+            ConnectionError) as e:
+        raise PeerUnavailable(f'manifest: {e}') from None
+
+
 def sources_from_plan(plan: Dict[str, Any],
                       timeout: Optional[float] = None
                       ) -> List[HTTPPeerSource]:

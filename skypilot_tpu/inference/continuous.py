@@ -81,6 +81,45 @@ DEFAULT_PREFILL_CHUNK = 64
 # LRU-evicting an idle one to make room.
 LORA_FETCH_SITE = 'infer.lora.fetch'
 LORA_EVICT_SITE = 'infer.lora.evict'
+WEIGHT_REFRESH_SITE = 'infer.weights.refresh'
+
+
+def flatten_param_paths(params) -> Dict[str, Any]:
+    """Stable ``'/'``-joined path -> leaf map for a params pytree.
+
+    The RL pipeline's PolicyStore names checkpoint shards by these
+    paths and the engine's refresh hook resolves them back; both sides
+    MUST use this one function or delta refresh silently misses
+    shards. Dicts walk in sorted key order so the mapping (and the
+    manifest built from it) is independent of insertion order."""
+    flat: Dict[str, Any] = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for key in sorted(node):
+                walk(node[key], prefix + (str(key),))
+        elif isinstance(node, (list, tuple)):
+            for i, value in enumerate(node):
+                walk(value, prefix + (str(i),))
+        else:
+            flat['/'.join(prefix)] = node
+
+    walk(params, ())
+    return flat
+
+
+class _WeightRefresh:
+    """A queued live weight swap; the serving loop applies it at a
+    step boundary and then sets ``done`` (``error`` on failure)."""
+
+    def __init__(self, updates, params, version, mode) -> None:
+        self.updates = updates
+        self.params = params
+        self.version = version
+        self.mode = mode
+        self.applied_shards = 0
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
 
 
 # Module-level jitted steps with the (frozen, hashable) ModelConfig as
@@ -208,6 +247,10 @@ class _Request:
         self.request_id = ''
         self.migration = None
         self.handoff_start: Optional[float] = None
+        # Policy version of the weights that generated this request's
+        # tokens — stamped at submit; the RL rollout path reads it to
+        # compute off-policy staleness per batch.
+        self.policy_version = 0
 
 
 class _DrrQueue:
@@ -368,7 +411,13 @@ class ContinuousBatchingEngine:
             self.cfg = with_int8_kv_cache(self.cfg)
         self.tokenizer = get_tokenizer(hf_checkpoint,
                                        require=bool(hf_checkpoint))
-        if self.tokenizer.vocab_size > self.cfg.vocab_size:
+        # A model whose vocab can't cover the tokenizer can still
+        # serve the id-level APIs (the RL rollout path samples raw
+        # token ids on purpose-built small vocabs) — only the TEXT
+        # entry points are poisoned, checked at call time below.
+        self._tokenizer_fits = (self.tokenizer.vocab_size <=
+                                self.cfg.vocab_size)
+        if hf_checkpoint and not self._tokenizer_fits:
             raise ValueError(
                 f'Model vocab {self.cfg.vocab_size} < tokenizer '
                 f'vocab {self.tokenizer.vocab_size}')
@@ -548,6 +597,22 @@ class ContinuousBatchingEngine:
         self._draft_tokens_total = 0
         self._accepted_tokens_total = 0
         self._verify_steps_total = 0
+        # Live in-place weight refresh (docs/rl_pipeline.md): tickets
+        # queue here and the serving loop swaps params at the TOP of a
+        # loop iteration — a step boundary, so the paged KV written by
+        # the old policy stays valid (cache entries describe past
+        # positions; only future positions see the new weights, which
+        # is exactly the off-policy staleness GRPO's group baseline
+        # absorbs). ``drain`` mode additionally holds admission and
+        # waits for in-flight requests — the per-replica
+        # stop-the-world baseline bench_rl.py compares against.
+        self.policy_version = 0
+        self._refresh_queue: 'queue.Queue[_WeightRefresh]' = \
+            queue.Queue()
+        self._refresh_hold = False
+        self._weight_refreshes_total = 0
+        self._refresh_shards_total = 0
+        self._refresh_seconds_total = 0.0
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop,
@@ -798,6 +863,8 @@ class ContinuousBatchingEngine:
         cached prefix blocks, allocate private blocks for the prompt.
         The compute (chunked prefill) happens in ``_prefill_tick``,
         interleaved with decode steps — never inline here."""
+        if self._refresh_hold:
+            return  # drain-mode refresh pending: admission held
         while True:
             try:
                 self._waiting.push(self._pending.get_nowait())
@@ -1449,8 +1516,125 @@ class ContinuousBatchingEngine:
         with mesh_context(self._mesh):
             self._loop_body()
 
+    # -- live weight refresh --------------------------------------------
+
+    def request_refresh(self, updates=None, *, params=None,
+                        version: Optional[int] = None,
+                        mode: str = 'step') -> _WeightRefresh:
+        """Queue a live weight refresh; returns the ticket (wait on
+        ``.done``, then check ``.error``).
+
+        Exactly one of ``updates`` (a ``flatten_param_paths``-keyed
+        dict of new shard values — the delta path) or ``params`` (a
+        full replacement pytree matching the engine's param structure)
+        must be given. ``mode='step'`` (default) swaps at the next
+        step boundary with generation still running; ``mode='drain'``
+        holds admission and waits for in-flight requests first."""
+        if (updates is None) == (params is None):
+            raise ValueError(
+                'pass exactly one of updates= (delta shards) or '
+                'params= (full tree)')
+        if mode not in ('step', 'drain'):
+            raise ValueError(
+                f"refresh mode must be 'step' or 'drain', got {mode!r}")
+        ticket = _WeightRefresh(updates, params, version, mode)
+        self._refresh_queue.put(ticket)
+        self._wake.set()
+        return ticket
+
+    def refresh_weights(self, updates=None, *, params=None,
+                        version: Optional[int] = None,
+                        mode: str = 'step',
+                        timeout: float = 120.0) -> int:
+        """Blocking :meth:`request_refresh`; returns the new
+        ``policy_version``."""
+        ticket = self.request_refresh(updates, params=params,
+                                      version=version, mode=mode)
+        if not ticket.done.wait(timeout):
+            raise TimeoutError('weight refresh timed out')
+        if ticket.error is not None:
+            raise ticket.error
+        return self.policy_version
+
+    def _device_put_like(self, new, old):
+        """Place a refreshed shard exactly where the old one lives:
+        under a mesh the old leaf's NamedSharding transfers, so
+        refresh is per-shard along the GSPMD layout — no host
+        re-gather, no resharding traffic."""
+        new = jnp.asarray(new, getattr(old, 'dtype', None))
+        sharding = getattr(old, 'sharding', None)
+        if self._mesh is not None and sharding is not None:
+            return jax.device_put(new, sharding)
+        return new
+
+    def _apply_updates(self, params, updates):
+        applied = set()
+
+        def walk(node, prefix):
+            if isinstance(node, dict):
+                return {k: walk(v, prefix + (str(k),))
+                        for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                return type(node)(walk(v, prefix + (str(i),))
+                                  for i, v in enumerate(node))
+            path = '/'.join(prefix)
+            if path in updates:
+                applied.add(path)
+                return self._device_put_like(updates[path], node)
+            return node
+
+        new_params = walk(params, ())
+        unknown = sorted(set(updates) - applied)
+        if unknown:
+            raise KeyError(
+                f'refresh updates name {len(unknown)} unknown param '
+                f'shards (first: {unknown[:3]}); learner and engine '
+                f'param trees have diverged')
+        return new_params, len(applied)
+
+    def _refresh_tick(self) -> None:
+        """Serving-loop-only: apply queued weight refreshes at the
+        step boundary (the caller invokes this at the top of a loop
+        iteration, before admission/prefill/decode touch params)."""
+        if self._refresh_queue.empty():
+            return
+        ticket = self._refresh_queue.queue[0]  # peek; sole consumer
+        if ticket.mode == 'drain':
+            self._refresh_hold = True
+            if any(r is not None for r in self._slots) or \
+                    self._prefilling:
+                return  # in-flight work finishes on the OLD policy
+        self._refresh_queue.get_nowait()
+        t0 = time.perf_counter()
+        try:
+            fault_injection.inject(WEIGHT_REFRESH_SITE)
+            if ticket.params is not None:
+                self.params = jax.tree_util.tree_map(
+                    lambda o, n: self._device_put_like(n, o),
+                    self.params, ticket.params)
+                n_shards = len(jax.tree_util.tree_leaves(self.params))
+            else:
+                self.params, n_shards = self._apply_updates(
+                    self.params, ticket.updates)
+            self.policy_version = (int(ticket.version)
+                                   if ticket.version is not None
+                                   else self.policy_version + 1)
+            ticket.applied_shards = n_shards
+            self._weight_refreshes_total += 1
+            self._refresh_shards_total += n_shards
+        except BaseException as e:  # pylint: disable=broad-except
+            ticket.error = e
+            logger.exception('live weight refresh failed')
+        finally:
+            self._refresh_seconds_total += time.perf_counter() - t0
+            queued = self._refresh_queue.queue
+            self._refresh_hold = bool(queued) and \
+                queued[0].mode == 'drain'
+            ticket.done.set()
+
     def _loop_body(self) -> None:
         while not self._stop.is_set():
+            self._refresh_tick()
             self._admit()
             self._prefill_tick()
             active_mask = np.array(self._decoding, bool)
@@ -1562,6 +1746,7 @@ class ContinuousBatchingEngine:
         request = _Request(token_ids, max_new_tokens, temperature,
                            eos_id, seed, trace_ctx=trace_ctx,
                            adapter=adapter or None)
+        request.policy_version = self.policy_version
         self._request_seq += 1
         request.request_id = f'r{self._request_seq}'
         request.migration = migration
@@ -1664,6 +1849,20 @@ class ContinuousBatchingEngine:
                 raise TimeoutError('generation timed out')
             time.sleep(0.005)
 
+    def submit_ids(self, token_ids: List[int], *,
+                   max_new_tokens: int = 32,
+                   temperature: float = 0.0,
+                   eos_id: Optional[int] = None,
+                   seed: int = 0,
+                   adapter: Optional[str] = None) -> _Request:
+        """Non-blocking admission for batch producers (the RL rollout
+        path submits a whole prompt group, then harvests): returns
+        the request handle — wait on ``handle.done``, then read
+        ``handle.generated`` / ``handle.error``. The handle's
+        ``policy_version`` records which weights admitted it."""
+        return self._submit(token_ids, max_new_tokens, temperature,
+                            eos_id, seed, adapter=adapter)
+
     def generate_ids(self, token_ids: List[int], *,
                      max_new_tokens: int = 32,
                      temperature: float = 0.0,
@@ -1684,7 +1883,15 @@ class ContinuousBatchingEngine:
             generated = generated[:generated.index(eos_id)]
         return generated
 
+    def _require_tokenizer(self) -> None:
+        if not self._tokenizer_fits:
+            raise ValueError(
+                f'Model vocab {self.cfg.vocab_size} < tokenizer '
+                f'vocab {self.tokenizer.vocab_size}; text APIs are '
+                f'unavailable (use the *_ids entry points)')
+
     def generate_text(self, prompt: str, **kwargs: Any) -> str:
+        self._require_tokenizer()
         ids = self.tokenizer.encode(prompt)
         out = self.generate_ids(ids, eos_id=self.tokenizer.eos_id,
                                 **kwargs)
@@ -1713,6 +1920,7 @@ class ContinuousBatchingEngine:
         """Yield text DELTAS: ids decode cumulatively (single BPE
         tokens may be partial UTF-8; the running decode keeps deltas
         well-formed)."""
+        self._require_tokenizer()
         ids = self.tokenizer.encode(prompt)
         out_ids: List[int] = []
         text_so_far = ''
@@ -1773,6 +1981,12 @@ class ContinuousBatchingEngine:
             'accepted_tokens': self._accepted_tokens_total,
             'verify_steps': self._verify_steps_total,
             'spec_window': self._spec_window,
+            # Live weight refresh (RL rollout serving; zero on engines
+            # that never refresh). policy_version is a gauge.
+            'policy_version': self.policy_version,
+            'weight_refreshes': self._weight_refreshes_total,
+            'refresh_shards': self._refresh_shards_total,
+            'refresh_seconds': round(self._refresh_seconds_total, 4),
             # Multi-LoRA (zero on engines with no adapter pages).
             'lora_hits': (self._adapter_pool.hits
                           if self._adapter_pool is not None else 0),

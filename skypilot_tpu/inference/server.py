@@ -446,6 +446,62 @@ def make_handler(engine: InferenceEngine):
     return Handler
 
 
+def watch_policy_store(engine, store_root: str,
+                       poll_s: float = None) -> 'threading.Thread':
+    """Serve an RL pipeline's policy store live (continuous engine):
+    pull the committed policy synchronously before the first request —
+    on an empty local copy the manifest diff IS the full weight tree —
+    then poll the store and refresh the engine in place with each
+    newer version's shard delta (``docs/rl_pipeline.md``).  The eval
+    fleet follows the learner with the same staggered, step-boundary
+    swaps the rollout fleet uses; a mid-pull manifest race is retried
+    on the next poll."""
+    import tempfile
+    import threading
+
+    from skypilot_tpu.jobs.rl_pipeline import PolicyStore
+    from skypilot_tpu.utils import env_registry
+
+    if poll_s is None:
+        poll_s = env_registry.get_float('SKYT_RL_EVAL_POLL_S',
+                                        minimum=0.1)
+    store = PolicyStore(store_root)
+    dest = tempfile.mkdtemp(prefix='skyt-eval-policy-')
+    served = [-1]
+
+    def pull_once() -> bool:
+        if store.version() in (None, served[0]):
+            return False
+        res = store.pull(dest)
+        if res is None or res['version'] == served[0]:
+            return False
+        if res['updates']:
+            engine.refresh_weights(updates=res['updates'],
+                                   version=res['version'],
+                                   mode='step')
+        served[0] = res['version']
+        logger.info('policy store %s: serving version %d '
+                    '(%d shards, %d bytes pulled)', store_root,
+                    res['version'], res['shards_pulled'],
+                    res['bytes_pulled'])
+        return True
+
+    pull_once()  # blocking: never serve the random-init weights
+
+    def loop():
+        while True:
+            time.sleep(poll_s)
+            try:
+                pull_once()
+            except Exception as exc:  # mid-publish race: retry
+                logger.warning('policy store poll failed: %s', exc)
+
+    thread = threading.Thread(target=loop, name='policy-store-watch',
+                              daemon=True)
+    thread.start()
+    return thread
+
+
 def serve(engine: InferenceEngine, host: str, port: int):
     server = ThreadingHTTPServer((host, port), make_handler(engine))
     logger.info('Inference server for %s on %s:%d', engine.cfg.name, host,
@@ -528,6 +584,15 @@ def main(argv=None) -> int:
                              'decode fleet to pull, decode replicas '
                              'import it and stream tokens '
                              '(docs/disaggregated_serving.md).')
+    parser.add_argument('--policy-store', default=None,
+                        help='RL-pipeline policy store to serve '
+                             '(continuous engine; default '
+                             '$SKYT_RL_STORE): pull the committed '
+                             'policy before serving, then poll every '
+                             '$SKYT_RL_EVAL_POLL_S seconds and '
+                             'live-refresh the engine with the shard '
+                             'delta of each newer version '
+                             '(docs/rl_pipeline.md).')
     args = parser.parse_args(argv)
     if args.engine == 'continuous':
         from skypilot_tpu.inference.continuous import (
@@ -564,6 +629,12 @@ def main(argv=None) -> int:
                 engine, args.lora_dir)
             logger.info('registered %d adapters from %s: %s',
                         len(names), args.lora_dir, names)
+        policy_store = args.policy_store
+        if policy_store is None:
+            from skypilot_tpu.utils import env_registry
+            policy_store = env_registry.get_str('SKYT_RL_STORE')
+        if policy_store:
+            watch_policy_store(engine, policy_store)
         if engine.role == 'prefill':
             # Warm the prefill program; drop the throwaway export.
             engine.exporter.pop(engine.prefill_and_export(

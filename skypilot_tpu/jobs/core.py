@@ -21,6 +21,12 @@ logger = log.init_logger(__name__)
 def launch(task: Task, name: Optional[str] = None) -> int:
     """Submit a managed job; returns its job id immediately."""
     from skypilot_tpu import admin_policy
+    if task.pipeline:
+        # A pipeline: task is sugar for a gang-scheduled learner +
+        # rollout group; expansion lives with the pipeline runtime.
+        from skypilot_tpu.jobs import rl_pipeline
+        job_ids = rl_pipeline.launch_pipeline(task, name)
+        return job_ids[0]
     task = admin_policy.apply(task, 'jobs.launch')
     resources = task.resources[0] if task.resources else None
     strategy = 'FAILOVER'

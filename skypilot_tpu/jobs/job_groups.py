@@ -58,13 +58,31 @@ def publish_hosts(job_id: int, cluster_name: str) -> None:
     jobs_state.set_group_hosts(job_id, [h for h in hosts if h])
 
 
+def _is_elastic_member(sibling: jobs_state.JobRecord) -> bool:
+    """RL-pipeline rollout members are *elastic* gang members: losing
+    one shrinks the rollout fleet (the pipeline redistributes waves
+    and the staleness valve absorbs the throughput dip) instead of
+    cancelling the whole gang.  A learner failure still gang-cancels —
+    rollouts without a consumer burn TPU-hours for nothing."""
+    envs = sibling.task_config.get('envs') or {}
+    return envs.get('SKYT_RL_ROLE') == 'rollout'
+
+
 def sibling_failed(record: jobs_state.JobRecord) -> Optional[str]:
-    """Name of a failed sibling, or None while the gang is healthy."""
+    """Name of a failed sibling, or None while the gang is healthy.
+    Elastic (rollout-role) siblings never trip the gang-cancel."""
     assert record.group_name is not None
     for sibling in jobs_state.list_group(record.group_name):
         if sibling.job_id == record.job_id:
             continue
         if sibling.status in _FAILED_STATUSES:
+            if _is_elastic_member(sibling):
+                logger.info(
+                    'Group %s: elastic rollout member %s is %s; '
+                    'fleet shrinks, gang continues.',
+                    record.group_name, sibling.name or sibling.job_id,
+                    sibling.status.value)
+                continue
             return (f'{sibling.name or sibling.job_id} '
                     f'({sibling.status.value})')
     return None

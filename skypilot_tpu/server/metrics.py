@@ -493,6 +493,64 @@ JOB_RESIZE_SECONDS = Histogram(
 
 _JOB_METRICS = [JOB_RECOVERIES, JOB_RESIZE_SECONDS]
 
+# -- RL post-training pipeline (jobs/rl_pipeline.py: GRPO learner +
+# rollout fleet with live delta weight refresh; incremented in the
+# pipeline process, same in-process stance as the fanout family) -------
+
+_RL_SYNC_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0, float('inf'))
+_RL_STALENESS_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 32,
+                         float('inf'))
+
+RL_ROLLOUT_TOKENS = Counter(
+    'skyt_rl_rollout_tokens_total',
+    'Rollout tokens generated by the pipeline rollout fleet, by '
+    'replica rank',
+    labels=('rank',))
+RL_ROLLOUT_BATCHES = Counter(
+    'skyt_rl_rollout_batches_total',
+    'Rollout batches by outcome (produced = enqueued for the '
+    'learner, consumed = folded into a learner step, requeued = '
+    'returned to the queue after a learner fault mid-step)',
+    labels=('outcome',))
+RL_WEIGHT_REFRESHES = Counter(
+    'skyt_rl_weight_refreshes_total',
+    'Per-replica live weight refreshes by outcome (ok, error)',
+    labels=('outcome',))
+RL_WEIGHT_SYNC_SECONDS = Histogram(
+    'skyt_rl_weight_sync_seconds',
+    'Learner-commit to rollout-replica-swapped latency per refresh '
+    '(delta manifest pull + per-shard device_put at the step '
+    'boundary)',
+    buckets=_RL_SYNC_BUCKETS,
+    labels=())
+RL_STALENESS = Histogram(
+    'skyt_rl_staleness_steps',
+    'Off-policy staleness at consume: learner steps between the '
+    'policy version that generated a rollout batch and the version '
+    'that consumed it (bounded by SKYT_RL_MAX_STALENESS)',
+    buckets=_RL_STALENESS_BUCKETS,
+    labels=())
+RL_VALVE_WAITS = Counter(
+    'skyt_rl_valve_waits_total',
+    'Times a rollout replica paused generation on the max_staleness '
+    'backpressure valve (waiting for a weight refresh to land)',
+    labels=('rank',))
+RL_LEARNER_VERSION = Gauge(
+    'skyt_rl_learner_version',
+    'Latest policy version the learner has published',
+    labels=())
+RL_QUEUE_DEPTH = Gauge(
+    'skyt_rl_queue_depth',
+    'Rollout batches buffered between the rollout fleet and the '
+    'learner',
+    labels=())
+
+_RL_METRICS = [RL_ROLLOUT_TOKENS, RL_ROLLOUT_BATCHES,
+               RL_WEIGHT_REFRESHES, RL_WEIGHT_SYNC_SECONDS,
+               RL_STALENESS, RL_VALVE_WAITS, RL_LEARNER_VERSION,
+               RL_QUEUE_DEPTH]
+
 # -- fleet telemetry plane (scrape federation + SLO engine; emitted by
 # the telemetry daemon in the API-server process) ----------------------
 
@@ -533,6 +591,9 @@ INFERENCE_COUNTER_STATS = frozenset({
     # Multi-LoRA paging (r19): adapter page-pool traffic; residency
     # and registration counts stay gauges.
     'lora_hits', 'lora_misses', 'lora_evictions',
+    # Live weight refresh (r20 RL rollout serving): cumulative swap
+    # counts/time; policy_version stays a gauge.
+    'weight_refreshes', 'refresh_shards', 'refresh_seconds',
 })
 # Highest recovery_events row id already folded into _JOB_METRICS.
 _recovery_cursor = 0
@@ -555,7 +616,7 @@ _ALL = ([REQUESTS_TOTAL, REQUESTS_IN_FLIGHT, QUEUE_DEPTH,
          RUNTIME_EVENTS, EVENT_WAKEUPS, NOTIFICATIONS, BUILD_INFO,
          REQUEST_EXEC_SECONDS]
         + _LB_METRICS + _TRANSFER_METRICS + _JOB_METRICS
-        + _TELEMETRY_METRICS)
+        + _RL_METRICS + _TELEMETRY_METRICS)
 
 
 def collect_from_db() -> None:

@@ -16,6 +16,10 @@ time):
 * ``rollout`` — a weight rollout: rolling restart of the fleet in
   ``batch``-sized waves every ``interval_s``, each wave NOT READY for
   ``restart_s`` (generalizes the weight-rollout-during-surge drill).
+* ``learner_preempt`` — the RL pipeline's learner (``fleet.rl``
+  scenarios) is preempted for ``down_s``: no batch consumption, no
+  policy-version bumps; its in-flight batch is requeued at the front
+  (the no-lost-batches drill).
 * ``fault_spec`` — replay a recorded ``SKYT_FAULT_SPEC`` value for
   ``duration_s``: the sim's controller tick runs
   ``fault_injection.inject('sim.controller.tick')``, so a clause like
@@ -48,6 +52,8 @@ def install_faults(fleet: 'FleetSim', faults: List[Dict]) -> None:
             _install_provision_slowdown(fleet, at, fault)
         elif kind == 'rollout':
             _install_rollout(fleet, at, fault)
+        elif kind == 'learner_preempt':
+            _install_learner_preempt(fleet, at, fault)
         elif kind == 'fault_spec':
             _install_fault_spec(fleet, at, fault)
         else:  # scenario validation already rejected this
@@ -154,6 +160,17 @@ def _install_rollout(fleet, at: float, fault: Dict) -> None:
         fleet.loop.after(interval, wave)
 
     fleet.loop.at(at, start)
+
+
+def _install_learner_preempt(fleet, at: float, fault: Dict) -> None:
+    down_s = float(fault.get('down_s', 120.0))
+
+    def preempt() -> None:
+        requeued = fleet.rl_learner_preempt(fleet.clock.now(), down_s)
+        fleet.report.event(fleet.clock.now(), 'learner_preempt',
+                           down_s=down_s, requeued=requeued)
+
+    fleet.loop.at(at, preempt)
 
 
 def _install_fault_spec(fleet, at: float, fault: Dict) -> None:

@@ -24,7 +24,7 @@ reproducible and adding a consumer never perturbs the others.
 from __future__ import annotations
 
 from bisect import bisect_left
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional
 
 from skypilot_tpu.data.fanout import bucket_lease_bound
@@ -222,6 +222,18 @@ class FleetSim:
         self.lora_enabled = bool(lora_cfg)
         if self.lora_enabled:
             self._init_lora(lora_cfg)
+
+        # -- live-sync RL rollout pipeline (fleet.rl) ------------------
+        # When present, READY replicas double as a GRPO rollout fleet
+        # feeding a fluid learner: delta weight refreshes, the
+        # max_staleness backpressure valve, and the ack/requeue batch
+        # queue are modeled on the virtual clock. When absent the
+        # block is inert — serving flow is untouched either way (the
+        # rollout fleet generates training tokens, not user traffic).
+        rl_cfg = fleet.get('rl') or {}
+        self.rl_enabled = bool(rl_cfg)
+        if self.rl_enabled:
+            self._init_rl(rl_cfg)
 
         # -- placement domains ----------------------------------------
         self.domains: List[Domain] = []
@@ -486,6 +498,168 @@ class FleetSim:
         miss_est = fetches * scale
         self.lora_misses += miss_est
         return miss_est, fetches * self.lora_cold_fetch_ms / 1000.0
+
+    def _init_rl(self, cfg: Dict) -> None:
+        """Parse the fleet.rl block (docs/rl_pipeline.md).
+
+        Fluid model of ``jobs/rl_pipeline.py``: every READY replica
+        produces rollout waves of ``wave_tokens`` tokens at
+        ``tokens_per_replica_s``; a singleton learner consumes one
+        batch per ``learn_step_s`` and bumps the policy version; a
+        replica whose version lags refreshes for ``refresh_s``,
+        staggered ``refresh_concurrency`` at a time ('step' mode keeps
+        producing through the swap, 'drain' holds admission — the
+        stop-the-world per-replica baseline). Production gates on the
+        projected-staleness valve and the bounded batch queue, exactly
+        the real pipeline's invariant:
+        staleness-at-consume = lag + queue depth + in-flight, which
+        consumption leaves unchanged and only a refresh lowers."""
+        self.rl_wave_tokens = float(cfg.get('wave_tokens', 2048.0))
+        self.rl_tokens_per_replica_s = float(
+            cfg.get('tokens_per_replica_s', 512.0))
+        self.rl_learn_step_s = float(cfg.get('learn_step_s', 0.5))
+        self.rl_refresh_s = float(cfg.get('refresh_s', 5.0))
+        self.rl_refresh_mode = str(cfg.get('refresh_mode', 'step'))
+        self.rl_refresh_concurrency = int(
+            cfg.get('refresh_concurrency', 1))
+        self.rl_max_staleness = int(cfg.get('max_staleness', 4))
+        self.rl_queue_batches = float(cfg.get('queue_batches', 2.0))
+        if min(self.rl_wave_tokens, self.rl_tokens_per_replica_s,
+               self.rl_learn_step_s, self.rl_refresh_s) <= 0:
+            raise ValueError('fleet.rl rates and latencies must be > 0')
+        if self.rl_refresh_mode not in ('step', 'drain'):
+            raise ValueError("fleet.rl refresh_mode must be 'step' or "
+                             "'drain'")
+        if self.rl_refresh_concurrency < 1 or \
+                self.rl_queue_batches < 1 or self.rl_max_staleness < 1:
+            raise ValueError('fleet.rl refresh_concurrency, '
+                             'queue_batches and max_staleness must '
+                             'be >= 1')
+        self.rl_learner_version = 0
+        # FIFO cohorts of [policy_version, batches] (fluid amounts).
+        self._rl_queue: 'deque[List[float]]' = deque()
+        self._rl_inflight: Optional[List[float]] = None  # [ver, eta]
+        self._rl_learn_free_at = 0.0
+        self._rl_replica_version: Dict[int, int] = {}
+        self._rl_refreshing: Dict[int, float] = {}  # id -> eta
+        self.rl_learner_down_until: Optional[float] = None
+        self.rl_batches_produced = 0.0
+        self.rl_batches_consumed = 0
+        self.rl_batches_requeued = 0
+        self.rl_refreshes = 0
+        self.rl_tokens_total = 0.0
+        self._rl_potential_tokens = 0.0
+        self.rl_staleness_max = 0
+        self.rl_valve_wait_s = 0.0
+
+    def rl_learner_preempt(self, t: float, down_s: float) -> int:
+        """Learner preemption (the ``learner_preempt`` fault): no
+        consumption and no version bumps until ``t + down_s``; the
+        in-flight batch goes back to the FRONT of the queue — the
+        ack/requeue contract that makes lost batches impossible."""
+        self.rl_learner_down_until = t + down_s
+        requeued = 0
+        if self._rl_inflight is not None:
+            ver, _eta = self._rl_inflight
+            self._rl_queue.appendleft([float(ver), 1.0])
+            self._rl_inflight = None
+            self.rl_batches_requeued += 1
+            requeued = 1
+        return requeued
+
+    def _rl_tick(self, t: float, dt: float, ready: List) -> None:
+        versions = self._rl_replica_version
+        refreshing = self._rl_refreshing
+        ready_ids = {r.replica_id for r in ready}
+        # Departed replicas (preempted, scaled down, mid-refresh or
+        # not) drop out of the fleet version map; a victim mid-refresh
+        # frees its stagger slot — the engine-shutdown semaphore
+        # release in the real pipeline.
+        for rid in list(versions):
+            if rid not in ready_ids:
+                versions.pop(rid)
+                refreshing.pop(rid, None)
+        lv = self.rl_learner_version
+        for record in ready:
+            if record.replica_id not in versions:
+                # A freshly landed replica pulls the committed policy
+                # as part of its start (the full-manifest cold pull).
+                versions[record.replica_id] = lv
+
+        # Refresh completions, then staggered starts.
+        for rid in sorted(refreshing):
+            if t >= refreshing[rid]:
+                versions[rid] = lv
+                del refreshing[rid]
+                self.rl_refreshes += 1
+        slots = self.rl_refresh_concurrency - len(refreshing)
+        if slots > 0:
+            lagging = sorted(rid for rid, ver in versions.items()
+                             if ver < lv and rid not in refreshing)
+            for rid in lagging[:slots]:
+                refreshing[rid] = t + self.rl_refresh_s
+
+        # Learner: commit in-flight batches whose step finished, pop
+        # the next — possibly several per tick when learn_step_s < dt.
+        if self.rl_learner_down_until is not None and \
+                t >= self.rl_learner_down_until:
+            self.rl_learner_down_until = None
+            self._rl_learn_free_at = t
+        if self.rl_learner_down_until is None:
+            while True:
+                if self._rl_inflight is not None:
+                    ver, eta = self._rl_inflight
+                    if eta > t:
+                        break
+                    self.rl_learner_version += 1
+                    self.rl_batches_consumed += 1
+                    self._rl_learn_free_at = eta
+                    self._rl_inflight = None
+                    continue
+                if sum(c[1] for c in self._rl_queue) < 1.0 - 1e-9:
+                    break
+                take, oldest = 1.0, None
+                while take > 1e-9:
+                    cohort = self._rl_queue[0]
+                    if oldest is None:
+                        oldest = int(cohort[0])
+                    amount = min(take, cohort[1])
+                    cohort[1] -= amount
+                    take -= amount
+                    if cohort[1] <= 1e-9:
+                        self._rl_queue.popleft()
+                stale = self.rl_learner_version - oldest
+                self.rl_staleness_max = max(self.rl_staleness_max,
+                                            stale)
+                start = max(self._rl_learn_free_at, t - dt)
+                self._rl_inflight = [float(oldest),
+                                     start + self.rl_learn_step_s]
+            lv = self.rl_learner_version
+
+        # Production: valve + bounded queue gate each replica's tick.
+        wave_s = self.rl_wave_tokens / self.rl_tokens_per_replica_s
+        rate = dt / wave_s
+        qtotal = sum(c[1] for c in self._rl_queue)
+        inflight_n = 0 if self._rl_inflight is None else 1
+        for record in sorted(ready, key=lambda r: r.replica_id):
+            rid = record.replica_id
+            self._rl_potential_tokens += rate * self.rl_wave_tokens
+            if rid in refreshing and self.rl_refresh_mode == 'drain':
+                continue    # admission held while the swap drains
+            projected = (lv - versions[rid]) + qtotal + inflight_n
+            if projected >= self.rl_max_staleness or \
+                    qtotal >= self.rl_queue_batches - 1e-9:
+                self.rl_valve_wait_s += dt
+                continue
+            amount = min(rate, self.rl_queue_batches - qtotal)
+            ver = versions[rid]
+            if self._rl_queue and int(self._rl_queue[-1][0]) == ver:
+                self._rl_queue[-1][1] += amount
+            else:
+                self._rl_queue.append([float(ver), amount])
+            qtotal += amount
+            self.rl_batches_produced += amount
+            self.rl_tokens_total += amount * self.rl_wave_tokens
 
     def _scaler_target(self) -> int:
         """The decision stack's current total target: per-role tracks
@@ -766,6 +940,11 @@ class FleetSim:
         if self.lb_policy is not None and n_ready > 0 and arrived > 0:
             self._lb_probe(ready, min(arrived, _LB_REQUEST_SAMPLE))
 
+        # 7b. RL rollout pipeline (its own fluid block: learner
+        # consumption, staggered refreshes, valve-gated production).
+        if self.rl_enabled:
+            self._rl_tick(t, dt, ready)
+
         # 8. emit the tick's metric points.
         report = self.report
         report.metric('sim_qps_offered', t, offered_qps)
@@ -787,6 +966,17 @@ class FleetSim:
                           float(self.lora_evictions))
             report.metric('sim_lora_resident', t,
                           float(len(self._lora_cache)))
+        if self.rl_enabled:
+            report.metric('sim_rl_learner_version', t,
+                          float(self.rl_learner_version))
+            report.metric('sim_rl_queue_batches', t,
+                          sum(c[1] for c in self._rl_queue))
+            report.metric('sim_rl_tokens_total', t,
+                          self.rl_tokens_total)
+            report.metric('sim_rl_refreshing', t,
+                          float(len(self._rl_refreshing)))
+            report.metric('sim_rl_staleness_max', t,
+                          float(self.rl_staleness_max))
         if self.disagg_enabled:
             last = self._disagg_last
             report.metric('sim_ttft_p99_ms', t, last['ttft_ms'])
@@ -1013,6 +1203,28 @@ class FleetSim:
                 _series_p99(self.cold_ttft_samples), 2)
             out['base_intertoken_p99_ms'] = round(
                 _series_p99(self.base_itl_samples), 2)
+        if self.rl_enabled:
+            # The numbers the RL pipeline invariants grade
+            # (max_rollout_staleness_steps /
+            # min_rollout_throughput_fraction /
+            # max_lost_rollout_batches in report.py).
+            qtotal = sum(c[1] for c in self._rl_queue)
+            inflight_n = 0 if self._rl_inflight is None else 1
+            lost = (self.rl_batches_produced - self.rl_batches_consumed
+                    - qtotal - inflight_n)
+            out['rl_learner_version'] = self.rl_learner_version
+            out['rl_batches_produced'] = round(
+                self.rl_batches_produced, 2)
+            out['rl_batches_consumed'] = self.rl_batches_consumed
+            out['rl_batches_requeued'] = self.rl_batches_requeued
+            out['rl_lost_batches'] = round(max(0.0, lost), 2)
+            out['rl_refreshes'] = self.rl_refreshes
+            out['rl_staleness_max'] = self.rl_staleness_max
+            out['rl_valve_wait_s'] = round(self.rl_valve_wait_s, 1)
+            out['rl_tokens_total'] = round(self.rl_tokens_total, 1)
+            out['rl_throughput_fraction'] = round(
+                self.rl_tokens_total /
+                max(1.0, self._rl_potential_tokens), 4)
         if self.disagg_enabled:
             # Run-level p99 over per-tick ground truth — the numbers
             # the max_ttft_p99_s / max_intertoken_p99_ms invariants

@@ -44,6 +44,18 @@ __all__ = ['SimReport']
 #                                    churn (fleet.lora runs)
 #   min_adapter_hit_fraction: f   -> adapter page hit rate floor
 #                                    (fleet.lora runs)
+#   max_rollout_staleness_steps: N -> max learner-versions-behind any
+#                                    consumed rollout batch was
+#                                    (fleet.rl runs; the valve bound)
+#   min_rollout_throughput_fraction: f -> rollout tokens produced over
+#                                    tokens the READY fleet could have
+#                                    produced — per-replica normalized,
+#                                    so elastic shrink doesn't fail it
+#                                    (fleet.rl runs)
+#   max_lost_rollout_batches: N   -> batches produced but neither
+#                                    consumed, queued, nor in flight
+#                                    at scenario end (fleet.rl runs;
+#                                    ack/requeue conservation)
 _INVARIANT_KEYS = ('no_lost_requests', 'max_shed_requests',
                    'max_slo_miss_seconds', 'max_target_flips',
                    'max_final_queue', 'min_served_fraction',
@@ -52,7 +64,10 @@ _INVARIANT_KEYS = ('no_lost_requests', 'max_shed_requests',
                    'max_intertoken_p99_ms',
                    'max_adapter_cold_ttft_p99_ms',
                    'max_base_intertoken_p99_ms',
-                   'min_adapter_hit_fraction')
+                   'min_adapter_hit_fraction',
+                   'max_rollout_staleness_steps',
+                   'min_rollout_throughput_fraction',
+                   'max_lost_rollout_batches')
 
 
 class SimReport:
@@ -159,6 +174,15 @@ class SimReport:
             elif key == 'min_adapter_hit_fraction':
                 actual = s['lora_hit_fraction']
                 ok = actual >= bound
+            elif key == 'max_rollout_staleness_steps':
+                actual = s['rl_staleness_max']
+                ok = actual <= bound
+            elif key == 'min_rollout_throughput_fraction':
+                actual = s['rl_throughput_fraction']
+                ok = actual >= bound
+            elif key == 'max_lost_rollout_batches':
+                actual = s['rl_lost_batches']
+                ok = actual <= bound
             else:  # max_controller_faults
                 actual = s['controller_faults']
                 ok = actual <= bound

@@ -48,7 +48,7 @@ from typing import Any, Dict, List, Optional
 __all__ = ['Scenario', 'library_dir', 'library_names', 'load_library']
 
 _FAULT_KINDS = ('region_outage', 'spot_reclaim', 'provision_slowdown',
-                'rollout', 'fault_spec')
+                'rollout', 'learner_preempt', 'fault_spec')
 
 _FLEET_DEFAULTS = {
     'initial_replicas': 0,
@@ -176,6 +176,15 @@ class Scenario:
                 # scales, keeping per-replica fetch pressure fixed.
                 lora['hot_set'] = max(
                     1, int(round(lora['hot_set'] * factor)))
+        rl = fleet.get('rl')
+        if rl:
+            # Rollout production scales with the fleet; the learner's
+            # consumption rate must scale WITH it or a shrunk smoke
+            # run becomes learner-rich (valve never closes) and a
+            # grown one learner-bound (valve always closed) — either
+            # would change the behavior under test.
+            rl['learn_step_s'] = (
+                float(rl.get('learn_step_s', 0.5)) / factor)
         service = data.setdefault('service', {})
         for key in ('min_replicas', 'max_replicas',
                     'base_ondemand_fallback_replicas'):
@@ -220,6 +229,11 @@ class Scenario:
                     f'unknown fault kind {kind!r}; one of {_FAULT_KINDS}')
             if 'at' not in fault:
                 raise ValueError(f'fault {fault!r} needs an `at` time')
+            if kind == 'learner_preempt' and \
+                    not self.fleet.get('rl'):
+                raise ValueError(
+                    'learner_preempt faults need a fleet.rl block '
+                    '(there is no learner to preempt otherwise)')
             if kind == 'fault_spec':
                 # Parse at load, not mid-run: a malformed spec would
                 # otherwise raise inside every controller tick and be
@@ -236,6 +250,16 @@ class Scenario:
             for key in ('n_adapters', 'pages_per_replica'):
                 if not lora.get(key):
                     raise ValueError(f'fleet.lora needs {key!r}')
+        rl = self.fleet.get('rl')
+        if rl:
+            for key in ('wave_tokens', 'tokens_per_replica_s',
+                        'learn_step_s', 'refresh_s'):
+                if key in rl and float(rl[key]) <= 0:
+                    raise ValueError(f'fleet.rl {key} must be > 0')
+            mode = rl.get('refresh_mode', 'step')
+            if mode not in ('step', 'drain'):
+                raise ValueError(
+                    "fleet.rl refresh_mode must be 'step' or 'drain'")
         if self.fleet.get('disagg'):
             service = data.get('service', {})
             if service.get('target_ttft_p99_ms') is None or \

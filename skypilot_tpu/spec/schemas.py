@@ -215,6 +215,30 @@ TASK_SCHEMA: Dict[str, Any] = {
                 'drain_seconds': {'type': 'number', 'minimum': 0},
             },
         },
+        # RL post-training pipeline: this task is the learner of a
+        # gang-scheduled GRPO run; `jobs launch` expands it into
+        # <name>-learner + <name>-rollout-<i> elastic members
+        # (jobs/rl_pipeline.py, docs/rl_pipeline.md).
+        'pipeline': {
+            'type': ['object', 'null'],
+            'additionalProperties': False,
+            'properties': {
+                'rollout_replicas': {'type': 'integer', 'minimum': 1},
+                # Off-policy staleness valve bound (learner steps).
+                'max_staleness': {'type': 'integer', 'minimum': 1},
+                'queue_batches': {'type': 'integer', 'minimum': 1},
+                'refresh_mode': {'enum': ['step', 'drain']},
+                # Replicas allowed to refresh weights at once (the
+                # stagger that keeps fleet-wide generation alive).
+                'refresh_concurrency': {'type': 'integer',
+                                        'minimum': 1},
+                'store': {'type': ['string', 'null']},
+                # Run command for rollout members (learner keeps the
+                # task-level `run:`).
+                'rollout_run': {'type': ['string', 'null']},
+            },
+            'required': ['rollout_replicas'],
+        },
         # Internal round-trip marker (admin policy already applied);
         # present when a task exported by to_yaml is re-imported.
         '_policy_applied': {'type': 'boolean'},

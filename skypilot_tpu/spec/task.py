@@ -48,6 +48,7 @@ class Task:
         estimated_outputs_gb: Optional[float] = None,
         depends_on: Optional[List[str]] = None,
         elastic: Optional[Dict[str, Any]] = None,
+        pipeline: Optional[Dict[str, Any]] = None,
     ) -> None:
         if name is not None and not _VALID_NAME_RE.fullmatch(name):
             raise exceptions.InvalidSpecError(f'Invalid task name {name!r}')
@@ -95,6 +96,12 @@ class Task:
         # ElasticStrategy). None = rigid world size (legacy behavior).
         self.elastic: Optional[Dict[str, Any]] = (
             dict(elastic) if elastic else None)
+        # RL post-training pipeline (jobs/rl_pipeline.py): this task is
+        # the LEARNER of a gang-scheduled learner + rollout fleet; the
+        # launcher expands it into one job group where rollout-member
+        # failure shrinks the fleet instead of cancelling the gang.
+        self.pipeline: Optional[Dict[str, Any]] = (
+            dict(pipeline) if pipeline else None)
         # Per-task config layer (the `config:` YAML section), threaded
         # into config.get_nested(... override_configs=...) by consumers.
         self.config_overrides: Dict[str, Any] = {}
@@ -129,6 +136,56 @@ class Task:
                         'resources.num_slices>1, not both.')
         if self.elastic is not None:
             self._validate_elastic()
+        if self.pipeline is not None:
+            self._validate_pipeline()
+
+    def _validate_pipeline(self) -> None:
+        assert self.pipeline is not None
+        if self.service is not None:
+            raise exceptions.InvalidSpecError(
+                'pipeline: and service: are mutually exclusive (a '
+                'pipeline task is the learner of a managed RL gang, '
+                'not a serving deployment)')
+        known = {'rollout_replicas', 'max_staleness', 'queue_batches',
+                 'refresh_mode', 'refresh_concurrency', 'store',
+                 'rollout_run'}
+        unknown = set(self.pipeline) - known
+        if unknown:
+            raise exceptions.InvalidSpecError(
+                f'Unknown pipeline fields: {sorted(unknown)} '
+                f'(known: {sorted(known)})')
+        replicas = int(self.pipeline.get('rollout_replicas', 0))
+        if replicas < 1:
+            raise exceptions.InvalidSpecError(
+                'pipeline.rollout_replicas must be >= 1 (the rollout '
+                'fleet feeding the learner)')
+        max_staleness = int(self.pipeline.get('max_staleness', 4))
+        if max_staleness < 0:
+            raise exceptions.InvalidSpecError(
+                f'pipeline.max_staleness must be >= 0, got '
+                f'{max_staleness} (0 = fully on-policy lockstep)')
+        queue_batches = int(self.pipeline.get('queue_batches', 2))
+        if queue_batches < 1:
+            raise exceptions.InvalidSpecError(
+                f'pipeline.queue_batches must be >= 1, got '
+                f'{queue_batches}')
+        mode = str(self.pipeline.get('refresh_mode', 'step'))
+        if mode not in ('step', 'drain'):
+            raise exceptions.InvalidSpecError(
+                f"pipeline.refresh_mode must be 'step' or 'drain', "
+                f'got {mode!r}')
+        concurrency = int(self.pipeline.get('refresh_concurrency', 1))
+        if not 1 <= concurrency <= replicas:
+            raise exceptions.InvalidSpecError(
+                f'pipeline.refresh_concurrency must be in '
+                f'[1, rollout_replicas], got {concurrency} '
+                f'(refreshing every replica at once IS the '
+                f'stop-the-world baseline)')
+        self.pipeline['rollout_replicas'] = replicas
+        self.pipeline['max_staleness'] = max_staleness
+        self.pipeline['queue_batches'] = queue_batches
+        self.pipeline['refresh_mode'] = mode
+        self.pipeline['refresh_concurrency'] = concurrency
 
     def _validate_elastic(self) -> None:
         assert self.elastic is not None
@@ -174,6 +231,7 @@ class Task:
             'resources', 'service', 'config', '_policy_applied',
             'estimated_flops', 'estimated_inputs_gb', 'inputs_region',
             'estimated_outputs_gb', 'depends_on', 'elastic',
+            'pipeline',
         }
         unknown = set(config) - known
         if unknown:
@@ -210,6 +268,7 @@ class Task:
             estimated_outputs_gb=config.get('estimated_outputs_gb'),
             depends_on=config.get('depends_on'),
             elastic=config.get('elastic'),
+            pipeline=config.get('pipeline'),
         )
         task.config_overrides = dict(config.get('config') or {})
         task.policy_applied = bool(config.get('_policy_applied', False))
@@ -311,6 +370,8 @@ class Task:
             config['depends_on'] = list(self.depends_on)
         if self.elastic:
             config['elastic'] = dict(self.elastic)
+        if self.pipeline:
+            config['pipeline'] = dict(self.pipeline)
         if self.policy_applied:
             config['_policy_applied'] = True
         return config

@@ -100,6 +100,104 @@ def make_grpo_step(cfg, optimizer):
     return step
 
 
+class GrpoLearner:
+    """Reusable GRPO learner: owns the policy state, one ``learn()``
+    per rollout batch, checkpoint save/restore.
+
+    Extracted from ``main()`` so the RL pipeline
+    (``jobs/rl_pipeline.py``) can drive the same optimizer loop from
+    queued rollout batches while rollout generation runs elsewhere;
+    ``version`` (the step counter) doubles as the published policy
+    version the pipeline's staleness accounting is measured in."""
+
+    def __init__(self, cfg, *, learning_rate: float = 1e-4,
+                 checkpoint_dir=None, seed: int = 0) -> None:
+        from skypilot_tpu.models import llama
+        self.cfg = cfg
+        self.checkpoint_dir = checkpoint_dir
+        self.optimizer = optax.adamw(learning_rate)
+        params = llama.init_params(jax.random.key(seed), cfg)
+        self.state = GrpoState(step=jnp.zeros((), jnp.int32),
+                               params=params,
+                               opt_state=self.optimizer.init(params))
+        self.resumed_from = None
+        if checkpoint_dir:
+            from skypilot_tpu.train import checkpoint as ckpt_lib
+            latest = ckpt_lib.latest_step(checkpoint_dir)
+            if latest is not None:
+                self.state = ckpt_lib.restore(checkpoint_dir, latest,
+                                              self.state)
+                self.resumed_from = int(self.state.step)
+        self._step_fn = make_grpo_step(cfg, self.optimizer)
+
+    @property
+    def params(self):
+        return self.state.params
+
+    @property
+    def version(self) -> int:
+        return int(self.state.step)
+
+    def learn(self, tokens, gen_mask, advantages) -> Dict[str, float]:
+        self.state, metrics = self._step_fn(self.state, tokens,
+                                            gen_mask, advantages)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def learn_rollouts(self, prompts, generated, rewards,
+                       group_size: int) -> Dict[str, float]:
+        """One GRPO step straight from rollout arrays: ``prompts``
+        [P*G, L] (already tiled), ``generated`` [P*G, N], ``rewards``
+        [P*G]."""
+        prompts = jnp.asarray(prompts)
+        generated = jnp.asarray(generated)
+        advantages = grpo_advantages(jnp.asarray(rewards), group_size)
+        tokens = jnp.concatenate([prompts, generated], axis=1)
+        gen_mask = jnp.concatenate(
+            [jnp.zeros_like(prompts), jnp.ones_like(generated)],
+            axis=1)
+        out = self.learn(tokens, gen_mask, advantages)
+        out['mean_reward'] = float(jnp.asarray(rewards).mean())
+        return out
+
+    def save(self) -> None:
+        if self.checkpoint_dir:
+            from skypilot_tpu.train import checkpoint as ckpt_lib
+            ckpt_lib.save(self.checkpoint_dir, self.version, self.state)
+
+
+def engine_rollouts(engine, tiled, *, max_new_tokens: int,
+                    temperature: float, step: int,
+                    timeout: float = 300.0):
+    """Sample one rollout wave through the continuous engine: submit
+    every row of ``tiled`` [B, L] as its own request (G copies of a
+    prompt share their prefill through the prefix cache; repeated
+    prompts give prompt-lookup speculation its best-case acceptance),
+    then harvest in order. Returns ([B, N] generated, min policy
+    version that served the wave)."""
+    handles = [
+        engine.submit_ids(
+            [int(t) for t in row],
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            # Deterministic per-request seed: rollout i of step s
+            # always samples the same stream, so a wave is replayable
+            # while G siblings still explore G distinct streams.
+            seed=(step << 20) | i)
+        for i, row in enumerate(tiled)
+    ]
+    outs = []
+    version = None
+    for handle in handles:
+        if not handle.done.wait(timeout):
+            raise TimeoutError('rollout generation timed out')
+        if handle.error is not None:
+            raise handle.error
+        outs.append(handle.generated)
+        version = (handle.policy_version if version is None
+                   else min(version, handle.policy_version))
+    return jnp.asarray(outs, jnp.int32), (version or 0)
+
+
 def main(argv=None) -> int:
     from skypilot_tpu.utils.jax_env import honor_jax_platforms
     honor_jax_platforms()
@@ -128,11 +226,21 @@ def main(argv=None) -> int:
                              "the config's, i.e. the flash kernel on "
                              'TPU; unsupported shapes fall back to XLA '
                              'inside the dispatch).')
+    parser.add_argument('--rollout-backend', default='engine',
+                        choices=('engine', 'loop'),
+                        help='How rollouts are sampled: "engine" '
+                             '(default) serves them through the '
+                             'continuous batching engine — paged KV, '
+                             'prompt-set prefix reuse, optional '
+                             'speculative decoding — with a live '
+                             'weight refresh after every learner '
+                             'step; "loop" keeps the naive '
+                             'decode.generate loop (the parity '
+                             'baseline).')
     args = parser.parse_args(argv)
 
-    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models import decode
     from skypilot_tpu.models.config import get_model_config
-    from skypilot_tpu.train import checkpoint as ckpt_lib
 
     # The RL step used to hard-force 'xla' (r2 verdict weak #3) — the
     # O(S^2) HBM-materializing path. The kernel dispatch now handles
@@ -144,23 +252,12 @@ def main(argv=None) -> int:
     if args.vocab_size:
         overrides['vocab_size'] = args.vocab_size
     cfg = get_model_config(args.model, **overrides)
-    optimizer = optax.adamw(args.learning_rate)
-
-    def init_state() -> GrpoState:
-        params = llama.init_params(jax.random.key(0), cfg)
-        return GrpoState(step=jnp.zeros((), jnp.int32), params=params,
-                         opt_state=optimizer.init(params))
-
-    state = init_state()
-    start_step = 0
-    if args.checkpoint_dir:
-        latest = ckpt_lib.latest_step(args.checkpoint_dir)
-        if latest is not None:
-            state = ckpt_lib.restore(args.checkpoint_dir, latest, state)
-            start_step = int(state.step)
-            print(json.dumps({'resumed_from_step': start_step}),
-                  flush=True)
-    grpo_step = make_grpo_step(cfg, optimizer)
+    learner = GrpoLearner(cfg, learning_rate=args.learning_rate,
+                          checkpoint_dir=args.checkpoint_dir)
+    start_step = learner.version
+    if learner.resumed_from is not None:
+        print(json.dumps({'resumed_from_step': learner.resumed_from}),
+              flush=True)
     p, g = args.prompts_per_step, args.group_size
     # The prompt "dataset": a fixed pool, cycled per step (a real RLVR
     # recipe would load prompts from a file/bucket here).
@@ -168,34 +265,59 @@ def main(argv=None) -> int:
                                       args.num_prompts, args.prompt_len,
                                       cfg.vocab_size)
 
-    for step in range(start_step, args.steps):
-        sample_rng = jax.random.key(1000 + step)
-        idx = (step * p + jnp.arange(p)) % args.num_prompts
-        prompts, targets = pool[idx], pool_targets[idx]
-        # G rollouts per prompt: tile the batch, one sampled seed per step
-        tiled = jnp.repeat(prompts, g, axis=0)              # [P*G, L]
-        tiled_targets = jnp.repeat(targets, g)
-        lengths = jnp.full((p * g,), args.prompt_len, jnp.int32)
-        generated, _ = decode.generate(
-            state.params, tiled, lengths, cfg,
-            max_new_tokens=args.max_new_tokens,
-            temperature=args.temperature, rng=sample_rng)
-        rewards = reward_fn(generated, tiled_targets)
-        advantages = grpo_advantages(rewards, g)
-        tokens = jnp.concatenate([tiled, generated], axis=1)
-        gen_mask = jnp.concatenate(
-            [jnp.zeros_like(tiled), jnp.ones_like(generated)], axis=1)
-        state, metrics = grpo_step(state, tokens, gen_mask, advantages)
-        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
-            print(json.dumps({
-                'step': step + 1,
-                'mean_reward': round(float(rewards.mean()), 4),
-                'loss': round(float(metrics['loss']), 4),
-            }), flush=True)
-        if (args.checkpoint_dir and
-                ((step + 1) % args.checkpoint_every == 0 or
-                 step + 1 == args.steps)):
-            ckpt_lib.save(args.checkpoint_dir, step + 1, state)
+    engine = None
+    if args.rollout_backend == 'engine' and start_step < args.steps:
+        from skypilot_tpu.inference.continuous import \
+            ContinuousBatchingEngine
+        engine = ContinuousBatchingEngine(
+            cfg=cfg, params=learner.params,
+            max_slots=min(p * g, 8),
+            max_len=min(cfg.max_seq_len,
+                        args.prompt_len + args.max_new_tokens + 1))
+
+    try:
+        for step in range(start_step, args.steps):
+            idx = (step * p + jnp.arange(p)) % args.num_prompts
+            prompts, targets = pool[idx], pool_targets[idx]
+            # G rollouts per prompt: tile the batch.
+            tiled = jnp.repeat(prompts, g, axis=0)          # [P*G, L]
+            tiled_targets = jnp.repeat(targets, g)
+            if engine is not None:
+                generated, _ = engine_rollouts(
+                    engine, list(map(list, tiled.tolist())),
+                    max_new_tokens=args.max_new_tokens,
+                    temperature=args.temperature, step=step)
+            else:
+                sample_rng = jax.random.key(1000 + step)
+                lengths = jnp.full((p * g,), args.prompt_len,
+                                   jnp.int32)
+                generated, _ = decode.generate(
+                    learner.params, tiled, lengths, cfg,
+                    max_new_tokens=args.max_new_tokens,
+                    temperature=args.temperature, rng=sample_rng)
+            rewards = reward_fn(generated, tiled_targets)
+            metrics = learner.learn_rollouts(tiled, generated, rewards,
+                                             g)
+            if engine is not None:
+                # Live in-place refresh: the engine serves the next
+                # wave on the post-step policy without tearing down
+                # (standalone mode is fully on-policy: staleness 0).
+                engine.refresh_weights(params=learner.params,
+                                       version=learner.version)
+            if (step + 1) % args.log_every == 0 or \
+                    step + 1 == args.steps:
+                print(json.dumps({
+                    'step': step + 1,
+                    'mean_reward': round(metrics['mean_reward'], 4),
+                    'loss': round(metrics['loss'], 4),
+                }), flush=True)
+            if (args.checkpoint_dir and
+                    ((step + 1) % args.checkpoint_every == 0 or
+                     step + 1 == args.steps)):
+                learner.save()
+    finally:
+        if engine is not None:
+            engine.shutdown()
     print(json.dumps({'done': True, 'final_step': args.steps}), flush=True)
     return 0
 

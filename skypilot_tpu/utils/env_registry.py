@@ -487,6 +487,45 @@ DECLARATIONS: List[EnvVar] = _decl([
      '(adapter -> last replica); overflow counts as '
      'skyt_lora_adapter_evictions_total.'),
 
+    # -- RL post-training pipeline (jobs/rl_pipeline.py) ------------
+    ('SKYT_RL_MAX_STALENESS', 'int', 4,
+     'Off-policy staleness bound in learner steps: a rollout replica '
+     'pauses generation (backpressure valve) whenever a batch it '
+     'produced now could be consumed more than this many versions '
+     'after the policy that generated it (docs/rl_pipeline.md).'),
+    ('SKYT_RL_QUEUE_BATCHES', 'int', 2,
+     'Rollout-batch buffer depth between the rollout fleet and the '
+     'learner; every buffered batch adds one step of worst-case '
+     'staleness, so the valve counts it.'),
+    ('SKYT_RL_REFRESH_MODE', 'str', 'step',
+     'How rollout replicas apply a published policy: "step" swaps '
+     'live at a decode step boundary (in-flight KV kept), "drain" '
+     'holds admission and waits out in-flight generation first (the '
+     'stop-the-world per-replica baseline).'),
+    ('SKYT_RL_REFRESH_CONCURRENCY', 'int', 1,
+     'Rollout replicas allowed to refresh weights simultaneously; '
+     'the rest keep generating, so a refresh wave never stops the '
+     'fleet (staggered rollout of the new policy).'),
+    ('SKYT_RL_ROLE', 'str', '',
+     'Pipeline member role injected by the pipeline launcher: '
+     '"learner" or "rollout"; empty = run the whole pipeline '
+     'in-process.', True),
+    ('SKYT_RL_RANK', 'int', 0,
+     'Rollout replica rank within the pipeline fleet (stagger phase '
+     'and metrics label).', True),
+    ('SKYT_RL_FLEET', 'int', 1,
+     'Rollout fleet size the pipeline was launched with.', True),
+    ('SKYT_RL_STORE', 'path', None,
+     'Policy store directory the learner commits delta manifests '
+     'into and rollout replicas pull from (content-addressed shards '
+     'via data/ckpt_manifest; rides the fan-out tree when remote).',
+     True),
+    ('SKYT_RL_EVAL_POLL_S', 'float', 10.0,
+     'Poll cadence (seconds) for an inference server launched with '
+     '--policy-store: the eval fleet checks the RL pipeline\'s store '
+     'for a newer committed policy and live-refreshes the engine '
+     'with the shard delta (recipe://rl-pipeline-evalserver).'),
+
     # -- provisioning -----------------------------------------------
     ('SKYT_K8S_FAKE', 'bool', False,
      'Use the in-repo fake kubernetes API (tests).'),

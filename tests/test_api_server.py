@@ -115,6 +115,9 @@ def test_workdir_upload_content_addressed(server, tmp_path):
     assert 'uploaded-data' in buf.getvalue()
 
 
+# r20 triage: 29s of streaming a synthetic GB; bounded-memory logic is
+# also pinned by the smaller upload tests
+@pytest.mark.slow
 def test_large_upload_streams_with_bounded_memory(server, tmp_path):
     """VERDICT r3 weak #3: the server buffered the whole upload body in
     RAM. A >256 MB workdir must now stream through spool files on both

@@ -116,6 +116,8 @@ def test_pallas_nondividing_heads_falls_back():
                                atol=2e-5, rtol=2e-5)
 
 
+# r20 triage: 13s compile
+@pytest.mark.slow
 def test_train_step_pallas_on_mesh():
     """Full sharded train step with attention_impl='pallas' on a
     tensor*fsdp*data mesh: compiles, runs, loss decreases, and matches

@@ -62,6 +62,8 @@ def _queue_in_child(cluster: str) -> int:
     return int(out.stdout.strip().splitlines()[-1])
 
 
+# r20 triage: 6s spawn-counting soak
+@pytest.mark.slow
 def test_broker_eliminates_per_request_channel_spawns(monkeypatch):
     execution.launch(
         Task(name='bj', run='sleep 1',

@@ -120,6 +120,8 @@ def test_unreachable_server_raises_cleanly(tmp_home, monkeypatch):
 # -- load: concurrent request storm ------------------------------------
 
 
+# r20 triage: 8s load soak
+@pytest.mark.slow
 def test_concurrent_request_storm(server, monkeypatch):
     """50 concurrent SDK calls (mixed short/long) all complete; the server
     stays healthy (parity: tests/load_tests/test_load_on_server.py's

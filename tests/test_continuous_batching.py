@@ -36,6 +36,8 @@ def _reference_greedy(engine, ids, max_new_tokens):
     return list(np.asarray(generated)[0][:int(gen_len[0])])
 
 
+# r20 triage: redundant with the interleaved-requests parity test
+@pytest.mark.slow
 def test_single_request_matches_batch_generate(engine):
     ids = [5, 9, 42, 7]
     out = engine.generate_ids(ids, max_new_tokens=8)

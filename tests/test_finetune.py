@@ -109,6 +109,9 @@ def test_finetune_driver_lora_end_to_end(hf_ckpt_dir, tmp_path):
         np.asarray(base['layers']['attn']['wq']))
 
 
+# r20 triage: full-mode repeats the driver compile; the LoRA-mode
+# driver test keeps the path in tier 1
+@pytest.mark.slow
 def test_finetune_driver_full_mode(hf_ckpt_dir, tmp_path):
     from skypilot_tpu.train import finetune
     ckpt, corpus = hf_ckpt_dir

@@ -55,6 +55,8 @@ def _hf_logits(model, tokens):
         return model(torch.tensor(tokens)).logits.numpy()
 
 
+# r20 triage: 7s transformers import + forward
+@pytest.mark.slow
 def test_forward_matches_transformers_llama():
     """Loaded checkpoint produces the same logits as transformers'
     LlamaForCausalLM — the end-to-end conversion correctness proof

@@ -100,6 +100,9 @@ def test_continuous_engine_with_int8_cache():
         eng.shutdown()
 
 
+# r20 triage: 8s compile; the continuous-engine int8 test keeps the
+# quantized-cache path in tier 1
+@pytest.mark.slow
 def test_paged_pool_int8_parity_with_monolithic():
     """Int8 KV through the PAGED pool tracks the monolithic int8
     cache: chunked prefill attends through the quantized rows (the

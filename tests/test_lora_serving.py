@@ -243,6 +243,9 @@ def _reference_greedy(engine, ids, max_new_tokens):
     return list(np.asarray(generated)[0][:int(gen_len[0])])
 
 
+# r20 triage: redundant with the all-base bitwise-trace and
+# merge-then-serve parity tests
+@pytest.mark.slow
 def test_absent_adapter_is_greedy_identical_to_base(lora_engine):
     """A LoRA-enabled engine serving a request with NO adapter must be
     the base model bit-for-bit: page 0 is all-zero deltas and the
@@ -354,8 +357,13 @@ def _parity_engines(quantize_kv):
     return eng_merged, eng_paged
 
 
-@pytest.mark.parametrize('quantize_kv', [False, True],
-                         ids=['fp32', 'int8_kv'])
+# r20 triage: the int8_kv variant repeats the merge-parity compile with
+# a quantized cache; fp32 keeps the contract in tier 1 and
+# test_kv_cache_int8 pins the quantized-cache path.
+@pytest.mark.parametrize('quantize_kv', [
+    pytest.param(False, id='fp32'),
+    pytest.param(True, id='int8_kv', marks=pytest.mark.slow),
+])
 def test_merge_then_serve_matches_adapter_runtime(quantize_kv):
     """The S-LoRA/Punica contract: serving base weights + paged
     adapter deltas produces the same greedy tokens as serving the

@@ -75,6 +75,9 @@ def test_collective_bench_recipe_launches_on_fake_cloud():
     assert result['detail']['devices'] >= 1
 
 
+# r20 triage: 14s end-to-end launch; the collective-bench recipe launch
+# keeps the fake-cloud e2e path in tier 1
+@pytest.mark.slow
 def test_pretrain_recipe_launches_tiny_on_fake_cloud(tmp_path):
     task = Task.from_yaml('recipe://pretrain-1b7')
     ckpt = tmp_path / 'ckpt'

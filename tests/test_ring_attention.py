@@ -121,6 +121,8 @@ def test_ring_rejects_indivisible_seq():
         ring_attention(q, k, v, mesh=mesh)
 
 
+# r20 triage: 12s compile
+@pytest.mark.slow
 def test_train_step_with_ring_attention():
     """Full sharded train step with ring attention on a seq=4 mesh:
     loss decreases and matches the xla-attention step numerically."""

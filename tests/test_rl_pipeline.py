@@ -1,0 +1,479 @@
+"""Live-sync GRPO rollout pipeline tests (``jobs/rl_pipeline.py``).
+
+The pipeline's three contracts, drilled here:
+
+* **Liveness** — rollout generation never stops fleet-wide for a
+  weight refresh: deltas swap in at a step boundary while other
+  replicas keep producing (engine-side site `infer.weights.refresh`).
+* **Staleness** — every consumed batch's learner-versions-behind is
+  bounded by ``max_staleness``; the valve closes production, and only
+  a refresh (never consumption) reopens it.
+* **Conservation** — no rollout batch is ever lost: ``produced ==
+  acked + depth`` at quiesce, with learner faults requeuing at the
+  FRONT of the queue.
+
+Chaos sites (SKYT_FAULT_SPEC grammar, ``tests/fault_injection.py``):
+``rl.rollout.generate`` (a wave dies mid-generation),
+``rl.refresh.pull`` (delta fetch fails mid-refresh), and
+``rl.learn.step`` (the learner crashes before mutating state).
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fault_injection import clause, inject_faults
+from skypilot_tpu.jobs import rl_pipeline
+from skypilot_tpu.jobs.rl_pipeline import (FileBatchQueue,
+                                           PipelineConfig, PolicyStore,
+                                           RLPipeline, RolloutBatch,
+                                           RolloutQueue,
+                                           expand_pipeline)
+
+
+def _batch(seq=0, rank=0, version=0, b=4, l=3, n=2):
+    rng = np.random.default_rng(seq * 100 + rank)
+    return RolloutBatch(
+        prompts=rng.integers(0, 50, (b, l)).astype(np.int32),
+        generated=rng.integers(0, 50, (b, n)).astype(np.int32),
+        rewards=rng.random(b).astype(np.float32),
+        group_size=2, policy_version=version, rank=rank, seq=seq)
+
+
+# --------------------------------------------------------------------
+# RolloutQueue: FIFO + ack/requeue accounting
+# --------------------------------------------------------------------
+
+
+def test_rollout_queue_fifo_and_conservation():
+    q = RolloutQueue(capacity=3)
+    batches = [_batch(seq=i) for i in range(3)]
+    for b in batches:
+        assert q.put(b, timeout=1)
+    assert q.depth() == 3 and q.produced == 3
+
+    first = q.pop(timeout=1)
+    assert first is batches[0]
+    # In-flight still counts toward depth (the learner hasn't retired
+    # it), which is what the staleness projection needs.
+    assert q.depth() == 3
+    q.ack(first)
+    assert q.depth() == 2 and q.acked == 1
+    assert q.unretired() == 2  # produced - acked
+
+
+def test_rollout_queue_requeue_goes_to_front():
+    q = RolloutQueue(capacity=3)
+    for i in range(3):
+        q.put(_batch(seq=i), timeout=1)
+    popped = q.pop(timeout=1)
+    assert popped.seq == 0
+    q.requeue(popped)
+    # A learner fault must NOT reorder the batch behind fresher ones —
+    # that would silently raise its staleness at re-consume time.
+    assert q.pop(timeout=1).seq == 0
+    assert q.requeued == 1
+
+
+def test_rollout_queue_put_blocks_when_full():
+    q = RolloutQueue(capacity=1)
+    assert q.put(_batch(seq=0), timeout=1)
+    assert not q.put(_batch(seq=1), timeout=0.05)  # backpressure
+    got = q.pop(timeout=1)
+    q.ack(got)
+    assert q.put(_batch(seq=1), timeout=1)
+
+
+# --------------------------------------------------------------------
+# FileBatchQueue: the cross-job hand-off (atomic claim protocol)
+# --------------------------------------------------------------------
+
+
+def test_file_queue_roundtrip(tmp_path):
+    q = FileBatchQueue(str(tmp_path), capacity=4)
+    sent = _batch(seq=7, rank=2, version=3)
+    assert q.put(sent, timeout=1)
+    assert q.depth() == 1
+    got = q.pop(timeout=1)
+    np.testing.assert_array_equal(got.prompts, sent.prompts)
+    np.testing.assert_array_equal(got.generated, sent.generated)
+    np.testing.assert_allclose(got.rewards, sent.rewards)
+    assert (got.group_size, got.policy_version, got.rank, got.seq) == \
+        (2, 3, 2, 7)
+    assert q.depth() == 1  # claimed, not yet retired
+    q.ack(got)
+    assert q.depth() == 0
+
+
+def test_file_queue_orphaned_claim_is_reclaimed(tmp_path):
+    """A learner that dies holding a claim leaves the ``.claim`` file;
+    its replacement consumes it FIRST (delayed, never lost)."""
+    q1 = FileBatchQueue(str(tmp_path), capacity=4)
+    q1.put(_batch(seq=0, version=1), timeout=1)
+    q1.put(_batch(seq=1, version=2), timeout=1)
+    dying = q1.pop(timeout=1)
+    assert dying.seq == 0
+    del q1  # the learner dies without ack/requeue
+
+    q2 = FileBatchQueue(str(tmp_path), capacity=4)
+    first = q2.pop(timeout=1)
+    assert first.seq == 0  # orphaned claim reclaimed before fresh work
+    q2.requeue(first)
+    again = q2.pop(timeout=1)
+    assert again.seq == 0
+    q2.ack(again)
+    assert q2.pop(timeout=1).seq == 1
+
+
+def test_file_queue_capacity_backpressure(tmp_path):
+    q = FileBatchQueue(str(tmp_path), capacity=1)
+    assert q.put(_batch(seq=0), timeout=1)
+    assert not q.put(_batch(seq=1), timeout=0.1)
+
+
+# --------------------------------------------------------------------
+# PolicyStore: delta publish/pull through the manifest diff
+# --------------------------------------------------------------------
+
+
+def _toy_params():
+    return {'head': {'w': np.arange(12, dtype=np.float32).reshape(3, 4)},
+            'embed': np.ones((5, 4), np.float32),
+            'layers': [{'w1': np.full((2, 2), 2.0, np.float32)},
+                       {'w1': np.full((2, 2), 3.0, np.float32)}]}
+
+
+def test_policy_store_delta_publish(tmp_path):
+    store = PolicyStore(str(tmp_path))
+    assert store.version() is None
+    params = _toy_params()
+    info = store.publish(params, version=0)
+    assert info['shards_total'] == info['shards_written'] == 4
+    assert store.version() == 0
+
+    # Touch ONE leaf: the next publish ships exactly one shard — the
+    # manifest diff IS the delta a replica transfers.
+    params['layers'][1]['w1'] = params['layers'][1]['w1'] + 1.0
+    info = store.publish(params, version=1)
+    assert info['shards_written'] == 1
+    assert store.version() == 1
+
+
+def test_policy_store_pull_is_incremental(tmp_path):
+    store = PolicyStore(str(tmp_path))
+    params = _toy_params()
+    store.publish(params, version=0)
+    dest = str(tmp_path / 'replica-0')
+
+    pulled = store.pull(dest)
+    assert pulled['version'] == 0
+    assert set(pulled['updates']) == {
+        'head/w', 'embed', 'layers/0/w1', 'layers/1/w1'}
+    np.testing.assert_array_equal(pulled['updates']['embed'],
+                                  params['embed'])
+
+    params['embed'] = params['embed'] * 2.0
+    store.publish(params, version=1)
+    pulled = store.pull(dest)
+    assert pulled['version'] == 1
+    # Only the changed shard crosses the wire on the second pull.
+    assert list(pulled['updates']) == ['embed']
+    assert pulled['shards_pulled'] == 1
+    np.testing.assert_array_equal(pulled['updates']['embed'],
+                                  params['embed'])
+
+
+# --------------------------------------------------------------------
+# PipelineConfig: env knobs + the pipeline: task block
+# --------------------------------------------------------------------
+
+
+def test_pipeline_config_from_env(monkeypatch):
+    monkeypatch.setenv('SKYT_RL_FLEET', '5')
+    monkeypatch.setenv('SKYT_RL_MAX_STALENESS', '7')
+    monkeypatch.setenv('SKYT_RL_QUEUE_BATCHES', '3')
+    monkeypatch.setenv('SKYT_RL_REFRESH_MODE', 'drain')
+    monkeypatch.setenv('SKYT_RL_REFRESH_CONCURRENCY', '2')
+    monkeypatch.setenv('SKYT_RL_STORE', '/tmp/rl-store')
+    pcfg = PipelineConfig.from_env()
+    assert pcfg == PipelineConfig(
+        rollout_replicas=5, max_staleness=7, queue_batches=3,
+        refresh_mode='drain', refresh_concurrency=2,
+        store='/tmp/rl-store')
+
+
+def test_expand_pipeline_members():
+    from skypilot_tpu.spec.task import Task
+    task = Task.from_yaml_config({
+        'name': 'grpo',
+        'run': 'python -m skypilot_tpu.jobs.rl_pipeline',
+        'resources': {'cloud': 'fake', 'accelerators': 'tpu-v5e-8'},
+        'pipeline': {
+            'rollout_replicas': 3,
+            'max_staleness': 6,
+            'refresh_concurrency': 2,
+            'store': '/shared/rl-store',
+            'rollout_run':
+                'python -m skypilot_tpu.jobs.rl_pipeline --role rollout',
+        },
+    })
+    members = expand_pipeline(task)
+    assert [m.name for m in members] == [
+        'grpo-learner', 'grpo-rollout-0', 'grpo-rollout-1',
+        'grpo-rollout-2']
+    learner = members[0]
+    assert learner.envs['SKYT_RL_ROLE'] == 'learner'
+    assert learner.envs['SKYT_RL_MAX_STALENESS'] == '6'
+    assert learner.envs['SKYT_RL_STORE'] == '/shared/rl-store'
+    assert learner.run == 'python -m skypilot_tpu.jobs.rl_pipeline'
+    for i, member in enumerate(members[1:]):
+        assert member.envs['SKYT_RL_ROLE'] == 'rollout'
+        assert member.envs['SKYT_RL_RANK'] == str(i)
+        assert member.envs['SKYT_RL_FLEET'] == '3'
+        assert member.run.endswith('--role rollout')
+
+
+def test_rollout_members_are_elastic_in_gang(tmp_home):
+    """A failed rollout member shrinks the fleet; a failed learner
+    still gang-cancels (rollouts without a consumer are waste)."""
+    from skypilot_tpu.jobs import job_groups
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+
+    def member(name, role):
+        return jobs_state.submit(
+            {'name': name, 'envs': {'SKYT_RL_ROLE': role}},
+            name, strategy='FAILOVER', max_restarts_on_errors=0,
+            group_name='rl-gang')
+
+    learner = member('rl-learner', 'learner')
+    rollout0 = member('rl-rollout-0', 'rollout')
+    rollout1 = member('rl-rollout-1', 'rollout')
+
+    jobs_state.set_status(rollout1, ManagedJobStatus.FAILED)
+    # Elastic member down: siblings see a healthy gang.
+    assert job_groups.sibling_failed(jobs_state.get(learner)) is None
+    assert job_groups.sibling_failed(jobs_state.get(rollout0)) is None
+
+    jobs_state.set_status(learner, ManagedJobStatus.FAILED)
+    failed = job_groups.sibling_failed(jobs_state.get(rollout0))
+    assert failed is not None and 'rl-learner' in failed
+
+
+# --------------------------------------------------------------------
+# Engine-side live refresh (the tentpole's serving half)
+# --------------------------------------------------------------------
+
+
+@pytest.fixture(scope='module')
+def engine():
+    from skypilot_tpu.inference.continuous import ContinuousBatchingEngine
+    eng = ContinuousBatchingEngine('tiny', max_slots=4, max_len=32)
+    yield eng
+    eng.shutdown()
+
+
+def test_engine_delta_refresh_version_and_output(engine):
+    from skypilot_tpu.inference.continuous import flatten_param_paths
+    ids = [5, 9, 42, 7]
+    before = engine.generate_ids(ids, max_new_tokens=6)
+    v0 = engine.policy_version
+
+    flat = flatten_param_paths(engine.params)
+    path = next(p for p in flat if 'embed' in p or 'tok' in p) \
+        if any('embed' in p or 'tok' in p for p in flat) \
+        else sorted(flat)[0]
+    # A delta that can't not change greedy output: negate one tensor.
+    update = {path: -np.asarray(flat[path])}
+    new_version = engine.refresh_weights(update, version=v0 + 3,
+                                         mode='step')
+    assert new_version == v0 + 3
+    assert engine.policy_version == v0 + 3
+    after = engine.generate_ids(ids, max_new_tokens=6)
+    assert after != before
+
+    # Restore for neighbors; drain mode holds admission first.
+    engine.refresh_weights({path: np.asarray(flat[path])},
+                           version=v0 + 4, mode='drain')
+    restored = engine.generate_ids(ids, max_new_tokens=6)
+    assert restored == before
+    stats = engine.stats()
+    assert stats['weight_refreshes'] >= 2
+    assert stats['policy_version'] == v0 + 4
+
+
+def test_engine_refresh_rejects_unknown_shards(engine):
+    v = engine.policy_version
+    with pytest.raises(KeyError):
+        engine.refresh_weights({'no/such/shard': np.zeros(2)},
+                               version=v + 1)
+    assert engine.policy_version == v  # failed swap leaves weights be
+
+
+def test_engine_refresh_chaos_site(engine):
+    """`infer.weights.refresh` chaos: an injected fault surfaces on
+    the ticket, the engine keeps serving, the retry lands."""
+    from skypilot_tpu.inference.continuous import flatten_param_paths
+    flat = flatten_param_paths(engine.params)
+    path = sorted(flat)[0]
+    update = {path: np.asarray(flat[path])}
+    v = engine.policy_version
+    with inject_faults(clause('infer.weights.refresh', 'OSError',
+                              times=1)):
+        with pytest.raises(OSError):
+            engine.refresh_weights(update, version=v + 1)
+        assert engine.policy_version == v
+        # Retry under the same (exhausted) spec succeeds.
+        assert engine.refresh_weights(update, version=v + 1) == v + 1
+
+
+def test_server_policy_store_watcher(engine, tmp_path):
+    """The evalserver path: `inference.server --policy-store` pulls
+    the committed policy synchronously before serving, then follows
+    the learner with live delta refreshes."""
+    import time
+
+    from skypilot_tpu.inference import server as server_mod
+    from skypilot_tpu.inference.continuous import flatten_param_paths
+
+    store = PolicyStore(str(tmp_path / 'store'))
+    flat = flatten_param_paths(engine.params)
+    base = {p: np.asarray(a) for p, a in flat.items()}
+    v1 = engine.policy_version + 100
+    store.publish(base, version=v1)
+
+    server_mod.watch_policy_store(engine, str(tmp_path / 'store'),
+                                  poll_s=0.1)
+    # The initial full pull is synchronous: the server never answers a
+    # request with random-init weights.
+    assert engine.policy_version == v1
+
+    # A newer commit with one changed shard: the poll thread pulls the
+    # delta and live-refreshes.
+    path = sorted(base)[0]
+    store.publish(dict(base, **{path: -base[path]}), version=v1 + 1)
+    deadline = time.monotonic() + 20.0
+    while (engine.policy_version != v1 + 1
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert engine.policy_version == v1 + 1
+
+    # Restore the original weights for neighboring tests.
+    store.publish(base, version=v1 + 2)
+    deadline = time.monotonic() + 20.0
+    while (engine.policy_version != v1 + 2
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert engine.policy_version == v1 + 2
+
+
+def test_engine_rollouts_greedy_parity(engine):
+    """Satellite 1: engine rollouts at temperature=0 are IDENTICAL to
+    the standalone batch generate the old GRPO loop used."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models import decode as decode_lib
+    from skypilot_tpu.train import grpo
+
+    prompts, _ = grpo.make_prompts(jax.random.key(3), 4, 6,
+                                   engine.cfg.vocab_size)
+    tiled = np.asarray(jnp.repeat(prompts, 2, axis=0))
+    generated, version = grpo.engine_rollouts(
+        engine, [list(map(int, row)) for row in tiled],
+        max_new_tokens=5, temperature=0.0, step=0)
+    assert version == engine.policy_version
+
+    lengths = jnp.full((tiled.shape[0],), tiled.shape[1], jnp.int32)
+    ref, _ = decode_lib.generate(
+        engine.params, jnp.asarray(tiled, jnp.int32), lengths,
+        engine.cfg, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(generated),
+                                  np.asarray(ref))
+
+
+# --------------------------------------------------------------------
+# The pipeline under chaos: one run, all three rl.* sites injected
+# --------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_pipeline_chaos_run_holds_invariants(tmp_path):
+    """One in-process pipeline run with a fault at EVERY rl site:
+    ``rl.rollout.generate`` kills a wave, ``rl.refresh.pull`` kills a
+    delta fetch mid-refresh, ``rl.learn.step`` kills a learner step
+    before it mutates state.  The run must still complete with the
+    staleness bound held, the faulted batch requeued (front of queue),
+    and zero batches lost."""
+    from skypilot_tpu.models.config import get_model_config
+    cfg = get_model_config('tiny')
+    pcfg = PipelineConfig(rollout_replicas=2, max_staleness=3,
+                          queue_batches=2, refresh_mode='step',
+                          refresh_concurrency=1,
+                          store=str(tmp_path / 'store'))
+    pipe = RLPipeline(cfg, pcfg, steps=4, prompts_per_step=2,
+                      group_size=2, prompt_len=4, max_new_tokens=4,
+                      num_prompts=16, max_slots=4)
+    with inject_faults(
+            clause(rl_pipeline.LEARN_STEP_SITE, 'Exception', times=1),
+            clause(rl_pipeline.ROLLOUT_GENERATE_SITE, 'Exception',
+                   times=1),
+            clause(rl_pipeline.REFRESH_PULL_SITE, 'OSError', times=1)):
+        summary = pipe.run()
+
+    assert summary['steps'] == 4
+    assert summary['learn_faults'] == 1
+    assert summary['batches_requeued'] >= 1      # front-requeued, re-fed
+    assert summary['worker_errors'] == 1         # the killed wave
+    assert summary['refresh_errors'] >= 1        # the killed pull
+    # The three contracts: staleness bound, conservation, liveness.
+    assert summary['staleness_max'] <= pcfg.max_staleness
+    assert summary['batches_unretired'] == summary['batches_produced'] \
+        - summary['batches_acked']
+    assert summary['batches_acked'] >= 4
+    assert summary['refreshes'] >= 1             # live refresh happened
+    assert summary['rollout_tokens'] > 0
+
+
+# --------------------------------------------------------------------
+# Simulation: the rl_pipeline library scenario
+# --------------------------------------------------------------------
+
+
+def test_rl_scenario_chaos_invariants():
+    from skypilot_tpu.sim import runner, scenario as scenario_lib
+    scn = scenario_lib.load_library('rl_pipeline')
+    report = runner.run_scenario(scn)
+    preempts = [e for e in report.events
+                if e['kind'] == 'learner_preempt']
+    assert preempts and preempts[0]['requeued'] >= 1
+    reclaims = [e for e in report.events
+                if e['kind'] == 'spot_reclaim']
+    assert reclaims and reclaims[0]['reclaimed'] >= 1
+    assert report.failed_invariants(scn.invariants) == []
+    s = report.summary
+    assert s['rl_lost_batches'] == 0
+    assert s['rl_staleness_max'] <= 8
+    assert s['rl_throughput_fraction'] >= 0.9
+    assert s['rl_refreshes'] > 0
+
+
+def test_rl_scenario_validation_and_scale():
+    from skypilot_tpu.sim.scenario import Scenario
+    base = {'name': 's', 'duration_s': 100,
+            'fleet': {'initial_replicas': 4,
+                      'rl': {'learn_step_s': 2.0}},
+            'faults': [{'at': 10, 'kind': 'learner_preempt'}]}
+    scn = Scenario.from_dict(base)
+    # Learner consumption rate scales WITH the fleet, or a shrunk
+    # smoke run changes the behavior under test.
+    half = scn.scale(0.5)
+    assert half.fleet['rl']['learn_step_s'] == pytest.approx(4.0)
+
+    with pytest.raises(ValueError, match='fleet.rl'):
+        Scenario.from_dict({'name': 's', 'duration_s': 100,
+                            'faults': [{'at': 10,
+                                        'kind': 'learner_preempt'}]})
+    with pytest.raises(ValueError, match='refresh_mode'):
+        Scenario.from_dict({'name': 's', 'duration_s': 100,
+                            'fleet': {'rl': {'refresh_mode': 'hot'}}})

@@ -64,6 +64,9 @@ def test_queue_runs_jobs_in_order():
     assert [j['status'] for j in jobs] == ['SUCCEEDED', 'SUCCEEDED']
 
 
+# r20 triage: 9s two-job soak; queue sharing is pinned by the faster
+# daemon scheduling tests
+@pytest.mark.slow
 def test_concurrent_cpu_job_shares_cluster_with_tpu_job():
     """VERDICT r3 weak #2: the daemon ran one job at a time, so a quick
     CPU job queued behind a long training run. Now CPU-only jobs share;
@@ -132,6 +135,8 @@ def test_gang_kill_on_rank_failure():
     assert time.time() - t0 < 60  # did not wait for the 120s sleep
 
 
+# r20 triage: 4s wall-clock idle wait
+@pytest.mark.slow
 def test_autostop_stops_idle_cluster():
     task = _task('echo quick', accel='tpu-v5e-8')
     task.resources[0] = Resources(cloud='fake', accelerators='tpu-v5e-8',

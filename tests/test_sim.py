@@ -207,6 +207,7 @@ _LIBRARY_SCALE = {
     'cold_start_convoy': 0.05,
     'disagg_saturation': 0.05,
     'adapter_churn': 0.05,
+    'rl_pipeline': 1.0,  # already smoke-sized (8-replica fleet)
 }
 
 

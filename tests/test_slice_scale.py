@@ -49,6 +49,9 @@ def _wait_status(cluster, job_id, statuses, timeout=180):
         f'job {job_id} never reached {statuses}: {core.queue(cluster)}')
 
 
+# r20 triage: 29s deadline soak; admission logic also covered by the
+# gang-cancel and fast slice tests
+@pytest.mark.slow
 def test_slice_width_admission_and_channel_tail():
     """One job gang-starts across all 32 hosts; every rank runs with
     the right identity envs, and queue/log reads ride the channel."""
@@ -85,6 +88,9 @@ def test_slice_width_admission_and_channel_tail():
     assert f'of {NUM_HOSTS}' in log
 
 
+# r20 triage: 14s multi-rank soak; gang-cancel semantics are also
+# pinned by simkit gang scenarios
+@pytest.mark.slow
 def test_slice_width_gang_cancel_reaps_all_ranks():
     """Cancel mid-run: the daemon's gang kill must reap the rank
     process on every one of the 32 hosts, not just the head."""
@@ -118,6 +124,8 @@ def test_slice_width_gang_cancel_reaps_all_ranks():
                        f'at slice width')
 
 
+# r20 triage: 21s wall-clock straggler wait
+@pytest.mark.slow
 def test_slice_width_straggler_deadline(monkeypatch):
     """One wedged rank spawn out of 32: the gang-start deadline fails
     the job promptly and names the straggler, instead of 31 ranks

@@ -96,6 +96,9 @@ def test_launch_inside_allocation_end_to_end():
     assert provider.query_instances('hpc-e2e') == {}
 
 
+# r20 triage: 8s wall-clock queue wait; allocation and release are
+# pinned by the other slurm tests
+@pytest.mark.slow
 def test_allocation_queues_when_cluster_full():
     """3 fake nodes: a 2-node allocation + another 2-node request —
     the second stays PENDING and provisioning fails with CapacityError

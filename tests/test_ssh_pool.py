@@ -69,6 +69,9 @@ def test_inventory_parsing_shapes(tmp_home):
     assert pools['mixed']['hosts'][1]['port'] == 2222
 
 
+# r20 triage: 5s sshd end-to-end; allocation exclusivity keeps the pool
+# contract in tier 1
+@pytest.mark.slow
 def test_launch_on_byo_hosts_end_to_end():
     """Full SSH-cluster path against inventory hosts: rank env, queue,
     logs, teardown releases the allocation."""

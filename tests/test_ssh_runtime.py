@@ -60,6 +60,9 @@ def _host_root(cluster, node, worker):
                         f'{node}-{worker}')
 
 
+# r20 triage: 6s sshd round-trips; the cancel-detached test keeps the
+# detached-exec path in tier 1
+@pytest.mark.slow
 def test_detached_exec_queue_logs_on_ssh_cluster():
     """The headline fix: detach on an SSH cluster must NOT fall back to
     foreground -- the job runs under the head daemon, and queue/logs read
@@ -122,7 +125,9 @@ def test_cancel_detached_job_gang_kills_remote_ranks():
     assert job['status'] == 'CANCELLED'
 
     # the daemon must reap the rank processes (remote kill protocol)
-    deadline = time.time() + 20
+    # generous under full-suite load on a 1-core host; exits as soon
+    # as the ranks are reaped, so the happy path stays fast
+    deadline = time.time() + 60
     while time.time() < deadline:
         import psutil
         alive = [p.pid for p in psutil.process_iter(['cmdline'])
@@ -133,6 +138,8 @@ def test_cancel_detached_job_gang_kills_remote_ranks():
     assert not alive, f'rank procs survived cancel: {alive}'
 
 
+# r20 triage: 8s wall-clock deadline wait
+@pytest.mark.slow
 def test_gang_start_straggler_fails_within_deadline(monkeypatch):
     """SURVEY §7 hard-parts bullet 3 (VERDICT r3 weak #6): a rank whose
     SSH spawn hangs never reaches 'started'; the daemon must fail the
